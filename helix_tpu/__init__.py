@@ -1,0 +1,26 @@
+"""helix-tpu: a TPU-native agent-fleet + GenAI serving/training framework.
+
+A ground-up rebuild of the capabilities of helixml/helix (see SURVEY.md) whose
+accelerator plane is JAX/XLA/Pallas on TPU instead of vLLM-CUDA containers:
+
+- ``helix_tpu.device``   — TPU topology + HBM accounting (replaces
+  ``api/pkg/gpudetect`` + ``api/pkg/runner/gpuarch`` in the reference).
+- ``helix_tpu.ops``      — Pallas TPU kernels (flash/paged attention, norms)
+  with pure-XLA reference paths for CPU testing.
+- ``helix_tpu.models``   — model families (Llama, Phi, Qwen2-VL, BGE) as
+  functional JAX code over parameter pytrees.
+- ``helix_tpu.parallel`` — mesh construction, logical sharding rules, ring
+  attention / sequence parallelism (replaces NCCL-inside-vLLM with XLA
+  collectives over ICI/DCN).
+- ``helix_tpu.engine``   — the serving engine: paged KV cache, continuous
+  batching scheduler, sampling, HBM-accounted multi-model residency
+  (replaces the vLLM container + ``api/pkg/composemgr`` hot-swap).
+- ``helix_tpu.serving``  — OpenAI/Anthropic-compatible HTTP surface
+  (``/v1/chat/completions``, ``/v1/embeddings``, SSE streaming).
+- ``helix_tpu.training`` — SPMD LoRA SFT with checkpoint/resume (replaces the
+  reference's deleted axolotl path).
+- ``helix_tpu.control``  — control-plane: profiles, router, heartbeats,
+  session store (mirrors ``api/pkg/inferencerouter``, ``api/pkg/runner``).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,17 @@
+from helix_tpu.device.detect import (
+    AcceleratorStatus,
+    detect_accelerators,
+    tpu_generation,
+    total_hbm_bytes,
+)
+from helix_tpu.device.mesh import MeshSpec, build_mesh, slice_devices
+
+__all__ = [
+    "AcceleratorStatus",
+    "detect_accelerators",
+    "tpu_generation",
+    "total_hbm_bytes",
+    "MeshSpec",
+    "build_mesh",
+    "slice_devices",
+]

@@ -1,0 +1,12 @@
+from helix_tpu.ops.norms import rms_norm, layer_norm
+from helix_tpu.ops.rope import apply_rope, rope_frequencies
+from helix_tpu.ops.attention import flash_attention, mha_reference
+
+__all__ = [
+    "rms_norm",
+    "layer_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "flash_attention",
+    "mha_reference",
+]

@@ -1,0 +1,34 @@
+"""Normalisation ops.
+
+Kept as plain XLA: norm -> matmul chains fuse well under the TPU compiler
+(elementwise ops fold into the adjacent MXU op's epilogue), so a Pallas
+kernel here would only pessimise scheduling.  Accumulation is fp32 even for
+bf16 activations — matches what the MXU wants and avoids bf16 variance
+underflow.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6, offset: float = 0.0):
+    """RMSNorm (Llama/Qwen style). ``offset=1.0`` gives Gemma's (1+w) form."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * (offset + weight.astype(jnp.float32))).astype(dtype)
+
+
+def layer_norm(x, weight, bias=None, eps: float = 1e-5):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mean) ** 2, axis=-1, keepdims=True)
+    normed = (xf - mean) * (var + eps) ** -0.5
+    out = normed * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(dtype)

@@ -1,0 +1,56 @@
+"""Rotary position embeddings (RoPE), including Llama-3 frequency scaling.
+
+Computed on the fly from position ids rather than precomputed tables so the
+same function serves ragged prefill (arbitrary positions per token) and
+decode (one position per sequence) without gather ops that would break XLA
+fusion.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 500000.0,
+    scaling: dict | None = None,
+) -> np.ndarray:
+    """Inverse frequencies, with optional Llama-3-style rope scaling.
+
+    ``scaling`` follows HF config ``rope_scaling`` with
+    ``rope_type=llama3``: {factor, low_freq_factor, high_freq_factor,
+    original_max_position_embeddings}.
+    """
+    inv_freq = 1.0 / (
+        theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim)
+    )
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
+        factor = scaling["factor"]
+        low = scaling["low_freq_factor"]
+        high = scaling["high_freq_factor"]
+        orig = scaling["original_max_position_embeddings"]
+        wavelen = 2 * np.pi / inv_freq
+        # three bands: high-freq untouched, low-freq divided by factor,
+        # middle smoothly interpolated
+        smooth = (orig / wavelen - low) / (high - low)
+        smooth = np.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / factor
+        inv_freq = (1 - smooth) * scaled + smooth * inv_freq
+    return inv_freq.astype(np.float32)
+
+
+def apply_rope(x, positions, inv_freq):
+    """Rotate q or k.
+
+    x:         [..., seq, heads, head_dim]
+    positions: broadcastable to [..., seq] (int32)
+    inv_freq:  [head_dim // 2]
+    """
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., seq, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., seq, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,13 @@
+from helix_tpu.parallel.sharding import (
+    LOGICAL_RULES,
+    logical_sharding,
+    shard_params,
+    with_constraint,
+)
+
+__all__ = [
+    "LOGICAL_RULES",
+    "logical_sharding",
+    "shard_params",
+    "with_constraint",
+]

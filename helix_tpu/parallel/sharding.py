@@ -1,0 +1,99 @@
+"""Logical-axis sharding rules: pick a mesh, annotate, let XLA insert collectives.
+
+The reference delegates all intra-model parallelism to vLLM's NCCL world
+(``SURVEY.md`` §2.2); here sharding is owned by the framework.  Every weight
+and activation carries *logical* axis names ("embed", "heads", "mlp", …);
+``LOGICAL_RULES`` maps those to mesh axes from ``helix_tpu.device.mesh``.
+``jax.jit`` + ``NamedSharding`` then compile in the right all-gathers /
+reduce-scatters over ICI — no hand-written collectives on the hot path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis name -> mesh axis (or tuple of mesh axes, or None = replicated)
+# Megatron-style layout: attention heads and the MLP hidden dim shard over
+# tp; embedding/vocab shards over tp for the big matmuls; batch shards over
+# dp; sequence shards over sp (ring attention); weights optionally shard
+# over fsdp on their non-tp axis.
+LOGICAL_RULES: dict[str, Any] = {
+    # activations
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed_act": None,
+    # weights
+    "vocab": "tp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "expert": "ep",
+    # kv cache
+    "cache_batch": ("dp", "fsdp"),
+    "cache_heads": "tp",
+    "pages": None,
+    # lora
+    "lora_rank": None,
+}
+
+
+def spec_for(logical_axes: Sequence[Optional[str]], rules=None) -> P:
+    """Translate a tuple of logical axis names into a PartitionSpec."""
+    rules = rules or LOGICAL_RULES
+    return P(*[rules.get(a) if a is not None else None for a in logical_axes])
+
+
+def logical_sharding(
+    mesh: Mesh, logical_axes: Sequence[Optional[str]], rules=None
+) -> NamedSharding:
+    return NamedSharding(mesh, spec_for(logical_axes, rules))
+
+
+def _prune_spec_for_mesh(mesh: Mesh, spec: P) -> P:
+    """Drop mesh axes of size 1 (keeps XLA layouts clean) and axes the mesh
+    does not define."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def keep(ax):
+        if ax is None:
+            return None
+        if isinstance(ax, (tuple, list)):
+            kept = tuple(a for a in ax if sizes.get(a, 1) > 1)
+            return kept if kept else None
+        return ax if sizes.get(ax, 1) > 1 else None
+
+    return P(*[keep(a) for a in spec])
+
+
+def with_constraint(x, mesh: Mesh, logical_axes: Sequence[Optional[str]]):
+    """``lax.with_sharding_constraint`` via logical names (activation pins)."""
+    spec = _prune_spec_for_mesh(mesh, spec_for(logical_axes))
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def shard_params(params: Any, mesh: Mesh, axes_tree: Any) -> Any:
+    """Device-put a parameter pytree according to a matching tree of logical
+    axis tuples (the pytree analogue of flax's ``partitioning`` metadata but
+    without a framework dependency — params stay plain dicts of jax.Arrays).
+    """
+
+    def place(leaf, axes):
+        spec = _prune_spec_for_mesh(mesh, spec_for(axes))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, params, axes_tree)
+
+
+def sharding_tree(mesh: Mesh, axes_tree: Any) -> Any:
+    """Tree of NamedShardings from a tree of logical-axes tuples (for use as
+    ``jit(..., in_shardings=...)``)."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, _prune_spec_for_mesh(mesh, spec_for(axes))),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )

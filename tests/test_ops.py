@@ -1,0 +1,159 @@
+"""Numerics tests for core ops against independent references.
+
+Modeled on the reference's co-located unit-test style (``SURVEY.md`` §4) —
+every op gets an oracle comparison, Pallas kernels run in interpret mode.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from helix_tpu.ops.attention import flash_attention, mha_reference
+from helix_tpu.ops.norms import layer_norm, rms_norm
+from helix_tpu.ops.rope import apply_rope, rope_frequencies
+
+
+class TestNorms:
+    def test_rms_norm_matches_numpy(self, rng):
+        x = jax.random.normal(rng, (4, 32), dtype=jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (32,)) * 0.1 + 1.0
+        got = rms_norm(x, w)
+        xn = np.asarray(x, dtype=np.float64)
+        expect = xn / np.sqrt((xn**2).mean(-1, keepdims=True) + 1e-6) * np.asarray(w)
+        np.testing.assert_allclose(got, expect, rtol=2e-5, atol=2e-5)
+
+    def test_rms_norm_bf16_returns_bf16(self, rng):
+        x = jax.random.normal(rng, (2, 16), dtype=jnp.bfloat16)
+        w = jnp.ones((16,), dtype=jnp.bfloat16)
+        assert rms_norm(x, w).dtype == jnp.bfloat16
+
+    def test_layer_norm(self, rng):
+        x = jax.random.normal(rng, (4, 32))
+        w = jnp.ones((32,))
+        b = jnp.zeros((32,))
+        got = np.asarray(layer_norm(x, w, b))
+        assert abs(got.mean(-1)).max() < 1e-5
+        np.testing.assert_allclose(got.std(-1), 1.0, rtol=1e-3)
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self, rng):
+        x = jax.random.normal(rng, (1, 8, 2, 64))
+        inv = rope_frequencies(64, theta=10000.0)
+        pos = jnp.arange(8)[None]
+        y = apply_rope(x, pos, inv)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=-1),
+            np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-4,
+        )
+
+    def test_position_zero_is_identity(self, rng):
+        x = jax.random.normal(rng, (1, 1, 2, 64))
+        inv = rope_frequencies(64)
+        y = apply_rope(x, jnp.zeros((1, 1), jnp.int32), inv)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), atol=1e-6)
+
+    def test_relative_property(self, rng):
+        # <rope(q, m), rope(k, n)> depends only on m - n
+        q = jax.random.normal(rng, (1, 1, 1, 64))
+        k = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1, 64))
+        inv = rope_frequencies(64)
+
+        def dot_at(m, n):
+            qr = apply_rope(q, jnp.array([[m]]), inv)
+            kr = apply_rope(k, jnp.array([[n]]), inv)
+            return float(jnp.sum(qr * kr))
+
+        assert dot_at(5, 3) == pytest.approx(dot_at(102, 100), rel=1e-4)
+
+    def test_llama3_scaling_changes_low_freqs(self):
+        base = rope_frequencies(64, theta=500000.0)
+        scaled = rope_frequencies(
+            64,
+            theta=500000.0,
+            scaling=dict(
+                rope_type="llama3",
+                factor=8.0,
+                low_freq_factor=1.0,
+                high_freq_factor=4.0,
+                original_max_position_embeddings=8192,
+            ),
+        )
+        # highest-frequency components untouched, lowest divided by ~factor
+        np.testing.assert_allclose(scaled[0], base[0], rtol=1e-6)
+        assert scaled[-1] < base[-1] / 4
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("kvh", [4, 1])  # MHA and GQA
+    def test_matches_reference_causal(self, rng, kvh):
+        B, S, H, D = 2, 128, 4, 64
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), dtype=jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, kvh, D), dtype=jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, kvh, D), dtype=jnp.float32)
+        got = flash_attention(
+            q, k, v, causal=True, block_q=64, block_kv=64, interpret=True
+        )
+        want = mha_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_segment_mask(self, rng):
+        B, S, H, D = 1, 128, 2, 64
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (B, S, H, D))
+        k = jax.random.normal(ks[1], (B, S, H, D))
+        v = jax.random.normal(ks[2], (B, S, H, D))
+        seg = (jnp.arange(S)[None] >= 64).astype(jnp.int32)
+        # positions restart within each packed segment
+        pos = jnp.concatenate([jnp.arange(64), jnp.arange(64)])[None]
+        got = flash_attention(
+            q, k, v,
+            q_positions=pos, kv_positions=pos,
+            q_segment_ids=seg, kv_segment_ids=seg,
+            causal=True, block_q=64, block_kv=64, interpret=True,
+        )
+        want = mha_reference(
+            q, k, v,
+            q_positions=pos, kv_positions=pos,
+            q_segment_ids=seg, kv_segment_ids=seg,
+            causal=True,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+        # second segment's first token must equal attention over itself only
+        solo = mha_reference(
+            q[:, 64:65], k[:, 64:65], v[:, 64:65], causal=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[:, 64]), np.asarray(solo[:, 0]), atol=2e-5
+        )
+
+    def test_soft_cap(self, rng):
+        B, S, H, D = 1, 64, 2, 64
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (B, S, H, D)) * 3
+        k = jax.random.normal(ks[1], (B, S, H, D)) * 3
+        v = jax.random.normal(ks[2], (B, S, H, D))
+        got = flash_attention(
+            q, k, v, causal=True, logits_soft_cap=20.0,
+            block_q=64, block_kv=64, interpret=True,
+        )
+        want = mha_reference(q, k, v, causal=True, logits_soft_cap=20.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    def test_cross_attention_shapes(self, rng):
+        # Sq != Skv (e.g. chunked prefill appending to existing KV)
+        B, H, D = 1, 2, 64
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (B, 64, H, D))
+        k = jax.random.normal(ks[1], (B, 128, H, D))
+        v = jax.random.normal(ks[2], (B, 128, H, D))
+        qpos = jnp.arange(64, 128)[None]
+        got = flash_attention(
+            q, k, v, q_positions=qpos, causal=True,
+            block_q=64, block_kv=64, interpret=True,
+        )
+        want = mha_reference(q, k, v, q_positions=qpos, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
