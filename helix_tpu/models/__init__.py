@@ -1,0 +1,8 @@
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import (
+    init_params,
+    forward,
+    param_logical_axes,
+)
+
+__all__ = ["ModelConfig", "init_params", "forward", "param_logical_axes"]

@@ -1,0 +1,234 @@
+"""Llama-family decoder as functional JAX over a parameter pytree.
+
+Covers Llama-3, Phi-3 (MHA, fused-free), Qwen-2 (attention bias), Qwen-3
+(qk-norm) via ``ModelConfig`` switches — the decoder families the reference
+serves through vLLM containers (``design/sample-profiles/``), here as owned
+TPU-first code:
+
+- Layers are **stacked** (every weight has a leading ``num_layers`` dim) and
+  the forward pass is a single ``lax.scan`` — one layer gets traced/compiled
+  once regardless of depth, keeping XLA compile times flat.
+- Attention is injected (``attn_fn``) so the same forward serves training
+  (flash attention), prefill (flash + segment masks) and decode (paged
+  attention over the engine's KV cache) without re-tracing model code.
+- All matmuls run in bf16 on the MXU with fp32 accumulation
+  (``preferred_element_type``); norms/softmax in fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.ops.norms import rms_norm
+from helix_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = dict
+# attn_fn(q, k, v, layer_cache, positions) -> attention output
+AttnFn = Callable[..., jax.Array]
+
+
+def _dense(x, w, b=None):
+    """x @ w with fp32 MXU accumulation; w may be rank-2 or fused rank-3."""
+    out = jax.lax.dot_general(
+        x,
+        w,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _act(name: str):
+    if name == "silu":
+        return jax.nn.silu
+    if name in ("gelu", "gelu_new", "gelu_pytorch_tanh", "gelu_tanh"):
+        return functools.partial(jax.nn.gelu, approximate=True)
+    raise ValueError(f"unknown activation {name}")
+
+
+def init_params(
+    cfg: ModelConfig, key: jax.Array, dtype=None
+) -> Params:
+    """Random-init a stacked-layer parameter tree (tests, training-from-init).
+
+    Real checkpoints come from ``helix_tpu.models.loader`` which produces the
+    same tree from HF safetensors.
+    """
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    L, E, H, KVH, D, F, V = (
+        cfg.num_layers,
+        cfg.hidden_size,
+        cfg.num_heads,
+        cfg.num_kv_heads,
+        cfg.head_dim,
+        cfg.intermediate_size,
+        cfg.vocab_size,
+    )
+    ks = jax.random.split(key, 8)
+
+    def norm(k, shape, scale=0.02):
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params = {
+        "embed": {"weight": norm(ks[0], (V, E))},
+        "layers": {
+            "attn_norm": {"weight": jnp.ones((L, E), dtype)},
+            "mlp_norm": {"weight": jnp.ones((L, E), dtype)},
+            "wq": {"weight": norm(ks[1], (L, E, H * D))},
+            "wk": {"weight": norm(ks[2], (L, E, KVH * D))},
+            "wv": {"weight": norm(ks[3], (L, E, KVH * D))},
+            "wo": {"weight": norm(ks[4], (L, H * D, E))},
+            "w_gate": {"weight": norm(ks[5], (L, E, F))},
+            "w_up": {"weight": norm(ks[6], (L, E, F))},
+            "w_down": {"weight": norm(ks[7], (L, F, E))},
+        },
+        "final_norm": {"weight": jnp.ones((E,), dtype)},
+    }
+    if cfg.attention_bias:
+        for nm, width in (("wq", H * D), ("wk", KVH * D), ("wv", KVH * D)):
+            params["layers"][nm]["bias"] = jnp.zeros((L, width), dtype)
+    if cfg.qk_norm:
+        params["layers"]["q_norm"] = {"weight": jnp.ones((L, D), dtype)}
+        params["layers"]["k_norm"] = {"weight": jnp.ones((L, D), dtype)}
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = {"weight": norm(jax.random.fold_in(key, 99), (E, V))}
+    return params
+
+
+def param_logical_axes(cfg: ModelConfig) -> Any:
+    """Tree of logical-axis tuples matching ``init_params`` (leading "layers"
+    axis on stacked weights is unsharded)."""
+    lax_ = {
+        "attn_norm": {"weight": (None, None)},
+        "mlp_norm": {"weight": (None, None)},
+        "wq": {"weight": (None, "embed", "heads")},
+        "wk": {"weight": (None, "embed", "kv_heads")},
+        "wv": {"weight": (None, "embed", "kv_heads")},
+        "wo": {"weight": (None, "heads", "embed")},
+        "w_gate": {"weight": (None, "embed", "mlp")},
+        "w_up": {"weight": (None, "embed", "mlp")},
+        "w_down": {"weight": (None, "mlp", "embed")},
+    }
+    if cfg.attention_bias:
+        lax_["wq"]["bias"] = (None, "heads")
+        lax_["wk"]["bias"] = (None, "kv_heads")
+        lax_["wv"]["bias"] = (None, "kv_heads")
+    if cfg.qk_norm:
+        lax_["q_norm"] = {"weight": (None, None)}
+        lax_["k_norm"] = {"weight": (None, None)}
+    axes = {
+        "embed": {"weight": ("vocab", "embed")},
+        "layers": lax_,
+        "final_norm": {"weight": (None,)},
+    }
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = {"weight": ("embed", "vocab")}
+    return axes
+
+
+def _layer(
+    h,
+    layer_params: Params,
+    layer_cache,
+    cfg: ModelConfig,
+    positions,
+    inv_freq,
+    attn_fn: AttnFn,
+):
+    """One decoder block. h: [B, S, E]."""
+    B, S, E = h.shape
+    H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    p = layer_params
+
+    # --- attention ---
+    x = rms_norm(h, p["attn_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
+    q = _dense(x, p["wq"]["weight"], p["wq"].get("bias")).reshape(B, S, H, D)
+    k = _dense(x, p["wk"]["weight"], p["wk"].get("bias")).reshape(B, S, KVH, D)
+    v = _dense(x, p["wv"]["weight"], p["wv"].get("bias")).reshape(B, S, KVH, D)
+    if cfg.qk_norm:
+        q = rms_norm(q, p["q_norm"]["weight"], cfg.rms_norm_eps)
+        k = rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
+    q = apply_rope(q, positions, inv_freq)
+    k = apply_rope(k, positions, inv_freq)
+    attn_out = attn_fn(q, k, v, layer_cache, positions)
+    h = h + _dense(attn_out.reshape(B, S, H * D), p["wo"]["weight"])
+
+    # --- mlp ---
+    x = rms_norm(h, p["mlp_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
+    act = _act(cfg.hidden_act)
+    gate = _dense(x, p["w_gate"]["weight"], p["w_gate"].get("bias"))
+    up = _dense(x, p["w_up"]["weight"], p["w_up"].get("bias"))
+    h = h + _dense(act(gate) * up, p["w_down"]["weight"], p["w_down"].get("bias"))
+    return h, (k, v)
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens,               # [B, S] int32
+    positions,            # [B, S] int32 (absolute, ragged-aware)
+    *,
+    attn_fn: AttnFn,
+    layer_caches=None,    # pytree whose leaves have leading num_layers dim
+    return_hidden: bool = False,
+):
+    """Run the decoder. Returns (logits [B, S, V], kv) where kv is the
+    per-layer fresh K/V stacked to [L, B, S, KVH, D] — the engine scatters
+    these into its paged cache in one op after the call."""
+    inv_freq = jnp.asarray(
+        rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
+    )
+    h = params["embed"]["weight"][tokens].astype(jnp.dtype(cfg.dtype))
+
+    def scan_body(h, xs):
+        layer_params, layer_cache = xs
+        h, kv = _layer(
+            h, layer_params, layer_cache, cfg, positions, inv_freq, attn_fn
+        )
+        return h, kv
+
+    if layer_caches is None:
+        # lax.scan needs every xs leaf to have a leading L dim; "no history"
+        # is a zero-length dummy the attn_fn never touches.
+        layer_caches = jnp.zeros((cfg.num_layers, 0), jnp.int32)
+    xs = (params["layers"], layer_caches)
+
+    h, kv = jax.lax.scan(scan_body, h, xs)
+    h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
+    if return_hidden:
+        return h, kv
+    if cfg.tie_word_embeddings:
+        w_out = params["embed"]["weight"].T
+    else:
+        w_out = params["lm_head"]["weight"]
+    logits = jax.lax.dot_general(
+        h, w_out, (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if cfg.logits_soft_cap:
+        logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
+    return logits, kv
+
+
+def prefill_attn_fn(q, k, v, layer_cache, positions, *, segment_ids=None,
+                    backend=None, soft_cap=None):
+    """Self-attention over the freshly computed K/V (no history)."""
+    from helix_tpu.ops.attention import attention
+
+    return attention(
+        q, k, v,
+        causal=True,
+        q_positions=positions,
+        kv_positions=positions,
+        q_segment_ids=segment_ids,
+        kv_segment_ids=segment_ids,
+        logits_soft_cap=soft_cap,
+        backend=backend,
+    )
