@@ -1,0 +1,154 @@
+"""helix-tpu CLI.
+
+The counterpart of the reference's cobra CLI (``api/cmd/helix/root.go:45-72``
+— serve/apply/chat/...), argparse-based:
+
+- ``serve``      — control plane (router, profiles, heartbeats, sessions,
+                   OpenAI passthrough).  Reference: ``helix serve``.
+- ``serve-node`` — TPU node agent: applies a serving profile as in-process
+  Engines and exposes the OpenAI surface.  Replaces the reference's sandbox
+  node stack (compose-manager + inference-proxy + heartbeat).
+- ``profile``    — validate / describe profile YAML (composeparse analogue).
+- ``chat``       — one-shot chat against a server (reference: ``helix chat``).
+- ``bench``      — run the standard benchmark.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _cmd_serve_node(args) -> int:
+    from aiohttp import web
+
+    from helix_tpu.control.node_agent import NodeAgent
+    from helix_tpu.control.profile import ServingProfile
+    from helix_tpu.serving.openai_api import OpenAIServer
+
+    agent = NodeAgent(
+        runner_id=args.runner_id,
+        heartbeat_url=args.control_plane,
+        heartbeat_interval=args.heartbeat_interval,
+        address=args.advertise or f"http://127.0.0.1:{args.port}",
+    )
+    if args.profile:
+        with open(args.profile) as f:
+            profile = ServingProfile.from_yaml(f.read())
+        state = agent.apply_profile(profile)
+        if state.status == "failed":
+            print(f"profile apply failed: {state.error}", file=sys.stderr)
+            return 1
+        print(f"profile '{profile.name}' running: {state.models}")
+    if args.control_plane:
+        agent.start_heartbeat(poll_assignment=not args.profile)
+    server = OpenAIServer(agent.registry)
+    app = server.build_app()
+
+    # expose agent state for the control plane / debugging
+    async def state_handler(request):
+        return web.json_response(agent.heartbeat_payload())
+
+    app.router.add_get("/api/v1/state", state_handler)
+    print(f"helix-tpu node listening on {args.host}:{args.port}")
+    web.run_app(app, host=args.host, port=args.port, print=None)
+    return 0
+
+
+def _cmd_serve(args) -> int:
+    from aiohttp import web
+
+    from helix_tpu.control.server import ControlPlane
+
+    cp = ControlPlane(db_path=args.db)
+    print(f"helix-tpu control plane listening on {args.host}:{args.port}")
+    web.run_app(cp.build_app(), host=args.host, port=args.port, print=None)
+    return 0
+
+
+def _cmd_profile(args) -> int:
+    from helix_tpu.control.profile import ServingProfile
+
+    with open(args.file) as f:
+        profile = ServingProfile.from_yaml(f.read())
+    errors = profile.validate()
+    out = {
+        "name": profile.name,
+        "models": profile.model_names,
+        "requirement": profile.requirement.to_dict(),
+        "valid": not errors,
+        "errors": errors,
+    }
+    print(json.dumps(out, indent=2))
+    return 0 if not errors else 1
+
+
+def _cmd_chat(args) -> int:
+    import requests
+
+    r = requests.post(
+        f"{args.url}/v1/chat/completions",
+        json={
+            "model": args.model,
+            "messages": [{"role": "user", "content": args.message}],
+            "max_tokens": args.max_tokens,
+            "temperature": args.temperature,
+        },
+        timeout=600,
+    )
+    if r.status_code != 200:
+        print(r.text, file=sys.stderr)
+        return 1
+    print(r.json()["choices"][0]["message"]["content"])
+    return 0
+
+
+def _cmd_bench(args) -> int:
+    import runpy
+
+    runpy.run_module("bench", run_name="__main__")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="helix-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    n = sub.add_parser("serve-node", help="run a TPU serving node")
+    n.add_argument("--profile", help="profile YAML to apply at boot")
+    n.add_argument("--runner-id", default="node-0")
+    n.add_argument("--host", default="0.0.0.0")
+    n.add_argument("--port", type=int, default=8000)
+    n.add_argument("--control-plane", help="control plane base URL")
+    n.add_argument("--heartbeat-interval", type=float, default=30.0)
+    n.add_argument("--advertise", help="address the control plane dials back")
+    n.set_defaults(fn=_cmd_serve_node)
+
+    s = sub.add_parser("serve", help="run the control plane")
+    s.add_argument("--host", default="0.0.0.0")
+    s.add_argument("--port", type=int, default=8080)
+    s.add_argument("--db", default="helix.db")
+    s.set_defaults(fn=_cmd_serve)
+
+    pr = sub.add_parser("profile", help="validate a profile YAML")
+    pr.add_argument("file")
+    pr.set_defaults(fn=_cmd_profile)
+
+    c = sub.add_parser("chat", help="one-shot chat against a server")
+    c.add_argument("message")
+    c.add_argument("--url", default="http://127.0.0.1:8000")
+    c.add_argument("--model", required=True)
+    c.add_argument("--max-tokens", type=int, default=256)
+    c.add_argument("--temperature", type=float, default=0.0)
+    c.set_defaults(fn=_cmd_chat)
+
+    b = sub.add_parser("bench", help="run the standard benchmark")
+    b.set_defaults(fn=_cmd_bench)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

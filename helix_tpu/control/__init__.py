@@ -1,0 +1,16 @@
+from helix_tpu.control.profile import (
+    ProfileModel,
+    ProfileRequirement,
+    ServingProfile,
+    check_compatibility,
+)
+from helix_tpu.control.router import InferenceRouter, RunnerState
+
+__all__ = [
+    "ProfileModel",
+    "ProfileRequirement",
+    "ServingProfile",
+    "check_compatibility",
+    "InferenceRouter",
+    "RunnerState",
+]

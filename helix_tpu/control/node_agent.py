@@ -1,0 +1,212 @@
+"""TPU node agent: applies serving profiles and heartbeats to the control plane.
+
+The single-process TPU replacement for the reference's on-node stack
+(``SURVEY.md`` §2.2/§3.3): compose-manager (``composemgr/manager.go:161``
+``Apply``: pull -> down old -> up -> poll health), inference-proxy (model ->
+container port routing) and sandbox-heartbeat (30s POST with GPU inventory).
+Here "apply" means: diff the assigned profile against running Engines, tear
+down removed models, build added ones (load weights -> HBM, optionally
+int8), register them in the ModelRegistry the OpenAI surface routes by, and
+publish state through the same lifecycle strings the router gates on
+(assigning | loading | starting | running | failed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+from helix_tpu.control.profile import ProfileModel, ServingProfile
+from helix_tpu.device.detect import detect_accelerators
+from helix_tpu.serving.registry import ModelRegistry, ServedModel
+
+
+@dataclasses.dataclass
+class ApplyState:
+    status: str = "assigning"       # assigning|loading|starting|running|failed
+    profile_name: str = ""
+    models: list = dataclasses.field(default_factory=list)
+    error: str = ""
+    progress: dict = dataclasses.field(default_factory=dict)  # model -> phase
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
+    """Realise one ProfileModel as a ServedModel (engine or embedder)."""
+    import jax
+
+    from helix_tpu.serving.tokenizer import load_tokenizer
+
+    tokenizer = load_tokenizer(pm.checkpoint, pm.name)
+
+    if pm.kind == "embedding":
+        from helix_tpu.models.bge import EmbeddingRunner
+
+        embedder = EmbeddingRunner.build(pm, tokenizer)
+        return ServedModel(
+            name=pm.name, loop=None, tokenizer=tokenizer,
+            kind="embedding", embedder=embedder,
+            context_length=pm.context_length,
+        )
+
+    from helix_tpu.engine.engine import Engine, EngineConfig
+    from helix_tpu.models.common import CATALOG, ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.ops.quant import quantize_params
+    from helix_tpu.serving.engine_loop import EngineLoop
+
+    if pm.checkpoint:
+        from helix_tpu.models.loader import load_params
+
+        model_cfg, params = load_params(pm.checkpoint)
+        model_cfg = dataclasses.replace(model_cfg, name=pm.name)
+    else:
+        model_cfg = CATALOG.get(pm.name) or ModelConfig.tiny(name=pm.name)
+        params = init_params(model_cfg, jax.random.PRNGKey(0))
+    if pm.quantization == "int8":
+        params = jax.jit(quantize_params, donate_argnums=0)(params)
+
+    ecfg = EngineConfig(
+        eos_token_ids=tuple(tokenizer.eos_ids),
+        **{k: v for k, v in pm.engine.items()},
+    )
+    engine = Engine(model_cfg, params, ecfg)
+    loop = EngineLoop(engine, name=pm.name).start()
+    return ServedModel(
+        name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,
+        context_length=pm.context_length or model_cfg.max_position_embeddings,
+    )
+
+
+class NodeAgent:
+    """Owns the registry + apply loop + heartbeat loop for one TPU host."""
+
+    def __init__(
+        self,
+        runner_id: str,
+        registry: Optional[ModelRegistry] = None,
+        build_model: Callable = _build_served_model,
+        heartbeat_url: Optional[str] = None,
+        heartbeat_interval: float = 30.0,
+        address: str = "",
+    ):
+        self.runner_id = runner_id
+        self.address = address   # where the control plane can reach our OpenAI surface
+        self.registry = registry or ModelRegistry()
+        self.state = ApplyState()
+        self._build = build_model
+        self.heartbeat_url = heartbeat_url
+        self.heartbeat_interval = heartbeat_interval
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def apply_profile(self, profile: Optional[ServingProfile]) -> ApplyState:
+        """Diff-apply: never tears down a model the new profile keeps
+        (mirrors composemgr's no-prune-mid-swap rule, manager.go:1-23)."""
+        with self._lock:
+            if profile is None:
+                for name in list(self.registry.names()):
+                    self.registry.unregister(name)
+                self.state = ApplyState(status="running", profile_name="")
+                return self.state
+            errors = profile.validate()
+            if errors:
+                self.state = ApplyState(
+                    status="failed",
+                    profile_name=profile.name,
+                    error="; ".join(errors),
+                )
+                return self.state
+            self.state = ApplyState(
+                status="loading", profile_name=profile.name
+            )
+            try:
+                want = {m.name: m for m in profile.models}
+                for name in list(self.registry.names()):
+                    if name not in want:
+                        self.registry.unregister(name)
+                for name, pm in want.items():
+                    if self.registry.get(name) is None:
+                        self.state.progress[name] = "loading"
+                        self.registry.register(self._build(pm))
+                        self.state.progress[name] = "ready"
+                self.state.status = "running"
+                self.state.models = sorted(want)
+            except Exception as e:  # noqa: BLE001 — reported via status
+                self.state.status = "failed"
+                self.state.error = f"{e}\n{traceback.format_exc(limit=5)}"
+            return self.state
+
+    # ------------------------------------------------------------------
+    def heartbeat_payload(self) -> dict:
+        """Wire format mirrors the reference heartbeat body
+        (``api/cmd/sandbox-heartbeat/main.go:28-60``): id + accelerator
+        inventory + profile state."""
+        import shutil
+
+        disk = shutil.disk_usage("/")
+        return {
+            "runner_id": self.runner_id,
+            "address": self.address,
+            "accelerators": [a.to_dict() for a in detect_accelerators()],
+            "profile": {
+                "name": self.state.profile_name,
+                "status": self.state.status,
+                "models": self.registry.names(),
+                "error": self.state.error,
+                "progress": self.state.progress,
+            },
+            "disk": {"total": disk.total, "used": disk.used, "free": disk.free},
+            "ts": time.time(),
+        }
+
+    def start_heartbeat(self, poll_assignment: bool = True):
+        """30s heartbeat + assignment polling against the control plane
+        (the pull-based loop of ``SURVEY.md`` §3.3)."""
+        import requests
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    r = requests.post(
+                        f"{self.heartbeat_url}/api/v1/runners/"
+                        f"{self.runner_id}/heartbeat",
+                        json=self.heartbeat_payload(),
+                        timeout=10,
+                    )
+                    if poll_assignment:
+                        a = requests.get(
+                            f"{self.heartbeat_url}/api/v1/runners/"
+                            f"{self.runner_id}/assignment",
+                            timeout=10,
+                        )
+                        if a.status_code == 200:
+                            doc = a.json()
+                            prof = (
+                                ServingProfile.from_dict(doc["profile"])
+                                if doc.get("profile")
+                                else None
+                            )
+                            name = prof.name if prof else ""
+                            if name != self.state.profile_name:
+                                self.apply_profile(prof)
+                except Exception:  # noqa: BLE001 — keep beating
+                    pass
+                self._stop.wait(self.heartbeat_interval)
+
+        self._hb_thread = threading.Thread(
+            target=run, name="helix-heartbeat", daemon=True
+        )
+        self._hb_thread.start()
+
+    def stop(self):
+        self._stop.set()
+        for name in list(self.registry.names()):
+            self.registry.unregister(name)

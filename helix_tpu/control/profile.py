@@ -1,0 +1,196 @@
+"""Serving profiles: declarative model -> mesh-slice layout for a TPU host.
+
+The TPU-native equivalent of the reference's Runner Profiles — "a Docker
+Compose YAML of vLLM containers pinned to GPU device IDs"
+(``api/pkg/types/runner_profile.go:28-62``, parsed by
+``api/pkg/runner/composeparse/parse.go``).  Where a compose profile says
+"vllm serve X --tensor-parallel-size 2 on device_ids [0,1]", a serving
+profile says "model X on a tp=2 mesh at device offset 0"; the node agent
+realises it with in-process Engines instead of ``docker compose up``.
+
+Schema (YAML):
+
+    name: v5e8-llama3-plus-embed
+    requirement:            # operator-declared, mirrors ProfileGPURequirement
+      chips: 8
+      generation: v5e       # "" = any
+      min_hbm_bytes: 0
+    models:
+      - name: meta-llama/Meta-Llama-3-8B-Instruct
+        checkpoint: /models/llama3-8b
+        kind: chat
+        quantization: int8
+        mesh: {tp: 4, device_offset: 0}
+        engine: {max_decode_batch: 32, num_pages: 4096, page_size: 16}
+      - name: BAAI/bge-base-en-v1.5
+        kind: embedding
+        mesh: {tp: 1, device_offset: 4}
+
+``check_compatibility`` mirrors the 6-constraint check in
+``api/pkg/runner/profile/compatibility.go:50-124`` (count, vendor,
+architecture, model-match, min VRAM -> min HBM) against a heartbeat's
+accelerator inventory, returning structured violations the control plane
+surfaces as HTTP 422 detail.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import yaml
+
+from helix_tpu.device.detect import AcceleratorStatus
+from helix_tpu.device.mesh import MeshSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileModel:
+    name: str
+    checkpoint: Optional[str] = None     # dir with safetensors; None = random-init
+    kind: str = "chat"                   # chat | embedding | vision
+    quantization: Optional[str] = None   # None | "int8"
+    mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
+    engine: dict = dataclasses.field(default_factory=dict)
+    context_length: Optional[int] = None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProfileModel":
+        return cls(
+            name=d["name"],
+            checkpoint=d.get("checkpoint"),
+            kind=d.get("kind", "chat"),
+            quantization=d.get("quantization"),
+            mesh=MeshSpec.from_dict(d.get("mesh", {})),
+            engine=dict(d.get("engine", {})),
+            context_length=d.get("context_length"),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "checkpoint": self.checkpoint,
+            "kind": self.kind,
+            "quantization": self.quantization,
+            "mesh": self.mesh.to_dict(),
+            "engine": dict(self.engine),
+            "context_length": self.context_length,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ProfileRequirement:
+    chips: int = 1
+    generation: str = ""          # "" = any; "v5e" | "v5p" | ...
+    min_hbm_bytes: int = 0
+    vendor: str = "tpu"
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ProfileRequirement":
+        return cls(
+            chips=int(d.get("chips", 1)),
+            generation=d.get("generation", ""),
+            min_hbm_bytes=int(d.get("min_hbm_bytes", 0)),
+            vendor=d.get("vendor", "tpu"),
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingProfile:
+    name: str
+    models: tuple
+    requirement: ProfileRequirement = ProfileRequirement()
+
+    @classmethod
+    def from_yaml(cls, text: str) -> "ServingProfile":
+        d = yaml.safe_load(text)
+        return cls.from_dict(d)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ServingProfile":
+        return cls(
+            name=d["name"],
+            models=tuple(ProfileModel.from_dict(m) for m in d.get("models", [])),
+            requirement=ProfileRequirement.from_dict(d.get("requirement", {})),
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "requirement": self.requirement.to_dict(),
+            "models": [m.to_dict() for m in self.models],
+        }
+
+    def to_yaml(self) -> str:
+        return yaml.safe_dump(self.to_dict(), sort_keys=False)
+
+    @property
+    def model_names(self) -> list:
+        return [m.name for m in self.models]
+
+    def validate(self) -> list:
+        """Static sanity: device claims within chip count, no overlap between
+        models sharing a host (overlap IS allowed for hot-swap groups —
+        flagged only when total concurrent footprint exceeds chips)."""
+        errors = []
+        seen = set()
+        for m in self.models:
+            lo = m.mesh.device_offset
+            hi = lo + m.mesh.num_devices
+            if hi > self.requirement.chips:
+                errors.append(
+                    f"model {m.name} claims devices [{lo},{hi}) but profile "
+                    f"requires only {self.requirement.chips} chips"
+                )
+            if not m.name or m.name in seen:
+                errors.append(f"duplicate or empty model name {m.name!r}")
+            seen.add(m.name)
+        return errors
+
+
+@dataclasses.dataclass
+class Violation:
+    constraint: str
+    want: str
+    have: str
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+def check_compatibility(
+    profile: ServingProfile, inventory: list
+) -> list:
+    """Profile vs a heartbeat's accelerator inventory.
+
+    Returns [] if compatible, else structured violations (mirrors
+    ``profile/compatibility.go:50-124`` which 422s with constraint detail).
+    ``inventory``: list of AcceleratorStatus or equivalent dicts.
+    """
+
+    def field(a, name):
+        return getattr(a, name, None) if not isinstance(a, dict) else a.get(name)
+
+    req = profile.requirement
+    violations = []
+    tpus = [a for a in inventory if field(a, "vendor") == req.vendor]
+    if len(tpus) < req.chips:
+        violations.append(
+            Violation("chips", f">={req.chips} {req.vendor}", str(len(tpus)))
+        )
+    if req.generation:
+        archs = {field(a, "arch") for a in tpus}
+        if archs and archs != {req.generation}:
+            violations.append(
+                Violation("generation", req.generation, ",".join(sorted(archs)))
+            )
+    if req.min_hbm_bytes:
+        have = min((field(a, "total_memory_bytes") or 0 for a in tpus), default=0)
+        if have < req.min_hbm_bytes:
+            violations.append(
+                Violation("min_hbm_bytes", str(req.min_hbm_bytes), str(have))
+            )
+    return violations

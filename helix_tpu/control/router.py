@@ -1,0 +1,122 @@
+"""In-memory inference router fed by heartbeats.
+
+Line-for-line behavioural mirror of the reference's
+``api/pkg/inferencerouter/router.go``: runner states keyed by id, updated
+from heartbeats (``router.go:85-99``); ``pick_runner`` filters to runners
+whose ACTIVE profile serves the model AND whose profile status is
+``running``, then round-robins per model (``router.go:168-198``);
+``available_models`` powers ``/v1/models`` (``:148``); stale runners are
+evicted after a TTL (``router.go:113``).  Profile status strings are the
+composemgr lifecycle set (``composemgr/manager.go:48``) with TPU semantics:
+``assigning | loading | starting | running | failed`` (loading = weights ->
+HBM instead of image pull).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+ROUTABLE_STATUS = "running"
+PROFILE_STATUSES = ("assigning", "loading", "starting", "running", "failed")
+
+
+@dataclasses.dataclass
+class RunnerState:
+    id: str
+    models: list = dataclasses.field(default_factory=list)
+    profile_name: str = ""
+    profile_status: str = "assigning"
+    accelerators: list = dataclasses.field(default_factory=list)
+    last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def routable(self) -> bool:
+        return self.profile_status == ROUTABLE_STATUS and bool(self.models)
+
+
+class InferenceRouter:
+    def __init__(self, ttl_seconds: float = 90.0):
+        self.ttl = ttl_seconds
+        self._runners: dict[str, RunnerState] = {}
+        self._rr: dict[str, int] = {}  # per-model round-robin cursor
+        self._lock = threading.Lock()
+
+    def upsert_from_heartbeat(
+        self,
+        runner_id: str,
+        *,
+        models: Optional[list] = None,
+        profile_name: str = "",
+        profile_status: str = "assigning",
+        accelerators: Optional[list] = None,
+        meta: Optional[dict] = None,
+    ) -> RunnerState:
+        with self._lock:
+            st = self._runners.get(runner_id)
+            if st is None:
+                st = RunnerState(id=runner_id)
+                self._runners[runner_id] = st
+            st.models = list(models or [])
+            st.profile_name = profile_name
+            st.profile_status = profile_status
+            st.accelerators = list(accelerators or [])
+            st.last_heartbeat = time.monotonic()
+            if meta:
+                st.meta.update(meta)
+            return st
+
+    def evict_stale(self) -> list:
+        now = time.monotonic()
+        with self._lock:
+            dead = [
+                rid
+                for rid, st in self._runners.items()
+                if now - st.last_heartbeat > self.ttl
+            ]
+            for rid in dead:
+                del self._runners[rid]
+            return dead
+
+    def remove(self, runner_id: str) -> None:
+        with self._lock:
+            self._runners.pop(runner_id, None)
+
+    def get(self, runner_id: str) -> Optional[RunnerState]:
+        with self._lock:
+            return self._runners.get(runner_id)
+
+    def runners(self) -> list:
+        with self._lock:
+            return list(self._runners.values())
+
+    def available_models(self) -> list:
+        """Union of models on routable, fresh runners (for /v1/models)."""
+        now = time.monotonic()
+        with self._lock:
+            out = set()
+            for st in self._runners.values():
+                if st.routable and now - st.last_heartbeat <= self.ttl:
+                    out.update(st.models)
+            return sorted(out)
+
+    def pick_runner(self, model: str) -> Optional[RunnerState]:
+        """Per-model round-robin over routable runners serving ``model``."""
+        now = time.monotonic()
+        with self._lock:
+            candidates = [
+                st
+                for st in sorted(self._runners.values(), key=lambda s: s.id)
+                if st.routable
+                and model in st.models
+                and now - st.last_heartbeat <= self.ttl
+            ]
+            if not candidates:
+                return None
+            cursor = self._rr.get(model, 0)
+            chosen = candidates[cursor % len(candidates)]
+            self._rr[model] = (cursor + 1) % max(len(candidates), 1)
+            return chosen

@@ -1,0 +1,251 @@
+"""Control plane: profiles, assignments, heartbeats, routing, sessions.
+
+The single-process counterpart of the reference's ``helix serve``
+(``api/cmd/helix/serve.go:203-503``), scoped in round 1 to the serving plane
+plus session storage:
+
+- runner heartbeat ingestion -> in-memory router refresh (mirrors
+  ``api/pkg/server/runner_assignment_handlers.go:28-50``)
+- profile CRUD + assignment with 422-on-incompatible (mirrors
+  ``assignRunnerProfile``, ``runner_assignment_handlers.go:118``)
+- assignment polling endpoint for node agents (``server.go:1346``)
+- OpenAI surface passthrough: ``/v1/chat/completions|completions|embeddings``
+  picks a runner via per-model round-robin and streams the response through
+  (the ``InternalHelixServer.dispatchToSandbox`` hot path,
+  ``helix_openai_server.go:222-307`` — HTTP to the runner's address instead
+  of a RevDial tunnel; the tunnel transport arrives with the sandbox layer)
+- sessions + interactions CRUD backed by the SQLite store.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+
+import aiohttp
+from aiohttp import web
+
+from helix_tpu.control.profile import ServingProfile, check_compatibility
+from helix_tpu.control.router import InferenceRouter
+from helix_tpu.control.store import Store
+
+
+def _err(status, message, **extra):
+    return web.json_response(
+        {"error": {"message": message, **extra}}, status=status
+    )
+
+
+class ControlPlane:
+    def __init__(self, db_path: str = ":memory:"):
+        self.store = Store(db_path)
+        self.router = InferenceRouter()
+
+    # ------------------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        r = app.router
+        r.add_get("/healthz", self.healthz)
+        # runner control loop
+        r.add_post("/api/v1/runners/{id}/heartbeat", self.heartbeat)
+        r.add_get("/api/v1/runners/{id}/assignment", self.get_assignment)
+        r.add_post("/api/v1/runners/{id}/assign-profile", self.assign_profile)
+        r.add_delete("/api/v1/runners/{id}/assignment", self.clear_assignment)
+        r.add_get("/api/v1/runners", self.list_runners)
+        # profiles
+        r.add_get("/api/v1/profiles", self.list_profiles)
+        r.add_post("/api/v1/profiles", self.create_profile)
+        r.add_get("/api/v1/profiles/{name}", self.get_profile)
+        r.add_delete("/api/v1/profiles/{name}", self.delete_profile)
+        # sessions
+        r.add_post("/api/v1/sessions", self.create_session)
+        r.add_get("/api/v1/sessions", self.list_sessions)
+        r.add_get("/api/v1/sessions/{id}", self.get_session)
+        r.add_delete("/api/v1/sessions/{id}", self.delete_session)
+        # openai passthrough
+        r.add_get("/v1/models", self.models)
+        for route in ("/v1/chat/completions", "/v1/completions", "/v1/embeddings"):
+            r.add_post(route, self.dispatch_openai)
+        return app
+
+    async def healthz(self, request):
+        return web.json_response(
+            {"status": "ok", "runners": len(self.router.runners())}
+        )
+
+    # -- runner control loop ----------------------------------------------
+    async def heartbeat(self, request):
+        rid = request.match_info["id"]
+        body = await request.json()
+        profile = body.get("profile", {})
+        self.router.upsert_from_heartbeat(
+            rid,
+            models=profile.get("models", []),
+            profile_name=profile.get("name", ""),
+            profile_status=profile.get("status", "assigning"),
+            accelerators=body.get("accelerators", []),
+            meta={"address": body.get("address", "")},
+        )
+        self.store.record_heartbeat(rid, body)
+        self.router.evict_stale()
+        return web.json_response({"ok": True})
+
+    async def get_assignment(self, request):
+        rid = request.match_info["id"]
+        name = self.store.get_assignment(rid)
+        profile = self.store.get_profile(name) if name else None
+        return web.json_response(
+            {"runner_id": rid, "profile_name": name, "profile": profile}
+        )
+
+    async def assign_profile(self, request):
+        """422 with structured violations on incompatibility, like the
+        reference (``runner_assignment_handlers.go:118``)."""
+        rid = request.match_info["id"]
+        body = await request.json()
+        name = body.get("profile_name")
+        doc = self.store.get_profile(name or "")
+        if doc is None:
+            return _err(404, f"profile '{name}' not found")
+        profile = ServingProfile.from_dict(doc)
+        hb = self.store.get_runner(rid)
+        inventory = (hb or {}).get("accelerators", [])
+        violations = check_compatibility(profile, inventory)
+        if violations:
+            return web.json_response(
+                {
+                    "error": {
+                        "message": "profile incompatible with runner inventory",
+                        "violations": [v.to_dict() for v in violations],
+                    }
+                },
+                status=422,
+            )
+        self.store.set_assignment(rid, name)
+        return web.json_response({"ok": True, "runner_id": rid, "profile": name})
+
+    async def clear_assignment(self, request):
+        rid = request.match_info["id"]
+        self.store.set_assignment(rid, None)
+        return web.json_response({"ok": True})
+
+    async def list_runners(self, request):
+        out = []
+        for st in self.router.runners():
+            out.append(
+                {
+                    "id": st.id,
+                    "models": st.models,
+                    "profile_name": st.profile_name,
+                    "profile_status": st.profile_status,
+                    "routable": st.routable,
+                    "address": st.meta.get("address", ""),
+                }
+            )
+        return web.json_response({"runners": out})
+
+    # -- profiles -----------------------------------------------------------
+    async def list_profiles(self, request):
+        return web.json_response({"profiles": self.store.list_profiles()})
+
+    async def create_profile(self, request):
+        body = await request.json()
+        try:
+            profile = ServingProfile.from_dict(body)
+        except Exception as e:  # noqa: BLE001
+            return _err(400, f"invalid profile: {e}")
+        errors = profile.validate()
+        if errors:
+            return _err(400, "profile validation failed", errors=errors)
+        self.store.upsert_profile(profile.name, profile.to_dict())
+        return web.json_response({"ok": True, "name": profile.name})
+
+    async def get_profile(self, request):
+        doc = self.store.get_profile(request.match_info["name"])
+        if doc is None:
+            return _err(404, "profile not found")
+        return web.json_response(doc)
+
+    async def delete_profile(self, request):
+        ok = self.store.delete_profile(request.match_info["name"])
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    # -- sessions ------------------------------------------------------------
+    async def create_session(self, request):
+        body = await request.json()
+        sid = self.store.create_session(
+            owner=body.get("owner", "anonymous"),
+            name=body.get("name", "untitled"),
+            doc=body.get("doc", {}),
+        )
+        return web.json_response({"id": sid})
+
+    async def list_sessions(self, request):
+        owner = request.query.get("owner")
+        return web.json_response(
+            {"sessions": self.store.list_sessions(owner)}
+        )
+
+    async def get_session(self, request):
+        s = self.store.get_session(request.match_info["id"])
+        if s is None:
+            return _err(404, "session not found")
+        s["interactions"] = self.store.list_interactions(s["id"])
+        return web.json_response(s)
+
+    async def delete_session(self, request):
+        self.store.delete_session(request.match_info["id"])
+        return web.json_response({"ok": True})
+
+    # -- openai passthrough ---------------------------------------------------
+    async def models(self, request):
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {"id": m, "object": "model", "owned_by": "helix-tpu"}
+                    for m in self.router.available_models()
+                ],
+            }
+        )
+
+    async def dispatch_openai(self, request):
+        """Pick a runner by model, stream the response through unbuffered
+        (the SSE-preserving trick of ``helix_openai_server.go:279-307`` —
+        chunk-for-chunk copy, no buffering of the whole stream)."""
+        raw = await request.read()
+        try:
+            body = json.loads(raw)
+        except Exception:
+            return _err(400, "invalid JSON body")
+        model = body.get("model", "")
+        runner = self.router.pick_runner(model)
+        if runner is None:
+            return _err(
+                404,
+                f"no runner serves model '{model}'",
+                available=self.router.available_models(),
+            )
+        address = runner.meta.get("address")
+        if not address:
+            return _err(503, f"runner {runner.id} has no address")
+        url = f"{address}{request.path}"
+        timeout = aiohttp.ClientTimeout(total=300)  # 5 min budget, like the
+        # reference's dispatch watchdog (helix_openai_server.go:260)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.post(
+                url, data=raw, headers={"Content-Type": "application/json"}
+            ) as upstream:
+                resp = web.StreamResponse(
+                    status=upstream.status,
+                    headers={
+                        "Content-Type": upstream.headers.get(
+                            "Content-Type", "application/json"
+                        )
+                    },
+                )
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp

@@ -1,0 +1,333 @@
+"""Control-plane store: SQLite-backed persistence.
+
+The reference persists ~80 GORM entities in Postgres
+(``api/pkg/store/postgres.go:170-258``).  This build uses stdlib SQLite so
+the control plane stays a single self-hostable process with zero external
+dependencies; the entity surface starts with the serving plane's tables
+(profiles, assignments, runner snapshots, sessions/interactions, api keys)
+and grows with the layers above it.  JSON documents in columns play the
+role of GORM's struct serialisation; every access goes through one lock
+(SQLite is the bottleneck only far beyond this control plane's write rates).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS profiles (
+    name TEXT PRIMARY KEY,
+    doc  TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS assignments (
+    runner_id TEXT PRIMARY KEY,
+    profile_name TEXT,
+    assigned_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS runners (
+    runner_id TEXT PRIMARY KEY,
+    last_heartbeat TEXT,      -- JSON snapshot of last heartbeat
+    updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS sessions (
+    id TEXT PRIMARY KEY,
+    owner TEXT,
+    name TEXT,
+    doc TEXT NOT NULL,        -- JSON: model, system prompt, app binding...
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS interactions (
+    id TEXT PRIMARY KEY,
+    session_id TEXT NOT NULL,
+    seq INTEGER NOT NULL,
+    doc TEXT NOT NULL,        -- JSON: role, content, usage, state
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_interactions_session
+    ON interactions(session_id, seq);
+CREATE TABLE IF NOT EXISTS api_keys (
+    key TEXT PRIMARY KEY,
+    owner TEXT NOT NULL,
+    name TEXT,
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS llm_calls (
+    id TEXT PRIMARY KEY,
+    session_id TEXT,
+    model TEXT,
+    provider TEXT,
+    doc TEXT NOT NULL,        -- JSON: request/response summary, usage, ms
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS usage_metrics (
+    id TEXT PRIMARY KEY,
+    owner TEXT,
+    model TEXT,
+    prompt_tokens INTEGER,
+    completion_tokens INTEGER,
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS kv (
+    k TEXT PRIMARY KEY,
+    v TEXT NOT NULL
+);
+"""
+
+
+class Store:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    # -- profiles ----------------------------------------------------------
+    def upsert_profile(self, name: str, doc: dict) -> None:
+        now = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO profiles(name, doc, created_at, updated_at) "
+                "VALUES(?,?,?,?) ON CONFLICT(name) DO UPDATE SET "
+                "doc=excluded.doc, updated_at=excluded.updated_at",
+                (name, json.dumps(doc), now, now),
+            )
+            self._conn.commit()
+
+    def get_profile(self, name: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT doc FROM profiles WHERE name=?", (name,)
+            ).fetchone()
+        return json.loads(row[0]) if row else None
+
+    def list_profiles(self) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT doc FROM profiles ORDER BY name"
+            ).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    def delete_profile(self, name: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM profiles WHERE name=?", (name,)
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    # -- assignments -------------------------------------------------------
+    def set_assignment(self, runner_id: str, profile_name: Optional[str]) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO assignments(runner_id, profile_name, assigned_at) "
+                "VALUES(?,?,?) ON CONFLICT(runner_id) DO UPDATE SET "
+                "profile_name=excluded.profile_name, "
+                "assigned_at=excluded.assigned_at",
+                (runner_id, profile_name, time.time()),
+            )
+            self._conn.commit()
+
+    def get_assignment(self, runner_id: str) -> Optional[str]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT profile_name FROM assignments WHERE runner_id=?",
+                (runner_id,),
+            ).fetchone()
+        return row[0] if row else None
+
+    # -- runners -----------------------------------------------------------
+    def record_heartbeat(self, runner_id: str, payload: dict) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO runners(runner_id, last_heartbeat, updated_at) "
+                "VALUES(?,?,?) ON CONFLICT(runner_id) DO UPDATE SET "
+                "last_heartbeat=excluded.last_heartbeat, "
+                "updated_at=excluded.updated_at",
+                (runner_id, json.dumps(payload), time.time()),
+            )
+            self._conn.commit()
+
+    def get_runner(self, runner_id: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT last_heartbeat FROM runners WHERE runner_id=?",
+                (runner_id,),
+            ).fetchone()
+        return json.loads(row[0]) if row and row[0] else None
+
+    def list_runners(self) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT runner_id, last_heartbeat, updated_at FROM runners"
+            ).fetchall()
+        return [
+            {
+                "runner_id": r[0],
+                "last_heartbeat": json.loads(r[1]) if r[1] else None,
+                "updated_at": r[2],
+            }
+            for r in rows
+        ]
+
+    # -- sessions / interactions ------------------------------------------
+    def create_session(self, owner: str, name: str, doc: dict) -> str:
+        sid = f"ses_{uuid.uuid4().hex[:16]}"
+        now = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO sessions(id, owner, name, doc, created_at, "
+                "updated_at) VALUES(?,?,?,?,?,?)",
+                (sid, owner, name, json.dumps(doc), now, now),
+            )
+            self._conn.commit()
+        return sid
+
+    def get_session(self, sid: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, owner, name, doc, created_at, updated_at "
+                "FROM sessions WHERE id=?",
+                (sid,),
+            ).fetchone()
+        if not row:
+            return None
+        return {
+            "id": row[0], "owner": row[1], "name": row[2],
+            "doc": json.loads(row[3]),
+            "created_at": row[4], "updated_at": row[5],
+        }
+
+    def update_session(self, sid: str, doc: dict) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE sessions SET doc=?, updated_at=? WHERE id=?",
+                (json.dumps(doc), time.time(), sid),
+            )
+            self._conn.commit()
+
+    def list_sessions(self, owner: Optional[str] = None) -> list:
+        q = "SELECT id, owner, name, created_at, updated_at FROM sessions"
+        args: tuple = ()
+        if owner:
+            q += " WHERE owner=?"
+            args = (owner,)
+        q += " ORDER BY updated_at DESC"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [
+            {
+                "id": r[0], "owner": r[1], "name": r[2],
+                "created_at": r[3], "updated_at": r[4],
+            }
+            for r in rows
+        ]
+
+    def delete_session(self, sid: str) -> None:
+        with self._lock:
+            self._conn.execute("DELETE FROM sessions WHERE id=?", (sid,))
+            self._conn.execute(
+                "DELETE FROM interactions WHERE session_id=?", (sid,)
+            )
+            self._conn.commit()
+
+    def add_interaction(self, session_id: str, doc: dict) -> str:
+        iid = f"int_{uuid.uuid4().hex[:16]}"
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT COALESCE(MAX(seq), -1) FROM interactions "
+                "WHERE session_id=?",
+                (session_id,),
+            ).fetchone()
+            seq = (row[0] if row else -1) + 1
+            self._conn.execute(
+                "INSERT INTO interactions(id, session_id, seq, doc, "
+                "created_at) VALUES(?,?,?,?,?)",
+                (iid, session_id, seq, json.dumps(doc), time.time()),
+            )
+            self._conn.commit()
+        return iid
+
+    def list_interactions(self, session_id: str) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, seq, doc, created_at FROM interactions "
+                "WHERE session_id=? ORDER BY seq",
+                (session_id,),
+            ).fetchall()
+        return [
+            {"id": r[0], "seq": r[1], **json.loads(r[2]), "created_at": r[3]}
+            for r in rows
+        ]
+
+    # -- telemetry ---------------------------------------------------------
+    def log_llm_call(self, doc: dict, session_id="", model="", provider="") -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO llm_calls(id, session_id, model, provider, doc, "
+                "created_at) VALUES(?,?,?,?,?,?)",
+                (
+                    f"llm_{uuid.uuid4().hex[:16]}", session_id, model,
+                    provider, json.dumps(doc), time.time(),
+                ),
+            )
+            self._conn.commit()
+
+    def add_usage(self, owner: str, model: str, prompt: int, completion: int):
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO usage_metrics(id, owner, model, prompt_tokens, "
+                "completion_tokens, created_at) VALUES(?,?,?,?,?,?)",
+                (
+                    f"use_{uuid.uuid4().hex[:16]}", owner, model,
+                    prompt, completion, time.time(),
+                ),
+            )
+            self._conn.commit()
+
+    def usage_summary(self, owner: Optional[str] = None) -> dict:
+        q = (
+            "SELECT model, SUM(prompt_tokens), SUM(completion_tokens), "
+            "COUNT(*) FROM usage_metrics"
+        )
+        args: tuple = ()
+        if owner:
+            q += " WHERE owner=?"
+            args = (owner,)
+        q += " GROUP BY model"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return {
+            r[0]: {
+                "prompt_tokens": r[1] or 0,
+                "completion_tokens": r[2] or 0,
+                "requests": r[3],
+            }
+            for r in rows
+        }
+
+    # -- kv ----------------------------------------------------------------
+    def kv_set(self, k: str, v: Any) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv(k, v) VALUES(?,?) ON CONFLICT(k) "
+                "DO UPDATE SET v=excluded.v",
+                (k, json.dumps(v)),
+            )
+            self._conn.commit()
+
+    def kv_get(self, k: str, default=None) -> Any:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM kv WHERE k=?", (k,)
+            ).fetchone()
+        return json.loads(row[0]) if row else default

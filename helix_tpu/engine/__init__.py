@@ -1,0 +1,14 @@
+from helix_tpu.engine.kv_cache import CacheConfig, PagedKVCache, PageAllocator
+from helix_tpu.engine.sampling import SamplingParams, sample
+from helix_tpu.engine.engine import Engine, EngineConfig, Request
+
+__all__ = [
+    "CacheConfig",
+    "PagedKVCache",
+    "PageAllocator",
+    "SamplingParams",
+    "sample",
+    "Engine",
+    "EngineConfig",
+    "Request",
+]

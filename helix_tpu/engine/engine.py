@@ -1,0 +1,406 @@
+"""The serving engine: continuous batching over a paged KV cache.
+
+Replaces the reference's per-model vLLM container (``SURVEY.md`` §2.2, §7
+stage 2).  One ``Engine`` owns one model's weights + page pool on a mesh
+slice and exposes token-level ``add_request`` / ``step`` — the OpenAI HTTP
+surface (``helix_tpu.serving``) sits on top, the multi-model residency
+manager (``helix_tpu.engine.residency``) creates/destroys Engines per the
+active profile.
+
+Execution model (all shapes static, everything jitted once per bucket):
+
+- **Prefill**: one request per call, prompt padded to a power-of-two bucket;
+  flash attention over its own K/V; fresh K/V scattered into the request's
+  pages; last-token logits sampled for the first generated token.
+- **Decode**: one fused step for all ``max_decode_batch`` slots — forward
+  (paged attention over each slot's page table) + KV write + penalty +
+  sampling inside a single jit; inactive slots ride along pointed at the
+  garbage page.
+- Host side keeps plain-Python queues, a page allocator, and per-request
+  state; nothing dynamic ever crosses into traced code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import functools
+import itertools
+import time
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from helix_tpu.engine.kv_cache import (
+    CacheConfig,
+    PageAllocator,
+    PagedKVCache,
+    slot_to_page_offset,
+    write_kv,
+)
+from helix_tpu.engine.sampling import (
+    SamplingParams,
+    SamplingState,
+    sample,
+)
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import forward
+from helix_tpu.ops.attention import attention as full_attention
+from helix_tpu.ops.paged import paged_decode_attention
+
+
+class FinishReason(str, enum.Enum):
+    STOP = "stop"
+    LENGTH = "length"
+    ABORT = "abort"
+
+
+@dataclasses.dataclass
+class Request:
+    id: str
+    prompt_tokens: list
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    stop_token_ids: tuple = ()
+    # mutable state
+    output_tokens: list = dataclasses.field(default_factory=list)
+    finished: bool = False
+    finish_reason: Optional[FinishReason] = None
+    slot: Optional[int] = None
+    max_len: Optional[int] = None   # page-capacity cap set at admission
+    submit_time: float = dataclasses.field(default_factory=time.monotonic)
+    first_token_time: Optional[float] = None
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_tokens) + len(self.output_tokens)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    max_decode_batch: int = 8
+    page_size: int = 16
+    num_pages: int = 2048
+    max_pages_per_seq: int = 128
+    max_prefill_len: int = 2048
+    attn_backend: Optional[str] = None   # None = auto (pallas on TPU)
+    eos_token_ids: tuple = ()
+
+    def cache_config(self, dtype: str = "bfloat16") -> CacheConfig:
+        return CacheConfig(
+            num_pages=self.num_pages,
+            page_size=self.page_size,
+            max_pages_per_seq=self.max_pages_per_seq,
+            dtype=dtype,
+        )
+
+
+def _bucket(n: int, lo: int, hi: int) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi) if b <= hi else hi
+
+
+# Compiled step functions are cached at module level keyed by the static
+# configuration, NOT per Engine instance — two Engines serving the same
+# architecture (or the same Engine recreated by a profile swap) reuse one
+# executable.  Combined with jax's persistent compilation cache this makes
+# profile hot-swap cheap (SURVEY.md §7 hard part #2).
+@functools.lru_cache(maxsize=64)
+def _build_prefill_fn(model_cfg: ModelConfig, page_size: int, backend):
+    cfg = model_cfg
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def prefill_fn(params, cache, tokens, page_table, length, sampling, key):
+        B, S = tokens.shape  # B == 1
+        positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        valid = positions < length
+        seg = valid.astype(jnp.int32)
+
+        def attn_fn(q, k, v, layer_cache, pos):
+            return full_attention(
+                q, k, v,
+                causal=True,
+                q_positions=pos,
+                kv_positions=pos,
+                q_segment_ids=seg,
+                kv_segment_ids=seg,
+                backend=backend,
+            )
+
+        logits, (k_new, v_new) = forward(
+            params, cfg, tokens, positions, attn_fn=attn_fn
+        )
+        pages, offsets = slot_to_page_offset(positions, page_table, page_size)
+        cache = write_kv(cache, k_new, v_new, pages, offsets, valid)
+        last = logits[jnp.arange(B), length - 1]  # [B, V] f32
+        token = sample(last, sampling, key)
+        return cache, token
+
+    return prefill_fn
+
+
+@functools.lru_cache(maxsize=64)
+def _build_decode_fn(model_cfg: ModelConfig, page_size: int, backend):
+    cfg = model_cfg
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def decode_fn(
+        params, cache, last_token, positions, page_tables, active,
+        sampling, key,
+    ):
+        tokens = last_token[:, None]                      # [B, 1]
+        pos2d = positions[:, None]                        # [B, 1]
+
+        def attn_fn(q, k, v, layer_cache, pos):
+            kp, vp = layer_cache
+            out = paged_decode_attention(
+                q[:, 0],
+                kp,
+                vp,
+                page_tables,
+                positions,
+                k_new=k[:, 0],
+                v_new=v[:, 0],
+                backend=backend,
+            )
+            return out[:, None]
+
+        logits, (k_new, v_new) = forward(
+            params, cfg, tokens, pos2d,
+            attn_fn=attn_fn,
+            layer_caches=(cache.k_pages, cache.v_pages),
+        )
+        pages, offsets = slot_to_page_offset(pos2d, page_tables, page_size)
+        cache = write_kv(
+            cache, k_new, v_new, pages, offsets, active[:, None] > 0
+        )
+        token = sample(logits[:, 0], sampling, key)
+        return cache, token
+
+    return decode_fn
+
+
+class Engine:
+    """Single-model serving engine on one mesh slice."""
+
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        params,
+        cfg: EngineConfig,
+        mesh=None,
+        rng_seed: int = 0,
+    ):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.params = params
+        self.mesh = mesh
+        self.cache_cfg = cfg.cache_config(dtype=model_cfg.dtype)
+        self.cache = PagedKVCache.create(model_cfg, self.cache_cfg, mesh)
+        self.allocator = PageAllocator(
+            self.cache_cfg.num_pages, self.cache_cfg.max_pages_per_seq
+        )
+        B = cfg.max_decode_batch
+        self.slots: list[Optional[Request]] = [None] * B
+        self.waiting: list[Request] = []
+        self._requests: dict[str, Request] = {}
+        # host mirrors of device-visible per-slot state
+        self._last_token = np.zeros((B,), np.int32)
+        self._positions = np.zeros((B,), np.int32)
+        self._page_tables = np.zeros(
+            (B, self.cache_cfg.max_pages_per_seq), np.int32
+        )
+        self._sampling_dirty = True
+        self._sampling_state: Optional[SamplingState] = None
+        self._key = jax.random.PRNGKey(rng_seed)
+        self._step_counter = itertools.count()
+        self._backend = cfg.attn_backend
+        # metrics
+        self.num_prefill_tokens = 0
+        self.num_decode_tokens = 0
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def add_request(self, req: Request) -> None:
+        if len(req.prompt_tokens) > self.cfg.max_prefill_len:
+            raise ValueError(
+                f"prompt ({len(req.prompt_tokens)} tokens) exceeds "
+                f"max_prefill_len {self.cfg.max_prefill_len}"
+            )
+        self._requests[req.id] = req
+        self.waiting.append(req)
+
+    def abort(self, req_id: str) -> None:
+        req = self._requests.get(req_id)
+        if req is None or req.finished:
+            return
+        self._finish(req, FinishReason.ABORT)
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or any(s is not None for s in self.slots)
+
+    def step(self) -> list[tuple[Request, int]]:
+        """Admit + prefill waiting requests, then one decode step.
+
+        Returns [(request, new_token_id), ...] for tokens produced this step.
+        """
+        emitted: list[tuple[Request, int]] = []
+        self._admit(emitted)
+        if any(s is not None for s in self.slots):
+            emitted.extend(self._decode_step())
+        return emitted
+
+    def generate(
+        self, prompts: Sequence[Sequence[int]], sampling: SamplingParams
+    ) -> list[list[int]]:
+        """Blocking convenience wrapper (tests, bench)."""
+        reqs = [
+            Request(
+                id=f"gen-{i}",
+                prompt_tokens=list(p),
+                sampling=sampling,
+                stop_token_ids=tuple(self.cfg.eos_token_ids),
+            )
+            for i, p in enumerate(prompts)
+        ]
+        for r in reqs:
+            self.add_request(r)
+        while self.has_work():
+            self.step()
+        return [r.output_tokens for r in reqs]
+
+    # ------------------------------------------------------------------
+    # admission + prefill
+    # ------------------------------------------------------------------
+
+    def _admit(self, emitted) -> None:
+        while self.waiting:
+            free_slots = [i for i, s in enumerate(self.slots) if s is None]
+            if not free_slots:
+                return
+            req = self.waiting[0]
+            plen = len(req.prompt_tokens)
+            need = self.allocator.pages_needed(
+                plen + req.sampling.max_tokens, self.cache_cfg.page_size
+            )
+            need = min(need, self.cache_cfg.max_pages_per_seq)
+            if not self.allocator.can_allocate(need):
+                return  # head-of-line blocking; decode will free pages
+            self.waiting.pop(0)
+            slot = free_slots[0]
+            pages = self.allocator.allocate(req.id, need)
+            req.slot = slot
+            req.max_len = len(pages) * self.cache_cfg.page_size
+            self.slots[slot] = req
+            table = np.zeros((self.cache_cfg.max_pages_per_seq,), np.int32)
+            table[: len(pages)] = pages
+            self._page_tables[slot] = table
+            first_token = self._prefill(req, table)
+            req.first_token_time = time.monotonic()
+            self._positions[slot] = plen
+            self._last_token[slot] = first_token
+            self._sampling_dirty = True
+            self._emit(req, int(first_token), emitted)
+
+    def _prefill(self, req: Request, page_table: np.ndarray) -> int:
+        plen = len(req.prompt_tokens)
+        bucket = _bucket(
+            max(plen, self.cache_cfg.page_size),
+            self.cache_cfg.page_size,
+            self.cfg.max_prefill_len,
+        )
+        fn = self._get_prefill_fn(bucket)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :plen] = req.prompt_tokens
+        length = np.int32(plen)
+        self._key, sub = jax.random.split(self._key)
+        sampling = SamplingState.from_params([req.sampling])
+        self.cache, token = fn(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.asarray(page_table)[None],
+            jnp.asarray(length),
+            sampling,
+            sub,
+        )
+        self.num_prefill_tokens += plen
+        return int(token[0])
+
+    def _get_prefill_fn(self, bucket: int):
+        return _build_prefill_fn(
+            self.model_cfg, self.cache_cfg.page_size, self._backend
+        )
+
+    # ------------------------------------------------------------------
+    # decode
+    # ------------------------------------------------------------------
+
+    def _decode_step(self) -> list[tuple[Request, int]]:
+        B = self.cfg.max_decode_batch
+        active = np.array(
+            [1 if s is not None else 0 for s in self.slots], np.int32
+        )
+        if self._sampling_dirty:
+            params_list = [
+                (s.sampling if s is not None else SamplingParams())
+                for s in self.slots
+            ]
+            self._sampling_state = SamplingState.from_params(params_list)
+            self._sampling_dirty = False
+        fn = self._get_decode_fn()
+        self._key, sub = jax.random.split(self._key)
+        self.cache, next_tokens = fn(
+            self.params,
+            self.cache,
+            jnp.asarray(self._last_token),
+            jnp.asarray(self._positions),
+            jnp.asarray(self._page_tables),
+            jnp.asarray(active),
+            self._sampling_state,
+            sub,
+        )
+        next_np = np.asarray(next_tokens)
+        emitted: list[tuple[Request, int]] = []
+        for i, req in enumerate(self.slots):
+            if req is None:
+                continue
+            self._positions[i] += 1
+            self._last_token[i] = next_np[i]
+            self.num_decode_tokens += 1
+            self._emit(req, int(next_np[i]), emitted)
+        return emitted
+
+    def _get_decode_fn(self):
+        return _build_decode_fn(
+            self.model_cfg, self.cache_cfg.page_size, self._backend
+        )
+
+    # ------------------------------------------------------------------
+    # completion
+    # ------------------------------------------------------------------
+
+    def _emit(self, req: Request, token: int, emitted: list) -> None:
+        req.output_tokens.append(token)
+        emitted.append((req, token))
+        stop_ids = set(req.stop_token_ids) | set(self.cfg.eos_token_ids)
+        if token in stop_ids:
+            self._finish(req, FinishReason.STOP)
+        elif len(req.output_tokens) >= req.sampling.max_tokens:
+            self._finish(req, FinishReason.LENGTH)
+        elif req.num_tokens >= (req.max_len or self.cache_cfg.max_seq_len):
+            self._finish(req, FinishReason.LENGTH)
+
+    def _finish(self, req: Request, reason: FinishReason) -> None:
+        req.finished = True
+        req.finish_reason = reason
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            self._sampling_dirty = True
+            req.slot = None
+        self.allocator.free(req.id)

@@ -1,0 +1,217 @@
+"""Paged KV cache: device-side page pool + host-side allocator.
+
+The TPU replacement for vLLM's PagedAttention block manager (which the
+reference rides inside its CUDA containers — ``SURVEY.md`` §2.2).  Design:
+
+- Device state is two arrays per model, ``k_pages``/``v_pages`` of shape
+  ``[num_layers, kv_heads, num_pages, page_size, head_dim]`` — statically
+  shaped so every jitted step reuses one executable.  The layer dim leads so
+  the model's ``lax.scan`` slices per-layer views; kv_heads comes next so a
+  (head, page) slice is a contiguous ``[page_size, head_dim]`` block — the
+  unit the Pallas decode kernel DMAs from HBM to VMEM.
+- The page pool shards over the mesh on the kv-head axis (follows tensor
+  parallelism; pages axis stays unsharded so any page can host any sequence).
+- Allocation/free is pure host Python (a free list) — it never appears in a
+  traced function; the device only ever sees page-table *arrays*.
+- Writes take the model's stacked fresh KV ``[L, B, S, KVH, D]`` and one
+  scatter places all layers/tokens; slot -> (page, offset) math happens on
+  host or in cheap integer ops.
+
+HBM cost per page = ``2 * L * page_size * KVH * D * itemsize`` — the unit the
+residency manager (``engine/residency.py``) budgets with, replacing the
+reference's GPU VRAM accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from helix_tpu.models.common import ModelConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheConfig:
+    num_pages: int
+    page_size: int = 16
+    max_pages_per_seq: int = 128
+    dtype: str = "bfloat16"
+
+    @property
+    def max_seq_len(self) -> int:
+        return self.page_size * self.max_pages_per_seq
+
+    def page_bytes(self, model: ModelConfig) -> int:
+        return (
+            2
+            * model.num_layers
+            * self.page_size
+            * model.num_kv_heads
+            * model.head_dim
+            * jnp.dtype(self.dtype).itemsize
+        )
+
+    def total_bytes(self, model: ModelConfig) -> int:
+        return self.num_pages * self.page_bytes(model)
+
+    @classmethod
+    def fit_hbm(
+        cls,
+        model: ModelConfig,
+        hbm_budget_bytes: int,
+        page_size: int = 16,
+        max_pages_per_seq: int = 128,
+    ) -> "CacheConfig":
+        """Size the page pool to an HBM budget (what's left after weights) —
+        the accounting the reference does per-GPU with
+        ``--gpu-memory-utilization`` on vLLM, done natively here."""
+        probe = cls(num_pages=1, page_size=page_size,
+                    max_pages_per_seq=max_pages_per_seq)
+        per_page = probe.page_bytes(model)
+        num_pages = max(hbm_budget_bytes // per_page, 0)
+        return cls(
+            num_pages=int(num_pages),
+            page_size=page_size,
+            max_pages_per_seq=max_pages_per_seq,
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVCache:
+    """Device page pool (a pytree — passes through jit with donation)."""
+
+    k_pages: jax.Array  # [L, KVH, N, P, D]
+    v_pages: jax.Array
+
+    @classmethod
+    def create(
+        cls,
+        model: ModelConfig,
+        cache: CacheConfig,
+        mesh=None,
+    ) -> "PagedKVCache":
+        shape = (
+            model.num_layers,
+            model.num_kv_heads,
+            cache.num_pages,
+            cache.page_size,
+            model.head_dim,
+        )
+        dtype = jnp.dtype(cache.dtype)
+        if mesh is not None:
+            from helix_tpu.parallel.sharding import logical_sharding
+
+            sharding = logical_sharding(
+                mesh, (None, "cache_heads", "pages", None, None)
+            )
+            zeros = jax.jit(
+                lambda: jnp.zeros(shape, dtype), out_shardings=(sharding)
+            )
+            k = zeros()
+            v = zeros()
+        else:
+            k = jnp.zeros(shape, dtype)
+            v = jnp.zeros(shape, dtype)
+        return cls(k_pages=k, v_pages=v)
+
+    @property
+    def num_layers(self):
+        return self.k_pages.shape[0]
+
+    def layer_view(self, layer: int):
+        return self.k_pages[layer], self.v_pages[layer]
+
+
+def write_kv(
+    cache: PagedKVCache,
+    k_new: jax.Array,  # [L, B, S, KVH, D]
+    v_new: jax.Array,
+    pages: jax.Array,   # [B, S] int32 — destination page per token
+    offsets: jax.Array, # [B, S] int32 — offset within page
+    valid: jax.Array,   # [B, S] bool — False for padding tokens
+) -> PagedKVCache:
+    """Scatter fresh KV into the pool in one op.
+
+    Padding tokens are routed to a reserved scratch page (page 0 is kept as
+    the engine's garbage page) so the scatter stays fully dense.
+    """
+    L, B, S, KVH, D = k_new.shape
+    flat_pages = jnp.where(valid, pages, 0).reshape(-1)
+    flat_off = jnp.where(valid, offsets, 0).reshape(-1)
+    # [L, B*S, KVH, D] -> [L, KVH, B*S, D] to match the pool layout
+    kf = (
+        k_new.reshape(L, B * S, KVH, D)
+        .transpose(0, 2, 1, 3)
+        .astype(cache.k_pages.dtype)
+    )
+    vf = (
+        v_new.reshape(L, B * S, KVH, D)
+        .transpose(0, 2, 1, 3)
+        .astype(cache.v_pages.dtype)
+    )
+    k_pages = cache.k_pages.at[:, :, flat_pages, flat_off].set(
+        kf, mode="drop", unique_indices=False
+    )
+    v_pages = cache.v_pages.at[:, :, flat_pages, flat_off].set(
+        vf, mode="drop", unique_indices=False
+    )
+    return PagedKVCache(k_pages=k_pages, v_pages=v_pages)
+
+
+class PageAllocator:
+    """Host-side free-list allocator for the page pool.
+
+    Page 0 is reserved as the garbage page that padding writes land on
+    (``write_kv``), so it is never handed out.
+    """
+
+    def __init__(self, num_pages: int, max_pages_per_seq: int):
+        self.num_pages = num_pages
+        self.max_pages_per_seq = max_pages_per_seq
+        self._free = list(range(num_pages - 1, 0, -1))  # page 0 reserved
+        self._owned: dict[str, list[int]] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, num_tokens: int, page_size: int) -> int:
+        return -(-num_tokens // page_size)
+
+    def can_allocate(self, n: int) -> bool:
+        return len(self._free) >= n
+
+    def allocate(self, seq_id: str, n: int) -> list[int]:
+        if len(self._free) < n:
+            raise MemoryError(
+                f"page pool exhausted: want {n}, have {len(self._free)}"
+            )
+        got = [self._free.pop() for _ in range(n)]
+        self._owned.setdefault(seq_id, []).extend(got)
+        if len(self._owned[seq_id]) > self.max_pages_per_seq:
+            raise MemoryError(f"sequence {seq_id} exceeds max_pages_per_seq")
+        return got
+
+    def seq_pages(self, seq_id: str) -> list[int]:
+        return list(self._owned.get(seq_id, []))
+
+    def free(self, seq_id: str) -> None:
+        pages = self._owned.pop(seq_id, [])
+        self._free.extend(reversed(pages))
+
+
+def slot_to_page_offset(slots: jax.Array, page_table, page_size: int):
+    """(page, offset) for absolute slot indices given per-seq page tables.
+
+    ``slots``: [B, S] absolute token positions; ``page_table``: [B, maxP].
+    Decode callers pass ``positions[:, None]`` for S=1.
+    """
+    page_idx = slots // page_size
+    offsets = slots % page_size
+    pages = jnp.take_along_axis(page_table, page_idx, axis=-1)
+    return pages.astype(jnp.int32), offsets.astype(jnp.int32)

@@ -1,0 +1,108 @@
+"""Token sampling, fully vectorised per batch slot.
+
+OpenAI-surface parameters (temperature / top_p / presence & frequency
+penalties — the knobs the reference forwards to vLLM via request JSON) are
+carried as per-slot arrays inside one jitted step: different requests in a
+continuous batch sample with different settings without re-tracing.
+
+Strategy: restrict to the top ``TOPK_BOUND`` logits (lax.top_k), apply
+temperature / top-k / top-p masking inside that subset, then one categorical
+draw.  Bounding the candidate set keeps the per-step cost O(B * TOPK_BOUND)
+instead of O(B * vocab) for the sort that exact top-p would need.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+TOPK_BOUND = 64
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Host-side request sampling settings (OpenAI semantics)."""
+
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0              # 0 = disabled
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    max_tokens: int = 256
+    stop: tuple = ()
+    seed: Optional[int] = None
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class SamplingState:
+    """Per-slot device arrays consumed by the jitted sampler."""
+
+    temperature: jax.Array   # [B] f32 (0 = greedy)
+    top_p: jax.Array         # [B] f32
+    top_k: jax.Array         # [B] i32 (0 = disabled)
+
+    @classmethod
+    def from_params(cls, params_list) -> "SamplingState":
+        import numpy as np
+
+        return cls(
+            temperature=jnp.asarray(
+                np.array([p.temperature for p in params_list], np.float32)
+            ),
+            top_p=jnp.asarray(np.array([p.top_p for p in params_list], np.float32)),
+            top_k=jnp.asarray(np.array([p.top_k for p in params_list], np.int32)),
+        )
+
+
+def sample(
+    logits: jax.Array,        # [B, V] f32
+    state: SamplingState,
+    key: jax.Array,
+) -> jax.Array:
+    """Draw one token per slot. Greedy slots (temperature==0) take argmax."""
+    B, V = logits.shape
+    k = min(TOPK_BOUND, V)
+    top_logits, top_idx = jax.lax.top_k(logits, k)          # [B, k] desc
+
+    temp = jnp.maximum(state.temperature, 1e-6)[:, None]
+    scaled = top_logits / temp
+
+    # per-row top-k: keep ranks < top_k (0 disables)
+    ranks = jnp.arange(k)[None, :]
+    topk = jnp.where(state.top_k[:, None] > 0, state.top_k[:, None], k)
+    mask = ranks < topk
+
+    # top-p: keep the smallest prefix whose prob mass >= top_p
+    probs = jax.nn.softmax(scaled, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = (cum - probs) < state.top_p[:, None]  # always keeps rank 0
+    mask = mask & keep_p
+
+    masked = jnp.where(mask, scaled, -jnp.inf)
+    draw = jax.random.categorical(key, masked, axis=-1)     # [B]
+    sampled = jnp.take_along_axis(top_idx, draw[:, None], axis=-1)[:, 0]
+    greedy = top_idx[:, 0]
+    return jnp.where(state.temperature == 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def apply_penalties(
+    logits: jax.Array,          # [B, V]
+    token_counts: jax.Array,    # [B, V] int32 — output-token histogram
+    presence: jax.Array,        # [B]
+    frequency: jax.Array,       # [B]
+) -> jax.Array:
+    """OpenAI presence/frequency penalties from an output-token histogram."""
+    present = (token_counts > 0).astype(logits.dtype)
+    return (
+        logits
+        - presence[:, None] * present
+        - frequency[:, None] * token_counts.astype(logits.dtype)
+    )

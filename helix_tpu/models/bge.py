@@ -1,0 +1,287 @@
+"""BERT-family embedding encoder (bge-base et al) as batched XLA.
+
+BASELINE.md config 2: "bge-base embedding worker for PGVector RAG ingest".
+The reference serves embeddings via vLLM pooling runners in compose profiles
+(``design/sample-profiles/8xH100-vllm.yaml:15-43``, `--runner pooling`);
+here it is a functional BERT encoder jitted per (batch, seq) bucket:
+tokens -> embeddings -> mean/CLS pool -> L2 normalise, behind
+``/v1/embeddings``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from helix_tpu.ops.norms import layer_norm
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pooling: str = "cls"          # cls | mean  (bge uses CLS)
+    normalize: bool = True
+    dtype: str = "float32"
+    name: str = "bge-base"
+
+    @classmethod
+    def from_hf_config(cls, hf: dict, name: str = "encoder") -> "EncoderConfig":
+        return cls(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            intermediate_size=hf["intermediate_size"],
+            max_position_embeddings=hf.get("max_position_embeddings", 512),
+            type_vocab_size=hf.get("type_vocab_size", 2),
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+            name=name,
+        )
+
+    @classmethod
+    def tiny(cls, **o) -> "EncoderConfig":
+        base = dict(
+            vocab_size=256, hidden_size=32, num_layers=2, num_heads=2,
+            intermediate_size=64, max_position_embeddings=64, name="tiny-enc",
+        )
+        base.update(o)
+        return cls(**base)
+
+
+def init_params(cfg: EncoderConfig, key) -> dict:
+    L, E, F, V = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    ks = jax.random.split(key, 10)
+    dt = jnp.dtype(cfg.dtype)
+
+    def w(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dt)
+
+    return {
+        "embed": {
+            "word": w(ks[0], (V, E)),
+            "position": w(ks[1], (cfg.max_position_embeddings, E)),
+            "token_type": w(ks[2], (cfg.type_vocab_size, E)),
+            "norm": {"weight": jnp.ones((E,), dt), "bias": jnp.zeros((E,), dt)},
+        },
+        "layers": {
+            "wq": {"weight": w(ks[3], (L, E, E)), "bias": jnp.zeros((L, E), dt)},
+            "wk": {"weight": w(ks[4], (L, E, E)), "bias": jnp.zeros((L, E), dt)},
+            "wv": {"weight": w(ks[5], (L, E, E)), "bias": jnp.zeros((L, E), dt)},
+            "wo": {"weight": w(ks[6], (L, E, E)), "bias": jnp.zeros((L, E), dt)},
+            "attn_norm": {
+                "weight": jnp.ones((L, E), dt), "bias": jnp.zeros((L, E), dt)
+            },
+            "w_in": {"weight": w(ks[7], (L, E, F)), "bias": jnp.zeros((L, F), dt)},
+            "w_out": {"weight": w(ks[8], (L, F, E)), "bias": jnp.zeros((L, E), dt)},
+            "mlp_norm": {
+                "weight": jnp.ones((L, E), dt), "bias": jnp.zeros((L, E), dt)
+            },
+        },
+    }
+
+
+def _dense(x, p):
+    out = jax.lax.dot_general(
+        x, p["weight"], (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (out + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def forward(params, cfg: EncoderConfig, tokens, attention_mask):
+    """tokens/attention_mask: [B, S] -> pooled embeddings [B, E]."""
+    B, S = tokens.shape
+    H = cfg.num_heads
+    E = cfg.hidden_size
+    D = E // H
+    dt = jnp.dtype(cfg.dtype)
+
+    emb = params["embed"]
+    h = (
+        emb["word"][tokens]
+        + emb["position"][jnp.arange(S)][None]
+        + emb["token_type"][jnp.zeros_like(tokens)]
+    ).astype(dt)
+    h = layer_norm(
+        h, emb["norm"]["weight"], emb["norm"]["bias"], cfg.layer_norm_eps
+    )
+
+    # bidirectional mask: [B, 1, 1, S]
+    neg = jnp.asarray(-1e9, jnp.float32)
+    bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0, neg)
+
+    def body(h, lp):
+        q = _dense(h, lp["wq"]).reshape(B, S, H, D)
+        k = _dense(h, lp["wk"]).reshape(B, S, H, D)
+        v = _dense(h, lp["wv"]).reshape(B, S, H, D)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / np.sqrt(D)
+        p = jax.nn.softmax(s + bias, axis=-1)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+        ctx = ctx.reshape(B, S, E).astype(dt)
+        h = layer_norm(
+            h + _dense(ctx, lp["wo"]),
+            lp["attn_norm"]["weight"], lp["attn_norm"]["bias"],
+            cfg.layer_norm_eps,
+        )
+        mid = jax.nn.gelu(_dense(h, lp["w_in"]), approximate=False)
+        h = layer_norm(
+            h + _dense(mid, lp["w_out"]),
+            lp["mlp_norm"]["weight"], lp["mlp_norm"]["bias"],
+            cfg.layer_norm_eps,
+        )
+        return h, None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+
+    if cfg.pooling == "cls":
+        pooled = h[:, 0]
+    else:
+        m = attention_mask[..., None].astype(jnp.float32)
+        pooled = (h.astype(jnp.float32) * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+        pooled = pooled.astype(dt)
+    if cfg.normalize:
+        pooled = pooled / jnp.linalg.norm(
+            pooled.astype(jnp.float32), axis=-1, keepdims=True
+        ).astype(pooled.dtype)
+    return pooled
+
+
+def load_hf_encoder(model_dir: str):
+    """Load a HF BERT-style checkpoint into the tree above."""
+    import json
+    import os
+
+    from helix_tpu.models.loader import _open_shards
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf = json.load(f)
+    cfg = EncoderConfig.from_hf_config(hf, name=os.path.basename(model_dir))
+    sh = _open_shards(model_dir)
+    pfx = (
+        "bert."
+        if any(n.startswith("bert.") for n in sh.names)
+        else ""
+    )
+
+    def g(name):
+        return sh.get(pfx + name)
+
+    def lin(name):
+        return np.ascontiguousarray(g(name + ".weight").T), g(name + ".bias")
+
+    L = cfg.num_layers
+
+    def stack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    def lw(i, n):
+        return lin(f"encoder.layer.{i}.{n}")
+
+    layers = {}
+    for ours, theirs in (
+        ("wq", "attention.self.query"),
+        ("wk", "attention.self.key"),
+        ("wv", "attention.self.value"),
+        ("wo", "attention.output.dense"),
+        ("w_in", "intermediate.dense"),
+        ("w_out", "output.dense"),
+    ):
+        layers[ours] = {
+            "weight": stack(lambda i, t=theirs: lw(i, t)[0]),
+            "bias": stack(lambda i, t=theirs: lw(i, t)[1]),
+        }
+    layers["attn_norm"] = {
+        "weight": stack(lambda i: g(f"encoder.layer.{i}.attention.output.LayerNorm.weight")),
+        "bias": stack(lambda i: g(f"encoder.layer.{i}.attention.output.LayerNorm.bias")),
+    }
+    layers["mlp_norm"] = {
+        "weight": stack(lambda i: g(f"encoder.layer.{i}.output.LayerNorm.weight")),
+        "bias": stack(lambda i: g(f"encoder.layer.{i}.output.LayerNorm.bias")),
+    }
+    params = {
+        "embed": {
+            "word": g("embeddings.word_embeddings.weight"),
+            "position": g("embeddings.position_embeddings.weight"),
+            "token_type": g("embeddings.token_type_embeddings.weight"),
+            "norm": {
+                "weight": g("embeddings.LayerNorm.weight"),
+                "bias": g("embeddings.LayerNorm.bias"),
+            },
+        },
+        "layers": layers,
+    }
+    return cfg, jax.tree.map(jnp.asarray, params)
+
+
+class EmbeddingRunner:
+    """Batched embedding worker behind /v1/embeddings (thread-safe via GIL +
+    single jit dispatch; bucketed (batch, seq) compiles)."""
+
+    def __init__(self, cfg: EncoderConfig, params, tokenizer, max_batch=32):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_batch = max_batch
+        self._fns: dict = {}
+
+    @classmethod
+    def build(cls, pm, tokenizer) -> "EmbeddingRunner":
+        if pm.checkpoint:
+            cfg, params = load_hf_encoder(pm.checkpoint)
+        else:
+            cfg = EncoderConfig.tiny(name=pm.name)
+            params = init_params(cfg, jax.random.PRNGKey(0))
+        return cls(cfg, params, tokenizer, max_batch=pm.engine.get("max_batch", 32))
+
+    def _fn(self, B, S):
+        key = (B, S)
+        if key not in self._fns:
+            self._fns[key] = jax.jit(
+                functools.partial(forward, cfg=self.cfg)
+            )
+        return self._fns[key]
+
+    def embed_tokens(self, token_lists) -> np.ndarray:
+        out = []
+        for i in range(0, len(token_lists), self.max_batch):
+            chunk = token_lists[i : i + self.max_batch]
+            maxlen = min(
+                max((len(t) for t in chunk), default=1),
+                self.cfg.max_position_embeddings,
+            )
+            S = 1
+            while S < maxlen:
+                S *= 2
+            S = min(S, self.cfg.max_position_embeddings)
+            B = len(chunk)
+            toks = np.zeros((B, S), np.int32)
+            mask = np.zeros((B, S), np.int32)
+            for j, t in enumerate(chunk):
+                t = list(t)[:S]
+                toks[j, : len(t)] = t
+                mask[j, : len(t)] = 1
+            fn = self._fn(B, S)
+            out.append(
+                np.asarray(
+                    fn(self.params, tokens=jnp.asarray(toks),
+                       attention_mask=jnp.asarray(mask))
+                )
+            )
+        return np.concatenate(out, axis=0) if out else np.zeros((0, self.cfg.hidden_size))
+
+    def embed_texts(self, texts) -> np.ndarray:
+        return self.embed_tokens([self.tokenizer.encode(t) for t in texts])

@@ -23,7 +23,9 @@ class ModelConfig:
     head_dim: int
     intermediate_size: int
     rope_theta: float = 500000.0
-    rope_scaling: Optional[dict] = None
+    # stored as a sorted tuple of (key, value) pairs so the config stays
+    # hashable (it keys compiled-function caches); None = no scaling
+    rope_scaling: Optional[tuple] = None
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     hidden_act: str = "silu"            # silu | gelu | gelu_tanh

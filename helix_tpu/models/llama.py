@@ -33,17 +33,12 @@ Params = dict
 AttnFn = Callable[..., jax.Array]
 
 
-def _dense(x, w, b=None):
-    """x @ w with fp32 MXU accumulation; w may be rank-2 or fused rank-3."""
-    out = jax.lax.dot_general(
-        x,
-        w,
-        (((x.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    if b is not None:
-        out = out + b.astype(jnp.float32)
-    return out.astype(x.dtype)
+def _dense(x, p):
+    """x @ p["weight"] with fp32 MXU accumulation; handles int8-quantized
+    weights ({weight, scale}) and optional bias transparently."""
+    from helix_tpu.ops.quant import maybe_dequant_dense
+
+    return maybe_dequant_dense(x, p)
 
 
 def _act(name: str):
@@ -150,23 +145,23 @@ def _layer(
 
     # --- attention ---
     x = rms_norm(h, p["attn_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
-    q = _dense(x, p["wq"]["weight"], p["wq"].get("bias")).reshape(B, S, H, D)
-    k = _dense(x, p["wk"]["weight"], p["wk"].get("bias")).reshape(B, S, KVH, D)
-    v = _dense(x, p["wv"]["weight"], p["wv"].get("bias")).reshape(B, S, KVH, D)
+    q = _dense(x, p["wq"]).reshape(B, S, H, D)
+    k = _dense(x, p["wk"]).reshape(B, S, KVH, D)
+    v = _dense(x, p["wv"]).reshape(B, S, KVH, D)
     if cfg.qk_norm:
         q = rms_norm(q, p["q_norm"]["weight"], cfg.rms_norm_eps)
         k = rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
     attn_out = attn_fn(q, k, v, layer_cache, positions)
-    h = h + _dense(attn_out.reshape(B, S, H * D), p["wo"]["weight"])
+    h = h + _dense(attn_out.reshape(B, S, H * D), p["wo"])
 
     # --- mlp ---
     x = rms_norm(h, p["mlp_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
     act = _act(cfg.hidden_act)
-    gate = _dense(x, p["w_gate"]["weight"], p["w_gate"].get("bias"))
-    up = _dense(x, p["w_up"]["weight"], p["w_up"].get("bias"))
-    h = h + _dense(act(gate) * up, p["w_down"]["weight"], p["w_down"].get("bias"))
+    gate = _dense(x, p["w_gate"])
+    up = _dense(x, p["w_up"])
+    h = h + _dense(act(gate) * up, p["w_down"])
     return h, (k, v)
 
 
@@ -183,10 +178,12 @@ def forward(
     """Run the decoder. Returns (logits [B, S, V], kv) where kv is the
     per-layer fresh K/V stacked to [L, B, S, KVH, D] — the engine scatters
     these into its paged cache in one op after the call."""
+    from helix_tpu.ops.quant import embed_lookup
+
     inv_freq = jnp.asarray(
         rope_frequencies(cfg.head_dim, cfg.rope_theta, cfg.rope_scaling)
     )
-    h = params["embed"]["weight"][tokens].astype(jnp.dtype(cfg.dtype))
+    h = embed_lookup(params["embed"], tokens, jnp.dtype(cfg.dtype))
 
     def scan_body(h, xs):
         layer_params, layer_cache = xs
@@ -207,11 +204,19 @@ def forward(
         return h, kv
     if cfg.tie_word_embeddings:
         w_out = params["embed"]["weight"].T
+        out_scale = params["embed"].get("embed_scale")  # [V, 1] if quantized
+        out_scale = None if out_scale is None else out_scale[:, 0]
     else:
         w_out = params["lm_head"]["weight"]
+        out_scale = params["lm_head"].get("scale")
+        out_scale = None if out_scale is None else out_scale.reshape(-1)
+    if w_out.dtype == jnp.int8:
+        w_out = w_out.astype(h.dtype)
     logits = jax.lax.dot_general(
         h, w_out, (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
+    if out_scale is not None:
+        logits = logits * out_scale[None, None, :]
     if cfg.logits_soft_cap:
         logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
     return logits, kv

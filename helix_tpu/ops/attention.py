@@ -279,9 +279,12 @@ def attention(
     backend: Optional[str] = None,
     **kwargs,
 ):
-    """Dispatch: Pallas on TPU, reference elsewhere (CPU tests, debugging)."""
+    """Dispatch: Pallas on TPU, reference elsewhere (CPU tests, debugging).
+
+    Auto mode keys off the process default backend (works under tracing,
+    where per-array .devices() is unavailable)."""
     if backend is None:
-        platform = q.devices().pop().platform if hasattr(q, "devices") else "cpu"
+        platform = jax.devices()[0].platform
         backend = "pallas" if platform in ("tpu", "axon") else "reference"
     if backend == "pallas":
         return flash_attention(q, k, v, **kwargs)

@@ -1,0 +1,107 @@
+"""Paged decode attention: one query token per sequence over the page pool.
+
+This is the decode-loop hot op (SURVEY.md §7 hard part #1) — the reference
+gets it from vLLM's PagedAttention CUDA kernels inside its containers; here
+it is TPU-owned:
+
+- ``paged_decode_attention_reference`` — XLA gather-based oracle: gathers
+  each sequence's pages, masks beyond its length, plain softmax.  Correct
+  everywhere; bandwidth-wasteful (gathers ``max_pages`` per seq).
+- ``paged_decode_attention`` — Pallas kernel (``helix_tpu/ops/paged_kernel``)
+  that walks only the pages each sequence actually uses, page table
+  scalar-prefetched into SMEM, double-buffered HBM->VMEM DMA.
+
+Length convention: ``lengths[b]`` = number of PAST tokens in the cache for
+sequence b (the current token's position).  The current token's K/V arrive
+as ``k_new``/``v_new`` and are appended logically at slot ``lengths[b]`` —
+the engine scatters them into pages *after* the forward pass, so the kernel
+must include them itself (write-after-attend keeps the model functional).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from helix_tpu.ops.attention import DEFAULT_MASK_VALUE
+
+
+def paged_decode_attention_reference(
+    q,            # [B, H, D]
+    k_pages,      # [KVH, N, P, D]
+    v_pages,
+    page_tables,  # [B, maxP] int32
+    lengths,      # [B] int32 — past tokens in cache
+    k_new=None,   # [B, KVH, D] current token's K (logically at slot lengths[b])
+    v_new=None,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    B, H, D = q.shape
+    KVH, N, P, _ = k_pages.shape
+    maxP = page_tables.shape[1]
+    group = H // KVH
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+
+    # Gather each sequence's pages: [KVH, B, maxP, P, D] -> [B, KVH, T, D]
+    T = maxP * P
+    kg = (
+        k_pages[:, page_tables]
+        .reshape(KVH, B, T, D)
+        .transpose(1, 0, 2, 3)
+        .astype(jnp.float32)
+    )
+    vg = (
+        v_pages[:, page_tables]
+        .reshape(KVH, B, T, D)
+        .transpose(1, 0, 2, 3)
+        .astype(jnp.float32)
+    )
+    valid = jnp.arange(T)[None, :] < lengths[:, None]  # [B, T]
+    if k_new is not None:
+        kg = jnp.concatenate(
+            [kg, k_new[:, :, None, :].astype(jnp.float32)], axis=2
+        )
+        vg = jnp.concatenate(
+            [vg, v_new[:, :, None, :].astype(jnp.float32)], axis=2
+        )
+        valid = jnp.concatenate([valid, jnp.ones((B, 1), bool)], axis=1)
+
+    qg = q.reshape(B, KVH, group, D).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bktd->bkgt", qg, kg) * scale
+    s = jnp.where(valid[:, None, None, :], s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgt,bktd->bkgd", p, vg)
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+def paged_decode_attention(
+    q,
+    k_pages,
+    v_pages,
+    page_tables,
+    lengths,
+    k_new=None,
+    v_new=None,
+    *,
+    scale: Optional[float] = None,
+    backend: Optional[str] = None,
+):
+    """Dispatcher: Pallas kernel on TPU, reference elsewhere."""
+    if backend is None:
+        platform = jax.devices()[0].platform
+        backend = "pallas" if platform in ("tpu", "axon") else "reference"
+    if backend == "pallas":
+        from helix_tpu.ops.paged_kernel import paged_decode_attention_tpu
+
+        return paged_decode_attention_tpu(
+            q, k_pages, v_pages, page_tables, lengths, k_new, v_new,
+            scale=scale,
+        )
+    return paged_decode_attention_reference(
+        q, k_pages, v_pages, page_tables, lengths, k_new, v_new, scale=scale
+    )

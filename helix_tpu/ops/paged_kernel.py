@@ -1,0 +1,227 @@
+"""Pallas TPU ragged paged-decode attention kernel.
+
+Per-sequence decode attention that walks ONLY the pages each sequence
+actually uses (ragged over the batch), instead of gathering
+``max_pages_per_seq`` like the XLA reference path — the design of Ragged
+Paged Attention (PAPERS.md) specialised to decode:
+
+- Page tables + lengths are **scalar-prefetched into SMEM**, so DMA source
+  addresses are computed before the kernel body runs.
+- KV pages stream HBM -> VMEM with **double-buffered async DMA**; chunks of
+  ``C = ceil(128 / page_size)`` pages are fetched per step so the score
+  matmul runs at full 128-lane width.
+- Online softmax in fp32 scratch; the current token's K/V (not yet written
+  to the pool — the engine scatters after the forward pass) is folded in as
+  a final virtual block.
+
+Grid is ``(B, KVH)``; each program owns one sequence x one kv-head group
+(``group = H / KVH`` query heads).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from helix_tpu.ops.attention import DEFAULT_MASK_VALUE
+
+
+def _decode_kernel(
+    # scalar prefetch
+    pt_ref,      # SMEM [B, maxP] int32 page tables
+    len_ref,     # SMEM [B] int32 past lengths
+    # inputs
+    q_ref,       # VMEM [1, 1, group, D]
+    knew_ref,    # VMEM [1, 1, 1, D]
+    vnew_ref,    # VMEM [1, 1, 1, D]
+    k_hbm,       # ANY  [KVH, N, P, D]
+    v_hbm,
+    # outputs
+    o_ref,       # VMEM [1, 1, group, D]
+    # scratch
+    kbuf,        # VMEM [2, C*P, D]
+    vbuf,        # VMEM [2, C*P, D]
+    sems,        # DMA sems [2, C, 2]
+    *,
+    scale: float,
+    page_size: int,
+    pages_per_chunk: int,
+    max_pages: int,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    P, C = page_size, pages_per_chunk
+    L = len_ref[b]
+    npages = jax.lax.div(L + P - 1, P)
+    nchunks = jax.lax.div(npages + C - 1, C)
+    max_chunks = (max_pages + C - 1) // C
+
+    def start_chunk(ci, slot):
+        for c in range(C):  # static unroll over pages in a chunk
+            @pl.when(ci * C + c < npages)
+            def _():
+                page = pt_ref[b, ci * C + c]
+                pltpu.make_async_copy(
+                    k_hbm.at[h, page],
+                    kbuf.at[slot, pl.ds(c * P, P), :],
+                    sems.at[slot, c, 0],
+                ).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[h, page],
+                    vbuf.at[slot, pl.ds(c * P, P), :],
+                    sems.at[slot, c, 1],
+                ).start()
+
+    def wait_chunk(ci, slot):
+        for c in range(C):
+            @pl.when(ci * C + c < npages)
+            def _():
+                page = pt_ref[b, ci * C + c]
+                pltpu.make_async_copy(
+                    k_hbm.at[h, page],
+                    kbuf.at[slot, pl.ds(c * P, P), :],
+                    sems.at[slot, c, 0],
+                ).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[h, page],
+                    vbuf.at[slot, pl.ds(c * P, P), :],
+                    sems.at[slot, c, 1],
+                ).wait()
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [group, D]
+    group, D = q.shape
+
+    @pl.when(nchunks > 0)
+    def _():
+        start_chunk(0, 0)
+
+    def body(ci, carry):
+        m_prev, l_prev, acc_prev = carry
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < nchunks)
+        def _():
+            start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
+
+        wait_chunk(ci, slot)
+        k = kbuf[slot].astype(jnp.float32)       # [C*P, D]
+        v = vbuf[slot]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                # [group, C*P]
+        token0 = ci * C * P
+        tok = token0 + jax.lax.broadcasted_iota(jnp.int32, (1, C * P), 1)
+        s = jnp.where(tok < L, s, DEFAULT_MASK_VALUE)
+
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc_prev * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((group, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((group, 1), jnp.float32)
+    acc0 = jnp.zeros((group, D), jnp.float32)
+
+    def guarded_body(ci, carry):
+        return jax.lax.cond(
+            ci < nchunks, lambda c: body(ci, c), lambda c: c, carry
+        )
+
+    m, l, acc = jax.lax.fori_loop(0, max_chunks, guarded_body, (m0, l0, acc0))
+
+    # fold in the current token's K/V (virtual final block, always valid)
+    knew = knew_ref[0, 0, 0].astype(jnp.float32)    # [D]
+    vnew = vnew_ref[0, 0, 0].astype(jnp.float32)
+    s_new = jax.lax.dot_general(
+        q, knew[:, None], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                    # [group, 1]
+    m_f = jnp.maximum(m, s_new)
+    p_new = jnp.exp(s_new - m_f)
+    alpha = jnp.exp(m - m_f)
+    l_f = alpha * l + p_new
+    acc_f = acc * alpha + p_new * vnew[None, :]
+    o_ref[0, 0] = (acc_f / l_f).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "interpret")
+)
+def paged_decode_attention_tpu(
+    q,            # [B, H, D]
+    k_pages,      # [KVH, N, P, D]
+    v_pages,
+    page_tables,  # [B, maxP]
+    lengths,      # [B]
+    k_new,        # [B, KVH, D]
+    v_new,
+    *,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+):
+    B, H, D = q.shape
+    KVH, N, P, _ = k_pages.shape
+    maxP = page_tables.shape[1]
+    group = H // KVH
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    C = max(1, 128 // P)
+    C = min(C, maxP)
+
+    qg = q.reshape(B, KVH, group, D)
+    knew4 = k_new.reshape(B, KVH, 1, D)
+    vnew4 = v_new.reshape(B, KVH, 1, D)
+    kernel = functools.partial(
+        _decode_kernel,
+        scale=scale,
+        page_size=P,
+        pages_per_chunk=C,
+        max_pages=maxP,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KVH),
+        in_specs=[
+            pl.BlockSpec((1, 1, group, D), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, D), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, 1, D), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, group, D), lambda b, h, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, C * P, D), k_pages.dtype),
+            pltpu.VMEM((2, C * P, D), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, C, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, group, D), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )(
+        page_tables.astype(jnp.int32),
+        lengths.astype(jnp.int32),
+        qg,
+        knew4,
+        vnew4,
+        k_pages,
+        v_pages,
+    )
+    return out.reshape(B, H, D)

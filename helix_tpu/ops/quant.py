@@ -1,0 +1,99 @@
+"""Weight-only int8 quantization.
+
+Fits Llama-3-8B (16.06 GB bf16 — over a v5e chip's 16 GiB HBM) on a single
+chip and halves weight HBM traffic, which is the decode bottleneck.  The
+reference reaches the same goal by passing ``--quantization`` flags to vLLM
+containers; here it is a pytree transform:
+
+- per-output-channel absmax scales (fp32), symmetric, no zero point;
+- matmul runs ``x_bf16 @ cast(w_int8 -> bf16)`` then scales the output —
+  the cast happens in VMEM after the (halved) HBM fetch, so bandwidth wins
+  are kept while the MXU stays in its well-tuned bf16 path;
+- norms/biases stay bf16 (negligible bytes, precision-critical).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_tensor(w: jax.Array):
+    """Symmetric int8, per-output-channel (last axis) scales.
+
+    Stacked-layer weights ``[L, in, out]`` keep independent scales per layer
+    (reduce over the contraction axes only, never the leading layer axis).
+    Returns {"weight": int8 array, "scale": f32}.
+    """
+    wf = w.astype(jnp.float32)
+    reduce_axes = tuple(range(1 if w.ndim >= 3 else 0, w.ndim - 1))
+    absmax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return {"weight": q, "scale": scale.astype(jnp.float32)}
+
+
+def quantize_params(params: Any) -> Any:
+    """Quantize every matmul weight in a model tree; embedding rows get
+    per-row scales (lookup then rescale)."""
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            out = {}
+            for k, v in tree.items():
+                if (
+                    k == "weight"
+                    and hasattr(v, "ndim")
+                    and v.ndim >= 2
+                    and not any("norm" in p for p in path)
+                ):
+                    if path and path[-1] == "embed":
+                        # embedding: quantize per row (axis -1 reduce)
+                        wf = v.astype(jnp.float32)
+                        absmax = jnp.max(jnp.abs(wf), axis=-1, keepdims=True)
+                        scale = jnp.maximum(absmax / 127.0, 1e-8)
+                        q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(
+                            jnp.int8
+                        )
+                        out["weight"] = q
+                        out["embed_scale"] = scale.astype(jnp.float32)
+                    else:
+                        qd = quantize_tensor(v)
+                        out["weight"] = qd["weight"]
+                        out["scale"] = qd["scale"]
+                else:
+                    out[k] = walk(v, path + (k,))
+            return out
+        return tree
+
+    return walk(params)
+
+
+def maybe_dequant_dense(x, p: dict, compute_dtype=None):
+    """Dense through a possibly-quantized weight dict {weight[, scale, bias]}."""
+    compute_dtype = compute_dtype or x.dtype
+    w = p["weight"]
+    scale = p.get("scale")
+    out = jax.lax.dot_general(
+        x,
+        w.astype(compute_dtype) if w.dtype == jnp.int8 else w,
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if scale is not None:
+        out = out * scale.reshape((1,) * (out.ndim - 1) + (-1,))
+    b = p.get("bias")
+    if b is not None:
+        out = out + b.astype(jnp.float32)
+    return out.astype(compute_dtype)
+
+
+def embed_lookup(p: dict, tokens, compute_dtype):
+    """Embedding lookup through a possibly row-quantized table."""
+    w = p["weight"]
+    emb = w[tokens]
+    if w.dtype == jnp.int8:
+        emb = emb.astype(jnp.float32) * p["embed_scale"][tokens]
+    return emb.astype(compute_dtype)

@@ -1,0 +1,11 @@
+from helix_tpu.serving.tokenizer import ByteTokenizer, load_tokenizer
+from helix_tpu.serving.engine_loop import EngineLoop
+from helix_tpu.serving.registry import ModelRegistry, ServedModel
+
+__all__ = [
+    "ByteTokenizer",
+    "load_tokenizer",
+    "EngineLoop",
+    "ModelRegistry",
+    "ServedModel",
+]

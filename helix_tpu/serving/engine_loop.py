@@ -1,0 +1,105 @@
+"""Engine driver thread: bridges async HTTP handlers to the step loop.
+
+The reference streams SSE chunks from vLLM through hydra and a NATS response
+queue back to the waiting HTTP handler (``SURVEY.md`` §3.2).  In-process the
+same shape holds with cheaper parts: one dedicated thread owns the Engine
+(all JAX dispatch stays single-threaded), handlers submit via a thread-safe
+inbox and receive per-request events through callbacks marshalled onto their
+asyncio loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+from helix_tpu.engine.engine import Engine, FinishReason, Request
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    request_id: str
+    token_id: int
+    finished: bool
+    finish_reason: Optional[str] = None
+
+
+class EngineLoop:
+    def __init__(self, engine: Engine, name: str = "engine"):
+        self.engine = engine
+        self.name = name
+        self._inbox: "queue.Queue" = queue.Queue()
+        self._subscribers: dict[str, Callable[[TokenEvent], None]] = {}
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # serving metrics (scraped by /metrics)
+        self.steps = 0
+        self.started_at = time.monotonic()
+
+    # -- called from any thread --------------------------------------------
+
+    def submit(self, req: Request, on_event: Callable[[TokenEvent], None]):
+        self._inbox.put((req, on_event))
+        self._wake.set()
+
+    def abort(self, request_id: str):
+        self._inbox.put((request_id, None))
+        self._wake.set()
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._run, name=f"helix-engine-{self.name}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, join: bool = True):
+        self._stop.set()
+        self._wake.set()
+        if join and self._thread is not None:
+            self._thread.join(timeout=30)
+
+    # -- engine thread ------------------------------------------------------
+
+    def _drain_inbox(self):
+        while True:
+            try:
+                item, on_event = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            if on_event is None:  # abort
+                self.engine.abort(item)
+                self._subscribers.pop(item, None)
+            else:
+                self._subscribers[item.id] = on_event
+                self.engine.add_request(item)
+
+    def _run(self):
+        while not self._stop.is_set():
+            self._drain_inbox()
+            if not self.engine.has_work():
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            emitted = self.engine.step()
+            self.steps += 1
+            for req, token in emitted:
+                cb = self._subscribers.get(req.id)
+                if cb is None:
+                    continue
+                cb(
+                    TokenEvent(
+                        request_id=req.id,
+                        token_id=token,
+                        finished=req.finished,
+                        finish_reason=(
+                            req.finish_reason.value if req.finish_reason else None
+                        ),
+                    )
+                )
+                if req.finished:
+                    self._subscribers.pop(req.id, None)

@@ -1,0 +1,501 @@
+"""OpenAI- and Anthropic-compatible HTTP surface over the engine.
+
+Mirrors the reference's public inference surface exactly (the routes its
+inference-proxy forwards: ``/v1/chat/completions``, ``/v1/completions``,
+``/v1/embeddings``, ``/v1/models`` — ``api/pkg/inferenceproxy/proxy.go:
+94-120`` — plus the native Anthropic ``/v1/messages`` proxy surface,
+``api/pkg/anthropic/anthropic_proxy.go:32-40``), so a reference control
+plane can point at this server the way it points at a vLLM container.
+
+SSE framing follows OpenAI: ``data: {json}\n\n`` chunks, closing
+``data: [DONE]``; Anthropic streaming emits the event-typed frames
+(message_start / content_block_delta / message_stop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import uuid
+from typing import Optional
+
+from aiohttp import web
+
+from helix_tpu.engine.engine import Request
+from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.serving.registry import ModelRegistry
+from helix_tpu.serving.tokenizer import IncrementalDetokenizer, _content_text
+
+
+def _now() -> int:
+    return int(time.time())
+
+
+def _error(status: int, message: str, etype: str = "invalid_request_error"):
+    return web.json_response(
+        {"error": {"message": message, "type": etype}}, status=status
+    )
+
+
+class OpenAIServer:
+    def __init__(self, registry: ModelRegistry, metrics=None):
+        self.registry = registry
+        self.metrics = metrics
+        self.started = time.monotonic()
+
+    # ------------------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/healthz", self.healthz)
+        app.router.add_get("/metrics", self.prometheus_metrics)
+        app.router.add_get("/v1/models", self.list_models)
+        app.router.add_post("/v1/chat/completions", self.chat_completions)
+        app.router.add_post("/v1/completions", self.completions)
+        app.router.add_post("/v1/embeddings", self.embeddings)
+        app.router.add_post("/v1/messages", self.anthropic_messages)
+        return app
+
+    # ------------------------------------------------------------------
+    async def healthz(self, request):
+        return web.json_response(
+            {"status": "ok", "models": self.registry.names()}
+        )
+
+    async def prometheus_metrics(self, request):
+        lines = [
+            "# TYPE helix_uptime_seconds gauge",
+            f"helix_uptime_seconds {time.monotonic() - self.started:.1f}",
+        ]
+        for m in self.registry.list():
+            if m.loop is None:
+                continue
+            eng = m.loop.engine
+            tag = f'{{model="{m.name}"}}'
+            lines += [
+                f"helix_engine_steps{tag} {m.loop.steps}",
+                f"helix_prefill_tokens_total{tag} {eng.num_prefill_tokens}",
+                f"helix_decode_tokens_total{tag} {eng.num_decode_tokens}",
+                f"helix_waiting_requests{tag} {len(eng.waiting)}",
+                f"helix_active_slots{tag} "
+                f"{sum(1 for s in eng.slots if s is not None)}",
+                f"helix_free_pages{tag} {eng.allocator.free_pages}",
+            ]
+        return web.Response(text="\n".join(lines) + "\n")
+
+    async def list_models(self, request):
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {
+                        "id": m.name,
+                        "object": "model",
+                        "created": m.created,
+                        "owned_by": m.owned_by,
+                        **(
+                            {"context_length": m.context_length}
+                            if m.context_length
+                            else {}
+                        ),
+                    }
+                    for m in self.registry.list()
+                ],
+            }
+        )
+
+    # ------------------------------------------------------------------
+    def _sampling_from_body(self, body: dict) -> SamplingParams:
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        return SamplingParams(
+            temperature=float(body.get("temperature", 1.0)),
+            top_p=float(body.get("top_p", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+            presence_penalty=float(body.get("presence_penalty", 0.0)),
+            frequency_penalty=float(body.get("frequency_penalty", 0.0)),
+            max_tokens=int(
+                body.get("max_tokens")
+                or body.get("max_completion_tokens")
+                or 256
+            ),
+            stop=tuple(stop),
+            seed=body.get("seed"),
+        )
+
+    async def _generate(self, served, prompt_ids, sampling):
+        """Submit to the engine; yields (delta_text, token_id, finished,
+        finish_reason)."""
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_event(ev):
+            loop.call_soon_threadsafe(q.put_nowait, ev)
+
+        req = Request(
+            id=f"req-{uuid.uuid4().hex[:12]}",
+            prompt_tokens=list(prompt_ids),
+            sampling=sampling,
+            stop_token_ids=tuple(served.tokenizer.eos_ids),
+        )
+        served.loop.submit(req, on_event)
+        detok = IncrementalDetokenizer(served.tokenizer)
+        emitted_len = 0
+        try:
+            while True:
+                ev = await asyncio.wait_for(q.get(), timeout=300)
+                is_eos = ev.token_id in served.tokenizer.eos_ids
+                delta = "" if is_eos else detok.push(ev.token_id)
+                # serving-level stop strings
+                hit_stop = None
+                for s in sampling.stop:
+                    idx = detok._emitted.find(s, max(0, emitted_len - len(s)))
+                    if idx >= 0:
+                        hit_stop = idx
+                        break
+                if hit_stop is not None:
+                    keep = detok._emitted[:hit_stop]
+                    final_delta = keep[emitted_len:]
+                    served.loop.abort(req.id)
+                    yield final_delta, ev.token_id, True, "stop"
+                    return
+                emitted_len = len(detok._emitted)
+                yield delta, ev.token_id, ev.finished, ev.finish_reason
+                if ev.finished:
+                    return
+        finally:
+            if not req.finished:
+                served.loop.abort(req.id)
+
+    # ------------------------------------------------------------------
+    async def chat_completions(self, request):
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        model = body.get("model", "")
+        served = self.registry.get(model)
+        if served is None or served.kind == "embedding":
+            return _error(
+                404,
+                f"model '{model}' not found; available: {self.registry.names()}",
+                "model_not_found",
+            )
+        messages = body.get("messages")
+        if not messages:
+            return _error(400, "'messages' is required")
+        sampling = self._sampling_from_body(body)
+        prompt_ids = served.tokenizer.apply_chat_template(
+            messages, add_generation_prompt=True
+        )
+        rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
+        created = _now()
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={
+                    "Content-Type": "text/event-stream",
+                    "Cache-Control": "no-cache",
+                }
+            )
+            await resp.prepare(request)
+
+            async def send(obj):
+                await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
+
+            first = True
+            finish_reason = None
+            ntokens = 0
+            async for delta, tok, finished, reason in self._generate(
+                served, prompt_ids, sampling
+            ):
+                ntokens += 1
+                chunk_delta = {}
+                if first:
+                    chunk_delta["role"] = "assistant"
+                    first = False
+                if delta:
+                    chunk_delta["content"] = delta
+                finish_reason = reason if finished else None
+                await send(
+                    {
+                        "id": rid,
+                        "object": "chat.completion.chunk",
+                        "created": created,
+                        "model": model,
+                        "choices": [
+                            {
+                                "index": 0,
+                                "delta": chunk_delta,
+                                "finish_reason": finish_reason,
+                            }
+                        ],
+                    }
+                )
+                if finished:
+                    break
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+
+        text_parts = []
+        finish_reason = "stop"
+        ntokens = 0
+        async for delta, tok, finished, reason in self._generate(
+            served, prompt_ids, sampling
+        ):
+            text_parts.append(delta)
+            ntokens += 1
+            if finished:
+                finish_reason = reason or "stop"
+                break
+        return web.json_response(
+            {
+                "id": rid,
+                "object": "chat.completion",
+                "created": created,
+                "model": model,
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {
+                            "role": "assistant",
+                            "content": "".join(text_parts),
+                        },
+                        "finish_reason": finish_reason,
+                    }
+                ],
+                "usage": {
+                    "prompt_tokens": len(prompt_ids),
+                    "completion_tokens": ntokens,
+                    "total_tokens": len(prompt_ids) + ntokens,
+                },
+            }
+        )
+
+    # ------------------------------------------------------------------
+    async def completions(self, request):
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        model = body.get("model", "")
+        served = self.registry.get(model)
+        if served is None:
+            return _error(404, f"model '{model}' not found", "model_not_found")
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        sampling = self._sampling_from_body(body)
+        prompt_ids = served.tokenizer.encode(prompt)
+        rid = f"cmpl-{uuid.uuid4().hex[:16]}"
+        created = _now()
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            async for delta, tok, finished, reason in self._generate(
+                served, prompt_ids, sampling
+            ):
+                await resp.write(
+                    f"data: {json.dumps({'id': rid, 'object': 'text_completion', 'created': created, 'model': model, 'choices': [{'index': 0, 'text': delta, 'finish_reason': reason if finished else None}]})}\n\n".encode()
+                )
+                if finished:
+                    break
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+            return resp
+
+        parts = []
+        finish_reason = "stop"
+        n = 0
+        async for delta, tok, finished, reason in self._generate(
+            served, prompt_ids, sampling
+        ):
+            parts.append(delta)
+            n += 1
+            if finished:
+                finish_reason = reason or "stop"
+                break
+        return web.json_response(
+            {
+                "id": rid,
+                "object": "text_completion",
+                "created": created,
+                "model": model,
+                "choices": [
+                    {
+                        "index": 0,
+                        "text": "".join(parts),
+                        "finish_reason": finish_reason,
+                    }
+                ],
+                "usage": {
+                    "prompt_tokens": len(prompt_ids),
+                    "completion_tokens": n,
+                    "total_tokens": len(prompt_ids) + n,
+                },
+            }
+        )
+
+    # ------------------------------------------------------------------
+    async def embeddings(self, request):
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        model = body.get("model", "")
+        served = self.registry.get(model)
+        if served is None or served.kind != "embedding":
+            return _error(
+                404, f"embedding model '{model}' not found", "model_not_found"
+            )
+        inputs = body.get("input", [])
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        vectors = await asyncio.get_running_loop().run_in_executor(
+            None, served.embedder.embed_texts, inputs
+        )
+        return web.json_response(
+            {
+                "object": "list",
+                "model": model,
+                "data": [
+                    {"object": "embedding", "index": i, "embedding": list(map(float, v))}
+                    for i, v in enumerate(vectors)
+                ],
+                "usage": {
+                    "prompt_tokens": sum(len(served.tokenizer.encode(t)) for t in inputs),
+                    "total_tokens": sum(len(served.tokenizer.encode(t)) for t in inputs),
+                },
+            }
+        )
+
+    # ------------------------------------------------------------------
+    async def anthropic_messages(self, request):
+        """Native Anthropic /v1/messages surface (reference:
+        ``api/pkg/anthropic/anthropic_proxy.go``)."""
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        model = body.get("model", "")
+        served = self.registry.get(model)
+        if served is None:
+            return _error(404, f"model '{model}' not found", "not_found_error")
+        messages = list(body.get("messages", []))
+        if body.get("system"):
+            messages = [{"role": "system", "content": body["system"]}] + messages
+        sampling = SamplingParams(
+            temperature=float(body.get("temperature", 1.0)),
+            top_p=float(body.get("top_p", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+            max_tokens=int(body.get("max_tokens", 256)),
+            stop=tuple(body.get("stop_sequences", []) or []),
+        )
+        prompt_ids = served.tokenizer.apply_chat_template(
+            messages, add_generation_prompt=True
+        )
+        rid = f"msg_{uuid.uuid4().hex[:20]}"
+
+        if body.get("stream"):
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+
+            async def ev(name, obj):
+                await resp.write(
+                    f"event: {name}\ndata: {json.dumps(obj)}\n\n".encode()
+                )
+
+            await ev(
+                "message_start",
+                {
+                    "type": "message_start",
+                    "message": {
+                        "id": rid,
+                        "type": "message",
+                        "role": "assistant",
+                        "model": model,
+                        "content": [],
+                        "usage": {"input_tokens": len(prompt_ids), "output_tokens": 0},
+                    },
+                },
+            )
+            await ev(
+                "content_block_start",
+                {
+                    "type": "content_block_start",
+                    "index": 0,
+                    "content_block": {"type": "text", "text": ""},
+                },
+            )
+            n = 0
+            stop_reason = "end_turn"
+            async for delta, tok, finished, reason in self._generate(
+                served, prompt_ids, sampling
+            ):
+                n += 1
+                if delta:
+                    await ev(
+                        "content_block_delta",
+                        {
+                            "type": "content_block_delta",
+                            "index": 0,
+                            "delta": {"type": "text_delta", "text": delta},
+                        },
+                    )
+                if finished:
+                    stop_reason = (
+                        "max_tokens" if reason == "length" else "end_turn"
+                    )
+                    break
+            await ev(
+                "content_block_stop", {"type": "content_block_stop", "index": 0}
+            )
+            await ev(
+                "message_delta",
+                {
+                    "type": "message_delta",
+                    "delta": {"stop_reason": stop_reason},
+                    "usage": {"output_tokens": n},
+                },
+            )
+            await ev("message_stop", {"type": "message_stop"})
+            await resp.write_eof()
+            return resp
+
+        parts = []
+        n = 0
+        stop_reason = "end_turn"
+        async for delta, tok, finished, reason in self._generate(
+            served, prompt_ids, sampling
+        ):
+            parts.append(delta)
+            n += 1
+            if finished:
+                stop_reason = "max_tokens" if reason == "length" else "end_turn"
+                break
+        return web.json_response(
+            {
+                "id": rid,
+                "type": "message",
+                "role": "assistant",
+                "model": model,
+                "content": [{"type": "text", "text": "".join(parts)}],
+                "stop_reason": stop_reason,
+                "usage": {
+                    "input_tokens": len(prompt_ids),
+                    "output_tokens": n,
+                },
+            }
+        )
+
+
+def run_server(registry: ModelRegistry, host="0.0.0.0", port=8000):
+    server = OpenAIServer(registry)
+    web.run_app(server.build_app(), host=host, port=port, print=None)

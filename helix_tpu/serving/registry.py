@@ -1,0 +1,50 @@
+"""Model registry: model name -> engine loop + tokenizer.
+
+The in-process analogue of the reference's inference-proxy routing table
+(``api/pkg/inferenceproxy/proxy.go:94-156`` reads the ``model`` field from
+the request body and forwards to the vLLM container serving it).  Here a
+profile's models map to Engines on mesh slices; the HTTP layer looks up by
+name, with the same "unknown model -> 404 with available list" behaviour.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from helix_tpu.serving.engine_loop import EngineLoop
+
+
+@dataclasses.dataclass
+class ServedModel:
+    name: str
+    loop: EngineLoop
+    tokenizer: object
+    kind: str = "chat"           # chat | embedding | vision
+    created: int = dataclasses.field(default_factory=lambda: int(time.time()))
+    owned_by: str = "helix-tpu"
+    context_length: Optional[int] = None
+    embedder: object = None      # EmbeddingRunner for kind == "embedding"
+
+
+class ModelRegistry:
+    def __init__(self):
+        self._models: dict[str, ServedModel] = {}
+
+    def register(self, model: ServedModel):
+        self._models[model.name] = model
+
+    def unregister(self, name: str):
+        m = self._models.pop(name, None)
+        if m and m.loop:
+            m.loop.stop(join=False)
+
+    def get(self, name: str) -> Optional[ServedModel]:
+        return self._models.get(name)
+
+    def names(self) -> list:
+        return sorted(self._models)
+
+    def list(self) -> list:
+        return [self._models[n] for n in self.names()]

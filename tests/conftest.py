@@ -9,8 +9,10 @@ XLA's host-platform device simulator, exactly how the driver's
 
 import os
 
-# Must be set before jax initialises its backends.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initialises its backends.  FORCE cpu (the sandbox
+# exports JAX_PLATFORMS=axon globally; tests must never touch the real TPU —
+# it is single-tenant and a concurrent bench/test pair deadlocks the tunnel).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -21,6 +23,9 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_default_matmul_precision", "highest")
+# persistent compile cache: repeat test runs skip XLA compilation entirely
+jax.config.update("jax_compilation_cache_dir", "/root/.jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 

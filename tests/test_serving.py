@@ -1,0 +1,237 @@
+"""Serving-surface tests: OpenAI + Anthropic HTTP APIs over a live engine.
+
+Black-box style, mirroring the reference's API integration tier
+(``integration-test/api`` — SURVEY.md §4): a real HTTP server with a real
+(tiny) model behind it, exercised with a plain HTTP client, including SSE
+framing."""
+
+import asyncio
+import json
+import threading
+import time
+
+import jax
+import pytest
+import requests
+
+from helix_tpu.engine.engine import Engine, EngineConfig
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import init_params
+from helix_tpu.serving.engine_loop import EngineLoop
+from helix_tpu.serving.openai_api import OpenAIServer
+from helix_tpu.serving.registry import ModelRegistry, ServedModel
+from helix_tpu.serving.tokenizer import ByteTokenizer, IncrementalDetokenizer
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    eng = Engine(
+        cfg, params,
+        EngineConfig(
+            max_decode_batch=2, page_size=4, num_pages=256,
+            max_pages_per_seq=32, max_prefill_len=128,
+            attn_backend="reference", eos_token_ids=tok.eos_ids,
+        ),
+    )
+    loop = EngineLoop(eng, "tiny").start()
+    registry = ModelRegistry()
+    registry.register(
+        ServedModel(name="tiny-chat", loop=loop, tokenizer=tok,
+                    context_length=128)
+    )
+
+    srv = OpenAIServer(registry)
+    app = srv.build_app()
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        aloop = asyncio.new_event_loop()
+        asyncio.set_event_loop(aloop)
+        runner = __import__("aiohttp").web.AppRunner(app)
+        aloop.run_until_complete(runner.setup())
+        site = __import__("aiohttp").web.TCPSite(runner, "127.0.0.1", 18301)
+        aloop.run_until_complete(site.start())
+        holder["loop"] = aloop
+        started.set()
+        aloop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    yield "http://127.0.0.1:18301"
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+    loop.stop(join=False)
+
+
+class TestOpenAISurface:
+    def test_healthz_and_models(self, server_url):
+        r = requests.get(f"{server_url}/healthz", timeout=10)
+        assert r.status_code == 200 and r.json()["status"] == "ok"
+        r = requests.get(f"{server_url}/v1/models", timeout=10)
+        data = r.json()
+        assert data["object"] == "list"
+        assert data["data"][0]["id"] == "tiny-chat"
+
+    def test_chat_completion_nonstream(self, server_url):
+        r = requests.post(
+            f"{server_url}/v1/chat/completions",
+            json={
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 8,
+                "temperature": 0,
+            },
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["message"]["role"] == "assistant"
+        assert body["usage"]["completion_tokens"] >= 1
+        assert body["choices"][0]["finish_reason"] in ("stop", "length")
+
+    def test_chat_completion_stream_sse(self, server_url):
+        r = requests.post(
+            f"{server_url}/v1/chat/completions",
+            json={
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 6,
+                "temperature": 0,
+                "stream": True,
+            },
+            stream=True,
+            timeout=120,
+        )
+        assert r.status_code == 200
+        assert r.headers["Content-Type"].startswith("text/event-stream")
+        chunks, done = [], False
+        for line in r.iter_lines():
+            if not line:
+                continue
+            assert line.startswith(b"data: ")
+            payload = line[len(b"data: "):]
+            if payload == b"[DONE]":
+                done = True
+                break
+            chunks.append(json.loads(payload))
+        assert done, "missing [DONE] sentinel"
+        assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+        assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+
+    def test_completions_endpoint(self, server_url):
+        r = requests.post(
+            f"{server_url}/v1/completions",
+            json={
+                "model": "tiny-chat", "prompt": "abc",
+                "max_tokens": 4, "temperature": 0,
+            },
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        assert r.json()["object"] == "text_completion"
+
+    def test_unknown_model_404(self, server_url):
+        r = requests.post(
+            f"{server_url}/v1/chat/completions",
+            json={"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+            timeout=10,
+        )
+        assert r.status_code == 404
+        assert "available" in r.json()["error"]["message"]
+
+    def test_missing_messages_400(self, server_url):
+        r = requests.post(
+            f"{server_url}/v1/chat/completions",
+            json={"model": "tiny-chat"},
+            timeout=10,
+        )
+        assert r.status_code == 400
+
+    def test_metrics(self, server_url):
+        r = requests.get(f"{server_url}/metrics", timeout=10)
+        assert "helix_decode_tokens_total" in r.text
+
+    def test_concurrent_requests(self, server_url):
+        """Continuous batching: two concurrent requests both complete."""
+        results = {}
+
+        def go(i):
+            results[i] = requests.post(
+                f"{server_url}/v1/chat/completions",
+                json={
+                    "model": "tiny-chat",
+                    "messages": [{"role": "user", "content": f"msg {i}"}],
+                    "max_tokens": 6,
+                    "temperature": 0,
+                },
+                timeout=180,
+            )
+
+        ts = [threading.Thread(target=go, args=(i,)) for i in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i, r in results.items():
+            assert r.status_code == 200, r.text
+
+
+class TestAnthropicSurface:
+    def test_messages_nonstream(self, server_url):
+        r = requests.post(
+            f"{server_url}/v1/messages",
+            json={
+                "model": "tiny-chat",
+                "system": "be brief",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 6,
+            },
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["type"] == "message"
+        assert body["content"][0]["type"] == "text"
+        assert body["usage"]["output_tokens"] >= 1
+
+    def test_messages_stream_event_framing(self, server_url):
+        r = requests.post(
+            f"{server_url}/v1/messages",
+            json={
+                "model": "tiny-chat",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 5,
+                "stream": True,
+            },
+            stream=True,
+            timeout=120,
+        )
+        events = []
+        for line in r.iter_lines():
+            if line.startswith(b"event: "):
+                events.append(line[len(b"event: "):].decode())
+        assert events[0] == "message_start"
+        assert "content_block_delta" in events
+        assert events[-1] == "message_stop"
+
+
+class TestTokenizer:
+    def test_byte_roundtrip(self):
+        tok = ByteTokenizer()
+        s = "hello wörld 🚀"
+        assert tok.decode(tok.encode(s)) == s
+
+    def test_incremental_detok_utf8_boundary(self):
+        tok = ByteTokenizer()
+        detok = IncrementalDetokenizer(tok)
+        ids = tok.encode("é🚀x")
+        out = ""
+        for i in ids:
+            out += detok.push(i)
+        assert out == "é🚀x"
