@@ -111,6 +111,79 @@ def _cmd_bench(args) -> int:
     return 0
 
 
+def _cmd_sft(args) -> int:
+    """LoRA SFT: the `fine-tune a model from a JSONL dataset` surface the
+    reference exposed through fine-tune sessions (axolotl, deleted)."""
+    import json as _json
+
+    import jax
+
+    from helix_tpu.device.mesh import default_mesh_spec, build_mesh
+    from helix_tpu.models.common import CATALOG, ModelConfig
+    from helix_tpu.models.llama import init_params, param_logical_axes
+    from helix_tpu.parallel.sharding import shard_params
+    from helix_tpu.serving.tokenizer import load_tokenizer
+    from helix_tpu.training.checkpoint import resume_trainer, save_checkpoint
+    from helix_tpu.training.data import load_jsonl, pack_examples
+    from helix_tpu.training.lora import LoraConfig
+    from helix_tpu.training.sft import SFTConfig, SFTTrainer
+
+    tokenizer = load_tokenizer(args.checkpoint, args.model)
+    if args.checkpoint:
+        from helix_tpu.models.loader import load_params
+
+        model_cfg, params = load_params(args.checkpoint)
+    else:
+        model_cfg = CATALOG.get(args.model) or ModelConfig.tiny(name=args.model)
+        params = init_params(model_cfg, jax.random.PRNGKey(0))
+
+    n_dev = len(jax.devices())
+    mesh = None
+    if n_dev > 1:
+        mesh = build_mesh(default_mesh_spec(n_dev))
+        params = shard_params(params, mesh, param_logical_axes(model_cfg))
+
+    cfg = SFTConfig(
+        lora=LoraConfig(rank=args.rank, alpha=args.alpha),
+        learning_rate=args.lr,
+        total_steps=args.steps,
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+    )
+    trainer = SFTTrainer(model_cfg, params, cfg, mesh=mesh)
+    if args.resume and args.output:
+        if resume_trainer(trainer, args.output):
+            print(f"resumed from step {trainer.step_num}")
+
+    examples = load_jsonl(args.data, tokenizer)
+    print(f"loaded {len(examples)} examples")
+
+    def batches():
+        epoch = 0
+        while True:
+            yield from pack_examples(
+                examples, cfg.batch_size, cfg.seq_len, shuffle_seed=epoch
+            )
+            epoch += 1
+
+    def on_log(m):
+        print(_json.dumps(m), flush=True)
+        if args.output and m["step"] % args.save_every == 0:
+            save_checkpoint(
+                args.output, trainer.step_num, trainer.lora_params,
+                trainer.opt_state,
+            )
+
+    trainer.train(batches(), log_every=args.log_every, on_log=on_log)
+    if args.output:
+        save_checkpoint(
+            args.output, trainer.step_num, trainer.lora_params,
+            trainer.opt_state,
+        )
+        print(f"saved adapters to {args.output}")
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="helix-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -145,6 +218,22 @@ def main(argv=None) -> int:
 
     b = sub.add_parser("bench", help="run the standard benchmark")
     b.set_defaults(fn=_cmd_bench)
+
+    t = sub.add_parser("sft", help="LoRA supervised fine-tune from JSONL")
+    t.add_argument("--data", required=True, help="JSONL dataset path")
+    t.add_argument("--model", default="tiny", help="catalogue model name")
+    t.add_argument("--checkpoint", help="HF checkpoint dir (weights+tokenizer)")
+    t.add_argument("--output", help="adapter checkpoint dir")
+    t.add_argument("--resume", action="store_true")
+    t.add_argument("--rank", type=int, default=16)
+    t.add_argument("--alpha", type=float, default=32.0)
+    t.add_argument("--lr", type=float, default=1e-4)
+    t.add_argument("--steps", type=int, default=100)
+    t.add_argument("--batch-size", type=int, default=8)
+    t.add_argument("--seq-len", type=int, default=1024)
+    t.add_argument("--save-every", type=int, default=50)
+    t.add_argument("--log-every", type=int, default=10)
+    t.set_defaults(fn=_cmd_sft)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -72,18 +72,32 @@ def quantize_params(params: Any) -> Any:
 
 
 def maybe_dequant_dense(x, p: dict, compute_dtype=None):
-    """Dense through a possibly-quantized weight dict {weight[, scale, bias]}."""
+    """Dense through a weight dict {weight[, scale, bias, lora_a/lora_b]}.
+
+    Handles int8 weight-only dequant and grafted LoRA adapters
+    (``helix_tpu.training.lora``) in one place so every projection in every
+    model family composes with both."""
     compute_dtype = compute_dtype or x.dtype
     w = p["weight"]
     scale = p.get("scale")
+    cdims = (((x.ndim - 1,), (0,)), ((), ()))
     out = jax.lax.dot_general(
         x,
         w.astype(compute_dtype) if w.dtype == jnp.int8 else w,
-        (((x.ndim - 1,), (0,)), ((), ())),
+        cdims,
         preferred_element_type=jnp.float32,
     )
     if scale is not None:
         out = out * scale.reshape((1,) * (out.ndim - 1) + (-1,))
+    if "lora_a" in p:
+        low = jax.lax.dot_general(
+            x, p["lora_a"].astype(compute_dtype), cdims,
+            preferred_element_type=jnp.float32,
+        )
+        out = out + p["lora_scale"] * jax.lax.dot_general(
+            low.astype(compute_dtype), p["lora_b"].astype(compute_dtype),
+            cdims, preferred_element_type=jnp.float32,
+        )
     b = p.get("bias")
     if b is not None:
         out = out + b.astype(jnp.float32)

@@ -1,0 +1,16 @@
+from helix_tpu.training.lora import (
+    LoraConfig,
+    init_lora_params,
+    merge_lora_into_params,
+    lora_logical_axes,
+)
+from helix_tpu.training.sft import SFTConfig, SFTTrainer
+
+__all__ = [
+    "LoraConfig",
+    "init_lora_params",
+    "merge_lora_into_params",
+    "lora_logical_axes",
+    "SFTConfig",
+    "SFTTrainer",
+]

@@ -1,0 +1,140 @@
+"""LoRA adapters as a separate parameter tree, composable with quantization.
+
+The reference's fine-tune path (axolotl LoRA SFT, deleted mid-pivot —
+``SURVEY.md`` "legacy fine-tune enums", ``types/enums.go:38``) rebuilt
+TPU-native: adapters live in their OWN pytree (only it receives gradients
+and optimizer state — frozen base weights never touch AdamW moments), and
+``merge_lora_into_params`` grafts ``lora_a/lora_b`` into the model tree so
+``models.llama._dense`` applies ``y += (x @ A) @ B * (alpha/r)`` wherever
+they appear.  Works over int8-quantized base weights (QLoRA-style: frozen
+int8 base + bf16 adapters), which is how an SFT job shares a chip with
+serving.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from helix_tpu.models.common import ModelConfig
+
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+ALL_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 16
+    alpha: float = 32.0
+    dropout: float = 0.0
+    targets: tuple = DEFAULT_TARGETS
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _target_dims(cfg: ModelConfig) -> dict:
+    E, H, KVH, D, F = (
+        cfg.hidden_size,
+        cfg.num_heads,
+        cfg.num_kv_heads,
+        cfg.head_dim,
+        cfg.intermediate_size,
+    )
+    return {
+        "wq": (E, H * D),
+        "wk": (E, KVH * D),
+        "wv": (E, KVH * D),
+        "wo": (H * D, E),
+        "w_gate": (E, F),
+        "w_up": (E, F),
+        "w_down": (F, E),
+    }
+
+
+def init_lora_params(
+    model_cfg: ModelConfig,
+    lora_cfg: LoraConfig,
+    key: jax.Array,
+    dtype=jnp.float32,
+) -> dict:
+    """A initialised gaussian, B zero — adapter starts as identity."""
+    dims = _target_dims(model_cfg)
+    L, r = model_cfg.num_layers, lora_cfg.rank
+    out = {}
+    for i, t in enumerate(lora_cfg.targets):
+        fan_in, fan_out = dims[t]
+        k = jax.random.fold_in(key, i)
+        out[t] = {
+            "lora_a": (
+                jax.random.normal(k, (L, fan_in, r), jnp.float32) / jnp.sqrt(fan_in)
+            ).astype(dtype),
+            "lora_b": jnp.zeros((L, r, fan_out), dtype),
+        }
+    return out
+
+
+def lora_logical_axes(lora_params: dict) -> dict:
+    """lora_a shards on its input axis like the base weight's input axis;
+    lora_b on its output axis; rank stays replicated (it is tiny)."""
+    in_axis = {
+        "wq": "embed", "wk": "embed", "wv": "embed", "wo": "heads",
+        "w_gate": "embed", "w_up": "embed", "w_down": "mlp",
+    }
+    out_axis = {
+        "wq": "heads", "wk": "kv_heads", "wv": "kv_heads", "wo": "embed",
+        "w_gate": "mlp", "w_up": "mlp", "w_down": "embed",
+    }
+    return {
+        t: {
+            "lora_a": (None, in_axis[t], "lora_rank"),
+            "lora_b": (None, "lora_rank", out_axis[t]),
+        }
+        for t in lora_params
+    }
+
+
+def merge_lora_into_params(params: dict, lora_params: dict, scaling: float) -> dict:
+    """Graft adapters into the model tree (shallow copies only — no weight
+    math; the low-rank matmul happens inside ``_dense`` at apply time)."""
+    merged = dict(params)
+    layers = dict(params["layers"])
+    for t, lp in lora_params.items():
+        entry = dict(layers[t])
+        entry["lora_a"] = lp["lora_a"]
+        entry["lora_b"] = lp["lora_b"]
+        # [L] so it scans per-layer alongside the stacked weights
+        entry["lora_scale"] = jnp.full(
+            (lp["lora_a"].shape[0],), scaling, jnp.float32
+        )
+        layers[t] = entry
+    merged["layers"] = layers
+    return merged
+
+
+def export_merged_weights(params: dict, lora_params: dict, scaling: float) -> dict:
+    """Bake adapters into dense weights (for serving without the lora path).
+    Only valid for non-quantized base weights."""
+    merged = jax.tree.map(lambda x: x, params)  # shallow-ish copy
+    layers = dict(merged["layers"])
+    for t, lp in lora_params.items():
+        w = layers[t]["weight"]
+        if w.dtype == jnp.int8:
+            raise ValueError(
+                "cannot bake LoRA into int8 base weights; serve with the "
+                "adapter path instead"
+            )
+        delta = jnp.einsum(
+            "lir,lro->lio",
+            lp["lora_a"].astype(jnp.float32),
+            lp["lora_b"].astype(jnp.float32),
+        ) * scaling
+        entry = dict(layers[t])
+        entry["weight"] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+        layers[t] = entry
+    merged["layers"] = layers
+    return merged

@@ -1,0 +1,193 @@
+"""SPMD LoRA SFT trainer: BASELINE.md config 5.
+
+Replaces the reference's deleted axolotl path with an owned JAX trainer:
+frozen (optionally int8) base weights + LoRA adapter tree, sharded over a
+``dp x tp`` mesh — gradients all-reduce over ICI automatically from the
+shardings (dp-sharded batch, tp-sharded weights), multi-host DCN data
+parallelism is the same code with a bigger mesh.  One jitted train step:
+forward (flash attention with packed-segment masking) -> masked CE loss ->
+adapter grads -> AdamW -> new adapters.  Checkpoint/resume via orbax
+(``helix_tpu.training.checkpoint``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import forward
+from helix_tpu.ops.attention import attention as _attention
+from helix_tpu.training.lora import (
+    LoraConfig,
+    init_lora_params,
+    lora_logical_axes,
+    merge_lora_into_params,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class SFTConfig:
+    lora: LoraConfig = LoraConfig()
+    learning_rate: float = 1e-4
+    weight_decay: float = 0.0
+    warmup_steps: int = 10
+    total_steps: int = 100
+    batch_size: int = 8
+    seq_len: int = 1024
+    grad_clip: float = 1.0
+    gradient_accumulation: int = 1
+    seed: int = 0
+    attn_backend: Optional[str] = None
+    remat: bool = True           # jax.checkpoint the layer scan for memory
+
+
+def masked_cross_entropy(logits, targets, loss_mask):
+    """Mean CE over loss-masked positions (fp32)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(loss_mask.sum(), 1.0)
+    return (nll * loss_mask).sum() / denom
+
+
+class SFTTrainer:
+    def __init__(
+        self,
+        model_cfg: ModelConfig,
+        base_params,
+        cfg: SFTConfig,
+        mesh=None,
+    ):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.mesh = mesh
+        self.base_params = base_params
+        key = jax.random.PRNGKey(cfg.seed)
+        self.lora_params = init_lora_params(model_cfg, cfg.lora, key)
+        if mesh is not None:
+            from helix_tpu.parallel.sharding import shard_params
+
+            self.lora_params = shard_params(
+                self.lora_params, mesh, lora_logical_axes(self.lora_params)
+            )
+        schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=cfg.learning_rate,
+            warmup_steps=cfg.warmup_steps,
+            decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
+        )
+        self.opt = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.adamw(schedule, weight_decay=cfg.weight_decay),
+        )
+        self.opt_state = self.opt.init(self.lora_params)
+        self.step_num = 0
+        self._step_fn = None
+
+    # ------------------------------------------------------------------
+    def loss_fn(self, lora_params, base_params, batch):
+        cfg = self.model_cfg
+        merged = merge_lora_into_params(
+            base_params, lora_params, self.cfg.lora.scaling
+        )
+        backend = self.cfg.attn_backend
+        seg = batch["segment_ids"]
+
+        def attn_fn(q, k, v, cache, pos):
+            return _attention(
+                q, k, v,
+                causal=True,
+                q_positions=pos, kv_positions=pos,
+                q_segment_ids=seg, kv_segment_ids=seg,
+                backend=backend,
+            )
+
+        logits, _ = forward(
+            merged, cfg, batch["tokens"], batch["positions"], attn_fn=attn_fn
+        )
+        return masked_cross_entropy(
+            logits, batch["targets"], batch["loss_mask"]
+        )
+
+    def _build_step(self):
+        opt = self.opt
+
+        @jax.jit
+        def step(lora_params, opt_state, base_params, batch):
+            loss, grads = jax.value_and_grad(self.loss_fn)(
+                lora_params, base_params, batch
+            )
+            updates, opt_state = opt.update(grads, opt_state, lora_params)
+            lora_params = optax.apply_updates(lora_params, updates)
+            return lora_params, opt_state, loss
+
+        return step
+
+    def _device_batch(self, batch) -> dict:
+        d = {
+            "tokens": batch.tokens,
+            "targets": batch.targets,
+            "loss_mask": batch.loss_mask,
+            "positions": batch.positions,
+            "segment_ids": batch.segment_ids,
+        }
+        if self.mesh is not None:
+            from helix_tpu.parallel.sharding import logical_sharding
+
+            sh = logical_sharding(self.mesh, ("batch", None))
+            return {k: jax.device_put(jnp.asarray(v), sh) for k, v in d.items()}
+        return {k: jnp.asarray(v) for k, v in d.items()}
+
+    def train_step(self, batch) -> float:
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        self.lora_params, self.opt_state, loss = self._step_fn(
+            self.lora_params, self.opt_state, self.base_params,
+            self._device_batch(batch),
+        )
+        self.step_num += 1
+        return float(loss)
+
+    def train(
+        self,
+        batches: Iterable,
+        log_every: int = 10,
+        on_log=None,
+    ) -> list:
+        """Run up to cfg.total_steps over ``batches``; returns loss history."""
+        history = []
+        t0 = time.monotonic()
+        for batch in batches:
+            if self.step_num >= self.cfg.total_steps:
+                break
+            loss = self.train_step(batch)
+            history.append(loss)
+            if self.step_num % log_every == 0:
+                msg = {
+                    "step": self.step_num,
+                    "loss": round(loss, 4),
+                    "tokens_per_sec": round(
+                        self.step_num
+                        * self.cfg.batch_size
+                        * self.cfg.seq_len
+                        / max(time.monotonic() - t0, 1e-9),
+                        1,
+                    ),
+                }
+                (on_log or (lambda m: None))(msg)
+        return history
+
+    def eval_loss(self, batches: Iterable) -> float:
+        loss_fn = jax.jit(self.loss_fn)
+        losses = [
+            float(loss_fn(self.lora_params, self.base_params,
+                          self._device_batch(b)))
+            for b in batches
+        ]
+        return sum(losses) / max(len(losses), 1)
