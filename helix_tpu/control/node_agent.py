@@ -83,6 +83,32 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     )
 
 
+class DelegatingRegistry:
+    """Stable registry handle whose backing store apply_profile can swap
+    (plain ModelRegistry <-> ResidencyManager) without re-wiring the HTTP
+    server that holds the reference."""
+
+    def __init__(self, inner=None):
+        self.inner = inner or ModelRegistry()
+
+    def get(self, name):
+        return self.inner.get(name)
+
+    def names(self):
+        return self.inner.names()
+
+    def list(self):
+        return self.inner.list()
+
+    def register(self, model):
+        return self.inner.register(model)
+
+    def unregister(self, name):
+        if hasattr(self.inner, "unregister"):
+            return self.inner.unregister(name)
+        return self.inner.evict(name)
+
+
 class NodeAgent:
     """Owns the registry + apply loop + heartbeat loop for one TPU host."""
 
@@ -97,7 +123,7 @@ class NodeAgent:
     ):
         self.runner_id = runner_id
         self.address = address   # where the control plane can reach our OpenAI surface
-        self.registry = registry or ModelRegistry()
+        self.registry = DelegatingRegistry(registry)
         self.state = ApplyState()
         self._build = build_model
         self.heartbeat_url = heartbeat_url
@@ -107,13 +133,24 @@ class NodeAgent:
         self._hb_thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
+    def _teardown_all(self):
+        inner = self.registry.inner
+        if hasattr(inner, "resident_names"):
+            for name in inner.resident_names():
+                inner.evict(name)
+        else:
+            for name in list(inner.names()):
+                inner.unregister(name)
+
     def apply_profile(self, profile: Optional[ServingProfile]) -> ApplyState:
         """Diff-apply: never tears down a model the new profile keeps
-        (mirrors composemgr's no-prune-mid-swap rule, manager.go:1-23)."""
+        (mirrors composemgr's no-prune-mid-swap rule, manager.go:1-23).
+        Profiles with a ``residency`` block swap the backing store to the
+        HBM-accounted ResidencyManager (lazy load, LRU-evict-idle)."""
         with self._lock:
             if profile is None:
-                for name in list(self.registry.names()):
-                    self.registry.unregister(name)
+                self._teardown_all()
+                self.registry.inner = ModelRegistry()
                 self.state = ApplyState(status="running", profile_name="")
                 return self.state
             errors = profile.validate()
@@ -129,20 +166,62 @@ class NodeAgent:
             )
             try:
                 want = {m.name: m for m in profile.models}
-                for name in list(self.registry.names()):
-                    if name not in want:
-                        self.registry.unregister(name)
-                for name, pm in want.items():
-                    if self.registry.get(name) is None:
-                        self.state.progress[name] = "loading"
-                        self.registry.register(self._build(pm))
-                        self.state.progress[name] = "ready"
+                if profile.residency:
+                    self._apply_residency(profile, want)
+                else:
+                    if hasattr(self.registry.inner, "resident_names"):
+                        self._teardown_all()
+                        self.registry.inner = ModelRegistry()
+                    for name in list(self.registry.names()):
+                        if name not in want:
+                            self.registry.unregister(name)
+                    for name, pm in want.items():
+                        if self.registry.get(name) is None:
+                            self.state.progress[name] = "loading"
+                            self.registry.register(self._build(pm))
+                            self.state.progress[name] = "ready"
                 self.state.status = "running"
                 self.state.models = sorted(want)
             except Exception as e:  # noqa: BLE001 — reported via status
                 self.state.status = "failed"
                 self.state.error = f"{e}\n{traceback.format_exc(limit=5)}"
             return self.state
+
+    def _apply_residency(self, profile: ServingProfile, want: dict) -> None:
+        from helix_tpu.device.detect import total_hbm_bytes
+        from helix_tpu.engine.residency import (
+            ResidencyManager,
+            estimate_model_bytes,
+        )
+
+        budget = int(
+            profile.residency.get("hbm_budget_bytes") or total_hbm_bytes()
+        )
+
+        def build(name: str):
+            return self._build(want[name])
+
+        def estimate(name: str) -> int:
+            pm = want[name]
+            if pm.kind == "embedding":
+                return 1 << 28  # encoders are small; flat 256 MiB reservation
+            if pm.checkpoint:
+                from helix_tpu.models.loader import load_config
+
+                model_cfg = load_config(pm.checkpoint, name=pm.name)
+            else:
+                from helix_tpu.models.common import CATALOG, ModelConfig
+
+                model_cfg = CATALOG.get(pm.name) or ModelConfig.tiny(name=pm.name)
+            return estimate_model_bytes(model_cfg, pm.engine, pm.quantization)
+
+        self._teardown_all()
+        mgr = ResidencyManager(budget, build, estimate=estimate)
+        for name in want:
+            mgr.register_name(name)
+        self.registry.inner = mgr
+        for name in want:
+            self.state.progress[name] = "lazy"
 
     # ------------------------------------------------------------------
     def heartbeat_payload(self) -> dict:

@@ -103,6 +103,11 @@ class ServingProfile:
     name: str
     models: tuple
     requirement: ProfileRequirement = ProfileRequirement()
+    # hot-swap group: {"hbm_budget_bytes": N} lets the profile declare MORE
+    # models than fit at once; the node agent then serves them through the
+    # HBM-accounted residency manager (load-on-demand, LRU-evict-idle) —
+    # the reference's multi-model story is compose down/up per swap.
+    residency: Optional[dict] = None
 
     @classmethod
     def from_yaml(cls, text: str) -> "ServingProfile":
@@ -115,6 +120,7 @@ class ServingProfile:
             name=d["name"],
             models=tuple(ProfileModel.from_dict(m) for m in d.get("models", [])),
             requirement=ProfileRequirement.from_dict(d.get("requirement", {})),
+            residency=d.get("residency"),
         )
 
     def to_dict(self) -> dict:
@@ -122,6 +128,7 @@ class ServingProfile:
             "name": self.name,
             "requirement": self.requirement.to_dict(),
             "models": [m.to_dict() for m in self.models],
+            **({"residency": self.residency} if self.residency else {}),
         }
 
     def to_yaml(self) -> str:

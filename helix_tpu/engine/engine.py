@@ -280,6 +280,9 @@ class Engine:
 
     def _admit(self, emitted) -> None:
         while self.waiting:
+            if self.waiting[0].finished:   # aborted while queued
+                self.waiting.pop(0)
+                continue
             free_slots = [i for i, s in enumerate(self.slots) if s is None]
             if not free_slots:
                 return
@@ -403,4 +406,6 @@ class Engine:
             self.slots[req.slot] = None
             self._sampling_dirty = True
             req.slot = None
+        if req in self.waiting:   # aborted before admission
+            self.waiting.remove(req)
         self.allocator.free(req.id)

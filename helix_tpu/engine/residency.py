@@ -1,0 +1,218 @@
+"""HBM-accounted multi-model residency: in-process hot-swap.
+
+BASELINE.md config 3 ("Llama-3-8B + Phi-3-mini hot-swap on one chip") and
+SURVEY.md §7 stage 3: where the reference swaps models by ``docker compose
+down/up`` of vLLM containers (weights re-downloaded/re-loaded each time,
+minutes), this build keeps models as in-process Engines and swaps by
+load/evict against an HBM budget:
+
+- every model's footprint = weight bytes (exact, from the param tree) +
+  page-pool bytes (from CacheConfig) + an activation headroom margin;
+- ``acquire(name)`` loads on demand, evicting least-recently-used IDLE
+  models (never one with in-flight requests) until the budget fits —
+  the scheduling decision ``gpu-memory-utilization`` flags approximate in
+  vLLM, made exact here by the device layer's HBM numbers;
+- eviction stops the engine loop and drops the param/cache references; XLA
+  frees the HBM when the arrays die.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Optional
+
+from helix_tpu.serving.registry import ModelRegistry, ServedModel
+
+
+def tree_bytes(tree) -> int:
+    import jax
+
+    return sum(
+        x.size * x.dtype.itemsize
+        for x in jax.tree.leaves(tree)
+        if hasattr(x, "size")
+    )
+
+
+def estimate_model_bytes(
+    model_cfg,
+    engine_kwargs: dict,
+    quantization: Optional[str] = None,
+    headroom: float = 0.10,
+) -> int:
+    """Predict a chat model's HBM footprint from its config BEFORE building:
+    weight bytes (arch param count x itemsize) + page-pool bytes + headroom.
+    The exact-accounting replacement for the reference's deleted GGUF
+    memory-estimation package (``api/pkg/memory/estimate.go`` — 'should not
+    be used anymore')."""
+    from helix_tpu.engine.engine import EngineConfig
+    from helix_tpu.engine.kv_cache import CacheConfig
+
+    c = model_cfg
+    embed = c.vocab_size * c.hidden_size
+    per_layer = (
+        c.hidden_size * c.num_heads * c.head_dim        # wq
+        + 2 * c.hidden_size * c.num_kv_heads * c.head_dim  # wk, wv
+        + c.num_heads * c.head_dim * c.hidden_size      # wo
+        + 3 * c.hidden_size * c.intermediate_size       # gate, up, down
+        + 2 * c.hidden_size                             # norms
+    )
+    n_params = embed * (1 if c.tie_word_embeddings else 2) + (
+        c.num_layers * per_layer + c.hidden_size
+    )
+    import jax.numpy as jnp
+
+    itemsize = 1 if quantization == "int8" else jnp.dtype(c.dtype).itemsize
+    weight_bytes = n_params * itemsize
+    ecfg = EngineConfig(**engine_kwargs) if engine_kwargs else EngineConfig()
+    cache_bytes = ecfg.cache_config(dtype=c.dtype).total_bytes(c)
+    return int((weight_bytes + cache_bytes) * (1 + headroom))
+
+
+def served_model_bytes(m: ServedModel, headroom: float = 0.10) -> int:
+    """Footprint of a live ServedModel: weights + KV pages (+headroom)."""
+    total = 0
+    if m.loop is not None:
+        eng = m.loop.engine
+        total += tree_bytes(eng.params)
+        total += tree_bytes((eng.cache.k_pages, eng.cache.v_pages))
+    elif m.embedder is not None:
+        total += tree_bytes(m.embedder.params)
+    return int(total * (1 + headroom))
+
+
+@dataclasses.dataclass
+class Resident:
+    model: ServedModel
+    bytes: int
+    last_used: float
+    loads: int = 0
+
+
+class ResidencyManager:
+    """A ModelRegistry whose ``get`` faults models in against an HBM budget."""
+
+    def __init__(
+        self,
+        hbm_budget_bytes: int,
+        build: Callable[[str], ServedModel],
+        estimate: Optional[Callable[[str], int]] = None,
+        measure: Callable[[ServedModel], int] = served_model_bytes,
+    ):
+        """``estimate(name)`` predicts a model's footprint BEFORE building it
+        so eviction happens first (mandatory on a real chip — build-then-
+        evict would OOM HBM).  Without it, acquire builds first and measures
+        (fine on CPU/tests, wrong on device)."""
+        self.budget = hbm_budget_bytes
+        self._build = build
+        self._estimate = estimate
+        self._measure = measure
+        self._resident: dict[str, Resident] = {}
+        self._known: set = set()
+        self._lock = threading.Lock()
+        # metrics
+        self.evictions = 0
+        self.loads = 0
+
+    # -- registry-compatible surface --------------------------------------
+    def register_name(self, name: str) -> None:
+        self._known.add(name)
+
+    def names(self) -> list:
+        return sorted(self._known)
+
+    def resident_names(self) -> list:
+        return sorted(self._resident)
+
+    def get(self, name: str) -> Optional[ServedModel]:
+        if name not in self._known:
+            return None
+        return self.acquire(name)
+
+    def list(self) -> list:
+        with self._lock:
+            return [r.model for _, r in sorted(self._resident.items())]
+
+    def used_bytes(self) -> int:
+        with self._lock:
+            return sum(r.bytes for r in self._resident.values())
+
+    # -- residency ----------------------------------------------------------
+    def _is_idle(self, r: Resident) -> bool:
+        loop = r.model.loop
+        if loop is None:
+            return True
+        eng = loop.engine
+        return not eng.has_work()
+
+    def _evict_until_fits(self, need: int) -> bool:
+        """Evict LRU idle models until ``need`` bytes fit. Lock held."""
+        while self.used_bytes_locked() + need > self.budget:
+            victims = [
+                r
+                for r in self._resident.values()
+                if self._is_idle(r)
+            ]
+            if not victims:
+                return False
+            victim = min(victims, key=lambda r: r.last_used)
+            self._evict(victim.model.name)
+        return True
+
+    def used_bytes_locked(self) -> int:
+        return sum(r.bytes for r in self._resident.values())
+
+    def _evict(self, name: str) -> None:
+        r = self._resident.pop(name, None)
+        if r is None:
+            return
+        if r.model.loop is not None:
+            r.model.loop.stop(join=False)
+        self.evictions += 1
+
+    def acquire(self, name: str) -> ServedModel:
+        with self._lock:
+            r = self._resident.get(name)
+            if r is not None:
+                r.last_used = time.monotonic()
+                return r.model
+            if self._estimate is not None:
+                # device path: predict footprint, evict FIRST, then build
+                need = self._estimate(name)
+                if not self._evict_until_fits(need):
+                    raise MemoryError(
+                        f"cannot fit model '{name}' ({need >> 20} MiB) in "
+                        f"HBM budget {self.budget >> 20} MiB: all resident "
+                        f"models busy"
+                    )
+                model = self._build(name)
+                need = max(need, self._measure(model))
+            else:
+                # host/test path: build first, measure exactly, then evict
+                model = self._build(name)
+                need = self._measure(model)
+                if not self._evict_until_fits(need):
+                    if model.loop is not None:
+                        model.loop.stop(join=False)
+                    raise MemoryError(
+                        f"cannot fit model '{name}' ({need >> 20} MiB) in "
+                        f"HBM budget {self.budget >> 20} MiB: all resident "
+                        f"models busy"
+                    )
+            self._resident[name] = Resident(
+                model=model, bytes=need, last_used=time.monotonic(), loads=1
+            )
+            self.loads += 1
+            return model
+
+    def evict(self, name: str) -> None:
+        with self._lock:
+            self._evict(name)
+
+    def touch(self, name: str) -> None:
+        with self._lock:
+            r = self._resident.get(name)
+            if r:
+                r.last_used = time.monotonic()

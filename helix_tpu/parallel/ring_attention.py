@@ -1,0 +1,141 @@
+"""Ring attention: sequence/context parallelism over the ``sp`` mesh axis.
+
+Long-context is absent in the reference (``SURVEY.md`` §5 "Long-context /
+sequence parallelism: Absent... No ring attention / blockwise / Ulysses / CP
+anywhere"); here it is a first-class engine capability.  Blockwise ring
+attention (Liu et al.) the XLA way:
+
+- the sequence shards over ``sp``; each device holds local Q, K, V blocks;
+- ``sp_size`` steps: each device computes blockwise attention of its local
+  Q against the KV block currently resident, folds it into running online-
+  softmax stats (m, l, acc), then rotates KV one hop with ``lax.ppermute``
+  — a neighbour exchange that XLA maps onto ICI ring links;
+- communication overlaps compute (XLA schedules the collective-permute
+  concurrently with the local block matmul), bytes per step are the KV
+  shard, never the full sequence; peak memory is O(S/sp).
+
+Inside each step the local block runs the same Pallas flash kernel the
+engine uses on TPU (reference path on CPU), so causal masking with absolute
+positions falls out of the existing kernels' ``q_positions/kv_positions``
+support rather than per-device index bookkeeping.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from helix_tpu.ops.attention import DEFAULT_MASK_VALUE
+
+
+def _block_stats(q, k, v, qpos, kpos, scale, causal):
+    """Blockwise attention stats for one (Q shard, KV block) pair.
+
+    q: [B, Sq, H, D]; k/v: [B, Skv, KVH, D] -> (m [B,H,Sq,1], l, acc
+    [B,H,Sq,D]) in fp32.  GQA handled by head repeat at the stats level.
+    """
+    B, Sq, H, D = q.shape
+    KVH = k.shape[2]
+    if KVH != H:
+        k = jnp.repeat(k, H // KVH, axis=2)
+        v = jnp.repeat(v, H // KVH, axis=2)
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        mask = qpos[:, None, :, None] >= kpos[:, None, None, :]
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    m = jnp.max(s, axis=-1, keepdims=True)                       # [B,H,Sq,1]
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def _merge_stats(m1, l1, a1, m2, l2, a2):
+    m = jnp.maximum(m1, m2)
+    e1 = jnp.exp(m1 - m)
+    e2 = jnp.exp(m2 - m)
+    return m, l1 * e1 + l2 * e2, a1 * e1 + a2 * e2
+
+
+def _ring_body(q, k, v, qpos, kpos, axis_name, scale, causal):
+    """Runs inside shard_map: local shards + ppermute ring."""
+    sp = jax.lax.axis_size(axis_name)
+    B, Sq, H, D = q.shape
+
+    # derive the init carry from q so it carries the same varying-manual-axes
+    # type as the loop outputs (jax>=0.9 shard_map typing)
+    acc = jnp.zeros_like(q, jnp.float32).transpose(0, 2, 1, 3)  # [B,H,Sq,D]
+    l = acc[..., :1]
+    m = l - jnp.inf
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def step(i, carry):
+        m, l, acc, k, v, kpos = carry
+        bm, bl, bacc = _block_stats(q, k, v, qpos, kpos, scale, causal)
+        m, l, acc = _merge_stats(m, l, acc, bm, bl, bacc)
+        # rotate KV (and its positions) one hop — skipped after last use
+        k, v, kpos = jax.lax.cond(
+            i < sp - 1,
+            lambda ops: tuple(
+                jax.lax.ppermute(o, axis_name, perm) for o in ops
+            ),
+            lambda ops: ops,
+            (k, v, kpos),
+        )
+        return m, l, acc, k, v, kpos
+
+    m, l, acc, _, _, _ = jax.lax.fori_loop(
+        0, sp, step, (m, l, acc, k, v, kpos)
+    )
+    l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows (padding) -> zeros
+    out = (acc / l).transpose(0, 2, 1, 3)   # [B, Sq, H, D]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q,            # [B, S, H, D] sharded on S over axis_name
+    k,
+    v,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    q_positions=None,   # [B, S] absolute positions (sharded like S)
+    causal: bool = True,
+    scale: Optional[float] = None,
+):
+    """Sequence-parallel attention over a mesh axis.
+
+    Call with globally-shaped arrays; shard_map splits them on the sequence
+    axis.  Positions default to ``arange(S)``."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+    kv_positions = q_positions
+
+    seq = P(None, axis_name, None, None)
+    pos = P(None, axis_name)
+
+    body = functools.partial(
+        _ring_body, axis_name=axis_name, scale=scale, causal=causal
+    )
+    fn = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(seq, seq, seq, pos, pos),
+        out_specs=seq,
+    )
+    return fn(q, k, v, q_positions, kv_positions)

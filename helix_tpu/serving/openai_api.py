@@ -105,6 +105,24 @@ class OpenAIServer:
         )
 
     # ------------------------------------------------------------------
+    async def _lookup(self, model: str):
+        """Resolve a model, faulting it in off the event loop (the registry
+        may be a ResidencyManager that loads weights on demand).  Returns
+        (served, error_response)."""
+        try:
+            served = await asyncio.get_running_loop().run_in_executor(
+                None, self.registry.get, model
+            )
+        except MemoryError as e:
+            return None, _error(503, str(e), "overloaded_error")
+        if served is None:
+            return None, _error(
+                404,
+                f"model '{model}' not found; available: {self.registry.names()}",
+                "model_not_found",
+            )
+        return served, None
+
     def _sampling_from_body(self, body: dict) -> SamplingParams:
         stop = body.get("stop") or []
         if isinstance(stop, str):
@@ -175,13 +193,12 @@ class OpenAIServer:
         except Exception:
             return _error(400, "invalid JSON body")
         model = body.get("model", "")
-        served = self.registry.get(model)
-        if served is None or served.kind == "embedding":
-            return _error(
-                404,
-                f"model '{model}' not found; available: {self.registry.names()}",
-                "model_not_found",
-            )
+        served, err = await self._lookup(model)
+        if err is not None:
+            return err
+        if served.kind == "embedding":
+            return _error(404, f"model '{model}' is an embedding model",
+                          "model_not_found")
         messages = body.get("messages")
         if not messages:
             return _error(400, "'messages' is required")
@@ -281,9 +298,9 @@ class OpenAIServer:
         except Exception:
             return _error(400, "invalid JSON body")
         model = body.get("model", "")
-        served = self.registry.get(model)
-        if served is None:
-            return _error(404, f"model '{model}' not found", "model_not_found")
+        served, err = await self._lookup(model)
+        if err is not None:
+            return err
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
@@ -348,10 +365,12 @@ class OpenAIServer:
         except Exception:
             return _error(400, "invalid JSON body")
         model = body.get("model", "")
-        served = self.registry.get(model)
-        if served is None or served.kind != "embedding":
+        served, err = await self._lookup(model)
+        if err is not None:
+            return err
+        if served.kind != "embedding":
             return _error(
-                404, f"embedding model '{model}' not found", "model_not_found"
+                404, f"'{model}' is not an embedding model", "model_not_found"
             )
         inputs = body.get("input", [])
         if isinstance(inputs, str):
@@ -383,9 +402,9 @@ class OpenAIServer:
         except Exception:
             return _error(400, "invalid JSON body")
         model = body.get("model", "")
-        served = self.registry.get(model)
-        if served is None:
-            return _error(404, f"model '{model}' not found", "not_found_error")
+        served, err = await self._lookup(model)
+        if err is not None:
+            return err
         messages = list(body.get("messages", []))
         if body.get("system"):
             messages = [{"role": "system", "content": body["system"]}] + messages

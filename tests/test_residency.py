@@ -1,0 +1,135 @@
+"""HBM-accounted multi-model residency tests (BASELINE config 3: hot-swap)."""
+
+import jax
+import pytest
+
+from helix_tpu.control.node_agent import NodeAgent
+from helix_tpu.control.profile import ServingProfile
+from helix_tpu.engine.engine import Engine, EngineConfig, Request
+from helix_tpu.engine.residency import (
+    ResidencyManager,
+    estimate_model_bytes,
+    served_model_bytes,
+    tree_bytes,
+)
+from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import init_params
+from helix_tpu.serving.engine_loop import EngineLoop
+from helix_tpu.serving.registry import ServedModel
+from helix_tpu.serving.tokenizer import ByteTokenizer
+
+
+def _mk_model(name: str) -> ServedModel:
+    cfg = ModelConfig.tiny(dtype="float32", name=name)
+    params = init_params(cfg, jax.random.PRNGKey(hash(name) % 1000))
+    eng = Engine(
+        cfg, params,
+        EngineConfig(
+            max_decode_batch=1, page_size=4, num_pages=32,
+            max_pages_per_seq=8, max_prefill_len=32,
+            attn_backend="reference",
+        ),
+    )
+    return ServedModel(
+        name=name, loop=EngineLoop(eng, name).start(), tokenizer=ByteTokenizer()
+    )
+
+
+class TestAccounting:
+    def test_tree_bytes(self):
+        cfg = ModelConfig.tiny(dtype="float32")
+        params = init_params(cfg, jax.random.PRNGKey(0), dtype="float32")
+        n = tree_bytes(params)
+        assert n > 4 * cfg.vocab_size * cfg.hidden_size  # at least embed
+
+    def test_estimate_close_to_measured(self):
+        m = _mk_model("estimate-check")
+        measured = served_model_bytes(m, headroom=0.0)
+        est = estimate_model_bytes(
+            m.loop.engine.model_cfg,
+            dict(max_decode_batch=1, page_size=4, num_pages=32,
+                 max_pages_per_seq=8, max_prefill_len=32,
+                 attn_backend="reference"),
+            headroom=0.0,
+        )
+        assert 0.8 < est / measured < 1.3, (est, measured)
+        m.loop.stop(join=False)
+
+
+class TestResidencyManager:
+    def _mgr(self, budget_models: float):
+        one = served_model_bytes(_mk_model("probe"), headroom=0.0)
+        mgr = ResidencyManager(
+            int(one * budget_models),
+            build=_mk_model,
+            measure=lambda m: served_model_bytes(m, headroom=0.0),
+        )
+        for n in ("model-a", "model-b"):
+            mgr.register_name(n)
+        return mgr
+
+    def test_lru_hot_swap(self):
+        mgr = self._mgr(1.5)   # fits exactly one model
+        a = mgr.acquire("model-a")
+        assert mgr.resident_names() == ["model-a"]
+        b = mgr.acquire("model-b")
+        assert mgr.resident_names() == ["model-b"]  # a evicted (idle LRU)
+        assert mgr.evictions == 1 and mgr.loads == 2
+        mgr.acquire("model-b")  # hit, no reload
+        assert mgr.loads == 2
+
+    def test_budget_fits_both(self):
+        mgr = self._mgr(3.0)
+        mgr.acquire("model-a")
+        mgr.acquire("model-b")
+        assert mgr.resident_names() == ["model-a", "model-b"]
+        assert mgr.evictions == 0
+
+    def test_busy_model_not_evicted(self):
+        mgr = self._mgr(1.5)
+        a = mgr.acquire("model-a")
+        # park an unfinished request so the engine reports work (freeze the
+        # loop so it cannot drain it mid-test)
+        a.loop.stop(join=True)
+        req = Request(
+            id="busy", prompt_tokens=[1, 2, 3],
+            sampling=SamplingParams(max_tokens=1000),
+        )
+        a.loop.engine.add_request(req)
+        with pytest.raises(MemoryError):
+            mgr.acquire("model-b")
+        a.loop.engine.abort("busy")
+        b = mgr.acquire("model-b")
+        assert mgr.resident_names() == ["model-b"]
+
+    def test_unknown_model_none(self):
+        mgr = self._mgr(2)
+        assert mgr.get("nope") is None
+
+
+class TestNodeAgentResidency:
+    def test_profile_with_residency_lazy_loads(self):
+        agent = NodeAgent("n1", build_model=lambda pm: _mk_model(pm.name))
+        profile = ServingProfile.from_dict(
+            {
+                "name": "hotswap",
+                "requirement": {"chips": 1},
+                "residency": {"hbm_budget_bytes": 1 << 40},
+                "models": [
+                    {"name": "model-a", "engine": {}},
+                    {"name": "model-b", "engine": {}},
+                ],
+            }
+        )
+        state = agent.apply_profile(profile)
+        assert state.status == "running", state.error
+        # nothing resident yet
+        assert agent.registry.inner.resident_names() == []
+        assert sorted(agent.registry.names()) == ["model-a", "model-b"]
+        served = agent.registry.get("model-a")
+        assert served is not None
+        assert agent.registry.inner.resident_names() == ["model-a"]
+        # switching back to an eager profile tears down residents
+        agent.apply_profile(None)
+        assert agent.registry.names() == []
