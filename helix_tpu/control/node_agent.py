@@ -60,7 +60,40 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     from helix_tpu.ops.quant import quantize_params
     from helix_tpu.serving.engine_loop import EngineLoop
 
-    if pm.checkpoint:
+    vision_runner = None
+    if pm.kind == "vision":
+        from helix_tpu.models.qwen2_vl import (
+            VisionConfig,
+            init_vision_params,
+            load_qwen2_vl,
+        )
+        from helix_tpu.serving.vision import VisionRunner
+
+        if pm.checkpoint:
+            model_cfg, vcfg, params = load_qwen2_vl(pm.checkpoint)
+            model_cfg = dataclasses.replace(model_cfg, name=pm.name)
+            vparams = params.pop("visual")
+        else:
+            model_cfg = ModelConfig.tiny(
+                name=pm.name, attention_bias=True, mrope_sections=(2, 3, 3),
+                vocab_size=max(getattr(tokenizer, "vocab_size", 512), 512),
+            )
+            params = init_params(model_cfg, jax.random.PRNGKey(0))
+            vcfg = VisionConfig.tiny(hidden_size=model_cfg.hidden_size)
+            vparams = init_vision_params(vcfg, jax.random.PRNGKey(1))
+
+        def special(tok, name, default):
+            fn = getattr(tok, "_special", None)
+            v = fn(name) if fn else None
+            return v if v is not None else default
+
+        vision_runner = VisionRunner(
+            vcfg, vparams,
+            image_pad_id=special(tokenizer, "<|image_pad|>", 260 + 4),
+            vision_start_id=special(tokenizer, "<|vision_start|>", 260 + 5),
+            vision_end_id=special(tokenizer, "<|vision_end|>", 260 + 6),
+        )
+    elif pm.checkpoint:
         from helix_tpu.models.loader import load_params
 
         model_cfg, params = load_params(pm.checkpoint)
@@ -80,6 +113,7 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     return ServedModel(
         name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,
         context_length=pm.context_length or model_cfg.max_position_embeddings,
+        vision=vision_runner,
     )
 
 

@@ -63,6 +63,11 @@ class Request:
     prompt_tokens: list
     sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
     stop_token_ids: tuple = ()
+    # --- multimodal (Qwen2-VL family) ---
+    image_embeds: Optional[object] = None    # [N_img_tokens, E] device array
+    image_positions: Optional[list] = None   # indices of image tokens in prompt
+    positions3: Optional[object] = None      # np [3, S] mrope position streams
+    mrope_delta: int = 0                     # decode-time stream offset
     # mutable state
     output_tokens: list = dataclasses.field(default_factory=list)
     finished: bool = False
@@ -143,13 +148,84 @@ def _build_prefill_fn(model_cfg: ModelConfig, page_size: int, backend):
 
 
 @functools.lru_cache(maxsize=64)
+def _build_prefill_fn_mrope(model_cfg: ModelConfig, page_size: int, backend):
+    """Qwen2-VL-family prefill: takes spliced input embeddings + 3-stream
+    mrope positions; masking/KV-writes stay sequence-indexed."""
+    from helix_tpu.models.qwen2_vl import text_forward_mrope
+
+    cfg = model_cfg
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def prefill_fn(
+        params, cache, tokens, embeds, positions3, page_table, length,
+        sampling, key,
+    ):
+        B, S = tokens.shape  # B == 1
+        positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+        valid = positions < length
+        seg = valid.astype(jnp.int32)
+
+        def attn_fn(q, k, v, layer_cache, pos):
+            return full_attention(
+                q, k, v,
+                causal=True,
+                q_positions=pos,
+                kv_positions=pos,
+                q_segment_ids=seg,
+                kv_segment_ids=seg,
+                backend=backend,
+            )
+
+        logits, (k_new, v_new) = text_forward_mrope(
+            params, cfg, tokens, positions3,
+            attn_fn=attn_fn,
+            input_embeds=embeds,
+            mrope_sections=cfg.mrope_sections,
+            seq_positions=positions,
+        )
+        pages, offsets = slot_to_page_offset(positions, page_table, page_size)
+        cache = write_kv(cache, k_new, v_new, pages, offsets, valid)
+        last = logits[jnp.arange(B), length - 1]
+        token = sample(last, sampling, key)
+        return cache, token
+
+    return prefill_fn
+
+
+@functools.lru_cache(maxsize=16)
+def _build_embed_splice_fn(model_cfg: ModelConfig):
+    """tokens [1,S] + padded image embeds [N, E] + their target indices ->
+    spliced input embeddings (bucketed on N by the caller)."""
+    cfg = model_cfg
+
+    @jax.jit
+    def splice(params, tokens, img_embeds, img_pos, n_img):
+        from helix_tpu.ops.quant import embed_lookup
+
+        emb = embed_lookup(params["embed"], tokens, jnp.dtype(cfg.dtype))
+        S = tokens.shape[1]
+        idx = jnp.where(
+            jnp.arange(img_embeds.shape[0]) < n_img, img_pos, S + 1
+        )
+        emb = emb[0].at[idx].set(
+            img_embeds.astype(emb.dtype), mode="drop"
+        )[None]
+        return emb
+
+    return splice
+
+
+@functools.lru_cache(maxsize=64)
 def _build_decode_fn(model_cfg: ModelConfig, page_size: int, backend):
     cfg = model_cfg
+    is_mrope = cfg.mrope_sections is not None
+    if is_mrope:
+        from helix_tpu.models.qwen2_vl import text_forward_mrope
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def decode_fn(
         params, cache, last_token, positions, page_tables, active,
-        sampling, key,
+        sampling, key, mrope_delta,
     ):
         tokens = last_token[:, None]                      # [B, 1]
         pos2d = positions[:, None]                        # [B, 1]
@@ -168,11 +244,25 @@ def _build_decode_fn(model_cfg: ModelConfig, page_size: int, backend):
             )
             return out[:, None]
 
-        logits, (k_new, v_new) = forward(
-            params, cfg, tokens, pos2d,
-            attn_fn=attn_fn,
-            layer_caches=(cache.k_pages, cache.v_pages),
-        )
+        if is_mrope:
+            # past the prompt, all three streams advance together at a
+            # per-request constant offset from the sequence index
+            pos3 = jnp.broadcast_to(
+                (positions + mrope_delta)[None, :, None], (3,) + pos2d.shape
+            )
+            logits, (k_new, v_new) = text_forward_mrope(
+                params, cfg, tokens, pos3,
+                attn_fn=attn_fn,
+                layer_caches=(cache.k_pages, cache.v_pages),
+                mrope_sections=cfg.mrope_sections,
+                seq_positions=pos2d,
+            )
+        else:
+            logits, (k_new, v_new) = forward(
+                params, cfg, tokens, pos2d,
+                attn_fn=attn_fn,
+                layer_caches=(cache.k_pages, cache.v_pages),
+            )
         pages, offsets = slot_to_page_offset(pos2d, page_tables, page_size)
         cache = write_kv(
             cache, k_new, v_new, pages, offsets, active[:, None] > 0
@@ -210,6 +300,7 @@ class Engine:
         # host mirrors of device-visible per-slot state
         self._last_token = np.zeros((B,), np.int32)
         self._positions = np.zeros((B,), np.int32)
+        self._mrope_delta = np.zeros((B,), np.int32)
         self._page_tables = np.zeros(
             (B, self.cache_cfg.max_pages_per_seq), np.int32
         )
@@ -306,6 +397,7 @@ class Engine:
             first_token = self._prefill(req, table)
             req.first_token_time = time.monotonic()
             self._positions[slot] = plen
+            self._mrope_delta[slot] = req.mrope_delta
             self._last_token[slot] = first_token
             self._sampling_dirty = True
             self._emit(req, int(first_token), emitted)
@@ -317,23 +409,59 @@ class Engine:
             self.cache_cfg.page_size,
             self.cfg.max_prefill_len,
         )
-        fn = self._get_prefill_fn(bucket)
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :plen] = req.prompt_tokens
         length = np.int32(plen)
         self._key, sub = jax.random.split(self._key)
         sampling = SamplingState.from_params([req.sampling])
-        self.cache, token = fn(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens),
-            jnp.asarray(page_table)[None],
-            jnp.asarray(length),
-            sampling,
-            sub,
-        )
+        if self.model_cfg.mrope_sections is not None:
+            embeds = self._splice_embeds(req, tokens, bucket)
+            pos3 = np.zeros((3, 1, bucket), np.int32)
+            if req.positions3 is not None:
+                pos3[:, 0, :plen] = np.asarray(req.positions3)[:, :plen]
+            else:
+                pos3[:, 0, :plen] = np.arange(plen)[None]
+            fn = _build_prefill_fn_mrope(
+                self.model_cfg, self.cache_cfg.page_size, self._backend
+            )
+            self.cache, token = fn(
+                self.params, self.cache, jnp.asarray(tokens), embeds,
+                jnp.asarray(pos3), jnp.asarray(page_table)[None],
+                jnp.asarray(length), sampling, sub,
+            )
+        else:
+            fn = self._get_prefill_fn(bucket)
+            self.cache, token = fn(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.asarray(page_table)[None],
+                jnp.asarray(length),
+                sampling,
+                sub,
+            )
         self.num_prefill_tokens += plen
         return int(token[0])
+
+    def _splice_embeds(self, req: Request, tokens: np.ndarray, bucket: int):
+        """Embed-lookup the prompt and splice image embeddings in (bucketed
+        on the image-token count so VL prefill compiles a handful of shapes)."""
+        splice = _build_embed_splice_fn(self.model_cfg)
+        E = self.model_cfg.hidden_size
+        if req.image_embeds is None:
+            img = jnp.zeros((1, E), jnp.dtype(self.model_cfg.dtype))
+            pos = jnp.full((1,), bucket + 1, jnp.int32)
+            n = jnp.int32(0)
+        else:
+            n_img = req.image_embeds.shape[0]
+            nb = _bucket(max(n_img, 1), 16, 1 << 16)
+            img = jnp.zeros((nb, E), jnp.dtype(self.model_cfg.dtype))
+            img = img.at[:n_img].set(jnp.asarray(req.image_embeds))
+            posn = np.full((nb,), bucket + 1, np.int32)
+            posn[:n_img] = req.image_positions
+            pos = jnp.asarray(posn)
+            n = jnp.int32(n_img)
+        return splice(self.params, jnp.asarray(tokens), img, pos, n)
 
     def _get_prefill_fn(self, bucket: int):
         return _build_prefill_fn(
@@ -367,6 +495,7 @@ class Engine:
             jnp.asarray(active),
             self._sampling_state,
             sub,
+            jnp.asarray(self._mrope_delta),
         )
         next_np = np.asarray(next_tokens)
         emitted: list[tuple[Request, int]] = []

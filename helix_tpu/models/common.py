@@ -37,6 +37,8 @@ class ModelConfig:
     norm_offset: float = 0.0            # 1.0 for Gemma-style (1+w) RMSNorm
     max_position_embeddings: int = 8192
     dtype: str = "bfloat16"
+    # multimodal rope sections (t, h, w) — set => Qwen2-VL-family text tower
+    mrope_sections: Optional[tuple] = None
     # --- non-architectural serving metadata ---
     name: str = "unnamed"
 
@@ -51,7 +53,17 @@ class ModelConfig:
         hidden = hf["hidden_size"]
         heads = hf["num_attention_heads"]
         model_type = hf.get("model_type", "llama")
+        rs = hf.get("rope_scaling") or {}
+        mrope = (
+            tuple(rs["mrope_section"]) if "mrope_section" in rs else None
+        )
+        # mrope is not a frequency scaling; store real scalings as a sorted
+        # tuple so the config stays hashable
+        rope_scaling = None
+        if rs and mrope is None:
+            rope_scaling = tuple(sorted(rs.items()))
         return cls(
+            mrope_sections=mrope,
             vocab_size=hf["vocab_size"],
             hidden_size=hidden,
             num_layers=hf["num_hidden_layers"],
@@ -60,7 +72,7 @@ class ModelConfig:
             head_dim=hf.get("head_dim") or hidden // heads,
             intermediate_size=hf["intermediate_size"],
             rope_theta=hf.get("rope_theta", 10000.0),
-            rope_scaling=hf.get("rope_scaling"),
+            rope_scaling=rope_scaling,
             rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
             tie_word_embeddings=hf.get("tie_word_embeddings", False),
             hidden_act=hf.get("hidden_act", "silu"),

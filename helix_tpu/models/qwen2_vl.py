@@ -1,0 +1,510 @@
+"""Qwen2-VL: vision tower + M-RoPE decoder for the vision-RAG config.
+
+BASELINE.md config 4 ("Qwen2-VL-7B vision-RAG agent session") — the
+reference serves it as a vLLM container; here both towers are owned JAX:
+
+- Vision tower: ViT over pre-extracted patch rows (the conv3d patch embed
+  becomes one matmul), 2D rotary embeddings split across the (h, w) halves
+  of each head, full bidirectional attention within each image (segment
+  masking between images), spatial merger MLP projecting merge^2 patch
+  groups into the LLM's hidden space.  Blocks run under ``lax.scan`` like
+  the decoder.
+- Text tower: the Qwen2 decoder with **M-RoPE** — rotary sections of the
+  head dim driven by (temporal, height, width) position streams; text
+  tokens advance all three together, image spans fan out over h/w
+  (``mrope_positions`` mirrors HF's ``get_rope_index``).
+- The merged sequence (text embeddings with image embeddings spliced at
+  image-token placeholders) enters the SAME engine prefill/decode as pure
+  text — multimodality is an embedding-level concern, invisible to the
+  paged cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.ops.norms import layer_norm
+from helix_tpu.ops.quant import maybe_dequant_dense as _dense
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    depth: int = 32
+    embed_dim: int = 1280
+    hidden_size: int = 3584          # LLM hidden (merger output)
+    num_heads: int = 16
+    mlp_ratio: int = 4
+    in_channels: int = 3
+    patch_size: int = 14
+    spatial_merge_size: int = 2
+    temporal_patch_size: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return self.in_channels * self.temporal_patch_size * self.patch_size**2
+
+    @classmethod
+    def from_hf(cls, d: dict) -> "VisionConfig":
+        return cls(
+            depth=d["depth"],
+            embed_dim=d["embed_dim"],
+            hidden_size=d["hidden_size"],
+            num_heads=d["num_heads"],
+            mlp_ratio=d["mlp_ratio"],
+            in_channels=d.get("in_channels", 3),
+            patch_size=d["patch_size"],
+            spatial_merge_size=d["spatial_merge_size"],
+            temporal_patch_size=d["temporal_patch_size"],
+        )
+
+    @classmethod
+    def tiny(cls, **o) -> "VisionConfig":
+        base = dict(
+            depth=2, embed_dim=32, hidden_size=64, num_heads=2, mlp_ratio=2,
+            patch_size=4, spatial_merge_size=2, temporal_patch_size=2,
+        )
+        base.update(o)
+        return cls(**base)
+
+
+# ---------------------------------------------------------------------------
+# vision tower
+# ---------------------------------------------------------------------------
+
+
+def vision_rotary_pos(grid_thw: np.ndarray, merge: int) -> np.ndarray:
+    """Per-patch (h, w) rotary ids in the processor's merge-block patch
+    order. grid_thw: [n_images, 3] (t, h, w in patch units)."""
+    out = []
+    for t, h, w in np.asarray(grid_thw):
+        hpos = np.arange(h)[:, None].repeat(w, axis=1)
+        wpos = np.arange(w)[None, :].repeat(h, axis=0)
+
+        def blockify(x):
+            return (
+                x.reshape(h // merge, merge, w // merge, merge)
+                .transpose(0, 2, 1, 3)
+                .reshape(-1)
+            )
+
+        hw = np.stack([blockify(hpos), blockify(wpos)], axis=-1)  # [h*w, 2]
+        out.append(np.tile(hw, (int(t), 1)))
+    return np.concatenate(out, axis=0)  # [N, 2]
+
+
+def _vision_rope(q, k, pos_hw, head_dim):
+    """Rotate q/k with 2D rope: first half of rotary dims from h, second
+    from w (HF Qwen2-VL convention: freqs for h and w concatenated)."""
+    half = head_dim // 2   # rotary dims (rotate_half over full head_dim)
+    quarter = half // 2
+    inv = 1.0 / (10000.0 ** (np.arange(0, quarter) * 2.0 / half))
+    inv = jnp.asarray(inv, jnp.float32)
+    h_angles = pos_hw[:, 0:1].astype(jnp.float32) * inv[None]  # [N, q]
+    w_angles = pos_hw[:, 1:2].astype(jnp.float32) * inv[None]
+    angles = jnp.concatenate([h_angles, w_angles], axis=-1)     # [N, half]
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+
+    def rot(x):
+        x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+        return jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def init_vision_params(cfg: VisionConfig, key, dtype=jnp.float32) -> dict:
+    E, F, D = cfg.embed_dim, cfg.embed_dim * cfg.mlp_ratio, cfg.head_dim
+    Lv = cfg.depth
+    m2 = cfg.spatial_merge_size**2
+    ks = jax.random.split(key, 8)
+
+    def w(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dtype)
+
+    return {
+        "patch_embed": {"weight": w(ks[0], (cfg.patch_dim, E))},
+        "blocks": {
+            "norm1": {"weight": jnp.ones((Lv, E), dtype),
+                      "bias": jnp.zeros((Lv, E), dtype)},
+            "norm2": {"weight": jnp.ones((Lv, E), dtype),
+                      "bias": jnp.zeros((Lv, E), dtype)},
+            "qkv": {"weight": w(ks[1], (Lv, E, 3 * E)),
+                    "bias": jnp.zeros((Lv, 3 * E), dtype)},
+            "proj": {"weight": w(ks[2], (Lv, E, E)),
+                     "bias": jnp.zeros((Lv, E), dtype)},
+            "fc1": {"weight": w(ks[3], (Lv, E, F)),
+                    "bias": jnp.zeros((Lv, F), dtype)},
+            "fc2": {"weight": w(ks[4], (Lv, F, E)),
+                    "bias": jnp.zeros((Lv, E), dtype)},
+        },
+        "merger": {
+            "ln_q": {"weight": jnp.ones((E,), dtype),
+                     "bias": jnp.zeros((E,), dtype)},
+            "mlp0": {"weight": w(ks[5], (E * m2, E * m2)),
+                     "bias": jnp.zeros((E * m2,), dtype)},
+            "mlp2": {"weight": w(ks[6], (E * m2, cfg.hidden_size)),
+                     "bias": jnp.zeros((cfg.hidden_size,), dtype)},
+        },
+    }
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def vision_forward(
+    params: dict,
+    cfg: VisionConfig,
+    patches,        # [N, patch_dim] pre-extracted patch rows
+    grid_thw,       # [n_images, 3] numpy (static — drives masks/positions)
+):
+    """-> [N / merge^2, hidden_size] image embeddings."""
+    grid = np.asarray(grid_thw)
+    N = patches.shape[0]
+    E, H, D = cfg.embed_dim, cfg.num_heads, cfg.head_dim
+
+    x = _dense(patches, params["patch_embed"])
+    pos_hw = jnp.asarray(vision_rotary_pos(grid, cfg.spatial_merge_size))
+
+    # segment id per patch (attention stays within an image)
+    sizes = [int(t * h * w) for t, h, w in grid]
+    seg = jnp.asarray(np.repeat(np.arange(len(sizes)), sizes))
+    attn_bias = jnp.where(
+        seg[:, None] == seg[None, :], 0.0, -1e9
+    )[None]  # [1, N, N]
+
+    def block(x, bp):
+        y = layer_norm(x, bp["norm1"]["weight"], bp["norm1"]["bias"])
+        qkv = _dense(y, bp["qkv"]).reshape(N, 3, H, D)
+        q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        q, k = _vision_rope(q, k, pos_hw, D)
+        s = jnp.einsum(
+            "qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / np.sqrt(D)
+        p = jax.nn.softmax(s + attn_bias, axis=-1)
+        ctx = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+        x = x + _dense(ctx.reshape(N, E).astype(x.dtype), bp["proj"])
+        y = layer_norm(x, bp["norm2"]["weight"], bp["norm2"]["bias"])
+        x = x + _dense(_quick_gelu(_dense(y, bp["fc1"])), bp["fc2"])
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+
+    m = params["merger"]
+    x = layer_norm(x, m["ln_q"]["weight"], m["ln_q"]["bias"])
+    m2 = cfg.spatial_merge_size**2
+    x = x.reshape(N // m2, E * m2)
+    x = _dense(jax.nn.gelu(_dense(x, m["mlp0"]), approximate=False), m["mlp2"])
+    return x
+
+
+# ---------------------------------------------------------------------------
+# M-RoPE for the text tower
+# ---------------------------------------------------------------------------
+
+
+def apply_mrope(x, positions3, inv_freq, sections: Sequence[int]):
+    """Rotate q or k with multimodal rope.
+
+    x: [B, S, H, D]; positions3: [3, B, S] (t, h, w streams);
+    sections: split of the D/2 frequency dims across the 3 streams
+    (e.g. [16, 24, 24] for D=128)."""
+    ang = (
+        positions3[..., None].astype(jnp.float32) * inv_freq
+    )  # [3, B, S, D/2]
+    ang = jnp.concatenate([ang, ang], axis=-1)  # [3, B, S, D]
+    # HF convention (apply_multimodal_rotary_pos_emb): ``mrope_section * 2``
+    # is LIST repetition — the [t, h, w] section split applies to each half
+    # of the doubled dim symmetrically, so a rotation pair (j, j + D/2)
+    # always takes both cos and sin from the same stream.
+    idx_half = np.concatenate(
+        [np.full((s,), i) for i, s in enumerate(sections)]
+    )  # [D/2]
+    idx = np.concatenate([idx_half, idx_half])  # [D]
+    sel = jax.nn.one_hot(jnp.asarray(idx), 3, dtype=ang.dtype)  # [D, 3]
+    angles = jnp.einsum("cbsf,fc->bsf", ang, sel)  # [B, S, D]
+    cos = jnp.cos(angles)[..., None, :]
+    sin = jnp.sin(angles)[..., None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
+    rot_half = jnp.concatenate([-x2, x1], axis=-1)
+    return (xf * cos + rot_half * sin).astype(x.dtype)
+
+
+def mrope_positions(
+    input_ids: Sequence[int],
+    grid_thw,
+    image_token_id: int,
+    merge: int = 2,
+    start: Sequence[int] = (0, 0, 0),
+) -> tuple:
+    """(positions3 [3, S], next_delta) for one sequence — HF's
+    ``get_rope_index`` reimplemented host-side.
+
+    Text tokens advance (t, h, w) together; each image span (a run of
+    ``image_token_id``) gets t constant, h/w enumerating the merged grid;
+    after the span, all streams jump to max+1.  ``next_delta`` is the shared
+    scalar offset for decode continuation (position - token_index)."""
+    ids = list(input_ids)
+    grid = np.asarray(grid_thw) if grid_thw is not None else np.zeros((0, 3))
+    S = len(ids)
+    pos = np.zeros((3, S), np.int64)
+    cur = list(start)
+    img = 0
+    i = 0
+    while i < S:
+        if ids[i] == image_token_id and img < len(grid):
+            t, h, w = (int(v) for v in grid[img])
+            hh, ww = h // merge, w // merge
+            n = t * hh * ww
+            tpos = np.repeat(np.arange(t), hh * ww)
+            hpos = np.tile(np.repeat(np.arange(hh), ww), t)
+            wpos = np.tile(np.tile(np.arange(ww), hh), t)
+            base = cur[0]
+            pos[0, i : i + n] = base + tpos
+            pos[1, i : i + n] = base + hpos
+            pos[2, i : i + n] = base + wpos
+            nxt = base + max(t, hh, ww)
+            cur = [nxt, nxt, nxt]
+            img += 1
+            i += n
+        else:
+            pos[:, i] = cur
+            cur = [c + 1 for c in cur]
+            i += 1
+    delta = int(cur[0]) - S
+    return pos, delta
+
+
+def text_forward_mrope(
+    params, cfg: ModelConfig, tokens, positions3, *, attn_fn,
+    layer_caches=None, input_embeds=None, mrope_sections=(16, 24, 24),
+    seq_positions=None,
+):
+    """Qwen2-VL text tower: llama forward with M-RoPE rotation and optional
+    pre-computed input embeddings (image splice)."""
+    from helix_tpu.models.llama import _layer
+    from helix_tpu.ops.norms import rms_norm
+    from helix_tpu.ops.quant import embed_lookup
+    from helix_tpu.ops.rope import rope_frequencies
+
+    inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, cfg.rope_theta))
+    if input_embeds is None:
+        h = embed_lookup(params["embed"], tokens, jnp.dtype(cfg.dtype))
+    else:
+        h = input_embeds.astype(jnp.dtype(cfg.dtype))
+
+    from helix_tpu.models.llama import _act
+
+    B, S = h.shape[0], h.shape[1]
+    if seq_positions is None:
+        seq_positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
+
+    def scan_body(h, xs):
+        layer_params, layer_cache = xs
+        B, S, E = h.shape
+        H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        p = layer_params
+        x = rms_norm(h, p["attn_norm"]["weight"], cfg.rms_norm_eps)
+        q = _dense(x, p["wq"]).reshape(B, S, H, D)
+        k = _dense(x, p["wk"]).reshape(B, S, KVH, D)
+        v = _dense(x, p["wv"]).reshape(B, S, KVH, D)
+        q = apply_mrope(q, positions3, inv_freq, mrope_sections)
+        k = apply_mrope(k, positions3, inv_freq, mrope_sections)
+        # causal masking is by SEQUENCE index, not the mrope t-stream —
+        # image-span tokens share t but still attend causally (HF parity)
+        attn_out = attn_fn(q, k, v, layer_cache, seq_positions)
+        h = h + _dense(attn_out.reshape(B, S, H * D), p["wo"])
+        x = rms_norm(h, p["mlp_norm"]["weight"], cfg.rms_norm_eps)
+        act = _act(cfg.hidden_act)
+        h = h + _dense(act(_dense(x, p["w_gate"])) * _dense(x, p["w_up"]),
+                       p["w_down"])
+        return h, (k, v)
+
+    if layer_caches is None:
+        layer_caches = jnp.zeros((cfg.num_layers, 0), jnp.int32)
+    h, kv = jax.lax.scan(scan_body, h, (params["layers"], layer_caches))
+    h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
+    w_out = (
+        params["embed"]["weight"].T
+        if cfg.tie_word_embeddings
+        else params["lm_head"]["weight"]
+    )
+    if w_out.dtype == jnp.int8:
+        w_out = w_out.astype(h.dtype)
+    logits = jax.lax.dot_general(
+        h, w_out, (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if cfg.tie_word_embeddings and "embed_scale" in params["embed"]:
+        logits = logits * params["embed"]["embed_scale"][:, 0][None, None, :]
+    elif not cfg.tie_word_embeddings and "scale" in params.get("lm_head", {}):
+        logits = logits * params["lm_head"]["scale"].reshape(-1)[None, None, :]
+    return logits, kv
+
+
+# ---------------------------------------------------------------------------
+# loader
+# ---------------------------------------------------------------------------
+
+
+def load_qwen2_vl(model_dir: str):
+    """(text_cfg, vision_cfg, params) from an HF Qwen2-VL checkpoint.
+    Weight names per ``transformers`` Qwen2VLForConditionalGeneration
+    (model.visual.* / model.language_model.*)."""
+    import json
+    import os
+
+    from helix_tpu.models.loader import _open_shards
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf = json.load(f)
+    tcfg = ModelConfig.from_hf_config(
+        {**hf, "model_type": "qwen2"}, name=os.path.basename(model_dir)
+    )
+    vcfg = VisionConfig.from_hf(hf["vision_config"])
+    sh = _open_shards(model_dir)
+
+    def g(name):
+        # HF has serialised Qwen2-VL both as model.<name> and
+        # model.language_model.<name> across versions
+        for pfx in ("model.", "", "model.language_model.", "language_model."):
+            n = pfx + name
+            if n in sh:
+                return sh.get(n)
+        raise KeyError(name)
+
+    def lin(name):
+        return np.ascontiguousarray(g(name + ".weight").T), g(name + ".bias")
+
+    Lv = vcfg.depth
+
+    def vstack(fn):
+        return np.stack([fn(i) for i in range(Lv)])
+
+    vb = "visual.blocks.{}."
+    vision = {
+        "patch_embed": {
+            "weight": np.ascontiguousarray(
+                g("visual.patch_embed.proj.weight")
+                .reshape(vcfg.embed_dim, -1)
+                .T
+            )
+        },
+        "blocks": {
+            "norm1": {
+                "weight": vstack(lambda i: g(vb.format(i) + "norm1.weight")),
+                "bias": vstack(lambda i: g(vb.format(i) + "norm1.bias")),
+            },
+            "norm2": {
+                "weight": vstack(lambda i: g(vb.format(i) + "norm2.weight")),
+                "bias": vstack(lambda i: g(vb.format(i) + "norm2.bias")),
+            },
+            "qkv": {
+                "weight": vstack(lambda i: lin(vb.format(i) + "attn.qkv")[0]),
+                "bias": vstack(lambda i: lin(vb.format(i) + "attn.qkv")[1]),
+            },
+            "proj": {
+                "weight": vstack(lambda i: lin(vb.format(i) + "attn.proj")[0]),
+                "bias": vstack(lambda i: lin(vb.format(i) + "attn.proj")[1]),
+            },
+            "fc1": {
+                "weight": vstack(lambda i: lin(vb.format(i) + "mlp.fc1")[0]),
+                "bias": vstack(lambda i: lin(vb.format(i) + "mlp.fc1")[1]),
+            },
+            "fc2": {
+                "weight": vstack(lambda i: lin(vb.format(i) + "mlp.fc2")[0]),
+                "bias": vstack(lambda i: lin(vb.format(i) + "mlp.fc2")[1]),
+            },
+        },
+        "merger": {
+            "ln_q": {
+                "weight": g("visual.merger.ln_q.weight"),
+                "bias": g("visual.merger.ln_q.bias"),
+            },
+            "mlp0": {
+                "weight": np.ascontiguousarray(
+                    g("visual.merger.mlp.0.weight").T
+                ),
+                "bias": g("visual.merger.mlp.0.bias"),
+            },
+            "mlp2": {
+                "weight": np.ascontiguousarray(
+                    g("visual.merger.mlp.2.weight").T
+                ),
+                "bias": g("visual.merger.mlp.2.bias"),
+            },
+        },
+    }
+
+    # text tower reuses the llama loader against the language_model prefix
+    # by temporarily aliasing names
+    L = tcfg.num_layers
+    lm = "layers.{}."
+
+    def tstack(fn):
+        return np.stack([fn(i) for i in range(L)])
+
+    def tlin(i, n):
+        return np.ascontiguousarray(g(lm.format(i) + n + ".weight").T)
+
+    layers = {
+        "attn_norm": {
+            "weight": tstack(lambda i: g(lm.format(i) + "input_layernorm.weight"))
+        },
+        "mlp_norm": {
+            "weight": tstack(
+                lambda i: g(lm.format(i) + "post_attention_layernorm.weight")
+            )
+        },
+        "wq": {
+            "weight": tstack(lambda i: tlin(i, "self_attn.q_proj")),
+            "bias": tstack(lambda i: g(lm.format(i) + "self_attn.q_proj.bias")),
+        },
+        "wk": {
+            "weight": tstack(lambda i: tlin(i, "self_attn.k_proj")),
+            "bias": tstack(lambda i: g(lm.format(i) + "self_attn.k_proj.bias")),
+        },
+        "wv": {
+            "weight": tstack(lambda i: tlin(i, "self_attn.v_proj")),
+            "bias": tstack(lambda i: g(lm.format(i) + "self_attn.v_proj.bias")),
+        },
+        "wo": {"weight": tstack(lambda i: tlin(i, "self_attn.o_proj"))},
+        "w_gate": {"weight": tstack(lambda i: tlin(i, "mlp.gate_proj"))},
+        "w_up": {"weight": tstack(lambda i: tlin(i, "mlp.up_proj"))},
+        "w_down": {"weight": tstack(lambda i: tlin(i, "mlp.down_proj"))},
+    }
+    params = {
+        "embed": {"weight": g("embed_tokens.weight")},
+        "layers": layers,
+        "final_norm": {"weight": g("norm.weight")},
+        "visual": jax.tree.map(jnp.asarray, vision),
+    }
+    if not tcfg.tie_word_embeddings:
+        try:
+            params["lm_head"] = {
+                "weight": np.ascontiguousarray(g("lm_head.weight").T)
+            }
+        except KeyError:
+            params["lm_head"] = {
+                "weight": np.ascontiguousarray(params["embed"]["weight"].T)
+            }
+    text = {k: v for k, v in params.items() if k != "visual"}
+    text = jax.tree.map(jnp.asarray, text)
+    text["visual"] = params["visual"]
+    import dataclasses as _dc
+
+    hf_dtype = hf.get("torch_dtype") or hf.get("dtype") or "float32"
+    tcfg = _dc.replace(tcfg, attention_bias=True, dtype=str(hf_dtype))
+    return tcfg, vcfg, text

@@ -26,6 +26,8 @@ def rope_frequencies(
     inv_freq = 1.0 / (
         theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim)
     )
+    if scaling is not None and not isinstance(scaling, dict):
+        scaling = dict(scaling)   # configs store it as a sorted item tuple
     if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
         factor = scaling["factor"]
         low = scaling["low_freq_factor"]

@@ -142,9 +142,9 @@ class OpenAIServer:
             seed=body.get("seed"),
         )
 
-    async def _generate(self, served, prompt_ids, sampling):
+    async def _generate(self, served, prompt_ids, sampling, extra=None):
         """Submit to the engine; yields (delta_text, token_id, finished,
-        finish_reason)."""
+        finish_reason).  ``extra`` carries multimodal Request fields."""
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
@@ -156,6 +156,7 @@ class OpenAIServer:
             prompt_tokens=list(prompt_ids),
             sampling=sampling,
             stop_token_ids=tuple(served.tokenizer.eos_ids),
+            **(extra or {}),
         )
         served.loop.submit(req, on_event)
         detok = IncrementalDetokenizer(served.tokenizer)
@@ -203,9 +204,31 @@ class OpenAIServer:
         if not messages:
             return _error(400, "'messages' is required")
         sampling = self._sampling_from_body(body)
-        prompt_ids = served.tokenizer.apply_chat_template(
-            messages, add_generation_prompt=True
+        has_images = any(
+            isinstance(m.get("content"), list)
+            and any(
+                p.get("type") in ("image_url", "image")
+                for p in m["content"]
+            )
+            for m in messages
         )
+        extra = None
+        if has_images:
+            if served.vision is None:
+                return _error(
+                    400, f"model '{model}' does not accept image input"
+                )
+            try:
+                extra = await asyncio.get_running_loop().run_in_executor(
+                    None, served.vision.prepare, messages, served.tokenizer
+                )
+            except Exception as e:  # noqa: BLE001 — bad image data etc.
+                return _error(400, f"image processing failed: {e}")
+            prompt_ids = extra.pop("prompt_tokens")
+        else:
+            prompt_ids = served.tokenizer.apply_chat_template(
+                messages, add_generation_prompt=True
+            )
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         created = _now()
 
@@ -225,7 +248,7 @@ class OpenAIServer:
             finish_reason = None
             ntokens = 0
             async for delta, tok, finished, reason in self._generate(
-                served, prompt_ids, sampling
+                served, prompt_ids, sampling, extra
             ):
                 ntokens += 1
                 chunk_delta = {}
@@ -260,7 +283,7 @@ class OpenAIServer:
         finish_reason = "stop"
         ntokens = 0
         async for delta, tok, finished, reason in self._generate(
-            served, prompt_ids, sampling
+            served, prompt_ids, sampling, extra
         ):
             text_parts.append(delta)
             ntokens += 1

@@ -26,6 +26,7 @@ class ServedModel:
     owned_by: str = "helix-tpu"
     context_length: Optional[int] = None
     embedder: object = None      # EmbeddingRunner for kind == "embedding"
+    vision: object = None        # VisionRunner for kind == "vision"
 
 
 class ModelRegistry:

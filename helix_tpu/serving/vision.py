@@ -1,0 +1,230 @@
+"""Image preprocessing for the vision serving path.
+
+The host-side half of Qwen2-VL serving: decode ``image_url`` content parts
+(base64 data URLs or raw bytes), smart-resize to patch-grid multiples,
+normalise, and extract patch rows in the merge-block order the vision tower
+and its rotary ids expect (mirrors HF's Qwen2VLImageProcessor numerics so
+checkpoints behave identically).  The reference feeds images to vLLM's own
+processor inside the container; here it is the serving layer's job.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import io
+import math
+from typing import Optional
+
+import numpy as np
+
+# OpenAI-CLIP normalisation constants (Qwen2-VL's image_mean/image_std)
+IMAGE_MEAN = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+IMAGE_STD = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+
+
+def smart_resize(
+    height: int,
+    width: int,
+    factor: int = 28,
+    min_pixels: int = 56 * 56,
+    max_pixels: int = 14 * 14 * 4 * 1280,
+) -> tuple:
+    """Target (h, w): multiples of ``factor`` with area in bounds, aspect
+    ratio approximately preserved (HF qwen2_vl smart_resize)."""
+    if max(height, width) / min(height, width) > 200:
+        raise ValueError("absurd aspect ratio")
+    h_bar = max(factor, round(height / factor) * factor)
+    w_bar = max(factor, round(width / factor) * factor)
+    if h_bar * w_bar > max_pixels:
+        beta = math.sqrt((height * width) / max_pixels)
+        h_bar = math.floor(height / beta / factor) * factor
+        w_bar = math.floor(width / beta / factor) * factor
+    elif h_bar * w_bar < min_pixels:
+        beta = math.sqrt(min_pixels / (height * width))
+        h_bar = math.ceil(height * beta / factor) * factor
+        w_bar = math.ceil(width * beta / factor) * factor
+    return int(h_bar), int(w_bar)
+
+
+def decode_image(source) -> np.ndarray:
+    """data URL / base64 string / raw bytes -> RGB uint8 [H, W, 3]."""
+    from PIL import Image
+
+    if isinstance(source, str):
+        if source.startswith("data:"):
+            _, b64 = source.split(",", 1)
+            raw = base64.b64decode(b64)
+        else:
+            raw = base64.b64decode(source)
+    else:
+        raw = bytes(source)
+    img = Image.open(io.BytesIO(raw)).convert("RGB")
+    return np.asarray(img)
+
+
+def patchify(
+    image: np.ndarray,           # [H, W, 3] uint8/float
+    patch_size: int = 14,
+    merge_size: int = 2,
+    temporal_patch_size: int = 2,
+    min_pixels: int = 56 * 56,
+    max_pixels: int = 14 * 14 * 4 * 1280,
+) -> tuple:
+    """-> (patches [N, C*Tp*P*P], grid (1, h, w)) in the processor's
+    merge-block order (temporal dim filled by frame repetition for stills,
+    as HF does)."""
+    from PIL import Image
+
+    H, W = image.shape[:2]
+    factor = patch_size * merge_size
+    h2, w2 = smart_resize(H, W, factor, min_pixels, max_pixels)
+    img = Image.fromarray(image.astype(np.uint8)).resize(
+        (w2, h2), Image.BICUBIC
+    )
+    x = np.asarray(img, np.float32) / 255.0
+    x = (x - IMAGE_MEAN) / IMAGE_STD
+    x = x.transpose(2, 0, 1)                        # [C, H, W]
+    x = np.tile(x[None], (temporal_patch_size, 1, 1, 1))  # [Tp, C, H, W]
+
+    C = x.shape[1]
+    gh, gw = h2 // patch_size, w2 // patch_size
+    m = merge_size
+    P = patch_size
+    # [grid_t=1, Tp, C, gh/m, m, P, gw/m, m, P]
+    x = x.reshape(1, temporal_patch_size, C, gh // m, m, P, gw // m, m, P)
+    x = x.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
+    patches = x.reshape(gh * gw, C * temporal_patch_size * P * P)
+    return patches.astype(np.float32), (1, gh, gw)
+
+
+class VisionRunner:
+    """Bundles the vision tower + special-token ids; turns chat messages
+    with image parts into the engine's multimodal Request fields."""
+
+    def __init__(
+        self,
+        vcfg,
+        vparams,
+        *,
+        image_pad_id: int,
+        vision_start_id: Optional[int] = None,
+        vision_end_id: Optional[int] = None,
+        max_pixels: int = 14 * 14 * 4 * 1280,
+    ):
+        self.vcfg = vcfg
+        self.vparams = vparams
+        self.image_pad_id = image_pad_id
+        self.vision_start_id = vision_start_id
+        self.vision_end_id = vision_end_id
+        self.max_pixels = max_pixels
+
+    def prepare(self, messages: list, tokenizer) -> dict:
+        """-> kwargs for ``engine.Request`` (prompt_tokens + multimodal)."""
+        import jax.numpy as jnp
+
+        from helix_tpu.models.qwen2_vl import mrope_positions, vision_forward
+
+        p = build_vl_prompt(
+            messages,
+            tokenizer,
+            image_pad_id=self.image_pad_id,
+            vision_start_id=self.vision_start_id,
+            vision_end_id=self.vision_end_id,
+            merge_size=self.vcfg.spatial_merge_size,
+            patch_size=self.vcfg.patch_size,
+            temporal_patch_size=self.vcfg.temporal_patch_size,
+            max_pixels=self.max_pixels,
+        )
+        image_embeds = None
+        if len(p.image_patches):
+            patches = np.concatenate(p.image_patches, axis=0)
+            image_embeds = vision_forward(
+                self.vparams, self.vcfg, jnp.asarray(patches), p.grid_thw
+            )
+        pos3, delta = mrope_positions(
+            p.input_ids,
+            p.grid_thw if len(p.grid_thw) else None,
+            self.image_pad_id,
+            merge=self.vcfg.spatial_merge_size,
+        )
+        return dict(
+            prompt_tokens=p.input_ids,
+            image_embeds=image_embeds,
+            image_positions=p.image_positions,
+            positions3=pos3,
+            mrope_delta=delta,
+        )
+
+
+@dataclasses.dataclass
+class VLPrompt:
+    input_ids: list
+    image_patches: list      # list of np arrays per image
+    grid_thw: np.ndarray     # [n_images, 3]
+    image_positions: list    # indices of image-pad tokens
+
+
+def build_vl_prompt(
+    messages: list,
+    tokenizer,
+    *,
+    image_pad_id: int,
+    vision_start_id: Optional[int] = None,
+    vision_end_id: Optional[int] = None,
+    merge_size: int = 2,
+    patch_size: int = 14,
+    temporal_patch_size: int = 2,
+    max_pixels: int = 14 * 14 * 4 * 1280,
+) -> VLPrompt:
+    """Chat messages (OpenAI content-parts format) -> token ids with image
+    spans expanded to the right number of pad tokens, plus per-image patch
+    tensors."""
+    ids: list = []
+    patches_all: list = []
+    grids: list = []
+    img_pos: list = []
+
+    def add_image(source):
+        patches, (t, gh, gw) = patchify(
+            decode_image(source),
+            patch_size=patch_size,
+            merge_size=merge_size,
+            temporal_patch_size=temporal_patch_size,
+            max_pixels=max_pixels,
+        )
+        n_tokens = t * (gh // merge_size) * (gw // merge_size)
+        if vision_start_id is not None:
+            ids.append(vision_start_id)
+        img_pos.extend(range(len(ids), len(ids) + n_tokens))
+        ids.extend([image_pad_id] * n_tokens)
+        if vision_end_id is not None:
+            ids.append(vision_end_id)
+        patches_all.append(patches)
+        grids.append((t, gh, gw))
+
+    for msg in messages:
+        content = msg.get("content", "")
+        ids.extend(tokenizer.encode(f"{msg['role']}: "))
+        if isinstance(content, str):
+            ids.extend(tokenizer.encode(content))
+        else:
+            for part in content:
+                ptype = part.get("type")
+                if ptype == "text":
+                    ids.extend(tokenizer.encode(part.get("text", "")))
+                elif ptype in ("image_url", "image"):
+                    url = (
+                        part.get("image_url", {}).get("url")
+                        if ptype == "image_url"
+                        else part.get("image")
+                    )
+                    add_image(url)
+        ids.extend(tokenizer.encode("\n"))
+    ids.extend(tokenizer.encode("assistant: "))
+    return VLPrompt(
+        input_ids=ids,
+        image_patches=patches_all,
+        grid_thw=np.asarray(grids) if grids else np.zeros((0, 3), np.int64),
+        image_positions=img_pos,
+    )

@@ -1,0 +1,125 @@
+"""Engine-level Qwen2-VL tests: multimodal prefill + M-RoPE paged decode
+must match the full-forward oracle (and therefore HF, per test_qwen2_vl)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from helix_tpu.engine.engine import Engine, EngineConfig, Request
+from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import init_params, prefill_attn_fn
+from helix_tpu.models.qwen2_vl import (
+    VisionConfig,
+    init_vision_params,
+    mrope_positions,
+    text_forward_mrope,
+    vision_forward,
+)
+
+IMG = 126
+
+
+@pytest.fixture(scope="module")
+def vl_model():
+    cfg = ModelConfig.tiny(
+        dtype="float32", attention_bias=True, mrope_sections=(2, 3, 3),
+        vocab_size=128,
+    )
+    params = init_params(cfg, jax.random.PRNGKey(5), dtype=jnp.float32)
+    vcfg = VisionConfig.tiny(hidden_size=cfg.hidden_size)
+    vparams = init_vision_params(vcfg, jax.random.PRNGKey(6))
+    return cfg, params, vcfg, vparams
+
+
+def _oracle(cfg, params, ids, pos3, embeds, n_steps):
+    """Greedy via full forward over the growing sequence each step."""
+    toks = list(ids)
+    pos3 = np.asarray(pos3)
+    delta = int(pos3[0, -1]) + 1 - len(toks)
+    out = []
+    emb_w = params["embed"]["weight"]
+    cur_embeds = embeds
+    for _ in range(n_steps):
+        S = len(toks)
+        logits, _ = text_forward_mrope(
+            params, cfg, jnp.asarray([toks]), jnp.asarray(pos3)[:, None, :],
+            attn_fn=lambda q, k, v, c, p: prefill_attn_fn(
+                q, k, v, c, p, backend="reference"
+            ),
+            input_embeds=cur_embeds[None],
+            mrope_sections=cfg.mrope_sections,
+        )
+        nxt = int(jnp.argmax(logits[0, -1]))
+        out.append(nxt)
+        toks.append(nxt)
+        nxt_pos = S + delta
+        pos3 = np.concatenate(
+            [pos3, np.full((3, 1), nxt_pos, pos3.dtype)], axis=1
+        )
+        cur_embeds = jnp.concatenate([cur_embeds, emb_w[nxt][None]], axis=0)
+    return out
+
+
+class TestVLEngine:
+    def test_greedy_decode_parity_with_image(self, vl_model):
+        cfg, params, vcfg, vparams = vl_model
+        grid = np.array([[1, 4, 4]])
+        rng = np.random.RandomState(3)
+        patches = rng.randn(16, vcfg.patch_dim).astype(np.float32)
+        img_embeds = vision_forward(vparams, vcfg, jnp.asarray(patches), grid)
+        ids = [1, 2] + [IMG] * 4 + [3]
+        pos3, delta = mrope_positions(ids, grid, IMG)
+        img_positions = [i for i, t in enumerate(ids) if t == IMG]
+
+        eng = Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=2, page_size=4, num_pages=64,
+                max_pages_per_seq=16, max_prefill_len=64,
+                attn_backend="reference",
+            ),
+        )
+        req = Request(
+            id="vl", prompt_tokens=ids,
+            sampling=SamplingParams(temperature=0.0, max_tokens=6),
+            image_embeds=img_embeds,
+            image_positions=img_positions,
+            positions3=pos3,
+            mrope_delta=delta,
+        )
+        eng.add_request(req)
+        while eng.has_work():
+            eng.step()
+
+        emb = jnp.asarray(params["embed"]["weight"])[jnp.asarray(ids)]
+        emb = emb.at[jnp.asarray(img_positions)].set(img_embeds)
+        want = _oracle(cfg, params, ids, pos3, emb, 6)
+        assert req.output_tokens == want, (req.output_tokens, want)
+
+    def test_text_only_vl_engine(self, vl_model):
+        """A VL engine must still serve text-only prompts correctly."""
+        cfg, params, vcfg, vparams = vl_model
+        ids = [5, 6, 7, 8]
+        pos3, delta = mrope_positions(ids, None, IMG)
+        eng = Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=2, page_size=4, num_pages=64,
+                max_pages_per_seq=16, max_prefill_len=64,
+                attn_backend="reference",
+            ),
+        )
+        req = Request(
+            id="t", prompt_tokens=ids,
+            sampling=SamplingParams(temperature=0.0, max_tokens=5),
+            positions3=pos3, mrope_delta=delta,
+        )
+        eng.add_request(req)
+        while eng.has_work():
+            eng.step()
+        emb = jnp.asarray(params["embed"]["weight"])[jnp.asarray(ids)]
+        want = _oracle(cfg, params, ids, pos3, emb, 5)
+        assert req.output_tokens == want

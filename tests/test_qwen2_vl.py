@@ -1,0 +1,141 @@
+"""Qwen2-VL parity vs HF/torch: vision tower, M-RoPE positions, full
+text+image logits."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from helix_tpu.models.qwen2_vl import (
+    VisionConfig,
+    apply_mrope,
+    load_qwen2_vl,
+    mrope_positions,
+    text_forward_mrope,
+    vision_forward,
+    vision_rotary_pos,
+)
+
+IMG, VID, VSTART, VEND = 126, 127, 125, 124
+
+
+@pytest.fixture(scope="module")
+def hf_tiny(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2VLConfig, Qwen2VLForConditionalGeneration
+
+    vc = dict(
+        depth=2, embed_dim=32, hidden_size=64, num_heads=2, mlp_ratio=2,
+        in_channels=3, patch_size=4, spatial_merge_size=2,
+        temporal_patch_size=2,
+    )
+    c = Qwen2VLConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0, vision_config=vc,
+        rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+        image_token_id=IMG, video_token_id=VID,
+        vision_start_token_id=VSTART, vision_end_token_id=VEND,
+        tie_word_embeddings=False, torch_dtype="float32",
+    )
+    m = Qwen2VLForConditionalGeneration(c)
+    m.eval()
+    d = str(tmp_path_factory.mktemp("qwen2vl"))
+    m.save_pretrained(d, safe_serialization=True)
+    return m, d
+
+
+class TestVisionTower:
+    def test_vision_parity(self, hf_tiny):
+        import torch
+
+        m, d = hf_tiny
+        tcfg, vcfg, params = load_qwen2_vl(d)
+        grid = np.array([[1, 4, 4]])  # one image, 4x4 patches
+        N = int(grid.prod())
+        rng = np.random.RandomState(0)
+        patches = rng.randn(N, vcfg.patch_dim).astype(np.float32)
+        with torch.no_grad():
+            want = m.model.visual(
+                torch.from_numpy(patches), torch.from_numpy(grid)
+            ).numpy()
+        got = vision_forward(params["visual"], vcfg, jnp.asarray(patches), grid)
+        np.testing.assert_allclose(np.asarray(got), want, atol=5e-4)
+
+    def test_vision_two_images_isolated(self, hf_tiny):
+        """Patches of image 2 must not influence image 1's embeddings."""
+        _, d = hf_tiny
+        tcfg, vcfg, params = load_qwen2_vl(d)
+        rng = np.random.RandomState(1)
+        g1 = np.array([[1, 4, 4]])
+        p1 = rng.randn(16, vcfg.patch_dim).astype(np.float32)
+        solo = vision_forward(params["visual"], vcfg, jnp.asarray(p1), g1)
+        g2 = np.array([[1, 4, 4], [1, 2, 2]])
+        p2 = np.concatenate(
+            [p1, rng.randn(4, vcfg.patch_dim).astype(np.float32)]
+        )
+        both = vision_forward(params["visual"], vcfg, jnp.asarray(p2), g2)
+        np.testing.assert_allclose(
+            np.asarray(both[:4]), np.asarray(solo), atol=1e-5
+        )
+
+
+class TestMRope:
+    def test_positions_text_only(self):
+        pos, delta = mrope_positions([5, 6, 7], None, IMG)
+        np.testing.assert_array_equal(pos, np.tile(np.arange(3), (3, 1)))
+        assert delta == 0
+
+    def test_positions_with_image(self):
+        # text(2) + image span of 1*2*2 merged grid (4 patches -> 4/4=1?
+        # grid is in patch units: t=1,h=4,w=4 -> merged 2x2 = 4 tokens)
+        ids = [1, 2] + [IMG] * 4 + [3]
+        grid = np.array([[1, 4, 4]])
+        pos, delta = mrope_positions(ids, grid, IMG)
+        # image tokens: t=2 const; h in {2,3}; w in {2,3}
+        np.testing.assert_array_equal(pos[0, 2:6], [2, 2, 2, 2])
+        np.testing.assert_array_equal(pos[1, 2:6], [2, 2, 3, 3])
+        np.testing.assert_array_equal(pos[2, 2:6], [2, 3, 2, 3])
+        # trailing text resumes at max+1 = 4
+        assert list(pos[:, 6]) == [4, 4, 4]
+        assert delta == 5 - 7 + 0 or pos[0, 6] - 6 == delta
+
+    def test_full_model_parity_with_image(self, hf_tiny):
+        import torch
+
+        m, d = hf_tiny
+        tcfg, vcfg, params = load_qwen2_vl(d)
+        grid = np.array([[1, 4, 4]])
+        rng = np.random.RandomState(2)
+        patches = rng.randn(16, vcfg.patch_dim).astype(np.float32)
+        ids = [1, 2, VSTART] + [IMG] * 4 + [VEND, 3, 4]
+        input_ids = np.asarray([ids], np.int64)
+        with torch.no_grad():
+            want = m(
+                input_ids=torch.from_numpy(input_ids),
+                pixel_values=torch.from_numpy(patches),
+                image_grid_thw=torch.from_numpy(grid),
+            ).logits.numpy()
+
+        img_embeds = vision_forward(
+            params["visual"], vcfg, jnp.asarray(patches), grid
+        )
+        text_params = {k: v for k, v in params.items() if k != "visual"}
+        emb = params["embed"]["weight"][np.asarray(ids)]
+        emb = jnp.asarray(emb)
+        img_positions = [i for i, t in enumerate(ids) if t == IMG]
+        emb = emb.at[jnp.asarray(img_positions)].set(img_embeds)
+        pos, _ = mrope_positions(ids, grid, IMG)
+        from helix_tpu.models.llama import prefill_attn_fn
+
+        logits, _ = text_forward_mrope(
+            text_params, tcfg, jnp.asarray([ids]),
+            jnp.asarray(pos)[:, None, :],
+            attn_fn=lambda q, k, v, c, p: prefill_attn_fn(
+                q, k, v, c, p, backend="reference"
+            ),
+            input_embeds=emb[None],
+            mrope_sections=(2, 3, 3),
+        )
+        np.testing.assert_allclose(np.asarray(logits), want, atol=1e-3)

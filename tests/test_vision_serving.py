@@ -1,0 +1,82 @@
+"""Vision preprocessing parity vs HF's Qwen2VLImageProcessor + prompt build."""
+
+import base64
+import io
+
+import numpy as np
+import pytest
+
+from helix_tpu.serving.tokenizer import ByteTokenizer
+from helix_tpu.serving.vision import (
+    build_vl_prompt,
+    decode_image,
+    patchify,
+    smart_resize,
+)
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+class TestPatchify:
+    def test_matches_hf_processor(self):
+        from transformers import Qwen2VLImageProcessor
+
+        rng = np.random.RandomState(0)
+        img = rng.randint(0, 255, (57, 93, 3), np.uint8)
+        proc = Qwen2VLImageProcessor(
+            patch_size=14, merge_size=2, temporal_patch_size=2
+        )
+        out = proc(images=[img], return_tensors="np")
+        want = out["pixel_values"]
+        want_grid = out["image_grid_thw"][0]
+        got, grid = patchify(img)
+        assert tuple(want_grid) == tuple(grid)
+        np.testing.assert_allclose(got, want, atol=2e-2)
+
+    def test_smart_resize_bounds(self):
+        h, w = smart_resize(1000, 3000, factor=28)
+        assert h % 28 == 0 and w % 28 == 0
+        assert h * w <= 14 * 14 * 4 * 1280
+
+
+class TestPromptBuild:
+    def test_image_expansion(self):
+        tok = ByteTokenizer()
+        img = np.zeros((56, 56, 3), np.uint8)
+        b64 = base64.b64encode(_png_bytes(img)).decode()
+        messages = [
+            {
+                "role": "user",
+                "content": [
+                    {"type": "text", "text": "what is this?"},
+                    {
+                        "type": "image_url",
+                        "image_url": {"url": f"data:image/png;base64,{b64}"},
+                    },
+                ],
+            }
+        ]
+        p = build_vl_prompt(
+            messages, tok, image_pad_id=300, vision_start_id=301,
+            vision_end_id=302,
+        )
+        # 56x56 -> 4x4 patch grid -> 2x2 merged = 4 image tokens
+        assert p.grid_thw.tolist() == [[1, 4, 4]]
+        assert len(p.image_positions) == 4
+        assert all(p.input_ids[i] == 300 for i in p.image_positions)
+        assert p.image_patches[0].shape == (16, 3 * 2 * 14 * 14)
+        # vision start/end wrap the span
+        first = p.image_positions[0]
+        assert p.input_ids[first - 1] == 301
+        assert p.input_ids[p.image_positions[-1] + 1] == 302
+
+    def test_decode_image_roundtrip(self):
+        img = np.arange(56 * 56 * 3, dtype=np.uint8).reshape(56, 56, 3)
+        out = decode_image(_png_bytes(img))
+        np.testing.assert_array_equal(out, img)
