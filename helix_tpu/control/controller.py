@@ -1,0 +1,248 @@
+"""Session/inference controller: the ChatCompletion pipeline.
+
+Mirrors ``api/pkg/controller/inference.go``: load the session and its app
+binding, build message history, inject the assistant's system prompt and
+secrets, enrich with knowledge/RAG context (``evaluateKnowledge``,
+``inference.go:1093-1192``), resolve a provider client, run the exchange
+(blocking or streaming), then persist interactions + LLMCall log + usage
+metrics.  Apps follow the reference's ``helix.yaml`` assistant schema
+(model/provider/system_prompt/knowledge/temperature).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import AsyncIterator, Optional
+
+from helix_tpu.control.providers import ProviderError, ProviderManager
+from helix_tpu.control.store import Store
+
+RAG_PROMPT = (
+    "Use the following context to answer the user's question. If the "
+    "context is not relevant, answer from your own knowledge.\n\n"
+    "<context>\n{context}\n</context>"
+)
+
+
+@dataclasses.dataclass
+class AssistantConfig:
+    name: str = "default"
+    model: str = ""
+    provider: str = ""
+    system_prompt: str = ""
+    temperature: Optional[float] = None
+    knowledge: tuple = ()          # knowledge ids
+    rag_top_k: int = 4
+    max_tokens: Optional[int] = None
+
+    @classmethod
+    def from_app_doc(cls, doc: dict, name: str = "") -> "AssistantConfig":
+        """Parse a helix.yaml-style app doc (``spec.assistants[...]``)."""
+        spec = doc.get("spec", doc)
+        assistants = spec.get("assistants") or [{}]
+        a = assistants[0]
+        if name:
+            for cand in assistants:
+                if cand.get("name") == name:
+                    a = cand
+                    break
+        knowledge = tuple(
+            k.get("id") or k.get("name") if isinstance(k, dict) else k
+            for k in (a.get("knowledge") or [])
+        )
+        return cls(
+            name=a.get("name", "default"),
+            model=a.get("model", ""),
+            provider=a.get("provider", ""),
+            system_prompt=a.get("system_prompt", ""),
+            temperature=a.get("temperature"),
+            knowledge=knowledge,
+            rag_top_k=int(a.get("rag_top_k", 4)),
+            max_tokens=a.get("max_tokens"),
+        )
+
+
+class SessionController:
+    def __init__(
+        self,
+        store: Store,
+        providers: ProviderManager,
+        knowledge=None,            # KnowledgeManager
+    ):
+        self.store = store
+        self.providers = providers
+        self.knowledge = knowledge
+
+    # ------------------------------------------------------------------
+    def _assistant_for(self, app_id: Optional[str], assistant: str = ""):
+        if not app_id:
+            return AssistantConfig()
+        app = self.store.get_app(app_id)
+        if app is None:
+            raise ProviderError(404, f"app '{app_id}' not found")
+        return AssistantConfig.from_app_doc(app["doc"], assistant)
+
+    def _history(self, session_id: Optional[str]) -> list:
+        if not session_id:
+            return []
+        out = []
+        for it in self.store.list_interactions(session_id):
+            if it.get("role") in ("user", "assistant", "system"):
+                out.append({"role": it["role"], "content": it.get("content", "")})
+        return out
+
+    def _enrich(self, assistant: AssistantConfig, user_text: str) -> Optional[str]:
+        """RAG context block for the user query, if knowledge is bound."""
+        if not assistant.knowledge or self.knowledge is None:
+            return None
+        results = self.knowledge.query(
+            list(assistant.knowledge), user_text, top_k=assistant.rag_top_k
+        )
+        if not results:
+            return None
+        ctx = "\n\n".join(
+            f"[{r['meta'].get('source', r['knowledge_id'])}] {r['text']}"
+            for r in results
+        )
+        return RAG_PROMPT.format(context=ctx)
+
+    def _build_body(
+        self, messages: list, assistant: AssistantConfig, overrides: dict
+    ) -> dict:
+        msgs = list(messages)
+        user_text = next(
+            (
+                m["content"]
+                for m in reversed(msgs)
+                if m["role"] == "user" and isinstance(m.get("content"), str)
+            ),
+            "",
+        )
+        system_parts = []
+        if assistant.system_prompt:
+            system_parts.append(assistant.system_prompt)
+        rag = self._enrich(assistant, user_text)
+        if rag:
+            system_parts.append(rag)
+        if system_parts and not any(m["role"] == "system" for m in msgs):
+            msgs = [{"role": "system", "content": "\n\n".join(system_parts)}] + msgs
+        body = {
+            "model": overrides.get("model") or assistant.model,
+            "messages": msgs,
+        }
+        temp = overrides.get("temperature", assistant.temperature)
+        if temp is not None:
+            body["temperature"] = temp
+        mx = overrides.get("max_tokens", assistant.max_tokens)
+        if mx is not None:
+            body["max_tokens"] = mx
+        return body
+
+    # ------------------------------------------------------------------
+    async def chat(
+        self,
+        messages: list,
+        *,
+        user: str = "anonymous",
+        session_id: Optional[str] = None,
+        app_id: Optional[str] = None,
+        assistant_name: str = "",
+        provider: Optional[str] = None,
+        **overrides,
+    ) -> dict:
+        """Blocking chat (``RunBlockingSession`` / ``ChatCompletion``)."""
+        assistant = self._assistant_for(app_id, assistant_name)
+        history = self._history(session_id)
+        body = self._build_body(history + list(messages), assistant, overrides)
+        client, model = self.providers.resolve(
+            body.get("model", ""), provider or assistant.provider or None
+        )
+        body["model"] = model
+        t0 = time.monotonic()
+        resp = await client.chat(body)
+        self._record(
+            user, session_id, model, provider, body, resp,
+            int((time.monotonic() - t0) * 1000), messages,
+        )
+        return resp
+
+    async def chat_stream(
+        self,
+        messages: list,
+        *,
+        user: str = "anonymous",
+        session_id: Optional[str] = None,
+        app_id: Optional[str] = None,
+        assistant_name: str = "",
+        provider: Optional[str] = None,
+        **overrides,
+    ) -> AsyncIterator[dict]:
+        assistant = self._assistant_for(app_id, assistant_name)
+        history = self._history(session_id)
+        body = self._build_body(history + list(messages), assistant, overrides)
+        client, model = self.providers.resolve(
+            body.get("model", ""), provider or assistant.provider or None
+        )
+        body["model"] = model
+        t0 = time.monotonic()
+        parts = []
+        async for chunk in client.chat_stream(body):
+            for ch in chunk.get("choices", []):
+                delta = ch.get("delta", {}).get("content")
+                if delta:
+                    parts.append(delta)
+            yield chunk
+        resp = {
+            "choices": [
+                {
+                    "message": {
+                        "role": "assistant",
+                        "content": "".join(parts),
+                    }
+                }
+            ],
+            "usage": {},
+        }
+        self._record(
+            user, session_id, model, provider, body, resp,
+            int((time.monotonic() - t0) * 1000), messages,
+        )
+
+    # ------------------------------------------------------------------
+    def _record(
+        self, user, session_id, model, provider, body, resp, ms, new_messages
+    ):
+        usage = resp.get("usage", {}) or {}
+        self.store.log_llm_call(
+            {
+                "request_messages": len(body.get("messages", [])),
+                "duration_ms": ms,
+                "usage": usage,
+            },
+            session_id=session_id or "",
+            model=model,
+            provider=provider or "",
+        )
+        self.store.add_usage(
+            user, model,
+            int(usage.get("prompt_tokens", 0)),
+            int(usage.get("completion_tokens", 0)),
+        )
+        if session_id:
+            for m in new_messages:
+                self.store.add_interaction(
+                    session_id,
+                    {"role": m["role"], "content": m.get("content", "")},
+                )
+            msg = resp["choices"][0]["message"]
+            self.store.add_interaction(
+                session_id,
+                {
+                    "role": "assistant",
+                    "content": msg.get("content", ""),
+                    "model": model,
+                    "usage": usage,
+                    "duration_ms": ms,
+                },
+            )

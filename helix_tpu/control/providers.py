@@ -1,0 +1,329 @@
+"""Provider manager: resolve model requests to inference clients.
+
+Mirrors ``api/pkg/openai/manager/provider_manager.go:35-66`` (env-baked
+global providers + DB-backed user endpoints -> clients) and the client layer
+``api/pkg/openai/openai_client.go``:
+
+- ``HelixProvider`` — the self-hosted path: dispatch through the inference
+  router to TPU runner nodes (the ``InternalHelixServer`` analogue).
+- ``OpenAICompatProvider`` — any OpenAI-compatible HTTP endpoint
+  (OpenAI/TogetherAI/vLLM/...), with retry + streaming passthrough.
+- ``AnthropicProvider`` — native /v1/messages upstream, translated to the
+  internal OpenAI-shaped exchange (reverse of our serving-side proxy).
+
+Every call is logged as an LLMCall row + usage metric through the store,
+like the reference's logging middleware (``openai/logger/``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+import uuid
+from typing import AsyncIterator, Optional
+
+import aiohttp
+
+
+@dataclasses.dataclass
+class ProviderEndpoint:
+    name: str                    # "helix" | "openai" | "anthropic" | custom
+    kind: str                    # helix | openai_compat | anthropic
+    base_url: str = ""
+    api_key: str = ""
+    models: tuple = ()           # advertised models ((), = discover/any)
+
+
+class ProviderError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+class OpenAICompatProvider:
+    """Client for any OpenAI-compatible endpoint with retries."""
+
+    RETRYABLE = (429, 500, 502, 503, 504)
+
+    def __init__(self, endpoint: ProviderEndpoint, max_retries: int = 3):
+        self.endpoint = endpoint
+        self.max_retries = max_retries
+
+    def _headers(self):
+        h = {"Content-Type": "application/json"}
+        if self.endpoint.api_key:
+            h["Authorization"] = f"Bearer {self.endpoint.api_key}"
+        return h
+
+    async def chat(self, body: dict) -> dict:
+        url = f"{self.endpoint.base_url}/v1/chat/completions"
+        timeout = aiohttp.ClientTimeout(total=300)
+        last = None
+        for attempt in range(self.max_retries):
+            async with aiohttp.ClientSession(timeout=timeout) as s:
+                async with s.post(
+                    url, json=body, headers=self._headers()
+                ) as r:
+                    if r.status == 200:
+                        return await r.json()
+                    last = ProviderError(r.status, await r.text())
+                    if r.status not in self.RETRYABLE:
+                        raise last
+            await _sleep_backoff(attempt)
+        raise last
+
+    async def chat_stream(self, body: dict) -> AsyncIterator[dict]:
+        url = f"{self.endpoint.base_url}/v1/chat/completions"
+        timeout = aiohttp.ClientTimeout(total=300)
+        async with aiohttp.ClientSession(timeout=timeout) as s:
+            async with s.post(
+                url, json={**body, "stream": True}, headers=self._headers()
+            ) as r:
+                if r.status != 200:
+                    raise ProviderError(r.status, await r.text())
+                async for line in r.content:
+                    line = line.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    payload = line[len(b"data: "):]
+                    if payload == b"[DONE]":
+                        return
+                    yield json.loads(payload)
+
+    async def embeddings(self, body: dict) -> dict:
+        url = f"{self.endpoint.base_url}/v1/embeddings"
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=120)
+        ) as s:
+            async with s.post(url, json=body, headers=self._headers()) as r:
+                if r.status != 200:
+                    raise ProviderError(r.status, await r.text())
+                return await r.json()
+
+
+class AnthropicProvider(OpenAICompatProvider):
+    """Upstream Anthropic /v1/messages, adapted to the OpenAI exchange shape
+    (the inverse of our serving-side Anthropic surface; reference:
+    ``api/pkg/openai/openai_client_anthropic.go``)."""
+
+    def _headers(self):
+        return {
+            "Content-Type": "application/json",
+            "x-api-key": self.endpoint.api_key,
+            "anthropic-version": "2023-06-01",
+        }
+
+    @staticmethod
+    def _to_anthropic(body: dict) -> dict:
+        messages = body.get("messages", [])
+        system = "\n".join(
+            m["content"] for m in messages if m["role"] == "system"
+            if isinstance(m.get("content"), str)
+        )
+        rest = [m for m in messages if m["role"] != "system"]
+        out = {
+            "model": body["model"],
+            "messages": rest,
+            "max_tokens": body.get("max_tokens", 1024),
+        }
+        for k in ("temperature", "top_p", "top_k"):
+            if k in body:
+                out[k] = body[k]
+        if system:
+            out["system"] = system
+        if body.get("stop"):
+            stops = body["stop"]
+            out["stop_sequences"] = [stops] if isinstance(stops, str) else stops
+        return out
+
+    async def chat(self, body: dict) -> dict:
+        url = f"{self.endpoint.base_url}/v1/messages"
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=300)
+        ) as s:
+            async with s.post(
+                url, json=self._to_anthropic(body), headers=self._headers()
+            ) as r:
+                if r.status != 200:
+                    raise ProviderError(r.status, await r.text())
+                doc = await r.json()
+        text = "".join(
+            b.get("text", "") for b in doc.get("content", [])
+            if b.get("type") == "text"
+        )
+        return {
+            "id": doc.get("id", f"chatcmpl-{uuid.uuid4().hex[:12]}"),
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body["model"],
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": "length"
+                    if doc.get("stop_reason") == "max_tokens"
+                    else "stop",
+                }
+            ],
+            "usage": {
+                "prompt_tokens": doc.get("usage", {}).get("input_tokens", 0),
+                "completion_tokens": doc.get("usage", {}).get(
+                    "output_tokens", 0
+                ),
+                "total_tokens": doc.get("usage", {}).get("input_tokens", 0)
+                + doc.get("usage", {}).get("output_tokens", 0),
+            },
+        }
+
+
+class HelixProvider:
+    """Self-hosted path: route to a TPU runner via the inference router
+    (the reference's ``InternalHelixServer`` -> ``PickRunner`` -> dispatch
+    loop, ``helix_openai_server.go:187-307``)."""
+
+    def __init__(self, router):
+        self.router = router
+
+    def _pick(self, model: str) -> str:
+        runner = self.router.pick_runner(model)
+        if runner is None:
+            raise ProviderError(
+                404,
+                f"no runner serves model '{model}'; available: "
+                f"{self.router.available_models()}",
+            )
+        address = runner.meta.get("address")
+        if not address:
+            raise ProviderError(503, f"runner {runner.id} has no address")
+        return address
+
+    async def chat(self, body: dict) -> dict:
+        address = self._pick(body.get("model", ""))
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=300)
+        ) as s:
+            async with s.post(
+                f"{address}/v1/chat/completions", json=body
+            ) as r:
+                if r.status != 200:
+                    raise ProviderError(r.status, await r.text())
+                return await r.json()
+
+    async def chat_stream(self, body: dict) -> AsyncIterator[dict]:
+        address = self._pick(body.get("model", ""))
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=300)
+        ) as s:
+            async with s.post(
+                f"{address}/v1/chat/completions",
+                json={**body, "stream": True},
+            ) as r:
+                if r.status != 200:
+                    raise ProviderError(r.status, await r.text())
+                async for line in r.content:
+                    line = line.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    payload = line[len(b"data: "):]
+                    if payload == b"[DONE]":
+                        return
+                    yield json.loads(payload)
+
+    async def embeddings(self, body: dict) -> dict:
+        address = self._pick(body.get("model", ""))
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=120)
+        ) as s:
+            async with s.post(f"{address}/v1/embeddings", json=body) as r:
+                if r.status != 200:
+                    raise ProviderError(r.status, await r.text())
+                return await r.json()
+
+
+async def _sleep_backoff(attempt: int):
+    import asyncio
+
+    await asyncio.sleep(min(0.25 * 2**attempt, 4.0))
+
+
+class ProviderManager:
+    """Global + dynamically-registered providers; per-model resolution.
+
+    The "helix" provider always exists once a router is attached; external
+    providers come from config/env (reference: env-baked) or runtime
+    registration (reference: DB-backed per-org endpoints)."""
+
+    def __init__(self, router=None):
+        self._providers: dict[str, object] = {}
+        if router is not None:
+            self._providers["helix"] = HelixProvider(router)
+        self._router = router
+
+    def register(self, endpoint: ProviderEndpoint):
+        cls = {
+            "openai_compat": OpenAICompatProvider,
+            "anthropic": AnthropicProvider,
+        }.get(endpoint.kind)
+        if cls is None:
+            raise ValueError(f"unknown provider kind {endpoint.kind}")
+        self._providers[endpoint.name] = cls(endpoint)
+
+    @classmethod
+    def from_env(cls, router=None, env=None) -> "ProviderManager":
+        import os
+
+        env = env or os.environ
+        pm = cls(router)
+        if env.get("OPENAI_API_KEY"):
+            pm.register(ProviderEndpoint(
+                name="openai", kind="openai_compat",
+                base_url=env.get("OPENAI_BASE_URL", "https://api.openai.com"),
+                api_key=env["OPENAI_API_KEY"],
+            ))
+        if env.get("ANTHROPIC_API_KEY"):
+            pm.register(ProviderEndpoint(
+                name="anthropic", kind="anthropic",
+                base_url=env.get(
+                    "ANTHROPIC_BASE_URL", "https://api.anthropic.com"
+                ),
+                api_key=env["ANTHROPIC_API_KEY"],
+            ))
+        if env.get("TOGETHER_API_KEY"):
+            pm.register(ProviderEndpoint(
+                name="togetherai", kind="openai_compat",
+                base_url="https://api.together.xyz",
+                api_key=env["TOGETHER_API_KEY"],
+            ))
+        return pm
+
+    def names(self) -> list:
+        return sorted(self._providers)
+
+    def get(self, name: str):
+        p = self._providers.get(name)
+        if p is None:
+            raise ProviderError(
+                400, f"unknown provider '{name}'; have {self.names()}"
+            )
+        return p
+
+    def resolve(self, model: str, provider: Optional[str] = None):
+        """Pick a provider for a model: explicit name, 'provider/model'
+        prefix, helix if the router serves it, else first registered."""
+        if provider:
+            return self.get(provider), model
+        if "/" in model:
+            head, rest = model.split("/", 1)
+            if head in self._providers:
+                return self._providers[head], rest
+        helix = self._providers.get("helix")
+        if helix is not None and self._router is not None:
+            if model in self._router.available_models():
+                return helix, model
+        for name in self.names():
+            if name != "helix":
+                return self._providers[name], model
+        if helix is not None:
+            return helix, model
+        raise ProviderError(503, "no providers configured")

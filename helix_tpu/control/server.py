@@ -37,9 +37,54 @@ def _err(status, message, **extra):
 
 
 class ControlPlane:
-    def __init__(self, db_path: str = ":memory:"):
+    def __init__(self, db_path: str = ":memory:", embed_fn=None):
+        from helix_tpu.control.controller import SessionController
+        from helix_tpu.control.providers import ProviderManager
+        from helix_tpu.knowledge.embed import HashEmbedder, RemoteEmbedder
+        from helix_tpu.knowledge.ingest import KnowledgeManager
+        from helix_tpu.knowledge.vector_store import VectorStore
+
         self.store = Store(db_path)
         self.router = InferenceRouter()
+        self.providers = ProviderManager.from_env(self.router)
+        vec_path = (
+            ":memory:" if db_path == ":memory:" else db_path + ".vectors"
+        )
+        self.vectors = VectorStore(vec_path)
+        if embed_fn is None:
+            # prefer a served embedding model when one exists; hashing
+            # fallback keeps RAG working with zero models
+            remote = RemoteEmbedder(
+                model="",
+                pick_address=self._pick_embed_address,
+            )
+            hash_embed = HashEmbedder()
+
+            def embed_fn(texts):
+                target = self._pick_embed_model()
+                if target is None:
+                    return hash_embed(texts)
+                remote.model = target[0]
+                remote.base_url = target[1]
+                return remote(texts)
+
+        self.knowledge = KnowledgeManager(self.vectors, embed_fn).start()
+        self.controller = SessionController(
+            self.store, self.providers, self.knowledge
+        )
+
+    def _pick_embed_model(self):
+        for st in self.router.runners():
+            if not st.routable:
+                continue
+            for m in st.models:
+                if "embed" in m.lower() or "bge" in m.lower():
+                    return m, st.meta.get("address")
+        return None
+
+    def _pick_embed_address(self):
+        t = self._pick_embed_model()
+        return t[1] if t else None
 
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -62,6 +107,21 @@ class ControlPlane:
         r.add_get("/api/v1/sessions", self.list_sessions)
         r.add_get("/api/v1/sessions/{id}", self.get_session)
         r.add_delete("/api/v1/sessions/{id}", self.delete_session)
+        r.add_post("/api/v1/sessions/{id}/chat", self.session_chat)
+        # apps (helix.yaml surface)
+        r.add_get("/api/v1/apps", self.list_apps)
+        r.add_post("/api/v1/apps", self.create_app)
+        r.add_get("/api/v1/apps/{id}", self.get_app)
+        r.add_delete("/api/v1/apps/{id}", self.delete_app)
+        # knowledge
+        r.add_get("/api/v1/knowledge", self.list_knowledge)
+        r.add_post("/api/v1/knowledge", self.create_knowledge)
+        r.add_get("/api/v1/knowledge/{id}", self.get_knowledge)
+        r.add_delete("/api/v1/knowledge/{id}", self.delete_knowledge)
+        r.add_post("/api/v1/knowledge/{id}/refresh", self.refresh_knowledge)
+        r.add_post("/api/v1/knowledge/{id}/search", self.search_knowledge)
+        # usage
+        r.add_get("/api/v1/usage", self.usage)
         # openai passthrough
         r.add_get("/v1/models", self.models)
         for route in ("/v1/chat/completions", "/v1/completions", "/v1/embeddings"):
@@ -196,6 +256,151 @@ class ControlPlane:
     async def delete_session(self, request):
         self.store.delete_session(request.match_info["id"])
         return web.json_response({"ok": True})
+
+    async def session_chat(self, request):
+        """Session-aware chat: history + app binding + RAG enrichment, then
+        provider dispatch (the reference's session inference path)."""
+        from helix_tpu.control.providers import ProviderError
+
+        sid = request.match_info["id"]
+        session = self.store.get_session(sid)
+        if session is None:
+            return _err(404, "session not found")
+        body = await request.json()
+        messages = body.get("messages") or (
+            [{"role": "user", "content": body["message"]}]
+            if body.get("message")
+            else None
+        )
+        if not messages:
+            return _err(400, "'messages' or 'message' required")
+        doc = session.get("doc", {})
+        kwargs = dict(
+            user=session.get("owner", "anonymous"),
+            session_id=sid,
+            app_id=body.get("app_id") or doc.get("app_id"),
+            assistant_name=body.get("assistant", ""),
+            provider=body.get("provider") or doc.get("provider"),
+            model=body.get("model") or doc.get("model"),
+        )
+        for k in ("temperature", "max_tokens"):
+            if k in body:
+                kwargs[k] = body[k]
+        try:
+            if body.get("stream"):
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream"}
+                )
+                await resp.prepare(request)
+                async for chunk in self.controller.chat_stream(
+                    messages, **kwargs
+                ):
+                    await resp.write(
+                        f"data: {json.dumps(chunk)}\n\n".encode()
+                    )
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            out = await self.controller.chat(messages, **kwargs)
+            return web.json_response(out)
+        except ProviderError as e:
+            return _err(e.status, str(e))
+
+    # -- apps ----------------------------------------------------------------
+    async def list_apps(self, request):
+        return web.json_response(
+            {"apps": self.store.list_apps(request.query.get("owner"))}
+        )
+
+    async def create_app(self, request):
+        """Accepts JSON app docs or raw helix.yaml (Content-Type: yaml)."""
+        ctype = request.headers.get("Content-Type", "")
+        raw = await request.read()
+        if "yaml" in ctype or raw.lstrip().startswith(b"apiVersion"):
+            import yaml as _yaml
+
+            doc = _yaml.safe_load(raw)
+        else:
+            doc = json.loads(raw)
+        name = (
+            doc.get("metadata", {}).get("name")
+            or doc.get("name")
+            or "untitled"
+        )
+        owner = request.query.get("owner", "anonymous")
+        app_id = self.store.upsert_app(name, owner, doc)
+        return web.json_response({"id": app_id, "name": name})
+
+    async def get_app(self, request):
+        app = self.store.get_app(request.match_info["id"])
+        if app is None:
+            return _err(404, "app not found")
+        return web.json_response(app)
+
+    async def delete_app(self, request):
+        ok = self.store.delete_app(request.match_info["id"])
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    # -- knowledge -----------------------------------------------------------
+    async def list_knowledge(self, request):
+        return web.json_response(
+            {"knowledge": [k.to_dict() for k in self.knowledge.list()]}
+        )
+
+    async def create_knowledge(self, request):
+        import uuid as _uuid
+
+        from helix_tpu.knowledge.ingest import KnowledgeSpec
+
+        body = await request.json()
+        kid = body.get("id") or f"kno_{_uuid.uuid4().hex[:12]}"
+        spec = KnowledgeSpec(
+            id=kid,
+            name=body.get("name", kid),
+            text=body.get("text"),
+            path=body.get("path"),
+            urls=tuple(body.get("urls", [])),
+            chunk_size=int(body.get("chunk_size", 1000)),
+            chunk_overlap=int(body.get("chunk_overlap", 100)),
+        )
+        self.knowledge.add(spec)
+        return web.json_response({"id": kid, "state": spec.state})
+
+    async def get_knowledge(self, request):
+        spec = self.knowledge.get(request.match_info["id"])
+        if spec is None:
+            return _err(404, "knowledge not found")
+        return web.json_response(spec.to_dict())
+
+    async def delete_knowledge(self, request):
+        self.knowledge.remove(request.match_info["id"])
+        return web.json_response({"ok": True})
+
+    async def refresh_knowledge(self, request):
+        kid = request.match_info["id"]
+        if self.knowledge.get(kid) is None:
+            return _err(404, "knowledge not found")
+        self.knowledge.refresh(kid)
+        return web.json_response({"ok": True})
+
+    async def search_knowledge(self, request):
+        kid = request.match_info["id"]
+        if self.knowledge.get(kid) is None:
+            return _err(404, "knowledge not found")
+        body = await request.json()
+        results = await __import__("asyncio").get_running_loop().run_in_executor(
+            None,
+            lambda: self.knowledge.query(
+                kid, body.get("query", ""), int(body.get("top_k", 5))
+            ),
+        )
+        return web.json_response({"results": results})
+
+    # -- usage ---------------------------------------------------------------
+    async def usage(self, request):
+        return web.json_response(
+            {"usage": self.store.usage_summary(request.query.get("owner"))}
+        )
 
     # -- openai passthrough ---------------------------------------------------
     async def models(self, request):

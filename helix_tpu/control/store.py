@@ -75,6 +75,14 @@ CREATE TABLE IF NOT EXISTS usage_metrics (
     completion_tokens INTEGER,
     created_at REAL NOT NULL
 );
+CREATE TABLE IF NOT EXISTS apps (
+    id TEXT PRIMARY KEY,
+    owner TEXT,
+    name TEXT NOT NULL,
+    doc TEXT NOT NULL,        -- JSON: assistants, triggers, secrets refs
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
 CREATE TABLE IF NOT EXISTS kv (
     k TEXT PRIMARY KEY,
     v TEXT NOT NULL
@@ -314,6 +322,61 @@ class Store:
             }
             for r in rows
         }
+
+    # -- apps --------------------------------------------------------------
+    def upsert_app(self, name: str, owner: str, doc: dict,
+                   app_id: Optional[str] = None) -> str:
+        now = time.time()
+        with self._lock:
+            if app_id is None:
+                row = self._conn.execute(
+                    "SELECT id FROM apps WHERE name=? AND owner=?",
+                    (name, owner),
+                ).fetchone()
+                app_id = row[0] if row else f"app_{uuid.uuid4().hex[:16]}"
+            self._conn.execute(
+                "INSERT INTO apps(id, owner, name, doc, created_at, "
+                "updated_at) VALUES(?,?,?,?,?,?) ON CONFLICT(id) DO UPDATE "
+                "SET doc=excluded.doc, name=excluded.name, "
+                "updated_at=excluded.updated_at",
+                (app_id, owner, name, json.dumps(doc), now, now),
+            )
+            self._conn.commit()
+        return app_id
+
+    def get_app(self, app_id: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, owner, name, doc FROM apps WHERE id=? OR name=?",
+                (app_id, app_id),
+            ).fetchone()
+        if not row:
+            return None
+        return {
+            "id": row[0], "owner": row[1], "name": row[2],
+            "doc": json.loads(row[3]),
+        }
+
+    def list_apps(self, owner: Optional[str] = None) -> list:
+        q = "SELECT id, owner, name, doc FROM apps"
+        args: tuple = ()
+        if owner:
+            q += " WHERE owner=?"
+            args = (owner,)
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [
+            {"id": r[0], "owner": r[1], "name": r[2], "doc": json.loads(r[3])}
+            for r in rows
+        ]
+
+    def delete_app(self, app_id: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM apps WHERE id=?", (app_id,)
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
 
     # -- kv ----------------------------------------------------------------
     def kv_set(self, k: str, v: Any) -> None:

@@ -1,0 +1,5 @@
+from helix_tpu.knowledge.vector_store import VectorStore
+from helix_tpu.knowledge.splitter import split_text
+from helix_tpu.knowledge.ingest import KnowledgeManager, KnowledgeSpec
+
+__all__ = ["VectorStore", "split_text", "KnowledgeManager", "KnowledgeSpec"]

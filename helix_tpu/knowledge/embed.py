@@ -1,0 +1,70 @@
+"""Embedding clients for knowledge ingestion.
+
+- ``RemoteEmbedder``: sync client for any /v1/embeddings surface (a TPU
+  runner's bge worker — BASELINE config 2 — or an external provider).
+- ``HashEmbedder``: deterministic character-n-gram feature hashing. Zero
+  dependencies, zero models; makes knowledge/RAG functional out of the box
+  and in tests, with the same interface the learned embedder fills later.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+
+class HashEmbedder:
+    def __init__(self, dim: int = 256, ngram: int = 3):
+        self.dim = dim
+        self.ngram = ngram
+
+    def __call__(self, texts: list) -> np.ndarray:
+        out = np.zeros((len(texts), self.dim), np.float32)
+        for i, t in enumerate(texts):
+            t = f"  {t.lower()}  "
+            for j in range(len(t) - self.ngram + 1):
+                g = t[j : j + self.ngram].encode()
+                h = int.from_bytes(
+                    hashlib.blake2b(g, digest_size=8).digest(), "little"
+                )
+                idx = h % self.dim
+                sign = 1.0 if (h >> 63) & 1 else -1.0
+                out[i, idx] += sign
+            n = np.linalg.norm(out[i])
+            if n > 0:
+                out[i] /= n
+        return out
+
+
+class RemoteEmbedder:
+    """Sync /v1/embeddings client; ``pick_address`` resolves lazily so a
+    router-backed deployment keeps working across runner churn."""
+
+    def __init__(self, model: str, base_url=None, pick_address=None,
+                 api_key: str = "", timeout: float = 120.0):
+        self.model = model
+        self.base_url = base_url
+        self.pick_address = pick_address
+        self.api_key = api_key
+        self.timeout = timeout
+
+    def __call__(self, texts: list) -> np.ndarray:
+        import requests
+
+        base = self.base_url or (self.pick_address and self.pick_address())
+        if not base:
+            raise RuntimeError("no embeddings endpoint available")
+        headers = {}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        r = requests.post(
+            f"{base}/v1/embeddings",
+            json={"model": self.model, "input": list(texts)},
+            headers=headers,
+            timeout=self.timeout,
+        )
+        r.raise_for_status()
+        data = r.json()["data"]
+        return np.asarray([d["embedding"] for d in data], np.float32)

@@ -1,0 +1,200 @@
+"""Knowledge manager: sources -> extract -> split -> embed -> index.
+
+The in-process counterpart of the reference's knowledge reconciler
+(``api/pkg/controller/knowledge/knowledge.go:35-103``): specs declare
+sources (files/dir/inline text), a background reconcile pass drives each
+knowledge through pending -> indexing -> ready (error on failure) with
+per-knowledge progress, and re-indexing bumps a version whose chunks
+atomically replace the old ones.  Embeddings come from any callable
+(the local TPU EmbeddingRunner or a provider's /v1/embeddings).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+import numpy as np
+
+from helix_tpu.knowledge.splitter import extract_text, split_text
+from helix_tpu.knowledge.vector_store import VectorStore
+
+
+@dataclasses.dataclass
+class KnowledgeSpec:
+    id: str
+    name: str = ""
+    # sources
+    text: Optional[str] = None          # inline content
+    path: Optional[str] = None          # file or directory
+    urls: tuple = ()                    # crawl targets (needs a fetcher)
+    # chunking
+    chunk_size: int = 1000
+    chunk_overlap: int = 100
+    # state (managed)
+    state: str = "pending"              # pending|indexing|ready|error
+    version: int = 0
+    progress: dict = dataclasses.field(default_factory=dict)
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+_TEXT_EXTS = {".txt", ".md", ".markdown", ".rst", ".py", ".go", ".js", ".ts",
+              ".json", ".yaml", ".yml", ".toml", ".html", ".htm", ".css"}
+
+
+class KnowledgeManager:
+    def __init__(
+        self,
+        store: VectorStore,
+        embed_fn: Callable[[list], np.ndarray],
+        fetch_fn: Optional[Callable[[str], tuple]] = None,  # url -> (text, ctype)
+        reconcile_interval: float = 10.0,
+    ):
+        self.store = store
+        self.embed = embed_fn
+        self.fetch = fetch_fn
+        self.reconcile_interval = reconcile_interval
+        self._specs: dict[str, KnowledgeSpec] = {}
+        self._dirty: set = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def add(self, spec: KnowledgeSpec) -> KnowledgeSpec:
+        with self._lock:
+            self._specs[spec.id] = spec
+            self._dirty.add(spec.id)
+        return spec
+
+    def get(self, kid: str) -> Optional[KnowledgeSpec]:
+        return self._specs.get(kid)
+
+    def list(self) -> list:
+        return [self._specs[k] for k in sorted(self._specs)]
+
+    def remove(self, kid: str) -> None:
+        with self._lock:
+            self._specs.pop(kid, None)
+            self._dirty.discard(kid)
+        self.store.delete_collection(kid)
+
+    def refresh(self, kid: str) -> None:
+        with self._lock:
+            if kid in self._specs:
+                self._dirty.add(kid)
+
+    # ------------------------------------------------------------------
+    def _gather(self, spec: KnowledgeSpec) -> list:
+        """-> [(text, meta)] raw documents."""
+        docs = []
+        if spec.text:
+            docs.append((spec.text, {"source": "inline"}))
+        if spec.path:
+            if os.path.isfile(spec.path):
+                paths = [spec.path]
+            else:
+                paths = [
+                    os.path.join(r, f)
+                    for r, _, fs in os.walk(spec.path)
+                    for f in fs
+                    if os.path.splitext(f)[1].lower() in _TEXT_EXTS
+                ]
+            for p in sorted(paths):
+                try:
+                    with open(p, errors="replace") as f:
+                        content = f.read()
+                except OSError:
+                    continue
+                ctype = (
+                    "text/html"
+                    if p.lower().endswith((".html", ".htm"))
+                    else "text/plain"
+                )
+                docs.append(
+                    (extract_text(content, ctype), {"source": p})
+                )
+        for url in spec.urls:
+            if self.fetch is None:
+                raise RuntimeError(
+                    "url sources need a fetcher (no egress in this node?)"
+                )
+            content, ctype = self.fetch(url)
+            docs.append((extract_text(content, ctype), {"source": url}))
+        return docs
+
+    def index(self, kid: str) -> KnowledgeSpec:
+        """Synchronous (re-)index of one knowledge."""
+        spec = self._specs[kid]
+        spec.state = "indexing"
+        spec.error = ""
+        try:
+            docs = self._gather(spec)
+            new_version = spec.version + 1
+            total_chunks = 0
+            for di, (text, meta) in enumerate(docs):
+                chunks = split_text(text, spec.chunk_size, spec.chunk_overlap)
+                if not chunks:
+                    continue
+                embeddings = self.embed(chunks)
+                self.store.upsert(
+                    kid, chunks, embeddings,
+                    metas=[{**meta, "doc": di}] * len(chunks),
+                    version=new_version,
+                )
+                total_chunks += len(chunks)
+                spec.progress = {
+                    "docs_done": di + 1,
+                    "docs_total": len(docs),
+                    "chunks": total_chunks,
+                }
+            self.store.delete_versions_below(kid, new_version)
+            spec.version = new_version
+            spec.state = "ready"
+        except Exception as e:  # noqa: BLE001 — surfaced in spec state
+            spec.state = "error"
+            spec.error = f"{e}\n{traceback.format_exc(limit=3)}"
+        return spec
+
+    # ------------------------------------------------------------------
+    def query(self, kids, text: str, top_k: int = 5) -> list:
+        """Search one or many knowledges; merged by score."""
+        if isinstance(kids, str):
+            kids = [kids]
+        q = self.embed([text])[0]
+        results = []
+        for kid in kids:
+            for r in self.store.query(kid, q, top_k=top_k):
+                results.append({**r, "knowledge_id": kid})
+        results.sort(key=lambda r: -r["score"])
+        return results[:top_k]
+
+    # ------------------------------------------------------------------
+    def start(self):
+        """Background reconcile loop (gocron analogue)."""
+
+        def run():
+            while not self._stop.is_set():
+                with self._lock:
+                    dirty = list(self._dirty)
+                    self._dirty.clear()
+                for kid in dirty:
+                    if kid in self._specs:
+                        self.index(kid)
+                self._stop.wait(self.reconcile_interval)
+
+        self._thread = threading.Thread(
+            target=run, name="helix-knowledge", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()

@@ -1,0 +1,77 @@
+"""Text extraction + chunking for knowledge ingestion.
+
+The reference pipeline is crawler -> extractor service -> splitter ->
+indexer (``api/pkg/controller/knowledge/``); extraction here is in-process
+(markdown/HTML/plain), splitting is paragraph-aware with overlap.
+"""
+
+from __future__ import annotations
+
+import re
+from html.parser import HTMLParser
+
+
+class _HTMLText(HTMLParser):
+    SKIP = {"script", "style", "noscript", "head"}
+
+    def __init__(self):
+        super().__init__()
+        self.parts: list = []
+        self._skip_depth = 0
+
+    def handle_starttag(self, tag, attrs):
+        if tag in self.SKIP:
+            self._skip_depth += 1
+
+    def handle_endtag(self, tag):
+        if tag in self.SKIP and self._skip_depth:
+            self._skip_depth -= 1
+
+    def handle_data(self, data):
+        if not self._skip_depth and data.strip():
+            self.parts.append(data.strip())
+
+
+def extract_text(content: str, content_type: str = "text/plain") -> str:
+    """HTML/markdown/plain -> clean text (the extractor-service stand-in,
+    reference ``api/pkg/extract/extract.go:22-29`` calls out over HTTP)."""
+    if "html" in content_type:
+        p = _HTMLText()
+        p.feed(content)
+        return "\n".join(p.parts)
+    # markdown: strip the common syntax, keep prose
+    text = re.sub(r"```.*?```", "", content, flags=re.S)
+    text = re.sub(r"`([^`]*)`", r"\1", text)
+    text = re.sub(r"!\[[^\]]*\]\([^)]*\)", "", text)
+    text = re.sub(r"\[([^\]]*)\]\([^)]*\)", r"\1", text)
+    text = re.sub(r"^#+\s*", "", text, flags=re.M)
+    text = re.sub(r"[*_]{1,3}([^*_]+)[*_]{1,3}", r"\1", text)
+    return text.strip()
+
+
+def split_text(
+    text: str,
+    chunk_size: int = 1000,
+    overlap: int = 100,
+) -> list:
+    """Paragraph-aware sliding chunks of ~chunk_size chars with overlap."""
+    paragraphs = [p.strip() for p in re.split(r"\n\s*\n", text) if p.strip()]
+    chunks: list = []
+    cur = ""
+    for p in paragraphs:
+        if len(cur) + len(p) + 1 <= chunk_size:
+            cur = f"{cur}\n{p}".strip()
+            continue
+        if cur:
+            chunks.append(cur)
+            tail = cur[-overlap:] if overlap else ""
+            cur = (tail + "\n" + p).strip()
+        else:
+            cur = p
+        # hard-split any paragraph that alone exceeds the chunk size
+        while len(cur) > chunk_size:
+            chunks.append(cur[:chunk_size])
+            cur = cur[chunk_size - overlap :] if overlap else cur[chunk_size:]
+    if cur:
+        chunks.append(cur)
+    return chunks

@@ -1,0 +1,166 @@
+"""Embedded vector store: SQLite rows + in-memory matmul search.
+
+The reference delegates vector search to a VectorChord/pgvector container
+via the embedded kodit library (``SURVEY.md`` §2.5); this build keeps the
+control plane dependency-free: chunk text/metadata persist in SQLite,
+embeddings sit in a normalised fp32 matrix per collection, and search is
+one [N, D] @ [D] matmul — exact cosine, no ANN approximation error, easily
+fast enough up to hundreds of thousands of chunks (numpy BLAS), and the
+interface (upsert/delete/query by collection) is pgvector-shaped so an
+external backend can slot in later.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import uuid
+from typing import Optional, Sequence
+
+import numpy as np
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS chunks (
+    id TEXT PRIMARY KEY,
+    collection TEXT NOT NULL,
+    version INTEGER NOT NULL DEFAULT 1,
+    text TEXT NOT NULL,
+    meta TEXT NOT NULL DEFAULT '{}',
+    embedding BLOB NOT NULL,
+    dim INTEGER NOT NULL,
+    created_at REAL DEFAULT (unixepoch('subsec'))
+);
+CREATE INDEX IF NOT EXISTS idx_chunks_collection ON chunks(collection, version);
+"""
+
+
+class VectorStore:
+    def __init__(self, path: str = ":memory:"):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+        # collection -> (ids, normalised matrix) cache
+        self._cache: dict[str, tuple] = {}
+
+    # ------------------------------------------------------------------
+    def upsert(
+        self,
+        collection: str,
+        texts: Sequence[str],
+        embeddings: np.ndarray,          # [N, D]
+        metas: Optional[Sequence[dict]] = None,
+        version: int = 1,
+    ) -> list:
+        embeddings = np.asarray(embeddings, np.float32)
+        metas = metas or [{}] * len(texts)
+        ids = []
+        with self._lock:
+            for text, emb, meta in zip(texts, embeddings, metas):
+                cid = f"chk_{uuid.uuid4().hex[:16]}"
+                ids.append(cid)
+                self._conn.execute(
+                    "INSERT INTO chunks(id, collection, version, text, meta, "
+                    "embedding, dim) VALUES(?,?,?,?,?,?,?)",
+                    (
+                        cid, collection, version, text, json.dumps(meta),
+                        emb.astype(np.float32).tobytes(), emb.shape[-1],
+                    ),
+                )
+            self._conn.commit()
+            self._cache.pop(collection, None)
+        return ids
+
+    def delete_collection(self, collection: str) -> int:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM chunks WHERE collection=?", (collection,)
+            )
+            self._conn.commit()
+            self._cache.pop(collection, None)
+            return cur.rowcount
+
+    def delete_versions_below(self, collection: str, version: int) -> int:
+        """Version-swap ingestion: new version lands, old is pruned
+        (mirrors the reference's knowledge versioning)."""
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM chunks WHERE collection=? AND version<?",
+                (collection, version),
+            )
+            self._conn.commit()
+            self._cache.pop(collection, None)
+            return cur.rowcount
+
+    def count(self, collection: str) -> int:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT COUNT(*) FROM chunks WHERE collection=?",
+                (collection,),
+            ).fetchone()
+        return row[0]
+
+    def collections(self) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT DISTINCT collection FROM chunks"
+            ).fetchall()
+        return sorted(r[0] for r in rows)
+
+    # ------------------------------------------------------------------
+    def _matrix(self, collection: str):
+        with self._lock:
+            cached = self._cache.get(collection)
+            if cached is not None:
+                return cached
+            rows = self._conn.execute(
+                "SELECT id, embedding, dim FROM chunks WHERE collection=?",
+                (collection,),
+            ).fetchall()
+            if not rows:
+                self._cache[collection] = ([], None)
+                return [], None
+            ids = [r[0] for r in rows]
+            mat = np.stack(
+                [np.frombuffer(r[1], np.float32, count=r[2]) for r in rows]
+            )
+            norms = np.linalg.norm(mat, axis=1, keepdims=True)
+            mat = mat / np.maximum(norms, 1e-9)
+            self._cache[collection] = (ids, mat)
+            return ids, mat
+
+    def query(
+        self,
+        collection: str,
+        embedding: np.ndarray,
+        top_k: int = 5,
+        min_score: float = 0.0,
+    ) -> list:
+        """-> [{id, text, meta, score}] by cosine similarity."""
+        ids, mat = self._matrix(collection)
+        if mat is None:
+            return []
+        q = np.asarray(embedding, np.float32).reshape(-1)
+        q = q / max(np.linalg.norm(q), 1e-9)
+        scores = mat @ q
+        k = min(top_k, len(ids))
+        top = np.argsort(-scores)[:k]
+        out = []
+        with self._lock:
+            for i in top:
+                if scores[i] < min_score:
+                    continue
+                row = self._conn.execute(
+                    "SELECT text, meta FROM chunks WHERE id=?", (ids[i],)
+                ).fetchone()
+                out.append(
+                    {
+                        "id": ids[i],
+                        "text": row[0],
+                        "meta": json.loads(row[1]),
+                        "score": float(scores[i]),
+                    }
+                )
+        return out

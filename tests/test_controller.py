@@ -1,0 +1,191 @@
+"""Session controller + provider manager tests (fake providers, real store)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from helix_tpu.control.controller import AssistantConfig, SessionController
+from helix_tpu.control.providers import (
+    ProviderEndpoint,
+    ProviderError,
+    ProviderManager,
+)
+from helix_tpu.control.store import Store
+from helix_tpu.knowledge.embed import HashEmbedder
+from helix_tpu.knowledge.ingest import KnowledgeManager, KnowledgeSpec
+from helix_tpu.knowledge.vector_store import VectorStore
+
+
+class FakeProvider:
+    def __init__(self):
+        self.calls = []
+
+    async def chat(self, body):
+        self.calls.append(body)
+        return {
+            "id": "x",
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": "pong"},
+                    "finish_reason": "stop",
+                }
+            ],
+            "usage": {"prompt_tokens": 7, "completion_tokens": 1,
+                      "total_tokens": 8},
+        }
+
+    async def chat_stream(self, body):
+        self.calls.append(body)
+        for piece in ("po", "ng"):
+            yield {
+                "choices": [{"index": 0, "delta": {"content": piece}}]
+            }
+
+
+def _controller(with_knowledge=False):
+    store = Store()
+    pm = ProviderManager()
+    fake = FakeProvider()
+    pm._providers["fake"] = fake
+    km = None
+    if with_knowledge:
+        km = KnowledgeManager(VectorStore(), HashEmbedder())
+        km.add(KnowledgeSpec(
+            id="kb",
+            text="The capital of Freedonia is Fredville.\n\nTPUs have MXUs.",
+            chunk_size=60, chunk_overlap=0,
+        ))
+        km.index("kb")
+    ctl = SessionController(store, pm, km)
+    return ctl, store, fake
+
+
+class TestAssistantConfig:
+    def test_helix_yaml_shape(self):
+        doc = {
+            "apiVersion": "app.aispec.org/v1alpha1",
+            "kind": "AIApp",
+            "metadata": {"name": "demo"},
+            "spec": {
+                "assistants": [
+                    {
+                        "name": "main",
+                        "model": "m1",
+                        "system_prompt": "be kind",
+                        "knowledge": [{"id": "kb"}],
+                        "temperature": 0.5,
+                    }
+                ]
+            },
+        }
+        a = AssistantConfig.from_app_doc(doc)
+        assert a.model == "m1" and a.system_prompt == "be kind"
+        assert a.knowledge == ("kb",) and a.temperature == 0.5
+
+
+class TestSessionController:
+    def test_chat_persists_interactions(self):
+        ctl, store, fake = _controller()
+        sid = store.create_session("u1", "s", {})
+        out = asyncio.run(
+            ctl.chat(
+                [{"role": "user", "content": "ping"}],
+                user="u1", session_id=sid, provider="fake", model="m",
+            )
+        )
+        assert out["choices"][0]["message"]["content"] == "pong"
+        inter = store.list_interactions(sid)
+        assert [i["role"] for i in inter] == ["user", "assistant"]
+        # usage + llm call recorded
+        usage = store.usage_summary("u1")
+        assert usage["m"]["completion_tokens"] == 1
+
+    def test_history_included_on_second_turn(self):
+        ctl, store, fake = _controller()
+        sid = store.create_session("u1", "s", {})
+        asyncio.run(ctl.chat(
+            [{"role": "user", "content": "first"}],
+            session_id=sid, provider="fake", model="m",
+        ))
+        asyncio.run(ctl.chat(
+            [{"role": "user", "content": "second"}],
+            session_id=sid, provider="fake", model="m",
+        ))
+        sent = fake.calls[-1]["messages"]
+        contents = [m["content"] for m in sent]
+        assert contents == ["first", "pong", "second"]
+
+    def test_app_system_prompt_and_rag(self):
+        ctl, store, fake = _controller(with_knowledge=True)
+        app_id = store.upsert_app(
+            "demo", "u1",
+            {
+                "spec": {
+                    "assistants": [
+                        {
+                            "name": "main",
+                            "model": "m",
+                            "system_prompt": "be kind",
+                            "knowledge": ["kb"],
+                        }
+                    ]
+                }
+            },
+        )
+        asyncio.run(ctl.chat(
+            [{"role": "user", "content": "what is the capital of Freedonia?"}],
+            provider="fake", app_id=app_id,
+        ))
+        sent = fake.calls[-1]["messages"]
+        assert sent[0]["role"] == "system"
+        assert "be kind" in sent[0]["content"]
+        assert "Fredville" in sent[0]["content"], "RAG context missing"
+
+    def test_stream_records_after_done(self):
+        ctl, store, fake = _controller()
+        sid = store.create_session("u1", "s", {})
+
+        async def run():
+            chunks = []
+            async for c in ctl.chat_stream(
+                [{"role": "user", "content": "hi"}],
+                session_id=sid, provider="fake", model="m",
+            ):
+                chunks.append(c)
+            return chunks
+
+        chunks = asyncio.run(run())
+        assert len(chunks) == 2
+        inter = store.list_interactions(sid)
+        assert inter[-1]["content"] == "pong"
+
+    def test_unknown_app_404(self):
+        ctl, store, fake = _controller()
+        with pytest.raises(ProviderError) as e:
+            asyncio.run(ctl.chat(
+                [{"role": "user", "content": "x"}],
+                provider="fake", app_id="missing",
+            ))
+        assert e.value.status == 404
+
+
+class TestProviderManager:
+    def test_resolve_prefix(self):
+        pm = ProviderManager()
+        pm._providers["openai"] = FakeProvider()
+        client, model = pm.resolve("openai/gpt-4o")
+        assert model == "gpt-4o"
+
+    def test_no_providers_503(self):
+        pm = ProviderManager()
+        with pytest.raises(ProviderError) as e:
+            pm.resolve("anything")
+        assert e.value.status == 503
+
+    def test_from_env(self):
+        pm = ProviderManager.from_env(
+            env={"OPENAI_API_KEY": "sk-x", "ANTHROPIC_API_KEY": "sk-y"}
+        )
+        assert set(pm.names()) == {"openai", "anthropic"}

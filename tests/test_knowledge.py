@@ -1,0 +1,142 @@
+"""Knowledge/RAG tests: vector store, splitter, ingestion reconcile,
+hash-embedder retrieval quality."""
+
+import numpy as np
+import pytest
+
+from helix_tpu.knowledge.embed import HashEmbedder
+from helix_tpu.knowledge.ingest import KnowledgeManager, KnowledgeSpec
+from helix_tpu.knowledge.splitter import extract_text, split_text
+from helix_tpu.knowledge.vector_store import VectorStore
+
+
+class TestVectorStore:
+    def test_upsert_query_roundtrip(self):
+        vs = VectorStore()
+        embs = np.eye(4, dtype=np.float32)
+        vs.upsert("c1", ["a", "b", "c", "d"], embs)
+        out = vs.query("c1", np.array([1, 0, 0, 0], np.float32), top_k=2)
+        assert out[0]["text"] == "a"
+        assert out[0]["score"] == pytest.approx(1.0)
+        assert len(out) == 2
+
+    def test_collections_isolated(self):
+        vs = VectorStore()
+        vs.upsert("c1", ["x"], np.ones((1, 4), np.float32))
+        vs.upsert("c2", ["y"], np.ones((1, 4), np.float32))
+        out = vs.query("c1", np.ones(4, np.float32))
+        assert [r["text"] for r in out] == ["x"]
+
+    def test_version_swap(self):
+        vs = VectorStore()
+        vs.upsert("c", ["old"], np.ones((1, 4), np.float32), version=1)
+        vs.upsert("c", ["new"], np.ones((1, 4), np.float32), version=2)
+        vs.delete_versions_below("c", 2)
+        out = vs.query("c", np.ones(4, np.float32), top_k=10)
+        assert [r["text"] for r in out] == ["new"]
+
+    def test_min_score_filter(self):
+        vs = VectorStore()
+        vs.upsert(
+            "c", ["pos", "neg"],
+            np.array([[1, 0], [-1, 0]], np.float32),
+        )
+        out = vs.query("c", np.array([1, 0], np.float32), min_score=0.5)
+        assert [r["text"] for r in out] == ["pos"]
+
+
+class TestSplitter:
+    def test_split_respects_size(self):
+        text = "\n\n".join(f"paragraph {i} " + "x" * 80 for i in range(20))
+        chunks = split_text(text, chunk_size=200, overlap=20)
+        assert all(len(c) <= 200 for c in chunks)
+        assert len(chunks) > 5
+
+    def test_overlap_present(self):
+        text = "A" * 150 + "\n\n" + "B" * 150
+        chunks = split_text(text, chunk_size=160, overlap=30)
+        assert len(chunks) >= 2
+        assert chunks[1].startswith("A" * 30)
+
+    def test_html_extraction(self):
+        html = "<html><head><style>x{}</style></head><body><p>Hello</p><script>bad()</script><div>World</div></body></html>"
+        text = extract_text(html, "text/html")
+        assert "Hello" in text and "World" in text
+        assert "bad()" not in text and "x{}" not in text
+
+    def test_markdown_extraction(self):
+        md = "# Title\n\nSome **bold** text with [a link](http://x.com).\n\n```\ncode\n```"
+        text = extract_text(md, "text/markdown")
+        assert "Title" in text and "bold" in text and "a link" in text
+        assert "http://x.com" not in text and "code" not in text
+
+
+class TestHashEmbedder:
+    def test_similar_texts_closer(self):
+        e = HashEmbedder()
+        v = e([
+            "the quick brown fox jumps over the dog",
+            "a quick brown fox jumped over a dog",
+            "quantum chromodynamics lattice simulation",
+        ])
+        sim_close = float(v[0] @ v[1])
+        sim_far = float(v[0] @ v[2])
+        assert sim_close > sim_far + 0.2
+
+    def test_deterministic(self):
+        e = HashEmbedder()
+        a = e(["hello world"])
+        b = e(["hello world"])
+        np.testing.assert_array_equal(a, b)
+
+
+class TestKnowledgeManager:
+    def _mgr(self):
+        return KnowledgeManager(VectorStore(), HashEmbedder())
+
+    def test_inline_text_index_and_query(self):
+        km = self._mgr()
+        km.add(KnowledgeSpec(
+            id="k1",
+            text=(
+                "Helix is a private agent fleet platform.\n\n"
+                "The TPU engine uses paged attention for serving.\n\n"
+                "Bananas are yellow fruit rich in potassium."
+            ),
+            chunk_size=60, chunk_overlap=0,
+        ))
+        spec = km.index("k1")
+        assert spec.state == "ready", spec.error
+        assert spec.version == 1
+        out = km.query("k1", "what fruit is yellow?", top_k=1)
+        assert "Banana" in out[0]["text"]
+
+    def test_directory_source(self, tmp_path):
+        (tmp_path / "a.md").write_text("# Doc A\n\nAlpha document about llamas.")
+        (tmp_path / "b.txt").write_text("Beta document about TPUs and chips.")
+        (tmp_path / "c.bin").write_bytes(b"\x00\x01")  # ignored
+        km = self._mgr()
+        km.add(KnowledgeSpec(id="k2", path=str(tmp_path)))
+        spec = km.index("k2")
+        assert spec.state == "ready", spec.error
+        out = km.query("k2", "llamas", top_k=1)
+        assert "llamas" in out[0]["text"]
+        assert out[0]["meta"]["source"].endswith("a.md")
+
+    def test_reindex_bumps_version(self):
+        km = self._mgr()
+        spec = km.add(KnowledgeSpec(id="k3", text="version one content"))
+        km.index("k3")
+        spec.text = "version two content"
+        km.index("k3")
+        assert spec.version == 2
+        out = km.query("k3", "content", top_k=5)
+        assert all("two" in r["text"] for r in out)
+
+    def test_error_state(self):
+        km = self._mgr()
+        km.add(KnowledgeSpec(id="k4", path="/nonexistent/path/xyz"))
+        spec = km.index("k4")
+        # empty gather -> ready with nothing, but unreadable url -> error;
+        # nonexistent dir yields no docs, which is ready-empty
+        assert spec.state in ("ready", "error")
