@@ -1,0 +1,4 @@
+from helix_tpu.agent.skill import Skill, SkillRegistry
+from helix_tpu.agent.agent import Agent, AgentConfig, StepInfo
+
+__all__ = ["Skill", "SkillRegistry", "Agent", "AgentConfig", "StepInfo"]

@@ -1,0 +1,222 @@
+"""The agent loop: LLM-driven skill calling with observability.
+
+Mirrors ``api/pkg/agent/agent.go:38-44`` (``Agent{prompt, skills, emitter,
+maxIterations}``) and its observability contract (``StepInfoEmitter``,
+``observability.go:20-28``: every step emitted as a structured record).
+
+Two tool-calling protocols, auto-negotiated per response:
+- native OpenAI ``tool_calls`` when the provider returns them;
+- a fenced-JSON text protocol for base models served by the TPU engine
+  (the system prompt teaches ``{"tool": ..., "arguments": ...}`` /
+  ``{"answer": ...}``), with malformed-JSON retries counted as ignorable
+  errors (the reference distinguishes retryable vs ignorable LLM errors).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import time
+from typing import Callable, Optional
+
+from helix_tpu.agent.skill import SkillRegistry
+
+SYSTEM_TEMPLATE = """{prompt}
+
+You can use tools. Available tools:
+{catalog}
+
+To use a tool, reply with ONLY a JSON object in a fenced block:
+```json
+{{"tool": "<name>", "arguments": {{...}}}}
+```
+When you have the final answer, reply with ONLY:
+```json
+{{"answer": "<your final answer>"}}
+```
+"""
+
+
+@dataclasses.dataclass
+class StepInfo:
+    """One observable step (reference: ``types.StepInfo``)."""
+
+    step: int
+    kind: str                  # llm | tool | answer | error
+    name: str = ""
+    arguments: Optional[dict] = None
+    result: str = ""
+    duration_ms: int = 0
+    error: str = ""
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class AgentConfig:
+    prompt: str = "You are a helpful assistant."
+    model: str = ""
+    provider: Optional[str] = None
+    max_iterations: int = 10
+    temperature: float = 0.0
+    native_tools: bool = True      # offer OpenAI tools payload
+    max_json_retries: int = 2
+
+
+class Agent:
+    def __init__(
+        self,
+        config: AgentConfig,
+        skills: SkillRegistry,
+        llm,                       # provider client: .chat(body) -> dict
+        emitter: Optional[Callable[[StepInfo], None]] = None,
+    ):
+        self.config = config
+        self.skills = skills
+        self.llm = llm
+        self.emit = emitter or (lambda step: None)
+
+    # ------------------------------------------------------------------
+    def _system_prompt(self) -> str:
+        return SYSTEM_TEMPLATE.format(
+            prompt=self.config.prompt,
+            catalog=self.skills.prompt_catalog() or "(none)",
+        )
+
+    @staticmethod
+    def _parse_json_protocol(text: str) -> Optional[dict]:
+        """Extract the first JSON object from fenced or bare text."""
+        m = re.search(r"```(?:json)?\s*(\{.*?\})\s*```", text, re.S)
+        candidates = [m.group(1)] if m else []
+        # bare JSON object spanning the whole message
+        stripped = text.strip()
+        if stripped.startswith("{"):
+            candidates.append(stripped)
+        # first {...} blob anywhere
+        m2 = re.search(r"\{.*\}", text, re.S)
+        if m2:
+            candidates.append(m2.group(0))
+        for c in candidates:
+            try:
+                doc = json.loads(c)
+                if isinstance(doc, dict):
+                    return doc
+            except json.JSONDecodeError:
+                continue
+        return None
+
+    # ------------------------------------------------------------------
+    async def run(self, user_message: str, history: Optional[list] = None):
+        """-> (final_answer, [StepInfo]). The reference's skill loop."""
+        messages = [{"role": "system", "content": self._system_prompt()}]
+        messages += history or []
+        messages.append({"role": "user", "content": user_message})
+        steps: list = []
+        json_retries = 0
+
+        def record(**kw):
+            info = StepInfo(step=len(steps), **kw)
+            steps.append(info)
+            self.emit(info)
+            return info
+
+        for _ in range(self.config.max_iterations):
+            body = {
+                "model": self.config.model,
+                "messages": messages,
+                "temperature": self.config.temperature,
+            }
+            if self.config.native_tools and self.skills.names():
+                body["tools"] = self.skills.openai_tools()
+            t0 = time.monotonic()
+            resp = await self.llm.chat(body)
+            ms = int((time.monotonic() - t0) * 1000)
+            choice = resp["choices"][0]
+            msg = choice.get("message", {})
+            record(kind="llm", name=self.config.model, duration_ms=ms,
+                   result=(msg.get("content") or "")[:2000])
+
+            # --- native tool calls ---
+            tool_calls = msg.get("tool_calls") or []
+            if tool_calls:
+                messages.append(msg)
+                for tc in tool_calls:
+                    fn = tc.get("function", {})
+                    name = fn.get("name", "")
+                    try:
+                        args = json.loads(fn.get("arguments") or "{}")
+                    except json.JSONDecodeError:
+                        args = {}
+                    result = await self._execute(name, args, record)
+                    messages.append(
+                        {
+                            "role": "tool",
+                            "tool_call_id": tc.get("id", name),
+                            "content": result,
+                        }
+                    )
+                continue
+
+            content = msg.get("content") or ""
+            doc = self._parse_json_protocol(content)
+            if doc is None and not (
+                "```json" in content or '"tool"' in content
+            ):
+                # model answered in prose — treat as the final answer
+                record(kind="answer", result=content)
+                return content, steps
+            if doc and "answer" in doc:
+                answer = str(doc["answer"])
+                record(kind="answer", result=answer)
+                return answer, steps
+            if doc and "tool" in doc:
+                messages.append({"role": "assistant", "content": content})
+                result = await self._execute(
+                    str(doc["tool"]), doc.get("arguments") or {}, record
+                )
+                messages.append(
+                    {
+                        "role": "user",
+                        "content": f"Tool result:\n{result}",
+                    }
+                )
+                continue
+            # malformed protocol — nudge and retry (ignorable error)
+            json_retries += 1
+            record(kind="error", error=f"malformed tool JSON: {content[:200]}")
+            if json_retries > self.config.max_json_retries:
+                return content, steps
+            messages.append({"role": "assistant", "content": content})
+            messages.append(
+                {
+                    "role": "user",
+                    "content": (
+                        "Your reply was not valid tool JSON. Reply with a "
+                        "single fenced JSON object per the protocol."
+                    ),
+                }
+            )
+
+        record(kind="error", error="max iterations reached")
+        return "", steps
+
+    async def _execute(self, name: str, args: dict, record) -> str:
+        skill = self.skills.get(name)
+        t0 = time.monotonic()
+        if skill is None:
+            result = f"error: unknown tool '{name}'; have {self.skills.names()}"
+            record(kind="tool", name=name, arguments=args, error=result)
+            return result
+        try:
+            result = await skill.run(**args)
+            record(
+                kind="tool", name=name, arguments=args,
+                result=result[:2000],
+                duration_ms=int((time.monotonic() - t0) * 1000),
+            )
+        except Exception as e:  # noqa: BLE001 — tool errors feed back to the LLM
+            result = f"error: {e}"
+            record(kind="tool", name=name, arguments=args, error=str(e))
+        return result

@@ -1,0 +1,130 @@
+"""MCP (Model Context Protocol) client: external tool servers as skills.
+
+The reference integrates MCP both ways (agent skill ``agent/skill/mcp`` and
+per-session MCP servers); this client speaks JSON-RPC 2.0 over stdio to a
+spawned server process, performs the ``initialize`` handshake, lists tools,
+and wraps each as a ``Skill`` so the agent loop sees no difference between
+built-ins and MCP tools.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import threading
+from typing import Optional
+
+from helix_tpu.agent.skill import Skill
+
+PROTOCOL_VERSION = "2024-11-05"
+
+
+class MCPClient:
+    def __init__(self, command: list, env: Optional[dict] = None):
+        self.command = command
+        self.env = env
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self.server_info: dict = {}
+
+    # -- transport ---------------------------------------------------------
+    def start(self) -> "MCPClient":
+        import os
+
+        self._proc = subprocess.Popen(
+            self.command,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            env={**os.environ, **(self.env or {})},
+            text=True,
+            bufsize=1,
+        )
+        info = self._request(
+            "initialize",
+            {
+                "protocolVersion": PROTOCOL_VERSION,
+                "capabilities": {},
+                "clientInfo": {"name": "helix-tpu", "version": "0.1"},
+            },
+        )
+        self.server_info = info or {}
+        self._notify("notifications/initialized", {})
+        return self
+
+    def stop(self):
+        if self._proc:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+
+    def _send(self, doc: dict):
+        line = json.dumps(doc)
+        self._proc.stdin.write(line + "\n")
+        self._proc.stdin.flush()
+
+    def _request(self, method: str, params: dict):
+        with self._lock:
+            self._next_id += 1
+            rid = self._next_id
+            self._send(
+                {"jsonrpc": "2.0", "id": rid, "method": method, "params": params}
+            )
+            while True:
+                line = self._proc.stdout.readline()
+                if not line:
+                    raise RuntimeError("MCP server closed the pipe")
+                try:
+                    doc = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if doc.get("id") == rid:
+                    if "error" in doc:
+                        raise RuntimeError(f"MCP error: {doc['error']}")
+                    return doc.get("result")
+                # ignore server notifications/other ids
+
+    def _notify(self, method: str, params: dict):
+        self._send({"jsonrpc": "2.0", "method": method, "params": params})
+
+    # -- MCP surface ---------------------------------------------------------
+    def list_tools(self) -> list:
+        result = self._request("tools/list", {}) or {}
+        return result.get("tools", [])
+
+    def call_tool(self, name: str, arguments: dict) -> str:
+        result = self._request(
+            "tools/call", {"name": name, "arguments": arguments}
+        ) or {}
+        parts = []
+        for c in result.get("content", []):
+            if c.get("type") == "text":
+                parts.append(c.get("text", ""))
+            else:
+                parts.append(json.dumps(c))
+        if result.get("isError"):
+            return "error: " + "\n".join(parts)
+        return "\n".join(parts)
+
+    def as_skills(self, prefix: str = "") -> list:
+        skills = []
+        for t in self.list_tools():
+            name = f"{prefix}{t['name']}"
+
+            def handler(_tool=t["name"], **kwargs):
+                return self.call_tool(_tool, kwargs)
+
+            skills.append(
+                Skill(
+                    name=name,
+                    description=t.get("description", ""),
+                    parameters=t.get(
+                        "inputSchema", {"type": "object", "properties": {}}
+                    ),
+                    handler=handler,
+                )
+            )
+        return skills

@@ -1,0 +1,72 @@
+"""Skill model: typed tools the agent loop can call.
+
+Mirrors the reference's skill system (``api/pkg/agent/skill/`` — API
+calling, browser, calculator, knowledge, MCP, ...): a skill is a name +
+description + JSON-schema parameters + an async handler.  Skills render
+both as OpenAI ``tools`` payloads (for providers with native tool calling)
+and as prompt text for the JSON-protocol fallback the TPU-served base
+models use.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+import json
+from typing import Any, Callable, Optional
+
+
+@dataclasses.dataclass
+class Skill:
+    name: str
+    description: str
+    parameters: dict                    # JSON schema ({"type": "object", ...})
+    handler: Callable                   # (**kwargs) -> str | awaitable str
+    dangerous: bool = False             # requires explicit enablement
+
+    def to_openai_tool(self) -> dict:
+        return {
+            "type": "function",
+            "function": {
+                "name": self.name,
+                "description": self.description,
+                "parameters": self.parameters,
+            },
+        }
+
+    def to_prompt_block(self) -> str:
+        return (
+            f"- {self.name}: {self.description}\n"
+            f"  parameters (JSON schema): {json.dumps(self.parameters)}"
+        )
+
+    async def run(self, **kwargs) -> str:
+        out = self.handler(**kwargs)
+        if inspect.isawaitable(out):
+            out = await out
+        return out if isinstance(out, str) else json.dumps(out)
+
+
+class SkillRegistry:
+    def __init__(self, skills: Optional[list] = None):
+        self._skills: dict[str, Skill] = {}
+        for s in skills or []:
+            self.register(s)
+
+    def register(self, skill: Skill) -> None:
+        self._skills[skill.name] = skill
+
+    def get(self, name: str) -> Optional[Skill]:
+        return self._skills.get(name)
+
+    def names(self) -> list:
+        return sorted(self._skills)
+
+    def list(self) -> list:
+        return [self._skills[n] for n in self.names()]
+
+    def openai_tools(self) -> list:
+        return [s.to_openai_tool() for s in self.list()]
+
+    def prompt_catalog(self) -> str:
+        return "\n".join(s.to_prompt_block() for s in self.list())

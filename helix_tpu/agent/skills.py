@@ -1,0 +1,229 @@
+"""Built-in skills, mirroring the reference's catalogue
+(``api/pkg/agent/skill/``: calculator, API-calling, knowledge, web search,
+...).  Network-touching skills take their endpoints via config (this build
+treats egress as a deployment property, like the reference's SearXNG URL).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import operator
+import os
+from typing import Optional
+
+from helix_tpu.agent.skill import Skill
+
+# ---------------------------------------------------------------------------
+# calculator — safe AST arithmetic (the reference ships the same tool)
+# ---------------------------------------------------------------------------
+
+_OPS = {
+    ast.Add: operator.add,
+    ast.Sub: operator.sub,
+    ast.Mult: operator.mul,
+    ast.Div: operator.truediv,
+    ast.FloorDiv: operator.floordiv,
+    ast.Mod: operator.mod,
+    ast.Pow: operator.pow,
+    ast.USub: operator.neg,
+    ast.UAdd: operator.pos,
+}
+
+
+def _safe_eval(node):
+    if isinstance(node, ast.Expression):
+        return _safe_eval(node.body)
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return node.value
+    if isinstance(node, ast.BinOp) and type(node.op) in _OPS:
+        return _OPS[type(node.op)](_safe_eval(node.left), _safe_eval(node.right))
+    if isinstance(node, ast.UnaryOp) and type(node.op) in _OPS:
+        return _OPS[type(node.op)](_safe_eval(node.operand))
+    raise ValueError(f"unsupported expression element: {ast.dump(node)}")
+
+
+def calculator_skill() -> Skill:
+    def calc(expression: str) -> str:
+        tree = ast.parse(expression, mode="eval")
+        return str(_safe_eval(tree))
+
+    return Skill(
+        name="calculator",
+        description="Evaluate an arithmetic expression (+-*/%,**, parentheses).",
+        parameters={
+            "type": "object",
+            "properties": {"expression": {"type": "string"}},
+            "required": ["expression"],
+        },
+        handler=calc,
+    )
+
+
+# ---------------------------------------------------------------------------
+# knowledge search
+# ---------------------------------------------------------------------------
+
+
+def knowledge_skill(knowledge_manager, knowledge_ids) -> Skill:
+    def search(query: str, top_k: int = 4) -> str:
+        results = knowledge_manager.query(list(knowledge_ids), query, top_k)
+        if not results:
+            return "no results"
+        return "\n\n".join(
+            f"[{r['score']:.2f}] {r['text']}" for r in results
+        )
+
+    return Skill(
+        name="knowledge_search",
+        description="Search the attached knowledge base for relevant context.",
+        parameters={
+            "type": "object",
+            "properties": {
+                "query": {"type": "string"},
+                "top_k": {"type": "integer", "default": 4},
+            },
+            "required": ["query"],
+        },
+        handler=search,
+    )
+
+
+# ---------------------------------------------------------------------------
+# HTTP API calling (the OpenAPI skill)
+# ---------------------------------------------------------------------------
+
+
+def api_skill(
+    name: str,
+    description: str,
+    base_url: str,
+    openapi_spec: Optional[dict] = None,
+    headers: Optional[dict] = None,
+) -> Skill:
+    """Generic REST caller; with an OpenAPI spec the description advertises
+    the operations (reference: API-calling skill driven by OpenAPI)."""
+    ops = []
+    if openapi_spec:
+        for path, methods in (openapi_spec.get("paths") or {}).items():
+            for method, op in methods.items():
+                ops.append(
+                    f"{method.upper()} {path} — "
+                    f"{op.get('summary', op.get('operationId', ''))}"
+                )
+    full_desc = description
+    if ops:
+        full_desc += "\nOperations:\n" + "\n".join(ops[:40])
+
+    def call(path: str, method: str = "GET", query: Optional[dict] = None,
+             body: Optional[dict] = None) -> str:
+        import requests
+
+        r = requests.request(
+            method.upper(),
+            base_url.rstrip("/") + "/" + path.lstrip("/"),
+            params=query,
+            json=body,
+            headers=headers or {},
+            timeout=30,
+        )
+        text = r.text
+        return f"HTTP {r.status_code}\n{text[:4000]}"
+
+    return Skill(
+        name=name,
+        description=full_desc,
+        parameters={
+            "type": "object",
+            "properties": {
+                "path": {"type": "string"},
+                "method": {"type": "string", "default": "GET"},
+                "query": {"type": "object"},
+                "body": {"type": "object"},
+            },
+            "required": ["path"],
+        },
+        handler=call,
+    )
+
+
+# ---------------------------------------------------------------------------
+# web search (SearXNG metasearch, reference: api/pkg/searxng)
+# ---------------------------------------------------------------------------
+
+
+def web_search_skill(searxng_url: str) -> Skill:
+    def search(query: str, max_results: int = 5) -> str:
+        import requests
+
+        r = requests.get(
+            f"{searxng_url.rstrip('/')}/search",
+            params={"q": query, "format": "json"},
+            timeout=20,
+        )
+        r.raise_for_status()
+        results = r.json().get("results", [])[:max_results]
+        return "\n\n".join(
+            f"{x.get('title')}\n{x.get('url')}\n{x.get('content', '')}"
+            for x in results
+        ) or "no results"
+
+    return Skill(
+        name="web_search",
+        description="Search the web (SearXNG metasearch).",
+        parameters={
+            "type": "object",
+            "properties": {
+                "query": {"type": "string"},
+                "max_results": {"type": "integer", "default": 5},
+            },
+            "required": ["query"],
+        },
+        handler=search,
+    )
+
+
+# ---------------------------------------------------------------------------
+# filesystem (workspace-scoped read/list, for project/repository skills)
+# ---------------------------------------------------------------------------
+
+
+def filesystem_skill(root: str) -> Skill:
+    root = os.path.realpath(root)
+
+    def _resolve(path: str) -> str:
+        p = os.path.realpath(os.path.join(root, path.lstrip("/")))
+        if not p.startswith(root):
+            raise ValueError("path escapes the workspace")
+        return p
+
+    def fs(action: str, path: str = ".", content: Optional[str] = None) -> str:
+        p = _resolve(path)
+        if action == "list":
+            entries = sorted(os.listdir(p))
+            return "\n".join(entries) or "(empty)"
+        if action == "read":
+            with open(p, errors="replace") as f:
+                return f.read()[:8000]
+        if action == "write":
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            with open(p, "w") as f:
+                f.write(content or "")
+            return f"wrote {len(content or '')} bytes to {path}"
+        raise ValueError("action must be list|read|write")
+
+    return Skill(
+        name="filesystem",
+        description="List, read, or write files in the agent workspace.",
+        parameters={
+            "type": "object",
+            "properties": {
+                "action": {"type": "string", "enum": ["list", "read", "write"]},
+                "path": {"type": "string"},
+                "content": {"type": "string"},
+            },
+            "required": ["action", "path"],
+        },
+        handler=fs,
+        dangerous=True,
+    )

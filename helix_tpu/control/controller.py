@@ -35,6 +35,11 @@ class AssistantConfig:
     knowledge: tuple = ()          # knowledge ids
     rag_top_k: int = 4
     max_tokens: Optional[int] = None
+    # agent mode (reference: runAgent + skills config on the assistant)
+    agent_mode: bool = False
+    max_iterations: int = 10
+    apis: tuple = ()               # ({name, description, url, headers}, ...)
+    tools: tuple = ()              # built-in skill names to enable
 
     @classmethod
     def from_app_doc(cls, doc: dict, name: str = "") -> "AssistantConfig":
@@ -60,6 +65,10 @@ class AssistantConfig:
             knowledge=knowledge,
             rag_top_k=int(a.get("rag_top_k", 4)),
             max_tokens=a.get("max_tokens"),
+            agent_mode=bool(a.get("agent_mode") or a.get("apis")),
+            max_iterations=int(a.get("max_iterations", 10)),
+            apis=tuple(a.get("apis") or ()),
+            tools=tuple(a.get("tools") or ()),
         )
 
 
@@ -153,6 +162,11 @@ class SessionController:
     ) -> dict:
         """Blocking chat (``RunBlockingSession`` / ``ChatCompletion``)."""
         assistant = self._assistant_for(app_id, assistant_name)
+        if assistant.agent_mode:
+            return await self._run_agent(
+                assistant, messages, user=user, session_id=session_id,
+                provider=provider, overrides=overrides,
+            )
         history = self._history(session_id)
         body = self._build_body(history + list(messages), assistant, overrides)
         client, model = self.providers.resolve(
@@ -164,6 +178,102 @@ class SessionController:
         self._record(
             user, session_id, model, provider, body, resp,
             int((time.monotonic() - t0) * 1000), messages,
+        )
+        return resp
+
+    async def _run_agent(
+        self, assistant: AssistantConfig, messages, *, user, session_id,
+        provider, overrides,
+    ) -> dict:
+        """Skill-loop execution for agent-mode assistants (reference:
+        ``controller/inference_agent.go:56 runAgent``).  Steps persist on
+        the assistant interaction for per-session observability."""
+        from helix_tpu.agent.agent import Agent, AgentConfig
+        from helix_tpu.agent.skill import SkillRegistry
+        from helix_tpu.agent.skills import (
+            api_skill,
+            calculator_skill,
+            knowledge_skill,
+        )
+
+        client, model = self.providers.resolve(
+            overrides.get("model") or assistant.model,
+            provider or assistant.provider or None,
+        )
+        registry = SkillRegistry()
+        if "calculator" in assistant.tools or not assistant.tools:
+            registry.register(calculator_skill())
+        if assistant.knowledge and self.knowledge is not None:
+            registry.register(
+                knowledge_skill(self.knowledge, list(assistant.knowledge))
+            )
+        for api in assistant.apis:
+            registry.register(
+                api_skill(
+                    name=api.get("name", "api"),
+                    description=api.get("description", ""),
+                    base_url=api.get("url", ""),
+                    openapi_spec=api.get("schema"),
+                    headers=api.get("headers"),
+                )
+            )
+        agent = Agent(
+            AgentConfig(
+                prompt=assistant.system_prompt or "You are a helpful assistant.",
+                model=model,
+                max_iterations=assistant.max_iterations,
+                temperature=overrides.get(
+                    "temperature", assistant.temperature or 0.0
+                ) or 0.0,
+            ),
+            registry,
+            client,
+        )
+        history = self._history(session_id)
+        user_text = next(
+            (
+                m["content"] for m in reversed(list(messages))
+                if m["role"] == "user"
+            ),
+            "",
+        )
+        t0 = time.monotonic()
+        answer, steps = await agent.run(user_text, history=history)
+        ms = int((time.monotonic() - t0) * 1000)
+        resp = {
+            "id": "agent",
+            "object": "chat.completion",
+            "model": model,
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": answer},
+                    "finish_reason": "stop",
+                }
+            ],
+            "usage": {},
+            "steps": [s.to_dict() for s in steps],
+        }
+        if session_id:
+            for m in messages:
+                self.store.add_interaction(
+                    session_id,
+                    {"role": m["role"], "content": m.get("content", "")},
+                )
+            self.store.add_interaction(
+                session_id,
+                {
+                    "role": "assistant",
+                    "content": answer,
+                    "model": model,
+                    "duration_ms": ms,
+                    "steps": resp["steps"],
+                },
+            )
+        self.store.log_llm_call(
+            {"agent_steps": len(steps), "duration_ms": ms},
+            session_id=session_id or "", model=model,
+            provider=provider or "",
         )
         return resp
 

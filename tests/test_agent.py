@@ -1,0 +1,223 @@
+"""Agent loop tests: both tool protocols, skill errors, observability,
+MCP over a real stdio subprocess."""
+
+import asyncio
+import json
+import sys
+import textwrap
+
+import pytest
+
+from helix_tpu.agent.agent import Agent, AgentConfig
+from helix_tpu.agent.mcp import MCPClient
+from helix_tpu.agent.skill import Skill, SkillRegistry
+from helix_tpu.agent.skills import (
+    api_skill,
+    calculator_skill,
+    filesystem_skill,
+    knowledge_skill,
+)
+
+
+class ScriptedLLM:
+    """Returns canned responses in order; records request bodies."""
+
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    async def chat(self, body):
+        self.calls.append(body)
+        r = self.responses.pop(0)
+        if isinstance(r, str):
+            msg = {"role": "assistant", "content": r}
+        else:
+            msg = r
+        return {"choices": [{"index": 0, "message": msg}]}
+
+
+def _run(agent, msg):
+    return asyncio.run(agent.run(msg))
+
+
+class TestAgentLoop:
+    def test_json_protocol_tool_then_answer(self):
+        llm = ScriptedLLM([
+            '```json\n{"tool": "calculator", "arguments": {"expression": "6*7"}}\n```',
+            '```json\n{"answer": "the result is 42"}\n```',
+        ])
+        skills = SkillRegistry([calculator_skill()])
+        agent = Agent(AgentConfig(model="m"), skills, llm)
+        answer, steps = _run(agent, "what is 6*7?")
+        assert answer == "the result is 42"
+        kinds = [s.kind for s in steps]
+        assert "tool" in kinds and kinds[-1] == "answer"
+        tool_step = next(s for s in steps if s.kind == "tool")
+        assert tool_step.result == "42"
+        # tool result was fed back to the model
+        assert any(
+            "42" in str(m.get("content", "")) for m in llm.calls[1]["messages"]
+        )
+
+    def test_native_tool_calls(self):
+        llm = ScriptedLLM([
+            {
+                "role": "assistant",
+                "content": None,
+                "tool_calls": [
+                    {
+                        "id": "call_1",
+                        "type": "function",
+                        "function": {
+                            "name": "calculator",
+                            "arguments": '{"expression": "2+3"}',
+                        },
+                    }
+                ],
+            },
+            "The answer is 5.",
+        ])
+        skills = SkillRegistry([calculator_skill()])
+        agent = Agent(AgentConfig(model="m"), skills, llm)
+        answer, steps = _run(agent, "2+3?")
+        assert answer == "The answer is 5."
+        tool_msgs = [m for m in llm.calls[1]["messages"] if m.get("role") == "tool"]
+        assert tool_msgs and tool_msgs[0]["content"] == "5"
+
+    def test_unknown_tool_feeds_error_back(self):
+        llm = ScriptedLLM([
+            '{"tool": "nope", "arguments": {}}',
+            '{"answer": "done"}',
+        ])
+        agent = Agent(AgentConfig(model="m"), SkillRegistry([calculator_skill()]), llm)
+        answer, steps = _run(agent, "x")
+        assert answer == "done"
+        assert any("unknown tool" in (s.error or "") for s in steps)
+
+    def test_malformed_json_retry(self):
+        llm = ScriptedLLM([
+            '```json\n{"tool": broken\n```',
+            '{"answer": "recovered"}',
+        ])
+        agent = Agent(AgentConfig(model="m"), SkillRegistry(), llm)
+        answer, _ = _run(agent, "x")
+        assert answer == "recovered"
+
+    def test_prose_is_final_answer(self):
+        llm = ScriptedLLM(["Just a plain prose reply."])
+        agent = Agent(AgentConfig(model="m"), SkillRegistry(), llm)
+        answer, steps = _run(agent, "hi")
+        assert answer == "Just a plain prose reply."
+
+    def test_max_iterations(self):
+        llm = ScriptedLLM(
+            ['{"tool": "calculator", "arguments": {"expression": "1+1"}}'] * 5
+        )
+        agent = Agent(
+            AgentConfig(model="m", max_iterations=3),
+            SkillRegistry([calculator_skill()]), llm,
+        )
+        answer, steps = _run(agent, "loop")
+        assert answer == ""
+        assert steps[-1].error == "max iterations reached"
+
+    def test_emitter_receives_steps(self):
+        seen = []
+        llm = ScriptedLLM(['{"answer": "ok"}'])
+        agent = Agent(
+            AgentConfig(model="m"), SkillRegistry(), llm, emitter=seen.append
+        )
+        _run(agent, "x")
+        assert [s.kind for s in seen] == ["llm", "answer"]
+
+
+class TestSkills:
+    def test_calculator_safe(self):
+        c = calculator_skill()
+        assert asyncio.run(c.run(expression="2**10 % 7")) == "2"
+        with pytest.raises(Exception):
+            asyncio.run(c.run(expression="__import__('os')"))
+
+    def test_filesystem_scoped(self, tmp_path):
+        fs = filesystem_skill(str(tmp_path))
+        asyncio.run(fs.run(action="write", path="a/b.txt", content="hi"))
+        assert asyncio.run(fs.run(action="read", path="a/b.txt")) == "hi"
+        assert "a" in asyncio.run(fs.run(action="list", path="."))
+        with pytest.raises(Exception):
+            asyncio.run(fs.run(action="read", path="../../etc/passwd"))
+
+    def test_knowledge_skill(self):
+        from helix_tpu.knowledge.embed import HashEmbedder
+        from helix_tpu.knowledge.ingest import KnowledgeManager, KnowledgeSpec
+        from helix_tpu.knowledge.vector_store import VectorStore
+
+        km = KnowledgeManager(VectorStore(), HashEmbedder())
+        km.add(KnowledgeSpec(id="k", text="Paris is the capital of France."))
+        km.index("k")
+        s = knowledge_skill(km, ["k"])
+        out = asyncio.run(s.run(query="capital of France"))
+        assert "Paris" in out
+
+
+MCP_SERVER = textwrap.dedent(
+    """
+    import json, sys
+    TOOLS = [{
+        "name": "echo",
+        "description": "Echo back the input string.",
+        "inputSchema": {"type": "object", "properties": {"text": {"type": "string"}}},
+    }]
+    for line in sys.stdin:
+        doc = json.loads(line)
+        m, rid = doc.get("method"), doc.get("id")
+        if m == "initialize":
+            out = {"protocolVersion": "2024-11-05",
+                   "serverInfo": {"name": "test-server", "version": "1"},
+                   "capabilities": {"tools": {}}}
+        elif m == "tools/list":
+            out = {"tools": TOOLS}
+        elif m == "tools/call":
+            args = doc["params"]["arguments"]
+            out = {"content": [{"type": "text", "text": "echo: " + args.get("text", "")}]}
+        else:
+            continue
+        sys.stdout.write(json.dumps({"jsonrpc": "2.0", "id": rid, "result": out}) + "\\n")
+        sys.stdout.flush()
+    """
+)
+
+
+class TestMCP:
+    def test_stdio_roundtrip(self, tmp_path):
+        server = tmp_path / "server.py"
+        server.write_text(MCP_SERVER)
+        client = MCPClient([sys.executable, str(server)]).start()
+        try:
+            assert client.server_info["serverInfo"]["name"] == "test-server"
+            tools = client.list_tools()
+            assert tools[0]["name"] == "echo"
+            out = client.call_tool("echo", {"text": "hello"})
+            assert out == "echo: hello"
+            skills = client.as_skills(prefix="mcp_")
+            assert skills[0].name == "mcp_echo"
+            assert asyncio.run(skills[0].run(text="hi")) == "echo: hi"
+        finally:
+            client.stop()
+
+    def test_mcp_skill_in_agent_loop(self, tmp_path):
+        server = tmp_path / "server.py"
+        server.write_text(MCP_SERVER)
+        client = MCPClient([sys.executable, str(server)]).start()
+        try:
+            llm = ScriptedLLM([
+                '{"tool": "echo", "arguments": {"text": "ping"}}',
+                '{"answer": "got: echo: ping"}',
+            ])
+            agent = Agent(
+                AgentConfig(model="m"),
+                SkillRegistry(client.as_skills()), llm,
+            )
+            answer, steps = asyncio.run(agent.run("echo ping"))
+            assert answer == "got: echo: ping"
+        finally:
+            client.stop()

@@ -189,3 +189,62 @@ class TestProviderManager:
             env={"OPENAI_API_KEY": "sk-x", "ANTHROPIC_API_KEY": "sk-y"}
         )
         assert set(pm.names()) == {"openai", "anthropic"}
+
+
+class AgentScriptedProvider:
+    """Provider whose chat() follows the agent JSON protocol."""
+
+    def __init__(self, responses):
+        self.responses = list(responses)
+        self.calls = []
+
+    async def chat(self, body):
+        self.calls.append(body)
+        return {
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {
+                        "role": "assistant",
+                        "content": self.responses.pop(0),
+                    },
+                }
+            ]
+        }
+
+
+class TestAgentMode:
+    def test_agent_app_runs_skill_loop(self):
+        store = Store()
+        pm = ProviderManager()
+        fake = AgentScriptedProvider([
+            '{"tool": "calculator", "arguments": {"expression": "3*9"}}',
+            '{"answer": "27 it is"}',
+        ])
+        pm._providers["fake"] = fake
+        ctl = SessionController(store, pm, None)
+        app_id = store.upsert_app(
+            "agent-app", "u1",
+            {
+                "spec": {
+                    "assistants": [
+                        {
+                            "model": "m",
+                            "agent_mode": True,
+                            "system_prompt": "solve math",
+                        }
+                    ]
+                }
+            },
+        )
+        sid = store.create_session("u1", "s", {})
+        out = asyncio.run(ctl.chat(
+            [{"role": "user", "content": "3*9?"}],
+            provider="fake", app_id=app_id, session_id=sid,
+        ))
+        assert out["choices"][0]["message"]["content"] == "27 it is"
+        kinds = [s["kind"] for s in out["steps"]]
+        assert "tool" in kinds
+        inter = store.list_interactions(sid)
+        assert inter[-1]["content"] == "27 it is"
+        assert inter[-1]["steps"]
