@@ -1,0 +1,293 @@
+"""Authentication, users, API keys, orgs/teams RBAC, and secrets.
+
+Mirrors the reference's auth stack (``api/pkg/auth/helix_authenticator.go``:
+users/API-keys/JWT; ``server/authz.go`` RBAC; ``api/pkg/crypto`` +
+``store`` Secret envelope encryption):
+
+- API keys (``hl-...``) hashed at rest; bearer-token middleware resolves
+  the user onto the request.
+- Orgs with member roles (owner/admin/member) and resource-level authz:
+  a resource is visible to its owner, org members per role, or admins.
+- Secrets: Fernet envelope encryption under a master key, values never
+  returned by list APIs; the controller substitutes them into app configs
+  at inference time (reference: ``controller/inference.go:997``).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import hmac
+import os
+import secrets as pysecrets
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Optional
+
+from cryptography.fernet import Fernet
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS users (
+    id TEXT PRIMARY KEY,
+    email TEXT UNIQUE,
+    name TEXT,
+    admin INTEGER DEFAULT 0,
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS auth_keys (
+    key_hash TEXT PRIMARY KEY,
+    user_id TEXT NOT NULL,
+    name TEXT,
+    created_at REAL NOT NULL,
+    last_used REAL
+);
+CREATE TABLE IF NOT EXISTS orgs (
+    id TEXT PRIMARY KEY,
+    name TEXT UNIQUE NOT NULL,
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS org_members (
+    org_id TEXT NOT NULL,
+    user_id TEXT NOT NULL,
+    role TEXT NOT NULL DEFAULT 'member',
+    PRIMARY KEY (org_id, user_id)
+);
+CREATE TABLE IF NOT EXISTS secrets (
+    id TEXT PRIMARY KEY,
+    owner TEXT NOT NULL,
+    name TEXT NOT NULL,
+    ciphertext BLOB NOT NULL,
+    created_at REAL NOT NULL,
+    UNIQUE(owner, name)
+);
+"""
+
+ROLES = ("owner", "admin", "member")
+
+
+@dataclasses.dataclass
+class User:
+    id: str
+    email: str = ""
+    name: str = ""
+    admin: bool = False
+
+
+class Authenticator:
+    def __init__(self, db_path: str = ":memory:", master_key: Optional[bytes] = None):
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+        if master_key is None:
+            master_key = os.environ.get(
+                "HELIX_MASTER_KEY", "helix-dev-master-key"
+            ).encode()
+        self._fernet = Fernet(
+            base64.urlsafe_b64encode(hashlib.sha256(master_key).digest())
+        )
+
+    # -- users -------------------------------------------------------------
+    def create_user(self, email: str, name: str = "", admin: bool = False) -> User:
+        uid = f"usr_{uuid.uuid4().hex[:16]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO users(id, email, name, admin, created_at) "
+                "VALUES(?,?,?,?,?)",
+                (uid, email, name, int(admin), time.time()),
+            )
+            self._conn.commit()
+        return User(id=uid, email=email, name=name, admin=admin)
+
+    def get_user(self, uid: str) -> Optional[User]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, email, name, admin FROM users WHERE id=? OR email=?",
+                (uid, uid),
+            ).fetchone()
+        if not row:
+            return None
+        return User(id=row[0], email=row[1] or "", name=row[2] or "",
+                    admin=bool(row[3]))
+
+    # -- api keys ------------------------------------------------------------
+    @staticmethod
+    def _hash_key(key: str) -> str:
+        return hashlib.sha256(key.encode()).hexdigest()
+
+    def create_api_key(self, user_id: str, name: str = "default") -> str:
+        key = f"hl-{pysecrets.token_urlsafe(32)}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO auth_keys(key_hash, user_id, name, created_at) "
+                "VALUES(?,?,?,?)",
+                (self._hash_key(key), user_id, name, time.time()),
+            )
+            self._conn.commit()
+        return key
+
+    def authenticate(self, bearer: Optional[str]) -> Optional[User]:
+        """'Bearer hl-...' or raw key -> User."""
+        if not bearer:
+            return None
+        key = bearer.split(" ", 1)[1] if bearer.lower().startswith("bearer ") else bearer
+        h = self._hash_key(key)
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT user_id FROM auth_keys WHERE key_hash=?", (h,)
+            ).fetchone()
+            if not row:
+                return None
+            self._conn.execute(
+                "UPDATE auth_keys SET last_used=? WHERE key_hash=?",
+                (time.time(), h),
+            )
+            self._conn.commit()
+        return self.get_user(row[0])
+
+    def revoke_api_key(self, key: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM auth_keys WHERE key_hash=?",
+                (self._hash_key(key),),
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    # -- orgs / RBAC ---------------------------------------------------------
+    def create_org(self, name: str, owner_id: str) -> str:
+        oid = f"org_{uuid.uuid4().hex[:16]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO orgs(id, name, created_at) VALUES(?,?,?)",
+                (oid, name, time.time()),
+            )
+            self._conn.execute(
+                "INSERT INTO org_members(org_id, user_id, role) VALUES(?,?,?)",
+                (oid, owner_id, "owner"),
+            )
+            self._conn.commit()
+        return oid
+
+    def add_member(self, org_id: str, user_id: str, role: str = "member"):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}")
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO org_members(org_id, user_id, role) VALUES(?,?,?) "
+                "ON CONFLICT(org_id, user_id) DO UPDATE SET role=excluded.role",
+                (org_id, user_id, role),
+            )
+            self._conn.commit()
+
+    def remove_member(self, org_id: str, user_id: str):
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM org_members WHERE org_id=? AND user_id=?",
+                (org_id, user_id),
+            )
+            self._conn.commit()
+
+    def member_role(self, org_id: str, user_id: str) -> Optional[str]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT role FROM org_members WHERE org_id=? AND user_id=?",
+                (org_id, user_id),
+            ).fetchone()
+        return row[0] if row else None
+
+    def org_members(self, org_id: str) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT user_id, role FROM org_members WHERE org_id=?",
+                (org_id,),
+            ).fetchall()
+        return [{"user_id": r[0], "role": r[1]} for r in rows]
+
+    def list_orgs(self, user_id: Optional[str] = None) -> list:
+        q = "SELECT o.id, o.name FROM orgs o"
+        args: tuple = ()
+        if user_id:
+            q += (
+                " JOIN org_members m ON m.org_id = o.id WHERE m.user_id=?"
+            )
+            args = (user_id,)
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [{"id": r[0], "name": r[1]} for r in rows]
+
+    def authorize(
+        self,
+        user: Optional[User],
+        *,
+        resource_owner: str = "",
+        org_id: str = "",
+        min_role: str = "member",
+    ) -> bool:
+        """Owner, sufficient org role, or platform admin."""
+        if user is None:
+            return False
+        if user.admin or (resource_owner and resource_owner == user.id):
+            return True
+        if org_id:
+            role = self.member_role(org_id, user.id)
+            if role is None:
+                return False
+            return ROLES.index(role) <= ROLES.index(min_role)
+        return False
+
+    # -- secrets ---------------------------------------------------------------
+    def set_secret(self, owner: str, name: str, value: str) -> str:
+        sid = f"sec_{uuid.uuid4().hex[:12]}"
+        ct = self._fernet.encrypt(value.encode())
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO secrets(id, owner, name, ciphertext, created_at) "
+                "VALUES(?,?,?,?,?) ON CONFLICT(owner, name) DO UPDATE SET "
+                "ciphertext=excluded.ciphertext",
+                (sid, owner, name, ct, time.time()),
+            )
+            self._conn.commit()
+        return sid
+
+    def get_secret(self, owner: str, name: str) -> Optional[str]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT ciphertext FROM secrets WHERE owner=? AND name=?",
+                (owner, name),
+            ).fetchone()
+        if not row:
+            return None
+        return self._fernet.decrypt(row[0]).decode()
+
+    def list_secrets(self, owner: str) -> list:
+        """Names only — values never leave the envelope via list."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT name, created_at FROM secrets WHERE owner=?",
+                (owner,),
+            ).fetchall()
+        return [{"name": r[0], "created_at": r[1]} for r in rows]
+
+    def delete_secret(self, owner: str, name: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM secrets WHERE owner=? AND name=?", (owner, name)
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    def substitute_secrets(self, owner: str, text: str) -> str:
+        """Replace ``${secrets.NAME}`` placeholders (app-config injection,
+        reference: ``controller/inference.go:997``)."""
+        import re
+
+        def repl(m):
+            v = self.get_secret(owner, m.group(1))
+            return v if v is not None else m.group(0)
+
+        return re.sub(r"\$\{secrets\.([A-Za-z0-9_\-]+)\}", repl, text)

@@ -1,0 +1,201 @@
+"""Billing, quotas, and token pricing.
+
+Mirrors the reference's wallet/transaction ledger + per-tier quotas + token
+pricing tables (``api/pkg/stripe`` Wallet/TopUp, ``api/pkg/quota``,
+``api/pkg/pricing``), minus the Stripe webhook surface (a payment provider
+is a deployment integration; the ledger and enforcement are the product
+logic and live here):
+
+- wallets with atomic debit/credit and a transactions ledger;
+- a pricing table ($/1M tokens, prompt+completion split) with a default
+  rate for unknown models;
+- per-user daily token quotas by tier, checked before inference and
+  consumed after (free tier gets a hard cap, paid tiers scale).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS wallets (
+    owner TEXT PRIMARY KEY,
+    balance_microusd INTEGER NOT NULL DEFAULT 0,
+    tier TEXT NOT NULL DEFAULT 'free',
+    updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS transactions (
+    id TEXT PRIMARY KEY,
+    owner TEXT NOT NULL,
+    amount_microusd INTEGER NOT NULL,   -- positive credit, negative debit
+    kind TEXT NOT NULL,                 -- topup | usage | adjustment
+    meta TEXT DEFAULT '',
+    created_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_tx_owner ON transactions(owner, created_at);
+"""
+
+# $/1M tokens (prompt, completion) — mirrors the reference's pricing tables
+PRICING = {
+    "default": (0.20, 0.60),
+    "meta-llama/Meta-Llama-3-8B-Instruct": (0.10, 0.30),
+    "microsoft/Phi-3-mini-4k-instruct": (0.05, 0.15),
+    "Qwen/Qwen2-VL-7B-Instruct": (0.20, 0.60),
+}
+
+TIER_DAILY_TOKENS = {
+    "free": 200_000,
+    "pro": 5_000_000,
+    "enterprise": None,   # unlimited
+}
+
+
+class QuotaExceeded(Exception):
+    pass
+
+
+class InsufficientFunds(Exception):
+    pass
+
+
+def price_microusd(model: str, prompt_tokens: int, completion_tokens: int) -> int:
+    p, c = PRICING.get(model, PRICING["default"])
+    usd = (prompt_tokens * p + completion_tokens * c) / 1_000_000
+    return int(usd * 1_000_000)
+
+
+class BillingService:
+    def __init__(self, db_path: str = ":memory:", usage_store=None):
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+        self.usage_store = usage_store   # Store, for daily-quota sums
+        # in-memory daily counters (rebuilt lazily; store is source of truth)
+        self._daily: dict[str, tuple] = {}
+
+    # -- wallets -------------------------------------------------------------
+    def wallet(self, owner: str) -> dict:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT balance_microusd, tier FROM wallets WHERE owner=?",
+                (owner,),
+            ).fetchone()
+        if row is None:
+            return {"owner": owner, "balance_usd": 0.0, "tier": "free"}
+        return {
+            "owner": owner,
+            "balance_usd": row[0] / 1_000_000,
+            "tier": row[1],
+        }
+
+    def set_tier(self, owner: str, tier: str):
+        if tier not in TIER_DAILY_TOKENS:
+            raise ValueError(f"unknown tier {tier}")
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO wallets(owner, balance_microusd, tier, "
+                "updated_at) VALUES(?,0,?,?) ON CONFLICT(owner) DO UPDATE "
+                "SET tier=excluded.tier, updated_at=excluded.updated_at",
+                (owner, tier, time.time()),
+            )
+            self._conn.commit()
+
+    def _tx(self, owner: str, amount: int, kind: str, meta: str = ""):
+        self._conn.execute(
+            "INSERT INTO transactions(id, owner, amount_microusd, kind, "
+            "meta, created_at) VALUES(?,?,?,?,?,?)",
+            (
+                f"tx_{uuid.uuid4().hex[:16]}", owner, amount, kind, meta,
+                time.time(),
+            ),
+        )
+
+    def topup(self, owner: str, usd: float) -> dict:
+        amount = int(usd * 1_000_000)
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO wallets(owner, balance_microusd, tier, "
+                "updated_at) VALUES(?,?, 'free', ?) ON CONFLICT(owner) DO "
+                "UPDATE SET balance_microusd = balance_microusd + ?, "
+                "updated_at=?",
+                (owner, amount, time.time(), amount, time.time()),
+            )
+            self._tx(owner, amount, "topup")
+            self._conn.commit()
+        return self.wallet(owner)
+
+    def charge_usage(
+        self, owner: str, model: str, prompt_tokens: int,
+        completion_tokens: int, require_funds: bool = False,
+    ) -> int:
+        """Debit the wallet for an exchange; returns micro-usd charged."""
+        cost = price_microusd(model, prompt_tokens, completion_tokens)
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT balance_microusd FROM wallets WHERE owner=?",
+                (owner,),
+            ).fetchone()
+            balance = row[0] if row else 0
+            if require_funds and balance < cost:
+                raise InsufficientFunds(
+                    f"balance {balance / 1e6:.4f} USD < cost {cost / 1e6:.4f}"
+                )
+            self._conn.execute(
+                "INSERT INTO wallets(owner, balance_microusd, tier, "
+                "updated_at) VALUES(?, ?, 'free', ?) ON CONFLICT(owner) DO "
+                "UPDATE SET balance_microusd = balance_microusd - ?, "
+                "updated_at=?",
+                (owner, -cost, time.time(), cost, time.time()),
+            )
+            self._tx(
+                owner, -cost, "usage",
+                f"{model}:{prompt_tokens}+{completion_tokens}",
+            )
+            self._conn.commit()
+        return cost
+
+    def transactions(self, owner: str, limit: int = 50) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, amount_microusd, kind, meta, created_at FROM "
+                "transactions WHERE owner=? ORDER BY created_at DESC LIMIT ?",
+                (owner, limit),
+            ).fetchall()
+        return [
+            {
+                "id": r[0], "amount_usd": r[1] / 1e6, "kind": r[2],
+                "meta": r[3], "created_at": r[4],
+            }
+            for r in rows
+        ]
+
+    # -- quotas ----------------------------------------------------------------
+    def check_quota(self, owner: str, want_tokens: int = 0) -> None:
+        """Raise QuotaExceeded if the user is over their daily tier cap."""
+        tier = self.wallet(owner)["tier"]
+        cap = TIER_DAILY_TOKENS.get(tier)
+        if cap is None:
+            return
+        day = int(time.time() // 86400)
+        used_day, used = self._daily.get(owner, (day, 0))
+        if used_day != day:
+            used = 0
+        if used + want_tokens > cap:
+            raise QuotaExceeded(
+                f"daily token quota exceeded for tier '{tier}' "
+                f"({used}/{cap})"
+            )
+
+    def consume_quota(self, owner: str, tokens: int) -> None:
+        day = int(time.time() // 86400)
+        used_day, used = self._daily.get(owner, (day, 0))
+        if used_day != day:
+            used = 0
+        self._daily[owner] = (day, used + tokens)

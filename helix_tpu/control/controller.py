@@ -78,10 +78,14 @@ class SessionController:
         store: Store,
         providers: ProviderManager,
         knowledge=None,            # KnowledgeManager
+        secrets=None,              # Authenticator (for ${secrets.X} substitution)
+        billing=None,              # BillingService (quota + wallet debits)
     ):
         self.store = store
         self.providers = providers
         self.knowledge = knowledge
+        self.secrets = secrets
+        self.billing = billing
 
     # ------------------------------------------------------------------
     def _assistant_for(self, app_id: Optional[str], assistant: str = ""):
@@ -162,6 +166,15 @@ class SessionController:
     ) -> dict:
         """Blocking chat (``RunBlockingSession`` / ``ChatCompletion``)."""
         assistant = self._assistant_for(app_id, assistant_name)
+        if self.secrets is not None and assistant.system_prompt:
+            assistant = dataclasses.replace(
+                assistant,
+                system_prompt=self.secrets.substitute_secrets(
+                    user, assistant.system_prompt
+                ),
+            )
+        if self.billing is not None:
+            self.billing.check_quota(user)
         if assistant.agent_mode:
             return await self._run_agent(
                 assistant, messages, user=user, session_id=session_id,
@@ -179,6 +192,15 @@ class SessionController:
             user, session_id, model, provider, body, resp,
             int((time.monotonic() - t0) * 1000), messages,
         )
+        if self.billing is not None:
+            usage = resp.get("usage", {}) or {}
+            total = int(usage.get("total_tokens", 0))
+            self.billing.consume_quota(user, total)
+            self.billing.charge_usage(
+                user, model,
+                int(usage.get("prompt_tokens", 0)),
+                int(usage.get("completion_tokens", 0)),
+            )
         return resp
 
     async def _run_agent(

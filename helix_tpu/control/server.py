@@ -37,7 +37,12 @@ def _err(status, message, **extra):
 
 
 class ControlPlane:
-    def __init__(self, db_path: str = ":memory:", embed_fn=None):
+    def __init__(
+        self, db_path: str = ":memory:", embed_fn=None,
+        auth_required: bool = False,
+    ):
+        from helix_tpu.control.auth import Authenticator
+        from helix_tpu.control.billing import BillingService
         from helix_tpu.control.controller import SessionController
         from helix_tpu.control.providers import ProviderManager
         from helix_tpu.knowledge.embed import HashEmbedder, RemoteEmbedder
@@ -46,6 +51,13 @@ class ControlPlane:
 
         self.store = Store(db_path)
         self.router = InferenceRouter()
+        auth_path = ":memory:" if db_path == ":memory:" else db_path + ".auth"
+        self.auth = Authenticator(auth_path)
+        bill_path = (
+            ":memory:" if db_path == ":memory:" else db_path + ".billing"
+        )
+        self.billing = BillingService(bill_path, usage_store=None)
+        self.auth_required = auth_required
         self.providers = ProviderManager.from_env(self.router)
         vec_path = (
             ":memory:" if db_path == ":memory:" else db_path + ".vectors"
@@ -70,7 +82,8 @@ class ControlPlane:
 
         self.knowledge = KnowledgeManager(self.vectors, embed_fn).start()
         self.controller = SessionController(
-            self.store, self.providers, self.knowledge
+            self.store, self.providers, self.knowledge,
+            secrets=self.auth, billing=self.billing,
         )
 
     def _pick_embed_model(self):
@@ -87,8 +100,28 @@ class ControlPlane:
         return t[1] if t else None
 
     # ------------------------------------------------------------------
+    @web.middleware
+    async def auth_middleware(self, request, handler):
+        """Resolve the bearer key to a user; enforce when auth_required.
+        Runner control-loop endpoints stay open (nodes authenticate by
+        runner id + network position, like the reference's heartbeats)."""
+        user = self.auth.authenticate(request.headers.get("Authorization"))
+        request["user"] = user
+        open_paths = ("/healthz", "/metrics", "/api/v1/runners")
+        if (
+            self.auth_required
+            and user is None
+            and not request.path.startswith(open_paths)
+        ):
+            return _err(401, "authentication required")
+        return await handler(request)
+
+    def _user_id(self, request) -> str:
+        u = request.get("user")
+        return u.id if u else request.query.get("owner", "anonymous")
+
     def build_app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(middlewares=[self.auth_middleware])
         r = app.router
         r.add_get("/healthz", self.healthz)
         # runner control loop
@@ -122,6 +155,21 @@ class ControlPlane:
         r.add_post("/api/v1/knowledge/{id}/search", self.search_knowledge)
         # usage
         r.add_get("/api/v1/usage", self.usage)
+        # auth: users / keys / orgs / secrets
+        r.add_post("/api/v1/users", self.create_user)
+        r.add_post("/api/v1/users/{id}/keys", self.create_key)
+        r.add_post("/api/v1/orgs", self.create_org)
+        r.add_get("/api/v1/orgs", self.list_orgs)
+        r.add_post("/api/v1/orgs/{id}/members", self.add_member)
+        r.add_get("/api/v1/orgs/{id}/members", self.list_members)
+        r.add_delete("/api/v1/orgs/{id}/members/{user}", self.remove_member)
+        r.add_get("/api/v1/secrets", self.list_secrets)
+        r.add_post("/api/v1/secrets", self.set_secret)
+        r.add_delete("/api/v1/secrets/{name}", self.delete_secret)
+        # billing
+        r.add_get("/api/v1/wallet", self.get_wallet)
+        r.add_post("/api/v1/wallet/topup", self.topup)
+        r.add_get("/api/v1/wallet/transactions", self.list_transactions)
         # openai passthrough
         r.add_get("/v1/models", self.models)
         for route in ("/v1/chat/completions", "/v1/completions", "/v1/embeddings"):
@@ -400,6 +448,96 @@ class ControlPlane:
     async def usage(self, request):
         return web.json_response(
             {"usage": self.store.usage_summary(request.query.get("owner"))}
+        )
+
+    # -- auth / orgs / secrets ------------------------------------------------
+    async def create_user(self, request):
+        body = await request.json()
+        u = self.auth.create_user(
+            email=body.get("email", ""),
+            name=body.get("name", ""),
+            admin=bool(body.get("admin")),
+        )
+        key = self.auth.create_api_key(u.id)
+        return web.json_response({"id": u.id, "api_key": key})
+
+    async def create_key(self, request):
+        uid = request.match_info["id"]
+        if self.auth.get_user(uid) is None:
+            return _err(404, "user not found")
+        body = await request.json()
+        key = self.auth.create_api_key(uid, body.get("name", "default"))
+        return web.json_response({"api_key": key})
+
+    async def create_org(self, request):
+        body = await request.json()
+        owner = self._user_id(request)
+        oid = self.auth.create_org(body["name"], owner)
+        return web.json_response({"id": oid})
+
+    async def list_orgs(self, request):
+        return web.json_response(
+            {"orgs": self.auth.list_orgs(request.query.get("user"))}
+        )
+
+    async def add_member(self, request):
+        oid = request.match_info["id"]
+        user = request.get("user")
+        if self.auth_required and not self.auth.authorize(
+            user, org_id=oid, min_role="admin"
+        ):
+            return _err(403, "admin role required")
+        body = await request.json()
+        try:
+            self.auth.add_member(oid, body["user_id"], body.get("role", "member"))
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response({"ok": True})
+
+    async def list_members(self, request):
+        return web.json_response(
+            {"members": self.auth.org_members(request.match_info["id"])}
+        )
+
+    async def remove_member(self, request):
+        self.auth.remove_member(
+            request.match_info["id"], request.match_info["user"]
+        )
+        return web.json_response({"ok": True})
+
+    async def list_secrets(self, request):
+        owner = self._user_id(request)
+        return web.json_response({"secrets": self.auth.list_secrets(owner)})
+
+    async def set_secret(self, request):
+        body = await request.json()
+        owner = self._user_id(request)
+        self.auth.set_secret(owner, body["name"], body["value"])
+        return web.json_response({"ok": True, "name": body["name"]})
+
+    async def delete_secret(self, request):
+        ok = self.auth.delete_secret(
+            self._user_id(request), request.match_info["name"]
+        )
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    # -- billing --------------------------------------------------------------
+    async def get_wallet(self, request):
+        return web.json_response(self.billing.wallet(self._user_id(request)))
+
+    async def topup(self, request):
+        body = await request.json()
+        return web.json_response(
+            self.billing.topup(self._user_id(request), float(body["usd"]))
+        )
+
+    async def list_transactions(self, request):
+        return web.json_response(
+            {
+                "transactions": self.billing.transactions(
+                    self._user_id(request)
+                )
+            }
         )
 
     # -- openai passthrough ---------------------------------------------------

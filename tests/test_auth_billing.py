@@ -1,0 +1,101 @@
+"""Auth/RBAC/orgs/secrets + billing/quota tests."""
+
+import pytest
+
+from helix_tpu.control.auth import Authenticator
+from helix_tpu.control.billing import (
+    BillingService,
+    InsufficientFunds,
+    QuotaExceeded,
+    price_microusd,
+)
+
+
+class TestAuth:
+    def test_api_key_lifecycle(self):
+        a = Authenticator()
+        u = a.create_user("x@y.com", "X")
+        key = a.create_api_key(u.id)
+        assert key.startswith("hl-")
+        got = a.authenticate(f"Bearer {key}")
+        assert got and got.id == u.id
+        assert a.authenticate("Bearer hl-wrong") is None
+        assert a.revoke_api_key(key)
+        assert a.authenticate(key) is None
+
+    def test_org_rbac(self):
+        a = Authenticator()
+        owner = a.create_user("o@x.com")
+        member = a.create_user("m@x.com")
+        outsider = a.create_user("z@x.com")
+        oid = a.create_org("acme", owner.id)
+        a.add_member(oid, member.id, "member")
+        assert a.member_role(oid, owner.id) == "owner"
+        # owner passes admin bar, member does not, outsider nothing
+        assert a.authorize(owner, org_id=oid, min_role="admin")
+        assert not a.authorize(member, org_id=oid, min_role="admin")
+        assert a.authorize(member, org_id=oid, min_role="member")
+        assert not a.authorize(outsider, org_id=oid, min_role="member")
+        # platform admin bypasses
+        root = a.create_user("r@x.com", admin=True)
+        assert a.authorize(root, org_id=oid, min_role="admin")
+
+    def test_resource_owner(self):
+        a = Authenticator()
+        u = a.create_user("u@x.com")
+        v = a.create_user("v@x.com")
+        assert a.authorize(u, resource_owner=u.id)
+        assert not a.authorize(v, resource_owner=u.id)
+
+    def test_secrets_roundtrip_and_substitution(self):
+        a = Authenticator()
+        a.set_secret("u1", "API_TOKEN", "s3cr3t")
+        assert a.get_secret("u1", "API_TOKEN") == "s3cr3t"
+        assert a.get_secret("u2", "API_TOKEN") is None
+        # list never exposes values
+        listed = a.list_secrets("u1")
+        assert listed[0]["name"] == "API_TOKEN"
+        assert "s3cr3t" not in str(listed)
+        out = a.substitute_secrets(
+            "u1", "header: ${secrets.API_TOKEN} and ${secrets.MISSING}"
+        )
+        assert out == "header: s3cr3t and ${secrets.MISSING}"
+
+    def test_secret_encrypted_at_rest(self, tmp_path):
+        db = str(tmp_path / "auth.db")
+        a = Authenticator(db)
+        a.set_secret("u1", "K", "topsecretvalue")
+        raw = open(db, "rb").read()
+        assert b"topsecretvalue" not in raw
+
+
+class TestBilling:
+    def test_pricing(self):
+        cost = price_microusd("default-model", 1_000_000, 1_000_000)
+        assert cost == int(0.8 * 1_000_000)
+
+    def test_wallet_ledger(self):
+        b = BillingService()
+        b.topup("u1", 10.0)
+        assert b.wallet("u1")["balance_usd"] == pytest.approx(10.0)
+        charged = b.charge_usage("u1", "m", 500_000, 100_000)
+        assert charged > 0
+        w = b.wallet("u1")
+        assert w["balance_usd"] < 10.0
+        tx = b.transactions("u1")
+        assert [t["kind"] for t in tx] == ["usage", "topup"]
+
+    def test_require_funds(self):
+        b = BillingService()
+        with pytest.raises(InsufficientFunds):
+            b.charge_usage("poor", "m", 10_000_000, 0, require_funds=True)
+
+    def test_quota_tiers(self):
+        b = BillingService()
+        b.check_quota("u1")                  # free tier, nothing used
+        b.consume_quota("u1", 150_000)
+        b.check_quota("u1", want_tokens=10_000)
+        with pytest.raises(QuotaExceeded):
+            b.check_quota("u1", want_tokens=100_000)
+        b.set_tier("u1", "enterprise")
+        b.check_quota("u1", want_tokens=10**9)  # unlimited
