@@ -36,6 +36,12 @@ def _err(status, message, **extra):
     )
 
 
+def tempfile_dir() -> str:
+    import tempfile
+
+    return tempfile.mkdtemp(prefix="helix-git-")
+
+
 class ControlPlane:
     def __init__(
         self, db_path: str = ":memory:", embed_fn=None,
@@ -85,6 +91,47 @@ class ControlPlane:
             self.store, self.providers, self.knowledge,
             secrets=self.auth, billing=self.billing,
         )
+
+        # spec-task pipeline: internal git hosting + orchestrator whose
+        # agents run through the provider manager (TPU-served or external)
+        import os as _os
+
+        from helix_tpu.services.git_service import GitService
+        from helix_tpu.services.spec_tasks import (
+            AgentExecutor,
+            SpecTaskOrchestrator,
+            TaskStore,
+        )
+
+        git_root = (
+            tempfile_dir()
+            if db_path == ":memory:"
+            else _os.path.join(_os.path.dirname(_os.path.abspath(db_path)) or ".",
+                               "helix-git")
+        )
+        self.git = GitService(git_root)
+        self.task_store = TaskStore(
+            ":memory:" if db_path == ":memory:" else db_path + ".tasks"
+        )
+
+        class _ProviderLLM:
+            """Resolve per call so agents follow provider availability."""
+
+            def __init__(self, providers, model=""):
+                self.providers = providers
+                self.model = model
+
+            async def chat(self, body):
+                client, model = self.providers.resolve(
+                    body.get("model") or self.model
+                )
+                return await client.chat({**body, "model": model})
+
+        self.orchestrator = SpecTaskOrchestrator(
+            self.task_store,
+            self.git,
+            AgentExecutor(_ProviderLLM(self.providers)),
+        ).start()
 
     def _pick_embed_model(self):
         for st in self.router.runners():
@@ -170,6 +217,17 @@ class ControlPlane:
         r.add_get("/api/v1/wallet", self.get_wallet)
         r.add_post("/api/v1/wallet/topup", self.topup)
         r.add_get("/api/v1/wallet/transactions", self.list_transactions)
+        # spec tasks + internal git hosting
+        r.add_get("/api/v1/spec-tasks", self.list_spec_tasks)
+        r.add_post("/api/v1/spec-tasks", self.create_spec_task)
+        r.add_get("/api/v1/spec-tasks/{id}", self.get_spec_task)
+        r.add_post("/api/v1/spec-tasks/{id}/review", self.review_spec_task)
+        r.add_get("/api/v1/pull-requests", self.list_prs)
+        r.add_get("/api/v1/pull-requests/{id}/diff", self.get_pr_diff)
+        r.add_post("/api/v1/pull-requests/{id}/merge", self.merge_pr)
+        r.add_get("/api/v1/repos", self.list_repos)
+        r.add_get("/git/{repo}/info/refs", self.git_info_refs)
+        r.add_post("/git/{repo}/{service}", self.git_rpc)
         # openai passthrough
         r.add_get("/v1/models", self.models)
         for route in ("/v1/chat/completions", "/v1/completions", "/v1/embeddings"):
@@ -538,6 +596,106 @@ class ControlPlane:
                     self._user_id(request)
                 )
             }
+        )
+
+    # -- spec tasks -----------------------------------------------------------
+    async def list_spec_tasks(self, request):
+        tasks = self.task_store.list_tasks(
+            project=request.query.get("project"),
+            status=request.query.get("status"),
+        )
+        return web.json_response({"tasks": [t.to_dict() for t in tasks]})
+
+    async def create_spec_task(self, request):
+        body = await request.json()
+        t = self.task_store.create_task(
+            project=body.get("project", "default"),
+            title=body["title"],
+            description=body.get("description", ""),
+        )
+        return web.json_response(t.to_dict())
+
+    async def get_spec_task(self, request):
+        t = self.task_store.get_task(request.match_info["id"])
+        if t is None:
+            return _err(404, "task not found")
+        doc = t.to_dict()
+        doc["reviews"] = self.task_store.reviews(t.id)
+        return web.json_response(doc)
+
+    async def review_spec_task(self, request):
+        body = await request.json()
+        try:
+            t = self.orchestrator.review_spec(
+                request.match_info["id"],
+                author=self._user_id(request),
+                decision=body.get("decision", "comment"),
+                comment=body.get("comment", ""),
+            )
+        except KeyError:
+            return _err(404, "task not found")
+        except ValueError as e:
+            return _err(409, str(e))
+        return web.json_response(t.to_dict())
+
+    async def list_prs(self, request):
+        return web.json_response(
+            {
+                "pull_requests": self.task_store.list_prs(
+                    project=request.query.get("project"),
+                    status=request.query.get("status"),
+                )
+            }
+        )
+
+    async def get_pr_diff(self, request):
+        try:
+            diff = self.orchestrator.pr_diff(request.match_info["id"])
+        except KeyError:
+            return _err(404, "PR not found")
+        return web.Response(text=diff, content_type="text/plain")
+
+    async def merge_pr(self, request):
+        try:
+            pr = await __import__("asyncio").get_running_loop().run_in_executor(
+                None, self.orchestrator.merge_pr, request.match_info["id"]
+            )
+        except KeyError:
+            return _err(404, "PR not found")
+        except ValueError as e:
+            return _err(409, str(e))
+        return web.json_response(pr)
+
+    async def list_repos(self, request):
+        return web.json_response({"repos": self.git.list_repos()})
+
+    # -- git smart HTTP --------------------------------------------------------
+    async def git_info_refs(self, request):
+        repo = request.match_info["repo"]
+        service = request.query.get("service", "")
+        if service not in ("git-upload-pack", "git-receive-pack"):
+            return _err(400, "unsupported service")
+        if not self.git.repo_exists(repo):
+            return _err(404, "repo not found")
+        data = self.git.info_refs(repo, service)
+        return web.Response(
+            body=data,
+            content_type=f"application/x-{service}-advertisement",
+        )
+
+    async def git_rpc(self, request):
+        repo = request.match_info["repo"]
+        service = request.match_info["service"]
+        if service not in ("git-upload-pack", "git-receive-pack"):
+            return _err(400, "unsupported service")
+        if not self.git.repo_exists(repo):
+            return _err(404, "repo not found")
+        body = await request.read()
+        data = await __import__("asyncio").get_running_loop().run_in_executor(
+            None, self.git.service_rpc, repo, service, body
+        )
+        return web.Response(
+            body=data, content_type=f"application/x-{service}-result"
         )
 
     # -- openai passthrough ---------------------------------------------------

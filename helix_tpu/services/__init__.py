@@ -1,0 +1,17 @@
+from helix_tpu.services.git_service import GitService
+from helix_tpu.services.spec_tasks import (
+    AgentExecutor,
+    Executor,
+    SpecTask,
+    SpecTaskOrchestrator,
+    TaskStore,
+)
+
+__all__ = [
+    "GitService",
+    "AgentExecutor",
+    "Executor",
+    "SpecTask",
+    "SpecTaskOrchestrator",
+    "TaskStore",
+]

@@ -1,0 +1,186 @@
+"""Internal git hosting service.
+
+Mirrors the reference's git service inside ``api/pkg/services``
+(``git_http_server.go`` + ``git_repository_service*.go``): bare repositories
+owned by the control plane, smart-HTTP protocol for real ``git clone/push``
+from agent workspaces, branch/diff/log/merge primitives used by the
+spec-task pipeline.  Implementation shells out to the system git (the
+reference does the same on the sandbox side); the smart-HTTP endpoints call
+``upload-pack``/``receive-pack --stateless-rpc`` exactly as git's own
+http-backend does.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import tempfile
+import threading
+import time
+import uuid
+from typing import Optional
+
+
+class GitError(RuntimeError):
+    pass
+
+
+def _run(args, cwd=None, input_bytes=None, check=True) -> bytes:
+    p = subprocess.run(
+        args, cwd=cwd, input=input_bytes,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    if check and p.returncode != 0:
+        raise GitError(
+            f"{' '.join(args)} failed ({p.returncode}): "
+            f"{p.stderr.decode(errors='replace')[:500]}"
+        )
+    return p.stdout
+
+
+class GitService:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    # -- repositories --------------------------------------------------------
+    def _repo_path(self, name: str) -> str:
+        safe = name.replace("/", "_")
+        return os.path.join(self.root, f"{safe}.git")
+
+    def create_repo(self, name: str, default_branch: str = "main") -> str:
+        path = self._repo_path(name)
+        if os.path.exists(path):
+            raise GitError(f"repo '{name}' already exists")
+        _run(["git", "init", "--bare", "-b", default_branch, path])
+        # seed an empty initial commit so clones have a HEAD
+        with tempfile.TemporaryDirectory() as tmp:
+            _run(["git", "clone", "-q", path, tmp])
+            _run(["git", "-C", tmp, "config", "user.email", "helix@local"])
+            _run(["git", "-C", tmp, "config", "user.name", "helix"])
+            readme = os.path.join(tmp, "README.md")
+            with open(readme, "w") as f:
+                f.write(f"# {name}\n")
+            _run(["git", "-C", tmp, "add", "-A"])
+            _run(["git", "-C", tmp, "commit", "-q", "-m", "initial commit"])
+            _run(["git", "-C", tmp, "push", "-q", "origin", default_branch])
+        return path
+
+    def repo_exists(self, name: str) -> bool:
+        return os.path.isdir(self._repo_path(name))
+
+    def list_repos(self) -> list:
+        return sorted(
+            d[:-4] for d in os.listdir(self.root) if d.endswith(".git")
+        )
+
+    def delete_repo(self, name: str) -> None:
+        import shutil
+
+        shutil.rmtree(self._repo_path(name), ignore_errors=True)
+
+    # -- workspace operations -------------------------------------------------
+    def clone_workspace(
+        self, name: str, dest: str, branch: Optional[str] = None
+    ) -> str:
+        args = ["git", "clone", "-q"]
+        if branch:
+            args += ["-b", branch]
+        args += [self._repo_path(name), dest]
+        _run(args)
+        _run(["git", "-C", dest, "config", "user.email", "agent@helix.local"])
+        _run(["git", "-C", dest, "config", "user.name", "helix-agent"])
+        return dest
+
+    def commit_and_push(
+        self, workspace: str, message: str, branch: str
+    ) -> Optional[str]:
+        """Commit all changes and push to ``branch``; returns commit sha or
+        None when the tree is clean."""
+        _run(["git", "-C", workspace, "add", "-A"])
+        status = _run(["git", "-C", workspace, "status", "--porcelain"])
+        if not status.strip():
+            return None
+        _run(["git", "-C", workspace, "commit", "-q", "-m", message])
+        sha = _run(["git", "-C", workspace, "rev-parse", "HEAD"]).decode().strip()
+        _run(["git", "-C", workspace, "push", "-q", "-f", "origin",
+              f"HEAD:{branch}"])
+        return sha
+
+    # -- repo queries ----------------------------------------------------------
+    def branches(self, name: str) -> list:
+        out = _run(
+            ["git", "-C", self._repo_path(name), "for-each-ref",
+             "--format=%(refname:short)", "refs/heads"]
+        )
+        return sorted(out.decode().split())
+
+    def log(self, name: str, branch: str = "main", limit: int = 20) -> list:
+        try:
+            out = _run(
+                ["git", "-C", self._repo_path(name), "log",
+                 f"--max-count={limit}", "--format=%H%x00%an%x00%at%x00%s",
+                 branch],
+            )
+        except GitError:
+            return []
+        entries = []
+        for line in out.decode().splitlines():
+            sha, author, at, subject = line.split("\x00")
+            entries.append(
+                {"sha": sha, "author": author, "time": int(at),
+                 "subject": subject}
+            )
+        return entries
+
+    def diff(self, name: str, base: str, head: str) -> str:
+        out = _run(
+            ["git", "-C", self._repo_path(name), "diff",
+             f"{base}...{head}"],
+        )
+        return out.decode(errors="replace")
+
+    def file_at(self, name: str, branch: str, path: str) -> Optional[str]:
+        try:
+            out = _run(
+                ["git", "-C", self._repo_path(name), "show",
+                 f"{branch}:{path}"],
+            )
+        except GitError:
+            return None
+        return out.decode(errors="replace")
+
+    def merge(self, name: str, base: str, head: str, message: str) -> str:
+        """Merge ``head`` into ``base`` (no-ff) inside a scratch clone;
+        returns the merge commit sha."""
+        with self._lock, tempfile.TemporaryDirectory() as tmp:
+            _run(["git", "clone", "-q", "-b", base, self._repo_path(name), tmp])
+            _run(["git", "-C", tmp, "config", "user.email", "helix@local"])
+            _run(["git", "-C", tmp, "config", "user.name", "helix"])
+            _run(["git", "-C", tmp, "fetch", "-q", "origin", head])
+            _run(["git", "-C", tmp, "merge", "--no-ff", "-q", "-m", message,
+                  "FETCH_HEAD"])
+            sha = _run(["git", "-C", tmp, "rev-parse", "HEAD"]).decode().strip()
+            _run(["git", "-C", tmp, "push", "-q", "origin", f"HEAD:{base}"])
+        return sha
+
+    # -- smart HTTP (git clone/push against the control plane) -----------------
+    def info_refs(self, name: str, service: str) -> bytes:
+        """GET /git/{name}/info/refs?service=git-upload-pack|git-receive-pack"""
+        cmd = service.replace("git-", "")
+        head = f"# service={service}\n"
+        pkt = f"{len(head) + 4:04x}{head}0000".encode()
+        out = _run(
+            ["git", cmd, "--stateless-rpc", "--advertise-refs",
+             self._repo_path(name)]
+        )
+        return pkt + out
+
+    def service_rpc(self, name: str, service: str, body: bytes) -> bytes:
+        """POST /git/{name}/git-upload-pack | git-receive-pack"""
+        cmd = service.replace("git-", "")
+        return _run(
+            ["git", cmd, "--stateless-rpc", self._repo_path(name)],
+            input_bytes=body,
+        )

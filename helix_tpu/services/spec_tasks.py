@@ -1,0 +1,490 @@
+"""Spec-driven task orchestration: the Kanban engine.
+
+Mirrors the reference's headline feature (``api/pkg/services/
+spec_task_orchestrator.go:299-330,605-912``): tasks flow
+backlog -> planning -> spec_review -> (revision loops) -> implementing ->
+pr_review -> done, driven by a polling orchestration loop; a planning agent
+writes a spec to a ``helix-specs`` branch of the project's internal repo,
+human design review gates implementation, an implementation agent codes on
+a task branch, and an internal pull request (diff + review + merge) closes
+the loop.  Agent execution is pluggable (``Executor``) — the reference
+launches desktop containers via hydra; this build's default executor runs
+the in-process agent loop against a git workspace, and a sandbox executor
+can slot in without touching the orchestrator.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import sqlite3
+import tempfile
+import threading
+import time
+import traceback
+import uuid
+from typing import Callable, Optional
+
+from helix_tpu.services.git_service import GitService
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS spec_tasks (
+    id TEXT PRIMARY KEY,
+    project TEXT NOT NULL,
+    title TEXT NOT NULL,
+    description TEXT DEFAULT '',
+    status TEXT NOT NULL DEFAULT 'backlog',
+    spec_branch TEXT DEFAULT '',
+    task_branch TEXT DEFAULT '',
+    spec_path TEXT DEFAULT '',
+    pr_id TEXT DEFAULT '',
+    error TEXT DEFAULT '',
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS design_reviews (
+    id TEXT PRIMARY KEY,
+    task_id TEXT NOT NULL,
+    author TEXT,
+    comment TEXT NOT NULL,
+    decision TEXT NOT NULL,      -- approve | request_changes | comment
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS pull_requests (
+    id TEXT PRIMARY KEY,
+    project TEXT NOT NULL,
+    task_id TEXT,
+    title TEXT,
+    base TEXT NOT NULL,
+    head TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT 'open',   -- open | merged | closed
+    merge_sha TEXT DEFAULT '',
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+"""
+
+STATUSES = (
+    "backlog", "planning", "spec_review", "spec_revision",
+    "implementation_queued", "implementing", "pr_review", "done",
+    "failed", "cancelled",
+)
+
+
+@dataclasses.dataclass
+class SpecTask:
+    id: str
+    project: str
+    title: str
+    description: str = ""
+    status: str = "backlog"
+    spec_branch: str = ""
+    task_branch: str = ""
+    spec_path: str = ""
+    pr_id: str = ""
+    error: str = ""
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+class TaskStore:
+    def __init__(self, db_path: str = ":memory:"):
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    # -- tasks ---------------------------------------------------------------
+    def create_task(self, project: str, title: str, description: str = "") -> SpecTask:
+        t = SpecTask(
+            id=f"tsk_{uuid.uuid4().hex[:12]}", project=project,
+            title=title, description=description,
+        )
+        now = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO spec_tasks(id, project, title, description, "
+                "status, created_at, updated_at) VALUES(?,?,?,?,?,?,?)",
+                (t.id, project, title, description, t.status, now, now),
+            )
+            self._conn.commit()
+        return t
+
+    def _row_to_task(self, r) -> SpecTask:
+        return SpecTask(
+            id=r[0], project=r[1], title=r[2], description=r[3], status=r[4],
+            spec_branch=r[5], task_branch=r[6], spec_path=r[7], pr_id=r[8],
+            error=r[9],
+        )
+
+    _COLS = (
+        "id, project, title, description, status, spec_branch, task_branch, "
+        "spec_path, pr_id, error"
+    )
+
+    def get_task(self, tid: str) -> Optional[SpecTask]:
+        with self._lock:
+            r = self._conn.execute(
+                f"SELECT {self._COLS} FROM spec_tasks WHERE id=?", (tid,)
+            ).fetchone()
+        return self._row_to_task(r) if r else None
+
+    def list_tasks(self, project: Optional[str] = None,
+                   status: Optional[str] = None) -> list:
+        q = f"SELECT {self._COLS} FROM spec_tasks"
+        conds, args = [], []
+        if project:
+            conds.append("project=?")
+            args.append(project)
+        if status:
+            conds.append("status=?")
+            args.append(status)
+        if conds:
+            q += " WHERE " + " AND ".join(conds)
+        q += " ORDER BY created_at"
+        with self._lock:
+            rows = self._conn.execute(q, tuple(args)).fetchall()
+        return [self._row_to_task(r) for r in rows]
+
+    def update_task(self, t: SpecTask) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE spec_tasks SET status=?, spec_branch=?, "
+                "task_branch=?, spec_path=?, pr_id=?, error=?, updated_at=? "
+                "WHERE id=?",
+                (
+                    t.status, t.spec_branch, t.task_branch, t.spec_path,
+                    t.pr_id, t.error, time.time(), t.id,
+                ),
+            )
+            self._conn.commit()
+
+    # -- design reviews -------------------------------------------------------
+    def add_review(self, task_id: str, author: str, comment: str,
+                   decision: str) -> str:
+        rid = f"rev_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO design_reviews(id, task_id, author, comment, "
+                "decision, created_at) VALUES(?,?,?,?,?,?)",
+                (rid, task_id, author, comment, decision, time.time()),
+            )
+            self._conn.commit()
+        return rid
+
+    def reviews(self, task_id: str) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, author, comment, decision, created_at FROM "
+                "design_reviews WHERE task_id=? ORDER BY created_at",
+                (task_id,),
+            ).fetchall()
+        return [
+            {"id": r[0], "author": r[1], "comment": r[2], "decision": r[3],
+             "created_at": r[4]}
+            for r in rows
+        ]
+
+    # -- pull requests --------------------------------------------------------
+    def create_pr(self, project: str, task_id: str, title: str,
+                  base: str, head: str) -> str:
+        pid = f"pr_{uuid.uuid4().hex[:12]}"
+        now = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO pull_requests(id, project, task_id, title, "
+                "base, head, status, created_at, updated_at) "
+                "VALUES(?,?,?,?,?,?, 'open', ?, ?)",
+                (pid, project, task_id, title, base, head, now, now),
+            )
+            self._conn.commit()
+        return pid
+
+    def get_pr(self, pid: str) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT id, project, task_id, title, base, head, status, "
+                "merge_sha FROM pull_requests WHERE id=?",
+                (pid,),
+            ).fetchone()
+        if not r:
+            return None
+        return {
+            "id": r[0], "project": r[1], "task_id": r[2], "title": r[3],
+            "base": r[4], "head": r[5], "status": r[6], "merge_sha": r[7],
+        }
+
+    def list_prs(self, project: Optional[str] = None,
+                 status: Optional[str] = None) -> list:
+        q = ("SELECT id, project, task_id, title, base, head, status, "
+             "merge_sha FROM pull_requests")
+        conds, args = [], []
+        if project:
+            conds.append("project=?")
+            args.append(project)
+        if status:
+            conds.append("status=?")
+            args.append(status)
+        if conds:
+            q += " WHERE " + " AND ".join(conds)
+        with self._lock:
+            rows = self._conn.execute(q, tuple(args)).fetchall()
+        return [
+            {"id": r[0], "project": r[1], "task_id": r[2], "title": r[3],
+             "base": r[4], "head": r[5], "status": r[6], "merge_sha": r[7]}
+            for r in rows
+        ]
+
+    def update_pr(self, pid: str, status: str, merge_sha: str = "") -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE pull_requests SET status=?, merge_sha=?, updated_at=? "
+                "WHERE id=?",
+                (status, merge_sha, time.time(), pid),
+            )
+            self._conn.commit()
+
+
+class Executor:
+    """Agent-execution seam (reference: ``external-agent/executor.go:13-37``).
+
+    ``run(task, workspace, mode)`` runs an agent in ``workspace`` (a git
+    clone) and returns a summary string; mode is "plan" or "implement"."""
+
+    def run(self, task: SpecTask, workspace: str, mode: str,
+            feedback: str = "") -> str:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class AgentExecutor(Executor):
+    """Default executor: the in-process agent loop with filesystem access to
+    the workspace (the TPU build's stand-in for a desktop container agent)."""
+
+    PLAN_PROMPT = (
+        "You are a software planning agent. Write a concise implementation "
+        "spec for the task into the file specs/{task_id}.md using the "
+        "filesystem tool, then answer with a one-line summary."
+    )
+    IMPL_PROMPT = (
+        "You are a software implementation agent. Read the spec at "
+        "{spec_path} and implement it by writing files in the workspace "
+        "with the filesystem tool, then answer with a one-line summary."
+    )
+
+    def __init__(self, llm, model: str = "", max_iterations: int = 12):
+        self.llm = llm
+        self.model = model
+        self.max_iterations = max_iterations
+
+    def run(self, task, workspace, mode, feedback: str = "") -> str:
+        import asyncio
+
+        from helix_tpu.agent.agent import Agent, AgentConfig
+        from helix_tpu.agent.skill import SkillRegistry
+        from helix_tpu.agent.skills import filesystem_skill
+
+        prompt = (
+            self.PLAN_PROMPT if mode == "plan" else self.IMPL_PROMPT
+        ).format(task_id=task.id, spec_path=task.spec_path or "specs/")
+        agent = Agent(
+            AgentConfig(
+                prompt=prompt, model=self.model,
+                max_iterations=self.max_iterations,
+            ),
+            SkillRegistry([filesystem_skill(workspace)]),
+            self.llm,
+        )
+        message = f"Task: {task.title}\n\n{task.description}"
+        if feedback:
+            message += f"\n\nReview feedback to address:\n{feedback}"
+        answer, steps = asyncio.run(agent.run(message))
+        return answer
+
+
+class SpecTaskOrchestrator:
+    """The polling state machine (``spec_task_orchestrator.go:140,299-330``)."""
+
+    def __init__(
+        self,
+        store: TaskStore,
+        git: GitService,
+        executor: Executor,
+        poll_interval: float = 2.0,
+        workspace_root: Optional[str] = None,
+    ):
+        self.store = store
+        self.git = git
+        self.executor = executor
+        self.poll_interval = poll_interval
+        self.workspace_root = workspace_root or tempfile.mkdtemp(
+            prefix="helix-workspaces-"
+        )
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # per-project serialisation (reference: backlogProjectLocks)
+        self._project_locks: dict[str, threading.Lock] = {}
+        self._plock = threading.Lock()
+
+    def _lock_for(self, project: str) -> threading.Lock:
+        with self._plock:
+            return self._project_locks.setdefault(project, threading.Lock())
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._loop, name="helix-spectask", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.process_once()
+            except Exception:  # noqa: BLE001 — orchestrator must survive
+                traceback.print_exc()
+            self._stop.wait(self.poll_interval)
+
+    # -- the state machine -----------------------------------------------------
+    def process_once(self) -> int:
+        """One pass over actionable statuses; returns tasks progressed."""
+        n = 0
+        for task in self.store.list_tasks(status="backlog"):
+            with self._lock_for(task.project):
+                self._handle_backlog(task)
+            n += 1
+        for task in self.store.list_tasks(status="planning"):
+            self._handle_planning(task)
+            n += 1
+        for task in self.store.list_tasks(status="spec_revision"):
+            self._handle_planning(task, revision=True)
+            n += 1
+        for task in self.store.list_tasks(status="implementation_queued"):
+            self._handle_implementation(task)
+            n += 1
+        return n
+
+    def _fail(self, task: SpecTask, err: str):
+        task.status = "failed"
+        task.error = err[:2000]
+        self.store.update_task(task)
+
+    def _handle_backlog(self, task: SpecTask):
+        if not self.git.repo_exists(task.project):
+            self.git.create_repo(task.project)
+        task.status = "planning"
+        task.spec_branch = "helix-specs"
+        task.spec_path = f"specs/{task.id}.md"
+        self.store.update_task(task)
+
+    def _handle_planning(self, task: SpecTask, revision: bool = False):
+        ws = os.path.join(self.workspace_root, f"{task.id}-plan")
+        shutil.rmtree(ws, ignore_errors=True)
+        try:
+            self.git.clone_workspace(task.project, ws)
+            feedback = ""
+            if revision:
+                feedback = "\n".join(
+                    r["comment"]
+                    for r in self.store.reviews(task.id)
+                    if r["decision"] == "request_changes"
+                )
+            self.executor.run(task, ws, "plan", feedback=feedback)
+            spec_file = os.path.join(ws, task.spec_path)
+            if not os.path.exists(spec_file):
+                raise RuntimeError(
+                    f"planning agent produced no spec at {task.spec_path}"
+                )
+            self.git.commit_and_push(
+                ws, f"spec: {task.title} ({task.id})", task.spec_branch
+            )
+            task.status = "spec_review"
+            self.store.update_task(task)
+        except Exception as e:  # noqa: BLE001
+            self._fail(task, f"planning failed: {e}")
+        finally:
+            shutil.rmtree(ws, ignore_errors=True)
+
+    def review_spec(self, task_id: str, author: str, decision: str,
+                    comment: str = "") -> SpecTask:
+        """Human design-review gate (reference: design-review comments +
+        approve -> implementation queue)."""
+        task = self.store.get_task(task_id)
+        if task is None:
+            raise KeyError(task_id)
+        if task.status != "spec_review":
+            raise ValueError(f"task is {task.status}, not spec_review")
+        self.store.add_review(task_id, author, comment, decision)
+        if decision == "approve":
+            task.status = "implementation_queued"
+            task.task_branch = f"task/{task.id}"
+        elif decision == "request_changes":
+            task.status = "spec_revision"
+        self.store.update_task(task)
+        return task
+
+    def _handle_implementation(self, task: SpecTask):
+        task.status = "implementing"
+        self.store.update_task(task)
+        ws = os.path.join(self.workspace_root, f"{task.id}-impl")
+        shutil.rmtree(ws, ignore_errors=True)
+        try:
+            self.git.clone_workspace(task.project, ws)
+            # bring the spec into the working tree
+            spec = self.git.file_at(
+                task.project, task.spec_branch, task.spec_path
+            )
+            if spec:
+                os.makedirs(
+                    os.path.dirname(os.path.join(ws, task.spec_path)),
+                    exist_ok=True,
+                )
+                with open(os.path.join(ws, task.spec_path), "w") as f:
+                    f.write(spec)
+            self.executor.run(task, ws, "implement")
+            sha = self.git.commit_and_push(
+                ws, f"{task.title} ({task.id})", task.task_branch
+            )
+            if sha is None:
+                raise RuntimeError("implementation agent changed nothing")
+            task.pr_id = self.store.create_pr(
+                task.project, task.id, task.title, "main", task.task_branch
+            )
+            task.status = "pr_review"
+            self.store.update_task(task)
+        except Exception as e:  # noqa: BLE001
+            self._fail(task, f"implementation failed: {e}")
+        finally:
+            shutil.rmtree(ws, ignore_errors=True)
+
+    def merge_pr(self, pr_id: str) -> dict:
+        """Approve + merge the task PR; task -> done (``handleDone``)."""
+        pr = self.store.get_pr(pr_id)
+        if pr is None:
+            raise KeyError(pr_id)
+        if pr["status"] != "open":
+            raise ValueError(f"PR is {pr['status']}")
+        sha = self.git.merge(
+            pr["project"], pr["base"], pr["head"],
+            f"Merge {pr['head']}: {pr['title']}",
+        )
+        self.store.update_pr(pr_id, "merged", sha)
+        if pr["task_id"]:
+            task = self.store.get_task(pr["task_id"])
+            if task:
+                task.status = "done"
+                self.store.update_task(task)
+        return {**pr, "status": "merged", "merge_sha": sha}
+
+    def pr_diff(self, pr_id: str) -> str:
+        pr = self.store.get_pr(pr_id)
+        if pr is None:
+            raise KeyError(pr_id)
+        return self.git.diff(pr["project"], pr["base"], pr["head"])

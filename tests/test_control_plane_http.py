@@ -1,0 +1,130 @@
+"""Control-plane HTTP tests: spec-task kanban over REST + real git clone
+through the smart-HTTP endpoints (black-box, reference integration style)."""
+
+import asyncio
+import os
+import subprocess
+import threading
+
+import pytest
+import requests
+
+from helix_tpu.control.server import ControlPlane
+
+
+@pytest.fixture(scope="module")
+def cp_url():
+    cp = ControlPlane()
+
+    # deterministic executor instead of an LLM
+    class ScriptedExecutor:
+        def run(self, task, workspace, mode, feedback=""):
+            if mode == "plan":
+                path = os.path.join(workspace, task.spec_path)
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, "w") as f:
+                    f.write(f"# Spec: {task.title}\n")
+            else:
+                with open(os.path.join(workspace, "main.py"), "w") as f:
+                    f.write("print('hello')\n")
+            return "ok"
+
+    cp.orchestrator.executor = ScriptedExecutor()
+    cp.orchestrator.poll_interval = 0.2
+
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(cp.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 18410)
+        loop.run_until_complete(site.start())
+        holder["loop"] = loop
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    yield "http://127.0.0.1:18410"
+    cp.orchestrator.stop()
+    cp.knowledge.stop()
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+def _wait_status(url, tid, status, timeout=20):
+    import time
+
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        t = requests.get(f"{url}/api/v1/spec-tasks/{tid}", timeout=5).json()
+        if t["status"] == status:
+            return t
+        if t["status"] == "failed":
+            raise AssertionError(f"task failed: {t['error']}")
+        time.sleep(0.2)
+    raise AssertionError(f"timeout waiting for {status}; last: {t['status']}")
+
+
+class TestSpecTaskAPI:
+    def test_kanban_lifecycle_over_http(self, cp_url):
+        r = requests.post(
+            f"{cp_url}/api/v1/spec-tasks",
+            json={"project": "webapp", "title": "Add search",
+                  "description": "full-text search"},
+            timeout=5,
+        )
+        tid = r.json()["id"]
+        t = _wait_status(cp_url, tid, "spec_review")
+        r = requests.post(
+            f"{cp_url}/api/v1/spec-tasks/{tid}/review",
+            json={"decision": "approve", "comment": "ship it"},
+            timeout=5,
+        )
+        assert r.status_code == 200
+        t = _wait_status(cp_url, tid, "pr_review")
+        pr_id = t["pr_id"]
+        diff = requests.get(
+            f"{cp_url}/api/v1/pull-requests/{pr_id}/diff", timeout=5
+        ).text
+        assert "main.py" in diff
+        r = requests.post(
+            f"{cp_url}/api/v1/pull-requests/{pr_id}/merge", timeout=15
+        )
+        assert r.status_code == 200, r.text
+        t = requests.get(f"{cp_url}/api/v1/spec-tasks/{tid}", timeout=5).json()
+        assert t["status"] == "done"
+        assert t["reviews"][0]["comment"] == "ship it"
+
+    def test_real_git_clone_over_http(self, cp_url, tmp_path):
+        # repo created by the previous test's task
+        repos = requests.get(f"{cp_url}/api/v1/repos", timeout=5).json()["repos"]
+        assert "webapp" in repos
+        dest = str(tmp_path / "clone")
+        p = subprocess.run(
+            ["git", "clone", "-q", f"{cp_url}/git/webapp", dest],
+            capture_output=True,
+        )
+        assert p.returncode == 0, p.stderr.decode()
+        assert os.path.exists(os.path.join(dest, "main.py"))
+        # and push back through receive-pack
+        with open(os.path.join(dest, "new.txt"), "w") as f:
+            f.write("pushed")
+        subprocess.run(["git", "-C", dest, "config", "user.email", "t@t"],
+                       check=True)
+        subprocess.run(["git", "-C", dest, "config", "user.name", "t"],
+                       check=True)
+        subprocess.run(["git", "-C", dest, "add", "-A"], check=True)
+        subprocess.run(
+            ["git", "-C", dest, "commit", "-q", "-m", "push test"], check=True
+        )
+        p = subprocess.run(
+            ["git", "-C", dest, "push", "-q", "origin", "main"],
+            capture_output=True,
+        )
+        assert p.returncode == 0, p.stderr.decode()

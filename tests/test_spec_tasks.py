@@ -1,0 +1,214 @@
+"""Spec-task pipeline tests: git service, full kanban lifecycle with a
+scripted planning/implementation agent, review gates, PR merge."""
+
+import os
+import subprocess
+
+import pytest
+
+from helix_tpu.services.git_service import GitError, GitService
+from helix_tpu.services.spec_tasks import (
+    AgentExecutor,
+    SpecTaskOrchestrator,
+    TaskStore,
+)
+
+
+@pytest.fixture()
+def git(tmp_path):
+    return GitService(str(tmp_path / "repos"))
+
+
+class TestGitService:
+    def test_create_clone_push_log(self, git, tmp_path):
+        git.create_repo("proj")
+        assert git.repo_exists("proj")
+        ws = str(tmp_path / "ws")
+        git.clone_workspace("proj", ws)
+        with open(os.path.join(ws, "hello.txt"), "w") as f:
+            f.write("hi")
+        sha = git.commit_and_push(ws, "add hello", "main")
+        assert sha
+        log = git.log("proj", "main")
+        assert log[0]["subject"] == "add hello"
+        assert git.file_at("proj", "main", "hello.txt") == "hi"
+
+    def test_branch_diff_merge(self, git, tmp_path):
+        git.create_repo("p2")
+        ws = str(tmp_path / "w2")
+        git.clone_workspace("p2", ws)
+        with open(os.path.join(ws, "f.txt"), "w") as f:
+            f.write("feature")
+        git.commit_and_push(ws, "feature commit", "feat")
+        assert "feat" in git.branches("p2")
+        diff = git.diff("p2", "main", "feat")
+        assert "+feature" in diff
+        sha = git.merge("p2", "main", "feat", "merge feat")
+        assert git.file_at("p2", "main", "f.txt") == "feature"
+
+    def test_smart_http_advertise(self, git):
+        git.create_repo("p3")
+        data = git.info_refs("p3", "git-upload-pack")
+        assert data.startswith(b"001e# service=git-upload-pack")
+        assert b"refs/heads/main" in data
+
+    def test_clean_tree_push_returns_none(self, git, tmp_path):
+        git.create_repo("p4")
+        ws = str(tmp_path / "w4")
+        git.clone_workspace("p4", ws)
+        assert git.commit_and_push(ws, "noop", "main") is None
+
+
+class ScriptedExecutor:
+    """Writes deterministic spec/impl files (stands in for the LLM agent)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def run(self, task, workspace, mode, feedback=""):
+        self.calls.append((task.id, mode, feedback))
+        if mode == "plan":
+            path = os.path.join(workspace, task.spec_path)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            body = f"# Spec for {task.title}\n"
+            if feedback:
+                body += f"\nAddressed feedback: {feedback}\n"
+            with open(path, "w") as f:
+                f.write(body)
+            return "spec written"
+        with open(os.path.join(workspace, "impl.py"), "w") as f:
+            f.write(f"# implementation for {task.id}\n")
+        return "implemented"
+
+
+class TestSpecTaskLifecycle:
+    def _orch(self, tmp_path):
+        store = TaskStore()
+        git = GitService(str(tmp_path / "repos"))
+        ex = ScriptedExecutor()
+        orch = SpecTaskOrchestrator(
+            store, git, ex, workspace_root=str(tmp_path / "ws")
+        )
+        return store, git, ex, orch
+
+    def test_full_happy_path(self, tmp_path):
+        store, git, ex, orch = self._orch(tmp_path)
+        t = store.create_task("demo", "Add login", "Users need to log in")
+        # backlog -> planning -> spec_review
+        orch.process_once()
+        orch.process_once()
+        t = store.get_task(t.id)
+        assert t.status == "spec_review", t.error
+        # spec landed on the helix-specs branch
+        spec = git.file_at("demo", "helix-specs", t.spec_path)
+        assert "Spec for Add login" in spec
+        # approve -> implementation -> pr_review
+        orch.review_spec(t.id, "alice", "approve", "LGTM")
+        orch.process_once()
+        t = store.get_task(t.id)
+        assert t.status == "pr_review", t.error
+        assert t.pr_id
+        diff = orch.pr_diff(t.pr_id)
+        assert "impl.py" in diff
+        # merge -> done; code on main
+        orch.merge_pr(t.pr_id)
+        t = store.get_task(t.id)
+        assert t.status == "done"
+        assert git.file_at("demo", "main", "impl.py") is not None
+
+    def test_request_changes_revision_loop(self, tmp_path):
+        store, git, ex, orch = self._orch(tmp_path)
+        t = store.create_task("demo", "Feature X")
+        orch.process_once()
+        orch.process_once()
+        orch.review_spec(t.id, "bob", "request_changes", "needs error handling")
+        orch.process_once()   # revision pass
+        t = store.get_task(t.id)
+        assert t.status == "spec_review"
+        spec = git.file_at("demo", "helix-specs", t.spec_path)
+        assert "needs error handling" in spec
+        # the revision executor call received the feedback
+        assert any(
+            mode == "plan" and "error handling" in fb
+            for _, mode, fb in ex.calls
+        )
+
+    def test_review_wrong_state_rejected(self, tmp_path):
+        store, git, ex, orch = self._orch(tmp_path)
+        t = store.create_task("demo", "Y")
+        with pytest.raises(ValueError):
+            orch.review_spec(t.id, "a", "approve")
+
+    def test_planner_without_spec_fails_task(self, tmp_path):
+        store = TaskStore()
+        git = GitService(str(tmp_path / "repos"))
+
+        class NoopExecutor:
+            def run(self, task, workspace, mode, feedback=""):
+                return "did nothing"
+
+        orch = SpecTaskOrchestrator(
+            store, git, NoopExecutor(), workspace_root=str(tmp_path / "ws")
+        )
+        t = store.create_task("demo", "Z")
+        orch.process_once()
+        orch.process_once()
+        t = store.get_task(t.id)
+        assert t.status == "failed"
+        assert "no spec" in t.error
+
+    def test_agent_executor_with_scripted_llm(self, tmp_path):
+        """The real AgentExecutor drives the agent loop with the filesystem
+        skill and a scripted LLM that plans then implements."""
+        store = TaskStore()
+        git = GitService(str(tmp_path / "repos"))
+
+        class LLM:
+            def __init__(self):
+                self.mode_calls = []
+
+            async def chat(self, body):
+                sysmsg = body["messages"][0]["content"]
+                user = body["messages"][-1]["content"]
+                if "planning agent" in sysmsg and "Tool result" not in user:
+                    tid = user.split("(")[0]
+                    content = (
+                        '{"tool": "filesystem", "arguments": {"action": '
+                        '"write", "path": "specs/SPEC_ID.md", "content": '
+                        '"# plan"}}'
+                    )
+                    # find task id embedded in the prompt
+                    import re
+
+                    m = re.search(r"specs/(tsk_\w+)\.md", sysmsg)
+                    content = content.replace("SPEC_ID", m.group(1))
+                    return _msg(content)
+                if "implementation agent" in sysmsg and "Tool result" not in user:
+                    return _msg(
+                        '{"tool": "filesystem", "arguments": {"action": '
+                        '"write", "path": "code.py", "content": "print(1)"}}'
+                    )
+                return _msg('{"answer": "done"}')
+
+        def _msg(content):
+            return {
+                "choices": [
+                    {"index": 0,
+                     "message": {"role": "assistant", "content": content}}
+                ]
+            }
+
+        orch = SpecTaskOrchestrator(
+            store, git, AgentExecutor(LLM(), model="m"),
+            workspace_root=str(tmp_path / "ws"),
+        )
+        t = store.create_task("demo", "real agent task")
+        orch.process_once()
+        orch.process_once()
+        t = store.get_task(t.id)
+        assert t.status == "spec_review", t.error
+        orch.review_spec(t.id, "a", "approve")
+        orch.process_once()
+        t = store.get_task(t.id)
+        assert t.status == "pr_review", t.error
+        assert "code.py" in orch.pr_diff(t.pr_id)
