@@ -1,0 +1,95 @@
+"""Filestore: user/app file storage behind the control plane.
+
+Mirrors ``api/pkg/filestore`` (local-FS or GCS blob store with presigned
+viewer URLs, ``serve.go:129-201``): a rooted local backend with
+path-traversal protection, per-owner prefixes, and HMAC-signed time-limited
+download URLs standing in for presigning (a cloud backend implements the
+same interface).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import shutil
+import time
+from typing import Optional
+
+
+class Filestore:
+    def __init__(self, root: str, secret: bytes = b"helix-filestore"):
+        self.root = os.path.realpath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._secret = secret
+
+    def _resolve(self, owner: str, path: str) -> str:
+        p = os.path.realpath(
+            os.path.join(self.root, owner, path.lstrip("/"))
+        )
+        if not p.startswith(os.path.join(self.root, owner)):
+            raise PermissionError("path escapes the filestore")
+        return p
+
+    # -- blob operations -------------------------------------------------------
+    def write(self, owner: str, path: str, data: bytes) -> dict:
+        p = self._resolve(owner, path)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data)
+        return self.stat(owner, path)
+
+    def read(self, owner: str, path: str) -> bytes:
+        with open(self._resolve(owner, path), "rb") as f:
+            return f.read()
+
+    def stat(self, owner: str, path: str) -> dict:
+        p = self._resolve(owner, path)
+        st = os.stat(p)
+        return {
+            "path": path.lstrip("/"),
+            "size": st.st_size,
+            "modified": st.st_mtime,
+            "is_dir": os.path.isdir(p),
+        }
+
+    def list(self, owner: str, path: str = "") -> list:
+        p = self._resolve(owner, path or ".")
+        if not os.path.isdir(p):
+            return []
+        out = []
+        for name in sorted(os.listdir(p)):
+            out.append(self.stat(owner, os.path.join(path, name)))
+        return out
+
+    def delete(self, owner: str, path: str) -> bool:
+        p = self._resolve(owner, path)
+        if os.path.isdir(p):
+            shutil.rmtree(p)
+            return True
+        if os.path.exists(p):
+            os.remove(p)
+            return True
+        return False
+
+    # -- signed URLs -----------------------------------------------------------
+    def sign(self, owner: str, path: str, ttl: float = 3600.0) -> dict:
+        """Presigned-style viewer token (reference: presigned viewer URLs)."""
+        expires = int(time.time() + ttl)
+        msg = f"{owner}:{path}:{expires}".encode()
+        sig = hmac.new(self._secret, msg, hashlib.sha256).hexdigest()
+        return {
+            "path": path,
+            "owner": owner,
+            "expires": expires,
+            "signature": sig,
+            "url": f"/files/view?owner={owner}&path={path}"
+                   f"&expires={expires}&sig={sig}",
+        }
+
+    def verify(self, owner: str, path: str, expires: int, sig: str) -> bool:
+        if time.time() > expires:
+            return False
+        msg = f"{owner}:{path}:{expires}".encode()
+        want = hmac.new(self._secret, msg, hashlib.sha256).hexdigest()
+        return hmac.compare_digest(want, sig)

@@ -1,0 +1,97 @@
+"""In-process pub/sub: the embedded-NATS equivalent.
+
+The reference embeds a NATS JetStream server for request/response queues,
+session events and the runner WS bridge (``api/pkg/pubsub/nats.go:39-60``
+and the in-memory variant used in tests, ``serve.go:113``).  A single
+self-hosted process doesn't need a broker protocol between its own
+subsystems — this bus supplies the same interface surface (publish /
+subscribe with wildcards / queue groups / request-reply) in-process, and
+the WebSocket gateway on the control plane plays the role of the
+user-facing event stream (``/ws/user``).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import itertools
+import queue
+import threading
+import uuid
+from typing import Callable, Optional
+
+
+class Subscription:
+    def __init__(self, bus, topic: str, cb, group: Optional[str]):
+        self.bus = bus
+        self.topic = topic
+        self.cb = cb
+        self.group = group
+        self.id = uuid.uuid4().hex
+
+    def unsubscribe(self):
+        self.bus._remove(self)
+
+
+class EventBus:
+    def __init__(self):
+        self._subs: list = []
+        self._lock = threading.Lock()
+        self._rr = itertools.count()
+
+    # -- core ----------------------------------------------------------------
+    def subscribe(
+        self, topic: str, cb: Callable[[str, dict], None],
+        group: Optional[str] = None,
+    ) -> Subscription:
+        """``topic`` supports fnmatch wildcards (``sessions.*``).  Within a
+        queue ``group``, each message goes to exactly one member."""
+        sub = Subscription(self, topic, cb, group)
+        with self._lock:
+            self._subs.append(sub)
+        return sub
+
+    def _remove(self, sub: Subscription):
+        with self._lock:
+            self._subs = [s for s in self._subs if s.id != sub.id]
+
+    def publish(self, topic: str, message: dict) -> int:
+        with self._lock:
+            matching = [s for s in self._subs if fnmatch.fnmatch(topic, s.topic)]
+        # queue groups: one delivery per group, round-robin
+        by_group: dict = {}
+        solo = []
+        for s in matching:
+            if s.group:
+                by_group.setdefault(s.group, []).append(s)
+            else:
+                solo.append(s)
+        targets = list(solo)
+        for members in by_group.values():
+            targets.append(members[next(self._rr) % len(members)])
+        for s in targets:
+            try:
+                s.cb(topic, message)
+            except Exception:  # noqa: BLE001 — one subscriber must not break fanout
+                import traceback
+
+                traceback.print_exc()
+        return len(targets)
+
+    # -- request / reply -------------------------------------------------------
+    def request(self, topic: str, message: dict, timeout: float = 5.0) -> dict:
+        """NATS-style request: publish with a reply inbox, await one reply."""
+        inbox = f"_inbox.{uuid.uuid4().hex}"
+        q: "queue.Queue" = queue.Queue()
+        sub = self.subscribe(inbox, lambda t, m: q.put(m))
+        try:
+            n = self.publish(topic, {**message, "_reply_to": inbox})
+            if n == 0:
+                raise TimeoutError(f"no responders on {topic}")
+            return q.get(timeout=timeout)
+        finally:
+            sub.unsubscribe()
+
+    def respond(self, request_message: dict, reply: dict) -> None:
+        inbox = request_message.get("_reply_to")
+        if inbox:
+            self.publish(inbox, reply)

@@ -133,6 +133,46 @@ class ControlPlane:
             AgentExecutor(_ProviderLLM(self.providers)),
         ).start()
 
+        # event bus (embedded-NATS equivalent) + filestore + triggers
+        from helix_tpu.control.filestore import Filestore
+        from helix_tpu.control.pubsub import EventBus
+        from helix_tpu.control.triggers import TriggerManager
+
+        self.bus = EventBus()
+        files_root = (
+            tempfile_dir()
+            if db_path == ":memory:"
+            else _os.path.join(
+                _os.path.dirname(_os.path.abspath(db_path)) or ".",
+                "helix-files",
+            )
+        )
+        self.files = Filestore(files_root)
+
+        def fire_trigger(trigger, payload):
+            import asyncio as _asyncio
+
+            prompt = trigger.prompt or payload.get("message", "")
+            if payload.get("text"):
+                prompt = f"{prompt}\n\n{payload['text']}".strip()
+            sid = self.store.create_session(
+                "trigger", f"trigger:{trigger.id}", {"app_id": trigger.app_id}
+            )
+            _asyncio.run(
+                self.controller.chat(
+                    [{"role": "user", "content": prompt or "(triggered)"}],
+                    user="trigger",
+                    session_id=sid,
+                    app_id=trigger.app_id,
+                )
+            )
+            self.bus.publish(
+                f"triggers.{trigger.id}.fired",
+                {"session_id": sid, "trigger": trigger.id},
+            )
+
+        self.triggers = TriggerManager(fire_trigger).start()
+
     def _pick_embed_model(self):
         for st in self.router.runners():
             if not st.routable:
@@ -228,6 +268,20 @@ class ControlPlane:
         r.add_get("/api/v1/repos", self.list_repos)
         r.add_get("/git/{repo}/info/refs", self.git_info_refs)
         r.add_post("/git/{repo}/{service}", self.git_rpc)
+        # triggers + webhooks
+        r.add_get("/api/v1/triggers", self.list_triggers)
+        r.add_post("/api/v1/triggers", self.create_trigger)
+        r.add_delete("/api/v1/triggers/{id}", self.delete_trigger)
+        r.add_post("/webhooks/{id}", self.fire_webhook)
+        # filestore
+        r.add_get("/api/v1/filestore", self.fs_list)
+        r.add_put("/api/v1/filestore/{path:.*}", self.fs_upload)
+        r.add_get("/api/v1/filestore/{path:.*}", self.fs_download)
+        r.add_delete("/api/v1/filestore/{path:.*}", self.fs_delete)
+        r.add_post("/api/v1/filestore-sign/{path:.*}", self.fs_sign)
+        r.add_get("/files/view", self.fs_view_signed)
+        # user event stream (the reference's /ws/user)
+        r.add_get("/ws/user", self.ws_user)
         # openai passthrough
         r.add_get("/v1/models", self.models)
         for route in ("/v1/chat/completions", "/v1/completions", "/v1/embeddings"):
@@ -408,6 +462,10 @@ class ControlPlane:
                 await resp.write_eof()
                 return resp
             out = await self.controller.chat(messages, **kwargs)
+            self.bus.publish(
+                f"sessions.{session.get('owner', 'anonymous')}.updated",
+                {"session_id": sid, "event": "interaction"},
+            )
             return web.json_response(out)
         except ProviderError as e:
             return _err(e.status, str(e))
@@ -668,6 +726,136 @@ class ControlPlane:
 
     async def list_repos(self, request):
         return web.json_response({"repos": self.git.list_repos()})
+
+    # -- triggers --------------------------------------------------------------
+    async def list_triggers(self, request):
+        return web.json_response(
+            {
+                "triggers": [
+                    t.to_dict()
+                    for t in self.triggers.list(request.query.get("app_id"))
+                ]
+            }
+        )
+
+    async def create_trigger(self, request):
+        body = await request.json()
+        try:
+            t = self.triggers.add(
+                app_id=body["app_id"],
+                kind=body.get("kind", "webhook"),
+                prompt=body.get("prompt", ""),
+                cron=body.get("cron"),
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(t.to_dict())
+
+    async def delete_trigger(self, request):
+        ok = self.triggers.remove(request.match_info["id"])
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def fire_webhook(self, request):
+        tid = request.match_info["id"]
+        try:
+            payload = await request.json()
+        except Exception:
+            payload = {}
+        secret = request.headers.get(
+            "X-Webhook-Secret", request.query.get("secret", "")
+        )
+        try:
+            ok = await __import__("asyncio").get_running_loop().run_in_executor(
+                None, lambda: self.triggers.fire_webhook(tid, payload, secret)
+            )
+        except PermissionError:
+            return _err(403, "bad webhook secret")
+        if not ok:
+            return _err(404, "trigger not found or not a webhook")
+        return web.json_response({"ok": True})
+
+    # -- filestore -------------------------------------------------------------
+    async def fs_list(self, request):
+        owner = self._user_id(request)
+        return web.json_response(
+            {"files": self.files.list(owner, request.query.get("path", ""))}
+        )
+
+    async def fs_upload(self, request):
+        owner = self._user_id(request)
+        data = await request.read()
+        try:
+            info = self.files.write(owner, request.match_info["path"], data)
+        except PermissionError as e:
+            return _err(403, str(e))
+        return web.json_response(info)
+
+    async def fs_download(self, request):
+        owner = self._user_id(request)
+        try:
+            data = self.files.read(owner, request.match_info["path"])
+        except FileNotFoundError:
+            return _err(404, "file not found")
+        except PermissionError as e:
+            return _err(403, str(e))
+        return web.Response(body=data)
+
+    async def fs_delete(self, request):
+        owner = self._user_id(request)
+        ok = self.files.delete(owner, request.match_info["path"])
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def fs_sign(self, request):
+        owner = self._user_id(request)
+        return web.json_response(
+            self.files.sign(owner, request.match_info["path"])
+        )
+
+    async def fs_view_signed(self, request):
+        q = request.query
+        if not self.files.verify(
+            q.get("owner", ""), q.get("path", ""),
+            int(q.get("expires", 0)), q.get("sig", ""),
+        ):
+            return _err(403, "invalid or expired signature")
+        try:
+            data = self.files.read(q["owner"], q["path"])
+        except FileNotFoundError:
+            return _err(404, "file not found")
+        return web.Response(body=data)
+
+    # -- user event stream -----------------------------------------------------
+    async def ws_user(self, request):
+        """WebSocket event stream: session/trigger events for an owner
+        (reference: ``/ws/user`` bridging NATS session events)."""
+        import asyncio as _asyncio
+
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        owner = self._user_id(request)
+        loop = _asyncio.get_running_loop()
+        q: _asyncio.Queue = _asyncio.Queue()
+
+        def on_event(topic, message):
+            loop.call_soon_threadsafe(
+                q.put_nowait, {"topic": topic, "data": message}
+            )
+
+        subs = [
+            self.bus.subscribe(f"sessions.{owner}.*", on_event),
+            self.bus.subscribe("triggers.*", on_event),
+        ]
+        try:
+            while not ws.closed:
+                try:
+                    ev = await _asyncio.wait_for(q.get(), timeout=5)
+                except _asyncio.TimeoutError:
+                    continue
+                await ws.send_json(ev)
+        finally:
+            for s in subs:
+                s.unsubscribe()
+        return ws
 
     # -- git smart HTTP --------------------------------------------------------
     async def git_info_refs(self, request):

@@ -1,0 +1,184 @@
+"""Trigger manager: cron schedules + webhooks firing agent sessions.
+
+Mirrors ``api/pkg/trigger`` (gocron cron triggers, webhook triggers, chat
+integrations — ``serve.go:434-436``): app docs declare triggers; the
+manager runs cron entries on a scheduler thread and exposes webhook
+endpoints; both fire a session chat through the controller.  Chat-platform
+integrations (Slack/Teams/Discord) are webhook-shaped here — their payload
+adapters normalise into the same fire path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import traceback
+import uuid
+from typing import Callable, Optional
+
+
+def _parse_cron_field(field: str, lo: int, hi: int) -> set:
+    out = set()
+    for part in field.split(","):
+        step = 1
+        if "/" in part:
+            part, step_s = part.split("/")
+            step = int(step_s)
+        if part in ("*", ""):
+            rng = range(lo, hi + 1)
+        elif "-" in part:
+            a, b = part.split("-")
+            rng = range(int(a), int(b) + 1)
+        else:
+            rng = range(int(part), int(part) + 1)
+        out.update(x for x in rng if (x - lo) % step == 0)
+    return out
+
+
+@dataclasses.dataclass
+class CronSchedule:
+    """Standard 5-field cron (minute hour dom month dow)."""
+
+    minute: set
+    hour: set
+    dom: set
+    month: set
+    dow: set
+
+    @classmethod
+    def parse(cls, expr: str) -> "CronSchedule":
+        parts = expr.split()
+        if len(parts) != 5:
+            raise ValueError(f"cron needs 5 fields, got {expr!r}")
+        return cls(
+            minute=_parse_cron_field(parts[0], 0, 59),
+            hour=_parse_cron_field(parts[1], 0, 23),
+            dom=_parse_cron_field(parts[2], 1, 31),
+            month=_parse_cron_field(parts[3], 1, 12),
+            dow=_parse_cron_field(parts[4], 0, 6),
+        )
+
+    def matches(self, t: time.struct_time) -> bool:
+        return (
+            t.tm_min in self.minute
+            and t.tm_hour in self.hour
+            and t.tm_mday in self.dom
+            and t.tm_mon in self.month
+            and t.tm_wday in self.dow   # note: python Monday=0 like cron-ish
+        )
+
+
+@dataclasses.dataclass
+class Trigger:
+    id: str
+    app_id: str
+    kind: str                       # cron | webhook | slack | discord | teams
+    prompt: str = ""                # message fired into the session
+    cron: Optional[str] = None
+    webhook_secret: Optional[str] = None
+    enabled: bool = True
+    last_fired: float = 0.0
+    fire_count: int = 0
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+class TriggerManager:
+    def __init__(self, fire: Callable[[Trigger, dict], None]):
+        """``fire(trigger, payload)`` runs the bound app session (sync; the
+        manager calls it from worker threads)."""
+        self._fire = fire
+        self._triggers: dict[str, Trigger] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- CRUD ----------------------------------------------------------------
+    def add(
+        self,
+        app_id: str,
+        kind: str,
+        prompt: str = "",
+        cron: Optional[str] = None,
+        webhook_secret: Optional[str] = None,
+    ) -> Trigger:
+        if kind == "cron":
+            CronSchedule.parse(cron or "")   # validate
+        t = Trigger(
+            id=f"trg_{uuid.uuid4().hex[:12]}",
+            app_id=app_id, kind=kind, prompt=prompt, cron=cron,
+            webhook_secret=webhook_secret
+            or (uuid.uuid4().hex if kind != "cron" else None),
+        )
+        with self._lock:
+            self._triggers[t.id] = t
+        return t
+
+    def get(self, tid: str) -> Optional[Trigger]:
+        return self._triggers.get(tid)
+
+    def list(self, app_id: Optional[str] = None) -> list:
+        with self._lock:
+            ts = list(self._triggers.values())
+        return [t for t in ts if app_id is None or t.app_id == app_id]
+
+    def remove(self, tid: str) -> bool:
+        with self._lock:
+            return self._triggers.pop(tid, None) is not None
+
+    def set_enabled(self, tid: str, enabled: bool) -> None:
+        t = self._triggers.get(tid)
+        if t:
+            t.enabled = enabled
+
+    # -- firing --------------------------------------------------------------
+    def fire_webhook(self, tid: str, payload: dict, secret: str = "") -> bool:
+        t = self._triggers.get(tid)
+        if t is None or not t.enabled or t.kind == "cron":
+            return False
+        if t.webhook_secret and secret != t.webhook_secret:
+            raise PermissionError("bad webhook secret")
+        self._do_fire(t, payload)
+        return True
+
+    def _do_fire(self, t: Trigger, payload: dict):
+        t.last_fired = time.time()
+        t.fire_count += 1
+        try:
+            self._fire(t, payload)
+        except Exception:  # noqa: BLE001 — triggers must not kill the loop
+            traceback.print_exc()
+
+    # -- cron loop ------------------------------------------------------------
+    def tick(self, now: Optional[float] = None) -> int:
+        """Fire all cron triggers matching the current minute (exposed for
+        tests; the loop calls it once per minute)."""
+        st = time.localtime(now or time.time())
+        fired = 0
+        for t in self.list():
+            if t.kind != "cron" or not t.enabled or not t.cron:
+                continue
+            if CronSchedule.parse(t.cron).matches(st):
+                # debounce: once per minute
+                if time.time() - t.last_fired >= 59:
+                    self._do_fire(t, {"source": "cron"})
+                    fired += 1
+        return fired
+
+    def start(self):
+        def run():
+            while not self._stop.is_set():
+                self.tick()
+                # sleep to the start of the next minute
+                self._stop.wait(60 - (time.time() % 60))
+
+        self._thread = threading.Thread(
+            target=run, name="helix-triggers", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()

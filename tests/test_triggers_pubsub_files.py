@@ -1,0 +1,126 @@
+"""Triggers (cron/webhook), event bus, and filestore tests."""
+
+import time
+
+import numpy as np
+import pytest
+
+from helix_tpu.control.filestore import Filestore
+from helix_tpu.control.pubsub import EventBus
+from helix_tpu.control.triggers import CronSchedule, TriggerManager
+
+
+class TestCron:
+    def test_parse_and_match(self):
+        s = CronSchedule.parse("*/15 9-17 * * 1-5")
+        t = time.struct_time((2026, 7, 28, 10, 30, 0, 1, 0, 0))  # Tue 10:30
+        assert s.matches(t)
+        t2 = time.struct_time((2026, 7, 28, 10, 7, 0, 1, 0, 0))
+        assert not s.matches(t2)
+        t3 = time.struct_time((2026, 7, 26, 10, 30, 0, 6, 0, 0))  # Sunday
+        assert not s.matches(t3)
+
+    def test_bad_cron_rejected(self):
+        with pytest.raises(ValueError):
+            CronSchedule.parse("* * *")
+
+
+class TestTriggerManager:
+    def test_webhook_fire_and_secret(self):
+        fired = []
+        tm = TriggerManager(lambda t, p: fired.append((t.id, p)))
+        t = tm.add("app1", "webhook", prompt="handle event")
+        assert tm.fire_webhook(t.id, {"x": 1}, t.webhook_secret)
+        assert fired and fired[0][1] == {"x": 1}
+        with pytest.raises(PermissionError):
+            tm.fire_webhook(t.id, {}, "wrong")
+
+    def test_cron_tick_fires_matching(self):
+        fired = []
+        tm = TriggerManager(lambda t, p: fired.append(t.id))
+        tm.add("app1", "cron", cron="* * * * *")
+        n = tm.tick()
+        assert n == 1 and len(fired) == 1
+        # debounced within the same minute
+        assert tm.tick() == 0
+
+    def test_disabled_not_fired(self):
+        fired = []
+        tm = TriggerManager(lambda t, p: fired.append(t.id))
+        t = tm.add("a", "webhook")
+        tm.set_enabled(t.id, False)
+        assert not tm.fire_webhook(t.id, {}, t.webhook_secret)
+
+
+class TestEventBus:
+    def test_wildcard_subscribe(self):
+        bus = EventBus()
+        got = []
+        bus.subscribe("sessions.u1.*", lambda t, m: got.append((t, m)))
+        bus.publish("sessions.u1.updated", {"a": 1})
+        bus.publish("sessions.u2.updated", {"a": 2})
+        assert got == [("sessions.u1.updated", {"a": 1})]
+
+    def test_queue_group_round_robin(self):
+        bus = EventBus()
+        a, b = [], []
+        bus.subscribe("work", lambda t, m: a.append(m), group="workers")
+        bus.subscribe("work", lambda t, m: b.append(m), group="workers")
+        for _ in range(4):
+            bus.publish("work", {})
+        # each publish delivered to exactly one member
+        assert len(a) + len(b) == 4
+        assert len(a) == 2 and len(b) == 2
+
+    def test_request_reply(self):
+        bus = EventBus()
+
+        def responder(topic, msg):
+            bus.respond(msg, {"answer": msg["q"] * 2})
+
+        bus.subscribe("math.double", responder)
+        out = bus.request("math.double", {"q": 21}, timeout=2)
+        assert out["answer"] == 42
+
+    def test_request_no_responders(self):
+        bus = EventBus()
+        with pytest.raises(TimeoutError):
+            bus.request("nobody.home", {})
+
+    def test_unsubscribe(self):
+        bus = EventBus()
+        got = []
+        sub = bus.subscribe("t", lambda t, m: got.append(m))
+        bus.publish("t", {})
+        sub.unsubscribe()
+        bus.publish("t", {})
+        assert len(got) == 1
+
+
+class TestFilestore:
+    def test_write_read_list_delete(self, tmp_path):
+        fs = Filestore(str(tmp_path))
+        fs.write("u1", "docs/a.txt", b"hello")
+        assert fs.read("u1", "docs/a.txt") == b"hello"
+        files = fs.list("u1", "docs")
+        assert files[0]["path"].endswith("a.txt") and files[0]["size"] == 5
+        assert fs.delete("u1", "docs/a.txt")
+        assert not fs.delete("u1", "docs/a.txt")
+
+    def test_owner_isolation_and_traversal(self, tmp_path):
+        fs = Filestore(str(tmp_path))
+        fs.write("u1", "f.txt", b"u1 data")
+        with pytest.raises(FileNotFoundError):
+            fs.read("u2", "f.txt")
+        with pytest.raises(PermissionError):
+            fs.read("u2", "../u1/f.txt")
+
+    def test_signed_urls(self, tmp_path):
+        fs = Filestore(str(tmp_path))
+        fs.write("u1", "img.png", b"\x89PNG")
+        s = fs.sign("u1", "img.png", ttl=60)
+        assert fs.verify("u1", "img.png", s["expires"], s["signature"])
+        assert not fs.verify("u1", "img.png", s["expires"], "bad")
+        assert not fs.verify(
+            "u1", "img.png", int(time.time()) - 10, s["signature"]
+        )
