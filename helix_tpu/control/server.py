@@ -127,10 +127,46 @@ class ControlPlane:
                 )
                 return await client.chat({**body, "model": model})
 
+        from helix_tpu.desktop.stream import DesktopManager
+
+        self.desktops = DesktopManager()
+
+        def make_emitter(task, mode):
+            """Stream a task agent's steps into a watchable desktop session
+            (the reference's 'user watches the agent's desktop' loop)."""
+            session = self.desktops.create(name=f"{task.id}:{mode}", fps=5)
+            src = session.source
+            src.push_line(f"=== {mode} agent for task {task.id}: {task.title} ===")
+
+            def emit(step):
+                if step.kind == "llm":
+                    src.push_line(f"[llm] {step.result[:160]}")
+                elif step.kind == "tool":
+                    src.push_line(
+                        f"[tool:{step.name}] {str(step.arguments)[:120]}"
+                    )
+                    if step.result:
+                        src.push_line(f"  -> {step.result[:160]}")
+                elif step.kind == "answer":
+                    src.push_line(f"[answer] {step.result[:200]}")
+                elif step.kind == "error":
+                    src.push_line(f"[error] {step.error[:200]}")
+
+            def close():
+                src.push_line("=== agent finished ===")
+                # keep the session viewable briefly, then reap
+                import threading as _th
+
+                _th.Timer(60.0, self.desktops.destroy, args=(session.id,)).start()
+
+            return emit, close
+
         self.orchestrator = SpecTaskOrchestrator(
             self.task_store,
             self.git,
-            AgentExecutor(_ProviderLLM(self.providers)),
+            AgentExecutor(
+                _ProviderLLM(self.providers), make_emitter=make_emitter
+            ),
         ).start()
 
         # event bus (embedded-NATS equivalent) + filestore + triggers
@@ -282,6 +318,12 @@ class ControlPlane:
         r.add_get("/files/view", self.fs_view_signed)
         # user event stream (the reference's /ws/user)
         r.add_get("/ws/user", self.ws_user)
+        # desktop streaming (reference: /external-agents/{id}/ws/stream|input)
+        r.add_get("/api/v1/desktops", self.list_desktops)
+        r.add_post("/api/v1/desktops", self.create_desktop)
+        r.add_delete("/api/v1/desktops/{id}", self.delete_desktop)
+        r.add_get("/api/v1/desktops/{id}/ws/stream", self.ws_desktop_stream)
+        r.add_get("/api/v1/desktops/{id}/ws/input", self.ws_desktop_input)
         # openai passthrough
         r.add_get("/v1/models", self.models)
         for route in ("/v1/chat/completions", "/v1/completions", "/v1/embeddings"):
@@ -855,6 +897,77 @@ class ControlPlane:
         finally:
             for s in subs:
                 s.unsubscribe()
+        return ws
+
+    # -- desktop streaming ------------------------------------------------------
+    async def list_desktops(self, request):
+        return web.json_response({"desktops": self.desktops.list()})
+
+    async def create_desktop(self, request):
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        s = self.desktops.create(
+            name=body.get("name", ""), fps=float(body.get("fps", 10))
+        )
+        return web.json_response({"id": s.id, "name": s.name})
+
+    async def delete_desktop(self, request):
+        ok = self.desktops.destroy(request.match_info["id"])
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def ws_desktop_stream(self, request):
+        """Binary packet stream of the native tile codec (client decodes
+        with the same library or the web UI's JS decoder)."""
+        import asyncio as _asyncio
+
+        session = self.desktops.get(request.match_info["id"])
+        if session is None:
+            return _err(404, "desktop not found")
+        ws = web.WebSocketResponse(heartbeat=30, max_msg_size=0)
+        await ws.prepare(request)
+        loop = _asyncio.get_running_loop()
+        q: _asyncio.Queue = _asyncio.Queue(maxsize=30)
+
+        def on_packet(packet: bytes):
+            # drop-oldest backpressure (reference: jitter-buffer + drop)
+            def put():
+                if q.full():
+                    try:
+                        q.get_nowait()
+                    except _asyncio.QueueEmpty:
+                        pass
+                q.put_nowait(packet)
+
+            loop.call_soon_threadsafe(put)
+
+        sid = session.subscribe(on_packet)
+        try:
+            while not ws.closed:
+                try:
+                    packet = await _asyncio.wait_for(q.get(), timeout=5)
+                except _asyncio.TimeoutError:
+                    continue
+                await ws.send_bytes(packet)
+        finally:
+            session.unsubscribe(sid)
+        return ws
+
+    async def ws_desktop_input(self, request):
+        import json as _json
+
+        session = self.desktops.get(request.match_info["id"])
+        if session is None:
+            return _err(404, "desktop not found")
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        async for msg in ws:
+            if msg.type == web.WSMsgType.TEXT:
+                try:
+                    session.handle_input(_json.loads(msg.data))
+                except Exception:  # noqa: BLE001
+                    pass
         return ws
 
     # -- git smart HTTP --------------------------------------------------------

@@ -1,0 +1,3 @@
+from helix_tpu.desktop.streamcore import StreamDecoder, StreamEncoder
+
+__all__ = ["StreamEncoder", "StreamDecoder"]

@@ -1,0 +1,179 @@
+"""Desktop session streaming: frame sources -> native codec -> WebSocket.
+
+The headless counterpart of the reference's desktop video path
+(``SURVEY.md`` §3.5: compositor -> zero-copy capture -> encoder ladder ->
+H.264 over WS -> browser WebCodecs).  On a TPU node there is no GPU
+compositor; agent "desktops" render their activity into a framebuffer
+(``TextScreenSource`` — the agent terminal view), the native tile codec
+(``native/streamcore``) encodes damage, and packets fan out to WebSocket
+subscribers; input events flow the reverse way into the source.  The
+client-side decoder is the same native library (plus a browser JS decoder
+in the web UI).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+import numpy as np
+
+from helix_tpu.desktop.streamcore import StreamEncoder
+
+
+class TextScreenSource:
+    """A scrolling text screen rendered to BGRA — the visible surface of an
+    in-process agent (steps, logs, chat), standing in for a compositor."""
+
+    def __init__(self, width: int = 960, height: int = 540,
+                 max_lines: int = 2000):
+        self.width = width
+        self.height = height
+        self._lines: list = []
+        self._max_lines = max_lines
+        self._lock = threading.Lock()
+        self._dirty = True
+        self._frame = np.zeros((height, width, 4), np.uint8)
+        self._input_log: list = []
+
+    def push_line(self, text: str) -> None:
+        with self._lock:
+            for chunk in text.splitlines() or [""]:
+                self._lines.append(chunk[:200])
+            self._lines = self._lines[-self._max_lines:]
+            self._dirty = True
+
+    def input(self, event: dict) -> None:
+        """Input events (keyboard) append to the screen as user input —
+        the steering channel of the reference's desktop sessions."""
+        self._input_log.append(event)
+        if event.get("type") == "text":
+            self.push_line(f"> {event.get('text', '')}")
+
+    def get_frame(self) -> np.ndarray:
+        with self._lock:
+            if not self._dirty:
+                return self._frame
+            from PIL import Image, ImageDraw
+
+            img = Image.new("RGBA", (self.width, self.height), (18, 18, 24, 255))
+            draw = ImageDraw.Draw(img)
+            line_h = 14
+            max_rows = self.height // line_h - 1
+            rows = self._lines[-max_rows:]
+            for i, line in enumerate(rows):
+                draw.text((8, 4 + i * line_h), line, fill=(220, 220, 210, 255))
+            rgba = np.asarray(img, np.uint8)
+            self._frame = rgba[:, :, [2, 1, 0, 3]].copy()  # RGBA -> BGRA
+            self._dirty = False
+            return self._frame
+
+
+class DesktopSession:
+    """One streamed desktop: source + encoder + subscriber fanout."""
+
+    def __init__(self, source, fps: float = 10.0, name: str = ""):
+        self.id = f"dsk_{uuid.uuid4().hex[:12]}"
+        self.name = name
+        self.source = source
+        self.fps = fps
+        self.encoder = StreamEncoder(source.width, source.height)
+        self._subs: dict[str, Callable[[bytes], None]] = {}
+        self._need_keyframe = False
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.created = time.time()
+
+    def subscribe(self, cb: Callable[[bytes], None]) -> str:
+        sid = uuid.uuid4().hex
+        with self._lock:
+            self._subs[sid] = cb
+            self._need_keyframe = True
+        return sid
+
+    def unsubscribe(self, sid: str) -> None:
+        with self._lock:
+            self._subs.pop(sid, None)
+
+    def handle_input(self, event: dict) -> None:
+        if hasattr(self.source, "input"):
+            self.source.input(event)
+
+    def _tick(self) -> Optional[bytes]:
+        frame = self.source.get_frame()
+        with self._lock:
+            kf = self._need_keyframe
+            self._need_keyframe = False
+            subs = list(self._subs.values())
+        packet = self.encoder.encode(frame, keyframe=kf)
+        if packet is not None:
+            for cb in subs:
+                try:
+                    cb(packet)
+                except Exception:  # noqa: BLE001 — dead subscriber
+                    pass
+        return packet
+
+    def start(self):
+        def run():
+            period = 1.0 / self.fps
+            while not self._stop.is_set():
+                t0 = time.monotonic()
+                self._tick()
+                dt = time.monotonic() - t0
+                self._stop.wait(max(period - dt, 0.01))
+
+        self._thread = threading.Thread(
+            target=run, name=f"helix-desktop-{self.id}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+
+class DesktopManager:
+    """Session registry (the hydra dev-container registry analogue)."""
+
+    def __init__(self):
+        self._sessions: dict[str, DesktopSession] = {}
+        self._lock = threading.Lock()
+
+    def create(self, name: str = "", fps: float = 10.0,
+               source=None) -> DesktopSession:
+        src = source or TextScreenSource()
+        s = DesktopSession(src, fps=fps, name=name).start()
+        with self._lock:
+            self._sessions[s.id] = s
+        return s
+
+    def get(self, sid: str) -> Optional[DesktopSession]:
+        return self._sessions.get(sid)
+
+    def list(self) -> list:
+        with self._lock:
+            return [
+                {
+                    "id": s.id, "name": s.name, "fps": s.fps,
+                    "width": s.source.width, "height": s.source.height,
+                    "created": s.created,
+                    "stats": s.encoder.stats,
+                }
+                for s in self._sessions.values()
+            ]
+
+    def destroy(self, sid: str) -> bool:
+        with self._lock:
+            s = self._sessions.pop(sid, None)
+        if s:
+            s.stop()
+            return True
+        return False
+
+    def stop_all(self):
+        for sid in list(self._sessions):
+            self.destroy(sid)

@@ -1,0 +1,143 @@
+"""ctypes bindings for the native streaming core (native/streamcore).
+
+Builds the shared library on first use if missing (make), mirroring the
+reference's pattern of native media elements behind a narrow FFI
+(``desktop/wayland-display-core`` cdylib + cgo in ``api/pkg/desktop``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "streamcore",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhxstream.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR], check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.hx_encoder_create.restype = ctypes.c_void_p
+        lib.hx_encoder_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.hx_encoder_destroy.argtypes = [ctypes.c_void_p]
+        lib.hx_encode.restype = ctypes.c_long
+        lib.hx_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
+        lib.hx_encoder_stats.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.hx_decoder_create.restype = ctypes.c_void_p
+        lib.hx_decoder_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.hx_decoder_destroy.argtypes = [ctypes.c_void_p]
+        lib.hx_decode.restype = ctypes.c_int
+        lib.hx_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long
+        ]
+        lib.hx_decoder_frame.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.hx_decoder_frame.argtypes = [ctypes.c_void_p]
+        lib.hx_decoder_frame_id.restype = ctypes.c_uint32
+        lib.hx_decoder_frame_id.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class StreamEncoder:
+    """Damage-tracking tile encoder. Frames: uint8 [H, W, 4] (BGRA)."""
+
+    def __init__(self, width: int, height: int):
+        self._lib = _load()
+        self._h = self._lib.hx_encoder_create(width, height)
+        if not self._h:
+            raise ValueError("bad encoder dimensions")
+        self.width = width
+        self.height = height
+
+    def encode(self, frame: np.ndarray, keyframe: bool = False) -> Optional[bytes]:
+        """-> packet bytes, or None when nothing changed."""
+        frame = np.ascontiguousarray(frame, dtype=np.uint8)
+        assert frame.shape == (self.height, self.width, 4), frame.shape
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.hx_encode(
+            self._h, frame.tobytes(), 1 if keyframe else 0,
+            ctypes.byref(out),
+        )
+        if n < 0:
+            raise RuntimeError(f"encode failed: {n}")
+        if n == 0:
+            return None
+        return ctypes.string_at(out, n)
+
+    @property
+    def stats(self) -> dict:
+        f = ctypes.c_uint64()
+        t = ctypes.c_uint64()
+        b = ctypes.c_uint64()
+        self._lib.hx_encoder_stats(
+            self._h, ctypes.byref(f), ctypes.byref(t), ctypes.byref(b)
+        )
+        return {
+            "frames": f.value, "tiles": t.value, "bytes_out": b.value,
+        }
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hx_encoder_destroy(self._h)
+            self._h = None
+
+
+class StreamDecoder:
+    def __init__(self, width: int, height: int):
+        self._lib = _load()
+        self._h = self._lib.hx_decoder_create(width, height)
+        if not self._h:
+            raise ValueError("bad decoder dimensions")
+        self.width = width
+        self.height = height
+
+    def decode(self, packet: bytes) -> np.ndarray:
+        rc = self._lib.hx_decode(self._h, packet, len(packet))
+        if rc != 0:
+            raise RuntimeError(f"decode failed: {rc}")
+        return self.frame
+
+    @property
+    def frame(self) -> np.ndarray:
+        ptr = self._lib.hx_decoder_frame(self._h)
+        buf = ctypes.string_at(
+            ptr, self.width * self.height * 4
+        )
+        return np.frombuffer(buf, np.uint8).reshape(
+            self.height, self.width, 4
+        )
+
+    @property
+    def frame_id(self) -> int:
+        return self._lib.hx_decoder_frame_id(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hx_decoder_destroy(self._h)
+            self._h = None

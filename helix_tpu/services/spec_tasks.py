@@ -275,10 +275,15 @@ class AgentExecutor(Executor):
         "with the filesystem tool, then answer with a one-line summary."
     )
 
-    def __init__(self, llm, model: str = "", max_iterations: int = 12):
+    def __init__(self, llm, model: str = "", max_iterations: int = 12,
+                 make_emitter=None):
+        """``make_emitter(task, mode)`` may return (emit_fn, close_fn) to
+        observe agent steps live — the control plane uses it to stream the
+        agent's activity into a watchable desktop session."""
         self.llm = llm
         self.model = model
         self.max_iterations = max_iterations
+        self.make_emitter = make_emitter
 
     def run(self, task, workspace, mode, feedback: str = "") -> str:
         import asyncio
@@ -290,6 +295,9 @@ class AgentExecutor(Executor):
         prompt = (
             self.PLAN_PROMPT if mode == "plan" else self.IMPL_PROMPT
         ).format(task_id=task.id, spec_path=task.spec_path or "specs/")
+        emit, close = (lambda s: None), (lambda: None)
+        if self.make_emitter is not None:
+            emit, close = self.make_emitter(task, mode)
         agent = Agent(
             AgentConfig(
                 prompt=prompt, model=self.model,
@@ -297,11 +305,15 @@ class AgentExecutor(Executor):
             ),
             SkillRegistry([filesystem_skill(workspace)]),
             self.llm,
+            emitter=emit,
         )
         message = f"Task: {task.title}\n\n{task.description}"
         if feedback:
             message += f"\n\nReview feedback to address:\n{feedback}"
-        answer, steps = asyncio.run(agent.run(message))
+        try:
+            answer, steps = asyncio.run(agent.run(message))
+        finally:
+            close()
         return answer
 
 

@@ -1,0 +1,254 @@
+// helix-tpu desktop streaming core.
+//
+// The native data plane for desktop session streaming — the C++ counterpart
+// of the reference's Rust wayland-display-core + gst-pipewire-zerocopy
+// (compositor frames -> encoder -> WebSocket; SURVEY.md §2.3).  This build
+// has no GPU encoder, so the codec is a damage-tracking tile codec tuned
+// for desktop content (large static regions, local changes):
+//
+//   - frames are BGRA8888; the encoder keeps the previous frame and splits
+//     the surface into TILE x TILE tiles;
+//   - per frame, changed tiles are detected with memcmp, packed, and
+//     deflate-compressed (zlib) into one packet:
+//       header:  magic 'HXF1' | u32 frame_id | u16 w | u16 h | u16 ntiles
+//                | u8 keyframe | u8 reserved
+//       tiles:   u16 tx | u16 ty  (tile coords), then the zlib stream of
+//                all tile pixels concatenated in listed order;
+//   - keyframes (all tiles) on demand for late joiners;
+//   - the decoder applies tiles onto its copy — bit-exact reconstruction.
+//
+// Exported as a C ABI consumed via ctypes (helix_tpu/desktop/streamcore.py);
+// one encoder/decoder instance per session, no global state, no threads —
+// the Python side owns scheduling (frame pacing / backpressure), matching
+// the reference's design where GStreamer pacing lives outside the element.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+#include <zlib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x31465848;  // 'HXF1' little-endian
+constexpr int kTile = 32;                // pixels per tile edge
+constexpr int kBytesPerPx = 4;           // BGRA
+
+struct Header {
+  uint32_t magic;
+  uint32_t frame_id;
+  uint16_t width;
+  uint16_t height;
+  uint16_t ntiles;
+  uint8_t keyframe;
+  uint8_t reserved;
+};
+static_assert(sizeof(Header) == 16, "packed header is 16 bytes");
+
+struct Encoder {
+  int width = 0;
+  int height = 0;
+  int tiles_x = 0;
+  int tiles_y = 0;
+  uint32_t frame_id = 0;
+  std::vector<uint8_t> prev;     // previous frame
+  std::vector<uint8_t> scratch;  // tile-concat buffer
+  std::vector<uint8_t> packet;   // output
+  // stats
+  uint64_t frames_encoded = 0;
+  uint64_t tiles_sent = 0;
+  uint64_t bytes_out = 0;
+};
+
+struct Decoder {
+  int width = 0;
+  int height = 0;
+  std::vector<uint8_t> frame;
+  std::vector<uint8_t> scratch;
+  uint32_t last_frame_id = 0;
+};
+
+int tile_h_at(const Encoder& e, int ty) {
+  int h = e.height - ty * kTile;
+  return h > kTile ? kTile : h;
+}
+int tile_w_at(const Encoder& e, int tx) {
+  int w = e.width - tx * kTile;
+  return w > kTile ? kTile : w;
+}
+
+// copy one tile of the frame into dst (tight-packed)
+size_t copy_tile(const uint8_t* frame, int fw, int tx, int ty, int tw, int th,
+                 uint8_t* dst) {
+  const int row_bytes = tw * kBytesPerPx;
+  for (int r = 0; r < th; ++r) {
+    const uint8_t* src =
+        frame + ((size_t)(ty * kTile + r) * fw + (size_t)tx * kTile) * kBytesPerPx;
+    std::memcpy(dst + (size_t)r * row_bytes, src, row_bytes);
+  }
+  return (size_t)th * row_bytes;
+}
+
+bool tile_changed(const uint8_t* a, const uint8_t* b, int fw, int tx, int ty,
+                  int tw, int th) {
+  for (int r = 0; r < th; ++r) {
+    size_t off =
+        ((size_t)(ty * kTile + r) * fw + (size_t)tx * kTile) * kBytesPerPx;
+    if (std::memcmp(a + off, b + off, (size_t)tw * kBytesPerPx) != 0)
+      return true;
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* hx_encoder_create(int width, int height) {
+  if (width <= 0 || height <= 0 || width > 16384 || height > 16384)
+    return nullptr;
+  auto* e = new Encoder();
+  e->width = width;
+  e->height = height;
+  e->tiles_x = (width + kTile - 1) / kTile;
+  e->tiles_y = (height + kTile - 1) / kTile;
+  e->prev.assign((size_t)width * height * kBytesPerPx, 0);
+  e->scratch.resize((size_t)width * height * kBytesPerPx);
+  return e;
+}
+
+void hx_encoder_destroy(void* enc) { delete static_cast<Encoder*>(enc); }
+
+// Encode one frame; returns packet size (0 = no damage, <0 = error).
+// force_keyframe sends every tile regardless of damage.
+long hx_encode(void* enc, const uint8_t* frame, int force_keyframe,
+               const uint8_t** out) {
+  auto* e = static_cast<Encoder*>(enc);
+  if (!e || !frame) return -1;
+
+  std::vector<std::pair<uint16_t, uint16_t>> changed;
+  size_t raw_size = 0;
+  for (int ty = 0; ty < e->tiles_y; ++ty) {
+    for (int tx = 0; tx < e->tiles_x; ++tx) {
+      const int tw = tile_w_at(*e, tx), th = tile_h_at(*e, ty);
+      if (force_keyframe ||
+          tile_changed(frame, e->prev.data(), e->width, tx, ty, tw, th)) {
+        changed.emplace_back((uint16_t)tx, (uint16_t)ty);
+        raw_size += copy_tile(frame, e->width, tx, ty, tw, th,
+                              e->scratch.data() + raw_size);
+      }
+    }
+  }
+  e->frame_id++;
+  if (changed.empty()) return 0;
+
+  uLongf comp_bound = compressBound((uLong)raw_size);
+  const size_t tiles_bytes = changed.size() * 4;
+  e->packet.resize(sizeof(Header) + tiles_bytes + comp_bound);
+
+  auto* h = reinterpret_cast<Header*>(e->packet.data());
+  h->magic = kMagic;
+  h->frame_id = e->frame_id;
+  h->width = (uint16_t)e->width;
+  h->height = (uint16_t)e->height;
+  h->ntiles = (uint16_t)changed.size();
+  h->keyframe = force_keyframe ? 1 : 0;
+  h->reserved = 0;
+
+  uint8_t* p = e->packet.data() + sizeof(Header);
+  for (auto& t : changed) {
+    std::memcpy(p, &t.first, 2);
+    std::memcpy(p + 2, &t.second, 2);
+    p += 4;
+  }
+  uLongf comp_size = comp_bound;
+  if (compress2(p, &comp_size, e->scratch.data(), (uLong)raw_size,
+                Z_BEST_SPEED) != Z_OK)
+    return -2;
+  e->packet.resize(sizeof(Header) + tiles_bytes + comp_size);
+
+  std::memcpy(e->prev.data(), frame,
+              (size_t)e->width * e->height * kBytesPerPx);
+  e->frames_encoded++;
+  e->tiles_sent += changed.size();
+  e->bytes_out += e->packet.size();
+  *out = e->packet.data();
+  return (long)e->packet.size();
+}
+
+void hx_encoder_stats(void* enc, uint64_t* frames, uint64_t* tiles,
+                      uint64_t* bytes) {
+  auto* e = static_cast<Encoder*>(enc);
+  if (!e) return;
+  if (frames) *frames = e->frames_encoded;
+  if (tiles) *tiles = e->tiles_sent;
+  if (bytes) *bytes = e->bytes_out;
+}
+
+void* hx_decoder_create(int width, int height) {
+  if (width <= 0 || height <= 0) return nullptr;
+  auto* d = new Decoder();
+  d->width = width;
+  d->height = height;
+  d->frame.assign((size_t)width * height * kBytesPerPx, 0);
+  d->scratch.resize((size_t)width * height * kBytesPerPx);
+  return d;
+}
+
+void hx_decoder_destroy(void* dec) { delete static_cast<Decoder*>(dec); }
+
+// Apply one packet; returns 0 on success. The reconstructed frame is
+// readable via hx_decoder_frame.
+int hx_decode(void* dec, const uint8_t* packet, long size) {
+  auto* d = static_cast<Decoder*>(dec);
+  if (!d || !packet || size < (long)sizeof(Header)) return -1;
+  Header h;
+  std::memcpy(&h, packet, sizeof(Header));
+  if (h.magic != kMagic) return -2;
+  if (h.width != d->width || h.height != d->height) return -3;
+  const size_t tiles_bytes = (size_t)h.ntiles * 4;
+  if ((size_t)size < sizeof(Header) + tiles_bytes) return -4;
+
+  const uint8_t* tiles = packet + sizeof(Header);
+  const uint8_t* comp = tiles + tiles_bytes;
+  const size_t comp_size = size - sizeof(Header) - tiles_bytes;
+
+  uLongf raw_size = (uLongf)d->scratch.size();
+  if (uncompress(d->scratch.data(), &raw_size, comp, (uLong)comp_size) != Z_OK)
+    return -5;
+
+  size_t off = 0;
+  for (int i = 0; i < h.ntiles; ++i) {
+    uint16_t tx, ty;
+    std::memcpy(&tx, tiles + (size_t)i * 4, 2);
+    std::memcpy(&ty, tiles + (size_t)i * 4 + 2, 2);
+    int tw = d->width - tx * kTile;
+    tw = tw > kTile ? kTile : tw;
+    int th = d->height - ty * kTile;
+    th = th > kTile ? kTile : th;
+    if (tw <= 0 || th <= 0) return -6;
+    const int row_bytes = tw * kBytesPerPx;
+    for (int r = 0; r < th; ++r) {
+      if (off + row_bytes > raw_size) return -7;
+      std::memcpy(d->frame.data() +
+                      ((size_t)(ty * kTile + r) * d->width +
+                       (size_t)tx * kTile) * kBytesPerPx,
+                  d->scratch.data() + off, row_bytes);
+      off += row_bytes;
+    }
+  }
+  d->last_frame_id = h.frame_id;
+  return 0;
+}
+
+const uint8_t* hx_decoder_frame(void* dec) {
+  auto* d = static_cast<Decoder*>(dec);
+  return d ? d->frame.data() : nullptr;
+}
+
+uint32_t hx_decoder_frame_id(void* dec) {
+  auto* d = static_cast<Decoder*>(dec);
+  return d ? d->last_frame_id : 0;
+}
+
+}  // extern "C"
