@@ -246,6 +246,7 @@ class ControlPlane:
     def build_app(self) -> web.Application:
         app = web.Application(middlewares=[self.auth_middleware])
         r = app.router
+        r.add_get("/", self.web_ui)
         r.add_get("/healthz", self.healthz)
         # runner control loop
         r.add_post("/api/v1/runners/{id}/heartbeat", self.heartbeat)
@@ -334,6 +335,16 @@ class ControlPlane:
         return web.json_response(
             {"status": "ok", "runners": len(self.router.runners())}
         )
+
+    async def web_ui(self, request):
+        import os as _os
+
+        path = _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)), "..", "web",
+            "index.html",
+        )
+        with open(path) as f:
+            return web.Response(text=f.read(), content_type="text/html")
 
     # -- runner control loop ----------------------------------------------
     async def heartbeat(self, request):
