@@ -230,10 +230,13 @@ class ControlPlane:
         runner id + network position, like the reference's heartbeats)."""
         user = self.auth.authenticate(request.headers.get("Authorization"))
         request["user"] = user
-        open_paths = ("/healthz", "/metrics", "/api/v1/runners")
+        # open: health, runner control loop, the UI shell itself (its API
+        # calls still authenticate), and signed file views (HMAC-gated)
+        open_paths = ("/healthz", "/metrics", "/api/v1/runners", "/files/view")
         if (
             self.auth_required
             and user is None
+            and request.path != "/"
             and not request.path.startswith(open_paths)
         ):
             return _err(401, "authentication required")
@@ -337,14 +340,18 @@ class ControlPlane:
         )
 
     async def web_ui(self, request):
-        import os as _os
+        if not hasattr(self, "_web_ui_html"):
+            import os as _os
 
-        path = _os.path.join(
-            _os.path.dirname(_os.path.abspath(__file__)), "..", "web",
-            "index.html",
+            path = _os.path.join(
+                _os.path.dirname(_os.path.abspath(__file__)), "..", "web",
+                "index.html",
+            )
+            with open(path) as f:
+                self._web_ui_html = f.read()
+        return web.Response(
+            text=self._web_ui_html, content_type="text/html"
         )
-        with open(path) as f:
-            return web.Response(text=f.read(), content_type="text/html")
 
     # -- runner control loop ----------------------------------------------
     async def heartbeat(self, request):

@@ -317,12 +317,21 @@ class Engine:
     # public API
     # ------------------------------------------------------------------
 
-    def add_request(self, req: Request) -> None:
+    def validate_request(self, req: Request) -> Optional[str]:
+        """Admission pre-check, safe from any thread; None = acceptable."""
         if len(req.prompt_tokens) > self.cfg.max_prefill_len:
-            raise ValueError(
+            return (
                 f"prompt ({len(req.prompt_tokens)} tokens) exceeds "
                 f"max_prefill_len {self.cfg.max_prefill_len}"
             )
+        if not req.prompt_tokens:
+            return "empty prompt"
+        return None
+
+    def add_request(self, req: Request) -> None:
+        err = self.validate_request(req)
+        if err:
+            raise ValueError(err)
         self._requests[req.id] = req
         self.waiting.append(req)
 

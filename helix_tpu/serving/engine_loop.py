@@ -25,6 +25,7 @@ class TokenEvent:
     token_id: int
     finished: bool
     finish_reason: Optional[str] = None
+    error: Optional[str] = None
 
 
 class EngineLoop:
@@ -43,6 +44,17 @@ class EngineLoop:
     # -- called from any thread --------------------------------------------
 
     def submit(self, req: Request, on_event: Callable[[TokenEvent], None]):
+        # reject unservable requests on the caller's thread with a clean
+        # event — the engine thread must never die on bad input
+        err = self.engine.validate_request(req)
+        if err:
+            on_event(
+                TokenEvent(
+                    request_id=req.id, token_id=-1, finished=True,
+                    finish_reason="error", error=err,
+                )
+            )
+            return
         self._inbox.put((req, on_event))
         self._wake.set()
 
@@ -75,8 +87,16 @@ class EngineLoop:
                 self.engine.abort(item)
                 self._subscribers.pop(item, None)
             else:
-                self._subscribers[item.id] = on_event
-                self.engine.add_request(item)
+                try:
+                    self.engine.add_request(item)
+                    self._subscribers[item.id] = on_event
+                except Exception as e:  # noqa: BLE001 — thread must survive
+                    on_event(
+                        TokenEvent(
+                            request_id=item.id, token_id=-1, finished=True,
+                            finish_reason="error", error=str(e),
+                        )
+                    )
 
     def _run(self):
         while not self._stop.is_set():
@@ -85,7 +105,26 @@ class EngineLoop:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
-            emitted = self.engine.step()
+            try:
+                emitted = self.engine.step()
+            except Exception as e:  # noqa: BLE001 — fail requests, not the loop
+                import traceback
+
+                traceback.print_exc()
+                for req in list(self.engine.slots) + list(self.engine.waiting):
+                    if req is None:
+                        continue
+                    self.engine.abort(req.id)
+                    cb = self._subscribers.pop(req.id, None)
+                    if cb:
+                        cb(
+                            TokenEvent(
+                                request_id=req.id, token_id=-1,
+                                finished=True, finish_reason="error",
+                                error=f"engine step failed: {e}",
+                            )
+                        )
+                continue
             self.steps += 1
             for req, token in emitted:
                 cb = self._subscribers.get(req.id)

@@ -38,6 +38,11 @@ def _error(status: int, message: str, etype: str = "invalid_request_error"):
     )
 
 
+class EngineRequestError(Exception):
+    """A request the engine rejected or failed mid-flight; surfaces as a
+    structured 400/500 instead of a dead stream."""
+
+
 class OpenAIServer:
     def __init__(self, registry: ModelRegistry, metrics=None):
         self.registry = registry
@@ -164,6 +169,8 @@ class OpenAIServer:
         try:
             while True:
                 ev = await asyncio.wait_for(q.get(), timeout=300)
+                if ev.error:
+                    raise EngineRequestError(ev.error)
                 is_eos = ev.token_id in served.tokenizer.eos_ids
                 delta = "" if is_eos else detok.push(ev.token_id)
                 # serving-level stop strings
@@ -247,9 +254,10 @@ class OpenAIServer:
             first = True
             finish_reason = None
             ntokens = 0
-            async for delta, tok, finished, reason in self._generate(
+            try:
+              async for delta, tok, finished, reason in self._generate(
                 served, prompt_ids, sampling, extra
-            ):
+              ):
                 ntokens += 1
                 chunk_delta = {}
                 if first:
@@ -275,6 +283,8 @@ class OpenAIServer:
                 )
                 if finished:
                     break
+            except EngineRequestError as e:
+                await send({"error": {"message": str(e)}})
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
@@ -282,14 +292,17 @@ class OpenAIServer:
         text_parts = []
         finish_reason = "stop"
         ntokens = 0
-        async for delta, tok, finished, reason in self._generate(
+        try:
+          async for delta, tok, finished, reason in self._generate(
             served, prompt_ids, sampling, extra
-        ):
+          ):
             text_parts.append(delta)
             ntokens += 1
             if finished:
                 finish_reason = reason or "stop"
                 break
+        except EngineRequestError as e:
+            return _error(400, str(e))
         return web.json_response(
             {
                 "id": rid,
@@ -337,14 +350,19 @@ class OpenAIServer:
                 headers={"Content-Type": "text/event-stream"}
             )
             await resp.prepare(request)
-            async for delta, tok, finished, reason in self._generate(
+            try:
+              async for delta, tok, finished, reason in self._generate(
                 served, prompt_ids, sampling
-            ):
+              ):
                 await resp.write(
                     f"data: {json.dumps({'id': rid, 'object': 'text_completion', 'created': created, 'model': model, 'choices': [{'index': 0, 'text': delta, 'finish_reason': reason if finished else None}]})}\n\n".encode()
                 )
                 if finished:
                     break
+            except EngineRequestError as e:
+                await resp.write(
+                    f"data: {json.dumps({'error': {'message': str(e)}})}\n\n".encode()
+                )
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
             return resp
@@ -352,14 +370,17 @@ class OpenAIServer:
         parts = []
         finish_reason = "stop"
         n = 0
-        async for delta, tok, finished, reason in self._generate(
+        try:
+          async for delta, tok, finished, reason in self._generate(
             served, prompt_ids, sampling
-        ):
+          ):
             parts.append(delta)
             n += 1
             if finished:
                 finish_reason = reason or "stop"
                 break
+        except EngineRequestError as e:
+            return _error(400, str(e))
         return web.json_response(
             {
                 "id": rid,
@@ -478,9 +499,10 @@ class OpenAIServer:
             )
             n = 0
             stop_reason = "end_turn"
-            async for delta, tok, finished, reason in self._generate(
+            try:
+              async for delta, tok, finished, reason in self._generate(
                 served, prompt_ids, sampling
-            ):
+              ):
                 n += 1
                 if delta:
                     await ev(
@@ -496,6 +518,9 @@ class OpenAIServer:
                         "max_tokens" if reason == "length" else "end_turn"
                     )
                     break
+            except EngineRequestError as e:
+                await ev("error", {"type": "error",
+                                   "error": {"message": str(e)}})
             await ev(
                 "content_block_stop", {"type": "content_block_stop", "index": 0}
             )
@@ -514,14 +539,17 @@ class OpenAIServer:
         parts = []
         n = 0
         stop_reason = "end_turn"
-        async for delta, tok, finished, reason in self._generate(
+        try:
+          async for delta, tok, finished, reason in self._generate(
             served, prompt_ids, sampling
-        ):
+          ):
             parts.append(delta)
             n += 1
             if finished:
                 stop_reason = "max_tokens" if reason == "length" else "end_turn"
                 break
+        except EngineRequestError as e:
+            return _error(400, str(e), "invalid_request_error")
         return web.json_response(
             {
                 "id": rid,

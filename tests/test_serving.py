@@ -235,3 +235,29 @@ class TestTokenizer:
         for i in ids:
             out += detok.push(i)
         assert out == "é🚀x"
+
+
+class TestEngineErrorSurface:
+    def test_oversized_prompt_clean_400_and_engine_survives(self, server_url):
+        """An unservable prompt must return a structured error AND leave the
+        engine alive for subsequent requests (regression: the engine thread
+        used to die on admission errors, hanging every later request)."""
+        big = "x" * 4000   # byte tokenizer -> way over max_prefill_len=128
+        r = requests.post(
+            f"{server_url}/v1/chat/completions",
+            json={"model": "tiny-chat",
+                  "messages": [{"role": "user", "content": big}],
+                  "max_tokens": 4},
+            timeout=60,
+        )
+        assert r.status_code == 400
+        assert "max_prefill_len" in r.json()["error"]["message"]
+        # engine still serves
+        r2 = requests.post(
+            f"{server_url}/v1/chat/completions",
+            json={"model": "tiny-chat",
+                  "messages": [{"role": "user", "content": "ok"}],
+                  "max_tokens": 4, "temperature": 0},
+            timeout=120,
+        )
+        assert r2.status_code == 200, r2.text
