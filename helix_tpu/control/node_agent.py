@@ -109,6 +109,7 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         **{k: v for k, v in pm.engine.items()},
     )
     engine = Engine(model_cfg, params, ecfg)
+    engine.warmup()   # compile prefill/decode before the model goes routable
     loop = EngineLoop(engine, name=pm.name).start()
     return ServedModel(
         name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,

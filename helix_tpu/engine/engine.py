@@ -344,6 +344,35 @@ class Engine:
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
 
+    def reap_stuck(self, max_queue_seconds: float = 600.0) -> list:
+        """Abort requests stuck in the wait queue beyond a budget (page
+        starvation under a long-running batch).  The engine-side analogue of
+        the reference's auto-wake-stuck-interactions loop (SURVEY.md §5).
+        Returns the aborted requests."""
+        now = time.monotonic()
+        stuck = [
+            r for r in list(self.waiting)
+            if now - r.submit_time > max_queue_seconds
+        ]
+        for r in stuck:
+            self._finish(r, FinishReason.ABORT)
+        return stuck
+
+    def warmup(self) -> None:
+        """Compile the decode step and the smallest prefill bucket ahead of
+        traffic (profile-apply time), so first-token latency excludes XLA
+        compilation.  Runs a dummy request against the garbage page only."""
+        if self.model_cfg.mrope_sections is not None:
+            return  # VL prefill shape depends on image buckets; skip
+        req = Request(
+            id="__warmup__",
+            prompt_tokens=[0] * min(4, self.cache_cfg.page_size),
+            sampling=SamplingParams(max_tokens=1),
+        )
+        table = np.zeros((self.cache_cfg.max_pages_per_seq,), np.int32)
+        self._prefill(req, table)          # compiles smallest bucket
+        self._decode_step()                # compiles fused decode (no slots)
+
     def step(self) -> list[tuple[Request, int]]:
         """Admit + prefill waiting requests, then one decode step.
 

@@ -29,14 +29,17 @@ class TokenEvent:
 
 
 class EngineLoop:
-    def __init__(self, engine: Engine, name: str = "engine"):
+    def __init__(self, engine: Engine, name: str = "engine",
+                 max_queue_seconds: float = 600.0):
         self.engine = engine
         self.name = name
+        self.max_queue_seconds = max_queue_seconds
         self._inbox: "queue.Queue" = queue.Queue()
         self._subscribers: dict[str, Callable[[TokenEvent], None]] = {}
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_reap = time.monotonic()
         # serving metrics (scraped by /metrics)
         self.steps = 0
         self.started_at = time.monotonic()
@@ -101,6 +104,18 @@ class EngineLoop:
     def _run(self):
         while not self._stop.is_set():
             self._drain_inbox()
+            if time.monotonic() - self._last_reap > 10.0:
+                self._last_reap = time.monotonic()
+                for req in self.engine.reap_stuck(self.max_queue_seconds):
+                    cb = self._subscribers.pop(req.id, None)
+                    if cb:
+                        cb(
+                            TokenEvent(
+                                request_id=req.id, token_id=-1,
+                                finished=True, finish_reason="error",
+                                error="request timed out in queue",
+                            )
+                        )
             if not self.engine.has_work():
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()

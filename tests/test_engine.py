@@ -280,3 +280,42 @@ class TestEngineE2E:
         outs = eng.generate(prompts, SamplingParams(temperature=0.0, max_tokens=4))
         for g in outs:
             assert len(g) == 4
+
+
+class TestResilience:
+    def test_warmup_compiles_and_serves(self, tiny_model):
+        cfg, params = tiny_model
+        eng = Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=2, page_size=4, num_pages=64,
+                max_pages_per_seq=16, max_prefill_len=64,
+                attn_backend="reference",
+            ),
+        )
+        eng.warmup()
+        # warmup must not leak state: a real request still works
+        out = eng.generate([[1, 2, 3]], SamplingParams(temperature=0.0, max_tokens=3))
+        assert len(out[0]) == 3
+        assert eng.allocator.free_pages == 63  # all pages back
+
+    def test_reap_stuck_queue(self, tiny_model):
+        cfg, params = tiny_model
+        eng = Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=1, page_size=4, num_pages=64,
+                max_pages_per_seq=16, max_prefill_len=64,
+                attn_backend="reference",
+            ),
+        )
+        import time as _t
+
+        r = Request(id="old", prompt_tokens=[1, 2],
+                    sampling=SamplingParams(max_tokens=4))
+        eng.add_request(r)
+        r.submit_time = _t.monotonic() - 1000
+        stuck = eng.reap_stuck(max_queue_seconds=600)
+        assert [s.id for s in stuck] == ["old"]
+        assert r.finish_reason == FinishReason.ABORT
+        assert not eng.has_work()
