@@ -19,13 +19,57 @@ vs_baseline = ours / 2300.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 A100_VLLM_LLAMA3_8B_TOKS = 2300.0  # public vLLM A100-80G decode throughput
 
 
+def _device_healthy(timeout_s: float = 90.0) -> bool:
+    """Probe the accelerator in a subprocess: the axon TPU relay is
+    single-tenant and can wedge (a hung relay blocks the first jax op
+    forever, even under JAX_PLATFORMS=cpu, because plugin init touches it).
+    A probe child that times out is killed without poisoning this process —
+    we then run the bench in a CPU-simulator child so a line ALWAYS prints.
+    """
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "print(float(jnp.arange(4).sum()))"],
+            timeout=timeout_s, capture_output=True,
+        )
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
+    if os.environ.get("HELIX_BENCH_CHILD") != "1" and not _device_healthy():
+        # accelerator unreachable: emit an honest degraded-mode line from a
+        # clean CPU child (axon plugin stripped so it cannot hang)
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HELIX_BENCH_CHILD"] = "1"
+        env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=1800,
+        )
+        out = (p.stdout or "").strip().splitlines()
+        if out:
+            print(out[-1])
+        else:
+            print(json.dumps({
+                "metric": "bench_unavailable",
+                "value": 0,
+                "unit": "tokens/s",
+                "vs_baseline": 0.0,
+            }))
+        return
+
     import jax
     import jax.numpy as jnp
 
