@@ -84,6 +84,27 @@ def _cmd_profile(args) -> int:
     return 0 if not errors else 1
 
 
+def _cmd_apply(args) -> int:
+    """Apply a helix.yaml app to the control plane (reference:
+    ``helix apply -f helix.yaml``, ``api/pkg/cli/apps/local.go``)."""
+    import requests
+
+    with open(args.file) as f:
+        raw = f.read()
+    r = requests.post(
+        f"{args.url}/api/v1/apps",
+        data=raw,
+        headers={"Content-Type": "application/x-yaml"},
+        timeout=30,
+    )
+    if r.status_code != 200:
+        print(r.text, file=sys.stderr)
+        return 1
+    doc = r.json()
+    print(f"applied app '{doc['name']}' ({doc['id']})")
+    return 0
+
+
 def _cmd_chat(args) -> int:
     import requests
 
@@ -207,6 +228,11 @@ def main(argv=None) -> int:
     pr = sub.add_parser("profile", help="validate a profile YAML")
     pr.add_argument("file")
     pr.set_defaults(fn=_cmd_profile)
+
+    ap = sub.add_parser("apply", help="apply a helix.yaml app")
+    ap.add_argument("-f", "--file", required=True)
+    ap.add_argument("--url", default="http://127.0.0.1:8080")
+    ap.set_defaults(fn=_cmd_apply)
 
     c = sub.add_parser("chat", help="one-shot chat against a server")
     c.add_argument("message")
