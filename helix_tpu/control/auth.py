@@ -78,18 +78,66 @@ class User:
 
 class Authenticator:
     def __init__(self, db_path: str = ":memory:", master_key: Optional[bytes] = None):
+        self._db_path = db_path
         self._conn = sqlite3.connect(db_path, check_same_thread=False)
         self._lock = threading.Lock()
         with self._lock:
             self._conn.executescript(_SCHEMA)
             self._conn.commit()
         if master_key is None:
-            master_key = os.environ.get(
-                "HELIX_MASTER_KEY", "helix-dev-master-key"
-            ).encode()
+            env_key = os.environ.get("HELIX_MASTER_KEY")
+            if env_key:
+                master_key = env_key.encode()
+            else:
+                # No configured key: generate one and persist it in a
+                # 0600 file NEXT TO the auth DB (never inside it — a
+                # leaked DB snapshot must not carry its own decryption
+                # key), and never fall back to a hard-coded value.
+                master_key = self._load_or_create_master_key()
         self._fernet = Fernet(
             base64.urlsafe_b64encode(hashlib.sha256(master_key).digest())
         )
+
+    def _load_or_create_master_key(self) -> bytes:
+        if self._db_path == ":memory:":
+            return pysecrets.token_bytes(32)  # ephemeral DB, ephemeral key
+        from helix_tpu.utils import load_or_create_keyfile
+
+        path = self._db_path + ".master-key"
+        existed = os.path.exists(path)
+        key = load_or_create_keyfile(path)
+        if not existed:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "HELIX_MASTER_KEY not set — generated a random master key "
+                "at %s. Set HELIX_MASTER_KEY explicitly for production.",
+                path,
+            )
+        return key
+
+    def create_first_user(
+        self, email: str, name: str = "", admin: bool = True
+    ) -> Optional[User]:
+        """Atomic bootstrap: insert only while the user table is empty.
+        Returns None if any user already exists (lost the race)."""
+        uid = f"usr_{uuid.uuid4().hex[:16]}"
+        with self._lock:
+            cur = self._conn.execute(
+                "INSERT INTO users(id, email, name, admin, created_at) "
+                "SELECT ?,?,?,?,? WHERE NOT EXISTS (SELECT 1 FROM users)",
+                (uid, email, name, int(admin), time.time()),
+            )
+            self._conn.commit()
+            if cur.rowcount == 0:
+                return None
+        return User(id=uid, email=email, name=name, admin=admin)
+
+    def count_users(self) -> int:
+        with self._lock:
+            return self._conn.execute(
+                "SELECT COUNT(*) FROM users"
+            ).fetchone()[0]
 
     # -- users -------------------------------------------------------------
     def create_user(self, email: str, name: str = "", admin: bool = False) -> User:

@@ -18,16 +18,40 @@ from typing import Optional
 
 
 class Filestore:
-    def __init__(self, root: str, secret: bytes = b"helix-filestore"):
+    def __init__(self, root: str, secret: Optional[bytes] = None):
         self.root = os.path.realpath(root)
         os.makedirs(self.root, exist_ok=True)
+        if secret is None:
+            # Random per-store URL-signing secret persisted under the
+            # root: a hard-coded default would make every unconfigured
+            # deployment's signed download URLs forgeable.
+            secret = self._load_or_create_secret()
         self._secret = secret
 
-    def _resolve(self, owner: str, path: str) -> str:
-        p = os.path.realpath(
-            os.path.join(self.root, owner, path.lstrip("/"))
+    def _load_or_create_secret(self) -> bytes:
+        from helix_tpu.utils import load_or_create_keyfile
+
+        return load_or_create_keyfile(
+            os.path.join(self.root, ".signing-secret")
         )
-        if not p.startswith(os.path.join(self.root, owner)):
+
+    def _resolve(self, owner: str, path: str) -> str:
+        if (
+            not owner
+            or owner.startswith(".")  # reserves dotfiles (.signing-secret)
+            or "/" in owner
+            or os.sep in owner
+            or ".." in owner
+        ):
+            raise PermissionError("invalid owner id")
+        base = os.path.realpath(os.path.join(self.root, owner))
+        # os.sep-terminated prefix compare: without it, '../ownerX' would
+        # pass a bare startswith check against sibling dirs whose names
+        # extend the owner id as a string prefix.
+        if base != self.root and not base.startswith(self.root + os.sep):
+            raise PermissionError("owner escapes the filestore")
+        p = os.path.realpath(os.path.join(base, path.lstrip("/")))
+        if p != base and not p.startswith(base + os.sep):
             raise PermissionError("path escapes the filestore")
         return p
 
@@ -75,6 +99,7 @@ class Filestore:
     # -- signed URLs -----------------------------------------------------------
     def sign(self, owner: str, path: str, ttl: float = 3600.0) -> dict:
         """Presigned-style viewer token (reference: presigned viewer URLs)."""
+        self._resolve(owner, path)  # validate before signing
         expires = int(time.time() + ttl)
         msg = f"{owner}:{path}:{expires}".encode()
         sig = hmac.new(self._secret, msg, hashlib.sha256).hexdigest()

@@ -155,7 +155,10 @@ class NodeAgent:
         heartbeat_url: Optional[str] = None,
         heartbeat_interval: float = 30.0,
         address: str = "",
+        runner_token: Optional[str] = None,
     ):
+        import os as _os
+
         self.runner_id = runner_id
         self.address = address   # where the control plane can reach our OpenAI surface
         self.registry = DelegatingRegistry(registry)
@@ -163,6 +166,11 @@ class NodeAgent:
         self._build = build_model
         self.heartbeat_url = heartbeat_url
         self.heartbeat_interval = heartbeat_interval
+        self.runner_token = (
+            runner_token
+            if runner_token is not None
+            else _os.environ.get("HELIX_RUNNER_TOKEN", "")
+        )
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
@@ -286,6 +294,10 @@ class NodeAgent:
         (the pull-based loop of ``SURVEY.md`` §3.3)."""
         import requests
 
+        headers = (
+            {"X-Runner-Token": self.runner_token} if self.runner_token else {}
+        )
+
         def run():
             while not self._stop.is_set():
                 try:
@@ -294,12 +306,22 @@ class NodeAgent:
                         f"{self.runner_id}/heartbeat",
                         json=self.heartbeat_payload(),
                         timeout=10,
+                        headers=headers,
                     )
+                    if r.status_code != 200:
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "heartbeat rejected (%s): %s — check "
+                            "HELIX_RUNNER_TOKEN", r.status_code,
+                            r.text[:200],
+                        )
                     if poll_assignment:
                         a = requests.get(
                             f"{self.heartbeat_url}/api/v1/runners/"
                             f"{self.runner_id}/assignment",
                             timeout=10,
+                            headers=headers,
                         )
                         if a.status_code == 200:
                             doc = a.json()

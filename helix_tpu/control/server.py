@@ -45,8 +45,18 @@ def tempfile_dir() -> str:
 class ControlPlane:
     def __init__(
         self, db_path: str = ":memory:", embed_fn=None,
-        auth_required: bool = False,
+        auth_required: bool = False, runner_token: str | None = None,
     ):
+        import os as _os_env
+
+        # Shared token node agents present on the runner control loop
+        # (reference: the runner router's shared runner token). Empty +
+        # auth_required => runner endpoints fail closed to admin-only.
+        self.runner_token = (
+            runner_token
+            if runner_token is not None
+            else _os_env.environ.get("HELIX_RUNNER_TOKEN", "")
+        )
         from helix_tpu.control.auth import Authenticator
         from helix_tpu.control.billing import BillingService
         from helix_tpu.control.controller import SessionController
@@ -223,24 +233,78 @@ class ControlPlane:
         return t[1] if t else None
 
     # ------------------------------------------------------------------
+    def _is_runner_loop(self, request) -> bool:
+        """The two node-agent endpoints (heartbeat POST, assignment poll
+        GET) — matched exactly by shape, never by prefix, so operator
+        endpoints under /api/v1/runners stay authenticated."""
+        parts = request.path.strip("/").split("/")
+        # api/v1/runners/{id}/heartbeat | api/v1/runners/{id}/assignment
+        if len(parts) != 5 or parts[:3] != ["api", "v1", "runners"]:
+            return False
+        if request.method == "POST" and parts[4] == "heartbeat":
+            return True
+        return request.method == "GET" and parts[4] == "assignment"
+
+    def _runner_token_ok(self, request) -> bool:
+        import hmac as _hmac
+
+        token = request.headers.get("X-Runner-Token", "")
+        return bool(self.runner_token) and _hmac.compare_digest(
+            token, self.runner_token
+        )
+
+    def _require_runner(self, request):
+        """403/401 unless auth is off, the caller presented the runner
+        token, or the caller is a platform admin. Keeps authenticated
+        non-admin users from spoofing heartbeats to hijack routing."""
+        if not self.auth_required:
+            return None
+        if self._runner_token_ok(request):
+            return None
+        u = request.get("user")
+        if u is not None and u.admin:
+            return None
+        return _err(
+            401 if u is None else 403, "runner token or admin required"
+        )
+
+    def _require_admin(self, request):
+        """403 response unless auth is off or the caller is a platform
+        admin. Returns None when the action may proceed."""
+        if not self.auth_required:
+            return None
+        u = request.get("user")
+        if u is None:
+            return _err(401, "authentication required")
+        if not u.admin:
+            return _err(403, "platform admin required")
+        return None
+
     @web.middleware
     async def auth_middleware(self, request, handler):
         """Resolve the bearer key to a user; enforce when auth_required.
-        Runner control-loop endpoints stay open (nodes authenticate by
-        runner id + network position, like the reference's heartbeats)."""
+        Node agents authenticate with the shared runner token on exactly
+        the heartbeat/assignment-poll endpoints (reference: runner router
+        shared token); webhook + signed-URL endpoints carry their own
+        secrets and stay open."""
         user = self.auth.authenticate(request.headers.get("Authorization"))
         request["user"] = user
-        # open: health, runner control loop, the UI shell itself (its API
-        # calls still authenticate), and signed file views (HMAC-gated)
-        open_paths = ("/healthz", "/metrics", "/api/v1/runners", "/files/view")
+        if not self.auth_required or user is not None:
+            return await handler(request)
+        # self-authenticating or public endpoints (exact / own-secret)
+        if request.path in ("/", "/healthz", "/metrics", "/files/view"):
+            return await handler(request)
+        if request.path.startswith("/webhooks/"):  # verifies webhook secret
+            return await handler(request)
         if (
-            self.auth_required
-            and user is None
-            and request.path != "/"
-            and not request.path.startswith(open_paths)
-        ):
-            return _err(401, "authentication required")
-        return await handler(request)
+            request.path == "/api/v1/users"
+            and request.method == "POST"
+            and self.auth.count_users() == 0
+        ):  # first-user bootstrap; handler re-checks
+            return await handler(request)
+        if self._is_runner_loop(request) and self._runner_token_ok(request):
+            return await handler(request)
+        return _err(401, "authentication required")
 
     def _user_id(self, request) -> str:
         u = request.get("user")
@@ -355,6 +419,9 @@ class ControlPlane:
 
     # -- runner control loop ----------------------------------------------
     async def heartbeat(self, request):
+        denied = self._require_runner(request)
+        if denied is not None:
+            return denied
         rid = request.match_info["id"]
         body = await request.json()
         profile = body.get("profile", {})
@@ -371,6 +438,9 @@ class ControlPlane:
         return web.json_response({"ok": True})
 
     async def get_assignment(self, request):
+        denied = self._require_runner(request)
+        if denied is not None:
+            return denied
         rid = request.match_info["id"]
         name = self.store.get_assignment(rid)
         profile = self.store.get_profile(name) if name else None
@@ -380,7 +450,11 @@ class ControlPlane:
 
     async def assign_profile(self, request):
         """422 with structured violations on incompatibility, like the
-        reference (``runner_assignment_handlers.go:118``)."""
+        reference (``runner_assignment_handlers.go:118``). Operator
+        action: admin-gated."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
         rid = request.match_info["id"]
         body = await request.json()
         name = body.get("profile_name")
@@ -405,6 +479,9 @@ class ControlPlane:
         return web.json_response({"ok": True, "runner_id": rid, "profile": name})
 
     async def clear_assignment(self, request):
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
         rid = request.match_info["id"]
         self.store.set_assignment(rid, None)
         return web.json_response({"ok": True})
@@ -429,6 +506,12 @@ class ControlPlane:
         return web.json_response({"profiles": self.store.list_profiles()})
 
     async def create_profile(self, request):
+        """Operator action: profiles drive what runners serve, so writes
+        are admin-gated (a non-admin redefining an assigned profile would
+        hijack routing on the next assignment poll)."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
         body = await request.json()
         try:
             profile = ServingProfile.from_dict(body)
@@ -447,6 +530,9 @@ class ControlPlane:
         return web.json_response(doc)
 
     async def delete_profile(self, request):
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
         ok = self.store.delete_profile(request.match_info["name"])
         return web.json_response({"ok": ok}, status=200 if ok else 404)
 
@@ -628,17 +714,41 @@ class ControlPlane:
 
     # -- auth / orgs / secrets ------------------------------------------------
     async def create_user(self, request):
+        """Admin-gated except for first-user bootstrap (an empty user
+        table lets the installer mint the initial admin account —
+        reference gates user creation behind isAdmin)."""
         body = await request.json()
-        u = self.auth.create_user(
-            email=body.get("email", ""),
-            name=body.get("name", ""),
-            admin=bool(body.get("admin")),
-        )
+        caller = request.get("user")
+        if self.auth_required and not (caller and caller.admin):
+            # Atomic bootstrap: succeeds only while the table is empty,
+            # so two racing unauthenticated requests can't both win.
+            u = self.auth.create_first_user(
+                email=body.get("email", ""),
+                name=body.get("name", ""),
+                admin=bool(body.get("admin")),
+            )
+            if u is None:
+                return self._require_admin(request)
+        else:
+            u = self.auth.create_user(
+                email=body.get("email", ""),
+                name=body.get("name", ""),
+                admin=bool(body.get("admin")),
+            )
         key = self.auth.create_api_key(u.id)
         return web.json_response({"id": u.id, "api_key": key})
 
     async def create_key(self, request):
+        """Keys may only be minted for the caller's own account, unless
+        the caller is a platform admin (reference: CreateAPIKey only for
+        the request user)."""
         uid = request.match_info["id"]
+        if self.auth_required:
+            caller = request.get("user")
+            if caller is None:
+                return _err(401, "authentication required")
+            if caller.id != uid and not caller.admin:
+                return _err(403, "can only mint keys for your own account")
         if self.auth.get_user(uid) is None:
             return _err(404, "user not found")
         body = await request.json()
@@ -837,9 +947,11 @@ class ControlPlane:
     # -- filestore -------------------------------------------------------------
     async def fs_list(self, request):
         owner = self._user_id(request)
-        return web.json_response(
-            {"files": self.files.list(owner, request.query.get("path", ""))}
-        )
+        try:
+            files = self.files.list(owner, request.query.get("path", ""))
+        except PermissionError as e:
+            return _err(403, str(e))
+        return web.json_response({"files": files})
 
     async def fs_upload(self, request):
         owner = self._user_id(request)
@@ -862,14 +974,20 @@ class ControlPlane:
 
     async def fs_delete(self, request):
         owner = self._user_id(request)
-        ok = self.files.delete(owner, request.match_info["path"])
+        try:
+            ok = self.files.delete(owner, request.match_info["path"])
+        except PermissionError as e:
+            return _err(403, str(e))
         return web.json_response({"ok": ok}, status=200 if ok else 404)
 
     async def fs_sign(self, request):
         owner = self._user_id(request)
-        return web.json_response(
-            self.files.sign(owner, request.match_info["path"])
-        )
+        try:
+            return web.json_response(
+                self.files.sign(owner, request.match_info["path"])
+            )
+        except PermissionError as e:
+            return _err(403, str(e))
 
     async def fs_view_signed(self, request):
         q = request.query
@@ -882,6 +1000,8 @@ class ControlPlane:
             data = self.files.read(q["owner"], q["path"])
         except FileNotFoundError:
             return _err(404, "file not found")
+        except PermissionError as e:
+            return _err(403, str(e))
         return web.Response(body=data)
 
     # -- user event stream -----------------------------------------------------

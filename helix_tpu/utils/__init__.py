@@ -1,0 +1,43 @@
+"""Small shared utilities for the helix-tpu runtime."""
+
+from __future__ import annotations
+
+import os
+import secrets as pysecrets
+
+
+def load_or_create_keyfile(path: str, nbytes: int = 32) -> bytes:
+    """Read a secret key file, creating it atomically if absent.
+
+    Concurrency-safe across processes sharing ``path``: creation uses a
+    0600 temp file hard-linked into place (``os.link`` fails if the file
+    already exists, so a loser of the race re-reads the winner's key —
+    nobody ever deletes or clobbers a live key). A truncated file (crash
+    mid-write of an older implementation) is atomically replaced via
+    ``os.rename``. All processes converge on whatever is on disk.
+    """
+    for _ in range(20):
+        truncated = False
+        try:
+            with open(path, "rb") as f:
+                key = f.read()
+            if len(key) >= nbytes:
+                return key
+            truncated = True
+        except FileNotFoundError:
+            pass
+        key = pysecrets.token_bytes(nbytes)
+        tmp = f"{path}.tmp.{os.getpid()}.{pysecrets.token_hex(4)}"
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+        with os.fdopen(fd, "wb") as f:
+            f.write(key)
+        if truncated:
+            os.rename(tmp, path)  # atomic replace of the garbage file
+        else:
+            try:
+                os.link(tmp, path)  # create-if-absent, never clobber
+            except FileExistsError:
+                pass  # lost the race — loop re-reads the winner's key
+            os.unlink(tmp)
+        # fall through to re-read so every caller returns the on-disk key
+    raise RuntimeError(f"could not create or read key file {path}")

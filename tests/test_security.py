@@ -1,0 +1,275 @@
+"""Security regressions: admin gating on user/key creation, runner-token
+auth on the node control loop, filestore traversal, secret-key hygiene.
+
+Mirrors the reference's authz posture (``server/authz.go`` isAdmin gates,
+runner router shared token, rooted filestore)."""
+
+import asyncio
+import os
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from helix_tpu.control.auth import Authenticator
+from helix_tpu.control.filestore import Filestore
+from helix_tpu.control.server import ControlPlane
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+async def _client(cp):
+    server = TestServer(cp.build_app())
+    client = TestClient(server)
+    await client.start_server()
+    return client
+
+
+def test_first_user_bootstrap_then_admin_gate():
+    async def main():
+        cp = ControlPlane(auth_required=True, runner_token="rt")
+        client = await _client(cp)
+        try:
+            # bootstrap: empty user table lets the installer mint an admin
+            r = await client.post(
+                "/api/v1/users", json={"email": "root@x", "admin": True}
+            )
+            assert r.status == 200
+            doc = await r.json()
+            admin_key = doc["api_key"]
+
+            # unauthenticated creation is now refused
+            r = await client.post("/api/v1/users", json={"email": "evil@x"})
+            assert r.status == 401
+
+            # a non-admin user cannot create users (no escalation path)
+            r = await client.post(
+                "/api/v1/users", json={"email": "u2@x"},
+                headers={"Authorization": f"Bearer {admin_key}"},
+            )
+            assert r.status == 200
+            u2 = await r.json()
+            r = await client.post(
+                "/api/v1/users", json={"email": "u3@x", "admin": True},
+                headers={"Authorization": f"Bearer {u2['api_key']}"},
+            )
+            assert r.status == 403
+            return doc, u2, client, cp
+        finally:
+            await client.close()
+            cp.orchestrator.stop()
+            cp.knowledge.stop()
+            cp.triggers.stop()
+
+    _run(main())
+
+
+def test_create_key_only_for_self_unless_admin():
+    async def main():
+        cp = ControlPlane(auth_required=True)
+        client = await _client(cp)
+        try:
+            r = await client.post(
+                "/api/v1/users", json={"email": "root@x", "admin": True}
+            )
+            admin = await r.json()
+            hdr = {"Authorization": f"Bearer {admin['api_key']}"}
+            r = await client.post(
+                "/api/v1/users", json={"email": "a@x"}, headers=hdr
+            )
+            ua = await r.json()
+            r = await client.post(
+                "/api/v1/users", json={"email": "b@x"}, headers=hdr
+            )
+            ub = await r.json()
+
+            # a cannot mint a key for b
+            r = await client.post(
+                f"/api/v1/users/{ub['id']}/keys", json={},
+                headers={"Authorization": f"Bearer {ua['api_key']}"},
+            )
+            assert r.status == 403
+            # a can mint for itself; admin can mint for anyone
+            r = await client.post(
+                f"/api/v1/users/{ua['id']}/keys", json={},
+                headers={"Authorization": f"Bearer {ua['api_key']}"},
+            )
+            assert r.status == 200
+            r = await client.post(
+                f"/api/v1/users/{ub['id']}/keys", json={}, headers=hdr
+            )
+            assert r.status == 200
+        finally:
+            await client.close()
+            cp.orchestrator.stop()
+            cp.knowledge.stop()
+            cp.triggers.stop()
+
+    _run(main())
+
+
+def test_runner_loop_requires_token_and_operator_ops_require_admin():
+    async def main():
+        cp = ControlPlane(auth_required=True, runner_token="node-secret")
+        client = await _client(cp)
+        try:
+            hb = {"accelerators": [], "profile": {"models": []}}
+            # no token -> 401
+            r = await client.post("/api/v1/runners/r1/heartbeat", json=hb)
+            assert r.status == 401
+            # wrong token -> 401
+            r = await client.post(
+                "/api/v1/runners/r1/heartbeat", json=hb,
+                headers={"X-Runner-Token": "wrong"},
+            )
+            assert r.status == 401
+            # right token -> ok, for exactly heartbeat + assignment poll
+            r = await client.post(
+                "/api/v1/runners/r1/heartbeat", json=hb,
+                headers={"X-Runner-Token": "node-secret"},
+            )
+            assert r.status == 200
+            r = await client.get(
+                "/api/v1/runners/r1/assignment",
+                headers={"X-Runner-Token": "node-secret"},
+            )
+            assert r.status == 200
+
+            # the token does NOT open operator endpoints (exact-shape match,
+            # not a /api/v1/runners prefix exemption)
+            r = await client.post(
+                "/api/v1/runners/r1/assign-profile",
+                json={"profile_name": "x"},
+                headers={"X-Runner-Token": "node-secret"},
+            )
+            assert r.status == 401
+            r = await client.get(
+                "/api/v1/runners", headers={"X-Runner-Token": "node-secret"}
+            )
+            assert r.status == 401
+
+            # non-admin users cannot repoint runners
+            r = await client.post(
+                "/api/v1/users", json={"email": "root@x", "admin": True}
+            )
+            admin = await r.json()
+            hdr = {"Authorization": f"Bearer {admin['api_key']}"}
+            r = await client.post(
+                "/api/v1/users", json={"email": "u@x"}, headers=hdr
+            )
+            user = await r.json()
+            uhdr = {"Authorization": f"Bearer {user['api_key']}"}
+            r = await client.post(
+                "/api/v1/runners/r1/assign-profile",
+                json={"profile_name": "x"}, headers=uhdr,
+            )
+            assert r.status == 403
+
+            # an ordinary API key must not be able to spoof heartbeats
+            # (routing hijack): runner loop needs the token or admin
+            r = await client.post(
+                "/api/v1/runners/evil/heartbeat",
+                json={"address": "http://evil", "profile": {"models": ["m"]}},
+                headers=uhdr,
+            )
+            assert r.status == 403
+            r = await client.get(
+                "/api/v1/runners/evil/assignment", headers=uhdr
+            )
+            assert r.status == 403
+            r = await client.delete(
+                "/api/v1/runners/r1/assignment", headers=uhdr
+            )
+            assert r.status == 403
+            # admin can (404: profile doesn't exist, but authz passed)
+            r = await client.post(
+                "/api/v1/runners/r1/assign-profile",
+                json={"profile_name": "x"}, headers=hdr,
+            )
+            assert r.status == 404
+        finally:
+            await client.close()
+            cp.orchestrator.stop()
+            cp.knowledge.stop()
+            cp.triggers.stop()
+
+    _run(main())
+
+
+def test_no_runner_token_configured_fails_closed():
+    async def main():
+        cp = ControlPlane(auth_required=True, runner_token="")
+        client = await _client(cp)
+        try:
+            r = await client.post(
+                "/api/v1/runners/r1/heartbeat",
+                json={}, headers={"X-Runner-Token": ""},
+            )
+            assert r.status == 401
+        finally:
+            await client.close()
+            cp.orchestrator.stop()
+            cp.knowledge.stop()
+            cp.triggers.stop()
+
+    _run(main())
+
+
+class TestFilestoreTraversal:
+    def test_sibling_owner_prefix_attack(self, tmp_path):
+        fs = Filestore(str(tmp_path))
+        # victim dir whose name extends the attacker's owner id
+        fs.write("alice", "f.txt", b"attacker")
+        fs.write("alicevictim", "secret.txt", b"victim data")
+        with pytest.raises(PermissionError):
+            fs.read("alice", "../alicevictim/secret.txt")
+        with pytest.raises(PermissionError):
+            fs.write("alice", "../alicevictim/planted.txt", b"x")
+        with pytest.raises(PermissionError):
+            fs.delete("alice", "../alicevictim/secret.txt")
+
+    def test_owner_id_is_sanitised(self, tmp_path):
+        fs = Filestore(str(tmp_path))
+        for owner in ("", "..", "a/../b", "a/b", ".signing-secret", ".hidden"):
+            with pytest.raises(PermissionError):
+                fs.read(owner, "x")
+
+    def test_plain_traversal_still_blocked(self, tmp_path):
+        fs = Filestore(str(tmp_path))
+        with pytest.raises(PermissionError):
+            fs.read("alice", "../../etc/passwd")
+
+    def test_signing_secret_is_random_and_persisted(self, tmp_path):
+        fs1 = Filestore(str(tmp_path))
+        url = fs1.sign("alice", "f.txt")
+        fs2 = Filestore(str(tmp_path))  # same root -> same secret
+        assert fs2.verify(
+            "alice", "f.txt", url["expires"], url["signature"]
+        )
+        other = Filestore(str(tmp_path / "other"))  # different root differs
+        assert not other.verify(
+            "alice", "f.txt", url["expires"], url["signature"]
+        )
+
+
+class TestMasterKey:
+    def test_random_master_key_persisted(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("HELIX_MASTER_KEY", raising=False)
+        db = str(tmp_path / "auth.db")
+        a1 = Authenticator(db)
+        a1.set_secret("u", "tok", "hunter2")
+        # a fresh instance on the same DB can still decrypt
+        a2 = Authenticator(db)
+        assert a2.get_secret("u", "tok") == "hunter2"
+        # but an instance on a different DB (different generated key) cannot
+        a3 = Authenticator(str(tmp_path / "other.db"))
+        assert a3.get_secret("u", "tok") is None
+
+    def test_env_key_still_wins(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HELIX_MASTER_KEY", "explicit-key")
+        db = str(tmp_path / "auth.db")
+        a1 = Authenticator(db)
+        a1.set_secret("u", "tok", "v")
+        a2 = Authenticator(db)
+        assert a2.get_secret("u", "tok") == "v"
