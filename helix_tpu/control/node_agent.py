@@ -104,9 +104,21 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     if pm.quantization == "int8":
         params = jax.jit(quantize_params, donate_argnums=0)(params)
 
+    ekw = dict(pm.engine)
+    if pm.context_length and "max_model_len" not in ekw:
+        # honour the profile's context_length (the vLLM --max-model-len
+        # analogue): cap requests there and make sure one sequence's page
+        # table can actually hold that many tokens
+        ekw["max_model_len"] = pm.context_length
+        ps = ekw.get("page_size", 16)
+        need_pages = -(-pm.context_length // ps)
+        if ekw.get("max_pages_per_seq", 128) < need_pages:
+            ekw["max_pages_per_seq"] = need_pages
+        if ekw.get("num_pages", 2048) < need_pages + 1:
+            ekw["num_pages"] = need_pages + 1
     ecfg = EngineConfig(
         eos_token_ids=tuple(tokenizer.eos_ids),
-        **{k: v for k, v in pm.engine.items()},
+        **ekw,
     )
     engine = Engine(model_cfg, params, ecfg)
     engine.warmup()   # compile prefill/decode before the model goes routable

@@ -43,7 +43,9 @@ from helix_tpu.engine.kv_cache import (
 from helix_tpu.engine.sampling import (
     SamplingParams,
     SamplingState,
+    apply_penalties,
     sample,
+    split_keys,
 )
 from helix_tpu.models.common import ModelConfig
 from helix_tpu.models.llama import forward
@@ -88,7 +90,11 @@ class EngineConfig:
     page_size: int = 16
     num_pages: int = 2048
     max_pages_per_seq: int = 128
-    max_prefill_len: int = 2048
+    max_prefill_len: int = 2048   # chunk size: longer prompts prefill in
+    # max_prefill_len-sized chunks appended to the same page table across
+    # engine steps, interleaved with decode (vLLM --max-model-len analogue:
+    # the true prompt limit is max_model_len / page capacity, not this)
+    max_model_len: Optional[int] = None  # None = page capacity
     attn_backend: Optional[str] = None   # None = auto (pallas on TPU)
     eos_token_ids: tuple = ()
 
@@ -106,6 +112,55 @@ def _bucket(n: int, lo: int, hi: int) -> int:
     while b < n:
         b *= 2
     return min(b, hi) if b <= hi else hi
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DecodeState:
+    """Device-resident per-slot decode state.
+
+    Steady-state decode never uploads anything from the host: last
+    tokens, positions, page tables, RNG keys, and the output-token
+    histogram (for presence/frequency penalties) all live on device and
+    are advanced inside the fused step.  The host re-syncs the state only
+    when the slot set changes (admission / completion) via one jitted
+    merge (``_rebuild_state``) that preserves the device-evolving
+    pieces (keys, histograms) of surviving slots.
+    """
+
+    last_token: jax.Array    # [B] i32
+    positions: jax.Array     # [B] i32
+    page_tables: jax.Array   # [B, P] i32
+    active: jax.Array        # [B] i32
+    mrope_delta: jax.Array   # [B] i32
+    keys: jax.Array          # [B, 2] u32 — per-slot PRNG keys
+    token_counts: jax.Array  # [B, V] i32 — output-token histogram
+    sampling: SamplingState
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _rebuild_state(
+    old: DecodeState, last_token, positions, page_tables, active,
+    mrope_delta, new_keys, keep, sampling,
+) -> DecodeState:
+    B = last_token.shape[0]
+    keepc = keep[:, None] > 0
+    # fresh slots start their histogram with the prefill-sampled first
+    # token (it is output token #1 for penalty purposes)
+    fresh = jnp.zeros_like(old.token_counts)
+    fresh = fresh.at[jnp.arange(B), jnp.clip(last_token, 0)].add(
+        ((keep == 0) & (active > 0)).astype(fresh.dtype)
+    )
+    return DecodeState(
+        last_token=last_token,
+        positions=positions,
+        page_tables=page_tables,
+        active=active,
+        mrope_delta=mrope_delta,
+        keys=jnp.where(keepc, old.keys, new_keys),
+        token_counts=jnp.where(keepc, old.token_counts, fresh),
+        sampling=sampling,
+    )
 
 
 # Compiled step functions are cached at module level keyed by the static
@@ -141,10 +196,75 @@ def _build_prefill_fn(model_cfg: ModelConfig, page_size: int, backend):
         pages, offsets = slot_to_page_offset(positions, page_table, page_size)
         cache = write_kv(cache, k_new, v_new, pages, offsets, valid)
         last = logits[jnp.arange(B), length - 1]  # [B, V] f32
-        token = sample(last, sampling, key)
+        token = sample(last, sampling, key[None])
         return cache, token
 
     return prefill_fn
+
+
+@functools.lru_cache(maxsize=64)
+def _build_chunk_prefill_fn(model_cfg: ModelConfig, page_size: int, backend):
+    """Chunked prefill: attend the current chunk against the already-cached
+    history (gathered from the page pool) plus itself, then scatter the
+    chunk's fresh KV into the pool.
+
+    Serves arbitrary prompt lengths with fixed compile shapes — the
+    reference reaches the same capability via vLLM's --max-model-len
+    (``design/sample-profiles/8xH100-vllm.yaml:40-41``); here it is native.
+    Shapes: chunk length C and history capacity m*page_size are bucketed by
+    the caller, so XLA compiles once per (C, m) pair.
+    """
+    cfg = model_cfg
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def chunk_fn(
+        params, cache, tokens, start, clen, hist_table, full_table,
+        sampling, key,
+    ):
+        B, C = tokens.shape          # B == 1
+        m = hist_table.shape[1]      # history pages (static per trace)
+        Hs = m * page_size           # history token capacity
+        pos_q = start + jnp.broadcast_to(jnp.arange(C)[None], (B, C))
+        valid_q = jnp.arange(C)[None] < clen
+        qseg = valid_q.astype(jnp.int32)
+        kv_pos_hist = jnp.broadcast_to(jnp.arange(Hs)[None], (B, Hs))
+        kseg_hist = (kv_pos_hist < start).astype(jnp.int32)
+
+        def attn_fn(q, k, v, layer_cache, pos):
+            kp, vp = layer_cache     # [KVH, N, P, D]
+            KVH, _, P, D = kp.shape
+            idx = hist_table[0]
+            # [KVH, m, P, D] -> [1, m*P, KVH, D]
+            kh = kp[:, idx].transpose(1, 2, 0, 3).reshape(1, Hs, KVH, D)
+            vh = vp[:, idx].transpose(1, 2, 0, 3).reshape(1, Hs, KVH, D)
+            k_all = jnp.concatenate([kh.astype(k.dtype), k], axis=1)
+            v_all = jnp.concatenate([vh.astype(v.dtype), v], axis=1)
+            kv_pos = jnp.concatenate([kv_pos_hist, pos_q], axis=1)
+            kseg = jnp.concatenate([kseg_hist, qseg], axis=1)
+            return full_attention(
+                q, k_all, v_all,
+                causal=True,
+                q_positions=pos_q,
+                kv_positions=kv_pos,
+                q_segment_ids=qseg,
+                kv_segment_ids=kseg,
+                backend=backend,
+                block_q=min(256, C),
+                block_kv=min(256, C),
+            )
+
+        logits, (k_new, v_new) = forward(
+            params, cfg, tokens, pos_q,
+            attn_fn=attn_fn,
+            layer_caches=(cache.k_pages, cache.v_pages),
+        )
+        pages, offsets = slot_to_page_offset(pos_q, full_table, page_size)
+        cache = write_kv(cache, k_new, v_new, pages, offsets, valid_q)
+        last = logits[jnp.arange(B), clen - 1]
+        token = sample(last, sampling, key[None])
+        return cache, token
+
+    return chunk_fn
 
 
 @functools.lru_cache(maxsize=64)
@@ -186,7 +306,7 @@ def _build_prefill_fn_mrope(model_cfg: ModelConfig, page_size: int, backend):
         pages, offsets = slot_to_page_offset(positions, page_table, page_size)
         cache = write_kv(cache, k_new, v_new, pages, offsets, valid)
         last = logits[jnp.arange(B), length - 1]
-        token = sample(last, sampling, key)
+        token = sample(last, sampling, key[None])
         return cache, token
 
     return prefill_fn
@@ -222,13 +342,15 @@ def _build_decode_fn(model_cfg: ModelConfig, page_size: int, backend):
     if is_mrope:
         from helix_tpu.models.qwen2_vl import text_forward_mrope
 
-    @functools.partial(jax.jit, donate_argnums=(1,))
-    def decode_fn(
-        params, cache, last_token, positions, page_tables, active,
-        sampling, key, mrope_delta,
-    ):
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def decode_fn(params, cache, state: DecodeState):
+        last_token = state.last_token
+        positions = state.positions
+        page_tables = state.page_tables
+        active = state.active
         tokens = last_token[:, None]                      # [B, 1]
         pos2d = positions[:, None]                        # [B, 1]
+        B = tokens.shape[0]
 
         def attn_fn(q, k, v, layer_cache, pos):
             kp, vp = layer_cache
@@ -248,7 +370,8 @@ def _build_decode_fn(model_cfg: ModelConfig, page_size: int, backend):
             # past the prompt, all three streams advance together at a
             # per-request constant offset from the sequence index
             pos3 = jnp.broadcast_to(
-                (positions + mrope_delta)[None, :, None], (3,) + pos2d.shape
+                (positions + state.mrope_delta)[None, :, None],
+                (3,) + pos2d.shape,
             )
             logits, (k_new, v_new) = text_forward_mrope(
                 params, cfg, tokens, pos3,
@@ -267,8 +390,25 @@ def _build_decode_fn(model_cfg: ModelConfig, page_size: int, backend):
         cache = write_kv(
             cache, k_new, v_new, pages, offsets, active[:, None] > 0
         )
-        token = sample(logits[:, 0], sampling, key)
-        return cache, token
+        penalised = apply_penalties(
+            logits[:, 0], state.token_counts,
+            state.sampling.presence, state.sampling.frequency,
+        )
+        carry_keys, step_keys = split_keys(state.keys)
+        token = sample(penalised, state.sampling, step_keys)
+        new_state = DecodeState(
+            last_token=token,
+            positions=positions + active,   # inactive slots stay parked
+            page_tables=page_tables,
+            active=active,
+            mrope_delta=state.mrope_delta,
+            keys=carry_keys,
+            token_counts=state.token_counts.at[jnp.arange(B), token].add(
+                active
+            ),
+            sampling=state.sampling,
+        )
+        return cache, new_state, token
 
     return decode_fn
 
@@ -288,6 +428,16 @@ class Engine:
         self.cfg = cfg
         self.params = params
         self.mesh = mesh
+        # chunked prefill assumes chunk/history shapes are page-aligned
+        # powers of two (flash block divisibility + exact history gather)
+        q, ps = cfg.max_prefill_len, cfg.page_size
+        while q > ps and q % 2 == 0:
+            q //= 2
+        if q != ps:
+            raise ValueError(
+                f"max_prefill_len ({cfg.max_prefill_len}) must be "
+                f"page_size ({ps}) times a power of two"
+            )
         self.cache_cfg = cfg.cache_config(dtype=model_cfg.dtype)
         self.cache = PagedKVCache.create(model_cfg, self.cache_cfg, mesh)
         self.allocator = PageAllocator(
@@ -304,8 +454,11 @@ class Engine:
         self._page_tables = np.zeros(
             (B, self.cache_cfg.max_pages_per_seq), np.int32
         )
-        self._sampling_dirty = True
-        self._sampling_state: Optional[SamplingState] = None
+        self._slot_keys = np.zeros((B, 2), np.uint32)   # per-slot carry keys
+        self._state_dirty = True
+        self._changed_slots: set[int] = set()  # admitted/freed since sync
+        self._dstate: Optional[DecodeState] = None
+        self._chunking: Optional[dict] = None  # in-flight chunked prefill
         self._key = jax.random.PRNGKey(rng_seed)
         self._step_counter = itertools.count()
         self._backend = cfg.attn_backend
@@ -317,12 +470,36 @@ class Engine:
     # public API
     # ------------------------------------------------------------------
 
+    @property
+    def max_context_len(self) -> int:
+        """Hard prompt+generation limit: the profile's max_model_len capped
+        by per-sequence page capacity AND the physical pool size (a prompt
+        that can never allocate must be rejected, not queued forever)."""
+        cap = min(
+            self.cache_cfg.max_seq_len,
+            (self.cache_cfg.num_pages - 1) * self.cache_cfg.page_size,
+        )
+        if self.cfg.max_model_len is not None:
+            cap = min(cap, self.cfg.max_model_len)
+        return cap
+
     def validate_request(self, req: Request) -> Optional[str]:
         """Admission pre-check, safe from any thread; None = acceptable."""
-        if len(req.prompt_tokens) > self.cfg.max_prefill_len:
+        plen = len(req.prompt_tokens)
+        if plen + 1 > self.max_context_len:
             return (
-                f"prompt ({len(req.prompt_tokens)} tokens) exceeds "
-                f"max_prefill_len {self.cfg.max_prefill_len}"
+                f"prompt ({plen} tokens) exceeds the model context limit "
+                f"{self.max_context_len}"
+            )
+        if (
+            self.model_cfg.mrope_sections is not None
+            and plen > self.cfg.max_prefill_len
+        ):
+            # VL prefill is single-shot (image splice shapes); text models
+            # prefill arbitrarily long prompts in chunks
+            return (
+                f"vision prompt ({plen} tokens) exceeds max_prefill_len "
+                f"{self.cfg.max_prefill_len}"
             )
         if not req.prompt_tokens:
             return "empty prompt"
@@ -376,13 +553,34 @@ class Engine:
     def step(self) -> list[tuple[Request, int]]:
         """Admit + prefill waiting requests, then one decode step.
 
+        Long prompts prefill one chunk per engine step, so decode slots
+        keep producing tokens while a 32k prompt works through its chunks
+        (no head-of-line stall for already-running requests).
+
         Returns [(request, new_token_id), ...] for tokens produced this step.
         """
         emitted: list[tuple[Request, int]] = []
         self._admit(emitted)
-        if any(s is not None for s in self.slots):
+        if self._chunking is not None:
+            self._chunk_step(emitted)
+        if any(self._slot_active(i) for i in range(len(self.slots))):
             emitted.extend(self._decode_step())
         return emitted
+
+    def _request_key(self, req: Request):
+        """Root PRNG key for one request: its seed when given, else a
+        split of the engine stream."""
+        if req.sampling.seed is not None:
+            return jax.random.PRNGKey(req.sampling.seed)
+        self._key, req_key = jax.random.split(self._key)
+        return req_key
+
+    def _slot_active(self, i: int) -> bool:
+        """Occupied and decodable (not mid-chunked-prefill)."""
+        s = self.slots[i]
+        if s is None:
+            return False
+        return self._chunking is None or s is not self._chunking["req"]
 
     def generate(
         self, prompts: Sequence[Sequence[int]], sampling: SamplingParams
@@ -417,8 +615,14 @@ class Engine:
                 return
             req = self.waiting[0]
             plen = len(req.prompt_tokens)
+            needs_chunking = plen > self.cfg.max_prefill_len
+            if needs_chunking and self._chunking is not None:
+                return  # one chunked prefill in flight at a time
+            limit = min(
+                plen + req.sampling.max_tokens, self.max_context_len
+            )
             need = self.allocator.pages_needed(
-                plen + req.sampling.max_tokens, self.cache_cfg.page_size
+                limit, self.cache_cfg.page_size
             )
             need = min(need, self.cache_cfg.max_pages_per_seq)
             if not self.allocator.can_allocate(need):
@@ -432,15 +636,93 @@ class Engine:
             table = np.zeros((self.cache_cfg.max_pages_per_seq,), np.int32)
             table[: len(pages)] = pages
             self._page_tables[slot] = table
-            first_token = self._prefill(req, table)
+            if needs_chunking:
+                # defer to _chunk_step: one chunk per engine step, decode
+                # interleaves; the slot stays inactive until the prompt is
+                # fully cached
+                self._chunking = {
+                    "req": req, "table": table, "next": 0,
+                    "key": self._request_key(req), "slot": slot,
+                }
+                self._state_dirty = True
+                self._changed_slots.add(slot)
+                continue
+            first_token = self._prefill(req, table, slot=slot)
             req.first_token_time = time.monotonic()
             self._positions[slot] = plen
             self._mrope_delta[slot] = req.mrope_delta
             self._last_token[slot] = first_token
-            self._sampling_dirty = True
+            self._state_dirty = True
+            self._changed_slots.add(slot)
             self._emit(req, int(first_token), emitted)
 
-    def _prefill(self, req: Request, page_table: np.ndarray) -> int:
+    def _chunk_step(self, emitted) -> None:
+        """Process ONE chunk of the in-flight long prefill (called once per
+        engine step so decode interleaves)."""
+        st = self._chunking
+        req: Request = st["req"]
+        if req.finished:   # aborted mid-prefill
+            self._chunking = None
+            return
+        plen = len(req.prompt_tokens)
+        start = st["next"]
+        C_cap = self.cfg.max_prefill_len
+        end = min(start + C_cap, plen)
+        rem = end - start
+        ps = self.cache_cfg.page_size
+        Cb = _bucket(max(rem, ps), ps, C_cap)
+        tokens = np.zeros((1, Cb), np.int32)
+        tokens[0, :rem] = req.prompt_tokens[start:end]
+        # history capacity: smallest power-of-two multiple of the chunk cap
+        # covering `start` — bounds distinct compile shapes to O(log S)
+        if start == 0:
+            m = 0
+        else:
+            hist_tokens = C_cap
+            while hist_tokens < start:
+                hist_tokens *= 2
+            m = hist_tokens // ps
+        full_table = st["table"]
+        hist_table = np.zeros((1, m), np.int32)
+        used = min(m, -(-start // ps))
+        hist_table[0, :used] = full_table[:used]
+        st["key"], sub = jax.random.split(st["key"])
+        fn = _build_chunk_prefill_fn(
+            self.model_cfg, ps, self._backend
+        )
+        self.cache, token = fn(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.int32(start),
+            jnp.int32(rem),
+            jnp.asarray(hist_table),
+            jnp.asarray(full_table)[None],
+            SamplingState.from_params([req.sampling]),
+            sub,
+        )
+        self.num_prefill_tokens += rem
+        st["next"] = end
+        if end < plen:
+            return
+        # prompt fully cached: activate the slot with the first sampled token
+        slot = st["slot"]
+        first_token = int(token[0])
+        self._chunking = None
+        req.first_token_time = time.monotonic()
+        self._positions[slot] = plen
+        self._mrope_delta[slot] = req.mrope_delta
+        self._last_token[slot] = first_token
+        self._slot_keys[slot] = np.asarray(
+            jax.random.split(st["key"])[0], np.uint32
+        )
+        self._state_dirty = True
+        self._changed_slots.add(slot)
+        self._emit(req, first_token, emitted)
+
+    def _prefill(
+        self, req: Request, page_table: np.ndarray, slot: Optional[int] = None
+    ) -> int:
         plen = len(req.prompt_tokens)
         bucket = _bucket(
             max(plen, self.cache_cfg.page_size),
@@ -450,7 +732,12 @@ class Engine:
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :plen] = req.prompt_tokens
         length = np.int32(plen)
-        self._key, sub = jax.random.split(self._key)
+        # per-request PRNG stream: seeded requests reproduce exactly
+        # regardless of batch-mates; the carry half becomes the slot's
+        # device-resident key for decode
+        carry, sub = jax.random.split(self._request_key(req))
+        if slot is not None:
+            self._slot_keys[slot] = np.asarray(carry, np.uint32)
         sampling = SamplingState.from_params([req.sampling])
         if self.model_cfg.mrope_sections is not None:
             embeds = self._splice_embeds(req, tokens, bucket)
@@ -510,35 +797,66 @@ class Engine:
     # decode
     # ------------------------------------------------------------------
 
-    def _decode_step(self) -> list[tuple[Request, int]]:
+    def _sync_state(self) -> None:
+        """One jitted merge uploads the host mirrors after the slot set
+        changed; device-evolving pieces (RNG keys, penalty histograms) of
+        surviving slots are preserved on device."""
         B = self.cfg.max_decode_batch
+        V = self.model_cfg.vocab_size
+        P = self.cache_cfg.max_pages_per_seq
         active = np.array(
-            [1 if s is not None else 0 for s in self.slots], np.int32
+            [1 if self._slot_active(i) else 0 for i in range(len(self.slots))],
+            np.int32,
         )
-        if self._sampling_dirty:
-            params_list = [
+        sampling = SamplingState.from_params(
+            [
                 (s.sampling if s is not None else SamplingParams())
                 for s in self.slots
             ]
-            self._sampling_state = SamplingState.from_params(params_list)
-            self._sampling_dirty = False
-        fn = self._get_decode_fn()
-        self._key, sub = jax.random.split(self._key)
-        self.cache, next_tokens = fn(
-            self.params,
-            self.cache,
+        )
+        if self._dstate is None:
+            self._dstate = DecodeState(
+                last_token=jnp.zeros((B,), jnp.int32),
+                positions=jnp.zeros((B,), jnp.int32),
+                page_tables=jnp.zeros((B, P), jnp.int32),
+                active=jnp.zeros((B,), jnp.int32),
+                mrope_delta=jnp.zeros((B,), jnp.int32),
+                keys=jnp.zeros((B, 2), jnp.uint32),
+                token_counts=jnp.zeros((B, V), jnp.int32),
+                sampling=sampling,
+            )
+        keep = np.array(
+            [
+                1 if (s is not None and i not in self._changed_slots) else 0
+                for i, s in enumerate(self.slots)
+            ],
+            np.int32,
+        )
+        self._dstate = _rebuild_state(
+            self._dstate,
             jnp.asarray(self._last_token),
             jnp.asarray(self._positions),
             jnp.asarray(self._page_tables),
             jnp.asarray(active),
-            self._sampling_state,
-            sub,
             jnp.asarray(self._mrope_delta),
+            jnp.asarray(self._slot_keys),
+            jnp.asarray(keep),
+            sampling,
+        )
+        self._changed_slots.clear()
+        self._state_dirty = False
+
+    def _decode_step(self) -> list[tuple[Request, int]]:
+        if self._state_dirty or self._dstate is None:
+            self._sync_state()
+        fn = self._get_decode_fn()
+        self.cache, self._dstate, next_tokens = fn(
+            self.params, self.cache, self._dstate
         )
         next_np = np.asarray(next_tokens)
         emitted: list[tuple[Request, int]] = []
         for i, req in enumerate(self.slots):
-            if req is None:
+            if req is None or not self._slot_active(i):
                 continue
             self._positions[i] += 1
             self._last_token[i] = next_np[i]
@@ -571,7 +889,8 @@ class Engine:
         req.finish_reason = reason
         if req.slot is not None:
             self.slots[req.slot] = None
-            self._sampling_dirty = True
+            self._state_dirty = True
+            self._changed_slots.add(req.slot)
             req.slot = None
         if req in self.waiting:   # aborted before admission
             self.waiting.remove(req)

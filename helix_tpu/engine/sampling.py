@@ -1,14 +1,19 @@
 """Token sampling, fully vectorised per batch slot.
 
 OpenAI-surface parameters (temperature / top_p / presence & frequency
-penalties — the knobs the reference forwards to vLLM via request JSON) are
-carried as per-slot arrays inside one jitted step: different requests in a
-continuous batch sample with different settings without re-tracing.
+penalties / seed — the knobs the reference forwards to vLLM via request
+JSON) are carried as per-slot arrays inside one jitted step: different
+requests in a continuous batch sample with different settings without
+re-tracing.
 
 Strategy: restrict to the top ``TOPK_BOUND`` logits (lax.top_k), apply
 temperature / top-k / top-p masking inside that subset, then one categorical
 draw.  Bounding the candidate set keeps the per-step cost O(B * TOPK_BOUND)
 instead of O(B * vocab) for the sort that exact top-p would need.
+
+Randomness is per-slot: each request carries its own PRNG key (seeded from
+``SamplingParams.seed`` when given), split on-device every step — a seeded
+request is reproducible regardless of what else shares the batch.
 """
 
 from __future__ import annotations
@@ -48,6 +53,8 @@ class SamplingState:
     temperature: jax.Array   # [B] f32 (0 = greedy)
     top_p: jax.Array         # [B] f32
     top_k: jax.Array         # [B] i32 (0 = disabled)
+    presence: jax.Array      # [B] f32
+    frequency: jax.Array     # [B] f32
 
     @classmethod
     def from_params(cls, params_list) -> "SamplingState":
@@ -59,13 +66,19 @@ class SamplingState:
             ),
             top_p=jnp.asarray(np.array([p.top_p for p in params_list], np.float32)),
             top_k=jnp.asarray(np.array([p.top_k for p in params_list], np.int32)),
+            presence=jnp.asarray(
+                np.array([p.presence_penalty for p in params_list], np.float32)
+            ),
+            frequency=jnp.asarray(
+                np.array([p.frequency_penalty for p in params_list], np.float32)
+            ),
         )
 
 
 def sample(
     logits: jax.Array,        # [B, V] f32
     state: SamplingState,
-    key: jax.Array,
+    keys: jax.Array,          # [B, 2] u32 — one PRNG key per slot
 ) -> jax.Array:
     """Draw one token per slot. Greedy slots (temperature==0) take argmax."""
     B, V = logits.shape
@@ -87,10 +100,16 @@ def sample(
     mask = mask & keep_p
 
     masked = jnp.where(mask, scaled, -jnp.inf)
-    draw = jax.random.categorical(key, masked, axis=-1)     # [B]
+    draw = jax.vmap(jax.random.categorical)(keys, masked)   # [B]
     sampled = jnp.take_along_axis(top_idx, draw[:, None], axis=-1)[:, 0]
     greedy = top_idx[:, 0]
     return jnp.where(state.temperature == 0.0, greedy, sampled).astype(jnp.int32)
+
+
+def split_keys(keys: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """[B, 2] u32 -> (carry [B, 2], step [B, 2]), all on-device."""
+    both = jax.vmap(lambda k: jax.random.split(k, 2))(keys)  # [B, 2, 2]
+    return both[:, 0], both[:, 1]
 
 
 def apply_penalties(
@@ -99,7 +118,8 @@ def apply_penalties(
     presence: jax.Array,        # [B]
     frequency: jax.Array,       # [B]
 ) -> jax.Array:
-    """OpenAI presence/frequency penalties from an output-token histogram."""
+    """OpenAI presence/frequency penalties from an output-token histogram
+    (vLLM semantics: generated tokens only)."""
     present = (token_counts > 0).astype(logits.dtype)
     return (
         logits

@@ -123,38 +123,54 @@ def _flash_kernel(
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    q = q_ref[0, 0, :, :]
-    k = k_ref[0, 0, :, :]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    if soft_cap is not None:
-        s = soft_cap * jnp.tanh(s / soft_cap)
-
     qp = qpos_ref[0, 0, :]
     kp = kpos_ref[0, 0, :]
-    mask = jnp.ones((block_q, block_kv), dtype=bool)
-    if causal:
-        mask = mask & (qp[:, None] >= kp[None, :])
-    if use_segments:
-        mask = mask & (qseg_ref[0, 0, :][:, None] == kseg_ref[0, 0, :][None, :])
-    s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
 
-    m_prev = m_scr[:]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    p = jnp.exp(s - m_new)
-    alpha = jnp.exp(m_prev - m_new)
-    l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
-    acc = acc_scr[:] * alpha + jax.lax.dot_general(
-        p.astype(v_ref.dtype),
-        v_ref[0, 0, :, :],
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_scr[:] = m_new
-    l_scr[:] = l_new
-    acc_scr[:] = acc
+    if causal:
+        # Causal block skipping: a kv block wholly above the diagonal
+        # (every key position beyond every query position) contributes
+        # nothing — skip its matmuls entirely. Computed from the position
+        # blocks, so it is exact for ragged/chunked prefill too; for the
+        # default arange positions it degenerates to the classic
+        # lower-triangle grid walk (~2x fewer MXU FLOPs at long S).
+        run = jnp.max(qp) >= jnp.min(kp)
+    else:
+        run = True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+
+        mask = jnp.ones((block_q, block_kv), dtype=bool)
+        if causal:
+            mask = mask & (qp[:, None] >= kp[None, :])
+        if use_segments:
+            mask = mask & (
+                qseg_ref[0, 0, :][:, None] == kseg_ref[0, 0, :][None, :]
+            )
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+
+        m_prev = m_scr[:]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype),
+            v_ref[0, 0, :, :],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+        acc_scr[:] = acc
 
     @pl.when(ki == nk - 1)
     def _finalize():

@@ -1,0 +1,111 @@
+"""Long-context serving: a 32k-token prompt streams through
+/v1/chat/completions via chunked prefill (the reference serves arbitrary
+--max-model-len through vLLM: design/sample-profiles/8xH100-vllm.yaml:40-41).
+"""
+
+import asyncio
+import json
+import threading
+
+import jax
+import pytest
+import requests
+
+from helix_tpu.engine.engine import Engine, EngineConfig
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import init_params
+from helix_tpu.serving.engine_loop import EngineLoop
+from helix_tpu.serving.openai_api import OpenAIServer
+from helix_tpu.serving.registry import ModelRegistry, ServedModel
+from helix_tpu.serving.tokenizer import ByteTokenizer
+
+
+@pytest.fixture(scope="module")
+def server_url():
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    eng = Engine(
+        cfg, params,
+        EngineConfig(
+            max_decode_batch=2, page_size=16, num_pages=2200,
+            max_pages_per_seq=2100, max_prefill_len=512,
+            max_model_len=33280, attn_backend="reference",
+            eos_token_ids=tok.eos_ids,
+        ),
+    )
+    loop = EngineLoop(eng, "tiny").start()
+    registry = ModelRegistry()
+    registry.register(
+        ServedModel(name="tiny-32k", loop=loop, tokenizer=tok,
+                    context_length=33280)
+    )
+    srv = OpenAIServer(registry)
+    app = srv.build_app()
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        aloop = asyncio.new_event_loop()
+        asyncio.set_event_loop(aloop)
+        from aiohttp import web
+
+        runner = web.AppRunner(app)
+        aloop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 18321)
+        aloop.run_until_complete(site.start())
+        holder["loop"] = aloop
+        started.set()
+        aloop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    yield "http://127.0.0.1:18321"
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+    loop.stop(join=False)
+
+
+def test_32k_prompt_streams(server_url):
+    prompt = "helix " * 5461  # ~32.7k bytes -> ~32.7k tokens (byte tokenizer)
+    assert len(prompt) > 32000
+    r = requests.post(
+        f"{server_url}/v1/chat/completions",
+        json={
+            "model": "tiny-32k",
+            "messages": [{"role": "user", "content": prompt}],
+            "max_tokens": 8,
+            "temperature": 0,
+            "stream": True,
+        },
+        stream=True,
+        timeout=600,
+    )
+    assert r.status_code == 200
+    chunks = []
+    for line in r.iter_lines():
+        if not line or not line.startswith(b"data: "):
+            continue
+        payload = line[len(b"data: "):]
+        if payload == b"[DONE]":
+            break
+        chunks.append(json.loads(payload))
+    assert chunks, "no SSE chunks received"
+    deltas = [
+        c["choices"][0]["delta"].get("content", "") for c in chunks
+    ]
+    finish = [c["choices"][0].get("finish_reason") for c in chunks]
+    assert any(d for d in deltas)
+    assert finish[-1] in ("stop", "length")
+
+
+def test_over_limit_prompt_rejected(server_url):
+    r = requests.post(
+        f"{server_url}/v1/chat/completions",
+        json={
+            "model": "tiny-32k",
+            "messages": [{"role": "user", "content": "x" * 40000}],
+            "max_tokens": 4,
+        },
+        timeout=60,
+    )
+    assert r.status_code in (400, 422)

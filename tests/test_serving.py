@@ -251,7 +251,7 @@ class TestEngineErrorSurface:
             timeout=60,
         )
         assert r.status_code == 400
-        assert "max_prefill_len" in r.json()["error"]["message"]
+        assert "context limit" in r.json()["error"]["message"]
         # engine still serves
         r2 = requests.post(
             f"{server_url}/v1/chat/completions",
