@@ -27,11 +27,23 @@ def _cmd_serve_node(args) -> int:
     from helix_tpu.control.profile import ServingProfile
     from helix_tpu.serving.openai_api import OpenAIServer
 
+    tunnel_mode = getattr(args, "tunnel", False)
+    if tunnel_mode and not args.control_plane:
+        print(
+            "serve-node: --tunnel requires --control-plane (the tunnel "
+            "dials out to it)", file=sys.stderr,
+        )
+        return 2
     agent = NodeAgent(
         runner_id=args.runner_id,
         heartbeat_url=args.control_plane,
         heartbeat_interval=args.heartbeat_interval,
-        address=args.advertise or f"http://127.0.0.1:{args.port}",
+        # tunnel mode advertises NO address: the control plane dispatches
+        # through the reverse tunnel (NAT'd node, no listening TCP port)
+        address=(
+            "" if tunnel_mode
+            else args.advertise or f"http://127.0.0.1:{args.port}"
+        ),
     )
     if args.profile:
         with open(args.profile) as f:
@@ -51,6 +63,33 @@ def _cmd_serve_node(args) -> int:
         return web.json_response(agent.heartbeat_payload())
 
     app.router.add_get("/api/v1/state", state_handler)
+    if tunnel_mode:
+        import asyncio
+        import os
+        import tempfile
+
+        from helix_tpu.control.tunnel import TunnelAgent
+
+        sock = getattr(args, "unix_socket", None) or os.path.join(
+            tempfile.mkdtemp(prefix="helix-node-"), "openai.sock"
+        )
+
+        async def main():
+            runner = web.AppRunner(app)
+            await runner.setup()
+            await web.UnixSite(runner, sock).start()
+            print(
+                f"helix-tpu node on unix socket {sock}; tunnelling to "
+                f"{args.control_plane}"
+            )
+            ta = TunnelAgent(
+                args.runner_id, args.control_plane, unix_socket=sock,
+                runner_token=agent.runner_token,
+            )
+            await ta.run()
+
+        asyncio.run(main())
+        return 0
     print(f"helix-tpu node listening on {args.host}:{args.port}")
     web.run_app(app, host=args.host, port=args.port, print=None)
     return 0
@@ -217,6 +256,12 @@ def main(argv=None) -> int:
     n.add_argument("--control-plane", help="control plane base URL")
     n.add_argument("--heartbeat-interval", type=float, default=30.0)
     n.add_argument("--advertise", help="address the control plane dials back")
+    n.add_argument(
+        "--tunnel", action="store_true",
+        help="no listening TCP port: serve on a unix socket and dial an "
+             "outbound reverse tunnel to the control plane (NAT'd nodes)",
+    )
+    n.add_argument("--unix-socket", help="socket path for --tunnel mode")
     n.set_defaults(fn=_cmd_serve_node)
 
     s = sub.add_parser("serve", help="run the control plane")

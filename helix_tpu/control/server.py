@@ -65,8 +65,11 @@ class ControlPlane:
         from helix_tpu.knowledge.ingest import KnowledgeManager
         from helix_tpu.knowledge.vector_store import VectorStore
 
+        from helix_tpu.control.tunnel import TunnelHub
+
         self.store = Store(db_path)
         self.router = InferenceRouter()
+        self.tunnels = TunnelHub()
         auth_path = ":memory:" if db_path == ":memory:" else db_path + ".auth"
         self.auth = Authenticator(auth_path)
         bill_path = (
@@ -243,7 +246,9 @@ class ControlPlane:
             return False
         if request.method == "POST" and parts[4] == "heartbeat":
             return True
-        return request.method == "GET" and parts[4] == "assignment"
+        return request.method == "GET" and parts[4] in (
+            "assignment", "tunnel"
+        )
 
     def _runner_token_ok(self, request) -> bool:
         import hmac as _hmac
@@ -318,6 +323,7 @@ class ControlPlane:
         # runner control loop
         r.add_post("/api/v1/runners/{id}/heartbeat", self.heartbeat)
         r.add_get("/api/v1/runners/{id}/assignment", self.get_assignment)
+        r.add_get("/api/v1/runners/{id}/tunnel", self.runner_tunnel)
         r.add_post("/api/v1/runners/{id}/assign-profile", self.assign_profile)
         r.add_delete("/api/v1/runners/{id}/assignment", self.clear_assignment)
         r.add_get("/api/v1/runners", self.list_runners)
@@ -436,6 +442,15 @@ class ControlPlane:
         self.store.record_heartbeat(rid, body)
         self.router.evict_stale()
         return web.json_response({"ok": True})
+
+    async def runner_tunnel(self, request):
+        """A runner's outbound reverse-tunnel dial (revdial: the control
+        plane dispatches inference back through this websocket, so NAT'd
+        runners with no listening port work)."""
+        denied = self._require_runner(request)
+        if denied is not None:
+            return denied
+        return await self.tunnels.handle_ws(request.match_info["id"], request)
 
     async def get_assignment(self, request):
         denied = self._require_runner(request)
@@ -1152,7 +1167,11 @@ class ControlPlane:
     async def dispatch_openai(self, request):
         """Pick a runner by model, stream the response through unbuffered
         (the SSE-preserving trick of ``helix_openai_server.go:279-307`` —
-        chunk-for-chunk copy, no buffering of the whole stream)."""
+        chunk-for-chunk copy, no buffering of the whole stream).
+
+        Runners with a routable address are dispatched over plain HTTP;
+        NAT'd runners (no address) are dispatched through their reverse
+        tunnel (``helix_tpu.control.tunnel``)."""
         raw = await request.read()
         try:
             body = json.loads(raw)
@@ -1168,7 +1187,7 @@ class ControlPlane:
             )
         address = runner.meta.get("address")
         if not address:
-            return _err(503, f"runner {runner.id} has no address")
+            return await self._dispatch_tunnel(request, runner, raw)
         url = f"{address}{request.path}"
         timeout = aiohttp.ClientTimeout(total=300)  # 5 min budget, like the
         # reference's dispatch watchdog (helix_openai_server.go:260)
@@ -1189,3 +1208,50 @@ class ControlPlane:
                     await resp.write(chunk)
                 await resp.write_eof()
                 return resp
+
+    async def _dispatch_tunnel(self, request, runner, raw: bytes):
+        """Dispatch through the runner's reverse tunnel, preserving SSE
+        chunk boundaries.  Mid-stream tunnel death surfaces as a terminal
+        SSE error frame (already-streamed tokens stand); pre-stream death
+        is a clean 502."""
+        from helix_tpu.control.tunnel import TunnelClosed
+
+        try:
+            status, headers, chunks = await self.tunnels.request(
+                runner.id,
+                "POST",
+                request.path,
+                {"Content-Type": "application/json"},
+                raw,
+            )
+        except TunnelClosed as e:
+            return _err(502, f"runner {runner.id} unreachable: {e}")
+        resp = web.StreamResponse(
+            status=status,
+            headers={
+                "Content-Type": headers.get(
+                    "Content-Type", "application/json"
+                )
+            },
+        )
+        await resp.prepare(request)
+        try:
+            try:
+                async for chunk in chunks:
+                    await resp.write(chunk)
+            except TunnelClosed as e:
+                frame = json.dumps(
+                    {
+                        "error": {
+                            "message": "runner disconnected mid-stream: "
+                            + str(e)[:200]
+                        }
+                    }
+                )
+                await resp.write(f"data: {frame}\n\n".encode())
+            await resp.write_eof()
+        except (ConnectionError, OSError):
+            # client went away: chunks' generator-exit sends OP_CLOSE to
+            # the runner so generation aborts instead of burning chips
+            await chunks.aclose()
+        return resp

@@ -1,0 +1,389 @@
+"""Reverse tunnels: NAT'd runners dial OUT; the control plane dials back
+through the same websocket.
+
+The TPU-native counterpart of the reference's RevDial + Connman transport
+(``api/pkg/revdial/revdial.go:5-18``: "a dialer that for the machine that
+accepted the original connection becomes the dialing side";
+``api/pkg/connman/connman.go:20-40``: keyed dialers, 30s reconnect grace,
+queued Dial waiters) and of the raw-conn SSE trick in
+``api/pkg/openai/helix_openai_server.go:279-307`` — responses stream
+chunk-for-chunk, never buffered.
+
+Design (idiomatic asyncio rather than a Go net.Conn translation): one
+websocket per runner carries multiplexed logical HTTP streams.  Binary
+frames: ``[sid: u32 BE][op: u8][payload]``.
+
+    OP_OPEN  (control->runner)  JSON {method, path, headers}
+    OP_BODY  (both directions)  raw body bytes
+    OP_END   (both directions)  body finished
+    OP_RESP  (runner->control)  JSON {status, headers}
+    OP_ERR   (runner->control)  JSON {error}
+    OP_CLOSE (both directions)  abort the stream
+
+The runner serves its OpenAI surface on a unix socket (no listening TCP
+port at all — exactly how the reference's hydra daemon runs, SURVEY.md
+§2.3) and the ``TunnelAgent`` bridges frames to it.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+from typing import AsyncIterator, Optional
+
+import aiohttp
+from aiohttp import web
+
+OP_OPEN = 0
+OP_BODY = 1
+OP_END = 2
+OP_RESP = 3
+OP_ERR = 4
+OP_CLOSE = 5
+
+_HDR = struct.Struct(">IB")
+
+
+def pack_frame(sid: int, op: int, payload: bytes = b"") -> bytes:
+    return _HDR.pack(sid, op) + payload
+
+
+def unpack_frame(data: bytes) -> tuple[int, int, bytes]:
+    sid, op = _HDR.unpack_from(data)
+    return sid, op, data[_HDR.size:]
+
+
+class TunnelClosed(Exception):
+    """The runner's tunnel dropped (mid-stream or before dispatch)."""
+
+
+class _Stream:
+    """Control-plane view of one logical request through the tunnel."""
+
+    def __init__(self):
+        self.resp_fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self.chunks: asyncio.Queue = asyncio.Queue()
+
+    def push_error(self, msg: str):
+        if not self.resp_fut.done():
+            self.resp_fut.set_exception(TunnelClosed(msg))
+        else:
+            self.chunks.put_nowait(TunnelClosed(msg))
+
+
+class TunnelConn:
+    """One live runner websocket; multiplexes logical streams over it."""
+
+    def __init__(self, runner_id: str, ws: web.WebSocketResponse):
+        self.runner_id = runner_id
+        self.ws = ws
+        self._streams: dict[int, _Stream] = {}
+        self._next_sid = 1
+        self._closed = False
+
+    async def pump(self):
+        """Read frames until the socket dies; fan out to streams."""
+        try:
+            async for msg in self.ws:
+                if msg.type != web.WSMsgType.BINARY:
+                    continue
+                sid, op, payload = unpack_frame(msg.data)
+                st = self._streams.get(sid)
+                if st is None:
+                    continue
+                if op == OP_RESP:
+                    doc = json.loads(payload)
+                    if not st.resp_fut.done():
+                        st.resp_fut.set_result(doc)
+                elif op == OP_BODY:
+                    st.chunks.put_nowait(payload)
+                elif op == OP_END:
+                    st.chunks.put_nowait(None)
+                    self._streams.pop(sid, None)
+                elif op in (OP_ERR, OP_CLOSE):
+                    detail = ""
+                    if payload:
+                        try:
+                            detail = json.loads(payload).get("error", "")
+                        except Exception:  # noqa: BLE001
+                            detail = payload[:200].decode("utf-8", "replace")
+                    st.push_error(detail or "stream closed by runner")
+                    self._streams.pop(sid, None)
+        finally:
+            self.close("tunnel disconnected")
+
+    def close(self, reason: str):
+        if self._closed:
+            return
+        self._closed = True
+        for st in list(self._streams.values()):
+            st.push_error(reason)
+        self._streams.clear()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    async def request(
+        self,
+        method: str,
+        path: str,
+        headers: Optional[dict] = None,
+        body: bytes = b"",
+    ) -> tuple[int, dict, AsyncIterator[bytes]]:
+        """Dispatch one HTTP request through the tunnel.  The returned
+        iterator yields response body chunks as they arrive (SSE-safe:
+        chunk-for-chunk, no buffering)."""
+        if self._closed:
+            raise TunnelClosed("tunnel is closed")
+        sid = self._next_sid
+        self._next_sid += 1
+        st = _Stream()
+        self._streams[sid] = st
+        try:
+            await self.ws.send_bytes(
+                pack_frame(
+                    sid, OP_OPEN,
+                    json.dumps(
+                        {
+                            "method": method,
+                            "path": path,
+                            "headers": headers or {},
+                        }
+                    ).encode(),
+                )
+            )
+            if body:
+                await self.ws.send_bytes(pack_frame(sid, OP_BODY, body))
+            await self.ws.send_bytes(pack_frame(sid, OP_END))
+        except (ConnectionError, OSError, RuntimeError) as e:
+            self._streams.pop(sid, None)
+            raise TunnelClosed(f"tunnel send failed: {e}") from e
+        doc = await st.resp_fut
+
+        async def body_iter():
+            try:
+                while True:
+                    chunk = await st.chunks.get()
+                    if chunk is None:
+                        return
+                    if isinstance(chunk, Exception):
+                        raise chunk
+                    yield chunk
+            finally:
+                # consumer stopped early (client disconnect): abort the
+                # runner-side generation instead of letting it burn chips
+                # for a dead client
+                if self._streams.get(sid) is st:
+                    await self.cancel(sid)
+
+        return int(doc["status"]), dict(doc.get("headers", {})), body_iter()
+
+    async def cancel(self, sid: int):
+        """Abort one logical stream: tell the runner to stop generating
+        (client went away) and drop the local bookkeeping."""
+        self._streams.pop(sid, None)
+        try:
+            await self.ws.send_bytes(pack_frame(sid, OP_CLOSE))
+        except Exception:  # noqa: BLE001 — socket already gone
+            pass
+
+
+class TunnelHub:
+    """Keyed runner tunnels with reconnect grace and queued dials
+    (connman semantics: ``connman.go:20-40``)."""
+
+    def __init__(self, grace: float = 30.0):
+        self.grace = grace
+        self._conns: dict[str, TunnelConn] = {}
+        self._waiters: dict[str, list[asyncio.Future]] = {}
+
+    def connected(self, runner_id: str) -> bool:
+        c = self._conns.get(runner_id)
+        return c is not None and not c.closed
+
+    async def handle_ws(self, runner_id: str, request) -> web.WebSocketResponse:
+        """Accept a runner's outbound dial (the server becomes the dialing
+        side from here on)."""
+        ws = web.WebSocketResponse(heartbeat=20, max_msg_size=0)
+        await ws.prepare(request)
+        old = self._conns.get(runner_id)
+        if old is not None and not old.closed:
+            old.close("replaced by a newer tunnel")
+        conn = TunnelConn(runner_id, ws)
+        self._conns[runner_id] = conn
+        for fut in self._waiters.pop(runner_id, []):
+            if not fut.done():
+                fut.set_result(conn)
+        try:
+            await conn.pump()
+        finally:
+            if self._conns.get(runner_id) is conn:
+                del self._conns[runner_id]
+        return ws
+
+    async def _get_conn(self, runner_id: str) -> TunnelConn:
+        c = self._conns.get(runner_id)
+        if c is not None and not c.closed:
+            return c
+        # queued dial: wait for the runner to re-dial within the grace
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._waiters.setdefault(runner_id, []).append(fut)
+        try:
+            return await asyncio.wait_for(fut, timeout=self.grace)
+        except asyncio.TimeoutError:
+            raise TunnelClosed(
+                f"runner {runner_id} has no tunnel (waited {self.grace}s)"
+            ) from None
+        finally:
+            waiters = self._waiters.get(runner_id)
+            if waiters and fut in waiters:
+                waiters.remove(fut)
+                if not waiters:
+                    del self._waiters[runner_id]
+
+    async def request(
+        self,
+        runner_id: str,
+        method: str,
+        path: str,
+        headers: Optional[dict] = None,
+        body: bytes = b"",
+    ) -> tuple[int, dict, AsyncIterator[bytes]]:
+        conn = await self._get_conn(runner_id)
+        return await conn.request(method, path, headers, body)
+
+
+class TunnelAgent:
+    """Runner-side: dial the control plane, serve tunneled requests against
+    the local (unix-socket) HTTP surface, stream responses back."""
+
+    def __init__(
+        self,
+        runner_id: str,
+        control_url: str,
+        *,
+        unix_socket: Optional[str] = None,
+        local_base: str = "http://localhost",
+        runner_token: str = "",
+        reconnect_delay: float = 1.0,
+    ):
+        self.runner_id = runner_id
+        self.control_url = control_url.rstrip("/")
+        self.unix_socket = unix_socket
+        self.local_base = local_base.rstrip("/")
+        self.runner_token = runner_token
+        self.reconnect_delay = reconnect_delay
+        self._stop = asyncio.Event()
+        self.connects = 0   # observability: how many times we dialed
+
+    def _connector(self):
+        if self.unix_socket:
+            return aiohttp.UnixConnector(path=self.unix_socket)
+        return None
+
+    async def run(self):
+        """Dial-out loop with reconnect backoff (runner keeps re-dialing
+        for the life of the process; the hub's grace window makes brief
+        drops invisible to callers)."""
+        url = f"{self.control_url}/api/v1/runners/{self.runner_id}/tunnel"
+        headers = (
+            {"X-Runner-Token": self.runner_token}
+            if self.runner_token
+            else {}
+        )
+        while not self._stop.is_set():
+            try:
+                async with aiohttp.ClientSession() as session:
+                    async with session.ws_connect(
+                        url, headers=headers, heartbeat=20, max_msg_size=0
+                    ) as ws:
+                        self.connects += 1
+                        await self._serve(ws)
+            except (aiohttp.ClientError, OSError, asyncio.TimeoutError):
+                pass
+            if not self._stop.is_set():
+                await asyncio.sleep(self.reconnect_delay)
+
+    def stop(self):
+        self._stop.set()
+
+    async def _serve(self, ws):
+        bodies: dict[int, bytearray] = {}
+        opens: dict[int, dict] = {}
+        tasks: dict[int, asyncio.Task] = {}
+        try:
+            async for msg in ws:
+                if msg.type != aiohttp.WSMsgType.BINARY:
+                    continue
+                sid, op, payload = unpack_frame(msg.data)
+                if op == OP_OPEN:
+                    opens[sid] = json.loads(payload)
+                    bodies[sid] = bytearray()
+                elif op == OP_BODY and sid in bodies:
+                    bodies[sid] += payload
+                elif op == OP_END and sid in opens:
+                    spec = opens.pop(sid)
+                    body = bytes(bodies.pop(sid))
+                    t = asyncio.create_task(
+                        self._dispatch(ws, sid, spec, body)
+                    )
+                    tasks[sid] = t
+                    t.add_done_callback(lambda _t, s=sid: tasks.pop(s, None))
+                elif op == OP_CLOSE:
+                    # control plane aborted the stream (client went away):
+                    # cancel the local request so the engine aborts too
+                    opens.pop(sid, None)
+                    bodies.pop(sid, None)
+                    t = tasks.pop(sid, None)
+                    if t is not None:
+                        t.cancel()
+        finally:
+            for t in tasks.values():
+                t.cancel()
+
+    async def _dispatch(self, ws, sid: int, spec: dict, body: bytes):
+        """One tunneled request -> local HTTP -> frames back.  Chunks are
+        forwarded as they arrive so SSE streams token-by-token."""
+        try:
+            async with aiohttp.ClientSession(
+                connector=self._connector(),
+                timeout=aiohttp.ClientTimeout(total=600),
+            ) as session:
+                async with session.request(
+                    spec.get("method", "POST"),
+                    f"{self.local_base}{spec.get('path', '/')}",
+                    data=body if body else None,
+                    headers=spec.get("headers") or {},
+                ) as resp:
+                    await ws.send_bytes(
+                        pack_frame(
+                            sid, OP_RESP,
+                            json.dumps(
+                                {
+                                    "status": resp.status,
+                                    "headers": {
+                                        "Content-Type": resp.headers.get(
+                                            "Content-Type",
+                                            "application/json",
+                                        )
+                                    },
+                                }
+                            ).encode(),
+                        )
+                    )
+                    async for chunk in resp.content.iter_any():
+                        await ws.send_bytes(pack_frame(sid, OP_BODY, chunk))
+                    await ws.send_bytes(pack_frame(sid, OP_END))
+        except Exception as e:  # noqa: BLE001 — reported through the tunnel
+            try:
+                await ws.send_bytes(
+                    pack_frame(
+                        sid, OP_ERR,
+                        json.dumps({"error": f"{type(e).__name__}: {e}"})
+                        .encode(),
+                    )
+                )
+            except Exception:  # noqa: BLE001 — socket already gone
+                pass

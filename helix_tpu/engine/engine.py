@@ -535,10 +535,15 @@ class Engine:
             self._finish(r, FinishReason.ABORT)
         return stuck
 
-    def warmup(self) -> None:
+    def warmup(self, chunked: bool = True) -> None:
         """Compile the decode step and the smallest prefill bucket ahead of
         traffic (profile-apply time), so first-token latency excludes XLA
-        compilation.  Runs a dummy request against the garbage page only."""
+        compilation.  Runs dummy requests against the garbage page only.
+
+        When the context limit admits chunked prefill, also compiles the
+        full-chunk shape against every history-capacity bucket (the
+        dominant per-chunk shapes; a ragged final chunk may still compile
+        one extra small shape at request time)."""
         if self.model_cfg.mrope_sections is not None:
             return  # VL prefill shape depends on image buckets; skip
         req = Request(
@@ -549,6 +554,23 @@ class Engine:
         table = np.zeros((self.cache_cfg.max_pages_per_seq,), np.int32)
         self._prefill(req, table)          # compiles smallest bucket
         self._decode_step()                # compiles fused decode (no slots)
+        C = self.cfg.max_prefill_len
+        if not chunked or self.max_context_len <= C:
+            return
+        ps = self.cache_cfg.page_size
+        fn = _build_chunk_prefill_fn(self.model_cfg, ps, self._backend)
+        sampling = SamplingState.from_params([SamplingParams()])
+        key = jax.random.PRNGKey(0)
+        tokens = jnp.zeros((1, C), jnp.int32)
+        full = jnp.zeros((1, self.cache_cfg.max_pages_per_seq), jnp.int32)
+        hist = 0   # 0 = the first-chunk (no-history) shape
+        while hist < self.max_context_len:
+            self.cache, _ = fn(
+                self.params, self.cache, tokens, jnp.int32(hist),
+                jnp.int32(C), jnp.zeros((1, hist // ps), jnp.int32), full,
+                sampling, key,
+            )
+            hist = C if hist == 0 else hist * 2
 
     def step(self) -> list[tuple[Request, int]]:
         """Admit + prefill waiting requests, then one decode step.
@@ -631,7 +653,11 @@ class Engine:
             slot = free_slots[0]
             pages = self.allocator.allocate(req.id, need)
             req.slot = slot
-            req.max_len = len(pages) * self.cache_cfg.page_size
+            # pages round up to page granularity; the model context limit
+            # still binds exactly
+            req.max_len = min(
+                len(pages) * self.cache_cfg.page_size, self.max_context_len
+            )
             self.slots[slot] = req
             table = np.zeros((self.cache_cfg.max_pages_per_seq,), np.int32)
             table[: len(pages)] = pages

@@ -1,0 +1,313 @@
+"""Reverse-tunnel transport tests (revdial/connman equivalent).
+
+The bar (VERDICT round 1, item 4): a runner with NO listening TCP port at
+all still streams chat through the control plane; mid-stream disconnect
+surfaces a clean error; the hub's 30s reconnect grace queues dials.
+Reference: api/pkg/revdial/revdial.go:5-18, api/pkg/connman/connman.go:20-40,
+api/pkg/openai/helix_openai_server.go:279-307."""
+
+import asyncio
+import json
+import os
+import tempfile
+import threading
+import time
+
+import jax
+import pytest
+import requests
+
+from helix_tpu.control.server import ControlPlane
+from helix_tpu.control.tunnel import TunnelAgent, TunnelClosed, TunnelHub
+from helix_tpu.engine.engine import Engine, EngineConfig
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import init_params
+from helix_tpu.serving.engine_loop import EngineLoop
+from helix_tpu.serving.openai_api import OpenAIServer
+from helix_tpu.serving.registry import ModelRegistry, ServedModel
+from helix_tpu.serving.tokenizer import ByteTokenizer
+
+CP_PORT = 18431
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """Control plane (TCP) + tunnelled runner (unix socket only)."""
+    from aiohttp import web
+
+    cp = ControlPlane()
+    sock = os.path.join(tempfile.mkdtemp(prefix="helix-tun-"), "node.sock")
+
+    # runner-side OpenAI surface on a unix socket — no TCP listener
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(3))
+    eng = Engine(
+        cfg, params,
+        EngineConfig(
+            max_decode_batch=2, page_size=4, num_pages=256,
+            max_pages_per_seq=32, max_prefill_len=128,
+            attn_backend="reference", eos_token_ids=tok.eos_ids,
+        ),
+    )
+    eloop = EngineLoop(eng, "tiny").start()
+    registry = ModelRegistry()
+    registry.register(
+        ServedModel(name="tiny-tunnel", loop=eloop, tokenizer=tok,
+                    context_length=128)
+    )
+    node_app = OpenAIServer(registry).build_app()
+
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        aloop = asyncio.new_event_loop()
+        asyncio.set_event_loop(aloop)
+
+        async def boot():
+            cp_runner = web.AppRunner(cp.build_app())
+            await cp_runner.setup()
+            await web.TCPSite(cp_runner, "127.0.0.1", CP_PORT).start()
+            node_runner = web.AppRunner(node_app)
+            await node_runner.setup()
+            await web.UnixSite(node_runner, sock).start()
+            agent = TunnelAgent(
+                "nat-node", f"http://127.0.0.1:{CP_PORT}",
+                unix_socket=sock, reconnect_delay=0.2,
+            )
+            holder["agent"] = agent
+            holder["agent_task"] = aloop.create_task(agent.run())
+
+        aloop.run_until_complete(boot())
+        holder["loop"] = aloop
+        started.set()
+        aloop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+
+    # heartbeat WITHOUT an address: the control plane must use the tunnel
+    hb = {
+        "address": "",
+        "accelerators": [],
+        "profile": {"name": "p", "status": "running",
+                    "models": ["tiny-tunnel"]},
+    }
+    r = requests.post(
+        f"http://127.0.0.1:{CP_PORT}/api/v1/runners/nat-node/heartbeat",
+        json=hb, timeout=10,
+    )
+    assert r.status_code == 200
+    deadline = time.time() + 10
+    while time.time() < deadline and not cp.tunnels.connected("nat-node"):
+        time.sleep(0.1)
+    assert cp.tunnels.connected("nat-node")
+
+    yield {
+        "cp": cp, "url": f"http://127.0.0.1:{CP_PORT}", "holder": holder,
+        "hb": hb,
+    }
+    holder["agent"].stop()
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+    eloop.stop(join=False)
+    cp.orchestrator.stop()
+    cp.knowledge.stop()
+    cp.triggers.stop()
+
+
+def test_chat_streams_through_tunnel(stack):
+    """Non-stream + SSE chat both ride the reverse tunnel."""
+    r = requests.post(
+        f"{stack['url']}/v1/chat/completions",
+        json={"model": "tiny-tunnel",
+              "messages": [{"role": "user", "content": "hello tunnel"}],
+              "max_tokens": 6, "temperature": 0},
+        timeout=120,
+    )
+    assert r.status_code == 200, r.text
+    doc = r.json()
+    assert doc["choices"][0]["message"]["content"] is not None
+
+    r = requests.post(
+        f"{stack['url']}/v1/chat/completions",
+        json={"model": "tiny-tunnel",
+              "messages": [{"role": "user", "content": "stream me"}],
+              "max_tokens": 6, "temperature": 0, "stream": True},
+        stream=True, timeout=120,
+    )
+    assert r.status_code == 200
+    assert "text/event-stream" in r.headers.get("Content-Type", "")
+    chunks = []
+    for line in r.iter_lines():
+        if line.startswith(b"data: "):
+            payload = line[6:]
+            if payload == b"[DONE]":
+                break
+            chunks.append(json.loads(payload))
+    assert len(chunks) >= 2  # token-by-token, not one buffered blob
+
+
+def test_embeddings_through_tunnel(stack):
+    r = requests.post(
+        f"{stack['url']}/v1/embeddings",
+        json={"model": "tiny-tunnel", "input": "embed me"},
+        timeout=60,
+    )
+    # tiny-tunnel is a chat model: the node returns a structured error —
+    # the point is the error RODE THE TUNNEL (status + JSON intact)
+    assert r.status_code in (200, 400, 404)
+    assert "error" in r.json() or r.json().get("object") == "list"
+
+
+def test_unknown_runner_is_clean_502(stack):
+    cp = stack["cp"]
+    cp.router.upsert_from_heartbeat(
+        "ghost", models=["ghost-model"], profile_name="p",
+        profile_status="running", accelerators=[], meta={"address": ""},
+    )
+    cp.tunnels.grace = 0.5  # don't wait the full 30s in tests
+    try:
+        r = requests.post(
+            f"{stack['url']}/v1/chat/completions",
+            json={"model": "ghost-model",
+                  "messages": [{"role": "user", "content": "x"}],
+                  "max_tokens": 2},
+            timeout=30,
+        )
+        assert r.status_code == 502
+        assert "unreachable" in r.json()["error"]["message"]
+    finally:
+        cp.tunnels.grace = 30.0
+
+
+def test_reconnect_grace_queues_dials(stack):
+    """Kill the tunnel; a dispatch issued while it's down must succeed
+    once the agent re-dials (queued dial inside the grace window)."""
+    cp = stack["cp"]
+    holder = stack["holder"]
+    loop = holder["loop"]
+
+    # drop the current tunnel from the server side
+    conn = cp.tunnels._conns.get("nat-node")
+    assert conn is not None
+
+    async def drop():
+        await conn.ws.close()
+
+    asyncio.run_coroutine_threadsafe(drop(), loop).result(timeout=10)
+
+    # dispatch immediately — the agent's reconnect_delay is 0.2s, well
+    # inside the grace, so the queued dial should complete
+    r = requests.post(
+        f"{stack['url']}/v1/chat/completions",
+        json={"model": "tiny-tunnel",
+              "messages": [{"role": "user", "content": "after drop"}],
+              "max_tokens": 4, "temperature": 0},
+        timeout=60,
+    )
+    assert r.status_code == 200, r.text
+    assert holder["agent"].connects >= 2  # proved it re-dialed
+
+
+def test_runner_token_required_on_tunnel_when_auth_on():
+    """With auth_required, a tunnel dial without the runner token is
+    rejected."""
+    from aiohttp import web
+
+    cp = ControlPlane(auth_required=True, runner_token="sekrit")
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        aloop = asyncio.new_event_loop()
+        asyncio.set_event_loop(aloop)
+
+        async def boot():
+            runner = web.AppRunner(cp.build_app())
+            await runner.setup()
+            await web.TCPSite(runner, "127.0.0.1", 18432).start()
+
+        aloop.run_until_complete(boot())
+        holder["loop"] = aloop
+        started.set()
+        aloop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    try:
+        import websocket  # noqa: F401 — not available; use aiohttp client
+    except ImportError:
+        pass
+
+    async def dial(token):
+        import aiohttp
+
+        headers = {"X-Runner-Token": token} if token else {}
+        async with aiohttp.ClientSession() as s:
+            try:
+                async with s.ws_connect(
+                    "http://127.0.0.1:18432/api/v1/runners/n/tunnel",
+                    headers=headers, timeout=aiohttp.ClientWSTimeout(10),
+                ) as ws:
+                    return 101
+            except aiohttp.WSServerHandshakeError as e:
+                return e.status
+
+    assert asyncio.run(dial("")) == 401
+    assert asyncio.run(dial("wrong")) == 401
+    assert asyncio.run(dial("sekrit")) == 101
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+    cp.orchestrator.stop()
+    cp.knowledge.stop()
+    cp.triggers.stop()
+
+
+def test_midstream_disconnect_surfaces_clean_error(stack):
+    """Kill the tunnel while an SSE stream is in flight: the client gets a
+    terminal structured error frame, not a hung or silently-truncated
+    stream."""
+    cp = stack["cp"]
+    loop = stack["holder"]["loop"]
+    r = requests.post(
+        f"{stack['url']}/v1/chat/completions",
+        json={"model": "tiny-tunnel",
+              "messages": [{"role": "user", "content": "long stream"}],
+              "max_tokens": 200, "temperature": 0, "stream": True},
+        stream=True, timeout=120,
+    )
+    assert r.status_code == 200
+    lines = r.iter_lines()
+    got_first = False
+    saw_error = False
+    for line in lines:
+        if not line.startswith(b"data: "):
+            continue
+        payload = line[6:]
+        if payload == b"[DONE]":
+            break
+        doc = json.loads(payload)
+        if "error" in doc:
+            saw_error = True
+            assert "disconnected" in doc["error"]["message"]
+            break
+        if not got_first:
+            got_first = True
+            conn = cp.tunnels._conns.get("nat-node")
+
+            async def drop():
+                if conn is not None:
+                    await conn.ws.close()
+
+            asyncio.run_coroutine_threadsafe(drop(), loop).result(timeout=10)
+    assert got_first
+    assert saw_error, "stream ended without a structured error frame"
+    # and the stack recovers: next request succeeds after re-dial
+    r2 = requests.post(
+        f"{stack['url']}/v1/chat/completions",
+        json={"model": "tiny-tunnel",
+              "messages": [{"role": "user", "content": "recovered"}],
+              "max_tokens": 4, "temperature": 0},
+        timeout=60,
+    )
+    assert r2.status_code == 200, r2.text
