@@ -100,7 +100,19 @@ def _cmd_serve(args) -> int:
 
     from helix_tpu.control.server import ControlPlane
 
-    cp = ControlPlane(db_path=args.db)
+    api_host = (
+        "127.0.0.1"
+        if args.host in ("0.0.0.0", "127.0.0.1", "localhost", "::")
+        else args.host
+    )
+    cp = ControlPlane(
+        db_path=args.db,
+        sandbox_agents_url=(
+            f"http://{api_host}:{args.port}"
+            if getattr(args, "sandbox_agents", False)
+            else None
+        ),
+    )
     print(f"helix-tpu control plane listening on {args.host}:{args.port}")
     web.run_app(cp.build_app(), host=args.host, port=args.port, print=None)
     return 0
@@ -268,6 +280,11 @@ def main(argv=None) -> int:
     s.add_argument("--host", default="0.0.0.0")
     s.add_argument("--port", type=int, default=8080)
     s.add_argument("--db", default="helix.db")
+    s.add_argument(
+        "--sandbox-agents", action="store_true",
+        help="run spec-task agents in isolated resource-limited "
+             "subprocesses instead of in-process",
+    )
     s.set_defaults(fn=_cmd_serve)
 
     pr = sub.add_parser("profile", help="validate a profile YAML")

@@ -46,6 +46,7 @@ class ControlPlane:
     def __init__(
         self, db_path: str = ":memory:", embed_fn=None,
         auth_required: bool = False, runner_token: str | None = None,
+        sandbox_agents_url: str | None = None,
     ):
         import os as _os_env
 
@@ -174,12 +175,21 @@ class ControlPlane:
 
             return emit, close
 
-        self.orchestrator = SpecTaskOrchestrator(
-            self.task_store,
-            self.git,
-            AgentExecutor(
+        if sandbox_agents_url:
+            # isolated execution: each agent turn runs in its own
+            # resource-limited subprocess talking back to OUR OpenAI
+            # surface (the reference's hydra-container model)
+            from helix_tpu.services.sandbox_executor import SandboxExecutor
+
+            executor = SandboxExecutor(
+                api_base=sandbox_agents_url, make_emitter=make_emitter
+            )
+        else:
+            executor = AgentExecutor(
                 _ProviderLLM(self.providers), make_emitter=make_emitter
-            ),
+            )
+        self.orchestrator = SpecTaskOrchestrator(
+            self.task_store, self.git, executor
         ).start()
 
         # event bus (embedded-NATS equivalent) + filestore + triggers
@@ -1178,6 +1188,13 @@ class ControlPlane:
         except Exception:
             return _err(400, "invalid JSON body")
         model = body.get("model", "")
+        if not model:
+            # default-model resolution for callers that don't care (the
+            # sandbox agents' children, quick curls): first served model
+            available = self.router.available_models()
+            if available:
+                model = available[0]
+                raw = json.dumps({**body, "model": model}).encode()
         runner = self.router.pick_runner(model)
         if runner is None:
             return _err(

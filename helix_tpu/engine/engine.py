@@ -564,12 +564,14 @@ class Engine:
         tokens = jnp.zeros((1, C), jnp.int32)
         full = jnp.zeros((1, self.cache_cfg.max_pages_per_seq), jnp.int32)
         hist = 0   # 0 = the first-chunk (no-history) shape
-        while hist < self.max_context_len:
+        while True:
             self.cache, _ = fn(
                 self.params, self.cache, tokens, jnp.int32(hist),
                 jnp.int32(C), jnp.zeros((1, hist // ps), jnp.int32), full,
                 sampling, key,
             )
+            if hist >= self.max_context_len:  # covered the largest bucket
+                break
             hist = C if hist == 0 else hist * 2
 
     def step(self) -> list[tuple[Request, int]]:

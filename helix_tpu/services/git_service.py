@@ -134,6 +134,16 @@ class GitService:
             )
         return entries
 
+    def branch_exists(self, name: str, branch: str) -> bool:
+        try:
+            _run(
+                ["git", "-C", self._repo_path(name), "rev-parse", "--verify",
+                 "--quiet", f"refs/heads/{branch}"],
+            )
+            return True
+        except GitError:
+            return False
+
     def diff(self, name: str, base: str, head: str) -> str:
         out = _run(
             ["git", "-C", self._repo_path(name), "diff",

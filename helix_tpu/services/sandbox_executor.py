@@ -1,0 +1,180 @@
+"""Isolated agent execution: each task turn runs in its own OS process.
+
+Fills the ``Executor`` seam (``spec_tasks.py``) the way the reference's
+``HydraExecutor`` fills its executor interface (``api/pkg/external-agent/
+hydra_executor.go:130-569``: container per session, image by agent type,
+idle/GC reaping) — scaled to this build's single-host runtime: a child
+process per agent turn with
+
+- its own session (``setsid``) so the whole process tree dies together,
+- RLIMIT_AS / RLIMIT_CPU / RLIMIT_NOFILE resource limits,
+- a scrubbed environment (no parent secrets; only the control-plane API
+  endpoint + key the agent is supposed to use),
+- cwd = the task's git workspace (its only filesystem scope of interest),
+- a wall-clock budget enforced by the parent (kill the process group).
+
+stdout lines stream into the watchable desktop session live (the
+reference's "user watches the agent's desktop" loop, SURVEY.md §3.4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+from typing import Optional
+
+from helix_tpu.services.spec_tasks import (
+    Executor,
+    SpecTask,
+    build_agent_message,
+    build_agent_prompt,
+)
+
+
+class SandboxError(RuntimeError):
+    pass
+
+
+class SandboxExecutor(Executor):
+    def __init__(
+        self,
+        api_base: str,
+        api_key: str = "",
+        model: str = "",
+        max_iterations: int = 12,
+        make_emitter=None,
+        time_limit: float = 900.0,
+        cpu_limit_s: int = 600,
+        memory_limit_bytes: int = 2 << 30,
+        allow_shell: bool = True,
+    ):
+        self.api_base = api_base
+        self.api_key = api_key
+        self.model = model
+        self.max_iterations = max_iterations
+        self.make_emitter = make_emitter
+        self.time_limit = time_limit
+        self.cpu_limit_s = cpu_limit_s
+        self.memory_limit_bytes = memory_limit_bytes
+        self.allow_shell = allow_shell
+
+    # ------------------------------------------------------------------
+    def _limits(self):
+        import resource
+
+        mem = self.memory_limit_bytes
+        cpu = self.cpu_limit_s
+
+        def apply():
+            os.setsid()   # own process group: parent kills the whole tree
+            resource.setrlimit(resource.RLIMIT_CPU, (cpu, cpu))
+            resource.setrlimit(resource.RLIMIT_NOFILE, (512, 512))
+            try:
+                resource.setrlimit(resource.RLIMIT_AS, (mem, mem))
+            except (ValueError, OSError):  # pragma: no cover - platform
+                pass
+
+        return apply
+
+    def _env(self, workspace: str) -> dict:
+        """Scrubbed environment: the agent gets the API endpoint it is
+        meant to use and nothing else from the parent."""
+        helix_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        return {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": workspace,
+            "LANG": os.environ.get("LANG", "C.UTF-8"),
+            "PYTHONPATH": helix_root,
+            "JAX_PLATFORMS": "cpu",   # a sandbox child never touches chips
+            "HELIX_API_BASE": self.api_base,
+            "HELIX_API_KEY": self.api_key,
+        }
+
+    # ------------------------------------------------------------------
+    def run(self, task: SpecTask, workspace: str, mode: str,
+            feedback: str = "") -> str:
+        prompt = build_agent_prompt(task, mode)
+        message = build_agent_message(task, feedback)
+        job = {
+            "prompt": prompt,
+            "message": message,
+            "model": self.model,
+            "max_iterations": self.max_iterations,
+            "shell": self.allow_shell,
+        }
+        emit, close = (lambda s: None), (lambda: None)
+        if self.make_emitter is not None:
+            emit, close = self.make_emitter(task, mode)
+
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "helix_tpu.services.sandbox_runner"],
+            cwd=workspace,
+            env=self._env(workspace),
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            preexec_fn=self._limits(),
+        )
+        result: dict = {}
+        error: dict = {}
+
+        def kill_tree():
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+        timer = threading.Timer(self.time_limit, kill_tree)
+        timer.daemon = True
+        timer.start()
+        try:
+            proc.stdin.write(json.dumps(job))
+            proc.stdin.close()
+            for line in proc.stdout:
+                line = line.rstrip("\n")
+                if line.startswith("STEP "):
+                    try:
+                        doc = json.loads(line[5:])
+                    except json.JSONDecodeError:
+                        continue
+                    emit(_StepView(doc))
+                elif line.startswith("RESULT "):
+                    result = json.loads(line[7:])
+                elif line.startswith("ERROR "):
+                    error = json.loads(line[6:])
+                elif line:
+                    # raw agent/tool output: mirror it into the session
+                    emit(_StepView({"kind": "tool", "name": "stdout",
+                                    "arguments": None, "result": line}))
+            rc = proc.wait()
+        finally:
+            timer.cancel()
+            kill_tree()   # reap any stragglers in the group
+            close()
+        if error:
+            raise SandboxError(error.get("error", "agent failed"))
+        if rc != 0 and not result:
+            raise SandboxError(
+                f"sandbox exited rc={rc} (killed after {self.time_limit}s?)"
+            )
+        return result.get("answer", "")
+
+
+class _StepView:
+    """Duck-typed StepInfo for emitters fed from the child's wire format."""
+
+    def __init__(self, doc: dict):
+        self.step = doc.get("step", 0)
+        self.kind = doc.get("kind", "tool")
+        self.name = doc.get("name", "")
+        self.arguments = doc.get("arguments")
+        self.result = doc.get("result", "") or ""
+        self.error = doc.get("error", "") or ""
+        self.duration_ms = doc.get("duration_ms", 0)

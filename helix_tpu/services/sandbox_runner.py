@@ -1,0 +1,131 @@
+"""Sandbox child process: runs one agent turn inside an isolated workspace.
+
+The in-sandbox half of the isolated executor (reference: the agent binary
+running inside a hydra dev container, ``api/pkg/external-agent/
+hydra_executor.go:130-569``).  The parent (``SandboxExecutor``) launches
+this module with resource limits applied, a scrubbed environment, and the
+workspace as cwd; the only egress is the control plane's OpenAI endpoint
+(HELIX_API_BASE) — exactly how the reference's containerised agents talk
+back to Helix.
+
+Protocol (stdout, line-oriented, mirrored into the watchable desktop
+stream by the parent):
+
+    STEP {json StepInfo}        one per agent step
+    RESULT {"answer": ...}      terminal line on success
+    ERROR {"error": ...}        terminal line on failure
+
+The job spec arrives as one JSON document on stdin.  This module imports
+only the jax-free agent core — a sandbox child never touches the
+accelerator.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+
+class HTTPLLM:
+    """Minimal OpenAI-compatible chat client (the sandbox's only egress)."""
+
+    def __init__(self, base_url: str, api_key: str = ""):
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+
+    async def chat(self, body: dict) -> dict:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.base_url}/v1/chat/completions",
+            data=json.dumps(body).encode(),
+            headers={
+                "Content-Type": "application/json",
+                **(
+                    {"Authorization": f"Bearer {self.api_key}"}
+                    if self.api_key
+                    else {}
+                ),
+            },
+        )
+
+        def call():
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                return json.loads(resp.read())
+
+        return await asyncio.get_running_loop().run_in_executor(None, call)
+
+
+def shell_skill(root: str, timeout: float = 120.0):
+    """Run shell commands inside the workspace.  Only offered in the
+    sandbox child — the process is already resource-limited and isolated,
+    which is the reference's model (agents get a full shell *inside* the
+    container, never in the control plane)."""
+    import subprocess
+
+    from helix_tpu.agent.skill import Skill
+
+    def run(command: str) -> str:
+        p = subprocess.run(
+            command, shell=True, cwd=root, capture_output=True, text=True,
+            timeout=timeout,
+        )
+        out = (p.stdout or "") + (p.stderr or "")
+        return f"exit={p.returncode}\n{out[:8000]}"
+
+    return Skill(
+        name="shell",
+        description="Run a shell command in the workspace; returns exit "
+                    "code and output.",
+        parameters={
+            "type": "object",
+            "properties": {"command": {"type": "string"}},
+            "required": ["command"],
+        },
+        handler=run,
+        dangerous=True,
+    )
+
+
+def main() -> int:
+    job = json.loads(sys.stdin.read())
+
+    from helix_tpu.agent.agent import Agent, AgentConfig
+    from helix_tpu.agent.skill import SkillRegistry
+    from helix_tpu.agent.skills import filesystem_skill
+
+    workspace = os.getcwd()
+    skills = [filesystem_skill(workspace)]
+    if job.get("shell", True):
+        skills.append(shell_skill(workspace))
+
+    def emit(step):
+        print("STEP " + json.dumps(step.to_dict()), flush=True)
+
+    agent = Agent(
+        AgentConfig(
+            prompt=job["prompt"],
+            model=job.get("model", ""),
+            max_iterations=int(job.get("max_iterations", 12)),
+        ),
+        SkillRegistry(skills),
+        HTTPLLM(
+            os.environ.get("HELIX_API_BASE", job.get("api_base", "")),
+            os.environ.get("HELIX_API_KEY", job.get("api_key", "")),
+        ),
+        emitter=emit,
+    )
+    try:
+        answer, _steps = asyncio.run(agent.run(job["message"]))
+    except Exception as e:  # noqa: BLE001 — reported over the protocol
+        print("ERROR " + json.dumps({"error": f"{type(e).__name__}: {e}"}),
+              flush=True)
+        return 1
+    print("RESULT " + json.dumps({"answer": answer}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

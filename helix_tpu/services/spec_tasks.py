@@ -41,6 +41,7 @@ CREATE TABLE IF NOT EXISTS spec_tasks (
     spec_path TEXT DEFAULT '',
     pr_id TEXT DEFAULT '',
     error TEXT DEFAULT '',
+    ci_attempts INTEGER DEFAULT 0,
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL
 );
@@ -61,10 +62,19 @@ CREATE TABLE IF NOT EXISTS pull_requests (
     head TEXT NOT NULL,
     status TEXT NOT NULL DEFAULT 'open',   -- open | merged | closed
     merge_sha TEXT DEFAULT '',
+    ci_status TEXT DEFAULT 'pending',  -- pending|running|passed|failed|none
+    ci_log TEXT DEFAULT '',
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL
 );
 """
+
+# columns added after round 1 — bring pre-existing DBs forward
+_MIGRATIONS = (
+    "ALTER TABLE pull_requests ADD COLUMN ci_status TEXT DEFAULT 'pending'",
+    "ALTER TABLE pull_requests ADD COLUMN ci_log TEXT DEFAULT ''",
+    "ALTER TABLE spec_tasks ADD COLUMN ci_attempts INTEGER DEFAULT 0",
+)
 
 STATUSES = (
     "backlog", "planning", "spec_review", "spec_revision",
@@ -85,6 +95,7 @@ class SpecTask:
     spec_path: str = ""
     pr_id: str = ""
     error: str = ""
+    ci_attempts: int = 0
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -96,6 +107,11 @@ class TaskStore:
         self._lock = threading.Lock()
         with self._lock:
             self._conn.executescript(_SCHEMA)
+            for mig in _MIGRATIONS:
+                try:
+                    self._conn.execute(mig)
+                except sqlite3.OperationalError:
+                    pass  # column already exists
             self._conn.commit()
 
     # -- tasks ---------------------------------------------------------------
@@ -118,12 +134,12 @@ class TaskStore:
         return SpecTask(
             id=r[0], project=r[1], title=r[2], description=r[3], status=r[4],
             spec_branch=r[5], task_branch=r[6], spec_path=r[7], pr_id=r[8],
-            error=r[9],
+            error=r[9], ci_attempts=r[10] or 0,
         )
 
     _COLS = (
         "id, project, title, description, status, spec_branch, task_branch, "
-        "spec_path, pr_id, error"
+        "spec_path, pr_id, error, ci_attempts"
     )
 
     def get_task(self, tid: str) -> Optional[SpecTask]:
@@ -154,11 +170,11 @@ class TaskStore:
         with self._lock:
             self._conn.execute(
                 "UPDATE spec_tasks SET status=?, spec_branch=?, "
-                "task_branch=?, spec_path=?, pr_id=?, error=?, updated_at=? "
-                "WHERE id=?",
+                "task_branch=?, spec_path=?, pr_id=?, error=?, "
+                "ci_attempts=?, updated_at=? WHERE id=?",
                 (
                     t.status, t.spec_branch, t.task_branch, t.spec_path,
-                    t.pr_id, t.error, time.time(), t.id,
+                    t.pr_id, t.error, t.ci_attempts, time.time(), t.id,
                 ),
             )
             self._conn.commit()
@@ -204,24 +220,30 @@ class TaskStore:
             self._conn.commit()
         return pid
 
-    def get_pr(self, pid: str) -> Optional[dict]:
-        with self._lock:
-            r = self._conn.execute(
-                "SELECT id, project, task_id, title, base, head, status, "
-                "merge_sha FROM pull_requests WHERE id=?",
-                (pid,),
-            ).fetchone()
-        if not r:
-            return None
+    _PR_COLS = (
+        "id, project, task_id, title, base, head, status, merge_sha, "
+        "ci_status, ci_log"
+    )
+
+    @staticmethod
+    def _row_to_pr(r) -> dict:
         return {
             "id": r[0], "project": r[1], "task_id": r[2], "title": r[3],
             "base": r[4], "head": r[5], "status": r[6], "merge_sha": r[7],
+            "ci_status": r[8], "ci_log": r[9],
         }
+
+    def get_pr(self, pid: str) -> Optional[dict]:
+        with self._lock:
+            r = self._conn.execute(
+                f"SELECT {self._PR_COLS} FROM pull_requests WHERE id=?",
+                (pid,),
+            ).fetchone()
+        return self._row_to_pr(r) if r else None
 
     def list_prs(self, project: Optional[str] = None,
                  status: Optional[str] = None) -> list:
-        q = ("SELECT id, project, task_id, title, base, head, status, "
-             "merge_sha FROM pull_requests")
+        q = f"SELECT {self._PR_COLS} FROM pull_requests"
         conds, args = [], []
         if project:
             conds.append("project=?")
@@ -233,11 +255,7 @@ class TaskStore:
             q += " WHERE " + " AND ".join(conds)
         with self._lock:
             rows = self._conn.execute(q, tuple(args)).fetchall()
-        return [
-            {"id": r[0], "project": r[1], "task_id": r[2], "title": r[3],
-             "base": r[4], "head": r[5], "status": r[6], "merge_sha": r[7]}
-            for r in rows
-        ]
+        return [self._row_to_pr(r) for r in rows]
 
     def update_pr(self, pid: str, status: str, merge_sha: str = "") -> None:
         with self._lock:
@@ -247,6 +265,102 @@ class TaskStore:
                 (status, merge_sha, time.time(), pid),
             )
             self._conn.commit()
+
+    def set_pr_ci(self, pid: str, ci_status: str, ci_log: str = "") -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE pull_requests SET ci_status=?, ci_log=?, "
+                "updated_at=? WHERE id=?",
+                (ci_status, ci_log[:20000], time.time(), pid),
+            )
+            self._conn.commit()
+
+
+class CIRunner:
+    """CI seam (reference: ``spec_task_orchestrator_ci.go`` +
+    ``spec_task_orchestrator.go:1074-1201`` PR/CI polling).
+
+    ``run(project, workspace)`` checks out is already done by the caller;
+    returns (passed, log) where passed is True/False, or None when the
+    project defines no CI."""
+
+    def run(self, project: str, workspace: str):  # pragma: no cover
+        raise NotImplementedError
+
+
+class LocalCIRunner(CIRunner):
+    """Runs the project's ``.helix-ci.sh`` (if present) in an isolated
+    subprocess — the internal-CI analogue of the reference's external CI
+    status polling."""
+
+    def __init__(self, timeout: float = 600.0):
+        self.timeout = timeout
+
+    def run(self, project: str, workspace: str):
+        import signal
+        import subprocess
+
+        script = os.path.join(workspace, ".helix-ci.sh")
+        if not os.path.exists(script):
+            return None, ""
+        p = subprocess.Popen(
+            ["sh", ".helix-ci.sh"], cwd=workspace,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            start_new_session=True,
+        )
+        try:
+            log, _ = p.communicate(timeout=self.timeout)
+        except subprocess.TimeoutExpired:
+            # kill the whole session, not just the sh leader — a hung
+            # pytest child must not outlive its workspace
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            p.wait()
+            return False, f"CI timed out after {self.timeout}s"
+        return p.returncode == 0, log or ""
+
+
+class ExternalGitSync:
+    """Seam for mirroring internal PRs to an external host (GitHub/GitLab/
+    ADO — reference: ``git_repository_service*.go`` pull/push sync + PR
+    list cache).  The default no-op keeps everything internal; a concrete
+    sync pushes the branch, opens the external PR, and reports its state
+    back through ``poll``."""
+
+    def push_pr(self, project: str, pr: dict) -> None:  # pragma: no cover
+        pass
+
+    def poll(self, project: str, pr: dict) -> Optional[dict]:
+        """Return {'status': 'open|merged|closed', 'ci_status': ...} from
+        the external host, or None when the PR is internal-only."""
+        return None
+
+
+PLAN_PROMPT = (
+    "You are a software planning agent. Write a concise implementation "
+    "spec for the task into the file specs/{task_id}.md using the "
+    "filesystem tool, then answer with a one-line summary."
+)
+IMPL_PROMPT = (
+    "You are a software implementation agent. Read the spec at "
+    "{spec_path} and implement it by writing files in the workspace "
+    "with the filesystem tool, then answer with a one-line summary."
+)
+
+
+def build_agent_prompt(task: "SpecTask", mode: str) -> str:
+    return (PLAN_PROMPT if mode == "plan" else IMPL_PROMPT).format(
+        task_id=task.id, spec_path=task.spec_path or "specs/"
+    )
+
+
+def build_agent_message(task: "SpecTask", feedback: str = "") -> str:
+    message = f"Task: {task.title}\n\n{task.description}"
+    if feedback:
+        message += f"\n\nReview feedback to address:\n{feedback}"
+    return message
 
 
 class Executor:
@@ -263,17 +377,6 @@ class Executor:
 class AgentExecutor(Executor):
     """Default executor: the in-process agent loop with filesystem access to
     the workspace (the TPU build's stand-in for a desktop container agent)."""
-
-    PLAN_PROMPT = (
-        "You are a software planning agent. Write a concise implementation "
-        "spec for the task into the file specs/{task_id}.md using the "
-        "filesystem tool, then answer with a one-line summary."
-    )
-    IMPL_PROMPT = (
-        "You are a software implementation agent. Read the spec at "
-        "{spec_path} and implement it by writing files in the workspace "
-        "with the filesystem tool, then answer with a one-line summary."
-    )
 
     def __init__(self, llm, model: str = "", max_iterations: int = 12,
                  make_emitter=None):
@@ -292,9 +395,7 @@ class AgentExecutor(Executor):
         from helix_tpu.agent.skill import SkillRegistry
         from helix_tpu.agent.skills import filesystem_skill
 
-        prompt = (
-            self.PLAN_PROMPT if mode == "plan" else self.IMPL_PROMPT
-        ).format(task_id=task.id, spec_path=task.spec_path or "specs/")
+        prompt = build_agent_prompt(task, mode)
         emit, close = (lambda s: None), (lambda: None)
         if self.make_emitter is not None:
             emit, close = self.make_emitter(task, mode)
@@ -307,9 +408,7 @@ class AgentExecutor(Executor):
             self.llm,
             emitter=emit,
         )
-        message = f"Task: {task.title}\n\n{task.description}"
-        if feedback:
-            message += f"\n\nReview feedback to address:\n{feedback}"
+        message = build_agent_message(task, feedback)
         try:
             answer, steps = asyncio.run(agent.run(message))
         finally:
@@ -327,10 +426,16 @@ class SpecTaskOrchestrator:
         executor: Executor,
         poll_interval: float = 2.0,
         workspace_root: Optional[str] = None,
+        ci: Optional[CIRunner] = None,
+        external_git: Optional[ExternalGitSync] = None,
+        max_ci_attempts: int = 2,
     ):
         self.store = store
         self.git = git
         self.executor = executor
+        self.ci = ci if ci is not None else LocalCIRunner()
+        self.external_git = external_git or ExternalGitSync()
+        self.max_ci_attempts = max_ci_attempts
         self.poll_interval = poll_interval
         self.workspace_root = workspace_root or tempfile.mkdtemp(
             prefix="helix-workspaces-"
@@ -381,6 +486,9 @@ class SpecTaskOrchestrator:
         for task in self.store.list_tasks(status="implementation_queued"):
             self._handle_implementation(task)
             n += 1
+        for task in self.store.list_tasks(status="pr_review"):
+            if self._handle_pr_review(task):
+                n += 1
         return n
 
     def _fail(self, task: SpecTask, err: str):
@@ -448,7 +556,15 @@ class SpecTaskOrchestrator:
         ws = os.path.join(self.workspace_root, f"{task.id}-impl")
         shutil.rmtree(ws, ignore_errors=True)
         try:
-            self.git.clone_workspace(task.project, ws)
+            # CI-fix retries continue on the task branch (incremental),
+            # first attempts start from the default branch
+            retry_branch = (
+                task.task_branch
+                if task.ci_attempts > 0
+                and self.git.branch_exists(task.project, task.task_branch)
+                else None
+            )
+            self.git.clone_workspace(task.project, ws, branch=retry_branch)
             # bring the spec into the working tree
             spec = self.git.file_at(
                 task.project, task.spec_branch, task.spec_path
@@ -460,21 +576,105 @@ class SpecTaskOrchestrator:
                 )
                 with open(os.path.join(ws, task.spec_path), "w") as f:
                     f.write(spec)
-            self.executor.run(task, ws, "implement")
+            # red-CI feedback from earlier attempts rides into the agent
+            # (the reference's CINotifier ci_passed/failed messages)
+            feedback = "\n".join(
+                r["comment"]
+                for r in self.store.reviews(task.id)
+                if r["decision"] == "ci_failed"
+            )
+            self.executor.run(task, ws, "implement", feedback=feedback)
             sha = self.git.commit_and_push(
                 ws, f"{task.title} ({task.id})", task.task_branch
             )
-            if sha is None:
+            if sha is None and not feedback:
                 raise RuntimeError("implementation agent changed nothing")
             task.pr_id = self.store.create_pr(
                 task.project, task.id, task.title, "main", task.task_branch
             )
             task.status = "pr_review"
             self.store.update_task(task)
+            self.external_git.push_pr(
+                task.project, self.store.get_pr(task.pr_id)
+            )
         except Exception as e:  # noqa: BLE001
             self._fail(task, f"implementation failed: {e}")
         finally:
             shutil.rmtree(ws, ignore_errors=True)
+
+    def _handle_pr_review(self, task: SpecTask) -> bool:
+        """PR/CI completion loop (``spec_task_orchestrator.go:1074-1201``):
+        run CI on pending PRs; feed failures back into a bounded
+        re-implementation loop; reflect external PR state when a sync is
+        configured.  Returns True when something progressed."""
+        pr = self.store.get_pr(task.pr_id) if task.pr_id else None
+        if pr is None or pr["status"] != "open":
+            return False
+        # external PR state (no-op for internal-only PRs)
+        ext = self.external_git.poll(task.project, pr)
+        if ext:
+            if ext.get("status") == "merged":
+                self.store.update_pr(pr["id"], "merged",
+                                     ext.get("merge_sha", ""))
+                task.status = "done"
+                self.store.update_task(task)
+                return True
+            if ext.get("ci_status") == "passed":
+                if pr["ci_status"] != "passed":
+                    self.store.set_pr_ci(pr["id"], "passed",
+                                         ext.get("ci_log", ""))
+                    return True
+                return False
+            if ext.get("ci_status") == "failed":
+                if pr["ci_status"] != "failed":
+                    self.store.set_pr_ci(pr["id"], "failed",
+                                         ext.get("ci_log", ""))
+                    self._ci_failed(task, pr, ext.get("ci_log", ""))
+                    return True
+                return False
+        if pr["ci_status"] != "pending":
+            return False
+        self.store.set_pr_ci(pr["id"], "running")
+        ws = os.path.join(self.workspace_root, f"{task.id}-ci")
+        shutil.rmtree(ws, ignore_errors=True)
+        try:
+            self.git.clone_workspace(task.project, ws, branch=pr["head"])
+            passed, log = self.ci.run(task.project, ws)
+        except Exception as e:  # noqa: BLE001 — CI infra failure != red CI
+            self.store.set_pr_ci(task.pr_id, "pending")
+            task.error = f"ci infra error: {e}"[:2000]
+            self.store.update_task(task)
+            return False
+        finally:
+            shutil.rmtree(ws, ignore_errors=True)
+        if passed is None:
+            self.store.set_pr_ci(pr["id"], "none")
+            return True
+        if passed:
+            self.store.set_pr_ci(pr["id"], "passed", log)
+            return True
+        self.store.set_pr_ci(pr["id"], "failed", log)
+        self._ci_failed(task, pr, log)
+        return True
+
+    def _ci_failed(self, task: SpecTask, pr: dict, log: str) -> None:
+        """CINotifier-equivalent: feed the red CI back into the agent loop,
+        bounded by max_ci_attempts (``spec_task_orchestrator.go:34-40``)."""
+        if task.ci_attempts < self.max_ci_attempts:
+            task.ci_attempts += 1
+            self.store.add_review(
+                task.id, "ci", f"CI failed:\n{log[-4000:]}", "ci_failed"
+            )
+            self.store.update_pr(pr["id"], "closed")
+            task.pr_id = ""
+            task.status = "implementation_queued"
+            self.store.update_task(task)
+        else:
+            self._fail(
+                task,
+                f"CI failed after {task.ci_attempts} fix attempts:\n"
+                f"{log[-2000:]}",
+            )
 
     def merge_pr(self, pr_id: str) -> dict:
         """Approve + merge the task PR; task -> done (``handleDone``)."""
