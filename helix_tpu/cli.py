@@ -105,6 +105,15 @@ def _cmd_serve(args) -> int:
         if args.host in ("0.0.0.0", "127.0.0.1", "localhost", "::")
         else args.host
     )
+    compute_cfg = None
+    if getattr(args, "compute_floor", 0) or getattr(args, "compute_max", 0):
+        from helix_tpu.control.compute import ManagerConfig
+
+        compute_cfg = ManagerConfig(
+            floor=args.compute_floor,
+            max=args.compute_max,
+            idle_timeout=args.compute_idle_timeout,
+        )
     cp = ControlPlane(
         db_path=args.db,
         sandbox_agents_url=(
@@ -112,6 +121,7 @@ def _cmd_serve(args) -> int:
             if getattr(args, "sandbox_agents", False)
             else None
         ),
+        compute_cfg=compute_cfg,
     )
     print(f"helix-tpu control plane listening on {args.host}:{args.port}")
     web.run_app(cp.build_app(), host=args.host, port=args.port, print=None)
@@ -285,6 +295,15 @@ def main(argv=None) -> int:
         help="run spec-task agents in isolated resource-limited "
              "subprocesses instead of in-process",
     )
+    s.add_argument(
+        "--compute-floor", type=int, default=0,
+        help="autoscaler: minimum provisioned hosts (stub provider "
+             "unless one is wired programmatically)",
+    )
+    s.add_argument("--compute-max", type=int, default=0,
+                   help="autoscaler: hard host ceiling (0 = floor only)")
+    s.add_argument("--compute-idle-timeout", type=float, default=600.0,
+                   help="autoscaler: idle seconds before shedding a host")
     s.set_defaults(fn=_cmd_serve)
 
     pr = sub.add_parser("profile", help="validate a profile YAML")

@@ -125,19 +125,34 @@ class Authenticator:
         with self._lock:
             cur = self._conn.execute(
                 "INSERT INTO users(id, email, name, admin, created_at) "
-                "SELECT ?,?,?,?,? WHERE NOT EXISTS (SELECT 1 FROM users)",
-                (uid, email, name, int(admin), time.time()),
+                "SELECT ?,?,?,?,? WHERE NOT EXISTS "
+                "(SELECT 1 FROM users WHERE email NOT LIKE ?)",
+                (uid, email, name, int(admin), time.time(),
+                 f"%{self.SERVICE_DOMAIN}"),
             )
             self._conn.commit()
             if cur.rowcount == 0:
                 return None
         return User(id=uid, email=email, name=name, admin=admin)
 
+    SERVICE_DOMAIN = "@helix.internal"
+
     def count_users(self) -> int:
+        """Human users only: internal service accounts (minted at boot for
+        e.g. sandbox agents) must not consume the first-user bootstrap."""
         with self._lock:
             return self._conn.execute(
-                "SELECT COUNT(*) FROM users"
+                "SELECT COUNT(*) FROM users WHERE email NOT LIKE ?",
+                (f"%{self.SERVICE_DOMAIN}",),
             ).fetchone()[0]
+
+    def create_service_key(self, name: str) -> str:
+        """Idempotent service account + API key (non-admin)."""
+        email = f"{name}{self.SERVICE_DOMAIN}"
+        u = self.get_user(email)
+        if u is None:
+            u = self.create_user(email=email, name=name)
+        return self.create_api_key(u.id, name=name)
 
     # -- users -------------------------------------------------------------
     def create_user(self, email: str, name: str = "", admin: bool = False) -> User:

@@ -1,0 +1,418 @@
+"""Cloud pool autoscaler: bring runner hosts into and out of existence.
+
+The counterpart of the reference's compute manager
+(``api/pkg/sandbox/compute/manager.go:39-150`` + ``provider.go``): one
+Manager per deployment owns one Provider and reconciles the cloud's view
+against the instance rows on a timer.  Decision arms (names kept from the
+reference's design docs):
+
+- **Floor**: keep (healthy ready + provisioning) >= floor at all times.
+- **D3 burst**: when free sandbox slots across ready+online hosts drop
+  below ``headroom_min`` and owned < max, provision another host.
+  Capacity already in flight (provisioning rows) counts toward headroom
+  so one burst doesn't double-provision (``manager.go:731-748``).
+- **D4 idle deprovision**: a ready host continuously idle >=
+  ``idle_timeout`` is shed (one per cycle) down toward floor — inhibited
+  while any other host sits at its session cap (anti-oscillation,
+  ``manager.go:…fleetAtCap``), with ``hard_idle_timeout`` overriding the
+  inhibition, and hosts holding a runner-profile assignment protected
+  (they may be serving inference with zero sandboxes).
+- **Stuck-provision rollback**: rows provisioning longer than
+  ``max_provisioning_age`` are rolled back so they stop holding floor
+  slots (``manager.go:986``).
+
+TPU nuance: ``can_host_sandbox=False`` marks accelerator-only hosts
+(e.g. a v5e pod slice serving inference with no desktop plane) — they
+count for floor but never for sandbox capacity/demand, mirroring the
+reference's neuron-host exclusion.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+
+@dataclasses.dataclass
+class Spec:
+    """What to ask the provider for (image tag, slots, labels)."""
+
+    image: str = "helix-tpu-node:latest"
+    max_sandboxes: int = 4
+    accelerator: str = "v5e-1"
+    can_host_sandbox: bool = True
+    labels: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class Instance:
+    id: str
+    provider: str = ""
+    provider_id: str = ""
+    status: str = "offline"            # heartbeat view: ready | offline
+    compute_state: str = "provisioning"  # provisioning|ready|failed|gone
+    active_sandboxes: int = 0
+    max_sandboxes: int = 4
+    can_host_sandbox: bool = True
+    created_at: float = 0.0
+    provision_started: float = 0.0
+    ready_at: float = 0.0        # when the provider reported ready
+    heartbeat_at: float = 0.0    # last node heartbeat (0 = never)
+    runner_id: str = ""          # the runner id this host registered as
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+class InstanceStore:
+    """In-memory instance rows (the reference's narrow SandboxStore slice,
+    ``manager.go:21-38``)."""
+
+    def __init__(self):
+        self._rows: dict[str, Instance] = {}
+        self._lock = threading.Lock()
+
+    def list(self) -> list[Instance]:
+        with self._lock:
+            return list(self._rows.values())
+
+    def get(self, iid: str) -> Optional[Instance]:
+        return self._rows.get(iid)
+
+    def register(self, inst: Instance) -> None:
+        with self._lock:
+            self._rows[inst.id] = inst
+
+    def deregister(self, iid: str) -> None:
+        with self._lock:
+            self._rows.pop(iid, None)
+
+
+class Provider:
+    """One upstream compute system (``provider.go:39``)."""
+
+    def name(self) -> str:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def provision(self, spec: Spec) -> str:
+        """Fire-and-forget: returns the upstream's opaque id."""
+        raise NotImplementedError  # pragma: no cover
+
+    def health_check(self, provider_id: str) -> str:
+        """-> 'provisioning' | 'ready' | 'failed' | 'gone'."""
+        raise NotImplementedError  # pragma: no cover
+
+    def deprovision(self, provider_id: str) -> None:
+        raise NotImplementedError  # pragma: no cover
+
+
+class StubProvider(Provider):
+    """Fake upstream for tests and dry runs (``compute/stub.go``): hosts
+    become ready after ``boot_cycles`` health checks; individual ids can
+    be forced to fail or hang."""
+
+    def __init__(self, boot_cycles: int = 1):
+        self.boot_cycles = boot_cycles
+        self.provisioned: list[str] = []
+        self.deprovisioned: list[str] = []
+        self.hung: set[str] = set()      # never leave 'provisioning'
+        self.fail_next_deprovision = 0
+        self._checks: dict[str, int] = {}
+
+    def name(self) -> str:
+        return "stub"
+
+    def provision(self, spec: Spec) -> str:
+        pid = f"stub-{uuid.uuid4().hex[:8]}"
+        self.provisioned.append(pid)
+        self._checks[pid] = 0
+        return pid
+
+    def health_check(self, provider_id: str) -> str:
+        if provider_id in self.hung:
+            return "provisioning"
+        if provider_id not in self._checks:
+            return "gone"
+        self._checks[provider_id] += 1
+        return (
+            "ready"
+            if self._checks[provider_id] >= self.boot_cycles
+            else "provisioning"
+        )
+
+    def deprovision(self, provider_id: str) -> None:
+        if self.fail_next_deprovision > 0:
+            self.fail_next_deprovision -= 1
+            raise RuntimeError("stub deprovision failure")
+        self.deprovisioned.append(provider_id)
+        self._checks.pop(provider_id, None)
+
+
+@dataclasses.dataclass
+class ManagerConfig:
+    floor: int = 0
+    max: int = 0                    # 0 disables D3 burst
+    headroom_min: int = 1
+    reconcile_interval: float = 30.0
+    max_concurrent_provisions: int = 1
+    max_provisioning_age: float = 1800.0
+    idle_timeout: float = 600.0     # 0 disables D4
+    hard_idle_timeout: float = 14400.0  # 0 disables the inhibition override
+    heartbeat_stale_after: float = 90.0  # ready host w/o heartbeat = offline
+    spec: Spec = dataclasses.field(default_factory=Spec)
+
+    def validate(self) -> None:
+        if self.floor < 0:
+            raise ValueError("floor must be >= 0")
+        if self.max and self.max < self.floor:
+            raise ValueError("max must be >= floor when set")
+
+
+class ComputeManager:
+    def __init__(
+        self,
+        cfg: ManagerConfig,
+        provider: Provider,
+        store: Optional[InstanceStore] = None,
+        assigned_runner_ids: Callable[[], set] = lambda: set(),
+        now: Callable[[], float] = time.monotonic,
+    ):
+        cfg.validate()
+        self.cfg = cfg
+        self.provider = provider
+        self.store = store or InstanceStore()
+        self.assigned_runner_ids = assigned_runner_ids
+        self.now = now
+        self._idle_since: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ComputeManager":
+        self._thread = threading.Thread(
+            target=self._loop, name="helix-compute", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.reconcile()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                import traceback
+
+                traceback.print_exc()
+            self._stop.wait(self.cfg.reconcile_interval)
+
+    # -- helpers ------------------------------------------------------------
+    @staticmethod
+    def _ready_state(r: Instance) -> bool:
+        return r.compute_state == "ready"
+
+    @staticmethod
+    def _ready_online(r: Instance) -> bool:
+        return r.compute_state == "ready" and r.status == "ready"
+
+    def _alive_for_floor(self, r: Instance) -> bool:
+        """Floor is a guarantee of HEALTHY capacity: provisioning rows
+        count (they're on the way), ready+offline rows do not."""
+        if r.compute_state == "provisioning":
+            return True
+        return self._ready_online(r)
+
+    def _available(self, r: Instance) -> bool:
+        """Counts toward the Max ceiling (don't double-provision while
+        D4 sheds an offline row)."""
+        return r.compute_state in ("provisioning", "ready")
+
+    # -- the reconcile cycle ------------------------------------------------
+    def heartbeat(self, instance_id: str, runner_id: str = "",
+                  active_sandboxes: int = 0) -> None:
+        """Record a node heartbeat against its compute row (called from
+        the control plane's heartbeat handler)."""
+        inst = self.store.get(instance_id)
+        if inst is None:
+            return
+        inst.status = "ready"
+        inst.heartbeat_at = self.now()
+        inst.active_sandboxes = int(active_sandboxes)
+        if runner_id:
+            inst.runner_id = runner_id
+
+    def _mark_stale(self, rows: list[Instance]) -> None:
+        """Ready hosts whose heartbeat went silent flip to offline so the
+        floor guarantee sees real capacity, not ghosts.  A freshly-ready
+        host gets a grace window to send its first heartbeat."""
+        stale = self.cfg.heartbeat_stale_after
+        if stale <= 0:
+            return
+        now = self.now()
+        for r in rows:
+            if r.compute_state != "ready" or r.status != "ready":
+                continue
+            last = r.heartbeat_at or r.ready_at
+            grace = stale if r.heartbeat_at else stale * 2
+            if now - last > grace:
+                r.status = "offline"
+
+    def reconcile(self) -> None:
+        rows = self.store.list()
+        self._refresh_provisioning(rows)
+        rows = self.store.list()
+        self._mark_stale(rows)
+        need = self._compute_needed(rows)
+        for _ in range(min(need, self.cfg.max_concurrent_provisions)):
+            self._provision_one()
+        self._try_deprovision_idle(self.store.list())
+
+    def _refresh_provisioning(self, rows: list[Instance]) -> None:
+        for r in rows:
+            if r.compute_state != "provisioning":
+                continue
+            state = self.provider.health_check(r.provider_id)
+            if state == "ready":
+                r.compute_state = "ready"
+                r.status = "ready"   # provisional until heartbeats arrive
+                r.ready_at = self.now()
+            elif state in ("failed", "gone"):
+                self._rollback(r, f"provider reports {state}")
+            elif (
+                self.cfg.max_provisioning_age > 0
+                and self.now() - r.provision_started
+                > self.cfg.max_provisioning_age
+            ):
+                self._rollback(r, "stuck provisioning past max age")
+
+    def _rollback(self, r: Instance, reason: str) -> None:
+        try:
+            self.provider.deprovision(r.provider_id)
+        except Exception:  # noqa: BLE001 — upstream may already be gone
+            pass
+        self.store.deregister(r.id)
+
+    def _compute_needed(self, rows: list[Instance]) -> int:
+        available = sum(1 for r in rows if self._available(r))
+        alive_for_floor = sum(1 for r in rows if self._alive_for_floor(r))
+        floor_need = max(self.cfg.floor - alive_for_floor, 0)
+
+        demand_need = 0
+        if self.cfg.max > self.cfg.floor:
+            ready_online = [
+                r for r in rows
+                if self._ready_online(r) and r.can_host_sandbox
+            ]
+            # capacity already in flight counts, so one burst doesn't
+            # provision twice for the same demand
+            provisioning_capacity = sum(
+                r.max_sandboxes for r in rows
+                if r.compute_state == "provisioning"
+            )
+            if ready_online:   # D3 needs at least one host to measure
+                free = (
+                    sum(r.max_sandboxes for r in ready_online)
+                    - sum(r.active_sandboxes for r in ready_online)
+                    + provisioning_capacity
+                )
+                if free < self.cfg.headroom_min:
+                    demand_need = max(
+                        min(
+                            self.cfg.headroom_min - free,
+                            self.cfg.max_concurrent_provisions,
+                        ),
+                        1,
+                    )
+        need = floor_need + demand_need
+        if self.cfg.max > 0:
+            # hard ceiling on owned hosts — but never starve the floor
+            # guarantee when dead ready+offline orphans fill Max
+            # (``manager.go`` floor-not-starved regression)
+            need = min(need, max(self.cfg.max - available, 0))
+            need = max(need, floor_need)
+        return need
+
+    def _provision_one(self) -> None:
+        pid = self.provider.provision(self.cfg.spec)
+        now = self.now()
+        self.store.register(
+            Instance(
+                id=f"ci_{uuid.uuid4().hex[:12]}",
+                provider=self.provider.name(),
+                provider_id=pid,
+                status="offline",
+                compute_state="provisioning",
+                active_sandboxes=0,
+                max_sandboxes=self.cfg.spec.max_sandboxes,
+                can_host_sandbox=self.cfg.spec.can_host_sandbox,
+                created_at=now,
+                provision_started=now,
+            )
+        )
+
+    def _try_deprovision_idle(self, rows: list[Instance]) -> None:
+        if self.cfg.idle_timeout <= 0:
+            return
+        now = self.now()
+        ready = {r.id: r for r in rows if self._ready_state(r)}
+        # anti-oscillation inhibition: shedding while another host is at
+        # its cap would just re-fire D3 next cycle
+        fleet_at_cap = any(
+            self._ready_online(r)
+            and r.can_host_sandbox
+            and r.max_sandboxes > 0
+            and r.active_sandboxes >= r.max_sandboxes
+            for r in rows
+        )
+        # idle tracker: ComputeState-keyed (not heartbeat) so a flap to
+        # offline doesn't reset accumulated idle time
+        for r in ready.values():
+            if r.active_sandboxes == 0:
+                self._idle_since.setdefault(r.id, now)
+            else:
+                self._idle_since.pop(r.id, None)
+        for iid in list(self._idle_since):
+            if iid not in ready:
+                del self._idle_since[iid]
+
+        ready_count = len(ready)
+        if ready_count <= self.cfg.floor:
+            return
+        protected = self.assigned_runner_ids()
+
+        def is_protected(iid: str) -> bool:
+            # a host may register its runner under a different id than
+            # its compute-instance id — protect on either
+            r = ready[iid]
+            return iid in protected or (
+                r.runner_id and r.runner_id in protected
+            )
+
+        candidates = sorted(
+            (
+                (since, iid) for iid, since in self._idle_since.items()
+                if now - since >= self.cfg.idle_timeout
+                and not is_protected(iid)
+            ),
+        )
+        for since, iid in candidates:
+            idle_for = now - since
+            hard = (
+                self.cfg.hard_idle_timeout > 0
+                and idle_for >= self.cfg.hard_idle_timeout
+            )
+            if fleet_at_cap and not hard:
+                continue   # inhibited; the hard timeout overrides
+            r = ready[iid]
+            try:
+                self.provider.deprovision(r.provider_id)
+            except Exception:  # noqa: BLE001 — retry next cycle
+                return
+            self.store.deregister(iid)
+            self._idle_since.pop(iid, None)
+            return   # one per cycle: drain gradually, never abruptly

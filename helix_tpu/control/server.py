@@ -47,6 +47,7 @@ class ControlPlane:
         self, db_path: str = ":memory:", embed_fn=None,
         auth_required: bool = False, runner_token: str | None = None,
         sandbox_agents_url: str | None = None,
+        compute_cfg=None, compute_provider=None,
     ):
         import os as _os_env
 
@@ -182,7 +183,9 @@ class ControlPlane:
             from helix_tpu.services.sandbox_executor import SandboxExecutor
 
             executor = SandboxExecutor(
-                api_base=sandbox_agents_url, make_emitter=make_emitter
+                api_base=sandbox_agents_url, make_emitter=make_emitter,
+                # service key so children pass auth when it's enforced
+                api_key=self.auth.create_service_key("sandbox-agents"),
             )
         else:
             executor = AgentExecutor(
@@ -231,6 +234,24 @@ class ControlPlane:
             )
 
         self.triggers = TriggerManager(fire_trigger).start()
+
+        # cloud pool autoscaler (reference: sandbox/compute manager) —
+        # constructed only when an operator supplies a config; the stub
+        # provider backs dry runs and tests
+        self.compute = None
+        if compute_cfg is not None:
+            from helix_tpu.control.compute import (
+                ComputeManager,
+                StubProvider,
+            )
+
+            self.compute = ComputeManager(
+                compute_cfg,
+                compute_provider or StubProvider(),
+                assigned_runner_ids=lambda: {
+                    rid for rid, _ in self.store.list_assignments()
+                },
+            ).start()
 
     def _pick_embed_model(self):
         for st in self.router.runners():
@@ -337,6 +358,7 @@ class ControlPlane:
         r.add_post("/api/v1/runners/{id}/assign-profile", self.assign_profile)
         r.add_delete("/api/v1/runners/{id}/assignment", self.clear_assignment)
         r.add_get("/api/v1/runners", self.list_runners)
+        r.add_get("/api/v1/compute/instances", self.list_compute_instances)
         # profiles
         r.add_get("/api/v1/profiles", self.list_profiles)
         r.add_post("/api/v1/profiles", self.create_profile)
@@ -451,6 +473,11 @@ class ControlPlane:
         )
         self.store.record_heartbeat(rid, body)
         self.router.evict_stale()
+        if self.compute is not None and body.get("instance_id"):
+            self.compute.heartbeat(
+                body["instance_id"], runner_id=rid,
+                active_sandboxes=int(body.get("active_sandboxes", 0)),
+            )
         return web.json_response({"ok": True})
 
     async def runner_tunnel(self, request):
@@ -525,6 +552,18 @@ class ControlPlane:
                 }
             )
         return web.json_response({"runners": out})
+
+    async def list_compute_instances(self, request):
+        if self.compute is None:
+            return web.json_response({"instances": [], "enabled": False})
+        return web.json_response(
+            {
+                "enabled": True,
+                "instances": [
+                    i.to_dict() for i in self.compute.store.list()
+                ],
+            }
+        )
 
     # -- profiles -----------------------------------------------------------
     async def list_profiles(self, request):
@@ -743,6 +782,8 @@ class ControlPlane:
         table lets the installer mint the initial admin account —
         reference gates user creation behind isAdmin)."""
         body = await request.json()
+        if str(body.get("email", "")).endswith(self.auth.SERVICE_DOMAIN):
+            return _err(400, "reserved service domain")
         caller = request.get("user")
         if self.auth_required and not (caller and caller.admin):
             # Atomic bootstrap: succeeds only while the table is empty,
@@ -1197,6 +1238,12 @@ class ControlPlane:
                 raw = json.dumps({**body, "model": model}).encode()
         runner = self.router.pick_runner(model)
         if runner is None:
+            # no self-hosted runner serves it: fall through to the
+            # provider manager (external OpenAI-compatible/Anthropic
+            # endpoints) so agents and API users reach the same model
+            # set regardless of where it runs
+            if request.path == "/v1/chat/completions":
+                return await self._dispatch_provider(request, body)
             return _err(
                 404,
                 f"no runner serves model '{model}'",
@@ -1225,6 +1272,37 @@ class ControlPlane:
                     await resp.write(chunk)
                 await resp.write_eof()
                 return resp
+
+    async def _dispatch_provider(self, request, body: dict):
+        """Chat via the provider manager when no runner serves the model
+        (external providers; also the sandbox agents' path on deployments
+        with zero runners)."""
+        from helix_tpu.control.providers import ProviderError
+
+        try:
+            client, model = self.providers.resolve(body.get("model", ""))
+        except ProviderError as e:
+            return _err(
+                e.status if 400 <= e.status < 600 else 404, str(e),
+                available=self.router.available_models(),
+            )
+        body = {**body, "model": model}
+        try:
+            if body.get("stream"):
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream"}
+                )
+                await resp.prepare(request)
+                async for chunk in client.chat_stream(body):
+                    await resp.write(
+                        f"data: {json.dumps(chunk)}\n\n".encode()
+                    )
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            return web.json_response(await client.chat(body))
+        except ProviderError as e:
+            return _err(e.status if 400 <= e.status < 600 else 502, str(e))
 
     async def _dispatch_tunnel(self, request, runner, raw: bytes):
         """Dispatch through the runner's reverse tunnel, preserving SSE

@@ -145,6 +145,16 @@ class Store:
             )
             self._conn.commit()
 
+    def list_assignments(self) -> list:
+        """[(runner_id, profile_name)] for runners with a live assignment
+        (the autoscaler's shed-protection set)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT runner_id, profile_name FROM assignments "
+                "WHERE profile_name IS NOT NULL"
+            ).fetchall()
+        return [(r[0], r[1]) for r in rows]
+
     def get_assignment(self, runner_id: str) -> Optional[str]:
         with self._lock:
             row = self._conn.execute(

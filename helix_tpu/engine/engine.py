@@ -563,6 +563,11 @@ class Engine:
         key = jax.random.PRNGKey(0)
         tokens = jnp.zeros((1, C), jnp.int32)
         full = jnp.zeros((1, self.cache_cfg.max_pages_per_seq), jnp.int32)
+        # largest history bucket runtime can ask for: chunk starts are
+        # multiples of C below max_context_len, bucketed up to the next
+        # C * 2^k — compiling past that would burn XLA time on shapes
+        # that can never occur
+        max_start = ((self.max_context_len - 1) // C) * C
         hist = 0   # 0 = the first-chunk (no-history) shape
         while True:
             self.cache, _ = fn(
@@ -570,7 +575,7 @@ class Engine:
                 jnp.int32(C), jnp.zeros((1, hist // ps), jnp.int32), full,
                 sampling, key,
             )
-            if hist >= self.max_context_len:  # covered the largest bucket
+            if hist >= max_start:   # covered the largest runtime bucket
                 break
             hist = C if hist == 0 else hist * 2
 

@@ -62,24 +62,6 @@ class SandboxExecutor(Executor):
         self.memory_limit_bytes = memory_limit_bytes
         self.allow_shell = allow_shell
 
-    # ------------------------------------------------------------------
-    def _limits(self):
-        import resource
-
-        mem = self.memory_limit_bytes
-        cpu = self.cpu_limit_s
-
-        def apply():
-            os.setsid()   # own process group: parent kills the whole tree
-            resource.setrlimit(resource.RLIMIT_CPU, (cpu, cpu))
-            resource.setrlimit(resource.RLIMIT_NOFILE, (512, 512))
-            try:
-                resource.setrlimit(resource.RLIMIT_AS, (mem, mem))
-            except (ValueError, OSError):  # pragma: no cover - platform
-                pass
-
-        return apply
-
     def _env(self, workspace: str) -> dict:
         """Scrubbed environment: the agent gets the API endpoint it is
         meant to use and nothing else from the parent."""
@@ -107,6 +89,15 @@ class SandboxExecutor(Executor):
             "model": self.model,
             "max_iterations": self.max_iterations,
             "shell": self.allow_shell,
+            # resource limits applied by the trusted child launcher
+            # (sandbox_runner.main) before any agent code runs — no
+            # preexec_fn: forked-interpreter Python in a threaded parent
+            # can deadlock (subprocess docs)
+            "limits": {
+                "cpu_s": self.cpu_limit_s,
+                "memory_bytes": self.memory_limit_bytes,
+                "nofile": 512,
+            },
         }
         emit, close = (lambda s: None), (lambda: None)
         if self.make_emitter is not None:
@@ -120,7 +111,7 @@ class SandboxExecutor(Executor):
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
-            preexec_fn=self._limits(),
+            start_new_session=True,  # own group: parent kills the tree
         )
         result: dict = {}
         error: dict = {}
@@ -146,9 +137,20 @@ class SandboxExecutor(Executor):
                         continue
                     emit(_StepView(doc))
                 elif line.startswith("RESULT "):
-                    result = json.loads(line[7:])
+                    try:
+                        result = json.loads(line[7:])
+                    except json.JSONDecodeError:
+                        # stderr is merged into stdout: a logging line
+                        # that merely starts with the keyword is output,
+                        # not protocol
+                        emit(_StepView({"kind": "tool", "name": "stdout",
+                                        "arguments": None, "result": line}))
                 elif line.startswith("ERROR "):
-                    error = json.loads(line[6:])
+                    try:
+                        error = json.loads(line[6:])
+                    except json.JSONDecodeError:
+                        emit(_StepView({"kind": "tool", "name": "stdout",
+                                        "arguments": None, "result": line}))
                 elif line:
                     # raw agent/tool output: mirror it into the session
                     emit(_StepView({"kind": "tool", "name": "stdout",

@@ -89,8 +89,28 @@ def shell_skill(root: str, timeout: float = 120.0):
     )
 
 
+def _apply_limits(limits: dict) -> None:
+    """Apply resource limits first thing, before any agent code runs —
+    this module is the trusted launcher inside the sandbox."""
+    import resource
+
+    cpu = int(limits.get("cpu_s", 0))
+    if cpu > 0:
+        resource.setrlimit(resource.RLIMIT_CPU, (cpu, cpu))
+    nofile = int(limits.get("nofile", 0))
+    if nofile > 0:
+        resource.setrlimit(resource.RLIMIT_NOFILE, (nofile, nofile))
+    mem = int(limits.get("memory_bytes", 0))
+    if mem > 0:
+        try:
+            resource.setrlimit(resource.RLIMIT_AS, (mem, mem))
+        except (ValueError, OSError):  # pragma: no cover - platform
+            pass
+
+
 def main() -> int:
     job = json.loads(sys.stdin.read())
+    _apply_limits(job.get("limits") or {})
 
     from helix_tpu.agent.agent import Agent, AgentConfig
     from helix_tpu.agent.skill import SkillRegistry

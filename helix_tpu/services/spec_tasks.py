@@ -632,7 +632,9 @@ class SpecTaskOrchestrator:
                     self._ci_failed(task, pr, ext.get("ci_log", ""))
                     return True
                 return False
-        if pr["ci_status"] != "pending":
+        # 'running' is retryable: the run is synchronous, so a persisted
+        # 'running' means a crash mid-CI — re-run rather than wedge
+        if pr["ci_status"] not in ("pending", "running"):
             return False
         self.store.set_pr_ci(pr["id"], "running")
         ws = os.path.join(self.workspace_root, f"{task.id}-ci")

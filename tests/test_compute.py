@@ -1,0 +1,303 @@
+"""Autoscaler scenario tests, mirroring the reference's suite
+(``manager_test.go``, ``manager_d3_burst_test.go``,
+``manager_d4_inhibition_test.go``, ``manager_d4_profile_test.go``,
+``manager_floor_offline_test.go``) against the stub provider."""
+
+from helix_tpu.control.compute import (
+    ComputeManager,
+    Instance,
+    InstanceStore,
+    ManagerConfig,
+    Spec,
+    StubProvider,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make(cfg=None, provider=None, assigned=lambda: set()):
+    clock = FakeClock()
+    provider = provider or StubProvider()
+    mgr = ComputeManager(
+        cfg or ManagerConfig(floor=2, reconcile_interval=1),
+        provider,
+        InstanceStore(),
+        assigned_runner_ids=assigned,
+        now=clock,
+    )
+    return mgr, provider, clock
+
+
+def ready_rows(mgr):
+    return [r for r in mgr.store.list() if r.compute_state == "ready"]
+
+
+class TestFloor:
+    def test_floor_provisions_up(self):
+        mgr, stub, clock = make(
+            ManagerConfig(floor=3, max_concurrent_provisions=5,
+                          reconcile_interval=1)
+        )
+        mgr.reconcile()
+        assert len(stub.provisioned) == 3
+        mgr.reconcile()   # stub boots after 1 health check
+        assert len(ready_rows(mgr)) == 3
+        mgr.reconcile()   # stable: no extra provisions
+        assert len(stub.provisioned) == 3
+
+    def test_per_cycle_provision_cap(self):
+        mgr, stub, clock = make(
+            ManagerConfig(floor=3, max_concurrent_provisions=1,
+                          reconcile_interval=1)
+        )
+        mgr.reconcile()
+        assert len(stub.provisioned) == 1
+        mgr.reconcile()
+        assert len(stub.provisioned) == 2
+
+    def test_floor_offline_hosts_dont_count(self):
+        """A ready host whose heartbeat went offline stops satisfying the
+        floor: the manager provisions a replacement
+        (``manager_floor_offline_test.go``)."""
+        mgr, stub, clock = make(
+            ManagerConfig(floor=2, max_concurrent_provisions=5,
+                          reconcile_interval=1)
+        )
+        mgr.reconcile()
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 2
+        ready_rows(mgr)[0].status = "offline"   # heartbeat loss
+        mgr.reconcile()
+        assert len(stub.provisioned) == 3       # replacement fired
+
+    def test_stuck_provisioning_rolled_back(self):
+        stub = StubProvider()
+        mgr, stub, clock = make(
+            ManagerConfig(floor=1, max_provisioning_age=100,
+                          reconcile_interval=1),
+            provider=stub,
+        )
+        mgr.reconcile()
+        stub.hung.add(stub.provisioned[0])      # never becomes ready
+        clock.advance(101)
+        mgr.reconcile()
+        # stuck row rolled back and replaced
+        assert stub.provisioned[0] in stub.deprovisioned
+        assert len(stub.provisioned) == 2
+
+
+class TestD3Burst:
+    def _cfg(self):
+        return ManagerConfig(
+            floor=1, max=3, headroom_min=1,
+            max_concurrent_provisions=5, reconcile_interval=1,
+            spec=Spec(max_sandboxes=2),
+        )
+
+    def test_burst_on_headroom_exhaustion(self):
+        mgr, stub, clock = make(self._cfg())
+        mgr.reconcile()
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 1
+        # fill the host: 2/2 sessions -> free slots 0 < headroom 1
+        ready_rows(mgr)[0].active_sandboxes = 2
+        mgr.reconcile()
+        assert len(stub.provisioned) == 2       # burst host fired
+
+    def test_no_double_provision_while_booting(self):
+        """Committed-but-booting capacity counts toward headroom: the same
+        demand must not fire a second provision next cycle
+        (``manager.go:731-748``)."""
+        stub = StubProvider(boot_cycles=3)      # slow boot
+        mgr, stub, clock = make(self._cfg(), provider=stub)
+        for _ in range(4):
+            mgr.reconcile()
+        assert len(ready_rows(mgr)) == 1
+        ready_rows(mgr)[0].active_sandboxes = 2
+        mgr.reconcile()                         # fires burst provision
+        n = len(stub.provisioned)
+        mgr.reconcile()                         # still booting: no extra
+        mgr.reconcile()
+        assert len(stub.provisioned) == n
+
+    def test_max_is_a_hard_ceiling(self):
+        mgr, stub, clock = make(self._cfg())
+        for _ in range(3):
+            mgr.reconcile()
+        for r in ready_rows(mgr):
+            r.active_sandboxes = r.max_sandboxes
+        for _ in range(6):
+            mgr.reconcile()
+            for r in ready_rows(mgr):
+                r.active_sandboxes = r.max_sandboxes
+        assert len(stub.provisioned) <= 3       # never past max
+
+    def test_d3_disabled_when_max_zero(self):
+        mgr, stub, clock = make(
+            ManagerConfig(floor=1, max=0, reconcile_interval=1,
+                          spec=Spec(max_sandboxes=1))
+        )
+        mgr.reconcile()
+        mgr.reconcile()
+        ready_rows(mgr)[0].active_sandboxes = 1
+        mgr.reconcile()
+        assert len(stub.provisioned) == 1       # floor only
+
+
+class TestD4Idle:
+    def _cfg(self, idle=100.0, hard=1000.0):
+        return ManagerConfig(
+            floor=1, max=3, headroom_min=1, idle_timeout=idle,
+            hard_idle_timeout=hard, max_concurrent_provisions=5,
+            reconcile_interval=1, spec=Spec(max_sandboxes=2),
+        )
+
+    def _fleet_of(self, mgr, n):
+        """Reconcile until n hosts are ready (driving demand)."""
+        mgr.reconcile()
+        mgr.reconcile()
+        while len(ready_rows(mgr)) < n:
+            for r in ready_rows(mgr):
+                r.active_sandboxes = r.max_sandboxes
+            mgr.reconcile()
+            mgr.reconcile()
+        for r in ready_rows(mgr):
+            r.active_sandboxes = 0
+
+    def test_idle_host_shed_toward_floor(self):
+        mgr, stub, clock = make(self._cfg())
+        self._fleet_of(mgr, 2)
+        clock.advance(101)
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 1        # one shed per cycle
+        clock.advance(101)
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 1        # floor holds
+
+    def test_busy_host_resets_idle_timer(self):
+        mgr, stub, clock = make(self._cfg())
+        self._fleet_of(mgr, 2)
+        clock.advance(60)
+        for r in ready_rows(mgr):
+            r.active_sandboxes = 1               # both pick up work
+        mgr.reconcile()
+        for r in ready_rows(mgr):
+            r.active_sandboxes = 0               # idle again
+        clock.advance(60)                        # 120 total but timers reset
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 2
+
+    def test_at_cap_fleet_inhibits_shedding(self):
+        """Don't reclaim an idle pre-warm host while another host is
+        pressed against its cap (anti-oscillation,
+        ``manager_d4_inhibition_test.go``)."""
+        mgr, stub, clock = make(self._cfg())
+        self._fleet_of(mgr, 2)
+        ready_rows(mgr)[0].active_sandboxes = 2  # at cap
+        clock.advance(101)
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 2         # inhibited
+
+    def test_hard_idle_timeout_overrides_inhibition(self):
+        mgr, stub, clock = make(self._cfg(idle=100, hard=500))
+        self._fleet_of(mgr, 2)
+        ready_rows(mgr)[0].active_sandboxes = 2  # stuck at cap forever
+        clock.advance(501)
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 1         # hard override shed it
+
+    def test_profile_assigned_runner_protected(self):
+        """A runner with a serving profile assigned may be serving
+        inference at 0 sandboxes — never shed it
+        (``manager_d4_profile_test.go``)."""
+        protected = set()
+        mgr, stub, clock = make(
+            self._cfg(), assigned=lambda: protected
+        )
+        self._fleet_of(mgr, 2)
+        protected.update(r.id for r in ready_rows(mgr))
+        clock.advance(101)
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 2         # both protected
+
+    def test_offline_flap_keeps_idle_clock(self):
+        """A heartbeat flap must not reset accumulated idle time
+        (ComputeState-keyed tracker)."""
+        mgr, stub, clock = make(self._cfg())
+        self._fleet_of(mgr, 2)
+        clock.advance(60)
+        victim = ready_rows(mgr)[0]
+        victim.status = "offline"                # flap
+        mgr.reconcile()
+        victim.status = "ready"
+        clock.advance(60)                        # 120 total idle
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 1         # timer survived the flap
+
+    def test_failed_deprovision_retries_next_cycle(self):
+        stub = StubProvider()
+        mgr, stub, clock = make(self._cfg(), provider=stub)
+        self._fleet_of(mgr, 2)
+        clock.advance(101)
+        stub.fail_next_deprovision = 1
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 2         # failed: nothing removed
+        mgr.reconcile()
+        assert len(ready_rows(mgr)) == 1         # retried and shed
+
+
+class TestControlPlaneWiring:
+    def test_autoscaler_behind_control_plane(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from helix_tpu.control.compute import ManagerConfig, StubProvider
+        from helix_tpu.control.server import ControlPlane
+
+        async def main():
+            stub = StubProvider()
+            cp = ControlPlane(
+                compute_cfg=ManagerConfig(
+                    floor=1, reconcile_interval=9999
+                ),
+                compute_provider=stub,
+            )
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                cp.compute.reconcile()   # floor kicks a provision
+                cp.compute.reconcile()   # stub becomes ready
+                r = await client.get("/api/v1/compute/instances")
+                doc = await r.json()
+                assert doc["enabled"] and len(doc["instances"]) == 1
+                inst = doc["instances"][0]
+                assert inst["compute_state"] == "ready"
+                # the booted host heartbeats with its instance id: the
+                # row reflects liveness + session load
+                r = await client.post(
+                    f"/api/v1/runners/{inst['id']}/heartbeat",
+                    json={"instance_id": inst["id"],
+                          "active_sandboxes": 3,
+                          "profile": {"models": []}},
+                )
+                assert r.status == 200
+                row = cp.compute.store.get(inst["id"])
+                assert row.status == "ready" and row.active_sandboxes == 3
+            finally:
+                await client.close()
+                cp.compute.stop()
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                cp.triggers.stop()
+
+        asyncio.run(main())
