@@ -227,3 +227,104 @@ def filesystem_skill(root: str) -> Skill:
         handler=fs,
         dangerous=True,
     )
+
+
+# ---------------------------------------------------------------------------
+# GitHub repo skill (OAuth-token backed; reference: api/pkg/agent/skill/
+# github — one of the repo-skill family powered by the OAuth manager)
+# ---------------------------------------------------------------------------
+
+
+def github_skill(get_token, api_base: str = "https://api.github.com") -> Skill:
+    """Repo operations against the GitHub REST API.  ``get_token`` is a
+    zero-arg callable resolving the calling user's OAuth access token
+    (refreshing it when needed — ``oauth/manager.go GetTokenForTool``)."""
+    import json as _json
+
+    import requests as _requests
+
+    def call(method: str, path: str, body: Optional[dict] = None):
+        r = _requests.request(
+            method,
+            f"{api_base}{path}",
+            headers={
+                "Authorization": f"Bearer {get_token()}",
+                "Accept": "application/vnd.github+json",
+            },
+            json=body,
+            timeout=30,
+        )
+        if r.status_code >= 400:
+            raise ValueError(f"github {r.status_code}: {r.text[:300]}")
+        return r.json()
+
+    def gh(action: str, repo: str = "", number: int = 0,
+           title: str = "", body: str = "", path: str = "",
+           base: str = "", head: str = "") -> str:
+        if action == "list_repos":
+            docs = call("GET", "/user/repos?per_page=30&sort=updated")
+            return "\n".join(d["full_name"] for d in docs) or "(none)"
+        if action == "list_issues":
+            docs = call("GET", f"/repos/{repo}/issues?per_page=30")
+            return "\n".join(
+                f"#{d['number']} [{d.get('state')}] {d['title']}"
+                for d in docs
+            ) or "(none)"
+        if action == "create_issue":
+            d = call("POST", f"/repos/{repo}/issues",
+                     {"title": title, "body": body})
+            return f"created issue #{d['number']}: {d['html_url']}"
+        if action == "get_pr":
+            d = call("GET", f"/repos/{repo}/pulls/{number}")
+            return _json.dumps(
+                {k: d.get(k) for k in
+                 ("number", "title", "state", "merged", "head", "base",
+                  "body")},
+                default=str,
+            )[:4000]
+        if action == "create_pr":
+            d = call("POST", f"/repos/{repo}/pulls",
+                     {"title": title, "body": body, "base": base,
+                      "head": head})
+            return f"created PR #{d['number']}: {d['html_url']}"
+        if action == "comment":
+            d = call("POST", f"/repos/{repo}/issues/{number}/comments",
+                     {"body": body})
+            return f"commented: {d['html_url']}"
+        if action == "get_file":
+            d = call("GET", f"/repos/{repo}/contents/{path}")
+            import base64 as _b64
+
+            return _b64.b64decode(d.get("content", "")).decode(
+                errors="replace"
+            )[:8000]
+        raise ValueError(
+            "action must be list_repos|list_issues|create_issue|get_pr|"
+            "create_pr|comment|get_file"
+        )
+
+    return Skill(
+        name="github",
+        description="GitHub: list repos/issues, create issues/PRs, read "
+                    "PRs and files, comment.",
+        parameters={
+            "type": "object",
+            "properties": {
+                "action": {"type": "string",
+                           "enum": ["list_repos", "list_issues",
+                                    "create_issue", "get_pr", "create_pr",
+                                    "comment", "get_file"]},
+                "repo": {"type": "string",
+                         "description": "owner/name"},
+                "number": {"type": "integer"},
+                "title": {"type": "string"},
+                "body": {"type": "string"},
+                "path": {"type": "string"},
+                "base": {"type": "string"},
+                "head": {"type": "string"},
+            },
+            "required": ["action"],
+        },
+        handler=gh,
+        dangerous=True,
+    )

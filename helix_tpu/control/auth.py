@@ -147,11 +147,18 @@ class Authenticator:
             ).fetchone()[0]
 
     def create_service_key(self, name: str) -> str:
-        """Idempotent service account + API key (non-admin)."""
+        """Service account + a SINGLE live API key: prior keys for the
+        account are revoked so restarts rotate rather than accumulate
+        credentials."""
         email = f"{name}{self.SERVICE_DOMAIN}"
         u = self.get_user(email)
         if u is None:
             u = self.create_user(email=email, name=name)
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM auth_keys WHERE user_id=?", (u.id,)
+            )
+            self._conn.commit()
         return self.create_api_key(u.id, name=name)
 
     # -- users -------------------------------------------------------------
@@ -302,6 +309,13 @@ class Authenticator:
                 return False
             return ROLES.index(role) <= ROLES.index(min_role)
         return False
+
+    # -- envelope encryption (shared with the OAuth token store) ----------
+    def encrypt(self, data: bytes) -> bytes:
+        return self._fernet.encrypt(data)
+
+    def decrypt(self, token: bytes) -> bytes:
+        return self._fernet.decrypt(token)
 
     # -- secrets ---------------------------------------------------------------
     def set_secret(self, owner: str, name: str, value: str) -> str:

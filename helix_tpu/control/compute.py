@@ -162,6 +162,8 @@ class ManagerConfig:
     idle_timeout: float = 600.0     # 0 disables D4
     hard_idle_timeout: float = 14400.0  # 0 disables the inhibition override
     heartbeat_stale_after: float = 90.0  # ready host w/o heartbeat = offline
+    offline_reap_after: float = 1800.0   # dead host reclaimed regardless of
+    # its frozen active_sandboxes count (0 disables the orphan reaper)
     spec: Spec = dataclasses.field(default_factory=Spec)
 
     def validate(self) -> None:
@@ -187,6 +189,7 @@ class ComputeManager:
         self.assigned_runner_ids = assigned_runner_ids
         self.now = now
         self._idle_since: dict[str, float] = {}
+        self._offline_since: dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -267,10 +270,40 @@ class ComputeManager:
         self._refresh_provisioning(rows)
         rows = self.store.list()
         self._mark_stale(rows)
+        self._reap_dead(rows)
+        rows = self.store.list()
         need = self._compute_needed(rows)
         for _ in range(min(need, self.cfg.max_concurrent_provisions)):
             self._provision_one()
         self._try_deprovision_idle(self.store.list())
+
+    def _reap_dead(self, rows: list[Instance]) -> None:
+        """Orphan reaper: a ready host offline continuously past
+        ``offline_reap_after`` is reclaimed even if it died holding
+        sessions (a crashed node never reports active_sandboxes=0, so the
+        idle arm alone would leak the cloud instance forever)."""
+        if self.cfg.offline_reap_after <= 0:
+            return
+        now = self.now()
+        for r in rows:
+            key = r.id
+            if r.compute_state == "ready" and r.status == "offline":
+                self._offline_since.setdefault(key, now)
+            else:
+                self._offline_since.pop(key, None)
+        for iid, since in list(self._offline_since.items()):
+            if now - since < self.cfg.offline_reap_after:
+                continue
+            r = self.store.get(iid)
+            if r is None:
+                del self._offline_since[iid]
+                continue
+            try:
+                self.provider.deprovision(r.provider_id)
+            except Exception:  # noqa: BLE001 — retry next cycle
+                continue
+            self.store.deregister(iid)
+            del self._offline_since[iid]
 
     def _refresh_provisioning(self, rows: list[Instance]) -> None:
         for r in rows:

@@ -80,12 +80,14 @@ class SessionController:
         knowledge=None,            # KnowledgeManager
         secrets=None,              # Authenticator (for ${secrets.X} substitution)
         billing=None,              # BillingService (quota + wallet debits)
+        oauth=None,                # OAuthManager (token-backed skills)
     ):
         self.store = store
         self.providers = providers
         self.knowledge = knowledge
         self.secrets = secrets
         self.billing = billing
+        self.oauth = oauth
 
     # ------------------------------------------------------------------
     def _assistant_for(self, app_id: Optional[str], assistant: str = ""):
@@ -229,6 +231,22 @@ class SessionController:
             registry.register(
                 knowledge_skill(self.knowledge, list(assistant.knowledge))
             )
+        if self.oauth is not None and "github" in assistant.tools:
+            # token-backed repo skill, enabled when the session user holds
+            # a GitHub OAuth connection (oauth/manager.go GetTokenForTool)
+            from helix_tpu.agent.skills import github_skill
+
+            try:
+                p = self.oauth.get_provider("github")
+                self.oauth.get_token(user, "github")  # validates connection
+                registry.register(
+                    github_skill(
+                        lambda: self.oauth.get_token(user, "github"),
+                        api_base=p.api_base or "https://api.github.com",
+                    )
+                )
+            except Exception:  # noqa: BLE001 — no connection: skill absent
+                pass
         for api in assistant.apis:
             registry.register(
                 api_skill(

@@ -1,0 +1,172 @@
+"""Notifications: email / Slack / Discord notifiers.
+
+Mirrors ``api/pkg/notification`` (email/Slack/Discord notifiers wired at
+``serve.go:286-289``): lifecycle events (task done/failed, CI red) fan out
+to every configured sink; a sink failure never breaks the caller.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Notification:
+    kind: str            # task_done | task_failed | ci_failed | custom...
+    title: str
+    body: str = ""
+    meta: dict = dataclasses.field(default_factory=dict)
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+class Notifier:
+    def send(self, n: Notification) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class SlackWebhookNotifier(Notifier):
+    """Incoming-webhook sink (https://hooks.slack.com/services/...)."""
+
+    def __init__(self, url: str, http_post=None):
+        self.url = url
+        self.http_post = http_post or _default_post
+
+    def send(self, n: Notification) -> None:
+        self.http_post(
+            self.url,
+            {"text": f"*{n.title}*\n{n.body}".strip()},
+        )
+
+
+class DiscordWebhookNotifier(Notifier):
+    def __init__(self, url: str, http_post=None):
+        self.url = url
+        self.http_post = http_post or _default_post
+
+    def send(self, n: Notification) -> None:
+        self.http_post(
+            self.url,
+            {"content": f"**{n.title}**\n{n.body}".strip()[:2000]},
+        )
+
+
+class EmailNotifier(Notifier):
+    def __init__(self, host: str, port: int, sender: str, to: str,
+                 username: str = "", password: str = "", use_tls=True):
+        self.host, self.port = host, port
+        self.sender, self.to = sender, to
+        self.username, self.password = username, password
+        self.use_tls = use_tls
+
+    def send(self, n: Notification) -> None:
+        import smtplib
+        from email.message import EmailMessage
+
+        msg = EmailMessage()
+        msg["Subject"] = n.title
+        msg["From"] = self.sender
+        msg["To"] = self.to
+        msg.set_content(n.body or n.title)
+        with smtplib.SMTP(self.host, self.port, timeout=30) as s:
+            if self.use_tls:
+                s.starttls()
+            if self.username:
+                s.login(self.username, self.password)
+            s.send_message(msg)
+
+
+def _default_post(url: str, doc: dict) -> None:
+    import requests
+
+    requests.post(url, json=doc, timeout=15).raise_for_status()
+
+
+class NotificationService:
+    """Fan-out with per-sink error isolation + a ring buffer the admin UI
+    reads (recent notifications survive even with zero sinks)."""
+
+    def __init__(self, notifiers: Optional[list] = None, history: int = 200):
+        self.notifiers: list[Notifier] = list(notifiers or [])
+        self.recent: collections.deque = collections.deque(maxlen=history)
+        self._lock = threading.Lock()
+        # sinks run on one worker thread so a slow SMTP/webhook endpoint
+        # never stalls the caller (the orchestrator's poll loop)
+        import queue as _queue
+
+        self._queue: _queue.Queue = _queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+
+    @classmethod
+    def from_env(cls, env: Optional[dict] = None) -> "NotificationService":
+        import os
+
+        env = env if env is not None else os.environ
+        sinks: list[Notifier] = []
+        if env.get("HELIX_SLACK_WEBHOOK_URL"):
+            sinks.append(SlackWebhookNotifier(env["HELIX_SLACK_WEBHOOK_URL"]))
+        if env.get("HELIX_DISCORD_WEBHOOK_URL"):
+            sinks.append(
+                DiscordWebhookNotifier(env["HELIX_DISCORD_WEBHOOK_URL"])
+            )
+        if env.get("HELIX_SMTP_HOST"):
+            sinks.append(
+                EmailNotifier(
+                    host=env["HELIX_SMTP_HOST"],
+                    port=int(env.get("HELIX_SMTP_PORT", "587")),
+                    sender=env.get("HELIX_SMTP_FROM", "helix@localhost"),
+                    to=env.get("HELIX_SMTP_TO", ""),
+                    username=env.get("HELIX_SMTP_USER", ""),
+                    password=env.get("HELIX_SMTP_PASSWORD", ""),
+                )
+            )
+        return cls(sinks)
+
+    def notify(self, kind: str, title: str, body: str = "",
+               **meta) -> Notification:
+        n = Notification(kind=kind, title=title, body=body, meta=meta)
+        with self._lock:
+            self.recent.appendleft(n)
+            if self.notifiers and self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._drain, name="helix-notify", daemon=True
+                )
+                self._worker.start()
+        if self.notifiers:
+            self._queue.put(n)
+        return n
+
+    def _drain(self):
+        while True:
+            n = self._queue.get()
+            for sink in self.notifiers:
+                try:
+                    sink.send(n)
+                except Exception:  # noqa: BLE001 — a sink never breaks us
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "notifier %s failed", type(sink).__name__,
+                        exc_info=True,
+                    )
+            self._queue.task_done()
+
+    def flush(self, timeout: float = 10.0) -> None:
+        """Block until queued notifications have been delivered (tests)."""
+        import time as _time
+
+        deadline = _time.time() + timeout
+        while not self._queue.empty() and _time.time() < deadline:
+            _time.sleep(0.02)
+        # one extra beat for the in-flight item past the queue
+        _time.sleep(0.05)
+
+    def history(self, limit: int = 50) -> list:
+        with self._lock:
+            return [n.to_dict() for n in list(self.recent)[:limit]]

@@ -1,0 +1,267 @@
+"""OAuth manager: provider registry + per-user connections + token refresh.
+
+Mirrors the reference's OAuth stack (``api/pkg/oauth/manager.go``:
+LoadProviders/GetProvider/GetTokenForTool + refresh-if-needed;
+``oauth2.go``: GetAuthorizationURL/CompleteAuthorization) powering agent
+skills — GitHub first, any RFC-6749 authorization-code provider via
+config (``api/cmd/helix/serve.go:400-408``).
+
+Tokens are encrypted at rest with the deployment's Fernet envelope (the
+same key protecting user secrets); refresh happens lazily on
+``get_token`` when the access token is inside the expiry skew.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import secrets as pysecrets
+import sqlite3
+import threading
+import time
+import urllib.parse
+from typing import Callable, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS oauth_connections (
+    user_id TEXT NOT NULL,
+    provider TEXT NOT NULL,
+    ciphertext BLOB NOT NULL,      -- encrypted token document
+    scopes TEXT DEFAULT '',
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL,
+    PRIMARY KEY (user_id, provider)
+);
+"""
+
+EXPIRY_SKEW = 120.0   # refresh when < 2 min of validity remain
+
+
+@dataclasses.dataclass(frozen=True)
+class OAuthProviderConfig:
+    """One upstream identity provider (reference: types.OAuthProvider)."""
+
+    name: str                      # "github", "gitlab", "google", ...
+    auth_url: str
+    token_url: str
+    client_id: str
+    client_secret: str
+    scopes: tuple = ()
+    api_base: str = ""             # e.g. https://api.github.com
+
+    @classmethod
+    def github(cls, client_id: str, client_secret: str,
+               scopes=("repo", "read:user")) -> "OAuthProviderConfig":
+        return cls(
+            name="github",
+            auth_url="https://github.com/login/oauth/authorize",
+            token_url="https://github.com/login/oauth/access_token",
+            client_id=client_id,
+            client_secret=client_secret,
+            scopes=tuple(scopes),
+            api_base="https://api.github.com",
+        )
+
+
+class OAuthError(Exception):
+    pass
+
+
+class OAuthManager:
+    def __init__(
+        self,
+        db_path: str = ":memory:",
+        encrypt: Optional[Callable[[bytes], bytes]] = None,
+        decrypt: Optional[Callable[[bytes], bytes]] = None,
+        http_post: Optional[Callable] = None,
+        now: Callable[[], float] = time.time,
+    ):
+        """``encrypt``/``decrypt`` come from the Authenticator's Fernet
+        envelope; ``http_post(url, data, headers) -> dict`` is the token
+        endpoint transport (injected in tests; requests-based default)."""
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+        self._providers: dict[str, OAuthProviderConfig] = {}
+        # state -> (user, provider, redirect_uri, created)
+        self._states: dict[str, tuple[str, str, str, float]] = {}
+        ident = lambda b: b  # noqa: E731
+        self.encrypt = encrypt or ident
+        self.decrypt = decrypt or ident
+        self.http_post = http_post or self._default_post
+        self.now = now
+
+    # -- provider registry --------------------------------------------------
+    def register_provider(self, cfg: OAuthProviderConfig) -> None:
+        self._providers[cfg.name] = cfg
+
+    def providers(self) -> list:
+        return [
+            {"name": p.name, "scopes": list(p.scopes),
+             "api_base": p.api_base}
+            for p in self._providers.values()
+        ]
+
+    def get_provider(self, name: str) -> OAuthProviderConfig:
+        p = self._providers.get(name)
+        if p is None:
+            raise OAuthError(f"unknown oauth provider '{name}'")
+        return p
+
+    # -- authorization-code flow -------------------------------------------
+    def authorization_url(self, user_id: str, provider: str,
+                          redirect_uri: str) -> str:
+        p = self.get_provider(provider)
+        state = pysecrets.token_urlsafe(24)
+        # purge abandoned flows so the map stays bounded
+        cutoff = self.now() - 900
+        for s, entry in list(self._states.items()):
+            if entry[3] < cutoff:
+                del self._states[s]
+        self._states[state] = (user_id, provider, redirect_uri, self.now())
+        q = urllib.parse.urlencode(
+            {
+                "client_id": p.client_id,
+                "redirect_uri": redirect_uri,
+                "scope": " ".join(p.scopes),
+                "state": state,
+                "response_type": "code",
+            }
+        )
+        return f"{p.auth_url}?{q}"
+
+    def complete(self, code: str, state: str) -> dict:
+        """Exchange the authorization code; persists the connection.
+        Returns {user_id, provider}.  The redirect_uri sent with the
+        authorization request rides along in the state entry — RFC 6749
+        §4.1.3 requires it to match at the token endpoint."""
+        entry = self._states.pop(state, None)
+        if entry is None or self.now() - entry[3] > 900:
+            raise OAuthError("unknown or expired oauth state")
+        user_id, provider, redirect_uri, _ = entry
+        p = self.get_provider(provider)
+        doc = self.http_post(
+            p.token_url,
+            data={
+                "client_id": p.client_id,
+                "client_secret": p.client_secret,
+                "code": code,
+                "grant_type": "authorization_code",
+                **({"redirect_uri": redirect_uri} if redirect_uri else {}),
+            },
+            headers={"Accept": "application/json"},
+        )
+        if "access_token" not in doc:
+            raise OAuthError(f"token exchange failed: {doc}")
+        self._save(user_id, provider, doc)
+        return {"user_id": user_id, "provider": provider}
+
+    # -- token storage ------------------------------------------------------
+    def _save(self, user_id: str, provider: str, doc: dict) -> None:
+        record = {
+            "access_token": doc["access_token"],
+            "refresh_token": doc.get("refresh_token", ""),
+            "expires_at": (
+                self.now() + float(doc["expires_in"])
+                if doc.get("expires_in")
+                else 0.0   # 0 = non-expiring (classic GitHub tokens)
+            ),
+            "scope": doc.get("scope", ""),
+        }
+        ct = self.encrypt(json.dumps(record).encode())
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO oauth_connections(user_id, provider, "
+                "ciphertext, scopes, created_at, updated_at) "
+                "VALUES(?,?,?,?,?,?) ON CONFLICT(user_id, provider) DO "
+                "UPDATE SET ciphertext=excluded.ciphertext, "
+                "scopes=excluded.scopes, updated_at=excluded.updated_at",
+                (user_id, provider, ct, record["scope"], self.now(),
+                 self.now()),
+            )
+            self._conn.commit()
+
+    def _load(self, user_id: str, provider: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT ciphertext FROM oauth_connections WHERE user_id=? "
+                "AND provider=?",
+                (user_id, provider),
+            ).fetchone()
+        if not row:
+            return None
+        return json.loads(self.decrypt(row[0]))
+
+    def connections(self, user_id: str) -> list:
+        """Metadata only — tokens never leave the envelope via list."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT provider, scopes, created_at, updated_at FROM "
+                "oauth_connections WHERE user_id=?",
+                (user_id,),
+            ).fetchall()
+        return [
+            {"provider": r[0], "scopes": r[1], "created_at": r[2],
+             "updated_at": r[3]}
+            for r in rows
+        ]
+
+    def disconnect(self, user_id: str, provider: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM oauth_connections WHERE user_id=? AND "
+                "provider=?",
+                (user_id, provider),
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    # -- the skill-facing API ----------------------------------------------
+    def get_token(self, user_id: str, provider: str) -> str:
+        """Valid access token, refreshing when needed
+        (``manager.go:627-…`` GetTokenForTool + RefreshTokenIfNeeded)."""
+        rec = self._load(user_id, provider)
+        if rec is None:
+            raise OAuthError(
+                f"user {user_id} has no {provider} connection"
+            )
+        if rec["expires_at"] and (
+            rec["expires_at"] - self.now() < EXPIRY_SKEW
+        ):
+            rec = self._refresh(user_id, provider, rec)
+        return rec["access_token"]
+
+    def _refresh(self, user_id: str, provider: str, rec: dict) -> dict:
+        if not rec.get("refresh_token"):
+            raise OAuthError(
+                f"{provider} token expired and no refresh token held"
+            )
+        p = self.get_provider(provider)
+        doc = self.http_post(
+            p.token_url,
+            data={
+                "client_id": p.client_id,
+                "client_secret": p.client_secret,
+                "refresh_token": rec["refresh_token"],
+                "grant_type": "refresh_token",
+            },
+            headers={"Accept": "application/json"},
+        )
+        if "access_token" not in doc:
+            raise OAuthError(f"token refresh failed: {doc}")
+        if "refresh_token" not in doc:   # providers may rotate or keep it
+            doc["refresh_token"] = rec["refresh_token"]
+        self._save(user_id, provider, doc)
+        return self._load(user_id, provider)
+
+    @staticmethod
+    def _default_post(url: str, data: dict, headers: dict) -> dict:
+        import requests
+
+        r = requests.post(url, data=data, headers=headers, timeout=30)
+        try:
+            return r.json()
+        except ValueError:
+            return dict(urllib.parse.parse_qsl(r.text))

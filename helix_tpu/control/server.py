@@ -101,10 +101,28 @@ class ControlPlane:
                 remote.base_url = target[1]
                 return remote(texts)
 
+        # OAuth provider registry + token store (reference: api/pkg/oauth)
+        import os as _os_oauth
+
+        from helix_tpu.control.oauth import OAuthManager, OAuthProviderConfig
+
+        oauth_path = (
+            ":memory:" if db_path == ":memory:" else db_path + ".oauth"
+        )
+        self.oauth = OAuthManager(
+            oauth_path, encrypt=self.auth.encrypt, decrypt=self.auth.decrypt
+        )
+        gh_id = _os_oauth.environ.get("HELIX_GITHUB_CLIENT_ID", "")
+        gh_secret = _os_oauth.environ.get("HELIX_GITHUB_CLIENT_SECRET", "")
+        if gh_id and gh_secret:
+            self.oauth.register_provider(
+                OAuthProviderConfig.github(gh_id, gh_secret)
+            )
+
         self.knowledge = KnowledgeManager(self.vectors, embed_fn).start()
         self.controller = SessionController(
             self.store, self.providers, self.knowledge,
-            secrets=self.auth, billing=self.billing,
+            secrets=self.auth, billing=self.billing, oauth=self.oauth,
         )
 
         # spec-task pipeline: internal git hosting + orchestrator whose
@@ -191,8 +209,13 @@ class ControlPlane:
             executor = AgentExecutor(
                 _ProviderLLM(self.providers), make_emitter=make_emitter
             )
+        from helix_tpu.control.notifications import NotificationService
+
+        self.notifications = NotificationService.from_env()
         self.orchestrator = SpecTaskOrchestrator(
-            self.task_store, self.git, executor
+            self.task_store, self.git, executor,
+            notify=lambda kind, title, body="", **meta:
+                self.notifications.notify(kind, title, body, **meta),
         ).start()
 
         # event bus (embedded-NATS equivalent) + filestore + triggers
@@ -392,6 +415,14 @@ class ControlPlane:
         r.add_post("/api/v1/orgs/{id}/members", self.add_member)
         r.add_get("/api/v1/orgs/{id}/members", self.list_members)
         r.add_delete("/api/v1/orgs/{id}/members/{user}", self.remove_member)
+        # oauth connections (agent-skill tokens)
+        r.add_get("/api/v1/oauth/providers", self.oauth_providers)
+        r.add_get("/api/v1/oauth/connect/{provider}", self.oauth_connect)
+        r.add_get("/api/v1/oauth/callback", self.oauth_callback)
+        r.add_get("/api/v1/oauth/connections", self.oauth_connections)
+        r.add_delete(
+            "/api/v1/oauth/connections/{provider}", self.oauth_disconnect
+        )
         r.add_get("/api/v1/secrets", self.list_secrets)
         r.add_post("/api/v1/secrets", self.set_secret)
         r.add_delete("/api/v1/secrets/{name}", self.delete_secret)
@@ -410,6 +441,8 @@ class ControlPlane:
         r.add_get("/api/v1/repos", self.list_repos)
         r.add_get("/git/{repo}/info/refs", self.git_info_refs)
         r.add_post("/git/{repo}/{service}", self.git_rpc)
+        # notifications
+        r.add_get("/api/v1/notifications", self.list_notifications)
         # triggers + webhooks
         r.add_get("/api/v1/triggers", self.list_triggers)
         r.add_post("/api/v1/triggers", self.create_trigger)
@@ -857,6 +890,52 @@ class ControlPlane:
         )
         return web.json_response({"ok": True})
 
+    # -- oauth ----------------------------------------------------------------
+    async def oauth_providers(self, request):
+        return web.json_response({"providers": self.oauth.providers()})
+
+    async def oauth_connect(self, request):
+        from helix_tpu.control.oauth import OAuthError
+
+        redirect = request.query.get(
+            "redirect_uri",
+            str(request.url.with_path("/api/v1/oauth/callback")),
+        )
+        try:
+            url = self.oauth.authorization_url(
+                self._user_id(request), request.match_info["provider"],
+                redirect,
+            )
+        except OAuthError as e:
+            return _err(404, str(e))
+        return web.json_response({"url": url})
+
+    async def oauth_callback(self, request):
+        from helix_tpu.control.oauth import OAuthError
+
+        code = request.query.get("code", "")
+        state = request.query.get("state", "")
+        if not code or not state:
+            return _err(400, "code and state required")
+        try:
+            doc = await __import__("asyncio").get_running_loop().run_in_executor(
+                None, lambda: self.oauth.complete(code, state)
+            )
+        except OAuthError as e:
+            return _err(400, str(e))
+        return web.json_response({"ok": True, **doc})
+
+    async def oauth_connections(self, request):
+        return web.json_response(
+            {"connections": self.oauth.connections(self._user_id(request))}
+        )
+
+    async def oauth_disconnect(self, request):
+        ok = self.oauth.disconnect(
+            self._user_id(request), request.match_info["provider"]
+        )
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
     async def list_secrets(self, request):
         owner = self._user_id(request)
         return web.json_response({"secrets": self.auth.list_secrets(owner)})
@@ -963,6 +1042,15 @@ class ControlPlane:
     async def list_repos(self, request):
         return web.json_response({"repos": self.git.list_repos()})
 
+    async def list_notifications(self, request):
+        try:
+            limit = max(1, min(int(request.query.get("limit", 50)), 500))
+        except ValueError:
+            return _err(400, "limit must be an integer")
+        return web.json_response(
+            {"notifications": self.notifications.history(limit)}
+        )
+
     # -- triggers --------------------------------------------------------------
     async def list_triggers(self, request):
         return web.json_response(
@@ -992,6 +1080,9 @@ class ControlPlane:
         return web.json_response({"ok": ok}, status=200 if ok else 404)
 
     async def fire_webhook(self, request):
+        """Webhook entry for plain + chat-platform triggers: Slack/Teams/
+        Discord payloads are normalised (and URL-verification handshakes
+        answered) before firing the bound agent session."""
         tid = request.match_info["id"]
         try:
             payload = await request.json()
@@ -1001,13 +1092,20 @@ class ControlPlane:
             "X-Webhook-Secret", request.query.get("secret", "")
         )
         try:
-            ok = await __import__("asyncio").get_running_loop().run_in_executor(
-                None, lambda: self.triggers.fire_webhook(tid, payload, secret)
+            verdict, doc = await __import__(
+                "asyncio"
+            ).get_running_loop().run_in_executor(
+                None,
+                lambda: self.triggers.handle_platform(tid, payload, secret),
             )
         except PermissionError:
             return _err(403, "bad webhook secret")
-        if not ok:
+        if verdict == "missing":
             return _err(404, "trigger not found or not a webhook")
+        if verdict == "challenge":
+            return web.json_response(doc)
+        if verdict == "ignored":
+            return web.json_response({"ok": True, "ignored": doc})
         return web.json_response({"ok": True})
 
     # -- filestore -------------------------------------------------------------
@@ -1289,14 +1387,31 @@ class ControlPlane:
         body = {**body, "model": model}
         try:
             if body.get("stream"):
+                # pull the first chunk BEFORE committing the 200/SSE
+                # headers, so upstream failures surface as real errors
+                # instead of a dead stream
+                stream = client.chat_stream(body)
+                try:
+                    first = await stream.__anext__()
+                except StopAsyncIteration:
+                    first = None
                 resp = web.StreamResponse(
                     headers={"Content-Type": "text/event-stream"}
                 )
                 await resp.prepare(request)
-                async for chunk in client.chat_stream(body):
-                    await resp.write(
-                        f"data: {json.dumps(chunk)}\n\n".encode()
-                    )
+                try:
+                    if first is not None:
+                        await resp.write(
+                            f"data: {json.dumps(first)}\n\n".encode()
+                        )
+                        async for chunk in stream:
+                            await resp.write(
+                                f"data: {json.dumps(chunk)}\n\n".encode()
+                            )
+                except ProviderError as e:
+                    # headers are committed: report in-band
+                    frame = json.dumps({"error": {"message": str(e)}})
+                    await resp.write(f"data: {frame}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
                 return resp

@@ -69,6 +69,69 @@ class CronSchedule:
         )
 
 
+def normalize_platform_payload(kind: str, payload: dict):
+    """Normalise a chat-platform webhook body into the common fire shape
+    (reference: ``api/pkg/trigger/{slack,teams,discord}`` payload
+    adapters).
+
+    Returns one of:
+      ("challenge", doc)  — platform URL-verification handshake; the
+                            server must respond with ``doc`` verbatim
+      ("fire", payload)   — normalised {message, user, channel, thread}
+      ("ignore", reason)  — bot echo / non-message event
+    """
+    if kind == "slack":
+        if payload.get("type") == "url_verification":
+            return "challenge", {"challenge": payload.get("challenge", "")}
+        if payload.get("type") == "event_callback":
+            ev = payload.get("event") or {}
+            if ev.get("bot_id") or ev.get("subtype") == "bot_message":
+                return "ignore", "bot message"
+            if ev.get("type") in ("app_mention", "message"):
+                return "fire", {
+                    "message": ev.get("text", ""),
+                    "user": ev.get("user", ""),
+                    "channel": ev.get("channel", ""),
+                    "thread": ev.get("thread_ts") or ev.get("ts", ""),
+                    "platform": "slack",
+                }
+        return "ignore", f"unhandled slack type {payload.get('type')}"
+    if kind == "teams":
+        if payload.get("type") != "message":
+            return "ignore", f"unhandled teams type {payload.get('type')}"
+        import re as _re
+
+        # drop <at>bot</at> mentions entirely, then any residual HTML tags
+        text = _re.sub(r"<at>.*?</at>", "", payload.get("text", ""))
+        text = _re.sub(r"<[^>]+>", "", text).strip()
+        frm = payload.get("from") or {}
+        conv = payload.get("conversation") or {}
+        return "fire", {
+            "message": text,
+            "user": frm.get("name") or frm.get("id", ""),
+            "channel": conv.get("id", ""),
+            "thread": payload.get("replyToId", ""),
+            "platform": "teams",
+        }
+    if kind == "discord":
+        if payload.get("type") == 1:   # interaction PING
+            return "challenge", {"type": 1}
+        author = payload.get("author") or {}
+        if author.get("bot"):
+            return "ignore", "bot message"
+        if "content" in payload:
+            return "fire", {
+                "message": payload.get("content", ""),
+                "user": author.get("username", ""),
+                "channel": payload.get("channel_id", ""),
+                "thread": payload.get("id", ""),
+                "platform": "discord",
+            }
+        return "ignore", "no content"
+    # plain webhook: pass through untouched
+    return "fire", payload
+
+
 @dataclasses.dataclass
 class Trigger:
     id: str
@@ -142,6 +205,25 @@ class TriggerManager:
             raise PermissionError("bad webhook secret")
         self._do_fire(t, payload)
         return True
+
+    def handle_platform(self, tid: str, payload: dict, secret: str = ""):
+        """Webhook dispatch with platform payload normalisation.
+
+        Returns one of ("challenge", doc) | ("fired", normalised) |
+        ("ignored", reason) | ("missing", None)."""
+        t = self._triggers.get(tid)
+        if t is None or not t.enabled or t.kind == "cron":
+            return "missing", None
+        verdict, doc = normalize_platform_payload(t.kind, payload)
+        if verdict == "challenge":
+            # handshakes precede secret provisioning on some platforms
+            return "challenge", doc
+        if t.webhook_secret and secret != t.webhook_secret:
+            raise PermissionError("bad webhook secret")
+        if verdict == "ignore":
+            return "ignored", doc
+        self._do_fire(t, doc)
+        return "fired", doc
 
     def _do_fire(self, t: Trigger, payload: dict):
         t.last_fired = time.time()

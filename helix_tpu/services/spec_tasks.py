@@ -429,6 +429,7 @@ class SpecTaskOrchestrator:
         ci: Optional[CIRunner] = None,
         external_git: Optional[ExternalGitSync] = None,
         max_ci_attempts: int = 2,
+        notify: Optional[Callable] = None,
     ):
         self.store = store
         self.git = git
@@ -436,6 +437,8 @@ class SpecTaskOrchestrator:
         self.ci = ci if ci is not None else LocalCIRunner()
         self.external_git = external_git or ExternalGitSync()
         self.max_ci_attempts = max_ci_attempts
+        # notify(kind, title, body, **meta) — email/Slack/Discord fan-out
+        self.notify = notify or (lambda *a, **k: None)
         self.poll_interval = poll_interval
         self.workspace_root = workspace_root or tempfile.mkdtemp(
             prefix="helix-workspaces-"
@@ -495,6 +498,10 @@ class SpecTaskOrchestrator:
         task.status = "failed"
         task.error = err[:2000]
         self.store.update_task(task)
+        self.notify(
+            "task_failed", f"Task failed: {task.title}",
+            task.error[:500], task_id=task.id, project=task.project,
+        )
 
     def _handle_backlog(self, task: SpecTask):
         if not self.git.repo_exists(task.project):
@@ -662,6 +669,10 @@ class SpecTaskOrchestrator:
     def _ci_failed(self, task: SpecTask, pr: dict, log: str) -> None:
         """CINotifier-equivalent: feed the red CI back into the agent loop,
         bounded by max_ci_attempts (``spec_task_orchestrator.go:34-40``)."""
+        self.notify(
+            "ci_failed", f"CI failed: {task.title}",
+            log[-500:], task_id=task.id, pr_id=pr["id"],
+        )
         if task.ci_attempts < self.max_ci_attempts:
             task.ci_attempts += 1
             self.store.add_review(
@@ -695,6 +706,11 @@ class SpecTaskOrchestrator:
             if task:
                 task.status = "done"
                 self.store.update_task(task)
+                self.notify(
+                    "task_done", f"Task done: {task.title}",
+                    f"PR {pr['id']} merged ({sha[:10]})",
+                    task_id=task.id, project=task.project,
+                )
         return {**pr, "status": "merged", "merge_sha": sha}
 
     def pr_diff(self, pr_id: str) -> str:

@@ -1,0 +1,198 @@
+"""Chat-platform trigger adapters + notification fan-out.
+
+Reference parity: api/pkg/trigger/{slack,teams,discord} payload
+normalisation, api/pkg/notification email/Slack/Discord notifiers."""
+
+from helix_tpu.control.notifications import (
+    DiscordWebhookNotifier,
+    NotificationService,
+    SlackWebhookNotifier,
+)
+from helix_tpu.control.triggers import (
+    TriggerManager,
+    normalize_platform_payload,
+)
+
+
+class TestPlatformAdapters:
+    def test_slack_url_verification_challenge(self):
+        verdict, doc = normalize_platform_payload(
+            "slack", {"type": "url_verification", "challenge": "abc123"}
+        )
+        assert verdict == "challenge" and doc == {"challenge": "abc123"}
+
+    def test_slack_app_mention_normalised(self):
+        verdict, doc = normalize_platform_payload(
+            "slack",
+            {
+                "type": "event_callback",
+                "event": {
+                    "type": "app_mention",
+                    "text": "<@U1> deploy please",
+                    "user": "U42",
+                    "channel": "C9",
+                    "ts": "171.001",
+                },
+            },
+        )
+        assert verdict == "fire"
+        assert doc["message"] == "<@U1> deploy please"
+        assert doc["user"] == "U42" and doc["channel"] == "C9"
+        assert doc["platform"] == "slack" and doc["thread"] == "171.001"
+
+    def test_slack_bot_echo_ignored(self):
+        verdict, _ = normalize_platform_payload(
+            "slack",
+            {
+                "type": "event_callback",
+                "event": {"type": "message", "bot_id": "B1", "text": "loop!"},
+            },
+        )
+        assert verdict == "ignore"
+
+    def test_teams_message_html_stripped(self):
+        verdict, doc = normalize_platform_payload(
+            "teams",
+            {
+                "type": "message",
+                "text": "<at>Helix</at> run the report",
+                "from": {"id": "29:x", "name": "Pat"},
+                "conversation": {"id": "19:meeting"},
+            },
+        )
+        assert verdict == "fire"
+        assert doc["message"] == "run the report"
+        assert doc["user"] == "Pat" and doc["platform"] == "teams"
+
+    def test_discord_ping_challenge_and_bot_skip(self):
+        verdict, doc = normalize_platform_payload("discord", {"type": 1})
+        assert verdict == "challenge" and doc == {"type": 1}
+        verdict, _ = normalize_platform_payload(
+            "discord",
+            {"content": "hi", "author": {"username": "helix", "bot": True},
+             "channel_id": "c"},
+        )
+        assert verdict == "ignore"
+        verdict, doc = normalize_platform_payload(
+            "discord",
+            {"content": "hello", "author": {"username": "sam"},
+             "channel_id": "c7", "id": "m1"},
+        )
+        assert verdict == "fire" and doc["platform"] == "discord"
+
+    def test_plain_webhook_passthrough(self):
+        verdict, doc = normalize_platform_payload("webhook", {"x": 1})
+        assert verdict == "fire" and doc == {"x": 1}
+
+
+class TestTriggerPlatformDispatch:
+    def test_slack_trigger_end_to_end(self):
+        fired = []
+        mgr = TriggerManager(lambda t, p: fired.append((t.kind, p)))
+        t = mgr.add(app_id="app1", kind="slack", prompt="You are ops.")
+        # challenge precedes secret enforcement
+        verdict, doc = mgr.handle_platform(
+            t.id, {"type": "url_verification", "challenge": "ch"}, ""
+        )
+        assert verdict == "challenge"
+        # real event with the right secret fires the session
+        verdict, doc = mgr.handle_platform(
+            t.id,
+            {"type": "event_callback",
+             "event": {"type": "message", "text": "hey", "user": "U",
+                       "channel": "C", "ts": "1.0"}},
+            t.webhook_secret,
+        )
+        assert verdict == "fired"
+        assert fired and fired[0][1]["message"] == "hey"
+        # wrong secret still rejected for real events
+        import pytest as _pytest
+
+        with _pytest.raises(PermissionError):
+            mgr.handle_platform(
+                t.id,
+                {"type": "event_callback",
+                 "event": {"type": "message", "text": "x", "ts": "2"}},
+                "wrong",
+            )
+
+
+class TestNotificationService:
+    def test_fanout_with_sink_isolation(self):
+        sent = []
+
+        class Boom:
+            def send(self, n):
+                raise RuntimeError("sink down")
+
+        svc = NotificationService(
+            [Boom(), SlackWebhookNotifier(
+                "http://x", http_post=lambda url, doc: sent.append(doc)
+            )]
+        )
+        n = svc.notify("task_done", "Task done: demo", "merged")
+        svc.flush()
+        assert sent and "Task done: demo" in sent[0]["text"]
+        assert svc.history()[0]["kind"] == "task_done"
+        assert n.title == "Task done: demo"
+
+    def test_discord_truncation(self):
+        sent = []
+        svc = NotificationService(
+            [DiscordWebhookNotifier(
+                "http://x", http_post=lambda url, doc: sent.append(doc)
+            )]
+        )
+        svc.notify("x", "t", "y" * 5000)
+        svc.flush()
+        assert len(sent[0]["content"]) <= 2000
+
+    def test_from_env_builds_configured_sinks(self):
+        svc = NotificationService.from_env(
+            {"HELIX_SLACK_WEBHOOK_URL": "http://slack",
+             "HELIX_DISCORD_WEBHOOK_URL": "http://discord"}
+        )
+        kinds = {type(s).__name__ for s in svc.notifiers}
+        assert kinds == {"SlackWebhookNotifier", "DiscordWebhookNotifier"}
+
+    def test_orchestrator_emits_lifecycle_notifications(self, tmp_path):
+        import os
+
+        from helix_tpu.services.git_service import GitService
+        from helix_tpu.services.spec_tasks import (
+            SpecTaskOrchestrator,
+            TaskStore,
+        )
+
+        class GreenExecutor:
+            def run(self, task, workspace, mode, feedback=""):
+                if mode == "plan":
+                    p = os.path.join(workspace, task.spec_path)
+                    os.makedirs(os.path.dirname(p), exist_ok=True)
+                    open(p, "w").write("# spec\n")
+                else:
+                    open(os.path.join(workspace, "a.py"), "w").write("pass\n")
+                return "ok"
+
+        events = []
+        store = TaskStore()
+        orch = SpecTaskOrchestrator(
+            store, GitService(str(tmp_path / "git")), GreenExecutor(),
+            workspace_root=str(tmp_path / "ws"),
+            notify=lambda kind, title, body="", **meta: events.append(
+                (kind, title)
+            ),
+        )
+        t = store.create_task("proj", "notify me")
+        for _ in range(20):
+            orch.process_once()
+            if store.get_task(t.id).status == "spec_review":
+                break
+        orch.review_spec(t.id, "human", "approve")
+        for _ in range(20):
+            orch.process_once()
+            if store.get_task(t.id).status == "pr_review":
+                break
+        orch.process_once()   # CI 'none'
+        orch.merge_pr(store.get_task(t.id).pr_id)
+        assert ("task_done", "Task done: notify me") in events

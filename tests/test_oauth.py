@@ -1,0 +1,251 @@
+"""OAuth manager + token-backed GitHub skill.
+
+Reference parity: api/pkg/oauth/manager.go (provider registry,
+GetTokenForTool with refresh-if-needed), oauth2.go (authorization-code
+flow), api/pkg/agent/skill/github (repo skill)."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qsl, urlparse
+
+import pytest
+
+from helix_tpu.agent.skills import github_skill
+from helix_tpu.control.auth import Authenticator
+from helix_tpu.control.oauth import (
+    OAuthError,
+    OAuthManager,
+    OAuthProviderConfig,
+)
+
+
+class FakeTokenEndpoint:
+    """Records token-endpoint posts; scripted responses."""
+
+    def __init__(self):
+        self.posts = []
+        self.expires_in = 3600
+        self.counter = 0
+
+    def __call__(self, url, data, headers):
+        self.posts.append({"url": url, "data": dict(data)})
+        self.counter += 1
+        if data.get("grant_type") == "authorization_code":
+            assert data["code"]
+            return {
+                "access_token": f"at-{self.counter}",
+                "refresh_token": f"rt-{self.counter}",
+                "expires_in": self.expires_in,
+                "scope": "repo",
+            }
+        if data.get("grant_type") == "refresh_token":
+            return {
+                "access_token": f"at-{self.counter}",
+                "expires_in": self.expires_in,
+            }
+        return {"error": "unsupported_grant_type"}
+
+
+def _mgr(endpoint, now=None, auth=None):
+    auth = auth or Authenticator()
+    clock = now or (lambda: time.time())
+    m = OAuthManager(
+        encrypt=auth.encrypt, decrypt=auth.decrypt,
+        http_post=endpoint, now=clock,
+    )
+    m.register_provider(
+        OAuthProviderConfig.github("cid", "csecret")
+    )
+    return m
+
+
+class TestOAuthFlow:
+    def test_authorize_exchange_and_get_token(self):
+        ep = FakeTokenEndpoint()
+        m = _mgr(ep)
+        url = m.authorization_url("usr1", "github", "http://cb")
+        q = dict(parse_qsl(urlparse(url).query))
+        assert q["client_id"] == "cid" and q["state"]
+        out = m.complete("the-code", q["state"])
+        assert out == {"user_id": "usr1", "provider": "github"}
+        assert m.get_token("usr1", "github") == "at-1"
+        # metadata listing never exposes the token
+        conns = m.connections("usr1")
+        assert conns[0]["provider"] == "github"
+        assert "at-1" not in json.dumps(conns)
+
+    def test_state_is_single_use_and_validated(self):
+        ep = FakeTokenEndpoint()
+        m = _mgr(ep)
+        url = m.authorization_url("usr1", "github", "http://cb")
+        state = dict(parse_qsl(urlparse(url).query))["state"]
+        m.complete("c", state)
+        with pytest.raises(OAuthError):
+            m.complete("c", state)          # replay
+        with pytest.raises(OAuthError):
+            m.complete("c", "bogus-state")  # forged
+
+    def test_token_refreshes_when_expiring(self):
+        clock = {"t": 1000.0}
+        ep = FakeTokenEndpoint()
+        ep.expires_in = 1000
+        m = _mgr(ep, now=lambda: clock["t"])
+        url = m.authorization_url("u", "github", "cb")
+        state = dict(parse_qsl(urlparse(url).query))["state"]
+        m.complete("c", state)
+        assert m.get_token("u", "github") == "at-1"   # fresh: no refresh
+        clock["t"] += 900   # 100s validity left < 120s skew -> refresh
+        tok = m.get_token("u", "github")
+        assert tok == "at-2"                       # refreshed
+        refresh_post = ep.posts[-1]["data"]
+        assert refresh_post["grant_type"] == "refresh_token"
+        assert refresh_post["refresh_token"] == "rt-1"
+        # rotated refresh token absent from response -> old one retained
+        clock["t"] += 900
+        assert m.get_token("u", "github") == "at-3"
+        assert ep.posts[-1]["data"]["refresh_token"] == "rt-1"
+
+    def test_nonexpiring_token_never_refreshes(self):
+        ep = FakeTokenEndpoint()
+        ep.expires_in = 0   # classic GitHub PAT-style token
+        m = _mgr(ep)
+        url = m.authorization_url("u", "github", "cb")
+        state = dict(parse_qsl(urlparse(url).query))["state"]
+        m.complete("c", state)
+        for _ in range(3):
+            assert m.get_token("u", "github") == "at-1"
+        assert len(ep.posts) == 1   # only the exchange
+
+    def test_tokens_encrypted_at_rest(self, tmp_path):
+        auth = Authenticator()
+        ep = FakeTokenEndpoint()
+        db = str(tmp_path / "oauth.db")
+        m = OAuthManager(
+            db, encrypt=auth.encrypt, decrypt=auth.decrypt, http_post=ep
+        )
+        m.register_provider(OAuthProviderConfig.github("cid", "cs"))
+        url = m.authorization_url("u", "github", "cb")
+        state = dict(parse_qsl(urlparse(url).query))["state"]
+        m.complete("c", state)
+        raw = open(db, "rb").read()
+        assert b"at-1" not in raw and b"rt-1" not in raw
+
+    def test_missing_connection_is_clean_error(self):
+        m = _mgr(FakeTokenEndpoint())
+        with pytest.raises(OAuthError, match="no github connection"):
+            m.get_token("stranger", "github")
+
+
+class _GitHubStub(BaseHTTPRequestHandler):
+    seen = []
+
+    def _reply(self, doc, status=200):
+        body = json.dumps(doc).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        _GitHubStub.seen.append(
+            (self.command, self.path, self.headers.get("Authorization"))
+        )
+        if self.path.startswith("/user/repos"):
+            return self._reply([{"full_name": "acme/widget"}])
+        if "/pulls/" in self.path:
+            return self._reply(
+                {"number": 7, "title": "fix", "state": "open",
+                 "merged": False, "head": {}, "base": {}, "body": ""}
+            )
+        return self._reply({"message": "not found"}, 404)
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        payload = json.loads(self.rfile.read(length) or b"{}")
+        _GitHubStub.seen.append(
+            (self.command, self.path, self.headers.get("Authorization"),
+             payload)
+        )
+        if self.path.endswith("/issues"):
+            return self._reply(
+                {"number": 42, "html_url": "http://gh/i/42"}, 200
+            )
+        return self._reply({"message": "nope"}, 404)
+
+    def log_message(self, *a):  # silence
+        pass
+
+
+class TestGitHubSkill:
+    def test_skill_calls_api_with_refreshed_token(self):
+        srv = HTTPServer(("127.0.0.1", 0), _GitHubStub)
+        port = srv.server_address[1]
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            tokens = iter(["tok-A", "tok-A", "tok-B"])
+            skill = github_skill(
+                lambda: next(tokens), api_base=f"http://127.0.0.1:{port}"
+            )
+            out = skill.handler(action="list_repos")
+            assert "acme/widget" in out
+            out = skill.handler(action="get_pr", repo="acme/widget",
+                                number=7)
+            assert json.loads(out)["number"] == 7
+            out = skill.handler(action="create_issue", repo="acme/widget",
+                                title="t", body="b")
+            assert "issue #42" in out
+            auths = [s[2] for s in _GitHubStub.seen]
+            assert auths[0] == "Bearer tok-A"
+            assert auths[-1] == "Bearer tok-B"   # re-resolved per call
+        finally:
+            srv.shutdown()
+
+
+class TestControlPlaneOAuthSurface:
+    def test_http_roundtrip(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from helix_tpu.control.server import ControlPlane
+
+        async def main():
+            cp = ControlPlane()
+            ep = FakeTokenEndpoint()
+            cp.oauth.http_post = ep
+            cp.oauth.register_provider(
+                OAuthProviderConfig.github("cid", "cs")
+            )
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                r = await client.get("/api/v1/oauth/providers")
+                assert (await r.json())["providers"][0]["name"] == "github"
+                r = await client.get(
+                    "/api/v1/oauth/connect/github?owner=u1"
+                )
+                url = (await r.json())["url"]
+                state = dict(
+                    parse_qsl(urlparse(url).query)
+                )["state"]
+                r = await client.get(
+                    f"/api/v1/oauth/callback?code=c&state={state}"
+                )
+                assert (await r.json())["ok"]
+                r = await client.get("/api/v1/oauth/connections?owner=u1")
+                conns = (await r.json())["connections"]
+                assert conns and conns[0]["provider"] == "github"
+                r = await client.delete(
+                    "/api/v1/oauth/connections/github?owner=u1"
+                )
+                assert r.status == 200
+            finally:
+                await client.close()
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                cp.triggers.stop()
+
+        asyncio.run(main())
