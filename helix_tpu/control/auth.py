@@ -310,6 +310,21 @@ class Authenticator:
             return ROLES.index(role) <= ROLES.index(min_role)
         return False
 
+    def set_admin(self, uid: str, admin: bool) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE users SET admin=? WHERE id=?", (int(admin), uid)
+            )
+            self._conn.commit()
+
+    def get_or_create_by_email(self, email: str, name: str = "") -> User:
+        """OIDC auto-provisioning: a verified identity maps to a local
+        user row keyed by email (``api/pkg/auth/oidc.go``)."""
+        u = self.get_user(email)
+        if u is not None:
+            return u
+        return self.create_user(email=email, name=name)
+
     # -- envelope encryption (shared with the OAuth token store) ----------
     def encrypt(self, data: bytes) -> bytes:
         return self._fernet.encrypt(data)

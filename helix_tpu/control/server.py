@@ -119,7 +119,32 @@ class ControlPlane:
                 OAuthProviderConfig.github(gh_id, gh_secret)
             )
 
-        self.knowledge = KnowledgeManager(self.vectors, embed_fn).start()
+        # OIDC bearer auth (reference: api/pkg/auth/oidc.go) — enabled
+        # when an issuer is configured; verified JWTs auto-provision users
+        self.oidc = None
+        # emails granted platform admin on OIDC provision — without this a
+        # pure-OIDC deployment could never mint an admin
+        self.oidc_admin_emails = {
+            e.strip()
+            for e in _os_oauth.environ.get(
+                "HELIX_OIDC_ADMIN_EMAILS", ""
+            ).split(",")
+            if e.strip()
+        }
+        issuer = _os_oauth.environ.get("HELIX_OIDC_ISSUER", "")
+        if issuer:
+            from helix_tpu.control.auth_oidc import OIDCVerifier
+
+            self.oidc = OIDCVerifier(
+                issuer,
+                _os_oauth.environ.get("HELIX_OIDC_CLIENT_ID", "helix"),
+            )
+
+        from helix_tpu.knowledge.crawler import default_fetch
+
+        self.knowledge = KnowledgeManager(
+            self.vectors, embed_fn, fetch_fn=default_fetch
+        ).start()
         self.controller = SessionController(
             self.store, self.providers, self.knowledge,
             secrets=self.auth, billing=self.billing, oauth=self.oauth,
@@ -346,7 +371,44 @@ class ControlPlane:
         the heartbeat/assignment-poll endpoints (reference: runner router
         shared token); webhook + signed-URL endpoints carry their own
         secrets and stay open."""
-        user = self.auth.authenticate(request.headers.get("Authorization"))
+        bearer = request.headers.get("Authorization")
+        user = self.auth.authenticate(bearer)
+        if user is None and self.oidc is not None and bearer:
+            token = (
+                bearer.split(" ", 1)[1]
+                if bearer.lower().startswith("bearer ")
+                else bearer
+            )
+            if token.count(".") == 2:   # JWT-shaped: try OIDC
+                try:
+                    claims = await __import__(
+                        "asyncio"
+                    ).get_running_loop().run_in_executor(
+                        None, self.oidc.verify, token
+                    )
+                    # never map an unverified email onto a local account
+                    # (account-linking takeover); fall back to sub
+                    email = (
+                        claims.get("email")
+                        if claims.get("email_verified", True) is not False
+                        else None
+                    )
+                    ident = email or claims.get("sub", "")
+                    if ident:
+                        user = self.auth.get_or_create_by_email(
+                            ident, claims.get("name", "")
+                        )
+                        if (
+                            email
+                            and email in self.oidc_admin_emails
+                            and not user.admin
+                        ):
+                            self.auth.set_admin(user.id, True)
+                            user = self.auth.get_user(user.id)
+                except Exception:  # noqa: BLE001 — IdP failure => 401,
+                    # never a 500 (attackers can trigger this path with
+                    # unauthenticated garbage JWTs)
+                    user = None
         request["user"] = user
         if not self.auth_required or user is not None:
             return await handler(request)
@@ -767,6 +829,8 @@ class ControlPlane:
             text=body.get("text"),
             path=body.get("path"),
             urls=tuple(body.get("urls", [])),
+            crawl_depth=min(int(body.get("crawl_depth", 0)), 5),
+            max_pages=min(int(body.get("max_pages", 50)), 500),
             chunk_size=int(body.get("chunk_size", 1000)),
             chunk_overlap=int(body.get("chunk_overlap", 100)),
         )

@@ -1,0 +1,182 @@
+"""Web crawler knowledge source: BFS fetch + readable-text extraction.
+
+The counterpart of the reference's browser-pool crawler
+(``api/pkg/controller/knowledge/`` — Chrome/rod + readability, wired at
+``api/cmd/helix/serve.go:375-382``), rebuilt without a browser: static
+fetch, HTML link extraction, same-domain BFS with page/depth budgets, and
+robots.txt respect.  The fetch function is injected (requests-based
+default) so zero-egress deployments and tests run against local servers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import html.parser
+import urllib.parse
+import urllib.robotparser
+from typing import Callable, Optional
+
+from helix_tpu.knowledge.splitter import extract_text
+
+
+@dataclasses.dataclass
+class CrawlSpec:
+    seeds: tuple
+    max_pages: int = 50
+    max_depth: int = 2
+    same_domain: bool = True
+    respect_robots: bool = True
+
+
+class _LinkParser(html.parser.HTMLParser):
+    def __init__(self):
+        super().__init__()
+        self.links: list[str] = []
+        self.title = ""
+        self._in_title = False
+
+    def handle_starttag(self, tag, attrs):
+        if tag == "a":
+            for k, v in attrs:
+                if k == "href" and v:
+                    self.links.append(v)
+        elif tag == "title":
+            self._in_title = True
+
+    def handle_endtag(self, tag):
+        if tag == "title":
+            self._in_title = False
+
+    def handle_data(self, data):
+        if self._in_title:
+            self.title += data
+
+
+def _host_is_private(host: str) -> bool:
+    import ipaddress
+    import socket
+
+    try:
+        infos = socket.getaddrinfo(host, None)
+    except socket.gaierror:
+        return True   # unresolvable: treat as forbidden
+    for info in infos:
+        addr = ipaddress.ip_address(info[4][0])
+        if (
+            addr.is_private
+            or addr.is_loopback
+            or addr.is_link_local
+            or addr.is_reserved
+            or addr.is_multicast
+        ):
+            return True
+    return False
+
+
+def default_fetch(url: str, timeout: float = 15.0) -> tuple:
+    """-> (content, content_type).  Used when the deployment has egress.
+
+    SSRF guard: refuses private/link-local/loopback targets (user-supplied
+    URLs must not read cloud metadata or internal services), following
+    redirects hop-by-hop so a public URL can't bounce inside.  Set
+    HELIX_CRAWLER_ALLOW_PRIVATE=1 to crawl intranet docs deliberately.
+    """
+    import os
+
+    import requests
+
+    allow_private = os.environ.get("HELIX_CRAWLER_ALLOW_PRIVATE") == "1"
+    for _ in range(5):   # bounded redirect chain
+        parts = urllib.parse.urlsplit(url)
+        if parts.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported scheme {parts.scheme!r}")
+        if not allow_private and _host_is_private(parts.hostname or ""):
+            raise PermissionError(f"refusing private address {url}")
+        r = requests.get(
+            url, timeout=timeout, allow_redirects=False,
+            headers={"User-Agent": "helix-tpu-crawler/1.0"},
+        )
+        if r.status_code in (301, 302, 303, 307, 308):
+            url = urllib.parse.urljoin(url, r.headers.get("Location", ""))
+            continue
+        r.raise_for_status()
+        return r.text, r.headers.get("Content-Type", "text/html")
+    raise ValueError("too many redirects")
+
+
+class Crawler:
+    def __init__(self, fetch: Optional[Callable] = None):
+        self.fetch = fetch or default_fetch
+        self._robots: dict[str, urllib.robotparser.RobotFileParser] = {}
+
+    # ------------------------------------------------------------------
+    def _allowed(self, url: str, spec: CrawlSpec) -> bool:
+        if not spec.respect_robots:
+            return True
+        parts = urllib.parse.urlsplit(url)
+        origin = f"{parts.scheme}://{parts.netloc}"
+        rp = self._robots.get(origin)
+        if rp is None:
+            rp = urllib.robotparser.RobotFileParser()
+            try:
+                content, _ = self.fetch(f"{origin}/robots.txt")
+                rp.parse(content.splitlines())
+            except Exception:  # noqa: BLE001 — no robots file: allow all
+                rp.parse([])
+            self._robots[origin] = rp
+        return rp.can_fetch("helix-tpu-crawler", url)
+
+    @staticmethod
+    def _normalise(base: str, href: str) -> Optional[str]:
+        href = href.split("#", 1)[0].strip()
+        if not href or href.startswith(("mailto:", "javascript:", "tel:")):
+            return None
+        absu = urllib.parse.urljoin(base, href)
+        if not absu.startswith(("http://", "https://")):
+            return None
+        return absu
+
+    # ------------------------------------------------------------------
+    def crawl(self, spec: CrawlSpec) -> list:
+        """BFS from the seeds.  Returns [(url, title, text)]."""
+        seed_domains = {
+            urllib.parse.urlsplit(s).netloc for s in spec.seeds
+        }
+        queue: list[tuple[str, int]] = [(s, 0) for s in spec.seeds]
+        seen: set[str] = set(spec.seeds)
+        out = []
+        while queue and len(out) < spec.max_pages:
+            url, depth = queue.pop(0)
+            if not self._allowed(url, spec):
+                continue
+            try:
+                content, ctype = self.fetch(url)
+            except Exception:  # noqa: BLE001 — dead link: skip
+                continue
+            is_html = "html" in (ctype or "").lower()
+            title, links = "", []
+            if is_html:
+                parser = _LinkParser()
+                try:
+                    parser.feed(content)
+                except Exception:  # noqa: BLE001 — malformed markup
+                    pass
+                title = parser.title.strip()
+                links = parser.links
+            text = extract_text(content, ctype or "text/html")
+            if text.strip():
+                out.append((url, title, text))
+            if depth >= spec.max_depth:
+                continue
+            for href in links:
+                nxt = self._normalise(url, href)
+                if nxt is None or nxt in seen:
+                    continue
+                if (
+                    spec.same_domain
+                    and urllib.parse.urlsplit(nxt).netloc not in seed_domains
+                ):
+                    continue
+                seen.add(nxt)
+                queue.append((nxt, depth + 1))
+        return out

@@ -31,7 +31,9 @@ class KnowledgeSpec:
     # sources
     text: Optional[str] = None          # inline content
     path: Optional[str] = None          # file or directory
-    urls: tuple = ()                    # crawl targets (needs a fetcher)
+    urls: tuple = ()                    # single pages, or crawl seeds
+    crawl_depth: int = 0                # >0: BFS-crawl from urls
+    max_pages: int = 50                 # crawl page budget
     # chunking
     chunk_size: int = 1000
     chunk_overlap: int = 100
@@ -121,13 +123,33 @@ class KnowledgeManager:
                 docs.append(
                     (extract_text(content, ctype), {"source": p})
                 )
-        for url in spec.urls:
+        if spec.urls and spec.crawl_depth > 0:
+            # web-crawl source (reference: the knowledge crawler's
+            # browser-pool + readability path)
             if self.fetch is None:
                 raise RuntimeError(
                     "url sources need a fetcher (no egress in this node?)"
                 )
-            content, ctype = self.fetch(url)
-            docs.append((extract_text(content, ctype), {"source": url}))
+            from helix_tpu.knowledge.crawler import Crawler, CrawlSpec
+
+            crawler = Crawler(fetch=self.fetch)
+            pages = crawler.crawl(
+                CrawlSpec(
+                    seeds=tuple(spec.urls),
+                    max_pages=spec.max_pages,
+                    max_depth=spec.crawl_depth,
+                )
+            )
+            for url, title, text in pages:
+                docs.append((text, {"source": url, "title": title}))
+        elif spec.urls:
+            if self.fetch is None:
+                raise RuntimeError(
+                    "url sources need a fetcher (no egress in this node?)"
+                )
+            for url in spec.urls:
+                content, ctype = self.fetch(url)
+                docs.append((extract_text(content, ctype), {"source": url}))
         return docs
 
     def index(self, kid: str) -> KnowledgeSpec:

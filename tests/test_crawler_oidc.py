@@ -1,0 +1,276 @@
+"""Web-crawler knowledge source + OIDC bearer auth.
+
+Reference parity: api/pkg/controller/knowledge (crawler + readability),
+api/pkg/auth/oidc.go."""
+
+import base64
+import json
+import time
+
+import pytest
+
+from helix_tpu.control.auth_oidc import OIDCError, OIDCVerifier
+from helix_tpu.knowledge.crawler import Crawler, CrawlSpec
+
+
+def _site(pages: dict):
+    """fetch(url) backed by an in-memory site; counts fetches."""
+    hits = []
+
+    def fetch(url):
+        hits.append(url)
+        if url not in pages:
+            raise FileNotFoundError(url)
+        return pages[url], "text/html"
+
+    return fetch, hits
+
+
+SITE = {
+    "http://docs.local/robots.txt": "User-agent: *\nDisallow: /private/\n",
+    "http://docs.local/": (
+        "<html><head><title>Home</title></head><body>"
+        "<p>Welcome to the docs.</p>"
+        '<a href="/guide">guide</a> <a href="/private/secret">s</a>'
+        '<a href="http://other.site/page">offsite</a>'
+        '<a href="mailto:x@y">mail</a></body></html>'
+    ),
+    "http://docs.local/guide": (
+        "<html><head><title>Guide</title></head><body>"
+        "<p>The guide explains paged attention.</p>"
+        '<a href="/guide/deep">deeper</a></body></html>'
+    ),
+    "http://docs.local/guide/deep": (
+        "<html><body><p>Deep page about ring attention.</p>"
+        '<a href="/guide/deeper-still">more</a></body></html>'
+    ),
+    "http://docs.local/guide/deeper-still": (
+        "<html><body><p>Too deep to reach at depth 2.</p></body></html>"
+    ),
+    "http://docs.local/private/secret": (
+        "<html><body><p>robots.txt forbids this.</p></body></html>"
+    ),
+    "http://other.site/page": "<html><body><p>offsite</p></body></html>",
+}
+
+
+class TestCrawler:
+    def test_bfs_depth_domain_and_robots(self):
+        fetch, hits = _site(SITE)
+        pages = Crawler(fetch=fetch).crawl(
+            CrawlSpec(seeds=("http://docs.local/",), max_depth=2)
+        )
+        urls = [u for u, _, _ in pages]
+        assert "http://docs.local/" in urls
+        assert "http://docs.local/guide" in urls
+        assert "http://docs.local/guide/deep" in urls          # depth 2
+        assert "http://docs.local/guide/deeper-still" not in urls  # depth 3
+        assert "http://docs.local/private/secret" not in urls  # robots
+        assert "http://other.site/page" not in urls            # offsite
+        titles = {u: t for u, t, _ in pages}
+        assert titles["http://docs.local/guide"] == "Guide"
+        text = dict((u, x) for u, _, x in pages)[
+            "http://docs.local/guide"
+        ]
+        assert "paged attention" in text and "<p>" not in text
+
+    def test_page_budget(self):
+        fetch, _ = _site(SITE)
+        pages = Crawler(fetch=fetch).crawl(
+            CrawlSpec(seeds=("http://docs.local/",), max_depth=5,
+                      max_pages=2)
+        )
+        assert len(pages) == 2
+
+    def test_robots_disabled(self):
+        fetch, _ = _site(SITE)
+        pages = Crawler(fetch=fetch).crawl(
+            CrawlSpec(seeds=("http://docs.local/",), max_depth=1,
+                      respect_robots=False)
+        )
+        assert "http://docs.local/private/secret" in [
+            u for u, _, _ in pages
+        ]
+
+    def test_knowledge_crawl_source_end_to_end(self):
+        from helix_tpu.knowledge.embed import HashEmbedder
+        from helix_tpu.knowledge.ingest import KnowledgeManager, KnowledgeSpec
+        from helix_tpu.knowledge.vector_store import VectorStore
+
+        fetch, _ = _site(SITE)
+        km = KnowledgeManager(VectorStore(), HashEmbedder(), fetch_fn=fetch)
+        km.add(
+            KnowledgeSpec(
+                id="kno_site", name="docs", urls=("http://docs.local/",),
+                crawl_depth=2,
+            )
+        )
+        spec = km.index("kno_site")
+        assert spec.state == "ready", spec.error
+        results = km.query("kno_site", "ring attention", top_k=3)
+        assert any("ring attention" in r["text"] for r in results)
+
+
+# ---------------------------------------------------------------------------
+# OIDC
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def oidc_env():
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import padding, rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pub = key.public_key().public_numbers()
+
+    def b64url_uint(n):
+        raw = n.to_bytes((n.bit_length() + 7) // 8, "big")
+        return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+    issuer = "https://idp.local"
+    docs = {
+        f"{issuer}/.well-known/openid-configuration": {
+            "issuer": issuer,
+            "jwks_uri": f"{issuer}/jwks",
+        },
+        f"{issuer}/jwks": {
+            "keys": [
+                {"kty": "RSA", "kid": "k1", "alg": "RS256",
+                 "n": b64url_uint(pub.n), "e": b64url_uint(pub.e)}
+            ]
+        },
+    }
+
+    def mint(claims, kid="k1"):
+        header = {"alg": "RS256", "typ": "JWT", "kid": kid}
+
+        def enc(d):
+            return base64.urlsafe_b64encode(
+                json.dumps(d).encode()
+            ).rstrip(b"=").decode()
+
+        signing = f"{enc(header)}.{enc(claims)}"
+        sig = key.sign(
+            signing.encode(), padding.PKCS1v15(), hashes.SHA256()
+        )
+        return (
+            signing + "."
+            + base64.urlsafe_b64encode(sig).rstrip(b"=").decode()
+        )
+
+    return issuer, docs, mint
+
+
+class TestOIDC:
+    def _verifier(self, oidc_env, now=None):
+        issuer, docs, _ = oidc_env
+        return OIDCVerifier(
+            issuer, "helix-client", http_get=lambda url: docs[url],
+            now=now or time.time,
+        )
+
+    def test_valid_token_verifies(self, oidc_env):
+        issuer, docs, mint = oidc_env
+        v = self._verifier(oidc_env)
+        tok = mint({
+            "iss": issuer, "aud": "helix-client", "sub": "u123",
+            "email": "pat@example.com", "exp": time.time() + 600,
+        })
+        claims = v.verify(tok)
+        assert claims["email"] == "pat@example.com"
+
+    def test_rejections(self, oidc_env):
+        issuer, docs, mint = oidc_env
+        v = self._verifier(oidc_env)
+        good = {
+            "iss": issuer, "aud": "helix-client", "sub": "u",
+            "exp": time.time() + 600,
+        }
+        with pytest.raises(OIDCError, match="expired"):
+            v.verify(mint({**good, "exp": time.time() - 600}))
+        with pytest.raises(OIDCError, match="audience"):
+            v.verify(mint({**good, "aud": "someone-else"}))
+        with pytest.raises(OIDCError, match="issuer"):
+            v.verify(mint({**good, "iss": "https://evil.local"}))
+        with pytest.raises(OIDCError, match="signing key"):
+            v.verify(mint(good, kid="unknown"))
+        # tampered payload: signature breaks
+        tok = mint(good)
+        h, p, s = tok.split(".")
+        evil = base64.urlsafe_b64encode(
+            json.dumps({**good, "email": "admin@x"}).encode()
+        ).rstrip(b"=").decode()
+        with pytest.raises(OIDCError, match="signature"):
+            v.verify(f"{h}.{evil}.{s}")
+        with pytest.raises(OIDCError, match="malformed"):
+            v.verify("not-a-jwt")
+
+    def test_middleware_auto_provisions_user(self, oidc_env):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from helix_tpu.control.server import ControlPlane
+
+        issuer, docs, mint = oidc_env
+
+        async def main():
+            cp = ControlPlane(auth_required=True)
+            cp.oidc = OIDCVerifier(
+                issuer, "helix-client", http_get=lambda url: docs[url]
+            )
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                tok = mint({
+                    "iss": issuer, "aud": "helix-client", "sub": "u9",
+                    "email": "dev@example.com", "name": "Dev",
+                    "exp": time.time() + 600,
+                })
+                r = await client.get(
+                    "/v1/models",
+                    headers={"Authorization": f"Bearer {tok}"},
+                )
+                assert r.status == 200
+                u = cp.auth.get_user("dev@example.com")
+                assert u is not None and u.name == "Dev"
+                # bad JWT still 401s
+                r = await client.get(
+                    "/v1/models",
+                    headers={"Authorization": "Bearer a.b.c"},
+                )
+                assert r.status == 401
+            finally:
+                await client.close()
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                cp.triggers.stop()
+
+        asyncio.run(main())
+
+
+class TestSSRFGuard:
+    def test_private_targets_refused(self, monkeypatch):
+        from helix_tpu.knowledge.crawler import default_fetch
+
+        monkeypatch.delenv("HELIX_CRAWLER_ALLOW_PRIVATE", raising=False)
+        for url in (
+            "http://169.254.169.254/latest/meta-data/",
+            "http://127.0.0.1:8080/admin",
+            "http://localhost/x",
+            "ftp://files.example.com/x",
+        ):
+            with pytest.raises((PermissionError, ValueError)):
+                default_fetch(url)
+
+    def test_crawl_without_fetcher_errors_cleanly(self):
+        from helix_tpu.knowledge.embed import HashEmbedder
+        from helix_tpu.knowledge.ingest import KnowledgeManager, KnowledgeSpec
+        from helix_tpu.knowledge.vector_store import VectorStore
+
+        km = KnowledgeManager(VectorStore(), HashEmbedder())  # no fetcher
+        km.add(KnowledgeSpec(id="k", urls=("http://x/",), crawl_depth=1))
+        spec = km.index("k")
+        assert spec.state == "error"
+        assert "fetcher" in spec.error
