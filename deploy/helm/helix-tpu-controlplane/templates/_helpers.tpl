@@ -1,0 +1,3 @@
+{{- define "helix-tpu-cp.fullname" -}}
+{{- printf "%s-%s" .Release.Name "helix-tpu-cp" | trunc 63 | trimSuffix "-" -}}
+{{- end -}}

@@ -1,0 +1,3 @@
+{{- define "helix-tpu-node.fullname" -}}
+{{- printf "%s-%s" .Release.Name "helix-tpu-node" | trunc 63 | trimSuffix "-" -}}
+{{- end -}}

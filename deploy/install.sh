@@ -1,0 +1,78 @@
+#!/bin/sh
+# Single-node helix-tpu install (the reference's install.sh analogue):
+# control plane + one serving node on this host, run under a python venv.
+#
+# Usage:
+#   sh deploy/install.sh [--dir /opt/helix-tpu] [--port 8080] \
+#       [--node-port 8000] [--profile profiles/dev-tiny.yaml] [--tpu]
+#
+# --tpu installs the libtpu-enabled jax build (run on a TPU VM);
+# without it the node serves on CPU (dev/smoke).
+
+set -eu
+
+DIR=/opt/helix-tpu
+PORT=8080
+NODE_PORT=8000
+PROFILE=""
+TPU=0
+
+while [ $# -gt 0 ]; do
+  case "$1" in
+    --dir) DIR="$2"; shift 2 ;;
+    --port) PORT="$2"; shift 2 ;;
+    --node-port) NODE_PORT="$2"; shift 2 ;;
+    --profile) PROFILE="$2"; shift 2 ;;
+    --tpu) TPU=1; shift ;;
+    *) echo "unknown flag $1" >&2; exit 2 ;;
+  esac
+done
+
+SRC=$(CDPATH= cd -- "$(dirname -- "$0")/.." && pwd)
+
+echo "==> installing helix-tpu into $DIR"
+mkdir -p "$DIR"
+python3 -m venv "$DIR/venv"
+# shellcheck disable=SC1091
+. "$DIR/venv/bin/activate"
+pip install --quiet --upgrade pip
+if [ "$TPU" = 1 ]; then
+  pip install --quiet 'jax[tpu]' \
+    -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
+else
+  pip install --quiet jax
+fi
+pip install --quiet flax optax orbax-checkpoint chex einops numpy \
+  aiohttp requests pyyaml cryptography safetensors
+
+cp -r "$SRC/helix_tpu" "$SRC/profiles" "$DIR/"
+export PYTHONPATH="$DIR"
+
+RUNNER_TOKEN=$(python3 -c "import secrets; print(secrets.token_urlsafe(24))")
+export HELIX_RUNNER_TOKEN="$RUNNER_TOKEN"
+echo "$RUNNER_TOKEN" > "$DIR/runner-token"
+chmod 600 "$DIR/runner-token"
+
+echo "==> starting control plane on :$PORT"
+nohup "$DIR/venv/bin/python" -m helix_tpu serve \
+  --port "$PORT" --db "$DIR/helix.db" --sandbox-agents \
+  > "$DIR/controlplane.log" 2>&1 &
+echo $! > "$DIR/controlplane.pid"
+
+sleep 2
+echo "==> starting serving node on :$NODE_PORT"
+set -- --runner-id "$(hostname)-node" \
+  --control-plane "http://127.0.0.1:$PORT" --port "$NODE_PORT" \
+  --advertise "http://127.0.0.1:$NODE_PORT"
+[ -n "$PROFILE" ] && set -- "$@" --profile "$PROFILE"
+nohup "$DIR/venv/bin/python" -m helix_tpu serve-node "$@" \
+  > "$DIR/node.log" 2>&1 &
+echo $! > "$DIR/node.pid"
+
+sleep 2
+echo "==> bootstrap the first admin:"
+echo "    curl -s -X POST http://127.0.0.1:$PORT/api/v1/users \\"
+echo "      -d '{\"email\": \"you@example.com\", \"admin\": true}'"
+echo "==> UI:   http://127.0.0.1:$PORT/"
+echo "==> API:  http://127.0.0.1:$PORT/v1/chat/completions"
+echo "==> logs: $DIR/controlplane.log  $DIR/node.log"

@@ -166,6 +166,118 @@ def _cmd_apply(args) -> int:
     return 0
 
 
+def _api(args, method: str, path: str, **kw):
+    """Authenticated control-plane call shared by the admin verbs
+    (reference: the cobra CLI's API client, ``api/pkg/cli/``)."""
+    import os
+
+    import requests
+
+    key = getattr(args, "api_key", None) or os.environ.get(
+        "HELIX_API_KEY", ""
+    )
+    headers = kw.pop("headers", {})
+    if key:
+        headers["Authorization"] = f"Bearer {key}"
+    r = requests.request(
+        method, f"{args.url}{path}", headers=headers, timeout=60, **kw
+    )
+    if r.status_code >= 400:
+        print(r.text, file=sys.stderr)
+        raise SystemExit(1)
+    return r.json()
+
+
+def _cmd_org(args) -> int:
+    if args.action == "create":
+        doc = _api(args, "POST", "/api/v1/orgs", json={"name": args.name})
+        print(f"created org {doc['id']}")
+    elif args.action == "list":
+        for o in _api(args, "GET", "/api/v1/orgs")["orgs"]:
+            print(f"{o['id']}\t{o['name']}")
+    elif args.action == "add-member":
+        _api(
+            args, "POST", f"/api/v1/orgs/{args.org}/members",
+            json={"user_id": args.user, "role": args.role},
+        )
+        print(f"added {args.user} to {args.org} as {args.role}")
+    elif args.action == "members":
+        for m in _api(
+            args, "GET", f"/api/v1/orgs/{args.org}/members"
+        )["members"]:
+            print(f"{m['user_id']}\t{m['role']}")
+    return 0
+
+
+def _cmd_knowledge(args) -> int:
+    if args.action == "list":
+        for k in _api(args, "GET", "/api/v1/knowledge")["knowledge"]:
+            print(f"{k['id']}\t{k['state']}\tv{k['version']}\t{k['name']}")
+    elif args.action == "create":
+        body = {"name": args.name}
+        if args.path:
+            body["path"] = args.path
+        if args.urls:
+            body["urls"] = args.urls
+            if args.crawl_depth:
+                body["crawl_depth"] = args.crawl_depth
+        doc = _api(args, "POST", "/api/v1/knowledge", json=body)
+        print(f"created knowledge {doc['id']} ({doc['state']})")
+    elif args.action == "search":
+        doc = _api(
+            args, "POST", f"/api/v1/knowledge/{args.id}/search",
+            json={"query": args.query, "top_k": args.top_k},
+        )
+        for r in doc["results"]:
+            print(f"[{r['score']:.3f}] {r['text'][:120]}")
+    elif args.action == "refresh":
+        _api(args, "POST", f"/api/v1/knowledge/{args.id}/refresh")
+        print("refresh queued")
+    elif args.action == "delete":
+        _api(args, "DELETE", f"/api/v1/knowledge/{args.id}")
+        print("deleted")
+    return 0
+
+
+def _cmd_secret(args) -> int:
+    if args.action == "set":
+        value = args.value
+        if value is None:
+            import getpass
+
+            value = getpass.getpass(f"value for {args.name}: ")
+        _api(
+            args, "POST", "/api/v1/secrets",
+            json={"name": args.name, "value": value},
+        )
+        print(f"secret {args.name} stored")
+    elif args.action == "list":
+        for s in _api(args, "GET", "/api/v1/secrets")["secrets"]:
+            print(s["name"])
+    elif args.action == "delete":
+        _api(args, "DELETE", f"/api/v1/secrets/{args.name}")
+        print("deleted")
+    return 0
+
+
+def _cmd_runner(args) -> int:
+    if args.action == "list":
+        for r in _api(args, "GET", "/api/v1/runners")["runners"]:
+            models = ",".join(r["models"]) or "-"
+            print(
+                f"{r['id']}\t{r['profile_name'] or '-'}\t"
+                f"{r['profile_status']}\t{models}"
+            )
+    elif args.action == "logs":
+        doc = _api(
+            args, "GET",
+            f"/api/v1/runners/{args.id}/logs?tail={args.tail}",
+        )
+        for entry in doc["logs"]:
+            print(entry["line"])
+    return 0
+
+
 def _cmd_chat(args) -> int:
     import requests
 
@@ -322,6 +434,63 @@ def main(argv=None) -> int:
     c.add_argument("--max-tokens", type=int, default=256)
     c.add_argument("--temperature", type=float, default=0.0)
     c.set_defaults(fn=_cmd_chat)
+
+    # shared --url/--api-key live on every ACTION subparser (parents=)
+    # so the natural `helix org list --url ...` order works
+    api_flags = argparse.ArgumentParser(add_help=False)
+    api_flags.add_argument("--url", default="http://127.0.0.1:8080")
+    api_flags.add_argument(
+        "--api-key", help="bearer key (or HELIX_API_KEY)"
+    )
+
+    o = sub.add_parser("org", help="org administration")
+    osub = o.add_subparsers(dest="action", required=True)
+    oc = osub.add_parser("create", parents=[api_flags])
+    oc.add_argument("name")
+    osub.add_parser("list", parents=[api_flags])
+    om = osub.add_parser("add-member", parents=[api_flags])
+    om.add_argument("org")
+    om.add_argument("user")
+    om.add_argument("--role", default="member")
+    ol = osub.add_parser("members", parents=[api_flags])
+    ol.add_argument("org")
+    o.set_defaults(fn=_cmd_org)
+
+    k = sub.add_parser("knowledge", help="knowledge sources")
+    ksub = k.add_subparsers(dest="action", required=True)
+    ksub.add_parser("list", parents=[api_flags])
+    kc = ksub.add_parser("create", parents=[api_flags])
+    kc.add_argument("name")
+    kc.add_argument("--path")
+    kc.add_argument("--urls", nargs="*")
+    kc.add_argument("--crawl-depth", type=int, default=0)
+    ks = ksub.add_parser("search", parents=[api_flags])
+    ks.add_argument("id")
+    ks.add_argument("query")
+    ks.add_argument("--top-k", type=int, default=5)
+    kr = ksub.add_parser("refresh", parents=[api_flags])
+    kr.add_argument("id")
+    kd = ksub.add_parser("delete", parents=[api_flags])
+    kd.add_argument("id")
+    k.set_defaults(fn=_cmd_knowledge)
+
+    se = sub.add_parser("secret", help="user secrets")
+    sesub = se.add_subparsers(dest="action", required=True)
+    ss = sesub.add_parser("set", parents=[api_flags])
+    ss.add_argument("name")
+    ss.add_argument("value", nargs="?")
+    sesub.add_parser("list", parents=[api_flags])
+    sd = sesub.add_parser("delete", parents=[api_flags])
+    sd.add_argument("name")
+    se.set_defaults(fn=_cmd_secret)
+
+    ru = sub.add_parser("runner", help="runner administration")
+    rusub = ru.add_subparsers(dest="action", required=True)
+    rusub.add_parser("list", parents=[api_flags])
+    rl = rusub.add_parser("logs", parents=[api_flags])
+    rl.add_argument("id")
+    rl.add_argument("--tail", type=int, default=200)
+    ru.set_defaults(fn=_cmd_runner)
 
     b = sub.add_parser("bench", help="run the standard benchmark")
     b.set_defaults(fn=_cmd_bench)

@@ -19,6 +19,7 @@ plus session storage:
 
 from __future__ import annotations
 
+import asyncio
 import json
 import uuid
 
@@ -443,6 +444,7 @@ class ControlPlane:
         r.add_post("/api/v1/runners/{id}/assign-profile", self.assign_profile)
         r.add_delete("/api/v1/runners/{id}/assignment", self.clear_assignment)
         r.add_get("/api/v1/runners", self.list_runners)
+        r.add_get("/api/v1/runners/{id}/logs", self.runner_logs)
         r.add_get("/api/v1/compute/instances", self.list_compute_instances)
         # profiles
         r.add_get("/api/v1/profiles", self.list_profiles)
@@ -647,6 +649,40 @@ class ControlPlane:
                 }
             )
         return web.json_response({"runners": out})
+
+    async def runner_logs(self, request):
+        """Admin log tailing for a runner, proxied by address or through
+        its reverse tunnel (reference: hydra logbuf + admin_runner_logs)."""
+        from helix_tpu.control.tunnel import TunnelClosed
+
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        rid = request.match_info["id"]
+        tail = request.query.get("tail", "200")
+        st = next((s for s in self.router.runners() if s.id == rid), None)
+        if st is None:
+            return _err(404, f"unknown runner '{rid}'")
+        address = st.meta.get("address")
+        path = f"/logs?tail={tail}"
+        if address:
+            timeout = aiohttp.ClientTimeout(total=30)
+            try:
+                async with aiohttp.ClientSession(timeout=timeout) as session:
+                    async with session.get(f"{address}{path}") as upstream:
+                        return web.json_response(
+                            await upstream.json(), status=upstream.status
+                        )
+            except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+                return _err(502, f"runner {rid} unreachable: {e}")
+        try:
+            status, _, chunks = await self.tunnels.request(
+                rid, "GET", path
+            )
+            body = b"".join([c async for c in chunks])
+            return web.json_response(json.loads(body), status=status)
+        except TunnelClosed as e:
+            return _err(502, f"runner {rid} unreachable: {e}")
 
     async def list_compute_instances(self, request):
         if self.compute is None:

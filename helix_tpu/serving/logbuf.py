@@ -1,0 +1,59 @@
+"""Runner log ring buffer (reference: hydra's in-memory log ring +
+admin tailer — ``api/pkg/hydra/logbuf.go``, ``server/admin_runner_logs.go``).
+
+A ``logging.Handler`` that keeps the last N records in memory; the node's
+HTTP surface exposes the tail and the control plane proxies it to the
+admin UI (by address or through the reverse tunnel)."""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+
+
+class RingLogBuffer(logging.Handler):
+    def __init__(self, capacity: int = 2000):
+        super().__init__()
+        self.records: collections.deque = collections.deque(maxlen=capacity)
+        self._lock2 = threading.Lock()
+        self.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            line = self.format(record)
+        except Exception:  # noqa: BLE001 — formatting must never raise
+            line = record.getMessage()
+        with self._lock2:
+            self.records.append((time.time(), line))
+
+    def push(self, line: str) -> None:
+        """Non-logging writes (engine step notes, apply progress)."""
+        with self._lock2:
+            self.records.append((time.time(), line))
+
+    def tail(self, n: int = 200) -> list:
+        with self._lock2:
+            items = list(self.records)[-n:]
+        return [{"ts": ts, "line": line} for ts, line in items]
+
+
+_global: RingLogBuffer | None = None
+
+
+def install(capacity: int = 2000) -> RingLogBuffer:
+    """Attach one ring buffer to the root logger (idempotent).
+
+    Deliberately does NOT change the root logger's level: the buffer
+    captures whatever the deployment's logging config emits, plus
+    explicit ``push()`` writes from the serving layer. Flooding other
+    handlers with INFO as a construction side effect would be worse than
+    a quieter ring."""
+    global _global
+    if _global is None:
+        _global = RingLogBuffer(capacity)
+        logging.getLogger().addHandler(_global)
+    return _global

@@ -45,15 +45,19 @@ class EngineRequestError(Exception):
 
 class OpenAIServer:
     def __init__(self, registry: ModelRegistry, metrics=None):
+        from helix_tpu.serving.logbuf import install as install_logbuf
+
         self.registry = registry
         self.metrics = metrics
         self.started = time.monotonic()
+        self.logbuf = install_logbuf()
 
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/metrics", self.prometheus_metrics)
+        app.router.add_get("/logs", self.tail_logs)
         app.router.add_get("/v1/models", self.list_models)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
@@ -87,6 +91,14 @@ class OpenAIServer:
                 f"helix_free_pages{tag} {eng.allocator.free_pages}",
             ]
         return web.Response(text="\n".join(lines) + "\n")
+
+    async def tail_logs(self, request):
+        """Node log tail for the admin UI (hydra logbuf analogue)."""
+        try:
+            n = max(1, min(int(request.query.get("tail", 200)), 2000))
+        except ValueError:
+            return _error(400, "tail must be an integer")
+        return web.json_response({"logs": self.logbuf.tail(n)})
 
     async def list_models(self, request):
         return web.json_response(

@@ -1,0 +1,96 @@
+"""Deployment artifacts stay consistent with the CLI they drive.
+
+The reference ships charts + install.sh (charts/helix-controlplane,
+charts/helix-sandbox with per-vendor GPU branches, install.sh); these
+tests keep our helm values/manifests/install script parseable and their
+flags in sync with `python -m helix_tpu`."""
+
+import os
+import subprocess
+import sys
+
+import yaml
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEPLOY = os.path.join(ROOT, "deploy")
+
+
+def test_yaml_artifacts_parse():
+    for rel in (
+        "helm/helix-tpu-node/Chart.yaml",
+        "helm/helix-tpu-node/values.yaml",
+        "helm/helix-tpu-controlplane/Chart.yaml",
+        "helm/helix-tpu-controlplane/values.yaml",
+    ):
+        with open(os.path.join(DEPLOY, rel)) as f:
+            doc = yaml.safe_load(f)
+        assert isinstance(doc, dict), rel
+    with open(os.path.join(DEPLOY, "k8s/single-node.yaml")) as f:
+        docs = list(yaml.safe_load_all(f))
+    kinds = [d["kind"] for d in docs]
+    assert kinds.count("Deployment") == 2
+    assert "Service" in kinds and "Secret" in kinds
+
+
+def test_tpu_vendor_branch_present():
+    values = yaml.safe_load(
+        open(os.path.join(DEPLOY, "helm/helix-tpu-node/values.yaml"))
+    )
+    assert values["accelerator"]["vendor"] == "tpu"
+    tpu = values["accelerator"]["tpu"]
+    assert tpu["resourceName"] == "google.com/tpu"
+    assert tpu["generation"] in ("v5e", "v5p", "v6e")
+    tmpl = open(
+        os.path.join(DEPLOY, "helm/helix-tpu-node/templates/deployment.yaml")
+    ).read()
+    # the GKE TPU selector pair + chip resource limit (the vendor branch)
+    assert "cloud.google.com/gke-tpu-accelerator" in tmpl
+    assert "cloud.google.com/gke-tpu-topology" in tmpl
+    assert ".Values.accelerator.tpu.resourceName" in tmpl
+    # tunnel mode drops the port/advertise pair
+    assert "--tunnel" in tmpl
+
+
+def test_install_script_shell_syntax():
+    p = subprocess.run(
+        ["sh", "-n", os.path.join(DEPLOY, "install.sh")],
+        capture_output=True, text=True,
+    )
+    assert p.returncode == 0, p.stderr
+
+
+def _cli_flags(subcommand):
+    p = subprocess.run(
+        [sys.executable, "-m", "helix_tpu", subcommand, "--help"],
+        capture_output=True, text=True,
+        env={**os.environ, "PYTHONPATH": ROOT, "JAX_PLATFORMS": "cpu"},
+    )
+    assert p.returncode == 0, p.stderr
+    return p.stdout
+
+
+def test_manifest_flags_exist_in_cli():
+    """Every flag the k8s manifests/charts pass must be a real CLI flag."""
+    serve_help = _cli_flags("serve")
+    node_help = _cli_flags("serve-node")
+    for flag in ("--port", "--db", "--sandbox-agents", "--compute-floor",
+                 "--compute-max"):
+        assert flag in serve_help, flag
+    for flag in ("--runner-id", "--control-plane", "--port", "--advertise",
+                 "--profile", "--tunnel", "--unix-socket"):
+        assert flag in node_help, flag
+
+
+def test_k8s_manifest_args_are_valid_cli_invocations():
+    with open(os.path.join(DEPLOY, "k8s/single-node.yaml")) as f:
+        docs = list(yaml.safe_load_all(f))
+    for d in docs:
+        if d["kind"] != "Deployment":
+            continue
+        c = d["spec"]["template"]["spec"]["containers"][0]
+        assert c["command"][:3] == ["python", "-m", "helix_tpu"]
+        sub = c["command"][3]
+        helptext = _cli_flags(sub)
+        flags = [a for a in c["args"] if a.startswith("--")]
+        for flag in flags:
+            assert flag in helptext, f"{sub} lacks {flag}"
