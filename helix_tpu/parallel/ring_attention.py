@@ -101,30 +101,41 @@ def _ring_body(q, k, v, qpos, kpos, axis_name, scale, causal):
 
 
 def ring_attention(
-    q,            # [B, S, H, D] sharded on S over axis_name
-    k,
+    q,            # [B, Sq, H, D] sharded on Sq over axis_name
+    k,            # [B, Skv, KVH, D] sharded on Skv (Skv may differ from Sq)
     v,
     mesh: Mesh,
     *,
     axis_name: str = "sp",
-    q_positions=None,   # [B, S] absolute positions (sharded like S)
+    q_positions=None,    # [B, Sq] absolute positions (sharded like Sq)
+    kv_positions=None,   # [B, Skv] — defaults to q_positions semantics
     causal: bool = True,
     scale: Optional[float] = None,
 ):
     """Sequence-parallel attention over a mesh axis.
 
-    Call with globally-shaped arrays; shard_map splits them on the sequence
-    axis.  Positions default to ``arange(S)``."""
+    Call with globally-shaped arrays; shard_map splits them on the
+    sequence axis.  Positions default to ``arange(S)``.  ``Skv`` may
+    exceed ``Sq`` (cross-attention of a prefill chunk against cached
+    history + itself): each device holds an Skv/sp KV shard and the ring
+    rotates shards so every Q shard sees all of KV with O(Skv/sp) peak
+    memory — the long-context serving path."""
     try:
         from jax import shard_map
     except ImportError:  # older jax
         from jax.experimental.shard_map import shard_map
 
-    B, S, H, D = q.shape
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     if q_positions is None:
-        q_positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
-    kv_positions = q_positions
+        q_positions = jnp.broadcast_to(jnp.arange(Sq)[None], (B, Sq))
+    if kv_positions is None:
+        kv_positions = (
+            q_positions
+            if Skv == Sq
+            else jnp.broadcast_to(jnp.arange(Skv)[None], (B, Skv))
+        )
 
     seq = P(None, axis_name, None, None)
     pos = P(None, axis_name)

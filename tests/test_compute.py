@@ -272,6 +272,10 @@ class TestControlPlaneWiring:
                 ),
                 compute_provider=stub,
             )
+            # drive reconcile by hand: the background loop's initial pass
+            # would race ours and double-provision
+            cp.compute.stop()
+            cp.compute._thread.join(timeout=10)
             client = TestClient(TestServer(cp.build_app()))
             await client.start_server()
             try:

@@ -59,3 +59,55 @@ class TestRingAttention:
         g1 = jax.grad(loss_ring)(q, k, v)
         g2 = jax.grad(loss_ref)(q, k, v)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+class TestRingCrossAttention:
+    """Chunk-vs-history cross attention: Skv > Sq (the long-context
+    serving path — each device holds an Skv/sp KV shard)."""
+
+    def test_chunk_against_longer_kv(self, sp_mesh, rng):
+        B, Sq, Skv, H, KVH, D = 1, 32, 128, 4, 2, 16
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (B, Sq, H, D))
+        k = jax.random.normal(ks[1], (B, Skv, KVH, D))
+        v = jax.random.normal(ks[2], (B, Skv, KVH, D))
+        start = 96   # chunk sits at absolute positions [96, 128)
+        qpos = jnp.broadcast_to(jnp.arange(start, start + Sq)[None], (B, Sq))
+        kpos = jnp.broadcast_to(jnp.arange(Skv)[None], (B, Skv))
+        got = ring_attention(
+            q, k, v, sp_mesh, q_positions=qpos, kv_positions=kpos,
+            causal=True,
+        )
+        want = mha_reference(
+            q, k, v, causal=True, q_positions=qpos, kv_positions=kpos
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
+
+    def test_sentinel_positions_mask_padding(self, sp_mesh, rng):
+        """Padding KV slots given huge positions are causally excluded —
+        the trick chunked prefill uses instead of segment ids."""
+        B, Sq, Skv, H, D = 1, 16, 64, 2, 16
+        ks = jax.random.split(rng, 3)
+        q = jax.random.normal(ks[0], (B, Sq, H, D))
+        k = jax.random.normal(ks[1], (B, Skv, H, D))
+        v = jax.random.normal(ks[2], (B, Skv, H, D))
+        start = 40
+        valid_kv = 48    # kv slots [48, 64) are garbage
+        qpos = jnp.broadcast_to(jnp.arange(start, start + Sq)[None], (B, Sq))
+        kpos = jnp.where(
+            jnp.arange(Skv) < valid_kv, jnp.arange(Skv), 1 << 30
+        )[None]
+        got = ring_attention(
+            q, k, v, sp_mesh, q_positions=qpos, kv_positions=kpos,
+            causal=True,
+        )
+        want = mha_reference(
+            q[:, :, :, :], k[:, :valid_kv], v[:, :valid_kv], causal=True,
+            q_positions=qpos,
+            kv_positions=jnp.arange(valid_kv)[None],
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-5
+        )
