@@ -167,12 +167,31 @@ def main():
     # warmup: one full generation pass compiles prefill+decode
     eng.generate(prompts[:1], SamplingParams(temperature=0.0, max_tokens=4))
 
+    from helix_tpu.engine.engine import Request
+
+    reqs = [
+        Request(id=f"bench-{i}", prompt_tokens=list(p), sampling=sampling)
+        for i, p in enumerate(prompts)
+    ]
     t0 = time.perf_counter()
     eng.num_decode_tokens = 0
-    outs = eng.generate(prompts, sampling)
+    for r in reqs:
+        eng.add_request(r)
+    while eng.has_work():
+        eng.step()
     dt = time.perf_counter() - t0
+    outs = [r.output_tokens for r in reqs]
     total_new = sum(len(o) for o in outs)
     toks_per_s = total_new / dt
+
+    # p50 time-to-first-token across the batch (BASELINE.md north star:
+    # "p50 TTFT, single-session chat")
+    ttfts = sorted(
+        (r.first_token_time - r.submit_time) * 1000.0
+        for r in reqs
+        if r.first_token_time is not None
+    )
+    p50_ttft_ms = ttfts[len(ttfts) // 2] if ttfts else 0.0
 
     result = {
         "metric": "llama3_8b_decode_tokens_per_sec_per_chip"
@@ -183,6 +202,10 @@ def main():
         "vs_baseline": round(toks_per_s / A100_VLLM_LLAMA3_8B_TOKS, 4)
         if on_tpu
         else 0.0,
+        "p50_ttft_ms": round(p50_ttft_ms, 1),
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "gen_len": gen_len,
     }
     print(json.dumps(result))
 

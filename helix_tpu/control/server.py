@@ -235,6 +235,38 @@ class ControlPlane:
             executor = AgentExecutor(
                 _ProviderLLM(self.providers), make_emitter=make_emitter
             )
+        # Helix Org: bot org-chart + channels (reference: api/pkg/org)
+        from helix_tpu.services.org import OrgService
+
+        def org_llm(prompt, msgs, model):
+            import asyncio as _asyncio
+
+            async def call():
+                if not model:
+                    # bots default to whatever the fleet serves (the web
+                    # UI's bot form has no required model field)
+                    available = self.router.available_models()
+                    resolved = available[0] if available else ""
+                else:
+                    resolved = model
+                client, m = self.providers.resolve(resolved)
+                resp = await client.chat(
+                    {
+                        "model": m,
+                        "messages": [
+                            {"role": "system", "content": prompt}, *msgs
+                        ],
+                    }
+                )
+                return resp["choices"][0]["message"]["content"] or ""
+
+            return _asyncio.run(call())
+
+        self.org = OrgService(
+            ":memory:" if db_path == ":memory:" else db_path + ".org",
+            llm=org_llm,
+        )
+
         from helix_tpu.control.notifications import NotificationService
 
         self.notifications = NotificationService.from_env()
@@ -505,6 +537,18 @@ class ControlPlane:
         r.add_get("/api/v1/repos", self.list_repos)
         r.add_get("/git/{repo}/info/refs", self.git_info_refs)
         r.add_post("/git/{repo}/{service}", self.git_rpc)
+        # org (bot org-chart + channels)
+        r.add_get("/api/v1/org/bots", self.org_list_bots)
+        r.add_post("/api/v1/org/bots", self.org_create_bot)
+        r.add_delete("/api/v1/org/bots/{id}", self.org_delete_bot)
+        r.add_post("/api/v1/org/reporting", self.org_add_reporting)
+        r.add_get("/api/v1/org/chart", self.org_chart)
+        r.add_get("/api/v1/org/channels", self.org_list_channels)
+        r.add_post("/api/v1/org/channels", self.org_create_channel)
+        r.add_get(
+            "/api/v1/org/channels/{id}/messages", self.org_messages
+        )
+        r.add_post("/api/v1/org/channels/{id}/messages", self.org_post)
         # notifications
         r.add_get("/api/v1/notifications", self.list_notifications)
         # triggers + webhooks
@@ -1141,6 +1185,80 @@ class ControlPlane:
 
     async def list_repos(self, request):
         return web.json_response({"repos": self.git.list_repos()})
+
+    # -- org (bot org-chart) ---------------------------------------------------
+    async def org_list_bots(self, request):
+        return web.json_response(
+            {"bots": [b.to_dict() for b in self.org.bots()]}
+        )
+
+    async def org_create_bot(self, request):
+        body = await request.json()
+        bot = self.org.create_bot(
+            name=body["name"], role=body.get("role", ""),
+            model=body.get("model", ""),
+        )
+        return web.json_response(bot.to_dict())
+
+    async def org_delete_bot(self, request):
+        ok = self.org.delete_bot(request.match_info["id"])
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def org_add_reporting(self, request):
+        from helix_tpu.services.org import OrgError
+
+        body = await request.json()
+        try:
+            self.org.add_reporting_line(body["manager"], body["report"])
+        except OrgError as e:
+            return _err(400, str(e))
+        return web.json_response({"ok": True})
+
+    async def org_chart(self, request):
+        return web.json_response(self.org.chart())
+
+    async def org_list_channels(self, request):
+        return web.json_response({"channels": self.org.channels()})
+
+    async def org_create_channel(self, request):
+        body = await request.json()
+        cid = self.org.create_channel(
+            name=body["name"], topic=body.get("topic", ""),
+            owner_bot=body.get("owner_bot", ""),
+            members=tuple(body.get("members", [])),
+        )
+        return web.json_response({"id": cid})
+
+    async def org_messages(self, request):
+        try:
+            limit = max(1, min(int(request.query.get("limit", 50)), 500))
+        except ValueError:
+            return _err(400, "limit must be an integer")
+        return web.json_response(
+            {
+                "messages": self.org.messages(
+                    request.match_info["id"], limit
+                )
+            }
+        )
+
+    async def org_post(self, request):
+        from helix_tpu.services.org import OrgError
+
+        body = await request.json()
+        author = f"user:{self._user_id(request)}"
+        try:
+            new = await __import__(
+                "asyncio"
+            ).get_running_loop().run_in_executor(
+                None,
+                lambda: self.org.post(
+                    request.match_info["id"], body["body"], author=author
+                ),
+            )
+        except OrgError as e:
+            return _err(404, str(e))
+        return web.json_response({"messages": new})
 
     async def list_notifications(self, request):
         try:

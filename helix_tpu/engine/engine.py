@@ -499,8 +499,13 @@ class Engine:
         self._step_counter = itertools.count()
         self._backend = cfg.attn_backend
         # metrics
+        import collections as _collections
+
         self.num_prefill_tokens = 0
         self.num_decode_tokens = 0
+        self.recent_ttfts: "_collections.deque" = _collections.deque(
+            maxlen=200
+        )   # ms; feeds /metrics p50/p95
 
     # ------------------------------------------------------------------
     # public API
@@ -594,6 +599,9 @@ class Engine:
         self.add_request(req)
         while self.has_work():
             self.step()
+        # the warmup token's latency is XLA compile time, not serving
+        # latency — keep it out of the TTFT percentiles
+        self.recent_ttfts.clear()
         C = self.cfg.max_prefill_len
         if not chunked or self.max_context_len <= C:
             return
@@ -736,6 +744,9 @@ class Engine:
                 continue
             first_token = self._prefill(req, table, slot=slot)
             req.first_token_time = time.monotonic()
+            self.recent_ttfts.append(
+                (req.first_token_time - req.submit_time) * 1000.0
+            )
             self._positions[slot] = plen
             self._mrope_delta[slot] = req.mrope_delta
             self._last_token[slot] = first_token
@@ -811,6 +822,9 @@ class Engine:
         for si, (req, _) in enumerate(batch):
             slot = req.slot
             req.first_token_time = now
+            self.recent_ttfts.append(
+                (now - req.submit_time) * 1000.0
+            )
             self._positions[slot] = len(req.prompt_tokens)
             self._mrope_delta[slot] = 0
             self._last_token[slot] = first_np[si]
@@ -874,6 +888,9 @@ class Engine:
         first_token = int(token[0])
         self._chunking = None
         req.first_token_time = time.monotonic()
+        self.recent_ttfts.append(
+            (req.first_token_time - req.submit_time) * 1000.0
+        )
         self._positions[slot] = plen
         self._mrope_delta[slot] = req.mrope_delta
         self._last_token[slot] = first_token

@@ -1,0 +1,384 @@
+"""Helix Org: a multi-agent "organization" of bots on a reporting DAG.
+
+The counterpart of the reference's largest uncovered subsystem
+(``api/pkg/org/`` — DDD-layered bot org-chart: bots in a reporting DAG
+(``domain/orgchart/reporting.go:5-17``), topics/channels, dispatch,
+activations/wake bus), rebuilt at this framework's scale:
+
+- **Bots**: named agents with a role prompt and a model; many-to-many
+  reporting lines form a DAG (cycles rejected on edge insert via an
+  ancestor walk, mirroring the reference's add-parent handler).
+- **Channels**: topics with member bots; posting a message *activates*
+  the responsible bot (explicit mention first, else the channel owner),
+  which answers through the LLM with channel history as context.
+- **Escalation**: a bot that answers with ``ESCALATE: <why>`` hands the
+  thread to its manager(s) up the chain — bounded by the DAG depth.
+- **Wake bus**: ``wake(bot_id, note)`` queues an activation the
+  dispatcher drains (the reference's activations + wake bus, scaled to
+  one process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS org_bots (
+    id TEXT PRIMARY KEY,
+    org TEXT NOT NULL DEFAULT 'default',
+    name TEXT NOT NULL,
+    role TEXT DEFAULT '',
+    model TEXT DEFAULT '',
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS org_reporting (
+    org TEXT NOT NULL,
+    manager_id TEXT NOT NULL,
+    report_id TEXT NOT NULL,
+    PRIMARY KEY (org, manager_id, report_id)
+);
+CREATE TABLE IF NOT EXISTS org_channels (
+    id TEXT PRIMARY KEY,
+    org TEXT NOT NULL DEFAULT 'default',
+    name TEXT NOT NULL,
+    topic TEXT DEFAULT '',
+    owner_bot TEXT DEFAULT '',
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS org_channel_members (
+    channel_id TEXT NOT NULL,
+    bot_id TEXT NOT NULL,
+    PRIMARY KEY (channel_id, bot_id)
+);
+CREATE TABLE IF NOT EXISTS org_messages (
+    id TEXT PRIMARY KEY,
+    channel_id TEXT NOT NULL,
+    author TEXT NOT NULL,       -- 'user:<id>' or 'bot:<id>'
+    body TEXT NOT NULL,
+    created_at REAL NOT NULL
+);
+"""
+
+ESCALATE_MARKER = "ESCALATE:"
+
+
+class OrgError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Bot:
+    id: str
+    org: str
+    name: str
+    role: str = ""
+    model: str = ""
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+
+class OrgService:
+    def __init__(
+        self,
+        db_path: str = ":memory:",
+        llm: Optional[Callable] = None,
+        history_limit: int = 20,
+        max_escalations: int = 4,
+    ):
+        """``llm(prompt, messages, model) -> str`` produces a bot's reply
+        (the control plane wires its provider manager in)."""
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+        self.llm = llm
+        self.history_limit = history_limit
+        self.max_escalations = max_escalations
+        self._wake_queue: list[tuple[str, str]] = []
+
+    # -- bots + reporting DAG ---------------------------------------------
+    def create_bot(self, name: str, role: str = "", model: str = "",
+                   org: str = "default") -> Bot:
+        bot = Bot(
+            id=f"bot_{uuid.uuid4().hex[:12]}", org=org, name=name,
+            role=role, model=model,
+        )
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO org_bots(id, org, name, role, model, "
+                "created_at) VALUES(?,?,?,?,?,?)",
+                (bot.id, org, name, role, model, time.time()),
+            )
+            self._conn.commit()
+        return bot
+
+    def get_bot(self, bid: str) -> Optional[Bot]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT id, org, name, role, model FROM org_bots WHERE "
+                "id=? OR name=?",
+                (bid, bid),
+            ).fetchone()
+        return Bot(*r) if r else None
+
+    def bots(self, org: str = "default") -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, org, name, role, model FROM org_bots WHERE "
+                "org=? ORDER BY created_at",
+                (org,),
+            ).fetchall()
+        return [Bot(*r) for r in rows]
+
+    def delete_bot(self, bid: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM org_bots WHERE id=?", (bid,)
+            )
+            # deleting an endpoint drops every reporting line touching it
+            # (reference: 'the store enforces this structurally')
+            self._conn.execute(
+                "DELETE FROM org_reporting WHERE manager_id=? OR report_id=?",
+                (bid, bid),
+            )
+            self._conn.execute(
+                "DELETE FROM org_channel_members WHERE bot_id=?", (bid,)
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    def managers_of(self, bid: str) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT manager_id FROM org_reporting WHERE report_id=?",
+                (bid,),
+            ).fetchall()
+        return [r[0] for r in rows]
+
+    def reports_of(self, bid: str) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT report_id FROM org_reporting WHERE manager_id=?",
+                (bid,),
+            ).fetchall()
+        return [r[0] for r in rows]
+
+    def add_reporting_line(self, manager_id: str, report_id: str,
+                           org: str = "default") -> None:
+        """report_id reports to manager_id.  Cycles rejected via ancestor
+        walk (``orgchart/reporting.go`` + the add-parent handler)."""
+        if manager_id == report_id:
+            raise OrgError("bot cannot report to itself")
+        for bid in (manager_id, report_id):
+            if self.get_bot(bid) is None:
+                raise OrgError(f"unknown bot {bid}")
+        # would manager_id become a descendant of report_id? then cycle
+        seen = set()
+        frontier = [manager_id]
+        while frontier:
+            cur = frontier.pop()
+            if cur == report_id:
+                raise OrgError(
+                    f"reporting line {report_id}->{manager_id} would "
+                    f"create a cycle"
+                )
+            if cur in seen:
+                continue
+            seen.add(cur)
+            frontier.extend(self.managers_of(cur))
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR IGNORE INTO org_reporting(org, manager_id, "
+                "report_id) VALUES(?,?,?)",
+                (org, manager_id, report_id),
+            )
+            self._conn.commit()
+
+    def chart(self, org: str = "default") -> dict:
+        """The org chart the UI renders: bots + edges."""
+        with self._lock:
+            edges = self._conn.execute(
+                "SELECT manager_id, report_id FROM org_reporting WHERE "
+                "org=?",
+                (org,),
+            ).fetchall()
+        return {
+            "bots": [b.to_dict() for b in self.bots(org)],
+            "reporting": [
+                {"manager": m, "report": r} for m, r in edges
+            ],
+        }
+
+    # -- channels ----------------------------------------------------------
+    def create_channel(self, name: str, topic: str = "",
+                       owner_bot: str = "", members: tuple = (),
+                       org: str = "default") -> str:
+        cid = f"chn_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO org_channels(id, org, name, topic, owner_bot, "
+                "created_at) VALUES(?,?,?,?,?,?)",
+                (cid, org, name, topic, owner_bot, time.time()),
+            )
+            for b in {*members, *( (owner_bot,) if owner_bot else () )}:
+                self._conn.execute(
+                    "INSERT OR IGNORE INTO org_channel_members(channel_id, "
+                    "bot_id) VALUES(?,?)",
+                    (cid, b),
+                )
+            self._conn.commit()
+        return cid
+
+    def channels(self, org: str = "default") -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, name, topic, owner_bot FROM org_channels WHERE "
+                "org=? ORDER BY created_at",
+                (org,),
+            ).fetchall()
+        return [
+            {"id": r[0], "name": r[1], "topic": r[2], "owner_bot": r[3]}
+            for r in rows
+        ]
+
+    def messages(self, channel_id: str, limit: int = 50) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, author, body, created_at FROM org_messages "
+                "WHERE channel_id=? ORDER BY created_at DESC LIMIT ?",
+                (channel_id, limit),
+            ).fetchall()
+        return [
+            {"id": r[0], "author": r[1], "body": r[2], "created_at": r[3]}
+            for r in reversed(rows)
+        ]
+
+    def _append(self, channel_id: str, author: str, body: str) -> dict:
+        mid = f"msg_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO org_messages(id, channel_id, author, body, "
+                "created_at) VALUES(?,?,?,?,?)",
+                (mid, channel_id, author, body, time.time()),
+            )
+            self._conn.commit()
+        return {"id": mid, "author": author, "body": body}
+
+    # -- dispatch ----------------------------------------------------------
+    def _responsible_bot(self, channel: dict, body: str) -> Optional[Bot]:
+        """Explicit @mention of a member wins; else the channel owner
+        (the reference's topic routing, scaled down)."""
+        with self._lock:
+            members = [
+                r[0] for r in self._conn.execute(
+                    "SELECT bot_id FROM org_channel_members WHERE "
+                    "channel_id=?",
+                    (channel["id"],),
+                ).fetchall()
+            ]
+        import re as _re
+
+        # longest-name-first + word boundary so '@dev2' never routes to a
+        # member merely named 'dev'
+        bots = sorted(
+            filter(None, (self.get_bot(b) for b in members)),
+            key=lambda b: -len(b.name),
+        )
+        for bot in bots:
+            if _re.search(
+                rf"@{_re.escape(bot.name)}(?![\w-])", body
+            ):
+                return bot
+        return self.get_bot(channel["owner_bot"]) if channel["owner_bot"] else None
+
+    def post(self, channel_id: str, body: str, author: str = "user:anon") -> list:
+        """Post to a channel; the responsible bot answers (escalating up
+        the reporting chain when it says so).  Returns new messages."""
+        chan = next(
+            (c for c in self.channels_all() if c["id"] == channel_id), None
+        )
+        if chan is None:
+            raise OrgError(f"unknown channel {channel_id}")
+        out = [self._append(channel_id, author, body)]
+        bot = self._responsible_bot(chan, body)
+        hops = 0
+        visited = set()
+        while bot is not None and hops <= self.max_escalations:
+            if bot.id in visited:
+                break
+            visited.add(bot.id)
+            reply = self._activate(bot, chan)
+            out.append(self._append(channel_id, f"bot:{bot.name}", reply))
+            if not reply.startswith(ESCALATE_MARKER):
+                break
+            managers = [
+                m for m in (
+                    self.get_bot(x) for x in self.managers_of(bot.id)
+                ) if m is not None
+            ]
+            bot = managers[0] if managers else None
+            hops += 1
+        return out
+
+    def channels_all(self) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, name, topic, owner_bot FROM org_channels"
+            ).fetchall()
+        return [
+            {"id": r[0], "name": r[1], "topic": r[2], "owner_bot": r[3]}
+            for r in rows
+        ]
+
+    def _activate(self, bot: Bot, chan: dict) -> str:
+        if self.llm is None:
+            return f"(no llm wired; {bot.name} saw the message)"
+        history = self.messages(chan["id"], self.history_limit)
+        msgs = [
+            {
+                "role": "assistant"
+                if m["author"] == f"bot:{bot.name}"
+                else "user",
+                "content": f"{m['author']}: {m['body']}",
+            }
+            for m in history
+        ]
+        prompt = (
+            f"You are {bot.name}, {bot.role or 'a bot'} in channel "
+            f"'{chan['name']}' (topic: {chan['topic'] or 'general'}). "
+            f"Answer the channel. If this is outside your remit, reply "
+            f"starting with '{ESCALATE_MARKER} <reason>' to hand it to "
+            f"your manager."
+        )
+        try:
+            return self.llm(prompt, msgs, bot.model)
+        except Exception as e:  # noqa: BLE001 — a bot failure is a message
+            return f"(activation failed: {type(e).__name__}: {e})"
+
+    # -- wake bus ----------------------------------------------------------
+    def wake(self, bot_id: str, note: str = "") -> None:
+        """Queue an activation outside any channel post (the reference's
+        wake bus)."""
+        self._wake_queue.append((bot_id, note))
+
+    def drain_wakes(self, channel_id: str) -> list:
+        """Run queued activations against a channel; returns new messages."""
+        out = []
+        while self._wake_queue:
+            bot_id, note = self._wake_queue.pop(0)
+            bot = self.get_bot(bot_id)
+            if bot is None:
+                continue
+            out.extend(
+                self.post(
+                    channel_id, note or f"@{bot.name} wake", author="system"
+                )
+            )
+        return out

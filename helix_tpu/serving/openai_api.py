@@ -90,6 +90,23 @@ class OpenAIServer:
                 f"{sum(1 for s in eng.slots if s is not None)}",
                 f"helix_free_pages{tag} {eng.allocator.free_pages}",
             ]
+            ttfts = getattr(eng, "recent_ttfts", None)
+            if ttfts:
+                # the engine thread appends concurrently; a mutation during
+                # iteration raises — retry on a fresh snapshot
+                s = []
+                for _ in range(3):
+                    try:
+                        s = sorted(ttfts)
+                        break
+                    except RuntimeError:
+                        continue
+                if s:
+                    lines += [
+                        f"helix_ttft_ms_p50{tag} {s[len(s) // 2]:.1f}",
+                        f"helix_ttft_ms_p95{tag} "
+                        f"{s[min(len(s) - 1, int(len(s) * 0.95))]:.1f}",
+                    ]
         return web.Response(text="\n".join(lines) + "\n")
 
     async def tail_logs(self, request):

@@ -1,0 +1,198 @@
+"""Helix Org: bot org-chart DAG, channel dispatch, escalation, wake bus.
+
+Reference parity: api/pkg/org (domain/orgchart/reporting.go DAG +
+validate.go cycle rejection; channels/dispatch/activations/wake bus)."""
+
+import pytest
+
+from helix_tpu.services.org import ESCALATE_MARKER, OrgError, OrgService
+
+
+class ScriptedLLM:
+    """Per-bot scripted replies; records activations."""
+
+    def __init__(self, replies):
+        self.replies = dict(replies)
+        self.activations = []
+
+    def __call__(self, prompt, msgs, model):
+        name = prompt.split(",")[0].removeprefix("You are ").strip()
+        self.activations.append((name, msgs[-1]["content"] if msgs else ""))
+        return self.replies.get(name, f"{name} here: done.")
+
+
+class TestOrgChart:
+    def test_reporting_dag_cycle_rejected(self):
+        org = OrgService()
+        a = org.create_bot("ceo")
+        b = org.create_bot("lead")
+        c = org.create_bot("dev")
+        org.add_reporting_line(a.id, b.id)   # lead reports to ceo
+        org.add_reporting_line(b.id, c.id)   # dev reports to lead
+        with pytest.raises(OrgError, match="cycle"):
+            org.add_reporting_line(c.id, a.id)   # ceo reports to dev: cycle
+        with pytest.raises(OrgError, match="itself"):
+            org.add_reporting_line(a.id, a.id)
+        chart = org.chart()
+        assert len(chart["bots"]) == 3
+        assert len(chart["reporting"]) == 2
+
+    def test_multi_manager_allowed(self):
+        org = OrgService()
+        m1 = org.create_bot("eng-mgr")
+        m2 = org.create_bot("product-mgr")
+        d = org.create_bot("dev")
+        org.add_reporting_line(m1.id, d.id)
+        org.add_reporting_line(m2.id, d.id)   # many-to-many is legal
+        assert set(org.managers_of(d.id)) == {m1.id, m2.id}
+
+    def test_deleting_bot_drops_its_lines(self):
+        org = OrgService()
+        m = org.create_bot("mgr")
+        d = org.create_bot("dev")
+        org.add_reporting_line(m.id, d.id)
+        org.delete_bot(m.id)
+        assert org.managers_of(d.id) == []
+        with pytest.raises(OrgError, match="unknown bot"):
+            org.add_reporting_line(m.id, d.id)
+
+
+class TestDispatch:
+    def _org(self, replies):
+        llm = ScriptedLLM(replies)
+        org = OrgService(llm=llm)
+        return org, llm
+
+    def test_mention_routes_to_member(self):
+        org, llm = self._org({"ops": "ops here: restarted the node."})
+        owner = org.create_bot("helpdesk")
+        ops = org.create_bot("ops", role="infrastructure operator")
+        cid = org.create_channel(
+            "infra", owner_bot=owner.id, members=(ops.id,)
+        )
+        out = org.post(cid, "@ops the runner looks stuck")
+        bodies = [m["body"] for m in out]
+        assert "ops here: restarted the node." in bodies
+        assert llm.activations[0][0] == "ops"   # mention won over owner
+
+    def test_owner_answers_unaddressed_messages(self):
+        org, llm = self._org({"helpdesk": "helpdesk: ticket filed."})
+        owner = org.create_bot("helpdesk")
+        cid = org.create_channel("support", owner_bot=owner.id)
+        out = org.post(cid, "something is broken")
+        assert any("ticket filed" in m["body"] for m in out)
+
+    def test_escalation_walks_reporting_chain(self):
+        org, llm = self._org({
+            "dev": f"{ESCALATE_MARKER} needs approval",
+            "lead": f"{ESCALATE_MARKER} budget decision",
+            "ceo": "ceo: approved.",
+        })
+        ceo = org.create_bot("ceo")
+        lead = org.create_bot("lead")
+        dev = org.create_bot("dev")
+        org.add_reporting_line(ceo.id, lead.id)
+        org.add_reporting_line(lead.id, dev.id)
+        cid = org.create_channel("eng", owner_bot=dev.id)
+        out = org.post(cid, "can we buy a v5p pod?")
+        authors = [m["author"] for m in out]
+        assert authors == ["user:anon", "bot:dev", "bot:lead", "bot:ceo"]
+        assert out[-1]["body"] == "ceo: approved."
+        # transcript keeps the escalation trail
+        msgs = org.messages(cid)
+        assert sum(ESCALATE_MARKER in m["body"] for m in msgs) == 2
+
+    def test_escalation_without_manager_stops(self):
+        org, llm = self._org({"solo": f"{ESCALATE_MARKER} no one above me"})
+        solo = org.create_bot("solo")
+        cid = org.create_channel("lonely", owner_bot=solo.id)
+        out = org.post(cid, "help")
+        assert len(out) == 2   # the user message + one bot attempt
+
+    def test_wake_bus(self):
+        org, llm = self._org({"janitor": "janitor: swept the floors."})
+        j = org.create_bot("janitor")
+        cid = org.create_channel("chores", owner_bot=j.id)
+        org.wake(j.id, "@janitor nightly sweep")
+        out = org.drain_wakes(cid)
+        assert any("swept the floors" in m["body"] for m in out)
+
+
+class TestOrgHTTP:
+    def test_rest_roundtrip(self):
+        import asyncio
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from helix_tpu.control.server import ControlPlane
+
+        async def main():
+            cp = ControlPlane()
+            cp.org.llm = ScriptedLLM({"support": "support: on it."})
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                r = await client.post(
+                    "/api/v1/org/bots",
+                    json={"name": "support", "role": "front line"},
+                )
+                bot = await r.json()
+                r = await client.post(
+                    "/api/v1/org/bots", json={"name": "mgr"}
+                )
+                mgr = await r.json()
+                r = await client.post(
+                    "/api/v1/org/reporting",
+                    json={"manager": mgr["id"], "report": bot["id"]},
+                )
+                assert r.status == 200
+                # cycle via HTTP is a clean 400
+                r = await client.post(
+                    "/api/v1/org/reporting",
+                    json={"manager": bot["id"], "report": mgr["id"]},
+                )
+                assert r.status == 400
+                r = await client.get("/api/v1/org/chart")
+                chart = await r.json()
+                assert len(chart["bots"]) == 2
+                assert chart["reporting"] == [
+                    {"manager": mgr["id"], "report": bot["id"]}
+                ]
+                r = await client.post(
+                    "/api/v1/org/channels",
+                    json={"name": "help", "owner_bot": bot["id"]},
+                )
+                cid = (await r.json())["id"]
+                r = await client.post(
+                    f"/api/v1/org/channels/{cid}/messages",
+                    json={"body": "printer on fire"},
+                )
+                new = (await r.json())["messages"]
+                assert any("on it" in m["body"] for m in new)
+                r = await client.get(
+                    f"/api/v1/org/channels/{cid}/messages"
+                )
+                msgs = (await r.json())["messages"]
+                assert len(msgs) == 2
+            finally:
+                await client.close()
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                cp.triggers.stop()
+
+        asyncio.run(main())
+
+
+def test_mention_prefix_names_dont_collide():
+    """'@dev2' must route to dev2, never to a member merely named 'dev'."""
+    llm = ScriptedLLM({"dev": "dev: hi", "dev2": "dev2: deploying."})
+    org = OrgService(llm=llm)
+    owner = org.create_bot("helpdesk")
+    d1 = org.create_bot("dev")
+    d2 = org.create_bot("dev2")
+    cid = org.create_channel(
+        "eng", owner_bot=owner.id, members=(d1.id, d2.id)
+    )
+    out = org.post(cid, "@dev2 please deploy")
+    assert any("dev2: deploying." == m["body"] for m in out)
+    assert llm.activations[0][0] == "dev2"
