@@ -278,6 +278,13 @@ def _cmd_runner(args) -> int:
     return 0
 
 
+def _cmd_config_reference(args) -> int:
+    from helix_tpu.config_reference import render
+
+    print(render())
+    return 0
+
+
 def _cmd_chat(args) -> int:
     import requests
 
@@ -491,6 +498,12 @@ def main(argv=None) -> int:
     rl.add_argument("id")
     rl.add_argument("--tail", type=int, default=200)
     ru.set_defaults(fn=_cmd_runner)
+
+    cr = sub.add_parser(
+        "config-reference",
+        help="print every HELIX_* environment variable the runtime reads",
+    )
+    cr.set_defaults(fn=_cmd_config_reference)
 
     b = sub.add_parser("bench", help="run the standard benchmark")
     b.set_defaults(fn=_cmd_bench)

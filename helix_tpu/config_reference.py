@@ -1,0 +1,140 @@
+"""The deployment's environment-variable reference, in one place.
+
+The reference loads one giant envconfig ``ServerConfig`` whose struct
+tags generate the ``serve --help`` env reference
+(``api/pkg/config/config.go:11-38``, ``serve.go:78,102``).  This module
+is the same single source of truth for helix-tpu: every HELIX_* knob the
+runtime reads, with description and default — rendered by
+``helix-tpu config-reference`` and asserted complete by tests (a knob
+read anywhere in the tree must be documented here).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvVar:
+    name: str
+    description: str
+    default: str = ""
+    section: str = "general"
+
+
+ENV_REFERENCE: tuple = (
+    # -- auth ------------------------------------------------------------
+    EnvVar(
+        "HELIX_MASTER_KEY",
+        "Envelope-encryption master key for user secrets and OAuth "
+        "tokens. Unset: a random key is generated and persisted next to "
+        "the auth DB (set explicitly in production).",
+        section="auth",
+    ),
+    EnvVar(
+        "HELIX_RUNNER_TOKEN",
+        "Shared token nodes present on the runner control loop "
+        "(heartbeat, assignment poll, reverse-tunnel dial). Empty + "
+        "auth_required: runner endpoints fail closed to admin-only.",
+        section="auth",
+    ),
+    EnvVar(
+        "HELIX_API_KEY",
+        "Bearer key used by the admin CLI verbs (org/knowledge/secret/"
+        "runner) when --api-key is not passed; also injected into "
+        "sandboxed agent children as their control-plane credential.",
+        section="auth",
+    ),
+    EnvVar(
+        "HELIX_API_BASE",
+        "Control-plane base URL injected into sandboxed agent children "
+        "(their only egress).",
+        section="auth",
+    ),
+    EnvVar(
+        "HELIX_OIDC_ISSUER",
+        "OIDC issuer URL; set to enable JWT bearer auth (discovery + "
+        "JWKS RS256 verification).",
+        section="auth",
+    ),
+    EnvVar(
+        "HELIX_OIDC_CLIENT_ID",
+        "Audience expected in OIDC tokens.",
+        default="helix",
+        section="auth",
+    ),
+    EnvVar(
+        "HELIX_OIDC_ADMIN_EMAILS",
+        "Comma-separated emails granted platform admin on OIDC "
+        "provision (a pure-OIDC deployment's only admin path).",
+        section="auth",
+    ),
+    # -- integrations -----------------------------------------------------
+    EnvVar(
+        "HELIX_GITHUB_CLIENT_ID",
+        "GitHub OAuth app client id (enables the GitHub agent skill).",
+        section="integrations",
+    ),
+    EnvVar(
+        "HELIX_GITHUB_CLIENT_SECRET",
+        "GitHub OAuth app client secret.",
+        section="integrations",
+    ),
+    EnvVar(
+        "HELIX_SLACK_WEBHOOK_URL",
+        "Slack incoming-webhook URL for lifecycle notifications.",
+        section="integrations",
+    ),
+    EnvVar(
+        "HELIX_DISCORD_WEBHOOK_URL",
+        "Discord webhook URL for lifecycle notifications.",
+        section="integrations",
+    ),
+    EnvVar(
+        "HELIX_SMTP_HOST",
+        "SMTP host for email notifications (enables the email sink).",
+        section="integrations",
+    ),
+    EnvVar("HELIX_SMTP_PORT", "SMTP port.", default="587",
+           section="integrations"),
+    EnvVar("HELIX_SMTP_FROM", "Email sender.", default="helix@localhost",
+           section="integrations"),
+    EnvVar("HELIX_SMTP_TO", "Notification recipient.",
+           section="integrations"),
+    EnvVar("HELIX_SMTP_USER", "SMTP username.", section="integrations"),
+    EnvVar("HELIX_SMTP_PASSWORD", "SMTP password.",
+           section="integrations"),
+    # -- knowledge --------------------------------------------------------
+    EnvVar(
+        "HELIX_CRAWLER_ALLOW_PRIVATE",
+        "Set to 1 to let the knowledge crawler fetch private/loopback "
+        "addresses (intranet docs). Default: refused (SSRF guard).",
+        default="0",
+        section="knowledge",
+    ),
+    # -- accelerator ------------------------------------------------------
+    EnvVar(
+        "JAX_PLATFORMS",
+        "JAX platform selection; the control plane and sandbox children "
+        "pin 'cpu' (they never touch chips). Serving nodes inherit the "
+        "deployment default (tpu).",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_BENCH_CHILD",
+        "Internal: marks the CPU-fallback bench child process.",
+        section="accelerator",
+    ),
+)
+
+
+def render(sections: bool = True) -> str:
+    out = []
+    cur = None
+    for var in ENV_REFERENCE:
+        if sections and var.section != cur:
+            cur = var.section
+            out.append(f"\n[{cur}]")
+        default = f" (default: {var.default})" if var.default else ""
+        out.append(f"  {var.name}{default}\n      {var.description}")
+    return "\n".join(out).strip()

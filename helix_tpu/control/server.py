@@ -1193,11 +1193,16 @@ class ControlPlane:
         )
 
     async def org_create_bot(self, request):
+        from helix_tpu.services.org import OrgError
+
         body = await request.json()
-        bot = self.org.create_bot(
-            name=body["name"], role=body.get("role", ""),
-            model=body.get("model", ""),
-        )
+        try:
+            bot = self.org.create_bot(
+                name=body.get("name", ""), role=body.get("role", ""),
+                model=body.get("model", ""),
+            )
+        except OrgError as e:
+            return _err(400, str(e))
         return web.json_response(bot.to_dict())
 
     async def org_delete_bot(self, request):
@@ -1221,12 +1226,17 @@ class ControlPlane:
         return web.json_response({"channels": self.org.channels()})
 
     async def org_create_channel(self, request):
+        from helix_tpu.services.org import OrgError
+
         body = await request.json()
-        cid = self.org.create_channel(
-            name=body["name"], topic=body.get("topic", ""),
-            owner_bot=body.get("owner_bot", ""),
-            members=tuple(body.get("members", [])),
-        )
+        try:
+            cid = self.org.create_channel(
+                name=body.get("name", ""), topic=body.get("topic", ""),
+                owner_bot=body.get("owner_bot", ""),
+                members=tuple(body.get("members", [])),
+            )
+        except OrgError as e:
+            return _err(400, str(e))
         return web.json_response({"id": cid})
 
     async def org_messages(self, request):

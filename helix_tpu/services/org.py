@@ -106,6 +106,9 @@ class OrgService:
     # -- bots + reporting DAG ---------------------------------------------
     def create_bot(self, name: str, role: str = "", model: str = "",
                    org: str = "default") -> Bot:
+        if not name or not name.strip():
+            raise OrgError("bot name is required")
+        name = name.strip()
         bot = Bot(
             id=f"bot_{uuid.uuid4().hex[:12]}", org=org, name=name,
             role=role, model=model,
@@ -150,6 +153,12 @@ class OrgService:
             )
             self._conn.execute(
                 "DELETE FROM org_channel_members WHERE bot_id=?", (bid,)
+            )
+            # channels owned by the deleted bot fall back to
+            # mention-routing rather than silently never answering
+            self._conn.execute(
+                "UPDATE org_channels SET owner_bot='' WHERE owner_bot=?",
+                (bid,),
             )
             self._conn.commit()
             return cur.rowcount > 0
@@ -220,6 +229,8 @@ class OrgService:
     def create_channel(self, name: str, topic: str = "",
                        owner_bot: str = "", members: tuple = (),
                        org: str = "default") -> str:
+        if not name or not name.strip():
+            raise OrgError("channel name is required")
         cid = f"chn_{uuid.uuid4().hex[:12]}"
         with self._lock:
             self._conn.execute(
@@ -298,16 +309,20 @@ class OrgService:
                 return bot
         return self.get_bot(channel["owner_bot"]) if channel["owner_bot"] else None
 
-    def post(self, channel_id: str, body: str, author: str = "user:anon") -> list:
+    def post(self, channel_id: str, body: str, author: str = "user:anon",
+             to_bot: Optional[Bot] = None) -> list:
         """Post to a channel; the responsible bot answers (escalating up
-        the reporting chain when it says so).  Returns new messages."""
+        the reporting chain when it says so).  Returns new messages.
+        ``to_bot`` forces the addressee (wake-bus activations)."""
         chan = next(
             (c for c in self.channels_all() if c["id"] == channel_id), None
         )
         if chan is None:
             raise OrgError(f"unknown channel {channel_id}")
         out = [self._append(channel_id, author, body)]
-        bot = self._responsible_bot(chan, body)
+        bot = to_bot if to_bot is not None else self._responsible_bot(
+            chan, body
+        )
         hops = 0
         visited = set()
         while bot is not None and hops <= self.max_escalations:
@@ -376,9 +391,11 @@ class OrgService:
             bot = self.get_bot(bot_id)
             if bot is None:
                 continue
+            # dispatch to the WOKEN bot, regardless of mentions/ownership
             out.extend(
                 self.post(
-                    channel_id, note or f"@{bot.name} wake", author="system"
+                    channel_id, note or f"wake {bot.name}",
+                    author="system", to_bot=bot,
                 )
             )
         return out

@@ -185,3 +185,34 @@ def test_web_ui_rows_use_table_context():
     src = open(path).read()
     assert "$row = (h)" in src
     assert "$(`<tr>" not in src, "raw div-parsed <tr> template reintroduced"
+
+
+def test_env_reference_covers_every_knob_the_tree_reads():
+    """Every HELIX_* env var read anywhere in helix_tpu/ must be
+    documented in the config reference (the reference auto-generates its
+    env docs from envconfig tags; ours are asserted complete)."""
+    import os
+    import re
+
+    from helix_tpu.config_reference import ENV_REFERENCE, render
+
+    root = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "helix_tpu",
+    )
+    read = set()
+    for dirpath, _, files in os.walk(root):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            if f == "config_reference.py":
+                continue
+            src = open(os.path.join(dirpath, f), errors="replace").read()
+            # any env read shape: environ.get, env.get (aliased), [ ]-index
+            read.update(re.findall(r'\.get\(\s*"(HELIX_\w+)"', src))
+            read.update(re.findall(r'\["(HELIX_\w+)"\]', src))
+    documented = {v.name for v in ENV_REFERENCE}
+    missing = read - documented
+    assert not missing, f"undocumented env vars: {sorted(missing)}"
+    text = render()
+    assert "HELIX_RUNNER_TOKEN" in text and "[auth]" in text

@@ -196,3 +196,40 @@ def test_mention_prefix_names_dont_collide():
     out = org.post(cid, "@dev2 please deploy")
     assert any("dev2: deploying." == m["body"] for m in out)
     assert llm.activations[0][0] == "dev2"
+
+
+def test_wake_targets_woken_bot_not_owner():
+    """A wake activates the WOKEN bot even with no mention and another
+    bot owning the channel."""
+    llm = ScriptedLLM({"ops": "ops: disks look fine.",
+                       "helpdesk": "helpdesk: ???"})
+    org = OrgService(llm=llm)
+    owner = org.create_bot("helpdesk")
+    ops = org.create_bot("ops")
+    cid = org.create_channel("infra", owner_bot=owner.id, members=(ops.id,))
+    org.wake(ops.id, "check disk usage")
+    out = org.drain_wakes(cid)
+    assert any("disks look fine" in m["body"] for m in out)
+    assert llm.activations[0][0] == "ops"
+
+
+def test_deleted_owner_channel_still_routes_mentions():
+    llm = ScriptedLLM({"ops": "ops: here."})
+    org = OrgService(llm=llm)
+    owner = org.create_bot("boss")
+    ops = org.create_bot("ops")
+    cid = org.create_channel("x", owner_bot=owner.id, members=(ops.id,))
+    org.delete_bot(owner.id)
+    out = org.post(cid, "@ops status?")
+    assert any("ops: here." == m["body"] for m in out)
+    # and an unaddressed post degrades to no bot reply, not a crash
+    out = org.post(cid, "anyone?")
+    assert len(out) == 1
+
+
+def test_empty_names_rejected():
+    org = OrgService()
+    with pytest.raises(OrgError):
+        org.create_bot("  ")
+    with pytest.raises(OrgError):
+        org.create_channel("")
