@@ -104,6 +104,13 @@ ENV_REFERENCE: tuple = (
     EnvVar("HELIX_SMTP_USER", "SMTP username.", section="integrations"),
     EnvVar("HELIX_SMTP_PASSWORD", "SMTP password.",
            section="integrations"),
+    # -- observability ----------------------------------------------------
+    EnvVar(
+        "HELIX_PING_URL",
+        "Version-ping beacon endpoint (anonymous {product, version, ts} "
+        "POST, hourly). Unset: no beacon (the default).",
+        section="observability",
+    ),
     # -- knowledge --------------------------------------------------------
     EnvVar(
         "HELIX_CRAWLER_ALLOW_PRIVATE",

@@ -1,0 +1,118 @@
+"""Janitor: error capture + version ping.
+
+The counterpart of the reference's Sentry janitor
+(``api/pkg/janitor/janitor.go:38-45``: init + error/event reporting) and
+phone-home ping service (``serve.go:443-449``), without the external
+Sentry dependency: captured errors land in a ring buffer the admin
+surface exposes, and an optional reporter callable forwards them
+(Sentry/webhook/log — deployment's choice).  The version ping is a
+background beacon, disabled unless a URL is configured.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import traceback
+from typing import Callable, Optional
+
+
+class Janitor:
+    def __init__(
+        self,
+        reporter: Optional[Callable[[dict], None]] = None,
+        capacity: int = 200,
+    ):
+        self.reporter = reporter
+        self.recent: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self.captured_total = 0
+
+    def capture(self, exc: BaseException, context: str = "") -> dict:
+        doc = {
+            "error": f"{type(exc).__name__}: {exc}",
+            "context": context,
+            "trace": traceback.format_exception(
+                type(exc), exc, exc.__traceback__, limit=8
+            ),
+            "ts": time.time(),
+        }
+        with self._lock:
+            self.recent.appendleft(doc)
+            self.captured_total += 1
+        # the log trail keeps full tracebacks observable even after a
+        # restart wipes the in-memory ring
+        import logging
+
+        logging.getLogger("helix.janitor").error(
+            "captured (%s): %s\n%s", context, doc["error"],
+            "".join(doc["trace"]),
+        )
+        if self.reporter is not None:
+            try:
+                self.reporter(doc)
+            except Exception:  # noqa: BLE001 — the janitor never raises
+                pass
+        return doc
+
+    def errors(self, limit: int = 50, include_trace: bool = False) -> list:
+        with self._lock:
+            docs = list(self.recent)[:limit]
+        if include_trace:
+            return [dict(d) for d in docs]
+        return [{k: v for k, v in d.items() if k != "trace"} for d in docs]
+
+
+class VersionPing:
+    """Periodic anonymous beacon (reference: the ping service) — inert
+    unless a URL is configured; never blocks or raises."""
+
+    def __init__(
+        self,
+        url: str = "",
+        version: str = "",
+        interval: float = 3600.0,
+        http_post: Optional[Callable] = None,
+    ):
+        self.url = url
+        self.version = version
+        self.interval = interval
+        self.http_post = http_post or self._default_post
+        self.sent = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @staticmethod
+    def _default_post(url: str, doc: dict) -> None:
+        import requests
+
+        requests.post(url, json=doc, timeout=10)
+
+    def start(self) -> "VersionPing":
+        if not self.url:
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="helix-ping", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    def _loop(self):
+        # first beacon after a full interval — constructing a control
+        # plane (CLI one-shots, tests) must not fire network calls at t=0
+        self._stop.wait(self.interval)
+        while not self._stop.is_set():
+            try:
+                self.http_post(
+                    self.url,
+                    {"product": "helix-tpu", "version": self.version,
+                     "ts": time.time()},
+                )
+                self.sent += 1
+            except Exception:  # noqa: BLE001 — beacons never break us
+                pass
+            self._stop.wait(self.interval)

@@ -267,6 +267,18 @@ class ControlPlane:
             llm=org_llm,
         )
 
+        # janitor + version ping (reference: api/pkg/janitor, serve.go
+        # ping service) — errors captured to an admin-readable ring;
+        # the beacon is inert unless HELIX_PING_URL is configured
+        from helix_tpu import __version__
+        from helix_tpu.control.janitor import Janitor, VersionPing
+
+        self.janitor = Janitor()
+        self.ping = VersionPing(
+            url=_os_oauth.environ.get("HELIX_PING_URL", ""),
+            version=__version__,
+        ).start()
+
         from helix_tpu.control.notifications import NotificationService
 
         self.notifications = NotificationService.from_env()
@@ -334,6 +346,15 @@ class ControlPlane:
                 },
             ).start()
 
+    def stop(self):
+        """Stop every background service (shutdown / test teardown)."""
+        self.orchestrator.stop()
+        self.knowledge.stop()
+        self.triggers.stop()
+        self.ping.stop()
+        if self.compute is not None:
+            self.compute.stop()
+
     def _pick_embed_model(self):
         for st in self.router.runners():
             if not st.routable:
@@ -396,6 +417,18 @@ class ControlPlane:
         if not u.admin:
             return _err(403, "platform admin required")
         return None
+
+    @web.middleware
+    async def error_middleware(self, request, handler):
+        """Unhandled handler exceptions are captured by the janitor and
+        surfaced as structured 500s (never bare tracebacks)."""
+        try:
+            return await handler(request)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # noqa: BLE001 — capture + clean 500
+            self.janitor.capture(e, context=f"{request.method} {request.path}")
+            return _err(500, f"internal error: {type(e).__name__}")
 
     @web.middleware
     async def auth_middleware(self, request, handler):
@@ -465,7 +498,9 @@ class ControlPlane:
         return u.id if u else request.query.get("owner", "anonymous")
 
     def build_app(self) -> web.Application:
-        app = web.Application(middlewares=[self.auth_middleware])
+        app = web.Application(
+            middlewares=[self.error_middleware, self.auth_middleware]
+        )
         r = app.router
         r.add_get("/", self.web_ui)
         r.add_get("/healthz", self.healthz)
@@ -549,8 +584,9 @@ class ControlPlane:
             "/api/v1/org/channels/{id}/messages", self.org_messages
         )
         r.add_post("/api/v1/org/channels/{id}/messages", self.org_post)
-        # notifications
+        # notifications + captured errors
         r.add_get("/api/v1/notifications", self.list_notifications)
+        r.add_get("/api/v1/errors", self.list_errors)
         # triggers + webhooks
         r.add_get("/api/v1/triggers", self.list_triggers)
         r.add_post("/api/v1/triggers", self.create_trigger)
@@ -1270,11 +1306,37 @@ class ControlPlane:
             return _err(404, str(e))
         return web.json_response({"messages": new})
 
-    async def list_notifications(self, request):
+    @staticmethod
+    def _parse_limit(request, default: int = 50, cap: int = 500):
+        """-> (limit, None) or (None, error response)."""
         try:
-            limit = max(1, min(int(request.query.get("limit", 50)), 500))
+            return max(1, min(int(request.query.get("limit", default)), cap)), None
         except ValueError:
-            return _err(400, "limit must be an integer")
+            return None, _err(400, "limit must be an integer")
+
+    async def list_errors(self, request):
+        """Captured unhandled errors (janitor ring) for the admin UI;
+        ?trace=1 includes full tracebacks (the endpoint is admin-only)."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        limit, err = self._parse_limit(request)
+        if err is not None:
+            return err
+        return web.json_response(
+            {
+                "errors": self.janitor.errors(
+                    limit,
+                    include_trace=request.query.get("trace") == "1",
+                ),
+                "captured_total": self.janitor.captured_total,
+            }
+        )
+
+    async def list_notifications(self, request):
+        limit, err = self._parse_limit(request)
+        if err is not None:
+            return err
         return web.json_response(
             {"notifications": self.notifications.history(limit)}
         )

@@ -1,0 +1,103 @@
+"""Janitor error capture + version ping (reference: api/pkg/janitor
+Sentry init/reporting, serve.go ping service)."""
+
+import asyncio
+import threading
+import time
+
+from helix_tpu.control.janitor import Janitor, VersionPing
+
+
+class TestJanitor:
+    def test_capture_and_ring(self):
+        reported = []
+        j = Janitor(reporter=reported.append, capacity=3)
+        for i in range(5):
+            try:
+                raise ValueError(f"boom {i}")
+            except ValueError as e:
+                j.capture(e, context=f"job {i}")
+        assert j.captured_total == 5
+        errs = j.errors()
+        assert len(errs) == 3                      # ring capped
+        assert errs[0]["error"] == "ValueError: boom 4"
+        assert errs[0]["context"] == "job 4"
+        assert "trace" not in errs[0]              # traces stay internal
+        assert len(reported) == 5
+
+    def test_broken_reporter_never_raises(self):
+        def bad(doc):
+            raise RuntimeError("sentry down")
+
+        j = Janitor(reporter=bad)
+        try:
+            raise KeyError("x")
+        except KeyError as e:
+            j.capture(e)
+        assert j.captured_total == 1
+
+
+class TestVersionPing:
+    def test_disabled_without_url(self):
+        p = VersionPing(url="").start()
+        assert p._thread is None
+
+    def test_beacon_posts_and_survives_failures(self):
+        sent = []
+        calls = {"n": 0}
+
+        def post(url, doc):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("network down")
+            sent.append((url, doc))
+
+        p = VersionPing(
+            url="http://beacon", version="0.2.0", interval=0.05,
+            http_post=post,
+        ).start()
+        # first beacon only after a full interval (no POST at t=0)
+        assert calls["n"] == 0
+        deadline = time.time() + 5
+        while not sent and time.time() < deadline:
+            time.sleep(0.02)
+        p.stop()
+        assert sent and sent[0][1]["product"] == "helix-tpu"
+        assert sent[0][1]["version"] == "0.2.0"
+
+
+def test_unhandled_handler_errors_captured_as_clean_500():
+    from aiohttp import web
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from helix_tpu.control.server import ControlPlane
+
+    async def main():
+        cp = ControlPlane()
+        app = cp.build_app()
+
+        async def kaboom(request):
+            raise RuntimeError("wires crossed")
+
+        app.router.add_get("/explode", kaboom)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            r = await client.get("/explode")
+            assert r.status == 500
+            doc = await r.json()
+            assert doc["error"]["message"] == "internal error: RuntimeError"
+            assert "wires crossed" not in str(doc)   # no leaked detail
+            assert cp.janitor.captured_total == 1
+            assert cp.janitor.errors()[0]["context"] == "GET /explode"
+            # admin surface exposes the ring (auth off in this test)
+            r = await client.get("/api/v1/errors")
+            errs = (await r.json())["errors"]
+            assert errs[0]["error"].startswith("RuntimeError")
+        finally:
+            await client.close()
+            cp.orchestrator.stop()
+            cp.knowledge.stop()
+            cp.triggers.stop()
+
+    asyncio.run(main())
