@@ -22,12 +22,84 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
+# An accelerator PJRT plugin registered at interpreter start (a
+# sitecustomize on PYTHONPATH) may have pinned jax_platforms via
+# config.update, which OUTRANKS the env var above — pin it back so the
+# suite can never touch the relay-backed accelerator even when run with
+# PYTHONPATH intact (same pin ``__graft_entry__.dryrun_multichip`` applies).
+jax.config.update("jax_platforms", "cpu")
+# Verify the pin took (config.update silently no-ops once backends are
+# initialised) and force deterministic early CPU init — if an earlier
+# plugin already initialised the axon backend, fail loudly here instead of
+# letting some test wedge the single-tenant relay.
+assert jax.default_backend() == "cpu", (
+    f"jax backend is {jax.default_backend()!r}, not cpu — backends were "
+    "initialised before conftest could pin jax_platforms"
+)
+
 jax.config.update("jax_default_matmul_precision", "highest")
 # persistent compile cache: repeat test runs skip XLA compilation entirely
 jax.config.update("jax_compilation_cache_dir", "/root/.jax_test_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Watchdog backstop: a wedged accelerator relay once deadlocked the suite
+# mid-run inside backend init. The jax_platforms pin above is the fix;
+# pytest's own faulthandler plugin (``faulthandler_timeout`` in pytest.ini)
+# dumps tracebacks if a test phase stalls; this timer then hard-exits so CI
+# never hangs forever. The timer spans one test's whole runtest protocol
+# (setup+call+teardown); the grace above faulthandler_timeout absorbs that
+# plus cold XLA compiles. Longest legitimate test (32k-token chunked
+# prefill e2e) runs ~90-120 s cold. Set HELIX_TEST_TIMEOUT_S=0 to disable.
+
+
+def _parse_timeout(default: float = 480.0) -> float:
+    try:
+        return float(os.environ.get("HELIX_TEST_TIMEOUT_S", default))
+    except ValueError:
+        return default
+
+
+_TEST_TIMEOUT_S = _parse_timeout()
+
+
+def _hard_exit(item) -> None:
+    try:
+        # restore the real stderr fd so the message reaches the terminal
+        # (we are about to _exit; thread-safety of capman no longer matters)
+        capman = item.config.pluginmanager.get_plugin("capturemanager")
+        if capman is not None:
+            capman.suspend_global_capture(in_=True)
+    except Exception:  # noqa: BLE001 — best effort on the way out
+        pass
+    try:
+        os.write(
+            2,
+            (
+                f"\n[conftest watchdog] test {item.nodeid!r} ran longer "
+                f"than {_TEST_TIMEOUT_S:.0f}s (setup+call+teardown) — hard "
+                f"exit. A faulthandler dump appears above iff one phase "
+                f"alone exceeded faulthandler_timeout.\n"
+            ).encode(),
+        )
+    except OSError:
+        pass
+    os._exit(2)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_protocol(item, nextitem):
+    timer = None
+    if _TEST_TIMEOUT_S > 0:
+        timer = threading.Timer(_TEST_TIMEOUT_S, _hard_exit, args=(item,))
+        timer.daemon = True
+        timer.start()
+    yield
+    if timer is not None:
+        timer.cancel()
 
 
 @pytest.fixture(scope="session")
