@@ -37,17 +37,37 @@ class ApplyState:
 
 
 def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
-    """Realise one ProfileModel as a ServedModel (engine or embedder)."""
+    """Realise one ProfileModel as a ServedModel (engine or embedder).
+
+    The profile's ``mesh:`` block is realised here: a multi-chip or
+    offset MeshSpec becomes a ``jax.sharding.Mesh`` over its device slice,
+    weights load sharded (shard-wise host->HBM), and the Engine's KV pool +
+    forward shard over it — the TPU analogue of compose pinning a vLLM
+    service to ``device_ids`` with ``--tensor-parallel-size``
+    (``design/sample-profiles/8xH100-vllm.yaml``,
+    ``api/pkg/runner/composeparse/parse.go:49-102``).
+    """
     import jax
 
     from helix_tpu.serving.tokenizer import load_tokenizer
 
     tokenizer = load_tokenizer(pm.checkpoint, pm.name)
 
+    if mesh is None and (pm.mesh.num_devices > 1 or pm.mesh.device_offset > 0):
+        from helix_tpu.device.mesh import build_mesh
+
+        mesh = build_mesh(pm.mesh)
+
     if pm.kind == "embedding":
         from helix_tpu.models.bge import EmbeddingRunner
 
         embedder = EmbeddingRunner.build(pm, tokenizer)
+        if mesh is not None:
+            # encoders are small: no intra-model sharding, but commit the
+            # weights to the slice's first device so embed traffic stays
+            # off other models' chips (computation follows committed data)
+            dev = mesh.devices.flat[0]
+            embedder.params = jax.device_put(embedder.params, dev)
         return ServedModel(
             name=pm.name, loop=None, tokenizer=tokenizer,
             kind="embedding", embedder=embedder,
@@ -70,7 +90,10 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         from helix_tpu.serving.vision import VisionRunner
 
         if pm.checkpoint:
-            model_cfg, vcfg, params = load_qwen2_vl(pm.checkpoint)
+            # mesh-aware load: text tower placed shard-wise, vision tower
+            # committed whole to the slice's first device (see
+            # ``models.qwen2_vl.load_qwen2_vl``)
+            model_cfg, vcfg, params = load_qwen2_vl(pm.checkpoint, mesh=mesh)
             model_cfg = dataclasses.replace(model_cfg, name=pm.name)
             vparams = params.pop("visual")
         else:
@@ -96,13 +119,42 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     elif pm.checkpoint:
         from helix_tpu.models.loader import load_params
 
-        model_cfg, params = load_params(pm.checkpoint)
+        # mesh-aware load: each stacked tensor is placed with its
+        # NamedSharding as it is built, so host->HBM transfer is shard-wise
+        # and no chip ever holds the full bf16 model
+        model_cfg, params = load_params(pm.checkpoint, mesh=mesh)
         model_cfg = dataclasses.replace(model_cfg, name=pm.name)
     else:
         model_cfg = CATALOG.get(pm.name) or ModelConfig.tiny(name=pm.name)
         params = init_params(model_cfg, jax.random.PRNGKey(0))
+    if mesh is not None and not pm.checkpoint:
+        # checkpoint branches place shard-wise inside the loaders; the
+        # random-init branches shard here. The text tower (llama layout for
+        # every kind) shards Megatron-style; a vision tower stays whole,
+        # committed to the slice's first device so image encode traffic
+        # never lands on another model's chips.
+        from helix_tpu.models.llama import param_logical_axes
+        from helix_tpu.parallel.sharding import shard_params
+
+        params = shard_params(params, mesh, param_logical_axes(model_cfg))
+        if vision_runner is not None:
+            vision_runner.vparams = jax.device_put(
+                vision_runner.vparams, mesh.devices.flat[0]
+            )
     if pm.quantization == "int8":
-        params = jax.jit(quantize_params, donate_argnums=0)(params)
+        if mesh is not None:
+            from helix_tpu.models.llama import param_logical_axes
+            from helix_tpu.ops.quant import quantized_logical_axes
+            from helix_tpu.parallel.sharding import sharding_tree
+
+            out_sh = sharding_tree(
+                mesh, quantized_logical_axes(param_logical_axes(model_cfg))
+            )
+            params = jax.jit(
+                quantize_params, donate_argnums=0, out_shardings=out_sh
+            )(params)
+        else:
+            params = jax.jit(quantize_params, donate_argnums=0)(params)
 
     ekw = dict(pm.engine)
     if pm.context_length and "max_model_len" not in ekw:
@@ -120,7 +172,7 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         eos_token_ids=tuple(tokenizer.eos_ids),
         **ekw,
     )
-    engine = Engine(model_cfg, params, ecfg)
+    engine = Engine(model_cfg, params, ecfg, mesh=mesh)
     engine.warmup()   # compile prefill/decode before the model goes routable
     loop = EngineLoop(engine, name=pm.name).start()
     return ServedModel(

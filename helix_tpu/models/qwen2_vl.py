@@ -359,10 +359,16 @@ def text_forward_mrope(
 # ---------------------------------------------------------------------------
 
 
-def load_qwen2_vl(model_dir: str):
+def load_qwen2_vl(model_dir: str, mesh=None):
     """(text_cfg, vision_cfg, params) from an HF Qwen2-VL checkpoint.
     Weight names per ``transformers`` Qwen2VLForConditionalGeneration
-    (model.visual.* / model.language_model.*)."""
+    (model.visual.* / model.language_model.*).
+
+    With ``mesh``, the text tower is placed shard-wise with its
+    NamedShardings as it leaves host memory (mirrors
+    ``models.loader.load_params``) and the vision tower is committed whole
+    to the mesh's first device — no device ever holds the full text tower.
+    """
     import json
     import os
 
@@ -485,26 +491,35 @@ def load_qwen2_vl(model_dir: str):
         "w_up": {"weight": tstack(lambda i: tlin(i, "mlp.up_proj"))},
         "w_down": {"weight": tstack(lambda i: tlin(i, "mlp.down_proj"))},
     }
-    params = {
+    text = {
         "embed": {"weight": g("embed_tokens.weight")},
         "layers": layers,
         "final_norm": {"weight": g("norm.weight")},
-        "visual": jax.tree.map(jnp.asarray, vision),
     }
     if not tcfg.tie_word_embeddings:
         try:
-            params["lm_head"] = {
+            text["lm_head"] = {
                 "weight": np.ascontiguousarray(g("lm_head.weight").T)
             }
         except KeyError:
-            params["lm_head"] = {
-                "weight": np.ascontiguousarray(params["embed"]["weight"].T)
+            text["lm_head"] = {
+                "weight": np.ascontiguousarray(text["embed"]["weight"].T)
             }
-    text = {k: v for k, v in params.items() if k != "visual"}
-    text = jax.tree.map(jnp.asarray, text)
-    text["visual"] = params["visual"]
     import dataclasses as _dc
 
     hf_dtype = hf.get("torch_dtype") or hf.get("dtype") or "float32"
     tcfg = _dc.replace(tcfg, attention_bias=True, dtype=str(hf_dtype))
+
+    if mesh is not None:
+        from helix_tpu.models.llama import param_logical_axes
+        from helix_tpu.parallel.sharding import shard_params
+
+        text = shard_params(text, mesh, param_logical_axes(tcfg))
+        dev0 = mesh.devices.flat[0]
+        text["visual"] = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), dev0), vision
+        )
+    else:
+        text = jax.tree.map(jnp.asarray, text)
+        text["visual"] = jax.tree.map(jnp.asarray, vision)
     return tcfg, vcfg, text

@@ -71,6 +71,45 @@ def quantize_params(params: Any) -> Any:
     return walk(params)
 
 
+def quantized_logical_axes(axes_tree: Any) -> Any:
+    """Transform a logical-axes tree matching the *unquantized* param layout
+    (``models.llama.param_logical_axes``) into one matching
+    ``quantize_params``' output layout, so int8 trees can be sharded with
+    ``parallel.sharding.shard_params`` / used as jit out_shardings.
+
+    Mirrors the walk in ``quantize_params``: every quantized ``weight``
+    gains a ``scale`` whose reduced (contraction) axes are replicated and
+    whose output-channel axis keeps the weight's sharding — the dequant
+    multiply then needs no extra collectives.  Embeddings gain a per-row
+    ``embed_scale`` sharded like the vocab axis.
+    """
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            out = {}
+            for k, v in tree.items():
+                if (
+                    k == "weight"
+                    and isinstance(v, tuple)
+                    and len(v) >= 2
+                    and not any("norm" in p for p in path)
+                ):
+                    out["weight"] = v
+                    if path and path[-1] == "embed":
+                        out["embed_scale"] = (v[0], None)
+                    else:
+                        out["scale"] = tuple(
+                            a if i == len(v) - 1 else None
+                            for i, a in enumerate(v)
+                        )
+                else:
+                    out[k] = walk(v, path + (k,))
+            return out
+        return tree
+
+    return walk(axes_tree)
+
+
 def maybe_dequant_dense(x, p: dict, compute_dtype=None):
     """Dense through a weight dict {weight[, scale, bias, lora_a/lora_b]}.
 

@@ -63,6 +63,34 @@ class TestVisionTower:
         got = vision_forward(params["visual"], vcfg, jnp.asarray(patches), grid)
         np.testing.assert_allclose(np.asarray(got), want, atol=5e-4)
 
+    def test_load_with_mesh_places_shard_wise(self, hf_tiny, cpu_devices):
+        """Mesh-aware checkpoint load: text tower sharded over the slice,
+        vision tower committed whole to the slice's first device, values
+        identical to the unsharded load."""
+        from helix_tpu.device.mesh import MeshSpec, build_mesh
+
+        _, d = hf_tiny
+        mesh = build_mesh(MeshSpec(tp=2, device_offset=4))
+        tcfg, vcfg, params = load_qwen2_vl(d, mesh=mesh)
+        visual = params.pop("visual")
+        text_devs = {
+            dev.id
+            for leaf in jax.tree.leaves(params)
+            for dev in leaf.devices()
+        }
+        assert text_devs == {4, 5}
+        vis_devs = {
+            dev.id
+            for leaf in jax.tree.leaves(visual)
+            for dev in leaf.devices()
+        }
+        assert vis_devs == {4}
+
+        _, _, plain = load_qwen2_vl(d)
+        plain.pop("visual")
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(plain)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_vision_two_images_isolated(self, hf_tiny):
         """Patches of image 2 must not influence image 1's embeddings."""
         _, d = hf_tiny

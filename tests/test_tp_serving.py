@@ -1,0 +1,209 @@
+"""Tensor-parallel serving: the profile ``mesh:`` block must actually shard.
+
+Round-2 verdict finding: ``mesh: {tp: N, device_offset: K}`` was parsed and
+then ignored by the node agent, so a profile that declared TP serving ran
+replicated on one device with no test catching it.  These tests close that
+hole on the virtual 8-device CPU mesh:
+
+- greedy decode parity: a tp=2 engine (sharded params + sharded KV pool)
+  must produce the same tokens as the single-device engine;
+- int8 parity: quantized trees shard via ``quantized_logical_axes``;
+- the node agent realises ``mesh:`` blocks as disjoint device slices, the
+  TPU analogue of compose pinning vLLM services to disjoint ``device_ids``
+  (reference ``design/sample-profiles/8xH100-vllm.yaml``,
+  ``api/pkg/runner/composeparse/parse.go:49-102``).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from helix_tpu.control.node_agent import NodeAgent
+from helix_tpu.control.profile import ServingProfile
+from helix_tpu.device.mesh import MeshSpec, build_mesh
+from helix_tpu.engine.engine import Engine, EngineConfig
+from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import init_params, param_logical_axes
+from helix_tpu.ops.quant import quantize_params, quantized_logical_axes
+from helix_tpu.parallel.sharding import shard_params, sharding_tree
+
+ECFG = dict(
+    max_decode_batch=2, page_size=16, num_pages=64,
+    max_pages_per_seq=8, max_prefill_len=32, attn_backend="reference",
+)
+
+PROMPTS = [
+    [(i * 7 + 3) % 250 + 1 for i in range(21)],
+    [(i * 5 + 11) % 250 + 1 for i in range(13)],
+]
+
+
+def _generate(engine):
+    return engine.generate(
+        PROMPTS, SamplingParams(temperature=0.0, max_tokens=8)
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_cfg():
+    return ModelConfig.tiny(dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def baseline_tokens(tiny_cfg):
+    params = init_params(tiny_cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    eng = Engine(tiny_cfg, params, EngineConfig(**ECFG))
+    return _generate(eng)
+
+
+def test_tp2_greedy_parity(tiny_cfg, baseline_tokens):
+    mesh = build_mesh(MeshSpec(tp=2))
+    params = init_params(tiny_cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    params = shard_params(params, mesh, param_logical_axes(tiny_cfg))
+    eng = Engine(tiny_cfg, params, EngineConfig(**ECFG), mesh=mesh)
+    # the KV pool must really be sharded over tp, not just the params
+    from jax.sharding import NamedSharding
+
+    kv_sharding = eng.cache.k_pages.sharding
+    assert isinstance(kv_sharding, NamedSharding), (
+        f"KV pool is not mesh-sharded: {kv_sharding}"
+    )
+    assert kv_sharding.spec[1] == "tp", kv_sharding.spec
+    assert _generate(eng) == baseline_tokens
+
+
+def test_tp2_int8_parity(tiny_cfg):
+    params = init_params(tiny_cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    q_single = jax.jit(quantize_params)(params)
+    eng1 = Engine(tiny_cfg, q_single, EngineConfig(**ECFG))
+    want = _generate(eng1)
+
+    mesh = build_mesh(MeshSpec(tp=2))
+    sharded = shard_params(params, mesh, param_logical_axes(tiny_cfg))
+    out_sh = sharding_tree(
+        mesh, quantized_logical_axes(param_logical_axes(tiny_cfg))
+    )
+    q_tp = jax.jit(quantize_params, out_shardings=out_sh)(sharded)
+    eng2 = Engine(tiny_cfg, q_tp, EngineConfig(**ECFG), mesh=mesh)
+    assert _generate(eng2) == want
+
+
+def test_node_agent_realises_mesh_disjoint_slices():
+    """Two chat models on tp=2 slices at offsets 0 and 2 + an embedder at
+    offset 4: engines shard over disjoint devices (the v5e8 profile shape)."""
+    agent = NodeAgent("n1")
+    profile = ServingProfile.from_dict(
+        {
+            "name": "tp-slices",
+            "requirement": {"chips": 8},
+            "models": [
+                {
+                    "name": "chat-a",
+                    "mesh": {"tp": 2, "device_offset": 0},
+                    "engine": dict(ECFG),
+                },
+                {
+                    "name": "chat-b",
+                    "mesh": {"tp": 2, "device_offset": 2},
+                    "engine": dict(ECFG),
+                },
+                {
+                    "name": "embed-c",
+                    "kind": "embedding",
+                    "mesh": {"tp": 1, "device_offset": 4},
+                },
+            ],
+        }
+    )
+    try:
+        state = agent.apply_profile(profile)
+        assert state.status == "running", state.error
+
+        devs = {}
+        for name in ("chat-a", "chat-b"):
+            served = agent.registry.get(name)
+            mesh = served.loop.engine.mesh
+            assert mesh is not None, f"{name}: profile mesh was not wired"
+            assert mesh.shape["tp"] == 2
+            devs[name] = set(d.id for d in mesh.devices.flat)
+        assert devs["chat-a"] == {0, 1}
+        assert devs["chat-b"] == {2, 3}
+
+        emb = agent.registry.get("embed-c")
+        emb_devs = {
+            d.id
+            for leaf in jax.tree.leaves(emb.embedder.params)
+            for d in leaf.devices()
+        }
+        assert emb_devs == {4}
+
+        # a tp engine must actually decode (freeze the loop thread first —
+        # engine.step is single-owner; the cache buffer is donated per step)
+        loop = agent.registry.get("chat-a").loop
+        loop.stop(join=True)
+        toks = loop.engine.generate(
+            [PROMPTS[0]], SamplingParams(temperature=0.0, max_tokens=4)
+        )
+        assert len(toks[0]) == 4
+    finally:
+        agent.stop()
+
+
+def test_node_agent_vision_mesh_shards_text_tower():
+    """A VL model on a tp=2 slice: the llama-layout text tower shards over
+    the slice, the vision tower is committed whole to the slice's first
+    device — so the v5e8 profile's three models really land on disjoint
+    chips."""
+    agent = NodeAgent("n1")
+    profile = ServingProfile.from_dict(
+        {
+            "name": "vl-slice",
+            "requirement": {"chips": 8},
+            "models": [
+                {
+                    "name": "vl-a",
+                    "kind": "vision",
+                    "mesh": {"tp": 2, "device_offset": 2},
+                    "engine": dict(ECFG),
+                },
+            ],
+        }
+    )
+    try:
+        state = agent.apply_profile(profile)
+        assert state.status == "running", state.error
+        served = agent.registry.get("vl-a")
+        eng = served.loop.engine
+        assert eng.mesh is not None and eng.mesh.shape["tp"] == 2
+        text_devs = {
+            d.id
+            for leaf in jax.tree.leaves(eng.params)
+            for d in leaf.devices()
+        }
+        assert text_devs == {2, 3}
+        vis_devs = {
+            d.id
+            for leaf in jax.tree.leaves(served.vision.vparams)
+            for d in leaf.devices()
+        }
+        assert vis_devs == {2}
+    finally:
+        agent.stop()
+
+
+def test_node_agent_single_device_has_no_mesh():
+    agent = NodeAgent("n1")
+    profile = ServingProfile.from_dict(
+        {
+            "name": "plain",
+            "requirement": {"chips": 1},
+            "models": [{"name": "solo", "engine": dict(ECFG)}],
+        }
+    )
+    try:
+        state = agent.apply_profile(profile)
+        assert state.status == "running", state.error
+        assert agent.registry.get("solo").loop.engine.mesh is None
+    finally:
+        agent.stop()
