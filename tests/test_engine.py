@@ -543,6 +543,40 @@ class TestSequenceParallelPrefill:
         sharded = eng.generate([prompt], sp)[0]
         assert sharded == single
 
+    def test_sp_non_divisible_geometry_engages_ring(
+        self, tiny_model, cpu_devices, monkeypatch
+    ):
+        """Chunk length 4 is not divisible by sp=8: ring attention must
+        still engage (padding inside ring_attention), never silently fall
+        back to replicated attention — and tokens must match the
+        single-device engine across the ragged chunk tail."""
+        import helix_tpu.parallel.ring_attention as ra
+        from helix_tpu.device.mesh import MeshSpec, build_mesh
+
+        calls = {"n": 0}
+        real = ra.ring_attention
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(ra, "ring_attention", counting)
+
+        cfg, params = tiny_model
+        ecfg = EngineConfig(
+            max_decode_batch=1, page_size=4, num_pages=256,
+            max_pages_per_seq=64, max_prefill_len=4,
+            attn_backend="reference",
+        )
+        prompt = [(7 * i) % 190 + 1 for i in range(23)]
+        sp = SamplingParams(temperature=0.0, max_tokens=5)
+        single = Engine(cfg, params, ecfg).generate([prompt], sp)[0]
+        mesh = build_mesh(MeshSpec(sp=8))
+        eng = Engine(cfg, params, ecfg, mesh=mesh)
+        sharded = eng.generate([prompt], sp)[0]
+        assert calls["n"] > 0, "ring attention never engaged"
+        assert sharded == single
+
 
 class TestPackedPrefill:
     """A burst of short prompts prefills in ONE packed forward call."""
