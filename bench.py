@@ -27,7 +27,7 @@ import time
 A100_VLLM_LLAMA3_8B_TOKS = 2300.0  # public vLLM A100-80G decode throughput
 
 
-def _device_healthy(timeout_s: float = 90.0) -> bool:
+def _device_healthy_once(timeout_s: float = 90.0) -> bool:
     """Probe the accelerator in a subprocess: the axon TPU relay is
     single-tenant and can wedge (a hung relay blocks the first jax op
     forever, even under JAX_PLATFORMS=cpu, because plugin init touches it).
@@ -44,6 +44,31 @@ def _device_healthy(timeout_s: float = 90.0) -> bool:
         return p.returncode == 0
     except subprocess.TimeoutExpired:
         return False
+
+
+def _device_healthy() -> bool:
+    """Retry the probe over a window: the relay wedges and *recovers* (its
+    grant timeout is minutes), so one 90 s attempt undersells a chip that
+    would be reachable two minutes later.  Bounded by HELIX_BENCH_PROBE_S
+    (default 15 min) so the driver still always gets its JSON line."""
+    try:
+        budget_s = float(os.environ.get("HELIX_BENCH_PROBE_S", "900"))
+    except ValueError:
+        budget_s = 900.0
+    deadline = time.monotonic() + budget_s
+    attempt = 0
+    while True:
+        attempt += 1
+        if _device_healthy_once():
+            return True
+        remaining = deadline - time.monotonic()
+        print(
+            f"[bench] device probe attempt {attempt} failed; "
+            f"{remaining:.0f}s of probe budget left", file=sys.stderr,
+        )
+        if remaining <= 0:
+            return False
+        time.sleep(min(60.0, max(0.0, remaining)))
 
 
 def main():
@@ -72,6 +97,11 @@ def main():
 
     import jax
     import jax.numpy as jnp
+
+    # persistent compile cache: repeat bench runs (and the warmup pass
+    # below) skip XLA compilation entirely, same as tests/conftest.py
+    jax.config.update("jax_compilation_cache_dir", "/root/.jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     from helix_tpu.engine.engine import Engine, EngineConfig
     from helix_tpu.engine.sampling import SamplingParams
@@ -164,22 +194,32 @@ def main():
     ]
     sampling = SamplingParams(temperature=0.0, max_tokens=gen_len)
 
-    # warmup: one full generation pass compiles prefill+decode
-    eng.generate(prompts[:1], SamplingParams(temperature=0.0, max_tokens=4))
-
     from helix_tpu.engine.engine import Request
 
-    reqs = [
-        Request(id=f"bench-{i}", prompt_tokens=list(p), sampling=sampling)
-        for i, p in enumerate(prompts)
-    ]
-    t0 = time.perf_counter()
-    eng.num_decode_tokens = 0
-    for r in reqs:
-        eng.add_request(r)
-    while eng.has_work():
-        eng.step()
-    dt = time.perf_counter() - t0
+    def run_workload(tag: str):
+        """Admit the full batch at once and drain it — the measured
+        pattern. Called twice: the first pass IS the warmup, so every
+        shape the timed pass hits (each packed-prefill bucket the
+        admission loop packs this batch into + the fused decode step) is
+        compiled before the clock starts. Timing the warm pass is what
+        round-2's harness got wrong: it warmed one request, then timed
+        two, and the second packed bucket compiled inside the window."""
+        reqs = [
+            Request(
+                id=f"{tag}-{i}", prompt_tokens=list(p), sampling=sampling
+            )
+            for i, p in enumerate(prompts)
+        ]
+        t0 = time.perf_counter()
+        for r in reqs:
+            eng.add_request(r)
+        while eng.has_work():
+            eng.step()
+        dt = time.perf_counter() - t0
+        return reqs, dt
+
+    run_workload("warmup")          # compiles every measured shape
+    reqs, dt = run_workload("bench")
     outs = [r.output_tokens for r in reqs]
     total_new = sum(len(o) for o in outs)
     toks_per_s = total_new / dt
@@ -207,6 +247,17 @@ def main():
         "prompt_len": prompt_len,
         "gen_len": gen_len,
     }
+    if on_tpu:
+        # decode-side model FLOPs utilisation: each generated token moves
+        # ~2 FLOPs per active parameter through the MXU; a v5e chip peaks
+        # at 197 TFLOP/s bf16 (394 TOPS int8 — we report against bf16, the
+        # conservative denominator for int8 weight-only which computes in
+        # bf16).
+        V5E_PEAK_BF16_FLOPS = 197e12
+        LLAMA3_8B_PARAMS = 8.03e9
+        result["mfu_est"] = round(
+            toks_per_s * 2 * LLAMA3_8B_PARAMS / V5E_PEAK_BF16_FLOPS, 4
+        )
     print(json.dumps(result))
 
 
