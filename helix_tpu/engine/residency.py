@@ -112,9 +112,17 @@ class ResidencyManager:
         self._resident: dict[str, Resident] = {}
         self._known: set = set()
         self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._loading: set = set()
+        self._load_errors: dict[str, BaseException] = {}
+        # bytes held for in-flight prefetches so concurrent acquires can't
+        # claim the headroom the prefetch just evicted for
+        self._reserved: dict[str, int] = {}
         # metrics
         self.evictions = 0
         self.loads = 0
+        self.swap_ms: dict[str, float] = {}   # model -> last acquire stall
+        self.load_ms: dict[str, float] = {}   # model -> last build duration
 
     # -- registry-compatible surface --------------------------------------
     def register_name(self, name: str) -> None:
@@ -137,7 +145,20 @@ class ResidencyManager:
 
     def used_bytes(self) -> int:
         with self._lock:
-            return sum(r.bytes for r in self._resident.values())
+            return self.used_bytes_locked()
+
+    def stats(self) -> dict:
+        """Consistent snapshot for /metrics (other threads mutate the dicts
+        mid-scrape otherwise). used_bytes includes in-flight prefetch
+        reservations — the number admission control actually sees."""
+        with self._lock:
+            return {
+                "loads": self.loads,
+                "evictions": self.evictions,
+                "used_bytes": self.used_bytes_locked(),
+                "swap_ms": dict(self.swap_ms),
+                "load_ms": dict(self.load_ms),
+            }
 
     # -- residency ----------------------------------------------------------
     def _is_idle(self, r: Resident) -> bool:
@@ -162,7 +183,9 @@ class ResidencyManager:
         return True
 
     def used_bytes_locked(self) -> int:
-        return sum(r.bytes for r in self._resident.values())
+        return sum(r.bytes for r in self._resident.values()) + sum(
+            self._reserved.values()
+        )
 
     def _evict(self, name: str) -> None:
         r = self._resident.pop(name, None)
@@ -172,11 +195,97 @@ class ResidencyManager:
             r.model.loop.stop(join=False)
         self.evictions += 1
 
-    def acquire(self, name: str) -> ServedModel:
+    def prefetch(self, name: str) -> bool:
+        """Stage ``name``'s weights in the background so the NEXT acquire
+        is (near-)free: evict idle models for headroom now, build+load on a
+        daemon thread, publish as resident on completion.  The in-flight
+        model keeps decoding throughout — nothing stops until an eviction
+        is actually required, and busy models are never evicted (SURVEY §7
+        hard part #2: swap latency is weights->HBM load time; overlap it
+        with serving instead of stalling the requesting call).
+
+        Returns False when overlap is impossible: unknown name, or the
+        headroom cannot be freed without evicting a busy model (the
+        subsequent ``acquire`` then does the old synchronous swap)."""
         with self._lock:
+            if (
+                name not in self._known
+                or name in self._resident
+                or name in self._loading
+            ):
+                return name in self._resident or name in self._loading
+            if self._estimate is not None:
+                need = self._estimate(name)
+                if not self._evict_until_fits(need):
+                    return False
+                self._reserved[name] = need
+            self._loading.add(name)
+            self._load_errors.pop(name, None)
+
+        def run():
+            t0 = time.monotonic()
+            try:
+                model = self._build(name)
+                need = self._measure(model)
+                ok = False
+                with self._lock:
+                    self._reserved.pop(name, None)
+                    # measured > estimated: make room, idle victims only
+                    ok = self._evict_until_fits(need)
+                    if ok:
+                        self._resident[name] = Resident(
+                            model=model, bytes=need,
+                            last_used=time.monotonic(), loads=1,
+                        )
+                        self.loads += 1
+                        self.load_ms[name] = (
+                            (time.monotonic() - t0) * 1000.0
+                        )
+                if not ok:
+                    if model.loop is not None:
+                        model.loop.stop(join=False)
+                    raise MemoryError(
+                        f"prefetched model '{name}' ({need >> 20} MiB) no "
+                        f"longer fits: resident models busy"
+                    )
+            except BaseException as e:  # noqa: BLE001 — delivered to waiters
+                with self._lock:
+                    self._load_errors[name] = e
+            finally:
+                with self._lock:
+                    self._reserved.pop(name, None)
+                    self._loading.discard(name)
+                    self._cond.notify_all()
+
+        threading.Thread(
+            target=run, name=f"helix-prefetch-{name}", daemon=True
+        ).start()
+        return True
+
+    def acquire(self, name: str) -> ServedModel:
+        t_enter = time.monotonic()
+        with self._lock:
+            # a prefetch in flight for this name: wait for it instead of
+            # double-building (the wait IS the swap latency)
+            waited = False
+            while name in self._loading:
+                waited = True
+                self._cond.wait(timeout=0.5)
+            err = self._load_errors.pop(name, None)
+            if err is not None:
+                if waited:
+                    raise err
+                # stale failure from an unattended prefetch: a fresh build
+                # may well succeed now — log and fall through to one
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "dropping stale prefetch failure for %s: %s", name, err
+                )
             r = self._resident.get(name)
             if r is not None:
                 r.last_used = time.monotonic()
+                self.swap_ms[name] = (time.monotonic() - t_enter) * 1000.0
                 return r.model
             if self._estimate is not None:
                 # device path: predict footprint, evict FIRST, then build
@@ -205,6 +314,11 @@ class ResidencyManager:
                 model=model, bytes=need, last_used=time.monotonic(), loads=1
             )
             self.loads += 1
+            # synchronous swap: the requesting call stalled for the whole
+            # build+load — exactly the latency prefetch() exists to hide
+            swap = (time.monotonic() - t_enter) * 1000.0
+            self.swap_ms[name] = swap
+            self.load_ms[name] = swap
             return model
 
     def evict(self, name: str) -> None:

@@ -58,6 +58,7 @@ class OpenAIServer:
         app.router.add_get("/healthz", self.healthz)
         app.router.add_get("/metrics", self.prometheus_metrics)
         app.router.add_get("/logs", self.tail_logs)
+        app.router.add_post("/admin/prefetch", self.prefetch_model)
         app.router.add_get("/v1/models", self.list_models)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
@@ -107,7 +108,55 @@ class OpenAIServer:
                         f"helix_ttft_ms_p95{tag} "
                         f"{s[min(len(s) - 1, int(len(s) * 0.95))]:.1f}",
                     ]
+        mgr = self._residency_manager()
+        if mgr is not None:
+            # executor: stats() takes the manager lock, which acquire()
+            # holds across whole model builds — never block the event loop
+            st = await asyncio.get_running_loop().run_in_executor(
+                None, mgr.stats
+            )
+            lines += [
+                "# TYPE helix_residency_loads_total counter",
+                f"helix_residency_loads_total {st['loads']}",
+                f"helix_residency_evictions_total {st['evictions']}",
+                f"helix_residency_used_bytes {st['used_bytes']}",
+            ]
+            for name, ms in sorted(st["swap_ms"].items()):
+                lines.append(
+                    f'helix_model_swap_ms{{model="{name}"}} {ms:.1f}'
+                )
+            for name, ms in sorted(st["load_ms"].items()):
+                lines.append(
+                    f'helix_model_load_ms{{model="{name}"}} {ms:.1f}'
+                )
         return web.Response(text="\n".join(lines) + "\n")
+
+    def _residency_manager(self):
+        """The ResidencyManager behind the registry, if hot-swap is on."""
+        for cand in (self.registry, getattr(self.registry, "inner", None)):
+            if cand is not None and hasattr(cand, "prefetch"):
+                return cand
+        return None
+
+    async def prefetch_model(self, request):
+        """Stage a model's weights in the background ahead of traffic (the
+        async half of hot-swap; swap_ms in /metrics shows the payoff)."""
+        body = await request.json()
+        name = body.get("model", "")
+        mgr = self._residency_manager()
+        if mgr is None:
+            return _error(
+                409, "no residency manager: profile has no residency block"
+            )
+        if name not in mgr.names():
+            return _error(404, f"unknown model {name!r}")
+        # executor: prefetch() takes the manager lock (see /metrics note)
+        started = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: bool(mgr.prefetch(name))
+        )
+        return web.json_response(
+            {"model": name, "prefetch": "started" if started else "declined"}
+        )
 
     async def tail_logs(self, request):
         """Node log tail for the admin UI (hydra logbuf analogue)."""

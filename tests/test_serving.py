@@ -261,3 +261,94 @@ class TestEngineErrorSurface:
             timeout=120,
         )
         assert r2.status_code == 200, r2.text
+
+
+@pytest.fixture(scope="module")
+def residency_url():
+    """OpenAI surface backed by a ResidencyManager (hot-swap group)."""
+    from helix_tpu.engine.residency import ResidencyManager
+
+    def mk(name):
+        tok = ByteTokenizer()
+        cfg = ModelConfig.tiny(vocab_size=512, dtype="float32", name=name)
+        params = init_params(cfg, jax.random.PRNGKey(5))
+        eng = Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=1, page_size=4, num_pages=64,
+                max_pages_per_seq=16, max_prefill_len=32,
+                attn_backend="reference", eos_token_ids=tok.eos_ids,
+            ),
+        )
+        return ServedModel(
+            name=name, loop=EngineLoop(eng, name).start(), tokenizer=tok,
+            context_length=64,
+        )
+
+    mgr = ResidencyManager(1 << 40, build=mk)
+    mgr.register_name("swap-a")
+    mgr.register_name("swap-b")
+
+    srv = OpenAIServer(mgr)
+    app = srv.build_app()
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        aloop = asyncio.new_event_loop()
+        asyncio.set_event_loop(aloop)
+        runner = __import__("aiohttp").web.AppRunner(app)
+        aloop.run_until_complete(runner.setup())
+        site = __import__("aiohttp").web.TCPSite(runner, "127.0.0.1", 18302)
+        aloop.run_until_complete(site.start())
+        holder["loop"] = aloop
+        started.set()
+        aloop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    yield "http://127.0.0.1:18302"
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+    for m in mgr.list():
+        if m.loop is not None:
+            m.loop.stop(join=False)
+
+
+class TestPrefetchSurface:
+    """Hot-swap over HTTP: /admin/prefetch stages weights ahead of traffic;
+    /metrics exposes swap_ms / load_ms (SURVEY §7 hard part #2)."""
+
+    def test_prefetch_then_metrics(self, residency_url):
+        r = requests.post(
+            f"{residency_url}/admin/prefetch", json={"model": "swap-b"},
+            timeout=30,
+        )
+        assert r.status_code == 200 and r.json()["prefetch"] == "started"
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            text = requests.get(f"{residency_url}/metrics", timeout=10).text
+            if 'helix_model_load_ms{model="swap-b"}' in text:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(f"load_ms never appeared:\n{text}")
+        assert "helix_residency_loads_total 1" in text
+        # the prefetched model serves without a load stall
+        r = requests.post(
+            f"{residency_url}/v1/chat/completions",
+            json={"model": "swap-b",
+                  "messages": [{"role": "user", "content": "hi"}],
+                  "max_tokens": 2, "temperature": 0},
+            timeout=120,
+        )
+        assert r.status_code == 200, r.text
+        text = requests.get(f"{residency_url}/metrics", timeout=10).text
+        assert 'helix_model_swap_ms{model="swap-b"}' in text
+
+    def test_prefetch_unknown_model_404(self, residency_url):
+        r = requests.post(
+            f"{residency_url}/admin/prefetch", json={"model": "nope"},
+            timeout=30,
+        )
+        assert r.status_code == 404
