@@ -121,6 +121,11 @@ def _cmd_serve(args) -> int:
             if getattr(args, "sandbox_agents", False)
             else None
         ),
+        external_agent_argv=(
+            __import__("shlex").split(args.external_agent)
+            if getattr(args, "external_agent", "")
+            else None
+        ),
         compute_cfg=compute_cfg,
     )
     print(f"helix-tpu control plane listening on {args.host}:{args.port}")
@@ -413,6 +418,11 @@ def main(argv=None) -> int:
         "--sandbox-agents", action="store_true",
         help="run spec-task agents in isolated resource-limited "
              "subprocesses instead of in-process",
+    )
+    s.add_argument(
+        "--external-agent", default="",
+        help="drive a third-party ACP coding-agent CLI for spec tasks "
+             "(e.g. 'claude-code-acp'); overrides --sandbox-agents",
     )
     s.add_argument(
         "--compute-floor", type=int, default=0,

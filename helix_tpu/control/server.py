@@ -48,6 +48,7 @@ class ControlPlane:
         self, db_path: str = ":memory:", embed_fn=None,
         auth_required: bool = False, runner_token: str | None = None,
         sandbox_agents_url: str | None = None,
+        external_agent_argv: list | None = None,
         compute_cfg=None, compute_provider=None,
     ):
         import os as _os_env
@@ -220,7 +221,18 @@ class ControlPlane:
 
             return emit, close
 
-        if sandbox_agents_url:
+        if external_agent_argv:
+            # third-party coding agent (Claude Code / Zed / any ACP CLI)
+            # in the process sandbox — the reference's hydra external-agent
+            # path (``external-agent/hydra_executor.go:130-569``)
+            from helix_tpu.services.external_agent import (
+                ExternalAgentExecutor,
+            )
+
+            executor = ExternalAgentExecutor(
+                external_agent_argv, make_emitter=make_emitter,
+            )
+        elif sandbox_agents_url:
             # isolated execution: each agent turn runs in its own
             # resource-limited subprocess talking back to OUR OpenAI
             # surface (the reference's hydra-container model)

@@ -208,12 +208,14 @@ class ResidencyManager:
         headroom cannot be freed without evicting a busy model (the
         subsequent ``acquire`` then does the old synchronous swap)."""
         with self._lock:
-            if (
-                name not in self._known
-                or name in self._resident
-                or name in self._loading
-            ):
-                return name in self._resident or name in self._loading
+            r = self._resident.get(name)
+            if r is not None:
+                # already warm: refresh LRU standing so the model the
+                # operator just asked to keep hot isn't the next victim
+                r.last_used = time.monotonic()
+                return True
+            if name not in self._known or name in self._loading:
+                return name in self._loading
             if self._estimate is not None:
                 need = self._estimate(name)
                 if not self._evict_until_fits(need):

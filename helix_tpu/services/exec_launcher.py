@@ -1,0 +1,33 @@
+"""Trusted exec launcher: apply resource limits, then become the agent.
+
+Third-party agent binaries cannot apply their own rlimits the way our
+``sandbox_runner`` does, and ``preexec_fn`` in a threaded parent can
+deadlock (subprocess docs) — so the parent launches THIS module, which
+applies the limits in-process and ``exec``s the target argv. The agent
+inherits the limits, the session (``setsid`` by the parent), and the
+scrubbed environment. Counterpart of the reference's container-side
+entrypoint wrapper (``api/pkg/external-agent/hydra_executor.go:130-569``
+runs agents under a container runtime that enforces limits for it).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    spec = json.loads(sys.argv[1])
+    from helix_tpu.services.sandbox_runner import _apply_limits
+
+    _apply_limits(spec.get("limits") or {})
+    # PYTHONPATH exists only so THIS launcher can import; the agent must
+    # not inherit repo access through it (scrubbed-env guarantee)
+    os.environ.pop("PYTHONPATH", None)
+    argv = spec["argv"]
+    os.execvp(argv[0], argv)
+
+
+if __name__ == "__main__":
+    main()

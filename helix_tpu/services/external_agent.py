@@ -1,0 +1,308 @@
+"""External coding agents on the kanban: an ACP client over stdio.
+
+The reference's headline orchestration runs third-party coding agents
+(Claude Code, Zed, Qwen Code) against spec-task workspaces inside hydra
+desktop containers, speaking the Agent Client Protocol over stdio
+(``api/pkg/external-agent/hydra_executor.go:130-569``, executor seam
+``external-agent/executor.go:13-37``).  This module is the TPU build's
+equivalent: ``ExternalAgentExecutor`` fills the orchestrator's
+``Executor`` seam by launching ANY ACP-speaking agent CLI as a
+resource-limited subprocess whose cwd is the task's git workspace,
+driving ``initialize -> session/new -> session/prompt`` and mirroring
+``session/update`` notifications into the watchable desktop stream.
+
+Protocol subset (JSON-RPC 2.0, one message per line over stdio):
+
+    -> {"jsonrpc":"2.0","id":1,"method":"initialize",
+        "params":{"protocolVersion":1}}
+    <- {"jsonrpc":"2.0","id":1,"result":{"protocolVersion":1}}
+    -> {"id":2,"method":"session/new","params":{"cwd": <workspace>}}
+    <- {"id":2,"result":{"sessionId":"sess-1"}}
+    -> {"id":3,"method":"session/prompt","params":{"sessionId":"sess-1",
+        "prompt":[{"type":"text","text": <prompt>}]}}
+    <- {"method":"session/update","params":{"update":{
+        "sessionUpdate":"agent_message_chunk",
+        "content":{"type":"text","text":"..."}}}}        (0..n)
+    <- {"id":3,"result":{"stopReason":"end_turn"}}
+
+The agent edits files directly in its cwd (the git workspace); the
+orchestrator commits and opens the PR exactly as for in-process agents.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+from typing import Callable, Optional
+
+from helix_tpu.services.sandbox_executor import _StepView
+from helix_tpu.services.spec_tasks import (
+    Executor,
+    SpecTask,
+    build_agent_message,
+    build_agent_prompt,
+)
+
+
+class ACPError(RuntimeError):
+    pass
+
+
+class ACPClient:
+    """Line-JSON-RPC client half of ACP over a child's stdio."""
+
+    def __init__(self, proc: subprocess.Popen,
+                 on_update: Optional[Callable[[dict], None]] = None):
+        self._proc = proc
+        self._ids = itertools.count(1)
+        self._pending: dict[int, dict] = {}
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._eof = False
+        self.on_update = on_update or (lambda u: None)
+        self._reader = threading.Thread(
+            target=self._read_loop, name="acp-reader", daemon=True
+        )
+        self._reader.start()
+
+    def _read_loop(self):
+        for line in self._proc.stdout:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                # agents log to stdout too; surface as an update
+                self.on_update({"sessionUpdate": "stdout", "text": line})
+                continue
+            if "id" in msg and ("result" in msg or "error" in msg):
+                with self._cond:
+                    self._pending[msg["id"]] = msg
+                    self._cond.notify_all()
+            elif msg.get("method") == "session/update":
+                self.on_update(
+                    (msg.get("params") or {}).get("update") or {}
+                )
+            elif "id" in msg and "method" in msg:
+                # agent-initiated request: answer it or the agent blocks
+                # forever waiting (claude-code-acp asks permission before
+                # edits; the workspace sandbox IS the permission boundary
+                # here, same as the reference's container policy)
+                self._answer_agent_request(msg)
+        with self._cond:
+            self._eof = True
+            self._cond.notify_all()
+
+    def _answer_agent_request(self, msg: dict):
+        method, mid = msg["method"], msg["id"]
+        if method == "session/request_permission":
+            opts = (msg.get("params") or {}).get("options") or []
+            pick = next(
+                (o for o in opts
+                 if str(o.get("kind", "")).startswith("allow")),
+                opts[0] if opts else {"optionId": "allow"},
+            )
+            reply = {"jsonrpc": "2.0", "id": mid, "result": {
+                "outcome": {"outcome": "selected",
+                            "optionId": pick.get("optionId", "allow")},
+            }}
+        else:
+            reply = {"jsonrpc": "2.0", "id": mid, "error": {
+                "code": -32601, "message": f"method not supported: {method}",
+            }}
+        try:
+            self._proc.stdin.write(json.dumps(reply) + "\n")
+            self._proc.stdin.flush()
+        except (BrokenPipeError, OSError):
+            pass
+
+    def request(self, method: str, params: dict, timeout: float) -> dict:
+        mid = next(self._ids)
+        doc = {"jsonrpc": "2.0", "id": mid, "method": method,
+               "params": params}
+        try:
+            self._proc.stdin.write(json.dumps(doc) + "\n")
+            self._proc.stdin.flush()
+        except (BrokenPipeError, OSError) as e:
+            raise ACPError(f"agent closed stdin mid-{method}: {e}") from e
+        deadline = threading.TIMEOUT_MAX if timeout is None else timeout
+        import time as _time
+
+        t_end = _time.monotonic() + deadline
+        with self._cond:
+            while mid not in self._pending:
+                if self._eof:
+                    raise ACPError(
+                        f"agent exited before replying to {method}"
+                    )
+                left = t_end - _time.monotonic()
+                if left <= 0:
+                    raise ACPError(f"{method} timed out after {timeout}s")
+                self._cond.wait(timeout=min(left, 0.5))
+            msg = self._pending.pop(mid)
+        if "error" in msg:
+            e = msg["error"]
+            raise ACPError(
+                f"{method} failed: {e.get('message', e)} "
+                f"(code {e.get('code')})"
+            )
+        return msg.get("result") or {}
+
+
+class ExternalAgentExecutor(Executor):
+    """Run an external ACP agent CLI per task turn, sandboxed.
+
+    ``argv`` is the agent command (e.g. ``["claude-code-acp"]`` or
+    ``["zed", "--acp"]``); it runs in its own session with rlimits applied
+    by the trusted ``exec_launcher``, a scrubbed environment (plus
+    ``extra_env`` for the agent's own credentials), and cwd = workspace.
+    """
+
+    def __init__(
+        self,
+        argv: list,
+        make_emitter=None,
+        time_limit: float = 900.0,
+        rpc_timeout: float = 60.0,
+        extra_env: Optional[dict] = None,
+        cpu_limit_s: int = 600,
+        memory_limit_bytes: int = 2 << 30,
+    ):
+        self.argv = list(argv)
+        self.make_emitter = make_emitter
+        self.time_limit = time_limit
+        self.rpc_timeout = rpc_timeout
+        self.extra_env = dict(extra_env or {})
+        self.cpu_limit_s = cpu_limit_s
+        self.memory_limit_bytes = memory_limit_bytes
+
+    def _env(self, workspace: str) -> dict:
+        env = {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": workspace,
+            "LANG": os.environ.get("LANG", "C.UTF-8"),
+        }
+        env.update(self.extra_env)
+        return env
+
+    def run(self, task: SpecTask, workspace: str, mode: str,
+            feedback: str = "") -> str:
+        prompt = build_agent_prompt(task, mode)
+        message = build_agent_message(task, feedback)
+        emit, close = (lambda s: None), (lambda: None)
+        if self.make_emitter is not None:
+            emit, close = self.make_emitter(task, mode)
+
+        launcher_spec = json.dumps({
+            "argv": self.argv,
+            "limits": {
+                "cpu_s": self.cpu_limit_s,
+                "memory_bytes": self.memory_limit_bytes,
+                "nofile": 512,
+            },
+        })
+        helix_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env = self._env(workspace)
+        env["PYTHONPATH"] = helix_root   # for the launcher only
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "helix_tpu.services.exec_launcher",
+             launcher_spec],
+            cwd=workspace,
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            start_new_session=True,
+        )
+
+        # drain stderr off-thread: an agent that can't even start (binary
+        # missing, import error) explains itself ONLY here
+        stderr_tail: list = []
+
+        def drain_stderr():
+            for line in proc.stderr:
+                line = line.rstrip("\n")
+                if line:
+                    stderr_tail.append(line)
+                    del stderr_tail[:-20]
+
+        threading.Thread(target=drain_stderr, daemon=True).start()
+
+        chunks: list = []
+
+        def on_update(update: dict):
+            kind = update.get("sessionUpdate", "")
+            if kind == "agent_message_chunk":
+                text = (update.get("content") or {}).get("text", "")
+                chunks.append(text)
+                emit(_StepView({"kind": "answer", "name": "agent",
+                                "result": text}))
+            elif kind == "tool_call":
+                emit(_StepView({
+                    "kind": "tool",
+                    "name": update.get("title")
+                    or update.get("toolCallId", "tool"),
+                    "arguments": update.get("rawInput"),
+                    "result": update.get("status", ""),
+                }))
+            else:
+                emit(_StepView({"kind": "tool", "name": kind or "update",
+                                "arguments": None,
+                                "result": update.get("text", "")}))
+
+        def kill_tree():
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+        timer = threading.Timer(self.time_limit, kill_tree)
+        timer.daemon = True
+        timer.start()
+        try:
+            client = ACPClient(proc, on_update=on_update)
+            client.request(
+                "initialize", {"protocolVersion": 1}, self.rpc_timeout
+            )
+            sess = client.request(
+                "session/new", {"cwd": workspace}, self.rpc_timeout
+            )
+            sid = sess.get("sessionId", "")
+            result = client.request(
+                "session/prompt",
+                {
+                    "sessionId": sid,
+                    "prompt": [{"type": "text",
+                                "text": f"{prompt}\n\n{message}"}],
+                },
+                # the prompt turn does the actual work — give it the whole
+                # wall-clock budget, the outer timer still bounds it
+                self.time_limit,
+            )
+            stop = result.get("stopReason", "end_turn")
+            if stop not in ("end_turn", "max_turn_requests"):
+                raise ACPError(f"agent stopped abnormally: {stop}")
+        except ACPError as e:
+            tail = "\n".join(stderr_tail[-10:])
+            if tail:
+                raise ACPError(f"{e}\nagent stderr:\n{tail}") from e
+            raise
+        finally:
+            timer.cancel()
+            try:
+                proc.stdin.close()
+            except OSError:
+                pass
+            kill_tree()
+            proc.wait()
+            close()
+        return "".join(chunks).strip()[-2000:]

@@ -141,7 +141,10 @@ class OpenAIServer:
     async def prefetch_model(self, request):
         """Stage a model's weights in the background ahead of traffic (the
         async half of hot-swap; swap_ms in /metrics shows the payoff)."""
-        body = await request.json()
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — client error, not server fault
+            return _error(400, "invalid JSON body")
         name = body.get("model", "")
         mgr = self._residency_manager()
         if mgr is None:

@@ -1,0 +1,125 @@
+"""Scripted ACP agent for tests: the stdio stand-in for Claude Code / Zed.
+
+Speaks the JSON-RPC-lines subset ``ExternalAgentExecutor`` drives
+(initialize, session/new, session/prompt, session/update notifications)
+and does what a coding agent would: planning prompts write the spec file,
+implementation prompts write code into the cwd workspace. Stdlib only —
+it runs exec'd through the rlimit launcher with a scrubbed environment.
+
+Env knobs:
+  FAKE_AGENT_RED_FIRST=1  first implementation is broken; the CI-failure
+                          feedback round then writes the fix (exercises
+                          the orchestrator's bounded red-CI retry loop).
+  FAKE_AGENT_MODE=error   reply to session/prompt with a JSON-RPC error.
+  FAKE_AGENT_MODE=hang    never reply to session/prompt (wall-clock kill).
+"""
+
+import json
+import os
+import re
+import sys
+import time
+
+
+def send(doc):
+    print(json.dumps(doc), flush=True)
+
+
+def update(kind, **kw):
+    send({
+        "jsonrpc": "2.0",
+        "method": "session/update",
+        "params": {"update": {"sessionUpdate": kind, **kw}},
+    })
+
+
+def say(text):
+    update("agent_message_chunk", content={"type": "text", "text": text})
+
+
+def handle_prompt(params, stdin, mode):
+    text = "".join(
+        p.get("text", "") for p in params.get("prompt", [])
+        if p.get("type") == "text"
+    )
+    say("on it. ")
+    if mode == "permission":
+        # ask before editing, like claude-code-acp does — the client must
+        # answer or we hang here forever
+        send({"jsonrpc": "2.0", "id": 999,
+              "method": "session/request_permission",
+              "params": {"options": [
+                  {"optionId": "allow-once", "kind": "allow_once"},
+                  {"optionId": "reject", "kind": "reject_once"},
+              ]}})
+        while True:
+            reply = json.loads(next(stdin))
+            if reply.get("id") == 999:
+                break
+        picked = (
+            (reply.get("result") or {}).get("outcome") or {}
+        ).get("optionId", "")
+        if not picked.startswith("allow"):
+            say("permission denied, stopping")
+            return {"stopReason": "refusal"}
+    m = re.search(r"specs/\S+\.md", text)
+    spec_path = m.group(0) if m else "specs/out.md"
+    if "planning agent" in text:
+        os.makedirs(os.path.dirname(spec_path) or ".", exist_ok=True)
+        tm = re.search(r"Task: (.*)", text)
+        with open(spec_path, "w") as f:
+            f.write(
+                f"# Spec: {tm.group(1) if tm else 'task'}\n\n"
+                "Write hello.py that prints hello.\n"
+            )
+        update("tool_call", title="write_spec", status="completed",
+               rawInput={"path": spec_path})
+        say("spec written")
+    else:
+        broken = (
+            os.environ.get("FAKE_AGENT_RED_FIRST") == "1"
+            and "CI failed" not in text
+        )
+        with open("hello.py", "w") as f:
+            f.write("raise SystemExit(1)\n" if broken
+                    else "print('hello')\n")
+        update("tool_call", title="write_code", status="completed",
+               rawInput={"path": "hello.py"})
+        say("implemented (broken)" if broken else "implemented")
+    return {"stopReason": "end_turn"}
+
+
+def main():
+    mode = os.environ.get("FAKE_AGENT_MODE", "")
+    if mode == "crash":
+        print("boom: agent cannot start", file=sys.stderr, flush=True)
+        sys.exit(3)
+    stdin = iter(sys.stdin)
+    for line in stdin:
+        line = line.strip()
+        if not line:
+            continue
+        msg = json.loads(line)
+        method, mid = msg.get("method"), msg.get("id")
+        if method == "initialize":
+            send({"jsonrpc": "2.0", "id": mid,
+                  "result": {"protocolVersion": 1,
+                             "agentCapabilities": {}}})
+        elif method == "session/new":
+            send({"jsonrpc": "2.0", "id": mid,
+                  "result": {"sessionId": "sess-fake-1"}})
+        elif method == "session/prompt":
+            if mode == "hang":
+                time.sleep(3600)
+            if mode == "error":
+                send({"jsonrpc": "2.0", "id": mid,
+                      "error": {"code": -32603,
+                                "message": "agent exploded"}})
+                continue
+            send({"jsonrpc": "2.0", "id": mid,
+                  "result": handle_prompt(
+                      msg.get("params") or {}, stdin, mode)})
+
+
+if __name__ == "__main__":
+    main()

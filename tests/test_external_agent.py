@@ -1,0 +1,171 @@
+"""External ACP agents driving the kanban (VERDICT round-2 item 4).
+
+Reference parity: ``api/pkg/external-agent/hydra_executor.go:130-569``
+runs Claude Code / Zed / Qwen agents over ACP inside desktop containers;
+here ``ExternalAgentExecutor`` drives any ACP CLI in the process sandbox.
+The scripted stand-in (``tests/fake_acp_agent.py``) plans and implements
+a spec task end to end: planned by the external agent, spec approved,
+implemented by the external agent, PR opened, CI run, merged — with the
+agent's activity streamed as watchable steps.
+"""
+
+import os
+import sys
+import time
+
+import pytest
+
+from helix_tpu.services.external_agent import ACPError, ExternalAgentExecutor
+from helix_tpu.services.git_service import GitService
+from helix_tpu.services.spec_tasks import SpecTaskOrchestrator, TaskStore
+
+FAKE = os.path.join(os.path.dirname(__file__), "fake_acp_agent.py")
+
+
+def _executor(steps=None, **kw):
+    kw.setdefault("argv", [sys.executable, FAKE])
+    kw.setdefault("time_limit", 60)
+    if steps is not None:
+        kw.setdefault(
+            "make_emitter", lambda t, m: (steps.append, lambda: None)
+        )
+    return ExternalAgentExecutor(**kw)
+
+
+class _Task:
+    id = "tsk_ext1"
+    title = "write hello"
+    description = "produce hello.py"
+    spec_path = "specs/out.md"
+
+
+class TestExternalAgentExecutor:
+    def test_plan_turn_writes_spec_and_streams(self, tmp_path):
+        steps = []
+        ex = _executor(steps)
+        ws = str(tmp_path / "ws")
+        os.makedirs(ws)
+        summary = ex.run(_Task(), ws, "plan")
+        assert "spec written" in summary
+        # plan prompts name specs/<task_id>.md; the agent wrote it there
+        assert os.path.exists(os.path.join(ws, f"specs/{_Task.id}.md"))
+        kinds = {s.kind for s in steps}
+        assert "tool" in kinds and "answer" in kinds   # watchable stream
+
+    def test_agent_error_raises(self, tmp_path):
+        ex = _executor(extra_env={"FAKE_AGENT_MODE": "error"})
+        ws = str(tmp_path / "ws")
+        os.makedirs(ws)
+        with pytest.raises(ACPError, match="agent exploded"):
+            ex.run(_Task(), ws, "plan")
+
+    def test_hung_agent_killed_at_wall_clock(self, tmp_path):
+        ex = _executor(extra_env={"FAKE_AGENT_MODE": "hang"}, time_limit=4)
+        ws = str(tmp_path / "ws")
+        os.makedirs(ws)
+        t0 = time.time()
+        with pytest.raises(ACPError):
+            ex.run(_Task(), ws, "plan")
+        assert time.time() - t0 < 60
+
+    def test_permission_request_auto_allowed(self, tmp_path):
+        """Agents that ask permission before editing (claude-code-acp)
+        must get an answer, not hang: the workspace sandbox is the
+        permission boundary."""
+        ex = _executor(extra_env={"FAKE_AGENT_MODE": "permission"},
+                       time_limit=30)
+        ws = str(tmp_path / "ws")
+        os.makedirs(ws)
+        summary = ex.run(_Task(), ws, "plan")
+        assert "spec written" in summary     # not "permission denied"
+        assert os.path.exists(os.path.join(ws, f"specs/{_Task.id}.md"))
+
+    def test_crash_at_start_surfaces_stderr(self, tmp_path):
+        ex = _executor(extra_env={"FAKE_AGENT_MODE": "crash"},
+                       time_limit=15)
+        ws = str(tmp_path / "ws")
+        os.makedirs(ws)
+        with pytest.raises(ACPError, match="boom: agent cannot start"):
+            ex.run(_Task(), ws, "plan")
+
+    def test_env_is_scrubbed_plus_agent_creds(self, tmp_path):
+        ex = _executor(extra_env={"AGENT_API_KEY": "k"})
+        env = ex._env(str(tmp_path))
+        assert env["HOME"] == str(tmp_path)
+        assert env["AGENT_API_KEY"] == "k"
+        assert "HELIX_MASTER_KEY" not in env
+
+
+def _drive(orch, store, tid, want_status, max_iters=30):
+    for _ in range(max_iters):
+        orch.process_once()
+        t = store.get_task(tid)
+        if t.status == want_status:
+            return t
+        if t.status == "failed":
+            raise AssertionError(f"task failed: {t.error}")
+    raise AssertionError(
+        f"never reached {want_status}; stuck at {store.get_task(tid).status}"
+    )
+
+
+class TestExternalAgentOnKanban:
+    """The reference's headline flow with a third-party agent subprocess."""
+
+    def _stack(self, tmp_path, **exkw):
+        git = GitService(str(tmp_path / "git"))
+        store = TaskStore()
+        orch = SpecTaskOrchestrator(
+            store, git, _executor(**exkw),
+            workspace_root=str(tmp_path / "ws"),
+        )
+        return git, store, orch
+
+    def test_task_planned_implemented_merged_by_external_agent(
+        self, tmp_path
+    ):
+        git, store, orch = self._stack(tmp_path)
+        t = store.create_task("proj", "write hello", "produce hello.py")
+        _drive(orch, store, t.id, "spec_review")
+        # the external agent's spec landed on the specs branch
+        spec = git.file_at("proj", "helix-specs", f"specs/{t.id}.md")
+        assert spec and "hello.py" in spec
+        orch.review_spec(t.id, "human", "approve")
+        t = _drive(orch, store, t.id, "pr_review")
+        pr = store.get_pr(t.pr_id)
+        assert pr["status"] == "open"
+        # the diff is the external agent's work
+        assert "hello.py" in orch.pr_diff(t.pr_id)
+        orch.merge_pr(t.pr_id)
+        assert store.get_task(t.id).status == "done"
+
+    def test_red_ci_feedback_reaches_external_agent(self, tmp_path):
+        """First implementation is broken; CI fails; the failure feedback
+        rides into the agent's next prompt and it ships the fix."""
+        git, store, orch = self._stack(
+            tmp_path, extra_env={"FAKE_AGENT_RED_FIRST": "1"}
+        )
+        # seed the project with CI before the task branch exists
+        t = store.create_task("proj", "write hello", "produce hello.py")
+        _drive(orch, store, t.id, "spec_review")
+        ws = str(tmp_path / "seed-ci")
+        git.clone_workspace("proj", ws)
+        with open(os.path.join(ws, ".helix-ci.sh"), "w") as f:
+            f.write("python hello.py\n")
+        git.commit_and_push(ws, "add CI", "main")
+        orch.review_spec(t.id, "human", "approve")
+        t = _drive(orch, store, t.id, "pr_review")
+        # CI pass 1: red -> re-queued; pass 2: green
+        for _ in range(40):
+            orch.process_once()
+            t = store.get_task(t.id)
+            if t.status == "implementation_queued":
+                t = _drive(orch, store, t.id, "pr_review")
+            pr = store.get_pr(t.pr_id) if t.pr_id else None
+            if pr and pr["ci_status"] == "passed":
+                break
+        else:
+            raise AssertionError(f"CI never went green: {t.to_dict()}")
+        assert t.ci_attempts == 1   # exactly one red round
+        orch.merge_pr(t.pr_id)
+        assert store.get_task(t.id).status == "done"
