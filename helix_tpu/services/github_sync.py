@@ -1,0 +1,185 @@
+"""External forge sync: mirror internal PRs to GitHub and poll PR/CI state.
+
+Fills the ``ExternalGitSync`` seam (``spec_tasks.py``) the way the
+reference's git-repository service syncs internal repos with
+GitHub/GitLab/ADO/Gitea and polls external PRs + CI back into the
+orchestrator (``api/pkg/services/git_repository_service*.go``,
+``spec_task_orchestrator.go:1074-1201``):
+
+- ``push_pr`` pushes the task branch (and base) from the control plane's
+  bare repo to the external clone URL, then opens a pull request through
+  the REST API;
+- ``poll`` reads the PR (merged/closed/open) and the head commit's
+  combined status, translating to the orchestrator's
+  ``ci_passed``/``ci_failed`` transitions — a red external CI re-queues
+  the task with feedback, an external merge completes it.
+
+Configuration is per-project: ``{"clone_url": ..., "repo": "owner/name"}``.
+The API base is configurable so self-hosted GitHub Enterprise (and the
+test suite's fake forge) work unchanged.  Sync is best-effort by design:
+a forge outage must never fail a task, so push errors are recorded on
+``last_error`` and polling returns None (internal flow continues).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import subprocess
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+from helix_tpu.services.git_service import GitService
+from helix_tpu.services.spec_tasks import ExternalGitSync
+
+log = logging.getLogger(__name__)
+
+
+class GitHubSync(ExternalGitSync):
+    def __init__(
+        self,
+        git: GitService,
+        api_base: str = "https://api.github.com",
+        token: str = "",
+        repos: Optional[dict] = None,
+        timeout: float = 15.0,
+    ):
+        self.git = git
+        self.api_base = api_base.rstrip("/")
+        self.token = token
+        self.repos = dict(repos or {})   # project -> {clone_url, repo}
+        self.timeout = timeout
+        self.last_error: str = ""
+        self._pr_numbers: dict = {}      # internal pr id -> external number
+        self._lock = threading.Lock()
+
+    # -- REST ---------------------------------------------------------------
+    def _api(self, method: str, path: str, body: Optional[dict] = None):
+        req = urllib.request.Request(
+            f"{self.api_base}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={
+                "Accept": "application/vnd.github+json",
+                "Content-Type": "application/json",
+                **(
+                    {"Authorization": f"Bearer {self.token}"}
+                    if self.token
+                    else {}
+                ),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    # -- sync surface --------------------------------------------------------
+    def push_branch(self, project: str, branch: str) -> None:
+        cfg = self.repos.get(project)
+        if not cfg:
+            return
+        bare = self.git._repo_path(project)
+        # The token travels via the environment + an inline credential
+        # helper — never on the command line (visible in /proc) and never
+        # in the URL git echoes into error output.
+        import os as _os
+
+        env = dict(_os.environ)
+        args = ["git", "-C", bare]
+        if self.token and cfg["clone_url"].startswith("http"):
+            env["HELIX_GIT_TOKEN"] = self.token
+            helper = (
+                '!f() { echo username=x-access-token; '
+                'echo "password=$HELIX_GIT_TOKEN"; }; f'
+            )
+            args += ["-c", f"credential.helper={helper}"]
+        args += ["push", "-f", cfg["clone_url"],
+                 f"refs/heads/{branch}:refs/heads/{branch}"]
+        p = subprocess.run(
+            args, capture_output=True, text=True, timeout=120, env=env,
+        )
+        if p.returncode != 0:
+            err = (p.stderr or "").replace(self.token or "\x00", "***")
+            raise RuntimeError(
+                f"push {project}:{branch} failed: {err[:300]}"
+            )
+
+    def push_pr(self, project: str, pr: dict) -> None:
+        cfg = self.repos.get(project)
+        if not cfg:
+            return
+        try:
+            self.push_branch(project, pr["base"])
+            self.push_branch(project, pr["head"])
+            doc = self._api(
+                "POST", f"/repos/{cfg['repo']}/pulls",
+                {
+                    "title": pr.get("title") or pr["head"],
+                    "head": pr["head"],
+                    "base": pr["base"],
+                    "body": f"helix task PR {pr['id']}",
+                },
+            )
+            with self._lock:
+                self._pr_numbers[pr["id"]] = doc["number"]
+            self.last_error = ""
+        except Exception as e:  # noqa: BLE001 — forge outage != task failure
+            self.last_error = f"push_pr {pr['id']}: {e}"
+            log.warning("external PR sync failed: %s", self.last_error)
+
+    def _find_number(self, cfg: dict, pr: dict) -> Optional[int]:
+        """Recover the external PR number by head branch (survives control
+        plane restarts — the map is in-memory only)."""
+        owner = cfg["repo"].split("/")[0]
+        q = urllib.parse.urlencode(
+            {"head": f"{owner}:{pr['head']}", "state": "all"}
+        )
+        docs = self._api("GET", f"/repos/{cfg['repo']}/pulls?{q}")
+        if isinstance(docs, list) and docs:
+            return docs[0]["number"]
+        return None
+
+    def poll(self, project: str, pr: dict) -> Optional[dict]:
+        cfg = self.repos.get(project)
+        if not cfg:
+            return None
+        try:
+            with self._lock:
+                number = self._pr_numbers.get(pr["id"])
+            if number is None:
+                number = self._find_number(cfg, pr)
+                if number is None:
+                    return None
+                with self._lock:
+                    self._pr_numbers[pr["id"]] = number
+            doc = self._api("GET", f"/repos/{cfg['repo']}/pulls/{number}")
+            if doc.get("merged") or doc.get("merged_at"):
+                return {
+                    "status": "merged",
+                    "merge_sha": doc.get("merge_commit_sha", ""),
+                }
+            if doc.get("state") == "closed":
+                return {"status": "closed"}
+            sha = (doc.get("head") or {}).get("sha", "")
+            if not sha:
+                return {"status": "open", "ci_status": "pending"}
+            st = self._api(
+                "GET", f"/repos/{cfg['repo']}/commits/{sha}/status"
+            )
+            ci = {
+                "success": "passed",
+                "failure": "failed",
+                "error": "failed",
+            }.get(st.get("state", "pending"), "pending")
+            ci_log = "\n".join(
+                f"{s.get('context')}: "
+                f"{s.get('description') or s.get('state')}"
+                for s in st.get("statuses", [])
+            )
+            return {"status": "open", "ci_status": ci, "ci_log": ci_log}
+        except Exception as e:  # noqa: BLE001 — keep the kanban moving
+            self.last_error = f"poll {pr['id']}: {e}"
+            log.warning("external PR poll failed: %s", self.last_error)
+            return None
