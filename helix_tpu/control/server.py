@@ -350,6 +350,13 @@ class ControlPlane:
                 StubProvider,
             )
 
+            if compute_provider is None:
+                # config-gated real cloud provider (HELIX_GCE_PROJECT/ZONE)
+                from helix_tpu.control.compute_gce import (
+                    from_env as _gce_from_env,
+                )
+
+                compute_provider = _gce_from_env()
             self.compute = ComputeManager(
                 compute_cfg,
                 compute_provider or StubProvider(),
@@ -523,6 +530,10 @@ class ControlPlane:
         r.add_post("/api/v1/runners/{id}/assign-profile", self.assign_profile)
         r.add_delete("/api/v1/runners/{id}/assignment", self.clear_assignment)
         r.add_get("/api/v1/runners", self.list_runners)
+        r.add_get(
+            "/api/v1/runners/{id}/compatible-profiles",
+            self.compatible_profiles,
+        )
         r.add_get("/api/v1/runners/{id}/logs", self.runner_logs)
         r.add_get("/api/v1/compute/instances", self.list_compute_instances)
         # profiles
@@ -730,6 +741,7 @@ class ControlPlane:
     async def list_runners(self, request):
         out = []
         for st in self.router.runners():
+            hb = self.store.get_runner(st.id) or {}
             out.append(
                 {
                     "id": st.id,
@@ -738,9 +750,27 @@ class ControlPlane:
                     "profile_status": st.profile_status,
                     "routable": st.routable,
                     "address": st.meta.get("address", ""),
+                    "accelerators": hb.get("accelerators", []),
                 }
             )
         return web.json_response({"runners": out})
+
+    async def compatible_profiles(self, request):
+        """Profiles whose requirement block the runner's heartbeat
+        inventory satisfies (reference: the sandbox GET compatible-profiles
+        surface, ``integration-test/gpucloud/README.md:50``; constraint
+        logic mirrors ``profile/compatibility.go:50-124``)."""
+        rid = request.match_info["id"]
+        hb = self.store.get_runner(rid)
+        if hb is None:
+            return _err(404, f"unknown runner '{rid}'")
+        inventory = hb.get("accelerators", [])
+        names = []
+        for doc in self.store.list_profiles():
+            profile = ServingProfile.from_dict(doc)
+            if not check_compatibility(profile, inventory):
+                names.append(profile.name)
+        return web.json_response({"profiles": sorted(names)})
 
     async def runner_logs(self, request):
         """Admin log tailing for a runner, proxied by address or through
