@@ -132,6 +132,50 @@ ENV_REFERENCE: tuple = (
         "Internal: marks the CPU-fallback bench child process.",
         section="accelerator",
     ),
+    # -- compute autoscaler (GCE provider) -------------------------------
+    EnvVar(
+        "HELIX_GCE_PROJECT",
+        "GCP project for the pool autoscaler's GCE provider. Setting "
+        "this together with HELIX_GCE_ZONE switches the autoscaler from "
+        "the stub to real instances.",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_GCE_ZONE",
+        "GCE zone runner instances are provisioned in.",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_GCE_MACHINE_TYPE",
+        "Machine type for provisioned runner hosts.",
+        default="n2-standard-8",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_GCE_IMAGE",
+        "Boot image for provisioned runner hosts.",
+        default="projects/debian-cloud/global/images/family/debian-12",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_GCE_CONTROL_PLANE",
+        "Control-plane URL baked into the instance startup script "
+        "(serve-node dials back here over the reverse tunnel).",
+        section="compute",
+    ),
+    EnvVar(
+        "GCE_TOKEN",
+        "Static OAuth bearer for the GCE API; falls back to the "
+        "instance metadata server when unset.",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_GIT_TOKEN",
+        "Internal: carries the forge token from GitHubSync to git's "
+        "credential helper via the child environment (never on the "
+        "command line).",
+        section="integrations",
+    ),
 )
 
 
