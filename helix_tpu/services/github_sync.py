@@ -46,14 +46,20 @@ class GitHubSync(ExternalGitSync):
         token: str = "",
         repos: Optional[dict] = None,
         timeout: float = 15.0,
+        min_poll_interval: float = 30.0,
     ):
         self.git = git
         self.api_base = api_base.rstrip("/")
         self.token = token
         self.repos = dict(repos or {})   # project -> {clone_url, repo}
         self.timeout = timeout
+        # the orchestrator ticks every ~2s; polling GitHub that often
+        # burns ~3600 req/h per open PR against a 5000 req/h limit. Cache
+        # each PR's last answer for min_poll_interval instead.
+        self.min_poll_interval = min_poll_interval
         self.last_error: str = ""
         self._pr_numbers: dict = {}      # internal pr id -> external number
+        self._poll_cache: dict = {}      # internal pr id -> (ts, result)
         self._lock = threading.Lock()
 
     # -- REST ---------------------------------------------------------------
@@ -76,7 +82,9 @@ class GitHubSync(ExternalGitSync):
             return json.loads(resp.read() or b"{}")
 
     # -- sync surface --------------------------------------------------------
-    def push_branch(self, project: str, branch: str) -> None:
+    def push_branch(
+        self, project: str, branch: str, force: bool = False
+    ) -> None:
         cfg = self.repos.get(project)
         if not cfg:
             return
@@ -95,7 +103,7 @@ class GitHubSync(ExternalGitSync):
                 'echo "password=$HELIX_GIT_TOKEN"; }; f'
             )
             args += ["-c", f"credential.helper={helper}"]
-        args += ["push", "-f", cfg["clone_url"],
+        args += ["push", *(["-f"] if force else []), cfg["clone_url"],
                  f"refs/heads/{branch}:refs/heads/{branch}"]
         p = subprocess.run(
             args, capture_output=True, text=True, timeout=120, env=env,
@@ -111,8 +119,16 @@ class GitHubSync(ExternalGitSync):
         if not cfg:
             return
         try:
-            self.push_branch(project, pr["base"])
-            self.push_branch(project, pr["head"])
+            # base: NEVER forced — the external base may hold merges the
+            # internal repo doesn't (external merges are not synced back);
+            # a non-fast-forward here just means the forge is ahead, which
+            # is fine for opening the PR against it
+            try:
+                self.push_branch(project, pr["base"])
+            except RuntimeError as e:
+                log.info("base push skipped (forge ahead): %s", e)
+            # head: ours alone, forced so CI-fix rounds can rewrite it
+            self.push_branch(project, pr["head"], force=True)
             doc = self._api(
                 "POST", f"/repos/{cfg['repo']}/pulls",
                 {
@@ -145,6 +161,18 @@ class GitHubSync(ExternalGitSync):
         cfg = self.repos.get(project)
         if not cfg:
             return None
+        import time as _time
+
+        with self._lock:
+            cached = self._poll_cache.get(pr["id"])
+            if cached and _time.monotonic() - cached[0] < self.min_poll_interval:
+                return cached[1]
+        result = self._poll_uncached(cfg, pr)
+        with self._lock:
+            self._poll_cache[pr["id"]] = (_time.monotonic(), result)
+        return result
+
+    def _poll_uncached(self, cfg: dict, pr: dict) -> Optional[dict]:
         try:
             with self._lock:
                 number = self._pr_numbers.get(pr["id"])

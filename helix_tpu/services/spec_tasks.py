@@ -626,6 +626,21 @@ class SpecTaskOrchestrator:
                 task.status = "done"
                 self.store.update_task(task)
                 return True
+            if ext.get("status") == "closed":
+                # externally rejected (closed without merging): honour it —
+                # the task must not merge internally after a maintainer
+                # said no on the forge
+                self.store.update_pr(pr["id"], "closed")
+                task.status = "cancelled"
+                task.error = "external PR closed without merging"
+                self.store.update_task(task)
+                self.notify(
+                    "task_cancelled",
+                    f"External PR rejected: {task.title}",
+                    f"PR {pr['id']} was closed on the external forge",
+                    task_id=task.id, project=task.project,
+                )
+                return True
             if ext.get("ci_status") == "passed":
                 if pr["ci_status"] != "passed":
                     self.store.set_pr_ci(pr["id"], "passed",

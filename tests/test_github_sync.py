@@ -35,7 +35,7 @@ def fake_github(tmp_path):
     remote = str(tmp_path / "remote.git")
     _git("init", "--bare", "-b", "main", remote)
 
-    state = {"pulls": {}, "next": [100], "status": {}}
+    state = {"pulls": {}, "next": [100], "status": {}, "gets": 0}
 
     def head_sha(branch):
         try:
@@ -65,6 +65,7 @@ def fake_github(tmp_path):
         return web.json_response(docs)
 
     async def get_pull(request):
+        state["gets"] += 1
         n = int(request.match_info["n"])
         p = state["pulls"].get(n)
         if p is None:
@@ -148,6 +149,7 @@ def _stack(tmp_path, fake_github):
     sync = GitHubSync(
         git, api_base=api, token="t0ken",
         repos={"proj": {"clone_url": remote, "repo": "acme/widget"}},
+        min_poll_interval=0.0,   # tests drive transitions tick-by-tick
     )
     store = TaskStore()
     orch = SpecTaskOrchestrator(
@@ -234,6 +236,72 @@ class TestGitHubSync:
         pr = store.get_pr(t.pr_id)
         ext = sync.poll("proj", pr)
         assert ext is not None and ext["status"] == "open"
+
+    def test_external_close_without_merge_cancels_task(
+        self, tmp_path, fake_github
+    ):
+        git, sync, store, orch, state, remote = _stack(
+            tmp_path, fake_github
+        )
+        t = store.create_task("proj", "ship it")
+        _drive(orch, store, t.id, "spec_review")
+        orch.review_spec(t.id, "human", "approve")
+        t = _drive(orch, store, t.id, "pr_review")
+        n = max(state["pulls"])
+        state["pulls"][n]["state"] = "closed"    # rejected, NOT merged
+        orch.process_once()
+        t = store.get_task(t.id)
+        assert t.status == "cancelled"
+        assert store.get_pr(t.pr_id)["status"] == "closed"
+
+    def test_base_branch_never_force_pushed(self, tmp_path, fake_github):
+        """The external base may hold merges the internal repo lacks;
+        mirroring must not overwrite it."""
+        git, sync, store, orch, state, remote = _stack(
+            tmp_path, fake_github
+        )
+        t = store.create_task("proj", "ship it")
+        _drive(orch, store, t.id, "spec_review")
+        # the forge's main diverges (e.g. an earlier external merge)
+        ws = str(tmp_path / "ext-main")
+        _git("clone", "-q", remote, ws)
+        _git("-C", ws, "config", "user.email", "x@y")
+        _git("-C", ws, "config", "user.name", "x")
+        with open(os.path.join(ws, "external.txt"), "w") as f:
+            f.write("merged externally\n")
+        _git("-C", ws, "add", "-A")
+        _git("-C", ws, "commit", "-q", "-m", "external work")
+        _git("-C", ws, "push", "-q", "origin", "main")
+        ext_sha = _git("rev-parse", "refs/heads/main", cwd=remote)
+
+        orch.review_spec(t.id, "human", "approve")
+        t = _drive(orch, store, t.id, "pr_review")
+        # PR still opened (head pushed), but external main is untouched
+        assert _git("rev-parse", "refs/heads/main", cwd=remote) == ext_sha
+        assert any(
+            p["head_branch"] == f"task/{t.id}"
+            for p in state["pulls"].values()
+        )
+
+    def test_poll_throttles_api_calls(self, tmp_path, fake_github):
+        api, remote, state = fake_github
+        git = GitService(str(tmp_path / "git"))
+        sync = GitHubSync(
+            git, api_base=api,
+            repos={"proj": {"clone_url": remote, "repo": "acme/widget"}},
+            min_poll_interval=300.0,
+        )
+        git.create_repo("proj")
+        sync.push_pr("proj", {"id": "pr_x", "title": "t",
+                              "base": "main", "head": "main"})
+        before = state["gets"]
+        pr = {"id": "pr_x", "head": "main"}
+        first = sync.poll("proj", pr)
+        calls_first = state["gets"] - before
+        assert first is not None and calls_first > 0
+        for _ in range(5):
+            assert sync.poll("proj", pr) == first
+        assert state["gets"] == before + calls_first   # cached, no traffic
 
     def test_forge_outage_is_best_effort(self, tmp_path, fake_github):
         _, remote, _ = fake_github
