@@ -261,6 +261,11 @@ class ProviderManager:
         self._router = router
 
     def register(self, endpoint: ProviderEndpoint):
+        if endpoint.name == "helix":
+            # the self-hosted dispatch provider is structural: letting a
+            # registration replace it would forward every locally-served
+            # model's traffic (conversation content included) elsewhere
+            raise ValueError("'helix' is reserved for the local fleet")
         cls = {
             "openai_compat": OpenAICompatProvider,
             "anthropic": AnthropicProvider,
@@ -299,6 +304,19 @@ class ProviderManager:
 
     def names(self) -> list:
         return sorted(self._providers)
+
+    def describe(self) -> list:
+        """Endpoint metadata with secrets masked (admin listing surface)."""
+        out = []
+        for name in self.names():
+            ep = getattr(self._providers[name], "endpoint", None)
+            out.append({
+                "name": name,
+                "kind": getattr(ep, "kind", "helix"),
+                "base_url": getattr(ep, "base_url", ""),
+                "has_key": bool(getattr(ep, "api_key", "")),
+            })
+        return out
 
     def get(self, name: str):
         p = self._providers.get(name)

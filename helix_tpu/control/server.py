@@ -82,6 +82,7 @@ class ControlPlane:
         self.billing = BillingService(bill_path, usage_store=None)
         self.auth_required = auth_required
         self.providers = ProviderManager.from_env(self.router)
+        self._restore_providers()   # DB-backed endpoints survive restarts
         vec_path = (
             ":memory:" if db_path == ":memory:" else db_path + ".vectors"
         )
@@ -562,6 +563,7 @@ class ControlPlane:
         # usage
         r.add_get("/api/v1/usage", self.usage)
         # auth: users / keys / orgs / secrets
+        r.add_get("/api/v1/auth/me", self.auth_me)
         r.add_post("/api/v1/users", self.create_user)
         r.add_post("/api/v1/users/{id}/keys", self.create_key)
         r.add_post("/api/v1/orgs", self.create_org)
@@ -570,6 +572,8 @@ class ControlPlane:
         r.add_get("/api/v1/orgs/{id}/members", self.list_members)
         r.add_delete("/api/v1/orgs/{id}/members/{user}", self.remove_member)
         # oauth connections (agent-skill tokens)
+        r.add_get("/api/v1/providers", self.list_providers)
+        r.add_post("/api/v1/providers", self.register_provider)
         r.add_get("/api/v1/oauth/providers", self.oauth_providers)
         r.add_get("/api/v1/oauth/connect/{provider}", self.oauth_connect)
         r.add_get("/api/v1/oauth/callback", self.oauth_callback)
@@ -829,7 +833,17 @@ class ControlPlane:
         denied = self._require_admin(request)
         if denied is not None:
             return denied
-        body = await request.json()
+        raw = await request.read()
+        ctype = request.headers.get("Content-Type", "")
+        try:
+            if "yaml" in ctype or not raw.lstrip().startswith(b"{"):
+                import yaml as _yaml
+
+                body = _yaml.safe_load(raw)
+            else:
+                body = json.loads(raw)
+        except Exception as e:  # noqa: BLE001
+            return _err(400, f"unparseable profile: {e}")
         try:
             profile = ServingProfile.from_dict(body)
         except Exception as e:  # noqa: BLE001
@@ -1032,6 +1046,95 @@ class ControlPlane:
         )
 
     # -- auth / orgs / secrets ------------------------------------------------
+    async def auth_me(self, request):
+        """Who the presented bearer is — the UI login check (reference:
+        the frontend's auth bootstrap against /api/v1/users/me)."""
+        user = request.get("user")
+        if user is None and self.auth_required:
+            return _err(401, "authentication required")
+        return web.json_response({
+            "auth_required": self.auth_required,
+            "user": (
+                {"id": user.id, "email": user.email, "name": user.name,
+                 "admin": user.admin}
+                if user is not None
+                else {"id": "anonymous", "email": "", "name": "anonymous",
+                      "admin": True}
+            ),
+        })
+
+    async def list_providers(self, request):
+        """Provider endpoints with secrets masked (reference: the
+        provider-management admin surface over DB/env endpoints).
+        Admin-gated: base URLs map internal infrastructure."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        return web.json_response({"providers": self.providers.describe()})
+
+    async def register_provider(self, request):
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — client error, not server fault
+            return _err(400, "invalid JSON body")
+        from helix_tpu.control.providers import ProviderEndpoint
+
+        try:
+            ep = ProviderEndpoint(
+                name=body["name"], kind=body.get("kind", "openai_compat"),
+                base_url=body["base_url"],
+                api_key=body.get("api_key", ""),
+            )
+            self.providers.register(ep)
+        except (KeyError, ValueError, TypeError) as e:
+            return _err(400, f"invalid provider: {e}")
+        self._persist_provider(ep)
+        return web.json_response({"ok": True, "name": body["name"]})
+
+    def _persist_provider(self, ep) -> None:
+        """DB-backed provider endpoints survive restarts (reference keeps
+        per-org endpoints in the store). API keys rest envelope-encrypted
+        under the auth master key, like user secrets."""
+        import base64
+        import dataclasses as _dc
+
+        doc = _dc.asdict(ep)
+        doc["models"] = list(doc.get("models") or ())
+        if doc.get("api_key"):
+            doc["api_key_enc"] = base64.b64encode(
+                self.auth.encrypt(doc.pop("api_key").encode())
+            ).decode()
+        saved = self.store.kv_get("providers.registered", [])
+        saved = [d for d in saved if d.get("name") != doc["name"]] + [doc]
+        self.store.kv_set("providers.registered", saved)
+
+    def _restore_providers(self) -> None:
+        import base64
+
+        from helix_tpu.control.providers import ProviderEndpoint
+
+        for doc in self.store.kv_get("providers.registered", []):
+            try:
+                key = doc.get("api_key", "")
+                if doc.get("api_key_enc"):
+                    key = self.auth.decrypt(
+                        base64.b64decode(doc["api_key_enc"])
+                    ).decode()
+                self.providers.register(ProviderEndpoint(
+                    name=doc["name"], kind=doc.get("kind", "openai_compat"),
+                    base_url=doc.get("base_url", ""), api_key=key,
+                ))
+            except Exception as e:  # noqa: BLE001 — one bad row won't block boot
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "skipping persisted provider %r: %s",
+                    doc.get("name"), e,
+                )
+
     async def create_user(self, request):
         """Admin-gated except for first-user bootstrap (an empty user
         table lets the installer mint the initial admin account —

@@ -216,3 +216,141 @@ def test_env_reference_covers_every_knob_the_tree_reads():
     assert not missing, f"undocumented env vars: {sorted(missing)}"
     text = render()
     assert "HELIX_RUNNER_TOKEN" in text and "[auth]" in text
+
+
+def _ui_source() -> str:
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "helix_tpu", "web", "index.html",
+    )
+    return open(path).read()
+
+
+def test_web_ui_reaches_every_admin_api_family():
+    """VERDICT r2 item 8 'every §2.1 admin API reachable from the UI':
+    the page must reference each admin route family, checked mechanically
+    so a dropped tab fails the suite."""
+    src = _ui_source()
+    for family in (
+        "/api/v1/sessions", "/api/v1/spec-tasks", "/api/v1/pull-requests",
+        "/api/v1/apps", "/api/v1/org/", "/api/v1/desktops",
+        "/api/v1/knowledge", "/api/v1/runners", "/api/v1/profiles",
+        "/api/v1/providers", "/api/v1/wallet", "/api/v1/usage",
+        "/api/v1/secrets", "/api/v1/triggers", "/api/v1/users",
+        "/api/v1/orgs", "/api/v1/notifications", "/api/v1/errors",
+        "/api/v1/compute/instances", "/api/v1/auth/me",
+        "compatible-profiles", "/v1/models",
+    ):
+        assert family in src, f"web UI lost its {family} surface"
+
+
+def test_web_ui_login_flow_present():
+    src = _ui_source()
+    assert "login-overlay" in src
+    assert "helix_api_key" in src           # key persisted for the session
+    assert "Authorization" in src           # and attached to requests
+
+
+class TestAuthMeAndProviders:
+    def test_auth_me_anonymous_when_auth_disabled(self, stack):
+        url = stack
+        r = requests.get(f"{url}/api/v1/auth/me", timeout=5)
+        assert r.status_code == 200
+        doc = r.json()
+        assert doc["auth_required"] is False
+        assert doc["user"]["admin"] is True
+
+    def test_providers_list_and_register(self, stack):
+        url = stack
+        r = requests.get(f"{url}/api/v1/providers", timeout=5)
+        assert r.status_code == 200
+        names = {p["name"] for p in r.json()["providers"]}
+        assert "helix" in names
+        r = requests.post(
+            f"{url}/api/v1/providers",
+            json={"name": "corp-llm", "kind": "openai_compat",
+                  "base_url": "https://llm.corp.example", "api_key": "sk-x"},
+            timeout=5,
+        )
+        assert r.status_code == 200, r.text
+        doc = requests.get(f"{url}/api/v1/providers", timeout=5).json()
+        reg = next(p for p in doc["providers"] if p["name"] == "corp-llm")
+        assert reg["has_key"] is True
+        import json as _json
+
+        assert "sk-x" not in _json.dumps(doc)    # secrets masked
+
+    def test_profile_accepts_yaml_body(self, stack):
+        url = stack
+        yml = (
+            "name: ui-made\n"
+            "requirement: {chips: 8, vendor: cpu}\n"
+            "models:\n"
+            "  - name: tiny-chat\n"
+            "    engine: {max_decode_batch: 1}\n"
+        )
+        r = requests.post(
+            f"{url}/api/v1/profiles", data=yml,
+            headers={"Content-Type": "application/yaml"}, timeout=5,
+        )
+        assert r.status_code == 200, r.text
+        doc = requests.get(f"{url}/api/v1/profiles/ui-made", timeout=5)
+        assert doc.status_code == 200
+        assert doc.json()["models"][0]["name"] == "tiny-chat"
+
+    def test_register_helix_provider_rejected(self, stack):
+        url = stack
+        r = requests.post(
+            f"{url}/api/v1/providers",
+            json={"name": "helix", "kind": "openai_compat",
+                  "base_url": "https://evil.example"},
+            timeout=5,
+        )
+        assert r.status_code == 400
+        assert "reserved" in r.json()["error"]["message"]
+
+    def test_register_provider_bad_json_is_400(self, stack):
+        url = stack
+        r = requests.post(
+            f"{url}/api/v1/providers", data="name: yaml-not-json",
+            timeout=5,
+        )
+        assert r.status_code == 400
+
+
+def test_registered_providers_survive_restart(tmp_path):
+    """DB-backed endpoints (reference: per-org provider rows) — and the
+    API key rests encrypted, never plaintext in the store file."""
+    from helix_tpu.control.providers import ProviderEndpoint
+    from helix_tpu.control.server import ControlPlane
+
+    db = str(tmp_path / "cp.db")
+
+    def stop(cp):
+        cp.orchestrator.stop()
+        cp.knowledge.stop()
+        cp.triggers.stop()
+
+    cp = ControlPlane(db_path=db)
+    try:
+        ep = ProviderEndpoint(
+            name="corp", kind="openai_compat",
+            base_url="https://llm.corp.example", api_key="sk-corp-1",
+        )
+        cp.providers.register(ep)
+        cp._persist_provider(ep)
+    finally:
+        stop(cp)
+    raw = open(db, "rb").read()
+    assert b"sk-corp-1" not in raw          # encrypted at rest
+
+    cp2 = ControlPlane(db_path=db)
+    try:
+        assert "corp" in cp2.providers.names()
+        restored = cp2.providers.get("corp").endpoint
+        assert restored.api_key == "sk-corp-1"
+        assert restored.base_url == "https://llm.corp.example"
+    finally:
+        stop(cp2)
