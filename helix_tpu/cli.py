@@ -322,6 +322,24 @@ def _cmd_sft(args) -> int:
     reference exposed through fine-tune sessions (axolotl, deleted)."""
     import json as _json
 
+    from helix_tpu.parallel.multihost import MultiHostConfig, initialize
+
+    # join the DCN world BEFORE the first backend query (jax.devices()
+    # must span every host for the global mesh)
+    # per-field merge: explicit flags override env, partial flag sets
+    # compose with env instead of being silently discarded
+    env_cfg = MultiHostConfig.from_env()
+    mh = MultiHostConfig(
+        coordinator=getattr(args, "coordinator", "") or env_cfg.coordinator,
+        num_processes=(
+            getattr(args, "num_hosts", 1)
+            if getattr(args, "num_hosts", 1) > 1
+            else env_cfg.num_processes
+        ),
+        process_id=getattr(args, "host_rank", 0) or env_cfg.process_id,
+    )
+    distributed = initialize(mh)
+
     import jax
 
     from helix_tpu.device.mesh import default_mesh_spec, build_mesh
@@ -345,7 +363,12 @@ def _cmd_sft(args) -> int:
 
     n_dev = len(jax.devices())
     mesh = None
-    if n_dev > 1:
+    if distributed:
+        from helix_tpu.parallel.multihost import global_mesh_spec
+
+        mesh = build_mesh(global_mesh_spec())
+        params = shard_params(params, mesh, param_logical_axes(model_cfg))
+    elif n_dev > 1:
         mesh = build_mesh(default_mesh_spec(n_dev))
         params = shard_params(params, mesh, param_logical_axes(model_cfg))
 
@@ -367,14 +390,37 @@ def _cmd_sft(args) -> int:
     def batches():
         epoch = 0
         while True:
-            yield from pack_examples(
+            for b in pack_examples(
                 examples, cfg.batch_size, cfg.seq_len, shuffle_seed=epoch
-            )
+            ):
+                if distributed:
+                    # every host packs the same deterministic global batch
+                    # and feeds only its own rows (dp-outermost layout)
+                    import dataclasses as _dc
+
+                    from helix_tpu.parallel.multihost import (
+                        host_local_slice,
+                    )
+
+                    b = _dc.replace(b, **{
+                        f.name: host_local_slice(
+                            getattr(b, f.name), mh.process_id,
+                            mh.num_processes,
+                        )
+                        for f in _dc.fields(b)
+                    })
+                yield b
             epoch += 1
 
     def on_log(m):
-        print(_json.dumps(m), flush=True)
+        from helix_tpu.parallel.multihost import is_coordinator
+
+        if not distributed or is_coordinator():
+            print(_json.dumps(m), flush=True)   # one log stream (rank 0)
         if args.output and m["step"] % args.save_every == 0:
+            # checkpoint save is a cross-process collective (every rank
+            # writes its addressable shards + a sync barrier) — it MUST
+            # run on all hosts, to a shared filesystem
             save_checkpoint(
                 args.output, trainer.step_num, trainer.lora_params,
                 trainer.opt_state,
@@ -382,11 +428,15 @@ def _cmd_sft(args) -> int:
 
     trainer.train(batches(), log_every=args.log_every, on_log=on_log)
     if args.output:
+        from helix_tpu.parallel.multihost import is_coordinator
+
+        # all ranks participate in the (collective) save; rank 0 narrates
         save_checkpoint(
             args.output, trainer.step_num, trainer.lora_params,
             trainer.opt_state,
         )
-        print(f"saved adapters to {args.output}")
+        if not distributed or is_coordinator():
+            print(f"saved adapters to {args.output}")
     return 0
 
 
@@ -532,6 +582,10 @@ def main(argv=None) -> int:
     t.add_argument("--seq-len", type=int, default=1024)
     t.add_argument("--save-every", type=int, default=50)
     t.add_argument("--log-every", type=int, default=10)
+    t.add_argument("--coordinator", default="",
+                   help="multi-host: process 0's host:port (DCN world)")
+    t.add_argument("--num-hosts", type=int, default=1)
+    t.add_argument("--host-rank", type=int, default=0)
     t.set_defaults(fn=_cmd_sft)
 
     args = p.parse_args(argv)

@@ -132,6 +132,26 @@ ENV_REFERENCE: tuple = (
         "Internal: marks the CPU-fallback bench child process.",
         section="accelerator",
     ),
+    # -- multi-host (DCN) training ---------------------------------------
+    EnvVar(
+        "HELIX_COORDINATOR",
+        "Multi-host training: process 0's host:port for the jax "
+        "distributed world (gradient all-reduce rides DCN between "
+        "hosts).",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_NUM_HOSTS",
+        "Multi-host training: total participating host processes.",
+        default="1",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_HOST_RANK",
+        "Multi-host training: this host's process rank (0-based).",
+        default="0",
+        section="accelerator",
+    ),
     # -- compute autoscaler (GCE provider) -------------------------------
     EnvVar(
         "HELIX_GCE_PROJECT",

@@ -1,0 +1,157 @@
+"""Multi-host (DCN) distributed training: data parallelism across hosts.
+
+SURVEY §2.2/§7: the reference's NCCL/MPI world is replaced by XLA
+collectives — ICI inside a slice, DCN between hosts — with gradient
+all-reduce placed by sharding, not hand-written comms. This module owns
+the process-level plumbing jax needs for that:
+
+- ``MultiHostConfig`` (coordinator address, process count, rank) from
+  flags or ``HELIX_COORDINATOR``/``HELIX_NUM_HOSTS``/``HELIX_HOST_RANK``;
+- ``initialize()`` wraps ``jax.distributed.initialize`` (a no-op for a
+  single host, so the same entrypoint serves both);
+- ``global_mesh_spec()`` lays out dp **outermost over hosts** (gradient
+  all-reduce rides DCN once per step — the bandwidth-tolerant axis) and
+  tp/sp innermost (latency-sensitive collectives stay on ICI within a
+  host), the standard TPU recipe;
+- ``host_local_slice()`` + ``device_batch_from_local()`` feed each
+  process ITS shard of the global batch via
+  ``jax.make_array_from_process_local_data`` — no host ever materialises
+  the global batch, which is what makes the dp axis scale past one
+  host's memory.
+
+Serving-plane DP across hosts is intentionally NOT here: N hosts serving
+one model name are load-balanced by the router's per-model round-robin
+(``control/router.py``), mirroring the reference
+(``inferencerouter/router.go:168-198``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from helix_tpu.device.mesh import MeshSpec
+
+
+@dataclasses.dataclass(frozen=True)
+class MultiHostConfig:
+    coordinator: str = ""        # "host:port" of process 0
+    num_processes: int = 1
+    process_id: int = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "MultiHostConfig":
+        env = env if env is not None else os.environ
+        return cls(
+            coordinator=env.get("HELIX_COORDINATOR", ""),
+            num_processes=int(env.get("HELIX_NUM_HOSTS", "1") or 1),
+            process_id=int(env.get("HELIX_HOST_RANK", "0") or 0),
+        )
+
+    def validate(self) -> None:
+        if self.num_processes < 1:
+            raise ValueError("num_processes must be >= 1")
+        if not (0 <= self.process_id < self.num_processes):
+            raise ValueError(
+                f"process_id {self.process_id} outside "
+                f"[0, {self.num_processes})"
+            )
+        if self.num_processes > 1 and not self.coordinator:
+            raise ValueError(
+                "multi-host training needs a coordinator address "
+                "(process 0's host:port)"
+            )
+        if self.coordinator and self.num_processes <= 1:
+            raise ValueError(
+                "a coordinator address was given but num_processes is 1 — "
+                "did you forget --num-hosts / HELIX_NUM_HOSTS? Refusing to "
+                "train a silent single-host copy."
+            )
+
+
+def initialize(cfg: Optional[MultiHostConfig] = None) -> bool:
+    """Join the jax distributed system; no-op (False) for a single host.
+
+    Must run before the first backend query — after this,
+    ``jax.devices()`` spans every host's chips and jit'd computations over
+    a global mesh insert DCN collectives automatically.
+    """
+    cfg = cfg or MultiHostConfig.from_env()
+    cfg.validate()
+    if cfg.num_processes <= 1:
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+    return True
+
+
+def is_coordinator() -> bool:
+    import jax
+
+    return jax.process_index() == 0
+
+
+def global_mesh_spec(
+    num_devices: Optional[int] = None,
+    num_hosts: Optional[int] = None,
+    max_tp: int = 8,
+) -> MeshSpec:
+    """dp-over-hosts x tp-within-host layout for the global device set.
+
+    tp never crosses a host boundary (its all-reduces are on every matmul
+    — they must stay on ICI); dp is a multiple of the host count so each
+    host's chips sit in whole dp rows and the gradient all-reduce between
+    hosts is the only DCN traffic.
+    """
+    import jax
+
+    if num_devices is None:
+        num_devices = jax.device_count()       # global, all processes
+    if num_hosts is None:
+        num_hosts = jax.process_count()
+    if num_devices % num_hosts:
+        raise ValueError(
+            f"{num_devices} devices do not divide over {num_hosts} hosts"
+        )
+    per_host = num_devices // num_hosts
+    import math
+
+    tp = math.gcd(per_host, max_tp)
+    return MeshSpec(dp=num_devices // tp, tp=tp)
+
+
+def host_local_slice(array, process_id: int, num_processes: int):
+    """This host's rows of a [global_batch, ...] array (contiguous block
+    layout, matching dp-outermost device order)."""
+    n = array.shape[0]
+    if n % num_processes:
+        raise ValueError(
+            f"global batch {n} does not divide over {num_processes} hosts"
+        )
+    per = n // num_processes
+    return array[process_id * per : (process_id + 1) * per]
+
+
+def device_batch_from_local(local_tree: dict, mesh, axes=("batch", None)):
+    """Assemble global device arrays from per-process local shards.
+
+    Each process passes only ITS slice; ``make_array_from_process_local_
+    data`` stitches the global logical array with the batch axis sharded
+    over dp — cross-host assembly without any host gather.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from helix_tpu.parallel.sharding import logical_sharding
+
+    sh = logical_sharding(mesh, axes)
+    return {
+        k: jax.make_array_from_process_local_data(sh, jnp.asarray(v))
+        for k, v in local_tree.items()
+    }

@@ -169,7 +169,12 @@ class GitHubSync(ExternalGitSync):
                 return cached[1]
         result = self._poll_uncached(cfg, pr)
         with self._lock:
-            self._poll_cache[pr["id"]] = (_time.monotonic(), result)
+            if result and result.get("status") in ("merged", "closed"):
+                # terminal: the orchestrator stops polling this PR —
+                # keeping the entry would leak one dict per PR forever
+                self._poll_cache.pop(pr["id"], None)
+            else:
+                self._poll_cache[pr["id"]] = (_time.monotonic(), result)
         return result
 
     def _poll_uncached(self, cfg: dict, pr: dict) -> Optional[dict]:

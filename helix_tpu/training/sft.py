@@ -138,6 +138,14 @@ class SFTTrainer:
             "segment_ids": batch.segment_ids,
         }
         if self.mesh is not None:
+            if jax.process_count() > 1:
+                # multi-host: this process holds only its host-local rows;
+                # stitch the global batch without any cross-host gather
+                from helix_tpu.parallel.multihost import (
+                    device_batch_from_local,
+                )
+
+                return device_batch_from_local(d, self.mesh)
             from helix_tpu.parallel.sharding import logical_sharding
 
             sh = logical_sharding(self.mesh, ("batch", None))
