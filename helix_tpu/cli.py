@@ -320,23 +320,31 @@ def _cmd_bench(args) -> int:
 def _cmd_sft(args) -> int:
     """LoRA SFT: the `fine-tune a model from a JSONL dataset` surface the
     reference exposed through fine-tune sessions (axolotl, deleted)."""
+    import dataclasses as _dc
     import json as _json
 
-    from helix_tpu.parallel.multihost import MultiHostConfig, initialize
+    from helix_tpu.parallel.multihost import (
+        MultiHostConfig,
+        host_local_slice,
+        initialize,
+        is_coordinator,
+    )
 
     # join the DCN world BEFORE the first backend query (jax.devices()
     # must span every host for the global mesh)
-    # per-field merge: explicit flags override env, partial flag sets
-    # compose with env instead of being silently discarded
+    # per-field merge: a flag the user passed overrides env; an omitted
+    # flag (None default) falls back to env.  None-sentinels matter:
+    # --host-rank 0 and --num-hosts 1 are legitimate explicit values.
     env_cfg = MultiHostConfig.from_env()
+
+    def _flag(name, env_val):
+        v = getattr(args, name, None)
+        return env_val if v is None else v
+
     mh = MultiHostConfig(
-        coordinator=getattr(args, "coordinator", "") or env_cfg.coordinator,
-        num_processes=(
-            getattr(args, "num_hosts", 1)
-            if getattr(args, "num_hosts", 1) > 1
-            else env_cfg.num_processes
-        ),
-        process_id=getattr(args, "host_rank", 0) or env_cfg.process_id,
+        coordinator=_flag("coordinator", env_cfg.coordinator),
+        num_processes=_flag("num_hosts", env_cfg.num_processes),
+        process_id=_flag("host_rank", env_cfg.process_id),
     )
     distributed = initialize(mh)
 
@@ -379,13 +387,15 @@ def _cmd_sft(args) -> int:
         batch_size=args.batch_size,
         seq_len=args.seq_len,
     )
+    rank0 = not distributed or is_coordinator()
     trainer = SFTTrainer(model_cfg, params, cfg, mesh=mesh)
     if args.resume and args.output:
-        if resume_trainer(trainer, args.output):
+        if resume_trainer(trainer, args.output) and rank0:
             print(f"resumed from step {trainer.step_num}")
 
     examples = load_jsonl(args.data, tokenizer)
-    print(f"loaded {len(examples)} examples")
+    if rank0:
+        print(f"loaded {len(examples)} examples")
 
     def batches():
         epoch = 0
@@ -396,12 +406,6 @@ def _cmd_sft(args) -> int:
                 if distributed:
                     # every host packs the same deterministic global batch
                     # and feeds only its own rows (dp-outermost layout)
-                    import dataclasses as _dc
-
-                    from helix_tpu.parallel.multihost import (
-                        host_local_slice,
-                    )
-
                     b = _dc.replace(b, **{
                         f.name: host_local_slice(
                             getattr(b, f.name), mh.process_id,
@@ -413,29 +417,31 @@ def _cmd_sft(args) -> int:
             epoch += 1
 
     def on_log(m):
-        from helix_tpu.parallel.multihost import is_coordinator
-
-        if not distributed or is_coordinator():
+        if rank0:
             print(_json.dumps(m), flush=True)   # one log stream (rank 0)
-        if args.output and m["step"] % args.save_every == 0:
+
+    def on_step(step):
+        if args.output and step % args.save_every == 0:
             # checkpoint save is a cross-process collective (every rank
             # writes its addressable shards + a sync barrier) — it MUST
-            # run on all hosts, to a shared filesystem
+            # run on all hosts, to a shared filesystem.  Fired from the
+            # per-step hook so --save-every is honoured exactly, not
+            # only when it happens to align with --log-every.
             save_checkpoint(
                 args.output, trainer.step_num, trainer.lora_params,
                 trainer.opt_state,
             )
 
-    trainer.train(batches(), log_every=args.log_every, on_log=on_log)
+    trainer.train(
+        batches(), log_every=args.log_every, on_log=on_log, on_step=on_step
+    )
     if args.output:
-        from helix_tpu.parallel.multihost import is_coordinator
-
         # all ranks participate in the (collective) save; rank 0 narrates
         save_checkpoint(
             args.output, trainer.step_num, trainer.lora_params,
             trainer.opt_state,
         )
-        if not distributed or is_coordinator():
+        if rank0:
             print(f"saved adapters to {args.output}")
     return 0
 
@@ -582,10 +588,10 @@ def main(argv=None) -> int:
     t.add_argument("--seq-len", type=int, default=1024)
     t.add_argument("--save-every", type=int, default=50)
     t.add_argument("--log-every", type=int, default=10)
-    t.add_argument("--coordinator", default="",
+    t.add_argument("--coordinator", default=None,
                    help="multi-host: process 0's host:port (DCN world)")
-    t.add_argument("--num-hosts", type=int, default=1)
-    t.add_argument("--host-rank", type=int, default=0)
+    t.add_argument("--num-hosts", type=int, default=None)
+    t.add_argument("--host-rank", type=int, default=None)
     t.set_defaults(fn=_cmd_sft)
 
     args = p.parse_args(argv)

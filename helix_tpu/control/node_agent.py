@@ -168,6 +168,11 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
             ekw["max_pages_per_seq"] = need_pages
         if ekw.get("num_pages", 2048) < need_pages + 1:
             ekw["num_pages"] = need_pages + 1
+    if "decode_steps_per_sync" not in ekw and jax.default_backend() == "tpu":
+        # on real TPU hardware the host-device link has latency (a relay
+        # device_get costs ~28 ms); fuse decode steps so steady-state
+        # decode fetches tokens once per window, not once per token
+        ekw["decode_steps_per_sync"] = 8
     ecfg = EngineConfig(
         eos_token_ids=tuple(tokenizer.eos_ids),
         **ekw,

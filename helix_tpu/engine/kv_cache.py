@@ -141,8 +141,18 @@ def write_kv(
     the engine's garbage page) so the scatter stays fully dense.
     """
     L, B, S, KVH, D = k_new.shape
-    flat_pages = jnp.where(valid, pages, 0).reshape(-1)
-    flat_off = jnp.where(valid, offsets, 0).reshape(-1)
+    Lp, KVHp, P, ps, Dp = cache.k_pages.shape
+    # Scatter at ONE fused token index (page*page_size + offset) into a
+    # [L, KVH, P*ps, D] view of the pool.  The (page, offset) two-index
+    # scatter made XLA:TPU pick a different result layout for the pool,
+    # which defeated buffer donation and materialised a full pool copy
+    # inside the prefill program (3 GiB for an 8B-scale cache — the r3
+    # bench OOM); the fused-index form keeps the default layout so the
+    # scatter updates the donated buffer in place.  The reshapes are
+    # bitcasts (pages and offset are adjacent, contiguous dims).
+    flat_idx = jnp.where(
+        valid, pages * ps + offsets, 0
+    ).reshape(-1)
     # [L, B*S, KVH, D] -> [L, KVH, B*S, D] to match the pool layout
     kf = (
         k_new.reshape(L, B * S, KVH, D)
@@ -154,11 +164,17 @@ def write_kv(
         .transpose(0, 2, 1, 3)
         .astype(cache.v_pages.dtype)
     )
-    k_pages = cache.k_pages.at[:, :, flat_pages, flat_off].set(
-        kf, mode="drop", unique_indices=False
+    k_pages = (
+        cache.k_pages.reshape(Lp, KVHp, P * ps, Dp)
+        .at[:, :, flat_idx]
+        .set(kf, mode="drop", unique_indices=False)
+        .reshape(Lp, KVHp, P, ps, Dp)
     )
-    v_pages = cache.v_pages.at[:, :, flat_pages, flat_off].set(
-        vf, mode="drop", unique_indices=False
+    v_pages = (
+        cache.v_pages.reshape(Lp, KVHp, P * ps, Dp)
+        .at[:, :, flat_idx]
+        .set(vf, mode="drop", unique_indices=False)
+        .reshape(Lp, KVHp, P, ps, Dp)
     )
     return PagedKVCache(k_pages=k_pages, v_pages=v_pages)
 

@@ -171,8 +171,10 @@ class GitHubSync(ExternalGitSync):
         with self._lock:
             if result and result.get("status") in ("merged", "closed"):
                 # terminal: the orchestrator stops polling this PR —
-                # keeping the entry would leak one dict per PR forever
+                # keeping the entries would leak per PR forever.  A
+                # post-terminal poll recovers the number via _find_number.
                 self._poll_cache.pop(pr["id"], None)
+                self._pr_numbers.pop(pr["id"], None)
             else:
                 self._poll_cache[pr["id"]] = (_time.monotonic(), result)
         return result

@@ -167,8 +167,13 @@ class SFTTrainer:
         batches: Iterable,
         log_every: int = 10,
         on_log=None,
+        on_step=None,
     ) -> list:
-        """Run up to cfg.total_steps over ``batches``; returns loss history."""
+        """Run up to cfg.total_steps over ``batches``; returns loss history.
+
+        ``on_step(step_num)`` fires after EVERY optimizer step (checkpoint
+        cadence must not be coupled to the logging cadence); ``on_log``
+        fires every ``log_every`` steps with a metrics dict."""
         history = []
         t0 = time.monotonic()
         for batch in batches:
@@ -176,6 +181,8 @@ class SFTTrainer:
                 break
             loss = self.train_step(batch)
             history.append(loss)
+            if on_step is not None:
+                on_step(self.step_num)
             if self.step_num % log_every == 0:
                 msg = {
                     "step": self.step_num,

