@@ -244,6 +244,73 @@ def _cmd_knowledge(args) -> int:
     return 0
 
 
+def _cmd_evals(args) -> int:
+    """Evaluation suites/runs (reference: the `evals` verb,
+    api/cmd/helix/evals.go, + suite/run routes server.go:1058-1067)."""
+    import json as _json
+
+    base = f"/api/v1/apps/{args.app}"
+    if args.action == "list":
+        for s in _api(args, "GET", f"{base}/evaluation-suites")["suites"]:
+            nq = len(s.get("questions", []))
+            print(f"{s['id']}\t{nq} questions\t{s.get('name', '')}")
+    elif args.action == "create":
+        with open(args.file) as f:
+            raw = f.read()
+        try:
+            doc = _json.loads(raw)
+        except ValueError:
+            import yaml as _yaml
+
+            doc = _yaml.safe_load(raw)
+        s = _api(args, "POST", f"{base}/evaluation-suites", json=doc)
+        print(f"created suite {s['id']} ({len(s['questions'])} questions)")
+    elif args.action == "run":
+        run = _api(
+            args, "POST", f"{base}/evaluation-suites/{args.id}/runs"
+        )
+        rid = run["id"]
+        print(f"run {rid} started")
+        import time as _time
+
+        while True:
+            run = _api(args, "GET", f"{base}/evaluation-runs/{rid}")
+            if run["status"] in ("completed", "failed", "cancelled"):
+                break
+            _time.sleep(1.0)
+        summ = run.get("summary", {})
+        print(
+            f"{run['status']}: {summ.get('passed', 0)}/"
+            f"{summ.get('total_questions', 0)} passed"
+        )
+        for r in run.get("results", []):
+            mark = "PASS" if r["passed"] else "FAIL"
+            print(f"  [{mark}] {r['question'][:70]}")
+        return 0 if run["status"] == "completed" and not summ.get(
+            "failed", 0
+        ) else 1
+    elif args.action == "runs":
+        for r in _api(
+            args, "GET", f"{base}/evaluation-suites/{args.id}/runs"
+        )["runs"]:
+            summ = r.get("summary", {})
+            print(
+                f"{r['id']}\t{r['status']}\t"
+                f"{summ.get('passed', 0)}/{summ.get('total_questions', 0)}"
+            )
+    elif args.action == "show":
+        print(
+            _json.dumps(
+                _api(args, "GET", f"{base}/evaluation-runs/{args.id}"),
+                indent=2,
+            )
+        )
+    elif args.action == "delete":
+        _api(args, "DELETE", f"{base}/evaluation-suites/{args.id}")
+        print("deleted")
+    return 0
+
+
 def _cmd_secret(args) -> int:
     if args.action == "set":
         value = args.value
@@ -546,6 +613,18 @@ def main(argv=None) -> int:
     kd = ksub.add_parser("delete", parents=[api_flags])
     kd.add_argument("id")
     k.set_defaults(fn=_cmd_knowledge)
+
+    ev = sub.add_parser("evals", help="evaluate an app with a test suite")
+    evsub = ev.add_subparsers(dest="action", required=True)
+    for act, extra in (
+        ("list", ()), ("create", ("file",)), ("run", ("id",)),
+        ("runs", ("id",)), ("show", ("id",)), ("delete", ("id",)),
+    ):
+        ep = evsub.add_parser(act, parents=[api_flags])
+        ep.add_argument("--app", required=True, help="app id")
+        for a in extra:
+            ep.add_argument(a)
+    ev.set_defaults(fn=_cmd_evals)
 
     se = sub.add_parser("secret", help="user secrets")
     sesub = se.add_subparsers(dest="action", required=True)

@@ -20,6 +20,7 @@ plus session storage:
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import uuid
 
@@ -80,6 +81,12 @@ class ControlPlane:
             ":memory:" if db_path == ":memory:" else db_path + ".billing"
         )
         self.billing = BillingService(bill_path, usage_store=None)
+        from helix_tpu.control.stripe import StripeService
+
+        stripe_path = (
+            ":memory:" if db_path == ":memory:" else db_path + ".stripe"
+        )
+        self.stripe = StripeService.from_env(self.billing, stripe_path)
         self.auth_required = auth_required
         self.providers = ProviderManager.from_env(self.router)
         self._restore_providers()   # DB-backed endpoints survive restarts
@@ -307,6 +314,9 @@ class ControlPlane:
         from helix_tpu.control.triggers import TriggerManager
 
         self.bus = EventBus()
+        from helix_tpu.services.evals import EvalService
+
+        self.evals = EvalService(self.store, self.controller, self.bus)
         files_root = (
             tempfile_dir()
             if db_path == ":memory:"
@@ -553,6 +563,45 @@ class ControlPlane:
         r.add_post("/api/v1/apps", self.create_app)
         r.add_get("/api/v1/apps/{id}", self.get_app)
         r.add_delete("/api/v1/apps/{id}", self.delete_app)
+        # evaluation suites + runs (reference: server.go:1058-1067)
+        r.add_get(
+            "/api/v1/apps/{app_id}/evaluation-suites", self.list_eval_suites
+        )
+        r.add_post(
+            "/api/v1/apps/{app_id}/evaluation-suites", self.create_eval_suite
+        )
+        r.add_get(
+            "/api/v1/apps/{app_id}/evaluation-suites/{id}",
+            self.get_eval_suite,
+        )
+        r.add_put(
+            "/api/v1/apps/{app_id}/evaluation-suites/{id}",
+            self.update_eval_suite,
+        )
+        r.add_delete(
+            "/api/v1/apps/{app_id}/evaluation-suites/{id}",
+            self.delete_eval_suite,
+        )
+        r.add_post(
+            "/api/v1/apps/{app_id}/evaluation-suites/{id}/runs",
+            self.start_eval_run,
+        )
+        r.add_get(
+            "/api/v1/apps/{app_id}/evaluation-suites/{id}/runs",
+            self.list_eval_runs,
+        )
+        r.add_get(
+            "/api/v1/apps/{app_id}/evaluation-runs/{run_id}",
+            self.get_eval_run,
+        )
+        r.add_delete(
+            "/api/v1/apps/{app_id}/evaluation-runs/{run_id}",
+            self.delete_eval_run,
+        )
+        r.add_get(
+            "/api/v1/apps/{app_id}/evaluation-runs/{run_id}/stream",
+            self.stream_eval_run,
+        )
         # knowledge
         r.add_get("/api/v1/knowledge", self.list_knowledge)
         r.add_post("/api/v1/knowledge", self.create_knowledge)
@@ -588,6 +637,18 @@ class ControlPlane:
         r.add_get("/api/v1/wallet", self.get_wallet)
         r.add_post("/api/v1/wallet/topup", self.topup)
         r.add_get("/api/v1/wallet/transactions", self.list_transactions)
+        # stripe rails (reference: api/pkg/stripe)
+        r.add_post("/webhooks/stripe", self.stripe_webhook)
+        r.add_post(
+            "/api/v1/wallet/topup-session", self.stripe_topup_session
+        )
+        r.add_post(
+            "/api/v1/wallet/subscription-session",
+            self.stripe_subscription_session,
+        )
+        r.add_get(
+            "/api/v1/wallet/subscription", self.stripe_subscription_state
+        )
         # spec tasks + internal git hosting
         r.add_get("/api/v1/spec-tasks", self.list_spec_tasks)
         r.add_post("/api/v1/spec-tasks", self.create_spec_task)
@@ -982,6 +1043,116 @@ class ControlPlane:
         ok = self.store.delete_app(request.match_info["id"])
         return web.json_response({"ok": ok}, status=200 if ok else 404)
 
+    # -- evaluation suites / runs -------------------------------------------
+    # (reference: server.go:1058-1067 + types/evaluation.go)
+    async def list_eval_suites(self, request):
+        return web.json_response(
+            {
+                "suites": self.store.list_eval_suites(
+                    request.match_info["app_id"]
+                )
+            }
+        )
+
+    async def create_eval_suite(self, request):
+        body = await request.json()
+        try:
+            suite = self.evals.create_suite(
+                request.match_info["app_id"],
+                request.query.get("owner", "anonymous"),
+                body,
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(suite)
+
+    async def get_eval_suite(self, request):
+        suite = self.store.get_eval_suite(request.match_info["id"])
+        if suite is None:
+            return _err(404, "suite not found")
+        return web.json_response(suite)
+
+    async def update_eval_suite(self, request):
+        body = await request.json()
+        try:
+            suite = self.evals.update_suite(request.match_info["id"], body)
+        except ValueError as e:
+            return _err(400, str(e))
+        if suite is None:
+            return _err(404, "suite not found")
+        return web.json_response(suite)
+
+    async def delete_eval_suite(self, request):
+        ok = self.store.delete_eval_suite(request.match_info["id"])
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def start_eval_run(self, request):
+        run = self.evals.start_run(
+            request.match_info["id"], request.query.get("owner", "anonymous")
+        )
+        if run is None:
+            return _err(404, "suite not found")
+        return web.json_response(run, status=201)
+
+    async def list_eval_runs(self, request):
+        return web.json_response(
+            {"runs": self.store.list_eval_runs(request.match_info["id"])}
+        )
+
+    async def get_eval_run(self, request):
+        run = self.store.get_eval_run(request.match_info["run_id"])
+        if run is None:
+            return _err(404, "run not found")
+        return web.json_response(run)
+
+    async def delete_eval_run(self, request):
+        rid = request.match_info["run_id"]
+        self.evals.cancel_run(rid)
+        ok = self.store.delete_eval_run(rid)
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def stream_eval_run(self, request):
+        """SSE progress stream for a running evaluation (reference:
+        ``streamEvaluationRun``, server.go:1067)."""
+        import asyncio as _asyncio
+
+        rid = request.match_info["run_id"]
+        if self.store.get_eval_run(rid) is None:
+            return _err(404, "run not found")
+        resp = web.StreamResponse(
+            headers={"Content-Type": "text/event-stream"}
+        )
+        await resp.prepare(request)
+        q: _asyncio.Queue = _asyncio.Queue()
+        loop = _asyncio.get_event_loop()
+        sub = self.bus.subscribe(
+            f"evals.{rid}",
+            lambda t, m: loop.call_soon_threadsafe(q.put_nowait, m),
+        )
+        # snapshot AFTER subscribing: a terminal event landing between
+        # snapshot and subscribe would otherwise be published to nobody
+        # and the stream would hang on a stale "running" state
+        run = self.store.get_eval_run(rid)
+        try:
+            # replay current state first so late subscribers see something
+            await resp.write(
+                f"data: {json.dumps(run)}\n\n".encode()
+            )
+            if run["status"] in ("completed", "failed", "cancelled"):
+                return resp
+            while True:
+                evt = await _asyncio.wait_for(q.get(), timeout=300)
+                await resp.write(f"data: {json.dumps(evt)}\n\n".encode())
+                if evt.get("status") in ("completed", "failed", "cancelled"):
+                    break
+        except (_asyncio.TimeoutError, ConnectionResetError):
+            pass
+        finally:
+            sub.unsubscribe()
+        with contextlib.suppress(ConnectionResetError):
+            await resp.write_eof()
+        return resp
+
     # -- knowledge -----------------------------------------------------------
     async def list_knowledge(self, request):
         return web.json_response(
@@ -1294,6 +1465,63 @@ class ControlPlane:
                     self._user_id(request)
                 )
             }
+        )
+
+    # -- stripe rails ---------------------------------------------------------
+    async def stripe_webhook(self, request):
+        """Signed Stripe webhook (reference: ProcessWebhook,
+        api/pkg/stripe/stripe.go:137). Open path — the signature IS the
+        authentication; 503 when rails are unconfigured so Stripe retries
+        instead of treating events as delivered."""
+        from helix_tpu.control.stripe import SignatureError
+
+        if not self.stripe.enabled():
+            return _err(503, "stripe is not configured")
+        payload = await request.read()
+        if len(payload) > 65536:
+            return _err(400, "payload too large")
+        try:
+            result = await asyncio.get_event_loop().run_in_executor(
+                None,
+                self.stripe.process_webhook,
+                payload,
+                request.headers.get("Stripe-Signature", ""),
+            )
+        except SignatureError as e:
+            return _err(400, f"bad signature: {e}")
+        return web.json_response(result)
+
+    async def stripe_topup_session(self, request):
+        if not self.stripe.enabled():
+            return _err(503, "stripe is not configured")
+        body = await request.json()
+        try:
+            url = await asyncio.get_event_loop().run_in_executor(
+                None,
+                self.stripe.topup_session_url,
+                self._user_id(request),
+                float(body.get("usd", 0)),
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response({"url": url})
+
+    async def stripe_subscription_session(self, request):
+        if not self.stripe.enabled():
+            return _err(503, "stripe is not configured")
+        try:
+            url = await asyncio.get_event_loop().run_in_executor(
+                None,
+                self.stripe.subscription_session_url,
+                self._user_id(request),
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response({"url": url})
+
+    async def stripe_subscription_state(self, request):
+        return web.json_response(
+            self.stripe.subscription_state(self._user_id(request))
         )
 
     # -- spec tasks -----------------------------------------------------------

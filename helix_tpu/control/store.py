@@ -87,6 +87,26 @@ CREATE TABLE IF NOT EXISTS kv (
     k TEXT PRIMARY KEY,
     v TEXT NOT NULL
 );
+CREATE TABLE IF NOT EXISTS eval_suites (
+    id TEXT PRIMARY KEY,
+    app_id TEXT,
+    owner TEXT,
+    doc TEXT NOT NULL,        -- JSON: name, description, questions[]
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_eval_suites_app ON eval_suites(app_id);
+CREATE TABLE IF NOT EXISTS eval_runs (
+    id TEXT PRIMARY KEY,
+    suite_id TEXT NOT NULL,
+    app_id TEXT,
+    owner TEXT,
+    status TEXT NOT NULL,     -- pending|running|completed|failed|cancelled
+    doc TEXT NOT NULL,        -- JSON: summary, results[], error
+    created_at REAL NOT NULL,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_eval_runs_suite ON eval_runs(suite_id);
 """
 
 
@@ -404,3 +424,133 @@ class Store:
                 "SELECT v FROM kv WHERE k=?", (k,)
             ).fetchone()
         return json.loads(row[0]) if row else default
+
+    # -- evaluation suites / runs ------------------------------------------
+    # (reference: EvaluationSuite/EvaluationRun entities,
+    #  api/pkg/types/evaluation.go + store/postgres.go:245-246)
+    def create_eval_suite(self, app_id: str, owner: str, doc: dict) -> str:
+        sid = "evs-" + uuid.uuid4().hex[:12]
+        now = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO eval_suites(id, app_id, owner, doc, "
+                "created_at, updated_at) VALUES(?,?,?,?,?,?)",
+                (sid, app_id, owner, json.dumps(doc), now, now),
+            )
+            self._conn.commit()
+        return sid
+
+    def update_eval_suite(self, sid: str, doc: dict) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE eval_suites SET doc=?, updated_at=? WHERE id=?",
+                (json.dumps(doc), time.time(), sid),
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    def get_eval_suite(self, sid: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, app_id, owner, doc, created_at, updated_at "
+                "FROM eval_suites WHERE id=?",
+                (sid,),
+            ).fetchone()
+        return self._suite_row(row) if row else None
+
+    def list_eval_suites(self, app_id: Optional[str] = None) -> list:
+        q = ("SELECT id, app_id, owner, doc, created_at, updated_at "
+             "FROM eval_suites")
+        args: tuple = ()
+        if app_id:
+            q += " WHERE app_id=?"
+            args = (app_id,)
+        with self._lock:
+            rows = self._conn.execute(
+                q + " ORDER BY created_at", args
+            ).fetchall()
+        return [self._suite_row(r) for r in rows]
+
+    def delete_eval_suite(self, sid: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM eval_suites WHERE id=?", (sid,)
+            )
+            self._conn.execute(
+                "DELETE FROM eval_runs WHERE suite_id=?", (sid,)
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    @staticmethod
+    def _suite_row(row) -> dict:
+        doc = json.loads(row[3])
+        doc.update(
+            id=row[0], app_id=row[1], owner=row[2],
+            created_at=row[4], updated_at=row[5],
+        )
+        return doc
+
+    def create_eval_run(
+        self, suite_id: str, app_id: str, owner: str, doc: dict,
+        status: str = "pending",
+    ) -> str:
+        rid = "evr-" + uuid.uuid4().hex[:12]
+        now = time.time()
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO eval_runs(id, suite_id, app_id, owner, status, "
+                "doc, created_at, updated_at) VALUES(?,?,?,?,?,?,?,?)",
+                (rid, suite_id, app_id, owner, status, json.dumps(doc),
+                 now, now),
+            )
+            self._conn.commit()
+        return rid
+
+    def update_eval_run(self, rid: str, status: str, doc: dict) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE eval_runs SET status=?, doc=?, updated_at=? "
+                "WHERE id=?",
+                (status, json.dumps(doc), time.time(), rid),
+            )
+            self._conn.commit()
+
+    def get_eval_run(self, rid: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, suite_id, app_id, owner, status, doc, "
+                "created_at, updated_at FROM eval_runs WHERE id=?",
+                (rid,),
+            ).fetchone()
+        return self._run_row(row) if row else None
+
+    def list_eval_runs(self, suite_id: Optional[str] = None) -> list:
+        q = ("SELECT id, suite_id, app_id, owner, status, doc, created_at, "
+             "updated_at FROM eval_runs")
+        args: tuple = ()
+        if suite_id:
+            q += " WHERE suite_id=?"
+            args = (suite_id,)
+        with self._lock:
+            rows = self._conn.execute(
+                q + " ORDER BY created_at", args
+            ).fetchall()
+        return [self._run_row(r) for r in rows]
+
+    def delete_eval_run(self, rid: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM eval_runs WHERE id=?", (rid,)
+            )
+            self._conn.commit()
+            return cur.rowcount > 0
+
+    @staticmethod
+    def _run_row(row) -> dict:
+        doc = json.loads(row[5])
+        doc.update(
+            id=row[0], suite_id=row[1], app_id=row[2], owner=row[3],
+            status=row[4], created_at=row[6], updated_at=row[7],
+        )
+        return doc

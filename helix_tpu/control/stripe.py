@@ -1,0 +1,400 @@
+"""Stripe billing rails: checkout top-ups, subscriptions, signed webhooks.
+
+Reference: ``api/pkg/stripe`` — customer creation (``stripe.go:59``),
+subscription sync (``stripe.go:99``), the webhook dispatcher
+(``stripe.go:137``: customer.subscription.{created,updated,deleted},
+invoice.paid, checkout.session.completed, payment_intent.succeeded) and
+top-up checkout sessions carrying user/org/amount metadata
+(``stripe_topups.go:34,273``).
+
+The ledger/quota logic stays in ``billing.py`` (it is product logic);
+this module is the payment-provider integration: a minimal Stripe REST
+client (mockable base URL — tests run against a fake server), webhook
+signature verification (Stripe's ``t=...,v1=HMAC-SHA256(t.payload)``
+scheme), idempotent event processing, and tier mapping from subscription
+state.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import logging
+import sqlite3
+import threading
+import time
+import urllib.parse
+import urllib.request
+from typing import Optional
+
+log = logging.getLogger("helix.stripe")
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS stripe_customers (
+    owner TEXT PRIMARY KEY,
+    customer_id TEXT NOT NULL,
+    subscription_id TEXT DEFAULT '',
+    subscription_status TEXT DEFAULT '',
+    period_end REAL DEFAULT 0,
+    cancel_at_period_end INTEGER DEFAULT 0,
+    updated_at REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS idx_stripe_customer
+    ON stripe_customers(customer_id);
+CREATE TABLE IF NOT EXISTS stripe_events (
+    event_id TEXT PRIMARY KEY,   -- idempotency: processed webhook events
+    processed_at REAL NOT NULL
+);
+"""
+
+# subscription status -> billing tier (active/trialing pay; else free)
+_TIER_FOR_STATUS = {
+    "active": "pro",
+    "trialing": "pro",
+}
+
+
+class SignatureError(Exception):
+    pass
+
+
+def verify_signature(
+    payload: bytes, header: str, secret: str, tolerance_s: float = 300.0,
+    now: Optional[float] = None,
+) -> None:
+    """Stripe webhook signature scheme: header ``t=<ts>,v1=<hex>`` where
+    ``v1 = HMAC-SHA256(secret, f"{t}.{payload}")``. Raises SignatureError."""
+    parts = dict(
+        kv.split("=", 1) for kv in header.split(",") if "=" in kv
+    )
+    ts = parts.get("t", "")
+    sigs = [v for k, v in parts.items() if k == "v1"]
+    # multiple v1 entries arrive comma-separated with duplicate keys; the
+    # dict above keeps one — also scan manually for robustness
+    sigs = [
+        kv.split("=", 1)[1]
+        for kv in header.split(",")
+        if kv.startswith("v1=")
+    ] or sigs
+    if not ts or not sigs:
+        raise SignatureError("malformed Stripe-Signature header")
+    try:
+        tsf = float(ts)
+    except ValueError:
+        raise SignatureError("bad timestamp") from None
+    if abs((now if now is not None else time.time()) - tsf) > tolerance_s:
+        raise SignatureError("timestamp outside tolerance")
+    want = hmac.new(
+        secret.encode(), f"{ts}.".encode() + payload, hashlib.sha256
+    ).hexdigest()
+    if not any(hmac.compare_digest(want, s) for s in sigs):
+        raise SignatureError("signature mismatch")
+
+
+def sign_payload(payload: bytes, secret: str, ts: Optional[int] = None) -> str:
+    """Produce a valid Stripe-Signature header (tests + local tooling)."""
+    ts = int(time.time()) if ts is None else ts
+    mac = hmac.new(
+        secret.encode(), f"{ts}.".encode() + payload, hashlib.sha256
+    ).hexdigest()
+    return f"t={ts},v1={mac}"
+
+
+class StripeService:
+    def __init__(
+        self,
+        billing,
+        db_path: str = ":memory:",
+        secret_key: str = "",
+        webhook_secret: str = "",
+        price_id_pro: str = "",
+        base_url: str = "https://api.stripe.com",
+        app_url: str = "http://localhost:8080",
+    ):
+        self.billing = billing
+        self.secret_key = secret_key
+        self.webhook_secret = webhook_secret
+        self.price_id_pro = price_id_pro
+        self.base_url = base_url.rstrip("/")
+        self.app_url = app_url.rstrip("/")
+        self._conn = sqlite3.connect(db_path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.executescript(_SCHEMA)
+            self._conn.commit()
+
+    @classmethod
+    def from_env(cls, billing, db_path: str = ":memory:", env=None):
+        import os
+
+        env = env or os.environ
+        return cls(
+            billing,
+            db_path,
+            secret_key=env.get("HELIX_STRIPE_SECRET_KEY", ""),
+            webhook_secret=env.get("HELIX_STRIPE_WEBHOOK_SECRET", ""),
+            price_id_pro=env.get("HELIX_STRIPE_PRICE_ID_PRO", ""),
+            base_url=env.get(
+                "HELIX_STRIPE_API_URL", "https://api.stripe.com"
+            ),
+            app_url=env.get("HELIX_APP_URL", "http://localhost:8080"),
+        )
+
+    def enabled(self) -> bool:
+        return bool(self.secret_key and self.webhook_secret)
+
+    # -- REST client (form-encoded, like Stripe's API) ----------------------
+    def _api(self, method: str, path: str, fields: Optional[dict] = None):
+        body = urllib.parse.urlencode(fields or {}).encode()
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=body if method == "POST" else None,
+            method=method,
+            headers={
+                "Authorization": f"Bearer {self.secret_key}",
+                "Content-Type": "application/x-www-form-urlencoded",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=20) as r:
+            return json.loads(r.read().decode())
+
+    # -- customers ----------------------------------------------------------
+    def customer_for(self, owner: str, email: str = "") -> str:
+        """Get-or-create the Stripe customer for a user."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT customer_id FROM stripe_customers WHERE owner=?",
+                (owner,),
+            ).fetchone()
+        if row:
+            return row[0]
+        doc = self._api(
+            "POST", "/v1/customers",
+            {"email": email or owner, "metadata[user_id]": owner},
+        )
+        cid = doc["id"]
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO stripe_customers(owner, customer_id,"
+                " updated_at) VALUES(?,?,?)",
+                (owner, cid, time.time()),
+            )
+            self._conn.commit()
+        return cid
+
+    def _owner_for_customer(self, customer_id: str) -> Optional[str]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT owner FROM stripe_customers WHERE customer_id=?",
+                (customer_id,),
+            ).fetchone()
+        return row[0] if row else None
+
+    # -- checkout sessions --------------------------------------------------
+    def topup_session_url(
+        self, owner: str, amount_usd: float, email: str = ""
+    ) -> str:
+        """One-time payment checkout for wallet credits
+        (reference: ``GetTopUpSessionURL``, stripe_topups.go:34)."""
+        cents = int(round(amount_usd * 100))
+        if cents < 100:
+            raise ValueError("minimum top-up is $1")
+        cid = self.customer_for(owner, email)
+        doc = self._api(
+            "POST", "/v1/checkout/sessions",
+            {
+                "mode": "payment",
+                "customer": cid,
+                "line_items[0][price_data][currency]": "usd",
+                "line_items[0][price_data][product_data][name]":
+                    "Helix credits",
+                "line_items[0][price_data][unit_amount]": str(cents),
+                "line_items[0][quantity]": "1",
+                "payment_intent_data[metadata][user_id]": owner,
+                "payment_intent_data[metadata][amount_cents]": str(cents),
+                "metadata[user_id]": owner,
+                "metadata[amount_cents]": str(cents),
+                "success_url": f"{self.app_url}/account?topup=success",
+                "cancel_url": f"{self.app_url}/account?topup=cancelled",
+            },
+        )
+        return doc["url"]
+
+    def subscription_session_url(self, owner: str, email: str = "") -> str:
+        """Subscription checkout for the pro tier."""
+        if not self.price_id_pro:
+            raise ValueError("no subscription price configured")
+        cid = self.customer_for(owner, email)
+        doc = self._api(
+            "POST", "/v1/checkout/sessions",
+            {
+                "mode": "subscription",
+                "customer": cid,
+                "line_items[0][price]": self.price_id_pro,
+                "line_items[0][quantity]": "1",
+                "metadata[user_id]": owner,
+                "success_url": f"{self.app_url}/account?sub=success",
+                "cancel_url": f"{self.app_url}/account?sub=cancelled",
+            },
+        )
+        return doc["url"]
+
+    def subscription_state(self, owner: str) -> dict:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT subscription_id, subscription_status, period_end,"
+                " cancel_at_period_end FROM stripe_customers WHERE owner=?",
+                (owner,),
+            ).fetchone()
+        if not row or not row[0]:
+            return {"subscription_id": "", "status": "none"}
+        return {
+            "subscription_id": row[0],
+            "status": row[1],
+            "current_period_end": row[2],
+            "cancel_at_period_end": bool(row[3]),
+        }
+
+    # -- webhook ------------------------------------------------------------
+    def process_webhook(self, payload: bytes, signature_header: str) -> dict:
+        """Verify + dispatch one webhook event. Returns a result doc;
+        raises SignatureError on bad signatures."""
+        verify_signature(payload, signature_header, self.webhook_secret)
+        event = json.loads(payload)
+        event_id = event.get("id", "")
+        if event_id and not self._claim_event(event_id):
+            return {"ok": True, "deduped": True}
+        etype = event.get("type", "")
+        obj = (event.get("data") or {}).get("object") or {}
+        try:
+            if etype in (
+                "customer.subscription.created",
+                "customer.subscription.updated",
+                "customer.subscription.deleted",
+            ):
+                return self._handle_subscription(etype, obj)
+            if etype == "checkout.session.completed":
+                return self._handle_checkout_completed(obj)
+            if etype == "payment_intent.succeeded":
+                return self._handle_payment_intent(obj)
+            if etype == "invoice.paid":
+                return self._handle_invoice_paid(obj)
+            log.info("unhandled stripe event type %s", etype)
+            return {"ok": True, "ignored": etype}
+        except Exception:
+            # processing failed: release the idempotency claim so a
+            # Stripe retry can succeed
+            self._release_event(event_id)
+            raise
+
+    def _claim_event(self, event_id: str) -> bool:
+        with self._lock:
+            try:
+                self._conn.execute(
+                    "INSERT INTO stripe_events(event_id, processed_at) "
+                    "VALUES(?,?)",
+                    (event_id, time.time()),
+                )
+                self._conn.commit()
+                return True
+            except sqlite3.IntegrityError:
+                return False
+
+    def _release_event(self, event_id: str) -> None:
+        if not event_id:
+            return
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM stripe_events WHERE event_id=?", (event_id,)
+            )
+            self._conn.commit()
+
+    def _handle_subscription(self, etype: str, sub: dict) -> dict:
+        customer = sub.get("customer", "")
+        owner = self._owner_for_customer(customer) or (
+            (sub.get("metadata") or {}).get("user_id", "")
+        )
+        if not owner:
+            log.warning("subscription event for unknown customer %s",
+                        customer)
+            return {"ok": True, "unknown_customer": customer}
+        status = (
+            "canceled"
+            if etype.endswith("deleted")
+            else sub.get("status", "")
+        )
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO stripe_customers(owner, customer_id, "
+                "subscription_id, subscription_status, period_end, "
+                "cancel_at_period_end, updated_at) VALUES(?,?,?,?,?,?,?) "
+                "ON CONFLICT(owner) DO UPDATE SET "
+                "customer_id=COALESCE(NULLIF(?, ''), customer_id), "
+                "subscription_id=?, subscription_status=?, period_end=?, "
+                "cancel_at_period_end=?, updated_at=?",
+                (
+                    owner, customer, sub.get("id", ""), status,
+                    float(sub.get("current_period_end") or 0),
+                    1 if sub.get("cancel_at_period_end") else 0, time.time(),
+                    customer, sub.get("id", ""), status,
+                    float(sub.get("current_period_end") or 0),
+                    1 if sub.get("cancel_at_period_end") else 0, time.time(),
+                ),
+            )
+            self._conn.commit()
+        self.billing.set_tier(owner, _TIER_FOR_STATUS.get(status, "free"))
+        return {"ok": True, "owner": owner, "tier_status": status}
+
+    def _topup_from_metadata(self, meta: dict, fallback_customer: str) -> dict:
+        owner = meta.get("user_id") or self._owner_for_customer(
+            fallback_customer
+        )
+        cents = int(meta.get("amount_cents") or 0)
+        if not owner or cents <= 0:
+            return {"ok": True, "skipped": "no user/amount metadata"}
+        wallet = self.billing.topup(owner, cents / 100.0)
+        return {"ok": True, "owner": owner, "balance": wallet["balance_usd"]}
+
+    def _claimed_topup(self, pi: str, meta: dict, customer: str) -> dict:
+        """Credit once per payment intent; release the intent claim if the
+        credit fails so a Stripe redelivery can retry."""
+        if pi and not self._claim_event(f"pi:{pi}"):
+            return {"ok": True, "deduped": "payment_intent"}
+        try:
+            return self._topup_from_metadata(meta, customer)
+        except Exception:
+            self._release_event(f"pi:{pi}")
+            raise
+
+    def _handle_checkout_completed(self, session: dict) -> dict:
+        """Top-up via checkout (reference stripe_topups.go:145). Payment
+        mode only; subscriptions arrive via their own events. Dedupe with
+        payment_intent.succeeded on the payment-intent id."""
+        if session.get("mode") != "payment":
+            return {"ok": True, "ignored": "non-payment checkout"}
+        return self._claimed_topup(
+            session.get("payment_intent") or "",
+            session.get("metadata") or {},
+            session.get("customer", ""),
+        )
+
+    def _handle_payment_intent(self, intent: dict) -> dict:
+        """Direct payment-intent top-up (reference stripe_topups.go:90)."""
+        return self._claimed_topup(
+            intent.get("id") or "",
+            intent.get("metadata") or {},
+            intent.get("customer", ""),
+        )
+
+    def _handle_invoice_paid(self, invoice: dict) -> dict:
+        """Subscription renewal: keep the tier fresh (reference
+        stripe_invoices.go). Credits come from top-ups; invoices only
+        confirm the subscription is alive."""
+        owner = self._owner_for_customer(invoice.get("customer", ""))
+        if owner is None:
+            return {"ok": True, "unknown_customer": True}
+        state = self.subscription_state(owner)
+        if state["status"] in _TIER_FOR_STATUS:
+            self.billing.set_tier(owner, _TIER_FOR_STATUS[state["status"]])
+        return {"ok": True, "owner": owner}

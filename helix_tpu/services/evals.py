@@ -1,0 +1,312 @@
+"""Agent evaluation suites: scripted questions + assertions against an app.
+
+Reference surface: ``api/pkg/types/evaluation.go`` (EvaluationSuite /
+EvaluationRun / assertion types contains | not_contains | regex |
+llm_judge | skill_used), persisted entities at
+``api/pkg/store/postgres.go:245-246``, routes at
+``api/pkg/server/server.go:1058-1067`` (suite CRUD under an app, run
+start/list/get/delete + an SSE progress stream), and the ``evals`` CLI
+verb (``api/cmd/helix/evals.go``).
+
+Design: a run executes every suite question through the session
+controller (the same ``ChatCompletion`` path users hit, so agent-mode
+apps exercise their real skill loop), applies the question's assertions
+to the response, and persists per-question results + an aggregate
+summary.  Progress events stream over the in-process event bus so the
+HTTP layer can serve them as SSE.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import re
+import time
+from typing import Optional
+
+log = logging.getLogger("helix.evals")
+
+ASSERTION_TYPES = (
+    "contains", "not_contains", "regex", "llm_judge", "skill_used",
+)
+
+_JUDGE_PROMPT = (
+    "You are grading an AI assistant's answer.\n"
+    "Question: {question}\n"
+    "Answer: {answer}\n"
+    "Grading instruction: {instruction}\n"
+    "Reply with exactly PASS or FAIL on the first line, then one short "
+    "sentence of reasoning."
+)
+
+
+@dataclasses.dataclass
+class Assertion:
+    type: str
+    value: str = ""
+    llm_judge_prompt: str = ""
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "Assertion":
+        t = doc.get("type", "contains")
+        if t not in ASSERTION_TYPES:
+            raise ValueError(f"unknown assertion type {t!r}")
+        return cls(
+            type=t,
+            value=doc.get("value", ""),
+            llm_judge_prompt=doc.get("llm_judge_prompt", ""),
+        )
+
+
+def validate_suite_doc(doc: dict) -> dict:
+    """Normalise + validate a suite document; raises ValueError."""
+    questions = doc.get("questions") or []
+    if not isinstance(questions, list):
+        raise ValueError("questions must be a list")
+    out_q = []
+    for i, q in enumerate(questions):
+        if not q.get("question"):
+            raise ValueError(f"question #{i} has no text")
+        asserts = [
+            dataclasses.asdict(Assertion.from_doc(a))
+            for a in (q.get("assertions") or [])
+        ]
+        out_q.append(
+            {
+                "id": q.get("id") or f"q{i + 1}",
+                "question": q["question"],
+                "assertions": asserts,
+            }
+        )
+    return {
+        "name": doc.get("name", ""),
+        "description": doc.get("description", ""),
+        # judge model/provider for llm_judge assertions; empty = first
+        # available model (self-hosted deployments have no external judge)
+        "judge_model": doc.get("judge_model", ""),
+        "judge_provider": doc.get("judge_provider", ""),
+        "questions": out_q,
+    }
+
+
+class EvalService:
+    """Runs evaluation suites through the controller; persists results."""
+
+    def __init__(self, store, controller, events=None):
+        self.store = store
+        self.controller = controller
+        self.events = events          # EventBus (optional)
+        self._tasks: dict[str, asyncio.Task] = {}
+        # crash recovery: run tasks are in-memory only, so rows left in a
+        # non-terminal state by a previous process can never finish —
+        # fail them at boot (reference boot-time reset of running
+        # executions, serve.go:270-278)
+        for run in self.store.list_eval_runs():
+            if run.get("status") in ("pending", "running"):
+                doc = {
+                    "summary": run.get("summary", {}),
+                    "results": run.get("results", []),
+                    "error": "interrupted by control-plane restart",
+                }
+                self.store.update_eval_run(run["id"], "failed", doc)
+
+    # -- suite CRUD (thin wrappers; validation lives here) -----------------
+    def create_suite(self, app_id: str, owner: str, doc: dict) -> dict:
+        sid = self.store.create_eval_suite(
+            app_id, owner, validate_suite_doc(doc)
+        )
+        return self.store.get_eval_suite(sid)
+
+    def update_suite(self, sid: str, doc: dict) -> Optional[dict]:
+        if not self.store.update_eval_suite(sid, validate_suite_doc(doc)):
+            return None
+        return self.store.get_eval_suite(sid)
+
+    # -- runs --------------------------------------------------------------
+    def start_run(self, suite_id: str, owner: str) -> Optional[dict]:
+        """Create a pending run and launch it on the current event loop."""
+        suite = self.store.get_eval_suite(suite_id)
+        if suite is None:
+            return None
+        rid = self.store.create_eval_run(
+            suite_id, suite.get("app_id", ""), owner,
+            {"summary": {}, "results": []},
+        )
+        self._tasks[rid] = asyncio.get_event_loop().create_task(
+            self._run(rid, suite, owner)
+        )
+        return self.store.get_eval_run(rid)
+
+    def cancel_run(self, rid: str) -> bool:
+        task = self._tasks.get(rid)
+        if task is None or task.done():
+            return False
+        task.cancel()
+        return True
+
+    async def _run(self, rid: str, suite: dict, owner: str) -> None:
+        results = []
+        summary = {
+            "total_questions": len(suite["questions"]),
+            "passed": 0, "failed": 0, "total_duration_ms": 0,
+            "total_tokens": 0, "skills_used": [],
+        }
+        doc = {"summary": summary, "results": results}
+        self.store.update_eval_run(rid, "running", doc)
+        self._progress(rid, "running", 0, summary)
+        try:
+            for i, q in enumerate(suite["questions"]):
+                result = await self._run_question(suite, q, owner)
+                results.append(result)
+                summary["passed" if result["passed"] else "failed"] += 1
+                summary["total_duration_ms"] += result["duration_ms"]
+                summary["total_tokens"] += result.get("tokens_used", 0)
+                for s in result.get("skills_used", []):
+                    if s not in summary["skills_used"]:
+                        summary["skills_used"].append(s)
+                self.store.update_eval_run(rid, "running", doc)
+                self._progress(rid, "running", i + 1, summary, result)
+            self.store.update_eval_run(rid, "completed", doc)
+            self._progress(
+                rid, "completed", len(suite["questions"]), summary
+            )
+        except asyncio.CancelledError:
+            doc["error"] = "cancelled"
+            self.store.update_eval_run(rid, "cancelled", doc)
+            self._progress(rid, "cancelled", len(results), summary)
+        except Exception as e:  # noqa: BLE001 — run must land in a state
+            log.exception("eval run %s failed", rid)
+            doc["error"] = str(e)
+            self.store.update_eval_run(rid, "failed", doc)
+            self._progress(rid, "failed", len(results), summary)
+        finally:
+            self._tasks.pop(rid, None)
+
+    async def _run_question(self, suite: dict, q: dict, owner: str) -> dict:
+        t0 = time.monotonic()
+        result = {
+            "question_id": q["id"],
+            "question": q["question"],
+            "response": "",
+            "duration_ms": 0,
+            "tokens_used": 0,
+            "skills_used": [],
+            "assertion_results": [],
+            "passed": False,
+            "error": "",
+        }
+        try:
+            resp = await self.controller.chat(
+                [{"role": "user", "content": q["question"]}],
+                user=owner,
+                app_id=suite.get("app_id") or None,
+            )
+            answer = (
+                resp.get("choices", [{}])[0]
+                .get("message", {})
+                .get("content", "")
+            )
+            result["response"] = answer
+            usage = resp.get("usage") or {}
+            result["tokens_used"] = int(usage.get("total_tokens", 0))
+            result["skills_used"] = sorted(
+                {
+                    s.get("name", "")
+                    for s in resp.get("steps", [])
+                    if s.get("kind") == "tool" and s.get("name")
+                }
+            )
+            checks = [
+                await self._check(suite, a, q["question"], answer, result)
+                for a in (
+                    Assertion.from_doc(d) for d in q["assertions"]
+                )
+            ]
+            result["assertion_results"] = checks
+            result["passed"] = all(c["passed"] for c in checks)
+        except Exception as e:  # noqa: BLE001 — one bad question != run
+            result["error"] = str(e)
+        result["duration_ms"] = int((time.monotonic() - t0) * 1000)
+        return result
+
+    async def _check(
+        self, suite: dict, a: Assertion, question: str, answer: str,
+        result: dict,
+    ) -> dict:
+        out = {
+            "assertion_type": a.type,
+            "assertion_value": a.value,
+            "passed": False,
+            "details": "",
+        }
+        if a.type == "contains":
+            out["passed"] = a.value.lower() in answer.lower()
+        elif a.type == "not_contains":
+            out["passed"] = a.value.lower() not in answer.lower()
+        elif a.type == "regex":
+            try:
+                out["passed"] = re.search(a.value, answer) is not None
+            except re.error as e:
+                out["details"] = f"bad regex: {e}"
+        elif a.type == "skill_used":
+            out["passed"] = a.value in result["skills_used"]
+        elif a.type == "llm_judge":
+            out.update(await self._judge(suite, a, question, answer))
+        return out
+
+    async def _judge(
+        self, suite: dict, a: Assertion, question: str, answer: str
+    ) -> dict:
+        """LLM-judge assertion: ask a model to grade PASS/FAIL.
+
+        The judge model comes from the suite (``judge_model`` /
+        ``judge_provider``); unset, it falls back to the first model the
+        router actually serves — a bare resolve("") in a helix-only
+        deployment would 404 on the empty model name."""
+        prompt = (a.llm_judge_prompt or _JUDGE_PROMPT).format(
+            question=question, answer=answer,
+            instruction=a.value or "Is the answer correct and helpful?",
+        )
+        model = suite.get("judge_model", "")
+        provider = suite.get("judge_provider") or None
+        if not model and not provider:
+            router = getattr(self.controller.providers, "_router", None)
+            served = router.available_models() if router else []
+            if served:
+                model = served[0]
+        client, model = self.controller.providers.resolve(model, provider)
+        resp = await client.chat(
+            {
+                "model": model,
+                "messages": [{"role": "user", "content": prompt}],
+                "temperature": 0.0,
+            }
+        )
+        verdict = (
+            resp.get("choices", [{}])[0]
+            .get("message", {})
+            .get("content", "")
+        )
+        first = verdict.strip().splitlines()[0].strip().upper() if verdict else ""
+        return {"passed": first.startswith("PASS"), "details": verdict[:500]}
+
+    def _progress(
+        self, rid: str, status: str, current: int, summary: dict,
+        latest: Optional[dict] = None,
+    ) -> None:
+        if self.events is None:
+            return
+        evt = {
+            "run_id": rid,
+            "status": status,
+            "current_question": current,
+            "total_questions": summary.get("total_questions", 0),
+            "summary": summary,
+        }
+        if latest is not None:
+            evt["latest_result"] = latest
+        try:
+            self.events.publish(f"evals.{rid}", evt)
+        except Exception:  # noqa: BLE001 — progress is best-effort
+            log.debug("eval progress publish failed", exc_info=True)

@@ -1,0 +1,336 @@
+"""Evaluation suites + runs: assertions, run lifecycle, HTTP surface.
+
+Reference: EvaluationSuite/EvaluationRun entities + assertion semantics
+(``api/pkg/types/evaluation.go``), suite/run routes under an app
+(``api/pkg/server/server.go:1058-1067``), and the ``evals`` CLI verb.
+"""
+
+import asyncio
+import threading
+
+import pytest
+import requests
+
+from helix_tpu.control.server import ControlPlane
+from helix_tpu.services.evals import (
+    Assertion,
+    EvalService,
+    validate_suite_doc,
+)
+
+
+class TestSuiteValidation:
+    def test_normalises_questions_and_ids(self):
+        doc = validate_suite_doc(
+            {
+                "name": "s",
+                "questions": [
+                    {"question": "What is 2+2?",
+                     "assertions": [{"type": "contains", "value": "4"}]},
+                    {"id": "custom", "question": "ping?"},
+                ],
+            }
+        )
+        assert doc["questions"][0]["id"] == "q1"
+        assert doc["questions"][1]["id"] == "custom"
+
+    def test_rejects_bad_assertion_type(self):
+        with pytest.raises(ValueError):
+            validate_suite_doc(
+                {"questions": [{"question": "x",
+                                "assertions": [{"type": "nope"}]}]}
+            )
+
+    def test_rejects_empty_question(self):
+        with pytest.raises(ValueError):
+            validate_suite_doc({"questions": [{"question": ""}]})
+
+
+class _JudgeProvider:
+    """Fake provider: answers questions AND grades judge prompts."""
+
+    def __init__(self, answer="the answer is 4"):
+        self.answer = answer
+        self.calls = []
+
+    async def chat(self, body):
+        self.calls.append(body)
+        text = body["messages"][-1]["content"]
+        if "grading an AI assistant" in text:
+            import re as _re
+
+            m = _re.search(r"Answer: (.*)", text)
+            graded = m.group(1) if m else ""
+            content = (
+                "PASS\nlooks right" if "4" in graded else "FAIL\nwrong"
+            )
+        else:
+            content = self.answer
+        return {
+            "choices": [
+                {"message": {"role": "assistant", "content": content},
+                 "finish_reason": "stop"}
+            ],
+            "usage": {"prompt_tokens": 5, "completion_tokens": 5,
+                      "total_tokens": 10},
+        }
+
+
+def _service(answer="the answer is 4"):
+    from helix_tpu.control.controller import SessionController
+    from helix_tpu.control.providers import ProviderManager
+    from helix_tpu.control.pubsub import EventBus
+    from helix_tpu.control.store import Store
+
+    store = Store()
+    app_id = store.upsert_app(
+        "demo", "u1",
+        {"spec": {"assistants": [{"name": "main", "model": "m"}]}},
+    )
+    pm = ProviderManager()
+    fake = _JudgeProvider(answer)
+    pm._providers["fake"] = fake
+    ctl = SessionController(store, pm, None)
+    bus = EventBus()
+    return EvalService(store, ctl, bus), store, bus, fake, app_id
+
+
+SUITE = {
+    "name": "math",
+    "questions": [
+        {
+            "question": "What is 2+2?",
+            "assertions": [
+                {"type": "contains", "value": "4"},
+                {"type": "not_contains", "value": "banana"},
+                {"type": "regex", "value": r"\b4\b"},
+            ],
+        },
+        {
+            "question": "What is 2+2, judged?",
+            "assertions": [{"type": "llm_judge",
+                            "value": "Answer must contain 4"}],
+        },
+    ],
+}
+
+
+class TestEvalRun:
+    def test_run_passes_and_aggregates(self):
+        svc, store, bus, fake, app_id = _service()
+        suite = svc.create_suite(app_id, "u1", SUITE)
+        events = []
+        bus.subscribe("evals.*", lambda t, m: events.append(m))
+
+        async def go():
+            run = svc.start_run(suite["id"], "u1")
+            await svc._tasks[run["id"]]
+            return run["id"]
+
+        rid = asyncio.new_event_loop().run_until_complete(go())
+        run = store.get_eval_run(rid)
+        assert run["status"] == "completed"
+        assert run["summary"]["passed"] == 2
+        assert run["summary"]["failed"] == 0
+        assert run["summary"]["total_tokens"] > 0
+        # every assertion recorded with its own verdict
+        first = run["results"][0]["assertion_results"]
+        assert [a["passed"] for a in first] == [True, True, True]
+        judge = run["results"][1]["assertion_results"][0]
+        assert judge["passed"] and "PASS" in judge["details"]
+        # progress streamed: running -> per-question -> completed
+        assert events[0]["status"] == "running"
+        assert events[-1]["status"] == "completed"
+
+    def test_failed_assertions_fail_the_question(self):
+        svc, store, bus, fake, app_id = _service(answer="i do not know")
+        suite = svc.create_suite(app_id, "u1", SUITE)
+
+        async def go():
+            run = svc.start_run(suite["id"], "u1")
+            await svc._tasks[run["id"]]
+            return run["id"]
+
+        rid = asyncio.new_event_loop().run_until_complete(go())
+        run = store.get_eval_run(rid)
+        assert run["status"] == "completed"
+        assert run["summary"]["failed"] == 2
+
+    def test_skill_used_assertion(self):
+        svc, store, bus, fake, app_id = _service()
+
+        async def fake_chat(messages, **kw):
+            return {
+                "choices": [{"message": {"content": "done"}}],
+                "usage": {},
+                "steps": [
+                    {"step": 1, "kind": "tool", "name": "calculator"},
+                    {"step": 2, "kind": "answer", "name": ""},
+                ],
+            }
+
+        svc.controller = type("C", (), {"chat": staticmethod(fake_chat)})()
+        suite = svc.create_suite(
+            "app1", "u1",
+            {
+                "questions": [
+                    {"question": "use the calculator",
+                     "assertions": [{"type": "skill_used",
+                                     "value": "calculator"}]}
+                ]
+            },
+        )
+
+        async def go():
+            run = svc.start_run(suite["id"], "u1")
+            await svc._tasks[run["id"]]
+            return run["id"]
+
+        rid = asyncio.new_event_loop().run_until_complete(go())
+        run = store.get_eval_run(rid)
+        assert run["results"][0]["passed"]
+        assert run["summary"]["skills_used"] == ["calculator"]
+
+    def test_restart_fails_stranded_runs(self):
+        """Runs left non-terminal by a dead process are failed at boot
+        (in-memory tasks cannot survive a restart)."""
+        svc, store, bus, fake, app_id = _service()
+        suite = svc.create_suite(app_id, "u1", SUITE)
+        rid = store.create_eval_run(
+            suite["id"], app_id, "u1", {"summary": {}, "results": []},
+            status="running",
+        )
+        svc2 = EvalService(store, svc.controller, bus)  # "restart"
+        run = store.get_eval_run(rid)
+        assert run["status"] == "failed"
+        assert "restart" in run["error"]
+        assert svc2._tasks == {}
+
+    def test_question_error_is_captured_not_fatal(self):
+        svc, store, bus, fake, app_id = _service()
+
+        async def boom(messages, **kw):
+            raise RuntimeError("provider down")
+
+        svc.controller = type("C", (), {"chat": staticmethod(boom)})()
+        suite = svc.create_suite(
+            "app1", "u1", {"questions": [{"question": "x"}]}
+        )
+
+        async def go():
+            run = svc.start_run(suite["id"], "u1")
+            await svc._tasks[run["id"]]
+            return run["id"]
+
+        rid = asyncio.new_event_loop().run_until_complete(go())
+        run = store.get_eval_run(rid)
+        assert run["status"] == "completed"
+        assert "provider down" in run["results"][0]["error"]
+        assert not run["results"][0]["passed"]
+
+
+@pytest.fixture(scope="module")
+def eval_url():
+    cp = ControlPlane()
+    fake = _JudgeProvider()
+    # drop env-registered real providers (the sandbox exports a live
+    # ANTHROPIC_API_KEY): eval questions must resolve to the fake
+    for name in list(cp.providers._providers):
+        if name != "helix":
+            del cp.providers._providers[name]
+    cp.providers._providers["fake"] = fake
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(cp.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 18425)
+        loop.run_until_complete(site.start())
+        holder["loop"] = loop
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    yield "http://127.0.0.1:18425"
+    cp.orchestrator.stop()
+    cp.knowledge.stop()
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+class TestEvalHTTP:
+    def test_suite_crud_run_and_stream(self, eval_url):
+        import time as _time
+
+        app_id = requests.post(
+            f"{eval_url}/api/v1/apps",
+            json={"name": "demo",
+                  "spec": {"assistants": [{"name": "main", "model": "m"}]}},
+            timeout=5,
+        ).json()["id"]
+        base = f"{eval_url}/api/v1/apps/{app_id}"
+        # create
+        r = requests.post(
+            f"{base}/evaluation-suites", json=SUITE, timeout=5
+        )
+        assert r.status_code == 200, r.text
+        sid = r.json()["id"]
+        # list + get + update
+        assert any(
+            s["id"] == sid
+            for s in requests.get(
+                f"{base}/evaluation-suites", timeout=5
+            ).json()["suites"]
+        )
+        r = requests.put(
+            f"{base}/evaluation-suites/{sid}",
+            json={**SUITE, "name": "math2"}, timeout=5,
+        )
+        assert r.json()["name"] == "math2"
+        # bad suite rejected
+        assert requests.post(
+            f"{base}/evaluation-suites",
+            json={"questions": [{"question": ""}]}, timeout=5,
+        ).status_code == 400
+        # start a run, poll to completion
+        r = requests.post(
+            f"{base}/evaluation-suites/{sid}/runs", timeout=5
+        )
+        assert r.status_code == 201, r.text
+        rid = r.json()["id"]
+        for _ in range(100):
+            run = requests.get(
+                f"{base}/evaluation-runs/{rid}", timeout=5
+            ).json()
+            if run["status"] in ("completed", "failed"):
+                break
+            _time.sleep(0.1)
+        assert run["status"] == "completed"
+        assert run["summary"]["passed"] == 2
+        # SSE stream replays terminal state for finished runs
+        with requests.get(
+            f"{base}/evaluation-runs/{rid}/stream", stream=True, timeout=5
+        ) as sr:
+            line = next(
+                ln for ln in sr.iter_lines() if ln.startswith(b"data:")
+            )
+            assert b"completed" in line
+        # runs listed under the suite
+        assert any(
+            x["id"] == rid
+            for x in requests.get(
+                f"{base}/evaluation-suites/{sid}/runs", timeout=5
+            ).json()["runs"]
+        )
+        # delete cascades
+        assert requests.delete(
+            f"{base}/evaluation-suites/{sid}", timeout=5
+        ).json()["ok"]
+        assert requests.get(
+            f"{base}/evaluation-runs/{rid}", timeout=5
+        ).status_code == 404

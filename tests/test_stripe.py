@@ -1,0 +1,268 @@
+"""Stripe billing rails: signed webhooks, idempotency, tier lifecycle,
+checkout sessions against a fake Stripe API.
+
+Reference: ``api/pkg/stripe`` (webhook dispatcher stripe.go:137, top-up
+checkout metadata stripe_topups.go:34,273, subscription sync
+stripe.go:99).
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+from urllib.parse import parse_qs
+
+import pytest
+
+from helix_tpu.control.billing import BillingService
+from helix_tpu.control.stripe import (
+    SignatureError,
+    StripeService,
+    sign_payload,
+    verify_signature,
+)
+
+SECRET = "whsec_test"
+
+
+def _svc(**kw):
+    billing = BillingService()
+    svc = StripeService(
+        billing, secret_key="sk_test", webhook_secret=SECRET, **kw
+    )
+    return svc, billing
+
+
+def _event(etype, obj, eid="evt_1"):
+    return json.dumps(
+        {"id": eid, "type": etype, "data": {"object": obj}}
+    ).encode()
+
+
+class TestSignature:
+    def test_roundtrip(self):
+        payload = b'{"id":"evt"}'
+        verify_signature(payload, sign_payload(payload, SECRET), SECRET)
+
+    def test_tampered_payload_rejected(self):
+        header = sign_payload(b"good", SECRET)
+        with pytest.raises(SignatureError):
+            verify_signature(b"evil", header, SECRET)
+
+    def test_wrong_secret_rejected(self):
+        payload = b"x"
+        with pytest.raises(SignatureError):
+            verify_signature(
+                payload, sign_payload(payload, "other"), SECRET
+            )
+
+    def test_stale_timestamp_rejected(self):
+        payload = b"x"
+        header = sign_payload(payload, SECRET, ts=int(time.time()) - 3600)
+        with pytest.raises(SignatureError):
+            verify_signature(payload, header, SECRET)
+
+    def test_malformed_header_rejected(self):
+        with pytest.raises(SignatureError):
+            verify_signature(b"x", "garbage", SECRET)
+
+
+class TestWebhooks:
+    def test_topup_via_checkout_completed(self):
+        svc, billing = _svc()
+        payload = _event(
+            "checkout.session.completed",
+            {
+                "mode": "payment",
+                "payment_intent": "pi_1",
+                "customer": "cus_1",
+                "metadata": {"user_id": "u1", "amount_cents": "2500"},
+            },
+        )
+        out = svc.process_webhook(payload, sign_payload(payload, SECRET))
+        assert out["ok"]
+        assert billing.wallet("u1")["balance_usd"] == 25.0
+
+    def test_payment_intent_deduped_against_checkout(self):
+        """checkout.session.completed and payment_intent.succeeded for the
+        same payment must credit ONCE (reference dedupes on intent id)."""
+        svc, billing = _svc()
+        p1 = _event(
+            "checkout.session.completed",
+            {"mode": "payment", "payment_intent": "pi_9",
+             "metadata": {"user_id": "u1", "amount_cents": "1000"}},
+            eid="evt_a",
+        )
+        p2 = _event(
+            "payment_intent.succeeded",
+            {"id": "pi_9",
+             "metadata": {"user_id": "u1", "amount_cents": "1000"}},
+            eid="evt_b",
+        )
+        svc.process_webhook(p1, sign_payload(p1, SECRET))
+        out = svc.process_webhook(p2, sign_payload(p2, SECRET))
+        assert out.get("deduped")
+        assert billing.wallet("u1")["balance_usd"] == 10.0
+
+    def test_duplicate_event_id_deduped(self):
+        svc, billing = _svc()
+        payload = _event(
+            "payment_intent.succeeded",
+            {"id": "pi_2",
+             "metadata": {"user_id": "u2", "amount_cents": "500"}},
+            eid="evt_dup",
+        )
+        svc.process_webhook(payload, sign_payload(payload, SECRET))
+        out = svc.process_webhook(payload, sign_payload(payload, SECRET))
+        assert out.get("deduped")
+        assert billing.wallet("u2")["balance_usd"] == 5.0
+
+    def test_subscription_lifecycle_drives_tier(self):
+        svc, billing = _svc()
+        created = _event(
+            "customer.subscription.created",
+            {"id": "sub_1", "customer": "cus_9", "status": "active",
+             "current_period_end": 2_000_000_000,
+             "metadata": {"user_id": "u3"}},
+            eid="evt_c1",
+        )
+        svc.process_webhook(created, sign_payload(created, SECRET))
+        assert billing.wallet("u3")["tier"] == "pro"
+        state = svc.subscription_state("u3")
+        assert state["status"] == "active"
+        assert state["subscription_id"] == "sub_1"
+        deleted = _event(
+            "customer.subscription.deleted",
+            {"id": "sub_1", "customer": "cus_9"},
+            eid="evt_c2",
+        )
+        svc.process_webhook(deleted, sign_payload(deleted, SECRET))
+        assert billing.wallet("u3")["tier"] == "free"
+        assert svc.subscription_state("u3")["status"] == "canceled"
+
+    def test_metadata_customer_binding_survives_for_invoices(self):
+        """A subscription resolved via metadata user_id must still bind
+        the customer id, so later invoice.paid events find the owner."""
+        svc, billing = _svc()
+        created = _event(
+            "customer.subscription.created",
+            {"id": "sub_2", "customer": "cus_meta", "status": "active",
+             "metadata": {"user_id": "u9"}},
+            eid="evt_m1",
+        )
+        svc.process_webhook(created, sign_payload(created, SECRET))
+        billing.set_tier("u9", "free")   # drift; invoice should restore
+        inv = _event(
+            "invoice.paid", {"customer": "cus_meta"}, eid="evt_m2"
+        )
+        out = svc.process_webhook(inv, sign_payload(inv, SECRET))
+        assert out.get("owner") == "u9"
+        assert billing.wallet("u9")["tier"] == "pro"
+
+    def test_bad_signature_never_processes(self):
+        svc, billing = _svc()
+        payload = _event(
+            "payment_intent.succeeded",
+            {"id": "pi_3",
+             "metadata": {"user_id": "u4", "amount_cents": "900"}},
+        )
+        with pytest.raises(SignatureError):
+            svc.process_webhook(payload, "t=1,v1=bad")
+        assert billing.wallet("u4")["balance_usd"] == 0.0
+
+    def test_failed_processing_releases_idempotency_claim(self):
+        """A Stripe retry after a transient failure must succeed."""
+        svc, billing = _svc()
+        real_topup = billing.topup
+        calls = {"n": 0}
+
+        def flaky(owner, usd):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("db briefly down")
+            return real_topup(owner, usd)
+
+        billing.topup = flaky
+        payload = _event(
+            "payment_intent.succeeded",
+            {"id": "pi_5",
+             "metadata": {"user_id": "u5", "amount_cents": "700"}},
+            eid="evt_retry",
+        )
+        with pytest.raises(RuntimeError):
+            svc.process_webhook(payload, sign_payload(payload, SECRET))
+        out = svc.process_webhook(payload, sign_payload(payload, SECRET))
+        assert out["ok"] and not out.get("deduped")
+        assert billing.wallet("u5")["balance_usd"] == 7.0
+
+
+class _FakeStripeAPI(BaseHTTPRequestHandler):
+    requests: list = []
+
+    def do_POST(self):
+        body = self.rfile.read(int(self.headers["Content-Length"]))
+        fields = {k: v[0] for k, v in parse_qs(body.decode()).items()}
+        _FakeStripeAPI.requests.append((self.path, fields, dict(self.headers)))
+        if self.path == "/v1/customers":
+            doc = {"id": "cus_fake1"}
+        elif self.path == "/v1/checkout/sessions":
+            doc = {"id": "cs_1", "url": "https://checkout.stripe.test/cs_1"}
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        out = json.dumps(doc).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture(scope="module")
+def fake_stripe():
+    srv = HTTPServer(("127.0.0.1", 18431), _FakeStripeAPI)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield "http://127.0.0.1:18431"
+    srv.shutdown()
+
+
+class TestCheckoutSessions:
+    def test_topup_session_carries_metadata(self, fake_stripe):
+        svc, billing = _svc(base_url=fake_stripe)
+        url = svc.topup_session_url("u1", 12.5, email="u1@x.test")
+        assert url.startswith("https://checkout.stripe.test/")
+        path, fields, headers = _FakeStripeAPI.requests[-1]
+        assert path == "/v1/checkout/sessions"
+        assert fields["mode"] == "payment"
+        assert fields["metadata[user_id]"] == "u1"
+        assert fields["metadata[amount_cents]"] == "1250"
+        assert (
+            fields["payment_intent_data[metadata][amount_cents]"] == "1250"
+        )
+        assert headers["Authorization"] == "Bearer sk_test"
+        # customer created once, reused after
+        svc.topup_session_url("u1", 3.0)
+        customer_calls = [
+            p for p, _, _ in _FakeStripeAPI.requests if p == "/v1/customers"
+        ]
+        assert len(customer_calls) == 1
+
+    def test_minimum_topup_enforced(self, fake_stripe):
+        svc, _ = _svc(base_url=fake_stripe)
+        with pytest.raises(ValueError):
+            svc.topup_session_url("u1", 0.5)
+
+    def test_subscription_session_requires_price(self, fake_stripe):
+        svc, _ = _svc(base_url=fake_stripe)
+        with pytest.raises(ValueError):
+            svc.subscription_session_url("u1")
+        svc.price_id_pro = "price_pro"
+        url = svc.subscription_session_url("u1")
+        assert url
+        _, fields, _ = _FakeStripeAPI.requests[-1]
+        assert fields["mode"] == "subscription"
+        assert fields["line_items[0][price]"] == "price_pro"
