@@ -1,0 +1,396 @@
+"""Anthropic /v1/messages gateway: direct, Vertex AI and AWS Bedrock
+transports, thinking-schema retry, and Claude-subscription probing.
+
+Reference: ``api/pkg/anthropic`` —
+- reverse proxy for the native messages API (``anthropic_proxy.go:32``),
+- Vertex AI transport: region base URLs, ``vertex-2023-10-16`` version
+  injection, model moved from body to URL, OAuth2 cloud-platform scope
+  (``vertex.go``),
+- thinking.type retry: Vertex's LB fronts pods that disagree on
+  ``adaptive`` vs ``enabled`` — flip and retry on matching 400s
+  (``thinking_retry.go``),
+- subscription probe: classify a Claude OAuth token by a 1-token probe
+  call — 401 invalid, 200/429 valid, else inconclusive
+  (``subscription_probe.go``).
+
+Bedrock follows the same adapter pattern with SigV4 request signing
+(stdlib hmac/hashlib — no boto dependency) and Bedrock's
+``bedrock-2023-05-31`` anthropic_version.
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import hmac
+import json
+import logging
+import urllib.parse
+from typing import Optional
+
+import aiohttp
+
+log = logging.getLogger("helix.anthropic")
+
+VERTEX_ANTHROPIC_VERSION = "vertex-2023-10-16"
+BEDROCK_ANTHROPIC_VERSION = "bedrock-2023-05-31"
+OAUTH_BETA_HEADER = "oauth-2025-04-20"
+MAX_THINKING_RETRIES = 5
+
+
+def vertex_base_url(region: str) -> str:
+    if region == "global":
+        return "https://aiplatform.googleapis.com"
+    return f"https://{region}-aiplatform.googleapis.com"
+
+
+class DirectTransport:
+    """api.anthropic.com with an API key or a subscription OAuth token."""
+
+    def __init__(self, api_key: str = "", oauth_token: str = "",
+                 base_url: str = "https://api.anthropic.com"):
+        self.api_key = api_key
+        self.oauth_token = oauth_token
+        self.base_url = base_url.rstrip("/")
+
+    def prepare(self, body: dict, stream: bool):
+        headers = {
+            "Content-Type": "application/json",
+            "anthropic-version": "2023-06-01",
+        }
+        if self.oauth_token:
+            headers["Authorization"] = f"Bearer {self.oauth_token}"
+            headers["anthropic-beta"] = OAUTH_BETA_HEADER
+        else:
+            headers["x-api-key"] = self.api_key
+        out = dict(body)
+        out["stream"] = bool(stream)
+        return f"{self.base_url}/v1/messages", headers, json.dumps(out)
+
+
+class VertexTransport:
+    """Vertex AI publisher endpoint (reference: ``vertex.go``).
+
+    The model moves from the body into the URL; ``anthropic_version`` is
+    injected; auth is a cloud-platform-scoped OAuth2 token.  Token
+    acquisition is injectable so tests (and non-GCP environments) run
+    without ADC; the default uses google.auth application-default
+    credentials with automatic refresh.
+    """
+
+    def __init__(
+        self, project: str, region: str = "us-east5",
+        credentials_json: str = "", base_url: str = "",
+        token_fn=None,
+    ):
+        self.project = project
+        self.region = region
+        self.base_url = (base_url or vertex_base_url(region)).rstrip("/")
+        self.credentials_json = credentials_json
+        self._token_fn = token_fn
+        self._creds = None
+
+    def _token(self) -> str:
+        if self._token_fn is not None:
+            return self._token_fn()
+        import google.auth
+        import google.auth.transport.requests
+
+        scope = ["https://www.googleapis.com/auth/cloud-platform"]
+        if self._creds is None:
+            if self.credentials_json:
+                from google.oauth2 import service_account
+
+                self._creds = (
+                    service_account.Credentials.from_service_account_info(
+                        json.loads(self.credentials_json), scopes=scope
+                    )
+                )
+            else:
+                self._creds, _ = google.auth.default(scopes=scope)
+        if not self._creds.valid:
+            self._creds.refresh(
+                google.auth.transport.requests.Request()
+            )
+        return self._creds.token
+
+    def prepare(self, body: dict, stream: bool):
+        out = dict(body)
+        model = out.pop("model", "")
+        out.setdefault("anthropic_version", VERTEX_ANTHROPIC_VERSION)
+        out.pop("stream", None)       # verb encodes streaming on Vertex
+        verb = "streamRawPredict" if stream else "rawPredict"
+        url = (
+            f"{self.base_url}/v1/projects/{self.project}/locations/"
+            f"{self.region}/publishers/anthropic/models/{model}:{verb}"
+        )
+        headers = {
+            "Content-Type": "application/json",
+            "Authorization": f"Bearer {self._token()}",
+        }
+        return url, headers, json.dumps(out)
+
+
+class BedrockTransport:
+    """AWS Bedrock runtime with stdlib SigV4 signing."""
+
+    def __init__(
+        self, region: str, access_key: str, secret_key: str,
+        session_token: str = "", base_url: str = "",
+    ):
+        self.region = region
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.session_token = session_token
+        self.base_url = (
+            base_url or f"https://bedrock-runtime.{region}.amazonaws.com"
+        ).rstrip("/")
+
+    def _sign(self, method: str, url: str, payload: bytes) -> dict:
+        """AWS Signature Version 4 for service 'bedrock'."""
+        parsed = urllib.parse.urlparse(url)
+        host = parsed.netloc
+        now = datetime.datetime.now(datetime.timezone.utc)
+        amz_date = now.strftime("%Y%m%dT%H%M%SZ")
+        date = now.strftime("%Y%m%d")
+        canonical_uri = urllib.parse.quote(parsed.path)
+        payload_hash = hashlib.sha256(payload).hexdigest()
+        headers = {
+            "content-type": "application/json",
+            "host": host,
+            "x-amz-content-sha256": payload_hash,
+            "x-amz-date": amz_date,
+        }
+        if self.session_token:
+            headers["x-amz-security-token"] = self.session_token
+        signed_headers = ";".join(sorted(headers))
+        canonical_headers = "".join(
+            f"{k}:{headers[k]}\n" for k in sorted(headers)
+        )
+        canonical_request = "\n".join(
+            [method, canonical_uri, "", canonical_headers, signed_headers,
+             payload_hash]
+        )
+        scope = f"{date}/{self.region}/bedrock/aws4_request"
+        string_to_sign = "\n".join(
+            [
+                "AWS4-HMAC-SHA256", amz_date, scope,
+                hashlib.sha256(canonical_request.encode()).hexdigest(),
+            ]
+        )
+
+        def _hmac(key, msg):
+            return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+        k = _hmac(f"AWS4{self.secret_key}".encode(), date)
+        k = _hmac(k, self.region)
+        k = _hmac(k, "bedrock")
+        k = _hmac(k, "aws4_request")
+        signature = hmac.new(
+            k, string_to_sign.encode(), hashlib.sha256
+        ).hexdigest()
+        out = {k_: v for k_, v in headers.items() if k_ != "host"}
+        out["Authorization"] = (
+            f"AWS4-HMAC-SHA256 Credential={self.access_key}/{scope}, "
+            f"SignedHeaders={signed_headers}, Signature={signature}"
+        )
+        return out
+
+    def prepare(self, body: dict, stream: bool):
+        out = dict(body)
+        model = out.pop("model", "")
+        out.pop("stream", None)
+        out.setdefault("anthropic_version", BEDROCK_ANTHROPIC_VERSION)
+        verb = "invoke-with-response-stream" if stream else "invoke"
+        url = (
+            f"{self.base_url}/model/{urllib.parse.quote(model, safe='')}"
+            f"/{verb}"
+        )
+        payload = json.dumps(out).encode()
+        return url, self._sign("POST", url, payload), payload
+
+
+# -- thinking-schema retry ---------------------------------------------------
+
+_ADAPTIVE_REJECTED = "does not match any of the expected tags"
+_ENABLED_REJECTED = "is not supported for this model"
+
+
+def _flip_thinking(body: dict, error_text: str) -> Optional[dict]:
+    """Return a body with thinking.type flipped if the 400 matches one of
+    Vertex's inconsistent-pod complaints; None when not applicable."""
+    thinking = body.get("thinking")
+    if not isinstance(thinking, dict) or "type" not in thinking:
+        return None
+    t = thinking.get("type")
+    if _ADAPTIVE_REJECTED in error_text and t == "adaptive":
+        new_t = "enabled"
+    elif _ENABLED_REJECTED in error_text and t == "enabled":
+        new_t = "adaptive"
+    else:
+        return None
+    out = dict(body)
+    out["thinking"] = {**thinking, "type": new_t}
+    if new_t == "enabled" and "budget_tokens" not in out["thinking"]:
+        # the old schema requires a budget; derive one like the SDKs do
+        out["thinking"]["budget_tokens"] = max(
+            1024, int(out.get("max_tokens", 2048)) // 2
+        )
+    elif new_t == "adaptive":
+        out["thinking"].pop("budget_tokens", None)
+    return out
+
+
+class AnthropicGateway:
+    """One upstream target + retry policy; proxies a /v1/messages body."""
+
+    def __init__(self, transport, session_factory=None):
+        self.transport = transport
+        self._session_factory = session_factory or (
+            lambda: aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=600)
+            )
+        )
+
+    async def messages(self, body: dict, stream: bool = False):
+        """Non-stream: returns (status, json_doc). Stream: returns an open
+        (status, aiohttp response, session) — caller must close both —
+        or a (status, json_doc) 2-tuple when the upstream resolved to an
+        error before any stream opened."""
+        import asyncio
+
+        attempt_body = dict(body)
+        last = None
+        loop = asyncio.get_running_loop()
+        for attempt in range(MAX_THINKING_RETRIES):
+            # prepare() may refresh OAuth credentials (Vertex) — a
+            # blocking HTTPS call that must not stall the event loop
+            url, headers, payload = await loop.run_in_executor(
+                None, self.transport.prepare, attempt_body, stream
+            )
+            session = self._session_factory()
+            try:
+                resp = await session.post(
+                    url, data=payload, headers=headers
+                )
+            except Exception:
+                await session.close()
+                raise
+            if resp.status == 400:
+                text = await resp.text()
+                await resp.release()
+                await session.close()
+                flipped = _flip_thinking(attempt_body, text)
+                if flipped is not None:
+                    log.info(
+                        "thinking schema 400 (attempt %d); flipping type",
+                        attempt + 1,
+                    )
+                    attempt_body = flipped
+                    last = (400, text)
+                    continue
+                return 400, _as_error_doc(text)
+            if stream:
+                return resp.status, resp, session
+            try:
+                doc = await resp.json(content_type=None)
+            except Exception:  # noqa: BLE001 — non-JSON upstream error
+                doc = _as_error_doc(await resp.text())
+            status = resp.status
+            await session.close()
+            return status, doc
+        return last[0], _as_error_doc(last[1])
+
+
+def _as_error_doc(text: str):
+    try:
+        return json.loads(text)
+    except ValueError:
+        return {"type": "error", "error": {"message": text[:2000]}}
+
+
+# -- subscription probe ------------------------------------------------------
+
+PROBE_VALID = "valid"
+PROBE_INVALID = "invalid"
+PROBE_INCONCLUSIVE = "inconclusive"
+
+
+async def probe_claude_subscription(
+    token: str, url: str = "https://api.anthropic.com/v1/messages",
+) -> tuple:
+    """Cheap liveness probe of a Claude subscription OAuth/setup token
+    (reference: ``subscription_probe.go:47``): 401 -> invalid, 200/429 ->
+    valid (429 is throttling, the token works), anything else ->
+    inconclusive (never punish the user for our network)."""
+    if not token:
+        return PROBE_INVALID, "no token"
+    body = {
+        "model": "claude-3-5-haiku-latest",
+        "max_tokens": 1,
+        "messages": [{"role": "user", "content": "ping"}],
+    }
+    headers = {
+        "Authorization": f"Bearer {token}",
+        "anthropic-beta": OAUTH_BETA_HEADER,
+        "anthropic-version": "2023-06-01",
+        "content-type": "application/json",
+    }
+    try:
+        async with aiohttp.ClientSession(
+            timeout=aiohttp.ClientTimeout(total=8)
+        ) as s:
+            async with s.post(url, json=body, headers=headers) as r:
+                if r.status in (200, 429):
+                    return PROBE_VALID, ""
+                if r.status == 401:
+                    detail = ""
+                    try:
+                        doc = await r.json(content_type=None)
+                        detail = doc.get("error", {}).get("message", "")
+                    except Exception:  # noqa: BLE001
+                        pass
+                    return PROBE_INVALID, detail or "401 unauthorized"
+                return PROBE_INCONCLUSIVE, f"HTTP {r.status}"
+    except Exception as e:  # noqa: BLE001 — network errors are inconclusive
+        return PROBE_INCONCLUSIVE, f"network error: {e}"
+
+
+def gateway_from_env(env=None) -> Optional[AnthropicGateway]:
+    """Build the configured upstream gateway (None when unconfigured).
+    Precedence mirrors the reference: Vertex > Bedrock > direct key."""
+    import os
+
+    env = env or os.environ
+    if env.get("HELIX_VERTEX_PROJECT"):
+        return AnthropicGateway(
+            VertexTransport(
+                project=env["HELIX_VERTEX_PROJECT"],
+                region=env.get("HELIX_VERTEX_REGION", "us-east5"),
+                credentials_json=env.get("HELIX_VERTEX_CREDENTIALS", ""),
+                base_url=env.get("HELIX_VERTEX_BASE_URL", ""),
+            )
+        )
+    if env.get("HELIX_BEDROCK_ACCESS_KEY"):
+        return AnthropicGateway(
+            BedrockTransport(
+                region=env.get("HELIX_BEDROCK_REGION", "us-east-1"),
+                access_key=env["HELIX_BEDROCK_ACCESS_KEY"],
+                secret_key=env.get("HELIX_BEDROCK_SECRET_KEY", ""),
+                session_token=env.get("HELIX_BEDROCK_SESSION_TOKEN", ""),
+                base_url=env.get("HELIX_BEDROCK_BASE_URL", ""),
+            )
+        )
+    key = env.get("HELIX_ANTHROPIC_PROXY_KEY", "")
+    oauth = env.get("HELIX_ANTHROPIC_OAUTH_TOKEN", "")
+    if key or oauth:
+        return AnthropicGateway(
+            DirectTransport(
+                api_key=key,
+                oauth_token=oauth,
+                base_url=env.get(
+                    "HELIX_ANTHROPIC_BASE_URL", "https://api.anthropic.com"
+                ),
+            )
+        )
+    return None

@@ -153,7 +153,10 @@ class ControlPlane:
         from helix_tpu.knowledge.crawler import default_fetch
 
         self.knowledge = KnowledgeManager(
-            self.vectors, embed_fn, fetch_fn=default_fetch
+            self.vectors, embed_fn, fetch_fn=default_fetch,
+            sharepoint_token=lambda owner, provider: self.oauth.get_token(
+                owner, provider
+            ),
         ).start()
         self.controller = SessionController(
             self.store, self.providers, self.knowledge,
@@ -695,9 +698,14 @@ class ControlPlane:
         r.add_delete("/api/v1/desktops/{id}", self.delete_desktop)
         r.add_get("/api/v1/desktops/{id}/ws/stream", self.ws_desktop_stream)
         r.add_get("/api/v1/desktops/{id}/ws/input", self.ws_desktop_input)
-        # openai passthrough
+        # openai passthrough (+ native Anthropic /v1/messages: served
+        # models dispatch to runners; unknown models proxy upstream via
+        # the direct/Vertex/Bedrock gateway — reference anthropic_proxy.go)
         r.add_get("/v1/models", self.models)
-        for route in ("/v1/chat/completions", "/v1/completions", "/v1/embeddings"):
+        for route in (
+            "/v1/chat/completions", "/v1/completions", "/v1/embeddings",
+            "/v1/messages",
+        ):
             r.add_post(route, self.dispatch_openai)
         return app
 
@@ -1059,7 +1067,7 @@ class ControlPlane:
         try:
             suite = self.evals.create_suite(
                 request.match_info["app_id"],
-                request.query.get("owner", "anonymous"),
+                self._user_id(request),
                 body,
             )
         except ValueError as e:
@@ -1088,7 +1096,7 @@ class ControlPlane:
 
     async def start_eval_run(self, request):
         run = self.evals.start_run(
-            request.match_info["id"], request.query.get("owner", "anonymous")
+            request.match_info["id"], self._user_id(request)
         )
         if run is None:
             return _err(404, "suite not found")
@@ -1176,6 +1184,8 @@ class ControlPlane:
             max_pages=min(int(body.get("max_pages", 50)), 500),
             chunk_size=int(body.get("chunk_size", 1000)),
             chunk_overlap=int(body.get("chunk_overlap", 100)),
+            sharepoint=body.get("sharepoint"),
+            owner=self._user_id(request),
         )
         self.knowledge.add(spec)
         return web.json_response({"id": kid, "state": spec.state})
@@ -2005,6 +2015,8 @@ class ControlPlane:
             # set regardless of where it runs
             if request.path == "/v1/chat/completions":
                 return await self._dispatch_provider(request, body)
+            if request.path == "/v1/messages":
+                return await self._dispatch_anthropic_gateway(request, body)
             return _err(
                 404,
                 f"no runner serves model '{model}'",
@@ -2033,6 +2045,47 @@ class ControlPlane:
                     await resp.write(chunk)
                 await resp.write_eof()
                 return resp
+
+    async def _dispatch_anthropic_gateway(self, request, body: dict):
+        """Native /v1/messages for models no runner serves: proxy to the
+        configured upstream (direct key / Vertex / Bedrock) with the
+        thinking-schema retry (reference: api/pkg/anthropic)."""
+        from helix_tpu.control.anthropic_gateway import gateway_from_env
+
+        if not hasattr(self, "_anthropic_gateway"):
+            self._anthropic_gateway = gateway_from_env()
+        gw = self._anthropic_gateway
+        if gw is None:
+            return _err(
+                404,
+                f"no runner serves model '{body.get('model', '')}' and no "
+                "Anthropic upstream is configured",
+                available=self.router.available_models(),
+            )
+        if body.get("stream"):
+            res = await gw.messages(body, stream=True)
+            if len(res) == 2:   # resolved to an error before streaming
+                return web.json_response(res[1], status=res[0])
+            status, upstream, session = res
+            try:
+                resp = web.StreamResponse(
+                    status=status,
+                    headers={
+                        "Content-Type": upstream.headers.get(
+                            "Content-Type", "text/event-stream"
+                        )
+                    },
+                )
+                await resp.prepare(request)
+                async for chunk in upstream.content.iter_any():
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+            finally:
+                upstream.release()
+                await session.close()
+        status, doc = await gw.messages(body, stream=False)
+        return web.json_response(doc, status=status)
 
     async def _dispatch_provider(self, request, body: dict):
         """Chat via the provider manager when no runner serves the model

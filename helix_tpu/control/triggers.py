@@ -128,8 +128,116 @@ def normalize_platform_payload(kind: str, payload: dict):
                 "platform": "discord",
             }
         return "ignore", "no content"
+    if kind == "azure-devops":
+        return _normalize_azure_devops(payload)
+    if kind == "crisp":
+        return _normalize_crisp(payload)
     # plain webhook: pass through untouched
     return "fire", payload
+
+
+def _normalize_azure_devops(payload: dict):
+    """Azure DevOps service-hook events -> agent prompt (reference:
+    ``api/pkg/trigger/azure/azure_devops_trigger.go:39-134`` + the
+    renderers in ``event_data_extract.go``).  PR created/updated events
+    render a structured summary; PR comment events relay the comment for
+    a reply; unknown events pass the raw JSON through for the agent."""
+    etype = payload.get("eventType", "")
+    res = payload.get("resource") or {}
+    if etype in ("git.pullrequest.created", "git.pullrequest.updated"):
+        repo = res.get("repository") or {}
+        creator = res.get("createdBy") or {}
+        what = (
+            "Created" if etype.endswith("created") else "Updated"
+        )
+        text = (
+            f"Azure DevOps Pull Request {what} Event\n\n"
+            f"PULL REQUEST DETAILS:\n"
+            f"- PR ID: {res.get('pullRequestId', '')}\n"
+            f"- Title: {res.get('title', '')}\n"
+            f"- Description: {res.get('description', '')}\n"
+            f"- Status: {res.get('status', '')}\n"
+            f"- Source Branch: {res.get('sourceRefName', '')}\n"
+            f"- Target Branch: {res.get('targetRefName', '')}\n"
+            f"- Creator: {creator.get('displayName', '')} "
+            f"({creator.get('uniqueName', '')})\n"
+            f"- Repository: {repo.get('name', '')}\n"
+            f"- Project: {(repo.get('project') or {}).get('name', '')}\n"
+            f"- Web URL: {repo.get('webUrl', '')}\n"
+        )
+        return "fire", {
+            "message": text,
+            "user": creator.get("uniqueName", ""),
+            "channel": repo.get("name", ""),
+            "thread": str(res.get("pullRequestId", "")),
+            "platform": "azure-devops",
+            "event_type": etype,
+        }
+    if etype == "ms.vss-code.git.pullrequest-comment-event" or (
+        etype.startswith("ms.vss-code") and "comment" in etype
+    ):
+        comment = (res.get("comment") or {}).get("content", "")
+        pr = res.get("pullRequest") or {}
+        msg = (payload.get("message") or {}).get("text", "")
+        text = (
+            "Here's the Azure DevOps Pull Request Comment Event:\n"
+            f"- Event Type: {etype}\n"
+            f"- What happened: {msg}\n"
+            f"- User message: {comment}\n\n"
+            "Reply to the user's message.\n"
+        )
+        return "fire", {
+            "message": text,
+            "user": (
+                (res.get("comment") or {}).get("author") or {}
+            ).get("uniqueName", ""),
+            "channel": (pr.get("repository") or {}).get("name", ""),
+            "thread": str(pr.get("pullRequestId", "")),
+            "platform": "azure-devops",
+            "event_type": etype,
+        }
+    if not etype:
+        return "ignore", "no eventType"
+    # unknown event type: relay raw JSON (processUnknownEvent)
+    import json as _json
+
+    return "fire", {
+        "message": (
+            f"Azure DevOps event {etype}:\n"
+            f"{_json.dumps(payload, indent=2)[:4000]}"
+        ),
+        "user": "",
+        "channel": "",
+        "thread": payload.get("id", ""),
+        "platform": "azure-devops",
+        "event_type": etype,
+    }
+
+
+def _normalize_crisp(payload: dict):
+    """Crisp helpdesk webhook -> agent prompt (reference:
+    ``api/pkg/trigger/crisp/crisp_bot.go:91-199``: message:send/text
+    events fire the bot; operator/bot echoes and non-text payloads are
+    ignored)."""
+    event = payload.get("event", "")
+    data = payload.get("data") or {}
+    if event != "message:send":
+        return "ignore", f"unhandled crisp event {event!r}"
+    if data.get("from") != "user":
+        return "ignore", "operator/bot message"
+    if data.get("type") != "text":
+        return "ignore", f"non-text crisp message ({data.get('type')})"
+    session = data.get("session_id", "")
+    if not session:
+        return "ignore", "missing crisp session_id"
+    user = data.get("user") or {}
+    return "fire", {
+        "message": data.get("content", ""),
+        "user": user.get("nickname", "") or user.get("user_id", ""),
+        "channel": data.get("website_id", ""),
+        "thread": session,
+        "platform": "crisp",
+    }
 
 
 @dataclasses.dataclass

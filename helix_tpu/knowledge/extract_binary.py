@@ -1,0 +1,234 @@
+"""Binary-document text extraction: PDF, docx, pptx, xlsx.
+
+The reference calls an unstructured.io-style extractor *service* over HTTP
+(``api/pkg/extract/extract.go:22-29``) and feeds it crawler output and
+uploaded/SharePoint files.  This build extracts in-process with the
+stdlib: Office OpenXML formats are zip archives of XML (pull the text
+nodes), and PDFs embed text in content streams as ``Tj``/``TJ`` operators
+(inflate FlateDecode streams, then parse the operators).  The PDF path
+covers the overwhelmingly common case (Flate-compressed, standard-encoded
+text); exotic encodings degrade to empty text rather than errors.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import zipfile
+import zlib
+
+__all__ = ["extract_any", "extract_pdf", "extract_docx", "extract_pptx",
+           "extract_xlsx", "sniff_kind"]
+
+
+def sniff_kind(data: bytes, filename: str = "") -> str:
+    """-> pdf | docx | pptx | xlsx | zip | text"""
+    if data[:5] == b"%PDF-":
+        return "pdf"
+    if data[:2] == b"PK":
+        name = filename.lower()
+        if name.endswith(".docx"):
+            return "docx"
+        if name.endswith(".pptx"):
+            return "pptx"
+        if name.endswith(".xlsx"):
+            return "xlsx"
+        # sniff by archive members
+        try:
+            with zipfile.ZipFile(io.BytesIO(data)) as z:
+                names = z.namelist()
+            if any(n.startswith("word/") for n in names):
+                return "docx"
+            if any(n.startswith("ppt/") for n in names):
+                return "pptx"
+            if any(n.startswith("xl/") for n in names):
+                return "xlsx"
+        except zipfile.BadZipFile:
+            pass
+        return "zip"
+    return "text"
+
+
+def extract_any(data: bytes, filename: str = "") -> str:
+    """Dispatch on sniffed kind; text-ish bytes decode with replacement."""
+    kind = sniff_kind(data, filename)
+    if kind == "pdf":
+        return extract_pdf(data)
+    if kind == "docx":
+        return extract_docx(data)
+    if kind == "pptx":
+        return extract_pptx(data)
+    if kind == "xlsx":
+        return extract_xlsx(data)
+    if kind == "zip":
+        return ""
+    return data.decode("utf-8", errors="replace")
+
+
+# -- Office OpenXML ----------------------------------------------------------
+
+_XML_TAG = re.compile(rb"<[^>]+>")
+
+
+def _xml_text(xml: bytes, para_tag: bytes, text_tag: bytes) -> str:
+    """Pull the character data of <text_tag> runs, joining runs within a
+    <para_tag> and separating paragraphs with newlines."""
+    out: list = []
+    for para in re.split(b"</" + para_tag + b">", xml):
+        runs = re.findall(
+            b"<" + text_tag + b"(?:\\s[^>]*)?>(.*?)</" + text_tag + b">",
+            para, re.S,
+        )
+        if runs:
+            text = b"".join(runs)
+            out.append(_unescape(_XML_TAG.sub(b"", text).decode(
+                "utf-8", errors="replace"
+            )))
+    return "\n".join(t for t in out if t.strip())
+
+
+def _unescape(s: str) -> str:
+    import html
+
+    return html.unescape(s)
+
+
+def extract_docx(data: bytes) -> str:
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        parts = []
+        for name in sorted(z.namelist()):
+            if name == "word/document.xml" or re.match(
+                r"word/(header|footer)\d*\.xml", name
+            ):
+                parts.append(_xml_text(z.read(name), b"w:p", b"w:t"))
+    return "\n".join(p for p in parts if p)
+
+
+def extract_pptx(data: bytes) -> str:
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        slides = sorted(
+            n for n in z.namelist()
+            if re.match(r"ppt/slides/slide\d+\.xml$", n)
+        )
+        return "\n\n".join(
+            t
+            for n in slides
+            if (t := _xml_text(z.read(n), b"a:p", b"a:t"))
+        )
+
+
+def extract_xlsx(data: bytes) -> str:
+    """Shared strings + inline strings; numbers are left out (RAG wants
+    prose, not a number soup)."""
+    with zipfile.ZipFile(io.BytesIO(data)) as z:
+        names = z.namelist()
+        parts = []
+        if "xl/sharedStrings.xml" in names:
+            parts.append(
+                _xml_text(z.read("xl/sharedStrings.xml"), b"si", b"t")
+            )
+        for n in sorted(names):
+            if re.match(r"xl/worksheets/sheet\d+\.xml$", n):
+                inline = _xml_text(z.read(n), b"is", b"t")
+                if inline:
+                    parts.append(inline)
+    return "\n".join(p for p in parts if p)
+
+
+# -- PDF ---------------------------------------------------------------------
+
+_STREAM_RE = re.compile(rb"stream\r?\n(.*?)\r?\nendstream", re.S)
+# text-showing operators inside content streams
+_TJ_RE = re.compile(rb"\((?:\\.|[^\\()])*\)\s*Tj")
+_TJ_ARRAY_RE = re.compile(rb"\[((?:[^\[\]\\]|\\.)*?)\]\s*TJ", re.S)
+_STR_RE = re.compile(rb"\(((?:\\.|[^\\()])*)\)")
+_BT_ET_RE = re.compile(rb"BT(.*?)ET", re.S)
+_TSTAR = re.compile(rb"T\*|\bTd\b|\bTD\b")
+
+
+def _pdf_unescape(raw: bytes) -> str:
+    out = bytearray()
+    i = 0
+    while i < len(raw):
+        c = raw[i]
+        if c == 0x5C and i + 1 < len(raw):  # backslash
+            n = raw[i + 1]
+            mapped = {
+                ord("n"): 10, ord("r"): 13, ord("t"): 9, ord("b"): 8,
+                ord("f"): 12, ord("("): 40, ord(")"): 41, ord("\\"): 92,
+            }.get(n)
+            if mapped is not None:
+                out.append(mapped)
+                i += 2
+                continue
+            if 0x30 <= n <= 0x37:  # octal escape, up to 3 digits
+                j = i + 1
+                oct_digits = b""
+                while j < len(raw) and len(oct_digits) < 3 and (
+                    0x30 <= raw[j] <= 0x37
+                ):
+                    oct_digits += bytes([raw[j]])
+                    j += 1
+                out.append(int(oct_digits, 8) & 0xFF)
+                i = j
+                continue
+            i += 1  # unknown escape: drop the backslash
+            continue
+        out.append(c)
+        i += 1
+    # PDFs may use UTF-16BE strings (BOM-prefixed)
+    if out[:2] == b"\xfe\xff":
+        return bytes(out[2:]).decode("utf-16-be", errors="replace")
+    return bytes(out).decode("latin-1", errors="replace")
+
+
+def _stream_text(stream: bytes) -> str:
+    lines: list = []
+    for block in _BT_ET_RE.findall(stream):
+        parts: list = []
+        pos = 0
+        # walk the block in order, collecting show-text ops and breaks
+        tokens = sorted(
+            [(m.start(), "tj", m) for m in _TJ_RE.finditer(block)]
+            + [(m.start(), "TJ", m) for m in _TJ_ARRAY_RE.finditer(block)]
+            + [(m.start(), "nl", m) for m in _TSTAR.finditer(block)]
+        )
+        del pos
+        for _, kind, m in tokens:
+            if kind == "nl":
+                parts.append("\n")
+            elif kind == "tj":
+                s = _STR_RE.search(m.group(0))
+                if s:
+                    parts.append(_pdf_unescape(s.group(1)))
+            else:
+                for s in _STR_RE.finditer(m.group(1)):
+                    parts.append(_pdf_unescape(s.group(1)))
+        text = "".join(parts)
+        if text.strip():
+            lines.append(text)
+    return "\n".join(lines)
+
+
+def extract_pdf(data: bytes) -> str:
+    """Inflate every Flate stream and parse BT..ET text blocks; raw
+    (uncompressed) streams are parsed as-is."""
+    texts: list = []
+    for m in _STREAM_RE.finditer(data):
+        raw = m.group(1)
+        inflated = None
+        try:
+            inflated = zlib.decompress(raw)
+        except zlib.error:
+            # try skipping leading whitespace junk, then give up -> raw
+            try:
+                inflated = zlib.decompress(raw.lstrip(b"\r\n"))
+            except zlib.error:
+                inflated = raw
+        t = _stream_text(inflated)
+        if t:
+            texts.append(t)
+    out = "\n".join(texts)
+    # collapse intra-word kerning artifacts: TJ arrays emit fragments
+    out = re.sub(r"[ \t]+", " ", out)
+    return out.strip()

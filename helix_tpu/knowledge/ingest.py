@@ -34,6 +34,11 @@ class KnowledgeSpec:
     urls: tuple = ()                    # single pages, or crawl seeds
     crawl_depth: int = 0                # >0: BFS-crawl from urls
     max_pages: int = 50                 # crawl page budget
+    # SharePoint drive source (reference: KnowledgeSourceSharePoint,
+    # knowledge_extract.go:423): {site_id|site_url, drive_id?,
+    # folder_path?, recursive?, extensions?, oauth_provider?}
+    sharepoint: Optional[dict] = None
+    owner: str = ""                     # OAuth connection owner
     # chunking
     chunk_size: int = 1000
     chunk_overlap: int = 100
@@ -49,6 +54,7 @@ class KnowledgeSpec:
 
 _TEXT_EXTS = {".txt", ".md", ".markdown", ".rst", ".py", ".go", ".js", ".ts",
               ".json", ".yaml", ".yml", ".toml", ".html", ".htm", ".css"}
+_BINARY_EXTS = {".pdf", ".docx", ".pptx", ".xlsx"}
 
 
 class KnowledgeManager:
@@ -58,10 +64,16 @@ class KnowledgeManager:
         embed_fn: Callable[[list], np.ndarray],
         fetch_fn: Optional[Callable[[str], tuple]] = None,  # url -> (text, ctype)
         reconcile_interval: float = 10.0,
+        sharepoint_token: Optional[Callable[[str, str], str]] = None,
+        sharepoint_http: Optional[Callable] = None,
     ):
         self.store = store
         self.embed = embed_fn
         self.fetch = fetch_fn
+        # (owner, provider) -> bearer token; wired to the OAuth manager by
+        # the control plane (reference: knowledge reconciler + oauthManager)
+        self.sharepoint_token = sharepoint_token
+        self.sharepoint_http = sharepoint_http   # injectable Graph HTTP
         self.reconcile_interval = reconcile_interval
         self._specs: dict[str, KnowledgeSpec] = {}
         self._dirty: set = set()
@@ -107,13 +119,30 @@ class KnowledgeManager:
                     os.path.join(r, f)
                     for r, _, fs in os.walk(spec.path)
                     for f in fs
-                    if os.path.splitext(f)[1].lower() in _TEXT_EXTS
+                    if os.path.splitext(f)[1].lower()
+                    in (_TEXT_EXTS | _BINARY_EXTS)
                 ]
             for p in sorted(paths):
+                ext = os.path.splitext(p)[1].lower()
                 try:
+                    if ext in _BINARY_EXTS:
+                        # pdf/docx/pptx/xlsx: in-process binary extractor
+                        # (the reference calls an extractor service here).
+                        # One corrupt file must not fail the whole index —
+                        # degrade per-file like the text path does.
+                        from helix_tpu.knowledge.extract_binary import (
+                            extract_any,
+                        )
+
+                        with open(p, "rb") as f:
+                            text = extract_any(f.read(), p)
+                        docs.append((text, {"source": p}))
+                        continue
                     with open(p, errors="replace") as f:
                         content = f.read()
                 except OSError:
+                    continue
+                except Exception:  # noqa: BLE001 — corrupt binary file
                     continue
                 ctype = (
                     "text/html"
@@ -150,6 +179,31 @@ class KnowledgeManager:
             for url in spec.urls:
                 content, ctype = self.fetch(url)
                 docs.append((extract_text(content, ctype), {"source": url}))
+        if spec.sharepoint:
+            if self.sharepoint_token is None:
+                raise RuntimeError(
+                    "sharepoint sources need an OAuth manager "
+                    "(sharepoint_token hook unset)"
+                )
+            from helix_tpu.knowledge.sharepoint import gather_sharepoint
+
+            provider = spec.sharepoint.get("oauth_provider", "microsoft")
+            token = self.sharepoint_token(spec.owner, provider)
+
+            def _progress(i, total, name):
+                spec.progress = {
+                    "step": "downloading",
+                    "progress": int(i / max(total, 1) * 100),
+                    "message": f"Downloading {name} ({i + 1}/{total})",
+                }
+
+            docs.extend(
+                gather_sharepoint(
+                    spec.sharepoint, token,
+                    http_fn=self.sharepoint_http,
+                    progress=_progress,
+                )
+            )
         return docs
 
     def index(self, kid: str) -> KnowledgeSpec:

@@ -1,0 +1,188 @@
+"""SharePoint knowledge source: Microsoft Graph drive walking + download.
+
+Reference: ``api/pkg/sharepoint/client.go`` (site lookup by id/URL,
+default drive, recursive folder listing with extension filters, download
+via ``@microsoft.graph.downloadUrl``) driven from the knowledge
+reconciler (``knowledge_extract.go:423 extractDataFromSharePoint``) with
+the owner's Microsoft OAuth connection supplying the bearer token.
+
+The HTTP layer is injectable (``http_fn``) so tests run against a fake
+Graph server and so the knowledge manager can plug in its own fetcher.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import urllib.parse
+import urllib.request
+from typing import Callable, Optional
+
+log = logging.getLogger("helix.sharepoint")
+
+GRAPH_BASE = "https://graph.microsoft.com/v1.0"
+
+
+@dataclasses.dataclass
+class SharePointSource:
+    """Source config (reference: ``types.KnowledgeSourceSharePoint``)."""
+
+    site_id: str = ""
+    site_url: str = ""                  # alternative to site_id
+    drive_id: str = ""                  # empty = site default drive
+    folder_path: str = ""               # empty = drive root
+    recursive: bool = True
+    extensions: tuple = ()              # (".docx", ".pdf"); empty = all
+    oauth_provider: str = "microsoft"   # token source
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "SharePointSource":
+        return cls(
+            site_id=doc.get("site_id", ""),
+            site_url=doc.get("site_url", ""),
+            drive_id=doc.get("drive_id", ""),
+            folder_path=doc.get("folder_path", ""),
+            recursive=bool(doc.get("recursive", True)),
+            extensions=tuple(
+                e.lower() if e.startswith(".") else f".{e.lower()}"
+                for e in doc.get("extensions", [])
+            ),
+            oauth_provider=doc.get("oauth_provider", "microsoft"),
+        )
+
+
+class SharePointClient:
+    def __init__(
+        self,
+        token: str,
+        base_url: str = GRAPH_BASE,
+        http_fn: Optional[Callable] = None,
+    ):
+        self.token = token
+        self.base_url = base_url.rstrip("/")
+        self._http = http_fn or self._default_http
+
+    def _default_http(self, url: str, headers: dict) -> bytes:
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.read()
+
+    def _get(self, path: str) -> dict:
+        url = (
+            path if path.startswith("http") else f"{self.base_url}{path}"
+        )
+        raw = self._http(
+            url, {"Authorization": f"Bearer {self.token}"}
+        )
+        return json.loads(raw)
+
+    # -- sites / drives -----------------------------------------------------
+    def site_by_url(self, site_url: str) -> dict:
+        """https://contoso.sharepoint.com/sites/Team ->
+        GET /sites/contoso.sharepoint.com:/sites/Team
+        (reference client.go:136 GetSiteByURL)."""
+        p = urllib.parse.urlparse(site_url)
+        return self._get(f"/sites/{p.netloc}:{p.path}")
+
+    def default_drive(self, site_id: str) -> dict:
+        return self._get(f"/sites/{site_id}/drive")
+
+    def resolve(self, src: SharePointSource) -> tuple:
+        """-> (site_id, drive_id)"""
+        site_id = src.site_id
+        if not site_id and src.site_url:
+            site_id = self.site_by_url(src.site_url)["id"]
+        if not site_id:
+            raise ValueError("sharepoint source needs site_id or site_url")
+        drive_id = src.drive_id or self.default_drive(site_id)["id"]
+        return site_id, drive_id
+
+    # -- files --------------------------------------------------------------
+    def list_files(
+        self, src: SharePointSource, drive_id: str = ""
+    ) -> list:
+        """-> [DriveItem dicts] honoring folder_path / recursive /
+        extension filter (reference client.go:188-281). Pass an already-
+        resolved ``drive_id`` to skip the site/drive lookup round-trips."""
+        if not drive_id:
+            _, drive_id = self.resolve(src)
+        if src.folder_path:
+            quoted = urllib.parse.quote(src.folder_path.strip("/"))
+            root = f"/drives/{drive_id}/root:/{quoted}:/children"
+        else:
+            root = f"/drives/{drive_id}/root/children"
+        out: list = []
+        self._walk(drive_id, root, src, out)
+        return out
+
+    def _walk(self, drive_id: str, path: str, src, out: list) -> None:
+        page: Optional[str] = path
+        while page:
+            doc = self._get(page)
+            for item in doc.get("value", []):
+                if "folder" in item:
+                    if src.recursive:
+                        self._walk(
+                            drive_id,
+                            f"/drives/{drive_id}/items/{item['id']}"
+                            "/children",
+                            src, out,
+                        )
+                    continue
+                if "file" not in item:
+                    continue
+                if src.extensions:
+                    name = item.get("name", "").lower()
+                    if not any(name.endswith(e) for e in src.extensions):
+                        continue
+                out.append(item)
+            page = doc.get("@odata.nextLink")
+
+    def download(self, drive_id: str, item: dict) -> bytes:
+        """Prefer the pre-authenticated downloadUrl; fall back to the
+        /content endpoint (reference client.go:283-356)."""
+        url = item.get("@microsoft.graph.downloadUrl")
+        if url:
+            return self._http(url, {})
+        return self._http(
+            f"{self.base_url}/drives/{drive_id}/items/{item['id']}/content",
+            {"Authorization": f"Bearer {self.token}"},
+        )
+
+
+def gather_sharepoint(
+    src_doc: dict, token: str, base_url: str = GRAPH_BASE,
+    http_fn: Optional[Callable] = None,
+    progress: Optional[Callable[[int, int, str], None]] = None,
+) -> list:
+    """-> [(text, meta)] documents for the knowledge indexer."""
+    from helix_tpu.knowledge.extract_binary import extract_any
+
+    src = SharePointSource.from_doc(src_doc)
+    client = SharePointClient(token, base_url=base_url, http_fn=http_fn)
+    _, drive_id = client.resolve(src)
+    files = client.list_files(src, drive_id=drive_id)
+    docs: list = []
+    for i, item in enumerate(files):
+        name = item.get("name", "")
+        if progress:
+            progress(i, len(files), name)
+        try:
+            data = client.download(drive_id, item)
+        except Exception as e:  # noqa: BLE001 — skip bad file, keep going
+            log.warning("sharepoint download failed for %s: %s", name, e)
+            continue
+        text = extract_any(data, name)
+        if text.strip():
+            docs.append(
+                (
+                    text,
+                    {
+                        "source": item.get("webUrl", name),
+                        "title": name,
+                        "sharepoint_id": item.get("id", ""),
+                    },
+                )
+            )
+    return docs

@@ -1,0 +1,288 @@
+"""Anthropic gateway: Vertex/Bedrock transports, thinking retry, probe.
+
+Reference: ``api/pkg/anthropic`` (vertex.go URL/version/model handling,
+thinking_retry.go flip-on-400 behavior, subscription_probe.go
+classification).
+"""
+
+import asyncio
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from helix_tpu.control.anthropic_gateway import (
+    AnthropicGateway,
+    BedrockTransport,
+    DirectTransport,
+    PROBE_INCONCLUSIVE,
+    PROBE_INVALID,
+    PROBE_VALID,
+    VertexTransport,
+    _flip_thinking,
+    gateway_from_env,
+    probe_claude_subscription,
+    vertex_base_url,
+)
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class TestTransportPrepare:
+    def test_vertex_url_version_and_model_move(self):
+        t = VertexTransport(
+            project="proj", region="us-east5", token_fn=lambda: "tok123"
+        )
+        url, headers, payload = t.prepare(
+            {"model": "claude-sonnet-4-5", "max_tokens": 5,
+             "messages": []},
+            stream=False,
+        )
+        assert url == (
+            "https://us-east5-aiplatform.googleapis.com/v1/projects/proj/"
+            "locations/us-east5/publishers/anthropic/models/"
+            "claude-sonnet-4-5:rawPredict"
+        )
+        body = json.loads(payload)
+        assert "model" not in body               # model moved to URL
+        assert body["anthropic_version"] == "vertex-2023-10-16"
+        assert headers["Authorization"] == "Bearer tok123"
+        url2, _, _ = t.prepare({"model": "m", "messages": []}, stream=True)
+        assert url2.endswith(":streamRawPredict")
+
+    def test_vertex_global_region(self):
+        assert vertex_base_url("global") == (
+            "https://aiplatform.googleapis.com"
+        )
+
+    def test_bedrock_sigv4_shape(self):
+        t = BedrockTransport(
+            region="us-east-1", access_key="AKIA123", secret_key="secret"
+        )
+        url, headers, payload = t.prepare(
+            {"model": "anthropic.claude-3-sonnet", "messages": []},
+            stream=False,
+        )
+        assert url == (
+            "https://bedrock-runtime.us-east-1.amazonaws.com/model/"
+            "anthropic.claude-3-sonnet/invoke"
+        )
+        auth = headers["Authorization"]
+        assert auth.startswith("AWS4-HMAC-SHA256 Credential=AKIA123/")
+        assert "/us-east-1/bedrock/aws4_request" in auth
+        assert "SignedHeaders=" in auth and "Signature=" in auth
+        assert headers["x-amz-content-sha256"] == __import__(
+            "hashlib"
+        ).sha256(payload).hexdigest()
+        body = json.loads(payload)
+        assert body["anthropic_version"] == "bedrock-2023-05-31"
+        url2, _, _ = t.prepare({"model": "m"}, stream=True)
+        assert url2.endswith("/invoke-with-response-stream")
+
+    def test_direct_oauth_token_gets_beta_header(self):
+        t = DirectTransport(oauth_token="sess_tok")
+        _, headers, _ = t.prepare({"model": "m"}, stream=False)
+        assert headers["Authorization"] == "Bearer sess_tok"
+        assert headers["anthropic-beta"] == "oauth-2025-04-20"
+        t2 = DirectTransport(api_key="sk-ant")
+        _, h2, _ = t2.prepare({"model": "m"}, stream=False)
+        assert h2["x-api-key"] == "sk-ant" and "Authorization" not in h2
+
+
+class TestThinkingFlip:
+    def test_adaptive_rejected_flips_to_enabled_with_budget(self):
+        body = {
+            "model": "m", "max_tokens": 4000,
+            "thinking": {"type": "adaptive"},
+        }
+        out = _flip_thinking(
+            body,
+            "thinking: Input tag 'adaptive' found using 'type' does not "
+            "match any of the expected tags: 'disabled', 'enabled'",
+        )
+        assert out["thinking"]["type"] == "enabled"
+        assert out["thinking"]["budget_tokens"] == 2000
+        assert body["thinking"]["type"] == "adaptive"  # original untouched
+
+    def test_enabled_rejected_flips_to_adaptive_dropping_budget(self):
+        out = _flip_thinking(
+            {"model": "m",
+             "thinking": {"type": "enabled", "budget_tokens": 1024}},
+            '"thinking.type.enabled" is not supported for this model. '
+            'Use "thinking.type.adaptive"',
+        )
+        assert out["thinking"] == {"type": "adaptive"}
+
+    def test_unrelated_400_does_not_flip(self):
+        assert _flip_thinking(
+            {"thinking": {"type": "adaptive"}}, "max_tokens too large"
+        ) is None
+        assert _flip_thinking({"model": "m"}, "whatever") is None
+
+
+class _FlakyVertex(BaseHTTPRequestHandler):
+    """Pod A rejects adaptive; pod B (every 2nd request) accepts."""
+
+    hits = 0
+
+    def do_POST(self):
+        n = int(self.headers["Content-Length"])
+        body = json.loads(self.rfile.read(n))
+        _FlakyVertex.hits += 1
+        t = (body.get("thinking") or {}).get("type")
+        if t == "adaptive":
+            out = json.dumps({
+                "error": {
+                    "message": "thinking: Input tag 'adaptive' found using "
+                    "'type' does not match any of the expected tags: "
+                    "'disabled', 'enabled'"
+                }
+            }).encode()
+            self.send_response(400)
+        else:
+            out = json.dumps({
+                "id": "msg_1", "type": "message",
+                "content": [{"type": "text", "text": "ok"}],
+                "stop_reason": "end_turn",
+            }).encode()
+            self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def flaky_vertex():
+    srv = HTTPServer(("127.0.0.1", 18433), _FlakyVertex)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    _FlakyVertex.hits = 0
+    yield "http://127.0.0.1:18433"
+    srv.shutdown()
+
+
+class TestGatewayRetry:
+    def test_thinking_400_is_retried_with_flipped_type(self, flaky_vertex):
+        gw = AnthropicGateway(
+            VertexTransport(
+                project="p", region="r", base_url=flaky_vertex,
+                token_fn=lambda: "t",
+            )
+        )
+        status, doc = _run(
+            gw.messages(
+                {"model": "m", "thinking": {"type": "adaptive"},
+                 "messages": [], "max_tokens": 8},
+            )
+        )
+        assert status == 200
+        assert doc["content"][0]["text"] == "ok"
+        assert _FlakyVertex.hits == 2     # one 400, one success
+
+    def test_non_thinking_400_not_retried(self, flaky_vertex):
+        gw = AnthropicGateway(
+            VertexTransport(
+                project="p", region="r", base_url=flaky_vertex,
+                token_fn=lambda: "t",
+            )
+        )
+        # adaptive thinking but flip disabled because no thinking field:
+        status, doc = _run(
+            gw.messages({"model": "m", "messages": [], "max_tokens": 8})
+        )
+        assert status == 200              # pod accepts non-adaptive
+        assert _FlakyVertex.hits == 1
+
+
+class _ProbeServer(BaseHTTPRequestHandler):
+    status = 200
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers["Content-Length"]))
+        assert self.headers["anthropic-beta"] == "oauth-2025-04-20"
+        out = json.dumps(
+            {"error": {"message": "authentication_failed"}}
+            if _ProbeServer.status == 401
+            else {"id": "msg"}
+        ).encode()
+        self.send_response(_ProbeServer.status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(out)))
+        self.end_headers()
+        self.wfile.write(out)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def probe_url():
+    srv = HTTPServer(("127.0.0.1", 18434), _ProbeServer)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield "http://127.0.0.1:18434/v1/messages"
+    srv.shutdown()
+
+
+class TestSubscriptionProbe:
+    def test_200_is_valid(self, probe_url):
+        _ProbeServer.status = 200
+        assert _run(probe_claude_subscription("tok", probe_url))[0] == (
+            PROBE_VALID
+        )
+
+    def test_429_is_valid(self, probe_url):
+        _ProbeServer.status = 429
+        assert _run(probe_claude_subscription("tok", probe_url))[0] == (
+            PROBE_VALID
+        )
+
+    def test_401_is_invalid_with_detail(self, probe_url):
+        _ProbeServer.status = 401
+        res, detail = _run(probe_claude_subscription("tok", probe_url))
+        assert res == PROBE_INVALID and "authentication_failed" in detail
+
+    def test_5xx_is_inconclusive(self, probe_url):
+        _ProbeServer.status = 503
+        assert _run(probe_claude_subscription("tok", probe_url))[0] == (
+            PROBE_INCONCLUSIVE
+        )
+
+    def test_network_error_is_inconclusive(self):
+        res, detail = _run(
+            probe_claude_subscription(
+                "tok", "http://127.0.0.1:1/v1/messages"
+            )
+        )
+        assert res == PROBE_INCONCLUSIVE
+
+    def test_empty_token_invalid(self):
+        assert _run(probe_claude_subscription(""))[0] == PROBE_INVALID
+
+
+class TestEnvWiring:
+    def test_vertex_takes_precedence(self):
+        gw = gateway_from_env(
+            {
+                "HELIX_VERTEX_PROJECT": "p",
+                "HELIX_BEDROCK_ACCESS_KEY": "a",
+                "HELIX_ANTHROPIC_PROXY_KEY": "k",
+            }
+        )
+        assert isinstance(gw.transport, VertexTransport)
+
+    def test_unconfigured_is_none(self):
+        assert gateway_from_env({}) is None
+
+    def test_direct_key(self):
+        gw = gateway_from_env({"HELIX_ANTHROPIC_PROXY_KEY": "k"})
+        assert isinstance(gw.transport, DirectTransport)

@@ -80,6 +80,107 @@ class TestPlatformAdapters:
         )
         assert verdict == "fire" and doc["platform"] == "discord"
 
+    def test_azure_devops_pr_created_rendered(self):
+        """PR created/updated events render the structured summary the
+        agent prompt expects (reference: azure/event_data_extract.go)."""
+        verdict, doc = normalize_platform_payload(
+            "azure-devops",
+            {
+                "eventType": "git.pullrequest.created",
+                "resource": {
+                    "pullRequestId": 42,
+                    "title": "Add search",
+                    "description": "full-text",
+                    "status": "active",
+                    "sourceRefName": "refs/heads/feat",
+                    "targetRefName": "refs/heads/main",
+                    "createdBy": {"displayName": "Ada",
+                                  "uniqueName": "ada@x.test"},
+                    "repository": {
+                        "name": "webapp",
+                        "webUrl": "https://dev.azure.com/x/webapp",
+                        "project": {"name": "X"},
+                    },
+                },
+            },
+        )
+        assert verdict == "fire"
+        assert "Pull Request Created" in doc["message"]
+        assert "Add search" in doc["message"]
+        assert "refs/heads/feat" in doc["message"]
+        assert doc["user"] == "ada@x.test"
+        assert doc["thread"] == "42"
+        assert doc["platform"] == "azure-devops"
+
+    def test_azure_devops_pr_comment_relayed(self):
+        verdict, doc = normalize_platform_payload(
+            "azure-devops",
+            {
+                "eventType": "ms.vss-code.git.pullrequest-comment-event",
+                "message": {"text": "Ada commented on PR 42"},
+                "resource": {
+                    "comment": {
+                        "content": "@helix please fix the tests",
+                        "author": {"uniqueName": "ada@x.test"},
+                    },
+                    "pullRequest": {
+                        "pullRequestId": 42,
+                        "repository": {"name": "webapp"},
+                    },
+                },
+            },
+        )
+        assert verdict == "fire"
+        assert "@helix please fix the tests" in doc["message"]
+        assert "Reply to the user's message" in doc["message"]
+        assert doc["thread"] == "42"
+
+    def test_azure_devops_unknown_event_passes_raw_json(self):
+        verdict, doc = normalize_platform_payload(
+            "azure-devops",
+            {"eventType": "build.complete", "id": "evt9",
+             "resource": {"status": "succeeded"}},
+        )
+        assert verdict == "fire"
+        assert "build.complete" in doc["message"]
+        assert "succeeded" in doc["message"]
+
+    def test_crisp_user_text_fires(self):
+        verdict, doc = normalize_platform_payload(
+            "crisp",
+            {
+                "event": "message:send",
+                "data": {
+                    "type": "text", "from": "user",
+                    "content": "my invoice is wrong",
+                    "session_id": "session_abc",
+                    "website_id": "site_1",
+                    "user": {"nickname": "Bob"},
+                },
+            },
+        )
+        assert verdict == "fire"
+        assert doc["message"] == "my invoice is wrong"
+        assert doc["thread"] == "session_abc"
+        assert doc["user"] == "Bob"
+
+    def test_crisp_operator_and_non_text_ignored(self):
+        assert normalize_platform_payload(
+            "crisp",
+            {"event": "message:send",
+             "data": {"type": "text", "from": "operator",
+                      "content": "hi", "session_id": "s"}},
+        )[0] == "ignore"
+        assert normalize_platform_payload(
+            "crisp",
+            {"event": "message:send",
+             "data": {"type": "file", "from": "user",
+                      "session_id": "s"}},
+        )[0] == "ignore"
+        assert normalize_platform_payload(
+            "crisp", {"event": "session:set_state", "data": {}}
+        )[0] == "ignore"
+
     def test_plain_webhook_passthrough(self):
         verdict, doc = normalize_platform_payload("webhook", {"x": 1})
         assert verdict == "fire" and doc == {"x": 1}
