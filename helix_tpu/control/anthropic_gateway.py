@@ -246,6 +246,12 @@ class AnthropicGateway:
 
     def __init__(self, transport, session_factory=None):
         self.transport = transport
+        # Bedrock's invoke-with-response-stream emits AWS binary
+        # event-stream framing, not Anthropic SSE — callers must
+        # downgrade to non-stream and synthesize SSE themselves
+        self.supports_streaming = not isinstance(
+            transport, BedrockTransport
+        )
         self._session_factory = session_factory or (
             lambda: aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=600)

@@ -37,6 +37,32 @@ class EventBus:
         self._subs: list = []
         self._lock = threading.Lock()
         self._rr = itertools.count()
+        self._jetstream = None
+
+    def attach_jetstream(self, js) -> None:
+        """Make publishes durable: every publish also lands in whatever
+        JetStream streams match the topic (reference: the embedded NATS
+        server IS JetStream-enabled, ``pubsub/nats.go:39-60``).
+
+        Persistence runs on a dedicated writer thread — publish() is
+        called from async handlers, and a SQLite COMMIT (disk fsync) on
+        the event loop would stall every connection."""
+        self._jetstream = js
+        self._js_queue: "queue.Queue" = queue.Queue()
+
+        def writer():
+            while True:
+                topic, message = self._js_queue.get()
+                try:
+                    js.publish(topic, message)
+                except Exception:  # noqa: BLE001 — durability is best
+                    import traceback  # effort; live fanout already ran
+
+                    traceback.print_exc()
+
+        threading.Thread(
+            target=writer, daemon=True, name="jetstream-writer"
+        ).start()
 
     # -- core ----------------------------------------------------------------
     def subscribe(
@@ -55,6 +81,8 @@ class EventBus:
             self._subs = [s for s in self._subs if s.id != sub.id]
 
     def publish(self, topic: str, message: dict) -> int:
+        if self._jetstream is not None:
+            self._js_queue.put((topic, message))
         with self._lock:
             matching = [s for s in self._subs if fnmatch.fnmatch(topic, s.topic)]
         # queue groups: one delivery per group, round-robin

@@ -38,6 +38,49 @@ def _err(status, message, **extra):
     )
 
 
+def _anthropic_sse_events(doc: dict):
+    """Synthesize the Anthropic streaming event sequence from a complete
+    /v1/messages response (used when the upstream transport cannot
+    stream natively — Bedrock emits AWS event-stream framing, not SSE)."""
+    content = doc.get("content") or []
+    yield "message_start", {
+        "type": "message_start",
+        "message": {**doc, "content": []},
+    }
+    for i, block in enumerate(content):
+        btype = block.get("type", "text")
+        start_block = (
+            {"type": btype, "text": ""}
+            if btype == "text"
+            else {k: v for k, v in block.items()
+                  if k not in ("text", "thinking")}
+        )
+        yield "content_block_start", {
+            "type": "content_block_start", "index": i,
+            "content_block": start_block,
+        }
+        if btype == "text" and block.get("text"):
+            yield "content_block_delta", {
+                "type": "content_block_delta", "index": i,
+                "delta": {"type": "text_delta", "text": block["text"]},
+            }
+        elif btype == "thinking" and block.get("thinking"):
+            yield "content_block_delta", {
+                "type": "content_block_delta", "index": i,
+                "delta": {"type": "thinking_delta",
+                          "thinking": block["thinking"]},
+            }
+        yield "content_block_stop", {
+            "type": "content_block_stop", "index": i,
+        }
+    yield "message_delta", {
+        "type": "message_delta",
+        "delta": {"stop_reason": doc.get("stop_reason", "end_turn")},
+        "usage": doc.get("usage", {}),
+    }
+    yield "message_stop", {"type": "message_stop"}
+
+
 def tempfile_dir() -> str:
     import tempfile
 
@@ -317,6 +360,24 @@ class ControlPlane:
         from helix_tpu.control.triggers import TriggerManager
 
         self.bus = EventBus()
+        # durable event streams (embedded JetStream analogue): session
+        # and task lifecycle events survive restarts; consumers resume
+        from helix_tpu.control.jetstream import JetStream
+
+        js_path = (
+            ":memory:" if db_path == ":memory:" else db_path + ".events"
+        )
+        self.jetstream = JetStream(js_path)
+        self.jetstream.add_stream(
+            "SESSIONS", ["sessions.*", "sessions.*.*"], max_msgs=10000
+        )
+        self.jetstream.add_stream(
+            "TASKS", ["tasks.*", "spectasks.*"], max_msgs=10000
+        )
+        self.jetstream.add_stream(
+            "EVALS", ["evals.*"], max_msgs=10000
+        )
+        self.bus.attach_jetstream(self.jetstream)
         from helix_tpu.services.evals import EvalService
 
         self.evals = EvalService(self.store, self.controller, self.bus)
@@ -2062,6 +2123,24 @@ class ControlPlane:
                 "Anthropic upstream is configured",
                 available=self.router.available_models(),
             )
+        if body.get("stream") and not gw.supports_streaming:
+            # Bedrock upstream: run non-stream and synthesize Anthropic
+            # SSE so streaming clients still parse (AWS returns binary
+            # event-stream framing, not SSE)
+            status, doc = await gw.messages(body, stream=False)
+            if status != 200:
+                return web.json_response(doc, status=status)
+            resp = web.StreamResponse(
+                headers={"Content-Type": "text/event-stream"}
+            )
+            await resp.prepare(request)
+            for event, payload in _anthropic_sse_events(doc):
+                await resp.write(
+                    f"event: {event}\ndata: {json.dumps(payload)}\n\n"
+                    .encode()
+                )
+            await resp.write_eof()
+            return resp
         if body.get("stream"):
             res = await gw.messages(body, stream=True)
             if len(res) == 2:   # resolved to an error before streaming
@@ -2082,7 +2161,6 @@ class ControlPlane:
                 await resp.write_eof()
                 return resp
             finally:
-                upstream.release()
                 await session.close()
         status, doc = await gw.messages(body, stream=False)
         return web.json_response(doc, status=status)

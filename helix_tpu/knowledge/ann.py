@@ -1,0 +1,150 @@
+"""ANN index: ctypes binding for the native HNSW (native/hnsw).
+
+The reference's vector search runs in a VectorChord (pgvector-compatible)
+container with ANN indexes (SURVEY.md §2.5); here the durable store is
+SQLite (``vector_store.py``) and this module supplies the ANN
+acceleration natively.  Falls back to exact numpy search when the native
+library cannot build, so nothing above this layer has a hard native
+dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("helix.ann")
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+    "native", "hnsw",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhxhnsw.so")
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_LIB_PATH):
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR], check=True,
+                    capture_output=True,
+                )
+            lib = ctypes.CDLL(_LIB_PATH)
+        except Exception as e:  # noqa: BLE001 — fall back to numpy
+            log.warning("native HNSW unavailable (%s); using exact numpy", e)
+            _lib_failed = True
+            return None
+        lib.hx_hnsw_create.restype = ctypes.c_void_p
+        lib.hx_hnsw_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.hx_hnsw_destroy.argtypes = [ctypes.c_void_p]
+        lib.hx_hnsw_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.hx_hnsw_size.restype = ctypes.c_int
+        lib.hx_hnsw_size.argtypes = [ctypes.c_void_p]
+        lib.hx_hnsw_search.restype = ctypes.c_int
+        lib.hx_hnsw_search.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class HNSWIndex:
+    """Cosine ANN over pre-normalised float32 vectors.
+
+    ids are caller-assigned int64 (the vector store uses row positions).
+    """
+
+    def __init__(self, dim: int, M: int = 16, ef_construction: int = 100):
+        self.dim = dim
+        self._lib = _load()
+        self._handle = None
+        self._fallback_vecs: list = []
+        self._fallback_ids: list = []
+        if self._lib is not None:
+            self._handle = self._lib.hx_hnsw_create(dim, M, ef_construction)
+        self._mu = threading.Lock()
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_handle", None)
+        if lib is not None and h:
+            lib.hx_hnsw_destroy(h)
+
+    def __len__(self) -> int:
+        if self._handle:
+            return self._lib.hx_hnsw_size(self._handle)
+        return len(self._fallback_ids)
+
+    def add(self, idx: int, vec: np.ndarray) -> None:
+        v = np.ascontiguousarray(vec, np.float32)
+        n = float(np.linalg.norm(v))
+        if n > 0:
+            v = v / n
+        with self._mu:
+            if self._handle:
+                self._lib.hx_hnsw_add(
+                    self._handle, idx,
+                    v.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                )
+            else:
+                self._fallback_ids.append(idx)
+                self._fallback_vecs.append(v)
+
+    def add_batch(self, vecs: np.ndarray, start_id: int = 0) -> None:
+        for i, v in enumerate(vecs):
+            self.add(start_id + i, v)
+
+    def search(
+        self, query: np.ndarray, k: int, ef: int = 64
+    ) -> tuple:
+        """-> (ids[int64], scores[float32]) sorted by descending cosine."""
+        q = np.ascontiguousarray(query, np.float32).reshape(-1)
+        n = float(np.linalg.norm(q))
+        if n > 0:
+            q = q / n
+        if self._handle:
+            out_ids = np.zeros((k,), np.int64)
+            out_scores = np.zeros((k,), np.float32)
+            got = self._lib.hx_hnsw_search(
+                self._handle,
+                q.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                k, max(ef, k),
+                out_ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                out_scores.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            )
+            return out_ids[:got], out_scores[:got]
+        if not self._fallback_ids:
+            return np.zeros((0,), np.int64), np.zeros((0,), np.float32)
+        mat = np.stack(self._fallback_vecs)
+        scores = mat @ q
+        top = np.argsort(-scores)[:k]
+        return (
+            np.asarray(self._fallback_ids, np.int64)[top],
+            scores[top].astype(np.float32),
+        )

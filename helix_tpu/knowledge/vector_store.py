@@ -1,11 +1,13 @@
-"""Embedded vector store: SQLite rows + in-memory matmul search.
+"""Embedded vector store: SQLite rows + matmul / native-HNSW search.
 
 The reference delegates vector search to a VectorChord/pgvector container
 via the embedded kodit library (``SURVEY.md`` §2.5); this build keeps the
 control plane dependency-free: chunk text/metadata persist in SQLite,
-embeddings sit in a normalised fp32 matrix per collection, and search is
-one [N, D] @ [D] matmul — exact cosine, no ANN approximation error, easily
-fast enough up to hundreds of thousands of chunks (numpy BLAS), and the
+embeddings sit in a normalised fp32 matrix per collection.  Small
+collections search with one exact [N, D] @ [D] matmul; collections past
+``ANN_THRESHOLD`` build a native HNSW graph (``native/hnsw`` via
+``knowledge/ann.py`` — the VectorChord-ANN analogue) and search that,
+with the SQLite rows remaining the durable source of truth.  The
 interface (upsert/delete/query by collection) is pgvector-shaped so an
 external backend can slot in later.
 """
@@ -35,8 +37,15 @@ CREATE INDEX IF NOT EXISTS idx_chunks_collection ON chunks(collection, version);
 """
 
 
+import os
+
+# collections at/above this many chunks search via the native HNSW graph
+ANN_THRESHOLD = int(os.environ.get("HELIX_ANN_THRESHOLD", "5000"))
+
+
 class VectorStore:
-    def __init__(self, path: str = ":memory:"):
+    def __init__(self, path: str = ":memory:",
+                 ann_threshold: int = ANN_THRESHOLD):
         self._conn = sqlite3.connect(path, check_same_thread=False)
         self._lock = threading.Lock()
         with self._lock:
@@ -44,6 +53,9 @@ class VectorStore:
             self._conn.commit()
         # collection -> (ids, normalised matrix) cache
         self._cache: dict[str, tuple] = {}
+        # collection -> HNSWIndex over the cached matrix's row positions
+        self._ann: dict[str, object] = {}
+        self.ann_threshold = ann_threshold
 
     # ------------------------------------------------------------------
     def upsert(
@@ -71,6 +83,7 @@ class VectorStore:
                 )
             self._conn.commit()
             self._cache.pop(collection, None)
+            self._ann.pop(collection, None)
         return ids
 
     def delete_collection(self, collection: str) -> int:
@@ -80,6 +93,7 @@ class VectorStore:
             )
             self._conn.commit()
             self._cache.pop(collection, None)
+            self._ann.pop(collection, None)
             return cur.rowcount
 
     def delete_versions_below(self, collection: str, version: int) -> int:
@@ -92,6 +106,7 @@ class VectorStore:
             )
             self._conn.commit()
             self._cache.pop(collection, None)
+            self._ann.pop(collection, None)
             return cur.rowcount
 
     def count(self, collection: str) -> int:
@@ -115,21 +130,51 @@ class VectorStore:
             cached = self._cache.get(collection)
             if cached is not None:
                 return cached
-            rows = self._conn.execute(
-                "SELECT id, embedding, dim FROM chunks WHERE collection=?",
-                (collection,),
-            ).fetchall()
-            if not rows:
-                self._cache[collection] = ([], None)
-                return [], None
-            ids = [r[0] for r in rows]
-            mat = np.stack(
-                [np.frombuffer(r[1], np.float32, count=r[2]) for r in rows]
-            )
-            norms = np.linalg.norm(mat, axis=1, keepdims=True)
-            mat = mat / np.maximum(norms, 1e-9)
-            self._cache[collection] = (ids, mat)
-            return ids, mat
+            return self._load_matrix_locked(collection)
+
+    def _load_matrix_locked(self, collection: str):
+        """Caller holds self._lock."""
+        rows = self._conn.execute(
+            "SELECT id, embedding, dim FROM chunks WHERE collection=?",
+            (collection,),
+        ).fetchall()
+        if not rows:
+            self._cache[collection] = ([], None)
+            return [], None
+        ids = [r[0] for r in rows]
+        mat = np.stack(
+            [np.frombuffer(r[1], np.float32, count=r[2]) for r in rows]
+        )
+        norms = np.linalg.norm(mat, axis=1, keepdims=True)
+        mat = mat / np.maximum(norms, 1e-9)
+        self._cache[collection] = (ids, mat)
+        return ids, mat
+
+    def _snapshot(self, collection: str):
+        """One consistent (ids, mat, ann_index_or_None) snapshot under a
+        single lock hold — pairing an ANN graph built over an OLD matrix
+        with NEW ids would silently return wrong chunks, so the graph is
+        built and stored under the same lock acquisition that read the
+        cache entry it indexes."""
+        from helix_tpu.knowledge import ann as _ann
+
+        with self._lock:
+            cached = self._cache.get(collection)
+            if cached is None:
+                cached = self._load_matrix_locked(collection)
+            ids, mat = cached
+            index = None
+            if (
+                mat is not None
+                and len(ids) >= self.ann_threshold
+                and _ann.native_available()
+            ):
+                index = self._ann.get(collection)
+                if index is None:
+                    index = _ann.HNSWIndex(mat.shape[1])
+                    index.add_batch(mat)     # row position == ANN id
+                    self._ann[collection] = index
+            return ids, mat, index
 
     def query(
         self,
@@ -138,29 +183,40 @@ class VectorStore:
         top_k: int = 5,
         min_score: float = 0.0,
     ) -> list:
-        """-> [{id, text, meta, score}] by cosine similarity."""
-        ids, mat = self._matrix(collection)
+        """-> [{id, text, meta, score}] by cosine similarity — exact
+        matmul for small collections, native HNSW past ann_threshold
+        (exact always when the native library is unavailable: the numpy
+        fallback inside HNSWIndex would be strictly slower than the
+        cached-matrix matmul)."""
+        ids, mat, index = self._snapshot(collection)
         if mat is None:
             return []
         q = np.asarray(embedding, np.float32).reshape(-1)
         q = q / max(np.linalg.norm(q), 1e-9)
-        scores = mat @ q
         k = min(top_k, len(ids))
-        top = np.argsort(-scores)[:k]
+        if index is not None:
+            rows, scores_arr = index.search(q, k)
+            ranked = list(zip(rows.tolist(), scores_arr.tolist()))
+        else:
+            scores = mat @ q
+            top = np.argsort(-scores)[:k]
+            ranked = [(int(i), float(scores[i])) for i in top]
         out = []
         with self._lock:
-            for i in top:
-                if scores[i] < min_score:
+            for i, score in ranked:
+                if score < min_score:
                     continue
                 row = self._conn.execute(
                     "SELECT text, meta FROM chunks WHERE id=?", (ids[i],)
                 ).fetchone()
+                if row is None:   # deleted between snapshot and fetch
+                    continue
                 out.append(
                     {
                         "id": ids[i],
                         "text": row[0],
                         "meta": json.loads(row[1]),
-                        "score": float(scores[i]),
+                        "score": score,
                     }
                 )
         return out

@@ -1,0 +1,219 @@
+// helix-tpu native ANN index: HNSW over inner-product (cosine on
+// pre-normalised vectors).
+//
+// Role in the stack: the reference delegates vector search to a
+// VectorChord/pgvector container (SURVEY.md §2.5 "Kodit RAG", backing DB
+// `vectorchord-kodit`); this build keeps the control plane self-contained
+// and supplies the ANN path natively — SQLite stays the durable store,
+// this graph is the in-memory search accelerator rebuilt from it.
+//
+// Classic HNSW (Malkov & Yashunin): layered proximity graph; greedy
+// descent through upper layers, beam search (ef) at layer 0; neighbour
+// lists pruned to M by distance. Single-writer, multi-reader safe: adds
+// take the write path under the caller's lock (python side), searches are
+// read-only.
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <vector>
+
+namespace {
+
+struct Node {
+  std::vector<float> vec;
+  int64_t id;
+  int level;
+  // neighbours[l] = ids (indexes into nodes) at layer l
+  std::vector<std::vector<int>> neighbours;
+};
+
+struct Index {
+  int dim;
+  int M;              // max neighbours per layer (2*M at layer 0)
+  int ef_construction;
+  double level_mult;
+  int entry = -1;     // index of entry point node
+  int max_level = -1;
+  std::vector<Node> nodes;
+  std::mt19937 rng{42};
+
+  float dot(const float* a, const float* b) const {
+    float s = 0.f;
+    for (int i = 0; i < dim; ++i) s += a[i] * b[i];
+    return s;
+  }
+  // distance = negative similarity (smaller is closer)
+  float dist(const float* a, const float* b) const { return -dot(a, b); }
+
+  int random_level() {
+    std::uniform_real_distribution<double> u(0.0, 1.0);
+    double r = u(rng);
+    if (r < 1e-12) r = 1e-12;
+    int l = static_cast<int>(-std::log(r) * level_mult);
+    return l;
+  }
+
+  // beam search at one layer from start; returns up to ef closest
+  // (dist, node) pairs, sorted ascending by dist.
+  std::vector<std::pair<float, int>> search_layer(
+      const float* q, int start, int layer, int ef) const {
+    std::vector<char> visited(nodes.size(), 0);
+    // max-heap of worst-in-result on top
+    std::priority_queue<std::pair<float, int>> result;
+    // min-heap of candidates (negated dist in a max-heap)
+    std::priority_queue<std::pair<float, int>> candidates;
+    float d0 = dist(q, nodes[start].vec.data());
+    visited[start] = 1;
+    result.push({d0, start});
+    candidates.push({-d0, start});
+    while (!candidates.empty()) {
+      auto [negd, c] = candidates.top();
+      candidates.pop();
+      if (-negd > result.top().first) break;  // best candidate worse than
+                                              // worst result: done
+      for (int nb : nodes[c].neighbours[layer]) {
+        if (visited[nb]) continue;
+        visited[nb] = 1;
+        float d = dist(q, nodes[nb].vec.data());
+        if (static_cast<int>(result.size()) < ef ||
+            d < result.top().first) {
+          candidates.push({-d, nb});
+          result.push({d, nb});
+          if (static_cast<int>(result.size()) > ef) result.pop();
+        }
+      }
+    }
+    std::vector<std::pair<float, int>> out;
+    out.reserve(result.size());
+    while (!result.empty()) {
+      out.push_back(result.top());
+      result.pop();
+    }
+    std::sort(out.begin(), out.end());
+    return out;
+  }
+
+  // shrink a candidate neighbour set to at most m by plain closest-first
+  void prune(std::vector<int>& nbrs, const float* base, int m) {
+    if (static_cast<int>(nbrs.size()) <= m) return;
+    std::sort(nbrs.begin(), nbrs.end(), [&](int a, int b) {
+      return dist(base, nodes[a].vec.data()) <
+             dist(base, nodes[b].vec.data());
+    });
+    nbrs.resize(m);
+  }
+
+  void add(int64_t id, const float* v) {
+    Node n;
+    n.vec.assign(v, v + dim);
+    n.id = id;
+    n.level = nodes.empty() ? 0 : random_level();
+    n.neighbours.assign(n.level + 1, {});
+    int idx = static_cast<int>(nodes.size());
+    nodes.push_back(std::move(n));
+    Node& node = nodes[idx];
+
+    if (entry < 0) {
+      entry = idx;
+      max_level = node.level;
+      return;
+    }
+    int cur = entry;
+    // greedy descent through layers above the node's level
+    for (int l = max_level; l > node.level; --l) {
+      bool improved = true;
+      while (improved) {
+        improved = false;
+        for (int nb : nodes[cur].neighbours[l]) {
+          if (dist(node.vec.data(), nodes[nb].vec.data()) <
+              dist(node.vec.data(), nodes[cur].vec.data())) {
+            cur = nb;
+            improved = true;
+          }
+        }
+      }
+    }
+    // connect at each layer from min(level, max_level) down to 0
+    for (int l = std::min(node.level, max_level); l >= 0; --l) {
+      auto cands =
+          search_layer(node.vec.data(), cur, l, ef_construction);
+      int m = (l == 0) ? 2 * M : M;
+      std::vector<int> sel;
+      for (auto& [d, c] : cands) {
+        sel.push_back(c);
+        if (static_cast<int>(sel.size()) >= m) break;
+      }
+      node.neighbours[l] = sel;
+      for (int nb : sel) {
+        auto& back = nodes[nb].neighbours[l];
+        back.push_back(idx);
+        prune(back, nodes[nb].vec.data(), m);
+      }
+      if (!cands.empty()) cur = cands.front().second;
+    }
+    if (node.level > max_level) {
+      max_level = node.level;
+      entry = idx;
+    }
+  }
+
+  int search(const float* q, int k, int ef, int64_t* out_ids,
+             float* out_scores) const {
+    if (entry < 0) return 0;
+    int cur = entry;
+    for (int l = max_level; l > 0; --l) {
+      bool improved = true;
+      while (improved) {
+        improved = false;
+        for (int nb : nodes[cur].neighbours[l]) {
+          if (dist(q, nodes[nb].vec.data()) <
+              dist(q, nodes[cur].vec.data())) {
+            cur = nb;
+            improved = true;
+          }
+        }
+      }
+    }
+    auto res = search_layer(q, cur, 0, std::max(ef, k));
+    int n = std::min<int>(k, res.size());
+    for (int i = 0; i < n; ++i) {
+      out_ids[i] = nodes[res[i].second].id;
+      out_scores[i] = -res[i].first;  // back to similarity
+    }
+    return n;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hx_hnsw_create(int dim, int M, int ef_construction) {
+  auto* ix = new Index();
+  ix->dim = dim;
+  ix->M = M;
+  ix->ef_construction = ef_construction;
+  ix->level_mult = 1.0 / std::log(static_cast<double>(M));
+  return ix;
+}
+
+void hx_hnsw_destroy(void* h) { delete static_cast<Index*>(h); }
+
+void hx_hnsw_add(void* h, int64_t id, const float* vec) {
+  static_cast<Index*>(h)->add(id, vec);
+}
+
+int hx_hnsw_size(void* h) {
+  return static_cast<int>(static_cast<Index*>(h)->nodes.size());
+}
+
+int hx_hnsw_search(void* h, const float* q, int k, int ef,
+                   int64_t* out_ids, float* out_scores) {
+  return static_cast<Index*>(h)->search(q, k, ef, out_ids, out_scores);
+}
+
+}  // extern "C"

@@ -1,0 +1,93 @@
+"""Native HNSW ANN index + vector-store integration.
+
+Reference: the VectorChord/pgvector ANN backend the knowledge stack
+delegates to (SURVEY.md §2.5). Here ANN is the native ``native/hnsw``
+graph behind ctypes; these tests check recall against exact search and
+the exact->ANN switchover in the vector store.
+"""
+
+import numpy as np
+import pytest
+
+from helix_tpu.knowledge.ann import HNSWIndex, native_available
+from helix_tpu.knowledge.vector_store import VectorStore
+
+
+def _vectors(n, d, seed=0):
+    rng = np.random.default_rng(seed)
+    v = rng.standard_normal((n, d)).astype(np.float32)
+    return v / np.linalg.norm(v, axis=1, keepdims=True)
+
+
+class TestHNSW:
+    def test_native_builds(self):
+        assert native_available(), "native HNSW failed to build"
+
+    def test_exact_hit_on_identical_vector(self):
+        vecs = _vectors(200, 32)
+        ix = HNSWIndex(32)
+        ix.add_batch(vecs)
+        ids, scores = ix.search(vecs[17], k=1)
+        assert ids[0] == 17
+        assert scores[0] == pytest.approx(1.0, abs=1e-5)
+
+    def test_recall_at_10_vs_exact(self):
+        """>= 90% of exact top-10 recovered over 2000 random vectors."""
+        vecs = _vectors(2000, 64, seed=1)
+        ix = HNSWIndex(64)
+        ix.add_batch(vecs)
+        queries = _vectors(20, 64, seed=2)
+        recalls = []
+        for q in queries:
+            exact = set(np.argsort(-(vecs @ q))[:10].tolist())
+            got, _ = ix.search(q, k=10, ef=128)
+            recalls.append(len(exact & set(got.tolist())) / 10)
+        assert float(np.mean(recalls)) >= 0.9
+
+    def test_scores_descend(self):
+        vecs = _vectors(500, 16, seed=3)
+        ix = HNSWIndex(16)
+        ix.add_batch(vecs)
+        _, scores = ix.search(_vectors(1, 16, seed=4)[0], k=8)
+        assert all(
+            scores[i] >= scores[i + 1] - 1e-6
+            for i in range(len(scores) - 1)
+        )
+
+    def test_empty_index(self):
+        ix = HNSWIndex(8)
+        ids, scores = ix.search(np.ones(8, np.float32), k=3)
+        assert len(ids) == 0
+
+
+class TestVectorStoreANN:
+    def test_switchover_uses_ann_and_matches_exact_top1(self):
+        store = VectorStore(ann_threshold=50)
+        vecs = _vectors(120, 24, seed=5)
+        store.upsert(
+            "c", [f"t{i}" for i in range(120)], vecs,
+        )
+        # past threshold: ANN path
+        hits = store.query("c", vecs[42], top_k=3)
+        assert hits[0]["text"] == "t42"
+        assert "c" in store._ann
+        # upsert invalidates the graph
+        store.upsert("c", ["extra"], _vectors(1, 24, seed=6))
+        assert "c" not in store._ann
+        hits = store.query("c", vecs[42], top_k=1)
+        assert hits[0]["text"] == "t42"
+
+    def test_below_threshold_stays_exact(self):
+        store = VectorStore(ann_threshold=1000)
+        vecs = _vectors(20, 8, seed=7)
+        store.upsert("c", [f"t{i}" for i in range(20)], vecs)
+        hits = store.query("c", vecs[3], top_k=2)
+        assert hits[0]["text"] == "t3"
+        assert "c" not in store._ann
+
+    def test_min_score_filter_still_applies(self):
+        store = VectorStore(ann_threshold=10)
+        vecs = _vectors(30, 8, seed=8)
+        store.upsert("c", [f"t{i}" for i in range(30)], vecs)
+        hits = store.query("c", vecs[0], top_k=5, min_score=0.999)
+        assert [h["text"] for h in hits] == ["t0"]
