@@ -244,6 +244,35 @@ def _cmd_knowledge(args) -> int:
     return 0
 
 
+def _cmd_operator(args) -> int:
+    """K8s operator: reconcile AIApp CRs into control-plane apps
+    (reference: operator/ kubebuilder controller)."""
+    import os
+    import time as _time
+
+    from helix_tpu.services.k8s_operator import AIAppReconciler, K8sClient
+
+    if args.kubeconfig_url:
+        k8s = K8sClient(args.kubeconfig_url, token=args.k8s_token)
+    else:
+        k8s = K8sClient.in_cluster()
+    rec = AIAppReconciler(
+        k8s,
+        helix_url=args.api or os.environ.get(
+            "HELIX_API_URL", "http://localhost:8080"
+        ),
+        helix_token=os.environ.get("HELIX_API_TOKEN", ""),
+        resync_interval=args.resync,
+    ).start()
+    print("operator running (ctrl-c to stop)")
+    try:
+        while True:
+            _time.sleep(3600)
+    except KeyboardInterrupt:
+        rec.stop()
+    return 0
+
+
 def _cmd_evals(args) -> int:
     """Evaluation suites/runs (reference: the `evals` verb,
     api/cmd/helix/evals.go, + suite/run routes server.go:1058-1067)."""
@@ -613,6 +642,16 @@ def main(argv=None) -> int:
     kd = ksub.add_parser("delete", parents=[api_flags])
     kd.add_argument("id")
     k.set_defaults(fn=_cmd_knowledge)
+
+    op = sub.add_parser(
+        "operator", help="K8s operator: reconcile AIApp CRs into apps"
+    )
+    op.add_argument("--api", default="", help="control plane URL")
+    op.add_argument("--kubeconfig-url", default="",
+                    help="K8s API URL (empty = in-cluster config)")
+    op.add_argument("--k8s-token", default="")
+    op.add_argument("--resync", type=float, default=30.0)
+    op.set_defaults(fn=_cmd_operator)
 
     ev = sub.add_parser("evals", help="evaluate an app with a test suite")
     evsub = ev.add_subparsers(dest="action", required=True)

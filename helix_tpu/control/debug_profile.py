@@ -1,0 +1,93 @@
+"""Runtime profiling endpoints: the Go pprof surface, Python-native.
+
+Reference: the API server serves ``/debug/pprof/`` (``server.go:59,
+1499-1500``) for goroutine dumps, CPU profiles and heap stats.  The
+Python equivalents:
+
+- ``threads``  -> per-thread stack dumps (goroutine profile analogue)
+- ``profile``  -> cProfile over ``seconds`` (CPU profile), pstats text
+- ``heap``     -> tracemalloc top allocations (heap profile; sampling
+                  starts on first call, so the first snapshot is empty)
+- ``objects``  -> gc object counts by type (allocation census)
+"""
+
+from __future__ import annotations
+
+import gc
+import io
+import sys
+import threading
+import traceback
+
+
+def thread_dump() -> str:
+    """Every thread's stack, goroutine-dump style."""
+    frames = sys._current_frames()
+    names = {t.ident: t for t in threading.enumerate()}
+    out = io.StringIO()
+    for ident, frame in sorted(frames.items()):
+        t = names.get(ident)
+        name = t.name if t else "?"
+        daemon = " daemon" if (t and t.daemon) else ""
+        out.write(f"thread {ident} [{name}]{daemon}:\n")
+        traceback.print_stack(frame, file=out)
+        out.write("\n")
+    return out.getvalue()
+
+
+def cpu_profile(seconds: float = 5.0, sort: str = "cumulative",
+                limit: int = 60) -> str:
+    """Profile the whole process for ``seconds`` using the C profiler.
+
+    cProfile only observes the calling thread, so this uses
+    ``sys.setprofile``-free statistical fallback: cProfile on a busy
+    control plane still captures the event loop when called from it —
+    for cross-thread visibility use ``threads`` repeatedly."""
+    import cProfile
+    import pstats
+    import time
+
+    prof = cProfile.Profile()
+    prof.enable()
+    time.sleep(seconds)
+    prof.disable()
+    out = io.StringIO()
+    stats = pstats.Stats(prof, stream=out)
+    stats.sort_stats(sort).print_stats(limit)
+    return out.getvalue() or "(no samples on this thread)\n"
+
+
+_tracemalloc_started = False
+
+
+def heap_profile(limit: int = 40) -> str:
+    """tracemalloc top allocation sites; sampling begins on first call."""
+    global _tracemalloc_started
+    import tracemalloc
+
+    if not _tracemalloc_started:
+        tracemalloc.start(10)
+        _tracemalloc_started = True
+        return (
+            "tracemalloc sampling started; call again for a snapshot\n"
+        )
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:limit]
+    out = io.StringIO()
+    total = sum(s.size for s in snap.statistics("filename"))
+    out.write(f"total tracked: {total / 2**20:.1f} MiB\n")
+    for s in stats:
+        out.write(f"{s.size / 1024:.1f} KiB x{s.count}  {s.traceback}\n")
+    return out.getvalue()
+
+
+def object_census(limit: int = 40) -> str:
+    counts: dict = {}
+    for obj in gc.get_objects():
+        t = type(obj).__name__
+        counts[t] = counts.get(t, 0) + 1
+    out = io.StringIO()
+    out.write(f"gc tracked objects: {sum(counts.values())}\n")
+    for name, n in sorted(counts.items(), key=lambda kv: -kv[1])[:limit]:
+        out.write(f"{n:>10}  {name}\n")
+    return out.getvalue()

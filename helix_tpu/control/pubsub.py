@@ -46,23 +46,30 @@ class EventBus:
 
         Persistence runs on a dedicated writer thread — publish() is
         called from async handlers, and a SQLite COMMIT (disk fsync) on
-        the event loop would stall every connection."""
+        the event loop would stall every connection.  Queue + thread are
+        set up BEFORE the attach becomes visible to publishers, and a
+        re-attach just swaps the target (the writer reads
+        ``self._jetstream`` per message) instead of leaking a thread."""
+        if getattr(self, "_js_queue", None) is None:
+            self._js_queue: "queue.Queue" = queue.Queue()
+
+            def writer():
+                while True:
+                    topic, message = self._js_queue.get()
+                    target = self._jetstream
+                    if target is None:
+                        continue
+                    try:
+                        target.publish(topic, message)
+                    except Exception:  # noqa: BLE001 — durability is
+                        import traceback  # best effort; fanout already ran
+
+                        traceback.print_exc()
+
+            threading.Thread(
+                target=writer, daemon=True, name="jetstream-writer"
+            ).start()
         self._jetstream = js
-        self._js_queue: "queue.Queue" = queue.Queue()
-
-        def writer():
-            while True:
-                topic, message = self._js_queue.get()
-                try:
-                    js.publish(topic, message)
-                except Exception:  # noqa: BLE001 — durability is best
-                    import traceback  # effort; live fanout already ran
-
-                    traceback.print_exc()
-
-        threading.Thread(
-            target=writer, daemon=True, name="jetstream-writer"
-        ).start()
 
     # -- core ----------------------------------------------------------------
     def subscribe(

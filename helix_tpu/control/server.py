@@ -49,12 +49,23 @@ def _anthropic_sse_events(doc: dict):
     }
     for i, block in enumerate(content):
         btype = block.get("type", "text")
-        start_block = (
-            {"type": btype, "text": ""}
-            if btype == "text"
-            else {k: v for k, v in block.items()
-                  if k not in ("text", "thinking")}
-        )
+        if btype == "text":
+            start_block = {"type": "text", "text": ""}
+        elif btype == "tool_use":
+            # streaming contract: input starts empty and arrives via
+            # input_json_delta partial_json — clients JSON-parse the
+            # accumulated buffer at content_block_stop
+            start_block = {
+                "type": "tool_use",
+                "id": block.get("id", ""),
+                "name": block.get("name", ""),
+                "input": {},
+            }
+        else:
+            start_block = {
+                k: v for k, v in block.items()
+                if k not in ("text", "thinking")
+            }
         yield "content_block_start", {
             "type": "content_block_start", "index": i,
             "content_block": start_block,
@@ -63,6 +74,14 @@ def _anthropic_sse_events(doc: dict):
             yield "content_block_delta", {
                 "type": "content_block_delta", "index": i,
                 "delta": {"type": "text_delta", "text": block["text"]},
+            }
+        elif btype == "tool_use":
+            yield "content_block_delta", {
+                "type": "content_block_delta", "index": i,
+                "delta": {
+                    "type": "input_json_delta",
+                    "partial_json": json.dumps(block.get("input", {})),
+                },
             }
         elif btype == "thinking" and block.get("thinking"):
             yield "content_block_delta", {
@@ -275,7 +294,37 @@ class ControlPlane:
 
             return emit, close
 
-        if external_agent_argv:
+        # external runners over WebSocket (reference: the external-agent
+        # runner WS pattern, server.go:798 + serve.go:305-307): remote
+        # agent processes register and receive kanban work; code syncs
+        # through the internal git smart-HTTP server, not a shared FS
+        from helix_tpu.services.ws_runner import (
+            WSRunnerExecutor,
+            WSRunnerRegistry,
+        )
+
+        self.ws_runners = WSRunnerRegistry()
+        self.public_url = _os_env.environ.get(
+            "HELIX_PUBLIC_URL", "http://localhost:8080"
+        ).rstrip("/")
+
+        if _os_env.environ.get("HELIX_EXECUTOR", "") == "ws":
+            def _git_url(task, mode):
+                repo = task.project
+                if not self.git.repo_exists(repo):
+                    self.git.create_repo(repo)
+                branch = (
+                    task.spec_branch if mode == "plan" else task.task_branch
+                )
+                return f"{self.public_url}/git/{repo}", branch
+
+            executor = WSRunnerExecutor(
+                self.ws_runners,
+                _git_url,
+                agent=_os_env.environ.get("HELIX_WS_AGENT") or None,
+                on_log=lambda tid, text: None,
+            )
+        elif external_agent_argv:
             # third-party coding agent (Claude Code / Zed / any ACP CLI)
             # in the process sandbox — the reference's hydra external-agent
             # path (``external-agent/hydra_executor.go:130-569``)
@@ -368,16 +417,23 @@ class ControlPlane:
             ":memory:" if db_path == ":memory:" else db_path + ".events"
         )
         self.jetstream = JetStream(js_path)
+        # (fnmatch "*" crosses dots, so one pattern per stream suffices)
         self.jetstream.add_stream(
-            "SESSIONS", ["sessions.*", "sessions.*.*"], max_msgs=10000
+            "SESSIONS", ["sessions.*"], max_msgs=10000
         )
         self.jetstream.add_stream(
-            "TASKS", ["tasks.*", "spectasks.*"], max_msgs=10000
+            "TASKS", ["spectasks.*"], max_msgs=10000
         )
         self.jetstream.add_stream(
             "EVALS", ["evals.*"], max_msgs=10000
         )
         self.bus.attach_jetstream(self.jetstream)
+        # kanban lifecycle -> durable TASKS stream
+        self.task_store.on_update = lambda t: self.bus.publish(
+            f"spectasks.{t.id}",
+            {"task_id": t.id, "project": t.project, "status": t.status,
+             "error": t.error},
+        )
         from helix_tpu.services.evals import EvalService
 
         self.evals = EvalService(self.store, self.controller, self.bus)
@@ -759,6 +815,13 @@ class ControlPlane:
         r.add_delete("/api/v1/desktops/{id}", self.delete_desktop)
         r.add_get("/api/v1/desktops/{id}/ws/stream", self.ws_desktop_stream)
         r.add_get("/api/v1/desktops/{id}/ws/input", self.ws_desktop_input)
+        # pprof-equivalent debug surface (reference: /debug/pprof/,
+        # server.go:59,1499-1500) — admin-gated when auth is on
+        r.add_get("/debug/pprof/{kind}", self.debug_pprof)
+        # external WS runners + editor agent sync
+        r.add_get("/ws/external-runner", self.ws_external_runner)
+        r.add_get("/api/v1/external-runners", self.list_external_runners)
+        r.add_get("/api/v1/external-agents/sync", self.ws_agent_sync)
         # openai passthrough (+ native Anthropic /v1/messages: served
         # models dispatch to runners; unknown models proxy upstream via
         # the direct/Vertex/Bedrock gateway — reference anthropic_proxy.go)
@@ -1933,6 +1996,163 @@ class ControlPlane:
         finally:
             for s in subs:
                 s.unsubscribe()
+        return ws
+
+    async def debug_pprof(self, request):
+        """Runtime profiles (reference: Go pprof at /debug/pprof/)."""
+        from helix_tpu.control import debug_profile as dp
+
+        user = request.get("user")
+        if self.auth_required and not (user and user.admin):
+            return _err(403, "admin only")
+        kind = request.match_info["kind"]
+        loop = asyncio.get_event_loop()
+        if kind == "threads":
+            text = dp.thread_dump()
+        elif kind == "profile":
+            seconds = min(float(request.query.get("seconds", 5)), 60.0)
+            text = await loop.run_in_executor(
+                None, dp.cpu_profile, seconds
+            )
+        elif kind == "heap":
+            text = await loop.run_in_executor(None, dp.heap_profile)
+        elif kind == "objects":
+            text = await loop.run_in_executor(None, dp.object_census)
+        else:
+            return _err(
+                404,
+                "unknown profile; have threads|profile|heap|objects",
+            )
+        return web.Response(text=text, content_type="text/plain")
+
+    # -- external WS runners ---------------------------------------------------
+    async def ws_external_runner(self, request):
+        """External agent runner connection (reference: the
+        /ws/external-agent-runner endpoint, server.go:798): the runner
+        registers, then receives task frames and streams results back."""
+        import asyncio as _asyncio
+
+        from helix_tpu.services.ws_runner import WSRunner
+
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        loop = _asyncio.get_running_loop()
+        name = None
+        runner_obj = None
+        try:
+            first = await ws.receive_json(timeout=30)
+            if first.get("type") != "register" or not first.get("name"):
+                await ws.close(code=4000, message=b"register first")
+                return ws
+
+            def send(frame: dict) -> None:
+                # called from the orchestrator thread
+                fut = _asyncio.run_coroutine_threadsafe(
+                    ws.send_json(frame), loop
+                )
+                fut.result(timeout=10)
+
+            name = first["name"]
+            runner_obj = WSRunner(
+                name=name,
+                agent=first.get("agent", ""),
+                send_fn=send,
+                concurrency=int(first.get("concurrency", 1)),
+            )
+            self.ws_runners.register(runner_obj)
+
+            def on_log(tid, text):
+                self.bus.publish(
+                    "external-runner.log",
+                    {"runner": name, "task_id": tid, "text": text},
+                )
+
+            async for msg in ws:
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    continue
+                try:
+                    frame = json.loads(msg.data)
+                except ValueError:
+                    continue
+                self.ws_runners.handle_frame(name, frame, on_log=on_log)
+        except (_asyncio.TimeoutError, TypeError, ValueError):
+            pass
+        finally:
+            if name and runner_obj is not None:
+                # only remove the registry entry if it is still THIS
+                # connection — a reconnect under the same name must not
+                # be evicted by the stale socket's late cleanup
+                self.ws_runners.unregister(name, expected=runner_obj)
+        return ws
+
+    async def list_external_runners(self, request):
+        return web.json_response({"runners": self.ws_runners.list()})
+
+    async def ws_agent_sync(self, request):
+        """Bidirectional session bridge for editor-embedded agents
+        (reference: /external-agents/sync 'Zed agent bidirectional
+        communication', server.go:1182): the editor joins a session,
+        sends user chat, and receives the session's event stream."""
+        import asyncio as _asyncio
+
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
+        sid = request.query.get("session_id", "")
+        session = self.store.get_session(sid) if sid else None
+        if session is None:
+            await ws.close(code=4004, message=b"unknown session")
+            return ws
+        loop = _asyncio.get_running_loop()
+        q: _asyncio.Queue = _asyncio.Queue()
+        owner = session.get("owner", "anonymous")
+        sub = self.bus.subscribe(
+            f"sessions.{owner}.*",
+            lambda t, m: loop.call_soon_threadsafe(
+                q.put_nowait, {"topic": t, "data": m}
+            ),
+        )
+
+        async def pump_events():
+            while not ws.closed:
+                try:
+                    ev = await _asyncio.wait_for(q.get(), timeout=5)
+                except _asyncio.TimeoutError:
+                    continue
+                try:
+                    await ws.send_json(ev)
+                except ConnectionResetError:
+                    return
+
+        pump = _asyncio.ensure_future(pump_events())
+        try:
+            async for msg in ws:
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    continue
+                try:
+                    frame = json.loads(msg.data)
+                except ValueError:
+                    continue
+                if frame.get("type") == "chat" and frame.get("text"):
+                    resp = await self.controller.chat(
+                        [{"role": "user", "content": frame["text"]}],
+                        user=owner, session_id=sid,
+                        app_id=session.get("doc", {}).get("app_id"),
+                    )
+                    await ws.send_json(
+                        {
+                            "type": "reply",
+                            "text": resp["choices"][0]["message"][
+                                "content"
+                            ],
+                        }
+                    )
+                    self.bus.publish(
+                        f"sessions.{owner}.updated",
+                        {"session_id": sid, "event": "interaction"},
+                    )
+        finally:
+            pump.cancel()
+            sub.unsubscribe()
         return ws
 
     # -- desktop streaming ------------------------------------------------------

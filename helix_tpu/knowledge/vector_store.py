@@ -151,11 +151,15 @@ class VectorStore:
         return ids, mat
 
     def _snapshot(self, collection: str):
-        """One consistent (ids, mat, ann_index_or_None) snapshot under a
-        single lock hold — pairing an ANN graph built over an OLD matrix
-        with NEW ids would silently return wrong chunks, so the graph is
-        built and stored under the same lock acquisition that read the
-        cache entry it indexes."""
+        """One consistent (ids, mat, ann_index_or_None) snapshot.
+
+        Consistency: the returned graph is always built over the returned
+        matrix (pairing an old graph with new ids would return wrong
+        chunks).  The build itself — thousands of ctypes inserts, and
+        possibly a first-use ``make`` — runs OUTSIDE the store lock so it
+        cannot freeze every other collection's queries; the finished
+        graph is only installed in the shared cache if the matrix it
+        indexes is still the current one."""
         from helix_tpu.knowledge import ann as _ann
 
         with self._lock:
@@ -163,18 +167,23 @@ class VectorStore:
             if cached is None:
                 cached = self._load_matrix_locked(collection)
             ids, mat = cached
-            index = None
-            if (
-                mat is not None
+            index = self._ann.get(collection)
+            need_build = (
+                index is None
+                and mat is not None
                 and len(ids) >= self.ann_threshold
-                and _ann.native_available()
-            ):
-                index = self._ann.get(collection)
-                if index is None:
-                    index = _ann.HNSWIndex(mat.shape[1])
-                    index.add_batch(mat)     # row position == ANN id
+            )
+        if need_build and _ann.native_available():
+            index = _ann.HNSWIndex(mat.shape[1])
+            index.add_batch(mat)             # row position == ANN id
+            with self._lock:
+                cur = self._cache.get(collection)
+                if cur is not None and cur[1] is mat:
                     self._ann[collection] = index
-            return ids, mat, index
+                # else: the collection changed mid-build — the graph
+                # still matches OUR (ids, mat) snapshot, so this query
+                # uses it; the next query rebuilds over fresh data
+        return ids, mat, index
 
     def query(
         self,

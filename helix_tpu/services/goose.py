@@ -1,0 +1,129 @@
+"""Goose recipe parsing + parameter substitution.
+
+Reference: ``api/pkg/goose/recipe.go`` — parse Block's Goose recipe YAML
+just enough to (1) list declared parameters for the task-creation UI,
+(2) substitute provided values Jinja-style (``{{ var }}``), and
+(3) reject obviously bogus recipes (no version / malformed YAML).
+Unknown variables and complex expressions are left intact for goose's
+own Jinja evaluator at agent runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Optional
+
+import yaml
+
+_VAR_RE = re.compile(r"\{\{\s*([A-Za-z_][A-Za-z0-9_]*)\s*\}\}")
+
+
+class RecipeError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class RecipeParameter:
+    key: str
+    input_type: str = ""
+    requirement: str = ""
+    description: str = ""
+    default: Optional[str] = None
+    options: tuple = ()
+
+    def to_dict(self) -> dict:
+        d = {"key": self.key}
+        for f in ("input_type", "requirement", "description"):
+            if getattr(self, f):
+                d[f] = getattr(self, f)
+        if self.default is not None:
+            d["default"] = self.default
+        if self.options:
+            d["options"] = list(self.options)
+        return d
+
+
+@dataclasses.dataclass
+class Recipe:
+    version: str
+    title: str = ""
+    description: str = ""
+    parameters: tuple = ()
+
+    def to_dict(self) -> dict:
+        return {
+            "version": self.version,
+            "title": self.title,
+            "description": self.description,
+            "parameters": [p.to_dict() for p in self.parameters],
+        }
+
+
+def parse(content: str) -> Recipe:
+    try:
+        doc = yaml.safe_load(content)
+    except yaml.YAMLError as e:
+        raise RecipeError(f"malformed recipe YAML: {e}") from e
+    if not isinstance(doc, dict):
+        raise RecipeError("recipe must be a YAML mapping")
+    version = doc.get("version")
+    if not version:
+        raise RecipeError("recipe has no version field")
+    params = []
+    for p in doc.get("parameters") or []:
+        if not isinstance(p, dict) or not p.get("key"):
+            raise RecipeError("parameter without a key")
+        params.append(
+            RecipeParameter(
+                key=p["key"],
+                input_type=p.get("input_type", ""),
+                requirement=p.get("requirement", ""),
+                description=p.get("description", ""),
+                default=(
+                    str(p["default"]) if "default" in p else None
+                ),
+                options=tuple(p.get("options") or ()),
+            )
+        )
+    return Recipe(
+        version=str(version),
+        title=doc.get("title", ""),
+        description=doc.get("description", ""),
+        parameters=tuple(params),
+    )
+
+
+def missing_required(recipe: Recipe, values: dict) -> list:
+    """Required parameters with no value and no default."""
+    return [
+        p.key
+        for p in recipe.parameters
+        if p.requirement == "required"
+        and p.key not in values
+        and p.default is None
+    ]
+
+
+def substitute(content: str, values: dict,
+               recipe: Optional[Recipe] = None) -> str:
+    """Replace ``{{ var }}`` with provided values (falling back to
+    declared defaults); anything unresolvable stays intact for goose's
+    full Jinja evaluator."""
+    defaults = {}
+    if recipe is not None:
+        defaults = {
+            p.key: p.default
+            for p in recipe.parameters
+            if p.default is not None
+        }
+
+    def repl(m: "re.Match") -> str:
+        key = m.group(1)
+        if key in values:
+            return str(values[key])
+        if key in defaults:
+            return str(defaults[key])
+        return m.group(0)
+
+    return _VAR_RE.sub(repl, content)

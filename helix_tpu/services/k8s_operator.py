@@ -1,0 +1,291 @@
+"""Kubernetes operator: AIApp custom resources reconciled into apps.
+
+Reference: ``operator/`` — a kubebuilder controller for the ``AIApp``
+CRD (group ``app.aispec.org/v1alpha1``) that converts each CR into a
+Helix app via the API, namespacing the app id as ``k8s.<ns>.<name>``,
+managing a finalizer for deletes, and writing status back
+(``operator/internal/controller/aiapp_controller.go:56``,
+``operator/api/v1alpha1/aiapp_types.go``).
+
+This build keeps the same reconcile semantics with a self-contained
+controller process: a list+watch loop against the K8s API (plain HTTP —
+injectable for tests), idempotent upserts into the control plane's app
+store, finalizer add/strip, and a status patch per reconcile.  CRD and
+deployment manifests live in ``deploy/k8s/``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.parse
+import urllib.request
+from typing import Callable, Optional
+
+log = logging.getLogger("helix.operator")
+
+GROUP = "app.aispec.org"
+VERSION = "v1alpha1"
+PLURAL = "aiapps"
+FINALIZER = "app.aispec.org/finalizer"
+K8S_PREFIX = "k8s"
+
+
+def app_id_for(namespace: str, name: str) -> str:
+    """Namespaced, clash-free app id for k8s-managed apps (reference
+    uses dots for URL safety: ``k8s.<ns>.<name>``)."""
+    return f"{K8S_PREFIX}.{namespace}.{name}"
+
+
+def crd_to_app_doc(aiapp: dict) -> dict:
+    """AIApp CR -> helix.yaml-shaped app document."""
+    meta = aiapp.get("metadata", {})
+    spec = aiapp.get("spec", {}) or {}
+    assistants = []
+    for a in spec.get("assistants", []) or []:
+        assistant = {
+            k: v
+            for k, v in a.items()
+            if k in (
+                "id", "name", "description", "provider", "model",
+                "system_prompt", "temperature", "max_tokens", "knowledge",
+                "apis", "tools",
+            )
+        }
+        assistants.append(assistant)
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "AIApp",
+        "metadata": {
+            "name": app_id_for(
+                meta.get("namespace", "default"), meta.get("name", "")
+            ),
+        },
+        "spec": {
+            "description": spec.get("description", ""),
+            "assistants": assistants,
+            "triggers": spec.get("triggers", []),
+        },
+    }
+
+
+class K8sClient:
+    """Minimal typed client for one CRD; HTTP layer injectable."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token: str = "",
+        http_fn: Optional[Callable] = None,
+        namespace: Optional[str] = None,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.namespace = namespace
+        self._http = http_fn or self._default_http
+
+    @classmethod
+    def in_cluster(cls) -> "K8sClient":
+        """Standard in-cluster config: service-account token + env."""
+        import os
+
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        token = ""
+        try:
+            with open(
+                "/var/run/secrets/kubernetes.io/serviceaccount/token"
+            ) as f:
+                token = f.read().strip()
+        except OSError:
+            pass
+        return cls(f"https://{host}:{port}", token)
+
+    def _default_http(self, method, url, body=None, headers=None):
+        req = urllib.request.Request(
+            url, data=body, method=method, headers=headers or {}
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, r.read()
+
+    def _req(self, method: str, path: str, body: Optional[dict] = None,
+             content_type: str = "application/json"):
+        headers = {"Accept": "application/json"}
+        if self.token:
+            headers["Authorization"] = f"Bearer {self.token}"
+        data = None
+        if body is not None:
+            headers["Content-Type"] = content_type
+            data = json.dumps(body).encode()
+        status, raw = self._http(
+            method, f"{self.base_url}{path}", data, headers
+        )
+        if status >= 400:
+            raise RuntimeError(f"k8s API {method} {path}: HTTP {status}")
+        return json.loads(raw) if raw else {}
+
+    def _crd_path(self, namespace: Optional[str] = None) -> str:
+        ns = namespace or self.namespace
+        if ns:
+            return f"/apis/{GROUP}/{VERSION}/namespaces/{ns}/{PLURAL}"
+        return f"/apis/{GROUP}/{VERSION}/{PLURAL}"
+
+    def list_aiapps(self) -> dict:
+        return self._req("GET", self._crd_path())
+
+    def update_aiapp(self, aiapp: dict) -> dict:
+        meta = aiapp["metadata"]
+        return self._req(
+            "PUT",
+            f"/apis/{GROUP}/{VERSION}/namespaces/{meta['namespace']}/"
+            f"{PLURAL}/{meta['name']}",
+            aiapp,
+        )
+
+    def patch_status(self, namespace: str, name: str, status: dict) -> None:
+        self._req(
+            "PATCH",
+            f"/apis/{GROUP}/{VERSION}/namespaces/{namespace}/{PLURAL}/"
+            f"{name}/status",
+            {"status": status},
+            content_type="application/merge-patch+json",
+        )
+
+
+class AIAppReconciler:
+    """Reconcile every AIApp CR into the control plane's app store."""
+
+    def __init__(
+        self,
+        k8s: K8sClient,
+        helix_url: str = "",
+        helix_token: str = "",
+        apply_fn: Optional[Callable[[str, dict], None]] = None,
+        delete_fn: Optional[Callable[[str], None]] = None,
+        resync_interval: float = 30.0,
+    ):
+        """``apply_fn(app_id, doc)`` / ``delete_fn(app_id)`` default to
+        the control-plane HTTP API at ``helix_url``; injectable so the
+        operator can run in-process with a ControlPlane store."""
+        self.k8s = k8s
+        self.helix_url = helix_url.rstrip("/")
+        self.helix_token = helix_token
+        self.apply_fn = apply_fn or self._apply_http
+        self.delete_fn = delete_fn or self._delete_http
+        self.resync_interval = resync_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # app_id -> last applied doc (skip no-op PUTs)
+        self._applied: dict[str, str] = {}
+
+    # -- helix API default sinks -------------------------------------------
+    def _helix_req(self, method, path, body=None):
+        headers = {"Content-Type": "application/json"}
+        if self.helix_token:
+            headers["Authorization"] = f"Bearer {self.helix_token}"
+        req = urllib.request.Request(
+            f"{self.helix_url}{path}",
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method, headers=headers,
+        )
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.read()
+
+    def _apply_http(self, app_id: str, doc: dict) -> None:
+        self._helix_req("POST", "/api/v1/apps", doc)
+
+    def _delete_http(self, app_id: str) -> None:
+        import urllib.error
+
+        try:
+            self._helix_req(
+                "DELETE", f"/api/v1/apps/{urllib.parse.quote(app_id)}"
+            )
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+
+    # -- reconcile ----------------------------------------------------------
+    def reconcile_one(self, aiapp: dict) -> str:
+        """-> outcome: applied | deleted | finalizer-added | unchanged"""
+        meta = aiapp.get("metadata", {})
+        ns = meta.get("namespace", "default")
+        name = meta.get("name", "")
+        app_id = app_id_for(ns, name)
+        finalizers = meta.get("finalizers", []) or []
+        if meta.get("deletionTimestamp"):
+            self.delete_fn(app_id)
+            self._applied.pop(app_id, None)
+            if FINALIZER in finalizers:
+                meta["finalizers"] = [
+                    f for f in finalizers if f != FINALIZER
+                ]
+                self.k8s.update_aiapp(aiapp)
+            return "deleted"
+        if FINALIZER not in finalizers:
+            meta["finalizers"] = finalizers + [FINALIZER]
+            self.k8s.update_aiapp(aiapp)
+            return "finalizer-added"
+        doc = crd_to_app_doc(aiapp)
+        fingerprint = json.dumps(doc, sort_keys=True)
+        if self._applied.get(app_id) == fingerprint:
+            return "unchanged"
+        try:
+            self.apply_fn(app_id, doc)
+            self._applied[app_id] = fingerprint
+            self._status(ns, name, "Ready", app_id, "")
+            return "applied"
+        except Exception as e:  # noqa: BLE001 — surface on the CR status
+            log.warning("reconcile %s failed: %s", app_id, e)
+            self._status(ns, name, "Error", app_id, str(e))
+            return "error"
+
+    def _status(self, ns, name, phase, app_id, message) -> None:
+        try:
+            self.k8s.patch_status(
+                ns, name,
+                {"phase": phase, "appId": app_id, "message": message},
+            )
+        except Exception:  # noqa: BLE001 — status is best effort
+            log.debug("status patch failed", exc_info=True)
+
+    def resync(self) -> dict:
+        """One full list+reconcile pass; returns outcome counts."""
+        out: dict = {}
+        doc = self.k8s.list_aiapps()
+        seen = set()
+        for item in doc.get("items", []):
+            meta = item.get("metadata", {})
+            seen.add(
+                app_id_for(meta.get("namespace", "default"),
+                           meta.get("name", ""))
+            )
+            res = self.reconcile_one(item)
+            out[res] = out.get(res, 0) + 1
+        # apps we applied whose CR vanished without a deletion event
+        # (finalizer normally prevents this; belt-and-braces GC)
+        for app_id in list(self._applied):
+            if app_id not in seen:
+                self.delete_fn(app_id)
+                self._applied.pop(app_id, None)
+                out["gc"] = out.get("gc", 0) + 1
+        return out
+
+    def start(self) -> "AIAppReconciler":
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.resync()
+                except Exception as e:  # noqa: BLE001 — keep the loop up
+                    log.warning("resync failed: %s", e)
+                self._stop.wait(self.resync_interval)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

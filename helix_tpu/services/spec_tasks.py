@@ -105,6 +105,9 @@ class TaskStore:
     def __init__(self, db_path: str = ":memory:"):
         self._conn = sqlite3.connect(db_path, check_same_thread=False)
         self._lock = threading.Lock()
+        # lifecycle observer: on_update(task) after every status persist
+        # (the control plane publishes these to the durable TASKS stream)
+        self.on_update = None
         with self._lock:
             self._conn.executescript(_SCHEMA)
             for mig in _MIGRATIONS:
@@ -178,6 +181,11 @@ class TaskStore:
                 ),
             )
             self._conn.commit()
+        if self.on_update is not None:
+            try:
+                self.on_update(t)
+            except Exception:  # noqa: BLE001 — observers must not break
+                pass           # the kanban loop
 
     # -- design reviews -------------------------------------------------------
     def add_review(self, task_id: str, author: str, comment: str,

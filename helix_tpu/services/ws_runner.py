@@ -1,0 +1,232 @@
+"""External agent runners over WebSocket: remote agents work the kanban.
+
+Reference: the external-agent runner WS pattern — agent processes connect
+to the control plane (``server.go:798`` "/ws/external-agent-runner",
+``serve.go:305-307`` GPTScript-style external runners) and receive work;
+the Zed flow additionally syncs code through the internal git server
+rather than a shared filesystem.
+
+Protocol (JSON frames):
+  runner -> server: {"type": "register", "name", "agent", "concurrency"}
+  server -> runner: {"type": "task", "task_id", "mode", "title",
+                     "description", "spec_path", "feedback",
+                     "git_url", "branch"}
+  runner -> server: {"type": "log",    "task_id", "text"}      (streamed)
+  runner -> server: {"type": "result", "task_id", "output"}
+  runner -> server: {"type": "error",  "task_id", "error"}
+
+The orchestrator runs executors on its own thread, so the executor blocks
+on a threading.Event while the asyncio side sends/receives frames; a
+disconnect fails all of that runner's in-flight tasks immediately (the
+orchestrator's bounded retries then re-dispatch).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+log = logging.getLogger("helix.wsrunner")
+
+
+class PendingTask:
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self.event = threading.Event()
+        self.output: Optional[str] = None
+        self.error: Optional[str] = None
+
+
+class WSRunner:
+    """One connected runner (server side)."""
+
+    def __init__(self, name: str, agent: str, send_fn: Callable[[dict], None],
+                 concurrency: int = 1):
+        self.name = name
+        self.agent = agent
+        self.send = send_fn              # thread-safe frame sender
+        self.concurrency = max(1, concurrency)
+        self.pending: dict[str, PendingTask] = {}
+        self.connected_at = time.time()
+
+    @property
+    def busy(self) -> int:
+        return len(self.pending)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "agent": self.agent,
+            "concurrency": self.concurrency,
+            "in_flight": self.busy,
+            "connected_at": self.connected_at,
+        }
+
+
+class WSRunnerRegistry:
+    """Connected external runners + dispatch bookkeeping."""
+
+    def __init__(self):
+        self._runners: dict[str, WSRunner] = {}
+        self._lock = threading.Lock()
+
+    def register(self, runner: WSRunner) -> None:
+        with self._lock:
+            self._runners[runner.name] = runner
+
+    def unregister(self, name: str, expected: Optional[WSRunner] = None,
+                   ) -> None:
+        """Disconnect: fail every in-flight task on this runner so the
+        orchestrator's retry loop can re-dispatch (reference: runner
+        crash reconciliation).
+
+        ``expected`` guards against a stale connection's late cleanup
+        (heartbeat timeout) removing a runner that has since RECONNECTED
+        under the same name: only the registry entry matching this exact
+        connection object is removed."""
+        with self._lock:
+            runner = self._runners.get(name)
+            if runner is None:
+                return
+            if expected is not None and runner is not expected:
+                runner = expected   # fail the stale conn's tasks only
+            else:
+                self._runners.pop(name, None)
+        for p in list(runner.pending.values()):
+            p.error = f"runner '{name}' disconnected"
+            p.event.set()
+        runner.pending.clear()
+
+    def list(self) -> list:
+        with self._lock:
+            return [r.to_dict() for r in self._runners.values()]
+
+    def pick(self, agent: Optional[str] = None) -> Optional[WSRunner]:
+        """Least-loaded runner with free capacity (optionally filtered by
+        agent type)."""
+        with self._lock:
+            candidates = [
+                r for r in self._runners.values()
+                if (agent is None or r.agent == agent)
+                and r.busy < r.concurrency
+            ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: r.busy)
+
+    def handle_frame(self, runner_name: str, frame: dict,
+                     on_log=None) -> None:
+        """Process one runner->server frame (log/result/error)."""
+        with self._lock:
+            runner = self._runners.get(runner_name)
+        if runner is None:
+            return
+        tid = frame.get("task_id", "")
+        p = runner.pending.get(tid)
+        kind = frame.get("type")
+        if kind == "log":
+            if on_log is not None:
+                on_log(tid, frame.get("text", ""))
+            return
+        if p is None:
+            return
+        if kind == "result":
+            p.output = frame.get("output", "")
+        elif kind == "error":
+            p.error = frame.get("error", "unknown runner error")
+        else:
+            return
+        runner.pending.pop(tid, None)
+        p.event.set()
+
+
+class WSRunnerExecutor:
+    """Executor that dispatches kanban work to a connected WS runner.
+
+    The workspace is NOT shared: the task frame carries the internal git
+    smart-HTTP URL + branch (``git_url_fn(task, mode)``), the runner
+    clones/pushes like the reference's Zed agents do."""
+
+    def __init__(
+        self,
+        registry: WSRunnerRegistry,
+        git_url_fn: Callable,
+        agent: Optional[str] = None,
+        timeout_s: float = 1800.0,
+        on_log=None,
+    ):
+        self.registry = registry
+        self.git_url_fn = git_url_fn
+        self.agent = agent
+        self.timeout_s = timeout_s
+        self.on_log = on_log
+
+    def run(self, task, workspace: str, mode: str,
+            feedback: str = "") -> str:
+        runner = self.registry.pick(self.agent)
+        if runner is None:
+            raise RuntimeError(
+                "no external runner connected"
+                + (f" for agent '{self.agent}'" if self.agent else "")
+            )
+        tid = f"wst-{uuid.uuid4().hex[:10]}"
+        pending = PendingTask(tid)
+        runner.pending[tid] = pending
+        git_url, branch = self.git_url_fn(task, mode)
+        frame = {
+            "type": "task",
+            "task_id": tid,
+            "mode": mode,
+            "title": task.title,
+            "description": task.description,
+            "spec_path": getattr(task, "spec_path", ""),
+            "feedback": feedback,
+            "git_url": git_url,
+            "branch": branch,
+        }
+        try:
+            runner.send(frame)
+        except Exception as e:
+            runner.pending.pop(tid, None)
+            raise RuntimeError(f"runner send failed: {e}") from e
+        if not pending.event.wait(self.timeout_s):
+            runner.pending.pop(tid, None)
+            raise RuntimeError(
+                f"external runner timed out after {self.timeout_s:.0f}s"
+            )
+        if pending.error is not None:
+            raise RuntimeError(pending.error)
+        self._sync_workspace(workspace, branch)
+        return pending.output or ""
+
+    @staticmethod
+    def _sync_workspace(workspace: str, branch: str) -> None:
+        """The runner pushed its work to the internal repo; materialise
+        that branch into the orchestrator's local workspace so the rest
+        of the pipeline (spec existence check, PR diff base) sees it.
+        commit_and_push afterwards is a clean-tree no-op."""
+        import os
+        import subprocess
+
+        if not os.path.isdir(os.path.join(workspace, ".git")):
+            return
+        try:
+            subprocess.run(
+                ["git", "-C", workspace, "fetch", "-q", "origin", branch],
+                check=True, capture_output=True,
+            )
+            subprocess.run(
+                ["git", "-C", workspace, "checkout", "-q", "-B", branch,
+                 "FETCH_HEAD"],
+                check=True, capture_output=True,
+            )
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                "runner reported success but its branch "
+                f"'{branch}' could not be fetched: "
+                f"{e.stderr.decode(errors='replace')[:300]}"
+            ) from e

@@ -1,0 +1,180 @@
+"""K8s operator: AIApp CR reconciliation (finalizers, upsert, delete,
+status patches) against a fake K8s API.
+
+Reference: ``operator/internal/controller/aiapp_controller.go:56`` —
+app id ``k8s.<ns>.<name>``, finalizer-managed deletion, CR->App
+conversion, status writeback.
+"""
+
+import json
+
+from helix_tpu.services.k8s_operator import (
+    FINALIZER,
+    AIAppReconciler,
+    K8sClient,
+    app_id_for,
+    crd_to_app_doc,
+)
+
+
+class FakeK8s:
+    """In-memory CR store speaking the operator's HTTP surface."""
+
+    def __init__(self, items=None):
+        self.items = {f"{i['metadata']['namespace']}/{i['metadata']['name']}":
+                      i for i in (items or [])}
+        self.status_patches = []
+
+    def http(self, method, url, body, headers):
+        path = url.split("://", 1)[-1].split("/", 1)[1]
+        parts = path.split("/")
+        if method == "GET":
+            return 200, json.dumps(
+                {"items": list(self.items.values())}
+            ).encode()
+        if method == "PUT":
+            doc = json.loads(body)
+            key = (f"{doc['metadata']['namespace']}/"
+                   f"{doc['metadata']['name']}")
+            self.items[key] = doc
+            return 200, json.dumps(doc).encode()
+        if method == "PATCH" and parts[-1] == "status":
+            ns, name = parts[-4], parts[-2]
+            patch = json.loads(body)
+            self.status_patches.append((ns, name, patch["status"]))
+            key = f"{ns}/{name}"
+            if key in self.items:
+                self.items[key]["status"] = patch["status"]
+            return 200, b"{}"
+        return 404, b""
+
+
+def _cr(name="chat", ns="prod", finalizers=None, deleting=False,
+        model="m1"):
+    meta = {"namespace": ns, "name": name}
+    if finalizers is not None:
+        meta["finalizers"] = finalizers
+    if deleting:
+        meta["deletionTimestamp"] = "2026-07-29T00:00:00Z"
+    return {
+        "metadata": meta,
+        "spec": {
+            "description": "demo",
+            "assistants": [{"name": "main", "model": model,
+                            "system_prompt": "be kind"}],
+        },
+    }
+
+
+def _reconciler(fake, applied=None, deleted=None):
+    applied = applied if applied is not None else []
+    deleted = deleted if deleted is not None else []
+    k8s = K8sClient("https://k8s.test", http_fn=fake.http)
+    return AIAppReconciler(
+        k8s,
+        apply_fn=lambda app_id, doc: applied.append((app_id, doc)),
+        delete_fn=lambda app_id: deleted.append(app_id),
+    )
+
+
+class TestConversion:
+    def test_app_id_namespacing(self):
+        assert app_id_for("prod", "chat") == "k8s.prod.chat"
+
+    def test_crd_to_app_doc_shape(self):
+        doc = crd_to_app_doc(_cr())
+        assert doc["metadata"]["name"] == "k8s.prod.chat"
+        a = doc["spec"]["assistants"][0]
+        assert a["model"] == "m1" and a["system_prompt"] == "be kind"
+
+
+class TestReconcile:
+    def test_first_pass_adds_finalizer_then_applies(self):
+        fake = FakeK8s([_cr()])
+        applied = []
+        rec = _reconciler(fake, applied=applied)
+        assert rec.resync() == {"finalizer-added": 1}
+        key = "prod/chat"
+        assert FINALIZER in fake.items[key]["metadata"]["finalizers"]
+        out = rec.resync()
+        assert out == {"applied": 1}
+        assert applied[0][0] == "k8s.prod.chat"
+        # status written back Ready
+        assert fake.status_patches[-1][2]["phase"] == "Ready"
+        # unchanged CR -> no-op
+        assert rec.resync() == {"unchanged": 1}
+
+    def test_spec_change_reapplies(self):
+        fake = FakeK8s([_cr(finalizers=[FINALIZER])])
+        applied = []
+        rec = _reconciler(fake, applied=applied)
+        rec.resync()
+        fake.items["prod/chat"]["spec"]["assistants"][0]["model"] = "m2"
+        rec.resync()
+        assert len(applied) == 2
+        assert applied[1][1]["spec"]["assistants"][0]["model"] == "m2"
+
+    def test_deletion_removes_app_and_strips_finalizer(self):
+        fake = FakeK8s(
+            [_cr(finalizers=[FINALIZER, "other"], deleting=True)]
+        )
+        deleted = []
+        rec = _reconciler(fake, deleted=deleted)
+        assert rec.resync() == {"deleted": 1}
+        assert deleted == ["k8s.prod.chat"]
+        assert fake.items["prod/chat"]["metadata"]["finalizers"] == [
+            "other"
+        ]
+
+    def test_apply_failure_writes_error_status(self):
+        fake = FakeK8s([_cr(finalizers=[FINALIZER])])
+        k8s = K8sClient("https://k8s.test", http_fn=fake.http)
+
+        def boom(app_id, doc):
+            raise RuntimeError("control plane down")
+
+        rec = AIAppReconciler(k8s, apply_fn=boom, delete_fn=lambda a: None)
+        assert rec.resync() == {"error": 1}
+        ns, name, status = fake.status_patches[-1]
+        assert status["phase"] == "Error"
+        assert "control plane down" in status["message"]
+
+    def test_vanished_cr_is_garbage_collected(self):
+        fake = FakeK8s([_cr(finalizers=[FINALIZER])])
+        applied, deleted = [], []
+        rec = _reconciler(fake, applied=applied, deleted=deleted)
+        rec.resync()
+        del fake.items["prod/chat"]
+        out = rec.resync()
+        assert out.get("gc") == 1
+        assert deleted == ["k8s.prod.chat"]
+
+
+class TestEndToEndWithControlPlane:
+    def test_reconciles_into_real_app_store(self):
+        """In-process reconcile into a live ControlPlane store."""
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+        fake = FakeK8s([_cr(finalizers=[FINALIZER])])
+        k8s = K8sClient("https://k8s.test", http_fn=fake.http)
+
+        def apply(app_id, doc):
+            cp.store.upsert_app(app_id, "k8s-operator", doc)
+
+        def delete(app_id):
+            for a in cp.store.list_apps():
+                if a["name"] == app_id:
+                    cp.store.delete_app(a["id"])
+
+        rec = AIAppReconciler(k8s, apply_fn=apply, delete_fn=delete)
+        rec.resync()
+        apps = cp.store.list_apps()
+        assert any(a["name"] == "k8s.prod.chat" for a in apps)
+        fake.items["prod/chat"]["metadata"]["deletionTimestamp"] = "now"
+        rec.resync()
+        assert not any(
+            a["name"] == "k8s.prod.chat" for a in cp.store.list_apps()
+        )
+        cp.orchestrator.stop()
+        cp.knowledge.stop()

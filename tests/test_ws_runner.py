@@ -1,0 +1,346 @@
+"""External WS runners + editor agent sync + Goose recipes.
+
+Reference: the external-agent runner WS endpoint (``server.go:798``),
+the Zed bidirectional sync WS (``server.go:1182``), and Goose recipe
+parsing (``api/pkg/goose/recipe.go``).
+"""
+
+import asyncio
+import json
+import subprocess
+import threading
+
+import pytest
+import requests
+
+from helix_tpu.services import goose
+from helix_tpu.services.ws_runner import (
+    PendingTask,
+    WSRunner,
+    WSRunnerExecutor,
+    WSRunnerRegistry,
+)
+
+
+class TestRegistry:
+    def test_pick_least_loaded_with_capacity(self):
+        reg = WSRunnerRegistry()
+        a = WSRunner("a", "zed", lambda f: None, concurrency=2)
+        b = WSRunner("b", "zed", lambda f: None, concurrency=2)
+        reg.register(a)
+        reg.register(b)
+        a.pending["t1"] = PendingTask("t1")
+        assert reg.pick().name == "b"
+        assert reg.pick(agent="goose") is None
+        b.pending["t2"] = PendingTask("t2")
+        b.pending["t3"] = PendingTask("t3")
+        assert reg.pick().name == "a"     # b is at capacity
+
+    def test_disconnect_fails_in_flight(self):
+        reg = WSRunnerRegistry()
+        r = WSRunner("a", "zed", lambda f: None)
+        reg.register(r)
+        p = PendingTask("t1")
+        r.pending["t1"] = p
+        reg.unregister("a")
+        assert p.event.is_set() and "disconnected" in p.error
+
+    def test_result_and_error_frames_resolve(self):
+        reg = WSRunnerRegistry()
+        r = WSRunner("a", "zed", lambda f: None)
+        reg.register(r)
+        p1, p2 = PendingTask("t1"), PendingTask("t2")
+        r.pending.update(t1=p1, t2=p2)
+        logs = []
+        reg.handle_frame(
+            "a", {"type": "log", "task_id": "t1", "text": "cloning"},
+            on_log=lambda tid, text: logs.append((tid, text)),
+        )
+        reg.handle_frame(
+            "a", {"type": "result", "task_id": "t1", "output": "done"}
+        )
+        reg.handle_frame(
+            "a", {"type": "error", "task_id": "t2", "error": "boom"}
+        )
+        assert p1.output == "done" and p2.error == "boom"
+        assert logs == [("t1", "cloning")]
+        assert not r.pending
+
+
+class _Task:
+    id = "st-1"
+    project = "webapp"
+    title = "Add search"
+    description = "full-text"
+    spec_path = "specs/add-search.md"
+    spec_branch = "helix-specs"
+    task_branch = "task/st-1"
+
+
+class TestExecutor:
+    def test_dispatch_roundtrip(self):
+        reg = WSRunnerRegistry()
+        frames = []
+
+        def send(frame):
+            frames.append(frame)
+            # simulate the runner finishing asynchronously
+            threading.Timer(
+                0.05,
+                reg.handle_frame,
+                args=("a", {"type": "result",
+                            "task_id": frame["task_id"],
+                            "output": "pushed"}),
+            ).start()
+
+        reg.register(WSRunner("a", "zed", send))
+        ex = WSRunnerExecutor(
+            reg, lambda t, mode: (f"http://cp/git/{t.project}",
+                                  t.task_branch),
+            timeout_s=5,
+        )
+        out = ex.run(_Task(), "/nonexistent", "implement", feedback="fix")
+        assert out == "pushed"
+        f = frames[0]
+        assert f["git_url"] == "http://cp/git/webapp"
+        assert f["branch"] == "task/st-1"
+        assert f["mode"] == "implement" and f["feedback"] == "fix"
+
+    def test_no_runner_raises(self):
+        ex = WSRunnerExecutor(
+            WSRunnerRegistry(), lambda t, m: ("u", "b")
+        )
+        with pytest.raises(RuntimeError, match="no external runner"):
+            ex.run(_Task(), "/x", "plan")
+
+    def test_timeout_cleans_pending(self):
+        reg = WSRunnerRegistry()
+        r = WSRunner("a", "zed", lambda f: None)
+        reg.register(r)
+        ex = WSRunnerExecutor(
+            reg, lambda t, m: ("u", "b"), timeout_s=0.1
+        )
+        with pytest.raises(RuntimeError, match="timed out"):
+            ex.run(_Task(), "/x", "plan")
+        assert not r.pending
+
+
+@pytest.fixture(scope="module")
+def ws_cp():
+    """Control plane with HELIX_EXECUTOR=ws: kanban work dispatches to a
+    connected WS runner."""
+    import os
+
+    from helix_tpu.control.server import ControlPlane
+
+    os.environ["HELIX_EXECUTOR"] = "ws"
+    os.environ["HELIX_PUBLIC_URL"] = "http://127.0.0.1:18427"
+    try:
+        cp = ControlPlane()
+    finally:
+        del os.environ["HELIX_EXECUTOR"]
+        del os.environ["HELIX_PUBLIC_URL"]
+    cp.orchestrator.poll_interval = 0.2
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(cp.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 18427)
+        loop.run_until_complete(site.start())
+        holder["loop"] = loop
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    yield "http://127.0.0.1:18427", cp
+    cp.orchestrator.stop()
+    cp.knowledge.stop()
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+def _fake_runner(url, tmp_path, stop_evt):
+    """A scripted external runner: clone, do the work, push, reply."""
+    import aiohttp
+
+    async def main():
+        async with aiohttp.ClientSession() as s:
+            async with s.ws_connect(
+                f"{url.replace('http', 'ws')}/ws/external-runner"
+            ) as ws:
+                await ws.send_json(
+                    {"type": "register", "name": "fake-zed",
+                     "agent": "zed", "concurrency": 2}
+                )
+                async for msg in ws:
+                    if stop_evt.is_set():
+                        return
+                    if msg.type != aiohttp.WSMsgType.TEXT:
+                        continue
+                    t = json.loads(msg.data)
+                    if t.get("type") != "task":
+                        continue
+                    out = await asyncio.get_event_loop().run_in_executor(
+                        None, _work, t, tmp_path
+                    )
+                    await ws.send_json(
+                        {"type": "result", "task_id": t["task_id"],
+                         "output": out}
+                    )
+
+    def _work(t, tmp):
+        ws_dir = str(tmp / t["task_id"])
+        subprocess.run(
+            ["git", "clone", "-q", t["git_url"], ws_dir], check=True
+        )
+        subprocess.run(
+            ["git", "-C", ws_dir, "checkout", "-q", "-B", t["branch"]],
+            check=True,
+        )
+        import os
+
+        if t["mode"] == "plan":
+            path = os.path.join(ws_dir, t["spec_path"])
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(path, "w") as f:
+                f.write(f"# Spec: {t['title']}\n")
+        else:
+            with open(os.path.join(ws_dir, "main.py"), "w") as f:
+                f.write("print('from ws runner')\n")
+        env = dict(
+            os.environ,
+            GIT_AUTHOR_NAME="r", GIT_AUTHOR_EMAIL="r@x",
+            GIT_COMMITTER_NAME="r", GIT_COMMITTER_EMAIL="r@x",
+        )
+        subprocess.run(
+            ["git", "-C", ws_dir, "add", "-A"], check=True, env=env
+        )
+        subprocess.run(
+            ["git", "-C", ws_dir, "commit", "-q", "-m", t["mode"]],
+            check=True, env=env,
+        )
+        subprocess.run(
+            ["git", "-C", ws_dir, "push", "-q", "-f", "origin",
+             t["branch"]],
+            check=True, env=env,
+        )
+        return f"{t['mode']} done"
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+class TestWSRunnerE2E:
+    def test_kanban_task_worked_by_ws_runner(self, ws_cp, tmp_path):
+        """A spec task is planned AND implemented by a remote WS runner
+        that syncs through the internal git server."""
+        import time
+
+        url, cp = ws_cp
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_fake_runner, args=(url, tmp_path, stop), daemon=True
+        )
+        t.start()
+        deadline = time.time() + 10
+        while not cp.ws_runners.list() and time.time() < deadline:
+            time.sleep(0.05)
+        assert cp.ws_runners.list(), "runner never registered"
+        r = requests.post(
+            f"{url}/api/v1/spec-tasks",
+            json={"project": "webapp", "title": "Add search",
+                  "description": "full-text"},
+            timeout=5,
+        )
+        tid = r.json()["id"]
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            task = requests.get(
+                f"{url}/api/v1/spec-tasks/{tid}", timeout=5
+            ).json()
+            if task["status"] in ("spec_review", "failed"):
+                break
+            time.sleep(0.2)
+        assert task["status"] == "spec_review", task
+        # the spec really landed in the internal repo via the runner push
+        assert cp.git.branch_exists("webapp", task["spec_branch"])
+        stop.set()
+
+    def test_runner_listing_endpoint(self, ws_cp):
+        url, cp = ws_cp
+        doc = requests.get(
+            f"{url}/api/v1/external-runners", timeout=5
+        ).json()
+        assert isinstance(doc["runners"], list)
+
+
+class TestDebugPprof:
+    def test_profiles_served(self, ws_cp):
+        """pprof-equivalent surface (reference: /debug/pprof/)."""
+        url, cp = ws_cp
+        threads = requests.get(
+            f"{url}/debug/pprof/threads", timeout=5
+        ).text
+        assert "thread" in threads and "MainThread" in threads
+        objects = requests.get(
+            f"{url}/debug/pprof/objects", timeout=5
+        ).text
+        assert "gc tracked objects" in objects and "dict" in objects
+        heap1 = requests.get(f"{url}/debug/pprof/heap", timeout=5).text
+        assert "tracemalloc" in heap1
+        heap2 = requests.get(f"{url}/debug/pprof/heap", timeout=10).text
+        assert "total tracked" in heap2
+        prof = requests.get(
+            f"{url}/debug/pprof/profile?seconds=0.2", timeout=10
+        ).text
+        assert "function calls" in prof or "no samples" in prof
+        assert requests.get(
+            f"{url}/debug/pprof/nope", timeout=5
+        ).status_code == 404
+
+
+class TestGooseRecipes:
+    RECIPE = """
+version: "1.0.0"
+title: Fix bug
+description: Fixes a bug in {{ repo }}
+parameters:
+  - key: repo
+    input_type: string
+    requirement: required
+    description: repository name
+  - key: severity
+    default: medium
+    options: [low, medium, high]
+prompt: |
+  Fix the {{ severity }} bug in {{ repo }}. Use {{ unknown_tool }}.
+"""
+
+    def test_parse_and_list_parameters(self):
+        r = goose.parse(self.RECIPE)
+        assert r.version == "1.0.0" and r.title == "Fix bug"
+        assert [p.key for p in r.parameters] == ["repo", "severity"]
+        assert r.parameters[1].default == "medium"
+
+    def test_missing_required(self):
+        r = goose.parse(self.RECIPE)
+        assert goose.missing_required(r, {}) == ["repo"]
+        assert goose.missing_required(r, {"repo": "x"}) == []
+
+    def test_substitute_with_defaults_and_unknowns_intact(self):
+        r = goose.parse(self.RECIPE)
+        out = goose.substitute(self.RECIPE, {"repo": "webapp"}, r)
+        assert "bug in webapp" in out
+        assert "the medium bug" in out            # default applied
+        assert "{{ unknown_tool }}" in out        # left for goose's jinja
+
+    def test_rejects_bogus(self):
+        with pytest.raises(goose.RecipeError):
+            goose.parse("title: no version here")
+        with pytest.raises(goose.RecipeError):
+            goose.parse(":\n  - not yaml: [")
