@@ -35,26 +35,54 @@ def thread_dump() -> str:
     return out.getvalue()
 
 
-def cpu_profile(seconds: float = 5.0, sort: str = "cumulative",
+def cpu_profile(seconds: float = 5.0, interval: float = 0.005,
                 limit: int = 60) -> str:
-    """Profile the whole process for ``seconds`` using the C profiler.
+    """Statistical whole-process CPU profile (py-spy style).
 
-    cProfile only observes the calling thread, so this uses
-    ``sys.setprofile``-free statistical fallback: cProfile on a busy
-    control plane still captures the event loop when called from it —
-    for cross-thread visibility use ``threads`` repeatedly."""
-    import cProfile
-    import pstats
+    cProfile only instruments its own thread — useless from a handler's
+    executor thread — so this samples EVERY thread's stack via
+    ``sys._current_frames()`` at ``interval`` and aggregates inclusive
+    sample counts per function, like Go's pprof CPU profile."""
     import time
 
-    prof = cProfile.Profile()
-    prof.enable()
-    time.sleep(seconds)
-    prof.disable()
+    own = threading.get_ident()
+    counts: dict = {}
+    leaf_counts: dict = {}
+    samples = 0
+    deadline = time.monotonic() + seconds
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == own:
+                continue
+            seen = set()
+            leaf = True
+            while frame is not None:
+                code = frame.f_code
+                key = (
+                    code.co_filename, code.co_firstlineno, code.co_name
+                )
+                if key not in seen:       # inclusive: once per stack
+                    seen.add(key)
+                    counts[key] = counts.get(key, 0) + 1
+                if leaf:
+                    leaf_counts[key] = leaf_counts.get(key, 0) + 1
+                    leaf = False
+                frame = frame.f_back
+        samples += 1
+        time.sleep(interval)
     out = io.StringIO()
-    stats = pstats.Stats(prof, stream=out)
-    stats.sort_stats(sort).print_stats(limit)
-    return out.getvalue() or "(no samples on this thread)\n"
+    out.write(
+        f"{samples} samples over {seconds:.1f}s "
+        f"({interval * 1000:.0f}ms interval); inclusive%  self%  function\n"
+    )
+    for key, n in sorted(counts.items(), key=lambda kv: -kv[1])[:limit]:
+        fn, line, name = key
+        out.write(
+            f"{100 * n / max(samples, 1):6.1f} "
+            f"{100 * leaf_counts.get(key, 0) / max(samples, 1):6.1f}  "
+            f"{name} ({fn}:{line})\n"
+        )
+    return out.getvalue()
 
 
 _tracemalloc_started = False

@@ -58,6 +58,22 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
 
         mesh = build_mesh(pm.mesh)
 
+    if pm.kind == "vision-embedding":
+        # vision-RAG pooling worker (reference: Qwen3-VL-Embedding as a
+        # vLLM --runner pooling service, 8xH100-vllm.yaml:15-43)
+        from helix_tpu.models.vision_embed import VisionEmbeddingRunner
+
+        vembedder = VisionEmbeddingRunner.build(pm, tokenizer)
+        if mesh is not None:
+            dev = mesh.devices.flat[0]
+            vembedder.params = jax.device_put(vembedder.params, dev)
+            vembedder.vparams = jax.device_put(vembedder.vparams, dev)
+        return ServedModel(
+            name=pm.name, loop=None, tokenizer=tokenizer,
+            kind="vision-embedding", embedder=vembedder,
+            context_length=pm.context_length,
+        )
+
     if pm.kind == "embedding":
         from helix_tpu.models.bge import EmbeddingRunner
 
@@ -179,6 +195,30 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     )
     engine = Engine(model_cfg, params, ecfg, mesh=mesh)
     engine.warmup()   # compile prefill/decode before the model goes routable
+    role = pm.multihost.get("role", "")
+    if role == "leader":
+        # journal the command stream for follower hosts (lockstep SPMD
+        # over DCN; serving/multihost_serving.py)
+        from helix_tpu.serving.multihost_serving import LockstepLeader
+
+        engine = LockstepLeader(engine)
+    elif role == "follower":
+        # this host replays the leader's journal — no local HTTP traffic
+        from helix_tpu.serving.multihost_serving import (
+            FollowerLoop,
+            HTTPFeed,
+        )
+
+        follower = FollowerLoop(
+            engine, HTTPFeed(pm.multihost["leader_url"], pm.name)
+        ).start()
+        return ServedModel(
+            name=pm.name, loop=None, tokenizer=tokenizer, kind=pm.kind,
+            context_length=(
+                pm.context_length or model_cfg.max_position_embeddings
+            ),
+            vision=vision_runner, follower=follower,
+        )
     loop = EngineLoop(engine, name=pm.name).start()
     return ServedModel(
         name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,

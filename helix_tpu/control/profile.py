@@ -48,14 +48,26 @@ from helix_tpu.device.mesh import MeshSpec
 class ProfileModel:
     name: str
     checkpoint: Optional[str] = None     # dir with safetensors; None = random-init
-    kind: str = "chat"                   # chat | embedding | vision
+    kind: str = "chat"     # chat | embedding | vision | vision-embedding
     quantization: Optional[str] = None   # None | "int8"
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     engine: dict = dataclasses.field(default_factory=dict)
     context_length: Optional[int] = None
+    # multi-host lockstep serving over DCN (serving/multihost_serving):
+    # {} = single host; {"role": "leader"} journals this engine's command
+    # stream; {"role": "follower", "leader_url": "http://host0:8000"}
+    # replays it on this host's shards of the global mesh
+    multihost: dict = dataclasses.field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: dict) -> "ProfileModel":
+        mh = dict(d.get("multihost", {}))
+        if mh and mh.get("role") not in ("leader", "follower"):
+            raise ValueError(
+                "multihost.role must be 'leader' or 'follower'"
+            )
+        if mh.get("role") == "follower" and not mh.get("leader_url"):
+            raise ValueError("multihost followers need leader_url")
         return cls(
             name=d["name"],
             checkpoint=d.get("checkpoint"),
@@ -64,6 +76,7 @@ class ProfileModel:
             mesh=MeshSpec.from_dict(d.get("mesh", {})),
             engine=dict(d.get("engine", {})),
             context_length=d.get("context_length"),
+            multihost=mh,
         )
 
     def to_dict(self) -> dict:
@@ -75,6 +88,7 @@ class ProfileModel:
             "mesh": self.mesh.to_dict(),
             "engine": dict(self.engine),
             "context_length": self.context_length,
+            "multihost": dict(self.multihost),
         }
 
 

@@ -322,7 +322,6 @@ class ControlPlane:
                 self.ws_runners,
                 _git_url,
                 agent=_os_env.environ.get("HELIX_WS_AGENT") or None,
-                on_log=lambda tid, text: None,
             )
         elif external_agent_argv:
             # third-party coding agent (Claude Code / Zed / any ACP CLI)
@@ -2095,10 +2094,18 @@ class ControlPlane:
         sends user chat, and receives the session's event stream."""
         import asyncio as _asyncio
 
-        ws = web.WebSocketResponse(heartbeat=30)
-        await ws.prepare(request)
         sid = request.query.get("session_id", "")
         session = self.store.get_session(sid) if sid else None
+        if session is not None and self.auth_required:
+            # the bridge speaks AS the session owner (quota, billing,
+            # secrets substitution) — only the owner or an admin may join
+            user = request.get("user")
+            if user is None or (
+                user.id != session.get("owner") and not user.admin
+            ):
+                return _err(403, "not your session")
+        ws = web.WebSocketResponse(heartbeat=30)
+        await ws.prepare(request)
         if session is None:
             await ws.close(code=4004, message=b"unknown session")
             return ws

@@ -55,6 +55,7 @@ class VectorStore:
         self._cache: dict[str, tuple] = {}
         # collection -> HNSWIndex over the cached matrix's row positions
         self._ann: dict[str, object] = {}
+        self._build_locks: dict[str, threading.Lock] = {}
         self.ann_threshold = ann_threshold
 
     # ------------------------------------------------------------------
@@ -174,15 +175,25 @@ class VectorStore:
                 and len(ids) >= self.ann_threshold
             )
         if need_build and _ann.native_available():
-            index = _ann.HNSWIndex(mat.shape[1])
-            index.add_batch(mat)             # row position == ANN id
-            with self._lock:
-                cur = self._cache.get(collection)
-                if cur is not None and cur[1] is mat:
-                    self._ann[collection] = index
-                # else: the collection changed mid-build — the graph
-                # still matches OUR (ids, mat) snapshot, so this query
-                # uses it; the next query rebuilds over fresh data
+            # per-collection build lock: N concurrent first-queries must
+            # not each rebuild an identical graph (the build is the
+            # expensive part the store lock no longer covers)
+            build_lock = self._build_locks.setdefault(
+                collection, threading.Lock()
+            )
+            with build_lock:
+                with self._lock:
+                    index = self._ann.get(collection)
+                if index is None:
+                    index = _ann.HNSWIndex(mat.shape[1])
+                    index.add_batch(mat)     # row position == ANN id
+                    with self._lock:
+                        cur = self._cache.get(collection)
+                        if cur is not None and cur[1] is mat:
+                            self._ann[collection] = index
+                        # else: changed mid-build — the graph still
+                        # matches OUR (ids, mat) snapshot; this query
+                        # uses it, the next one rebuilds fresh
         return ids, mat, index
 
     def query(

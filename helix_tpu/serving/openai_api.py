@@ -32,6 +32,22 @@ def _now() -> int:
     return int(time.time())
 
 
+_LONGPOLL_POOL = None
+
+
+def _longpoll_pool():
+    """Dedicated pool for multi-host journal long-polls (they park a
+    thread for tens of seconds each)."""
+    global _LONGPOLL_POOL
+    if _LONGPOLL_POOL is None:
+        import concurrent.futures
+
+        _LONGPOLL_POOL = concurrent.futures.ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="mh-longpoll"
+        )
+    return _LONGPOLL_POOL
+
+
 def _error(status: int, message: str, etype: str = "invalid_request_error"):
     return web.json_response(
         {"error": {"message": message, "type": etype}}, status=status
@@ -64,7 +80,38 @@ class OpenAIServer:
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/messages", self.anthropic_messages)
+        # multi-host lockstep journal (followers long-poll over DCN;
+        # see serving/multihost_serving.py)
+        app.router.add_get("/multihost/commands", self.multihost_commands)
         return app
+
+    async def multihost_commands(self, request):
+        """Leader-side journal feed for follower hosts."""
+        import asyncio as _asyncio
+
+        from helix_tpu.serving.multihost_serving import LagError
+
+        model = request.query.get("model", "")
+        served = self.registry.get(model)
+        if served is None or served.loop is None:
+            return _error(404, f"model '{model}' is not served here")
+        journal = getattr(served.loop.engine, "journal", None)
+        if journal is None:
+            return _error(
+                400, f"model '{model}' is not running in lockstep mode"
+            )
+        since = int(request.query.get("since", 0))
+        timeout = min(float(request.query.get("timeout", 25)), 55.0)
+        try:
+            # long-polls park a thread for up to ``timeout`` — keep them
+            # out of the shared default executor or a few followers
+            # would starve every other run_in_executor call
+            records = await _asyncio.get_running_loop().run_in_executor(
+                _longpoll_pool(), journal.read_since, since, timeout
+            )
+        except LagError as e:
+            return web.json_response({"lagged": True, "error": str(e)})
+        return web.json_response({"records": records})
 
     # ------------------------------------------------------------------
     async def healthz(self, request):
@@ -493,15 +540,45 @@ class OpenAIServer:
         served, err = await self._lookup(model)
         if err is not None:
             return err
-        if served.kind != "embedding":
+        if served.kind not in ("embedding", "vision-embedding"):
             return _error(
                 404, f"'{model}' is not an embedding model", "model_not_found"
             )
         inputs = body.get("input", [])
-        if isinstance(inputs, str):
+        if isinstance(inputs, (str, dict)):
             inputs = [inputs]
+        bad = [
+            x for x in inputs if isinstance(x, dict) and "image" not in x
+        ]
+        if bad:
+            return _error(
+                400,
+                "dict inputs must be {\"image\": <url/base64>}; got keys "
+                f"{sorted(bad[0])}",
+            )
+        has_images = any(
+            isinstance(x, dict) and "image" in x for x in inputs
+        )
+        if has_images:
+            # vision-RAG: image entries ({"image": url/b64}) pool through
+            # the vision tower into the same space as text (reference:
+            # Qwen3-VL-Embedding pooling runner)
+            if served.kind != "vision-embedding":
+                return _error(
+                    400,
+                    f"'{model}' cannot embed images; serve a "
+                    "vision-embedding model",
+                )
+            embed = served.embedder.embed_mixed
+        else:
+            embed = served.embedder.embed_texts
         vectors = await asyncio.get_running_loop().run_in_executor(
-            None, served.embedder.embed_texts, inputs
+            None, embed, inputs
+        )
+        text_tokens = sum(
+            len(served.tokenizer.encode(t))
+            for t in inputs
+            if isinstance(t, str)
         )
         return web.json_response(
             {
@@ -512,8 +589,8 @@ class OpenAIServer:
                     for i, v in enumerate(vectors)
                 ],
                 "usage": {
-                    "prompt_tokens": sum(len(served.tokenizer.encode(t)) for t in inputs),
-                    "total_tokens": sum(len(served.tokenizer.encode(t)) for t in inputs),
+                    "prompt_tokens": text_tokens,
+                    "total_tokens": text_tokens,
                 },
             }
         )

@@ -27,6 +27,7 @@ class ServedModel:
     context_length: Optional[int] = None
     embedder: object = None      # EmbeddingRunner for kind == "embedding"
     vision: object = None        # VisionRunner for kind == "vision"
+    follower: object = None      # FollowerLoop on multi-host followers
 
 
 class ModelRegistry:

@@ -1,0 +1,134 @@
+"""Vision embedding worker: images + text pooled into one space, served
+behind /v1/embeddings.
+
+Reference: the vision-RAG embedding service (Qwen3-VL-Embedding pooling
+runner, ``design/sample-profiles/8xH100-vllm.yaml:15-43``; SURVEY §2.5
+"Vision RAG"). Round-2 had VL chat only — this is the embedding half.
+"""
+
+import asyncio
+import base64
+import io
+import threading
+
+import numpy as np
+import pytest
+import requests
+
+from helix_tpu.control.profile import ProfileModel
+from helix_tpu.models.vision_embed import VisionEmbeddingRunner
+from helix_tpu.serving.tokenizer import ByteTokenizer
+
+
+def _png_b64(arr) -> str:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return base64.b64encode(buf.getvalue()).decode()
+
+
+def _runner():
+    pm = ProfileModel(name="tiny-vl-embed", kind="vision-embedding")
+    return VisionEmbeddingRunner.build(pm, ByteTokenizer())
+
+
+class TestRunner:
+    def test_text_vectors_normalised_and_deterministic(self):
+        r = _runner()
+        v = r.embed_texts(["hello world", "hello world", "other"])
+        assert v.shape == (3, r.model_cfg.hidden_size)
+        np.testing.assert_allclose(
+            np.linalg.norm(v, axis=1), 1.0, atol=1e-5
+        )
+        np.testing.assert_allclose(v[0], v[1], atol=1e-6)
+        assert not np.allclose(v[0], v[2])
+
+    def test_image_vectors_share_dimension(self):
+        r = _runner()
+        rng = np.random.RandomState(0)
+        imgs = [
+            _png_b64(rng.randint(0, 255, (56, 56, 3), np.uint8)),
+            _png_b64(np.zeros((56, 84, 3), np.uint8)),
+        ]
+        v = r.embed_images(imgs)
+        assert v.shape == (2, r.model_cfg.hidden_size)
+        np.testing.assert_allclose(
+            np.linalg.norm(v, axis=1), 1.0, atol=1e-4
+        )
+        assert not np.allclose(v[0], v[1])
+
+    def test_mixed_preserves_order(self):
+        r = _runner()
+        img = _png_b64(np.zeros((56, 56, 3), np.uint8))
+        mixed = r.embed_mixed(["a cat", {"image": img}, "a dog"])
+        assert mixed.shape[0] == 3
+        np.testing.assert_allclose(
+            mixed[0], r.embed_texts(["a cat"])[0], atol=1e-6
+        )
+        np.testing.assert_allclose(
+            mixed[1], r.embed_images([img])[0], atol=1e-6
+        )
+
+
+@pytest.fixture(scope="module")
+def vembed_url():
+    from helix_tpu.serving.openai_api import OpenAIServer
+    from helix_tpu.serving.registry import ModelRegistry, ServedModel
+
+    registry = ModelRegistry()
+    registry.register(
+        ServedModel(
+            name="tiny-vl-embed", loop=None, tokenizer=ByteTokenizer(),
+            kind="vision-embedding", embedder=_runner(),
+        )
+    )
+    srv = OpenAIServer(registry)
+    started = threading.Event()
+    holder = {}
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(srv.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 18437)
+        loop.run_until_complete(site.start())
+        holder["loop"] = loop
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    yield "http://127.0.0.1:18437"
+    holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+class TestHTTP:
+    def test_mixed_embeddings_over_http(self, vembed_url):
+        img = _png_b64(np.zeros((56, 56, 3), np.uint8))
+        r = requests.post(
+            f"{vembed_url}/v1/embeddings",
+            json={
+                "model": "tiny-vl-embed",
+                "input": ["a photo of a cat", {"image": img}],
+            },
+            timeout=60,
+        )
+        assert r.status_code == 200, r.text
+        doc = r.json()
+        assert len(doc["data"]) == 2
+        dims = {len(d["embedding"]) for d in doc["data"]}
+        assert len(dims) == 1          # text + image share one space
+        assert doc["usage"]["prompt_tokens"] > 0
+
+    def test_text_only_still_works(self, vembed_url):
+        r = requests.post(
+            f"{vembed_url}/v1/embeddings",
+            json={"model": "tiny-vl-embed", "input": "hello"},
+            timeout=30,
+        )
+        assert r.status_code == 200
+        assert len(r.json()["data"]) == 1
