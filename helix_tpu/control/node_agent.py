@@ -333,7 +333,13 @@ class NodeAgent:
                             self.registry.register(self._build(pm))
                             self.state.progress[name] = "ready"
                 self.state.status = "running"
-                self.state.models = sorted(want)
+                # multi-host FOLLOWERS replay the leader's journal and
+                # take no HTTP traffic: keep them out of the routable
+                # model list the router feeds on
+                self.state.models = sorted(
+                    name for name, pm in want.items()
+                    if pm.multihost.get("role", "") != "follower"
+                )
             except Exception as e:  # noqa: BLE001 — reported via status
                 self.state.status = "failed"
                 self.state.error = f"{e}\n{traceback.format_exc(limit=5)}"

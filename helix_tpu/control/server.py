@@ -396,11 +396,46 @@ class ControlPlane:
         from helix_tpu.control.notifications import NotificationService
 
         self.notifications = NotificationService.from_env()
+        # workspace manager: golden caches + orphan GC + disk pressure
+        # (reference: hydra golden.go / workspace_gc.go / disk_pressure.go)
+        from helix_tpu.services.workspaces import WorkspaceManager
+
+        ws_root = (
+            tempfile_dir()
+            if db_path == ":memory:"
+            else _os.path.join(
+                _os.path.dirname(_os.path.abspath(db_path)) or ".",
+                "helix-workspaces",
+            )
+        )
+        self.workspaces = WorkspaceManager(ws_root)
+
         self.orchestrator = SpecTaskOrchestrator(
             self.task_store, self.git, executor,
             notify=lambda kind, title, body="", **meta:
                 self.notifications.notify(kind, title, body, **meta),
+            workspaces=self.workspaces,
         ).start()
+
+        def _live_workspace_ids() -> set:
+            # in-flight tasks keep their clones; everything else is
+            # orphaned (reference: DB live-set fanned to sandboxes)
+            return {
+                f"{t.id}-plan" for t in self.task_store.list_tasks()
+                if t.status in ("planning", "implementing", "spec_review")
+            } | {
+                f"{t.id}-impl" for t in self.task_store.list_tasks()
+                if t.status in ("implementing", "pr_review")
+            }
+
+        self._workspace_pressure_stop = self.workspaces.start_pressure_loop(
+            on_pressure=lambda p: self.janitor.capture(
+                RuntimeError(f"disk pressure {p['level']}: "
+                             f"{p['used_pct']}% used"),
+                context="workspaces",
+            ),
+            gc_live_ids=_live_workspace_ids,
+        )
 
         # event bus (embedded-NATS equivalent) + filestore + triggers
         from helix_tpu.control.filestore import Filestore
@@ -814,6 +849,16 @@ class ControlPlane:
         r.add_delete("/api/v1/desktops/{id}", self.delete_desktop)
         r.add_get("/api/v1/desktops/{id}/ws/stream", self.ws_desktop_stream)
         r.add_get("/api/v1/desktops/{id}/ws/input", self.ws_desktop_input)
+        # agent settings sync (reference: settings-sync-daemon)
+        r.add_get("/api/v1/settings/agents", self.get_agent_settings)
+        r.add_put("/api/v1/settings/agents", self.put_agent_settings)
+        # workspace manager admin (golden caches / GC / disk pressure)
+        r.add_get("/api/v1/workspaces/golden", self.list_golden)
+        r.add_delete(
+            "/api/v1/workspaces/golden/{project}", self.drop_golden
+        )
+        r.add_post("/api/v1/workspaces/gc", self.workspaces_gc)
+        r.add_get("/api/v1/workspaces/pressure", self.workspaces_pressure)
         # pprof-equivalent debug surface (reference: /debug/pprof/,
         # server.go:59,1499-1500) — admin-gated when auth is on
         r.add_get("/debug/pprof/{kind}", self.debug_pprof)
@@ -1997,6 +2042,67 @@ class ControlPlane:
                 s.unsubscribe()
         return ws
 
+    async def get_agent_settings(self, request):
+        return web.json_response(
+            self.store.kv_get("agent_settings", {}) or {}
+        )
+
+    async def put_agent_settings(self, request):
+        """Persist agent settings and push them to every connected
+        external runner (reference: settings-sync-daemon syncing Zed /
+        agent settings into running desktops)."""
+        user = request.get("user")
+        if self.auth_required and not (user and user.admin):
+            return _err(403, "admin only")
+        body = await request.json()
+        if not isinstance(body, dict):
+            return _err(400, "settings must be a JSON object")
+        self.store.kv_set("agent_settings", body)
+        pushed = await asyncio.get_event_loop().run_in_executor(
+            None,
+            self.ws_runners.broadcast,
+            {"type": "settings", "settings": body},
+        )
+        return web.json_response({"ok": True, "pushed_to": pushed})
+
+    def _admin_only(self, request):
+        user = request.get("user")
+        if self.auth_required and not (user and user.admin):
+            return _err(403, "admin only")
+        return None
+
+    async def list_golden(self, request):
+        return web.json_response({"golden": self.workspaces.list_golden()})
+
+    async def drop_golden(self, request):
+        denied = self._admin_only(request)
+        if denied:
+            return denied
+        try:
+            ok = self.workspaces.drop_golden(request.match_info["project"])
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def workspaces_gc(self, request):
+        denied = self._admin_only(request)
+        if denied:
+            return denied
+        removed = await asyncio.get_event_loop().run_in_executor(
+            None,
+            self.workspaces.gc,
+            lambda: {
+                f"{t.id}-plan" for t in self.task_store.list_tasks()
+            } | {
+                f"{t.id}-impl" for t in self.task_store.list_tasks()
+            },
+            float(request.query.get("min_age_s", 3600)),
+        )
+        return web.json_response({"removed": removed})
+
+    async def workspaces_pressure(self, request):
+        return web.json_response(self.workspaces.disk_pressure())
+
     async def debug_pprof(self, request):
         """Runtime profiles (reference: Go pprof at /debug/pprof/)."""
         from helix_tpu.control import debug_profile as dp
@@ -2059,6 +2165,13 @@ class ControlPlane:
                 concurrency=int(first.get("concurrency", 1)),
             )
             self.ws_runners.register(runner_obj)
+            # late joiners receive the current agent settings immediately
+            # (reference: settings-sync-daemon)
+            settings = self.store.kv_get("agent_settings", None)
+            if settings:
+                await ws.send_json(
+                    {"type": "settings", "settings": settings}
+                )
 
             def on_log(tid, text):
                 self.bus.publish(

@@ -163,12 +163,22 @@ class VectorStore:
         indexes is still the current one."""
         from helix_tpu.knowledge import ann as _ann
 
+        def _index_for(mat_obj):
+            # an index is only ever used with the exact matrix object it
+            # was built over (stored as a (matrix, graph) pair) — a graph
+            # built over a newer matrix must not be paired with an older
+            # snapshot's ids
+            stored = self._ann.get(collection)
+            if stored is not None and stored[0] is mat_obj:
+                return stored[1]
+            return None
+
         with self._lock:
             cached = self._cache.get(collection)
             if cached is None:
                 cached = self._load_matrix_locked(collection)
             ids, mat = cached
-            index = self._ann.get(collection)
+            index = _index_for(mat)
             need_build = (
                 index is None
                 and mat is not None
@@ -183,14 +193,14 @@ class VectorStore:
             )
             with build_lock:
                 with self._lock:
-                    index = self._ann.get(collection)
+                    index = _index_for(mat)
                 if index is None:
                     index = _ann.HNSWIndex(mat.shape[1])
                     index.add_batch(mat)     # row position == ANN id
                     with self._lock:
                         cur = self._cache.get(collection)
                         if cur is not None and cur[1] is mat:
-                            self._ann[collection] = index
+                            self._ann[collection] = (mat, index)
                         # else: changed mid-build — the graph still
                         # matches OUR (ids, mat) snapshot; this query
                         # uses it, the next one rebuilds fresh

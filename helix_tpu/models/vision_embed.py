@@ -68,9 +68,14 @@ class VisionEmbeddingRunner:
     # -- pooling jits --------------------------------------------------------
     @functools.cached_property
     def _pool_text(self):
+        from helix_tpu.ops.quant import embed_lookup
+
         @jax.jit
-        def pool(embed_table, tokens, mask):
-            emb = embed_table[tokens].astype(jnp.float32)  # [B, S, E]
+        def pool(embed_params, tokens, mask):
+            # embed_lookup handles both plain and row-quantized (int8 +
+            # embed_scale) tables — hand-rolled dequant here previously
+            # risked pooling raw int8 rows into garbage vectors
+            emb = embed_lookup(embed_params, tokens, jnp.float32)
             m = mask[..., None].astype(emb.dtype)
             summed = (emb * m).sum(axis=1)
             count = jnp.maximum(m.sum(axis=1), 1.0)
@@ -104,14 +109,8 @@ class VisionEmbeddingRunner:
         for i, t in enumerate(token_lists):
             toks[i, : len(t)] = t
             mask[i, : len(t)] = 1
-        table = self.params["embed"]["weight"]
-        if isinstance(table, dict):      # int8-quantized embed table
-            table = (
-                table["weight"].astype(jnp.float32)
-                * table.get("embed_scale", table.get("scale"))
-            )
         out = self._pool_text(
-            table, jnp.asarray(toks), jnp.asarray(mask)
+            self.params["embed"], jnp.asarray(toks), jnp.asarray(mask)
         )
         return np.asarray(out, np.float32)
 

@@ -93,6 +93,24 @@ class GitService:
         _run(["git", "-C", dest, "config", "user.name", "helix-agent"])
         return dest
 
+    def refresh_workspace(
+        self, dest: str, branch: Optional[str] = None
+    ) -> None:
+        """Bring an EXISTING clone (e.g. a golden hardlink clone that
+        already carries .git + warm build artifacts) up to date: fetch
+        origin and hard-switch to ``branch`` (default branch when None).
+        Non-git files the snapshot carried stay in place — that warmth
+        is the point of golden caches."""
+        _run(["git", "-C", dest, "fetch", "-q", "origin"])
+        if branch is None:
+            head = _run(
+                ["git", "-C", dest, "symbolic-ref", "-q", "--short",
+                 "refs/remotes/origin/HEAD"], check=False,
+            ).decode().strip()
+            branch = head.split("/", 1)[1] if "/" in head else "main"
+        _run(["git", "-C", dest, "checkout", "-q", "-B", branch,
+              f"origin/{branch}"])
+
     def commit_and_push(
         self, workspace: str, message: str, branch: str
     ) -> Optional[str]:

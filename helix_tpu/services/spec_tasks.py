@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import os
 import shutil
 import sqlite3
@@ -26,6 +27,8 @@ import time
 import traceback
 import uuid
 from typing import Callable, Optional
+
+log = logging.getLogger("helix.spectasks")
 
 from helix_tpu.services.git_service import GitService
 
@@ -438,6 +441,7 @@ class SpecTaskOrchestrator:
         external_git: Optional[ExternalGitSync] = None,
         max_ci_attempts: int = 2,
         notify: Optional[Callable] = None,
+        workspaces=None,   # WorkspaceManager: golden caches + GC
     ):
         self.store = store
         self.git = git
@@ -447,6 +451,7 @@ class SpecTaskOrchestrator:
         self.max_ci_attempts = max_ci_attempts
         # notify(kind, title, body, **meta) — email/Slack/Discord fan-out
         self.notify = notify or (lambda *a, **k: None)
+        self.workspaces = workspaces
         self.poll_interval = poll_interval
         self.workspace_root = workspace_root or tempfile.mkdtemp(
             prefix="helix-workspaces-"
@@ -519,11 +524,40 @@ class SpecTaskOrchestrator:
         task.spec_path = f"specs/{task.id}.md"
         self.store.update_task(task)
 
-    def _handle_planning(self, task: SpecTask, revision: bool = False):
-        ws = os.path.join(self.workspace_root, f"{task.id}-plan")
+    def _workspace(self, task: SpecTask, suffix: str,
+                   branch: Optional[str] = None) -> str:
+        """Fresh agent workspace: a hardlink clone of the project's
+        golden snapshot when one exists (warm deps + .git — reference:
+        hydra golden caches seeding dev-container workspaces), else a
+        plain git clone.  Either way the tree ends on ``branch``."""
+        if self.workspaces is not None:
+            try:
+                if self.workspaces.golden_info(task.project) is not None:
+                    ws = self.workspaces.clone_workspace(
+                        task.project, f"{task.id}-{suffix}"
+                    )
+                    self.git.refresh_workspace(ws, branch)
+                    return ws
+            except Exception:  # noqa: BLE001 — a bad snapshot falls
+                log.debug("golden seed failed", exc_info=True)  # back
+        ws = os.path.join(self.workspace_root, f"{task.id}-{suffix}")
         shutil.rmtree(ws, ignore_errors=True)
+        self.git.clone_workspace(task.project, ws, branch=branch)
+        return ws
+
+    def _release_workspace(self, task: SpecTask, suffix: str,
+                           ws: str) -> None:
+        shutil.rmtree(ws, ignore_errors=True)
+        if self.workspaces is not None:
+            try:
+                self.workspaces.release_workspace(f"{task.id}-{suffix}")
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _handle_planning(self, task: SpecTask, revision: bool = False):
+        ws = None
         try:
-            self.git.clone_workspace(task.project, ws)
+            ws = self._workspace(task, "plan")
             feedback = ""
             if revision:
                 feedback = "\n".join(
@@ -545,7 +579,8 @@ class SpecTaskOrchestrator:
         except Exception as e:  # noqa: BLE001
             self._fail(task, f"planning failed: {e}")
         finally:
-            shutil.rmtree(ws, ignore_errors=True)
+            if ws is not None:
+                self._release_workspace(task, "plan", ws)
 
     def review_spec(self, task_id: str, author: str, decision: str,
                     comment: str = "") -> SpecTask:
@@ -568,8 +603,7 @@ class SpecTaskOrchestrator:
     def _handle_implementation(self, task: SpecTask):
         task.status = "implementing"
         self.store.update_task(task)
-        ws = os.path.join(self.workspace_root, f"{task.id}-impl")
-        shutil.rmtree(ws, ignore_errors=True)
+        ws = None
         try:
             # CI-fix retries continue on the task branch (incremental),
             # first attempts start from the default branch
@@ -579,7 +613,7 @@ class SpecTaskOrchestrator:
                 and self.git.branch_exists(task.project, task.task_branch)
                 else None
             )
-            self.git.clone_workspace(task.project, ws, branch=retry_branch)
+            ws = self._workspace(task, "impl", branch=retry_branch)
             # bring the spec into the working tree
             spec = self.git.file_at(
                 task.project, task.spec_branch, task.spec_path
@@ -604,6 +638,15 @@ class SpecTaskOrchestrator:
             )
             if sha is None and not feedback:
                 raise RuntimeError("implementation agent changed nothing")
+            if self.workspaces is not None:
+                # promote the post-implementation tree (checkout + any
+                # deps the agent installed) as the project's golden
+                # cache: the next agent's workspace hardlink-clones it
+                # (reference: hydra/golden.go promote-session-to-golden)
+                try:
+                    self.workspaces.promote_golden(task.project, ws)
+                except Exception:  # noqa: BLE001 — cache only
+                    log.debug("golden promote failed", exc_info=True)
             task.pr_id = self.store.create_pr(
                 task.project, task.id, task.title, "main", task.task_branch
             )
@@ -615,7 +658,8 @@ class SpecTaskOrchestrator:
         except Exception as e:  # noqa: BLE001
             self._fail(task, f"implementation failed: {e}")
         finally:
-            shutil.rmtree(ws, ignore_errors=True)
+            if ws is not None:
+                self._release_workspace(task, "impl", ws)
 
     def _handle_pr_review(self, task: SpecTask) -> bool:
         """PR/CI completion loop (``spec_task_orchestrator.go:1074-1201``):
@@ -667,26 +711,27 @@ class SpecTaskOrchestrator:
         if pr["ci_status"] not in ("pending", "running"):
             return False
         self.store.set_pr_ci(pr["id"], "running")
-        ws = os.path.join(self.workspace_root, f"{task.id}-ci")
-        shutil.rmtree(ws, ignore_errors=True)
+        ws = None
         try:
-            self.git.clone_workspace(task.project, ws, branch=pr["head"])
-            passed, log = self.ci.run(task.project, ws)
+            # CI gets the golden warmth too (deps already installed)
+            ws = self._workspace(task, "ci", branch=pr["head"])
+            passed, ci_log = self.ci.run(task.project, ws)
         except Exception as e:  # noqa: BLE001 — CI infra failure != red CI
             self.store.set_pr_ci(task.pr_id, "pending")
             task.error = f"ci infra error: {e}"[:2000]
             self.store.update_task(task)
             return False
         finally:
-            shutil.rmtree(ws, ignore_errors=True)
+            if ws is not None:
+                self._release_workspace(task, "ci", ws)
         if passed is None:
             self.store.set_pr_ci(pr["id"], "none")
             return True
         if passed:
-            self.store.set_pr_ci(pr["id"], "passed", log)
+            self.store.set_pr_ci(pr["id"], "passed", ci_log)
             return True
-        self.store.set_pr_ci(pr["id"], "failed", log)
-        self._ci_failed(task, pr, log)
+        self.store.set_pr_ci(pr["id"], "failed", ci_log)
+        self._ci_failed(task, pr, ci_log)
         return True
 
     def _ci_failed(self, task: SpecTask, pr: dict, log: str) -> None:

@@ -1,0 +1,121 @@
+"""TTS sidecar: /v1/audio/speech serving WAV.
+
+Reference: ``tts-server/`` — an optional sidecar wrapping a neural TTS
+engine behind a small HTTP surface.  This build keeps the same shape:
+an OpenAI-compatible ``/v1/audio/speech`` route with a pluggable
+``synthesize(text, voice, speed) -> (pcm16, sample_rate)`` backend.  The
+built-in backend is a dependency-free formant synthesizer (diphone-ish
+vowel formants + noise bursts for consonants) — intelligibility is not
+the point; the API surface, WAV plumbing, and backend seam are, and a
+neural acoustic model drops into the same seam.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import wave
+
+import numpy as np
+
+SAMPLE_RATE = 16_000
+
+# coarse letter -> (f1, f2) vowel formants / noise flags
+_VOWELS = {
+    "a": (730, 1090), "e": (530, 1840), "i": (270, 2290),
+    "o": (570, 840), "u": (300, 870), "y": (270, 2100),
+}
+_VOICED = set("bdgjlmnrvwz")
+
+
+def _segment(ch: str, dur_s: float, f0: float, sr: int) -> np.ndarray:
+    n = max(int(dur_s * sr), 1)
+    t = np.arange(n) / sr
+    env = np.hanning(n)
+    if ch in _VOWELS:
+        f1, f2 = _VOWELS[ch]
+        carrier = (
+            0.6 * np.sign(np.sin(2 * np.pi * f0 * t))  # glottal-ish buzz
+        )
+        formant = (
+            0.5 * np.sin(2 * np.pi * f1 * t)
+            + 0.35 * np.sin(2 * np.pi * f2 * t)
+        )
+        return env * carrier * (0.5 + 0.5 * formant)
+    if ch.isalpha():
+        rng = np.random.default_rng(ord(ch))
+        noise = rng.standard_normal(n) * 0.3
+        if ch in _VOICED:
+            noise += 0.4 * np.sin(2 * np.pi * f0 * t)
+        return env * noise
+    return np.zeros(n)   # space / punctuation = silence
+
+
+def formant_synthesize(
+    text: str, voice: str = "default", speed: float = 1.0,
+    sample_rate: int = SAMPLE_RATE,
+) -> tuple:
+    """-> (int16 pcm array, sample_rate)."""
+    f0 = {"default": 120.0, "alto": 180.0, "bass": 90.0}.get(voice, 120.0)
+    speed = min(max(speed, 0.25), 4.0)
+    base = 0.09 / speed
+    parts = [
+        _segment(ch, base * (1.4 if ch in _VOWELS else 0.8), f0,
+                 sample_rate)
+        for ch in text.lower()[:2000]
+    ] or [np.zeros(sample_rate // 10)]
+    pcm = np.concatenate(parts)
+    peak = np.max(np.abs(pcm)) or 1.0
+    return (pcm / peak * 0.8 * 32767).astype(np.int16), sample_rate
+
+
+def to_wav_bytes(pcm: np.ndarray, sample_rate: int) -> bytes:
+    buf = io.BytesIO()
+    with wave.open(buf, "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sample_rate)
+        w.writeframes(pcm.tobytes())
+    return buf.getvalue()
+
+
+class TTSService:
+    def __init__(self, synthesize=None):
+        self.synthesize = synthesize or formant_synthesize
+
+    def speech(self, text: str, voice: str = "default",
+               speed: float = 1.0) -> bytes:
+        pcm, sr = self.synthesize(text, voice=voice, speed=speed)
+        return to_wav_bytes(np.asarray(pcm, np.int16), sr)
+
+    def build_app(self):
+        from aiohttp import web
+
+        async def speech(request):
+            try:
+                body = await request.json()
+            except Exception:
+                return web.json_response(
+                    {"error": {"message": "invalid JSON"}}, status=400
+                )
+            text = body.get("input", "")
+            if not text:
+                return web.json_response(
+                    {"error": {"message": "'input' required"}}, status=400
+                )
+            import asyncio
+
+            wav = await asyncio.get_running_loop().run_in_executor(
+                None, self.speech, text,
+                body.get("voice", "default"),
+                float(body.get("speed", 1.0)),
+            )
+            return web.Response(body=wav, content_type="audio/wav")
+
+        async def healthz(request):
+            return web.json_response({"status": "ok"})
+
+        app = web.Application()
+        app.router.add_post("/v1/audio/speech", speech)
+        app.router.add_get("/healthz", healthz)
+        return app

@@ -1,0 +1,264 @@
+"""Workspace manager: golden caches, hardlink clones, GC, disk pressure.
+
+Reference: hydra's per-GPU-host workspace machinery —
+**golden cache snapshots** per project cloned via overlayfs/ZFS zvols so
+a new agent desktop starts from a warm build environment
+(``api/pkg/hydra/golden.go:17-31``, ``golden_zvol.go``), a durable
+**orphan reaper** computing a DB live-set and GC-ing everything else
+(``api/pkg/hydra/workspace_gc.go``, ``external-agent/gc_reaper.go``),
+and a **disk-pressure monitor** (``api/pkg/hydra/disk_pressure.go``).
+
+This build's agents run in process sandboxes over plain directories, so
+the same capabilities map to filesystem primitives:
+
+- golden snapshots are directory trees captured from a prepared
+  workspace; **clones hardlink file content** (`os.link`) so a clone of
+  a multi-GB dependency tree costs directory entries, not bytes — the
+  overlay/zvol trick without kernel support.  Agents that WRITE a file
+  break the link only if they truncate in place; git and package
+  managers replace files, which is hardlink-safe.
+- GC walks the workspace root against a caller-supplied live-set.
+- disk pressure samples `os.statvfs` and reports watermarks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import uuid
+from typing import Callable, Optional
+
+log = logging.getLogger("helix.workspaces")
+
+
+@dataclasses.dataclass
+class GoldenInfo:
+    project: str
+    snapshot_id: str
+    created_at: float
+    files: int
+    bytes: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _tree_stats(root: str) -> tuple:
+    files = 0
+    size = 0
+    for r, _, fs in os.walk(root):
+        for f in fs:
+            p = os.path.join(r, f)
+            try:
+                st = os.lstat(p)
+            except OSError:
+                continue
+            files += 1
+            size += st.st_size
+    return files, size
+
+
+def clone_tree(src: str, dst: str) -> None:
+    """Hardlink-clone ``src`` into ``dst`` (same filesystem): directories
+    are recreated, regular files hardlinked, symlinks copied. Falls back
+    to a byte copy per file when linking fails (cross-device)."""
+    os.makedirs(dst, exist_ok=True)
+    for r, dirs, files in os.walk(src):
+        rel = os.path.relpath(r, src)
+        target_dir = os.path.join(dst, rel) if rel != "." else dst
+        for d in dirs:
+            os.makedirs(os.path.join(target_dir, d), exist_ok=True)
+        for f in files:
+            sp = os.path.join(r, f)
+            tp = os.path.join(target_dir, f)
+            if os.path.islink(sp):
+                os.symlink(os.readlink(sp), tp)
+                continue
+            try:
+                os.link(sp, tp)
+            except OSError:
+                shutil.copy2(sp, tp)
+
+
+class WorkspaceManager:
+    """Owns a workspace root: golden snapshots + clones + GC + pressure."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.golden_root = os.path.join(root, ".golden")
+        self.clones_root = os.path.join(root, "clones")
+        os.makedirs(self.golden_root, exist_ok=True)
+        os.makedirs(self.clones_root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    # -- golden snapshots ---------------------------------------------------
+    @staticmethod
+    def _safe_name(name: str) -> str:
+        """Project/owner names become path segments: one flat component,
+        no separators or dot-traversal (the HTTP layer passes route
+        segments through verbatim)."""
+        if (
+            not name
+            or name in (".", "..")
+            or "/" in name
+            or "\\" in name
+            or "\x00" in name
+        ):
+            raise ValueError(f"invalid workspace name {name!r}")
+        return name
+
+    def _golden_dir(self, project: str) -> str:
+        return os.path.join(self.golden_root, self._safe_name(project))
+
+    def promote_golden(self, project: str, workspace: str) -> GoldenInfo:
+        """Capture ``workspace`` as the project's golden snapshot
+        (reference: promote-session-to-golden, hydra/golden.go:33-49).
+        Atomic swap: built next to the old snapshot, renamed over it."""
+        snap_id = f"gold-{uuid.uuid4().hex[:10]}"
+        final = self._golden_dir(project)
+        tmp = final + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        clone_tree(workspace, tmp)
+        # never snapshot VCS-internal lock files mid-operation
+        files, size = _tree_stats(tmp)
+        info = GoldenInfo(
+            project=project, snapshot_id=snap_id,
+            created_at=time.time(), files=files, bytes=size,
+        )
+        with open(os.path.join(tmp, ".golden.json"), "w") as f:
+            json.dump(info.to_dict(), f)
+        with self._lock:
+            old = final + ".old"
+            shutil.rmtree(old, ignore_errors=True)
+            if os.path.exists(final):
+                os.rename(final, old)
+            os.rename(tmp, final)
+            shutil.rmtree(old, ignore_errors=True)
+        return info
+
+    def golden_info(self, project: str) -> Optional[GoldenInfo]:
+        path = os.path.join(self._golden_dir(project), ".golden.json")
+        try:
+            with open(path) as f:
+                return GoldenInfo(**json.load(f))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def list_golden(self) -> list:
+        out = []
+        for name in sorted(os.listdir(self.golden_root)):
+            info = self.golden_info(name)
+            if info is not None:
+                out.append(info.to_dict())
+        return out
+
+    def drop_golden(self, project: str) -> bool:
+        with self._lock:
+            path = self._golden_dir(project)
+            if not os.path.exists(path):
+                return False
+            shutil.rmtree(path, ignore_errors=True)
+            return True
+
+    # -- clones -------------------------------------------------------------
+    def clone_workspace(self, project: str, owner_id: str) -> str:
+        """New workspace for ``owner_id`` seeded from the golden snapshot
+        when one exists (warm deps/git), else empty.  Hardlink clones
+        make warm starts ~free (the 193x BuildKit-cache effect,
+        ``design/2026-02-21-smart-load-blog.md``, by filesystem means)."""
+        dst = os.path.join(self.clones_root, self._safe_name(owner_id))
+        shutil.rmtree(dst, ignore_errors=True)
+        golden = self._golden_dir(project)
+        with self._lock:
+            if os.path.isdir(golden):
+                clone_tree(golden, dst)
+                # the marker belongs to the snapshot, not the clone
+                try:
+                    os.remove(os.path.join(dst, ".golden.json"))
+                except OSError:
+                    pass
+            else:
+                os.makedirs(dst, exist_ok=True)
+        return dst
+
+    def release_workspace(self, owner_id: str) -> None:
+        shutil.rmtree(
+            os.path.join(self.clones_root, self._safe_name(owner_id)),
+            ignore_errors=True,
+        )
+
+    # -- GC (orphan reaper) -------------------------------------------------
+    def gc(self, live_ids: Callable[[], set], min_age_s: float = 3600.0,
+           ) -> list:
+        """Remove clone workspaces whose owner is not in the live-set and
+        whose mtime is older than ``min_age_s`` (grace for races between
+        workspace creation and DB persistence — reference gc_reaper)."""
+        live = set(live_ids())
+        removed = []
+        now = time.time()
+        for name in os.listdir(self.clones_root):
+            if name in live:
+                continue
+            path = os.path.join(self.clones_root, name)
+            try:
+                age = now - os.stat(path).st_mtime
+            except OSError:
+                continue
+            if age < min_age_s:
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            removed.append(name)
+        if removed:
+            log.info("workspace gc removed %d orphans", len(removed))
+        return removed
+
+    # -- disk pressure ------------------------------------------------------
+    def disk_pressure(self, high_pct: float = 85.0,
+                      critical_pct: float = 95.0) -> dict:
+        st = os.statvfs(self.root)
+        total = st.f_blocks * st.f_frsize
+        free = st.f_bavail * st.f_frsize
+        used_pct = 100.0 * (1 - free / total) if total else 0.0
+        level = "ok"
+        if used_pct >= critical_pct:
+            level = "critical"
+        elif used_pct >= high_pct:
+            level = "high"
+        return {
+            "total_bytes": total,
+            "free_bytes": free,
+            "used_pct": round(used_pct, 1),
+            "level": level,
+        }
+
+    def start_pressure_loop(
+        self, interval_s: float = 60.0,
+        on_pressure: Optional[Callable[[dict], None]] = None,
+        gc_live_ids: Optional[Callable[[], set]] = None,
+    ):
+        """Background monitor: at 'high' it triggers an early GC; at
+        'critical' it also drops golden snapshots (rebuildable caches go
+        first, reference disk_pressure.go)."""
+        stop = threading.Event()
+
+        def run():
+            while not stop.is_set():
+                p = self.disk_pressure()
+                if p["level"] != "ok":
+                    if on_pressure is not None:
+                        on_pressure(p)
+                    if gc_live_ids is not None:
+                        self.gc(gc_live_ids, min_age_s=0)
+                    if p["level"] == "critical":
+                        for info in self.list_golden():
+                            self.drop_golden(info["project"])
+                stop.wait(interval_s)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return stop

@@ -108,6 +108,21 @@ class WSRunnerRegistry:
         with self._lock:
             return [r.to_dict() for r in self._runners.values()]
 
+    def broadcast(self, frame: dict) -> int:
+        """Best-effort frame to every connected runner (settings sync —
+        reference: settings-sync-daemon pushing Zed/agent settings into
+        running desktops). Returns how many runners received it."""
+        with self._lock:
+            runners = list(self._runners.values())
+        n = 0
+        for r in runners:
+            try:
+                r.send(frame)
+                n += 1
+            except Exception:  # noqa: BLE001 — a dead socket is handled
+                pass           # by its own connection teardown
+        return n
+
     def pick(self, agent: Optional[str] = None) -> Optional[WSRunner]:
         """Least-loaded runner with free capacity (optionally filtered by
         agent type)."""

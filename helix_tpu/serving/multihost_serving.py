@@ -70,6 +70,15 @@ class CommandLog:
                         f"follower at seq {since} fell behind the ring "
                         f"(first retained: {self._first})"
                     )
+                if since >= self._next:
+                    # AHEAD of the journal: the leader restarted and its
+                    # sequence reset — silent empty polls here would hang
+                    # the whole cluster mid-collective; fail loudly so
+                    # the follower restarts and resyncs
+                    raise LagError(
+                        f"follower at seq {since} is ahead of the "
+                        f"journal (next: {self._next}) — leader restart?"
+                    )
                 out = [r for r in self._records if r["seq"] > since]
                 if out:
                     return out

@@ -256,6 +256,23 @@ class OpenAIServer:
             )
         return served, None
 
+    @staticmethod
+    def _require_loop(served, model: str):
+        """Generation needs a live engine loop; embedding-only workers
+        and multi-host FOLLOWERS (journal replay, no local traffic) have
+        loop=None and must answer with a clean error, not a 500."""
+        if served.loop is not None:
+            return None
+        if served.follower is not None:
+            return _error(
+                409,
+                f"'{model}' is a multi-host follower replica on this "
+                "host; send traffic to the leader",
+            )
+        return _error(
+            404, f"'{model}' does not serve generation", "model_not_found"
+        )
+
     def _sampling_from_body(self, body: dict) -> SamplingParams:
         stop = body.get("stop") or []
         if isinstance(stop, str):
@@ -335,6 +352,9 @@ class OpenAIServer:
         if served.kind == "embedding":
             return _error(404, f"model '{model}' is an embedding model",
                           "model_not_found")
+        err = self._require_loop(served, model)
+        if err is not None:
+            return err
         messages = body.get("messages")
         if not messages:
             return _error(400, "'messages' is required")
@@ -463,6 +483,9 @@ class OpenAIServer:
             return _error(400, "invalid JSON body")
         model = body.get("model", "")
         served, err = await self._lookup(model)
+        if err is not None:
+            return err
+        err = self._require_loop(served, model)
         if err is not None:
             return err
         prompt = body.get("prompt", "")
@@ -605,6 +628,9 @@ class OpenAIServer:
             return _error(400, "invalid JSON body")
         model = body.get("model", "")
         served, err = await self._lookup(model)
+        if err is not None:
+            return err
+        err = self._require_loop(served, model)
         if err is not None:
             return err
         messages = list(body.get("messages", []))

@@ -39,6 +39,10 @@ class ModelRegistry:
 
     def unregister(self, name: str):
         m = self._models.pop(name, None)
+        if m is not None and m.follower is not None:
+            # a zombie follower would keep replaying the journal against
+            # the torn-down engine (duplicate collective participation)
+            m.follower.stop()
         if m and m.loop:
             m.loop.stop(join=False)
 

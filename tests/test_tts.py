@@ -1,0 +1,73 @@
+"""TTS sidecar: /v1/audio/speech WAV plumbing (reference: tts-server/)."""
+
+import asyncio
+import io
+import threading
+import wave
+
+import requests
+
+from helix_tpu.services.tts import (
+    SAMPLE_RATE,
+    TTSService,
+    formant_synthesize,
+    to_wav_bytes,
+)
+
+
+class TestSynth:
+    def test_duration_scales_with_text_and_speed(self):
+        short, sr = formant_synthesize("hi")
+        long, _ = formant_synthesize("hello there friend")
+        fast, _ = formant_synthesize("hello there friend", speed=2.0)
+        assert len(long) > len(short)
+        assert abs(len(fast) - len(long) / 2) < sr * 0.2
+
+    def test_wav_bytes_valid(self):
+        pcm, sr = formant_synthesize("test")
+        data = to_wav_bytes(pcm, sr)
+        with wave.open(io.BytesIO(data)) as w:
+            assert w.getframerate() == SAMPLE_RATE
+            assert w.getnchannels() == 1
+            assert w.getnframes() == len(pcm)
+
+    def test_empty_text_still_produces_audio(self):
+        pcm, _ = formant_synthesize("")
+        assert len(pcm) > 0
+
+
+class TestHTTP:
+    def test_speech_endpoint(self):
+        svc = TTSService()
+        started = threading.Event()
+        holder = {}
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            from aiohttp import web
+
+            runner = web.AppRunner(svc.build_app())
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", 18443)
+            loop.run_until_complete(site.start())
+            holder["loop"] = loop
+            started.set()
+            loop.run_forever()
+
+        threading.Thread(target=run, daemon=True).start()
+        assert started.wait(10)
+        r = requests.post(
+            "http://127.0.0.1:18443/v1/audio/speech",
+            json={"input": "hello world", "voice": "alto"},
+            timeout=30,
+        )
+        assert r.status_code == 200
+        assert r.headers["Content-Type"] == "audio/wav"
+        with wave.open(io.BytesIO(r.content)) as w:
+            assert w.getnframes() > 0
+        assert requests.post(
+            "http://127.0.0.1:18443/v1/audio/speech", json={},
+            timeout=5,
+        ).status_code == 400
+        holder["loop"].call_soon_threadsafe(holder["loop"].stop)

@@ -1,0 +1,240 @@
+"""Workspace manager: golden caches, hardlink clones, GC, disk pressure.
+
+Reference: hydra golden caches (``api/pkg/hydra/golden.go:17-31``),
+workspace GC against a live-set (``workspace_gc.go`` +
+``external-agent/gc_reaper.go``), disk pressure (``disk_pressure.go``).
+"""
+
+import os
+import time
+
+import requests
+
+from helix_tpu.services.workspaces import WorkspaceManager, clone_tree
+
+
+def _make_tree(root, files):
+    for rel, content in files.items():
+        p = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "w") as f:
+            f.write(content)
+
+
+class TestCloneTree:
+    def test_hardlinks_not_copies(self, tmp_path):
+        src = str(tmp_path / "src")
+        _make_tree(src, {"a.txt": "x" * 100, "deps/lib.py": "code"})
+        dst = str(tmp_path / "dst")
+        clone_tree(src, dst)
+        s = os.stat(os.path.join(src, "a.txt"))
+        d = os.stat(os.path.join(dst, "a.txt"))
+        assert s.st_ino == d.st_ino            # same inode: zero-copy
+        assert open(os.path.join(dst, "deps/lib.py")).read() == "code"
+
+    def test_replacing_a_file_does_not_leak_into_the_source(self, tmp_path):
+        """Package managers REPLACE files (write+rename) — hardlink-safe."""
+        src = str(tmp_path / "src")
+        _make_tree(src, {"a.txt": "original"})
+        dst = str(tmp_path / "dst")
+        clone_tree(src, dst)
+        tmp = os.path.join(dst, "a.txt.new")
+        with open(tmp, "w") as f:
+            f.write("replaced")
+        os.replace(tmp, os.path.join(dst, "a.txt"))
+        assert open(os.path.join(src, "a.txt")).read() == "original"
+
+    def test_symlinks_preserved(self, tmp_path):
+        src = str(tmp_path / "src")
+        _make_tree(src, {"real.txt": "data"})
+        os.symlink("real.txt", os.path.join(src, "link.txt"))
+        dst = str(tmp_path / "dst")
+        clone_tree(src, dst)
+        assert os.readlink(os.path.join(dst, "link.txt")) == "real.txt"
+
+
+class TestGolden:
+    def test_promote_clone_and_atomic_replace(self, tmp_path):
+        wm = WorkspaceManager(str(tmp_path / "root"))
+        ws = str(tmp_path / "prepared")
+        _make_tree(ws, {"deps/big.bin": "B" * 1000, "src/app.py": "v1"})
+        info = wm.promote_golden("webapp", ws)
+        assert info.files == 2 and info.bytes == 1002
+        assert wm.golden_info("webapp").snapshot_id == info.snapshot_id
+        # clone seeds from golden, without the marker file
+        c = wm.clone_workspace("webapp", "task1-impl")
+        assert open(os.path.join(c, "src/app.py")).read() == "v1"
+        assert not os.path.exists(os.path.join(c, ".golden.json"))
+        # re-promote replaces atomically
+        _make_tree(ws, {"src/app.py": "v2"})
+        wm.promote_golden("webapp", ws)
+        c2 = wm.clone_workspace("webapp", "task2-impl")
+        assert open(os.path.join(c2, "src/app.py")).read() == "v2"
+        assert len(wm.list_golden()) == 1
+
+    def test_clone_without_golden_is_empty(self, tmp_path):
+        wm = WorkspaceManager(str(tmp_path / "root"))
+        c = wm.clone_workspace("nogold", "t1")
+        assert os.path.isdir(c) and not os.listdir(c)
+
+    def test_drop_golden(self, tmp_path):
+        wm = WorkspaceManager(str(tmp_path / "root"))
+        ws = str(tmp_path / "w")
+        _make_tree(ws, {"f": "x"})
+        wm.promote_golden("p", ws)
+        assert wm.drop_golden("p")
+        assert not wm.drop_golden("p")
+        assert wm.list_golden() == []
+
+
+class TestGC:
+    def test_orphans_reaped_live_and_young_kept(self, tmp_path):
+        wm = WorkspaceManager(str(tmp_path / "root"))
+        for name in ("t1-impl", "t2-impl", "t3-impl"):
+            os.makedirs(os.path.join(wm.clones_root, name))
+        # backdate t1 + t2
+        old = time.time() - 7200
+        for name in ("t1-impl", "t2-impl"):
+            os.utime(os.path.join(wm.clones_root, name), (old, old))
+        removed = wm.gc(lambda: {"t1-impl"}, min_age_s=3600)
+        assert removed == ["t2-impl"]          # live kept, young kept
+        assert os.path.isdir(os.path.join(wm.clones_root, "t1-impl"))
+        assert os.path.isdir(os.path.join(wm.clones_root, "t3-impl"))
+
+
+class TestPressure:
+    def test_levels(self, tmp_path):
+        wm = WorkspaceManager(str(tmp_path / "root"))
+        p = wm.disk_pressure()
+        assert p["level"] in ("ok", "high", "critical")
+        assert p["total_bytes"] > 0
+        # forced thresholds exercise the classification
+        assert wm.disk_pressure(high_pct=0.0)["level"] in (
+            "high", "critical"
+        )
+        assert wm.disk_pressure(
+            high_pct=0.0, critical_pct=0.0
+        )["level"] == "critical"
+
+
+class TestOrchestratorIntegration:
+    def test_implementation_promotes_golden_and_next_task_consumes_it(
+        self, tmp_path
+    ):
+        """The kanban loop promotes the post-implementation tree and the
+        NEXT task's workspace is hardlink-seeded from it (reference:
+        hydra golden caches warming dev-container workspaces)."""
+        from helix_tpu.services.git_service import GitService
+        from helix_tpu.services.spec_tasks import (
+            SpecTaskOrchestrator,
+            TaskStore,
+        )
+
+        git = GitService(str(tmp_path / "repos"))
+        store = TaskStore()
+        wm = WorkspaceManager(str(tmp_path / "ws-root"))
+        seen_workspaces = []
+
+        class ScriptedExecutor:
+            def run(self, task, workspace, mode, feedback=""):
+                seen_workspaces.append((mode, workspace))
+                if mode == "plan":
+                    p = os.path.join(workspace, task.spec_path)
+                    os.makedirs(os.path.dirname(p), exist_ok=True)
+                    with open(p, "w") as f:
+                        f.write("# spec\n")
+                else:
+                    # simulate installed deps next to the code
+                    os.makedirs(
+                        os.path.join(workspace, "deps"), exist_ok=True
+                    )
+                    with open(
+                        os.path.join(workspace, "deps", "lib.bin"), "w"
+                    ) as f:
+                        f.write("D" * 500)
+                    with open(
+                        os.path.join(workspace, "main.py"), "w"
+                    ) as f:
+                        f.write("print('hi')\n")
+                return "ok"
+
+        orch = SpecTaskOrchestrator(
+            store, git, ScriptedExecutor(), workspaces=wm,
+            poll_interval=0.1,
+        )
+        t1 = store.create_task("webapp", "first")
+        orch._handle_backlog(t1)
+        orch._handle_planning(t1)
+        assert t1.status == "spec_review", t1.error
+        t1.status = "implementation_queued"
+        t1.task_branch = "task/t1"
+        orch._handle_implementation(t1)
+        assert t1.status == "pr_review", t1.error
+        assert wm.golden_info("webapp") is not None
+        # second task's planning workspace comes from the golden clone
+        t2 = store.create_task("webapp", "second")
+        orch._handle_backlog(t2)
+        orch._handle_planning(t2)
+        assert t2.status == "spec_review", t2.error
+        mode, ws2 = seen_workspaces[-1]
+        assert ws2.startswith(wm.clones_root)
+        orch.stop()
+
+    def test_traversal_names_rejected(self, tmp_path):
+        wm = WorkspaceManager(str(tmp_path / "root"))
+        import pytest
+
+        for bad in ("..", "a/b", "", "x\\y"):
+            with pytest.raises(ValueError):
+                wm.drop_golden(bad)
+            with pytest.raises(ValueError):
+                wm.clone_workspace(bad, "owner")
+
+
+class TestHTTPSurface:
+    def test_admin_routes(self, tmp_path):
+        import asyncio
+        import threading
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+        ws = str(tmp_path / "prepared")
+        _make_tree(ws, {"f.py": "x"})
+        cp.workspaces.promote_golden("webapp", ws)
+        started = threading.Event()
+        holder = {}
+
+        def run():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            from aiohttp import web
+
+            runner = web.AppRunner(cp.build_app())
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", 18441)
+            loop.run_until_complete(site.start())
+            holder["loop"] = loop
+            started.set()
+            loop.run_forever()
+
+        threading.Thread(target=run, daemon=True).start()
+        assert started.wait(10)
+        url = "http://127.0.0.1:18441"
+        golden = requests.get(
+            f"{url}/api/v1/workspaces/golden", timeout=5
+        ).json()["golden"]
+        assert golden and golden[0]["project"] == "webapp"
+        p = requests.get(
+            f"{url}/api/v1/workspaces/pressure", timeout=5
+        ).json()
+        assert "used_pct" in p
+        assert requests.post(
+            f"{url}/api/v1/workspaces/gc", timeout=5
+        ).json() == {"removed": []}
+        assert requests.delete(
+            f"{url}/api/v1/workspaces/golden/webapp", timeout=5
+        ).json()["ok"]
+        cp.orchestrator.stop()
+        cp.knowledge.stop()
+        holder["loop"].call_soon_threadsafe(holder["loop"].stop)
