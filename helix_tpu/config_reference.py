@@ -196,6 +196,149 @@ ENV_REFERENCE: tuple = (
         "command line).",
         section="integrations",
     ),
+    # -- CLI --------------------------------------------------------------
+    EnvVar(
+        "HELIX_API_URL",
+        "Control-plane base URL the CLI verbs talk to when --url is not "
+        "passed.",
+        default="http://localhost:8080",
+        section="cli",
+    ),
+    EnvVar(
+        "HELIX_API_TOKEN",
+        "Bearer token the CLI presents to the control plane when "
+        "--api-key is not passed.",
+        section="cli",
+    ),
+    # -- server -----------------------------------------------------------
+    EnvVar(
+        "HELIX_PUBLIC_URL",
+        "Externally-reachable base URL of this control plane (used in "
+        "links the server hands out: OAuth callbacks, runner dial-back).",
+        default="http://localhost:8080",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_EXECUTOR",
+        "Spec-task executor backend: empty = in-process sandbox agent; "
+        "'ws' = dispatch implementation work to an external runner over "
+        "the /ws/external-runner websocket.",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_WS_AGENT",
+        "With HELIX_EXECUTOR=ws: agent type requested from external "
+        "runners (e.g. claude-code, zed, goose).",
+        section="server",
+    ),
+    # -- knowledge --------------------------------------------------------
+    EnvVar(
+        "HELIX_ANN_THRESHOLD",
+        "Vector-store size (rows) above which similarity search switches "
+        "from exact cosine scan to the native HNSW ANN index.",
+        default="5000",
+        section="knowledge",
+    ),
+    # -- billing (Stripe rails) ------------------------------------------
+    EnvVar(
+        "HELIX_STRIPE_SECRET_KEY",
+        "Stripe API secret key; setting it enables the billing rails "
+        "(checkout sessions, subscriptions, webhooks).",
+        section="billing",
+    ),
+    EnvVar(
+        "HELIX_STRIPE_WEBHOOK_SECRET",
+        "Stripe webhook signing secret used to verify "
+        "/api/v1/stripe/webhook payloads.",
+        section="billing",
+    ),
+    EnvVar(
+        "HELIX_STRIPE_PRICE_ID_PRO",
+        "Stripe price id for the pro-tier subscription checkout.",
+        section="billing",
+    ),
+    EnvVar(
+        "HELIX_STRIPE_API_URL",
+        "Stripe API base (tests point it at a fake).",
+        default="https://api.stripe.com",
+        section="billing",
+    ),
+    EnvVar(
+        "HELIX_APP_URL",
+        "User-facing app URL Stripe checkout redirects back to.",
+        default="http://localhost:8080",
+        section="billing",
+    ),
+    # -- Anthropic gateway ------------------------------------------------
+    EnvVar(
+        "HELIX_ANTHROPIC_PROXY_KEY",
+        "Upstream Anthropic API key for the native /v1/messages gateway.",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_ANTHROPIC_OAUTH_TOKEN",
+        "Claude-subscription OAuth bearer; preferred over the API key "
+        "when present (the gateway probes which auth the account has).",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_ANTHROPIC_BASE_URL",
+        "Anthropic API base for the direct gateway backend.",
+        default="https://api.anthropic.com",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_VERTEX_PROJECT",
+        "GCP project id; setting it routes the Anthropic gateway "
+        "through Vertex AI model endpoints.",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_VERTEX_REGION",
+        "Vertex AI region for Anthropic models.",
+        default="us-east5",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_VERTEX_CREDENTIALS",
+        "Service-account credentials JSON (inline) for Vertex auth; "
+        "falls back to metadata-server tokens when unset.",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_VERTEX_BASE_URL",
+        "Override for the Vertex endpoint base (tests point it at a "
+        "fake).",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_BEDROCK_ACCESS_KEY",
+        "AWS access key id; setting it routes the Anthropic gateway "
+        "through Bedrock invoke endpoints.",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_BEDROCK_SECRET_KEY",
+        "AWS secret access key for Bedrock SigV4 signing.",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_BEDROCK_SESSION_TOKEN",
+        "Optional AWS STS session token for Bedrock.",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_BEDROCK_REGION",
+        "AWS region for Bedrock Anthropic models.",
+        default="us-east-1",
+        section="anthropic",
+    ),
+    EnvVar(
+        "HELIX_BEDROCK_BASE_URL",
+        "Override for the Bedrock endpoint base (tests point it at a "
+        "fake).",
+        section="anthropic",
+    ),
 )
 
 
