@@ -124,6 +124,34 @@ def _bucket(n: int, lo: int, hi: int) -> int:
     return min(b, hi) if b <= hi else hi
 
 
+# ---------------------------------------------------------------------------
+# Host-side PRNG key derivation (no device round trips — see _request_key)
+# ---------------------------------------------------------------------------
+
+_M64 = (1 << 64) - 1
+_SEED_DOMAIN = 0xA076_1D64_78BD_642F  # seeded-request key domain
+
+
+def _splitmix64(x: int) -> int:
+    x = (x + 0x9E37_79B9_7F4A_7C15) & _M64
+    z = ((x ^ (x >> 30)) * 0xBF58_476D_1CE4_E5B9) & _M64
+    z = ((z ^ (z >> 27)) * 0x94D0_49BB_1331_11EB) & _M64
+    return z ^ (z >> 31)
+
+
+def _host_key(x: int) -> np.ndarray:
+    """uint32[2] threefry key data from a 64-bit state."""
+    z = _splitmix64(x)
+    return np.array([z >> 32, z & 0xFFFF_FFFF], np.uint32)
+
+
+def _host_split(key: np.ndarray, n: int = 2) -> list:
+    """Derive n child keys from a host key, deterministically."""
+    base = (int(key[0]) << 32) | int(key[1])
+    return [_host_key(base ^ (0xD6E8_FEB8_6659_FD93 * (i + 1))) for i in
+            range(n)]
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class DecodeState:
@@ -255,12 +283,13 @@ def _build_chunk_prefill_fn(
         kseg_hist = (kv_pos_hist < start).astype(jnp.int32)
 
         def attn_fn(q, k, v, layer_cache, pos):
-            kp, vp = layer_cache     # [KVH, N, P, D]
-            KVH, _, P, D = kp.shape
+            kp, vp = layer_cache     # [N, P, KVH, D]
+            _, P, KVH, D = kp.shape
             idx = hist_table[0]
-            # [KVH, m, P, D] -> [1, m*P, KVH, D]
-            kh = kp[:, idx].transpose(1, 2, 0, 3).reshape(1, Hs, KVH, D)
-            vh = vp[:, idx].transpose(1, 2, 0, 3).reshape(1, Hs, KVH, D)
+            # [m, P, KVH, D] -> [1, m*P, KVH, D] — a pure reshape under
+            # the pool's token-major layout (no transpose)
+            kh = kp[idx].reshape(1, Hs, KVH, D)
+            vh = vp[idx].reshape(1, Hs, KVH, D)
             k_all = jnp.concatenate([kh.astype(k.dtype), k], axis=1)
             v_all = jnp.concatenate([vh.astype(v.dtype), v], axis=1)
             kv_pos = jnp.concatenate([kv_pos_hist, pos_q], axis=1)
@@ -430,19 +459,25 @@ def _build_decode_fn(
         pos2d = positions[:, None]                        # [B, 1]
         B = tokens.shape[0]
 
-        def attn_fn(q, k, v, layer_cache, pos):
-            kp, vp = layer_cache
-            out = paged_decode_attention(
+        def attn_fn(q, k, v, carry_cache, pos):
+            # carry protocol: the FULL pool threads through the layer scan
+            # and the kernel persists the token's K/V in place — the
+            # decode program contains no KV scatter (whose layout
+            # preference made XLA relay the multi-GiB pool every step).
+            (kp, vp), lyr = carry_cache
+            out, kp, vp = paged_decode_attention(
                 q[:, 0],
                 kp,
                 vp,
                 page_tables,
                 positions,
+                lyr,
+                active,
                 k_new=k[:, 0],
                 v_new=v[:, 0],
                 backend=backend,
             )
-            return out[:, None]
+            return out[:, None], (kp, vp)
 
         if is_mrope:
             # past the prompt, all three streams advance together at a
@@ -451,23 +486,20 @@ def _build_decode_fn(
                 (positions + state.mrope_delta)[None, :, None],
                 (3,) + pos2d.shape,
             )
-            logits, (k_new, v_new) = text_forward_mrope(
+            logits, (kp, vp) = text_forward_mrope(
                 params, cfg, tokens, pos3,
                 attn_fn=attn_fn,
-                layer_caches=(cache.k_pages, cache.v_pages),
+                carry_caches=(cache.k_pages, cache.v_pages),
                 mrope_sections=cfg.mrope_sections,
                 seq_positions=pos2d,
             )
         else:
-            logits, (k_new, v_new) = forward(
+            logits, (kp, vp) = forward(
                 params, cfg, tokens, pos2d,
                 attn_fn=attn_fn,
-                layer_caches=(cache.k_pages, cache.v_pages),
+                carry_caches=(cache.k_pages, cache.v_pages),
             )
-        pages, offsets = slot_to_page_offset(pos2d, page_tables, page_size)
-        cache = write_kv(
-            cache, k_new, v_new, pages, offsets, active[:, None] > 0
-        )
+        cache = PagedKVCache(k_pages=kp, v_pages=vp)
         penalised = apply_penalties(
             logits[:, 0], state.token_counts,
             state.sampling.presence, state.sampling.frequency,
@@ -537,7 +569,8 @@ class Engine:
         self._changed_slots: set[int] = set()  # admitted/freed since sync
         self._dstate: Optional[DecodeState] = None
         self._chunking: Optional[dict] = None  # in-flight chunked prefill
-        self._key = jax.random.PRNGKey(rng_seed)
+        self._key_base = _splitmix64(0x8E1_1C9 ^ (rng_seed & _M64))
+        self._key_nonce = 0
         self._step_counter = itertools.count()
         self._backend = cfg.attn_backend
         # metrics
@@ -701,13 +734,24 @@ class Engine:
             emitted.extend(self._decode_step())
         return emitted
 
-    def _request_key(self, req: Request):
-        """Root PRNG key for one request: its seed when given, else a
-        split of the engine stream."""
+    def _request_key(self, req: Request) -> np.ndarray:
+        """Root PRNG key for one request: derived from its seed when given,
+        else from the engine stream counter.
+
+        Keys are derived ON HOST (splitmix64 -> two uint32 words used as
+        threefry key data).  The previous ``jax.random.split`` chain cost a
+        device dispatch + a blocking fetch PER REQUEST — through the axon
+        relay (~70 ms/round-trip) admission of a 32-request burst spent
+        ~3 s of device IDLE in key bookkeeping (the r3 TTFT).  Any distinct
+        uint32 pair is a valid threefry key; determinism contracts hold:
+        a seeded request's key depends only on its seed (reproducible
+        across engines and batchmates), unseeded requests get the engine
+        counter stream.
+        """
         if req.sampling.seed is not None:
-            return jax.random.PRNGKey(req.sampling.seed)
-        self._key, req_key = jax.random.split(self._key)
-        return req_key
+            return _host_key(_SEED_DOMAIN ^ (req.sampling.seed & _M64))
+        self._key_nonce += 1
+        return _host_key(self._key_base ^ self._key_nonce)
 
     def _slot_active(self, i: int) -> bool:
         """Occupied and decodable (not mid-chunked-prefill)."""
@@ -774,13 +818,16 @@ class Engine:
         # still blocks FIFO — bypassing there would let a stream of short
         # prompts starve a long prompt of the very pages it is waiting for.
         deferred: list[Request] = []
+        pending: list = []   # (batch, first_tokens device handle) per call
         try:
-            self._admit_inner(emitted, deferred)
+            self._admit_inner(emitted, deferred, pending)
         finally:
+            if pending:
+                self._finish_packed_admissions(pending, emitted)
             if deferred:
                 self.waiting[:0] = deferred
 
-    def _admit_inner(self, emitted, deferred: list) -> None:
+    def _admit_inner(self, emitted, deferred: list, pending: list) -> None:
         while self.waiting:
             if self.waiting[0].finished:   # aborted while queued
                 self.waiting.pop(0)
@@ -790,8 +837,11 @@ class Engine:
             needs_chunking = plen > self.cfg.max_prefill_len
             is_mrope = self.model_cfg.mrope_sections is not None
             if not needs_chunking and not is_mrope:
-                # short text prompts pack into ONE prefill call
-                if not self._admit_packed(emitted):
+                # short text prompts pack into ONE prefill call; first
+                # tokens stay on device until the whole wave is admitted
+                # (one fetch per wave, not per call — each fetch is a
+                # full relay round trip)
+                if not self._admit_packed(pending):
                     return
                 continue
             if needs_chunking and self._chunking is not None:
@@ -828,10 +878,14 @@ class Engine:
             self._changed_slots.add(slot)
             self._emit(req, int(first_token), emitted)
 
-    def _admit_packed(self, emitted) -> int:
+    def _admit_packed(self, pending: list) -> int:
         """Claim as many short waiting prompts as fit one packed bucket
         and prefill them in a single forward pass (segment-packed, like
-        the SFT data path).  Returns requests admitted (0 = blocked)."""
+        the SFT data path).  Returns requests admitted (0 = blocked).
+
+        First tokens are NOT fetched here: the device handle is appended
+        to ``pending`` and ``_finish_packed_admissions`` fetches the whole
+        admission wave in one host round trip."""
         C_cap = self.cfg.max_prefill_len
         ps = self.cache_cfg.page_size
         batch = []
@@ -872,9 +926,9 @@ class Engine:
             pages[0, sl] = table[abs_pos // ps]
             offsets[0, sl] = abs_pos % ps
             ends[si] = cursor + plen - 1
-            carry, sub = jax.random.split(self._request_key(req))
-            self._slot_keys[req.slot] = np.asarray(carry, np.uint32)
-            keys[si] = np.asarray(sub, np.uint32)
+            carry, sub = _host_split(self._request_key(req))
+            self._slot_keys[req.slot] = carry
+            keys[si] = sub
             cursor += plen
         sampling = SamplingState.from_params([r.sampling for r, _ in batch])
         fn = _build_packed_prefill_fn(self.model_cfg, self._backend)
@@ -891,22 +945,36 @@ class Engine:
             sampling,
             jnp.asarray(keys),
         )
-        first_np = np.asarray(first_tokens)
-        now = time.monotonic()
-        for si, (req, _) in enumerate(batch):
-            slot = req.slot
-            req.first_token_time = now
-            self.recent_ttfts.append(
-                (now - req.submit_time) * 1000.0
-            )
-            self._positions[slot] = len(req.prompt_tokens)
-            self._mrope_delta[slot] = 0
-            self._last_token[slot] = first_np[si]
-            self._state_dirty = True
-            self._changed_slots.add(slot)
-            self.num_prefill_tokens += len(req.prompt_tokens)
-            self._emit(req, int(first_np[si]), emitted)
+        pending.append((batch, first_tokens))
         return K
+
+    def _finish_packed_admissions(self, pending: list, emitted) -> None:
+        """Fetch every packed call's first tokens in ONE host round trip
+        and complete the per-request bookkeeping."""
+        if len(pending) == 1:
+            flat = np.asarray(pending[0][1])
+        else:
+            flat = np.asarray(
+                jnp.concatenate([t for _, t in pending], axis=0)
+            )
+        now = time.monotonic()
+        i = 0
+        for batch, _ in pending:
+            for req, _table in batch:
+                first_token = int(flat[i])
+                i += 1
+                slot = req.slot
+                req.first_token_time = now
+                self.recent_ttfts.append(
+                    (now - req.submit_time) * 1000.0
+                )
+                self._positions[slot] = len(req.prompt_tokens)
+                self._mrope_delta[slot] = 0
+                self._last_token[slot] = first_token
+                self._state_dirty = True
+                self._changed_slots.add(slot)
+                self.num_prefill_tokens += len(req.prompt_tokens)
+                self._emit(req, first_token, emitted)
 
     def _chunk_step(self, emitted) -> None:
         """Process ONE chunk of the in-flight long prefill (called once per
@@ -938,7 +1006,7 @@ class Engine:
         hist_table = np.zeros((1, m), np.int32)
         used = min(m, -(-start // ps))
         hist_table[0, :used] = full_table[:used]
-        st["key"], sub = jax.random.split(st["key"])
+        st["key"], sub = _host_split(st["key"])
         fn = _build_chunk_prefill_fn(
             self.model_cfg, ps, self._backend, self.mesh
         )
@@ -968,9 +1036,7 @@ class Engine:
         self._positions[slot] = plen
         self._mrope_delta[slot] = req.mrope_delta
         self._last_token[slot] = first_token
-        self._slot_keys[slot] = np.asarray(
-            jax.random.split(st["key"])[0], np.uint32
-        )
+        self._slot_keys[slot] = _host_split(st["key"])[0]
         self._state_dirty = True
         self._changed_slots.add(slot)
         self._emit(req, first_token, emitted)
@@ -994,9 +1060,9 @@ class Engine:
         # per-request PRNG stream: seeded requests reproduce exactly
         # regardless of batch-mates; the carry half becomes the slot's
         # device-resident key for decode
-        carry, sub = jax.random.split(self._request_key(req))
+        carry, sub = _host_split(self._request_key(req))
         if slot is not None:
-            self._slot_keys[slot] = np.asarray(carry, np.uint32)
+            self._slot_keys[slot] = carry
         sampling = SamplingState.from_params([req.sampling])
         embeds = self._splice_embeds(req, tokens, bucket)
         pos3 = np.zeros((3, 1, bucket), np.int32)

@@ -4,11 +4,16 @@ The TPU replacement for vLLM's PagedAttention block manager (which the
 reference rides inside its CUDA containers — ``SURVEY.md`` §2.2).  Design:
 
 - Device state is two arrays per model, ``k_pages``/``v_pages`` of shape
-  ``[num_layers, kv_heads, num_pages, page_size, head_dim]`` — statically
+  ``[num_layers, num_pages, page_size, kv_heads, head_dim]`` — statically
   shaped so every jitted step reuses one executable.  The layer dim leads so
-  the model's ``lax.scan`` slices per-layer views; kv_heads comes next so a
-  (head, page) slice is a contiguous ``[page_size, head_dim]`` block — the
-  unit the Pallas decode kernel DMAs from HBM to VMEM.
+  the model's ``lax.scan`` slices per-layer views.  ``[kv_heads, head_dim]``
+  are minormost so ONE token's K (the KV-write scatter's update block) is
+  contiguous in the default row-major layout — with heads ahead of pages the
+  scatter preferred a transposed layout and XLA relaid the whole multi-GiB
+  pool out and back *inside the decode loop* (the r3 profiler trace showed
+  ~40% of each decode window in those copies).  A ``(layer, page)`` slice is
+  a contiguous ``[page_size, kv_heads, head_dim]`` block — the DMA unit the
+  Pallas decode kernel streams HBM->VMEM (one DMA per page for ALL heads).
 - The page pool shards over the mesh on the kv-head axis (follows tensor
   parallelism; pages axis stays unsharded so any page can host any sequence).
 - Allocation/free is pure host Python (a free list) — it never appears in a
@@ -85,7 +90,7 @@ class CacheConfig:
 class PagedKVCache:
     """Device page pool (a pytree — passes through jit with donation)."""
 
-    k_pages: jax.Array  # [L, KVH, N, P, D]
+    k_pages: jax.Array  # [L, N, P, KVH, D]
     v_pages: jax.Array
 
     @classmethod
@@ -97,9 +102,9 @@ class PagedKVCache:
     ) -> "PagedKVCache":
         shape = (
             model.num_layers,
-            model.num_kv_heads,
             cache.num_pages,
             cache.page_size,
+            model.num_kv_heads,
             model.head_dim,
         )
         dtype = jnp.dtype(cache.dtype)
@@ -107,7 +112,7 @@ class PagedKVCache:
             from helix_tpu.parallel.sharding import logical_sharding
 
             sharding = logical_sharding(
-                mesh, (None, "cache_heads", "pages", None, None)
+                mesh, (None, "pages", None, "cache_heads", None)
             )
             zeros = jax.jit(
                 lambda: jnp.zeros(shape, dtype), out_shardings=(sharding)
@@ -141,40 +146,30 @@ def write_kv(
     the engine's garbage page) so the scatter stays fully dense.
     """
     L, B, S, KVH, D = k_new.shape
-    Lp, KVHp, P, ps, Dp = cache.k_pages.shape
+    Lp, P, ps, KVHp, Dp = cache.k_pages.shape
     # Scatter at ONE fused token index (page*page_size + offset) into a
-    # [L, KVH, P*ps, D] view of the pool.  The (page, offset) two-index
-    # scatter made XLA:TPU pick a different result layout for the pool,
-    # which defeated buffer donation and materialised a full pool copy
-    # inside the prefill program (3 GiB for an 8B-scale cache — the r3
-    # bench OOM); the fused-index form keeps the default layout so the
-    # scatter updates the donated buffer in place.  The reshapes are
-    # bitcasts (pages and offset are adjacent, contiguous dims).
+    # [L, P*ps, KVH, D] view of the pool.  One update block = a token's
+    # [KVH, D] — contiguous under the pool's default row-major layout, so
+    # XLA keeps that layout (a (page, offset) two-index scatter, or a pool
+    # with heads ahead of pages, makes layout assignment flip the pool and
+    # copy multi-GiB temporaries).  The reshapes are bitcasts (pages and
+    # offset are adjacent, contiguous dims).
     flat_idx = jnp.where(
         valid, pages * ps + offsets, 0
     ).reshape(-1)
-    # [L, B*S, KVH, D] -> [L, KVH, B*S, D] to match the pool layout
-    kf = (
-        k_new.reshape(L, B * S, KVH, D)
-        .transpose(0, 2, 1, 3)
-        .astype(cache.k_pages.dtype)
-    )
-    vf = (
-        v_new.reshape(L, B * S, KVH, D)
-        .transpose(0, 2, 1, 3)
-        .astype(cache.v_pages.dtype)
-    )
+    kf = k_new.reshape(L, B * S, KVH, D).astype(cache.k_pages.dtype)
+    vf = v_new.reshape(L, B * S, KVH, D).astype(cache.v_pages.dtype)
     k_pages = (
-        cache.k_pages.reshape(Lp, KVHp, P * ps, Dp)
-        .at[:, :, flat_idx]
+        cache.k_pages.reshape(Lp, P * ps, KVHp, Dp)
+        .at[:, flat_idx]
         .set(kf, mode="drop", unique_indices=False)
-        .reshape(Lp, KVHp, P, ps, Dp)
+        .reshape(Lp, P, ps, KVHp, Dp)
     )
     v_pages = (
-        cache.v_pages.reshape(Lp, KVHp, P * ps, Dp)
-        .at[:, :, flat_idx]
+        cache.v_pages.reshape(Lp, P * ps, KVHp, Dp)
+        .at[:, flat_idx]
         .set(vf, mode="drop", unique_indices=False)
-        .reshape(Lp, KVHp, P, ps, Dp)
+        .reshape(Lp, P, ps, KVHp, Dp)
     )
     return PagedKVCache(k_pages=k_pages, v_pages=v_pages)
 

@@ -138,7 +138,13 @@ def _layer(
     inv_freq,
     attn_fn: AttnFn,
 ):
-    """One decoder block. h: [B, S, E]."""
+    """One decoder block. h: [B, S, E].
+
+    When ``attn_fn`` returns ``(out, new_cache)`` (the carry-cache decode
+    protocol — the paged pool threads through the layer scan and the
+    kernel updates it in place), the new cache is returned as the third
+    element; plain attn_fns (prefill) return the output alone.
+    """
     B, S, E = h.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     p = layer_params
@@ -153,7 +159,12 @@ def _layer(
         k = rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
     q = apply_rope(q, positions, inv_freq)
     k = apply_rope(k, positions, inv_freq)
-    attn_out = attn_fn(q, k, v, layer_cache, positions)
+    res = attn_fn(q, k, v, layer_cache, positions)
+    new_cache = None
+    if isinstance(res, tuple):
+        attn_out, new_cache = res
+    else:
+        attn_out = res
     h = h + _dense(attn_out.reshape(B, S, H * D), p["wo"])
 
     # --- mlp ---
@@ -162,7 +173,46 @@ def _layer(
     gate = _dense(x, p["w_gate"])
     up = _dense(x, p["w_up"])
     h = h + _dense(act(gate) * up, p["w_down"])
-    return h, (k, v)
+    return h, (k, v), new_cache
+
+
+def scan_decoder_blocks(
+    h, layers_params, num_layers: int, block, layer_caches, carry_caches
+):
+    """Shared cache-protocol dispatch for decoder towers (llama families +
+    the Qwen2-VL mrope tower share this so the two protocols cannot
+    diverge).
+
+    ``block(h, layer_params, layer_cache) -> (h, (k, v), new_cache)``.
+
+    - xs mode (``layer_caches`` or no cache): the scan slices a per-layer
+      cache view; returns (h, kv) with kv stacked [L, ...] for the
+      caller's scatter.
+    - carry mode (``carry_caches``): the full cache pytree threads through
+      the scan carry and block's attn_fn receives ``(caches, layer_idx)``;
+      returns (h, final_caches).
+    """
+    if carry_caches is not None:
+        def carry_body(carry, xs):
+            h, caches = carry
+            layer_params, lyr = xs
+            h, _, caches = block(h, layer_params, (caches, lyr))
+            return (h, caches), None
+
+        xs = (layers_params, jnp.arange(num_layers, dtype=jnp.int32))
+        (h, kv), _ = jax.lax.scan(carry_body, (h, carry_caches), xs)
+    else:
+        def scan_body(h, xs):
+            layer_params, layer_cache = xs
+            h, kv, _ = block(h, layer_params, layer_cache)
+            return h, kv
+
+        if layer_caches is None:
+            # lax.scan needs every xs leaf to have a leading L dim; "no
+            # history" is a zero-length dummy the attn_fn never touches.
+            layer_caches = jnp.zeros((num_layers, 0), jnp.int32)
+        h, kv = jax.lax.scan(scan_body, h, (layers_params, layer_caches))
+    return h, kv
 
 
 def forward(
@@ -173,11 +223,23 @@ def forward(
     *,
     attn_fn: AttnFn,
     layer_caches=None,    # pytree whose leaves have leading num_layers dim
+    carry_caches=None,    # pytree threaded through the scan as carry
     return_hidden: bool = False,
 ):
-    """Run the decoder. Returns (logits [B, S, V], kv) where kv is the
-    per-layer fresh K/V stacked to [L, B, S, KVH, D] — the engine scatters
-    these into its paged cache in one op after the call."""
+    """Run the decoder.
+
+    Two cache protocols:
+
+    - ``layer_caches`` (prefill): the scan slices a per-layer view as xs;
+      ``attn_fn(q, k, v, layer_cache, pos)`` returns the attention output;
+      returns (logits, kv) with kv = fresh K/V stacked [L, B, S, KVH, D]
+      for the caller's one-shot scatter into the paged pool.
+    - ``carry_caches`` (decode): the FULL cache pytree threads through the
+      scan carry; ``attn_fn(q, k, v, (caches, layer_idx), pos)`` returns
+      ``(out, new_caches)`` and updates the pool itself (the Pallas kernel
+      writes the token's K/V in place) — no stacked kv, no scatter, no
+      pool-sized layout copies in the loop.  Returns (logits, caches).
+    """
     from helix_tpu.ops.quant import embed_lookup
 
     inv_freq = jnp.asarray(
@@ -185,20 +247,15 @@ def forward(
     )
     h = embed_lookup(params["embed"], tokens, jnp.dtype(cfg.dtype))
 
-    def scan_body(h, xs):
-        layer_params, layer_cache = xs
-        h, kv = _layer(
+    def block(h, layer_params, layer_cache):
+        return _layer(
             h, layer_params, layer_cache, cfg, positions, inv_freq, attn_fn
         )
-        return h, kv
 
-    if layer_caches is None:
-        # lax.scan needs every xs leaf to have a leading L dim; "no history"
-        # is a zero-length dummy the attn_fn never touches.
-        layer_caches = jnp.zeros((cfg.num_layers, 0), jnp.int32)
-    xs = (params["layers"], layer_caches)
-
-    h, kv = jax.lax.scan(scan_body, h, xs)
+    h, kv = scan_decoder_blocks(
+        h, params["layers"], cfg.num_layers, block, layer_caches,
+        carry_caches,
+    )
     h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
     if return_hidden:
         return h, kv

@@ -290,12 +290,16 @@ def mrope_positions(
 
 def text_forward_mrope(
     params, cfg: ModelConfig, tokens, positions3, *, attn_fn,
-    layer_caches=None, input_embeds=None, mrope_sections=(16, 24, 24),
-    seq_positions=None,
+    layer_caches=None, carry_caches=None, input_embeds=None,
+    mrope_sections=(16, 24, 24), seq_positions=None,
 ):
     """Qwen2-VL text tower: llama forward with M-RoPE rotation and optional
-    pre-computed input embeddings (image splice)."""
-    from helix_tpu.models.llama import _layer
+    pre-computed input embeddings (image splice).
+
+    Cache protocols mirror ``models.llama.forward``: ``layer_caches``
+    slices per layer as xs (prefill); ``carry_caches`` threads the full
+    pool through the scan carry and the attn_fn returns
+    ``(out, new_caches)`` (paged decode — in-kernel KV write)."""
     from helix_tpu.ops.norms import rms_norm
     from helix_tpu.ops.quant import embed_lookup
     from helix_tpu.ops.rope import rope_frequencies
@@ -312,8 +316,7 @@ def text_forward_mrope(
     if seq_positions is None:
         seq_positions = jnp.broadcast_to(jnp.arange(S)[None], (B, S))
 
-    def scan_body(h, xs):
-        layer_params, layer_cache = xs
+    def block(h, layer_params, layer_cache):
         B, S, E = h.shape
         H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
         p = layer_params
@@ -325,17 +328,25 @@ def text_forward_mrope(
         k = apply_mrope(k, positions3, inv_freq, mrope_sections)
         # causal masking is by SEQUENCE index, not the mrope t-stream —
         # image-span tokens share t but still attend causally (HF parity)
-        attn_out = attn_fn(q, k, v, layer_cache, seq_positions)
+        res = attn_fn(q, k, v, layer_cache, seq_positions)
+        new_cache = None
+        if isinstance(res, tuple):
+            attn_out, new_cache = res
+        else:
+            attn_out = res
         h = h + _dense(attn_out.reshape(B, S, H * D), p["wo"])
         x = rms_norm(h, p["mlp_norm"]["weight"], cfg.rms_norm_eps)
         act = _act(cfg.hidden_act)
         h = h + _dense(act(_dense(x, p["w_gate"])) * _dense(x, p["w_up"]),
                        p["w_down"])
-        return h, (k, v)
+        return h, (k, v), new_cache
 
-    if layer_caches is None:
-        layer_caches = jnp.zeros((cfg.num_layers, 0), jnp.int32)
-    h, kv = jax.lax.scan(scan_body, h, (params["layers"], layer_caches))
+    from helix_tpu.models.llama import scan_decoder_blocks
+
+    h, kv = scan_decoder_blocks(
+        h, params["layers"], cfg.num_layers, block, layer_caches,
+        carry_caches,
+    )
     h = rms_norm(h, params["final_norm"]["weight"], cfg.rms_norm_eps)
     w_out = (
         params["embed"]["weight"].T

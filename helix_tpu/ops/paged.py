@@ -4,18 +4,25 @@ This is the decode-loop hot op (SURVEY.md §7 hard part #1) — the reference
 gets it from vLLM's PagedAttention CUDA kernels inside its containers; here
 it is TPU-owned:
 
-- ``paged_decode_attention_reference`` — XLA gather-based oracle: gathers
-  each sequence's pages, masks beyond its length, plain softmax.  Correct
-  everywhere; bandwidth-wasteful (gathers ``max_pages`` per seq).
-- ``paged_decode_attention`` — Pallas kernel (``helix_tpu/ops/paged_kernel``)
-  that walks only the pages each sequence actually uses, page table
-  scalar-prefetched into SMEM, double-buffered HBM->VMEM DMA.
+- ``paged_decode_attention_reference`` — XLA gather-based oracle over one
+  layer's pages: gathers each sequence's pages, masks beyond its length,
+  plain softmax.  Correct everywhere; bandwidth-wasteful (gathers
+  ``max_pages`` per seq).
+- ``paged_decode_attention`` — attend-and-write over the FULL pool
+  (``[L, N, P, KVH, D]``): Pallas kernel (``helix_tpu/ops/paged_kernel``)
+  that walks only the pages each sequence actually uses, one whole-page
+  ``[P, KVH, D]`` DMA per page, and writes the current token's K/V into its
+  page in-place (pool aliased through the call) — the decode loop contains
+  NO scatter, so XLA never relays the pool out (the r3 trace showed the
+  external-scatter design spending ~40% of each decode window transposing
+  the pool).  Returns ``(out, k_pages, v_pages)``.
 
 Length convention: ``lengths[b]`` = number of PAST tokens in the cache for
 sequence b (the current token's position).  The current token's K/V arrive
-as ``k_new``/``v_new`` and are appended logically at slot ``lengths[b]`` —
-the engine scatters them into pages *after* the forward pass, so the kernel
-must include them itself (write-after-attend keeps the model functional).
+as ``k_new``/``v_new``; the kernel folds them into attention as a virtual
+final block AND persists them at slot ``lengths[b]`` of the page table.
+Inactive slots (``active[b] == 0``) read nothing (their tables may point at
+reallocated pages) and write to the garbage page 0.
 """
 
 from __future__ import annotations
@@ -32,7 +39,7 @@ from helix_tpu.ops.attention import DEFAULT_MASK_VALUE
 
 def paged_decode_attention_reference(
     q,            # [B, H, D]
-    k_pages,      # [KVH, N, P, D]
+    k_pages,      # [N, P, KVH, D] — ONE layer's pages
     v_pages,
     page_tables,  # [B, maxP] int32
     lengths,      # [B] int32 — past tokens in cache
@@ -42,23 +49,23 @@ def paged_decode_attention_reference(
     scale: Optional[float] = None,
 ) -> jax.Array:
     B, H, D = q.shape
-    KVH, N, P, _ = k_pages.shape
+    N, P, KVH, _ = k_pages.shape
     maxP = page_tables.shape[1]
     group = H // KVH
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
 
-    # Gather each sequence's pages: [KVH, B, maxP, P, D] -> [B, KVH, T, D]
+    # Gather each sequence's pages: [B, maxP, P, KVH, D] -> [B, KVH, T, D]
     T = maxP * P
     kg = (
-        k_pages[:, page_tables]
-        .reshape(KVH, B, T, D)
-        .transpose(1, 0, 2, 3)
+        k_pages[page_tables]
+        .reshape(B, T, KVH, D)
+        .transpose(0, 2, 1, 3)
         .astype(jnp.float32)
     )
     vg = (
-        v_pages[:, page_tables]
-        .reshape(KVH, B, T, D)
-        .transpose(1, 0, 2, 3)
+        v_pages[page_tables]
+        .reshape(B, T, KVH, D)
+        .transpose(0, 2, 1, 3)
         .astype(jnp.float32)
     )
     valid = jnp.arange(T)[None, :] < lengths[:, None]  # [B, T]
@@ -79,29 +86,69 @@ def paged_decode_attention_reference(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
+def _reference_attend_and_write(
+    q, k_pages, v_pages, page_tables, lengths, layer, active, k_new, v_new,
+    *, scale,
+):
+    """XLA oracle for the attend-and-write op (CPU tests / non-TPU)."""
+    B = q.shape[0]
+    L_, N, P, KVH, D = k_pages.shape
+    kp_l = k_pages[layer]
+    vp_l = v_pages[layer]
+    # inactive slots must not attend over their (possibly reallocated)
+    # pages: zero their length
+    lengths_eff = lengths * active
+    out = paged_decode_attention_reference(
+        q, kp_l, vp_l, page_tables, lengths_eff, k_new, v_new, scale=scale
+    )
+    # persist the current token: flat token index into [N*P]; inactive
+    # slots land on garbage page 0
+    pidx = jnp.take_along_axis(
+        page_tables, (lengths // P)[:, None], axis=1
+    )[:, 0]
+    flat = jnp.where(active > 0, pidx * P + lengths % P, 0)
+    kp_l = kp_l.reshape(N * P, KVH, D).at[flat].set(
+        k_new.astype(k_pages.dtype), mode="drop"
+    ).reshape(N, P, KVH, D)
+    vp_l = vp_l.reshape(N * P, KVH, D).at[flat].set(
+        v_new.astype(v_pages.dtype), mode="drop"
+    ).reshape(N, P, KVH, D)
+    k_pages = k_pages.at[layer].set(kp_l)
+    v_pages = v_pages.at[layer].set(vp_l)
+    return out, k_pages, v_pages
+
+
 def paged_decode_attention(
-    q,
-    k_pages,
+    q,            # [B, H, D]
+    k_pages,      # [L, N, P, KVH, D] — FULL pool
     v_pages,
-    page_tables,
-    lengths,
-    k_new=None,
-    v_new=None,
+    page_tables,  # [B, maxP]
+    lengths,      # [B]
+    layer,        # scalar int32 — which layer's pages to use
+    active,       # [B] int32 — 0 = parked slot (no read, garbage write)
+    k_new,        # [B, KVH, D]
+    v_new,
     *,
     scale: Optional[float] = None,
     backend: Optional[str] = None,
 ):
-    """Dispatcher: Pallas kernel on TPU, reference elsewhere."""
+    """Attend one query token per sequence over its pages and persist the
+    token's K/V — pool in, pool out (aliased in-place on TPU).
+
+    Dispatcher: Pallas kernel on TPU, XLA reference elsewhere.
+    """
     if backend is None:
         platform = jax.devices()[0].platform
         backend = "pallas" if platform in ("tpu", "axon") else "reference"
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if backend == "pallas":
         from helix_tpu.ops.paged_kernel import paged_decode_attention_tpu
 
         return paged_decode_attention_tpu(
-            q, k_pages, v_pages, page_tables, lengths, k_new, v_new,
-            scale=scale,
+            q, k_pages, v_pages, page_tables, lengths, layer, active,
+            k_new, v_new, scale=scale,
         )
-    return paged_decode_attention_reference(
-        q, k_pages, v_pages, page_tables, lengths, k_new, v_new, scale=scale
+    return _reference_attend_and_write(
+        q, k_pages, v_pages, page_tables, lengths, layer, active,
+        k_new, v_new, scale=scale,
     )

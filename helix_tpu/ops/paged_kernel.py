@@ -1,21 +1,25 @@
-"""Pallas TPU ragged paged-decode attention kernel.
+"""Pallas TPU ragged paged-decode attention kernel (attend-and-write).
 
 Per-sequence decode attention that walks ONLY the pages each sequence
 actually uses (ragged over the batch), instead of gathering
 ``max_pages_per_seq`` like the XLA reference path — the design of Ragged
 Paged Attention (PAPERS.md) specialised to decode:
 
-- Page tables + lengths are **scalar-prefetched into SMEM**, so DMA source
-  addresses are computed before the kernel body runs.
-- KV pages stream HBM -> VMEM with **double-buffered async DMA**; chunks of
-  ``C = ceil(128 / page_size)`` pages are fetched per step so the score
-  matmul runs at full 128-lane width.
-- Online softmax in fp32 scratch; the current token's K/V (not yet written
-  to the pool — the engine scatters after the forward pass) is folded in as
-  a final virtual block.
-
-Grid is ``(B, KVH)``; each program owns one sequence x one kv-head group
-(``group = H / KVH`` query heads).
+- Page tables, lengths, active flags and the layer index are
+  **scalar-prefetched into SMEM**, so DMA source addresses are computed
+  before the kernel body runs.
+- The pool is ``[L, N, P, KVH, D]``: one ``(layer, page)`` slice is a
+  contiguous ``[P, KVH, D]`` block, fetched HBM -> VMEM in ONE
+  double-buffered async DMA carrying every kv head (the previous
+  head-major pool needed ``KVH`` separate 4 KB DMAs per page — 8x the
+  descriptor traffic).
+- Grid is ``(B,)``: each program owns one sequence and computes all
+  ``KVH`` head groups from the same VMEM-resident chunk.
+- Online softmax in fp32; the current token's K/V is folded in as a final
+  virtual block, then **persisted into its page by an in-kernel DMA**
+  (pool aliased input->output) — the decode loop needs no external
+  scatter, which is what kept XLA from relaying the pool (r3 trace: ~40%
+  of each decode window went to those layout copies).
 """
 
 from __future__ import annotations
@@ -36,28 +40,37 @@ def _decode_kernel(
     # scalar prefetch
     pt_ref,      # SMEM [B, maxP] int32 page tables
     len_ref,     # SMEM [B] int32 past lengths
+    act_ref,     # SMEM [B] int32 active flags
+    layer_ref,   # SMEM [1] int32 layer index
     # inputs
-    q_ref,       # VMEM [1, 1, group, D]
-    knew_ref,    # VMEM [1, 1, 1, D]
-    vnew_ref,    # VMEM [1, 1, 1, D]
-    k_hbm,       # ANY  [KVH, N, P, D]
+    q_ref,       # VMEM [1, KVH, group, D]
+    knew_ref,    # VMEM [1, KVH, D]
+    vnew_ref,    # VMEM [1, KVH, D]
+    k_hbm,       # ANY  [L, N, P, KVH, D]
     v_hbm,
     # outputs
-    o_ref,       # VMEM [1, 1, group, D]
+    o_ref,       # VMEM [1, KVH, group, D]
+    ko_hbm,      # ANY — aliased to k_hbm
+    vo_hbm,      # ANY — aliased to v_hbm
     # scratch
-    kbuf,        # VMEM [2, C*P, D]
-    vbuf,        # VMEM [2, C*P, D]
+    kbuf,        # VMEM [2, C, P, KVH, D]
+    vbuf,        # VMEM [2, C, P, KVH, D]
     sems,        # DMA sems [2, C, 2]
+    wsems,       # DMA sems [2] for the write-back
     *,
     scale: float,
     page_size: int,
     pages_per_chunk: int,
     max_pages: int,
+    kv_heads: int,
+    group: int,
 ):
     b = pl.program_id(0)
-    h = pl.program_id(1)
-    P, C = page_size, pages_per_chunk
-    L = len_ref[b]
+    lyr = layer_ref[0]
+    P, C, KVH = page_size, pages_per_chunk, kv_heads
+    act = act_ref[b]
+    # parked slots read nothing: their tables may point at reallocated pages
+    L = len_ref[b] * act
     npages = jax.lax.div(L + P - 1, P)
     nchunks = jax.lax.div(npages + C - 1, C)
     max_chunks = (max_pages + C - 1) // C
@@ -68,13 +81,13 @@ def _decode_kernel(
             def _():
                 page = pt_ref[b, ci * C + c]
                 pltpu.make_async_copy(
-                    k_hbm.at[h, page],
-                    kbuf.at[slot, pl.ds(c * P, P), :],
+                    k_hbm.at[lyr, page],
+                    kbuf.at[slot, c],
                     sems.at[slot, c, 0],
                 ).start()
                 pltpu.make_async_copy(
-                    v_hbm.at[h, page],
-                    vbuf.at[slot, pl.ds(c * P, P), :],
+                    v_hbm.at[lyr, page],
+                    vbuf.at[slot, c],
                     sems.at[slot, c, 1],
                 ).start()
 
@@ -84,25 +97,44 @@ def _decode_kernel(
             def _():
                 page = pt_ref[b, ci * C + c]
                 pltpu.make_async_copy(
-                    k_hbm.at[h, page],
-                    kbuf.at[slot, pl.ds(c * P, P), :],
+                    k_hbm.at[lyr, page],
+                    kbuf.at[slot, c],
                     sems.at[slot, c, 0],
                 ).wait()
                 pltpu.make_async_copy(
-                    v_hbm.at[h, page],
-                    vbuf.at[slot, pl.ds(c * P, P), :],
+                    v_hbm.at[lyr, page],
+                    vbuf.at[slot, c],
                     sems.at[slot, c, 1],
                 ).wait()
 
-    q = q_ref[0, 0].astype(jnp.float32)  # [group, D]
-    group, D = q.shape
+    q = q_ref[0].astype(jnp.float32)  # [KVH, group, D]
+    D = q.shape[-1]
+
+    # persist the current token's K/V into its page (write-after-nothing:
+    # slot lengths[b] is strictly beyond the masked read range, so the
+    # attention below never observes this write).  Parked slots write to
+    # the garbage page 0 — but their stale position can sit AT page
+    # capacity, so clamp the table index before the SMEM read (jnp.where
+    # evaluates both branches; an unclamped len//P == maxP reads past the
+    # prefetch buffer).
+    pt_idx = jnp.minimum(jax.lax.div(len_ref[b], P), max_pages - 1)
+    w_page = jnp.where(act > 0, pt_ref[b, pt_idx], 0)
+    w_off = jax.lax.rem(len_ref[b], P) * act
+    kw = pltpu.make_async_copy(
+        knew_ref.at[0], ko_hbm.at[lyr, w_page, w_off], wsems.at[0]
+    )
+    vw = pltpu.make_async_copy(
+        vnew_ref.at[0], vo_hbm.at[lyr, w_page, w_off], wsems.at[1]
+    )
+    kw.start()
+    vw.start()
 
     @pl.when(nchunks > 0)
     def _():
         start_chunk(0, 0)
 
     def body(ci, carry):
-        m_prev, l_prev, acc_prev = carry
+        ms, ls, accs = carry            # tuples of per-head [group, *]
         slot = jax.lax.rem(ci, 2)
 
         @pl.when(ci + 1 < nchunks)
@@ -110,51 +142,78 @@ def _decode_kernel(
             start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
 
         wait_chunk(ci, slot)
-        k = kbuf[slot].astype(jnp.float32)       # [C*P, D]
-        v = vbuf[slot]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                                # [group, C*P]
+        k = kbuf[slot].reshape(C * P, KVH, D).astype(jnp.float32)
+        v = vbuf[slot].reshape(C * P, KVH, D)
         token0 = ci * C * P
         tok = token0 + jax.lax.broadcasted_iota(jnp.int32, (1, C * P), 1)
-        s = jnp.where(tok < L, s, DEFAULT_MASK_VALUE)
-
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc_prev * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        in_range = tok < L
+        # un-DMA'd buffer regions (pages past this sequence's length) hold
+        # garbage; the softmax weight there is exactly 0, but 0 * NaN
+        # still poisons the PV accumulation — zero V explicitly.  (K needs
+        # no guard: its scores are overwritten by the mask.)
+        v = jnp.where(
+            jax.lax.broadcasted_iota(jnp.int32, (C * P, 1, 1), 0)
+            < L - token0,
+            v, 0,
         )
-        return m_new, l_new, acc_new
 
-    m0 = jnp.full((group, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((group, 1), jnp.float32)
-    acc0 = jnp.zeros((group, D), jnp.float32)
+        ms2, ls2, accs2 = [], [], []
+        for h in range(KVH):            # static unroll over kv heads
+            qh = q[h]                   # [group, D]
+            kh = k[:, h, :]             # [C*P, D]
+            vh = v[:, h, :]
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                   # [group, C*P]
+            s = jnp.where(in_range, s, DEFAULT_MASK_VALUE)
+            m_prev, l_prev, acc_prev = ms[h], ls[h], accs[h]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc_prev * alpha + jax.lax.dot_general(
+                p.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ms2.append(m_new)
+            ls2.append(l_new)
+            accs2.append(acc_new)
+        return tuple(ms2), tuple(ls2), tuple(accs2)
+
+    m0 = tuple(
+        jnp.full((group, 1), -jnp.inf, jnp.float32) for _ in range(KVH)
+    )
+    l0 = tuple(jnp.zeros((group, 1), jnp.float32) for _ in range(KVH))
+    acc0 = tuple(jnp.zeros((group, D), jnp.float32) for _ in range(KVH))
 
     def guarded_body(ci, carry):
         return jax.lax.cond(
             ci < nchunks, lambda c: body(ci, c), lambda c: c, carry
         )
 
-    m, l, acc = jax.lax.fori_loop(0, max_chunks, guarded_body, (m0, l0, acc0))
+    ms, ls, accs = jax.lax.fori_loop(
+        0, max_chunks, guarded_body, (m0, l0, acc0)
+    )
 
     # fold in the current token's K/V (virtual final block, always valid)
-    knew = knew_ref[0, 0, 0].astype(jnp.float32)    # [D]
-    vnew = vnew_ref[0, 0, 0].astype(jnp.float32)
-    s_new = jax.lax.dot_general(
-        q, knew[:, None], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale                                    # [group, 1]
-    m_f = jnp.maximum(m, s_new)
-    p_new = jnp.exp(s_new - m_f)
-    alpha = jnp.exp(m - m_f)
-    l_f = alpha * l + p_new
-    acc_f = acc * alpha + p_new * vnew[None, :]
-    o_ref[0, 0] = (acc_f / l_f).astype(o_ref.dtype)
+    knew = knew_ref[0].astype(jnp.float32)    # [KVH, D]
+    vnew = vnew_ref[0].astype(jnp.float32)
+    for h in range(KVH):
+        s_new = jax.lax.dot_general(
+            q[h], knew[h][:, None], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                             # [group, 1]
+        m_f = jnp.maximum(ms[h], s_new)
+        p_new = jnp.exp(s_new - m_f)
+        alpha = jnp.exp(ms[h] - m_f)
+        l_f = alpha * ls[h] + p_new
+        acc_f = accs[h] * alpha + p_new * vnew[h][None, :]
+        o_ref[0, h] = (acc_f / l_f).astype(o_ref.dtype)
+
+    kw.wait()
+    vw.wait()
 
 
 @functools.partial(
@@ -162,10 +221,12 @@ def _decode_kernel(
 )
 def paged_decode_attention_tpu(
     q,            # [B, H, D]
-    k_pages,      # [KVH, N, P, D]
+    k_pages,      # [L, N, P, KVH, D] — FULL pool, aliased through
     v_pages,
     page_tables,  # [B, maxP]
     lengths,      # [B]
+    layer,        # scalar int32
+    active,       # [B] int32
     k_new,        # [B, KVH, D]
     v_new,
     *,
@@ -173,7 +234,7 @@ def paged_decode_attention_tpu(
     interpret: bool = False,
 ):
     B, H, D = q.shape
-    KVH, N, P, _ = k_pages.shape
+    L, N, P, KVH, _ = k_pages.shape
     maxP = page_tables.shape[1]
     group = H // KVH
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
@@ -181,47 +242,61 @@ def paged_decode_attention_tpu(
     C = min(C, maxP)
 
     qg = q.reshape(B, KVH, group, D)
-    knew4 = k_new.reshape(B, KVH, 1, D)
-    vnew4 = v_new.reshape(B, KVH, 1, D)
     kernel = functools.partial(
         _decode_kernel,
         scale=scale,
         page_size=P,
         pages_per_chunk=C,
         max_pages=maxP,
+        kv_heads=KVH,
+        group=group,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, KVH),
+        num_scalar_prefetch=4,
+        grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, 1, group, D), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, 1, D), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec((1, 1, 1, D), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec((1, KVH, group, D), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((1, KVH, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec((1, KVH, D), lambda b, *_: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1, group, D), lambda b, h, *_: (b, h, 0, 0)),
+        out_specs=[
+            pl.BlockSpec((1, KVH, group, D), lambda b, *_: (b, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((2, C * P, D), k_pages.dtype),
-            pltpu.VMEM((2, C * P, D), v_pages.dtype),
+            pltpu.VMEM((2, C, P, KVH, D), k_pages.dtype),
+            pltpu.VMEM((2, C, P, KVH, D), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, C, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
     )
-    out = pl.pallas_call(
+    # flat input order: pt, len, act, layer, q, knew, vnew, k_pages(7),
+    # v_pages(8) -> outputs (out, k_pages, v_pages)
+    out, kp, vp = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, KVH, group, D), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, KVH, group, D), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        input_output_aliases={7: 1, 8: 2},
         interpret=interpret,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary", "arbitrary"),
+            dimension_semantics=("arbitrary",),
         ),
     )(
         page_tables.astype(jnp.int32),
         lengths.astype(jnp.int32),
+        active.astype(jnp.int32),
+        jnp.asarray(layer, jnp.int32).reshape(1),
         qg,
-        knew4,
-        vnew4,
+        k_new.reshape(B, KVH, D),
+        v_new.reshape(B, KVH, D),
         k_pages,
         v_pages,
     )
-    return out.reshape(B, H, D)
+    return out.reshape(B, H, D), kp, vp

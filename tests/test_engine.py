@@ -67,7 +67,7 @@ class TestPagedCacheOps:
         # token i of layer l must sit at page table[i//4], offset i%4
         for i in range(S):
             page = int(table[0, i // 4])
-            got = cache.k_pages[0, :, page, i % 4]   # [KVH, D]
+            got = cache.k_pages[0, page, i % 4]   # [KVH, D]
             np.testing.assert_allclose(got, k_new[0, 0, i], atol=1e-6)
 
     def test_padding_goes_to_garbage_page(self, rng):
@@ -81,8 +81,8 @@ class TestPagedCacheOps:
         pages, offsets = slot_to_page_offset(positions, table, cc.page_size)
         valid = jnp.asarray([[True, True, False, False]])
         cache = write_kv(cache, k_new, k_new, pages, offsets, valid)
-        assert float(jnp.abs(cache.k_pages[:, :, 2, 2:]).max()) == 0.0
-        assert float(jnp.abs(cache.k_pages[:, :, 0]).max()) > 0.0  # garbage page
+        assert float(jnp.abs(cache.k_pages[:, 2, 2:]).max()) == 0.0
+        assert float(jnp.abs(cache.k_pages[:, 0]).max()) > 0.0  # garbage page
 
 
 class TestPagedDecodeAttention:
@@ -97,10 +97,10 @@ class TestPagedDecodeAttention:
         v_new = jax.random.normal(ks[4], (B, KVH, D))
         lengths = jnp.asarray([12, 7], jnp.int32)
 
-        # scatter contexts into a shuffled page pool [KVH, N, P, D]
+        # scatter contexts into a shuffled page pool [N, P, KVH, D]
         num_pages, maxP = 16, 4
-        k_pages = jnp.zeros((KVH, num_pages, P, D))
-        v_pages = jnp.zeros((KVH, num_pages, P, D))
+        k_pages = jnp.zeros((num_pages, P, KVH, D))
+        v_pages = jnp.zeros((num_pages, P, KVH, D))
         tables = np.zeros((B, maxP), np.int32)
         perm = [9, 3, 14, 6, 1, 11, 7, 2]
         pi = 0
@@ -110,10 +110,12 @@ class TestPagedDecodeAttention:
                 page = perm[pi]; pi += 1
                 tables[b, j] = page
                 chunk = min(P, int(lengths[b]) - j * P)
-                src_k = k_ctx[b, j * P : j * P + chunk].transpose(1, 0, 2)
-                src_v = v_ctx[b, j * P : j * P + chunk].transpose(1, 0, 2)
-                k_pages = k_pages.at[:, page, :chunk].set(src_k)
-                v_pages = v_pages.at[:, page, :chunk].set(src_v)
+                k_pages = k_pages.at[page, :chunk].set(
+                    k_ctx[b, j * P : j * P + chunk]
+                )
+                v_pages = v_pages.at[page, :chunk].set(
+                    v_ctx[b, j * P : j * P + chunk]
+                )
 
         got = paged_decode_attention_reference(
             q, k_pages, v_pages, jnp.asarray(tables), lengths, k_new, v_new
@@ -134,6 +136,56 @@ class TestPagedDecodeAttention:
             np.testing.assert_allclose(
                 np.asarray(got[b]), np.asarray(want[0, 0]), atol=1e-5
             )
+
+    def test_attend_and_write_kernel_interpret(self, rng):
+        """Pallas attend-and-write (interpret mode) == XLA reference:
+        same attention output, same pool contents after the in-kernel
+        token write, parked slots untouched except the garbage page."""
+        from helix_tpu.ops.paged import _reference_attend_and_write
+        from helix_tpu.ops.paged_kernel import paged_decode_attention_tpu
+
+        B, KVH, H, D, P = 2, 2, 4, 128, 4
+        L, N, maxP = 3, 16, 4
+        ks = jax.random.split(rng, 5)
+        q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
+        k_pages = jax.random.normal(ks[1], (L, N, P, KVH, D), jnp.float32)
+        v_pages = k_pages + 0.5
+        k_new = jax.random.normal(ks[2], (B, KVH, D), jnp.float32)
+        v_new = jax.random.normal(ks[3], (B, KVH, D), jnp.float32)
+        tables = jnp.asarray([[3, 5, 7, 0], [9, 2, 0, 0]], jnp.int32)
+        lengths = jnp.asarray([11, 5], jnp.int32)
+        active = jnp.asarray([1, 0], jnp.int32)  # slot 1 parked
+        layer = jnp.int32(1)
+
+        want_out, want_kp, want_vp = _reference_attend_and_write(
+            q, k_pages, v_pages, tables, lengths, layer, active,
+            k_new, v_new, scale=None,
+        )
+        got_out, got_kp, got_vp = paged_decode_attention_tpu(
+            q, k_pages, v_pages, tables, lengths, layer, active,
+            k_new, v_new, interpret=True,
+        )
+        # active slot's attention matches the oracle (parked slot's output
+        # is unspecified — the engine discards it)
+        np.testing.assert_allclose(
+            np.asarray(got_out[0]), np.asarray(want_out[0]), atol=1e-5
+        )
+        # slot 0's token landed at table[0, 11//4]=7, offset 3 of layer 1
+        np.testing.assert_allclose(
+            np.asarray(got_kp[1, 7, 3]), np.asarray(k_new[0]), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_vp[1, 7, 3]), np.asarray(v_new[0]), atol=1e-6
+        )
+        # pools agree with the functional oracle everywhere but the
+        # garbage page (parked slots dump their token there; the oracle
+        # wrote slot 1's k_new to page 0, the kernel did too)
+        np.testing.assert_allclose(
+            np.asarray(got_kp), np.asarray(want_kp), atol=1e-6
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_vp), np.asarray(want_vp), atol=1e-6
+        )
 
 
 def _keys(b, seed):

@@ -207,6 +207,10 @@ class TestAnthropicSurface:
                 "model": "tiny-chat",
                 "messages": [{"role": "user", "content": "hi"}],
                 "max_tokens": 5,
+                # greedy: with default temperature the tiny random model can
+                # draw EOS as its first token (stream then has no content
+                # delta) depending on where the engine's key stream stands
+                "temperature": 0,
                 "stream": True,
             },
             stream=True,

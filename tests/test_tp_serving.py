@@ -69,7 +69,8 @@ def test_tp2_greedy_parity(tiny_cfg, baseline_tokens):
     assert isinstance(kv_sharding, NamedSharding), (
         f"KV pool is not mesh-sharded: {kv_sharding}"
     )
-    assert kv_sharding.spec[1] == "tp", kv_sharding.spec
+    # pool is [L, N, P, KVH, D]; kv heads (axis 3) follow tensor parallelism
+    assert kv_sharding.spec[3] == "tp", kv_sharding.spec
     assert _generate(eng) == baseline_tokens
 
 
