@@ -1,0 +1,149 @@
+"""Shared per-project build caches for sandboxed agent runs.
+
+Reference: the sandbox node shares ONE BuildKit daemon + registry across
+all agent desktops so a cold 43-minute stack build becomes ~0.5 s warm
+(``api/pkg/hydra/manager.go:16-52``, ``design/2026-02-21-smart-load-blog``),
+and ``api/cmd/docker-wrapper`` intercepts ``docker build`` to route every
+container build through it.
+
+This build's agents run in process sandboxes over plain directories, so
+the same capability maps to toolchain cache redirection: every sandboxed
+build of a project points its package/compiler caches at ONE shared
+per-project directory, so task N+1's ``pip install`` / ``npm ci`` /
+``cargo build`` hits task N's warm cache instead of re-downloading and
+re-compiling.  The moving parts:
+
+- ``env_for(project)`` -> env vars redirecting the common toolchain caches
+  (pip, uv, npm, Go build+module, ccache, cargo registry, generic
+  XDG_CACHE_HOME) into ``<root>/<project-slug>/``.  Injected into the
+  sandbox child env by ``SandboxExecutor`` — the agent needs no wrapper
+  binary because cache location is an env contract for these tools.
+- usage accounting + ``gc(max_bytes)``: least-recently-USED project
+  caches are evicted first (use = an ``env_for`` call, touched on disk),
+  mirroring hydra's disk-pressure-driven GC
+  (``api/pkg/hydra/disk_pressure.go``, ``workspace_gc.go``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import re
+import shutil
+import threading
+import time
+
+log = logging.getLogger("helix.buildcache")
+
+# env var -> subdirectory under the project cache
+_CACHE_ENV = {
+    "PIP_CACHE_DIR": "pip",
+    "UV_CACHE_DIR": "uv",
+    "NPM_CONFIG_CACHE": "npm",
+    "GOMODCACHE": "gomod",
+    "GOCACHE": "gobuild",
+    "CCACHE_DIR": "ccache",
+    "CARGO_HOME": "cargo",
+    "XDG_CACHE_HOME": "xdg",
+}
+
+
+def _slug(name: str) -> str:
+    s = re.sub(r"[^A-Za-z0-9._-]+", "-", name).strip("-")
+    return s or "default"
+
+
+@dataclasses.dataclass
+class CacheInfo:
+    project: str
+    bytes: int
+    last_used: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class BuildCacheManager:
+    """One shared cache tree per project under ``root``."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def project_dir(self, project: str) -> str:
+        return os.path.join(self.root, _slug(project))
+
+    def env_for(self, project: str) -> dict:
+        """Cache-redirection env for one sandboxed build; creating the
+        directories counts as a use (LRU freshness)."""
+        base = self.project_dir(project)
+        env = {}
+        with self._lock:
+            for var, sub in _CACHE_ENV.items():
+                d = os.path.join(base, sub)
+                os.makedirs(d, exist_ok=True)
+                env[var] = d
+            os.utime(base)
+        return env
+
+    # ------------------------------------------------------------------
+    def _tree_bytes(self, path: str) -> int:
+        total = 0
+        for r, _, files in os.walk(path):
+            for f in files:
+                try:
+                    total += os.lstat(os.path.join(r, f)).st_size
+                except OSError:
+                    pass
+        return total
+
+    def list(self) -> list:
+        out = []
+        with self._lock:
+            for name in sorted(os.listdir(self.root)):
+                p = os.path.join(self.root, name)
+                if not os.path.isdir(p):
+                    continue
+                try:
+                    used = os.stat(p).st_mtime
+                except OSError:
+                    continue
+                out.append(CacheInfo(
+                    project=name,
+                    bytes=self._tree_bytes(p),
+                    last_used=used,
+                ))
+        return out
+
+    def total_bytes(self) -> int:
+        return sum(c.bytes for c in self.list())
+
+    def drop(self, project: str) -> bool:
+        p = self.project_dir(project)
+        with self._lock:
+            if not os.path.isdir(p):
+                return False
+            shutil.rmtree(p, ignore_errors=True)
+        return True
+
+    def gc(self, max_bytes: int) -> list:
+        """Evict least-recently-used project caches until the tree fits
+        ``max_bytes``.  Returns the evicted project names."""
+        infos = self.list()
+        total = sum(c.bytes for c in infos)
+        evicted = []
+        if total <= max_bytes:
+            return evicted
+        for c in sorted(infos, key=lambda c: c.last_used):
+            if total <= max_bytes:
+                break
+            if self.drop(c.project):
+                log.info(
+                    "build-cache gc: evicted %s (%d bytes, idle %.0fs)",
+                    c.project, c.bytes, time.time() - c.last_used,
+                )
+                evicted.append(c.project)
+                total -= c.bytes
+        return evicted
