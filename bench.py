@@ -98,10 +98,17 @@ def main():
     import jax
     import jax.numpy as jnp
 
-    # persistent compile cache: repeat bench runs (and the warmup pass
-    # below) skip XLA compilation entirely, same as tests/conftest.py
-    jax.config.update("jax_compilation_cache_dir", "/root/.jax_bench_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    # Persistent compile cache ONLY on the accelerator path: repeat bench
+    # runs skip XLA compilation.  The CPU fallback must not use it —
+    # XLA:CPU AOT cache deserialization segfaults in this jax build
+    # (see tests/conftest.py), and a dead bench emits no JSON line.
+    if os.environ.get("HELIX_BENCH_CHILD") != "1":
+        jax.config.update(
+            "jax_compilation_cache_dir", "/root/.jax_bench_cache"
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.5
+        )
 
     from helix_tpu.engine.engine import Engine, EngineConfig
     from helix_tpu.engine.sampling import SamplingParams

@@ -77,13 +77,14 @@ class User:
 
 
 class Authenticator:
-    def __init__(self, db_path: str = ":memory:", master_key: Optional[bytes] = None):
-        self._db_path = db_path
-        self._conn = sqlite3.connect(db_path, check_same_thread=False)
-        self._lock = threading.Lock()
-        with self._lock:
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+    def __init__(self, db_path=":memory:", master_key: Optional[bytes] = None):
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(db_path)
+        self._db_path = self._db.path
+        self._conn = self._db.conn
+        self._lock = self._db.lock
+        self._db.migrate("auth", [(1, "initial", _SCHEMA)])
         if master_key is None:
             env_key = os.environ.get("HELIX_MASTER_KEY")
             if env_key:
@@ -130,7 +131,7 @@ class Authenticator:
                 (uid, email, name, int(admin), time.time(),
                  f"%{self.SERVICE_DOMAIN}"),
             )
-            self._conn.commit()
+            self._db.commit()
             if cur.rowcount == 0:
                 return None
         return User(id=uid, email=email, name=name, admin=admin)
@@ -158,7 +159,7 @@ class Authenticator:
             self._conn.execute(
                 "DELETE FROM auth_keys WHERE user_id=?", (u.id,)
             )
-            self._conn.commit()
+            self._db.commit()
         return self.create_api_key(u.id, name=name)
 
     # -- users -------------------------------------------------------------
@@ -170,7 +171,7 @@ class Authenticator:
                 "VALUES(?,?,?,?,?)",
                 (uid, email, name, int(admin), time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
         return User(id=uid, email=email, name=name, admin=admin)
 
     def get_user(self, uid: str) -> Optional[User]:
@@ -197,7 +198,7 @@ class Authenticator:
                 "VALUES(?,?,?,?)",
                 (self._hash_key(key), user_id, name, time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
         return key
 
     def authenticate(self, bearer: Optional[str]) -> Optional[User]:
@@ -216,7 +217,7 @@ class Authenticator:
                 "UPDATE auth_keys SET last_used=? WHERE key_hash=?",
                 (time.time(), h),
             )
-            self._conn.commit()
+            self._db.commit()
         return self.get_user(row[0])
 
     def revoke_api_key(self, key: str) -> bool:
@@ -225,7 +226,7 @@ class Authenticator:
                 "DELETE FROM auth_keys WHERE key_hash=?",
                 (self._hash_key(key),),
             )
-            self._conn.commit()
+            self._db.commit()
             return cur.rowcount > 0
 
     # -- orgs / RBAC ---------------------------------------------------------
@@ -240,7 +241,7 @@ class Authenticator:
                 "INSERT INTO org_members(org_id, user_id, role) VALUES(?,?,?)",
                 (oid, owner_id, "owner"),
             )
-            self._conn.commit()
+            self._db.commit()
         return oid
 
     def add_member(self, org_id: str, user_id: str, role: str = "member"):
@@ -252,7 +253,7 @@ class Authenticator:
                 "ON CONFLICT(org_id, user_id) DO UPDATE SET role=excluded.role",
                 (org_id, user_id, role),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def remove_member(self, org_id: str, user_id: str):
         with self._lock:
@@ -260,7 +261,7 @@ class Authenticator:
                 "DELETE FROM org_members WHERE org_id=? AND user_id=?",
                 (org_id, user_id),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def member_role(self, org_id: str, user_id: str) -> Optional[str]:
         with self._lock:
@@ -315,7 +316,7 @@ class Authenticator:
             self._conn.execute(
                 "UPDATE users SET admin=? WHERE id=?", (int(admin), uid)
             )
-            self._conn.commit()
+            self._db.commit()
 
     def get_or_create_by_email(self, email: str, name: str = "") -> User:
         """OIDC auto-provisioning: a verified identity maps to a local
@@ -343,7 +344,7 @@ class Authenticator:
                 "ciphertext=excluded.ciphertext",
                 (sid, owner, name, ct, time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
         return sid
 
     def get_secret(self, owner: str, name: str) -> Optional[str]:
@@ -370,7 +371,7 @@ class Authenticator:
             cur = self._conn.execute(
                 "DELETE FROM secrets WHERE owner=? AND name=?", (owner, name)
             )
-            self._conn.commit()
+            self._db.commit()
             return cur.rowcount > 0
 
     def substitute_secrets(self, owner: str, text: str) -> str:

@@ -70,12 +70,13 @@ def price_microusd(model: str, prompt_tokens: int, completion_tokens: int) -> in
 
 
 class BillingService:
-    def __init__(self, db_path: str = ":memory:", usage_store=None):
-        self._conn = sqlite3.connect(db_path, check_same_thread=False)
-        self._lock = threading.Lock()
-        with self._lock:
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+    def __init__(self, db_path=":memory:", usage_store=None):
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(db_path)
+        self._conn = self._db.conn
+        self._lock = self._db.lock
+        self._db.migrate("billing", [(1, "initial", _SCHEMA)])
         self.usage_store = usage_store   # Store, for daily-quota sums
         # in-memory daily counters (rebuilt lazily; store is source of truth)
         self._daily: dict[str, tuple] = {}
@@ -105,7 +106,7 @@ class BillingService:
                 "SET tier=excluded.tier, updated_at=excluded.updated_at",
                 (owner, tier, time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def _tx(self, owner: str, amount: int, kind: str, meta: str = ""):
         self._conn.execute(
@@ -128,7 +129,7 @@ class BillingService:
                 (owner, amount, time.time(), amount, time.time()),
             )
             self._tx(owner, amount, "topup")
-            self._conn.commit()
+            self._db.commit()
         return self.wallet(owner)
 
     def charge_usage(
@@ -158,7 +159,7 @@ class BillingService:
                 owner, -cost, "usage",
                 f"{model}:{prompt_tokens}+{completion_tokens}",
             )
-            self._conn.commit()
+            self._db.commit()
         return cost
 
     def transactions(self, owner: str, limit: int = 50) -> list:

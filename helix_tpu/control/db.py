@@ -1,0 +1,143 @@
+"""Shared control-plane database: one connection, one migration path.
+
+The reference runs every entity through one GORM/Postgres store with a
+migrations framework (``api/pkg/store/postgres.go:84-170``).  Round 3 of
+this build had grown nine independent SQLite files (auth, billing, stripe,
+oauth, org, tasks, events, vectors, core) with no cross-store transactions
+— fine per-component, but no atomicity across entities and nine WAL files
+per deployment (round-3 verdict, "Store breadth" / next #10).
+
+``Database`` is the consolidation point:
+
+- ONE SQLite connection + re-entrant lock shared by every component; a
+  component does ``db = Database.resolve(db_or_path)`` so legacy
+  path-string construction (tests, standalone use) still works.
+- A ``schema_migrations`` table keyed ``(component, version)``; components
+  declare ordered migrations and ``migrate()`` applies the missing suffix
+  — schema evolution is recorded, not re-executed ``CREATE IF NOT
+  EXISTS`` hope.
+- ``transaction()`` gives multi-entity atomicity (e.g. billing debit +
+  usage row + session update commit or roll back together) — the RLock
+  makes nesting safe: inner transactions join the outermost commit.
+
+Postgres: this environment ships no driver (psycopg2/pg8000 absent), so a
+DSN of the form ``postgres://...`` raises with instructions rather than
+pretending; the seam exists so a deployment with a driver installed can
+drop one in (``HELIX_DB_DSN``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sqlite3
+import threading
+import time
+from typing import Iterable, Tuple, Union
+
+Migration = Tuple[int, str, str]  # (version, name, sql script)
+
+
+class Database:
+    def __init__(self, path: str = ":memory:"):
+        if path.startswith(("postgres://", "postgresql://")):
+            raise RuntimeError(
+                "Postgres DSNs need a driver (psycopg2/pg8000), which this "
+                "environment does not ship; install one and register a "
+                "connection factory, or use a SQLite path"
+            )
+        self.path = path
+        self.conn = sqlite3.connect(path, check_same_thread=False)
+        self.conn.execute("PRAGMA journal_mode=WAL")
+        self.lock = threading.RLock()
+        self._txn_depth = 0
+        with self.lock:
+            self.conn.execute(
+                "CREATE TABLE IF NOT EXISTS schema_migrations ("
+                " component TEXT NOT NULL,"
+                " version INTEGER NOT NULL,"
+                " name TEXT NOT NULL,"
+                " applied_at REAL NOT NULL,"
+                " PRIMARY KEY (component, version))"
+            )
+            self.conn.commit()
+
+    @classmethod
+    def resolve(cls, db_or_path: Union["Database", str, None]) -> "Database":
+        """Accept a shared Database or a legacy path string."""
+        if isinstance(db_or_path, Database):
+            return db_or_path
+        return cls(db_or_path if db_or_path is not None else ":memory:")
+
+    # -- migrations --------------------------------------------------------
+    def migrate(self, component: str, migrations: Iterable[Migration]) -> int:
+        """Apply the not-yet-applied suffix of a component's ordered
+        migration list.  Returns how many were applied."""
+        applied = 0
+        with self.lock:
+            have = {
+                row[0]
+                for row in self.conn.execute(
+                    "SELECT version FROM schema_migrations WHERE component=?",
+                    (component,),
+                )
+            }
+            for version, name, sql in sorted(migrations):
+                if version in have:
+                    continue
+                self.conn.executescript(sql)
+                self.conn.execute(
+                    "INSERT INTO schema_migrations(component, version, name,"
+                    " applied_at) VALUES(?,?,?,?)",
+                    (component, version, name, time.time()),
+                )
+                applied += 1
+            self.conn.commit()
+        return applied
+
+    def migrations(self, component: str | None = None) -> list:
+        q = ("SELECT component, version, name, applied_at FROM "
+             "schema_migrations")
+        args: tuple = ()
+        if component:
+            q += " WHERE component=?"
+            args = (component,)
+        with self.lock:
+            rows = self.conn.execute(
+                q + " ORDER BY component, version", args
+            ).fetchall()
+        return [
+            {"component": r[0], "version": r[1], "name": r[2],
+             "applied_at": r[3]}
+            for r in rows
+        ]
+
+    # -- transactions ------------------------------------------------------
+    @contextlib.contextmanager
+    def transaction(self):
+        """Cross-entity atomic block.  Nested blocks join the outermost
+        transaction (commit/rollback happens only at depth 0), so a
+        component method that takes the lock and commits itself can also
+        run inside a wider transaction unchanged."""
+        with self.lock:
+            self._txn_depth += 1
+            try:
+                yield self.conn
+            except BaseException:
+                self._txn_depth -= 1
+                if self._txn_depth == 0:
+                    self.conn.rollback()
+                raise
+            else:
+                self._txn_depth -= 1
+                if self._txn_depth == 0:
+                    self.conn.commit()
+
+    def commit(self) -> None:
+        """Commit unless inside a transaction() block (join semantics)."""
+        with self.lock:
+            if self._txn_depth == 0:
+                self.conn.commit()
+
+    def close(self) -> None:
+        with self.lock:
+            self.conn.close()

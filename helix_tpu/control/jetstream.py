@@ -53,13 +53,14 @@ CREATE TABLE IF NOT EXISTS consumers (
 
 
 class JetStream:
-    def __init__(self, path: str = ":memory:", ack_wait: float = 30.0):
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.Lock()
+    def __init__(self, path=":memory:", ack_wait: float = 30.0):
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(path)
+        self._conn = self._db.conn
+        self._lock = self._db.lock
         self.ack_wait = ack_wait
-        with self._lock:
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+        self._db.migrate("jetstream", [(1, "initial", _SCHEMA)])
         # (stream, name) -> {seq: deadline} in-flight deliveries
         self._pending: dict[tuple, dict] = {}
         # out-of-order acks above the floor: (stream, name) -> set(seq)
@@ -76,7 +77,7 @@ class JetStream:
                 "subjects=excluded.subjects, max_msgs=excluded.max_msgs",
                 (name, json.dumps(list(subjects)), max_msgs),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def streams(self) -> list:
         with self._lock:
@@ -120,7 +121,7 @@ class JetStream:
                         (name, seq - max_msgs),
                     )
                 out[name] = seq
-            self._conn.commit()
+            self._db.commit()
         return out
 
     def stream_info(self, name: str) -> dict:
@@ -144,7 +145,7 @@ class JetStream:
                 "VALUES(?,?,0)",
                 (stream, consumer),
             )
-            self._conn.commit()
+            self._db.commit()
             return 0
         return row[0]
 
@@ -209,7 +210,7 @@ class JetStream:
                     "AND name=?",
                     (new_floor, stream, consumer),
                 )
-                self._conn.commit()
+                self._db.commit()
 
     def consumer_info(self, stream: str, consumer: str) -> dict:
         with self._lock:

@@ -79,11 +79,12 @@ class OAuthManager:
         """``encrypt``/``decrypt`` come from the Authenticator's Fernet
         envelope; ``http_post(url, data, headers) -> dict`` is the token
         endpoint transport (injected in tests; requests-based default)."""
-        self._conn = sqlite3.connect(db_path, check_same_thread=False)
-        self._lock = threading.Lock()
-        with self._lock:
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(db_path)
+        self._conn = self._db.conn
+        self._lock = self._db.lock
+        self._db.migrate("oauth", [(1, "initial", _SCHEMA)])
         self._providers: dict[str, OAuthProviderConfig] = {}
         # state -> (user, provider, redirect_uri, created)
         self._states: dict[str, tuple[str, str, str, float]] = {}
@@ -181,7 +182,7 @@ class OAuthManager:
                 (user_id, provider, ct, record["scope"], self.now(),
                  self.now()),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def _load(self, user_id: str, provider: str) -> Optional[dict]:
         with self._lock:
@@ -215,7 +216,7 @@ class OAuthManager:
                 "provider=?",
                 (user_id, provider),
             )
-            self._conn.commit()
+            self._db.commit()
             return cur.rowcount > 0
 
     # -- the skill-facing API ----------------------------------------------

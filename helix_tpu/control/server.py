@@ -134,28 +134,28 @@ class ControlPlane:
 
         from helix_tpu.control.tunnel import TunnelHub
 
-        self.store = Store(db_path)
+        # ONE database file for every control-plane entity (round-3 next
+        # #10): components share its connection and migration registry, and
+        # multi-entity writes can run in one db.transaction() block.  The
+        # HELIX_DB_DSN env overrides the path (a postgres:// DSN raises
+        # with instructions unless a driver is installed — see db.py).
+        from helix_tpu.control.db import Database
+
+        self.db = Database.resolve(
+            _os_env.environ.get("HELIX_DB_DSN") or db_path
+        )
+        self.store = Store(self.db)
         self.router = InferenceRouter()
         self.tunnels = TunnelHub()
-        auth_path = ":memory:" if db_path == ":memory:" else db_path + ".auth"
-        self.auth = Authenticator(auth_path)
-        bill_path = (
-            ":memory:" if db_path == ":memory:" else db_path + ".billing"
-        )
-        self.billing = BillingService(bill_path, usage_store=None)
+        self.auth = Authenticator(self.db)
+        self.billing = BillingService(self.db, usage_store=None)
         from helix_tpu.control.stripe import StripeService
 
-        stripe_path = (
-            ":memory:" if db_path == ":memory:" else db_path + ".stripe"
-        )
-        self.stripe = StripeService.from_env(self.billing, stripe_path)
+        self.stripe = StripeService.from_env(self.billing, self.db)
         self.auth_required = auth_required
         self.providers = ProviderManager.from_env(self.router)
         self._restore_providers()   # DB-backed endpoints survive restarts
-        vec_path = (
-            ":memory:" if db_path == ":memory:" else db_path + ".vectors"
-        )
-        self.vectors = VectorStore(vec_path)
+        self.vectors = VectorStore(self.db)
         if embed_fn is None:
             # prefer a served embedding model when one exists; hashing
             # fallback keeps RAG working with zero models
@@ -178,11 +178,8 @@ class ControlPlane:
 
         from helix_tpu.control.oauth import OAuthManager, OAuthProviderConfig
 
-        oauth_path = (
-            ":memory:" if db_path == ":memory:" else db_path + ".oauth"
-        )
         self.oauth = OAuthManager(
-            oauth_path, encrypt=self.auth.encrypt, decrypt=self.auth.decrypt
+            self.db, encrypt=self.auth.encrypt, decrypt=self.auth.decrypt
         )
         gh_id = _os_oauth.environ.get("HELIX_GITHUB_CLIENT_ID", "")
         gh_secret = _os_oauth.environ.get("HELIX_GITHUB_CLIENT_SECRET", "")
@@ -243,9 +240,7 @@ class ControlPlane:
                                "helix-git")
         )
         self.git = GitService(git_root)
-        self.task_store = TaskStore(
-            ":memory:" if db_path == ":memory:" else db_path + ".tasks"
-        )
+        self.task_store = TaskStore(self.db)
 
         class _ProviderLLM:
             """Resolve per call so agents follow provider availability."""
@@ -376,9 +371,37 @@ class ControlPlane:
 
             return _asyncio.run(call())
 
+        def org_agent_runner(bot, prompt, msgs):
+            """Agent-backed bot activation: a REAL skill-loop session
+            through the provider manager (round-3 next #8 — bots that run
+            agent sessions on dispatch, not one-shot completions)."""
+            import asyncio as _asyncio
+
+            from helix_tpu.agent.agent import Agent, AgentConfig
+            from helix_tpu.agent.skill import SkillRegistry
+            from helix_tpu.agent.skills import calculator_skill
+
+            async def call():
+                model = bot.model
+                if not model:
+                    available = self.router.available_models()
+                    model = available[0] if available else ""
+                client, m = self.providers.resolve(model)
+                agent = Agent(
+                    AgentConfig(prompt=prompt, model=m),
+                    SkillRegistry([calculator_skill()]),
+                    client,
+                )
+                user_text = msgs[-1]["content"] if msgs else ""
+                answer, _steps = await agent.run(
+                    user_text, history=msgs[:-1]
+                )
+                return answer
+
+            return _asyncio.run(call())
+
         self.org = OrgService(
-            ":memory:" if db_path == ":memory:" else db_path + ".org",
-            llm=org_llm,
+            self.db, llm=org_llm, agent_runner=org_agent_runner
         )
 
         # janitor + version ping (reference: api/pkg/janitor, serve.go
@@ -447,10 +470,7 @@ class ControlPlane:
         # and task lifecycle events survive restarts; consumers resume
         from helix_tpu.control.jetstream import JetStream
 
-        js_path = (
-            ":memory:" if db_path == ":memory:" else db_path + ".events"
-        )
-        self.jetstream = JetStream(js_path)
+        self.jetstream = JetStream(self.db)
         # (fnmatch "*" crosses dots, so one pattern per stream suffices)
         self.jetstream.add_stream(
             "SESSIONS", ["sessions.*"], max_msgs=10000
@@ -503,7 +523,10 @@ class ControlPlane:
                 {"session_id": sid, "trigger": trigger.id},
             )
 
-        self.triggers = TriggerManager(fire_trigger).start()
+        self.triggers = TriggerManager(fire_trigger)
+        # org scheduled activations ride the trigger cron loop
+        self.triggers.extra_ticks.append(self.org.tick)
+        self.triggers.start()
 
         # cloud pool autoscaler (reference: sandbox/compute manager) —
         # constructed only when an operator supplies a config; the stub
@@ -687,6 +710,7 @@ class ControlPlane:
         )
         r = app.router
         r.add_get("/", self.web_ui)
+        r.add_get("/ui/js/{name}", self.web_ui_module)
         r.add_get("/healthz", self.healthz)
         # runner control loop
         r.add_post("/api/v1/runners/{id}/heartbeat", self.heartbeat)
@@ -826,9 +850,20 @@ class ControlPlane:
             "/api/v1/org/channels/{id}/messages", self.org_messages
         )
         r.add_post("/api/v1/org/channels/{id}/messages", self.org_post)
+        r.add_get("/api/v1/org/bindings", self.org_list_bindings)
+        r.add_post("/api/v1/org/bindings", self.org_bind_channel)
+        r.add_post(
+            "/api/v1/org/platform/{kind}", self.org_platform_webhook
+        )
+        r.add_get("/api/v1/org/activations", self.org_list_activations)
+        r.add_post("/api/v1/org/activations", self.org_add_activation)
+        r.add_delete(
+            "/api/v1/org/activations/{id}", self.org_remove_activation
+        )
         # notifications + captured errors
         r.add_get("/api/v1/notifications", self.list_notifications)
         r.add_get("/api/v1/errors", self.list_errors)
+        r.add_get("/api/v1/admin/migrations", self.list_migrations)
         # triggers + webhooks
         r.add_get("/api/v1/triggers", self.list_triggers)
         r.add_post("/api/v1/triggers", self.create_trigger)
@@ -894,6 +929,31 @@ class ControlPlane:
                 self._web_ui_html = f.read()
         return web.Response(
             text=self._web_ui_html, content_type="text/html"
+        )
+
+    async def web_ui_module(self, request):
+        """Serve the UI's ES modules (no build step: each tab is a plain
+        module under web/js/)."""
+        import os as _os
+        import re as _re
+
+        name = request.match_info["name"]
+        if not _re.fullmatch(r"[a-z_]+\.js", name):
+            return _err(404, "no such module")
+        path = _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)), "..", "web",
+            "js", name,
+        )
+        if not _os.path.exists(path):
+            return _err(404, "no such module")
+        cache = getattr(self, "_web_ui_modules", None)
+        if cache is None:
+            cache = self._web_ui_modules = {}
+        if name not in cache:
+            with open(path) as f:
+                cache[name] = f.read()
+        return web.Response(
+            text=cache[name], content_type="application/javascript"
         )
 
     # -- runner control loop ----------------------------------------------
@@ -1787,6 +1847,7 @@ class ControlPlane:
             bot = self.org.create_bot(
                 name=body.get("name", ""), role=body.get("role", ""),
                 model=body.get("model", ""),
+                agent=bool(body.get("agent", False)),
             )
         except OrgError as e:
             return _err(400, str(e))
@@ -1857,6 +1918,59 @@ class ControlPlane:
             return _err(404, str(e))
         return web.json_response({"messages": new})
 
+    async def org_list_bindings(self, request):
+        return web.json_response({"bindings": self.org.bindings()})
+
+    async def org_bind_channel(self, request):
+        from helix_tpu.services.org import OrgError
+
+        body = await request.json()
+        try:
+            self.org.bind_channel(
+                body["platform"], body["external_id"], body["channel_id"]
+            )
+        except (OrgError, KeyError) as e:
+            return _err(400, str(e))
+        return web.json_response({"ok": True})
+
+    async def org_platform_webhook(self, request):
+        """Inbound Slack/Teams/Discord event for the org (distinct from
+        app triggers): routes into the bound channel, bots answer, and
+        the reply batch is returned (a deployment with egress passes a
+        ``send`` callback via OrgService directly)."""
+        import asyncio as _asyncio
+
+        kind = request.match_info["kind"]
+        payload = await request.json()
+        verdict, doc = await _asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.org.handle_platform_event(kind, payload)
+        )
+        if verdict == "challenge":
+            return web.json_response(doc)
+        if verdict == "ignore":
+            return web.json_response({"ok": True, "ignored": doc})
+        return web.json_response({"ok": True, "messages": doc})
+
+    async def org_list_activations(self, request):
+        return web.json_response({"activations": self.org.activations()})
+
+    async def org_add_activation(self, request):
+        from helix_tpu.services.org import OrgError
+
+        body = await request.json()
+        try:
+            aid = self.org.add_activation(
+                body["bot_id"], body["channel_id"], body["schedule"],
+                note=body.get("note", ""),
+            )
+        except (OrgError, ValueError, KeyError) as e:
+            return _err(400, str(e))
+        return web.json_response({"id": aid})
+
+    async def org_remove_activation(self, request):
+        ok = self.org.remove_activation(request.match_info["id"])
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
     @staticmethod
     def _parse_limit(request, default: int = 50, cap: int = 500):
         """-> (limit, None) or (None, error response)."""
@@ -1864,6 +1978,15 @@ class ControlPlane:
             return max(1, min(int(request.query.get("limit", default)), cap)), None
         except ValueError:
             return None, _err(400, "limit must be an integer")
+
+    async def list_migrations(self, request):
+        """The consolidated database's migration ledger (admin UI; the
+        reference exposes its GORM auto-migration state through ops
+        tooling — here it is first-class)."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        return web.json_response({"migrations": self.db.migrations()})
 
     async def list_errors(self, request):
         """Captured unhandled errors (janitor ring) for the admin UI;

@@ -111,13 +111,13 @@ CREATE INDEX IF NOT EXISTS idx_eval_runs_suite ON eval_runs(suite_id);
 
 
 class Store:
-    def __init__(self, path: str = ":memory:"):
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._conn.execute("PRAGMA journal_mode=WAL")
-        self._lock = threading.Lock()
-        with self._lock:
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+    def __init__(self, path=":memory:"):
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(path)
+        self._conn = self._db.conn
+        self._lock = self._db.lock
+        self._db.migrate("core", [(1, "initial", _SCHEMA)])
 
     # -- profiles ----------------------------------------------------------
     def upsert_profile(self, name: str, doc: dict) -> None:
@@ -129,7 +129,7 @@ class Store:
                 "doc=excluded.doc, updated_at=excluded.updated_at",
                 (name, json.dumps(doc), now, now),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def get_profile(self, name: str) -> Optional[dict]:
         with self._lock:
@@ -150,7 +150,7 @@ class Store:
             cur = self._conn.execute(
                 "DELETE FROM profiles WHERE name=?", (name,)
             )
-            self._conn.commit()
+            self._db.commit()
             return cur.rowcount > 0
 
     # -- assignments -------------------------------------------------------
@@ -163,7 +163,7 @@ class Store:
                 "assigned_at=excluded.assigned_at",
                 (runner_id, profile_name, time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def list_assignments(self) -> list:
         """[(runner_id, profile_name)] for runners with a live assignment
@@ -193,7 +193,7 @@ class Store:
                 "updated_at=excluded.updated_at",
                 (runner_id, json.dumps(payload), time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def get_runner(self, runner_id: str) -> Optional[dict]:
         with self._lock:
@@ -227,7 +227,7 @@ class Store:
                 "updated_at) VALUES(?,?,?,?,?,?)",
                 (sid, owner, name, json.dumps(doc), now, now),
             )
-            self._conn.commit()
+            self._db.commit()
         return sid
 
     def get_session(self, sid: str) -> Optional[dict]:
@@ -251,7 +251,7 @@ class Store:
                 "UPDATE sessions SET doc=?, updated_at=? WHERE id=?",
                 (json.dumps(doc), time.time(), sid),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def list_sessions(self, owner: Optional[str] = None) -> list:
         q = "SELECT id, owner, name, created_at, updated_at FROM sessions"
@@ -276,7 +276,7 @@ class Store:
             self._conn.execute(
                 "DELETE FROM interactions WHERE session_id=?", (sid,)
             )
-            self._conn.commit()
+            self._db.commit()
 
     def add_interaction(self, session_id: str, doc: dict) -> str:
         iid = f"int_{uuid.uuid4().hex[:16]}"
@@ -292,7 +292,7 @@ class Store:
                 "created_at) VALUES(?,?,?,?,?)",
                 (iid, session_id, seq, json.dumps(doc), time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
         return iid
 
     def list_interactions(self, session_id: str) -> list:
@@ -318,7 +318,7 @@ class Store:
                     provider, json.dumps(doc), time.time(),
                 ),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def add_usage(self, owner: str, model: str, prompt: int, completion: int):
         with self._lock:
@@ -330,7 +330,7 @@ class Store:
                     prompt, completion, time.time(),
                 ),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def usage_summary(self, owner: Optional[str] = None) -> dict:
         q = (
@@ -371,7 +371,7 @@ class Store:
                 "updated_at=excluded.updated_at",
                 (app_id, owner, name, json.dumps(doc), now, now),
             )
-            self._conn.commit()
+            self._db.commit()
         return app_id
 
     def get_app(self, app_id: str) -> Optional[dict]:
@@ -405,7 +405,7 @@ class Store:
             cur = self._conn.execute(
                 "DELETE FROM apps WHERE id=?", (app_id,)
             )
-            self._conn.commit()
+            self._db.commit()
             return cur.rowcount > 0
 
     # -- kv ----------------------------------------------------------------
@@ -416,7 +416,7 @@ class Store:
                 "DO UPDATE SET v=excluded.v",
                 (k, json.dumps(v)),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def kv_get(self, k: str, default=None) -> Any:
         with self._lock:
@@ -437,7 +437,7 @@ class Store:
                 "created_at, updated_at) VALUES(?,?,?,?,?,?)",
                 (sid, app_id, owner, json.dumps(doc), now, now),
             )
-            self._conn.commit()
+            self._db.commit()
         return sid
 
     def update_eval_suite(self, sid: str, doc: dict) -> bool:
@@ -446,7 +446,7 @@ class Store:
                 "UPDATE eval_suites SET doc=?, updated_at=? WHERE id=?",
                 (json.dumps(doc), time.time(), sid),
             )
-            self._conn.commit()
+            self._db.commit()
             return cur.rowcount > 0
 
     def get_eval_suite(self, sid: str) -> Optional[dict]:
@@ -479,7 +479,7 @@ class Store:
             self._conn.execute(
                 "DELETE FROM eval_runs WHERE suite_id=?", (sid,)
             )
-            self._conn.commit()
+            self._db.commit()
             return cur.rowcount > 0
 
     @staticmethod
@@ -504,7 +504,7 @@ class Store:
                 (rid, suite_id, app_id, owner, status, json.dumps(doc),
                  now, now),
             )
-            self._conn.commit()
+            self._db.commit()
         return rid
 
     def update_eval_run(self, rid: str, status: str, doc: dict) -> None:
@@ -514,7 +514,7 @@ class Store:
                 "WHERE id=?",
                 (status, json.dumps(doc), time.time(), rid),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def get_eval_run(self, rid: str) -> Optional[dict]:
         with self._lock:
@@ -543,7 +543,7 @@ class Store:
             cur = self._conn.execute(
                 "DELETE FROM eval_runs WHERE id=?", (rid,)
             )
-            self._conn.commit()
+            self._db.commit()
             return cur.rowcount > 0
 
     @staticmethod

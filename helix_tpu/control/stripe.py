@@ -118,11 +118,12 @@ class StripeService:
         self.price_id_pro = price_id_pro
         self.base_url = base_url.rstrip("/")
         self.app_url = app_url.rstrip("/")
-        self._conn = sqlite3.connect(db_path, check_same_thread=False)
-        self._lock = threading.Lock()
-        with self._lock:
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(db_path)
+        self._conn = self._db.conn
+        self._lock = self._db.lock
+        self._db.migrate("stripe", [(1, "initial", _SCHEMA)])
 
     @classmethod
     def from_env(cls, billing, db_path: str = ":memory:", env=None):
@@ -180,7 +181,7 @@ class StripeService:
                 " updated_at) VALUES(?,?,?)",
                 (owner, cid, time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
         return cid
 
     def _owner_for_customer(self, customer_id: str) -> Optional[str]:
@@ -296,7 +297,7 @@ class StripeService:
                     "VALUES(?,?)",
                     (event_id, time.time()),
                 )
-                self._conn.commit()
+                self._db.commit()
                 return True
             except sqlite3.IntegrityError:
                 return False
@@ -308,7 +309,7 @@ class StripeService:
             self._conn.execute(
                 "DELETE FROM stripe_events WHERE event_id=?", (event_id,)
             )
-            self._conn.commit()
+            self._db.commit()
 
     def _handle_subscription(self, etype: str, sub: dict) -> dict:
         customer = sub.get("customer", "")
@@ -342,7 +343,7 @@ class StripeService:
                     1 if sub.get("cancel_at_period_end") else 0, time.time(),
                 ),
             )
-            self._conn.commit()
+            self._db.commit()
         self.billing.set_tier(owner, _TIER_FOR_STATUS.get(status, "free"))
         return {"ok": True, "owner": owner, "tier_status": status}
 

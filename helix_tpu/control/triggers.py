@@ -265,6 +265,10 @@ class TriggerManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # additional per-minute tickers riding this manager's cron loop
+        # (e.g. the org's scheduled activations) — each is called with no
+        # args once per minute and must not raise
+        self.extra_ticks: list = []
 
     # -- CRUD ----------------------------------------------------------------
     def add(
@@ -361,6 +365,11 @@ class TriggerManager:
         def run():
             while not self._stop.is_set():
                 self.tick()
+                for cb in list(self.extra_ticks):
+                    try:
+                        cb()
+                    except Exception:  # noqa: BLE001 — keep the loop alive
+                        traceback.print_exc()
                 # sleep to the start of the next minute
                 self._stop.wait(60 - (time.time() % 60))
 

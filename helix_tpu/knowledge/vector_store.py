@@ -46,11 +46,12 @@ ANN_THRESHOLD = int(os.environ.get("HELIX_ANN_THRESHOLD", "5000"))
 class VectorStore:
     def __init__(self, path: str = ":memory:",
                  ann_threshold: int = ANN_THRESHOLD):
-        self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.Lock()
-        with self._lock:
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(path)
+        self._conn = self._db.conn
+        self._lock = self._db.lock
+        self._db.migrate("vectors", [(1, "initial", _SCHEMA)])
         # collection -> (ids, normalised matrix) cache
         self._cache: dict[str, tuple] = {}
         # collection -> HNSWIndex over the cached matrix's row positions
@@ -82,7 +83,7 @@ class VectorStore:
                         emb.astype(np.float32).tobytes(), emb.shape[-1],
                     ),
                 )
-            self._conn.commit()
+            self._db.commit()
             self._cache.pop(collection, None)
             self._ann.pop(collection, None)
         return ids
@@ -92,7 +93,7 @@ class VectorStore:
             cur = self._conn.execute(
                 "DELETE FROM chunks WHERE collection=?", (collection,)
             )
-            self._conn.commit()
+            self._db.commit()
             self._cache.pop(collection, None)
             self._ann.pop(collection, None)
             return cur.rowcount
@@ -105,7 +106,7 @@ class VectorStore:
                 "DELETE FROM chunks WHERE collection=? AND version<?",
                 (collection, version),
             )
-            self._conn.commit()
+            self._db.commit()
             self._cache.pop(collection, None)
             self._ann.pop(collection, None)
             return cur.rowcount

@@ -1,0 +1,233 @@
+"""Container-grade agent isolation on Linux namespaces.
+
+The reference runs coding agents inside hydra dev containers — inner
+dockerd, shared BuildKit, golden snapshots
+(``api/pkg/hydra/manager.go:16-52``, ``external-agent/
+hydra_executor.go:130-569``).  This environment ships no container
+engine, so the equivalent isolation is built directly on the primitives
+engines themselves use: **user + mount + PID namespaces** (``unshare``)
+with a private tmpfs root assembled from bind mounts.
+
+What the agent sees inside:
+
+- a root filesystem holding ONLY the system toolchains (``/usr``,
+  ``/opt``, merged-usr symlinks) — the host's ``/root``, ``/home``,
+  control-plane DBs and checkpoints do not exist in its mount namespace
+  (the rlimit sandbox of round 3 shared the host view; this closes that);
+- the task workspace bind-mounted RW at ``/workspace`` (its HOME and
+  cwd) — the one writable host surface;
+- a fresh PID namespace (the agent is pid 1's child; nothing else is
+  visible or signalable), private ``/tmp`` and ``/dev`` subset;
+- rlimits applied inside (cpu-seconds + address space), so runaway
+  agents die without operator action.
+
+Writes to system binds fail at the host-kernel level: the namespace's
+uid 0 maps to the unprivileged host uid, which has no write permission
+on ``/usr``.  Golden snapshots compose with this orthogonally: the
+``WorkspaceManager`` promote/clone machinery snapshots ``/workspace``
+content (built envs, caches), and task N+1's container mounts the clone
+— the hydra golden flow with copy-on-write scoped to the workspace.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Optional, Sequence
+
+from helix_tpu.services.external_agent import ExternalAgentExecutor
+
+# Stage-1 script run by sh inside the new namespaces (argv: R WS then the
+# agent command).  Assembles the private root and enters it.  Propagation
+# is private in the new mount namespace, so none of these mounts are
+# visible to the host.
+_SETUP = r"""
+set -e
+R="$1"; WS="$2"; shift 2
+mount -t tmpfs tmpfs "$R"
+mkdir -p "$R/usr" "$R/proc" "$R/tmp" "$R/dev" "$R/etc" "$R/workspace"
+ro_bind() {
+    # bind + explicit read-only remount: permission bits alone do not
+    # protect the system binds when the control plane itself runs as
+    # root (the mapped uid then owns them)
+    mount --rbind "$1" "$2"
+    mount -o remount,bind,ro "$2" 2>/dev/null || true
+}
+ro_bind /usr "$R/usr"
+if [ -d /opt ]; then mkdir -p "$R/opt"; ro_bind /opt "$R/opt"; fi
+for d in bin sbin lib lib32 lib64 libx32; do
+    if [ -e "/$d" ]; then ln -s "usr/$d" "$R/$d" 2>/dev/null || true; fi
+done
+mount -t proc proc "$R/proc"
+mount -t tmpfs tmpfs "$R/tmp"
+for f in null zero urandom random; do
+    touch "$R/dev/$f"; mount --bind "/dev/$f" "$R/dev/$f"
+done
+echo 'root:x:0:0:root:/workspace:/bin/sh' > "$R/etc/passwd"
+echo 'root:x:0:' > "$R/etc/group"
+if [ -d /etc/ssl ]; then
+    mkdir -p "$R/etc/ssl"; ro_bind /etc/ssl "$R/etc/ssl"
+fi
+if [ -d /etc/alternatives ]; then
+    mkdir -p "$R/etc/alternatives"
+    ro_bind /etc/alternatives "$R/etc/alternatives"
+fi
+OLDIFS="$IFS"; IFS=:
+for p in $HELIX_CONTAINER_BINDS; do
+    [ -n "$p" ] || continue
+    mkdir -p "$R$p"; ro_bind "$p" "$R$p"
+done
+IFS="$OLDIFS"
+mount --rbind "$WS" "$R/workspace"
+if [ -n "$HELIX_CONTAINER_CPU_S" ]; then
+    ulimit -t "$HELIX_CONTAINER_CPU_S" 2>/dev/null || true
+fi
+if [ -n "$HELIX_CONTAINER_MEM_KB" ]; then
+    ulimit -v "$HELIX_CONTAINER_MEM_KB" 2>/dev/null || true
+fi
+exec chroot "$R" /bin/sh -c 'cd /workspace && exec "$@"' helix-container "$@"
+"""
+
+_probe_lock = threading.Lock()
+_probe_result: Optional[bool] = None
+
+
+def runtime_available() -> bool:
+    """Can this host create user+mount+pid namespaces?  (Kernels with
+    ``kernel.unprivileged_userns_clone=0`` or seccomp-blocked unshare —
+    e.g. inside an unprivileged container — cannot; callers fall back to
+    the rlimit process sandbox and say so.)  Cached after first probe."""
+    global _probe_result
+    with _probe_lock:
+        if _probe_result is None:
+            try:
+                p = subprocess.run(
+                    ["unshare", "--user", "--map-root-user", "--mount",
+                     "--pid", "--fork", "/bin/sh", "-c",
+                     "mount -t tmpfs tmpfs /tmp && echo ok"],
+                    capture_output=True, timeout=20,
+                )
+                _probe_result = p.returncode == 0 and b"ok" in p.stdout
+            except (OSError, subprocess.TimeoutExpired):
+                _probe_result = False
+        return _probe_result
+
+
+def container_command(
+    argv: Sequence[str],
+    workspace: str,
+    staging_dir: str,
+    ro_binds: Sequence[str] = (),
+    cpu_limit_s: Optional[int] = None,
+    memory_limit_bytes: Optional[int] = None,
+) -> tuple[list, dict]:
+    """-> (full argv, env additions) running ``argv`` containerised with
+    ``workspace`` mounted RW at /workspace.  ``ro_binds`` appear at their
+    host paths (for agent installs outside /usr//opt); writes to them
+    fail at the host-permission level like the system binds."""
+    env = {
+        "HELIX_CONTAINER_BINDS": ":".join(ro_binds),
+        "HELIX_CONTAINER_CPU_S":
+            "" if cpu_limit_s is None else str(int(cpu_limit_s)),
+        "HELIX_CONTAINER_MEM_KB":
+            "" if memory_limit_bytes is None
+            else str(int(memory_limit_bytes) // 1024),
+    }
+    cmd = [
+        "unshare", "--user", "--map-root-user", "--mount", "--pid",
+        "--fork", "/bin/sh", "-c", _SETUP, "helix-container-setup",
+        staging_dir, workspace, *argv,
+    ]
+    return cmd, env
+
+
+class ContainerAgentExecutor(ExternalAgentExecutor):
+    """ACP agent executor whose turns run inside a namespace container.
+
+    Drop-in for ``ExternalAgentExecutor`` on the orchestrator's Executor
+    seam: same ACP conversation, same emitter stream, but the agent's
+    filesystem view is the private root above with the task workspace at
+    ``/workspace`` (reference: hydra's dev-container execution,
+    ``api/pkg/external-agent/hydra_executor.go:130-569``)."""
+
+    def __init__(self, argv: list, ro_binds: Sequence[str] = (), **kw):
+        super().__init__(argv, **kw)
+        self.ro_binds = tuple(ro_binds)
+        if not runtime_available():
+            raise RuntimeError(
+                "namespace container runtime unavailable on this host "
+                "(unprivileged user namespaces disabled) — use "
+                "ExternalAgentExecutor (rlimit sandbox) instead"
+            )
+
+    def _agent_cwd(self, workspace: str) -> str:
+        return "/workspace"   # how the mount appears inside
+
+    def _env(self, workspace: str) -> dict:
+        env = super()._env(workspace)
+        env["HOME"] = "/workspace"
+        return env
+
+    def _spawn(self, workspace: str) -> subprocess.Popen:
+        staging = tempfile.mkdtemp(prefix="helix-ctr-")
+        cmd, extra = container_command(
+            self.argv, workspace, staging,
+            ro_binds=self.ro_binds,
+            cpu_limit_s=self.cpu_limit_s,
+            memory_limit_bytes=self.memory_limit_bytes,
+        )
+        env = self._env(workspace)
+        env.update(extra)
+        proc = subprocess.Popen(
+            cmd,
+            cwd=workspace,
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            start_new_session=True,
+        )
+        # the staging dir only anchors the in-namespace tmpfs; reap it
+        # once the container exits (nothing is ever written to it on the
+        # host side)
+        def reap():
+            proc.wait()
+            shutil.rmtree(staging, ignore_errors=True)
+
+        threading.Thread(target=reap, daemon=True).start()
+        return proc
+
+
+def run_in_container(
+    argv: Sequence[str],
+    workspace: str,
+    ro_binds: Sequence[str] = (),
+    timeout: float = 120.0,
+    env: Optional[dict] = None,
+) -> subprocess.CompletedProcess:
+    """One-shot containerised command (build steps, CI inside the
+    sandbox).  Returns the CompletedProcess; raises on runtime absence."""
+    if not runtime_available():
+        raise RuntimeError("namespace container runtime unavailable")
+    staging = tempfile.mkdtemp(prefix="helix-ctr-")
+    cmd, extra = container_command(argv, workspace, staging,
+                                   ro_binds=ro_binds)
+    full_env = {
+        "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+        "HOME": "/workspace",
+        "LANG": os.environ.get("LANG", "C.UTF-8"),
+        **(env or {}),
+        **extra,
+    }
+    try:
+        return subprocess.run(
+            cmd, cwd=workspace, env=full_env, capture_output=True,
+            text=True, timeout=timeout,
+        )
+    finally:
+        shutil.rmtree(staging, ignore_errors=True)

@@ -182,6 +182,11 @@ class ExternalAgentExecutor(Executor):
         self.cpu_limit_s = cpu_limit_s
         self.memory_limit_bytes = memory_limit_bytes
 
+    def _agent_cwd(self, workspace: str) -> str:
+        """Workspace path AS THE AGENT SEES IT (container executors remap
+        the host workspace to a fixed mount point)."""
+        return workspace
+
     def _env(self, workspace: str) -> dict:
         env = {
             "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
@@ -191,14 +196,11 @@ class ExternalAgentExecutor(Executor):
         env.update(self.extra_env)
         return env
 
-    def run(self, task: SpecTask, workspace: str, mode: str,
-            feedback: str = "") -> str:
-        prompt = build_agent_prompt(task, mode)
-        message = build_agent_message(task, feedback)
-        emit, close = (lambda s: None), (lambda: None)
-        if self.make_emitter is not None:
-            emit, close = self.make_emitter(task, mode)
-
+    def _spawn(self, workspace: str) -> subprocess.Popen:
+        """Launch the agent process for one turn.  The base class applies
+        rlimits via the trusted exec launcher; ``ContainerAgentExecutor``
+        (``helix_tpu.services.containers``) overrides this to run the same
+        ACP conversation inside a mount/pid/user-namespace container."""
         launcher_spec = json.dumps({
             "argv": self.argv,
             "limits": {
@@ -212,7 +214,7 @@ class ExternalAgentExecutor(Executor):
         )
         env = self._env(workspace)
         env["PYTHONPATH"] = helix_root   # for the launcher only
-        proc = subprocess.Popen(
+        return subprocess.Popen(
             [sys.executable, "-m", "helix_tpu.services.exec_launcher",
              launcher_spec],
             cwd=workspace,
@@ -223,6 +225,16 @@ class ExternalAgentExecutor(Executor):
             text=True,
             start_new_session=True,
         )
+
+    def run(self, task: SpecTask, workspace: str, mode: str,
+            feedback: str = "") -> str:
+        prompt = build_agent_prompt(task, mode)
+        message = build_agent_message(task, feedback)
+        emit, close = (lambda s: None), (lambda: None)
+        if self.make_emitter is not None:
+            emit, close = self.make_emitter(task, mode)
+
+        proc = self._spawn(workspace)
 
         # drain stderr off-thread: an agent that can't even start (binary
         # missing, import error) explains itself ONLY here
@@ -274,7 +286,8 @@ class ExternalAgentExecutor(Executor):
                 "initialize", {"protocolVersion": 1}, self.rpc_timeout
             )
             sess = client.request(
-                "session/new", {"cwd": workspace}, self.rpc_timeout
+                "session/new", {"cwd": self._agent_cwd(workspace)},
+                self.rpc_timeout,
             )
             sid = sess.get("sessionId", "")
             result = client.request(

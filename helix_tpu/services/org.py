@@ -3,16 +3,30 @@
 The counterpart of the reference's largest uncovered subsystem
 (``api/pkg/org/`` — DDD-layered bot org-chart: bots in a reporting DAG
 (``domain/orgchart/reporting.go:5-17``), topics/channels, dispatch,
-activations/wake bus), rebuilt at this framework's scale:
+activations/wake bus, Slack routing, stream cron), rebuilt at this
+framework's scale:
 
 - **Bots**: named agents with a role prompt and a model; many-to-many
   reporting lines form a DAG (cycles rejected on edge insert via an
   ancestor walk, mirroring the reference's add-parent handler).
+  Bots flagged ``agent=True`` answer through a REAL agent session (the
+  skill loop in ``helix_tpu.agent``) instead of a one-shot completion.
 - **Channels**: topics with member bots; posting a message *activates*
   the responsible bot (explicit mention first, else the channel owner),
-  which answers through the LLM with channel history as context.
+  which answers with channel history as context.
 - **Escalation**: a bot that answers with ``ESCALATE: <why>`` hands the
-  thread to its manager(s) up the chain — bounded by the DAG depth.
+  thread to its manager(s) up the chain — bounded by the DAG depth.  A
+  FAILED activation (agent crash, provider down) escalates the same way
+  instead of dying in-channel, so an org never silently drops a thread.
+- **Platform routing**: external chat platforms (Slack/Teams/Discord)
+  bind to org channels through the shared trigger adapters
+  (``helix_tpu.control.triggers.normalize_platform_payload``); inbound
+  events post into the bound channel and bot replies flow back through a
+  ``send`` callback (the reference's Slack routing,
+  ``api/pkg/org/infrastructure``).
+- **Activations**: cron-scheduled wakes (``add_activation``) — the
+  reference's stream-cron/activations — fire bots into their channel on
+  a 5-field cron schedule via ``tick()``.
 - **Wake bus**: ``wake(bot_id, note)`` queues an activation the
   dispatcher drains (the reference's activations + wake bus, scaled to
   one process).
@@ -64,6 +78,26 @@ CREATE TABLE IF NOT EXISTS org_messages (
 );
 """
 
+_SCHEMA_V2 = """
+ALTER TABLE org_bots ADD COLUMN agent INTEGER NOT NULL DEFAULT 0;
+CREATE TABLE IF NOT EXISTS org_bindings (
+    platform TEXT NOT NULL,      -- slack | teams | discord
+    external_id TEXT NOT NULL,   -- the platform's channel id
+    channel_id TEXT NOT NULL,    -- org channel it routes into
+    PRIMARY KEY (platform, external_id)
+);
+CREATE TABLE IF NOT EXISTS org_activations (
+    id TEXT PRIMARY KEY,
+    bot_id TEXT NOT NULL,
+    channel_id TEXT NOT NULL,
+    schedule TEXT NOT NULL,      -- 5-field cron
+    note TEXT DEFAULT '',
+    enabled INTEGER NOT NULL DEFAULT 1,
+    last_fired REAL NOT NULL DEFAULT 0,
+    created_at REAL NOT NULL
+);
+"""
+
 ESCALATE_MARKER = "ESCALATE:"
 
 
@@ -78,6 +112,7 @@ class Bot:
     name: str
     role: str = ""
     model: str = ""
+    agent: bool = False   # answer via a real agent session (skill loop)
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -86,59 +121,72 @@ class Bot:
 class OrgService:
     def __init__(
         self,
-        db_path: str = ":memory:",
+        db_path=":memory:",
         llm: Optional[Callable] = None,
         history_limit: int = 20,
         max_escalations: int = 4,
+        agent_runner: Optional[Callable] = None,
     ):
         """``llm(prompt, messages, model) -> str`` produces a bot's reply
-        (the control plane wires its provider manager in)."""
-        self._conn = sqlite3.connect(db_path, check_same_thread=False)
-        self._lock = threading.Lock()
-        with self._lock:
-            self._conn.executescript(_SCHEMA)
-            self._conn.commit()
+        (the control plane wires its provider manager in).
+        ``agent_runner(bot, prompt, messages) -> str`` runs an agent-backed
+        bot through a real skill-loop session (``helix_tpu.agent``); bots
+        created with ``agent=True`` use it when wired."""
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(db_path)
+        self._conn = self._db.conn
+        self._lock = self._db.lock
+        self._db.migrate(
+            "org",
+            [(1, "initial", _SCHEMA), (2, "routing+activations", _SCHEMA_V2)],
+        )
         self.llm = llm
+        self.agent_runner = agent_runner
         self.history_limit = history_limit
         self.max_escalations = max_escalations
         self._wake_queue: list[tuple[str, str]] = []
 
     # -- bots + reporting DAG ---------------------------------------------
     def create_bot(self, name: str, role: str = "", model: str = "",
-                   org: str = "default") -> Bot:
+                   org: str = "default", agent: bool = False) -> Bot:
         if not name or not name.strip():
             raise OrgError("bot name is required")
         name = name.strip()
         bot = Bot(
             id=f"bot_{uuid.uuid4().hex[:12]}", org=org, name=name,
-            role=role, model=model,
+            role=role, model=model, agent=agent,
         )
         with self._lock:
             self._conn.execute(
-                "INSERT INTO org_bots(id, org, name, role, model, "
-                "created_at) VALUES(?,?,?,?,?,?)",
-                (bot.id, org, name, role, model, time.time()),
+                "INSERT INTO org_bots(id, org, name, role, model, agent, "
+                "created_at) VALUES(?,?,?,?,?,?,?)",
+                (bot.id, org, name, role, model, int(agent), time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
         return bot
+
+    @staticmethod
+    def _bot_row(r) -> Bot:
+        return Bot(r[0], r[1], r[2], r[3], r[4], bool(r[5]))
 
     def get_bot(self, bid: str) -> Optional[Bot]:
         with self._lock:
             r = self._conn.execute(
-                "SELECT id, org, name, role, model FROM org_bots WHERE "
-                "id=? OR name=?",
+                "SELECT id, org, name, role, model, agent FROM org_bots "
+                "WHERE id=? OR name=?",
                 (bid, bid),
             ).fetchone()
-        return Bot(*r) if r else None
+        return self._bot_row(r) if r else None
 
     def bots(self, org: str = "default") -> list:
         with self._lock:
             rows = self._conn.execute(
-                "SELECT id, org, name, role, model FROM org_bots WHERE "
-                "org=? ORDER BY created_at",
+                "SELECT id, org, name, role, model, agent FROM org_bots "
+                "WHERE org=? ORDER BY created_at",
                 (org,),
             ).fetchall()
-        return [Bot(*r) for r in rows]
+        return [self._bot_row(r) for r in rows]
 
     def delete_bot(self, bid: str) -> bool:
         with self._lock:
@@ -160,7 +208,7 @@ class OrgService:
                 "UPDATE org_channels SET owner_bot='' WHERE owner_bot=?",
                 (bid,),
             )
-            self._conn.commit()
+            self._db.commit()
             return cur.rowcount > 0
 
     def managers_of(self, bid: str) -> list:
@@ -208,7 +256,7 @@ class OrgService:
                 "report_id) VALUES(?,?,?)",
                 (org, manager_id, report_id),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def chart(self, org: str = "default") -> dict:
         """The org chart the UI renders: bots + edges."""
@@ -244,7 +292,7 @@ class OrgService:
                     "bot_id) VALUES(?,?)",
                     (cid, b),
                 )
-            self._conn.commit()
+            self._db.commit()
         return cid
 
     def channels(self, org: str = "default") -> list:
@@ -279,7 +327,7 @@ class OrgService:
                 "created_at) VALUES(?,?,?,?,?)",
                 (mid, channel_id, author, body, time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
         return {"id": mid, "author": author, "body": body}
 
     # -- dispatch ----------------------------------------------------------
@@ -353,7 +401,7 @@ class OrgService:
         ]
 
     def _activate(self, bot: Bot, chan: dict) -> str:
-        if self.llm is None:
+        if self.llm is None and not (bot.agent and self.agent_runner):
             return f"(no llm wired; {bot.name} saw the message)"
         history = self.messages(chan["id"], self.history_limit)
         msgs = [
@@ -373,9 +421,172 @@ class OrgService:
             f"your manager."
         )
         try:
+            if bot.agent and self.agent_runner is not None:
+                # a REAL agent session: skill loop, tools, step records
+                return self.agent_runner(bot, prompt, msgs)
             return self.llm(prompt, msgs, bot.model)
-        except Exception as e:  # noqa: BLE001 — a bot failure is a message
-            return f"(activation failed: {type(e).__name__}: {e})"
+        except Exception as e:  # noqa: BLE001 — a failed activation
+            # escalates up the chain instead of dying in-channel: the
+            # manager (possibly on another model/provider) gets the thread
+            return (
+                f"{ESCALATE_MARKER} activation failed "
+                f"({type(e).__name__}: {e})"
+            )
+
+    # -- platform routing (Slack/Teams/Discord -> org channels) ------------
+    def bind_channel(self, platform: str, external_id: str,
+                     channel_id: str) -> None:
+        """Route a platform channel into an org channel (the reference's
+        Slack routing: messages in the bound Slack channel activate the
+        org's bots and replies flow back)."""
+        if not any(c["id"] == channel_id for c in self.channels_all()):
+            raise OrgError(f"unknown channel {channel_id}")
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO org_bindings(platform, external_id, "
+                "channel_id) VALUES(?,?,?) ON CONFLICT(platform, "
+                "external_id) DO UPDATE SET channel_id=excluded.channel_id",
+                (platform, external_id, channel_id),
+            )
+            self._db.commit()
+
+    def binding_for(self, platform: str, external_id: str) -> Optional[str]:
+        with self._lock:
+            r = self._conn.execute(
+                "SELECT channel_id FROM org_bindings WHERE platform=? AND "
+                "external_id=?",
+                (platform, external_id),
+            ).fetchone()
+        return r[0] if r else None
+
+    def bindings(self) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT platform, external_id, channel_id FROM org_bindings"
+            ).fetchall()
+        return [
+            {"platform": r[0], "external_id": r[1], "channel_id": r[2]}
+            for r in rows
+        ]
+
+    def handle_platform_event(self, kind: str, payload: dict,
+                              send: Optional[Callable] = None):
+        """Inbound platform webhook -> bound org channel -> bot replies
+        back out through ``send(external_id, text, thread)``.
+
+        Reuses the shared trigger adapters so Slack URL-verification,
+        bot-echo suppression and Teams mention stripping behave exactly
+        like app triggers do.  Returns (verdict, doc):
+        ``("challenge", doc)`` — respond with doc verbatim;
+        ``("ignore", reason)``; ``("posted", messages)``.
+        """
+        from helix_tpu.control.triggers import normalize_platform_payload
+
+        verdict, doc = normalize_platform_payload(kind, payload)
+        if verdict != "fire":
+            return verdict, doc
+        channel_id = self.binding_for(kind, doc.get("channel", ""))
+        if channel_id is None:
+            return "ignore", f"no binding for {kind}:{doc.get('channel')}"
+        author = f"{kind}:{doc.get('user') or 'unknown'}"
+        out = self.post(channel_id, doc.get("message", ""), author=author)
+        if send is not None:
+            for m in out:
+                if m["author"].startswith("bot:"):
+                    send(
+                        doc.get("channel", ""),
+                        f"[{m['author'][4:]}] {m['body']}",
+                        doc.get("thread", ""),
+                    )
+        return "posted", out
+
+    # -- scheduled activations (stream cron) -------------------------------
+    def add_activation(self, bot_id: str, channel_id: str, schedule: str,
+                       note: str = "") -> str:
+        """Cron-scheduled wake: the bot activates into its channel on the
+        schedule (the reference's activations / stream cron)."""
+        from helix_tpu.control.triggers import CronSchedule
+
+        CronSchedule.parse(schedule)   # validate now, not at tick time
+        if self.get_bot(bot_id) is None:
+            raise OrgError(f"unknown bot {bot_id}")
+        if not any(c["id"] == channel_id for c in self.channels_all()):
+            raise OrgError(f"unknown channel {channel_id}")
+        aid = f"act_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO org_activations(id, bot_id, channel_id, "
+                "schedule, note, enabled, last_fired, created_at) "
+                "VALUES(?,?,?,?,?,1,0,?)",
+                (aid, bot_id, channel_id, schedule, note, time.time()),
+            )
+            self._db.commit()
+        return aid
+
+    def activations(self) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, bot_id, channel_id, schedule, note, enabled, "
+                "last_fired FROM org_activations ORDER BY created_at"
+            ).fetchall()
+        return [
+            {"id": r[0], "bot_id": r[1], "channel_id": r[2],
+             "schedule": r[3], "note": r[4], "enabled": bool(r[5]),
+             "last_fired": r[6]}
+            for r in rows
+        ]
+
+    def remove_activation(self, aid: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM org_activations WHERE id=?", (aid,)
+            )
+            self._db.commit()
+            return cur.rowcount > 0
+
+    def set_activation_enabled(self, aid: str, enabled: bool) -> None:
+        with self._lock:
+            self._conn.execute(
+                "UPDATE org_activations SET enabled=? WHERE id=?",
+                (int(enabled), aid),
+            )
+            self._db.commit()
+
+    def tick(self, now: Optional[float] = None) -> int:
+        """Fire activations matching the current minute (the org's cron
+        loop; the control plane calls this from the trigger ticker).
+        Debounced to once per minute per activation."""
+        from helix_tpu.control.triggers import CronSchedule
+
+        now = now if now is not None else time.time()
+        st = time.localtime(now)
+        fired = 0
+        for a in self.activations():
+            if not a["enabled"]:
+                continue
+            try:
+                if not CronSchedule.parse(a["schedule"]).matches(st):
+                    continue
+            except ValueError:
+                continue
+            if now - a["last_fired"] < 59:
+                continue
+            with self._lock:
+                self._conn.execute(
+                    "UPDATE org_activations SET last_fired=? WHERE id=?",
+                    (now, a["id"]),
+                )
+                self._db.commit()
+            bot = self.get_bot(a["bot_id"])
+            if bot is None:
+                continue
+            self.post(
+                a["channel_id"],
+                a["note"] or f"scheduled activation for {bot.name}",
+                author="system:cron", to_bot=bot,
+            )
+            fired += 1
+        return fired
 
     # -- wake bus ----------------------------------------------------------
     def wake(self, bot_id: str, note: str = "") -> None:

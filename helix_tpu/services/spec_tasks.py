@@ -105,20 +105,25 @@ class SpecTask:
 
 
 class TaskStore:
-    def __init__(self, db_path: str = ":memory:"):
-        self._conn = sqlite3.connect(db_path, check_same_thread=False)
-        self._lock = threading.Lock()
+    def __init__(self, db_path=":memory:"):
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(db_path)
+        self._conn = self._db.conn
+        self._lock = self._db.lock
         # lifecycle observer: on_update(task) after every status persist
         # (the control plane publishes these to the durable TASKS stream)
         self.on_update = None
+        self._db.migrate("spec_tasks", [(1, "initial", _SCHEMA)])
         with self._lock:
-            self._conn.executescript(_SCHEMA)
+            # pre-migration-framework DBs: bring columns forward (these
+            # predate the schema_migrations table, so they stay try/except)
             for mig in _MIGRATIONS:
                 try:
                     self._conn.execute(mig)
                 except sqlite3.OperationalError:
                     pass  # column already exists
-            self._conn.commit()
+            self._db.commit()
 
     # -- tasks ---------------------------------------------------------------
     def create_task(self, project: str, title: str, description: str = "") -> SpecTask:
@@ -133,7 +138,7 @@ class TaskStore:
                 "status, created_at, updated_at) VALUES(?,?,?,?,?,?,?)",
                 (t.id, project, title, description, t.status, now, now),
             )
-            self._conn.commit()
+            self._db.commit()
         return t
 
     def _row_to_task(self, r) -> SpecTask:
@@ -183,7 +188,7 @@ class TaskStore:
                     t.pr_id, t.error, t.ci_attempts, time.time(), t.id,
                 ),
             )
-            self._conn.commit()
+            self._db.commit()
         if self.on_update is not None:
             try:
                 self.on_update(t)
@@ -200,7 +205,7 @@ class TaskStore:
                 "decision, created_at) VALUES(?,?,?,?,?,?)",
                 (rid, task_id, author, comment, decision, time.time()),
             )
-            self._conn.commit()
+            self._db.commit()
         return rid
 
     def reviews(self, task_id: str) -> list:
@@ -228,7 +233,7 @@ class TaskStore:
                 "VALUES(?,?,?,?,?,?, 'open', ?, ?)",
                 (pid, project, task_id, title, base, head, now, now),
             )
-            self._conn.commit()
+            self._db.commit()
         return pid
 
     _PR_COLS = (
@@ -275,7 +280,7 @@ class TaskStore:
                 "WHERE id=?",
                 (status, merge_sha, time.time(), pid),
             )
-            self._conn.commit()
+            self._db.commit()
 
     def set_pr_ci(self, pid: str, ci_status: str, ci_log: str = "") -> None:
         with self._lock:
@@ -284,7 +289,7 @@ class TaskStore:
                 "updated_at=? WHERE id=?",
                 (ci_status, ci_log[:20000], time.time(), pid),
             )
-            self._conn.commit()
+            self._db.commit()
 
 
 class CIRunner:

@@ -1,0 +1,102 @@
+/* Admin: health, users/keys, organizations, notifications, error ring,
+ * DB migrations ledger, version. */
+import {$, $row, api, esc} from "./core.js";
+
+export async function render(m) {
+  const health = await api("/healthz").catch(() => ({}));
+  m.appendChild($(`<div class="panel row">
+    <div><div class="statlabel">status</div><div class="stat">${esc(health.status || "?")}</div></div>
+    <div style="margin-left:24px"><div class="statlabel">runners</div>
+      <div class="stat">${health.runners ?? "?"}</div></div></div>`));
+
+  const users = $(`<div class="panel"><h3>Users & API keys</h3>
+    <div class="row"><input id="ue" placeholder="email">
+      <input id="un" placeholder="name">
+      <label class="id"><input type="checkbox" id="ua"> admin</label>
+      <button class="primary" id="ugo">Create user</button></div>
+    <div id="ukey" class="id" style="margin-top:6px"></div>
+    <div class="row" style="margin-top:10px">
+      <input id="kid" placeholder="user id">
+      <button class="ghost" id="kgo">Mint API key</button></div>
+    <div id="kout" class="id" style="margin-top:6px"></div></div>`);
+  m.appendChild(users);
+  users.querySelector("#ugo").onclick = async () => {
+    const doc = await api("/api/v1/users", {method:"POST", body: JSON.stringify({
+      email: users.querySelector("#ue").value,
+      name: users.querySelector("#un").value,
+      admin: users.querySelector("#ua").checked})});
+    users.querySelector("#ukey").textContent =
+      `created ${doc.id} — API key (copy now, shown once): ${doc.api_key}`;
+  };
+  users.querySelector("#kgo").onclick = async () => {
+    const uid = users.querySelector("#kid").value.trim();
+    const doc = await api(`/api/v1/users/${uid}/keys`, {method:"POST",
+      body: JSON.stringify({name:"web"})});
+    users.querySelector("#kout").textContent = `new key: ${doc.api_key}`;
+  };
+
+  const orgs = $(`<div class="panel"><h3>Organizations</h3>
+    <div class="row"><input id="on" placeholder="org name">
+      <button class="ghost" id="ogo">Create org</button></div>
+    <table id="ot" style="margin-top:8px"></table></div>`);
+  m.appendChild(orgs);
+  async function loadOrgs() {
+    const {orgs: list} = await api("/api/v1/orgs").catch(() => ({orgs:[]}));
+    const ot = orgs.querySelector("#ot");
+    ot.innerHTML = `<tr><th>id</th><th>name</th><th>members</th></tr>`;
+    for (const o of list || []) {
+      const tr = $row(`<tr><td>${esc(o.id)}</td><td>${esc(o.name)}</td><td>…</td></tr>`);
+      api(`/api/v1/orgs/${o.id}/members`).then(doc => {
+        tr.lastElementChild.textContent =
+          (doc.members || []).map(x => x.user_id || x).join(", ") || "-";
+      }).catch(() => {});
+      ot.appendChild(tr);
+    }
+  }
+  orgs.querySelector("#ogo").onclick = async () => {
+    await api("/api/v1/orgs", {method:"POST", body: JSON.stringify({
+      name: orgs.querySelector("#on").value})});
+    loadOrgs();
+  };
+  loadOrgs();
+
+  const mig = $(`<div class="panel"><h3>Database migrations</h3>
+    <table id="mt"></table></div>`);
+  m.appendChild(mig);
+  const {migrations} = await api("/api/v1/admin/migrations")
+    .catch(() => ({migrations:[]}));
+  const mt = mig.querySelector("#mt");
+  mt.innerHTML = `<tr><th>component</th><th>version</th><th>name</th><th>applied</th></tr>`;
+  for (const x of migrations || [])
+    mt.appendChild($row(`<tr><td>${esc(x.component)}</td><td>${x.version}</td>
+      <td>${esc(x.name)}</td>
+      <td>${esc(new Date((x.applied_at || 0) * 1000).toLocaleString())}</td></tr>`));
+
+  const notif = $(`<div class="panel"><h3>Notifications</h3><table id="nt"></table></div>`);
+  m.appendChild(notif);
+  const {notifications} = await api("/api/v1/notifications")
+    .catch(() => ({notifications:[]}));
+  const nt = notif.querySelector("#nt");
+  nt.innerHTML = `<tr><th>when</th><th>kind</th><th>title</th><th>body</th></tr>`;
+  for (const n of (notifications || []).slice(0, 50)) {
+    const tr = $row(`<tr><td>${esc(new Date(n.created_at * 1000).toLocaleTimeString())}</td>
+      <td><span class="tag">${esc(n.kind)}</span></td><td></td><td></td></tr>`);
+    tr.children[2].textContent = n.title;
+    tr.children[3].textContent = (n.body || "").slice(0, 160);
+    nt.appendChild(tr);
+  }
+
+  const errs = $(`<div class="panel"><h3>Error ring (janitor)</h3><table id="et"></table></div>`);
+  m.appendChild(errs);
+  const {errors} = await api("/api/v1/errors").catch(() => ({errors:[]}));
+  const et = errs.querySelector("#et");
+  et.innerHTML = `<tr><th>when</th><th>where</th><th>error</th></tr>`;
+  for (const e of (errors || []).slice(-50).reverse()) {
+    const tr = $row(`<tr><td>${esc(new Date((e.ts || 0) * 1000).toLocaleTimeString())}</td>
+      <td>${esc(e.where || e.source || "")}</td><td></td></tr>`);
+    tr.lastElementChild.textContent = (e.error || e.message || "").slice(0, 200);
+    et.appendChild(tr);
+  }
+  if (!(errors || []).length)
+    et.appendChild($row(`<tr><td colspan="3" class="id">no captured errors</td></tr>`));
+}

@@ -1,0 +1,53 @@
+/* App editor: helix.yaml upsert + app list/inspect/delete. */
+import {$, $row, api, authHeaders, esc, toast} from "./core.js";
+
+export async function render(m) {
+  const editor = $(`<div class="panel"><h3>App editor (helix.yaml)</h3>
+    <textarea id="yaml" class="code" rows="12"
+      placeholder="apiVersion: app.aispec.org/v1alpha1&#10;kind: AIApp&#10;metadata:&#10;  name: my-app&#10;spec: ..."></textarea>
+    <div class="row" style="margin-top:8px">
+      <button class="primary" id="save">Apply</button>
+      <span class="id">POSTs the YAML to /api/v1/apps (upsert by name)</span>
+    </div></div>`);
+  m.appendChild(editor);
+  editor.querySelector("#save").onclick = async () => {
+    const r = await fetch("/api/v1/apps", {method:"POST",
+      headers: Object.assign({"Content-Type":"application/yaml"}, authHeaders()),
+      body: editor.querySelector("#yaml").value});
+    const doc = await r.json();
+    if (!r.ok) { toast(doc.error?.message || `HTTP ${r.status}`); return; }
+    toast(`applied app ${doc.name}`);
+    refresh();
+  };
+  const listPanel = $(`<div class="panel"><h3>Apps</h3>
+    <table><tr><th>id</th><th>name</th><th>owner</th><th></th><th></th></tr>
+    </table><pre class="code" id="doc" style="display:none"></pre></div>`);
+  m.appendChild(listPanel);
+  async function refresh() {
+    const {apps} = await api("/api/v1/apps").catch(() => ({apps:[]}));
+    const tbl = listPanel.querySelector("table");
+    tbl.innerHTML = "<tr><th>id</th><th>name</th><th>owner</th><th></th><th></th></tr>";
+    for (const a of apps) {
+      const tr = $row(`<tr><td>${esc(a.id)}</td><td>${esc(a.name)}</td>
+        <td>${esc(a.owner)}</td><td></td><td></td></tr>`);
+      const v = $(`<button class="ghost">view</button>`);
+      v.onclick = async () => {
+        const doc = await api(`/api/v1/apps/${a.id}`);
+        const pre = listPanel.querySelector("#doc");
+        pre.style.display = "";
+        pre.textContent = JSON.stringify(doc, null, 2);
+      };
+      tr.children[3].appendChild(v);
+      const del = $(`<button class="ghost danger">delete</button>`);
+      del.onclick = async () => {
+        await api(`/api/v1/apps/${a.id}`, {method:"DELETE"}); refresh();
+      };
+      tr.children[4].appendChild(del);
+      tbl.appendChild(tr);
+    }
+    if (!apps.length)
+      listPanel.querySelector("table").appendChild(
+        $row(`<tr><td colspan="5" class="id">no apps yet</td></tr>`));
+  }
+  refresh();
+}

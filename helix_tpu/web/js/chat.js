@@ -1,0 +1,72 @@
+/* Chat: streaming session chat against the OpenAI-surface routes. */
+import {$, api, authHeaders} from "./core.js";
+
+let sessionId = null;
+
+export async function render(m) {
+  const panel = $(`<div class="panel">
+    <div class="chat-log" id="log"></div>
+    <div class="row" style="margin-top:10px">
+      <select id="model"></select>
+      <input id="box" class="grow" placeholder="Say something...">
+      <button class="primary" id="send">Send</button>
+      <button class="ghost" id="newchat">New chat</button>
+    </div></div>`);
+  m.appendChild(panel);
+  const models = await api("/v1/models").catch(() => ({data:[]}));
+  const sel = panel.querySelector("#model");
+  for (const md of models.data || [])
+    sel.appendChild(new Option(md.id, md.id));
+  const log = panel.querySelector("#log");
+  const add = (role, text) => {
+    const d = $(`<div class="msg ${role}"></div>`);
+    d.textContent = text; log.appendChild(d);
+    log.scrollTop = log.scrollHeight; return d;
+  };
+  panel.querySelector("#newchat").onclick = () => {
+    sessionId = null; log.innerHTML = "";
+  };
+  const send = async () => {
+    const box = panel.querySelector("#box");
+    const text = box.value.trim(); if (!text) return;
+    box.value = ""; add("user", text);
+    const d = add("assistant", "…");
+    if (!sessionId) {
+      const s = await api("/api/v1/sessions", {method:"POST",
+        body: JSON.stringify({name:"web", doc:{model: sel.value}})})
+        .catch(() => null);
+      if (!s || !s.id) { d.textContent = "error: could not create session"; return; }
+      sessionId = s.id;
+    }
+    const r = await fetch(`/api/v1/sessions/${sessionId}/chat`, {
+      method: "POST", headers: authHeaders(),
+      body: JSON.stringify({message:text, model: sel.value, stream:true}),
+    });
+    if (!r.ok) {
+      let msg = `HTTP ${r.status}`;
+      try { msg = (await r.json()).error?.message || msg; } catch {}
+      d.textContent = `error: ${msg}`;
+      return;
+    }
+    d.textContent = "";
+    const reader = r.body.getReader();
+    const dec = new TextDecoder(); let buf = "";
+    for (;;) {
+      const {done, value} = await reader.read();
+      if (done) break;
+      buf += dec.decode(value, {stream:true});
+      for (const line of buf.split("\n\n").slice(0, -1)) {
+        const p = line.replace(/^data: /, "").trim();
+        if (!p || p === "[DONE]") continue;
+        try {
+          const c = JSON.parse(p);
+          const delta = c.choices?.[0]?.delta?.content;
+          if (delta) d.textContent += delta;
+        } catch {}
+      }
+      buf = buf.split("\n\n").slice(-1)[0];
+    }
+  };
+  panel.querySelector("#send").onclick = send;
+  panel.querySelector("#box").onkeydown = (e) => { if (e.key === "Enter") send(); };
+}

@@ -1,0 +1,64 @@
+/* Compute: autoscaler instances + golden workspace caches + disk
+ * pressure (reference: sandbox/compute manager dashboards). */
+import {$, $row, api, esc, setRefresh, tab, toast} from "./core.js";
+
+export async function render(m) {
+  const computePanel = $(`<div class="panel"><h3>Compute instances (autoscaler)</h3>
+    <table id="ct"></table></div>`);
+  m.appendChild(computePanel);
+  const goldenPanel = $(`<div class="panel"><h3>Golden workspace caches</h3>
+    <table id="gt"></table>
+    <div class="row" style="margin-top:8px">
+      <button class="ghost" id="ggc">Run GC</button>
+      <span class="id" id="gp"></span></div></div>`);
+  m.appendChild(goldenPanel);
+
+  async function refresh() {
+    const {instances} = await api("/api/v1/compute/instances")
+      .catch(() => ({instances:[]}));
+    const ct = computePanel.querySelector("#ct");
+    ct.innerHTML = `<tr><th>id</th><th>provider</th><th>state</th>
+      <th>runner</th><th>sandboxes</th></tr>`;
+    for (const i of instances || [])
+      ct.appendChild($row(`<tr><td>${esc(i.id)}</td>
+        <td>${esc(i.provider)} ${esc(i.provider_id)}</td>
+        <td><span class="tag ${esc(i.compute_state)}">${esc(i.compute_state)}</span></td>
+        <td>${esc(i.runner_id)}</td>
+        <td>${i.active_sandboxes}/${i.max_sandboxes}</td></tr>`));
+    if (!(instances || []).length)
+      ct.appendChild($row(`<tr><td colspan="5" class="id">autoscaler idle or disabled</td></tr>`));
+
+    const {golden} = await api("/api/v1/workspaces/golden")
+      .catch(() => ({golden:[]}));
+    const gt = goldenPanel.querySelector("#gt");
+    gt.innerHTML = `<tr><th>project</th><th>files</th><th>bytes</th>
+      <th>promoted</th><th></th></tr>`;
+    for (const g of golden || []) {
+      const tr = $row(`<tr><td>${esc(g.project)}</td><td>${g.files}</td>
+        <td>${(g.bytes / 1e6).toFixed(1)} MB</td>
+        <td>${esc(new Date((g.promoted_at || 0) * 1000).toLocaleString())}</td>
+        <td></td></tr>`);
+      const del = $(`<button class="ghost danger">drop</button>`);
+      del.onclick = async () => {
+        await api(`/api/v1/workspaces/golden/${encodeURIComponent(g.project)}`,
+          {method:"DELETE"});
+        refresh();
+      };
+      tr.lastElementChild.appendChild(del);
+      gt.appendChild(tr);
+    }
+    if (!(golden || []).length)
+      gt.appendChild($row(`<tr><td colspan="5" class="id">no golden snapshots</td></tr>`));
+    const pressure = await api("/api/v1/workspaces/pressure").catch(() => null);
+    if (pressure)
+      goldenPanel.querySelector("#gp").textContent =
+        `disk ${pressure.used_pct?.toFixed?.(1) ?? pressure.used_pct}% used`;
+  }
+  goldenPanel.querySelector("#ggc").onclick = async () => {
+    const doc = await api("/api/v1/workspaces/gc", {method:"POST"});
+    toast(`GC reaped ${doc.reaped ?? 0} workspaces`);
+    refresh();
+  };
+  refresh();
+  setRefresh(() => { if (tab === "compute") refresh(); }, 5000);
+}

@@ -1,0 +1,150 @@
+/* Core: auth, helpers, tab router.  Each tab is an ES module under
+ * /ui/js/<tab>.js exporting `render(main, ctx)`; the router dynamic-imports
+ * it so one broken page never takes down the app shell. */
+
+export const TABS = ["chat","sessions","tasks","apps","org","desktops",
+  "knowledge","runners","compute","providers","wallet","evals","oauth",
+  "secrets","triggers","admin"];
+
+export let tab = location.hash.slice(1) || "chat";
+export let ME = null;
+let refreshTimer = null;
+
+export const $ = (h) => {
+  const d = document.createElement("div"); d.innerHTML = h;
+  return d.firstElementChild;
+};
+export const $row = (h) => {
+  const t = document.createElement("table"); t.innerHTML = h;
+  return t.querySelector("tr");
+};
+export const esc = (s) => String(s ?? "").replace(/[&<>"']/g,
+  (c) => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+
+export function authHeaders() {
+  const k = localStorage.getItem("helix_api_key");
+  return k ? {"Authorization": `Bearer ${k}`} : {};
+}
+
+export async function api(p, opts = {}) {
+  opts.headers = Object.assign({}, authHeaders(), opts.headers || {});
+  const r = await fetch(p, opts);
+  if (r.status === 401) { showLogin(); throw new Error("unauthenticated"); }
+  const doc = await r.json().catch(() => ({}));
+  if (!r.ok) {
+    const msg = doc.error?.message || `HTTP ${r.status}`;
+    toast(msg);
+    throw new Error(msg);
+  }
+  return doc;
+}
+
+export function toast(msg) {
+  const t = $(`<div class="toast"></div>`);
+  t.textContent = msg;
+  document.body.appendChild(t);
+  setTimeout(() => t.remove(), 5000);
+}
+
+/* pages register their polling loop here; the router clears it on tab
+ * switch so background tabs never keep fetching */
+export function setRefresh(fn, ms) {
+  if (refreshTimer) clearInterval(refreshTimer);
+  refreshTimer = setInterval(fn, ms);
+}
+
+/* ------------------------------------------------------------------ auth */
+function showLogin() {
+  document.getElementById("login-overlay").style.display = "";
+}
+function hideLogin() {
+  document.getElementById("login-overlay").style.display = "none";
+}
+export async function whoami() {
+  try {
+    const doc = await api("/api/v1/auth/me");
+    ME = doc.user;
+    document.getElementById("who").textContent =
+      doc.auth_required
+        ? `${ME.email || ME.name}${ME.admin ? " (admin)" : ""}`
+        : "auth disabled";
+    document.getElementById("logout").style.display =
+      doc.auth_required ? "" : "none";
+    hideLogin();
+    return true;
+  } catch { return false; }
+}
+
+document.getElementById("logout").onclick = () => {
+  localStorage.removeItem("helix_api_key"); location.reload();
+};
+document.getElementById("login-go").onclick = async () => {
+  // validate BEFORE persisting: a bad key must not poison later loads,
+  // and a network failure is not a rejection
+  const key = document.getElementById("login-key").value.trim();
+  const err = document.getElementById("login-err");
+  let r;
+  try {
+    r = await fetch("/api/v1/auth/me",
+      {headers: {"Authorization": `Bearer ${key}`}});
+  } catch (e) {
+    err.textContent = `server unreachable: ${e.message || e}`;
+    return;
+  }
+  if (r.status === 401) { err.textContent = "key rejected"; return; }
+  if (!r.ok) { err.textContent = `server error (HTTP ${r.status})`; return; }
+  localStorage.setItem("helix_api_key", key);
+  await whoami();
+  render();
+};
+document.getElementById("boot-go").onclick = async () => {
+  try {
+    const r = await fetch("/api/v1/users", {method:"POST",
+      body: JSON.stringify({email:
+        document.getElementById("boot-email").value, admin:true})});
+    const doc = await r.json();
+    if (!r.ok) throw new Error(doc.error?.message || `HTTP ${r.status}`);
+    localStorage.setItem("helix_api_key", doc.api_key);
+    toast(`admin created — key saved to this browser`);
+    if (await whoami()) render();
+  } catch (e) {
+    document.getElementById("login-err").textContent = String(e.message || e);
+  }
+};
+
+/* ---------------------------------------------------------------- router */
+function nav() {
+  const n = document.getElementById("nav");
+  n.innerHTML = "";
+  for (const t of TABS) {
+    const b = document.createElement("button");
+    b.textContent = t;
+    b.className = t === tab ? "active" : "";
+    b.onclick = () => { tab = t; location.hash = t; render(); };
+    n.appendChild(b);
+  }
+}
+
+export async function render() {
+  if (!TABS.includes(tab)) tab = "chat";   // stale bookmarks from old tabs
+  nav();
+  if (refreshTimer) { clearInterval(refreshTimer); refreshTimer = null; }
+  const m = document.getElementById("main");
+  m.innerHTML = "";
+  try {
+    const mod = await import(`/ui/js/${tab}.js`);
+    await mod.render(m);
+  } catch (e) {
+    const d = $(`<div class="panel" style="color:var(--err)"></div>`);
+    d.textContent = `failed to load ${tab}: ${e.message || e}`;
+    m.appendChild(d);
+  }
+}
+
+window.addEventListener("hashchange", () => {
+  tab = location.hash.slice(1) || "chat"; render();
+});
+// render regardless of auth state: a transient auth/me failure must not
+// leave a blank page (tabs surface their own errors; 401s raise the
+// login overlay from the api() wrapper)
+whoami().finally(() => render());

@@ -1,0 +1,71 @@
+/* Desktop stream viewer: tile-codec frames over WS onto a canvas,
+ * keyboard input back (reference: DesktopStreamViewer.tsx). */
+import {$, api} from "./core.js";
+
+export async function render(m) {
+  const {desktops} = await api("/api/v1/desktops");
+  const list = $(`<div class="panel"><h3>Agent desktops</h3><div id="dl"></div></div>`);
+  m.appendChild(list);
+  const dl = list.querySelector("#dl");
+  if (!desktops.length) dl.textContent = "No live desktops. They appear while task agents run.";
+  for (const d of desktops) {
+    const b = $(`<button class="ghost" style="margin:4px"></button>`);
+    b.textContent = d.name || d.id;
+    b.onclick = () => watch(d);
+    dl.appendChild(b);
+  }
+  const view = $(`<div class="panel"><canvas id="cv" width="960" height="540"></canvas>
+    <div class="row" style="margin-top:8px">
+      <input id="inp" class="grow" placeholder="type to the agent...">
+    </div></div>`);
+  m.appendChild(view);
+  let inputWs = null, streamWs = null;
+  async function watch(d) {
+    if (streamWs) { streamWs.close(); streamWs = null; }
+    if (inputWs) { inputWs.close(); inputWs = null; }
+    const cv = view.querySelector("#cv");
+    cv.width = d.width; cv.height = d.height;
+    const ctx = cv.getContext("2d");
+    ctx.clearRect(0, 0, cv.width, cv.height);
+    const proto = location.protocol === "https:" ? "wss" : "ws";
+    const ws = new WebSocket(`${proto}://${location.host}/api/v1/desktops/${d.id}/ws/stream`);
+    ws.binaryType = "arraybuffer";
+    streamWs = ws;
+    inputWs = new WebSocket(`${proto}://${location.host}/api/v1/desktops/${d.id}/ws/input`);
+    ws.onmessage = async (ev) => {
+      const buf = new Uint8Array(ev.data);
+      const dv = new DataView(ev.data);
+      if (dv.getUint32(0, true) !== 0x31465848) return;
+      // header: magic(4) frame_id(4) w(2) h(2) ntiles(2) kf(1) res(1) = 16
+      const W = dv.getUint16(8, true), H = dv.getUint16(10, true),
+            NT = dv.getUint16(12, true);
+      const tiles = [];
+      for (let i = 0; i < NT; i++) {
+        tiles.push([dv.getUint16(16 + i*4, true), dv.getUint16(18 + i*4, true)]);
+      }
+      const comp = buf.slice(16 + NT*4);
+      const ds = new DecompressionStream("deflate");
+      const stream = new Blob([comp]).stream().pipeThrough(ds);
+      const raw = new Uint8Array(await new Response(stream).arrayBuffer());
+      let off = 0;
+      for (const [tx, ty] of tiles) {
+        const tw = Math.min(32, W - tx*32), th = Math.min(32, H - ty*32);
+        const img = ctx.createImageData(tw, th);
+        for (let p = 0; p < tw*th; p++) {     // BGRA -> RGBA
+          img.data[p*4]   = raw[off + p*4 + 2];
+          img.data[p*4+1] = raw[off + p*4 + 1];
+          img.data[p*4+2] = raw[off + p*4];
+          img.data[p*4+3] = raw[off + p*4 + 3];
+        }
+        ctx.putImageData(img, tx*32, ty*32);
+        off += tw*th*4;
+      }
+    };
+    view.querySelector("#inp").onkeydown = (e) => {
+      if (e.key === "Enter" && inputWs?.readyState === 1) {
+        inputWs.send(JSON.stringify({type:"text", text:e.target.value}));
+        e.target.value = "";
+      }
+    };
+  }
+}

@@ -1,0 +1,111 @@
+/* Evals: per-app evaluation suites + runs (reference: the evaluations
+ * product surface the apps carry). */
+import {$, $row, api, esc, setRefresh, tab, toast} from "./core.js";
+
+export async function render(m) {
+  const top = $(`<div class="panel row">
+    <span class="id">app</span><select id="app" class="grow"></select></div>`);
+  m.appendChild(top);
+  const suitePanel = $(`<div class="panel"><h3>Evaluation suites</h3>
+    <table id="st"></table>
+    <div class="row" style="margin-top:8px">
+      <input id="sn" placeholder="suite name">
+      <textarea id="sq" class="grow code" rows="3"
+        placeholder='questions, one per line: "question => expected substring"'></textarea>
+      <button class="primary" id="sgo">Create suite</button></div></div>`);
+  m.appendChild(suitePanel);
+  const runPanel = $(`<div class="panel"><h3>Runs</h3><table id="rt"></table>
+    <pre class="code" id="rd" style="display:none"></pre></div>`);
+  m.appendChild(runPanel);
+
+  const {apps} = await api("/api/v1/apps").catch(() => ({apps:[]}));
+  const appSel = top.querySelector("#app");
+  for (const a of apps) appSel.appendChild(new Option(a.name, a.id));
+  if (!apps.length) {
+    suitePanel.querySelector("#st").innerHTML =
+      `<tr><td class="id">create an app first — suites hang off apps</td></tr>`;
+    return;
+  }
+  appSel.onchange = refresh;
+
+  async function refresh() {
+    const appId = appSel.value;
+    if (!appId) return;
+    const {suites} = await api(
+      `/api/v1/apps/${appId}/evaluation-suites`).catch(() => ({suites:[]}));
+    const st = suitePanel.querySelector("#st");
+    st.innerHTML = `<tr><th>id</th><th>name</th><th>questions</th><th></th><th></th></tr>`;
+    for (const s of suites || []) {
+      const tr = $row(`<tr><td>${esc(s.id)}</td><td>${esc(s.name)}</td>
+        <td>${(s.questions || []).length}</td><td></td><td></td></tr>`);
+      const run = $(`<button class="ghost">run</button>`);
+      run.onclick = async () => {
+        await api(`/api/v1/apps/${appId}/evaluation-suites/${s.id}/runs`,
+          {method:"POST", body: "{}"});
+        toast("run started");
+        loadRuns(s.id);
+      };
+      tr.children[3].appendChild(run);
+      const del = $(`<button class="ghost danger">delete</button>`);
+      del.onclick = async () => {
+        await api(`/api/v1/apps/${appId}/evaluation-suites/${s.id}`,
+          {method:"DELETE"});
+        refresh();
+      };
+      tr.children[4].appendChild(del);
+      tr.onclick = (e) => {
+        if (e.target.tagName !== "BUTTON") loadRuns(s.id);
+      };
+      st.appendChild(tr);
+    }
+    if (!(suites || []).length)
+      st.appendChild($row(`<tr><td colspan="5" class="id">no suites for this app</td></tr>`));
+    if ((suites || []).length) loadRuns(suites[0].id);
+  }
+
+  async function loadRuns(suiteId) {
+    const appId = appSel.value;
+    const {runs} = await api(
+      `/api/v1/apps/${appId}/evaluation-suites/${suiteId}/runs`)
+      .catch(() => ({runs:[]}));
+    const rt = runPanel.querySelector("#rt");
+    rt.innerHTML = `<tr><th>id</th><th>status</th><th>score</th><th>when</th><th></th></tr>`;
+    for (const r of (runs || []).slice().reverse()) {
+      const score = r.summary
+        ? `${r.summary.passed ?? 0}/${r.summary.total ?? 0}` : "-";
+      const tr = $row(`<tr><td>${esc(r.id)}</td>
+        <td><span class="tag ${esc(r.status)}">${esc(r.status)}</span></td>
+        <td>${esc(score)}</td>
+        <td>${esc(new Date((r.created_at || 0) * 1000).toLocaleString())}</td>
+        <td></td></tr>`);
+      const v = $(`<button class="ghost">results</button>`);
+      v.onclick = async () => {
+        const doc = await api(`/api/v1/apps/${appId}/evaluation-runs/${r.id}`);
+        const pre = runPanel.querySelector("#rd");
+        pre.style.display = "";
+        pre.textContent = JSON.stringify(doc, null, 2);
+      };
+      tr.lastElementChild.appendChild(v);
+      rt.appendChild(tr);
+    }
+    if (!(runs || []).length)
+      rt.appendChild($row(`<tr><td colspan="5" class="id">no runs yet</td></tr>`));
+  }
+
+  suitePanel.querySelector("#sgo").onclick = async () => {
+    const questions = suitePanel.querySelector("#sq").value.split("\n")
+      .map(l => l.trim()).filter(Boolean)
+      .map(l => {
+        const [q, expect] = l.split("=>").map(x => x.trim());
+        return expect ? {question: q, expected_contains: expect}
+                      : {question: q};
+      });
+    await api(`/api/v1/apps/${appSel.value}/evaluation-suites`, {
+      method:"POST", body: JSON.stringify({
+        name: suitePanel.querySelector("#sn").value, questions})});
+    toast("suite created");
+    refresh();
+  };
+  refresh();
+  setRefresh(() => { if (tab === "evals") refresh(); }, 5000);
+}

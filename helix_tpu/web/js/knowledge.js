@@ -1,0 +1,60 @@
+/* Knowledge: sources, re-index, semantic search. */
+import {$, $row, api, esc, render as rerender} from "./core.js";
+
+export async function render(m) {
+  const top = $(`<div class="panel row">
+    <input id="kn" placeholder="name">
+    <textarea id="kt" class="grow" placeholder="inline text content" rows="2"></textarea>
+    <button class="primary" id="mk">Add knowledge</button></div>`);
+  m.appendChild(top);
+  top.querySelector("#mk").onclick = async () => {
+    await api("/api/v1/knowledge", {method:"POST", body: JSON.stringify({
+      name: top.querySelector("#kn").value, text: top.querySelector("#kt").value})});
+    rerender();
+  };
+  const search = $(`<div class="panel row">
+    <select id="ksel"></select>
+    <input id="kq" class="grow" placeholder="semantic search query">
+    <button class="ghost" id="kgo">Search</button></div>`);
+  m.appendChild(search);
+  const results = $(`<div class="panel" style="display:none"><h3>Results</h3>
+    <div id="kr"></div></div>`);
+  m.appendChild(results);
+  const {knowledge} = await api("/api/v1/knowledge");
+  for (const k of knowledge)
+    search.querySelector("#ksel").appendChild(new Option(k.name, k.id));
+  search.querySelector("#kgo").onclick = async () => {
+    const kid = search.querySelector("#ksel").value;
+    if (!kid) return;
+    const doc = await api(`/api/v1/knowledge/${kid}/search`, {method:"POST",
+      body: JSON.stringify({query: search.querySelector("#kq").value, top_k: 5})});
+    results.style.display = "";
+    const kr = results.querySelector("#kr");
+    kr.innerHTML = "";
+    for (const hit of doc.results || []) {
+      const d = $(`<div class="card"></div>`);
+      d.textContent = `[${(hit.score ?? 0).toFixed(3)}] ${hit.text || hit.chunk || ""}`.slice(0, 400);
+      kr.appendChild(d);
+    }
+    if (!(doc.results || []).length) kr.textContent = "no hits";
+  };
+  const p = $(`<div class="panel"><table><tr><th>id</th><th>name</th>
+    <th>state</th><th>version</th><th></th><th></th></tr></table></div>`);
+  for (const k of knowledge) {
+    const tr = $row(`<tr><td>${esc(k.id)}</td>
+      <td>${esc(k.name)}</td><td><span class="tag ${esc(k.state)}">${esc(k.state)}</span></td>
+      <td>${esc(k.version)}</td><td></td><td></td></tr>`);
+    const rf = $(`<button class="ghost">refresh</button>`);
+    rf.onclick = async () => {
+      await api(`/api/v1/knowledge/${k.id}/refresh`, {method:"POST"}); rerender();
+    };
+    tr.children[4].appendChild(rf);
+    const del = $(`<button class="ghost danger">delete</button>`);
+    del.onclick = async () => {
+      await api(`/api/v1/knowledge/${k.id}`, {method:"DELETE"}); rerender();
+    };
+    tr.children[5].appendChild(del);
+    p.querySelector("table").appendChild(tr);
+  }
+  m.appendChild(p);
+}

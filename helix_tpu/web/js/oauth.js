@@ -1,0 +1,52 @@
+/* OAuth connections: provider registry, connect (authorization-code
+ * flow), connection status, disconnect. */
+import {$, $row, api, esc} from "./core.js";
+
+export async function render(m) {
+  const p = $(`<div class="panel"><h3>OAuth connections</h3>
+    <p class="id">Connect external accounts (GitHub, ...) — agents use the
+    tokens for repo skills; knowledge sources use them for SharePoint.</p>
+    <table id="ot"></table></div>`);
+  m.appendChild(p);
+
+  async function refresh() {
+    const {providers} = await api("/api/v1/oauth/providers")
+      .catch(() => ({providers:[]}));
+    const {connections} = await api("/api/v1/oauth/connections")
+      .catch(() => ({connections:[]}));
+    const connected = Object.fromEntries(
+      (connections || []).map(c => [c.provider || c, c]));
+    const ot = p.querySelector("#ot");
+    ot.innerHTML = `<tr><th>provider</th><th>status</th><th></th></tr>`;
+    for (const pr of providers || []) {
+      const name = pr.name || pr;
+      const conn = connected[name];
+      const tr = $row(`<tr><td>${esc(name)}</td>
+        <td><span class="tag ${conn ? "connected" : ""}">${conn ? "connected" : "not connected"}</span></td>
+        <td></td></tr>`);
+      if (conn) {
+        const d = $(`<button class="ghost danger">disconnect</button>`);
+        d.onclick = async () => {
+          await api(`/api/v1/oauth/connections/${encodeURIComponent(name)}`,
+            {method:"DELETE"});
+          refresh();
+        };
+        tr.lastElementChild.appendChild(d);
+      } else {
+        const c = $(`<button class="ghost">connect</button>`);
+        c.onclick = async () => {
+          const doc = await api(
+            `/api/v1/oauth/connect/${encodeURIComponent(name)}`);
+          if (doc.url) location.href = doc.url;
+        };
+        tr.lastElementChild.appendChild(c);
+      }
+      ot.appendChild(tr);
+    }
+    if (!(providers || []).length)
+      ot.appendChild($row(`<tr><td colspan="3" class="id">
+        no OAuth providers configured (set HELIX_GITHUB_CLIENT_ID/SECRET)
+        </td></tr>`));
+  }
+  refresh();
+}

@@ -1,0 +1,197 @@
+/* Helix Org: bot org-chart (layered SVG), channels, platform bindings,
+ * scheduled activations — the UI over /api/v1/org/*. */
+import {$, $row, api, esc, toast} from "./core.js";
+
+function chartSvg(bots, reporting) {
+  // layer bots by depth in the reporting DAG (roots = no managers)
+  const managers = {};
+  for (const e of reporting)
+    (managers[e.report] = managers[e.report] || []).push(e.manager);
+  const depth = {};
+  const d = (id, seen = new Set()) => {
+    if (depth[id] !== undefined) return depth[id];
+    if (seen.has(id)) return 0;
+    seen.add(id);
+    const ms = managers[id] || [];
+    depth[id] = ms.length ? 1 + Math.max(...ms.map(x => d(x, seen))) : 0;
+    return depth[id];
+  };
+  bots.forEach(b => d(b.id));
+  const layers = [];
+  for (const b of bots) (layers[depth[b.id]] = layers[depth[b.id]] || []).push(b);
+  const W = 1080, RH = 74, BW = 150, BH = 44;
+  const pos = {};
+  layers.forEach((layer, li) => layer.forEach((b, i) => {
+    pos[b.id] = [ (i + 0.5) * (W / layer.length) - BW/2, li * RH + 8 ];
+  }));
+  const H = Math.max(layers.length * RH + 10, 60);
+  let s = `<svg class="chart" viewBox="0 0 ${W} ${H}" width="100%" height="${H}">`;
+  for (const e of reporting) {
+    const a = pos[e.manager], b = pos[e.report];
+    if (!a || !b) continue;
+    s += `<line x1="${a[0]+BW/2}" y1="${a[1]+BH}" x2="${b[0]+BW/2}" y2="${b[1]}"/>`;
+  }
+  for (const b of bots) {
+    const [x, y] = pos[b.id];
+    s += `<rect x="${x}" y="${y}" width="${BW}" height="${BH}"/>` +
+      `<text x="${x+BW/2}" y="${y+19}" text-anchor="middle">${esc(b.name)}${b.agent ? " ⚙" : ""}</text>` +
+      `<text x="${x+BW/2}" y="${y+35}" text-anchor="middle" style="fill:var(--dim);font-size:10px">${esc((b.role||"").slice(0,24))}</text>`;
+  }
+  return s + "</svg>";
+}
+
+export async function render(m) {
+  const top = $(`<div class="panel row">
+    <input id="bname" placeholder="bot name">
+    <input id="brole" class="grow" placeholder="role prompt">
+    <label class="id"><input type="checkbox" id="bagent"> agent session</label>
+    <button class="primary" id="mkbot">Create bot</button></div>`);
+  m.appendChild(top);
+  const chartPanel = $(`<div class="panel"><h3>Org chart</h3>
+    <div id="chart"></div>
+    <div class="row" style="margin-top:8px">
+      <select id="rrep"></select><span class="id">reports to</span>
+      <select id="rmgr"></select>
+      <button class="ghost" id="raddr">Add line</button></div></div>`);
+  m.appendChild(chartPanel);
+  const chanPanel = $(`<div class="panel"><h3>Channels</h3>
+    <div class="row"><select id="csel" class="grow"></select>
+      <input id="cname" placeholder="new channel">
+      <select id="cowner"></select>
+      <button class="ghost" id="mkchan">Create</button></div>
+    <div id="clog" class="chat-log" style="height:240px;margin-top:8px"></div>
+    <div class="row" style="margin-top:8px">
+      <input id="cbox" class="grow" placeholder="Message the channel (@bot to address one)...">
+      <button class="primary" id="cpost">Post</button></div></div>`);
+  m.appendChild(chanPanel);
+  const bindPanel = $(`<div class="panel"><h3>Platform routing (Slack / Teams / Discord)</h3>
+    <table id="bt"></table>
+    <div class="row" style="margin-top:8px">
+      <select id="bplat"><option>slack</option><option>teams</option><option>discord</option></select>
+      <input id="bext" placeholder="platform channel id (e.g. C0ABC123)">
+      <select id="bchan"></select>
+      <button class="ghost" id="bgo">Bind</button>
+      <span class="id">webhook: POST /api/v1/org/platform/&lt;kind&gt;</span></div></div>`);
+  m.appendChild(bindPanel);
+  const actPanel = $(`<div class="panel"><h3>Scheduled activations (stream cron)</h3>
+    <table id="at"></table>
+    <div class="row" style="margin-top:8px">
+      <select id="abot"></select>
+      <select id="achan"></select>
+      <input id="acron" placeholder="cron: m h dom mon dow" value="0 9 * * *">
+      <input id="anote" class="grow" placeholder="activation note">
+      <button class="ghost" id="ago">Schedule</button></div></div>`);
+  m.appendChild(actPanel);
+
+  async function refresh() {
+    const chart = await api("/api/v1/org/chart").catch(() => ({bots:[],reporting:[]}));
+    chartPanel.querySelector("#chart").innerHTML =
+      chart.bots.length ? chartSvg(chart.bots, chart.reporting) : "no bots yet";
+    for (const sel of ["#rrep", "#rmgr"])
+      chartPanel.querySelector(sel).innerHTML = "";
+    for (const sel of ["#cowner"]) chanPanel.querySelector(sel).innerHTML = "";
+    actPanel.querySelector("#abot").innerHTML = "";
+    for (const b of chart.bots) {
+      chartPanel.querySelector("#rrep").appendChild(new Option(b.name, b.id));
+      chartPanel.querySelector("#rmgr").appendChild(new Option(b.name, b.id));
+      chanPanel.querySelector("#cowner").appendChild(new Option(b.name, b.id));
+      actPanel.querySelector("#abot").appendChild(new Option(b.name, b.id));
+    }
+    const {channels} = await api("/api/v1/org/channels").catch(() => ({channels:[]}));
+    const sel = chanPanel.querySelector("#csel");
+    const prev = sel.value;
+    sel.innerHTML = "";
+    bindPanel.querySelector("#bchan").innerHTML = "";
+    actPanel.querySelector("#achan").innerHTML = "";
+    for (const c of channels) {
+      sel.appendChild(new Option(c.name, c.id));
+      bindPanel.querySelector("#bchan").appendChild(new Option(c.name, c.id));
+      actPanel.querySelector("#achan").appendChild(new Option(c.name, c.id));
+    }
+    if (prev) sel.value = prev;
+    const byId = Object.fromEntries(channels.map(c => [c.id, c.name]));
+    const {bindings} = await api("/api/v1/org/bindings").catch(() => ({bindings:[]}));
+    const bt = bindPanel.querySelector("#bt");
+    bt.innerHTML = `<tr><th>platform</th><th>external channel</th><th>org channel</th></tr>`;
+    for (const b of bindings || [])
+      bt.appendChild($row(`<tr><td>${esc(b.platform)}</td>
+        <td>${esc(b.external_id)}</td><td>${esc(byId[b.channel_id] || b.channel_id)}</td></tr>`));
+    const {activations} = await api("/api/v1/org/activations").catch(() => ({activations:[]}));
+    const at = actPanel.querySelector("#at");
+    at.innerHTML = `<tr><th>bot</th><th>channel</th><th>schedule</th><th>note</th><th></th></tr>`;
+    const bots = Object.fromEntries(chart.bots.map(b => [b.id, b.name]));
+    for (const a of activations || []) {
+      const tr = $row(`<tr><td>${esc(bots[a.bot_id] || a.bot_id)}</td>
+        <td>${esc(byId[a.channel_id] || a.channel_id)}</td>
+        <td><code>${esc(a.schedule)}</code></td><td>${esc(a.note)}</td><td></td></tr>`);
+      const del = $(`<button class="ghost danger">remove</button>`);
+      del.onclick = async () => {
+        await api(`/api/v1/org/activations/${a.id}`, {method:"DELETE"});
+        refresh();
+      };
+      tr.lastElementChild.appendChild(del);
+      at.appendChild(tr);
+    }
+    loadLog();
+  }
+  async function loadLog() {
+    const cid = chanPanel.querySelector("#csel").value;
+    const log = chanPanel.querySelector("#clog");
+    log.innerHTML = "";
+    if (!cid) return;
+    const {messages} = await api(`/api/v1/org/channels/${cid}/messages`);
+    for (const msg of messages) {
+      const d = $(`<div class="msg ${msg.author.startsWith("bot:") ? "assistant" : "user"}"></div>`);
+      d.textContent = `${msg.author}: ${msg.body}`;
+      log.appendChild(d);
+    }
+    log.scrollTop = log.scrollHeight;
+  }
+  top.querySelector("#mkbot").onclick = async () => {
+    await api("/api/v1/org/bots", {method:"POST", body: JSON.stringify({
+      name: top.querySelector("#bname").value,
+      role: top.querySelector("#brole").value,
+      agent: top.querySelector("#bagent").checked})});
+    refresh();
+  };
+  chartPanel.querySelector("#raddr").onclick = async () => {
+    await api("/api/v1/org/reporting", {method:"POST", body: JSON.stringify({
+      report: chartPanel.querySelector("#rrep").value,
+      manager: chartPanel.querySelector("#rmgr").value})});
+    refresh();
+  };
+  chanPanel.querySelector("#mkchan").onclick = async () => {
+    await api("/api/v1/org/channels", {method:"POST", body: JSON.stringify({
+      name: chanPanel.querySelector("#cname").value,
+      owner_bot: chanPanel.querySelector("#cowner").value})});
+    refresh();
+  };
+  chanPanel.querySelector("#csel").onchange = loadLog;
+  chanPanel.querySelector("#cpost").onclick = async () => {
+    const cid = chanPanel.querySelector("#csel").value;
+    const box = chanPanel.querySelector("#cbox");
+    if (!cid || !box.value.trim()) return;
+    await api(`/api/v1/org/channels/${cid}/messages`, {method:"POST",
+      body: JSON.stringify({body: box.value})});
+    box.value = "";
+    loadLog();
+  };
+  bindPanel.querySelector("#bgo").onclick = async () => {
+    await api("/api/v1/org/bindings", {method:"POST", body: JSON.stringify({
+      platform: bindPanel.querySelector("#bplat").value,
+      external_id: bindPanel.querySelector("#bext").value,
+      channel_id: bindPanel.querySelector("#bchan").value})});
+    toast("channel bound");
+    refresh();
+  };
+  actPanel.querySelector("#ago").onclick = async () => {
+    await api("/api/v1/org/activations", {method:"POST", body: JSON.stringify({
+      bot_id: actPanel.querySelector("#abot").value,
+      channel_id: actPanel.querySelector("#achan").value,
+      schedule: actPanel.querySelector("#acron").value,
+      note: actPanel.querySelector("#anote").value})});
+    toast("activation scheduled");
+    refresh();
+  };
+  refresh();
+}

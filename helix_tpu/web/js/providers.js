@@ -1,0 +1,40 @@
+/* Provider editor + served models. */
+import {$, $row, api, esc} from "./core.js";
+
+export async function render(m) {
+  const p = $(`<div class="panel"><h3>Inference providers</h3>
+    <table id="pv"></table></div>`);
+  m.appendChild(p);
+  const form = $(`<div class="panel row">
+    <input id="pn" placeholder="name">
+    <select id="pk"><option>openai_compat</option><option>anthropic</option></select>
+    <input id="pu" class="grow" placeholder="base url">
+    <input id="pkey" placeholder="api key" type="password">
+    <button class="primary" id="pgo">Register</button></div>`);
+  m.appendChild(form);
+  const mp = $(`<div class="panel"><h3>Served models</h3><table id="mt"></table></div>`);
+  m.appendChild(mp);
+  async function refresh() {
+    const {providers} = await api("/api/v1/providers").catch(() => ({providers:[]}));
+    const pv = p.querySelector("#pv");
+    pv.innerHTML = `<tr><th>name</th><th>kind</th><th>base url</th><th>key</th></tr>`;
+    for (const x of providers)
+      pv.appendChild($row(`<tr><td>${esc(x.name)}</td><td>${esc(x.kind)}</td>
+        <td>${esc(x.base_url)}</td><td>${x.has_key ? "•••" : "-"}</td></tr>`));
+    const models = await api("/v1/models").catch(() => ({data:[]}));
+    const mt = mp.querySelector("#mt");
+    mt.innerHTML = `<tr><th>id</th><th>owner</th><th>context</th></tr>`;
+    for (const md of models.data || [])
+      mt.appendChild($row(`<tr><td>${esc(md.id)}</td><td>${esc(md.owned_by || "")}</td>
+        <td>${esc(md.context_length || "")}</td></tr>`));
+  }
+  form.querySelector("#pgo").onclick = async () => {
+    await api("/api/v1/providers", {method:"POST", body: JSON.stringify({
+      name: form.querySelector("#pn").value,
+      kind: form.querySelector("#pk").value,
+      base_url: form.querySelector("#pu").value,
+      api_key: form.querySelector("#pkey").value})});
+    refresh();
+  };
+  refresh();
+}

@@ -1,0 +1,117 @@
+/* Runners + serving-profile editor: heartbeats, profile assignment with
+ * compatibility filtering, runner logs. */
+import {$, $row, api, authHeaders, esc, setRefresh, tab, toast} from "./core.js";
+
+export async function render(m) {
+  const p = $(`<div class="panel"><h3>TPU runners</h3><table id="rt"></table></div>`);
+  const logPanel = $(`<div class="panel" style="display:none">
+    <h3 id="lt"></h3>
+    <pre id="lp" class="code"></pre>
+  </div>`);
+  m.appendChild(p);
+  m.appendChild(logPanel);
+
+  const profPanel = $(`<div class="panel"><h3>Serving profiles</h3>
+    <table id="pt"></table>
+    <textarea id="py" class="code" rows="8" style="margin-top:8px"
+      placeholder="name: my-profile&#10;requirement: {chips: 8, vendor: tpu}&#10;models:&#10;  - name: meta-llama/Meta-Llama-3-8B-Instruct&#10;    mesh: {tp: 4, device_offset: 0}"></textarea>
+    <div class="row" style="margin-top:8px">
+      <button class="primary" id="pc">Create profile</button>
+      <button class="ghost" id="pe">Load into editor…</button></div></div>`);
+  m.appendChild(profPanel);
+
+  async function refresh() {
+    // don't clobber an in-progress interaction: skip the cycle while the
+    // operator has a control inside the runners table focused
+    if (p.contains(document.activeElement) &&
+        document.activeElement.tagName !== "BODY") return;
+    const picked = {};   // preserve pending (unassigned) dropdown choices
+    for (const sel of p.querySelectorAll("select[data-runner]"))
+      picked[sel.dataset.runner] = sel.value;
+    const {runners} = await api("/api/v1/runners");
+    const {profiles} = await api("/api/v1/profiles").catch(() => ({profiles:[]}));
+    const rt = p.querySelector("#rt");
+    rt.innerHTML = `<tr><th>id</th><th>profile</th><th>status</th>
+      <th>models</th><th>chips</th><th>assign</th><th></th></tr>`;
+    for (const r of runners) {
+      const tr = $row(`<tr><td>${esc(r.id)}</td>
+        <td>${esc(r.profile_name)}</td>
+        <td><span class="tag ${esc(r.profile_status)}">${esc(r.profile_status)}</span></td>
+        <td>${esc((r.models || []).join(", "))}</td>
+        <td>${(r.accelerators || []).length}</td><td></td><td></td></tr>`);
+      const cell = tr.children[5];
+      const sel = document.createElement("select");
+      sel.dataset.runner = r.id;
+      cell.appendChild(sel);
+      api(`/api/v1/runners/${r.id}/compatible-profiles`)
+        .then(doc => {
+          for (const n of doc.profiles) sel.appendChild(new Option(n, n));
+          sel.value = picked[r.id] || r.profile_name || sel.value;
+        }).catch(() => {});
+      const go = $(`<button class="ghost">assign</button>`);
+      go.onclick = async () => {
+        await api(`/api/v1/runners/${r.id}/assign-profile`, {method:"POST",
+          body: JSON.stringify({profile_name: sel.value})});
+        toast(`assigned ${sel.value} to ${r.id}`);
+        refresh();
+      };
+      cell.appendChild(go);
+      const clr = $(`<button class="ghost danger">clear</button>`);
+      clr.onclick = async () => {
+        await api(`/api/v1/runners/${r.id}/assignment`, {method:"DELETE"});
+        refresh();
+      };
+      cell.appendChild(clr);
+      const lb = $(`<button class="ghost">logs</button>`);
+      lb.onclick = async () => {
+        logPanel.style.display = "";
+        logPanel.querySelector("#lt").textContent = `logs: ${r.id}`;
+        const pre = logPanel.querySelector("#lp");
+        pre.textContent = "loading…";
+        const doc = await api(`/api/v1/runners/${r.id}/logs?tail=300`)
+          .catch(e => ({error: String(e)}));
+        pre.textContent = doc.logs
+          ? doc.logs.map(l => l.line).join("\n") || "(empty)"
+          : JSON.stringify(doc);
+        pre.scrollTop = pre.scrollHeight;
+      };
+      tr.children[6].appendChild(lb);
+      rt.appendChild(tr);
+    }
+    if (!runners.length)
+      rt.appendChild($row(`<tr><td colspan="7" class="id">no runners heartbeating</td></tr>`));
+
+    const pt = profPanel.querySelector("#pt");
+    pt.innerHTML = `<tr><th>name</th><th>requirement</th><th>models</th><th></th></tr>`;
+    for (const doc of profiles) {
+      const req = doc.requirement || {};
+      const tr = $row(`<tr><td>${esc(doc.name)}</td>
+        <td>${esc(`${req.chips || 1} × ${req.vendor || "tpu"} ${req.generation || ""}`)}</td>
+        <td>${esc((doc.models || []).map(x => x.name).join(", "))}</td><td></td></tr>`);
+      const del = $(`<button class="ghost danger">delete</button>`);
+      del.onclick = async () => {
+        await api(`/api/v1/profiles/${encodeURIComponent(doc.name)}`, {method:"DELETE"});
+        refresh();
+      };
+      tr.lastElementChild.appendChild(del);
+      pt.appendChild(tr);
+    }
+  }
+  profPanel.querySelector("#pc").onclick = async () => {
+    const r = await fetch("/api/v1/profiles", {method:"POST",
+      headers: Object.assign({"Content-Type":"application/yaml"}, authHeaders()),
+      body: profPanel.querySelector("#py").value});
+    const doc = await r.json();
+    if (!r.ok) { toast(doc.error?.message || `HTTP ${r.status}`); return; }
+    toast(`profile ${doc.name} saved`);
+    refresh();
+  };
+  profPanel.querySelector("#pe").onclick = async () => {
+    const name = prompt("profile name to load") || "";
+    if (!name) return;
+    const doc = await api(`/api/v1/profiles/${encodeURIComponent(name)}`);
+    profPanel.querySelector("#py").value = JSON.stringify(doc, null, 2);
+  };
+  refresh();
+  setRefresh(() => { if (tab === "runners") refresh(); }, 3000);
+}

@@ -1,0 +1,39 @@
+/* Secrets: envelope-encrypted values referenced as ${secrets.NAME}. */
+import {$, $row, api, esc} from "./core.js";
+
+export async function render(m) {
+  const form = $(`<div class="panel row">
+    <input id="sn" placeholder="SECRET_NAME">
+    <input id="sv" class="grow" placeholder="value" type="password">
+    <button class="primary" id="sgo">Set secret</button>
+    <span class="id">referenced as \${secrets.NAME} in app prompts/tools</span></div>`);
+  m.appendChild(form);
+  const p = $(`<div class="panel"><table id="st"></table></div>`);
+  m.appendChild(p);
+  async function refresh() {
+    const {secrets} = await api("/api/v1/secrets").catch(() => ({secrets:[]}));
+    const st = p.querySelector("#st");
+    st.innerHTML = `<tr><th>name</th><th></th></tr>`;
+    for (const s of secrets || []) {
+      const name = s.name || s;
+      const tr = $row(`<tr><td>${esc(name)}</td><td></td></tr>`);
+      const del = $(`<button class="ghost danger">delete</button>`);
+      del.onclick = async () => {
+        await api(`/api/v1/secrets/${encodeURIComponent(name)}`, {method:"DELETE"});
+        refresh();
+      };
+      tr.lastElementChild.appendChild(del);
+      st.appendChild(tr);
+    }
+    if (!(secrets || []).length)
+      st.appendChild($row(`<tr><td colspan="2" class="id">no secrets</td></tr>`));
+  }
+  form.querySelector("#sgo").onclick = async () => {
+    await api("/api/v1/secrets", {method:"POST", body: JSON.stringify({
+      name: form.querySelector("#sn").value,
+      value: form.querySelector("#sv").value})});
+    form.querySelector("#sv").value = "";
+    refresh();
+  };
+  refresh();
+}

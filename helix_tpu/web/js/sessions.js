@@ -1,0 +1,40 @@
+/* Session browser: history, transcript viewer, delete. */
+import {$, $row, api, esc, render as rerender} from "./core.js";
+
+export async function render(m) {
+  const wrap = $(`<div class="panel"><h3>Session history</h3>
+    <table><tr><th>id</th><th>name</th><th>owner</th><th></th><th></th></tr></table>
+    <div id="detail"></div></div>`);
+  m.appendChild(wrap);
+  const {sessions} = await api("/api/v1/sessions").catch(() => ({sessions:[]}));
+  const tbl = wrap.querySelector("table");
+  const detail = wrap.querySelector("#detail");
+  for (const s of sessions) {
+    const tr = $row(`<tr><td>${esc(s.id)}</td><td>${esc(s.name)}</td>
+      <td>${esc(s.owner)}</td><td></td><td></td></tr>`);
+    const b = $(`<button class="ghost">open</button>`);
+    b.onclick = async () => {
+      const doc = await api(`/api/v1/sessions/${s.id}`);
+      detail.innerHTML = `<h3 style="margin-top:14px">${esc(s.name)}</h3>`;
+      for (const it of doc.interactions || []) {
+        const d = $(`<div class="msg ${esc(it.role || "assistant")}"></div>`);
+        d.textContent = `${it.role}: ${
+          typeof it.content === "string" ? it.content
+          : JSON.stringify(it.content)}`.slice(0, 2000);
+        detail.appendChild(d);
+      }
+      if (!(doc.interactions || []).length)
+        detail.appendChild($(`<div class="id">no interactions</div>`));
+    };
+    tr.children[3].appendChild(b);
+    const del = $(`<button class="ghost danger">delete</button>`);
+    del.onclick = async () => {
+      await api(`/api/v1/sessions/${s.id}`, {method:"DELETE"});
+      rerender();
+    };
+    tr.children[4].appendChild(del);
+    tbl.appendChild(tr);
+  }
+  if (!sessions.length)
+    wrap.appendChild($(`<div class="id">no sessions yet</div>`));
+}

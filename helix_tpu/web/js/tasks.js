@@ -1,0 +1,125 @@
+/* Kanban: spec tasks across the board with live PR/CI state on the
+ * cards (polled every 4 s), spec review actions, PR diff viewer. */
+import {$, $row, api, authHeaders, esc, setRefresh, tab} from "./core.js";
+
+const COLS = {backlog:["backlog","planning","spec_revision"],
+  "spec review":["spec_review"],
+  implementing:["implementation_queued","implementing"],
+  "pr review":["pr_review"], done:["done","failed","cancelled"]};
+
+export async function render(m) {
+  const top = $(`<div class="panel row">
+    <input id="proj" placeholder="project" value="default">
+    <input id="title" class="grow" placeholder="task title">
+    <button class="primary" id="mk">Create task</button></div>`);
+  m.appendChild(top);
+  const board = $(`<div class="board"></div>`);
+  m.appendChild(board);
+  top.querySelector("#mk").onclick = async () => {
+    await api("/api/v1/spec-tasks", {method:"POST", body: JSON.stringify({
+      project: top.querySelector("#proj").value,
+      title: top.querySelector("#title").value})});
+    refresh();
+  };
+  async function refresh() {
+    const {tasks} = await api("/api/v1/spec-tasks");
+    // one PR-index fetch per cycle: cards show live PR + CI state
+    const prs = Object.fromEntries(
+      ((await api("/api/v1/pull-requests").catch(() => ({pull_requests:[]})))
+        .pull_requests || []).map(p => [p.id, p]));
+    board.innerHTML = "";
+    for (const [name, statuses] of Object.entries(COLS)) {
+      const col = $(`<div class="col"><h3>${esc(name)}</h3></div>`);
+      for (const t of tasks.filter(t => statuses.includes(t.status))) {
+        const c = $(`<div class="card"><b>${esc(t.title)}</b>
+          <div class="id">${esc(t.id)} · <span class="tag ${esc(t.status)}">${esc(t.status)}</span></div>
+        </div>`);
+        const pr = t.pr_id ? prs[t.pr_id] : null;
+        if (pr) {
+          c.appendChild($(`<div class="id">PR <span class="tag ${esc(pr.status)}">${esc(pr.status)}</span>
+            · CI <span class="tag ${esc(pr.ci_status)}">${esc(pr.ci_status)}</span></div>`));
+        }
+        c.querySelector("b").style.cursor = "pointer";
+        c.querySelector("b").onclick = () => taskDetail(t);
+        if (t.status === "spec_review") {
+          const a = $(`<button class="ghost">approve</button>`);
+          a.onclick = async () => { await api(`/api/v1/spec-tasks/${t.id}/review`,
+            {method:"POST", body:JSON.stringify({decision:"approve"})}); refresh(); };
+          c.appendChild(a);
+          const rc = $(`<button class="ghost">request changes</button>`);
+          rc.onclick = async () => {
+            const comment = prompt("What should change?") || "";
+            if (!comment) return;
+            await api(`/api/v1/spec-tasks/${t.id}/review`, {method:"POST",
+              body: JSON.stringify({decision:"request_changes", comment})});
+            refresh();
+          };
+          c.appendChild(rc);
+        }
+        if (t.status === "pr_review" && t.pr_id) {
+          const mg = $(`<button class="ghost">merge PR</button>`);
+          mg.onclick = async () => { await api(`/api/v1/pull-requests/${t.pr_id}/merge`,
+            {method:"POST"}); refresh(); };
+          c.appendChild(mg);
+        }
+        if (t.error) {
+          const e = $(`<div style="color:var(--err);font-size:11px"></div>`);
+          e.textContent = t.error.slice(0, 120);
+          c.appendChild(e);
+        }
+        col.appendChild(c);
+      }
+      board.appendChild(col);
+    }
+  }
+  refresh();
+  setRefresh(() => { if (tab === "tasks") refresh(); }, 4000);
+
+  async function taskDetail(t) {
+    const doc = await api(`/api/v1/spec-tasks/${t.id}`);
+    let detail = m.querySelector("#task-detail");
+    if (detail) detail.remove();
+    detail = $(`<div class="panel" id="task-detail"></div>`);
+    const h = $(`<h3></h3>`); h.textContent = doc.title;
+    detail.appendChild(h);
+    const meta = $(`<div class="id"></div>`);
+    meta.textContent =
+      `${doc.id} · ${doc.status} · branch ${doc.task_branch || "-"}` +
+      ` · CI attempts ${doc.ci_attempts || 0}`;
+    detail.appendChild(meta);
+    if (doc.description) {
+      const d = $(`<p style="white-space:pre-wrap"></p>`);
+      d.textContent = doc.description; detail.appendChild(d);
+    }
+    if (doc.pr_id) {
+      const prdoc = (await api(`/api/v1/pull-requests`)).pull_requests
+        .find(p => p.id === doc.pr_id);
+      if (prdoc) {
+        const pr = $(`<div class="id"></div>`);
+        pr.textContent = `PR ${prdoc.id}: ${prdoc.status} · CI ${
+          prdoc.ci_status}`;
+        detail.appendChild(pr);
+      }
+      const diffBtn = $(`<button class="ghost">view diff</button>`);
+      diffBtn.onclick = async () => {
+        const r = await fetch(`/api/v1/pull-requests/${doc.pr_id}/diff`,
+          {headers: authHeaders()});
+        const pre = $(`<pre class="code"></pre>`);
+        pre.textContent = await r.text();
+        detail.appendChild(pre);
+      };
+      detail.appendChild(diffBtn);
+    }
+    const rh = $(`<h3 style="margin-top:10px">Design review</h3>`);
+    detail.appendChild(rh);
+    for (const r of doc.reviews || []) {
+      const row = $(`<div class="msg"></div>`);
+      row.textContent = `[${r.decision}] ${r.author}: ${r.comment}`;
+      detail.appendChild(row);
+    }
+    if (!(doc.reviews || []).length)
+      detail.appendChild($(`<div class="id">no review comments yet</div>`));
+    m.appendChild(detail);
+    detail.scrollIntoView();
+  }
+}

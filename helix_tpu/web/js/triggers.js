@@ -1,0 +1,44 @@
+/* Triggers: cron + webhook (+ platform adapters via kind). */
+import {$, $row, api, esc} from "./core.js";
+
+export async function render(m) {
+  const form = $(`<div class="panel row">
+    <select id="tk"><option>webhook</option><option>cron</option>
+      <option>slack</option><option>teams</option><option>discord</option>
+      <option>azure-devops</option><option>crisp</option></select>
+    <input id="tname" placeholder="name">
+    <input id="tspec" class="grow" placeholder="cron spec (cron only), e.g. */5 * * * *">
+    <input id="tapp" placeholder="app id">
+    <button class="primary" id="tgo">Create trigger</button></div>`);
+  m.appendChild(form);
+  const p = $(`<div class="panel"><table id="tt"></table></div>`);
+  m.appendChild(p);
+  async function refresh() {
+    const {triggers} = await api("/api/v1/triggers").catch(() => ({triggers:[]}));
+    const tt = p.querySelector("#tt");
+    tt.innerHTML = `<tr><th>id</th><th>kind</th><th>name</th><th>detail</th><th></th></tr>`;
+    for (const t of triggers || []) {
+      const detail = t.kind === "cron"
+        ? (t.cron || t.spec || "") : `POST /webhooks/${t.id}`;
+      const tr = $row(`<tr><td>${esc(t.id)}</td><td>${esc(t.kind)}</td>
+        <td>${esc(t.name)}</td><td>${esc(detail)}</td><td></td></tr>`);
+      const del = $(`<button class="ghost danger">delete</button>`);
+      del.onclick = async () => {
+        await api(`/api/v1/triggers/${t.id}`, {method:"DELETE"}); refresh();
+      };
+      tr.lastElementChild.appendChild(del);
+      tt.appendChild(tr);
+    }
+    if (!(triggers || []).length)
+      tt.appendChild($row(`<tr><td colspan="5" class="id">no triggers</td></tr>`));
+  }
+  form.querySelector("#tgo").onclick = async () => {
+    await api("/api/v1/triggers", {method:"POST", body: JSON.stringify({
+      kind: form.querySelector("#tk").value,
+      name: form.querySelector("#tname").value,
+      cron: form.querySelector("#tspec").value,
+      app_id: form.querySelector("#tapp").value})});
+    refresh();
+  };
+  refresh();
+}

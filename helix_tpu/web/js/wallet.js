@@ -1,0 +1,70 @@
+/* Wallet: balance/tier, Stripe checkout + subscription, transactions,
+ * usage metering. */
+import {$, $row, api, esc, render as rerender, toast} from "./core.js";
+
+export async function render(m) {
+  const w = await api("/api/v1/wallet").catch(() => ({balance_usd: 0}));
+  const sub = await api("/api/v1/wallet/subscription").catch(() => null);
+  const stats = $(`<div class="grid3">
+    <div class="panel"><div class="statlabel">balance</div>
+      <div class="stat">$${(w.balance_usd ?? 0).toFixed(2)}</div></div>
+    <div class="panel"><div class="statlabel">tier</div>
+      <div class="stat">${esc(w.tier || "free")}</div>
+      <div class="id" id="substate"></div></div>
+    <div class="panel"><div class="statlabel">top up</div>
+      <div class="row"><input id="amt" style="width:90px" value="10">
+        <button class="primary" id="tgo">Add</button>
+        <button class="ghost" id="sgo">Card…</button></div>
+      <div class="row" style="margin-top:6px">
+        <button class="ghost" id="subgo">Subscribe to Pro</button></div></div>
+  </div>`);
+  m.appendChild(stats);
+  if (sub)
+    stats.querySelector("#substate").textContent =
+      sub.active ? `subscription active (${sub.status || "ok"})`
+                 : "no subscription";
+  stats.querySelector("#tgo").onclick = async () => {
+    await api("/api/v1/wallet/topup", {method:"POST", body: JSON.stringify({
+      usd: parseFloat(stats.querySelector("#amt").value || "0")})});
+    rerender();
+  };
+  stats.querySelector("#sgo").onclick = async () => {
+    // Stripe checkout session for card top-ups; inert unless the
+    // operator configured Stripe keys
+    const doc = await api("/api/v1/wallet/topup-session", {method:"POST",
+      body: JSON.stringify({
+        usd: parseFloat(stats.querySelector("#amt").value || "0")})})
+      .catch(() => null);
+    if (doc?.url) location.href = doc.url;
+    else toast("Stripe is not configured on this deployment");
+  };
+  stats.querySelector("#subgo").onclick = async () => {
+    const doc = await api("/api/v1/wallet/subscription-session",
+      {method:"POST", body: "{}"}).catch(() => null);
+    if (doc?.url) location.href = doc.url;
+    else toast("Stripe is not configured on this deployment");
+  };
+  const tx = $(`<div class="panel"><h3>Transactions</h3><table id="tt"></table></div>`);
+  m.appendChild(tx);
+  const {transactions} = await api("/api/v1/wallet/transactions")
+    .catch(() => ({transactions:[]}));
+  const tt = tx.querySelector("#tt");
+  tt.innerHTML = `<tr><th>when</th><th>kind</th><th>amount</th><th>note</th></tr>`;
+  for (const t of (transactions || []).slice(0, 50)) {
+    const tr = $row(`<tr><td>${esc(new Date((t.created_at || 0) * 1000).toLocaleString())}</td>
+      <td>${esc(t.kind)}</td><td>$${(t.amount_usd ?? t.usd ?? 0).toFixed(4)}</td><td></td></tr>`);
+    tr.lastElementChild.textContent = t.note || t.reference || "";
+    tt.appendChild(tr);
+  }
+  const up = $(`<div class="panel"><h3>Usage</h3><table id="ut"></table></div>`);
+  m.appendChild(up);
+  const {usage} = await api("/api/v1/usage").catch(() => ({usage:[]}));
+  const ut = up.querySelector("#ut");
+  ut.innerHTML = `<tr><th>model</th><th>requests</th><th>prompt tokens</th>
+    <th>completion tokens</th></tr>`;
+  for (const u of usage || [])
+    ut.appendChild($row(`<tr><td>${esc(u.model)}</td><td>${u.requests ?? u.calls ?? 0}</td>
+      <td>${u.prompt_tokens ?? 0}</td><td>${u.completion_tokens ?? 0}</td></tr>`));
+  if (!(usage || []).length)
+    ut.appendChild($row(`<tr><td colspan="4" class="id">no usage recorded</td></tr>`));
+}

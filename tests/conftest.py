@@ -38,9 +38,17 @@ assert jax.default_backend() == "cpu", (
 )
 
 jax.config.update("jax_default_matmul_precision", "highest")
-# persistent compile cache: repeat test runs skip XLA compilation entirely
-jax.config.update("jax_compilation_cache_dir", "/root/.jax_test_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+# NO persistent compile cache on CPU: XLA:CPU AOT deserialization
+# segfaults in this jax build (observed round 4, twice: pytest died at
+# jax _cache_read/get_executable_and_time on entries written seconds
+# earlier by the same process — not a stale-cache problem).  Re-compiling
+# per run costs minutes; a segfault costs the whole suite.  Opt back in
+# with HELIX_TEST_COMPILE_CACHE=1 on hosts where the cache is known good.
+import os as _os
+
+if _os.environ.get("HELIX_TEST_COMPILE_CACHE") == "1":
+    jax.config.update("jax_compilation_cache_dir", "/root/.jax_test_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import threading  # noqa: E402
 

@@ -1,0 +1,117 @@
+"""Consolidated control-plane database (round-3 next #10).
+
+The reference runs every entity through one Postgres store with a
+migrations framework (``api/pkg/store/postgres.go:84-170``).  These tests
+pin the consolidation contract: one file for every component, a recorded
+migration ledger, and cross-entity transactions that commit or roll back
+together.
+"""
+
+import os
+
+import pytest
+
+from helix_tpu.control.db import Database
+
+
+def test_migrations_ledger_applied_once(tmp_path):
+    db = Database(str(tmp_path / "one.db"))
+    n1 = db.migrate("demo", [(1, "a", "CREATE TABLE t1 (x)"),
+                             (2, "b", "CREATE TABLE t2 (y)")])
+    n2 = db.migrate("demo", [(1, "a", "CREATE TABLE t1 (x)"),
+                             (2, "b", "CREATE TABLE t2 (y)"),
+                             (3, "c", "CREATE TABLE t3 (z)")])
+    assert (n1, n2) == (2, 1)
+    ledger = db.migrations("demo")
+    assert [m["version"] for m in ledger] == [1, 2, 3]
+
+
+def test_all_components_share_one_file(tmp_path):
+    """Every store that used to open its own SQLite file now lands in one
+    shared database — no sibling .auth/.billing/... files."""
+    from helix_tpu.control.auth import Authenticator
+    from helix_tpu.control.billing import BillingService
+    from helix_tpu.control.jetstream import JetStream
+    from helix_tpu.control.oauth import OAuthManager
+    from helix_tpu.control.store import Store
+    from helix_tpu.knowledge.vector_store import VectorStore
+    from helix_tpu.services.org import OrgService
+    from helix_tpu.services.spec_tasks import TaskStore
+
+    path = str(tmp_path / "helix.db")
+    db = Database(path)
+    Store(db)
+    Authenticator(db)
+    BillingService(db)
+    OAuthManager(db)
+    JetStream(db)
+    OrgService(db)
+    TaskStore(db)
+    VectorStore(db)
+    files = {
+        f for f in os.listdir(tmp_path)
+        if not f.startswith("helix.db")  # -wal/-shm are SQLite's own
+        and f != "helix.db.master-key"   # auth keyfile lives beside the DB
+    }
+    assert files == set(), f"stray per-component files: {files}"
+    comps = {m["component"] for m in db.migrations()}
+    assert {"core", "auth", "billing", "oauth", "jetstream", "org",
+            "spec_tasks", "vectors"} <= comps
+
+
+def test_cross_entity_transaction_rolls_back(tmp_path):
+    """A failure mid-block must undo writes across DIFFERENT components'
+    tables — the atomicity the nine separate files could not give."""
+    from helix_tpu.control.billing import BillingService
+    from helix_tpu.control.store import Store
+
+    db = Database(str(tmp_path / "txn.db"))
+    store = Store(db)
+    billing = BillingService(db)
+    billing.topup("alice", 10.0)
+    base = billing.wallet("alice")["balance_usd"]
+
+    with pytest.raises(RuntimeError):
+        with db.transaction():
+            billing.charge_usage("alice", "llama-3-8b", 1000, 500)
+            store.add_usage("alice", "llama-3-8b", 1000, 500)
+            raise RuntimeError("boom")
+
+    assert billing.wallet("alice")["balance_usd"] == pytest.approx(base)
+    assert store.usage_summary("alice") == {}
+
+    with db.transaction():
+        charged = billing.charge_usage("alice", "llama-3-8b", 1000, 500)
+        store.add_usage("alice", "llama-3-8b", 1000, 500)
+    assert billing.wallet("alice")["balance_usd"] == pytest.approx(
+        base - charged / 1e6
+    )
+    assert store.usage_summary("alice")["llama-3-8b"]["requests"] == 1
+
+
+def test_legacy_path_string_still_works(tmp_path):
+    from helix_tpu.control.store import Store
+
+    s = Store(str(tmp_path / "legacy.db"))
+    s.kv_set("k", {"v": 1})
+    assert s.kv_get("k") == {"v": 1}
+
+
+def test_postgres_dsn_raises_actionably():
+    with pytest.raises(RuntimeError, match="driver"):
+        Database("postgres://u:p@host/db")
+
+
+def test_control_plane_single_db(tmp_path):
+    """The server wires one Database for everything."""
+    from helix_tpu.control.server import ControlPlane
+
+    cp = ControlPlane(db_path=str(tmp_path / "cp.db"))
+    assert cp.store._db is cp.db
+    assert cp.auth._db is cp.db
+    assert cp.billing._db is cp.db
+    assert cp.jetstream._db is cp.db
+    assert cp.org._db is cp.db
+    assert cp.task_store._db is cp.db
+    assert cp.vectors._db is cp.db
+    assert cp.oauth._db is cp.db

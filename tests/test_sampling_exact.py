@@ -1,0 +1,151 @@
+"""Exact-sampling guarantees (round-3 verdict weak #4 / next #6).
+
+The sampler's candidate window (``TOPK_BOUND``) must be an optimisation,
+never a truncation: whenever the requested nucleus extends past the window
+the sampler escalates to a full-vocab path.  These tests compare empirical
+distributions against a full-vocab numpy reference at adversarial settings
+(high temperature, ``top_p=1.0``, flat logits), i.e. exactly the regimes
+where the r3 sampler deviated from OpenAI/vLLM semantics.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from helix_tpu.engine.sampling import (
+    TOPK_BOUND,
+    SamplingParams,
+    SamplingState,
+    sample,
+)
+
+V = 8 * TOPK_BOUND  # 512: big enough that the window is a real subset
+
+
+def _state(B, **kw):
+    return SamplingState.from_params([SamplingParams(**kw)] * B)
+
+
+def _keys(B, seed):
+    key = jax.random.PRNGKey(seed)
+    return jax.random.split(key, B)
+
+
+def _reference_dist(logits, temperature, top_p=1.0, top_k=0):
+    """Full-vocab OpenAI/vLLM sampling distribution in float64 numpy."""
+    scaled = np.asarray(logits, np.float64) / temperature
+    p = np.exp(scaled - scaled.max())
+    p /= p.sum()
+    order = np.argsort(-p, kind="stable")
+    sp = p[order]
+    cum = np.cumsum(sp)
+    keep = (cum - sp) < top_p
+    if top_k > 0:
+        keep &= np.arange(len(p)) < top_k
+    dist = np.zeros_like(p)
+    dist[order[keep]] = sp[keep]
+    return dist / dist.sum()
+
+
+def _empirical(logits_row, n, **kw):
+    """Draw n samples through the production sampler (n slots per call)."""
+    B = 512
+    logits = jnp.broadcast_to(jnp.asarray(logits_row, jnp.float32), (B, V))
+    st = _state(B, **kw)
+    counts = np.zeros(V, np.int64)
+    rounds = (n + B - 1) // B
+    for r in range(rounds):
+        toks = np.asarray(sample(logits, st, _keys(B, r)))
+        counts += np.bincount(toks, minlength=V)
+    return counts / counts.sum()
+
+
+def _tv(a, b):
+    return 0.5 * float(np.abs(a - b).sum())
+
+
+class TestExactEscalation:
+    def test_top_p_1_samples_past_window(self):
+        """top_p=1.0 must sample from the FULL vocab: with flat logits,
+        ~7/8 of the mass lies beyond the 64-token window the r3 sampler
+        truncated to."""
+        logits = np.zeros(V, np.float32)
+        emp = _empirical(logits, 4096, temperature=1.0, top_p=1.0)
+        beyond = emp[TOPK_BOUND:].sum()
+        # true mass beyond any 64 tokens is 448/512 = 0.875
+        assert beyond > 0.7, f"window truncation: {beyond:.3f} mass past 64"
+
+    def test_top_p_1_high_temperature_distribution(self):
+        """temperature=2.0, top_p=1.0 vs the full-vocab reference (the
+        verdict's prescribed adversarial setting)."""
+        rng = np.random.default_rng(0)
+        logits = rng.normal(0, 2, V).astype(np.float32)
+        emp = _empirical(logits, 16384, temperature=2.0, top_p=1.0)
+        ref = _reference_dist(logits, 2.0, top_p=1.0)
+        # expected sampling-noise TV at n=16k over 512 bins is ~0.06
+        assert _tv(emp, ref) < 0.09
+
+    def test_top_p_past_window_mass_full_sort(self):
+        """top_p < 1 but beyond the window's mass -> tier-3 full sort.
+        Flat logits: window holds 64/512 = 12.5% of the mass, so
+        top_p=0.9 needs ~461 candidates."""
+        logits = np.zeros(V, np.float32)
+        emp = _empirical(logits, 16384, temperature=1.0, top_p=0.9)
+        ref = _reference_dist(logits, 1.0, top_p=0.9)
+        assert emp[TOPK_BOUND:].sum() > 0.5
+        assert _tv(emp, ref) < 0.09
+
+    def test_top_k_past_window(self):
+        """top_k > TOPK_BOUND escalates; samples stay within top_k."""
+        rng = np.random.default_rng(1)
+        logits = rng.normal(0, 1, V).astype(np.float32)
+        k = 2 * TOPK_BOUND
+        emp = _empirical(logits, 4096, temperature=1.5, top_p=1.0, top_k=k)
+        order = np.argsort(-logits, kind="stable")
+        allowed = set(order[:k].tolist())
+        sampled = set(np.nonzero(emp)[0].tolist())
+        assert sampled <= allowed
+        # and it actually uses candidates past the window
+        past = [t for t in sampled if t in set(order[TOPK_BOUND:k].tolist())]
+        assert past, "no samples past the 64-token window despite top_k=128"
+
+    def test_nucleus_within_window_still_exact(self):
+        """Peaked logits, top_p=0.8: nucleus fits the window; distribution
+        must match the reference computed with FULL-vocab probabilities
+        (the r3 window renormalised within the window, skewing mass)."""
+        logits = np.zeros(V, np.float32)
+        logits[:8] = np.array([8, 7.5, 7, 6.5, 6, 5.5, 5, 4.5])
+        emp = _empirical(logits, 8192, temperature=1.0, top_p=0.8)
+        ref = _reference_dist(logits, 1.0, top_p=0.8)
+        assert _tv(emp, ref) < 0.05
+
+    def test_greedy_unchanged(self):
+        rng = np.random.default_rng(2)
+        logits = rng.normal(0, 1, (4, V)).astype(np.float32)
+        st = _state(4, temperature=0.0)
+        toks = np.asarray(sample(jnp.asarray(logits), st, _keys(4, 0)))
+        np.testing.assert_array_equal(toks, logits.argmax(-1))
+
+    def test_exact_flag_runs_and_matches(self):
+        """exact=True (HELIX_EXACT_SAMPLING) swaps approx_max_k for
+        lax.top_k; the distribution is statistically identical."""
+        rng = np.random.default_rng(3)
+        logits_row = rng.normal(0, 1, V).astype(np.float32)
+        B = 512
+        logits = jnp.broadcast_to(jnp.asarray(logits_row), (B, V))
+        st = _state(B, temperature=1.0, top_p=0.9)
+        counts = np.zeros(V, np.int64)
+        for r in range(24):
+            toks = np.asarray(sample(logits, st, _keys(B, r), exact=True))
+            counts += np.bincount(toks, minlength=V)
+        ref = _reference_dist(logits_row, 1.0, top_p=0.9)
+        assert _tv(counts / counts.sum(), ref) < 0.09
+
+    def test_seeded_reproducible(self):
+        rng = np.random.default_rng(4)
+        logits = jnp.asarray(rng.normal(0, 1, (3, V)).astype(np.float32))
+        st = _state(3, temperature=1.0, top_p=1.0)
+        a = np.asarray(sample(logits, st, _keys(3, 7)))
+        b = np.asarray(sample(logits, st, _keys(3, 7)))
+        np.testing.assert_array_equal(a, b)
