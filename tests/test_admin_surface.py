@@ -178,13 +178,19 @@ def test_web_ui_rows_use_table_context():
     go through the $row helper (parsed inside a <table>)."""
     import os
 
-    path = os.path.join(
+    web = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "helix_tpu", "web", "index.html",
+        "helix_tpu", "web",
     )
-    src = open(path).read()
-    assert "$row = (h)" in src
-    assert "$(`<tr>" not in src, "raw div-parsed <tr> template reintroduced"
+    core = open(os.path.join(web, "js", "core.js")).read()
+    assert "$row = (h)" in core
+    for dirpath, _, files in os.walk(web):
+        for f in files:
+            if f.endswith((".js", ".html")):
+                src = open(os.path.join(dirpath, f)).read()
+                assert "$(`<tr>" not in src, (
+                    f"raw div-parsed <tr> template reintroduced in {f}"
+                )
 
 
 def test_env_reference_covers_every_knob_the_tree_reads():
@@ -219,13 +225,19 @@ def test_env_reference_covers_every_knob_the_tree_reads():
 
 
 def _ui_source() -> str:
+    """All web UI source: index.html plus the JS modules it loads."""
     import os
 
-    path = os.path.join(
+    web = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "helix_tpu", "web", "index.html",
+        "helix_tpu", "web",
     )
-    return open(path).read()
+    parts = [open(os.path.join(web, "index.html")).read()]
+    jsdir = os.path.join(web, "js")
+    for f in sorted(os.listdir(jsdir)):
+        if f.endswith(".js"):
+            parts.append(open(os.path.join(jsdir, f)).read())
+    return "\n".join(parts)
 
 
 def test_web_ui_reaches_every_admin_api_family():
