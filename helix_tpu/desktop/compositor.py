@@ -1,0 +1,162 @@
+"""ctypes bindings for the native software compositor (native/compositor).
+
+The scene-graph / composition half of the GUI desktop path — standing
+where the reference's headless Wayland compositor sits
+(``desktop/wayland-display-core/src/lib.rs:28-40``).  Surfaces are BGRA
+buffers owned by in-process apps; the compositor z-orders, alpha-blends,
+overlays the cursor, and answers hit tests for input routing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "compositor",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhxcomp.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.hxc_create.restype = ctypes.c_void_p
+        lib.hxc_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.hxc_destroy.argtypes = [ctypes.c_void_p]
+        lib.hxc_surface_create.restype = ctypes.c_uint32
+        lib.hxc_surface_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int
+        ]
+        for fn in ("hxc_surface_destroy", "hxc_surface_raise"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.hxc_surface_attach.restype = ctypes.c_int
+        lib.hxc_surface_attach.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p
+        ]
+        lib.hxc_surface_move.restype = ctypes.c_int
+        lib.hxc_surface_move.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int, ctypes.c_int
+        ]
+        lib.hxc_surface_set_visible.restype = ctypes.c_int
+        lib.hxc_surface_set_visible.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_int
+        ]
+        lib.hxc_set_cursor.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int
+        ]
+        lib.hxc_composite.restype = ctypes.c_int
+        lib.hxc_composite.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint8, ctypes.c_uint8, ctypes.c_uint8
+        ]
+        lib.hxc_framebuffer.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.hxc_framebuffer.argtypes = [ctypes.c_void_p]
+        lib.hxc_hit_test.restype = ctypes.c_uint32
+        lib.hxc_hit_test.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.hxc_composite_count.restype = ctypes.c_uint64
+        lib.hxc_composite_count.argtypes = [ctypes.c_void_p]
+        lib.hxc_surface_count.restype = ctypes.c_int
+        lib.hxc_surface_count.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class Compositor:
+    """Z-ordered alpha-blending surface compositor with cursor + hit test."""
+
+    def __init__(self, width: int, height: int):
+        self._lib = _load()
+        self._h = self._lib.hxc_create(width, height)
+        if not self._h:
+            raise ValueError("bad compositor dimensions")
+        self.width = width
+        self.height = height
+        self._sizes: dict[int, Tuple[int, int]] = {}
+
+    def create_surface(self, width: int, height: int) -> int:
+        sid = self._lib.hxc_surface_create(self._h, width, height)
+        if not sid:
+            raise ValueError("bad surface dimensions")
+        self._sizes[sid] = (width, height)
+        return sid
+
+    def destroy_surface(self, sid: int) -> None:
+        self._lib.hxc_surface_destroy(self._h, sid)
+        self._sizes.pop(sid, None)
+
+    def attach(self, sid: int, bgra: np.ndarray) -> None:
+        w, h = self._sizes[sid]
+        bgra = np.ascontiguousarray(bgra, dtype=np.uint8)
+        assert bgra.shape == (h, w, 4), (bgra.shape, (h, w))
+        rc = self._lib.hxc_surface_attach(self._h, sid, bgra.tobytes())
+        if rc != 0:
+            raise KeyError(sid)
+
+    def move(self, sid: int, x: int, y: int) -> None:
+        self._lib.hxc_surface_move(self._h, sid, x, y)
+
+    def raise_(self, sid: int) -> None:
+        self._lib.hxc_surface_raise(self._h, sid)
+
+    def set_visible(self, sid: int, visible: bool) -> None:
+        self._lib.hxc_surface_set_visible(self._h, sid, 1 if visible else 0)
+
+    def set_cursor(self, x: int, y: int, visible: bool = True) -> None:
+        self._lib.hxc_set_cursor(self._h, x, y, 1 if visible else 0)
+
+    def composite(self, bg=(18, 18, 24)) -> bool:
+        """-> True if the framebuffer changed since the last composite."""
+        return bool(
+            self._lib.hxc_composite(self._h, bg[2], bg[1], bg[0])
+        )
+
+    @property
+    def framebuffer(self) -> np.ndarray:
+        ptr = self._lib.hxc_framebuffer(self._h)
+        buf = ctypes.string_at(ptr, self.width * self.height * 4)
+        return np.frombuffer(buf, np.uint8).reshape(
+            self.height, self.width, 4
+        )
+
+    def hit_test(self, x: int, y: int) -> Optional[Tuple[int, int, int]]:
+        """-> (surface_id, local_x, local_y), or None on background."""
+        lx = ctypes.c_int()
+        ly = ctypes.c_int()
+        sid = self._lib.hxc_hit_test(
+            self._h, x, y, ctypes.byref(lx), ctypes.byref(ly)
+        )
+        if not sid:
+            return None
+        return sid, lx.value, ly.value
+
+    @property
+    def composite_count(self) -> int:
+        return self._lib.hxc_composite_count(self._h)
+
+    @property
+    def surface_count(self) -> int:
+        return self._lib.hxc_surface_count(self._h)
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hxc_destroy(self._h)
+            self._h = None

@@ -1,0 +1,360 @@
+"""Software GUI toolkit + desktop source for agent desktops.
+
+The reference streams a real Wayland desktop where agents drive GUI apps
+(``api/pkg/desktop/ws_stream.go``, ``desktop/wayland-display-core``).  A
+TPU node has no GPU or display server, so this module provides the whole
+GUI column in software:
+
+- a small widget toolkit (windows with title bars, labels, buttons, text
+  inputs, scrolling logs) rendered with PIL into BGRA surfaces;
+- :class:`GuiScreenSource`, a desktop source that composites windows via
+  the native compositor, routes pointer/keyboard events (hit test -> focus
+  -> widget callbacks), supports window dragging and raise-on-click —
+  i.e. the job of a display server's seat + the toolkit's event loop;
+- a demo "agent console" desktop (:func:`build_agent_desktop`) proving the
+  e2e loop the reference sells: watch the agent's GUI, click its buttons,
+  type into its inputs, over /ws/stream + /ws/input.
+
+Frames feed either codec (lossless tiles or the lossy video codec) through
+the existing :class:`helix_tpu.desktop.stream.DesktopSession`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from helix_tpu.desktop.compositor import Compositor
+
+TITLE_H = 22
+_FONT = None
+
+
+def _font():
+    global _FONT
+    if _FONT is None:
+        from PIL import ImageFont
+
+        _FONT = ImageFont.load_default()
+    return _FONT
+
+
+class Widget:
+    """Base widget: a rect inside a window's content area."""
+
+    def __init__(self, x: int, y: int, w: int, h: int):
+        self.x, self.y, self.w, self.h = x, y, w, h
+        self.focused = False
+
+    def contains(self, px: int, py: int) -> bool:
+        return self.x <= px < self.x + self.w and self.y <= py < self.y + self.h
+
+    def draw(self, d) -> None:  # d: PIL ImageDraw
+        raise NotImplementedError
+
+    def on_click(self, lx: int, ly: int) -> None:
+        pass
+
+    def on_key(self, key: str) -> None:
+        pass
+
+    def on_text(self, text: str) -> None:
+        pass
+
+
+class Label(Widget):
+    def __init__(self, x, y, text: str, color=(220, 220, 210)):
+        super().__init__(x, y, 8 * len(text), 14)
+        self.text = text
+        self.color = color
+
+    def draw(self, d):
+        d.text((self.x, self.y), self.text, fill=self.color, font=_font())
+
+
+class Button(Widget):
+    def __init__(self, x, y, w, h, text: str,
+                 on_click: Optional[Callable[[], None]] = None):
+        super().__init__(x, y, w, h)
+        self.text = text
+        self._cb = on_click
+        self.clicks = 0
+
+    def draw(self, d):
+        d.rectangle(
+            [self.x, self.y, self.x + self.w - 1, self.y + self.h - 1],
+            fill=(70, 90, 160), outline=(120, 150, 230),
+        )
+        tw = d.textlength(self.text, font=_font())
+        d.text(
+            (self.x + (self.w - tw) // 2, self.y + (self.h - 12) // 2),
+            self.text, fill=(240, 240, 250), font=_font(),
+        )
+
+    def on_click(self, lx, ly):
+        self.clicks += 1
+        if self._cb:
+            self._cb()
+
+
+class TextInput(Widget):
+    def __init__(self, x, y, w, on_submit: Optional[Callable[[str], None]] = None):
+        super().__init__(x, y, w, 20)
+        self.value = ""
+        self._cb = on_submit
+
+    def draw(self, d):
+        d.rectangle(
+            [self.x, self.y, self.x + self.w - 1, self.y + self.h - 1],
+            fill=(28, 28, 36),
+            outline=(130, 160, 240) if self.focused else (80, 80, 100),
+        )
+        shown = self.value[-max(1, self.w // 8 - 2):]
+        caret = "_" if self.focused else ""
+        d.text((self.x + 4, self.y + 3), shown + caret,
+               fill=(230, 230, 220), font=_font())
+
+    def on_text(self, text):
+        self.value += text
+
+    def on_key(self, key):
+        if key == "Backspace":
+            self.value = self.value[:-1]
+        elif key in ("Enter", "Return"):
+            v, self.value = self.value, ""
+            if self._cb:
+                self._cb(v)
+
+
+class LogView(Widget):
+    """Scrolling text log (the agent's activity feed)."""
+
+    def __init__(self, x, y, w, h, max_lines: int = 500):
+        super().__init__(x, y, w, h)
+        self.lines: List[str] = []
+        self._max = max_lines
+
+    def push(self, text: str) -> None:
+        for chunk in text.splitlines() or [""]:
+            self.lines.append(chunk[:200])
+        self.lines = self.lines[-self._max:]
+
+    def draw(self, d):
+        d.rectangle(
+            [self.x, self.y, self.x + self.w - 1, self.y + self.h - 1],
+            fill=(14, 14, 18), outline=(60, 60, 75),
+        )
+        rows = (self.h - 8) // 13
+        for i, line in enumerate(self.lines[-rows:]):
+            d.text((self.x + 4, self.y + 4 + i * 13), line,
+                   fill=(190, 210, 190), font=_font())
+
+
+class Window:
+    """A titled, draggable window backed by one compositor surface."""
+
+    def __init__(self, title: str, x: int, y: int, w: int, h: int):
+        self.title = title
+        self.x, self.y, self.w, self.h = x, y, w, h
+        self.widgets: List[Widget] = []
+        self.surface_id: int = 0   # assigned by GuiScreenSource
+        self.dirty = True
+        self.focus: Optional[Widget] = None
+
+    def add(self, widget: Widget) -> Widget:
+        self.widgets.append(widget)
+        self.dirty = True
+        return widget
+
+    def render(self) -> np.ndarray:
+        from PIL import Image, ImageDraw
+
+        img = Image.new("RGBA", (self.w, self.h), (34, 34, 44, 255))
+        d = ImageDraw.Draw(img)
+        d.rectangle([0, 0, self.w - 1, TITLE_H - 1], fill=(52, 56, 90))
+        d.text((8, 4), self.title, fill=(235, 235, 245), font=_font())
+        d.rectangle([0, 0, self.w - 1, self.h - 1], outline=(90, 95, 130))
+        for wdg in self.widgets:
+            base_y = wdg.y
+            wdg.y = base_y + TITLE_H
+            try:
+                wdg.draw(d)
+            finally:
+                wdg.y = base_y
+        rgba = np.asarray(img, np.uint8)
+        self.dirty = False
+        return rgba[:, :, [2, 1, 0, 3]].copy()   # -> BGRA
+
+    # -- input (coords local to the window) --------------------------------
+    def click(self, lx: int, ly: int) -> None:
+        cy = ly - TITLE_H
+        for wdg in self.widgets:
+            was = wdg.focused
+            wdg.focused = wdg.contains(lx, cy)
+            if wdg.focused:
+                self.focus = wdg
+            if wdg.focused != was:
+                self.dirty = True
+        if self.focus is not None and self.focus.contains(lx, cy):
+            self.focus.on_click(lx - self.focus.x, cy - self.focus.y)
+            self.dirty = True
+
+
+class GuiScreenSource:
+    """A pixel desktop: windows -> native compositor -> BGRA frames, with
+    pointer/keyboard routing back into the windows (the seat)."""
+
+    def __init__(self, width: int = 960, height: int = 540):
+        self.width = width
+        self.height = height
+        self.comp = Compositor(width, height)
+        self.windows: List[Window] = []
+        self._by_surface: dict[int, Window] = {}
+        self._lock = threading.Lock()
+        self._drag: Optional[Tuple[Window, int, int]] = None
+        self._pointer = (width // 2, height // 2)
+        self.comp.set_cursor(*self._pointer, True)
+        self._input_log: list = []
+
+    def add_window(self, win: Window) -> Window:
+        with self._lock:
+            win.surface_id = self.comp.create_surface(win.w, win.h)
+            self.comp.move(win.surface_id, win.x, win.y)
+            self._by_surface[win.surface_id] = win
+            self.windows.append(win)
+        return win
+
+    def close_window(self, win: Window) -> None:
+        with self._lock:
+            if win.surface_id:
+                self.comp.destroy_surface(win.surface_id)
+                self._by_surface.pop(win.surface_id, None)
+            if win in self.windows:
+                self.windows.remove(win)
+
+    @property
+    def focused_window(self) -> Optional[Window]:
+        with self._lock:
+            return self.windows[-1] if self.windows else None
+
+    def move_window(self, win: Window, x: int, y: int) -> None:
+        """Programmatic move (MCP move_window) — same lock discipline as
+        the input path; the native compositor has no mutex of its own."""
+        with self._lock:
+            win.x, win.y = x, y
+            self.comp.move(win.surface_id, x, y)
+
+    def window_snapshot(self) -> List[dict]:
+        with self._lock:
+            focused = self.windows[-1] if self.windows else None
+            return [
+                {
+                    "title": w.title, "x": w.x, "y": w.y,
+                    "w": w.w, "h": w.h, "focused": w is focused,
+                }
+                for w in self.windows
+            ]
+
+    # -- stream source protocol --------------------------------------------
+    def get_frame(self) -> np.ndarray:
+        with self._lock:
+            for win in self.windows:
+                if win.dirty:
+                    self.comp.attach(win.surface_id, win.render())
+            self.comp.composite()
+            return self.comp.framebuffer
+
+    def input(self, event: dict) -> None:
+        """Pointer/keyboard protocol (shared with the web UI viewer):
+        {"type": "pointer", "x", "y", ["button", "state"]}  move/click
+        {"type": "key", "key": "Backspace"|"Enter"|...}
+        {"type": "text", "text": "..."}
+        """
+        self._input_log.append(event)
+        et = event.get("type")
+        with self._lock:
+            if et == "pointer":
+                x = int(event.get("x", 0))
+                y = int(event.get("y", 0))
+                x = max(0, min(self.width - 1, x))
+                y = max(0, min(self.height - 1, y))
+                self._pointer = (x, y)
+                self.comp.set_cursor(x, y, True)
+                if self._drag is not None and not event.get("button"):
+                    win, dx, dy = self._drag
+                    win.x, win.y = x - dx, y - dy
+                    self.comp.move(win.surface_id, win.x, win.y)
+                if event.get("state") == "up":
+                    self._drag = None
+                    return
+                if event.get("button") == 1 and event.get("state") == "down":
+                    hit = self.comp.hit_test(x, y)
+                    if hit is None:
+                        return
+                    sid, lx, ly = hit
+                    win = self._by_surface.get(sid)
+                    if win is None:
+                        return
+                    self.comp.raise_(sid)
+                    self.windows.remove(win)
+                    self.windows.append(win)
+                    if ly < TITLE_H:
+                        self._drag = (win, lx, ly)
+                    else:
+                        win.click(lx, ly)
+            elif et in ("key", "text"):
+                win = self.windows[-1] if self.windows else None
+                if win is None or win.focus is None:
+                    return
+                if et == "key":
+                    win.focus.on_key(event.get("key", ""))
+                else:
+                    win.focus.on_text(event.get("text", ""))
+                win.dirty = True
+
+
+def build_agent_desktop(width: int = 960, height: int = 540,
+                        on_command: Optional[Callable[[str], None]] = None
+                        ) -> Tuple[GuiScreenSource, dict]:
+    """The demo agent desktop: a console window (activity log + command
+    input), an approval dialog, and a status window.  Returns the source
+    plus handles for tests/agents to drive it."""
+    src = GuiScreenSource(width, height)
+
+    console = Window("agent console", 40, 40, 560, 360)
+    log = console.add(LogView(10, 10, 540, 270))
+    log.push(f"agent console ready {time.strftime('%H:%M:%S')}")
+
+    def submit(cmd: str) -> None:
+        log.push(f"$ {cmd}")
+        if on_command:
+            on_command(cmd)
+
+    entry = console.add(TextInput(10, 290, 460, on_submit=submit))
+    console.add(Button(480, 290, 70, 20, "Run",
+                       on_click=lambda: submit(entry.value)))
+    src.add_window(console)
+
+    approvals = Window("approval", 640, 80, 260, 140)
+    approvals.add(Label(12, 10, "agent requests approval:"))
+    state = {"approved": 0, "denied": 0}
+
+    def approve():
+        state["approved"] += 1
+        log.push("approval GRANTED")
+
+    def deny():
+        state["denied"] += 1
+        log.push("approval DENIED")
+
+    approvals.add(Button(20, 60, 90, 26, "Approve", on_click=approve))
+    approvals.add(Button(140, 60, 90, 26, "Deny", on_click=deny))
+    src.add_window(approvals)
+
+    return src, {
+        "log": log, "entry": entry, "console": console,
+        "approvals": approvals, "state": state,
+    }

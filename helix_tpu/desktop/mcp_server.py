@@ -1,0 +1,245 @@
+"""Per-session desktop MCP server.
+
+The reference runs an MCP server inside each desktop session so editor
+agents (Zed threads, Claude-family tools) can drive the GUI —
+``api/pkg/desktop/mcp_server.go`` (screenshot, type_text, mouse_click,
+clipboard, window management over sway/wlroots) exposed through the
+control plane at a per-session endpoint
+(``api/pkg/server/mcp_backend_desktop.go``).
+
+Ours drives the software compositor desktop (:mod:`helix_tpu.desktop.gui`)
+with the same tool inventory, speaking MCP JSON-RPC 2.0:
+
+- transport A: HTTP POST  ``/api/v1/desktops/{id}/mcp``  (one JSON-RPC
+  message per request — the streamable-HTTP profile the reference's
+  ServeHTTP implements);
+- transport B: stdio loop (:func:`serve_stdio`) so
+  :class:`helix_tpu.agent.mcp.MCPClient` — and any MCP-speaking editor —
+  can spawn it as a subprocess bound to a desktop id.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+from typing import Optional
+
+PROTOCOL_VERSION = "2024-11-05"
+
+
+def _png(frame) -> bytes:
+    """BGRA numpy frame -> PNG bytes."""
+    from PIL import Image
+
+    rgba = frame[:, :, [2, 1, 0, 3]]
+    buf = io.BytesIO()
+    Image.fromarray(rgba, "RGBA").save(buf, "PNG")
+    return buf.getvalue()
+
+
+class DesktopMCPServer:
+    """MCP tool surface over one GUI desktop session."""
+
+    def __init__(self, session):
+        """session: DesktopSession whose source is a GuiScreenSource."""
+        self.session = session
+        self._clipboard = ""
+
+    # -- tool inventory (mirrors mcp_server.go's sway tool set) ------------
+    TOOLS = (
+        {
+            "name": "screenshot",
+            "description": "Capture the desktop as a PNG (base64).",
+            "inputSchema": {"type": "object", "properties": {}},
+        },
+        {
+            "name": "type_text",
+            "description": "Type text into the focused window.",
+            "inputSchema": {
+                "type": "object",
+                "properties": {"text": {"type": "string"}},
+                "required": ["text"],
+            },
+        },
+        {
+            "name": "press_key",
+            "description": "Press a named key (Enter, Backspace, ...).",
+            "inputSchema": {
+                "type": "object",
+                "properties": {"key": {"type": "string"}},
+                "required": ["key"],
+            },
+        },
+        {
+            "name": "mouse_click",
+            "description": "Click at desktop coordinates.",
+            "inputSchema": {
+                "type": "object",
+                "properties": {
+                    "x": {"type": "integer"}, "y": {"type": "integer"},
+                },
+                "required": ["x", "y"],
+            },
+        },
+        {
+            "name": "list_windows",
+            "description": "List windows (title, geometry, focus).",
+            "inputSchema": {"type": "object", "properties": {}},
+        },
+        {
+            "name": "focus_window",
+            "description": "Raise + focus a window by title.",
+            "inputSchema": {
+                "type": "object",
+                "properties": {"title": {"type": "string"}},
+                "required": ["title"],
+            },
+        },
+        {
+            "name": "move_window",
+            "description": "Move a window by title to x, y.",
+            "inputSchema": {
+                "type": "object",
+                "properties": {
+                    "title": {"type": "string"},
+                    "x": {"type": "integer"}, "y": {"type": "integer"},
+                },
+                "required": ["title", "x", "y"],
+            },
+        },
+        {
+            "name": "get_clipboard",
+            "description": "Read the desktop clipboard.",
+            "inputSchema": {"type": "object", "properties": {}},
+        },
+        {
+            "name": "set_clipboard",
+            "description": "Write the desktop clipboard.",
+            "inputSchema": {
+                "type": "object",
+                "properties": {"text": {"type": "string"}},
+                "required": ["text"],
+            },
+        },
+    )
+
+    # -- JSON-RPC ----------------------------------------------------------
+    def handle(self, msg: dict) -> Optional[dict]:
+        """One JSON-RPC message in, one out (None for notifications)."""
+        mid = msg.get("id")
+        method = msg.get("method", "")
+        params = msg.get("params") or {}
+        if mid is None and method:  # notification
+            return None
+        try:
+            if method == "initialize":
+                result = {
+                    "protocolVersion": PROTOCOL_VERSION,
+                    "capabilities": {"tools": {}},
+                    "serverInfo": {
+                        "name": "helix-desktop",
+                        "version": "1.0",
+                    },
+                }
+            elif method == "tools/list":
+                result = {"tools": list(self.TOOLS)}
+            elif method == "tools/call":
+                result = self._call(
+                    params.get("name", ""), params.get("arguments") or {}
+                )
+            elif method == "ping":
+                result = {}
+            else:
+                return {
+                    "jsonrpc": "2.0", "id": mid,
+                    "error": {"code": -32601,
+                              "message": f"unknown method {method!r}"},
+                }
+            return {"jsonrpc": "2.0", "id": mid, "result": result}
+        except Exception as e:  # noqa: BLE001 — tool errors -> MCP error
+            return {
+                "jsonrpc": "2.0", "id": mid,
+                "error": {"code": -32000, "message": str(e)[:500]},
+            }
+
+    # -- tools -------------------------------------------------------------
+    def _src(self):
+        return self.session.source
+
+    def _call(self, name: str, args: dict) -> dict:
+        src = self._src()
+        if name == "screenshot":
+            png = _png(src.get_frame())
+            return {"content": [{
+                "type": "image", "mimeType": "image/png",
+                "data": base64.b64encode(png).decode(),
+            }]}
+        if name == "type_text":
+            src.input({"type": "text", "text": str(args["text"])})
+            return _text("typed")
+        if name == "press_key":
+            src.input({"type": "key", "key": str(args["key"])})
+            return _text(f"pressed {args['key']}")
+        if name == "mouse_click":
+            src.input({
+                "type": "pointer", "x": int(args["x"]), "y": int(args["y"]),
+                "button": 1, "state": "down",
+            })
+            src.input({
+                "type": "pointer", "x": int(args["x"]), "y": int(args["y"]),
+                "state": "up",
+            })
+            return _text(f"clicked {args['x']},{args['y']}")
+        if name == "list_windows":
+            return _text(json.dumps(src.window_snapshot()))
+        if name == "focus_window":
+            w = self._find_window(str(args["title"]))
+            # click the titlebar: raises + focuses through the seat path
+            src.input({
+                "type": "pointer", "x": w.x + 2, "y": w.y + 2,
+                "button": 1, "state": "down",
+            })
+            src.input({"type": "pointer", "x": w.x + 2, "y": w.y + 2,
+                       "state": "up"})
+            return _text(f"focused {w.title}")
+        if name == "move_window":
+            w = self._find_window(str(args["title"]))
+            src.move_window(w, int(args["x"]), int(args["y"]))
+            return _text(f"moved {w.title} to {w.x},{w.y}")
+        if name == "get_clipboard":
+            return _text(self._clipboard)
+        if name == "set_clipboard":
+            self._clipboard = str(args["text"])
+            return _text("ok")
+        raise ValueError(f"unknown tool {name!r}")
+
+    def _find_window(self, title: str):
+        for w in self._src().windows:
+            if w.title == title:
+                return w
+        raise ValueError(f"no window titled {title!r}")
+
+
+def _text(s: str) -> dict:
+    return {"content": [{"type": "text", "text": s}]}
+
+
+def serve_stdio(session) -> None:
+    """Blocking stdio MCP loop (newline-delimited JSON-RPC), the transport
+    MCPClient and editors spawn."""
+    import sys
+
+    srv = DesktopMCPServer(session)
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            msg = json.loads(line)
+        except ValueError:
+            continue
+        out = srv.handle(msg)
+        if out is not None:
+            sys.stdout.write(json.dumps(out) + "\n")
+            sys.stdout.flush()

@@ -72,14 +72,29 @@ class TextScreenSource:
 
 
 class DesktopSession:
-    """One streamed desktop: source + encoder + subscriber fanout."""
+    """One streamed desktop: source + encoder + subscriber fanout.
 
-    def __init__(self, source, fps: float = 10.0, name: str = ""):
+    ``codec`` picks the wire format: "tiles" (lossless damage tiles, the
+    default for text screens) or "video" (the native lossy DCT codec —
+    the software H.264 stand-in for GUI desktops,
+    ``api/pkg/desktop/ws_stream.go:502-530``)."""
+
+    def __init__(self, source, fps: float = 10.0, name: str = "",
+                 codec: str = "tiles"):
         self.id = f"dsk_{uuid.uuid4().hex[:12]}"
         self.name = name
         self.source = source
         self.fps = fps
-        self.encoder = StreamEncoder(source.width, source.height)
+        self.codec = codec
+        if codec == "video":
+            from helix_tpu.desktop.video import VideoEncoder
+
+            self.encoder = VideoEncoder(
+                source.width, source.height, quality=70,
+                target_kbps=2000, fps=fps,
+            )
+        else:
+            self.encoder = StreamEncoder(source.width, source.height)
         self._subs: dict[str, Callable[[bytes], None]] = {}
         self._need_keyframe = False
         self._lock = threading.Lock()
@@ -99,6 +114,11 @@ class DesktopSession:
             self._subs.pop(sid, None)
 
     def handle_input(self, event: dict) -> None:
+        if event.get("type") == "refresh":
+            # a viewer lost a P-frame (backpressure drop) and needs an I
+            with self._lock:
+                self._need_keyframe = True
+            return
         if hasattr(self.source, "input"):
             self.source.input(event)
 
@@ -144,9 +164,20 @@ class DesktopManager:
         self._lock = threading.Lock()
 
     def create(self, name: str = "", fps: float = 10.0,
-               source=None) -> DesktopSession:
-        src = source or TextScreenSource()
-        s = DesktopSession(src, fps=fps, name=name).start()
+               source=None, kind: str = "text",
+               codec: str = "") -> DesktopSession:
+        """kind: "text" (agent terminal) or "gui" (compositor desktop,
+        defaults to the lossy video codec)."""
+        if source is None:
+            if kind == "gui":
+                from helix_tpu.desktop.gui import build_agent_desktop
+
+                source, handles = build_agent_desktop()
+                source.handles = handles
+            else:
+                source = TextScreenSource()
+        codec = codec or ("video" if kind == "gui" else "tiles")
+        s = DesktopSession(source, fps=fps, name=name, codec=codec).start()
         with self._lock:
             self._sessions[s.id] = s
         return s
@@ -159,6 +190,7 @@ class DesktopManager:
             return [
                 {
                     "id": s.id, "name": s.name, "fps": s.fps,
+                    "codec": s.codec,
                     "width": s.source.width, "height": s.source.height,
                     "created": s.created,
                     "stats": s.encoder.stats,

@@ -1,0 +1,149 @@
+"""ctypes bindings for the native lossy video codec (native/vidcodec).
+
+The software encoder standing where the reference's hardware ladder sits
+(``api/pkg/desktop/ws_stream.go:502-530`` nvenc→vaapi→openh264→x264): a
+DCT block codec with I/P frames, 4:2:0 chroma, quantizer rate control.
+Same FFI pattern as :mod:`helix_tpu.desktop.streamcore`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native", "vidcodec",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libhxvid.so")
+_lock = threading.Lock()
+_lib = None
+
+
+def _load():
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.hxv_encoder_create.restype = ctypes.c_void_p
+        lib.hxv_encoder_create.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_float, ctypes.c_int,
+            ctypes.c_float, ctypes.c_int,
+        ]
+        lib.hxv_encoder_destroy.argtypes = [ctypes.c_void_p]
+        lib.hxv_encode.restype = ctypes.c_long
+        lib.hxv_encode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ]
+        lib.hxv_encoder_stats.argtypes = [
+            ctypes.c_void_p] + [ctypes.POINTER(ctypes.c_uint64)] * 4
+        lib.hxv_encoder_qscale.restype = ctypes.c_float
+        lib.hxv_encoder_qscale.argtypes = [ctypes.c_void_p]
+        lib.hxv_decoder_create.restype = ctypes.c_void_p
+        lib.hxv_decoder_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.hxv_decoder_destroy.argtypes = [ctypes.c_void_p]
+        lib.hxv_decode.restype = ctypes.c_int
+        lib.hxv_decode.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long
+        ]
+        lib.hxv_decoder_frame.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.hxv_decoder_frame.argtypes = [ctypes.c_void_p]
+        lib.hxv_decoder_frame_id.restype = ctypes.c_uint32
+        lib.hxv_decoder_frame_id.argtypes = [ctypes.c_void_p]
+        lib.hxv_decoder_frame_type.restype = ctypes.c_int
+        lib.hxv_decoder_frame_type.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+class VideoEncoder:
+    """Lossy I/P-frame encoder. Frames: uint8 [H, W, 4] BGRA.
+
+    Unlike the lossless tile codec, EVERY call yields a packet (P-frames of
+    an unchanged screen are a few bytes of skip flags)."""
+
+    def __init__(self, width: int, height: int, quality: float = 70.0,
+                 target_kbps: int = 0, fps: float = 10.0,
+                 kf_interval: int = 100):
+        self._lib = _load()
+        self._h = self._lib.hxv_encoder_create(
+            width, height, quality, target_kbps, fps, kf_interval
+        )
+        if not self._h:
+            raise ValueError("bad encoder dimensions")
+        self.width = width
+        self.height = height
+
+    def encode(self, frame: np.ndarray, keyframe: bool = False) -> bytes:
+        frame = np.ascontiguousarray(frame, dtype=np.uint8)
+        assert frame.shape == (self.height, self.width, 4), frame.shape
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.hxv_encode(
+            self._h, frame.tobytes(), 1 if keyframe else 0, ctypes.byref(out)
+        )
+        if n <= 0:
+            raise RuntimeError(f"encode failed: {n}")
+        return ctypes.string_at(out, n)
+
+    @property
+    def stats(self) -> dict:
+        v = [ctypes.c_uint64() for _ in range(4)]
+        self._lib.hxv_encoder_stats(self._h, *[ctypes.byref(x) for x in v])
+        return {
+            "frames": v[0].value, "bytes_out": v[1].value,
+            "coded_mbs": v[2].value, "skipped_mbs": v[3].value,
+            "qscale": round(self._lib.hxv_encoder_qscale(self._h), 3),
+        }
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hxv_encoder_destroy(self._h)
+            self._h = None
+
+
+class VideoDecoder:
+    def __init__(self, width: int, height: int):
+        self._lib = _load()
+        self._h = self._lib.hxv_decoder_create(width, height)
+        if not self._h:
+            raise ValueError("bad decoder dimensions")
+        self.width = width
+        self.height = height
+
+    def decode(self, packet: bytes) -> np.ndarray:
+        rc = self._lib.hxv_decode(self._h, packet, len(packet))
+        if rc != 0:
+            raise RuntimeError(f"decode failed: {rc}")
+        return self.frame
+
+    @property
+    def frame(self) -> np.ndarray:
+        ptr = self._lib.hxv_decoder_frame(self._h)
+        buf = ctypes.string_at(ptr, self.width * self.height * 4)
+        return np.frombuffer(buf, np.uint8).reshape(
+            self.height, self.width, 4
+        )
+
+    @property
+    def frame_id(self) -> int:
+        return self._lib.hxv_decoder_frame_id(self._h)
+
+    @property
+    def frame_type(self) -> str:
+        return "I" if self._lib.hxv_decoder_frame_type(self._h) == 0 else "P"
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.hxv_decoder_destroy(self._h)
+            self._h = None

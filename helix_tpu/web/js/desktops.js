@@ -1,42 +1,68 @@
-/* Desktop stream viewer: tile-codec frames over WS onto a canvas,
- * keyboard input back (reference: DesktopStreamViewer.tsx). */
+/* Desktop stream viewer: tile-codec or video-codec frames over WS onto a
+ * canvas, pointer + keyboard input back
+ * (reference: DesktopStreamViewer.tsx + helix-stream WebCodecs worker). */
 import {$, api} from "./core.js";
+import {HxvDecoder} from "./vidcodec.js";
 
 export async function render(m) {
   const {desktops} = await api("/api/v1/desktops");
-  const list = $(`<div class="panel"><h3>Agent desktops</h3><div id="dl"></div></div>`);
+  const list = $(`<div class="panel"><h3>Agent desktops</h3>
+    <div id="dl"></div>
+    <div class="row" style="margin-top:6px">
+      <button id="newgui" class="ghost">+ GUI desktop</button>
+    </div></div>`);
   m.appendChild(list);
   const dl = list.querySelector("#dl");
   if (!desktops.length) dl.textContent = "No live desktops. They appear while task agents run.";
   for (const d of desktops) {
     const b = $(`<button class="ghost" style="margin:4px"></button>`);
-    b.textContent = d.name || d.id;
+    b.textContent = `${d.name || d.id} [${d.codec || "tiles"}]`;
     b.onclick = () => watch(d);
     dl.appendChild(b);
   }
-  const view = $(`<div class="panel"><canvas id="cv" width="960" height="540"></canvas>
+  list.querySelector("#newgui").onclick = async () => {
+    const d = await api("/api/v1/desktops", {method: "POST",
+      body: JSON.stringify({kind: "gui", name: "gui-desktop"})});
+    watch(d);
+  };
+  const view = $(`<div class="panel"><canvas id="cv" width="960" height="540" tabindex="0"
+      style="outline:none;max-width:100%"></canvas>
     <div class="row" style="margin-top:8px">
       <input id="inp" class="grow" placeholder="type to the agent...">
     </div></div>`);
   m.appendChild(view);
   let inputWs = null, streamWs = null;
+
   async function watch(d) {
     if (streamWs) { streamWs.close(); streamWs = null; }
     if (inputWs) { inputWs.close(); inputWs = null; }
     const cv = view.querySelector("#cv");
-    cv.width = d.width; cv.height = d.height;
+    cv.width = d.width || 960; cv.height = d.height || 540;
     const ctx = cv.getContext("2d");
     ctx.clearRect(0, 0, cv.width, cv.height);
+    const vdec = new HxvDecoder(cv.width, cv.height);
     const proto = location.protocol === "https:" ? "wss" : "ws";
     const ws = new WebSocket(`${proto}://${location.host}/api/v1/desktops/${d.id}/ws/stream`);
     ws.binaryType = "arraybuffer";
     streamWs = ws;
     inputWs = new WebSocket(`${proto}://${location.host}/api/v1/desktops/${d.id}/ws/input`);
+    let lastKfReq = 0;
+    const send = (o) => { if (inputWs?.readyState === 1) inputWs.send(JSON.stringify(o)); };
     ws.onmessage = async (ev) => {
-      const buf = new Uint8Array(ev.data);
       const dv = new DataView(ev.data);
-      if (dv.getUint32(0, true) !== 0x31465848) return;
-      // header: magic(4) frame_id(4) w(2) h(2) ntiles(2) kf(1) res(1) = 16
+      const magic = dv.getUint32(0, true);
+      if (magic === 0x31565848) {              // 'HXV1' lossy video
+        const img = await vdec.decode(ev.data);
+        if (img) ctx.putImageData(img, 0, 0);
+        else if (vdec.needKeyframe && Date.now() - lastKfReq > 500) {
+          // a P-frame was dropped under backpressure: re-sync with an I
+          lastKfReq = Date.now();
+          send({type: "refresh"});
+        }
+        return;
+      }
+      if (magic !== 0x31465848) return;        // 'HXF1' lossless tiles
+      const buf = new Uint8Array(ev.data);
       const W = dv.getUint16(8, true), H = dv.getUint16(10, true),
             NT = dv.getUint16(12, true);
       const tiles = [];
@@ -60,6 +86,20 @@ export async function render(m) {
         ctx.putImageData(img, tx*32, ty*32);
         off += tw*th*4;
       }
+    };
+    const pos = (e) => {
+      const r = cv.getBoundingClientRect();
+      return {x: Math.round((e.clientX - r.left) * cv.width / r.width),
+              y: Math.round((e.clientY - r.top) * cv.height / r.height)};
+    };
+    cv.onmousemove = (e) => send({type: "pointer", ...pos(e)});
+    cv.onmousedown = (e) => { cv.focus();
+      send({type: "pointer", ...pos(e), button: 1, state: "down"}); };
+    cv.onmouseup = (e) => send({type: "pointer", ...pos(e), button: 1, state: "up"});
+    cv.onkeydown = (e) => {
+      if (e.key.length === 1) send({type: "text", text: e.key});
+      else send({type: "key", key: e.key});
+      e.preventDefault();
     };
     view.querySelector("#inp").onkeydown = (e) => {
       if (e.key === "Enter" && inputWs?.readyState === 1) {

@@ -1,0 +1,176 @@
+/* JS decoder for the native lossy video codec (native/vidcodec — 'HXV1').
+ * Mirrors the C++ decoder: zlib payload -> per-macroblock skip/intra flags
+ * -> (run,level) RLE -> dequant -> 8x8 IDCT -> YCbCr 4:2:0 -> RGBA canvas.
+ * The browser-side half of the reference's WebCodecs worker
+ * (frontend/src/lib/helix-stream/), implemented for our bitstream. */
+
+const QLUMA = [
+  16,11,10,16,24,40,51,61, 12,12,14,19,26,58,60,55,
+  14,13,16,24,40,57,69,56, 14,17,22,29,51,87,80,62,
+  18,22,37,56,68,109,103,77, 24,35,55,64,81,104,113,92,
+  49,64,78,87,103,121,120,101, 72,92,95,98,112,100,103,99];
+const QCHROMA = [
+  17,18,24,47,99,99,99,99, 18,21,26,66,99,99,99,99,
+  24,26,56,99,99,99,99,99, 47,66,99,99,99,99,99,99,
+  99,99,99,99,99,99,99,99, 99,99,99,99,99,99,99,99,
+  99,99,99,99,99,99,99,99, 99,99,99,99,99,99,99,99];
+const ZIGZAG = [
+  0,1,8,16,9,2,3,10,17,24,32,25,18,11,4,5,
+  12,19,26,33,40,48,41,34,27,20,13,6,7,14,21,28,
+  35,42,49,56,57,50,43,36,29,22,15,23,30,37,44,51,
+  58,59,52,45,38,31,39,46,53,60,61,54,47,55,62,63];
+
+const COS = [];
+for (let u = 0; u < 8; u++) {
+  const a = u === 0 ? Math.sqrt(0.125) : 0.5;
+  COS.push(Array.from({length: 8},
+    (_, x) => a * Math.cos((2*x + 1) * u * Math.PI / 16)));
+}
+
+function idct8x8(coef, out) {
+  const tmp = new Float32Array(64);
+  for (let v = 0; v < 8; v++)
+    for (let y = 0; y < 8; y++) {
+      let s = 0;
+      for (let u = 0; u < 8; u++) s += coef[u*8 + v] * COS[u][y];
+      tmp[y*8 + v] = s;
+    }
+  for (let y = 0; y < 8; y++)
+    for (let x = 0; x < 8; x++) {
+      let s = 0;
+      for (let u = 0; u < 8; u++) s += tmp[y*8 + u] * COS[u][x];
+      out[y*8 + x] = s;
+    }
+}
+
+class Reader {
+  constructor(buf) { this.b = buf; this.i = 0; this.ok = true; }
+  u8() {
+    if (this.i >= this.b.length) { this.ok = false; return 0; }
+    return this.b[this.i++];
+  }
+  varint() {
+    let v = 0, shift = 0;
+    for (;;) {
+      if (this.i >= this.b.length || shift > 28) { this.ok = false; return 0; }
+      const byte = this.b[this.i++];
+      v |= (byte & 0x7f) << shift;
+      if (!(byte & 0x80)) break;
+      shift += 7;
+    }
+    return (v >>> 1) ^ -(v & 1);
+  }
+}
+
+function decodeBlock(br, qbase, qscale, dst, stride, ox, oy) {
+  const q = new Float32Array(64);
+  let i = 0;
+  for (;;) {
+    const run = br.u8();
+    if (!br.ok) return false;
+    if (run === 255) break;
+    i += run;
+    if (i >= 64) return false;
+    q[ZIGZAG[i]] = br.varint();
+    i++;
+  }
+  const deq = new Float32Array(64), rec = new Float32Array(64);
+  for (let k = 0; k < 64; k++)
+    deq[k] = q[k] * Math.max(qbase[k] * qscale, 1);
+  idct8x8(deq, rec);
+  for (let y = 0; y < 8; y++)
+    for (let x = 0; x < 8; x++) {
+      const v = Math.round(rec[y*8 + x] + 128);
+      dst[(oy + y) * stride + ox + x] = v < 0 ? 0 : (v > 255 ? 255 : v);
+    }
+  return true;
+}
+
+export class HxvDecoder {
+  constructor(w, h) {
+    this.sw = w; this.sh = h;
+    this.w = Math.ceil(w / 16) * 16;
+    this.h = Math.ceil(h / 16) * 16;
+    this.mbx = this.w / 16; this.mby = this.h / 16;
+    this.Y = new Uint8Array(this.w * this.h);
+    this.Cb = new Uint8Array(this.w * this.h / 4).fill(128);
+    this.Cr = new Uint8Array(this.w * this.h / 4).fill(128);
+    this.haveFrame = false;
+    this.frameId = 0;
+    this.needKeyframe = false;  // set on P-frame gap; viewer should ask for an I
+    this._chain = Promise.resolve(null);
+  }
+
+  /* Serialized decode: packets must apply in arrival order, but each
+   * decode awaits DecompressionStream — chain them so a small P-frame
+   * can never overtake a large keyframe onto the shared planes. */
+  decode(packet) {
+    this._chain = this._chain.catch(() => null)
+      .then(() => this._decode(packet));
+    return this._chain;
+  }
+
+  async _decode(packet) {
+    const dv = new DataView(packet);
+    if (dv.getUint32(0, true) !== 0x31565848) return null;  // 'HXV1'
+    const type = dv.getUint8(12);
+    const fid = dv.getUint32(4, true);
+    if (type === 1 && !this.haveFrame) { this.needKeyframe = true; return null; }
+    if (type === 1 && fid !== this.frameId + 1) {
+      // a P-frame was dropped upstream (server ring buffer under
+      // backpressure): our reconstruction has diverged — freeze and ask
+      // for a keyframe instead of painting garbage until kf_interval
+      this.needKeyframe = true;
+      return null;
+    }
+    const qscale = dv.getFloat32(14, true);
+    const comp = new Uint8Array(packet, 22);
+    const ds = new DecompressionStream("deflate");
+    const stream = new Blob([comp]).stream().pipeThrough(ds);
+    const raw = new Uint8Array(await new Response(stream).arrayBuffer());
+    const br = new Reader(raw);
+    const cw = this.w / 2;
+    let codedMbs = 0;
+    for (let my = 0; my < this.mby; my++)
+      for (let mx = 0; mx < this.mbx; mx++) {
+        const flags = br.u8();
+        if (!br.ok) return null;
+        if (flags === 0) continue;
+        codedMbs++;
+        const px = mx * 16, py = my * 16;
+        for (let by = 0; by < 2; by++)
+          for (let bx = 0; bx < 2; bx++)
+            if (!decodeBlock(br, QLUMA, qscale, this.Y, this.w,
+                             px + bx*8, py + by*8)) return null;
+        if (!decodeBlock(br, QCHROMA, qscale, this.Cb, cw, px/2, py/2))
+          return null;
+        if (!decodeBlock(br, QCHROMA, qscale, this.Cr, cw, px/2, py/2))
+          return null;
+      }
+    this.haveFrame = true;
+    this.frameId = fid;
+    this.needKeyframe = false;
+    // all-skip P-frame: the screen is unchanged — skip the full-frame
+    // color conversion + canvas upload entirely
+    if (type === 1 && codedMbs === 0) return null;
+    // YCbCr -> RGBA
+    const img = new ImageData(this.sw, this.sh);
+    const d = img.data;
+    for (let y = 0; y < this.sh; y++)
+      for (let x = 0; x < this.sw; x++) {
+        const Y = this.Y[y * this.w + x];
+        const cb = this.Cb[(y >> 1) * cw + (x >> 1)] - 128;
+        const cr = this.Cr[(y >> 1) * cw + (x >> 1)] - 128;
+        const c = (Y - 16) * 298;
+        let r = (c + 409*cr + 128) >> 8,
+            g = (c - 100*cb - 208*cr + 128) >> 8,
+            b = (c + 516*cb + 128) >> 8;
+        const o = (y * this.sw + x) * 4;
+        d[o]   = r < 0 ? 0 : (r > 255 ? 255 : r);
+        d[o+1] = g < 0 ? 0 : (g > 255 ? 255 : g);
+        d[o+2] = b < 0 ? 0 : (b > 255 ? 255 : b);
+        d[o+3] = 255;
+      }
+    return img;
+  }
+}

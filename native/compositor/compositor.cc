@@ -1,0 +1,234 @@
+// helix-tpu software compositor.
+//
+// The native compositor for agent GUI desktops — the C++ counterpart of
+// the reference's headless Wayland compositor
+// (desktop/wayland-display-core/src/lib.rs:28-40, which renders client
+// surfaces into GStreamer buffers).  No GPU and no Wayland protocol here;
+// clients are in-process apps that attach BGRA buffers to surfaces, and
+// the compositor:
+//
+//   - keeps a z-ordered list of surfaces (position, size, visibility);
+//   - alpha-blends them back-to-front into a BGRA framebuffer, over an
+//     opaque background color;
+//   - overlays a software cursor (drawn arrow, no hardware plane);
+//   - answers hit tests (screen point -> topmost surface + local coords)
+//     so the input path can route pointer events to the right app, the
+//     job wlroots' scene-graph does for the reference;
+//   - tracks a coarse damage flag per composite so callers can skip
+//     encoding entirely when nothing changed.
+//
+// The composed framebuffer feeds either codec (tile or video) and streams
+// over the existing /ws/stream path.  C ABI via ctypes
+// (helix_tpu/desktop/compositor.py); one instance per desktop session.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Surface {
+  uint32_t id;
+  int x = 0, y = 0;
+  int w, h;
+  bool visible = true;
+  std::vector<uint8_t> buf;  // BGRA, straight alpha
+};
+
+// 12x19 arrow cursor mask: 0 transparent, 1 black fill, 2 white outline
+const char* kCursor[19] = {
+    "2           ", "22          ", "212         ", "2112        ",
+    "21112       ", "211112      ", "2111112     ", "21111112    ",
+    "211111112   ", "2111111112  ", "21111111112 ", "211111222222",
+    "2111211     ", "211 2112    ", "21  2112    ", "2    2112   ",
+    "     2112   ", "      22    ", "            "};
+
+struct Compositor {
+  int w, h;
+  std::vector<uint8_t> fb;       // BGRA
+  std::vector<Surface> zorder;   // back ... front
+  uint32_t next_id = 1;
+  int cursor_x = 0, cursor_y = 0;
+  bool cursor_visible = false;
+  uint64_t composites = 0;
+  bool dirty = true;
+
+  Surface* find(uint32_t id) {
+    for (auto& s : zorder)
+      if (s.id == id) return &s;
+    return nullptr;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hxc_create(int w, int h) {
+  if (w <= 0 || h <= 0 || w > 8192 || h > 8192) return nullptr;
+  auto* c = new Compositor();
+  c->w = w;
+  c->h = h;
+  c->fb.assign((size_t)w * h * 4, 0);
+  return c;
+}
+
+void hxc_destroy(void* h) { delete (Compositor*)h; }
+
+uint32_t hxc_surface_create(void* hc, int w, int h) {
+  auto* c = (Compositor*)hc;
+  if (w <= 0 || h <= 0 || w > 8192 || h > 8192) return 0;
+  Surface s;
+  s.id = c->next_id++;
+  s.w = w;
+  s.h = h;
+  s.buf.assign((size_t)w * h * 4, 0);
+  c->zorder.push_back(std::move(s));
+  c->dirty = true;
+  return c->zorder.back().id;
+}
+
+int hxc_surface_destroy(void* hc, uint32_t id) {
+  auto* c = (Compositor*)hc;
+  for (auto it = c->zorder.begin(); it != c->zorder.end(); ++it)
+    if (it->id == id) {
+      c->zorder.erase(it);
+      c->dirty = true;
+      return 0;
+    }
+  return -1;
+}
+
+int hxc_surface_attach(void* hc, uint32_t id, const uint8_t* bgra) {
+  auto* c = (Compositor*)hc;
+  Surface* s = c->find(id);
+  if (!s) return -1;
+  memcpy(s->buf.data(), bgra, s->buf.size());
+  c->dirty = true;
+  return 0;
+}
+
+int hxc_surface_move(void* hc, uint32_t id, int x, int y) {
+  auto* c = (Compositor*)hc;
+  Surface* s = c->find(id);
+  if (!s) return -1;
+  s->x = x;
+  s->y = y;
+  c->dirty = true;
+  return 0;
+}
+
+int hxc_surface_raise(void* hc, uint32_t id) {
+  auto* c = (Compositor*)hc;
+  for (size_t i = 0; i < c->zorder.size(); ++i)
+    if (c->zorder[i].id == id) {
+      Surface s = std::move(c->zorder[i]);
+      c->zorder.erase(c->zorder.begin() + i);
+      c->zorder.push_back(std::move(s));
+      c->dirty = true;
+      return 0;
+    }
+  return -1;
+}
+
+int hxc_surface_set_visible(void* hc, uint32_t id, int visible) {
+  auto* c = (Compositor*)hc;
+  Surface* s = c->find(id);
+  if (!s) return -1;
+  s->visible = visible != 0;
+  c->dirty = true;
+  return 0;
+}
+
+void hxc_set_cursor(void* hc, int x, int y, int visible) {
+  auto* c = (Compositor*)hc;
+  c->cursor_x = x;
+  c->cursor_y = y;
+  c->cursor_visible = visible != 0;
+  c->dirty = true;
+}
+
+// Composite back-to-front; returns 1 if the framebuffer changed since the
+// previous composite, 0 if callers may skip encoding.
+int hxc_composite(void* hc, uint8_t bg_b, uint8_t bg_g, uint8_t bg_r) {
+  auto* c = (Compositor*)hc;
+  if (!c->dirty) return 0;
+  // background
+  for (size_t i = 0; i < c->fb.size(); i += 4) {
+    c->fb[i] = bg_b;
+    c->fb[i + 1] = bg_g;
+    c->fb[i + 2] = bg_r;
+    c->fb[i + 3] = 255;
+  }
+  for (const auto& s : c->zorder) {
+    if (!s.visible) continue;
+    int x0 = std::max(0, -s.x), y0 = std::max(0, -s.y);
+    int x1 = std::min(s.w, c->w - s.x), y1 = std::min(s.h, c->h - s.y);
+    for (int sy = y0; sy < y1; ++sy) {
+      const uint8_t* src = &s.buf[((size_t)sy * s.w + x0) * 4];
+      uint8_t* dst = &c->fb[(((size_t)(s.y + sy)) * c->w + s.x + x0) * 4];
+      for (int sx = x0; sx < x1; ++sx, src += 4, dst += 4) {
+        unsigned a = src[3];
+        if (a == 255) {
+          dst[0] = src[0];
+          dst[1] = src[1];
+          dst[2] = src[2];
+        } else if (a) {
+          unsigned ia = 255 - a;
+          dst[0] = (uint8_t)((src[0] * a + dst[0] * ia + 127) / 255);
+          dst[1] = (uint8_t)((src[1] * a + dst[1] * ia + 127) / 255);
+          dst[2] = (uint8_t)((src[2] * a + dst[2] * ia + 127) / 255);
+        }
+      }
+    }
+  }
+  if (c->cursor_visible) {
+    for (int cy = 0; cy < 19; ++cy) {
+      int py = c->cursor_y + cy;
+      if (py < 0 || py >= c->h) continue;
+      for (int cx = 0; cx < 12; ++cx) {
+        char m = kCursor[cy][cx];
+        if (m == ' ') continue;
+        int px = c->cursor_x + cx;
+        if (px < 0 || px >= c->w) continue;
+        uint8_t* dst = &c->fb[((size_t)py * c->w + px) * 4];
+        uint8_t v = m == '2' ? 255 : 20;
+        dst[0] = dst[1] = dst[2] = v;
+      }
+    }
+  }
+  ++c->composites;
+  c->dirty = false;
+  return 1;
+}
+
+const uint8_t* hxc_framebuffer(void* hc) {
+  return ((Compositor*)hc)->fb.data();
+}
+
+// Topmost visible surface containing (x, y); fills surface id + local
+// coords. Returns 0 when the point hits only the background.
+uint32_t hxc_hit_test(void* hc, int x, int y, int* lx, int* ly) {
+  auto* c = (Compositor*)hc;
+  for (auto it = c->zorder.rbegin(); it != c->zorder.rend(); ++it) {
+    if (!it->visible) continue;
+    if (x >= it->x && x < it->x + it->w && y >= it->y && y < it->y + it->h) {
+      if (lx) *lx = x - it->x;
+      if (ly) *ly = y - it->y;
+      return it->id;
+    }
+  }
+  return 0;
+}
+
+uint64_t hxc_composite_count(void* hc) {
+  return ((Compositor*)hc)->composites;
+}
+
+int hxc_surface_count(void* hc) {
+  return (int)((Compositor*)hc)->zorder.size();
+}
+
+}  // extern "C"

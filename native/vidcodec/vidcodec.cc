@@ -1,0 +1,540 @@
+// helix-tpu lossy video codec.
+//
+// The "real video codec" leg of the desktop streaming path — the software
+// stand-in for the reference's hardware encoder ladder (nvenc -> vaapi ->
+// openh264 -> x264, api/pkg/desktop/ws_stream.go:502-530).  This build has
+// no GPU and no GStreamer, so the codec is implemented from first
+// principles as a block-transform video codec in the H.261/MJPEG family,
+// tuned for desktop/agent-GUI content:
+//
+//   - BGRA input -> YCbCr 4:2:0 (integer BT.601), 16x16 macroblocks;
+//   - I-frames: every macroblock intra-coded with an 8x8 DCT, JPEG-style
+//     quantization (quality-scaled matrices, separate luma/chroma);
+//   - P-frames: conditional replenishment — macroblocks whose luma SAD
+//     against the encoder's *reconstructed* previous frame is under a
+//     threshold are SKIPped (1 bit-ish), the rest are intra-coded.  The
+//     encoder reconstructs exactly what the decoder will, so skip
+//     decisions never drift;
+//   - entropy stage: zigzag scan, (run,level) RLE with varint levels,
+//     then one zlib deflate over the whole frame payload;
+//   - rate control: a proportional controller nudges the quantizer scale
+//     toward a target bytes/frame budget (target_kbps / fps), clamped to
+//     [0.25, 8].  Keyframes may overshoot (late joiners need one);
+//   - keyframe cadence: forced keyframe every kf_interval frames and on
+//     demand (subscriber join), like any streaming codec.
+//
+// Packet layout (little-endian):
+//   u32 magic 'HXV1' | u32 frame_id | u16 w | u16 h | u8 type (0=I,1=P)
+//   | u8 reserved | f32 qscale | u32 raw_len | zlib(payload)
+//   payload: per-MB in raster order — u8 flags (0=skip, 1=coded); coded
+//   MBs follow with 6 RLE-coded 8x8 blocks (4 Y, 1 Cb, 1 Cr).
+//
+// Exported as a C ABI consumed via ctypes (helix_tpu/desktop/video.py).
+// One encoder/decoder per session; no globals, no threads — Python owns
+// pacing, the browser decodes the same bitstream in a worker
+// (helix_tpu/web/js/vidcodec.js).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+#include <zlib.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x31565848;  // 'HXV1' little-endian
+constexpr int kMB = 16;                  // macroblock edge (luma)
+
+#pragma pack(push, 1)
+struct Header {
+  uint32_t magic;
+  uint32_t frame_id;
+  uint16_t width;
+  uint16_t height;
+  uint8_t type;  // 0 = I, 1 = P
+  uint8_t reserved;
+  float qscale;
+  uint32_t raw_len;
+};
+#pragma pack(pop)
+
+// JPEG Annex K base quantization matrices (public domain constants).
+const int kQLuma[64] = {
+    16, 11, 10, 16, 24,  40,  51,  61,  12, 12, 14, 19, 26,  58,  60,  55,
+    14, 13, 16, 24, 40,  57,  69,  56,  14, 17, 22, 29, 51,  87,  80,  62,
+    18, 22, 37, 56, 68,  109, 103, 77,  24, 35, 55, 64, 81,  104, 113, 92,
+    49, 64, 78, 87, 103, 121, 120, 101, 72, 92, 95, 98, 112, 100, 103, 99};
+const int kQChroma[64] = {
+    17, 18, 24, 47, 99, 99, 99, 99, 18, 21, 26, 66, 99, 99, 99, 99,
+    24, 26, 56, 99, 99, 99, 99, 99, 47, 66, 99, 99, 99, 99, 99, 99,
+    99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99,
+    99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99, 99};
+
+const int kZigzag[64] = {
+    0,  1,  8,  16, 9,  2,  3,  10, 17, 24, 32, 25, 18, 11, 4,  5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6,  7,  14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63};
+
+// 8-point DCT-II basis, precomputed: c[u][x] = a(u) cos((2x+1)u pi / 16).
+struct DctTables {
+  float c[8][8];
+  DctTables() {
+    for (int u = 0; u < 8; ++u) {
+      float a = (u == 0) ? std::sqrt(0.125f) : 0.5f;
+      for (int x = 0; x < 8; ++x)
+        c[u][x] = a * std::cos((2 * x + 1) * u * M_PI / 16.0f);
+    }
+  }
+};
+const DctTables kDct;
+
+void fdct8x8(const float in[64], float out[64]) {
+  float tmp[64];
+  for (int y = 0; y < 8; ++y)            // rows
+    for (int u = 0; u < 8; ++u) {
+      float s = 0;
+      for (int x = 0; x < 8; ++x) s += in[y * 8 + x] * kDct.c[u][x];
+      tmp[y * 8 + u] = s;
+    }
+  for (int u = 0; u < 8; ++u)            // cols
+    for (int v = 0; v < 8; ++v) {
+      float s = 0;
+      for (int y = 0; y < 8; ++y) s += tmp[y * 8 + u] * kDct.c[v][y];
+      out[v * 8 + u] = s;
+    }
+}
+
+void idct8x8(const float in[64], float out[64]) {
+  float tmp[64];
+  for (int v = 0; v < 8; ++v)            // cols
+    for (int y = 0; y < 8; ++y) {
+      float s = 0;
+      for (int u = 0; u < 8; ++u) s += in[u * 8 + v] * kDct.c[u][y];
+      tmp[y * 8 + v] = s;
+    }
+  for (int y = 0; y < 8; ++y)            // rows
+    for (int x = 0; x < 8; ++x) {
+      float s = 0;
+      for (int u = 0; u < 8; ++u) s += tmp[y * 8 + u] * kDct.c[u][x];
+      out[y * 8 + x] = s;
+    }
+}
+
+inline uint8_t clamp_u8(float v) {
+  return (uint8_t)(v < 0 ? 0 : (v > 255 ? 255 : v + 0.5f));
+}
+
+// Planar YCbCr 4:2:0 frame.
+struct Planes {
+  int w, h;      // luma dims (padded to MB multiple)
+  std::vector<uint8_t> y, cb, cr;
+  void init(int W, int H) {
+    w = W;
+    h = H;
+    y.assign((size_t)w * h, 0);
+    cb.assign((size_t)(w / 2) * (h / 2), 128);
+    cr.assign((size_t)(w / 2) * (h / 2), 128);
+  }
+};
+
+void bgra_to_planes(const uint8_t* bgra, int src_w, int src_h, Planes& p) {
+  // BT.601 integer, replicate-pad to the MB-aligned plane size.
+  for (int yy = 0; yy < p.h; ++yy) {
+    int sy = yy < src_h ? yy : src_h - 1;
+    for (int xx = 0; xx < p.w; ++xx) {
+      int sx = xx < src_w ? xx : src_w - 1;
+      const uint8_t* px = bgra + ((size_t)sy * src_w + sx) * 4;
+      int b = px[0], g = px[1], r = px[2];
+      p.y[(size_t)yy * p.w + xx] =
+          (uint8_t)((66 * r + 129 * g + 25 * b + 128 + 4096) >> 8);
+    }
+  }
+  int cw = p.w / 2, ch = p.h / 2;
+  for (int cy = 0; cy < ch; ++cy) {
+    for (int cx = 0; cx < cw; ++cx) {
+      // average the 2x2 site in source space (clamped)
+      int rs = 0, gs = 0, bs = 0;
+      for (int dy = 0; dy < 2; ++dy)
+        for (int dx = 0; dx < 2; ++dx) {
+          int sy = std::min(cy * 2 + dy, src_h - 1);
+          int sx = std::min(cx * 2 + dx, src_w - 1);
+          const uint8_t* px = bgra + ((size_t)sy * src_w + sx) * 4;
+          bs += px[0];
+          gs += px[1];
+          rs += px[2];
+        }
+      int r = rs >> 2, g = gs >> 2, b = bs >> 2;
+      p.cb[(size_t)cy * cw + cx] =
+          (uint8_t)(((-38 * r - 74 * g + 112 * b + 128) >> 8) + 128);
+      p.cr[(size_t)cy * cw + cx] =
+          (uint8_t)(((112 * r - 94 * g - 18 * b + 128) >> 8) + 128);
+    }
+  }
+}
+
+void planes_to_bgra(const Planes& p, int dst_w, int dst_h, uint8_t* bgra) {
+  int cw = p.w / 2;
+  for (int yy = 0; yy < dst_h; ++yy) {
+    for (int xx = 0; xx < dst_w; ++xx) {
+      int Y = p.y[(size_t)yy * p.w + xx];
+      int Cb = p.cb[(size_t)(yy / 2) * cw + xx / 2] - 128;
+      int Cr = p.cr[(size_t)(yy / 2) * cw + xx / 2] - 128;
+      int c = (Y - 16) * 298;
+      int r = (c + 409 * Cr + 128) >> 8;
+      int g = (c - 100 * Cb - 208 * Cr + 128) >> 8;
+      int b = (c + 516 * Cb + 128) >> 8;
+      uint8_t* px = bgra + ((size_t)yy * dst_w + xx) * 4;
+      px[0] = clamp_u8((float)b);
+      px[1] = clamp_u8((float)g);
+      px[2] = clamp_u8((float)r);
+      px[3] = 255;
+    }
+  }
+}
+
+// --- bitstream helpers ------------------------------------------------
+
+void put_varint(std::vector<uint8_t>& out, int32_t sv) {
+  // zigzag-map signed, then LEB128
+  uint32_t v = ((uint32_t)sv << 1) ^ (uint32_t)(sv >> 31);
+  while (v >= 0x80) {
+    out.push_back((uint8_t)(v | 0x80));
+    v >>= 7;
+  }
+  out.push_back((uint8_t)v);
+}
+
+struct ByteReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+  uint8_t u8() {
+    if (p >= end) {
+      ok = false;
+      return 0;
+    }
+    return *p++;
+  }
+  int32_t varint() {
+    uint32_t v = 0;
+    int shift = 0;
+    while (true) {
+      if (p >= end || shift > 28) {
+        ok = false;
+        return 0;
+      }
+      uint8_t b = *p++;
+      v |= (uint32_t)(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    return (int32_t)(v >> 1) ^ -(int32_t)(v & 1);
+  }
+};
+
+// Quantize + RLE one 8x8 block; also produce the reconstructed pixels the
+// decoder will see (for the encoder's reference frame).
+void code_block(const uint8_t* src, int stride, const int* qbase,
+                float qscale, std::vector<uint8_t>& out, uint8_t* recon,
+                int rstride) {
+  float px[64], coef[64];
+  for (int y = 0; y < 8; ++y)
+    for (int x = 0; x < 8; ++x)
+      px[y * 8 + x] = (float)src[y * stride + x] - 128.0f;
+  fdct8x8(px, coef);
+  int16_t q[64];
+  for (int i = 0; i < 64; ++i) {
+    float qs = qbase[i] * qscale;
+    if (qs < 1) qs = 1;
+    q[i] = (int16_t)std::lround(coef[i] / qs);
+  }
+  // RLE over zigzag: (run, level) varints, terminated by run=63 marker
+  int last_nz = -1;
+  for (int i = 0; i < 64; ++i)
+    if (q[kZigzag[i]] != 0) last_nz = i;
+  int run = 0;
+  for (int i = 0; i <= last_nz; ++i) {
+    int16_t v = q[kZigzag[i]];
+    if (v == 0) {
+      ++run;
+      continue;
+    }
+    out.push_back((uint8_t)run);
+    put_varint(out, v);
+    run = 0;
+  }
+  out.push_back(255);  // end-of-block
+  // reconstruct
+  float deq[64], rec[64];
+  for (int i = 0; i < 64; ++i) {
+    float qs = qbase[i] * qscale;
+    if (qs < 1) qs = 1;
+    deq[i] = q[i] * qs;
+  }
+  idct8x8(deq, rec);
+  for (int y = 0; y < 8; ++y)
+    for (int x = 0; x < 8; ++x)
+      recon[y * rstride + x] = clamp_u8(rec[y * 8 + x] + 128.0f);
+}
+
+bool decode_block(ByteReader& br, const int* qbase, float qscale,
+                  uint8_t* dst, int stride) {
+  int16_t q[64] = {0};
+  int i = 0;
+  while (true) {
+    uint8_t run = br.u8();
+    if (!br.ok) return false;
+    if (run == 255) break;
+    i += run;
+    if (i >= 64) return false;
+    q[kZigzag[i]] = (int16_t)br.varint();
+    ++i;
+  }
+  float deq[64], rec[64];
+  for (int k = 0; k < 64; ++k) {
+    float qs = qbase[k] * qscale;
+    if (qs < 1) qs = 1;
+    deq[k] = q[k] * qs;
+  }
+  idct8x8(deq, rec);
+  for (int y = 0; y < 8; ++y)
+    for (int x = 0; x < 8; ++x)
+      dst[y * stride + x] = clamp_u8(rec[y * 8 + x] + 128.0f);
+  return true;
+}
+
+std::vector<uint8_t> deflate_all(const std::vector<uint8_t>& raw) {
+  uLongf cap = compressBound(raw.size());
+  std::vector<uint8_t> out(cap);
+  compress2(out.data(), &cap, raw.data(), raw.size(), 6);
+  out.resize(cap);
+  return out;
+}
+
+struct Encoder {
+  int src_w, src_h;      // caller frame dims
+  int w, h;              // MB-aligned luma dims
+  int mbx, mby;
+  float quality;         // 1..100 -> base qscale
+  float qscale;          // current quantizer scale (rate-controlled)
+  double target_bytes;   // per frame; 0 = no rate control
+  int kf_interval;
+  uint32_t frame_id = 0;
+  int since_kf = 0;
+  bool have_ref = false;
+  Planes ref;            // encoder-side reconstruction == decoder state
+  Planes cur;
+  std::vector<uint8_t> packet;
+  // stats
+  uint64_t frames = 0, bytes_out = 0, coded_mbs = 0, skipped_mbs = 0;
+};
+
+float quality_to_qscale(float quality) {
+  // JPEG-style: quality 50 -> 1.0, 100 -> ~0.02, 10 -> 5.0
+  if (quality < 1) quality = 1;
+  if (quality > 100) quality = 100;
+  return quality < 50 ? 50.0f / quality : (100.0f - quality) / 50.0f + 0.02f;
+}
+
+struct Decoder {
+  int src_w, src_h;
+  int w, h;
+  int mbx, mby;
+  Planes ref;
+  std::vector<uint8_t> bgra;
+  uint32_t frame_id = 0;
+  uint8_t frame_type = 0;
+  bool have_frame = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hxv_encoder_create(int w, int h, float quality, int target_kbps,
+                         float fps, int kf_interval) {
+  if (w <= 0 || h <= 0 || w > 8192 || h > 8192) return nullptr;
+  auto* e = new Encoder();
+  e->src_w = w;
+  e->src_h = h;
+  e->w = (w + kMB - 1) / kMB * kMB;
+  e->h = (h + kMB - 1) / kMB * kMB;
+  e->mbx = e->w / kMB;
+  e->mby = e->h / kMB;
+  e->quality = quality;
+  e->qscale = quality_to_qscale(quality);
+  e->target_bytes =
+      (target_kbps > 0 && fps > 0) ? target_kbps * 1000.0 / 8.0 / fps : 0.0;
+  e->kf_interval = kf_interval > 0 ? kf_interval : 120;
+  e->ref.init(e->w, e->h);
+  e->cur.init(e->w, e->h);
+  return e;
+}
+
+void hxv_encoder_destroy(void* h) { delete (Encoder*)h; }
+
+// Returns packet size (>0) and sets *out; every call produces a packet.
+long hxv_encode(void* henc, const uint8_t* bgra, int force_keyframe,
+                uint8_t** out) {
+  auto* e = (Encoder*)henc;
+  bgra_to_planes(bgra, e->src_w, e->src_h, e->cur);
+  bool kf = force_keyframe || !e->have_ref || e->since_kf >= e->kf_interval;
+
+  std::vector<uint8_t> raw;
+  raw.reserve((size_t)e->mbx * e->mby * 8);
+  int cw = e->w / 2;
+  for (int my = 0; my < e->mby; ++my) {
+    for (int mx = 0; mx < e->mbx; ++mx) {
+      int px0 = mx * kMB, py0 = my * kMB;
+      bool skip = false;
+      if (!kf) {
+        long sad = 0;
+        for (int yy = 0; yy < kMB; ++yy) {
+          const uint8_t* a = &e->cur.y[(size_t)(py0 + yy) * e->w + px0];
+          const uint8_t* b = &e->ref.y[(size_t)(py0 + yy) * e->w + px0];
+          for (int xx = 0; xx < kMB; ++xx) sad += std::abs(a[xx] - b[xx]);
+        }
+        // ~0.8/px mean abs diff: below visual threshold for screen content
+        skip = sad < kMB * kMB;
+      }
+      if (skip) {
+        raw.push_back(0);
+        ++e->skipped_mbs;
+        // ref keeps its pixels (decoder does the same)
+        continue;
+      }
+      raw.push_back(1);
+      ++e->coded_mbs;
+      // 4 luma blocks
+      for (int by = 0; by < 2; ++by)
+        for (int bx = 0; bx < 2; ++bx) {
+          int ox = px0 + bx * 8, oy = py0 + by * 8;
+          code_block(&e->cur.y[(size_t)oy * e->w + ox], e->w, kQLuma,
+                     e->qscale, raw, &e->ref.y[(size_t)oy * e->w + ox], e->w);
+        }
+      int cx0 = px0 / 2, cy0 = py0 / 2;
+      code_block(&e->cur.cb[(size_t)cy0 * cw + cx0], cw, kQChroma, e->qscale,
+                 raw, &e->ref.cb[(size_t)cy0 * cw + cx0], cw);
+      code_block(&e->cur.cr[(size_t)cy0 * cw + cx0], cw, kQChroma, e->qscale,
+                 raw, &e->ref.cr[(size_t)cy0 * cw + cx0], cw);
+    }
+  }
+
+  std::vector<uint8_t> z = deflate_all(raw);
+  Header hdr;
+  hdr.magic = kMagic;
+  hdr.frame_id = e->frame_id++;
+  hdr.width = (uint16_t)e->src_w;
+  hdr.height = (uint16_t)e->src_h;
+  hdr.type = kf ? 0 : 1;
+  hdr.reserved = 0;
+  hdr.qscale = e->qscale;
+  hdr.raw_len = (uint32_t)raw.size();
+  e->packet.resize(sizeof(hdr) + z.size());
+  memcpy(e->packet.data(), &hdr, sizeof(hdr));
+  memcpy(e->packet.data() + sizeof(hdr), z.data(), z.size());
+
+  e->have_ref = true;
+  e->since_kf = kf ? 0 : e->since_kf + 1;
+  ++e->frames;
+  e->bytes_out += e->packet.size();
+
+  // proportional rate control on non-keyframes
+  if (e->target_bytes > 0 && !kf) {
+    double err = (double)e->packet.size() / e->target_bytes;
+    if (err > 1.1)
+      e->qscale = std::min(e->qscale * (float)std::min(err, 2.0), 8.0f);
+    else if (err < 0.5)
+      e->qscale = std::max(e->qscale * 0.9f, 0.25f);
+  }
+
+  *out = e->packet.data();
+  return (long)e->packet.size();
+}
+
+void hxv_encoder_stats(void* henc, uint64_t* frames, uint64_t* bytes,
+                       uint64_t* coded, uint64_t* skipped) {
+  auto* e = (Encoder*)henc;
+  *frames = e->frames;
+  *bytes = e->bytes_out;
+  *coded = e->coded_mbs;
+  *skipped = e->skipped_mbs;
+}
+
+float hxv_encoder_qscale(void* henc) { return ((Encoder*)henc)->qscale; }
+
+void* hxv_decoder_create(int w, int h) {
+  if (w <= 0 || h <= 0 || w > 8192 || h > 8192) return nullptr;
+  auto* d = new Decoder();
+  d->src_w = w;
+  d->src_h = h;
+  d->w = (w + kMB - 1) / kMB * kMB;
+  d->h = (h + kMB - 1) / kMB * kMB;
+  d->mbx = d->w / kMB;
+  d->mby = d->h / kMB;
+  d->ref.init(d->w, d->h);
+  d->bgra.assign((size_t)w * h * 4, 0);
+  return d;
+}
+
+void hxv_decoder_destroy(void* h) { delete (Decoder*)h; }
+
+int hxv_decode(void* hdec, const uint8_t* buf, long len) {
+  auto* d = (Decoder*)hdec;
+  if (len < (long)sizeof(Header)) return -1;
+  Header hdr;
+  memcpy(&hdr, buf, sizeof(hdr));
+  if (hdr.magic != kMagic) return -2;
+  if (hdr.width != d->src_w || hdr.height != d->src_h) return -3;
+  if (hdr.type == 1 && !d->have_frame) return -4;  // P before first I
+  std::vector<uint8_t> raw(hdr.raw_len);
+  uLongf rl = hdr.raw_len;
+  if (uncompress(raw.data(), &rl, buf + sizeof(hdr),
+                 (uLong)(len - sizeof(hdr))) != Z_OK ||
+      rl != hdr.raw_len)
+    return -5;
+
+  ByteReader br{raw.data(), raw.data() + raw.size()};
+  int cw = d->w / 2;
+  for (int my = 0; my < d->mby; ++my) {
+    for (int mx = 0; mx < d->mbx; ++mx) {
+      uint8_t flags = br.u8();
+      if (!br.ok) return -6;
+      if (flags == 0) continue;  // skip: keep ref pixels
+      int px0 = mx * kMB, py0 = my * kMB;
+      for (int by = 0; by < 2; ++by)
+        for (int bx = 0; bx < 2; ++bx) {
+          int ox = px0 + bx * 8, oy = py0 + by * 8;
+          if (!decode_block(br, kQLuma, hdr.qscale,
+                            &d->ref.y[(size_t)oy * d->w + ox], d->w))
+            return -6;
+        }
+      int cx0 = px0 / 2, cy0 = py0 / 2;
+      if (!decode_block(br, kQChroma, hdr.qscale,
+                        &d->ref.cb[(size_t)cy0 * cw + cx0], cw))
+        return -6;
+      if (!decode_block(br, kQChroma, hdr.qscale,
+                        &d->ref.cr[(size_t)cy0 * cw + cx0], cw))
+        return -6;
+    }
+  }
+  planes_to_bgra(d->ref, d->src_w, d->src_h, d->bgra.data());
+  d->frame_id = hdr.frame_id;
+  d->frame_type = hdr.type;
+  d->have_frame = true;
+  return 0;
+}
+
+const uint8_t* hxv_decoder_frame(void* hdec) {
+  return ((Decoder*)hdec)->bgra.data();
+}
+uint32_t hxv_decoder_frame_id(void* hdec) {
+  return ((Decoder*)hdec)->frame_id;
+}
+int hxv_decoder_frame_type(void* hdec) {
+  return ((Decoder*)hdec)->frame_type;
+}
+
+}  // extern "C"
