@@ -234,6 +234,30 @@ def main():
 
     run_workload("warmup")          # compiles every measured shape
     reqs, dt = run_workload("bench")
+
+    # single-session TTFT (north star line 2: "p50 TTFT, single-session
+    # chat") — measured separately from burst admission: one request on an
+    # idle engine, prefill + first token, repeated for a median
+    single_ttfts = []
+    for k in range(5):
+        r1 = Request(
+            id=f"ttft-{k}",
+            prompt_tokens=list(prompts[0]),
+            sampling=SamplingParams(temperature=0.0, max_tokens=2),
+        )
+        t0 = time.perf_counter()
+        eng.add_request(r1)
+        while eng.has_work() and r1.first_token_time is None:
+            eng.step()
+        single_ttfts.append(
+            (r1.first_token_time - r1.submit_time) * 1000.0
+            if r1.first_token_time is not None
+            else (time.perf_counter() - t0) * 1000.0
+        )
+        while eng.has_work():
+            eng.step()
+    single_ttfts.sort()
+    p50_single_ttft = single_ttfts[len(single_ttfts) // 2]
     outs = [r.output_tokens for r in reqs]
     total_new = sum(len(o) for o in outs)
     toks_per_s = total_new / dt
@@ -257,6 +281,7 @@ def main():
         if on_tpu
         else 0.0,
         "p50_ttft_ms": round(p50_ttft_ms, 1),
+        "p50_single_ttft_ms": round(p50_single_ttft, 1),
         "batch": batch,
         "prompt_len": prompt_len,
         "gen_len": gen_len,

@@ -183,6 +183,55 @@ def web_search_skill(searxng_url: str) -> Skill:
     )
 
 
+def builtin_web_search_skill(metasearch) -> Skill:
+    """web_search over the IN-PROCESS metasearch aggregator
+    (helix_tpu.knowledge.metasearch) — no SearXNG sidecar needed."""
+
+    def search(query: str, max_results: int = 5) -> str:
+        out = metasearch.search(query, max_results=max_results)
+        return "\n\n".join(
+            f"{r['title']}\n{r['url']}\n{r['content']}"
+            for r in out["results"]
+        ) or "no results"
+
+    return Skill(
+        name="web_search",
+        description="Search the web (bundled metasearch).",
+        parameters={
+            "type": "object",
+            "properties": {
+                "query": {"type": "string"},
+                "max_results": {"type": "integer", "default": 5},
+            },
+            "required": ["query"],
+        },
+        handler=search,
+    )
+
+
+def browser_skill(pool) -> Skill:
+    """Fetch + readability-extract a page through the browser pool
+    (reference: the agent browser skill over the Chrome pool)."""
+
+    def browse(url: str) -> str:
+        page = pool.fetch(url)
+        links = "\n".join(page.links[:20])
+        return (
+            f"# {page.title}\n\n{page.text[:8000]}\n\n## links\n{links}"
+        )
+
+    return Skill(
+        name="browser",
+        description="Open a web page and read its main content.",
+        parameters={
+            "type": "object",
+            "properties": {"url": {"type": "string"}},
+            "required": ["url"],
+        },
+        handler=browse,
+    )
+
+
 # ---------------------------------------------------------------------------
 # filesystem (workspace-scoped read/list, for project/repository skills)
 # ---------------------------------------------------------------------------

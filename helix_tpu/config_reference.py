@@ -23,6 +23,89 @@ class EnvVar:
 
 
 ENV_REFERENCE: tuple = (
+    # -- server ----------------------------------------------------------
+    EnvVar(
+        "HELIX_DB_DSN",
+        "Control-plane database location: a filesystem path to the "
+        "consolidated SQLite file. A postgres:// DSN is recognised and "
+        "rejected with a pointer at the SQLite deployment story (the "
+        "reference runs GORM/Postgres; we run one-box SQLite with "
+        "cross-entity transactions).",
+        section="server",
+    ),
+    # -- accelerator -----------------------------------------------------
+    EnvVar(
+        "HELIX_EXACT_SAMPLING",
+        "Set to 1 to force the exact full-vocab top-p sampling path for "
+        "every request (default: auto — the 64-candidate MXU fast path "
+        "when the nucleus provably fits, exact fallback otherwise).",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_SEARCH_ENGINES",
+        "JSON list of metasearch engine specs for the bundled searx-"
+        "compatible /search endpoint, e.g. "
+        '[{"kind": "searx", "name": "sx", "url": "http://host"}, '
+        '{"kind": "mediawiki"}, {"kind": "ddg"}]. Empty (default): '
+        "/search returns 503 instead of hanging on missing egress.",
+        section="knowledge",
+    ),
+    EnvVar(
+        "HELIX_BROWSER_POOL_SIZE",
+        "Instances in the crawling/browsing pool (default 2). Each is an "
+        "HTTP fetcher + readability extractor; with HELIX_CHROME_BIN set "
+        "the pool seam can hold real Chromium sessions instead.",
+        section="knowledge",
+    ),
+    EnvVar(
+        "HELIX_CHROME_BIN",
+        "Path to a Chromium binary for the CDP browser seam (JS-rendered "
+        "crawling). Unset: the JS-less HttpBrowser serves the pool.",
+        section="knowledge",
+    ),
+    EnvVar(
+        "HELIX_FILESTORE",
+        "Blob store backend: 'local' (default, rooted FS under the data "
+        "dir) or 'gcs' (Google Cloud Storage over the JSON API).",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_GCS_BUCKET",
+        "Bucket for HELIX_FILESTORE=gcs (required in that mode).",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_GCS_PREFIX",
+        "Optional object-key prefix for the GCS filestore.",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_GCS_ENDPOINT",
+        "GCS API endpoint override (default "
+        "https://storage.googleapis.com); point at fake-gcs-server or an "
+        "emulator in tests/dev.",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_GCS_TOKEN",
+        "Static bearer token for GCS requests. Unset: the GCE metadata "
+        "server is tried (2 s budget), else anonymous (emulators).",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_LICENSE_KEY",
+        "Offline-verifiable ed25519-signed license key (HELIX-... "
+        "format). Absent or invalid: the deployment runs the community "
+        "tier; /api/v1/config/license reports the reason.",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_LICENSE_PUBKEY",
+        "Hex ed25519 public key that license signatures must verify "
+        "against (default: the built-in issuer key). Self-licensing "
+        "deployments run their own issuer with helix_tpu.control.license.",
+        section="server",
+    ),
     # -- auth ------------------------------------------------------------
     EnvVar(
         "HELIX_MASTER_KEY",

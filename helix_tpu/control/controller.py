@@ -227,6 +227,20 @@ class SessionController:
         registry = SkillRegistry()
         if "calculator" in assistant.tools or not assistant.tools:
             registry.register(calculator_skill())
+        # bundled metasearch + browser pool (server wires these; the agent
+        # web_search/browser skills hit them in-process, no sidecar)
+        metasearch = getattr(self, "metasearch", None)
+        if metasearch is not None and metasearch.engines and (
+            "web_search" in assistant.tools or not assistant.tools
+        ):
+            from helix_tpu.agent.skills import builtin_web_search_skill
+
+            registry.register(builtin_web_search_skill(metasearch))
+        browser_pool = getattr(self, "browser_pool", None)
+        if browser_pool is not None and "browser" in assistant.tools:
+            from helix_tpu.agent.skills import browser_skill
+
+            registry.register(browser_skill(browser_pool))
         if assistant.knowledge and self.knowledge is not None:
             registry.register(
                 knowledge_skill(self.knowledge, list(assistant.knowledge))

@@ -1,0 +1,267 @@
+"""GCS filestore backend — the cloud half of the reference's blob store
+(``api/cmd/helix/serve.go:129-201``: local-FS or GCS via gocloud blob).
+
+Speaks the GCS JSON API directly over HTTP (no SDK in this image):
+media upload/download, metadata stat, prefix list, delete.  The endpoint
+is configurable so tests (and fake-gcs-server/emulator deployments) point
+it at a local server; auth is a pluggable bearer-token provider — GCE
+metadata token on cloud nodes, ``HELIX_GCS_TOKEN`` elsewhere, anonymous
+against emulators.
+
+Viewer-URL signing stays the control plane's HMAC scheme (same wire shape
+as the local backend) — downloads proxy through the control plane, which
+is how the reference serves presigned viewer URLs behind its auth.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.parse
+from typing import Callable, Optional
+
+
+def _default_token_provider() -> str:
+    """GCE metadata-server access token, else HELIX_GCS_TOKEN, else
+    anonymous (emulators)."""
+    tok = os.environ.get("HELIX_GCS_TOKEN", "")
+    if tok:
+        return tok
+    try:
+        import requests
+
+        r = requests.get(
+            "http://metadata.google.internal/computeMetadata/v1/instance/"
+            "service-accounts/default/token",
+            headers={"Metadata-Flavor": "Google"},
+            timeout=2,
+        )
+        if r.ok:
+            return r.json().get("access_token", "")
+    except Exception:  # noqa: BLE001 — not on GCE
+        pass
+    return ""
+
+
+def _check_owner_path(owner: str, path: str) -> str:
+    """Same containment rules as the local backend, on object keys."""
+    if (
+        not owner
+        or owner.startswith(".")
+        or "/" in owner
+        or ".." in owner
+    ):
+        raise PermissionError("invalid owner id")
+    parts = [s for s in path.split("/") if s not in ("", ".")]
+    if any(s == ".." for s in parts):
+        raise PermissionError("path escapes the filestore")
+    return "/".join(parts)
+
+
+class GCSFilestore:
+    """Same surface as :class:`helix_tpu.control.filestore.Filestore`,
+    objects keyed ``{prefix}{owner}/{path}``."""
+
+    def __init__(
+        self,
+        bucket: str,
+        prefix: str = "",
+        endpoint: str = "https://storage.googleapis.com",
+        token_provider: Optional[Callable[[], str]] = None,
+        secret: Optional[bytes] = None,
+        session=None,
+    ):
+        import requests
+
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+        if self.prefix:
+            self.prefix += "/"
+        self.endpoint = endpoint.rstrip("/")
+        self._token = token_provider or _default_token_provider
+        self._http = session or requests.Session()
+        if secret is None:
+            mk = os.environ.get("HELIX_MASTER_KEY", "")
+            if mk:
+                secret = mk.encode()
+            else:
+                # no configured key: random per-process secret. Signed
+                # viewer URLs stop verifying across restarts, but a
+                # hard-coded default would make every unconfigured
+                # deployment's URLs forgeable (filestore.py:24-28) —
+                # the factory passes a persisted keyfile instead.
+                secret = os.urandom(32)
+        self._secret = secret
+
+    # -- plumbing ----------------------------------------------------------
+    def _headers(self) -> dict:
+        tok = self._token()
+        return {"Authorization": f"Bearer {tok}"} if tok else {}
+
+    def _key(self, owner: str, path: str) -> str:
+        rel = _check_owner_path(owner, path)
+        return f"{self.prefix}{owner}/{rel}" if rel else f"{self.prefix}{owner}"
+
+    def _obj_url(self, key: str, media: bool = False) -> str:
+        q = "?alt=media" if media else ""
+        return (
+            f"{self.endpoint}/storage/v1/b/{self.bucket}/o/"
+            f"{urllib.parse.quote(key, safe='')}{q}"
+        )
+
+    # -- blob operations ---------------------------------------------------
+    def write(self, owner: str, path: str, data: bytes) -> dict:
+        key = self._key(owner, path)
+        r = self._http.post(
+            f"{self.endpoint}/upload/storage/v1/b/{self.bucket}/o"
+            f"?uploadType=media&name={urllib.parse.quote(key, safe='')}",
+            data=data,
+            headers={
+                **self._headers(),
+                "Content-Type": "application/octet-stream",
+            },
+            timeout=60,
+        )
+        r.raise_for_status()
+        return self.stat(owner, path)
+
+    def read(self, owner: str, path: str) -> bytes:
+        r = self._http.get(
+            self._obj_url(self._key(owner, path), media=True),
+            headers=self._headers(), timeout=60,
+        )
+        if r.status_code == 404:
+            raise FileNotFoundError(path)
+        r.raise_for_status()
+        return r.content
+
+    def stat(self, owner: str, path: str) -> dict:
+        r = self._http.get(
+            self._obj_url(self._key(owner, path)),
+            headers=self._headers(), timeout=30,
+        )
+        if r.status_code == 404:
+            raise FileNotFoundError(path)
+        r.raise_for_status()
+        meta = r.json()
+        return {
+            "path": _check_owner_path(owner, path),
+            "size": int(meta.get("size", 0)),
+            "modified": meta.get("updated", ""),
+            "is_dir": False,
+        }
+
+    def list(self, owner: str, path: str = "") -> list:
+        rel = _check_owner_path(owner, path)
+        prefix = f"{self.prefix}{owner}/"
+        if rel:
+            prefix += rel + "/"
+        out = []
+        page_token = ""
+        while True:
+            params = {"prefix": prefix, "delimiter": "/"}
+            if page_token:
+                params["pageToken"] = page_token
+            r = self._http.get(
+                f"{self.endpoint}/storage/v1/b/{self.bucket}/o",
+                params=params, headers=self._headers(), timeout=30,
+            )
+            r.raise_for_status()
+            data = r.json()
+            for item in data.get("items", []):
+                name = item["name"][len(prefix):]
+                if not name:
+                    continue
+                out.append({
+                    "path": (rel + "/" if rel else "") + name,
+                    "size": int(item.get("size", 0)),
+                    "modified": item.get("updated", ""),
+                    "is_dir": False,
+                })
+            for sub in data.get("prefixes", []):
+                name = sub[len(prefix):].rstrip("/")
+                out.append({
+                    "path": (rel + "/" if rel else "") + name,
+                    "size": 0, "modified": "", "is_dir": True,
+                })
+            page_token = data.get("nextPageToken", "")
+            if not page_token:
+                break
+        return sorted(out, key=lambda e: e["path"])
+
+    def delete(self, owner: str, path: str) -> bool:
+        # object delete; on 404, try prefix delete (a "directory")
+        key = self._key(owner, path)
+        r = self._http.delete(
+            self._obj_url(key), headers=self._headers(), timeout=30
+        )
+        if r.status_code in (200, 204):
+            return True
+        if r.status_code != 404:
+            r.raise_for_status()
+        deleted = False
+        for entry in self.list(owner, path):
+            if entry["is_dir"]:
+                deleted |= self.delete(owner, entry["path"])
+            else:
+                rr = self._http.delete(
+                    self._obj_url(self._key(owner, entry["path"])),
+                    headers=self._headers(), timeout=30,
+                )
+                deleted |= rr.status_code in (200, 204)
+        return deleted
+
+    # -- signed viewer URLs (control-plane HMAC, same as local) -----------
+    def sign(self, owner: str, path: str, ttl: float = 3600.0) -> dict:
+        import hashlib
+        import hmac as _hmac
+
+        _check_owner_path(owner, path)
+        expires = int(time.time() + ttl)
+        msg = f"{owner}:{path}:{expires}".encode()
+        sig = _hmac.new(self._secret, msg, hashlib.sha256).hexdigest()
+        return {
+            "path": path, "owner": owner, "expires": expires,
+            "signature": sig,
+            "url": f"/files/view?owner={owner}&path={path}"
+                   f"&expires={expires}&sig={sig}",
+        }
+
+    def verify(self, owner: str, path: str, expires: int, sig: str) -> bool:
+        import hashlib
+        import hmac as _hmac
+
+        if time.time() > expires:
+            return False
+        msg = f"{owner}:{path}:{expires}".encode()
+        want = _hmac.new(self._secret, msg, hashlib.sha256).hexdigest()
+        return _hmac.compare_digest(want, sig)
+
+
+def filestore_from_env(local_root: str):
+    """HELIX_FILESTORE=gcs -> GCSFilestore(HELIX_GCS_BUCKET[, _PREFIX,
+    _ENDPOINT]); anything else -> local Filestore(root)."""
+    from helix_tpu.control.filestore import Filestore
+
+    if os.environ.get("HELIX_FILESTORE", "local").lower() == "gcs":
+        bucket = os.environ.get("HELIX_GCS_BUCKET", "")
+        if not bucket:
+            raise ValueError("HELIX_FILESTORE=gcs needs HELIX_GCS_BUCKET")
+        # persisted random viewer-URL signing secret (same posture as the
+        # local backend: never a guessable default)
+        from helix_tpu.utils import load_or_create_keyfile
+
+        os.makedirs(local_root, exist_ok=True)
+        secret = load_or_create_keyfile(
+            os.path.join(local_root, ".signing-secret")
+        )
+        return GCSFilestore(
+            bucket,
+            prefix=os.environ.get("HELIX_GCS_PREFIX", ""),
+            endpoint=os.environ.get(
+                "HELIX_GCS_ENDPOINT", "https://storage.googleapis.com"
+            ),
+            secret=secret,
+        )
+    return Filestore(local_root)

@@ -1,0 +1,194 @@
+"""License key validation — the reference validates an offline-signed
+license at serve time and surfaces tier/expiry to the deployment
+(``api/cmd/helix/serve.go:210-241``).
+
+Keys are ed25519-signed, offline-verifiable, no phone-home:
+
+    HELIX-<base64url(payload json)>.<base64url(signature)>
+
+payload: {"id", "org", "seats", "features": [...], "valid_until": epoch,
+"issued": epoch}.  The verifying public key ships in the binary
+(``DEFAULT_PUBKEY_HEX``); ``HELIX_LICENSE_PUBKEY`` overrides it so tests
+and self-issued deployments can run their own issuer
+(:func:`generate_keypair` + :func:`sign_license` are the issuer half).
+
+No key (or an invalid one) is not fatal: the deployment runs at the
+community tier; feature gates consult :meth:`LicenseManager.require`.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+# verifying key for production-issued licenses (self-issued deployments
+# override via HELIX_LICENSE_PUBKEY)
+DEFAULT_PUBKEY_HEX = (
+    "3ba55640d9db6a38d6a2b9565c932d4a4e33f1651b9d2f16b540bdc55e4a4f00"
+)
+
+COMMUNITY_FEATURES = ("serving", "training", "knowledge", "agents")
+ENTERPRISE_FEATURES = ("org", "compute-autoscale", "multihost", "sso")
+
+
+class LicenseError(Exception):
+    pass
+
+
+@dataclass
+class License:
+    id: str
+    org: str
+    seats: int
+    features: List[str]
+    valid_until: float
+    issued: float
+    tier: str = "enterprise"
+
+    @property
+    def expired(self) -> bool:
+        return time.time() > self.valid_until
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id, "org": self.org, "seats": self.seats,
+            "features": list(self.features),
+            "valid_until": self.valid_until, "issued": self.issued,
+            "tier": self.tier, "expired": self.expired,
+        }
+
+
+def _b64e(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).decode().rstrip("=")
+
+
+def _b64d(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def generate_keypair() -> tuple:
+    """-> (private_key_hex, public_key_hex) for a license issuer."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, NoEncryption, PrivateFormat, PublicFormat,
+    )
+
+    priv = Ed25519PrivateKey.generate()
+    priv_raw = priv.private_bytes(
+        Encoding.Raw, PrivateFormat.Raw, NoEncryption()
+    )
+    pub_raw = priv.public_key().public_bytes(
+        Encoding.Raw, PublicFormat.Raw
+    )
+    return priv_raw.hex(), pub_raw.hex()
+
+
+def sign_license(payload: dict, private_key_hex: str) -> str:
+    """Issuer: payload dict -> 'HELIX-....' key string."""
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+
+    priv = Ed25519PrivateKey.from_private_bytes(
+        bytes.fromhex(private_key_hex)
+    )
+    body = json.dumps(payload, sort_keys=True, separators=(",", ":")).encode()
+    sig = priv.sign(body)
+    return f"HELIX-{_b64e(body)}.{_b64e(sig)}"
+
+
+def parse_license(key: str, pubkey_hex: Optional[str] = None) -> License:
+    """Verify signature + shape. Raises LicenseError; expiry is reported
+    on the License, not raised (an expired license identifies the org)."""
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+
+    pubkey_hex = pubkey_hex or os.environ.get(
+        "HELIX_LICENSE_PUBKEY", DEFAULT_PUBKEY_HEX
+    )
+    key = key.strip()
+    if not key.startswith("HELIX-") or "." not in key:
+        raise LicenseError("malformed license key")
+    try:
+        body_b64, sig_b64 = key[len("HELIX-"):].split(".", 1)
+        body = _b64d(body_b64)
+        sig = _b64d(sig_b64)
+    except Exception as e:  # noqa: BLE001
+        raise LicenseError(f"undecodable license key: {e}") from None
+    try:
+        Ed25519PublicKey.from_public_bytes(
+            bytes.fromhex(pubkey_hex)
+        ).verify(sig, body)
+    except InvalidSignature:
+        raise LicenseError("license signature invalid") from None
+    try:
+        p = json.loads(body)
+        return License(
+            id=str(p["id"]), org=str(p["org"]),
+            seats=int(p.get("seats", 0)),
+            features=list(p.get("features", [])),
+            valid_until=float(p["valid_until"]),
+            issued=float(p.get("issued", 0)),
+        )
+    except (KeyError, ValueError, TypeError) as e:
+        raise LicenseError(f"license payload invalid: {e}") from None
+
+
+class LicenseManager:
+    """Deployment-level license state + feature gating."""
+
+    def __init__(self, key: Optional[str] = None,
+                 pubkey_hex: Optional[str] = None):
+        key = key if key is not None else os.environ.get(
+            "HELIX_LICENSE_KEY", ""
+        )
+        self.license: Optional[License] = None
+        self.error: str = ""
+        if key:
+            try:
+                self.license = parse_license(key, pubkey_hex)
+            except LicenseError as e:
+                # invalid key: run community, but say so loudly in status
+                self.error = str(e)
+
+    @property
+    def tier(self) -> str:
+        if self.license and not self.license.expired:
+            return self.license.tier
+        return "community"
+
+    def features(self) -> List[str]:
+        feats = list(COMMUNITY_FEATURES)
+        if self.license and not self.license.expired:
+            feats += [
+                f for f in self.license.features if f not in feats
+            ]
+        return feats
+
+    def has(self, feature: str) -> bool:
+        return feature in self.features()
+
+    def require(self, feature: str) -> None:
+        """Gate for enterprise surfaces; community features always pass."""
+        if not self.has(feature):
+            raise LicenseError(
+                f"feature {feature!r} needs a valid license"
+                + (f" (current key: {self.error})" if self.error else
+                   " (no license key configured)")
+            )
+
+    def status(self) -> dict:
+        return {
+            "tier": self.tier,
+            "features": self.features(),
+            "license": self.license.to_dict() if self.license else None,
+            "error": self.error,
+        }

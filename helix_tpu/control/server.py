@@ -259,6 +259,22 @@ class ControlPlane:
 
         self.desktops = DesktopManager()
 
+        # bundled metasearch + browser pool (reference runs SearXNG and a
+        # Chrome/rod pool as sidecar containers; ours are in-process —
+        # knowledge/metasearch.py, knowledge/browser_pool.py)
+        import os as _os
+
+        from helix_tpu.knowledge.browser_pool import BrowserPool
+        from helix_tpu.knowledge.metasearch import MetaSearch
+
+        self.metasearch = MetaSearch()
+        self.browser_pool = BrowserPool(
+            size=int(_os.environ.get("HELIX_BROWSER_POOL_SIZE", "2"))
+        )
+        # agent skills (web_search/browser) hit these in-process
+        self.controller.metasearch = self.metasearch
+        self.controller.browser_pool = self.browser_pool
+
         def make_emitter(task, mode):
             """Stream a task agent's steps into a watchable desktop session
             (the reference's 'user watches the agent's desktop' loop)."""
@@ -482,6 +498,18 @@ class ControlPlane:
             "EVALS", ["evals.*"], max_msgs=10000
         )
         self.bus.attach_jetstream(self.jetstream)
+
+        # Zed editor bridge: instance/thread protocol over the durable
+        # streams (api/pkg/pubsub/zed_protocol.go); thread activity lands
+        # on the kanban card as a review note
+        from helix_tpu.services.zed_bridge import ZedBridge
+
+        self.zed = ZedBridge(
+            self.bus,
+            task_note=lambda tid, kind, note: self.task_store.add_review(
+                tid, author=kind, comment=note, decision="note"
+            ),
+        ).start()
         # kanban lifecycle -> durable TASKS stream
         self.task_store.on_update = lambda t: self.bus.publish(
             f"spectasks.{t.id}",
@@ -499,7 +527,16 @@ class ControlPlane:
                 "helix-files",
             )
         )
-        self.files = Filestore(files_root)
+        from helix_tpu.control.filestore_gcs import filestore_from_env
+
+        # local FS by default; HELIX_FILESTORE=gcs swaps in the GCS JSON-API
+        # backend (serve.go:129-201 local/GCS via gocloud)
+        self.files = filestore_from_env(files_root)
+
+        # license validation (serve.go:210-241): no key = community tier
+        from helix_tpu.control.license import LicenseManager
+
+        self.license = LicenseManager()
 
         def fire_trigger(trigger, payload):
             import asyncio as _asyncio
@@ -787,6 +824,10 @@ class ControlPlane:
         r.add_delete("/api/v1/knowledge/{id}", self.delete_knowledge)
         r.add_post("/api/v1/knowledge/{id}/refresh", self.refresh_knowledge)
         r.add_post("/api/v1/knowledge/{id}/search", self.search_knowledge)
+        # bundled metasearch (searx-compatible wire shape) + browser pool
+        r.add_get("/api/v1/search", self.web_search)
+        r.add_get("/search", self.web_search)
+        r.add_post("/api/v1/browse", self.browse_url)
         # usage
         r.add_get("/api/v1/usage", self.usage)
         # auth: users / keys / orgs / secrets
@@ -875,6 +916,8 @@ class ControlPlane:
         r.add_get("/api/v1/filestore/{path:.*}", self.fs_download)
         r.add_delete("/api/v1/filestore/{path:.*}", self.fs_delete)
         r.add_post("/api/v1/filestore-sign/{path:.*}", self.fs_sign)
+        # license status
+        r.add_get("/api/v1/config/license", self.license_status)
         r.add_get("/files/view", self.fs_view_signed)
         # user event stream (the reference's /ws/user)
         r.add_get("/ws/user", self.ws_user)
@@ -884,6 +927,11 @@ class ControlPlane:
         r.add_delete("/api/v1/desktops/{id}", self.delete_desktop)
         r.add_get("/api/v1/desktops/{id}/ws/stream", self.ws_desktop_stream)
         r.add_get("/api/v1/desktops/{id}/ws/input", self.ws_desktop_input)
+        r.add_post("/api/v1/desktops/{id}/mcp", self.desktop_mcp)
+        # zed editor bridge
+        r.add_get("/api/v1/zed/instances", self.zed_list)
+        r.add_post("/api/v1/zed/instances", self.zed_create)
+        r.add_delete("/api/v1/zed/instances/{id}", self.zed_stop)
         # agent settings sync (reference: settings-sync-daemon)
         r.add_get("/api/v1/settings/agents", self.get_agent_settings)
         r.add_put("/api/v1/settings/agents", self.put_agent_settings)
@@ -1447,6 +1495,43 @@ class ControlPlane:
             ),
         )
         return web.json_response({"results": results})
+
+    async def web_search(self, request):
+        """Bundled metasearch on the searx wire shape — the agent
+        web_search skill and any SearXNG-pointed tool can target this
+        server directly (reference runs a searxng sidecar)."""
+        q = request.query.get("q", "").strip()
+        if not q:
+            return _err(400, "missing q")
+        try:
+            max_results = int(request.query.get("max_results", "20"))
+        except ValueError:
+            return _err(400, "max_results must be an integer")
+        try:
+            result = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self.metasearch.search(q, max_results)
+            )
+        except RuntimeError as e:
+            return _err(503, str(e))
+        return web.json_response(result)
+
+    async def browse_url(self, request):
+        """Fetch + readability-extract one page through the browser pool
+        (the agent browser skill's backend)."""
+        body = await request.json()
+        url = body.get("url", "")
+        if not url:
+            return _err(400, "missing url")
+        try:
+            page = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self.browser_pool.fetch(url)
+            )
+        except Exception as e:  # noqa: BLE001 — fetch/SSRF errors -> client
+            return _err(502, str(e))
+        return web.json_response({
+            "url": page.url, "title": page.title, "text": page.text,
+            "links": page.links[:200], "pool": self.browser_pool.stats,
+        })
 
     # -- usage ---------------------------------------------------------------
     async def usage(self, request):
@@ -2072,6 +2157,10 @@ class ControlPlane:
             return web.json_response({"ok": True, "ignored": doc})
         return web.json_response({"ok": True})
 
+    # -- license ---------------------------------------------------------------
+    async def license_status(self, request):
+        return web.json_response(self.license.status())
+
     # -- filestore -------------------------------------------------------------
     async def fs_list(self, request):
         owner = self._user_id(request)
@@ -2407,10 +2496,25 @@ class ControlPlane:
             body = await request.json()
         except Exception:
             body = {}
-        s = self.desktops.create(
-            name=body.get("name", ""), fps=float(body.get("fps", 10))
+        import asyncio as _asyncio
+        import functools as _functools
+
+        # off the event loop: a cold GUI desktop builds the native codec +
+        # compositor libs (make) and renders its first windows
+        s = await _asyncio.get_running_loop().run_in_executor(
+            None,
+            _functools.partial(
+                self.desktops.create,
+                name=body.get("name", ""), fps=float(body.get("fps", 10)),
+                kind=body.get("kind", "text"), codec=body.get("codec", ""),
+            ),
         )
-        return web.json_response({"id": s.id, "name": s.name})
+        return web.json_response(
+            {
+                "id": s.id, "name": s.name, "codec": s.codec,
+                "width": s.source.width, "height": s.source.height,
+            }
+        )
 
     async def delete_desktop(self, request):
         ok = self.desktops.destroy(request.match_info["id"])
@@ -2452,6 +2556,77 @@ class ControlPlane:
         finally:
             session.unsubscribe(sid)
         return ws
+
+    # -- zed bridge ------------------------------------------------------------
+    async def zed_list(self, request):
+        return web.json_response({"instances": self.zed.list()})
+
+    async def zed_create(self, request):
+        """Request an editor instance over the protocol stream; the bridge
+        answers on zed_events with a correlation id (queue semantics)."""
+        from helix_tpu.services import zed_bridge as zp
+
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        before = {i["id"] for i in self.zed.list()}
+        msg = zp.make_message(zp.T_INSTANCE_CREATE, body)
+        self.bus.publish(zp.STREAM_INSTANCES, msg)
+        # the in-process bridge handles on the bus thread; poll briefly
+        # for the instance THIS request created (explicit id, or the one
+        # that appeared since `before`)
+        iid = body.get("instance_id", "")
+        for _ in range(50):
+            insts = self.zed.list()
+            hit = next(
+                (
+                    i for i in insts
+                    if (i["id"] == iid if iid else i["id"] not in before)
+                ),
+                None,
+            )
+            if hit is not None:
+                return web.json_response(hit, status=201)
+            await asyncio.sleep(0.02)
+        return web.json_response(
+            {"requested": True, "correlation_id": msg["message_id"]},
+            status=202,
+        )
+
+    async def zed_stop(self, request):
+        from helix_tpu.services import zed_bridge as zp
+
+        iid = request.match_info["id"]
+        if self.zed.get(iid) is None:
+            return _err(404, "zed instance not found")
+        self.bus.publish(
+            zp.STREAM_INSTANCES,
+            zp.make_message(zp.T_INSTANCE_STOP, {"instance_id": iid}),
+        )
+        return web.json_response({"ok": True})
+
+    async def desktop_mcp(self, request):
+        """Per-session desktop MCP endpoint (streamable-HTTP profile, one
+        JSON-RPC message per POST) — reference:
+        api/pkg/server/mcp_backend_desktop.go + desktop/mcp_server.go."""
+        session = self.desktops.get(request.match_info["id"])
+        if session is None:
+            return _err(404, "desktop not found")
+        if not hasattr(session, "_mcp"):
+            from helix_tpu.desktop.mcp_server import DesktopMCPServer
+
+            session._mcp = DesktopMCPServer(session)
+        try:
+            msg = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON-RPC payload")
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, session._mcp.handle, msg
+        )
+        if out is None:  # notification
+            return web.Response(status=202)
+        return web.json_response(out)
 
     async def ws_desktop_input(self, request):
         import json as _json

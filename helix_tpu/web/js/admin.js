@@ -4,10 +4,14 @@ import {$, $row, api, esc} from "./core.js";
 
 export async function render(m) {
   const health = await api("/healthz").catch(() => ({}));
+  const lic = await api("/api/v1/config/license").catch(() => ({}));
   m.appendChild($(`<div class="panel row">
     <div><div class="statlabel">status</div><div class="stat">${esc(health.status || "?")}</div></div>
     <div style="margin-left:24px"><div class="statlabel">runners</div>
-      <div class="stat">${health.runners ?? "?"}</div></div></div>`));
+      <div class="stat">${health.runners ?? "?"}</div></div>
+    <div style="margin-left:24px"><div class="statlabel">license</div>
+      <div class="stat">${esc(lic.tier || "?")}</div>
+      <div class="id">${esc(lic.license ? `${lic.license.org} · ${lic.license.seats} seats` : (lic.error || "community tier"))}</div></div></div>`));
 
   const users = $(`<div class="panel"><h3>Users & API keys</h3>
     <div class="row"><input id="ue" placeholder="email">

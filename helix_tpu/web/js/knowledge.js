@@ -23,6 +23,30 @@ export async function render(m) {
   const {knowledge} = await api("/api/v1/knowledge");
   for (const k of knowledge)
     search.querySelector("#ksel").appendChild(new Option(k.name, k.id));
+
+  // bundled metasearch (searx-compatible /api/v1/search)
+  const web = $(`<div class="panel"><h3>Web search</h3>
+    <div class="row"><input id="wq" class="grow" placeholder="metasearch the web">
+      <button class="ghost" id="wgo">Search</button></div>
+    <div id="wr" style="margin-top:8px"></div></div>`);
+  m.appendChild(web);
+  web.querySelector("#wgo").onclick = async () => {
+    const out = web.querySelector("#wr");
+    out.textContent = "searching...";
+    try {
+      const data = await api(`/api/v1/search?q=${encodeURIComponent(web.querySelector("#wq").value)}`);
+      out.innerHTML = "";
+      for (const r of data.results) {
+        const d = $(`<div style="margin-bottom:8px"><a target="_blank"></a>
+          <div class="id"></div></div>`);
+        const a = d.querySelector("a");
+        a.href = r.url; a.textContent = r.title || r.url;
+        d.querySelector("div").textContent = r.content || "";
+        out.appendChild(d);
+      }
+      if (!data.results.length) out.textContent = "no results";
+    } catch (e) { out.textContent = String(e.message || e); }
+  };
   search.querySelector("#kgo").onclick = async () => {
     const kid = search.querySelector("#ksel").value;
     if (!kid) return;

@@ -1,0 +1,328 @@
+"""GCS filestore backend (against an in-process fake GCS JSON API) and
+ed25519 license validation (``api/cmd/helix/serve.go:129-201,210-241``)."""
+
+import json
+import threading
+import time
+import urllib.parse
+
+import pytest
+
+from helix_tpu.control.filestore_gcs import GCSFilestore, filestore_from_env
+from helix_tpu.control.license import (
+    COMMUNITY_FEATURES,
+    License,
+    LicenseError,
+    LicenseManager,
+    generate_keypair,
+    parse_license,
+    sign_license,
+)
+
+
+# ---------------------------------------------------------------------------
+# fake GCS JSON API (media upload/download, metadata, prefix list, delete)
+# ---------------------------------------------------------------------------
+
+
+class FakeGCS:
+    def __init__(self):
+        self.objects: dict = {}          # name -> bytes
+        self.requests: list = []
+        self._srv = None
+        self.port = 0
+
+    def start(self):
+        import http.server
+
+        fake = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _send(self, code, body=b"", ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                u = urllib.parse.urlsplit(self.path)
+                q = dict(urllib.parse.parse_qsl(u.query))
+                fake.requests.append(("POST", self.path))
+                if u.path.startswith("/upload/storage/v1/b/"):
+                    n = int(self.headers.get("Content-Length", 0))
+                    fake.objects[q["name"]] = self.rfile.read(n)
+                    self._send(200, json.dumps(
+                        {"name": q["name"],
+                         "size": str(n)}).encode())
+                else:
+                    self._send(404)
+
+            def do_GET(self):
+                u = urllib.parse.urlsplit(self.path)
+                q = dict(urllib.parse.parse_qsl(u.query))
+                fake.requests.append(("GET", self.path))
+                if u.path.endswith("/o") and "prefix" in q:
+                    prefix = q["prefix"]
+                    delim = q.get("delimiter", "")
+                    items, prefixes = [], set()
+                    for name, data in sorted(fake.objects.items()):
+                        if not name.startswith(prefix):
+                            continue
+                        rest = name[len(prefix):]
+                        if delim and delim in rest:
+                            prefixes.add(prefix + rest.split(delim)[0] + delim)
+                            continue
+                        items.append({
+                            "name": name, "size": str(len(data)),
+                            "updated": "2026-01-01T00:00:00Z",
+                        })
+                    self._send(200, json.dumps({
+                        "items": items, "prefixes": sorted(prefixes),
+                    }).encode())
+                    return
+                if "/o/" in u.path:
+                    name = urllib.parse.unquote(u.path.split("/o/", 1)[1])
+                    if name not in fake.objects:
+                        self._send(404, b"{}")
+                        return
+                    if q.get("alt") == "media":
+                        self._send(200, fake.objects[name],
+                                   "application/octet-stream")
+                    else:
+                        self._send(200, json.dumps({
+                            "name": name,
+                            "size": str(len(fake.objects[name])),
+                            "updated": "2026-01-01T00:00:00Z",
+                        }).encode())
+                    return
+                self._send(404)
+
+            def do_DELETE(self):
+                u = urllib.parse.urlsplit(self.path)
+                fake.requests.append(("DELETE", self.path))
+                name = urllib.parse.unquote(u.path.split("/o/", 1)[1])
+                if name in fake.objects:
+                    del fake.objects[name]
+                    self._send(204)
+                else:
+                    self._send(404, b"{}")
+
+        self._srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self._srv.server_port
+        threading.Thread(target=self._srv.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self.port}"
+
+
+@pytest.fixture()
+def gcs():
+    f = FakeGCS().start()
+    yield f
+    f.stop()
+
+
+class TestGCSFilestore:
+    def _store(self, gcs, **kw):
+        return GCSFilestore(
+            "test-bucket", endpoint=gcs.endpoint,
+            token_provider=lambda: "fake-token", **kw,
+        )
+
+    def test_write_read_stat_roundtrip(self, gcs):
+        fs = self._store(gcs)
+        meta = fs.write("alice", "docs/a.txt", b"hello gcs")
+        assert meta["size"] == 9
+        assert fs.read("alice", "docs/a.txt") == b"hello gcs"
+        assert "alice/docs/a.txt" in gcs.objects
+
+    def test_list_files_and_dirs(self, gcs):
+        fs = self._store(gcs)
+        fs.write("alice", "docs/a.txt", b"a")
+        fs.write("alice", "docs/sub/b.txt", b"b")
+        fs.write("alice", "top.txt", b"t")
+        top = fs.list("alice")
+        assert [(e["path"], e["is_dir"]) for e in top] == [
+            ("docs", True), ("top.txt", False),
+        ]
+        docs = fs.list("alice", "docs")
+        assert [(e["path"], e["is_dir"]) for e in docs] == [
+            ("docs/a.txt", False), ("docs/sub", True),
+        ]
+
+    def test_delete_object_and_prefix(self, gcs):
+        fs = self._store(gcs)
+        fs.write("alice", "d/a.txt", b"a")
+        fs.write("alice", "d/b.txt", b"b")
+        assert fs.delete("alice", "d/a.txt")
+        assert fs.delete("alice", "d")          # prefix delete
+        assert gcs.objects == {}
+
+    def test_owner_containment(self, gcs):
+        fs = self._store(gcs)
+        with pytest.raises(PermissionError):
+            fs.write("../bob", "x", b"x")
+        with pytest.raises(PermissionError):
+            fs.read("alice", "../bob/secret")
+        with pytest.raises(PermissionError):
+            fs.write(".hidden", "x", b"x")
+
+    def test_missing_object_is_file_not_found(self, gcs):
+        fs = self._store(gcs)
+        with pytest.raises(FileNotFoundError):
+            fs.read("alice", "nope.txt")
+        with pytest.raises(FileNotFoundError):
+            fs.stat("alice", "nope.txt")
+
+    def test_auth_header_sent(self, gcs):
+        fs = self._store(gcs)
+        fs.write("alice", "a.txt", b"x")
+        # (fake records paths; verify the token provider is consulted by
+        # swapping in a failing one)
+        calls = []
+        fs2 = GCSFilestore(
+            "test-bucket", endpoint=gcs.endpoint,
+            token_provider=lambda: calls.append(1) or "",
+        )
+        fs2.read("alice", "a.txt")
+        assert calls
+
+    def test_signed_viewer_urls(self, gcs):
+        fs = self._store(gcs, secret=b"k")
+        fs.write("alice", "a.txt", b"x")
+        s = fs.sign("alice", "a.txt", ttl=60)
+        assert fs.verify("alice", "a.txt", s["expires"], s["signature"])
+        assert not fs.verify("alice", "b.txt", s["expires"], s["signature"])
+        assert not fs.verify("alice", "a.txt", int(time.time()) - 1,
+                             s["signature"])
+
+    def test_factory_selects_backend(self, gcs, tmp_path, monkeypatch):
+        monkeypatch.setenv("HELIX_FILESTORE", "gcs")
+        monkeypatch.setenv("HELIX_GCS_BUCKET", "b")
+        monkeypatch.setenv("HELIX_GCS_ENDPOINT", gcs.endpoint)
+        fs = filestore_from_env(str(tmp_path))
+        assert isinstance(fs, GCSFilestore)
+        monkeypatch.setenv("HELIX_FILESTORE", "local")
+        from helix_tpu.control.filestore import Filestore
+
+        assert isinstance(filestore_from_env(str(tmp_path)), Filestore)
+        monkeypatch.setenv("HELIX_FILESTORE", "gcs")
+        monkeypatch.delenv("HELIX_GCS_BUCKET")
+        with pytest.raises(ValueError):
+            filestore_from_env(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# license validation
+# ---------------------------------------------------------------------------
+
+
+def _issue(**over):
+    priv, pub = generate_keypair()
+    payload = {
+        "id": "lic_1", "org": "acme", "seats": 25,
+        "features": ["org", "multihost"],
+        "valid_until": time.time() + 86400, "issued": time.time(),
+    }
+    payload.update(over)
+    return sign_license(payload, priv), pub
+
+
+class TestLicense:
+    def test_roundtrip_valid(self):
+        key, pub = _issue()
+        lic = parse_license(key, pub)
+        assert lic.org == "acme" and lic.seats == 25
+        assert not lic.expired
+
+    def test_tampered_payload_rejected(self):
+        key, pub = _issue()
+        head, sig = key.split(".", 1)
+        import base64
+
+        body = json.loads(base64.urlsafe_b64decode(
+            head[len("HELIX-"):] + "=="
+        ))
+        body["seats"] = 100000
+        forged = "HELIX-" + base64.urlsafe_b64encode(
+            json.dumps(body, sort_keys=True,
+                       separators=(",", ":")).encode()
+        ).decode().rstrip("=") + "." + sig
+        with pytest.raises(LicenseError, match="signature"):
+            parse_license(forged, pub)
+
+    def test_wrong_issuer_rejected(self):
+        key, _pub = _issue()
+        _, other_pub = generate_keypair()
+        with pytest.raises(LicenseError, match="signature"):
+            parse_license(key, other_pub)
+
+    def test_malformed_keys(self):
+        for bad in ("", "HELIX-", "nope", "HELIX-abc"):
+            with pytest.raises(LicenseError):
+                parse_license(bad, generate_keypair()[1])
+
+    def test_manager_enterprise_gating(self):
+        key, pub = _issue()
+        m = LicenseManager(key=key, pubkey_hex=pub)
+        assert m.tier == "enterprise"
+        m.require("org")                        # licensed feature
+        m.require("serving")                    # community always passes
+        with pytest.raises(LicenseError):
+            m.require("sso")                    # not in this license
+
+    def test_manager_community_when_absent_or_invalid(self):
+        m = LicenseManager(key="")
+        assert m.tier == "community"
+        assert sorted(m.features()) == sorted(COMMUNITY_FEATURES)
+        with pytest.raises(LicenseError):
+            m.require("org")
+        m2 = LicenseManager(key="HELIX-garbage.sig",
+                            pubkey_hex=generate_keypair()[1])
+        assert m2.tier == "community" and m2.error
+
+    def test_expired_license_reports_but_downgrades(self):
+        key, pub = _issue(valid_until=time.time() - 10)
+        m = LicenseManager(key=key, pubkey_hex=pub)
+        assert m.tier == "community"
+        assert m.license is not None and m.license.expired
+        with pytest.raises(LicenseError):
+            m.require("org")
+        st = m.status()
+        assert st["license"]["expired"] is True
+
+    def test_status_route(self):
+        import asyncio
+
+        from helix_tpu.control.server import ControlPlane
+
+        key, pub = _issue()
+        cp = ControlPlane()
+        cp.license = LicenseManager(key=key, pubkey_hex=pub)
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                r = await client.get("/api/v1/config/license")
+                data = await r.json()
+                assert data["tier"] == "enterprise"
+                assert data["license"]["org"] == "acme"
+            finally:
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )

@@ -1,0 +1,340 @@
+"""Bundled metasearch + browser pool (the reference's SearXNG + Chrome/rod
+sidecars, in-process: ``api/cmd/helix/serve.go:356-382``,
+``api/pkg/searxng/``).  Engines are faked in-process — no egress."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from helix_tpu.knowledge.browser_pool import (
+    BrowserPool,
+    HttpBrowser,
+    extract_readable,
+)
+from helix_tpu.knowledge.metasearch import (
+    DdgLiteEngine,
+    MediaWikiEngine,
+    MetaSearch,
+    SearxJsonEngine,
+    _canonical,
+    engine_from_spec,
+)
+
+
+def fake_fetch(responses):
+    """fetch(url) keyed by substring match."""
+
+    def fetch(url, timeout=10.0):
+        for key, val in responses.items():
+            if key in url:
+                return val
+        raise ValueError(f"no fake for {url}")
+
+    return fetch
+
+
+class TestEngines:
+    def test_searx_json_engine(self):
+        eng = SearxJsonEngine("sx", "http://sx.local")
+        fetch = fake_fetch({
+            "sx.local": json.dumps({"results": [
+                {"title": "A", "url": "http://a.com", "content": "aa"},
+                {"title": "B", "url": "http://b.com"},
+            ]})
+        })
+        rs = eng.search("q", fetch)
+        assert [r.title for r in rs] == ["A", "B"]
+        assert rs[0].engine == "sx"
+
+    def test_mediawiki_engine(self):
+        eng = MediaWikiEngine("wiki", "http://wiki.local")
+        fetch = fake_fetch({
+            "wiki.local": json.dumps([
+                "tpu", ["TPU", "Tensor Processing Unit"],
+                ["a chip", "google asic"],
+                ["http://wiki.local/TPU", "http://wiki.local/Tensor"],
+            ])
+        })
+        rs = eng.search("tpu", fetch)
+        assert len(rs) == 2
+        assert rs[0].url.endswith("/TPU")
+        assert rs[1].content == "google asic"
+
+    def test_ddg_lite_engine_parses_table(self):
+        html = """
+        <table>
+          <tr><td><a class="result-link" href="http://one.com">One
+              site</a></td></tr>
+          <tr><td class="result-snippet">first snippet</td></tr>
+          <tr><td><a class="result-link" href="http://two.com">Two</a></td></tr>
+          <tr><td class="result-snippet">second</td></tr>
+        </table>"""
+        eng = DdgLiteEngine(base_url="http://ddg.local")
+        rs = eng.search("q", fake_fetch({"ddg.local": html}))
+        assert [(r.title, r.url) for r in rs] == [
+            ("One\n              site".replace("\n", "\n"), "http://one.com"),
+            ("Two", "http://two.com"),
+        ] or [r.url for r in rs] == ["http://one.com", "http://two.com"]
+
+    def test_engine_from_spec(self):
+        assert isinstance(
+            engine_from_spec({"kind": "searx", "url": "http://x"}),
+            SearxJsonEngine,
+        )
+        assert isinstance(
+            engine_from_spec({"kind": "mediawiki"}), MediaWikiEngine
+        )
+        assert isinstance(engine_from_spec({"kind": "ddg"}), DdgLiteEngine)
+        with pytest.raises(ValueError):
+            engine_from_spec({"kind": "nope"})
+
+
+class TestMetaSearch:
+    def _two_engines(self):
+        e1 = SearxJsonEngine("e1", "http://e1.local", weight=1.0)
+        e2 = SearxJsonEngine("e2", "http://e2.local", weight=1.0)
+        fetch = fake_fetch({
+            "e1.local": json.dumps({"results": [
+                {"title": "Shared", "url": "http://shared.com/x", "content": "s1"},
+                {"title": "OnlyE1", "url": "http://only1.com"},
+            ]}),
+            "e2.local": json.dumps({"results": [
+                {"title": "Shared dup", "url": "http://SHARED.com/x/",
+                 "content": "much longer snippet from e2"},
+                {"title": "OnlyE2", "url": "http://only2.com"},
+            ]}),
+        })
+        return MetaSearch(engines=[e1, e2], fetch=fetch)
+
+    def test_rrf_merge_and_dedup(self):
+        ms = self._two_engines()
+        out = ms.search("q")
+        urls = [r["url"] for r in out["results"]]
+        # the shared result (rank 1 on both) outranks singles
+        assert urls[0].startswith("http://shared.com") or urls[0].startswith(
+            "http://SHARED.com"
+        )
+        assert len(out["results"]) == 3          # dedup across case/slash
+        assert out["engines"] == {"e1": 2, "e2": 2}
+        # longest snippet wins for the merged entry
+        assert out["results"][0]["content"] == "much longer snippet from e2"
+
+    def test_engine_error_does_not_fail_query(self):
+        good = SearxJsonEngine("ok", "http://ok.local")
+        bad = SearxJsonEngine("bad", "http://bad.local")
+        fetch = fake_fetch({
+            "ok.local": json.dumps({"results": [
+                {"title": "T", "url": "http://t.com"}]}),
+        })
+        ms = MetaSearch(engines=[good, bad], fetch=fetch)
+        out = ms.search("q")
+        assert [r["url"] for r in out["results"]] == ["http://t.com"]
+        assert "bad" in ms.stats["engine_errors"]
+
+    def test_slow_engine_dropped_at_deadline(self):
+        fast = SearxJsonEngine("fast", "http://fast.local")
+        slow = SearxJsonEngine("slow", "http://slow.local")
+
+        def fetch(url, timeout=10.0):
+            if "slow" in url:
+                time.sleep(5)
+            return json.dumps({"results": [
+                {"title": "F", "url": "http://f.com"}]})
+
+        ms = MetaSearch(engines=[fast, slow], fetch=fetch,
+                        engine_timeout=0.5)
+        t0 = time.monotonic()
+        out = ms.search("q")
+        assert time.monotonic() - t0 < 3
+        assert [r["url"] for r in out["results"]] == ["http://f.com"]
+
+    def test_no_engines_is_loud(self):
+        ms = MetaSearch(engines=[])
+        with pytest.raises(RuntimeError):
+            ms.search("q")
+
+    def test_canonical_url(self):
+        assert _canonical("HTTP://A.com:80/x/?utm_source=t&b=1") == \
+            _canonical("http://a.com/x?b=1")
+        assert _canonical("https://a.com/") == _canonical("https://a.com")
+
+
+PAGE = """
+<html><head><title>Doc Title</title><style>.x{}</style></head><body>
+<nav><a href="/home">home</a><a href="/about">about</a></nav>
+<article>
+<p>The main body of the document talks about sequence parallelism on TPU
+meshes at considerable length, easily the densest text on the page.</p>
+<p>A second paragraph continues the discussion with more detail about ring
+attention and collective scheduling.</p>
+<p>See <a href="/paper">the paper</a> for details.</p>
+</article>
+<footer><a href="/tos">terms</a> copyright nobody</footer>
+</body></html>
+"""
+
+
+class TestReadability:
+    def test_extracts_main_text_not_chrome(self):
+        title, text, links = extract_readable(PAGE)
+        assert title == "Doc Title"
+        assert "sequence parallelism" in text
+        assert "ring\nattention" in text or "ring attention" in text
+        assert "copyright nobody" not in text
+        assert "home" not in text.splitlines()[0]
+        assert "/paper" in links
+
+    def test_malformed_html_no_crash(self):
+        title, text, _ = extract_readable("<p>ok<div><b>broken")
+        assert "ok" in text or title == ""
+
+
+class TestBrowserPool:
+    def _pool(self, **kw):
+        def fetch(url, timeout=15.0):
+            if "boom" in url:
+                raise ValueError("fetch failed")
+            return PAGE, "text/html"
+
+        return BrowserPool(factory=lambda: HttpBrowser(fetch=fetch), **kw)
+
+    def test_fetch_returns_readable_page(self):
+        pool = self._pool(size=1)
+        page = pool.fetch("http://site.test/doc")
+        assert page.title == "Doc Title"
+        assert "sequence parallelism" in page.text
+        assert any(l.endswith("/paper") for l in page.links)
+        assert page.links[0].startswith("http://site.test")
+
+    def test_lease_blocks_and_times_out(self):
+        pool = self._pool(size=1)
+        with pool.lease():
+            with pytest.raises(TimeoutError):
+                with pool.lease(timeout=0.2):
+                    pass
+        # released: can lease again
+        with pool.lease(timeout=1):
+            pass
+
+    def test_recycle_after_max_pages(self):
+        pool = self._pool(size=1, max_pages=2)
+        for _ in range(5):
+            pool.fetch("http://site.test/doc")
+        assert pool.stats["recycled"] >= 2
+        assert pool.stats["idle"] == 1
+
+    def test_crash_replaces_instance(self):
+        pool = self._pool(size=1)
+        with pytest.raises(ValueError):
+            pool.fetch("http://boom.test/x")
+        assert pool.stats["recycled"] == 1
+        assert pool.fetch("http://site.test/doc").title == "Doc Title"
+
+
+class TestAgentSkills:
+    def test_builtin_web_search_skill(self):
+        from helix_tpu.agent.skills import builtin_web_search_skill
+
+        ms = MetaSearch(
+            engines=[SearxJsonEngine("e", "http://e.local")],
+            fetch=fake_fetch({
+                "e.local": json.dumps({"results": [
+                    {"title": "TPU guide", "url": "http://g.com",
+                     "content": "all about tpus"},
+                ]})
+            }),
+        )
+        sk = builtin_web_search_skill(ms)
+        out = sk.handler(query="tpu")
+        assert "TPU guide" in out and "http://g.com" in out
+
+    def test_browser_skill(self):
+        from helix_tpu.agent.skills import browser_skill
+        from helix_tpu.knowledge.browser_pool import BrowserPool, HttpBrowser
+
+        pool = BrowserPool(
+            size=1,
+            factory=lambda: HttpBrowser(
+                fetch=lambda url, timeout=15.0: (PAGE, "text/html")
+            ),
+        )
+        out = browser_skill(pool).handler(url="http://x.test/doc")
+        assert out.startswith("# Doc Title")
+        assert "sequence parallelism" in out
+
+
+class TestSearchRoutes:
+    def test_search_and_browse_over_http(self):
+        import asyncio
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+        cp.metasearch = MetaSearch(
+            engines=[SearxJsonEngine("e1", "http://e1.local")],
+            fetch=fake_fetch({
+                "e1.local": json.dumps({"results": [
+                    {"title": "T", "url": "http://t.com", "content": "c"},
+                ]})
+            }),
+        )
+
+        def page_fetch(url, timeout=15.0):
+            return PAGE, "text/html"
+
+        cp.browser_pool = BrowserPool(
+            size=1, factory=lambda: HttpBrowser(fetch=page_fetch)
+        )
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                r = await client.get("/search", params={"q": "tpu",
+                                                        "format": "json"})
+                assert r.status == 200
+                data = await r.json()
+                assert data["results"][0]["url"] == "http://t.com"
+
+                r = await client.get("/api/v1/search", params={"q": ""})
+                assert r.status == 400
+
+                r = await client.post("/api/v1/browse",
+                                      json={"url": "http://site.test/d"})
+                assert r.status == 200
+                page = await r.json()
+                assert page["title"] == "Doc Title"
+                assert "sequence parallelism" in page["text"]
+            finally:
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
+
+    def test_unconfigured_search_is_503(self):
+        import asyncio
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+        cp.metasearch = MetaSearch(engines=[])
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                r = await client.get("/api/v1/search", params={"q": "x"})
+                assert r.status == 503
+            finally:
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )

@@ -1,0 +1,293 @@
+"""Zed editor bridge (instance/thread protocol over durable streams,
+``api/pkg/pubsub/zed_protocol.go``) and the per-session desktop MCP server
+(``api/pkg/desktop/mcp_server.go`` + ``server/mcp_backend_desktop.go``)."""
+
+import base64
+import json
+import time
+
+import numpy as np
+import pytest
+
+from helix_tpu.control.pubsub import EventBus
+from helix_tpu.desktop.gui import build_agent_desktop
+from helix_tpu.desktop.mcp_server import DesktopMCPServer
+from helix_tpu.services.zed_bridge import (
+    STREAM_EVENTS,
+    STREAM_INSTANCES,
+    STREAM_THREADS,
+    T_ACTIVITY,
+    T_HEARTBEAT,
+    T_INSTANCE_CREATE,
+    T_INSTANCE_CREATED,
+    T_INSTANCE_STOP,
+    T_THREAD_CREATE,
+    ZedBridge,
+    make_message,
+    validate_message,
+)
+
+
+class TestProtocol:
+    def test_envelope_shape(self):
+        m = make_message(T_HEARTBEAT, {"instance_id": "z1"})
+        validate_message(m)
+        assert m["version"] == "v1.0"
+        assert m["message_id"].startswith("zmsg_")
+
+    def test_validate_rejects_bad(self):
+        with pytest.raises(ValueError):
+            validate_message({"type": "x"})
+        bad = make_message(T_HEARTBEAT, {})
+        bad["version"] = "v9"
+        with pytest.raises(ValueError):
+            validate_message(bad)
+
+
+def _wait(pred, timeout=5.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+class TestZedBridge:
+    def _bridge(self, **kw):
+        bus = EventBus()
+        events = []
+        bus.subscribe(STREAM_EVENTS, lambda t, m: events.append(m))
+        # auto_evict off: eviction timing is asserted explicitly below
+        br = ZedBridge(bus, **kw).start(auto_evict=False)
+        return bus, br, events
+
+    def test_instance_create_answers_created(self):
+        bus, br, events = self._bridge()
+        req = make_message(T_INSTANCE_CREATE, {
+            "instance_id": "zed_a", "spec_task_id": "task_1",
+            "user_id": "u1", "project_path": "/w",
+            "initial_threads": [{"thread_id": "t1", "name": "impl"}],
+        })
+        bus.publish(STREAM_INSTANCES, req)
+        assert _wait(lambda: br.get("zed_a") is not None)
+        inst = br.get("zed_a")
+        assert inst.spec_task_id == "task_1"
+        assert "t1" in inst.threads
+        assert _wait(lambda: any(
+            e["type"] == T_INSTANCE_CREATED
+            and e["metadata"]["correlation_id"] == req["message_id"]
+            for e in events
+        ))
+        created = [e for e in events if e["type"] == T_INSTANCE_CREATED][0]
+        assert created["data"]["auth_token"]
+
+    def test_thread_create_and_activity_routes_to_task(self):
+        notes = []
+        bus, br, _ = self._bridge(
+            task_note=lambda tid, kind, note: notes.append((tid, kind, note))
+        )
+        bus.publish(STREAM_INSTANCES, make_message(T_INSTANCE_CREATE, {
+            "instance_id": "zed_b", "spec_task_id": "task_9",
+        }))
+        assert _wait(lambda: br.get("zed_b") is not None)
+        bus.publish(STREAM_THREADS, make_message(T_THREAD_CREATE, {
+            "instance_id": "zed_b",
+            "thread": {"thread_id": "t2", "work_session_id": "ws1"},
+        }))
+        assert _wait(lambda: "t2" in br.get("zed_b").threads)
+        bus.publish(STREAM_EVENTS, make_message(T_ACTIVITY, {
+            "instance_id": "zed_b", "thread_id": "t2",
+            "status": "working", "description": "editing engine.py",
+        }))
+        assert _wait(lambda: notes)
+        assert notes[0][0] == "task_9"
+        assert "editing engine.py" in notes[0][2]
+        assert br.get("zed_b").threads["t2"].status == "working"
+
+    def test_heartbeat_and_eviction(self):
+        bus, br, events = self._bridge(heartbeat_timeout=0.2)
+        bus.publish(STREAM_INSTANCES, make_message(T_INSTANCE_CREATE, {
+            "instance_id": "zed_c",
+        }))
+        assert _wait(lambda: br.get("zed_c") is not None)
+        bus.publish(STREAM_EVENTS, make_message(T_HEARTBEAT, {
+            "instance_id": "zed_c", "status": "running",
+        }))
+        time.sleep(0.05)
+        assert br.evict_stale() == []       # fresh heartbeat
+        time.sleep(0.3)
+        assert br.evict_stale() == ["zed_c"]
+        assert br.get("zed_c") is None
+
+    def test_auto_evictor_runs_without_explicit_calls(self):
+        bus = EventBus()
+        br = ZedBridge(bus, heartbeat_timeout=0.2).start()
+        bus.publish(STREAM_INSTANCES, make_message(T_INSTANCE_CREATE, {
+            "instance_id": "zed_auto",
+        }))
+        assert _wait(lambda: br.get("zed_auto") is not None)
+        # the background evictor (period <= timeout/3) removes it alone
+        assert _wait(lambda: br.get("zed_auto") is None, timeout=3.0)
+        br.stop()
+
+    def test_stop_removes_instance(self):
+        bus, br, events = self._bridge()
+        bus.publish(STREAM_INSTANCES, make_message(T_INSTANCE_CREATE, {
+            "instance_id": "zed_d",
+        }))
+        assert _wait(lambda: br.get("zed_d") is not None)
+        bus.publish(STREAM_INSTANCES, make_message(T_INSTANCE_STOP, {
+            "instance_id": "zed_d",
+        }))
+        assert _wait(lambda: br.get("zed_d") is None)
+
+
+class _FakeSession:
+    def __init__(self, source):
+        self.source = source
+
+
+class TestDesktopMCP:
+    def _mcp(self):
+        src, handles = build_agent_desktop()
+        return DesktopMCPServer(_FakeSession(src)), src, handles
+
+    def _call(self, srv, name, args=None, mid=1):
+        out = srv.handle({
+            "jsonrpc": "2.0", "id": mid, "method": "tools/call",
+            "params": {"name": name, "arguments": args or {}},
+        })
+        assert "error" not in out, out
+        return out["result"]
+
+    def test_initialize_and_list_tools(self):
+        srv, _, _ = self._mcp()
+        out = srv.handle({"jsonrpc": "2.0", "id": 1,
+                          "method": "initialize", "params": {}})
+        assert out["result"]["serverInfo"]["name"] == "helix-desktop"
+        out = srv.handle({"jsonrpc": "2.0", "id": 2, "method": "tools/list"})
+        names = {t["name"] for t in out["result"]["tools"]}
+        assert {"screenshot", "type_text", "mouse_click", "list_windows",
+                "focus_window", "get_clipboard"} <= names
+
+    def test_screenshot_returns_png(self):
+        srv, src, _ = self._mcp()
+        res = self._call(srv, "screenshot")
+        item = res["content"][0]
+        assert item["mimeType"] == "image/png"
+        png = base64.b64decode(item["data"])
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+
+    def test_click_type_flow_drives_the_gui(self):
+        srv, src, handles = self._mcp()
+        # click Approve through MCP (window 640,80 + title 22, widget 20,60)
+        self._call(srv, "mouse_click", {"x": 640 + 25, "y": 80 + 22 + 65})
+        assert handles["state"]["approved"] == 1
+        # focus console entry and type through MCP
+        self._call(srv, "mouse_click", {"x": 40 + 15, "y": 40 + 22 + 295})
+        self._call(srv, "type_text", {"text": "make test"})
+        self._call(srv, "press_key", {"key": "Enter"})
+        assert any("make test" in ln for ln in handles["log"].lines)
+
+    def test_window_management(self):
+        srv, src, _ = self._mcp()
+        wins = json.loads(
+            self._call(srv, "list_windows")["content"][0]["text"]
+        )
+        titles = {w["title"] for w in wins}
+        assert {"agent console", "approval"} <= titles
+        self._call(srv, "focus_window", {"title": "agent console"})
+        wins = json.loads(
+            self._call(srv, "list_windows")["content"][0]["text"]
+        )
+        assert next(
+            w for w in wins if w["title"] == "agent console"
+        )["focused"]
+        self._call(srv, "move_window",
+                   {"title": "approval", "x": 5, "y": 7})
+        wins = json.loads(
+            self._call(srv, "list_windows")["content"][0]["text"]
+        )
+        ap = next(w for w in wins if w["title"] == "approval")
+        assert (ap["x"], ap["y"]) == (5, 7)
+
+    def test_clipboard_roundtrip(self):
+        srv, _, _ = self._mcp()
+        self._call(srv, "set_clipboard", {"text": "secret plan"})
+        assert self._call(
+            srv, "get_clipboard"
+        )["content"][0]["text"] == "secret plan"
+
+    def test_unknown_method_and_tool_errors(self):
+        srv, _, _ = self._mcp()
+        out = srv.handle({"jsonrpc": "2.0", "id": 9, "method": "nope"})
+        assert out["error"]["code"] == -32601
+        out = srv.handle({
+            "jsonrpc": "2.0", "id": 10, "method": "tools/call",
+            "params": {"name": "bad_tool", "arguments": {}},
+        })
+        assert out["error"]["code"] == -32000
+        # notifications get no reply
+        assert srv.handle({"jsonrpc": "2.0",
+                           "method": "notifications/initialized"}) is None
+
+
+class TestZedAndMCPRoutes:
+    def test_http_surface(self):
+        import asyncio
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                # zed instance lifecycle over HTTP
+                r = await client.post("/api/v1/zed/instances", json={
+                    "instance_id": "zed_http", "spec_task_id": "t1",
+                })
+                assert r.status == 201, await r.text()
+                inst = await r.json()
+                assert inst["id"] == "zed_http"
+                r = await client.get("/api/v1/zed/instances")
+                assert [i["id"] for i in (await r.json())["instances"]] == \
+                    ["zed_http"]
+                r = await client.delete("/api/v1/zed/instances/zed_http")
+                assert r.status == 200
+
+                # desktop MCP over HTTP against a GUI desktop
+                r = await client.post(
+                    "/api/v1/desktops",
+                    json={"kind": "gui", "name": "mcp-target"},
+                )
+                did = (await r.json())["id"]
+                r = await client.post(
+                    f"/api/v1/desktops/{did}/mcp",
+                    json={"jsonrpc": "2.0", "id": 1,
+                          "method": "tools/list"},
+                )
+                assert r.status == 200
+                tools = (await r.json())["result"]["tools"]
+                assert any(t["name"] == "screenshot" for t in tools)
+                r = await client.post(
+                    f"/api/v1/desktops/{did}/mcp",
+                    json={"jsonrpc": "2.0", "id": 2,
+                          "method": "tools/call",
+                          "params": {"name": "mouse_click",
+                                     "arguments": {"x": 665, "y": 167}}},
+                )
+                assert r.status == 200
+                sess = cp.desktops.get(did)
+                assert sess.source.handles["state"]["approved"] == 1
+            finally:
+                cp.desktops.stop_all()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
