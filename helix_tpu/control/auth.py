@@ -65,6 +65,31 @@ CREATE TABLE IF NOT EXISTS secrets (
 );
 """
 
+_TEAMS_SCHEMA = """
+CREATE TABLE IF NOT EXISTS org_teams (
+    id TEXT PRIMARY KEY,
+    org_id TEXT NOT NULL,
+    name TEXT NOT NULL,
+    created_at REAL NOT NULL,
+    UNIQUE(org_id, name)
+);
+CREATE TABLE IF NOT EXISTS team_members (
+    team_id TEXT NOT NULL,
+    user_id TEXT NOT NULL,
+    added_at REAL NOT NULL,
+    PRIMARY KEY (team_id, user_id)
+);
+CREATE TABLE IF NOT EXISTS org_invitations (
+    id TEXT PRIMARY KEY,
+    org_id TEXT NOT NULL,
+    email TEXT NOT NULL,
+    role TEXT NOT NULL,
+    token TEXT NOT NULL UNIQUE,
+    created_at REAL NOT NULL,
+    accepted_by TEXT NOT NULL DEFAULT ''
+);
+"""
+
 ROLES = ("owner", "admin", "member")
 
 
@@ -84,7 +109,10 @@ class Authenticator:
         self._db_path = self._db.path
         self._conn = self._db.conn
         self._lock = self._db.lock
-        self._db.migrate("auth", [(1, "initial", _SCHEMA)])
+        self._db.migrate("auth", [
+            (1, "initial", _SCHEMA),
+            (2, "teams_invitations", _TEAMS_SCHEMA),
+        ])
         if master_key is None:
             env_key = os.environ.get("HELIX_MASTER_KEY")
             if env_key:
@@ -291,6 +319,159 @@ class Authenticator:
             rows = self._conn.execute(q, args).fetchall()
         return [{"id": r[0], "name": r[1]} for r in rows]
 
+    # -- teams (org sub-groups, reference /organizations/{}/teams) --------
+    def create_team(self, org_id: str, name: str) -> dict:
+        tid = f"team_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            org = self._conn.execute(
+                "SELECT id FROM orgs WHERE id=?", (org_id,)
+            ).fetchone()
+            if org is None:
+                raise KeyError(org_id)
+            self._conn.execute(
+                "INSERT INTO org_teams(id, org_id, name, created_at)"
+                " VALUES(?,?,?,?)",
+                (tid, org_id, name, time.time()),
+            )
+            self._db.commit()
+        return {"id": tid, "org_id": org_id, "name": name, "members": []}
+
+    def list_teams(self, org_id: str) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, name FROM org_teams WHERE org_id=?"
+                " ORDER BY name",
+                (org_id,),
+            ).fetchall()
+        return [
+            {"id": r[0], "org_id": org_id, "name": r[1],
+             "members": self.team_members(r[0])}
+            for r in rows
+        ]
+
+    def delete_team(self, team_id: str) -> bool:
+        with self._db.transaction():
+            cur = self._conn.execute(
+                "DELETE FROM org_teams WHERE id=?", (team_id,)
+            )
+            self._conn.execute(
+                "DELETE FROM team_members WHERE team_id=?", (team_id,)
+            )
+        return cur.rowcount > 0
+
+    def add_team_member(self, team_id: str, user_id: str) -> None:
+        with self._lock:
+            team = self._conn.execute(
+                "SELECT org_id FROM org_teams WHERE id=?", (team_id,)
+            ).fetchone()
+            if team is None:
+                raise KeyError(team_id)
+            # team membership requires org membership first
+            if self._conn.execute(
+                "SELECT 1 FROM org_members WHERE org_id=? AND user_id=?",
+                (team[0], user_id),
+            ).fetchone() is None:
+                raise PermissionError(
+                    "user must be an org member before joining a team"
+                )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO team_members(team_id, user_id,"
+                " added_at) VALUES(?,?,?)",
+                (team_id, user_id, time.time()),
+            )
+            self._db.commit()
+
+    def remove_team_member(self, team_id: str, user_id: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM team_members WHERE team_id=? AND user_id=?",
+                (team_id, user_id),
+            )
+            self._db.commit()
+        return cur.rowcount > 0
+
+    def team_members(self, team_id: str) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT tm.user_id, u.email, u.name FROM team_members tm"
+                " LEFT JOIN users u ON u.id = tm.user_id"
+                " WHERE tm.team_id=? ORDER BY tm.added_at",
+                (team_id,),
+            ).fetchall()
+        return [
+            {"user_id": r[0], "email": r[1] or "", "name": r[2] or ""}
+            for r in rows
+        ]
+
+    # -- invitations (email -> role grant on accept) ----------------------
+    def create_invitation(self, org_id: str, email: str,
+                          role: str = "member") -> dict:
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}")
+        iid = f"inv_{uuid.uuid4().hex[:12]}"
+        token = uuid.uuid4().hex + uuid.uuid4().hex
+        with self._lock:
+            if self._conn.execute(
+                "SELECT id FROM orgs WHERE id=?", (org_id,)
+            ).fetchone() is None:
+                raise KeyError(org_id)
+            self._conn.execute(
+                "INSERT INTO org_invitations(id, org_id, email, role,"
+                " token, created_at) VALUES(?,?,?,?,?,?)",
+                (iid, org_id, email, role, token, time.time()),
+            )
+            self._db.commit()
+        return {"id": iid, "org_id": org_id, "email": email, "role": role,
+                "token": token}
+
+    def list_invitations(self, org_id: str) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, email, role, created_at, accepted_by"
+                " FROM org_invitations WHERE org_id=? ORDER BY created_at",
+                (org_id,),
+            ).fetchall()
+        return [
+            {"id": r[0], "org_id": org_id, "email": r[1], "role": r[2],
+             "created_at": r[3], "accepted": bool(r[4])}
+            for r in rows
+        ]
+
+    def accept_invitation(self, token: str, user_id: str) -> dict:
+        """Token + authenticated user -> org membership at the invited
+        role. One-shot: a token accepts once."""
+        with self._db.transaction():
+            row = self._conn.execute(
+                "SELECT id, org_id, role, accepted_by FROM org_invitations"
+                " WHERE token=?",
+                (token,),
+            ).fetchone()
+            if row is None:
+                raise KeyError("invitation not found")
+            if row[3]:
+                raise PermissionError("invitation already accepted")
+            self._conn.execute(
+                "UPDATE org_invitations SET accepted_by=? WHERE id=?",
+                (user_id, row[0]),
+            )
+            # never DOWNGRADE an existing member: an owner accepting a
+            # stale member-role invitation must stay owner
+            existing = self._conn.execute(
+                "SELECT role FROM org_members WHERE org_id=? AND user_id=?",
+                (row[1], user_id),
+            ).fetchone()
+            role = row[2]
+            if existing is not None and (
+                ROLES.index(existing[0]) < ROLES.index(role)
+            ):
+                role = existing[0]
+            self._conn.execute(
+                "INSERT OR REPLACE INTO org_members(org_id, user_id, role)"
+                " VALUES(?,?,?)",
+                (row[1], user_id, role),
+            )
+        return {"org_id": row[1], "role": role}
+
     def authorize(
         self,
         user: Optional[User],
@@ -310,6 +491,21 @@ class Authenticator:
                 return False
             return ROLES.index(role) <= ROLES.index(min_role)
         return False
+
+    def search_users(self, q: str, limit: int = 20) -> list:
+        """Substring match over email/name (reference /users/search)."""
+        like = f"%{q}%"
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, email, name, admin FROM users"
+                " WHERE (email LIKE ? OR name LIKE ?) AND email NOT LIKE ?"
+                " ORDER BY email LIMIT ?",
+                (like, like, "svc:%", limit),
+            ).fetchall()
+        return [
+            {"id": r[0], "email": r[1], "name": r[2], "admin": bool(r[3])}
+            for r in rows
+        ]
 
     def set_admin(self, uid: str, admin: bool) -> None:
         with self._lock:

@@ -103,6 +103,18 @@ class InferenceRouter:
                     out.update(st.models)
             return sorted(out)
 
+    def model_map(self) -> dict:
+        """{model: [runner ids serving it]} over routable, fresh runners
+        (the /api/v1/model-info shape)."""
+        now = time.monotonic()
+        with self._lock:
+            out: dict = {}
+            for st in sorted(self._runners.values(), key=lambda s: s.id):
+                if st.routable and now - st.last_heartbeat <= self.ttl:
+                    for m in st.models:
+                        out.setdefault(m, []).append(st.id)
+            return out
+
     def pick_runner(self, model: str) -> Optional[RunnerState]:
         """Per-model round-robin over routable runners serving ``model``."""
         now = time.monotonic()

@@ -242,6 +242,11 @@ class ControlPlane:
         self.git = GitService(git_root)
         self.task_store = TaskStore(self.db)
 
+        # projects: the grouping layer over kanban boards + repos
+        from helix_tpu.services.projects import ProjectService
+
+        self.projects = ProjectService(self.db, task_store=self.task_store)
+
         class _ProviderLLM:
             """Resolve per call so agents follow provider availability."""
 
@@ -879,6 +884,79 @@ class ControlPlane:
         r.add_get("/api/v1/repos", self.list_repos)
         r.add_get("/git/{repo}/info/refs", self.git_info_refs)
         r.add_post("/git/{repo}/{service}", self.git_rpc)
+        # git browse API (reference /api/v1/git/repositories family)
+        r.add_get("/api/v1/git/repositories", self.list_repos)
+        r.add_post("/api/v1/git/repositories", self.git_create_repo)
+        r.add_get("/api/v1/git/repositories/{repo}", self.git_repo_meta)
+        r.add_get(
+            "/api/v1/git/repositories/{repo}/branches", self.git_branches
+        )
+        r.add_get(
+            "/api/v1/git/repositories/{repo}/commits", self.git_commits
+        )
+        r.add_get("/api/v1/git/repositories/{repo}/tree", self.git_tree)
+        r.add_get(
+            "/api/v1/git/repositories/{repo}/file-content",
+            self.git_file_content,
+        )
+        r.add_get("/api/v1/git/repositories/{repo}/grep", self.git_grep)
+        r.add_get(
+            "/api/v1/git/repositories/{repo}/clone-command",
+            self.git_clone_command,
+        )
+        # projects (kanban grouping layer)
+        r.add_get("/api/v1/projects", self.projects_list)
+        r.add_post("/api/v1/projects", self.projects_create)
+        r.add_get("/api/v1/projects/{id}", self.projects_get)
+        r.add_put("/api/v1/projects/{id}", self.projects_update)
+        r.add_delete("/api/v1/projects/{id}", self.projects_delete)
+        r.add_post("/api/v1/projects/{id}/pin", self.projects_pin)
+        r.add_get(
+            "/api/v1/projects/{id}/tasks-progress",
+            self.projects_tasks_progress,
+        )
+        r.add_post(
+            "/api/v1/projects/{id}/repositories/{repo}/attach",
+            self.projects_attach_repo,
+        )
+        r.add_post(
+            "/api/v1/projects/{id}/repositories/{repo}/detach",
+            self.projects_detach_repo,
+        )
+        # per-user settings (reference /users/me/* family)
+        r.add_get("/api/v1/users/me/settings/{key}", self.user_pref_get)
+        r.add_put("/api/v1/users/me/settings/{key}", self.user_pref_put)
+        r.add_get("/api/v1/users/search", self.users_search)
+        # observability + model metadata
+        r.add_get("/api/v1/llm_calls", self.list_llm_calls)
+        r.add_get("/api/v1/model-info", self.model_info)
+        # manual trigger execution (reference /triggers/{}/execute)
+        r.add_post(
+            "/api/v1/triggers/{id}/execute", self.trigger_execute
+        )
+        # org teams + invitations
+        r.add_get("/api/v1/orgs/{id}/teams", self.org_teams_list)
+        r.add_post("/api/v1/orgs/{id}/teams", self.org_teams_create)
+        r.add_delete(
+            "/api/v1/orgs/{id}/teams/{team}", self.org_teams_delete
+        )
+        r.add_post(
+            "/api/v1/orgs/{id}/teams/{team}/members",
+            self.org_team_add_member,
+        )
+        r.add_delete(
+            "/api/v1/orgs/{id}/teams/{team}/members/{user}",
+            self.org_team_remove_member,
+        )
+        r.add_get(
+            "/api/v1/orgs/{id}/invitations", self.org_invitations_list
+        )
+        r.add_post(
+            "/api/v1/orgs/{id}/invitations", self.org_invitations_create
+        )
+        r.add_post(
+            "/api/v1/invitations/accept", self.org_invitation_accept
+        )
         # org (bot org-chart + channels)
         r.add_get("/api/v1/org/bots", self.org_list_bots)
         r.add_post("/api/v1/org/bots", self.org_create_bot)
@@ -1918,6 +1996,355 @@ class ControlPlane:
     async def list_repos(self, request):
         return web.json_response({"repos": self.git.list_repos()})
 
+    # -- git browse API --------------------------------------------------------
+    def _repo_or_404(self, request):
+        repo = request.match_info["repo"]
+        if not self.git.repo_exists(repo):
+            return None
+        return repo
+
+    async def git_create_repo(self, request):
+        body = await request.json()
+        name = body.get("name", "")
+        if not name or "/" in name or name.startswith("."):
+            return _err(400, "invalid repo name")
+        if self.git.repo_exists(name):
+            return _err(409, "repo exists")
+        self.git.create_repo(
+            name, default_branch=body.get("default_branch", "main")
+        )
+        return web.json_response({"name": name}, status=201)
+
+    async def git_repo_meta(self, request):
+        repo = self._repo_or_404(request)
+        if repo is None:
+            return _err(404, "repo not found")
+        branches = self.git.branches(repo)
+        return web.json_response({
+            "name": repo, "branches": branches,
+            "default_branch": "main" if "main" in branches else (
+                branches[0] if branches else "main"
+            ),
+        })
+
+    async def git_branches(self, request):
+        repo = self._repo_or_404(request)
+        if repo is None:
+            return _err(404, "repo not found")
+        return web.json_response({"branches": self.git.branches(repo)})
+
+    async def git_commits(self, request):
+        repo = self._repo_or_404(request)
+        if repo is None:
+            return _err(404, "repo not found")
+        limit, err = self._parse_limit(request)
+        if err is not None:
+            return err
+        from helix_tpu.services.git_service import GitError
+
+        try:
+            commits = self.git.log(
+                repo,
+                branch=request.query.get("branch", "main"),
+                limit=limit,
+            )
+        except GitError as e:
+            return _err(400, str(e))
+        return web.json_response({"commits": commits})
+
+    async def git_tree(self, request):
+        repo = self._repo_or_404(request)
+        if repo is None:
+            return _err(404, "repo not found")
+        from helix_tpu.services.git_service import GitError
+
+        try:
+            entries = self.git.tree(
+                repo,
+                branch=request.query.get("branch", "main"),
+                path=request.query.get("path", ""),
+            )
+        except GitError as e:
+            return _err(400, str(e))
+        return web.json_response({"entries": entries})
+
+    async def git_file_content(self, request):
+        repo = self._repo_or_404(request)
+        if repo is None:
+            return _err(404, "repo not found")
+        from helix_tpu.services.git_service import GitError
+
+        try:
+            content = self.git.file_at(
+                repo,
+                request.query.get("branch", "main"),
+                request.query.get("path", ""),
+            )
+        except GitError as e:
+            return _err(400, str(e))
+        if content is None:
+            return _err(404, "file not found")
+        return web.json_response({
+            "path": request.query.get("path", ""), "content": content,
+        })
+
+    async def git_grep(self, request):
+        repo = self._repo_or_404(request)
+        if repo is None:
+            return _err(404, "repo not found")
+        q = request.query.get("q", "")
+        if not q:
+            return _err(400, "missing q")
+        from helix_tpu.services.git_service import GitError
+
+        try:
+            hits = self.git.grep(
+                repo, q, branch=request.query.get("branch", "main")
+            )
+        except GitError as e:
+            return _err(400, str(e))
+        return web.json_response({"hits": hits})
+
+    async def git_clone_command(self, request):
+        repo = self._repo_or_404(request)
+        if repo is None:
+            return _err(404, "repo not found")
+        host = request.headers.get("Host", "localhost")
+        scheme = request.scheme
+        return web.json_response({
+            "command": f"git clone {scheme}://{host}/git/{repo}",
+        })
+
+    # -- projects --------------------------------------------------------------
+    async def projects_list(self, request):
+        return web.json_response({"projects": self.projects.list()})
+
+    async def projects_create(self, request):
+        body = await request.json()
+        try:
+            p = self.projects.create(
+                body.get("name", ""),
+                description=body.get("description", ""),
+                owner=self._user_id(request),
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(p, status=201)
+
+    async def projects_get(self, request):
+        p = self.projects.get(request.match_info["id"])
+        if p is None:
+            return _err(404, "project not found")
+        return web.json_response(p)
+
+    async def projects_update(self, request):
+        body = await request.json()
+        try:
+            p = self.projects.update(
+                request.match_info["id"],
+                name=body.get("name"),
+                description=body.get("description"),
+                labels=body.get("labels"),
+                pinned=body.get("pinned"),
+            )
+        except KeyError:
+            return _err(404, "project not found")
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(p)
+
+    async def projects_delete(self, request):
+        ok = self.projects.delete(request.match_info["id"])
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def projects_pin(self, request):
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        try:
+            p = self.projects.update(
+                request.match_info["id"], pinned=body.get("pinned", True)
+            )
+        except KeyError:
+            return _err(404, "project not found")
+        return web.json_response(p)
+
+    async def projects_tasks_progress(self, request):
+        try:
+            return web.json_response(
+                self.projects.tasks_progress(request.match_info["id"])
+            )
+        except KeyError:
+            return _err(404, "project not found")
+
+    async def projects_attach_repo(self, request):
+        repo = request.match_info["repo"]
+        if not self.git.repo_exists(repo):
+            return _err(404, "repo not found")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        try:
+            self.projects.attach_repo(
+                request.match_info["id"], repo,
+                primary=bool(body.get("primary")),
+            )
+        except KeyError:
+            return _err(404, "project not found")
+        return web.json_response({"ok": True})
+
+    async def projects_detach_repo(self, request):
+        ok = self.projects.detach_repo(
+            request.match_info["id"], request.match_info["repo"]
+        )
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    # -- per-user settings -----------------------------------------------------
+    _USER_PREF_KEYS = (
+        "chat-settings", "color-scheme", "onboarding", "guidelines",
+        "pinned-projects",
+    )
+
+    async def user_pref_get(self, request):
+        key = request.match_info["key"]
+        if key not in self._USER_PREF_KEYS:
+            return _err(404, f"unknown setting {key!r}")
+        owner = self._user_id(request)
+        return web.json_response({
+            "key": key,
+            "value": self.store.kv_get(f"userpref:{owner}:{key}"),
+        })
+
+    async def user_pref_put(self, request):
+        key = request.match_info["key"]
+        if key not in self._USER_PREF_KEYS:
+            return _err(404, f"unknown setting {key!r}")
+        body = await request.json()
+        owner = self._user_id(request)
+        self.store.kv_set(f"userpref:{owner}:{key}", body.get("value"))
+        return web.json_response({"ok": True})
+
+    # -- org teams + invitations -----------------------------------------------
+    def _org_admin_denied(self, request, oid: str):
+        """Same gate the existing member-management routes enforce
+        (add_member): org admin or platform admin."""
+        user = request.get("user")
+        if self.auth_required and not self.auth.authorize(
+            user, org_id=oid, min_role="admin"
+        ):
+            return _err(403, "admin role required")
+        return None
+
+    def _team_in_org(self, request):
+        """Resolve {team} AND verify it belongs to the {id} org segment —
+        a team id from org B must not be reachable through org A's path."""
+        oid = request.match_info["id"]
+        team_id = request.match_info["team"]
+        if any(t["id"] == team_id for t in self.auth.list_teams(oid)):
+            return oid, team_id
+        return oid, None
+
+    async def org_teams_list(self, request):
+        return web.json_response(
+            {"teams": self.auth.list_teams(request.match_info["id"])}
+        )
+
+    async def org_teams_create(self, request):
+        oid = request.match_info["id"]
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        body = await request.json()
+        try:
+            team = self.auth.create_team(oid, body.get("name", ""))
+        except KeyError:
+            return _err(404, "org not found")
+        except Exception as e:  # noqa: BLE001 — duplicate name etc.
+            return _err(400, str(e))
+        return web.json_response(team, status=201)
+
+    async def org_teams_delete(self, request):
+        oid, team_id = self._team_in_org(request)
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        if team_id is None:
+            return _err(404, "team not found in this org")
+        ok = self.auth.delete_team(team_id)
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def org_team_add_member(self, request):
+        oid, team_id = self._team_in_org(request)
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        if team_id is None:
+            return _err(404, "team not found in this org")
+        body = await request.json()
+        try:
+            self.auth.add_team_member(team_id, body.get("user_id", ""))
+        except KeyError:
+            return _err(404, "team not found")
+        except PermissionError as e:
+            return _err(403, str(e))
+        return web.json_response({"ok": True})
+
+    async def org_team_remove_member(self, request):
+        oid, team_id = self._team_in_org(request)
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        if team_id is None:
+            return _err(404, "team not found in this org")
+        ok = self.auth.remove_team_member(
+            team_id, request.match_info["user"]
+        )
+        return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    async def org_invitations_list(self, request):
+        oid = request.match_info["id"]
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        return web.json_response({
+            "invitations": self.auth.list_invitations(oid)
+        })
+
+    async def org_invitations_create(self, request):
+        oid = request.match_info["id"]
+        # inviting (and receiving the accept token!) is org-admin only —
+        # otherwise any user invites themselves into any org at any role
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        body = await request.json()
+        try:
+            inv = self.auth.create_invitation(
+                oid, body.get("email", ""),
+                role=body.get("role", "member"),
+            )
+        except KeyError:
+            return _err(404, "org not found")
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(inv, status=201)
+
+    async def org_invitation_accept(self, request):
+        body = await request.json()
+        user = request.get("user")
+        uid = user.id if user else body.get("user_id", "")
+        if not uid:
+            return _err(400, "authenticated user required")
+        try:
+            out = self.auth.accept_invitation(body.get("token", ""), uid)
+        except KeyError:
+            return _err(404, "invitation not found")
+        except PermissionError as e:
+            return _err(409, str(e))
+        return web.json_response(out)
+
     # -- org (bot org-chart) ---------------------------------------------------
     async def org_list_bots(self, request):
         return web.json_response(
@@ -2684,6 +3111,56 @@ class ControlPlane:
                 ],
             }
         )
+
+    async def model_info(self, request):
+        """Model metadata beyond the bare /v1/models ids (reference
+        /api/v1/model-info): serving runners + provider endpoints."""
+        info = [
+            {"id": m, "runners": runners, "source": "runner"}
+            for m, runners in sorted(self.router.model_map().items())
+        ]
+        for name in self.providers.names():
+            info.append({
+                "id": name, "runners": [], "source": "provider",
+            })
+        return web.json_response({"models": info})
+
+    async def list_llm_calls(self, request):
+        limit, err = self._parse_limit(request, default=100, cap=1000)
+        if err is not None:
+            return err
+        return web.json_response({
+            "calls": self.store.list_llm_calls(
+                session_id=request.query.get("session_id", ""),
+                limit=limit,
+            )
+        })
+
+    async def users_search(self, request):
+        q = request.query.get("q", "")
+        if not q:
+            return _err(400, "missing q")
+        return web.json_response({"users": self.auth.search_users(q)})
+
+    async def trigger_execute(self, request):
+        """Manual run of a trigger with an inline payload — the 'Run now'
+        button (reference /triggers/{}/execute). Admin-gated: this path
+        intentionally skips the webhook secret (which authenticates
+        external callers), so only operators may use it."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        tid = request.match_info["id"]
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        fired = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.triggers.fire_manual(tid, body)
+        )
+        if not fired:
+            return _err(404, "trigger not found or disabled")
+        return web.json_response({"ok": True, "trigger": tid})
 
     async def dispatch_openai(self, request):
         """Pick a runner by model, stream the response through unbuffered

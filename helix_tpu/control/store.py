@@ -320,6 +320,27 @@ class Store:
             )
             self._db.commit()
 
+    def list_llm_calls(self, session_id: str = "", limit: int = 100) -> list:
+        """Admin observability surface (reference /api/v1/llm_calls):
+        newest first, optionally filtered to one session."""
+        q = ("SELECT id, session_id, model, provider, doc, created_at"
+             " FROM llm_calls")
+        args: tuple = ()
+        if session_id:
+            q += " WHERE session_id=?"
+            args = (session_id,)
+        q += " ORDER BY created_at DESC LIMIT ?"
+        with self._lock:
+            rows = self._conn.execute(q, (*args, limit)).fetchall()
+        return [
+            {
+                "id": r[0], "session_id": r[1], "model": r[2],
+                "provider": r[3], "doc": json.loads(r[4]),
+                "created_at": r[5],
+            }
+            for r in rows
+        ]
+
     def add_usage(self, owner: str, model: str, prompt: int, completion: int):
         with self._lock:
             self._conn.execute(

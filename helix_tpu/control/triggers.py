@@ -309,6 +309,17 @@ class TriggerManager:
             t.enabled = enabled
 
     # -- firing --------------------------------------------------------------
+    def fire_manual(self, tid: str, payload: dict) -> bool:
+        """Operator-initiated 'Run now' (the /triggers/{id}/execute
+        surface). Caller is responsible for authorization — this path
+        deliberately bypasses the webhook secret, which authenticates
+        EXTERNAL callers, not the operator console."""
+        t = self._triggers.get(tid)
+        if t is None or not t.enabled:
+            return False
+        self._do_fire(t, payload)
+        return True
+
     def fire_webhook(self, tid: str, payload: dict, secret: str = "") -> bool:
         t = self._triggers.get(tid)
         if t is None or not t.enabled or t.kind == "cron":

@@ -25,6 +25,17 @@ class GitError(RuntimeError):
     pass
 
 
+def _safe_ref(ref: str) -> str:
+    """Reject ref/path values that could be parsed as git OPTIONS when
+    interpolated into argv (e.g. ``--open-files-in-pager=cmd`` on
+    ``git grep`` executes the command; ``--output=path`` on ``git log``
+    writes server files).  Every user-facing branch/path query param must
+    pass through here before reaching a git command."""
+    if not ref or ref.startswith("-") or "\x00" in ref:
+        raise GitError(f"invalid ref or path {ref!r}")
+    return ref
+
+
 def _run(args, cwd=None, input_bytes=None, check=True) -> bytes:
     p = subprocess.run(
         args, cwd=cwd, input=input_bytes,
@@ -138,8 +149,9 @@ class GitService:
         try:
             out = _run(
                 ["git", "-C", self._repo_path(name), "log",
-                 f"--max-count={limit}", "--format=%H%x00%an%x00%at%x00%s",
-                 branch],
+                 f"--max-count={int(limit)}",
+                 "--format=%H%x00%an%x00%at%x00%s",
+                 _safe_ref(branch), "--"],
             )
         except GitError:
             return []
@@ -162,6 +174,62 @@ class GitService:
         except GitError:
             return False
 
+    def tree(self, name: str, branch: str = "main", path: str = "") -> list:
+        """One directory level (the /git/repositories/{id}/tree shape):
+        [{path, type: blob|tree, size}]."""
+        _safe_ref(branch)
+        if path:
+            _safe_ref(path)
+        spec = f"{branch}:{path}" if path else branch
+        try:
+            out = _run(
+                ["git", "-C", self._repo_path(name), "ls-tree", "--long",
+                 spec, "--"],
+            )
+        except GitError:
+            return []
+        entries = []
+        for line in out.decode().splitlines():
+            # <mode> <type> <sha> <size>\t<name>
+            meta, fname = line.split("\t", 1)
+            parts = meta.split()
+            entries.append({
+                "path": (path.rstrip("/") + "/" if path else "") + fname,
+                "name": fname,
+                "type": parts[1],
+                "size": 0 if parts[3] == "-" else int(parts[3]),
+            })
+        return sorted(
+            entries, key=lambda e: (e["type"] != "tree", e["name"])
+        )
+
+    def grep(self, name: str, pattern: str, branch: str = "main",
+             max_results: int = 200) -> list:
+        """Regex search over a branch's tree (the /git/repositories/{id}/
+        grep shape): [{path, line, text}]."""
+        try:
+            out = _run(
+                ["git", "-C", self._repo_path(name), "grep", "-nIE",
+                 "--max-count", "50", "-e", pattern, _safe_ref(branch),
+                 "--"],
+                check=False,
+            )
+        except GitError:
+            return []
+        hits = []
+        for line in out.decode(errors="replace").splitlines():
+            # <branch>:<path>:<lineno>:<text>
+            try:
+                _ref, path, lineno, text = line.split(":", 3)
+            except ValueError:
+                continue
+            hits.append({
+                "path": path, "line": int(lineno), "text": text[:400],
+            })
+            if len(hits) >= max_results:
+                break
+        return hits
+
     def diff(self, name: str, base: str, head: str) -> str:
         out = _run(
             ["git", "-C", self._repo_path(name), "diff",
@@ -173,7 +241,7 @@ class GitService:
         try:
             out = _run(
                 ["git", "-C", self._repo_path(name), "show",
-                 f"{branch}:{path}"],
+                 f"{_safe_ref(branch)}:{_safe_ref(path)}"],
             )
         except GitError:
             return None

@@ -1,0 +1,203 @@
+"""Projects: the grouping layer over spec-task kanbans.
+
+The reference organizes everything under projects — boards of spec
+tasks, attached git repositories, labels, pins, per-project usage
+(``api/pkg/server/server.go`` ``/api/v1/projects*`` family backed by the
+project store).  Our spec tasks always carried a ``project`` field; this
+service gives it a real entity: CRUD + labels + pin + repository
+attachments + task-progress aggregation, all on the consolidated
+control-plane database (one migration path, cross-entity transactions).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import List, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS projects (
+  id TEXT PRIMARY KEY,
+  name TEXT NOT NULL UNIQUE,
+  description TEXT NOT NULL DEFAULT '',
+  owner TEXT NOT NULL DEFAULT '',
+  labels TEXT NOT NULL DEFAULT '[]',
+  pinned INTEGER NOT NULL DEFAULT 0,
+  created_at REAL NOT NULL,
+  updated_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS project_repos (
+  project_id TEXT NOT NULL,
+  repo TEXT NOT NULL,
+  is_primary INTEGER NOT NULL DEFAULT 0,
+  attached_at REAL NOT NULL,
+  PRIMARY KEY (project_id, repo)
+);
+"""
+
+
+class ProjectService:
+    def __init__(self, db_or_path=":memory:", task_store=None):
+        from helix_tpu.control.db import Database
+
+        self._db = Database.resolve(db_or_path)
+        self._conn = self._db.conn
+        self._lock = self._db.lock
+        self._db.migrate("projects", [(1, "initial", _SCHEMA)])
+        self.task_store = task_store
+
+    # -- CRUD --------------------------------------------------------------
+    def create(self, name: str, description: str = "", owner: str = ""
+               ) -> dict:
+        if not name or "/" in name:
+            raise ValueError("invalid project name")
+        pid = f"prj_{uuid.uuid4().hex[:12]}"
+        now = time.time()
+        with self._lock:
+            dup = self._conn.execute(
+                "SELECT id FROM projects WHERE name=?", (name,)
+            ).fetchone()
+            if dup:
+                raise ValueError(f"project {name!r} already exists")
+            self._conn.execute(
+                "INSERT INTO projects(id, name, description, owner, labels,"
+                " pinned, created_at, updated_at) VALUES(?,?,?,?,?,0,?,?)",
+                (pid, name, description, owner, "[]", now, now),
+            )
+            self._db.commit()
+        return self.get(pid)
+
+    def get(self, pid_or_name: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, name, description, owner, labels, pinned,"
+                " created_at, updated_at FROM projects WHERE id=? OR name=?",
+                (pid_or_name, pid_or_name),
+            ).fetchone()
+        if row is None:
+            return None
+        return self._to_dict(row)
+
+    def _to_dict(self, row) -> dict:
+        return {
+            "id": row[0], "name": row[1], "description": row[2],
+            "owner": row[3], "labels": json.loads(row[4]),
+            "pinned": bool(row[5]), "created_at": row[6],
+            "updated_at": row[7],
+            "repositories": self.repositories(row[0]),
+        }
+
+    def list(self, owner: Optional[str] = None) -> List[dict]:
+        q = ("SELECT id, name, description, owner, labels, pinned,"
+             " created_at, updated_at FROM projects")
+        args: tuple = ()
+        if owner:
+            q += " WHERE owner=?"
+            args = (owner,)
+        q += " ORDER BY pinned DESC, updated_at DESC"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [self._to_dict(r) for r in rows]
+
+    def update(self, pid: str, **fields) -> dict:
+        allowed = {"name", "description", "labels", "pinned"}
+        sets, args = [], []
+        for k, v in fields.items():
+            if k not in allowed or v is None:
+                continue
+            if k == "labels":
+                v = json.dumps(list(v))
+            if k == "pinned":
+                v = 1 if v else 0
+            sets.append(f"{k}=?")
+            args.append(v)
+        if sets:
+            import sqlite3
+
+            sets.append("updated_at=?")
+            args.append(time.time())
+            with self._lock:
+                try:
+                    cur = self._conn.execute(
+                        f"UPDATE projects SET {', '.join(sets)} WHERE id=?",
+                        (*args, pid),
+                    )
+                except sqlite3.IntegrityError:
+                    raise ValueError(
+                        "project name already exists"
+                    ) from None
+                self._db.commit()
+                if cur.rowcount == 0:
+                    raise KeyError(pid)
+        out = self.get(pid)
+        if out is None:
+            raise KeyError(pid)
+        return out
+
+    def delete(self, pid: str) -> bool:
+        with self._db.transaction():
+            cur = self._conn.execute(
+                "DELETE FROM projects WHERE id=?", (pid,)
+            )
+            self._conn.execute(
+                "DELETE FROM project_repos WHERE project_id=?", (pid,)
+            )
+        return cur.rowcount > 0
+
+    # -- repositories ------------------------------------------------------
+    def attach_repo(self, pid: str, repo: str, primary: bool = False
+                    ) -> None:
+        if self.get(pid) is None:
+            raise KeyError(pid)
+        with self._db.transaction():
+            if primary:
+                self._conn.execute(
+                    "UPDATE project_repos SET is_primary=0"
+                    " WHERE project_id=?",
+                    (pid,),
+                )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO project_repos(project_id, repo,"
+                " is_primary, attached_at) VALUES(?,?,?,?)",
+                (pid, repo, 1 if primary else 0, time.time()),
+            )
+
+    def detach_repo(self, pid: str, repo: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM project_repos WHERE project_id=? AND repo=?",
+                (pid, repo),
+            )
+            self._db.commit()
+        return cur.rowcount > 0
+
+    def repositories(self, pid: str) -> List[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT repo, is_primary FROM project_repos"
+                " WHERE project_id=? ORDER BY is_primary DESC, repo",
+                (pid,),
+            ).fetchall()
+        return [{"repo": r[0], "primary": bool(r[1])} for r in rows]
+
+    # -- aggregation -------------------------------------------------------
+    def tasks_progress(self, pid: str) -> dict:
+        """Kanban progress for the project board (status -> count), the
+        /projects/{id}/tasks-progress shape."""
+        p = self.get(pid)
+        if p is None:
+            raise KeyError(pid)
+        counts: dict = {}
+        total = done = 0
+        if self.task_store is not None:
+            for t in self.task_store.list_tasks(project=p["name"]):
+                counts[t.status] = counts.get(t.status, 0) + 1
+                total += 1
+                if t.status == "done":
+                    done += 1
+        return {
+            "project": p["name"], "total": total, "done": done,
+            "by_status": counts,
+            "percent": round(100.0 * done / total, 1) if total else 0.0,
+        }

@@ -1,0 +1,361 @@
+"""Projects layer, git browse API, per-user settings, org teams +
+invitations — the reference's largest HTTP route families
+(``api/pkg/server/server.go`` /projects*, /git/repositories*,
+/users/me/*, /organizations/{}/teams|invitations)."""
+
+import asyncio
+import os
+import subprocess
+
+import pytest
+
+from helix_tpu.control.auth import Authenticator
+from helix_tpu.services.git_service import GitService
+from helix_tpu.services.projects import ProjectService
+
+
+class TestProjectService:
+    def test_crud_labels_pin(self):
+        ps = ProjectService()
+        p = ps.create("webapp", description="the web app")
+        assert p["name"] == "webapp" and not p["pinned"]
+        with pytest.raises(ValueError):
+            ps.create("webapp")        # duplicate
+        with pytest.raises(ValueError):
+            ps.create("bad/name")
+        p = ps.update(p["id"], labels=["infra", "q3"], pinned=True)
+        assert p["labels"] == ["infra", "q3"] and p["pinned"]
+        # pinned projects list first
+        ps.create("other")
+        assert ps.list()[0]["name"] == "webapp"
+        assert ps.delete(p["id"])
+        assert ps.get(p["id"]) is None
+
+    def test_get_by_name_or_id(self):
+        ps = ProjectService()
+        p = ps.create("named")
+        assert ps.get("named")["id"] == p["id"]
+
+    def test_repo_attach_primary_detach(self):
+        ps = ProjectService()
+        p = ps.create("p1")
+        ps.attach_repo(p["id"], "repo-a")
+        ps.attach_repo(p["id"], "repo-b", primary=True)
+        repos = ps.repositories(p["id"])
+        assert repos[0] == {"repo": "repo-b", "primary": True}
+        ps.attach_repo(p["id"], "repo-a", primary=True)  # primary moves
+        repos = {r["repo"]: r["primary"] for r in ps.repositories(p["id"])}
+        assert repos == {"repo-a": True, "repo-b": False}
+        assert ps.detach_repo(p["id"], "repo-b")
+        assert not ps.detach_repo(p["id"], "repo-b")
+
+    def test_tasks_progress_aggregates_board(self):
+        from helix_tpu.services.spec_tasks import TaskStore
+
+        ts = TaskStore()
+        ps = ProjectService(task_store=ts)
+        p = ps.create("board")
+        for status in ("backlog", "backlog", "implementation", "done"):
+            t = ts.create_task("board", f"t-{status}")
+            t.status = status
+            ts.update_task(t)
+        prog = ps.tasks_progress(p["id"])
+        assert prog["total"] == 4 and prog["done"] == 1
+        assert prog["by_status"]["backlog"] == 2
+        assert prog["percent"] == 25.0
+
+
+@pytest.fixture()
+def repo(tmp_path):
+    git = GitService(str(tmp_path / "repos"))
+    git.create_repo("proj")
+    ws = str(tmp_path / "ws")
+    git.clone_workspace("proj", ws)
+    os.makedirs(os.path.join(ws, "src"), exist_ok=True)
+    with open(os.path.join(ws, "src", "main.py"), "w") as f:
+        f.write("def main():\n    return 'hello world'\n")
+    with open(os.path.join(ws, "README.md"), "w") as f:
+        f.write("# proj\n")
+    git.commit_and_push(ws, "initial code", "main")
+    return git
+
+
+class TestGitBrowse:
+    def test_tree_levels(self, repo):
+        top = repo.tree("proj")
+        assert [(e["name"], e["type"]) for e in top] == [
+            ("src", "tree"), ("README.md", "blob"),
+        ]
+        sub = repo.tree("proj", path="src")
+        assert sub[0]["path"] == "src/main.py"
+        assert sub[0]["size"] > 0
+
+    def test_grep(self, repo):
+        hits = repo.grep("proj", "hello")
+        assert hits and hits[0]["path"] == "src/main.py"
+        assert "hello world" in hits[0]["text"]
+        assert repo.grep("proj", "nomatchxyz") == []
+
+
+class TestAuthTeamsInvitations:
+    def _org(self):
+        a = Authenticator()
+        owner = a.create_user("o@x.com", "owner")
+        member = a.create_user("m@x.com", "member")
+        org = a.create_org("acme", owner.id)
+        return a, org, owner, member
+
+    def test_team_lifecycle(self):
+        a, org, owner, member = self._org()
+        team = a.create_team(org, "platform")
+        # org membership required before team membership
+        with pytest.raises(PermissionError):
+            a.add_team_member(team["id"], member.id)
+        a.add_member(org, member.id)
+        a.add_team_member(team["id"], member.id)
+        teams = a.list_teams(org)
+        assert teams[0]["name"] == "platform"
+        assert teams[0]["members"][0]["email"] == "m@x.com"
+        assert a.remove_team_member(team["id"], member.id)
+        assert a.delete_team(team["id"])
+        assert a.list_teams(org) == []
+
+    def test_invitation_accept_grants_role(self):
+        a, org, owner, member = self._org()
+        inv = a.create_invitation(org, "m@x.com", role="admin")
+        out = a.accept_invitation(inv["token"], member.id)
+        assert out == {"org_id": org, "role": "admin"}
+        assert a.member_role(org, member.id) == "admin"
+        # one-shot token
+        with pytest.raises(PermissionError):
+            a.accept_invitation(inv["token"], member.id)
+        with pytest.raises(KeyError):
+            a.accept_invitation("bogus", member.id)
+        listed = a.list_invitations(org)
+        assert listed[0]["accepted"] is True
+
+    def test_invitation_bad_role(self):
+        a, org, *_ = self._org()
+        with pytest.raises(ValueError):
+            a.create_invitation(org, "x@x.com", role="superuser")
+
+
+class TestGitOptionInjection:
+    """Query params must never be parsed as git OPTIONS (e.g.
+    --open-files-in-pager executes commands; --output writes files)."""
+
+    def test_injected_options_rejected_everywhere(self, repo, tmp_path):
+        from helix_tpu.services.git_service import GitError
+
+        marker = tmp_path / "pwned"
+        evil = f"--open-files-in-pager=touch {marker}"
+        assert repo.grep("proj", "hello", branch=evil) == []
+        assert not marker.exists()
+        assert repo.log("proj", branch=f"--output={marker}") == []
+        assert not marker.exists()
+        with pytest.raises(GitError):
+            repo.tree("proj", branch="--help")
+        assert repo.file_at("proj", "-", "x") is None
+
+    def test_safe_ref_rules(self):
+        from helix_tpu.services.git_service import GitError, _safe_ref
+
+        assert _safe_ref("main") == "main"
+        assert _safe_ref("feature/x-1") == "feature/x-1"
+        for bad in ("", "-x", "--anything", "a\x00b"):
+            with pytest.raises(GitError):
+                _safe_ref(bad)
+
+
+class TestOrgAuthz:
+    """Teams/invitations are org-admin-gated; a team id from org B is not
+    reachable through org A's path; accepting a stale invitation never
+    downgrades a higher role."""
+
+    def test_accept_never_downgrades(self):
+        a = Authenticator()
+        owner = a.create_user("o@x.com")
+        org = a.create_org("acme", owner.id)
+        inv = a.create_invitation(org, "o@x.com", role="member")
+        out = a.accept_invitation(inv["token"], owner.id)
+        assert out["role"] == "owner"          # kept, not downgraded
+        assert a.member_role(org, owner.id) == "owner"
+
+    def test_http_gates(self):
+        import asyncio
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+        cp.auth_required = True
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                admin = cp.auth.create_user("root@x.com", admin=True)
+                admin_key = cp.auth.create_api_key(admin.id)
+                ah = {"Authorization": f"Bearer {admin_key}"}
+                intruder = cp.auth.create_user("evil@x.com")
+                ik = cp.auth.create_api_key(intruder.id)
+                ih = {"Authorization": f"Bearer {ik}"}
+
+                org_a = cp.auth.create_org("org-a", admin.id)
+                org_b = cp.auth.create_org("org-b", admin.id)
+                team_b = cp.auth.create_team(org_b, "secret-team")
+
+                # non-admin cannot mint invitations (self-escalation)
+                r = await client.post(
+                    f"/api/v1/orgs/{org_a}/invitations",
+                    json={"email": "evil@x.com", "role": "owner"},
+                    headers=ih,
+                )
+                assert r.status == 403
+                # non-admin cannot delete teams
+                r = await client.delete(
+                    f"/api/v1/orgs/{org_b}/teams/{team_b['id']}",
+                    headers=ih,
+                )
+                assert r.status == 403
+                # org B's team is NOT addressable through org A even for
+                # an org-A admin path (cross-org id smuggling)
+                r = await client.delete(
+                    f"/api/v1/orgs/{org_a}/teams/{team_b['id']}",
+                    headers=ah,
+                )
+                assert r.status == 404
+                assert cp.auth.list_teams(org_b)  # still there
+                # trigger execute is admin-only
+                r = await client.post(
+                    "/api/v1/triggers/trg_x/execute", json={}, headers=ih
+                )
+                assert r.status == 403
+            finally:
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
+
+
+class TestHTTPSurface:
+    def test_projects_git_settings_teams_over_http(self):
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                # project CRUD + progress
+                r = await client.post("/api/v1/projects",
+                                      json={"name": "api-breadth"})
+                assert r.status == 201
+                pid = (await r.json())["id"]
+                r = await client.post(
+                    "/api/v1/spec-tasks",
+                    json={"project": "api-breadth", "title": "a task"},
+                )
+                assert r.status in (200, 201)
+                r = await client.get(
+                    f"/api/v1/projects/{pid}/tasks-progress"
+                )
+                prog = await r.json()
+                assert prog["total"] == 1
+                r = await client.post(f"/api/v1/projects/{pid}/pin",
+                                      json={})
+                assert (await r.json())["pinned"] is True
+
+                # git browse over the kanban's own repos
+                r = await client.post("/api/v1/git/repositories",
+                                      json={"name": "browse-me"})
+                assert r.status == 201
+                r = await client.post("/api/v1/git/repositories",
+                                      json={"name": "browse-me"})
+                assert r.status == 409
+                r = await client.get(
+                    "/api/v1/git/repositories/browse-me/branches"
+                )
+                assert r.status == 200
+                r = await client.get(
+                    "/api/v1/git/repositories/browse-me/clone-command"
+                )
+                assert "git clone" in (await r.json())["command"]
+                r = await client.post(
+                    f"/api/v1/projects/{pid}/repositories/browse-me/attach",
+                    json={"primary": True},
+                )
+                assert r.status == 200
+                r = await client.get(f"/api/v1/projects/{pid}")
+                assert (await r.json())["repositories"] == [
+                    {"repo": "browse-me", "primary": True}
+                ]
+
+                # user settings roundtrip
+                r = await client.put(
+                    "/api/v1/users/me/settings/color-scheme",
+                    json={"value": {"mode": "dark"}},
+                )
+                assert r.status == 200
+                r = await client.get(
+                    "/api/v1/users/me/settings/color-scheme"
+                )
+                assert (await r.json())["value"] == {"mode": "dark"}
+                r = await client.get("/api/v1/users/me/settings/nope")
+                assert r.status == 404
+
+                # org teams + invitations over HTTP
+                r = await client.post("/api/v1/users",
+                                      json={"email": "o@y.com"})
+                uid = (await r.json())["id"]
+                r = await client.post(
+                    "/api/v1/orgs", json={"name": "org9", "owner": uid}
+                )
+                oid = (await r.json())["id"]
+                r = await client.post(f"/api/v1/orgs/{oid}/teams",
+                                      json={"name": "core"})
+                assert r.status == 201
+                r = await client.post(
+                    f"/api/v1/orgs/{oid}/invitations",
+                    json={"email": "new@y.com", "role": "member"},
+                )
+                inv = await r.json()
+                r = await client.post("/api/v1/users",
+                                      json={"email": "new@y.com"})
+                nid = (await r.json())["id"]
+                r = await client.post(
+                    "/api/v1/invitations/accept",
+                    json={"token": inv["token"], "user_id": nid},
+                )
+                assert (await r.json())["role"] == "member"
+
+                # users search / llm_calls / model-info
+                r = await client.get("/api/v1/users/search",
+                                     params={"q": "new@"})
+                assert [u["email"] for u in (await r.json())["users"]] == \
+                    ["new@y.com"]
+                cp.store.log_llm_call(
+                    {"prompt": "hi"}, session_id="s1", model="m1",
+                    provider="helix",
+                )
+                r = await client.get("/api/v1/llm_calls",
+                                     params={"session_id": "s1"})
+                calls = (await r.json())["calls"]
+                assert calls and calls[0]["model"] == "m1"
+                r = await client.get("/api/v1/model-info")
+                assert r.status == 200 and "models" in await r.json()
+            finally:
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
