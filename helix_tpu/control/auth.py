@@ -493,12 +493,15 @@ class Authenticator:
         return False
 
     def search_users(self, q: str, limit: int = 20) -> list:
-        """Substring match over email/name (reference /users/search)."""
-        like = f"%{q}%"
+        """Substring match over email/name (reference /users/search).
+        LIKE metacharacters in the query are escaped to literals."""
+        esc = q.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
+        like = f"%{esc}%"
         with self._lock:
             rows = self._conn.execute(
                 "SELECT id, email, name, admin FROM users"
-                " WHERE (email LIKE ? OR name LIKE ?) AND email NOT LIKE ?"
+                " WHERE (email LIKE ? ESCAPE '\\'"
+                " OR name LIKE ? ESCAPE '\\') AND email NOT LIKE ?"
                 " ORDER BY email LIMIT ?",
                 (like, like, "svc:%", limit),
             ).fetchall()

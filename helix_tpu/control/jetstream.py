@@ -124,6 +124,28 @@ class JetStream:
             self._db.commit()
         return out
 
+    def peek(self, stream: str, subject: str = "", limit: int = 100
+             ) -> list:
+        """Read-only view of a stream's tail (no consumer state, no
+        claims) — for UI surfaces that show history without consuming."""
+        q = ("SELECT seq, subject, body, published_at FROM messages"
+             " WHERE stream=?")
+        args: list = [stream]
+        if subject:
+            q += " AND subject=?"
+            args.append(subject)
+        q += " ORDER BY seq DESC LIMIT ?"
+        args.append(limit)
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [
+            {
+                "seq": r[0], "subject": r[1],
+                "message": json.loads(r[2]), "published_at": r[3],
+            }
+            for r in reversed(rows)
+        ]
+
     def stream_info(self, name: str) -> dict:
         with self._lock:
             row = self._conn.execute(

@@ -775,8 +775,11 @@ class ControlPlane:
         # sessions
         r.add_post("/api/v1/sessions", self.create_session)
         r.add_get("/api/v1/sessions", self.list_sessions)
+        # static /search must register before the {id} wildcard
+        r.add_get("/api/v1/sessions/search", self.search_sessions)
         r.add_get("/api/v1/sessions/{id}", self.get_session)
         r.add_delete("/api/v1/sessions/{id}", self.delete_session)
+        r.add_put("/api/v1/sessions/{id}", self.update_session)
         r.add_post("/api/v1/sessions/{id}/chat", self.session_chat)
         # apps (helix.yaml surface)
         r.add_get("/api/v1/apps", self.list_apps)
@@ -878,6 +881,19 @@ class ControlPlane:
         r.add_post("/api/v1/spec-tasks", self.create_spec_task)
         r.add_get("/api/v1/spec-tasks/{id}", self.get_spec_task)
         r.add_post("/api/v1/spec-tasks/{id}/review", self.review_spec_task)
+        r.add_get("/api/v1/spec-tasks/{id}/view", self.spec_task_view)
+        r.add_post(
+            "/api/v1/spec-tasks/{id}/attachments",
+            self.spec_task_attach,
+        )
+        r.add_get(
+            "/api/v1/spec-tasks/{id}/attachments",
+            self.spec_task_attachments,
+        )
+        r.add_get(
+            "/api/v1/spec-tasks/{id}/attachments/{name}",
+            self.spec_task_attachment_get,
+        )
         r.add_get("/api/v1/pull-requests", self.list_prs)
         r.add_get("/api/v1/pull-requests/{id}/diff", self.get_pr_diff)
         r.add_post("/api/v1/pull-requests/{id}/merge", self.merge_pr)
@@ -1316,6 +1332,28 @@ class ControlPlane:
     async def delete_session(self, request):
         self.store.delete_session(request.match_info["id"])
         return web.json_response({"ok": True})
+
+    async def update_session(self, request):
+        """Rename and/or replace the session doc."""
+        sid = request.match_info["id"]
+        if self.store.get_session(sid) is None:
+            return _err(404, "session not found")
+        body = await request.json()
+        if body.get("name"):
+            self.store.rename_session(sid, str(body["name"]))
+        if isinstance(body.get("doc"), dict):
+            self.store.update_session(sid, body["doc"])
+        return web.json_response(self.store.get_session(sid))
+
+    async def search_sessions(self, request):
+        q = request.query.get("q", "")
+        if not q:
+            return _err(400, "missing q")
+        return web.json_response({
+            "sessions": self.store.search_sessions(
+                q, owner=request.query.get("owner")
+            )
+        })
 
     async def session_chat(self, request):
         """Session-aware chat: history + app binding + RAG enrichment, then
@@ -1964,6 +2002,66 @@ class ControlPlane:
         except ValueError as e:
             return _err(409, str(e))
         return web.json_response(t.to_dict())
+
+    async def spec_task_view(self, request):
+        """The full task card in one fetch (reference /spec-tasks/{}/view):
+        task + reviews + PR + durable lifecycle events + zed threads."""
+        t = self.task_store.get_task(request.match_info["id"])
+        if t is None:
+            return _err(404, "task not found")
+        doc = t.to_dict()
+        doc["reviews"] = self.task_store.reviews(t.id)
+        if t.pr_id:
+            doc["pull_request"] = self.task_store.get_pr(t.pr_id)
+        # lifecycle events from the durable TASKS stream (read-only peek,
+        # never consumes)
+        doc["events"] = [
+            {"seq": m["seq"], **m["message"], "at": m["published_at"]}
+            for m in self.jetstream.peek(
+                "TASKS", subject=f"spectasks.{t.id}"
+            )
+        ]
+        doc["zed_instances"] = [
+            i for i in self.zed.list() if i["spec_task_id"] == t.id
+        ]
+        return web.json_response(doc)
+
+    def _attach_owner(self, task_id: str) -> str:
+        return f"task-{task_id}"
+
+    async def spec_task_attach(self, request):
+        """Upload an attachment (design doc, screenshot) onto the card."""
+        t = self.task_store.get_task(request.match_info["id"])
+        if t is None:
+            return _err(404, "task not found")
+        name = request.query.get("name", "")
+        if not name or "/" in name or name.startswith("."):
+            return _err(400, "attachment needs a simple ?name=")
+        data = await request.read()
+        meta = self.files.write(self._attach_owner(t.id), name, data)
+        return web.json_response(meta, status=201)
+
+    async def spec_task_attachments(self, request):
+        t = self.task_store.get_task(request.match_info["id"])
+        if t is None:
+            return _err(404, "task not found")
+        return web.json_response(
+            {"attachments": self.files.list(self._attach_owner(t.id))}
+        )
+
+    async def spec_task_attachment_get(self, request):
+        t = self.task_store.get_task(request.match_info["id"])
+        if t is None:
+            return _err(404, "task not found")
+        try:
+            data = self.files.read(
+                self._attach_owner(t.id), request.match_info["name"]
+            )
+        except (FileNotFoundError, PermissionError):
+            return _err(404, "attachment not found")
+        return web.Response(
+            body=data, content_type="application/octet-stream"
+        )
 
     async def list_prs(self, request):
         return web.json_response(

@@ -253,6 +253,40 @@ class Store:
             )
             self._db.commit()
 
+    def rename_session(self, sid: str, name: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "UPDATE sessions SET name=?, updated_at=? WHERE id=?",
+                (name, time.time(), sid),
+            )
+            self._db.commit()
+        return cur.rowcount > 0
+
+    def search_sessions(self, q: str, owner: Optional[str] = None,
+                        limit: int = 50) -> list:
+        """Name-substring search (reference /sessions?search= surface).
+        LIKE metacharacters in the query are literals: 'q=50%' must match
+        names containing '50%', not anything containing '50'."""
+        esc = q.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
+        like = f"%{esc}%"
+        sql = ("SELECT id, owner, name, created_at, updated_at FROM"
+               " sessions WHERE name LIKE ? ESCAPE '\\'")
+        args: list = [like]
+        if owner:
+            sql += " AND owner=?"
+            args.append(owner)
+        sql += " ORDER BY updated_at DESC LIMIT ?"
+        args.append(limit)
+        with self._lock:
+            rows = self._conn.execute(sql, args).fetchall()
+        return [
+            {
+                "id": r[0], "owner": r[1], "name": r[2],
+                "created_at": r[3], "updated_at": r[4],
+            }
+            for r in rows
+        ]
+
     def list_sessions(self, owner: Optional[str] = None) -> list:
         q = "SELECT id, owner, name, created_at, updated_at FROM sessions"
         args: tuple = ()

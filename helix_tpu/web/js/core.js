@@ -2,9 +2,9 @@
  * /ui/js/<tab>.js exporting `render(main, ctx)`; the router dynamic-imports
  * it so one broken page never takes down the app shell. */
 
-export const TABS = ["chat","sessions","tasks","apps","org","desktops",
-  "knowledge","runners","compute","providers","wallet","evals","oauth",
-  "secrets","triggers","admin"];
+export const TABS = ["chat","sessions","projects","tasks","apps","org",
+  "desktops","knowledge","runners","compute","providers","wallet","evals",
+  "oauth","secrets","triggers","admin"];
 
 export let tab = location.hash.slice(1) || "chat";
 export let ME = null;

@@ -1,0 +1,91 @@
+/* Projects: boards over spec-task kanbans + attached repos + git browser
+ * (reference: frontend/src/components/project). */
+import {$, $row, api, esc, render as rerender} from "./core.js";
+
+export async function render(m) {
+  const top = $(`<div class="panel row">
+    <input id="pn" placeholder="project name">
+    <input id="pd" class="grow" placeholder="description">
+    <button class="primary" id="mk">Create project</button></div>`);
+  m.appendChild(top);
+  top.querySelector("#mk").onclick = async () => {
+    await api("/api/v1/projects", {method: "POST", body: JSON.stringify({
+      name: top.querySelector("#pn").value,
+      description: top.querySelector("#pd").value})});
+    rerender();
+  };
+
+  const {projects} = await api("/api/v1/projects");
+  // one round trip wave, not N sequential fetches
+  const progress = await Promise.all(projects.map(
+    p => api(`/api/v1/projects/${p.id}/tasks-progress`)
+      .catch(() => ({total: 0, done: 0, percent: 0}))));
+  const list = $(`<div class="panel"><h3>Projects</h3>
+    <table><thead><tr><th>name</th><th>labels</th><th>progress</th>
+    <th>repos</th><th></th></tr></thead><tbody id="pb"></tbody></table></div>`);
+  m.appendChild(list);
+  const pb = list.querySelector("#pb");
+  projects.forEach((p, i) => {
+    const prog = progress[i];
+    const tr = $row(`<tr>
+      <td>${p.pinned ? "&#9733; " : ""}${esc(p.name)}</td>
+      <td>${p.labels.map(esc).join(", ")}</td>
+      <td>${prog.done}/${prog.total} (${prog.percent}%)</td>
+      <td>${p.repositories.map(r => esc(r.repo) + (r.primary ? "*" : "")).join(", ")}</td>
+      <td><button class="ghost pin">pin</button>
+          <button class="ghost del">delete</button></td></tr>`);
+    tr.querySelector(".pin").onclick = async () => {
+      await api(`/api/v1/projects/${p.id}/pin`,
+                {method: "POST", body: JSON.stringify({pinned: !p.pinned})});
+      rerender();
+    };
+    tr.querySelector(".del").onclick = async () => {
+      await api(`/api/v1/projects/${p.id}`, {method: "DELETE"});
+      rerender();
+    };
+    pb.appendChild(tr);
+  });
+
+  // git browser over the control plane's repos
+  const repos = (await api("/api/v1/git/repositories")).repos || [];
+  const gb = $(`<div class="panel"><h3>Repository browser</h3>
+    <div class="row"><select id="gr"></select>
+      <input id="gq" class="grow" placeholder="grep pattern (optional)">
+      <button class="ghost" id="go">Browse</button></div>
+    <div id="gt" style="margin-top:8px"></div></div>`);
+  m.appendChild(gb);
+  for (const r of repos) gb.querySelector("#gr").appendChild(new Option(r, r));
+  gb.querySelector("#go").onclick = async () => {
+    const repo = gb.querySelector("#gr").value;
+    const q = gb.querySelector("#gq").value.trim();
+    const out = gb.querySelector("#gt");
+    out.innerHTML = "";
+    if (q) {
+      const {hits} = await api(
+        `/api/v1/git/repositories/${repo}/grep?q=${encodeURIComponent(q)}`);
+      for (const h of hits.slice(0, 50)) {
+        const d = $(`<div class="id"></div>`);
+        d.textContent = `${h.path}:${h.line}: ${h.text}`;
+        out.appendChild(d);
+      }
+      if (!hits.length) out.textContent = "no matches";
+      return;
+    }
+    const {entries} = await api(`/api/v1/git/repositories/${repo}/tree`);
+    for (const e of entries) {
+      const d = $(`<div class="id"></div>`);
+      d.textContent = `${e.type === "tree" ? "dir " : "file"} ${e.path}` +
+        (e.type === "blob" ? ` (${e.size}b)` : "");
+      if (e.type === "blob") {
+        d.style.cursor = "pointer";
+        d.onclick = async () => {
+          const f = await api(`/api/v1/git/repositories/${repo}/file-content?path=${encodeURIComponent(e.path)}`);
+          const pre = $(`<pre style="max-height:300px;overflow:auto"></pre>`);
+          pre.textContent = f.content;
+          d.after(pre);
+        };
+      }
+      out.appendChild(d);
+    }
+  };
+}

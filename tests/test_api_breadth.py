@@ -140,6 +140,91 @@ class TestAuthTeamsInvitations:
             a.create_invitation(org, "x@x.com", role="superuser")
 
 
+class TestSessionsAndTaskView:
+    def test_session_search_rename_task_view_attachments(self):
+        import asyncio
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                # sessions: create, search (static route wins over {id}),
+                # rename
+                r = await client.post(
+                    "/api/v1/sessions",
+                    json={"name": "tpu planning chat"},
+                )
+                sid = (await r.json())["id"]
+                await client.post("/api/v1/sessions",
+                                  json={"name": "other"})
+                r = await client.get("/api/v1/sessions/search",
+                                     params={"q": "planning"})
+                found = (await r.json())["sessions"]
+                assert [s["id"] for s in found] == [sid]
+                r = await client.put(f"/api/v1/sessions/{sid}",
+                                     json={"name": "renamed"})
+                assert (await r.json())["name"] == "renamed"
+                r = await client.get("/api/v1/sessions/search",
+                                     params={"q": "planning"})
+                assert (await r.json())["sessions"] == []
+
+                # spec-task view + attachments
+                r = await client.post(
+                    "/api/v1/spec-tasks",
+                    json={"project": "p", "title": "carded"},
+                )
+                tid = (await r.json())["id"]
+                r = await client.post(
+                    f"/api/v1/spec-tasks/{tid}/attachments",
+                    params={"name": "design.md"},
+                    data=b"# the design",
+                )
+                assert r.status == 201
+                r = await client.get(
+                    f"/api/v1/spec-tasks/{tid}/attachments"
+                )
+                atts = (await r.json())["attachments"]
+                assert [a["path"] for a in atts] == ["design.md"]
+                r = await client.get(
+                    f"/api/v1/spec-tasks/{tid}/attachments/design.md"
+                )
+                assert await r.read() == b"# the design"
+                r = await client.get(f"/api/v1/spec-tasks/{tid}/view")
+                view = await r.json()
+                assert view["id"] == tid
+                assert "events" in view and "zed_instances" in view
+                # lifecycle events appear once the orchestrator moves it
+                assert isinstance(view["events"], list)
+            finally:
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
+
+    def test_jetstream_peek_is_read_only(self):
+        from helix_tpu.control.jetstream import JetStream
+
+        js = JetStream()
+        js.add_stream("S", ["s.*"])
+        for i in range(5):
+            js.publish("s.a", {"n": i})
+        js.publish("s.b", {"n": 99})
+        peeked = js.peek("S", subject="s.a")
+        assert [m["message"]["n"] for m in peeked] == [0, 1, 2, 3, 4]
+        # no consumer state created; a real consumer still gets everything
+        got = js.fetch("S", "real-consumer", batch=10)
+        assert len(got) == 6
+
+
 class TestGitOptionInjection:
     """Query params must never be parsed as git OPTIONS (e.g.
     --open-files-in-pager executes commands; --output writes files)."""
