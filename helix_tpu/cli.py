@@ -587,6 +587,27 @@ def main(argv=None) -> int:
                    help="autoscaler: idle seconds before shedding a host")
     s.set_defaults(fn=_cmd_serve)
 
+    db = sub.add_parser(
+        "desktop-bridge",
+        help="guest agent: serve this process's GUI desktop to a "
+             "control plane (runs inside a sandbox)",
+    )
+    db.add_argument("--control-plane", required=True)
+    db.add_argument("--name", default="bridged-desktop")
+    db.add_argument("--fps", type=float, default=10.0)
+    db.add_argument("--api-key", default="")
+
+    def _cmd_desktop_bridge(args):
+        from helix_tpu.desktop.bridge import main as bridge_main
+
+        argv = ["--control-plane", args.control_plane,
+                "--name", args.name, "--fps", str(args.fps)]
+        if args.api_key:
+            argv += ["--api-key", args.api_key]
+        return bridge_main(argv)
+
+    db.set_defaults(fn=_cmd_desktop_bridge)
+
     pr = sub.add_parser("profile", help="validate a profile YAML")
     pr.add_argument("file")
     pr.set_defaults(fn=_cmd_profile)

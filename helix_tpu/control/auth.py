@@ -495,8 +495,9 @@ class Authenticator:
     def search_users(self, q: str, limit: int = 20) -> list:
         """Substring match over email/name (reference /users/search).
         LIKE metacharacters in the query are escaped to literals."""
-        esc = q.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
-        like = f"%{esc}%"
+        from helix_tpu.utils import like_escape
+
+        like = f"%{like_escape(q)}%"
         with self._lock:
             rows = self._conn.execute(
                 "SELECT id, email, name, admin FROM users"

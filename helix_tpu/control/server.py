@@ -1022,6 +1022,9 @@ class ControlPlane:
         r.add_get("/api/v1/desktops/{id}/ws/stream", self.ws_desktop_stream)
         r.add_get("/api/v1/desktops/{id}/ws/input", self.ws_desktop_input)
         r.add_post("/api/v1/desktops/{id}/mcp", self.desktop_mcp)
+        r.add_get(
+            "/api/v1/desktops/{id}/ws/provider", self.ws_desktop_provider
+        )
         # zed editor bridge
         r.add_get("/api/v1/zed/instances", self.zed_list)
         r.add_post("/api/v1/zed/instances", self.zed_create)
@@ -3152,6 +3155,59 @@ class ControlPlane:
         if out is None:  # notification
             return web.Response(status=202)
         return web.json_response(out)
+
+    async def ws_desktop_provider(self, request):
+        """Guest leg of an external desktop (desktop-bridge agent): the
+        guest sends encoded frame packets as binary; input events for the
+        guest flow back as JSON text frames."""
+        import asyncio as _asyncio
+        import json as _json
+
+        session = self.desktops.get(request.match_info["id"])
+        if session is None:
+            return _err(404, "desktop not found")
+        if not hasattr(session, "attach_provider"):
+            return _err(409, "not an external desktop")
+        ws = web.WebSocketResponse(heartbeat=30, max_msg_size=0)
+        await ws.prepare(request)
+        loop = _asyncio.get_running_loop()
+        outq: _asyncio.Queue = _asyncio.Queue(maxsize=100)
+
+        def input_sink(event: dict) -> None:
+            def put():
+                if outq.full():
+                    try:
+                        outq.get_nowait()
+                    except _asyncio.QueueEmpty:
+                        pass
+                outq.put_nowait(event)
+
+            loop.call_soon_threadsafe(put)
+
+        session.attach_provider(input_sink)
+
+        async def pump_inputs():
+            while not ws.closed:
+                try:
+                    ev = await _asyncio.wait_for(outq.get(), timeout=5)
+                except _asyncio.TimeoutError:
+                    continue
+                try:
+                    await ws.send_str(_json.dumps(ev))
+                except Exception:  # noqa: BLE001
+                    return
+
+        pump = _asyncio.ensure_future(pump_inputs())
+        try:
+            async for msg in ws:
+                if msg.type == web.WSMsgType.BINARY:
+                    session.push_packet(msg.data)
+        finally:
+            pump.cancel()
+            # only detach OUR sink — a reconnected provider's fresh sink
+            # must survive this stale connection's teardown
+            session.detach_provider(input_sink)
+        return ws
 
     async def ws_desktop_input(self, request):
         import json as _json

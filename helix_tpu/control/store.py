@@ -267,8 +267,9 @@ class Store:
         """Name-substring search (reference /sessions?search= surface).
         LIKE metacharacters in the query are literals: 'q=50%' must match
         names containing '50%', not anything containing '50'."""
-        esc = q.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
-        like = f"%{esc}%"
+        from helix_tpu.utils import like_escape
+
+        like = f"%{like_escape(q)}%"
         sql = ("SELECT id, owner, name, created_at, updated_at FROM"
                " sessions WHERE name LIKE ? ESCAPE '\\'")
         args: list = [like]

@@ -1,0 +1,191 @@
+"""desktop-bridge: the guest agent that runs INSIDE a sandbox and serves
+its GUI to the control plane.
+
+The reference ships ``desktop-bridge`` inside each desktop VM/container —
+it owns the guest-side compositor hookup and relays video + input between
+guest and host (SURVEY.md §2.3 #38).  Ours is the same shape over our
+stack: the guest process hosts the software compositor desktop
+(:mod:`helix_tpu.desktop.gui`), encodes frames with the native video
+codec, and ships packets up ``/api/v1/desktops/{id}/ws/provider``; input
+events from viewers come back down the socket and are applied to the
+local seat.  The control plane never executes guest code — it only
+relays packets, which is what keeps agent GUI isolation real.
+
+Run inside the sandbox:
+
+    python -m helix_tpu desktop-bridge --control-plane http://cp:8080 \
+        [--name my-desktop] [--fps 10] [--api-key ...]
+
+or programmatically: ``DesktopBridge(url).start()`` (tests drive a demo
+agent desktop through a real control plane this way).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+
+class DesktopBridge:
+    def __init__(self, control_plane: str, name: str = "bridged-desktop",
+                 fps: float = 10.0, api_key: str = "",
+                 width: int = 960, height: int = 540,
+                 on_command=None):
+        self.control_plane = control_plane.rstrip("/")
+        self.name = name
+        self.fps = fps
+        self.api_key = api_key
+        self.width = width
+        self.height = height
+        self.desktop_id: str = ""
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.connected = threading.Event()
+        self.frames_sent = 0
+
+        from helix_tpu.desktop.gui import build_agent_desktop
+
+        self.source, self.handles = build_agent_desktop(
+            width, height, on_command=on_command
+        )
+
+    def _headers(self) -> dict:
+        return (
+            {"Authorization": f"Bearer {self.api_key}"}
+            if self.api_key else {}
+        )
+
+    def register(self) -> str:
+        """Create the external desktop on the control plane."""
+        import requests
+
+        r = requests.post(
+            f"{self.control_plane}/api/v1/desktops",
+            json={
+                "kind": "external", "name": self.name, "fps": self.fps,
+                "codec": "video",
+            },
+            headers=self._headers(), timeout=10,
+        )
+        r.raise_for_status()
+        self.desktop_id = r.json()["id"]
+        return self.desktop_id
+
+    def start(self) -> "DesktopBridge":
+        if not self.desktop_id:
+            self.register()
+        self._thread = threading.Thread(
+            target=self._run, name="helix-desktop-bridge", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- the provider loop ---------------------------------------------------
+    def _run(self) -> None:
+        import asyncio
+
+        asyncio.new_event_loop().run_until_complete(self._session())
+
+    async def _session(self) -> None:
+        import asyncio
+
+        import aiohttp
+
+        url = (
+            self.control_plane.replace("http://", "ws://")
+            .replace("https://", "wss://")
+            + f"/api/v1/desktops/{self.desktop_id}/ws/provider"
+        )
+        backoff = 0.5
+        while not self._stop.is_set():
+            try:
+                async with aiohttp.ClientSession() as http:
+                    async with http.ws_connect(
+                        url, headers=self._headers(), max_msg_size=0
+                    ) as ws:
+                        self.connected.set()
+                        backoff = 0.5
+                        await self._pump(ws)
+            except Exception:  # noqa: BLE001 — control plane away: retry
+                pass
+            finally:
+                self.connected.clear()
+            if self._stop.is_set():
+                return
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 15.0)
+
+    async def _pump(self, ws) -> None:
+        """Encode+send at fps; apply input events as they arrive."""
+        import asyncio
+
+        import aiohttp
+
+        from helix_tpu.desktop.video import VideoEncoder
+
+        enc = VideoEncoder(
+            self.width, self.height, quality=70, target_kbps=2000,
+            fps=self.fps,
+        )
+        period = 1.0 / self.fps
+        force_kf = True
+        next_frame = time.monotonic()
+        while not self._stop.is_set() and not ws.closed:
+            now = time.monotonic()
+            if now >= next_frame:
+                frame = self.source.get_frame()
+                packet = enc.encode(frame, keyframe=force_kf)
+                force_kf = False
+                await ws.send_bytes(packet)
+                self.frames_sent += 1
+                next_frame = now + period
+            try:
+                msg = await asyncio.wait_for(
+                    ws.receive(), timeout=max(next_frame - now, 0.005)
+                )
+            except asyncio.TimeoutError:
+                continue
+            if msg.type == aiohttp.WSMsgType.TEXT:
+                try:
+                    event = json.loads(msg.data)
+                except ValueError:
+                    continue
+                if event.get("type") == "refresh":
+                    force_kf = True
+                else:
+                    self.source.input(event)
+            elif msg.type in (
+                aiohttp.WSMsgType.CLOSED, aiohttp.WSMsgType.CLOSE,
+                aiohttp.WSMsgType.ERROR,
+            ):
+                return
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="helix-tpu desktop-bridge")
+    ap.add_argument("--control-plane", required=True)
+    ap.add_argument("--name", default="bridged-desktop")
+    ap.add_argument("--fps", type=float, default=10.0)
+    ap.add_argument("--api-key", default="")
+    args = ap.parse_args(argv)
+    bridge = DesktopBridge(
+        args.control_plane, name=args.name, fps=args.fps,
+        api_key=args.api_key,
+    ).start()
+    print(f"desktop-bridge serving {bridge.desktop_id} "
+          f"-> {args.control_plane}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        bridge.stop()
+    return 0

@@ -156,6 +156,126 @@ class DesktopSession:
         self._stop.set()
 
 
+class ExternalDesktopSession:
+    """A desktop whose frames are PRODUCED OUTSIDE this process — the
+    guest half runs :mod:`helix_tpu.desktop.bridge` inside a sandbox/VM
+    and ships pre-encoded packets up a provider WebSocket; input events
+    flow back down the same socket (the reference's desktop-bridge guest
+    agent, ``SURVEY.md`` §2.3 #38).
+
+    Shares DesktopSession's subscriber surface so /ws/stream and
+    /ws/input work unchanged; there is no local encoder or frame loop —
+    the guest owns pacing and encoding."""
+
+    def __init__(self, name: str = "", codec: str = "video",
+                 width: int = 960, height: int = 540, fps: float = 10.0):
+        self.id = f"dsk_{uuid.uuid4().hex[:12]}"
+        self.name = name
+        self.codec = codec
+        self.fps = fps
+        self.created = time.time()
+
+        class _Shape:
+            pass
+
+        self.source = _Shape()
+        self.source.width = width
+        self.source.height = height
+        self._subs: dict[str, Callable[[bytes], None]] = {}
+        self._input_sink: Optional[Callable[[dict], None]] = None
+        self._lock = threading.Lock()
+        self._last_keyframe: Optional[bytes] = None
+        self.provider_connected = False
+        self._packets = 0
+        self._bytes = 0
+
+    # -- viewer side (same protocol as DesktopSession) ---------------------
+    def subscribe(self, cb: Callable[[bytes], None]) -> str:
+        sid = uuid.uuid4().hex
+        with self._lock:
+            self._subs[sid] = cb
+            kf = self._last_keyframe
+        # late joiner: replay the last keyframe immediately, then ask the
+        # guest for a fresh one
+        if kf is not None:
+            try:
+                cb(kf)
+            except Exception:  # noqa: BLE001
+                pass
+        self.handle_input({"type": "refresh"})
+        return sid
+
+    def unsubscribe(self, sid: str) -> None:
+        with self._lock:
+            self._subs.pop(sid, None)
+
+    def handle_input(self, event: dict) -> None:
+        with self._lock:
+            sink = self._input_sink
+        if sink is not None:
+            try:
+                sink(event)
+            except Exception:  # noqa: BLE001 — provider gone mid-send
+                pass
+
+    # -- provider side -----------------------------------------------------
+    def attach_provider(self, input_sink: Callable[[dict], None]) -> None:
+        with self._lock:
+            self._input_sink = input_sink
+            self.provider_connected = True
+        # a (re)connecting guest must start with an I-frame
+        self.handle_input({"type": "refresh"})
+
+    def detach_provider(self, input_sink=None) -> None:
+        """Compare-and-clear: a lingering dead connection (noticed only at
+        heartbeat timeout) must not detach the sink a reconnected provider
+        just attached.  ``None`` forces the clear (shutdown)."""
+        with self._lock:
+            if input_sink is not None and self._input_sink is not input_sink:
+                return
+            self._input_sink = None
+            self.provider_connected = False
+
+    def push_packet(self, packet: bytes) -> None:
+        """Guest-encoded packet -> fan out to viewers."""
+        is_kf = False
+        # both codecs carry a type/keyframe marker: HXV1 byte 12 (0 = I),
+        # HXF1 keyframe flag at byte 14 — guard each offset separately so
+        # a truncated/malicious guest packet can't IndexError the relay
+        if packet[:4] == b"HXV1" and len(packet) >= 13:
+            is_kf = packet[12] == 0
+        elif packet[:4] == b"HXF1" and len(packet) >= 15:
+            is_kf = packet[14] == 1
+        with self._lock:
+            if is_kf:
+                self._last_keyframe = packet
+            subs = list(self._subs.values())
+            self._packets += 1
+            self._bytes += len(packet)
+        for cb in subs:
+            try:
+                cb(packet)
+            except Exception:  # noqa: BLE001 — dead subscriber
+                pass
+
+    # -- manager protocol --------------------------------------------------
+    @property
+    def encoder(self):
+        class _Stats:
+            stats = {
+                "packets": self._packets, "bytes_out": self._bytes,
+                "provider_connected": self.provider_connected,
+            }
+
+        return _Stats()
+
+    def start(self):
+        return self
+
+    def stop(self):
+        self.detach_provider()
+
+
 class DesktopManager:
     """Session registry (the hydra dev-container registry analogue)."""
 
@@ -166,8 +286,16 @@ class DesktopManager:
     def create(self, name: str = "", fps: float = 10.0,
                source=None, kind: str = "text",
                codec: str = "") -> DesktopSession:
-        """kind: "text" (agent terminal) or "gui" (compositor desktop,
-        defaults to the lossy video codec)."""
+        """kind: "text" (agent terminal), "gui" (in-process compositor
+        desktop, lossy video codec) or "external" (a desktop-bridge guest
+        process provides pre-encoded frames over /ws/provider)."""
+        if kind == "external":
+            s = ExternalDesktopSession(
+                name=name, codec=codec or "video", fps=fps
+            )
+            with self._lock:
+                self._sessions[s.id] = s
+            return s
         if source is None:
             if kind == "gui":
                 from helix_tpu.desktop.gui import build_agent_desktop

@@ -41,3 +41,12 @@ def load_or_create_keyfile(path: str, nbytes: int = 32) -> bytes:
             os.unlink(tmp)
         # fall through to re-read so every caller returns the on-disk key
     raise RuntimeError(f"could not create or read key file {path}")
+
+
+def like_escape(q: str) -> str:
+    """Escape SQL LIKE metacharacters so a user query matches literally
+    (pair with ``LIKE ? ESCAPE '\\'``).  Backslash must be escaped FIRST
+    or it would double-escape the %/_ replacements."""
+    return (
+        q.replace("\\", "\\\\").replace("%", r"\%").replace("_", r"\_")
+    )

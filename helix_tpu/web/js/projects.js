@@ -56,7 +56,7 @@ export async function render(m) {
   m.appendChild(gb);
   for (const r of repos) gb.querySelector("#gr").appendChild(new Option(r, r));
   gb.querySelector("#go").onclick = async () => {
-    const repo = gb.querySelector("#gr").value;
+    const repo = encodeURIComponent(gb.querySelector("#gr").value);
     const q = gb.querySelector("#gq").value.trim();
     const out = gb.querySelector("#gt");
     out.innerHTML = "";

@@ -1,0 +1,144 @@
+"""desktop-bridge guest agent (SURVEY §2.3 #38): a separate "guest"
+serves its GUI desktop to the control plane over /ws/provider; viewers
+watch via the normal /ws/stream and click via /ws/input — the control
+plane only relays packets."""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+
+from helix_tpu.desktop.stream import DesktopManager, ExternalDesktopSession
+from helix_tpu.desktop.video import VideoDecoder, VideoEncoder
+
+
+class TestExternalSession:
+    def test_packet_fanout_and_keyframe_replay(self):
+        m = DesktopManager()
+        s = m.create(name="ext", kind="external")
+        assert isinstance(s, ExternalDesktopSession)
+        inputs = []
+        s.attach_provider(inputs.append)
+        # a keyframe packet (video codec, type byte 0)
+        enc = VideoEncoder(64, 48)
+        import numpy as np
+
+        kf = enc.encode(np.zeros((48, 64, 4), np.uint8), keyframe=True)
+        got_a = []
+        s.subscribe(got_a.append)
+        s.push_packet(kf)
+        assert got_a == [kf]
+        # late joiner gets the cached keyframe instantly + a refresh is
+        # sent to the guest
+        got_b = []
+        s.subscribe(got_b.append)
+        assert got_b == [kf]
+        assert any(e.get("type") == "refresh" for e in inputs)
+        # input routing to provider
+        s.handle_input({"type": "pointer", "x": 1, "y": 2})
+        assert inputs[-1]["type"] == "pointer"
+        m.destroy(s.id)
+
+    def test_manager_lists_external_with_codec(self):
+        m = DesktopManager()
+        s = m.create(name="ext2", kind="external")
+        entry = next(d for d in m.list() if d["id"] == s.id)
+        assert entry["codec"] == "video"
+        assert entry["stats"]["provider_connected"] is False
+
+
+class TestBridgeE2E:
+    def test_guest_bridge_through_real_control_plane(self):
+        """Full loop: guest DesktopBridge process-side -> control plane
+        relay -> viewer WS decode; click flows back to the guest GUI."""
+        from aiohttp import web as _web
+
+        from helix_tpu.control.server import ControlPlane
+        from helix_tpu.desktop.bridge import DesktopBridge
+
+        cp = ControlPlane()
+        started = threading.Event()
+        holder = {}
+
+        def serve():
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            runner = _web.AppRunner(cp.build_app())
+            loop.run_until_complete(runner.setup())
+            site = _web.TCPSite(runner, "127.0.0.1", 18465)
+            loop.run_until_complete(site.start())
+            holder["loop"] = loop
+            started.set()
+            loop.run_forever()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        assert started.wait(10)
+        url = "http://127.0.0.1:18465"
+
+        commands = []
+        bridge = DesktopBridge(
+            url, name="guest-gui", fps=30,
+            on_command=commands.append,
+        ).start()
+        try:
+            assert bridge.connected.wait(10), "bridge never connected"
+
+            async def viewer():
+                import aiohttp
+
+                dec = VideoDecoder(960, 540)
+                async with aiohttp.ClientSession() as http:
+                    ws = await http.ws_connect(
+                        f"{url}/api/v1/desktops/{bridge.desktop_id}"
+                        f"/ws/stream"
+                    )
+                    # first decodable frame must be an I-frame
+                    deadline = time.time() + 15
+                    frame = None
+                    while time.time() < deadline:
+                        msg = await asyncio.wait_for(ws.receive(), 10)
+                        if msg.type != aiohttp.WSMsgType.BINARY:
+                            continue
+                        try:
+                            frame = dec.decode(msg.data)
+                            break
+                        except RuntimeError:
+                            continue   # P before our I: wait for keyframe
+                    assert frame is not None and dec.frame_type == "I"
+
+                    # click the console entry, type a command, Enter —
+                    # through the normal viewer input path
+                    wsi = await http.ws_connect(
+                        f"{url}/api/v1/desktops/{bridge.desktop_id}"
+                        f"/ws/input"
+                    )
+                    await wsi.send_str(json.dumps(
+                        {"type": "pointer", "x": 55, "y": 357,
+                         "button": 1, "state": "down"}
+                    ))
+                    for ch in "do it":
+                        await wsi.send_str(json.dumps(
+                            {"type": "text", "text": ch}
+                        ))
+                    await wsi.send_str(json.dumps(
+                        {"type": "key", "key": "Enter"}
+                    ))
+                    await ws.close()
+                    await wsi.close()
+
+            asyncio.new_event_loop().run_until_complete(viewer())
+
+            deadline = time.time() + 10
+            while time.time() < deadline and not commands:
+                time.sleep(0.05)
+            assert commands == ["do it"]
+            assert bridge.frames_sent > 0
+        finally:
+            bridge.stop()
+            cp.desktops.stop_all()
+            cp.orchestrator.stop()
+            cp.knowledge.stop()
+            holder["loop"].call_soon_threadsafe(holder["loop"].stop)
