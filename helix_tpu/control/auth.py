@@ -90,7 +90,24 @@ CREATE TABLE IF NOT EXISTS org_invitations (
 );
 """
 
+_GRANTS_SCHEMA = """
+CREATE TABLE IF NOT EXISTS access_grants (
+    id TEXT PRIMARY KEY,
+    resource_type TEXT NOT NULL,   -- app | project | repo | knowledge ...
+    resource_id TEXT NOT NULL,
+    principal_type TEXT NOT NULL,  -- user | team
+    principal_id TEXT NOT NULL,
+    role TEXT NOT NULL,            -- read | write | admin
+    created_by TEXT NOT NULL DEFAULT '',
+    created_at REAL NOT NULL,
+    UNIQUE(resource_type, resource_id, principal_type, principal_id)
+);
+CREATE INDEX IF NOT EXISTS idx_grants_resource
+    ON access_grants(resource_type, resource_id);
+"""
+
 ROLES = ("owner", "admin", "member")
+GRANT_ROLES = ("admin", "write", "read")   # strongest first
 
 
 @dataclasses.dataclass
@@ -112,6 +129,7 @@ class Authenticator:
         self._db.migrate("auth", [
             (1, "initial", _SCHEMA),
             (2, "teams_invitations", _TEAMS_SCHEMA),
+            (3, "access_grants", _GRANTS_SCHEMA),
         ])
         if master_key is None:
             env_key = os.environ.get("HELIX_MASTER_KEY")
@@ -491,6 +509,107 @@ class Authenticator:
                 return False
             return ROLES.index(role) <= ROLES.index(min_role)
         return False
+
+    # -- access grants (per-resource sharing, access_grant_handlers.go) ---
+    def grant_access(self, resource_type: str, resource_id: str,
+                     principal_type: str, principal_id: str,
+                     role: str = "read", created_by: str = "") -> dict:
+        if role not in GRANT_ROLES:
+            raise ValueError(f"role must be one of {GRANT_ROLES}")
+        if principal_type not in ("user", "team"):
+            raise ValueError("principal_type must be user or team")
+        # an unknown principal would make an inert grant and the sharer
+        # would never learn the share failed — fail loudly instead
+        if not principal_id:
+            raise ValueError("principal_id is required")
+        if principal_type == "user" and self.get_user(principal_id) is None:
+            raise ValueError(f"unknown user {principal_id!r}")
+        if principal_type == "team":
+            with self._lock:
+                if self._conn.execute(
+                    "SELECT 1 FROM org_teams WHERE id=?", (principal_id,)
+                ).fetchone() is None:
+                    raise ValueError(f"unknown team {principal_id!r}")
+        gid = f"grant_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO access_grants(id, resource_type, resource_id,"
+                " principal_type, principal_id, role, created_by,"
+                " created_at) VALUES(?,?,?,?,?,?,?,?)"
+                " ON CONFLICT(resource_type, resource_id, principal_type,"
+                " principal_id) DO UPDATE SET role=excluded.role",
+                (gid, resource_type, resource_id, principal_type,
+                 principal_id, role, created_by, time.time()),
+            )
+            self._db.commit()
+            row = self._conn.execute(
+                "SELECT id FROM access_grants WHERE resource_type=? AND"
+                " resource_id=? AND principal_type=? AND principal_id=?",
+                (resource_type, resource_id, principal_type, principal_id),
+            ).fetchone()
+        return self.get_grant(row[0])
+
+    def get_grant(self, gid: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, resource_type, resource_id, principal_type,"
+                " principal_id, role, created_by, created_at"
+                " FROM access_grants WHERE id=?",
+                (gid,),
+            ).fetchone()
+        if row is None:
+            return None
+        return {
+            "id": row[0], "resource_type": row[1], "resource_id": row[2],
+            "principal_type": row[3], "principal_id": row[4],
+            "role": row[5], "created_by": row[6], "created_at": row[7],
+        }
+
+    def list_grants(self, resource_type: str, resource_id: str) -> list:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT id, resource_type, resource_id, principal_type,"
+                " principal_id, role, created_by, created_at"
+                " FROM access_grants WHERE resource_type=? AND"
+                " resource_id=? ORDER BY created_at",
+                (resource_type, resource_id),
+            ).fetchall()
+        return [
+            {
+                "id": r[0], "resource_type": r[1], "resource_id": r[2],
+                "principal_type": r[3], "principal_id": r[4],
+                "role": r[5], "created_by": r[6], "created_at": r[7],
+            }
+            for r in rows
+        ]
+
+    def revoke_grant(self, gid: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM access_grants WHERE id=?", (gid,)
+            )
+            self._db.commit()
+        return cur.rowcount > 0
+
+    def has_access(self, user: Optional[User], resource_type: str,
+                   resource_id: str, min_role: str = "read") -> bool:
+        """Grant-based access: direct user grants plus grants to any team
+        the user belongs to; platform admins always pass."""
+        if user is None:
+            return False
+        if user.admin:
+            return True
+        need = GRANT_ROLES.index(min_role)
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT role FROM access_grants WHERE resource_type=? AND"
+                " resource_id=? AND ((principal_type='user' AND"
+                " principal_id=?) OR (principal_type='team' AND"
+                " principal_id IN (SELECT team_id FROM team_members"
+                " WHERE user_id=?)))",
+                (resource_type, resource_id, user.id, user.id),
+            ).fetchall()
+        return any(GRANT_ROLES.index(r[0]) <= need for r in rows)
 
     def search_users(self, q: str, limit: int = 20) -> list:
         """Substring match over email/name (reference /users/search).

@@ -939,6 +939,41 @@ class ControlPlane:
             "/api/v1/projects/{id}/repositories/{repo}/detach",
             self.projects_detach_repo,
         )
+        # question sets: standalone reusable questionnaires (reference
+        # /question-sets family) — eval suites without an app binding
+        r.add_get("/api/v1/question-sets", self.question_sets_list)
+        r.add_post("/api/v1/question-sets", self.question_sets_create)
+        r.add_get("/api/v1/question-sets/{id}", self.question_set_get)
+        r.add_put("/api/v1/question-sets/{id}", self.question_set_update)
+        r.add_delete(
+            "/api/v1/question-sets/{id}", self.question_set_delete
+        )
+        r.add_post(
+            "/api/v1/question-sets/{id}/executions",
+            self.question_set_execute,
+        )
+        r.add_get(
+            "/api/v1/question-sets/{id}/executions",
+            self.question_set_executions,
+        )
+        # access grants: per-resource sharing (user/team principals)
+        for rtype, prefix in (
+            ("app", "/api/v1/apps/{rid}"),
+            ("project", "/api/v1/projects/{rid}"),
+            ("repo", "/api/v1/git/repositories/{rid}"),
+        ):
+            r.add_get(
+                f"{prefix}/access-grants",
+                self._make_grants_handler("list", rtype),
+            )
+            r.add_post(
+                f"{prefix}/access-grants",
+                self._make_grants_handler("create", rtype),
+            )
+            r.add_delete(
+                f"{prefix}/access-grants/{{gid}}",
+                self._make_grants_handler("delete", rtype),
+            )
         # per-user settings (reference /users/me/* family)
         r.add_get("/api/v1/users/me/settings/{key}", self.user_pref_get)
         r.add_put("/api/v1/users/me/settings/{key}", self.user_pref_put)
@@ -1413,9 +1448,18 @@ class ControlPlane:
 
     # -- apps ----------------------------------------------------------------
     async def list_apps(self, request):
-        return web.json_response(
-            {"apps": self.store.list_apps(request.query.get("owner"))}
-        )
+        apps = self.store.list_apps(request.query.get("owner"))
+        if self.auth_required:
+            # same visibility rule as get_app: owner / admin / read grant
+            user = request.get("user")
+            apps = [
+                a for a in apps
+                if self.auth.authorize(
+                    user, resource_owner=a.get("owner", "")
+                )
+                or self.auth.has_access(user, "app", a["id"], "read")
+            ]
+        return web.json_response({"apps": apps})
 
     async def create_app(self, request):
         """Accepts JSON app docs or raw helix.yaml (Content-Type: yaml)."""
@@ -1440,14 +1484,110 @@ class ControlPlane:
         app = self.store.get_app(request.match_info["id"])
         if app is None:
             return _err(404, "app not found")
+        # visibility: owner / platform admin / access grant (read+)
+        user = request.get("user")
+        if self.auth_required and not (
+            self.auth.authorize(user, resource_owner=app.get("owner", ""))
+            or self.auth.has_access(user, "app", app["id"], "read")
+        ):
+            return _err(403, "no access to this app")
         return web.json_response(app)
 
     async def delete_app(self, request):
-        ok = self.store.delete_app(request.match_info["id"])
+        app = self.store.get_app(request.match_info["id"])
+        if app is None:
+            return _err(404, "app not found")
+        user = request.get("user")
+        if self.auth_required and not (
+            self.auth.authorize(user, resource_owner=app.get("owner", ""))
+            or self.auth.has_access(user, "app", app["id"], "admin")
+        ):
+            return _err(403, "no admin access to this app")
+        ok = self.store.delete_app(app["id"])
         return web.json_response({"ok": ok}, status=200 if ok else 404)
 
     # -- evaluation suites / runs -------------------------------------------
     # (reference: server.go:1058-1067 + types/evaluation.go)
+    # -- question sets (standalone questionnaires over the eval engine) --------
+    async def question_sets_list(self, request):
+        return web.json_response({
+            "question_sets": self.store.list_eval_suites(app_id="")
+        })
+
+    async def question_sets_create(self, request):
+        body = await request.json()
+        try:
+            qs = self.evals.create_suite(
+                app_id="", owner=self._user_id(request), doc=body
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(qs, status=201)
+
+    def _question_set_or_none(self, request):
+        qs = self.store.get_eval_suite(request.match_info["id"])
+        if qs is None or qs.get("app_id"):
+            return None    # app-bound suites are not question sets
+        return qs
+
+    def _question_set_denied(self, request, qs):
+        """Mutations/executions: owner or platform admin only."""
+        user = request.get("user")
+        if self.auth_required and not self.auth.authorize(
+            user, resource_owner=qs.get("owner", "")
+        ):
+            return _err(403, "not your question set")
+        return None
+
+    async def question_set_get(self, request):
+        qs = self._question_set_or_none(request)
+        if qs is None:
+            return _err(404, "question set not found")
+        return web.json_response(qs)
+
+    async def question_set_update(self, request):
+        qs = self._question_set_or_none(request)
+        if qs is None:
+            return _err(404, "question set not found")
+        denied = self._question_set_denied(request, qs)
+        if denied is not None:
+            return denied
+        body = await request.json()
+        try:
+            updated = self.evals.update_suite(qs["id"], body)
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(updated)
+
+    async def question_set_delete(self, request):
+        qs = self._question_set_or_none(request)
+        if qs is None:
+            return _err(404, "question set not found")
+        denied = self._question_set_denied(request, qs)
+        if denied is not None:
+            return denied
+        return web.json_response(
+            {"ok": self.store.delete_eval_suite(qs["id"])}
+        )
+
+    async def question_set_execute(self, request):
+        qs = self._question_set_or_none(request)
+        if qs is None:
+            return _err(404, "question set not found")
+        denied = self._question_set_denied(request, qs)
+        if denied is not None:
+            return denied
+        run = self.evals.start_run(qs["id"], owner=self._user_id(request))
+        return web.json_response(run, status=202)
+
+    async def question_set_executions(self, request):
+        qs = self._question_set_or_none(request)
+        if qs is None:
+            return _err(404, "question set not found")
+        return web.json_response(
+            {"executions": self.store.list_eval_runs(qs["id"])}
+        )
+
     async def list_eval_suites(self, request):
         return web.json_response(
             {
@@ -2114,6 +2254,8 @@ class ControlPlane:
         self.git.create_repo(
             name, default_branch=body.get("default_branch", "main")
         )
+        # creator owns the repo: the bootstrap identity for repo grants
+        self.store.kv_set(f"repo-owner:{name}", self._user_id(request))
         return web.json_response({"name": name}, status=201)
 
     async def git_repo_meta(self, request):
@@ -2301,6 +2443,65 @@ class ControlPlane:
             request.match_info["id"], request.match_info["repo"]
         )
         return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    # -- access grants ---------------------------------------------------------
+    def _resource_owner(self, rtype: str, rid: str) -> Optional[str]:
+        """-> owner id, or None when the resource does not exist."""
+        if rtype == "app":
+            app = self.store.get_app(rid)
+            return None if app is None else app.get("owner", "")
+        if rtype == "project":
+            p = self.projects.get(rid)
+            return None if p is None else p.get("owner", "")
+        if rtype == "repo":
+            if not self.git.repo_exists(rid):
+                return None
+            return self.store.kv_get(f"repo-owner:{rid}") or ""
+        return None
+
+    def _make_grants_handler(self, op: str, rtype: str):
+        async def handler(request):
+            rid = request.match_info["rid"]
+            owner = self._resource_owner(rtype, rid)
+            if owner is None:
+                return _err(404, f"{rtype} not found")
+            user = request.get("user")
+            # every grant operation (including listing who has access)
+            # needs ownership, an admin grant, or platform admin
+            # (reference: createAppAccessGrant authz)
+            if self.auth_required and not (
+                self.auth.authorize(user, resource_owner=owner)
+                or self.auth.has_access(user, rtype, rid, "admin")
+            ):
+                return _err(403, "grant management needs ownership")
+            if op == "list":
+                return web.json_response(
+                    {"grants": self.auth.list_grants(rtype, rid)}
+                )
+            if op == "create":
+                body = await request.json()
+                try:
+                    g = self.auth.grant_access(
+                        rtype, rid,
+                        body.get("principal_type", "user"),
+                        body.get("principal_id", ""),
+                        role=body.get("role", "read"),
+                        created_by=self._user_id(request),
+                    )
+                except ValueError as e:
+                    return _err(400, str(e))
+                return web.json_response(g, status=201)
+            gid = request.match_info["gid"]
+            g = self.auth.get_grant(gid)
+            if g is None or (g["resource_type"], g["resource_id"]) != (
+                rtype, rid
+            ):
+                return _err(404, "grant not found on this resource")
+            return web.json_response(
+                {"ok": self.auth.revoke_grant(gid)}
+            )
+
+        return handler
 
     # -- per-user settings -----------------------------------------------------
     _USER_PREF_KEYS = (

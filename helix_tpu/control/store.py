@@ -515,10 +515,12 @@ class Store:
         return self._suite_row(row) if row else None
 
     def list_eval_suites(self, app_id: Optional[str] = None) -> list:
+        """None = every suite; "" = standalone question sets only; any
+        other value = that app's suites."""
         q = ("SELECT id, app_id, owner, doc, created_at, updated_at "
              "FROM eval_suites")
         args: tuple = ()
-        if app_id:
+        if app_id is not None:
             q += " WHERE app_id=?"
             args = (app_id,)
         with self._lock:

@@ -327,6 +327,191 @@ class TestOrgAuthz:
         )
 
 
+class TestQuestionSets:
+    def test_lifecycle_and_execution(self):
+        import asyncio
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                r = await client.post("/api/v1/question-sets", json={
+                    "name": "smoke-set",
+                    "questions": [
+                        {"question": "What is 2+2?", "assertions":
+                         [{"type": "contains", "value": "4"}]},
+                    ],
+                })
+                assert r.status == 201
+                qid = (await r.json())["id"]
+                r = await client.get("/api/v1/question-sets")
+                sets = (await r.json())["question_sets"]
+                assert [s["id"] for s in sets] == [qid]
+
+                # app-bound suites do NOT leak into question sets
+                app_id = cp.store.upsert_app("a", "o", {"name": "a"})
+                cp.evals.create_suite(app_id, "o", {
+                    "name": "bound", "questions":
+                    [{"question": "q?"}],
+                })
+                r = await client.get("/api/v1/question-sets")
+                assert len((await r.json())["question_sets"]) == 1
+                r = await client.get(f"/api/v1/question-sets/{qid}")
+                assert (await r.json())["name"] == "smoke-set"
+
+                # update + invalid doc rejected
+                r = await client.put(f"/api/v1/question-sets/{qid}",
+                                     json={"questions": [{}]})
+                assert r.status == 400
+                r = await client.put(f"/api/v1/question-sets/{qid}", json={
+                    "name": "smoke-set-2",
+                    "questions": [{"question": "Still 2+2?"}],
+                })
+                assert (await r.json())["name"] == "smoke-set-2"
+
+                # execution runs through the eval engine (no model
+                # backends in this test server: the run completes with
+                # error results, but the execution surface works)
+                r = await client.post(
+                    f"/api/v1/question-sets/{qid}/executions", json={}
+                )
+                assert r.status == 202
+                rid = (await r.json())["id"]
+                for _ in range(100):
+                    r = await client.get(
+                        f"/api/v1/question-sets/{qid}/executions"
+                    )
+                    exes = (await r.json())["executions"]
+                    if exes and exes[0]["status"] in (
+                        "completed", "failed"
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+                assert exes[0]["id"] == rid
+                assert exes[0]["status"] in ("completed", "failed")
+
+                r = await client.delete(f"/api/v1/question-sets/{qid}")
+                assert (await r.json())["ok"]
+            finally:
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
+
+
+class TestAccessGrants:
+    def test_grant_resolution_user_and_team(self):
+        a = Authenticator()
+        owner = a.create_user("o@g.com")
+        alice = a.create_user("a@g.com")
+        bob = a.create_user("b@g.com")
+        org = a.create_org("g-org", owner.id)
+        a.add_member(org, bob.id)
+        team = a.create_team(org, "readers")
+        a.add_team_member(team["id"], bob.id)
+
+        a.grant_access("app", "app_1", "user", alice.id, role="write")
+        a.grant_access("app", "app_1", "team", team["id"], role="read")
+
+        assert a.has_access(alice, "app", "app_1", "write")
+        assert a.has_access(alice, "app", "app_1", "read")
+        assert not a.has_access(alice, "app", "app_1", "admin")
+        assert a.has_access(bob, "app", "app_1", "read")    # via team
+        assert not a.has_access(bob, "app", "app_1", "write")
+        stranger = a.create_user("s@g.com")
+        assert not a.has_access(stranger, "app", "app_1", "read")
+        # upsert: re-grant upgrades the role in place
+        a.grant_access("app", "app_1", "user", alice.id, role="admin")
+        assert a.has_access(alice, "app", "app_1", "admin")
+        assert len(a.list_grants("app", "app_1")) == 2
+        with pytest.raises(ValueError):
+            a.grant_access("app", "x", "user", alice.id, role="root")
+
+    def test_http_grant_flow_and_enforcement(self):
+        import asyncio
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+        cp.auth_required = True
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                owner = cp.auth.create_user("own@h.com")
+                ok_h = {"Authorization":
+                        f"Bearer {cp.auth.create_api_key(owner.id)}"}
+                guest = cp.auth.create_user("guest@h.com")
+                g_h = {"Authorization":
+                       f"Bearer {cp.auth.create_api_key(guest.id)}"}
+
+                app_id = cp.store.upsert_app(
+                    "shared-app", owner.id, {"name": "shared-app"}
+                )
+                # guest blocked before any grant
+                r = await client.get(f"/api/v1/apps/{app_id}", headers=g_h)
+                assert r.status == 403
+                # guest cannot mint their own grant
+                r = await client.post(
+                    f"/api/v1/apps/{app_id}/access-grants",
+                    json={"principal_type": "user",
+                          "principal_id": guest.id, "role": "read"},
+                    headers=g_h,
+                )
+                assert r.status == 403
+                # owner grants read
+                r = await client.post(
+                    f"/api/v1/apps/{app_id}/access-grants",
+                    json={"principal_type": "user",
+                          "principal_id": guest.id, "role": "read"},
+                    headers=ok_h,
+                )
+                assert r.status == 201
+                gid = (await r.json())["id"]
+                r = await client.get(f"/api/v1/apps/{app_id}", headers=g_h)
+                assert r.status == 200
+                # read grant does not allow delete
+                r = await client.delete(f"/api/v1/apps/{app_id}",
+                                        headers=g_h)
+                assert r.status == 403
+                # revoke -> blocked again
+                r = await client.delete(
+                    f"/api/v1/apps/{app_id}/access-grants/{gid}",
+                    headers=ok_h,
+                )
+                assert r.status == 200
+                r = await client.get(f"/api/v1/apps/{app_id}", headers=g_h)
+                assert r.status == 403
+                # grants exist on projects and repos too
+                r = await client.post("/api/v1/projects",
+                                      json={"name": "gp"}, headers=ok_h)
+                pid = (await r.json())["id"]
+                r = await client.get(
+                    f"/api/v1/projects/{pid}/access-grants", headers=ok_h
+                )
+                assert (await r.json())["grants"] == []
+            finally:
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
+
+
 class TestHTTPSurface:
     def test_projects_git_settings_teams_over_http(self):
         from helix_tpu.control.server import ControlPlane
