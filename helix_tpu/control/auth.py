@@ -611,6 +611,28 @@ class Authenticator:
             ).fetchall()
         return any(GRANT_ROLES.index(r[0]) <= need for r in rows)
 
+    def accessible_resources(self, user: Optional[User],
+                             resource_type: str,
+                             min_role: str = "read") -> set:
+        """All resource ids of ``resource_type`` the user can reach via
+        grants (direct or team), in one query — the batch form of
+        has_access for list filtering."""
+        if user is None:
+            return set()
+        need = GRANT_ROLES.index(min_role)
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT resource_id, role FROM access_grants WHERE"
+                " resource_type=? AND ((principal_type='user' AND"
+                " principal_id=?) OR (principal_type='team' AND"
+                " principal_id IN (SELECT team_id FROM team_members"
+                " WHERE user_id=?)))",
+                (resource_type, user.id, user.id),
+            ).fetchall()
+        return {
+            r[0] for r in rows if GRANT_ROLES.index(r[1]) <= need
+        }
+
     def search_users(self, q: str, limit: int = 20) -> list:
         """Substring match over email/name (reference /users/search).
         LIKE metacharacters in the query are escaped to literals."""

@@ -280,6 +280,21 @@ class ControlPlane:
         self.controller.metasearch = self.metasearch
         self.controller.browser_pool = self.browser_pool
 
+        # org dev sandboxes (interactive command/file/screenshot surface)
+        from helix_tpu.services.dev_sandbox import DevSandboxService
+
+        sbx_root = (
+            tempfile_dir()
+            if db_path == ":memory:"
+            else _os.path.join(
+                _os.path.dirname(_os.path.abspath(db_path)) or ".",
+                "helix-sandboxes",
+            )
+        )
+        self.dev_sandboxes = DevSandboxService(
+            sbx_root, desktops=self.desktops
+        )
+
         def make_emitter(task, mode):
             """Stream a task agent's steps into a watchable desktop session
             (the reference's 'user watches the agent's desktop' loop)."""
@@ -603,6 +618,9 @@ class ControlPlane:
         self.ping.stop()
         if self.compute is not None:
             self.compute.stop()
+        self.dev_sandboxes.stop_all()
+        self.desktops.stop_all()
+        self.zed.stop()
 
     def _pick_embed_model(self):
         for st in self.router.runners():
@@ -832,6 +850,15 @@ class ControlPlane:
         r.add_delete("/api/v1/knowledge/{id}", self.delete_knowledge)
         r.add_post("/api/v1/knowledge/{id}/refresh", self.refresh_knowledge)
         r.add_post("/api/v1/knowledge/{id}/search", self.search_knowledge)
+        r.add_get(
+            "/api/v1/knowledge/{id}/versions", self.knowledge_versions
+        )
+        r.add_get(
+            "/api/v1/knowledge/{id}/download", self.knowledge_download
+        )
+        r.add_post(
+            "/api/v1/knowledge/{id}/complete", self.knowledge_complete
+        )
         # bundled metasearch (searx-compatible wire shape) + browser pool
         r.add_get("/api/v1/search", self.web_search)
         r.add_get("/search", self.web_search)
@@ -938,6 +965,47 @@ class ControlPlane:
         r.add_post(
             "/api/v1/projects/{id}/repositories/{repo}/detach",
             self.projects_detach_repo,
+        )
+        # org dev sandboxes (interactive: commands/files/screenshot)
+        r.add_get("/api/v1/orgs/{id}/sandboxes", self.sandboxes_list)
+        r.add_post("/api/v1/orgs/{id}/sandboxes", self.sandboxes_create)
+        r.add_get(
+            "/api/v1/orgs/{id}/sandboxes/{sid}", self.sandbox_get
+        )
+        r.add_delete(
+            "/api/v1/orgs/{id}/sandboxes/{sid}", self.sandbox_delete
+        )
+        r.add_post(
+            "/api/v1/orgs/{id}/sandboxes/{sid}/commands",
+            self.sandbox_run_command,
+        )
+        r.add_get(
+            "/api/v1/orgs/{id}/sandboxes/{sid}/commands",
+            self.sandbox_commands,
+        )
+        r.add_get(
+            "/api/v1/orgs/{id}/sandboxes/{sid}/commands/{cid}",
+            self.sandbox_command_get,
+        )
+        r.add_post(
+            "/api/v1/orgs/{id}/sandboxes/{sid}/commands/{cid}/kill",
+            self.sandbox_command_kill,
+        )
+        r.add_get(
+            "/api/v1/orgs/{id}/sandboxes/{sid}/commands/{cid}/logs",
+            self.sandbox_command_logs,
+        )
+        r.add_get(
+            "/api/v1/orgs/{id}/sandboxes/{sid}/files/list",
+            self.sandbox_files_list,
+        )
+        r.add_get(
+            "/api/v1/orgs/{id}/sandboxes/{sid}/files",
+            self.sandbox_file_read,
+        )
+        r.add_get(
+            "/api/v1/orgs/{id}/sandboxes/{sid}/screenshot",
+            self.sandbox_screenshot,
         )
         # question sets: standalone reusable questionnaires (reference
         # /question-sets family) — eval suites without an app binding
@@ -1450,14 +1518,16 @@ class ControlPlane:
     async def list_apps(self, request):
         apps = self.store.list_apps(request.query.get("owner"))
         if self.auth_required:
-            # same visibility rule as get_app: owner / admin / read grant
+            # same visibility rule as get_app: owner / admin / read
+            # grant — grants fetched in ONE query, filtered in memory
             user = request.get("user")
+            granted = self.auth.accessible_resources(user, "app", "read")
             apps = [
                 a for a in apps
                 if self.auth.authorize(
                     user, resource_owner=a.get("owner", "")
                 )
-                or self.auth.has_access(user, "app", a["id"], "read")
+                or a["id"] in granted
             ]
         return web.json_response({"apps": apps})
 
@@ -1609,27 +1679,43 @@ class ControlPlane:
             return _err(400, str(e))
         return web.json_response(suite)
 
-    async def get_eval_suite(self, request):
+    def _app_suite_or_none(self, request):
+        """Resolve the suite THROUGH the app path segment: a suite from
+        another app — or a standalone question set — must not be
+        reachable via /apps/{other}/evaluation-suites/{id} (the
+        question-set owner gate would be bypassable otherwise)."""
         suite = self.store.get_eval_suite(request.match_info["id"])
+        if suite is None:
+            return None
+        if suite.get("app_id") != request.match_info["app_id"]:
+            return None
+        return suite
+
+    async def get_eval_suite(self, request):
+        suite = self._app_suite_or_none(request)
         if suite is None:
             return _err(404, "suite not found")
         return web.json_response(suite)
 
     async def update_eval_suite(self, request):
+        if self._app_suite_or_none(request) is None:
+            return _err(404, "suite not found")
         body = await request.json()
         try:
             suite = self.evals.update_suite(request.match_info["id"], body)
         except ValueError as e:
             return _err(400, str(e))
-        if suite is None:
-            return _err(404, "suite not found")
         return web.json_response(suite)
 
     async def delete_eval_suite(self, request):
+        if self._app_suite_or_none(request) is None:
+            return _err(404, "suite not found")
         ok = self.store.delete_eval_suite(request.match_info["id"])
         return web.json_response({"ok": ok}, status=200 if ok else 404)
 
     async def start_eval_run(self, request):
+        if self._app_suite_or_none(request) is None:
+            return _err(404, "suite not found")
         run = self.evals.start_run(
             request.match_info["id"], self._user_id(request)
         )
@@ -1638,17 +1724,27 @@ class ControlPlane:
         return web.json_response(run, status=201)
 
     async def list_eval_runs(self, request):
+        if self._app_suite_or_none(request) is None:
+            return _err(404, "suite not found")
         return web.json_response(
             {"runs": self.store.list_eval_runs(request.match_info["id"])}
         )
 
-    async def get_eval_run(self, request):
+    def _app_run_or_none(self, request):
         run = self.store.get_eval_run(request.match_info["run_id"])
+        if run is None or run.get("app_id") != request.match_info["app_id"]:
+            return None
+        return run
+
+    async def get_eval_run(self, request):
+        run = self._app_run_or_none(request)
         if run is None:
             return _err(404, "run not found")
         return web.json_response(run)
 
     async def delete_eval_run(self, request):
+        if self._app_run_or_none(request) is None:
+            return _err(404, "run not found")
         rid = request.match_info["run_id"]
         self.evals.cancel_run(rid)
         ok = self.store.delete_eval_run(rid)
@@ -1754,6 +1850,52 @@ class ControlPlane:
             ),
         )
         return web.json_response({"results": results})
+
+    async def knowledge_versions(self, request):
+        kid = request.match_info["id"]
+        spec = self.knowledge.get(kid)
+        if spec is None:
+            return _err(404, "knowledge not found")
+        versions = self.knowledge.store.versions(kid)
+        for v in versions:
+            v["current"] = v["version"] == spec.version
+        return web.json_response(
+            {"versions": versions, "state": spec.state}
+        )
+
+    async def knowledge_download(self, request):
+        """Export the indexed content as JSONL (one chunk per line)."""
+        kid = request.match_info["id"]
+        spec = self.knowledge.get(kid)
+        if spec is None:
+            return _err(404, "knowledge not found")
+        chunks = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: self.knowledge.store.dump(kid, version=spec.version),
+        )
+        body = "\n".join(json.dumps(c) for c in chunks)
+        return web.Response(
+            text=body, content_type="application/jsonl",
+            headers={
+                "Content-Disposition":
+                    f'attachment; filename="{kid}.jsonl"',
+            },
+        )
+
+    async def knowledge_complete(self, request):
+        """External-extractor push: pre-extracted chunks -> new version."""
+        kid = request.match_info["id"]
+        if self.knowledge.get(kid) is None:
+            return _err(404, "knowledge not found")
+        body = await request.json()
+        chunks = body.get("chunks") or []
+        try:
+            spec = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self.knowledge.complete(kid, chunks)
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(spec.to_dict())
 
     async def web_search(self, request):
         """Bundled metasearch on the searx wire shape — the agent
@@ -2443,6 +2585,182 @@ class ControlPlane:
             request.match_info["id"], request.match_info["repo"]
         )
         return web.json_response({"ok": ok}, status=200 if ok else 404)
+
+    # -- org dev sandboxes -----------------------------------------------------
+    def _sandbox_or_none(self, request):
+        """Sandbox resolved THROUGH its org path segment."""
+        sb = self.dev_sandboxes.get(request.match_info["sid"])
+        if sb is None or sb.org_id != request.match_info["id"]:
+            return None
+        return sb
+
+    def _org_member_denied(self, request, oid: str):
+        """Sandboxes run shell commands and expose workspaces: EVERY
+        operation needs at least org membership (platform admins pass)."""
+        user = request.get("user")
+        if self.auth_required and not self.auth.authorize(
+            user, org_id=oid, min_role="member"
+        ):
+            return _err(403, "org membership required")
+        return None
+
+    async def sandboxes_list(self, request):
+        oid = request.match_info["id"]
+        denied = self._org_member_denied(request, oid)
+        if denied is not None:
+            return denied
+        return web.json_response({
+            "sandboxes": self.dev_sandboxes.list(org_id=oid)
+        })
+
+    async def sandboxes_create(self, request):
+        oid = request.match_info["id"]
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        try:
+            sb = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: self.dev_sandboxes.create(
+                    oid, name=body.get("name", ""),
+                    with_desktop=bool(body.get("with_desktop")),
+                ),
+            )
+        except RuntimeError as e:
+            return _err(429, str(e))
+        return web.json_response(sb.to_dict(), status=201)
+
+    async def sandbox_get(self, request):
+        denied = self._org_member_denied(request, request.match_info["id"])
+        if denied is not None:
+            return denied
+        sb = self._sandbox_or_none(request)
+        if sb is None:
+            return _err(404, "sandbox not found")
+        doc = sb.to_dict()
+        doc["command_list"] = [
+            c.to_dict() for c in sb.commands.values()
+        ]
+        return web.json_response(doc)
+
+    async def sandbox_delete(self, request):
+        denied = self._org_member_denied(request, request.match_info["id"])
+        if denied is not None:
+            return denied
+        sb = self._sandbox_or_none(request)
+        if sb is None:
+            return _err(404, "sandbox not found")
+        ok = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: self.dev_sandboxes.destroy(sb.id)
+        )
+        return web.json_response({"ok": ok})
+
+    async def sandbox_run_command(self, request):
+        denied = self._org_member_denied(request, request.match_info["id"])
+        if denied is not None:
+            return denied
+        sb = self._sandbox_or_none(request)
+        if sb is None:
+            return _err(404, "sandbox not found")
+        body = await request.json()
+        shell = body.get("command", "")
+        if not shell:
+            return _err(400, "missing command")
+        try:
+            cmd = sb.run_command(shell)
+        except RuntimeError as e:
+            return _err(409, str(e))
+        return web.json_response(cmd.to_dict(), status=201)
+
+    async def sandbox_commands(self, request):
+        denied = self._org_member_denied(request, request.match_info["id"])
+        if denied is not None:
+            return denied
+        sb = self._sandbox_or_none(request)
+        if sb is None:
+            return _err(404, "sandbox not found")
+        return web.json_response({
+            "commands": [c.to_dict() for c in sb.commands.values()]
+        })
+
+    def _sandbox_command(self, request):
+        if self._org_member_denied(
+            request, request.match_info["id"]
+        ) is not None:
+            return None    # caller returns 404; no info leak either way
+        sb = self._sandbox_or_none(request)
+        if sb is None:
+            return None
+        return sb.commands.get(request.match_info["cid"])
+
+    async def sandbox_command_get(self, request):
+        cmd = self._sandbox_command(request)
+        if cmd is None:
+            return _err(404, "command not found")
+        return web.json_response(cmd.to_dict())
+
+    async def sandbox_command_kill(self, request):
+        cmd = self._sandbox_command(request)
+        if cmd is None:
+            return _err(404, "command not found")
+        return web.json_response({"ok": cmd.kill()})
+
+    async def sandbox_command_logs(self, request):
+        cmd = self._sandbox_command(request)
+        if cmd is None:
+            return _err(404, "command not found")
+        limit, err = self._parse_limit(request, default=200, cap=2000)
+        if err is not None:
+            return err
+        return web.json_response({"lines": cmd.log(tail=limit)})
+
+    async def sandbox_files_list(self, request):
+        denied = self._org_member_denied(request, request.match_info["id"])
+        if denied is not None:
+            return denied
+        sb = self._sandbox_or_none(request)
+        if sb is None:
+            return _err(404, "sandbox not found")
+        try:
+            files = sb.list_files(request.query.get("path", ""))
+        except PermissionError as e:
+            return _err(403, str(e))
+        return web.json_response({"files": files})
+
+    async def sandbox_file_read(self, request):
+        denied = self._org_member_denied(request, request.match_info["id"])
+        if denied is not None:
+            return denied
+        sb = self._sandbox_or_none(request)
+        if sb is None:
+            return _err(404, "sandbox not found")
+        try:
+            data = sb.read_file(request.query.get("path", ""))
+        except PermissionError as e:
+            return _err(403, str(e))
+        except (FileNotFoundError, IsADirectoryError):
+            return _err(404, "file not found")
+        return web.Response(
+            body=data, content_type="application/octet-stream"
+        )
+
+    async def sandbox_screenshot(self, request):
+        denied = self._org_member_denied(request, request.match_info["id"])
+        if denied is not None:
+            return denied
+        sb = self._sandbox_or_none(request)
+        if sb is None:
+            return _err(404, "sandbox not found")
+        png = await asyncio.get_running_loop().run_in_executor(
+            None, sb.screenshot_png
+        )
+        if png is None:
+            return _err(409, "sandbox has no desktop attached")
+        return web.Response(body=png, content_type="image/png")
 
     # -- access grants ---------------------------------------------------------
     def _resource_owner(self, rtype: str, rid: str) -> Optional[str]:

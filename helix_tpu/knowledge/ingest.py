@@ -240,6 +240,34 @@ class KnowledgeManager:
         return spec
 
     # ------------------------------------------------------------------
+    def complete(self, kid: str, chunks: list) -> KnowledgeSpec:
+        """External-extractor ingestion (reference: the extractor service
+        POSTs /knowledge/{id}/complete with pre-extracted content): embed
+        + index caller-supplied chunks as a new version and mark ready.
+
+        chunks: [{"text": ..., "meta": {...}?}, ...]"""
+        spec = self._specs[kid]
+        if not all(isinstance(c, dict) for c in chunks):
+            raise ValueError("chunks must be objects with a 'text' field")
+        texts = [str(c.get("text", "")) for c in chunks if c.get("text")]
+        if not texts:
+            raise ValueError("complete needs at least one chunk with text")
+        metas = [
+            dict(c.get("meta") or {})
+            for c in chunks if c.get("text")
+        ]
+        new_version = spec.version + 1
+        embeddings = self.embed(texts)
+        self.store.upsert(
+            kid, texts, embeddings, metas=metas, version=new_version
+        )
+        self.store.delete_versions_below(kid, new_version)
+        spec.version = new_version
+        spec.state = "ready"
+        spec.error = ""
+        spec.progress = {"chunks": len(texts), "source": "external"}
+        return spec
+
     def query(self, kids, text: str, top_k: int = 5) -> list:
         """Search one or many knowledges; merged by score."""
         if isinstance(kids, str):

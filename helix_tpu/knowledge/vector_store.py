@@ -88,6 +88,37 @@ class VectorStore:
             self._ann.pop(collection, None)
         return ids
 
+    def versions(self, collection: str) -> list:
+        """[{version, chunks}] newest first (the /knowledge/{}/versions
+        shape; the reconciler keeps only the live version after a
+        successful re-index, older rows exist mid-index)."""
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT version, COUNT(*) FROM chunks WHERE collection=?"
+                " GROUP BY version ORDER BY version DESC",
+                (collection,),
+            ).fetchall()
+        return [{"version": r[0], "chunks": r[1]} for r in rows]
+
+    def dump(self, collection: str, version: Optional[int] = None) -> list:
+        """Chunk texts + metadata for export (embeddings omitted)."""
+        q = ("SELECT id, version, text, meta FROM chunks"
+             " WHERE collection=?")
+        args: list = [collection]
+        if version is not None:
+            q += " AND version=?"
+            args.append(version)
+        q += " ORDER BY created_at"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [
+            {
+                "id": r[0], "version": r[1], "text": r[2],
+                "meta": json.loads(r[3]),
+            }
+            for r in rows
+        ]
+
     def delete_collection(self, collection: str) -> int:
         with self._lock:
             cur = self._conn.execute(

@@ -1,0 +1,265 @@
+"""Interactive dev sandboxes — the org-scoped sandbox REST family.
+
+The reference exposes ephemeral dev sandboxes per organization with an
+interactive surface: run commands, stream logs, kill, browse/read the
+workspace, screenshot the attached desktop
+(``/organizations/{}/sandboxes`` + ``/commands|files|screenshot`` in
+``api/pkg/server/server.go``, backed by hydra dev containers).
+
+Ours are process sandboxes (the same posture as the spec-task sandbox:
+setsid group, scrubbed env, rlimits applied in the child before any user
+command runs) over a per-sandbox workspace directory, with an optional
+GUI desktop attached for the screenshot/VNC-ish surface.  The container
+executor (``services/containers.py``) is the stronger-isolation seam
+when a runtime exists.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Dict, List, Optional
+
+COMMAND_LOG_LINES = 2000
+
+
+class Command:
+    def __init__(self, shell: str, cwd: str, env: dict,
+                 cpu_s: int, memory_bytes: int, timeout_s: float):
+        self.id = f"cmd_{uuid.uuid4().hex[:12]}"
+        self.shell = shell
+        self.status = "running"
+        self.exit_code: Optional[int] = None
+        self.started = time.time()
+        self.finished: Optional[float] = None
+        self._log: deque = deque(maxlen=COMMAND_LOG_LINES)
+        self._lock = threading.Lock()
+        # the trusted child launcher applies rlimits before exec'ing the
+        # user command (no preexec_fn: fork+threads deadlock hazard)
+        launcher = (
+            "import resource, os, sys\n"
+            f"resource.setrlimit(resource.RLIMIT_CPU, ({cpu_s}, {cpu_s}))\n"
+            f"resource.setrlimit(resource.RLIMIT_AS,"
+            f" ({memory_bytes}, {memory_bytes}))\n"
+            "resource.setrlimit(resource.RLIMIT_NOFILE, (512, 512))\n"
+            "os.execvp('/bin/sh', ['/bin/sh', '-c', sys.argv[1]])\n"
+        )
+        self._proc = subprocess.Popen(
+            [sys.executable, "-c", launcher, shell],
+            cwd=cwd, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, start_new_session=True,
+        )
+        self._timer = threading.Timer(timeout_s, self.kill)
+        self._timer.daemon = True
+        self._timer.start()
+        self._reader = threading.Thread(target=self._pump, daemon=True)
+        self._reader.start()
+
+    def _pump(self) -> None:
+        for line in self._proc.stdout:
+            with self._lock:
+                self._log.append(line.rstrip("\n"))
+        rc = self._proc.wait()
+        self._timer.cancel()
+        with self._lock:
+            self.exit_code = rc
+            self.status = "exited" if self.status != "killed" else "killed"
+            self.finished = time.time()
+
+    def kill(self) -> bool:
+        with self._lock:
+            if self.status != "running":
+                return False
+            self.status = "killed"
+        try:
+            os.killpg(self._proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        return True
+
+    def log(self, tail: int = 200) -> List[str]:
+        with self._lock:
+            return list(self._log)[-tail:]
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "id": self.id, "command": self.shell,
+                "status": self.status, "exit_code": self.exit_code,
+                "started": self.started, "finished": self.finished,
+            }
+
+
+class DevSandbox:
+    def __init__(self, org_id: str, name: str, root: str,
+                 cpu_s: int = 120, memory_bytes: int = 1 << 30,
+                 command_timeout_s: float = 300.0,
+                 desktop_session=None):
+        self.id = f"sbx_{uuid.uuid4().hex[:12]}"
+        self.org_id = org_id
+        self.name = name
+        self.workspace = os.path.join(root, self.id)
+        os.makedirs(self.workspace, exist_ok=True)
+        self.created = time.time()
+        self.status = "running"
+        self.cpu_s = cpu_s
+        self.memory_bytes = memory_bytes
+        self.command_timeout_s = command_timeout_s
+        self.commands: Dict[str, Command] = {}
+        self.desktop = desktop_session    # optional GUI desktop
+        self._lock = threading.Lock()
+
+    def _env(self) -> dict:
+        return {
+            "PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+            "HOME": self.workspace,
+            "LANG": os.environ.get("LANG", "C.UTF-8"),
+        }
+
+    def run_command(self, shell: str) -> Command:
+        if self.status != "running":
+            raise RuntimeError("sandbox is stopped")
+        cmd = Command(
+            shell, cwd=self.workspace, env=self._env(),
+            cpu_s=self.cpu_s, memory_bytes=self.memory_bytes,
+            timeout_s=self.command_timeout_s,
+        )
+        with self._lock:
+            self.commands[cmd.id] = cmd
+        return cmd
+
+    # -- files (workspace-contained) --------------------------------------
+    def _resolve(self, path: str) -> str:
+        p = os.path.realpath(
+            os.path.join(self.workspace, path.lstrip("/"))
+        )
+        ws = os.path.realpath(self.workspace)
+        if p != ws and not p.startswith(ws + os.sep):
+            raise PermissionError("path escapes the sandbox workspace")
+        return p
+
+    def list_files(self, path: str = "") -> List[dict]:
+        p = self._resolve(path or ".")
+        if not os.path.isdir(p):
+            return []
+        out = []
+        for name in sorted(os.listdir(p)):
+            fp = os.path.join(p, name)
+            try:
+                # lstat: a dangling symlink a command created must not
+                # 500 the listing
+                st = os.lstat(fp)
+            except OSError:
+                continue
+            out.append({
+                "name": name,
+                "path": os.path.join(path, name).lstrip("/"),
+                "is_dir": os.path.isdir(fp),
+                "size": st.st_size,
+                "modified": st.st_mtime,
+            })
+        return out
+
+    def read_file(self, path: str, max_bytes: int = 1 << 20) -> bytes:
+        with open(self._resolve(path), "rb") as f:
+            return f.read(max_bytes)
+
+    def screenshot_png(self) -> Optional[bytes]:
+        """PNG of the attached desktop (None without one)."""
+        if self.desktop is None:
+            return None
+        from helix_tpu.desktop.mcp_server import _png
+
+        return _png(self.desktop.source.get_frame())
+
+    def stop(self) -> None:
+        self.status = "stopped"
+        for cmd in list(self.commands.values()):
+            cmd.kill()
+        if self.desktop is not None:
+            self.desktop.stop()
+
+    def destroy(self) -> None:
+        self.stop()
+        shutil.rmtree(self.workspace, ignore_errors=True)
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id, "org_id": self.org_id, "name": self.name,
+            "status": self.status, "created": self.created,
+            "workspace": self.workspace,
+            "desktop_id": self.desktop.id if self.desktop else None,
+            "commands": len(self.commands),
+        }
+
+
+class DevSandboxService:
+    def __init__(self, root: str, desktops=None,
+                 max_per_org: int = 8):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self.desktops = desktops          # DesktopManager (optional)
+        self.max_per_org = max_per_org
+        self._sandboxes: Dict[str, DevSandbox] = {}
+        self._lock = threading.Lock()
+
+    def create(self, org_id: str, name: str = "",
+               with_desktop: bool = False, **limits) -> DevSandbox:
+        # quota check + registration under ONE lock hold (two concurrent
+        # creates must not both pass the count and overshoot the quota);
+        # sandbox construction is local mkdir work, cheap enough to hold
+        desktop = None
+        if with_desktop and self.desktops is not None:
+            desktop = self.desktops.create(
+                name=f"sandbox:{name}", kind="gui"
+            )
+        with self._lock:
+            n = sum(
+                1 for s in self._sandboxes.values()
+                if s.org_id == org_id and s.status == "running"
+            )
+            if n >= self.max_per_org:
+                if desktop is not None:
+                    self.desktops.destroy(desktop.id)
+                raise RuntimeError(
+                    f"org sandbox quota reached ({self.max_per_org})"
+                )
+            sb = DevSandbox(
+                org_id, name or "sandbox", self.root,
+                desktop_session=desktop, **limits,
+            )
+            self._sandboxes[sb.id] = sb
+        return sb
+
+    def get(self, sid: str) -> Optional[DevSandbox]:
+        return self._sandboxes.get(sid)
+
+    def list(self, org_id: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            sandboxes = list(self._sandboxes.values())
+        return [
+            s.to_dict() for s in sandboxes
+            if org_id is None or s.org_id == org_id
+        ]
+
+    def destroy(self, sid: str) -> bool:
+        with self._lock:
+            sb = self._sandboxes.pop(sid, None)
+        if sb is None:
+            return False
+        if sb.desktop is not None and self.desktops is not None:
+            self.desktops.destroy(sb.desktop.id)
+        sb.destroy()
+        return True
+
+    def stop_all(self) -> None:
+        for sid in list(self._sandboxes):
+            self.destroy(sid)

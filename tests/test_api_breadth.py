@@ -396,6 +396,18 @@ class TestQuestionSets:
                 assert exes[0]["id"] == rid
                 assert exes[0]["status"] in ("completed", "failed")
 
+                # the app-suite routes cannot reach a question set (the
+                # owner gate would be bypassable through them)
+                r = await client.put(
+                    f"/api/v1/apps/{app_id}/evaluation-suites/{qid}",
+                    json={"questions": [{"question": "hijack"}]},
+                )
+                assert r.status == 404
+                r = await client.delete(
+                    f"/api/v1/apps/anything/evaluation-suites/{qid}"
+                )
+                assert r.status == 404
+
                 r = await client.delete(f"/api/v1/question-sets/{qid}")
                 assert (await r.json())["ok"]
             finally:

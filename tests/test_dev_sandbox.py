@@ -1,0 +1,240 @@
+"""Org dev sandboxes: interactive command/file/screenshot surface over
+process sandboxes (reference /organizations/{}/sandboxes family backed by
+hydra dev containers)."""
+
+import asyncio
+import time
+
+import pytest
+
+from helix_tpu.services.dev_sandbox import DevSandbox, DevSandboxService
+
+
+def _wait(pred, timeout=10.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestDevSandbox:
+    def test_command_runs_with_logs_and_exit_code(self, tmp_path):
+        svc = DevSandboxService(str(tmp_path))
+        sb = svc.create("org1", name="dev")
+        cmd = sb.run_command("echo hello; echo err >&2; exit 3")
+        assert _wait(lambda: cmd.status != "running")
+        assert cmd.exit_code == 3
+        assert cmd.log() == ["hello", "err"]
+        svc.stop_all()
+
+    def test_workspace_isolated_files(self, tmp_path):
+        svc = DevSandboxService(str(tmp_path))
+        sb = svc.create("org1")
+        cmd = sb.run_command("mkdir -p sub && echo data > sub/file.txt")
+        assert _wait(lambda: cmd.status != "running")
+        files = sb.list_files()
+        assert [f["name"] for f in files] == ["sub"]
+        assert sb.read_file("sub/file.txt") == b"data\n"
+        with pytest.raises(PermissionError):
+            sb.read_file("../../etc/passwd")
+        svc.stop_all()
+
+    def test_kill_long_running_command(self, tmp_path):
+        svc = DevSandboxService(str(tmp_path))
+        sb = svc.create("org1")
+        cmd = sb.run_command("sleep 60")
+        assert cmd.status == "running"
+        assert cmd.kill()
+        assert _wait(lambda: cmd.status == "killed")
+        assert not cmd.kill()     # already dead
+        svc.stop_all()
+
+    def test_org_quota(self, tmp_path):
+        svc = DevSandboxService(str(tmp_path), max_per_org=2)
+        svc.create("org1")
+        svc.create("org1")
+        with pytest.raises(RuntimeError):
+            svc.create("org1")
+        svc.create("org2")        # other orgs unaffected
+        svc.stop_all()
+
+    def test_destroy_removes_workspace(self, tmp_path):
+        import os
+
+        svc = DevSandboxService(str(tmp_path))
+        sb = svc.create("org1")
+        ws = sb.workspace
+        assert os.path.isdir(ws)
+        assert svc.destroy(sb.id)
+        assert not os.path.isdir(ws)
+        assert not svc.destroy(sb.id)
+
+    def test_stopped_sandbox_rejects_commands(self, tmp_path):
+        svc = DevSandboxService(str(tmp_path))
+        sb = svc.create("org1")
+        sb.stop()
+        with pytest.raises(RuntimeError):
+            sb.run_command("true")
+
+
+class TestSandboxAuthz:
+    def test_cross_org_user_cannot_touch_sandboxes(self):
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+        cp.auth_required = True
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                owner = cp.auth.create_user("own@s.com")
+                oh = {"Authorization":
+                      f"Bearer {cp.auth.create_api_key(owner.id)}"}
+                outsider = cp.auth.create_user("out@s.com")
+                xh = {"Authorization":
+                      f"Bearer {cp.auth.create_api_key(outsider.id)}"}
+                oid = cp.auth.create_org("sec-org", owner.id)
+
+                r = await client.post(
+                    f"/api/v1/orgs/{oid}/sandboxes", json={}, headers=oh
+                )
+                assert r.status == 201
+                sid = (await r.json())["id"]
+
+                # a non-member cannot list, run commands, read files,
+                # or delete — the cross-org RCE hole
+                for method, path, kw in (
+                    ("get", f"/api/v1/orgs/{oid}/sandboxes", {}),
+                    ("post", f"/api/v1/orgs/{oid}/sandboxes/{sid}"
+                             "/commands", {"json": {"command": "id"}}),
+                    ("get", f"/api/v1/orgs/{oid}/sandboxes/{sid}"
+                            "/files/list", {}),
+                    ("delete", f"/api/v1/orgs/{oid}/sandboxes/{sid}", {}),
+                ):
+                    r = await getattr(client, method)(
+                        path, headers=xh, **kw
+                    )
+                    assert r.status == 403, (method, path, r.status)
+                # org members (non-admin) CAN use the sandbox
+                member = cp.auth.create_user("mem@s.com")
+                cp.auth.add_member(oid, member.id)
+                mh = {"Authorization":
+                      f"Bearer {cp.auth.create_api_key(member.id)}"}
+                r = await client.post(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid}/commands",
+                    json={"command": "true"}, headers=mh,
+                )
+                assert r.status == 201
+            finally:
+                cp.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
+
+
+class TestSandboxHTTP:
+    def test_full_surface(self):
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                u = cp.auth.create_user("sbx@x.com")
+                oid = cp.auth.create_org("sbx-org", u.id)
+                r = await client.post(
+                    f"/api/v1/orgs/{oid}/sandboxes",
+                    json={"name": "workbench", "with_desktop": True},
+                )
+                assert r.status == 201, await r.text()
+                sb = await r.json()
+                sid = sb["id"]
+                assert sb["desktop_id"]
+
+                # commands: run, poll, logs
+                r = await client.post(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid}/commands",
+                    json={"command": "echo from-sandbox"},
+                )
+                cid = (await r.json())["id"]
+                for _ in range(100):
+                    r = await client.get(
+                        f"/api/v1/orgs/{oid}/sandboxes/{sid}"
+                        f"/commands/{cid}"
+                    )
+                    if (await r.json())["status"] != "running":
+                        break
+                    await asyncio.sleep(0.05)
+                assert (await r.json())["exit_code"] == 0
+                r = await client.get(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid}"
+                    f"/commands/{cid}/logs"
+                )
+                assert (await r.json())["lines"] == ["from-sandbox"]
+
+                # files written by the command are browsable
+                r = await client.post(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid}/commands",
+                    json={"command": "echo content > out.txt"},
+                )
+                cid2 = (await r.json())["id"]
+                for _ in range(100):
+                    r = await client.get(
+                        f"/api/v1/orgs/{oid}/sandboxes/{sid}"
+                        f"/commands/{cid2}"
+                    )
+                    if (await r.json())["status"] != "running":
+                        break
+                    await asyncio.sleep(0.05)
+                r = await client.get(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid}/files/list"
+                )
+                names = [f["name"] for f in (await r.json())["files"]]
+                assert "out.txt" in names
+                r = await client.get(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid}/files",
+                    params={"path": "out.txt"},
+                )
+                assert await r.read() == b"content\n"
+
+                # screenshot of the attached GUI desktop
+                r = await client.get(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid}/screenshot"
+                )
+                assert r.status == 200
+                assert (await r.read())[:8] == b"\x89PNG\r\n\x1a\n"
+
+                # sandbox ids are org-scoped: wrong org path -> 404
+                other = cp.auth.create_org(
+                    "other-org", cp.auth.create_user("o2@x.com").id
+                )
+                r = await client.get(
+                    f"/api/v1/orgs/{other}/sandboxes/{sid}"
+                )
+                assert r.status == 404
+
+                r = await client.delete(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid}"
+                )
+                assert (await r.json())["ok"]
+            finally:
+                cp.dev_sandboxes.stop_all()
+                cp.desktops.stop_all()
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )

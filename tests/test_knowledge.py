@@ -140,3 +140,86 @@ class TestKnowledgeManager:
         # empty gather -> ready with nothing, but unreadable url -> error;
         # nonexistent dir yields no docs, which is ready-empty
         assert spec.state in ("ready", "error")
+
+
+class TestVersionsDownloadComplete:
+    """/knowledge/{}/versions|download|complete (reference: knowledge
+    reconciler versions + external extractor push)."""
+
+    def _mgr(self):
+        return KnowledgeManager(VectorStore(), HashEmbedder())
+
+    def test_complete_external_chunks(self):
+        km = self._mgr()
+        km.add(KnowledgeSpec(id="kx", text="placeholder"))
+        spec = km.complete("kx", [
+            {"text": "externally extracted alpha", "meta": {"src": "pdf"}},
+            {"text": "externally extracted beta"},
+        ])
+        assert spec.state == "ready" and spec.version == 1
+        assert spec.progress["source"] == "external"
+        out = km.query("kx", "alpha", top_k=1)
+        assert "alpha" in out[0]["text"]
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            km.complete("kx", [])
+
+    def test_versions_and_dump(self):
+        km = self._mgr()
+        km.add(KnowledgeSpec(id="kv", text="version one text"))
+        km.index("kv")
+        vs = km.store.versions("kv")
+        assert vs == [{"version": 1, "chunks": 1}]
+        km.index("kv")   # re-index bumps version, old rows reaped
+        vs = km.store.versions("kv")
+        assert vs == [{"version": 2, "chunks": 1}]
+        dump = km.store.dump("kv", version=2)
+        assert dump[0]["text"] == "version one text"
+        assert "embedding" not in dump[0]
+
+    def test_http_surface(self):
+        import asyncio
+        import json as _json
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                r = await client.post("/api/v1/knowledge", json={
+                    "name": "ext", "text": "seed",
+                })
+                kid = (await r.json())["id"]
+                r = await client.post(
+                    f"/api/v1/knowledge/{kid}/complete",
+                    json={"chunks": [{"text": "pushed chunk about TPUs"}]},
+                )
+                assert r.status == 200
+                assert (await r.json())["state"] == "ready"
+                r = await client.get(f"/api/v1/knowledge/{kid}/versions")
+                data = await r.json()
+                assert data["versions"][0]["current"]
+                r = await client.get(f"/api/v1/knowledge/{kid}/download")
+                lines = [
+                    _json.loads(ln)
+                    for ln in (await r.text()).splitlines() if ln
+                ]
+                assert any("TPUs" in c["text"] for c in lines)
+                r = await client.post(
+                    "/api/v1/knowledge/nope/complete", json={"chunks": []}
+                )
+                assert r.status == 404
+            finally:
+                cp.orchestrator.stop()
+                cp.knowledge.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
