@@ -608,6 +608,32 @@ def main(argv=None) -> int:
 
     db.set_defaults(fn=_cmd_desktop_bridge)
 
+    ts = sub.add_parser(
+        "tts-server",
+        help="run the TTS sidecar (/v1/audio/speech, Klatt backend)",
+    )
+    ts.add_argument("--port", type=int, default=8444)
+
+    def _cmd_tts(args):
+        import asyncio as _asyncio
+
+        from aiohttp import web as _web
+
+        from helix_tpu.services.tts import TTSService
+
+        async def main():
+            runner = _web.AppRunner(TTSService().build_app())
+            await runner.setup()
+            await _web.TCPSite(runner, "0.0.0.0", args.port).start()
+            print(f"tts-server on :{args.port}")
+            while True:
+                await _asyncio.sleep(3600)
+
+        _asyncio.run(main())
+        return 0
+
+    ts.set_defaults(fn=_cmd_tts)
+
     pr = sub.add_parser("profile", help="validate a profile YAML")
     pr.add_argument("file")
     pr.set_defaults(fn=_cmd_profile)

@@ -615,8 +615,12 @@ class Authenticator:
                              resource_type: str,
                              min_role: str = "read") -> set:
         """All resource ids of ``resource_type`` the user can reach via
-        grants (direct or team), in one query — the batch form of
-        has_access for list filtering."""
+        EXPLICIT grants (direct or team), in one query.
+
+        NOT the batch form of has_access: platform admins get every
+        resource there but only their explicit grants here — callers must
+        keep their own admin/owner check (as list_apps does via
+        authorize) or admins lose visibility."""
         if user is None:
             return set()
         need = GRANT_ROLES.index(min_role)

@@ -100,10 +100,10 @@ def _anthropic_sse_events(doc: dict):
     yield "message_stop", {"type": "message_stop"}
 
 
-def tempfile_dir() -> str:
+def tempfile_dir(prefix: str = "helix-ephemeral-") -> str:
     import tempfile
 
-    return tempfile.mkdtemp(prefix="helix-git-")
+    return tempfile.mkdtemp(prefix=prefix)
 
 
 class ControlPlane:
@@ -1158,7 +1158,32 @@ class ControlPlane:
             "/v1/messages",
         ):
             r.add_post(route, self.dispatch_openai)
+        # speech synthesis on the OpenAI surface (the reference proxies
+        # its tts-server sidecar; ours also runs standalone via
+        # `helix-tpu tts-server`)
+        r.add_post("/v1/audio/speech", self.audio_speech)
         return app
+
+    async def audio_speech(self, request):
+        from helix_tpu.services.tts import TTSService
+
+        if not hasattr(self, "_tts"):
+            self._tts = TTSService()
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        text = body.get("input", "")
+        if not text:
+            return _err(400, "missing input")
+        wav = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: self._tts.speech(
+                text, voice=body.get("voice", "default"),
+                speed=float(body.get("speed", 1.0)),
+            ),
+        )
+        return web.Response(body=wav, content_type="audio/wav")
 
     async def healthz(self, request):
         return web.json_response(
@@ -1756,7 +1781,9 @@ class ControlPlane:
         import asyncio as _asyncio
 
         rid = request.match_info["run_id"]
-        if self.store.get_eval_run(rid) is None:
+        # same app-path scoping as get/delete: a run id from another app
+        # (or a question-set execution) is not reachable through this app
+        if self._app_run_or_none(request) is None:
             return _err(404, "run not found")
         resp = web.StreamResponse(
             headers={"Content-Type": "text/event-stream"}

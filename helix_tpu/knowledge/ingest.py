@@ -256,16 +256,22 @@ class KnowledgeManager:
             dict(c.get("meta") or {})
             for c in chunks if c.get("text")
         ]
-        new_version = spec.version + 1
         embeddings = self.embed(texts)
-        self.store.upsert(
-            kid, texts, embeddings, metas=metas, version=new_version
-        )
-        self.store.delete_versions_below(kid, new_version)
-        spec.version = new_version
-        spec.state = "ready"
-        spec.error = ""
-        spec.progress = {"chunks": len(texts), "source": "external"}
+        with self._lock:
+            # the externally pushed content IS this knowledge's content
+            # now: clear any pending reconcile so the background index()
+            # cannot re-gather the original source at a higher version
+            # and delete_versions_below() the pushed chunks
+            self._dirty.discard(kid)
+            new_version = spec.version + 1
+            self.store.upsert(
+                kid, texts, embeddings, metas=metas, version=new_version
+            )
+            self.store.delete_versions_below(kid, new_version)
+            spec.version = new_version
+            spec.state = "ready"
+            spec.error = ""
+            spec.progress = {"chunks": len(texts), "source": "external"}
         return spec
 
     def query(self, kids, text: str, top_k: int = 5) -> list:

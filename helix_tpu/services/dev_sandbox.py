@@ -221,22 +221,27 @@ class DevSandboxService:
             desktop = self.desktops.create(
                 name=f"sandbox:{name}", kind="gui"
             )
-        with self._lock:
-            n = sum(
-                1 for s in self._sandboxes.values()
-                if s.org_id == org_id and s.status == "running"
-            )
-            if n >= self.max_per_org:
-                if desktop is not None:
-                    self.desktops.destroy(desktop.id)
-                raise RuntimeError(
-                    f"org sandbox quota reached ({self.max_per_org})"
+        try:
+            with self._lock:
+                n = sum(
+                    1 for s in self._sandboxes.values()
+                    if s.org_id == org_id and s.status == "running"
                 )
-            sb = DevSandbox(
-                org_id, name or "sandbox", self.root,
-                desktop_session=desktop, **limits,
-            )
-            self._sandboxes[sb.id] = sb
+                if n >= self.max_per_org:
+                    raise RuntimeError(
+                        f"org sandbox quota reached ({self.max_per_org})"
+                    )
+                sb = DevSandbox(
+                    org_id, name or "sandbox", self.root,
+                    desktop_session=desktop, **limits,
+                )
+                self._sandboxes[sb.id] = sb
+        except BaseException:
+            # quota hit OR constructor failure: the desktop session we
+            # spun up must not outlive this failed create
+            if desktop is not None:
+                self.desktops.destroy(desktop.id)
+            raise
         return sb
 
     def get(self, sid: str) -> Optional[DevSandbox]:

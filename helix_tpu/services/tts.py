@@ -79,9 +79,24 @@ def to_wav_bytes(pcm: np.ndarray, sample_rate: int) -> bytes:
     return buf.getvalue()
 
 
+def klatt_synthesize(
+    text: str, voice: str = "default", speed: float = 1.0,
+    sample_rate: int = 16000,
+) -> tuple:
+    """Default backend: the rule-based Klatt-style pipeline
+    (text normalisation -> letter-to-sound -> prosody -> cascade formant
+    synthesis, :mod:`helix_tpu.services.tts_klatt`)."""
+    from helix_tpu.services.tts_klatt import SR, synthesize
+
+    f0 = {"default": 120.0, "alto": 180.0, "bass": 90.0}.get(voice, 120.0)
+    speed = min(max(speed, 0.25), 4.0)
+    pcm = synthesize(text[:2000], f0_base=f0, speed=speed)
+    return (pcm * 32767).astype(np.int16), SR
+
+
 class TTSService:
     def __init__(self, synthesize=None):
-        self.synthesize = synthesize or formant_synthesize
+        self.synthesize = synthesize or klatt_synthesize
 
     def speech(self, text: str, voice: str = "default",
                speed: float = 1.0) -> bytes:

@@ -1,0 +1,481 @@
+"""Rule-based speech synthesis: text -> phonemes -> Klatt-style formant
+synthesis.
+
+The reference runs a neural TTS sidecar (``tts-server/``).  This image
+has no speech weights and no egress, so the backend is the classic
+knowledge-based pipeline (the DECtalk/MITalk family — Klatt 1980,
+"Software for a cascade/parallel formant synthesizer"; NRL 1976
+letter-to-sound report), implemented from the published principles:
+
+1. text normalisation — numbers to words, abbreviations, punctuation to
+   phrase breaks;
+2. grapheme-to-phoneme — a context-sensitive letter-to-sound rule set
+   (longest-match rules with left/right context classes, NRL-style);
+3. prosody — declining F0 contour per phrase, phrase-final lengthening,
+   pauses at punctuation;
+4. acoustic synthesis — a cascade formant synthesizer: voiced glottal
+   source + noise source through three time-varying second-order
+   resonators, with per-phoneme formant targets (Peterson–Barney /
+   Klatt tables), linear formant transitions for coarticulation, stop
+   closures + bursts, aspiration for voiceless onsets.
+
+Output is intelligible machine speech, not natural speech — the honest
+ceiling of a weightless synthesizer.  The neural seam stays:
+``TTSService(synthesize=...)`` accepts any backend.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+SR = 16000
+FRAME_S = 0.005                # coefficient update interval
+
+
+# ---------------------------------------------------------------------------
+# phoneme inventory: name -> (F1, F2, F3, duration_ms, kind)
+# kind: v=vowel, n=nasal, l=liquid/glide, f=voiceless fricative,
+#       z=voiced fricative, p=voiceless stop, b=voiced stop,
+#       a=affricate(vl), j=affricate(vd), h=aspirate, sil=silence
+# Formant targets from the published Peterson–Barney / Klatt tables.
+# ---------------------------------------------------------------------------
+
+PHONES = {
+    # vowels
+    "AA": (730, 1090, 2440, 160, "v"),   # father
+    "AE": (660, 1720, 2410, 150, "v"),   # cat
+    "AH": (640, 1190, 2390, 110, "v"),   # but
+    "AO": (570, 840, 2410, 160, "v"),    # law
+    "EH": (530, 1840, 2480, 130, "v"),   # bet
+    "ER": (490, 1350, 1690, 150, "v"),   # bird
+    "IH": (390, 1990, 2550, 110, "v"),   # bit
+    "IY": (270, 2290, 3010, 140, "v"),   # beet
+    "OW": (450, 1030, 2380, 160, "v"),   # boat
+    "UH": (440, 1020, 2240, 110, "v"),   # book
+    "UW": (300, 870, 2240, 150, "v"),    # boot
+    "AX": (500, 1500, 2500, 70, "v"),    # about (schwa)
+    # diphthongs synthesized as two targets (see DIPHTHONGS)
+    "AY": (730, 1090, 2440, 200, "v"),
+    "AW": (730, 1090, 2440, 200, "v"),
+    "EY": (530, 1840, 2480, 180, "v"),
+    "OY": (570, 840, 2410, 200, "v"),
+    # nasals
+    "M": (280, 900, 2200, 70, "n"),
+    "N": (280, 1700, 2600, 70, "n"),
+    "NG": (280, 2300, 2750, 90, "n"),
+    # liquids / glides
+    "L": (360, 1100, 2600, 70, "l"),
+    "R": (420, 1300, 1600, 80, "l"),
+    "W": (300, 700, 2200, 70, "l"),
+    "Y": (270, 2200, 3000, 60, "l"),
+    # voiceless fricatives (noise center freq encoded in F2 slot)
+    "S": (200, 6000, 7000, 110, "f"),
+    "SH": (200, 2600, 3500, 120, "f"),
+    "F": (200, 1400, 6000, 100, "f"),
+    "TH": (200, 1600, 6500, 90, "f"),
+    "HH": (500, 1500, 2500, 60, "h"),
+    # voiced fricatives
+    "Z": (250, 6000, 7000, 90, "z"),
+    "ZH": (250, 2600, 3500, 100, "z"),
+    "V": (250, 1400, 6000, 70, "z"),
+    "DH": (250, 1600, 6500, 60, "z"),
+    # stops (F2 = burst center)
+    "P": (200, 800, 2000, 90, "p"),
+    "T": (200, 4500, 5500, 90, "p"),
+    "K": (200, 2200, 3200, 95, "p"),
+    "B": (250, 800, 2000, 70, "b"),
+    "D": (250, 4000, 5000, 70, "b"),
+    "G": (250, 2000, 3000, 75, "b"),
+    # affricates
+    "CH": (200, 2600, 3500, 120, "a"),
+    "JH": (250, 2600, 3500, 100, "j"),
+    # silence / pause
+    "SIL": (0, 0, 0, 120, "sil"),
+    "PAU": (0, 0, 0, 250, "sil"),
+}
+
+DIPHTHONGS = {
+    "AY": ("AA", "IY"), "AW": ("AA", "UW"),
+    "EY": ("EH", "IY"), "OY": ("AO", "IY"),
+}
+
+
+# ---------------------------------------------------------------------------
+# letter-to-sound rules (NRL-style): (left, letters, right, phones)
+# context classes: '#'=one or more vowels, '^'=consonant, '.'=voiced
+# consonant, '$'=zero or more consonants, ' '=word boundary, ''=any.
+# Scanned in order; first match wins; longest letter groups first.
+# ---------------------------------------------------------------------------
+
+VOWELS = set("aeiouy")
+CONSONANTS = set("bcdfghjklmnpqrstvwxz")
+VOICED_C = set("bdvgjlmnrwz")
+
+RULES: List[Tuple[str, str, str, str]] = [
+    # punctuation handled upstream; common whole words first
+    (" ", "the", " ", "DH AX"),
+    (" ", "a", " ", "AX"),
+    (" ", "to", " ", "T UW"),
+    (" ", "of", " ", "AH V"),
+    (" ", "and", " ", "AE N D"),
+    (" ", "is", " ", "IH Z"),
+    (" ", "are", " ", "AA R"),
+    (" ", "was", " ", "W AH Z"),
+    (" ", "you", " ", "Y UW"),
+    (" ", "i", " ", "AY"),
+    (" ", "one", " ", "W AH N"),
+    (" ", "two", " ", "T UW"),
+    (" ", "have", " ", "HH AE V"),
+    (" ", "do", " ", "D UW"),
+    (" ", "does", " ", "D AH Z"),
+    (" ", "done", " ", "D AH N"),
+    # multi-letter graphemes
+    ("", "tion", "", "SH AX N"),
+    ("", "sion", "", "ZH AX N"),
+    ("", "ough", " ", "OW"),
+    ("", "ought", "", "AO T"),
+    ("", "igh", "", "AY"),
+    ("", "eigh", "", "EY"),
+    ("", "tch", "", "CH"),
+    ("", "ch", "", "CH"),
+    ("", "sh", "", "SH"),
+    ("", "ph", "", "F"),
+    ("", "th", "", "TH"),     # (voiced 'th' handled by word rules above)
+    ("", "wh", "", "W"),
+    ("", "gh", "", ""),       # silent (light)
+    ("", "ck", "", "K"),
+    ("", "ng", " ", "NG"),
+    ("", "ng", "", "NG G"),
+    ("", "qu", "", "K W"),
+    ("", "kn", "", "N"),      # knee
+    (" ", "wr", "", "R"),     # write
+    ("", "dge", "", "JH"),
+    # vowel digraphs
+    ("", "ee", "", "IY"),
+    ("", "ea", "", "IY"),
+    ("", "oo", "k", "UH"),
+    ("", "oo", "", "UW"),
+    ("", "ou", "", "AW"),
+    ("", "ow", " ", "OW"),
+    ("", "ow", "", "AW"),
+    ("", "oa", "", "OW"),
+    ("", "oi", "", "OY"),
+    ("", "oy", "", "OY"),
+    ("", "ai", "", "EY"),
+    ("", "ay", "", "EY"),
+    ("", "au", "", "AO"),
+    ("", "aw", "", "AO"),
+    ("", "ei", "", "EY"),
+    ("", "ey", " ", "IY"),
+    ("", "ie", " ", "AY"),
+    ("", "ie", "", "IY"),
+    ("", "ue", "", "UW"),
+    ("", "ui", "", "UW"),
+    # magic-e: vowel ^ e(word end) -> long vowel
+    ("", "a", "^e ", "EY"),
+    ("", "i", "^e ", "AY"),
+    ("", "o", "^e ", "OW"),
+    ("", "u", "^e ", "UW"),
+    ("", "e", "^e ", "IY"),
+    # single vowels
+    ("", "e", " ", ""),        # final silent e
+    ("", "e", "d ", "AX"),     # -ed (approx)
+    ("", "a", "", "AE"),
+    ("", "e", "", "EH"),
+    ("", "i", "", "IH"),
+    ("", "o", " ", "OW"),      # final open o (hello, go)
+    ("", "o", "", "AA"),
+    ("", "u", "", "AH"),
+    ("", "y", " ", "IY"),
+    (" ", "y", "", "Y"),
+    ("", "y", "", "IH"),
+    # consonants with context
+    ("", "c", "e", "S"), ("", "c", "i", "S"), ("", "c", "y", "S"),
+    ("", "c", "", "K"),
+    ("", "g", "e", "JH"), ("", "g", "i", "JH"), ("", "g", "y", "JH"),
+    ("", "g", "", "G"),
+    ("#", "s", " ", "Z"),      # plural after vowel
+    ("", "s", "", "S"),
+    ("", "x", "", "K S"),
+    ("", "j", "", "JH"),
+    ("", "b", "", "B"), ("", "d", "", "D"), ("", "f", "", "F"),
+    ("", "h", "", "HH"), ("", "k", "", "K"), ("", "l", "", "L"),
+    ("", "m", "", "M"), ("", "n", "", "N"), ("", "p", "", "P"),
+    ("", "r", "", "R"), ("", "t", "", "T"), ("", "v", "", "V"),
+    ("", "w", "", "W"), ("", "z", "", "Z"),
+]
+
+_ONES = ["zero", "one", "two", "three", "four", "five", "six", "seven",
+         "eight", "nine", "ten", "eleven", "twelve", "thirteen",
+         "fourteen", "fifteen", "sixteen", "seventeen", "eighteen",
+         "nineteen"]
+_TENS = ["", "", "twenty", "thirty", "forty", "fifty", "sixty",
+         "seventy", "eighty", "ninety"]
+
+
+def number_to_words(n: int) -> str:
+    if n < 0:
+        return "minus " + number_to_words(-n)
+    if n < 20:
+        return _ONES[n]
+    if n < 100:
+        t, r = divmod(n, 10)
+        return _TENS[t] + (" " + _ONES[r] if r else "")
+    if n < 1000:
+        h, r = divmod(n, 100)
+        return (_ONES[h] + " hundred"
+                + (" " + number_to_words(r) if r else ""))
+    if n < 1_000_000:
+        k, r = divmod(n, 1000)
+        return (number_to_words(k) + " thousand"
+                + (" " + number_to_words(r) if r else ""))
+    m, r = divmod(n, 1_000_000)
+    return (number_to_words(m) + " million"
+            + (" " + number_to_words(r) if r else ""))
+
+
+_ABBREV = {
+    "dr": "doctor", "mr": "mister", "mrs": "missus", "st": "street",
+    "etc": "etcetera", "vs": "versus", "e.g": "for example",
+    "i.e": "that is",
+}
+
+
+def normalize(text: str) -> str:
+    """Numbers to words, abbreviations expanded, case folded."""
+    text = text.lower()
+    text = re.sub(
+        r"\d+", lambda m: " " + number_to_words(int(m.group())) + " ", text
+    )
+    words = []
+    for w in re.split(r"(\s+)", text):
+        words.append(_ABBREV.get(w.strip("."), w))
+    return "".join(words)
+
+
+def _ctx_match(ctx: str, s: str, pos: int, left: bool) -> bool:
+    """Match one context pattern against the string at pos."""
+    if not ctx:
+        return True
+    step = -1 if left else 1
+    i = pos
+    for c in (reversed(ctx) if left else ctx):
+        ch = s[i] if 0 <= i < len(s) else " "
+        if c == "#":
+            if ch not in VOWELS:
+                return False
+        elif c == "^":
+            if ch not in CONSONANTS:
+                return False
+        elif c == ".":
+            if ch not in VOICED_C:
+                return False
+        elif c == " ":
+            if ch.isalpha():
+                return False
+        else:
+            if ch != c:
+                return False
+        i += step
+    return True
+
+
+def to_phonemes(text: str) -> List[str]:
+    """Letter-to-sound: normalised text -> phoneme list with PAU breaks."""
+    text = normalize(text)
+    out: List[str] = []
+    for sentence in re.split(r"[.!?;:]+", text):
+        sentence = sentence.strip()
+        if not sentence:
+            continue
+        for clause in sentence.split(","):
+            clause = " " + re.sub(r"[^a-z.' ]", " ", clause).strip() + " "
+            i = 1
+            while i < len(clause) - 0:
+                if clause[i] == " " or clause[i] in ".'":
+                    i += 1
+                    continue
+                matched = False
+                for left, letters, right, phones in RULES:
+                    n = len(letters)
+                    if clause[i:i + n] != letters:
+                        continue
+                    if not _ctx_match(left, clause, i - 1, left=True):
+                        continue
+                    if not _ctx_match(right, clause, i + n, left=False):
+                        continue
+                    if phones:
+                        out.extend(phones.split())
+                    i += n
+                    matched = True
+                    break
+                if not matched:
+                    i += 1
+            out.append("SIL")
+        if out and out[-1] == "SIL":
+            out[-1] = "PAU"
+    # collapse doubled consonants (hello -> one L): adjacent identical
+    # non-vowel phones are one articulation
+    collapsed: List[str] = []
+    for ph in out:
+        if (
+            collapsed
+            and ph == collapsed[-1]
+            and PHONES.get(ph, (0, 0, 0, 0, "v"))[4] not in ("v", "sil")
+        ):
+            continue
+        collapsed.append(ph)
+    return collapsed
+
+
+# ---------------------------------------------------------------------------
+# cascade formant synthesizer
+# ---------------------------------------------------------------------------
+
+
+def _resonator_coeffs(f: float, bw: float):
+    """Klatt second-order resonator: y = A x + B y1 + C y2."""
+    c = -np.exp(-2 * np.pi * bw / SR)
+    b = 2 * np.exp(-np.pi * bw / SR) * np.cos(2 * np.pi * f / SR)
+    a = 1 - b - c
+    return a, b, c
+
+
+class _Resonator:
+    def __init__(self):
+        self.y1 = 0.0
+        self.y2 = 0.0
+
+    def run(self, x: np.ndarray, f: float, bw: float) -> np.ndarray:
+        a, b, c = _resonator_coeffs(max(f, 1.0), bw)
+        y = np.empty_like(x)
+        y1, y2 = self.y1, self.y2
+        for i in range(len(x)):
+            v = a * x[i] + b * y1 + c * y2
+            y[i] = v
+            y2 = y1
+            y1 = v
+        self.y1, self.y2 = y1, y2
+        return y
+
+
+def _glottal_source(n: int, f0: np.ndarray) -> np.ndarray:
+    """Impulse train at f0[n] (per-sample), shaped by a one-pole lowpass
+    (approximate glottal pulse spectrum, -12 dB/oct)."""
+    phase = np.cumsum(f0 / SR)
+    pulses = np.diff(np.floor(phase), prepend=0.0) > 0
+    src = pulses.astype(np.float64)
+    # -12dB/oct shaping
+    y = np.empty(n)
+    acc = 0.0
+    for i in range(n):
+        acc = 0.9 * acc + src[i]
+        y[i] = acc
+    return y - y.mean()
+
+
+def _expand_targets(phonemes: List[str]):
+    """Per-FRAME formant/amplitude targets with linear transitions."""
+    segs = []
+    for ph in phonemes:
+        if ph in DIPHTHONGS:
+            a, b = DIPHTHONGS[ph]
+            fa, fb = PHONES[a], PHONES[b]
+            d = PHONES[ph][3]
+            segs.append((fa[0], fa[1], fa[2], d * 0.55, "v"))
+            segs.append((fb[0], fb[1], fb[2], d * 0.45, "v"))
+        else:
+            f1, f2, f3, d, kind = PHONES[ph]
+            segs.append((f1, f2, f3, d, kind))
+    return segs
+
+
+def synthesize(text: str, f0_base: float = 120.0,
+               speed: float = 1.0) -> np.ndarray:
+    """text -> float32 PCM in [-1, 1] at 16 kHz."""
+    phonemes = to_phonemes(text)
+    if not phonemes:
+        return np.zeros(int(0.1 * SR), np.float32)
+    segs = _expand_targets(phonemes)
+
+    # per-frame parameter tracks
+    frames = []           # (f1, f2, f3, voiced_amp, noise_amp, noise_cf)
+    n_total = len(segs)
+    for si, (f1, f2, f3, dur_ms, kind) in enumerate(segs):
+        # phrase-final lengthening
+        if si >= n_total - 2:
+            dur_ms *= 1.3
+        nfr = max(int(dur_ms / 1000.0 / speed / FRAME_S), 1)
+        if kind == "sil":
+            frames += [(500, 1500, 2500, 0.0, 0.0, 0)] * nfr
+        elif kind == "v":
+            frames += [(f1, f2, f3, 1.0, 0.0, 0)] * nfr
+        elif kind in ("n", "l"):
+            frames += [(f1, f2, f3, 0.6, 0.0, 0)] * nfr
+        elif kind == "f":          # voiceless fricative: noise only
+            frames += [(f1, f2, f3, 0.0, 0.8, f2)] * nfr
+        elif kind == "z":          # voiced fricative: mixed
+            frames += [(f1, f2, f3, 0.4, 0.5, f2)] * nfr
+        elif kind == "h":
+            frames += [(f1, f2, f3, 0.0, 0.4, 1500)] * nfr
+        elif kind in ("p", "b", "a", "j"):
+            # closure + burst (+ aspiration when voiceless)
+            closure = max(int(0.045 / FRAME_S), 1)
+            burst = max(int(0.018 / FRAME_S), 1)
+            voiced_leak = 0.15 if kind in ("b", "j") else 0.0
+            frames += [(f1, f2, f3, voiced_leak, 0.0, 0)] * closure
+            frames += [(f1, f2, f3, 0.0, 1.0, f2)] * burst
+            if kind in ("a", "j"):   # affricate: frication tail
+                frames += [(f1, f2, f3, 0.0, 0.7, f2)] * (burst * 2)
+            elif kind == "p":        # aspiration
+                frames += [(f1, f2, f3, 0.0, 0.3, 1500)] * burst
+
+    nfr = len(frames)
+    arr = np.array(frames, np.float64)
+    # formant smoothing for coarticulation (3-frame boxcar twice ~ 30ms)
+    for col in range(3):
+        track = arr[:, col]
+        for _ in range(2):
+            track = np.convolve(
+                track, np.ones(5) / 5.0, mode="same"
+            )
+        arr[:, col] = track
+
+    n = nfr * int(FRAME_S * SR)
+    spf = int(FRAME_S * SR)
+
+    # F0 contour: declination across the whole utterance + slight fall
+    # within the final phrase
+    f0 = np.linspace(f0_base * 1.15, f0_base * 0.85, n)
+    voiced_amp = np.repeat(arr[:, 3], spf)[:n]
+    noise_amp = np.repeat(arr[:, 4], spf)[:n]
+
+    voiced = _glottal_source(n, f0) * voiced_amp
+    rng = np.random.default_rng(0)
+    noise = rng.standard_normal(n) * 0.3
+
+    r1, r2, r3 = _Resonator(), _Resonator(), _Resonator()
+    rn = _Resonator()
+    out = np.zeros(n)
+    for fi in range(nfr):
+        s, e = fi * spf, (fi + 1) * spf
+        f1v, f2v, f3v = arr[fi, 0], arr[fi, 1], arr[fi, 2]
+        chunk = voiced[s:e]
+        # cascade through three formants
+        y = r1.run(chunk, f1v, 60)
+        y = r2.run(y, min(f2v, SR / 2 - 500), 90)
+        y = r3.run(y, min(f3v, SR / 2 - 200), 150)
+        out[s:e] += y
+        na = noise_amp[s:e]
+        if na.max() > 0:
+            cf = arr[fi, 5] if arr[fi, 5] > 0 else f2v
+            nz = rn.run(noise[s:e], min(cf, SR / 2 - 500), 600)
+            out[s:e] += nz * na
+
+    peak = np.abs(out).max()
+    if peak > 0:
+        out = out / peak * 0.85
+    return out.astype(np.float32)
