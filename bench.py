@@ -120,7 +120,7 @@ def main():
 
     if on_tpu:
         cfg = LLAMA3_8B
-        batch = 32
+        batch = int(os.environ.get("HELIX_BENCH_BATCH", "32"))
         prompt_len = 128
         gen_len = 128
         num_pages = 2048          # 16 tokens/page -> 32k cached tokens

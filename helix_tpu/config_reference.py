@@ -35,6 +35,12 @@ ENV_REFERENCE: tuple = (
     ),
     # -- accelerator -----------------------------------------------------
     EnvVar(
+        "HELIX_BENCH_BATCH",
+        "Decode batch size for bench.py's TPU measurement (default 32; "
+        "the KV pool is provisioned for 64 at 256 tokens/request).",
+        section="accelerator",
+    ),
+    EnvVar(
         "HELIX_EXACT_SAMPLING",
         "Set to 1 to force the exact full-vocab top-p sampling path for "
         "every request (default: auto — the 64-candidate MXU fast path "

@@ -1049,6 +1049,22 @@ class ControlPlane:
         # observability + model metadata
         r.add_get("/api/v1/llm_calls", self.list_llm_calls)
         r.add_get("/api/v1/model-info", self.model_info)
+        r.add_get("/api/v1/helix-models", self.helix_models)
+        # service connections (stored forge/service credentials)
+        r.add_get(
+            "/api/v1/service-connections", self.service_connections_list
+        )
+        r.add_post(
+            "/api/v1/service-connections", self.service_connections_create
+        )
+        r.add_delete(
+            "/api/v1/service-connections/{id}",
+            self.service_connections_delete,
+        )
+        r.add_get(
+            "/api/v1/git-provider-connections/{id}/repositories",
+            self.service_connection_repos,
+        )
         # manual trigger execution (reference /triggers/{}/execute)
         r.add_post(
             "/api/v1/triggers/{id}/execute", self.trigger_execute
@@ -1165,25 +1181,12 @@ class ControlPlane:
         return app
 
     async def audio_speech(self, request):
+        # one shared handler with the sidecar (validation + dispatch)
         from helix_tpu.services.tts import TTSService
 
         if not hasattr(self, "_tts"):
             self._tts = TTSService()
-        try:
-            body = await request.json()
-        except Exception:
-            return _err(400, "invalid JSON body")
-        text = body.get("input", "")
-        if not text:
-            return _err(400, "missing input")
-        wav = await asyncio.get_running_loop().run_in_executor(
-            None,
-            lambda: self._tts.speech(
-                text, voice=body.get("voice", "default"),
-                speed=float(body.get("speed", 1.0)),
-            ),
-        )
-        return web.Response(body=wav, content_type="audio/wav")
+        return await self._tts.handle_speech(request)
 
     async def healthz(self, request):
         return web.json_response(
@@ -3824,6 +3827,118 @@ class ControlPlane:
                 "id": name, "runners": [], "source": "provider",
             })
         return web.json_response({"models": info})
+
+    # -- service connections ---------------------------------------------------
+    def _svc_conn(self):
+        if not hasattr(self, "_service_connections"):
+            from helix_tpu.services.service_connections import (
+                ServiceConnections,
+            )
+
+            self._service_connections = ServiceConnections(self.auth)
+        return self._service_connections
+
+    async def service_connections_list(self, request):
+        owner = self._user_id(request)
+        user = request.get("user")
+        if user is not None and user.admin and request.query.get("all"):
+            owner = None
+        return web.json_response(
+            {"connections": self._svc_conn().list(owner)}
+        )
+
+    async def service_connections_create(self, request):
+        body = await request.json()
+        try:
+            conn = self._svc_conn().create(
+                owner=self._user_id(request),
+                provider=body.get("provider", ""),
+                token=body.get("token", ""),
+                name=body.get("name", ""),
+                base_url=body.get("base_url", ""),
+                api_base=body.get("api_base", ""),
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(conn, status=201)
+
+    def _owned_connection(self, request):
+        conn = self._svc_conn().get(request.match_info["id"])
+        if conn is None:
+            return None, _err(404, "connection not found")
+        user = request.get("user")
+        if self.auth_required and not self.auth.authorize(
+            user, resource_owner=conn["owner"]
+        ):
+            return None, _err(403, "not your connection")
+        return conn, None
+
+    async def service_connections_delete(self, request):
+        conn, err = self._owned_connection(request)
+        if err is not None:
+            return err
+        return web.json_response(
+            {"ok": self._svc_conn().delete(conn["id"])}
+        )
+
+    async def service_connection_repos(self, request):
+        conn, err = self._owned_connection(request)
+        if err is not None:
+            return err
+        try:
+            repos = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self._svc_conn().repositories(conn["id"])
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        except Exception as e:  # noqa: BLE001 — forge API errors
+            return _err(502, str(e)[:300])
+        return web.json_response({"repositories": repos})
+
+    async def helix_models(self, request):
+        """The curated model catalogue (reference /api/v1/helix-models):
+        architectures this framework serves natively, with sizing facts a
+        deployment planner needs (params, HBM at bf16/int8, context)."""
+        from helix_tpu.models.common import CATALOG
+
+        def params_of(m) -> int:
+            # embedding + L x (attn + mlp) + head, tied norms negligible
+            attn = m.hidden_size * m.head_dim * (
+                m.num_heads + 2 * m.num_kv_heads
+            ) + m.num_heads * m.head_dim * m.hidden_size
+            mlp = 3 * m.hidden_size * m.intermediate_size
+            return (
+                m.vocab_size * m.hidden_size * 2
+                + m.num_layers * (attn + mlp)
+            )
+
+        out = []
+        for name, m in sorted(CATALOG.items()):
+            p = params_of(m)
+            out.append({
+                "id": name,
+                "family": name.split("/")[-1].split("-")[0].lower(),
+                "parameters": p,
+                "context_length": m.max_position_embeddings,
+                "hbm_bytes_bf16": p * 2,
+                "hbm_bytes_int8": p,
+                "num_layers": m.num_layers,
+                "hidden_size": m.hidden_size,
+                "kv_heads": m.num_kv_heads,
+                "kinds": ["chat", "completions"],
+            })
+        out.append({
+            "id": "qwen2-vl", "family": "qwen2-vl",
+            "kinds": ["chat", "vision"],
+            "notes": "vision-language serving (models/qwen2_vl.py)",
+        })
+        out.append({
+            "id": "vision-embedding", "family": "embedding",
+            "kinds": ["embeddings", "vision-embeddings"],
+            "notes": "mixed text+image /v1/embeddings "
+                     "(models/vision_embed.py)",
+        })
+        return web.json_response({"models": out})
 
     async def list_llm_calls(self, request):
         limit, err = self._parse_limit(request, default=100, cap=1000)

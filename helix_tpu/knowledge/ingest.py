@@ -78,6 +78,11 @@ class KnowledgeManager:
         self._specs: dict[str, KnowledgeSpec] = {}
         self._dirty: set = set()
         self._lock = threading.Lock()
+        # per-knowledge mutation locks: index() and complete() hold the
+        # kid's lock for their WHOLE read-version/gather/upsert/reap
+        # span, so an in-flight background index can never interleave
+        # with an external push and delete its chunks
+        self._kid_locks: dict = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -206,8 +211,16 @@ class KnowledgeManager:
             )
         return docs
 
+    def _kid_lock(self, kid: str) -> threading.Lock:
+        with self._lock:
+            return self._kid_locks.setdefault(kid, threading.Lock())
+
     def index(self, kid: str) -> KnowledgeSpec:
         """Synchronous (re-)index of one knowledge."""
+        with self._kid_lock(kid):
+            return self._index_locked(kid)
+
+    def _index_locked(self, kid: str) -> KnowledgeSpec:
         spec = self._specs[kid]
         spec.state = "indexing"
         spec.error = ""
@@ -257,12 +270,13 @@ class KnowledgeManager:
             for c in chunks if c.get("text")
         ]
         embeddings = self.embed(texts)
+        # clear any pending reconcile (a scheduled re-gather of the
+        # original source must not supersede the push), then commit under
+        # the per-kid lock — an ALREADY-RUNNING index() holds that lock,
+        # so the push lands strictly after it at a higher version
         with self._lock:
-            # the externally pushed content IS this knowledge's content
-            # now: clear any pending reconcile so the background index()
-            # cannot re-gather the original source at a higher version
-            # and delete_versions_below() the pushed chunks
             self._dirty.discard(kid)
+        with self._kid_lock(kid):
             new_version = spec.version + 1
             self.store.upsert(
                 kid, texts, embeddings, metas=metas, version=new_version

@@ -1,0 +1,224 @@
+"""Service connections: stored credentials for external services.
+
+The reference keeps token-based connections to external forges and
+services per user/org (``/api/v1/service-connections`` +
+``/api/v1/git-provider-connections/{}/repositories`` in
+``api/pkg/server/server.go``) — the credential store behind forge sync,
+repo import, and provider-backed skills.
+
+Tokens are envelope-encrypted with the control plane's master key (the
+same posture as user secrets — a leaked DB row is ciphertext), never
+returned by list/get APIs, and resolved in-process by consumers
+(``GitHubSync`` takes its token from here instead of the environment).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import List, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS service_connections (
+  id TEXT PRIMARY KEY,
+  owner TEXT NOT NULL,
+  provider TEXT NOT NULL,           -- github | gitlab | generic
+  name TEXT NOT NULL,
+  base_url TEXT NOT NULL DEFAULT '',
+  api_base TEXT NOT NULL DEFAULT '',
+  token_ciphertext BLOB NOT NULL,
+  created_at REAL NOT NULL
+);
+"""
+
+PROVIDERS = ("github", "gitlab", "generic")
+
+_DEFAULT_API = {
+    "github": "https://api.github.com",
+    "gitlab": "https://gitlab.com/api/v4",
+}
+
+
+class ServiceConnections:
+    def __init__(self, auth, http=None):
+        """auth: the Authenticator (shared DB + envelope crypto);
+        http: injectable requests-like session for forge API calls."""
+        self.auth = auth
+        self._db = auth._db
+        self._conn = auth._conn
+        self._lock = auth._lock
+        self._db.migrate("service_connections", [(1, "initial", _SCHEMA)])
+        if http is None:
+            import requests
+
+            http = requests.Session()
+        self._http = http
+
+    @staticmethod
+    def _check_url(url: str) -> None:
+        """SSRF guard: a user-supplied api_base/base_url must not point
+        the control plane's outbound requests at internal services or
+        cloud metadata (same posture as the crawler's default_fetch)."""
+        if not url:
+            return
+        import os
+        import urllib.parse
+
+        from helix_tpu.knowledge.crawler import _host_is_private
+
+        p = urllib.parse.urlsplit(url)
+        if p.scheme not in ("http", "https"):
+            raise ValueError(f"unsupported scheme in {url!r}")
+        if (
+            os.environ.get("HELIX_CRAWLER_ALLOW_PRIVATE") != "1"
+            and _host_is_private(p.hostname or "")
+        ):
+            raise ValueError(
+                f"refusing private address {url!r} "
+                "(HELIX_CRAWLER_ALLOW_PRIVATE=1 to allow intranet forges)"
+            )
+
+    # -- CRUD ----------------------------------------------------------------
+    def create(self, owner: str, provider: str, token: str,
+               name: str = "", base_url: str = "",
+               api_base: str = "") -> dict:
+        if provider not in PROVIDERS:
+            raise ValueError(f"provider must be one of {PROVIDERS}")
+        if not token:
+            raise ValueError("token is required")
+        self._check_url(base_url)
+        self._check_url(api_base)
+        cid = f"svc_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO service_connections(id, owner, provider,"
+                " name, base_url, api_base, token_ciphertext, created_at)"
+                " VALUES(?,?,?,?,?,?,?,?)",
+                (
+                    cid, owner, provider, name or provider,
+                    base_url,
+                    api_base or _DEFAULT_API.get(provider, ""),
+                    self.auth.encrypt(token.encode()),
+                    time.time(),
+                ),
+            )
+            self._db.commit()
+        return self.get(cid)
+
+    def get(self, cid: str) -> Optional[dict]:
+        row = self._row(cid)
+        if row is None:
+            return None
+        return self._to_dict(row)
+
+    def _row(self, cid: str):
+        with self._lock:
+            return self._conn.execute(
+                "SELECT id, owner, provider, name, base_url, api_base,"
+                " token_ciphertext, created_at FROM service_connections"
+                " WHERE id=?",
+                (cid,),
+            ).fetchone()
+
+    @staticmethod
+    def _to_dict(row) -> dict:
+        # token NEVER leaves the store through the API shape
+        return {
+            "id": row[0], "owner": row[1], "provider": row[2],
+            "name": row[3], "base_url": row[4], "api_base": row[5],
+            "created_at": row[7],
+        }
+
+    def list(self, owner: Optional[str] = None) -> List[dict]:
+        q = ("SELECT id, owner, provider, name, base_url, api_base,"
+             " token_ciphertext, created_at FROM service_connections")
+        args: tuple = ()
+        if owner:
+            q += " WHERE owner=?"
+            args = (owner,)
+        q += " ORDER BY created_at"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [self._to_dict(r) for r in rows]
+
+    def delete(self, cid: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM service_connections WHERE id=?", (cid,)
+            )
+            self._db.commit()
+        return cur.rowcount > 0
+
+    # -- consumers -----------------------------------------------------------
+    def token(self, cid: str) -> Optional[str]:
+        """Decrypted token for IN-PROCESS consumers (forge sync, skills)."""
+        row = self._row(cid)
+        if row is None:
+            return None
+        return self.auth.decrypt(row[6]).decode()
+
+    def repositories(self, cid: str, per_page: int = 50) -> List[dict]:
+        """List repositories visible to the connection (the
+        /git-provider-connections/{}/repositories surface)."""
+        row = self._row(cid)
+        if row is None:
+            raise KeyError(cid)
+        provider, api_base = row[2], row[5]
+        # re-check at use time: the env may have changed, and rows could
+        # predate the guard
+        self._check_url(api_base)
+        tok = self.auth.decrypt(row[6]).decode()
+        if provider == "github":
+            r = self._http.get(
+                f"{api_base}/user/repos",
+                params={"per_page": per_page, "sort": "pushed"},
+                headers={"Authorization": f"Bearer {tok}"},
+                timeout=20,
+            )
+            r.raise_for_status()
+            return [
+                {
+                    "full_name": x.get("full_name", ""),
+                    "clone_url": x.get("clone_url", ""),
+                    "default_branch": x.get("default_branch", "main"),
+                    "private": bool(x.get("private")),
+                }
+                for x in r.json()
+            ]
+        if provider == "gitlab":
+            r = self._http.get(
+                f"{api_base}/projects",
+                params={"membership": "true", "per_page": per_page},
+                headers={"PRIVATE-TOKEN": tok},
+                timeout=20,
+            )
+            r.raise_for_status()
+            return [
+                {
+                    "full_name": x.get("path_with_namespace", ""),
+                    "clone_url": x.get("http_url_to_repo", ""),
+                    "default_branch": x.get("default_branch", "main"),
+                    "private": x.get("visibility") != "public",
+                }
+                for x in r.json()
+            ]
+        raise ValueError(
+            f"repository listing not supported for {provider!r}"
+        )
+
+    def github_sync(self, cid: str, git, repos: Optional[dict] = None):
+        """A GitHubSync wired with this connection's token + api_base
+        (the forge bridge resolves credentials from here, not the
+        environment)."""
+        from helix_tpu.services.github_sync import GitHubSync
+
+        row = self._row(cid)
+        if row is None:
+            raise KeyError(cid)
+        return GitHubSync(
+            git,
+            api_base=row[5] or "https://api.github.com",
+            token=self.auth.decrypt(row[6]).decode(),
+            repos=repos,
+        )

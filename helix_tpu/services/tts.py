@@ -103,34 +103,51 @@ class TTSService:
         pcm, sr = self.synthesize(text, voice=voice, speed=speed)
         return to_wav_bytes(np.asarray(pcm, np.int16), sr)
 
-    def build_app(self):
+    async def handle_speech(self, request):
+        """Shared /v1/audio/speech handler (mounted by the sidecar app
+        AND the control plane — one copy of validation + dispatch)."""
+        import asyncio as _asyncio
+
         from aiohttp import web
 
-        async def speech(request):
-            try:
-                body = await request.json()
-            except Exception:
-                return web.json_response(
-                    {"error": {"message": "invalid JSON"}}, status=400
-                )
-            text = body.get("input", "")
-            if not text:
-                return web.json_response(
-                    {"error": {"message": "'input' required"}}, status=400
-                )
-            import asyncio
-
-            wav = await asyncio.get_running_loop().run_in_executor(
-                None, self.speech, text,
-                body.get("voice", "default"),
-                float(body.get("speed", 1.0)),
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"error": {"message": "invalid JSON body"}}, status=400
             )
-            return web.Response(body=wav, content_type="audio/wav")
+        text = body.get("input", "")
+        if not text:
+            return web.json_response(
+                {"error": {"message": "missing input"}}, status=400
+            )
+        try:
+            speed = float(body.get("speed", 1.0))
+        except (TypeError, ValueError):
+            return web.json_response(
+                {"error": {"message": "speed must be a number"}},
+                status=400,
+            )
+        if not (0.1 <= speed <= 10.0):   # also rejects NaN
+            return web.json_response(
+                {"error": {"message": "speed out of range (0.1-10)"}},
+                status=400,
+            )
+        wav = await _asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: self.speech(
+                text, voice=body.get("voice", "default"), speed=speed
+            ),
+        )
+        return web.Response(body=wav, content_type="audio/wav")
+
+    def build_app(self):
+        from aiohttp import web
 
         async def healthz(request):
             return web.json_response({"status": "ok"})
 
         app = web.Application()
-        app.router.add_post("/v1/audio/speech", speech)
+        app.router.add_post("/v1/audio/speech", self.handle_speech)
         app.router.add_get("/healthz", healthz)
         return app

@@ -345,20 +345,19 @@ def _resonator_coeffs(f: float, bw: float):
 
 
 class _Resonator:
+    """Stateful biquad run through scipy.signal.lfilter (vectorised —
+    a pure-Python per-sample loop holds the GIL for ~10M iterations on
+    long inputs and starves the serving event loop)."""
+
     def __init__(self):
-        self.y1 = 0.0
-        self.y2 = 0.0
+        self._zi = np.zeros(2)
 
     def run(self, x: np.ndarray, f: float, bw: float) -> np.ndarray:
+        from scipy.signal import lfilter
+
         a, b, c = _resonator_coeffs(max(f, 1.0), bw)
-        y = np.empty_like(x)
-        y1, y2 = self.y1, self.y2
-        for i in range(len(x)):
-            v = a * x[i] + b * y1 + c * y2
-            y[i] = v
-            y2 = y1
-            y1 = v
-        self.y1, self.y2 = y1, y2
+        # y[n] = a x[n] + b y[n-1] + c y[n-2]
+        y, self._zi = lfilter([a], [1.0, -b, -c], x, zi=self._zi)
         return y
 
 
@@ -368,12 +367,10 @@ def _glottal_source(n: int, f0: np.ndarray) -> np.ndarray:
     phase = np.cumsum(f0 / SR)
     pulses = np.diff(np.floor(phase), prepend=0.0) > 0
     src = pulses.astype(np.float64)
-    # -12dB/oct shaping
-    y = np.empty(n)
-    acc = 0.0
-    for i in range(n):
-        acc = 0.9 * acc + src[i]
-        y[i] = acc
+    # -12dB/oct shaping: one-pole lowpass, vectorised
+    from scipy.signal import lfilter
+
+    y = lfilter([1.0], [1.0, -0.9], src)
     return y - y.mean()
 
 
@@ -409,6 +406,7 @@ def synthesize(text: str, f0_base: float = 120.0,
         if si >= n_total - 2:
             dur_ms *= 1.3
         nfr = max(int(dur_ms / 1000.0 / speed / FRAME_S), 1)
+        nfr = min(nfr, 400)   # bound any single segment at 2 s
         if kind == "sil":
             frames += [(500, 1500, 2500, 0.0, 0.0, 0)] * nfr
         elif kind == "v":

@@ -1,0 +1,156 @@
+"""Service connections (stored forge credentials, envelope-encrypted) +
+the helix-models catalogue (``/api/v1/service-connections``,
+``/git-provider-connections/{}/repositories``, ``/helix-models``)."""
+
+import asyncio
+import json
+
+import pytest
+
+from helix_tpu.control.auth import Authenticator
+from helix_tpu.services.service_connections import ServiceConnections
+
+
+class FakeHTTP:
+    """requests-like session returning canned forge responses."""
+
+    def __init__(self):
+        self.calls = []
+
+    def get(self, url, params=None, headers=None, timeout=None):
+        self.calls.append((url, params, headers))
+
+        class R:
+            status_code = 200
+
+            def raise_for_status(self):
+                pass
+
+            def json(self_inner):
+                if "api.github.test" in url:
+                    return [{
+                        "full_name": "acme/webapp",
+                        "clone_url": "https://github.test/acme/webapp.git",
+                        "default_branch": "main", "private": True,
+                    }]
+                return [{
+                    "path_with_namespace": "acme/lib",
+                    "http_url_to_repo": "https://gitlab.test/acme/lib.git",
+                    "default_branch": "master", "visibility": "private",
+                }]
+
+        return R()
+
+
+class TestServiceConnections:
+    def _svc(self):
+        a = Authenticator()
+        return a, ServiceConnections(a, http=FakeHTTP())
+
+    def test_token_encrypted_and_never_in_api_shape(self):
+        a, svc = self._svc()
+        conn = svc.create("u1", "github", token="ghp_secret123")
+        assert "token" not in conn and "token_ciphertext" not in conn
+        # at rest: ciphertext, not the token
+        row = a._conn.execute(
+            "SELECT token_ciphertext FROM service_connections"
+        ).fetchone()
+        assert b"ghp_secret123" not in row[0]
+        # in-process consumers can resolve it
+        assert svc.token(conn["id"]) == "ghp_secret123"
+
+    def test_validation(self):
+        _, svc = self._svc()
+        with pytest.raises(ValueError):
+            svc.create("u1", "bitkeeper", token="t")
+        with pytest.raises(ValueError):
+            svc.create("u1", "github", token="")
+
+    def test_ssrf_guard_on_api_base(self):
+        """A user-supplied api_base must not let the control plane probe
+        internal services (cloud metadata, loopback)."""
+        _, svc = self._svc()
+        for bad in (
+            "http://169.254.169.254/latest",
+            "http://127.0.0.1:8080",
+            "http://localhost/admin",
+            "file:///etc/passwd",
+        ):
+            with pytest.raises(ValueError):
+                svc.create("u1", "github", token="t", api_base=bad)
+
+    def test_repo_listing_github_and_gitlab(self, monkeypatch):
+        # .test hostnames don't resolve; the SSRF guard fails closed on
+        # them, so explicitly allow for this fixture
+        monkeypatch.setenv("HELIX_CRAWLER_ALLOW_PRIVATE", "1")
+        _, svc = self._svc()
+        gh = svc.create("u1", "github", token="t1",
+                        api_base="https://api.github.test")
+        gl = svc.create("u1", "gitlab", token="t2",
+                        api_base="https://gitlab.test/api/v4")
+        repos = svc.repositories(gh["id"])
+        assert repos[0]["full_name"] == "acme/webapp"
+        repos = svc.repositories(gl["id"])
+        assert repos[0]["full_name"] == "acme/lib"
+        assert repos[0]["default_branch"] == "master"
+        # auth header style differs per forge
+        gh_call = svc._http.calls[0]
+        assert gh_call[2]["Authorization"] == "Bearer t1"
+        gl_call = svc._http.calls[1]
+        assert gl_call[2]["PRIVATE-TOKEN"] == "t2"
+
+    def test_list_delete_scoped_by_owner(self):
+        _, svc = self._svc()
+        c1 = svc.create("alice", "github", token="t")
+        svc.create("bob", "github", token="t")
+        assert [c["id"] for c in svc.list("alice")] == [c1["id"]]
+        assert len(svc.list()) == 2
+        assert svc.delete(c1["id"])
+        assert svc.list("alice") == []
+
+
+class TestHTTPSurface:
+    def test_connections_and_catalog(self):
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                r = await client.post(
+                    "/api/v1/service-connections",
+                    json={"provider": "github", "token": "ghp_x",
+                          "name": "work"},
+                )
+                assert r.status == 201
+                conn = await r.json()
+                assert "token" not in json.dumps(conn)
+                r = await client.get("/api/v1/service-connections")
+                assert len((await r.json())["connections"]) == 1
+                r = await client.delete(
+                    f"/api/v1/service-connections/{conn['id']}"
+                )
+                assert (await r.json())["ok"]
+
+                # model catalogue carries sizing facts
+                r = await client.get("/api/v1/helix-models")
+                models = (await r.json())["models"]
+                llama = next(
+                    m for m in models if "Llama-3-8B" in m["id"]
+                )
+                assert 7e9 < llama["parameters"] < 9e9
+                assert llama["hbm_bytes_int8"] == llama["parameters"]
+                assert any(
+                    m["family"] == "qwen2-vl" for m in models
+                )
+            finally:
+                cp.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
