@@ -112,6 +112,21 @@ ENV_REFERENCE: tuple = (
         "deployments run their own issuer with helix_tpu.control.license.",
         section="server",
     ),
+    EnvVar(
+        "HELIX_PUBLIC_DOMAINS",
+        "Comma-separated domains this deployment itself fronts. The "
+        "/.well-known/helix-domain-verify route only answers for claims "
+        "on these domains — unset (default), it answers for none, so a "
+        "user can never self-verify the deployment's own domain and "
+        "hijack email auto-join.",
+        section="auth",
+    ),
+    EnvVar(
+        "HELIX_DOMAIN_CLAIM_TTL_S",
+        "Seconds an UNVERIFIED org-domain claim blocks competing claims "
+        "(default 259200 = 72h). Verified claims never expire.",
+        section="auth",
+    ),
     # -- auth ------------------------------------------------------------
     EnvVar(
         "HELIX_MASTER_KEY",

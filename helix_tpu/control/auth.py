@@ -144,6 +144,17 @@ class Authenticator:
         self._fernet = Fernet(
             base64.urlsafe_b64encode(hashlib.sha256(master_key).digest())
         )
+        # purpose-bound derived keys (HMAC signing for short-lived
+        # credentials etc.) — deterministic across restarts, never the
+        # master key itself
+        self._derive_base = hashlib.sha256(
+            b"helix-derive:" + master_key
+        ).digest()
+
+    def derive_key(self, purpose: str) -> bytes:
+        return hmac.new(
+            self._derive_base, purpose.encode(), hashlib.sha256
+        ).digest()
 
     def _load_or_create_master_key(self) -> bytes:
         if self._db_path == ":memory:":
@@ -663,13 +674,24 @@ class Authenticator:
             )
             self._db.commit()
 
+    # optional hook fired for users provisioned through SSO — the server
+    # wires org-domain auto-join here (the IdP-verified email is the
+    # signup path where domain matching is actually trustworthy)
+    on_user_provisioned = None
+
     def get_or_create_by_email(self, email: str, name: str = "") -> User:
         """OIDC auto-provisioning: a verified identity maps to a local
         user row keyed by email (``api/pkg/auth/oidc.go``)."""
         u = self.get_user(email)
         if u is not None:
             return u
-        return self.create_user(email=email, name=name)
+        u = self.create_user(email=email, name=name)
+        if self.on_user_provisioned is not None:
+            try:
+                self.on_user_provisioned(u)
+            except Exception:  # noqa: BLE001 — hook must not block SSO
+                pass
+        return u
 
     # -- envelope encryption (shared with the OAuth token store) ----------
     def encrypt(self, data: bytes) -> bytes:

@@ -558,6 +558,11 @@ class ControlPlane:
 
         self.license = LicenseManager()
 
+        # SSO-provisioned users auto-join their verified email-domain org
+        self.auth.on_user_provisioned = (
+            lambda u: self._org_domains().auto_join(u)
+        )
+
         def fire_trigger(trigger, payload):
             import asyncio as _asyncio
 
@@ -749,6 +754,10 @@ class ControlPlane:
         if request.path in ("/", "/healthz", "/metrics", "/files/view"):
             return await handler(request)
         if request.path.startswith("/webhooks/"):  # verifies webhook secret
+            return await handler(request)
+        if request.path.startswith("/.well-known/helix-domain-verify/"):
+            # external verifiers fetch this anonymously; the token IS the
+            # secret
             return await handler(request)
         if (
             request.path == "/api/v1/users"
@@ -1050,6 +1059,42 @@ class ControlPlane:
         r.add_get("/api/v1/llm_calls", self.list_llm_calls)
         r.add_get("/api/v1/model-info", self.model_info)
         r.add_get("/api/v1/helix-models", self.helix_models)
+        # agent subscriptions (claude/codex) + session credentials
+        for vendor in ("claude", "codex"):
+            r.add_get(
+                f"/api/v1/{vendor}-subscriptions",
+                self._make_subs_handler("list", vendor),
+            )
+            r.add_post(
+                f"/api/v1/{vendor}-subscriptions",
+                self._make_subs_handler("create", vendor),
+            )
+            r.add_delete(
+                f"/api/v1/{vendor}-subscriptions/{{sid}}",
+                self._make_subs_handler("delete", vendor),
+            )
+        r.add_post(
+            "/api/v1/sessions/{id}/claude-credentials",
+            self.session_claude_credentials,
+        )
+        # org domains + well-known verification
+        r.add_get(
+            "/api/v1/organization-domains", self.org_domains_list
+        )
+        r.add_post(
+            "/api/v1/organization-domains", self.org_domains_claim
+        )
+        r.add_post(
+            "/api/v1/organization-domains/{id}/verify",
+            self.org_domains_verify,
+        )
+        r.add_delete(
+            "/api/v1/organization-domains/{id}", self.org_domains_delete
+        )
+        r.add_get(
+            "/.well-known/helix-domain-verify/{token}",
+            self.well_known_domain_verify,
+        )
         # service connections (stored forge/service credentials)
         r.add_get(
             "/api/v1/service-connections", self.service_connections_list
@@ -2085,7 +2130,16 @@ class ControlPlane:
                 admin=bool(body.get("admin")),
             )
         key = self.auth.create_api_key(u.id)
-        return web.json_response({"id": u.id, "api_key": key})
+        # verified email-domain -> automatic org membership
+        joined = None
+        try:
+            joined = self._org_domains().auto_join(u)
+        except Exception:  # noqa: BLE001 — auto-join must not block signup
+            pass
+        out = {"id": u.id, "api_key": key}
+        if joined:
+            out["joined_org"] = joined
+        return web.json_response(out)
 
     async def create_key(self, request):
         """Keys may only be minted for the caller's own account, unless
@@ -3827,6 +3881,145 @@ class ControlPlane:
                 "id": name, "runners": [], "source": "provider",
             })
         return web.json_response({"models": info})
+
+    # -- agent subscriptions ---------------------------------------------------
+    def _subs(self):
+        if not hasattr(self, "_subscriptions"):
+            from helix_tpu.services.subscriptions import SubscriptionStore
+
+            self._subscriptions = SubscriptionStore(self.auth)
+        return self._subscriptions
+
+    def _make_subs_handler(self, op: str, vendor: str):
+        async def handler(request):
+            owner = self._user_id(request)
+            subs = self._subs()
+            if op == "list":
+                return web.json_response(
+                    {"subscriptions": subs.list(owner, vendor=vendor)}
+                )
+            if op == "create":
+                body = await request.json()
+                try:
+                    sub = subs.create(
+                        owner, vendor, body.get("token", ""),
+                        name=body.get("name", ""),
+                        tier=body.get("tier", ""),
+                    )
+                except ValueError as e:
+                    return _err(400, str(e))
+                return web.json_response(sub, status=201)
+            sub = subs.get(request.match_info["sid"])
+            if sub is None or sub["vendor"] != vendor:
+                return _err(404, "subscription not found")
+            user = request.get("user")
+            if self.auth_required and not self.auth.authorize(
+                user, resource_owner=sub["owner"]
+            ):
+                return _err(403, "not your subscription")
+            return web.json_response({"ok": subs.delete(sub["id"])})
+
+        return handler
+
+    async def session_claude_credentials(self, request):
+        """Mint a session-bound credential handle for the user's Claude
+        subscription (the raw OAuth token never rides the session wire)."""
+        sid = request.match_info["id"]
+        if self.store.get_session(sid) is None:
+            return _err(404, "session not found")
+        owner = self._user_id(request)
+        subs = self._subs().list(owner, vendor="claude")
+        if not subs:
+            return _err(409, "no claude subscription on this account")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        sub_id = body.get("subscription_id") or subs[0]["id"]
+        target = self._subs().get(sub_id)
+        if target is None or target["owner"] != owner:
+            return _err(404, "subscription not found")
+        cred = self._subs().mint_session_credential(sub_id, sid)
+        return web.json_response(cred, status=201)
+
+    # -- org domains -----------------------------------------------------------
+    def _org_domains(self):
+        if not hasattr(self, "_org_domains_svc"):
+            from helix_tpu.services.org_domains import OrgDomains
+
+            self._org_domains_svc = OrgDomains(self.auth)
+        return self._org_domains_svc
+
+    async def org_domains_list(self, request):
+        """Claims carry their verification token (the entire proof of
+        ownership) — listing is org-admin scoped; the unscoped view is
+        platform-admin only."""
+        org = request.query.get("org", "")
+        if org:
+            denied = self._org_admin_denied(request, org)
+            if denied is not None:
+                return denied
+        else:
+            denied = self._require_admin(request)
+            if denied is not None:
+                return denied
+        return web.json_response({
+            "domains": self._org_domains().list(org_id=org or None)
+        })
+
+    async def org_domains_claim(self, request):
+        body = await request.json()
+        oid = body.get("org_id", "")
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        try:
+            claim = self._org_domains().claim(
+                oid, body.get("domain", ""),
+                auto_join_role=body.get("auto_join_role", "member"),
+            )
+        except KeyError:
+            return _err(404, "org not found")
+        except ValueError as e:
+            return _err(400, str(e))
+        return web.json_response(claim, status=201)
+
+    async def org_domains_verify(self, request):
+        dom = self._org_domains().get(request.match_info["id"])
+        if dom is None:
+            return _err(404, "domain claim not found")
+        denied = self._org_admin_denied(request, dom["org_id"])
+        if denied is not None:
+            return denied
+        try:
+            out = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: self._org_domains().verify(dom["id"]),
+            )
+        except PermissionError as e:
+            return _err(409, str(e))
+        except Exception as e:  # noqa: BLE001 — fetch failures
+            return _err(502, str(e)[:300])
+        return web.json_response(out)
+
+    async def org_domains_delete(self, request):
+        dom = self._org_domains().get(request.match_info["id"])
+        if dom is None:
+            return _err(404, "domain claim not found")
+        denied = self._org_admin_denied(request, dom["org_id"])
+        if denied is not None:
+            return denied
+        return web.json_response(
+            {"ok": self._org_domains().delete(dom["id"])}
+        )
+
+    async def well_known_domain_verify(self, request):
+        token = self._org_domains().token_body(
+            request.match_info["token"]
+        )
+        if token is None:
+            return _err(404, "unknown token")
+        return web.Response(text=token, content_type="text/plain")
 
     # -- service connections ---------------------------------------------------
     def _svc_conn(self):

@@ -83,6 +83,11 @@ class KnowledgeManager:
         # span, so an in-flight background index can never interleave
         # with an external push and delete its chunks
         self._kid_locks: dict = {}
+        # push epochs: bumped by complete(); the reconcile loop snapshots
+        # them at dequeue and skips any kid whose epoch moved before its
+        # index started (a dequeued-but-not-started re-index must not
+        # clobber a push that landed in between)
+        self._push_epoch: dict = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -271,11 +276,14 @@ class KnowledgeManager:
         ]
         embeddings = self.embed(texts)
         # clear any pending reconcile (a scheduled re-gather of the
-        # original source must not supersede the push), then commit under
-        # the per-kid lock — an ALREADY-RUNNING index() holds that lock,
-        # so the push lands strictly after it at a higher version
+        # original source must not supersede the push), bump the push
+        # epoch (a DEQUEUED-but-not-started re-index checks it and
+        # skips), then commit under the per-kid lock — an ALREADY-RUNNING
+        # index() holds that lock, so the push lands strictly after it at
+        # a higher version
         with self._lock:
             self._dirty.discard(kid)
+            self._push_epoch[kid] = self._push_epoch.get(kid, 0) + 1
         with self._kid_lock(kid):
             new_version = spec.version + 1
             self.store.upsert(
@@ -309,7 +317,16 @@ class KnowledgeManager:
                 with self._lock:
                     dirty = list(self._dirty)
                     self._dirty.clear()
+                    epochs = {
+                        k: self._push_epoch.get(k, 0) for k in dirty
+                    }
                 for kid in dirty:
+                    with self._lock:
+                        moved = (
+                            self._push_epoch.get(kid, 0) != epochs[kid]
+                        )
+                    if moved:
+                        continue   # an external push superseded this pass
                     if kid in self._specs:
                         self.index(kid)
                 self._stop.wait(self.reconcile_interval)

@@ -1,0 +1,209 @@
+"""Organization domain verification and email-domain auto-join.
+
+The reference claims domains per organization and verifies control via a
+well-known token (``/api/v1/organization-domains`` +
+``/.well-known/helix-domain-verify/{token}`` in
+``api/pkg/server/server.go``); users whose email matches a verified
+domain join the org automatically.
+
+Flow: claim(org, domain) -> token; the domain owner serves the token at
+``https://{domain}/.well-known/helix-domain-verify/{token}``; verify()
+fetches it (injectable fetch, crawler SSRF posture) and flips the claim
+to verified.  ``org_for_email`` drives auto-join on user creation.  The
+control plane also answers its own well-known path for domains it hosts,
+so a deployment fronting its org's domain self-verifies.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+import uuid
+from typing import Callable, List, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS org_domains (
+  id TEXT PRIMARY KEY,
+  org_id TEXT NOT NULL,
+  domain TEXT NOT NULL UNIQUE,
+  token TEXT NOT NULL UNIQUE,
+  verified INTEGER NOT NULL DEFAULT 0,
+  auto_join_role TEXT NOT NULL DEFAULT 'member',
+  created_at REAL NOT NULL,
+  verified_at REAL
+);
+"""
+
+_DOMAIN_RE = re.compile(
+    r"^(?=.{1,253}$)([a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?\.)+"
+    r"[a-z]{2,63}$"
+)
+
+
+class OrgDomains:
+    def __init__(self, auth, fetch: Optional[Callable] = None):
+        """fetch(url) -> body str; defaults to the crawler's SSRF-guarded
+        fetcher."""
+        self.auth = auth
+        self._db = auth._db
+        self._conn = auth._conn
+        self._lock = auth._lock
+        self._db.migrate("org_domains", [(1, "initial", _SCHEMA)])
+        self._fetch = fetch
+
+    def _default_fetch(self, url: str) -> str:
+        from helix_tpu.knowledge.crawler import default_fetch
+
+        body, _ctype = default_fetch(url, timeout=10.0)
+        return body
+
+    # -- claims --------------------------------------------------------------
+    def claim(self, org_id: str, domain: str,
+              auto_join_role: str = "member") -> dict:
+        domain = domain.strip().lower().rstrip(".")
+        if not _DOMAIN_RE.match(domain):
+            raise ValueError(f"invalid domain {domain!r}")
+        did = f"dom_{uuid.uuid4().hex[:12]}"
+        token = uuid.uuid4().hex + uuid.uuid4().hex
+        import os
+
+        claim_ttl = float(
+            os.environ.get("HELIX_DOMAIN_CLAIM_TTL_S", str(72 * 3600))
+        )
+        with self._lock:
+            if self._conn.execute(
+                "SELECT 1 FROM orgs WHERE id=?", (org_id,)
+            ).fetchone() is None:
+                raise KeyError(org_id)
+            dup = self._conn.execute(
+                "SELECT id, verified, created_at FROM org_domains"
+                " WHERE domain=?",
+                (domain,),
+            ).fetchone()
+            if dup:
+                # an UNVERIFIED claim is not ownership: it expires after
+                # claim_ttl so a squatter cannot block the real owner
+                if dup[1] or time.time() - dup[2] < claim_ttl:
+                    raise ValueError(
+                        f"domain {domain!r} is already claimed"
+                    )
+                self._conn.execute(
+                    "DELETE FROM org_domains WHERE id=?", (dup[0],)
+                )
+            self._conn.execute(
+                "INSERT INTO org_domains(id, org_id, domain, token,"
+                " auto_join_role, created_at) VALUES(?,?,?,?,?,?)",
+                (did, org_id, domain, token, auto_join_role, time.time()),
+            )
+            self._db.commit()
+        return self.get(did)
+
+    def get(self, did: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, org_id, domain, token, verified,"
+                " auto_join_role, created_at, verified_at FROM org_domains"
+                " WHERE id=?",
+                (did,),
+            ).fetchone()
+        if row is None:
+            return None
+        return {
+            "id": row[0], "org_id": row[1], "domain": row[2],
+            "token": row[3], "verified": bool(row[4]),
+            "auto_join_role": row[5], "created_at": row[6],
+            "verified_at": row[7],
+            "well_known_url": (
+                f"https://{row[2]}/.well-known/helix-domain-verify/"
+                f"{row[3]}"
+            ),
+        }
+
+    def list(self, org_id: Optional[str] = None) -> List[dict]:
+        q = "SELECT id FROM org_domains"
+        args: tuple = ()
+        if org_id:
+            q += " WHERE org_id=?"
+            args = (org_id,)
+        q += " ORDER BY created_at"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [self.get(r[0]) for r in rows]
+
+    def delete(self, did: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM org_domains WHERE id=?", (did,)
+            )
+            self._db.commit()
+        return cur.rowcount > 0
+
+    # -- verification --------------------------------------------------------
+    def verify(self, did: str) -> dict:
+        """Fetch the well-known URL; the body must contain the token."""
+        claim = self.get(did)
+        if claim is None:
+            raise KeyError(did)
+        fetch = self._fetch or self._default_fetch
+        body = fetch(claim["well_known_url"])
+        if claim["token"] not in (body or ""):
+            raise PermissionError(
+                "well-known token mismatch: serve the token at "
+                + claim["well_known_url"]
+            )
+        with self._lock:
+            self._conn.execute(
+                "UPDATE org_domains SET verified=1, verified_at=?"
+                " WHERE id=?",
+                (time.time(), did),
+            )
+            self._db.commit()
+        return self.get(did)
+
+    def token_body(self, token: str) -> Optional[str]:
+        """Answer OUR well-known path — but ONLY for domains the operator
+        declared this deployment fronts (HELIX_PUBLIC_DOMAINS, comma
+        separated).  Answering for every row would let any user claim the
+        deployment's own domain and self-verify it, hijacking email
+        auto-join."""
+        import os
+
+        fronted = {
+            d.strip().lower()
+            for d in os.environ.get("HELIX_PUBLIC_DOMAINS", "").split(",")
+            if d.strip()
+        }
+        if not fronted:
+            return None
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT token, domain FROM org_domains WHERE token=?",
+                (token,),
+            ).fetchone()
+        if row is None or row[1] not in fronted:
+            return None
+        return row[0]
+
+    # -- auto-join -----------------------------------------------------------
+    def org_for_email(self, email: str) -> Optional[dict]:
+        """Verified-domain match for an email -> {org_id, role}."""
+        domain = email.rsplit("@", 1)[-1].lower()
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT org_id, auto_join_role FROM org_domains"
+                " WHERE domain=? AND verified=1",
+                (domain,),
+            ).fetchone()
+        if row is None:
+            return None
+        return {"org_id": row[0], "role": row[1]}
+
+    def auto_join(self, user) -> Optional[dict]:
+        """Join a user to their email-domain org (used at user create)."""
+        if not user.email or "@" not in user.email:
+            return None
+        hit = self.org_for_email(user.email)
+        if hit is None:
+            return None
+        self.auth.add_member(hit["org_id"], user.id, role=hit["role"])
+        return hit

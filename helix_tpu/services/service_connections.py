@@ -174,8 +174,13 @@ class ServiceConnections:
                 f"{api_base}/user/repos",
                 params={"per_page": per_page, "sort": "pushed"},
                 headers={"Authorization": f"Bearer {tok}"},
-                timeout=20,
+                timeout=20, allow_redirects=False,
             )
+            if 300 <= getattr(r, "status_code", 200) < 400:
+                # a redirecting forge could bounce the (SSRF-checked)
+                # request at an internal target — refuse, like the
+                # crawler does per hop
+                raise ValueError("forge API redirected; refusing")
             r.raise_for_status()
             return [
                 {
@@ -191,8 +196,10 @@ class ServiceConnections:
                 f"{api_base}/projects",
                 params={"membership": "true", "per_page": per_page},
                 headers={"PRIVATE-TOKEN": tok},
-                timeout=20,
+                timeout=20, allow_redirects=False,
             )
+            if 300 <= getattr(r, "status_code", 200) < 400:
+                raise ValueError("forge API redirected; refusing")
             r.raise_for_status()
             return [
                 {

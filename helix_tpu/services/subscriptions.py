@@ -1,0 +1,160 @@
+"""Agent-subscription credentials: Claude / Codex OAuth tokens per user.
+
+The reference stores per-user Claude and Codex subscription credentials
+and mints session-scoped copies for sandboxes
+(``/api/v1/claude-subscriptions``, ``/codex-subscriptions``,
+``/sessions/{}/claude-credentials`` in ``api/pkg/server/server.go``) —
+agents inside sandboxes then call the vendor API on the USER's
+subscription rather than a platform key.
+
+Credentials are envelope-encrypted at rest (the service-connection
+posture).  ``session_credentials`` mints a short-lived, session-bound
+HMAC-wrapped credential handle: the sandbox gets a reference it can
+exchange in-process, never the raw token on the wire; the gateway
+(``control/anthropic_gateway.py`` DirectTransport oauth_token) consumes
+the resolved token.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import json
+import time
+import uuid
+from typing import List, Optional
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS agent_subscriptions (
+  id TEXT PRIMARY KEY,
+  owner TEXT NOT NULL,
+  vendor TEXT NOT NULL,             -- claude | codex
+  name TEXT NOT NULL DEFAULT '',
+  tier TEXT NOT NULL DEFAULT '',
+  token_ciphertext BLOB NOT NULL,
+  created_at REAL NOT NULL,
+  last_used REAL
+);
+"""
+
+VENDORS = ("claude", "codex")
+
+
+class SubscriptionStore:
+    def __init__(self, auth):
+        self.auth = auth
+        self._db = auth._db
+        self._conn = auth._conn
+        self._lock = auth._lock
+        self._db.migrate("agent_subscriptions", [(1, "initial", _SCHEMA)])
+        # deterministic across restarts (derived from the master key):
+        # minted session credentials stay resolvable after a reboot
+        self._hmac_key = auth.derive_key("session-credential")
+
+    # -- CRUD ----------------------------------------------------------------
+    def create(self, owner: str, vendor: str, token: str,
+               name: str = "", tier: str = "") -> dict:
+        if vendor not in VENDORS:
+            raise ValueError(f"vendor must be one of {VENDORS}")
+        if not token:
+            raise ValueError("token is required")
+        sid = f"sub_{uuid.uuid4().hex[:12]}"
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO agent_subscriptions(id, owner, vendor, name,"
+                " tier, token_ciphertext, created_at)"
+                " VALUES(?,?,?,?,?,?,?)",
+                (sid, owner, vendor, name or vendor, tier,
+                 self.auth.encrypt(token.encode()), time.time()),
+            )
+            self._db.commit()
+        return self.get(sid)
+
+    def get(self, sid: str) -> Optional[dict]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT id, owner, vendor, name, tier, created_at,"
+                " last_used FROM agent_subscriptions WHERE id=?",
+                (sid,),
+            ).fetchone()
+        if row is None:
+            return None
+        return {
+            "id": row[0], "owner": row[1], "vendor": row[2],
+            "name": row[3], "tier": row[4], "created_at": row[5],
+            "last_used": row[6],
+        }
+
+    def list(self, owner: str, vendor: Optional[str] = None) -> List[dict]:
+        q = ("SELECT id FROM agent_subscriptions WHERE owner=?")
+        args: list = [owner]
+        if vendor:
+            q += " AND vendor=?"
+            args.append(vendor)
+        q += " ORDER BY created_at"
+        with self._lock:
+            rows = self._conn.execute(q, args).fetchall()
+        return [self.get(r[0]) for r in rows]
+
+    def delete(self, sid: str) -> bool:
+        with self._lock:
+            cur = self._conn.execute(
+                "DELETE FROM agent_subscriptions WHERE id=?", (sid,)
+            )
+            self._db.commit()
+        return cur.rowcount > 0
+
+    # -- in-process consumers ------------------------------------------------
+    def token(self, sid: str) -> Optional[str]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT token_ciphertext FROM agent_subscriptions"
+                " WHERE id=?",
+                (sid,),
+            ).fetchone()
+            if row is None:
+                return None
+            self._conn.execute(
+                "UPDATE agent_subscriptions SET last_used=? WHERE id=?",
+                (time.time(), sid),
+            )
+            self._db.commit()
+        return self.auth.decrypt(row[0]).decode()
+
+    # -- session-scoped credentials ------------------------------------------
+    def mint_session_credential(self, sid: str, session_id: str,
+                                ttl: float = 3600.0) -> dict:
+        """A signed, expiring handle binding subscription -> session.
+        The sandbox presents the handle; the control plane exchanges it
+        in-process via resolve_session_credential — the raw OAuth token
+        never rides the session wire."""
+        if self.get(sid) is None:
+            raise KeyError(sid)
+        expires = int(time.time() + ttl)
+        msg = f"{sid}:{session_id}:{expires}".encode()
+        sig = hmac.new(self._hmac_key, msg, hashlib.sha256).hexdigest()
+        return {
+            "subscription_id": sid,
+            "session_id": session_id,
+            "expires": expires,
+            "credential": f"hxc_{sid}.{session_id}.{expires}.{sig}",
+        }
+
+    def resolve_session_credential(self, credential: str) -> Optional[str]:
+        """credential handle -> raw token (None: invalid/expired)."""
+        if not credential.startswith("hxc_"):
+            return None
+        try:
+            sid, session_id, expires_s, sig = (
+                credential[len("hxc_"):].split(".")
+            )
+            expires = int(expires_s)
+        except ValueError:
+            return None
+        if time.time() > expires:
+            return None
+        msg = f"{sid}:{session_id}:{expires}".encode()
+        want = hmac.new(self._hmac_key, msg, hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, sig):
+            return None
+        return self.token(sid)

@@ -17,7 +17,8 @@ class FakeHTTP:
     def __init__(self):
         self.calls = []
 
-    def get(self, url, params=None, headers=None, timeout=None):
+    def get(self, url, params=None, headers=None, timeout=None,
+            allow_redirects=True):
         self.calls.append((url, params, headers))
 
         class R:

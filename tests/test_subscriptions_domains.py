@@ -1,0 +1,256 @@
+"""Agent subscriptions (claude/codex credential store + session-scoped
+handles) and org domain verification with email auto-join
+(``/api/v1/claude-subscriptions``, ``/sessions/{}/claude-credentials``,
+``/api/v1/organization-domains``, ``/.well-known/helix-domain-verify``)."""
+
+import asyncio
+import time
+
+import pytest
+
+from helix_tpu.control.auth import Authenticator
+from helix_tpu.services.org_domains import OrgDomains
+from helix_tpu.services.subscriptions import SubscriptionStore
+
+
+class TestSubscriptionStore:
+    def _store(self):
+        a = Authenticator()
+        return a, SubscriptionStore(a)
+
+    def test_crud_and_encryption(self):
+        a, subs = self._store()
+        sub = subs.create("u1", "claude", token="oat_secret",
+                          tier="max")
+        assert "token" not in sub
+        row = a._conn.execute(
+            "SELECT token_ciphertext FROM agent_subscriptions"
+        ).fetchone()
+        assert b"oat_secret" not in row[0]
+        assert subs.token(sub["id"]) == "oat_secret"
+        assert subs.get(sub["id"])["last_used"] is not None
+        assert [s["id"] for s in subs.list("u1", vendor="claude")] == \
+            [sub["id"]]
+        assert subs.list("u1", vendor="codex") == []
+        assert subs.delete(sub["id"])
+
+    def test_validation(self):
+        _, subs = self._store()
+        with pytest.raises(ValueError):
+            subs.create("u1", "copilot", token="t")
+        with pytest.raises(ValueError):
+            subs.create("u1", "claude", token="")
+
+    def test_session_credential_roundtrip(self):
+        _, subs = self._store()
+        sub = subs.create("u1", "claude", token="oat_tok")
+        cred = subs.mint_session_credential(sub["id"], "ses_1", ttl=60)
+        assert cred["credential"].startswith("hxc_")
+        assert subs.resolve_session_credential(
+            cred["credential"]
+        ) == "oat_tok"
+        # tampered / expired / garbage all refuse
+        assert subs.resolve_session_credential(
+            cred["credential"][:-2] + "xx"
+        ) is None
+        expired = subs.mint_session_credential(sub["id"], "ses_1",
+                                               ttl=-1)
+        assert subs.resolve_session_credential(
+            expired["credential"]
+        ) is None
+        assert subs.resolve_session_credential("hxc_bogus") is None
+
+    def test_credential_survives_restart(self):
+        """The HMAC key derives from the master key, so handles minted
+        before a restart still resolve after one."""
+        a = Authenticator(master_key=b"fixed-master")
+        subs = SubscriptionStore(a)
+        sub = subs.create("u1", "claude", token="tok")
+        cred = subs.mint_session_credential(sub["id"], "s", ttl=60)
+        # "restart": new store over the same DB + same master key
+        subs2 = SubscriptionStore(a)
+        assert subs2.resolve_session_credential(
+            cred["credential"]
+        ) == "tok"
+
+
+class TestOrgDomains:
+    def _svc(self, body="TOKEN"):
+        a = Authenticator()
+        owner = a.create_user("o@corp.example")
+        org = a.create_org("corp", owner.id)
+        served = {}
+
+        def fetch(url):
+            served["url"] = url
+            tok = url.rsplit("/", 1)[-1]
+            return tok if body == "TOKEN" else body
+
+        return a, OrgDomains(a, fetch=fetch), org, served
+
+    def test_claim_verify_autojoin(self):
+        a, dom, org, served = self._svc()
+        claim = dom.claim(org, "corp.example")
+        assert not claim["verified"]
+        out = dom.verify(claim["id"])
+        assert out["verified"] and out["verified_at"]
+        assert served["url"] == claim["well_known_url"]
+        # auto-join: a user at the verified domain joins the org
+        u = a.create_user("new@corp.example")
+        hit = dom.auto_join(u)
+        assert hit == {"org_id": org, "role": "member"}
+        assert a.member_role(org, u.id) == "member"
+        # other domains don't
+        assert dom.auto_join(a.create_user("x@other.example")) is None
+
+    def test_verify_fails_on_wrong_body(self):
+        a, dom, org, _ = self._svc(body="not-the-token")
+        claim = dom.claim(org, "corp.example")
+        with pytest.raises(PermissionError):
+            dom.verify(claim["id"])
+        assert not dom.get(claim["id"])["verified"]
+
+    def test_claim_validation(self):
+        a, dom, org, _ = self._svc()
+        with pytest.raises(ValueError):
+            dom.claim(org, "not a domain")
+        dom.claim(org, "one.example")
+        with pytest.raises(ValueError):
+            dom.claim(org, "one.example")   # already claimed
+        with pytest.raises(KeyError):
+            dom.claim("org_nope", "two.example")
+
+    def test_token_body_only_for_declared_domains(self, monkeypatch):
+        """Self-verification answers ONLY for operator-declared fronted
+        domains — otherwise any user could claim the deployment's own
+        domain and self-verify it (auto-join hijack)."""
+        a, dom, org, _ = self._svc()
+        claim = dom.claim(org, "corp.example")
+        # undeclared: never answer
+        monkeypatch.delenv("HELIX_PUBLIC_DOMAINS", raising=False)
+        assert dom.token_body(claim["token"]) is None
+        # declared: answer for that domain's claims only
+        monkeypatch.setenv("HELIX_PUBLIC_DOMAINS", "corp.example")
+        assert dom.token_body(claim["token"]) == claim["token"]
+        other = dom.claim(org, "other.example")
+        assert dom.token_body(other["token"]) is None
+        assert dom.token_body("nope") is None
+
+    def test_unverified_claim_expires_verified_never(self, monkeypatch):
+        a, dom, org, _ = self._svc()
+        owner2 = a.create_user("o2@x.example")
+        org2 = a.create_org("rival", owner2.id)
+        monkeypatch.setenv("HELIX_DOMAIN_CLAIM_TTL_S", "0.05")
+        squat = dom.claim(org, "target.example")
+        import time as _t
+
+        _t.sleep(0.1)
+        # expired unverified squat: the real owner claims over it
+        fresh = dom.claim(org2, "target.example")
+        assert dom.get(squat["id"]) is None
+        # a VERIFIED claim never expires
+        dom.verify(fresh["id"])
+        _t.sleep(0.1)
+        with pytest.raises(ValueError):
+            dom.claim(org, "target.example")
+
+    def test_push_epoch_guards_dequeued_reindex(self):
+        """A complete() landing between the reconcile loop's dequeue and
+        its index() call must not be clobbered by the re-index."""
+        from helix_tpu.knowledge.ingest import (
+            KnowledgeManager,
+            KnowledgeSpec,
+        )
+        from helix_tpu.knowledge.vector_store import VectorStore
+        from helix_tpu.knowledge.embed import HashEmbedder
+
+        km = KnowledgeManager(VectorStore(), HashEmbedder())
+        km.add(KnowledgeSpec(id="kp", text="original source"))
+        # simulate the loop's dequeue: dirty popped, epoch snapshotted
+        with km._lock:
+            km._dirty.clear()
+            epoch_at_dequeue = km._push_epoch.get("kp", 0)
+        # push lands before the loop reaches index()
+        km.complete("kp", [{"text": "external truth"}])
+        # the loop's guard must now skip the re-index
+        moved = km._push_epoch.get("kp", 0) != epoch_at_dequeue
+        assert moved
+        out = km.query("kp", "truth", top_k=1)
+        assert "external truth" in out[0]["text"]
+
+
+class TestHTTPSurface:
+    def test_subscriptions_domains_over_http(self, monkeypatch):
+        # this deployment "fronts" d.example so self-verification works
+        monkeypatch.setenv("HELIX_PUBLIC_DOMAINS", "d.example")
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                # claude subscription CRUD
+                r = await client.post(
+                    "/api/v1/claude-subscriptions",
+                    json={"token": "oat_x", "tier": "max"},
+                )
+                assert r.status == 201
+                sub = await r.json()
+                r = await client.get("/api/v1/claude-subscriptions")
+                assert len((await r.json())["subscriptions"]) == 1
+                # codex list is separate
+                r = await client.get("/api/v1/codex-subscriptions")
+                assert (await r.json())["subscriptions"] == []
+
+                # session-scoped credential
+                r = await client.post("/api/v1/sessions",
+                                      json={"name": "s"})
+                sid = (await r.json())["id"]
+                r = await client.post(
+                    f"/api/v1/sessions/{sid}/claude-credentials", json={}
+                )
+                assert r.status == 201
+                cred = (await r.json())["credential"]
+                assert cp._subs().resolve_session_credential(
+                    cred
+                ) == "oat_x"
+
+                # org domain claim + self-hosted well-known + verify
+                u = cp.auth.create_user("adm@d.example")
+                org = cp.auth.create_org("d-org", u.id)
+                r = await client.post(
+                    "/api/v1/organization-domains",
+                    json={"org_id": org, "domain": "d.example"},
+                )
+                assert r.status == 201
+                dom = await r.json()
+                r = await client.get(
+                    f"/.well-known/helix-domain-verify/{dom['token']}"
+                )
+                assert await r.text() == dom["token"]
+                # verify via an injected fetch that hits our own route
+                async def fetch_self(url):
+                    rr = await client.get(
+                        f"/.well-known/helix-domain-verify/{dom['token']}"
+                    )
+                    return await rr.text()
+
+                # (sync wrapper for the service's fetch seam)
+                cp._org_domains()._fetch = (
+                    lambda url: dom["token"]
+                )
+                r = await client.post(
+                    f"/api/v1/organization-domains/{dom['id']}/verify"
+                )
+                assert (await r.json())["verified"] is True
+            finally:
+                cp.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
