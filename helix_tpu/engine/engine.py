@@ -78,6 +78,8 @@ class Request:
     max_len: Optional[int] = None   # page-capacity cap set at admission
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
     first_token_time: Optional[float] = None
+    cached_tokens: int = 0          # prompt tokens served by prefix cache
+    _page_hashes: Optional[list] = None
 
     @property
     def num_tokens(self) -> int:
@@ -113,6 +115,13 @@ class EngineConfig:
     # amortising the host round trip beats per-token latency (round-3
     # verdict weak #5 — bursty cadence is the wrong default for chat).
     adaptive_sync_max_streams: int = 2
+    # Automatic prefix caching (vLLM APC): full prompt pages are content-
+    # hashed and shared across requests — a request whose prompt starts
+    # with an already-cached prefix skips prefilling those tokens (the
+    # shared-system-prompt TTFT lever).  Pages stay read-only by
+    # construction: the shareable prefix is capped at the prompt's FULL
+    # pages below its last token, and decode writes only past the prompt.
+    enable_prefix_cache: bool = True
 
     def cache_config(self, dtype: str = "bfloat16") -> CacheConfig:
         return CacheConfig(
@@ -575,6 +584,12 @@ class Engine:
         self._changed_slots: set[int] = set()  # admitted/freed since sync
         self._dstate: Optional[DecodeState] = None
         self._chunking: Optional[dict] = None  # in-flight chunked prefill
+        from helix_tpu.engine.kv_cache import PrefixCache
+
+        self.prefix_cache = (
+            PrefixCache() if cfg.enable_prefix_cache else None
+        )
+        self._shared_pages: dict[str, list] = {}  # req id -> cache pages
         self._key_base = _splitmix64(0x8E1_1C9 ^ (rng_seed & _M64))
         self._key_nonce = 0
         self._step_counter = itertools.count()
@@ -789,9 +804,40 @@ class Engine:
     # admission + prefill
     # ------------------------------------------------------------------
 
-    def _try_claim(self, req: Request):
+    def _prompt_hashes(self, req: Request) -> list:
+        """Chain digests for the prompt's shareable full pages, capped at
+        (plen-1)//ps: the page holding the LAST prompt token is never
+        shared so sampling always has at least one token to prefill."""
+        if getattr(req, "_page_hashes", None) is None:
+            from helix_tpu.engine.kv_cache import PrefixCache
+
+            ps = self.cache_cfg.page_size
+            cap = (len(req.prompt_tokens) - 1) // ps
+            req._page_hashes = PrefixCache.page_hashes(
+                req.prompt_tokens, ps, cap
+            )
+        return req._page_hashes
+
+    def _ensure_pages(self, need: int) -> bool:
+        """can_allocate, with prefix-cache LRU eviction as the backstop."""
+        if self.allocator.can_allocate(need):
+            return True
+        if self.prefix_cache is not None:
+            freed = self.prefix_cache.evict(
+                need - self.allocator.free_pages
+            )
+            if freed:
+                self.allocator.give_back(freed)
+        return self.allocator.can_allocate(need)
+
+    def _try_claim(self, req: Request, use_cache: bool = False):
         """Allocate pages + a slot for one waiting request; returns its
-        page table or None when resources are unavailable."""
+        page table or None when resources are unavailable.
+
+        With ``use_cache`` the longest cached prefix is acquired from the
+        prefix cache and stitched in front of freshly allocated pages;
+        ``req.cached_tokens`` records how many prompt tokens are already
+        resident (page-aligned)."""
         free_slots = [i for i, s in enumerate(self.slots) if s is None]
         if not free_slots:
             return None
@@ -799,11 +845,27 @@ class Engine:
         limit = min(plen + req.sampling.max_tokens, self.max_context_len)
         need = self.allocator.pages_needed(limit, self.cache_cfg.page_size)
         need = min(need, self.cache_cfg.max_pages_per_seq)
-        if not self.allocator.can_allocate(need):
+        shared: list = []
+        hashes: list = []
+        if use_cache and self.prefix_cache is not None:
+            hashes = self._prompt_hashes(req)
+            k = self.prefix_cache.match_len(hashes)
+            if not self._ensure_pages(need - k):
+                return None   # blocked retry: no acquire, no stat churn
+            shared = self.prefix_cache.acquire(hashes)
+        need_new = need - len(shared)
+        if not self._ensure_pages(need_new):
+            if shared:
+                self.prefix_cache.release(shared)
             return None
         slot = free_slots[0]
-        pages = self.allocator.allocate(req.id, need)
+        pages = shared + self.allocator.allocate(req.id, need_new)
         req.slot = slot
+        req.cached_tokens = len(shared) * self.cache_cfg.page_size
+        if use_cache and self.prefix_cache is not None:
+            self.prefix_cache.record_claim(len(shared), len(hashes))
+        if shared:
+            self._shared_pages[req.id] = shared
         # pages round up to page granularity; the model context limit
         # still binds exactly
         req.max_len = min(
@@ -842,6 +904,20 @@ class Engine:
             plen = len(req.prompt_tokens)
             needs_chunking = plen > self.cfg.max_prefill_len
             is_mrope = self.model_cfg.mrope_sections is not None
+            cache_match = 0
+            if self.prefix_cache is not None and not is_mrope:
+                cache_match = self.prefix_cache.match_len(
+                    self._prompt_hashes(req)
+                )
+            if cache_match and not needs_chunking:
+                # a cached prefix means the remainder must attend HISTORY
+                # (the shared pages): the packed path can't, but a ONE-
+                # SHOT chunk call can — run it inline so hit bursts admit
+                # in the same step (they must not serialize through the
+                # single in-flight chunking state)
+                if not self._admit_chunk_hit(req, pending):
+                    return   # resource wait
+                continue
             if not needs_chunking and not is_mrope:
                 # short text prompts pack into ONE prefill call; first
                 # tokens stay on device until the whole wave is admitted
@@ -856,7 +932,7 @@ class Engine:
                 # blocked (VERDICT r2 weak #6)
                 deferred.append(self.waiting.pop(0))
                 continue
-            table = self._try_claim(req)
+            table = self._try_claim(req, use_cache=not is_mrope)
             if table is None:
                 return  # resource wait; decode will free pages
             self.waiting.pop(0)
@@ -864,9 +940,10 @@ class Engine:
             if needs_chunking:
                 # defer to _chunk_step: one chunk per engine step, decode
                 # interleaves; the slot stays inactive until the prompt is
-                # fully cached
+                # fully cached.  A prefix-cache hit starts past the
+                # resident pages: those tokens are never prefilled again.
                 self._chunking = {
-                    "req": req, "table": table, "next": 0,
+                    "req": req, "table": table, "next": req.cached_tokens,
                     "key": self._request_key(req), "slot": slot,
                 }
                 self._state_dirty = True
@@ -883,6 +960,52 @@ class Engine:
             self._state_dirty = True
             self._changed_slots.add(slot)
             self._emit(req, int(first_token), emitted)
+
+    def _admit_chunk_hit(self, req: Request, pending: list) -> bool:
+        """Admit ONE short prompt whose prefix is cache-resident: a
+        single chunk-prefill call attends the remainder against the
+        shared history pages.  First tokens join the packed wave's
+        batched fetch.  Returns False when blocked on resources."""
+        table = self._try_claim(req, use_cache=True)
+        if table is None:
+            return False
+        self.waiting.pop(0)
+        plen = len(req.prompt_tokens)
+        start = req.cached_tokens
+        rem = plen - start
+        ps = self.cache_cfg.page_size
+        C_cap = self.cfg.max_prefill_len
+        Cb = _bucket(max(rem, ps), ps, C_cap)
+        tokens = np.zeros((1, Cb), np.int32)
+        tokens[0, :rem] = req.prompt_tokens[start:plen]
+        if start == 0:
+            m = 0
+        else:
+            hist_tokens = C_cap
+            while hist_tokens < start:
+                hist_tokens *= 2
+            m = hist_tokens // ps
+        hist_table = np.zeros((1, m), np.int32)
+        used = min(m, -(-start // ps))
+        hist_table[0, :used] = table[:used]
+        carry, sub = _host_split(self._request_key(req))
+        self._slot_keys[req.slot] = carry
+        fn = _build_chunk_prefill_fn(
+            self.model_cfg, ps, self._backend, self.mesh
+        )
+        self.cache, token = fn(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.int32(start),
+            jnp.int32(rem),
+            jnp.asarray(hist_table),
+            jnp.asarray(table)[None],
+            SamplingState.from_params([req.sampling]),
+            sub,
+        )
+        pending.append(([(req, table)], token))
+        return True
 
     def _admit_packed(self, pending: list) -> int:
         """Claim as many short waiting prompts as fit one packed bucket
@@ -979,7 +1102,12 @@ class Engine:
                 self._last_token[slot] = first_token
                 self._state_dirty = True
                 self._changed_slots.add(slot)
-                self.num_prefill_tokens += len(req.prompt_tokens)
+                self.num_prefill_tokens += (
+                    len(req.prompt_tokens) - req.cached_tokens
+                )
+                self._adopt_prompt_pages(
+                    req, self._page_tables[slot]
+                )
                 self._emit(req, first_token, emitted)
 
     def _chunk_step(self, emitted) -> None:
@@ -1032,6 +1160,7 @@ class Engine:
         if end < plen:
             return
         # prompt fully cached: activate the slot with the first sampled token
+        self._adopt_prompt_pages(req, st["table"])
         slot = st["slot"]
         first_token = int(token[0])
         self._chunking = None
@@ -1260,6 +1389,33 @@ class Engine:
         elif req.num_tokens >= (req.max_len or self.cache_cfg.max_seq_len):
             self._finish(req, FinishReason.LENGTH)
 
+    def _adopt_prompt_pages(self, req: Request, table) -> None:
+        """After a prompt is fully resident, hand its fresh full pages to
+        the prefix cache so the next request with the same prefix skips
+        them.  Pages acquired FROM the cache are already shared; only the
+        newly prefilled full pages transfer ownership (detached from the
+        allocator so request teardown can't free them out from under a
+        future sharer)."""
+        if self.prefix_cache is None:
+            return
+        hashes = self._prompt_hashes(req)
+        if not hashes:
+            return
+        ps = self.cache_cfg.page_size
+        k_shared = req.cached_tokens // ps
+        fresh_hashes = hashes[k_shared:]
+        if not fresh_hashes:
+            return
+        fresh_pages = [
+            int(table[i]) for i in range(k_shared, len(hashes))
+        ]
+        adopted = self.prefix_cache.adopt(fresh_hashes, fresh_pages)
+        if adopted:
+            self.allocator.detach(req.id, adopted)
+            # the request keeps USING them (refcount 1 held on its
+            # behalf); release on finish
+            self._shared_pages.setdefault(req.id, []).extend(adopted)
+
     def _finish(self, req: Request, reason: FinishReason) -> None:
         req.finished = True
         req.finish_reason = reason
@@ -1270,4 +1426,7 @@ class Engine:
             req.slot = None
         if req in self.waiting:   # aborted before admission
             self.waiting.remove(req)
+        shared = self._shared_pages.pop(req.id, None)
+        if shared and self.prefix_cache is not None:
+            self.prefix_cache.release(shared)
         self.allocator.free(req.id)

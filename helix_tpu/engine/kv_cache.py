@@ -215,6 +215,19 @@ class PageAllocator:
         pages = self._owned.pop(seq_id, [])
         self._free.extend(reversed(pages))
 
+    def detach(self, seq_id: str, pages: list) -> None:
+        """Remove ``pages`` from the sequence's ownership WITHOUT freeing
+        them — the prefix cache adopts them; they re-enter the free list
+        only through give_back() on eviction."""
+        drop = set(pages)
+        owned = self._owned.get(seq_id)
+        if owned:
+            self._owned[seq_id] = [p for p in owned if p not in drop]
+
+    def give_back(self, pages: list) -> None:
+        """Return cache-evicted pages to the free list."""
+        self._free.extend(pages)
+
 
 def slot_to_page_offset(slots: jax.Array, page_table, page_size: int):
     """(page, offset) for absolute slot indices given per-seq page tables.
@@ -226,3 +239,135 @@ def slot_to_page_offset(slots: jax.Array, page_table, page_size: int):
     offsets = slots % page_size
     pages = jnp.take_along_axis(page_table, page_idx, axis=-1)
     return pages.astype(jnp.int32), offsets.astype(jnp.int32)
+
+
+class PrefixCache:
+    """Automatic prefix caching: content-hashed full pages of prompt KV
+    shared across requests (vLLM's APC — the reference serves through
+    vLLM where this is the flagship TTFT feature for shared system
+    prompts; SURVEY.md §2.2).
+
+    Pages enter the cache when a request's prompt finishes prefilling
+    (``adopt``) and are then OWNED by the cache: the allocator's ``free``
+    no longer returns them (they are detached from the request), and they
+    go back to the free list only via LRU eviction under allocation
+    pressure.  A later request whose prompt starts with the same page
+    contents ``acquire``s them (refcount++) and skips prefilling those
+    tokens entirely — attention reads them as history through the page
+    table, which is safe because decode only ever writes pages PAST the
+    shared prefix.
+
+    Hash chain: h_i = blake2b(h_{i-1} || tokens[i*ps:(i+1)*ps]) — a page
+    matches only when its entire prefix matches, so a page table can be
+    stitched from the longest cached run.
+    """
+
+    def __init__(self):
+        self._entries: dict[bytes, list] = {}   # digest -> [page, refs, tick]
+        self._by_page: dict[int, bytes] = {}
+        self._tick = 0
+        self.hits = 0          # pages served from cache
+        self.misses = 0        # full pages prefilled fresh
+
+    @staticmethod
+    def page_hashes(tokens, page_size: int, max_pages: int) -> list:
+        """Chain digests for the first ``max_pages`` FULL pages."""
+        import hashlib
+
+        out = []
+        prev = b""
+        for i in range(max_pages):
+            chunk = tokens[i * page_size:(i + 1) * page_size]
+            if len(chunk) < page_size:
+                break
+            h = hashlib.blake2b(digest_size=16)
+            h.update(prev)
+            h.update(np.asarray(chunk, np.int32).tobytes())
+            prev = h.digest()
+            out.append(prev)
+        return out
+
+    def match_len(self, hashes: list) -> int:
+        """Longest cached prefix (pages), without acquiring."""
+        n = 0
+        for h in hashes:
+            if h not in self._entries:
+                break
+            n += 1
+        return n
+
+    def acquire(self, hashes: list) -> list:
+        """Claim the longest cached prefix; returns its pages (refs++).
+        Does NOT touch the hit/miss counters — a claim can still fail on
+        page pressure and be released; the engine records hits only for
+        admissions that actually start (record_claim)."""
+        pages = []
+        self._tick += 1
+        for h in hashes:
+            e = self._entries.get(h)
+            if e is None:
+                break
+            e[1] += 1
+            e[2] = self._tick
+            pages.append(e[0])
+        return pages
+
+    def record_claim(self, hit_pages: int, total_pages: int) -> None:
+        """Stats for ONE admitted request: pages served from cache vs
+        full pages prefilled fresh."""
+        self.hits += hit_pages
+        self.misses += total_pages - hit_pages
+
+    def release(self, pages: list) -> None:
+        for p in pages:
+            h = self._by_page.get(p)
+            if h is None:
+                continue
+            e = self._entries.get(h)
+            if e is not None and e[1] > 0:
+                e[1] -= 1
+
+    def adopt(self, hashes: list, pages: list) -> list:
+        """Transfer ownership of a finished prompt's fresh full pages to
+        the cache (refs=1 for the adopting request).  Pages whose hash is
+        already cached (a concurrent duplicate prefilled its own copy)
+        are NOT adopted — the caller keeps them and they free normally.
+        Returns the adopted pages."""
+        adopted = []
+        self._tick += 1
+        for h, p in zip(hashes, pages):
+            if h in self._entries or p in self._by_page:
+                continue
+            self._entries[h] = [p, 1, self._tick]
+            self._by_page[p] = h
+            adopted.append(p)
+        return adopted
+
+    def evict(self, n: int) -> list:
+        """Free up to ``n`` pages from refcount-0 entries, LRU first.
+        NOTE: evicting entry i invalidates the hash CHAIN below it for
+        future matches, but match_len stops at the first missing digest,
+        so correctness holds — later entries just become unreachable and
+        age out the same way."""
+        if n <= 0:
+            return []
+        victims = sorted(
+            (e for e in self._entries.values() if e[1] == 0),
+            key=lambda e: e[2],
+        )[:n]
+        freed = []
+        for e in victims:
+            page = e[0]
+            h = self._by_page.pop(page)
+            del self._entries[h]
+            freed.append(page)
+        return freed
+
+    @property
+    def stats(self) -> dict:
+        return {
+            "entries": len(self._entries),
+            "pages": len(self._by_page),
+            "hits": self.hits,
+            "misses": self.misses,
+        }

@@ -505,7 +505,16 @@ class TestMultiStepDecode:
         assert req.finish_reason == FinishReason.STOP
         # slot + pages freed despite the mid-window finish
         assert all(s is None for s in eng.slots)
-        assert eng.allocator.free_pages == eng.allocator.num_pages - 1
+        # every page is either free or held by the prefix cache (the
+        # prompt's full pages are adopted for reuse, not leaked)
+        cached = (
+            eng.prefix_cache.stats["pages"]
+            if eng.prefix_cache is not None else 0
+        )
+        assert (
+            eng.allocator.free_pages + cached
+            == eng.allocator.num_pages - 1
+        )
 
     def test_window_shrinks_near_token_budget(self, tiny_model):
         """max_tokens is still exact under fused windows (no overshoot)."""
