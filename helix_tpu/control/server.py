@@ -930,6 +930,14 @@ class ControlPlane:
             "/api/v1/spec-tasks/{id}/attachments/{name}",
             self.spec_task_attachment_get,
         )
+        r.add_post(
+            "/api/v1/spec-tasks/{id}/zed-instance",
+            self.spec_task_zed_instance,
+        )
+        r.add_post(
+            "/api/v1/projects/{id}/exploratory-session",
+            self.project_exploratory_session,
+        )
         r.add_get("/api/v1/pull-requests", self.list_prs)
         r.add_get("/api/v1/pull-requests/{id}/diff", self.get_pr_diff)
         r.add_post("/api/v1/pull-requests/{id}/merge", self.merge_pr)
@@ -2432,6 +2440,54 @@ class ControlPlane:
             body=data, content_type="application/octet-stream"
         )
 
+    async def spec_task_zed_instance(self, request):
+        """Open a Zed editor instance bound to this task (reference
+        /spec-tasks/{}/zed-instance): publish the create request over the
+        protocol stream; the bridge answers with the registered instance."""
+        t = self.task_store.get_task(request.match_info["id"])
+        if t is None:
+            return _err(404, "task not found")
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        hit = await self._request_zed_instance(
+            {
+                "spec_task_id": t.id,
+                "user_id": self._user_id(request),
+                "project_path": body.get("project_path", ""),
+                "initial_threads": body.get("initial_threads", []),
+            },
+            lambda i: i["spec_task_id"] == t.id,
+        )
+        if hit is not None:
+            return web.json_response(hit, status=201)
+        return web.json_response({"requested": True}, status=202)
+
+    async def project_exploratory_session(self, request):
+        """A chat session pre-bound to the project's board + primary repo
+        (reference /projects/{}/exploratory-session)."""
+        p = self.projects.get(request.match_info["id"])
+        if p is None:
+            return _err(404, "project not found")
+        primary = next(
+            (r["repo"] for r in p["repositories"] if r["primary"]),
+            p["repositories"][0]["repo"] if p["repositories"] else "",
+        )
+        sid = self.store.create_session(
+            owner=self._user_id(request),
+            name=f"explore: {p['name']}",
+            doc={
+                "project": p["name"],
+                "project_id": p["id"],
+                "repo": primary,
+                "kind": "exploratory",
+            },
+        )
+        return web.json_response(
+            self.store.get_session(sid), status=201
+        )
+
     async def list_prs(self, request):
         return web.json_response(
             {
@@ -3689,6 +3745,29 @@ class ControlPlane:
         return ws
 
     # -- zed bridge ------------------------------------------------------------
+    async def _request_zed_instance(self, data: dict, match) -> Optional[dict]:
+        """Publish an instance_create and poll for the instance the
+        bridge registers (match(instance) -> bool picks it out); None
+        when the bridge did not answer in time."""
+        from helix_tpu.services import zed_bridge as zp
+
+        before = {i["id"] for i in self.zed.list()}
+        self.bus.publish(
+            zp.STREAM_INSTANCES, zp.make_message(zp.T_INSTANCE_CREATE, data)
+        )
+        for _ in range(50):
+            hit = next(
+                (
+                    i for i in self.zed.list()
+                    if i["id"] not in before and match(i)
+                ),
+                None,
+            )
+            if hit is not None:
+                return hit
+            await asyncio.sleep(0.02)
+        return None
+
     async def zed_list(self, request):
         return web.json_response({"instances": self.zed.list()})
 
@@ -3701,29 +3780,13 @@ class ControlPlane:
             body = await request.json()
         except Exception:
             body = {}
-        before = {i["id"] for i in self.zed.list()}
-        msg = zp.make_message(zp.T_INSTANCE_CREATE, body)
-        self.bus.publish(zp.STREAM_INSTANCES, msg)
-        # the in-process bridge handles on the bus thread; poll briefly
-        # for the instance THIS request created (explicit id, or the one
-        # that appeared since `before`)
         iid = body.get("instance_id", "")
-        for _ in range(50):
-            insts = self.zed.list()
-            hit = next(
-                (
-                    i for i in insts
-                    if (i["id"] == iid if iid else i["id"] not in before)
-                ),
-                None,
-            )
-            if hit is not None:
-                return web.json_response(hit, status=201)
-            await asyncio.sleep(0.02)
-        return web.json_response(
-            {"requested": True, "correlation_id": msg["message_id"]},
-            status=202,
+        hit = await self._request_zed_instance(
+            body, lambda i: i["id"] == iid if iid else True
         )
+        if hit is not None:
+            return web.json_response(hit, status=201)
+        return web.json_response({"requested": True}, status=202)
 
     async def zed_stop(self, request):
         from helix_tpu.services import zed_bridge as zp

@@ -138,6 +138,16 @@ class OpenAIServer:
                 f"{sum(1 for s in eng.slots if s is not None)}",
                 f"helix_free_pages{tag} {eng.allocator.free_pages}",
             ]
+            pc = getattr(eng, "prefix_cache", None)
+            if pc is not None:
+                st = pc.stats
+                lines += [
+                    f"helix_prefix_cache_pages{tag} {st['pages']}",
+                    f"helix_prefix_cache_hit_pages_total{tag} "
+                    f"{st['hits']}",
+                    f"helix_prefix_cache_miss_pages_total{tag} "
+                    f"{st['misses']}",
+                ]
             ttfts = getattr(eng, "recent_ttfts", None)
             if ttfts:
                 # the engine thread appends concurrently; a mutation during

@@ -3,8 +3,8 @@
  * it so one broken page never takes down the app shell. */
 
 export const TABS = ["chat","sessions","projects","tasks","apps","org",
-  "desktops","knowledge","runners","compute","providers","wallet","evals",
-  "oauth","secrets","triggers","admin"];
+  "desktops","sandboxes","knowledge","runners","compute","providers",
+  "wallet","evals","oauth","secrets","triggers","admin"];
 
 export let tab = location.hash.slice(1) || "chat";
 export let ME = null;

@@ -1,0 +1,92 @@
+/* Org dev sandboxes: run commands, browse files, watch the desktop
+ * (reference: the organization sandbox console). */
+import {$, $row, api, esc, render as rerender} from "./core.js";
+
+export async function render(m) {
+  const {orgs} = await api("/api/v1/orgs").catch(() => ({orgs: []}));
+  const top = $(`<div class="panel row">
+    <select id="so"></select>
+    <input id="sn" placeholder="sandbox name">
+    <label class="id"><input type="checkbox" id="sd"> desktop</label>
+    <button class="primary" id="mk">Create sandbox</button></div>`);
+  m.appendChild(top);
+  for (const o of orgs)
+    top.querySelector("#so").appendChild(new Option(o.name, o.id));
+  top.querySelector("#mk").onclick = async () => {
+    const oid = top.querySelector("#so").value;
+    await api(`/api/v1/orgs/${oid}/sandboxes`, {method: "POST",
+      body: JSON.stringify({name: top.querySelector("#sn").value,
+                            with_desktop: top.querySelector("#sd").checked})});
+    rerender();
+  };
+
+  const list = $(`<div class="panel"><h3>Sandboxes</h3>
+    <table><thead><tr><th>name</th><th>org</th><th>status</th>
+    <th>commands</th><th></th></tr></thead><tbody id="sb"></tbody></table>
+    </div>`);
+  m.appendChild(list);
+  const sb = list.querySelector("#sb");
+  const console_ = $(`<div class="panel" style="display:none">
+    <h3 id="ct">console</h3>
+    <div class="row"><input id="cc" class="grow" placeholder="shell command">
+      <button class="ghost" id="cgo">Run</button></div>
+    <pre id="cl" style="max-height:260px;overflow:auto"></pre>
+    <div id="cf" class="id"></div></div>`);
+  m.appendChild(console_);
+
+  const listings = await Promise.all(orgs.map(
+    o => api(`/api/v1/orgs/${o.id}/sandboxes`)
+      .catch(() => ({sandboxes: []}))));
+  orgs.forEach((o, oi) => {
+    for (const s of listings[oi].sandboxes) {
+      const tr = $row(`<tr><td>${esc(s.name)}</td><td>${esc(o.name)}</td>
+        <td>${esc(s.status)}</td><td>${s.commands}</td>
+        <td><button class="ghost open">open</button>
+            <button class="ghost del">destroy</button></td></tr>`);
+      tr.querySelector(".open").onclick = () => openConsole(o.id, s);
+      tr.querySelector(".del").onclick = async () => {
+        await api(`/api/v1/orgs/${o.id}/sandboxes/${s.id}`,
+                  {method: "DELETE"});
+        rerender();
+      };
+      sb.appendChild(tr);
+    }
+  });
+
+  function openConsole(oid, s) {
+    console_.style.display = "";
+    console_.querySelector("#ct").textContent = `console: ${s.name}`;
+    const log = console_.querySelector("#cl");
+    log.textContent = "";   // a previous sandbox's transcript is not ours
+    console_.querySelector("#cgo").onclick = async () => {
+      const cmd = console_.querySelector("#cc").value;
+      const c = await api(`/api/v1/orgs/${oid}/sandboxes/${s.id}/commands`,
+        {method: "POST", body: JSON.stringify({command: cmd})});
+      log.textContent += `$ ${cmd}\n`;
+      // poll to just past the server's 300s command timeout, backing off
+      const deadline = Date.now() + 310_000;
+      while (Date.now() < deadline) {
+        const st = await api(
+          `/api/v1/orgs/${oid}/sandboxes/${s.id}/commands/${c.id}`);
+        if (st.status !== "running") {
+          const {lines} = await api(
+            `/api/v1/orgs/${oid}/sandboxes/${s.id}/commands/${c.id}/logs`);
+          log.textContent += lines.join("\n") +
+            `\n[exit ${st.exit_code}]\n`;
+          log.scrollTop = log.scrollHeight;
+          break;
+        }
+        await new Promise(r => setTimeout(r, 500));
+      }
+      listFiles();
+    };
+    async function listFiles() {
+      const {files} = await api(
+        `/api/v1/orgs/${oid}/sandboxes/${s.id}/files/list`)
+        .catch(() => ({files: []}));
+      console_.querySelector("#cf").textContent =
+        "files: " + (files.map(f => f.name).join(", ") || "(empty)");
+    }
+    listFiles();
+  }
+}

@@ -210,6 +210,61 @@ class TestSessionsAndTaskView:
             run()
         )
 
+    def test_zed_instance_and_exploratory_session(self):
+        import asyncio
+
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                r = await client.post(
+                    "/api/v1/spec-tasks",
+                    json={"project": "zp", "title": "with editor"},
+                )
+                tid = (await r.json())["id"]
+                r = await client.post(
+                    f"/api/v1/spec-tasks/{tid}/zed-instance",
+                    json={"project_path": "/w"},
+                )
+                assert r.status == 201, await r.text()
+                inst = await r.json()
+                assert inst["spec_task_id"] == tid
+                # the instance shows on the task view
+                r = await client.get(f"/api/v1/spec-tasks/{tid}/view")
+                assert (await r.json())["zed_instances"][0]["id"] == \
+                    inst["id"]
+
+                # exploratory session bound to a project + primary repo
+                r = await client.post("/api/v1/projects",
+                                      json={"name": "exp"})
+                pid = (await r.json())["id"]
+                await client.post("/api/v1/git/repositories",
+                                  json={"name": "exp-repo"})
+                await client.post(
+                    f"/api/v1/projects/{pid}/repositories/exp-repo/attach",
+                    json={"primary": True},
+                )
+                r = await client.post(
+                    f"/api/v1/projects/{pid}/exploratory-session"
+                )
+                assert r.status == 201
+                ses = await r.json()
+                assert ses["doc"]["repo"] == "exp-repo"
+                assert ses["doc"]["kind"] == "exploratory"
+            finally:
+                cp.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
+
     def test_jetstream_peek_is_read_only(self):
         from helix_tpu.control.jetstream import JetStream
 
