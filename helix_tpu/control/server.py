@@ -4163,6 +4163,10 @@ class ControlPlane:
                 m.num_heads + 2 * m.num_kv_heads
             ) + m.num_heads * m.head_dim * m.hidden_size
             mlp = 3 * m.hidden_size * m.intermediate_size
+            if m.num_experts > 0:   # MoE: per-expert FFNs + router
+                mlp = m.num_experts * mlp + (
+                    m.hidden_size * m.num_experts
+                )
             return (
                 m.vocab_size * m.hidden_size * 2
                 + m.num_layers * (attn + mlp)

@@ -248,7 +248,8 @@ def _build_packed_prefill_fn(model_cfg: ModelConfig, backend):
             )
 
         logits, (k_new, v_new) = forward(
-            params, cfg, tokens, positions, attn_fn=attn_fn
+            params, cfg, tokens, positions, attn_fn=attn_fn,
+            moe_token_mask=segments > 0,
         )
         cache = write_kv(cache, k_new, v_new, pages, offsets, valid)
         last = logits[0, ends]          # [K, V] — each request's last token
@@ -340,6 +341,7 @@ def _build_chunk_prefill_fn(
             params, cfg, tokens, pos_q,
             attn_fn=attn_fn,
             layer_caches=(cache.k_pages, cache.v_pages),
+            moe_token_mask=valid_q,
         )
         pages, offsets = slot_to_page_offset(pos_q, full_table, page_size)
         cache = write_kv(cache, k_new, v_new, pages, offsets, valid_q)
@@ -513,6 +515,9 @@ def _build_decode_fn(
                 params, cfg, tokens, pos2d,
                 attn_fn=attn_fn,
                 carry_caches=(cache.k_pages, cache.v_pages),
+                # inactive slots never consume expert capacity: outputs
+                # are independent of batch-mates (decode is dropless too)
+                moe_token_mask=(active > 0)[:, None],
             )
         cache = PagedKVCache(k_pages=kp, v_pages=vp)
         penalised = apply_penalties(

@@ -39,6 +39,12 @@ class ModelConfig:
     dtype: str = "bfloat16"
     # multimodal rope sections (t, h, w) — set => Qwen2-VL-family text tower
     mrope_sections: Optional[tuple] = None
+    # --- mixture of experts (Mixtral family); 0 = dense FFN ---
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # per-expert token capacity = factor * tokens * k / num_experts
+    # (GShard-style dispatch; overflow tokens fall back to the residual)
+    expert_capacity_factor: float = 1.5
     # --- non-architectural serving metadata ---
     name: str = "unnamed"
 
@@ -81,6 +87,8 @@ class ModelConfig:
             mlp_bias=hf.get("mlp_bias", False),
             qk_norm=model_type == "qwen3",
             max_position_embeddings=hf.get("max_position_embeddings", 8192),
+            num_experts=hf.get("num_local_experts", 0),
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
             name=name,
         )
 
@@ -147,4 +155,22 @@ QWEN2_7B = ModelConfig(
     name="Qwen/Qwen2-7B-Instruct",
 )
 
-CATALOG = {m.name: m for m in (LLAMA3_8B, PHI3_MINI, QWEN2_7B)}
+MIXTRAL_8X7B = ModelConfig(
+    vocab_size=32000,
+    hidden_size=4096,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=128,
+    intermediate_size=14336,
+    rope_theta=1000000.0,
+    max_position_embeddings=32768,
+    num_experts=8,
+    num_experts_per_tok=2,
+    name="mistralai/Mixtral-8x7B-Instruct-v0.1",
+)
+
+CATALOG = {
+    m.name: m
+    for m in (LLAMA3_8B, PHI3_MINI, QWEN2_7B, MIXTRAL_8X7B)
+}

@@ -87,6 +87,19 @@ def init_params(
         },
         "final_norm": {"weight": jnp.ones((E,), dtype)},
     }
+    if cfg.num_experts > 0:
+        # Mixtral-family: router + expert-stacked SwiGLU replaces the
+        # dense FFN (models/moe.py)
+        X = cfg.num_experts
+        kk = jax.random.split(jax.random.fold_in(key, 7), 4)
+        layers = params["layers"]
+        del layers["w_gate"], layers["w_up"], layers["w_down"]
+        layers["router"] = {"weight": norm(kk[0], (L, E, X))}
+        layers["experts"] = {
+            "w_gate": {"weight": norm(kk[1], (L, X, E, F))},
+            "w_up": {"weight": norm(kk[2], (L, X, E, F))},
+            "w_down": {"weight": norm(kk[3], (L, X, F, E))},
+        }
     if cfg.attention_bias:
         for nm, width in (("wq", H * D), ("wk", KVH * D), ("wv", KVH * D)):
             params["layers"][nm]["bias"] = jnp.zeros((L, width), dtype)
@@ -112,6 +125,14 @@ def param_logical_axes(cfg: ModelConfig) -> Any:
         "w_up": {"weight": (None, "embed", "mlp")},
         "w_down": {"weight": (None, "mlp", "embed")},
     }
+    if cfg.num_experts > 0:
+        del lax_["w_gate"], lax_["w_up"], lax_["w_down"]
+        lax_["router"] = {"weight": (None, "embed", None)}
+        lax_["experts"] = {
+            "w_gate": {"weight": (None, "expert", "embed", "mlp")},
+            "w_up": {"weight": (None, "expert", "embed", "mlp")},
+            "w_down": {"weight": (None, "expert", "mlp", "embed")},
+        }
     if cfg.attention_bias:
         lax_["wq"]["bias"] = (None, "heads")
         lax_["wk"]["bias"] = (None, "kv_heads")
@@ -137,6 +158,7 @@ def _layer(
     positions,
     inv_freq,
     attn_fn: AttnFn,
+    moe_token_mask=None,
 ):
     """One decoder block. h: [B, S, E].
 
@@ -170,9 +192,22 @@ def _layer(
     # --- mlp ---
     x = rms_norm(h, p["mlp_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
     act = _act(cfg.hidden_act)
-    gate = _dense(x, p["w_gate"])
-    up = _dense(x, p["w_up"])
-    h = h + _dense(act(gate) * up, p["w_down"])
+    if cfg.num_experts > 0:
+        from helix_tpu.models.moe import moe_ffn
+
+        router_w = p["router"]["weight"]
+        if router_w.dtype == jnp.int8:
+            router_w = router_w.astype(x.dtype) * p["router"][
+                "scale"
+            ].astype(x.dtype)
+        h = h + moe_ffn(
+            x, router_w, p["experts"], cfg, act,
+            token_mask=moe_token_mask,
+        )
+    else:
+        gate = _dense(x, p["w_gate"])
+        up = _dense(x, p["w_up"])
+        h = h + _dense(act(gate) * up, p["w_down"])
     return h, (k, v), new_cache
 
 
@@ -225,6 +260,8 @@ def forward(
     layer_caches=None,    # pytree whose leaves have leading num_layers dim
     carry_caches=None,    # pytree threaded through the scan as carry
     return_hidden: bool = False,
+    moe_token_mask=None,  # [B, S] bool: MoE routing validity (padding /
+                          # inactive decode slots never consume capacity)
 ):
     """Run the decoder.
 
@@ -249,7 +286,8 @@ def forward(
 
     def block(h, layer_params, layer_cache):
         return _layer(
-            h, layer_params, layer_cache, cfg, positions, inv_freq, attn_fn
+            h, layer_params, layer_cache, cfg, positions, inv_freq,
+            attn_fn, moe_token_mask=moe_token_mask,
         )
 
     h, kv = scan_decoder_blocks(

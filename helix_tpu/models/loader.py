@@ -160,6 +160,33 @@ def load_params(
             "weight": stack(lambda i: linear_t(pfx.format(i) + "mlp.down_proj.weight"))
         },
     }
+    if cfg.num_experts > 0:
+        # Mixtral: block_sparse_moe.gate + experts.N.w1/w3/w2
+        X = cfg.num_experts
+        del layers["w_gate"], layers["w_up"], layers["w_down"]
+        layers["router"] = {
+            "weight": stack(
+                lambda i: linear_t(
+                    pfx.format(i) + "block_sparse_moe.gate.weight"
+                )
+            )
+        }
+
+        def experts(i, w):
+            return np.stack([
+                linear_t(
+                    pfx.format(i)
+                    + f"block_sparse_moe.experts.{e}.{w}.weight"
+                )
+                for e in range(X)
+            ])
+
+        layers["experts"] = {
+            # HF Mixtral: w1 = gate, w3 = up, w2 = down
+            "w_gate": {"weight": stack(lambda i: experts(i, "w1"))},
+            "w_up": {"weight": stack(lambda i: experts(i, "w3"))},
+            "w_down": {"weight": stack(lambda i: experts(i, "w2"))},
+        }
     if cfg.attention_bias and f"{pfx.format(0)}self_attn.q_proj.bias" in shards:
         for ours, theirs in (("wq", "q_proj"), ("wk", "k_proj"), ("wv", "v_proj")):
             layers[ours]["bias"] = stack(

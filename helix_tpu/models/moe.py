@@ -1,0 +1,123 @@
+"""Mixture-of-experts FFN: GShard-style top-k dispatch on the MXU.
+
+The reference serves Mixtral through vLLM's fused CUDA MoE kernels
+(SURVEY.md §2.2 model families); the TPU-native formulation is the
+GShard/Switch dispatch algebra — everything is dense einsums over a
+``[experts, capacity]`` buffer, so XLA tiles it onto the MXU and, when
+the mesh carries an ``ep`` axis, shards the expert dimension and inserts
+the all-to-alls (the layout jax-ml's scaling guidance prescribes for
+MoE):
+
+- router: per-token logits over experts, softmax, top-k;
+- capacity: each expert processes at most ``C = factor * T * k / X``
+  tokens per call — a STATIC shape, which is the whole point: ragged
+  per-expert batches don't exist under jit. Tokens that overflow an
+  expert's capacity are dropped from that expert (their combine weight
+  is zero) and ride the residual stream, the standard GShard fallback;
+- dispatch/combine: one-hot ``[T, X, C]`` masks move tokens into and out
+  of the expert buffers with two einsums; the expert FFNs themselves are
+  a single batched SwiGLU over stacked ``[X, E, F]`` weights.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _expert_dense(h_in, wp, spec):
+    """Batched per-expert matmul over stacked weights, feeding int8
+    weight-only storage DIRECTLY into the einsum (mixed-precision dot:
+    XLA converts the int8 operand in VMEM after the halved HBM fetch —
+    ops/quant.py maybe_dequant_dense's convention) and rescaling the
+    output per channel."""
+    w = wp["weight"]
+    out = jnp.einsum(spec, h_in, w, preferred_element_type=jnp.float32)
+    scale = wp.get("scale")
+    if scale is not None:
+        # scale: [X, 1, out] — broadcasts over the capacity dim
+        out = out * scale.astype(jnp.float32)
+    return out
+
+
+def moe_ffn(x, router_p, experts_p, cfg, act, token_mask=None):
+    """x: [B, S, E] -> [B, S, E].
+
+    router_p: [E, X] (dequantised); experts_p: {"w_gate"/"w_up":
+    {"weight": [X, E, F][, "scale"]}, "w_down": {...}} — int8
+    weight-only trees pass through unchanged.
+
+    token_mask [B, S] (optional): False tokens (padding, inactive decode
+    slots) are EXCLUDED from routing entirely — they consume no expert
+    capacity, so a request's outputs never depend on garbage riding the
+    same batch.
+
+    Capacity: C = factor * T * k / X for prefill shapes; decode (S == 1)
+    runs DROPLESS (C = T) — the buffers are tiny at decode batch sizes
+    and per-token determinism matters more than the dispatch saving."""
+    B, S, E = x.shape
+    X = cfg.num_experts
+    k = cfg.num_experts_per_tok
+    T = B * S
+    xf = x.reshape(T, E)
+    valid = (
+        jnp.ones((T,), jnp.bool_)
+        if token_mask is None
+        else token_mask.reshape(T)
+    )
+
+    # --- router (fp32 for a stable softmax over few logits) ---
+    logits = jnp.dot(
+        xf.astype(jnp.float32), router_p.astype(jnp.float32)
+    )                                               # [T, X]
+    top_vals, top_idx = jax.lax.top_k(logits, k)    # [T, k]
+    top_w = jax.nn.softmax(top_vals, axis=-1)       # renormalised over k
+
+    # --- capacity + position of each (token, choice) in its expert ---
+    if S == 1:
+        C = T                                        # dropless decode
+    else:
+        C = max(int(cfg.expert_capacity_factor * T * k / X), 1)
+    # choice-major flattening ranks first choices ahead of second
+    # choices across the batch, so capacity overflow drops the weaker
+    # assignments first; invalid tokens are routed to a sentinel so they
+    # never occupy a capacity slot
+    flat_idx = jnp.where(
+        jnp.tile(valid, k), top_idx.T.reshape(-1), X
+    )                                               # [k*T] expert ids
+    onehot = jax.nn.one_hot(flat_idx, X, dtype=jnp.int32)   # [kT, X]
+    pos_in_expert = (
+        jnp.cumsum(onehot, axis=0) - onehot
+    )                                               # [kT, X]
+    pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [kT]
+    keep = (pos < C) & (flat_idx < X)
+    # back to [T, k]
+    pos = pos.reshape(k, T).T
+    keep = keep.reshape(k, T).T
+
+    # --- dispatch/combine tensors ---
+    # dispatch[t, x, c] = 1 where token t's choice lands at slot c of
+    # expert x; combine carries the softmax weight on the same support
+    dispatch = jnp.zeros((T, X, C), jnp.float32)
+    combine = jnp.zeros((T, X, C), jnp.float32)
+    for j in range(k):      # k is 2: an unrolled static loop
+        sel = (
+            jax.nn.one_hot(top_idx[:, j], X, dtype=jnp.float32)[:, :, None]
+            * jax.nn.one_hot(pos[:, j], C, dtype=jnp.float32)[:, None, :]
+            * keep[:, j, None, None].astype(jnp.float32)
+        )
+        dispatch = dispatch + sel
+        combine = combine + sel * top_w[:, j, None, None]
+
+    # --- expert buffers + batched SwiGLU over stacked weights ---
+    expert_in = jnp.einsum(
+        "txc,te->xce", dispatch.astype(x.dtype), xf
+    )                                                       # [X, C, E]
+    gate = _expert_dense(expert_in, experts_p["w_gate"], "xce,xef->xcf")
+    up = _expert_dense(expert_in, experts_p["w_up"], "xce,xef->xcf")
+    h = _expert_dense(
+        (act(gate) * up).astype(x.dtype), experts_p["w_down"],
+        "xcf,xfe->xce",
+    )                                                       # [X, C, E]
+    out = jnp.einsum("txc,xce->te", combine, h)
+    return out.reshape(B, S, E).astype(x.dtype)

@@ -28,7 +28,11 @@ def quantize_tensor(w: jax.Array):
     Returns {"weight": int8 array, "scale": f32}.
     """
     wf = w.astype(jnp.float32)
-    reduce_axes = tuple(range(1 if w.ndim >= 3 else 0, w.ndim - 1))
+    # reduce ONLY the contraction (input) axis: leading axes are batch
+    # dims (stacked layers, stacked experts) that must keep independent
+    # scales — reducing over experts would let one loud expert crush the
+    # quantization levels of the others
+    reduce_axes = (w.ndim - 2,)
     absmax = jnp.max(jnp.abs(wf), axis=reduce_axes, keepdims=True)
     scale = jnp.maximum(absmax / 127.0, 1e-8)
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)

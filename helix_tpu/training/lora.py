@@ -45,7 +45,7 @@ def _target_dims(cfg: ModelConfig) -> dict:
         cfg.head_dim,
         cfg.intermediate_size,
     )
-    return {
+    dims = {
         "wq": (E, H * D),
         "wk": (E, KVH * D),
         "wv": (E, KVH * D),
@@ -54,6 +54,12 @@ def _target_dims(cfg: ModelConfig) -> dict:
         "w_up": (E, F),
         "w_down": (F, E),
     }
+    if cfg.num_experts > 0:
+        # MoE replaces the dense FFN with router + expert stacks; FFN
+        # LoRA targets have nothing to graft onto — adapt attention only
+        for t in ("w_gate", "w_up", "w_down"):
+            del dims[t]
+    return dims
 
 
 def init_lora_params(
