@@ -141,7 +141,9 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         model_cfg, params = load_params(pm.checkpoint, mesh=mesh)
         model_cfg = dataclasses.replace(model_cfg, name=pm.name)
     else:
-        model_cfg = CATALOG.get(pm.name) or ModelConfig.tiny(name=pm.name)
+        model_cfg = CATALOG.get(pm.name) or ModelConfig.tiny(
+            name=pm.name, **pm.model_overrides
+        )
         params = init_params(model_cfg, jax.random.PRNGKey(0))
     if mesh is not None and not pm.checkpoint:
         # checkpoint branches place shard-wise inside the loaders; the

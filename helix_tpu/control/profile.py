@@ -53,6 +53,10 @@ class ProfileModel:
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     engine: dict = dataclasses.field(default_factory=dict)
     context_length: Optional[int] = None
+    # architecture overrides for random-init dev models (no checkpoint):
+    # forwarded to ModelConfig.tiny — e.g. {num_experts: 4} builds a toy
+    # MoE for ep-mesh dev profiles
+    model_overrides: dict = dataclasses.field(default_factory=dict)
     # multi-host lockstep serving over DCN (serving/multihost_serving):
     # {} = single host; {"role": "leader"} journals this engine's command
     # stream; {"role": "follower", "leader_url": "http://host0:8000"}
@@ -76,6 +80,7 @@ class ProfileModel:
             mesh=MeshSpec.from_dict(d.get("mesh", {})),
             engine=dict(d.get("engine", {})),
             context_length=d.get("context_length"),
+            model_overrides=dict(d.get("model_overrides", {})),
             multihost=mh,
         )
 
@@ -88,6 +93,7 @@ class ProfileModel:
             "mesh": self.mesh.to_dict(),
             "engine": dict(self.engine),
             "context_length": self.context_length,
+            "model_overrides": dict(self.model_overrides),
             "multihost": dict(self.multihost),
         }
 

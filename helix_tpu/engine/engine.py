@@ -1024,12 +1024,22 @@ class Engine:
         ps = self.cache_cfg.page_size
         batch = []
         used = 0
+        # MoE: one request per packed call — expert capacity is a shared
+        # field across the whole packed sequence, so co-packed requests
+        # would perturb each other's routing (and the KV the prefix
+        # cache adopts). The admission loop still issues the calls in
+        # one wave with one batched token fetch.
+        max_pack = (
+            1 if self.model_cfg.num_experts > 0 else len(self.waiting)
+        )
         while self.waiting:
             req = self.waiting[0]
             if req.finished:
                 self.waiting.pop(0)
                 continue
             plen = len(req.prompt_tokens)
+            if len(batch) >= max_pack:
+                break
             if plen > C_cap or (batch and used + plen > C_cap):
                 break
             table = self._try_claim(req)

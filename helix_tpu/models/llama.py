@@ -197,9 +197,11 @@ def _layer(
 
         router_w = p["router"]["weight"]
         if router_w.dtype == jnp.int8:
-            router_w = router_w.astype(x.dtype) * p["router"][
+            # dequantise in fp32: the router's softmax runs in fp32, and
+            # rounding through bf16 here could flip near-tied top-k picks
+            router_w = router_w.astype(jnp.float32) * p["router"][
                 "scale"
-            ].astype(x.dtype)
+            ].astype(jnp.float32)
         h = h + moe_ffn(
             x, router_w, p["experts"], cfg, act,
             token_mask=moe_token_mask,

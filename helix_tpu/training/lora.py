@@ -72,7 +72,15 @@ def init_lora_params(
     dims = _target_dims(model_cfg)
     L, r = model_cfg.num_layers, lora_cfg.rank
     out = {}
-    for i, t in enumerate(lora_cfg.targets):
+    # targets the architecture doesn't have (FFN targets on MoE configs)
+    # are skipped, not KeyError'd — ALL_TARGETS stays usable everywhere
+    targets = [t for t in lora_cfg.targets if t in dims]
+    if not targets:
+        raise ValueError(
+            f"no usable LoRA targets in {lora_cfg.targets} for "
+            f"{model_cfg.name} (MoE configs adapt attention only)"
+        )
+    for i, t in enumerate(targets):
         fan_in, fan_out = dims[t]
         k = jax.random.fold_in(key, i)
         out[t] = {

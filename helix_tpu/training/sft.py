@@ -109,7 +109,11 @@ class SFTTrainer:
             )
 
         logits, _ = forward(
-            merged, cfg, batch["tokens"], batch["positions"], attn_fn=attn_fn
+            merged, cfg, batch["tokens"], batch["positions"],
+            attn_fn=attn_fn,
+            # MoE: padding tokens must not consume expert capacity, or
+            # real tokens' routing (and gradients) vary with batch padding
+            moe_token_mask=batch["segment_ids"] > 0,
         )
         return masked_cross_entropy(
             logits, batch["targets"], batch["loss_mask"]

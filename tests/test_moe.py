@@ -227,3 +227,56 @@ class TestMoEEngine:
             want.append(nxt)
             toks.append(nxt)
         assert got == want
+
+
+class TestMoEDeterminism:
+    def test_prefill_independent_of_batch_mates(self):
+        """The same prompt admitted alone vs in a burst produces the same
+        tokens (MoE requests never co-pack, so no shared capacity field;
+        decode is dropless)."""
+        from helix_tpu.engine.engine import Engine, EngineConfig, Request
+        from helix_tpu.engine.sampling import SamplingParams
+
+        cfg = tiny_moe_cfg(expert_capacity_factor=1.0)
+        params = init_params(cfg, jax.random.PRNGKey(7))
+
+        def make():
+            return Engine(
+                cfg, params,
+                EngineConfig(
+                    max_decode_batch=4, page_size=4, num_pages=64,
+                    max_pages_per_seq=16, max_prefill_len=64,
+                    attn_backend="reference", enable_prefix_cache=False,
+                ),
+            )
+
+        target = [9, 8, 7, 6, 5]
+        alone = make().generate(
+            [target], SamplingParams(temperature=0.0, max_tokens=5)
+        )[0]
+        # same prompt in a burst with expert-hungry batch-mates
+        burst = make().generate(
+            [[1] * 12, target, [2] * 12],
+            SamplingParams(temperature=0.0, max_tokens=5),
+        )[1]
+        assert alone == burst
+
+    def test_lora_all_targets_on_moe(self):
+        """ALL_TARGETS works on MoE configs: FFN targets are skipped
+        with attention-only adapters, not KeyError'd."""
+        from helix_tpu.training.lora import (
+            ALL_TARGETS,
+            LoraConfig,
+            init_lora_params,
+            merge_lora_into_params,
+        )
+
+        cfg = tiny_moe_cfg()
+        lp = init_lora_params(
+            cfg, LoraConfig(rank=4, targets=ALL_TARGETS),
+            jax.random.PRNGKey(0),
+        )
+        assert "wq" in lp and "w_gate" not in lp
+        params = init_params(cfg, jax.random.PRNGKey(1))
+        merged = merge_lora_into_params(params, lp, scaling=1.0)
+        assert "experts" in merged["layers"]

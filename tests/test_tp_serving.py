@@ -208,3 +208,46 @@ def test_node_agent_single_device_has_no_mesh():
         assert agent.registry.get("solo").loop.engine.mesh is None
     finally:
         agent.stop()
+
+
+def test_node_agent_applies_ep_moe_profile():
+    """A Mixtral-style profile (mesh: {ep: 4, tp: 2}) applies through the
+    node agent: expert stacks shard over ep, the engine decodes."""
+    agent = NodeAgent("n-moe")
+    profile = ServingProfile.from_dict(
+        {
+            "name": "ep-moe",
+            "requirement": {"chips": 8},
+            "models": [
+                {
+                    "name": "tiny-moe",
+                    "mesh": {"ep": 4, "tp": 2},
+                    "engine": dict(ECFG),
+                    "model_overrides": {
+                        "num_experts": 4, "num_experts_per_tok": 2,
+                    },
+                }
+            ],
+        }
+    )
+    try:
+        state = agent.apply_profile(profile)
+        assert state.status == "running", state.error
+        served = agent.registry.get("tiny-moe")
+        mesh = served.loop.engine.mesh
+        assert mesh is not None and mesh.shape["ep"] == 4
+        # the expert stacks are genuinely split over ep: each device
+        # holds 1/4 of the expert dim
+        loop = served.loop
+        loop.stop(join=True)
+        eng = loop.engine
+        w = eng.params["layers"]["experts"]["w_gate"]["weight"]
+        shard_shapes = {s.data.shape for s in w.addressable_shards}
+        X = w.shape[1]
+        assert all(sh[1] == X // 4 for sh in shard_shapes), shard_shapes
+        out = eng.generate(
+            [[7, 8, 9, 10]], SamplingParams(temperature=0.0, max_tokens=3)
+        )[0]
+        assert len(out) == 3
+    finally:
+        agent.stop()
