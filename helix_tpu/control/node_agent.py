@@ -138,12 +138,27 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         # mesh-aware load: each stacked tensor is placed with its
         # NamedSharding as it is built, so host->HBM transfer is shard-wise
         # and no chip ever holds the full bf16 model
+        if pm.model_overrides:
+            raise ValueError(
+                "model_overrides apply to random-init dev models only; "
+                f"{pm.name!r} loads a checkpoint whose architecture is "
+                "fixed by its config.json"
+            )
         model_cfg, params = load_params(pm.checkpoint, mesh=mesh)
         model_cfg = dataclasses.replace(model_cfg, name=pm.name)
     else:
-        model_cfg = CATALOG.get(pm.name) or ModelConfig.tiny(
-            name=pm.name, **pm.model_overrides
-        )
+        model_cfg = CATALOG.get(pm.name)
+        if model_cfg is not None and pm.model_overrides:
+            # overrides apply to catalog configs too (shrink a catalog
+            # architecture for a dev mesh) — silently ignoring them
+            # would random-init the full-size model instead
+            model_cfg = dataclasses.replace(
+                model_cfg, **pm.model_overrides
+            )
+        if model_cfg is None:
+            model_cfg = ModelConfig.tiny(
+                name=pm.name, **pm.model_overrides
+            )
         params = init_params(model_cfg, jax.random.PRNGKey(0))
     if mesh is not None and not pm.checkpoint:
         # checkpoint branches place shard-wise inside the loaders; the

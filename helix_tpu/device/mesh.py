@@ -27,7 +27,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXIS_ORDER = ("dp", "fsdp", "ep", "sp", "tp")
+AXIS_ORDER = ("dp", "fsdp", "pp", "ep", "sp", "tp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,6 +42,7 @@ class MeshSpec:
 
     dp: int = 1
     fsdp: int = 1
+    pp: int = 1
     ep: int = 1
     sp: int = 1
     tp: int = 1
@@ -49,12 +50,15 @@ class MeshSpec:
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.fsdp * self.ep * self.sp * self.tp
+        return (
+            self.dp * self.fsdp * self.pp * self.ep * self.sp * self.tp
+        )
 
     def axis_sizes(self) -> dict[str, int]:
         return {
             "dp": self.dp,
             "fsdp": self.fsdp,
+            "pp": self.pp,
             "ep": self.ep,
             "sp": self.sp,
             "tp": self.tp,

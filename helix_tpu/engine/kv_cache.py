@@ -111,8 +111,12 @@ class PagedKVCache:
         if mesh is not None:
             from helix_tpu.parallel.sharding import logical_sharding
 
+            # leading L follows the pp layer sharding: each pipeline
+            # group holds ONLY its own layers' KV pages (KV dominates
+            # serving HBM; replicating it would forfeit most of pp's
+            # capacity win). Meshes without pp prune it to replicated.
             sharding = logical_sharding(
-                mesh, (None, "pages", None, "cache_heads", None)
+                mesh, ("layers", "pages", None, "cache_heads", None)
             )
             zeros = jax.jit(
                 lambda: jnp.zeros(shape, dtype), out_shardings=(sharding)

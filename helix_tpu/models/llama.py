@@ -112,34 +112,39 @@ def init_params(
 
 
 def param_logical_axes(cfg: ModelConfig) -> Any:
-    """Tree of logical-axis tuples matching ``init_params`` (leading "layers"
-    axis on stacked weights is unsharded)."""
+    """Tree of logical-axis tuples matching ``init_params``.
+
+    The leading stacked-layer axis carries the "layers" logical name on
+    EVERY weight: it prunes to replicated on meshes without a pp axis,
+    and shards layer blocks across pipeline groups on ``mesh: {pp: N}``
+    — a new stacked weight must use "layers" too or it silently
+    replicates across the pipeline."""
     lax_ = {
-        "attn_norm": {"weight": (None, None)},
-        "mlp_norm": {"weight": (None, None)},
-        "wq": {"weight": (None, "embed", "heads")},
-        "wk": {"weight": (None, "embed", "kv_heads")},
-        "wv": {"weight": (None, "embed", "kv_heads")},
-        "wo": {"weight": (None, "heads", "embed")},
-        "w_gate": {"weight": (None, "embed", "mlp")},
-        "w_up": {"weight": (None, "embed", "mlp")},
-        "w_down": {"weight": (None, "mlp", "embed")},
+        "attn_norm": {"weight": ("layers", None)},
+        "mlp_norm": {"weight": ("layers", None)},
+        "wq": {"weight": ("layers", "embed", "heads")},
+        "wk": {"weight": ("layers", "embed", "kv_heads")},
+        "wv": {"weight": ("layers", "embed", "kv_heads")},
+        "wo": {"weight": ("layers", "heads", "embed")},
+        "w_gate": {"weight": ("layers", "embed", "mlp")},
+        "w_up": {"weight": ("layers", "embed", "mlp")},
+        "w_down": {"weight": ("layers", "mlp", "embed")},
     }
     if cfg.num_experts > 0:
         del lax_["w_gate"], lax_["w_up"], lax_["w_down"]
-        lax_["router"] = {"weight": (None, "embed", None)}
+        lax_["router"] = {"weight": ("layers", "embed", None)}
         lax_["experts"] = {
-            "w_gate": {"weight": (None, "expert", "embed", "mlp")},
-            "w_up": {"weight": (None, "expert", "embed", "mlp")},
-            "w_down": {"weight": (None, "expert", "mlp", "embed")},
+            "w_gate": {"weight": ("layers", "expert", "embed", "mlp")},
+            "w_up": {"weight": ("layers", "expert", "embed", "mlp")},
+            "w_down": {"weight": ("layers", "expert", "mlp", "embed")},
         }
     if cfg.attention_bias:
-        lax_["wq"]["bias"] = (None, "heads")
-        lax_["wk"]["bias"] = (None, "kv_heads")
-        lax_["wv"]["bias"] = (None, "kv_heads")
+        lax_["wq"]["bias"] = ("layers", "heads")
+        lax_["wk"]["bias"] = ("layers", "kv_heads")
+        lax_["wv"]["bias"] = ("layers", "kv_heads")
     if cfg.qk_norm:
-        lax_["q_norm"] = {"weight": (None, None)}
-        lax_["k_norm"] = {"weight": (None, None)}
+        lax_["q_norm"] = {"weight": ("layers", None)}
+        lax_["k_norm"] = {"weight": ("layers", None)}
     axes = {
         "embed": {"weight": ("vocab", "embed")},
         "layers": lax_,

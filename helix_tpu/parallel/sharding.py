@@ -33,6 +33,12 @@ LOGICAL_RULES: dict[str, Any] = {
     "head_dim": None,
     "mlp": "tp",
     "expert": "ep",
+    # stacked-layer leading axis: sharding it over a pp mesh axis IS
+    # pipeline-parallel placement — each pp group holds a contiguous
+    # block of layers and the lax.scan's per-layer slice makes XLA move
+    # the activations between groups (inference pipelining for models
+    # that exceed one chip group's HBM)
+    "layers": "pp",
     # kv cache
     "cache_batch": ("dp", "fsdp"),
     "cache_heads": "tp",
