@@ -212,7 +212,11 @@ class DevSandboxService:
         self._lock = threading.Lock()
 
     def create(self, org_id: str, name: str = "",
-               with_desktop: bool = False, **limits) -> DevSandbox:
+               with_desktop: bool = False,
+               init_script: str = "", **limits) -> DevSandbox:
+        """init_script: shell run in the fresh workspace before the
+        sandbox is handed over (the reference's sandbox container init
+        scripts — toolchain setup, repo clone, env priming)."""
         # quota check + registration under ONE lock hold (two concurrent
         # creates must not both pass the count and overshoot the quota);
         # sandbox construction is local mkdir work, cheap enough to hold
@@ -242,6 +246,8 @@ class DevSandboxService:
             if desktop is not None:
                 self.desktops.destroy(desktop.id)
             raise
+        if init_script:
+            sb.run_command(init_script)   # async; status via /commands
         return sb
 
     def get(self, sid: str) -> Optional[DevSandbox]:

@@ -238,3 +238,19 @@ class TestSandboxHTTP:
         asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
             run()
         )
+
+
+class TestInitScript:
+    def test_init_script_primes_the_workspace(self, tmp_path):
+        """SURVEY #35: sandbox init scripts — the fresh workspace is
+        primed before handover and the init command is observable."""
+        svc = DevSandboxService(str(tmp_path))
+        sb = svc.create(
+            "org1", name="primed",
+            init_script="mkdir -p tools && echo ready > tools/marker",
+        )
+        init_cmd = next(iter(sb.commands.values()))
+        assert _wait(lambda: init_cmd.status != "running")
+        assert init_cmd.exit_code == 0
+        assert sb.read_file("tools/marker") == b"ready\n"
+        svc.stop_all()
