@@ -526,6 +526,7 @@ def _cmd_sft(args) -> int:
             save_checkpoint(
                 args.output, trainer.step_num, trainer.lora_params,
                 trainer.opt_state,
+                lora_scaling=trainer.cfg.lora.scaling,
             )
 
     trainer.train(
@@ -536,6 +537,7 @@ def _cmd_sft(args) -> int:
         save_checkpoint(
             args.output, trainer.step_num, trainer.lora_params,
             trainer.opt_state,
+            lora_scaling=trainer.cfg.lora.scaling,
         )
         if rank0:
             print(f"saved adapters to {args.output}")

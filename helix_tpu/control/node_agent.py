@@ -189,6 +189,43 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         else:
             params = jax.jit(quantize_params, donate_argnums=0)(params)
 
+    if pm.adapter:
+        # LoRA adapter serving: graft a trained adapter onto the base —
+        # the low-rank matmul rides every projection at apply time
+        # (ops/quant.py maybe_dequant_dense), so int8 bases work and the
+        # adapter stays hot-swappable with the profile
+        from helix_tpu.training.checkpoint import restore_checkpoint
+        from helix_tpu.training.lora import (
+            lora_logical_axes,
+            merge_lora_into_params,
+        )
+
+        # NOTE: this restores the full checkpoint (incl. the optimizer
+        # moments, ~2x adapter bytes) — orbax partial restore needs a
+        # matching target tree we don't have before reading; adapters
+        # are small next to base weights, so the extra I/O is accepted
+        restored = restore_checkpoint(pm.adapter)
+        if restored is None:
+            raise ValueError(
+                f"adapter checkpoint not found at {pm.adapter!r}"
+            )
+        lora_params = restored["lora_params"]
+        # serve at the strength the adapter was TRAINED at (alpha/rank,
+        # stored in the checkpoint); an explicit profile adapter_scale
+        # overrides
+        scaling = pm.adapter_scale
+        if scaling is None:
+            scaling = float(restored.get("lora_scaling") or 0) or 1.0
+        if mesh is not None:
+            from helix_tpu.parallel.sharding import shard_params
+
+            lora_params = shard_params(
+                lora_params, mesh, lora_logical_axes(lora_params)
+            )
+        params = merge_lora_into_params(
+            params, lora_params, scaling=scaling
+        )
+
     ekw = dict(pm.engine)
     if pm.context_length and "max_model_len" not in ekw:
         # honour the profile's context_length (the vLLM --max-model-len

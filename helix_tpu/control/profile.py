@@ -50,6 +50,14 @@ class ProfileModel:
     checkpoint: Optional[str] = None     # dir with safetensors; None = random-init
     kind: str = "chat"     # chat | embedding | vision | vision-embedding
     quantization: Optional[str] = None   # None | "int8"
+    # LoRA adapter serving: an orbax checkpoint dir written by
+    # `helix-tpu sft --output` — grafted onto the base weights at apply
+    # (the low-rank matmul rides every projection at runtime, so int8
+    # bases work too)
+    adapter: Optional[str] = None
+    # None = apply at the checkpoint's trained alpha/rank scaling; set a
+    # number to override
+    adapter_scale: Optional[float] = None
     mesh: MeshSpec = dataclasses.field(default_factory=MeshSpec)
     engine: dict = dataclasses.field(default_factory=dict)
     context_length: Optional[int] = None
@@ -77,6 +85,12 @@ class ProfileModel:
             checkpoint=d.get("checkpoint"),
             kind=d.get("kind", "chat"),
             quantization=d.get("quantization"),
+            adapter=d.get("adapter"),
+            adapter_scale=(
+                float(d["adapter_scale"])
+                if d.get("adapter_scale") is not None
+                else None
+            ),
             mesh=MeshSpec.from_dict(d.get("mesh", {})),
             engine=dict(d.get("engine", {})),
             context_length=d.get("context_length"),
@@ -90,6 +104,8 @@ class ProfileModel:
             "checkpoint": self.checkpoint,
             "kind": self.kind,
             "quantization": self.quantization,
+            "adapter": self.adapter,
+            "adapter_scale": self.adapter_scale,
             "mesh": self.mesh.to_dict(),
             "engine": dict(self.engine),
             "context_length": self.context_length,

@@ -2768,7 +2768,7 @@ class ControlPlane:
                 lambda: self.dev_sandboxes.create(
                     oid, name=body.get("name", ""),
                     with_desktop=bool(body.get("with_desktop")),
-                    init_script=str(body.get("init_script", "")),
+                    init_script=str(body.get("init_script") or ""),
                 ),
             )
         except RuntimeError as e:

@@ -27,8 +27,13 @@ def save_checkpoint(
     lora_params,
     opt_state,
     keep_last: int = 3,
+    lora_scaling: float = 0.0,
 ) -> str:
-    """Atomic save of {adapters, optimizer, step}; prunes old steps."""
+    """Atomic save of {adapters, optimizer, step}; prunes old steps.
+
+    ``lora_scaling`` (alpha/rank) rides along so SERVING applies the
+    adapter at the strength it was trained at — without it the operator
+    would have to remember alpha/rank and set adapter_scale by hand."""
     import orbax.checkpoint as ocp
 
     os.makedirs(directory, exist_ok=True)
@@ -40,6 +45,7 @@ def save_checkpoint(
             "lora_params": lora_params,
             "opt_state": opt_state,
             "step": step,
+            "lora_scaling": float(lora_scaling),
         },
         force=True,
     )
@@ -91,8 +97,14 @@ def resume_trainer(trainer, directory: str) -> bool:
         "lora_params": trainer.lora_params,
         "opt_state": trainer.opt_state,
         "step": trainer.step_num,
+        "lora_scaling": 0.0,
     }
-    restored = restore_checkpoint(directory, target=target)
+    try:
+        restored = restore_checkpoint(directory, target=target)
+    except ValueError:
+        # checkpoints written before lora_scaling existed
+        del target["lora_scaling"]
+        restored = restore_checkpoint(directory, target=target)
     if restored is None:
         return False
     trainer.lora_params = restored["lora_params"]
