@@ -280,20 +280,6 @@ class ControlPlane:
         self.controller.metasearch = self.metasearch
         self.controller.browser_pool = self.browser_pool
 
-        # org dev sandboxes (interactive command/file/screenshot surface)
-        from helix_tpu.services.dev_sandbox import DevSandboxService
-
-        sbx_root = (
-            tempfile_dir()
-            if db_path == ":memory:"
-            else _os.path.join(
-                _os.path.dirname(_os.path.abspath(db_path)) or ".",
-                "helix-sandboxes",
-            )
-        )
-        self.dev_sandboxes = DevSandboxService(
-            sbx_root, desktops=self.desktops
-        )
 
         def make_emitter(task, mode):
             """Stream a task agent's steps into a watchable desktop session
@@ -468,6 +454,22 @@ class ControlPlane:
             )
         )
         self.workspaces = WorkspaceManager(ws_root)
+
+        # org dev sandboxes (interactive command/file/screenshot surface;
+        # golden seeds ride the workspace manager)
+        from helix_tpu.services.dev_sandbox import DevSandboxService
+
+        sbx_root = (
+            tempfile_dir()
+            if db_path == ":memory:"
+            else _os.path.join(
+                _os.path.dirname(_os.path.abspath(db_path)) or ".",
+                "helix-sandboxes",
+            )
+        )
+        self.dev_sandboxes = DevSandboxService(
+            sbx_root, desktops=self.desktops, workspaces=self.workspaces
+        )
 
         self.orchestrator = SpecTaskOrchestrator(
             self.task_store, self.git, executor,
@@ -1024,6 +1026,13 @@ class ControlPlane:
             "/api/v1/orgs/{id}/sandboxes/{sid}/screenshot",
             self.sandbox_screenshot,
         )
+        r.add_post(
+            "/api/v1/orgs/{id}/sandboxes/{sid}/promote-golden",
+            self.sandbox_promote_golden,
+        )
+        # usage aggregation
+        r.add_get("/api/v1/users/{id}/stats", self.user_stats)
+        r.add_get("/api/v1/usage/org-summary", self.usage_org_summary)
         # question sets: standalone reusable questionnaires (reference
         # /question-sets family) — eval suites without an app binding
         r.add_get("/api/v1/question-sets", self.question_sets_list)
@@ -2018,6 +2027,51 @@ class ControlPlane:
         })
 
     # -- usage ---------------------------------------------------------------
+    async def user_stats(self, request):
+        """Per-user stats (reference /users/{}/stats): sessions, boards,
+        token usage."""
+        uid = request.match_info["id"]
+        u = self.auth.get_user(uid)
+        if u is None:
+            return _err(404, "user not found")
+        caller = request.get("user")
+        if self.auth_required and not (
+            caller and (caller.admin or caller.id == u.id)
+        ):
+            return _err(403, "your own stats only")
+        sessions = self.store.list_sessions(owner=u.id)
+        return web.json_response({
+            "user_id": u.id,
+            "sessions": len(sessions),
+            "usage": self.store.usage_summary(u.id),
+            "orgs": self.auth.list_orgs(u.id),
+        })
+
+    async def usage_org_summary(self, request):
+        """Aggregated token usage across an org's members (reference
+        /usage/org-summary)."""
+        oid = request.query.get("org", "")
+        if not oid:
+            return _err(400, "missing org")
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        totals: dict = {}
+        members = self.auth.org_members(oid)
+        for m in members:
+            for model, u in self.store.usage_summary(
+                m["user_id"]
+            ).items():
+                t = totals.setdefault(model, {
+                    "prompt_tokens": 0, "completion_tokens": 0,
+                    "requests": 0,
+                })
+                for k in t:
+                    t[k] += u[k]
+        return web.json_response({
+            "org": oid, "members": len(members), "by_model": totals,
+        })
+
     async def usage(self, request):
         return web.json_response(
             {"usage": self.store.usage_summary(request.query.get("owner"))}
@@ -2769,10 +2823,17 @@ class ControlPlane:
                     oid, name=body.get("name", ""),
                     with_desktop=bool(body.get("with_desktop")),
                     init_script=str(body.get("init_script") or ""),
+                    golden=self._org_golden_key(
+                        oid, str(body.get("golden") or "")
+                    ),
                 ),
             )
         except RuntimeError as e:
             return _err(429, str(e))
+        except KeyError as e:
+            return _err(404, str(e))
+        except ValueError as e:
+            return _err(400, str(e))
         return web.json_response(sb.to_dict(), status=201)
 
     async def sandbox_get(self, request):
@@ -2888,6 +2949,43 @@ class ControlPlane:
         return web.Response(
             body=data, content_type="application/octet-stream"
         )
+
+    @staticmethod
+    def _org_golden_key(oid: str, project: str) -> str:
+        """Sandbox goldens live in an ORG-scoped namespace: org A's admin
+        must not overwrite (or seed from) org B's snapshots — the golden
+        seeds every future workspace for that project."""
+        return f"{oid}--{project}" if project else ""
+
+    async def sandbox_promote_golden(self, request):
+        """Capture the sandbox workspace as a project's golden snapshot
+        (interactive promote-session-to-golden; org-admin gated)."""
+        oid = request.match_info["id"]
+        denied = self._org_admin_denied(request, oid)
+        if denied is not None:
+            return denied
+        sb = self._sandbox_or_none(request)
+        if sb is None:
+            return _err(404, "sandbox not found")
+        try:
+            body = await request.json()
+        except Exception:
+            return _err(400, "invalid JSON body")
+        project = body.get("project", "")
+        if not project:
+            return _err(400, "missing project")
+        try:
+            info = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: self.dev_sandboxes.promote_golden(
+                    sb.id, self._org_golden_key(oid, project)
+                ),
+            )
+        except ValueError as e:
+            return _err(400, str(e))
+        doc = info.to_dict()
+        doc["project"] = project   # report the caller's name, not the key
+        return web.json_response(doc, status=201)
 
     async def sandbox_screenshot(self, request):
         denied = self._org_member_denied(request, request.match_info["id"])

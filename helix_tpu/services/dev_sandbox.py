@@ -203,20 +203,25 @@ class DevSandbox:
 
 class DevSandboxService:
     def __init__(self, root: str, desktops=None,
-                 max_per_org: int = 8):
+                 max_per_org: int = 8, workspaces=None):
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.desktops = desktops          # DesktopManager (optional)
+        self.workspaces = workspaces      # WorkspaceManager: golden snaps
         self.max_per_org = max_per_org
         self._sandboxes: Dict[str, DevSandbox] = {}
         self._lock = threading.Lock()
 
     def create(self, org_id: str, name: str = "",
                with_desktop: bool = False,
-               init_script: str = "", **limits) -> DevSandbox:
+               init_script: str = "", golden: str = "",
+               **limits) -> DevSandbox:
         """init_script: shell run in the fresh workspace before the
         sandbox is handed over (the reference's sandbox container init
-        scripts — toolchain setup, repo clone, env priming)."""
+        scripts — toolchain setup, repo clone, env priming).
+        golden: a project whose golden snapshot seeds the workspace
+        (hardlink clone — warm toolchains/build caches for ~free, the
+        hydra golden.go posture)."""
         # quota check + registration under ONE lock hold (two concurrent
         # creates must not both pass the count and overshoot the quota);
         # sandbox construction is local mkdir work, cheap enough to hold
@@ -246,9 +251,39 @@ class DevSandboxService:
             if desktop is not None:
                 self.desktops.destroy(desktop.id)
             raise
+        if golden:
+            try:
+                if self.workspaces is None:
+                    raise ValueError(
+                        "no workspace manager for golden seeds"
+                    )
+                # BYTE copies, not hardlinks: this sandbox runs
+                # arbitrary user shell — aliased inodes would let it
+                # mutate the shared golden in place
+                self.workspaces.seed_from_golden(
+                    golden, sb.workspace, hardlink=False
+                )
+            except BaseException:
+                # a failed seed must not leak the registered sandbox
+                # (it would count against the org quota forever)
+                self.destroy(sb.id)
+                raise
         if init_script:
             sb.run_command(init_script)   # async; status via /commands
         return sb
+
+    def promote_golden(self, sid: str, project: str):
+        """Capture a sandbox's workspace as a project's golden snapshot
+        — the interactive half of promote-session-to-golden."""
+        sb = self.get(sid)
+        if sb is None:
+            raise KeyError(sid)
+        if self.workspaces is None:
+            raise ValueError("no workspace manager for golden snapshots")
+        # copy-mode: the source sandbox keeps running user shell
+        return self.workspaces.promote_golden(
+            project, sb.workspace, hardlink=False
+        )
 
     def get(self, sid: str) -> Optional[DevSandbox]:
         return self._sandboxes.get(sid)

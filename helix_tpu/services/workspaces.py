@@ -63,10 +63,13 @@ def _tree_stats(root: str) -> tuple:
     return files, size
 
 
-def clone_tree(src: str, dst: str) -> None:
-    """Hardlink-clone ``src`` into ``dst`` (same filesystem): directories
-    are recreated, regular files hardlinked, symlinks copied. Falls back
-    to a byte copy per file when linking fails (cross-device)."""
+def clone_tree(src: str, dst: str, hardlink: bool = True) -> None:
+    """Clone ``src`` into ``dst``: directories recreated, regular files
+    hardlinked (same filesystem; falls back to byte copy), symlinks
+    copied.  ``hardlink=False`` forces byte copies — REQUIRED whenever
+    either side will see in-place writes from arbitrary user shell
+    (interactive sandboxes): aliased inodes would let one side silently
+    mutate the other."""
     os.makedirs(dst, exist_ok=True)
     for r, dirs, files in os.walk(src):
         rel = os.path.relpath(r, src)
@@ -79,10 +82,13 @@ def clone_tree(src: str, dst: str) -> None:
             if os.path.islink(sp):
                 os.symlink(os.readlink(sp), tp)
                 continue
-            try:
-                os.link(sp, tp)
-            except OSError:
-                shutil.copy2(sp, tp)
+            if hardlink:
+                try:
+                    os.link(sp, tp)
+                    continue
+                except OSError:
+                    pass
+            shutil.copy2(sp, tp)
 
 
 class WorkspaceManager:
@@ -115,15 +121,28 @@ class WorkspaceManager:
     def _golden_dir(self, project: str) -> str:
         return os.path.join(self.golden_root, self._safe_name(project))
 
-    def promote_golden(self, project: str, workspace: str) -> GoldenInfo:
+    def seed_from_golden(self, project: str, dst: str,
+                         hardlink: bool = True) -> GoldenInfo:
+        """Populate ``dst`` from the project's golden snapshot; raises
+        KeyError when none exists.  Interactive consumers (dev sandboxes
+        running arbitrary shell) must pass hardlink=False."""
+        info = self.golden_info(project)
+        if info is None:
+            raise KeyError(f"no golden snapshot for {project!r}")
+        clone_tree(self._golden_dir(project), dst, hardlink=hardlink)
+        return info
+
+    def promote_golden(self, project: str, workspace: str,
+                       hardlink: bool = True) -> GoldenInfo:
         """Capture ``workspace`` as the project's golden snapshot
         (reference: promote-session-to-golden, hydra/golden.go:33-49).
-        Atomic swap: built next to the old snapshot, renamed over it."""
+        Atomic swap: built next to the old snapshot, renamed over it.
+        ``hardlink=False`` when the source keeps running user shell."""
         snap_id = f"gold-{uuid.uuid4().hex[:10]}"
         final = self._golden_dir(project)
         tmp = final + ".tmp"
         shutil.rmtree(tmp, ignore_errors=True)
-        clone_tree(workspace, tmp)
+        clone_tree(workspace, tmp, hardlink=hardlink)
         # never snapshot VCS-internal lock files mid-operation
         files, size = _tree_stats(tmp)
         info = GoldenInfo(

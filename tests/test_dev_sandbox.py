@@ -254,3 +254,85 @@ class TestInitScript:
         assert init_cmd.exit_code == 0
         assert sb.read_file("tools/marker") == b"ready\n"
         svc.stop_all()
+
+
+class TestGoldenSandboxes:
+    def test_promote_and_seed_from_golden(self, tmp_path):
+        """A sandbox's built environment promotes to a project golden;
+        the next sandbox starts warm from it (hydra golden.go loop)."""
+        from helix_tpu.services.workspaces import WorkspaceManager
+
+        wm = WorkspaceManager(str(tmp_path / "ws"))
+        svc = DevSandboxService(str(tmp_path / "sbx"), workspaces=wm)
+        sb1 = svc.create("org1", name="builder")
+        cmd = sb1.run_command(
+            "mkdir -p .cache && echo built > .cache/toolchain"
+        )
+        assert _wait(lambda: cmd.status != "running")
+        info = svc.promote_golden(sb1.id, "proj-x")
+        assert info.files >= 1
+
+        sb2 = svc.create("org1", name="warm", golden="proj-x")
+        assert sb2.read_file(".cache/toolchain") == b"built\n"
+        with pytest.raises(KeyError):
+            svc.create("org1", golden="no-such-project")
+        svc.stop_all()
+
+    def test_http_promote_and_usage_routes(self):
+        from helix_tpu.control.server import ControlPlane
+
+        cp = ControlPlane()
+
+        async def run():
+            from aiohttp.test_utils import TestClient, TestServer
+
+            client = TestClient(TestServer(cp.build_app()))
+            await client.start_server()
+            try:
+                u = cp.auth.create_user("g@x.com")
+                oid = cp.auth.create_org("g-org", u.id)
+                r = await client.post(
+                    f"/api/v1/orgs/{oid}/sandboxes",
+                    json={"init_script": "echo hi > seed.txt"},
+                )
+                sid = (await r.json())["id"]
+                sb = cp.dev_sandboxes.get(sid)
+                init_cmd = next(iter(sb.commands.values()))
+                for _ in range(100):
+                    if init_cmd.status != "running":
+                        break
+                    await asyncio.sleep(0.05)
+                r = await client.post(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid}/promote-golden",
+                    json={"project": "gold-proj"},
+                )
+                assert r.status == 201, await r.text()
+                assert (await r.json())["project"] == "gold-proj"
+                r = await client.post(
+                    f"/api/v1/orgs/{oid}/sandboxes",
+                    json={"golden": "gold-proj"},
+                )
+                assert r.status == 201
+                sid2 = (await r.json())["id"]
+                r = await client.get(
+                    f"/api/v1/orgs/{oid}/sandboxes/{sid2}/files",
+                    params={"path": "seed.txt"},
+                )
+                assert await r.read() == b"hi\n"
+
+                # usage routes
+                cp.store.add_usage(u.id, "m1", 10, 5)
+                r = await client.get(f"/api/v1/users/{u.id}/stats")
+                stats = await r.json()
+                assert stats["usage"]["m1"]["prompt_tokens"] == 10
+                r = await client.get("/api/v1/usage/org-summary",
+                                     params={"org": oid})
+                data = await r.json()
+                assert data["by_model"]["m1"]["completion_tokens"] == 5
+            finally:
+                cp.stop()
+                await client.close()
+
+        asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
+            run()
+        )
