@@ -2,7 +2,22 @@
  * product surface the apps carry). */
 import {$, $row, api, esc, setRefresh, tab, toast} from "./core.js";
 
+/* "question => expected substring" lines -> the backend's question docs
+ * (assertions[{type: contains}] — expected_contains is NOT a backend
+ * field; validate_suite_doc would drop it and every run would pass
+ * trivially). */
+export function parseQuestions(text) {
+  return text.split("\n").map(l => l.trim()).filter(Boolean)
+    .map(l => {
+      const [q, want] = l.split("=>").map(x => x.trim());
+      const doc = {question: q};
+      if (want) doc.assertions = [{type: "contains", value: want}];
+      return doc;
+    });
+}
+
 export async function render(m) {
+  await renderQuestionSets(m);
   const top = $(`<div class="panel row">
     <span class="id">app</span><select id="app" class="grow"></select></div>`);
   m.appendChild(top);
@@ -93,13 +108,7 @@ export async function render(m) {
   }
 
   suitePanel.querySelector("#sgo").onclick = async () => {
-    const questions = suitePanel.querySelector("#sq").value.split("\n")
-      .map(l => l.trim()).filter(Boolean)
-      .map(l => {
-        const [q, expect] = l.split("=>").map(x => x.trim());
-        return expect ? {question: q, expected_contains: expect}
-                      : {question: q};
-      });
+    const questions = parseQuestions(suitePanel.querySelector("#sq").value);
     await api(`/api/v1/apps/${appSel.value}/evaluation-suites`, {
       method:"POST", body: JSON.stringify({
         name: suitePanel.querySelector("#sn").value, questions})});
@@ -108,4 +117,68 @@ export async function render(m) {
   };
   refresh();
   setRefresh(() => { if (tab === "evals") refresh(); }, 5000);
+}
+
+export async function renderQuestionSets(m) {
+  const p = $(`<div class="panel"><h3>Question sets</h3>
+    <p class="id">Standalone reusable questionnaires; executions run
+    through the eval engine.</p>
+    <div class="row"><input id="qn" placeholder="set name">
+      <textarea id="qq" class="grow code" rows="2"
+        placeholder='one per line: "question => expected substring"'></textarea>
+      <button class="primary" id="qgo">Create</button></div>
+    <table id="qt"></table>
+    <div id="qe" style="margin-top:8px"></div></div>`);
+  m.appendChild(p);
+
+  async function showExecutions(qs) {
+    const qe = p.querySelector("#qe");
+    qe.textContent = "loading executions...";
+    const {executions} = await api(
+      `/api/v1/question-sets/${qs.id}/executions`
+    ).catch(() => ({executions: []}));
+    qe.innerHTML = `<h3>executions: ${esc(qs.name)}</h3>`;
+    for (const ex of executions.slice().reverse()) {
+      const sum = ex.summary || {};
+      const d = $(`<div class="id"></div>`);
+      d.textContent = `${ex.id}  ${ex.status}  ` +
+        (sum.total ? `${sum.passed || 0}/${sum.total} passed` : "");
+      qe.appendChild(d);
+    }
+    if (!executions.length) qe.innerHTML += `<div class="id">none yet</div>`;
+  }
+
+  async function refresh() {
+    const {question_sets} = await api("/api/v1/question-sets")
+      .catch(() => ({question_sets: []}));
+    const qt = p.querySelector("#qt");
+    qt.innerHTML = `<tr><th>name</th><th>questions</th><th></th></tr>`;
+    for (const qs of question_sets) {
+      const tr = $row(`<tr><td>${esc(qs.name)}</td>
+        <td>${(qs.questions || []).length}</td>
+        <td><button class="ghost run">execute</button>
+            <button class="ghost del">delete</button></td></tr>`);
+      tr.querySelector(".run").onclick = async () => {
+        await api(`/api/v1/question-sets/${qs.id}/executions`,
+                  {method: "POST", body: "{}"});
+        showExecutions(qs);
+      };
+      tr.querySelector("td:first-child").style.cursor = "pointer";
+      tr.querySelector("td:first-child").onclick =
+        () => showExecutions(qs);
+      tr.querySelector(".del").onclick = async () => {
+        await api(`/api/v1/question-sets/${qs.id}`, {method: "DELETE"});
+        refresh();
+      };
+      qt.appendChild(tr);
+    }
+  }
+  p.querySelector("#qgo").onclick = async () => {
+    const questions = parseQuestions(p.querySelector("#qq").value);
+    await api("/api/v1/question-sets", {method: "POST",
+      body: JSON.stringify({name: p.querySelector("#qn").value,
+                            questions})});
+    refresh();
+  };
+  refresh();
 }

@@ -3,6 +3,7 @@
 import {$, $row, api, esc} from "./core.js";
 
 export async function render(m) {
+  await renderServiceConnections(m);
   const p = $(`<div class="panel"><h3>OAuth connections</h3>
     <p class="id">Connect external accounts (GitHub, ...) — agents use the
     tokens for repo skills; knowledge sources use them for SharePoint.</p>
@@ -48,5 +49,46 @@ export async function render(m) {
         no OAuth providers configured (set HELIX_GITHUB_CLIENT_ID/SECRET)
         </td></tr>`));
   }
+  refresh();
+}
+
+export async function renderServiceConnections(m) {
+  const p = $(`<div class="panel"><h3>Service connections</h3>
+    <p class="id">Stored forge credentials (tokens encrypted at rest) —
+    forge sync and repo import resolve them here.</p>
+    <div class="row"><select id="sp"><option>github</option>
+      <option>gitlab</option><option>generic</option></select>
+      <input id="sn" placeholder="name">
+      <input id="st" class="grow" placeholder="token" type="password">
+      <button class="primary" id="sgo">Add</button></div>
+    <table id="sc"></table></div>`);
+  m.appendChild(p);
+
+  async function refresh() {
+    const {connections} = await api("/api/v1/service-connections")
+      .catch(() => ({connections: []}));
+    const sc = p.querySelector("#sc");
+    sc.innerHTML = `<tr><th>name</th><th>provider</th><th>api</th><th></th></tr>`;
+    for (const c of connections) {
+      const tr = $row(`<tr><td>${esc(c.name)}</td><td>${esc(c.provider)}</td>
+        <td class="id">${esc(c.api_base || "")}</td>
+        <td><button class="ghost del">remove</button></td></tr>`);
+      tr.querySelector(".del").onclick = async () => {
+        await api(`/api/v1/service-connections/${c.id}`, {method: "DELETE"});
+        refresh();
+      };
+      sc.appendChild(tr);
+    }
+  }
+  p.querySelector("#sgo").onclick = async () => {
+    await api("/api/v1/service-connections", {method: "POST",
+      body: JSON.stringify({
+        provider: p.querySelector("#sp").value,
+        name: p.querySelector("#sn").value,
+        token: p.querySelector("#st").value,
+      })});
+    p.querySelector("#st").value = "";
+    refresh();
+  };
   refresh();
 }
