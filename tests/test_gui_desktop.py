@@ -313,3 +313,50 @@ class TestGuiStreamE2E:
         asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
             run()
         )
+
+
+class TestVideoCodecRobustness:
+    """Decoder hardening: malformed packets reject cleanly, never crash."""
+
+    def test_corrupt_packets_rejected(self):
+        f = screen_frame()
+        enc = VideoEncoder(320, 200)
+        dec = VideoDecoder(320, 200)
+        good = enc.encode(f)
+        # wrong magic
+        with pytest.raises(RuntimeError):
+            dec.decode(b"XXXX" + good[4:])
+        # truncated header
+        with pytest.raises(RuntimeError):
+            dec.decode(good[:10])
+        # wrong dimensions
+        dec2 = VideoDecoder(64, 64)
+        with pytest.raises(RuntimeError):
+            dec2.decode(good)
+        # corrupted zlib payload
+        with pytest.raises(RuntimeError):
+            dec.decode(good[:30] + b"\x00" * (len(good) - 30))
+        # after all that, a clean keyframe still decodes
+        out = dec.decode(enc.encode(f, keyframe=True))
+        assert psnr(out, f) > 30
+
+    def test_long_stream_stays_synced(self):
+        """200 frames of drifting content: decoder tracks encoder exactly
+        (PSNR never collapses, keyframe cadence honoured)."""
+        enc = VideoEncoder(160, 120, quality=70, kf_interval=50)
+        dec = VideoDecoder(160, 120)
+        f = np.zeros((120, 160, 4), np.uint8)
+        f[..., 3] = 255
+        worst = 99.0
+        kf_seen = 0
+        for i in range(200):
+            # a moving block + slow background drift
+            f[..., :3] = (f[..., :3].astype(int) + 1) % 250
+            x = (i * 7) % 120
+            f[40:80, x:x + 30, :3] = (250, 40, 40)
+            out = dec.decode(enc.encode(f))
+            if dec.frame_type == "I":
+                kf_seen += 1
+            worst = min(worst, psnr(out, f))
+        assert worst > 22, worst
+        assert kf_seen >= 4     # 200 frames / kf_interval 50

@@ -291,3 +291,36 @@ class TestZedAndMCPRoutes:
         asyncio.get_event_loop_policy().new_event_loop().run_until_complete(
             run()
         )
+
+
+class TestMCPStdioTransport:
+    def test_serve_stdio_loop(self, monkeypatch, capsys):
+        """The stdio transport (what editors/MCPClient spawn): newline-
+        delimited JSON-RPC in, responses out, notifications silent,
+        garbage skipped."""
+        import io
+
+        from helix_tpu.desktop import mcp_server
+
+        src, _ = build_agent_desktop()
+        lines = "\n".join([
+            '{"jsonrpc":"2.0","id":1,"method":"initialize","params":{}}',
+            "not json at all",
+            '{"jsonrpc":"2.0","method":"notifications/initialized"}',
+            '{"jsonrpc":"2.0","id":2,"method":"tools/list"}',
+            "",
+        ])
+        monkeypatch.setattr("sys.stdin", io.StringIO(lines))
+        mcp_server.serve_stdio(_FakeSession(src))
+        out = [
+            json.loads(l)
+            for l in capsys.readouterr().out.splitlines() if l.strip()
+        ]
+        # exactly two responses: initialize + tools/list (garbage and the
+        # notification produce nothing)
+        assert [o["id"] for o in out] == [1, 2]
+        assert out[0]["result"]["serverInfo"]["name"] == "helix-desktop"
+        assert any(
+            t["name"] == "screenshot"
+            for t in out[1]["result"]["tools"]
+        )
