@@ -1512,8 +1512,26 @@ class ControlPlane:
         )
         return web.json_response({"id": sid})
 
+    def _session_denied(self, request, session):
+        """Owner-or-admin gate shared by the session read/write routes —
+        gating only update/search while list/get/delete stay open would
+        leave the same leak one sibling endpoint away."""
+        if self.auth_required and not self.auth.authorize(
+            request.get("user"), resource_owner=session.get("owner", "")
+        ):
+            return _err(403, "not your session")
+        return None
+
     async def list_sessions(self, request):
         owner = request.query.get("owner")
+        if self.auth_required:
+            # non-admins list ONLY their own sessions (names leak other
+            # users' activity); admins may scope to anyone or list all
+            user = request.get("user")
+            if user is None:
+                return _err(401, "authentication required")
+            if not user.admin:
+                owner = user.id
         return web.json_response(
             {"sessions": self.store.list_sessions(owner)}
         )
@@ -1522,18 +1540,34 @@ class ControlPlane:
         s = self.store.get_session(request.match_info["id"])
         if s is None:
             return _err(404, "session not found")
+        denied = self._session_denied(request, s)
+        if denied is not None:
+            return denied
         s["interactions"] = self.store.list_interactions(s["id"])
         return web.json_response(s)
 
     async def delete_session(self, request):
+        s = self.store.get_session(request.match_info["id"])
+        if s is None:
+            return _err(404, "session not found")
+        denied = self._session_denied(request, s)
+        if denied is not None:
+            return denied
         self.store.delete_session(request.match_info["id"])
         return web.json_response({"ok": True})
 
     async def update_session(self, request):
-        """Rename and/or replace the session doc."""
+        """Rename and/or replace the session doc.  Writes are owner-or-
+        admin gated: a session's doc binds provider/model/app for every
+        later interaction, so letting any caller rewrite it would hijack
+        other users' chats."""
         sid = request.match_info["id"]
-        if self.store.get_session(sid) is None:
+        session = self.store.get_session(sid)
+        if session is None:
             return _err(404, "session not found")
+        denied = self._session_denied(request, session)
+        if denied is not None:
+            return denied
         body = await request.json()
         if body.get("name"):
             self.store.rename_session(sid, str(body["name"]))
@@ -1545,10 +1579,18 @@ class ControlPlane:
         q = request.query.get("q", "")
         if not q:
             return _err(400, "missing q")
+        owner = request.query.get("owner")
+        if self.auth_required:
+            # non-admins search ONLY their own sessions regardless of the
+            # owner param (session names/docs leak other users' activity);
+            # admins may scope to any owner or search globally
+            user = request.get("user")
+            if user is None:
+                return _err(401, "authentication required")
+            if not user.admin:
+                owner = user.id
         return web.json_response({
-            "sessions": self.store.search_sessions(
-                q, owner=request.query.get("owner")
-            )
+            "sessions": self.store.search_sessions(q, owner=owner)
         })
 
     async def session_chat(self, request):
@@ -4085,10 +4127,19 @@ class ControlPlane:
 
     async def session_claude_credentials(self, request):
         """Mint a session-bound credential handle for the user's Claude
-        subscription (the raw OAuth token never rides the session wire)."""
+        subscription (the raw OAuth token never rides the session wire).
+
+        The session must BELONG to the caller (or the caller is admin):
+        a credential handle minted against someone else's session would
+        let that session's traffic bill the caller's subscription — or
+        let the caller attach their token to a session they can't see."""
         sid = request.match_info["id"]
-        if self.store.get_session(sid) is None:
+        session = self.store.get_session(sid)
+        if session is None:
             return _err(404, "session not found")
+        denied = self._session_denied(request, session)
+        if denied is not None:
+            return denied
         owner = self._user_id(request)
         subs = self._subs().list(owner, vendor="claude")
         if not subs:

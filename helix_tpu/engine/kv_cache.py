@@ -44,21 +44,32 @@ class CacheConfig:
     num_pages: int
     page_size: int = 16
     max_pages_per_seq: int = 128
+    # Page-pool storage dtype.  "int8" stores K/V codes at 1 byte/elem
+    # plus per-(slot, kv-head) f32 scale pools — page bytes drop to
+    # (D + 4) / (2 * D) of bf16, so ``fit_hbm`` admits ~1.94x the pages
+    # at head_dim 128 (the decode-throughput lever: batch is page-bound).
     dtype: str = "bfloat16"
+
+    @property
+    def quantized(self) -> bool:
+        return self.dtype == "int8"
 
     @property
     def max_seq_len(self) -> int:
         return self.page_size * self.max_pages_per_seq
 
     def page_bytes(self, model: ModelConfig) -> int:
-        return (
+        per_elem = (
             2
             * model.num_layers
             * self.page_size
             * model.num_kv_heads
-            * model.head_dim
-            * jnp.dtype(self.dtype).itemsize
         )
+        total = per_elem * model.head_dim * jnp.dtype(self.dtype).itemsize
+        if self.quantized:
+            # f32 scale per (token slot, kv head), for K and V pools
+            total += per_elem * 4
+        return total
 
     def total_bytes(self, model: ModelConfig) -> int:
         return self.num_pages * self.page_bytes(model)
@@ -70,28 +81,41 @@ class CacheConfig:
         hbm_budget_bytes: int,
         page_size: int = 16,
         max_pages_per_seq: int = 128,
+        dtype: str = "bfloat16",
     ) -> "CacheConfig":
         """Size the page pool to an HBM budget (what's left after weights) —
         the accounting the reference does per-GPU with
-        ``--gpu-memory-utilization`` on vLLM, done natively here."""
+        ``--gpu-memory-utilization`` on vLLM, done natively here.
+        ``dtype="int8"`` budgets codes + scale pools, admitting
+        ``2*D/(D+4)`` (~1.94x at head_dim 128) the bf16 pages."""
         probe = cls(num_pages=1, page_size=page_size,
-                    max_pages_per_seq=max_pages_per_seq)
+                    max_pages_per_seq=max_pages_per_seq, dtype=dtype)
         per_page = probe.page_bytes(model)
         num_pages = max(hbm_budget_bytes // per_page, 0)
         return cls(
             num_pages=int(num_pages),
             page_size=page_size,
             max_pages_per_seq=max_pages_per_seq,
+            dtype=dtype,
         )
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class PagedKVCache:
-    """Device page pool (a pytree — passes through jit with donation)."""
+    """Device page pool (a pytree — passes through jit with donation).
+
+    With an int8 pool the per-(slot, head) f32 scale pools ``k_scale`` /
+    ``v_scale`` (shape ``[L, N, P, KVH]``) ride along; they are ``None``
+    for full-precision pools so the pytree structure itself encodes the
+    storage mode (jit re-traces on the structural change, no static flag
+    needed).
+    """
 
     k_pages: jax.Array  # [L, N, P, KVH, D]
     v_pages: jax.Array
+    k_scale: Optional[jax.Array] = None  # [L, N, P, KVH] f32 (int8 pools)
+    v_scale: Optional[jax.Array] = None
 
     @classmethod
     def create(
@@ -107,6 +131,7 @@ class PagedKVCache:
             model.num_kv_heads,
             model.head_dim,
         )
+        sshape = shape[:-1]
         dtype = jnp.dtype(cache.dtype)
         if mesh is not None:
             from helix_tpu.parallel.sharding import logical_sharding
@@ -123,17 +148,56 @@ class PagedKVCache:
             )
             k = zeros()
             v = zeros()
+            if cache.quantized:
+                ssharding = logical_sharding(
+                    mesh, ("layers", "pages", None, "cache_heads")
+                )
+                szeros = jax.jit(
+                    lambda: jnp.zeros(sshape, jnp.float32),
+                    out_shardings=(ssharding),
+                )
+                return cls(
+                    k_pages=k, v_pages=v, k_scale=szeros(),
+                    v_scale=szeros(),
+                )
         else:
             k = jnp.zeros(shape, dtype)
             v = jnp.zeros(shape, dtype)
+            if cache.quantized:
+                return cls(
+                    k_pages=k,
+                    v_pages=v,
+                    k_scale=jnp.zeros(sshape, jnp.float32),
+                    v_scale=jnp.zeros(sshape, jnp.float32),
+                )
         return cls(k_pages=k, v_pages=v)
 
     @property
     def num_layers(self):
         return self.k_pages.shape[0]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
     def layer_view(self, layer: int):
         return self.k_pages[layer], self.v_pages[layer]
+
+    def carry(self):
+        """The pytree threaded through decode scans / prefill xs: pools
+        plus scale pools when quantized (leaves all carry a leading L)."""
+        if self.k_scale is None:
+            return (self.k_pages, self.v_pages)
+        return (self.k_pages, self.v_pages, self.k_scale, self.v_scale)
+
+    @classmethod
+    def from_carry(cls, carry) -> "PagedKVCache":
+        if len(carry) == 2:
+            return cls(k_pages=carry[0], v_pages=carry[1])
+        return cls(
+            k_pages=carry[0], v_pages=carry[1],
+            k_scale=carry[2], v_scale=carry[3],
+        )
 
 
 def write_kv(
@@ -148,6 +212,9 @@ def write_kv(
 
     Padding tokens are routed to a reserved scratch page (page 0 is kept as
     the engine's garbage page) so the scatter stays fully dense.
+
+    Int8 pools quantize here (per-slot-per-head absmax scales) and scatter
+    the f32 scale rows into the scale pools with the same fused index.
     """
     L, B, S, KVH, D = k_new.shape
     Lp, P, ps, KVHp, Dp = cache.k_pages.shape
@@ -161,6 +228,12 @@ def write_kv(
     flat_idx = jnp.where(
         valid, pages * ps + offsets, 0
     ).reshape(-1)
+    k_sc = v_sc = None
+    if cache.quantized:
+        from helix_tpu.ops.quant import quantize_kv
+
+        k_new, k_sc = quantize_kv(k_new)   # int8 + [L, B, S, KVH] f32
+        v_new, v_sc = quantize_kv(v_new)
     kf = k_new.reshape(L, B * S, KVH, D).astype(cache.k_pages.dtype)
     vf = v_new.reshape(L, B * S, KVH, D).astype(cache.v_pages.dtype)
     k_pages = (
@@ -175,7 +248,26 @@ def write_kv(
         .set(vf, mode="drop", unique_indices=False)
         .reshape(Lp, P, ps, KVHp, Dp)
     )
-    return PagedKVCache(k_pages=k_pages, v_pages=v_pages)
+    if not cache.quantized:
+        return PagedKVCache(k_pages=k_pages, v_pages=v_pages)
+    k_scale = (
+        cache.k_scale.reshape(Lp, P * ps, KVHp)
+        .at[:, flat_idx]
+        .set(k_sc.reshape(L, B * S, KVH), mode="drop",
+             unique_indices=False)
+        .reshape(Lp, P, ps, KVHp)
+    )
+    v_scale = (
+        cache.v_scale.reshape(Lp, P * ps, KVHp)
+        .at[:, flat_idx]
+        .set(v_sc.reshape(L, B * S, KVH), mode="drop",
+             unique_indices=False)
+        .reshape(Lp, P, ps, KVHp)
+    )
+    return PagedKVCache(
+        k_pages=k_pages, v_pages=v_pages,
+        k_scale=k_scale, v_scale=v_scale,
+    )
 
 
 class PageAllocator:

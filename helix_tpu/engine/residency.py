@@ -77,7 +77,7 @@ def served_model_bytes(m: ServedModel, headroom: float = 0.10) -> int:
     if m.loop is not None:
         eng = m.loop.engine
         total += tree_bytes(eng.params)
-        total += tree_bytes((eng.cache.k_pages, eng.cache.v_pages))
+        total += tree_bytes(eng.cache.carry())  # pools + int8 scale pools
     elif m.embedder is not None:
         total += tree_bytes(m.embedder.params)
     return int(total * (1 + headroom))

@@ -171,6 +171,10 @@ def _layer(
     protocol — the paged pool threads through the layer scan and the
     kernel updates it in place), the new cache is returned as the third
     element; plain attn_fns (prefill) return the output alone.
+
+    The fourth return is the layer's MoE capacity-overflow drop count
+    (int32 scalar, 0 for dense layers) — threaded out of the scan so the
+    engine can surface silently-dropped routing work in its stats.
     """
     B, S, E = h.shape
     H, KVH, D = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -197,6 +201,7 @@ def _layer(
     # --- mlp ---
     x = rms_norm(h, p["mlp_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
     act = _act(cfg.hidden_act)
+    moe_dropped = jnp.int32(0)
     if cfg.num_experts > 0:
         from helix_tpu.models.moe import moe_ffn
 
@@ -207,15 +212,17 @@ def _layer(
             router_w = router_w.astype(jnp.float32) * p["router"][
                 "scale"
             ].astype(jnp.float32)
-        h = h + moe_ffn(
+        moe_out, moe_dropped = moe_ffn(
             x, router_w, p["experts"], cfg, act,
             token_mask=moe_token_mask,
+            return_dropped=True,
         )
+        h = h + moe_out
     else:
         gate = _dense(x, p["w_gate"])
         up = _dense(x, p["w_up"])
         h = h + _dense(act(gate) * up, p["w_down"])
-    return h, (k, v), new_cache
+    return h, (k, v), new_cache, moe_dropped
 
 
 def scan_decoder_blocks(
@@ -225,36 +232,45 @@ def scan_decoder_blocks(
     the Qwen2-VL mrope tower share this so the two protocols cannot
     diverge).
 
-    ``block(h, layer_params, layer_cache) -> (h, (k, v), new_cache)``.
+    ``block(h, layer_params, layer_cache) -> (h, (k, v), new_cache,
+    moe_dropped)``.
 
     - xs mode (``layer_caches`` or no cache): the scan slices a per-layer
-      cache view; returns (h, kv) with kv stacked [L, ...] for the
-      caller's scatter.
+      cache view; returns (h, kv, moe_dropped) with kv stacked [L, ...]
+      for the caller's scatter.
     - carry mode (``carry_caches``): the full cache pytree threads through
       the scan carry and block's attn_fn receives ``(caches, layer_idx)``;
-      returns (h, final_caches).
+      returns (h, final_caches, moe_dropped).
+
+    ``moe_dropped`` is the int32 total of MoE capacity-overflow drops
+    summed over all layers (0 for dense towers).
     """
     if carry_caches is not None:
         def carry_body(carry, xs):
-            h, caches = carry
+            h, caches, drops = carry
             layer_params, lyr = xs
-            h, _, caches = block(h, layer_params, (caches, lyr))
-            return (h, caches), None
+            h, _, caches, d = block(h, layer_params, (caches, lyr))
+            return (h, caches, drops + d), None
 
         xs = (layers_params, jnp.arange(num_layers, dtype=jnp.int32))
-        (h, kv), _ = jax.lax.scan(carry_body, (h, carry_caches), xs)
+        (h, kv, dropped), _ = jax.lax.scan(
+            carry_body, (h, carry_caches, jnp.int32(0)), xs
+        )
     else:
         def scan_body(h, xs):
             layer_params, layer_cache = xs
-            h, kv, _ = block(h, layer_params, layer_cache)
-            return h, kv
+            h, kv, _, d = block(h, layer_params, layer_cache)
+            return h, (kv, d)
 
         if layer_caches is None:
             # lax.scan needs every xs leaf to have a leading L dim; "no
             # history" is a zero-length dummy the attn_fn never touches.
             layer_caches = jnp.zeros((num_layers, 0), jnp.int32)
-        h, kv = jax.lax.scan(scan_body, h, (layers_params, layer_caches))
-    return h, kv
+        h, (kv, drops) = jax.lax.scan(
+            scan_body, h, (layers_params, layer_caches)
+        )
+        dropped = jnp.sum(drops)
+    return h, kv, dropped
 
 
 def forward(
@@ -269,6 +285,8 @@ def forward(
     return_hidden: bool = False,
     moe_token_mask=None,  # [B, S] bool: MoE routing validity (padding /
                           # inactive decode slots never consume capacity)
+    return_moe_stats: bool = False,  # also return {"dropped": int32} —
+                          # MoE capacity-overflow drops summed over layers
 ):
     """Run the decoder.
 
@@ -297,7 +315,7 @@ def forward(
             attn_fn, moe_token_mask=moe_token_mask,
         )
 
-    h, kv = scan_decoder_blocks(
+    h, kv, moe_dropped = scan_decoder_blocks(
         h, params["layers"], cfg.num_layers, block, layer_caches,
         carry_caches,
     )
@@ -321,6 +339,8 @@ def forward(
         logits = logits * out_scale[None, None, :]
     if cfg.logits_soft_cap:
         logits = cfg.logits_soft_cap * jnp.tanh(logits / cfg.logits_soft_cap)
+    if return_moe_stats:
+        return logits, kv, {"dropped": moe_dropped}
     return logits, kv
 
 

@@ -40,8 +40,13 @@ def _expert_dense(h_in, wp, spec):
     return out
 
 
-def moe_ffn(x, router_p, experts_p, cfg, act, token_mask=None):
-    """x: [B, S, E] -> [B, S, E].
+def moe_ffn(x, router_p, experts_p, cfg, act, token_mask=None,
+            return_dropped=False):
+    """x: [B, S, E] -> [B, S, E] (or ``(out, dropped)`` with
+    ``return_dropped``: the int32 count of (token, choice) routing
+    assignments this call dropped to capacity overflow — the tokens that
+    silently ride the residual stream instead of their expert.  Decode is
+    dropless (C = T), so only prefill shapes ever report > 0).
 
     router_p: [E, X] (dequantised); experts_p: {"w_gate"/"w_up":
     {"weight": [X, E, F][, "scale"]}, "w_down": {...}} — int8
@@ -91,6 +96,10 @@ def moe_ffn(x, router_p, experts_p, cfg, act, token_mask=None):
     )                                               # [kT, X]
     pos = jnp.sum(pos_in_expert * onehot, axis=-1)  # [kT]
     keep = (pos < C) & (flat_idx < X)
+    # capacity-overflow accounting: a valid assignment (real token, real
+    # expert) whose position overflowed C — exactly the work that falls
+    # back to the residual stream
+    dropped = jnp.sum(((~keep) & (flat_idx < X)).astype(jnp.int32))
     # back to [T, k]
     pos = pos.reshape(k, T).T
     keep = keep.reshape(k, T).T
@@ -120,4 +129,7 @@ def moe_ffn(x, router_p, experts_p, cfg, act, token_mask=None):
         "xcf,xfe->xce",
     )                                                       # [X, C, E]
     out = jnp.einsum("txc,xce->te", combine, h)
-    return out.reshape(B, S, E).astype(x.dtype)
+    out = out.reshape(B, S, E).astype(x.dtype)
+    if return_dropped:
+        return out, dropped
+    return out

@@ -339,11 +339,11 @@ def text_forward_mrope(
         act = _act(cfg.hidden_act)
         h = h + _dense(act(_dense(x, p["w_gate"])) * _dense(x, p["w_up"]),
                        p["w_down"])
-        return h, (k, v), new_cache
+        return h, (k, v), new_cache, jnp.int32(0)
 
     from helix_tpu.models.llama import scan_decoder_blocks
 
-    h, kv = scan_decoder_blocks(
+    h, kv, _ = scan_decoder_blocks(
         h, params["layers"], cfg.num_layers, block, layer_caches,
         carry_caches,
     )

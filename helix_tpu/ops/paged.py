@@ -15,7 +15,14 @@ it is TPU-owned:
   page in-place (pool aliased through the call) — the decode loop contains
   NO scatter, so XLA never relays the pool out (the r3 trace showed the
   external-scatter design spending ~40% of each decode window transposing
-  the pool).  Returns ``(out, k_pages, v_pages)``.
+  the pool).  Returns ``(out, k_pages, v_pages, k_scale, v_scale)``.
+
+Int8 pools: pass the per-(slot, head) f32 scale pools (``k_scale`` /
+``v_scale``, shape ``[L, N, P, KVH]``) and both paths dequantize
+in-register right after the page fetch — HBM traffic stays at 1 byte/elem.
+The current token's K/V is quantized through the SAME codec before both
+the attention fold-in and the page write, so decode at step t+1 reads
+exactly the values step t attended over.
 
 Length convention: ``lengths[b]`` = number of PAST tokens in the cache for
 sequence b (the current token's position).  The current token's K/V arrive
@@ -47,6 +54,8 @@ def paged_decode_attention_reference(
     v_new=None,
     *,
     scale: Optional[float] = None,
+    k_scale=None,  # [N, P, KVH] f32 — ONE layer's scale pool (int8 pages)
+    v_scale=None,
 ) -> jax.Array:
     B, H, D = q.shape
     N, P, KVH, _ = k_pages.shape
@@ -56,18 +65,13 @@ def paged_decode_attention_reference(
 
     # Gather each sequence's pages: [B, maxP, P, KVH, D] -> [B, KVH, T, D]
     T = maxP * P
-    kg = (
-        k_pages[page_tables]
-        .reshape(B, T, KVH, D)
-        .transpose(0, 2, 1, 3)
-        .astype(jnp.float32)
-    )
-    vg = (
-        v_pages[page_tables]
-        .reshape(B, T, KVH, D)
-        .transpose(0, 2, 1, 3)
-        .astype(jnp.float32)
-    )
+    kg = k_pages[page_tables].astype(jnp.float32)
+    vg = v_pages[page_tables].astype(jnp.float32)
+    if k_scale is not None:
+        kg = kg * k_scale[page_tables].astype(jnp.float32)[..., None]
+        vg = vg * v_scale[page_tables].astype(jnp.float32)[..., None]
+    kg = kg.reshape(B, T, KVH, D).transpose(0, 2, 1, 3)
+    vg = vg.reshape(B, T, KVH, D).transpose(0, 2, 1, 3)
     valid = jnp.arange(T)[None, :] < lengths[:, None]  # [B, T]
     if k_new is not None:
         kg = jnp.concatenate(
@@ -88,18 +92,34 @@ def paged_decode_attention_reference(
 
 def _reference_attend_and_write(
     q, k_pages, v_pages, page_tables, lengths, layer, active, k_new, v_new,
-    *, scale,
+    *, scale, k_scale=None, v_scale=None,
 ):
     """XLA oracle for the attend-and-write op (CPU tests / non-TPU)."""
     B = q.shape[0]
     L_, N, P, KVH, D = k_pages.shape
     kp_l = k_pages[layer]
     vp_l = v_pages[layer]
+    ks_l = None if k_scale is None else k_scale[layer]
+    vs_l = None if v_scale is None else v_scale[layer]
+    kn_s = vn_s = None
+    if k_scale is not None:
+        # quantize the current token through the SAME codec the write
+        # persists, and fold the dequantized values into attention — the
+        # virtual final block then matches what later steps read back
+        from helix_tpu.ops.quant import dequantize_kv, quantize_kv
+
+        k_new, kn_s = quantize_kv(k_new)
+        v_new, vn_s = quantize_kv(v_new)
+        k_att = dequantize_kv(k_new, kn_s)
+        v_att = dequantize_kv(v_new, vn_s)
+    else:
+        k_att, v_att = k_new, v_new
     # inactive slots must not attend over their (possibly reallocated)
     # pages: zero their length
     lengths_eff = lengths * active
     out = paged_decode_attention_reference(
-        q, kp_l, vp_l, page_tables, lengths_eff, k_new, v_new, scale=scale
+        q, kp_l, vp_l, page_tables, lengths_eff, k_att, v_att,
+        scale=scale, k_scale=ks_l, v_scale=vs_l,
     )
     # persist the current token: flat token index into [N*P]; inactive
     # slots land on garbage page 0
@@ -115,7 +135,16 @@ def _reference_attend_and_write(
     ).reshape(N, P, KVH, D)
     k_pages = k_pages.at[layer].set(kp_l)
     v_pages = v_pages.at[layer].set(vp_l)
-    return out, k_pages, v_pages
+    if k_scale is not None:
+        ks_l = ks_l.reshape(N * P, KVH).at[flat].set(
+            kn_s, mode="drop"
+        ).reshape(N, P, KVH)
+        vs_l = vs_l.reshape(N * P, KVH).at[flat].set(
+            vn_s, mode="drop"
+        ).reshape(N, P, KVH)
+        k_scale = k_scale.at[layer].set(ks_l)
+        v_scale = v_scale.at[layer].set(vs_l)
+    return out, k_pages, v_pages, k_scale, v_scale
 
 
 def paged_decode_attention(
@@ -131,9 +160,14 @@ def paged_decode_attention(
     *,
     scale: Optional[float] = None,
     backend: Optional[str] = None,
+    k_scale=None,  # [L, N, P, KVH] f32 — int8 pools' scale pools
+    v_scale=None,
 ):
     """Attend one query token per sequence over its pages and persist the
     token's K/V — pool in, pool out (aliased in-place on TPU).
+
+    Returns ``(out, k_pages, v_pages, k_scale, v_scale)``; the scale pools
+    are ``None`` when the pool is full-precision.
 
     Dispatcher: Pallas kernel on TPU, XLA reference elsewhere.
     """
@@ -146,9 +180,9 @@ def paged_decode_attention(
 
         return paged_decode_attention_tpu(
             q, k_pages, v_pages, page_tables, lengths, layer, active,
-            k_new, v_new, scale=scale,
+            k_new, v_new, scale=scale, k_scale=k_scale, v_scale=v_scale,
         )
     return _reference_attend_and_write(
         q, k_pages, v_pages, page_tables, lengths, layer, active,
-        k_new, v_new, scale=scale,
+        k_new, v_new, scale=scale, k_scale=k_scale, v_scale=v_scale,
     )

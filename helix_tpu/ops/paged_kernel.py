@@ -20,6 +20,15 @@ Paged Attention (PAPERS.md) specialised to decode:
   (pool aliased input->output) — the decode loop needs no external
   scatter, which is what kept XLA from relaying the pool (r3 trace: ~40%
   of each decode window went to those layout copies).
+- **Int8 pools**: when scale pools ride along, pages stream to VMEM as
+  int8 (half the bf16 HBM bytes) together with their ``[P, KVH]`` f32
+  scale rows, and dequantization happens **in-register** right before the
+  score dot — the MXU still sees fp32 operands.  The current token is
+  quantized through the same codec on the host side of the pallas_call
+  and its codes + scale row are DMA'd into the page, so step t+1 reads
+  exactly the values step t attended over.  (The scale buffers' minor dim
+  is ``KVH`` — narrower than a 128 lane tile, so Mosaic pads them; they
+  are ~``D/4``x smaller than the data buffers, so the padding is noise.)
 """
 
 from __future__ import annotations
@@ -35,6 +44,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 from helix_tpu.ops.attention import DEFAULT_MASK_VALUE
 
+# jax renamed these between versions; support both spellings
+_MemorySpace = getattr(pltpu, "MemorySpace", None) or pltpu.TPUMemorySpace
+_CompilerParams = (
+    getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+)
+
 
 def _decode_kernel(
     # scalar prefetch
@@ -42,29 +57,29 @@ def _decode_kernel(
     len_ref,     # SMEM [B] int32 past lengths
     act_ref,     # SMEM [B] int32 active flags
     layer_ref,   # SMEM [1] int32 layer index
-    # inputs
-    q_ref,       # VMEM [1, KVH, group, D]
-    knew_ref,    # VMEM [1, KVH, D]
-    vnew_ref,    # VMEM [1, KVH, D]
-    k_hbm,       # ANY  [L, N, P, KVH, D]
-    v_hbm,
-    # outputs
-    o_ref,       # VMEM [1, KVH, group, D]
-    ko_hbm,      # ANY — aliased to k_hbm
-    vo_hbm,      # ANY — aliased to v_hbm
-    # scratch
-    kbuf,        # VMEM [2, C, P, KVH, D]
-    vbuf,        # VMEM [2, C, P, KVH, D]
-    sems,        # DMA sems [2, C, 2]
-    wsems,       # DMA sems [2] for the write-back
-    *,
+    # inputs / outputs / scratch — order depends on ``quantized``:
+    #   plain: q, knew, vnew, k_hbm, v_hbm | o, ko_hbm, vo_hbm
+    #          | kbuf, vbuf, sems, wsems
+    #   quant: q, knew(i8), vnew(i8), kns, vns, k_hbm, v_hbm, ks_hbm,
+    #          vs_hbm | o, ko_hbm, vo_hbm, kso_hbm, vso_hbm
+    #          | kbuf, vbuf, ksbuf, vsbuf, sems, ssems, wsems
+    *refs,
     scale: float,
     page_size: int,
     pages_per_chunk: int,
     max_pages: int,
     kv_heads: int,
     group: int,
+    quantized: bool,
 ):
+    if quantized:
+        (q_ref, knew_ref, vnew_ref, kns_ref, vns_ref,
+         k_hbm, v_hbm, ks_hbm, vs_hbm,
+         o_ref, ko_hbm, vo_hbm, kso_hbm, vso_hbm,
+         kbuf, vbuf, ksbuf, vsbuf, sems, ssems, wsems) = refs
+    else:
+        (q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
+         o_ref, ko_hbm, vo_hbm, kbuf, vbuf, sems, wsems) = refs
     b = pl.program_id(0)
     lyr = layer_ref[0]
     P, C, KVH = page_size, pages_per_chunk, kv_heads
@@ -90,6 +105,17 @@ def _decode_kernel(
                     vbuf.at[slot, c],
                     sems.at[slot, c, 1],
                 ).start()
+                if quantized:
+                    pltpu.make_async_copy(
+                        ks_hbm.at[lyr, page],
+                        ksbuf.at[slot, c],
+                        ssems.at[slot, c, 0],
+                    ).start()
+                    pltpu.make_async_copy(
+                        vs_hbm.at[lyr, page],
+                        vsbuf.at[slot, c],
+                        ssems.at[slot, c, 1],
+                    ).start()
 
     def wait_chunk(ci, slot):
         for c in range(C):
@@ -106,6 +132,17 @@ def _decode_kernel(
                     vbuf.at[slot, c],
                     sems.at[slot, c, 1],
                 ).wait()
+                if quantized:
+                    pltpu.make_async_copy(
+                        ks_hbm.at[lyr, page],
+                        ksbuf.at[slot, c],
+                        ssems.at[slot, c, 0],
+                    ).wait()
+                    pltpu.make_async_copy(
+                        vs_hbm.at[lyr, page],
+                        vsbuf.at[slot, c],
+                        ssems.at[slot, c, 1],
+                    ).wait()
 
     q = q_ref[0].astype(jnp.float32)  # [KVH, group, D]
     D = q.shape[-1]
@@ -146,6 +183,15 @@ def _decode_kernel(
     )
     kw.start()
     vw.start()
+    if quantized:
+        ksw = pltpu.make_async_copy(
+            kns_ref.at[0], kso_hbm.at[lyr, w_page, w_off], wsems.at[2]
+        )
+        vsw = pltpu.make_async_copy(
+            vns_ref.at[0], vso_hbm.at[lyr, w_page, w_off], wsems.at[3]
+        )
+        ksw.start()
+        vsw.start()
 
     @pl.when(nchunks > 0)
     def _():
@@ -160,15 +206,30 @@ def _decode_kernel(
             start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
 
         wait_chunk(ci, slot)
-        k_flat = kbuf[slot].reshape(C * P, KVH * D).astype(jnp.float32)
-        v_flat = vbuf[slot].reshape(C * P, KVH * D).astype(jnp.float32)
+        if quantized:
+            # in-register dequant: int8 codes x per-(slot, head) scale —
+            # the HBM fetch above moved 1 byte/elem; the MXU sees fp32
+            k_flat = (
+                kbuf[slot].astype(jnp.float32)
+                * ksbuf[slot][..., None]
+            ).reshape(C * P, KVH * D)
+            v_flat = (
+                vbuf[slot].astype(jnp.float32)
+                * vsbuf[slot][..., None]
+            ).reshape(C * P, KVH * D)
+        else:
+            k_flat = kbuf[slot].reshape(C * P, KVH * D).astype(jnp.float32)
+            v_flat = vbuf[slot].reshape(C * P, KVH * D).astype(jnp.float32)
         token0 = ci * C * P
         tok = token0 + jax.lax.broadcasted_iota(jnp.int32, (1, C * P), 1)
         in_range = tok < L                  # [1, T]
         # un-DMA'd buffer regions (pages past this sequence's length) hold
         # garbage; the softmax weight there is exactly 0, but 0 * NaN
         # still poisons the PV accumulation — zero V explicitly.  (K needs
-        # no guard: its scores are overwritten by the mask.)
+        # no guard: its scores are overwritten by the mask.  With int8
+        # pools the garbage risk lives in the f32 SCALE buffer, which the
+        # dequant multiply above has already folded into v_flat — this
+        # same guard covers it.)
         v_flat = jnp.where(
             jax.lax.broadcasted_iota(jnp.int32, (C * P, 1), 0)
             < L - token0,
@@ -204,9 +265,19 @@ def _decode_kernel(
         0, max_chunks, guarded_body, (m0, l0, acc0)
     )
 
-    # fold in the current token's K/V (virtual final block, always valid)
-    knew_flat = knew_ref[0].reshape(KVH * D).astype(jnp.float32)
-    vnew_flat = vnew_ref[0].reshape(KVH * D).astype(jnp.float32)
+    # fold in the current token's K/V (virtual final block, always valid);
+    # int8 mode dequantizes the token's own codes so the fold-in matches
+    # what the page write persists bit-for-bit
+    if quantized:
+        knew_flat = (
+            knew_ref[0].astype(jnp.float32) * kns_ref[0][..., None]
+        ).reshape(KVH * D)
+        vnew_flat = (
+            vnew_ref[0].astype(jnp.float32) * vns_ref[0][..., None]
+        ).reshape(KVH * D)
+    else:
+        knew_flat = knew_ref[0].reshape(KVH * D).astype(jnp.float32)
+        vnew_flat = vnew_ref[0].reshape(KVH * D).astype(jnp.float32)
     s_new = jax.lax.dot_general(
         q_bd, knew_flat[:, None], (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
@@ -224,6 +295,9 @@ def _decode_kernel(
 
     kw.wait()
     vw.wait()
+    if quantized:
+        ksw.wait()
+        vsw.wait()
 
 
 @functools.partial(
@@ -242,7 +316,12 @@ def paged_decode_attention_tpu(
     *,
     scale: Optional[float] = None,
     interpret: bool = False,
+    k_scale=None,  # [L, N, P, KVH] f32 — present iff the pool is int8
+    v_scale=None,
 ):
+    """Returns ``(out, k_pages, v_pages, k_scale, v_scale)``; the scale
+    pools are ``None`` for full-precision pools (pytree structure keys the
+    jit trace, so both modes share this entry point)."""
     B, H, D = q.shape
     L, N, P, KVH, _ = k_pages.shape
     maxP = page_tables.shape[1]
@@ -250,6 +329,7 @@ def paged_decode_attention_tpu(
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     C = max(1, 128 // P)
     C = min(C, maxP)
+    quantized = k_scale is not None
 
     qg = q.reshape(B, KVH, group, D)
     kernel = functools.partial(
@@ -260,42 +340,98 @@ def paged_decode_attention_tpu(
         max_pages=maxP,
         kv_heads=KVH,
         group=group,
+        quantized=quantized,
     )
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
-        grid=(B,),
-        in_specs=[
+    token_specs = [
+        pl.BlockSpec((1, KVH, group, D), lambda b, *_: (b, 0, 0, 0)),
+        pl.BlockSpec((1, KVH, D), lambda b, *_: (b, 0, 0)),
+        pl.BlockSpec((1, KVH, D), lambda b, *_: (b, 0, 0)),
+    ]
+    pool_specs = [
+        pl.BlockSpec(memory_space=_MemorySpace.ANY),
+        pl.BlockSpec(memory_space=_MemorySpace.ANY),
+    ]
+    if quantized:
+        from helix_tpu.ops.quant import quantize_kv
+
+        knew_q, kns = quantize_kv(k_new.reshape(B, KVH, D))
+        vnew_q, vns = quantize_kv(v_new.reshape(B, KVH, D))
+        in_specs = (
+            token_specs
+            + [
+                pl.BlockSpec((1, KVH), lambda b, *_: (b, 0)),
+                pl.BlockSpec((1, KVH), lambda b, *_: (b, 0)),
+            ]
+            + pool_specs
+            + pool_specs   # scale pools stay in ANY/HBM too
+        )
+        out_shape = [
+            jax.ShapeDtypeStruct((B, KVH, group, D), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ]
+        out_specs = [
             pl.BlockSpec((1, KVH, group, D), lambda b, *_: (b, 0, 0, 0)),
-            pl.BlockSpec((1, KVH, D), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec((1, KVH, D), lambda b, *_: (b, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-        ],
-        out_specs=[
+        ] + pool_specs + pool_specs
+        scratch = [
+            pltpu.VMEM((2, C, P, KVH, D), k_pages.dtype),
+            pltpu.VMEM((2, C, P, KVH, D), v_pages.dtype),
+            pltpu.VMEM((2, C, P, KVH), jnp.float32),
+            pltpu.VMEM((2, C, P, KVH), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, C, 2)),
+            pltpu.SemaphoreType.DMA((2, C, 2)),
+            pltpu.SemaphoreType.DMA((4,)),
+        ]
+        # flat input order: pt, len, act, layer, q, knew, vnew, kns, vns,
+        # k_pages(9), v_pages(10), k_scale(11), v_scale(12) -> outputs
+        # (out, k_pages, v_pages, k_scale, v_scale)
+        aliases = {9: 1, 10: 2, 11: 3, 12: 4}
+        inputs = (
+            qg, knew_q, vnew_q, kns, vns, k_pages, v_pages,
+            k_scale, v_scale,
+        )
+    else:
+        in_specs = token_specs + pool_specs
+        out_shape = [
+            jax.ShapeDtypeStruct((B, KVH, group, D), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ]
+        out_specs = [
             pl.BlockSpec((1, KVH, group, D), lambda b, *_: (b, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.ANY),
-        ],
-        scratch_shapes=[
+        ] + pool_specs
+        scratch = [
             pltpu.VMEM((2, C, P, KVH, D), k_pages.dtype),
             pltpu.VMEM((2, C, P, KVH, D), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, C, 2)),
             pltpu.SemaphoreType.DMA((2,)),
-        ],
+        ]
+        # flat input order: pt, len, act, layer, q, knew, vnew, k_pages(7),
+        # v_pages(8) -> outputs (out, k_pages, v_pages)
+        aliases = {7: 1, 8: 2}
+        inputs = (
+            qg,
+            k_new.reshape(B, KVH, D),
+            v_new.reshape(B, KVH, D),
+            k_pages,
+            v_pages,
+        )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch,
     )
-    # flat input order: pt, len, act, layer, q, knew, vnew, k_pages(7),
-    # v_pages(8) -> outputs (out, k_pages, v_pages)
-    out, kp, vp = pl.pallas_call(
+    res = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct((B, KVH, group, D), q.dtype),
-            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
-            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
-        ],
-        input_output_aliases={7: 1, 8: 2},
+        out_shape=out_shape,
+        input_output_aliases=aliases,
         interpret=interpret,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("arbitrary",),
         ),
     )(
@@ -303,10 +439,11 @@ def paged_decode_attention_tpu(
         lengths.astype(jnp.int32),
         active.astype(jnp.int32),
         jnp.asarray(layer, jnp.int32).reshape(1),
-        qg,
-        k_new.reshape(B, KVH, D),
-        v_new.reshape(B, KVH, D),
-        k_pages,
-        v_pages,
+        *inputs,
     )
-    return out.reshape(B, H, D), kp, vp
+    if quantized:
+        out, kp, vp, ks, vs = res
+    else:
+        out, kp, vp = res
+        ks = vs = None
+    return out.reshape(B, H, D), kp, vp, ks, vs

@@ -20,6 +20,38 @@ import jax
 import jax.numpy as jnp
 
 
+# Floor on KV quantization scales: keeps all-zero (never-written) page
+# slots exactly representable and the dequant multiply finite.
+KV_SCALE_EPS = 1e-8
+
+
+def quantize_kv(x: jax.Array):
+    """Symmetric int8 KV quantization with per-(token-slot, kv-head)
+    fp32 scales — absmax over the trailing head_dim axis only.
+
+    Per-slot (not whole-page) granularity is what makes incremental
+    decode writes safe: appending a token never has to requantize the
+    page's existing slots against a new scale, it just writes its own
+    ``[KVH, D]`` codes plus a ``[KVH]`` scale row.
+
+    x: ``[..., KVH, D]`` -> (int8 ``[..., KVH, D]``, f32 ``[..., KVH]``).
+    """
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(absmax / 127.0, KV_SCALE_EPS)
+    q = jnp.clip(
+        jnp.round(xf / scale[..., None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q: jax.Array, scale: jax.Array, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv` (broadcasts the per-head scale over
+    head_dim)."""
+    out = q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+    return out.astype(dtype)
+
+
 def quantize_tensor(w: jax.Array):
     """Symmetric int8, per-output-channel (last axis) scales.
 

@@ -66,7 +66,12 @@ def _merge_stats(m1, l1, a1, m2, l2, a2):
 
 def _ring_body(q, k, v, qpos, kpos, axis_name, scale, causal):
     """Runs inside shard_map: local shards + ppermute ring."""
-    sp = jax.lax.axis_size(axis_name)
+    # axis_size is missing on older jax; psum of a literal 1 constant-folds
+    # to the concrete axis size on every version
+    if hasattr(jax.lax, "axis_size"):
+        sp = jax.lax.axis_size(axis_name)
+    else:
+        sp = jax.lax.psum(1, axis_name)
     B, Sq, H, D = q.shape
 
     # derive the init carry from q so it carries the same varying-manual-axes
@@ -170,11 +175,24 @@ def ring_attention(
     body = functools.partial(
         _ring_body, axis_name=axis_name, scale=scale, causal=causal
     )
+    # check_rep=False: older jax's replication checker mistypes the ring's
+    # fori_loop carry under grad (the ppermute rotates a carry whose
+    # replication it tracks as axis-varying on input but not output) and
+    # rejects a correct program; newer jax removed the parameter, so only
+    # pass it where it exists.
+    import inspect
+
+    kw = (
+        {"check_rep": False}
+        if "check_rep" in inspect.signature(shard_map).parameters
+        else {}
+    )
     fn = shard_map(
         body,
         mesh=mesh,
         in_specs=(seq, seq, seq, pos, pos),
         out_specs=seq,
+        **kw,
     )
     out = fn(q, k, v, q_positions, kv_positions)
     return out[:, :Sq] if pad_q else out

@@ -65,6 +65,22 @@ class EngineLoop:
         self._inbox.put((request_id, None))
         self._wake.set()
 
+    def stats(self) -> dict:
+        """Counter snapshot for /metrics (reads of plain ints are atomic
+        under the GIL, so no lock against the engine thread is needed)."""
+        eng = self.engine
+        return {
+            "steps": self.steps,
+            "prefill_tokens": eng.num_prefill_tokens,
+            "decode_tokens": eng.num_decode_tokens,
+            "mixed_steps": getattr(eng, "num_mixed_steps", 0),
+            "moe_dropped_tokens": getattr(eng, "moe_dropped_tokens", 0),
+            "waiting": len(eng.waiting),
+            "active_slots": sum(1 for s in eng.slots if s is not None),
+            "free_pages": eng.allocator.free_pages,
+            "kv_cache_dtype": eng.cache_cfg.dtype,
+        }
+
     def start(self):
         self._thread = threading.Thread(
             target=self._run, name=f"helix-engine-{self.name}", daemon=True

@@ -133,6 +133,13 @@ class OpenAIServer:
                 f"helix_engine_steps{tag} {m.loop.steps}",
                 f"helix_prefill_tokens_total{tag} {eng.num_prefill_tokens}",
                 f"helix_decode_tokens_total{tag} {eng.num_decode_tokens}",
+                # ragged mixed steps: chunk prefill + decode in ONE call
+                f"helix_mixed_steps_total{tag} "
+                f"{getattr(eng, 'num_mixed_steps', 0)}",
+                # MoE prefill routing assignments dropped to expert-
+                # capacity overflow (rode the residual stream instead)
+                f"helix_moe_dropped_tokens_total{tag} "
+                f"{getattr(eng, 'moe_dropped_tokens', 0)}",
                 f"helix_waiting_requests{tag} {len(eng.waiting)}",
                 f"helix_active_slots{tag} "
                 f"{sum(1 for s in eng.slots if s is not None)}",

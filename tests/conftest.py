@@ -135,3 +135,47 @@ def cpu_devices():
 @pytest.fixture()
 def rng():
     return jax.random.PRNGKey(0)
+
+
+# ---------------------------------------------------------------------------
+# Per-test duration recording: every run (tier-1 included, which passes
+# -p no:cacheprovider so pytest's own cache is unavailable) appends each
+# test's setup+call+teardown seconds to .pytest_last_durations.json in the
+# repo root.  ``tools/slowest_tests.py`` prints the top offenders — the
+# wall-clock-creep watchdog for keeping tier-1 under its timeout.
+# ---------------------------------------------------------------------------
+
+_DURATIONS: dict = {}
+
+
+@pytest.hookimpl
+def pytest_runtest_logreport(report):
+    if report.when in ("setup", "call", "teardown"):
+        _DURATIONS[report.nodeid] = (
+            _DURATIONS.get(report.nodeid, 0.0) + report.duration
+        )
+
+
+@pytest.hookimpl
+def pytest_sessionfinish(session):
+    if not _DURATIONS:
+        return
+    import json
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".pytest_last_durations.json",
+    )
+    try:
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "total_seconds": round(sum(_DURATIONS.values()), 3),
+                    "tests": {
+                        k: round(v, 4) for k, v in _DURATIONS.items()
+                    },
+                },
+                f,
+            )
+    except OSError:
+        pass  # read-only checkout: recording is best-effort

@@ -39,6 +39,7 @@ def _fake_trained_adapter(cfg, rank=4, seed=9):
     return lp
 
 
+@pytest.mark.slow  # full profile-apply + LoRA e2e, ~90 s; adapter math covered in test_training
 def test_profile_adapter_changes_generation(tmp_path):
     cfg = ModelConfig.tiny(dtype="float32")
     lora = _fake_trained_adapter(cfg)

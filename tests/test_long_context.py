@@ -11,6 +11,12 @@ import jax
 import pytest
 import requests
 
+# ~100 s of the tier-1 wall clock for two e2e streams; the chunked-prefill
+# machinery it exercises is covered per-step by tests/test_engine.py
+# (TestChunkedPrefill, TestMixedStep), so the 32k end-to-end pass runs in
+# the slow lane: `pytest -m slow tests/test_long_context.py`
+pytestmark = pytest.mark.slow
+
 from helix_tpu.engine.engine import Engine, EngineConfig
 from helix_tpu.models.common import ModelConfig
 from helix_tpu.models.llama import init_params

@@ -86,6 +86,7 @@ def _torch_parity(hf_model, hf_cfg_name, our_tokens, tmp_path, atol):
 class TestHFParity:
     TOKENS = np.array([[1, 5, 9, 200, 42, 7, 13, 99]], dtype=np.int32)
 
+    @pytest.mark.slow  # ~32 s HF parity sweep; forward-shape tests stay in tier-1
     def test_llama_parity(self, tmp_path):
         from transformers import LlamaConfig, LlamaForCausalLM
 

@@ -280,3 +280,67 @@ class TestMoEDeterminism:
         params = init_params(cfg, jax.random.PRNGKey(1))
         merged = merge_lora_into_params(params, lp, scaling=1.0)
         assert "experts" in merged["layers"]
+
+
+class TestMoEDropCounter:
+    def test_moe_ffn_reports_capacity_drops(self):
+        """return_dropped counts exactly the valid (token, choice)
+        assignments that overflowed expert capacity."""
+        cfg = tiny_moe_cfg(expert_capacity_factor=0.01)  # C = 1
+        E, X = cfg.hidden_size, 4
+        x = jnp.ones((1, 6, E), jnp.float32) * 0.3       # identical tokens
+        router_w = jnp.zeros((E, X), jnp.float32).at[:, 0].set(0.1)
+        mats = {
+            "w_gate": {"weight": jnp.ones((X, E, cfg.intermediate_size)) * 0.01},
+            "w_up": {"weight": jnp.ones((X, E, cfg.intermediate_size)) * 0.01},
+            "w_down": {"weight": jnp.ones((X, cfg.intermediate_size, E)) * 0.01},
+        }
+        out, dropped = moe_ffn(
+            x, router_w, mats, cfg, jax.nn.silu, return_dropped=True
+        )
+        # identical tokens all route to the same two experts (top-1 and
+        # the tied top-2 pick): 6 tokens x 2 choices = 12 assignments
+        # into 2 capacity-1 experts -> exactly 2 survive, 10 drop
+        assert int(dropped) == 10
+        # padding/masked tokens never count as drops
+        mask = jnp.zeros((1, 6), bool).at[0, 0].set(True)
+        _, dropped_masked = moe_ffn(
+            x, router_w, mats, cfg, jax.nn.silu, token_mask=mask,
+            return_dropped=True,
+        )
+        assert int(dropped_masked) == 0   # 1 token, 2 choices, both fit
+
+    def test_engine_counts_prefill_drops(self):
+        """The serving engine surfaces prefill capacity overflow in its
+        per-engine counter instead of dropping silently (ADVICE r5)."""
+        from helix_tpu.engine.engine import Engine, EngineConfig
+        from helix_tpu.engine.sampling import SamplingParams
+
+        cfg = tiny_moe_cfg(expert_capacity_factor=0.01)
+        params = init_params(cfg, jax.random.PRNGKey(4))
+        eng = Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=2, page_size=4, num_pages=64,
+                max_pages_per_seq=16, max_prefill_len=64,
+                attn_backend="reference", enable_prefix_cache=False,
+            ),
+        )
+        from helix_tpu.engine.engine import Request
+
+        req = Request(
+            id="moe-drops", prompt_tokens=[3, 1, 4, 1, 5, 9, 2, 6],
+            sampling=SamplingParams(temperature=0.0, max_tokens=5),
+        )
+        eng.add_request(req)
+        eng.step()   # prefill + first token
+        # capacity 1 with an 8-token prompt must overflow during prefill
+        after_prefill = eng.moe_dropped_tokens
+        assert after_prefill > 0
+        # decode is dropless (C = T): the counter must not move while the
+        # remaining 4 tokens drain
+        while eng.has_work():
+            eng.step()
+        assert len(req.output_tokens) == 5
+        eng._drain_moe_drops()   # fold anything decode might have queued
+        assert eng.moe_dropped_tokens == after_prefill

@@ -188,3 +188,37 @@ class TestPrefixCacheEngine:
             SamplingParams(temperature=0.0, max_tokens=5),
         )
         assert got == want
+
+
+class TestPrefixCacheInt8KV:
+    """Shared-prefix pages hold QUANTIZED KV when the pool is int8: a
+    second request must reuse the codes + scale rows correctly and decode
+    exactly like an int8 engine that prefilled everything itself."""
+
+    def _greedy(self, eng, prompt, n=6):
+        return eng.generate(
+            [list(prompt)],
+            SamplingParams(temperature=0.0, max_tokens=n),
+        )[0]
+
+    def test_int8_pages_shared_through_prefix_cache(self, tiny_model):
+        cfg, params = tiny_model
+        base = make_engine(cfg, params, cache=False,
+                           kv_cache_dtype="int8")
+        cached = make_engine(cfg, params, cache=True,
+                             kv_cache_dtype="int8")
+        assert cached.cache.quantized
+        sys_prompt = list(range(1, 13))     # 3 full pages of 4
+        a = sys_prompt + [20, 21]
+        b = sys_prompt + [30, 31, 32]
+        want_a = self._greedy(base, a)
+        want_b = self._greedy(base, b)
+        got_a = self._greedy(cached, a)
+        prefill_after_a = cached.num_prefill_tokens
+        got_b = self._greedy(cached, b)
+        assert got_a == want_a
+        # b's whole 12-token prefix was served from QUANTIZED cached
+        # pages (codes + scale rows) — only the 3 fresh tokens prefilled
+        assert cached.num_prefill_tokens - prefill_after_a == 3
+        assert cached.prefix_cache.hits == 3
+        assert got_b == want_b

@@ -76,6 +76,7 @@ class TestExactEscalation:
         # true mass beyond any 64 tokens is 448/512 = 0.875
         assert beyond > 0.7, f"window truncation: {beyond:.3f} mass past 64"
 
+    @pytest.mark.slow  # 16k-draw distribution check; ~15-20 s
     def test_top_p_1_high_temperature_distribution(self):
         """temperature=2.0, top_p=1.0 vs the full-vocab reference (the
         verdict's prescribed adversarial setting)."""
@@ -86,6 +87,7 @@ class TestExactEscalation:
         # expected sampling-noise TV at n=16k over 512 bins is ~0.06
         assert _tv(emp, ref) < 0.09
 
+    @pytest.mark.slow  # 16k-draw distribution check; ~15-20 s
     def test_top_p_past_window_mass_full_sort(self):
         """top_p < 1 but beyond the window's mass -> tier-3 full sort.
         Flat logits: window holds 64/512 = 12.5% of the mass, so
@@ -127,6 +129,7 @@ class TestExactEscalation:
         toks = np.asarray(sample(jnp.asarray(logits), st, _keys(4, 0)))
         np.testing.assert_array_equal(toks, logits.argmax(-1))
 
+    @pytest.mark.slow  # 16k-draw distribution check; ~15-20 s
     def test_exact_flag_runs_and_matches(self):
         """exact=True (HELIX_EXACT_SAMPLING) swaps approx_max_k for
         lax.top_k; the distribution is statistically identical."""

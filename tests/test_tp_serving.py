@@ -90,6 +90,7 @@ def test_tp2_int8_parity(tiny_cfg):
     assert _generate(eng2) == want
 
 
+@pytest.mark.slow  # profile-apply e2e ~20-50 s; tp2/pp serving parity stays in tier-1
 def test_node_agent_realises_mesh_disjoint_slices():
     """Two chat models on tp=2 slices at offsets 0 and 2 + an embedder at
     offset 4: engines shard over disjoint devices (the v5e8 profile shape)."""
@@ -193,6 +194,7 @@ def test_node_agent_vision_mesh_shards_text_tower():
         agent.stop()
 
 
+@pytest.mark.slow  # profile-apply e2e ~20-50 s; tp2/pp serving parity stays in tier-1
 def test_node_agent_single_device_has_no_mesh():
     agent = NodeAgent("n1")
     profile = ServingProfile.from_dict(
@@ -210,6 +212,7 @@ def test_node_agent_single_device_has_no_mesh():
         agent.stop()
 
 
+@pytest.mark.slow  # profile-apply e2e ~20-50 s; tp2/pp serving parity stays in tier-1
 def test_node_agent_applies_ep_moe_profile():
     """A Mixtral-style profile (mesh: {ep: 4, tp: 2}) applies through the
     node agent: expert stacks shard over ep, the engine decodes."""
@@ -280,6 +283,7 @@ def test_pp_layer_pipelined_serving():
     assert got == want
 
 
+@pytest.mark.slow  # profile-apply e2e ~20-50 s; tp2/pp serving parity stays in tier-1
 def test_pp_profile_applies_through_node_agent():
     agent = NodeAgent("n-pp")
     profile = ServingProfile.from_dict(

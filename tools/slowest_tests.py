@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Print the slowest tests from the last pytest run.
+
+``tests/conftest.py`` records every test's setup+call+teardown seconds to
+``.pytest_last_durations.json`` on each run (the tier-1 command disables
+pytest's own cache with ``-p no:cacheprovider``, so this file is the only
+durable record).  This script is the wall-clock-creep watchdog: when
+tier-1 drifts toward its 870 s timeout, run it, then mark the offenders
+``@pytest.mark.slow`` (pytest.ini registers the marker) or split them.
+
+Usage:  python tools/slowest_tests.py [N]      (default N=10)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".pytest_last_durations.json",
+    )
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        print(
+            f"no durations recorded yet ({path} missing) — run pytest "
+            "first; tests/conftest.py writes it on session finish",
+            file=sys.stderr,
+        )
+        return 1
+    tests = sorted(
+        data.get("tests", {}).items(), key=lambda kv: kv[1], reverse=True
+    )
+    total = data.get("total_seconds", sum(v for _, v in tests))
+    print(f"last run: {len(tests)} tests, {total:.1f}s total")
+    print(f"{'seconds':>9}  {'cum%':>5}  test")
+    cum = 0.0
+    for nodeid, secs in tests[:n]:
+        cum += secs
+        print(f"{secs:9.2f}  {100 * cum / max(total, 1e-9):4.1f}%  {nodeid}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
