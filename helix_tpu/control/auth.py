@@ -27,7 +27,58 @@ import time
 import uuid
 from typing import Optional
 
-from cryptography.fernet import Fernet
+try:
+    from cryptography.fernet import Fernet
+except ImportError:  # gated dep: minimal containers ship without it
+    Fernet = None
+
+
+class _StdlibEnvelope:
+    """Stdlib fallback when ``cryptography`` is unavailable: HMAC-SHA256
+    as the PRF for a CTR-style keystream (encrypt) plus
+    encrypt-then-MAC authentication, on ``os`` + ``hmac`` + ``hashlib``
+    only.  Same surface as Fernet (``encrypt``/``decrypt``, raises on
+    tamper); tokens from the two implementations are NOT interchangeable,
+    so a deployment that later installs ``cryptography`` keeps its
+    existing HELIX_MASTER_KEY but must re-enter stored secrets."""
+
+    def __init__(self, key: bytes):
+        digest = hashlib.sha256(base64.urlsafe_b64decode(key)).digest()
+        self._enc_key = hashlib.sha256(b"enc:" + digest).digest()
+        self._mac_key = hashlib.sha256(b"mac:" + digest).digest()
+
+    def _keystream(self, nonce: bytes, n: int) -> bytes:
+        out = b""
+        counter = 0
+        while len(out) < n:
+            out += hmac.new(
+                self._enc_key,
+                nonce + counter.to_bytes(8, "big"),
+                hashlib.sha256,
+            ).digest()
+            counter += 1
+        return out[:n]
+
+    def encrypt(self, data: bytes) -> bytes:
+        nonce = os.urandom(16)
+        ct = bytes(
+            a ^ b for a, b in zip(data, self._keystream(nonce, len(data)))
+        )
+        tag = hmac.new(self._mac_key, nonce + ct, hashlib.sha256).digest()
+        return base64.urlsafe_b64encode(nonce + ct + tag)
+
+    def decrypt(self, token: bytes) -> bytes:
+        try:
+            blob = base64.urlsafe_b64decode(token)
+            nonce, ct, tag = blob[:16], blob[16:-32], blob[-32:]
+        except Exception as e:  # noqa: BLE001 — malformed token
+            raise ValueError(f"invalid token: {e}") from e
+        want = hmac.new(self._mac_key, nonce + ct, hashlib.sha256).digest()
+        if not hmac.compare_digest(tag, want):
+            raise ValueError("invalid token: authentication failed")
+        return bytes(
+            a ^ b for a, b in zip(ct, self._keystream(nonce, len(ct)))
+        )
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS users (
@@ -141,7 +192,20 @@ class Authenticator:
                 # leaked DB snapshot must not carry its own decryption
                 # key), and never fall back to a hard-coded value.
                 master_key = self._load_or_create_master_key()
-        self._fernet = Fernet(
+        envelope = Fernet if Fernet is not None else _StdlibEnvelope
+        if envelope is _StdlibEnvelope:
+            # loud, once per Authenticator: a silent downgrade would make
+            # Fernet-written secrets fail decryption with an opaque
+            # "invalid token" after a container rebuild drops the package
+            import logging
+
+            logging.getLogger("helix.auth").warning(
+                "cryptography package unavailable: secrets envelope is "
+                "the stdlib HMAC fallback (_StdlibEnvelope). Tokens are "
+                "NOT interchangeable with Fernet — secrets written under "
+                "one implementation cannot be read under the other."
+            )
+        self._fernet = envelope(
             base64.urlsafe_b64encode(hashlib.sha256(master_key).digest())
         )
         # purpose-bound derived keys (HMAC signing for short-lived

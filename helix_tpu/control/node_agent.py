@@ -273,7 +273,19 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
             ),
             vision=vision_runner, follower=follower,
         )
-    loop = EngineLoop(engine, name=pm.name).start()
+    def _bound(env_name):
+        import os
+
+        v = os.environ.get(env_name, "")
+        return int(v) if v else None
+
+    loop = EngineLoop(
+        engine, name=pm.name,
+        # admission bounds (shed -> 429 instead of queue-rot); unbounded
+        # unless the operator sets them — see README "Robustness knobs"
+        max_queue_depth=_bound("HELIX_MAX_QUEUE_DEPTH"),
+        max_queued_tokens=_bound("HELIX_MAX_QUEUED_TOKENS"),
+    ).start()
     return ServedModel(
         name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,
         context_length=pm.context_length or model_cfg.max_position_embeddings,

@@ -17,10 +17,137 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Optional
+from typing import Callable, Iterable, Optional
 
 ROUTABLE_STATUS = "running"
 PROFILE_STATUSES = ("assigning", "loading", "starting", "running", "failed")
+
+
+@dataclasses.dataclass(frozen=True)
+class BreakerConfig:
+    """Per-runner circuit breaker tuning (see README "Robustness knobs")."""
+
+    window: int = 20              # sliding window of dispatch outcomes
+    min_samples: int = 4          # outcomes required before the rate applies
+    failure_threshold: float = 0.5  # failure rate that opens the breaker
+    cooldown: float = 15.0        # seconds open before probing (half-open)
+    half_open_probes: int = 2     # concurrent probe dispatches in half-open
+    half_open_successes: int = 2  # probe successes required to close
+
+
+class CircuitBreaker:
+    """closed -> open (failure rate over a sliding window) -> half-open
+    (after ``cooldown``) -> closed (probe successes) | open (probe failure).
+
+    Callers must hold whatever lock guards the owning router; this class
+    itself is not thread-safe.  The clock is injectable so state
+    transitions are testable without sleeping."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(
+        self,
+        cfg: BreakerConfig,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.cfg = cfg
+        self.clock = clock
+        self.state = self.CLOSED
+        self.window: list[bool] = []   # True = failure
+        self.opened_at = 0.0
+        self.probe_inflight = 0
+        self.probe_successes = 0
+        self.opens = 0                 # lifetime open transitions (metrics)
+        # epoch fences outcomes to the state generation their dispatch
+        # started in: a long-lived stream that began before the breaker
+        # tripped must not count as a half-open probe success later
+        self.epoch = 0
+
+    def _maybe_half_open(self) -> None:
+        if (
+            self.state == self.OPEN
+            and self.clock() - self.opened_at >= self.cfg.cooldown
+        ):
+            self.state = self.HALF_OPEN
+            self.probe_inflight = 0
+            self.probe_successes = 0
+            self.epoch += 1
+
+    def allow(self) -> bool:
+        """May a new dispatch go to this runner right now?"""
+        self._maybe_half_open()
+        if self.state == self.CLOSED:
+            return True
+        if self.state == self.HALF_OPEN:
+            return self.probe_inflight < self.cfg.half_open_probes
+        return False
+
+    def on_dispatch(self) -> int:
+        """Returns the epoch token the dispatch starts in; hand it back
+        to record()/release() so stale outcomes can be fenced off."""
+        self._maybe_half_open()
+        if self.state == self.HALF_OPEN:
+            self.probe_inflight += 1
+        return self.epoch
+
+    def _trip(self) -> None:
+        self.state = self.OPEN
+        self.opened_at = self.clock()
+        self.opens += 1
+        self.window.clear()
+        self.probe_inflight = 0
+        self.probe_successes = 0
+        self.epoch += 1
+
+    def release(self, epoch: Optional[int] = None) -> None:
+        """Outcome unknowable (dispatch cancelled mid-flight): free the
+        probe slot without counting a success or failure — a cancelled
+        probe must never close a half-open breaker."""
+        self._maybe_half_open()
+        if epoch is not None and epoch != self.epoch:
+            return
+        if self.state == self.HALF_OPEN:
+            self.probe_inflight = max(0, self.probe_inflight - 1)
+
+    def record(self, failure: bool, epoch: Optional[int] = None) -> None:
+        self._maybe_half_open()
+        if epoch is not None and epoch != self.epoch:
+            # outcome of a dispatch from a previous state generation
+            # (e.g. a stream that started before the breaker tripped):
+            # it says nothing about the runner NOW — a pre-open success
+            # must not close a half-open breaker with zero real probes
+            return
+        if self.state == self.HALF_OPEN:
+            self.probe_inflight = max(0, self.probe_inflight - 1)
+            if failure:
+                self._trip()
+                return
+            self.probe_successes += 1
+            if self.probe_successes >= self.cfg.half_open_successes:
+                self.state = self.CLOSED
+                self.window.clear()
+            return
+        if self.state == self.OPEN:
+            # stale outcome from a dispatch that started pre-open; the
+            # breaker already acted on this runner, ignore it
+            return
+        self.window.append(failure)
+        if len(self.window) > self.cfg.window:
+            self.window.pop(0)
+        if len(self.window) >= self.cfg.min_samples:
+            rate = sum(self.window) / len(self.window)
+            if rate >= self.cfg.failure_threshold:
+                self._trip()
+
+    def snapshot(self) -> dict:
+        self._maybe_half_open()
+        return {
+            "state": self.state,
+            "window_failures": sum(self.window),
+            "window_size": len(self.window),
+            "opens": self.opens,
+            "probe_successes": self.probe_successes,
+        }
 
 
 @dataclasses.dataclass
@@ -39,11 +166,28 @@ class RunnerState:
 
 
 class InferenceRouter:
-    def __init__(self, ttl_seconds: float = 90.0):
+    def __init__(
+        self,
+        ttl_seconds: float = 90.0,
+        breaker: Optional[BreakerConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.ttl = ttl_seconds
+        self.breaker_cfg = breaker or BreakerConfig()
+        self.clock = clock
         self._runners: dict[str, RunnerState] = {}
         self._rr: dict[str, int] = {}  # per-model round-robin cursor
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._inflight: dict[str, int] = {}
         self._lock = threading.Lock()
+
+    def _breaker(self, runner_id: str) -> CircuitBreaker:
+        """Lock must be held."""
+        br = self._breakers.get(runner_id)
+        if br is None:
+            br = CircuitBreaker(self.breaker_cfg, clock=self.clock)
+            self._breakers[runner_id] = br
+        return br
 
     def upsert_from_heartbeat(
         self,
@@ -64,13 +208,13 @@ class InferenceRouter:
             st.profile_name = profile_name
             st.profile_status = profile_status
             st.accelerators = list(accelerators or [])
-            st.last_heartbeat = time.monotonic()
+            st.last_heartbeat = self.clock()
             if meta:
                 st.meta.update(meta)
             return st
 
     def evict_stale(self) -> list:
-        now = time.monotonic()
+        now = self.clock()
         with self._lock:
             dead = [
                 rid
@@ -79,11 +223,23 @@ class InferenceRouter:
             ]
             for rid in dead:
                 del self._runners[rid]
+                self._prune_dispatch_state(rid)
             return dead
+
+    def _prune_dispatch_state(self, runner_id: str) -> None:
+        """Drop breaker/in-flight state for a departed runner (lock must
+        be held).  Without this, churning ephemeral runner ids grow the
+        breaker map — and /metrics label cardinality — forever.  An
+        in-flight dispatch keeps the entries alive until it completes;
+        _record prunes when the last outcome for a departed id lands."""
+        if self._inflight.get(runner_id, 0) == 0:
+            self._breakers.pop(runner_id, None)
+            self._inflight.pop(runner_id, None)
 
     def remove(self, runner_id: str) -> None:
         with self._lock:
             self._runners.pop(runner_id, None)
+            self._prune_dispatch_state(runner_id)
 
     def get(self, runner_id: str) -> Optional[RunnerState]:
         with self._lock:
@@ -95,7 +251,7 @@ class InferenceRouter:
 
     def available_models(self) -> list:
         """Union of models on routable, fresh runners (for /v1/models)."""
-        now = time.monotonic()
+        now = self.clock()
         with self._lock:
             out = set()
             for st in self._runners.values():
@@ -106,7 +262,7 @@ class InferenceRouter:
     def model_map(self) -> dict:
         """{model: [runner ids serving it]} over routable, fresh runners
         (the /api/v1/model-info shape)."""
-        now = time.monotonic()
+        now = self.clock()
         with self._lock:
             out: dict = {}
             for st in sorted(self._runners.values(), key=lambda s: s.id):
@@ -115,9 +271,17 @@ class InferenceRouter:
                         out.setdefault(m, []).append(st.id)
             return out
 
-    def pick_runner(self, model: str) -> Optional[RunnerState]:
-        """Per-model round-robin over routable runners serving ``model``."""
-        now = time.monotonic()
+    def pick_runner(
+        self, model: str, exclude: Iterable[str] = ()
+    ) -> Optional[RunnerState]:
+        """Failure- and load-aware pick over routable runners serving
+        ``model``: skips runners in ``exclude`` (already tried this
+        request) and runners whose circuit breaker is open (or half-open
+        with no probe budget left), prefers the least-loaded of what
+        remains, and round-robins per model among ties — so with healthy
+        idle runners the behaviour is the seed's pure round-robin."""
+        now = self.clock()
+        exclude = set(exclude)
         with self._lock:
             candidates = [
                 st
@@ -125,10 +289,90 @@ class InferenceRouter:
                 if st.routable
                 and model in st.models
                 and now - st.last_heartbeat <= self.ttl
+                and st.id not in exclude
             ]
             if not candidates:
                 return None
+            allowed = [
+                st for st in candidates if self._breaker(st.id).allow()
+            ]
+            if not allowed:
+                return None
+            min_load = min(
+                self._inflight.get(st.id, 0) for st in allowed
+            )
+            least = [
+                st
+                for st in allowed
+                if self._inflight.get(st.id, 0) == min_load
+            ]
             cursor = self._rr.get(model, 0)
-            chosen = candidates[cursor % len(candidates)]
-            self._rr[model] = (cursor + 1) % max(len(candidates), 1)
+            chosen = least[cursor % len(least)]
+            self._rr[model] = (cursor + 1) % max(len(least), 1)
             return chosen
+
+    # -- dispatch feedback (breakers + load) -------------------------------
+
+    def record_dispatch_start(self, runner_id: str) -> int:
+        """The dispatcher is about to send a request to this runner.
+        Returns the breaker epoch token to pass back to record_*, so an
+        outcome that straddles a breaker state change is discarded
+        instead of, e.g., closing a half-open breaker on the strength of
+        a stream that started before the runner broke."""
+        with self._lock:
+            self._inflight[runner_id] = self._inflight.get(runner_id, 0) + 1
+            return self._breaker(runner_id).on_dispatch()
+
+    def _record(
+        self, runner_id: str, failure: bool, epoch: Optional[int] = None
+    ) -> None:
+        with self._lock:
+            self._inflight[runner_id] = max(
+                0, self._inflight.get(runner_id, 0) - 1
+            )
+            self._breaker(runner_id).record(failure=failure, epoch=epoch)
+            if runner_id not in self._runners:
+                # runner departed while this dispatch was in flight: once
+                # the last one lands, drop its state entirely
+                self._prune_dispatch_state(runner_id)
+
+    def record_success(
+        self, runner_id: str, epoch: Optional[int] = None
+    ) -> None:
+        self._record(runner_id, failure=False, epoch=epoch)
+
+    def record_failure(
+        self, runner_id: str, epoch: Optional[int] = None
+    ) -> None:
+        self._record(runner_id, failure=True, epoch=epoch)
+
+    def record_release(
+        self, runner_id: str, epoch: Optional[int] = None
+    ) -> None:
+        """Dispatch ended with no attributable outcome (client cancelled
+        mid-flight): free the in-flight slot and probe budget without
+        feeding the breaker's failure window or probe successes."""
+        with self._lock:
+            self._inflight[runner_id] = max(
+                0, self._inflight.get(runner_id, 0) - 1
+            )
+            self._breaker(runner_id).release(epoch=epoch)
+            if runner_id not in self._runners:
+                self._prune_dispatch_state(runner_id)
+
+    def inflight(self, runner_id: str) -> int:
+        with self._lock:
+            return self._inflight.get(runner_id, 0)
+
+    def breaker_states(self) -> dict:
+        """{runner_id: breaker snapshot + inflight} for /metrics and
+        operator introspection.  A runner evicted with dispatches still
+        in flight lingers until its last outcome lands, then is pruned."""
+        with self._lock:
+            return {
+                rid: {
+                    **br.snapshot(),
+                    "inflight": self._inflight.get(rid, 0),
+                }
+                for rid, br in sorted(self._breakers.items())
+            }

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import random
 import uuid
 
 import aiohttp
@@ -36,6 +37,43 @@ def _err(status, message, **extra):
     return web.json_response(
         {"error": {"message": message, **extra}}, status=status
     )
+
+
+class _RetryableDispatch(Exception):
+    """A dispatch attempt failed before the first streamed byte reached
+    the client (connect error, 5xx, tunnel closed): safe to fail over to
+    the next candidate runner."""
+
+
+class _DispatchAccount:
+    """Record exactly one outcome per dispatch attempt so the router's
+    in-flight counter and half-open probe budget can never leak or
+    double-count — cancellation (client gone) releases the slot without
+    blaming the runner."""
+
+    def __init__(self, router, runner_id: str):
+        self.router = router
+        self.runner_id = runner_id
+        self.done = False
+        self.epoch = router.record_dispatch_start(runner_id)
+
+    def success(self):
+        if not self.done:
+            self.done = True
+            self.router.record_success(self.runner_id, epoch=self.epoch)
+
+    def failure(self):
+        if not self.done:
+            self.done = True
+            self.router.record_failure(self.runner_id, epoch=self.epoch)
+
+    def release(self):
+        """Outcome unknowable (cancelled mid-flight): free the in-flight
+        slot and half-open probe budget without feeding the breaker — a
+        cancelled probe must neither close nor re-trip it."""
+        if not self.done:
+            self.done = True
+            self.router.record_release(self.runner_id, epoch=self.epoch)
 
 
 def _anthropic_sse_events(doc: dict):
@@ -147,6 +185,28 @@ class ControlPlane:
         self.store = Store(self.db)
         self.router = InferenceRouter()
         self.tunnels = TunnelHub()
+        # failure-aware dispatch (ISSUE 2): one shared client session for
+        # the whole dispatch path (created lazily on the event loop,
+        # closed via app.on_cleanup), bounded retry/failover with capped
+        # exponential backoff + jitter, counters for /metrics
+        self._dispatch_session = None
+        self.dispatch_max_attempts = int(
+            _os_env.environ.get("HELIX_DISPATCH_MAX_ATTEMPTS", "3")
+        )
+        self.dispatch_backoff_base = float(
+            _os_env.environ.get("HELIX_DISPATCH_BACKOFF_BASE", "0.05")
+        )
+        self.dispatch_backoff_cap = float(
+            _os_env.environ.get("HELIX_DISPATCH_BACKOFF_CAP", "1.0")
+        )
+        self.dispatch_total_timeout = float(
+            _os_env.environ.get("HELIX_DISPATCH_TIMEOUT", "300")
+        )
+        self.dispatch_retries = 0     # pre-stream failures that got a retry
+        self.dispatch_failovers = 0   # retries that landed on a runner
+        self.dispatch_exhausted = 0   # requests that ran out of candidates
+        self.dispatch_ok = 0
+        self.heartbeats_dropped = 0   # fault-injected heartbeat loss
         self.auth = Authenticator(self.db)
         self.billing = BillingService(self.db, usage_store=None)
         from helix_tpu.control.stripe import StripeService
@@ -1240,7 +1300,49 @@ class ControlPlane:
         # its tts-server sidecar; ours also runs standalone via
         # `helix-tpu tts-server`)
         r.add_post("/v1/audio/speech", self.audio_speech)
+        # serving-spine observability: breaker states, dispatch outcomes
+        r.add_get("/metrics", self.metrics)
+        # the shared dispatch ClientSession binds to the app's event loop
+        app.on_cleanup.append(self._close_dispatch_session)
         return app
+
+    async def metrics(self, request):
+        """Prometheus text surface for the control plane: per-runner
+        circuit-breaker state (0=closed 1=half_open 2=open), in-flight
+        dispatches, and dispatch retry/failover/shed outcomes."""
+        lines = [
+            "# TYPE helix_cp_dispatch_retries_total counter",
+            f"helix_cp_dispatch_retries_total {self.dispatch_retries}",
+            "# TYPE helix_cp_dispatch_failovers_total counter",
+            f"helix_cp_dispatch_failovers_total {self.dispatch_failovers}",
+            "# TYPE helix_cp_dispatch_exhausted_total counter",
+            f"helix_cp_dispatch_exhausted_total {self.dispatch_exhausted}",
+            "# TYPE helix_cp_dispatch_ok_total counter",
+            f"helix_cp_dispatch_ok_total {self.dispatch_ok}",
+            "# TYPE helix_cp_heartbeats_dropped_total counter",
+            f"helix_cp_heartbeats_dropped_total {self.heartbeats_dropped}",
+        ]
+        state_num = {"closed": 0, "half_open": 1, "open": 2}
+
+        def esc(label: str) -> str:
+            """Prometheus exposition-format label escaping — runner ids
+            arrive verbatim from the heartbeat URL path, and one stray
+            quote would invalidate the whole scrape."""
+            return (
+                label.replace("\\", "\\\\")
+                .replace('"', '\\"')
+                .replace("\n", "\\n")
+            )
+
+        for rid, snap in self.router.breaker_states().items():
+            t = f'{{runner="{esc(rid)}"}}'
+            lines += [
+                f"helix_cp_runner_breaker_state{t} "
+                f"{state_num.get(snap['state'], -1)}",
+                f"helix_cp_runner_breaker_opens_total{t} {snap['opens']}",
+                f"helix_cp_runner_inflight{t} {snap['inflight']}",
+            ]
+        return web.Response(text="\n".join(lines) + "\n")
 
     async def audio_speech(self, request):
         # one shared handler with the sidecar (validation + dispatch)
@@ -1300,6 +1402,15 @@ class ControlPlane:
         if denied is not None:
             return denied
         rid = request.match_info["id"]
+        from helix_tpu.testing import faults
+
+        inj = faults.active()
+        if inj is not None and inj.drop_heartbeat(rid):
+            # injected heartbeat loss: the runner believes it checked in,
+            # the router never hears it — it goes stale and is evicted
+            self.heartbeats_dropped += 1
+            self.router.evict_stale()
+            return web.json_response({"ok": True})
         body = await request.json()
         profile = body.get("profile", {})
         self.router.upsert_from_heartbeat(
@@ -4387,6 +4498,23 @@ class ControlPlane:
             return _err(404, "trigger not found or disabled")
         return web.json_response({"ok": True, "trigger": tid})
 
+    def _http_session(self) -> aiohttp.ClientSession:
+        """One shared ClientSession for the dispatch path (connection
+        pooling + keep-alive to the runners) instead of a session per
+        request; per-attempt deadlines are passed to ``post``.  Created
+        lazily so it binds to the serving event loop; closed by
+        ``_close_dispatch_session`` on app cleanup."""
+        if self._dispatch_session is None or self._dispatch_session.closed:
+            self._dispatch_session = aiohttp.ClientSession(
+                connector=aiohttp.TCPConnector(limit=256)
+            )
+        return self._dispatch_session
+
+    async def _close_dispatch_session(self, app=None):
+        session, self._dispatch_session = self._dispatch_session, None
+        if session is not None and not session.closed:
+            await session.close()
+
     async def dispatch_openai(self, request):
         """Pick a runner by model, stream the response through unbuffered
         (the SSE-preserving trick of ``helix_openai_server.go:279-307`` —
@@ -4394,7 +4522,16 @@ class ControlPlane:
 
         Runners with a routable address are dispatched over plain HTTP;
         NAT'd runners (no address) are dispatched through their reverse
-        tunnel (``helix_tpu.control.tunnel``)."""
+        tunnel (``helix_tpu.control.tunnel``).
+
+        Failure-aware (ISSUE 2): connect errors and 5xx received before
+        the first streamed byte fail over to the next candidate runner
+        (capped exponential backoff + jitter, bounded attempts, one total
+        deadline), every outcome feeds the router's per-runner circuit
+        breakers, and exhausting every candidate returns a clean
+        OpenAI-style 503 with Retry-After."""
+        from helix_tpu.testing import faults
+
         raw = await request.read()
         try:
             body = json.loads(raw)
@@ -4410,6 +4547,25 @@ class ControlPlane:
                 raw = json.dumps({**body, "model": model}).encode()
         runner = self.router.pick_runner(model)
         if runner is None:
+            if model and model in self.router.model_map():
+                # runners DO serve this model but none admits traffic
+                # right now (breakers open / probe budgets spent):
+                # overload, not a routing miss
+                self.dispatch_exhausted += 1
+                return web.json_response(
+                    {
+                        "error": {
+                            "message": (
+                                f"every runner serving '{model}' is "
+                                "circuit-broken; retry shortly"
+                            ),
+                            "type": "overloaded_error",
+                            "code": "runners_exhausted",
+                        }
+                    },
+                    status=503,
+                    headers={"Retry-After": "1"},
+                )
             # no self-hosted runner serves it: fall through to the
             # provider manager (external OpenAI-compatible/Anthropic
             # endpoints) so agents and API users reach the same model
@@ -4423,29 +4579,174 @@ class ControlPlane:
                 f"no runner serves model '{model}'",
                 available=self.router.available_models(),
             )
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.dispatch_total_timeout
+        tried: set = set()
+        last_err = "no candidate runner"
+        attempt = 0
+        while attempt < self.dispatch_max_attempts:
+            if runner is None:
+                runner = self.router.pick_runner(model, exclude=tried)
+                if runner is None and tried:
+                    # every distinct candidate already failed once this
+                    # request; revisit (faults may be transient) as long
+                    # as a breaker still admits traffic
+                    runner = self.router.pick_runner(model)
+                if runner is None:
+                    break
+                self.dispatch_failovers += 1   # a retry found a runner
+            attempt += 1
+            tried.add(runner.id)
+            acct = _DispatchAccount(self.router, runner.id)
+            try:
+                inj = faults.active()
+                fault = inj.dispatch_fault(runner.id) if inj else None
+                if fault is not None:
+                    if fault["mode"] == "slow_first_byte":
+                        await asyncio.sleep(fault["delay"])
+                    elif fault["mode"] == "http_500":
+                        raise _RetryableDispatch(
+                            f"runner {runner.id} returned 500 (injected)"
+                        )
+                    else:
+                        raise _RetryableDispatch(
+                            f"cannot connect to runner {runner.id} "
+                            "(injected)"
+                        )
+                return await self._dispatch_attempt(
+                    request, runner, raw, deadline, acct
+                )
+            except _RetryableDispatch as e:
+                last_err = str(e.__cause__ or e)
+            except (
+                aiohttp.ClientConnectionError,
+                aiohttp.ServerTimeoutError,
+                asyncio.TimeoutError,
+                ConnectionError,
+                OSError,
+            ) as e:
+                last_err = f"{type(e).__name__}: {e}"
+            except asyncio.CancelledError:
+                # client went away mid-attempt: release the runner's
+                # in-flight slot without blaming it, then propagate
+                acct.release()
+                raise
+            except Exception:
+                # anything else (malformed runner address -> InvalidURL,
+                # payload errors, ...) is a non-retryable dispatch
+                # failure: resolve the account so the in-flight counter
+                # and probe budget can't leak, then let the error
+                # middleware shape the 500
+                acct.failure()
+                raise
+            acct.failure()
+            runner = None
+            if attempt >= self.dispatch_max_attempts:
+                break
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            self.dispatch_retries += 1
+            backoff = min(
+                self.dispatch_backoff_cap,
+                self.dispatch_backoff_base * (2 ** (attempt - 1)),
+            ) * (0.5 + random.random() / 2)   # full-jitter-ish
+            await asyncio.sleep(min(backoff, remaining))
+        self.dispatch_exhausted += 1
+        return web.json_response(
+            {
+                "error": {
+                    "message": (
+                        f"all {max(len(tried), 1)} runner(s) for model "
+                        f"'{model}' are unavailable "
+                        f"({attempt} attempt(s); last error: {last_err})"
+                    ),
+                    "type": "overloaded_error",
+                    "code": "runners_exhausted",
+                }
+            },
+            status=503,
+            headers={"Retry-After": "1"},
+        )
+
+    async def _dispatch_attempt(self, request, runner, raw, deadline, acct):
+        """One dispatch to one runner.  Raises for failures before the
+        first streamed byte (the caller fails over); after headers are
+        committed, mid-stream runner death is reported in-band on SSE
+        responses and as an aborted connection on JSON bodies (a clean
+        EOF after a truncated JSON body would be indistinguishable from
+        a complete response)."""
         address = runner.meta.get("address")
         if not address:
-            return await self._dispatch_tunnel(request, runner, raw)
+            return await self._dispatch_tunnel(request, runner, raw, acct)
         url = f"{address}{request.path}"
-        timeout = aiohttp.ClientTimeout(total=300)  # 5 min budget, like the
-        # reference's dispatch watchdog (helix_openai_server.go:260)
-        async with aiohttp.ClientSession(timeout=timeout) as session:
-            async with session.post(
-                url, data=raw, headers={"Content-Type": "application/json"}
-            ) as upstream:
-                resp = web.StreamResponse(
-                    status=upstream.status,
-                    headers={
-                        "Content-Type": upstream.headers.get(
-                            "Content-Type", "application/json"
-                        )
-                    },
+        remaining = max(
+            1.0, deadline - asyncio.get_running_loop().time()
+        )
+        session = self._http_session()
+        async with session.post(
+            url,
+            data=raw,
+            headers={"Content-Type": "application/json"},
+            timeout=aiohttp.ClientTimeout(total=remaining),
+        ) as upstream:
+            if upstream.status >= 500:
+                raise _RetryableDispatch(
+                    f"runner {runner.id} returned {upstream.status} "
+                    "before streaming"
                 )
+            ctype = upstream.headers.get("Content-Type", "application/json")
+            resp = web.StreamResponse(
+                status=upstream.status, headers={"Content-Type": ctype}
+            )
+            # nothing below may propagate to the failover loop — once
+            # prepare() commits headers a retry cannot restart the
+            # response, and a client disconnect must release the runner's
+            # in-flight slot without blaming it
+            try:
                 await resp.prepare(request)
-                async for chunk in upstream.content.iter_any():
-                    await resp.write(chunk)
+                try:
+                    async for chunk in upstream.content.iter_any():
+                        await resp.write(chunk)
+                except asyncio.TimeoutError:
+                    # total dispatch deadline ran out mid-stream: the
+                    # deadline is ours, not the runner's fault — don't
+                    # feed the breaker a phantom failure
+                    acct.release()
+                    await self._abort_mid_stream(
+                        request, resp, ctype,
+                        "dispatch deadline exceeded mid-stream",
+                    )
+                    return resp
+                except aiohttp.ClientError as e:
+                    acct.failure()
+                    await self._abort_mid_stream(
+                        request, resp, ctype,
+                        "runner died mid-stream: " + str(e)[:200],
+                    )
+                    return resp
                 await resp.write_eof()
-                return resp
+                acct.success()
+                self.dispatch_ok += 1
+            except (ConnectionError, OSError):
+                acct.release()
+            except asyncio.CancelledError:
+                acct.release()
+                raise
+            return resp
+
+    @staticmethod
+    async def _abort_mid_stream(request, resp, ctype: str, message: str):
+        """Terminate a half-streamed response: SSE gets a terminal error
+        frame + clean EOF (already-streamed tokens stand); JSON bodies
+        get a hard connection abort so clients see a transport error
+        instead of silently-truncated JSON."""
+        if "text/event-stream" in ctype:
+            frame = json.dumps({"error": {"message": message}})
+            await resp.write(f"data: {frame}\n\n".encode())
+            await resp.write_eof()
+        elif request.transport is not None:
+            request.transport.close()
 
     async def _dispatch_anthropic_gateway(self, request, body: dict):
         """Native /v1/messages for models no runner serves: proxy to the
@@ -4553,11 +4854,12 @@ class ControlPlane:
         except ProviderError as e:
             return _err(e.status if 400 <= e.status < 600 else 502, str(e))
 
-    async def _dispatch_tunnel(self, request, runner, raw: bytes):
+    async def _dispatch_tunnel(self, request, runner, raw: bytes, acct):
         """Dispatch through the runner's reverse tunnel, preserving SSE
         chunk boundaries.  Mid-stream tunnel death surfaces as a terminal
-        SSE error frame (already-streamed tokens stand); pre-stream death
-        is a clean 502."""
+        SSE error frame on SSE responses / an aborted connection on JSON
+        bodies; pre-stream death raises so ``dispatch_openai`` fails over
+        to the next candidate."""
         from helix_tpu.control.tunnel import TunnelClosed
 
         try:
@@ -4569,33 +4871,40 @@ class ControlPlane:
                 raw,
             )
         except TunnelClosed as e:
-            return _err(502, f"runner {runner.id} unreachable: {e}")
+            raise _RetryableDispatch(
+                f"runner {runner.id} unreachable over tunnel"
+            ) from e
+        if status >= 500:
+            await chunks.aclose()
+            raise _RetryableDispatch(
+                f"runner {runner.id} returned {status} before streaming"
+            )
+        ctype = headers.get("Content-Type", "application/json")
         resp = web.StreamResponse(
-            status=status,
-            headers={
-                "Content-Type": headers.get(
-                    "Content-Type", "application/json"
-                )
-            },
+            status=status, headers={"Content-Type": ctype}
         )
-        await resp.prepare(request)
         try:
+            await resp.prepare(request)
             try:
                 async for chunk in chunks:
                     await resp.write(chunk)
             except TunnelClosed as e:
-                frame = json.dumps(
-                    {
-                        "error": {
-                            "message": "runner disconnected mid-stream: "
-                            + str(e)[:200]
-                        }
-                    }
+                acct.failure()
+                await self._abort_mid_stream(
+                    request, resp, ctype,
+                    "runner disconnected mid-stream: " + str(e)[:200],
                 )
-                await resp.write(f"data: {frame}\n\n".encode())
+                return resp
             await resp.write_eof()
+            acct.success()
+            self.dispatch_ok += 1
         except (ConnectionError, OSError):
             # client went away: chunks' generator-exit sends OP_CLOSE to
             # the runner so generation aborts instead of burning chips
             await chunks.aclose()
+            acct.release()
+        except asyncio.CancelledError:
+            await chunks.aclose()
+            acct.release()
+            raise
         return resp

@@ -831,6 +831,11 @@ class Engine:
             return
         self._finish(req, FinishReason.ABORT)
 
+    def get_request(self, req_id: str) -> Optional[Request]:
+        """Live view of a submitted request (engine-thread callers: the
+        quarantine path in EngineLoop inspects admission recency)."""
+        return self._requests.get(req_id)
+
     def has_work(self) -> bool:
         return bool(self.waiting) or any(s is not None for s in self.slots)
 

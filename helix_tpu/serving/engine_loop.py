@@ -6,6 +6,15 @@ same shape holds with cheaper parts: one dedicated thread owns the Engine
 (all JAX dispatch stays single-threaded), handlers submit via a thread-safe
 inbox and receive per-request events through callbacks marshalled onto their
 asyncio loop.
+
+Robustness (ISSUE 2): a failing ``engine.step()`` no longer aborts every
+in-flight request.  The loop retries the step once (transient faults), then
+quarantines the not-yet-emitting requests and bisects them back in to find
+the poisoned one(s) — only the culprit gets an error event, everything else
+keeps generating.  Admission is bounded (queue depth / queued-token budget)
+so overload sheds immediately with a clean ``queue_full`` error (HTTP 429)
+instead of rotting toward the queue timeout, and ``stop(drain=...)`` drains
+in-flight work before the thread exits.
 """
 
 from __future__ import annotations
@@ -17,6 +26,11 @@ import time
 from typing import Callable, Optional
 
 from helix_tpu.engine.engine import Engine, FinishReason, Request
+
+# error-message prefixes the HTTP layer maps onto statuses (429 / 503);
+# keep in sync with openai_api._engine_error_response
+QUEUE_FULL = "queue_full"
+SHUTTING_DOWN = "shutting_down"
 
 
 @dataclasses.dataclass
@@ -30,26 +44,92 @@ class TokenEvent:
 
 class EngineLoop:
     def __init__(self, engine: Engine, name: str = "engine",
-                 max_queue_seconds: float = 600.0):
+                 max_queue_seconds: float = 600.0,
+                 max_queue_depth: Optional[int] = None,
+                 max_queued_tokens: Optional[int] = None):
         self.engine = engine
         self.name = name
         self.max_queue_seconds = max_queue_seconds
+        # admission bounds: None = unbounded (seed behaviour).  Depth
+        # counts requests waiting for a slot (inbox + engine wait queue);
+        # tokens bound the queued prefill work so one burst of 32k
+        # prompts can't hide behind a small depth bound.
+        self.max_queue_depth = max_queue_depth
+        self.max_queued_tokens = max_queued_tokens
         self._inbox: "queue.Queue" = queue.Queue()
+        self._pending = 0          # submitted, not yet drained to the engine
+        self._pending_tokens = 0
+        # RLock: submit holds it across check+enqueue so the draining
+        # flag flip in stop() can be made atomic against in-flight submits
+        self._admission_lock = threading.RLock()
         self._subscribers: dict[str, Callable[[TokenEvent], None]] = {}
+        self._admit_order: list[str] = []   # request ids, admission order
         self._wake = threading.Event()
         self._stop = threading.Event()
+        self._draining = False
+        self._drain_deadline = 0.0
         self._thread: Optional[threading.Thread] = None
         self._last_reap = time.monotonic()
+        self._consec_failures = 0
+        self._barren_rounds = 0   # quarantine rounds that found no culprit
         # serving metrics (scraped by /metrics)
         self.steps = 0
+        self.step_failures = 0
+        self.step_retries = 0
+        self.quarantine_evictions = 0
+        self.shed_requests = 0
         self.started_at = time.monotonic()
 
     # -- called from any thread --------------------------------------------
 
+    def check_admission(
+        self, prompt_len: int, count_shed: bool = False
+    ) -> Optional[str]:
+        """Would a submit of this size be shed right now?  Returns the
+        error string (``queue_full: ...`` / ``shutting_down: ...``) or
+        None.  HTTP handlers pre-check so streaming requests get a clean
+        429/503 status instead of an SSE error frame; callers that act on
+        the verdict (actually shed the request) pass ``count_shed=True``
+        so the metric is owned here, in one place."""
+        err = self._check_admission(prompt_len)
+        if err is not None and count_shed:
+            self.shed_requests += 1
+        return err
+
+    def _check_admission(self, prompt_len: int) -> Optional[str]:
+        if self._draining or self._stop.is_set():
+            return f"{SHUTTING_DOWN}: engine '{self.name}' is draining"
+        # the engine-side sums are read without the admission lock (list
+        # copies are GIL-atomic; the bound is advisory by one request
+        # anyway), so overloaded submitters don't serialize on an O(n)
+        # walk of the wait queue
+        depth = self._pending + len(self.engine.waiting)
+        if (
+            self.max_queue_depth is not None
+            and depth >= self.max_queue_depth
+        ):
+            return (
+                f"{QUEUE_FULL}: {depth} request(s) already queued "
+                f"(max_queue_depth={self.max_queue_depth})"
+            )
+        if self.max_queued_tokens is not None:
+            queued = self._pending_tokens + sum(
+                len(r.prompt_tokens) for r in list(self.engine.waiting)
+            )
+            if queued + prompt_len > self.max_queued_tokens:
+                return (
+                    f"{QUEUE_FULL}: {queued} tokens queued + "
+                    f"{prompt_len} requested exceeds "
+                    f"max_queued_tokens={self.max_queued_tokens}"
+                )
+        return None
+
     def submit(self, req: Request, on_event: Callable[[TokenEvent], None]):
         # reject unservable requests on the caller's thread with a clean
         # event — the engine thread must never die on bad input
-        err = self.engine.validate_request(req)
+        err = self.engine.validate_request(req) or self.check_admission(
+            len(req.prompt_tokens), count_shed=True
+        )
         if err:
             on_event(
                 TokenEvent(
@@ -58,7 +138,24 @@ class EngineLoop:
                 )
             )
             return
-        self._inbox.put((req, on_event))
+        with self._admission_lock:
+            # re-check under the lock: stop() flips _draining inside the
+            # same lock, so a submit can never slip its request into the
+            # inbox after the engine thread's terminal sweep
+            if self._draining or self._stop.is_set():
+                self.shed_requests += 1
+                on_event(
+                    TokenEvent(
+                        request_id=req.id, token_id=-1, finished=True,
+                        finish_reason="error",
+                        error=f"{SHUTTING_DOWN}: engine '{self.name}' "
+                              "is draining",
+                    )
+                )
+                return
+            self._pending += 1
+            self._pending_tokens += len(req.prompt_tokens)
+            self._inbox.put((req, on_event))
         self._wake.set()
 
     def abort(self, request_id: str):
@@ -71,6 +168,10 @@ class EngineLoop:
         eng = self.engine
         return {
             "steps": self.steps,
+            "step_failures": self.step_failures,
+            "step_retries": self.step_retries,
+            "quarantine_evictions": self.quarantine_evictions,
+            "shed_requests": self.shed_requests,
             "prefill_tokens": eng.num_prefill_tokens,
             "decode_tokens": eng.num_decode_tokens,
             "mixed_steps": getattr(eng, "num_mixed_steps", 0),
@@ -88,7 +189,23 @@ class EngineLoop:
         self._thread.start()
         return self
 
-    def stop(self, join: bool = True):
+    def stop(self, join: bool = True, drain: float = 0.0):
+        """Stop the engine thread.  With ``drain > 0`` new submissions are
+        shed (``shutting_down`` -> 503) while in-flight requests keep
+        stepping for up to ``drain`` seconds; anything still unfinished at
+        the deadline gets a clean error event before the thread exits.
+        ``join=False`` + drain leaves the thread to finish the drain on
+        its own (it exits once idle or at the deadline)."""
+        if drain > 0 and self._thread is not None and self._thread.is_alive():
+            # deadline must be visible before the flag: the engine thread
+            # checks the deadline as soon as it sees _draining
+            self._drain_deadline = time.monotonic() + drain
+            with self._admission_lock:
+                self._draining = True
+            self._wake.set()
+            if not join:
+                return   # thread self-terminates when drained
+            self._thread.join(timeout=drain + 30)
         self._stop.set()
         self._wake.set()
         if join and self._thread is not None:
@@ -106,9 +223,15 @@ class EngineLoop:
                 self.engine.abort(item)
                 self._subscribers.pop(item, None)
             else:
+                with self._admission_lock:
+                    self._pending = max(0, self._pending - 1)
+                    self._pending_tokens = max(
+                        0, self._pending_tokens - len(item.prompt_tokens)
+                    )
                 try:
                     self.engine.add_request(item)
                     self._subscribers[item.id] = on_event
+                    self._admit_order.append(item.id)
                 except Exception as e:  # noqa: BLE001 — thread must survive
                     on_event(
                         TokenEvent(
@@ -117,9 +240,46 @@ class EngineLoop:
                         )
                     )
 
+    def _emit(self, emitted) -> None:
+        for req, token in emitted:
+            cb = self._subscribers.get(req.id)
+            if cb is None:
+                continue
+            cb(
+                TokenEvent(
+                    request_id=req.id,
+                    token_id=token,
+                    finished=req.finished,
+                    finish_reason=(
+                        req.finish_reason.value if req.finish_reason else None
+                    ),
+                )
+            )
+            if req.finished:
+                self._subscribers.pop(req.id, None)
+
+    def _step_once(self):
+        """One engine step, with the (normally disabled) fault-injection
+        hook in front so chaos tests can poison specific requests."""
+        from helix_tpu.testing import faults
+
+        inj = faults.active()
+        if inj is not None:
+            ids = [r.id for r in self.engine.slots if r is not None] + [
+                r.id for r in self.engine.waiting
+            ]
+            inj.maybe_fail_step(self.name, self.steps, ids)
+        return self.engine.step()
+
     def _run(self):
         while not self._stop.is_set():
             self._drain_inbox()
+            if self._draining:
+                if not self.engine.has_work():
+                    break
+                if time.monotonic() > self._drain_deadline:
+                    self._fail_all("drain deadline exceeded at shutdown")
+                    break
             if time.monotonic() - self._last_reap > 10.0:
                 self._last_reap = time.monotonic()
                 for req in self.engine.reap_stuck(self.max_queue_seconds):
@@ -137,39 +297,206 @@ class EngineLoop:
                 self._wake.clear()
                 continue
             try:
-                emitted = self.engine.step()
+                emitted = self._step_once()
             except Exception as e:  # noqa: BLE001 — fail requests, not the loop
+                self.step_failures += 1
+                self._consec_failures += 1
+                if self._consec_failures == 1:
+                    # transient faults (preemption, relay hiccup) clear on
+                    # an immediate retry of the exact same state
+                    self.step_retries += 1
+                    continue
                 import traceback
 
                 traceback.print_exc()
-                for req in list(self.engine.slots) + list(self.engine.waiting):
-                    if req is None:
-                        continue
-                    self.engine.abort(req.id)
-                    cb = self._subscribers.pop(req.id, None)
-                    if cb:
-                        cb(
-                            TokenEvent(
-                                request_id=req.id, token_id=-1,
-                                finished=True, finish_reason="error",
-                                error=f"engine step failed: {e}",
-                            )
-                        )
+                self._quarantine(e)
+                self._consec_failures = 0
                 continue
+            self._consec_failures = 0
+            self._barren_rounds = 0
             self.steps += 1
-            for req, token in emitted:
-                cb = self._subscribers.get(req.id)
-                if cb is None:
-                    continue
-                cb(
+            self._emit(emitted)
+        # terminal sweep: anything still in the inbox (raced a shutdown)
+        # gets a clean error event instead of a 300s client hang
+        while True:
+            try:
+                item, on_event = self._inbox.get_nowait()
+            except queue.Empty:
+                break
+            if on_event is not None:
+                on_event(
                     TokenEvent(
-                        request_id=req.id,
-                        token_id=token,
-                        finished=req.finished,
-                        finish_reason=(
-                            req.finish_reason.value if req.finish_reason else None
-                        ),
+                        request_id=item.id, token_id=-1, finished=True,
+                        finish_reason="error",
+                        error=f"{SHUTTING_DOWN}: engine '{self.name}' "
+                              "stopped",
                     )
                 )
-                if req.finished:
-                    self._subscribers.pop(req.id, None)
+
+    # -- poisoned-request quarantine ----------------------------------------
+
+    def _active_by_recency(self) -> list:
+        """Unfinished submitted requests, oldest admission first."""
+        out = []
+        for rid in self._admit_order:
+            req = self.engine.get_request(rid)
+            if req is not None and not req.finished:
+                out.append(req)
+        # prune finished ids so the order list doesn't grow unboundedly
+        self._admit_order = [r.id for r in out]
+        return out
+
+    def _evict(self, req, msg: str) -> None:
+        self.engine.abort(req.id)
+        self.quarantine_evictions += 1
+        cb = self._subscribers.pop(req.id, None)
+        if cb:
+            cb(
+                TokenEvent(
+                    request_id=req.id, token_id=-1, finished=True,
+                    finish_reason="error", error=msg,
+                )
+            )
+
+    @staticmethod
+    def _clone_for_readmit(req) -> Request:
+        """A fresh Request (same id — subscribers stay valid) for a
+        quarantined request that never emitted a token, so it can be
+        re-prefilled from scratch during bisection."""
+        return Request(
+            id=req.id,
+            prompt_tokens=list(req.prompt_tokens),
+            sampling=req.sampling,
+            stop_token_ids=req.stop_token_ids,
+            image_embeds=req.image_embeds,
+            image_positions=req.image_positions,
+            positions3=req.positions3,
+            mrope_delta=req.mrope_delta,
+        )
+
+    def _trial(self, group: list) -> bool:
+        """Re-admit ``group`` (clones) and step until each member emits or
+        finishes.  True = group is clean (members left running); False =
+        a step failed, members re-aborted (subscribers kept)."""
+        clones = []
+        for req in group:
+            clone = self._clone_for_readmit(req)
+            try:
+                self.engine.add_request(clone)
+            except Exception as e:  # noqa: BLE001 — validation changed?
+                self._evict(clone, f"engine rejected request: {e}")
+                continue
+            clones.append(clone)
+        if not clones:
+            return True
+        # budget: admission + every prefill chunk + slack; prevents an
+        # unbounded spin if a clone can never reach its first token
+        chunk = max(1, self.engine.cfg.max_prefill_len)
+        budget = 8 + sum(
+            len(c.prompt_tokens) // chunk + 1 for c in clones
+        )
+        for _ in range(budget):
+            try:
+                emitted = self._step_once()
+            except Exception:  # noqa: BLE001 — the culprit is in this group
+                for c in clones:
+                    self.engine.abort(c.id)
+                return False
+            self.steps += 1
+            self._emit(emitted)
+            if all(c.finished or c.output_tokens for c in clones):
+                return True
+        return True   # budget exhausted without a failure: call it clean
+
+    def _quarantine(self, err: Exception) -> None:
+        """The step failed twice on the same state: blame the most
+        recently admitted request(s) instead of aborting the world.
+
+        Requests that have not emitted a token yet (just-admitted — the
+        usual poison: a prompt whose prefill trips the fault) can be
+        safely re-prefilled, so they are pulled out and bisected back in;
+        only the subset whose re-admission still fails the step is
+        evicted.  A control step with the suspects removed guards the
+        other direction: if the fault persists without them, it lives in
+        an already-emitting request — the suspects are re-admitted
+        untouched and requests are shed newest-first instead (bounded
+        collateral, never abort-all)."""
+        active = self._active_by_recency()
+        suspects = [r for r in active if not r.output_tokens]
+        emitting = [r for r in active if r.output_tokens]
+        if suspects:
+            for r in suspects:
+                self.engine.abort(r.id)   # keep subscribers: clones re-emit
+            if emitting and self.engine.has_work():
+                # control step: suspects quarantined, only the emitting
+                # set runs.  A failure here exonerates the suspects.
+                try:
+                    emitted = self._step_once()
+                    self.steps += 1
+                    self._emit(emitted)
+                except Exception:  # noqa: BLE001 — fault is in the batch
+                    for r in suspects:
+                        try:
+                            self.engine.add_request(
+                                self._clone_for_readmit(r)
+                            )
+                        except Exception as e:  # noqa: BLE001
+                            self._evict(r, f"engine rejected request: {e}")
+                    self._evict(
+                        emitting[-1],
+                        f"evicted after repeated engine step failures "
+                        f"({err})",
+                    )
+                    return
+            culprits: list = []
+            stack = [suspects]
+            while stack:
+                group = stack.pop()
+                if self._trial(group):
+                    continue
+                if len(group) == 1:
+                    culprits.append(group[0])
+                    continue
+                mid = len(group) // 2
+                stack.append(group[:mid])    # older half
+                stack.append(group[mid:])    # newer half tested first
+            for r in culprits:
+                self.quarantine_evictions += 1
+                cb = self._subscribers.pop(r.id, None)
+                if cb:
+                    cb(
+                        TokenEvent(
+                            request_id=r.id, token_id=-1, finished=True,
+                            finish_reason="error",
+                            error=f"request quarantined: engine step "
+                                  f"failed while scheduled ({err})",
+                        )
+                    )
+            if culprits:
+                self._barren_rounds = 0
+                return
+            # all suspects came back clean: either the fault was
+            # transient (give the loop one more chance) or it lives in an
+            # already-emitting request (shed newest-first next round)
+            self._barren_rounds += 1
+            if self._barren_rounds < 2:
+                return
+        # no fresh suspect to blame — shed the most recently admitted
+        # active request and let the loop retry with the remainder
+        if active:
+            self._evict(
+                active[-1],
+                f"evicted after repeated engine step failures ({err})",
+            )
+
+    def _fail_all(self, msg: str) -> None:
+        for req in self._active_by_recency():
+            self.engine.abort(req.id)
+            cb = self._subscribers.pop(req.id, None)
+            if cb:
+                cb(
+                    TokenEvent(
+                        request_id=req.id, token_id=-1, finished=True,
+                        finish_reason="error", error=msg,
+                    )
+                )

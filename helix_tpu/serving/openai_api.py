@@ -24,6 +24,7 @@ from aiohttp import web
 
 from helix_tpu.engine.engine import Request
 from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.serving.engine_loop import QUEUE_FULL, SHUTTING_DOWN
 from helix_tpu.serving.registry import ModelRegistry
 from helix_tpu.serving.tokenizer import IncrementalDetokenizer, _content_text
 
@@ -48,25 +49,52 @@ def _longpoll_pool():
     return _LONGPOLL_POOL
 
 
-def _error(status: int, message: str, etype: str = "invalid_request_error"):
+def _error(status: int, message: str, etype: str = "invalid_request_error",
+           headers: Optional[dict] = None):
     return web.json_response(
-        {"error": {"message": message, "type": etype}}, status=status
+        {"error": {"message": message, "type": etype}}, status=status,
+        headers=headers,
     )
 
 
 class EngineRequestError(Exception):
     """A request the engine rejected or failed mid-flight; surfaces as a
-    structured 400/500 instead of a dead stream."""
+    structured 4xx/5xx instead of a dead stream."""
+
+
+def _engine_error_response(e: Exception):
+    """Map an engine error onto its HTTP shape: shed load is a clean 429
+    with Retry-After, drain is 503, engine timeouts are 504, everything
+    else stays the seed's 400."""
+    msg = str(e)
+    if msg.startswith(QUEUE_FULL):
+        return _error(429, msg, "overloaded_error",
+                      headers={"Retry-After": "1"})
+    if msg.startswith(SHUTTING_DOWN):
+        return _error(503, msg, "overloaded_error",
+                      headers={"Retry-After": "5"})
+    if msg.startswith("inter_token_timeout"):
+        return _error(504, msg, "timeout_error")
+    return _error(400, msg)
 
 
 class OpenAIServer:
-    def __init__(self, registry: ModelRegistry, metrics=None):
+    def __init__(self, registry: ModelRegistry, metrics=None,
+                 inter_token_timeout: Optional[float] = None):
+        import os
         from helix_tpu.serving.logbuf import install as install_logbuf
 
         self.registry = registry
         self.metrics = metrics
         self.started = time.monotonic()
         self.logbuf = install_logbuf()
+        # max seconds between consecutive engine events for one request
+        # before the server gives up on it (wedged engine watchdog)
+        self.inter_token_timeout = (
+            inter_token_timeout
+            if inter_token_timeout is not None
+            else float(os.environ.get("HELIX_INTER_TOKEN_TIMEOUT", "300"))
+        )
 
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
@@ -144,6 +172,16 @@ class OpenAIServer:
                 f"helix_active_slots{tag} "
                 f"{sum(1 for s in eng.slots if s is not None)}",
                 f"helix_free_pages{tag} {eng.allocator.free_pages}",
+                # robustness spine: step failure/retry/quarantine/shed
+                # accounting (ISSUE 2)
+                f"helix_step_failures_total{tag} "
+                f"{getattr(m.loop, 'step_failures', 0)}",
+                f"helix_step_retries_total{tag} "
+                f"{getattr(m.loop, 'step_retries', 0)}",
+                f"helix_quarantine_evictions_total{tag} "
+                f"{getattr(m.loop, 'quarantine_evictions', 0)}",
+                f"helix_shed_requests_total{tag} "
+                f"{getattr(m.loop, 'shed_requests', 0)}",
             ]
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
@@ -290,6 +328,20 @@ class OpenAIServer:
             404, f"'{model}' does not serve generation", "model_not_found"
         )
 
+    @staticmethod
+    def _precheck_admission(served, prompt_ids):
+        """Shed before committing response headers: streaming handlers
+        prepare() the SSE response before the first engine event, so a
+        queue_full discovered after submit can only surface as an in-band
+        error frame — this pre-check turns it into a real 429/503."""
+        check = getattr(served.loop, "check_admission", None)
+        if check is None:
+            return None
+        err = check(len(prompt_ids), count_shed=True)
+        if err is None:
+            return None
+        return _engine_error_response(EngineRequestError(err))
+
     def _sampling_from_body(self, body: dict) -> SamplingParams:
         stop = body.get("stop") or []
         if isinstance(stop, str):
@@ -330,7 +382,20 @@ class OpenAIServer:
         emitted_len = 0
         try:
             while True:
-                ev = await asyncio.wait_for(q.get(), timeout=300)
+                try:
+                    ev = await asyncio.wait_for(
+                        q.get(), timeout=self.inter_token_timeout
+                    )
+                except asyncio.TimeoutError:
+                    # never leak a raw TimeoutError (dead stream / bare
+                    # 500): abort the engine request and surface a typed
+                    # error the handlers map to 504 / an SSE error event
+                    served.loop.abort(req.id)
+                    raise EngineRequestError(
+                        f"inter_token_timeout: no engine event for "
+                        f"{self.inter_token_timeout:.0f}s; request "
+                        f"{req.id} aborted"
+                    ) from None
                 if ev.error:
                     raise EngineRequestError(ev.error)
                 is_eos = ev.token_id in served.tokenizer.eos_ids
@@ -401,6 +466,9 @@ class OpenAIServer:
             prompt_ids = served.tokenizer.apply_chat_template(
                 messages, add_generation_prompt=True
             )
+        shed = self._precheck_admission(served, prompt_ids)
+        if shed is not None:
+            return shed
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         created = _now()
 
@@ -467,7 +535,7 @@ class OpenAIServer:
                 finish_reason = reason or "stop"
                 break
         except EngineRequestError as e:
-            return _error(400, str(e))
+            return _engine_error_response(e)
         return web.json_response(
             {
                 "id": rid,
@@ -510,6 +578,9 @@ class OpenAIServer:
             prompt = prompt[0] if prompt else ""
         sampling = self._sampling_from_body(body)
         prompt_ids = served.tokenizer.encode(prompt)
+        shed = self._precheck_admission(served, prompt_ids)
+        if shed is not None:
+            return shed
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
         created = _now()
 
@@ -548,7 +619,7 @@ class OpenAIServer:
                 finish_reason = reason or "stop"
                 break
         except EngineRequestError as e:
-            return _error(400, str(e))
+            return _engine_error_response(e)
         return web.json_response(
             {
                 "id": rid,
@@ -663,6 +734,9 @@ class OpenAIServer:
         prompt_ids = served.tokenizer.apply_chat_template(
             messages, add_generation_prompt=True
         )
+        shed = self._precheck_admission(served, prompt_ids)
+        if shed is not None:
+            return shed
         rid = f"msg_{uuid.uuid4().hex[:20]}"
 
         if body.get("stream"):
@@ -750,7 +824,7 @@ class OpenAIServer:
                 stop_reason = "max_tokens" if reason == "length" else "end_turn"
                 break
         except EngineRequestError as e:
-            return _error(400, str(e), "invalid_request_error")
+            return _engine_error_response(e)
         return web.json_response(
             {
                 "id": rid,

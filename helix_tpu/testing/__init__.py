@@ -1,0 +1,1 @@
+"""Test-support machinery shipped with the package (fault injection)."""

@@ -1,0 +1,192 @@
+"""Deterministic fault injection for the serving spine.
+
+Chaos tests (and ``tools/chaos_soak.py``) need to *prove* tail behaviour
+under faults — a runner that refuses connections, an engine step that
+raises while a poisoned request is scheduled, a runner whose heartbeats
+stop arriving — and prove it deterministically, so the assertions hold on
+every run.  This module is the single switchboard: production code calls
+the tiny hooks below (``maybe_fail_step``, ``dispatch_fault``,
+``drop_heartbeat``), which are no-ops unless an injector has been armed
+programmatically (tests) or via the ``HELIX_FAULTS`` env var (soak tools,
+staging).
+
+Determinism contract: every probabilistic rule draws from one seeded
+``random.Random``; with a fixed seed and a fixed call order the exact
+sequence of injected faults is reproducible.  Counting rules (``times``,
+``on_step``) are exact regardless of seed.
+
+Rule shapes (dicts, JSON-friendly for the env var)::
+
+    {"point": "engine_step", "engine": "*", "on_step": 7, "times": 1}
+    {"point": "engine_step", "request_id_contains": "poison"}
+    {"point": "dispatch", "runner": "r1", "mode": "connect_error", "p": 0.3}
+    {"point": "dispatch", "runner": "*", "mode": "http_500", "times": 4}
+    {"point": "dispatch", "runner": "r2", "mode": "slow_first_byte",
+     "delay": 0.5}
+    {"point": "heartbeat", "runner": "r1"}          # drop heartbeats
+
+``times`` caps how often a rule fires (omit for unlimited); ``p`` gates
+each match through the seeded RNG (omit for always).
+
+Env form: ``HELIX_FAULTS='{"seed": 42, "rules": [...]}'``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+from typing import Optional
+
+ENV_VAR = "HELIX_FAULTS"
+
+DISPATCH_MODES = ("connect_error", "http_500", "slow_first_byte")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed injection point (engine-step faults)."""
+
+
+class FaultInjector:
+    def __init__(self, seed: int = 0, rules: Optional[list] = None):
+        self._lock = threading.Lock()
+        self.reset(seed=seed, rules=rules)
+
+    def reset(self, seed: int = 0, rules: Optional[list] = None) -> None:
+        with self._lock:
+            self.seed = seed
+            self.rng = random.Random(seed)
+            self.rules = [dict(r) for r in (rules or [])]
+            self.fired: dict[int, int] = {}   # rule index -> fire count
+
+    def add_rule(self, **rule) -> None:
+        with self._lock:
+            self.rules.append(dict(rule))
+
+    def clear(self) -> None:
+        self.reset(seed=self.seed)
+
+    # -- internals ---------------------------------------------------------
+
+    def _try_fire(self, idx: int, rule: dict) -> bool:
+        """Apply the ``times`` cap and the seeded ``p`` gate (must be
+        called with the lock held)."""
+        times = rule.get("times")
+        if times is not None and self.fired.get(idx, 0) >= times:
+            return False
+        p = rule.get("p")
+        if p is not None and self.rng.random() >= float(p):
+            return False
+        self.fired[idx] = self.fired.get(idx, 0) + 1
+        return True
+
+    # -- injection points --------------------------------------------------
+
+    def maybe_fail_step(
+        self, engine_name: str, step_no: int, request_ids: list
+    ) -> None:
+        """Raise FaultInjected if an engine_step rule matches this step.
+
+        ``request_ids`` are the requests the step would touch (slots +
+        waiting), so a ``request_id_contains`` rule models a poisoned
+        request: the step fails every time that request is scheduled and
+        recovers the moment it is evicted."""
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "engine_step":
+                    continue
+                eng = rule.get("engine", "*")
+                if eng not in ("*", engine_name):
+                    continue
+                frag = rule.get("request_id_contains")
+                if frag is not None and not any(
+                    frag in rid for rid in request_ids
+                ):
+                    continue
+                on_step = rule.get("on_step")
+                if on_step is not None and step_no != on_step:
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                raise FaultInjected(
+                    f"injected engine-step fault (engine={engine_name}, "
+                    f"step={step_no}, rule={idx})"
+                )
+
+    def dispatch_fault(self, runner_id: str) -> Optional[dict]:
+        """Return the fault to apply to this dispatch attempt, or None.
+
+        The caller (``dispatch_openai``) turns ``connect_error`` into an
+        aiohttp connection error, ``http_500`` into a synthetic 5xx before
+        the first streamed byte, and ``slow_first_byte`` into a sleep of
+        ``delay`` seconds before contacting the runner."""
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "dispatch":
+                    continue
+                if rule.get("runner", "*") not in ("*", runner_id):
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                return {
+                    "mode": rule.get("mode", "connect_error"),
+                    "delay": float(rule.get("delay", 0.0)),
+                    "runner": runner_id,
+                }
+        return None
+
+    def drop_heartbeat(self, runner_id: str) -> bool:
+        """True if this runner's heartbeat should be dropped on the floor
+        (models heartbeat loss: the runner goes stale and is evicted)."""
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "heartbeat":
+                    continue
+                if rule.get("runner", "*") not in ("*", runner_id):
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                return True
+        return False
+
+
+# -- module-level switchboard ---------------------------------------------
+
+_INSTANCE: Optional[FaultInjector] = None
+_ENV_CHECKED = False
+
+
+def arm(seed: int = 0, rules: Optional[list] = None) -> FaultInjector:
+    """Install (or re-seed) the global injector; returns it."""
+    global _INSTANCE
+    if _INSTANCE is None:
+        _INSTANCE = FaultInjector(seed=seed, rules=rules)
+    else:
+        _INSTANCE.reset(seed=seed, rules=rules)
+    return _INSTANCE
+
+
+def disarm() -> None:
+    """Remove the global injector: every hook becomes a no-op again."""
+    global _INSTANCE, _ENV_CHECKED
+    _INSTANCE = None
+    _ENV_CHECKED = True   # don't resurrect from the env after explicit disarm
+
+
+def active() -> Optional[FaultInjector]:
+    """The armed injector, or None.  Checks ``HELIX_FAULTS`` once, lazily,
+    so soak tools can configure faults without touching test code."""
+    global _INSTANCE, _ENV_CHECKED
+    if _INSTANCE is None and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        spec = os.environ.get(ENV_VAR)
+        if spec:
+            try:
+                doc = json.loads(spec)
+                _INSTANCE = FaultInjector(
+                    seed=int(doc.get("seed", 0)), rules=doc.get("rules", [])
+                )
+            except (ValueError, TypeError) as e:
+                raise ValueError(f"invalid {ENV_VAR} JSON: {e}") from e
+    return _INSTANCE

@@ -138,6 +138,7 @@ class TestCLIAdminVerbs:
         rc, out = self._run(["secret", "list", "--url", stack], capsys)
         assert "API_TOKEN" not in out
 
+    @pytest.mark.slow  # ~20s ingest+reindex wait; CLI verbs stay tier-1
     def test_knowledge_create_and_search(self, stack, capsys, tmp_path):
         doc = tmp_path / "notes.md"
         doc.write_text("# Ops\nThe flux capacitor needs 1.21 gigawatts.\n")

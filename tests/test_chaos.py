@@ -1,0 +1,460 @@
+"""Chaos suite: deterministic fault injection against the serving spine.
+
+Proves the ISSUE 2 acceptance bar end to end, all under fixed seeds:
+
+- 30% injected dispatch failures -> every request still completes via
+  failover, and a hard-failing runner's breaker opens then half-open
+  recovers (visible in the control plane's /metrics);
+- one injected poisoned request -> only that request errors; every other
+  in-flight request keeps generating and finishes;
+- admission bounds exceeded -> immediate clean 429/queue_full, never a
+  slow rot toward the queue timeout.
+
+Fast lane (unmarked-slow, ``-m chaos`` selectable) runs in tier-1; the
+randomized soak rides in ``tools/chaos_soak.py`` behind the slow marker.
+"""
+
+import asyncio
+import json
+import threading
+import time
+
+import pytest
+import requests
+
+from helix_tpu.control.router import BreakerConfig, InferenceRouter
+from helix_tpu.control.server import ControlPlane
+from helix_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# dispatch failover / breakers (control plane + two stub runners)
+# ---------------------------------------------------------------------------
+
+def _serve_app(app, holder):
+    """Serve ``app`` on an ephemeral port from a background thread;
+    returns the bound port (no fixed ports -> no rebind races)."""
+    started = threading.Event()
+    box = {}
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        box["port"] = site._server.sockets[0].getsockname()[1]
+        holder.setdefault("loops", []).append(loop)
+        started.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert started.wait(10)
+    return box["port"]
+
+
+def _stub_runner_app(name, hits):
+    from aiohttp import web
+
+    async def chat(request):
+        hits[name] = hits.get(name, 0) + 1
+        return web.json_response(
+            {
+                "id": f"chatcmpl-{name}",
+                "object": "chat.completion",
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant",
+                                    "content": f"hello from {name}"},
+                        "finish_reason": "stop",
+                    }
+                ],
+            }
+        )
+
+    app = web.Application()
+    app.router.add_post("/v1/chat/completions", chat)
+    return app
+
+
+@pytest.fixture()
+def chaos_cp():
+    """A control plane + two live stub runners serving model 'm1'."""
+    cp = ControlPlane()
+    # lenient breakers by default; individual tests override
+    cp.router = InferenceRouter(
+        breaker=BreakerConfig(min_samples=8, failure_threshold=0.7)
+    )
+    cp.dispatch_backoff_base = 0.001
+    cp.dispatch_backoff_cap = 0.002
+    holder = {}
+    hits = {}
+    good_port = _serve_app(_stub_runner_app("good", hits), holder)
+    bad_port = _serve_app(_stub_runner_app("bad", hits), holder)
+    cp_port = _serve_app(cp.build_app(), holder)
+    ports = {"bad": bad_port, "good": good_port}
+    for rid, port in ports.items():
+        cp.router.upsert_from_heartbeat(
+            rid, models=["m1"], profile_name="p",
+            profile_status="running",
+            meta={"address": f"http://127.0.0.1:{port}"},
+        )
+    yield cp, f"http://127.0.0.1:{cp_port}", hits, ports
+    cp.stop()
+    for loop in holder.get("loops", []):
+        loop.call_soon_threadsafe(loop.stop)
+
+
+def _chat(url, timeout=15):
+    return requests.post(
+        f"{url}/v1/chat/completions",
+        json={"model": "m1",
+              "messages": [{"role": "user", "content": "hi"}]},
+        timeout=timeout,
+    )
+
+
+class TestDispatchFailover:
+    def test_30pct_dispatch_faults_all_requests_complete(self, chaos_cp):
+        cp, url, hits, ports = chaos_cp
+        cp.dispatch_max_attempts = 6
+        faults.arm(
+            seed=1234,
+            rules=[{"point": "dispatch", "runner": "*",
+                    "mode": "connect_error", "p": 0.3}],
+        )
+        codes = [_chat(url).status_code for _ in range(20)]
+        assert codes == [200] * 20
+        assert cp.dispatch_retries > 0          # faults really fired
+        assert cp.dispatch_ok == 20
+        m = requests.get(f"{url}/metrics", timeout=5).text
+        assert "helix_cp_dispatch_retries_total" in m
+        assert f"helix_cp_dispatch_ok_total {cp.dispatch_ok}" in m
+
+    def test_hard_failing_runner_breaker_opens_then_recovers(self, chaos_cp):
+        cp, url, hits, ports = chaos_cp
+        cp.router = InferenceRouter(
+            breaker=BreakerConfig(
+                min_samples=2, failure_threshold=0.5, cooldown=0.5,
+                half_open_probes=1, half_open_successes=1,
+            )
+        )
+        for rid, port in ports.items():
+            cp.router.upsert_from_heartbeat(
+                rid, models=["m1"], profile_name="p",
+                profile_status="running",
+                meta={"address": f"http://127.0.0.1:{port}"},
+            )
+        # runner 'bad' refuses exactly its first two dispatches
+        faults.arm(
+            seed=7,
+            rules=[{"point": "dispatch", "runner": "bad",
+                    "mode": "http_500", "times": 2}],
+        )
+        for _ in range(4):
+            assert _chat(url).status_code == 200   # failover hides faults
+        assert cp.router.breaker_states()["bad"]["state"] == "open"
+        m = requests.get(f"{url}/metrics", timeout=5).text
+        assert 'helix_cp_runner_breaker_state{runner="bad"} 2' in m
+        # while open, traffic avoids 'bad' entirely
+        before = hits.get("bad", 0)
+        for _ in range(3):
+            assert _chat(url).status_code == 200
+        assert hits.get("bad", 0) == before
+        # cooldown elapses -> half-open probe -> success closes it
+        time.sleep(0.6)
+        for _ in range(4):
+            assert _chat(url).status_code == 200
+        assert cp.router.breaker_states()["bad"]["state"] == "closed"
+        assert hits.get("bad", 0) > before      # probe actually landed
+        m = requests.get(f"{url}/metrics", timeout=5).text
+        assert 'helix_cp_runner_breaker_state{runner="bad"} 0' in m
+
+    def test_all_candidates_exhausted_clean_503(self, chaos_cp):
+        cp, url, hits, ports = chaos_cp
+        faults.arm(
+            seed=3,
+            rules=[{"point": "dispatch", "runner": "*",
+                    "mode": "connect_error", "p": 1.0}],
+        )
+        r = _chat(url)
+        assert r.status_code == 503
+        assert r.headers.get("Retry-After") == "1"
+        body = r.json()["error"]
+        assert body["code"] == "runners_exhausted"
+        assert body["type"] == "overloaded_error"
+        assert cp.dispatch_exhausted >= 1
+
+    def test_heartbeat_loss_evicts_runner(self, chaos_cp):
+        cp, url, hits, ports = chaos_cp
+        faults.arm(
+            seed=0, rules=[{"point": "heartbeat", "runner": "hb-lost"}]
+        )
+        r = requests.post(
+            f"{url}/api/v1/runners/hb-lost/heartbeat",
+            json={"profile": {"models": ["m1"], "name": "p",
+                              "status": "running"},
+                  "address": "http://127.0.0.1:1"},
+            timeout=5,
+        )
+        assert r.status_code == 200   # loss is silent to the runner
+        assert cp.router.get("hb-lost") is None
+        assert cp.heartbeats_dropped == 1
+        m = requests.get(f"{url}/metrics", timeout=5).text
+        assert "helix_cp_heartbeats_dropped_total 1" in m
+
+
+# ---------------------------------------------------------------------------
+# engine-side: poisoned-request quarantine + admission bounds (real engine)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    import jax
+
+    from helix_tpu.engine.engine import Engine, EngineConfig
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(5))
+
+    def make_engine():
+        return Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=4, page_size=4, num_pages=256,
+                max_pages_per_seq=32, max_prefill_len=64,
+                attn_backend="reference", eos_token_ids=tok.eos_ids,
+            ),
+        )
+
+    return make_engine, tok
+
+
+class _Collector:
+    """Terminal + token events for one request."""
+
+    def __init__(self):
+        self.events = []
+        self.done = threading.Event()
+
+    def __call__(self, ev):
+        self.events.append(ev)
+        if ev.finished:
+            self.done.set()
+
+    @property
+    def error(self):
+        return next((e.error for e in self.events if e.error), None)
+
+    @property
+    def tokens(self):
+        return [e.token_id for e in self.events if e.token_id >= 0]
+
+
+def _mk_req(rid, n=8, max_tokens=24):
+    from helix_tpu.engine.engine import Request
+    from helix_tpu.engine.sampling import SamplingParams
+
+    return Request(
+        id=rid, prompt_tokens=list(range(4, 4 + n)),
+        sampling=SamplingParams(max_tokens=max_tokens, seed=0),
+        stop_token_ids=(1,),
+    )
+
+
+class TestPoisonQuarantine:
+    def test_poisoned_request_evicted_others_survive(
+        self, tiny_engine_parts
+    ):
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        make_engine, _ = tiny_engine_parts
+        loop = EngineLoop(make_engine(), "chaos-q").start()
+        try:
+            innocents = {}
+            for rid in ("keep-1", "keep-2"):
+                col = _Collector()
+                innocents[rid] = col
+                loop.submit(_mk_req(rid, max_tokens=48), col)
+            # let the innocents start emitting before the poison arrives
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not all(
+                c.tokens for c in innocents.values()
+            ):
+                time.sleep(0.02)
+            assert all(c.tokens for c in innocents.values())
+
+            faults.arm(
+                seed=11,
+                rules=[{"point": "engine_step",
+                        "request_id_contains": "poison"}],
+            )
+            poison = _Collector()
+            loop.submit(_mk_req("poison-1", max_tokens=8), poison)
+            assert poison.done.wait(60)
+            assert "quarantined" in (poison.error or "")
+            # every other in-flight request finishes, error-free
+            for rid, col in innocents.items():
+                assert col.done.wait(60), f"{rid} stuck"
+                assert col.error is None, f"{rid}: {col.error}"
+            assert loop.quarantine_evictions == 1
+            assert loop.step_retries >= 1
+
+            # the engine keeps serving after recovery
+            faults.disarm()
+            after = _Collector()
+            loop.submit(_mk_req("after-1", max_tokens=4), after)
+            assert after.done.wait(60)
+            assert after.error is None
+        finally:
+            faults.disarm()
+            loop.stop(join=False)
+
+    def test_bisection_isolates_poison_among_fresh_batch(
+        self, tiny_engine_parts
+    ):
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        make_engine, _ = tiny_engine_parts
+        faults.arm(
+            seed=13,
+            rules=[{"point": "engine_step",
+                    "request_id_contains": "poison"}],
+        )
+        loop = EngineLoop(make_engine(), "chaos-b").start()
+        try:
+            cols = {}
+            for rid in ("fresh-1", "poison-a", "fresh-2", "poison-b"):
+                col = _Collector()
+                cols[rid] = col
+                loop.submit(_mk_req(rid, max_tokens=6), col)
+            for rid, col in cols.items():
+                assert col.done.wait(90), f"{rid} stuck"
+            for rid in ("poison-a", "poison-b"):
+                assert "quarantined" in (cols[rid].error or ""), rid
+            for rid in ("fresh-1", "fresh-2"):
+                assert cols[rid].error is None, f"{rid}: {cols[rid].error}"
+            assert loop.quarantine_evictions == 2
+        finally:
+            faults.disarm()
+            loop.stop(join=False)
+
+
+class TestAdmissionBounds:
+    def test_queue_depth_shed_is_immediate(self, tiny_engine_parts):
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        make_engine, _ = tiny_engine_parts
+        # depth 0: every submit sheds without touching the engine thread
+        loop = EngineLoop(make_engine(), "shed", max_queue_depth=0)
+        col = _Collector()
+        t0 = time.monotonic()
+        loop.submit(_mk_req("r1"), col)
+        assert time.monotonic() - t0 < 1.0      # immediate, no queueing
+        assert col.done.is_set()
+        assert (col.error or "").startswith("queue_full")
+        assert loop.shed_requests == 1
+
+    def test_queued_token_budget_shed(self, tiny_engine_parts):
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        make_engine, _ = tiny_engine_parts
+        loop = EngineLoop(make_engine(), "shed-tok", max_queued_tokens=8)
+        col = _Collector()
+        loop.submit(_mk_req("big", n=16), col)
+        assert (col.error or "").startswith("queue_full")
+
+    def test_http_429_with_retry_after(self, tiny_engine_parts):
+        from helix_tpu.serving.engine_loop import EngineLoop
+        from helix_tpu.serving.openai_api import OpenAIServer
+        from helix_tpu.serving.registry import ModelRegistry, ServedModel
+
+        make_engine, tok = tiny_engine_parts
+        registry = ModelRegistry()
+        registry.register(
+            ServedModel(
+                name="tiny-shed",
+                loop=EngineLoop(make_engine(), "shed-http",
+                                max_queue_depth=0),
+                tokenizer=tok, context_length=128,
+            )
+        )
+        holder = {}
+        port = _serve_app(OpenAIServer(registry).build_app(), holder)
+        try:
+            for stream in (False, True):
+                r = requests.post(
+                    f"http://127.0.0.1:{port}/v1/chat/completions",
+                    json={"model": "tiny-shed", "stream": stream,
+                          "messages": [{"role": "user", "content": "x"}]},
+                    timeout=10,
+                )
+                assert r.status_code == 429, r.text
+                assert r.headers.get("Retry-After") == "1"
+                assert r.json()["error"]["type"] == "overloaded_error"
+            m = requests.get(
+                f"http://127.0.0.1:{port}/metrics", timeout=5
+            ).text
+            assert "helix_shed_requests_total" in m
+        finally:
+            for loop in holder.get("loops", []):
+                loop.call_soon_threadsafe(loop.stop)
+
+
+@pytest.mark.slow
+class TestChaosSoak:
+    def test_soak_zero_stuck_requests(self):
+        import os
+        import sys
+
+        sys.path.insert(
+            0,
+            os.path.join(os.path.dirname(__file__), "..", "tools"),
+        )
+        try:
+            from chaos_soak import run_soak
+        finally:
+            sys.path.pop(0)
+        res = run_soak(seconds=8.0, seed=42)
+        assert res["submitted"] > 0
+        assert res["stuck"] == []
+        assert res["healthy_after"]
+
+
+class TestGracefulDrain:
+    def test_drain_finishes_inflight_then_sheds_new(
+        self, tiny_engine_parts
+    ):
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        make_engine, _ = tiny_engine_parts
+        loop = EngineLoop(make_engine(), "drain").start()
+        col = _Collector()
+        loop.submit(_mk_req("d1", max_tokens=6), col)
+        # wait for admission so drain has real in-flight work
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and not col.tokens:
+            time.sleep(0.02)
+        loop.stop(drain=60.0)
+        assert col.done.is_set()
+        assert col.error is None                 # drained, not killed
+        late = _Collector()
+        loop.submit(_mk_req("late"), late)
+        assert (late.error or "").startswith("shutting_down")

@@ -50,6 +50,7 @@ class TestExternalSession:
 
 
 class TestBridgeE2E:
+    @pytest.mark.slow  # ~47s full-stack E2E; packet/codec units stay tier-1
     def test_guest_bridge_through_real_control_plane(self):
         """Full loop: guest DesktopBridge process-side -> control plane
         relay -> viewer WS decode; click flows back to the guest GUI."""

@@ -155,6 +155,8 @@ class TestGpucloudScenarios:
         assert "cpusim-chat-alt" in names
         assert "v5e8-needs-real-chips" not in names
 
+    @pytest.mark.slow  # stateful 3..7 chain: ~85s of profile
+    # apply/switch XLA compiles; boot+compat smoke (1,2) stay tier-1
     def test_3_assignment_apply(self, deployment):
         url = deployment
         r = requests.post(
@@ -173,6 +175,8 @@ class TestGpucloudScenarios:
         )
         assert st["routable"]
 
+    @pytest.mark.slow  # stateful 3..7 chain: ~85s of profile
+    # apply/switch XLA compiles; boot+compat smoke (1,2) stay tier-1
     def test_4_inference_roundtrip(self, deployment):
         url = deployment
         r = requests.post(
@@ -192,6 +196,8 @@ class TestGpucloudScenarios:
         assert r.status_code == 200, r.text
         assert len(r.json()["data"]) == 2
 
+    @pytest.mark.slow  # stateful 3..7 chain: ~85s of profile
+    # apply/switch XLA compiles; boot+compat smoke (1,2) stay tier-1
     def test_5_profile_switch(self, deployment):
         url = deployment
         r = requests.post(
@@ -217,6 +223,8 @@ class TestGpucloudScenarios:
         )
         assert r.status_code == 200, r.text
 
+    @pytest.mark.slow  # stateful 3..7 chain: ~85s of profile
+    # apply/switch XLA compiles; boot+compat smoke (1,2) stay tier-1
     def test_6_clear_profile(self, deployment):
         url = deployment
         r = requests.delete(
@@ -230,6 +238,8 @@ class TestGpucloudScenarios:
             desc="idle state after clear",
         )
 
+    @pytest.mark.slow  # stateful 3..7 chain: ~85s of profile
+    # apply/switch XLA compiles; boot+compat smoke (1,2) stay tier-1
     def test_7_incompatible_rejection(self, deployment):
         url = deployment
         r = requests.post(

@@ -242,6 +242,7 @@ class TestGuiStreamE2E:
     browser, click its buttons — here through the real control-plane WS
     routes with the lossy video codec on the wire."""
 
+    @pytest.mark.slow  # ~47s full-stack E2E; codec/compositor units stay tier-1
     def test_stream_and_click_gui_desktop(self):
         import asyncio
 

@@ -1,0 +1,206 @@
+"""InferenceRouter under churn + circuit-breaker state machine.
+
+Pure in-memory tests (no JAX, no HTTP): runner sets that shrink/grow
+between picks, stale eviction racing the round-robin cursor, and the
+closed -> open -> half-open -> closed|open breaker lifecycle driven by a
+fake clock."""
+
+from helix_tpu.control.router import (
+    BreakerConfig,
+    CircuitBreaker,
+    InferenceRouter,
+)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _hb(router, rid, models=("m",), address=None):
+    router.upsert_from_heartbeat(
+        rid,
+        models=list(models),
+        profile_name="p",
+        profile_status="running",
+        meta={"address": address or f"http://{rid}"},
+    )
+
+
+class TestRouterChurn:
+    def test_pick_when_candidate_set_shrinks_and_grows(self):
+        r = InferenceRouter()
+        for rid in ("r1", "r2", "r3"):
+            _hb(r, rid)
+        picks = [r.pick_runner("m").id for _ in range(3)]
+        assert sorted(picks) == ["r1", "r2", "r3"]   # round-robin coverage
+        # shrink: cursor may point past the new candidate count — picks
+        # must keep working and only return live runners
+        r.remove("r3")
+        r.remove("r2")
+        for _ in range(4):
+            assert r.pick_runner("m").id == "r1"
+        # grow again: both serve traffic
+        _hb(r, "r4")
+        got = {r.pick_runner("m").id for _ in range(4)}
+        assert got == {"r1", "r4"}
+
+    def test_evict_stale_vs_round_robin_cursor(self):
+        clock_now = [1000.0]
+        r = InferenceRouter(ttl_seconds=5.0)
+        for rid in ("a", "b", "c"):
+            _hb(r, rid)
+        # advance the cursor mid-rotation, then let everything go stale
+        r.pick_runner("m")
+        r.pick_runner("m")
+        for st in r.runners():
+            st.last_heartbeat -= 10.0   # older than ttl
+        assert sorted(r.evict_stale()) == ["a", "b", "c"]
+        assert r.pick_runner("m") is None
+        # a fresh runner after eviction is picked despite the stale cursor
+        _hb(r, "d")
+        for _ in range(3):
+            assert r.pick_runner("m").id == "d"
+        del clock_now
+
+    def test_pick_prefers_least_loaded(self):
+        r = InferenceRouter()
+        _hb(r, "r1")
+        _hb(r, "r2")
+        r.record_dispatch_start("r1")
+        r.record_dispatch_start("r1")
+        r.record_dispatch_start("r2")
+        # r2 has 1 in flight vs r1's 2: every pick goes to r2
+        for _ in range(3):
+            assert r.pick_runner("m").id == "r2"
+        r.record_success("r1")
+        r.record_success("r1")
+        # now r1 idle (0) vs r2 (1)
+        assert r.pick_runner("m").id == "r1"
+
+    def test_exclude_skips_already_tried_runner(self):
+        r = InferenceRouter()
+        _hb(r, "r1")
+        _hb(r, "r2")
+        first = r.pick_runner("m")
+        second = r.pick_runner("m", exclude={first.id})
+        assert second.id != first.id
+        assert r.pick_runner("m", exclude={"r1", "r2"}) is None
+
+
+class TestCircuitBreaker:
+    def cfg(self, **over):
+        base = dict(
+            window=10, min_samples=4, failure_threshold=0.5,
+            cooldown=5.0, half_open_probes=2, half_open_successes=2,
+        )
+        base.update(over)
+        return BreakerConfig(**base)
+
+    def test_opens_on_failure_rate_then_half_open_then_closes(self):
+        clk = FakeClock()
+        br = CircuitBreaker(self.cfg(), clock=clk)
+        assert br.state == "closed"
+        for _ in range(3):
+            br.record(failure=True)
+        assert br.state == "closed"   # below min_samples
+        br.record(failure=True)
+        assert br.state == "open"     # 4/4 failures >= 0.5
+        assert not br.allow()
+        clk.advance(4.9)
+        assert not br.allow()         # cooldown not elapsed
+        clk.advance(0.2)
+        assert br.allow()             # half-open probe budget
+        assert br.state == "half_open"
+        br.on_dispatch()
+        br.on_dispatch()
+        assert not br.allow()         # probe budget (2) exhausted
+        br.record(failure=False)
+        br.record(failure=False)
+        assert br.state == "closed"   # enough probe successes
+
+    def test_half_open_failure_reopens(self):
+        clk = FakeClock()
+        br = CircuitBreaker(self.cfg(), clock=clk)
+        for _ in range(4):
+            br.record(failure=True)
+        clk.advance(5.1)
+        assert br.allow()
+        br.on_dispatch()
+        br.record(failure=True)
+        assert br.state == "open"     # probe failed: back to open
+        assert not br.allow()
+        # and the cooldown restarted from the reopen
+        clk.advance(5.1)
+        assert br.allow()
+
+    def test_cancelled_probe_releases_budget_without_closing(self):
+        clk = FakeClock()
+        br = CircuitBreaker(
+            self.cfg(half_open_probes=1, half_open_successes=1), clock=clk
+        )
+        for _ in range(4):
+            br.record(failure=True)
+        clk.advance(5.1)
+        assert br.allow()
+        br.on_dispatch()
+        assert not br.allow()     # single probe in flight
+        br.release()              # client cancelled: no outcome
+        assert br.state == "half_open"   # NOT closed by the cancellation
+        assert br.allow()         # but the probe budget is free again
+        br.on_dispatch()
+        br.record(failure=False)
+        assert br.state == "closed"      # a real success closes it
+
+    def test_mixed_outcomes_below_threshold_stay_closed(self):
+        br = CircuitBreaker(self.cfg(), clock=FakeClock())
+        for i in range(20):
+            br.record(failure=(i % 4 == 0))   # 25% < 50% threshold
+        assert br.state == "closed"
+
+
+class TestRouterBreakerIntegration:
+    def test_pick_skips_open_breaker_and_recovers(self):
+        clk = FakeClock()
+        r = InferenceRouter(
+            breaker=BreakerConfig(
+                window=10, min_samples=2, failure_threshold=0.5,
+                cooldown=5.0, half_open_probes=1, half_open_successes=1,
+            ),
+            clock=clk,
+        )
+        _hb(r, "bad")
+        _hb(r, "good")
+        for _ in range(3):
+            r.record_dispatch_start("bad")
+            r.record_failure("bad")
+        assert r.breaker_states()["bad"]["state"] == "open"
+        # while open, every pick lands on the healthy runner
+        for _ in range(4):
+            assert r.pick_runner("m").id == "good"
+        # cooldown elapses: the bad runner gets exactly one probe
+        clk.advance(5.1)
+        picked = [r.pick_runner("m").id for _ in range(2)]
+        assert "bad" in picked
+        r.record_dispatch_start("bad")
+        assert r.breaker_states()["bad"]["state"] == "half_open"
+        # single probe budget: with the probe in flight, bad is skipped
+        assert r.pick_runner("m").id == "good"
+        r.record_success("bad")
+        assert r.breaker_states()["bad"]["state"] == "closed"
+
+    def test_all_breakers_open_returns_none(self):
+        r = InferenceRouter(
+            breaker=BreakerConfig(min_samples=1, failure_threshold=0.1)
+        )
+        _hb(r, "r1")
+        r.record_dispatch_start("r1")
+        r.record_failure("r1")
+        assert r.breaker_states()["r1"]["state"] == "open"
+        assert r.pick_runner("m") is None

@@ -160,13 +160,18 @@ def test_embeddings_through_tunnel(stack):
     assert "error" in r.json() or r.json().get("object") == "list"
 
 
-def test_unknown_runner_is_clean_502(stack):
+def test_unknown_runner_is_clean_503(stack):
+    """A runner with no live tunnel exhausts the dispatch retry budget
+    and surfaces as a clean OpenAI-style 503 with Retry-After (the
+    failure-aware dispatch path; pre-failover this was a bare 502)."""
     cp = stack["cp"]
     cp.router.upsert_from_heartbeat(
         "ghost", models=["ghost-model"], profile_name="p",
         profile_status="running", accelerators=[], meta={"address": ""},
     )
     cp.tunnels.grace = 0.5  # don't wait the full 30s in tests
+    prev_base = cp.dispatch_backoff_base
+    cp.dispatch_backoff_base = 0.001
     try:
         r = requests.post(
             f"{stack['url']}/v1/chat/completions",
@@ -175,10 +180,14 @@ def test_unknown_runner_is_clean_502(stack):
                   "max_tokens": 2},
             timeout=30,
         )
-        assert r.status_code == 502
-        assert "unreachable" in r.json()["error"]["message"]
+        assert r.status_code == 503
+        body = r.json()["error"]
+        assert body["code"] == "runners_exhausted"
+        assert r.headers.get("Retry-After") == "1"
+        assert "unavailable" in body["message"]
     finally:
         cp.tunnels.grace = 30.0
+        cp.dispatch_backoff_base = prev_base
 
 
 def test_reconnect_grace_queues_dials(stack):

@@ -1,0 +1,164 @@
+"""Randomized (but seeded) chaos soak of the in-process serving stack.
+
+Builds a tiny engine + EngineLoop with admission bounds, arms the fault
+injector with a probabilistic engine-step fault plus persistent poisoned
+requests, then pumps seeded random traffic for N seconds.  The exit
+assertion is the serving spine's core robustness contract: **zero stuck
+requests** — every submission reaches a terminal event (tokens+finish,
+quarantine eviction, shed, or timeout), the engine thread never dies, and
+the loop keeps accepting work afterwards.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_soak.py --seconds 10 --seed 42
+
+Also imported by the slow lane of ``tests/test_chaos.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+)
+
+
+def run_soak(seconds: float = 10.0, seed: int = 42,
+             step_fault_p: float = 0.02, poison_every: int = 7) -> dict:
+    import jax
+
+    from helix_tpu.engine.engine import Engine, EngineConfig, Request
+    from helix_tpu.engine.sampling import SamplingParams
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+    from helix_tpu.testing import faults
+
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    engine = Engine(
+        cfg, params,
+        EngineConfig(
+            max_decode_batch=4, page_size=4, num_pages=256,
+            max_pages_per_seq=32, max_prefill_len=64,
+            attn_backend="reference", eos_token_ids=tok.eos_ids,
+        ),
+    )
+    faults.arm(
+        seed=seed,
+        rules=[
+            # transient step faults: retry-once should absorb most
+            {"point": "engine_step", "p": step_fault_p},
+            # persistent poison: every step that schedules such a request
+            # fails until quarantine evicts it
+            {"point": "engine_step", "request_id_contains": "poison"},
+        ],
+    )
+    loop = EngineLoop(
+        engine, "soak", max_queue_seconds=20.0,
+        max_queue_depth=32, max_queued_tokens=4096,
+    ).start()
+
+    rng = random.Random(seed)
+    outcomes: dict[str, str] = {}
+    terminal: dict[str, bool] = {}
+
+    def on_event_for(rid):
+        def on_event(ev):
+            if ev.finished:
+                terminal[rid] = True
+                outcomes[rid] = (
+                    "error:" + ev.error.split(":")[0]
+                    if ev.error
+                    else (ev.finish_reason or "stop")
+                )
+        return on_event
+
+    t0 = time.monotonic()
+    n = 0
+    try:
+        while time.monotonic() - t0 < seconds:
+            n += 1
+            rid = (
+                f"poison-{n}" if n % poison_every == 0 else f"req-{n}"
+            )
+            req = Request(
+                id=rid,
+                prompt_tokens=[rng.randrange(4, 260)
+                               for _ in range(rng.randrange(4, 48))],
+                sampling=SamplingParams(
+                    max_tokens=rng.randrange(2, 16), seed=n
+                ),
+                stop_token_ids=tok.eos_ids,
+            )
+            terminal[rid] = False
+            loop.submit(req, on_event_for(rid))
+            time.sleep(rng.uniform(0.0, 0.05))
+        # drain: give every in-flight request time to reach a terminal
+        # event (quarantine/shed/finish), then a final health probe
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and not all(terminal.values()):
+            time.sleep(0.1)
+        faults.disarm()
+        probe_done = [False]
+        loop.submit(
+            Request(
+                id="final-probe", prompt_tokens=[5, 6, 7, 8],
+                sampling=SamplingParams(max_tokens=2),
+                stop_token_ids=tok.eos_ids,
+            ),
+            lambda ev: probe_done.__setitem__(0, ev.finished or probe_done[0]),
+        )
+        pdeadline = time.monotonic() + 30.0
+        while time.monotonic() < pdeadline and not probe_done[0]:
+            time.sleep(0.05)
+    finally:
+        faults.disarm()
+        loop.stop(join=False)
+
+    stuck = sorted(r for r, done in terminal.items() if not done)
+    counts: dict[str, int] = {}
+    for o in outcomes.values():
+        counts[o] = counts.get(o, 0) + 1
+    return {
+        "submitted": n,
+        "stuck": stuck,
+        "outcomes": counts,
+        "healthy_after": probe_done[0],
+        "stats": loop.stats(),
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--step-fault-p", type=float, default=0.02)
+    args = ap.parse_args(argv)
+    res = run_soak(
+        seconds=args.seconds, seed=args.seed,
+        step_fault_p=args.step_fault_p,
+    )
+    print(f"submitted:     {res['submitted']}")
+    print(f"outcomes:      {res['outcomes']}")
+    print(f"loop stats:    {res['stats']}")
+    print(f"healthy after: {res['healthy_after']}")
+    if res["stuck"]:
+        print(f"STUCK REQUESTS: {res['stuck']}", file=sys.stderr)
+        return 1
+    if not res["healthy_after"]:
+        print("ENGINE UNHEALTHY AFTER SOAK", file=sys.stderr)
+        return 1
+    print("zero stuck requests — soak passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
