@@ -6,8 +6,12 @@ Python equivalents:
 
 - ``threads``  -> per-thread stack dumps (goroutine profile analogue)
 - ``profile``  -> cProfile over ``seconds`` (CPU profile), pstats text
-- ``heap``     -> tracemalloc top allocations (heap profile; sampling
-                  starts on first call, so the first snapshot is empty)
+- ``heap``     -> tracemalloc top allocations (heap profile).  Sampling
+                  arms at import time when ``HELIX_TRACEMALLOC`` is set
+                  (so the first snapshot sees process history); otherwise
+                  it arms on the first call and the payload says exactly
+                  when sampling began instead of silently returning an
+                  empty snapshot.
 - ``objects``  -> gc object counts by type (allocation census)
 """
 
@@ -15,8 +19,10 @@ from __future__ import annotations
 
 import gc
 import io
+import os
 import sys
 import threading
+import time
 import traceback
 
 
@@ -85,23 +91,70 @@ def cpu_profile(seconds: float = 5.0, interval: float = 0.005,
     return out.getvalue()
 
 
-_tracemalloc_started = False
+_tracemalloc_started_at: float = 0.0   # wall time sampling began; 0 = off
+_tracemalloc_external: bool = False    # armed outside this module
+
+
+def _arm_tracemalloc() -> None:
+    global _tracemalloc_started_at, _tracemalloc_external
+    import tracemalloc
+
+    if tracemalloc.is_tracing():
+        if not _tracemalloc_started_at:
+            # PYTHONTRACEMALLOC or another component armed it first: our
+            # timestamp is only when this module noticed
+            _tracemalloc_external = True
+            _tracemalloc_started_at = time.time()
+    else:
+        # (re)starting tracing: the window begins NOW, even if we had an
+        # older stamp from a previous arm that was since stopped
+        tracemalloc.start(10)
+        _tracemalloc_started_at = time.time()
+        _tracemalloc_external = False
+
+
+# arm at module import (the control plane imports this when it first
+# serves /debug/pprof/*) when the operator opts in — then the FIRST heap
+# snapshot already covers everything allocated since process start-ish,
+# instead of an empty window
+if os.environ.get("HELIX_TRACEMALLOC", "").lower() not in ("", "0", "false"):
+    _arm_tracemalloc()
 
 
 def heap_profile(limit: int = 40) -> str:
-    """tracemalloc top allocation sites; sampling begins on first call."""
-    global _tracemalloc_started
+    """tracemalloc top allocation sites.  Never returns an empty payload:
+    if sampling was not armed (no ``HELIX_TRACEMALLOC``), it arms NOW and
+    the snapshot header states the sampling window so the reader knows
+    which allocations are invisible."""
     import tracemalloc
 
-    if not _tracemalloc_started:
-        tracemalloc.start(10)
-        _tracemalloc_started = True
-        return (
-            "tracemalloc sampling started; call again for a snapshot\n"
-        )
+    # distinguish "we armed it now" from "it was already tracing"
+    # (PYTHONTRACEMALLOC / another component) — only the former makes
+    # pre-call allocations invisible; captured BEFORE arming (covers
+    # re-arms after an external tracemalloc.stop() too)
+    armed_this_call = not tracemalloc.is_tracing()
+    _arm_tracemalloc()
     snap = tracemalloc.take_snapshot()
     stats = snap.statistics("lineno")[:limit]
     out = io.StringIO()
+    age = time.time() - _tracemalloc_started_at
+    out.write(
+        f"tracemalloc sampling since "
+        f"{time.strftime('%Y-%m-%dT%H:%M:%S', time.gmtime(_tracemalloc_started_at))}Z "
+        f"({age:.1f}s ago)\n"
+    )
+    if armed_this_call:
+        out.write(
+            "note: sampling armed by THIS call — allocations made before "
+            "it are invisible; set HELIX_TRACEMALLOC=1 to arm at import\n"
+        )
+    elif _tracemalloc_external:
+        # tracing began before we first observed it: the timestamp above
+        # is when THIS module noticed, not when sampling actually started
+        out.write(
+            "note: tracemalloc was armed externally before this module "
+            "first observed it; the true sampling window started earlier\n"
+        )
     total = sum(s.size for s in snap.statistics("filename"))
     out.write(f"total tracked: {total / 2**20:.1f} MiB\n")
     for s in stats:

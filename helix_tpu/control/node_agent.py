@@ -14,10 +14,13 @@ publish state through the same lifecycle strings the router gates on
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 import traceback
 from typing import Callable, Optional
+
+log = logging.getLogger("helix.node_agent")
 
 from helix_tpu.control.profile import ProfileModel, ServingProfile
 from helix_tpu.device.detect import detect_accelerators
@@ -396,7 +399,14 @@ class NodeAgent:
                     for name, pm in want.items():
                         if self.registry.get(name) is None:
                             self.state.progress[name] = "loading"
+                            t0 = time.monotonic()
                             self.registry.register(self._build(pm))
+                            log.info(
+                                "runner %s: model %s built in %.1fs "
+                                "(profile %s)",
+                                self.runner_id, name,
+                                time.monotonic() - t0, profile.name,
+                            )
                             self.state.progress[name] = "ready"
                 self.state.status = "running"
                 # multi-host FOLLOWERS replay the leader's journal and
@@ -409,6 +419,10 @@ class NodeAgent:
             except Exception as e:  # noqa: BLE001 — reported via status
                 self.state.status = "failed"
                 self.state.error = f"{e}\n{traceback.format_exc(limit=5)}"
+                log.warning(
+                    "runner %s: profile %s apply failed: %s",
+                    self.runner_id, profile.name, e,
+                )
             return self.state
 
     def _apply_residency(self, profile: ServingProfile, want: dict) -> None:

@@ -22,20 +22,27 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import json
+import logging
 import random
+import time
 import uuid
 
 import aiohttp
 from aiohttp import web
 
+from helix_tpu import obs
 from helix_tpu.control.profile import ServingProfile, check_compatibility
 from helix_tpu.control.router import InferenceRouter
 from helix_tpu.control.store import Store
+from helix_tpu.obs.trace import TRACE_HEADER
+
+_dispatch_log = logging.getLogger("helix.dispatch")
 
 
-def _err(status, message, **extra):
+def _err(status, message, headers=None, **extra):
     return web.json_response(
-        {"error": {"message": message, **extra}}, status=status
+        {"error": {"message": message, **extra}}, status=status,
+        headers=headers,
     )
 
 
@@ -55,16 +62,19 @@ class _DispatchAccount:
         self.router = router
         self.runner_id = runner_id
         self.done = False
+        self.outcome = None   # "success" | "failure" | "release" once done
         self.epoch = router.record_dispatch_start(runner_id)
 
     def success(self):
         if not self.done:
             self.done = True
+            self.outcome = "success"
             self.router.record_success(self.runner_id, epoch=self.epoch)
 
     def failure(self):
         if not self.done:
             self.done = True
+            self.outcome = "failure"
             self.router.record_failure(self.runner_id, epoch=self.epoch)
 
     def release(self):
@@ -73,6 +83,7 @@ class _DispatchAccount:
         cancelled probe must neither close nor re-trip it."""
         if not self.done:
             self.done = True
+            self.outcome = "release"
             self.router.record_release(self.runner_id, epoch=self.epoch)
 
 
@@ -207,6 +218,16 @@ class ControlPlane:
         self.dispatch_exhausted = 0   # requests that ran out of candidates
         self.dispatch_ok = 0
         self.heartbeats_dropped = 0   # fault-injected heartbeat loss
+        # observability (ISSUE 3): shared metrics registry renders
+        # /metrics; the trace store holds per-request dispatch spans
+        # (every failover attempt is a span), served by /v1/debug/traces
+        self.obs = obs.Registry()
+        self.obs.register_callback(self._collect_cp_metrics)
+        self.dispatch_attempt_seconds = self.obs.histogram(
+            "helix_cp_dispatch_attempt_seconds",
+            "One dispatch attempt to one runner (send to stream end)",
+        )
+        self.traces = obs.default_store()
         self.auth = Authenticator(self.db)
         self.billing = BillingService(self.db, usage_store=None)
         from helix_tpu.control.stripe import StripeService
@@ -1300,49 +1321,76 @@ class ControlPlane:
         # its tts-server sidecar; ours also runs standalone via
         # `helix-tpu tts-server`)
         r.add_post("/v1/audio/speech", self.audio_speech)
-        # serving-spine observability: breaker states, dispatch outcomes
+        # serving-spine observability: breaker states, dispatch outcomes,
+        # end-to-end request traces (admin-gated when auth is on)
         r.add_get("/metrics", self.metrics)
+        r.add_get("/v1/debug/traces", self.debug_traces_list)
+        r.add_get("/v1/debug/traces/{trace_id}", self.debug_trace)
         # the shared dispatch ClientSession binds to the app's event loop
         app.on_cleanup.append(self._close_dispatch_session)
         return app
 
     async def metrics(self, request):
-        """Prometheus text surface for the control plane: per-runner
-        circuit-breaker state (0=closed 1=half_open 2=open), in-flight
-        dispatches, and dispatch retry/failover/shed outcomes."""
-        lines = [
-            "# TYPE helix_cp_dispatch_retries_total counter",
-            f"helix_cp_dispatch_retries_total {self.dispatch_retries}",
-            "# TYPE helix_cp_dispatch_failovers_total counter",
-            f"helix_cp_dispatch_failovers_total {self.dispatch_failovers}",
-            "# TYPE helix_cp_dispatch_exhausted_total counter",
-            f"helix_cp_dispatch_exhausted_total {self.dispatch_exhausted}",
-            "# TYPE helix_cp_dispatch_ok_total counter",
-            f"helix_cp_dispatch_ok_total {self.dispatch_ok}",
-            "# TYPE helix_cp_heartbeats_dropped_total counter",
-            f"helix_cp_heartbeats_dropped_total {self.heartbeats_dropped}",
-        ]
+        """Prometheus text surface for the control plane, rendered by the
+        shared obs registry: per-runner circuit-breaker state (0=closed
+        1=half_open 2=open), in-flight dispatches, dispatch
+        retry/failover/shed outcomes, and the dispatch-attempt latency
+        histogram."""
+        return web.Response(text=self.obs.render())
+
+    def _collect_cp_metrics(self, c: "obs.Collector") -> None:
+        """Scrape-time samples from live control-plane state (the
+        registry owns exposition formatting; this only reads values)."""
+        c.counter(
+            "helix_cp_dispatch_retries_total", self.dispatch_retries,
+            help="Pre-stream dispatch failures that got a retry",
+        )
+        c.counter(
+            "helix_cp_dispatch_failovers_total", self.dispatch_failovers,
+            help="Retries that landed on another runner",
+        )
+        c.counter(
+            "helix_cp_dispatch_exhausted_total", self.dispatch_exhausted,
+            help="Requests that ran out of candidate runners",
+        )
+        c.counter("helix_cp_dispatch_ok_total", self.dispatch_ok)
+        c.counter(
+            "helix_cp_heartbeats_dropped_total", self.heartbeats_dropped
+        )
+        c.gauge("helix_cp_traces_stored", len(self.traces))
         state_num = {"closed": 0, "half_open": 1, "open": 2}
-
-        def esc(label: str) -> str:
-            """Prometheus exposition-format label escaping — runner ids
-            arrive verbatim from the heartbeat URL path, and one stray
-            quote would invalidate the whole scrape."""
-            return (
-                label.replace("\\", "\\\\")
-                .replace('"', '\\"')
-                .replace("\n", "\\n")
-            )
-
         for rid, snap in self.router.breaker_states().items():
-            t = f'{{runner="{esc(rid)}"}}'
-            lines += [
-                f"helix_cp_runner_breaker_state{t} "
-                f"{state_num.get(snap['state'], -1)}",
-                f"helix_cp_runner_breaker_opens_total{t} {snap['opens']}",
-                f"helix_cp_runner_inflight{t} {snap['inflight']}",
-            ]
-        return web.Response(text="\n".join(lines) + "\n")
+            lbl = {"runner": rid}
+            c.gauge(
+                "helix_cp_runner_breaker_state",
+                state_num.get(snap["state"], -1), lbl,
+            )
+            c.counter(
+                "helix_cp_runner_breaker_opens_total", snap["opens"], lbl
+            )
+            c.gauge("helix_cp_runner_inflight", snap["inflight"], lbl)
+
+    async def debug_traces_list(self, request):
+        user = request.get("user")
+        if self.auth_required and not (user and user.admin):
+            return _err(403, "admin only")
+        return web.json_response({"traces": self.traces.ids()[-100:]})
+
+    async def debug_trace(self, request):
+        """One request's spans across the spine (control plane dispatch
+        attempts + runner + engine when co-resident) as JSON, or Chrome
+        trace_event format with ?format=chrome."""
+        user = request.get("user")
+        if self.auth_required and not (user and user.admin):
+            return _err(403, "admin only")
+        tid = request.match_info["trace_id"]
+        if request.query.get("format") == "chrome":
+            doc = self.traces.chrome_trace(tid)
+        else:
+            doc = self.traces.get(tid)
+        if doc is None:
+            return _err(404, f"unknown trace {tid!r}")
+        return web.json_response(doc)
 
     async def audio_speech(self, request):
         # one shared handler with the sidecar (validation + dispatch)
@@ -4537,6 +4585,14 @@ class ControlPlane:
             body = json.loads(raw)
         except Exception:
             return _err(400, "invalid JSON body")
+        # end-to-end trace identity: minted here (or adopted from the
+        # caller when shaped like a trace id), propagated to the runner
+        # via X-Helix-Trace-Id, echoed in response headers and error
+        # bodies — every failover attempt below records its own span
+        from helix_tpu.obs.trace import adopt_trace_id
+
+        trace_id = adopt_trace_id(request.headers.get(TRACE_HEADER))
+        t_req = time.monotonic()
         model = body.get("model", "")
         if not model:
             # default-model resolution for callers that don't care (the
@@ -4561,10 +4617,11 @@ class ControlPlane:
                             ),
                             "type": "overloaded_error",
                             "code": "runners_exhausted",
+                            "trace_id": trace_id,
                         }
                     },
                     status=503,
-                    headers={"Retry-After": "1"},
+                    headers={"Retry-After": "1", TRACE_HEADER: trace_id},
                 )
             # no self-hosted runner serves it: fall through to the
             # provider manager (external OpenAI-compatible/Anthropic
@@ -4597,7 +4654,20 @@ class ControlPlane:
                 self.dispatch_failovers += 1   # a retry found a runner
             attempt += 1
             tried.add(runner.id)
+            runner_id = runner.id
             acct = _DispatchAccount(self.router, runner.id)
+            t_attempt = time.monotonic()
+
+            def attempt_span(outcome, _rid=runner_id, _n=attempt,
+                             _t0=t_attempt):
+                now = time.monotonic()
+                self.dispatch_attempt_seconds.observe(now - _t0)
+                self.traces.record(
+                    trace_id, "dispatch_attempt", _t0, now,
+                    plane="control", runner=_rid, attempt=_n,
+                    outcome=outcome,
+                )
+
             try:
                 inj = faults.active()
                 fault = inj.dispatch_fault(runner.id) if inj else None
@@ -4613,9 +4683,23 @@ class ControlPlane:
                             f"cannot connect to runner {runner.id} "
                             "(injected)"
                         )
-                return await self._dispatch_attempt(
-                    request, runner, raw, deadline, acct
+                resp = await self._dispatch_attempt(
+                    request, runner, raw, deadline, acct, trace_id
                 )
+                # headers committed, but the stream may still have died
+                # mid-flight (the attempt resolved its own account):
+                # report what actually happened, not a blanket "ok"
+                stream_outcome = {
+                    "failure": "failed_mid_stream",
+                    "release": "released_mid_stream",
+                }.get(acct.outcome, "ok")
+                attempt_span(stream_outcome)
+                self.traces.record(
+                    trace_id, "dispatch", t_req, time.monotonic(),
+                    plane="control", model=model, attempts=attempt,
+                    outcome=stream_outcome,
+                )
+                return resp
             except _RetryableDispatch as e:
                 last_err = str(e.__cause__ or e)
             except (
@@ -4630,16 +4714,24 @@ class ControlPlane:
                 # client went away mid-attempt: release the runner's
                 # in-flight slot without blaming it, then propagate
                 acct.release()
+                attempt_span("cancelled")
                 raise
-            except Exception:
+            except Exception as e:
                 # anything else (malformed runner address -> InvalidURL,
                 # payload errors, ...) is a non-retryable dispatch
                 # failure: resolve the account so the in-flight counter
                 # and probe budget can't leak, then let the error
                 # middleware shape the 500
                 acct.failure()
+                attempt_span(f"error: {type(e).__name__}")
                 raise
             acct.failure()
+            attempt_span(f"failed: {last_err[:200]}")
+            _dispatch_log.warning(
+                "dispatch attempt %d to runner %s failed "
+                "(trace_id=%s model=%s): %s",
+                attempt, runner_id, trace_id, model, last_err,
+            )
             runner = None
             if attempt >= self.dispatch_max_attempts:
                 break
@@ -4653,6 +4745,16 @@ class ControlPlane:
             ) * (0.5 + random.random() / 2)   # full-jitter-ish
             await asyncio.sleep(min(backoff, remaining))
         self.dispatch_exhausted += 1
+        self.traces.record(
+            trace_id, "dispatch", t_req, time.monotonic(),
+            plane="control", model=model, attempts=attempt,
+            outcome="runners_exhausted",
+        )
+        _dispatch_log.warning(
+            "dispatch exhausted after %d attempt(s) "
+            "(trace_id=%s model=%s): %s",
+            attempt, trace_id, model, last_err,
+        )
         return web.json_response(
             {
                 "error": {
@@ -4663,13 +4765,15 @@ class ControlPlane:
                     ),
                     "type": "overloaded_error",
                     "code": "runners_exhausted",
+                    "trace_id": trace_id,
                 }
             },
             status=503,
-            headers={"Retry-After": "1"},
+            headers={"Retry-After": "1", TRACE_HEADER: trace_id},
         )
 
-    async def _dispatch_attempt(self, request, runner, raw, deadline, acct):
+    async def _dispatch_attempt(self, request, runner, raw, deadline, acct,
+                                trace_id: str = ""):
         """One dispatch to one runner.  Raises for failures before the
         first streamed byte (the caller fails over); after headers are
         committed, mid-stream runner death is reported in-band on SSE
@@ -4678,7 +4782,9 @@ class ControlPlane:
         a complete response)."""
         address = runner.meta.get("address")
         if not address:
-            return await self._dispatch_tunnel(request, runner, raw, acct)
+            return await self._dispatch_tunnel(
+                request, runner, raw, acct, trace_id
+            )
         url = f"{address}{request.path}"
         remaining = max(
             1.0, deadline - asyncio.get_running_loop().time()
@@ -4687,7 +4793,10 @@ class ControlPlane:
         async with session.post(
             url,
             data=raw,
-            headers={"Content-Type": "application/json"},
+            headers={
+                "Content-Type": "application/json",
+                TRACE_HEADER: trace_id,
+            },
             timeout=aiohttp.ClientTimeout(total=remaining),
         ) as upstream:
             if upstream.status >= 500:
@@ -4697,7 +4806,8 @@ class ControlPlane:
                 )
             ctype = upstream.headers.get("Content-Type", "application/json")
             resp = web.StreamResponse(
-                status=upstream.status, headers={"Content-Type": ctype}
+                status=upstream.status,
+                headers={"Content-Type": ctype, TRACE_HEADER: trace_id},
             )
             # nothing below may propagate to the failover loop — once
             # prepare() commits headers a retry cannot restart the
@@ -4716,6 +4826,7 @@ class ControlPlane:
                     await self._abort_mid_stream(
                         request, resp, ctype,
                         "dispatch deadline exceeded mid-stream",
+                        trace_id,
                     )
                     return resp
                 except aiohttp.ClientError as e:
@@ -4723,6 +4834,7 @@ class ControlPlane:
                     await self._abort_mid_stream(
                         request, resp, ctype,
                         "runner died mid-stream: " + str(e)[:200],
+                        trace_id,
                     )
                     return resp
                 await resp.write_eof()
@@ -4736,13 +4848,18 @@ class ControlPlane:
             return resp
 
     @staticmethod
-    async def _abort_mid_stream(request, resp, ctype: str, message: str):
+    async def _abort_mid_stream(request, resp, ctype: str, message: str,
+                                trace_id: str = ""):
         """Terminate a half-streamed response: SSE gets a terminal error
         frame + clean EOF (already-streamed tokens stand); JSON bodies
         get a hard connection abort so clients see a transport error
-        instead of silently-truncated JSON."""
+        instead of silently-truncated JSON.  The error frame carries the
+        trace id so the death can be correlated with runner logs."""
         if "text/event-stream" in ctype:
-            frame = json.dumps({"error": {"message": message}})
+            err: dict = {"message": message}
+            if trace_id:
+                err["trace_id"] = trace_id
+            frame = json.dumps({"error": err})
             await resp.write(f"data: {frame}\n\n".encode())
             await resp.write_eof()
         elif request.transport is not None:
@@ -4854,7 +4971,8 @@ class ControlPlane:
         except ProviderError as e:
             return _err(e.status if 400 <= e.status < 600 else 502, str(e))
 
-    async def _dispatch_tunnel(self, request, runner, raw: bytes, acct):
+    async def _dispatch_tunnel(self, request, runner, raw: bytes, acct,
+                               trace_id: str = ""):
         """Dispatch through the runner's reverse tunnel, preserving SSE
         chunk boundaries.  Mid-stream tunnel death surfaces as a terminal
         SSE error frame on SSE responses / an aborted connection on JSON
@@ -4867,7 +4985,10 @@ class ControlPlane:
                 runner.id,
                 "POST",
                 request.path,
-                {"Content-Type": "application/json"},
+                {
+                    "Content-Type": "application/json",
+                    TRACE_HEADER: trace_id,
+                },
                 raw,
             )
         except TunnelClosed as e:
@@ -4881,7 +5002,8 @@ class ControlPlane:
             )
         ctype = headers.get("Content-Type", "application/json")
         resp = web.StreamResponse(
-            status=status, headers={"Content-Type": ctype}
+            status=status,
+            headers={"Content-Type": ctype, TRACE_HEADER: trace_id},
         )
         try:
             await resp.prepare(request)
@@ -4893,6 +5015,7 @@ class ControlPlane:
                 await self._abort_mid_stream(
                     request, resp, ctype,
                     "runner disconnected mid-stream: " + str(e)[:200],
+                    trace_id,
                 )
                 return resp
             await resp.write_eof()

@@ -35,6 +35,8 @@ from typing import AsyncIterator, Optional
 import aiohttp
 from aiohttp import web
 
+from helix_tpu.obs.trace import TRACE_HEADER
+
 OP_OPEN = 0
 OP_BODY = 1
 OP_END = 2
@@ -357,19 +359,23 @@ class TunnelAgent:
                     data=body if body else None,
                     headers=spec.get("headers") or {},
                 ) as resp:
+                    headers = {
+                        "Content-Type": resp.headers.get(
+                            "Content-Type", "application/json"
+                        ),
+                    }
+                    # trace correlation survives the tunnel hop: the
+                    # runner echoes X-Helix-Trace-Id; forward it so the
+                    # control plane (and client) see the same id the
+                    # runner logged
+                    tid = resp.headers.get(TRACE_HEADER)
+                    if tid:
+                        headers[TRACE_HEADER] = tid
                     await ws.send_bytes(
                         pack_frame(
                             sid, OP_RESP,
                             json.dumps(
-                                {
-                                    "status": resp.status,
-                                    "headers": {
-                                        "Content-Type": resp.headers.get(
-                                            "Content-Type",
-                                            "application/json",
-                                        )
-                                    },
-                                }
+                                {"status": resp.status, "headers": headers}
                             ).encode(),
                         )
                     )

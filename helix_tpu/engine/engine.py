@@ -57,6 +57,7 @@ from helix_tpu.engine.sampling import (
 )
 from helix_tpu.models.common import ModelConfig
 from helix_tpu.models.llama import forward
+from helix_tpu.obs import trace as obs_trace
 from helix_tpu.ops.attention import attention as full_attention
 from helix_tpu.ops.paged import paged_decode_attention
 
@@ -85,7 +86,12 @@ class Request:
     slot: Optional[int] = None
     max_len: Optional[int] = None   # page-capacity cap set at admission
     submit_time: float = dataclasses.field(default_factory=time.monotonic)
+    admitted_time: Optional[float] = None   # slot claimed (queue wait ends)
     first_token_time: Optional[float] = None
+    # end-to-end trace identity (obs.trace): minted at the OpenAI
+    # endpoint, carried through dispatch into engine-level spans; empty
+    # string = untraced (span recording is then a no-op)
+    trace_id: str = ""
     cached_tokens: int = 0          # prompt tokens served by prefix cache
     _page_hashes: Optional[list] = None
 
@@ -1074,6 +1080,7 @@ class Engine:
         slot = free_slots[0]
         pages = shared + self.allocator.allocate(req.id, need_new)
         req.slot = slot
+        req.admitted_time = time.monotonic()   # queue wait ends here
         req.cached_tokens = len(shared) * self.cache_cfg.page_size
         if use_cache and self.prefix_cache is not None:
             self.prefix_cache.record_claim(len(shared), len(hashes))
@@ -1436,6 +1443,20 @@ class Engine:
         self._drain_moe_drops()
         self._emit(req, first_token, emitted)
 
+    # per-request cap on prefill_chunk spans: a 128k prompt would
+    # otherwise flood its own trace's span budget and evict the decode/
+    # emit summary spans recorded later (the spans a slow-request
+    # investigation actually needs)
+    _MAX_CHUNK_SPANS = 32
+
+    def _should_trace_chunk(self, st: dict, req: Request, end: int) -> bool:
+        """First _MAX_CHUNK_SPANS chunks + always the final chunk."""
+        n = st.get("chunk_spans", 0)
+        if n < self._MAX_CHUNK_SPANS or end >= len(req.prompt_tokens):
+            st["chunk_spans"] = n + 1
+            return True
+        return False
+
     def _chunk_step(self, emitted) -> None:
         """Process ONE chunk of the in-flight long prefill (called once per
         engine step so decode interleaves)."""
@@ -1444,6 +1465,7 @@ class Engine:
         if req.finished:   # aborted mid-prefill
             self._chunking = None
             return
+        t0 = time.monotonic()
         args, rem, end = self._chunk_host_args(st)
         fn = _build_chunk_prefill_fn(
             self.model_cfg, self.cache_cfg.page_size, self._backend,
@@ -1453,6 +1475,14 @@ class Engine:
         self._note_moe_drops(drops)
         self.num_prefill_tokens += rem
         st["next"] = end
+        if req.trace_id and self._should_trace_chunk(st, req, end):
+            # host-side step attribution (device work is async; the final
+            # chunk's span absorbs the sync when the first token is read)
+            obs_trace.default_store().record(
+                req.trace_id, "prefill_chunk", t0, time.monotonic(),
+                plane="engine", request_id=req.id,
+                chunk_end=end, tokens=rem,
+            )
         if end < len(req.prompt_tokens):
             return
         self._finish_chunk(st, int(token[0]), emitted)
@@ -1477,6 +1507,7 @@ class Engine:
                     f"at position {self._positions[i]} — headroom "
                     f"invariant violated"
                 )
+        t0 = time.monotonic()
         args, rem, end = self._chunk_host_args(st)
         fn = _build_mixed_step_fn(
             self.model_cfg, self.cache_cfg.page_size, self._backend,
@@ -1489,6 +1520,12 @@ class Engine:
         self._note_moe_drops(drops)
         self.num_prefill_tokens += rem
         st["next"] = end
+        if req.trace_id and self._should_trace_chunk(st, req, end):
+            obs_trace.default_store().record(
+                req.trace_id, "prefill_chunk", t0, time.monotonic(),
+                plane="engine", request_id=req.id,
+                chunk_end=end, tokens=rem, mixed=True,
+            )
         # decode emissions first (the chunking slot is still parked here)
         next_np = np.asarray(dec_tokens)        # [B] — ONE host fetch
         for i, r in enumerate(self.slots):

@@ -1,0 +1,32 @@
+"""Serving-spine observability: shared metrics registry + request tracing.
+
+- :mod:`helix_tpu.obs.metrics` — counters/gauges/fixed-bucket histograms
+  with Prometheus text exposition (the ONLY place exposition strings are
+  built; ``tools/lint_metrics.py`` enforces this).
+- :mod:`helix_tpu.obs.trace` — trace IDs minted at the OpenAI endpoint,
+  propagated via ``X-Helix-Trace-Id`` across dispatch/tunnel/engine,
+  stored in a bounded ring buffer, exported as JSON or Chrome
+  ``trace_event``.
+"""
+
+from helix_tpu.obs.metrics import (  # noqa: F401
+    Collector,
+    Counter,
+    EngineLoopObs,
+    FAST_BUCKETS,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS,
+    METRIC_NAME_RE,
+    Registry,
+    escape_label_value,
+    format_value,
+    validate_metric_name,
+)
+from helix_tpu.obs.trace import (  # noqa: F401
+    TRACE_HEADER,
+    Span,
+    TraceStore,
+    default_store,
+    new_trace_id,
+)

@@ -20,12 +20,17 @@ in-flight work before the thread exits.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 import time
 from typing import Callable, Optional
 
 from helix_tpu.engine.engine import Engine, FinishReason, Request
+from helix_tpu.obs import EngineLoopObs
+from helix_tpu.obs import trace as obs_trace
+
+log = logging.getLogger("helix.engine")
 
 # error-message prefixes the HTTP layer maps onto statuses (429 / 503);
 # keep in sync with openai_api._engine_error_response
@@ -79,6 +84,13 @@ class EngineLoop:
         self.quarantine_evictions = 0
         self.shed_requests = 0
         self.started_at = time.monotonic()
+        # latency histograms (TTFT / queue wait / inter-token / step) —
+        # standalone obs families; the runner's /metrics folds them in
+        # with a model label at scrape time
+        self.obs = EngineLoopObs()
+        self._trace = obs_trace.default_store()
+        self._first_emit: dict[str, float] = {}   # req id -> first-token t
+        self._last_emit: dict[str, float] = {}    # req id -> last-token t
 
     # -- called from any thread --------------------------------------------
 
@@ -222,6 +234,7 @@ class EngineLoop:
             if on_event is None:  # abort
                 self.engine.abort(item)
                 self._subscribers.pop(item, None)
+                self._forget_request(item)
             else:
                 with self._admission_lock:
                     self._pending = max(0, self._pending - 1)
@@ -240,8 +253,54 @@ class EngineLoop:
                         )
                     )
 
+    def _observe_emit(self, req: Request) -> None:
+        """Feed the latency histograms + engine-level spans from one
+        emitted token (queue/prefill on the first token, decode span on
+        finish)."""
+        now = time.monotonic()
+        rid = req.id
+        last = self._last_emit.get(rid)
+        if rid not in self._first_emit:
+            self._first_emit[rid] = now
+            admitted = req.admitted_time or now
+            self.obs.queue_wait.observe(max(0.0, admitted - req.submit_time))
+            self.obs.ttft.observe(max(0.0, now - req.submit_time))
+            if req.trace_id:
+                self._trace.record(
+                    req.trace_id, "queue", req.submit_time, admitted,
+                    plane="engine", request_id=rid,
+                )
+                self._trace.record(
+                    req.trace_id, "prefill", admitted, now,
+                    plane="engine", request_id=rid,
+                    prompt_tokens=len(req.prompt_tokens),
+                    cached_tokens=req.cached_tokens,
+                )
+        elif last is not None:
+            self.obs.inter_token.observe(max(0.0, now - last))
+        self._last_emit[rid] = now
+        if req.finished:
+            t_first = self._first_emit.pop(rid, now)
+            self._last_emit.pop(rid, None)
+            if req.trace_id:
+                self._trace.record(
+                    req.trace_id, "decode", t_first, now,
+                    plane="engine", request_id=rid,
+                    output_tokens=len(req.output_tokens),
+                    finish_reason=(
+                        req.finish_reason.value if req.finish_reason else None
+                    ),
+                )
+
+    def _forget_request(self, request_id: str) -> None:
+        """Drop per-request emit bookkeeping (abort/evict paths where no
+        finished token event flows through _emit)."""
+        self._first_emit.pop(request_id, None)
+        self._last_emit.pop(request_id, None)
+
     def _emit(self, emitted) -> None:
         for req, token in emitted:
+            self._observe_emit(req)
             cb = self._subscribers.get(req.id)
             if cb is None:
                 continue
@@ -296,11 +355,22 @@ class EngineLoop:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            t_step = time.monotonic()
             try:
                 emitted = self._step_once()
             except Exception as e:  # noqa: BLE001 — fail requests, not the loop
+                self.obs.step_seconds.observe(time.monotonic() - t_step)
                 self.step_failures += 1
                 self._consec_failures += 1
+                scheduled = [
+                    r.id for r in self.engine.slots if r is not None
+                ]
+                log.warning(
+                    "engine '%s' step %d failed (consecutive=%d, "
+                    "scheduled request_ids=%s): %s",
+                    self.name, self.steps, self._consec_failures,
+                    scheduled, e,
+                )
                 if self._consec_failures == 1:
                     # transient faults (preemption, relay hiccup) clear on
                     # an immediate retry of the exact same state
@@ -312,6 +382,7 @@ class EngineLoop:
                 self._quarantine(e)
                 self._consec_failures = 0
                 continue
+            self.obs.step_seconds.observe(time.monotonic() - t_step)
             self._consec_failures = 0
             self._barren_rounds = 0
             self.steps += 1
@@ -349,6 +420,17 @@ class EngineLoop:
     def _evict(self, req, msg: str) -> None:
         self.engine.abort(req.id)
         self.quarantine_evictions += 1
+        log.warning(
+            "engine '%s' evicting request_id=%s trace_id=%s: %s",
+            self.name, req.id, req.trace_id or "-", msg,
+        )
+        if req.trace_id:
+            now = time.monotonic()
+            self._trace.record(
+                req.trace_id, "quarantine", self._first_emit.get(req.id, now),
+                now, plane="engine", request_id=req.id, reason=msg,
+            )
+        self._forget_request(req.id)
         cb = self._subscribers.pop(req.id, None)
         if cb:
             cb(
@@ -372,6 +454,7 @@ class EngineLoop:
             image_positions=req.image_positions,
             positions3=req.positions3,
             mrope_delta=req.mrope_delta,
+            trace_id=req.trace_id,
         )
 
     def _trial(self, group: list) -> bool:
@@ -462,14 +545,27 @@ class EngineLoop:
                 stack.append(group[mid:])    # newer half tested first
             for r in culprits:
                 self.quarantine_evictions += 1
+                msg = (
+                    f"request quarantined: engine step failed while "
+                    f"scheduled ({err})"
+                )
+                log.warning(
+                    "engine '%s' quarantined request_id=%s trace_id=%s: %s",
+                    self.name, r.id, r.trace_id or "-", msg,
+                )
+                if r.trace_id:
+                    now = time.monotonic()
+                    self._trace.record(
+                        r.trace_id, "quarantine", now, now,
+                        plane="engine", request_id=r.id, reason=msg,
+                    )
+                self._forget_request(r.id)
                 cb = self._subscribers.pop(r.id, None)
                 if cb:
                     cb(
                         TokenEvent(
                             request_id=r.id, token_id=-1, finished=True,
-                            finish_reason="error",
-                            error=f"request quarantined: engine step "
-                                  f"failed while scheduled ({err})",
+                            finish_reason="error", error=msg,
                         )
                     )
             if culprits:
@@ -492,6 +588,7 @@ class EngineLoop:
     def _fail_all(self, msg: str) -> None:
         for req in self._active_by_recency():
             self.engine.abort(req.id)
+            self._forget_request(req.id)
             cb = self._subscribers.pop(req.id, None)
             if cb:
                 cb(

@@ -16,14 +16,17 @@ from __future__ import annotations
 
 import asyncio
 import json
+import threading
 import time
 import uuid
 from typing import Optional
 
 from aiohttp import web
 
+from helix_tpu import obs
 from helix_tpu.engine.engine import Request
 from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.obs.trace import TRACE_HEADER
 from helix_tpu.serving.engine_loop import QUEUE_FULL, SHUTTING_DOWN
 from helix_tpu.serving.registry import ModelRegistry
 from helix_tpu.serving.tokenizer import IncrementalDetokenizer, _content_text
@@ -50,37 +53,66 @@ def _longpoll_pool():
 
 
 def _error(status: int, message: str, etype: str = "invalid_request_error",
-           headers: Optional[dict] = None):
-    return web.json_response(
-        {"error": {"message": message, "type": etype}}, status=status,
-        headers=headers,
-    )
+           headers: Optional[dict] = None, trace_id: str = "",
+           request_id: str = ""):
+    """Structured error body.  When a trace id is known it rides both the
+    body and the response header, so a failing request can be correlated
+    from the client straight to runner logs and /v1/debug/traces."""
+    err: dict = {"message": message, "type": etype}
+    if trace_id:
+        err["trace_id"] = trace_id
+        headers = {**(headers or {}), TRACE_HEADER: trace_id}
+    if request_id:
+        err["request_id"] = request_id
+    return web.json_response({"error": err}, status=status, headers=headers)
 
 
 class EngineRequestError(Exception):
     """A request the engine rejected or failed mid-flight; surfaces as a
     structured 4xx/5xx instead of a dead stream."""
 
+    def __init__(self, message: str, request_id: str = ""):
+        super().__init__(message)
+        self.request_id = request_id
 
-def _engine_error_response(e: Exception):
+
+def _engine_error_response(e: Exception, trace_id: str = ""):
     """Map an engine error onto its HTTP shape: shed load is a clean 429
     with Retry-After, drain is 503, engine timeouts are 504, everything
     else stays the seed's 400."""
     msg = str(e)
+    rid = getattr(e, "request_id", "")
     if msg.startswith(QUEUE_FULL):
         return _error(429, msg, "overloaded_error",
-                      headers={"Retry-After": "1"})
+                      headers={"Retry-After": "1"}, trace_id=trace_id,
+                      request_id=rid)
     if msg.startswith(SHUTTING_DOWN):
         return _error(503, msg, "overloaded_error",
-                      headers={"Retry-After": "5"})
+                      headers={"Retry-After": "5"}, trace_id=trace_id,
+                      request_id=rid)
     if msg.startswith("inter_token_timeout"):
-        return _error(504, msg, "timeout_error")
-    return _error(400, msg)
+        return _error(504, msg, "timeout_error", trace_id=trace_id,
+                      request_id=rid)
+    return _error(400, msg, trace_id=trace_id, request_id=rid)
+
+
+def _sse_error_frame(e: Exception, trace_id: str = "") -> dict:
+    """In-band SSE error payload with correlation ids (a quarantined
+    request's client error names the trace/request the runner logged)."""
+    err: dict = {"message": str(e)}
+    if trace_id:
+        err["trace_id"] = trace_id
+    rid = getattr(e, "request_id", "")
+    if rid:
+        err["request_id"] = rid
+    return {"error": err}
 
 
 class OpenAIServer:
     def __init__(self, registry: ModelRegistry, metrics=None,
-                 inter_token_timeout: Optional[float] = None):
+                 inter_token_timeout: Optional[float] = None,
+                 obs_registry: Optional[obs.Registry] = None,
+                 trace_store: Optional[obs.TraceStore] = None):
         import os
         from helix_tpu.serving.logbuf import install as install_logbuf
 
@@ -88,6 +120,13 @@ class OpenAIServer:
         self.metrics = metrics
         self.started = time.monotonic()
         self.logbuf = install_logbuf()
+        # shared metrics registry (obs): every runner-side series renders
+        # through it — engine counters/gauges attach per model at scrape
+        # time, latency histograms come from each EngineLoop's obs bundle
+        self.obs = obs_registry or obs.Registry()
+        self.obs.register_callback(self._collect_metrics)
+        self.traces = trace_store or obs.default_store()
+        self._profiler_lock = threading.Lock()
         # max seconds between consecutive engine events for one request
         # before the server gives up on it (wedged engine watchdog)
         self.inter_token_timeout = (
@@ -108,6 +147,12 @@ class OpenAIServer:
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/embeddings", self.embeddings)
         app.router.add_post("/v1/messages", self.anthropic_messages)
+        # request tracing + on-demand device profiling (obs)
+        app.router.add_get("/v1/debug/traces", self.debug_traces_list)
+        app.router.add_get(
+            "/v1/debug/traces/{trace_id}", self.debug_trace
+        )
+        app.router.add_post("/admin/profiler", self.profiler_capture)
         # multi-host lockstep journal (followers long-poll over DCN;
         # see serving/multihost_serving.py)
         app.router.add_get("/multihost/commands", self.multihost_commands)
@@ -148,55 +193,91 @@ class OpenAIServer:
         )
 
     async def prometheus_metrics(self, request):
-        lines = [
-            "# TYPE helix_uptime_seconds gauge",
-            f"helix_uptime_seconds {time.monotonic() - self.started:.1f}",
-        ]
+        """Prometheus text surface, rendered by the shared obs registry.
+        Runs in an executor: scrape-time collectors take live locks (the
+        residency manager's stats() lock is held across whole model
+        builds) and must never block the event loop."""
+        text = await asyncio.get_running_loop().run_in_executor(
+            None, self.obs.render
+        )
+        return web.Response(text=text)
+
+    def _collect_metrics(self, c: "obs.Collector") -> None:
+        """Scrape-time collection from every live engine (per-model
+        labels) + the residency manager.  Counter/gauge values are plain
+        GIL-atomic int reads off the engine thread's state."""
+        c.gauge(
+            "helix_uptime_seconds", time.monotonic() - self.started,
+            help="Runner process uptime",
+        )
         for m in self.registry.list():
             if m.loop is None:
                 continue
             eng = m.loop.engine
-            tag = f'{{model="{m.name}"}}'
-            lines += [
-                f"helix_engine_steps{tag} {m.loop.steps}",
-                f"helix_prefill_tokens_total{tag} {eng.num_prefill_tokens}",
-                f"helix_decode_tokens_total{tag} {eng.num_decode_tokens}",
-                # ragged mixed steps: chunk prefill + decode in ONE call
-                f"helix_mixed_steps_total{tag} "
-                f"{getattr(eng, 'num_mixed_steps', 0)}",
-                # MoE prefill routing assignments dropped to expert-
-                # capacity overflow (rode the residual stream instead)
-                f"helix_moe_dropped_tokens_total{tag} "
-                f"{getattr(eng, 'moe_dropped_tokens', 0)}",
-                f"helix_waiting_requests{tag} {len(eng.waiting)}",
-                f"helix_active_slots{tag} "
-                f"{sum(1 for s in eng.slots if s is not None)}",
-                f"helix_free_pages{tag} {eng.allocator.free_pages}",
-                # robustness spine: step failure/retry/quarantine/shed
-                # accounting (ISSUE 2)
-                f"helix_step_failures_total{tag} "
-                f"{getattr(m.loop, 'step_failures', 0)}",
-                f"helix_step_retries_total{tag} "
-                f"{getattr(m.loop, 'step_retries', 0)}",
-                f"helix_quarantine_evictions_total{tag} "
-                f"{getattr(m.loop, 'quarantine_evictions', 0)}",
-                f"helix_shed_requests_total{tag} "
-                f"{getattr(m.loop, 'shed_requests', 0)}",
-            ]
+            lbl = {"model": m.name}
+            c.counter("helix_engine_steps", m.loop.steps, lbl)
+            c.counter(
+                "helix_prefill_tokens_total", eng.num_prefill_tokens, lbl
+            )
+            c.counter(
+                "helix_decode_tokens_total", eng.num_decode_tokens, lbl
+            )
+            # ragged mixed steps: chunk prefill + decode in ONE call
+            c.counter(
+                "helix_mixed_steps_total",
+                getattr(eng, "num_mixed_steps", 0), lbl,
+            )
+            # MoE prefill routing assignments dropped to expert-capacity
+            # overflow (rode the residual stream instead)
+            c.counter(
+                "helix_moe_dropped_tokens_total",
+                getattr(eng, "moe_dropped_tokens", 0), lbl,
+            )
+            c.gauge("helix_waiting_requests", len(eng.waiting), lbl)
+            c.gauge(
+                "helix_active_slots",
+                sum(1 for s in eng.slots if s is not None), lbl,
+            )
+            c.gauge("helix_free_pages", eng.allocator.free_pages, lbl)
+            # robustness spine: step failure/retry/quarantine/shed
+            # accounting (ISSUE 2)
+            c.counter(
+                "helix_step_failures_total",
+                getattr(m.loop, "step_failures", 0), lbl,
+            )
+            c.counter(
+                "helix_step_retries_total",
+                getattr(m.loop, "step_retries", 0), lbl,
+            )
+            c.counter(
+                "helix_quarantine_evictions_total",
+                getattr(m.loop, "quarantine_evictions", 0), lbl,
+            )
+            c.counter(
+                "helix_shed_requests_total",
+                getattr(m.loop, "shed_requests", 0), lbl,
+            )
+            # latency histograms (TTFT / queue wait / inter-token / step
+            # duration) observed by the engine loop itself
+            loop_obs = getattr(m.loop, "obs", None)
+            if loop_obs is not None:
+                loop_obs.collect(c, lbl)
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 st = pc.stats
-                lines += [
-                    f"helix_prefix_cache_pages{tag} {st['pages']}",
-                    f"helix_prefix_cache_hit_pages_total{tag} "
-                    f"{st['hits']}",
-                    f"helix_prefix_cache_miss_pages_total{tag} "
-                    f"{st['misses']}",
-                ]
+                c.gauge("helix_prefix_cache_pages", st["pages"], lbl)
+                c.counter(
+                    "helix_prefix_cache_hit_pages_total", st["hits"], lbl
+                )
+                c.counter(
+                    "helix_prefix_cache_miss_pages_total", st["misses"], lbl
+                )
             ttfts = getattr(eng, "recent_ttfts", None)
             if ttfts:
-                # the engine thread appends concurrently; a mutation during
-                # iteration raises — retry on a fresh snapshot
+                # rolling-window percentiles kept for dashboard
+                # continuity (the histogram is the durable surface).
+                # The engine thread appends concurrently; a mutation
+                # during iteration raises — retry on a fresh snapshot
                 s = []
                 for _ in range(3):
                     try:
@@ -205,33 +286,124 @@ class OpenAIServer:
                     except RuntimeError:
                         continue
                 if s:
-                    lines += [
-                        f"helix_ttft_ms_p50{tag} {s[len(s) // 2]:.1f}",
-                        f"helix_ttft_ms_p95{tag} "
-                        f"{s[min(len(s) - 1, int(len(s) * 0.95))]:.1f}",
-                    ]
+                    c.gauge("helix_ttft_ms_p50", s[len(s) // 2], lbl)
+                    c.gauge(
+                        "helix_ttft_ms_p95",
+                        s[min(len(s) - 1, int(len(s) * 0.95))], lbl,
+                    )
         mgr = self._residency_manager()
         if mgr is not None:
-            # executor: stats() takes the manager lock, which acquire()
-            # holds across whole model builds — never block the event loop
-            st = await asyncio.get_running_loop().run_in_executor(
-                None, mgr.stats
-            )
-            lines += [
-                "# TYPE helix_residency_loads_total counter",
-                f"helix_residency_loads_total {st['loads']}",
-                f"helix_residency_evictions_total {st['evictions']}",
-                f"helix_residency_used_bytes {st['used_bytes']}",
-            ]
+            st = mgr.stats()
+            c.counter("helix_residency_loads_total", st["loads"])
+            c.counter("helix_residency_evictions_total", st["evictions"])
+            c.gauge("helix_residency_used_bytes", st["used_bytes"])
             for name, ms in sorted(st["swap_ms"].items()):
-                lines.append(
-                    f'helix_model_swap_ms{{model="{name}"}} {ms:.1f}'
-                )
+                c.gauge("helix_model_swap_ms", ms, {"model": name})
             for name, ms in sorted(st["load_ms"].items()):
-                lines.append(
-                    f'helix_model_load_ms{{model="{name}"}} {ms:.1f}'
-                )
-        return web.Response(text="\n".join(lines) + "\n")
+                c.gauge("helix_model_load_ms", ms, {"model": name})
+
+    # -- tracing + profiling ---------------------------------------------
+    @staticmethod
+    def _require_runner_token(request):
+        """Debug surfaces carry request metadata / cost serving latency:
+        when the node has a shared runner token configured, callers must
+        present it (``X-Runner-Token``).  Without one (dev, unix-socket,
+        behind-the-tunnel deployments) they stay open like /logs."""
+        import hmac
+        import os
+
+        token = os.environ.get("HELIX_RUNNER_TOKEN", "")
+        if token and not hmac.compare_digest(
+            request.headers.get("X-Runner-Token", ""), token
+        ):
+            return _error(403, "requires the runner token")
+        return None
+
+    async def debug_traces_list(self, request):
+        denied = self._require_runner_token(request)
+        if denied is not None:
+            return denied
+        return web.json_response({"traces": self.traces.ids()[-100:]})
+
+    async def debug_trace(self, request):
+        denied = self._require_runner_token(request)
+        if denied is not None:
+            return denied
+        tid = request.match_info["trace_id"]
+        if request.query.get("format") == "chrome":
+            doc = self.traces.chrome_trace(tid)
+        else:
+            doc = self.traces.get(tid)
+        if doc is None:
+            return _error(404, f"unknown trace {tid!r}")
+        return web.json_response(doc)
+
+    async def profiler_capture(self, request):
+        """On-demand ``jax.profiler`` capture against the live runner:
+        POST {"seconds": 2} starts a device+host trace and returns the
+        directory to feed TensorBoard/XProf.  One capture at a time; the
+        capture runs in an executor so serving traffic keeps flowing
+        while it records.
+
+        Trust model: captures are expensive (real serving-latency cost)
+        and write to disk, so when ``HELIX_RUNNER_TOKEN`` is set the
+        caller must present it (``X-Runner-Token``) — the same shared
+        secret the node uses on the control loop.  Capture directories
+        are always minted under the system temp dir (or the operator's
+        ``HELIX_PROFILER_DIR``); clients never choose the path."""
+        import os
+
+        denied = self._require_runner_token(request)
+        if denied is not None:
+            return denied
+        try:
+            body = await request.json() if request.can_read_body else {}
+        except Exception:  # noqa: BLE001 — client error
+            return _error(400, "invalid JSON body")
+        if not isinstance(body, dict):
+            return _error(400, "body must be a JSON object")
+        try:
+            seconds = min(max(float(body.get("seconds", 2.0)), 0.01), 60.0)
+        except (TypeError, ValueError):
+            return _error(400, "'seconds' must be a number")
+        if not self._profiler_lock.acquire(blocking=False):
+            return _error(
+                409, "a profiler capture is already running",
+                "overloaded_error",
+            )
+
+        def capture():
+            # the CAPTURE THREAD owns the lock release: if the client
+            # disconnects and the awaiting handler is cancelled, the
+            # capture still runs to completion — releasing in the
+            # handler would let a retry call start_trace concurrently
+            try:
+                import tempfile
+                import jax
+
+                base = os.environ.get("HELIX_PROFILER_DIR") or None
+                d = tempfile.mkdtemp(prefix="helix-jax-profile-", dir=base)
+                jax.profiler.start_trace(d)
+                try:
+                    time.sleep(seconds)
+                finally:
+                    jax.profiler.stop_trace()
+                return d
+            finally:
+                self._profiler_lock.release()
+
+        try:
+            fut = asyncio.get_running_loop().run_in_executor(None, capture)
+        except Exception:   # submission failed: the thread never runs
+            self._profiler_lock.release()
+            raise
+        try:
+            d = await fut
+        except asyncio.CancelledError:
+            raise   # capture thread finishes + releases on its own
+        except Exception as e:  # noqa: BLE001 — profiler not available
+            return _error(501, f"jax profiler capture failed: {e}")
+        return web.json_response({"log_dir": d, "seconds": seconds})
 
     def _residency_manager(self):
         """The ResidencyManager behind the registry, if hot-swap is on."""
@@ -329,7 +501,7 @@ class OpenAIServer:
         )
 
     @staticmethod
-    def _precheck_admission(served, prompt_ids):
+    def _precheck_admission(served, prompt_ids, trace_id: str = ""):
         """Shed before committing response headers: streaming handlers
         prepare() the SSE response before the first engine event, so a
         queue_full discovered after submit can only surface as an in-band
@@ -340,7 +512,16 @@ class OpenAIServer:
         err = check(len(prompt_ids), count_shed=True)
         if err is None:
             return None
-        return _engine_error_response(EngineRequestError(err))
+        return _engine_error_response(
+            EngineRequestError(err), trace_id=trace_id
+        )
+
+    def _trace_id(self, request) -> str:
+        """The request's end-to-end trace identity: adopt the control
+        plane's (header, shape-validated) or mint one at this endpoint."""
+        from helix_tpu.obs.trace import adopt_trace_id
+
+        return adopt_trace_id(request.headers.get(TRACE_HEADER))
 
     def _sampling_from_body(self, body: dict) -> SamplingParams:
         stop = body.get("stop") or []
@@ -361,9 +542,11 @@ class OpenAIServer:
             seed=body.get("seed"),
         )
 
-    async def _generate(self, served, prompt_ids, sampling, extra=None):
+    async def _generate(self, served, prompt_ids, sampling, extra=None,
+                        trace_id: str = ""):
         """Submit to the engine; yields (delta_text, token_id, finished,
-        finish_reason).  ``extra`` carries multimodal Request fields."""
+        finish_reason).  ``extra`` carries multimodal Request fields;
+        ``trace_id`` rides the Request into engine-level spans."""
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
@@ -375,6 +558,7 @@ class OpenAIServer:
             prompt_tokens=list(prompt_ids),
             sampling=sampling,
             stop_token_ids=tuple(served.tokenizer.eos_ids),
+            trace_id=trace_id,
             **(extra or {}),
         )
         served.loop.submit(req, on_event)
@@ -394,10 +578,10 @@ class OpenAIServer:
                     raise EngineRequestError(
                         f"inter_token_timeout: no engine event for "
                         f"{self.inter_token_timeout:.0f}s; request "
-                        f"{req.id} aborted"
+                        f"{req.id} aborted", request_id=req.id,
                     ) from None
                 if ev.error:
-                    raise EngineRequestError(ev.error)
+                    raise EngineRequestError(ev.error, request_id=req.id)
                 is_eos = ev.token_id in served.tokenizer.eos_ids
                 delta = "" if is_eos else detok.push(ev.token_id)
                 # serving-level stop strings
@@ -427,20 +611,23 @@ class OpenAIServer:
             body = await request.json()
         except Exception:
             return _error(400, "invalid JSON body")
+        tid = self._trace_id(request)
+        t_req = time.monotonic()
         model = body.get("model", "")
         served, err = await self._lookup(model)
         if err is not None:
             return err
         if served.kind == "embedding":
             return _error(404, f"model '{model}' is an embedding model",
-                          "model_not_found")
+                          "model_not_found", trace_id=tid)
         err = self._require_loop(served, model)
         if err is not None:
             return err
         messages = body.get("messages")
         if not messages:
-            return _error(400, "'messages' is required")
+            return _error(400, "'messages' is required", trace_id=tid)
         sampling = self._sampling_from_body(body)
+        t_admit = time.monotonic()
         has_images = any(
             isinstance(m.get("content"), list)
             and any(
@@ -453,20 +640,28 @@ class OpenAIServer:
         if has_images:
             if served.vision is None:
                 return _error(
-                    400, f"model '{model}' does not accept image input"
+                    400, f"model '{model}' does not accept image input",
+                    trace_id=tid,
                 )
             try:
                 extra = await asyncio.get_running_loop().run_in_executor(
                     None, served.vision.prepare, messages, served.tokenizer
                 )
             except Exception as e:  # noqa: BLE001 — bad image data etc.
-                return _error(400, f"image processing failed: {e}")
+                return _error(
+                    400, f"image processing failed: {e}", trace_id=tid
+                )
             prompt_ids = extra.pop("prompt_tokens")
         else:
             prompt_ids = served.tokenizer.apply_chat_template(
                 messages, add_generation_prompt=True
             )
-        shed = self._precheck_admission(served, prompt_ids)
+        shed = self._precheck_admission(served, prompt_ids, trace_id=tid)
+        self.traces.record(
+            tid, "admit", t_admit, time.monotonic(), plane="runner",
+            model=model, prompt_tokens=len(prompt_ids),
+            shed=shed is not None,
+        )
         if shed is not None:
             return shed
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
@@ -477,6 +672,7 @@ class OpenAIServer:
                 headers={
                     "Content-Type": "text/event-stream",
                     "Cache-Control": "no-cache",
+                    TRACE_HEADER: tid,
                 }
             )
             await resp.prepare(request)
@@ -487,10 +683,13 @@ class OpenAIServer:
             first = True
             finish_reason = None
             ntokens = 0
+            t_emit = None
             try:
               async for delta, tok, finished, reason in self._generate(
-                served, prompt_ids, sampling, extra
+                served, prompt_ids, sampling, extra, trace_id=tid
               ):
+                if t_emit is None:
+                    t_emit = time.monotonic()
                 ntokens += 1
                 chunk_delta = {}
                 if first:
@@ -517,25 +716,46 @@ class OpenAIServer:
                 if finished:
                     break
             except EngineRequestError as e:
-                await send({"error": {"message": str(e)}})
+                await send(_sse_error_frame(e, tid))
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
+            end = time.monotonic()
+            self.traces.record(
+                tid, "emit", t_emit if t_emit is not None else end, end,
+                plane="runner", tokens=ntokens, stream=True,
+            )
+            self.traces.record(
+                tid, "request", t_req, end, plane="runner",
+                endpoint=request.path, model=model, http_id=rid,
+            )
             return resp
 
         text_parts = []
         finish_reason = "stop"
         ntokens = 0
+        t_emit = None
         try:
           async for delta, tok, finished, reason in self._generate(
-            served, prompt_ids, sampling, extra
+            served, prompt_ids, sampling, extra, trace_id=tid
           ):
+            if t_emit is None:
+                t_emit = time.monotonic()
             text_parts.append(delta)
             ntokens += 1
             if finished:
                 finish_reason = reason or "stop"
                 break
         except EngineRequestError as e:
-            return _engine_error_response(e)
+            return _engine_error_response(e, trace_id=tid)
+        end = time.monotonic()
+        self.traces.record(
+            tid, "emit", t_emit if t_emit is not None else end, end,
+            plane="runner", tokens=ntokens, stream=False,
+        )
+        self.traces.record(
+            tid, "request", t_req, end, plane="runner",
+            endpoint=request.path, model=model, http_id=rid,
+        )
         return web.json_response(
             {
                 "id": rid,
@@ -557,7 +777,8 @@ class OpenAIServer:
                     "completion_tokens": ntokens,
                     "total_tokens": len(prompt_ids) + ntokens,
                 },
-            }
+            },
+            headers={TRACE_HEADER: tid},
         )
 
     # ------------------------------------------------------------------
@@ -566,6 +787,8 @@ class OpenAIServer:
             body = await request.json()
         except Exception:
             return _error(400, "invalid JSON body")
+        tid = self._trace_id(request)
+        t_req = time.monotonic()
         model = body.get("model", "")
         served, err = await self._lookup(model)
         if err is not None:
@@ -577,8 +800,14 @@ class OpenAIServer:
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
         sampling = self._sampling_from_body(body)
+        t_admit = time.monotonic()
         prompt_ids = served.tokenizer.encode(prompt)
-        shed = self._precheck_admission(served, prompt_ids)
+        shed = self._precheck_admission(served, prompt_ids, trace_id=tid)
+        self.traces.record(
+            tid, "admit", t_admit, time.monotonic(), plane="runner",
+            model=model, prompt_tokens=len(prompt_ids),
+            shed=shed is not None,
+        )
         if shed is not None:
             return shed
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
@@ -586,13 +815,21 @@ class OpenAIServer:
 
         if body.get("stream"):
             resp = web.StreamResponse(
-                headers={"Content-Type": "text/event-stream"}
+                headers={
+                    "Content-Type": "text/event-stream",
+                    TRACE_HEADER: tid,
+                }
             )
             await resp.prepare(request)
+            n = 0
+            t_emit = None
             try:
               async for delta, tok, finished, reason in self._generate(
-                served, prompt_ids, sampling
+                served, prompt_ids, sampling, trace_id=tid
               ):
+                if t_emit is None:
+                    t_emit = time.monotonic()
+                n += 1
                 await resp.write(
                     f"data: {json.dumps({'id': rid, 'object': 'text_completion', 'created': created, 'model': model, 'choices': [{'index': 0, 'text': delta, 'finish_reason': reason if finished else None}]})}\n\n".encode()
                 )
@@ -600,26 +837,48 @@ class OpenAIServer:
                     break
             except EngineRequestError as e:
                 await resp.write(
-                    f"data: {json.dumps({'error': {'message': str(e)}})}\n\n".encode()
+                    f"data: {json.dumps(_sse_error_frame(e, tid))}\n\n"
+                    .encode()
                 )
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
+            end = time.monotonic()
+            self.traces.record(
+                tid, "emit", t_emit if t_emit is not None else end, end,
+                plane="runner", tokens=n, stream=True,
+            )
+            self.traces.record(
+                tid, "request", t_req, end, plane="runner",
+                endpoint=request.path, model=model, http_id=rid,
+            )
             return resp
 
         parts = []
         finish_reason = "stop"
         n = 0
+        t_emit = None
         try:
           async for delta, tok, finished, reason in self._generate(
-            served, prompt_ids, sampling
+            served, prompt_ids, sampling, trace_id=tid
           ):
+            if t_emit is None:
+                t_emit = time.monotonic()
             parts.append(delta)
             n += 1
             if finished:
                 finish_reason = reason or "stop"
                 break
         except EngineRequestError as e:
-            return _engine_error_response(e)
+            return _engine_error_response(e, trace_id=tid)
+        end = time.monotonic()
+        self.traces.record(
+            tid, "emit", t_emit if t_emit is not None else end, end,
+            plane="runner", tokens=n, stream=False,
+        )
+        self.traces.record(
+            tid, "request", t_req, end, plane="runner",
+            endpoint=request.path, model=model, http_id=rid,
+        )
         return web.json_response(
             {
                 "id": rid,
@@ -638,7 +897,8 @@ class OpenAIServer:
                     "completion_tokens": n,
                     "total_tokens": len(prompt_ids) + n,
                 },
-            }
+            },
+            headers={TRACE_HEADER: tid},
         )
 
     # ------------------------------------------------------------------
@@ -714,6 +974,8 @@ class OpenAIServer:
             body = await request.json()
         except Exception:
             return _error(400, "invalid JSON body")
+        tid = self._trace_id(request)
+        t_req = time.monotonic()
         model = body.get("model", "")
         served, err = await self._lookup(model)
         if err is not None:
@@ -731,17 +993,26 @@ class OpenAIServer:
             max_tokens=int(body.get("max_tokens", 256)),
             stop=tuple(body.get("stop_sequences", []) or []),
         )
+        t_admit = time.monotonic()
         prompt_ids = served.tokenizer.apply_chat_template(
             messages, add_generation_prompt=True
         )
-        shed = self._precheck_admission(served, prompt_ids)
+        shed = self._precheck_admission(served, prompt_ids, trace_id=tid)
+        self.traces.record(
+            tid, "admit", t_admit, time.monotonic(), plane="runner",
+            model=model, prompt_tokens=len(prompt_ids),
+            shed=shed is not None,
+        )
         if shed is not None:
             return shed
         rid = f"msg_{uuid.uuid4().hex[:20]}"
 
         if body.get("stream"):
             resp = web.StreamResponse(
-                headers={"Content-Type": "text/event-stream"}
+                headers={
+                    "Content-Type": "text/event-stream",
+                    TRACE_HEADER: tid,
+                }
             )
             await resp.prepare(request)
 
@@ -774,10 +1045,13 @@ class OpenAIServer:
             )
             n = 0
             stop_reason = "end_turn"
+            t_emit = None
             try:
               async for delta, tok, finished, reason in self._generate(
-                served, prompt_ids, sampling
+                served, prompt_ids, sampling, trace_id=tid
               ):
+                if t_emit is None:
+                    t_emit = time.monotonic()
                 n += 1
                 if delta:
                     await ev(
@@ -795,7 +1069,7 @@ class OpenAIServer:
                     break
             except EngineRequestError as e:
                 await ev("error", {"type": "error",
-                                   "error": {"message": str(e)}})
+                                   "error": _sse_error_frame(e, tid)["error"]})
             await ev(
                 "content_block_stop", {"type": "content_block_stop", "index": 0}
             )
@@ -809,22 +1083,43 @@ class OpenAIServer:
             )
             await ev("message_stop", {"type": "message_stop"})
             await resp.write_eof()
+            end = time.monotonic()
+            self.traces.record(
+                tid, "emit", t_emit if t_emit is not None else end, end,
+                plane="runner", tokens=n, stream=True,
+            )
+            self.traces.record(
+                tid, "request", t_req, end, plane="runner",
+                endpoint=request.path, model=model, http_id=rid,
+            )
             return resp
 
         parts = []
         n = 0
         stop_reason = "end_turn"
+        t_emit = None
         try:
           async for delta, tok, finished, reason in self._generate(
-            served, prompt_ids, sampling
+            served, prompt_ids, sampling, trace_id=tid
           ):
+            if t_emit is None:
+                t_emit = time.monotonic()
             parts.append(delta)
             n += 1
             if finished:
                 stop_reason = "max_tokens" if reason == "length" else "end_turn"
                 break
         except EngineRequestError as e:
-            return _engine_error_response(e)
+            return _engine_error_response(e, trace_id=tid)
+        end = time.monotonic()
+        self.traces.record(
+            tid, "emit", t_emit if t_emit is not None else end, end,
+            plane="runner", tokens=n, stream=False,
+        )
+        self.traces.record(
+            tid, "request", t_req, end, plane="runner",
+            endpoint=request.path, model=model, http_id=rid,
+        )
         return web.json_response(
             {
                 "id": rid,
@@ -837,7 +1132,8 @@ class OpenAIServer:
                     "input_tokens": len(prompt_ids),
                     "output_tokens": n,
                 },
-            }
+            },
+            headers={TRACE_HEADER: tid},
         )
 
 

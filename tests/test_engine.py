@@ -1039,6 +1039,8 @@ class TestMixedStep:
         assert dec_m == dec_s
         assert long_m == long_s
 
+    @pytest.mark.slow  # ~43 s; mixed-step parity + int8-engine parity
+    # siblings keep both axes covered in tier-1
     def test_mixed_step_with_int8_kv(self, tiny_model):
         """The fused mixed step composes with the int8 pool."""
         cfg, params = tiny_model

@@ -74,6 +74,8 @@ def test_tp2_greedy_parity(tiny_cfg, baseline_tokens):
     assert _generate(eng) == baseline_tokens
 
 
+@pytest.mark.slow  # ~21 s; tp2 bf16 parity + single-chip int8 engine
+# parity stay in tier-1, covering both axes of this composition
 def test_tp2_int8_parity(tiny_cfg):
     params = init_params(tiny_cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
     q_single = jax.jit(quantize_params)(params)

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Metric-name + exposition-drift linter for the helix serving spine.
+
+Two contracts, enforced repo-wide (wired into tier-1 via
+``tests/test_observability.py``):
+
+1. **Naming**: every metric-name string literal (``"helix_..."``) must
+   be lowercase snake_case (``helix_[a-z0-9_]+``) with base-unit
+   suffixes only — ``_total`` for counters, ``_seconds`` / ``_bytes``
+   for units; ``_ms`` / ``_cnt``-style suffixes are rejected (a short
+   legacy allowlist grandfathers PR 1's ms gauges).
+2. **No ad-hoc exposition**: Prometheus text formatting (f-strings that
+   build ``helix_...`` sample lines, or ``# TYPE`` literals) may exist
+   ONLY inside ``helix_tpu/obs/`` — everything else feeds the shared
+   registry.  PR 1/2 grew three hand-rolled ``/metrics`` builders that
+   drifted apart; this keeps it at zero.
+
+Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
+line per violation.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+# the naming contract (keep in sync with helix_tpu.obs.metrics):
+# lowercase snake_case under the helix_ prefix...
+NAME_RE = re.compile(r"helix_[a-z0-9_]+")
+# ...with base units only: counters end _total, durations are _seconds,
+# sizes are _bytes.  Non-base-unit suffixes are rejected so new series
+# can't drift into _ms/_cnt style.
+_BAD_SUFFIXES = ("_ms", "_us", "_millis", "_msec", "_cnt", "_num")
+# PR 1-era gauges kept for dashboard continuity; do not add to this list
+_LEGACY_NAMES = frozenset({
+    "helix_ttft_ms_p50",
+    "helix_ttft_ms_p95",
+    "helix_model_swap_ms",
+    "helix_model_load_ms",
+})
+
+# any quoted string that *starts* with helix_ is treated as a metric-name
+# candidate (module paths use dots / dashes and never match)
+_NAME_LITERAL = re.compile(r"""["'](helix_[A-Za-z0-9_]*)["']""")
+
+# exposition built outside the registry: an f-string whose text starts
+# with a metric name (f"helix_foo{tag} {value}"), or a "# TYPE" literal
+_ADHOC_FSTRING = re.compile(r"""f["']helix_""")
+_ADHOC_TYPE = re.compile(r"""["']\# TYPE """)
+
+# suffixes the registry appends itself; a literal carrying one would
+# double-suffix the exposition
+_RESERVED_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _iter_py_files(root: str):
+    for base in ("helix_tpu", "tools"):
+        top = os.path.join(root, base)
+        if not os.path.isdir(top):
+            continue
+        for dirpath, dirnames, filenames in os.walk(top):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def _in_obs(path: str, root: str) -> bool:
+    rel = os.path.relpath(path, root)
+    return rel.startswith(os.path.join("helix_tpu", "obs") + os.sep)
+
+
+def _is_self(path: str) -> bool:
+    return os.path.basename(path) == "lint_metrics.py"
+
+
+def run(root: str) -> list:
+    """Returns a list of violation strings (empty = clean)."""
+    violations: list = []
+    for path in _iter_py_files(root):
+        if _is_self(path):
+            continue
+        rel = os.path.relpath(path, root)
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.read().splitlines()
+        allowed_exposition = _in_obs(path, root)
+        for i, line in enumerate(lines, 1):
+            for m in _NAME_LITERAL.finditer(line):
+                name = m.group(1)
+                if not NAME_RE.fullmatch(name):
+                    violations.append(
+                        f"{rel}:{i}: metric name {name!r} violates "
+                        "helix_[a-z0-9_]+ (lowercase snake_case)"
+                    )
+                elif (
+                    name not in _LEGACY_NAMES
+                    and any(name.endswith(s) for s in _BAD_SUFFIXES)
+                ):
+                    violations.append(
+                        f"{rel}:{i}: metric name {name!r} uses a "
+                        "non-base-unit suffix; use _seconds/_bytes/_total"
+                    )
+                elif not allowed_exposition and any(
+                    name.endswith(s) for s in _RESERVED_SUFFIXES
+                ):
+                    violations.append(
+                        f"{rel}:{i}: metric name {name!r} carries a "
+                        "registry-reserved suffix "
+                        f"({'/'.join(_RESERVED_SUFFIXES)})"
+                    )
+            if allowed_exposition:
+                continue
+            if _ADHOC_FSTRING.search(line):
+                violations.append(
+                    f"{rel}:{i}: ad-hoc Prometheus exposition (f-string "
+                    "building a helix_ sample line) outside "
+                    "helix_tpu/obs/ — feed the shared registry instead"
+                )
+            if _ADHOC_TYPE.search(line):
+                violations.append(
+                    f"{rel}:{i}: ad-hoc '# TYPE' exposition literal "
+                    "outside helix_tpu/obs/ — feed the shared registry "
+                    "instead"
+                )
+    return violations
+
+
+def main() -> int:
+    root = sys.argv[1] if len(sys.argv) > 1 else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
+    violations = run(root)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"lint_metrics: {len(violations)} violation(s)")
+        return 1
+    print("lint_metrics: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
