@@ -294,32 +294,38 @@ def main():
             )
             for i, p in enumerate(prompts)
         ]
+        d0 = eng.num_decode_tokens
+        s0 = eng.num_decode_device_steps
         t0 = time.perf_counter()
         for r in reqs:
             eng.add_request(r)
         while eng.has_work():
             eng.step()
         dt = time.perf_counter() - t0
-        return reqs, dt
+        return (
+            reqs, dt,
+            eng.num_decode_device_steps - s0,
+            eng.num_decode_tokens - d0,
+        )
 
     def measure(kv):
         eng = make_engine(kv)
         run_workload(eng, f"warmup-{kv}")   # compiles every measured shape
-        reqs, dt = run_workload(eng, f"bench-{kv}")
-        return eng, reqs, dt
+        reqs, dt, steps, decode_toks = run_workload(eng, f"bench-{kv}")
+        return eng, reqs, dt, steps, decode_toks
 
     other_toks_per_s = None
     if compare:
         # secondary config first (engine freed before the primary runs so
         # two page pools never coexist in HBM)
         other_kv = "auto" if kv_dtype == "int8" else "int8"
-        o_eng, o_reqs, o_dt = measure(other_kv)
+        o_eng, o_reqs, o_dt, _, _ = measure(other_kv)
         other_toks_per_s = (
             sum(len(r.output_tokens) for r in o_reqs) / o_dt
         )
         del o_eng, o_reqs
 
-    eng, reqs, dt = measure(kv_dtype)
+    eng, reqs, dt, bench_steps, bench_decode_toks = measure(kv_dtype)
 
     # single-session TTFT (north star line 2: "p50 TTFT, single-session
     # chat") — measured separately from burst admission: one request on an
@@ -372,6 +378,26 @@ def main():
         "prompt_len": prompt_len,
         "gen_len": gen_len,
         "kv_cache_dtype": eng.cache_cfg.dtype,
+    }
+    # saturation snapshot (ISSUE 4): BENCH_r* tracks efficiency, not just
+    # raw tokens/s — peak KV occupancy, decode-slot utilization across the
+    # timed pass, prefix hit rate (0 here: APC is off for comparability),
+    # padding waste and goodput
+    kv_cap = getattr(eng, "kv_pages_capacity", max(1, num_pages - 1))
+    pc_hits = eng.prefix_cache.hits if eng.prefix_cache else 0
+    pc_misses = eng.prefix_cache.misses if eng.prefix_cache else 0
+    result["saturation"] = {
+        "peak_kv_pages_used": eng.allocator.peak_used,
+        "kv_pages_capacity": kv_cap,
+        "peak_kv_occupancy": round(eng.allocator.peak_used / kv_cap, 4),
+        "decode_slot_utilization": round(
+            bench_decode_toks / max(1, bench_steps * batch), 4
+        ),
+        "prefix_hit_rate": round(
+            pc_hits / (pc_hits + pc_misses), 4
+        ) if pc_hits + pc_misses else 0.0,
+        "prefill_padding_tokens": eng.num_prefill_padding_tokens,
+        "goodput_tokens_per_sec": round(toks_per_s, 2),
     }
     if other_toks_per_s is not None:
         # same batch, same prompts, other KV storage dtype — the

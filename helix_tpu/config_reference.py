@@ -41,6 +41,13 @@ ENV_REFERENCE: tuple = (
         section="accelerator",
     ),
     EnvVar(
+        "HELIX_PEAK_FLOPS",
+        "Peak accelerator FLOP/s used as the denominator of the runner's "
+        "helix_mfu_estimate gauge. Unset: the v5e bf16 peak (197e12) on "
+        "TPU backends, no MFU gauge elsewhere.",
+        section="accelerator",
+    ),
+    EnvVar(
         "HELIX_EXACT_SAMPLING",
         "Set to 1 to force the exact full-vocab top-p sampling path for "
         "every request (default: auto — the 64-candidate MXU fast path "

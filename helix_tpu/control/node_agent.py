@@ -24,6 +24,7 @@ log = logging.getLogger("helix.node_agent")
 
 from helix_tpu.control.profile import ProfileModel, ServingProfile
 from helix_tpu.device.detect import detect_accelerators
+from helix_tpu.obs.flight import SATURATION_KEYS
 from helix_tpu.serving.registry import ModelRegistry, ServedModel
 
 
@@ -409,6 +410,18 @@ class NodeAgent:
                             )
                             self.state.progress[name] = "ready"
                 self.state.status = "running"
+                # the apply's compile wave is over: drop any step-duration
+                # samples the flight recorders banked while it ran.  Loops
+                # that kept serving through a hot-swap recorded
+                # compile-contended multi-second steps as "clean", which
+                # would inflate the watchdog's trailing p99 until the
+                # window turned over (flight.FlightRecorder.reset_baseline)
+                for served in self._live_models():
+                    flight = getattr(
+                        getattr(served, "loop", None), "flight", None
+                    )
+                    if flight is not None:
+                        flight.reset_baseline()
                 # multi-host FOLLOWERS replay the leader's journal and
                 # take no HTTP traffic: keep them out of the routable
                 # model list the router feeds on
@@ -462,10 +475,69 @@ class NodeAgent:
             self.state.progress[name] = "lazy"
 
     # ------------------------------------------------------------------
+    def _live_models(self) -> list:
+        """Already-resident ServedModels, without building or blocking.
+
+        On a ResidencyManager-backed registry, ``get()`` lazily BUILDS a
+        declared model and ``list()`` waits on the lock that is held
+        across whole builds — either would stall the heartbeat thread
+        past the router TTL (or force every lazy model resident).
+        Snapshot the resident dict lock-free instead; a racing mutation
+        raises and yields an empty list for this pass (one lean
+        heartbeat beats a stale-evicted runner)."""
+        try:
+            inner = getattr(self.registry, "inner", self.registry)
+            if hasattr(inner, "_resident"):
+                return [r.model for r in list(inner._resident.values())]
+            return self.registry.list()
+        except Exception:  # noqa: BLE001 — callers must never die
+            return []
+
+    def saturation_summary(self) -> dict:
+        """The compact per-node saturation rollup heartbeated to the
+        control plane: exactly the ``obs.flight.SATURATION_KEYS`` schema
+        (the control plane renders one ``helix_cp_runner_saturation_*``
+        gauge per key).  Aggregates every live engine on this node:
+        slots/queue sum, KV occupancy and prefix hit rate pool across
+        engines, tokens/s sums the per-engine goodput windows."""
+        slots_busy = slots_total = queue_depth = 0
+        kv_used = kv_cap = 0
+        hits = misses = 0
+        tps = 0.0
+        for m in self._live_models():
+            loop = getattr(m, "loop", None)
+            if loop is None or not hasattr(loop, "saturation"):
+                continue
+            sat = loop.saturation()
+            slots_busy += sat["slots_busy"]
+            slots_total += sat["slots_total"]
+            queue_depth += sat["queue_depth"]
+            tps += sat["tokens_per_sec"]
+            eng = loop.engine
+            kv_used += getattr(eng, "kv_pages_used", 0)
+            kv_cap += getattr(eng, "kv_pages_capacity", 0)
+            pc = getattr(eng, "prefix_cache", None)
+            if pc is not None:
+                hits += pc.hits
+                misses += pc.misses
+        out = {
+            "kv_occupancy": round(kv_used / kv_cap, 4) if kv_cap else 0.0,
+            "slots_busy": slots_busy,
+            "slots_total": slots_total,
+            "queue_depth": queue_depth,
+            "tokens_per_sec": round(tps, 2),
+            "prefix_hit_rate": (
+                round(hits / (hits + misses), 4) if hits + misses else 0.0
+            ),
+        }
+        # schema lockstep: emit exactly the shared key set
+        return {k: out[k] for k in SATURATION_KEYS}
+
     def heartbeat_payload(self) -> dict:
         """Wire format mirrors the reference heartbeat body
         (``api/cmd/sandbox-heartbeat/main.go:28-60``): id + accelerator
-        inventory + profile state."""
+        inventory + profile state + the saturation summary the control
+        plane federates into ``helix_cp_runner_saturation_*``."""
         import shutil
 
         disk = shutil.disk_usage("/")
@@ -480,6 +552,7 @@ class NodeAgent:
                 "error": self.state.error,
                 "progress": self.state.progress,
             },
+            "saturation": self.saturation_summary(),
             "disk": {"total": disk.total, "used": disk.used, "free": disk.free},
             "ts": time.time(),
         }

@@ -159,6 +159,11 @@ class RunnerState:
     accelerators: list = dataclasses.field(default_factory=list)
     last_heartbeat: float = dataclasses.field(default_factory=time.monotonic)
     meta: dict = dataclasses.field(default_factory=dict)
+    # compact saturation summary from the last heartbeat (the
+    # obs.flight.SATURATION_KEYS schema).  Living on RunnerState means it
+    # is pruned with the runner on evict_stale()/remove() — no /metrics
+    # label-cardinality leak under runner churn (same rule as breakers).
+    saturation: dict = dataclasses.field(default_factory=dict)
 
     @property
     def routable(self) -> bool:
@@ -198,6 +203,7 @@ class InferenceRouter:
         profile_status: str = "assigning",
         accelerators: Optional[list] = None,
         meta: Optional[dict] = None,
+        saturation: Optional[dict] = None,
     ) -> RunnerState:
         with self._lock:
             st = self._runners.get(runner_id)
@@ -211,6 +217,8 @@ class InferenceRouter:
             st.last_heartbeat = self.clock()
             if meta:
                 st.meta.update(meta)
+            if saturation is not None:
+                st.saturation = dict(saturation)
             return st
 
     def evict_stale(self) -> list:
@@ -363,6 +371,18 @@ class InferenceRouter:
     def inflight(self, runner_id: str) -> int:
         with self._lock:
             return self._inflight.get(runner_id, 0)
+
+    def saturation_map(self) -> dict:
+        """{runner_id: last-heartbeat saturation summary} over runners
+        that reported one.  Departed runners vanish here the moment they
+        are evicted (the summary lives on RunnerState), so the
+        ``helix_cp_runner_saturation_*`` gauges can never leak labels."""
+        with self._lock:
+            return {
+                rid: dict(st.saturation)
+                for rid, st in sorted(self._runners.items())
+                if st.saturation
+            }
 
     def breaker_states(self) -> dict:
         """{runner_id: breaker snapshot + inflight} for /metrics and

@@ -23,6 +23,7 @@ import asyncio
 import contextlib
 import json
 import logging
+import math
 import random
 import time
 import uuid
@@ -34,6 +35,7 @@ from helix_tpu import obs
 from helix_tpu.control.profile import ServingProfile, check_compatibility
 from helix_tpu.control.router import InferenceRouter
 from helix_tpu.control.store import Store
+from helix_tpu.obs.flight import SATURATION_KEYS
 from helix_tpu.obs.trace import TRACE_HEADER
 
 _dispatch_log = logging.getLogger("helix.dispatch")
@@ -1326,6 +1328,8 @@ class ControlPlane:
         r.add_get("/metrics", self.metrics)
         r.add_get("/v1/debug/traces", self.debug_traces_list)
         r.add_get("/v1/debug/traces/{trace_id}", self.debug_trace)
+        # cluster-wide saturation rollup (ISSUE 4; admin-gated under auth)
+        r.add_get("/v1/cluster/status", self.cluster_status)
         # the shared dispatch ClientSession binds to the app's event loop
         app.on_cleanup.append(self._close_dispatch_session)
         return app
@@ -1336,6 +1340,11 @@ class ControlPlane:
         1=half_open 2=open), in-flight dispatches, dispatch
         retry/failover/shed outcomes, and the dispatch-attempt latency
         histogram."""
+        # scrape-time eviction: heartbeats are the usual evict trigger,
+        # but a cluster whose *last* runner died gets no more heartbeats
+        # — prune here so stale saturation/breaker series never outlive
+        # the TTL on the scrape surface
+        self.router.evict_stale()
         return web.Response(text=self.obs.render())
 
     def _collect_cp_metrics(self, c: "obs.Collector") -> None:
@@ -1369,6 +1378,84 @@ class ControlPlane:
                 "helix_cp_runner_breaker_opens_total", snap["opens"], lbl
             )
             c.gauge("helix_cp_runner_inflight", snap["inflight"], lbl)
+        # federated runner saturation (ISSUE 4): one gauge per
+        # SATURATION_KEYS entry per runner, read from the router's
+        # per-runner state — evicting a runner prunes its series (the
+        # breaker-state cardinality rule applies here too)
+        for rid, sat in self.router.saturation_map().items():
+            lbl = {"runner": rid}
+            for key in SATURATION_KEYS:
+                if key in sat:
+                    c.gauge(
+                        "helix_cp_runner_saturation_" + key, sat[key], lbl
+                    )
+
+    async def cluster_status(self, request):
+        """Operator rollup of the whole cluster's saturation: per runner
+        the last-heartbeat saturation summary + breaker state + in-flight
+        dispatches, plus cluster totals — the JSON twin of the
+        ``helix_cp_runner_saturation_*`` gauge family, for humans and
+        future schedulers/autoscalers.  Admin-gated when auth is on."""
+        user = request.get("user")
+        if self.auth_required and not (user and user.admin):
+            return _err(403, "admin only")
+        # same scrape-time eviction as /metrics: without it a runner that
+        # died after the cluster's last heartbeat would be reported
+        # routable forever (dispatch itself is TTL-aware; this surface
+        # must agree with it)
+        self.router.evict_stale()
+        breakers = self.router.breaker_states()
+        now = self.router.clock()
+        runners = []
+        totals = {
+            "runners": 0,
+            "routable": 0,
+            "slots_busy": 0,
+            "slots_total": 0,
+            "queue_depth": 0,
+            "tokens_per_sec": 0.0,
+            "inflight": 0,
+        }
+        occ = []
+        for st in sorted(self.router.runners(), key=lambda s: s.id):
+            sat = dict(st.saturation)
+            br = breakers.get(st.id, {})
+            runners.append(
+                {
+                    "id": st.id,
+                    "models": st.models,
+                    "profile_name": st.profile_name,
+                    "profile_status": st.profile_status,
+                    "routable": st.routable,
+                    "heartbeat_age_seconds": round(
+                        max(0.0, now - st.last_heartbeat), 3
+                    ),
+                    "saturation": sat,
+                    "breaker": br.get("state", "closed"),
+                    "inflight": br.get(
+                        "inflight", self.router.inflight(st.id)
+                    ),
+                }
+            )
+            totals["runners"] += 1
+            totals["routable"] += 1 if st.routable else 0
+            totals["slots_busy"] += int(sat.get("slots_busy", 0))
+            totals["slots_total"] += int(sat.get("slots_total", 0))
+            totals["queue_depth"] += int(sat.get("queue_depth", 0))
+            totals["tokens_per_sec"] += float(sat.get("tokens_per_sec", 0.0))
+            totals["inflight"] += runners[-1]["inflight"]
+            if "kv_occupancy" in sat:
+                occ.append(float(sat["kv_occupancy"]))
+        totals["tokens_per_sec"] = round(totals["tokens_per_sec"], 2)
+        totals["kv_occupancy_mean"] = (
+            round(sum(occ) / len(occ), 4) if occ else 0.0
+        )
+        totals["slot_utilization"] = (
+            round(totals["slots_busy"] / totals["slots_total"], 4)
+            if totals["slots_total"]
+            else 0.0
+        )
+        return web.json_response({"runners": runners, "cluster": totals})
 
     async def debug_traces_list(self, request):
         user = request.get("user")
@@ -1461,6 +1548,27 @@ class ControlPlane:
             return web.json_response({"ok": True})
         body = await request.json()
         profile = body.get("profile", {})
+        # saturation summary: accept exactly the shared schema keys with
+        # FINITE numeric values (a heartbeat is runner-supplied input —
+        # unknown keys must not become unbounded /metrics series, and
+        # json.loads admits NaN/Infinity literals, which would 500
+        # /v1/cluster/status at int(nan) and corrupt the gauges).  A
+        # malformed value must never reject the whole heartbeat: that
+        # would TTL-evict an otherwise healthy runner.
+        raw_sat = body.get("saturation")
+        if not isinstance(raw_sat, dict):
+            raw_sat = {}
+        saturation = {}
+        for k in SATURATION_KEYS:
+            v = raw_sat.get(k)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            try:
+                f = float(v)   # OverflowError on e.g. int(10**400)
+            except (OverflowError, ValueError):
+                continue
+            if math.isfinite(f):
+                saturation[k] = f
         self.router.upsert_from_heartbeat(
             rid,
             models=profile.get("models", []),
@@ -1468,6 +1576,7 @@ class ControlPlane:
             profile_status=profile.get("status", "assigning"),
             accelerators=body.get("accelerators", []),
             meta={"address": body.get("address", "")},
+            saturation=saturation,
         )
         self.store.record_heartbeat(rid, body)
         self.router.evict_stale()

@@ -773,8 +773,24 @@ class Engine:
 
         self.num_prefill_tokens = 0
         self.num_decode_tokens = 0
+        # every token handed to a subscriber (decode + prefill first
+        # tokens) — the numerator of goodput tokens/s
+        self.num_generated_tokens = 0
+        # prefill-bucket padding: tokens of forward-pass work spent on
+        # zeros because prompts round up to power-of-two buckets (the
+        # padding-waste axis of the ragged-paged-attention analysis)
+        self.num_prefill_padding_tokens = 0
+        # requests admitted to a slot (flight-recorder admission deltas)
+        self.num_admitted = 0
+        # request-level prefix-cache outcomes, counted at claim time
+        # (page-level hit/miss pools live on PrefixCache itself)
+        self.prefix_cache_hits = 0
+        self.prefix_cache_misses = 0
         # ragged mixed steps taken (chunk prefill + decode in ONE call)
         self.num_mixed_steps = 0
+        # device-side decode steps (each fused window of n counts n):
+        # decode_tokens / (device_steps * batch) is exact slot utilization
+        self.num_decode_device_steps = 0
         # MoE routing assignments dropped to expert-capacity overflow
         # during prefill (those tokens silently rode the residual stream);
         # device scalars accumulate un-fetched and drain lazily so the
@@ -788,6 +804,17 @@ class Engine:
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
+
+    @property
+    def kv_pages_used(self) -> int:
+        """Occupied pages in the pool (prefix-cache-owned pages count as
+        used: they hold live KV).  Page 0 (garbage) is excluded from
+        both sides, so used/capacity is a true occupancy ratio."""
+        return self.allocator.used_pages
+
+    @property
+    def kv_pages_capacity(self) -> int:
+        return max(1, self.cache_cfg.num_pages - 1)
 
     @property
     def max_context_len(self) -> int:
@@ -1082,6 +1109,14 @@ class Engine:
         req.slot = slot
         req.admitted_time = time.monotonic()   # queue wait ends here
         req.cached_tokens = len(shared) * self.cache_cfg.page_size
+        self.num_admitted += 1
+        if self.prefix_cache is not None:
+            # request-level outcome: did THIS admission reuse any cached
+            # prefix pages?  (page-level pools are record_claim below)
+            if shared:
+                self.prefix_cache_hits += 1
+            else:
+                self.prefix_cache_misses += 1
         if use_cache and self.prefix_cache is not None:
             self.prefix_cache.record_claim(len(shared), len(hashes))
         if shared:
@@ -1196,6 +1231,7 @@ class Engine:
         ps = self.cache_cfg.page_size
         C_cap = self.cfg.max_prefill_len
         Cb = _bucket(max(rem, ps), ps, C_cap)
+        self.num_prefill_padding_tokens += Cb - rem
         tokens = np.zeros((1, Cb), np.int32)
         tokens[0, :rem] = req.prompt_tokens[start:plen]
         if start == 0:
@@ -1267,6 +1303,7 @@ class Engine:
             return 0
         K = len(batch)
         C = _bucket(max(used, ps), ps, C_cap)
+        self.num_prefill_padding_tokens += C - used
         tokens = np.zeros((1, C), np.int32)
         positions = np.zeros((1, C), np.int32)
         segments = np.zeros((1, C), np.int32)     # 0 = padding
@@ -1394,6 +1431,7 @@ class Engine:
         rem = end - start
         ps = self.cache_cfg.page_size
         Cb = _bucket(max(rem, ps), ps, C_cap)
+        self.num_prefill_padding_tokens += Cb - rem
         tokens = np.zeros((1, Cb), np.int32)
         tokens[0, :rem] = req.prompt_tokens[start:end]
         # history capacity: smallest power-of-two multiple of the chunk cap
@@ -1517,6 +1555,7 @@ class Engine:
             self.params, self.cache, *args, self._dstate
         )
         self.num_mixed_steps += 1
+        self.num_decode_device_steps += 1
         self._note_moe_drops(drops)
         self.num_prefill_tokens += rem
         st["next"] = end
@@ -1554,6 +1593,7 @@ class Engine:
         )
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :plen] = req.prompt_tokens
+        self.num_prefill_padding_tokens += bucket - plen
         length = np.int32(plen)
         # per-request PRNG stream: seeded requests reproduce exactly
         # regardless of batch-mates; the carry half becomes the slot's
@@ -1720,6 +1760,7 @@ class Engine:
         self.cache, self._dstate, next_tokens = fn(
             self.params, self.cache, self._dstate
         )
+        self.num_decode_device_steps += n
         next_np = np.asarray(next_tokens)       # [n, B] — ONE host fetch
         emitted: list[tuple[Request, int]] = []
         for s in range(n):
@@ -1743,6 +1784,7 @@ class Engine:
 
     def _emit(self, req: Request, token: int, emitted: list) -> None:
         req.output_tokens.append(token)
+        self.num_generated_tokens += 1
         emitted.append((req, token))
         stop_ids = set(req.stop_token_ids) | set(self.cfg.eos_token_ids)
         if token in stop_ids:

@@ -282,10 +282,17 @@ class PageAllocator:
         self.max_pages_per_seq = max_pages_per_seq
         self._free = list(range(num_pages - 1, 0, -1))  # page 0 reserved
         self._owned: dict[str, list[int]] = {}
+        self.peak_used = 0   # high-water mark of occupied pages (metrics)
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        """Occupied pages (incl. prefix-cache-owned); garbage page 0 is
+        outside both used and free."""
+        return self.num_pages - 1 - len(self._free)
 
     def pages_needed(self, num_tokens: int, page_size: int) -> int:
         return -(-num_tokens // page_size)
@@ -299,6 +306,8 @@ class PageAllocator:
                 f"page pool exhausted: want {n}, have {len(self._free)}"
             )
         got = [self._free.pop() for _ in range(n)]
+        if self.used_pages > self.peak_used:
+            self.peak_used = self.used_pages
         self._owned.setdefault(seq_id, []).extend(got)
         if len(self._owned[seq_id]) > self.max_pages_per_seq:
             raise MemoryError(f"sequence {seq_id} exceeds max_pages_per_seq")
@@ -364,6 +373,7 @@ class PrefixCache:
         self._tick = 0
         self.hits = 0          # pages served from cache
         self.misses = 0        # full pages prefilled fresh
+        self.evicted_pages = 0  # pages LRU-evicted under allocation pressure
 
     @staticmethod
     def page_hashes(tokens, page_size: int, max_pages: int) -> list:
@@ -457,6 +467,7 @@ class PrefixCache:
             h = self._by_page.pop(page)
             del self._entries[h]
             freed.append(page)
+        self.evicted_pages += len(freed)
         return freed
 
     @property
@@ -466,4 +477,5 @@ class PrefixCache:
             "pages": len(self._by_page),
             "hits": self.hits,
             "misses": self.misses,
+            "evicted_pages": self.evicted_pages,
         }

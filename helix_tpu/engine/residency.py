@@ -36,6 +36,25 @@ def tree_bytes(tree) -> int:
     )
 
 
+def model_param_count(model_cfg) -> int:
+    """Architectural parameter count from a config (dense-path weights:
+    for MoE this is the ACTIVE-per-token shape, which is also the right
+    numerator for decode MFU — each generated token moves ~2 FLOPs per
+    active parameter through the MXU)."""
+    c = model_cfg
+    embed = c.vocab_size * c.hidden_size
+    per_layer = (
+        c.hidden_size * c.num_heads * c.head_dim        # wq
+        + 2 * c.hidden_size * c.num_kv_heads * c.head_dim  # wk, wv
+        + c.num_heads * c.head_dim * c.hidden_size      # wo
+        + 3 * c.hidden_size * c.intermediate_size       # gate, up, down
+        + 2 * c.hidden_size                             # norms
+    )
+    return embed * (1 if c.tie_word_embeddings else 2) + (
+        c.num_layers * per_layer + c.hidden_size
+    )
+
+
 def estimate_model_bytes(
     model_cfg,
     engine_kwargs: dict,
@@ -51,17 +70,7 @@ def estimate_model_bytes(
     from helix_tpu.engine.kv_cache import CacheConfig
 
     c = model_cfg
-    embed = c.vocab_size * c.hidden_size
-    per_layer = (
-        c.hidden_size * c.num_heads * c.head_dim        # wq
-        + 2 * c.hidden_size * c.num_kv_heads * c.head_dim  # wk, wv
-        + c.num_heads * c.head_dim * c.hidden_size      # wo
-        + 3 * c.hidden_size * c.intermediate_size       # gate, up, down
-        + 2 * c.hidden_size                             # norms
-    )
-    n_params = embed * (1 if c.tie_word_embeddings else 2) + (
-        c.num_layers * per_layer + c.hidden_size
-    )
+    n_params = model_param_count(c)
     import jax.numpy as jnp
 
     itemsize = 1 if quantization == "int8" else jnp.dtype(c.dtype).itemsize
@@ -156,6 +165,7 @@ class ResidencyManager:
                 "loads": self.loads,
                 "evictions": self.evictions,
                 "used_bytes": self.used_bytes_locked(),
+                "budget_bytes": self.budget,
                 "swap_ms": dict(self.swap_ms),
                 "load_ms": dict(self.load_ms),
             }

@@ -9,6 +9,11 @@
   ``trace_event``.
 """
 
+from helix_tpu.obs.flight import (  # noqa: F401
+    SATURATION_KEYS,
+    FlightRecorder,
+    RateTracker,
+)
 from helix_tpu.obs.metrics import (  # noqa: F401
     Collector,
     Counter,

@@ -27,7 +27,7 @@ import time
 from typing import Callable, Optional
 
 from helix_tpu.engine.engine import Engine, FinishReason, Request
-from helix_tpu.obs import EngineLoopObs
+from helix_tpu.obs import EngineLoopObs, FlightRecorder, RateTracker
 from helix_tpu.obs import trace as obs_trace
 
 log = logging.getLogger("helix.engine")
@@ -88,6 +88,13 @@ class EngineLoop:
         # standalone obs families; the runner's /metrics folds them in
         # with a model label at scrape time
         self.obs = EngineLoopObs()
+        # flight recorder: bounded per-step ring + anomaly watchdog
+        # (host-side counter deltas only — nothing enters the jitted
+        # path), served at GET /v1/debug/flight
+        self.flight = FlightRecorder()
+        # goodput tokens/s over a trailing window (scraped by /metrics
+        # and the heartbeat saturation summary)
+        self._tps = RateTracker()
         self._trace = obs_trace.default_store()
         self._first_emit: dict[str, float] = {}   # req id -> first-token t
         self._last_emit: dict[str, float] = {}    # req id -> last-token t
@@ -108,6 +115,15 @@ class EngineLoop:
             self.shed_requests += 1
         return err
 
+    def queued_tokens(self) -> int:
+        """Prompt tokens awaiting admission (inbox + engine wait queue)
+        — the quantity ``max_queued_tokens`` bounds and the
+        ``helix_queued_tokens`` gauge reports.  GIL-atomic reads, safe
+        from any thread."""
+        return self._pending_tokens + sum(
+            len(r.prompt_tokens) for r in list(self.engine.waiting)
+        )
+
     def _check_admission(self, prompt_len: int) -> Optional[str]:
         if self._draining or self._stop.is_set():
             return f"{SHUTTING_DOWN}: engine '{self.name}' is draining"
@@ -125,9 +141,7 @@ class EngineLoop:
                 f"(max_queue_depth={self.max_queue_depth})"
             )
         if self.max_queued_tokens is not None:
-            queued = self._pending_tokens + sum(
-                len(r.prompt_tokens) for r in list(self.engine.waiting)
-            )
+            queued = self.queued_tokens()
             if queued + prompt_len > self.max_queued_tokens:
                 return (
                     f"{QUEUE_FULL}: {queued} tokens queued + "
@@ -186,12 +200,44 @@ class EngineLoop:
             "shed_requests": self.shed_requests,
             "prefill_tokens": eng.num_prefill_tokens,
             "decode_tokens": eng.num_decode_tokens,
+            "generated_tokens": getattr(eng, "num_generated_tokens", 0),
+            "prefill_padding_tokens": getattr(
+                eng, "num_prefill_padding_tokens", 0
+            ),
             "mixed_steps": getattr(eng, "num_mixed_steps", 0),
             "moe_dropped_tokens": getattr(eng, "moe_dropped_tokens", 0),
             "waiting": len(eng.waiting),
             "active_slots": sum(1 for s in eng.slots if s is not None),
             "free_pages": eng.allocator.free_pages,
+            "kv_pages_used": getattr(eng, "kv_pages_used", 0),
+            "kv_pages_peak": getattr(eng.allocator, "peak_used", 0),
+            "flight_anomalies": self.flight.anomalies_total,
             "kv_cache_dtype": eng.cache_cfg.dtype,
+        }
+
+    def tokens_per_sec(self) -> float:
+        """Goodput: generated tokens/s over the trailing rate window."""
+        return self._tps.rate(getattr(self.engine, "num_generated_tokens", 0))
+
+    def saturation(self) -> dict:
+        """The compact saturation summary (``obs.flight.SATURATION_KEYS``
+        schema) this engine contributes to the node heartbeat and the
+        runner's capacity gauges.  Plain GIL-atomic reads, safe from any
+        thread."""
+        eng = self.engine
+        used = getattr(eng, "kv_pages_used", 0)
+        cap = getattr(eng, "kv_pages_capacity", 1)
+        pc = getattr(eng, "prefix_cache", None)
+        hits = getattr(pc, "hits", 0) if pc is not None else 0
+        misses = getattr(pc, "misses", 0) if pc is not None else 0
+        denom = hits + misses
+        return {
+            "kv_occupancy": round(used / cap, 4),
+            "slots_busy": sum(1 for s in eng.slots if s is not None),
+            "slots_total": len(eng.slots),
+            "queue_depth": self._pending + len(eng.waiting),
+            "tokens_per_sec": round(self.tokens_per_sec(), 2),
+            "prefix_hit_rate": round(hits / denom, 4) if denom else 0.0,
         }
 
     def start(self):
@@ -330,6 +376,66 @@ class EngineLoop:
             inj.maybe_fail_step(self.name, self.steps, ids)
         return self.engine.step()
 
+    # -- flight recorder (host-side counter deltas only) --------------------
+
+    def _flight_pre(self) -> tuple:
+        """Counter snapshot taken just before a step so the per-step
+        record carries deltas, not lifetime totals."""
+        eng = self.engine
+        return (
+            eng.num_prefill_tokens,
+            getattr(eng, "num_prefill_padding_tokens", 0),
+            eng.num_decode_tokens,
+            getattr(eng, "num_admitted", 0),
+            self.quarantine_evictions,
+        )
+
+    def _flight_record(
+        self, duration: float, pre: tuple, generated: int,
+        failed: Optional[str] = None,
+    ) -> None:
+        eng = self.engine
+        p0, pad0, d0, a0, q0 = pre
+        prefill = eng.num_prefill_tokens - p0
+        decode = eng.num_decode_tokens - d0
+        if failed is not None:
+            kind = "failed"
+        elif prefill and decode:
+            kind = "mixed"
+        elif prefill:
+            kind = "prefill"
+        elif decode:
+            kind = "decode"
+        else:
+            kind = "idle"
+        rec = {
+            "step": self.steps,
+            "ts": time.time(),
+            "duration": duration,
+            "kind": kind,
+            "slots_busy": sum(1 for s in eng.slots if s is not None),
+            "slots_total": len(eng.slots),
+            "queue_depth": self._pending + len(eng.waiting),
+            "kv_pages_used": getattr(eng, "kv_pages_used", 0),
+            "kv_pages_free": eng.allocator.free_pages,
+            "prefill_tokens": prefill,
+            "padding_tokens": (
+                getattr(eng, "num_prefill_padding_tokens", 0) - pad0
+            ),
+            "decode_tokens": decode,
+            "generated_tokens": generated,
+            "admissions": getattr(eng, "num_admitted", 0) - a0,
+            "evictions": self.quarantine_evictions - q0,
+        }
+        if failed is not None:
+            rec["anomaly"] = "step_failure"
+            rec["error"] = failed[:200]
+        self.flight.record_step(rec)
+        # bank a goodput sample while the engine works (throttled inside
+        # the tracker): keeps the rate anchor within ~one window of now,
+        # so sparse external scrapes can't understate a recent burst
+        self._tps.rate(getattr(eng, "num_generated_tokens", 0))
+
     def _run(self):
         while not self._stop.is_set():
             self._drain_inbox()
@@ -356,10 +462,15 @@ class EngineLoop:
                 self._wake.clear()
                 continue
             t_step = time.monotonic()
+            flight_pre = self._flight_pre()
             try:
                 emitted = self._step_once()
             except Exception as e:  # noqa: BLE001 — fail requests, not the loop
-                self.obs.step_seconds.observe(time.monotonic() - t_step)
+                dt_step = time.monotonic() - t_step
+                self.obs.step_seconds.observe(dt_step)
+                self._flight_record(
+                    dt_step, flight_pre, generated=0, failed=str(e)
+                )
                 self.step_failures += 1
                 self._consec_failures += 1
                 scheduled = [
@@ -382,11 +493,13 @@ class EngineLoop:
                 self._quarantine(e)
                 self._consec_failures = 0
                 continue
-            self.obs.step_seconds.observe(time.monotonic() - t_step)
+            dt_step = time.monotonic() - t_step
+            self.obs.step_seconds.observe(dt_step)
             self._consec_failures = 0
             self._barren_rounds = 0
             self.steps += 1
             self._emit(emitted)
+            self._flight_record(dt_step, flight_pre, generated=len(emitted))
         # terminal sweep: anything still in the inbox (raced a shutdown)
         # gets a clean error event instead of a 300s client hang
         while True:
@@ -420,9 +533,13 @@ class EngineLoop:
     def _evict(self, req, msg: str) -> None:
         self.engine.abort(req.id)
         self.quarantine_evictions += 1
+        self.flight.note_anomaly(
+            "quarantine", request_id=req.id, detail=msg[:200]
+        )
         log.warning(
             "engine '%s' evicting request_id=%s trace_id=%s: %s",
             self.name, req.id, req.trace_id or "-", msg,
+            extra={"trace_id": req.trace_id or "", "request_id": req.id},
         )
         if req.trace_id:
             now = time.monotonic()
@@ -549,9 +666,13 @@ class EngineLoop:
                     f"request quarantined: engine step failed while "
                     f"scheduled ({err})"
                 )
+                self.flight.note_anomaly(
+                    "quarantine", request_id=r.id, detail=msg[:200]
+                )
                 log.warning(
                     "engine '%s' quarantined request_id=%s trace_id=%s: %s",
                     self.name, r.id, r.trace_id or "-", msg,
+                    extra={"trace_id": r.trace_id or "", "request_id": r.id},
                 )
                 if r.trace_id:
                     now = time.monotonic()

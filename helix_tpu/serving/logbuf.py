@@ -3,7 +3,10 @@ admin tailer — ``api/pkg/hydra/logbuf.go``, ``server/admin_runner_logs.go``).
 
 A ``logging.Handler`` that keeps the last N records in memory; the node's
 HTTP surface exposes the tail and the control plane proxies it to the
-admin UI (by address or through the reverse tunnel)."""
+admin UI (by address or through the reverse tunnel).  Records carry the
+``trace_id`` / ``request_id`` attached to the log record (via
+``extra={...}``) when present, so the admin log tail correlates directly
+with ``/v1/debug/traces``."""
 
 from __future__ import annotations
 
@@ -17,7 +20,7 @@ class RingLogBuffer(logging.Handler):
     def __init__(self, capacity: int = 2000):
         super().__init__()
         self.records: collections.deque = collections.deque(maxlen=capacity)
-        self._lock2 = threading.Lock()
+        self._lock = threading.Lock()
         self.setFormatter(
             logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
         )
@@ -27,18 +30,28 @@ class RingLogBuffer(logging.Handler):
             line = self.format(record)
         except Exception:  # noqa: BLE001 — formatting must never raise
             line = record.getMessage()
-        with self._lock2:
-            self.records.append((time.time(), line))
+        tid = str(getattr(record, "trace_id", "") or "")
+        rid = str(getattr(record, "request_id", "") or "")
+        with self._lock:
+            self.records.append((time.time(), line, tid, rid))
 
     def push(self, line: str) -> None:
         """Non-logging writes (engine step notes, apply progress)."""
-        with self._lock2:
-            self.records.append((time.time(), line))
+        with self._lock:
+            self.records.append((time.time(), line, "", ""))
 
     def tail(self, n: int = 200) -> list:
-        with self._lock2:
+        with self._lock:
             items = list(self.records)[-n:]
-        return [{"ts": ts, "line": line} for ts, line in items]
+        out = []
+        for ts, line, tid, rid in items:
+            d = {"ts": ts, "line": line}
+            if tid:
+                d["trace_id"] = tid
+            if rid:
+                d["request_id"] = rid
+            out.append(d)
+        return out
 
 
 _global: RingLogBuffer | None = None

@@ -152,6 +152,9 @@ class OpenAIServer:
         app.router.add_get(
             "/v1/debug/traces/{trace_id}", self.debug_trace
         )
+        # engine flight recorder: per-step saturation ring + frozen
+        # anomaly snapshots (ISSUE 4)
+        app.router.add_get("/v1/debug/flight", self.debug_flight)
         app.router.add_post("/admin/profiler", self.profiler_capture)
         # multi-host lockstep journal (followers long-poll over DCN;
         # see serving/multihost_serving.py)
@@ -262,6 +265,9 @@ class OpenAIServer:
             loop_obs = getattr(m.loop, "obs", None)
             if loop_obs is not None:
                 loop_obs.collect(c, lbl)
+            # saturation / capacity-efficiency gauges (ISSUE 4): how full
+            # the machine is and where the capacity goes
+            self._collect_saturation(c, m, eng, lbl)
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 st = pc.stats
@@ -271,6 +277,19 @@ class OpenAIServer:
                 )
                 c.counter(
                     "helix_prefix_cache_miss_pages_total", st["misses"], lbl
+                )
+                # request-level hit/miss + eviction pressure (ISSUE 4)
+                c.counter(
+                    "helix_prefix_cache_hits_total",
+                    getattr(eng, "prefix_cache_hits", 0), lbl,
+                )
+                c.counter(
+                    "helix_prefix_cache_misses_total",
+                    getattr(eng, "prefix_cache_misses", 0), lbl,
+                )
+                c.counter(
+                    "helix_prefix_cache_evicted_pages_total",
+                    st.get("evicted_pages", 0), lbl,
                 )
             ttfts = getattr(eng, "recent_ttfts", None)
             if ttfts:
@@ -297,10 +316,89 @@ class OpenAIServer:
             c.counter("helix_residency_loads_total", st["loads"])
             c.counter("helix_residency_evictions_total", st["evictions"])
             c.gauge("helix_residency_used_bytes", st["used_bytes"])
+            c.gauge(
+                "helix_residency_budget_bytes", st.get("budget_bytes", 0)
+            )
             for name, ms in sorted(st["swap_ms"].items()):
                 c.gauge("helix_model_swap_ms", ms, {"model": name})
             for name, ms in sorted(st["load_ms"].items()):
                 c.gauge("helix_model_load_ms", ms, {"model": name})
+
+    def _collect_saturation(self, c, m, eng, lbl: dict) -> None:
+        """Per-model capacity gauges: KV occupancy + high-water mark,
+        decode-slot utilization, queue depth/queued tokens, goodput
+        tokens/s, padding waste, and an MFU estimate when a peak-FLOPs
+        figure is known.  All values are GIL-atomic host reads."""
+        sat = m.loop.saturation()
+        used = getattr(eng, "kv_pages_used", 0)
+        cap = getattr(eng, "kv_pages_capacity", 1)
+        c.gauge("helix_kv_pages_used", used, lbl)
+        c.gauge("helix_kv_pages_capacity", cap, lbl)
+        c.gauge(
+            "helix_kv_pages_used_peak",
+            getattr(eng.allocator, "peak_used", 0), lbl,
+        )
+        c.gauge("helix_kv_occupancy_ratio", sat["kv_occupancy"], lbl)
+        c.gauge("helix_decode_slots_busy", sat["slots_busy"], lbl)
+        c.gauge("helix_decode_slots_capacity", sat["slots_total"], lbl)
+        c.gauge(
+            "helix_decode_slot_utilization",
+            sat["slots_busy"] / max(1, sat["slots_total"]), lbl,
+        )
+        c.gauge("helix_queue_depth", sat["queue_depth"], lbl)
+        c.gauge("helix_queued_tokens", m.loop.queued_tokens(), lbl)
+        c.counter(
+            "helix_generated_tokens_total",
+            getattr(eng, "num_generated_tokens", 0), lbl,
+        )
+        c.counter(
+            "helix_prefill_padding_tokens_total",
+            getattr(eng, "num_prefill_padding_tokens", 0), lbl,
+        )
+        c.gauge(
+            "helix_goodput_tokens_per_second", sat["tokens_per_sec"], lbl
+        )
+        c.gauge(
+            "helix_prefix_cache_hit_ratio", sat["prefix_hit_rate"], lbl
+        )
+        c.counter(
+            "helix_flight_anomalies_total",
+            m.loop.flight.anomalies_total, lbl,
+        )
+        peak = self._peak_flops()
+        if peak > 0:
+            from helix_tpu.engine.residency import model_param_count
+
+            # decode-side MFU estimate: each generated token moves ~2
+            # FLOPs per active parameter through the MXU
+            c.gauge(
+                "helix_mfu_estimate",
+                sat["tokens_per_sec"] * 2 * model_param_count(eng.model_cfg)
+                / peak,
+                lbl,
+            )
+
+    @staticmethod
+    def _peak_flops() -> float:
+        """Peak accelerator FLOP/s for the MFU denominator:
+        ``HELIX_PEAK_FLOPS`` when the operator sets it, else the v5e
+        bf16 peak on TPU backends, else 0 (gauge omitted)."""
+        import os
+
+        v = os.environ.get("HELIX_PEAK_FLOPS", "")
+        if v:
+            try:
+                return float(v)
+            except ValueError:
+                return 0.0
+        try:
+            import jax
+
+            if jax.default_backend() in ("tpu", "axon"):
+                return 197e12   # v5e bf16 peak; override for other gens
+        except Exception:  # noqa: BLE001 — metrics must never raise
+            pass
+        return 0.0
 
     # -- tracing + profiling ---------------------------------------------
     @staticmethod
@@ -337,6 +435,44 @@ class OpenAIServer:
         if doc is None:
             return _error(404, f"unknown trace {tid!r}")
         return web.json_response(doc)
+
+    async def debug_flight(self, request):
+        """Engine flight recorder: the per-step saturation ring (batch
+        composition, KV occupancy, padding waste, step wall time) plus
+        the frozen snapshots of the last N anomalies (slow step,
+        zero-progress step, step failure, quarantine).  Runner-token
+        gated like the other debug surfaces; ``?model=`` filters to one
+        engine, ``?recent=`` bounds the live-ring tail returned."""
+        denied = self._require_runner_token(request)
+        if denied is not None:
+            return denied
+        want = request.query.get("model", "")
+        try:
+            recent = max(1, min(int(request.query.get("recent", 64)), 512))
+        except ValueError:
+            return _error(400, "recent must be an integer")
+        def collect():
+            # off the event loop: registry.list() on a residency-backed
+            # runner blocks on the build-holding ResidencyManager lock
+            # (same rule as the /metrics render above)
+            snap = {}
+            for m in self.registry.list():
+                if m.loop is None or (want and m.name != want):
+                    continue
+                fl = getattr(m.loop, "flight", None)
+                if fl is None:
+                    continue
+                snap[m.name] = fl.snapshot(recent=recent)
+            return snap
+
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, collect
+        )
+        if want and not out:
+            return _error(
+                404, f"model {want!r} has no engine flight recorder"
+            )
+        return web.json_response({"models": out})
 
     async def profiler_capture(self, request):
         """On-demand ``jax.profiler`` capture against the live runner:

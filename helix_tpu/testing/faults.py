@@ -19,6 +19,7 @@ Rule shapes (dicts, JSON-friendly for the env var)::
 
     {"point": "engine_step", "engine": "*", "on_step": 7, "times": 1}
     {"point": "engine_step", "request_id_contains": "poison"}
+    {"point": "engine_step", "mode": "slow", "delay": 0.5, "times": 1}
     {"point": "dispatch", "runner": "r1", "mode": "connect_error", "p": 0.3}
     {"point": "dispatch", "runner": "*", "mode": "http_500", "times": 4}
     {"point": "dispatch", "runner": "r2", "mode": "slow_first_byte",
@@ -86,12 +87,19 @@ class FaultInjector:
     def maybe_fail_step(
         self, engine_name: str, step_no: int, request_ids: list
     ) -> None:
-        """Raise FaultInjected if an engine_step rule matches this step.
+        """Raise FaultInjected if an engine_step rule matches this step;
+        ``mode: "slow"`` rules sleep ``delay`` seconds instead of raising
+        (models a straggling device call — the flight recorder's
+        slow-step watchdog fodder).
 
         ``request_ids`` are the requests the step would touch (slots +
         waiting), so a ``request_id_contains`` rule models a poisoned
         request: the step fails every time that request is scheduled and
         recovers the moment it is evicted."""
+        import time as _time
+
+        slow = 0.0
+        raise_msg = None
         with self._lock:
             for idx, rule in enumerate(self.rules):
                 if rule.get("point") != "engine_step":
@@ -109,10 +117,21 @@ class FaultInjector:
                     continue
                 if not self._try_fire(idx, rule):
                     continue
-                raise FaultInjected(
+                if rule.get("mode") == "slow":
+                    slow += float(rule.get("delay", 0.1))
+                    continue
+                raise_msg = (
                     f"injected engine-step fault (engine={engine_name}, "
                     f"step={step_no}, rule={idx})"
                 )
+                break
+        if slow > 0:
+            # outside the lock: other hooks keep firing.  The sleep runs
+            # even when a raising rule fired the same pass — a slow rule
+            # that consumed its `times` budget must still slow the step.
+            _time.sleep(slow)
+        if raise_msg is not None:
+            raise FaultInjected(raise_msg)
 
     def dispatch_fault(self, runner_id: str) -> Optional[dict]:
         """Return the fault to apply to this dispatch attempt, or None.

@@ -1,0 +1,498 @@
+"""Saturation observability (ISSUE 4): engine flight recorder, capacity
+gauges, and cluster-wide saturation federation.
+
+- Runner ``/metrics`` exposes KV occupancy, decode-slot utilization,
+  queue depth, goodput and prefix hit-rate series per model.
+- An injected slow step (``testing/faults.py`` ``mode: "slow"``) trips
+  the flight-recorder watchdog; the frozen snapshot (with the per-step
+  batch composition preceding the anomaly) is served at
+  ``GET /v1/debug/flight``.
+- A heartbeat carrying the ``SATURATION_KEYS`` summary federates into
+  ``helix_cp_runner_saturation_*`` gauges on the control plane and the
+  ``/v1/cluster/status`` rollup; evicting the runner prunes the gauges
+  (no label-cardinality leak).
+- Prefix-cache request-level hit/miss + evicted-page counters.
+"""
+
+import asyncio
+import threading
+from types import SimpleNamespace
+
+import pytest
+import requests
+
+from helix_tpu.control.server import ControlPlane
+from helix_tpu.obs.flight import SATURATION_KEYS, FlightRecorder, RateTracker
+from helix_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+def _serve_app(app, holder):
+    started = threading.Event()
+    box = {}
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        box["port"] = site._server.sockets[0].getsockname()[1]
+        holder.setdefault("loops", []).append(loop)
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    return box["port"]
+
+
+def _tiny_engine(tok, page_size=4, num_pages=64, batch=4):
+    import jax
+
+    from helix_tpu.engine.engine import Engine, EngineConfig
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    return Engine(
+        cfg, params,
+        EngineConfig(
+            max_decode_batch=batch, page_size=page_size,
+            num_pages=num_pages, max_pages_per_seq=16, max_prefill_len=64,
+            attn_backend="reference", eos_token_ids=tok.eos_ids,
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def spine():
+    """Runner (tiny engine as 'm1') + control plane, like the ISSUE-3
+    observability spine."""
+    from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.openai_api import OpenAIServer
+    from helix_tpu.serving.registry import ModelRegistry, ServedModel
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    engine = _tiny_engine(tok)
+    loop = EngineLoop(engine, name="m1").start()
+    registry = ModelRegistry()
+    registry.register(
+        ServedModel(name="m1", loop=loop, tokenizer=tok, context_length=128)
+    )
+    api = OpenAIServer(registry)
+    holder: dict = {}
+    runner_port = _serve_app(api.build_app(), holder)
+    cp = ControlPlane()
+    cp_port = _serve_app(cp.build_app(), holder)
+    yield SimpleNamespace(
+        cp=cp,
+        cp_url=f"http://127.0.0.1:{cp_port}",
+        runner_url=f"http://127.0.0.1:{runner_port}",
+        api=api,
+        registry=registry,
+        loop=loop,
+    )
+    cp.stop()
+    loop.stop(join=False)
+    for lp in holder.get("loops", []):
+        lp.call_soon_threadsafe(lp.stop)
+
+
+def _chat(url, text="saturate me", max_tokens=6, timeout=30):
+    return requests.post(
+        f"{url}/v1/chat/completions",
+        json={
+            "model": "m1", "max_tokens": max_tokens, "temperature": 0,
+            "messages": [{"role": "user", "content": text}],
+        },
+        timeout=timeout,
+    )
+
+
+# ---------------------------------------------------------------------------
+# flight recorder + rate tracker units
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorderUnit:
+    def test_slow_step_watchdog_freezes_snapshot(self):
+        fl = FlightRecorder(min_samples=4, min_step_seconds=0.0,
+                            slow_factor=3.0, freeze_steps=8)
+        for i in range(10):
+            fl.record_step({"step": i, "duration": 0.01, "slots_busy": 1,
+                            "generated_tokens": 1, "prefill_tokens": 0})
+        assert fl.anomalies_total == 0
+        reason = fl.record_step(
+            {"step": 10, "duration": 1.0, "slots_busy": 1,
+             "generated_tokens": 1, "prefill_tokens": 0}
+        )
+        assert reason == "slow_step"
+        snap = fl.snapshot()
+        assert snap["anomalies_total"] == 1
+        a = snap["anomalies"][0]
+        assert a["reason"] == "slow_step"
+        # the frozen tail holds the batch composition of the steps
+        # PRECEDING the anomaly
+        assert len(a["steps"]) == 8
+        assert a["steps"][-1]["step"] == 10
+        assert a["steps"][0]["step"] == 3
+        # the frozen copy is immutable against later ring churn
+        for i in range(600):
+            fl.record_step({"step": 100 + i, "duration": 0.01,
+                            "slots_busy": 1, "generated_tokens": 1,
+                            "prefill_tokens": 0})
+        assert fl.snapshot()["anomalies"][0]["steps"][-1]["step"] == 10
+
+    def test_zero_progress_and_min_samples_gate(self):
+        fl = FlightRecorder(min_samples=64, min_step_seconds=0.0)
+        # a slow first step does NOT trip before min_samples are banked
+        assert fl.record_step(
+            {"step": 0, "duration": 5.0, "slots_busy": 1,
+             "generated_tokens": 1, "prefill_tokens": 0}
+        ) is None
+        # busy slots with zero progress is always anomalous
+        assert fl.record_step(
+            {"step": 1, "duration": 0.01, "slots_busy": 2,
+             "generated_tokens": 0, "prefill_tokens": 0}
+        ) == "zero_progress"
+        # idle steps (no busy slots) are not
+        assert fl.record_step(
+            {"step": 2, "duration": 0.01, "slots_busy": 0,
+             "generated_tokens": 0, "prefill_tokens": 0}
+        ) is None
+
+    def test_rate_tracker_windowed(self):
+        rt = RateTracker(window_seconds=10.0)
+        assert rt.rate(0, now=0.0) == 0.0
+        assert rt.rate(50, now=5.0) == pytest.approx(10.0)
+        assert rt.rate(100, now=10.0) == pytest.approx(10.0)
+        # a counter that stops advancing decays to zero over the window
+        assert rt.rate(100, now=100.0) == 0.0
+
+    def test_burst_after_idle_reads_trailing_window(self):
+        # engine-loop per-step feeding keeps the anchor within the
+        # window, so a burst after a long idle is not averaged over the
+        # whole idle stretch by a sparse external scrape
+        rt = RateTracker(window_seconds=10.0, min_sample_interval=1.0)
+        rt.rate(0, now=0.0)
+        for t in range(290, 300):        # burst: 100 tokens per second
+            rt.rate((t - 289) * 100, now=float(t))
+        assert rt.rate(1100, now=300.0) == pytest.approx(100.0)
+        # sub-interval calls don't grow the sample deque
+        for _ in range(100):
+            rt.rate(1100, now=300.5)
+        assert len(rt._samples) < 20
+
+
+# ---------------------------------------------------------------------------
+# runner: capacity gauges + flight endpoint
+# ---------------------------------------------------------------------------
+
+class TestRunnerSaturation:
+    def test_metrics_expose_saturation_series(self, spine):
+        assert _chat(spine.runner_url).status_code == 200
+        text = requests.get(f"{spine.runner_url}/metrics", timeout=10).text
+        for series in (
+            "helix_kv_pages_used{", "helix_kv_pages_capacity{",
+            "helix_kv_pages_used_peak{", "helix_kv_occupancy_ratio{",
+            "helix_decode_slots_busy{", "helix_decode_slots_capacity{",
+            "helix_decode_slot_utilization{", "helix_queue_depth{",
+            "helix_queued_tokens{", "helix_generated_tokens_total{",
+            "helix_prefill_padding_tokens_total{",
+            "helix_goodput_tokens_per_second{",
+            "helix_prefix_cache_hit_ratio{",
+            "helix_flight_anomalies_total{",
+            "helix_prefix_cache_hits_total{",
+            "helix_prefix_cache_misses_total{",
+            "helix_prefix_cache_evicted_pages_total{",
+        ):
+            assert series in text, f"missing series: {series}"
+            assert f'{series}model="m1"' in text
+        # a completed request leaves a real peak behind
+        eng = spine.loop.engine
+        assert eng.allocator.peak_used >= 1
+        assert eng.num_generated_tokens >= 1
+
+    def test_mfu_gauge_when_peak_flops_known(self, spine, monkeypatch):
+        monkeypatch.setenv("HELIX_PEAK_FLOPS", "1e12")
+        assert _chat(spine.runner_url).status_code == 200
+        text = requests.get(f"{spine.runner_url}/metrics", timeout=10).text
+        assert 'helix_mfu_estimate{model="m1"}' in text
+
+    def test_saturation_summary_schema(self, spine):
+        sat = spine.loop.saturation()
+        assert set(sat) == set(SATURATION_KEYS)
+        assert sat["slots_total"] == 4
+        assert 0.0 <= sat["kv_occupancy"] <= 1.0
+
+    def test_slow_step_fault_freezes_and_serves_snapshot(self, spine):
+        """The acceptance path: inject a slow step, the watchdog freezes
+        a snapshot with the preceding batch composition, and it is
+        retrievable at /v1/debug/flight."""
+        fl = spine.loop.flight
+        # tiny-engine steps are milliseconds; make the gate reachable
+        # without waiting for 32 banked samples, and drop the
+        # compile-laden durations earlier tests banked
+        fl.min_samples = 4
+        fl.min_step_seconds = 0.05
+        fl.slow_factor = 3.0
+        fl.reset_baseline()
+        for _ in range(2):   # bank clean baseline steps
+            assert _chat(spine.runner_url).status_code == 200
+        before = fl.anomalies_total
+        faults.arm(
+            seed=1,
+            rules=[{"point": "engine_step", "mode": "slow",
+                    "delay": 1.5, "times": 1}],
+        )
+        assert _chat(spine.runner_url).status_code == 200
+        faults.disarm()
+        assert fl.anomalies_total > before
+        doc = requests.get(
+            f"{spine.runner_url}/v1/debug/flight?model=m1", timeout=10
+        ).json()
+        m1 = doc["models"]["m1"]
+        assert m1["anomalies_total"] > 0
+        slow = [a for a in m1["anomalies"] if a["reason"] == "slow_step"]
+        assert slow, m1["anomalies"]
+        frozen = slow[-1]
+        assert frozen["record"]["duration"] >= 1.5
+        # per-step batch composition for the steps preceding the anomaly
+        assert frozen["steps"]
+        for rec in frozen["steps"]:
+            for field in ("slots_busy", "kv_pages_used", "queue_depth",
+                          "prefill_tokens", "decode_tokens", "duration"):
+                assert field in rec
+        # the live ring keeps flowing
+        assert m1["recent"]
+        assert m1["steps_recorded"] > 0
+
+    def test_flight_endpoint_unknown_model_404(self, spine):
+        r = requests.get(
+            f"{spine.runner_url}/v1/debug/flight?model=nope", timeout=10
+        )
+        assert r.status_code == 404
+
+    def test_flight_endpoint_runner_token_gated(self, spine, monkeypatch):
+        monkeypatch.setenv("HELIX_RUNNER_TOKEN", "sekrit")
+        r = requests.get(f"{spine.runner_url}/v1/debug/flight", timeout=10)
+        assert r.status_code == 403
+        r = requests.get(
+            f"{spine.runner_url}/v1/debug/flight",
+            headers={"X-Runner-Token": "sekrit"}, timeout=10,
+        )
+        assert r.status_code == 200
+
+
+# ---------------------------------------------------------------------------
+# prefix cache counters (satellite)
+# ---------------------------------------------------------------------------
+
+class TestPrefixCacheCounters:
+    def test_request_level_hits_misses_and_evictions(self):
+        from helix_tpu.engine.sampling import SamplingParams
+        from helix_tpu.serving.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        eng = _tiny_engine(tok)
+        h0, m0 = eng.prefix_cache_hits, eng.prefix_cache_misses
+        prompt = list(range(1, 10))   # 9 tokens -> 2 cacheable full pages
+        sampling = SamplingParams(temperature=0.0, max_tokens=3)
+        eng.generate([prompt], sampling)
+        assert eng.prefix_cache_misses == m0 + 1
+        assert eng.prefix_cache_hits == h0
+        eng.generate([list(prompt)], sampling)   # same prefix: a hit
+        assert eng.prefix_cache_hits == h0 + 1
+        pc = eng.prefix_cache
+        assert pc.stats["hits"] >= 2           # page-level pool
+        assert pc.stats["evicted_pages"] == 0
+        freed = pc.evict(len(pc._by_page))
+        assert freed
+        assert pc.stats["evicted_pages"] == len(freed)
+        assert pc.evicted_pages == len(freed)
+
+
+# ---------------------------------------------------------------------------
+# cluster federation: heartbeat -> cp gauges + /v1/cluster/status -> prune
+# ---------------------------------------------------------------------------
+
+class TestClusterFederation:
+    def _heartbeat(self, spine, rid="satr1", **overrides):
+        sat = {
+            "kv_occupancy": 0.25, "slots_busy": 2, "slots_total": 8,
+            "queue_depth": 1, "tokens_per_sec": 123.5,
+            "prefix_hit_rate": 0.5,
+        }
+        sat.update(overrides)
+        r = requests.post(
+            f"{spine.cp_url}/api/v1/runners/{rid}/heartbeat",
+            json={
+                "runner_id": rid,
+                "address": "http://127.0.0.1:1",
+                "accelerators": [],
+                "profile": {"name": "p", "status": "running",
+                            "models": ["m1"]},
+                "saturation": {**sat, "bogus_key": 9, "evil": "x"},
+            },
+            timeout=10,
+        )
+        assert r.status_code == 200, r.text
+        return sat
+
+    def test_heartbeat_federates_saturation_gauges(self, spine):
+        self._heartbeat(spine)
+        text = requests.get(f"{spine.cp_url}/metrics", timeout=10).text
+        assert (
+            'helix_cp_runner_saturation_kv_occupancy{runner="satr1"} 0.25'
+            in text
+        )
+        for key in SATURATION_KEYS:
+            assert (
+                f'helix_cp_runner_saturation_{key}{{runner="satr1"}}'
+                in text
+            ), f"missing cp saturation gauge for {key}"
+        # runner-supplied unknown keys never become series
+        assert "bogus_key" not in text
+        assert "helix_cp_runner_saturation_evil" not in text
+
+    def test_heartbeat_rejects_non_finite_values(self, spine):
+        # stdlib json emits/parses NaN-Infinity literals (requests
+        # refuses, so post the raw body): a buggy runner must not be
+        # able to 500 /v1/cluster/status or corrupt gauges
+        import json as _json
+
+        body = {
+            "runner_id": "nanr", "address": "http://127.0.0.1:1",
+            "accelerators": [],
+            "profile": {"name": "p", "status": "running",
+                        "models": ["m1"]},
+            "saturation": {
+                "kv_occupancy": 0.25, "slots_busy": float("nan"),
+                "slots_total": 8, "queue_depth": 1,
+                "tokens_per_sec": float("inf"), "prefix_hit_rate": 0.5,
+            },
+        }
+        r = requests.post(
+            f"{spine.cp_url}/api/v1/runners/nanr/heartbeat",
+            data=_json.dumps(body),
+            headers={"Content-Type": "application/json"},
+            timeout=10,
+        )
+        assert r.status_code == 200, r.text
+        text = requests.get(f"{spine.cp_url}/metrics", timeout=10).text
+        assert 'helix_cp_runner_saturation_slots_busy{runner="nanr"}' \
+            not in text
+        assert 'helix_cp_runner_saturation_tokens_per_sec{runner="nanr"}' \
+            not in text
+        # the finite keys still federate; the rollup endpoint stays 200
+        assert 'helix_cp_runner_saturation_kv_occupancy{runner="nanr"}' \
+            in text
+        r = requests.get(f"{spine.cp_url}/v1/cluster/status", timeout=10)
+        assert r.status_code == 200, r.text
+        # a non-dict saturation value or a float()-overflowing int must
+        # not reject the heartbeat either (that would TTL-evict the node)
+        for bad in ([1, 2], {"queue_depth": 10 ** 400}):
+            body["saturation"] = bad
+            r = requests.post(
+                f"{spine.cp_url}/api/v1/runners/nanr/heartbeat",
+                data=_json.dumps(body),
+                headers={"Content-Type": "application/json"},
+                timeout=10,
+            )
+            assert r.status_code == 200, r.text
+
+    def test_cluster_status_rollup(self, spine):
+        self._heartbeat(spine, rid="satr1")
+        self._heartbeat(spine, rid="satr2", slots_busy=4, queue_depth=3,
+                        tokens_per_sec=100.0)
+        doc = requests.get(
+            f"{spine.cp_url}/v1/cluster/status", timeout=10
+        ).json()
+        byid = {r["id"]: r for r in doc["runners"]}
+        assert {"satr1", "satr2"} <= set(byid)
+        r1 = byid["satr1"]
+        assert r1["saturation"]["kv_occupancy"] == 0.25
+        assert r1["breaker"] in ("closed", "half_open", "open")
+        assert "inflight" in r1 and "heartbeat_age_seconds" in r1
+        cl = doc["cluster"]
+        assert cl["runners"] >= 2
+        assert cl["slots_busy"] >= 6
+        assert cl["slots_total"] >= 16
+        assert cl["queue_depth"] >= 4
+        assert cl["tokens_per_sec"] >= 223.5
+        assert 0.0 <= cl["kv_occupancy_mean"] <= 1.0
+        assert 0.0 <= cl["slot_utilization"] <= 1.0
+
+    def test_eviction_prunes_saturation_gauges(self, spine):
+        self._heartbeat(spine, rid="ghost")
+        text = requests.get(f"{spine.cp_url}/metrics", timeout=10).text
+        assert 'runner="ghost"' in text
+        st = spine.cp.router.get("ghost")
+        st.last_heartbeat -= 10_000
+        dead = spine.cp.router.evict_stale()
+        assert "ghost" in dead
+        text = requests.get(f"{spine.cp_url}/metrics", timeout=10).text
+        assert 'helix_cp_runner_saturation_kv_occupancy{runner="ghost"}' \
+            not in text
+        # no cardinality leak: ghost is gone from every saturation series
+        assert "ghost" not in requests.get(
+            f"{spine.cp_url}/v1/cluster/status", timeout=10
+        ).text
+
+    def test_scrape_evicts_stale_runner(self, spine):
+        # a cluster whose LAST runner dies gets no more heartbeats (the
+        # usual evict trigger): the scrape surfaces themselves must prune
+        self._heartbeat(spine, rid="lonely")
+        spine.cp.router.get("lonely").last_heartbeat -= 10_000
+        text = requests.get(f"{spine.cp_url}/metrics", timeout=10).text
+        assert 'runner="lonely"' not in text
+        doc = requests.get(
+            f"{spine.cp_url}/v1/cluster/status", timeout=10
+        ).json()
+        assert all(r["id"] != "lonely" for r in doc["runners"])
+
+    def test_node_agent_summary_matches_schema(self, spine):
+        from helix_tpu.control.node_agent import NodeAgent
+
+        agent = NodeAgent("unit-runner", registry=spine.registry)
+        sat = agent.saturation_summary()
+        assert set(sat) == set(SATURATION_KEYS)
+        assert sat["slots_total"] == 4     # the one tiny engine
+        payload = agent.heartbeat_payload()
+        assert set(payload["saturation"]) == set(SATURATION_KEYS)
+
+    def test_logbuf_carries_correlation_ids(self):
+        import logging
+
+        from helix_tpu.serving.logbuf import RingLogBuffer
+
+        buf = RingLogBuffer(capacity=16)
+        lg = logging.getLogger("helix.test.logbuf")
+        lg.addHandler(buf)
+        lg.setLevel(logging.INFO)
+        try:
+            lg.info("plain line")
+            lg.warning(
+                "evicting", extra={"trace_id": "t" * 32,
+                                   "request_id": "req-1"},
+            )
+        finally:
+            lg.removeHandler(buf)
+        tail = buf.tail(5)
+        assert "trace_id" not in tail[-2]
+        assert tail[-1]["trace_id"] == "t" * 32
+        assert tail[-1]["request_id"] == "req-1"
+        assert hasattr(buf, "_lock")
+        assert not hasattr(buf, "_lock2")

@@ -14,6 +14,11 @@ Two contracts, enforced repo-wide (wired into tier-1 via
    ONLY inside ``helix_tpu/obs/`` — everything else feeds the shared
    registry.  PR 1/2 grew three hand-rolled ``/metrics`` builders that
    drifted apart; this keeps it at zero.
+3. **One saturation schema**: the heartbeat saturation summary and the
+   control plane's ``helix_cp_runner_saturation_*`` gauges both derive
+   from ``helix_tpu.obs.flight.SATURATION_KEYS``.  The linter fails if
+   either side stops importing the shared tuple, or if any hard-coded
+   ``helix_cp_runner_saturation_<key>`` literal names a key outside it.
 
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
@@ -75,9 +80,60 @@ def _is_self(path: str) -> bool:
     return os.path.basename(path) == "lint_metrics.py"
 
 
+# the heartbeat saturation-summary schema lives in obs/flight.py as a
+# tuple literal closed by a ")" at column 0 — parsed textually so the
+# linter never has to import the package
+_SAT_KEYS_RE = re.compile(
+    r"SATURATION_KEYS\s*=\s*\((.*?)^\)", re.S | re.M
+)
+_SAT_KEY_LITERAL = re.compile(r"""["']([a-z0-9_]+)["']""")
+_SAT_GAUGE_RE = re.compile(r"helix_cp_runner_saturation_([a-z0-9_]+)")
+# both sides of the heartbeat must import the shared schema tuple
+_SAT_IMPORTERS = (
+    os.path.join("helix_tpu", "control", "node_agent.py"),
+    os.path.join("helix_tpu", "control", "server.py"),
+)
+
+
+def _load_saturation_schema(root: str):
+    """Contract 3 setup: the shared SATURATION_KEYS set from
+    obs/flight.py plus any schema-level violations (missing tuple, a
+    heartbeat side that stopped importing it).  The per-line
+    ``helix_cp_runner_saturation_<key>`` check runs inside ``run()``'s
+    single file walk."""
+    violations: list = []
+    flight = os.path.join(root, "helix_tpu", "obs", "flight.py")
+    if not os.path.isfile(flight):
+        return set(), [
+            "helix_tpu/obs/flight.py: missing — SATURATION_KEYS schema "
+            "must live there"
+        ]
+    with open(flight, encoding="utf-8", errors="replace") as f:
+        m = _SAT_KEYS_RE.search(f.read())
+    if not m:
+        return set(), [
+            "helix_tpu/obs/flight.py: SATURATION_KEYS tuple literal not "
+            "found"
+        ]
+    keys = {k for k in _SAT_KEY_LITERAL.findall(m.group(1))}
+    if not keys:
+        return set(), ["helix_tpu/obs/flight.py: SATURATION_KEYS is empty"]
+    for rel in _SAT_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if "SATURATION_KEYS" not in f.read():
+                violations.append(
+                    f"{rel}: does not use the shared heartbeat schema "
+                    "(import obs.flight.SATURATION_KEYS)"
+                )
+    return keys, violations
+
+
 def run(root: str) -> list:
     """Returns a list of violation strings (empty = clean)."""
-    violations: list = []
+    sat_keys, violations = _load_saturation_schema(root)
     for path in _iter_py_files(root):
         if _is_self(path):
             continue
@@ -86,6 +142,13 @@ def run(root: str) -> list:
             lines = f.read().splitlines()
         allowed_exposition = _in_obs(path, root)
         for i, line in enumerate(lines, 1):
+            for gm in _SAT_GAUGE_RE.finditer(line):
+                if sat_keys and gm.group(1) not in sat_keys:
+                    violations.append(
+                        f"{rel}:{i}: saturation gauge key "
+                        f"{gm.group(1)!r} is not in "
+                        "obs.flight.SATURATION_KEYS"
+                    )
             for m in _NAME_LITERAL.finditer(line):
                 name = m.group(1)
                 if not NAME_RE.fullmatch(name):
