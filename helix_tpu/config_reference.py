@@ -48,6 +48,15 @@ ENV_REFERENCE: tuple = (
         section="accelerator",
     ),
     EnvVar(
+        "HELIX_SPEC_TOKENS",
+        "Speculative decoding override for every engine this node "
+        "serves: >0 enables prompt-lookup drafting with that many draft "
+        "tokens per slot per verify call, 0 forces speculation off even "
+        "where a profile enables it. Unset: the profile's "
+        "enable_spec_decode/spec_tokens settings apply.",
+        section="accelerator",
+    ),
+    EnvVar(
         "HELIX_EXACT_SAMPLING",
         "Set to 1 to force the exact full-vocab top-p sampling path for "
         "every request (default: auto — the 64-candidate MXU fast path "
@@ -221,6 +230,63 @@ ENV_REFERENCE: tuple = (
         "Version-ping beacon endpoint (anonymous {product, version, ts} "
         "POST, hourly). Unset: no beacon (the default).",
         section="observability",
+    ),
+    EnvVar(
+        "HELIX_PROFILER_DIR",
+        "Directory for on-demand jax.profiler captures written by the "
+        "runner's POST /admin/profiler (the server picks the filename; "
+        "clients never choose paths). Unset: a fresh tempdir per "
+        "capture.",
+        section="observability",
+    ),
+    EnvVar(
+        "HELIX_TRACEMALLOC",
+        "Set to 1 to arm tracemalloc at import so the control plane's "
+        "heap-profile endpoint sees allocations from process start. "
+        "Costs 2-7x on every later jax compile — diagnostics only, "
+        "never in production serving.",
+        default="0",
+        section="observability",
+    ),
+    # -- dispatch robustness (control plane -> runner) -------------------
+    EnvVar(
+        "HELIX_DISPATCH_MAX_ATTEMPTS",
+        "Max runner candidates one inference dispatch tries before "
+        "returning 503 runners_exhausted (connect errors and 5xx "
+        "received before the first streamed byte fail over to the next "
+        "candidate).",
+        default="3",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_DISPATCH_BACKOFF_BASE",
+        "Base seconds for the capped exponential backoff (with jitter) "
+        "between dispatch failover attempts.",
+        default="0.05",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_DISPATCH_BACKOFF_CAP",
+        "Upper bound in seconds on the per-attempt dispatch backoff.",
+        default="1.0",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_DISPATCH_TIMEOUT",
+        "Total deadline in seconds for one inference dispatch across "
+        "all failover attempts (the remaining budget shrinks with each "
+        "retry).",
+        default="300",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_INTER_TOKEN_TIMEOUT",
+        "Runner-side ceiling in seconds on the gap between consecutive "
+        "streamed tokens of one response; a stall past it aborts the "
+        "request with a typed 504 (SSE clients get an in-band error "
+        "frame).",
+        default="300",
+        section="server",
     ),
     # -- knowledge --------------------------------------------------------
     EnvVar(

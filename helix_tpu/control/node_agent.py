@@ -247,6 +247,18 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         # device_get costs ~28 ms); fuse decode steps so steady-state
         # decode fetches tokens once per window, not once per token
         ekw["decode_steps_per_sync"] = 8
+    import os as _os_env
+
+    spec_env = _os_env.environ.get("HELIX_SPEC_TOKENS", "")
+    if spec_env:
+        # operator-level speculative-decoding override for EVERY engine
+        # this node serves: >0 turns on prompt-lookup drafting with that
+        # many draft tokens per slot, 0 forces it off even when the
+        # profile enables it (the documented contract — so it must beat
+        # profile-set spec_tokens too, not just fill the default)
+        n_spec = int(spec_env)
+        ekw["spec_tokens"] = max(n_spec, 1)
+        ekw["enable_spec_decode"] = n_spec > 0
     ecfg = EngineConfig(
         eos_token_ids=tuple(tokenizer.eos_ids),
         **ekw,
@@ -503,6 +515,7 @@ class NodeAgent:
         slots_busy = slots_total = queue_depth = 0
         kv_used = kv_cap = 0
         hits = misses = 0
+        drafted = accepted = 0
         tps = 0.0
         for m in self._live_models():
             loop = getattr(m, "loop", None)
@@ -520,6 +533,10 @@ class NodeAgent:
             if pc is not None:
                 hits += pc.hits
                 misses += pc.misses
+            # speculative-decoding acceptance pools across engines the
+            # same way the prefix hit rate does (token-weighted)
+            drafted += getattr(eng, "num_spec_drafted_tokens", 0)
+            accepted += getattr(eng, "num_spec_accepted_tokens", 0)
         out = {
             "kv_occupancy": round(kv_used / kv_cap, 4) if kv_cap else 0.0,
             "slots_busy": slots_busy,
@@ -528,6 +545,9 @@ class NodeAgent:
             "tokens_per_sec": round(tps, 2),
             "prefix_hit_rate": (
                 round(hits / (hits + misses), 4) if hits + misses else 0.0
+            ),
+            "spec_acceptance_ratio": (
+                round(accepted / drafted, 4) if drafted else 0.0
             ),
         }
         # schema lockstep: emit exactly the shared key set

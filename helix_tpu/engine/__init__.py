@@ -1,5 +1,6 @@
 from helix_tpu.engine.kv_cache import CacheConfig, PagedKVCache, PageAllocator
 from helix_tpu.engine.sampling import SamplingParams, sample
+from helix_tpu.engine.spec import SpecConfig, SpecDecoder
 from helix_tpu.engine.engine import Engine, EngineConfig, Request
 
 __all__ = [
@@ -8,6 +9,8 @@ __all__ = [
     "PageAllocator",
     "SamplingParams",
     "sample",
+    "SpecConfig",
+    "SpecDecoder",
     "Engine",
     "EngineConfig",
     "Request",

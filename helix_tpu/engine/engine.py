@@ -23,6 +23,13 @@ Execution model (all shapes static, everything jitted once per bucket):
 - **Int8 KV** (``EngineConfig.kv_cache_dtype="int8"``): pages store codes
   + per-(slot, head) f32 scales; ~2x the cached tokens per HBM byte, with
   in-register dequant in the paged kernel.
+- **Speculative decoding** (``EngineConfig.enable_spec_decode``): the host
+  drafts up to ``spec_tokens`` continuation tokens per slot via
+  prompt-lookup n-grams (``engine/spec.py`` — no draft model), and ONE
+  device call scores all k+1 positions per slot against its ragged paged
+  history, accepting the longest prefix the model's own sampling agrees
+  with — accepted tokens cost no extra forward pass, and a per-slot
+  acceptance EMA degrades the worst case back to the plain fused window.
 - Host side keeps plain-Python queues, a page allocator, and per-request
   state; nothing dynamic ever crosses into traced code.
 """
@@ -150,6 +157,22 @@ class EngineConfig:
     # every engine step during long-prompt admission without paying two
     # serialized dispatches; vLLM v1 calls this a mixed batch.
     enable_mixed_step: bool = True
+    # Speculative decoding (engine/spec.py): draft up to spec_tokens
+    # continuation tokens per slot on the HOST (prompt-lookup n-grams —
+    # no draft model), then score all k+1 positions in ONE device call
+    # (a short ragged chunk per slot over its paged history) and accept
+    # the longest draft prefix the model agrees with.  Each accepted
+    # token is a decode forward pass the request never runs.  Sampling
+    # at every verified position draws from the request's own
+    # SamplingParams tiers, so the output distribution is exactly the
+    # non-speculative one (greedy is bit-identical); a per-slot
+    # acceptance EMA turns speculation off for slots whose drafts keep
+    # missing, so the worst case degenerates to the existing fused
+    # window.  Not supported for mrope (VL) or MoE models (expert
+    # capacity is shared across the verify chunk, which would perturb
+    # routing vs plain decode) — the engine logs and disables there.
+    enable_spec_decode: bool = False
+    spec_tokens: int = 4
 
     def cache_config(self, dtype: str = "bfloat16") -> CacheConfig:
         kv_dtype = (
@@ -322,25 +345,9 @@ def _chunk_prefill_body(
     kseg_hist = (kv_pos_hist < start).astype(jnp.int32)
 
     def attn_fn(q, k, v, layer_cache, pos):
-        kp, vp = layer_cache[0], layer_cache[1]   # [N, P, KVH, D]
-        _, P, KVH, D = kp.shape
-        idx = hist_table[0]
         # [m, P, KVH, D] -> [1, m*P, KVH, D] — a pure reshape under
         # the pool's token-major layout (no transpose)
-        kh = kp[idx].reshape(1, Hs, KVH, D)
-        vh = vp[idx].reshape(1, Hs, KVH, D)
-        if len(layer_cache) == 4:
-            # int8 pool: dequant the gathered history in-register with
-            # the per-(slot, head) scales (the gather moved 1 byte/elem)
-            ks, vs = layer_cache[2], layer_cache[3]
-            kh = (
-                kh.astype(jnp.float32)
-                * ks[idx].reshape(1, Hs, KVH)[..., None]
-            )
-            vh = (
-                vh.astype(jnp.float32)
-                * vs[idx].reshape(1, Hs, KVH)[..., None]
-            )
+        kh, vh = _gather_history(layer_cache, hist_table[0], 1, Hs)
         k_all = jnp.concatenate([kh.astype(k.dtype), k], axis=1)
         v_all = jnp.concatenate([vh.astype(v.dtype), v], axis=1)
         kv_pos = jnp.concatenate([kv_pos_hist, pos_q], axis=1)
@@ -400,6 +407,24 @@ def _mesh_sp(mesh) -> int:
     if mesh is not None and "sp" in mesh.axis_names:
         return mesh.shape["sp"]
     return 0
+
+
+def _gather_history(layer_cache, idx, B: int, Hs: int):
+    """Gather a slot-history window from the paged pool: ``idx`` indexes
+    pages ([m] for the single-sequence chunk path, [B, m] for the batched
+    verify path), reshaped token-major to [B, Hs, KVH, D].  Int8 pools
+    dequantize in-register with the per-(slot, head) scales right after
+    the gather (the gather itself moved 1 byte/elem) — the ONE recipe
+    shared by chunk prefill, the mixed step, and speculative verify."""
+    kp, vp = layer_cache[0], layer_cache[1]   # [N, P, KVH, D]
+    _, P, KVH, D = kp.shape
+    kh = kp[idx].reshape(B, Hs, KVH, D)
+    vh = vp[idx].reshape(B, Hs, KVH, D)
+    if len(layer_cache) == 4:
+        ks, vs = layer_cache[2], layer_cache[3]
+        kh = kh.astype(jnp.float32) * ks[idx].reshape(B, Hs, KVH)[..., None]
+        vh = vh.astype(jnp.float32) * vs[idx].reshape(B, Hs, KVH)[..., None]
+    return kh, vh
 
 
 @functools.lru_cache(maxsize=64)
@@ -705,6 +730,190 @@ def _build_mixed_step_fn(
     return mixed_fn
 
 
+@functools.lru_cache(maxsize=64)
+def _build_verify_fn(
+    model_cfg: ModelConfig, page_size: int, backend, n_tokens: int,
+    hist_pages: int, n_extra: int = 0,
+):
+    """Speculative verification: ONE forward pass scores ``n_tokens``
+    positions (the slot's last sampled token + up to ``n_tokens-1``
+    host-drafted tokens) for EVERY decode slot, each against its own
+    ragged paged history — a batch of short chunks over the shared page
+    pool, the same shape as a k-token mixed-step chunk.
+
+    Decode forwards are HBM-bandwidth-bound, so scoring k+1 positions
+    costs roughly one position's pool sweep; every drafted token the
+    model agrees with is a forward pass (and, under a relay, a host
+    round trip) the request never pays.
+
+    In-call semantics (all device-side; the host only sees the sampled
+    tokens and per-slot emit counts):
+
+    - every position samples from the slot's OWN ``SamplingParams``
+      tiers with a fresh key split and the penalty histogram evolved
+      along the drafted prefix — position j's draw is exactly the draw
+      plain decode would make after emitting the first j verified
+      tokens.  Acceptance keeps the longest prefix where the draw
+      equals the draft; the first disagreeing draw is itself a valid
+      sample (for a point-mass draft, "sample from the target and
+      compare" IS rejection sampling: accept with probability p(draft),
+      else emit a draw from p conditioned off the draft), so the output
+      distribution is provably the non-speculative one and greedy
+      (temperature 0) is bit-identical.
+    - positions past a slot's draft length ride along masked (segment 0,
+      KV write suppressed) — slots draft ragged lengths, including 0
+      (plain single-token decode) when the drafter found no match.
+    - rejected positions roll back INSIDE the call: positions,
+      last_token and the penalty histogram are reset to the accepted
+      length, so the returned ``DecodeState`` is indistinguishable from
+      having decoded ``emit`` plain steps.  The rejected drafts' KV
+      writes land only in the slot's private page tail past the
+      accepted length (asserted host-side in ``_spec_step``) and are
+      overwritten by the next step at those same (page, offset) slots.
+
+    Composition with the fused window (``decode_steps_per_sync``): the
+    host cannot draft again mid-window (drafting needs the sampled
+    tokens back), so instead of shrinking an n-step window to one
+    verify call — which would regress every non-drafting batchmate from
+    n tokens per host sync to 1 on relay-attached TPUs — ``n_extra``
+    PLAIN decode steps are scanned onto the verify call's rolled-back
+    state inside the SAME jit.  One spec sync then yields
+    ``(1 + accepted) + n_extra`` tokens per slot, strictly at least the
+    plain window's ``n``.
+
+    Returns ``(cache, state, sampled [B, n], emit [B],
+    extra [n_extra, B])`` — the host emits each slot's first ``emit``
+    sampled tokens, then the ``extra`` window tokens.
+    """
+    cfg = model_cfg
+    n = n_tokens
+    Hs = hist_pages * page_size
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2))
+    def verify_fn(params, cache, state: DecodeState, drafts, draft_len):
+        B = state.last_token.shape[0]
+        active = state.active
+        pos0 = state.positions
+        tokens = jnp.concatenate(
+            [state.last_token[:, None], drafts], axis=1
+        )                                                    # [B, n]
+        pos_q = pos0[:, None] + jnp.arange(n)[None, :]       # [B, n]
+        # position j is live when it has a draft to verify (j-1 <
+        # draft_len) or is the bonus position right after the last
+        # accepted draft (j == draft_len); inactive slots mask entirely
+        valid_q = (
+            (jnp.arange(n)[None, :] <= draft_len[:, None])
+            & (active > 0)[:, None]
+        )
+        qseg = valid_q.astype(jnp.int32)
+        hist_idx = state.page_tables[:, :hist_pages]         # [B, m]
+        kv_pos_hist = jnp.broadcast_to(jnp.arange(Hs)[None], (B, Hs))
+        kseg_hist = (kv_pos_hist < pos0[:, None]).astype(jnp.int32)
+
+        def attn_fn(q, k, v, layer_cache, pos):
+            kh, vh = _gather_history(layer_cache, hist_idx, B, Hs)
+            k_all = jnp.concatenate([kh.astype(k.dtype), k], axis=1)
+            v_all = jnp.concatenate([vh.astype(v.dtype), v], axis=1)
+            kv_pos = jnp.concatenate([kv_pos_hist, pos_q], axis=1)
+            kseg = jnp.concatenate([kseg_hist, qseg], axis=1)
+            # n and Hs are both page_size multiples (the caller buckets
+            # the verify width), so page_size kv blocks always tile the
+            # flash grid exactly — Hs + n is rarely a 256 multiple
+            return full_attention(
+                q, k_all, v_all,
+                causal=True,
+                q_positions=pos_q,
+                kv_positions=kv_pos,
+                q_segment_ids=qseg,
+                kv_segment_ids=kseg,
+                backend=backend,
+                block_q=min(256, n),
+                block_kv=page_size,
+            )
+
+        logits, (k_new, v_new) = forward(
+            params, cfg, tokens, pos_q,
+            attn_fn=attn_fn,
+            layer_caches=cache.carry(),
+            moe_token_mask=valid_q,
+        )
+        pages, offsets = slot_to_page_offset(
+            pos_q, state.page_tables, page_size
+        )
+        cache = write_kv(cache, k_new, v_new, pages, offsets, valid_q)
+
+        # position-by-position penalised sampling (cheap [B, V] ops):
+        # the histogram carries the drafted prefix forward so position
+        # j's penalties match plain decode having emitted j tokens
+        act_i32 = (active > 0).astype(state.token_counts.dtype)
+
+        def samp_body(carry, j):
+            counts, keys = carry
+            pen = apply_penalties(
+                logits[:, j], counts,
+                state.sampling.presence, state.sampling.frequency,
+            )
+            carry_keys, step_keys = split_keys(keys)
+            tok = sample(pen, state.sampling, step_keys)
+            counts = counts.at[jnp.arange(B), tok].add(act_i32)
+            return (counts, carry_keys), tok
+
+        (counts, keys), sampled = jax.lax.scan(
+            samp_body, (state.token_counts, state.keys), jnp.arange(n)
+        )
+        sampled = sampled.T                                  # [B, n]
+
+        # acceptance: longest prefix of draws agreeing with the drafts
+        in_draft = jnp.arange(n - 1)[None, :] < draft_len[:, None]
+        agree = jnp.where(in_draft, sampled[:, : n - 1] == drafts, True)
+        prefix = jnp.cumprod(agree.astype(jnp.int32), axis=1)
+        n_acc = jnp.sum(prefix * in_draft.astype(jnp.int32), axis=1)
+        emit = jnp.where(active > 0, n_acc + 1, 0)           # [B]
+
+        # roll back past the accepted length: positions/last_token/
+        # histogram come out exactly as ``emit`` plain decode steps
+        new_last = jnp.take_along_axis(
+            sampled, jnp.maximum(emit - 1, 0)[:, None], axis=1
+        )[:, 0]
+        discard = (
+            (jnp.arange(n)[None, :] >= emit[:, None])
+            & (active > 0)[:, None]
+        )
+        counts = counts.at[jnp.arange(B)[:, None], sampled].add(
+            -discard.astype(counts.dtype)
+        )
+        new_state = DecodeState(
+            last_token=jnp.where(active > 0, new_last, state.last_token),
+            positions=pos0 + emit,
+            page_tables=state.page_tables,
+            active=active,
+            mrope_delta=state.mrope_delta,
+            keys=keys,
+            token_counts=counts,
+            sampling=state.sampling,
+        )
+        if n_extra:
+            # fused-window tail on the rolled-back state: identical to
+            # the plain n-step decode scan, just sharing the verify
+            # call's host sync
+            def step_body(carry, _):
+                c, st = carry
+                c, st, tok = _decode_one_step(
+                    params, c, st, cfg=cfg, backend=backend
+                )
+                return (_pin_default_layout(c), st), tok
+
+            (cache, new_state), extra = jax.lax.scan(
+                step_body, (_pin_default_layout(cache), new_state), None,
+                length=n_extra,
+            )
+        else:
+            extra = jnp.zeros((0, B), jnp.int32)
+        return cache, new_state, sampled, emit, extra
+
+    return verify_fn
+
+
 class Engine:
     """Single-model serving engine on one mesh slice."""
 
@@ -788,6 +997,39 @@ class Engine:
         self.prefix_cache_misses = 0
         # ragged mixed steps taken (chunk prefill + decode in ONE call)
         self.num_mixed_steps = 0
+        # --- speculative decoding (engine/spec.py) ---
+        # host-side prompt-lookup drafter + per-request acceptance EMA;
+        # None = speculation off (config, or an unsupported model family)
+        self.spec = None
+        if cfg.enable_spec_decode:
+            if cfg.spec_tokens < 1:
+                raise ValueError(
+                    f"spec_tokens ({cfg.spec_tokens}) must be >= 1 when "
+                    "enable_spec_decode is set"
+                )
+            if (
+                model_cfg.mrope_sections is not None
+                or model_cfg.num_experts > 0
+            ):
+                # mrope decode needs 3-stream positions the verify chunk
+                # does not thread; MoE expert capacity is shared across
+                # the chunk, which would perturb routing vs plain decode
+                logging.getLogger(__name__).warning(
+                    "speculative decoding is not supported for %s models"
+                    " — running plain decode",
+                    "mrope (VL)" if model_cfg.mrope_sections is not None
+                    else "MoE",
+                )
+            else:
+                from helix_tpu.engine.spec import SpecConfig, SpecDecoder
+
+                self.spec = SpecDecoder(
+                    SpecConfig(spec_tokens=cfg.spec_tokens)
+                )
+        # verify calls issued, drafts proposed, drafts accepted
+        self.num_spec_steps = 0
+        self.num_spec_drafted_tokens = 0
+        self.num_spec_accepted_tokens = 0
         # device-side decode steps (each fused window of n counts n):
         # decode_tokens / (device_steps * batch) is exact slot utilization
         self.num_decode_device_steps = 0
@@ -925,6 +1167,42 @@ class Engine:
                     self.params, self.cache, self._dstate
                 )
                 n *= 2
+        if self.spec is not None:
+            # compile the verify shape for every (history bucket,
+            # fused-window tail) pair the runtime can pick, against the
+            # idle state (active==0 masks every KV write to the garbage
+            # page) — the first speculative window under live traffic
+            # must not pay XLA
+            self._sync_state()
+            width = self._spec_width()
+            B = self.cfg.max_decode_batch
+            zdrafts = jnp.zeros((B, width - 1), jnp.int32)
+            zlen = jnp.zeros((B,), jnp.int32)
+            ps = self.cache_cfg.page_size
+            max_m = self._spec_hist_pages(self.max_context_len)
+            extras = {0}
+            n = 2
+            while n <= self.cfg.decode_steps_per_sync:
+                extras.add(n - 1)
+                n *= 2
+            m = 1
+            while True:
+                for ne in sorted(extras):
+                    vfn = _build_verify_fn(
+                        self.model_cfg, ps, self._backend, width, m, ne
+                    )
+                    self.cache, self._dstate, _, _, _ = vfn(
+                        self.params, self.cache, self._dstate, zdrafts,
+                        zlen,
+                    )
+                if m >= max_m:
+                    break
+                # max_m is clamped to max_pages_per_seq, which need not
+                # be a power of two — overshooting it would gather more
+                # page-table columns than exist (reshape trace error)
+                # AND skip compiling the bucket the runtime actually
+                # picks
+                m = min(m * 2, max_m)
         C = self.cfg.max_prefill_len
         if not chunked or self.max_context_len <= C:
             return
@@ -998,7 +1276,11 @@ class Engine:
         # re-check: a chunk that just completed activates its slot and
         # decodes its second token this same step (pre-mixed behaviour)
         if any(self._slot_active(i) for i in range(len(self.slots))):
-            emitted.extend(self._decode_step())
+            # speculate when the drafter has something to verify; any
+            # step it doesn't (no n-gram hit, EMA-disabled slots, no
+            # headroom) falls straight through to the plain fused window
+            if self.spec is None or not self._spec_step(emitted):
+                emitted.extend(self._decode_step())
         return emitted
 
     def _request_key(self, req: Request) -> np.ndarray:
@@ -1739,6 +2021,180 @@ class Engine:
             n *= 2
         return n
 
+    # ------------------------------------------------------------------
+    # speculative decoding (engine/spec.py + _build_verify_fn)
+    # ------------------------------------------------------------------
+
+    @property
+    def spec_acceptance_ratio(self) -> float:
+        """Lifetime accepted/drafted ratio (0.0 before any draft)."""
+        d = self.num_spec_drafted_tokens
+        return self.num_spec_accepted_tokens / d if d else 0.0
+
+    def spec_disabled_slots(self) -> int:
+        """Live requests currently EMA-disabled from speculating."""
+        return self.spec.disabled_count() if self.spec is not None else 0
+
+    def _spec_width(self) -> int:
+        """Verify-call token width: spec_tokens + 1 (the bonus position),
+        bucketed up to a page_size multiple on the pallas backend so the
+        flash grid tiles.  The reference backend ignores block shapes, so
+        it keeps the exact width — the in-call sampling scan then runs
+        k+1 iterations, not page_size."""
+        w = self.cfg.spec_tokens + 1
+        backend = self._backend
+        if backend is None:
+            platform = jax.devices()[0].platform
+            backend = (
+                "pallas" if platform in ("tpu", "axon") else "reference"
+            )
+        if backend != "pallas":
+            return w
+        ps = self.cache_cfg.page_size
+        return -(-w // ps) * ps
+
+    def _spec_hist_pages(self, max_pos: int) -> int:
+        """History gather capacity for a verify call: smallest
+        power-of-two page count covering ``max_pos`` cached tokens —
+        bounds distinct compile shapes to O(log S), same scheme as
+        chunked prefill."""
+        ps = self.cache_cfg.page_size
+        m = 1
+        while m * ps < max_pos:
+            m *= 2
+        return min(m, self.cache_cfg.max_pages_per_seq)
+
+    def _spec_extra_steps(self) -> int:
+        """Fused-window tail for a verify call: plain decode steps
+        scanned onto the rolled-back state inside the same jit, so a
+        spec sync never yields fewer tokens per host round trip than
+        the plain window would have.  Starts from ``_decode_window()``
+        (which owns the chunking/adaptive-streaming/queued-work gates)
+        and shrinks while any active slot lacks headroom for the worst
+        case: ``spec_tokens + 1`` verify positions plus the tail."""
+        n = self._decode_window()
+        if n <= 1:
+            return 0
+        k1 = self.cfg.spec_tokens + 1
+        table_cap = (
+            self.cache_cfg.max_pages_per_seq * self.cache_cfg.page_size
+        )
+        for i, req in enumerate(self.slots):
+            if req is None or not self._slot_active(i):
+                continue
+            h = min(
+                req.sampling.max_tokens - len(req.output_tokens),
+                (req.max_len or self.cache_cfg.max_seq_len)
+                - req.num_tokens,
+                table_cap - int(self._positions[i]),
+            )
+            while n > 1 and k1 + n - 1 > h:
+                n //= 2
+            if n <= 1:
+                return 0
+        return n - 1
+
+    def _spec_step(self, emitted) -> bool:
+        """One speculative decode step: draft per slot on the host, then
+        verify every slot's drafts in ONE device call.  Returns False
+        when no slot drafted anything (the caller then runs the plain
+        fused-window decode — speculation never makes a step slower than
+        the baseline path, it only substitutes for it)."""
+        k = self.cfg.spec_tokens
+        ps = self.cache_cfg.page_size
+        B = self.cfg.max_decode_batch
+        width = self._spec_width()
+        table_cap = self.cache_cfg.max_pages_per_seq * ps
+        drafts = np.zeros((B, width - 1), np.int32)
+        draft_len = np.zeros((B,), np.int32)
+        max_pos = 1
+        for i, req in enumerate(self.slots):
+            if req is None or not self._slot_active(i):
+                continue
+            pos = int(self._positions[i])
+            max_pos = max(max_pos, pos)
+            # headroom: the verify call writes KV for pos..pos+L, so the
+            # draft must fit the slot's allocated pages (max_len) and is
+            # not worth proposing past the remaining token budget
+            budget = req.sampling.max_tokens - len(req.output_tokens)
+            room = (
+                (req.max_len or self.cache_cfg.max_seq_len) - req.num_tokens
+            )
+            cap = min(k, budget - 1, room - 1, table_cap - pos - 1)
+            if cap <= 0:
+                continue
+            toks = self.spec.draft(
+                req.id, req.prompt_tokens + req.output_tokens, cap
+            )
+            if not toks:
+                continue
+            # Stale-KV safety invariant: drafted (possibly rejected) KV
+            # lands only in the slot's PRIVATE page tail past the last
+            # prompt token — the prefix cache shares only full pages
+            # strictly below it, so a rejected draft can never corrupt
+            # KV another request reads.  Rollback is then just resetting
+            # host length + DecodeState; the next step overwrites the
+            # same (page, offset) slots.
+            plen = len(req.prompt_tokens)
+            n_shared = len(self._shared_pages.get(req.id, ()))
+            assert pos >= plen and n_shared * ps <= max(plen - 1, 0), (
+                f"speculative KV write would touch shared pages: slot "
+                f"{i} at position {pos}, prompt {plen} tokens, "
+                f"{n_shared} shared pages of {ps}"
+            )
+            drafts[i, : len(toks)] = toks
+            draft_len[i] = len(toks)
+        if not draft_len.any():
+            return False
+        if self._state_dirty or self._dstate is None:
+            self._sync_state()
+        n_extra = self._spec_extra_steps()
+        fn = _build_verify_fn(
+            self.model_cfg, ps, self._backend, width,
+            self._spec_hist_pages(max_pos), n_extra,
+        )
+        self.cache, self._dstate, sampled, emit, extra = fn(
+            self.params, self.cache, self._dstate,
+            jnp.asarray(drafts), jnp.asarray(draft_len),
+        )
+        self.num_spec_steps += 1
+        # ONE device call for verify + the fused-window tail: with
+        # accepted drafts, decode_tokens / device_steps exceeds 1 per
+        # slot — that ratio IS the speculation win (tokens per forward)
+        self.num_decode_device_steps += 1 + n_extra
+        sampled_np, emit_np, extra_np = jax.device_get(
+            (sampled, emit, extra)
+        )
+        for i in range(B):
+            req = self.slots[i]
+            if req is None or not self._slot_active(i):
+                continue
+            e = int(emit_np[i])
+            L = int(draft_len[i])
+            if L:
+                acc = min(e - 1, L)
+                self.num_spec_drafted_tokens += L
+                self.num_spec_accepted_tokens += acc
+                self.spec.observe(req.id, L, acc)
+            for j in range(e):
+                if self.slots[i] is not req or req.finished:
+                    break   # finished mid-verify: discard the overrun
+                self._positions[i] += 1
+                self._last_token[i] = sampled_np[i, j]
+                self.num_decode_tokens += 1
+                self._emit(req, int(sampled_np[i, j]), emitted)
+        # fused-window tail tokens (same contract as _decode_step:
+        # finished slots discard the overrun)
+        for s in range(n_extra):
+            for i, req in enumerate(self.slots):
+                if req is None or not self._slot_active(i):
+                    continue
+                self._positions[i] += 1
+                self._last_token[i] = extra_np[s, i]
+                self.num_decode_tokens += 1
+                self._emit(req, int(extra_np[s, i]), emitted)
+        return True
+
     def _decode_step(self) -> list[tuple[Request, int]]:
         if self._state_dirty or self._dstate is None:
             self._sync_state()
@@ -1834,4 +2290,6 @@ class Engine:
         shared = self._shared_pages.pop(req.id, None)
         if shared and self.prefix_cache is not None:
             self.prefix_cache.release(shared)
+        if self.spec is not None:
+            self.spec.forget(req.id)
         self.allocator.free(req.id)

@@ -1,0 +1,174 @@
+"""Host-side speculative drafting: prompt-lookup n-grams, no draft model.
+
+The decode-throughput lever the fused window (``decode_steps_per_sync``)
+cannot reach: a fused window still runs ONE forward pass per emitted
+token — it only amortises the host round trip.  Speculative decoding
+amortises the *forward passes themselves*: draft ``k`` continuation
+tokens cheaply on the host (the same async CPU-side work APEX overlaps
+with device execution), then score all ``k+1`` positions in ONE device
+call (``engine._build_verify_fn`` — a short ragged chunk over the paged
+history, exactly the shape the Ragged Paged Attention analysis shows
+TPUs handle well) and accept the longest draft prefix the model agrees
+with.  Decode-phase forwards are memory-bandwidth-bound, so scoring k+1
+positions costs roughly one position's HBM sweep — every accepted draft
+token is a forward pass the request never pays for.
+
+Drafting is prompt-lookup (vLLM's ``[ngram]`` speculative mode): match
+the sequence's trailing n-gram
+against *its own earlier tokens* (prompt + generated output) and propose
+the continuation that followed last time.  No second model, no extra
+HBM, and the draft cost is a numpy scan per slot per step.  It shines
+exactly where serving traffic repeats itself — code edits, RAG answers
+quoting retrieved context, extraction workloads echoing the document —
+and degrades to nothing on novel text.
+
+That degradation is managed per slot: a per-request acceptance EMA
+disables speculation for slots whose drafts keep missing (the drafts
+would otherwise waste verify-call width and host time), with a periodic
+re-probe so a request that *becomes* repetitive (e.g. a long quoted
+block later in the answer) gets speculation back.  A disabled slot runs
+the plain fused-window decode path — the worst case is the engine we
+already have.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SpecConfig:
+    """Drafter tuning (host-side only; never crosses into traced code)."""
+
+    spec_tokens: int = 4      # max drafted tokens per slot per step
+    max_ngram: int = 4        # longest trailing n-gram to match
+    min_ngram: int = 1        # shortest n-gram worth matching
+    ema_alpha: float = 0.35   # acceptance EMA update weight
+    disable_below: float = 0.12   # EMA floor: speculation off under this
+    reprobe_after: int = 64   # draft opportunities skipped before re-probe
+
+
+@dataclasses.dataclass
+class _SlotSpec:
+    """Per-request drafting state (keyed by request id, not slot index:
+    slots are recycled across requests but acceptance history is a
+    property of the *request's* text)."""
+
+    ema: float = 1.0          # optimistic start: every slot gets a shot
+    enabled: bool = True
+    cooldown: int = 0         # disabled-state countdown to the re-probe
+    drafted: int = 0
+    accepted: int = 0
+
+
+def propose(
+    tokens: Sequence[int],
+    k: int,
+    max_ngram: int = 4,
+    min_ngram: int = 1,
+) -> list:
+    """Prompt-lookup draft: the continuation that followed the most
+    recent earlier occurrence of the sequence's trailing n-gram.
+
+    Longest n-gram first (a 4-gram match is far more predictive than a
+    1-gram), most recent occurrence wins (locality: the repetition we
+    are inside of beats one from the distant prompt).  Returns at most
+    ``k`` tokens; empty when nothing matches.
+    """
+    n_tok = len(tokens)
+    if k <= 0 or n_tok < min_ngram + 1:
+        return []
+    arr = np.asarray(tokens, dtype=np.int64)
+    for n in range(min(max_ngram, n_tok - 1), min_ngram - 1, -1):
+        pattern = arr[-n:]
+        # windows over arr[:-1]: starts 0..n_tok-1-n, so the trailing
+        # n-gram itself is never its own (trivial) match, while earlier
+        # overlapping occurrences — the heart of "abcabcabc" — are kept
+        windows = np.lib.stride_tricks.sliding_window_view(arr[:-1], n)
+        hits = np.nonzero((windows == pattern).all(axis=1))[0]
+        if hits.size == 0:
+            continue
+        start = int(hits[-1]) + n
+        cont = arr[start: start + k]
+        if cont.size:
+            return [int(t) for t in cont]
+    return []
+
+
+class SpecDecoder:
+    """Per-engine drafting controller: proposes drafts per slot and
+    folds verify outcomes back into each request's acceptance EMA.
+
+    Mutating methods run on the engine thread (plain dict state, no
+    locks); ``disabled_count`` additionally serves the /metrics thread
+    off a GIL-atomic snapshot, and the engine exposes aggregate
+    counters off its own GIL-atomic ints.
+    """
+
+    def __init__(self, cfg: Optional[SpecConfig] = None):
+        self.cfg = cfg or SpecConfig()
+        self._slots: dict = {}   # request id -> _SlotSpec
+
+    def _state(self, req_id: str) -> _SlotSpec:
+        st = self._slots.get(req_id)
+        if st is None:
+            st = self._slots[req_id] = _SlotSpec()
+        return st
+
+    def draft(self, req_id: str, tokens: Sequence[int], k: int) -> list:
+        """Draft up to ``k`` tokens for one slot, honouring the slot's
+        enable/cooldown state.  ``k`` may be below ``spec_tokens`` when
+        the caller clamps to page-room/token-budget headroom."""
+        st = self._state(req_id)
+        if not st.enabled:
+            st.cooldown -= 1
+            if st.cooldown > 0:
+                return []
+            # re-probe: one tentative round right at the disable floor —
+            # a hit climbs back to full speculation, a miss re-disables
+            # on the next observe()
+            st.enabled = True
+            st.ema = self.cfg.disable_below
+        k = min(k, self.cfg.spec_tokens)
+        if k <= 0:
+            return []
+        return propose(
+            tokens, k,
+            max_ngram=self.cfg.max_ngram,
+            min_ngram=self.cfg.min_ngram,
+        )
+
+    def observe(self, req_id: str, drafted: int, accepted: int) -> None:
+        """Fold one verify outcome into the slot's acceptance EMA."""
+        if drafted <= 0:
+            return
+        st = self._state(req_id)
+        st.drafted += drafted
+        st.accepted += accepted
+        ratio = accepted / drafted
+        st.ema = (1.0 - self.cfg.ema_alpha) * st.ema \
+            + self.cfg.ema_alpha * ratio
+        if st.enabled and st.ema < self.cfg.disable_below:
+            st.enabled = False
+            st.cooldown = self.cfg.reprobe_after
+
+    def forget(self, req_id: str) -> None:
+        self._slots.pop(req_id, None)
+
+    def enabled(self, req_id: str) -> bool:
+        """Would a draft() call currently propose for this request?
+        (Read-only: does not tick the cooldown.)"""
+        st = self._slots.get(req_id)
+        return st is None or st.enabled or st.cooldown <= 0
+
+    def disabled_count(self) -> int:
+        """Live slots currently sitting out speculation (EMA floor).
+
+        Unlike the other methods this one IS called off the engine
+        thread (the /metrics collector) — ``list()`` snapshots the dict
+        values in one GIL-atomic op so concurrent draft/forget churn on
+        the engine thread cannot raise mid-iteration."""
+        return sum(1 for st in list(self._slots.values()) if not st.enabled)

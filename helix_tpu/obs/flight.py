@@ -39,6 +39,7 @@ SATURATION_KEYS = (
     "queue_depth",       # requests waiting for a slot (inbox + engine)
     "tokens_per_sec",    # generated tokens/s over the trailing window
     "prefix_hit_rate",   # prefix-cache page hit rate, 0..1
+    "spec_acceptance_ratio",  # speculative drafts accepted/drafted, 0..1
 )
 
 

@@ -68,6 +68,12 @@ class GitService:
         # seed an empty initial commit so clones have a HEAD
         with tempfile.TemporaryDirectory() as tmp:
             _run(["git", "clone", "-q", path, tmp])
+            # cloning an EMPTY repo puts the clone on the local
+            # init.defaultBranch (often 'master' on older git), ignoring
+            # the bare repo's HEAD — pin the unborn branch so the seed
+            # commit lands on (and pushes to) the declared default
+            _run(["git", "-C", tmp, "symbolic-ref", "HEAD",
+                  f"refs/heads/{_safe_ref(default_branch)}"])
             _run(["git", "-C", tmp, "config", "user.email", "helix@local"])
             _run(["git", "-C", tmp, "config", "user.name", "helix"])
             readme = os.path.join(tmp, "README.md")

@@ -206,6 +206,13 @@ class EngineLoop:
             ),
             "mixed_steps": getattr(eng, "num_mixed_steps", 0),
             "moe_dropped_tokens": getattr(eng, "moe_dropped_tokens", 0),
+            "spec_steps": getattr(eng, "num_spec_steps", 0),
+            "spec_drafted_tokens": getattr(
+                eng, "num_spec_drafted_tokens", 0
+            ),
+            "spec_accepted_tokens": getattr(
+                eng, "num_spec_accepted_tokens", 0
+            ),
             "waiting": len(eng.waiting),
             "active_slots": sum(1 for s in eng.slots if s is not None),
             "free_pages": eng.allocator.free_pages,
@@ -238,6 +245,9 @@ class EngineLoop:
             "queue_depth": self._pending + len(eng.waiting),
             "tokens_per_sec": round(self.tokens_per_sec(), 2),
             "prefix_hit_rate": round(hits / denom, 4) if denom else 0.0,
+            "spec_acceptance_ratio": round(
+                getattr(eng, "spec_acceptance_ratio", 0.0), 4
+            ),
         }
 
     def start(self):
@@ -388,6 +398,8 @@ class EngineLoop:
             eng.num_decode_tokens,
             getattr(eng, "num_admitted", 0),
             self.quarantine_evictions,
+            getattr(eng, "num_spec_drafted_tokens", 0),
+            getattr(eng, "num_spec_accepted_tokens", 0),
         )
 
     def _flight_record(
@@ -395,7 +407,7 @@ class EngineLoop:
         failed: Optional[str] = None,
     ) -> None:
         eng = self.engine
-        p0, pad0, d0, a0, q0 = pre
+        p0, pad0, d0, a0, q0, sd0, sa0 = pre
         prefill = eng.num_prefill_tokens - p0
         decode = eng.num_decode_tokens - d0
         if failed is not None:
@@ -426,6 +438,14 @@ class EngineLoop:
             "generated_tokens": generated,
             "admissions": getattr(eng, "num_admitted", 0) - a0,
             "evictions": self.quarantine_evictions - q0,
+            # speculative decoding gains: drafts proposed/accepted this
+            # step (0/0 on non-speculative steps)
+            "spec_drafted": (
+                getattr(eng, "num_spec_drafted_tokens", 0) - sd0
+            ),
+            "spec_accepted": (
+                getattr(eng, "num_spec_accepted_tokens", 0) - sa0
+            ),
         }
         if failed is not None:
             rec["anomaly"] = "step_failure"

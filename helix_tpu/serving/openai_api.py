@@ -236,6 +236,26 @@ class OpenAIServer:
                 "helix_moe_dropped_tokens_total",
                 getattr(eng, "moe_dropped_tokens", 0), lbl,
             )
+            # speculative decoding (ISSUE 5): host-drafted tokens, the
+            # subset the verify pass accepted, lifetime acceptance, and
+            # slots the per-request EMA currently benches
+            c.counter(
+                "helix_spec_drafted_tokens_total",
+                getattr(eng, "num_spec_drafted_tokens", 0), lbl,
+            )
+            c.counter(
+                "helix_spec_accepted_tokens_total",
+                getattr(eng, "num_spec_accepted_tokens", 0), lbl,
+            )
+            c.gauge(
+                "helix_spec_acceptance_ratio",
+                getattr(eng, "spec_acceptance_ratio", 0.0), lbl,
+            )
+            spec_disabled = getattr(eng, "spec_disabled_slots", None)
+            c.gauge(
+                "helix_spec_disabled_slots",
+                spec_disabled() if callable(spec_disabled) else 0, lbl,
+            )
             c.gauge("helix_waiting_requests", len(eng.waiting), lbl)
             c.gauge(
                 "helix_active_slots",

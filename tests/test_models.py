@@ -98,6 +98,7 @@ class TestHFParity:
         )
         _torch_parity(LlamaForCausalLM(hf_cfg), "llama", self.TOKENS, tmp_path, 3e-4)
 
+    @pytest.mark.slow  # ~20 s; phi3 + rope-scaling parity stay in tier-1
     def test_qwen2_parity(self, tmp_path):
         """Qwen2: qkv bias + tied embeddings."""
         from transformers import Qwen2Config, Qwen2ForCausalLM
@@ -111,6 +112,7 @@ class TestHFParity:
         m = Qwen2ForCausalLM(hf_cfg)
         _torch_parity(m, "qwen2", self.TOKENS, tmp_path, 3e-4)
 
+    @pytest.mark.slow  # HF parity sweep; rope-scaling parity stays in tier-1
     def test_phi3_parity(self, tmp_path):
         """Phi-3: fused qkv_proj / gate_up_proj checkpoint layout."""
         from transformers import Phi3Config, Phi3ForCausalLM

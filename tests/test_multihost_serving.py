@@ -65,6 +65,9 @@ class TestWire:
 
 
 class TestLockstep:
+    @pytest.mark.slow  # ~11 s; the other lockstep tests (abort/reaper
+    # replication, rejoin-from-ring, sampled mid-stream kill) keep the
+    # journal-replay axis in tier-1
     def test_follower_reproduces_leader_tokens(self, tiny):
         leader = LockstepLeader(_engine(tiny))
         follower_engine = _engine(tiny)

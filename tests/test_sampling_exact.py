@@ -112,6 +112,8 @@ class TestExactEscalation:
         past = [t for t in sampled if t in set(order[TOPK_BOUND:k].tolist())]
         assert past, "no samples past the 64-token window despite top_k=128"
 
+    @pytest.mark.slow  # ~13 s distribution check; the fast escalation
+    # tests above keep the exact-sampling axis in tier-1
     def test_nucleus_within_window_still_exact(self):
         """Peaked logits, top_p=0.8: nucleus fits the window; distribution
         must match the reference computed with FULL-vocab probabilities

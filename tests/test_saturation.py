@@ -333,7 +333,7 @@ class TestClusterFederation:
         sat = {
             "kv_occupancy": 0.25, "slots_busy": 2, "slots_total": 8,
             "queue_depth": 1, "tokens_per_sec": 123.5,
-            "prefix_hit_rate": 0.5,
+            "prefix_hit_rate": 0.5, "spec_acceptance_ratio": 0.4,
         }
         sat.update(overrides)
         r = requests.post(

@@ -98,6 +98,8 @@ class TestLora:
             atol=1e-4,
         )
 
+    @pytest.mark.slow  # ~11 s; identity/merged-export/masking LoRA
+    # tests keep the training axis in tier-1
     def test_grads_flow_only_to_lora(self, tiny):
         cfg, params = tiny
         lora = init_lora_params(cfg, LoraConfig(rank=4), jax.random.PRNGKey(0))
