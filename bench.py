@@ -513,6 +513,103 @@ def main():
     }
     del on_eng
 
+    # KV tiering (ISSUE 6): a system-prompt-heavy workload against a
+    # device pool too small to keep every prefix resident.  Two fleets'
+    # system prompts alternate, so the device prefix cache thrashes:
+    # WITHOUT the host tier every eviction is a re-prefill (request hit
+    # rate collapses); WITH it the evicted pages spill to host RAM and
+    # restore on the next shared-prefix arrival.  CPU-smoke comparable
+    # like the speculation block — the hit-rate delta and pages
+    # restored are hardware-independent; restore latency is indicative
+    # only off-TPU.
+    from helix_tpu.engine.residency import host_tier_pages
+
+    ps_t = 16
+    sys_prompts = [
+        [(13 * s + j) % (cfg.vocab_size - 2) + 1 for j in range(6 * ps_t)]
+        for s in range(2)
+    ]   # two 6-page system prefixes: the 12-page pool holds only ONE
+    # fleet's prefix at a time, so alternating traffic thrashes it
+    tier_sampling = SamplingParams(temperature=0.0, max_tokens=8)
+
+    def tiering_pass(host_bytes: int):
+        eng3 = Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=2, page_size=ps_t, num_pages=13,
+                max_pages_per_seq=8,
+                max_prefill_len=512 if on_tpu else 64,
+                enable_prefix_cache=True,
+                kv_cache_dtype=kv_dtype,
+                host_pool_bytes=host_bytes,
+            ),
+        )
+
+        def drive(tag, n):
+            for i in range(n):
+                req = Request(
+                    id=f"{tag}-{i}",
+                    prompt_tokens=sys_prompts[i % 2]
+                    + [(31 * i + j) % 200 + 1 for j in range(17)],
+                    sampling=tier_sampling,
+                )
+                eng3.add_request(req)
+                while eng3.has_work():
+                    eng3.step()
+
+        drive("tier-warm", 2)   # compiles packed + chunk-hit shapes
+        h0, m0 = eng3.prefix_cache_hits, eng3.prefix_cache_misses
+        drive("tier-bench", 12)
+        hits = eng3.prefix_cache_hits - h0
+        misses = eng3.prefix_cache_misses - m0
+        return eng3, hits / max(1, hits + misses)
+
+    off3, tier_off_rate = tiering_pass(0)
+    del off3
+    on3, tier_on_rate = tiering_pass(64 << 20)
+    # snapshot the prefix-restore numbers BEFORE the preempt exercise —
+    # its resume also restores pages and banks restore_seconds, which
+    # would skew the per-page figure
+    restored = on3.host_pool.restored_pages
+    tier_restore_s = on3.restore_seconds
+    # preempt/resume round trip on the same engine: park a running
+    # decoder to host and swap it back (the graceful-degradation rung)
+    pr = Request(
+        id="tier-preempt", prompt_tokens=sys_prompts[0][: 2 * ps_t],
+        sampling=SamplingParams(temperature=0.0, max_tokens=48),
+    )
+    eng3 = on3
+    eng3.add_request(pr)
+    while len(pr.output_tokens) < 4:
+        eng3.step()
+    t_pre = time.perf_counter()
+    preempt_ok = eng3.preempt(pr.id)
+    preempt_ms = (time.perf_counter() - t_pre) * 1000.0
+    t_res = time.perf_counter()
+    while eng3.preempted:
+        eng3.step()   # resumes immediately: pages are free
+    resume_ms = (time.perf_counter() - t_res) * 1000.0
+    while eng3.has_work():
+        eng3.step()
+    result["kv_tiering"] = {
+        "host_pool_bytes": 64 << 20,
+        "prefix_request_hit_rate_host_on": round(tier_on_rate, 4),
+        "prefix_request_hit_rate_host_off": round(tier_off_rate, 4),
+        "spilled_pages": eng3.host_pool.spilled_pages,
+        "restored_pages": restored,
+        "host_tier_pages": host_tier_pages(
+            cfg, eng3.cache_cfg, 64 << 20
+        ),
+        "restore_ms_per_page": round(
+            tier_restore_s * 1000.0 / max(1, restored), 3
+        ),
+        "preemptions": eng3.num_preemptions,
+        "preempt_ok": bool(preempt_ok),
+        "preempt_ms": round(preempt_ms, 3),
+        "resume_ms": round(resume_ms, 3),
+    }
+    del eng3, on3
+
     if on_tpu:
         # decode-side model FLOPs utilisation: each generated token moves
         # ~2 FLOPs per active parameter through the MXU; a v5e chip peaks

@@ -57,6 +57,38 @@ ENV_REFERENCE: tuple = (
         section="accelerator",
     ),
     EnvVar(
+        "HELIX_KV_HOST_POOL_BYTES",
+        "Host-RAM KV tier budget (bytes) for every engine this node "
+        "serves: prefix-cache evictions spill page contents to pinned "
+        "host buffers instead of dying (restored + re-adopted when a "
+        "later prompt shares the prefix), and running decoders become "
+        "preemptible by page swap (Engine.preempt). Overrides a "
+        "profile's engine.host_pool_bytes; 0 forces the tier off. "
+        "Unset: the profile setting applies (default off).",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_ADMISSION_TIMEOUT",
+        "Seconds a request may wait for KV pages before it is shed with "
+        "a typed 503 (code kv_exhausted, Retry-After) instead of aging "
+        "silently in the queue. While admission has been starved longer "
+        "than this, NEW arrivals fast-fail the same way before SSE "
+        "headers commit. Applies to queued and preempted-parked "
+        "requests. Unset: no deadline (requests wait up to the 600 s "
+        "queue reaper).",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_PREEMPT_STALL_SECONDS",
+        "Admission stall threshold for preemption-by-swap: when the "
+        "wait queue has been KV-starved this long, the engine loop "
+        "swaps the newest/largest running decoder out to the host KV "
+        "tier (exact resume later) instead of letting the whole queue "
+        "age out. Needs HELIX_KV_HOST_POOL_BYTES > 0. Unset: never "
+        "preempt.",
+        section="accelerator",
+    ),
+    EnvVar(
         "HELIX_EXACT_SAMPLING",
         "Set to 1 to force the exact full-vocab top-p sampling path for "
         "every request (default: auto — the 64-candidate MXU fast path "

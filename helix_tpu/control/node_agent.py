@@ -259,6 +259,15 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         n_spec = int(spec_env)
         ekw["spec_tokens"] = max(n_spec, 1)
         ekw["enable_spec_decode"] = n_spec > 0
+    from helix_tpu.engine.residency import host_pool_budget_bytes
+
+    host_budget = host_pool_budget_bytes(default=-1)
+    if host_budget >= 0:
+        # host-RAM KV tier budget for EVERY engine this node serves
+        # (spill-instead-of-die + preemption-by-swap); same
+        # operator-beats-profile contract as HELIX_SPEC_TOKENS, and 0
+        # forces the tier off
+        ekw["host_pool_bytes"] = host_budget
     ecfg = EngineConfig(
         eos_token_ids=tuple(tokenizer.eos_ids),
         **ekw,
@@ -289,11 +298,11 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
             ),
             vision=vision_runner, follower=follower,
         )
-    def _bound(env_name):
+    def _bound(env_name, cast=int):
         import os
 
         v = os.environ.get(env_name, "")
-        return int(v) if v else None
+        return cast(v) if v else None
 
     loop = EngineLoop(
         engine, name=pm.name,
@@ -301,6 +310,15 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         # unless the operator sets them — see README "Robustness knobs"
         max_queue_depth=_bound("HELIX_MAX_QUEUE_DEPTH"),
         max_queued_tokens=_bound("HELIX_MAX_QUEUED_TOKENS"),
+        # KV-pressure degradation ladder (ISSUE 6): queued requests shed
+        # with a typed kv_exhausted 503 after this many seconds without
+        # pages, and admission stalls longer than the stall threshold
+        # preempt the newest decoder by swap — see README "KV tiering &
+        # preemption"
+        admission_timeout=_bound("HELIX_ADMISSION_TIMEOUT", float),
+        preempt_stall_seconds=_bound(
+            "HELIX_PREEMPT_STALL_SECONDS", float
+        ),
     ).start()
     return ServedModel(
         name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,
@@ -516,6 +534,8 @@ class NodeAgent:
         kv_used = kv_cap = 0
         hits = misses = 0
         drafted = accepted = 0
+        host_used = host_budget = 0
+        preempted = 0
         tps = 0.0
         for m in self._live_models():
             loop = getattr(m, "loop", None)
@@ -537,6 +557,13 @@ class NodeAgent:
             # same way the prefix hit rate does (token-weighted)
             drafted += getattr(eng, "num_spec_drafted_tokens", 0)
             accepted += getattr(eng, "num_spec_accepted_tokens", 0)
+            # host KV tier occupancy pools byte-weighted across engines;
+            # parked (swapped-out) decoders sum
+            hp = getattr(eng, "host_pool", None)
+            if hp is not None:
+                host_used += hp.used_bytes
+                host_budget += hp.budget_bytes
+            preempted += len(getattr(eng, "preempted", ()))
         out = {
             "kv_occupancy": round(kv_used / kv_cap, 4) if kv_cap else 0.0,
             "slots_busy": slots_busy,
@@ -549,6 +576,10 @@ class NodeAgent:
             "spec_acceptance_ratio": (
                 round(accepted / drafted, 4) if drafted else 0.0
             ),
+            "kv_host_occupancy": (
+                round(host_used / host_budget, 4) if host_budget else 0.0
+            ),
+            "preempted_requests": preempted,
         }
         # schema lockstep: emit exactly the shared key set
         return {k: out[k] for k in SATURATION_KEYS}

@@ -1415,6 +1415,9 @@ class ControlPlane:
             "queue_depth": 0,
             "tokens_per_sec": 0.0,
             "inflight": 0,
+            # decoders swapped out to host RAM cluster-wide (ISSUE 6):
+            # sustained non-zero = the fleet is running degraded on KV
+            "preempted_requests": 0,
         }
         occ = []
         for st in sorted(self.router.runners(), key=lambda s: s.id):
@@ -1444,6 +1447,9 @@ class ControlPlane:
             totals["queue_depth"] += int(sat.get("queue_depth", 0))
             totals["tokens_per_sec"] += float(sat.get("tokens_per_sec", 0.0))
             totals["inflight"] += runners[-1]["inflight"]
+            totals["preempted_requests"] += int(
+                sat.get("preempted_requests", 0)
+            )
             if "kv_occupancy" in sat:
                 occ.append(float(sat["kv_occupancy"]))
         totals["tokens_per_sec"] = round(totals["tokens_per_sec"], 2)

@@ -100,6 +100,7 @@ class Request:
     # string = untraced (span recording is then a no-op)
     trace_id: str = ""
     cached_tokens: int = 0          # prompt tokens served by prefix cache
+    preempt_count: int = 0          # times swapped out (bounds thrash)
     _page_hashes: Optional[list] = None
 
     @property
@@ -173,6 +174,18 @@ class EngineConfig:
     # routing vs plain decode) — the engine logs and disables there.
     enable_spec_decode: bool = False
     spec_tokens: int = 4
+    # Host-RAM KV tier (engine/kv_cache.HostPagePool): byte budget for
+    # spilled pages.  >0 turns the tier on: PrefixCache evictions demote
+    # page contents to host buffers instead of dying (restored into
+    # fresh device pages when a later prompt chains onto the digest —
+    # the 10-100x effective-prefix-cache lever for system-prompt-heavy
+    # fleets), and Engine.preempt can swap a running slot's private
+    # pages + sampling state out and exactly resume it later
+    # (preemption-by-swap; the graceful-degradation lever under KV
+    # exhaustion).  0 = no host tier (seed behaviour: evictions free,
+    # preemption unavailable).  Node-level override:
+    # HELIX_KV_HOST_POOL_BYTES.
+    host_pool_bytes: int = 0
 
     def cache_config(self, dtype: str = "bfloat16") -> CacheConfig:
         kv_dtype = (
@@ -270,6 +283,40 @@ def _rebuild_state(
         token_counts=jnp.where(keepc, old.token_counts, fresh),
         sampling=sampling,
     )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _override_token_counts(state: DecodeState, slot, counts) -> DecodeState:
+    """Replace ONE slot's device-resident output-token histogram — the
+    exact-resume path restores the penalty state a preempted request had
+    evolved on device (``_rebuild_state``'s fresh-slot histogram only
+    seeds the first token, which would skew presence/frequency penalties
+    after a swap-in)."""
+    return dataclasses.replace(
+        state, token_counts=state.token_counts.at[slot].set(counts)
+    )
+
+
+@dataclasses.dataclass
+class PreemptedSeq:
+    """A decoder swapped out to host RAM, parked for exact resume.
+
+    Private page CONTENTS live in the engine's ``HostPagePool`` keyed
+    ``("seq", req.id, table_pos)`` and pinned; this record keeps the
+    book-keeping needed to rebuild the slot bit-identically: the table
+    layout (shared prefix pages keep their device page ids — their
+    refcounts stay held while parked), decode position, last token,
+    the evolved PRNG key, and the output-token histogram."""
+
+    req: "Request"
+    table: np.ndarray           # first n_pages entries of the page table
+    private_pos: list           # table indices whose pages were spilled
+    position: int
+    last_token: int
+    mrope_delta: int
+    key: np.ndarray             # evolved per-slot PRNG key, [2] u32
+    counts: np.ndarray          # output-token histogram, [V] i32
+    preempted_at: float = dataclasses.field(default_factory=time.monotonic)
 
 
 # Compiled step functions are cached at module level keyed by the static
@@ -973,6 +1020,20 @@ class Engine:
             PrefixCache() if cfg.enable_prefix_cache else None
         )
         self._shared_pages: dict[str, list] = {}  # req id -> cache pages
+        # host-RAM KV tier (ISSUE 6): spilled prefix pages + swapped-out
+        # decoders, byte-budgeted; None = tier off (evictions free pages,
+        # preemption unavailable)
+        from helix_tpu.engine.kv_cache import HostPagePool
+
+        self.host_pool = (
+            HostPagePool(cfg.host_pool_bytes)
+            if cfg.host_pool_bytes > 0
+            else None
+        )
+        self.preempted: list[PreemptedSeq] = []   # parked, resume FIFO
+        self._resume_failures: list = []          # (req, reason) for the loop
+        self._slot_count_overrides: dict[int, np.ndarray] = {}
+        self._prefetched: set = set()   # digests with in-flight device puts
         self._key_base = _splitmix64(0x8E1_1C9 ^ (rng_seed & _M64))
         self._key_nonce = 0
         self._step_counter = itertools.count()
@@ -1033,6 +1094,12 @@ class Engine:
         # device-side decode steps (each fused window of n counts n):
         # decode_tokens / (device_steps * batch) is exact slot utilization
         self.num_decode_device_steps = 0
+        # KV tiering (ISSUE 6): swap-out/swap-in of running decoders and
+        # cumulative host->device restore time (bench's restore-latency
+        # numerator; page-level spill/restore pools live on host_pool)
+        self.num_preemptions = 0
+        self.num_resumes = 0
+        self.restore_seconds = 0.0
         # MoE routing assignments dropped to expert-capacity overflow
         # during prefill (those tokens silently rode the residual stream);
         # device scalars accumulate un-fetched and drain lazily so the
@@ -1112,7 +1179,11 @@ class Engine:
         return self._requests.get(req_id)
 
     def has_work(self) -> bool:
-        return bool(self.waiting) or any(s is not None for s in self.slots)
+        return (
+            bool(self.waiting)
+            or bool(self.preempted)
+            or any(s is not None for s in self.slots)
+        )
 
     def reap_stuck(self, max_queue_seconds: float = 600.0) -> list:
         """Abort requests stuck in the wait queue beyond a budget (page
@@ -1258,6 +1329,12 @@ class Engine:
         Returns [(request, new_token_id), ...] for tokens produced this step.
         """
         emitted: list[tuple[Request, int]] = []
+        if self.host_pool is not None:
+            # release the HBM gather buffers of spills from EARLIER
+            # steps (their async D2H copies have landed by now) —
+            # step-entry so every step shape drains, including the
+            # early-returning mixed step
+            self.host_pool.drain_pending()
         self._admit(emitted)
         if self._chunking is not None and self._chunking["req"].finished:
             self._chunking = None    # aborted mid-prefill
@@ -1347,25 +1424,50 @@ class Engine:
         return req._page_hashes
 
     def _ensure_pages(self, need: int) -> bool:
-        """can_allocate, with prefix-cache LRU eviction as the backstop."""
+        """can_allocate, with prefix-cache LRU eviction as the backstop.
+
+        With a host tier, eviction SPILLS instead of destroying: the
+        page contents demote to host buffers keyed by the same chain
+        digest ``match_len`` looks up, so a later prompt sharing the
+        prefix restores them instead of re-prefilling (the effective
+        prefix cache grows from HBM-pages to host-budget-pages)."""
         if self.allocator.can_allocate(need):
             return True
         if self.prefix_cache is not None:
-            freed = self.prefix_cache.evict(
+            entries = self.prefix_cache.evict_entries(
                 need - self.allocator.free_pages
             )
-            if freed:
-                self.allocator.give_back(freed)
+            if entries:
+                if self.host_pool is not None:
+                    self._spill_prefix_pages(entries)
+                self.allocator.give_back([p for _, p in entries])
         return self.allocator.can_allocate(need)
+
+    def _spill_prefix_pages(self, entries: list) -> None:
+        """Demote evicted prefix pages (``[(digest, page), ...]``) to the
+        host tier.  The gather result is fresh device buffers with their
+        D2H copies issued asynchronously inside ``put`` — the engine
+        thread never blocks on the transfer.  A page the pool rejects
+        (budget, injected alloc_fail) is simply lost, exactly as before
+        the tier existed."""
+        from helix_tpu.engine.kv_cache import gather_pages
+
+        arrays = gather_pages(self.cache, [p for _, p in entries])
+        for (digest, _page), page_arrays in zip(entries, arrays):
+            self.host_pool.put(digest, page_arrays)
 
     def _try_claim(self, req: Request, use_cache: bool = False):
         """Allocate pages + a slot for one waiting request; returns its
         page table or None when resources are unavailable.
 
         With ``use_cache`` the longest cached prefix is acquired from the
-        prefix cache and stitched in front of freshly allocated pages;
-        ``req.cached_tokens`` records how many prompt tokens are already
-        resident (page-aligned)."""
+        prefix cache and stitched in front of freshly allocated pages.
+        When the chain continues into the HOST tier, those pages are
+        restored into freshly allocated device pages here (their uploads
+        were typically prefetched while the request sat queue-blocked,
+        so the device_put overlapped the wait) and re-adopted into the
+        device prefix cache.  ``req.cached_tokens`` records how many
+        prompt tokens are already resident (page-aligned)."""
         free_slots = [i for i, s in enumerate(self.slots) if s is None]
         if not free_slots:
             return None
@@ -1390,19 +1492,24 @@ class Engine:
         pages = shared + self.allocator.allocate(req.id, need_new)
         req.slot = slot
         req.admitted_time = time.monotonic()   # queue wait ends here
-        req.cached_tokens = len(shared) * self.cache_cfg.page_size
+        restored = 0
+        if use_cache and self.host_pool is not None and hashes:
+            restored = self._restore_host_prefix(req, hashes, shared, pages)
+        req.cached_tokens = (len(shared) + restored) * self.cache_cfg.page_size
         self.num_admitted += 1
         if self.prefix_cache is not None:
             # request-level outcome: did THIS admission reuse any cached
             # prefix pages?  (page-level pools are record_claim below)
-            if shared:
+            if shared or restored:
                 self.prefix_cache_hits += 1
             else:
                 self.prefix_cache_misses += 1
         if use_cache and self.prefix_cache is not None:
-            self.prefix_cache.record_claim(len(shared), len(hashes))
+            self.prefix_cache.record_claim(
+                len(shared) + restored, len(hashes)
+            )
         if shared:
-            self._shared_pages[req.id] = shared
+            self._shared_pages.setdefault(req.id, []).extend(shared)
         # pages round up to page granularity; the model context limit
         # still binds exactly
         req.max_len = min(
@@ -1413,6 +1520,90 @@ class Engine:
         table[: len(pages)] = pages
         self._page_tables[slot] = table
         return table
+
+    def _restore_host_prefix(
+        self, req: Request, hashes: list, shared: list, pages: list
+    ) -> int:
+        """Promote the host-resident continuation of the prefix chain
+        into this request's freshly allocated device pages.
+
+        Walks digests past the device-matched head, claims each page
+        from the host pool (checksum-verified; a corrupt or concurrently
+        evicted entry truncates the chain — the remainder prefills
+        normally, correct by construction), writes the batch back with
+        one donated scatter, and re-adopts the pages into the device
+        prefix cache so the NEXT sharer hits in HBM."""
+        k = len(shared)
+        entries: list = []
+        digests: list = []
+        while k + len(entries) < len(hashes):
+            h = hashes[k + len(entries)]
+            if not self.host_pool.contains(h):
+                break
+            e = self.host_pool.take_restored(h)
+            self._prefetched.discard(h)   # consumed (or dropped corrupt)
+            if e is None:   # corrupt (detected + dropped) — chain ends
+                break
+            entries.append(e)
+            digests.append(h)
+        if not entries:
+            return 0
+        from helix_tpu.engine.kv_cache import restore_pages
+
+        t0 = time.monotonic()
+        targets = pages[k:k + len(entries)]
+        self.cache = restore_pages(self.cache, targets, entries)
+        self.restore_seconds += time.monotonic() - t0
+        if self.prefix_cache is not None:
+            adopted = self.prefix_cache.adopt(digests, targets)
+            if adopted:
+                # same ownership transfer as _adopt_prompt_pages: the
+                # cache owns them, the request holds one ref until finish
+                self.allocator.detach(req.id, adopted)
+                self._shared_pages.setdefault(req.id, []).extend(adopted)
+        return len(entries)
+
+    def _cached_prefix_pages(self, req: Request) -> int:
+        """Resident prefix length in pages across BOTH tiers (device
+        chain, then its host-spilled continuation) — the admission
+        router's signal that a prompt's remainder must attend history."""
+        if self.prefix_cache is None:
+            return 0
+        hashes = self._prompt_hashes(req)
+        k = self.prefix_cache.match_len(hashes)
+        if self.host_pool is not None:
+            while k < len(hashes) and self.host_pool.contains(hashes[k]):
+                k += 1
+        return k
+
+    def _prefetch_host_prefix(self, req: Request) -> None:
+        """Start host->device uploads for the waiting head's host-resident
+        prefix pages while it is still resource-blocked: ``device_put``
+        is async, so the transfer rides the queue wait (the same
+        host/device overlap recipe as spec drafting) and the eventual
+        ``_restore_host_prefix`` consumes in-flight handles instead of
+        paying the upload at admission time.
+
+        Device handles are bounded to ONE in-flight chain: a new wave
+        (different waiting head) releases the previous wave's uploads —
+        prefetch borrows HBM from a machine that is out of it, so
+        handles whose admission never happened (request shed, chain
+        superseded) must not linger until LRU eviction."""
+        if self.host_pool is None or self.prefix_cache is None:
+            return
+        hashes = self._prompt_hashes(req)
+        k = self.prefix_cache.match_len(hashes)
+        chain = []
+        while k < len(hashes) and self.host_pool.contains(hashes[k]):
+            chain.append(hashes[k])
+            k += 1
+        for stale in self._prefetched - set(chain):
+            self.host_pool.release_device(stale)
+        self._prefetched = set()
+        for h in chain:
+            if not self.host_pool.prefetch(h):
+                break
+            self._prefetched.add(h)
 
     def _admit(self, emitted) -> None:
         # Long prompts that cannot start THIS step (another chunked prefill
@@ -1431,6 +1622,20 @@ class Engine:
                 self._finish_packed_admissions(pending, emitted)
             if deferred:
                 self.waiting[:0] = deferred
+        if self.preempted:
+            # swapped-out decoders resume AFTER the wait queue got its
+            # chance at the freed pages (they were preempted FOR that
+            # queue — resume-first would re-grab the pages and starve it);
+            # the loop's admission deadline backstops a park that never
+            # clears
+            self._try_resume()
+        if not self.waiting and self._prefetched:
+            # the queue unblocked without consuming the prefetched chain
+            # (head admitted fresh, shed, or aborted): let its device
+            # uploads go — no future wave would release them otherwise
+            for h in self._prefetched:
+                self.host_pool.release_device(h)
+            self._prefetched = set()
 
     def _admit_inner(self, emitted, deferred: list, pending: list) -> None:
         while self.waiting:
@@ -1443,9 +1648,10 @@ class Engine:
             is_mrope = self.model_cfg.mrope_sections is not None
             cache_match = 0
             if self.prefix_cache is not None and not is_mrope:
-                cache_match = self.prefix_cache.match_len(
-                    self._prompt_hashes(req)
-                )
+                # both tiers: a host-resident continuation also means the
+                # remainder must attend history (its pages restore into
+                # the table during the claim)
+                cache_match = self._cached_prefix_pages(req)
             if cache_match and not needs_chunking:
                 # a cached prefix means the remainder must attend HISTORY
                 # (the shared pages): the packed path can't, but a ONE-
@@ -1453,7 +1659,10 @@ class Engine:
                 # in the same step (they must not serialize through the
                 # single in-flight chunking state)
                 if not self._admit_chunk_hit(req, pending):
-                    return   # resource wait
+                    # resource wait: overlap it with the host->device
+                    # uploads the eventual claim will consume
+                    self._prefetch_host_prefix(req)
+                    return
                 continue
             if not needs_chunking and not is_mrope:
                 # short text prompts pack into ONE prefill call; first
@@ -1461,6 +1670,8 @@ class Engine:
                 # (one fetch per wave, not per call — each fetch is a
                 # full relay round trip)
                 if not self._admit_packed(pending):
+                    if not is_mrope:
+                        self._prefetch_host_prefix(req)
                     return
                 continue
             if needs_chunking and self._chunking is not None:
@@ -1471,6 +1682,8 @@ class Engine:
                 continue
             table = self._try_claim(req, use_cache=not is_mrope)
             if table is None:
+                if not is_mrope:
+                    self._prefetch_host_prefix(req)
                 return  # resource wait; decode will free pages
             self.waiting.pop(0)
             slot = req.slot
@@ -1973,6 +2186,14 @@ class Engine:
         )
         self._changed_slots.clear()
         self._state_dirty = False
+        if self._slot_count_overrides:
+            # resumed slots: re-inject the saved output-token histogram
+            # over the fresh-slot reset the rebuild just applied
+            for slot, counts in sorted(self._slot_count_overrides.items()):
+                self._dstate = _override_token_counts(
+                    self._dstate, jnp.int32(slot), jnp.asarray(counts)
+                )
+            self._slot_count_overrides.clear()
 
     def _decode_window(self) -> int:
         """Fused decode steps to run before the next host sync.
@@ -2020,6 +2241,201 @@ class Engine:
         while n * 2 <= cap:
             n *= 2
         return n
+
+    # ------------------------------------------------------------------
+    # preemption-by-swap (ISSUE 6)
+    # ------------------------------------------------------------------
+
+    def preempt(self, req_id: str) -> bool:
+        """Swap a running decoder out to host RAM and park it for exact
+        resume: private page contents + the device-evolved sampler state
+        (PRNG key stream, output-token histogram) move to the host tier,
+        the slot and pages free, and the request joins ``preempted``.
+
+        Shared prefix pages stay in the device prefix cache with their
+        refcounts held — they are shared (typically the hot system
+        prompt), so swapping them would free nothing for anyone else and
+        would break other holders' tables.
+
+        Returns False when the request is not preemptible right now
+        (no host tier, unknown/finished/queued request, mid-chunk
+        prefill) or the host budget cannot take its pages — the caller
+        degrades to the next rung of the ladder (shed)."""
+        if self.host_pool is None:
+            return False
+        req = self._requests.get(req_id)
+        if req is None or req.finished or req.slot is None:
+            return False
+        slot = req.slot
+        if not self._slot_active(slot):
+            return False   # mid-chunked-prefill: nothing decodable to park
+        # capture the device-evolving sampler state AFTER making the
+        # device copy current — bit-exact resume needs the key stream
+        # and penalty histogram exactly where the last step left them
+        if self._state_dirty or self._dstate is None:
+            self._sync_state()
+        key = np.asarray(self._dstate.keys[slot])
+        counts = np.asarray(self._dstate.token_counts[slot])
+        shared = self._shared_pages.get(req_id, [])
+        owned = self.allocator.seq_pages(req_id)
+        n_pages = len(owned) + len(shared)
+        table = np.array(self._page_tables[slot][:n_pages])
+        private = set(owned)
+        private_pos = [
+            i for i in range(n_pages) if int(table[i]) in private
+        ]
+        from helix_tpu.engine.kv_cache import gather_pages
+
+        page_ids = [int(table[i]) for i in private_pos]
+        arrays = gather_pages(self.cache, page_ids) if page_ids else []
+        put_keys = []
+        for pos, page_arrays in zip(private_pos, arrays):
+            k = ("seq", req_id, pos)
+            # pinned: prefix-spill pressure must never evict a parked
+            # decoder's pages out from under its resume
+            if not self.host_pool.put(k, page_arrays, pinned=True):
+                for kk in put_keys:   # roll back: preemption is atomic
+                    self.host_pool.discard(kk)
+                return False
+            put_keys.append(k)
+        self.preempted.append(
+            PreemptedSeq(
+                req=req,
+                table=table,
+                private_pos=private_pos,
+                position=int(self._positions[slot]),
+                last_token=int(self._last_token[slot]),
+                mrope_delta=int(self._mrope_delta[slot]),
+                key=key,
+                counts=counts,
+            )
+        )
+        if self.allocator.owns(req_id):
+            self.allocator.free(req_id)
+        self.slots[slot] = None
+        req.slot = None
+        self._state_dirty = True
+        self._changed_slots.add(slot)
+        self.num_preemptions += 1
+        logging.getLogger(__name__).info(
+            "preempted request %s: %d private page(s) swapped to host, "
+            "%d shared prefix page(s) kept resident",
+            req_id, len(private_pos), len(shared),
+        )
+        return True
+
+    def preempt_for_pressure(self) -> Optional[str]:
+        """Pick and preempt the degradation-ladder victim: the NEWEST
+        admission (least sunk decode work), breaking ties toward the
+        largest page footprint (frees the most for the starved queue).
+        Requests already swapped twice are exempt — bounded thrash.
+        Returns the preempted request id, or None."""
+        cands = [
+            (req, i)
+            for i, req in enumerate(self.slots)
+            if req is not None
+            and self._slot_active(i)
+            and req.preempt_count < 2
+        ]
+        while cands:
+            req, i = max(
+                cands,
+                key=lambda c: (
+                    c[0].admitted_time or 0.0,
+                    len(self.allocator.seq_pages(c[0].id))
+                    + len(self._shared_pages.get(c[0].id, ())),
+                ),
+            )
+            if self.preempt(req.id):
+                req.preempt_count += 1
+                return req.id
+            cands.remove((req, i))
+        return None
+
+    def _discard_preempted(self, st: PreemptedSeq) -> None:
+        for pos in st.private_pos:
+            self.host_pool.discard(("seq", st.req.id, pos))
+
+    def _try_resume(self) -> None:
+        """Swap parked decoders back in, FIFO, while a slot + pages are
+        available.  Restored pages are bit-identical to what was spilled
+        (checksummed both ways), the PRNG key and penalty histogram
+        rejoin the device state exactly, so a greedy or seeded
+        continuation matches an unpreempted run token for token."""
+        while self.preempted:
+            st = self.preempted[0]
+            req = st.req
+            if req.finished:   # aborted while parked
+                self.preempted.pop(0)
+                self._discard_preempted(st)
+                continue
+            free_slots = [
+                i for i, s in enumerate(self.slots) if s is None
+            ]
+            n_private = len(st.private_pos)
+            if not free_slots or not self.allocator.can_allocate(n_private):
+                return
+            # claim + verify every host copy BEFORE touching allocator
+            # state: a corrupt page means the sequence cannot be
+            # reconstructed bit-exactly — fail the request loudly, never
+            # resume wrong KV.  One pass (checksum verified inside
+            # take_restored); a mid-chain failure aborts the whole
+            # resume, so a None can never reach restore_pages.
+            t0 = time.monotonic()
+            entries = []
+            for pos in st.private_pos:
+                e = self.host_pool.take_restored(("seq", req.id, pos))
+                if e is None:
+                    break
+                entries.append(e)
+            if len(entries) != n_private:
+                self.preempted.pop(0)
+                self._discard_preempted(st)
+                self._resume_failures.append(
+                    (
+                        req,
+                        "kv_restore_corrupt: a swapped-out page failed "
+                        "checksum verification on resume",
+                    )
+                )
+                self._finish(req, FinishReason.ABORT)
+                continue
+            new_pages = self.allocator.allocate(req.id, n_private)
+            from helix_tpu.engine.kv_cache import restore_pages
+
+            self.cache = restore_pages(self.cache, new_pages, entries)
+            table = np.array(st.table)
+            for pos, pg in zip(st.private_pos, new_pages):
+                table[pos] = pg
+            slot = free_slots[0]
+            self.slots[slot] = req
+            req.slot = slot
+            row = np.zeros((self.cache_cfg.max_pages_per_seq,), np.int32)
+            row[: len(table)] = table
+            self._page_tables[slot] = row
+            self._positions[slot] = st.position
+            self._last_token[slot] = st.last_token
+            self._mrope_delta[slot] = st.mrope_delta
+            # the evolved key re-enters through the host mirror (the
+            # changed-slot rebuild takes keys from it); the histogram
+            # needs the explicit device override applied at next sync
+            self._slot_keys[slot] = st.key
+            self._slot_count_overrides[slot] = st.counts
+            self._state_dirty = True
+            self._changed_slots.add(slot)
+            self.num_resumes += 1
+            self.restore_seconds += time.monotonic() - t0
+            self.preempted.pop(0)
+            logging.getLogger(__name__).info(
+                "resumed request %s into slot %d (%d page(s) restored)",
+                req.id, slot, n_private,
+            )
+
+    def drain_resume_failures(self) -> list:
+        """(request, reason) pairs for resumes that failed verification —
+        the engine loop turns them into typed client error events."""
+        out, self._resume_failures = self._resume_failures, []
+        return out
 
     # ------------------------------------------------------------------
     # speculative decoding (engine/spec.py + _build_verify_fn)
@@ -2287,9 +2703,14 @@ class Engine:
             req.slot = None
         if req in self.waiting:   # aborted before admission
             self.waiting.remove(req)
+        for st in list(self.preempted):   # aborted while parked
+            if st.req is req:
+                self.preempted.remove(st)
+                self._discard_preempted(st)
         shared = self._shared_pages.pop(req.id, None)
         if shared and self.prefix_cache is not None:
             self.prefix_cache.release(shared)
         if self.spec is not None:
             self.spec.forget(req.id)
-        self.allocator.free(req.id)
+        if self.allocator.owns(req.id):
+            self.allocator.free(req.id)

@@ -30,6 +30,9 @@ reference's GPU VRAM accounting.
 from __future__ import annotations
 
 import dataclasses
+import functools
+import hashlib
+import time
 from typing import Optional
 
 import jax
@@ -275,12 +278,20 @@ class PageAllocator:
 
     Page 0 is reserved as the garbage page that padding writes land on
     (``write_kv``), so it is never handed out.
+
+    Invariants, enforced loudly (ISSUE 6): ``used + free == num_pages - 1``
+    after every operation, ``free()`` of a sequence that owns nothing is
+    an error (double-free / typo'd seq id), ``give_back()`` of a page
+    already on the free list is an error, and ``allocate()`` either
+    fully succeeds or changes nothing — a partial failure can never
+    orphan pages.
     """
 
     def __init__(self, num_pages: int, max_pages_per_seq: int):
         self.num_pages = num_pages
         self.max_pages_per_seq = max_pages_per_seq
         self._free = list(range(num_pages - 1, 0, -1))  # page 0 reserved
+        self._free_set = set(self._free)   # O(1) double-give_back guard
         self._owned: dict[str, list[int]] = {}
         self.peak_used = 0   # high-water mark of occupied pages (metrics)
 
@@ -301,24 +312,44 @@ class PageAllocator:
         return len(self._free) >= n
 
     def allocate(self, seq_id: str, n: int) -> list[int]:
+        """All-or-nothing: every failure path is checked BEFORE any page
+        leaves the free list, so a raising allocate leaves no orphans."""
+        if n < 0:
+            raise ValueError(f"allocate({seq_id!r}, {n}): negative count")
+        if n == 0:
+            return []
         if len(self._free) < n:
             raise MemoryError(
                 f"page pool exhausted: want {n}, have {len(self._free)}"
             )
+        if len(self._owned.get(seq_id, ())) + n > self.max_pages_per_seq:
+            raise MemoryError(f"sequence {seq_id} exceeds max_pages_per_seq")
         got = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(got)
         if self.used_pages > self.peak_used:
             self.peak_used = self.used_pages
         self._owned.setdefault(seq_id, []).extend(got)
-        if len(self._owned[seq_id]) > self.max_pages_per_seq:
-            raise MemoryError(f"sequence {seq_id} exceeds max_pages_per_seq")
         return got
 
     def seq_pages(self, seq_id: str) -> list[int]:
         return list(self._owned.get(seq_id, []))
 
+    def owns(self, seq_id: str) -> bool:
+        """Does this sequence currently own any pages?  Callers with a
+        legitimately-maybe-unallocated sequence (a request aborted while
+        still queued) guard ``free()`` with this instead of relying on a
+        silent no-op that would also mask real double-frees."""
+        return seq_id in self._owned
+
     def free(self, seq_id: str) -> None:
-        pages = self._owned.pop(seq_id, [])
+        if seq_id not in self._owned:
+            raise KeyError(
+                f"free() of sequence {seq_id!r} that owns no pages "
+                "(double free, or never allocated?)"
+            )
+        pages = self._owned.pop(seq_id)
         self._free.extend(reversed(pages))
+        self._free_set.update(pages)
 
     def detach(self, seq_id: str, pages: list) -> None:
         """Remove ``pages`` from the sequence's ownership WITHOUT freeing
@@ -331,7 +362,13 @@ class PageAllocator:
 
     def give_back(self, pages: list) -> None:
         """Return cache-evicted pages to the free list."""
+        dup = self._free_set.intersection(pages)
+        if dup:
+            raise ValueError(
+                f"give_back() of already-free page(s) {sorted(dup)}"
+            )
         self._free.extend(pages)
+        self._free_set.update(pages)
 
 
 def slot_to_page_offset(slots: jax.Array, page_table, page_size: int):
@@ -451,6 +488,15 @@ class PrefixCache:
 
     def evict(self, n: int) -> list:
         """Free up to ``n`` pages from refcount-0 entries, LRU first.
+        Returns the freed page ids (see ``evict_entries`` for the
+        digest-carrying variant the host spill tier feeds on)."""
+        return [p for _, p in self.evict_entries(n)]
+
+    def evict_entries(self, n: int) -> list:
+        """Free up to ``n`` pages from refcount-0 entries, LRU first;
+        returns ``[(digest, page), ...]`` so the caller can demote the
+        page CONTENTS to a host tier keyed by the same chain digest a
+        future ``match_len`` would look up.
         NOTE: evicting entry i invalidates the hash CHAIN below it for
         future matches, but match_len stops at the first missing digest,
         so correctness holds — later entries just become unreachable and
@@ -466,7 +512,7 @@ class PrefixCache:
             page = e[0]
             h = self._by_page.pop(page)
             del self._entries[h]
-            freed.append(page)
+            freed.append((h, page))
         self.evicted_pages += len(freed)
         return freed
 
@@ -479,3 +525,373 @@ class PrefixCache:
             "misses": self.misses,
             "evicted_pages": self.evicted_pages,
         }
+
+
+# ---------------------------------------------------------------------------
+# Host-RAM page tier (ISSUE 6): spill instead of die
+# ---------------------------------------------------------------------------
+
+
+def _page_checksum(arrays: dict) -> bytes:
+    """Content digest over a page's host buffers, in a fixed field order.
+    Spilled int8 pools checksum the raw codes + scale rows, so a
+    restore is verified bit-exact in the STORED representation."""
+    h = hashlib.blake2b(digest_size=16)
+    for field in ("k", "v", "k_scale", "v_scale"):
+        a = arrays.get(field)
+        if a is not None:
+            h.update(np.ascontiguousarray(a).tobytes())
+    return h.digest()
+
+
+class _HostPage:
+    """One spilled page: host copies of its K/V (+ int8 scale rows).
+
+    ``arrays`` may still hold device arrays whose host copy is in
+    flight (``copy_to_host_async`` issued at spill time — the engine
+    thread never blocks on the D2H transfer); ``_finalize`` converts to
+    numpy and stamps the checksum on first use."""
+
+    __slots__ = (
+        "key", "arrays", "nbytes", "pinned", "tick", "checksum", "ready",
+        "device",
+    )
+
+    def __init__(self, key, arrays: dict, nbytes: int, pinned: bool,
+                 tick: int):
+        self.key = key
+        self.arrays = arrays
+        self.nbytes = nbytes
+        self.pinned = pinned
+        self.tick = tick
+        self.checksum: Optional[bytes] = None
+        self.ready = False
+        self.device: Optional[dict] = None   # prefetched device handles
+
+
+class HostPagePool:
+    """Byte-budgeted host-RAM tier under the device page pool.
+
+    Two key spaces share one budget:
+
+    - **prefix pages** keyed by the ``PrefixCache`` chain digest:
+      ``PrefixCache`` evictions demote here instead of dying, and a
+      later admission whose prompt chains onto a host-resident digest
+      restores the page into fresh device pages (10-100x the effective
+      prefix cache for system-prompt-heavy fleets);
+    - **preempted sequences** keyed by ``("seq", request_id, table_pos)``
+      and PINNED: a swapped-out decoder's private pages must survive
+      until resume or abort, so prefix-spill pressure can never evict
+      them.
+
+    Unpinned entries LRU-evict to fit the budget.  Every entry carries a
+    content checksum verified at restore (and at prefetch) — a corrupt
+    host buffer is detected, dropped, and surfaces as a counter + a
+    cache miss (prefix pages) or a resume failure (preempted pages),
+    never as silently wrong KV.
+
+    Engine-thread owned; the counters and occupancy ints are plain
+    GIL-atomic reads for the /metrics and heartbeat threads.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget_bytes = int(budget_bytes)
+        self._entries: dict = {}
+        self._pending: list = []   # keys spilled but not yet finalized
+        self._tick = 0
+        self._bytes = 0
+        # counters (monotonic; scraped as helix_kv_* series)
+        self.spilled_pages = 0      # pages demoted device -> host
+        self.restored_pages = 0     # pages promoted host -> device
+        self.evicted_pages = 0      # unpinned pages LRU-dropped for budget
+        self.corrupt_pages = 0      # checksum failures detected at restore
+        self.alloc_failures = 0     # spills dropped: budget/fault
+
+    # -- occupancy (GIL-atomic reads, any thread) ---------------------------
+
+    @property
+    def used_bytes(self) -> int:
+        return self._bytes
+
+    @property
+    def pages(self) -> int:
+        return len(self._entries)
+
+    @property
+    def occupancy(self) -> float:
+        return self._bytes / self.budget_bytes if self.budget_bytes else 0.0
+
+    def stats(self) -> dict:
+        return {
+            "pages": len(self._entries),
+            "used_bytes": self._bytes,
+            "budget_bytes": self.budget_bytes,
+            "spilled_pages": self.spilled_pages,
+            "restored_pages": self.restored_pages,
+            "evicted_pages": self.evicted_pages,
+            "corrupt_pages": self.corrupt_pages,
+            "alloc_failures": self.alloc_failures,
+        }
+
+    # -- write side (engine thread) -----------------------------------------
+
+    @staticmethod
+    def _fault(op: str) -> Optional[dict]:
+        from helix_tpu.testing import faults
+
+        inj = faults.active()
+        return inj.host_pool_fault(op) if inj is not None else None
+
+    def put(self, key, arrays: dict, pinned: bool = False) -> bool:
+        """Adopt one page's buffers (device arrays fresh off a gather, or
+        numpy).  Device arrays get ``copy_to_host_async`` issued here so
+        the D2H copy overlaps whatever the engine does next; numpy
+        conversion + checksum happen lazily on first use.  Returns False
+        (and counts ``alloc_failures``) when the page cannot fit."""
+        fault = self._fault("spill")
+        if fault is not None and fault.get("mode") == "alloc_fail":
+            self.alloc_failures += 1
+            return False
+        nbytes = sum(
+            int(a.nbytes) for a in arrays.values() if a is not None
+        )
+        old = self._entries.get(key)
+        if old is not None:
+            self._drop(key)
+        if nbytes > self.budget_bytes or not self._evict_for(nbytes):
+            # a failed RE-spill must not destroy the previously valid
+            # host copy (same digest = same content) — put it back; it
+            # fit before and only evictions happened since
+            if (
+                old is not None
+                and self._bytes + old.nbytes <= self.budget_bytes
+            ):
+                self._entries[key] = old
+                self._bytes += old.nbytes
+            self.alloc_failures += 1
+            return False
+        for a in arrays.values():
+            copy_async = getattr(a, "copy_to_host_async", None)
+            if copy_async is not None:
+                try:
+                    copy_async()
+                except Exception:  # noqa: BLE001 — fallback: lazy blocking fetch
+                    pass
+        self._tick += 1
+        self._entries[key] = _HostPage(key, arrays, nbytes, pinned,
+                                       self._tick)
+        self._bytes += nbytes
+        self._pending.append(key)
+        self.spilled_pages += 1
+        return True
+
+    def drain_pending(self) -> None:
+        """Finalize spills whose async D2H copies have had time to land
+        (called once per engine step): converts the stored device
+        arrays to numpy and stamps checksums, RELEASING the device
+        buffers.  Without this, a cold spilled prefix that is never
+        re-read would pin its HBM gather buffers for the life of the
+        pool — the 'host' tier must not hold device memory beyond ~one
+        step."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        for key in pending:
+            e = self._entries.get(key)
+            if e is not None:
+                self._finalize(e)
+
+    def _evict_for(self, nbytes: int) -> bool:
+        """LRU-drop unpinned entries until ``nbytes`` fit; False when the
+        pinned set alone exceeds the headroom."""
+        while self._bytes + nbytes > self.budget_bytes:
+            victims = [e for e in self._entries.values() if not e.pinned]
+            if not victims:
+                return False
+            victim = min(victims, key=lambda e: e.tick)
+            self._drop(victim.key)
+            self.evicted_pages += 1
+        return True
+
+    def _drop(self, key) -> None:
+        e = self._entries.pop(key, None)
+        if e is not None:
+            self._bytes -= e.nbytes
+
+    def discard(self, key) -> None:
+        """Remove an entry without restore accounting (aborted preempted
+        request, prefix page superseded on device)."""
+        self._drop(key)
+
+    # -- read side (engine thread) ------------------------------------------
+
+    def contains(self, key) -> bool:
+        """Presence check only — never blocks on an in-flight D2H copy
+        (the admission loop chains digests through this every step)."""
+        return key in self._entries
+
+    @staticmethod
+    def _finalize(e: _HostPage) -> None:
+        if e.ready:
+            return
+        e.arrays = {
+            f: (None if a is None else np.asarray(a))
+            for f, a in e.arrays.items()
+        }
+        e.checksum = _page_checksum(e.arrays)
+        e.ready = True
+
+    def get(self, key) -> Optional[dict]:
+        """Fetch one page's host buffers for restore, checksum-verified.
+        Returns None on a miss OR a detected corruption (the entry is
+        dropped and counted — the caller treats it as a cache miss /
+        resume failure, never as usable KV)."""
+        e = self._entries.get(key)
+        if e is None:
+            return None
+        fault = self._fault("restore")
+        if fault is not None:
+            if fault.get("mode") == "slow":
+                time.sleep(float(fault.get("delay", 0.05)))
+            elif fault.get("mode") == "corrupt":
+                self._finalize(e)
+                k = np.array(e.arrays["k"])   # detached copy, then flip
+                k.view(np.uint8).reshape(-1)[0] ^= 0xFF
+                e.arrays = {**e.arrays, "k": k}
+        self._finalize(e)
+        if _page_checksum(e.arrays) != e.checksum:
+            self._drop(key)
+            self.corrupt_pages += 1
+            return None
+        self._tick += 1
+        e.tick = self._tick
+        return e.arrays
+
+    def prefetch(self, key) -> bool:
+        """Start the host->device upload for a page expected to restore
+        soon (admission saw the digest while the request was still
+        queue-blocked): ``jax.device_put`` is async, so the upload
+        overlaps the queue wait and the eventual restore consumes the
+        in-flight handles.  Verification happens here — a corrupt page
+        is dropped now, before any device write."""
+        e = self._entries.get(key)
+        if e is None:
+            return False
+        if e.device is not None:
+            return True
+        arrays = self.get(key)
+        if arrays is None:
+            return False
+        e.device = {
+            f: (None if a is None else jax.device_put(a))
+            for f, a in arrays.items()
+        }
+        return True
+
+    def release_device(self, key) -> None:
+        """Drop a prefetched entry's device handles (the host copy
+        stays).  Prefetch targets HBM — the resource the machine is by
+        definition short of when this tier is active — so uploads whose
+        admission never materialised (request shed, chain truncated)
+        must be let go, not retained until LRU eviction."""
+        e = self._entries.get(key)
+        if e is not None:
+            e.device = None
+
+    def take_restored(self, key) -> Optional[dict]:
+        """Claim a page for device restore: verified buffers (device
+        handles when prefetched, else host numpy), removed from the pool
+        and counted as restored."""
+        e = self._entries.get(key)
+        if e is None:
+            return None
+        if e.device is not None:
+            out = e.device
+        else:
+            out = self.get(key)
+            if out is None:
+                return None
+        self._drop(key)
+        self.restored_pages += 1
+        return out
+
+
+def gather_pages(cache: PagedKVCache, page_ids: list) -> list:
+    """Slice ``page_ids`` out of the device pool as per-page array dicts
+    (``[L, page_size, KVH, D]`` each, scale rows ``[L, page_size, KVH]``
+    when quantized).  One fused gather per field, then cheap per-page
+    slices — the result arrays are fresh buffers, safe to hand to
+    ``HostPagePool.put`` while later steps donate the pool."""
+    idx = jnp.asarray(np.asarray(page_ids, np.int32))
+    k = cache.k_pages[:, idx]
+    v = cache.v_pages[:, idx]
+    ks = cache.k_scale[:, idx] if cache.k_scale is not None else None
+    vs = cache.v_scale[:, idx] if cache.v_scale is not None else None
+    out = []
+    for i in range(len(page_ids)):
+        out.append(
+            {
+                "k": k[:, i],
+                "v": v[:, i],
+                "k_scale": None if ks is None else ks[:, i],
+                "v_scale": None if vs is None else vs[:, i],
+            }
+        )
+    return out
+
+
+@functools.lru_cache(maxsize=32)
+def _build_page_restore_fn(n: int, quantized: bool):
+    """One donated scatter writes ``n`` whole pages back into the pool
+    (host->device restore).  Cached per (bucketed n, storage mode) so
+    restores reuse one executable; padding rows target the garbage
+    page 0."""
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def fn(carry, idx, k_new, v_new, k_sc, v_sc):
+        k_pages = carry[0].at[:, idx].set(k_new)
+        v_pages = carry[1].at[:, idx].set(v_new)
+        if not quantized:
+            return (k_pages, v_pages)
+        return (
+            k_pages,
+            v_pages,
+            carry[2].at[:, idx].set(k_sc),
+            carry[3].at[:, idx].set(v_sc),
+        )
+
+    return fn
+
+
+def restore_pages(
+    cache: PagedKVCache, page_ids: list, entries: list
+) -> PagedKVCache:
+    """Write spilled page contents into freshly allocated device pages.
+
+    ``entries[i]`` (from ``HostPagePool.take_restored``) lands in pool
+    page ``page_ids[i]``.  The batch is bucketed to a power of two
+    (bounded compile shapes, same scheme as chunked prefill) and written
+    by ONE donated scatter; prefetched device handles upload nothing
+    here — ``jnp.stack`` just fuses the already-resident pages."""
+    if not page_ids:
+        return cache
+    n = len(page_ids)
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    idx = np.zeros((bucket,), np.int32)   # padding targets garbage page 0
+    idx[:n] = page_ids
+    quantized = cache.quantized
+
+    def stack(field):
+        parts = [e[field] for e in entries]
+        parts += [jnp.zeros_like(parts[0])] * (bucket - n)
+        return jnp.stack(parts, axis=1)   # [L, bucket, ...]
+
+    k_new = stack("k")
+    v_new = stack("v")
+    k_sc = stack("k_scale") if quantized else None
+    v_sc = stack("v_scale") if quantized else None
+    fn = _build_page_restore_fn(bucket, quantized)
+    carry = fn(cache.carry(), jnp.asarray(idx), k_new, v_new, k_sc, v_sc)
+    return PagedKVCache.from_carry(carry)

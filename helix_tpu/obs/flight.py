@@ -40,6 +40,8 @@ SATURATION_KEYS = (
     "tokens_per_sec",    # generated tokens/s over the trailing window
     "prefix_hit_rate",   # prefix-cache page hit rate, 0..1
     "spec_acceptance_ratio",  # speculative drafts accepted/drafted, 0..1
+    "kv_host_occupancy",  # host KV tier bytes used / budget, 0..1
+    "preempted_requests",  # decoders swapped out, parked for resume
 )
 
 

@@ -29,6 +29,7 @@ from typing import Callable, Optional
 from helix_tpu.engine.engine import Engine, FinishReason, Request
 from helix_tpu.obs import EngineLoopObs, FlightRecorder, RateTracker
 from helix_tpu.obs import trace as obs_trace
+from helix_tpu.obs.flight import SATURATION_KEYS
 
 log = logging.getLogger("helix.engine")
 
@@ -36,6 +37,11 @@ log = logging.getLogger("helix.engine")
 # keep in sync with openai_api._engine_error_response
 QUEUE_FULL = "queue_full"
 SHUTTING_DOWN = "shutting_down"
+# typed KV-exhaustion shed (ISSUE 6): a request that cannot claim pages
+# within the admission deadline — or arrives while admission has already
+# been KV-starved longer than the deadline — gets a clean 503 +
+# Retry-After instead of silently aging in the queue
+KV_EXHAUSTED = "kv_exhausted"
 
 
 @dataclasses.dataclass
@@ -51,7 +57,9 @@ class EngineLoop:
     def __init__(self, engine: Engine, name: str = "engine",
                  max_queue_seconds: float = 600.0,
                  max_queue_depth: Optional[int] = None,
-                 max_queued_tokens: Optional[int] = None):
+                 max_queued_tokens: Optional[int] = None,
+                 admission_timeout: Optional[float] = None,
+                 preempt_stall_seconds: Optional[float] = None):
         self.engine = engine
         self.name = name
         self.max_queue_seconds = max_queue_seconds
@@ -61,6 +69,18 @@ class EngineLoop:
         # prompts can't hide behind a small depth bound.
         self.max_queue_depth = max_queue_depth
         self.max_queued_tokens = max_queued_tokens
+        # KV-pressure degradation ladder (ISSUE 6), rungs from mildest:
+        # spill (engine-internal, always on with a host tier) ->
+        # preempt-by-swap after admission has stalled preempt_stall_
+        # seconds -> typed kv_exhausted shed once a request has waited
+        # admission_timeout (and fast-fail of NEW arrivals while the
+        # engine is that starved).  None disables a rung.
+        self.admission_timeout = admission_timeout
+        self.preempt_stall_seconds = preempt_stall_seconds
+        self._stall_since: Optional[float] = None
+        self._admit_seen = 0            # num_admitted at last progress
+        self._last_preempt_at = 0.0
+        self.kv_exhausted_sheds = 0     # typed 503s issued
         self._inbox: "queue.Queue" = queue.Queue()
         self._pending = 0          # submitted, not yet drained to the engine
         self._pending_tokens = 0
@@ -113,6 +133,8 @@ class EngineLoop:
         err = self._check_admission(prompt_len)
         if err is not None and count_shed:
             self.shed_requests += 1
+            if err.startswith(KV_EXHAUSTED):
+                self.kv_exhausted_sheds += 1
         return err
 
     def queued_tokens(self) -> int:
@@ -127,6 +149,23 @@ class EngineLoop:
     def _check_admission(self, prompt_len: int) -> Optional[str]:
         if self._draining or self._stop.is_set():
             return f"{SHUTTING_DOWN}: engine '{self.name}' is draining"
+        # KV-starved fast-fail: when admission has already been stalled
+        # longer than the deadline, a new arrival would only age out the
+        # same way — reject it NOW, before the HTTP layer commits SSE
+        # headers, so the client gets a real 503 + Retry-After
+        # (_stall_since is written by the engine thread; a float read is
+        # GIL-atomic)
+        stall_since = self._stall_since
+        if (
+            self.admission_timeout is not None
+            and stall_since is not None
+            and time.monotonic() - stall_since > self.admission_timeout
+        ):
+            return (
+                f"{KV_EXHAUSTED}: engine '{self.name}' admission has been "
+                f"KV-starved for {time.monotonic() - stall_since:.1f}s "
+                f"(admission_timeout={self.admission_timeout}s)"
+            )
         # the engine-side sums are read without the admission lock (list
         # copies are GIL-atomic; the bound is advisory by one request
         # anyway), so overloaded submitters don't serialize on an O(n)
@@ -220,6 +259,16 @@ class EngineLoop:
             "kv_pages_peak": getattr(eng.allocator, "peak_used", 0),
             "flight_anomalies": self.flight.anomalies_total,
             "kv_cache_dtype": eng.cache_cfg.dtype,
+            # KV tiering + preemption-by-swap (ISSUE 6)
+            "preemptions": getattr(eng, "num_preemptions", 0),
+            "resumes": getattr(eng, "num_resumes", 0),
+            "preempted_parked": len(getattr(eng, "preempted", ())),
+            "kv_exhausted_sheds": self.kv_exhausted_sheds,
+            "host_pool": (
+                eng.host_pool.stats()
+                if getattr(eng, "host_pool", None) is not None
+                else None
+            ),
         }
 
     def tokens_per_sec(self) -> float:
@@ -238,7 +287,8 @@ class EngineLoop:
         hits = getattr(pc, "hits", 0) if pc is not None else 0
         misses = getattr(pc, "misses", 0) if pc is not None else 0
         denom = hits + misses
-        return {
+        hp = getattr(eng, "host_pool", None)
+        out = {
             "kv_occupancy": round(used / cap, 4),
             "slots_busy": sum(1 for s in eng.slots if s is not None),
             "slots_total": len(eng.slots),
@@ -248,7 +298,16 @@ class EngineLoop:
             "spec_acceptance_ratio": round(
                 getattr(eng, "spec_acceptance_ratio", 0.0), 4
             ),
+            # host KV tier fullness (0 with the tier off) + decoders
+            # currently swapped out awaiting resume
+            "kv_host_occupancy": round(
+                hp.occupancy if hp is not None else 0.0, 4
+            ),
+            "preempted_requests": len(getattr(eng, "preempted", ())),
         }
+        # schema lockstep: this summary IS the per-engine instance of the
+        # shared heartbeat schema — emit exactly its key set
+        return {k: out[k] for k in SATURATION_KEYS}
 
     def start(self):
         self._thread = threading.Thread(
@@ -373,6 +432,119 @@ class EngineLoop:
             if req.finished:
                 self._subscribers.pop(req.id, None)
 
+    def _shed_kv_exhausted(self, req, waited: float) -> None:
+        """Terminal typed shed for one request that outwaited the
+        admission deadline (queued or parked-preempted)."""
+        msg = (
+            f"{KV_EXHAUSTED}: request waited {waited:.1f}s for KV pages "
+            f"(admission_timeout={self.admission_timeout}s) — the engine "
+            "is out of KV capacity; retry later"
+        )
+        self.engine.abort(req.id)
+        self.kv_exhausted_sheds += 1
+        self.shed_requests += 1
+        log.warning(
+            "engine '%s' shedding request_id=%s trace_id=%s: %s",
+            self.name, req.id, req.trace_id or "-", msg,
+            extra={"trace_id": req.trace_id or "", "request_id": req.id},
+        )
+        self._forget_request(req.id)
+        cb = self._subscribers.pop(req.id, None)
+        if cb:
+            cb(
+                TokenEvent(
+                    request_id=req.id, token_id=-1, finished=True,
+                    finish_reason="error", error=msg,
+                )
+            )
+
+    def _memory_pressure_tick(self) -> None:
+        """The graceful-degradation ladder, walked once per loop pass.
+
+        Tracks how long admission has been KV-starved (queue non-empty
+        with no admissions or resumes landing).  Past
+        ``preempt_stall_seconds``, swap out the newest/largest decoder
+        (``Engine.preempt_for_pressure``) so the starved queue gets its
+        pages — bounded to one preemption per stall window.  Past
+        ``admission_timeout``, requests stop aging silently: queued and
+        parked requests over the deadline get the typed ``kv_exhausted``
+        shed."""
+        eng = self.engine
+        now = time.monotonic()
+        progress = eng.num_admitted + getattr(eng, "num_resumes", 0)
+        waiting = list(eng.waiting)
+        if progress != self._admit_seen:
+            self._admit_seen = progress
+            self._stall_since = now if waiting else None
+        elif not waiting:
+            self._stall_since = None
+        elif self._stall_since is None:
+            self._stall_since = now
+        if self.admission_timeout is not None:
+            # queued sheds require the STALL ITSELF to have outlived the
+            # deadline (same criterion as the fast-fail path): a request
+            # aging in a merely throughput-bound queue — admissions still
+            # landing, so the stall clock keeps resetting — is ordinary
+            # latency, not KV exhaustion, and labelling it kv_exhausted
+            # would misdirect both the client's retry and the operator's
+            # capacity read
+            if (
+                self._stall_since is not None
+                and now - self._stall_since > self.admission_timeout
+            ):
+                for r in waiting:
+                    waited = now - r.submit_time
+                    if not r.finished and waited > self.admission_timeout:
+                        self._shed_kv_exhausted(r, waited)
+            # a parked decoder that cannot re-acquire pages IS KV
+            # pressure by construction (resume is retried every step),
+            # so its deadline is unconditional
+            for st in list(getattr(eng, "preempted", ())):
+                waited = now - st.preempted_at
+                if not st.req.finished and waited > self.admission_timeout:
+                    self._shed_kv_exhausted(st.req, waited)
+        if (
+            self.preempt_stall_seconds is not None
+            and self._stall_since is not None
+            and now - self._stall_since > self.preempt_stall_seconds
+            and now - self._last_preempt_at > self.preempt_stall_seconds
+        ):
+            victim = self.engine.preempt_for_pressure()
+            if victim is not None:
+                self._last_preempt_at = now
+                log.warning(
+                    "engine '%s' admission KV-starved for %.1fs: "
+                    "preempted request_id=%s (swap-to-host)",
+                    self.name, now - self._stall_since, victim,
+                )
+
+    def _deliver_resume_failures(self) -> None:
+        """Typed error events for parked requests whose swap-in failed
+        verification (corrupt host copy) — detected inside the engine,
+        surfaced to the subscriber here."""
+        drain = getattr(self.engine, "drain_resume_failures", None)
+        if drain is None:
+            return
+        for req, msg in drain():
+            log.warning(
+                "engine '%s' resume failed for request_id=%s: %s",
+                self.name, req.id, msg,
+                extra={"trace_id": req.trace_id or "",
+                       "request_id": req.id},
+            )
+            self.flight.note_anomaly(
+                "resume_corrupt", request_id=req.id, detail=msg[:200]
+            )
+            self._forget_request(req.id)
+            cb = self._subscribers.pop(req.id, None)
+            if cb:
+                cb(
+                    TokenEvent(
+                        request_id=req.id, token_id=-1, finished=True,
+                        finish_reason="error", error=msg,
+                    )
+                )
+
     def _step_once(self):
         """One engine step, with the (normally disabled) fault-injection
         hook in front so chaos tests can poison specific requests."""
@@ -392,6 +564,7 @@ class EngineLoop:
         """Counter snapshot taken just before a step so the per-step
         record carries deltas, not lifetime totals."""
         eng = self.engine
+        hp = getattr(eng, "host_pool", None)
         return (
             eng.num_prefill_tokens,
             getattr(eng, "num_prefill_padding_tokens", 0),
@@ -400,6 +573,10 @@ class EngineLoop:
             self.quarantine_evictions,
             getattr(eng, "num_spec_drafted_tokens", 0),
             getattr(eng, "num_spec_accepted_tokens", 0),
+            hp.spilled_pages if hp is not None else 0,
+            hp.restored_pages if hp is not None else 0,
+            getattr(eng, "num_preemptions", 0),
+            getattr(eng, "num_resumes", 0),
         )
 
     def _flight_record(
@@ -407,7 +584,8 @@ class EngineLoop:
         failed: Optional[str] = None,
     ) -> None:
         eng = self.engine
-        p0, pad0, d0, a0, q0, sd0, sa0 = pre
+        p0, pad0, d0, a0, q0, sd0, sa0, sp0, rs0, pe0, re0 = pre
+        hp = getattr(eng, "host_pool", None)
         prefill = eng.num_prefill_tokens - p0
         decode = eng.num_decode_tokens - d0
         if failed is not None:
@@ -446,6 +624,17 @@ class EngineLoop:
             "spec_accepted": (
                 getattr(eng, "num_spec_accepted_tokens", 0) - sa0
             ),
+            # KV tiering this step: pages demoted/promoted across the
+            # host tier, decoders swapped out/in, host-pool fullness
+            "spilled_pages": (
+                (hp.spilled_pages - sp0) if hp is not None else 0
+            ),
+            "restored_pages": (
+                (hp.restored_pages - rs0) if hp is not None else 0
+            ),
+            "preemptions": getattr(eng, "num_preemptions", 0) - pe0,
+            "resumes": getattr(eng, "num_resumes", 0) - re0,
+            "host_pool_pages": hp.pages if hp is not None else 0,
         }
         if failed is not None:
             rec["anomaly"] = "step_failure"
@@ -477,6 +666,7 @@ class EngineLoop:
                                 error="request timed out in queue",
                             )
                         )
+            self._memory_pressure_tick()
             if not self.engine.has_work():
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
@@ -519,6 +709,7 @@ class EngineLoop:
             self._barren_rounds = 0
             self.steps += 1
             self._emit(emitted)
+            self._deliver_resume_failures()
             self._flight_record(dt_step, flight_pre, generated=len(emitted))
         # terminal sweep: anything still in the inbox (raced a shutdown)
         # gets a clean error event instead of a 300s client hang

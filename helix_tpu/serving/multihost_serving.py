@@ -163,6 +163,15 @@ class LockstepLeader:
         self._pending_aborts = []
         return self.engine.step()
 
+    def preempt_for_pressure(self):
+        """Preemption-by-swap is a leader-LOCAL scheduling move the
+        journal does not replicate: followers would keep decoding the
+        parked victim and their per-step emissions would diverge from
+        the leader's.  Disabled under lockstep — the degradation ladder
+        falls through to the typed kv_exhausted shed (which replicates
+        as an explicit abort)."""
+        return None
+
     # -- passthrough --------------------------------------------------------
     def __getattr__(self, name):
         return getattr(self.engine, name)

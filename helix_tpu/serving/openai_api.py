@@ -27,7 +27,11 @@ from helix_tpu import obs
 from helix_tpu.engine.engine import Request
 from helix_tpu.engine.sampling import SamplingParams
 from helix_tpu.obs.trace import TRACE_HEADER
-from helix_tpu.serving.engine_loop import QUEUE_FULL, SHUTTING_DOWN
+from helix_tpu.serving.engine_loop import (
+    KV_EXHAUSTED,
+    QUEUE_FULL,
+    SHUTTING_DOWN,
+)
 from helix_tpu.serving.registry import ModelRegistry
 from helix_tpu.serving.tokenizer import IncrementalDetokenizer, _content_text
 
@@ -54,11 +58,13 @@ def _longpoll_pool():
 
 def _error(status: int, message: str, etype: str = "invalid_request_error",
            headers: Optional[dict] = None, trace_id: str = "",
-           request_id: str = ""):
+           request_id: str = "", code: str = ""):
     """Structured error body.  When a trace id is known it rides both the
     body and the response header, so a failing request can be correlated
     from the client straight to runner logs and /v1/debug/traces."""
     err: dict = {"message": message, "type": etype}
+    if code:
+        err["code"] = code
     if trace_id:
         err["trace_id"] = trace_id
         headers = {**(headers or {}), TRACE_HEADER: trace_id}
@@ -86,6 +92,13 @@ def _engine_error_response(e: Exception, trace_id: str = ""):
         return _error(429, msg, "overloaded_error",
                       headers={"Retry-After": "1"}, trace_id=trace_id,
                       request_id=rid)
+    if msg.startswith(KV_EXHAUSTED):
+        # typed KV-exhaustion shed (ISSUE 6): the engine is out of KV
+        # pages and the request outwaited (or would outwait) the
+        # admission deadline — clean 503 + Retry-After, code kv_exhausted
+        return _error(503, msg, "overloaded_error",
+                      headers={"Retry-After": "2"}, trace_id=trace_id,
+                      request_id=rid, code="kv_exhausted")
     if msg.startswith(SHUTTING_DOWN):
         return _error(503, msg, "overloaded_error",
                       headers={"Retry-After": "5"}, trace_id=trace_id,
@@ -384,6 +397,50 @@ class OpenAIServer:
         c.counter(
             "helix_flight_anomalies_total",
             m.loop.flight.anomalies_total, lbl,
+        )
+        # KV tiering + preemption-by-swap (ISSUE 6): host-tier traffic
+        # and fullness, swap-out/swap-in counts, parked decoders, typed
+        # kv_exhausted sheds, cumulative restore time
+        hp = getattr(eng, "host_pool", None)
+        if hp is not None:
+            c.counter("helix_kv_spilled_pages_total", hp.spilled_pages, lbl)
+            c.counter(
+                "helix_kv_restored_pages_total", hp.restored_pages, lbl
+            )
+            c.counter(
+                "helix_kv_host_evicted_pages_total", hp.evicted_pages, lbl
+            )
+            c.counter(
+                "helix_kv_host_corrupt_pages_total", hp.corrupt_pages, lbl
+            )
+            c.counter(
+                "helix_kv_host_alloc_failures_total", hp.alloc_failures,
+                lbl,
+            )
+            c.gauge("helix_kv_host_pool_pages", hp.pages, lbl)
+            c.gauge("helix_kv_host_pool_used_bytes", hp.used_bytes, lbl)
+            c.gauge(
+                "helix_kv_host_pool_budget_bytes", hp.budget_bytes, lbl
+            )
+            c.gauge("helix_kv_host_occupancy_ratio", hp.occupancy, lbl)
+            c.counter(
+                "helix_kv_restore_seconds_total",
+                getattr(eng, "restore_seconds", 0.0), lbl,
+            )
+        c.counter(
+            "helix_preemptions_total",
+            getattr(eng, "num_preemptions", 0), lbl,
+        )
+        c.counter(
+            "helix_resumes_total", getattr(eng, "num_resumes", 0), lbl
+        )
+        c.gauge(
+            "helix_preempted_requests",
+            len(getattr(eng, "preempted", ())), lbl,
+        )
+        c.counter(
+            "helix_kv_exhausted_sheds_total",
+            getattr(m.loop, "kv_exhausted_sheds", 0), lbl,
         )
         peak = self._peak_flops()
         if peak > 0:

@@ -25,6 +25,9 @@ Rule shapes (dicts, JSON-friendly for the env var)::
     {"point": "dispatch", "runner": "r2", "mode": "slow_first_byte",
      "delay": 0.5}
     {"point": "heartbeat", "runner": "r1"}          # drop heartbeats
+    {"point": "host_pool", "op": "restore", "mode": "slow", "delay": 0.2}
+    {"point": "host_pool", "op": "restore", "mode": "corrupt", "times": 1}
+    {"point": "host_pool", "op": "spill", "mode": "alloc_fail", "p": 0.5}
 
 ``times`` caps how often a rule fires (omit for unlimited); ``p`` gates
 each match through the seeded RNG (omit for always).
@@ -43,6 +46,11 @@ from typing import Optional
 ENV_VAR = "HELIX_FAULTS"
 
 DISPATCH_MODES = ("connect_error", "http_500", "slow_first_byte")
+
+# host KV tier (ISSUE 6): slow restore models a saturated host<->device
+# link, corrupt flips a byte so the checksum path must catch it, and
+# alloc_fail models host-RAM pressure rejecting a spill
+HOST_POOL_MODES = ("slow", "corrupt", "alloc_fail")
 
 
 class FaultInjected(RuntimeError):
@@ -152,6 +160,30 @@ class FaultInjector:
                     "mode": rule.get("mode", "connect_error"),
                     "delay": float(rule.get("delay", 0.0)),
                     "runner": runner_id,
+                }
+        return None
+
+    def host_pool_fault(self, op: str) -> Optional[dict]:
+        """Return the fault to apply to one host-pool operation, or None.
+
+        ``op`` is ``"spill"`` (HostPagePool.put) or ``"restore"``
+        (get/prefetch/take_restored).  The pool turns ``slow`` into a
+        ``delay``-second sleep before the restore, ``corrupt`` into a
+        flipped byte in the fetched buffers (the checksum MUST catch it
+        — detection is the contract under test), and ``alloc_fail`` into
+        a rejected spill (the page is simply lost, as under real host-RAM
+        pressure)."""
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "host_pool":
+                    continue
+                if rule.get("op", "*") not in ("*", op):
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                return {
+                    "mode": rule.get("mode", "slow"),
+                    "delay": float(rule.get("delay", 0.05)),
                 }
         return None
 

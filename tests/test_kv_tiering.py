@@ -1,0 +1,490 @@
+"""KV tiering to host RAM (ISSUE 6): spill/restore, preemption-by-swap.
+
+The correctness contracts this file pins:
+
+- **Exact resume**: preempting a SEEDED temp>0 generation mid-decode
+  (with presence/frequency penalties live, so the device-evolved RNG key
+  stream AND output-token histogram both matter), running other traffic,
+  then resuming produces a continuation bit-identical to an unpreempted
+  run — the oracle-bit-identity recipe of ``tests/test_spec_decode.py``
+  applied to the swap path.
+- **Spill -> restore round trip**: prefix pages evicted to the host tier
+  and restored for a later prompt give the same greedy output as a
+  fresh prefill, under float32 AND int8 KV storage (int8 spills raw
+  codes + scale rows, so the round trip is bit-exact in the stored
+  representation).
+- **PageAllocator invariants**: ``used + free == capacity`` after every
+  operation of a random allocate/free/detach/give_back churn; double
+  free and double give_back raise instead of corrupting the free list;
+  a failing allocate changes nothing.
+- **HostPagePool**: byte budget enforced by LRU over unpinned entries
+  only, pinned (preempted) pages never evicted, checksum corruption
+  detected and surfaced as a miss, fault-injection hooks honoured.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(scope="module")
+def tiny_parts():
+    import jax
+
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    return cfg, params, tok
+
+
+def _mk_engine(tiny_parts, host_pool_bytes=1 << 22, **kw):
+    from helix_tpu.engine.engine import Engine, EngineConfig
+
+    cfg, params, tok = tiny_parts
+    defaults = dict(
+        max_decode_batch=4, page_size=4, num_pages=64,
+        max_pages_per_seq=16, max_prefill_len=64,
+        attn_backend="reference", eos_token_ids=tok.eos_ids,
+        host_pool_bytes=host_pool_bytes,
+    )
+    defaults.update(kw)
+    return Engine(cfg, params, EngineConfig(**defaults))
+
+
+def _req(rid, prompt, **samp):
+    from helix_tpu.engine.engine import Request
+    from helix_tpu.engine.sampling import SamplingParams
+
+    return Request(
+        id=rid, prompt_tokens=list(prompt),
+        sampling=SamplingParams(**samp), stop_token_ids=(1,),
+    )
+
+
+def _run(eng, req):
+    eng.add_request(req)
+    while eng.has_work():
+        eng.step()
+    return list(req.output_tokens)
+
+
+# ---------------------------------------------------------------------------
+# spill -> restore round trip
+# ---------------------------------------------------------------------------
+
+
+class TestSpillRestore:
+    # int8 variant slow-marked: the spill path is storage-agnostic (raw
+    # codes + scale rows spill as-is) and the int8 axis keeps a faster
+    # tier-1 sibling in TestExactResume's int8 parametrization
+    @pytest.mark.parametrize(
+        "kv_dtype",
+        ["auto", pytest.param("int8", marks=pytest.mark.slow)],
+    )
+    def test_evicted_prefix_restores_with_greedy_parity(
+        self, tiny_parts, kv_dtype
+    ):
+        eng = _mk_engine(tiny_parts, kv_cache_dtype=kv_dtype)
+        sys_prompt = list(range(4, 24)) + [30, 31]   # 5 shareable pages
+        ref = _run(
+            eng, _req("a", sys_prompt, max_tokens=6, temperature=0.0)
+        )
+        cached = eng.prefix_cache.stats["pages"]
+        assert cached >= 1
+        # force the adopted pages out: the host tier must receive them
+        assert eng._ensure_pages(eng.allocator.free_pages + cached)
+        assert eng.host_pool.pages >= cached
+        assert eng.host_pool.spilled_pages >= cached
+        assert eng.prefix_cache.stats["pages"] == 0
+        # same prompt again: restored from host, not re-prefilled
+        r2 = _req("b", sys_prompt, max_tokens=6, temperature=0.0)
+        out2 = _run(eng, r2)
+        assert r2.cached_tokens >= 4 * cached
+        assert out2 == ref
+        assert eng.host_pool.restored_pages >= cached
+        # restored pages were re-adopted: a third request hits in HBM
+        r3 = _req("c", sys_prompt, max_tokens=6, temperature=0.0)
+        hits_before = eng.prefix_cache.hits
+        out3 = _run(eng, r3)
+        assert out3 == ref
+        assert eng.prefix_cache.hits > hits_before
+
+    def test_prefetch_overlaps_wait_then_claim_consumes(self, tiny_parts):
+        eng = _mk_engine(tiny_parts)
+        sys_prompt = list(range(4, 24)) + [40]
+        ref = _run(
+            eng, _req("a", sys_prompt, max_tokens=4, temperature=0.0)
+        )
+        cached = eng.prefix_cache.stats["pages"]
+        assert eng._ensure_pages(eng.allocator.free_pages + cached)
+        # simulate the admission loop's blocked-head prefetch, then claim
+        r2 = _req("b", sys_prompt, max_tokens=4, temperature=0.0)
+        eng._prefetch_host_prefix(r2)
+        out2 = _run(eng, r2)
+        assert r2.cached_tokens >= 4 * cached
+        assert out2 == ref
+
+    def test_alloc_fail_fault_degrades_to_plain_eviction(self, tiny_parts):
+        from helix_tpu.testing import faults
+
+        eng = _mk_engine(tiny_parts)
+        sys_prompt = list(range(4, 24))
+        _run(eng, _req("a", sys_prompt, max_tokens=4, temperature=0.0))
+        cached = eng.prefix_cache.stats["pages"]
+        faults.arm(
+            seed=1,
+            rules=[{"point": "host_pool", "op": "spill",
+                    "mode": "alloc_fail"}],
+        )
+        try:
+            assert eng._ensure_pages(eng.allocator.free_pages + cached)
+            # nothing spilled, pages still freed — seed behaviour
+            assert eng.host_pool.pages == 0
+            assert eng.host_pool.alloc_failures >= cached
+        finally:
+            faults.disarm()
+
+    def test_slow_restore_fault_still_correct(self, tiny_parts):
+        from helix_tpu.testing import faults
+
+        eng = _mk_engine(tiny_parts)
+        sys_prompt = list(range(4, 24)) + [50]
+        ref = _run(
+            eng, _req("a", sys_prompt, max_tokens=4, temperature=0.0)
+        )
+        cached = eng.prefix_cache.stats["pages"]
+        assert eng._ensure_pages(eng.allocator.free_pages + cached)
+        faults.arm(
+            seed=1,
+            rules=[{"point": "host_pool", "op": "restore",
+                    "mode": "slow", "delay": 0.02}],
+        )
+        try:
+            r2 = _req("b", sys_prompt, max_tokens=4, temperature=0.0)
+            assert _run(eng, r2) == ref
+            assert r2.cached_tokens > 0
+        finally:
+            faults.disarm()
+
+    def test_corrupt_prefix_restore_is_a_miss_not_wrong_kv(
+        self, tiny_parts
+    ):
+        from helix_tpu.testing import faults
+
+        eng = _mk_engine(tiny_parts)
+        sys_prompt = list(range(4, 24)) + [60]
+        ref = _run(
+            eng, _req("a", sys_prompt, max_tokens=4, temperature=0.0)
+        )
+        cached = eng.prefix_cache.stats["pages"]
+        assert eng._ensure_pages(eng.allocator.free_pages + cached)
+        faults.arm(
+            seed=1,
+            rules=[{"point": "host_pool", "op": "restore",
+                    "mode": "corrupt", "times": 1}],
+        )
+        try:
+            r2 = _req("b", sys_prompt, max_tokens=4, temperature=0.0)
+            out2 = _run(eng, r2)
+            # the corrupted page fell out of the chain (counted), and the
+            # remainder re-prefilled — output still correct
+            assert out2 == ref
+            assert eng.host_pool.corrupt_pages >= 1
+        finally:
+            faults.disarm()
+
+
+# ---------------------------------------------------------------------------
+# preemption-by-swap: exact resume
+# ---------------------------------------------------------------------------
+
+
+class TestExactResume:
+    @pytest.mark.parametrize("kv_dtype", ["auto", "int8"])
+    def test_seeded_temp_generation_bit_identical_across_swap(
+        self, tiny_parts, kv_dtype
+    ):
+        """The acceptance bar: preempt a seeded temp>0 generation
+        mid-decode (penalties live), run an interloper while parked,
+        resume — the continuation is bit-identical to an unpreempted
+        run."""
+        samp = dict(
+            max_tokens=12, temperature=0.9, seed=123,
+            presence_penalty=0.5, frequency_penalty=0.3,
+        )
+        ref = _run(
+            _mk_engine(tiny_parts, kv_cache_dtype=kv_dtype),
+            _req("ref", [7] * 6, **samp),
+        )
+        eng = _mk_engine(tiny_parts, kv_cache_dtype=kv_dtype)
+        rp = _req("pre", [7] * 6, **samp)
+        eng.add_request(rp)
+        while len(rp.output_tokens) < 5:
+            eng.step()
+        assert eng.preempt(rp.id)
+        assert rp.slot is None
+        assert len(eng.preempted) == 1
+        assert eng.host_pool.pages >= 1   # private pages parked on host
+        # an interloper claims pages + advances the engine RNG counter
+        # while the victim is parked — neither may perturb the resume
+        mid = _req("mid", [9] * 5, max_tokens=3)
+        eng.add_request(mid)
+        while not rp.finished:
+            eng.step()
+        assert rp.output_tokens == ref
+        assert mid.finished
+        assert eng.num_preemptions == 1
+        assert eng.num_resumes == 1
+
+    def test_greedy_bit_identical_across_swap(self, tiny_parts):
+        ref = _run(
+            _mk_engine(tiny_parts),
+            _req("ref", list(range(4, 12)), max_tokens=16,
+                 temperature=0.0),
+        )
+        eng = _mk_engine(tiny_parts)
+        rp = _req("pre", list(range(4, 12)), max_tokens=16,
+                  temperature=0.0)
+        eng.add_request(rp)
+        while len(rp.output_tokens) < 4:
+            eng.step()
+        assert eng.preempt(rp.id)
+        while not rp.finished:
+            eng.step()
+        assert rp.output_tokens == ref
+
+    def test_preempt_gates(self, tiny_parts):
+        # no host tier -> preemption unavailable
+        eng0 = _mk_engine(tiny_parts, host_pool_bytes=0)
+        r = _req("r", [5] * 4, max_tokens=8)
+        eng0.add_request(r)
+        eng0.step()
+        assert eng0.host_pool is None
+        assert not eng0.preempt(r.id)
+        # unknown / queued / finished requests are not preemptible
+        eng = _mk_engine(tiny_parts)
+        assert not eng.preempt("nope")
+        q = _req("q", [5] * 4, max_tokens=2)
+        eng._requests[q.id] = q   # queued, no slot
+        assert not eng.preempt(q.id)
+
+    def test_abort_while_parked_cleans_host_copies(self, tiny_parts):
+        eng = _mk_engine(tiny_parts)
+        rp = _req("pre", [7] * 6, max_tokens=40, temperature=0.0)
+        eng.add_request(rp)
+        while len(rp.output_tokens) < 3:
+            eng.step()
+        assert eng.preempt(rp.id)
+        parked_pages = eng.host_pool.pages
+        assert parked_pages >= 1
+        eng.abort(rp.id)
+        assert rp.finished
+        assert not eng.preempted
+        assert eng.host_pool.pages < parked_pages
+        # pool stays consistent for further traffic
+        out = _run(eng, _req("after", [9] * 4, max_tokens=3))
+        assert out
+
+
+# ---------------------------------------------------------------------------
+# PageAllocator invariants
+# ---------------------------------------------------------------------------
+
+
+class TestAllocatorInvariants:
+    def test_churn_preserves_used_plus_free(self):
+        from helix_tpu.engine.kv_cache import PageAllocator
+
+        alloc = PageAllocator(num_pages=64, max_pages_per_seq=16)
+        capacity = 64 - 1   # garbage page 0 outside both sides
+        rng = random.Random(7)
+        live: dict = {}      # seq -> owned count
+        detached: list = []  # pages owned by "the cache" (spill targets)
+
+        def check():
+            assert alloc.used_pages + alloc.free_pages == capacity
+            assert alloc.used_pages >= 0 and alloc.free_pages >= 0
+
+        for i in range(600):
+            op = rng.randrange(4)
+            if op == 0:   # allocate
+                sid = f"s{rng.randrange(20)}"
+                n = rng.randrange(1, 6)
+                try:
+                    got = alloc.allocate(sid, n)
+                    assert len(got) == n
+                    live[sid] = live.get(sid, 0) + n
+                except MemoryError:
+                    pass   # full pool / per-seq cap: state unchanged
+            elif op == 1 and live:   # free
+                sid = rng.choice(list(live))
+                alloc.free(sid)
+                del live[sid]
+            elif op == 2 and live:   # detach (cache adoption = spill prep)
+                sid = rng.choice(list(live))
+                pages = alloc.seq_pages(sid)
+                if pages:
+                    take = pages[: rng.randrange(1, len(pages) + 1)]
+                    alloc.detach(sid, take)
+                    detached.extend(take)
+                    live[sid] -= len(take)
+                    if live[sid] == 0:
+                        alloc.free(sid)   # frees the empty remainder
+                        del live[sid]
+            elif op == 3 and detached:   # give_back (eviction/spill)
+                n = rng.randrange(1, len(detached) + 1)
+                back, detached = detached[:n], detached[n:]
+                alloc.give_back(back)
+            check()
+
+    def test_double_free_raises(self):
+        from helix_tpu.engine.kv_cache import PageAllocator
+
+        alloc = PageAllocator(num_pages=16, max_pages_per_seq=8)
+        alloc.allocate("a", 2)
+        alloc.free("a")
+        with pytest.raises(KeyError):
+            alloc.free("a")
+        with pytest.raises(KeyError):
+            alloc.free("never-allocated")
+
+    def test_double_give_back_raises(self):
+        from helix_tpu.engine.kv_cache import PageAllocator
+
+        alloc = PageAllocator(num_pages=16, max_pages_per_seq=8)
+        pages = alloc.allocate("a", 2)
+        alloc.detach("a", pages)
+        alloc.give_back(pages)
+        with pytest.raises(ValueError):
+            alloc.give_back(pages)
+
+    def test_failing_allocate_changes_nothing(self):
+        from helix_tpu.engine.kv_cache import PageAllocator
+
+        alloc = PageAllocator(num_pages=16, max_pages_per_seq=4)
+        alloc.allocate("a", 3)
+        used, free = alloc.used_pages, alloc.free_pages
+        # per-seq cap exceeded: full failure, no orphaned pages
+        with pytest.raises(MemoryError):
+            alloc.allocate("a", 2)
+        assert (alloc.used_pages, alloc.free_pages) == (used, free)
+        assert len(alloc.seq_pages("a")) == 3
+        # pool exhaustion: same contract
+        with pytest.raises(MemoryError):
+            alloc.allocate("b", 15)
+        assert (alloc.used_pages, alloc.free_pages) == (used, free)
+        assert not alloc.owns("b")
+
+    @pytest.mark.slow
+    def test_engine_churn_invariant_with_tiering(self, tiny_parts):
+        """used + free == capacity holds after EVERY engine step of a
+        workload that spills, restores, preempts and resumes.  Slow
+        lane: the allocator-level churn above and the memory-pressure
+        chaos lane keep the fast-tier coverage."""
+        eng = _mk_engine(tiny_parts, num_pages=33, max_pages_per_seq=24,
+                         max_prefill_len=8)
+        capacity = 33 - 1
+        hog = _req("hog", list(range(4, 12)), max_tokens=60,
+                   temperature=0.0)
+        eng.add_request(hog)
+        steps = 0
+        preempted = False
+        while eng.has_work():
+            eng.step()
+            steps += 1
+            assert (
+                eng.allocator.used_pages + eng.allocator.free_pages
+                == capacity
+            ), f"invariant broken at step {steps}"
+            if not preempted and len(hog.output_tokens) >= 3:
+                assert eng.preempt(hog.id)
+                preempted = True
+                for i in range(3):
+                    eng.add_request(
+                        _req(f"m{i}", [30 + 9 * i + j for j in range(8)],
+                             max_tokens=10, temperature=0.0)
+                    )
+        assert hog.finished and eng.num_resumes == 1
+
+
+# ---------------------------------------------------------------------------
+# HostPagePool unit behaviour
+# ---------------------------------------------------------------------------
+
+
+def _page(seed, shape=(2, 4, 2, 8)):
+    rng = np.random.default_rng(seed)
+    return {
+        "k": rng.standard_normal(shape, dtype=np.float32),
+        "v": rng.standard_normal(shape, dtype=np.float32),
+        "k_scale": None,
+        "v_scale": None,
+    }
+
+
+class TestHostPagePool:
+    def test_budget_lru_evicts_unpinned_only(self):
+        from helix_tpu.engine.kv_cache import HostPagePool
+
+        one = _page(0)
+        page_bytes = sum(
+            a.nbytes for a in one.values() if a is not None
+        )
+        pool = HostPagePool(budget_bytes=page_bytes * 3)
+        assert pool.put("pin", _page(1), pinned=True)
+        assert pool.put("a", _page(2))
+        assert pool.put("b", _page(3))
+        assert pool.put("c", _page(4))   # evicts LRU unpinned: "a"
+        assert not pool.contains("a")
+        assert pool.contains("pin") and pool.contains("b")
+        assert pool.evicted_pages == 1
+        # pinned entries alone over budget: put fails, counted
+        pool2 = HostPagePool(budget_bytes=page_bytes)
+        assert pool2.put("p1", _page(5), pinned=True)
+        assert not pool2.put("p2", _page(6), pinned=True)
+        assert pool2.alloc_failures == 1
+
+    def test_checksum_detects_mutation(self):
+        from helix_tpu.engine.kv_cache import HostPagePool
+
+        pool = HostPagePool(budget_bytes=1 << 20)
+        page = _page(0)
+        assert pool.put("x", page)
+        assert pool.get("x") is not None   # finalizes + verifies
+        # mutate the stored buffer behind the pool's back
+        entry = pool._entries["x"]
+        entry.arrays["k"].reshape(-1)[0] += 1.0
+        assert pool.get("x") is None
+        assert pool.corrupt_pages == 1
+        assert not pool.contains("x")
+
+    def test_take_restored_counts_and_removes(self):
+        from helix_tpu.engine.kv_cache import HostPagePool
+
+        pool = HostPagePool(budget_bytes=1 << 20)
+        page = _page(0)
+        assert pool.put("x", page)
+        got = pool.take_restored("x")
+        assert got is not None
+        np.testing.assert_array_equal(got["k"], page["k"])
+        assert pool.restored_pages == 1
+        assert not pool.contains("x")
+        assert pool.used_bytes == 0
+
+    def test_prefetch_serves_device_handles(self):
+        from helix_tpu.engine.kv_cache import HostPagePool
+
+        pool = HostPagePool(budget_bytes=1 << 20)
+        page = _page(0)
+        assert pool.put("x", page)
+        assert pool.prefetch("x")
+        got = pool.take_restored("x")
+        assert got is not None
+        np.testing.assert_array_equal(np.asarray(got["k"]), page["k"])

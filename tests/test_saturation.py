@@ -334,6 +334,7 @@ class TestClusterFederation:
             "kv_occupancy": 0.25, "slots_busy": 2, "slots_total": 8,
             "queue_depth": 1, "tokens_per_sec": 123.5,
             "prefix_hit_rate": 0.5, "spec_acceptance_ratio": 0.4,
+            "kv_host_occupancy": 0.1, "preempted_requests": 0,
         }
         sat.update(overrides)
         r = requests.post(

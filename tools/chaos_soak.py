@@ -136,16 +136,170 @@ def run_soak(seconds: float = 10.0, seed: int = 42,
     }
 
 
+# the system-prompt-heavy fleet shape (ISSUE 6's headline workload):
+# every soak request opens with one of two fixed 16-token system
+# prefixes (4 full shareable pages each), so pages evicted-and-spilled
+# under pressure get RESTORED for later arrivals instead of re-prefilled
+_MEM_SOAK_PREFIXES = (
+    [20 + j for j in range(16)],
+    [60 + j for j in range(16)],
+)
+
+
+def run_memory_pressure(seconds: float = 10.0, seed: int = 42) -> dict:
+    """ISSUE 6 scenario: a page pool sized WELL below sustained demand
+    (long prompts, generous token budgets), host tier + the full
+    degradation ladder armed.  Traffic keeps admission KV-starved, so
+    the loop must spill prefix pages, preempt-by-swap running decoders,
+    and shed the over-deadline tail with typed kv_exhausted errors.
+    Exit contract: zero stuck requests, every outcome terminal
+    (finish / kv_exhausted / queue_full), the engine healthy afterwards,
+    and the spill/restore counters actually moving."""
+    import jax
+
+    from helix_tpu.engine.engine import Engine, EngineConfig, Request
+    from helix_tpu.engine.sampling import SamplingParams
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    engine = Engine(
+        cfg, params,
+        EngineConfig(
+            # 32 allocatable pages of 4 tokens: ~2.5 concurrent requests
+            # worth of KV for a 4-slot batch under the traffic below
+            max_decode_batch=4, page_size=4, num_pages=33,
+            max_pages_per_seq=24, max_prefill_len=32,
+            attn_backend="reference", eos_token_ids=tok.eos_ids,
+            host_pool_bytes=1 << 22,
+        ),
+    )
+    # compile every shape the traffic can hit BEFORE the timed window —
+    # an 8-second soak must measure the ladder, not the XLA compile
+    # wave: generic warmup, then one full request per system prefix
+    # (below) so the packed-prefill bucket AND the prefix-hit chunk
+    # shape are both hot
+    engine.warmup()
+    for i, prefix in enumerate(_MEM_SOAK_PREFIXES):
+        engine.add_request(
+            Request(
+                id=f"shape-warm-{i}",
+                prompt_tokens=prefix + [100 + j for j in range(4)],
+                sampling=SamplingParams(max_tokens=4),
+                stop_token_ids=tok.eos_ids,
+            )
+        )
+        while engine.has_work():
+            engine.step()
+    loop = EngineLoop(
+        engine, "mem-soak", max_queue_seconds=30.0,
+        max_queue_depth=32, max_queued_tokens=4096,
+        admission_timeout=3.0, preempt_stall_seconds=0.1,
+    ).start()
+
+    rng = random.Random(seed)
+    outcomes: dict[str, str] = {}
+    terminal: dict[str, bool] = {}
+
+    def on_event_for(rid):
+        def on_event(ev):
+            if ev.finished:
+                terminal[rid] = True
+                outcomes[rid] = (
+                    "error:" + ev.error.split(":")[0]
+                    if ev.error
+                    else (ev.finish_reason or "stop")
+                )
+        return on_event
+
+    t0 = time.monotonic()
+    n = 0
+    try:
+        while time.monotonic() - t0 < seconds:
+            n += 1
+            rid = f"mem-{n}"
+            # every ~4th request is a hog (large token budget -> large
+            # page claim); the rest are short interactive shapes.  The
+            # random TAIL varies content, not length — constant shapes
+            # keep the run compile-free after the warmers above
+            hog = n % 4 == 0
+            req = Request(
+                id=rid,
+                prompt_tokens=_MEM_SOAK_PREFIXES[n % 2]
+                + [rng.randrange(4, 260) for _ in range(4)],
+                sampling=SamplingParams(
+                    max_tokens=rng.randrange(60, 90) if hog
+                    else rng.randrange(4, 12),
+                    seed=n,
+                ),
+                stop_token_ids=tok.eos_ids,
+            )
+            terminal[rid] = False
+            loop.submit(req, on_event_for(rid))
+            time.sleep(rng.uniform(0.0, 0.04))
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and not all(terminal.values()):
+            time.sleep(0.1)
+        probe_done = [False]
+        loop.submit(
+            Request(
+                id="final-probe", prompt_tokens=[5, 6, 7, 8],
+                sampling=SamplingParams(max_tokens=2),
+                stop_token_ids=tok.eos_ids,
+            ),
+            lambda ev: probe_done.__setitem__(0, ev.finished or probe_done[0]),
+        )
+        pdeadline = time.monotonic() + 30.0
+        while time.monotonic() < pdeadline and not probe_done[0]:
+            time.sleep(0.05)
+    finally:
+        loop.stop(join=False)
+
+    stuck = sorted(r for r, done in terminal.items() if not done)
+    counts: dict[str, int] = {}
+    for o in outcomes.values():
+        counts[o] = counts.get(o, 0) + 1
+    stats = loop.stats()
+    return {
+        "submitted": n,
+        "stuck": stuck,
+        "outcomes": counts,
+        "healthy_after": probe_done[0],
+        "stats": stats,
+        "tiering_moved": bool(
+            stats["host_pool"]
+            and stats["host_pool"]["spilled_pages"] > 0
+            and (
+                stats["host_pool"]["restored_pages"] > 0
+                or stats["resumes"] > 0
+            )
+        ),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--step-fault-p", type=float, default=0.02)
-    args = ap.parse_args(argv)
-    res = run_soak(
-        seconds=args.seconds, seed=args.seed,
-        step_fault_p=args.step_fault_p,
+    ap.add_argument(
+        "--scenario", choices=("faults", "memory"), default="faults",
+        help="faults: injected step/dispatch faults (ISSUE 2); memory: "
+        "sustained KV exhaustion against the tiering/preemption ladder "
+        "(ISSUE 6)",
     )
+    args = ap.parse_args(argv)
+    if args.scenario == "memory":
+        res = run_memory_pressure(seconds=args.seconds, seed=args.seed)
+    else:
+        res = run_soak(
+            seconds=args.seconds, seed=args.seed,
+            step_fault_p=args.step_fault_p,
+        )
     print(f"submitted:     {res['submitted']}")
     print(f"outcomes:      {res['outcomes']}")
     print(f"loop stats:    {res['stats']}")
@@ -156,9 +310,19 @@ def main(argv=None) -> int:
     if not res["healthy_after"]:
         print("ENGINE UNHEALTHY AFTER SOAK", file=sys.stderr)
         return 1
+    if args.scenario == "memory" and not res.get("tiering_moved"):
+        print("KV TIERING COUNTERS DID NOT MOVE", file=sys.stderr)
+        return 1
     print("zero stuck requests — soak passed")
     return 0
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    rc = main()
+    # the engine-loop daemon thread may still be inside a JAX dispatch;
+    # normal interpreter teardown then aborts (std::terminate) AFTER the
+    # verdict printed, clobbering the exit code CI keys on.  Flush and
+    # leave without running destructors.
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

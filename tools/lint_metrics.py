@@ -88,8 +88,14 @@ _SAT_KEYS_RE = re.compile(
 )
 _SAT_KEY_LITERAL = re.compile(r"""["']([a-z0-9_]+)["']""")
 _SAT_GAUGE_RE = re.compile(r"helix_cp_runner_saturation_([a-z0-9_]+)")
-# both sides of the heartbeat must import the shared schema tuple
+# every producer/consumer of the saturation summary must import the
+# shared schema tuple: the engine loop (per-engine summary), the node
+# agent (per-node rollup it heartbeats) and the control plane (the
+# helix_cp_runner_saturation_* gauges it renders) — three sites that
+# PR 6's kv_host_occupancy/preempted_requests keys must reach in
+# lockstep
 _SAT_IMPORTERS = (
+    os.path.join("helix_tpu", "serving", "engine_loop.py"),
     os.path.join("helix_tpu", "control", "node_agent.py"),
     os.path.join("helix_tpu", "control", "server.py"),
 )
