@@ -610,6 +610,52 @@ def main():
     }
     del eng3, on3
 
+    # per-tenant SLO baseline (ISSUE 7): a two-tenant mixed load through
+    # the real EngineLoop (the layer that owns TTFT/queue-wait
+    # accounting), so the item-5 scheduler PR has a recorded
+    # latency/goodput split to beat.  Tenants alternate request-for-
+    # request on one engine — the "fair" baseline a fairness scheduler
+    # must not regress.
+    import threading as _threading
+
+    from helix_tpu.obs.slo import SLOObserver
+    from helix_tpu.serving.engine_loop import EngineLoop
+
+    slo_eng = make_engine(kv_dtype)
+    slo_loop = EngineLoop(slo_eng, name="bench-slo").start()
+    slo_sampling = SamplingParams(
+        temperature=0.0, max_tokens=min(gen_len, 16)
+    )
+
+    def slo_pass(tag: str):
+        done = []
+        for i in range(2 * batch):
+            ev = _threading.Event()
+            done.append(ev)
+
+            def cb(e, _ev=ev):
+                if e.finished:
+                    _ev.set()
+
+            slo_loop.submit(
+                Request(
+                    id=f"{tag}-{i}",
+                    prompt_tokens=list(prompts[i % batch]),
+                    sampling=slo_sampling,
+                    tenant="tenant-a" if i % 2 == 0 else "tenant-b",
+                ),
+                cb,
+            )
+        for ev in done:
+            ev.wait(timeout=300)
+
+    slo_pass("slo-warm")   # compile wave stays out of the baseline
+    slo_loop.slo = SLOObserver(top_k=4)
+    slo_pass("slo-bench")
+    result["slo"] = slo_loop.slo.summary()
+    slo_loop.stop(join=True)
+    del slo_loop, slo_eng
+
     if on_tpu:
         # decode-side model FLOPs utilisation: each generated token moves
         # ~2 FLOPs per active parameter through the MXU; a v5e chip peaks

@@ -272,6 +272,26 @@ ENV_REFERENCE: tuple = (
         section="observability",
     ),
     EnvVar(
+        "HELIX_TENANT_TOP_K",
+        "How many tenants get their own label series per engine in the "
+        "per-tenant SLO accounting (helix_tenant_* metrics and the "
+        "heartbeat tenants rollup); everyone else folds into one "
+        "__other__ bucket via LRU demotion, so /metrics cardinality is "
+        "constant under tenant churn.",
+        default="8",
+        section="observability",
+    ),
+    EnvVar(
+        "HELIX_SLO_BURN_WINDOWS",
+        "Fast,slow window seconds for the SLO error-budget burn-rate "
+        "gauges (helix_slo_burn_rate / helix_tenant_slo_burn_rate), "
+        "e.g. '300,3600'. Burn rate 1.0 = the error budget is spent "
+        "exactly as fast as it accrues; >1.0 = the SLO is being "
+        "violated.",
+        default="300,3600",
+        section="observability",
+    ),
+    EnvVar(
         "HELIX_TRACEMALLOC",
         "Set to 1 to arm tracemalloc at import so the control plane's "
         "heap-profile endpoint sees allocations from process start. "

@@ -319,6 +319,13 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         preempt_stall_seconds=_bound(
             "HELIX_PREEMPT_STALL_SECONDS", float
         ),
+        # per-tenant SLO observability (ISSUE 7): the profile declares
+        # the targets (slo: {ttft_p95_seconds, queue_wait_p95_seconds,
+        # goodput_floor_tps}); top-K bounding and burn windows are
+        # operator knobs (HELIX_TENANT_TOP_K, HELIX_SLO_BURN_WINDOWS,
+        # read inside obs/slo.py when left None here)
+        slo_targets=pm.slo,
+        tenant_top_k=_bound("HELIX_TENANT_TOP_K"),
     ).start()
     return ServedModel(
         name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,
@@ -584,6 +591,29 @@ class NodeAgent:
         # schema lockstep: emit exactly the shared key set
         return {k: out[k] for k in SATURATION_KEYS}
 
+    def tenant_summary(self) -> dict:
+        """The compact per-node tenants rollup heartbeated to the
+        control plane: each live engine's bounded top-K block
+        (``obs.slo.TENANT_KEYS`` entries) merged across engines —
+        counters sum, burn rates take the worst — then re-bounded so
+        the node's heartbeat stays top-K + ``__other__`` no matter how
+        many engines it serves.  {} when no engine tracks tenants yet
+        (a fresh/restarted node — the cp clears any stale rollup)."""
+        from helix_tpu.obs.slo import merge_rollups, tenant_top_k_from_env
+
+        rollups = []
+        for m in self._live_models():
+            slo = getattr(getattr(m, "loop", None), "slo", None)
+            if slo is None:
+                continue
+            try:
+                rollups.append(slo.rollup())
+            except Exception:  # noqa: BLE001 — heartbeat must never die
+                continue
+        if not any(r.get("top") for r in rollups):
+            return {}
+        return merge_rollups(rollups, top_k=tenant_top_k_from_env())
+
     def heartbeat_payload(self) -> dict:
         """Wire format mirrors the reference heartbeat body
         (``api/cmd/sandbox-heartbeat/main.go:28-60``): id + accelerator
@@ -604,6 +634,7 @@ class NodeAgent:
                 "progress": self.state.progress,
             },
             "saturation": self.saturation_summary(),
+            "tenants": self.tenant_summary(),
             "disk": {"total": disk.total, "used": disk.used, "free": disk.free},
             "ts": time.time(),
         }

@@ -70,6 +70,11 @@ class ProfileModel:
     # stream; {"role": "follower", "leader_url": "http://host0:8000"}
     # replays it on this host's shards of the global mesh
     multihost: dict = dataclasses.field(default_factory=dict)
+    # declared SLO targets (obs/slo.py): {ttft_p95_seconds,
+    # queue_wait_p95_seconds, goodput_floor_tps} — drives the engine
+    # loop's per-model/per-tenant error-budget burn-rate gauges; {} =
+    # no targets, no burn gauges
+    slo: dict = dataclasses.field(default_factory=dict)
 
     @classmethod
     def from_dict(cls, d: dict) -> "ProfileModel":
@@ -96,6 +101,7 @@ class ProfileModel:
             context_length=d.get("context_length"),
             model_overrides=dict(d.get("model_overrides", {})),
             multihost=mh,
+            slo=dict(d.get("slo", {})),
         )
 
     def to_dict(self) -> dict:
@@ -111,6 +117,7 @@ class ProfileModel:
             "context_length": self.context_length,
             "model_overrides": dict(self.model_overrides),
             "multihost": dict(self.multihost),
+            "slo": dict(self.slo),
         }
 
 

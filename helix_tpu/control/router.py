@@ -164,6 +164,10 @@ class RunnerState:
     # is pruned with the runner on evict_stale()/remove() — no /metrics
     # label-cardinality leak under runner churn (same rule as breakers).
     saturation: dict = dataclasses.field(default_factory=dict)
+    # per-tenant rollup from the last heartbeat (obs.slo.TENANT_KEYS
+    # entries, top-K + __other__) — pruned with the runner like
+    # saturation, so tenant gauges can never outlive their reporter
+    tenants: dict = dataclasses.field(default_factory=dict)
 
     @property
     def routable(self) -> bool:
@@ -204,6 +208,7 @@ class InferenceRouter:
         accelerators: Optional[list] = None,
         meta: Optional[dict] = None,
         saturation: Optional[dict] = None,
+        tenants: Optional[dict] = None,
     ) -> RunnerState:
         with self._lock:
             st = self._runners.get(runner_id)
@@ -219,6 +224,8 @@ class InferenceRouter:
                 st.meta.update(meta)
             if saturation is not None:
                 st.saturation = dict(saturation)
+            if tenants is not None:
+                st.tenants = dict(tenants)
             return st
 
     def evict_stale(self) -> list:
@@ -382,6 +389,17 @@ class InferenceRouter:
                 rid: dict(st.saturation)
                 for rid, st in sorted(self._runners.items())
                 if st.saturation
+            }
+
+    def tenants_map(self) -> dict:
+        """{runner_id: last-heartbeat tenants rollup} over runners that
+        reported one.  Pruned with the runner, like saturation_map — the
+        cp's per-tenant burn gauges can never leak labels."""
+        with self._lock:
+            return {
+                rid: dict(st.tenants)
+                for rid, st in sorted(self._runners.items())
+                if st.tenants
             }
 
     def breaker_states(self) -> dict:

@@ -36,6 +36,15 @@ from helix_tpu.control.profile import ServingProfile, check_compatibility
 from helix_tpu.control.router import InferenceRouter
 from helix_tpu.control.store import Store
 from helix_tpu.obs.flight import SATURATION_KEYS
+from helix_tpu.obs.slo import (
+    ANON_TENANT,
+    TENANT_HEADER,
+    TENANT_KEYS,  # noqa: F401 — the federation schema this plane consumes
+    collect_cp_tenant_gauges,
+    merge_rollups,
+    resolve_tenant,
+    validate_tenant_rollup,
+)
 from helix_tpu.obs.trace import TRACE_HEADER
 
 _dispatch_log = logging.getLogger("helix.dispatch")
@@ -220,6 +229,14 @@ class ControlPlane:
         self.dispatch_exhausted = 0   # requests that ran out of candidates
         self.dispatch_ok = 0
         self.heartbeats_dropped = 0   # fault-injected heartbeat loss
+        # tenant id -> the identity resolved at dispatch (bounded LRU):
+        # /v1/tenants/usage joins the federated per-tenant rollups back
+        # to the human-readable identity the auth layer already knows
+        import collections as _collections
+
+        self._tenant_identities: "_collections.OrderedDict" = (
+            _collections.OrderedDict()
+        )
         # observability (ISSUE 3): shared metrics registry renders
         # /metrics; the trace store holds per-request dispatch spans
         # (every failover attempt is a span), served by /v1/debug/traces
@@ -1330,6 +1347,8 @@ class ControlPlane:
         r.add_get("/v1/debug/traces/{trace_id}", self.debug_trace)
         # cluster-wide saturation rollup (ISSUE 4; admin-gated under auth)
         r.add_get("/v1/cluster/status", self.cluster_status)
+        # cluster-wide per-tenant usage/SLO rollup (ISSUE 7; admin-gated)
+        r.add_get("/v1/tenants/usage", self.tenants_usage)
         # the shared dispatch ClientSession binds to the app's event loop
         app.on_cleanup.append(self._close_dispatch_session)
         return app
@@ -1389,6 +1408,12 @@ class ControlPlane:
                     c.gauge(
                         "helix_cp_runner_saturation_" + key, sat[key], lbl
                     )
+        # federated per-tenant SLO burn (ISSUE 7): worst burn across
+        # runners per tenant + the worst-tenant rollup.  The render
+        # helper lives in obs/slo.py — the one legal tenant-label
+        # emitter (lint contract 4); cardinality is bounded by the
+        # runners' top-K rollups and pruned with the runner.
+        collect_cp_tenant_gauges(c, self.router.tenants_map())
 
     async def cluster_status(self, request):
         """Operator rollup of the whole cluster's saturation: per runner
@@ -1462,6 +1487,65 @@ class ControlPlane:
             else 0.0
         )
         return web.json_response({"runners": runners, "cluster": totals})
+
+    async def tenants_usage(self, request):
+        """Cluster-wide per-tenant usage + SLO rollup: the federated
+        heartbeat ``tenants`` blocks merged across runners (counters
+        sum, burn rates take the worst), joined with the identity the
+        dispatch path already resolved for that tenant.  The JSON twin
+        of the ``helix_cp_slo_burn_rate`` gauges — what an operator (or
+        the item-5 fairness scheduler) reads to answer "who is burning
+        the budget".  Admin-gated when auth is on."""
+        user = request.get("user")
+        if self.auth_required and not (user and user.admin):
+            return _err(403, "admin only")
+        self.router.evict_stale()   # same freshness rule as /metrics
+        per_runner = self.router.tenants_map()
+        merged = merge_rollups(list(per_runner.values()), top_k=32)
+        serving = {}   # tenant -> runner ids reporting it
+        for rid, roll in sorted(per_runner.items()):
+            for entry in roll.get("top", []) or []:
+                t = entry.get("tenant")
+                if isinstance(t, str):
+                    serving.setdefault(t, []).append(rid)
+        tenants = []
+        worst = {"tenant": "", "burn_rate_fast": 0.0}
+        for entry in merged["top"]:
+            t = entry["tenant"]
+            doc = {
+                **entry,
+                "runners": serving.get(t, []),
+                "identity": self._tenant_identities.get(t),
+            }
+            tenants.append(doc)
+            if entry.get("burn_rate_fast", 0.0) > worst["burn_rate_fast"]:
+                worst = {
+                    "tenant": t,
+                    "burn_rate_fast": entry["burn_rate_fast"],
+                }
+        totals = {
+            "tenants": len(tenants),
+            "tracked": merged["tracked"],
+            "demotions": merged["demotions"],
+            "runners_reporting": len(per_runner),
+            "prompt_tokens": sum(
+                int(e.get("prompt_tokens", 0)) for e in merged["top"]
+            ),
+            "generated_tokens": sum(
+                int(e.get("generated_tokens", 0)) for e in merged["top"]
+            ),
+            "sheds": sum(int(e.get("sheds", 0)) for e in merged["top"]),
+            "kv_exhausted": sum(
+                int(e.get("kv_exhausted", 0)) for e in merged["top"]
+            ),
+            "preemptions": sum(
+                int(e.get("preemptions", 0)) for e in merged["top"]
+            ),
+            "worst_tenant": worst if worst["tenant"] else None,
+        }
+        return web.json_response(
+            {"tenants": tenants, "cluster": totals}
+        )
 
     async def debug_traces_list(self, request):
         user = request.get("user")
@@ -1575,6 +1659,11 @@ class ControlPlane:
                 continue
             if math.isfinite(f):
                 saturation[k] = f
+        # per-tenant rollup (ISSUE 7): runner-supplied like saturation,
+        # so entries are clamped to the obs.slo.TENANT_KEYS schema with
+        # finite values and a bounded count; malformed blocks degrade to
+        # {} and never reject the heartbeat
+        tenants = validate_tenant_rollup(body.get("tenants"))
         self.router.upsert_from_heartbeat(
             rid,
             models=profile.get("models", []),
@@ -1583,6 +1672,11 @@ class ControlPlane:
             accelerators=body.get("accelerators", []),
             meta={"address": body.get("address", "")},
             saturation=saturation,
+            # always overwrite: a live runner with past traffic reports
+            # lifetime counters every beat, so {} means a RESTARTED (or
+            # traffic-never-seen) runner — keeping the previous rollup
+            # would freeze stale burn gauges on a healthy node
+            tenants=tenants,
         )
         self.store.record_heartbeat(rid, body)
         self.router.evict_stale()
@@ -4707,6 +4801,14 @@ class ControlPlane:
         from helix_tpu.obs.trace import adopt_trace_id
 
         trace_id = adopt_trace_id(request.headers.get(TRACE_HEADER))
+        # tenant identity (ISSUE 7): the auth middleware already resolved
+        # the caller — forward it as X-Helix-Tenant so the runner's
+        # per-tenant accounting and admission audit attribute this
+        # request, and remember the identity for /v1/tenants/usage joins
+        tenant = resolve_tenant(
+            request.get("user"), request.headers.get("Authorization")
+        )
+        self._note_tenant_identity(tenant, request.get("user"))
         t_req = time.monotonic()
         model = body.get("model", "")
         if not model:
@@ -4799,7 +4901,8 @@ class ControlPlane:
                             "(injected)"
                         )
                 resp = await self._dispatch_attempt(
-                    request, runner, raw, deadline, acct, trace_id
+                    request, runner, raw, deadline, acct, trace_id,
+                    tenant,
                 )
                 # headers committed, but the stream may still have died
                 # mid-flight (the attempt resolved its own account):
@@ -4887,8 +4990,24 @@ class ControlPlane:
             headers={"Retry-After": "1", TRACE_HEADER: trace_id},
         )
 
+    def _note_tenant_identity(self, tenant: str, user) -> None:
+        """Bounded LRU of tenant -> dispatch-time identity (the join key
+        for /v1/tenants/usage).  Anonymous traffic is not an identity."""
+        if not tenant or tenant == ANON_TENANT:
+            return
+        ident = {
+            "user_id": getattr(user, "id", "") if user else "",
+            "email": getattr(user, "email", "") if user else "",
+            "name": getattr(user, "name", "") if user else "",
+            "last_dispatch": time.time(),
+        }
+        self._tenant_identities.pop(tenant, None)
+        self._tenant_identities[tenant] = ident
+        while len(self._tenant_identities) > 1024:
+            self._tenant_identities.popitem(last=False)
+
     async def _dispatch_attempt(self, request, runner, raw, deadline, acct,
-                                trace_id: str = ""):
+                                trace_id: str = "", tenant: str = ""):
         """One dispatch to one runner.  Raises for failures before the
         first streamed byte (the caller fails over); after headers are
         committed, mid-stream runner death is reported in-band on SSE
@@ -4898,20 +5017,23 @@ class ControlPlane:
         address = runner.meta.get("address")
         if not address:
             return await self._dispatch_tunnel(
-                request, runner, raw, acct, trace_id
+                request, runner, raw, acct, trace_id, tenant
             )
         url = f"{address}{request.path}"
         remaining = max(
             1.0, deadline - asyncio.get_running_loop().time()
         )
         session = self._http_session()
+        headers = {
+            "Content-Type": "application/json",
+            TRACE_HEADER: trace_id,
+        }
+        if tenant:
+            headers[TENANT_HEADER] = tenant
         async with session.post(
             url,
             data=raw,
-            headers={
-                "Content-Type": "application/json",
-                TRACE_HEADER: trace_id,
-            },
+            headers=headers,
             timeout=aiohttp.ClientTimeout(total=remaining),
         ) as upstream:
             if upstream.status >= 500:
@@ -5087,7 +5209,7 @@ class ControlPlane:
             return _err(e.status if 400 <= e.status < 600 else 502, str(e))
 
     async def _dispatch_tunnel(self, request, runner, raw: bytes, acct,
-                               trace_id: str = ""):
+                               trace_id: str = "", tenant: str = ""):
         """Dispatch through the runner's reverse tunnel, preserving SSE
         chunk boundaries.  Mid-stream tunnel death surfaces as a terminal
         SSE error frame on SSE responses / an aborted connection on JSON
@@ -5096,14 +5218,17 @@ class ControlPlane:
         from helix_tpu.control.tunnel import TunnelClosed
 
         try:
+            fwd_headers = {
+                "Content-Type": "application/json",
+                TRACE_HEADER: trace_id,
+            }
+            if tenant:
+                fwd_headers[TENANT_HEADER] = tenant
             status, headers, chunks = await self.tunnels.request(
                 runner.id,
                 "POST",
                 request.path,
-                {
-                    "Content-Type": "application/json",
-                    TRACE_HEADER: trace_id,
-                },
+                fwd_headers,
                 raw,
             )
         except TunnelClosed as e:

@@ -65,6 +65,7 @@ from helix_tpu.engine.sampling import (
 from helix_tpu.models.common import ModelConfig
 from helix_tpu.models.llama import forward
 from helix_tpu.obs import trace as obs_trace
+from helix_tpu.obs.slo import ANON_TENANT
 from helix_tpu.ops.attention import attention as full_attention
 from helix_tpu.ops.paged import paged_decode_attention
 
@@ -99,6 +100,10 @@ class Request:
     # endpoint, carried through dispatch into engine-level spans; empty
     # string = untraced (span recording is then a no-op)
     trace_id: str = ""
+    # tenant identity (obs.slo): auth-resolved at the control plane,
+    # adopted from X-Helix-Tenant by the OpenAI surface — feeds the
+    # bounded per-tenant accounting and the admission audit trail
+    tenant: str = ANON_TENANT
     cached_tokens: int = 0          # prompt tokens served by prefix cache
     preempt_count: int = 0          # times swapped out (bounds thrash)
     _page_hashes: Optional[list] = None
@@ -1108,7 +1113,7 @@ class Engine:
         self._moe_drop_handles: list = []
         self.recent_ttfts: "_collections.deque" = _collections.deque(
             maxlen=200
-        )   # ms; feeds /metrics p50/p95
+        )   # seconds; feeds /metrics p50/p95
 
     # ------------------------------------------------------------------
     # public API
@@ -1702,7 +1707,7 @@ class Engine:
             first_token = self._prefill(req, table, slot=slot)
             req.first_token_time = time.monotonic()
             self.recent_ttfts.append(
-                (req.first_token_time - req.submit_time) * 1000.0
+                req.first_token_time - req.submit_time
             )
             self._positions[slot] = plen
             self._mrope_delta[slot] = req.mrope_delta
@@ -1860,9 +1865,7 @@ class Engine:
                 i += 1
                 slot = req.slot
                 req.first_token_time = now
-                self.recent_ttfts.append(
-                    (now - req.submit_time) * 1000.0
-                )
+                self.recent_ttfts.append(now - req.submit_time)
                 self._positions[slot] = len(req.prompt_tokens)
                 self._mrope_delta[slot] = 0
                 self._last_token[slot] = first_token
@@ -1963,7 +1966,7 @@ class Engine:
         self._chunking = None
         req.first_token_time = time.monotonic()
         self.recent_ttfts.append(
-            (req.first_token_time - req.submit_time) * 1000.0
+            req.first_token_time - req.submit_time
         )
         self._positions[slot] = len(req.prompt_tokens)
         self._mrope_delta[slot] = req.mrope_delta

@@ -152,8 +152,9 @@ class ResidencyManager:
         # metrics
         self.evictions = 0
         self.loads = 0
-        self.swap_ms: dict[str, float] = {}   # model -> last acquire stall
-        self.load_ms: dict[str, float] = {}   # model -> last build duration
+        # model -> last acquire stall / build duration, in seconds
+        self.swap_seconds: dict[str, float] = {}
+        self.load_seconds: dict[str, float] = {}
 
     # -- registry-compatible surface --------------------------------------
     def register_name(self, name: str) -> None:
@@ -188,8 +189,8 @@ class ResidencyManager:
                 "evictions": self.evictions,
                 "used_bytes": self.used_bytes_locked(),
                 "budget_bytes": self.budget,
-                "swap_ms": dict(self.swap_ms),
-                "load_ms": dict(self.load_ms),
+                "swap_seconds": dict(self.swap_seconds),
+                "load_seconds": dict(self.load_seconds),
             }
 
     # -- residency ----------------------------------------------------------
@@ -272,8 +273,8 @@ class ResidencyManager:
                             last_used=time.monotonic(), loads=1,
                         )
                         self.loads += 1
-                        self.load_ms[name] = (
-                            (time.monotonic() - t0) * 1000.0
+                        self.load_seconds[name] = (
+                            time.monotonic() - t0
                         )
                 if not ok:
                     if model.loop is not None:
@@ -319,7 +320,7 @@ class ResidencyManager:
             r = self._resident.get(name)
             if r is not None:
                 r.last_used = time.monotonic()
-                self.swap_ms[name] = (time.monotonic() - t_enter) * 1000.0
+                self.swap_seconds[name] = time.monotonic() - t_enter
                 return r.model
             if self._estimate is not None:
                 # device path: predict footprint, evict FIRST, then build
@@ -350,9 +351,9 @@ class ResidencyManager:
             self.loads += 1
             # synchronous swap: the requesting call stalled for the whole
             # build+load — exactly the latency prefetch() exists to hide
-            swap = (time.monotonic() - t_enter) * 1000.0
-            self.swap_ms[name] = swap
-            self.load_ms[name] = swap
+            swap = time.monotonic() - t_enter
+            self.swap_seconds[name] = swap
+            self.load_seconds[name] = swap
             return model
 
     def evict(self, name: str) -> None:

@@ -28,6 +28,18 @@ from helix_tpu.obs.metrics import (  # noqa: F401
     format_value,
     validate_metric_name,
 )
+from helix_tpu.obs.slo import (  # noqa: F401
+    ANON_TENANT,
+    OTHER_TENANT,
+    TENANT_HEADER,
+    TENANT_KEYS,
+    AdmissionAudit,
+    SLOObserver,
+    SLOTargets,
+    TenantAccounting,
+    resolve_tenant,
+    sanitize_tenant,
+)
 from helix_tpu.obs.trace import (  # noqa: F401
     TRACE_HEADER,
     Span,

@@ -30,6 +30,7 @@ from helix_tpu.engine.engine import Engine, FinishReason, Request
 from helix_tpu.obs import EngineLoopObs, FlightRecorder, RateTracker
 from helix_tpu.obs import trace as obs_trace
 from helix_tpu.obs.flight import SATURATION_KEYS
+from helix_tpu.obs.slo import ANON_TENANT, SLOObserver
 
 log = logging.getLogger("helix.engine")
 
@@ -59,7 +60,10 @@ class EngineLoop:
                  max_queue_depth: Optional[int] = None,
                  max_queued_tokens: Optional[int] = None,
                  admission_timeout: Optional[float] = None,
-                 preempt_stall_seconds: Optional[float] = None):
+                 preempt_stall_seconds: Optional[float] = None,
+                 slo_targets: Optional[dict] = None,
+                 tenant_top_k: Optional[int] = None,
+                 burn_windows: Optional[tuple] = None):
         self.engine = engine
         self.name = name
         self.max_queue_seconds = max_queue_seconds
@@ -115,6 +119,13 @@ class EngineLoop:
         # goodput tokens/s over a trailing window (scraped by /metrics
         # and the heartbeat saturation summary)
         self._tps = RateTracker()
+        # per-tenant SLO observability (ISSUE 7): bounded top-K tenant
+        # accounting (+ __other__ fold), multi-window burn rates against
+        # the profile-declared SLO targets, and the admission audit ring
+        # served at GET /v1/debug/admissions
+        self.slo = SLOObserver(
+            targets=slo_targets, top_k=tenant_top_k, windows=burn_windows
+        )
         self._trace = obs_trace.default_store()
         self._first_emit: dict[str, float] = {}   # req id -> first-token t
         self._last_emit: dict[str, float] = {}    # req id -> last-token t
@@ -122,20 +133,51 @@ class EngineLoop:
     # -- called from any thread --------------------------------------------
 
     def check_admission(
-        self, prompt_len: int, count_shed: bool = False
+        self, prompt_len: int, count_shed: bool = False,
+        tenant: str = ANON_TENANT, trace_id: str = "",
+        request_id: str = "",
     ) -> Optional[str]:
         """Would a submit of this size be shed right now?  Returns the
         error string (``queue_full: ...`` / ``shutting_down: ...``) or
         None.  HTTP handlers pre-check so streaming requests get a clean
         429/503 status instead of an SSE error frame; callers that act on
         the verdict (actually shed the request) pass ``count_shed=True``
-        so the metric is owned here, in one place."""
+        so the metric — and the per-tenant accounting + admission audit
+        entry — is owned here, in one place."""
         err = self._check_admission(prompt_len)
         if err is not None and count_shed:
             self.shed_requests += 1
-            if err.startswith(KV_EXHAUSTED):
+            kv = err.startswith(KV_EXHAUSTED)
+            if kv:
                 self.kv_exhausted_sheds += 1
+            reason = (
+                "kv_exhausted" if kv
+                else "shutting_down" if err.startswith(SHUTTING_DOWN)
+                else "queue_full"
+            )
+            self.slo.note_shed(tenant, kv_exhausted=kv)
+            self._audit(
+                reason, tenant=tenant, trace_id=trace_id,
+                request_id=request_id, detail=err,
+            )
         return err
+
+    def _audit(self, reason: str, tenant: str = ANON_TENANT,
+               trace_id: str = "", request_id: str = "",
+               detail: str = "") -> None:
+        """One admission-decision audit record, stamped with the queue
+        state at the moment of the decision.  O(1) reads only — sheds
+        spike exactly when the node is saturated, so the rejection path
+        must not walk the wait queue per record."""
+        eng = self.engine
+        self.slo.audit.record(
+            reason, tenant=tenant, trace_id=trace_id,
+            request_id=request_id, detail=detail,
+            queue_depth=self._pending + len(eng.waiting),
+            kv_pages_free=eng.allocator.free_pages,
+            slots_busy=sum(1 for s in eng.slots if s is not None),
+            preempted_parked=len(getattr(eng, "preempted", ())),
+        )
 
     def queued_tokens(self) -> int:
         """Prompt tokens awaiting admission (inbox + engine wait queue)
@@ -193,7 +235,9 @@ class EngineLoop:
         # reject unservable requests on the caller's thread with a clean
         # event — the engine thread must never die on bad input
         err = self.engine.validate_request(req) or self.check_admission(
-            len(req.prompt_tokens), count_shed=True
+            len(req.prompt_tokens), count_shed=True,
+            tenant=getattr(req, "tenant", ANON_TENANT),
+            trace_id=req.trace_id, request_id=req.id,
         )
         if err:
             on_event(
@@ -209,6 +253,13 @@ class EngineLoop:
             # inbox after the engine thread's terminal sweep
             if self._draining or self._stop.is_set():
                 self.shed_requests += 1
+                self.slo.note_shed(getattr(req, "tenant", ANON_TENANT))
+                self._audit(
+                    "shutting_down",
+                    tenant=getattr(req, "tenant", ANON_TENANT),
+                    trace_id=req.trace_id, request_id=req.id,
+                    detail="draining",
+                )
                 on_event(
                     TokenEvent(
                         request_id=req.id, token_id=-1, finished=True,
@@ -269,6 +320,9 @@ class EngineLoop:
                 if getattr(eng, "host_pool", None) is not None
                 else None
             ),
+            # per-tenant SLO observability (ISSUE 7): pooled totals +
+            # top-K bounding introspection
+            "tenants": self.slo.stats(),
         }
 
     def tokens_per_sec(self) -> float:
@@ -374,12 +428,19 @@ class EngineLoop:
         finish)."""
         now = time.monotonic()
         rid = req.id
+        tenant = getattr(req, "tenant", ANON_TENANT)
         last = self._last_emit.get(rid)
         if rid not in self._first_emit:
             self._first_emit[rid] = now
             admitted = req.admitted_time or now
             self.obs.queue_wait.observe(max(0.0, admitted - req.submit_time))
             self.obs.ttft.observe(max(0.0, now - req.submit_time))
+            self.slo.note_first_token(
+                tenant,
+                max(0.0, now - req.submit_time),
+                max(0.0, admitted - req.submit_time),
+                len(req.prompt_tokens),
+            )
             if req.trace_id:
                 self._trace.record(
                     req.trace_id, "queue", req.submit_time, admitted,
@@ -414,8 +475,14 @@ class EngineLoop:
         self._last_emit.pop(request_id, None)
 
     def _emit(self, emitted) -> None:
+        # per-tenant token counts batched to ONE accounting call per
+        # tenant per step (not per token) — the accounting lock is
+        # shared with /metrics scrapes and must stay off the hot path
+        tenant_tokens: dict = {}
         for req, token in emitted:
             self._observe_emit(req)
+            t = getattr(req, "tenant", ANON_TENANT)
+            tenant_tokens[t] = tenant_tokens.get(t, 0) + 1
             cb = self._subscribers.get(req.id)
             if cb is None:
                 continue
@@ -431,6 +498,8 @@ class EngineLoop:
             )
             if req.finished:
                 self._subscribers.pop(req.id, None)
+        for t, n in tenant_tokens.items():
+            self.slo.note_tokens(t, n)
 
     def _shed_kv_exhausted(self, req, waited: float) -> None:
         """Terminal typed shed for one request that outwaited the
@@ -443,6 +512,12 @@ class EngineLoop:
         self.engine.abort(req.id)
         self.kv_exhausted_sheds += 1
         self.shed_requests += 1
+        tenant = getattr(req, "tenant", ANON_TENANT)
+        self.slo.note_shed(tenant, kv_exhausted=True)
+        self._audit(
+            "kv_exhausted", tenant=tenant, trace_id=req.trace_id,
+            request_id=req.id, detail=msg,
+        )
         log.warning(
             "engine '%s' shedding request_id=%s trace_id=%s: %s",
             self.name, req.id, req.trace_id or "-", msg,
@@ -512,6 +587,16 @@ class EngineLoop:
             victim = self.engine.preempt_for_pressure()
             if victim is not None:
                 self._last_preempt_at = now
+                vreq = self.engine.get_request(victim)
+                tenant = getattr(vreq, "tenant", ANON_TENANT)
+                self.slo.note_preemption(tenant)
+                self._audit(
+                    "preempt_by_swap", tenant=tenant,
+                    trace_id=getattr(vreq, "trace_id", ""),
+                    request_id=victim,
+                    detail=f"admission KV-starved "
+                           f"{now - self._stall_since:.1f}s",
+                )
                 log.warning(
                     "engine '%s' admission KV-starved for %.1fs: "
                     "preempted request_id=%s (swap-to-host)",
@@ -635,6 +720,13 @@ class EngineLoop:
             "preemptions": getattr(eng, "num_preemptions", 0) - pe0,
             "resumes": getattr(eng, "num_resumes", 0) - re0,
             "host_pool_pages": hp.pages if hp is not None else 0,
+            # distinct tenants sharing this step's decode batch: the
+            # noisy-neighbour axis (1 = single-tenant step, >1 = a slow
+            # step taxed every tenant listed)
+            "distinct_tenants": len({
+                getattr(s, "tenant", ANON_TENANT)
+                for s in eng.slots if s is not None
+            }),
         }
         if failed is not None:
             rec["anomaly"] = "step_failure"
@@ -747,6 +839,10 @@ class EngineLoop:
         self.flight.note_anomaly(
             "quarantine", request_id=req.id, detail=msg[:200]
         )
+        self._audit(
+            "quarantine", tenant=getattr(req, "tenant", ANON_TENANT),
+            trace_id=req.trace_id or "", request_id=req.id, detail=msg,
+        )
         log.warning(
             "engine '%s' evicting request_id=%s trace_id=%s: %s",
             self.name, req.id, req.trace_id or "-", msg,
@@ -783,6 +879,7 @@ class EngineLoop:
             positions3=req.positions3,
             mrope_delta=req.mrope_delta,
             trace_id=req.trace_id,
+            tenant=getattr(req, "tenant", ANON_TENANT),
         )
 
     def _trial(self, group: list) -> bool:
@@ -879,6 +976,12 @@ class EngineLoop:
                 )
                 self.flight.note_anomaly(
                     "quarantine", request_id=r.id, detail=msg[:200]
+                )
+                self._audit(
+                    "quarantine",
+                    tenant=getattr(r, "tenant", ANON_TENANT),
+                    trace_id=r.trace_id or "", request_id=r.id,
+                    detail=msg,
                 )
                 log.warning(
                     "engine '%s' quarantined request_id=%s trace_id=%s: %s",

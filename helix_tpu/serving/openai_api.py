@@ -26,6 +26,7 @@ from aiohttp import web
 from helix_tpu import obs
 from helix_tpu.engine.engine import Request
 from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.obs.slo import ANON_TENANT, TENANT_HEADER, sanitize_tenant
 from helix_tpu.obs.trace import TRACE_HEADER
 from helix_tpu.serving.engine_loop import (
     KV_EXHAUSTED,
@@ -168,6 +169,9 @@ class OpenAIServer:
         # engine flight recorder: per-step saturation ring + frozen
         # anomaly snapshots (ISSUE 4)
         app.router.add_get("/v1/debug/flight", self.debug_flight)
+        # admission-decision audit trail: every shed / quarantine /
+        # preemption with its tenant + trace id (ISSUE 7)
+        app.router.add_get("/v1/debug/admissions", self.debug_admissions)
         app.router.add_post("/admin/profiler", self.profiler_capture)
         # multi-host lockstep journal (followers long-poll over DCN;
         # see serving/multihost_serving.py)
@@ -301,6 +305,12 @@ class OpenAIServer:
             # saturation / capacity-efficiency gauges (ISSUE 4): how full
             # the machine is and where the capacity goes
             self._collect_saturation(c, m, eng, lbl)
+            # per-tenant SLO series (ISSUE 7): bounded top-K + __other__
+            # accounting and burn-rate gauges — obs/slo.py is the ONLY
+            # legal emitter of tenant-labelled samples (lint contract 4)
+            slo = getattr(m.loop, "slo", None)
+            if slo is not None:
+                slo.collect(c, lbl)
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 st = pc.stats
@@ -338,9 +348,11 @@ class OpenAIServer:
                     except RuntimeError:
                         continue
                 if s:
-                    c.gauge("helix_ttft_ms_p50", s[len(s) // 2], lbl)
                     c.gauge(
-                        "helix_ttft_ms_p95",
+                        "helix_ttft_p50_seconds", s[len(s) // 2], lbl
+                    )
+                    c.gauge(
+                        "helix_ttft_p95_seconds",
                         s[min(len(s) - 1, int(len(s) * 0.95))], lbl,
                     )
         mgr = self._residency_manager()
@@ -352,10 +364,14 @@ class OpenAIServer:
             c.gauge(
                 "helix_residency_budget_bytes", st.get("budget_bytes", 0)
             )
-            for name, ms in sorted(st["swap_ms"].items()):
-                c.gauge("helix_model_swap_ms", ms, {"model": name})
-            for name, ms in sorted(st["load_ms"].items()):
-                c.gauge("helix_model_load_ms", ms, {"model": name})
+            for name, secs in sorted(st["swap_seconds"].items()):
+                c.gauge(
+                    "helix_model_swap_seconds", secs, {"model": name}
+                )
+            for name, secs in sorted(st["load_seconds"].items()):
+                c.gauge(
+                    "helix_model_load_seconds", secs, {"model": name}
+                )
 
     def _collect_saturation(self, c, m, eng, lbl: dict) -> None:
         """Per-model capacity gauges: KV occupancy + high-water mark,
@@ -551,6 +567,42 @@ class OpenAIServer:
             )
         return web.json_response({"models": out})
 
+    async def debug_admissions(self, request):
+        """The admission-decision audit trail: a bounded ring per model
+        of every 429 shed, typed kv_exhausted shed, quarantine eviction
+        and preemption-by-swap — ``(tenant, trace_id, reason, queue
+        state)`` at the moment of the decision.  Runner-token gated like
+        ``/v1/debug/flight``; ``?model=`` filters, ``?recent=`` bounds
+        the tail returned."""
+        denied = self._require_runner_token(request)
+        if denied is not None:
+            return denied
+        want = request.query.get("model", "")
+        try:
+            recent = max(1, min(int(request.query.get("recent", 64)), 256))
+        except ValueError:
+            return _error(400, "recent must be an integer")
+
+        def collect():
+            # off the event loop: registry.list() on a residency-backed
+            # runner blocks on the build-holding lock (debug_flight rule)
+            snap = {}
+            for m in self.registry.list():
+                if m.loop is None or (want and m.name != want):
+                    continue
+                slo = getattr(m.loop, "slo", None)
+                if slo is None:
+                    continue
+                snap[m.name] = slo.audit.snapshot(recent=recent)
+            return snap
+
+        out = await asyncio.get_running_loop().run_in_executor(
+            None, collect
+        )
+        if want and not out:
+            return _error(404, f"model {want!r} has no admission audit")
+        return web.json_response({"models": out})
+
     async def profiler_capture(self, request):
         """On-demand ``jax.profiler`` capture against the live runner:
         POST {"seconds": 2} starts a device+host trace and returns the
@@ -627,7 +679,8 @@ class OpenAIServer:
 
     async def prefetch_model(self, request):
         """Stage a model's weights in the background ahead of traffic (the
-        async half of hot-swap; swap_ms in /metrics shows the payoff)."""
+        async half of hot-swap; helix_model_swap_seconds in /metrics
+        shows the payoff)."""
         try:
             body = await request.json()
         except Exception:  # noqa: BLE001 — client error, not server fault
@@ -714,15 +767,21 @@ class OpenAIServer:
         )
 
     @staticmethod
-    def _precheck_admission(served, prompt_ids, trace_id: str = ""):
+    def _precheck_admission(served, prompt_ids, trace_id: str = "",
+                            tenant: str = ANON_TENANT):
         """Shed before committing response headers: streaming handlers
         prepare() the SSE response before the first engine event, so a
         queue_full discovered after submit can only surface as an in-band
-        error frame — this pre-check turns it into a real 429/503."""
+        error frame — this pre-check turns it into a real 429/503.  The
+        tenant rides along so the shed lands in that tenant's accounting
+        and the admission audit ring."""
         check = getattr(served.loop, "check_admission", None)
         if check is None:
             return None
-        err = check(len(prompt_ids), count_shed=True)
+        err = check(
+            len(prompt_ids), count_shed=True, tenant=tenant,
+            trace_id=trace_id,
+        )
         if err is None:
             return None
         return _engine_error_response(
@@ -735,6 +794,17 @@ class OpenAIServer:
         from helix_tpu.obs.trace import adopt_trace_id
 
         return adopt_trace_id(request.headers.get(TRACE_HEADER))
+
+    @staticmethod
+    def _tenant(request) -> str:
+        """The request's tenant identity: the control plane resolves it
+        at dispatch and forwards ``X-Helix-Tenant``.  The runner is an
+        internal surface (same trust model as /logs and /metrics), so a
+        direct caller's header is trusted like its prompts; the
+        sanitiser bounds the SHAPE — malformed values and claims on the
+        ``__other__`` fold bucket land under ``anonymous`` — and the
+        top-K accounting bounds the series count."""
+        return sanitize_tenant(request.headers.get(TENANT_HEADER, ""))
 
     def _sampling_from_body(self, body: dict) -> SamplingParams:
         stop = body.get("stop") or []
@@ -756,10 +826,11 @@ class OpenAIServer:
         )
 
     async def _generate(self, served, prompt_ids, sampling, extra=None,
-                        trace_id: str = ""):
+                        trace_id: str = "", tenant: str = ANON_TENANT):
         """Submit to the engine; yields (delta_text, token_id, finished,
         finish_reason).  ``extra`` carries multimodal Request fields;
-        ``trace_id`` rides the Request into engine-level spans."""
+        ``trace_id`` and ``tenant`` ride the Request into engine-level
+        spans and the per-tenant accounting."""
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
@@ -772,6 +843,7 @@ class OpenAIServer:
             sampling=sampling,
             stop_token_ids=tuple(served.tokenizer.eos_ids),
             trace_id=trace_id,
+            tenant=tenant,
             **(extra or {}),
         )
         served.loop.submit(req, on_event)
@@ -825,6 +897,7 @@ class OpenAIServer:
         except Exception:
             return _error(400, "invalid JSON body")
         tid = self._trace_id(request)
+        tenant = self._tenant(request)
         t_req = time.monotonic()
         model = body.get("model", "")
         served, err = await self._lookup(model)
@@ -869,11 +942,13 @@ class OpenAIServer:
             prompt_ids = served.tokenizer.apply_chat_template(
                 messages, add_generation_prompt=True
             )
-        shed = self._precheck_admission(served, prompt_ids, trace_id=tid)
+        shed = self._precheck_admission(
+            served, prompt_ids, trace_id=tid, tenant=tenant
+        )
         self.traces.record(
             tid, "admit", t_admit, time.monotonic(), plane="runner",
             model=model, prompt_tokens=len(prompt_ids),
-            shed=shed is not None,
+            shed=shed is not None, tenant=tenant,
         )
         if shed is not None:
             return shed
@@ -899,7 +974,8 @@ class OpenAIServer:
             t_emit = None
             try:
               async for delta, tok, finished, reason in self._generate(
-                served, prompt_ids, sampling, extra, trace_id=tid
+                served, prompt_ids, sampling, extra, trace_id=tid,
+                tenant=tenant,
               ):
                 if t_emit is None:
                     t_emit = time.monotonic()
@@ -949,7 +1025,8 @@ class OpenAIServer:
         t_emit = None
         try:
           async for delta, tok, finished, reason in self._generate(
-            served, prompt_ids, sampling, extra, trace_id=tid
+            served, prompt_ids, sampling, extra, trace_id=tid,
+            tenant=tenant,
           ):
             if t_emit is None:
                 t_emit = time.monotonic()
@@ -1001,6 +1078,7 @@ class OpenAIServer:
         except Exception:
             return _error(400, "invalid JSON body")
         tid = self._trace_id(request)
+        tenant = self._tenant(request)
         t_req = time.monotonic()
         model = body.get("model", "")
         served, err = await self._lookup(model)
@@ -1015,11 +1093,13 @@ class OpenAIServer:
         sampling = self._sampling_from_body(body)
         t_admit = time.monotonic()
         prompt_ids = served.tokenizer.encode(prompt)
-        shed = self._precheck_admission(served, prompt_ids, trace_id=tid)
+        shed = self._precheck_admission(
+            served, prompt_ids, trace_id=tid, tenant=tenant
+        )
         self.traces.record(
             tid, "admit", t_admit, time.monotonic(), plane="runner",
             model=model, prompt_tokens=len(prompt_ids),
-            shed=shed is not None,
+            shed=shed is not None, tenant=tenant,
         )
         if shed is not None:
             return shed
@@ -1038,7 +1118,8 @@ class OpenAIServer:
             t_emit = None
             try:
               async for delta, tok, finished, reason in self._generate(
-                served, prompt_ids, sampling, trace_id=tid
+                served, prompt_ids, sampling, trace_id=tid,
+                tenant=tenant,
               ):
                 if t_emit is None:
                     t_emit = time.monotonic()
@@ -1072,7 +1153,8 @@ class OpenAIServer:
         t_emit = None
         try:
           async for delta, tok, finished, reason in self._generate(
-            served, prompt_ids, sampling, trace_id=tid
+            served, prompt_ids, sampling, trace_id=tid,
+            tenant=tenant,
           ):
             if t_emit is None:
                 t_emit = time.monotonic()
@@ -1188,6 +1270,7 @@ class OpenAIServer:
         except Exception:
             return _error(400, "invalid JSON body")
         tid = self._trace_id(request)
+        tenant = self._tenant(request)
         t_req = time.monotonic()
         model = body.get("model", "")
         served, err = await self._lookup(model)
@@ -1210,11 +1293,13 @@ class OpenAIServer:
         prompt_ids = served.tokenizer.apply_chat_template(
             messages, add_generation_prompt=True
         )
-        shed = self._precheck_admission(served, prompt_ids, trace_id=tid)
+        shed = self._precheck_admission(
+            served, prompt_ids, trace_id=tid, tenant=tenant
+        )
         self.traces.record(
             tid, "admit", t_admit, time.monotonic(), plane="runner",
             model=model, prompt_tokens=len(prompt_ids),
-            shed=shed is not None,
+            shed=shed is not None, tenant=tenant,
         )
         if shed is not None:
             return shed
@@ -1261,7 +1346,8 @@ class OpenAIServer:
             t_emit = None
             try:
               async for delta, tok, finished, reason in self._generate(
-                served, prompt_ids, sampling, trace_id=tid
+                served, prompt_ids, sampling, trace_id=tid,
+                tenant=tenant,
               ):
                 if t_emit is None:
                     t_emit = time.monotonic()
@@ -1313,7 +1399,8 @@ class OpenAIServer:
         t_emit = None
         try:
           async for delta, tok, finished, reason in self._generate(
-            served, prompt_ids, sampling, trace_id=tid
+            served, prompt_ids, sampling, trace_id=tid,
+            tenant=tenant,
           ):
             if t_emit is None:
                 t_emit = time.monotonic()

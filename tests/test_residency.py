@@ -156,15 +156,19 @@ class TestAsyncPrefetch:
         assert sorted(mgr.resident_names()) == ["model-a", "model-b"]
         # the acquire stall was the tail of the load, and both latencies
         # were recorded for /metrics
-        assert "model-b" in mgr.swap_ms and "model-b" in mgr.load_ms
+        assert "model-b" in mgr.swap_seconds
+        assert "model-b" in mgr.load_seconds
 
     def test_sync_swap_records_latency(self):
         mgr, gate, _ = self._mgr_with_gate(1.5)
         gate.set()
         mgr.acquire("model-a")
         mgr.acquire("model-b")         # evicts a, builds b synchronously
-        assert mgr.swap_ms["model-b"] > 0
-        assert mgr.load_ms["model-b"] >= mgr.swap_ms["model-b"] * 0.5
+        assert mgr.swap_seconds["model-b"] > 0
+        assert (
+            mgr.load_seconds["model-b"]
+            >= mgr.swap_seconds["model-b"] * 0.5
+        )
 
     def test_prefetch_declines_when_only_busy_models_fit(self):
         mgr, gate, builds = self._mgr_with_gate(1.5)

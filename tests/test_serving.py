@@ -321,7 +321,7 @@ def residency_url():
 
 class TestPrefetchSurface:
     """Hot-swap over HTTP: /admin/prefetch stages weights ahead of traffic;
-    /metrics exposes swap_ms / load_ms (SURVEY §7 hard part #2)."""
+    /metrics exposes swap/load seconds (SURVEY §7 hard part #2)."""
 
     def test_prefetch_then_metrics(self, residency_url):
         r = requests.post(
@@ -332,11 +332,11 @@ class TestPrefetchSurface:
         deadline = time.monotonic() + 60
         while time.monotonic() < deadline:
             text = requests.get(f"{residency_url}/metrics", timeout=10).text
-            if 'helix_model_load_ms{model="swap-b"}' in text:
+            if 'helix_model_load_seconds{model="swap-b"}' in text:
                 break
             time.sleep(0.5)
         else:
-            raise AssertionError(f"load_ms never appeared:\n{text}")
+            raise AssertionError(f"load_seconds never appeared:\n{text}")
         assert "helix_residency_loads_total 1" in text
         # the prefetched model serves without a load stall
         r = requests.post(
@@ -348,7 +348,7 @@ class TestPrefetchSurface:
         )
         assert r.status_code == 200, r.text
         text = requests.get(f"{residency_url}/metrics", timeout=10).text
-        assert 'helix_model_swap_ms{model="swap-b"}' in text
+        assert 'helix_model_swap_seconds{model="swap-b"}' in text
 
     def test_prefetch_unknown_model_404(self, residency_url):
         r = requests.post(

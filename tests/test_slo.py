@@ -1,0 +1,829 @@
+"""Per-tenant SLO observability (ISSUE 7): identity propagation,
+bounded tenant accounting, burn-rate tracking, admission audit trail.
+
+- Cardinality under churn: 200 distinct tenants through a tiny engine
+  loop keep the /metrics series count at top-K + ``__other__``, and a
+  demoted tenant's counts are folded, not lost (totals conserved).
+- Identity propagation: a request dispatched through the control plane
+  with auth enabled surfaces the same tenant id in runner /metrics, the
+  admission audit ring and ``/v1/tenants/usage``; with auth off
+  everything lands under ``anonymous`` and no endpoint 500s.
+- Two-tenant chaos: an injected slow-step fault degrading one model
+  makes the victim tenant's fast-window burn rate exceed 1.0 while the
+  unaffected tenant's stays below it, and every shed in the run appears
+  in ``/v1/debug/admissions`` with the correct tenant and reason.
+- lint_metrics contract 4: ad-hoc tenant labels outside obs/slo.py fail
+  the build.
+"""
+
+import asyncio
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+import requests
+
+from helix_tpu.obs.slo import (
+    ANON_TENANT,
+    OTHER_TENANT,
+    AdmissionAudit,
+    SLOTargets,
+    TenantAccounting,
+    merge_rollups,
+    resolve_tenant,
+    sanitize_tenant,
+    validate_tenant_rollup,
+)
+from helix_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+def _serve_app(app, holder):
+    started = threading.Event()
+    box = {}
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        box["port"] = site._server.sockets[0].getsockname()[1]
+        holder.setdefault("loops", []).append(loop)
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    return box["port"]
+
+
+def _tiny_engine(tok, page_size=4, num_pages=64, batch=4):
+    import jax
+
+    from helix_tpu.engine.engine import Engine, EngineConfig
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(7))
+    return Engine(
+        cfg, params,
+        EngineConfig(
+            max_decode_batch=batch, page_size=page_size,
+            num_pages=num_pages, max_pages_per_seq=16, max_prefill_len=64,
+            attn_backend="reference", eos_token_ids=tok.eos_ids,
+        ),
+    )
+
+
+def _drain(loop_obj, reqs, timeout=120):
+    """Submit requests and wait for each to finish (engine-loop path,
+    no HTTP)."""
+    done = []
+    for req in reqs:
+        ev = threading.Event()
+        done.append(ev)
+
+        def cb(e, _ev=ev):
+            if e.finished:
+                _ev.set()
+
+        loop_obj.submit(req, cb)
+    for ev in done:
+        assert ev.wait(timeout), "request did not finish"
+
+
+# ---------------------------------------------------------------------------
+# accounting / burn-rate / audit units
+# ---------------------------------------------------------------------------
+
+class TestTenantAccountingUnit:
+    def test_topk_demotion_conserves_totals(self):
+        t = [0.0]
+        acc = TenantAccounting(
+            top_k=4, windows=(10.0, 100.0), clock=lambda: t[0]
+        )
+        for i in range(200):
+            t[0] += 0.01
+            acc.note_first_token(f"t-{i}", 0.02, 0.01, 5)
+            acc.note_tokens(f"t-{i}", 3)
+        tot = acc.totals()
+        assert tot["tracked_tenants"] == 4
+        assert tot["demotions"] == 196
+        roll = acc.rollup()
+        # top-4 + __other__, never more
+        assert len(roll["top"]) == 5
+        assert roll["top"][-1]["tenant"] == OTHER_TENANT
+        # folded, not lost: counter totals conserved across demotion
+        assert sum(e["requests"] for e in roll["top"]) == 200
+        assert sum(e["generated_tokens"] for e in roll["top"]) == 600
+        assert sum(e["prompt_tokens"] for e in roll["top"]) == 1000
+
+    def test_metrics_series_fixed_under_churn(self):
+        from helix_tpu.obs.metrics import Collector
+
+        t = [0.0]
+        acc = TenantAccounting(
+            top_k=3, windows=(10.0, 100.0), clock=lambda: t[0]
+        )
+        for i in range(50):
+            t[0] += 0.5
+            acc.note_first_token(f"churn-{i}", 0.02, 0.01, 2)
+        c = Collector()
+        acc.collect(c, {"model": "m"})
+        fam = c.families["helix_tenant_requests_total"][2]
+        tenants = {lbl["tenant"] for _, lbl, _ in fam}
+        assert len(tenants) == 4          # top-3 + __other__
+        assert OTHER_TENANT in tenants
+
+    def test_burn_rate_fast_window_violation(self):
+        t = [0.0]
+        acc = TenantAccounting(
+            top_k=4, windows=(60.0, 600.0),
+            targets=SLOTargets.from_dict(
+                {"ttft_p95_seconds": 0.1, "goodput_floor_tps": 100.0}
+            ),
+            clock=lambda: t[0],
+        )
+        # victim: every sample violates the 0.1 s target -> burn 20x
+        # bystander: every sample inside it -> burn 0
+        for _ in range(10):
+            t[0] += 1.0
+            acc.note_first_token("victim", 0.5, 0.01, 2)
+            acc.note_first_token("bystander", 0.02, 0.01, 2)
+        v = acc.burn_rates(tenant="victim")
+        b = acc.burn_rates(tenant="bystander")
+        assert v["fast"]["ttft_p95"] > 1.0
+        assert b["fast"]["ttft_p95"] < 1.0
+        # pooled per-model view sits between the two
+        m = acc.burn_rates()
+        assert b["fast"]["ttft_p95"] < m["fast"]["ttft_p95"]
+        # goodput floor is a CAPACITY SLO: judged only on the pooled
+        # per-model view — a per-tenant demand shortfall is not a
+        # violation, so per-tenant burns don't carry the key at all
+        assert "goodput_floor" not in v["fast"]
+        assert "goodput_floor" not in acc.burn_rates(
+            tenant="ghost"
+        )["fast"]
+        # the pooled view (active requests, ~zero goodput) burns hard
+        assert m["fast"]["goodput_floor"] > 1.0
+
+    def test_goodput_exact_at_high_token_rates(self):
+        # the counter-based window sums must not undercount a fast
+        # tenant (a per-token sample deque capped far below
+        # rate x window would): 100 tok/s against a 50 tps floor is
+        # healthy, burn 0
+        t = [0.0]
+        acc = TenantAccounting(
+            top_k=4, windows=(300.0, 3600.0),
+            targets=SLOTargets.from_dict({"goodput_floor_tps": 50.0}),
+            clock=lambda: t[0],
+        )
+        for _ in range(600):           # 10 minutes at 100 tok/s
+            t[0] += 1.0
+            acc.note_tokens("fast-tenant", 100)
+        snap = acc._snapshot("fast-tenant")
+        assert acc._goodput(snap, t[0]) == pytest.approx(100.0, rel=0.02)
+        br = acc.burn_rates()   # capacity SLO: the pooled view
+        assert br["fast"]["goodput_floor"] == 0.0
+        assert br["slow"]["goodput_floor"] == 0.0
+
+    def test_slow_window_burn_really_covers_the_hour(self):
+        # a 3-minute outage inside an otherwise clean hour: the fast
+        # window (5 m) recovers once the outage ages out, the slow
+        # window (1 h) must keep reporting the burned budget — at any
+        # request rate (minute buckets, not a bounded raw-sample deque)
+        t = [0.0]
+        acc = TenantAccounting(
+            top_k=2, windows=(300.0, 3600.0),
+            targets=SLOTargets.from_dict({"ttft_p95_seconds": 0.1}),
+            clock=lambda: t[0],
+        )
+        # 8 min clean at 5 req/s, 3 min violating, 8 min clean again
+        for phase, minutes, ttft in (
+            ("clean", 8, 0.02), ("outage", 3, 0.5), ("clean", 8, 0.02),
+        ):
+            for _ in range(minutes * 60):
+                t[0] += 1.0
+                for _ in range(5):
+                    acc.note_first_token("t1", ttft, 0.0, 1)
+        br = acc.burn_rates(tenant="t1")
+        assert br["fast"]["ttft_p95"] == 0.0          # outage aged out
+        # slow window: 900 violations / 5700 requests / 0.05 ~ 3.2
+        assert br["slow"]["ttft_p95"] > 1.0
+        # per-tenant bucket memory stays bounded to the slow horizon
+        with acc._lock:
+            assert len(acc._tenants["t1"].buckets) <= 62
+
+    def test_sanitize_and_resolve(self):
+        assert sanitize_tenant("usr_ab12") == "usr_ab12"
+        assert sanitize_tenant("a b!") == ANON_TENANT
+        assert sanitize_tenant("") == ANON_TENANT
+        assert sanitize_tenant(None) == ANON_TENANT
+        # a client may not claim the fold bucket
+        assert sanitize_tenant(OTHER_TENANT) == ANON_TENANT
+        assert sanitize_tenant("x" * 65) == ANON_TENANT
+        u = SimpleNamespace(id="usr_1", email="a@b")
+        assert resolve_tenant(u, "Bearer k") == "usr_1"
+        k1 = resolve_tenant(None, "Bearer secret-key")
+        assert k1.startswith("key-") and len(k1) == 16
+        assert resolve_tenant(None, "Bearer secret-key") == k1  # stable
+        assert resolve_tenant(None, None) == ANON_TENANT
+
+    def test_rollup_validation_and_merge(self):
+        # hostile runner input: bad tenant ids, non-finite numbers,
+        # unbounded entry lists — all clamped, heartbeat never rejected
+        v = validate_tenant_rollup({
+            "top": [
+                {"tenant": "good", "generated_tokens": 5,
+                 "burn_rate_fast": 2.5, "sheds": 1},
+                {"tenant": "evil !!", "burn_rate_fast": float("inf"),
+                 "generated_tokens": float("nan")},
+                {"tenant": OTHER_TENANT, "generated_tokens": 7},
+            ] + [{"tenant": f"flood-{i}"} for i in range(500)],
+            "tracked": 3,
+        })
+        assert len(v["top"]) <= 64
+        byt = {e["tenant"]: e for e in v["top"][:3]}
+        assert byt["good"]["burn_rate_fast"] == 2.5
+        assert ANON_TENANT in byt          # sanitised hostile id
+        assert byt[ANON_TENANT]["burn_rate_fast"] == 0
+        assert byt[OTHER_TENANT]["generated_tokens"] == 7
+        assert validate_tenant_rollup("nonsense") == {}
+        assert validate_tenant_rollup({"top": "x"}) == {}
+        # merge: counters sum, burn takes the worst, re-bounded
+        m = merge_rollups(
+            [
+                {"top": [{"tenant": "a", "generated_tokens": 5,
+                          "burn_rate_fast": 0.5}]},
+                {"top": [{"tenant": "a", "generated_tokens": 3,
+                          "burn_rate_fast": 2.0}]},
+            ],
+            top_k=8,
+        )
+        a = m["top"][0]
+        assert a["tenant"] == "a"
+        assert a["generated_tokens"] == 8
+        assert a["burn_rate_fast"] == 2.0
+        # overflow folds into __other__ with sums conserved
+        m2 = merge_rollups(
+            [{"top": [{"tenant": f"t{i}", "generated_tokens": 1}
+                      for i in range(10)]}],
+            top_k=3,
+        )
+        assert len(m2["top"]) == 4
+        assert m2["top"][-1]["tenant"] == OTHER_TENANT
+        assert sum(e["generated_tokens"] for e in m2["top"]) == 10
+        # tracked counts DISTINCT tenants, not engine/runner fan-out
+        m3 = merge_rollups(
+            [
+                {"top": [{"tenant": "a"}], "tracked": 1},
+                {"top": [{"tenant": "a"}], "tracked": 1},
+            ],
+            top_k=8,
+        )
+        assert m3["tracked"] == 1
+
+    def test_audit_ring_bounded(self):
+        audit = AdmissionAudit(capacity=8)
+        for i in range(20):
+            audit.record(
+                "queue_full", tenant=f"t{i}", trace_id="x" * 32,
+                request_id=f"r{i}", queue_depth=i,
+            )
+        snap = audit.snapshot(recent=64)
+        assert snap["recorded"] == 20
+        assert len(snap["recent"]) == 8          # ring bounded
+        assert snap["recent"][-1]["tenant"] == "t19"
+        assert snap["recent"][-1]["queue_depth"] == 19
+        assert snap["recent"][-1]["reason"] == "queue_full"
+
+
+# ---------------------------------------------------------------------------
+# lint contract 4: tenant labels only from obs/slo.py
+# ---------------------------------------------------------------------------
+
+class TestTenantLintContract:
+    def _tree(self, tmp_path, extra: str):
+        obs = tmp_path / "helix_tpu" / "obs"
+        obs.mkdir(parents=True)
+        (obs / "flight.py").write_text(
+            'SATURATION_KEYS = (\n    "kv_occupancy",\n)\n'
+        )
+        srv = tmp_path / "helix_tpu" / "serving"
+        srv.mkdir(parents=True)
+        (srv / "bad.py").write_text(extra)
+        return str(tmp_path)
+
+    def test_adhoc_tenant_label_rejected(self, tmp_path):
+        import tools.lint_metrics as lint
+
+        root = self._tree(
+            tmp_path,
+            'def f(c, t):\n'
+            '    c.gauge("helix_foo", 1, {"tenant": t})\n',
+        )
+        vs = lint.run(root)
+        assert any("ad-hoc 'tenant' metric label" in v for v in vs), vs
+
+    def test_tenant_family_literal_rejected(self, tmp_path):
+        import tools.lint_metrics as lint
+
+        root = self._tree(
+            tmp_path,
+            'NAME = "helix_tenant_rogue_total"\n',
+        )
+        vs = lint.run(root)
+        assert any("tenant/SLO metric family" in v for v in vs), vs
+
+    def test_ms_allowlist_is_gone(self, tmp_path):
+        import tools.lint_metrics as lint
+
+        assert not hasattr(lint, "_LEGACY_NAMES")
+        root = self._tree(tmp_path, 'NAME = "helix_model_swap_ms"\n')
+        vs = lint.run(root)
+        assert any("non-base-unit suffix" in v for v in vs), vs
+
+    def test_repo_is_clean(self):
+        import os
+
+        import tools.lint_metrics as lint
+
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        assert lint.run(root) == []
+
+
+# ---------------------------------------------------------------------------
+# engine-loop integration: cardinality under churn through a real loop
+# ---------------------------------------------------------------------------
+
+class TestChurnThroughEngineLoop:
+    def test_200_tenants_constant_series_and_conserved_totals(self):
+        from helix_tpu.engine.engine import Request
+        from helix_tpu.engine.sampling import SamplingParams
+        from helix_tpu.serving.engine_loop import EngineLoop
+        from helix_tpu.serving.openai_api import OpenAIServer
+        from helix_tpu.serving.registry import ModelRegistry, ServedModel
+        from helix_tpu.serving.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        engine = _tiny_engine(tok)
+        loop = EngineLoop(
+            engine, name="churn", tenant_top_k=6,
+            burn_windows=(30.0, 300.0),
+        ).start()
+        registry = ModelRegistry()
+        registry.register(
+            ServedModel(name="churn", loop=loop, tokenizer=tok,
+                        context_length=128)
+        )
+        api = OpenAIServer(registry)
+        try:
+            sampling = SamplingParams(temperature=0.0, max_tokens=1)
+            reqs = [
+                Request(
+                    id=f"churn-{i}",
+                    prompt_tokens=[(i % 100) + 1, 7, 9, 11],
+                    sampling=sampling,
+                    tenant=f"tenant-{i}",
+                )
+                for i in range(200)
+            ]
+            _drain(loop, reqs)
+
+            def tenant_labels(text):
+                out = {}
+                for line in text.splitlines():
+                    if not line.startswith("helix_tenant_"):
+                        continue
+                    if 'tenant="' not in line:
+                        # introspection series (tracked/demotions) are
+                        # per-model, intentionally tenant-unlabelled
+                        continue
+                    name = line.split("{", 1)[0]
+                    seen = out.setdefault(name, set())
+                    seen.add(line.split('tenant="', 1)[1].split('"')[0])
+                return out
+
+            text = api.obs.render()
+            fams = tenant_labels(text)
+            # every tenant-labelled family holds exactly top-K +
+            # __other__ label values — 200 tenants, 7 series each
+            for name, tenants in fams.items():
+                assert len(tenants) == 7, (name, sorted(tenants))
+                assert OTHER_TENANT in tenants
+            # conservation: requests/tokens folded, not lost
+            tot = loop.slo.accounting.totals()
+            assert tot["requests"] == 200
+            assert tot["prompt_tokens"] == 800
+            assert tot["demotions"] == 194
+            roll = loop.slo.rollup()
+            assert sum(e["requests"] for e in roll["top"]) == 200
+            # a second churn wave leaves the series count unchanged
+            # longer generations so decode batches hold several
+            # tenants at once (feeds the distinct_tenants flight axis)
+            reqs2 = [
+                Request(
+                    id=f"churn2-{i}",
+                    prompt_tokens=[(i % 100) + 1, 7, 9, 11],
+                    sampling=SamplingParams(
+                        temperature=0.0, max_tokens=6
+                    ),
+                    tenant=f"wave2-{i}",
+                )
+                for i in range(40)
+            ]
+            _drain(loop, reqs2)
+            fams2 = tenant_labels(api.obs.render())
+            for name, tenants in fams2.items():
+                assert len(tenants) == 7, (name, sorted(tenants))
+            # the flight recorder's per-step records carry the
+            # distinct-tenant count of each batch
+            recent = loop.flight.snapshot(recent=512)["recent"]
+            assert recent and all(
+                "distinct_tenants" in r for r in recent
+            )
+            assert max(r["distinct_tenants"] for r in recent) >= 2
+        finally:
+            loop.stop(join=False)
+
+    def test_preemption_audited_with_tenant(self):
+        """The preempt-by-swap rung records (tenant, trace, reason)
+        into the audit ring — exercised at the _memory_pressure_tick
+        seam with a stubbed engine preemption."""
+        from helix_tpu.engine.engine import Request
+        from helix_tpu.serving.engine_loop import EngineLoop
+        from helix_tpu.serving.tokenizer import ByteTokenizer
+
+        tok = ByteTokenizer()
+        engine = _tiny_engine(tok)
+        loop = EngineLoop(
+            engine, name="pre", preempt_stall_seconds=0.0,
+        )   # not started: we drive the tick directly
+        victim = Request(
+            id="vic-1", prompt_tokens=[1, 2, 3], tenant="tenant-vic",
+            trace_id="a" * 32,
+        )
+        engine._requests[victim.id] = victim
+        engine.waiting.append(
+            Request(id="starved", prompt_tokens=[4, 5])
+        )
+        engine.preempt_for_pressure = lambda: victim.id
+        loop._stall_since = time.monotonic() - 10.0
+        loop._admit_seen = engine.num_admitted
+        loop._memory_pressure_tick()
+        snap = loop.slo.audit.snapshot()
+        pre = [r for r in snap["recent"]
+               if r["reason"] == "preempt_by_swap"]
+        assert pre, snap
+        assert pre[-1]["tenant"] == "tenant-vic"
+        assert pre[-1]["request_id"] == "vic-1"
+        assert pre[-1]["trace_id"] == "a" * 32
+        assert loop.slo.accounting.totals()["preemptions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the serving spine: runner + control planes
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spine():
+    """Runner serving two tiny models (m1, m2) + two control planes
+    (auth on / auth off), all in-process."""
+    from helix_tpu.control.server import ControlPlane
+    from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.openai_api import OpenAIServer
+    from helix_tpu.serving.registry import ModelRegistry, ServedModel
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    registry = ModelRegistry()
+    loops = {}
+    for name in ("m1", "m2"):
+        engine = _tiny_engine(tok)
+        loop = EngineLoop(
+            engine, name=name, tenant_top_k=8,
+            burn_windows=(30.0, 300.0),
+            slo_targets={"ttft_p95_seconds": 0.2},
+        ).start()
+        loops[name] = loop
+        registry.register(
+            ServedModel(name=name, loop=loop, tokenizer=tok,
+                        context_length=128)
+        )
+    api = OpenAIServer(registry)
+    holder: dict = {}
+    runner_port = _serve_app(api.build_app(), holder)
+    runner_url = f"http://127.0.0.1:{runner_port}"
+
+    cp_auth = ControlPlane(auth_required=True, runner_token="rt")
+    cp_open = ControlPlane()
+    auth_port = _serve_app(cp_auth.build_app(), holder)
+    open_port = _serve_app(cp_open.build_app(), holder)
+
+    admin = cp_auth.auth.create_user("op@x", name="Op", admin=True)
+    admin_key = cp_auth.auth.create_api_key(admin.id)
+
+    def heartbeat(cp_url, rid="slor1", headers=None, tenants=None):
+        body = {
+            "runner_id": rid,
+            "address": runner_url,
+            "accelerators": [],
+            "profile": {"name": "p", "status": "running",
+                        "models": ["m1", "m2"]},
+            "saturation": {},
+        }
+        if tenants is not None:
+            body["tenants"] = tenants
+        r = requests.post(
+            f"{cp_url}/api/v1/runners/{rid}/heartbeat", json=body,
+            headers=headers or {}, timeout=10,
+        )
+        assert r.status_code == 200, r.text
+    yield SimpleNamespace(
+        registry=registry,
+        loops=loops,
+        runner_url=runner_url,
+        auth_url=f"http://127.0.0.1:{auth_port}",
+        open_url=f"http://127.0.0.1:{open_port}",
+        cp_auth=cp_auth,
+        cp_open=cp_open,
+        admin=admin,
+        admin_key=admin_key,
+        heartbeat=heartbeat,
+    )
+    cp_auth.stop()
+    cp_open.stop()
+    for loop in loops.values():
+        loop.stop(join=False)
+    for lp in holder.get("loops", []):
+        lp.call_soon_threadsafe(lp.stop)
+
+
+def _chat(url, model="m1", headers=None, max_tokens=4, timeout=60):
+    return requests.post(
+        f"{url}/v1/chat/completions",
+        json={
+            "model": model, "max_tokens": max_tokens, "temperature": 0,
+            "messages": [{"role": "user", "content": "hello tenants"}],
+        },
+        headers=headers or {},
+        timeout=timeout,
+    )
+
+
+class TestIdentityPropagation:
+    def test_auth_dispatch_surfaces_tenant_everywhere(self, spine):
+        spine.heartbeat(
+            spine.auth_url, headers={"X-Runner-Token": "rt"}
+        )
+        bearer = {"Authorization": f"Bearer {spine.admin_key}"}
+        r = _chat(spine.auth_url, headers=bearer)
+        assert r.status_code == 200, r.text
+        uid = spine.admin.id
+        # 1) runner /metrics carries the auth-resolved tenant id
+        text = requests.get(
+            f"{spine.runner_url}/metrics", timeout=10
+        ).text
+        assert (
+            f'helix_tenant_requests_total{{model="m1",tenant="{uid}"}}'
+            in text
+        ), text[:2000]
+        # 2) a shed lands in the admission audit ring with that tenant
+        loop = spine.loops["m1"]
+        loop.max_queue_depth = 0
+        try:
+            r = _chat(spine.auth_url, headers=bearer)
+            assert r.status_code == 429, r.text
+        finally:
+            loop.max_queue_depth = None
+        audit = requests.get(
+            f"{spine.runner_url}/v1/debug/admissions?model=m1",
+            timeout=10,
+        ).json()["models"]["m1"]
+        sheds = [e for e in audit["recent"]
+                 if e["reason"] == "queue_full"]
+        assert sheds and sheds[-1]["tenant"] == uid
+        assert sheds[-1]["trace_id"]
+        assert "queue_depth" in sheds[-1]
+        # 3) the federated rollup joins the dispatch-resolved identity
+        from helix_tpu.control.node_agent import NodeAgent
+
+        agent = NodeAgent("slor1", registry=spine.registry)
+        payload = agent.heartbeat_payload()
+        assert any(
+            e["tenant"] == uid for e in payload["tenants"]["top"]
+        ), payload["tenants"]
+        spine.heartbeat(
+            spine.auth_url, headers={"X-Runner-Token": "rt"},
+            tenants=payload["tenants"],
+        )
+        doc = requests.get(
+            f"{spine.auth_url}/v1/tenants/usage", headers=bearer,
+            timeout=10,
+        ).json()
+        mine = [t for t in doc["tenants"] if t["tenant"] == uid]
+        assert mine, doc
+        assert mine[0]["identity"]["email"] == "op@x"
+        assert mine[0]["runners"] == ["slor1"]
+        assert mine[0]["generated_tokens"] >= 1
+        assert doc["cluster"]["runners_reporting"] == 1
+        # the cp renders the federated burn gauges for that tenant
+        cp_text = requests.get(
+            f"{spine.auth_url}/metrics", timeout=10
+        ).text
+        assert (
+            f'helix_cp_slo_burn_rate{{tenant="{uid}",window="fast"}}'
+            in cp_text
+        )
+        assert 'helix_cp_worst_tenant_burn_rate{window="fast"}' in cp_text
+
+    def test_usage_admin_gated(self, spine):
+        r = requests.get(
+            f"{spine.auth_url}/v1/tenants/usage", timeout=10
+        )
+        assert r.status_code == 401
+        r = requests.get(
+            f"{spine.runner_url}/v1/debug/admissions", timeout=10
+        )
+        assert r.status_code == 200   # no runner token configured
+
+    def test_runner_restart_clears_stale_rollup(self, spine):
+        """A restarted runner heartbeats an empty tenants block; the cp
+        must clear the stale rollup, not freeze yesterday's burn."""
+        hdr = {"X-Runner-Token": "rt"}
+        spine.heartbeat(
+            spine.auth_url, rid="restr", headers=hdr,
+            tenants={"top": [{"tenant": "stale-t",
+                              "burn_rate_fast": 20.0}], "tracked": 1},
+        )
+        text = requests.get(
+            f"{spine.auth_url}/metrics", timeout=10
+        ).text
+        assert 'tenant="stale-t"' in text
+        spine.heartbeat(spine.auth_url, rid="restr", headers=hdr)
+        text = requests.get(
+            f"{spine.auth_url}/metrics", timeout=10
+        ).text
+        assert 'tenant="stale-t"' not in text
+
+    def test_auth_off_lands_under_anonymous(self, spine):
+        spine.heartbeat(spine.open_url, rid="openr1")
+        r = _chat(spine.open_url, model="m2")
+        assert r.status_code == 200, r.text
+        text = requests.get(
+            f"{spine.runner_url}/metrics", timeout=10
+        ).text
+        assert (
+            'helix_tenant_requests_total{model="m2",tenant="anonymous"}'
+            in text
+        )
+        # no endpoint 500s without auth/tenants anywhere
+        r = requests.get(
+            f"{spine.open_url}/v1/tenants/usage", timeout=10
+        )
+        assert r.status_code == 200, r.text
+        assert requests.get(
+            f"{spine.runner_url}/v1/debug/admissions", timeout=10
+        ).status_code == 200
+
+    def test_hostile_tenant_header_cannot_mint_labels(self, spine):
+        r = _chat(
+            spine.runner_url, model="m2",
+            headers={"X-Helix-Tenant": 'evil"} bad {label'},
+        )
+        assert r.status_code == 200, r.text
+        text = requests.get(
+            f"{spine.runner_url}/metrics", timeout=10
+        ).text
+        assert "evil" not in text
+
+
+class TestTwoTenantChaos:
+    def test_slow_fault_distinguishes_victim_tenant(self, spine):
+        """The acceptance scenario: a slow-step fault degrades m1 only;
+        tenant-a (on m1) blows its TTFT SLO — fast-window burn > 1.0 —
+        while tenant-b (on m2) stays under it, and every shed in the
+        run appears in /v1/debug/admissions with tenant + reason."""
+        # clean baseline traffic for both tenants
+        for _ in range(2):
+            assert _chat(
+                spine.runner_url, model="m1",
+                headers={"X-Helix-Tenant": "tenant-a"},
+            ).status_code == 200
+            assert _chat(
+                spine.runner_url, model="m2",
+                headers={"X-Helix-Tenant": "tenant-b"},
+            ).status_code == 200
+        # degrade m1: every step sleeps 0.4 s (>> the 0.2 s TTFT target)
+        faults.arm(
+            seed=3,
+            rules=[{"point": "engine_step", "engine": "m1",
+                    "mode": "slow", "delay": 0.4, "times": 12}],
+        )
+        try:
+            for _ in range(3):
+                assert _chat(
+                    spine.runner_url, model="m1",
+                    headers={"X-Helix-Tenant": "tenant-a"},
+                    timeout=120,
+                ).status_code == 200
+                assert _chat(
+                    spine.runner_url, model="m2",
+                    headers={"X-Helix-Tenant": "tenant-b"},
+                ).status_code == 200
+        finally:
+            faults.disarm()
+        m1, m2 = spine.loops["m1"], spine.loops["m2"]
+        burn_a = m1.slo.burn_rates("tenant-a")["fast"]["ttft_p95"]
+        burn_b = m2.slo.burn_rates("tenant-b")["fast"]["ttft_p95"]
+        assert burn_a > 1.0, (burn_a, burn_b)
+        assert burn_b < 1.0, (burn_a, burn_b)
+        # the /metrics series distinguish the victim tenant
+        text = requests.get(
+            f"{spine.runner_url}/metrics", timeout=10
+        ).text
+
+        def gauge(line_prefix):
+            for line in text.splitlines():
+                if line.startswith(line_prefix):
+                    return float(line.rsplit(" ", 1)[1])
+            raise AssertionError(f"missing series {line_prefix}")
+
+        ttft_a = gauge(
+            'helix_tenant_ttft_p95_seconds{model="m1",tenant="tenant-a"}'
+        )
+        ttft_b = gauge(
+            'helix_tenant_ttft_p95_seconds{model="m2",tenant="tenant-b"}'
+        )
+        assert ttft_a > 0.2 > ttft_b
+        assert gauge(
+            'helix_tenant_slo_burn_rate{model="m1",tenant="tenant-a",'
+            'slo="ttft_p95",window="fast"}'
+        ) > 1.0
+        assert gauge(
+            'helix_tenant_slo_burn_rate{model="m2",tenant="tenant-b",'
+            'slo="ttft_p95",window="fast"}'
+        ) < 1.0
+
+    def test_every_shed_in_run_is_audited(self, spine):
+        """Shed a burst and reconcile: the shed counter delta equals
+        the audit entries recorded for the run, each with the correct
+        tenant and reason."""
+        loop = spine.loops["m1"]
+        before_recorded = loop.slo.audit.recorded
+        before_sheds = loop.shed_requests
+        loop.max_queue_depth = 0
+        try:
+            for i in range(5):
+                r = _chat(
+                    spine.runner_url, model="m1",
+                    headers={"X-Helix-Tenant": "tenant-a"},
+                )
+                assert r.status_code == 429, r.text
+        finally:
+            loop.max_queue_depth = None
+        shed_delta = loop.shed_requests - before_sheds
+        assert shed_delta == 5
+        snap = loop.slo.audit.snapshot(recent=256)
+        assert snap["recorded"] - before_recorded == shed_delta
+        new = snap["recent"][-shed_delta:]
+        assert all(e["reason"] == "queue_full" for e in new)
+        assert all(e["tenant"] == "tenant-a" for e in new)
+        # and the per-tenant shed counter agrees
+        text = requests.get(
+            f"{spine.runner_url}/metrics", timeout=10
+        ).text
+        line = [
+            ln for ln in text.splitlines()
+            if ln.startswith(
+                'helix_tenant_sheds_total{model="m1",tenant="tenant-a"}'
+            )
+        ]
+        assert line and float(line[0].rsplit(" ", 1)[1]) >= 5
+
+    def test_debug_admissions_token_gated(self, spine, monkeypatch):
+        monkeypatch.setenv("HELIX_RUNNER_TOKEN", "sekrit")
+        r = requests.get(
+            f"{spine.runner_url}/v1/debug/admissions", timeout=10
+        )
+        assert r.status_code == 403
+        r = requests.get(
+            f"{spine.runner_url}/v1/debug/admissions",
+            headers={"X-Runner-Token": "sekrit"}, timeout=10,
+        )
+        assert r.status_code == 200

@@ -7,8 +7,8 @@ Two contracts, enforced repo-wide (wired into tier-1 via
 1. **Naming**: every metric-name string literal (``"helix_..."``) must
    be lowercase snake_case (``helix_[a-z0-9_]+``) with base-unit
    suffixes only — ``_total`` for counters, ``_seconds`` / ``_bytes``
-   for units; ``_ms`` / ``_cnt``-style suffixes are rejected (a short
-   legacy allowlist grandfathers PR 1's ms gauges).
+   for units; ``_ms`` / ``_cnt``-style suffixes are rejected (the PR 1
+   ``_ms`` allowlist is gone: those series are renamed to ``_seconds``).
 2. **No ad-hoc exposition**: Prometheus text formatting (f-strings that
    build ``helix_...`` sample lines, or ``# TYPE`` literals) may exist
    ONLY inside ``helix_tpu/obs/`` — everything else feeds the shared
@@ -19,6 +19,14 @@ Two contracts, enforced repo-wide (wired into tier-1 via
    from ``helix_tpu.obs.flight.SATURATION_KEYS``.  The linter fails if
    either side stops importing the shared tuple, or if any hard-coded
    ``helix_cp_runner_saturation_<key>`` literal names a key outside it.
+4. **Bounded tenant labels**: any metric emitted with a ``tenant``
+   label must come from ``helix_tpu/obs/slo.py``'s bounded top-K
+   accounting — the linter rejects ``helix_tenant_*`` /
+   ``helix_slo_*`` / ``helix_cp_slo_*`` name literals and
+   ``tenant``-labelled collector/metric calls anywhere else, so ad-hoc
+   unbounded tenant label cardinality can't drift in later.  The
+   federation sides (node agent emits, control plane consumes) must
+   keep importing the shared ``TENANT_KEYS`` entry schema.
 
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
@@ -37,13 +45,6 @@ NAME_RE = re.compile(r"helix_[a-z0-9_]+")
 # sizes are _bytes.  Non-base-unit suffixes are rejected so new series
 # can't drift into _ms/_cnt style.
 _BAD_SUFFIXES = ("_ms", "_us", "_millis", "_msec", "_cnt", "_num")
-# PR 1-era gauges kept for dashboard continuity; do not add to this list
-_LEGACY_NAMES = frozenset({
-    "helix_ttft_ms_p50",
-    "helix_ttft_ms_p95",
-    "helix_model_swap_ms",
-    "helix_model_load_ms",
-})
 
 # any quoted string that *starts* with helix_ is treated as a metric-name
 # candidate (module paths use dots / dashes and never match)
@@ -100,6 +101,36 @@ _SAT_IMPORTERS = (
     os.path.join("helix_tpu", "control", "server.py"),
 )
 
+# -- contract 4: bounded tenant labels --------------------------------------
+# Tenant-labelled series may only be minted by obs/slo.py's bounded
+# accounting (top-K + __other__, LRU demotion): a `tenant` label applied
+# anywhere else is unbounded cardinality waiting to happen.  Two textual
+# detectors, same style as contract 3:
+#   - name literals in the tenant/SLO families outside obs/slo.py
+#   - a collector/metric call passing a "tenant" label key
+# quoted literals only: prose in docstrings may NAME the families, but
+# an actual emission site passes the name as a string literal
+_TENANT_NAME_RE = re.compile(
+    r"[\"']helix_(?:cp_)?(?:tenant_[a-z0-9_]+|slo_[a-z0-9_]+"
+    r"|worst_tenant_[a-z0-9_]+)[\"']"
+)
+_TENANT_LABEL_CALL = re.compile(
+    r"\.(?:gauge|counter|histogram|metric|labels)\("
+    r"[^#]*[\"']tenant[\"']"
+)
+# the federation schema both planes must share (TENANT_KEYS entries):
+# the node agent builds the heartbeat `tenants` block from it and the
+# control plane filters/renders with it
+_TENANT_IMPORTERS = (
+    os.path.join("helix_tpu", "control", "node_agent.py"),
+    os.path.join("helix_tpu", "control", "server.py"),
+)
+
+
+def _is_slo(path: str, root: str) -> bool:
+    rel = os.path.relpath(path, root)
+    return rel == os.path.join("helix_tpu", "obs", "slo.py")
+
 
 def _load_saturation_schema(root: str):
     """Contract 3 setup: the shared SATURATION_KEYS set from
@@ -137,9 +168,28 @@ def _load_saturation_schema(root: str):
     return keys, violations
 
 
+def _tenant_schema_violations(root: str) -> list:
+    """Contract 4 setup: both federation sides must keep referencing the
+    shared TENANT_KEYS entry schema (the SATURATION_KEYS importer
+    rule)."""
+    violations = []
+    for rel in _TENANT_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if "TENANT_KEYS" not in f.read():
+                violations.append(
+                    f"{rel}: does not use the shared tenant rollup "
+                    "schema (obs.slo.TENANT_KEYS)"
+                )
+    return violations
+
+
 def run(root: str) -> list:
     """Returns a list of violation strings (empty = clean)."""
     sat_keys, violations = _load_saturation_schema(root)
+    violations += _tenant_schema_violations(root)
     for path in _iter_py_files(root):
         if _is_self(path):
             continue
@@ -147,7 +197,22 @@ def run(root: str) -> list:
         with open(path, encoding="utf-8", errors="replace") as f:
             lines = f.read().splitlines()
         allowed_exposition = _in_obs(path, root)
+        tenant_emitter = _is_slo(path, root)
         for i, line in enumerate(lines, 1):
+            if not tenant_emitter:
+                if _TENANT_NAME_RE.search(line):
+                    violations.append(
+                        f"{rel}:{i}: tenant/SLO metric family named "
+                        "outside helix_tpu/obs/slo.py — tenant-labelled "
+                        "series must come from its bounded accounting"
+                    )
+                elif _TENANT_LABEL_CALL.search(line):
+                    violations.append(
+                        f"{rel}:{i}: ad-hoc 'tenant' metric label "
+                        "outside helix_tpu/obs/slo.py — unbounded "
+                        "tenant cardinality; route through the bounded "
+                        "top-K accounting"
+                    )
             for gm in _SAT_GAUGE_RE.finditer(line):
                 if sat_keys and gm.group(1) not in sat_keys:
                     violations.append(
@@ -162,10 +227,7 @@ def run(root: str) -> list:
                         f"{rel}:{i}: metric name {name!r} violates "
                         "helix_[a-z0-9_]+ (lowercase snake_case)"
                     )
-                elif (
-                    name not in _LEGACY_NAMES
-                    and any(name.endswith(s) for s in _BAD_SUFFIXES)
-                ):
+                elif any(name.endswith(s) for s in _BAD_SUFFIXES):
                     violations.append(
                         f"{rel}:{i}: metric name {name!r} uses a "
                         "non-base-unit suffix; use _seconds/_bytes/_total"
