@@ -656,6 +656,149 @@ def main():
     slo_loop.stop(join=True)
     del slo_loop, slo_eng
 
+    # FIFO vs WFQ fairness (ISSUE 9): a flooding batch tenant vs an
+    # interactive tenant over the PR 7 two-tenant baseline.  The claim
+    # under test: with the WFQ scheduler the interactive tenant's TTFT
+    # p95 stays within ~2x of its uncontended value while the FIFO
+    # baseline (interactive queued behind the whole flood) blows past
+    # it, total goodput stays within ~10% of FIFO (ordering changes,
+    # work doesn't), and greedy outputs are bit-identical to the
+    # unscheduled engine for every completed request.
+    fair_slots = 2   # few slots so the flood actually queues
+    fair_kw = dict(
+        max_decode_batch=fair_slots, page_size=16, num_pages=num_pages,
+        max_pages_per_seq=64, max_prefill_len=512 if on_tpu else 32,
+        enable_prefix_cache=False, kv_cache_dtype=kv_dtype,
+    )
+    flood_n, chat_n = 6 * fair_slots, 4
+    fair_sampling = SamplingParams(
+        temperature=0.0, max_tokens=min(gen_len, 8)
+    )
+
+    def fair_prompts(tag, n, seed):
+        return {
+            f"{tag}-{i}": [
+                (seed * 131 + 17 * i + j) % (cfg.vocab_size - 2) + 1
+                for j in range(prompt_len)
+            ]
+            for i in range(n)
+        }
+
+    bulk_prompts = fair_prompts("bulk", flood_n, 3)
+    chat_prompts = fair_prompts("chat", chat_n, 11)
+
+    def fair_req(rid, prompt, tenant="", klass=""):
+        return Request(
+            id=rid, prompt_tokens=list(prompt), sampling=fair_sampling,
+            tenant=tenant or "bulk", sched_class=klass,
+        )
+
+    # unscheduled reference: the same requests stepped straight through
+    # a bare engine — the scheduler may only change ORDER, not tokens
+    ref_eng = Engine(cfg, params, EngineConfig(**fair_kw))
+    ref_reqs = [
+        fair_req(rid, p)
+        for rid, p in {**bulk_prompts, **chat_prompts}.items()
+    ]
+    for r in ref_reqs:
+        ref_eng.add_request(r)
+    while ref_eng.has_work():
+        ref_eng.step()
+    ref_out = {r.id: list(r.output_tokens) for r in ref_reqs}
+    del ref_eng
+
+    def fair_pass(policy: str, contended: bool):
+        eng_f = Engine(cfg, params, EngineConfig(**fair_kw))
+        loop_f = EngineLoop(
+            eng_f, name=f"bench-fair-{policy}",
+            sched_config={"sched": {"policy": policy}},
+        ).start()
+
+        def drive(reqs):
+            done = []
+            for r in reqs:
+                ev = _threading.Event()
+                done.append(ev)
+
+                def cb(e, _ev=ev):
+                    if e.finished:
+                        _ev.set()
+
+                loop_f.submit(r, cb)
+            for ev in done:
+                ev.wait(timeout=600)
+
+        # warm pass: every compiled shape lands before the clock starts
+        drive([
+            fair_req(f"warm-{i}", bulk_prompts[f"bulk-{i}"])
+            for i in range(fair_slots)
+        ])
+        loop_f.slo = SLOObserver(top_k=4)
+        reqs = []
+        if contended:
+            reqs += [
+                fair_req(rid, p, tenant="bulk", klass="batch")
+                for rid, p in bulk_prompts.items()
+            ]
+        reqs += [
+            fair_req(rid, p, tenant="chat", klass="interactive")
+            for rid, p in chat_prompts.items()
+        ]
+        t0 = time.perf_counter()
+        drive(reqs)
+        elapsed = time.perf_counter() - t0
+        summary = loop_f.slo.summary()
+        outputs = {r.id: list(r.output_tokens) for r in reqs}
+        loop_f.stop(join=True)
+        del loop_f, eng_f
+        toks = sum(len(v) for v in outputs.values())
+        return {
+            "interactive_ttft_p95_seconds": summary["tenants"]
+            .get("chat", {})
+            .get("ttft_p95_seconds", 0.0),
+            "goodput_tokens_per_second": round(
+                toks / max(elapsed, 1e-9), 2
+            ),
+            "tenant_generated_tokens": {
+                t: d["generated_tokens"]
+                for t, d in summary["tenants"].items()
+            },
+        }, outputs
+
+    uncontended, _ = fair_pass("fifo", contended=False)
+    fifo, fifo_out = fair_pass("fifo", contended=True)
+    wfq, wfq_out = fair_pass("wfq", contended=True)
+    base_ttft = max(
+        uncontended["interactive_ttft_p95_seconds"], 1e-9
+    )
+    result["fairness"] = {
+        "flood_requests": flood_n,
+        "interactive_requests": chat_n,
+        "decode_slots": fair_slots,
+        "uncontended_interactive_ttft_p95_seconds": uncontended[
+            "interactive_ttft_p95_seconds"
+        ],
+        "fifo": fifo,
+        "wfq": wfq,
+        "wfq_ttft_vs_uncontended": round(
+            wfq["interactive_ttft_p95_seconds"] / base_ttft, 2
+        ),
+        "fifo_ttft_vs_uncontended": round(
+            fifo["interactive_ttft_p95_seconds"] / base_ttft, 2
+        ),
+        "goodput_ratio_wfq_vs_fifo": round(
+            wfq["goodput_tokens_per_second"]
+            / max(fifo["goodput_tokens_per_second"], 1e-9),
+            3,
+        ),
+        # bit-identity vs the unscheduled engine (greedy): the
+        # scheduler reorders admissions, it never changes tokens
+        "outputs_bit_identical": bool(
+            all(fifo_out[rid] == ref_out[rid] for rid in fifo_out)
+            and all(wfq_out[rid] == ref_out[rid] for rid in wfq_out)
+        ),
+    }
+
     if on_tpu:
         # decode-side model FLOPs utilisation: each generated token moves
         # ~2 FLOPs per active parameter through the MXU; a v5e chip peaks

@@ -300,6 +300,53 @@ ENV_REFERENCE: tuple = (
         default="0",
         section="observability",
     ),
+    # -- scheduler (serving/sched.py; README "Scheduling") ---------------
+    # HELIX_SCHED_* knobs beat the profile's slo.sched block (the
+    # HELIX_SPEC_TOKENS operator-override contract)
+    EnvVar(
+        "HELIX_SCHED_POLICY",
+        "Scheduler policy for every engine this node serves: 'wfq' "
+        "turns on strict interactive/batch priority tiers + per-tenant "
+        "deficit-weighted fair queueing; 'fifo' forces the baseline "
+        "FIFO ordering even where a profile enables wfq. Unset: the "
+        "profile's slo.sched.policy applies (default fifo).",
+        section="scheduler",
+    ),
+    EnvVar(
+        "HELIX_SCHED_DEFAULT_CLASS",
+        "Priority class assumed for requests that carry no (or an "
+        "unauthenticated) X-Helix-Class header: 'interactive' or "
+        "'batch'. Unset: the profile's slo.sched.default_class "
+        "(default interactive).",
+        section="scheduler",
+    ),
+    EnvVar(
+        "HELIX_SCHED_TENANT_QUEUE_DEPTH",
+        "Bounded per-tenant queues: max queued requests one tenant may "
+        "hold before ITS submissions get 429s (per-tenant queue_full), "
+        "so a flooding tenant cannot fill the global admission bound "
+        "and starve everyone else. Unset: the profile's "
+        "slo.sched.max_tenant_queue_depth (default unbounded).",
+        section="scheduler",
+    ),
+    EnvVar(
+        "HELIX_SCHED_PREFILL_BUDGET",
+        "Adaptive per-step prefill-admission token budget (cap and "
+        "initial value) under the wfq policy: halves toward the floor "
+        "while the fast-window TTFT/queue-wait burn rate exceeds 1.0, "
+        "grows back 1.25x once healthy. Unset: the profile's "
+        "slo.sched.prefill_budget_tokens (default unbudgeted).",
+        section="scheduler",
+    ),
+    EnvVar(
+        "HELIX_SCHED_PREFILL_BUDGET_MIN",
+        "Floor the TTFT-burn feedback loop may shrink the prefill "
+        "budget to; admission always makes progress (>= 1 admission "
+        "per step) regardless. Unset: the profile's "
+        "slo.sched.prefill_budget_min_tokens.",
+        default="256",
+        section="scheduler",
+    ),
     # -- dispatch robustness (control plane -> runner) -------------------
     EnvVar(
         "HELIX_DISPATCH_MAX_ATTEMPTS",

@@ -99,6 +99,7 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     from helix_tpu.models.llama import init_params
     from helix_tpu.ops.quant import quantize_params
     from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.sched import SchedConfig
 
     vision_runner = None
     if pm.kind == "vision":
@@ -326,6 +327,12 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         # read inside obs/slo.py when left None here)
         slo_targets=pm.slo,
         tenant_top_k=_bound("HELIX_TENANT_TOP_K"),
+        # the scheduler (ISSUE 9): policy, class default, per-tenant DRR
+        # weights, bounded tenant queues and the adaptive prefill budget
+        # come from the profile's slo.sched block; HELIX_SCHED_* env
+        # knobs beat the profile (the HELIX_SPEC_TOKENS contract) — see
+        # README "Scheduling"
+        sched_config=SchedConfig.from_profile(pm.slo),
     ).start()
     return ServedModel(
         name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,
@@ -543,6 +550,7 @@ class NodeAgent:
         drafted = accepted = 0
         host_used = host_budget = 0
         preempted = 0
+        prefill_budget = 0
         tps = 0.0
         for m in self._live_models():
             loop = getattr(m, "loop", None)
@@ -552,6 +560,9 @@ class NodeAgent:
             slots_busy += sat["slots_busy"]
             slots_total += sat["slots_total"]
             queue_depth += sat["queue_depth"]
+            # per-step prefill-admission capacity sums across engines
+            # (0 per engine = unbudgeted)
+            prefill_budget += sat.get("prefill_budget_tokens", 0)
             tps += sat["tokens_per_sec"]
             eng = loop.engine
             kv_used += getattr(eng, "kv_pages_used", 0)
@@ -587,6 +598,7 @@ class NodeAgent:
                 round(host_used / host_budget, 4) if host_budget else 0.0
             ),
             "preempted_requests": preempted,
+            "prefill_budget_tokens": prefill_budget,
         }
         # schema lockstep: emit exactly the shared key set
         return {k: out[k] for k in SATURATION_KEYS}

@@ -46,6 +46,7 @@ from helix_tpu.obs.slo import (
     validate_tenant_rollup,
 )
 from helix_tpu.obs.trace import TRACE_HEADER
+from helix_tpu.serving.sched import CLASS_HEADER, sanitize_class
 
 _dispatch_log = logging.getLogger("helix.dispatch")
 
@@ -4809,6 +4810,15 @@ class ControlPlane:
             request.get("user"), request.headers.get("Authorization")
         )
         self._note_tenant_identity(tenant, request.get("user"))
+        # scheduler priority class (ISSUE 9): the caller's X-Helix-Class
+        # is honoured only when auth resolved an identity — anonymous
+        # traffic cannot self-select "interactive" and gets the serving
+        # profile's default class at the runner
+        sched_class = (
+            sanitize_class(request.headers.get(CLASS_HEADER))
+            if tenant != ANON_TENANT
+            else ""
+        )
         t_req = time.monotonic()
         model = body.get("model", "")
         if not model:
@@ -4902,7 +4912,7 @@ class ControlPlane:
                         )
                 resp = await self._dispatch_attempt(
                     request, runner, raw, deadline, acct, trace_id,
-                    tenant,
+                    tenant, sched_class,
                 )
                 # headers committed, but the stream may still have died
                 # mid-flight (the attempt resolved its own account):
@@ -5007,7 +5017,8 @@ class ControlPlane:
             self._tenant_identities.popitem(last=False)
 
     async def _dispatch_attempt(self, request, runner, raw, deadline, acct,
-                                trace_id: str = "", tenant: str = ""):
+                                trace_id: str = "", tenant: str = "",
+                                sched_class: str = ""):
         """One dispatch to one runner.  Raises for failures before the
         first streamed byte (the caller fails over); after headers are
         committed, mid-stream runner death is reported in-band on SSE
@@ -5017,7 +5028,7 @@ class ControlPlane:
         address = runner.meta.get("address")
         if not address:
             return await self._dispatch_tunnel(
-                request, runner, raw, acct, trace_id, tenant
+                request, runner, raw, acct, trace_id, tenant, sched_class
             )
         url = f"{address}{request.path}"
         remaining = max(
@@ -5030,6 +5041,8 @@ class ControlPlane:
         }
         if tenant:
             headers[TENANT_HEADER] = tenant
+        if sched_class:
+            headers[CLASS_HEADER] = sched_class
         async with session.post(
             url,
             data=raw,
@@ -5209,7 +5222,8 @@ class ControlPlane:
             return _err(e.status if 400 <= e.status < 600 else 502, str(e))
 
     async def _dispatch_tunnel(self, request, runner, raw: bytes, acct,
-                               trace_id: str = "", tenant: str = ""):
+                               trace_id: str = "", tenant: str = "",
+                               sched_class: str = ""):
         """Dispatch through the runner's reverse tunnel, preserving SSE
         chunk boundaries.  Mid-stream tunnel death surfaces as a terminal
         SSE error frame on SSE responses / an aborted connection on JSON
@@ -5224,6 +5238,8 @@ class ControlPlane:
             }
             if tenant:
                 fwd_headers[TENANT_HEADER] = tenant
+            if sched_class:
+                fwd_headers[CLASS_HEADER] = sched_class
             status, headers, chunks = await self.tunnels.request(
                 runner.id,
                 "POST",

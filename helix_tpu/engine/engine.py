@@ -104,6 +104,9 @@ class Request:
     # adopted from X-Helix-Tenant by the OpenAI surface — feeds the
     # bounded per-tenant accounting and the admission audit trail
     tenant: str = ANON_TENANT
+    # priority class (serving/sched.py): "interactive" | "batch";
+    # "" lets the engine loop stamp the profile's default at submit
+    sched_class: str = ""
     cached_tokens: int = 0          # prompt tokens served by prefix cache
     preempt_count: int = 0          # times swapped out (bounds thrash)
     _page_hashes: Optional[list] = None
@@ -1037,6 +1040,17 @@ class Engine:
         )
         self.preempted: list[PreemptedSeq] = []   # parked, resume FIFO
         self._resume_failures: list = []          # (req, reason) for the loop
+        # scheduler delegation (serving/sched.py): the loop wires these.
+        # on_admit fires once per confirmed admission (_try_claim
+        # success) — the fair-share charge point; victim_policy, when
+        # set, orders preempt_for_pressure candidates (None keeps the
+        # builtin newest-admission/largest-footprint pick);
+        # prefill_budget caps NEW prefill-admission tokens per step
+        # (None = unbudgeted — the historical behaviour)
+        self.on_admit: Optional[Callable[[Request], None]] = None
+        self.victim_policy: Optional[Callable[[list], list]] = None
+        self.prefill_budget: Optional[int] = None
+        self._budget_left: Optional[int] = None
         self._slot_count_overrides: dict[int, np.ndarray] = {}
         self._prefetched: set = set()   # digests with in-flight device puts
         self._key_base = _splitmix64(0x8E1_1C9 ^ (rng_seed & _M64))
@@ -1340,6 +1354,9 @@ class Engine:
             # step-entry so every step shape drains, including the
             # early-returning mixed step
             self.host_pool.drain_pending()
+        # per-step prefill-admission budget (scheduler feedback loop):
+        # refreshed every step; admission charges it in _try_claim
+        self._budget_left = self.prefill_budget
         self._admit(emitted)
         if self._chunking is not None and self._chunking["req"].finished:
             self._chunking = None    # aborted mid-prefill
@@ -1502,6 +1519,18 @@ class Engine:
             restored = self._restore_host_prefix(req, hashes, shared, pages)
         req.cached_tokens = (len(shared) + restored) * self.cache_cfg.page_size
         self.num_admitted += 1
+        if self._budget_left is not None:
+            # charge the uncached prefill work this admission injects
+            self._budget_left -= max(
+                1, len(req.prompt_tokens) - req.cached_tokens
+            )
+        if self.on_admit is not None:
+            try:
+                self.on_admit(req)
+            except Exception:  # noqa: BLE001 — policy hooks never fail admission
+                logging.getLogger(__name__).exception(
+                    "on_admit hook failed for request %s", req.id
+                )
         if self.prefix_cache is not None:
             # request-level outcome: did THIS admission reuse any cached
             # prefix pages?  (page-level pools are record_claim below)
@@ -1618,6 +1647,13 @@ class Engine:
         # long prompts is preserved.  Resource exhaustion (no slot/pages)
         # still blocks FIFO — bypassing there would let a stream of short
         # prompts starve a long prompt of the very pages it is waiting for.
+        if any(r.finished for r in self.waiting):
+            # purge aborted-while-queued requests ANYWHERE in the queue,
+            # not just at the head: a finished request deep in the list
+            # would otherwise keep counting against queue-depth/token
+            # bounds (and the scheduler's per-tenant queues) until
+            # admission happened to reach it
+            self.waiting[:] = [r for r in self.waiting if not r.finished]
         deferred: list[Request] = []
         pending: list = []   # (batch, first_tokens device handle) per call
         try:
@@ -1644,6 +1680,17 @@ class Engine:
 
     def _admit_inner(self, emitted, deferred: list, pending: list) -> None:
         while self.waiting:
+            if (
+                self._budget_left is not None
+                and self._budget_left <= 0
+            ):
+                # per-step prefill-admission budget spent (scheduler
+                # TTFT-burn feedback): stop admitting; decode keeps
+                # running and the next step gets a fresh budget.  The
+                # budget starts >= 1, so the first admission of a step
+                # always proceeds — a shrunken budget throttles, it can
+                # never wedge admission.
+                return
             if self.waiting[0].finished:   # aborted while queued
                 self.waiting.pop(0)
                 continue
@@ -1792,6 +1839,14 @@ class Engine:
             if len(batch) >= max_pack:
                 break
             if plen > C_cap or (batch and used + plen > C_cap):
+                break
+            if (
+                batch
+                and self._budget_left is not None
+                and self._budget_left <= 0
+            ):
+                # budget spent mid-wave: close the packed call with what
+                # fit (the first claim of a wave is always admitted)
                 break
             table = self._try_claim(req)
             if table is None:
@@ -2328,11 +2383,16 @@ class Engine:
         return True
 
     def preempt_for_pressure(self) -> Optional[str]:
-        """Pick and preempt the degradation-ladder victim: the NEWEST
-        admission (least sunk decode work), breaking ties toward the
-        largest page footprint (frees the most for the starved queue).
-        Requests already swapped twice are exempt — bounded thrash.
-        Returns the preempted request id, or None."""
+        """Pick and preempt the degradation-ladder victim.
+
+        With a ``victim_policy`` wired (the scheduler's ladder: lowest
+        class, then most-over-fair-share tenant, then newest) the
+        policy's preference order is walked; otherwise the builtin pick
+        applies — the NEWEST admission (least sunk decode work),
+        breaking ties toward the largest page footprint (frees the most
+        for the starved queue).  Requests already swapped twice are
+        exempt — bounded thrash.  Returns the preempted request id, or
+        None."""
         cands = [
             (req, i)
             for i, req in enumerate(self.slots)
@@ -2340,6 +2400,24 @@ class Engine:
             and self._slot_active(i)
             and req.preempt_count < 2
         ]
+        if self.victim_policy is not None and cands:
+            try:
+                ordered = list(
+                    self.victim_policy([req for req, _i in cands])
+                )
+            except Exception:  # noqa: BLE001 — a policy bug degrades, not kills
+                logging.getLogger(__name__).exception(
+                    "victim_policy failed; falling back to builtin pick"
+                )
+                ordered = []
+            for req in ordered:
+                if req.finished or req.slot is None:
+                    continue
+                if self.preempt(req.id):
+                    req.preempt_count += 1
+                    return req.id
+            if ordered:
+                return None   # the policy's candidates all declined
         while cands:
             req, i = max(
                 cands,

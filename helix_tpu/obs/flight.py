@@ -42,6 +42,7 @@ SATURATION_KEYS = (
     "spec_acceptance_ratio",  # speculative drafts accepted/drafted, 0..1
     "kv_host_occupancy",  # host KV tier bytes used / budget, 0..1
     "preempted_requests",  # decoders swapped out, parked for resume
+    "prefill_budget_tokens",  # scheduler prefill-admission budget/step
 )
 
 

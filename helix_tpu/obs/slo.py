@@ -753,6 +753,23 @@ class SLOObserver:
     def burn_rates(self, tenant: Optional[str] = None) -> dict:
         return self.accounting.burn_rates(tenant=tenant)
 
+    def latency_fast_burn(self) -> float:
+        """The worst pooled fast-window LATENCY burn (TTFT p95 /
+        queue-wait p95 — the goodput floor is a capacity SLO, not a
+        latency one).  This is the scheduler's prefill-budget feedback
+        signal: >1.0 means interactive latency is spending its error
+        budget faster than it accrues, so admission work per step
+        should shrink.  0.0 with no declared latency targets."""
+        fast = self.accounting.burn_rates().get("fast", {})
+        return max(
+            (
+                v
+                for k, v in fast.items()
+                if k in ("ttft_p95", "queue_wait_p95")
+            ),
+            default=0.0,
+        )
+
     def collect(self, c, lbl: dict) -> None:
         self.accounting.collect(c, lbl)
 

@@ -31,6 +31,12 @@ from helix_tpu.obs import EngineLoopObs, FlightRecorder, RateTracker
 from helix_tpu.obs import trace as obs_trace
 from helix_tpu.obs.flight import SATURATION_KEYS
 from helix_tpu.obs.slo import ANON_TENANT, SLOObserver
+from helix_tpu.serving.sched import (
+    PREEMPT_VICTIM,
+    SHED_VICTIM,
+    TENANT_QUEUE_FULL,
+    make_scheduler,
+)
 
 log = logging.getLogger("helix.engine")
 
@@ -63,7 +69,8 @@ class EngineLoop:
                  preempt_stall_seconds: Optional[float] = None,
                  slo_targets: Optional[dict] = None,
                  tenant_top_k: Optional[int] = None,
-                 burn_windows: Optional[tuple] = None):
+                 burn_windows: Optional[tuple] = None,
+                 sched_config=None):
         self.engine = engine
         self.name = name
         self.max_queue_seconds = max_queue_seconds
@@ -129,6 +136,28 @@ class EngineLoop:
         self._trace = obs_trace.default_store()
         self._first_emit: dict[str, float] = {}   # req id -> first-token t
         self._last_emit: dict[str, float] = {}    # req id -> last-token t
+        # the scheduler (ISSUE 9, serving/sched.py): owns every
+        # ordering / per-tenant-bound / victim decision.  The FIFO
+        # baseline (no sched_config, or policy: fifo) preserves the
+        # pre-scheduler semantics exactly.  Lockstep engines keep the
+        # scheduler inert: reordering, per-step budgets and policy
+        # preemption are leader-local decisions the follower's replayed
+        # command stream would never see.
+        self.sched = make_scheduler(sched_config)
+        if self.sched.active and hasattr(engine, "journal"):
+            # downgrade to the FIFO baseline outright (not just a
+            # disabled flag): metrics/stats must never claim a policy
+            # this loop will not run
+            from helix_tpu.serving.sched import FifoScheduler
+
+            self.sched = FifoScheduler(self.sched.cfg)
+        self._sched_active = self.sched.active
+        # per-tenant inbox depth (admission lock); the per-tenant bound
+        # adds the engine-side wait-queue count on demand
+        self._pending_by_tenant: dict[str, int] = {}
+        engine.on_admit = self._note_admit
+        if self._sched_active:
+            engine.victim_policy = self.sched.preempt_order
 
     # -- called from any thread --------------------------------------------
 
@@ -144,17 +173,19 @@ class EngineLoop:
         the verdict (actually shed the request) pass ``count_shed=True``
         so the metric — and the per-tenant accounting + admission audit
         entry — is owned here, in one place."""
-        err = self._check_admission(prompt_len)
-        if err is not None and count_shed:
+        hit = self._check_admission(prompt_len, tenant)
+        if hit is None:
+            return None
+        reason, err = hit
+        if count_shed:
             self.shed_requests += 1
-            kv = err.startswith(KV_EXHAUSTED)
+            kv = reason == "kv_exhausted"
             if kv:
                 self.kv_exhausted_sheds += 1
-            reason = (
-                "kv_exhausted" if kv
-                else "shutting_down" if err.startswith(SHUTTING_DOWN)
-                else "queue_full"
-            )
+            if reason == TENANT_QUEUE_FULL:
+                # a scheduler decision: the flooding tenant overflowed
+                # ITS bounded queue — everyone else keeps admitting
+                self.sched.note_tenant_shed()
             self.slo.note_shed(tenant, kv_exhausted=kv)
             self._audit(
                 reason, tenant=tenant, trace_id=trace_id,
@@ -173,24 +204,53 @@ class EngineLoop:
         self.slo.audit.record(
             reason, tenant=tenant, trace_id=trace_id,
             request_id=request_id, detail=detail,
-            queue_depth=self._pending + len(eng.waiting),
+            queue_depth=self.queue_depth(),
             kv_pages_free=eng.allocator.free_pages,
             slots_busy=sum(1 for s in eng.slots if s is not None),
             preempted_parked=len(getattr(eng, "preempted", ())),
         )
 
+    def queue_depth(self) -> int:
+        """Requests awaiting a slot (inbox + engine wait queue) — THE
+        queue-depth formula: the admission bound, audit records,
+        saturation summary and flight records all read this one helper
+        (the ``queued_tokens()`` treatment).  O(1) GIL-atomic reads,
+        safe from any thread."""
+        return self._pending + len(self.engine.waiting)
+
     def queued_tokens(self) -> int:
         """Prompt tokens awaiting admission (inbox + engine wait queue)
         — the quantity ``max_queued_tokens`` bounds and the
-        ``helix_queued_tokens`` gauge reports.  GIL-atomic reads, safe
-        from any thread."""
+        ``helix_queued_tokens`` gauge reports.  Finished (aborted while
+        queued) requests no longer hold KV work, so they don't count.
+        GIL-atomic reads, safe from any thread."""
         return self._pending_tokens + sum(
-            len(r.prompt_tokens) for r in list(self.engine.waiting)
+            len(r.prompt_tokens)
+            for r in list(self.engine.waiting)
+            if not r.finished
         )
 
-    def _check_admission(self, prompt_len: int) -> Optional[str]:
+    def _tenant_depth(self, tenant: str) -> int:
+        """Queued requests for ONE tenant (inbox + engine wait queue).
+        Only computed when the scheduler's per-tenant bound is
+        configured — an O(queue) walk like ``queued_tokens``."""
+        return self._pending_by_tenant.get(tenant, 0) + sum(
+            1
+            for r in list(self.engine.waiting)
+            if not r.finished
+            and getattr(r, "tenant", ANON_TENANT) == tenant
+        )
+
+    def _check_admission(
+        self, prompt_len: int, tenant: str = ANON_TENANT,
+    ) -> Optional[tuple]:
+        """(audit_reason, error_string) when a submit of this size would
+        be shed right now, else None."""
         if self._draining or self._stop.is_set():
-            return f"{SHUTTING_DOWN}: engine '{self.name}' is draining"
+            return (
+                "shutting_down",
+                f"{SHUTTING_DOWN}: engine '{self.name}' is draining",
+            )
         # KV-starved fast-fail: when admission has already been stalled
         # longer than the deadline, a new arrival would only age out the
         # same way — reject it NOW, before the HTTP layer commits SSE
@@ -204,34 +264,53 @@ class EngineLoop:
             and time.monotonic() - stall_since > self.admission_timeout
         ):
             return (
+                "kv_exhausted",
                 f"{KV_EXHAUSTED}: engine '{self.name}' admission has been "
                 f"KV-starved for {time.monotonic() - stall_since:.1f}s "
-                f"(admission_timeout={self.admission_timeout}s)"
+                f"(admission_timeout={self.admission_timeout}s)",
             )
         # the engine-side sums are read without the admission lock (list
         # copies are GIL-atomic; the bound is advisory by one request
         # anyway), so overloaded submitters don't serialize on an O(n)
         # walk of the wait queue
-        depth = self._pending + len(self.engine.waiting)
+        depth = self.queue_depth()
         if (
             self.max_queue_depth is not None
             and depth >= self.max_queue_depth
         ):
             return (
+                "queue_full",
                 f"{QUEUE_FULL}: {depth} request(s) already queued "
-                f"(max_queue_depth={self.max_queue_depth})"
+                f"(max_queue_depth={self.max_queue_depth})",
             )
+        # bounded per-tenant queues (scheduler policy): one flooding
+        # tenant overflows ITS queue and gets per-tenant 429s instead
+        # of filling the global bound and starving the cluster
+        if self.sched.cfg.max_tenant_queue_depth is not None:
+            td = self._tenant_depth(tenant)
+            if self.sched.tenant_overflow(tenant, td):
+                return (
+                    TENANT_QUEUE_FULL,
+                    f"{QUEUE_FULL}: tenant '{tenant}' already has {td} "
+                    f"request(s) queued (max_tenant_queue_depth="
+                    f"{self.sched.cfg.max_tenant_queue_depth})",
+                )
         if self.max_queued_tokens is not None:
             queued = self.queued_tokens()
             if queued + prompt_len > self.max_queued_tokens:
                 return (
+                    "queue_full",
                     f"{QUEUE_FULL}: {queued} tokens queued + "
                     f"{prompt_len} requested exceeds "
-                    f"max_queued_tokens={self.max_queued_tokens}"
+                    f"max_queued_tokens={self.max_queued_tokens}",
                 )
         return None
 
     def submit(self, req: Request, on_event: Callable[[TokenEvent], None]):
+        # resolve the priority class once, at the edge: a stamped class
+        # passes through, everything else gets the profile default
+        if not getattr(req, "sched_class", ""):
+            req.sched_class = self.sched.cfg.default_class
         # reject unservable requests on the caller's thread with a clean
         # event — the engine thread must never die on bad input
         err = self.engine.validate_request(req) or self.check_admission(
@@ -271,6 +350,10 @@ class EngineLoop:
                 return
             self._pending += 1
             self._pending_tokens += len(req.prompt_tokens)
+            t = getattr(req, "tenant", ANON_TENANT)
+            self._pending_by_tenant[t] = (
+                self._pending_by_tenant.get(t, 0) + 1
+            )
             self._inbox.put((req, on_event))
         self._wake.set()
 
@@ -323,6 +406,9 @@ class EngineLoop:
             # per-tenant SLO observability (ISSUE 7): pooled totals +
             # top-K bounding introspection
             "tenants": self.slo.stats(),
+            # scheduler policy + per-class admission/victim counters
+            # (ISSUE 9)
+            "sched": self.sched.stats(),
         }
 
     def tokens_per_sec(self) -> float:
@@ -346,7 +432,7 @@ class EngineLoop:
             "kv_occupancy": round(used / cap, 4),
             "slots_busy": sum(1 for s in eng.slots if s is not None),
             "slots_total": len(eng.slots),
-            "queue_depth": self._pending + len(eng.waiting),
+            "queue_depth": self.queue_depth(),
             "tokens_per_sec": round(self.tokens_per_sec(), 2),
             "prefix_hit_rate": round(hits / denom, 4) if denom else 0.0,
             "spec_acceptance_ratio": round(
@@ -358,6 +444,11 @@ class EngineLoop:
                 hp.occupancy if hp is not None else 0.0, 4
             ),
             "preempted_requests": len(getattr(eng, "preempted", ())),
+            # scheduler prefill-admission budget this engine is running
+            # under (0 = unbudgeted — FIFO baseline or no cap declared)
+            "prefill_budget_tokens": int(
+                getattr(eng, "prefill_budget", None) or 0
+            ),
         }
         # schema lockstep: this summary IS the per-engine instance of the
         # shared heartbeat schema — emit exactly its key set
@@ -410,6 +501,12 @@ class EngineLoop:
                     self._pending_tokens = max(
                         0, self._pending_tokens - len(item.prompt_tokens)
                     )
+                    t = getattr(item, "tenant", ANON_TENANT)
+                    n = self._pending_by_tenant.get(t, 0) - 1
+                    if n > 0:
+                        self._pending_by_tenant[t] = n
+                    else:
+                        self._pending_by_tenant.pop(t, None)
                 try:
                     self.engine.add_request(item)
                     self._subscribers[item.id] = on_event
@@ -421,6 +518,17 @@ class EngineLoop:
                             finish_reason="error", error=str(e),
                         )
                     )
+
+    def _note_admit(self, req) -> None:
+        """Engine admission-confirm hook (fires on the engine thread
+        inside ``_try_claim``): feeds the scheduler's class counters and
+        the per-tenant fair-share account — charging only on CONFIRMED
+        admissions is what keeps the DRR ledger honest when a reorder
+        pass couldn't be acted on (resource block)."""
+        try:
+            self.sched.note_admitted(req)
+        except Exception:  # noqa: BLE001 — bookkeeping must never fail admission
+            log.exception("scheduler note_admitted failed")
 
     def _observe_emit(self, req: Request) -> None:
         """Feed the latency histograms + engine-level spans from one
@@ -567,10 +675,18 @@ class EngineLoop:
                 self._stall_since is not None
                 and now - self._stall_since > self.admission_timeout
             ):
-                for r in waiting:
-                    waited = now - r.submit_time
-                    if not r.finished and waited > self.admission_timeout:
-                        self._shed_kv_exhausted(r, waited)
+                over = [
+                    r for r in waiting
+                    if not r.finished
+                    and now - r.submit_time > self.admission_timeout
+                ]
+                if self._sched_active and len(over) > 1:
+                    # every over-deadline request sheds, but in the
+                    # policy's victim order (lowest class first) so the
+                    # audit trail reflects the ladder
+                    over = self.sched.preempt_order(over)
+                for r in over:
+                    self._shed_kv_exhausted(r, now - r.submit_time)
             # a parked decoder that cannot re-acquire pages IS KV
             # pressure by construction (resume is retried every step),
             # so its deadline is unconditional
@@ -590,8 +706,16 @@ class EngineLoop:
                 vreq = self.engine.get_request(victim)
                 tenant = getattr(vreq, "tenant", ANON_TENANT)
                 self.slo.note_preemption(tenant)
+                if self._sched_active and vreq is not None:
+                    # a scheduler decision: the victim came from the
+                    # policy ladder (lowest class, most-over-fair-share
+                    # tenant, newest) — audited under its own reason
+                    self.sched.note_preempt_victim(vreq)
+                    preempt_reason = PREEMPT_VICTIM
+                else:
+                    preempt_reason = "preempt_by_swap"
                 self._audit(
-                    "preempt_by_swap", tenant=tenant,
+                    preempt_reason, tenant=tenant,
                     trace_id=getattr(vreq, "trace_id", ""),
                     request_id=victim,
                     detail=f"admission KV-starved "
@@ -690,7 +814,7 @@ class EngineLoop:
             "kind": kind,
             "slots_busy": sum(1 for s in eng.slots if s is not None),
             "slots_total": len(eng.slots),
-            "queue_depth": self._pending + len(eng.waiting),
+            "queue_depth": self.queue_depth(),
             "kv_pages_used": getattr(eng, "kv_pages_used", 0),
             "kv_pages_free": eng.allocator.free_pages,
             "prefill_tokens": prefill,
@@ -720,6 +844,11 @@ class EngineLoop:
             "preemptions": getattr(eng, "num_preemptions", 0) - pe0,
             "resumes": getattr(eng, "num_resumes", 0) - re0,
             "host_pool_pages": hp.pages if hp is not None else 0,
+            # the scheduler's prefill-admission budget in force this
+            # step (0 = unbudgeted)
+            "prefill_budget_tokens": int(
+                getattr(eng, "prefill_budget", None) or 0
+            ),
             # distinct tenants sharing this step's decode batch: the
             # noisy-neighbour axis (1 = single-tenant step, >1 = a slow
             # step taxed every tenant listed)
@@ -763,6 +892,15 @@ class EngineLoop:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            if self._sched_active:
+                # scheduler pass (engine thread — the wait queue's
+                # owner): rewrite the queue into dispatch order (strict
+                # classes + per-tenant DRR) and refresh the per-step
+                # prefill-admission budget from the live TTFT burn
+                self.sched.reorder(self.engine.waiting)
+                self.engine.prefill_budget = self.sched.prefill_budget(
+                    self.slo
+                )
             t_step = time.monotonic()
             flight_pre = self._flight_pre()
             try:
@@ -833,6 +971,26 @@ class EngineLoop:
         self._admit_order = [r.id for r in out]
         return out
 
+    def _evict_victim(self, cands: list, msg: str) -> None:
+        """Shed ONE of ``cands`` (oldest-admission-first): the scheduler
+        picks the victim — the policy ladder (lowest class, then
+        most-over-fair-share tenant, then newest) under WFQ, the
+        historical newest-first under the FIFO baseline — and the
+        decision is recorded in the admission audit ring."""
+        victim = self.sched.pick_shed_victim(cands)
+        if victim is None:
+            return
+        if self._sched_active:
+            self.sched.note_shed_victim(victim)
+            self._audit(
+                SHED_VICTIM,
+                tenant=getattr(victim, "tenant", ANON_TENANT),
+                trace_id=victim.trace_id or "",
+                request_id=victim.id,
+                detail=f"policy victim among {len(cands)} candidate(s)",
+            )
+        self._evict(victim, msg)
+
     def _evict(self, req, msg: str) -> None:
         self.engine.abort(req.id)
         self.quarantine_evictions += 1
@@ -880,6 +1038,7 @@ class EngineLoop:
             mrope_delta=req.mrope_delta,
             trace_id=req.trace_id,
             tenant=getattr(req, "tenant", ANON_TENANT),
+            sched_class=getattr(req, "sched_class", ""),
         )
 
     def _trial(self, group: list) -> bool:
@@ -950,8 +1109,8 @@ class EngineLoop:
                             )
                         except Exception as e:  # noqa: BLE001
                             self._evict(r, f"engine rejected request: {e}")
-                    self._evict(
-                        emitting[-1],
+                    self._evict_victim(
+                        emitting,
                         f"evicted after repeated engine step failures "
                         f"({err})",
                     )
@@ -1012,11 +1171,12 @@ class EngineLoop:
             self._barren_rounds += 1
             if self._barren_rounds < 2:
                 return
-        # no fresh suspect to blame — shed the most recently admitted
-        # active request and let the loop retry with the remainder
+        # no fresh suspect to blame — shed the policy's pick (baseline:
+        # the most recently admitted active request) and let the loop
+        # retry with the remainder
         if active:
-            self._evict(
-                active[-1],
+            self._evict_victim(
+                active,
                 f"evicted after repeated engine step failures ({err})",
             )
 

@@ -27,6 +27,7 @@ from helix_tpu import obs
 from helix_tpu.engine.engine import Request
 from helix_tpu.engine.sampling import SamplingParams
 from helix_tpu.obs.slo import ANON_TENANT, TENANT_HEADER, sanitize_tenant
+from helix_tpu.serving.sched import CLASS_HEADER, sanitize_class
 from helix_tpu.obs.trace import TRACE_HEADER
 from helix_tpu.serving.engine_loop import (
     KV_EXHAUSTED,
@@ -311,6 +312,11 @@ class OpenAIServer:
             slo = getattr(m.loop, "slo", None)
             if slo is not None:
                 slo.collect(c, lbl)
+            # scheduler policy series (ISSUE 9): helix_sched_* samples
+            # are minted ONLY by serving/sched.py (lint contract 5)
+            sched = getattr(m.loop, "sched", None)
+            if sched is not None:
+                sched.collect(c, lbl)
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 st = pc.stats
@@ -806,6 +812,14 @@ class OpenAIServer:
         top-K accounting bounds the series count."""
         return sanitize_tenant(request.headers.get(TENANT_HEADER, ""))
 
+    @staticmethod
+    def _sched_class(request) -> str:
+        """The request's priority class (``X-Helix-Class``): forwarded
+        by the control plane for authenticated callers, sanitised to
+        the known class names; "" defers to the serving profile's
+        default class (stamped by the engine loop at submit)."""
+        return sanitize_class(request.headers.get(CLASS_HEADER, ""))
+
     def _sampling_from_body(self, body: dict) -> SamplingParams:
         stop = body.get("stop") or []
         if isinstance(stop, str):
@@ -826,11 +840,13 @@ class OpenAIServer:
         )
 
     async def _generate(self, served, prompt_ids, sampling, extra=None,
-                        trace_id: str = "", tenant: str = ANON_TENANT):
+                        trace_id: str = "", tenant: str = ANON_TENANT,
+                        sched_class: str = ""):
         """Submit to the engine; yields (delta_text, token_id, finished,
         finish_reason).  ``extra`` carries multimodal Request fields;
         ``trace_id`` and ``tenant`` ride the Request into engine-level
-        spans and the per-tenant accounting."""
+        spans and the per-tenant accounting; ``sched_class`` is the
+        scheduler priority class ("" = profile default)."""
         loop = asyncio.get_running_loop()
         q: asyncio.Queue = asyncio.Queue()
 
@@ -844,6 +860,7 @@ class OpenAIServer:
             stop_token_ids=tuple(served.tokenizer.eos_ids),
             trace_id=trace_id,
             tenant=tenant,
+            sched_class=sched_class,
             **(extra or {}),
         )
         served.loop.submit(req, on_event)
@@ -898,6 +915,7 @@ class OpenAIServer:
             return _error(400, "invalid JSON body")
         tid = self._trace_id(request)
         tenant = self._tenant(request)
+        sclass = self._sched_class(request)
         t_req = time.monotonic()
         model = body.get("model", "")
         served, err = await self._lookup(model)
@@ -975,7 +993,7 @@ class OpenAIServer:
             try:
               async for delta, tok, finished, reason in self._generate(
                 served, prompt_ids, sampling, extra, trace_id=tid,
-                tenant=tenant,
+                tenant=tenant, sched_class=sclass,
               ):
                 if t_emit is None:
                     t_emit = time.monotonic()
@@ -1026,7 +1044,7 @@ class OpenAIServer:
         try:
           async for delta, tok, finished, reason in self._generate(
             served, prompt_ids, sampling, extra, trace_id=tid,
-            tenant=tenant,
+            tenant=tenant, sched_class=sclass,
           ):
             if t_emit is None:
                 t_emit = time.monotonic()
@@ -1079,6 +1097,7 @@ class OpenAIServer:
             return _error(400, "invalid JSON body")
         tid = self._trace_id(request)
         tenant = self._tenant(request)
+        sclass = self._sched_class(request)
         t_req = time.monotonic()
         model = body.get("model", "")
         served, err = await self._lookup(model)
@@ -1119,7 +1138,7 @@ class OpenAIServer:
             try:
               async for delta, tok, finished, reason in self._generate(
                 served, prompt_ids, sampling, trace_id=tid,
-                tenant=tenant,
+                tenant=tenant, sched_class=sclass,
               ):
                 if t_emit is None:
                     t_emit = time.monotonic()
@@ -1154,7 +1173,7 @@ class OpenAIServer:
         try:
           async for delta, tok, finished, reason in self._generate(
             served, prompt_ids, sampling, trace_id=tid,
-            tenant=tenant,
+            tenant=tenant, sched_class=sclass,
           ):
             if t_emit is None:
                 t_emit = time.monotonic()
@@ -1271,6 +1290,7 @@ class OpenAIServer:
             return _error(400, "invalid JSON body")
         tid = self._trace_id(request)
         tenant = self._tenant(request)
+        sclass = self._sched_class(request)
         t_req = time.monotonic()
         model = body.get("model", "")
         served, err = await self._lookup(model)
@@ -1347,7 +1367,7 @@ class OpenAIServer:
             try:
               async for delta, tok, finished, reason in self._generate(
                 served, prompt_ids, sampling, trace_id=tid,
-                tenant=tenant,
+                tenant=tenant, sched_class=sclass,
               ):
                 if t_emit is None:
                     t_emit = time.monotonic()
@@ -1400,7 +1420,7 @@ class OpenAIServer:
         try:
           async for delta, tok, finished, reason in self._generate(
             served, prompt_ids, sampling, trace_id=tid,
-            tenant=tenant,
+            tenant=tenant, sched_class=sclass,
           ):
             if t_emit is None:
                 t_emit = time.monotonic()

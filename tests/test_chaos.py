@@ -495,6 +495,18 @@ class TestMemoryPressure:
                 ref_eng.step()
             refs[rid] = list(r.output_tokens)
 
+        # pin per-step wall time (the deadline test's recipe): on a fast
+        # host the hog's whole 88-step decode can finish inside the
+        # 50 ms stall window and the preempt rung never engages — the
+        # slow-step fault makes the stall demonstrably outlive the
+        # threshold however fast the host is.  Injection rides
+        # EngineLoop._step_once only, so the direct-stepped reference
+        # run above is unaffected.
+        faults.arm(
+            seed=13,
+            rules=[{"point": "engine_step", "mode": "slow",
+                    "delay": 0.005}],
+        )
         loop = EngineLoop(
             make_engine(), "pressure",
             admission_timeout=30.0, preempt_stall_seconds=0.05,
@@ -632,8 +644,15 @@ class TestMemoryPressure:
         try:
             faults.arm(
                 seed=21,
-                rules=[{"point": "host_pool", "op": "restore",
-                        "mode": "corrupt", "times": 1}],
+                rules=[
+                    {"point": "host_pool", "op": "restore",
+                     "mode": "corrupt", "times": 1},
+                    # pin step time so the stall outlives the 50 ms
+                    # preempt threshold on fast hosts (see the
+                    # sustained-exhaustion test)
+                    {"point": "engine_step", "mode": "slow",
+                     "delay": 0.005},
+                ],
             )
             cols = {}
             cols["hog"] = _Collector()

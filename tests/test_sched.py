@@ -1,0 +1,756 @@
+"""The scheduler (ISSUE 9): SLO-tiered admission, per-tenant WFQ,
+policy-driven preemption.
+
+The contracts this file pins:
+
+- **FIFO default is inert**: without an explicit ``policy: wfq`` the
+  scheduler preserves every pre-scheduler semantic — submission order,
+  newest-first victims, no per-step budget.
+- **DRR conservation**: under saturation, tenants with weights 2:1 get
+  ~2:1 admitted tokens; strict priority always dispatches interactive
+  ahead of batch; FIFO order within a tenant is preserved.
+- **Starvation bound**: a flooding batch tenant cannot keep an
+  interactive tenant's requests from jumping the queue — every
+  interactive request admits ahead of the flood's tail.
+- **Bounded per-tenant queues**: the flooding tenant's overflow 429s
+  (per-tenant ``queue_full``, audited under the scheduler's own
+  reason) while another tenant keeps admitting.
+- **Adaptive prefill budget**: the TTFT-burn feedback halves/regrows
+  the budget between floor and cap, and a budget smaller than one
+  prompt throttles to one admission per step without ever wedging.
+- **Policy preemption (chaos lane)**: under memory pressure the
+  victim ladder picks the batch-class decoder first and the PR 6 swap
+  path resumes it bit-identically.
+- **lint contract 5**: ``helix_sched_*`` literals and scheduler audit
+  reasons outside ``serving/sched.py`` fail the build.
+"""
+
+import threading
+import time
+
+import pytest
+
+from helix_tpu.serving.sched import (
+    BATCH,
+    INTERACTIVE,
+    PREEMPT_VICTIM,
+    SCHED_AUDIT_REASONS,
+    SHED_VICTIM,
+    TENANT_QUEUE_FULL,
+    FifoScheduler,
+    SchedConfig,
+    WFQScheduler,
+    make_scheduler,
+    sanitize_class,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny_parts():
+    import jax
+
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(9))
+    return cfg, params, tok
+
+
+def _mk_engine(tiny_parts, **kw):
+    from helix_tpu.engine.engine import Engine, EngineConfig
+
+    cfg, params, tok = tiny_parts
+    defaults = dict(
+        max_decode_batch=2, page_size=4, num_pages=64,
+        max_pages_per_seq=16, max_prefill_len=64,
+        attn_backend="reference", eos_token_ids=tok.eos_ids,
+        enable_prefix_cache=False,
+    )
+    defaults.update(kw)
+    return Engine(cfg, params, EngineConfig(**defaults))
+
+
+def _req(rid, prompt, tenant="t", klass="", **samp):
+    from helix_tpu.engine.engine import Request
+    from helix_tpu.engine.sampling import SamplingParams
+
+    samp.setdefault("temperature", 0.0)
+    samp.setdefault("max_tokens", 4)
+    return Request(
+        id=rid, prompt_tokens=list(prompt),
+        sampling=SamplingParams(**samp), stop_token_ids=(1,),
+        tenant=tenant, sched_class=klass,
+    )
+
+
+def _drain(loop_obj, reqs, timeout=120):
+    done = []
+    errs = []
+    for req in reqs:
+        ev = threading.Event()
+        done.append(ev)
+
+        def cb(e, _ev=ev):
+            if e.error:
+                errs.append(e.error)
+            if e.finished:
+                _ev.set()
+
+        loop_obj.submit(req, cb)
+    for ev in done:
+        assert ev.wait(timeout), "request did not finish"
+    return errs
+
+
+# ---------------------------------------------------------------------------
+# class resolution + config
+# ---------------------------------------------------------------------------
+
+class TestClassAndConfig:
+    def test_sanitize_class(self):
+        assert sanitize_class("interactive") == INTERACTIVE
+        assert sanitize_class(" Batch ") == BATCH
+        assert sanitize_class("premium") == ""
+        assert sanitize_class(None, "batch") == "batch"
+        assert sanitize_class("", INTERACTIVE) == INTERACTIVE
+
+    def test_config_from_profile_block(self):
+        cfg = SchedConfig.from_profile({
+            "ttft_p95_seconds": 1.0,
+            "sched": {
+                "policy": "wfq",
+                "default_class": "batch",
+                "tenant_weights": {"a": 2, "bad": "x"},
+                "max_tenant_queue_depth": 8,
+                "prefill_budget_tokens": 512,
+                "prefill_budget_min_tokens": 64,
+            },
+        })
+        assert cfg.policy == "wfq"
+        assert cfg.default_class == BATCH
+        assert cfg.tenant_weights == {"a": 2.0}
+        assert cfg.max_tenant_queue_depth == 8
+        assert cfg.prefill_budget_tokens == 512
+        assert cfg.prefill_budget_min_tokens == 64
+
+    def test_env_beats_profile(self, monkeypatch):
+        monkeypatch.setenv("HELIX_SCHED_POLICY", "fifo")
+        monkeypatch.setenv("HELIX_SCHED_TENANT_QUEUE_DEPTH", "3")
+        cfg = SchedConfig.from_profile(
+            {"sched": {"policy": "wfq", "max_tenant_queue_depth": 99}}
+        )
+        assert cfg.policy == "fifo"
+        assert cfg.max_tenant_queue_depth == 3
+
+    def test_env_policy_case_insensitive(self, monkeypatch):
+        monkeypatch.setenv("HELIX_SCHED_POLICY", "WFQ")
+        assert SchedConfig.from_profile(None).policy == "wfq"
+
+    def test_garbage_yields_fifo_default(self):
+        for blob in (None, {}, {"sched": "nope"}, {"sched": {"policy": "x"}}):
+            cfg = SchedConfig.from_profile(blob)
+            assert cfg.policy == "fifo"
+            assert isinstance(make_scheduler(blob), FifoScheduler)
+
+    def test_fifo_baseline_is_inert(self):
+        sched = make_scheduler(None)
+        assert sched.name == "fifo" and not sched.active
+        reqs = [_req(f"r{i}", range(4, 12), tenant=f"t{i % 2}")
+                for i in range(5)]
+        order = list(reqs)
+        sched.reorder(order)
+        assert order == reqs                      # no reordering
+        assert sched.pick_shed_victim(reqs) is reqs[-1]   # newest-first
+        assert sched.preempt_order(reqs) == []    # engine builtin pick
+        assert sched.prefill_budget() is None     # no budget
+
+
+# ---------------------------------------------------------------------------
+# DRR conservation + strict priority (pure scheduler units)
+# ---------------------------------------------------------------------------
+
+class TestDRRConservation:
+    def test_weights_2_1_yield_2_1_admitted_tokens(self):
+        sched = WFQScheduler(SchedConfig(
+            policy="wfq", tenant_weights={"a": 2.0, "b": 1.0},
+        ))
+        cost = 10
+        admitted = {"a": 0, "b": 0}
+        counter = [0]
+
+        def fresh(tenant):
+            counter[0] += 1
+            return _req(f"{tenant}-{counter[0]}", range(4, 4 + cost),
+                        tenant=tenant, klass=INTERACTIVE)
+
+        # saturated: both tenants always have 4 queued; admit ONE per
+        # round (the adversarial prefix — a reorder the engine can only
+        # partially act on must still converge to the weights)
+        waiting = [fresh(t) for _ in range(4) for t in ("a", "b")]
+        for _ in range(120):
+            sched.reorder(waiting)
+            head = waiting.pop(0)
+            head.cached_tokens = 0
+            sched.note_admitted(head)
+            admitted[head.tenant] += cost
+            waiting.append(fresh(head.tenant))
+        ratio = admitted["a"] / admitted["b"]
+        assert 1.7 <= ratio <= 2.4, (ratio, admitted)
+        # and the class counters saw every admission
+        assert sched.admitted_tokens[INTERACTIVE] == 120 * cost
+
+    def test_strict_priority_interactive_before_batch(self):
+        sched = WFQScheduler(SchedConfig(policy="wfq"))
+        waiting = []
+        for i in range(6):
+            waiting.append(_req(f"b{i}", range(4, 12), tenant=f"t{i}",
+                                klass=BATCH))
+        for i in range(3):
+            waiting.append(_req(f"i{i}", range(4, 12), tenant=f"t{i}",
+                                klass=INTERACTIVE))
+        sched.reorder(waiting)
+        classes = [r.sched_class for r in waiting]
+        assert classes == [INTERACTIVE] * 3 + [BATCH] * 6
+
+    def test_fifo_within_tenant_preserved(self):
+        sched = WFQScheduler(SchedConfig(policy="wfq"))
+        waiting = [
+            _req(f"a{i}", range(4, 12), tenant="a", klass=INTERACTIVE)
+            for i in range(5)
+        ]
+        sched.reorder(waiting)
+        assert [r.id for r in waiting] == [f"a{i}" for i in range(5)]
+
+    def test_class_depth_gauge_clears_when_queue_drains(self):
+        sched = WFQScheduler(SchedConfig(policy="wfq"))
+        waiting = [
+            _req(f"b{i}", range(4, 12), tenant="t", klass=BATCH)
+            for i in range(5)
+        ]
+        sched.reorder(waiting)
+        assert sched.stats()["queue_depth"][BATCH] == 5
+        del waiting[1:]   # queue drained below the reorder threshold
+        sched.reorder(waiting)
+        assert sched.stats()["queue_depth"][BATCH] == 1
+        waiting.clear()
+        sched.reorder(waiting)
+        assert sched.stats()["queue_depth"][BATCH] == 0
+
+    def test_reorder_purges_finished(self):
+        sched = WFQScheduler(SchedConfig(policy="wfq"))
+        waiting = [
+            _req(f"r{i}", range(4, 12), tenant="a", klass=INTERACTIVE)
+            for i in range(4)
+        ]
+        waiting[1].finished = True
+        sched.reorder(waiting)
+        assert [r.id for r in waiting] == ["r0", "r2", "r3"]
+
+    def test_returning_idle_tenant_gets_no_monopoly_burst(self):
+        sched = WFQScheduler(SchedConfig(
+            policy="wfq", tenant_weights={"a": 1.0, "b": 1.0},
+        ))
+        # tenant a consumes service for a while, alone
+        for i in range(50):
+            r = _req(f"a{i}", range(4, 14), tenant="a", klass=INTERACTIVE)
+            sched.reorder([r, _req("x", range(4, 14), tenant="a",
+                                   klass=INTERACTIVE)])
+            sched.note_admitted(r)
+        # b arrives: it starts at the virtual floor, so the interleave
+        # is fair from here — not 50 b-requests of back-pay first
+        waiting = []
+        for i in range(4):
+            waiting.append(_req(f"b{i}", range(4, 14), tenant="b",
+                                klass=INTERACTIVE))
+            waiting.append(_req(f"a-new{i}", range(4, 14), tenant="a",
+                                klass=INTERACTIVE))
+        sched.reorder(waiting)
+        first4 = [r.tenant for r in waiting[:4]]
+        assert first4.count("a") >= 1, first4
+
+
+# ---------------------------------------------------------------------------
+# victim-selection ladder
+# ---------------------------------------------------------------------------
+
+class TestVictimLadder:
+    def test_batch_class_sacrificed_first(self):
+        sched = WFQScheduler(SchedConfig(policy="wfq"))
+        cands = [
+            _req("i-old", range(4, 12), tenant="a", klass=INTERACTIVE),
+            _req("b-mid", range(4, 12), tenant="b", klass=BATCH),
+            _req("i-new", range(4, 12), tenant="c", klass=INTERACTIVE),
+        ]
+        assert sched.pick_shed_victim(cands).id == "b-mid"
+        order = sched.preempt_order(cands)
+        assert order[0].id == "b-mid"
+        assert order[-1].id == "i-old"   # oldest interactive last
+
+    def test_over_fair_share_tenant_before_newest(self):
+        sched = WFQScheduler(SchedConfig(policy="wfq"))
+        # tenant "hog" has consumed far more normalized service
+        for i in range(10):
+            sched.note_admitted(
+                _req(f"h{i}", range(4, 34), tenant="hog",
+                     klass=INTERACTIVE)
+            )
+        cands = [
+            _req("hog-old", range(4, 12), tenant="hog",
+                 klass=INTERACTIVE),
+            _req("meek-new", range(4, 12), tenant="meek",
+                 klass=INTERACTIVE),
+        ]
+        # newest-first would pick meek-new; the ladder prefers the
+        # over-fair-share tenant
+        assert sched.pick_shed_victim(cands).id == "hog-old"
+
+    def test_fifo_victim_is_newest(self):
+        sched = FifoScheduler()
+        cands = [
+            _req("old", range(4, 12), klass=BATCH),
+            _req("new", range(4, 12), klass=INTERACTIVE),
+        ]
+        assert sched.pick_shed_victim(cands).id == "new"
+
+    def test_newest_judged_by_admission_time_not_list_order(self):
+        # preempt candidates arrive in SLOT order, which need not match
+        # admission order — the ladder must key on admitted_time
+        sched = WFQScheduler(SchedConfig(policy="wfq"))
+        older = _req("older", range(4, 12), tenant="t", klass=BATCH)
+        newer = _req("newer", range(4, 12), tenant="t", klass=BATCH)
+        older.admitted_time = 100.0
+        newer.admitted_time = 200.0
+        # newer sits FIRST in the candidate list (lower slot index)
+        assert sched.pick_shed_victim([newer, older]).id == "newer"
+        assert sched.preempt_order([newer, older])[0].id == "newer"
+
+
+# ---------------------------------------------------------------------------
+# adaptive prefill budget
+# ---------------------------------------------------------------------------
+
+class _FakeSLO:
+    def __init__(self):
+        self.burn = 0.0
+
+    def latency_fast_burn(self):
+        return self.burn
+
+
+class TestBudgetController:
+    def _sched(self):
+        t = [0.0]
+        sched = WFQScheduler(
+            SchedConfig(
+                policy="wfq", prefill_budget_tokens=1024,
+                prefill_budget_min_tokens=128,
+                adapt_interval_seconds=1.0,
+            ),
+            clock=lambda: t[0],
+        )
+        return sched, t
+
+    def test_burn_shrinks_then_regrows(self):
+        sched, t = self._sched()
+        slo = _FakeSLO()
+        assert sched.prefill_budget(slo) == 1024
+        slo.burn = 3.0
+        for _ in range(6):
+            t[0] += 1.5
+            sched.prefill_budget(slo)
+        assert sched.prefill_budget(slo) == 128   # floored
+        assert sched.budget_shrinks == 3          # 1024->512->256->128
+        slo.burn = 0.0
+        for _ in range(20):
+            t[0] += 1.5
+            sched.prefill_budget(slo)
+        assert sched.prefill_budget(slo) == 1024  # back at the cap
+        assert sched.budget_grows > 0
+
+    def test_adapt_throttled_between_intervals(self):
+        sched, t = self._sched()
+        slo = _FakeSLO()
+        sched.prefill_budget(slo)
+        slo.burn = 3.0
+        # same tick: no re-evaluation
+        assert sched.prefill_budget(slo) == 1024
+        t[0] += 1.5
+        assert sched.prefill_budget(slo) == 512
+
+    def test_no_cap_means_no_budget(self):
+        sched = WFQScheduler(SchedConfig(policy="wfq"))
+        assert sched.prefill_budget(_FakeSLO()) is None
+
+    def test_budget_throttles_but_never_wedges(self, tiny_parts):
+        eng = _mk_engine(tiny_parts, max_decode_batch=4)
+        eng.prefill_budget = 4   # far below one 16-token prompt
+        reqs = [
+            _req(f"r{i}", range(4, 20), max_tokens=2) for i in range(3)
+        ]
+        for r in reqs:
+            eng.add_request(r)
+        admissions_per_step = []
+        a0 = eng.num_admitted
+        while eng.has_work():
+            eng.step()
+            admissions_per_step.append(eng.num_admitted - a0)
+            a0 = eng.num_admitted
+        assert all(r.finished for r in reqs)
+        # the budget throttled packed admission to one claim per step
+        assert max(admissions_per_step) == 1
+
+
+# ---------------------------------------------------------------------------
+# engine-loop integration: per-tenant 429s, starvation bound, FIFO parity
+# ---------------------------------------------------------------------------
+
+class TestLoopIntegration:
+    def test_per_tenant_bound_429s_flooder_only(self, tiny_parts):
+        eng = _mk_engine(tiny_parts, max_decode_batch=1)
+        loop = (
+            __import__("helix_tpu.serving.engine_loop",
+                       fromlist=["EngineLoop"])
+            .EngineLoop(
+                eng, name="tb",
+                sched_config={"sched": {"policy": "wfq",
+                                        "max_tenant_queue_depth": 2}},
+            )
+        )
+        # NOT started: the inbox holds everything, so per-tenant depth
+        # is deterministic
+        events = []
+
+        def cb(e):
+            events.append(e)
+
+        hog_errs = []
+        for i in range(5):
+            loop.submit(
+                _req(f"hog{i}", range(4, 12), tenant="hog",
+                     max_tokens=64),
+                lambda e: hog_errs.append(e.error) if e.error else None,
+            )
+        # the 3rd..5th hog submissions overflowed hog's bounded queue
+        assert len([e for e in hog_errs if e]) == 3
+        assert all("tenant 'hog'" in e for e in hog_errs if e)
+        # another tenant still admits
+        loop.submit(_req("meek", range(4, 12), tenant="meek"), cb)
+        assert not events   # no shed event for meek
+        # the sheds were audited under the scheduler's own reason with
+        # per-tenant accounting
+        snap = loop.slo.audit.snapshot()
+        reasons = [r["reason"] for r in snap["recent"]]
+        assert reasons.count(TENANT_QUEUE_FULL) == 3
+        assert loop.sched.tenant_queue_sheds == 3
+        assert loop.stats()["sched"]["tenant_queue_sheds"] == 3
+
+    def test_flood_cannot_starve_interactive(self, tiny_parts):
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        eng = _mk_engine(tiny_parts, max_decode_batch=2)
+        loop = EngineLoop(
+            eng, name="fair",
+            sched_config={"sched": {"policy": "wfq"}},
+        ).start()
+        admit_order = []
+        inner = eng.on_admit
+
+        def spy(req):
+            admit_order.append(req.id)
+            inner(req)
+
+        eng.on_admit = spy
+        flood = [
+            _req(f"bulk{i}", range(4, 16), tenant="bulk", klass=BATCH,
+                 max_tokens=8)
+            for i in range(10)
+        ]
+        chat = [
+            _req(f"chat{i}", range(4, 16), tenant="chat",
+                 klass=INTERACTIVE, max_tokens=4)
+            for i in range(3)
+        ]
+        done = []
+        for r in flood:
+            ev = threading.Event()
+            done.append(ev)
+            loop.submit(r, lambda e, _ev=ev: e.finished and _ev.set())
+        # wait until the flood has filled the slots, then inject the
+        # interactive tenant
+        t0 = time.monotonic()
+        while eng.num_admitted < 2 and time.monotonic() - t0 < 30:
+            time.sleep(0.005)
+        for r in chat:
+            ev = threading.Event()
+            done.append(ev)
+            loop.submit(r, lambda e, _ev=ev: e.finished and _ev.set())
+        for ev in done:
+            assert ev.wait(120)
+        loop.stop(join=True)
+        # every interactive request jumped the queued flood: the last
+        # chat admission precedes at least the flood's last 4 admissions
+        last_chat = max(admit_order.index(r.id) for r in chat)
+        bulk_after = sum(
+            1 for rid in admit_order[last_chat + 1:]
+            if rid.startswith("bulk")
+        )
+        assert bulk_after >= 4, admit_order
+        # and nobody starved outright
+        assert all(r.finished for r in flood + chat)
+
+    def test_fifo_default_loop_unchanged(self, tiny_parts):
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        eng = _mk_engine(tiny_parts)
+        loop = EngineLoop(eng, name="plain")
+        assert loop.sched.name == "fifo"
+        assert not loop._sched_active
+        assert eng.victim_policy is None
+        assert eng.prefill_budget is None
+        # submit stamps the default class
+        loop.start()
+        reqs = [_req(f"r{i}", range(4, 12)) for i in range(2)]
+        assert _drain(loop, reqs) == []
+        assert all(r.sched_class == INTERACTIVE for r in reqs)
+        loop.stop(join=True)
+
+
+# ---------------------------------------------------------------------------
+# satellite: aborted-deep-in-queue purge
+# ---------------------------------------------------------------------------
+
+class TestQueuePurge:
+    def test_finished_request_purged_anywhere_in_waiting(self, tiny_parts):
+        eng = _mk_engine(tiny_parts, max_decode_batch=1)
+        hog = _req("hog", range(4, 12), max_tokens=32)
+        eng.add_request(hog)
+        eng.step()   # hog takes the only slot
+        queued = [_req(f"q{i}", range(4, 24), max_tokens=2)
+                  for i in range(3)]
+        for r in queued:
+            eng.add_request(r)
+        # abort the MIDDLE queued request through a path that leaves it
+        # in the waiting list (the bug class: only the head used to be
+        # discarded)
+        queued[1].finished = True
+        assert queued[1] in eng.waiting
+        eng.step()
+        assert queued[1] not in eng.waiting
+        eng.abort(hog.id)
+        for r in (queued[0], queued[2]):
+            while not r.finished:
+                eng.step()
+
+    def test_loop_queued_tokens_skips_finished(self, tiny_parts):
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        eng = _mk_engine(tiny_parts, max_decode_batch=1)
+        loop = EngineLoop(eng, name="qt")   # not started
+        deep = [_req(f"d{i}", range(4, 24)) for i in range(3)]
+        for r in deep:
+            eng.waiting.append(r)
+        before = loop.queued_tokens()
+        deep[1].finished = True
+        assert loop.queued_tokens() == before - len(deep[1].prompt_tokens)
+
+
+# ---------------------------------------------------------------------------
+# chaos lane: preemption-victim selection under memory pressure
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestPreemptVictimPolicy:
+    def test_batch_class_preempted_first_with_bit_identical_resume(
+        self, tiny_parts
+    ):
+        # reference: both requests run uncontended to completion
+        samp = dict(max_tokens=10, temperature=0.8, seed=1234,
+                    presence_penalty=0.3, frequency_penalty=0.2)
+        mk = lambda: (  # noqa: E731
+            _req("inter", range(4, 16), tenant="chat",
+                 klass=INTERACTIVE, **samp),
+            _req("bulk", range(20, 34), tenant="bulk", klass=BATCH,
+                 **samp),
+        )
+        ref_eng = _mk_engine(tiny_parts, host_pool_bytes=1 << 22)
+        ra, rb = mk()
+        ref_eng.add_request(ra)
+        ref_eng.add_request(rb)
+        while ref_eng.has_work():
+            ref_eng.step()
+        ref = {ra.id: list(ra.output_tokens), rb.id: list(rb.output_tokens)}
+
+        eng = _mk_engine(tiny_parts, host_pool_bytes=1 << 22)
+        eng.victim_policy = WFQScheduler(
+            SchedConfig(policy="wfq")
+        ).preempt_order
+        a, b = mk()
+        eng.add_request(a)
+        eng.add_request(b)
+        for _ in range(3):
+            eng.step()
+        assert a.slot is not None and b.slot is not None
+        # memory pressure strikes: the ladder must pick the BATCH-class
+        # decoder, not the newest/largest (the interactive request is
+        # newer-admitted here only by slot order — make the class the
+        # deciding axis by checking the victim id)
+        victim = eng.preempt_for_pressure()
+        assert victim == "bulk"
+        assert b.slot is None and len(eng.preempted) == 1
+        # drain: the interactive request finishes, the victim resumes
+        # and completes bit-identically to the unpreempted reference
+        while eng.has_work():
+            eng.step()
+        assert list(a.output_tokens) == ref["inter"]
+        assert list(b.output_tokens) == ref["bulk"]
+        assert eng.num_preemptions == 1 and eng.num_resumes == 1
+
+
+# ---------------------------------------------------------------------------
+# lint contract 5: scheduler vocabulary fenced to serving/sched.py
+# ---------------------------------------------------------------------------
+
+class TestSchedLintContract:
+    def _tree(self, tmp_path, extra: str):
+        obs = tmp_path / "helix_tpu" / "obs"
+        obs.mkdir(parents=True)
+        (obs / "flight.py").write_text(
+            'SATURATION_KEYS = (\n    "kv_occupancy",\n)\n'
+        )
+        srv = tmp_path / "helix_tpu" / "serving"
+        srv.mkdir(parents=True)
+        (srv / "sched.py").write_text(
+            'TENANT_QUEUE_FULL = "sched_tenant_queue_full"\n'
+            "SCHED_AUDIT_REASONS = (TENANT_QUEUE_FULL,)\n"
+        )
+        (srv / "bad.py").write_text(extra)
+        return str(tmp_path)
+
+    def test_sched_metric_literal_rejected(self, tmp_path):
+        import tools.lint_metrics as lint
+
+        root = self._tree(
+            tmp_path, 'NAME = "helix_sched_rogue_total"\n'
+        )
+        vs = lint.run(root)
+        assert any("helix_sched_* metric family" in v for v in vs), vs
+
+    def test_sched_reason_literal_rejected(self, tmp_path):
+        import tools.lint_metrics as lint
+
+        root = self._tree(
+            tmp_path,
+            'def f(audit):\n'
+            '    audit.record("sched_tenant_queue_full")\n',
+        )
+        vs = lint.run(root)
+        assert any("scheduler audit-reason literal" in v for v in vs), vs
+
+    def test_missing_sched_module_is_flagged(self, tmp_path):
+        import tools.lint_metrics as lint
+
+        obs = tmp_path / "helix_tpu" / "obs"
+        obs.mkdir(parents=True)
+        (obs / "flight.py").write_text(
+            'SATURATION_KEYS = (\n    "kv_occupancy",\n)\n'
+        )
+        vs = lint.run(str(tmp_path))
+        assert any("SCHED_AUDIT_REASONS" in v or "sched.py: missing" in v
+                   for v in vs), vs
+
+    def test_reason_constants_are_the_tuple(self):
+        assert set(SCHED_AUDIT_REASONS) == {
+            TENANT_QUEUE_FULL, PREEMPT_VICTIM, SHED_VICTIM,
+        }
+
+    def test_repo_is_clean(self):
+        import os
+
+        import tools.lint_metrics as lint
+
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        assert lint.run(root) == []
+
+
+# ---------------------------------------------------------------------------
+# metrics surface
+# ---------------------------------------------------------------------------
+
+class _Collector:
+    def __init__(self):
+        self.samples = {}
+
+    def gauge(self, name, value, labels=None, help=None):  # noqa: A002
+        self.samples[(name, tuple(sorted((labels or {}).items())))] = value
+
+    counter = gauge
+
+
+class TestSchedMetrics:
+    def test_collect_emits_the_family(self):
+        sched = WFQScheduler(SchedConfig(
+            policy="wfq", prefill_budget_tokens=512,
+        ))
+        sched.note_admitted(
+            _req("r0", range(4, 20), tenant="a", klass=BATCH)
+        )
+        c = _Collector()
+        sched.collect(c, {"model": "m"})
+        names = {n for n, _l in c.samples}
+        assert {
+            "helix_sched_wfq_enabled",
+            "helix_sched_prefill_budget_tokens",
+            "helix_sched_admitted_requests_total",
+            "helix_sched_admitted_tokens_total",
+            "helix_sched_queue_depth",
+            "helix_sched_tenant_queue_sheds_total",
+            "helix_sched_preempt_victims_total",
+            "helix_sched_shed_victims_total",
+            "helix_sched_reorders_total",
+        } <= names
+        key = (
+            "helix_sched_admitted_tokens_total",
+            (("class", BATCH), ("model", "m")),
+        )
+        assert c.samples[key] == 16
+
+    def test_fifo_never_claims_a_budget_or_wfq(self):
+        sched = FifoScheduler(SchedConfig(
+            policy="fifo", prefill_budget_tokens=512,
+        ))
+        c = _Collector()
+        sched.collect(c, {})
+        assert c.samples[("helix_sched_wfq_enabled", ())] == 0
+        assert c.samples[("helix_sched_prefill_budget_tokens", ())] == 0
+
+    def test_lockstep_downgrades_to_fifo_scheduler(self, tiny_parts):
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        eng = _mk_engine(tiny_parts)
+        eng.journal = object()   # duck-typed lockstep marker
+        loop = EngineLoop(
+            eng, name="ls",
+            sched_config={"sched": {"policy": "wfq",
+                                    "prefill_budget_tokens": 512}},
+        )   # not started
+        assert loop.sched.name == "fifo" and not loop._sched_active
+        c = _Collector()
+        loop.sched.collect(c, {})
+        assert c.samples[("helix_sched_wfq_enabled", ())] == 0
+        del eng.journal
+
+    def test_saturation_carries_prefill_budget(self, tiny_parts):
+        from helix_tpu.obs.flight import SATURATION_KEYS
+        from helix_tpu.serving.engine_loop import EngineLoop
+
+        eng = _mk_engine(tiny_parts)
+        loop = EngineLoop(eng, name="sat")   # not started
+        eng.prefill_budget = 256
+        sat = loop.saturation()
+        assert set(sat) == set(SATURATION_KEYS)
+        assert sat["prefill_budget_tokens"] == 256

@@ -27,6 +27,14 @@ Two contracts, enforced repo-wide (wired into tier-1 via
    unbounded tenant label cardinality can't drift in later.  The
    federation sides (node agent emits, control plane consumes) must
    keep importing the shared ``TENANT_KEYS`` entry schema.
+5. **One scheduler vocabulary**: ``helix_sched_*`` metric names and the
+   scheduler-decision audit reasons (the ``SCHED_AUDIT_REASONS`` tuple)
+   may only be minted by ``helix_tpu/serving/sched.py`` — everywhere
+   else must import the shared constants, so the admission audit ring's
+   reason vocabulary and the scheduler metric family each have exactly
+   one owner.  The engine loop must keep building its scheduler through
+   ``make_scheduler`` and the OpenAI surface must keep adopting
+   ``CLASS_HEADER`` (the contracts 3/4 importer pattern).
 
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
@@ -132,6 +140,73 @@ def _is_slo(path: str, root: str) -> bool:
     return rel == os.path.join("helix_tpu", "obs", "slo.py")
 
 
+# -- contract 5: one scheduler vocabulary -----------------------------------
+# helix_sched_* series and the scheduler-decision audit reasons are
+# minted only by serving/sched.py; other modules import the constants.
+_SCHED_NAME_RE = re.compile(r"""["']helix_sched_[a-z0-9_]*["']""")
+# the reason vocabulary: module-level `NAME = "sched_..."` constant
+# assignments (collected into the SCHED_AUDIT_REASONS tuple) — NOT every
+# sched_* string (e.g. the "sched_class" attribute name is not a reason)
+_SCHED_REASON_LITERAL = re.compile(
+    r"""^[A-Z][A-Z0-9_]*\s*=\s*["'](sched_[a-z0-9_]+)["']""", re.M
+)
+# (file, required symbol): the loop must build its scheduler through the
+# factory; the OpenAI surface must adopt the shared class header
+_SCHED_IMPORTERS = (
+    (
+        os.path.join("helix_tpu", "serving", "engine_loop.py"),
+        "make_scheduler",
+    ),
+    (
+        os.path.join("helix_tpu", "serving", "openai_api.py"),
+        "CLASS_HEADER",
+    ),
+)
+
+
+def _is_sched(path: str, root: str) -> bool:
+    rel = os.path.relpath(path, root)
+    return rel == os.path.join("helix_tpu", "serving", "sched.py")
+
+
+def _load_sched_schema(root: str):
+    """Contract 5 setup: the audit-reason vocabulary from
+    serving/sched.py (textual parse, like SATURATION_KEYS) plus
+    schema-level violations (missing tuple, an importer that stopped
+    referencing its required symbol)."""
+    violations: list = []
+    sched = os.path.join(root, "helix_tpu", "serving", "sched.py")
+    if not os.path.isfile(sched):
+        return (), [
+            "helix_tpu/serving/sched.py: missing — the scheduler "
+            "vocabulary (SCHED_AUDIT_REASONS) must live there"
+        ]
+    with open(sched, encoding="utf-8", errors="replace") as f:
+        src = f.read()
+    if "SCHED_AUDIT_REASONS" not in src:
+        return (), [
+            "helix_tpu/serving/sched.py: SCHED_AUDIT_REASONS tuple "
+            "not found"
+        ]
+    reasons = tuple(sorted(set(_SCHED_REASON_LITERAL.findall(src))))
+    if not reasons:
+        return (), [
+            "helix_tpu/serving/sched.py: no sched_* audit-reason "
+            "literals found"
+        ]
+    for rel, symbol in _SCHED_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if symbol not in f.read():
+                violations.append(
+                    f"{rel}: does not reference {symbol} from the "
+                    "scheduler module (helix_tpu/serving/sched.py)"
+                )
+    return reasons, violations
+
+
 def _load_saturation_schema(root: str):
     """Contract 3 setup: the shared SATURATION_KEYS set from
     obs/flight.py plus any schema-level violations (missing tuple, a
@@ -190,6 +265,12 @@ def run(root: str) -> list:
     """Returns a list of violation strings (empty = clean)."""
     sat_keys, violations = _load_saturation_schema(root)
     violations += _tenant_schema_violations(root)
+    sched_reasons, sched_violations = _load_sched_schema(root)
+    violations += sched_violations
+    sched_reason_res = [
+        re.compile(r"""["']{}["']""".format(re.escape(r)))
+        for r in sched_reasons
+    ]
     for path in _iter_py_files(root):
         if _is_self(path):
             continue
@@ -198,7 +279,21 @@ def run(root: str) -> list:
             lines = f.read().splitlines()
         allowed_exposition = _in_obs(path, root)
         tenant_emitter = _is_slo(path, root)
+        sched_emitter = _is_sched(path, root)
         for i, line in enumerate(lines, 1):
+            if not sched_emitter:
+                if _SCHED_NAME_RE.search(line):
+                    violations.append(
+                        f"{rel}:{i}: helix_sched_* metric family named "
+                        "outside helix_tpu/serving/sched.py — scheduler "
+                        "series must come from the policy module"
+                    )
+                elif any(p.search(line) for p in sched_reason_res):
+                    violations.append(
+                        f"{rel}:{i}: scheduler audit-reason literal "
+                        "outside helix_tpu/serving/sched.py — import "
+                        "the shared constant instead"
+                    )
             if not tenant_emitter:
                 if _TENANT_NAME_RE.search(line):
                     violations.append(
