@@ -799,6 +799,134 @@ def main():
         ),
     }
 
+    # --- unified ragged kernel (ISSUE 10): shape count, warmup, padding,
+    # tokens per device step — CPU-smoke-runnable --------------------------
+    kern_slots = 4
+    kern_C = 64
+    # page_size distinct from the main bench engines: the compiled-shape
+    # registry is shared per (model, page geometry) exactly like the
+    # traces, so a distinct geometry gives this block a clean count
+    kern_ps = 8
+    eng_k = Engine(cfg, params, EngineConfig(
+        max_decode_batch=kern_slots, page_size=kern_ps, num_pages=256,
+        max_pages_per_seq=32, max_prefill_len=kern_C,
+        enable_prefix_cache=True, enable_spec_decode=True, spec_tokens=3,
+        enable_mixed_step=True, decode_steps_per_sync=4,
+        kv_cache_dtype=kv_dtype,
+    ))
+    t0 = time.perf_counter()
+    eng_k.warmup()
+    kern_warmup_s = time.perf_counter() - t0
+    warmed_shapes = eng_k.compiled_step_shapes
+    gen = SamplingParams(temperature=0.0, max_tokens=24)
+    sys_prefix = [(13 * i) % (cfg.vocab_size - 2) + 1 for i in range(32)]
+    shorts = [sys_prefix + [40 + i, 41, 42 + i] for i in range(3)]
+    rep = [(5, 9, 7, 3) * 10][0]
+    long_p = [(7 * i) % (cfg.vocab_size - 2) + 1 for i in range(3 * kern_C)]
+    p0 = eng_k.num_prefill_tokens
+    pad0 = eng_k.num_prefill_padding_tokens
+    d0, c0 = eng_k.num_decode_tokens, eng_k.num_device_calls
+    # phase 1: cold shorts + spec-friendly repetitive prompt (packed wave
+    # + verify rows); phase 2: same prefixes again (cache-hit rows pack
+    # the SAME wave as cold rows — the padding win); phase 3: a long
+    # prompt admitted mid-decode (chunk + mixed rows)
+    for i, r in enumerate(
+        [Request(id=f"k1-{j}", prompt_tokens=list(p), sampling=gen)
+         for j, p in enumerate(shorts + [list(rep)])]
+    ):
+        eng_k.add_request(r)
+    while eng_k.has_work():
+        eng_k.step()
+    hit_reqs = [
+        Request(id=f"k2-{j}", prompt_tokens=list(p), sampling=gen)
+        for j, p in enumerate(shorts)
+    ]
+    hit_rems = []
+    for r in hit_reqs:
+        eng_k.add_request(r)
+    for _ in range(2):
+        eng_k.step()
+    eng_k.add_request(
+        Request(id="k-long", prompt_tokens=list(long_p), sampling=gen)
+    )
+    while eng_k.has_work():
+        eng_k.step()
+    hit_rems = [
+        len(r.prompt_tokens) - r.cached_tokens for r in hit_reqs
+    ]
+    k_prefill = eng_k.num_prefill_tokens - p0
+    k_pad = eng_k.num_prefill_padding_tokens - pad0
+    k_decode = eng_k.num_decode_tokens - d0
+    k_calls = eng_k.num_device_calls - c0
+
+    def _pow2(n, lo, hi):
+        b = lo
+        while b < n:
+            b *= 2
+        return min(b, hi)
+
+    # what the pre-unification zoo would have compiled / padded for the
+    # SAME workload (lower-bound ESTIMATE, replaying the old bucketing
+    # rules): packed pow2 buckets, per-request chunk-hit calls with
+    # pow2(remainder) × pow2-history pairs, chunk + mixed (C × hist)
+    # pairs, per-window decode scans, verify width×hist×tail triples
+    legacy_shapes = set()
+    for p in shorts + [list(rep)]:
+        legacy_shapes.add(("packed", _pow2(len(p), kern_ps, kern_C)))
+    for rem, r in zip(hit_rems, hit_reqs):
+        m = kern_C
+        while m < r.cached_tokens:
+            m *= 2
+        legacy_shapes.add(("chunk_hit", _pow2(max(rem, kern_ps), kern_ps,
+                                              kern_C), m))
+    for start in range(0, len(long_p), kern_C):
+        m = 0 if start == 0 else max(kern_C, _pow2(start, kern_C, 1 << 20))
+        legacy_shapes.add(("chunk", kern_C, m))
+        legacy_shapes.add(("mixed", kern_C, m))   # compiled separately
+    for n in (1, 2, 4):                           # fused windows used
+        legacy_shapes.add(("decode", n))
+    for tail in (0, 1, 3):                        # verify tails per window
+        legacy_shapes.add(("verify", 4, tail))
+    legacy_hit_pad = sum(
+        _pow2(max(rem, kern_ps), kern_ps, kern_C) - rem
+        for rem in hit_rems
+    )
+    hit_wave_pad = (
+        _pow2(max(sum(hit_rems), kern_ps), kern_ps, kern_C)
+        - sum(hit_rems)
+    )
+    result["kernel"] = {
+        "compiled_step_shapes": eng_k.compiled_step_shapes,
+        "compiled_step_shapes_warmup": warmed_shapes,
+        "warmup_seconds": round(kern_warmup_s, 2),
+        "prefill_tokens": k_prefill,
+        "padding_tokens": k_pad,
+        "padding_ratio": round(k_pad / max(k_pad + k_prefill, 1), 4),
+        "tokens_per_device_step": round(
+            (k_prefill + k_decode) / max(k_calls, 1), 2
+        ),
+        "decode_tokens": k_decode,
+        "device_step_calls": k_calls,
+        "spec_steps": eng_k.num_spec_steps,
+        "mixed_steps": eng_k.num_mixed_steps,
+        "prefix_hits": eng_k.prefix_cache_hits,
+        # pre-unification comparators (estimates replaying the old
+        # bucketing rules on this exact workload): per-request chunk-hit
+        # calls each padded their own pow2 bucket where the unified wave
+        # packs them into one, and each hit was its own device call
+        "legacy_step_shapes_estimate": len(legacy_shapes),
+        "legacy_padding_ratio_estimate": round(
+            (k_pad - hit_wave_pad + legacy_hit_pad)
+            / max(k_pad - hit_wave_pad + legacy_hit_pad + k_prefill, 1),
+            4,
+        ),
+        "legacy_device_step_calls_estimate": (
+            k_calls + max(0, len(hit_rems) - 1)
+        ),
+        "legacy_chunk_hit_padding_tokens": legacy_hit_pad,
+    }
+    del eng_k
+
     if on_tpu:
         # decode-side model FLOPs utilisation: each generated token moves
         # ~2 FLOPs per active parameter through the MXU; a v5e chip peaks

@@ -57,6 +57,19 @@ ENV_REFERENCE: tuple = (
         section="accelerator",
     ),
     EnvVar(
+        "HELIX_TOKEN_BUCKETS",
+        "Comma-separated token-bucket ladder for the unified ragged "
+        "device step's prefill segment (e.g. '64,192,512,2048'). Each "
+        "admission wave / prefill chunk pads its flat token axis up to "
+        "the smallest rung that fits, so the ladder trades compiled "
+        "step shapes (one per rung used, watch "
+        "helix_compiled_step_shapes) against padding waste (watch "
+        "helix_prefill_padding_ratio). The top rung is always clamped "
+        "to max_prefill_len. Unset: powers of two from page_size to "
+        "max_prefill_len.",
+        section="accelerator",
+    ),
+    EnvVar(
         "HELIX_KV_HOST_POOL_BYTES",
         "Host-RAM KV tier budget (bytes) for every engine this node "
         "serves: prefix-cache evictions spill page contents to pinned "

@@ -41,6 +41,7 @@ import enum
 import functools
 import itertools
 import logging
+import os
 import time
 from typing import Callable, Optional, Sequence
 
@@ -48,6 +49,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from helix_tpu.engine import ragged as ragged_meta
 from helix_tpu.engine.kv_cache import (
     CacheConfig,
     PageAllocator,
@@ -55,6 +57,7 @@ from helix_tpu.engine.kv_cache import (
     slot_to_page_offset,
     write_kv,
 )
+from helix_tpu.engine.ragged import PrefillPlan, bucket_tokens
 from helix_tpu.engine.sampling import (
     SamplingParams,
     SamplingState,
@@ -67,7 +70,7 @@ from helix_tpu.models.llama import forward
 from helix_tpu.obs import trace as obs_trace
 from helix_tpu.obs.slo import ANON_TENANT
 from helix_tpu.ops.attention import attention as full_attention
-from helix_tpu.ops.paged import paged_decode_attention
+from helix_tpu.ops.paged import ragged_paged_attention
 
 
 class FinishReason(str, enum.Enum):
@@ -332,132 +335,15 @@ class PreemptedSeq:
 # architecture (or the same Engine recreated by a profile swap) reuse one
 # executable.  Combined with jax's persistent compilation cache this makes
 # profile hot-swap cheap (SURVEY.md §7 hard part #2).
-@functools.lru_cache(maxsize=64)
-def _build_packed_prefill_fn(model_cfg: ModelConfig, backend):
-    """Packed prefill: several prompts concatenated into ONE sequence with
-    per-request segment ids and restarting positions — one forward pass
-    prefills a whole burst instead of one jit call per prompt (vLLM-style
-    prefill batching; round-1 VERDICT flagged the serial path).  KV
-    destinations arrive as flat (page, offset) arrays computed on host, so
-    any mix of requests lands in its own pages in one scatter."""
-    cfg = model_cfg
-    is_moe = cfg.num_experts > 0
-
-    @functools.partial(jax.jit, donate_argnums=(1,))
-    def packed_fn(
-        params, cache, tokens, positions, segments, pages, offsets,
-        valid, ends, sampling, keys,
-    ):
-        def attn_fn(q, k, v, layer_cache, pos):
-            return full_attention(
-                q, k, v,
-                causal=True,
-                q_positions=positions,
-                kv_positions=positions,
-                q_segment_ids=segments,
-                kv_segment_ids=segments,
-                backend=backend,
-            )
-
-        drops = None
-        if is_moe:
-            logits, (k_new, v_new), moe_stats = forward(
-                params, cfg, tokens, positions, attn_fn=attn_fn,
-                moe_token_mask=segments > 0,
-                return_moe_stats=True,
-            )
-            drops = moe_stats["dropped"]
-        else:
-            logits, (k_new, v_new) = forward(
-                params, cfg, tokens, positions, attn_fn=attn_fn,
-                moe_token_mask=segments > 0,
-            )
-        cache = write_kv(cache, k_new, v_new, pages, offsets, valid)
-        last = logits[0, ends]          # [K, V] — each request's last token
-        token = sample(last, sampling, keys)
-        return cache, token, drops
-
-    return packed_fn
-
-
-def _chunk_prefill_body(
-    params, cache, tokens, start, clen, hist_table, full_table,
-    sampling, key, *, cfg: ModelConfig, page_size: int, backend, sp, mesh,
-):
-    """Traced body of one chunk-prefill step (shared by the standalone
-    chunk jit and the ragged mixed step): attend the current chunk against
-    the already-cached history (gathered from the page pool — int8 pools
-    dequantize right after the gather) plus itself, then scatter the
-    chunk's fresh KV into the pool.  Returns ``(cache, token, drops)``
-    with ``drops`` = MoE capacity-overflow count (None for dense)."""
-    B, C = tokens.shape          # B == 1
-    m = hist_table.shape[1]      # history pages (static per trace)
-    Hs = m * page_size           # history token capacity
-    pos_q = start + jnp.broadcast_to(jnp.arange(C)[None], (B, C))
-    valid_q = jnp.arange(C)[None] < clen
-    qseg = valid_q.astype(jnp.int32)
-    kv_pos_hist = jnp.broadcast_to(jnp.arange(Hs)[None], (B, Hs))
-    kseg_hist = (kv_pos_hist < start).astype(jnp.int32)
-
-    def attn_fn(q, k, v, layer_cache, pos):
-        # [m, P, KVH, D] -> [1, m*P, KVH, D] — a pure reshape under
-        # the pool's token-major layout (no transpose)
-        kh, vh = _gather_history(layer_cache, hist_table[0], 1, Hs)
-        k_all = jnp.concatenate([kh.astype(k.dtype), k], axis=1)
-        v_all = jnp.concatenate([vh.astype(v.dtype), v], axis=1)
-        kv_pos = jnp.concatenate([kv_pos_hist, pos_q], axis=1)
-        kseg = jnp.concatenate([kseg_hist, qseg], axis=1)
-        if sp > 1:
-            from helix_tpu.parallel.ring_attention import ring_attention
-
-            # padding KV slots get a sentinel position so causal
-            # masking excludes them (ring has no segment ids);
-            # non-divisible chunk geometry is padded to sp inside
-            # ring_attention itself — sequence parallelism always
-            # engages (round-2 verdict weak #4)
-            kv_pos_m = jnp.where(kseg > 0, kv_pos, 1 << 30)
-            return ring_attention(
-                q, k_all, v_all, mesh,
-                q_positions=pos_q,
-                kv_positions=kv_pos_m,
-                causal=True,
-            )
-        return full_attention(
-            q, k_all, v_all,
-            causal=True,
-            q_positions=pos_q,
-            kv_positions=kv_pos,
-            q_segment_ids=qseg,
-            kv_segment_ids=kseg,
-            backend=backend,
-            block_q=min(256, C),
-            block_kv=min(256, C),
-        )
-
-    drops = None
-    if cfg.num_experts > 0:
-        logits, (k_new, v_new), moe_stats = forward(
-            params, cfg, tokens, pos_q,
-            attn_fn=attn_fn,
-            layer_caches=cache.carry(),
-            moe_token_mask=valid_q,
-            return_moe_stats=True,
-        )
-        drops = moe_stats["dropped"]
-    else:
-        logits, (k_new, v_new) = forward(
-            params, cfg, tokens, pos_q,
-            attn_fn=attn_fn,
-            layer_caches=cache.carry(),
-            moe_token_mask=valid_q,
-        )
-    pages, offsets = slot_to_page_offset(pos_q, full_table, page_size)
-    cache = write_kv(cache, k_new, v_new, pages, offsets, valid_q)
-    last = logits[jnp.arange(B), clen - 1]
-    token = sample(last, sampling, key[None])
-    return cache, token, drops
-
-
+#
+# Since the ragged unification there is ONE such builder for the whole
+# device step (``_build_ragged_step_fn``, keyed only on the prefill
+# token-bucket at runtime) — packed/cache-hit prefill, chunked prefill,
+# plain decode, the mixed step and spec-verify are host-side metadata
+# builders over it.  The VL single-shot prefill (image-bucket shapes) and
+# the embed splice are the only other compiled entry points;
+# ``tools/lint_metrics.py`` contract 6 fails the build if a new lru-cached
+# step builder appears outside this set.
 def _mesh_sp(mesh) -> int:
     if mesh is not None and "sp" in mesh.axis_names:
         return mesh.shape["sp"]
@@ -480,32 +366,6 @@ def _gather_history(layer_cache, idx, B: int, Hs: int):
         kh = kh.astype(jnp.float32) * ks[idx].reshape(B, Hs, KVH)[..., None]
         vh = vh.astype(jnp.float32) * vs[idx].reshape(B, Hs, KVH)[..., None]
     return kh, vh
-
-
-@functools.lru_cache(maxsize=64)
-def _build_chunk_prefill_fn(
-    model_cfg: ModelConfig, page_size: int, backend, mesh=None,
-):
-    """Chunked prefill: one chunk against the cached history per call.
-
-    Serves arbitrary prompt lengths with fixed compile shapes — the
-    reference reaches the same capability via vLLM's --max-model-len
-    (``design/sample-profiles/8xH100-vllm.yaml:40-41``); here it is native.
-    Shapes: chunk length C and history capacity m*page_size are bucketed by
-    the caller, so XLA compiles once per (C, m) pair.
-
-    When ``mesh`` carries an ``sp`` axis (>1), the chunk-vs-history
-    attention runs as ring attention over it: each chip holds a KV shard
-    and ``ppermute`` rotates shards over ICI — contexts beyond one chip's
-    activation budget prefill sequence-parallel (the long-context serving
-    path VERDICT round 1 asked to wire in).
-    """
-    body = functools.partial(
-        _chunk_prefill_body,
-        cfg=model_cfg, page_size=page_size, backend=backend,
-        sp=_mesh_sp(mesh), mesh=mesh,
-    )
-    return jax.jit(body, donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=64)
@@ -623,74 +483,120 @@ def _pin_default_layout(cache):
     )
 
 
-def _decode_one_step(
-    params, cache, state: DecodeState, *, cfg: ModelConfig, backend,
-):
-    """Traced body of ONE decode step over every slot (shared by the fused
-    decode scan and the ragged mixed step)."""
-    is_mrope = cfg.mrope_sections is not None
-    last_token = state.last_token
-    positions = state.positions
-    page_tables = state.page_tables
+def _ragged_attn_call(q, k, v, caches, lyr, t0, q_len, hist, tables,
+                      backend):
+    """One ragged-op invocation from inside a forward pass: unpack the
+    pool carry (with optional int8 scale pools) and flatten the token
+    grid onto the op's flat row axis."""
+    kp, vp = caches[0], caches[1]
+    ks = caches[2] if len(caches) == 4 else None
+    vs = caches[3] if len(caches) == 4 else None
+    Bq, Sq, H, D = q.shape
+    KVH = k.shape[-2]
+    out = ragged_paged_attention(
+        q.reshape(Bq * Sq, H, D),
+        k.reshape(Bq * Sq, KVH, D),
+        v.reshape(Bq * Sq, KVH, D),
+        kp, vp, lyr, t0, q_len, hist, tables,
+        backend=backend, k_scale=ks, v_scale=vs,
+    )
+    return out.reshape(Bq, Sq, H, D)
+
+
+def _ring_chunk_attention(q, k, v, caches, lyr, p_pos, p_seg, p_hist,
+                          p_tables, mesh, page_size, hist_pages):
+    """Sequence-parallel chunk-vs-history attention over the ICI ring
+    (``sp`` mesh axis > 1): each chip holds a KV shard and ``ppermute``
+    rotates shards — contexts beyond one chip's activation budget
+    prefill sequence-parallel.  Ring attention has no segment ids, so
+    the engine keeps history-attending rows ALONE in their call on sp
+    meshes (padding KV slots get a sentinel position instead).
+
+    ``hist_pages`` is the STATIC pow2-bucketed history capacity (part of
+    the builder key, like the pre-unification chunk path): the gather
+    and the ring payload scale with actual history, not max context."""
+    from helix_tpu.parallel.ring_attention import ring_attention
+
+    layer_view = tuple(c[lyr] for c in caches)
+    Hs = hist_pages * page_size
+    kh, vh = _gather_history(layer_view, p_tables[0, :hist_pages], 1, Hs)
+    k_all = jnp.concatenate([kh.astype(k.dtype), k], axis=1)
+    v_all = jnp.concatenate([vh.astype(v.dtype), v], axis=1)
+    kv_pos_hist = jnp.arange(Hs)[None]
+    kseg_hist = (kv_pos_hist < p_hist[0]).astype(jnp.int32)
+    kv_pos = jnp.concatenate([kv_pos_hist, p_pos], axis=1)
+    kseg = jnp.concatenate(
+        [kseg_hist, (p_seg > 0).astype(jnp.int32)], axis=1
+    )
+    kv_pos_m = jnp.where(kseg > 0, kv_pos, 1 << 30)
+    return ring_attention(
+        q, k_all, v_all, mesh,
+        q_positions=p_pos,
+        kv_positions=kv_pos_m,
+        causal=True,
+    )
+
+
+def _tail_decode_step(params, cache, state: DecodeState, *, cfg, backend,
+                      page_size):
+    """Traced body of ONE plain decode step over every slot: each active
+    slot is a one-token row over its ragged paged history.  This is the
+    fused-window TAIL of the unified step (scanned ``n_extra`` times
+    inside the same jit so a multi-token window still costs one host
+    sync), bit-compatible with the pre-unification ``_decode_one_step``:
+    same penalty → key-split → sample order, same garbage-page routing
+    for parked slots."""
+    B = state.last_token.shape[0]
+    L, KVH, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+    kdt = jnp.dtype(cfg.dtype)
+    tokens = state.last_token[:, None]
+    pos2d = state.positions[:, None]
     active = state.active
-    tokens = last_token[:, None]                      # [B, 1]
-    pos2d = positions[:, None]                        # [B, 1]
-    B = tokens.shape[0]
+    t0 = jnp.arange(B, dtype=jnp.int32)
+    q_len = (active > 0).astype(jnp.int32)
+    hist = state.positions * active
+    kacc0 = jnp.zeros((L, B, 1, KVH, D), kdt)
+    vacc0 = jnp.zeros((L, B, 1, KVH, D), kdt)
 
     def attn_fn(q, k, v, carry_cache, pos):
-        # carry protocol: the FULL pool threads through the layer scan
-        # and the kernel persists the token's K/V in place — the
-        # decode program contains no KV scatter (whose layout
-        # preference made XLA relay the multi-GiB pool every step).
-        # With an int8 pool the scale pools ride the same carry and the
-        # kernel dequantizes in-register after the page DMA.
-        caches, lyr = carry_cache
-        kp, vp = caches[0], caches[1]
-        ks = caches[2] if len(caches) == 4 else None
-        vs = caches[3] if len(caches) == 4 else None
-        out, kp, vp, ks, vs = paged_decode_attention(
-            q[:, 0],
-            kp,
-            vp,
-            page_tables,
-            positions,
-            lyr,
-            active,
-            k_new=k[:, 0],
-            v_new=v[:, 0],
-            backend=backend,
-            k_scale=ks,
-            v_scale=vs,
+        (caches, kacc, vacc), lyr = carry_cache
+        out = _ragged_attn_call(
+            q, k, v, caches, lyr, t0, q_len, hist, state.page_tables,
+            backend,
         )
-        new_caches = (kp, vp) if ks is None else (kp, vp, ks, vs)
-        return out[:, None], new_caches
+        return out, (caches, kacc.at[lyr].set(k), vacc.at[lyr].set(v))
 
-    if is_mrope:
+    carry0 = (cache.carry(), kacc0, vacc0)
+    if cfg.mrope_sections is not None:
         from helix_tpu.models.qwen2_vl import text_forward_mrope
 
         # past the prompt, all three streams advance together at a
         # per-request constant offset from the sequence index
         pos3 = jnp.broadcast_to(
-            (positions + state.mrope_delta)[None, :, None],
-            (3,) + pos2d.shape,
+            (state.positions + state.mrope_delta)[None, :, None],
+            (3, B, 1),
         )
-        logits, caches = text_forward_mrope(
+        logits, (pc, kacc, vacc) = text_forward_mrope(
             params, cfg, tokens, pos3,
             attn_fn=attn_fn,
-            carry_caches=cache.carry(),
+            carry_caches=carry0,
             mrope_sections=cfg.mrope_sections,
             seq_positions=pos2d,
         )
     else:
-        logits, caches = forward(
+        logits, (pc, kacc, vacc) = forward(
             params, cfg, tokens, pos2d,
             attn_fn=attn_fn,
-            carry_caches=cache.carry(),
+            carry_caches=carry0,
             # inactive slots never consume expert capacity: outputs
             # are independent of batch-mates (decode is dropless too)
             moe_token_mask=(active > 0)[:, None],
         )
-    cache = PagedKVCache.from_carry(caches)
+    cache = PagedKVCache.from_carry(pc)
+    pages, offsets = slot_to_page_offset(pos2d, state.page_tables,
+                                         page_size)
+    cache = write_kv(cache, kacc, vacc, pages, offsets,
+                     (active > 0)[:, None])
     penalised = apply_penalties(
         logits[:, 0], state.token_counts,
         state.sampling.presence, state.sampling.frequency,
@@ -699,8 +605,8 @@ def _decode_one_step(
     token = sample(penalised, state.sampling, step_keys)
     new_state = DecodeState(
         last_token=token,
-        positions=positions + active,   # inactive slots stay parked
-        page_tables=page_tables,
+        positions=state.positions + active,   # inactive slots stay parked
+        page_tables=state.page_tables,
         active=active,
         mrope_delta=state.mrope_delta,
         keys=carry_keys,
@@ -712,261 +618,270 @@ def _decode_one_step(
     return cache, new_state, token
 
 
-@functools.lru_cache(maxsize=64)
-def _build_decode_fn(
-    model_cfg: ModelConfig, page_size: int, backend, n_steps: int = 1
+@functools.lru_cache(maxsize=256)
+def _build_ragged_step_fn(
+    model_cfg: ModelConfig, page_size: int, backend, mesh,
+    token_bucket: int, has_hist: bool, prefill_rows: int,
+    state_width: int, n_tail_max: int, ring_hist_pages: int = 0,
 ):
-    """One fused decode call advancing ``n_steps`` tokens per slot.
+    """THE unified device step: ONE compiled entry point serves every
+    caller, keyed at runtime only on the prefill token-bucket.
 
-    ``n_steps=1`` is the classic per-token step.  ``n_steps>1`` scans the
-    identical step body on device and returns all sampled tokens in one
-    [n, B] array — one host fetch per window (multi-step scheduling).
-    The caller guarantees every active slot has at least ``n_steps`` of
-    page capacity and token budget left; slots that hit a stop token
-    mid-window keep decoding until the window ends and the host discards
-    the overrun (same contract as vLLM's multi-step scheduler).
+    One call runs, in one jit:
+
+    1. **Prefill segment** (``token_bucket`` > 0): a flat token axis of
+       up to ``prefill_rows`` ragged rows — cold packed prompts,
+       prefix-cache hits (their remainder attends the shared pages via
+       ``hist``) and the in-flight long-prompt chunk all share it.  One
+       forward, one ``write_kv`` scatter, one batched first-token
+       sample.  ``has_hist`` statically selects between pure packed
+       self-attention (no pool reads — the cold common case) and the
+       ragged paged op; an ``sp`` mesh routes single-row history chunks
+       through ring attention instead.
+    2. **State segment**: every decode slot is a ``state_width``-token
+       row — its last sampled token plus up to ``state_width - 1``
+       host-drafted speculative tokens (``draft_len[b]`` of them; 0 = a
+       plain decode step, -1 = the slot sits this call out, e.g. during
+       an admission wave).  Verification is in-call: every live position
+       samples from the slot's OWN SamplingParams with the penalty
+       histogram evolved along the drafted prefix ("sample from target
+       and compare" IS rejection sampling for a point-mass draft, so the
+       output distribution is exactly non-speculative and greedy is
+       bit-identical); the longest agreeing prefix is kept and
+       positions/last_token/histogram roll back INSIDE the call.
+       Rejected drafts' KV lands only in the slot's private page tail
+       and is overwritten by the next step.  Key splits are consumed
+       only at live positions, so a plain step costs exactly one split —
+       the same key stream plain decode always had.
+    3. **Fused tail**: ``n_extra`` (DYNAMIC — no shape per window size)
+       plain decode steps scanned onto the rolled-back state inside the
+       same jit, so one host sync still yields a full
+       ``decode_steps_per_sync`` window.
+
+    Pre-unification this was six lru-cached builders × their bucket
+    grids (packed buckets, chunk C×hist pairs, mixed pairs, per-window
+    decode scans, verify width×hist×tail triples).  Now the compiled
+    set is O(|token ladder|); ``engine/ragged.py``'s registry records
+    each entry for the ``helix_compiled_step_shapes`` gauge.
     """
+    ragged_meta.note_step_shape(
+        (model_cfg, page_size, backend, mesh),
+        ("ragged", token_bucket, has_hist, prefill_rows,
+         ring_hist_pages),
+    )
     cfg = model_cfg
+    is_moe = cfg.num_experts > 0
+    is_mrope = cfg.mrope_sections is not None
+    Cb = token_bucket
+    W = state_width
+    # sp meshes run single-row chunks (cold first chunk included —
+    # sharding the 32k chunk's self-attention is the point) through
+    # ring attention; multi-row packed waves keep segment-masked
+    # full attention like the pre-unification packed path
+    use_ring = _mesh_sp(mesh) > 1 and Cb > 0 and prefill_rows == 1
+    if is_mrope and Cb > 0:
+        raise ValueError(
+            "mrope prompts prefill through the VL single-shot builder, "
+            "never the ragged prefill segment"
+        )
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def decode_fn(params, cache, state: DecodeState):
-        def step_body(carry, _):
-            cache, state = carry
-            cache, state, token = _decode_one_step(
-                params, cache, state, cfg=cfg, backend=backend
-            )
-            return (_pin_default_layout(cache), state), token
-
-        (cache, state), tokens = jax.lax.scan(
-            step_body, (_pin_default_layout(cache), state), None,
-            length=n_steps,
-        )
-        return cache, state, tokens          # tokens: [n_steps, B]
-
-    return decode_fn
-
-
-@functools.lru_cache(maxsize=64)
-def _build_mixed_step_fn(
-    model_cfg: ModelConfig, page_size: int, backend, mesh=None,
-):
-    """Ragged mixed prefill/decode step: ONE device call that advances
-    every active decode slot one token AND runs one chunk of the in-flight
-    long prefill over the same page pool.
-
-    The decode rows walk their ragged page tables inside the paged
-    attention kernel; the chunk attends its gathered history — both in the
-    same traced program, so a long prompt's admission no longer costs two
-    serialized dispatches (plus their host round trips) per engine step.
-    The two requests' page sets are disjoint (the chunking slot is parked
-    for decode, and decode writes only to its own slots' pages or the
-    garbage page), so the decode-then-chunk order inside the call is not
-    observable.  vLLM v1 schedules prefill and decode in one mixed batch
-    the same way.
-    """
-    cfg = model_cfg
-
-    @functools.partial(jax.jit, donate_argnums=(1, 9))
-    def mixed_fn(
-        params, cache, tokens, start, clen, hist_table, full_table,
-        sampling, key, state: DecodeState,
-    ):
-        cache, state, dec_tokens = _decode_one_step(
-            params, cache, state, cfg=cfg, backend=backend
-        )
-        cache, chunk_token, drops = _chunk_prefill_body(
-            params, cache, tokens, start, clen, hist_table, full_table,
-            sampling, key,
-            cfg=cfg, page_size=page_size, backend=backend,
-            sp=_mesh_sp(mesh), mesh=mesh,
-        )
-        return cache, state, dec_tokens, chunk_token, drops
-
-    return mixed_fn
-
-
-@functools.lru_cache(maxsize=64)
-def _build_verify_fn(
-    model_cfg: ModelConfig, page_size: int, backend, n_tokens: int,
-    hist_pages: int, n_extra: int = 0,
-):
-    """Speculative verification: ONE forward pass scores ``n_tokens``
-    positions (the slot's last sampled token + up to ``n_tokens-1``
-    host-drafted tokens) for EVERY decode slot, each against its own
-    ragged paged history — a batch of short chunks over the shared page
-    pool, the same shape as a k-token mixed-step chunk.
-
-    Decode forwards are HBM-bandwidth-bound, so scoring k+1 positions
-    costs roughly one position's pool sweep; every drafted token the
-    model agrees with is a forward pass (and, under a relay, a host
-    round trip) the request never pays.
-
-    In-call semantics (all device-side; the host only sees the sampled
-    tokens and per-slot emit counts):
-
-    - every position samples from the slot's OWN ``SamplingParams``
-      tiers with a fresh key split and the penalty histogram evolved
-      along the drafted prefix — position j's draw is exactly the draw
-      plain decode would make after emitting the first j verified
-      tokens.  Acceptance keeps the longest prefix where the draw
-      equals the draft; the first disagreeing draw is itself a valid
-      sample (for a point-mass draft, "sample from the target and
-      compare" IS rejection sampling: accept with probability p(draft),
-      else emit a draw from p conditioned off the draft), so the output
-      distribution is provably the non-speculative one and greedy
-      (temperature 0) is bit-identical.
-    - positions past a slot's draft length ride along masked (segment 0,
-      KV write suppressed) — slots draft ragged lengths, including 0
-      (plain single-token decode) when the drafter found no match.
-    - rejected positions roll back INSIDE the call: positions,
-      last_token and the penalty histogram are reset to the accepted
-      length, so the returned ``DecodeState`` is indistinguishable from
-      having decoded ``emit`` plain steps.  The rejected drafts' KV
-      writes land only in the slot's private page tail past the
-      accepted length (asserted host-side in ``_spec_step``) and are
-      overwritten by the next step at those same (page, offset) slots.
-
-    Composition with the fused window (``decode_steps_per_sync``): the
-    host cannot draft again mid-window (drafting needs the sampled
-    tokens back), so instead of shrinking an n-step window to one
-    verify call — which would regress every non-drafting batchmate from
-    n tokens per host sync to 1 on relay-attached TPUs — ``n_extra``
-    PLAIN decode steps are scanned onto the verify call's rolled-back
-    state inside the SAME jit.  One spec sync then yields
-    ``(1 + accepted) + n_extra`` tokens per slot, strictly at least the
-    plain window's ``n``.
-
-    Returns ``(cache, state, sampled [B, n], emit [B],
-    extra [n_extra, B])`` — the host emits each slot's first ``emit``
-    sampled tokens, then the ``extra`` window tokens.
-    """
-    cfg = model_cfg
-    n = n_tokens
-    Hs = hist_pages * page_size
-
-    @functools.partial(jax.jit, donate_argnums=(1, 2))
-    def verify_fn(params, cache, state: DecodeState, drafts, draft_len):
+    def step_fn(params, cache, state: DecodeState, pargs, drafts,
+                draft_len, n_extra):
         B = state.last_token.shape[0]
-        active = state.active
-        pos0 = state.positions
-        tokens = jnp.concatenate(
-            [state.last_token[:, None], drafts], axis=1
-        )                                                    # [B, n]
-        pos_q = pos0[:, None] + jnp.arange(n)[None, :]       # [B, n]
-        # position j is live when it has a draft to verify (j-1 <
-        # draft_len) or is the bonus position right after the last
-        # accepted draft (j == draft_len); inactive slots mask entirely
-        valid_q = (
-            (jnp.arange(n)[None, :] <= draft_len[:, None])
-            & (active > 0)[:, None]
-        )
-        qseg = valid_q.astype(jnp.int32)
-        hist_idx = state.page_tables[:, :hist_pages]         # [B, m]
-        kv_pos_hist = jnp.broadcast_to(jnp.arange(Hs)[None], (B, Hs))
-        kseg_hist = (kv_pos_hist < pos0[:, None]).astype(jnp.int32)
+        L, KVH, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
+        kdt = jnp.dtype(cfg.dtype)
+        drops = None
 
-        def attn_fn(q, k, v, layer_cache, pos):
-            kh, vh = _gather_history(layer_cache, hist_idx, B, Hs)
-            k_all = jnp.concatenate([kh.astype(k.dtype), k], axis=1)
-            v_all = jnp.concatenate([vh.astype(v.dtype), v], axis=1)
-            kv_pos = jnp.concatenate([kv_pos_hist, pos_q], axis=1)
-            kseg = jnp.concatenate([kseg_hist, qseg], axis=1)
-            # n and Hs are both page_size multiples (the caller buckets
-            # the verify width), so page_size kv blocks always tile the
-            # flash grid exactly — Hs + n is rarely a 256 multiple
-            return full_attention(
-                q, k_all, v_all,
-                causal=True,
-                q_positions=pos_q,
-                kv_positions=kv_pos,
-                q_segment_ids=qseg,
-                kv_segment_ids=kseg,
-                backend=backend,
-                block_q=min(256, n),
-                block_kv=page_size,
+        # ---- 1. prefill segment --------------------------------------
+        if Cb > 0:
+            (p_tokens, p_pos, p_seg, p_pages, p_offsets, p_t0, p_qlen,
+             p_hist, p_tables, p_ends, p_sampling, p_keys) = pargs
+            kacc0 = jnp.zeros((L, 1, Cb, KVH, D), kdt)
+            vacc0 = jnp.zeros((L, 1, Cb, KVH, D), kdt)
+
+            def p_attn(q, k, v, carry_cache, pos):
+                (caches, kacc, vacc), lyr = carry_cache
+                if use_ring:
+                    out = _ring_chunk_attention(
+                        q, k, v, caches, lyr, p_pos, p_seg, p_hist,
+                        p_tables, mesh, page_size, ring_hist_pages,
+                    )
+                elif has_hist:
+                    out = _ragged_attn_call(
+                        q, k, v, caches, lyr, p_t0, p_qlen, p_hist,
+                        p_tables, backend,
+                    )
+                else:
+                    # cold rows only: packed self-attention, no pool
+                    # reads — bit-compatible with the pre-unification
+                    # packed-prefill path
+                    out = full_attention(
+                        q, k, v,
+                        causal=True,
+                        q_positions=p_pos,
+                        kv_positions=p_pos,
+                        q_segment_ids=p_seg,
+                        kv_segment_ids=p_seg,
+                        backend=backend,
+                    )
+                return out, (caches, kacc.at[lyr].set(k),
+                             vacc.at[lyr].set(v))
+
+            res = forward(
+                params, cfg, p_tokens, p_pos,
+                attn_fn=p_attn,
+                carry_caches=(cache.carry(), kacc0, vacc0),
+                moe_token_mask=p_seg > 0,
+                return_moe_stats=is_moe,
             )
+            if is_moe:
+                logits_p, (pc, kacc, vacc), moe_stats = res
+                drops = moe_stats["dropped"]
+            else:
+                logits_p, (pc, kacc, vacc) = res
+            cache = write_kv(
+                PagedKVCache.from_carry(pc), kacc, vacc, p_pages,
+                p_offsets, p_seg > 0,
+            )
+            last = logits_p[0, p_ends]      # [R, V] — each row's last token
+            p_first = sample(last, p_sampling, p_keys)
+        else:
+            p_first = jnp.zeros((0,), jnp.int32)
 
-        logits, (k_new, v_new) = forward(
-            params, cfg, tokens, pos_q,
-            attn_fn=attn_fn,
-            layer_caches=cache.carry(),
-            moe_token_mask=valid_q,
+        # ---- 2. state segment (decode / verify rows) -----------------
+        tokens_s = jnp.concatenate(
+            [state.last_token[:, None], drafts], axis=1
+        )                                                    # [B, W]
+        pos_s = state.positions[:, None] + jnp.arange(W)[None]
+        act = state.active > 0
+        live = (jnp.arange(W)[None] <= draft_len[:, None]) & act[:, None]
+        s_t0 = jnp.arange(B, dtype=jnp.int32) * W
+        # rows sitting this call out (draft_len -1: admission waves,
+        # standalone chunk steps) get q_len 0 so the kernel skips their
+        # page-pool sweep entirely — an admission wave must not cost a
+        # wasted decode step per active slot
+        s_qlen = jnp.where(act & (draft_len >= 0), W, 0).astype(jnp.int32)
+        s_hist = state.positions * state.active
+        kacc0s = jnp.zeros((L, B, W, KVH, D), kdt)
+        vacc0s = jnp.zeros((L, B, W, KVH, D), kdt)
+
+        def s_attn(q, k, v, carry_cache, pos):
+            (caches, kacc, vacc), lyr = carry_cache
+            out = _ragged_attn_call(
+                q, k, v, caches, lyr, s_t0, s_qlen, s_hist,
+                state.page_tables, backend,
+            )
+            return out, (caches, kacc.at[lyr].set(k),
+                         vacc.at[lyr].set(v))
+
+        carry0 = (cache.carry(), kacc0s, vacc0s)
+        if is_mrope:
+            from helix_tpu.models.qwen2_vl import text_forward_mrope
+
+            pos3 = jnp.broadcast_to(
+                (pos_s + state.mrope_delta[:, None])[None], (3, B, W)
+            )
+            logits_s, (pc2, kaccs, vaccs) = text_forward_mrope(
+                params, cfg, tokens_s, pos3,
+                attn_fn=s_attn,
+                carry_caches=carry0,
+                mrope_sections=cfg.mrope_sections,
+                seq_positions=pos_s,
+            )
+        else:
+            logits_s, (pc2, kaccs, vaccs) = forward(
+                params, cfg, tokens_s, pos_s,
+                attn_fn=s_attn,
+                carry_caches=carry0,
+                moe_token_mask=live,
+            )
+        cache = PagedKVCache.from_carry(pc2)
+        pages_s, offs_s = slot_to_page_offset(
+            pos_s, state.page_tables, page_size
         )
-        pages, offsets = slot_to_page_offset(
-            pos_q, state.page_tables, page_size
-        )
-        cache = write_kv(cache, k_new, v_new, pages, offsets, valid_q)
+        cache = write_kv(cache, kaccs, vaccs, pages_s, offs_s, live)
 
         # position-by-position penalised sampling (cheap [B, V] ops):
         # the histogram carries the drafted prefix forward so position
-        # j's penalties match plain decode having emitted j tokens
-        act_i32 = (active > 0).astype(state.token_counts.dtype)
-
+        # j's penalties match plain decode having emitted j tokens.
+        # Splits are consumed ONLY at live positions — a plain step
+        # (draft_len 0) advances the key stream exactly once.
         def samp_body(carry, j):
             counts, keys = carry
             pen = apply_penalties(
-                logits[:, j], counts,
+                logits_s[:, j], counts,
                 state.sampling.presence, state.sampling.frequency,
             )
             carry_keys, step_keys = split_keys(keys)
             tok = sample(pen, state.sampling, step_keys)
-            counts = counts.at[jnp.arange(B), tok].add(act_i32)
-            return (counts, carry_keys), tok
+            lj = live[:, j]
+            tok = jnp.where(lj, tok, 0)
+            keys = jnp.where(lj[:, None], carry_keys, keys)
+            counts = counts.at[jnp.arange(B), tok].add(
+                lj.astype(counts.dtype)
+            )
+            return (counts, keys), tok
 
         (counts, keys), sampled = jax.lax.scan(
-            samp_body, (state.token_counts, state.keys), jnp.arange(n)
+            samp_body, (state.token_counts, state.keys), jnp.arange(W)
         )
-        sampled = sampled.T                                  # [B, n]
+        sampled = sampled.T                                  # [B, W]
 
         # acceptance: longest prefix of draws agreeing with the drafts
-        in_draft = jnp.arange(n - 1)[None, :] < draft_len[:, None]
-        agree = jnp.where(in_draft, sampled[:, : n - 1] == drafts, True)
-        prefix = jnp.cumprod(agree.astype(jnp.int32), axis=1)
-        n_acc = jnp.sum(prefix * in_draft.astype(jnp.int32), axis=1)
-        emit = jnp.where(active > 0, n_acc + 1, 0)           # [B]
+        if W > 1:
+            in_draft = jnp.arange(W - 1)[None, :] < draft_len[:, None]
+            agree = jnp.where(
+                in_draft, sampled[:, : W - 1] == drafts, True
+            )
+            prefix = jnp.cumprod(agree.astype(jnp.int32), axis=1)
+            n_acc = jnp.sum(prefix * in_draft.astype(jnp.int32), axis=1)
+        else:
+            n_acc = jnp.zeros((B,), jnp.int32)
+        emit = jnp.where(live[:, 0], n_acc + 1, 0)           # [B]
 
         # roll back past the accepted length: positions/last_token/
         # histogram come out exactly as ``emit`` plain decode steps
         new_last = jnp.take_along_axis(
             sampled, jnp.maximum(emit - 1, 0)[:, None], axis=1
         )[:, 0]
-        discard = (
-            (jnp.arange(n)[None, :] >= emit[:, None])
-            & (active > 0)[:, None]
-        )
+        discard = (jnp.arange(W)[None, :] >= emit[:, None]) & live
         counts = counts.at[jnp.arange(B)[:, None], sampled].add(
             -discard.astype(counts.dtype)
         )
         new_state = DecodeState(
-            last_token=jnp.where(active > 0, new_last, state.last_token),
-            positions=pos0 + emit,
+            last_token=jnp.where(emit > 0, new_last, state.last_token),
+            positions=state.positions + emit,
             page_tables=state.page_tables,
-            active=active,
+            active=state.active,
             mrope_delta=state.mrope_delta,
             keys=keys,
             token_counts=counts,
             sampling=state.sampling,
         )
-        if n_extra:
-            # fused-window tail on the rolled-back state: identical to
-            # the plain n-step decode scan, just sharing the verify
-            # call's host sync
-            def step_body(carry, _):
-                c, st = carry
-                c, st, tok = _decode_one_step(
-                    params, c, st, cfg=cfg, backend=backend
-                )
-                return (_pin_default_layout(c), st), tok
 
-            (cache, new_state), extra = jax.lax.scan(
-                step_body, (_pin_default_layout(cache), new_state), None,
-                length=n_extra,
+        # ---- 3. fused plain-decode tail (dynamic length) -------------
+        if n_tail_max > 0:
+            buf0 = jnp.zeros((n_tail_max, B), jnp.int32)
+
+            def tail_body(t, carry):
+                c, st, buf = carry
+                c, st, tok = _tail_decode_step(
+                    params, c, st, cfg=cfg, backend=backend,
+                    page_size=page_size,
+                )
+                return _pin_default_layout(c), st, buf.at[t].set(tok)
+
+            cache, new_state, extra = jax.lax.fori_loop(
+                0, n_extra, tail_body,
+                (_pin_default_layout(cache), new_state, buf0),
             )
         else:
             extra = jnp.zeros((0, B), jnp.int32)
-        return cache, new_state, sampled, emit, extra
+        return cache, new_state, p_first, sampled, emit, extra, drops
 
-    return verify_fn
+    return step_fn
+
 
 
 class Engine:
@@ -1106,6 +1021,28 @@ class Engine:
                 self.spec = SpecDecoder(
                     SpecConfig(spec_tokens=cfg.spec_tokens)
                 )
+        # --- unified ragged step (ISSUE 10) ---
+        # ONE compiled device-step entry point serves packed/cache-hit
+        # prefill, chunked prefill, plain decode, the mixed step and
+        # spec-verify; at runtime it is keyed only on the prefill
+        # token-bucket ladder below (HELIX_TOKEN_BUCKETS overrides the
+        # power-of-two default with finer rungs → less padding, a few
+        # more compiles).
+        self._token_ladder = ragged_meta.parse_token_buckets(
+            os.environ.get("HELIX_TOKEN_BUCKETS"),
+            self.cache_cfg.page_size,
+            cfg.max_prefill_len,
+        )
+        # fused-window tail capacity (static buffer; actual tail length
+        # is a DYNAMIC argument, so every window size shares one trace)
+        self._n_tail_max = max(0, cfg.decode_steps_per_sync - 1)
+        W = self._spec_width()
+        self._zero_drafts = np.zeros((B, W - 1), np.int32)
+        self._zero_rows = np.zeros((B,), np.int32)     # plain decode rows
+        self._inert_rows = np.full((B,), -1, np.int32)  # state rows sit out
+        self._shape_key = (
+            model_cfg, self.cache_cfg.page_size, self._backend, mesh,
+        )
         # verify calls issued, drafts proposed, drafts accepted
         self.num_spec_steps = 0
         self.num_spec_drafted_tokens = 0
@@ -1113,6 +1050,10 @@ class Engine:
         # device-side decode steps (each fused window of n counts n):
         # decode_tokens / (device_steps * batch) is exact slot utilization
         self.num_decode_device_steps = 0
+        # device-step CALLS issued (one per unified ragged step / VL
+        # prefill): (prefill + decode tokens) / calls is the
+        # tokens-per-device-step figure the ragged unification moves
+        self.num_device_calls = 0
         # KV tiering (ISSUE 6): swap-out/swap-in of running decoders and
         # cumulative host->device restore time (bench's restore-latency
         # numerator; page-level spill/restore pools live on host_pool)
@@ -1219,20 +1160,22 @@ class Engine:
         return stuck
 
     def warmup(self, chunked: bool = True) -> None:
-        """Compile the packed prefill (smallest bucket) and the fused
-        decode step ahead of traffic (profile-apply time), so first-token
-        latency excludes XLA compilation.  Drives one real tiny request
-        through the public path (pages are allocated and freed normally).
+        """Compile the unified ragged step's shape ladder ahead of
+        traffic (profile-apply time), so first-token latency excludes
+        XLA compilation.  Drives one real tiny request through the
+        public path (pages are allocated and freed normally) — that
+        alone compiles the decode-only entry point, and with it EVERY
+        fused-window size and spec-verify width (both are dynamic
+        arguments of the one trace, not shape families) — then walks the
+        prefill token-bucket ladder against the garbage page.
 
-        When the context limit admits chunked prefill, also compiles the
-        full-chunk shape against every history-capacity bucket (the
-        dominant per-chunk shapes; a ragged final chunk may still compile
-        one extra small shape at request time) — those run against the
-        garbage page only."""
+        Pre-unification this compiled packed buckets + per-window decode
+        scans + verify (width × history × tail) triples + chunk/mixed
+        (C × history) pairs; the whole zoo is now O(|token ladder|)
+        entry points (a ragged final chunk may still compile one extra
+        small single-row shape at request time)."""
         if self.model_cfg.mrope_sections is not None:
             return  # VL prefill shape depends on image buckets; skip
-        # drive a real tiny request through the public path: compiles the
-        # packed prefill (smallest bucket) AND the fused decode step
         req = Request(
             id="__warmup__",
             prompt_tokens=[0] * min(4, self.cache_cfg.page_size),
@@ -1244,97 +1187,43 @@ class Engine:
         # the warmup token's latency is XLA compile time, not serving
         # latency — keep it out of the TTFT percentiles
         self.recent_ttfts.clear()
-        # compile every fused multi-step decode window the runtime can
-        # pick (powers of two <= decode_steps_per_sync), against the idle
-        # state: active==0 masks every KV write to the garbage page, so
-        # this advances nothing
-        if self.cfg.decode_steps_per_sync > 1:
-            self._sync_state()
-            n = 2
-            while n <= self.cfg.decode_steps_per_sync:
-                fn = self._get_decode_fn(n)
-                self.cache, self._dstate, _ = fn(
-                    self.params, self.cache, self._dstate
-                )
-                n *= 2
-        if self.spec is not None:
-            # compile the verify shape for every (history bucket,
-            # fused-window tail) pair the runtime can pick, against the
-            # idle state (active==0 masks every KV write to the garbage
-            # page) — the first speculative window under live traffic
-            # must not pay XLA
-            self._sync_state()
-            width = self._spec_width()
-            B = self.cfg.max_decode_batch
-            zdrafts = jnp.zeros((B, width - 1), jnp.int32)
-            zlen = jnp.zeros((B,), jnp.int32)
-            ps = self.cache_cfg.page_size
-            max_m = self._spec_hist_pages(self.max_context_len)
-            extras = {0}
-            n = 2
-            while n <= self.cfg.decode_steps_per_sync:
-                extras.add(n - 1)
-                n *= 2
-            m = 1
-            while True:
-                for ne in sorted(extras):
-                    vfn = _build_verify_fn(
-                        self.model_cfg, ps, self._backend, width, m, ne
-                    )
-                    self.cache, self._dstate, _, _, _ = vfn(
-                        self.params, self.cache, self._dstate, zdrafts,
-                        zlen,
-                    )
-                if m >= max_m:
-                    break
-                # max_m is clamped to max_pages_per_seq, which need not
-                # be a power of two — overshooting it would gather more
-                # page-table columns than exist (reshape trace error)
-                # AND skip compiling the bucket the runtime actually
-                # picks
-                m = min(m * 2, max_m)
-        C = self.cfg.max_prefill_len
-        if not chunked or self.max_context_len <= C:
-            return
+        self._sync_state()
         ps = self.cache_cfg.page_size
-        fn = _build_chunk_prefill_fn(
-            self.model_cfg, ps, self._backend, self.mesh
+        maxP = self.cache_cfg.max_pages_per_seq
+        B = self.cfg.max_decode_batch
+        can_chunk = (
+            chunked and self.max_context_len > self.cfg.max_prefill_len
         )
-        sampling = SamplingState.from_params([SamplingParams()])
-        key = jax.random.PRNGKey(0)
-        tokens = jnp.zeros((1, C), jnp.int32)
-        full = jnp.zeros((1, self.cache_cfg.max_pages_per_seq), jnp.int32)
-        # largest history bucket runtime can ask for: chunk starts are
-        # multiples of C below max_context_len, bucketed up to the next
-        # C * 2^k — compiling past that would burn XLA time on shapes
-        # that can never occur
-        max_start = ((self.max_context_len - 1) // C) * C
-        # the mixed step is what actually runs whenever decode slots are
-        # active during a long-prompt admission — compile it per bucket
-        # too (idle decode state: active==0 writes to the garbage page),
-        # or the first long prompt under live decode traffic would pay
-        # the XLA compile as a mid-serving stall
-        mixed_fn = None
-        if self.cfg.enable_mixed_step:
-            self._sync_state()
-            mixed_fn = _build_mixed_step_fn(
-                self.model_cfg, ps, self._backend, self.mesh
+        hist_variants = [False]
+        if self.prefix_cache is not None or can_chunk:
+            # cache-hit waves / chunk continuations attend history
+            hist_variants.append(True)
+
+        def drive(rung: int, with_hist: bool, rows: int) -> None:
+            # one dummy row filling the rung exactly; its table is all
+            # garbage-page zeros, so reads see garbage (discarded) and
+            # writes land on page 0 — nothing real advances
+            plan = PrefillPlan(ps, maxP, rows)
+            plan.add(
+                None, np.zeros((maxP,), np.int32),
+                ps if with_hist else 0, rung, [0] * rung,
+                _host_key(0), SamplingParams(),
             )
-        hist = 0   # 0 = the first-chunk (no-history) shape
-        while True:
-            args = (
-                tokens, jnp.int32(hist), jnp.int32(C),
-                jnp.zeros((1, hist // ps), jnp.int32), full, sampling,
-                key,
+            self._ragged_step(
+                plan=plan, draft_len=self._inert_rows, n_extra=0,
             )
-            self.cache, _, _ = fn(self.params, self.cache, *args)
-            if mixed_fn is not None:
-                self.cache, self._dstate, _, _, _ = mixed_fn(
-                    self.params, self.cache, *args, self._dstate
-                )
-            if hist >= max_start:   # covered the largest runtime bucket
-                break
-            hist = C if hist == 0 else hist * 2
+
+        for rung in self._token_ladder:
+            for hh in hist_variants:
+                drive(rung, hh, B)
+        if can_chunk:
+            # the dominant per-chunk shapes: full chunks run single-row
+            # at the top rung — the FIRST chunk of a cold long prompt
+            # has no history, every later chunk does, and the mixed
+            # step shares both traces (the state segment rides along in
+            # every entry point)
+            drive(self.cfg.max_prefill_len, False, 1)
+            drive(self.cfg.max_prefill_len, True, 1)
 
     def step(self) -> list[tuple[Request, int]]:
         """Admit + prefill waiting requests, then one decode step.
@@ -1698,32 +1587,18 @@ class Engine:
             plen = len(req.prompt_tokens)
             needs_chunking = plen > self.cfg.max_prefill_len
             is_mrope = self.model_cfg.mrope_sections is not None
-            cache_match = 0
-            if self.prefix_cache is not None and not is_mrope:
-                # both tiers: a host-resident continuation also means the
-                # remainder must attend history (its pages restore into
-                # the table during the claim)
-                cache_match = self._cached_prefix_pages(req)
-            if cache_match and not needs_chunking:
-                # a cached prefix means the remainder must attend HISTORY
-                # (the shared pages): the packed path can't, but a ONE-
-                # SHOT chunk call can — run it inline so hit bursts admit
-                # in the same step (they must not serialize through the
-                # single in-flight chunking state)
-                if not self._admit_chunk_hit(req, pending):
+            if not needs_chunking and not is_mrope:
+                # short text prompts — cold AND prefix-cache hits — pack
+                # into ONE ragged prefill segment (a hit row's remainder
+                # attends the shared pages via its per-row history
+                # length; pre-unification each hit paid its own padded
+                # chunk call).  First tokens stay on device until the
+                # whole wave is admitted (one fetch per wave, not per
+                # call — each fetch is a full relay round trip).
+                if not self._admit_wave(pending):
                     # resource wait: overlap it with the host->device
                     # uploads the eventual claim will consume
                     self._prefetch_host_prefix(req)
-                    return
-                continue
-            if not needs_chunking and not is_mrope:
-                # short text prompts pack into ONE prefill call; first
-                # tokens stay on device until the whole wave is admitted
-                # (one fetch per wave, not per call — each fetch is a
-                # full relay round trip)
-                if not self._admit_packed(pending):
-                    if not is_mrope:
-                        self._prefetch_host_prefix(req)
                     return
                 continue
             if needs_chunking and self._chunking is not None:
@@ -1763,150 +1638,103 @@ class Engine:
             self._changed_slots.add(slot)
             self._emit(req, int(first_token), emitted)
 
-    def _admit_chunk_hit(self, req: Request, pending: list) -> bool:
-        """Admit ONE short prompt whose prefix is cache-resident: a
-        single chunk-prefill call attends the remainder against the
-        shared history pages.  First tokens join the packed wave's
-        batched fetch.  Returns False when blocked on resources."""
-        table = self._try_claim(req, use_cache=True)
-        if table is None:
-            return False
-        self.waiting.pop(0)
-        plen = len(req.prompt_tokens)
-        start = req.cached_tokens
-        rem = plen - start
-        ps = self.cache_cfg.page_size
-        C_cap = self.cfg.max_prefill_len
-        Cb = _bucket(max(rem, ps), ps, C_cap)
-        self.num_prefill_padding_tokens += Cb - rem
-        tokens = np.zeros((1, Cb), np.int32)
-        tokens[0, :rem] = req.prompt_tokens[start:plen]
-        if start == 0:
-            m = 0
-        else:
-            hist_tokens = C_cap
-            while hist_tokens < start:
-                hist_tokens *= 2
-            m = hist_tokens // ps
-        hist_table = np.zeros((1, m), np.int32)
-        used = min(m, -(-start // ps))
-        hist_table[0, :used] = table[:used]
-        carry, sub = _host_split(self._request_key(req))
-        self._slot_keys[req.slot] = carry
-        fn = _build_chunk_prefill_fn(
-            self.model_cfg, ps, self._backend, self.mesh
-        )
-        self.cache, token, drops = fn(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens),
-            jnp.int32(start),
-            jnp.int32(rem),
-            jnp.asarray(hist_table),
-            jnp.asarray(table)[None],
-            SamplingState.from_params([req.sampling]),
-            sub,
-        )
-        pending.append(([(req, table)], token, drops))
-        return True
-
-    def _admit_packed(self, pending: list) -> int:
-        """Claim as many short waiting prompts as fit one packed bucket
-        and prefill them in a single forward pass (segment-packed, like
-        the SFT data path).  Returns requests admitted (0 = blocked).
+    def _admit_wave(self, pending: list) -> int:
+        """Claim as many waiting short text prompts as fit one ragged
+        prefill segment and prefill them in ONE unified step.  Cold
+        prompts and prefix-cache hits pack the same flat token axis — a
+        hit row's remainder attends the shared pages through its per-row
+        history length, so hit bursts no longer serialize through padded
+        one-request chunk calls.  Returns requests admitted (0 =
+        blocked on resources).
 
         First tokens are NOT fetched here: the device handle is appended
         to ``pending`` and ``_finish_packed_admissions`` fetches the whole
         admission wave in one host round trip."""
         C_cap = self.cfg.max_prefill_len
         ps = self.cache_cfg.page_size
-        batch = []
-        used = 0
-        # MoE: one request per packed call — expert capacity is a shared
-        # field across the whole packed sequence, so co-packed requests
-        # would perturb each other's routing (and the KV the prefix
-        # cache adopts). The admission loop still issues the calls in
-        # one wave with one batched token fetch.
-        max_pack = (
-            1 if self.model_cfg.num_experts > 0 else len(self.waiting)
-        )
+        maxP = self.cache_cfg.max_pages_per_seq
+        B = self.cfg.max_decode_batch
+        # MoE: one request per call — expert capacity is a shared field
+        # across the whole segment, so co-packed requests would perturb
+        # each other's routing (and the KV the prefix cache adopts).
+        # The admission loop still issues the calls in one wave with one
+        # batched token fetch.
+        max_pack = 1 if self.model_cfg.num_experts > 0 else B
+        sp_ring = _mesh_sp(self.mesh) > 1
+        plan = PrefillPlan(ps, maxP, B)
+        batch: list = []
+        waves: list = []   # closed (plan, batch) pairs
+
+        def flush():
+            nonlocal plan, batch
+            if batch:
+                waves.append((plan, batch))
+            plan = PrefillPlan(ps, maxP, B)
+            batch = []
+
+        admitted_any = False
         while self.waiting:
             req = self.waiting[0]
             if req.finished:
                 self.waiting.pop(0)
                 continue
             plen = len(req.prompt_tokens)
+            if plen > C_cap:
+                break   # long prompt: the outer admission loop chunks it
             if len(batch) >= max_pack:
-                break
-            if plen > C_cap or (batch and used + plen > C_cap):
-                break
+                flush()
             if (
-                batch
+                (batch or waves or admitted_any)
                 and self._budget_left is not None
                 and self._budget_left <= 0
             ):
-                # budget spent mid-wave: close the packed call with what
-                # fit (the first claim of a wave is always admitted)
+                # budget spent mid-wave: close with what fit (the first
+                # claim of a step is always admitted)
                 break
-            table = self._try_claim(req)
+            cache_match = 0
+            if self.prefix_cache is not None:
+                cache_match = self._cached_prefix_pages(req)
+            if sp_ring and batch and (cache_match or plan.has_hist):
+                # ring attention has no segment ids: a history-attending
+                # row runs alone in its call on sp meshes
+                flush()
+            table = self._try_claim(req, use_cache=cache_match > 0)
             if table is None:
                 break
             self.waiting.pop(0)
-            batch.append((req, table))
-            used += plen
-        if not batch:
-            return 0
-        K = len(batch)
-        C = _bucket(max(used, ps), ps, C_cap)
-        self.num_prefill_padding_tokens += C - used
-        tokens = np.zeros((1, C), np.int32)
-        positions = np.zeros((1, C), np.int32)
-        segments = np.zeros((1, C), np.int32)     # 0 = padding
-        pages = np.zeros((1, C), np.int32)        # garbage page default
-        offsets = np.zeros((1, C), np.int32)
-        ends = np.zeros((K,), np.int32)
-        keys = np.zeros((K, 2), np.uint32)
-        cursor = 0
-        for si, (req, table) in enumerate(batch):
-            plen = len(req.prompt_tokens)
-            sl = slice(cursor, cursor + plen)
-            tokens[0, sl] = req.prompt_tokens
-            abs_pos = np.arange(plen)
-            positions[0, sl] = abs_pos
-            segments[0, sl] = si + 1
-            pages[0, sl] = table[abs_pos // ps]
-            offsets[0, sl] = abs_pos % ps
-            ends[si] = cursor + plen - 1
+            admitted_any = True
+            start = req.cached_tokens   # 0 unless prefix-cache hit
+            rem = plen - start
+            if batch and not plan.fits(rem, C_cap):
+                flush()
             carry, sub = _host_split(self._request_key(req))
             self._slot_keys[req.slot] = carry
-            keys[si] = sub
-            cursor += plen
-        sampling = SamplingState.from_params([r.sampling for r, _ in batch])
-        fn = _build_packed_prefill_fn(self.model_cfg, self._backend)
-        self.cache, first_tokens, drops = fn(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(segments),
-            jnp.asarray(pages),
-            jnp.asarray(offsets),
-            jnp.asarray(segments > 0),
-            jnp.asarray(ends),
-            sampling,
-            jnp.asarray(keys),
-        )
-        pending.append((batch, first_tokens, drops))
-        return K
+            plan.add(
+                req, table, start, rem,
+                req.prompt_tokens[start:plen], sub, req.sampling,
+            )
+            batch.append((req, table))
+        flush()
+        admitted = 0
+        for wave_plan, wave_batch in waves:
+            first_tokens, _, _, _, drops = self._ragged_step(
+                plan=wave_plan, draft_len=self._inert_rows, n_extra=0,
+            )
+            pending.append((wave_batch, first_tokens, drops))
+            admitted += len(wave_batch)
+        return admitted
 
     def _finish_packed_admissions(self, pending: list, emitted) -> None:
-        """Fetch every packed call's first tokens in ONE host round trip
-        and complete the per-request bookkeeping."""
+        """Fetch every admission wave's first tokens in ONE host round
+        trip and complete the per-request bookkeeping."""
         if len(pending) == 1:
-            flat = np.asarray(pending[0][1])
+            batch0, tok0, _ = pending[0]
+            flat = np.asarray(tok0)[: len(batch0)]
         else:
             flat = np.asarray(
-                jnp.concatenate([t for _, t, _ in pending], axis=0)
+                jnp.concatenate(
+                    [t[: len(b)] for b, t, _ in pending], axis=0
+                )
             )
         for _, _, drops in pending:
             self._note_moe_drops(drops)
@@ -1972,45 +1800,26 @@ class Engine:
         behind the device."""
         return self._moe_dropped
 
-    def _chunk_host_args(self, st) -> tuple:
-        """Host-side prep for one chunk of the in-flight long prefill:
-        returns ``(device_args, rem, end)`` where ``device_args`` feed
-        ``_chunk_prefill_body``'s traced signature."""
+    def _chunk_plan(self, st) -> tuple:
+        """ONE ragged row for the in-flight long prefill's next chunk:
+        the row's history length is simply the chunk start (no history
+        bucketing — the ragged op walks exactly the pages in use), so
+        chunked prefill compiles one single-row shape per token-bucket
+        rung instead of one per (chunk, history) pair."""
         req: Request = st["req"]
         plen = len(req.prompt_tokens)
         start = st["next"]
-        C_cap = self.cfg.max_prefill_len
-        end = min(start + C_cap, plen)
+        end = min(start + self.cfg.max_prefill_len, plen)
         rem = end - start
-        ps = self.cache_cfg.page_size
-        Cb = _bucket(max(rem, ps), ps, C_cap)
-        self.num_prefill_padding_tokens += Cb - rem
-        tokens = np.zeros((1, Cb), np.int32)
-        tokens[0, :rem] = req.prompt_tokens[start:end]
-        # history capacity: smallest power-of-two multiple of the chunk cap
-        # covering `start` — bounds distinct compile shapes to O(log S)
-        if start == 0:
-            m = 0
-        else:
-            hist_tokens = C_cap
-            while hist_tokens < start:
-                hist_tokens *= 2
-            m = hist_tokens // ps
-        full_table = st["table"]
-        hist_table = np.zeros((1, m), np.int32)
-        used = min(m, -(-start // ps))
-        hist_table[0, :used] = full_table[:used]
         st["key"], sub = _host_split(st["key"])
-        args = (
-            jnp.asarray(tokens),
-            jnp.int32(start),
-            jnp.int32(rem),
-            jnp.asarray(hist_table),
-            jnp.asarray(full_table)[None],
-            SamplingState.from_params([req.sampling]),
-            sub,
+        plan = PrefillPlan(
+            self.cache_cfg.page_size, self.cache_cfg.max_pages_per_seq, 1
         )
-        return args, rem, end
+        plan.add(
+            req, st["table"], start, rem,
+            req.prompt_tokens[start:end], sub, req.sampling,
+        )
+        return plan, rem, end
 
     def _finish_chunk(self, st, first_token: int, emitted) -> None:
         """Prompt fully cached: activate the slot with the first sampled
@@ -2057,12 +1866,10 @@ class Engine:
             self._chunking = None
             return
         t0 = time.monotonic()
-        args, rem, end = self._chunk_host_args(st)
-        fn = _build_chunk_prefill_fn(
-            self.model_cfg, self.cache_cfg.page_size, self._backend,
-            self.mesh,
+        plan, rem, end = self._chunk_plan(st)
+        token, _, _, _, drops = self._ragged_step(
+            plan=plan, draft_len=self._inert_rows, n_extra=0,
         )
-        self.cache, token, drops = fn(self.params, self.cache, *args)
         self._note_moe_drops(drops)
         self.num_prefill_tokens += rem
         st["next"] = end
@@ -2076,7 +1883,7 @@ class Engine:
             )
         if end < len(req.prompt_tokens):
             return
-        self._finish_chunk(st, int(token[0]), emitted)
+        self._finish_chunk(st, int(np.asarray(token)[0]), emitted)
 
     def _mixed_step(self, emitted) -> None:
         """Ragged mixed step: ONE device call advances every active decode
@@ -2099,13 +1906,9 @@ class Engine:
                     f"invariant violated"
                 )
         t0 = time.monotonic()
-        args, rem, end = self._chunk_host_args(st)
-        fn = _build_mixed_step_fn(
-            self.model_cfg, self.cache_cfg.page_size, self._backend,
-            self.mesh,
-        )
-        self.cache, self._dstate, dec_tokens, token, drops = fn(
-            self.params, self.cache, *args, self._dstate
+        plan, rem, end = self._chunk_plan(st)
+        token, sampled, _, _, drops = self._ragged_step(
+            plan=plan, draft_len=self._zero_rows, n_extra=0,
         )
         self.num_mixed_steps += 1
         self.num_decode_device_steps += 1
@@ -2119,23 +1922,23 @@ class Engine:
                 chunk_end=end, tokens=rem, mixed=True,
             )
         # decode emissions first (the chunking slot is still parked here)
-        next_np = np.asarray(dec_tokens)        # [B] — ONE host fetch
+        next_np = np.asarray(sampled)       # [B, W] — ONE host fetch
         for i, r in enumerate(self.slots):
             if r is None or not self._slot_active(i):
                 continue
             self._positions[i] += 1
-            self._last_token[i] = next_np[i]
+            self._last_token[i] = next_np[i, 0]
             self.num_decode_tokens += 1
-            self._emit(r, int(next_np[i]), emitted)
+            self._emit(r, int(next_np[i, 0]), emitted)
         if end < len(req.prompt_tokens):
             return
-        self._finish_chunk(st, int(token[0]), emitted)
+        self._finish_chunk(st, int(np.asarray(token)[0]), emitted)
 
     def _prefill(
         self, req: Request, page_table: np.ndarray, slot: Optional[int] = None
     ) -> int:
         """VL (mrope) single-shot prefill.  Text prompts never come here:
-        short ones pack through ``_admit_packed`` and long ones chunk
+        short ones pack through ``_admit_wave`` and long ones chunk
         through ``_chunk_step``."""
         assert self.model_cfg.mrope_sections is not None
         plen = len(req.prompt_tokens)
@@ -2146,7 +1949,10 @@ class Engine:
         )
         tokens = np.zeros((1, bucket), np.int32)
         tokens[0, :plen] = req.prompt_tokens
-        self.num_prefill_padding_tokens += bucket - plen
+        self._charge_padding(bucket, plen)
+        ragged_meta.note_step_shape(
+            self._shape_key, ("mrope_prefill", bucket)
+        )
         length = np.int32(plen)
         # per-request PRNG stream: seeded requests reproduce exactly
         # regardless of batch-mates; the carry half becomes the slot's
@@ -2164,6 +1970,7 @@ class Engine:
         fn = _build_prefill_fn_mrope(
             self.model_cfg, self.cache_cfg.page_size, self._backend
         )
+        self.num_device_calls += 1
         self.cache, token = fn(
             self.params, self.cache, jnp.asarray(tokens), embeds,
             jnp.asarray(pos3), jnp.asarray(page_table)[None],
@@ -2519,7 +2326,7 @@ class Engine:
         return out
 
     # ------------------------------------------------------------------
-    # speculative decoding (engine/spec.py + _build_verify_fn)
+    # speculative decoding (engine/spec.py + the unified ragged step)
     # ------------------------------------------------------------------
 
     @property
@@ -2533,33 +2340,15 @@ class Engine:
         return self.spec.disabled_count() if self.spec is not None else 0
 
     def _spec_width(self) -> int:
-        """Verify-call token width: spec_tokens + 1 (the bonus position),
-        bucketed up to a page_size multiple on the pallas backend so the
-        flash grid tiles.  The reference backend ignores block shapes, so
-        it keeps the exact width — the in-call sampling scan then runs
-        k+1 iterations, not page_size."""
-        w = self.cfg.spec_tokens + 1
-        backend = self._backend
-        if backend is None:
-            platform = jax.devices()[0].platform
-            backend = (
-                "pallas" if platform in ("tpu", "axon") else "reference"
-            )
-        if backend != "pallas":
-            return w
-        ps = self.cache_cfg.page_size
-        return -(-w // ps) * ps
-
-    def _spec_hist_pages(self, max_pos: int) -> int:
-        """History gather capacity for a verify call: smallest
-        power-of-two page count covering ``max_pos`` cached tokens —
-        bounds distinct compile shapes to O(log S), same scheme as
-        chunked prefill."""
-        ps = self.cache_cfg.page_size
-        m = 1
-        while m * ps < max_pos:
-            m *= 2
-        return min(m, self.cache_cfg.max_pages_per_seq)
+        """State-segment token width: spec_tokens + 1 (the bonus
+        position) when speculation is on, 1 otherwise — EXACT on every
+        backend.  The ragged kernel tiles 8-token query blocks
+        internally, so pallas no longer buckets the verify width up to a
+        page_size multiple (pre-unification a k=4 draft padded every
+        verify call to 16 positions at page_size 16), and the history
+        length is a per-row runtime value rather than a compile-shape
+        bucket."""
+        return 1 if self.spec is None else self.cfg.spec_tokens + 1
 
     def _spec_extra_steps(self) -> int:
         """Fused-window tail for a verify call: plain decode steps
@@ -2604,12 +2393,10 @@ class Engine:
         table_cap = self.cache_cfg.max_pages_per_seq * ps
         drafts = np.zeros((B, width - 1), np.int32)
         draft_len = np.zeros((B,), np.int32)
-        max_pos = 1
         for i, req in enumerate(self.slots):
             if req is None or not self._slot_active(i):
                 continue
             pos = int(self._positions[i])
-            max_pos = max(max_pos, pos)
             # headroom: the verify call writes KV for pos..pos+L, so the
             # draft must fit the slot's allocated pages (max_len) and is
             # not worth proposing past the remaining token budget
@@ -2643,16 +2430,9 @@ class Engine:
             draft_len[i] = len(toks)
         if not draft_len.any():
             return False
-        if self._state_dirty or self._dstate is None:
-            self._sync_state()
         n_extra = self._spec_extra_steps()
-        fn = _build_verify_fn(
-            self.model_cfg, ps, self._backend, width,
-            self._spec_hist_pages(max_pos), n_extra,
-        )
-        self.cache, self._dstate, sampled, emit, extra = fn(
-            self.params, self.cache, self._dstate,
-            jnp.asarray(drafts), jnp.asarray(draft_len),
+        _, sampled, emit, extra, _ = self._ragged_step(
+            drafts=drafts, draft_len=draft_len, n_extra=n_extra,
         )
         self.num_spec_steps += 1
         # ONE device call for verify + the fused-window tail: with
@@ -2693,14 +2473,12 @@ class Engine:
         return True
 
     def _decode_step(self) -> list[tuple[Request, int]]:
-        if self._state_dirty or self._dstate is None:
-            self._sync_state()
         n = self._decode_window()
-        # Headroom invariant, checked loudly on host: the in-kernel KV
-        # write clamps its page-table index, so a slot whose position can
-        # reach table capacity inside this window would silently corrupt
-        # offset 0 of its last page instead of failing (ADVICE r3).  The
-        # window logic above must make this impossible; verify it.
+        # Headroom invariant, checked loudly on host: the KV write clamps
+        # its page-table index, so a slot whose position can reach table
+        # capacity inside this window would silently corrupt offset 0 of
+        # its last page instead of failing (ADVICE r3).  The window logic
+        # above must make this impossible; verify it.
         table_cap = self.cache_cfg.max_pages_per_seq * self.cache_cfg.page_size
         for i in range(len(self.slots)):
             if self._slot_active(i) and self._positions[i] + n > table_cap:
@@ -2709,27 +2487,114 @@ class Engine:
                     f"at position {self._positions[i]} + {n} steps > "
                     f"{table_cap} — headroom invariant violated"
                 )
-        fn = self._get_decode_fn(n)
-        self.cache, self._dstate, next_tokens = fn(
-            self.params, self.cache, self._dstate
+        # plain decode IS the unified step with zero drafts: position 0
+        # of each active row samples this step's token, and the fused
+        # tail advances the remaining n-1 window steps in the same jit
+        _, sampled, _, extra, _ = self._ragged_step(
+            draft_len=self._zero_rows, n_extra=n - 1,
         )
         self.num_decode_device_steps += n
-        next_np = np.asarray(next_tokens)       # [n, B] — ONE host fetch
+        sampled_np, extra_np = jax.device_get((sampled, extra))
         emitted: list[tuple[Request, int]] = []
-        for s in range(n):
+        for i, req in enumerate(self.slots):
+            if req is None or not self._slot_active(i):
+                continue  # finished mid-window: discard the overrun
+            self._positions[i] += 1
+            self._last_token[i] = sampled_np[i, 0]
+            self.num_decode_tokens += 1
+            self._emit(req, int(sampled_np[i, 0]), emitted)
+        for s in range(n - 1):
             for i, req in enumerate(self.slots):
                 if req is None or not self._slot_active(i):
-                    continue  # finished mid-window: discard the overrun
+                    continue
                 self._positions[i] += 1
-                self._last_token[i] = next_np[s, i]
+                self._last_token[i] = extra_np[s, i]
                 self.num_decode_tokens += 1
-                self._emit(req, int(next_np[s, i]), emitted)
+                self._emit(req, int(extra_np[s, i]), emitted)
         return emitted
 
-    def _get_decode_fn(self, n_steps: int = 1):
-        return _build_decode_fn(
-            self.model_cfg, self.cache_cfg.page_size, self._backend, n_steps
+    # ------------------------------------------------------------------
+    # the unified ragged device step (ISSUE 10)
+    # ------------------------------------------------------------------
+
+    def _charge_padding(self, bucket: int, used: int) -> None:
+        """THE padding formula: every prefill caller rounds its token
+        axis up to a compile bucket, and the difference is forward-pass
+        work spent on zeros.  One site (plus the VL single-shot path)
+        so ``helix_prefill_padding_*`` can never drift between
+        callers."""
+        self.num_prefill_padding_tokens += max(0, int(bucket) - int(used))
+
+    @property
+    def compiled_step_shapes(self) -> int:
+        """Distinct compiled device-step entry points live for this
+        model (unified ragged shapes + VL prefill buckets), from the
+        module-level registry — the shape-zoo collapse, observable."""
+        return ragged_meta.compiled_step_shapes(self._shape_key)
+
+    def _ragged_step(self, plan=None, drafts=None, draft_len=None,
+                     n_extra: int = 0):
+        """Issue ONE unified device step: the optional prefill plan's
+        ragged rows + the decode-state segment (+ a fused plain-decode
+        tail of ``n_extra`` steps).  Every device-step caller routes
+        here; the compiled entry point is keyed only on the prefill
+        token-bucket (plus the has-history / row-capacity variants the
+        plan implies).  Returns ``(p_first, sampled, emit, extra,
+        drops)`` device handles."""
+        if self._state_dirty or self._dstate is None:
+            self._sync_state()
+        if drafts is None:
+            drafts = self._zero_drafts
+        if draft_len is None:
+            draft_len = self._inert_rows
+        if plan is not None and plan.rows:
+            rung = bucket_tokens(plan.used, self._token_ladder)
+            self._charge_padding(rung, plan.used)
+            a = plan.finalize(rung)
+            sampling = SamplingState.from_params(
+                [r.sampling for r in plan.rows]
+                + [SamplingParams()] * (plan.max_rows - len(plan.rows))
+            )
+            pargs = (
+                jnp.asarray(a["tokens"]), jnp.asarray(a["pos"]),
+                jnp.asarray(a["seg"]), jnp.asarray(a["pages"]),
+                jnp.asarray(a["offsets"]), jnp.asarray(a["t0"]),
+                jnp.asarray(a["qlen"]), jnp.asarray(a["hist"]),
+                jnp.asarray(a["tables"]), jnp.asarray(a["ends"]),
+                sampling, jnp.asarray(a["keys"]),
+            )
+            rows = plan.max_rows
+            has_hist = plan.has_hist
+        else:
+            rung, rows, has_hist, pargs = 0, 0, False, ()
+        ring_hist = 0
+        if rows == 1 and _mesh_sp(self.mesh) > 1:
+            # ring chunks gather a STATIC pow2-bucketed history capacity
+            # (smallest pow2 multiple of the chunk cap covering the
+            # start — the pre-unification chunk scheme), so the ring
+            # payload scales with actual history, not max context
+            start = max((r.start for r in plan.rows), default=0)
+            if start > 0:
+                hist_tokens = self.cfg.max_prefill_len
+                while hist_tokens < start:
+                    hist_tokens *= 2
+                ring_hist = min(
+                    hist_tokens // self.cache_cfg.page_size,
+                    self.cache_cfg.max_pages_per_seq,
+                )
+        fn = _build_ragged_step_fn(
+            self.model_cfg, self.cache_cfg.page_size, self._backend,
+            self.mesh, rung, has_hist, rows, self._spec_width(),
+            self._n_tail_max, ring_hist,
         )
+        self.num_device_calls += 1
+        (self.cache, self._dstate, p_first, sampled, emit, extra,
+         drops) = fn(
+            self.params, self.cache, self._dstate, pargs,
+            jnp.asarray(drafts), jnp.asarray(draft_len),
+            jnp.int32(n_extra),
+        )
+        return p_first, sampled, emit, extra, drops
 
     # ------------------------------------------------------------------
     # completion

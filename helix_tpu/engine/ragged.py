@@ -1,0 +1,186 @@
+"""Host-side metadata for the unified ragged device step.
+
+The engine compiles ONE device-step entry point per (model, backend,
+token-bucket) — ``engine._build_ragged_step_fn`` — and every caller
+(packed/cache-hit prefill, chunked prefill, plain decode, the mixed
+step, spec-verify) is a thin metadata builder over it.  This module owns
+the host-side pieces of that contract:
+
+- the **token-bucket ladder**: the prefill segment's flat token axis is
+  padded to a rung so XLA compiles O(log max_prefill_len) shapes, not
+  one per prompt length.  Default: powers of two from ``page_size`` to
+  ``max_prefill_len``; ``HELIX_TOKEN_BUCKETS`` overrides with an
+  explicit comma-separated ladder (finer rungs trade a few extra
+  compiles for less padding — the padding-ratio gauge shows whether it
+  paid off).
+- :class:`PrefillPlan` — accumulates prefill **rows** (one per admitted
+  prompt / in-flight chunk) and finalizes them into the device arrays
+  the unified step consumes: flat tokens + positions + segment ids + KV
+  write destinations, and per-row (t0, q_len, hist, table, end,
+  sampling, key).
+- the **compiled-shape registry** — every distinct (token-bucket,
+  has-history) entry point the unified builder traces is recorded per
+  model key, so ``helix_compiled_step_shapes`` can report the shape-zoo
+  collapse instead of asserting it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Optional
+
+import numpy as np
+
+
+def parse_token_buckets(
+    spec: Optional[str], page_size: int, cap: int
+) -> tuple:
+    """The prefill token-bucket ladder, ascending, capped at ``cap``.
+
+    ``spec`` (from ``HELIX_TOKEN_BUCKETS``) is a comma-separated list of
+    rung sizes; invalid entries raise (a typo'd ladder must not silently
+    become the default).  ``None``/empty: powers of two from
+    ``page_size`` up to ``cap``.  The top rung is always ``cap`` so any
+    admissible chunk has a home."""
+    if spec:
+        rungs = sorted(
+            {min(int(tok), cap) for tok in spec.split(",") if tok.strip()}
+        )
+        if not rungs or any(r <= 0 for r in rungs):
+            raise ValueError(
+                f"HELIX_TOKEN_BUCKETS {spec!r}: rungs must be positive ints"
+            )
+    else:
+        rungs = []
+        b = page_size
+        while b < cap:
+            rungs.append(b)
+            b *= 2
+    if not rungs or rungs[-1] != cap:
+        rungs.append(cap)
+    return tuple(rungs)
+
+
+def bucket_tokens(n: int, ladder: tuple) -> int:
+    """Smallest rung >= n (callers guarantee n <= ladder[-1])."""
+    for b in ladder:
+        if b >= n:
+            return b
+    return ladder[-1]
+
+
+# ---------------------------------------------------------------------------
+# compiled-shape registry (feeds helix_compiled_step_shapes)
+# ---------------------------------------------------------------------------
+
+_SHAPES: dict = {}           # model key -> set of shape tuples
+_SHAPES_LOCK = threading.Lock()
+
+
+def note_step_shape(model_key, shape: tuple) -> None:
+    """Record one distinct compiled device-step entry point for a model.
+    Called from the unified builder on cache miss (and from the VL
+    prefill path per bucket), so the count IS the number of live traced
+    step programs."""
+    with _SHAPES_LOCK:
+        _SHAPES.setdefault(model_key, set()).add(shape)
+
+
+def compiled_step_shapes(model_key) -> int:
+    with _SHAPES_LOCK:
+        return len(_SHAPES.get(model_key, ()))
+
+
+@dataclasses.dataclass
+class PrefillRow:
+    req: object                 # engine.Request (None for warmup rows)
+    table: np.ndarray           # full page table row [maxP]
+    start: int                  # pages-resident history tokens
+    rem: int                    # fresh tokens this call
+    tokens: list                # the rem token ids
+    key: np.ndarray             # [2] u32 sampling sub-key
+    sampling: object            # SamplingParams
+    t0: int = 0                 # assigned at finalize
+
+
+class PrefillPlan:
+    """One call's prefill segment: rows packed back-to-back on a flat
+    token axis, finalized to a ladder rung.
+
+    The unification win lives here: cache-hit prompts (nonzero
+    ``start``), cold packed prompts and the in-flight chunk all share
+    ONE segment instead of one padded call each — padding is charged
+    once, ``rung - sum(rem)``, by the engine's ``_charge_padding``."""
+
+    def __init__(self, page_size: int, max_pages: int, max_rows: int):
+        self.page_size = page_size
+        self.max_pages = max_pages
+        self.max_rows = max_rows
+        self.rows: list = []
+        self.used = 0
+
+    def fits(self, rem: int, cap: int) -> bool:
+        return len(self.rows) < self.max_rows and self.used + rem <= cap
+
+    def add(self, req, table, start: int, rem: int, tokens, key,
+            sampling) -> None:
+        row = PrefillRow(
+            req=req, table=np.asarray(table), start=int(start),
+            rem=int(rem), tokens=list(tokens), key=key, sampling=sampling,
+            t0=self.used,
+        )
+        self.rows.append(row)
+        self.used += row.rem
+
+    @property
+    def has_hist(self) -> bool:
+        return any(r.start > 0 for r in self.rows)
+
+    def finalize(self, rung: int):
+        """Device arrays for the unified step's prefill inputs.
+
+        Returns a dict of host arrays (the engine asarray's them):
+        ``tokens/pos/seg/pages/offsets [1, rung]``, per-row
+        ``t0/qlen/hist/ends [R]`` and ``tables [R, maxP]``, plus the
+        rows' sampling params and keys."""
+        R = self.max_rows
+        ps = self.page_size
+        tokens = np.zeros((1, rung), np.int32)
+        pos = np.zeros((1, rung), np.int32)
+        seg = np.zeros((1, rung), np.int32)
+        pages = np.zeros((1, rung), np.int32)
+        offsets = np.zeros((1, rung), np.int32)
+        t0 = np.zeros((R,), np.int32)
+        qlen = np.zeros((R,), np.int32)
+        hist = np.zeros((R,), np.int32)
+        ends = np.zeros((R,), np.int32)
+        tables = np.zeros((R, self.max_pages), np.int32)
+        keys = np.zeros((R, 2), np.uint32)
+        for j, row in enumerate(self.rows):
+            sl = slice(row.t0, row.t0 + row.rem)
+            tokens[0, sl] = row.tokens
+            abs_pos = np.arange(row.start, row.start + row.rem)
+            pos[0, sl] = abs_pos
+            seg[0, sl] = j + 1
+            # clamp like the device paths: real rows never exceed their
+            # table (admission caps max_len), warmup's garbage-page rows
+            # may — they write page 0 regardless
+            pages[0, sl] = row.table[
+                np.minimum(abs_pos // ps, len(row.table) - 1)
+            ]
+            offsets[0, sl] = abs_pos % ps
+            t0[j] = row.t0
+            qlen[j] = row.rem
+            hist[j] = row.start
+            ends[j] = row.t0 + row.rem - 1
+            tables[j, : len(row.table)] = row.table
+            keys[j] = row.key
+        # unused rows park at the segment end (ascending-start contract)
+        t0[len(self.rows):] = self.used
+        return {
+            "tokens": tokens, "pos": pos, "seg": seg,
+            "pages": pages, "offsets": offsets,
+            "t0": t0, "qlen": qlen, "hist": hist, "ends": ends,
+            "tables": tables, "keys": keys,
+        }

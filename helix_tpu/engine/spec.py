@@ -6,12 +6,17 @@ token — it only amortises the host round trip.  Speculative decoding
 amortises the *forward passes themselves*: draft ``k`` continuation
 tokens cheaply on the host (the same async CPU-side work APEX overlaps
 with device execution), then score all ``k+1`` positions in ONE device
-call (``engine._build_verify_fn`` — a short ragged chunk over the paged
-history, exactly the shape the Ragged Paged Attention analysis shows
-TPUs handle well) and accept the longest draft prefix the model agrees
-with.  Decode-phase forwards are memory-bandwidth-bound, so scoring k+1
-positions costs roughly one position's HBM sweep — every accepted draft
-token is a forward pass the request never pays for.
+call — since the ragged unification that call is simply the engine's
+unified step (``engine._build_ragged_step_fn``) with each drafting slot
+a ``1 + draft_len``-token row over its paged history, exactly the shape
+the Ragged Paged Attention analysis shows TPUs handle well — and accept
+the longest draft prefix the model agrees with.  The verify width is
+EXACT (``spec_tokens + 1``) on every backend: the ragged kernel tiles
+8-token query blocks internally, so pallas no longer buckets the width
+up to a page_size multiple the way the dedicated pre-unification verify
+trace did.  Decode-phase forwards are memory-bandwidth-bound, so
+scoring k+1 positions costs roughly one position's HBM sweep — every
+accepted draft token is a forward pass the request never pays for.
 
 Drafting is prompt-lookup (vLLM's ``[ngram]`` speculative mode): match
 the sequence's trailing n-gram

@@ -211,6 +211,25 @@ class FlightRecorder:
 
     # -- read side (HTTP threads) ------------------------------------------
 
+    def window_ratio(
+        self, num_key: str, den_keys: tuple, recent: int = 256
+    ) -> float:
+        """Sum of ``num_key`` over the last ``recent`` step records
+        divided by the summed ``den_keys`` (0.0 on an empty window).
+
+        Feeds ratio gauges computed over the flight window rather than
+        process lifetime — e.g. ``helix_prefill_padding_ratio`` =
+        padding / (padding + useful prefill) over recent steps, so a
+        config change shows up in the gauge instead of being averaged
+        away by history."""
+        with self._lock:
+            recs = list(self._ring)[-recent:]
+        num = float(sum(r.get(num_key, 0) or 0 for r in recs))
+        den = float(
+            sum(r.get(k, 0) or 0 for r in recs for k in den_keys)
+        )
+        return num / den if den > 0 else 0.0
+
     def snapshot(self, recent: int = 64) -> dict:
         with self._lock:
             return {

@@ -1,47 +1,70 @@
-"""Paged decode attention: one query token per sequence over the page pool.
+"""Ragged paged attention: ONE op for every device-step caller.
 
-This is the decode-loop hot op (SURVEY.md §7 hard part #1) — the reference
-gets it from vLLM's PagedAttention CUDA kernels inside its containers; here
-it is TPU-owned:
+This is the serving engine's only attention over the page pool (SURVEY.md
+§7 hard part #1) — the reference gets the decode case from vLLM's
+PagedAttention CUDA kernels inside its containers; here the op is
+TPU-owned AND generalized the way the Ragged Paged Attention paper
+(PAPERS.md) argues for: per-row sequence metadata instead of one compiled
+shape per caller.
 
-- ``paged_decode_attention_reference`` — XLA gather-based oracle over one
-  layer's pages: gathers each sequence's pages, masks beyond its length,
-  plain softmax.  Correct everywhere; bandwidth-wasteful (gathers
-  ``max_pages`` per seq).
-- ``paged_decode_attention`` — attend-and-write over the FULL pool
-  (``[L, N, P, KVH, D]``): Pallas kernel (``helix_tpu/ops/paged_kernel``)
-  that walks only the pages each sequence actually uses, one whole-page
-  ``[P, KVH, D]`` DMA per page, and writes the current token's K/V into its
-  page in-place (pool aliased through the call) — the decode loop contains
-  NO scatter, so XLA never relays the pool out (the r3 trace showed the
-  external-scatter design spending ~40% of each decode window transposing
-  the pool).  Returns ``(out, k_pages, v_pages, k_scale, v_scale)``.
+- ``ragged_paged_attention`` — the dispatcher.  Queries arrive as a flat
+  token axis ``[T, H, D]`` carved into up to R **rows** (one row = one
+  sequence's fresh tokens this call): ``t0[r]``/``q_len[r]`` delimit row
+  r's tokens, ``hist[r]`` is its pages-resident history length, and
+  ``tables[r]`` its page-table row.  Every engine caller is a metadata
+  assignment over this one contract:
 
-Int8 pools: pass the per-(slot, head) f32 scale pools (``k_scale`` /
-``v_scale``, shape ``[L, N, P, KVH]``) and both paths dequantize
-in-register right after the page fetch — HBM traffic stays at 1 byte/elem.
-The current token's K/V is quantized through the SAME codec before both
-the attention fold-in and the page write, so decode at step t+1 reads
-exactly the values step t attended over.
+  * plain decode — R slots, ``q_len`` 1 each, ``hist`` = position;
+  * speculative verify — ``q_len`` = 1 + drafted tokens (ragged);
+  * packed / cache-hit prefill — one row per admitted prompt,
+    ``hist`` = its prefix-cache-resident tokens (0 for a cold prompt);
+  * chunked prefill — one row, ``q_len`` = chunk, ``hist`` = chunk start;
+  * the mixed step — prefill rows and decode rows in the same call.
 
-Length convention: ``lengths[b]`` = number of PAST tokens in the cache for
-sequence b (the current token's position).  The current token's K/V arrive
-as ``k_new``/``v_new``; the kernel folds them into attention as a virtual
-final block AND persists them at slot ``lengths[b]`` of the page table.
-Inactive slots (``active[b] == 0``) read nothing (their tables may point at
-reallocated pages) and write to the garbage page 0.
+- ``ragged_paged_attention_reference`` — XLA gather-based oracle: gathers
+  each row's pages, masks beyond its history, and runs the plain-softmax
+  ``mha_reference`` with segment ids (row identity) + absolute positions
+  (causality).  Correct everywhere; bandwidth-wasteful (gathers
+  ``max_pages`` per row).
+- ``ragged_paged_attention_tpu`` (``helix_tpu/ops/paged_kernel``) — the
+  Pallas kernel: walks ONLY the pages each row actually uses (ragged over
+  rows), one whole-page ``[P, KVH, D]`` DMA per page, 8-token query
+  blocks, int8 dequantization in-register after the page fetch.
+
+- ``paged_decode_attention_reference`` is kept as the decode-shaped
+  numerics oracle for tests (one query token per sequence, no fresh-token
+  self-attention plumbing).
+
+Semantics shared by both backends:
+
+- token t of row r sits at absolute position ``hist[r] + (t - t0[r])``;
+  it attends the row's pages-resident history ``[0, hist[r])`` plus the
+  row's fresh tokens up to and including itself (causal).  Fresh K/V are
+  attended RAW (as given) — exactly what the pre-unification prefill and
+  verify paths did; persistence into pages is the caller's separate
+  ``write_kv`` scatter.
+- rows never see each other: cross-row attention is masked (the packed-
+  prefill segment contract).
+- a row with ``q_len[r] == 0`` is unused; tokens outside every row
+  produce unspecified output the caller must ignore.
+- int8 pools: pass the per-(slot, head) f32 scale pools (``k_scale`` /
+  ``v_scale``, ``[L, N, P, KVH]``); history dequantizes in-register right
+  after the page fetch — HBM traffic stays at 1 byte/elem.
+
+Layout contract (both backends): ``t0`` is ascending and rows are
+disjoint; rows may start at any offset (the Pallas kernel pads the flat
+axis internally so its 8-token query blocks never DMA out of bounds).
 """
 
 from __future__ import annotations
 
-import functools
 import math
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
-from helix_tpu.ops.attention import DEFAULT_MASK_VALUE
+from helix_tpu.ops.attention import DEFAULT_MASK_VALUE, mha_reference
 
 
 def paged_decode_attention_reference(
@@ -90,99 +113,121 @@ def paged_decode_attention_reference(
     return out.reshape(B, H, D).astype(q.dtype)
 
 
-def _reference_attend_and_write(
-    q, k_pages, v_pages, page_tables, lengths, layer, active, k_new, v_new,
-    *, scale, k_scale=None, v_scale=None,
-):
-    """XLA oracle for the attend-and-write op (CPU tests / non-TPU)."""
-    B = q.shape[0]
-    L_, N, P, KVH, D = k_pages.shape
-    kp_l = k_pages[layer]
-    vp_l = v_pages[layer]
-    ks_l = None if k_scale is None else k_scale[layer]
-    vs_l = None if v_scale is None else v_scale[layer]
-    kn_s = vn_s = None
-    if k_scale is not None:
-        # quantize the current token through the SAME codec the write
-        # persists, and fold the dequantized values into attention — the
-        # virtual final block then matches what later steps read back
-        from helix_tpu.ops.quant import dequantize_kv, quantize_kv
+def _row_of_tokens(t0, q_len, T: int):
+    """Per-token row assignment from ascending disjoint row extents.
 
-        k_new, kn_s = quantize_kv(k_new)
-        v_new, vn_s = quantize_kv(v_new)
-        k_att = dequantize_kv(k_new, kn_s)
-        v_att = dequantize_kv(v_new, vn_s)
-    else:
-        k_att, v_att = k_new, v_new
-    # inactive slots must not attend over their (possibly reallocated)
-    # pages: zero their length
-    lengths_eff = lengths * active
-    out = paged_decode_attention_reference(
-        q, kp_l, vp_l, page_tables, lengths_eff, k_att, v_att,
-        scale=scale, k_scale=ks_l, v_scale=vs_l,
-    )
-    # persist the current token: flat token index into [N*P]; inactive
-    # slots land on garbage page 0
-    pidx = jnp.take_along_axis(
-        page_tables, (lengths // P)[:, None], axis=1
-    )[:, 0]
-    flat = jnp.where(active > 0, pidx * P + lengths % P, 0)
-    kp_l = kp_l.reshape(N * P, KVH, D).at[flat].set(
-        k_new.astype(k_pages.dtype), mode="drop"
-    ).reshape(N, P, KVH, D)
-    vp_l = vp_l.reshape(N * P, KVH, D).at[flat].set(
-        v_new.astype(v_pages.dtype), mode="drop"
-    ).reshape(N, P, KVH, D)
-    k_pages = k_pages.at[layer].set(kp_l)
-    v_pages = v_pages.at[layer].set(vp_l)
-    if k_scale is not None:
-        ks_l = ks_l.reshape(N * P, KVH).at[flat].set(
-            kn_s, mode="drop"
-        ).reshape(N, P, KVH)
-        vs_l = vs_l.reshape(N * P, KVH).at[flat].set(
-            vn_s, mode="drop"
-        ).reshape(N, P, KVH)
-        k_scale = k_scale.at[layer].set(ks_l)
-        v_scale = v_scale.at[layer].set(vs_l)
-    return out, k_pages, v_pages, k_scale, v_scale
+    Returns ``(row, q_off)``: ``row[t]`` is the owning row id or -1 for
+    tokens outside every row; ``q_off[t]`` the token's offset within its
+    row (garbage where ``row < 0``)."""
+    t = jnp.arange(T)
+    # last row whose start is <= t (t0 ascending)
+    cand = jnp.sum((t[:, None] >= t0[None, :]).astype(jnp.int32), axis=1) - 1
+    cand = jnp.clip(cand, 0, t0.shape[0] - 1)
+    start = t0[cand]
+    in_row = (t >= start) & (t < start + q_len[cand])
+    return jnp.where(in_row, cand, -1), t - start
 
 
-def paged_decode_attention(
-    q,            # [B, H, D]
+def ragged_paged_attention_reference(
+    q,            # [T, H, D] flat fresh queries
+    k_new,        # [T, KVH, D] fresh K/V, attended raw
+    v_new,
     k_pages,      # [L, N, P, KVH, D] — FULL pool
     v_pages,
-    page_tables,  # [B, maxP]
-    lengths,      # [B]
-    layer,        # scalar int32 — which layer's pages to use
-    active,       # [B] int32 — 0 = parked slot (no read, garbage write)
-    k_new,        # [B, KVH, D]
+    layer,        # scalar int32 — which layer's pages to read
+    t0,           # [R] int32 — row r's first flat token (ascending)
+    q_len,        # [R] int32 — row r's fresh-token count (0 = unused)
+    hist,         # [R] int32 — row r's pages-resident history tokens
+    tables,       # [R, maxP] int32 — row r's page table
+    *,
+    scale: Optional[float] = None,
+    k_scale=None,  # [L, N, P, KVH] f32 — int8 pools' scale pools
+    v_scale=None,
+) -> jax.Array:
+    """XLA oracle for the ragged contract: gather every row's pages, build
+    one segment-masked kv axis (R histories + the fresh tokens) and run
+    the plain-softmax oracle.  Numerics match the pre-unification callers:
+    history dequantized then cast to the compute dtype, fresh K/V raw,
+    masked positions at ``DEFAULT_MASK_VALUE`` (``exp`` → exactly 0.0, so
+    the gather's fixed ``maxP`` width cannot perturb live sums)."""
+    T, H, D = q.shape
+    R, maxP = tables.shape
+    _, N, P, KVH, _ = k_pages.shape
+    Hs = maxP * P
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    row, q_off = _row_of_tokens(t0, q_len, T)
+    q_pos = jnp.where(row >= 0, hist[jnp.clip(row, 0)] + q_off, 0)
+
+    kp_l = k_pages[layer]
+    vp_l = v_pages[layer]
+    kh = kp_l[tables]                       # [R, maxP, P, KVH, D]
+    vh = vp_l[tables]
+    if k_scale is not None:
+        kh = kh.astype(jnp.float32) * k_scale[layer][tables][..., None]
+        vh = vh.astype(jnp.float32) * v_scale[layer][tables][..., None]
+    kh = kh.astype(q.dtype).reshape(1, R * Hs, KVH, D)
+    vh = vh.astype(q.dtype).reshape(1, R * Hs, KVH, D)
+    hist_tok = jnp.arange(Hs)
+    kv_seg_h = jnp.where(
+        hist_tok[None, :] < hist[:, None],
+        jnp.arange(R)[:, None] + 1,
+        0,
+    ).reshape(1, R * Hs)
+    kv_pos_h = jnp.broadcast_to(hist_tok[None, :], (R, Hs)).reshape(
+        1, R * Hs
+    )
+    k_all = jnp.concatenate([kh, k_new.astype(q.dtype)[None]], axis=1)
+    v_all = jnp.concatenate([vh, v_new.astype(q.dtype)[None]], axis=1)
+    seg_fresh = jnp.where(row >= 0, row + 1, 0)
+    kv_seg = jnp.concatenate([kv_seg_h, seg_fresh[None]], axis=1)
+    kv_pos = jnp.concatenate([kv_pos_h, q_pos[None]], axis=1)
+    out = mha_reference(
+        q[None], k_all, v_all,
+        causal=True,
+        q_positions=q_pos[None],
+        kv_positions=kv_pos,
+        q_segment_ids=seg_fresh[None],
+        kv_segment_ids=kv_seg,
+        scale=scale,
+    )
+    return out[0]
+
+
+def ragged_paged_attention(
+    q,            # [T, H, D] flat fresh queries across all rows
+    k_new,        # [T, KVH, D] fresh K/V (attended raw; caller persists)
     v_new,
+    k_pages,      # [L, N, P, KVH, D] — FULL pool
+    v_pages,
+    layer,        # scalar int32
+    t0,           # [R] int32 — row starts (ascending; 8-aligned on pallas)
+    q_len,        # [R] int32 — fresh tokens per row (0 = unused row)
+    hist,         # [R] int32 — pages-resident history tokens per row
+    tables,       # [R, maxP] int32
     *,
     scale: Optional[float] = None,
     backend: Optional[str] = None,
     k_scale=None,  # [L, N, P, KVH] f32 — int8 pools' scale pools
     v_scale=None,
 ):
-    """Attend one query token per sequence over its pages and persist the
-    token's K/V — pool in, pool out (aliased in-place on TPU).
+    """THE paged-attention entry point: every device-step caller (packed/
+    chunk prefill, decode, mixed, spec-verify) is a metadata assignment
+    over this one contract.  Returns ``out [T, H, D]``.
 
-    Returns ``(out, k_pages, v_pages, k_scale, v_scale)``; the scale pools
-    are ``None`` when the pool is full-precision.
-
-    Dispatcher: Pallas kernel on TPU, XLA reference elsewhere.
+    Dispatcher: Pallas kernel on TPU, XLA gather oracle elsewhere.
     """
     if backend is None:
         platform = jax.devices()[0].platform
         backend = "pallas" if platform in ("tpu", "axon") else "reference"
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if backend == "pallas":
-        from helix_tpu.ops.paged_kernel import paged_decode_attention_tpu
+        from helix_tpu.ops.paged_kernel import ragged_paged_attention_tpu
 
-        return paged_decode_attention_tpu(
-            q, k_pages, v_pages, page_tables, lengths, layer, active,
-            k_new, v_new, scale=scale, k_scale=k_scale, v_scale=v_scale,
+        return ragged_paged_attention_tpu(
+            q, k_new, v_new, k_pages, v_pages, layer, t0, q_len, hist,
+            tables, scale=scale, k_scale=k_scale, v_scale=v_scale,
         )
-    return _reference_attend_and_write(
-        q, k_pages, v_pages, page_tables, lengths, layer, active,
-        k_new, v_new, scale=scale, k_scale=k_scale, v_scale=v_scale,
+    return ragged_paged_attention_reference(
+        q, k_new, v_new, k_pages, v_pages, layer, t0, q_len, hist,
+        tables, scale=scale, k_scale=k_scale, v_scale=v_scale,
     )

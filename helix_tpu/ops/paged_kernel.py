@@ -1,34 +1,50 @@
-"""Pallas TPU ragged paged-decode attention kernel (attend-and-write).
+"""Pallas TPU ragged paged-attention kernel — ONE kernel for every caller.
 
-Per-sequence decode attention that walks ONLY the pages each sequence
-actually uses (ragged over the batch), instead of gathering
-``max_pages_per_seq`` like the XLA reference path — the design of Ragged
-Paged Attention (PAPERS.md) specialised to decode:
+The Ragged Paged Attention design (PAPERS.md) specialised to this
+engine's page pool: a flat query axis carved into per-sequence **rows**
+(row → query start/length, KV history length, page-table row), so packed/
+chunk prefill, plain decode, the mixed prefill+decode step and
+speculative verify are all metadata assignments over one compiled kernel
+instead of one trace family per caller.
 
-- Page tables, lengths, active flags and the layer index are
-  **scalar-prefetched into SMEM**, so DMA source addresses are computed
-  before the kernel body runs.
+- Row metadata (``t0``/``q_len``/``hist``/``tables``) and the layer index
+  are **scalar-prefetched into SMEM**, so every DMA source address is
+  computed before the kernel body runs.
 - The pool is ``[L, N, P, KVH, D]``: one ``(layer, page)`` slice is a
   contiguous ``[P, KVH, D]`` block, fetched HBM -> VMEM in ONE
-  double-buffered async DMA carrying every kv head (the previous
-  head-major pool needed ``KVH`` separate 4 KB DMAs per page — 8x the
-  descriptor traffic).
-- Grid is ``(B,)``: each program owns one sequence and computes all
-  ``KVH`` head groups from the same VMEM-resident chunk.
-- Online softmax in fp32; the current token's K/V is folded in as a final
-  virtual block, then **persisted into its page by an in-kernel DMA**
-  (pool aliased input->output) — the decode loop needs no external
-  scatter, which is what kept XLA from relaying the pool (r3 trace: ~40%
-  of each decode window went to those layout copies).
-- **Int8 pools**: when scale pools ride along, pages stream to VMEM as
-  int8 (half the bf16 HBM bytes) together with their ``[P, KVH]`` f32
-  scale rows, and dequantization happens **in-register** right before the
-  score dot — the MXU still sees fp32 operands.  The current token is
-  quantized through the same codec on the host side of the pallas_call
-  and its codes + scale row are DMA'd into the page, so step t+1 reads
-  exactly the values step t attended over.  (The scale buffers' minor dim
-  is ``KVH`` — narrower than a 128 lane tile, so Mosaic pads them; they
-  are ~``D/4``x smaller than the data buffers, so the padding is noise.)
+  double-buffered async DMA carrying every kv head.
+- Grid is ``(R, NQ)``: program ``(r, i)`` owns 8-token query block ``i``
+  of row ``r`` (programs past the row's ragged length skip everything) and
+  computes all ``KVH`` head groups from the same VMEM-resident chunks.
+  Rows are ragged: a decode row is 1 token, a verify row ``1+k`` tokens, a
+  prefill row a whole chunk — the grid walks ONLY the pages and fresh
+  blocks each row actually uses, which is where the padding-waste win
+  comes from.
+- Online softmax in fp32 over (a) the row's pages-resident history and
+  (b) the row's fresh tokens up to the causal limit.  Fresh K/V arrive
+  raw (``k_new``/``v_new`` on the flat token axis) and are attended as
+  given; persistence into pages is the caller's separate ``write_kv``
+  scatter (the flat one-index scatter that keeps the pool's row-major
+  layout — see ``engine/kv_cache.py``).
+- **Int8 pools**: history pages stream to VMEM as int8 (half the bf16 HBM
+  bytes) together with their ``[P, KVH]`` f32 scale rows, and
+  dequantization happens **in-register** right before the score dot — the
+  MXU still sees fp32 operands.  Fresh tokens are attended at full
+  precision (matching the pre-unification prefill/verify numerics); the
+  write path quantizes through the shared codec.
+- Scores for ALL heads of a q block come from ONE 128-aligned MXU dot:
+  the block-diagonal q layout ``[8*H, KVH*D]`` (query head h occupies the
+  column block of its kv head) against the chunk buffer viewed flat
+  ``[T, KVH*D]`` — no per-head strided slices (the same trick the
+  decode-only predecessor kernel used, extended to 8-token q blocks).
+
+Layout contract: rows are disjoint and ascending on the flat axis; rows
+may start at ANY offset.  A row's final partial query block writes
+garbage into the following flat positions, but the grid iterates rows in
+ascending order ("arbitrary" = sequential on TPU), so every later row's
+program overwrites its own positions afterwards — and the wrapper pads
+the flat axis with 8 tail tokens so the LAST row's spill lands in
+scratch, never out of bounds.
 """
 
 from __future__ import annotations
@@ -50,19 +66,21 @@ _CompilerParams = (
     getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 )
 
+BQ = 8  # query-block tokens: one f32 sublane tile; bounds ragged waste
 
-def _decode_kernel(
+
+def _ragged_kernel(
     # scalar prefetch
-    pt_ref,      # SMEM [B, maxP] int32 page tables
-    len_ref,     # SMEM [B] int32 past lengths
-    act_ref,     # SMEM [B] int32 active flags
+    t0_ref,      # SMEM [R] int32 row starts on the flat token axis
+    qlen_ref,    # SMEM [R] int32 fresh tokens per row (0 = unused)
+    hist_ref,    # SMEM [R] int32 pages-resident history tokens per row
+    pt_ref,      # SMEM [R, maxP] int32 page tables
     layer_ref,   # SMEM [1] int32 layer index
     # inputs / outputs / scratch — order depends on ``quantized``:
-    #   plain: q, knew, vnew, k_hbm, v_hbm | o, ko_hbm, vo_hbm
-    #          | kbuf, vbuf, sems, wsems
-    #   quant: q, knew(i8), vnew(i8), kns, vns, k_hbm, v_hbm, ks_hbm,
-    #          vs_hbm | o, ko_hbm, vo_hbm, kso_hbm, vso_hbm
-    #          | kbuf, vbuf, ksbuf, vsbuf, sems, ssems, wsems
+    #   plain: qf, knf, vnf, k_hbm, v_hbm | o_hbm
+    #          | qbuf, kbuf, vbuf, knbuf, vnbuf, obuf, sems, fsems, qsem,
+    #            osem
+    #   quant: ... + ks_hbm, vs_hbm pools and ksbuf/vsbuf/ssems scratch
     *refs,
     scale: float,
     page_size: int,
@@ -73,267 +91,303 @@ def _decode_kernel(
     quantized: bool,
 ):
     if quantized:
-        (q_ref, knew_ref, vnew_ref, kns_ref, vns_ref,
-         k_hbm, v_hbm, ks_hbm, vs_hbm,
-         o_ref, ko_hbm, vo_hbm, kso_hbm, vso_hbm,
-         kbuf, vbuf, ksbuf, vsbuf, sems, ssems, wsems) = refs
+        (qf, knf, vnf, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         o_hbm,
+         qbuf, kbuf, vbuf, ksbuf, vsbuf, knbuf, vnbuf, obuf,
+         sems, ssems, fsems, qsem, osem) = refs
     else:
-        (q_ref, knew_ref, vnew_ref, k_hbm, v_hbm,
-         o_ref, ko_hbm, vo_hbm, kbuf, vbuf, sems, wsems) = refs
-    b = pl.program_id(0)
+        (qf, knf, vnf, k_hbm, v_hbm,
+         o_hbm,
+         qbuf, kbuf, vbuf, knbuf, vnbuf, obuf,
+         sems, fsems, qsem, osem) = refs
+    r = pl.program_id(0)
+    i = pl.program_id(1)
     lyr = layer_ref[0]
     P, C, KVH = page_size, pages_per_chunk, kv_heads
-    act = act_ref[b]
-    # parked slots read nothing: their tables may point at reallocated pages
-    L = len_ref[b] * act
-    npages = jax.lax.div(L + P - 1, P)
-    nchunks = jax.lax.div(npages + C - 1, C)
-    max_chunks = (max_pages + C - 1) // C
+    qlen_r = qlen_ref[r]
+    hist_r = hist_ref[r]
+    base = t0_ref[r] + i * BQ
 
-    def start_chunk(ci, slot):
-        for c in range(C):  # static unroll over pages in a chunk
-            @pl.when(ci * C + c < npages)
-            def _():
-                page = pt_ref[b, ci * C + c]
-                pltpu.make_async_copy(
-                    k_hbm.at[lyr, page],
-                    kbuf.at[slot, c],
-                    sems.at[slot, c, 0],
-                ).start()
-                pltpu.make_async_copy(
-                    v_hbm.at[lyr, page],
-                    vbuf.at[slot, c],
-                    sems.at[slot, c, 1],
-                ).start()
-                if quantized:
+    @pl.when(i * BQ < qlen_r)
+    def _program():
+        # ---- fetch this q block --------------------------------------
+        qcp = pltpu.make_async_copy(
+            qf.at[pl.ds(base, BQ)], qbuf, qsem
+        )
+        qcp.start()
+
+        npages = jax.lax.div(hist_r + P - 1, P)
+        nchunks = jax.lax.div(npages + C - 1, C)
+        max_chunks = (max_pages + C - 1) // C
+
+        def start_chunk(ci, slot):
+            for c in range(C):  # static unroll over pages in a chunk
+                @pl.when(ci * C + c < npages)
+                def _():
+                    page = pt_ref[r, ci * C + c]
                     pltpu.make_async_copy(
-                        ks_hbm.at[lyr, page],
-                        ksbuf.at[slot, c],
-                        ssems.at[slot, c, 0],
+                        k_hbm.at[lyr, page],
+                        kbuf.at[slot, c],
+                        sems.at[slot, c, 0],
                     ).start()
                     pltpu.make_async_copy(
-                        vs_hbm.at[lyr, page],
-                        vsbuf.at[slot, c],
-                        ssems.at[slot, c, 1],
+                        v_hbm.at[lyr, page],
+                        vbuf.at[slot, c],
+                        sems.at[slot, c, 1],
                     ).start()
+                    if quantized:
+                        pltpu.make_async_copy(
+                            ks_hbm.at[lyr, page],
+                            ksbuf.at[slot, c],
+                            ssems.at[slot, c, 0],
+                        ).start()
+                        pltpu.make_async_copy(
+                            vs_hbm.at[lyr, page],
+                            vsbuf.at[slot, c],
+                            ssems.at[slot, c, 1],
+                        ).start()
 
-    def wait_chunk(ci, slot):
-        for c in range(C):
-            @pl.when(ci * C + c < npages)
-            def _():
-                page = pt_ref[b, ci * C + c]
-                pltpu.make_async_copy(
-                    k_hbm.at[lyr, page],
-                    kbuf.at[slot, c],
-                    sems.at[slot, c, 0],
-                ).wait()
-                pltpu.make_async_copy(
-                    v_hbm.at[lyr, page],
-                    vbuf.at[slot, c],
-                    sems.at[slot, c, 1],
-                ).wait()
-                if quantized:
+        def wait_chunk(ci, slot):
+            for c in range(C):
+                @pl.when(ci * C + c < npages)
+                def _():
+                    page = pt_ref[r, ci * C + c]
                     pltpu.make_async_copy(
-                        ks_hbm.at[lyr, page],
-                        ksbuf.at[slot, c],
-                        ssems.at[slot, c, 0],
+                        k_hbm.at[lyr, page],
+                        kbuf.at[slot, c],
+                        sems.at[slot, c, 0],
                     ).wait()
                     pltpu.make_async_copy(
-                        vs_hbm.at[lyr, page],
-                        vsbuf.at[slot, c],
-                        ssems.at[slot, c, 1],
+                        v_hbm.at[lyr, page],
+                        vbuf.at[slot, c],
+                        sems.at[slot, c, 1],
                     ).wait()
+                    if quantized:
+                        pltpu.make_async_copy(
+                            ks_hbm.at[lyr, page],
+                            ksbuf.at[slot, c],
+                            ssems.at[slot, c, 0],
+                        ).wait()
+                        pltpu.make_async_copy(
+                            vs_hbm.at[lyr, page],
+                            vsbuf.at[slot, c],
+                            ssems.at[slot, c, 1],
+                        ).wait()
 
-    q = q_ref[0].astype(jnp.float32)  # [KVH, group, D]
-    D = q.shape[-1]
-    H = KVH * group
-
-    # Block-diagonal q [H, KVH*D]: query head h occupies the column block
-    # of its kv head.  Scores for ALL heads then come from ONE 128-aligned
-    # MXU dot against the chunk buffer viewed flat [T, KVH*D] — no
-    # per-head strided slices, no 8-way unrolled small dots (the unrolled
-    # form cost ~5 ms/step across the 32 layer calls, 30% of the decode
-    # step).  The PV dot accumulates [H, KVH*D]; off-block columns hold
-    # garbage that the final per-head extraction never reads.
-    q_bd_rows = []
-    for k in range(KVH):
-        row = [jnp.zeros((group, k * D), jnp.float32)] if k else []
-        row.append(q[k])
-        if k < KVH - 1:
-            row.append(jnp.zeros((group, (KVH - 1 - k) * D), jnp.float32))
-        q_bd_rows.append(jnp.concatenate(row, axis=1) if len(row) > 1
-                         else row[0])
-    q_bd = jnp.concatenate(q_bd_rows, axis=0)       # [H, KVH*D]
-
-    # persist the current token's K/V into its page (write-after-nothing:
-    # slot lengths[b] is strictly beyond the masked read range, so the
-    # attention below never observes this write).  Parked slots write to
-    # the garbage page 0 — but their stale position can sit AT page
-    # capacity, so clamp the table index before the SMEM read (jnp.where
-    # evaluates both branches; an unclamped len//P == maxP reads past the
-    # prefetch buffer).
-    pt_idx = jnp.minimum(jax.lax.div(len_ref[b], P), max_pages - 1)
-    w_page = jnp.where(act > 0, pt_ref[b, pt_idx], 0)
-    w_off = jax.lax.rem(len_ref[b], P) * act
-    kw = pltpu.make_async_copy(
-        knew_ref.at[0], ko_hbm.at[lyr, w_page, w_off], wsems.at[0]
-    )
-    vw = pltpu.make_async_copy(
-        vnew_ref.at[0], vo_hbm.at[lyr, w_page, w_off], wsems.at[1]
-    )
-    kw.start()
-    vw.start()
-    if quantized:
-        ksw = pltpu.make_async_copy(
-            kns_ref.at[0], kso_hbm.at[lyr, w_page, w_off], wsems.at[2]
-        )
-        vsw = pltpu.make_async_copy(
-            vns_ref.at[0], vso_hbm.at[lyr, w_page, w_off], wsems.at[3]
-        )
-        ksw.start()
-        vsw.start()
-
-    @pl.when(nchunks > 0)
-    def _():
-        start_chunk(0, 0)
-
-    def body(ci, carry):
-        m_prev, l_prev, acc_prev = carry    # [H,1], [H,1], [H, KVH*D]
-        slot = jax.lax.rem(ci, 2)
-
-        @pl.when(ci + 1 < nchunks)
+        @pl.when(nchunks > 0)
         def _():
-            start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
+            start_chunk(0, 0)
 
-        wait_chunk(ci, slot)
-        if quantized:
-            # in-register dequant: int8 codes x per-(slot, head) scale —
-            # the HBM fetch above moved 1 byte/elem; the MXU sees fp32
-            k_flat = (
-                kbuf[slot].astype(jnp.float32)
-                * ksbuf[slot][..., None]
-            ).reshape(C * P, KVH * D)
-            v_flat = (
-                vbuf[slot].astype(jnp.float32)
-                * vsbuf[slot][..., None]
-            ).reshape(C * P, KVH * D)
-        else:
-            k_flat = kbuf[slot].reshape(C * P, KVH * D).astype(jnp.float32)
-            v_flat = vbuf[slot].reshape(C * P, KVH * D).astype(jnp.float32)
-        token0 = ci * C * P
-        tok = token0 + jax.lax.broadcasted_iota(jnp.int32, (1, C * P), 1)
-        in_range = tok < L                  # [1, T]
-        # un-DMA'd buffer regions (pages past this sequence's length) hold
-        # garbage; the softmax weight there is exactly 0, but 0 * NaN
-        # still poisons the PV accumulation — zero V explicitly.  (K needs
-        # no guard: its scores are overwritten by the mask.  With int8
-        # pools the garbage risk lives in the f32 SCALE buffer, which the
-        # dequant multiply above has already folded into v_flat — this
-        # same guard covers it.)
-        v_flat = jnp.where(
-            jax.lax.broadcasted_iota(jnp.int32, (C * P, 1), 0)
-            < L - token0,
-            v_flat, 0,
+        qcp.wait()
+        q = qbuf[...].astype(jnp.float32)    # [BQ, KVH, group, D]
+        D = q.shape[-1]
+        H = KVH * group
+        RQ = BQ * H                          # q_bd rows
+
+        # Block-diagonal q [BQ*H, KVH*D]: kv head k's query rows occupy
+        # the column block of its kv head — ONE MXU dot scores every
+        # head of every block token against a flat [T, KVH*D] kv view.
+        q_bd_rows = []
+        for k in range(KVH):
+            blk = q[:, k].reshape(BQ * group, D)   # token-major rows
+            row = [jnp.zeros((BQ * group, k * D), jnp.float32)] if k else []
+            row.append(blk)
+            if k < KVH - 1:
+                row.append(
+                    jnp.zeros((BQ * group, (KVH - 1 - k) * D), jnp.float32)
+                )
+            q_bd_rows.append(
+                jnp.concatenate(row, axis=1) if len(row) > 1 else row[0]
+            )
+        q_bd = jnp.concatenate(q_bd_rows, axis=0)   # [BQ*H, KVH*D]
+        # token offset of each q_bd row within the block (rows are
+        # [kv_head, token, group]-major)
+        r_iota = jax.lax.broadcasted_iota(jnp.int32, (RQ, 1), 0)
+        tok_of_row = jax.lax.rem(r_iota, BQ * group) // group  # [RQ, 1]
+        q_off_row = i * BQ + tok_of_row                         # [RQ, 1]
+
+        # ---- history pages: online softmax over the ragged page walk --
+        def body(ci, carry):
+            m_prev, l_prev, acc_prev = carry   # [RQ,1],[RQ,1],[RQ,KVH*D]
+            slot = jax.lax.rem(ci, 2)
+
+            @pl.when(ci + 1 < nchunks)
+            def _():
+                start_chunk(ci + 1, jax.lax.rem(ci + 1, 2))
+
+            wait_chunk(ci, slot)
+            if quantized:
+                k_flat = (
+                    kbuf[slot].astype(jnp.float32)
+                    * ksbuf[slot][..., None]
+                ).reshape(C * P, KVH * D)
+                v_flat = (
+                    vbuf[slot].astype(jnp.float32)
+                    * vsbuf[slot][..., None]
+                ).reshape(C * P, KVH * D)
+            else:
+                k_flat = (
+                    kbuf[slot].reshape(C * P, KVH * D).astype(jnp.float32)
+                )
+                v_flat = (
+                    vbuf[slot].reshape(C * P, KVH * D).astype(jnp.float32)
+                )
+            token0 = ci * C * P
+            tok = token0 + jax.lax.broadcasted_iota(
+                jnp.int32, (1, C * P), 1
+            )
+            in_range = tok < hist_r             # [1, T]
+            # un-DMA'd buffer regions (pages past this row's history)
+            # hold garbage; the softmax weight there is exactly 0, but
+            # 0 * NaN still poisons the PV accumulation — zero V
+            # explicitly (the int8 scale garbage folds into v_flat, so
+            # this one guard covers it too).
+            v_flat = jnp.where(
+                jax.lax.broadcasted_iota(jnp.int32, (C * P, 1), 0)
+                < hist_r - token0,
+                v_flat, 0,
+            )
+            s = jax.lax.dot_general(
+                q_bd, k_flat, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                           # [RQ, T]
+            s = jnp.where(in_range, s, DEFAULT_MASK_VALUE)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc_prev * alpha + jax.lax.dot_general(
+                p, v_flat, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((RQ, 1), -jnp.inf, jnp.float32)
+        l0 = jnp.zeros((RQ, 1), jnp.float32)
+        acc0 = jnp.zeros((RQ, KVH * D), jnp.float32)
+
+        def guarded_body(ci, carry):
+            return jax.lax.cond(
+                ci < nchunks, lambda c: body(ci, c), lambda c: c, carry
+            )
+
+        m, l, acc = jax.lax.fori_loop(
+            0, max_chunks, guarded_body, (m0, l0, acc0)
         )
 
-        s = jax.lax.dot_general(
-            q_bd, k_flat, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale                           # [H, T]
-        s = jnp.where(in_range, s, DEFAULT_MASK_VALUE)
-        m_cur = jnp.max(s, axis=-1, keepdims=True)
-        m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc_prev * alpha + jax.lax.dot_general(
-            p, v_flat, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                   # [H, KVH*D]
-        return m_new, l_new, acc_new
+        # ---- fresh tokens of this row, block by block (causal) --------
+        def fresh_body(j, carry):
+            m_prev, l_prev, acc_prev = carry
+            src = t0_ref[r] + j * BQ
+            kcp = pltpu.make_async_copy(
+                knf.at[pl.ds(src, BQ)], knbuf, fsems.at[0]
+            )
+            vcp = pltpu.make_async_copy(
+                vnf.at[pl.ds(src, BQ)], vnbuf, fsems.at[1]
+            )
+            kcp.start()
+            vcp.start()
+            kcp.wait()
+            vcp.wait()
+            kf = knbuf[...].reshape(BQ, KVH * D).astype(jnp.float32)
+            vf = vnbuf[...].reshape(BQ, KVH * D).astype(jnp.float32)
+            kv_off = j * BQ + jax.lax.broadcasted_iota(
+                jnp.int32, (1, BQ), 1
+            )                                   # [1, BQ]
+            # a partial tail block reads the NEXT row's fresh tokens (or
+            # flat padding); their softmax weight is exactly 0, but a
+            # skipped neighbour row's uninitialized output feeds later
+            # layers' projections, so its V here can be NaN — and
+            # 0 * NaN still poisons the PV accumulation.  Zero V
+            # out-of-row, same guard as the history path.
+            vf = jnp.where(
+                j * BQ + jax.lax.broadcasted_iota(
+                    jnp.int32, (BQ, 1), 0
+                ) < qlen_r,
+                vf, 0,
+            )
+            ok = (kv_off < qlen_r) & (kv_off <= q_off_row)  # [RQ, BQ]
+            s = jax.lax.dot_general(
+                q_bd, kf, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                           # [RQ, BQ]
+            s = jnp.where(ok, s, DEFAULT_MASK_VALUE)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc_prev * alpha + jax.lax.dot_general(
+                p, vf, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return m_new, l_new, acc_new
 
-    m0 = jnp.full((H, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((H, 1), jnp.float32)
-    acc0 = jnp.zeros((H, KVH * D), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, i + 1, fresh_body, (m, l, acc))
 
-    def guarded_body(ci, carry):
-        return jax.lax.cond(
-            ci < nchunks, lambda c: body(ci, c), lambda c: c, carry
+        # fully-masked q rows (block-tail padding past the row's ragged
+        # length) have l == 0; guard the divide so garbage stays finite
+        out = acc / jnp.where(l > 0, l, 1.0)    # [RQ, KVH*D]
+        for k in range(KVH):                    # extract each head block
+            obuf[:, k] = out[
+                k * BQ * group:(k + 1) * BQ * group,
+                k * D:(k + 1) * D,
+            ].reshape(BQ, group, D).astype(obuf.dtype)
+        ocp = pltpu.make_async_copy(
+            obuf, o_hbm.at[pl.ds(base, BQ)], osem
         )
-
-    m, l, acc = jax.lax.fori_loop(
-        0, max_chunks, guarded_body, (m0, l0, acc0)
-    )
-
-    # fold in the current token's K/V (virtual final block, always valid);
-    # int8 mode dequantizes the token's own codes so the fold-in matches
-    # what the page write persists bit-for-bit
-    if quantized:
-        knew_flat = (
-            knew_ref[0].astype(jnp.float32) * kns_ref[0][..., None]
-        ).reshape(KVH * D)
-        vnew_flat = (
-            vnew_ref[0].astype(jnp.float32) * vns_ref[0][..., None]
-        ).reshape(KVH * D)
-    else:
-        knew_flat = knew_ref[0].reshape(KVH * D).astype(jnp.float32)
-        vnew_flat = vnew_ref[0].reshape(KVH * D).astype(jnp.float32)
-    s_new = jax.lax.dot_general(
-        q_bd, knew_flat[:, None], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) * scale                               # [H, 1]
-    m_f = jnp.maximum(m, s_new)
-    p_new = jnp.exp(s_new - m_f)
-    alpha = jnp.exp(m - m_f)
-    l_f = alpha * l + p_new
-    acc_f = acc * alpha + p_new * vnew_flat[None, :]
-    out = acc_f / l_f                       # [H, KVH*D]
-    for k in range(KVH):                    # extract each head's block
-        o_ref[0, k] = out[
-            k * group:(k + 1) * group, k * D:(k + 1) * D
-        ].astype(o_ref.dtype)
-
-    kw.wait()
-    vw.wait()
-    if quantized:
-        ksw.wait()
-        vsw.wait()
+        ocp.start()
+        ocp.wait()
 
 
-@functools.partial(
-    jax.jit, static_argnames=("scale", "interpret")
-)
-def paged_decode_attention_tpu(
-    q,            # [B, H, D]
-    k_pages,      # [L, N, P, KVH, D] — FULL pool, aliased through
-    v_pages,
-    page_tables,  # [B, maxP]
-    lengths,      # [B]
-    layer,        # scalar int32
-    active,       # [B] int32
-    k_new,        # [B, KVH, D]
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def ragged_paged_attention_tpu(
+    q,            # [T, H, D] flat fresh queries
+    k_new,        # [T, KVH, D] fresh K/V, attended raw
     v_new,
+    k_pages,      # [L, N, P, KVH, D] — FULL pool (read-only here)
+    v_pages,
+    layer,        # scalar int32
+    t0,           # [R] int32 row starts (ascending, disjoint)
+    q_len,        # [R] int32 fresh tokens per row (0 = unused)
+    hist,         # [R] int32 history tokens per row
+    tables,       # [R, maxP] int32
     *,
     scale: Optional[float] = None,
     interpret: bool = False,
     k_scale=None,  # [L, N, P, KVH] f32 — present iff the pool is int8
     v_scale=None,
 ):
-    """Returns ``(out, k_pages, v_pages, k_scale, v_scale)``; the scale
-    pools are ``None`` for full-precision pools (pytree structure keys the
-    jit trace, so both modes share this entry point)."""
-    B, H, D = q.shape
+    """Returns ``out [T, H, D]``.  Rows may start at any offset; the
+    flat axis is padded internally so partial query blocks never DMA out
+    of bounds."""
+    T, H, D = q.shape
     L, N, P, KVH, _ = k_pages.shape
-    maxP = page_tables.shape[1]
+    R, maxP = tables.shape
     group = H // KVH
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     C = max(1, 128 // P)
     C = min(C, maxP)
     quantized = k_scale is not None
+    # pad so the last row's final (possibly unaligned, possibly partial)
+    # 8-token query block stays in bounds: need Tpad >= T + (BQ - 1) and
+    # Tpad % BQ == 0
+    Tpad = (T + 2 * BQ - 2) // BQ * BQ
+    if Tpad != T:
+        zpad = Tpad - T
+        q = jnp.concatenate([q, jnp.zeros((zpad, H, D), q.dtype)], axis=0)
+        k_new = jnp.concatenate(
+            [k_new, jnp.zeros((zpad, KVH, D), k_new.dtype)], axis=0
+        )
+        v_new = jnp.concatenate(
+            [v_new, jnp.zeros((zpad, KVH, D), v_new.dtype)], axis=0
+        )
+    NQ = Tpad // BQ
 
-    qg = q.reshape(B, KVH, group, D)
+    qg = q.reshape(Tpad, KVH, group, D)
     kernel = functools.partial(
-        _decode_kernel,
+        _ragged_kernel,
         scale=scale,
         page_size=P,
         pages_per_chunk=C,
@@ -342,108 +396,58 @@ def paged_decode_attention_tpu(
         group=group,
         quantized=quantized,
     )
-    token_specs = [
-        pl.BlockSpec((1, KVH, group, D), lambda b, *_: (b, 0, 0, 0)),
-        pl.BlockSpec((1, KVH, D), lambda b, *_: (b, 0, 0)),
-        pl.BlockSpec((1, KVH, D), lambda b, *_: (b, 0, 0)),
-    ]
-    pool_specs = [
-        pl.BlockSpec(memory_space=_MemorySpace.ANY),
-        pl.BlockSpec(memory_space=_MemorySpace.ANY),
+    any_spec = pl.BlockSpec(memory_space=_MemorySpace.ANY)
+    in_specs = [any_spec] * (7 if quantized else 5)
+    out_spec = any_spec
+    scratch = [
+        pltpu.VMEM((BQ, KVH, group, D), q.dtype),           # qbuf
+        pltpu.VMEM((2, C, P, KVH, D), k_pages.dtype),       # kbuf
+        pltpu.VMEM((2, C, P, KVH, D), v_pages.dtype),       # vbuf
     ]
     if quantized:
-        from helix_tpu.ops.quant import quantize_kv
-
-        knew_q, kns = quantize_kv(k_new.reshape(B, KVH, D))
-        vnew_q, vns = quantize_kv(v_new.reshape(B, KVH, D))
-        in_specs = (
-            token_specs
-            + [
-                pl.BlockSpec((1, KVH), lambda b, *_: (b, 0)),
-                pl.BlockSpec((1, KVH), lambda b, *_: (b, 0)),
-            ]
-            + pool_specs
-            + pool_specs   # scale pools stay in ANY/HBM too
-        )
-        out_shape = [
-            jax.ShapeDtypeStruct((B, KVH, group, D), q.dtype),
-            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
-            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
-            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
-            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        scratch += [
+            pltpu.VMEM((2, C, P, KVH), jnp.float32),        # ksbuf
+            pltpu.VMEM((2, C, P, KVH), jnp.float32),        # vsbuf
         ]
-        out_specs = [
-            pl.BlockSpec((1, KVH, group, D), lambda b, *_: (b, 0, 0, 0)),
-        ] + pool_specs + pool_specs
-        scratch = [
-            pltpu.VMEM((2, C, P, KVH, D), k_pages.dtype),
-            pltpu.VMEM((2, C, P, KVH, D), v_pages.dtype),
-            pltpu.VMEM((2, C, P, KVH), jnp.float32),
-            pltpu.VMEM((2, C, P, KVH), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, C, 2)),
-            pltpu.SemaphoreType.DMA((2, C, 2)),
-            pltpu.SemaphoreType.DMA((4,)),
-        ]
-        # flat input order: pt, len, act, layer, q, knew, vnew, kns, vns,
-        # k_pages(9), v_pages(10), k_scale(11), v_scale(12) -> outputs
-        # (out, k_pages, v_pages, k_scale, v_scale)
-        aliases = {9: 1, 10: 2, 11: 3, 12: 4}
-        inputs = (
-            qg, knew_q, vnew_q, kns, vns, k_pages, v_pages,
-            k_scale, v_scale,
-        )
-    else:
-        in_specs = token_specs + pool_specs
-        out_shape = [
-            jax.ShapeDtypeStruct((B, KVH, group, D), q.dtype),
-            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
-            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
-        ]
-        out_specs = [
-            pl.BlockSpec((1, KVH, group, D), lambda b, *_: (b, 0, 0, 0)),
-        ] + pool_specs
-        scratch = [
-            pltpu.VMEM((2, C, P, KVH, D), k_pages.dtype),
-            pltpu.VMEM((2, C, P, KVH, D), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, C, 2)),
-            pltpu.SemaphoreType.DMA((2,)),
-        ]
-        # flat input order: pt, len, act, layer, q, knew, vnew, k_pages(7),
-        # v_pages(8) -> outputs (out, k_pages, v_pages)
-        aliases = {7: 1, 8: 2}
-        inputs = (
-            qg,
-            k_new.reshape(B, KVH, D),
-            v_new.reshape(B, KVH, D),
-            k_pages,
-            v_pages,
-        )
+    scratch += [
+        pltpu.VMEM((BQ, KVH, D), k_new.dtype),              # knbuf
+        pltpu.VMEM((BQ, KVH, D), v_new.dtype),              # vnbuf
+        pltpu.VMEM((BQ, KVH, group, D), q.dtype),           # obuf
+        pltpu.SemaphoreType.DMA((2, C, 2)),                 # sems
+    ]
+    if quantized:
+        scratch += [pltpu.SemaphoreType.DMA((2, C, 2))]     # ssems
+    scratch += [
+        pltpu.SemaphoreType.DMA((2,)),                      # fsems
+        pltpu.SemaphoreType.DMA(()),                        # qsem
+        pltpu.SemaphoreType.DMA(()),                        # osem
+    ]
+    inputs = (
+        (qg, k_new, v_new, k_pages, v_pages, k_scale, v_scale)
+        if quantized
+        else (qg, k_new, v_new, k_pages, v_pages)
+    )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
-        grid=(B,),
+        num_scalar_prefetch=5,
+        grid=(R, NQ),
         in_specs=in_specs,
-        out_specs=out_specs,
+        out_specs=out_spec,
         scratch_shapes=scratch,
     )
-    res = pl.pallas_call(
+    out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=out_shape,
-        input_output_aliases=aliases,
+        out_shape=jax.ShapeDtypeStruct((Tpad, KVH, group, D), q.dtype),
         interpret=interpret,
         compiler_params=_CompilerParams(
-            dimension_semantics=("arbitrary",),
+            dimension_semantics=("arbitrary", "arbitrary"),
         ),
     )(
-        page_tables.astype(jnp.int32),
-        lengths.astype(jnp.int32),
-        active.astype(jnp.int32),
+        t0.astype(jnp.int32),
+        q_len.astype(jnp.int32),
+        hist.astype(jnp.int32),
+        tables.astype(jnp.int32),
         jnp.asarray(layer, jnp.int32).reshape(1),
         *inputs,
     )
-    if quantized:
-        out, kp, vp, ks, vs = res
-    else:
-        out, kp, vp = res
-        ks = vs = None
-    return out.reshape(B, H, D), kp, vp, ks, vs
+    return out.reshape(Tpad, H, D)[:T]

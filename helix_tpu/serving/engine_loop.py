@@ -377,6 +377,12 @@ class EngineLoop:
             "prefill_padding_tokens": getattr(
                 eng, "num_prefill_padding_tokens", 0
             ),
+            # ragged unification (ISSUE 10): distinct compiled device-
+            # step entry points + padding over the flight window
+            "compiled_step_shapes": getattr(
+                eng, "compiled_step_shapes", 0
+            ),
+            "prefill_padding_ratio": self.padding_ratio(),
             "mixed_steps": getattr(eng, "num_mixed_steps", 0),
             "moe_dropped_tokens": getattr(eng, "moe_dropped_tokens", 0),
             "spec_steps": getattr(eng, "num_spec_steps", 0),
@@ -414,6 +420,14 @@ class EngineLoop:
     def tokens_per_sec(self) -> float:
         """Goodput: generated tokens/s over the trailing rate window."""
         return self._tps.rate(getattr(self.engine, "num_generated_tokens", 0))
+
+    def padding_ratio(self) -> float:
+        """Prefill padding / (padding + useful prefill) over the flight
+        window — the ragged unification's waste gauge (one formula,
+        fed by the engine's single ``_charge_padding`` site)."""
+        return self.flight.window_ratio(
+            "padding_tokens", ("padding_tokens", "prefill_tokens")
+        )
 
     def saturation(self) -> dict:
         """The compact saturation summary (``obs.flight.SATURATION_KEYS``
@@ -821,6 +835,11 @@ class EngineLoop:
             "padding_tokens": (
                 getattr(eng, "num_prefill_padding_tokens", 0) - pad0
             ),
+            # distinct compiled device-step entry points live for this
+            # model at step time: flat after warmup = the shape ladder
+            # is doing its job; climbing under traffic = a caller is
+            # minting new trace shapes (the pre-unification zoo smell)
+            "compiled_shapes": getattr(eng, "compiled_step_shapes", 0),
             "decode_tokens": decode,
             "generated_tokens": generated,
             "admissions": getattr(eng, "num_admitted", 0) - a0,

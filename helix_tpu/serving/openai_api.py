@@ -410,6 +410,17 @@ class OpenAIServer:
             "helix_prefill_padding_tokens_total",
             getattr(eng, "num_prefill_padding_tokens", 0), lbl,
         )
+        # ragged unification (ISSUE 10): the shape-zoo collapse made
+        # observable — distinct compiled device-step entry points per
+        # model, and padding / (padding + useful prefill) over the
+        # flight-recorder window
+        c.gauge(
+            "helix_compiled_step_shapes",
+            getattr(eng, "compiled_step_shapes", 0), lbl,
+        )
+        c.gauge(
+            "helix_prefill_padding_ratio", m.loop.padding_ratio(), lbl
+        )
         c.gauge(
             "helix_goodput_tokens_per_second", sat["tokens_per_sec"], lbl
         )

@@ -137,54 +137,40 @@ class TestPagedDecodeAttention:
                 np.asarray(got[b]), np.asarray(want[0, 0]), atol=1e-5
             )
 
-    def test_attend_and_write_kernel_interpret(self, rng):
-        """Pallas attend-and-write (interpret mode) == XLA reference:
-        same attention output, same pool contents after the in-kernel
-        token write, parked slots untouched except the garbage page."""
-        from helix_tpu.ops.paged import _reference_attend_and_write
-        from helix_tpu.ops.paged_kernel import paged_decode_attention_tpu
+    def test_ragged_kernel_interpret_decode_layout(self, rng):
+        """Pallas ragged kernel (interpret mode) == XLA reference on the
+        decode layout: one-token rows, ragged histories, a parked row
+        (q_len 0) whose output is unspecified and never read."""
+        from helix_tpu.ops.paged import ragged_paged_attention_reference
+        from helix_tpu.ops.paged_kernel import ragged_paged_attention_tpu
 
-        B, KVH, H, D, P = 2, 2, 4, 128, 4
-        L, N, maxP = 3, 16, 4
+        KVH, H, D, P = 2, 4, 128, 4
+        L, N = 3, 16
         ks = jax.random.split(rng, 5)
-        q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
         k_pages = jax.random.normal(ks[1], (L, N, P, KVH, D), jnp.float32)
         v_pages = k_pages + 0.5
-        k_new = jax.random.normal(ks[2], (B, KVH, D), jnp.float32)
-        v_new = jax.random.normal(ks[3], (B, KVH, D), jnp.float32)
+        T = 2
+        q = jax.random.normal(ks[0], (T, H, D), jnp.float32)
+        k_new = jax.random.normal(ks[2], (T, KVH, D), jnp.float32)
+        v_new = jax.random.normal(ks[3], (T, KVH, D), jnp.float32)
         tables = jnp.asarray([[3, 5, 7, 0], [9, 2, 0, 0]], jnp.int32)
-        lengths = jnp.asarray([11, 5], jnp.int32)
-        active = jnp.asarray([1, 0], jnp.int32)  # slot 1 parked
+        t0 = jnp.asarray([0, 1], jnp.int32)
+        q_len = jnp.asarray([1, 0], jnp.int32)   # row 1 parked
+        hist = jnp.asarray([11, 5], jnp.int32)
         layer = jnp.int32(1)
 
-        want_out, want_kp, want_vp, _, _ = _reference_attend_and_write(
-            q, k_pages, v_pages, tables, lengths, layer, active,
-            k_new, v_new, scale=None,
+        want = ragged_paged_attention_reference(
+            q, k_new, v_new, k_pages, v_pages, layer, t0, q_len, hist,
+            tables,
         )
-        got_out, got_kp, got_vp, _, _ = paged_decode_attention_tpu(
-            q, k_pages, v_pages, tables, lengths, layer, active,
-            k_new, v_new, interpret=True,
+        got = ragged_paged_attention_tpu(
+            q, k_new, v_new, k_pages, v_pages, layer, t0, q_len, hist,
+            tables, interpret=True,
         )
-        # active slot's attention matches the oracle (parked slot's output
-        # is unspecified — the engine discards it)
+        # the active row's attention matches the oracle (the parked
+        # row's output is unspecified — the engine discards it)
         np.testing.assert_allclose(
-            np.asarray(got_out[0]), np.asarray(want_out[0]), atol=1e-5
-        )
-        # slot 0's token landed at table[0, 11//4]=7, offset 3 of layer 1
-        np.testing.assert_allclose(
-            np.asarray(got_kp[1, 7, 3]), np.asarray(k_new[0]), atol=1e-6
-        )
-        np.testing.assert_allclose(
-            np.asarray(got_vp[1, 7, 3]), np.asarray(v_new[0]), atol=1e-6
-        )
-        # pools agree with the functional oracle everywhere but the
-        # garbage page (parked slots dump their token there; the oracle
-        # wrote slot 1's k_new to page 0, the kernel did too)
-        np.testing.assert_allclose(
-            np.asarray(got_kp), np.asarray(want_kp), atol=1e-6
-        )
-        np.testing.assert_allclose(
-            np.asarray(got_vp), np.asarray(want_vp), atol=1e-6
+            np.asarray(got[0]), np.asarray(want[0]), atol=1e-5
         )
 
 
@@ -246,6 +232,9 @@ class TestEngineE2E:
             toks.append(nxt)
         return out
 
+    @pytest.mark.slow  # superseded in tier-1 by the unified-step sibling
+    # tests/test_ragged_kernel.py::TestEngineCallerShapes::
+    # test_packed_and_decode (same full-forward oracle, same caller shape)
     def test_greedy_decode_parity(self, tiny_model):
         cfg, params = tiny_model
         eng = Engine(
@@ -550,6 +539,9 @@ class TestChunkedPrefill:
         want = TestEngineE2E()._oracle_greedy(cfg, params, prompt, n)
         assert got == want
 
+    @pytest.mark.slow  # superseded in tier-1 by the unified-step sibling
+    # tests/test_ragged_kernel.py::TestEngineCallerShapes::
+    # test_chunked_prefill (chunk rows vs the full-forward oracle)
     def test_chunked_matches_single_shot(self, tiny_model):
         """Same prompt through chunked vs single-shot prefill: same tokens."""
         cfg, params = tiny_model
@@ -889,51 +881,42 @@ class TestInt8KVCache:
             np.asarray(got), np.asarray(want), atol=2e-2
         )
 
-    def test_int8_kernel_interpret_matches_reference(self, rng):
-        """Quantized Pallas attend-and-write (interpret mode) == the
-        quantized XLA reference: same output, same codes, same scales."""
-        from helix_tpu.ops.paged import _reference_attend_and_write
-        from helix_tpu.ops.paged_kernel import paged_decode_attention_tpu
+    def test_int8_ragged_kernel_interpret_matches_reference(self, rng):
+        """Quantized Pallas ragged kernel (interpret mode) == the
+        quantized XLA reference: in-register dequant of the streamed
+        int8 pages matches the gather-then-dequant oracle, on a mixed
+        layout (a verify-width row + a decode row)."""
+        from helix_tpu.ops.paged import ragged_paged_attention_reference
+        from helix_tpu.ops.paged_kernel import ragged_paged_attention_tpu
         from helix_tpu.ops.quant import quantize_kv
 
-        B, KVH, H, D, P = 2, 2, 4, 128, 4
-        L, N, maxP = 3, 16, 4
+        KVH, H, D, P = 2, 4, 128, 4
+        L, N = 3, 16
         ks = jax.random.split(rng, 5)
-        q = jax.random.normal(ks[0], (B, H, D), jnp.float32)
         k_f = jax.random.normal(ks[1], (L, N, P, KVH, D), jnp.float32)
         v_f = k_f + 0.5
         k_pages, k_scale = quantize_kv(k_f)
         v_pages, v_scale = quantize_kv(v_f)
-        k_new = jax.random.normal(ks[2], (B, KVH, D), jnp.float32)
-        v_new = jax.random.normal(ks[3], (B, KVH, D), jnp.float32)
+        T = 4
+        q = jax.random.normal(ks[0], (T, H, D), jnp.float32)
+        k_new = jax.random.normal(ks[2], (T, KVH, D), jnp.float32)
+        v_new = jax.random.normal(ks[3], (T, KVH, D), jnp.float32)
         tables = jnp.asarray([[3, 5, 7, 0], [9, 2, 0, 0]], jnp.int32)
-        lengths = jnp.asarray([11, 5], jnp.int32)
-        active = jnp.asarray([1, 0], jnp.int32)  # slot 1 parked
+        t0 = jnp.asarray([0, 3], jnp.int32)
+        q_len = jnp.asarray([3, 1], jnp.int32)   # verify row + decode row
+        hist = jnp.asarray([11, 5], jnp.int32)
         layer = jnp.int32(1)
 
-        want = _reference_attend_and_write(
-            q, k_pages, v_pages, tables, lengths, layer, active,
-            k_new, v_new, scale=None, k_scale=k_scale, v_scale=v_scale,
+        want = ragged_paged_attention_reference(
+            q, k_new, v_new, k_pages, v_pages, layer, t0, q_len, hist,
+            tables, k_scale=k_scale, v_scale=v_scale,
         )
-        got = paged_decode_attention_tpu(
-            q, k_pages, v_pages, tables, lengths, layer, active,
-            k_new, v_new, interpret=True,
-            k_scale=k_scale, v_scale=v_scale,
-        )
-        np.testing.assert_allclose(
-            np.asarray(got[0][0]), np.asarray(want[0][0]), atol=1e-5
-        )
-        for gi, wi in zip(got[1:], want[1:]):   # codes + scale pools
-            np.testing.assert_allclose(
-                np.asarray(gi), np.asarray(wi), atol=1e-6
-            )
-        # slot 0's quantized token landed at table[0, 11//4]=7, offset 3
-        qk, sk = quantize_kv(k_new)
-        np.testing.assert_array_equal(
-            np.asarray(got[1][1, 7, 3]), np.asarray(qk[0])
+        got = ragged_paged_attention_tpu(
+            q, k_new, v_new, k_pages, v_pages, layer, t0, q_len, hist,
+            tables, interpret=True, k_scale=k_scale, v_scale=v_scale,
         )
         np.testing.assert_allclose(
-            np.asarray(got[3][1, 7, 3]), np.asarray(sk[0]), atol=1e-7
+            np.asarray(got), np.asarray(want), atol=1e-5
         )
 
     def test_fit_hbm_admits_1_8x_pages(self):

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Metric-name + exposition-drift linter for the helix serving spine.
 
-Two contracts, enforced repo-wide (wired into tier-1 via
+Contracts, enforced repo-wide (wired into tier-1 via
 ``tests/test_observability.py``):
 
 1. **Naming**: every metric-name string literal (``"helix_..."``) must
@@ -35,6 +35,14 @@ Two contracts, enforced repo-wide (wired into tier-1 via
    one owner.  The engine loop must keep building its scheduler through
    ``make_scheduler`` and the OpenAI surface must keep adopting
    ``CLASS_HEADER`` (the contracts 3/4 importer pattern).
+6. **One compiled step entry point**: the engine's device step compiles
+   through ONE lru-cached builder (``_build_ragged_step_fn``) plus the
+   two grandfathered VL paths — a NEW ``@functools.lru_cache`` step
+   builder anywhere under ``helix_tpu/engine/`` fails the build, so the
+   trace zoo the ragged unification collapsed (six shape families ×
+   their bucket grids) cannot regrow one helper at a time.  The
+   ``helix_compiled_step_shapes`` gauge would expose it at runtime;
+   this catches it at review time.
 
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
@@ -261,10 +269,67 @@ def _tenant_schema_violations(root: str) -> list:
     return violations
 
 
+# -- contract 6: one compiled step entry point -------------------------------
+# The unified ragged step is THE device-step builder; these existing
+# names are the only lru-cached ``_build_*`` functions allowed under
+# helix_tpu/engine/ — a new one is a new trace family and fails here.
+_ALLOWED_STEP_BUILDERS = frozenset({
+    "_build_ragged_step_fn",     # THE unified device-step entry point
+    "_build_prefill_fn_mrope",   # VL single-shot prefill (image buckets)
+    "_build_embed_splice_fn",    # VL embed splice
+    "_build_page_restore_fn",    # host-tier restore scatter, not a step
+})
+_LRU_DECOR = re.compile(r"@functools\.(partial\(\s*)?lru_cache")
+_DEF_NAME = re.compile(r"\s*def\s+([A-Za-z_][A-Za-z0-9_]*)")
+
+
+def _step_builder_violations(root: str) -> list:
+    """Contract 6: flag any lru-cached ``_build_*`` function under
+    helix_tpu/engine/ that is not in the allowlist."""
+    violations = []
+    eng_dir = os.path.join(root, "helix_tpu", "engine")
+    if not os.path.isdir(eng_dir):
+        return violations
+    for fn in sorted(os.listdir(eng_dir)):
+        if not fn.endswith(".py"):
+            continue
+        path = os.path.join(eng_dir, fn)
+        with open(path, encoding="utf-8", errors="replace") as f:
+            lines = f.read().splitlines()
+        pending_lru = False
+        for i, line in enumerate(lines, 1):
+            if _LRU_DECOR.search(line):
+                pending_lru = True
+                continue
+            stripped = line.strip()
+            if pending_lru and stripped.startswith("@"):
+                continue  # stacked decorators
+            if pending_lru:
+                m = _DEF_NAME.match(line)
+                if m:
+                    name = m.group(1)
+                    if (
+                        name.startswith("_build_")
+                        and name not in _ALLOWED_STEP_BUILDERS
+                    ):
+                        rel = os.path.relpath(path, root)
+                        violations.append(
+                            f"{rel}:{i}: new lru-cached step builder "
+                            f"{name!r} outside the unified ragged entry "
+                            "point — route the shape through "
+                            "_build_ragged_step_fn (or argue for an "
+                            "allowlist entry in tools/lint_metrics.py)"
+                        )
+                if stripped and not stripped.startswith("#"):
+                    pending_lru = False
+    return violations
+
+
 def run(root: str) -> list:
     """Returns a list of violation strings (empty = clean)."""
     sat_keys, violations = _load_saturation_schema(root)
     violations += _tenant_schema_violations(root)
+    violations += _step_builder_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
     sched_reason_res = [

@@ -93,17 +93,23 @@ def main():
     for _ in range(3):
         eng.step()   # prefill everything, warm the window fns
 
-    fn = eng._get_decode_fn(16)
+    def window16():
+        # the unified ragged step: zero drafts + a 15-step fused tail is
+        # exactly the old 16-step decode window, one compiled shape
+        return eng._ragged_step(
+            draft_len=eng._zero_rows, n_extra=15,
+        )
+
     # warm this exact shape
-    eng.cache, eng._dstate, toks = fn(eng.params, eng.cache, eng._dstate)
-    _ = np.asarray(toks)
+    _, toks, _, extra, _ = window16()
+    _ = np.asarray(extra)
 
     # (a) bare window calls, sync only at the end of the run
     t0 = time.perf_counter()
     N = 5
     for _ in range(N):
-        eng.cache, eng._dstate, toks = fn(eng.params, eng.cache, eng._dstate)
-    jax.block_until_ready(toks)
+        _, toks, _, extra, _ = window16()
+    jax.block_until_ready(extra)
     dt = (time.perf_counter() - t0) / N
     print(f"bare 16-step window (pipelined): {dt*1000:7.1f} ms "
           f"-> {16*batch/dt:6.0f} tok/s")
@@ -111,8 +117,9 @@ def main():
     # (b) window + token fetch each time (the engine's actual pattern)
     t0 = time.perf_counter()
     for _ in range(N):
-        eng.cache, eng._dstate, toks = fn(eng.params, eng.cache, eng._dstate)
+        _, toks, _, extra, _ = window16()
         _ = np.asarray(toks)
+        _ = np.asarray(extra)
     dt = (time.perf_counter() - t0) / N
     print(f"window + np.asarray fetch:       {dt*1000:7.1f} ms "
           f"-> {16*batch/dt:6.0f} tok/s")
