@@ -610,6 +610,67 @@ def main():
     }
     del eng3, on3
 
+    # cross-runner migration (ISSUE 11): export a mid-generation
+    # request as a portable snapshot, ship it through the wire format,
+    # import into a second engine and finish there.  The continuation
+    # must be bit-identical to an uninterrupted run (tokens_lost == 0
+    # is asserted, not just reported); snapshot bytes/request and the
+    # export+import round-trip cost are the capacity-planning numbers a
+    # rolling restart pays per in-flight request.
+    from helix_tpu.serving import migration as _migration
+
+    mig_a = make_engine(kv_dtype)
+    mig_b = make_engine(kv_dtype)
+    mig_ref = make_engine(kv_dtype)
+    mig_prompt = [(17 * j) % (cfg.vocab_size - 2) + 1 for j in range(48)]
+    mig_sampling = SamplingParams(temperature=0.0, max_tokens=32)
+    ref_req = Request(
+        id="mig-ref", prompt_tokens=list(mig_prompt),
+        sampling=mig_sampling,
+    )
+    mig_ref.add_request(ref_req)
+    while not ref_req.finished:
+        mig_ref.step()
+    mig_req = Request(
+        id="mig-bench", prompt_tokens=list(mig_prompt),
+        sampling=mig_sampling,
+    )
+    mig_a.add_request(mig_req)
+    while len(mig_req.output_tokens) < 12 and mig_a.has_work():
+        mig_a.step()
+    cut = len(mig_req.output_tokens)
+    t_exp = time.perf_counter()
+    mig_snap = mig_a.export_request("mig-bench")
+    mig_wire = _migration.snapshot_to_wire(mig_snap)
+    export_ms = (time.perf_counter() - t_exp) * 1000.0
+    wire_bytes = len(json.dumps(mig_wire).encode())
+    t_imp = time.perf_counter()
+    mig_cont = mig_b.import_request(
+        _migration.wire_to_snapshot(mig_wire)
+    )
+    while not mig_cont.finished:
+        mig_b.step()
+    import_ms = (time.perf_counter() - t_imp) * 1000.0
+    combined = mig_req.output_tokens[:cut] + mig_cont.output_tokens[cut:]
+    tokens_lost = len(ref_req.output_tokens) - len(combined)
+    assert combined == ref_req.output_tokens, (
+        "migrated continuation diverged from the uninterrupted run"
+    )
+    result["migration"] = {
+        "snapshot_pages": len(mig_snap.pages),
+        "snapshot_kv_bytes": mig_snap.kv_bytes(),
+        "snapshot_wire_bytes": wire_bytes,
+        "export_ms": round(export_ms, 3),
+        "import_and_finish_ms": round(import_ms, 3),
+        "tokens_before_migration": cut,
+        "tokens_after_migration": len(mig_cont.output_tokens) - cut,
+        # asserted zero above — recorded so regressions are visible in
+        # the JSON even when assertions are stripped
+        "tokens_lost": tokens_lost,
+        "bit_identical": combined == ref_req.output_tokens,
+    }
+    del mig_a, mig_b, mig_ref
+
     # per-tenant SLO baseline (ISSUE 7): a two-tenant mixed load through
     # the real EngineLoop (the layer that owns TTFT/queue-wait
     # accounting), so the item-5 scheduler PR has a recorded

@@ -63,9 +63,24 @@ def _cmd_serve_node(args) -> int:
         return web.json_response(agent.heartbeat_payload())
 
     app.router.add_get("/api/v1/state", state_handler)
+
+    # graceful shutdown (ISSUE 11): SIGTERM/SIGINT (the rolling-restart
+    # signals) set `draining` in the heartbeat, drain in-flight streams
+    # for HELIX_DRAIN_SECONDS, export survivors to a peer runner, then
+    # exit 0 — a restart no longer hard-kills client streams
+    async def _graceful(_app):
+        import asyncio
+
+        await asyncio.get_running_loop().run_in_executor(
+            None, agent.graceful_shutdown
+        )
+
+    app.on_shutdown.append(_graceful)
+
     if tunnel_mode:
         import asyncio
         import os
+        import signal
         import tempfile
 
         from helix_tpu.control.tunnel import TunnelAgent
@@ -86,10 +101,41 @@ def _cmd_serve_node(args) -> int:
                 args.runner_id, args.control_plane, unix_socket=sock,
                 runner_token=agent.runner_token,
             )
-            await ta.run()
+            loop = asyncio.get_running_loop()
+            stop = asyncio.Event()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    loop.add_signal_handler(sig, stop.set)
+                except (NotImplementedError, RuntimeError):
+                    pass   # non-main thread / platform without signals
+            ta_task = asyncio.create_task(ta.run())
+            stop_task = asyncio.create_task(stop.wait())
+            await asyncio.wait(
+                {ta_task, stop_task},
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if stop.is_set():
+                print("draining before exit (SIGTERM/SIGINT)...")
+                await loop.run_in_executor(None, agent.graceful_shutdown)
+                ta_task.cancel()
+            for t in (ta_task, stop_task):
+                t.cancel()
 
         asyncio.run(main())
         return 0
+    import signal
+
+    from aiohttp.web_runner import GracefulExit
+
+    def _sigterm(signum, frame):
+        # run_app catches GracefulExit, runs app cleanup (our on_shutdown
+        # drain hook included) and returns normally -> exit 0
+        raise GracefulExit()
+
+    try:
+        signal.signal(signal.SIGTERM, _sigterm)
+    except ValueError:
+        pass   # not the main thread (embedded/test use)
     print(f"helix-tpu node listening on {args.host}:{args.port}")
     web.run_app(app, host=args.host, port=args.port, print=None)
     return 0

@@ -102,6 +102,38 @@ ENV_REFERENCE: tuple = (
         section="accelerator",
     ),
     EnvVar(
+        "HELIX_DRAIN_SECONDS",
+        "Graceful-shutdown drain window (node agent SIGTERM/SIGINT "
+        "path): the heartbeat flips to draining immediately (the router "
+        "stops sending new work), in-flight requests keep generating "
+        "this many seconds, and whatever is still unfinished at the "
+        "deadline is exported as request snapshots to a peer runner "
+        "instead of shed (finish -> snapshot+ship -> shed ladder).",
+        default="10",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MIGRATION_TIMEOUT",
+        "Cross-runner migration timeout in seconds: bounds each "
+        "snapshot ship during drain AND how long an imported request "
+        "waits for its stream to be claimed via /v1/migrate/resume "
+        "before the peer aborts the orphan.",
+        default="30",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MIDSTREAM_FAILOVER",
+        "Set to 1 to arm the control plane's SSE-aware dispatch path: "
+        "a runner death PAST the first streamed byte continues the "
+        "client stream on a surviving runner (resume-from-snapshot "
+        "after a clean drain, else deterministic replay-from-prompt "
+        "with already-delivered text elided) with exactly-once token "
+        "delivery for greedy/seeded requests. Unset/0: mid-stream "
+        "death surfaces as an in-band error frame (the PR 2 "
+        "behaviour).",
+        section="server",
+    ),
+    EnvVar(
         "HELIX_EXACT_SAMPLING",
         "Set to 1 to force the exact full-vocab top-p sampling path for "
         "every request (default: auto — the 64-candidate MXU fast path "

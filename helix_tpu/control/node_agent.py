@@ -397,6 +397,12 @@ class NodeAgent:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
+        # graceful shutdown (ISSUE 11): once draining, the heartbeat
+        # advertises unroutable-for-new-work and the control plane's
+        # pick_runner skips this node; drain_deadline_ts feeds the
+        # honest Retry-After on a cluster-wide-drain 503
+        self.draining = False
+        self.drain_deadline_ts = 0.0
 
     # ------------------------------------------------------------------
     def _teardown_all(self):
@@ -647,29 +653,100 @@ class NodeAgent:
             },
             "saturation": self.saturation_summary(),
             "tenants": self.tenant_summary(),
+            # drain state (ISSUE 11): the router stops routing NEW work
+            # here the beat after this flips; in-flight work finishes or
+            # migrates before the deadline
+            "draining": self.draining,
+            "drain_deadline_ts": self.drain_deadline_ts,
             "disk": {"total": disk.total, "used": disk.used, "free": disk.free},
             "ts": time.time(),
         }
+
+    def _heartbeat_headers(self) -> dict:
+        return (
+            {"X-Runner-Token": self.runner_token} if self.runner_token else {}
+        )
+
+    def _post_heartbeat(self):
+        """One heartbeat POST (used by the loop and by graceful_shutdown
+        to announce the drain immediately instead of waiting out the
+        interval).  Returns the response or raises."""
+        import requests
+
+        return requests.post(
+            f"{self.heartbeat_url}/api/v1/runners/"
+            f"{self.runner_id}/heartbeat",
+            json=self.heartbeat_payload(),
+            timeout=10,
+            headers=self._heartbeat_headers(),
+        )
+
+    def graceful_shutdown(self, drain: Optional[float] = None) -> dict:
+        """SIGTERM/rolling-restart path (ISSUE 11): announce ``draining``
+        to the control plane NOW (new work reroutes immediately), let
+        every engine loop drain in parallel for up to ``drain`` seconds,
+        and ship whatever is still unfinished at the deadline to a peer
+        runner as request snapshots (the finish -> snapshot+ship -> shed
+        ladder).  Returns per-model migration stats for the exit log."""
+        from helix_tpu.serving.migration import PeerShipper, drain_seconds
+
+        if drain is None:
+            drain = drain_seconds()
+        self.draining = True
+        self.drain_deadline_ts = time.time() + drain
+        if self.heartbeat_url:
+            try:
+                self._post_heartbeat()
+            except Exception:  # noqa: BLE001 — drain proceeds regardless
+                log.warning(
+                    "runner %s: could not announce drain to the "
+                    "control plane", self.runner_id,
+                )
+        shipper = None
+        if self.heartbeat_url:
+            shipper = PeerShipper(
+                self.heartbeat_url, self.runner_id,
+                runner_token=self.runner_token,
+            )
+        loops = [
+            (m.name, m.loop)
+            for m in self._live_models()
+            if getattr(m, "loop", None) is not None
+        ]
+        # drain every loop CONCURRENTLY (join=False: each engine thread
+        # self-drains and exports its own survivors at the deadline)
+        for _name, loop in loops:
+            if shipper is not None:
+                loop.exporter = shipper
+            loop.stop(drain=drain, join=False)
+        deadline = time.monotonic() + drain + 30.0
+        stats = {}
+        for name, loop in loops:
+            t = getattr(loop, "_thread", None)
+            if t is not None and t.is_alive():
+                t.join(timeout=max(0.0, deadline - time.monotonic()))
+            loop.stop(join=True)   # belt-and-braces: thread must be down
+            st = loop.stats().get("migration", {})
+            stats[name] = st
+            log.info(
+                "runner %s: model %s drained (exported=%s failures=%s)",
+                self.runner_id, name,
+                st.get("exported"), st.get("failures"),
+            )
+        self.stop()
+        return stats
 
     def start_heartbeat(self, poll_assignment: bool = True):
         """30s heartbeat + assignment polling against the control plane
         (the pull-based loop of ``SURVEY.md`` §3.3)."""
         import requests
 
-        headers = (
-            {"X-Runner-Token": self.runner_token} if self.runner_token else {}
-        )
+        headers = self._heartbeat_headers()
 
         def run():
             while not self._stop.is_set():
                 try:
-                    r = requests.post(
-                        f"{self.heartbeat_url}/api/v1/runners/"
-                        f"{self.runner_id}/heartbeat",
-                        json=self.heartbeat_payload(),
-                        timeout=10,
-                        headers=headers,
-                    )
+                    r = self._post_heartbeat()
                     if r.status_code != 200:
                         import logging
 

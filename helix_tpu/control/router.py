@@ -168,6 +168,15 @@ class RunnerState:
     # entries, top-K + __other__) — pruned with the runner like
     # saturation, so tenant gauges can never outlive their reporter
     tenants: dict = dataclasses.field(default_factory=dict)
+    # graceful-shutdown state (ISSUE 11): a draining runner finishes /
+    # migrates its in-flight work but takes NO new requests —
+    # ``pick_runner`` skips it (including half-open breaker probes,
+    # which would be burned on a runner about to exit).  It stays in
+    # ``model_map`` so a cluster-wide drain answers 503 code=draining
+    # instead of 404.  ``drain_deadline`` (unix seconds, 0 = unknown)
+    # feeds the honest Retry-After on that 503.
+    draining: bool = False
+    drain_deadline: float = 0.0
 
     @property
     def routable(self) -> bool:
@@ -209,6 +218,8 @@ class InferenceRouter:
         meta: Optional[dict] = None,
         saturation: Optional[dict] = None,
         tenants: Optional[dict] = None,
+        draining: bool = False,
+        drain_deadline: float = 0.0,
     ) -> RunnerState:
         with self._lock:
             st = self._runners.get(runner_id)
@@ -226,6 +237,8 @@ class InferenceRouter:
                 st.saturation = dict(saturation)
             if tenants is not None:
                 st.tenants = dict(tenants)
+            st.draining = bool(draining)
+            st.drain_deadline = float(drain_deadline or 0.0)
             return st
 
     def evict_stale(self) -> list:
@@ -302,6 +315,9 @@ class InferenceRouter:
                 st
                 for st in sorted(self._runners.values(), key=lambda s: s.id)
                 if st.routable
+                and not st.draining   # unroutable-for-new-work; also
+                # keeps half-open breaker PROBES off a runner that is
+                # about to exit — a probe burned there proves nothing
                 and model in st.models
                 and now - st.last_heartbeat <= self.ttl
                 and st.id not in exclude
@@ -325,6 +341,65 @@ class InferenceRouter:
             chosen = least[cursor % len(least)]
             self._rr[model] = (cursor + 1) % max(len(least), 1)
             return chosen
+
+    def drain_retry_after(self, model: str) -> Optional[int]:
+        """When EVERY fresh, routable runner serving ``model`` is
+        draining, the honest Retry-After in seconds (the latest reported
+        drain deadline, floored at 1s; a conservative default when no
+        runner reported one).  None = at least one non-draining runner
+        exists (or none serve the model at all) — the caller keeps its
+        ordinary error shape."""
+        now = self.clock()
+        with self._lock:
+            serving = [
+                st
+                for st in self._runners.values()
+                if st.routable
+                and model in st.models
+                and now - st.last_heartbeat <= self.ttl
+            ]
+            if not serving or any(not st.draining for st in serving):
+                return None
+            deadlines = [
+                st.drain_deadline for st in serving if st.drain_deadline
+            ]
+            if not deadlines:
+                return 5
+            import time as _time
+
+            return max(1, int(max(deadlines) - _time.time()) + 1)
+
+    def draining_map(self) -> dict:
+        """{runner_id: draining} over live runners — the drain-state
+        gauge's source; pruned with the runner like saturation_map."""
+        with self._lock:
+            return {
+                rid: st.draining
+                for rid, st in sorted(self._runners.items())
+            }
+
+    def migration_targets(self, for_runner: str) -> list:
+        """Peers a draining runner may ship snapshots to: fresh,
+        routable, NOT draining, with an address, excluding the asker.
+        Each entry carries the peer's model list so the shipper can
+        match a snapshot's model to a runner that serves it."""
+        now = self.clock()
+        with self._lock:
+            return [
+                {
+                    "id": st.id,
+                    "address": st.meta.get("address", ""),
+                    "models": list(st.models),
+                }
+                for st in sorted(
+                    self._runners.values(), key=lambda s: s.id
+                )
+                if st.routable
+                and not st.draining
+                and st.id != for_runner
+                and now - st.last_heartbeat <= self.ttl
+                and st.meta.get("address")
+            ]
 
     # -- dispatch feedback (breakers + load) -------------------------------
 

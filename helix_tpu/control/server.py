@@ -46,6 +46,17 @@ from helix_tpu.obs.slo import (
     validate_tenant_rollup,
 )
 from helix_tpu.obs.trace import TRACE_HEADER
+from helix_tpu.serving.migration import (
+    SSEParser,
+    ElisionTracker,
+    chunk_delta_text,
+    chunk_finish_reason,
+    collect_cp_migration,
+    make_chunk,
+    midstream_failover_enabled,
+    parse_migrated_peer,
+    sse_frame,
+)
 from helix_tpu.serving.sched import CLASS_HEADER, sanitize_class
 
 _dispatch_log = logging.getLogger("helix.dispatch")
@@ -62,6 +73,12 @@ class _RetryableDispatch(Exception):
     """A dispatch attempt failed before the first streamed byte reached
     the client (connect error, 5xx, tunnel closed): safe to fail over to
     the next candidate runner."""
+
+
+class _ClientGone(Exception):
+    """The CLIENT's transport died mid-stream (failover path): the
+    runner did nothing wrong — release it without feeding the breaker,
+    and never replay a generation into a dead socket."""
 
 
 class _DispatchAccount:
@@ -230,6 +247,10 @@ class ControlPlane:
         self.dispatch_exhausted = 0   # requests that ran out of candidates
         self.dispatch_ok = 0
         self.heartbeats_dropped = 0   # fault-injected heartbeat loss
+        # mid-stream failover (ISSUE 11, HELIX_MIDSTREAM_FAILOVER):
+        # client streams continued on another runner after a death past
+        # the first byte (resume-from-snapshot or deterministic replay)
+        self.cp_midstream_failovers = 0
         # tenant id -> the identity resolved at dispatch (bounded LRU):
         # /v1/tenants/usage joins the federated per-tenant rollups back
         # to the human-readable identity the auth layer already knows
@@ -755,7 +776,7 @@ class ControlPlane:
         if request.method == "POST" and parts[4] == "heartbeat":
             return True
         return request.method == "GET" and parts[4] in (
-            "assignment", "tunnel"
+            "assignment", "tunnel", "migration-targets"
         )
 
     def _runner_token_ok(self, request) -> bool:
@@ -896,6 +917,12 @@ class ControlPlane:
             self.compatible_profiles,
         )
         r.add_get("/api/v1/runners/{id}/logs", self.runner_logs)
+        # drain migration targets (ISSUE 11): a draining runner asks
+        # where to ship its in-flight request snapshots
+        r.add_get(
+            "/api/v1/runners/{id}/migration-targets",
+            self.migration_targets,
+        )
         r.add_get("/api/v1/compute/instances", self.list_compute_instances)
         # profiles
         r.add_get("/api/v1/profiles", self.list_profiles)
@@ -1415,6 +1442,12 @@ class ControlPlane:
         # emitter (lint contract 4); cardinality is bounded by the
         # runners' top-K rollups and pruned with the runner.
         collect_cp_tenant_gauges(c, self.router.tenants_map())
+        # migration/drain series (ISSUE 11): minted ONLY by
+        # serving/migration.py (lint contract 6); the drain gauge reads
+        # live router state so it prunes with the runner
+        collect_cp_migration(
+            c, self.cp_midstream_failovers, self.router.draining_map()
+        )
 
     async def cluster_status(self, request):
         """Operator rollup of the whole cluster's saturation: per runner
@@ -1456,6 +1489,7 @@ class ControlPlane:
                     "profile_name": st.profile_name,
                     "profile_status": st.profile_status,
                     "routable": st.routable,
+                    "draining": st.draining,
                     "heartbeat_age_seconds": round(
                         max(0.0, now - st.last_heartbeat), 3
                     ),
@@ -1665,6 +1699,28 @@ class ControlPlane:
         # finite values and a bounded count; malformed blocks degrade to
         # {} and never reject the heartbeat
         tenants = validate_tenant_rollup(body.get("tenants"))
+        # drain state (ISSUE 11): runner-supplied like saturation, so a
+        # malformed flag DEGRADES to false (still-routable) instead of
+        # 500ing the heartbeat and TTL-evicting a healthy runner — the
+        # PR 4/PR 7 hardening pattern
+        raw_draining = body.get("draining")
+        draining = (
+            raw_draining
+            if isinstance(raw_draining, bool)
+            else bool(raw_draining) if isinstance(raw_draining, int)
+            else False
+        )
+        raw_deadline = body.get("drain_deadline_ts")
+        drain_deadline = 0.0
+        if isinstance(raw_deadline, (int, float)) and not isinstance(
+            raw_deadline, bool
+        ):
+            try:
+                f = float(raw_deadline)
+                if math.isfinite(f) and f > 0:
+                    drain_deadline = f
+            except (OverflowError, ValueError):
+                pass
         self.router.upsert_from_heartbeat(
             rid,
             models=profile.get("models", []),
@@ -1678,6 +1734,8 @@ class ControlPlane:
             # traffic-never-seen) runner — keeping the previous rollup
             # would freeze stale burn gauges on a healthy node
             tenants=tenants,
+            draining=draining,
+            drain_deadline=drain_deadline,
         )
         self.store.record_heartbeat(rid, body)
         self.router.evict_stale()
@@ -1696,6 +1754,20 @@ class ControlPlane:
         if denied is not None:
             return denied
         return await self.tunnels.handle_ws(request.match_info["id"], request)
+
+    async def migration_targets(self, request):
+        """Peers a draining runner may ship request snapshots to (ISSUE
+        11): fresh, routable, not-draining runners with an address,
+        excluding the asker.  Runner-token gated like the rest of the
+        control loop."""
+        denied = self._require_runner(request)
+        if denied is not None:
+            return denied
+        rid = request.match_info["id"]
+        self.router.evict_stale()
+        return web.json_response(
+            {"targets": self.router.migration_targets(rid)}
+        )
 
     async def get_assignment(self, request):
         denied = self._require_runner(request)
@@ -4828,9 +4900,53 @@ class ControlPlane:
             if available:
                 model = available[0]
                 raw = json.dumps({**body, "model": model}).encode()
+        # mid-stream failover (ISSUE 11, HELIX_MIDSTREAM_FAILOVER=1):
+        # streaming requests go through the SSE-aware path that can
+        # continue the client's stream on a surviving runner after a
+        # death PAST the first byte — resume-from-snapshot when the
+        # source drained cleanly, deterministic replay-from-prompt with
+        # already-delivered text elided otherwise
+        if (
+            midstream_failover_enabled()
+            and body.get("stream")
+            and request.path in ("/v1/chat/completions", "/v1/completions")
+            and model
+            and model in self.router.model_map()
+        ):
+            return await self._dispatch_stream_failover(
+                request, body, raw, model, trace_id, tenant, sched_class,
+                t_req,
+            )
         runner = self.router.pick_runner(model)
         if runner is None:
             if model and model in self.router.model_map():
+                # cluster-wide drain (ISSUE 11): every runner serving
+                # the model is draining — distinct typed 503 with an
+                # HONEST Retry-After (the latest reported drain
+                # deadline), so clients back off for the right duration
+                # instead of hammering a cluster mid-rollout
+                drain_after = self.router.drain_retry_after(model)
+                if drain_after is not None:
+                    self.dispatch_exhausted += 1
+                    return web.json_response(
+                        {
+                            "error": {
+                                "message": (
+                                    f"every runner serving '{model}' is "
+                                    "draining for shutdown; retry after "
+                                    f"{drain_after}s"
+                                ),
+                                "type": "overloaded_error",
+                                "code": "draining",
+                                "trace_id": trace_id,
+                            }
+                        },
+                        status=503,
+                        headers={
+                            "Retry-After": str(drain_after),
+                            TRACE_HEADER: trace_id,
+                        },
+                    )
                 # runners DO serve this model but none admits traffic
                 # right now (breakers open / probe budgets spent):
                 # overload, not a routing miss
@@ -5059,6 +5175,15 @@ class ControlPlane:
                 status=upstream.status,
                 headers={"Content-Type": ctype, TRACE_HEADER: trace_id},
             )
+            # mid-stream death injection (chaos: the kill-runner-
+            # mid-stream scenario rides this hook on the plain path too)
+            from helix_tpu.testing import faults as _faults
+
+            _inj = _faults.active()
+            _kill_after = (
+                _inj.stream_kill_after(runner.id) if _inj else None
+            )
+            _n_chunks = 0
             # nothing below may propagate to the failover loop — once
             # prepare() commits headers a retry cannot restart the
             # response, and a client disconnect must release the runner's
@@ -5067,6 +5192,14 @@ class ControlPlane:
                 await resp.prepare(request)
                 try:
                     async for chunk in upstream.content.iter_any():
+                        if (
+                            _kill_after is not None
+                            and _n_chunks >= _kill_after
+                        ):
+                            raise aiohttp.ClientPayloadError(
+                                "injected mid-stream death"
+                            )
+                        _n_chunks += 1
                         await resp.write(chunk)
                 except asyncio.TimeoutError:
                     # total dispatch deadline ran out mid-stream: the
@@ -5114,6 +5247,449 @@ class ControlPlane:
             await resp.write_eof()
         elif request.transport is not None:
             request.transport.close()
+
+    async def _open_runner_stream(self, runner, path: str, data: bytes,
+                                  headers: dict, remaining: float):
+        """One streaming POST to a runner over HTTP or its reverse
+        tunnel.  Returns ``(status, chunk-iterator, closer)``; raises
+        ``_RetryableDispatch`` for 5xx/unreachable before streaming."""
+        address = runner.meta.get("address")
+        if not address:
+            from helix_tpu.control.tunnel import TunnelClosed
+
+            try:
+                status, _hdrs, chunks = await self.tunnels.request(
+                    runner.id, "POST", path, headers, data
+                )
+            except TunnelClosed as e:
+                raise _RetryableDispatch(
+                    f"runner {runner.id} unreachable over tunnel"
+                ) from e
+            if status >= 500:
+                await chunks.aclose()
+                raise _RetryableDispatch(
+                    f"runner {runner.id} returned {status} before "
+                    "streaming"
+                )
+            return status, chunks, chunks.aclose
+        session = self._http_session()
+        resp = await session.post(
+            f"{address}{path}", data=data, headers=headers,
+            timeout=aiohttp.ClientTimeout(total=remaining),
+        )
+        if resp.status >= 500:
+            resp.close()
+            raise _RetryableDispatch(
+                f"runner {runner.id} returned {resp.status} before "
+                "streaming"
+            )
+
+        async def closer():
+            resp.close()
+
+        return resp.status, resp.content.iter_any(), closer
+
+    async def _dispatch_stream_failover(self, request, body, raw, model,
+                                        trace_id, tenant, sched_class,
+                                        t_req):
+        """SSE dispatch that survives runner death PAST the first byte
+        (ISSUE 11, opt-in via HELIX_MIDSTREAM_FAILOVER).
+
+        The stream is parsed frame-by-frame and re-emitted in a stable
+        template (id/model/created captured from the first upstream
+        frame), with an exact count of generated characters already
+        delivered to the client.  When the source dies mid-stream:
+
+        - if it drained cleanly, its terminal frame names the peer that
+          imported the request's snapshot — the stream resumes there via
+          ``/v1/migrate/resume`` (the peer continues from the snapshot,
+          sending only what the client has not seen);
+        - otherwise the request REPLAYS from the prompt on a surviving
+          runner and the already-delivered prefix is elided by character
+          arithmetic (deterministic generation — greedy or seeded —
+          makes the replayed prefix identical).
+
+        Either way the client sees one continuous stream with
+        exactly-once token delivery instead of an abort frame."""
+        from helix_tpu.testing import faults
+
+        kind = (
+            "chat" if request.path == "/v1/chat/completions"
+            else "completions"
+        )
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.dispatch_total_timeout
+        fwd_headers = {
+            "Content-Type": "application/json",
+            TRACE_HEADER: trace_id,
+        }
+        if tenant:
+            fwd_headers[TENANT_HEADER] = tenant
+        if sched_class:
+            fwd_headers[CLASS_HEADER] = sched_class
+        client = None                 # prepared client StreamResponse
+        track = ElisionTracker()
+        template: dict = {}
+        role_sent = False
+        had_failover = False          # a death was survived mid-request
+
+        async def ensure_client():
+            nonlocal client
+            if client is None:
+                client = web.StreamResponse(
+                    headers={
+                        "Content-Type": "text/event-stream",
+                        "Cache-Control": "no-cache",
+                        TRACE_HEADER: trace_id,
+                    }
+                )
+                try:
+                    await client.prepare(request)
+                except (ConnectionError, OSError) as e:
+                    raise _ClientGone() from e
+
+        async def client_send(data: bytes):
+            # client-transport failures must be distinguishable from
+            # upstream runner deaths: the latter fail over, the former
+            # must STOP the whole dispatch (no replay into a dead
+            # socket, no breaker blame on an innocent runner)
+            try:
+                await client.write(data)
+            except (ConnectionError, OSError) as e:
+                raise _ClientGone() from e
+
+        async def finish(outcome):
+            if had_failover:
+                self.cp_midstream_failovers += 1
+            self.traces.record(
+                trace_id, "dispatch", t_req, time.monotonic(),
+                plane="control", model=model, attempts=attempt,
+                outcome=outcome,
+            )
+            try:
+                await client.write(b"data: [DONE]\n\n")
+                await client.write_eof()
+            except (ConnectionError, OSError):
+                pass   # client left during the terminal frame
+            return client
+
+        tried: set = set()
+        attempt = 0
+        resume = None    # (peer runner id, engine request id) when migrated
+        last_err = "no candidate runner"
+        while attempt < self.dispatch_max_attempts:
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            # -- pick the source for this attempt -------------------------
+            mode = "origin"
+            if resume is not None:
+                peer_id, rid_resume = resume
+                target = self.router.get(peer_id)
+                resume = None
+                if target is None:
+                    last_err = f"migration peer {peer_id} is gone"
+                    continue
+                mode = "resume"
+                path = "/v1/migrate/resume"
+                data = json.dumps(
+                    {
+                        "request_id": rid_resume,
+                        "emitted_chars": track.forwarded_chars,
+                    }
+                ).encode()
+                headers = {"Content-Type": "application/json"}
+                if self.runner_token:
+                    headers["X-Runner-Token"] = self.runner_token
+            else:
+                target = self.router.pick_runner(model, exclude=tried)
+                if target is None and tried:
+                    target = self.router.pick_runner(model)
+                if target is None:
+                    break
+                path = request.path
+                data = raw
+                headers = fwd_headers
+            attempt += 1
+            if mode == "origin":
+                tried.add(target.id)
+            acct = _DispatchAccount(self.router, target.id)
+            t_attempt = time.monotonic()
+
+            def attempt_span(outcome, _rid=target.id, _n=attempt,
+                             _t0=t_attempt):
+                now = time.monotonic()
+                self.dispatch_attempt_seconds.observe(now - _t0)
+                self.traces.record(
+                    trace_id, "dispatch_attempt", _t0, now,
+                    plane="control", runner=_rid, attempt=_n,
+                    outcome=outcome,
+                )
+
+            finished = False
+            died = False
+            closer = None
+            try:
+                inj = faults.active()
+                fault = (
+                    inj.dispatch_fault(target.id)
+                    if inj and mode == "origin" else None
+                )
+                if fault is not None:
+                    if fault["mode"] == "slow_first_byte":
+                        await asyncio.sleep(fault["delay"])
+                    elif fault["mode"] == "http_500":
+                        raise _RetryableDispatch(
+                            f"runner {target.id} returned 500 (injected)"
+                        )
+                    else:
+                        raise _RetryableDispatch(
+                            f"cannot connect to runner {target.id} "
+                            "(injected)"
+                        )
+                kill_after = (
+                    inj.stream_kill_after(target.id) if inj else None
+                )
+                status, payload_iter, closer = (
+                    await self._open_runner_stream(
+                        target, path, data, headers, max(1.0, remaining)
+                    )
+                )
+                if status != 200:
+                    # pre-stream shed / validation error from the runner
+                    # (non-5xx): with nothing forwarded yet, hand the
+                    # body to the client verbatim; past the first byte,
+                    # report in-band — a 429 is not a runner fault
+                    chunks = []
+                    async for chunk in payload_iter:
+                        chunks.append(chunk)
+                    err_body = b"".join(chunks)
+                    acct.release()
+                    attempt_span(f"upstream_{status}")
+                    if mode == "resume":
+                        # expired/claimed import: fall back to replay
+                        last_err = (
+                            f"resume on {target.id} answered {status}"
+                        )
+                        died = True
+                        continue
+                    if client is None:
+                        return web.Response(
+                            status=status, body=err_body,
+                            content_type="application/json",
+                            headers={TRACE_HEADER: trace_id},
+                        )
+                    try:
+                        msg = json.loads(err_body)["error"]["message"]
+                    except Exception:  # noqa: BLE001 — opaque body
+                        msg = f"runner answered {status}"
+                    await client_send(
+                        sse_frame({"error": {"message": msg,
+                                             "trace_id": trace_id}})
+                    )
+                    return await finish(f"failed_{status}")
+                parser = SSEParser()
+                track.start_replay()
+                n_payloads = 0
+                async for chunk in payload_iter:
+                    if (
+                        kill_after is not None
+                        and n_payloads >= kill_after
+                    ):
+                        raise aiohttp.ClientPayloadError(
+                            "injected mid-stream death"
+                        )
+                    for payload in parser.feed(chunk):
+                        n_payloads += 1
+                        if payload == "[DONE]":
+                            continue   # we write our own terminal DONE
+                        try:
+                            doc = json.loads(payload)
+                        except ValueError:
+                            continue
+                        err = doc.get("error")
+                        if err is not None:
+                            msg = str(err.get("message", ""))
+                            peer = parse_migrated_peer(msg)
+                            if peer is not None:
+                                # clean source drain: the snapshot is on
+                                # `peer`; continue the stream there
+                                rid = str(
+                                    err.get("request_id", "")
+                                ) or ""
+                                resume = (peer, rid)
+                                acct.release()
+                                attempt_span("migrated")
+                                had_failover = True
+                                break
+                            if msg.startswith("shutting_down"):
+                                # drain without migration: replay on a
+                                # surviving runner
+                                acct.release()
+                                attempt_span("source_draining")
+                                last_err = msg
+                                died = True
+                                break
+                            # request-level terminal error: forward
+                            await ensure_client()
+                            await client_send(
+                                sse_frame({"error": {
+                                    "message": msg,
+                                    "trace_id": trace_id,
+                                }})
+                            )
+                            acct.success()
+                            attempt_span("error_forwarded")
+                            return await finish("upstream_error")
+                        if mode == "resume":
+                            text = str(doc.get("delta") or "")
+                            fr = doc.get("finish_reason")
+                            out = text
+                        else:
+                            text = chunk_delta_text(doc)
+                            fr = chunk_finish_reason(doc)
+                            out = track.elide(text)
+                            if not template:
+                                template = {
+                                    "id": str(doc.get("id", "")),
+                                    "model": str(
+                                        doc.get("model", model)
+                                    ),
+                                    "created": doc.get("created", 0),
+                                }
+                        if out or fr or not role_sent:
+                            await ensure_client()
+                            if not template:
+                                template = {
+                                    "id": f"failover-{trace_id[:16]}",
+                                    "model": model,
+                                    "created": int(time.time()),
+                                }
+                            await client_send(
+                                sse_frame(make_chunk(
+                                    template, kind, out, fr,
+                                    first=not role_sent,
+                                ))
+                            )
+                            role_sent = True
+                            track.note_forwarded(out)
+                        if fr:
+                            finished = True
+                            break
+                    if finished or died or resume is not None:
+                        break
+                if finished:
+                    acct.success()
+                    self.dispatch_ok += 1
+                    attempt_span("ok" if not had_failover
+                                 else "failover_ok")
+                    return await finish(
+                        "ok" if not had_failover else "failover_ok"
+                    )
+                if resume is not None:
+                    continue   # migrated: next attempt resumes on peer
+                if died:
+                    had_failover = had_failover or role_sent
+                    continue
+                # stream ended without finish_reason or error: the
+                # runner died between frames (clean EOF mid-generation)
+                acct.failure()
+                attempt_span("truncated")
+                last_err = f"runner {target.id} truncated the stream"
+                had_failover = had_failover or role_sent
+                died = True
+            except _ClientGone:
+                # the CLIENT went away mid-stream: neutral release (the
+                # runner did nothing wrong) and STOP — no replay into a
+                # dead transport
+                acct.release()
+                attempt_span("client_gone")
+                return client
+            except _RetryableDispatch as e:
+                acct.failure()
+                attempt_span(f"failed: {str(e)[:120]}")
+                last_err = str(e)
+            except (
+                aiohttp.ClientError,
+                asyncio.TimeoutError,
+                ConnectionError,
+                OSError,
+            ) as e:
+                # mid-stream (or connect-time) UPSTREAM death (client
+                # write failures raise _ClientGone above): survive it
+                acct.failure()
+                attempt_span(f"died: {type(e).__name__}")
+                last_err = f"{type(e).__name__}: {e}"
+                had_failover = had_failover or role_sent
+            except asyncio.CancelledError:
+                acct.release()
+                attempt_span("cancelled")
+                raise
+            finally:
+                if closer is not None:
+                    try:
+                        await closer()
+                    except Exception:  # noqa: BLE001 — already torn down
+                        pass
+            if attempt >= self.dispatch_max_attempts:
+                break
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                break
+            self.dispatch_retries += 1
+            backoff = min(
+                self.dispatch_backoff_cap,
+                self.dispatch_backoff_base * (2 ** (attempt - 1)),
+            ) * (0.5 + random.random() / 2)
+            await asyncio.sleep(min(backoff, remaining))
+        # every candidate exhausted
+        self.dispatch_exhausted += 1
+        self.traces.record(
+            trace_id, "dispatch", t_req, time.monotonic(),
+            plane="control", model=model, attempts=attempt,
+            outcome="runners_exhausted",
+        )
+        _dispatch_log.warning(
+            "failover dispatch exhausted after %d attempt(s) "
+            "(trace_id=%s model=%s): %s",
+            attempt, trace_id, model, last_err,
+        )
+        drain_after = self.router.drain_retry_after(model)
+        if client is None:
+            code = "draining" if drain_after is not None else (
+                "runners_exhausted"
+            )
+            return web.json_response(
+                {
+                    "error": {
+                        "message": (
+                            f"all runner(s) for model '{model}' are "
+                            f"unavailable ({attempt} attempt(s); last "
+                            f"error: {last_err})"
+                        ),
+                        "type": "overloaded_error",
+                        "code": code,
+                        "trace_id": trace_id,
+                    }
+                },
+                status=503,
+                headers={
+                    "Retry-After": str(drain_after or 1),
+                    TRACE_HEADER: trace_id,
+                },
+            )
+        try:
+            await client_send(
+                sse_frame({"error": {
+                    "message": (
+                        "stream could not be failed over: " + last_err
+                    ),
+                    "trace_id": trace_id,
+                }})
+            )
+        except _ClientGone:
+            return client
+        return await finish("runners_exhausted")
 
     async def _dispatch_anthropic_gateway(self, request, body: dict):
         """Native /v1/messages for models no runner serves: proxy to the

@@ -328,6 +328,90 @@ class PreemptedSeq:
     key: np.ndarray             # evolved per-slot PRNG key, [2] u32
     counts: np.ndarray          # output-token histogram, [V] i32
     preempted_at: float = dataclasses.field(default_factory=time.monotonic)
+    # imported-snapshot path (ISSUE 11): page contents carried INLINE
+    # (already checksum-verified at import) instead of through the host
+    # pool — a migrated-in request must park and resume even on engines
+    # whose host tier is off.  None = the PR 6 host-pool path.
+    entries: Optional[list] = None
+
+
+# ---------------------------------------------------------------------------
+# portable request snapshots (ISSUE 11): export / migrate / import
+# ---------------------------------------------------------------------------
+
+SNAPSHOT_VERSION = 1
+
+
+class SnapshotError(ValueError):
+    """A request snapshot that must not touch the engine: wrong version,
+    incompatible KV geometry, or a failed page checksum.  ``code`` is the
+    typed discriminator surfaced to HTTP callers."""
+
+    def __init__(self, message: str, code: str = "snapshot_invalid"):
+        super().__init__(message)
+        self.code = code
+
+
+@dataclasses.dataclass
+class RequestSnapshot:
+    """One in-flight request as a first-class, portable object.
+
+    Everything a peer engine with the same weights needs to continue the
+    generation bit-identically: prompt + already-emitted token ids, the
+    device-evolved sampler state captured via the PR 6 preempt path
+    (evolved PRNG key, output-token penalty histogram, decode position),
+    the sequence's KV pages in their STORED representation (raw int8
+    codes + scales for quantized pools — restore is bit-exact), and the
+    tenant/trace/sched-class identity so accounting follows the request
+    across runners.  ``pages`` hold numpy array dicts (the
+    ``gather_pages`` field layout); ``page_checksums`` are blake2b
+    digests over the stored representation, verified by
+    ``import_request`` BEFORE any allocator mutation."""
+
+    version: int
+    model: str
+    request_id: str
+    prompt_tokens: list
+    output_tokens: list
+    sampling: dict              # dataclasses.asdict(SamplingParams)
+    stop_token_ids: list
+    tenant: str
+    trace_id: str
+    sched_class: str
+    max_len: Optional[int]
+    preempt_count: int
+    # device-evolved decode state; position None = the request never
+    # reached a slot (queued / mid-chunk) and replays from the prompt
+    position: Optional[int]
+    last_token: Optional[int]
+    mrope_delta: int
+    key: Optional[list]         # evolved PRNG key, two uint32 words
+    token_counts: dict          # SPARSE {token_id: count} histogram
+    # KV geometry the importer validates before anything else
+    page_size: int
+    num_layers: int
+    kv_heads: int
+    head_dim: int
+    kv_dtype: str
+    pages: list                 # [{k, v, k_scale, v_scale}, ...] numpy
+    page_checksums: list        # blake2b hex digest per page
+    # table capacity the peer must allocate (>= len(pages)): only pages
+    # holding WRITTEN KV ship — wire size scales with progress, not
+    # max_tokens — and the importer backs the table's tail with fresh
+    # (content-irrelevant) pages up to this count
+    total_pages: int = 0
+
+    @property
+    def has_kv(self) -> bool:
+        return self.position is not None and bool(self.pages)
+
+    def kv_bytes(self) -> int:
+        return sum(
+            int(a.nbytes)
+            for p in self.pages
+            for a in p.values()
+            if a is not None
+        )
 
 
 # Compiled step functions are cached at module level keyed by the static
@@ -1060,6 +1144,10 @@ class Engine:
         self.num_preemptions = 0
         self.num_resumes = 0
         self.restore_seconds = 0.0
+        # portable request snapshots (ISSUE 11): export/import counters
+        # feed the helix_migrations_* series and the migration bench
+        self.num_snapshots_exported = 0
+        self.num_snapshots_imported = 0
         # MoE routing assignments dropped to expert-capacity overflow
         # during prefill (those tokens silently rode the residual stream);
         # device scalars accumulate un-fetched and drain lazily so the
@@ -2240,7 +2328,330 @@ class Engine:
             cands.remove((req, i))
         return None
 
+    # ------------------------------------------------------------------
+    # portable request snapshots (ISSUE 11)
+    # ------------------------------------------------------------------
+
+    def _snapshot_pages(self, table, n_pages: int, private_pos=None,
+                        req_id: str = "") -> Optional[tuple]:
+        """Gather the sequence's pages as host numpy dicts, in table
+        order, with their stored-representation checksums.  Pages listed
+        in ``private_pos`` are read from the host pool (a parked
+        decoder's swapped-out pages — already spilled, verified at get);
+        everything else gathers from the device pool.  Returns
+        (pages, checksums) or None when a host copy failed verification
+        (the caller degrades to shed — never exports wrong KV)."""
+        from helix_tpu.engine.kv_cache import gather_pages, page_checksum
+
+        private = set(private_pos or ())
+        device_pos = [i for i in range(n_pages) if i not in private]
+        gathered = {}
+        if device_pos:
+            page_ids = [int(table[i]) for i in device_pos]
+            arrays = gather_pages(self.cache, page_ids)
+            for pos, page_arrays in zip(device_pos, arrays):
+                gathered[pos] = {
+                    f: (None if a is None else np.asarray(a))
+                    for f, a in page_arrays.items()
+                }
+        for pos in sorted(private):
+            host = self.host_pool.get(("seq", req_id, pos))
+            if host is None:   # corrupt or evicted: cannot export exactly
+                return None
+            gathered[pos] = host
+        pages = [gathered[i] for i in range(n_pages)]
+        checksums = [page_checksum(p).hex() for p in pages]
+        return pages, checksums
+
+    def _snapshot_base(self, req: Request) -> dict:
+        return {
+            "version": SNAPSHOT_VERSION,
+            "model": self.model_cfg.name,
+            "request_id": req.id,
+            "prompt_tokens": [int(t) for t in req.prompt_tokens],
+            "output_tokens": [int(t) for t in req.output_tokens],
+            "sampling": dataclasses.asdict(req.sampling),
+            "stop_token_ids": [int(t) for t in req.stop_token_ids],
+            "tenant": req.tenant,
+            "trace_id": req.trace_id,
+            "sched_class": req.sched_class,
+            "max_len": req.max_len,
+            "preempt_count": req.preempt_count,
+            "page_size": self.cache_cfg.page_size,
+            "num_layers": self.model_cfg.num_layers,
+            "kv_heads": self.model_cfg.num_kv_heads,
+            "head_dim": self.model_cfg.head_dim,
+            "kv_dtype": self.cache_cfg.dtype,
+        }
+
+    def export_request(self, req_id: str) -> Optional[RequestSnapshot]:
+        """Build a portable snapshot of one live request (engine thread).
+
+        Three shapes, mirroring where the request is in its life:
+
+        - **decoding in a slot**: full KV export — the device-evolved
+          sampler state is captured via the PR 6 preempt recipe (sync
+          the device copy, read the slot's key + penalty histogram) and
+          every table page gathers to host with a checksum;
+        - **parked preempted**: private pages come from the host pool
+          (verified), shared prefix pages from the device;
+        - **queued / mid-chunk-prefill**: no KV state — the snapshot
+          replays from the prompt on the peer (no token was emitted
+          yet, so exactly-once delivery holds trivially).
+
+        Returns None for requests that cannot be exported (unknown,
+        finished, VL — image embeds are device arrays bound to this
+        runner — or a parked page that failed verification).  The caller
+        owns the request's local teardown; export itself mutates
+        nothing."""
+        req = self._requests.get(req_id)
+        if req is None or req.finished:
+            return None
+        if req.image_embeds is not None or req.positions3 is not None:
+            return None   # VL requests pin device-resident image state
+        base = self._snapshot_base(req)
+        parked = next(
+            (st for st in self.preempted if st.req is req), None
+        )
+        if parked is not None:
+            if parked.entries is not None:
+                # imported-and-not-yet-resumed: the verified pages are
+                # already inline (every table position is private)
+                from helix_tpu.engine.kv_cache import page_checksum
+
+                pages = list(parked.entries)
+                checksums = [page_checksum(p).hex() for p in pages]
+            else:
+                snapped = self._snapshot_pages(
+                    parked.table, len(parked.table),
+                    private_pos=parked.private_pos, req_id=req.id,
+                )
+                if snapped is None:
+                    return None
+                pages, checksums = snapped
+            base["total_pages"] = len(parked.table)
+            counts = parked.counts
+            state = dict(
+                position=int(parked.position),
+                last_token=int(parked.last_token),
+                mrope_delta=int(parked.mrope_delta),
+                key=[int(parked.key[0]), int(parked.key[1])],
+            )
+        elif req.slot is not None and self._slot_active(req.slot):
+            slot = req.slot
+            # capture the device-evolving sampler state AFTER making the
+            # device copy current — the same bit-exactness rule as
+            # ``preempt``: the key stream and penalty histogram must be
+            # exactly where the last step left them
+            if self._state_dirty or self._dstate is None:
+                self._sync_state()
+            key = np.asarray(self._dstate.keys[slot])
+            counts = np.asarray(self._dstate.token_counts[slot])
+            n_alloc = len(self.allocator.seq_pages(req.id)) + len(
+                self._shared_pages.get(req.id, ())
+            )
+            # ship only pages holding WRITTEN KV (token slots
+            # 0..num_tokens-2 — the newest token's KV lands during the
+            # NEXT step): admission allocated capacity for max_tokens up
+            # front, and shipping that mostly-uninitialized tail would
+            # scale the wire bytes with the budget, not the progress
+            ps = self.cache_cfg.page_size
+            n_resident = min(n_alloc, -(-req.num_tokens // ps))
+            snapped = self._snapshot_pages(
+                self._page_tables[slot], n_resident
+            )
+            if snapped is None:
+                return None
+            pages, checksums = snapped
+            base["total_pages"] = n_alloc
+            state = dict(
+                position=int(self._positions[slot]),
+                last_token=int(self._last_token[slot]),
+                mrope_delta=int(self._mrope_delta[slot]),
+                key=[int(key[0]), int(key[1])],
+            )
+        else:
+            # queued, or mid-chunk prefill (partial KV is not worth
+            # shipping: no token emitted, replay is exact by definition)
+            base["output_tokens"] = []
+            pages, checksums, counts = [], [], None
+            state = dict(
+                position=None, last_token=None, mrope_delta=0, key=None,
+            )
+        sparse: dict = {}
+        if counts is not None:
+            nz = np.nonzero(counts)[0]
+            sparse = {int(i): int(counts[i]) for i in nz}
+        self.num_snapshots_exported += 1
+        return RequestSnapshot(
+            **base, **state, token_counts=sparse,
+            pages=pages, page_checksums=checksums,
+        )
+
+    def import_request(self, snap: RequestSnapshot) -> Request:
+        """Re-admit a snapshot on this engine (engine thread).
+
+        Validation is strictly BEFORE mutation: version, KV geometry
+        (page size / layers / heads / head dim / storage dtype must
+        match — bit-identical continuation is the contract, not
+        best-effort), then EVERY page checksum against the stored
+        representation.  Only then does the request enter the engine —
+        KV-carrying snapshots park on the ``preempted`` list with their
+        verified pages INLINE and re-admit through ``_try_resume`` as a
+        plain admission wave (exactly the PR 6 local-resume path, so the
+        continuation is bit-identical); plain snapshots join the wait
+        queue like any fresh request.  Raises ``SnapshotError`` (typed)
+        without touching allocator or queue state on any failure."""
+        if snap.version != SNAPSHOT_VERSION:
+            raise SnapshotError(
+                f"snapshot version {snap.version} != engine version "
+                f"{SNAPSHOT_VERSION}",
+                code="snapshot_unsupported",
+            )
+        existing = self._requests.get(snap.request_id)
+        if existing is not None and not existing.finished:
+            raise SnapshotError(
+                f"request {snap.request_id!r} is already live here",
+                code="snapshot_duplicate",
+            )
+        samp = dict(snap.sampling)
+        samp["stop"] = tuple(samp.get("stop", ()) or ())
+        req = Request(
+            id=snap.request_id,
+            prompt_tokens=list(snap.prompt_tokens),
+            sampling=SamplingParams(**samp),
+            stop_token_ids=tuple(snap.stop_token_ids),
+            output_tokens=list(snap.output_tokens),
+            trace_id=snap.trace_id,
+            tenant=snap.tenant,
+            sched_class=snap.sched_class,
+            preempt_count=int(snap.preempt_count),
+        )
+        err = self.validate_request(req)
+        if err:
+            raise SnapshotError(err, code="snapshot_invalid")
+        if not snap.has_kv:
+            if snap.output_tokens:
+                raise SnapshotError(
+                    "snapshot carries emitted tokens but no KV state — "
+                    "it cannot be continued exactly",
+                    code="snapshot_corrupt",
+                )
+            self._requests[req.id] = req
+            self.waiting.append(req)
+            self.num_snapshots_imported += 1
+            return req
+        cc = self.cache_cfg
+        geometry = (
+            ("page_size", snap.page_size, cc.page_size),
+            ("num_layers", snap.num_layers, self.model_cfg.num_layers),
+            ("kv_heads", snap.kv_heads, self.model_cfg.num_kv_heads),
+            ("head_dim", snap.head_dim, self.model_cfg.head_dim),
+            ("kv_dtype", snap.kv_dtype, cc.dtype),
+        )
+        for field, theirs, ours in geometry:
+            if theirs != ours:
+                raise SnapshotError(
+                    f"KV geometry mismatch on {field}: snapshot has "
+                    f"{theirs!r}, this engine has {ours!r}",
+                    code="snapshot_incompatible",
+                )
+        n = len(snap.pages)
+        if n != len(snap.page_checksums) or n == 0:
+            raise SnapshotError(
+                "page/checksum count mismatch", code="snapshot_corrupt"
+            )
+        n_total = max(n, int(snap.total_pages or n))
+        if n_total > cc.max_pages_per_seq or n_total > cc.num_pages - 1:
+            raise SnapshotError(
+                f"snapshot needs {n_total} pages; this engine caps a "
+                f"sequence at "
+                f"{min(cc.max_pages_per_seq, cc.num_pages - 1)}",
+                code="snapshot_incompatible",
+            )
+        # the shipped pages must COVER every written KV slot (tokens
+        # 0..num_tokens-2): fewer means the continuation would attend
+        # garbage — refuse rather than diverge
+        written = max(0, len(req.prompt_tokens) + len(req.output_tokens) - 1)
+        if n * cc.page_size < written:
+            raise SnapshotError(
+                f"{n} shipped page(s) cannot cover {written} written "
+                "KV slot(s)",
+                code="snapshot_corrupt",
+            )
+        from helix_tpu.engine.kv_cache import page_checksum
+
+        quantized = cc.quantized
+        kshape = (
+            self.model_cfg.num_layers, cc.page_size,
+            self.model_cfg.num_kv_heads, self.model_cfg.head_dim,
+        )
+        entries = []
+        for arrays, digest in zip(snap.pages, snap.page_checksums):
+            entry = {
+                f: arrays.get(f)
+                for f in ("k", "v", "k_scale", "v_scale")
+            }
+            if entry["k"] is None or entry["v"] is None:
+                raise SnapshotError(
+                    "page missing k/v buffers", code="snapshot_corrupt"
+                )
+            if tuple(entry["k"].shape) != kshape:
+                raise SnapshotError(
+                    f"page shape {tuple(entry['k'].shape)} != pool page "
+                    f"shape {kshape}",
+                    code="snapshot_incompatible",
+                )
+            if quantized != (entry["k_scale"] is not None):
+                raise SnapshotError(
+                    "snapshot storage mode does not match the pool "
+                    "(int8 scales present/absent)",
+                    code="snapshot_incompatible",
+                )
+            if page_checksum(entry).hex() != digest:
+                raise SnapshotError(
+                    "page failed checksum verification — refusing to "
+                    "restore corrupt KV",
+                    code="snapshot_corrupt",
+                )
+            entries.append(entry)
+        V = self.model_cfg.vocab_size
+        counts = np.zeros((V,), np.int32)
+        for tok, cnt in snap.token_counts.items():
+            t = int(tok)
+            if not 0 <= t < V:
+                raise SnapshotError(
+                    f"histogram token id {t} outside vocab {V}",
+                    code="snapshot_incompatible",
+                )
+            counts[t] = int(cnt)
+        if snap.key is None or len(snap.key) != 2:
+            raise SnapshotError(
+                "missing sampler key", code="snapshot_corrupt"
+            )
+        limit = min(n_total * cc.page_size, self.max_context_len)
+        req.max_len = min(int(snap.max_len or limit), limit)
+        req.cached_tokens = 0
+        st = PreemptedSeq(
+            req=req,
+            table=np.zeros((n_total,), np.int32),  # rewritten at resume
+            private_pos=list(range(n_total)),
+            position=int(snap.position),
+            last_token=int(snap.last_token),
+            mrope_delta=int(snap.mrope_delta),
+            key=np.asarray(snap.key, np.uint32),
+            counts=counts,
+            entries=entries,
+        )
+        self._requests[req.id] = req
+        self.preempted.append(st)
+        self.num_snapshots_imported += 1
+        return req
+
     def _discard_preempted(self, st: PreemptedSeq) -> None:
+        st.entries = None
+        if self.host_pool is None:
+            return   # imported-snapshot park: nothing lives in the pool
         for pos in st.private_pos:
             self.host_pool.discard(("seq", st.req.id, pos))
 
@@ -2261,7 +2672,12 @@ class Engine:
                 i for i, s in enumerate(self.slots) if s is None
             ]
             n_private = len(st.private_pos)
-            if not free_slots or not self.allocator.can_allocate(n_private):
+            # _ensure_pages, not bare can_allocate: refcount-0 prefix
+            # cache pages must LRU-evict (spilling to the host tier when
+            # armed) for a parked resume exactly as they do for a fresh
+            # admission — otherwise a pool whose free list is mostly
+            # cache-owned wedges every parked/imported request
+            if not free_slots or not self._ensure_pages(n_private):
                 return
             # claim + verify every host copy BEFORE touching allocator
             # state: a corrupt page means the sequence cannot be
@@ -2269,14 +2685,19 @@ class Engine:
             # resume wrong KV.  One pass (checksum verified inside
             # take_restored); a mid-chain failure aborts the whole
             # resume, so a None can never reach restore_pages.
+            # Imported snapshots (ISSUE 11) carry their pages INLINE,
+            # verified once at import — no pool round trip.
             t0 = time.monotonic()
-            entries = []
-            for pos in st.private_pos:
-                e = self.host_pool.take_restored(("seq", req.id, pos))
-                if e is None:
-                    break
-                entries.append(e)
-            if len(entries) != n_private:
+            if st.entries is not None:
+                entries = st.entries
+            else:
+                entries = []
+                for pos in st.private_pos:
+                    e = self.host_pool.take_restored(("seq", req.id, pos))
+                    if e is None:
+                        break
+                    entries.append(e)
+            if st.entries is None and len(entries) != n_private:
                 self.preempted.pop(0)
                 self._discard_preempted(st)
                 self._resume_failures.append(
@@ -2291,7 +2712,13 @@ class Engine:
             new_pages = self.allocator.allocate(req.id, n_private)
             from helix_tpu.engine.kv_cache import restore_pages
 
-            self.cache = restore_pages(self.cache, new_pages, entries)
+            # imported snapshots ship only the WRITTEN head of the
+            # table; the tail pages just allocated stay as-is (their
+            # contents are overwritten before they are ever attended)
+            self.cache = restore_pages(
+                self.cache, new_pages[: len(entries)], entries
+            )
+            st.entries = None   # inline page buffers are on device now
             table = np.array(st.table)
             for pos, pg in zip(st.private_pos, new_pages):
                 table[pos] = pg

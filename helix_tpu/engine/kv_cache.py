@@ -544,6 +544,15 @@ def _page_checksum(arrays: dict) -> bytes:
     return h.digest()
 
 
+def page_checksum(arrays: dict) -> bytes:
+    """Public content digest over one page's host buffers (the
+    ``gather_pages`` field layout) — the host tier verifies restores
+    with it and request snapshots (ISSUE 11) stamp/verify every shipped
+    page with the same digest, so a page is checked identically whether
+    it crossed a process boundary or just the PCIe bus."""
+    return _page_checksum(arrays)
+
+
 class _HostPage:
     """One spilled page: host copies of its K/V (+ int8 scale rows).
 

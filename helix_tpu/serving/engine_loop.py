@@ -26,7 +26,12 @@ import threading
 import time
 from typing import Callable, Optional
 
-from helix_tpu.engine.engine import Engine, FinishReason, Request
+from helix_tpu.engine.engine import (
+    Engine,
+    FinishReason,
+    Request,
+    SnapshotError,
+)
 from helix_tpu.obs import EngineLoopObs, FlightRecorder, RateTracker
 from helix_tpu.obs import trace as obs_trace
 from helix_tpu.obs.flight import SATURATION_KEYS
@@ -58,6 +63,19 @@ class TokenEvent:
     finished: bool
     finish_reason: Optional[str] = None
     error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _ImportItem:
+    """An inbox entry carrying a migrated-in request snapshot (ISSUE 11):
+    ``engine.import_request`` must run on the engine thread, so the HTTP
+    handler enqueues here like a submit.  ``on_result(err, code)`` fires
+    once validation settles (None = accepted) so the import endpoint can
+    answer with a typed status instead of a blind 200."""
+
+    snapshot: object
+    on_event: Callable[[TokenEvent], None]
+    on_result: Optional[Callable] = None
 
 
 class EngineLoop:
@@ -155,6 +173,13 @@ class EngineLoop:
         # per-tenant inbox depth (admission lock); the per-tenant bound
         # adds the engine-side wait-queue count on demand
         self._pending_by_tenant: dict[str, int] = {}
+        # cross-runner migration (ISSUE 11): when set, requests still
+        # unfinished at the drain deadline are snapshotted and handed to
+        # this callable (wire dict -> accepting peer id; raises on
+        # failure) instead of shed — the node agent wires a PeerShipper
+        # here during graceful shutdown, tests wire a direct stub
+        self.exporter = None
+        self.migration_failures = 0   # failed exports/ships/imports
         engine.on_admit = self._note_admit
         if self._sched_active:
             engine.victim_policy = self.sched.preempt_order
@@ -361,6 +386,134 @@ class EngineLoop:
         self._inbox.put((request_id, None))
         self._wake.set()
 
+    @property
+    def draining(self) -> bool:
+        """Shutdown-ladder state for metrics/heartbeats (GIL-atomic)."""
+        return self._draining or self._stop.is_set()
+
+    def submit_import(self, snapshot, on_event, on_result=None):
+        """Enqueue a migrated-in request snapshot (any thread).
+
+        Validation and re-admission happen on the engine thread
+        (``engine.import_request`` — every checksum checked before any
+        allocator mutation); ``on_result(err, code)`` reports the
+        outcome.  A KV-carrying snapshot parks on the preempted list and
+        re-admits when a slot + pages free up, so an import landing on a
+        FULL engine queues behind admission instead of wedging — and the
+        ordinary admission deadline sheds it (typed) if capacity never
+        comes."""
+        with self._admission_lock:
+            if self._draining or self._stop.is_set():
+                if on_result is not None:
+                    on_result(
+                        f"{SHUTTING_DOWN}: engine '{self.name}' is "
+                        "draining",
+                        "shutting_down",
+                    )
+                return
+            self._inbox.put(
+                (_ImportItem(snapshot, on_event, on_result), None)
+            )
+        self._wake.set()
+
+    def _handle_import(self, item: _ImportItem) -> None:
+        """Engine-thread half of submit_import."""
+        rid = getattr(item.snapshot, "request_id", "")
+        try:
+            req = self.engine.import_request(item.snapshot)
+        except SnapshotError as e:
+            self.migration_failures += 1
+            self.flight.note_anomaly(
+                "import_rejected", request_id=rid, detail=str(e)[:200]
+            )
+            log.warning(
+                "engine '%s' rejected snapshot import request_id=%s: %s",
+                self.name, rid, e,
+            )
+            if item.on_result is not None:
+                item.on_result(str(e), e.code)
+            return
+        except Exception as e:  # noqa: BLE001 — thread must survive
+            self.migration_failures += 1
+            log.exception(
+                "engine '%s' snapshot import failed request_id=%s",
+                self.name, rid,
+            )
+            if item.on_result is not None:
+                item.on_result(str(e), "snapshot_invalid")
+            return
+        self._subscribers[req.id] = item.on_event
+        self._admit_order.append(req.id)
+        log.info(
+            "engine '%s' imported request_id=%s (%d prior token(s), "
+            "%d page(s))",
+            self.name, req.id, len(req.output_tokens),
+            len(getattr(item.snapshot, "pages", ())),
+        )
+        if item.on_result is not None:
+            item.on_result(None, None)
+
+    def _export_survivors(self) -> int:
+        """Drain-deadline migration: snapshot every still-unfinished
+        request and ship it to a peer via ``self.exporter`` instead of
+        shedding.  Runs on the engine thread after the last drain step,
+        so the captured sampler state is exactly where generation
+        stopped.  Requests that cannot export (VL, ship failure) are
+        left for the ``_fail_all`` that follows."""
+        if self.exporter is None:
+            return 0
+        if getattr(self.engine, "export_request", None) is None:
+            # lockstep leaders (journaled command stream) have no
+            # export path — a leader-local export would desync the
+            # follower's replay; degrade to the ordinary shed
+            return 0
+        from helix_tpu.serving.migration import (
+            migrated_error,
+            snapshot_to_wire,
+        )
+
+        shipped = 0
+        for req in self._active_by_recency():
+            try:
+                snap = self.engine.export_request(req.id)
+            except Exception:  # noqa: BLE001 — degrade to shed
+                log.exception(
+                    "engine '%s' export failed for request_id=%s",
+                    self.name, req.id,
+                )
+                snap = None
+            if snap is None:
+                self.migration_failures += 1
+                continue
+            try:
+                peer = self.exporter(snapshot_to_wire(snap))
+            except Exception as e:  # noqa: BLE001 — degrade to shed
+                self.migration_failures += 1
+                log.warning(
+                    "engine '%s' could not ship snapshot for "
+                    "request_id=%s: %s",
+                    self.name, req.id, e,
+                )
+                continue
+            shipped += 1
+            msg = migrated_error(req.id, peer)
+            self.engine.abort(req.id)
+            self._forget_request(req.id)
+            log.info(
+                "engine '%s' migrated request_id=%s to peer %s at "
+                "drain deadline",
+                self.name, req.id, peer,
+            )
+            cb = self._subscribers.pop(req.id, None)
+            if cb:
+                cb(
+                    TokenEvent(
+                        request_id=req.id, token_id=-1, finished=True,
+                        finish_reason="error", error=msg,
+                    )
+                )
+        return shipped
+
     def stats(self) -> dict:
         """Counter snapshot for /metrics (reads of plain ints are atomic
         under the GIL, so no lock against the engine thread is needed)."""
@@ -409,6 +562,14 @@ class EngineLoop:
                 if getattr(eng, "host_pool", None) is not None
                 else None
             ),
+            # cross-runner migration (ISSUE 11): snapshots out/in +
+            # ship/import failures + the drain-ladder state
+            "migration": {
+                "exported": getattr(eng, "num_snapshots_exported", 0),
+                "imported": getattr(eng, "num_snapshots_imported", 0),
+                "failures": self.migration_failures,
+                "draining": self.draining,
+            },
             # per-tenant SLO observability (ISSUE 7): pooled totals +
             # top-K bounding introspection
             "tenants": self.slo.stats(),
@@ -505,6 +666,9 @@ class EngineLoop:
                 item, on_event = self._inbox.get_nowait()
             except queue.Empty:
                 return
+            if isinstance(item, _ImportItem):  # migrated-in snapshot
+                self._handle_import(item)
+                continue
             if on_event is None:  # abort
                 self.engine.abort(item)
                 self._subscribers.pop(item, None)
@@ -892,6 +1056,16 @@ class EngineLoop:
                 if not self.engine.has_work():
                     break
                 if time.monotonic() > self._drain_deadline:
+                    # migrate instead of shed (ISSUE 11): with an
+                    # exporter wired, the drain ladder is
+                    # finish -> snapshot+ship -> shed — _fail_all only
+                    # sees what could not be exported
+                    shipped = self._export_survivors()
+                    if shipped:
+                        log.info(
+                            "engine '%s' exported %d request(s) at the "
+                            "drain deadline", self.name, shipped,
+                        )
                     self._fail_all("drain deadline exceeded at shutdown")
                     break
             if time.monotonic() - self._last_reap > 10.0:

@@ -1,0 +1,603 @@
+"""Cross-runner request migration: wire format, shipping, stream glue.
+
+ISSUE 11 makes an in-flight request a first-class, portable object.  The
+engine builds and consumes ``RequestSnapshot``s (``Engine.export_request``
+/ ``import_request`` — checksummed pages, device-evolved sampler state);
+this module owns everything around that core:
+
+- the **wire format**: a JSON-safe encoding of a snapshot (numpy page
+  buffers ride base64 with dtype+shape; scalar fields are covered by a
+  meta checksum) shipped over ``POST /v1/migrate/import``;
+- the **drain shipper** (``PeerShipper``): during graceful shutdown the
+  node agent wires it into each engine loop — survivors of the drain
+  deadline are snapshotted and POSTed to a peer runner instead of shed
+  (targets come from the control plane's migration-targets endpoint);
+- the **imported-stream registry** (``ImportedStreams``): a migrated-in
+  request starts generating as soon as the peer engine has resources,
+  possibly before anyone is listening — its token events buffer here
+  until the control plane attaches via ``POST /v1/migrate/resume`` (or
+  the claim TTL expires and the request is aborted);
+- the **SSE plumbing** the control plane's mid-stream failover uses to
+  watch a proxied stream (incremental parser, delta-text extraction,
+  frame templating) so a runner death past the first byte continues the
+  client's stream with exactly-once token delivery;
+- the **metric vocabulary**: every ``helix_migrations_*`` /
+  ``helix_migration_*`` / ``helix_cp_midstream_*`` /
+  ``helix_cp_runner_draining`` series is minted HERE and only here
+  (``tools/lint_metrics.py`` contract 6) — the runner and control plane
+  call the collector helpers below.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import json
+import os
+import re
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+from helix_tpu.engine.engine import (
+    SNAPSHOT_VERSION,
+    RequestSnapshot,
+    SnapshotError,
+)
+
+# ---------------------------------------------------------------------------
+# metric vocabulary (lint_metrics contract 6: minted only in this module)
+# ---------------------------------------------------------------------------
+
+MIGRATIONS_EXPORTED = "helix_migrations_exported_total"
+MIGRATIONS_IMPORTED = "helix_migrations_imported_total"
+MIGRATION_FAILURES = "helix_migration_failures_total"
+MIGRATION_DRAIN_STATE = "helix_migration_drain_state"
+CP_MIDSTREAM_FAILOVERS = "helix_cp_midstream_failovers_total"
+CP_RUNNER_DRAINING = "helix_cp_runner_draining"
+
+# error-message prefix for a request that was exported instead of shed
+# (the engine-loop/openai error-mapping contract, like QUEUE_FULL); the
+# control plane's mid-stream failover parses the peer out of the message
+MIGRATED = "migrated"
+
+_PEER_RE = re.compile(r"peer=([A-Za-z0-9._:\-]+)")
+
+
+def migrated_error(request_id: str, peer_id: str) -> str:
+    """The in-band terminal event for a drained-and-shipped request.
+    Carries enough structure for the control plane to resume the stream
+    on the peer: the engine request id and the peer runner id."""
+    return f"{MIGRATED}: request {request_id} exported to peer={peer_id}"
+
+
+def parse_migrated_peer(message: str) -> Optional[str]:
+    """The peer runner id from a ``migrated_error`` message, or None."""
+    if not message.startswith(MIGRATED):
+        return None
+    m = _PEER_RE.search(message)
+    return m.group(1) if m else None
+
+
+def collect_runner_migration(c, loop, labels: dict) -> None:
+    """Runner-side migration series for one engine loop (called from the
+    OpenAI server's scrape-time collector; plain GIL-atomic reads)."""
+    eng = loop.engine
+    c.counter(
+        MIGRATIONS_EXPORTED,
+        getattr(eng, "num_snapshots_exported", 0), labels,
+        help="Request snapshots exported for cross-runner migration",
+    )
+    c.counter(
+        MIGRATIONS_IMPORTED,
+        getattr(eng, "num_snapshots_imported", 0), labels,
+        help="Request snapshots imported from a peer runner",
+    )
+    c.counter(
+        MIGRATION_FAILURES,
+        getattr(loop, "migration_failures", 0), labels,
+        help="Failed exports/ships/imports (request shed instead)",
+    )
+    c.gauge(
+        MIGRATION_DRAIN_STATE,
+        1 if getattr(loop, "draining", False) else 0, labels,
+        help="1 while this engine loop is draining (shutdown ladder)",
+    )
+
+
+def collect_cp_migration(c, failovers: int, draining: dict) -> None:
+    """Control-plane migration series: mid-stream failover count + the
+    per-runner drain-state gauge (pruned with the runner — ``draining``
+    comes from live router state, the breaker-cardinality rule)."""
+    c.counter(
+        CP_MIDSTREAM_FAILOVERS, failovers,
+        help="Client streams continued on another runner after a "
+             "mid-stream death (resume-from-snapshot or replay)",
+    )
+    for rid, is_draining in sorted(draining.items()):
+        c.gauge(
+            CP_RUNNER_DRAINING, 1 if is_draining else 0,
+            {"runner": rid},
+            help="1 while the runner reports draining in its heartbeat",
+        )
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+
+def migration_timeout() -> float:
+    """HELIX_MIGRATION_TIMEOUT: per-snapshot ship timeout at export AND
+    the TTL an imported request waits for its stream to be claimed."""
+    return float(os.environ.get("HELIX_MIGRATION_TIMEOUT", "30") or 30)
+
+
+def drain_seconds() -> float:
+    """HELIX_DRAIN_SECONDS: graceful-shutdown drain window before
+    survivors are exported (node agent SIGTERM path)."""
+    return float(os.environ.get("HELIX_DRAIN_SECONDS", "10") or 10)
+
+
+def midstream_failover_enabled() -> bool:
+    """HELIX_MIDSTREAM_FAILOVER: opt-in for the control plane's
+    SSE-parsing failover path (resume/replay past the first byte)."""
+    return os.environ.get("HELIX_MIDSTREAM_FAILOVER", "") not in ("", "0")
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+_PAGE_FIELDS = ("k", "v", "k_scale", "v_scale")
+# RequestSnapshot fields covered by the meta checksum (everything except
+# the page payloads, which carry per-page digests of their own)
+_META_FIELDS = (
+    "version", "model", "request_id", "prompt_tokens", "output_tokens",
+    "sampling", "stop_token_ids", "tenant", "trace_id", "sched_class",
+    "max_len", "preempt_count", "position", "last_token", "mrope_delta",
+    "key", "token_counts", "page_size", "num_layers", "kv_heads",
+    "head_dim", "kv_dtype", "page_checksums", "total_pages",
+)
+
+
+def _meta_checksum(doc: dict) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    canon = {
+        k: doc.get(k) for k in _META_FIELDS
+    }
+    h.update(json.dumps(canon, sort_keys=True, default=str).encode())
+    return h.hexdigest()
+
+
+def _encode_array(a) -> Optional[dict]:
+    if a is None:
+        return None
+    a = np.ascontiguousarray(a)
+    return {
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def _decode_array(doc) -> Optional[np.ndarray]:
+    if doc is None:
+        return None
+    try:
+        raw = base64.b64decode(doc["b64"])
+        a = np.frombuffer(raw, dtype=np.dtype(doc["dtype"]))
+        return a.reshape([int(d) for d in doc["shape"]]).copy()
+    except (KeyError, TypeError, ValueError) as e:
+        raise SnapshotError(
+            f"undecodable page buffer: {e}", code="snapshot_corrupt"
+        ) from e
+
+
+def snapshot_to_wire(snap: RequestSnapshot) -> dict:
+    """JSON-safe encoding of a snapshot: scalar fields verbatim, page
+    buffers as base64 with dtype+shape, plus a meta checksum over the
+    scalar fields so header corruption is as detectable as page
+    corruption."""
+    import dataclasses
+
+    doc = dataclasses.asdict(snap)
+    doc["token_counts"] = {
+        str(k): int(v) for k, v in snap.token_counts.items()
+    }
+    doc["pages"] = [
+        {f: _encode_array(p.get(f)) for f in _PAGE_FIELDS}
+        for p in snap.pages
+    ]
+    doc["meta_checksum"] = _meta_checksum(doc)
+    return doc
+
+
+def wire_to_snapshot(doc: dict) -> RequestSnapshot:
+    """Decode + structurally validate one wire document.  Raises
+    ``SnapshotError`` (typed) on version/shape/meta-checksum problems;
+    page-content checksums are verified later by the ENGINE, immediately
+    before any allocator mutation (the import contract)."""
+    if not isinstance(doc, dict):
+        raise SnapshotError("snapshot body must be a JSON object")
+    version = doc.get("version")
+    if version != SNAPSHOT_VERSION:
+        raise SnapshotError(
+            f"snapshot version {version!r} != supported "
+            f"{SNAPSHOT_VERSION}",
+            code="snapshot_unsupported",
+        )
+    claimed = doc.get("meta_checksum")
+    if not claimed or _meta_checksum(doc) != claimed:
+        raise SnapshotError(
+            "snapshot meta checksum mismatch", code="snapshot_corrupt"
+        )
+    counts_doc = doc.get("token_counts") or {}
+    if not isinstance(counts_doc, dict):
+        raise SnapshotError(
+            "token_counts must be an object", code="snapshot_corrupt"
+        )
+    try:
+        token_counts = {int(k): int(v) for k, v in counts_doc.items()}
+    except (TypeError, ValueError) as e:
+        raise SnapshotError(
+            f"undecodable token_counts: {e}", code="snapshot_corrupt"
+        ) from e
+    pages_doc = doc.get("pages") or []
+    pages = [
+        {f: _decode_array((p or {}).get(f)) for f in _PAGE_FIELDS}
+        for p in pages_doc
+    ]
+    try:
+        return RequestSnapshot(
+            version=int(version),
+            model=str(doc.get("model", "")),
+            request_id=str(doc.get("request_id", "")),
+            prompt_tokens=[int(t) for t in doc.get("prompt_tokens", [])],
+            output_tokens=[int(t) for t in doc.get("output_tokens", [])],
+            sampling=dict(doc.get("sampling") or {}),
+            stop_token_ids=[
+                int(t) for t in doc.get("stop_token_ids", [])
+            ],
+            tenant=str(doc.get("tenant", "")),
+            trace_id=str(doc.get("trace_id", "")),
+            sched_class=str(doc.get("sched_class", "")),
+            max_len=(
+                int(doc["max_len"])
+                if doc.get("max_len") is not None else None
+            ),
+            preempt_count=int(doc.get("preempt_count", 0)),
+            position=(
+                int(doc["position"])
+                if doc.get("position") is not None else None
+            ),
+            last_token=(
+                int(doc["last_token"])
+                if doc.get("last_token") is not None else None
+            ),
+            mrope_delta=int(doc.get("mrope_delta", 0)),
+            key=(
+                [int(w) for w in doc["key"]]
+                if doc.get("key") is not None else None
+            ),
+            token_counts=token_counts,
+            page_size=int(doc.get("page_size", 0)),
+            num_layers=int(doc.get("num_layers", 0)),
+            kv_heads=int(doc.get("kv_heads", 0)),
+            head_dim=int(doc.get("head_dim", 0)),
+            kv_dtype=str(doc.get("kv_dtype", "")),
+            pages=pages,
+            page_checksums=[
+                str(s) for s in doc.get("page_checksums", [])
+            ],
+            total_pages=int(doc.get("total_pages", 0) or 0),
+        )
+    except (TypeError, ValueError) as e:
+        raise SnapshotError(
+            f"malformed snapshot field: {e}", code="snapshot_corrupt"
+        ) from e
+
+
+# ---------------------------------------------------------------------------
+# imported-stream registry (runner side)
+# ---------------------------------------------------------------------------
+
+
+class ImportedStream:
+    """Token-event buffer for one migrated-in request.
+
+    The peer engine resumes the request as soon as resources allow —
+    typically before the control plane's resume call lands — so events
+    buffer until exactly one consumer attaches.  Thread contract: the
+    engine thread calls ``on_event``; the aiohttp handler (event loop
+    thread) calls ``attach``."""
+
+    def __init__(self, request_id: str, model: str, prior_tokens: list,
+                 stop: tuple = ()):
+        self.request_id = request_id
+        self.model = model
+        self.prior_tokens = list(prior_tokens)
+        # serving-level stop STRINGS travel with the snapshot: the
+        # resume stream must truncate on them exactly like the ordinary
+        # handler would (engine-side stop_token_ids alone miss them)
+        self.stop = tuple(s for s in (stop or ()) if s)
+        self.created = time.monotonic()
+        self._lock = threading.Lock()
+        self._backlog: list = []
+        self._consumer = None   # (asyncio loop, asyncio.Queue)
+        self.claimed = False
+
+    def on_event(self, ev) -> None:
+        with self._lock:
+            if self._consumer is not None:
+                loop, q = self._consumer
+                loop.call_soon_threadsafe(q.put_nowait, ev)
+            else:
+                self._backlog.append(ev)
+
+    def attach(self, loop, q) -> bool:
+        """Claim the stream (once); backlogged events drain into ``q``
+        first, later events follow live.  False = already claimed."""
+        with self._lock:
+            if self.claimed:
+                return False
+            self.claimed = True
+            for ev in self._backlog:
+                q.put_nowait(ev)
+            self._backlog = []
+            self._consumer = (loop, q)
+            return True
+
+
+class ImportedStreams:
+    """Bounded registry of migrated-in requests awaiting their stream.
+
+    ``sweep`` expires unclaimed entries past the migration timeout and
+    returns them so the caller can abort the now-ownerless requests —
+    an imported request whose control plane never came back must not
+    generate into the void forever."""
+
+    def __init__(self, max_entries: int = 256):
+        self._lock = threading.Lock()
+        self._entries: dict[str, ImportedStream] = {}
+        self.max_entries = max_entries
+
+    def register(self, stream: ImportedStream) -> bool:
+        with self._lock:
+            if len(self._entries) >= self.max_entries:
+                return False
+            self._entries[stream.request_id] = stream
+            return True
+
+    def get(self, request_id: str) -> Optional[ImportedStream]:
+        with self._lock:
+            return self._entries.get(request_id)
+
+    def discard(self, request_id: str) -> None:
+        with self._lock:
+            self._entries.pop(request_id, None)
+
+    def sweep(self, ttl: Optional[float] = None) -> list:
+        """Expired, never-claimed streams (removed from the registry)."""
+        if ttl is None:
+            ttl = migration_timeout()
+        now = time.monotonic()
+        with self._lock:
+            dead = [
+                s for s in self._entries.values()
+                if not s.claimed and now - s.created > ttl
+            ]
+            for s in dead:
+                del self._entries[s.request_id]
+            return dead
+
+
+# ---------------------------------------------------------------------------
+# drain shipper (node-agent side)
+# ---------------------------------------------------------------------------
+
+
+class PeerShipper:
+    """Ships wire snapshots to a peer runner during drain.
+
+    Targets are fetched once per drain from the control plane's
+    migration-targets endpoint (routable, non-draining runners serving
+    an overlapping model set) — or injected directly for tests.  The
+    call contract matches ``EngineLoop.exporter``: given a wire dict,
+    return the peer runner id that accepted it, raise on failure."""
+
+    def __init__(self, control_plane_url: str = "", runner_id: str = "",
+                 runner_token: str = "", targets: Optional[list] = None,
+                 timeout: Optional[float] = None):
+        self.control_plane_url = control_plane_url.rstrip("/")
+        self.runner_id = runner_id
+        self.runner_token = runner_token
+        self._targets = targets
+        self.timeout = timeout if timeout is not None else (
+            migration_timeout()
+        )
+
+    def _headers(self) -> dict:
+        return (
+            {"X-Runner-Token": self.runner_token}
+            if self.runner_token else {}
+        )
+
+    def targets(self) -> list:
+        if self._targets is not None:
+            return self._targets
+        import requests
+
+        r = requests.get(
+            f"{self.control_plane_url}/api/v1/runners/"
+            f"{self.runner_id}/migration-targets",
+            headers=self._headers(), timeout=min(self.timeout, 10.0),
+        )
+        r.raise_for_status()
+        self._targets = [
+            t for t in r.json().get("targets", [])
+            if t.get("address")
+        ]
+        return self._targets
+
+    def __call__(self, wire: dict) -> str:
+        import requests
+
+        model = wire.get("model", "")
+        last_err = "no migration target"
+        for t in self.targets():
+            if model and model not in (t.get("models") or [model]):
+                continue
+            try:
+                r = requests.post(
+                    f"{t['address'].rstrip('/')}/v1/migrate/import",
+                    json=wire, headers=self._headers(),
+                    timeout=self.timeout,
+                )
+                if r.status_code == 200:
+                    return t.get("id", t["address"])
+                last_err = f"{t.get('id')}: HTTP {r.status_code}"
+            except Exception as e:  # noqa: BLE001 — try the next peer
+                last_err = f"{t.get('id')}: {e}"
+        raise RuntimeError(f"snapshot ship failed: {last_err}")
+
+
+# ---------------------------------------------------------------------------
+# SSE plumbing (control-plane mid-stream failover)
+# ---------------------------------------------------------------------------
+
+
+class SSEParser:
+    """Incremental server-sent-events parser: feed raw bytes, get the
+    ``data:`` payload strings of every complete event (``[DONE]``
+    included verbatim).  Partial events stay buffered."""
+
+    def __init__(self):
+        self._buf = b""
+
+    def feed(self, chunk: bytes) -> list:
+        self._buf += chunk
+        out = []
+        while True:
+            # events are \n\n-terminated; tolerate \r\n line endings
+            idx = self._buf.find(b"\n\n")
+            if idx < 0:
+                break
+            raw, self._buf = self._buf[:idx], self._buf[idx + 2:]
+            for line in raw.split(b"\n"):
+                line = line.strip(b"\r")
+                if line.startswith(b"data:"):
+                    out.append(line[5:].strip().decode(
+                        "utf-8", "replace"
+                    ))
+        return out
+
+
+def sse_frame(payload) -> bytes:
+    """One SSE data frame (payload = dict to JSON-encode, or a
+    preformatted string such as ``[DONE]``)."""
+    if not isinstance(payload, str):
+        payload = json.dumps(payload)
+    return f"data: {payload}\n\n".encode()
+
+
+def chunk_delta_text(doc: dict) -> str:
+    """Generated text carried by one OpenAI stream chunk (chat
+    ``delta.content`` or legacy-completions ``text``)."""
+    try:
+        choice = (doc.get("choices") or [{}])[0]
+    except (TypeError, IndexError):
+        return ""
+    if "delta" in choice:
+        return str((choice.get("delta") or {}).get("content") or "")
+    return str(choice.get("text") or "")
+
+
+def chunk_finish_reason(doc: dict) -> Optional[str]:
+    try:
+        choice = (doc.get("choices") or [{}])[0]
+    except (TypeError, IndexError):
+        return None
+    fr = choice.get("finish_reason")
+    return str(fr) if fr else None
+
+
+def make_chunk(template: dict, kind: str, delta_text: str,
+               finish_reason: Optional[str],
+               first: bool = False) -> dict:
+    """Re-materialise a stream chunk in the CLIENT's original frame
+    shape from a neutral (resume) or foreign (replay) delta.
+    ``template`` carries the id/model/created the client has been
+    seeing, captured from the frames forwarded before the death."""
+    if kind == "chat":
+        delta: dict = {}
+        if first:
+            delta["role"] = "assistant"
+        if delta_text:
+            delta["content"] = delta_text
+        return {
+            "id": template.get("id", ""),
+            "object": "chat.completion.chunk",
+            "created": template.get("created", 0),
+            "model": template.get("model", ""),
+            "choices": [
+                {
+                    "index": 0,
+                    "delta": delta,
+                    "finish_reason": finish_reason,
+                }
+            ],
+        }
+    return {
+        "id": template.get("id", ""),
+        "object": "text_completion",
+        "created": template.get("created", 0),
+        "model": template.get("model", ""),
+        "choices": [
+            {
+                "index": 0,
+                "text": delta_text,
+                "finish_reason": finish_reason,
+            }
+        ],
+    }
+
+
+class ElisionTracker:
+    """Exactly-once accounting for a failed-over stream: how many
+    characters of generated text the CLIENT has already received, and
+    the elision of a replayed stream's duplicate head against it.
+
+    ``note_forwarded`` counts what went to the client; after a death,
+    ``elide`` is fed the replacement stream's deltas and returns only
+    the not-yet-delivered suffix (deterministic generation — greedy or
+    seeded — makes the replayed prefix byte-identical, so character
+    arithmetic is exact)."""
+
+    def __init__(self):
+        self.forwarded_chars = 0
+        self._replay_seen = 0
+
+    def note_forwarded(self, text: str) -> None:
+        self.forwarded_chars += len(text)
+
+    def start_replay(self) -> None:
+        self._replay_seen = 0
+
+    def elide(self, text: str) -> str:
+        """The portion of a replayed delta the client has NOT seen."""
+        if not text:
+            return ""
+        start = self._replay_seen
+        self._replay_seen += len(text)
+        skip = self.forwarded_chars - start
+        if skip <= 0:
+            return text
+        if skip >= len(text):
+            return ""
+        return text[skip:]
+
+
+MigrationExporter = Callable[[dict], str]

@@ -172,6 +172,14 @@ class LockstepLeader:
         as an explicit abort)."""
         return None
 
+    # snapshot export/import (ISSUE 11) are leader-local state moves the
+    # journal cannot express — a migrated-away request would keep
+    # decoding on followers, a migrated-in one would exist only on the
+    # leader.  Absent attributes make the engine loop's drain exporter
+    # degrade to the ordinary shed (and imports fail typed).
+    export_request = None
+    import_request = None
+
     # -- passthrough --------------------------------------------------------
     def __getattr__(self, name):
         return getattr(self.engine, name)

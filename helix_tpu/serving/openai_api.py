@@ -24,7 +24,7 @@ from typing import Optional
 from aiohttp import web
 
 from helix_tpu import obs
-from helix_tpu.engine.engine import Request
+from helix_tpu.engine.engine import Request, SnapshotError
 from helix_tpu.engine.sampling import SamplingParams
 from helix_tpu.obs.slo import ANON_TENANT, TENANT_HEADER, sanitize_tenant
 from helix_tpu.serving.sched import CLASS_HEADER, sanitize_class
@@ -33,6 +33,14 @@ from helix_tpu.serving.engine_loop import (
     KV_EXHAUSTED,
     QUEUE_FULL,
     SHUTTING_DOWN,
+)
+from helix_tpu.serving.migration import (
+    MIGRATED,
+    ImportedStream,
+    ImportedStreams,
+    collect_runner_migration,
+    migration_timeout,
+    wire_to_snapshot,
 )
 from helix_tpu.serving.registry import ModelRegistry
 from helix_tpu.serving.tokenizer import IncrementalDetokenizer, _content_text
@@ -105,6 +113,13 @@ def _engine_error_response(e: Exception, trace_id: str = ""):
         return _error(503, msg, "overloaded_error",
                       headers={"Retry-After": "5"}, trace_id=trace_id,
                       request_id=rid)
+    if msg.startswith(MIGRATED):
+        # the request was exported to a peer at the drain deadline
+        # (ISSUE 11): the control plane's mid-stream failover resumes
+        # SSE streams in place; non-stream callers get a typed retry
+        return _error(503, msg, "overloaded_error",
+                      headers={"Retry-After": "1"}, trace_id=trace_id,
+                      request_id=rid, code="migrated")
     if msg.startswith("inter_token_timeout"):
         return _error(504, msg, "timeout_error", trace_id=trace_id,
                       request_id=rid)
@@ -142,6 +157,11 @@ class OpenAIServer:
         self.obs.register_callback(self._collect_metrics)
         self.traces = trace_store or obs.default_store()
         self._profiler_lock = threading.Lock()
+        # migrated-in requests awaiting their resumed stream (ISSUE 11):
+        # the peer engine may start generating before the control plane
+        # attaches, so token events buffer here until /v1/migrate/resume
+        # claims them (or the migration timeout aborts the orphan)
+        self._imported = ImportedStreams()
         # max seconds between consecutive engine events for one request
         # before the server gives up on it (wedged engine watchdog)
         self.inter_token_timeout = (
@@ -173,6 +193,10 @@ class OpenAIServer:
         # admission-decision audit trail: every shed / quarantine /
         # preemption with its tenant + trace id (ISSUE 7)
         app.router.add_get("/v1/debug/admissions", self.debug_admissions)
+        # cross-runner migration (ISSUE 11): a peer ships a request
+        # snapshot in; the control plane re-attaches the client stream
+        app.router.add_post("/v1/migrate/import", self.migrate_import)
+        app.router.add_post("/v1/migrate/resume", self.migrate_resume)
         app.router.add_post("/admin/profiler", self.profiler_capture)
         # multi-host lockstep journal (followers long-poll over DCN;
         # see serving/multihost_serving.py)
@@ -317,6 +341,9 @@ class OpenAIServer:
             sched = getattr(m.loop, "sched", None)
             if sched is not None:
                 sched.collect(c, lbl)
+            # cross-runner migration series (ISSUE 11): minted ONLY by
+            # serving/migration.py (lint contract 6)
+            collect_runner_migration(c, m.loop, lbl)
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 st = pc.stats
@@ -619,6 +646,240 @@ class OpenAIServer:
         if want and not out:
             return _error(404, f"model {want!r} has no admission audit")
         return web.json_response({"models": out})
+
+    # -- cross-runner migration (ISSUE 11) --------------------------------
+
+    def _sweep_imports(self) -> None:
+        """Abort imported requests whose stream was never claimed within
+        the migration timeout — a peer must not generate into the void
+        because the control plane that planned to resume went away."""
+        for stream in self._imported.sweep():
+            served = self.registry.get(stream.model)
+            if served is not None and served.loop is not None:
+                served.loop.abort(stream.request_id)
+                served.loop.migration_failures += 1
+
+    async def migrate_import(self, request):
+        """Accept one request snapshot from a peer runner (the drain
+        ladder's ship step).  Runner-token gated — migration is
+        cluster-internal traffic.  The snapshot is decoded, then
+        re-admitted on the engine thread where EVERY page checksum is
+        verified before any allocator mutation; a corrupt or
+        incompatible snapshot fails typed (422) and touches nothing.
+        On success the request parks until resources free (a full
+        engine queues it behind admission) and its token events buffer
+        until ``/v1/migrate/resume`` attaches."""
+        denied = self._require_runner_token(request)
+        if denied is not None:
+            return denied
+        self._sweep_imports()
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — client error
+            return _error(400, "invalid JSON body")
+        try:
+            snap = wire_to_snapshot(body)
+        except SnapshotError as e:
+            return _error(422, str(e), "invalid_request_error",
+                          code=e.code)
+        served, err = await self._lookup(snap.model)
+        if err is not None:
+            return err
+        err = self._require_loop(served, snap.model)
+        if err is not None:
+            return err
+        stream = ImportedStream(
+            snap.request_id, snap.model, snap.output_tokens,
+            stop=tuple(snap.sampling.get("stop") or ()),
+        )
+        if not self._imported.register(stream):
+            return _error(
+                429, "too many unclaimed imported requests",
+                "overloaded_error", headers={"Retry-After": "2"},
+            )
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+
+        def on_result(err_msg, code):
+            def settle():
+                if not fut.done():
+                    fut.set_result((err_msg, code))
+
+            loop.call_soon_threadsafe(settle)
+
+        served.loop.submit_import(snap, stream.on_event,
+                                  on_result=on_result)
+        try:
+            err_msg, code = await asyncio.wait_for(
+                fut, timeout=migration_timeout()
+            )
+        except asyncio.TimeoutError:
+            # the source treats 504 as a failed ship and may re-ship
+            # elsewhere — abort the (possibly later-admitted) request so
+            # an unregistered orphan can never keep generating here
+            self._imported.discard(snap.request_id)
+            served.loop.abort(snap.request_id)
+            return _error(
+                504, "import was not admitted in time", "timeout_error"
+            )
+        if err_msg is not None:
+            self._imported.discard(snap.request_id)
+            status = 503 if code == "shutting_down" else 422
+            return _error(
+                status, err_msg, "invalid_request_error",
+                code=code or "snapshot_invalid",
+            )
+        return web.json_response(
+            {
+                "ok": True,
+                "request_id": snap.request_id,
+                "model": snap.model,
+                "prior_tokens": len(snap.output_tokens),
+            }
+        )
+
+    async def migrate_resume(self, request):
+        """Attach the client stream to a migrated-in request.
+
+        The control plane calls this after a clean source drain: the
+        body names the engine request id and how many characters of
+        generated text the CLIENT has already received.  The response
+        is a neutral SSE delta stream — first the catch-up slice (text
+        the source engine emitted but the client never saw), then live
+        deltas — which the control plane re-wraps in the client's
+        original chunk shape.  Exactly-once: the snapshot's prior
+        tokens seed the detokenizer, so character arithmetic against
+        ``emitted_chars`` is exact."""
+        denied = self._require_runner_token(request)
+        if denied is not None:
+            return denied
+        self._sweep_imports()
+        try:
+            body = await request.json()
+        except Exception:  # noqa: BLE001 — client error
+            return _error(400, "invalid JSON body")
+        rid = str(body.get("request_id", ""))
+        try:
+            emitted_chars = max(0, int(body.get("emitted_chars", 0) or 0))
+        except (TypeError, ValueError):
+            return _error(400, "'emitted_chars' must be an integer")
+        stream = self._imported.get(rid)
+        if stream is None:
+            return _error(
+                404, f"no imported request {rid!r} awaiting resume"
+            )
+        served, err = await self._lookup(stream.model)
+        if err is not None:
+            return err
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+        if not stream.attach(loop, q):
+            return _error(409, f"request {rid!r} was already resumed")
+        self._imported.discard(rid)
+        detok = IncrementalDetokenizer(served.tokenizer)
+        prior = ""
+        for t in stream.prior_tokens:
+            if t not in served.tokenizer.eos_ids:
+                prior += detok.push(t)
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            }
+        )
+        await resp.prepare(request)
+
+        async def send(obj):
+            await resp.write(f"data: {json.dumps(obj)}\n\n".encode())
+
+        finished = False
+        stops = stream.stop
+        full = prior                      # everything generated so far
+        sent = min(emitted_chars, len(prior))   # chars the client has
+
+        def stop_hit(scan_from: int):
+            """Earliest stop-string index at/after ``scan_from`` (a
+            stop may SPAN the migration point, so matches straddle the
+            prior/resumed boundary)."""
+            hit = None
+            for s in stops:
+                idx = full.find(s, max(0, scan_from - len(s)))
+                if idx >= 0:
+                    hit = idx if hit is None else min(hit, idx)
+            return hit
+
+        try:
+            # stop already completed in the prior text (defensive: the
+            # source's HTTP handler normally catches this pre-export)
+            hit = stop_hit(0)
+            if hit is not None:
+                finished = True
+                served.loop.abort(rid)
+                await send(
+                    {"request_id": rid, "delta": full[sent:hit],
+                     "finish_reason": "stop"}
+                )
+            elif len(full) > sent:
+                # catch-up: text the source engine emitted that the
+                # client never saw
+                await send(
+                    {"request_id": rid, "delta": full[sent:],
+                     "catchup": True, "finish_reason": None}
+                )
+                sent = len(full)
+            while not finished:
+                try:
+                    ev = await asyncio.wait_for(
+                        q.get(), timeout=self.inter_token_timeout
+                    )
+                except asyncio.TimeoutError:
+                    await send(
+                        {"request_id": rid,
+                         "error": {"message": "inter_token_timeout on "
+                                              "resumed stream"}}
+                    )
+                    break
+                if ev.error:
+                    finished = True
+                    await send(
+                        {"request_id": rid,
+                         "error": {"message": ev.error}}
+                    )
+                    break
+                is_eos = ev.token_id in served.tokenizer.eos_ids
+                prev = len(full)
+                delta = "" if is_eos else detok.push(ev.token_id)
+                full += delta
+                hit = stop_hit(prev)
+                if hit is not None:
+                    # serving-level stop string: truncate exactly like
+                    # the ordinary stream handler would have
+                    finished = True
+                    served.loop.abort(rid)
+                    await send(
+                        {"request_id": rid,
+                         "delta": full[min(sent, hit):hit],
+                         "finish_reason": "stop"}
+                    )
+                    break
+                await send(
+                    {
+                        "request_id": rid,
+                        "delta": full[sent:],
+                        "finish_reason": (
+                            ev.finish_reason if ev.finished else None
+                        ),
+                    }
+                )
+                sent = len(full)
+                if ev.finished:
+                    finished = True
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        finally:
+            if not finished and served.loop is not None:
+                served.loop.abort(rid)
+        return resp
 
     async def profiler_capture(self, request):
         """On-demand ``jax.profiler`` capture against the live runner:

@@ -24,6 +24,7 @@ Rule shapes (dicts, JSON-friendly for the env var)::
     {"point": "dispatch", "runner": "*", "mode": "http_500", "times": 4}
     {"point": "dispatch", "runner": "r2", "mode": "slow_first_byte",
      "delay": 0.5}
+    {"point": "stream", "runner": "r1", "after_chunks": 2, "times": 1}
     {"point": "heartbeat", "runner": "r1"}          # drop heartbeats
     {"point": "host_pool", "op": "restore", "mode": "slow", "delay": 0.2}
     {"point": "host_pool", "op": "restore", "mode": "corrupt", "times": 1}
@@ -161,6 +162,27 @@ class FaultInjector:
                     "delay": float(rule.get("delay", 0.0)),
                     "runner": runner_id,
                 }
+        return None
+
+    def stream_kill_after(self, runner_id: str) -> Optional[int]:
+        """Mid-stream runner-death injection (ISSUE 11): how many SSE
+        payloads the dispatch copy loop should forward before the
+        stream dies, or None.  Consumed ONCE per stream (the dispatcher
+        asks at stream start), so ``times`` counts streams killed, not
+        chunks.  Rule shape::
+
+            {"point": "stream", "runner": "r1", "after_chunks": 2,
+             "times": 1}
+        """
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "stream":
+                    continue
+                if rule.get("runner", "*") not in ("*", runner_id):
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                return int(rule.get("after_chunks", 1))
         return None
 
     def host_pool_fault(self, op: str) -> Optional[dict]:

@@ -1,0 +1,946 @@
+"""Crash-tolerant serving (ISSUE 11): portable request snapshots,
+cross-runner migration, and mid-stream failover.
+
+Fast lane:
+
+- **snapshot round-trip bit-identity**: export a mid-generation request
+  from engine A, import into engine B, continue — the combined token
+  stream equals an uninterrupted reference run bit-for-bit (greedy,
+  seeded temp>0 with penalties, int8 KV pool);
+- **corrupt snapshots fail typed** before any allocator mutation (page
+  checksum + meta checksum + version gate);
+- **import on a full engine queues behind admission** instead of
+  wedging;
+- **drain-deadline export** at the engine-loop level: survivors ship to
+  a peer loop, clients see exactly-once tokens across the migration;
+- **router drain semantics**: draining runners are unroutable for new
+  work (half-open breaker probes included), malformed heartbeat flags
+  degrade to false, cluster-wide drain answers 503 ``code=draining``
+  with an honest Retry-After;
+- **mid-stream failover over real HTTP** (cp + two runners,
+  ``HELIX_MIDSTREAM_FAILOVER=1``): a runner killed past the first byte
+  -> the client stream completes with greedy output bit-identical to an
+  uninterrupted run; a clean drain resumes the stream from the shipped
+  snapshot on the peer;
+- **lint contract 6**: migration/drain metric literals outside
+  ``serving/migration.py`` fail the build.
+
+Slow lane: ``tools/chaos_soak.py --scenario crash`` (repeated
+crash-drains against a standby, bit-identity asserted per migrated
+stream).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+requests = pytest.importorskip("requests")
+
+from helix_tpu.control.router import BreakerConfig, InferenceRouter
+from helix_tpu.engine.engine import (
+    SNAPSHOT_VERSION,
+    Engine,
+    EngineConfig,
+    Request,
+    SnapshotError,
+)
+from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.models.common import ModelConfig
+from helix_tpu.models.llama import init_params
+from helix_tpu.serving import migration
+from helix_tpu.serving.engine_loop import EngineLoop
+from helix_tpu.serving.tokenizer import ByteTokenizer
+from helix_tpu.testing import faults
+
+pytestmark = pytest.mark.chaos
+
+_TOK = ByteTokenizer()
+_CFG = ModelConfig.tiny(vocab_size=512, dtype="float32")
+_PARAMS = None
+
+
+def _params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = init_params(_CFG, jax.random.PRNGKey(7))
+    return _PARAMS
+
+
+def _engine(dtype="auto", num_pages=64, max_pages=16, batch=4,
+            eos=(), name=None):
+    import dataclasses
+
+    cfg = _CFG if name is None else dataclasses.replace(_CFG, name=name)
+    return Engine(
+        cfg, _params(),
+        EngineConfig(
+            max_decode_batch=batch, page_size=4, num_pages=num_pages,
+            max_pages_per_seq=max_pages, max_prefill_len=64,
+            attn_backend="reference", eos_token_ids=tuple(eos),
+            kv_cache_dtype=dtype,
+        ),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+def _run_to_finish(engine, req):
+    engine.add_request(req)
+    while not req.finished:
+        engine.step()
+    return list(req.output_tokens)
+
+
+def _roundtrip(eng_ref, eng_a, eng_b, samp, rid, cut=6,
+               through_wire=True):
+    """Export ``rid`` from A after ``cut`` tokens, import into B,
+    continue — returns (reference tokens, combined tokens)."""
+    prompt = list(range(7, 30))
+    ref = _run_to_finish(
+        eng_ref,
+        Request(id=f"ref-{rid}", prompt_tokens=list(prompt),
+                sampling=samp),
+    )
+    req_a = Request(id=rid, prompt_tokens=list(prompt), sampling=samp,
+                    tenant="tenant-x", trace_id="trace-x",
+                    sched_class="interactive")
+    eng_a.add_request(req_a)
+    while len(req_a.output_tokens) < cut and eng_a.has_work():
+        eng_a.step()
+    snap = eng_a.export_request(rid)
+    assert snap is not None and snap.has_kv
+    eng_a.abort(rid)
+    while eng_a.has_work():   # flush any batchmates
+        eng_a.step()
+    if through_wire:
+        snap = migration.wire_to_snapshot(migration.snapshot_to_wire(snap))
+    req_b = eng_b.import_request(snap)
+    assert req_b.tenant == "tenant-x"
+    assert req_b.sched_class == "interactive"
+    while not req_b.finished:
+        eng_b.step()
+    return ref, req_a.output_tokens[:cut] + req_b.output_tokens[cut:]
+
+
+@pytest.fixture(scope="module")
+def triple():
+    """(reference, exporter, importer) engines sharing one weight set."""
+    return _engine(), _engine(), _engine()
+
+
+class TestSnapshotRoundTrip:
+    def test_greedy_bit_identity(self, triple):
+        ref, got = _roundtrip(
+            *triple, SamplingParams(temperature=0.0, max_tokens=18),
+            "mig-greedy",
+        )
+        assert got == ref
+
+    def test_seeded_temperature_and_penalties_bit_identity(self, triple):
+        ref, got = _roundtrip(
+            *triple,
+            SamplingParams(
+                temperature=0.9, top_p=0.9, seed=1234,
+                presence_penalty=0.4, frequency_penalty=0.3,
+                max_tokens=18,
+            ),
+            "mig-seeded",
+        )
+        assert got == ref
+
+    def test_int8_pool_bit_identity(self):
+        a, b, r = (_engine(dtype="int8") for _ in range(3))
+        ref, got = _roundtrip(
+            r, a, b, SamplingParams(temperature=0.0, max_tokens=16),
+            "mig-int8",
+        )
+        assert got == ref
+
+    def test_wire_roundtrip_preserves_pages(self, triple):
+        _ref, eng_a, _b = triple
+        req = Request(
+            id="wire-1", prompt_tokens=list(range(40, 60)),
+            sampling=SamplingParams(temperature=0.0, max_tokens=12),
+        )
+        eng_a.add_request(req)
+        while len(req.output_tokens) < 4 and eng_a.has_work():
+            eng_a.step()
+        snap = eng_a.export_request("wire-1")
+        eng_a.abort("wire-1")
+        while eng_a.has_work():
+            eng_a.step()
+        wire = migration.snapshot_to_wire(snap)
+        # the wire doc is pure JSON
+        decoded = migration.wire_to_snapshot(json.loads(json.dumps(wire)))
+        assert decoded.version == SNAPSHOT_VERSION
+        assert decoded.page_checksums == snap.page_checksums
+        for orig, back in zip(snap.pages, decoded.pages):
+            np.testing.assert_array_equal(orig["k"], back["k"])
+            np.testing.assert_array_equal(orig["v"], back["v"])
+        assert decoded.token_counts == snap.token_counts
+        assert decoded.key == snap.key
+
+    def test_wrong_version_rejected(self, triple):
+        _r, eng_a, eng_b = triple
+        req = Request(
+            id="ver-1", prompt_tokens=list(range(40, 60)),
+            sampling=SamplingParams(temperature=0.0, max_tokens=8),
+        )
+        eng_a.add_request(req)
+        while len(req.output_tokens) < 2 and eng_a.has_work():
+            eng_a.step()
+        wire = migration.snapshot_to_wire(eng_a.export_request("ver-1"))
+        eng_a.abort("ver-1")
+        while eng_a.has_work():
+            eng_a.step()
+        wire["version"] = SNAPSHOT_VERSION + 1
+        with pytest.raises(SnapshotError) as ei:
+            migration.wire_to_snapshot(wire)
+        assert ei.value.code == "snapshot_unsupported"
+
+    def test_corrupt_page_rejected_before_allocator_mutation(self):
+        eng_a, eng_b = _engine(), _engine()   # fresh: prove zero churn
+        req = Request(
+            id="cor-1", prompt_tokens=list(range(7, 30)),
+            sampling=SamplingParams(temperature=0.0, max_tokens=12),
+        )
+        eng_a.add_request(req)
+        while len(req.output_tokens) < 4 and eng_a.has_work():
+            eng_a.step()
+        snap = eng_a.export_request("cor-1")
+        k = np.array(snap.pages[0]["k"])
+        k.view(np.uint8).reshape(-1)[0] ^= 0xFF
+        snap.pages[0]["k"] = k
+        free0 = eng_b.allocator.free_pages
+        with pytest.raises(SnapshotError) as ei:
+            eng_b.import_request(snap)
+        assert ei.value.code == "snapshot_corrupt"
+        assert eng_b.allocator.free_pages == free0
+        assert not eng_b.has_work()
+        assert eng_b.get_request("cor-1") is None
+
+    def test_meta_corruption_rejected(self, triple):
+        _r, eng_a, _b = triple
+        req = Request(
+            id="meta-1", prompt_tokens=list(range(7, 30)),
+            sampling=SamplingParams(temperature=0.0, max_tokens=8),
+        )
+        eng_a.add_request(req)
+        while len(req.output_tokens) < 2 and eng_a.has_work():
+            eng_a.step()
+        wire = migration.snapshot_to_wire(eng_a.export_request("meta-1"))
+        eng_a.abort("meta-1")
+        while eng_a.has_work():
+            eng_a.step()
+        wire["output_tokens"] = wire["output_tokens"] + [1]  # tamper
+        with pytest.raises(SnapshotError) as ei:
+            migration.wire_to_snapshot(wire)
+        assert ei.value.code == "snapshot_corrupt"
+
+    def test_queued_request_snapshots_without_kv(self, triple):
+        _ref, eng_a, eng_b = triple
+        req = Request(
+            id="q-1", prompt_tokens=[1, 2, 3, 4],
+            sampling=SamplingParams(temperature=0.0, max_tokens=6),
+        )
+        eng_a.add_request(req)   # never stepped: still queued
+        snap = eng_a.export_request("q-1")
+        assert snap is not None and not snap.has_kv
+        ref = _run_to_finish(
+            eng_b,
+            Request(id="q-ref", prompt_tokens=[1, 2, 3, 4],
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_tokens=6)),
+        )
+        wire = migration.wire_to_snapshot(migration.snapshot_to_wire(snap))
+        req_b = eng_b.import_request(wire)
+        while not req_b.finished:
+            eng_b.step()
+        assert req_b.output_tokens == ref
+
+    def test_export_ships_only_written_pages(self):
+        """Wire size scales with PROGRESS, not max_tokens: a request
+        with a big token budget ships only the pages holding written
+        KV, the peer allocates the full capacity fresh, and the
+        continuation is still bit-identical."""
+        eng_a, eng_b, eng_r = (
+            _engine(max_pages=32) for _ in range(3)
+        )
+        samp = SamplingParams(temperature=0.0, max_tokens=100)
+        prompt = list(range(7, 30))
+        ref = _run_to_finish(
+            eng_r,
+            Request(id="trim-ref", prompt_tokens=list(prompt),
+                    sampling=samp),
+        )
+        req = Request(id="trim-1", prompt_tokens=list(prompt),
+                      sampling=samp)
+        eng_a.add_request(req)
+        while len(req.output_tokens) < 6 and eng_a.has_work():
+            eng_a.step()
+        cut = len(req.output_tokens)
+        snap = eng_a.export_request("trim-1")
+        assert len(snap.pages) <= -(-req.num_tokens // 4) # written only
+        assert snap.total_pages > len(snap.pages)         # budget tail
+        req_b = eng_b.import_request(
+            migration.wire_to_snapshot(migration.snapshot_to_wire(snap))
+        )
+        while not req_b.finished:
+            eng_b.step()
+        assert req.output_tokens[:cut] + req_b.output_tokens[cut:] == ref
+
+    def test_geometry_mismatch_rejected(self, triple):
+        _r, eng_a, _b = triple
+        req = Request(
+            id="geo-1", prompt_tokens=list(range(7, 30)),
+            sampling=SamplingParams(temperature=0.0, max_tokens=8),
+        )
+        eng_a.add_request(req)
+        while len(req.output_tokens) < 2 and eng_a.has_work():
+            eng_a.step()
+        snap = eng_a.export_request("geo-1")
+        eng_a.abort("geo-1")
+        while eng_a.has_work():
+            eng_a.step()
+        snap.page_size = 8   # lie about geometry
+        other = _engine()
+        with pytest.raises(SnapshotError) as ei:
+            other.import_request(snap)
+        assert ei.value.code == "snapshot_incompatible"
+
+
+class TestImportQueueing:
+    def test_import_on_full_engine_queues_behind_admission(self):
+        """A KV-carrying import that cannot allocate parks on the
+        preempted list and re-admits when pages free — never wedges,
+        never steals the running request's pages."""
+        eng_a = _engine()
+        req = Request(
+            id="full-1", prompt_tokens=list(range(7, 30)),
+            sampling=SamplingParams(temperature=0.0, max_tokens=30),
+        )
+        eng_a.add_request(req)
+        while len(req.output_tokens) < 6 and eng_a.has_work():
+            eng_a.step()
+        snap = eng_a.export_request("full-1")
+        # importer: a pool with JUST enough pages for one hog
+        eng_b = _engine(num_pages=16, max_pages=14, batch=1)
+        hog = Request(
+            id="hog", prompt_tokens=list(range(30, 60)),
+            sampling=SamplingParams(temperature=0.0, max_tokens=18),
+        )
+        eng_b.add_request(hog)
+        while hog.slot is None:
+            eng_b.step()
+        req_b = eng_b.import_request(snap)
+        for _ in range(4):   # import stays parked while the hog runs
+            eng_b.step()
+        assert req_b.slot is None and not req_b.finished
+        assert len(eng_b.preempted) == 1
+        while not req_b.finished:   # hog finishes -> import resumes
+            eng_b.step()
+        assert hog.finished
+        assert req_b.output_tokens[:6] == req.output_tokens[:6]
+
+
+class TestDrainExport:
+    def _client(self):
+        state = {"tokens": [], "errors": [], "done": threading.Event()}
+
+        def on_event(ev):
+            if ev.token_id >= 0:
+                state["tokens"].append(ev.token_id)
+            if ev.error:
+                state["errors"].append(ev.error)
+            if ev.finished:
+                state["done"].set()
+
+        return state, on_event
+
+    def test_drain_deadline_exports_survivors_exactly_once(self):
+        ref = _run_to_finish(
+            _engine(max_pages=32),
+            Request(id="ref", prompt_tokens=list(range(7, 30)),
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_tokens=70)),
+        )
+        loop_a = EngineLoop(_engine(max_pages=32), "a").start()
+        loop_b = EngineLoop(_engine(max_pages=32), "b").start()
+        b_state, b_on = self._client()
+
+        def exporter(wire):
+            snap = migration.wire_to_snapshot(wire)
+            res = []
+            loop_b.submit_import(
+                snap, b_on, on_result=lambda e, c: res.append(e)
+            )
+            deadline = time.monotonic() + 10
+            while not res and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert res and res[0] is None, res
+            return "peer-b"
+
+        loop_a.exporter = exporter
+        a_state, a_on = self._client()
+        try:
+            loop_a.submit(
+                Request(id="drain-1", prompt_tokens=list(range(7, 30)),
+                        sampling=SamplingParams(temperature=0.0,
+                                                max_tokens=70)),
+                a_on,
+            )
+            while len(a_state["tokens"]) < 5:
+                time.sleep(0.01)
+            loop_a.stop(drain=0.01, join=True)
+            assert a_state["done"].wait(10)
+            assert a_state["errors"], "no terminal event on the source"
+            assert a_state["errors"][0].startswith(migration.MIGRATED)
+            assert migration.parse_migrated_peer(
+                a_state["errors"][0]
+            ) == "peer-b"
+            assert b_state["done"].wait(30), "peer never finished"
+            combined = a_state["tokens"] + b_state["tokens"]
+            assert combined == ref    # exactly-once, bit-identical
+            st = loop_b.stats()["migration"]
+            assert st["imported"] == 1
+            assert loop_a.stats()["migration"]["exported"] == 1
+        finally:
+            loop_a.stop(join=False)
+            loop_b.stop(join=False)
+
+    def test_ship_failure_degrades_to_shed(self):
+        loop_a = EngineLoop(_engine(max_pages=32), "a2").start()
+
+        def exporter(_wire):
+            raise RuntimeError("no peer reachable")
+
+        loop_a.exporter = exporter
+        state, on_event = self._client()
+        try:
+            loop_a.submit(
+                Request(id="noship-1", prompt_tokens=list(range(7, 30)),
+                        sampling=SamplingParams(temperature=0.0,
+                                                max_tokens=60)),
+                on_event,
+            )
+            while len(state["tokens"]) < 3:
+                time.sleep(0.01)
+            loop_a.stop(drain=0.01, join=True)
+            assert state["done"].wait(10)
+            assert state["errors"]
+            assert not state["errors"][0].startswith(migration.MIGRATED)
+            assert loop_a.migration_failures == 1
+        finally:
+            loop_a.stop(join=False)
+
+
+class TestRouterDraining:
+    def _router(self):
+        t = [1000.0]
+        r = InferenceRouter(
+            ttl_seconds=90.0,
+            breaker=BreakerConfig(min_samples=2, failure_threshold=0.5,
+                                  cooldown=5.0),
+            clock=lambda: t[0],
+        )
+        return r, t
+
+    def _beat(self, r, rid, draining=False, deadline=0.0,
+              address="http://x"):
+        r.upsert_from_heartbeat(
+            rid, models=["m"], profile_name="p",
+            profile_status="running", meta={"address": address},
+            draining=draining, drain_deadline=deadline,
+        )
+
+    def test_pick_runner_skips_draining(self):
+        r, _t = self._router()
+        self._beat(r, "r1", draining=True)
+        self._beat(r, "r2")
+        for _ in range(6):
+            st = r.pick_runner("m")
+            assert st is not None and st.id == "r2"
+
+    def test_half_open_probe_not_burned_on_draining_runner(self):
+        """A draining runner in half-open must not receive (and burn)
+        breaker probes — the probe budget goes to nobody, and traffic
+        goes to the healthy runner."""
+        r, t = self._router()
+        self._beat(r, "r1")
+        self._beat(r, "r2")
+        # trip r1's breaker
+        for _ in range(4):
+            r.record_dispatch_start("r1")
+            r.record_failure("r1")
+        assert r.breaker_states()["r1"]["state"] == "open"
+        t[0] += 6.0   # past cooldown: r1 would be half-open/probeable
+        self._beat(r, "r1", draining=True)
+        self._beat(r, "r2")
+        for _ in range(6):
+            st = r.pick_runner("m")
+            assert st is not None and st.id == "r2"
+        assert r.breaker_states()["r1"]["probe_successes"] == 0
+        # ...and the moment the drain clears, probes may flow again
+        self._beat(r, "r1")
+        picked = {r.pick_runner("m").id for _ in range(6)}
+        assert "r1" in picked
+
+    def test_drain_retry_after_honest(self):
+        r, _t = self._router()
+        now = time.time()
+        self._beat(r, "r1", draining=True, deadline=now + 7.0)
+        self._beat(r, "r2", draining=True, deadline=now + 12.0)
+        ra = r.drain_retry_after("m")
+        assert ra is not None and 10 <= ra <= 14
+        # one healthy runner -> not a cluster-wide drain
+        self._beat(r, "r3")
+        assert r.drain_retry_after("m") is None
+        # unknown model -> None (ordinary 404 path)
+        assert r.drain_retry_after("nope") is None
+
+    def test_drain_retry_after_default_without_deadline(self):
+        r, _t = self._router()
+        self._beat(r, "r1", draining=True)
+        assert r.drain_retry_after("m") == 5
+
+    def test_migration_targets(self):
+        r, _t = self._router()
+        self._beat(r, "r1", draining=True)
+        self._beat(r, "r2")
+        self._beat(r, "r3", address="")      # tunnel-only: no address
+        self._beat(r, "r4")
+        targets = r.migration_targets("r4")
+        ids = [t["id"] for t in targets]
+        assert ids == ["r2"]
+        assert targets[0]["models"] == ["m"]
+
+    def test_draining_map_prunes_with_runner(self):
+        r, t = self._router()
+        self._beat(r, "r1", draining=True)
+        assert r.draining_map() == {"r1": True}
+        t[0] += 1000.0
+        r.evict_stale()
+        assert r.draining_map() == {}
+
+
+# ---------------------------------------------------------------------------
+# HTTP spine: cp + two runners, mid-stream failover + drain semantics
+# ---------------------------------------------------------------------------
+
+
+def _serve_app(app, holder):
+    started = threading.Event()
+    box = {}
+
+    def run():
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        from aiohttp import web
+
+        runner = web.AppRunner(app)
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        loop.run_until_complete(site.start())
+        box["port"] = site._server.sockets[0].getsockname()[1]
+        holder.setdefault("loops", []).append(loop)
+        started.set()
+        loop.run_forever()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert started.wait(10)
+    return box["port"]
+
+
+@pytest.fixture(scope="module")
+def spine():
+    """Two runner servers (same weights: greedy output identical) + a
+    control plane with mid-stream failover armed.  Models: ``m1`` is
+    routed to BOTH runners (replay failover), ``m2`` is routed only to
+    runner 1 but SERVED by runner 2 too (clean-drain resume target)."""
+    from helix_tpu.control.server import ControlPlane
+    from helix_tpu.serving.openai_api import OpenAIServer
+    from helix_tpu.serving.registry import ModelRegistry, ServedModel
+
+    prior_env = os.environ.get("HELIX_MIDSTREAM_FAILOVER")
+    os.environ["HELIX_MIDSTREAM_FAILOVER"] = "1"
+    holder: dict = {}
+    sides = {}
+    for side in ("r1", "r2"):
+        registry = ModelRegistry()
+        loops = {}
+        for name in ("m1", "m2"):
+            loop = EngineLoop(
+                _engine(max_pages=32, eos=_TOK.eos_ids, name=name),
+                f"{side}-{name}",
+            ).start()
+            loops[name] = loop
+            registry.register(
+                ServedModel(name=name, loop=loop, tokenizer=_TOK,
+                            context_length=256)
+            )
+        api = OpenAIServer(registry)
+        port = _serve_app(api.build_app(), holder)
+        sides[side] = {
+            "registry": registry,
+            "loops": loops,
+            "api": api,
+            "url": f"http://127.0.0.1:{port}",
+        }
+    cp = ControlPlane()
+    cp_port = _serve_app(cp.build_app(), holder)
+    cp_url = f"http://127.0.0.1:{cp_port}"
+
+    def heartbeat(rid, models, draining=False, deadline=0.0):
+        r = requests.post(
+            f"{cp_url}/api/v1/runners/{rid}/heartbeat",
+            json={
+                "runner_id": rid,
+                "address": sides[rid]["url"] if rid in sides else "",
+                "accelerators": [],
+                "profile": {"name": "p", "status": "running",
+                            "models": models},
+                "saturation": {},
+                "draining": draining,
+                "drain_deadline_ts": deadline,
+            },
+            timeout=10,
+        )
+        assert r.status_code == 200, r.text
+        return r
+
+    heartbeat("r1", ["m1", "m2"])
+    heartbeat("r2", ["m1"])
+    from types import SimpleNamespace
+
+    yield SimpleNamespace(
+        sides=sides, cp=cp, cp_url=cp_url, heartbeat=heartbeat,
+    )
+    if prior_env is None:
+        os.environ.pop("HELIX_MIDSTREAM_FAILOVER", None)
+    else:
+        os.environ["HELIX_MIDSTREAM_FAILOVER"] = prior_env
+    cp.stop()
+    for side in sides.values():
+        for loop in side["loops"].values():
+            loop.stop(join=False)
+    for lp in holder.get("loops", []):
+        lp.call_soon_threadsafe(lp.stop)
+
+
+_MSG = [{"role": "user", "content": "migrate me across the fleet"}]
+
+
+def _reference_content(url, model, max_tokens):
+    r = requests.post(
+        f"{url}/v1/chat/completions",
+        json={"model": model, "temperature": 0, "max_tokens": max_tokens,
+              "messages": _MSG},
+        timeout=120,
+    )
+    assert r.status_code == 200, r.text
+    return r.json()["choices"][0]["message"]["content"]
+
+
+def _stream_chat(url, model, max_tokens, on_delta=None, timeout=120):
+    """Stream a chat completion; returns (content, finish_reason,
+    error-frames)."""
+    content, errors, finish = [], [], [None]
+    with requests.post(
+        f"{url}/v1/chat/completions",
+        json={"model": model, "temperature": 0, "max_tokens": max_tokens,
+              "stream": True, "messages": _MSG},
+        stream=True, timeout=timeout,
+    ) as r:
+        assert r.status_code == 200, r.text
+        for line in r.iter_lines():
+            if not line or not line.startswith(b"data:"):
+                continue
+            payload = line[5:].strip()
+            if payload == b"[DONE]":
+                break
+            doc = json.loads(payload)
+            if "error" in doc:
+                errors.append(doc["error"])
+                continue
+            delta = doc["choices"][0]["delta"].get("content", "")
+            if delta:
+                content.append(delta)
+                if on_delta is not None:
+                    on_delta("".join(content))
+            if doc["choices"][0].get("finish_reason"):
+                finish[0] = doc["choices"][0]["finish_reason"]
+    return "".join(content), finish[0], errors
+
+
+class TestMidstreamFailoverHTTP:
+    def test_killed_runner_mid_stream_replays_exactly_once(self, spine):
+        """The headline acceptance: a runner dies past the first byte,
+        the client stream continues on the survivor, and the delivered
+        greedy text is bit-identical to an uninterrupted run — no
+        duplicated, missing, or diverged characters."""
+        ref = _reference_content(spine.sides["r1"]["url"], "m1", 40)
+        assert ref == _reference_content(spine.sides["r2"]["url"],
+                                         "m1", 40)
+        before = spine.cp.cp_midstream_failovers
+        faults.arm(
+            seed=3,
+            rules=[{"point": "stream", "runner": "*",
+                    "after_chunks": 3, "times": 1}],
+        )
+        content, finish, errors = _stream_chat(spine.cp_url, "m1", 40)
+        assert errors == [], errors
+        assert content == ref
+        assert finish in ("stop", "length")
+        assert spine.cp.cp_midstream_failovers == before + 1
+
+    def test_clean_drain_resumes_from_snapshot_on_peer(self, spine):
+        """Graceful drain mid-stream: the source exports the request's
+        snapshot to the peer, the cp resumes the SSE stream there via
+        /v1/migrate/resume, and the client sees one continuous
+        exactly-once stream."""
+        ref = _reference_content(spine.sides["r2"]["url"], "m2", 110)
+        loop1 = spine.sides["r1"]["loops"]["m2"]
+        loop2 = spine.sides["r2"]["loops"]["m2"]
+        imported_before = loop2.stats()["migration"]["imported"]
+        loop1.exporter = migration.PeerShipper(
+            targets=[{
+                "id": "r2",
+                "address": spine.sides["r2"]["url"],
+                "models": ["m1", "m2"],
+            }]
+        )
+        seen = threading.Event()
+
+        def on_delta(_acc):
+            seen.set()
+
+        result = {}
+
+        def run_stream():
+            result["out"] = _stream_chat(
+                spine.cp_url, "m2", 110, on_delta=on_delta
+            )
+
+        t = threading.Thread(target=run_stream)
+        t.start()
+        assert seen.wait(60), "stream never produced a delta"
+        loop1.stop(drain=0.05, join=True)
+        t.join(timeout=120)
+        assert not t.is_alive()
+        content, finish, errors = result["out"]
+        assert errors == [], errors
+        assert content == ref
+        assert loop2.stats()["migration"]["imported"] == (
+            imported_before + 1
+        )
+        assert loop1.stats()["migration"]["exported"] >= 1
+
+    def test_import_endpoint_rejects_corrupt_snapshot(self, spine):
+        eng_a = _engine(eos=_TOK.eos_ids)
+        req = Request(
+            id="http-cor", prompt_tokens=list(range(7, 30)),
+            sampling=SamplingParams(temperature=0.0, max_tokens=12),
+        )
+        eng_a.add_request(req)
+        while len(req.output_tokens) < 4 and eng_a.has_work():
+            eng_a.step()
+        snap = eng_a.export_request("http-cor")
+        snap.model = "m1"
+        wire = migration.snapshot_to_wire(snap)
+        wire["pages"][0]["k"]["b64"] = wire["pages"][0]["v"]["b64"]
+        r = requests.post(
+            f"{spine.sides['r2']['url']}/v1/migrate/import",
+            json=wire, timeout=30,
+        )
+        assert r.status_code == 422, r.text
+        assert r.json()["error"]["code"] == "snapshot_corrupt"
+        # nothing was admitted
+        assert spine.sides["r2"]["loops"]["m1"].stats()["waiting"] == 0
+
+    def test_resume_unknown_request_404(self, spine):
+        r = requests.post(
+            f"{spine.sides['r2']['url']}/v1/migrate/resume",
+            json={"request_id": "nope", "emitted_chars": 0}, timeout=30,
+        )
+        assert r.status_code == 404
+
+    def test_cluster_wide_drain_503_code_draining(self, spine):
+        """Every runner serving the model draining -> 503 with a
+        DISTINCT code and an honest Retry-After from the reported drain
+        deadline (not the generic runners_exhausted)."""
+        deadline = time.time() + 9.0
+        spine.heartbeat("r1", ["m1", "m2"], draining=True,
+                        deadline=deadline)
+        spine.heartbeat("r2", ["m1"], draining=True, deadline=deadline)
+        try:
+            r = requests.post(
+                f"{spine.cp_url}/v1/chat/completions",
+                json={"model": "m1", "max_tokens": 4, "messages": _MSG},
+                timeout=30,
+            )
+            assert r.status_code == 503, r.text
+            assert r.json()["error"]["code"] == "draining"
+            retry_after = int(r.headers["Retry-After"])
+            assert 1 <= retry_after <= 12
+        finally:
+            spine.heartbeat("r1", ["m1", "m2"])
+            spine.heartbeat("r2", ["m1"])
+
+    def test_malformed_draining_flag_degrades_to_false(self, spine):
+        """A hostile/buggy runner heartbeat with a non-bool draining
+        value must not 500 the heartbeat (TTL-evicting a healthy
+        runner) — it degrades to not-draining."""
+        r = requests.post(
+            f"{spine.cp_url}/api/v1/runners/r9/heartbeat",
+            json={
+                "runner_id": "r9",
+                "address": "http://127.0.0.1:1",
+                "profile": {"name": "p", "status": "running",
+                            "models": ["m9"]},
+                "draining": {"weird": ["shape"]},
+                "drain_deadline_ts": "also-not-a-number",
+            },
+            timeout=10,
+        )
+        assert r.status_code == 200, r.text
+        st = spine.cp.router.get("r9")
+        assert st is not None and st.draining is False
+        assert st.drain_deadline == 0.0
+        spine.cp.router.remove("r9")
+
+
+class TestMigrationMetrics:
+    def test_runner_metrics_render(self, spine):
+        text = requests.get(
+            f"{spine.sides['r2']['url']}/metrics", timeout=10
+        ).text
+        assert 'helix_migrations_imported_total{model="m2"}' in text
+        assert 'helix_migrations_exported_total{model="m1"}' in text
+        assert 'helix_migration_drain_state{model="m1"}' in text
+
+    def test_cp_metrics_render(self, spine):
+        text = requests.get(f"{spine.cp_url}/metrics", timeout=10).text
+        assert "helix_cp_midstream_failovers_total" in text
+        assert 'helix_cp_runner_draining{runner="r1"}' in text
+
+
+class TestLintContractMigration:
+    def _tree(self, tmp_path, extra: str):
+        obs = tmp_path / "helix_tpu" / "obs"
+        obs.mkdir(parents=True)
+        (obs / "flight.py").write_text(
+            'SATURATION_KEYS = (\n    "kv_occupancy",\n)\n'
+        )
+        srv = tmp_path / "helix_tpu" / "serving"
+        srv.mkdir(parents=True)
+        (srv / "sched.py").write_text(
+            'TENANT_QUEUE_FULL = "sched_tenant_queue_full"\n'
+            "SCHED_AUDIT_REASONS = (TENANT_QUEUE_FULL,)\n"
+        )
+        (srv / "migration.py").write_text(
+            'MIGRATIONS_EXPORTED = "helix_migrations_exported_total"\n'
+        )
+        (srv / "bad.py").write_text(extra)
+        return str(tmp_path)
+
+    def test_migration_literal_outside_module_rejected(self, tmp_path):
+        import tools.lint_metrics as lint
+
+        for literal in (
+            "helix_migrations_exported_total",
+            "helix_migration_failures_total",
+            "helix_cp_midstream_failovers_total",
+            "helix_cp_runner_draining",
+        ):
+            root = self._tree(tmp_path / literal, f'N = "{literal}"\n')
+            vs = lint.run(root)
+            assert any(
+                "migration/drain metric family" in v for v in vs
+            ), (literal, vs)
+
+    def test_repo_is_clean(self):
+        import tools.lint_metrics as lint
+
+        root = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        assert lint.run(root) == []
+
+
+class TestNodeAgentGracefulShutdown:
+    def test_sigterm_path_drains_and_announces(self):
+        """The node agent's SIGTERM path: heartbeat flips to draining
+        with a deadline, every loop drains (exporting survivors through
+        the wired shipper), and the loops are down afterwards."""
+        from helix_tpu.control.node_agent import NodeAgent
+        from helix_tpu.serving.registry import ModelRegistry, ServedModel
+
+        registry = ModelRegistry()
+        loop_a = EngineLoop(_engine(max_pages=32), "agent-m").start()
+        registry.register(
+            ServedModel(name="agent-m", loop=loop_a, tokenizer=_TOK,
+                        context_length=128)
+        )
+        agent = NodeAgent("drainer", registry=registry)
+        payload = agent.heartbeat_payload()
+        assert payload["draining"] is False
+        state = {"tokens": 0, "errors": [], "done": threading.Event()}
+
+        def on_event(ev):
+            if ev.token_id >= 0:
+                state["tokens"] += 1
+            if ev.error:
+                state["errors"].append(ev.error)
+            if ev.finished:
+                state["done"].set()
+
+        shipped = []
+        loop_a.exporter = None   # graceful_shutdown has no cp: keep a
+        # test shipper via monkeypatching the loop AFTER shutdown wires
+        loop_a.submit(
+            Request(id="agent-req", prompt_tokens=list(range(7, 30)),
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_tokens=80)),
+            on_event,
+        )
+        while state["tokens"] < 3:
+            time.sleep(0.01)
+        # no heartbeat_url -> no PeerShipper; wire our own exporter so
+        # the drain ladder ships instead of shedding
+        loop_a.exporter = lambda wire: shipped.append(wire) or "peer-x"
+        stats = agent.graceful_shutdown(drain=0.01)
+        assert agent.draining is True
+        assert agent.heartbeat_payload()["draining"] is True
+        assert agent.heartbeat_payload()["drain_deadline_ts"] > 0
+        assert state["done"].wait(10)
+        assert state["errors"] and state["errors"][0].startswith(
+            migration.MIGRATED
+        )
+        assert len(shipped) == 1
+        assert stats["agent-m"]["exported"] == 1
+        t = getattr(loop_a, "_thread", None)
+        assert t is None or not t.is_alive()
+
+
+@pytest.mark.slow
+class TestCrashSoak:
+    def test_crash_soak_scenario(self):
+        import tools.chaos_soak as soak
+
+        res = soak.run_crash(seconds=6.0, seed=11)
+        assert res["stuck"] == []
+        assert res["migrated"] > 0
+        assert res["mismatches"] == []
+        assert res["healthy_after"]

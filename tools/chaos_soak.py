@@ -281,20 +281,178 @@ def run_memory_pressure(seconds: float = 10.0, seed: int = 42) -> dict:
     }
 
 
+def run_crash(seconds: float = 10.0, seed: int = 42,
+              crash_every: float = 1.5) -> dict:
+    """ISSUE 11 scenario: a runner dies mid-stream, over and over.
+
+    Two engine loops share one set of weights: the ACTIVE loop takes
+    seeded greedy traffic and is crash-drained (near-zero drain window —
+    in-flight work survives only by snapshot export) every
+    ``crash_every`` seconds with the STANDBY loop as the migration
+    target; a fresh active loop replaces it and the cycle repeats.
+    Clients accumulate tokens across the migration.
+
+    Exit contract: **zero stuck requests**, at least one real migration,
+    and — the crash-tolerance headline — every migrated greedy request's
+    combined token stream (active-loop part + standby-loop continuation)
+    is BIT-IDENTICAL to an uninterrupted reference run: no duplicated,
+    missing, or diverged tokens."""
+    import jax
+
+    from helix_tpu.engine.engine import Engine, EngineConfig, Request
+    from helix_tpu.engine.sampling import SamplingParams
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.migration import wire_to_snapshot
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def build_engine():
+        return Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=4, page_size=4, num_pages=256,
+                max_pages_per_seq=64, max_prefill_len=64,
+                attn_backend="reference", eos_token_ids=tok.eos_ids,
+            ),
+        )
+
+    rng = random.Random(seed)
+    tokens: dict[str, list] = {}     # rid -> combined token stream
+    terminal: dict[str, bool] = {}
+    outcomes: dict[str, str] = {}
+    migrated: set = set()
+    prompts: dict[str, tuple] = {}   # rid -> (prompt, max_tokens)
+
+    def on_event_for(rid):
+        def on_event(ev):
+            if ev.token_id >= 0:
+                tokens[rid].append(ev.token_id)
+            if ev.finished and not ev.error:
+                terminal[rid] = True
+                outcomes[rid] = ev.finish_reason or "stop"
+            elif ev.finished and ev.error:
+                if ev.error.startswith("migrated"):
+                    migrated.add(rid)   # continuation lands via standby
+                else:
+                    terminal[rid] = True
+                    outcomes[rid] = "error:" + ev.error.split(":")[0]
+        return on_event
+
+    standby = EngineLoop(build_engine(), "standby").start()
+
+    def exporter(wire):
+        snap = wire_to_snapshot(wire)
+        res: list = []
+        standby.submit_import(
+            snap, on_event_for(snap.request_id),
+            on_result=lambda e, c: res.append(e),
+        )
+        deadline = time.monotonic() + 30.0
+        while not res and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if not res or res[0] is not None:
+            raise RuntimeError(f"standby rejected import: {res}")
+        return "standby"
+
+    t0 = time.monotonic()
+    n = 0
+    crashes = 0
+    try:
+        while time.monotonic() - t0 < seconds:
+            active = EngineLoop(
+                build_engine(), f"active-{crashes}"
+            ).start()
+            active.exporter = exporter
+            cycle_end = min(
+                time.monotonic() + crash_every, t0 + seconds
+            )
+            while time.monotonic() < cycle_end:
+                n += 1
+                rid = f"crash-{n}"
+                prompt = [rng.randrange(4, 260)
+                          for _ in range(rng.randrange(6, 24))]
+                max_toks = rng.randrange(40, 120)
+                prompts[rid] = (prompt, max_toks)
+                tokens[rid] = []
+                terminal[rid] = False
+                active.submit(
+                    Request(
+                        id=rid, prompt_tokens=prompt,
+                        sampling=SamplingParams(
+                            temperature=0.0, max_tokens=max_toks,
+                        ),
+                        stop_token_ids=tok.eos_ids,
+                    ),
+                    on_event_for(rid),
+                )
+                time.sleep(rng.uniform(0.005, 0.04))
+            # crash: near-zero drain — survivors live or die by export
+            crashes += 1
+            active.stop(drain=0.01, join=True)
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline and not all(terminal.values()):
+            time.sleep(0.1)
+    finally:
+        standby.stop(join=False)
+
+    stuck = sorted(r for r, done in terminal.items() if not done)
+    # bit-identity: every migrated request's combined stream must equal
+    # an uninterrupted reference run of the same prompt
+    ref_engine = build_engine()
+    mismatches = []
+    for rid in sorted(migrated):
+        if rid in stuck or outcomes.get(rid, "").startswith("error"):
+            continue
+        prompt, max_toks = prompts[rid]
+        ref = Request(
+            id=f"ref-{rid}", prompt_tokens=list(prompt),
+            sampling=SamplingParams(temperature=0.0, max_tokens=max_toks),
+            stop_token_ids=tok.eos_ids,
+        )
+        ref_engine.add_request(ref)
+        while not ref.finished:
+            ref_engine.step()
+        if tokens[rid] != ref.output_tokens:
+            mismatches.append(rid)
+    counts: dict[str, int] = {}
+    for o in outcomes.values():
+        counts[o] = counts.get(o, 0) + 1
+    return {
+        "submitted": n,
+        "crashes": crashes,
+        "migrated": len(migrated),
+        "stuck": stuck,
+        "mismatches": mismatches,
+        "outcomes": counts,
+        "healthy_after": not stuck,
+        "stats": standby.stats(),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--step-fault-p", type=float, default=0.02)
     ap.add_argument(
-        "--scenario", choices=("faults", "memory"), default="faults",
+        "--scenario", choices=("faults", "memory", "crash"),
+        default="faults",
         help="faults: injected step/dispatch faults (ISSUE 2); memory: "
         "sustained KV exhaustion against the tiering/preemption ladder "
-        "(ISSUE 6)",
+        "(ISSUE 6); crash: repeated runner crash-drains with snapshot "
+        "migration to a standby — combined streams must be bit-identical "
+        "to uninterrupted runs (ISSUE 11)",
     )
     args = ap.parse_args(argv)
     if args.scenario == "memory":
         res = run_memory_pressure(seconds=args.seconds, seed=args.seed)
+    elif args.scenario == "crash":
+        res = run_crash(seconds=args.seconds, seed=args.seed)
     else:
         res = run_soak(
             seconds=args.seconds, seed=args.seed,
@@ -313,6 +471,20 @@ def main(argv=None) -> int:
     if args.scenario == "memory" and not res.get("tiering_moved"):
         print("KV TIERING COUNTERS DID NOT MOVE", file=sys.stderr)
         return 1
+    if args.scenario == "crash":
+        if res.get("mismatches"):
+            print(
+                f"MIGRATED STREAMS DIVERGED: {res['mismatches']}",
+                file=sys.stderr,
+            )
+            return 1
+        if not res.get("migrated"):
+            print("NO REQUEST ACTUALLY MIGRATED", file=sys.stderr)
+            return 1
+        print(
+            f"crashes: {res['crashes']}, migrated: {res['migrated']} — "
+            "all combined streams bit-identical to uninterrupted runs"
+        )
     print("zero stuck requests — soak passed")
     return 0
 

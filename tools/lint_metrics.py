@@ -35,7 +35,15 @@ Contracts, enforced repo-wide (wired into tier-1 via
    one owner.  The engine loop must keep building its scheduler through
    ``make_scheduler`` and the OpenAI surface must keep adopting
    ``CLASS_HEADER`` (the contracts 3/4 importer pattern).
-6. **One compiled step entry point**: the engine's device step compiles
+6. **One migration vocabulary**: the cross-runner migration series —
+   ``helix_migrations_*`` / ``helix_migration_*`` runner counters, the
+   ``helix_cp_midstream_*`` failover counters and the
+   ``helix_cp_runner_draining`` drain-state gauge — are minted ONLY by
+   ``helix_tpu/serving/migration.py``; the runner metrics collector and
+   the control plane must keep calling its collector helpers
+   (``collect_runner_migration`` / ``collect_cp_migration``), the
+   contracts 3/4/5 importer pattern.
+7. **One compiled step entry point**: the engine's device step compiles
    through ONE lru-cached builder (``_build_ragged_step_fn``) plus the
    two grandfathered VL paths — a NEW ``@functools.lru_cache`` step
    builder anywhere under ``helix_tpu/engine/`` fails the build, so the
@@ -177,6 +185,55 @@ def _is_sched(path: str, root: str) -> bool:
     return rel == os.path.join("helix_tpu", "serving", "sched.py")
 
 
+# -- contract 6: one migration vocabulary -----------------------------------
+# Cross-runner migration series (runner export/import counters, the
+# drain-state gauge, and the control plane's mid-stream failover
+# counters) are minted only by serving/migration.py; the runner and the
+# control plane call its collector helpers.
+_MIGRATION_NAME_RE = re.compile(
+    r"""["']helix_(?:migrations?_[a-z0-9_]+"""
+    r"""|cp_midstream_[a-z0-9_]*|cp_runner_draining)["']"""
+)
+# (file, required symbol): both scrape surfaces must keep routing
+# through the migration module's collectors
+_MIGRATION_IMPORTERS = (
+    (
+        os.path.join("helix_tpu", "serving", "openai_api.py"),
+        "collect_runner_migration",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "server.py"),
+        "collect_cp_migration",
+    ),
+)
+
+
+def _is_migration(path: str, root: str) -> bool:
+    rel = os.path.relpath(path, root)
+    return rel == os.path.join("helix_tpu", "serving", "migration.py")
+
+
+def _migration_schema_violations(root: str) -> list:
+    violations = []
+    mod = os.path.join(root, "helix_tpu", "serving", "migration.py")
+    if not os.path.isfile(mod):
+        return [
+            "helix_tpu/serving/migration.py: missing — the migration "
+            "metric vocabulary must live there"
+        ]
+    for rel, symbol in _MIGRATION_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if symbol not in f.read():
+                violations.append(
+                    f"{rel}: does not call {symbol} from the migration "
+                    "module (helix_tpu/serving/migration.py)"
+                )
+    return violations
+
+
 def _load_sched_schema(root: str):
     """Contract 5 setup: the audit-reason vocabulary from
     serving/sched.py (textual parse, like SATURATION_KEYS) plus
@@ -269,7 +326,7 @@ def _tenant_schema_violations(root: str) -> list:
     return violations
 
 
-# -- contract 6: one compiled step entry point -------------------------------
+# -- contract 7: one compiled step entry point -------------------------------
 # The unified ragged step is THE device-step builder; these existing
 # names are the only lru-cached ``_build_*`` functions allowed under
 # helix_tpu/engine/ — a new one is a new trace family and fails here.
@@ -284,7 +341,7 @@ _DEF_NAME = re.compile(r"\s*def\s+([A-Za-z_][A-Za-z0-9_]*)")
 
 
 def _step_builder_violations(root: str) -> list:
-    """Contract 6: flag any lru-cached ``_build_*`` function under
+    """Contract 7: flag any lru-cached ``_build_*`` function under
     helix_tpu/engine/ that is not in the allowlist."""
     violations = []
     eng_dir = os.path.join(root, "helix_tpu", "engine")
@@ -329,6 +386,7 @@ def run(root: str) -> list:
     """Returns a list of violation strings (empty = clean)."""
     sat_keys, violations = _load_saturation_schema(root)
     violations += _tenant_schema_violations(root)
+    violations += _migration_schema_violations(root)
     violations += _step_builder_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
@@ -345,7 +403,14 @@ def run(root: str) -> list:
         allowed_exposition = _in_obs(path, root)
         tenant_emitter = _is_slo(path, root)
         sched_emitter = _is_sched(path, root)
+        migration_emitter = _is_migration(path, root)
         for i, line in enumerate(lines, 1):
+            if not migration_emitter and _MIGRATION_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: migration/drain metric family named "
+                    "outside helix_tpu/serving/migration.py — import "
+                    "its collector helpers instead"
+                )
             if not sched_emitter:
                 if _SCHED_NAME_RE.search(line):
                     violations.append(
