@@ -45,6 +45,20 @@ def _cmd_serve_node(args) -> int:
             else args.advertise or f"http://127.0.0.1:{args.port}"
         ),
     )
+
+    # control-plane-requested drain (ISSUE 12 autoscale scale-down):
+    # once the agent's graceful ladder finishes, deliver SIGTERM to
+    # ourselves — both serving modes already translate it into a clean
+    # exit 0 (graceful_shutdown is idempotent, the second call returns
+    # the recorded stats), so the drained host actually frees itself
+    # for the autoscaler to terminate
+    def _exit_after_drain():
+        import os
+        import signal as _signal
+
+        os.kill(os.getpid(), _signal.SIGTERM)
+
+    agent.on_drain = _exit_after_drain
     if args.profile:
         with open(args.profile) as f:
             profile = ServingProfile.from_yaml(f.read())

@@ -392,6 +392,79 @@ ENV_REFERENCE: tuple = (
         default="256",
         section="scheduler",
     ),
+    # -- routing (control/router.py; README "Routing & autoscaling") -----
+    EnvVar(
+        "HELIX_ROUTER_POLICY",
+        "Control-plane placement policy: 'scored' closes the loop from "
+        "federated heartbeat saturation (hard-avoid runners near KV/"
+        "host-pool exhaustion or with a squeezed prefill budget, "
+        "soft-prefer low queue depth / occupancy / warm spec "
+        "acceptance, steer batch-class traffic off runners whose "
+        "tenants are burning SLO budget; stale or missing saturation "
+        "scores neutral, never best). Unset or 'rr': the seed "
+        "least-loaded/round-robin baseline, bit-for-bit.",
+        default="rr",
+        section="router",
+    ),
+    EnvVar(
+        "HELIX_ROUTER_KV_AVOID_THRESHOLD",
+        "KV occupancy (0..1) at which the scored policy hard-avoids a "
+        "runner — routed to only when no alternative exists.",
+        default="0.85",
+        section="router",
+    ),
+    EnvVar(
+        "HELIX_ROUTER_KV_FULL_THRESHOLD",
+        "KV occupancy (0..1) past which a runner is treated as FULL: a "
+        "new dispatch there is a guaranteed typed kv_exhausted, so "
+        "when EVERY candidate is full the control plane sheds with a "
+        "503 code=kv_saturated and an honest Retry-After instead of "
+        "dispatching into certain failure.",
+        default="0.98",
+        section="router",
+    ),
+    EnvVar(
+        "HELIX_ROUTER_HOST_AVOID_THRESHOLD",
+        "Host KV tier occupancy (0..1) at which the scored policy "
+        "hard-avoids a runner (its spill headroom is nearly gone).",
+        default="0.92",
+        section="router",
+    ),
+    EnvVar(
+        "HELIX_ROUTER_PREFILL_AVOID_TOKENS",
+        "A runner reporting a prefill-admission budget in (0, this] is "
+        "hard-avoided: the scheduler's SLO-burn feedback has squeezed "
+        "admission to the floor there. 0 in the heartbeat always means "
+        "unbudgeted and never triggers the avoid.",
+        default="256",
+        section="router",
+    ),
+    EnvVar(
+        "HELIX_ROUTER_BURN_STEER_THRESHOLD",
+        "Worst-tenant fast-window SLO burn rate above which batch-class "
+        "(X-Helix-Class) traffic is steered away from a runner (soft "
+        "score penalty, not an avoid).",
+        default="1.0",
+        section="router",
+    ),
+    EnvVar(
+        "HELIX_PREFIX_AFFINITY",
+        "Set to 1 to route requests sharing a prompt head (system "
+        "prompt) to the runner whose PrefixCache/host tier already "
+        "holds those pages (cp-side bounded LRU of prefix digest -> "
+        "runner). Affinity is a hint, not a pin: under the scored "
+        "policy it yields to saturation, breakers and drain; under rr "
+        "it yields whenever the hinted runner is no longer among the "
+        "least-loaded. Unset/0: off.",
+        section="router",
+    ),
+    EnvVar(
+        "HELIX_PREFIX_AFFINITY_ENTRIES",
+        "Bound on the prefix-affinity LRU (distinct prompt heads "
+        "remembered cluster-wide).",
+        default="2048",
+        section="router",
+    ),
     # -- dispatch robustness (control plane -> runner) -------------------
     EnvVar(
         "HELIX_DISPATCH_MAX_ATTEMPTS",
@@ -508,6 +581,65 @@ ENV_REFERENCE: tuple = (
         "GCE_TOKEN",
         "Static OAuth bearer for the GCE API; falls back to the "
         "instance metadata server when unset.",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_INSTANCE_ID",
+        "Compute-row identity an autoscaled host includes in its "
+        "heartbeats so the pool manager can bind them to its instance "
+        "row (matched by row id or provider id; the GCE startup script "
+        "exports the instance hostname). Unset on hand-managed nodes.",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_AUTOSCALE_FLOOR",
+        "Override for the autoscaler's floor (healthy hosts kept alive "
+        "at all times); beats the supplied ManagerConfig.",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_AUTOSCALE_MAX",
+        "Override for the autoscaler's max owned hosts (hard ceiling; "
+        "0 disables demand/saturation bursts).",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_AUTOSCALE_QUEUE_HIGH",
+        "Cluster-wide queued-request depth (summed over runner "
+        "heartbeats) that, sustained for HELIX_AUTOSCALE_SUSTAIN_"
+        "SECONDS, provisions another host (0 disables the queue "
+        "trigger).",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_AUTOSCALE_BURN_HIGH",
+        "Worst-tenant fast-window SLO burn rate that, sustained, "
+        "provisions another host (0 disables the burn trigger).",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_AUTOSCALE_SUSTAIN_SECONDS",
+        "How long a scale-up trigger (and the idle condition for "
+        "scale-down victim selection) must hold before the autoscaler "
+        "acts — one hot scrape must not provision.",
+        default="60",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_AUTOSCALE_IDLE_SECONDS",
+        "Cluster idle duration (zero queued work, tenant burn healthy) "
+        "after which the autoscaler drains ONE runner at a time down "
+        "toward the floor — announce draining, migrate in-flight "
+        "requests to peers (ISSUE 11 ladder), then terminate the host. "
+        "0 disables saturation-driven scale-down.",
+        section="compute",
+    ),
+    EnvVar(
+        "HELIX_AUTOSCALE_DRAIN_GRACE",
+        "Seconds a drain-requested host may linger before it is "
+        "terminated anyway (0 = HELIX_DRAIN_SECONDS + 30). Normal "
+        "completion is earlier: the host is reclaimed as soon as its "
+        "runner leaves the router.",
         section="compute",
     ),
     EnvVar(

@@ -20,6 +20,20 @@ reference's design docs):
 - **Stuck-provision rollback**: rows provisioning longer than
   ``max_provisioning_age`` are rolled back so they stop holding floor
   slots (``manager.go:986``).
+- **D5 saturation burst (ISSUE 12)**: the control plane injects
+  ``cluster_signals()`` (federated queue depth, goodput, worst-tenant
+  SLO burn from the router's heartbeat state); sustained backlog or
+  burn past the configured thresholds provisions another host — the
+  autoscaler scales on what the serving fleet *reports*, not on
+  sandbox headroom alone.
+- **D6 drain-then-terminate scale-down (ISSUE 12)**: a sustained-idle
+  cluster above floor sheds capacity through the ISSUE 11 migration
+  ladder instead of killing it: mark the victim row ``draining``, ask
+  the control plane to request a graceful drain from its runner
+  (announce draining -> unroutable -> export in-flight requests to
+  peers), and only deprovision once the runner has left the router (or
+  the drain grace expires) — a capacity change never kills a
+  generation.  One victim at a time, never below floor.
 
 TPU nuance: ``can_host_sandbox=False`` marks accelerator-only hosts
 (e.g. a v5e pod slice serving inference with no desktop plane) — they
@@ -30,10 +44,20 @@ reference's neuron-host exclusion.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import time
 import uuid
 from typing import Callable, Optional
+
+# the autoscaler metric vocabulary (tools/lint_metrics.py contract 8:
+# minted only in this module; the control plane calls
+# ``collect_cp_autoscale``)
+CP_AUTOSCALE_PROVISIONS = "helix_cp_autoscale_provisions_total"
+CP_AUTOSCALE_DEPROVISIONS = "helix_cp_autoscale_deprovisions_total"
+CP_AUTOSCALE_DRAINS = "helix_cp_autoscale_drains_total"
+CP_AUTOSCALE_BURSTS = "helix_cp_autoscale_saturation_bursts_total"
+CP_AUTOSCALE_INSTANCES = "helix_cp_autoscale_instances"
 
 
 @dataclasses.dataclass
@@ -62,6 +86,13 @@ class Instance:
     ready_at: float = 0.0        # when the provider reported ready
     heartbeat_at: float = 0.0    # last node heartbeat (0 = never)
     runner_id: str = ""          # the runner id this host registered as
+    # drain-then-terminate scale-down (ISSUE 12): set when this host was
+    # chosen as the D6 victim — its runner has been asked to drain
+    # gracefully (migrate in-flight work to peers) and the row is
+    # deprovisioned only once the runner leaves the router or the drain
+    # grace expires
+    draining: bool = False
+    drain_started: float = 0.0
 
     def to_dict(self):
         return dataclasses.asdict(self)
@@ -81,6 +112,20 @@ class InstanceStore:
 
     def get(self, iid: str) -> Optional[Instance]:
         return self._rows.get(iid)
+
+    def find_by_provider(self, provider_id: str) -> Optional[Instance]:
+        """Row lookup by the upstream's id.  Autoscaled hosts only know
+        their cloud-side identity (GCE bakes ``HELIX_INSTANCE_ID=$(
+        hostname)`` into the startup script and the instance name IS the
+        provider id), so heartbeats bind to the row through this
+        fallback when the ``ci_...`` id doesn't match."""
+        if not provider_id:
+            return None
+        with self._lock:
+            for r in self._rows.values():
+                if r.provider_id == provider_id:
+                    return r
+        return None
 
     def register(self, inst: Instance) -> None:
         with self._lock:
@@ -165,12 +210,69 @@ class ManagerConfig:
     offline_reap_after: float = 1800.0   # dead host reclaimed regardless of
     # its frozen active_sandboxes count (0 disables the orphan reaper)
     spec: Spec = dataclasses.field(default_factory=Spec)
+    # -- saturation-driven scaling (ISSUE 12; HELIX_AUTOSCALE_*) ---------
+    # D5 burst triggers: sustained cluster queue depth (0 disables) or
+    # sustained worst-tenant fast SLO burn (0.0 disables), each judged
+    # against the control plane's cluster_signals()
+    scale_up_queue_depth: int = 0
+    scale_up_burn: float = 0.0
+    # how long a trigger must hold before acting (both directions) — one
+    # hot scrape must not provision, one idle scrape must not drain
+    scale_sustain_seconds: float = 60.0
+    # D6 drain-down: cluster idle (zero queued work, burn healthy) this
+    # long and ready > floor -> drain one runner then terminate its host
+    # (0 disables)
+    scale_down_idle_seconds: float = 0.0
+    # how long after requesting a drain the host may linger before it is
+    # deprovisioned anyway (0 = HELIX_DRAIN_SECONDS + 30)
+    drain_grace_seconds: float = 0.0
 
     def validate(self) -> None:
         if self.floor < 0:
             raise ValueError("floor must be >= 0")
         if self.max and self.max < self.floor:
             raise ValueError("max must be >= floor when set")
+
+
+def autoscale_config_from_env(
+    base: Optional[ManagerConfig] = None,
+) -> ManagerConfig:
+    """HELIX_AUTOSCALE_* env overrides applied over ``base`` (the
+    HELIX_SPEC_TOKENS operator-beats-config contract).  Unparsable
+    values keep the base setting."""
+    cfg = base or ManagerConfig()
+
+    def pick(name, cur, cast):
+        v = os.environ.get(name, "")
+        if not v:
+            return cur
+        try:
+            return cast(v)
+        except (TypeError, ValueError):
+            return cur
+
+    return dataclasses.replace(
+        cfg,
+        floor=pick("HELIX_AUTOSCALE_FLOOR", cfg.floor, int),
+        max=pick("HELIX_AUTOSCALE_MAX", cfg.max, int),
+        scale_up_queue_depth=pick(
+            "HELIX_AUTOSCALE_QUEUE_HIGH", cfg.scale_up_queue_depth, int
+        ),
+        scale_up_burn=pick(
+            "HELIX_AUTOSCALE_BURN_HIGH", cfg.scale_up_burn, float
+        ),
+        scale_sustain_seconds=pick(
+            "HELIX_AUTOSCALE_SUSTAIN_SECONDS",
+            cfg.scale_sustain_seconds, float,
+        ),
+        scale_down_idle_seconds=pick(
+            "HELIX_AUTOSCALE_IDLE_SECONDS",
+            cfg.scale_down_idle_seconds, float,
+        ),
+        drain_grace_seconds=pick(
+            "HELIX_AUTOSCALE_DRAIN_GRACE", cfg.drain_grace_seconds, float
+        ),
+    )
 
 
 class ComputeManager:
@@ -181,6 +283,8 @@ class ComputeManager:
         store: Optional[InstanceStore] = None,
         assigned_runner_ids: Callable[[], set] = lambda: set(),
         now: Callable[[], float] = time.monotonic,
+        cluster_signals: Callable[[], dict] = lambda: {},
+        request_drain: Callable[[str], None] = lambda runner_id: None,
     ):
         cfg.validate()
         self.cfg = cfg
@@ -188,8 +292,21 @@ class ComputeManager:
         self.store = store or InstanceStore()
         self.assigned_runner_ids = assigned_runner_ids
         self.now = now
+        # ISSUE 12 feedback loop: the control plane injects federated
+        # cluster saturation (queue depth, goodput, worst-tenant burn,
+        # live runner ids) and a way to ask a runner for a graceful
+        # drain (the assignment-poll drain flag)
+        self.cluster_signals = cluster_signals
+        self.request_drain = request_drain
         self._idle_since: dict[str, float] = {}
         self._offline_since: dict[str, float] = {}
+        self._hot_since: Optional[float] = None
+        self._cold_since: Optional[float] = None
+        # lifetime decision counters for collect_cp_autoscale
+        self.provisions = 0
+        self.deprovisions = 0
+        self.drains_requested = 0
+        self.saturation_bursts = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -240,7 +357,9 @@ class ComputeManager:
                   active_sandboxes: int = 0) -> None:
         """Record a node heartbeat against its compute row (called from
         the control plane's heartbeat handler)."""
-        inst = self.store.get(instance_id)
+        inst = self.store.get(instance_id) or self.store.find_by_provider(
+            instance_id
+        )
         if inst is None:
             return
         inst.status = "ready"
@@ -276,6 +395,7 @@ class ComputeManager:
         for _ in range(min(need, self.cfg.max_concurrent_provisions)):
             self._provision_one()
         self._try_deprovision_idle(self.store.list())
+        self._saturation_scale(self.store.list())
 
     def _reap_dead(self, rows: list[Instance]) -> None:
         """Orphan reaper: a ready host offline continuously past
@@ -303,6 +423,7 @@ class ComputeManager:
             except Exception:  # noqa: BLE001 — retry next cycle
                 continue
             self.store.deregister(iid)
+            self.deprovisions += 1
             del self._offline_since[iid]
 
     def _refresh_provisioning(self, rows: list[Instance]) -> None:
@@ -326,6 +447,7 @@ class ComputeManager:
     def _rollback(self, r: Instance, reason: str) -> None:
         try:
             self.provider.deprovision(r.provider_id)
+            self.deprovisions += 1
         except Exception:  # noqa: BLE001 — upstream may already be gone
             pass
         self.store.deregister(r.id)
@@ -372,6 +494,7 @@ class ComputeManager:
 
     def _provision_one(self) -> None:
         pid = self.provider.provision(self.cfg.spec)
+        self.provisions += 1
         now = self.now()
         self.store.register(
             Instance(
@@ -413,7 +536,11 @@ class ComputeManager:
             if iid not in ready:
                 del self._idle_since[iid]
 
-        ready_count = len(ready)
+        # draining victims are LEAVING capacity: they must not count
+        # toward the floor guarantee here, or consecutive cycles could
+        # mark every host draining while ready_count never shrank and
+        # the whole fleet drains below floor
+        ready_count = sum(1 for r in ready.values() if not r.draining)
         if ready_count <= self.cfg.floor:
             return
         protected = self.assigned_runner_ids()
@@ -431,6 +558,9 @@ class ComputeManager:
                 (since, iid) for iid, since in self._idle_since.items()
                 if now - since >= self.cfg.idle_timeout
                 and not is_protected(iid)
+                # a D6 victim is mid-drain: terminating it here would
+                # kill the very generations the drain is migrating
+                and not ready[iid].draining
             ),
         )
         for since, iid in candidates:
@@ -442,10 +572,209 @@ class ComputeManager:
             if fleet_at_cap and not hard:
                 continue   # inhibited; the hard timeout overrides
             r = ready[iid]
+            if self.cfg.scale_down_idle_seconds > 0 and r.runner_id:
+                # graceful mode (ISSUE 12): the host registered a
+                # runner — it may be serving inference with zero
+                # sandboxes, so route the idle shed through the
+                # drain-then-terminate ladder instead of hard-killing
+                # whatever it is generating.  ONE victim at a time,
+                # like D6: drains complete before the next starts
+                if any(x.draining for x in ready.values()):
+                    return
+                r.draining = True
+                r.drain_started = now
+                self.drains_requested += 1
+                try:
+                    self.request_drain(r.runner_id)
+                except Exception:  # noqa: BLE001 — grace timeout
+                    pass           # still terminates the host
+                return
             try:
                 self.provider.deprovision(r.provider_id)
             except Exception:  # noqa: BLE001 — retry next cycle
                 return
             self.store.deregister(iid)
+            self.deprovisions += 1
             self._idle_since.pop(iid, None)
             return   # one per cycle: drain gradually, never abruptly
+
+    # -- D5/D6: the saturation feedback loop (ISSUE 12) ---------------------
+
+    def _drain_grace(self) -> float:
+        if self.cfg.drain_grace_seconds > 0:
+            return self.cfg.drain_grace_seconds
+        from helix_tpu.serving.migration import drain_seconds
+
+        return drain_seconds() + 30.0
+
+    def _saturation_scale(self, rows: list[Instance]) -> None:
+        """Scale on what the serving fleet reports: provision on
+        sustained cluster queue backlog / worst-tenant SLO burn, shed
+        idle capacity through drain-then-terminate.  Disabled unless at
+        least one trigger is configured."""
+        cfg = self.cfg
+        enabled = (
+            cfg.scale_up_queue_depth > 0
+            or cfg.scale_up_burn > 0
+            or cfg.scale_down_idle_seconds > 0
+        )
+        if not enabled:
+            return
+        try:
+            sig = self.cluster_signals() or {}
+        except Exception:  # noqa: BLE001 — scaling must not kill the loop
+            sig = {}
+        now = self.now()
+        live = set(sig.get("live_runners") or ())
+        # drain completion first: a victim whose runner has left the
+        # router (drained, exported survivors, exited) — or that
+        # overstayed the grace — is terminated now
+        for r in rows:
+            if not r.draining:
+                continue
+            gone = bool(live) and r.runner_id and r.runner_id not in live
+            overdue = now - r.drain_started >= self._drain_grace()
+            if not (gone or overdue):
+                continue
+            try:
+                self.provider.deprovision(r.provider_id)
+            except Exception:  # noqa: BLE001 — retry next cycle
+                continue
+            self.store.deregister(r.id)
+            self.deprovisions += 1
+        if not sig:
+            # signals unavailable (fetch failed or the cp reported
+            # nothing): an outage is indistinguishable from idleness —
+            # NEVER classify; grace-based drain completion above still
+            # ran, but no new scaling decision is made on no data
+            self._hot_since = None
+            self._cold_since = None
+            return
+        rows = self.store.list()
+        qd = float(sig.get("queue_depth", 0) or 0)
+        burn = float(sig.get("worst_tenant_burn", 0.0) or 0.0)
+        # runners actually REPORTING saturation: zero means the fleet's
+        # telemetry is dark (not that it is idle) — default 1 for
+        # callers that don't supply the key
+        reporting = float(sig.get("reporting_runners", 1) or 0)
+        hot = (
+            cfg.scale_up_queue_depth > 0 and qd >= cfg.scale_up_queue_depth
+        ) or (cfg.scale_up_burn > 0 and burn >= cfg.scale_up_burn)
+        # cold = genuinely idle AND healthy, judged on EVIDENCE: no
+        # queued work anywhere, no tenant burning its error budget, and
+        # at least one runner actually reporting saturation
+        cold = qd <= 0 and burn < 1.0 and not hot and reporting > 0
+        self._hot_since = (
+            (self._hot_since or now) if hot else None
+        )
+        self._cold_since = (
+            (self._cold_since or now) if cold else None
+        )
+        # D5 burst: sustained saturation provisions one host per cycle
+        # up to Max (capacity in flight counts via _available, so one
+        # hot stretch doesn't stack provisions for the same backlog)
+        if (
+            hot
+            and now - self._hot_since >= cfg.scale_sustain_seconds
+            and cfg.max > 0
+            and sum(1 for r in rows if self._available(r)) < cfg.max
+        ):
+            self._provision_one()
+            self.saturation_bursts += 1
+            self._hot_since = now   # re-arm: next burst needs a fresh
+            # sustained window against the grown fleet
+            return
+        # D6 drain-down: sustained idle sheds ONE runner at a time via
+        # the graceful-drain ladder, never below floor
+        if not (
+            cfg.scale_down_idle_seconds > 0
+            and cold
+            and now - self._cold_since >= cfg.scale_down_idle_seconds
+        ):
+            return
+        ready = [r for r in rows if self._ready_state(r)]
+        if any(r.draining for r in ready):
+            return   # one victim at a time: let the current drain finish
+        if len(ready) <= cfg.floor:
+            return
+        protected = self.assigned_runner_ids()
+        victims = [
+            r for r in ready
+            if r.runner_id
+            and not r.draining
+            and r.id not in protected
+            and r.runner_id not in protected
+        ]
+        if not victims:
+            return
+        # LIFO: shed the newest capacity first (the burst we grew last)
+        victim = max(victims, key=lambda r: (r.ready_at, r.id))
+        victim.draining = True
+        victim.drain_started = now
+        self.drains_requested += 1
+        self._cold_since = now   # re-arm for the next victim
+        try:
+            self.request_drain(victim.runner_id)
+        except Exception:  # noqa: BLE001 — the grace timeout still
+            # terminates the host; the drain request is best-effort
+            pass
+
+    def autoscale_status(self) -> dict:
+        """The /v1/cluster/status 'autoscale' block (JSON twin of
+        ``collect_cp_autoscale``)."""
+        rows = self.store.list()
+        by_state: dict[str, int] = {}
+        for r in rows:
+            key = "draining" if r.draining else r.compute_state
+            by_state[key] = by_state.get(key, 0) + 1
+        return {
+            "enabled": True,
+            "floor": self.cfg.floor,
+            "max": self.cfg.max,
+            "scale_up_queue_depth": self.cfg.scale_up_queue_depth,
+            "scale_up_burn": self.cfg.scale_up_burn,
+            "scale_down_idle_seconds": self.cfg.scale_down_idle_seconds,
+            "instances": by_state,
+            "provisions": self.provisions,
+            "deprovisions": self.deprovisions,
+            "drains_requested": self.drains_requested,
+            "saturation_bursts": self.saturation_bursts,
+        }
+
+
+def collect_cp_autoscale(c, mgr: Optional["ComputeManager"]) -> None:
+    """Control-plane autoscaler series (scrape-time collector helper;
+    the ``helix_cp_autoscale_*`` vocabulary is minted here and only
+    here — lint contract 8).  No-op when the autoscaler is off."""
+    if mgr is None:
+        return
+    c.counter(
+        CP_AUTOSCALE_PROVISIONS, mgr.provisions,
+        help="Hosts provisioned (floor, headroom burst, saturation "
+             "burst)",
+    )
+    c.counter(
+        CP_AUTOSCALE_DEPROVISIONS, mgr.deprovisions,
+        help="Hosts deprovisioned (idle, orphan reap, rollback, "
+             "drain-then-terminate)",
+    )
+    c.counter(
+        CP_AUTOSCALE_DRAINS, mgr.drains_requested,
+        help="Graceful runner drains requested by the D6 scale-down arm",
+    )
+    c.counter(
+        CP_AUTOSCALE_BURSTS, mgr.saturation_bursts,
+        help="Provisions triggered by sustained cluster queue depth or "
+             "worst-tenant SLO burn",
+    )
+    by_state: dict[str, int] = {
+        "provisioning": 0, "ready": 0, "draining": 0,
+    }
+    for r in mgr.store.list():
+        key = "draining" if r.draining else r.compute_state
+        by_state[key] = by_state.get(key, 0) + 1
+    for state, n in sorted(by_state.items()):
+        c.gauge(
+            CP_AUTOSCALE_INSTANCES, n, {"state": state},
+            help="Compute instances by lifecycle state",
+        )

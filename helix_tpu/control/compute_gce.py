@@ -119,6 +119,13 @@ class GCEProvider(Provider):
         return (
             "#!/bin/sh\n"
             f"export HELIX_RUNNER_TOKEN={shlex.quote(self.runner_token)}\n"
+            # bind heartbeats to this host's autoscaler row: the GCE
+            # instance name IS the provider id the ComputeManager knows
+            # (InstanceStore.find_by_provider), and on GCE the hostname
+            # is the instance name — without this the manager never sees
+            # a heartbeat for the row, flips it offline after the stale
+            # window and reaps a perfectly healthy host
+            "export HELIX_INSTANCE_ID=\"$(hostname)\"\n"
             "python -m helix_tpu serve-node "
             f"--control-plane {shlex.quote(self.control_plane_url)} "
             "--runner-id \"$(hostname)\" --tunnel\n"

@@ -403,6 +403,12 @@ class NodeAgent:
         # honest Retry-After on a cluster-wide-drain 503
         self.draining = False
         self.drain_deadline_ts = 0.0
+        self._drain_stats: dict = {}
+        self._drain_thread: Optional[threading.Thread] = None
+        # fired AFTER a control-plane-requested drain completes (ISSUE
+        # 12 autoscale scale-down / operator drain): the CLI wires this
+        # to process exit so a drained node actually releases its host
+        self.on_drain: Optional[Callable[[], None]] = None
 
     # ------------------------------------------------------------------
     def _teardown_all(self):
@@ -588,6 +594,8 @@ class NodeAgent:
                 host_used += hp.used_bytes
                 host_budget += hp.budget_bytes
             preempted += len(getattr(eng, "preempted", ()))
+        from helix_tpu.testing import faults
+
         out = {
             "kv_occupancy": round(kv_used / kv_cap, 4) if kv_cap else 0.0,
             "slots_busy": slots_busy,
@@ -606,6 +614,18 @@ class NodeAgent:
             "preempted_requests": preempted,
             "prefill_budget_tokens": prefill_budget,
         }
+        # chaos (ISSUE 12): a "saturation" fault rule overrides reported
+        # keys so routing/autoscale tests can drive one runner toward
+        # apparent KV exhaustion deterministically (schema-filtered —
+        # an override can never mint an unknown gauge)
+        inj = faults.active()
+        if inj is not None:
+            over = inj.saturation_override(self.runner_id)
+            if over:
+                out.update(
+                    {k: v for k, v in over.items()
+                     if k in SATURATION_KEYS}
+                )
         # schema lockstep: emit exactly the shared key set
         return {k: out[k] for k in SATURATION_KEYS}
 
@@ -637,12 +657,18 @@ class NodeAgent:
         (``api/cmd/sandbox-heartbeat/main.go:28-60``): id + accelerator
         inventory + profile state + the saturation summary the control
         plane federates into ``helix_cp_runner_saturation_*``."""
+        import os
         import shutil
 
         disk = shutil.disk_usage("/")
         return {
             "runner_id": self.runner_id,
             "address": self.address,
+            # binds this node to its autoscaler compute row (ISSUE 12):
+            # provisioned hosts export HELIX_INSTANCE_ID in their
+            # startup script; the ComputeManager resolves it by row id
+            # or provider id so heartbeats keep the row alive
+            "instance_id": os.environ.get("HELIX_INSTANCE_ID", ""),
             "accelerators": [a.to_dict() for a in detect_accelerators()],
             "profile": {
                 "name": self.state.profile_name,
@@ -690,6 +716,18 @@ class NodeAgent:
         ladder).  Returns per-model migration stats for the exit log."""
         from helix_tpu.serving.migration import PeerShipper, drain_seconds
 
+        if self.draining:
+            # already draining (e.g. a SIGTERM lands while an
+            # assignment-requested drain runs): wait for it rather than
+            # double-draining stopped loops
+            t = self._drain_thread
+            if (
+                t is not None
+                and t is not threading.current_thread()
+                and t.is_alive()
+            ):
+                t.join(timeout=120.0)
+            return dict(self._drain_stats)
         if drain is None:
             drain = drain_seconds()
         self.draining = True
@@ -734,7 +772,37 @@ class NodeAgent:
                 st.get("exported"), st.get("failures"),
             )
         self.stop()
+        self._drain_stats = stats
         return stats
+
+    def _drain_async(self) -> None:
+        """Control-plane-requested drain (the assignment poll answered
+        ``drain: true`` — autoscale scale-down or an operator POST):
+        run the graceful ladder off the heartbeat thread, then hand
+        control to ``on_drain`` (the CLI exits the process, releasing
+        the host for the autoscaler to terminate)."""
+        if self.draining or self._drain_thread is not None:
+            return
+        log.info(
+            "runner %s: control plane requested drain — starting the "
+            "graceful ladder", self.runner_id,
+        )
+
+        def run():
+            try:
+                self.graceful_shutdown()
+            finally:
+                cb = self.on_drain
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:  # noqa: BLE001 — exit is best-effort
+                        pass
+
+        self._drain_thread = threading.Thread(
+            target=run, name="helix-drain", daemon=True
+        )
+        self._drain_thread.start()
 
     def start_heartbeat(self, poll_assignment: bool = True):
         """30s heartbeat + assignment polling against the control plane
@@ -764,14 +832,22 @@ class NodeAgent:
                         )
                         if a.status_code == 200:
                             doc = a.json()
-                            prof = (
-                                ServingProfile.from_dict(doc["profile"])
-                                if doc.get("profile")
-                                else None
-                            )
-                            name = prof.name if prof else ""
-                            if name != self.state.profile_name:
-                                self.apply_profile(prof)
+                            if doc.get("drain"):
+                                # scale-down / operator drain request:
+                                # run the graceful ladder; skip profile
+                                # churn on a node that is leaving
+                                self._drain_async()
+                            else:
+                                prof = (
+                                    ServingProfile.from_dict(
+                                        doc["profile"]
+                                    )
+                                    if doc.get("profile")
+                                    else None
+                                )
+                                name = prof.name if prof else ""
+                                if name != self.state.profile_name:
+                                    self.apply_profile(prof)
                 except Exception:  # noqa: BLE001 — keep beating
                     pass
                 self._stop.wait(self.heartbeat_interval)

@@ -14,13 +14,214 @@ HBM instead of image pull).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
+import json
+import os
 import threading
 import time
 from typing import Callable, Iterable, Optional
 
 ROUTABLE_STATUS = "running"
 PROFILE_STATUSES = ("assigning", "loading", "starting", "running", "failed")
+
+# ---------------------------------------------------------------------------
+# routing policy (ISSUE 12): the control-plane feedback loop from federated
+# heartbeat saturation into placement.  The ``helix_cp_route_*`` metric
+# vocabulary is minted ONLY here (tools/lint_metrics.py contract 8); the
+# control plane calls ``collect_cp_routing``.
+# ---------------------------------------------------------------------------
+
+ROUTE_POLICY_RR = "rr"          # the seed least-loaded/round-robin baseline
+ROUTE_POLICY_SCORED = "scored"  # saturation/SLO-aware composite scoring
+
+CP_ROUTE_POLICY = "helix_cp_route_policy_scored"
+CP_ROUTE_DECISIONS = "helix_cp_route_decisions_total"
+CP_ROUTE_HARD_AVOIDED = "helix_cp_route_hard_avoided_total"
+CP_ROUTE_SATURATION_SHEDS = "helix_cp_route_saturation_sheds_total"
+CP_ROUTE_AFFINITY_HITS = "helix_cp_route_affinity_hits_total"
+CP_ROUTE_AFFINITY_YIELDS = "helix_cp_route_affinity_yields_total"
+CP_ROUTE_CLASS_STEERED = "helix_cp_route_class_steered_total"
+CP_ROUTE_STALE_NEUTRAL = "helix_cp_route_stale_neutral_total"
+CP_ROUTE_AFFINITY_ENTRIES = "helix_cp_route_affinity_entries"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except (TypeError, ValueError):
+        return default
+
+
+@dataclasses.dataclass(frozen=True)
+class RouterPolicy:
+    """Placement policy knobs (see README "Routing & autoscaling").
+
+    The default (``policy="rr"``, ``affinity=False``) preserves the seed
+    least-loaded/round-robin behaviour bit-for-bit — the scored path is
+    opt-in via ``HELIX_ROUTER_POLICY=scored``, the same default-off
+    contract ``sched.py`` shipped with."""
+
+    policy: str = ROUTE_POLICY_RR
+    # hard-avoid: a runner at/past these is routed to only when no
+    # alternative exists (its next admissions are one step from a typed
+    # kv_exhausted shed)
+    kv_avoid_threshold: float = 0.85
+    host_avoid_threshold: float = 0.92
+    # a scheduler prefill budget squeezed to (0, this] means SLO burn is
+    # actively throttling admission there — hard-avoid (0 = unbudgeted,
+    # never an avoid signal)
+    prefill_avoid_tokens: float = 256.0
+    # full: past this the runner is a GUARANTEED kv_exhausted for a new
+    # admission — when every candidate is full the cp sheds with a typed
+    # 503 instead of dispatching into certain failure
+    kv_full_threshold: float = 0.98
+    # batch-class traffic is steered away from runners whose tenants are
+    # burning SLO budget faster than it accrues
+    burn_steer_threshold: float = 1.0
+    # saturation older than this (but inside the heartbeat TTL) is
+    # treated as unknown — scored NEUTRAL, never best
+    stale_after: float = 90.0
+    # prefix-affinity routing (cp-side prompt-head digest -> runner)
+    affinity: bool = False
+    affinity_entries: int = 2048
+
+    @classmethod
+    def from_env(cls) -> "RouterPolicy":
+        raw = os.environ.get("HELIX_ROUTER_POLICY", "").strip().lower()
+        policy = (
+            ROUTE_POLICY_SCORED if raw == ROUTE_POLICY_SCORED
+            else ROUTE_POLICY_RR
+        )
+        return cls(
+            policy=policy,
+            kv_avoid_threshold=_env_float(
+                "HELIX_ROUTER_KV_AVOID_THRESHOLD", 0.85
+            ),
+            host_avoid_threshold=_env_float(
+                "HELIX_ROUTER_HOST_AVOID_THRESHOLD", 0.92
+            ),
+            prefill_avoid_tokens=_env_float(
+                "HELIX_ROUTER_PREFILL_AVOID_TOKENS", 256.0
+            ),
+            kv_full_threshold=_env_float(
+                "HELIX_ROUTER_KV_FULL_THRESHOLD", 0.98
+            ),
+            burn_steer_threshold=_env_float(
+                "HELIX_ROUTER_BURN_STEER_THRESHOLD", 1.0
+            ),
+            affinity=os.environ.get("HELIX_PREFIX_AFFINITY", "")
+            not in ("", "0"),
+            affinity_entries=_env_int(
+                "HELIX_PREFIX_AFFINITY_ENTRIES", 2048
+            ),
+        )
+
+
+def prompt_head(body: dict) -> str:
+    """The routing-relevant head of an OpenAI-shaped request body: the
+    first message (where the shared system prompt lives) for chat, the
+    prompt head for completions.  Bounded so hashing cost is O(1) in
+    prompt length — multimodal content lists are summarised from their
+    first text part (never serialised whole: a base64 image part would
+    cost megabytes of json.dumps per dispatch); '' disables affinity
+    for this request."""
+    msgs = body.get("messages")
+    if isinstance(msgs, list) and msgs:
+        first = msgs[0] if isinstance(msgs[0], dict) else {}
+        content = first.get("content", "")
+        if isinstance(content, list):
+            # OpenAI multimodal parts: key on the first TEXT part (the
+            # shared system/instruction text) plus the part-type shape,
+            # without touching image payload bytes
+            text = next(
+                (
+                    str(p.get("text", ""))[:512]
+                    for p in content[:8]
+                    if isinstance(p, dict) and p.get("type") == "text"
+                ),
+                "",
+            )
+            shape = ",".join(
+                str(p.get("type", "?")) if isinstance(p, dict) else "?"
+                for p in content[:8]
+            )
+            content = f"[{shape}]{text}"
+        elif not isinstance(content, str):
+            content = str(content)[:512]
+        return f"{first.get('role', '')}:{content[:512]}"
+    prompt = body.get("prompt", "")
+    if isinstance(prompt, list):
+        # pre-tokenised / batched prompts: a bounded slice is plenty of
+        # head identity and keeps the dump O(1) in prompt length
+        try:
+            prompt = json.dumps(prompt[:128])
+        except (TypeError, ValueError):
+            prompt = str(prompt[:16])
+    elif not isinstance(prompt, str):
+        prompt = str(prompt)[:512]
+    return prompt[:512]
+
+
+def prefix_digest(model: str, head: str) -> Optional[str]:
+    """Stable digest of (model, prompt head) — the prefix-affinity map
+    key.  None when there is no head to hash (affinity disabled for the
+    request, never a shared empty-string bucket)."""
+    if not head:
+        return None
+    h = hashlib.blake2b(digest_size=8)
+    h.update(model.encode("utf-8", "replace"))
+    h.update(b"\x00")
+    h.update(head.encode("utf-8", "replace"))
+    return h.hexdigest()
+
+
+class PrefixAffinity:
+    """Bounded LRU of prefix digest -> the runner whose PrefixCache /
+    host tier most recently served that prompt head.  A hint, not a pin:
+    ``pick_runner`` honours it only while the runner is routable and not
+    saturated (affinity yields to saturation)."""
+
+    def __init__(self, max_entries: int = 2048):
+        self.max_entries = max(1, int(max_entries))
+        self._lock = threading.Lock()
+        self._map: "collections.OrderedDict[str, str]" = (
+            collections.OrderedDict()
+        )
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            rid = self._map.get(key)
+            if rid is not None:
+                self._map.move_to_end(key)
+            return rid
+
+    def put(self, key: str, runner_id: str) -> None:
+        with self._lock:
+            self._map.pop(key, None)
+            self._map[key] = runner_id
+            while len(self._map) > self.max_entries:
+                self._map.popitem(last=False)
+
+    def forget_runner(self, runner_id: str) -> None:
+        """Drop every hint pointing at a departed runner (evict/remove)."""
+        with self._lock:
+            for k in [
+                k for k, v in self._map.items() if v == runner_id
+            ]:
+                del self._map[k]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -164,6 +365,10 @@ class RunnerState:
     # is pruned with the runner on evict_stale()/remove() — no /metrics
     # label-cardinality leak under runner churn (same rule as breakers).
     saturation: dict = dataclasses.field(default_factory=dict)
+    # clock() stamp of the last NON-EMPTY saturation block: the scored
+    # policy treats saturation older than ``RouterPolicy.stale_after``
+    # (or never reported) as unknown — scored neutral, never best
+    saturation_at: float = 0.0
     # per-tenant rollup from the last heartbeat (obs.slo.TENANT_KEYS
     # entries, top-K + __other__) — pruned with the runner like
     # saturation, so tenant gauges can never outlive their reporter
@@ -189,15 +394,31 @@ class InferenceRouter:
         ttl_seconds: float = 90.0,
         breaker: Optional[BreakerConfig] = None,
         clock: Callable[[], float] = time.monotonic,
+        policy: Optional[RouterPolicy] = None,
     ):
         self.ttl = ttl_seconds
         self.breaker_cfg = breaker or BreakerConfig()
         self.clock = clock
+        self.policy = policy if policy is not None else (
+            RouterPolicy.from_env()
+        )
         self._runners: dict[str, RunnerState] = {}
         self._rr: dict[str, int] = {}  # per-model round-robin cursor
         self._breakers: dict[str, CircuitBreaker] = {}
         self._inflight: dict[str, int] = {}
         self._lock = threading.Lock()
+        # prefix-affinity map (a hint store: always constructed, only
+        # consulted when policy.affinity) + routing decision counters
+        # for collect_cp_routing (plain ints mutated under the lock)
+        self._affinity = PrefixAffinity(self.policy.affinity_entries)
+        self.route_decisions_rr = 0
+        self.route_decisions_scored = 0
+        self.route_hard_avoided = 0
+        self.route_saturation_sheds = 0
+        self.route_affinity_hits = 0
+        self.route_affinity_yields = 0
+        self.route_class_steered = 0
+        self.route_stale_neutral = 0
 
     def _breaker(self, runner_id: str) -> CircuitBreaker:
         """Lock must be held."""
@@ -235,6 +456,8 @@ class InferenceRouter:
                 st.meta.update(meta)
             if saturation is not None:
                 st.saturation = dict(saturation)
+                if saturation:
+                    st.saturation_at = self.clock()
             if tenants is not None:
                 st.tenants = dict(tenants)
             st.draining = bool(draining)
@@ -252,7 +475,9 @@ class InferenceRouter:
             for rid in dead:
                 del self._runners[rid]
                 self._prune_dispatch_state(rid)
-            return dead
+        for rid in dead:
+            self._affinity.forget_runner(rid)
+        return dead
 
     def _prune_dispatch_state(self, runner_id: str) -> None:
         """Drop breaker/in-flight state for a departed runner (lock must
@@ -268,6 +493,7 @@ class InferenceRouter:
         with self._lock:
             self._runners.pop(runner_id, None)
             self._prune_dispatch_state(runner_id)
+        self._affinity.forget_runner(runner_id)
 
     def get(self, runner_id: str) -> Optional[RunnerState]:
         with self._lock:
@@ -300,14 +526,29 @@ class InferenceRouter:
             return out
 
     def pick_runner(
-        self, model: str, exclude: Iterable[str] = ()
+        self, model: str, exclude: Iterable[str] = (),
+        sched_class: str = "", affinity_key: Optional[str] = None,
     ) -> Optional[RunnerState]:
         """Failure- and load-aware pick over routable runners serving
         ``model``: skips runners in ``exclude`` (already tried this
         request) and runners whose circuit breaker is open (or half-open
-        with no probe budget left), prefers the least-loaded of what
-        remains, and round-robins per model among ties — so with healthy
-        idle runners the behaviour is the seed's pure round-robin."""
+        with no probe budget left).
+
+        Under the default ``rr`` policy the remainder is the seed
+        behaviour bit-for-bit: prefer the least-loaded, round-robin per
+        model among ties.  Under ``scored`` (HELIX_ROUTER_POLICY) the
+        pick closes the loop from federated heartbeat saturation:
+        runners near KV/host-pool exhaustion (or with a squeezed prefill
+        budget) are hard-avoided unless no alternative exists, runners
+        past the FULL threshold are never picked (``None`` — the caller
+        sheds via ``saturation_retry_after`` instead of dispatching into
+        a guaranteed kv_exhausted), queue depth / slot and KV occupancy
+        / in-flight dispatches / spec acceptance soft-rank the rest,
+        batch-class traffic (``sched_class``) steers away from runners
+        whose tenants are burning SLO budget, and stale or missing
+        saturation scores NEUTRAL — never best.  ``affinity_key`` (a
+        ``prefix_digest``) is honoured as a hint when the remembered
+        runner is a non-avoided candidate; it yields to saturation."""
         now = self.clock()
         exclude = set(exclude)
         with self._lock:
@@ -329,9 +570,35 @@ class InferenceRouter:
             ]
             if not allowed:
                 return None
+            if self.policy.policy == ROUTE_POLICY_SCORED:
+                return self._pick_scored(
+                    model, allowed, now, sched_class, affinity_key
+                )
+            # -- seed baseline (bit-for-bit): least-loaded + RR ---------
             min_load = min(
                 self._inflight.get(st.id, 0) for st in allowed
             )
+            if affinity_key is not None and self.policy.affinity:
+                # a hint, not a pin, under rr too: honoured only while
+                # the hinted runner is among the least-loaded — a busy
+                # runner's popular prompt head rebalances instead of
+                # pinning all same-head traffic onto it
+                hint = self._affinity.get(affinity_key)
+                chosen = next(
+                    (
+                        st for st in allowed
+                        if st.id == hint
+                        and self._inflight.get(st.id, 0) <= min_load
+                    ),
+                    None,
+                )
+                if chosen is not None:
+                    self.route_affinity_hits += 1
+                    self.route_decisions_rr += 1
+                    self._affinity.put(affinity_key, chosen.id)
+                    return chosen
+                if hint is not None:
+                    self.route_affinity_yields += 1
             least = [
                 st
                 for st in allowed
@@ -340,7 +607,189 @@ class InferenceRouter:
             cursor = self._rr.get(model, 0)
             chosen = least[cursor % len(least)]
             self._rr[model] = (cursor + 1) % max(len(least), 1)
+            self.route_decisions_rr += 1
+            if affinity_key is not None and self.policy.affinity:
+                self._affinity.put(affinity_key, chosen.id)
             return chosen
+
+    # -- scored policy internals (lock must be held) -----------------------
+
+    def _score(
+        self, st: RunnerState, now: float, sched_class: str
+    ) -> tuple:
+        """One candidate's routing verdict: ``(full, avoid, score,
+        steered)``.  Score components live in [0, 1], lower = better;
+        unknown (missing/stale) saturation pins every saturation-derived
+        component at the 0.5 midpoint so an unreporting runner is
+        NEUTRAL — it can win against a loaded runner but never against
+        one that reports being idle (the 'fresh heartbeat with no
+        saturation yet looks idle' bugfix)."""
+        p = self.policy
+        sat = st.saturation
+        fresh = bool(sat) and (now - st.saturation_at) <= p.stale_after
+        full = avoid = False
+        if not fresh:
+            self.route_stale_neutral += 1
+            kv = host = slots = queue = spec = 0.5
+        else:
+            kv = min(max(float(sat.get("kv_occupancy", 0.0)), 0.0), 1.0)
+            host = min(
+                max(float(sat.get("kv_host_occupancy", 0.0)), 0.0), 1.0
+            )
+            total = float(sat.get("slots_total", 0) or 0)
+            slots = (
+                min(float(sat.get("slots_busy", 0)) / total, 1.0)
+                if total > 0 else 0.5
+            )
+            qd = max(float(sat.get("queue_depth", 0)), 0.0)
+            queue = qd / (qd + 4.0)
+            # warm speculative acceptance is a soft preference; ratio 0
+            # usually means spec is off/cold — neutral, not worst
+            ratio = min(
+                max(float(sat.get("spec_acceptance_ratio", 0.0)), 0.0),
+                1.0,
+            )
+            spec = (1.0 - ratio) if ratio > 0 else 0.5
+            budget = float(sat.get("prefill_budget_tokens", 0) or 0)
+            avoid = (
+                kv >= p.kv_avoid_threshold
+                or host >= p.host_avoid_threshold
+                # 0 = unbudgeted; a budget squeezed to the floor means
+                # the scheduler's SLO-burn feedback is throttling there
+                or 0 < budget <= p.prefill_avoid_tokens
+            )
+            full = kv >= p.kv_full_threshold
+        infl = float(self._inflight.get(st.id, 0))
+        load = infl / (infl + 4.0)
+        score = (
+            0.30 * kv + 0.10 * host + 0.15 * slots
+            + 0.20 * queue + 0.15 * load + 0.10 * spec
+        )
+        steered = False
+        if sched_class == "batch":
+            top = (st.tenants or {}).get("top") or []
+            worst = max(
+                (
+                    float(e.get("burn_rate_fast", 0.0) or 0.0)
+                    for e in top
+                    if isinstance(e, dict)
+                ),
+                default=0.0,
+            )
+            if worst > p.burn_steer_threshold:
+                # keep batch floods off a runner whose interactive
+                # tenants are already burning SLO budget — a soft
+                # penalty, not an avoid (batch still lands somewhere)
+                score += 0.5
+                steered = True
+        return full, avoid, score, steered
+
+    def _pick_scored(
+        self, model: str, allowed: list, now: float,
+        sched_class: str, affinity_key: Optional[str],
+    ) -> Optional[RunnerState]:
+        scored = [
+            (st, *self._score(st, now, sched_class)) for st in allowed
+        ]
+        # FULL runners are excluded from BOTH pools (a dispatch there is
+        # a guaranteed kv_exhausted) — including from `ok`, so a config
+        # with kv_avoid_threshold above kv_full_threshold cannot sneak a
+        # full-but-not-avoided runner back in
+        ok = [e for e in scored if not e[1] and not e[2]]
+        last_resort = [e for e in scored if e[2] and not e[1]]
+        if ok and len(ok) < len(scored):
+            self.route_hard_avoided += 1
+        if any(e[4] for e in scored):
+            self.route_class_steered += 1
+        pool = ok or last_resort
+        if not pool:
+            # every candidate is FULL: dispatching is a guaranteed typed
+            # kv_exhausted at the runner — the caller sheds at the cp
+            # with an honest Retry-After (saturation_retry_after)
+            return None
+        if affinity_key is not None and self.policy.affinity:
+            hint = self._affinity.get(affinity_key)
+            if hint is not None:
+                entry = next(
+                    (e for e in ok if e[0].id == hint), None
+                )
+                if entry is not None:
+                    self.route_affinity_hits += 1
+                    self.route_decisions_scored += 1
+                    self._affinity.put(affinity_key, entry[0].id)
+                    return entry[0]
+                # the remembered runner is gone, excluded, or saturated:
+                # affinity is a hint, not a pin — yield to the scorer
+                self.route_affinity_yields += 1
+        best = min(e[3] for e in pool)
+        least = [e[0] for e in pool if e[3] <= best + 1e-9]
+        cursor = self._rr.get(model, 0)
+        chosen = least[cursor % len(least)]
+        self._rr[model] = (cursor + 1) % max(len(least), 1)
+        self.route_decisions_scored += 1
+        if affinity_key is not None and self.policy.affinity:
+            self._affinity.put(affinity_key, chosen.id)
+        return chosen
+
+    def saturation_retry_after(self, model: str) -> Optional[int]:
+        """When the scored policy refused to place a request because
+        EVERY fresh, routable, non-draining runner serving ``model`` is
+        past the FULL KV threshold: the honest Retry-After in seconds
+        (cluster queue backlog over cluster goodput, clamped to [1, 30]).
+        None = not a saturation shed — the caller keeps its ordinary
+        error shape (breakers-open / no-candidates)."""
+        if self.policy.policy != ROUTE_POLICY_SCORED:
+            return None
+        now = self.clock()
+        with self._lock:
+            serving = [
+                st
+                for st in self._runners.values()
+                if st.routable
+                and not st.draining
+                and model in st.models
+                and now - st.last_heartbeat <= self.ttl
+            ]
+            if not serving:
+                return None
+            qd = tps = 0.0
+            for st in serving:
+                sat = st.saturation
+                fresh = bool(sat) and (
+                    now - st.saturation_at <= self.policy.stale_after
+                )
+                if not fresh or (
+                    float(sat.get("kv_occupancy", 0.0))
+                    < self.policy.kv_full_threshold
+                ):
+                    return None
+                qd += max(float(sat.get("queue_depth", 0)), 0.0)
+                tps += max(float(sat.get("tokens_per_sec", 0.0)), 0.0)
+            self.route_saturation_sheds += 1
+            return max(1, min(30, int(qd / max(tps, 1.0)) + 1))
+
+    def routing_status(self) -> dict:
+        """The /v1/cluster/status 'routing' block: live policy +
+        decision counters (the JSON twin of collect_cp_routing)."""
+        p = self.policy
+        return {
+            "policy": p.policy,
+            "prefix_affinity": p.affinity,
+            "kv_avoid_threshold": p.kv_avoid_threshold,
+            "kv_full_threshold": p.kv_full_threshold,
+            "host_avoid_threshold": p.host_avoid_threshold,
+            "prefill_avoid_tokens": p.prefill_avoid_tokens,
+            "burn_steer_threshold": p.burn_steer_threshold,
+            "decisions_rr": self.route_decisions_rr,
+            "decisions_scored": self.route_decisions_scored,
+            "hard_avoided": self.route_hard_avoided,
+            "saturation_sheds": self.route_saturation_sheds,
+            "affinity_hits": self.route_affinity_hits,
+            "affinity_yields": self.route_affinity_yields,
+            "class_steered": self.route_class_steered,
+            "stale_neutral": self.route_stale_neutral,
+            "affinity_entries": len(self._affinity),
+        }
 
     def drain_retry_after(self, model: str) -> Optional[int]:
         """When EVERY fresh, routable runner serving ``model`` is
@@ -489,3 +938,56 @@ class InferenceRouter:
                 }
                 for rid, br in sorted(self._breakers.items())
             }
+
+
+def collect_cp_routing(c, router: "InferenceRouter") -> None:
+    """Control-plane routing series (called from the cp's scrape-time
+    collector; plain GIL-atomic reads).  The ``helix_cp_route_*``
+    vocabulary is minted here and only here (lint contract 8)."""
+    c.gauge(
+        CP_ROUTE_POLICY,
+        1 if router.policy.policy == ROUTE_POLICY_SCORED else 0,
+        help="1 while the saturation-aware scored routing policy is on",
+    )
+    c.counter(
+        CP_ROUTE_DECISIONS, router.route_decisions_rr,
+        {"policy": ROUTE_POLICY_RR},
+        help="Placement decisions by policy",
+    )
+    c.counter(
+        CP_ROUTE_DECISIONS, router.route_decisions_scored,
+        {"policy": ROUTE_POLICY_SCORED},
+    )
+    c.counter(
+        CP_ROUTE_HARD_AVOIDED, router.route_hard_avoided,
+        help="Picks that steered around a runner near KV/host-pool "
+             "exhaustion or with a squeezed prefill budget",
+    )
+    c.counter(
+        CP_ROUTE_SATURATION_SHEDS, router.route_saturation_sheds,
+        help="Requests shed at the control plane (typed 503) because "
+             "every candidate runner was past the FULL KV threshold",
+    )
+    c.counter(
+        CP_ROUTE_AFFINITY_HITS, router.route_affinity_hits,
+        help="Dispatches placed on the prefix-affinity hinted runner",
+    )
+    c.counter(
+        CP_ROUTE_AFFINITY_YIELDS, router.route_affinity_yields,
+        help="Affinity hints not honoured (runner gone, excluded, or "
+             "saturated) — affinity yields to saturation",
+    )
+    c.counter(
+        CP_ROUTE_CLASS_STEERED, router.route_class_steered,
+        help="Batch-class picks where at least one candidate was "
+             "penalised for tenant SLO-budget burn",
+    )
+    c.counter(
+        CP_ROUTE_STALE_NEUTRAL, router.route_stale_neutral,
+        help="Candidate scorings that fell back to the neutral midpoint "
+             "because the runner's saturation was missing or stale",
+    )
+    c.gauge(
+        CP_ROUTE_AFFINITY_ENTRIES, len(router._affinity),
+        help="Live prefix-digest -> runner entries in the affinity LRU",
+    )

@@ -32,8 +32,14 @@ import aiohttp
 from aiohttp import web
 
 from helix_tpu import obs
+from helix_tpu.control.compute import collect_cp_autoscale
 from helix_tpu.control.profile import ServingProfile, check_compatibility
-from helix_tpu.control.router import InferenceRouter
+from helix_tpu.control.router import (
+    InferenceRouter,
+    collect_cp_routing,
+    prefix_digest,
+    prompt_head,
+)
 from helix_tpu.control.store import Store
 from helix_tpu.obs.flight import SATURATION_KEYS
 from helix_tpu.obs.slo import (
@@ -223,8 +229,15 @@ class ControlPlane:
             _os_env.environ.get("HELIX_DB_DSN") or db_path
         )
         self.store = Store(self.db)
+        # routing policy comes from the environment (HELIX_ROUTER_POLICY
+        # / HELIX_PREFIX_AFFINITY / HELIX_ROUTER_* thresholds); the
+        # default is the seed least-loaded/RR behaviour bit-for-bit
         self.router = InferenceRouter()
         self.tunnels = TunnelHub()
+        # runner ids the autoscaler (or an operator via POST
+        # /api/v1/runners/{id}/drain) asked to drain: surfaced on the
+        # assignment poll so the node agent runs its graceful ladder
+        self._drain_requested: set = set()
         # failure-aware dispatch (ISSUE 2): one shared client session for
         # the whole dispatch path (created lazily on the event loop,
         # closed via app.on_cleanup), bounded retry/failover with capped
@@ -722,6 +735,7 @@ class ControlPlane:
             from helix_tpu.control.compute import (
                 ComputeManager,
                 StubProvider,
+                autoscale_config_from_env,
             )
 
             if compute_provider is None:
@@ -732,12 +746,73 @@ class ControlPlane:
 
                 compute_provider = _gce_from_env()
             self.compute = ComputeManager(
-                compute_cfg,
+                # HELIX_AUTOSCALE_* env knobs beat the supplied config
+                # (the HELIX_SPEC_TOKENS operator-override contract)
+                autoscale_config_from_env(compute_cfg),
                 compute_provider or StubProvider(),
                 assigned_runner_ids=lambda: {
                     rid for rid, _ in self.store.list_assignments()
                 },
+                # ISSUE 12: close the loop — the autoscaler scales on
+                # the router's federated saturation and sheds capacity
+                # through the graceful drain ladder
+                cluster_signals=self._cluster_signals,
+                request_drain=self._request_runner_drain,
             ).start()
+
+    def _cluster_signals(self) -> dict:
+        """Federated cluster saturation for the autoscaler's D5/D6 arms
+        (read from the same heartbeat state the scored router uses)."""
+        self.router.evict_stale()
+        runners = self.router.runners()
+        qd = tps = 0.0
+        occ = []
+        for st in runners:
+            sat = st.saturation
+            try:
+                qd += float(sat.get("queue_depth", 0) or 0)
+                tps += float(sat.get("tokens_per_sec", 0.0) or 0.0)
+                if "kv_occupancy" in sat:
+                    occ.append(float(sat["kv_occupancy"]))
+            except (TypeError, ValueError):
+                continue
+        worst = 0.0
+        for roll in self.router.tenants_map().values():
+            for e in roll.get("top") or []:
+                if isinstance(e, dict):
+                    try:
+                        worst = max(
+                            worst,
+                            float(e.get("burn_rate_fast", 0.0) or 0.0),
+                        )
+                    except (TypeError, ValueError):
+                        continue
+        return {
+            "queue_depth": qd,
+            "tokens_per_sec": round(tps, 2),
+            "kv_occupancy_mean": (
+                sum(occ) / len(occ) if occ else 0.0
+            ),
+            "worst_tenant_burn": worst,
+            "routable_runners": sum(1 for st in runners if st.routable),
+            # runners whose heartbeats carry a saturation block: zero =
+            # the telemetry is dark, not the cluster idle — the
+            # autoscaler must not drain capacity on no data
+            "reporting_runners": sum(
+                1 for st in runners if st.saturation
+            ),
+            "live_runners": [st.id for st in runners],
+            "draining_runners": [
+                st.id for st in runners if st.draining
+            ],
+        }
+
+    def _request_runner_drain(self, runner_id: str) -> None:
+        """Mark a runner for graceful drain: the next assignment poll
+        answers ``drain: true`` and the node agent runs the ISSUE 11
+        ladder (announce draining -> drain -> export survivors -> exit)."""
+        if runner_id:
+            self._drain_requested.add(runner_id)
 
     def stop(self):
         """Stop every background service (shutdown / test teardown)."""
@@ -917,6 +992,8 @@ class ControlPlane:
             self.compatible_profiles,
         )
         r.add_get("/api/v1/runners/{id}/logs", self.runner_logs)
+        r.add_post("/api/v1/runners/{id}/drain", self.request_drain)
+        r.add_delete("/api/v1/runners/{id}/drain", self.cancel_drain)
         # drain migration targets (ISSUE 11): a draining runner asks
         # where to ship its in-flight request snapshots
         r.add_get(
@@ -1448,6 +1525,10 @@ class ControlPlane:
         collect_cp_migration(
             c, self.cp_midstream_failovers, self.router.draining_map()
         )
+        # routing + autoscale series (ISSUE 12): minted ONLY by
+        # control/router.py and control/compute.py (lint contract 8)
+        collect_cp_routing(c, self.router)
+        collect_cp_autoscale(c, self.compute)
 
     async def cluster_status(self, request):
         """Operator rollup of the whole cluster's saturation: per runner
@@ -1521,7 +1602,20 @@ class ControlPlane:
             if totals["slots_total"]
             else 0.0
         )
-        return web.json_response({"runners": runners, "cluster": totals})
+        return web.json_response(
+            {
+                "runners": runners,
+                "cluster": totals,
+                # placement + capacity feedback loop (ISSUE 12): live
+                # policy, decision counters, autoscaler lifecycle
+                "routing": self.router.routing_status(),
+                "autoscale": (
+                    self.compute.autoscale_status()
+                    if self.compute is not None
+                    else {"enabled": False}
+                ),
+            }
+        )
 
     async def tenants_usage(self, request):
         """Cluster-wide per-tenant usage + SLO rollup: the federated
@@ -1737,6 +1831,10 @@ class ControlPlane:
             draining=draining,
             drain_deadline=drain_deadline,
         )
+        if draining:
+            # the runner is acting on the drain: the request is served —
+            # stop re-announcing it on the assignment poll
+            self._drain_requested.discard(rid)
         self.store.record_heartbeat(rid, body)
         self.router.evict_stale()
         if self.compute is not None and body.get("instance_id"):
@@ -1777,8 +1875,36 @@ class ControlPlane:
         name = self.store.get_assignment(rid)
         profile = self.store.get_profile(name) if name else None
         return web.json_response(
-            {"runner_id": rid, "profile_name": name, "profile": profile}
+            {
+                "runner_id": rid,
+                "profile_name": name,
+                "profile": profile,
+                # drain-then-terminate (ISSUE 12): the autoscaler's D6
+                # arm (or an operator) asked this runner to drain — the
+                # node agent runs the graceful ladder and exits
+                "drain": rid in self._drain_requested,
+            }
         )
+
+    async def request_drain(self, request):
+        """Operator-initiated graceful drain for one runner (the same
+        channel the autoscaler's scale-down arm uses): the runner picks
+        the flag up on its next assignment poll, announces draining,
+        migrates in-flight work and exits.  Admin-gated."""
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        rid = request.match_info["id"]
+        self._request_runner_drain(rid)
+        return web.json_response({"ok": True, "runner_id": rid})
+
+    async def cancel_drain(self, request):
+        denied = self._require_admin(request)
+        if denied is not None:
+            return denied
+        rid = request.match_info["id"]
+        self._drain_requested.discard(rid)
+        return web.json_response({"ok": True, "runner_id": rid})
 
     async def assign_profile(self, request):
         """422 with structured violations on incompatibility, like the
@@ -4917,7 +5043,18 @@ class ControlPlane:
                 request, body, raw, model, trace_id, tenant, sched_class,
                 t_req,
             )
-        runner = self.router.pick_runner(model)
+        # prefix-affinity routing (ISSUE 12, HELIX_PREFIX_AFFINITY):
+        # requests sharing a prompt head (system prompt) land on the
+        # runner whose PrefixCache / host tier already holds those pages
+        # — a hint the router may override for a saturated runner
+        affinity_key = (
+            prefix_digest(model, prompt_head(body))
+            if self.router.policy.affinity and model
+            else None
+        )
+        runner = self.router.pick_runner(
+            model, sched_class=sched_class, affinity_key=affinity_key
+        )
         if runner is None:
             if model and model in self.router.model_map():
                 # cluster-wide drain (ISSUE 11): every runner serving
@@ -4944,6 +5081,34 @@ class ControlPlane:
                         status=503,
                         headers={
                             "Retry-After": str(drain_after),
+                            TRACE_HEADER: trace_id,
+                        },
+                    )
+                # saturation shed (ISSUE 12, scored policy): every
+                # candidate is past the FULL KV threshold — dispatching
+                # would land a guaranteed typed kv_exhausted at the
+                # runner after a queue wait.  Shed HERE with an honest
+                # Retry-After (cluster backlog over cluster goodput)
+                # so clients back off instead of deepening the queues.
+                sat_after = self.router.saturation_retry_after(model)
+                if sat_after is not None:
+                    self.dispatch_exhausted += 1
+                    return web.json_response(
+                        {
+                            "error": {
+                                "message": (
+                                    f"every runner serving '{model}' "
+                                    "is KV-saturated; retry after "
+                                    f"{sat_after}s"
+                                ),
+                                "type": "overloaded_error",
+                                "code": "kv_saturated",
+                                "trace_id": trace_id,
+                            }
+                        },
+                        status=503,
+                        headers={
+                            "Retry-After": str(sat_after),
                             TRACE_HEADER: trace_id,
                         },
                     )
@@ -4986,12 +5151,16 @@ class ControlPlane:
         attempt = 0
         while attempt < self.dispatch_max_attempts:
             if runner is None:
-                runner = self.router.pick_runner(model, exclude=tried)
+                runner = self.router.pick_runner(
+                    model, exclude=tried, sched_class=sched_class
+                )
                 if runner is None and tried:
                     # every distinct candidate already failed once this
                     # request; revisit (faults may be transient) as long
                     # as a breaker still admits traffic
-                    runner = self.router.pick_runner(model)
+                    runner = self.router.pick_runner(
+                        model, sched_class=sched_class
+                    )
                 if runner is None:
                     break
                 self.dispatch_failovers += 1   # a retry found a runner
@@ -5319,6 +5488,11 @@ class ControlPlane:
         )
         loop = asyncio.get_running_loop()
         deadline = loop.time() + self.dispatch_total_timeout
+        affinity_key = (
+            prefix_digest(model, prompt_head(body))
+            if self.router.policy.affinity and model
+            else None
+        )
         fwd_headers = {
             "Content-Type": "application/json",
             TRACE_HEADER: trace_id,
@@ -5402,9 +5576,14 @@ class ControlPlane:
                 if self.runner_token:
                     headers["X-Runner-Token"] = self.runner_token
             else:
-                target = self.router.pick_runner(model, exclude=tried)
+                target = self.router.pick_runner(
+                    model, exclude=tried, sched_class=sched_class,
+                    affinity_key=affinity_key,
+                )
                 if target is None and tried:
-                    target = self.router.pick_runner(model)
+                    target = self.router.pick_runner(
+                        model, sched_class=sched_class
+                    )
                 if target is None:
                     break
                 path = request.path
@@ -5656,8 +5835,21 @@ class ControlPlane:
         )
         drain_after = self.router.drain_retry_after(model)
         if client is None:
-            code = "draining" if drain_after is not None else (
-                "runners_exhausted"
+            # saturation shed (ISSUE 12): the stream path must answer a
+            # fully KV-saturated cluster with the same typed
+            # kv_saturated + honest Retry-After as the non-stream path
+            # — Retry-After: 1 here would have streaming clients
+            # hammering an overload.  Queried only on this pre-byte
+            # branch: saturation_retry_after counts a cp-side shed, and
+            # a mid-stream abort frame is not one.
+            sat_after = (
+                self.router.saturation_retry_after(model)
+                if drain_after is None else None
+            )
+            code = (
+                "draining" if drain_after is not None
+                else "kv_saturated" if sat_after is not None
+                else "runners_exhausted"
             )
             return web.json_response(
                 {
@@ -5674,7 +5866,7 @@ class ControlPlane:
                 },
                 status=503,
                 headers={
-                    "Retry-After": str(drain_after or 1),
+                    "Retry-After": str(drain_after or sat_after or 1),
                     TRACE_HEADER: trace_id,
                 },
             )

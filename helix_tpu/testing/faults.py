@@ -26,6 +26,8 @@ Rule shapes (dicts, JSON-friendly for the env var)::
      "delay": 0.5}
     {"point": "stream", "runner": "r1", "after_chunks": 2, "times": 1}
     {"point": "heartbeat", "runner": "r1"}          # drop heartbeats
+    {"point": "saturation", "runner": "r1",
+     "set": {"kv_occupancy": 0.99}}                 # fake saturation
     {"point": "host_pool", "op": "restore", "mode": "slow", "delay": 0.2}
     {"point": "host_pool", "op": "restore", "mode": "corrupt", "times": 1}
     {"point": "host_pool", "op": "spill", "mode": "alloc_fail", "p": 0.5}
@@ -207,6 +209,29 @@ class FaultInjector:
                     "mode": rule.get("mode", "slow"),
                     "delay": float(rule.get("delay", 0.05)),
                 }
+        return None
+
+    def saturation_override(self, runner_id: str) -> Optional[dict]:
+        """Keys to override in this runner's heartbeat saturation
+        summary, or None (ISSUE 12: drives one runner toward apparent
+        KV/host-pool exhaustion so routing and autoscale behaviour under
+        saturation is testable deterministically, without waiting for a
+        real pool to fill).  The node agent filters the override through
+        the shared SATURATION_KEYS schema before emitting.  Rule shape::
+
+            {"point": "saturation", "runner": "r1",
+             "set": {"kv_occupancy": 0.99, "queue_depth": 40}}
+        """
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "saturation":
+                    continue
+                if rule.get("runner", "*") not in ("*", runner_id):
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                over = rule.get("set")
+                return dict(over) if isinstance(over, dict) else None
         return None
 
     def drop_heartbeat(self, runner_id: str) -> bool:

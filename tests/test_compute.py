@@ -305,3 +305,337 @@ class TestControlPlaneWiring:
                 cp.triggers.stop()
 
         asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 12: saturation-driven scaling + drain-then-terminate
+# ---------------------------------------------------------------------------
+
+
+def make_scaled(cfg, signals):
+    """Autoscaler wired to a mutable signals dict + a drain recorder."""
+    clock = FakeClock()
+    provider = StubProvider()
+    drains = []
+    mgr = ComputeManager(
+        cfg,
+        provider,
+        InstanceStore(),
+        now=clock,
+        cluster_signals=lambda: signals,
+        request_drain=drains.append,
+    )
+    return mgr, provider, clock, drains
+
+
+def _scale_cfg(**over):
+    base = dict(
+        floor=1, max=3, reconcile_interval=1,
+        scale_up_queue_depth=10, scale_up_burn=2.0,
+        scale_sustain_seconds=30.0, scale_down_idle_seconds=30.0,
+        drain_grace_seconds=300.0,
+        # keep the sandbox-era arms out of these scenarios
+        idle_timeout=0, heartbeat_stale_after=0, offline_reap_after=0,
+    )
+    base.update(over)
+    return ManagerConfig(**base)
+
+
+def _boot(mgr, n_extra=0, runner_ids=()):
+    """Run reconcile until floor(+manual extras) are ready; bind runner
+    ids so D6 has drainable victims."""
+    mgr.reconcile()
+    mgr.reconcile()
+    for i in range(n_extra):
+        mgr._provision_one()
+    mgr.reconcile()
+    rows = sorted(
+        (r for r in mgr.store.list() if r.compute_state == "ready"),
+        key=lambda r: (r.ready_at, r.id),
+    )
+    for r, rid in zip(rows, runner_ids):
+        r.runner_id = rid
+    return rows
+
+
+class TestSaturationBurst:
+    def test_sustained_queue_depth_provisions(self):
+        signals = {"queue_depth": 0, "live_runners": ["rA"]}
+        mgr, stub, clock, _ = make_scaled(_scale_cfg(), signals)
+        _boot(mgr, runner_ids=["rA"])
+        owned = len(stub.provisioned)
+        signals["queue_depth"] = 25
+        mgr.reconcile()              # hot noted — one scrape must not act
+        assert len(stub.provisioned) == owned
+        clock.advance(31)
+        mgr.reconcile()              # sustained past the window: burst
+        assert len(stub.provisioned) == owned + 1
+        assert mgr.saturation_bursts == 1
+        # the freshly provisioned capacity re-arms the window
+        mgr.reconcile()
+        assert len(stub.provisioned) == owned + 1
+
+    def test_burst_clears_when_backlog_drains(self):
+        signals = {"queue_depth": 25, "live_runners": []}
+        mgr, stub, clock, _ = make_scaled(_scale_cfg(), signals)
+        _boot(mgr)
+        owned = len(stub.provisioned)
+        mgr.reconcile()
+        signals["queue_depth"] = 0   # backlog drained before sustain
+        clock.advance(31)
+        mgr.reconcile()
+        assert len(stub.provisioned) == owned
+        assert mgr.saturation_bursts == 0
+
+    def test_worst_tenant_burn_triggers_burst(self):
+        signals = {"queue_depth": 0, "worst_tenant_burn": 5.0,
+                   "live_runners": []}
+        mgr, stub, clock, _ = make_scaled(_scale_cfg(), signals)
+        _boot(mgr)
+        owned = len(stub.provisioned)
+        mgr.reconcile()
+        clock.advance(31)
+        mgr.reconcile()
+        assert len(stub.provisioned) == owned + 1
+
+    def test_burst_respects_max(self):
+        signals = {"queue_depth": 99, "live_runners": []}
+        mgr, stub, clock, _ = make_scaled(_scale_cfg(max=1), signals)
+        _boot(mgr)
+        owned = len(stub.provisioned)
+        mgr.reconcile()
+        clock.advance(31)
+        mgr.reconcile()
+        assert len(stub.provisioned) == owned   # at the ceiling
+
+
+class TestDrainThenTerminate:
+    def _idle(self, live):
+        return {"queue_depth": 0, "worst_tenant_burn": 0.0,
+                "live_runners": list(live)}
+
+    def test_drain_requested_then_terminated_when_runner_leaves(self):
+        signals = self._idle(["rA", "rB"])
+        mgr, stub, clock, drains = make_scaled(_scale_cfg(), signals)
+        _boot(mgr, n_extra=1, runner_ids=["rA", "rB"])
+        assert len(ready_rows(mgr)) == 2
+        mgr.reconcile()              # idle noted
+        clock.advance(31)
+        mgr.reconcile()              # sustained idle: drain the NEWEST
+        assert drains == ["rB"]
+        victim = next(r for r in mgr.store.list() if r.runner_id == "rB")
+        assert victim.draining is True
+        # still alive: the runner is mid-drain, nothing terminated yet
+        assert stub.deprovisioned == []
+        clock.advance(5)
+        mgr.reconcile()
+        assert stub.deprovisioned == []
+        # the runner finished its ladder and left the router
+        signals["live_runners"] = ["rA"]
+        clock.advance(5)
+        mgr.reconcile()
+        assert len(stub.deprovisioned) == 1
+        assert len(ready_rows(mgr)) == 1       # back at floor
+        # at floor: sustained idle must NOT drain the last host
+        clock.advance(120)
+        mgr.reconcile()
+        assert drains == ["rB"]
+
+    def test_drain_grace_terminates_a_stuck_runner(self):
+        signals = self._idle(["rA", "rB"])
+        mgr, stub, clock, drains = make_scaled(
+            _scale_cfg(drain_grace_seconds=60.0), signals
+        )
+        _boot(mgr, n_extra=1, runner_ids=["rA", "rB"])
+        mgr.reconcile()
+        clock.advance(31)
+        mgr.reconcile()
+        assert drains == ["rB"]
+        clock.advance(61)            # runner never left: grace expires
+        mgr.reconcile()
+        assert len(stub.deprovisioned) == 1
+
+    def test_one_victim_at_a_time(self):
+        signals = self._idle(["rA", "rB", "rC"])
+        mgr, stub, clock, drains = make_scaled(_scale_cfg(), signals)
+        _boot(mgr, n_extra=2, runner_ids=["rA", "rB", "rC"])
+        mgr.reconcile()
+        clock.advance(31)
+        mgr.reconcile()
+        assert len(drains) == 1
+        clock.advance(31)
+        mgr.reconcile()              # first victim still draining
+        assert len(drains) == 1
+
+    def test_assigned_runner_not_drained(self):
+        signals = self._idle(["rA", "rB"])
+        clock = FakeClock()
+        stub = StubProvider()
+        drains = []
+        mgr = ComputeManager(
+            _scale_cfg(), stub, InstanceStore(),
+            assigned_runner_ids=lambda: {"rB"},
+            now=clock,
+            cluster_signals=lambda: signals,
+            request_drain=drains.append,
+        )
+        _boot(mgr, n_extra=1, runner_ids=["rA", "rB"])
+        mgr.reconcile()
+        clock.advance(31)
+        mgr.reconcile()
+        assert drains == ["rA"]      # rB holds an assignment: protected
+
+    def test_idle_arm_drains_instead_of_hard_killing(self):
+        """With graceful scale-down enabled, the D4 sandbox-idle arm
+        must not hard-kill a host that registered a runner (it may be
+        serving inference with zero sandboxes): it requests a drain and
+        the host terminates through the ladder."""
+        signals = self._idle(["rA", "rB"])
+        mgr, stub, clock, drains = make_scaled(
+            _scale_cfg(idle_timeout=10.0), signals
+        )
+        _boot(mgr, n_extra=1, runner_ids=["rA", "rB"])
+        mgr.reconcile()
+        clock.advance(31)            # past BOTH idle thresholds
+        mgr.reconcile()
+        assert len(drains) == 1      # drained, not deprovisioned
+        assert stub.deprovisioned == []
+        assert sum(1 for r in mgr.store.list() if r.draining) == 1
+        # the draining victim is never hard-killed by later D4 cycles
+        clock.advance(20)
+        mgr.reconcile()
+        assert stub.deprovisioned == []
+        # runner leaves -> the ladder terminates the host
+        signals["live_runners"] = [
+            r for r in ("rA", "rB")
+            if r != drains[0]
+        ]
+        mgr.reconcile()
+        assert len(stub.deprovisioned) == 1
+
+
+class TestHeartbeatBinding:
+    def test_heartbeat_binds_by_provider_id(self):
+        """Autoscaled hosts only know their cloud identity (GCE exports
+        HELIX_INSTANCE_ID=$(hostname) = the instance/provider id);
+        heartbeats must still find the row."""
+        mgr, stub, clock = make(
+            ManagerConfig(floor=1, reconcile_interval=1)
+        )
+        mgr.reconcile()
+        mgr.reconcile()
+        row = ready_rows(mgr)[0]
+        clock.advance(50)
+        mgr.heartbeat(row.provider_id, runner_id="gce-host-1",
+                      active_sandboxes=2)
+        assert row.heartbeat_at == clock()
+        assert row.runner_id == "gce-host-1"
+        assert row.active_sandboxes == 2
+
+
+class TestAutoscaleEnvOverrides:
+    def test_env_beats_config(self, monkeypatch):
+        from helix_tpu.control.compute import autoscale_config_from_env
+
+        monkeypatch.setenv("HELIX_AUTOSCALE_QUEUE_HIGH", "42")
+        monkeypatch.setenv("HELIX_AUTOSCALE_IDLE_SECONDS", "120")
+        monkeypatch.setenv("HELIX_AUTOSCALE_MAX", "7")
+        monkeypatch.setenv("HELIX_AUTOSCALE_BURN_HIGH", "bogus")
+        base = ManagerConfig(floor=2, scale_up_burn=1.5)
+        cfg = autoscale_config_from_env(base)
+        assert cfg.scale_up_queue_depth == 42
+        assert cfg.scale_down_idle_seconds == 120.0
+        assert cfg.max == 7
+        assert cfg.floor == 2                 # untouched
+        assert cfg.scale_up_burn == 1.5       # unparsable kept base
+
+    def test_status_and_collector(self):
+        from helix_tpu import obs
+        from helix_tpu.control.compute import collect_cp_autoscale
+
+        signals = {"queue_depth": 0, "live_runners": []}
+        mgr, stub, clock, _ = make_scaled(_scale_cfg(), signals)
+        _boot(mgr)
+        status = mgr.autoscale_status()
+        assert status["enabled"] and status["instances"]["ready"] == 1
+        reg = obs.Registry()
+        reg.register_callback(lambda c: collect_cp_autoscale(c, mgr))
+        text = reg.render()
+        assert "helix_cp_autoscale_provisions_total" in text
+        assert 'helix_cp_autoscale_instances{state="ready"} 1' in text
+        # None-safe: the cp calls it with autoscaler off
+        reg2 = obs.Registry()
+        reg2.register_callback(lambda c: collect_cp_autoscale(c, None))
+        assert "helix_cp_autoscale" not in reg2.render()
+
+
+class TestReviewRegressions:
+    """Fixes from the pre-merge review pass."""
+
+    def test_d4_graceful_never_drains_below_floor(self):
+        """The idle arm's graceful path: draining hosts no longer count
+        as ready capacity, one victim at a time, and the fleet stops at
+        floor."""
+        signals = {"queue_depth": 0, "worst_tenant_burn": 0.0,
+                   "live_runners": ["rA", "rB", "rC"]}
+        mgr, stub, clock, drains = make_scaled(
+            _scale_cfg(floor=2, idle_timeout=10.0,
+                       scale_down_idle_seconds=30.0), signals
+        )
+        _boot(mgr, n_extra=1, runner_ids=["rA", "rB", "rC"])
+        assert len(ready_rows(mgr)) == 3
+        for _ in range(6):
+            clock.advance(31)
+            mgr.reconcile()
+        # only ONE drain ever started (3 ready, floor 2), and nothing
+        # was hard-killed while it ran
+        assert len(drains) == 1
+        assert stub.deprovisioned == []
+        # drain completes -> host terminated -> at floor, no more drains
+        signals["live_runners"] = [
+            r for r in ("rA", "rB", "rC") if r != drains[0]
+        ]
+        for _ in range(6):
+            clock.advance(31)
+            mgr.reconcile()
+        assert len(stub.deprovisioned) == 1
+        assert len(ready_rows(mgr)) == 2
+        assert len(drains) == 1
+
+    def test_no_scaling_decisions_on_missing_signals(self):
+        """A signal outage is indistinguishable from idleness: empty or
+        failing cluster_signals must never drain (or burst)."""
+        drained = []
+        mgr = ComputeManager(
+            _scale_cfg(), StubProvider(), InstanceStore(),
+            now=FakeClock(),
+            cluster_signals=lambda: (_ for _ in ()).throw(
+                RuntimeError("signals down")
+            ),
+            request_drain=drained.append,
+        )
+        mgr.reconcile()
+        mgr.now.advance(120)
+        mgr.reconcile()
+        assert mgr.saturation_bursts == 0
+        assert mgr.drains_requested == 0
+
+    def test_dark_telemetry_is_not_idle(self):
+        """Runners heartbeating WITHOUT saturation blocks (cp reports
+        reporting_runners=0) must not read as an idle cluster."""
+        signals = {"queue_depth": 0, "worst_tenant_burn": 0.0,
+                   "reporting_runners": 0,
+                   "live_runners": ["rA", "rB"]}
+        mgr, stub, clock, drains = make_scaled(_scale_cfg(), signals)
+        _boot(mgr, n_extra=1, runner_ids=["rA", "rB"])
+        for _ in range(4):
+            clock.advance(31)
+            mgr.reconcile()
+        assert drains == []
+        # telemetry returns: idleness is now evidenced and D6 proceeds
+        signals["reporting_runners"] = 2
+        clock.advance(31)
+        mgr.reconcile()
+        clock.advance(31)
+        mgr.reconcile()
+        assert len(drains) == 1

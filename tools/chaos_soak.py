@@ -434,25 +434,195 @@ def run_crash(seconds: float = 10.0, seed: int = 42,
     }
 
 
+def run_scale(seconds: float = 10.0, seed: int = 42,
+              scale_every: float = 2.0) -> dict:
+    """ISSUE 12 scenario: the autoscaler keeps scaling the cluster down
+    under load.
+
+    A permanent "floor" loop and a rotating "scaled" loop share traffic
+    round-robin.  Every ``scale_every`` seconds the autoscaler's D6 arm
+    fires on the scaled loop: it drains GRACEFULLY (a real drain window,
+    unlike run_crash's near-zero one) with the floor loop as its
+    migration target, its thread exits (the host would now be
+    terminated), and a replacement is "provisioned".  Clients accumulate
+    tokens across every migration.
+
+    Exit contract: **zero stuck requests**, zero lost tokens — every
+    migrated greedy stream's combined tokens are BIT-IDENTICAL to an
+    uninterrupted reference run — and at least one request actually
+    rode the migration path (a drain window long enough to finish
+    everything would prove nothing)."""
+    import jax
+
+    from helix_tpu.engine.engine import Engine, EngineConfig, Request
+    from helix_tpu.engine.sampling import SamplingParams
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.migration import wire_to_snapshot
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def build_engine():
+        return Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=4, page_size=4, num_pages=256,
+                max_pages_per_seq=64, max_prefill_len=64,
+                attn_backend="reference", eos_token_ids=tok.eos_ids,
+            ),
+        )
+
+    rng = random.Random(seed)
+    tokens: dict[str, list] = {}
+    terminal: dict[str, bool] = {}
+    outcomes: dict[str, str] = {}
+    migrated: set = set()
+    prompts: dict[str, tuple] = {}
+
+    def on_event_for(rid):
+        def on_event(ev):
+            if ev.token_id >= 0:
+                tokens[rid].append(ev.token_id)
+            if ev.finished and not ev.error:
+                terminal[rid] = True
+                outcomes[rid] = ev.finish_reason or "stop"
+            elif ev.finished and ev.error:
+                if ev.error.startswith("migrated"):
+                    migrated.add(rid)   # continuation lands on floor
+                else:
+                    terminal[rid] = True
+                    outcomes[rid] = "error:" + ev.error.split(":")[0]
+        return on_event
+
+    floor = EngineLoop(build_engine(), "floor").start()
+
+    def exporter(wire):
+        snap = wire_to_snapshot(wire)
+        res: list = []
+        floor.submit_import(
+            snap, on_event_for(snap.request_id),
+            on_result=lambda e, c: res.append(e),
+        )
+        deadline = time.monotonic() + 30.0
+        while not res and time.monotonic() < deadline:
+            time.sleep(0.005)
+        if not res or res[0] is not None:
+            raise RuntimeError(f"floor rejected import: {res}")
+        return "floor"
+
+    t0 = time.monotonic()
+    n = 0
+    scale_downs = 0
+    try:
+        while time.monotonic() - t0 < seconds:
+            # "scale up": a replacement node joins the pool
+            scaled = EngineLoop(
+                build_engine(), f"scaled-{scale_downs}"
+            ).start()
+            scaled.exporter = exporter
+            pool = [floor, scaled]
+            cycle_end = min(
+                time.monotonic() + scale_every, t0 + seconds
+            )
+            while time.monotonic() < cycle_end:
+                n += 1
+                rid = f"scale-{n}"
+                prompt = [rng.randrange(4, 260)
+                          for _ in range(rng.randrange(6, 24))]
+                max_toks = rng.randrange(40, 120)
+                prompts[rid] = (prompt, max_toks)
+                tokens[rid] = []
+                terminal[rid] = False
+                pool[n % 2].submit(
+                    Request(
+                        id=rid, prompt_tokens=prompt,
+                        sampling=SamplingParams(
+                            temperature=0.0, max_tokens=max_toks,
+                        ),
+                        stop_token_ids=tok.eos_ids,
+                    ),
+                    on_event_for(rid),
+                )
+                time.sleep(rng.uniform(0.005, 0.04))
+            # D6: graceful drain-then-terminate — a REAL window (short
+            # requests finish in place; long ones migrate), then the
+            # thread must be down before the "host" is reclaimed
+            scale_downs += 1
+            scaled.stop(drain=0.5, join=True)
+            t = getattr(scaled, "_thread", None)
+            assert t is None or not t.is_alive(), (
+                "scale-down left the drained loop running"
+            )
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline and not all(terminal.values()):
+            time.sleep(0.1)
+    finally:
+        floor.stop(join=False)
+
+    stuck = sorted(r for r, done in terminal.items() if not done)
+    ref_engine = build_engine()
+    mismatches = []
+    lost_tokens = 0
+    for rid in sorted(migrated):
+        if rid in stuck or outcomes.get(rid, "").startswith("error"):
+            continue
+        prompt, max_toks = prompts[rid]
+        ref = Request(
+            id=f"ref-{rid}", prompt_tokens=list(prompt),
+            sampling=SamplingParams(temperature=0.0, max_tokens=max_toks),
+            stop_token_ids=tok.eos_ids,
+        )
+        ref_engine.add_request(ref)
+        while not ref.finished:
+            ref_engine.step()
+        if tokens[rid] != ref.output_tokens:
+            mismatches.append(rid)
+            lost_tokens += max(
+                0, len(ref.output_tokens) - len(tokens[rid])
+            )
+    counts: dict[str, int] = {}
+    for o in outcomes.values():
+        counts[o] = counts.get(o, 0) + 1
+    return {
+        "submitted": n,
+        "scale_downs": scale_downs,
+        "migrated": len(migrated),
+        "stuck": stuck,
+        "mismatches": mismatches,
+        "lost_tokens": lost_tokens,
+        "outcomes": counts,
+        "healthy_after": not stuck,
+        "stats": floor.stats(),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--step-fault-p", type=float, default=0.02)
     ap.add_argument(
-        "--scenario", choices=("faults", "memory", "crash"),
+        "--scenario", choices=("faults", "memory", "crash", "scale"),
         default="faults",
         help="faults: injected step/dispatch faults (ISSUE 2); memory: "
         "sustained KV exhaustion against the tiering/preemption ladder "
         "(ISSUE 6); crash: repeated runner crash-drains with snapshot "
         "migration to a standby — combined streams must be bit-identical "
-        "to uninterrupted runs (ISSUE 11)",
+        "to uninterrupted runs (ISSUE 11); scale: repeated autoscaler "
+        "scale-downs (graceful drain-then-terminate) under load — zero "
+        "stuck, zero lost tokens via the migration path (ISSUE 12)",
     )
     args = ap.parse_args(argv)
     if args.scenario == "memory":
         res = run_memory_pressure(seconds=args.seconds, seed=args.seed)
     elif args.scenario == "crash":
         res = run_crash(seconds=args.seconds, seed=args.seed)
+    elif args.scenario == "scale":
+        res = run_scale(seconds=args.seconds, seed=args.seed)
     else:
         res = run_soak(
             seconds=args.seconds, seed=args.seed,
@@ -471,19 +641,22 @@ def main(argv=None) -> int:
     if args.scenario == "memory" and not res.get("tiering_moved"):
         print("KV TIERING COUNTERS DID NOT MOVE", file=sys.stderr)
         return 1
-    if args.scenario == "crash":
+    if args.scenario in ("crash", "scale"):
         if res.get("mismatches"):
             print(
-                f"MIGRATED STREAMS DIVERGED: {res['mismatches']}",
+                f"MIGRATED STREAMS DIVERGED: {res['mismatches']} "
+                f"(lost_tokens={res.get('lost_tokens', '?')})",
                 file=sys.stderr,
             )
             return 1
         if not res.get("migrated"):
             print("NO REQUEST ACTUALLY MIGRATED", file=sys.stderr)
             return 1
+        events = res.get("crashes", res.get("scale_downs"))
         print(
-            f"crashes: {res['crashes']}, migrated: {res['migrated']} — "
-            "all combined streams bit-identical to uninterrupted runs"
+            f"{args.scenario} events: {events}, migrated: "
+            f"{res['migrated']} — zero lost tokens, all combined "
+            "streams bit-identical to uninterrupted runs"
         )
     print("zero stuck requests — soak passed")
     return 0

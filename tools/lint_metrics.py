@@ -51,6 +51,14 @@ Contracts, enforced repo-wide (wired into tier-1 via
    their bucket grids) cannot regrow one helper at a time.  The
    ``helix_compiled_step_shapes`` gauge would expose it at runtime;
    this catches it at review time.
+8. **One routing/autoscale vocabulary**: the control plane's placement
+   and capacity series — ``helix_cp_route_*`` (scored routing, prefix
+   affinity, saturation sheds) and ``helix_cp_autoscale_*`` (provision/
+   drain/deprovision lifecycle) — are minted ONLY by
+   ``helix_tpu/control/router.py`` and ``helix_tpu/control/compute.py``
+   respectively; the control plane must keep calling their collector
+   helpers (``collect_cp_routing`` / ``collect_cp_autoscale``), the
+   contracts 3-6 importer pattern.
 
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
@@ -326,6 +334,65 @@ def _tenant_schema_violations(root: str) -> list:
     return violations
 
 
+# -- contract 8: one routing/autoscale vocabulary ----------------------------
+# helix_cp_route_* series are minted only by control/router.py (the
+# scored-policy module) and helix_cp_autoscale_* only by
+# control/compute.py (the pool autoscaler); the control plane renders
+# both through their collector helpers.
+_ROUTE_NAME_RE = re.compile(r"""["']helix_cp_route_[a-z0-9_]*["']""")
+_AUTOSCALE_NAME_RE = re.compile(
+    r"""["']helix_cp_autoscale_[a-z0-9_]*["']"""
+)
+# (file, required symbol): the cp scrape surface must keep routing
+# through both modules' collectors
+_ROUTING_IMPORTERS = (
+    (
+        os.path.join("helix_tpu", "control", "server.py"),
+        "collect_cp_routing",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "server.py"),
+        "collect_cp_autoscale",
+    ),
+)
+
+
+def _is_route(path: str, root: str) -> bool:
+    rel = os.path.relpath(path, root)
+    return rel == os.path.join("helix_tpu", "control", "router.py")
+
+
+def _is_autoscale(path: str, root: str) -> bool:
+    rel = os.path.relpath(path, root)
+    return rel == os.path.join("helix_tpu", "control", "compute.py")
+
+
+def _routing_schema_violations(root: str) -> list:
+    violations = []
+    for rel, mod in (
+        (os.path.join("helix_tpu", "control", "router.py"),
+         "routing"),
+        (os.path.join("helix_tpu", "control", "compute.py"),
+         "autoscale"),
+    ):
+        if not os.path.isfile(os.path.join(root, rel)):
+            violations.append(
+                f"{rel}: missing — the {mod} metric vocabulary must "
+                "live there"
+            )
+    for rel, symbol in _ROUTING_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if symbol not in f.read():
+                violations.append(
+                    f"{rel}: does not call {symbol} (the routing/"
+                    "autoscale collector importer pattern)"
+                )
+    return violations
+
+
 # -- contract 7: one compiled step entry point -------------------------------
 # The unified ragged step is THE device-step builder; these existing
 # names are the only lru-cached ``_build_*`` functions allowed under
@@ -388,6 +455,7 @@ def run(root: str) -> list:
     violations += _tenant_schema_violations(root)
     violations += _migration_schema_violations(root)
     violations += _step_builder_violations(root)
+    violations += _routing_schema_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
     sched_reason_res = [
@@ -404,7 +472,21 @@ def run(root: str) -> list:
         tenant_emitter = _is_slo(path, root)
         sched_emitter = _is_sched(path, root)
         migration_emitter = _is_migration(path, root)
+        route_emitter = _is_route(path, root)
+        autoscale_emitter = _is_autoscale(path, root)
         for i, line in enumerate(lines, 1):
+            if not route_emitter and _ROUTE_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: helix_cp_route_* metric family named "
+                    "outside helix_tpu/control/router.py — routing "
+                    "series must come from the policy module"
+                )
+            if not autoscale_emitter and _AUTOSCALE_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: helix_cp_autoscale_* metric family "
+                    "named outside helix_tpu/control/compute.py — "
+                    "autoscaler series must come from the pool manager"
+                )
             if not migration_emitter and _MIGRATION_NAME_RE.search(line):
                 violations.append(
                     f"{rel}:{i}: migration/drain metric family named "
