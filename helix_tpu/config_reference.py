@@ -57,6 +57,20 @@ ENV_REFERENCE: tuple = (
         section="accelerator",
     ),
     EnvVar(
+        "HELIX_ASYNC_LOOP",
+        "Asynchronous pipelined engine loop override for every engine "
+        "this node serves: truthy dispatches device step N+1 against "
+        "predicted post-step state while step N executes and emits "
+        "tokens through a bounded off-thread stage (greedy and seeded "
+        "temp>0 outputs stay bit-identical to the synchronous loop); "
+        "0/false forces the synchronous baseline even where a profile "
+        "sets engine.enable_async_loop. Watch helix_device_idle_ratio "
+        "and the helix_step_host_build_seconds / "
+        "helix_step_emit_seconds histograms for the effect. Unset: the "
+        "profile setting applies (default off).",
+        section="accelerator",
+    ),
+    EnvVar(
         "HELIX_TOKEN_BUCKETS",
         "Comma-separated token-bucket ladder for the unified ragged "
         "device step's prefill segment (e.g. '64,192,512,2048'). Each "

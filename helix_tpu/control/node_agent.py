@@ -260,6 +260,15 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         n_spec = int(spec_env)
         ekw["spec_tokens"] = max(n_spec, 1)
         ekw["enable_spec_decode"] = n_spec > 0
+    async_env = _os_env.environ.get("HELIX_ASYNC_LOOP", "")
+    if async_env:
+        # operator-level async-engine-loop override for EVERY engine
+        # this node serves (same operator-beats-profile contract as
+        # HELIX_SPEC_TOKENS): truthy enables the pipelined loop, 0/false
+        # forces the synchronous baseline even where a profile enables it
+        ekw["enable_async_loop"] = async_env.strip().lower() not in (
+            "0", "false", "no", "off"
+        )
     from helix_tpu.engine.residency import host_pool_budget_bytes
 
     host_budget = host_pool_budget_bytes(default=-1)

@@ -185,6 +185,24 @@ class EngineConfig:
     # routing vs plain decode) — the engine logs and disables there.
     enable_spec_decode: bool = False
     spec_tokens: int = 4
+    # Asynchronous pipelined engine loop (serving/engine_loop.py): while
+    # device step N executes, the loop dispatches step N+1 against
+    # PREDICTED post-step state (positions/budgets advanced at dispatch
+    # — the device advances every active row by the full window whether
+    # or not the host later discards an overrun, so the prediction is
+    # exact for everything but EOS, whose overrun tokens are discarded
+    # exactly like fused-window overruns always were) and emits step
+    # N-1's tokens through a bounded off-thread emission stage.  The
+    # pipeline engages only for plain fused-decode steps in steady state
+    # (no admissions, no chunked prefill, no parked preemptions, state
+    # clean) and degrades to the synchronous loop everywhere else —
+    # including for the WHOLE engine when speculative decoding is
+    # enabled (a drafter conditioning on host-lagged sequences would
+    # gut acceptance; spec already amortizes host syncs via its fused
+    # verify+tail) — so greedy AND seeded temp>0 outputs are
+    # bit-identical with the knob on or off.  Node-level override:
+    # HELIX_ASYNC_LOOP (operator-beats-profile, 0 forces off).
+    enable_async_loop: bool = False
     # Host-RAM KV tier (engine/kv_cache.HostPagePool): byte budget for
     # spilled pages.  >0 turns the tier on: PrefixCache evictions demote
     # page contents to host buffers instead of dying (restored into
@@ -306,6 +324,50 @@ def _override_token_counts(state: DecodeState, slot, counts) -> DecodeState:
     return dataclasses.replace(
         state, token_counts=state.token_counts.at[slot].set(counts)
     )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _patch_first_token(state: DecodeState, slot, tok) -> DecodeState:
+    """Seed ONE fresh slot's device-resident last_token + histogram from
+    a still-on-device first-token handle (deferred chunk-final fetch):
+    ``_rebuild_state`` seeded the slot from the host mirror's placeholder
+    0, so move that histogram count to the real token and set last_token
+    — the decode step that follows in the same engine step then conditions
+    on the true first token without the host ever fetching it alone."""
+    counts = state.token_counts.at[slot, 0].add(-1)
+    counts = counts.at[slot, tok].add(1)
+    return dataclasses.replace(
+        state,
+        last_token=state.last_token.at[slot].set(tok),
+        token_counts=counts,
+    )
+
+
+@dataclasses.dataclass
+class PendingStep:
+    """One dispatched-but-not-reconciled device step.
+
+    ``step_dispatch`` builds metadata, issues the (async) device call and
+    returns one of these; ``step_complete`` performs the step's SINGLE
+    host fetch and the post-fetch bookkeeping (emits, stop conditions,
+    slot frees).  ``rows`` snapshots the slot occupants at dispatch so a
+    completion that runs after the slot set changed (async pipeline:
+    step N+1 completes after step N's finishes freed slots) can never
+    attribute tokens to a later occupant — a row whose slot no longer
+    holds the same request discards its tokens, exactly the fused-window
+    overrun contract."""
+
+    kind: str                   # "decode" | "spec" | "mixed"
+    rows: list                  # [(slot_index, Request)] at dispatch
+    handles: tuple              # device arrays the completion fetches
+    n: int = 1                  # fused window size (decode)
+    n_extra: int = 0            # fused tail length (spec)
+    draft_len: Optional[np.ndarray] = None   # [B] (spec)
+    # deferred chunk-final first tokens: [(Request, [R] device handle)],
+    # fetched inside this step's one device_get instead of their own
+    pending_first: list = dataclasses.field(default_factory=list)
+    st: Optional[dict] = None   # mixed: the in-flight chunking record
+    final: bool = False         # mixed: this chunk completes the prompt
 
 
 @dataclasses.dataclass
@@ -1051,6 +1113,17 @@ class Engine:
         self.prefill_budget: Optional[int] = None
         self._budget_left: Optional[int] = None
         self._slot_count_overrides: dict[int, np.ndarray] = {}
+        # deferred chunk-final first tokens (ISSUE 13): the final chunk's
+        # sampled token stays on device — _sync_state patches the slot's
+        # DecodeState from the handle and the emit joins the decode
+        # step's single device_get (one host round trip per step, not
+        # two).  _inflight_out counts dispatched-not-yet-reconciled
+        # tokens per request so the async loop's predicted dispatch
+        # computes budgets/headroom against post-step state.
+        self._pending_first: list = []           # [(req, [R] dev handle)]
+        self._pending_first_ids: set = set()
+        self._pending_token_patches: dict[int, object] = {}
+        self._inflight_out: dict[str, int] = {}
         self._prefetched: set = set()   # digests with in-flight device puts
         self._key_base = _splitmix64(0x8E1_1C9 ^ (rng_seed & _M64))
         self._key_nonce = 0
@@ -1323,7 +1396,32 @@ class Engine:
         step packs them into ONE device call (``enable_mixed_step``).
 
         Returns [(request, new_token_id), ...] for tokens produced this step.
+
+        ``step()`` is exactly ``step_complete(step_dispatch())`` — the
+        async engine loop (ISSUE 13) calls the halves itself so the host
+        phase of step N+1 overlaps the device phase of step N.
         """
+        emitted, pend = self.step_dispatch()
+        if pend is not None:
+            try:
+                self.step_complete(pend, emitted)
+            except Exception:
+                # roll the predicted-state advance back before the
+                # failure propagates: quarantine bisection and lockstep
+                # callers retry through this wrapper, and a retry
+                # against mirrors claiming (position p+n, last_token at
+                # p-1) would silently skip/mis-condition n tokens
+                self.discard_pending(pend)
+                raise
+        return emitted
+
+    def step_dispatch(self) -> tuple[list, Optional[PendingStep]]:
+        """The HOST phase of one engine step: admission, plan building,
+        metadata upload and the (async) device dispatch.  Returns
+        ``(emitted_so_far, pending)`` — ``pending`` carries the device
+        handles; nothing here blocks on the device except the admission
+        wave's batched first-token fetch (conservative fallback: steps
+        with admissions reconcile synchronously)."""
         emitted: list[tuple[Request, int]] = []
         if self.host_pool is not None:
             # release the HBM gather buffers of spills from EARLIER
@@ -1345,19 +1443,154 @@ class Engine:
             and decode_ready
             and self.cfg.enable_mixed_step
         ):
-            self._mixed_step(emitted)
-            return emitted
+            return emitted, self._mixed_dispatch()
         if self._chunking is not None:
-            self._chunk_step(emitted)
+            self._chunk_dispatch()
         # re-check: a chunk that just completed activates its slot and
-        # decodes its second token this same step (pre-mixed behaviour)
+        # decodes its second token this same step (pre-mixed behaviour);
+        # its deferred first token rides that step's single device_get
         if any(self._slot_active(i) for i in range(len(self.slots))):
             # speculate when the drafter has something to verify; any
             # step it doesn't (no n-gram hit, EMA-disabled slots, no
             # headroom) falls straight through to the plain fused window
-            if self.spec is None or not self._spec_step(emitted):
-                emitted.extend(self._decode_step())
+            pend = None
+            if self.spec is not None:
+                pend = self._spec_dispatch()
+            if pend is None:
+                pend = self._decode_dispatch()
+            return emitted, pend
+        # nothing decodable (admission-only step, or a chunk whose
+        # request aborted between activation and decode): any deferred
+        # first token must still land — conservative synchronous flush
+        self._flush_pending_first(emitted)
+        return emitted, None
+
+    def step_complete(self, pend: PendingStep, emitted=None) -> list:
+        """The RECONCILE phase: the step's one host fetch plus every
+        host-visible effect (emits, stop conditions, slot frees).  The
+        async loop calls this AFTER dispatching the next step, so the
+        fetch blocks only for the device time the host work did not
+        already cover."""
+        emitted = [] if emitted is None else emitted
+        if pend.kind == "decode":
+            self._decode_complete(pend, emitted)
+        elif pend.kind == "spec":
+            self._spec_complete(pend, emitted)
+        else:
+            self._mixed_complete(pend, emitted)
         return emitted
+
+    def pipeline_ready(self) -> bool:
+        """True when the NEXT dispatch can safely run against predicted
+        post-step state while a step is still in flight: plain
+        fused-decode steady state only.  Admission waves, chunked
+        prefill, speculation (its per-slot advance depends on acceptance
+        counts the host has not seen), parked preemptions and any dirty
+        slot state (the rebuild uploads host mirrors that are only
+        accurate at reconcile points) all force the loop back to the
+        synchronous dispatch->complete ordering."""
+        if (
+            self._state_dirty
+            or self._dstate is None
+            or self.waiting
+            or self._chunking is not None
+            or self.preempted
+            or self.spec is not None
+            or self._pending_first
+        ):
+            return False
+        # every active slot must have headroom for at least one more
+        # predicted token: a slot whose in-flight window exhausts its
+        # budget or page allocation is about to FINISH at the reconcile,
+        # and dispatching past that point would trip the headroom
+        # invariant (or waste a whole discarded step) — reconcile first
+        for i, req in enumerate(self.slots):
+            if req is None or not self._slot_active(i):
+                continue
+            pend = self._pending_out(req)
+            if (
+                req.sampling.max_tokens - len(req.output_tokens) - pend
+                <= 0
+                or (req.max_len or self.cache_cfg.max_seq_len)
+                - req.num_tokens - pend <= 0
+            ):
+                return False
+        return True
+
+    def discard_pending(self, pend: PendingStep) -> None:
+        """Forget an in-flight dispatch whose completion failed or will
+        never run (step-failure path): host bookkeeping only — every
+        slot is marked changed so the next ``_sync_state`` re-uploads
+        the mirrors rather than trusting device state the failed step
+        may have left behind."""
+        if pend.kind == "decode":
+            # roll back the predicted-position advance: the mirror's
+            # last_token is still the last RECONCILED token (position
+            # p-1), so the retry must re-decode from p — leaving the
+            # dispatch-time p+n in place would re-sync a (position,
+            # last_token) pair that never existed and silently skip n
+            # tokens from the client's stream
+            for i, r in pend.rows:
+                if self.slots[i] is r:
+                    self._positions[i] -= pend.n
+        for _i, r in pend.rows:
+            self._inflight_out.pop(r.id, None)
+        self._pending_token_patches.clear()
+        self._pending_first = []
+        self._pending_first_ids.clear()
+        for req, tok in pend.pending_first:
+            if req.finished or req.slot is None:
+                continue
+            # the chunk call that sampled this deferred first token
+            # SUCCEEDED — only the decode completion failed.  Put it
+            # back so the retry re-seeds the slot from the handle and
+            # still emits token #1; dropping it would condition the
+            # retried stream on the placeholder mirror (0) and silently
+            # lose the prompt's first sampled token.
+            self._pending_first.append((req, tok))
+            self._pending_first_ids.add(req.id)
+            self._pending_token_patches[req.slot] = tok[0]
+        self._state_dirty = True
+        self._changed_slots.update(range(len(self.slots)))
+
+    def _pending_out(self, req: Request) -> int:
+        """Tokens this request has in flight (dispatched, not yet
+        reconciled) plus a deferred chunk-final first token — the
+        correction every budget/headroom read applies so a predicted
+        dispatch can never overrun max_tokens or the allocated pages."""
+        return self._inflight_out.get(req.id, 0) + (
+            1 if req.id in self._pending_first_ids else 0
+        )
+
+    def _take_pending_first(self) -> list:
+        pf, self._pending_first = self._pending_first, []
+        self._pending_first_ids.clear()
+        return pf
+
+    def _finish_first_emit(self, req: Request, first_token: int,
+                           emitted) -> None:
+        """Deferred chunk-final emit, after its handle was fetched as
+        part of the step's batched device_get."""
+        if req.finished:
+            return   # aborted after activation: the token is moot
+        if req.slot is not None:
+            self._last_token[req.slot] = first_token
+            # a patch not yet consumed by _sync_state is superseded by
+            # the now-accurate mirror (a stale patch after the mirror
+            # write would double-count the histogram seed)
+            self._pending_token_patches.pop(req.slot, None)
+        self._emit(req, first_token, emitted)
+
+    def _flush_pending_first(self, emitted) -> None:
+        """Conservative fallback when no same-step decode fetch will
+        carry the deferred first token: fetch it alone (today's
+        behaviour)."""
+        pf = self._take_pending_first()
+        if not pf:
+            return
+        for req, tok in pf:
+            self._finish_first_emit(req, int(np.asarray(tok)[0]), emitted)
+        self._drain_moe_drops()   # the fetch above synced the device
 
     def _request_key(self, req: Request) -> np.ndarray:
         """Root PRNG key for one request: derived from its seed when given,
@@ -1703,7 +1936,7 @@ class Engine:
             self.waiting.pop(0)
             slot = req.slot
             if needs_chunking:
-                # defer to _chunk_step: one chunk per engine step, decode
+                # defer to _chunk_dispatch: one chunk per engine step, decode
                 # interleaves; the slot stays inactive until the prompt is
                 # fully cached.  A prefix-cache hit starts past the
                 # resident pages: those tokens are never prefilled again.
@@ -1909,9 +2142,16 @@ class Engine:
         )
         return plan, rem, end
 
-    def _finish_chunk(self, st, first_token: int, emitted) -> None:
+    def _finish_chunk(self, st, first_token, emitted) -> None:
         """Prompt fully cached: activate the slot with the first sampled
-        token (shared by the standalone chunk step and the mixed step)."""
+        token (shared by the standalone chunk step and the mixed step).
+
+        ``first_token`` is either a host int (mixed step — its fetch was
+        folded into the step's one device_get) or the chunk step's [R]
+        DEVICE handle, in which case the fetch DEFERS: _sync_state seeds
+        the slot's device state from the handle and the emit joins the
+        same-step decode fetch, so a long-prompt chunk cascade costs one
+        host round trip per step, not two."""
         req: Request = st["req"]
         self._adopt_prompt_pages(req, st["table"])
         slot = st["slot"]
@@ -1922,14 +2162,22 @@ class Engine:
         )
         self._positions[slot] = len(req.prompt_tokens)
         self._mrope_delta[slot] = req.mrope_delta
-        self._last_token[slot] = first_token
         self._slot_keys[slot] = _host_split(st["key"])[0]
         self._state_dirty = True
         self._changed_slots.add(slot)
-        # the caller fetched the first token already: device is synced,
-        # so folding the prompt's queued chunk drop counts is free
-        self._drain_moe_drops()
-        self._emit(req, first_token, emitted)
+        if isinstance(first_token, (int, np.integer)):
+            self._last_token[slot] = first_token
+            # the caller fetched the first token already: device is
+            # synced, so folding the queued chunk drop counts is free
+            self._drain_moe_drops()
+            self._emit(req, int(first_token), emitted)
+            return
+        # deferred: placeholder mirror, device-side patch at the next
+        # _sync_state, emit at the next batched fetch
+        self._last_token[slot] = 0
+        self._pending_token_patches[slot] = first_token[0]
+        self._pending_first.append((req, first_token))
+        self._pending_first_ids.add(req.id)
 
     # per-request cap on prefill_chunk spans: a 128k prompt would
     # otherwise flood its own trace's span budget and evict the decode/
@@ -1945,9 +2193,11 @@ class Engine:
             return True
         return False
 
-    def _chunk_step(self, emitted) -> None:
-        """Process ONE chunk of the in-flight long prefill (called once per
-        engine step so decode interleaves)."""
+    def _chunk_dispatch(self) -> None:
+        """Dispatch ONE chunk of the in-flight long prefill (called once
+        per engine step so decode interleaves).  Pure dispatch: non-final
+        chunks fetch nothing at all, and the final chunk's first token
+        defers into the same-step decode fetch (``_finish_chunk``)."""
         st = self._chunking
         req: Request = st["req"]
         if req.finished:   # aborted mid-prefill
@@ -1971,9 +2221,9 @@ class Engine:
             )
         if end < len(req.prompt_tokens):
             return
-        self._finish_chunk(st, int(np.asarray(token)[0]), emitted)
+        self._finish_chunk(st, token, None)
 
-    def _mixed_step(self, emitted) -> None:
+    def _mixed_dispatch(self) -> Optional[PendingStep]:
         """Ragged mixed step: ONE device call advances every active decode
         slot one token AND the in-flight long prefill one chunk — decode
         never stalls (and never pays a second dispatch) while a long
@@ -1982,7 +2232,7 @@ class Engine:
         req: Request = st["req"]
         if self._state_dirty or self._dstate is None:
             self._sync_state()
-        # same headroom invariant as _decode_step, for the single fused step
+        # same headroom invariant as the decode step, for the fused step
         table_cap = (
             self.cache_cfg.max_pages_per_seq * self.cache_cfg.page_size
         )
@@ -1993,6 +2243,10 @@ class Engine:
                     f"at position {self._positions[i]} — headroom "
                     f"invariant violated"
                 )
+        rows = [
+            (i, r) for i, r in enumerate(self.slots)
+            if r is not None and self._slot_active(i)
+        ]
         t0 = time.monotonic()
         plan, rem, end = self._chunk_plan(st)
         token, sampled, _, _, drops = self._ragged_step(
@@ -2009,25 +2263,51 @@ class Engine:
                 plane="engine", request_id=req.id,
                 chunk_end=end, tokens=rem, mixed=True,
             )
+        return PendingStep(
+            kind="mixed", rows=rows, handles=(sampled, token), st=st,
+            final=end >= len(req.prompt_tokens),
+            # a deferred chunk-final first token re-queued by a failed
+            # step can cross into a mixed retry (a NEW prompt started
+            # chunking): it must ride THIS step's fetch or its request
+            # would emit token #2 before token #1
+            pending_first=self._take_pending_first(),
+        )
+
+    def _mixed_complete(self, p: PendingStep, emitted) -> None:
+        sampled, token = p.handles
+        firsts = tuple(tok for _r, tok in p.pending_first)
+        if p.final:
+            # chunk-final token folded into the step's ONE device_get
+            # (previously its own np.asarray fetch — a second host
+            # round trip on every long-prompt completion step)
+            fetched = jax.device_get((sampled, token) + firsts)
+            next_np, tok_np = fetched[0], fetched[1]
+            first_np = fetched[2:]
+        else:
+            fetched = jax.device_get((sampled,) + firsts)
+            next_np, tok_np = fetched[0], None
+            first_np = fetched[1:]
+        if p.pending_first:
+            for (req, _h), t_np in zip(p.pending_first, first_np):
+                self._finish_first_emit(req, int(t_np[0]), emitted)
+            self._drain_moe_drops()   # the fetch above synced the device
         # decode emissions first (the chunking slot is still parked here)
-        next_np = np.asarray(sampled)       # [B, W] — ONE host fetch
-        for i, r in enumerate(self.slots):
-            if r is None or not self._slot_active(i):
+        for i, r in p.rows:
+            if self.slots[i] is not r or r.finished:
                 continue
             self._positions[i] += 1
             self._last_token[i] = next_np[i, 0]
             self.num_decode_tokens += 1
             self._emit(r, int(next_np[i, 0]), emitted)
-        if end < len(req.prompt_tokens):
-            return
-        self._finish_chunk(st, int(np.asarray(token)[0]), emitted)
+        if p.final:
+            self._finish_chunk(p.st, int(tok_np[0]), emitted)
 
     def _prefill(
         self, req: Request, page_table: np.ndarray, slot: Optional[int] = None
     ) -> int:
         """VL (mrope) single-shot prefill.  Text prompts never come here:
         short ones pack through ``_admit_wave`` and long ones chunk
-        through ``_chunk_step``."""
+        through ``_chunk_dispatch``."""
         assert self.model_cfg.mrope_sections is not None
         plen = len(req.prompt_tokens)
         bucket = _bucket(
@@ -2147,6 +2427,15 @@ class Engine:
                     self._dstate, jnp.int32(slot), jnp.asarray(counts)
                 )
             self._slot_count_overrides.clear()
+        if self._pending_token_patches:
+            # deferred chunk-final first tokens: seed the fresh slot's
+            # last_token + histogram from the still-on-device handle —
+            # the rebuild above used the placeholder mirror (0)
+            for slot, tok in sorted(self._pending_token_patches.items()):
+                self._dstate = _patch_first_token(
+                    self._dstate, jnp.int32(slot), tok
+                )
+            self._pending_token_patches.clear()
 
     def _decode_window(self) -> int:
         """Fused decode steps to run before the next host sync.
@@ -2183,9 +2472,16 @@ class Engine:
         for i, req in enumerate(self.slots):
             if req is None or not self._slot_active(i):
                 continue
-            budget = req.sampling.max_tokens - len(req.output_tokens)
+            # in-flight tokens (async pipeline / deferred chunk-final)
+            # count against budget and page room: the predicted dispatch
+            # must never overrun what the reconcile will reveal
+            pend = self._pending_out(req)
+            budget = (
+                req.sampling.max_tokens - len(req.output_tokens) - pend
+            )
             room = (
-                (req.max_len or self.cache_cfg.max_seq_len) - req.num_tokens
+                (req.max_len or self.cache_cfg.max_seq_len)
+                - req.num_tokens - pend
             )
             cap = min(cap, budget, room)
         if cap <= 1:
@@ -2795,10 +3091,11 @@ class Engine:
         for i, req in enumerate(self.slots):
             if req is None or not self._slot_active(i):
                 continue
+            pend = self._pending_out(req)
             h = min(
-                req.sampling.max_tokens - len(req.output_tokens),
+                req.sampling.max_tokens - len(req.output_tokens) - pend,
                 (req.max_len or self.cache_cfg.max_seq_len)
-                - req.num_tokens,
+                - req.num_tokens - pend,
                 table_cap - int(self._positions[i]),
             )
             while n > 1 and k1 + n - 1 > h:
@@ -2807,9 +3104,9 @@ class Engine:
                 return 0
         return n - 1
 
-    def _spec_step(self, emitted) -> bool:
+    def _spec_dispatch(self) -> Optional[PendingStep]:
         """One speculative decode step: draft per slot on the host, then
-        verify every slot's drafts in ONE device call.  Returns False
+        verify every slot's drafts in ONE device call.  Returns None
         when no slot drafted anything (the caller then runs the plain
         fused-window decode — speculation never makes a step slower than
         the baseline path, it only substitutes for it)."""
@@ -2823,13 +3120,23 @@ class Engine:
         for i, req in enumerate(self.slots):
             if req is None or not self._slot_active(i):
                 continue
+            if req.id in self._pending_first_ids:
+                # deferred chunk-final first token: the host-visible
+                # sequence lags the device by one token, so a draft
+                # would condition on the wrong suffix — sit this call
+                # out (the verify would just reject it anyway)
+                continue
             pos = int(self._positions[i])
             # headroom: the verify call writes KV for pos..pos+L, so the
             # draft must fit the slot's allocated pages (max_len) and is
             # not worth proposing past the remaining token budget
-            budget = req.sampling.max_tokens - len(req.output_tokens)
+            pend = self._pending_out(req)
+            budget = (
+                req.sampling.max_tokens - len(req.output_tokens) - pend
+            )
             room = (
-                (req.max_len or self.cache_cfg.max_seq_len) - req.num_tokens
+                (req.max_len or self.cache_cfg.max_seq_len)
+                - req.num_tokens - pend
             )
             cap = min(k, budget - 1, room - 1, table_cap - pos - 1)
             if cap <= 0:
@@ -2856,7 +3163,11 @@ class Engine:
             drafts[i, : len(toks)] = toks
             draft_len[i] = len(toks)
         if not draft_len.any():
-            return False
+            return None
+        rows = [
+            (i, r) for i, r in enumerate(self.slots)
+            if r is not None and self._slot_active(i)
+        ]
         n_extra = self._spec_extra_steps()
         _, sampled, emit, extra, _ = self._ragged_step(
             drafts=drafts, draft_len=draft_len, n_extra=n_extra,
@@ -2866,12 +3177,24 @@ class Engine:
         # accepted drafts, decode_tokens / device_steps exceeds 1 per
         # slot — that ratio IS the speculation win (tokens per forward)
         self.num_decode_device_steps += 1 + n_extra
-        sampled_np, emit_np, extra_np = jax.device_get(
-            (sampled, emit, extra)
+        return PendingStep(
+            kind="spec", rows=rows, handles=(sampled, emit, extra),
+            n_extra=n_extra, draft_len=draft_len,
+            pending_first=self._take_pending_first(),
         )
-        for i in range(B):
-            req = self.slots[i]
-            if req is None or not self._slot_active(i):
+
+    def _spec_complete(self, p: PendingStep, emitted) -> None:
+        sampled, emit, extra = p.handles
+        firsts = tuple(tok for _r, tok in p.pending_first)
+        fetched = jax.device_get((sampled, emit, extra) + firsts)
+        sampled_np, emit_np, extra_np = fetched[0], fetched[1], fetched[2]
+        if p.pending_first:
+            for (req, _h), tok_np in zip(p.pending_first, fetched[3:]):
+                self._finish_first_emit(req, int(tok_np[0]), emitted)
+            self._drain_moe_drops()   # the fetch above synced the device
+        draft_len = p.draft_len
+        for i, req in p.rows:
+            if self.slots[i] is not req:
                 continue
             e = int(emit_np[i])
             L = int(draft_len[i])
@@ -2887,19 +3210,18 @@ class Engine:
                 self._last_token[i] = sampled_np[i, j]
                 self.num_decode_tokens += 1
                 self._emit(req, int(sampled_np[i, j]), emitted)
-        # fused-window tail tokens (same contract as _decode_step:
-        # finished slots discard the overrun)
-        for s in range(n_extra):
-            for i, req in enumerate(self.slots):
-                if req is None or not self._slot_active(i):
+        # fused-window tail tokens (same contract as the plain decode
+        # window: finished slots discard the overrun)
+        for s in range(p.n_extra):
+            for i, req in p.rows:
+                if self.slots[i] is not req or req.finished:
                     continue
                 self._positions[i] += 1
                 self._last_token[i] = extra_np[s, i]
                 self.num_decode_tokens += 1
                 self._emit(req, int(extra_np[s, i]), emitted)
-        return True
 
-    def _decode_step(self) -> list[tuple[Request, int]]:
+    def _decode_dispatch(self) -> PendingStep:
         n = self._decode_window()
         # Headroom invariant, checked loudly on host: the KV write clamps
         # its page-table index, so a slot whose position can reach table
@@ -2914,6 +3236,10 @@ class Engine:
                     f"at position {self._positions[i]} + {n} steps > "
                     f"{table_cap} — headroom invariant violated"
                 )
+        rows = [
+            (i, r) for i, r in enumerate(self.slots)
+            if r is not None and self._slot_active(i)
+        ]
         # plain decode IS the unified step with zero drafts: position 0
         # of each active row samples this step's token, and the fused
         # tail advances the remaining n-1 window steps in the same jit
@@ -2921,24 +3247,50 @@ class Engine:
             draft_len=self._zero_rows, n_extra=n - 1,
         )
         self.num_decode_device_steps += n
-        sampled_np, extra_np = jax.device_get((sampled, extra))
-        emitted: list[tuple[Request, int]] = []
-        for i, req in enumerate(self.slots):
-            if req is None or not self._slot_active(i):
-                continue  # finished mid-window: discard the overrun
-            self._positions[i] += 1
+        # Predicted-state advance: the DEVICE moves every dispatched row
+        # forward by the full window whether or not the host later
+        # discards an overrun, so the position mirror advances at
+        # dispatch — this is what lets the async loop build step N+1's
+        # metadata before step N's tokens are on host.  Completion only
+        # fetches, emits and applies stop conditions.
+        for i, r in rows:
+            self._positions[i] += n
+            self._inflight_out[r.id] = self._inflight_out.get(r.id, 0) + n
+        return PendingStep(
+            kind="decode", rows=rows, handles=(sampled, extra), n=n,
+            pending_first=self._take_pending_first(),
+        )
+
+    def _decode_complete(self, p: PendingStep, emitted) -> None:
+        sampled, extra = p.handles
+        firsts = tuple(tok for _r, tok in p.pending_first)
+        fetched = jax.device_get((sampled, extra) + firsts)
+        sampled_np, extra_np = fetched[0], fetched[1]
+        if p.pending_first:
+            # deferred chunk-final first tokens land in the SAME host
+            # round trip as the decode window (ISSUE 13 satellite)
+            for (req, _h), tok_np in zip(p.pending_first, fetched[2:]):
+                self._finish_first_emit(req, int(tok_np[0]), emitted)
+            self._drain_moe_drops()   # the fetch above synced the device
+        for _i, r in p.rows:
+            left = self._inflight_out.get(r.id, 0) - p.n
+            if left > 0:
+                self._inflight_out[r.id] = left
+            else:
+                self._inflight_out.pop(r.id, None)
+        for i, r in p.rows:
+            if self.slots[i] is not r or r.finished:
+                continue  # finished/evicted mid-flight: discard the overrun
             self._last_token[i] = sampled_np[i, 0]
             self.num_decode_tokens += 1
-            self._emit(req, int(sampled_np[i, 0]), emitted)
-        for s in range(n - 1):
-            for i, req in enumerate(self.slots):
-                if req is None or not self._slot_active(i):
+            self._emit(r, int(sampled_np[i, 0]), emitted)
+        for s in range(p.n - 1):
+            for i, r in p.rows:
+                if self.slots[i] is not r or r.finished:
                     continue
-                self._positions[i] += 1
                 self._last_token[i] = extra_np[s, i]
                 self.num_decode_tokens += 1
-                self._emit(req, int(extra_np[s, i]), emitted)
-        return emitted
+                self._emit(r, int(extra_np[s, i]), emitted)
 
     # ------------------------------------------------------------------
     # the unified ragged device step (ISSUE 10)
@@ -2977,18 +3329,19 @@ class Engine:
         if plan is not None and plan.rows:
             rung = bucket_tokens(plan.used, self._token_ladder)
             self._charge_padding(rung, plan.used)
-            a = plan.finalize(rung)
+            # host->device conversion happens HERE, at dispatch time:
+            # under the async loop this step's metadata uploads overlap
+            # the previous step's device execution (double-buffered
+            # metadata — jax issues the transfers asynchronously)
+            a = plan.finalize_device(rung)
             sampling = SamplingState.from_params(
                 [r.sampling for r in plan.rows]
                 + [SamplingParams()] * (plan.max_rows - len(plan.rows))
             )
             pargs = (
-                jnp.asarray(a["tokens"]), jnp.asarray(a["pos"]),
-                jnp.asarray(a["seg"]), jnp.asarray(a["pages"]),
-                jnp.asarray(a["offsets"]), jnp.asarray(a["t0"]),
-                jnp.asarray(a["qlen"]), jnp.asarray(a["hist"]),
-                jnp.asarray(a["tables"]), jnp.asarray(a["ends"]),
-                sampling, jnp.asarray(a["keys"]),
+                a["tokens"], a["pos"], a["seg"], a["pages"],
+                a["offsets"], a["t0"], a["qlen"], a["hist"],
+                a["tables"], a["ends"], sampling, a["keys"],
             )
             rows = plan.max_rows
             has_hist = plan.has_hist

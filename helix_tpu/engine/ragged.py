@@ -184,3 +184,16 @@ class PrefillPlan:
             "t0": t0, "qlen": qlen, "hist": hist, "ends": ends,
             "tables": tables, "keys": keys,
         }
+
+    def finalize_device(self, rung: int):
+        """``finalize`` + the host->device upload, in one place.
+
+        The engine calls this at DISPATCH time so the conversion (and
+        the transfers jax issues for it) overlap whatever device step is
+        already in flight — the async engine loop's double-buffered
+        metadata upload.  Plan building itself stays pure host work and
+        may run against the loop's PREDICTED post-step state; nothing
+        here reads device values."""
+        import jax.numpy as jnp
+
+        return {k: jnp.asarray(v) for k, v in self.finalize(rung).items()}

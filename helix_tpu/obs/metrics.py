@@ -431,9 +431,28 @@ class EngineLoopObs:
             "Engine step wall time (host view, includes device sync)",
             buckets=FAST_BUCKETS,
         )
+        # async-loop time split (ISSUE 13): where each step's host
+        # milliseconds go — schedule/plan/dispatch vs token emission.
+        # Under the pipelined loop both phases overlap device execution;
+        # the flight recorder's idle_gap_s field (and the
+        # helix_device_idle_ratio gauge) shows whether they still leave
+        # the device waiting.
+        self.host_build = Histogram(
+            "helix_step_host_build_seconds",
+            "Host-side step build time (scheduling + plan packing + "
+            "metadata upload + dispatch) per engine step",
+            buckets=FAST_BUCKETS,
+        )
+        self.emit_seconds = Histogram(
+            "helix_step_emit_seconds",
+            "Token emission time (subscriber callbacks + per-tenant SLO "
+            "accounting) per step batch",
+            buckets=FAST_BUCKETS,
+        )
 
     def collect(self, c: Collector, labels: Optional[dict] = None) -> None:
         for m in (
-            self.queue_wait, self.ttft, self.inter_token, self.step_seconds
+            self.queue_wait, self.ttft, self.inter_token,
+            self.step_seconds, self.host_build, self.emit_seconds,
         ):
             c.metric(m, labels)

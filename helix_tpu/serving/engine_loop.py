@@ -65,6 +65,79 @@ class TokenEvent:
     error: Optional[str] = None
 
 
+class _EmissionStage:
+    """Bounded, ordered, off-critical-path token emission (ISSUE 13).
+
+    The async loop hands each step's emitted batch to this stage so SSE
+    subscriber callbacks and per-tenant SLO accounting never sit between
+    a device completion and the next dispatch.  One worker thread keeps
+    per-request event order; the bounded queue applies backpressure (a
+    full queue blocks the engine thread, so the pipeline never runs more
+    than ``depth`` batches ahead of the slowest subscriber).  When not
+    started (synchronous loop), ``push`` degrades to a direct call on
+    the caller's thread — exactly the pre-pipeline behaviour."""
+
+    def __init__(self, sink: Callable, obs_hist, depth: int = 8):
+        self._sink = sink
+        self._obs = obs_hist
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._thread: Optional[threading.Thread] = None
+        self.started = False
+        self.batches = 0
+
+    def start(self, name: str = "emit") -> None:
+        self._thread = threading.Thread(
+            target=self._run, name=f"helix-emit-{name}", daemon=True
+        )
+        self.started = True
+        self._thread.start()
+
+    def push(self, emitted) -> None:
+        if not emitted:
+            return
+        if not self.started:
+            t0 = time.monotonic()
+            self._sink(emitted)
+            self._obs.observe(time.monotonic() - t0)
+            return
+        self._q.put(emitted)   # blocks when full: bounded backpressure
+        self.batches += 1
+
+    def flush(self) -> None:
+        """Block until every pushed batch has been delivered — THE
+        ordering barrier the engine thread takes before any terminal
+        event (evict/shed/drain/quarantine), so an error frame can never
+        overtake that request's queued tokens."""
+        if self.started:
+            self._q.join()
+
+    def stop(self) -> None:
+        if not self.started:
+            return
+        self._q.put(None)
+        self.started = False
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    def _run(self) -> None:
+        while True:
+            batch = self._q.get()
+            try:
+                if batch is None:
+                    return
+                t0 = time.monotonic()
+                try:
+                    self._sink(batch)
+                except Exception:  # noqa: BLE001 — a subscriber bug must not kill emission
+                    log.exception("emission stage sink failed")
+                self._obs.observe(time.monotonic() - t0)
+            finally:
+                self._q.task_done()
+
+
 @dataclasses.dataclass
 class _ImportItem:
     """An inbox entry carrying a migrated-in request snapshot (ISSUE 11):
@@ -173,6 +246,28 @@ class EngineLoop:
         # per-tenant inbox depth (admission lock); the per-tenant bound
         # adds the engine-side wait-queue count on demand
         self._pending_by_tenant: dict[str, int] = {}
+        # asynchronous pipelined loop (ISSUE 13): dispatch step N+1
+        # against predicted post-step state while step N executes, and
+        # emit through the bounded off-thread stage.  Requires the
+        # dispatch/complete engine split; lockstep leaders (journaled
+        # command stream) stay synchronous — a leader-local reorder of
+        # dispatch vs fetch would not desync the follower, but the
+        # journal step cadence is the replay contract, so don't touch it.
+        self.async_enabled = (
+            bool(getattr(
+                getattr(engine, "cfg", None), "enable_async_loop", False
+            ))
+            and hasattr(engine, "step_dispatch")
+            and not hasattr(engine, "journal")
+        )
+        self.pipelined_steps = 0    # steps dispatched while one was in flight
+        self._emit_stage = _EmissionStage(
+            self._deliver, self.obs.emit_seconds
+        )
+        # host-side device-busy watermark: the last completion's return
+        # time.  A dispatch that happens with nothing in flight charges
+        # the gap since this watermark as device idle (idle_gap_s).
+        self._device_busy_until = 0.0
         # cross-runner migration (ISSUE 11): when set, requests still
         # unfinished at the drain deadline are snapshotted and handed to
         # this callable (wire dict -> accepting peer id; raises on
@@ -460,6 +555,7 @@ class EngineLoop:
         so the captured sampler state is exactly where generation
         stopped.  Requests that cannot export (VL, ship failure) are
         left for the ``_fail_all`` that follows."""
+        self._emit_stage.flush()   # no error frame may overtake tokens
         if self.exporter is None:
             return 0
         if getattr(self.engine, "export_request", None) is None:
@@ -576,7 +672,24 @@ class EngineLoop:
             # scheduler policy + per-class admission/victim counters
             # (ISSUE 9)
             "sched": self.sched.stats(),
+            # asynchronous pipelined loop (ISSUE 13)
+            "async_loop": {
+                "enabled": self.async_enabled,
+                "pipelined_steps": self.pipelined_steps,
+                "device_idle_ratio": round(self.device_idle_ratio(), 4),
+                "emit_queue_depth": self._emit_stage.depth(),
+            },
         }
+
+    def device_idle_ratio(self) -> float:
+        """Fraction of recent serving wall time the device had NOTHING
+        dispatched (flight-window ``idle_gap_s`` / ``wall_s``) — the
+        async loop's headline gauge.  Host-side approximation: a gap is
+        charged from the previous completion's return to the next
+        dispatch whenever no step was in flight in between (pipelined
+        dispatches therefore charge zero), so it understates idle only
+        when a fetch returned after the device actually finished."""
+        return self.flight.window_ratio("idle_gap_s", ("wall_s",))
 
     def tokens_per_sec(self) -> float:
         """Goodput: generated tokens/s over the trailing rate window."""
@@ -630,6 +743,8 @@ class EngineLoop:
         return {k: out[k] for k in SATURATION_KEYS}
 
     def start(self):
+        if self.async_enabled:
+            self._emit_stage.start(self.name)
         self._thread = threading.Thread(
             target=self._run, name=f"helix-engine-{self.name}", daemon=True
         )
@@ -670,6 +785,10 @@ class EngineLoop:
                 self._handle_import(item)
                 continue
             if on_event is None:  # abort
+                # barrier: the emission worker may still be delivering
+                # this request's queued tokens — its bookkeeping and the
+                # forget below must not interleave
+                self._emit_stage.flush()
                 self.engine.abort(item)
                 self._subscribers.pop(item, None)
                 self._forget_request(item)
@@ -708,10 +827,12 @@ class EngineLoop:
         except Exception:  # noqa: BLE001 — bookkeeping must never fail admission
             log.exception("scheduler note_admitted failed")
 
-    def _observe_emit(self, req: Request) -> None:
+    def _observe_emit(self, req: Request, finished: bool) -> None:
         """Feed the latency histograms + engine-level spans from one
         emitted token (queue/prefill on the first token, decode span on
-        finish)."""
+        finish).  ``finished`` is the emission-time snapshot — the live
+        ``req.finished`` may already reflect a LATER step's reconcile
+        when delivery runs on the emission worker."""
         now = time.monotonic()
         rid = req.id
         tenant = getattr(req, "tenant", ANON_TENANT)
@@ -741,7 +862,7 @@ class EngineLoop:
         elif last is not None:
             self.obs.inter_token.observe(max(0.0, now - last))
         self._last_emit[rid] = now
-        if req.finished:
+        if finished:
             t_first = self._first_emit.pop(rid, now)
             self._last_emit.pop(rid, None)
             if req.trace_id:
@@ -761,28 +882,56 @@ class EngineLoop:
         self._last_emit.pop(request_id, None)
 
     def _emit(self, emitted) -> None:
+        """Snapshot + deliver in one call (synchronous paths: direct
+        emission, quarantine bisection).  The async loop snapshots on
+        the engine thread at push time and delivers on the emission
+        worker."""
+        self._deliver(self._snapshot_events(emitted))
+
+    def _snapshot_events(self, emitted) -> list:
+        """Render ``[(req, token), ...]`` into delivery-ready events —
+        ENGINE-THREAD ONLY, at emission time.  ``req.finished`` keeps
+        evolving after the push (the next step's reconcile may finish
+        this request before the worker delivers), so a delivery-time
+        read would stamp an EARLIER token as terminal, pop the
+        subscriber, and drop the real final tokens.  Within one batch
+        the finishing token is always a request's LAST entry (the
+        engine discards post-finish overruns), so only the last
+        occurrence carries the finished flag."""
+        last: dict = {}
+        for idx, (req, _token) in enumerate(emitted):
+            last[req.id] = idx
+        events = []
+        for idx, (req, token) in enumerate(emitted):
+            fin = req.finished and last[req.id] == idx
+            events.append((
+                req, fin,
+                TokenEvent(
+                    request_id=req.id,
+                    token_id=token,
+                    finished=fin,
+                    finish_reason=(
+                        req.finish_reason.value
+                        if fin and req.finish_reason else None
+                    ),
+                ),
+            ))
+        return events
+
+    def _deliver(self, events) -> None:
         # per-tenant token counts batched to ONE accounting call per
         # tenant per step (not per token) — the accounting lock is
         # shared with /metrics scrapes and must stay off the hot path
         tenant_tokens: dict = {}
-        for req, token in emitted:
-            self._observe_emit(req)
+        for req, fin, ev in events:
+            self._observe_emit(req, fin)
             t = getattr(req, "tenant", ANON_TENANT)
             tenant_tokens[t] = tenant_tokens.get(t, 0) + 1
             cb = self._subscribers.get(req.id)
             if cb is None:
                 continue
-            cb(
-                TokenEvent(
-                    request_id=req.id,
-                    token_id=token,
-                    finished=req.finished,
-                    finish_reason=(
-                        req.finish_reason.value if req.finish_reason else None
-                    ),
-                )
-            )
-            if req.finished:
+            cb(ev)
+            if fin:
                 self._subscribers.pop(req.id, None)
         for t, n in tenant_tokens.items():
             self.slo.note_tokens(t, n)
@@ -790,6 +939,7 @@ class EngineLoop:
     def _shed_kv_exhausted(self, req, waited: float) -> None:
         """Terminal typed shed for one request that outwaited the
         admission deadline (queued or parked-preempted)."""
+        self._emit_stage.flush()   # no error frame may overtake tokens
         msg = (
             f"{KV_EXHAUSTED}: request waited {waited:.1f}s for KV pages "
             f"(admission_timeout={self.admission_timeout}s) — the engine "
@@ -912,6 +1062,8 @@ class EngineLoop:
         drain = getattr(self.engine, "drain_resume_failures", None)
         if drain is None:
             return
+        if self._resume_failures_pending():
+            self._emit_stage.flush()
         for req, msg in drain():
             log.warning(
                 "engine '%s' resume failed for request_id=%s: %s",
@@ -932,9 +1084,10 @@ class EngineLoop:
                     )
                 )
 
-    def _step_once(self):
-        """One engine step, with the (normally disabled) fault-injection
-        hook in front so chaos tests can poison specific requests."""
+    def _fault_gate(self) -> None:
+        """The (normally disabled) fault-injection hook so chaos tests
+        can poison specific requests — shared by the synchronous step
+        and the async dispatch."""
         from helix_tpu.testing import faults
 
         inj = faults.active()
@@ -943,7 +1096,58 @@ class EngineLoop:
                 r.id for r in self.engine.waiting
             ]
             inj.maybe_fail_step(self.name, self.steps, ids)
+
+    def _step_once(self):
+        """One full synchronous engine step (quarantine bisection and
+        lockstep leaders use this — no pipelining)."""
+        self._fault_gate()
         return self.engine.step()
+
+    def _dispatch_once(self):
+        """Host phase of one engine step.  Lockstep leaders must run
+        their monolithic journaling ``step()`` — their ``__getattr__``
+        forwards ``step_dispatch`` to the INNER engine, which would step
+        correctly but publish nothing to the follower journal — as must
+        any engine without the dispatch/complete split.  Both return no
+        pending, so the loop behaves exactly synchronously."""
+        self._fault_gate()
+        if hasattr(self.engine, "journal") or not hasattr(
+            self.engine, "step_dispatch"
+        ):
+            return self.engine.step(), None
+        return self.engine.step_dispatch()
+
+    def _handle_step_failure(
+        self, e: Exception, dt_step: float, flight_pre: tuple,
+    ) -> None:
+        """The step-failure ladder (shared by the sync and async paths):
+        record, retry once on the exact same state, then quarantine."""
+        self._emit_stage.flush()
+        self.obs.step_seconds.observe(dt_step)
+        self._flight_record(
+            dt_step, flight_pre, generated=0, failed=str(e)
+        )
+        self.step_failures += 1
+        self._consec_failures += 1
+        scheduled = [
+            r.id for r in self.engine.slots if r is not None
+        ]
+        log.warning(
+            "engine '%s' step %d failed (consecutive=%d, "
+            "scheduled request_ids=%s): %s",
+            self.name, self.steps, self._consec_failures,
+            scheduled, e,
+        )
+        if self._consec_failures == 1:
+            # transient faults (preemption, relay hiccup) clear on
+            # an immediate retry of the exact same state
+            self.step_retries += 1
+            return
+        import traceback
+
+        traceback.print_exc()
+        self._quarantine(e)
+        self._consec_failures = 0
 
     # -- flight recorder (host-side counter deltas only) --------------------
 
@@ -966,9 +1170,12 @@ class EngineLoop:
             getattr(eng, "num_resumes", 0),
         )
 
+    def _resume_failures_pending(self) -> bool:
+        return bool(getattr(self.engine, "_resume_failures", None))
+
     def _flight_record(
         self, duration: float, pre: tuple, generated: int,
-        failed: Optional[str] = None,
+        failed: Optional[str] = None, timing: Optional[dict] = None,
     ) -> None:
         eng = self.engine
         p0, pad0, d0, a0, q0, sd0, sa0, sp0, rs0, pe0, re0 = pre
@@ -1040,6 +1247,12 @@ class EngineLoop:
                 for s in eng.slots if s is not None
             }),
         }
+        if timing:
+            # per-step time split (ISSUE 13): host build / device wait /
+            # emit, plus the device-idle gap this step charged — the
+            # numerators of helix_device_idle_ratio and the bench's
+            # host_overlap block
+            rec.update(timing)
         if failed is not None:
             rec["anomaly"] = "step_failure"
             rec["error"] = failed[:200]
@@ -1050,9 +1263,68 @@ class EngineLoop:
         self._tps.rate(getattr(eng, "num_generated_tokens", 0))
 
     def _run(self):
+        # the at-most-one dispatched-but-not-reconciled step (async
+        # pipeline, ISSUE 13); always None under the synchronous loop
+        inflight = None
+
+        def complete(pend):
+            """One step's reconcile: the fetch + every host-visible
+            effect, stamping the device-busy watermark the idle-gap
+            accounting reads."""
+            emitted = self.engine.step_complete(pend)
+            self._device_busy_until = time.monotonic()
+            return emitted
+
+        def reconcile_or_fail() -> bool:
+            """Reconcile point outside the main step path (inbox
+            arrivals, drain, idle, preemption): complete the in-flight
+            step and drain the emission stage.  False = the completion
+            failed and the failure ladder ran — restart the loop pass."""
+            nonlocal inflight
+            if inflight is None:
+                self._emit_stage.flush()
+                return True
+            pend, inflight = inflight, None
+            pre = self._flight_pre()
+            t0 = time.monotonic()
+            try:
+                emitted = complete(pend)
+            except Exception as e:  # noqa: BLE001 — fail requests, not the loop
+                self.engine.discard_pending(pend)
+                self._handle_step_failure(e, time.monotonic() - t0, pre)
+                return False
+            dt_wait = time.monotonic() - t0
+            t_emit = time.monotonic()
+            self._emit_stage.push(self._snapshot_events(emitted))
+            dt_emit = time.monotonic() - t_emit
+            # the step was dispatched by an earlier pass that skipped
+            # its record ("numbers land with its completion"): record
+            # it here or the burst's last step vanishes from the flight
+            # window (tokens, device wait, the idle-ratio denominator)
+            self._flight_record(
+                dt_wait, pre, generated=len(emitted),
+                timing={
+                    "host_build_s": 0.0,
+                    "device_wait_s": round(dt_wait, 6),
+                    "emit_s": round(dt_emit, 6),
+                    "idle_gap_s": 0.0,
+                    "wall_s": round(time.monotonic() - t0, 6),
+                    "pipelined": 1,
+                },
+            )
+            self._emit_stage.flush()
+            return True
+
         while not self._stop.is_set():
+            if inflight is not None and not self._inbox.empty():
+                # inbox items (submit/abort/import) mutate state the
+                # in-flight prediction did not see — reconcile first
+                if not reconcile_or_fail():
+                    continue
             self._drain_inbox()
             if self._draining:
+                if not reconcile_or_fail():
+                    continue
                 if not self.engine.has_work():
                     break
                 if time.monotonic() > self._drain_deadline:
@@ -1070,7 +1342,10 @@ class EngineLoop:
                     break
             if time.monotonic() - self._last_reap > 10.0:
                 self._last_reap = time.monotonic()
-                for req in self.engine.reap_stuck(self.max_queue_seconds):
+                reaped = self.engine.reap_stuck(self.max_queue_seconds)
+                if reaped:
+                    self._emit_stage.flush()
+                for req in reaped:
                     cb = self._subscribers.pop(req.id, None)
                     if cb:
                         cb(
@@ -1082,6 +1357,10 @@ class EngineLoop:
                         )
             self._memory_pressure_tick()
             if not self.engine.has_work():
+                if not reconcile_or_fail():
+                    continue
+                if self.engine.has_work():
+                    continue   # the reconcile freed/advanced work
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
@@ -1089,51 +1368,145 @@ class EngineLoop:
                 # scheduler pass (engine thread — the wait queue's
                 # owner): rewrite the queue into dispatch order (strict
                 # classes + per-tenant DRR) and refresh the per-step
-                # prefill-admission budget from the live TTFT burn
+                # prefill-admission budget from the live TTFT burn.
+                # With a step in flight this still only touches the wait
+                # queue and burn-rate reads (the sched.reorder contract)
+                # — and a non-empty queue forces the reconcile below
+                # before the dispatch acts on the new order anyway.
                 self.sched.reorder(self.engine.waiting)
                 self.engine.prefill_budget = self.sched.prefill_budget(
                     self.slo
                 )
+            # pipeline gate, decided BEFORE the dispatch: plain
+            # fused-decode steady state only — anything else (admission
+            # waves, chunked prefill, speculation, parked preemptions,
+            # dirty slot state, draining) reconciles first and runs the
+            # synchronous dispatch -> complete ordering
+            can_pipe = (
+                self.async_enabled
+                and not self._draining
+                and self.engine.pipeline_ready()
+            )
+            if inflight is not None and not can_pipe:
+                if not reconcile_or_fail():
+                    continue
             t_step = time.monotonic()
             flight_pre = self._flight_pre()
+            overlapped = inflight is not None
             try:
-                emitted = self._step_once()
+                emitted, pend = self._dispatch_once()
             except Exception as e:  # noqa: BLE001 — fail requests, not the loop
-                dt_step = time.monotonic() - t_step
-                self.obs.step_seconds.observe(dt_step)
-                self._flight_record(
-                    dt_step, flight_pre, generated=0, failed=str(e)
+                # the in-flight step is healthy already-dispatched work:
+                # reconcile it first so its tokens are not lost — and
+                # flight-record it (its fill pass skipped the record on
+                # the promise the completion would land it)
+                if inflight is not None:
+                    prev, inflight = inflight, None
+                    pre_prev = self._flight_pre()
+                    t0_prev = time.monotonic()
+                    try:
+                        prev_emitted = complete(prev)
+                    except Exception:  # noqa: BLE001 — poisoned chain
+                        self.engine.discard_pending(prev)
+                    else:
+                        self._emit_stage.push(
+                            self._snapshot_events(prev_emitted)
+                        )
+                        dt_prev = time.monotonic() - t0_prev
+                        self._flight_record(
+                            dt_prev, pre_prev,
+                            generated=len(prev_emitted),
+                            timing={
+                                "host_build_s": 0.0,
+                                "device_wait_s": round(dt_prev, 6),
+                                "emit_s": 0.0,
+                                "idle_gap_s": 0.0,
+                                "wall_s": round(dt_prev, 6),
+                                "pipelined": 1,
+                            },
+                        )
+                self._handle_step_failure(
+                    e, time.monotonic() - t_step, flight_pre
                 )
-                self.step_failures += 1
-                self._consec_failures += 1
-                scheduled = [
-                    r.id for r in self.engine.slots if r is not None
-                ]
-                log.warning(
-                    "engine '%s' step %d failed (consecutive=%d, "
-                    "scheduled request_ids=%s): %s",
-                    self.name, self.steps, self._consec_failures,
-                    scheduled, e,
+                continue
+            t_build_end = time.monotonic()
+            dt_build = t_build_end - t_step
+            idle_gap = 0.0
+            if not overlapped and self._device_busy_until:
+                # nothing was in flight while this step's metadata was
+                # built: the device sat idle from the last completion's
+                # return until this dispatch landed
+                idle_gap = max(
+                    0.0, t_build_end - self._device_busy_until
                 )
-                if self._consec_failures == 1:
-                    # transient faults (preemption, relay hiccup) clear on
-                    # an immediate retry of the exact same state
-                    self.step_retries += 1
-                    continue
-                import traceback
-
-                traceback.print_exc()
-                self._quarantine(e)
-                self._consec_failures = 0
+            prev, inflight = inflight, None
+            dt_wait = 0.0
+            try:
+                if prev is not None:
+                    # step N+1 is now queued on the device: fetch step
+                    # N's results — the block covers only the device
+                    # time the host build did not already overlap
+                    t_w = time.monotonic()
+                    prev_emitted = complete(prev)
+                    prev = None
+                    dt_wait += time.monotonic() - t_w
+                    emitted = prev_emitted + emitted
+                if pend is not None and can_pipe and pend.kind == "decode":
+                    inflight, pend = pend, None
+                    self.pipelined_steps += 1
+                elif pend is not None:
+                    t_w = time.monotonic()
+                    self.engine.step_complete(pend, emitted)
+                    pend = None
+                    dt_wait += time.monotonic() - t_w
+                    self._device_busy_until = time.monotonic()
+            except Exception as e:  # noqa: BLE001 — fail requests, not the loop
+                for p in (prev, pend):
+                    if p is not None:
+                        self.engine.discard_pending(p)
+                inflight = None
+                self._handle_step_failure(
+                    e, time.monotonic() - t_step, flight_pre
+                )
                 continue
             dt_step = time.monotonic() - t_step
             self.obs.step_seconds.observe(dt_step)
+            self.obs.host_build.observe(dt_build)
             self._consec_failures = 0
             self._barren_rounds = 0
             self.steps += 1
-            self._emit(emitted)
+            if inflight is not None and not emitted:
+                # pipeline-fill pass: dispatched with nothing reconciled
+                # yet — no flight record (a dispatch-only pass would read
+                # as zero_progress to the watchdog); the step's numbers
+                # land with its completion next pass
+                continue
+            t_emit = time.monotonic()
+            self._emit_stage.push(self._snapshot_events(emitted))
+            dt_emit = time.monotonic() - t_emit
             self._deliver_resume_failures()
-            self._flight_record(dt_step, flight_pre, generated=len(emitted))
+            self._flight_record(
+                dt_step, flight_pre, generated=len(emitted),
+                timing={
+                    "host_build_s": round(dt_build, 6),
+                    "device_wait_s": round(dt_wait, 6),
+                    "emit_s": round(dt_emit, 6),
+                    "idle_gap_s": round(idle_gap, 6),
+                    "wall_s": round(time.monotonic() - t_step, 6),
+                    "pipelined": 1 if overlapped else 0,
+                },
+            )
+        # a step still in flight at shutdown: reconcile so its tokens
+        # reach subscribers before the terminal sweep
+        if inflight is not None:
+            try:
+                self._emit_stage.push(
+                    self._snapshot_events(complete(inflight))
+                )
+            except Exception:  # noqa: BLE001 — best-effort at shutdown
+                self.engine.discard_pending(inflight)
+            inflight = None
+        self._emit_stage.stop()
         # terminal sweep: anything still in the inbox (raced a shutdown)
         # gets a clean error event instead of a 300s client hang
         while True:
@@ -1185,6 +1558,7 @@ class EngineLoop:
         self._evict(victim, msg)
 
     def _evict(self, req, msg: str) -> None:
+        self._emit_stage.flush()   # no error frame may overtake tokens
         self.engine.abort(req.id)
         self.quarantine_evictions += 1
         self.flight.note_anomaly(
@@ -1281,6 +1655,7 @@ class EngineLoop:
         an already-emitting request — the suspects are re-admitted
         untouched and requests are shed newest-first instead (bounded
         collateral, never abort-all)."""
+        self._emit_stage.flush()   # bisection emits directly from here
         active = self._active_by_recency()
         suspects = [r for r in active if not r.output_tokens]
         emitting = [r for r in active if r.output_tokens]
@@ -1374,6 +1749,7 @@ class EngineLoop:
             )
 
     def _fail_all(self, msg: str) -> None:
+        self._emit_stage.flush()   # no error frame may overtake tokens
         for req in self._active_by_recency():
             self.engine.abort(req.id)
             self._forget_request(req.id)

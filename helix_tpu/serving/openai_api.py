@@ -322,6 +322,20 @@ class OpenAIServer:
                 "helix_shed_requests_total",
                 getattr(m.loop, "shed_requests", 0), lbl,
             )
+            # asynchronous pipelined loop (ISSUE 13): how often the loop
+            # dispatched step N+1 while step N was still executing, and
+            # the flight-window fraction of serving time the device had
+            # nothing dispatched (the pipeline's headline gauge — the
+            # sync loop's build+emit shadow shows up here)
+            c.counter(
+                "helix_pipelined_steps_total",
+                getattr(m.loop, "pipelined_steps", 0), lbl,
+            )
+            if hasattr(m.loop, "device_idle_ratio"):
+                c.gauge(
+                    "helix_device_idle_ratio",
+                    round(m.loop.device_idle_ratio(), 4), lbl,
+                )
             # latency histograms (TTFT / queue wait / inter-token / step
             # duration) observed by the engine loop itself
             loop_obs = getattr(m.loop, "obs", None)

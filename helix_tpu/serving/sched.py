@@ -284,7 +284,9 @@ class FifoScheduler:
     # -- ordering ------------------------------------------------------------
 
     def reorder(self, waiting: list) -> None:
-        """FIFO: leave the queue exactly as submitted."""
+        """FIFO: leave the queue exactly as submitted.  Like the WFQ
+        override, safe to call with a device step in flight (the async
+        loop's pending-dispatch contract): reads only the wait queue."""
 
     # -- per-step prefill budget --------------------------------------------
 
@@ -440,7 +442,15 @@ class WFQScheduler(FifoScheduler):
         of the account so one pass emits the whole fair interleave.
         FIFO order within a tenant is preserved.  Runs on the engine
         thread (the list's owner); an in-place slice assignment keeps
-        concurrent GIL-atomic ``len()`` / ``list()`` readers safe."""
+        concurrent GIL-atomic ``len()`` / ``list()`` readers safe.
+
+        Pending-dispatch contract (ISSUE 13): the async engine loop may
+        invoke this while a device step is still in flight, so a reorder
+        pass must read ONLY the wait queue and the burn-rate accounts —
+        never slot state, page occupancy or anything else the in-flight
+        step's reconcile will rewrite.  The loop reconciles before the
+        dispatch that acts on the new order, so the order can never be
+        applied against a stale resource picture."""
         if len(waiting) < 2:
             # nothing to reorder, but keep the per-class depth gauges
             # live — a burst's stamp must not outlast the burst

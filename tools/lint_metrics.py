@@ -59,6 +59,15 @@ Contracts, enforced repo-wide (wired into tier-1 via
    respectively; the control plane must keep calling their collector
    helpers (``collect_cp_routing`` / ``collect_cp_autoscale``), the
    contracts 3-6 importer pattern.
+9. **Engine-loop host-sync discipline** (ISSUE 13): the asynchronous
+   pipelined loop keeps every device fetch inside the engine's
+   ``step_complete`` reconcile — ``serving/engine_loop.py`` itself must
+   contain NO ``jax.device_get`` / ``block_until_ready`` /
+   ``np.asarray`` call.  A future helper that quietly fetches per step
+   would re-serialize the pipeline without failing any functional test;
+   this fails the build instead.  A genuinely designated reconcile/emit
+   site is allowlisted by carrying a ``host-sync-ok: <why>`` marker on
+   the same line.
 
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
@@ -449,6 +458,39 @@ def _step_builder_violations(root: str) -> list:
     return violations
 
 
+# -- contract 9: engine-loop host-sync discipline ----------------------------
+# The async pipeline lives or dies on the loop never blocking on the
+# device outside the engine's reconcile: one stray per-step fetch added
+# to engine_loop.py re-serializes everything without failing a test.
+_HOST_SYNC_RE = re.compile(
+    r"jax\.device_get|block_until_ready|np\.asarray\("
+)
+# a designated reconcile/emit site carries this marker on the same line
+_HOST_SYNC_OK = "host-sync-ok"
+
+
+def _host_sync_violations(root: str) -> list:
+    """Contract 9: no host-device synchronization primitives in
+    serving/engine_loop.py outside marker-allowlisted sites."""
+    path = os.path.join(root, "helix_tpu", "serving", "engine_loop.py")
+    if not os.path.isfile(path):
+        return []
+    violations = []
+    rel = os.path.relpath(path, root)
+    with open(path, encoding="utf-8", errors="replace") as f:
+        lines = f.read().splitlines()
+    for i, line in enumerate(lines, 1):
+        if _HOST_SYNC_RE.search(line) and _HOST_SYNC_OK not in line:
+            violations.append(
+                f"{rel}:{i}: host-device sync in the engine loop — "
+                "fetches belong in Engine.step_complete (the reconcile "
+                "point); a per-step fetch here re-serializes the async "
+                "pipeline.  If this IS a designated reconcile/emit "
+                "site, mark the line 'host-sync-ok: <why>'"
+            )
+    return violations
+
+
 def run(root: str) -> list:
     """Returns a list of violation strings (empty = clean)."""
     sat_keys, violations = _load_saturation_schema(root)
@@ -456,6 +498,7 @@ def run(root: str) -> list:
     violations += _migration_schema_violations(root)
     violations += _step_builder_violations(root)
     violations += _routing_schema_violations(root)
+    violations += _host_sync_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
     sched_reason_res = [
