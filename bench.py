@@ -671,6 +671,230 @@ def main():
     }
     del mig_a, mig_b, mig_ref
 
+    # disaggregated prefill/decode (ISSUE 14): does splitting the pools
+    # protect decode TTFT from a concurrent long prefill?  Two passes
+    # over the same workload — a long chunked prompt + a burst of short
+    # decode requests: (a) COLOCATED, everything on one mixed engine
+    # loop; (b) SPLIT, the long prompt lands on a prefill-pool loop,
+    # exports at prefill completion and ships (in-process, through the
+    # real wire format + checksum-validated import) to the decode-pool
+    # loop that serves the shorts.  Recorded: short-request TTFT p95
+    # both ways, transfer ms/page, and the filestore tier's
+    # warm-restart hit (a fresh engine serving a cached prefix without
+    # recomputing it).
+    import tempfile as _tempfile
+    import threading as _threading2
+
+    from helix_tpu.serving.engine_loop import EngineLoop as _Loop
+    from helix_tpu.serving import migration as _mig2
+
+    short_sampling = SamplingParams(temperature=0.0, max_tokens=6)
+    long_sampling = SamplingParams(temperature=0.0, max_tokens=4)
+    long_len = 4096 if on_tpu else 480   # >> max_prefill_len: chunks
+    long_prompt = [
+        (11 * j) % (cfg.vocab_size - 2) + 1 for j in range(long_len)
+    ]
+    short_prompts = [
+        [(7 * j + i) % (cfg.vocab_size - 2) + 1
+         for j in range(prompt_len)]
+        for i in range(6)
+    ]
+
+    def ttft_probe(loop_short, submit_long, tag):
+        """Submit the long prefill, then the short burst; return the
+        shorts' TTFTs (seconds)."""
+        submit_long()
+        waits = []
+        for i, p in enumerate(short_prompts):
+            ev = _threading2.Event()
+            first: dict = {}
+            t0 = time.perf_counter()
+
+            def cb(e, _ev=ev, _f=first, _t0=t0):
+                if "t" not in _f and e.token_id >= 0:
+                    _f["t"] = time.perf_counter() - _t0
+                if e.finished:
+                    _ev.set()
+
+            loop_short.submit(
+                Request(
+                    id=f"{tag}-short-{i}", prompt_tokens=list(p),
+                    sampling=short_sampling,
+                ),
+                cb,
+            )
+            waits.append((ev, first))
+        out = []
+        for ev, first in waits:
+            ev.wait(timeout=300)
+            out.append(first.get("t", float("inf")))
+        return out
+
+    def p95(xs):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(0.95 * len(xs)))]
+
+    def submit_long_to(loop, tag, cb=None):
+        ev = _threading2.Event()
+
+        def done(e, _ev=ev):
+            if cb is not None:
+                cb(e)
+            if e.finished:
+                _ev.set()
+
+        loop.submit(
+            Request(
+                id=f"{tag}-long", prompt_tokens=list(long_prompt),
+                sampling=long_sampling,
+            ),
+            done,
+        )
+        return ev
+
+    # -- colocated baseline (warm pass first: compiles stay out) ----------
+    colo_loop = _Loop(make_engine(kv_dtype), name="bench-disagg-colo")
+    colo_loop.start()
+    submit_long_to(colo_loop, "warm").wait(timeout=600)
+    ttft_probe(colo_loop, lambda: None, "warm")
+    long_done = [None]
+    colo_ttfts = ttft_probe(
+        colo_loop,
+        lambda: long_done.__setitem__(
+            0, submit_long_to(colo_loop, "colo")
+        ),
+        "colo",
+    )
+    if long_done[0] is not None:
+        long_done[0].wait(timeout=600)
+    colo_loop.stop(join=True)
+
+    # -- split pools: prefill loop hands off to the decode loop -----------
+    pre_loop = _Loop(make_engine(kv_dtype), name="bench-disagg-pre")
+    dec_loop = _Loop(make_engine(kv_dtype), name="bench-disagg-dec")
+    pre_loop.start()
+    dec_loop.start()
+    xfer_ms = [0.0]
+    xfer_pages = [0]
+    handoff_ok = [False]
+    long_finished = _threading2.Event()
+
+    def on_remote_event(e):
+        if e.finished:
+            long_finished.set()
+
+    def on_local_long_event(e):
+        # a failed/skipped handoff finishes the long request HERE —
+        # without this the 600 s wait below would stall on a fault
+        # (handoff_ok stays False, which already marks the split
+        # comparison invalid).  On a CONFIRMED handoff the local abort
+        # also finishes the request, but handoff_ok is set before the
+        # abort fires, so the remote side owns the event then.
+        if e.finished and not handoff_ok[0]:
+            long_finished.set()
+
+    def on_export(kind, wire):
+        # runs on the prefill loop's engine thread — fine for a bench
+        if kind != "snapshot":
+            return
+        t0 = time.perf_counter()
+        snap2 = _mig2.wire_to_snapshot(wire)
+        res: list = []
+        dec_loop.submit_import(
+            snap2, on_remote_event,
+            on_result=lambda e, c: res.append(e),
+        )
+        deadline = time.monotonic() + 60.0
+        while not res and time.monotonic() < deadline:
+            time.sleep(0.002)
+        if res and res[0] is None:
+            xfer_ms[0] = (time.perf_counter() - t0) * 1000.0
+            xfer_pages[0] = len(wire.get("pages") or [])
+            handoff_ok[0] = True
+            pre_loop.abort(f"split-long")
+
+    def submit_split_long():
+        pre_loop.stage_disagg_export("split-long", on_export)
+        pre_loop.submit(
+            Request(
+                id="split-long", prompt_tokens=list(long_prompt),
+                sampling=long_sampling,
+            ),
+            on_local_long_event,
+        )
+
+    split_ttfts = ttft_probe(dec_loop, submit_split_long, "split")
+    long_finished.wait(timeout=600)
+    pre_loop.stop(join=True)
+    dec_loop.stop(join=True)
+
+    # -- filestore warm restart (cross-process prompt caching) ------------
+    from helix_tpu.serving.kv_filestore import filestore_for_engine
+
+    fs_dir = _tempfile.mkdtemp(prefix="helix-bench-kvfs-")
+    fs_prompt = [
+        (13 * j) % (cfg.vocab_size - 2) + 1 for j in range(52)
+    ]
+    fs_sampling = SamplingParams(temperature=0.0, max_tokens=8)
+
+    def fs_run(tag):
+        # prefix cache ON here (the tier feeds it), own engine per run
+        e = Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=batch, page_size=16,
+                num_pages=num_pages, max_pages_per_seq=64,
+                max_prefill_len=512 if on_tpu else 32,
+                decode_steps_per_sync=16 if on_tpu else 1,
+                kv_cache_dtype=kv_dtype,
+            ),
+        )
+        e.kv_filestore = filestore_for_engine(fs_dir, cfg, e.cache_cfg)
+        r = Request(
+            id=f"fs-{tag}", prompt_tokens=list(fs_prompt),
+            sampling=fs_sampling,
+        )
+        e.add_request(r)
+        while not r.finished:
+            e.step()
+        e.kv_filestore.flush()   # async write-through: land the blobs
+        return e, r
+
+    cold_e, cold_r = fs_run("cold")
+    warm_e, warm_r = fs_run("warm")
+    assert warm_r.output_tokens == cold_r.output_tokens, (
+        "filestore-warm restart diverged from the cold run"
+    )
+    result["disagg"] = {
+        "colo_short_ttft_p95_ms": round(p95(colo_ttfts) * 1000.0, 3),
+        "split_short_ttft_p95_ms": round(p95(split_ttfts) * 1000.0, 3),
+        # the acceptance read: pools split must not be worse than the
+        # colocated mixed engine for decode TTFT under a long prefill
+        "split_no_worse": p95(split_ttfts) <= p95(colo_ttfts) * 1.25,
+        "handoff_ok": bool(handoff_ok[0]),
+        "transfer_ms_per_page": round(
+            xfer_ms[0] / max(1, xfer_pages[0]), 3
+        ),
+        "transfer_pages": xfer_pages[0],
+        "filestore": {
+            "cold_stores": cold_e.kv_filestore.stores,
+            "warm_hit_pages": warm_e.kv_filestore.hits,
+            "warm_cached_tokens": warm_r.cached_tokens,
+            "warm_restored_pages": warm_e.filestore_restored_pages,
+            "hit_rate": round(
+                warm_e.kv_filestore.hits
+                / max(
+                    1,
+                    warm_e.kv_filestore.hits
+                    + warm_e.kv_filestore.misses,
+                ),
+                4,
+            ),
+            "bit_identical": warm_r.output_tokens == cold_r.output_tokens,
+        },
+    }
+    del colo_loop, pre_loop, dec_loop, cold_e, warm_e
+
     # per-tenant SLO baseline (ISSUE 7): a two-tenant mixed load through
     # the real EngineLoop (the layer that owns TTFT/queue-wait
     # accounting), so the item-5 scheduler PR has a recorded

@@ -148,6 +148,94 @@ ENV_REFERENCE: tuple = (
         section="server",
     ),
     EnvVar(
+        "HELIX_POOL_DISAGG",
+        "Set to 1 to enable disaggregated prefill/decode at the control "
+        "plane: streaming prompts dispatch to a prefill-pool runner "
+        "that computes the prompt, ships the KV snapshot + sampler "
+        "state to a decode-pool peer, and the stream resumes there "
+        "(greedy and seeded outputs bit-identical to colocated "
+        "serving). Every failure rung falls back toward colocated "
+        "serving — prefill runner serves locally on a failed ship, the "
+        "decode pool re-prefills on a failed handoff. Needs runners "
+        "declaring role: prefill and decode (profile role: or "
+        "HELIX_POOL_ROLE). Unset/0: colocated serving.",
+        section="server",
+    ),
+    EnvVar(
+        "HELIX_POOL_ROLE",
+        "This node's disaggregation pool role (prefill | decode | "
+        "mixed), heartbeat-federated to the control plane. Beats the "
+        "applied profile's role: declaration (the HELIX_SPEC_TOKENS "
+        "operator contract). Ordinary traffic avoids prefill-pool "
+        "runners while any decode/mixed runner serves the model; the "
+        "prefill handoff picks strictly from the prefill pool. Unset: "
+        "the profile's role (default mixed).",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_XFER_ATTEMPT_TIMEOUT",
+        "Per-attempt timeout in seconds for one KV snapshot ship (a "
+        "POST /v1/migrate/import to a peer runner) — drain migration "
+        "and disaggregated prefill handoffs both obey it, so one slow "
+        "peer cannot wedge a drain.",
+        default="10",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_XFER_MAX_ATTEMPTS",
+        "Rounds over the candidate peer set a KV snapshot ship makes "
+        "before giving up (each round tries every model-matching "
+        "target once; rounds back off exponentially).",
+        default="3",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_XFER_BACKOFF_BASE",
+        "Base seconds of the capped exponential backoff between KV "
+        "ship rounds (round n sleeps min(base * 2^n, cap)).",
+        default="0.1",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_XFER_BACKOFF_CAP",
+        "Cap seconds of the KV ship backoff.",
+        default="2.0",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_XFER_DEADLINE",
+        "Hard total deadline in seconds for one KV snapshot transfer "
+        "(all attempts + backoffs + the disagg handler's wait for "
+        "prefill completion). Past it the ship is abandoned "
+        "(helix_xfer_deadline_exceeded_total) and the request degrades "
+        "to local serving. Unset: HELIX_MIGRATION_TIMEOUT.",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_FILESTORE_KV_DIR",
+        "Root directory of the persistent filestore KV tier (the "
+        "bottom rung of the residency ladder: HBM -> host RAM -> peer "
+        "-> filestore). Freshly prefilled full prefix pages persist "
+        "here (content-addressed by prefix-chain digest, namespaced by "
+        "model + KV geometry, blake2b-checksummed) and restore across "
+        "process restarts — an agent fleet's shared system prompt "
+        "survives a rolling deploy without recomputing. Corrupt or "
+        "missing blobs degrade to recompute with a typed counter "
+        "(helix_filestore_kv_corrupt_total), never an error. Point it "
+        "at a shared filesystem to share prefixes across runners. "
+        "Unset: tier off. Never armed for multihost lockstep engines.",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_FILESTORE_KV_QUOTA_BYTES",
+        "Per-tenant write quota for the filestore KV tier in bytes "
+        "(the PR 7 tenant identity is charged at write-through). Past "
+        "it new blobs are rejected with a typed counter "
+        "(helix_filestore_kv_quota_rejects_total); reads are never "
+        "gated. 0/unset: unlimited.",
+        section="accelerator",
+    ),
+    EnvVar(
         "HELIX_EXACT_SAMPLING",
         "Set to 1 to force the exact full-vocab top-p sampling path for "
         "every request (default: auto — the 64-candidate MXU fast path "

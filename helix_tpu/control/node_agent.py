@@ -284,6 +284,18 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     )
     engine = Engine(model_cfg, params, ecfg, mesh=mesh)
     engine.warmup()   # compile prefill/decode before the model goes routable
+    fs_dir = _os_env.environ.get("HELIX_FILESTORE_KV_DIR", "")
+    if fs_dir and not pm.multihost:
+        # persistent filestore KV tier (ISSUE 14): the bottom rung of
+        # the residency ladder — full prefix pages persist across
+        # restarts (content-addressed, checksummed, tenant-quota'd).
+        # Lockstep engines never arm it: a local-disk read at admission
+        # would desync follower replay.
+        from helix_tpu.serving.kv_filestore import filestore_for_engine
+
+        engine.kv_filestore = filestore_for_engine(
+            fs_dir, model_cfg, engine.cache_cfg
+        )
     role = pm.multihost.get("role", "")
     if role == "leader":
         # journal the command stream for follower hosts (lockstep SPMD
@@ -412,6 +424,10 @@ class NodeAgent:
         # honest Retry-After on a cluster-wide-drain 503
         self.draining = False
         self.drain_deadline_ts = 0.0
+        # disaggregated prefill/decode pool role (ISSUE 14): declared by
+        # the applied profile, heartbeat-federated; HELIX_POOL_ROLE
+        # beats the profile (the HELIX_SPEC_TOKENS operator contract)
+        self.profile_role = "mixed"
         self._drain_stats: dict = {}
         self._drain_thread: Optional[threading.Thread] = None
         # fired AFTER a control-plane-requested drain completes (ISSUE
@@ -439,7 +455,9 @@ class NodeAgent:
                 self._teardown_all()
                 self.registry.inner = ModelRegistry()
                 self.state = ApplyState(status="running", profile_name="")
+                self.profile_role = "mixed"
                 return self.state
+            self.profile_role = getattr(profile, "role", "mixed")
             errors = profile.validate()
             if errors:
                 self.state = ApplyState(
@@ -661,6 +679,17 @@ class NodeAgent:
             return {}
         return merge_rollups(rollups, top_k=tenant_top_k_from_env())
 
+    def pool_role(self) -> str:
+        """This node's disaggregation pool role: HELIX_POOL_ROLE beats
+        the applied profile's ``role:`` (unknown values degrade to the
+        profile's, then to mixed — the control plane re-sanitises)."""
+        import os
+
+        env = os.environ.get("HELIX_POOL_ROLE", "").strip().lower()
+        if env in ("prefill", "decode", "mixed"):
+            return env
+        return self.profile_role or "mixed"
+
     def heartbeat_payload(self) -> dict:
         """Wire format mirrors the reference heartbeat body
         (``api/cmd/sandbox-heartbeat/main.go:28-60``): id + accelerator
@@ -688,6 +717,9 @@ class NodeAgent:
             },
             "saturation": self.saturation_summary(),
             "tenants": self.tenant_summary(),
+            # disaggregation pool role (ISSUE 14): the router schedules
+            # prefill and decode pools independently off this
+            "role": self.pool_role(),
             # drain state (ISSUE 11): the router stops routing NEW work
             # here the beat after this flips; in-flight work finishes or
             # migrates before the deadline

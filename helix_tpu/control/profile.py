@@ -151,6 +151,12 @@ class ServingProfile:
     # HBM-accounted residency manager (load-on-demand, LRU-evict-idle) —
     # the reference's multi-model story is compose down/up per swap.
     residency: Optional[dict] = None
+    # disaggregated prefill/decode pool role (ISSUE 14): "prefill" nodes
+    # compute prompts and ship KV snapshots to the decode pool; "decode"
+    # nodes run latency-sensitive decode (and import handoffs); "mixed"
+    # (the default) serves both — exactly the pre-pools behaviour.
+    # Heartbeat-federated; HELIX_POOL_ROLE on the node beats the profile.
+    role: str = "mixed"
 
     @classmethod
     def from_yaml(cls, text: str) -> "ServingProfile":
@@ -159,11 +165,17 @@ class ServingProfile:
 
     @classmethod
     def from_dict(cls, d: dict) -> "ServingProfile":
+        role = str(d.get("role", "mixed") or "mixed").strip().lower()
+        if role not in ("prefill", "decode", "mixed"):
+            raise ValueError(
+                f"profile role must be prefill|decode|mixed, got {role!r}"
+            )
         return cls(
             name=d["name"],
             models=tuple(ProfileModel.from_dict(m) for m in d.get("models", [])),
             requirement=ProfileRequirement.from_dict(d.get("requirement", {})),
             residency=d.get("residency"),
+            role=role,
         )
 
     def to_dict(self) -> dict:
@@ -172,6 +184,7 @@ class ServingProfile:
             "requirement": self.requirement.to_dict(),
             "models": [m.to_dict() for m in self.models],
             **({"residency": self.residency} if self.residency else {}),
+            **({"role": self.role} if self.role != "mixed" else {}),
         }
 
     def to_yaml(self) -> str:

@@ -46,6 +46,36 @@ CP_ROUTE_CLASS_STEERED = "helix_cp_route_class_steered_total"
 CP_ROUTE_STALE_NEUTRAL = "helix_cp_route_stale_neutral_total"
 CP_ROUTE_AFFINITY_ENTRIES = "helix_cp_route_affinity_entries"
 
+# ---------------------------------------------------------------------------
+# pool roles (ISSUE 14): disaggregated prefill/decode.  A runner's
+# serving profile declares its pool (heartbeat-federated); the router
+# schedules the pools independently — ordinary (decode) traffic never
+# lands on a prefill-pool runner while any decode/mixed runner serves
+# the model, and the prefill handoff picks strictly from the prefill
+# pool.  The ``helix_cp_pool_*`` vocabulary is minted ONLY here
+# (tools/lint_metrics.py contract 10); the control plane calls
+# ``collect_cp_pools``.
+# ---------------------------------------------------------------------------
+
+POOL_PREFILL = "prefill"
+POOL_DECODE = "decode"
+POOL_MIXED = "mixed"
+POOL_ROLES = (POOL_PREFILL, POOL_DECODE, POOL_MIXED)
+
+CP_POOL_RUNNERS = "helix_cp_pool_runners"
+CP_POOL_HANDOFFS = "helix_cp_pool_handoffs_total"
+CP_POOL_HANDOFF_FALLBACKS = "helix_cp_pool_handoff_fallbacks_total"
+CP_POOL_DISAGG_ENABLED = "helix_cp_pool_disagg_enabled"
+
+
+def sanitize_pool_role(value) -> str:
+    """Clamp a runner-supplied pool role to the known set — a malformed
+    role degrades to ``mixed`` (fully routable), never rejects the
+    heartbeat (the PR 4/7/11 heartbeat-hardening pattern)."""
+    if isinstance(value, str) and value.strip().lower() in POOL_ROLES:
+        return value.strip().lower()
+    return POOL_MIXED
+
 
 def _env_float(name: str, default: float) -> float:
     try:
@@ -382,6 +412,12 @@ class RunnerState:
     # feeds the honest Retry-After on that 503.
     draining: bool = False
     drain_deadline: float = 0.0
+    # pool role (ISSUE 14): prefill | decode | mixed.  Profile-declared,
+    # heartbeat-federated; ordinary picks avoid prefill-pool runners
+    # (they serve handoff prefills), the disagg handoff picks from them
+    # strictly.  Mixed (the default) behaves exactly as before roles
+    # existed.
+    role: str = POOL_MIXED
 
     @property
     def routable(self) -> bool:
@@ -419,6 +455,11 @@ class InferenceRouter:
         self.route_affinity_yields = 0
         self.route_class_steered = 0
         self.route_stale_neutral = 0
+        # disaggregated prefill/decode (ISSUE 14): handoff outcomes,
+        # incremented by the dispatch orchestration (plain ints, GIL-
+        # atomic) and rendered by collect_cp_pools
+        self.pool_handoffs = 0
+        self.pool_handoff_fallbacks = 0
 
     def _breaker(self, runner_id: str) -> CircuitBreaker:
         """Lock must be held."""
@@ -441,12 +482,14 @@ class InferenceRouter:
         tenants: Optional[dict] = None,
         draining: bool = False,
         drain_deadline: float = 0.0,
+        role: str = POOL_MIXED,
     ) -> RunnerState:
         with self._lock:
             st = self._runners.get(runner_id)
             if st is None:
                 st = RunnerState(id=runner_id)
                 self._runners[runner_id] = st
+            st.role = sanitize_pool_role(role)
             st.models = list(models or [])
             st.profile_name = profile_name
             st.profile_status = profile_status
@@ -528,6 +571,7 @@ class InferenceRouter:
     def pick_runner(
         self, model: str, exclude: Iterable[str] = (),
         sched_class: str = "", affinity_key: Optional[str] = None,
+        role: Optional[str] = None,
     ) -> Optional[RunnerState]:
         """Failure- and load-aware pick over routable runners serving
         ``model``: skips runners in ``exclude`` (already tried this
@@ -548,7 +592,15 @@ class InferenceRouter:
         whose tenants are burning SLO budget, and stale or missing
         saturation scores NEUTRAL — never best.  ``affinity_key`` (a
         ``prefix_digest``) is honoured as a hint when the remembered
-        runner is a non-avoided candidate; it yields to saturation."""
+        runner is a non-avoided candidate; it yields to saturation.
+
+        Pool roles (ISSUE 14): ``role="prefill"`` restricts the pick to
+        prefill-pool runners (None when the pool is empty — the caller
+        degrades to colocated serving).  Ordinary picks
+        (``role=None``) avoid prefill-pool runners while ANY
+        decode/mixed runner serves the model; when the prefill pool is
+        all there is, it serves ordinary traffic too (degrade-to-local
+        by design — a role is scheduling intent, not capability)."""
         now = self.clock()
         exclude = set(exclude)
         with self._lock:
@@ -563,6 +615,16 @@ class InferenceRouter:
                 and now - st.last_heartbeat <= self.ttl
                 and st.id not in exclude
             ]
+            if role == POOL_PREFILL:
+                candidates = [
+                    st for st in candidates if st.role == POOL_PREFILL
+                ]
+            else:
+                non_prefill = [
+                    st for st in candidates if st.role != POOL_PREFILL
+                ]
+                if non_prefill:
+                    candidates = non_prefill
             if not candidates:
                 return None
             allowed = [
@@ -827,6 +889,35 @@ class InferenceRouter:
                 for rid, st in sorted(self._runners.items())
             }
 
+    def note_pool_handoff(self) -> None:
+        """A disaggregated prefill handoff reached its decode peer."""
+        self.pool_handoffs += 1
+
+    def note_pool_fallback(self) -> None:
+        """A disaggregated handoff attempt fell back to colocated
+        serving (prefill runner failed / ship failed / resume failed)."""
+        self.pool_handoff_fallbacks += 1
+
+    def role_counts(self) -> dict:
+        """{role: routable fresh runners} — the pool-shape gauge source
+        and the /v1/cluster/status pools block."""
+        now = self.clock()
+        out = {r: 0 for r in POOL_ROLES}
+        with self._lock:
+            for st in self._runners.values():
+                if st.routable and now - st.last_heartbeat <= self.ttl:
+                    out[sanitize_pool_role(st.role)] += 1
+        return out
+
+    def pools_status(self) -> dict:
+        """The /v1/cluster/status 'pools' block (the JSON twin of
+        collect_cp_pools)."""
+        return {
+            "roles": self.role_counts(),
+            "handoffs": self.pool_handoffs,
+            "handoff_fallbacks": self.pool_handoff_fallbacks,
+        }
+
     def migration_targets(self, for_runner: str) -> list:
         """Peers a draining runner may ship snapshots to: fresh,
         routable, NOT draining, with an address, excluding the asker.
@@ -839,6 +930,7 @@ class InferenceRouter:
                     "id": st.id,
                     "address": st.meta.get("address", ""),
                     "models": list(st.models),
+                    "role": st.role,
                 }
                 for st in sorted(
                     self._runners.values(), key=lambda s: s.id
@@ -990,4 +1082,31 @@ def collect_cp_routing(c, router: "InferenceRouter") -> None:
     c.gauge(
         CP_ROUTE_AFFINITY_ENTRIES, len(router._affinity),
         help="Live prefix-digest -> runner entries in the affinity LRU",
+    )
+
+
+def collect_cp_pools(
+    c, router: "InferenceRouter", disagg_enabled: bool = False
+) -> None:
+    """Control-plane pool-role series (ISSUE 14, called from the cp's
+    scrape-time collector).  The ``helix_cp_pool_*`` vocabulary is
+    minted here and only here (lint contract 10)."""
+    for role, n in sorted(router.role_counts().items()):
+        c.gauge(
+            CP_POOL_RUNNERS, n, {"role": role},
+            help="Routable runners by declared pool role",
+        )
+    c.counter(
+        CP_POOL_HANDOFFS, router.pool_handoffs,
+        help="Disaggregated prefill handoffs that resumed on the "
+             "decode peer",
+    )
+    c.counter(
+        CP_POOL_HANDOFF_FALLBACKS, router.pool_handoff_fallbacks,
+        help="Handoff attempts that fell back to colocated serving "
+             "(prefill/ship/resume failure — the degrade ladder)",
+    )
+    c.gauge(
+        CP_POOL_DISAGG_ENABLED, 1 if disagg_enabled else 0,
+        help="1 while disaggregated prefill/decode routing is enabled",
     )

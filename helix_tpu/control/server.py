@@ -35,10 +35,13 @@ from helix_tpu import obs
 from helix_tpu.control.compute import collect_cp_autoscale
 from helix_tpu.control.profile import ServingProfile, check_compatibility
 from helix_tpu.control.router import (
+    POOL_PREFILL,
     InferenceRouter,
+    collect_cp_pools,
     collect_cp_routing,
     prefix_digest,
     prompt_head,
+    sanitize_pool_role,
 )
 from helix_tpu.control.store import Store
 from helix_tpu.obs.flight import SATURATION_KEYS
@@ -53,11 +56,15 @@ from helix_tpu.obs.slo import (
 )
 from helix_tpu.obs.trace import TRACE_HEADER
 from helix_tpu.serving.migration import (
+    DISAGG_HEADER,
+    DISAGG_PEER_ADDR_HEADER,
+    DISAGG_PEER_ID_HEADER,
     SSEParser,
     ElisionTracker,
     chunk_delta_text,
     chunk_finish_reason,
     collect_cp_migration,
+    disagg_pools_enabled,
     make_chunk,
     midstream_failover_enabled,
     parse_migrated_peer,
@@ -1529,6 +1536,9 @@ class ControlPlane:
         # control/router.py and control/compute.py (lint contract 8)
         collect_cp_routing(c, self.router)
         collect_cp_autoscale(c, self.compute)
+        # pool-role + disagg handoff series (ISSUE 14): minted ONLY by
+        # control/router.py (lint contract 10)
+        collect_cp_pools(c, self.router, disagg_pools_enabled())
 
     async def cluster_status(self, request):
         """Operator rollup of the whole cluster's saturation: per runner
@@ -1571,6 +1581,7 @@ class ControlPlane:
                     "profile_status": st.profile_status,
                     "routable": st.routable,
                     "draining": st.draining,
+                    "role": st.role,
                     "heartbeat_age_seconds": round(
                         max(0.0, now - st.last_heartbeat), 3
                     ),
@@ -1614,6 +1625,11 @@ class ControlPlane:
                     if self.compute is not None
                     else {"enabled": False}
                 ),
+                # disaggregated prefill/decode pools (ISSUE 14)
+                "pools": {
+                    **self.router.pools_status(),
+                    "disagg_enabled": disagg_pools_enabled(),
+                },
             }
         )
 
@@ -1823,6 +1839,10 @@ class ControlPlane:
             accelerators=body.get("accelerators", []),
             meta={"address": body.get("address", "")},
             saturation=saturation,
+            # pool role (ISSUE 14): runner-supplied like saturation —
+            # a malformed role degrades to "mixed" (fully routable),
+            # never rejects the heartbeat
+            role=sanitize_pool_role(body.get("role")),
             # always overwrite: a live runner with past traffic reports
             # lifetime counters every beat, so {} means a RESTARTED (or
             # traffic-never-seen) runner — keeping the previous rollup
@@ -5031,9 +5051,13 @@ class ControlPlane:
         # continue the client's stream on a surviving runner after a
         # death PAST the first byte — resume-from-snapshot when the
         # source drained cleanly, deterministic replay-from-prompt with
-        # already-delivered text elided otherwise
+        # already-delivered text elided otherwise.  Disaggregated
+        # prefill/decode (ISSUE 14, HELIX_POOL_DISAGG=1) rides the same
+        # SSE-aware path: the handoff's migrated frame IS the clean-
+        # drain resume contract, and every failure rung falls back to
+        # the replay machinery.
         if (
-            midstream_failover_enabled()
+            (midstream_failover_enabled() or disagg_pools_enabled())
             and body.get("stream")
             and request.path in ("/v1/chat/completions", "/v1/completions")
             and model
@@ -5506,6 +5530,24 @@ class ControlPlane:
         template: dict = {}
         role_sent = False
         had_failover = False          # a death was survived mid-request
+        # disaggregated prefill/decode (ISSUE 14): plan ONE handoff —
+        # prefill-pool origin + decode-pool peer (the peer needs a
+        # direct address: the snapshot ships runner-to-runner).  The
+        # plan is consumed by its attempt; ANY failure falls back to
+        # the ordinary pick below, which lands on the decode pool and
+        # re-prefills locally — never worse than colocated serving.
+        disagg_plan = None
+        if disagg_pools_enabled() and request.path in (
+            "/v1/chat/completions", "/v1/completions"
+        ):
+            pre = self.router.pick_runner(model, role=POOL_PREFILL)
+            if pre is not None:
+                dec = self.router.pick_runner(
+                    model, exclude={pre.id}, sched_class=sched_class,
+                    affinity_key=affinity_key,
+                )
+                if dec is not None and dec.meta.get("address"):
+                    disagg_plan = (pre, dec)
 
         async def ensure_client():
             nonlocal client
@@ -5575,6 +5617,23 @@ class ControlPlane:
                 headers = {"Content-Type": "application/json"}
                 if self.runner_token:
                     headers["X-Runner-Token"] = self.runner_token
+            elif disagg_plan is not None:
+                # the one disaggregated handoff attempt: prefill-pool
+                # origin, decode peer named in headers.  Consumed here —
+                # a failed attempt falls through to the ordinary pick.
+                target, peer = disagg_plan
+                disagg_plan = None
+                mode = "disagg"
+                path = request.path
+                data = raw
+                headers = dict(fwd_headers)
+                headers[DISAGG_HEADER] = "prefill"
+                headers[DISAGG_PEER_ID_HEADER] = peer.id
+                headers[DISAGG_PEER_ADDR_HEADER] = peer.meta.get(
+                    "address", ""
+                )
+                if self.runner_token:
+                    headers["X-Runner-Token"] = self.runner_token
             else:
                 target = self.router.pick_runner(
                     model, exclude=tried, sched_class=sched_class,
@@ -5590,7 +5649,7 @@ class ControlPlane:
                 data = raw
                 headers = fwd_headers
             attempt += 1
-            if mode == "origin":
+            if mode in ("origin", "disagg"):
                 tried.add(target.id)
             acct = _DispatchAccount(self.router, target.id)
             t_attempt = time.monotonic()
@@ -5612,7 +5671,7 @@ class ControlPlane:
                 inj = faults.active()
                 fault = (
                     inj.dispatch_fault(target.id)
-                    if inj and mode == "origin" else None
+                    if inj and mode in ("origin", "disagg") else None
                 )
                 if fault is not None:
                     if fault["mode"] == "slow_first_byte":
@@ -5651,6 +5710,16 @@ class ControlPlane:
                             f"resume on {target.id} answered {status}"
                         )
                         died = True
+                        continue
+                    if mode == "disagg":
+                        # the prefill runner shed/refused the handoff
+                        # (429/ship-failed 502/...): fall back to the
+                        # decode pool — it re-prefills locally
+                        last_err = (
+                            f"disagg prefill on {target.id} answered "
+                            f"{status}"
+                        )
+                        self.router.note_pool_fallback()
                         continue
                     if client is None:
                         return web.Response(
@@ -5691,15 +5760,22 @@ class ControlPlane:
                             msg = str(err.get("message", ""))
                             peer = parse_migrated_peer(msg)
                             if peer is not None:
-                                # clean source drain: the snapshot is on
-                                # `peer`; continue the stream there
+                                # clean source drain OR a confirmed
+                                # disagg prefill handoff: the snapshot
+                                # is on `peer`; continue the stream
+                                # there
                                 rid = str(
                                     err.get("request_id", "")
                                 ) or ""
                                 resume = (peer, rid)
                                 acct.release()
                                 attempt_span("migrated")
-                                had_failover = True
+                                if mode == "disagg":
+                                    # the INTENDED handoff, not a
+                                    # survived death
+                                    self.router.note_pool_handoff()
+                                else:
+                                    had_failover = True
                                 break
                             if msg.startswith("shutting_down"):
                                 # drain without migration: replay on a
@@ -5707,6 +5783,16 @@ class ControlPlane:
                                 acct.release()
                                 attempt_span("source_draining")
                                 last_err = msg
+                                died = True
+                                break
+                            if mode == "disagg":
+                                # a handoff attempt may not surface its
+                                # errors to the client — the decode
+                                # pool can still serve this request
+                                acct.release()
+                                attempt_span("disagg_error")
+                                last_err = msg
+                                self.router.note_pool_fallback()
                                 died = True
                                 break
                             # request-level terminal error: forward
@@ -5776,6 +5862,8 @@ class ControlPlane:
                 attempt_span("truncated")
                 last_err = f"runner {target.id} truncated the stream"
                 had_failover = had_failover or role_sent
+                if mode == "disagg":
+                    self.router.note_pool_fallback()
                 died = True
             except _ClientGone:
                 # the CLIENT went away mid-stream: neutral release (the
@@ -5788,6 +5876,8 @@ class ControlPlane:
                 acct.failure()
                 attempt_span(f"failed: {str(e)[:120]}")
                 last_err = str(e)
+                if mode == "disagg":
+                    self.router.note_pool_fallback()
             except (
                 aiohttp.ClientError,
                 asyncio.TimeoutError,
@@ -5800,6 +5890,8 @@ class ControlPlane:
                 attempt_span(f"died: {type(e).__name__}")
                 last_err = f"{type(e).__name__}: {e}"
                 had_failover = had_failover or role_sent
+                if mode == "disagg":
+                    self.router.note_pool_fallback()
             except asyncio.CancelledError:
                 acct.release()
                 attempt_span("cancelled")

@@ -1221,6 +1221,17 @@ class Engine:
         # feed the helix_migrations_* series and the migration bench
         self.num_snapshots_exported = 0
         self.num_snapshots_imported = 0
+        # disaggregated prefill/decode (ISSUE 14): snapshots exported at
+        # prefill completion for a decode-pool peer (a subset of
+        # num_snapshots_exported)
+        self.num_prefill_exports = 0
+        # persistent filestore KV tier (ISSUE 14): the bottom rung of
+        # the residency ladder (HBM -> host RAM -> peer -> filestore).
+        # Wired post-construction (serving.kv_filestore.filestore_for_
+        # engine) like on_admit; None = tier off.  filestore_restored_
+        # pages counts pages adopted FROM it (cross-restart prefix hits).
+        self.kv_filestore = None
+        self.filestore_restored_pages = 0
         # MoE routing assignments dropped to expert-capacity overflow
         # during prefill (those tokens silently rode the residual stream);
         # device scalars accumulate un-fetched and drain lazily so the
@@ -1727,6 +1738,15 @@ class Engine:
         restored = 0
         if use_cache and self.host_pool is not None and hashes:
             restored = self._restore_host_prefix(req, hashes, shared, pages)
+        if use_cache and self.kv_filestore is not None and hashes:
+            # the persistent rung below the host tier (ISSUE 14): the
+            # chain's continuation may survive on the filestore across
+            # restarts — verified blobs restore and re-adopt exactly
+            # like host pages; a corrupt/missing blob truncates the
+            # chain and the remainder prefills (never an error)
+            restored += self._restore_filestore_prefix(
+                req, hashes, len(shared) + restored, pages
+            )
         req.cached_tokens = (len(shared) + restored) * self.cache_cfg.page_size
         self.num_admitted += 1
         if self._budget_left is not None:
@@ -1808,9 +1828,10 @@ class Engine:
         return len(entries)
 
     def _cached_prefix_pages(self, req: Request) -> int:
-        """Resident prefix length in pages across BOTH tiers (device
-        chain, then its host-spilled continuation) — the admission
-        router's signal that a prompt's remainder must attend history."""
+        """Resident prefix length in pages across the tiers this engine
+        can restore from (device chain, its host-spilled continuation,
+        then the persistent filestore rung) — the admission router's
+        signal that a prompt's remainder must attend history."""
         if self.prefix_cache is None:
             return 0
         hashes = self._prompt_hashes(req)
@@ -1818,7 +1839,47 @@ class Engine:
         if self.host_pool is not None:
             while k < len(hashes) and self.host_pool.contains(hashes[k]):
                 k += 1
+        if self.kv_filestore is not None:
+            while k < len(hashes) and self.kv_filestore.contains(
+                hashes[k]
+            ):
+                k += 1
         return k
+
+    def _restore_filestore_prefix(
+        self, req: Request, hashes: list, k: int, pages: list
+    ) -> int:
+        """Promote the filestore-resident continuation of the prefix
+        chain (digests past position ``k``) into this request's freshly
+        allocated device pages — the cross-restart sibling of
+        ``_restore_host_prefix``.  Every blob is checksum-verified by
+        ``KVFilestore.get`` BEFORE anything touches the pool; a missing
+        or corrupt blob truncates the chain (typed counter) and the
+        remainder prefills normally.  Restored pages re-adopt into the
+        device prefix cache so the NEXT sharer hits in HBM."""
+        entries: list = []
+        digests: list = []
+        while k + len(entries) < len(hashes):
+            e = self.kv_filestore.get(hashes[k + len(entries)])
+            if e is None:   # miss or corrupt — chain ends, recompute
+                break
+            entries.append(e)
+            digests.append(hashes[k + len(entries) - 1])
+        if not entries:
+            return 0
+        from helix_tpu.engine.kv_cache import restore_pages
+
+        t0 = time.monotonic()
+        targets = pages[k:k + len(entries)]
+        self.cache = restore_pages(self.cache, targets, entries)
+        self.restore_seconds += time.monotonic() - t0
+        self.filestore_restored_pages += len(entries)
+        if self.prefix_cache is not None:
+            adopted = self.prefix_cache.adopt(digests, targets)
+            if adopted:
+                self.allocator.detach(req.id, adopted)
+                self._shared_pages.setdefault(req.id, []).extend(adopted)
+        return len(entries)
 
     def _prefetch_host_prefix(self, req: Request) -> None:
         """Start host->device uploads for the waiting head's host-resident
@@ -2784,6 +2845,31 @@ class Engine:
             pages=pages, page_checksums=checksums,
         )
 
+    def export_prefill(self, req_id: str) -> Optional[RequestSnapshot]:
+        """Disaggregated prefill/decode handoff (ISSUE 14): snapshot a
+        request as soon as its prefill has completed — the first token
+        is sampled and every prompt page holds written KV — so a
+        decode-pool peer can import it (``import_request``'s
+        validate-checksums-before-mutation path) and continue the
+        generation bit-identically as an ordinary admission wave.
+
+        Ships *before* meaningful decode happens: the caller invokes
+        this the moment output tokens exist.  Refuses requests whose
+        prefill has not finished (nothing to hand off — the peer
+        replaying from the prompt would be cheaper than shipping) and
+        requests whose export would carry no KV.  Export itself mutates
+        nothing; the caller tears the local request down only after the
+        ship is CONFIRMED, so a failed transfer degrades to local
+        decode — never a lost request."""
+        req = self._requests.get(req_id)
+        if req is None or req.finished or not req.output_tokens:
+            return None
+        snap = self.export_request(req_id)
+        if snap is None or not snap.has_kv:
+            return None
+        self.num_prefill_exports += 1
+        return snap
+
     def import_request(self, snap: RequestSnapshot) -> Request:
         """Re-admit a snapshot on this engine (engine thread).
 
@@ -3418,6 +3504,43 @@ class Engine:
             # the request keeps USING them (refcount 1 held on its
             # behalf); release on finish
             self._shared_pages.setdefault(req.id, []).extend(adopted)
+        if self.kv_filestore is not None:
+            # write-through to the persistent rung (ISSUE 14): freshly
+            # prefilled full pages persist so a restarted process (or a
+            # brand-new decode-pool runner on the shared filesystem)
+            # serves this prefix without recomputing it.  Quota'd per
+            # tenant; a rejected write is a counter, never an error.
+            self._store_filestore_pages(req, fresh_hashes, fresh_pages)
+
+    def _store_filestore_pages(
+        self, req: Request, hashes: list, pages: list
+    ) -> None:
+        """Persist freshly prefilled full prefix pages to the filestore
+        tier.  One device gather for the not-yet-stored subset; runs at
+        adoption time (the prefill device call has completed, so the
+        gathered buffers hold the written KV).  The gather returns NEW
+        device buffers (safe against page reuse), and the engine thread
+        only dispatches it — the D2H fetch, encode, and disk write run
+        on the store's background writer (``put_async``), so the tier
+        never stalls the step loop."""
+        from helix_tpu.engine.kv_cache import gather_pages
+
+        want = [
+            (h, p) for h, p in zip(hashes, pages)
+            if not self.kv_filestore.contains(h)
+        ]
+        if not want:
+            return
+        try:
+            arrays = gather_pages(self.cache, [p for _h, p in want])
+            tenant = getattr(req, "tenant", "")
+            for (h, _p), page_arrays in zip(want, arrays):
+                self.kv_filestore.put_async(h, page_arrays, tenant=tenant)
+        except Exception:  # noqa: BLE001 — the tier degrades, never fails serving
+            logging.getLogger(__name__).exception(
+                "KV filestore write-through failed for request %s",
+                req.id,
+            )
 
     def _finish(self, req: Request, reason: FinishReason) -> None:
         req.finished = True

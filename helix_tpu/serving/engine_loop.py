@@ -275,6 +275,12 @@ class EngineLoop:
         # here during graceful shutdown, tests wire a direct stub
         self.exporter = None
         self.migration_failures = 0   # failed exports/ships/imports
+        # disaggregated prefill/decode (ISSUE 14): request ids staged
+        # for export-at-prefill-completion -> callback(kind, wire).
+        # Written by HTTP handler threads (stage/unstage), consumed on
+        # the engine thread (_disagg_tick) — dict ops are GIL-atomic.
+        self._disagg_cb: dict = {}
+        self.disagg_exports = 0       # prefill snapshots handed to a shipper
         engine.on_admit = self._note_admit
         if self._sched_active:
             engine.victim_policy = self.sched.preempt_order
@@ -548,6 +554,89 @@ class EngineLoop:
         if item.on_result is not None:
             item.on_result(None, None)
 
+    def stage_disagg_export(self, request_id: str, on_snapshot) -> None:
+        """Register a disaggregated prefill export (ISSUE 14): the
+        moment ``request_id`` has completed its prefill (first token
+        sampled), the engine thread snapshots it via
+        ``engine.export_prefill`` and fires ``on_snapshot(kind, wire)``
+        exactly once, where kind is:
+
+        - ``"snapshot"`` — wire dict attached; the request KEEPS
+          decoding locally until the caller confirms the ship and
+          aborts it (a failed ship degrades to local serving);
+        - ``"completed"`` — the request finished before the export
+          fired (short generation): serve the buffered stream locally;
+        - ``"local"`` — export unavailable/failed (VL, lockstep, host
+          page lost): the request keeps generating here, colocated;
+        - ``"gone"`` — the request vanished (aborted) before export.
+
+        Call BEFORE ``submit`` so the first token cannot race the
+        staging."""
+        self._disagg_cb[request_id] = on_snapshot
+
+    def unstage_disagg_export(self, request_id: str) -> None:
+        """Withdraw a staged export (handler timed out / chose local)."""
+        self._disagg_cb.pop(request_id, None)
+
+    def _handoff_work(self) -> bool:
+        """True when a staged disagg export is actionable — the gate
+        that forces a reconcile before ``_disagg_tick`` runs (export
+        gathers pages + syncs sampler state, so no step may be in
+        flight).  O(staged), GIL-atomic reads."""
+        if not self._disagg_cb:
+            return False
+        for rid in list(self._disagg_cb):
+            req = self.engine.get_request(rid)
+            if req is None or req.finished or req.output_tokens:
+                return True
+        return False
+
+    def _disagg_tick(self) -> None:
+        """Engine-thread half of the disaggregated handoff: export every
+        staged request whose prefill completed and hand the wire dict to
+        its callback (the HTTP handler ships it OFF this thread — a slow
+        peer must never stall the engine).  Export mutates nothing; the
+        request keeps decoding until the ship is confirmed."""
+        from helix_tpu.serving.migration import snapshot_to_wire
+
+        for rid, cb in list(self._disagg_cb.items()):
+            req = self.engine.get_request(rid)
+            if req is None:
+                self._disagg_cb.pop(rid, None)
+                cb("gone", None)
+                continue
+            if req.finished:
+                self._disagg_cb.pop(rid, None)
+                cb("completed", None)
+                continue
+            if not req.output_tokens:
+                continue   # still queued / prefilling
+            self._disagg_cb.pop(rid, None)
+            export = getattr(self.engine, "export_prefill", None)
+            snap = None
+            if export is not None:
+                try:
+                    snap = export(rid)
+                except Exception:  # noqa: BLE001 — degrade to local serving
+                    log.exception(
+                        "engine '%s' prefill export failed for "
+                        "request_id=%s", self.name, rid,
+                    )
+            if snap is None:
+                cb("local", None)
+                continue
+            try:
+                wire = snapshot_to_wire(snap)
+            except Exception:  # noqa: BLE001 — degrade to local serving
+                log.exception(
+                    "engine '%s' prefill snapshot encode failed for "
+                    "request_id=%s", self.name, rid,
+                )
+                cb("local", None)
+                continue
+            self.disagg_exports += 1
+            cb("snapshot", wire)
+
     def _export_survivors(self) -> int:
         """Drain-deadline migration: snapshot every still-unfinished
         request and ship it to a peer via ``self.exporter`` instead of
@@ -665,7 +754,18 @@ class EngineLoop:
                 "imported": getattr(eng, "num_snapshots_imported", 0),
                 "failures": self.migration_failures,
                 "draining": self.draining,
+                # disaggregated prefill handoffs (ISSUE 14)
+                "prefill_exports": getattr(
+                    eng, "num_prefill_exports", 0
+                ),
+                "disagg_exports": self.disagg_exports,
             },
+            # persistent filestore KV tier (ISSUE 14): None = tier off
+            "filestore": (
+                eng.kv_filestore.stats()
+                if getattr(eng, "kv_filestore", None) is not None
+                else None
+            ),
             # per-tenant SLO observability (ISSUE 7): pooled totals +
             # top-K bounding introspection
             "tenants": self.slo.stats(),
@@ -1356,6 +1456,13 @@ class EngineLoop:
                             )
                         )
             self._memory_pressure_tick()
+            if self._handoff_work():
+                # disaggregated prefill export (ISSUE 14): the export
+                # gathers pages + syncs device sampler state, so the
+                # in-flight pipelined step (if any) reconciles first
+                if not reconcile_or_fail():
+                    continue
+                self._disagg_tick()
             if not self.engine.has_work():
                 if not reconcile_or_fail():
                     continue

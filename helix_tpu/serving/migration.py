@@ -58,6 +58,108 @@ MIGRATION_DRAIN_STATE = "helix_migration_drain_state"
 CP_MIDSTREAM_FAILOVERS = "helix_cp_midstream_failovers_total"
 CP_RUNNER_DRAINING = "helix_cp_runner_draining"
 
+# KV-transfer series (ISSUE 14, lint_metrics contract 10: the
+# ``helix_xfer_*`` family is minted only here).  Shipping is the network
+# rung of the residency ladder, so its outcomes get the same per-outcome
+# accounting the dispatch path has — a slow or flapping peer shows up as
+# a labelled counter, not a mystery drain stall.
+XFER_ATTEMPTS = "helix_xfer_attempts_total"
+XFER_SHIP_SECONDS = "helix_xfer_ship_seconds_total"
+XFER_SHIPPED_BYTES = "helix_xfer_shipped_bytes_total"
+XFER_DEADLINE_EXCEEDED = "helix_xfer_deadline_exceeded_total"
+XFER_PREFILL_HANDOFFS = "helix_xfer_prefill_handoffs_total"
+
+# every way one ship attempt can end (the XFER_ATTEMPTS label values)
+XFER_OUTCOMES = (
+    "ok",          # peer answered 200 — snapshot accepted
+    "unreachable",  # connect error / injected drop
+    "rejected",    # peer answered 4xx (corrupt/incompatible/duplicate)
+    "http_error",  # peer answered 5xx / other status
+    "timeout",     # per-attempt timeout expired
+)
+
+
+class XferStats:
+    """Process-wide KV-transfer accounting (runner side).  Thread
+    contract: shippers increment under the lock from worker threads; the
+    /metrics collector reads snapshots."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.attempts = {o: 0 for o in XFER_OUTCOMES}
+        self.ship_seconds = 0.0
+        self.shipped_bytes = 0
+        self.shipped_pages = 0
+        self.deadline_exceeded = 0
+        self.prefill_handoffs = 0
+
+    def note_attempt(self, outcome: str, seconds: float = 0.0) -> None:
+        with self._lock:
+            if outcome not in self.attempts:
+                outcome = "http_error"
+            self.attempts[outcome] += 1
+            self.ship_seconds += max(0.0, seconds)
+
+    def note_shipped(self, wire: dict, prefill: bool = False) -> None:
+        with self._lock:
+            pages = wire.get("pages") or []
+            self.shipped_pages += len(pages)
+            self.shipped_bytes += sum(
+                len((f or {}).get("b64", ""))
+                for p in pages
+                for f in (p or {}).values()
+                if isinstance(f, dict)
+            )
+            if prefill:
+                self.prefill_handoffs += 1
+
+    def note_deadline(self) -> None:
+        with self._lock:
+            self.deadline_exceeded += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "attempts": dict(self.attempts),
+                "ship_seconds": self.ship_seconds,
+                "shipped_bytes": self.shipped_bytes,
+                "shipped_pages": self.shipped_pages,
+                "deadline_exceeded": self.deadline_exceeded,
+                "prefill_handoffs": self.prefill_handoffs,
+            }
+
+
+# the one process-wide instance every shipper feeds (drain shippers are
+# per-drain, disagg shippers per-request — counters must outlive both)
+XFER_STATS = XferStats()
+
+
+def collect_xfer(c) -> None:
+    """Runner-side KV-transfer series (called from the OpenAI server's
+    scrape-time collector)."""
+    snap = XFER_STATS.snapshot()
+    for outcome, n in sorted(snap["attempts"].items()):
+        c.counter(
+            XFER_ATTEMPTS, n, {"outcome": outcome},
+            help="KV snapshot ship attempts by outcome",
+        )
+    c.counter(
+        XFER_SHIP_SECONDS, snap["ship_seconds"],
+        help="Cumulative wall time spent shipping KV snapshots",
+    )
+    c.counter(
+        XFER_SHIPPED_BYTES, snap["shipped_bytes"],
+        help="Wire bytes of successfully shipped KV snapshots",
+    )
+    c.counter(
+        XFER_DEADLINE_EXCEEDED, snap["deadline_exceeded"],
+        help="Ships abandoned at the total transfer deadline",
+    )
+    c.counter(
+        XFER_PREFILL_HANDOFFS, snap["prefill_handoffs"],
+        help="Disaggregated prefill snapshots shipped to a decode peer",
+    )
+
 # error-message prefix for a request that was exported instead of shed
 # (the engine-loop/openai error-mapping contract, like QUEUE_FULL); the
 # control plane's mid-stream failover parses the peer out of the message
@@ -145,6 +247,73 @@ def midstream_failover_enabled() -> bool:
     """HELIX_MIDSTREAM_FAILOVER: opt-in for the control plane's
     SSE-parsing failover path (resume/replay past the first byte)."""
     return os.environ.get("HELIX_MIDSTREAM_FAILOVER", "") not in ("", "0")
+
+
+def disagg_pools_enabled() -> bool:
+    """HELIX_POOL_DISAGG: opt-in for disaggregated prefill/decode —
+    the control plane hands streaming prompts to a prefill-pool runner
+    that computes the prompt, ships the KV snapshot to a decode-pool
+    peer, and the stream resumes there.  Off = colocated serving
+    (every runner prefills its own traffic), the seed behaviour."""
+    return os.environ.get("HELIX_POOL_DISAGG", "") not in ("", "0")
+
+
+# disaggregation handoff headers (ISSUE 14): the control plane marks a
+# dispatch as prefill-only and names the decode peer the snapshot must
+# ship to.  Runner-token gated on the runner side like /v1/migrate/* —
+# handoff is cluster-internal traffic.
+DISAGG_HEADER = "X-Helix-Disagg"
+DISAGG_PEER_ID_HEADER = "X-Helix-Disagg-Peer"
+DISAGG_PEER_ADDR_HEADER = "X-Helix-Disagg-Peer-Addr"
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except (TypeError, ValueError):
+        return default
+
+
+class XferConfig:
+    """KV-transfer retry/backoff discipline (ISSUE 14 satellite): every
+    ship attempt gets a per-attempt timeout, attempts back off with a
+    capped exponential, and the WHOLE transfer has a hard deadline — a
+    slow or black-holed peer can wedge neither a drain nor a prefill
+    handoff (the hard fallback — local recompute — is always reachable
+    in bounded time)."""
+
+    def __init__(self, attempt_timeout: Optional[float] = None,
+                 max_attempts: Optional[int] = None,
+                 backoff_base: Optional[float] = None,
+                 backoff_cap: Optional[float] = None,
+                 deadline: Optional[float] = None):
+        self.attempt_timeout = (
+            attempt_timeout if attempt_timeout is not None
+            else _env_float("HELIX_XFER_ATTEMPT_TIMEOUT", 10.0)
+        )
+        self.max_attempts = (
+            max_attempts if max_attempts is not None
+            else max(1, _env_int("HELIX_XFER_MAX_ATTEMPTS", 3))
+        )
+        self.backoff_base = (
+            backoff_base if backoff_base is not None
+            else _env_float("HELIX_XFER_BACKOFF_BASE", 0.1)
+        )
+        self.backoff_cap = (
+            backoff_cap if backoff_cap is not None
+            else _env_float("HELIX_XFER_BACKOFF_CAP", 2.0)
+        )
+        self.deadline = (
+            deadline if deadline is not None
+            else _env_float("HELIX_XFER_DEADLINE", migration_timeout())
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -399,25 +568,65 @@ class ImportedStreams:
 # ---------------------------------------------------------------------------
 
 
+def _flip_wire_page(wire: dict, page_idx: int) -> dict:
+    """A shallow copy of ``wire`` with one byte flipped inside page
+    ``page_idx``'s k buffer (chaos ``transfer`` corrupt mode).  The
+    receiver's pre-mutation checksum validation MUST reject the result
+    — detection-then-recompute is the contract the chaos lane proves."""
+    pages = list(wire.get("pages") or [])
+    if not pages:
+        return wire
+    i = max(0, min(page_idx, len(pages) - 1))
+    page = dict(pages[i])
+    k = dict(page.get("k") or {})
+    raw = bytearray(base64.b64decode(k.get("b64", "") or "AA=="))
+    raw[0] ^= 0xFF
+    k["b64"] = base64.b64encode(bytes(raw)).decode("ascii")
+    page["k"] = k
+    pages[i] = page
+    return {**wire, "pages": pages}
+
+
 class PeerShipper:
-    """Ships wire snapshots to a peer runner during drain.
+    """Ships wire snapshots to a peer runner (the drain ladder AND the
+    disaggregated prefill handoff).
 
     Targets are fetched once per drain from the control plane's
     migration-targets endpoint (routable, non-draining runners serving
-    an overlapping model set) — or injected directly for tests.  The
-    call contract matches ``EngineLoop.exporter``: given a wire dict,
-    return the peer runner id that accepted it, raise on failure."""
+    an overlapping model set) — or injected directly (tests, and the
+    disagg handoff where the control plane names the peer).  The call
+    contract matches ``EngineLoop.exporter``: given a wire dict, return
+    the peer runner id that accepted it, raise on failure.
+
+    Robustness discipline (ISSUE 14 satellite): every attempt has a
+    per-attempt timeout, rounds over the target set back off with a
+    capped exponential, and the whole ship has a hard deadline — a slow
+    peer cannot wedge a drain, and per-outcome counters
+    (``helix_xfer_attempts_total``) make a flapping link visible.
+    ``post``/``clock``/``sleep`` are injectable for deterministic
+    tests."""
 
     def __init__(self, control_plane_url: str = "", runner_id: str = "",
                  runner_token: str = "", targets: Optional[list] = None,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 config: Optional[XferConfig] = None,
+                 post=None, clock=time.monotonic, sleep=time.sleep,
+                 stats: Optional[XferStats] = None,
+                 prefill: bool = False):
         self.control_plane_url = control_plane_url.rstrip("/")
         self.runner_id = runner_id
         self.runner_token = runner_token
         self._targets = targets
-        self.timeout = timeout if timeout is not None else (
-            migration_timeout()
+        self.config = config if config is not None else XferConfig(
+            attempt_timeout=timeout
         )
+        # legacy knob: an explicit timeout= is the per-attempt timeout
+        self.timeout = self.config.attempt_timeout
+        self._post = post
+        self._clock = clock
+        self._sleep = sleep
+        self.stats = stats if stats is not None else XFER_STATS
+        self.prefill = prefill   # counts helix_xfer_prefill_handoffs
 
     def _headers(self) -> dict:
         return (
@@ -442,25 +651,96 @@ class PeerShipper:
         ]
         return self._targets
 
-    def __call__(self, wire: dict) -> str:
+    def _post_fn(self):
+        if self._post is not None:
+            return self._post
         import requests
 
+        return requests.post
+
+    def __call__(self, wire: dict) -> str:
+        from helix_tpu.testing import faults
+
+        post = self._post_fn()
         model = wire.get("model", "")
+        cfg = self.config
+        deadline = self._clock() + cfg.deadline
         last_err = "no migration target"
-        for t in self.targets():
-            if model and model not in (t.get("models") or [model]):
-                continue
-            try:
-                r = requests.post(
-                    f"{t['address'].rstrip('/')}/v1/migrate/import",
-                    json=wire, headers=self._headers(),
-                    timeout=self.timeout,
-                )
+        candidates = [
+            t for t in self.targets()
+            if not model or model in (t.get("models") or [model])
+        ]
+        if not candidates:
+            raise RuntimeError(f"snapshot ship failed: {last_err}")
+        for attempt in range(cfg.max_attempts):
+            for t in candidates:
+                peer_id = t.get("id", t.get("address", ""))
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    self.stats.note_deadline()
+                    raise RuntimeError(
+                        f"snapshot ship failed: transfer deadline "
+                        f"({cfg.deadline:.1f}s) exceeded; last error: "
+                        f"{last_err}"
+                    )
+                body = wire
+                inj = faults.active()
+                fault = inj.transfer_fault(peer_id) if inj else None
+                if fault is not None:
+                    if fault["mode"] == "slow":
+                        self._sleep(fault["delay"])
+                    elif fault["mode"] == "corrupt":
+                        body = _flip_wire_page(wire, fault["page"])
+                    elif fault["mode"] == "partial":
+                        pages = list(wire.get("pages") or [])
+                        body = {**wire, "pages": pages[: len(pages) // 2]}
+                    else:   # drop: the peer is unreachable
+                        self.stats.note_attempt("unreachable")
+                        last_err = f"{peer_id}: injected transfer drop"
+                        continue
+                t0 = self._clock()
+                try:
+                    r = post(
+                        f"{t['address'].rstrip('/')}/v1/migrate/import",
+                        json=body, headers=self._headers(),
+                        timeout=min(cfg.attempt_timeout, remaining),
+                    )
+                except Exception as e:  # noqa: BLE001 — try the next peer
+                    dt = self._clock() - t0
+                    outcome = (
+                        "timeout"
+                        if "timeout" in type(e).__name__.lower()
+                        or "timed out" in str(e).lower()
+                        else "unreachable"
+                    )
+                    self.stats.note_attempt(outcome, dt)
+                    last_err = f"{peer_id}: {e}"
+                    continue
+                dt = self._clock() - t0
                 if r.status_code == 200:
-                    return t.get("id", t["address"])
-                last_err = f"{t.get('id')}: HTTP {r.status_code}"
-            except Exception as e:  # noqa: BLE001 — try the next peer
-                last_err = f"{t.get('id')}: {e}"
+                    self.stats.note_attempt("ok", dt)
+                    self.stats.note_shipped(body, prefill=self.prefill)
+                    return peer_id
+                outcome = (
+                    "rejected" if 400 <= r.status_code < 500
+                    else "http_error"
+                )
+                self.stats.note_attempt(outcome, dt)
+                last_err = f"{peer_id}: HTTP {r.status_code}"
+            if attempt + 1 >= cfg.max_attempts:
+                break
+            backoff = min(
+                cfg.backoff_cap, cfg.backoff_base * (2 ** attempt)
+            )
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                self.stats.note_deadline()
+                raise RuntimeError(
+                    f"snapshot ship failed: transfer deadline "
+                    f"({cfg.deadline:.1f}s) exceeded; last error: "
+                    f"{last_err}"
+                )
+            self._sleep(min(backoff, remaining))
         raise RuntimeError(f"snapshot ship failed: {last_err}")
 
 

@@ -179,6 +179,12 @@ class LockstepLeader:
     # degrade to the ordinary shed (and imports fail typed).
     export_request = None
     import_request = None
+    # the disaggregated prefill handoff (ISSUE 14) is the same
+    # leader-local state move — pinned off for the same reason
+    export_prefill = None
+    # the filestore KV tier reads local disk at admission, which would
+    # desync follower replay (cached_tokens diverge) — never armed here
+    kv_filestore = None
 
     # -- passthrough --------------------------------------------------------
     def __getattr__(self, name):

@@ -34,11 +34,19 @@ from helix_tpu.serving.engine_loop import (
     QUEUE_FULL,
     SHUTTING_DOWN,
 )
+from helix_tpu.serving.kv_filestore import collect_filestore_kv
 from helix_tpu.serving.migration import (
+    DISAGG_HEADER,
+    DISAGG_PEER_ADDR_HEADER,
+    DISAGG_PEER_ID_HEADER,
     MIGRATED,
     ImportedStream,
     ImportedStreams,
+    XferConfig,
     collect_runner_migration,
+    collect_xfer,
+    make_chunk,
+    migrated_error,
     migration_timeout,
     wire_to_snapshot,
 )
@@ -255,6 +263,10 @@ class OpenAIServer:
             "helix_uptime_seconds", time.monotonic() - self.started,
             help="Runner process uptime",
         )
+        # KV-transfer outcomes (ISSUE 14): process-wide (drain shippers
+        # and disagg handoffs share one ledger), minted ONLY by
+        # serving/migration.py (lint contract 10)
+        collect_xfer(c)
         for m in self.registry.list():
             if m.loop is None:
                 continue
@@ -358,6 +370,9 @@ class OpenAIServer:
             # cross-runner migration series (ISSUE 11): minted ONLY by
             # serving/migration.py (lint contract 6)
             collect_runner_migration(c, m.loop, lbl)
+            # persistent filestore KV tier (ISSUE 14): minted ONLY by
+            # serving/kv_filestore.py (lint contract 10)
+            collect_filestore_kv(c, m.loop, lbl)
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 st = pc.stats
@@ -1194,6 +1209,282 @@ class OpenAIServer:
                 served.loop.abort(req.id)
 
     # ------------------------------------------------------------------
+    async def _disagg_prefill(self, request, served, model, prompt_ids,
+                              sampling, kind, http_id, created,
+                              trace_id, tenant, sched_class):
+        """Disaggregated prefill/decode handoff (ISSUE 14), runner side.
+
+        Submits the request like an ordinary stream, but stages an
+        export-at-prefill-completion with the engine loop: the moment
+        the first token exists, the engine thread snapshots the request
+        (pages + device-evolved sampler state) and hands the wire dict
+        back HERE, where the ship to the control-plane-named decode
+        peer runs off the engine thread with the full
+        ``HELIX_XFER_*`` retry/backoff/deadline discipline.
+
+        Degrade-to-local by design — every rung falls back one step and
+        none can produce a stuck or wrong-token stream:
+
+        - ship CONFIRMED: the local request aborts and the response is
+          a single ``migrated ... peer=<id>`` SSE frame the control
+          plane resumes on the peer (the PR 11 clean-drain contract,
+          exactly-once via prior-token catch-up);
+        - ship FAILED (peer unreachable / corrupt-rejected / slow past
+          the deadline): the local request never stopped decoding —
+          the stream serves from HERE, colocated, bit-identical;
+        - export unavailable or prefill deadline exceeded: same local
+          path;
+        - the request finished before the export fired (short
+          generation): the buffered events replay as a normal stream.
+        """
+        import os
+
+        from helix_tpu.serving.migration import PeerShipper
+
+        peer_id = request.headers.get(DISAGG_PEER_ID_HEADER, "")
+        peer_addr = request.headers.get(DISAGG_PEER_ADDR_HEADER, "")
+        loop = asyncio.get_running_loop()
+        q: asyncio.Queue = asyncio.Queue()
+
+        def on_event(ev):
+            loop.call_soon_threadsafe(q.put_nowait, ("ev", ev))
+
+        def on_export(kind2, wire):
+            loop.call_soon_threadsafe(
+                q.put_nowait, ("export", kind2, wire)
+            )
+
+        req = Request(
+            id=f"req-{uuid.uuid4().hex[:12]}",
+            prompt_tokens=list(prompt_ids),
+            sampling=sampling,
+            stop_token_ids=tuple(served.tokenizer.eos_ids),
+            trace_id=trace_id,
+            tenant=tenant,
+            sched_class=sched_class,
+        )
+        if peer_addr:
+            served.loop.stage_disagg_export(req.id, on_export)
+        served.loop.submit(req, on_event)
+        xfer = XferConfig()
+        deadline = loop.time() + xfer.deadline
+        last_event = loop.time()
+        buffered: list = []
+        outcome = ("local", None) if not peer_addr else None
+        try:
+            while outcome is None:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    # prefill did not complete inside the transfer
+                    # deadline (engine under load): serve locally —
+                    # never a stuck handoff
+                    served.loop.unstage_disagg_export(req.id)
+                    outcome = ("local", None)
+                    break
+                try:
+                    item = await asyncio.wait_for(
+                        q.get(),
+                        timeout=min(remaining, self.inter_token_timeout),
+                    )
+                except asyncio.TimeoutError:
+                    if loop.time() - last_event < self.inter_token_timeout:
+                        # the TRANSFER deadline cut this wait short, not
+                        # a wedged engine: a slow prefill that colocated
+                        # serving would have tolerated must not become
+                        # an error just because disagg was attempted —
+                        # withdraw the handoff and serve locally (the
+                        # colocated tail keeps its own inter-token
+                        # discipline)
+                        served.loop.unstage_disagg_export(req.id)
+                        outcome = ("local", None)
+                        break
+                    served.loop.unstage_disagg_export(req.id)
+                    served.loop.abort(req.id)
+                    raise EngineRequestError(
+                        f"inter_token_timeout: no engine event for "
+                        f"{self.inter_token_timeout:.0f}s; request "
+                        f"{req.id} aborted", request_id=req.id,
+                    ) from None
+                last_event = loop.time()
+                if item[0] == "export":
+                    _tag, k2, wire = item
+                    if k2 == "snapshot":
+                        outcome = ("snapshot", wire)
+                    elif k2 == "completed":
+                        outcome = ("completed", None)
+                    elif k2 == "gone":
+                        return _error(
+                            502,
+                            f"request {req.id} vanished before the "
+                            "prefill handoff",
+                            "overloaded_error", code="disagg_failed",
+                            trace_id=trace_id,
+                        )
+                    else:   # "local": export unavailable — serve here
+                        outcome = ("local", None)
+                    continue
+                ev = item[1]
+                if ev.error:
+                    served.loop.unstage_disagg_export(req.id)
+                    raise EngineRequestError(
+                        ev.error, request_id=req.id
+                    )
+                buffered.append(ev)
+                if ev.finished:
+                    served.loop.unstage_disagg_export(req.id)
+                    outcome = ("completed", None)
+        except EngineRequestError as e:
+            return _engine_error_response(e, trace_id=trace_id)
+        except asyncio.CancelledError:
+            served.loop.unstage_disagg_export(req.id)
+            served.loop.abort(req.id)
+            raise
+
+        if outcome[0] == "snapshot":
+            # the ship spends only what is LEFT of the one transfer
+            # deadline (HELIX_XFER_DEADLINE covers prefill wait + all
+            # ship attempts + backoffs, as config_reference documents);
+            # an exhausted budget fails the first remaining-time check
+            # inside the shipper and degrades to local serving
+            shipper = PeerShipper(
+                runner_token=os.environ.get("HELIX_RUNNER_TOKEN", ""),
+                targets=[{
+                    "id": peer_id or peer_addr,
+                    "address": peer_addr,
+                    "models": [model],
+                }],
+                config=XferConfig(
+                    attempt_timeout=xfer.attempt_timeout,
+                    max_attempts=xfer.max_attempts,
+                    backoff_base=xfer.backoff_base,
+                    backoff_cap=xfer.backoff_cap,
+                    deadline=max(0.0, deadline - loop.time()),
+                ),
+                prefill=True,
+            )
+            peer = None
+            ship_err = ""
+            try:
+                peer = await loop.run_in_executor(
+                    None, shipper, outcome[1]
+                )
+            except Exception as e:  # noqa: BLE001 — degrade to local serving
+                ship_err = str(e)
+            if peer is not None:
+                # handoff confirmed: tear the local request down and
+                # hand the stream to the control plane's resume path
+                served.loop.abort(req.id)
+                resp = web.StreamResponse(
+                    headers={
+                        "Content-Type": "text/event-stream",
+                        "Cache-Control": "no-cache",
+                        TRACE_HEADER: trace_id,
+                    }
+                )
+                await resp.prepare(request)
+                err: dict = {
+                    "message": migrated_error(req.id, peer),
+                    "request_id": req.id,
+                }
+                if trace_id:
+                    err["trace_id"] = trace_id
+                await resp.write(
+                    f"data: {json.dumps({'error': err})}\n\n".encode()
+                )
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
+            # ship failed: the local request never stopped decoding —
+            # degrade to colocated serving (strictly never worse than
+            # not having attempted the handoff)
+            import logging as _logging
+
+            _logging.getLogger(__name__).warning(
+                "disagg ship for request %s to %s failed (%s): "
+                "serving locally", req.id, peer_id or peer_addr,
+                ship_err[:200],
+            )
+
+        # -- colocated tail: stream buffered + live events ----------------
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                TRACE_HEADER: trace_id,
+            }
+        )
+        await resp.prepare(request)
+        detok = IncrementalDetokenizer(served.tokenizer)
+        template = {"id": http_id, "model": model, "created": created}
+        emitted_len = 0
+        first = True
+        idx = 0
+        finished = False
+        try:
+            while not finished:
+                if idx < len(buffered):
+                    ev = buffered[idx]
+                    idx += 1
+                elif outcome[0] == "completed":
+                    break   # defensive: finish event should be last
+                else:
+                    try:
+                        item = await asyncio.wait_for(
+                            q.get(), timeout=self.inter_token_timeout
+                        )
+                    except asyncio.TimeoutError:
+                        served.loop.abort(req.id)
+                        await resp.write(
+                            f"data: {json.dumps(_sse_error_frame(EngineRequestError('inter_token_timeout on disagg-local stream', req.id), trace_id))}\n\n"
+                            .encode()
+                        )
+                        break
+                    if item[0] == "export":
+                        continue   # stale sentinel: we already chose local
+                    ev = item[1]
+                if ev.error:
+                    await resp.write(
+                        f"data: {json.dumps(_sse_error_frame(EngineRequestError(ev.error, req.id), trace_id))}\n\n"
+                        .encode()
+                    )
+                    break
+                is_eos = ev.token_id in served.tokenizer.eos_ids
+                delta = "" if is_eos else detok.push(ev.token_id)
+                hit_stop = None
+                for s in sampling.stop:
+                    j = detok._emitted.find(
+                        s, max(0, emitted_len - len(s))
+                    )
+                    if j >= 0:
+                        hit_stop = j
+                        break
+                if hit_stop is not None:
+                    keep = detok._emitted[:hit_stop]
+                    served.loop.abort(req.id)
+                    await resp.write(
+                        f"data: {json.dumps(make_chunk(template, kind, keep[emitted_len:], 'stop', first=first))}\n\n"
+                        .encode()
+                    )
+                    finished = True
+                    break
+                emitted_len = len(detok._emitted)
+                fr = ev.finish_reason if ev.finished else None
+                if delta or fr or first:
+                    await resp.write(
+                        f"data: {json.dumps(make_chunk(template, kind, delta, fr, first=first))}\n\n"
+                        .encode()
+                    )
+                    first = False
+                if ev.finished:
+                    finished = True
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
+        finally:
+            if not req.finished:
+                served.loop.abort(req.id)
+        return resp
+
+    # ------------------------------------------------------------------
     async def chat_completions(self, request):
         try:
             body = await request.json()
@@ -1258,6 +1549,24 @@ class OpenAIServer:
             return shed
         rid = f"chatcmpl-{uuid.uuid4().hex[:16]}"
         created = _now()
+
+        # disaggregated prefill handoff (ISSUE 14): the control plane
+        # marked this dispatch prefill-only and named a decode peer.
+        # VL requests (device-resident image state) and non-stream
+        # bodies ignore the header and serve colocated — the control
+        # plane handles an ordinary stream transparently.
+        if (
+            request.headers.get(DISAGG_HEADER)
+            and body.get("stream")
+            and extra is None
+            and self._require_runner_token(request) is None
+            and hasattr(served.loop, "stage_disagg_export")
+        ):
+            return await self._disagg_prefill(
+                request, served, model, prompt_ids, sampling,
+                kind="chat", http_id=rid, created=created,
+                trace_id=tid, tenant=tenant, sched_class=sclass,
+            )
 
         if body.get("stream"):
             resp = web.StreamResponse(
@@ -1410,6 +1719,19 @@ class OpenAIServer:
             return shed
         rid = f"cmpl-{uuid.uuid4().hex[:16]}"
         created = _now()
+
+        # disaggregated prefill handoff (ISSUE 14) — see chat_completions
+        if (
+            request.headers.get(DISAGG_HEADER)
+            and body.get("stream")
+            and self._require_runner_token(request) is None
+            and hasattr(served.loop, "stage_disagg_export")
+        ):
+            return await self._disagg_prefill(
+                request, served, model, prompt_ids, sampling,
+                kind="completions", http_id=rid, created=created,
+                trace_id=tid, tenant=tenant, sched_class=sclass,
+            )
 
         if body.get("stream"):
             resp = web.StreamResponse(

@@ -25,6 +25,10 @@ Rule shapes (dicts, JSON-friendly for the env var)::
     {"point": "dispatch", "runner": "r2", "mode": "slow_first_byte",
      "delay": 0.5}
     {"point": "stream", "runner": "r1", "after_chunks": 2, "times": 1}
+    {"point": "transfer", "peer": "r2", "mode": "drop", "times": 1}
+    {"point": "transfer", "peer": "*", "mode": "corrupt", "page": 3}
+    {"point": "transfer", "mode": "slow", "delay": 0.3, "p": 0.5}
+    {"point": "transfer", "mode": "partial", "times": 1}
     {"point": "heartbeat", "runner": "r1"}          # drop heartbeats
     {"point": "saturation", "runner": "r1",
      "set": {"kv_occupancy": 0.99}}                 # fake saturation
@@ -54,6 +58,16 @@ DISPATCH_MODES = ("connect_error", "http_500", "slow_first_byte")
 # link, corrupt flips a byte so the checksum path must catch it, and
 # alloc_fail models host-RAM pressure rejecting a spill
 HOST_POOL_MODES = ("slow", "corrupt", "alloc_fail")
+
+# KV-transfer path (ISSUE 14): faults on the snapshot ship between a
+# prefill-pool runner (or a draining node) and its peer — drop models an
+# unreachable peer, slow a saturated inter-node link, corrupt flips a
+# byte inside ONE page's buffer (keyed by page index; the receiver's
+# pre-mutation checksum validation MUST reject it), and partial
+# truncates the shipped page list (the receiver's coverage check must
+# reject it).  Every mode must degrade to local recompute, never to a
+# stuck or wrong-KV request — that ladder is what the chaos lane proves.
+TRANSFER_MODES = ("drop", "slow", "corrupt", "partial")
 
 
 class FaultInjected(RuntimeError):
@@ -208,6 +222,33 @@ class FaultInjector:
                 return {
                     "mode": rule.get("mode", "slow"),
                     "delay": float(rule.get("delay", 0.05)),
+                }
+        return None
+
+    def transfer_fault(self, peer_id: str) -> Optional[dict]:
+        """Return the fault to apply to ONE KV-snapshot ship attempt to
+        ``peer_id``, or None (ISSUE 14 disaggregated prefill/decode).
+
+        The shipper (``migration.PeerShipper``) turns ``drop`` into a
+        connection error without contacting the peer, ``slow`` into a
+        ``delay``-second sleep before the POST, ``corrupt`` into one
+        flipped byte in page ``page``'s shipped buffer (detected by the
+        importer's checksum validation — detection-then-recompute is the
+        contract under test), and ``partial`` into a truncated page list
+        (rejected by the importer's coverage check).  Rules match by
+        ``peer`` ("*" = any)."""
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "transfer":
+                    continue
+                if rule.get("peer", "*") not in ("*", peer_id):
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                return {
+                    "mode": rule.get("mode", "drop"),
+                    "delay": float(rule.get("delay", 0.05)),
+                    "page": int(rule.get("page", 0)),
                 }
         return None
 

@@ -600,13 +600,282 @@ def run_scale(seconds: float = 10.0, seed: int = 42,
     }
 
 
+def run_disagg(seconds: float = 10.0, seed: int = 42) -> dict:
+    """ISSUE 14 scenario: disaggregated prefill/decode under injected
+    transfer faults.
+
+    A prefill-pool loop takes every prompt with a staged
+    export-at-prefill-completion; a shipping worker (the HTTP handler's
+    stand-in) ships each snapshot to the decode-pool loop through a
+    REAL ``PeerShipper`` — so the armed ``transfer`` fault rules
+    (drop / corrupt / slow / partial) hit the exact production retry/
+    backoff/checksum path.  A confirmed ship aborts the local request
+    and the decode loop continues it; a failed ship degrades to local
+    serving on the prefill loop (the bottom rung of the ladder).
+
+    Exit contract: **zero stuck requests**, **zero wrong tokens** —
+    every request's committed stream (snapshot prior + decode-pool
+    continuation for handoffs, the local stream otherwise) is
+    BIT-IDENTICAL to an uninterrupted colocated reference — and the
+    fault mix actually exercised ≥1 handoff AND ≥1 fallback."""
+    import queue as _queue
+    import threading
+
+    import jax
+
+    from helix_tpu.engine.engine import (
+        Engine,
+        EngineConfig,
+        Request,
+        SnapshotError,
+    )
+    from helix_tpu.testing import faults
+    from helix_tpu.engine.sampling import SamplingParams
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.migration import (
+        PeerShipper,
+        XferConfig,
+        wire_to_snapshot,
+    )
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def build_engine():
+        return Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=4, page_size=4, num_pages=256,
+                max_pages_per_seq=64, max_prefill_len=64,
+                attn_backend="reference", eos_token_ids=tok.eos_ids,
+            ),
+        )
+
+    faults.arm(
+        seed=seed,
+        rules=[
+            {"point": "transfer", "peer": "decode", "mode": "drop",
+             "p": 0.2},
+            {"point": "transfer", "peer": "decode", "mode": "corrupt",
+             "p": 0.15, "page": 1},
+            {"point": "transfer", "peer": "decode", "mode": "partial",
+             "p": 0.1},
+            {"point": "transfer", "peer": "decode", "mode": "slow",
+             "p": 0.2, "delay": 0.02},
+        ],
+    )
+
+    rng = random.Random(seed)
+    # per-request committed streams: local (prefill-pool) events and
+    # remote (decode-pool) events are kept APART — a handoff commits
+    # snapshot-prior + remote, a fallback commits local (exactly the
+    # HTTP handler's exactly-once discipline)
+    local: dict[str, list] = {}
+    remote: dict[str, list] = {}
+    prior: dict[str, list] = {}      # rid -> snapshot prior output tokens
+    local_done: dict[str, str] = {}
+    remote_done: dict[str, str] = {}
+    handed: set = set()
+    prompts: dict[str, tuple] = {}
+    fallbacks = [0]
+
+    def on_local(rid):
+        def on_event(ev):
+            if ev.token_id >= 0:
+                local[rid].append(ev.token_id)
+            if ev.finished:
+                local_done[rid] = (
+                    "error:" + ev.error.split(":")[0] if ev.error
+                    else (ev.finish_reason or "stop")
+                )
+        return on_event
+
+    def on_remote(rid):
+        def on_event(ev):
+            if ev.token_id >= 0:
+                remote[rid].append(ev.token_id)
+            if ev.finished:
+                remote_done[rid] = (
+                    "error:" + ev.error.split(":")[0] if ev.error
+                    else (ev.finish_reason or "stop")
+                )
+        return on_event
+
+    prefill = EngineLoop(build_engine(), "prefill-pool").start()
+    decode = EngineLoop(build_engine(), "decode-pool").start()
+
+    class _Resp:
+        def __init__(self, status_code):
+            self.status_code = status_code
+
+    def fake_post(url, json=None, headers=None, timeout=None):
+        """The decode runner's /v1/migrate/import, in-process: decode +
+        engine-thread validation with the real pre-mutation checksum
+        path, answering the typed statuses the HTTP surface would."""
+        try:
+            snap = wire_to_snapshot(json)
+        except SnapshotError:
+            return _Resp(422)
+        res: list = []
+        decode.submit_import(
+            snap, on_remote(snap.request_id),
+            on_result=lambda e, c: res.append((e, c)),
+        )
+        deadline = time.monotonic() + 30.0
+        while not res and time.monotonic() < deadline:
+            time.sleep(0.002)
+        if not res:
+            return _Resp(504)
+        err, code = res[0]
+        if err is None:
+            return _Resp(200)
+        return _Resp(503 if code == "shutting_down" else 422)
+
+    ship_q: "_queue.Queue" = _queue.Queue()
+    stop_shipping = threading.Event()
+
+    def shipping_worker():
+        xfer = XferConfig(
+            attempt_timeout=5.0, max_attempts=2,
+            backoff_base=0.01, backoff_cap=0.05, deadline=10.0,
+        )
+        while not stop_shipping.is_set():
+            try:
+                rid, wire = ship_q.get(timeout=0.05)
+            except _queue.Empty:
+                continue
+            shipper = PeerShipper(
+                targets=[{"id": "decode", "address": "http://decode"}],
+                config=xfer, post=fake_post, prefill=True,
+            )
+            try:
+                try:
+                    shipper(wire)
+                except Exception:  # noqa: BLE001 — the ladder: serve locally
+                    fallbacks[0] += 1
+                    continue
+                prior[rid] = [
+                    int(t) for t in wire.get("output_tokens", [])
+                ]
+                handed.add(rid)
+                prefill.abort(rid)
+            finally:
+                # task_done AFTER the outcome is recorded: settled()
+                # keys off unfinished_tasks, so an in-flight ship (the
+                # worker popped it but is still retrying) still counts
+                # as pending
+                ship_q.task_done()
+
+    shipper_t = threading.Thread(target=shipping_worker, daemon=True)
+    shipper_t.start()
+
+    def on_export_for(rid):
+        def cb(kind, wire):
+            if kind == "snapshot":
+                ship_q.put((rid, wire))
+            # completed/local/gone: the stream stays on the prefill loop
+        return cb
+
+    t0 = time.monotonic()
+    n = 0
+    try:
+        while time.monotonic() - t0 < seconds:
+            n += 1
+            rid = f"disagg-{n}"
+            prompt = [rng.randrange(4, 260)
+                      for _ in range(rng.randrange(8, 28))]
+            max_toks = rng.randrange(30, 90)
+            prompts[rid] = (prompt, max_toks)
+            local[rid] = []
+            remote[rid] = []
+            prefill.stage_disagg_export(rid, on_export_for(rid))
+            prefill.submit(
+                Request(
+                    id=rid, prompt_tokens=prompt,
+                    sampling=SamplingParams(
+                        temperature=0.0, max_tokens=max_toks,
+                    ),
+                    stop_token_ids=tok.eos_ids,
+                ),
+                on_local(rid),
+            )
+            time.sleep(rng.uniform(0.01, 0.05))
+
+        def settled(rid):
+            if rid in handed:
+                return rid in remote_done
+            # not handed off (yet): local finish settles it once every
+            # queued AND in-flight ship has resolved (unfinished_tasks
+            # covers a popped-but-still-retrying ship that could yet
+            # flip this request to handed)
+            return rid in local_done and ship_q.unfinished_tasks == 0
+
+        deadline = time.monotonic() + 90.0
+        while time.monotonic() < deadline and not all(
+            settled(r) for r in prompts
+        ):
+            time.sleep(0.1)
+    finally:
+        stop_shipping.set()
+        prefill.stop(join=False)
+        decode.stop(join=False)
+        faults.disarm()
+
+    stuck = sorted(r for r in prompts if not settled(r))
+    ref_engine = build_engine()
+    mismatches = []
+    for rid in sorted(prompts):
+        if rid in stuck:
+            continue
+        if rid in handed:
+            committed = prior.get(rid, []) + remote[rid]
+            outcome = remote_done.get(rid, "")
+        else:
+            committed = local[rid]
+            outcome = local_done.get(rid, "")
+        if outcome.startswith("error"):
+            mismatches.append((rid, "errored: " + outcome))
+            continue
+        prompt, max_toks = prompts[rid]
+        ref = Request(
+            id=f"ref-{rid}", prompt_tokens=list(prompt),
+            sampling=SamplingParams(temperature=0.0, max_tokens=max_toks),
+            stop_token_ids=tok.eos_ids,
+        )
+        ref_engine.add_request(ref)
+        while not ref.finished:
+            ref_engine.step()
+        if committed != ref.output_tokens:
+            mismatches.append((rid, "diverged"))
+    counts: dict[str, int] = {
+        "handoff": len(handed),
+        "local": len(prompts) - len(handed),
+    }
+    return {
+        "submitted": n,
+        "handoffs": len(handed),
+        "fallbacks": fallbacks[0],
+        "migrated": len(handed),
+        "stuck": stuck,
+        "mismatches": mismatches,
+        "outcomes": counts,
+        "healthy_after": not stuck,
+        "stats": decode.stats(),
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--step-fault-p", type=float, default=0.02)
     ap.add_argument(
-        "--scenario", choices=("faults", "memory", "crash", "scale"),
+        "--scenario",
+        choices=("faults", "memory", "crash", "scale", "disagg"),
         default="faults",
         help="faults: injected step/dispatch faults (ISSUE 2); memory: "
         "sustained KV exhaustion against the tiering/preemption ladder "
@@ -614,7 +883,10 @@ def main(argv=None) -> int:
         "migration to a standby — combined streams must be bit-identical "
         "to uninterrupted runs (ISSUE 11); scale: repeated autoscaler "
         "scale-downs (graceful drain-then-terminate) under load — zero "
-        "stuck, zero lost tokens via the migration path (ISSUE 12)",
+        "stuck, zero lost tokens via the migration path (ISSUE 12); "
+        "disagg: prefill/decode handoffs under injected transfer faults "
+        "(drop/corrupt/slow/partial) — zero stuck, zero wrong tokens, "
+        "every failure degrades to local serving (ISSUE 14)",
     )
     args = ap.parse_args(argv)
     if args.scenario == "memory":
@@ -623,6 +895,8 @@ def main(argv=None) -> int:
         res = run_crash(seconds=args.seconds, seed=args.seed)
     elif args.scenario == "scale":
         res = run_scale(seconds=args.seconds, seed=args.seed)
+    elif args.scenario == "disagg":
+        res = run_disagg(seconds=args.seconds, seed=args.seed)
     else:
         res = run_soak(
             seconds=args.seconds, seed=args.seed,
@@ -641,7 +915,7 @@ def main(argv=None) -> int:
     if args.scenario == "memory" and not res.get("tiering_moved"):
         print("KV TIERING COUNTERS DID NOT MOVE", file=sys.stderr)
         return 1
-    if args.scenario in ("crash", "scale"):
+    if args.scenario in ("crash", "scale", "disagg"):
         if res.get("mismatches"):
             print(
                 f"MIGRATED STREAMS DIVERGED: {res['mismatches']} "
@@ -652,7 +926,15 @@ def main(argv=None) -> int:
         if not res.get("migrated"):
             print("NO REQUEST ACTUALLY MIGRATED", file=sys.stderr)
             return 1
-        events = res.get("crashes", res.get("scale_downs"))
+        if args.scenario == "disagg" and not res.get("fallbacks"):
+            print(
+                "NO TRANSFER FAULT ACTUALLY FORCED A FALLBACK",
+                file=sys.stderr,
+            )
+            return 1
+        events = res.get(
+            "crashes", res.get("scale_downs", res.get("handoffs"))
+        )
         print(
             f"{args.scenario} events: {events}, migrated: "
             f"{res['migrated']} — zero lost tokens, all combined "

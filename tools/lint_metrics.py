@@ -68,6 +68,16 @@ Contracts, enforced repo-wide (wired into tier-1 via
    this fails the build instead.  A genuinely designated reconcile/emit
    site is allowlisted by carrying a ``host-sync-ok: <why>`` marker on
    the same line.
+10. **One transfer/pool/filestore vocabulary** (ISSUE 14): the
+   disaggregation families each have exactly one owner —
+   ``helix_xfer_*`` (KV snapshot ship outcomes) is minted only by
+   ``helix_tpu/serving/migration.py``, ``helix_filestore_kv_*`` (the
+   persistent KV tier) only by ``helix_tpu/serving/kv_filestore.py``,
+   and ``helix_cp_pool_*`` (pool roles + handoff outcomes) only by
+   ``helix_tpu/control/router.py``.  The runner's scrape surface must
+   keep calling ``collect_xfer`` + ``collect_filestore_kv`` and the
+   control plane ``collect_cp_pools`` (the contracts 3-8 importer
+   pattern).
 
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
@@ -402,6 +412,63 @@ def _routing_schema_violations(root: str) -> list:
     return violations
 
 
+# -- contract 10: one transfer/pool/filestore vocabulary ---------------------
+# Disaggregated prefill/decode (ISSUE 14): KV-ship outcomes are minted
+# only by serving/migration.py, the persistent KV tier's series only by
+# serving/kv_filestore.py, and the cp's pool-role/handoff series only by
+# control/router.py.
+_XFER_NAME_RE = re.compile(r"""["']helix_xfer_[a-z0-9_]*["']""")
+_FILESTORE_KV_NAME_RE = re.compile(
+    r"""["']helix_filestore_kv_[a-z0-9_]*["']"""
+)
+_POOL_NAME_RE = re.compile(r"""["']helix_cp_pool_[a-z0-9_]*["']""")
+# (file, required symbol): both scrape surfaces keep routing through
+# the owning modules' collector helpers
+_DISAGG_IMPORTERS = (
+    (
+        os.path.join("helix_tpu", "serving", "openai_api.py"),
+        "collect_xfer",
+    ),
+    (
+        os.path.join("helix_tpu", "serving", "openai_api.py"),
+        "collect_filestore_kv",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "server.py"),
+        "collect_cp_pools",
+    ),
+)
+
+
+def _is_kv_filestore(path: str, root: str) -> bool:
+    rel = os.path.relpath(path, root)
+    return rel == os.path.join("helix_tpu", "serving", "kv_filestore.py")
+
+
+def _disagg_schema_violations(root: str) -> list:
+    violations = []
+    for rel, mod in (
+        (os.path.join("helix_tpu", "serving", "kv_filestore.py"),
+         "filestore-KV"),
+    ):
+        if not os.path.isfile(os.path.join(root, rel)):
+            violations.append(
+                f"{rel}: missing — the {mod} metric vocabulary must "
+                "live there"
+            )
+    for rel, symbol in _DISAGG_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if symbol not in f.read():
+                violations.append(
+                    f"{rel}: does not call {symbol} (the transfer/pool/"
+                    "filestore collector importer pattern)"
+                )
+    return violations
+
+
 # -- contract 7: one compiled step entry point -------------------------------
 # The unified ragged step is THE device-step builder; these existing
 # names are the only lru-cached ``_build_*`` functions allowed under
@@ -498,6 +565,7 @@ def run(root: str) -> list:
     violations += _migration_schema_violations(root)
     violations += _step_builder_violations(root)
     violations += _routing_schema_violations(root)
+    violations += _disagg_schema_violations(root)
     violations += _host_sync_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
@@ -517,7 +585,28 @@ def run(root: str) -> list:
         migration_emitter = _is_migration(path, root)
         route_emitter = _is_route(path, root)
         autoscale_emitter = _is_autoscale(path, root)
+        kv_filestore_emitter = _is_kv_filestore(path, root)
         for i, line in enumerate(lines, 1):
+            if not migration_emitter and _XFER_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: helix_xfer_* metric family named "
+                    "outside helix_tpu/serving/migration.py — KV "
+                    "transfer series must come from the shipper module"
+                )
+            if not kv_filestore_emitter and _FILESTORE_KV_NAME_RE.search(
+                line
+            ):
+                violations.append(
+                    f"{rel}:{i}: helix_filestore_kv_* metric family "
+                    "named outside helix_tpu/serving/kv_filestore.py — "
+                    "filestore-tier series must come from its module"
+                )
+            if not route_emitter and _POOL_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: helix_cp_pool_* metric family named "
+                    "outside helix_tpu/control/router.py — pool-role "
+                    "series must come from the router module"
+                )
             if not route_emitter and _ROUTE_NAME_RE.search(line):
                 violations.append(
                     f"{rel}:{i}: helix_cp_route_* metric family named "
