@@ -212,6 +212,41 @@ ENV_REFERENCE: tuple = (
         section="accelerator",
     ),
     EnvVar(
+        "HELIX_ADAPTER_POOL_SLOTS",
+        "Continuous multi-LoRA serving override for every engine this "
+        "node serves (the HELIX_SPEC_TOKENS contract — beats the "
+        "profile's engine.adapter_pool_slots): >=2 slots arm the "
+        "batched adapter path (one resident base model serves many "
+        "`model@adapter` tenants through a stacked HBM pool, slot 0 "
+        "reserved for the zero identity adapter; the pool shape "
+        "compiles once at warmup, so publishing an adapter later "
+        "needs no restart or recompile), 0 forces it off even where a "
+        "profile enables it. Unset: the profile setting applies "
+        "(default off). Not supported for mrope (VL) or multihost "
+        "lockstep engines.",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_ADAPTER_HOST_POOL_BYTES",
+        "Byte budget for the host rung of the adapter residency "
+        "ladder (decoded LoRA adapter trees awaiting an HBM pool "
+        "slot; LRU over filestore-backed entries — an adapter whose "
+        "only copy is the host one is never evicted). Cold adapters "
+        "promote filestore -> host on the async prefetch worker and "
+        "host -> HBM at admission. Default 268435456 (256 MiB); 0 "
+        "disables the bound.",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_ADAPTER_PREFETCH",
+        "Async adapter prefetch (ISSUE 15): on (default), a cold "
+        "adapter's filestore->host load runs on a background worker "
+        "kicked at submit/admission, overlapping the request's queue "
+        "wait — an engine step never blocks on an adapter load. "
+        "0/false forces synchronous loads (debug/tests).",
+        section="accelerator",
+    ),
+    EnvVar(
         "HELIX_FILESTORE_KV_DIR",
         "Root directory of the persistent filestore KV tier (the "
         "bottom rung of the residency ladder: HBM -> host RAM -> peer "

@@ -260,6 +260,20 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         n_spec = int(spec_env)
         ekw["spec_tokens"] = max(n_spec, 1)
         ekw["enable_spec_decode"] = n_spec > 0
+    from helix_tpu.engine.adapters import adapter_pool_slots_env
+
+    adapter_slots = adapter_pool_slots_env()
+    if adapter_slots is not None:
+        # operator-level multi-LoRA pool override for EVERY engine this
+        # node serves (the HELIX_SPEC_TOKENS contract): >=2 slots turn
+        # the batched adapter path on, 0 forces it off even where a
+        # profile enables it
+        ekw["adapter_pool_slots"] = adapter_slots
+    if pm.multihost:
+        # lockstep engines never serve the batched adapter path:
+        # publish/residency are leader-local decisions the follower's
+        # replayed command stream would not see
+        ekw["adapter_pool_slots"] = 0
     async_env = _os_env.environ.get("HELIX_ASYNC_LOOP", "")
     if async_env:
         # operator-level async-engine-loop override for EVERY engine
@@ -590,6 +604,7 @@ class NodeAgent:
         host_used = host_budget = 0
         preempted = 0
         prefill_budget = 0
+        adapters_resident = 0
         tps = 0.0
         for m in self._live_models():
             loop = getattr(m, "loop", None)
@@ -621,6 +636,9 @@ class NodeAgent:
                 host_used += hp.used_bytes
                 host_budget += hp.budget_bytes
             preempted += len(getattr(eng, "preempted", ()))
+            # multi-LoRA adapters resident in HBM pools sum across
+            # engines (ISSUE 15) — the router's affinity denominator
+            adapters_resident += sat.get("adapters_resident", 0)
         from helix_tpu.testing import faults
 
         out = {
@@ -640,6 +658,7 @@ class NodeAgent:
             ),
             "preempted_requests": preempted,
             "prefill_budget_tokens": prefill_budget,
+            "adapters_resident": adapters_resident,
         }
         # chaos (ISSUE 12): a "saturation" fault rule overrides reported
         # keys so routing/autoscale tests can drive one runner toward
@@ -679,6 +698,19 @@ class NodeAgent:
             return {}
         return merge_rollups(rollups, top_k=tenant_top_k_from_env())
 
+    def adapter_summary(self) -> list:
+        """The heartbeat adapter-residency block (ISSUE 15): bounded
+        sorted ``model@adapter`` ids currently HBM-resident on this
+        node (``engine.adapters.adapter_residency_summary`` over the
+        lock-free live-model snapshot — the heartbeat thread never
+        blocks on a build)."""
+        from helix_tpu.engine.adapters import adapter_residency_summary
+
+        try:
+            return adapter_residency_summary(self._live_models())
+        except Exception:  # noqa: BLE001 — heartbeat must never die
+            return []
+
     def pool_role(self) -> str:
         """This node's disaggregation pool role: HELIX_POOL_ROLE beats
         the applied profile's ``role:`` (unknown values degrade to the
@@ -717,6 +749,10 @@ class NodeAgent:
             },
             "saturation": self.saturation_summary(),
             "tenants": self.tenant_summary(),
+            # multi-LoRA residency federation (ISSUE 15): bounded
+            # `model@adapter` ids resident in any live engine's HBM
+            # pool — the scored router's adapter-affinity signal
+            "adapters": self.adapter_summary(),
             # disaggregation pool role (ISSUE 14): the router schedules
             # prefill and decode pools independently off this
             "role": self.pool_role(),

@@ -45,6 +45,9 @@ CP_ROUTE_AFFINITY_YIELDS = "helix_cp_route_affinity_yields_total"
 CP_ROUTE_CLASS_STEERED = "helix_cp_route_class_steered_total"
 CP_ROUTE_STALE_NEUTRAL = "helix_cp_route_stale_neutral_total"
 CP_ROUTE_AFFINITY_ENTRIES = "helix_cp_route_affinity_entries"
+CP_ROUTE_ADAPTER_AFFINITY_HITS = (
+    "helix_cp_route_adapter_affinity_hits_total"
+)
 
 # ---------------------------------------------------------------------------
 # pool roles (ISSUE 14): disaggregated prefill/decode.  A runner's
@@ -403,6 +406,11 @@ class RunnerState:
     # entries, top-K + __other__) — pruned with the runner like
     # saturation, so tenant gauges can never outlive their reporter
     tenants: dict = dataclasses.field(default_factory=dict)
+    # multi-LoRA residency block (ISSUE 15): bounded, sanitised
+    # `model@adapter` ids HBM-resident on this runner (validated by
+    # engine.adapters.validate_adapter_block at heartbeat ingestion) —
+    # the adapter-affinity hint's signal, pruned with the runner
+    adapters: list = dataclasses.field(default_factory=list)
     # graceful-shutdown state (ISSUE 11): a draining runner finishes /
     # migrates its in-flight work but takes NO new requests —
     # ``pick_runner`` skips it (including half-open breaker probes,
@@ -455,6 +463,9 @@ class InferenceRouter:
         self.route_affinity_yields = 0
         self.route_class_steered = 0
         self.route_stale_neutral = 0
+        # multi-LoRA adapter-affinity (ISSUE 15): picks placed on a
+        # runner whose heartbeat residency block held the adapter
+        self.route_adapter_affinity_hits = 0
         # disaggregated prefill/decode (ISSUE 14): handoff outcomes,
         # incremented by the dispatch orchestration (plain ints, GIL-
         # atomic) and rendered by collect_cp_pools
@@ -480,6 +491,7 @@ class InferenceRouter:
         meta: Optional[dict] = None,
         saturation: Optional[dict] = None,
         tenants: Optional[dict] = None,
+        adapters: Optional[list] = None,
         draining: bool = False,
         drain_deadline: float = 0.0,
         role: str = POOL_MIXED,
@@ -503,6 +515,8 @@ class InferenceRouter:
                     st.saturation_at = self.clock()
             if tenants is not None:
                 st.tenants = dict(tenants)
+            if adapters is not None:
+                st.adapters = list(adapters)
             st.draining = bool(draining)
             st.drain_deadline = float(drain_deadline or 0.0)
             return st
@@ -556,6 +570,18 @@ class InferenceRouter:
                     out.update(st.models)
             return sorted(out)
 
+    def available_adapters(self) -> list:
+        """Union of heartbeat-federated ``model@adapter`` residency
+        entries on routable, fresh runners, bounded — the cp
+        /v1/models adapter listing (ISSUE 15)."""
+        now = self.clock()
+        with self._lock:
+            out = set()
+            for st in self._runners.values():
+                if st.routable and now - st.last_heartbeat <= self.ttl:
+                    out.update(st.adapters)
+        return sorted(out)[:128]
+
     def model_map(self) -> dict:
         """{model: [runner ids serving it]} over routable, fresh runners
         (the /api/v1/model-info shape)."""
@@ -571,7 +597,7 @@ class InferenceRouter:
     def pick_runner(
         self, model: str, exclude: Iterable[str] = (),
         sched_class: str = "", affinity_key: Optional[str] = None,
-        role: Optional[str] = None,
+        role: Optional[str] = None, adapter: str = "",
     ) -> Optional[RunnerState]:
         """Failure- and load-aware pick over routable runners serving
         ``model``: skips runners in ``exclude`` (already tried this
@@ -634,12 +660,31 @@ class InferenceRouter:
                 return None
             if self.policy.policy == ROUTE_POLICY_SCORED:
                 return self._pick_scored(
-                    model, allowed, now, sched_class, affinity_key
+                    model, allowed, now, sched_class, affinity_key,
+                    adapter=adapter,
                 )
             # -- seed baseline (bit-for-bit): least-loaded + RR ---------
             min_load = min(
                 self._inflight.get(st.id, 0) for st in allowed
             )
+            if adapter:
+                # adapter-affinity (ISSUE 15): prefer a runner whose
+                # heartbeat residency block already holds this adapter
+                # in HBM — a HINT like prefix affinity, honoured only
+                # among the least-loaded so a popular adapter
+                # rebalances instead of pinning onto one runner.  No
+                # resident runner = plain pick (the chosen runner's
+                # residency ladder loads it on admission).
+                key = f"{model}{'@'}{adapter}"
+                warm = [
+                    st for st in allowed
+                    if key in st.adapters
+                    and self._inflight.get(st.id, 0) <= min_load
+                ]
+                if warm:
+                    self.route_adapter_affinity_hits += 1
+                    self.route_decisions_rr += 1
+                    return warm[0]
             if affinity_key is not None and self.policy.affinity:
                 # a hint, not a pin, under rr too: honoured only while
                 # the hinted runner is among the least-loaded — a busy
@@ -749,6 +794,7 @@ class InferenceRouter:
     def _pick_scored(
         self, model: str, allowed: list, now: float,
         sched_class: str, affinity_key: Optional[str],
+        adapter: str = "",
     ) -> Optional[RunnerState]:
         scored = [
             (st, *self._score(st, now, sched_class)) for st in allowed
@@ -783,6 +829,16 @@ class InferenceRouter:
                 # the remembered runner is gone, excluded, or saturated:
                 # affinity is a hint, not a pin — yield to the scorer
                 self.route_affinity_yields += 1
+        if adapter:
+            # adapter-affinity (ISSUE 15): restrict to NON-AVOIDED
+            # candidates already holding this adapter in HBM (the
+            # heartbeat residency block) — still score-ordered within,
+            # and yielding entirely to saturation like prefix affinity
+            key = f"{model}{'@'}{adapter}"
+            warm = [e for e in ok if key in e[0].adapters]
+            if warm:
+                self.route_adapter_affinity_hits += 1
+                pool = warm
         best = min(e[3] for e in pool)
         least = [e[0] for e in pool if e[3] <= best + 1e-9]
         cursor = self._rr.get(model, 0)
@@ -1082,6 +1138,12 @@ def collect_cp_routing(c, router: "InferenceRouter") -> None:
     c.gauge(
         CP_ROUTE_AFFINITY_ENTRIES, len(router._affinity),
         help="Live prefix-digest -> runner entries in the affinity LRU",
+    )
+    c.counter(
+        CP_ROUTE_ADAPTER_AFFINITY_HITS,
+        router.route_adapter_affinity_hits,
+        help="Dispatches placed on a runner whose heartbeat residency "
+             "block already held the request's adapter in HBM",
     )
 
 

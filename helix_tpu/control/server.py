@@ -44,6 +44,10 @@ from helix_tpu.control.router import (
     sanitize_pool_role,
 )
 from helix_tpu.control.store import Store
+from helix_tpu.engine.adapters import (
+    split_model_adapter,
+    validate_adapter_block,
+)
 from helix_tpu.obs.flight import SATURATION_KEYS
 from helix_tpu.obs.slo import (
     ANON_TENANT,
@@ -1809,6 +1813,11 @@ class ControlPlane:
         # finite values and a bounded count; malformed blocks degrade to
         # {} and never reject the heartbeat
         tenants = validate_tenant_rollup(body.get("tenants"))
+        # multi-LoRA residency block (ISSUE 15): runner-supplied like
+        # saturation — clamped to bounded, sanitised `model@adapter`
+        # strings; malformed blocks degrade to [] and never reject the
+        # heartbeat
+        adapters = validate_adapter_block(body.get("adapters"))
         # drain state (ISSUE 11): runner-supplied like saturation, so a
         # malformed flag DEGRADES to false (still-routable) instead of
         # 500ing the heartbeat and TTL-evicting a healthy runner — the
@@ -1848,6 +1857,7 @@ class ControlPlane:
             # traffic-never-seen) runner — keeping the previous rollup
             # would freeze stale burn gauges on a healthy node
             tenants=tenants,
+            adapters=adapters,
             draining=draining,
             drain_deadline=drain_deadline,
         )
@@ -4650,12 +4660,21 @@ class ControlPlane:
 
     # -- openai passthrough ---------------------------------------------------
     async def models(self, request):
+        # published multi-LoRA adapters (ISSUE 15) list as bounded
+        # `base@adapter` entries next to their base models, from the
+        # federated heartbeat residency blocks — addressable through
+        # the same dispatch path
+        base = self.router.available_models()
+        adapters = self.router.available_adapters()
         return web.json_response(
             {
                 "object": "list",
                 "data": [
                     {"id": m, "object": "model", "owned_by": "helix-tpu"}
-                    for m in self.router.available_models()
+                    for m in base
+                ] + [
+                    {"id": a, "object": "model", "owned_by": "helix-tpu"}
+                    for a in adapters
                 ],
             }
         )
@@ -5046,6 +5065,25 @@ class ControlPlane:
             if available:
                 model = available[0]
                 raw = json.dumps({**body, "model": model}).encode()
+        # `model@adapter` addressing (ISSUE 15): ROUTE on the base
+        # model (runners serve the base; the adapter resolves against
+        # the chosen runner's residency ladder) and pass the adapter as
+        # an affinity hint; the body keeps the full name for the
+        # runner.  A model whose LITERAL registered name contains '@'
+        # keeps routing by exact name (no behavior change for
+        # pre-existing names); a malformed adapter id on an unserved
+        # literal is a clean 404, never forwarded.
+        route_model, route_adapter, adapter_ok = split_model_adapter(
+            model
+        )
+        if (
+            route_adapter or not adapter_ok
+        ) and model in self.router.model_map():
+            route_model, route_adapter, adapter_ok = model, "", True
+        if not adapter_ok:
+            return _err(
+                404, f"model '{model}' not found (invalid adapter id)"
+            )
         # mid-stream failover (ISSUE 11, HELIX_MIDSTREAM_FAILOVER=1):
         # streaming requests go through the SSE-aware path that can
         # continue the client's stream on a surviving runner after a
@@ -5060,12 +5098,12 @@ class ControlPlane:
             (midstream_failover_enabled() or disagg_pools_enabled())
             and body.get("stream")
             and request.path in ("/v1/chat/completions", "/v1/completions")
-            and model
-            and model in self.router.model_map()
+            and route_model
+            and route_model in self.router.model_map()
         ):
             return await self._dispatch_stream_failover(
-                request, body, raw, model, trace_id, tenant, sched_class,
-                t_req,
+                request, body, raw, route_model, trace_id, tenant,
+                sched_class, t_req, adapter=route_adapter,
             )
         # prefix-affinity routing (ISSUE 12, HELIX_PREFIX_AFFINITY):
         # requests sharing a prompt head (system prompt) land on the
@@ -5077,16 +5115,17 @@ class ControlPlane:
             else None
         )
         runner = self.router.pick_runner(
-            model, sched_class=sched_class, affinity_key=affinity_key
+            route_model, sched_class=sched_class,
+            affinity_key=affinity_key, adapter=route_adapter,
         )
         if runner is None:
-            if model and model in self.router.model_map():
+            if route_model and route_model in self.router.model_map():
                 # cluster-wide drain (ISSUE 11): every runner serving
                 # the model is draining — distinct typed 503 with an
                 # HONEST Retry-After (the latest reported drain
                 # deadline), so clients back off for the right duration
                 # instead of hammering a cluster mid-rollout
-                drain_after = self.router.drain_retry_after(model)
+                drain_after = self.router.drain_retry_after(route_model)
                 if drain_after is not None:
                     self.dispatch_exhausted += 1
                     return web.json_response(
@@ -5114,7 +5153,9 @@ class ControlPlane:
                 # runner after a queue wait.  Shed HERE with an honest
                 # Retry-After (cluster backlog over cluster goodput)
                 # so clients back off instead of deepening the queues.
-                sat_after = self.router.saturation_retry_after(model)
+                sat_after = self.router.saturation_retry_after(
+                    route_model
+                )
                 if sat_after is not None:
                     self.dispatch_exhausted += 1
                     return web.json_response(
@@ -5176,14 +5217,16 @@ class ControlPlane:
         while attempt < self.dispatch_max_attempts:
             if runner is None:
                 runner = self.router.pick_runner(
-                    model, exclude=tried, sched_class=sched_class
+                    route_model, exclude=tried, sched_class=sched_class,
+                    adapter=route_adapter,
                 )
                 if runner is None and tried:
                     # every distinct candidate already failed once this
                     # request; revisit (faults may be transient) as long
                     # as a breaker still admits traffic
                     runner = self.router.pick_runner(
-                        model, sched_class=sched_class
+                        route_model, sched_class=sched_class,
+                        adapter=route_adapter,
                     )
                 if runner is None:
                     break
@@ -5484,7 +5527,7 @@ class ControlPlane:
 
     async def _dispatch_stream_failover(self, request, body, raw, model,
                                         trace_id, tenant, sched_class,
-                                        t_req):
+                                        t_req, adapter: str = ""):
         """SSE dispatch that survives runner death PAST the first byte
         (ISSUE 11, opt-in via HELIX_MIDSTREAM_FAILOVER).
 
@@ -5540,11 +5583,13 @@ class ControlPlane:
         if disagg_pools_enabled() and request.path in (
             "/v1/chat/completions", "/v1/completions"
         ):
-            pre = self.router.pick_runner(model, role=POOL_PREFILL)
+            pre = self.router.pick_runner(
+                model, role=POOL_PREFILL, adapter=adapter
+            )
             if pre is not None:
                 dec = self.router.pick_runner(
                     model, exclude={pre.id}, sched_class=sched_class,
-                    affinity_key=affinity_key,
+                    affinity_key=affinity_key, adapter=adapter,
                 )
                 if dec is not None and dec.meta.get("address"):
                     disagg_plan = (pre, dec)
@@ -5637,11 +5682,11 @@ class ControlPlane:
             else:
                 target = self.router.pick_runner(
                     model, exclude=tried, sched_class=sched_class,
-                    affinity_key=affinity_key,
+                    affinity_key=affinity_key, adapter=adapter,
                 )
                 if target is None and tried:
                     target = self.router.pick_runner(
-                        model, sched_class=sched_class
+                        model, sched_class=sched_class, adapter=adapter,
                     )
                 if target is None:
                     break

@@ -1,0 +1,980 @@
+"""Multi-LoRA adapter serving: tiered residency + batched application
+(ISSUE 15).
+
+One resident base model serves MANY LoRA adapters concurrently: requests
+address ``model@adapter``, every engine-step row carries an
+``adapter_id`` column in the PR 9 per-row metadata, and the unified
+ragged step applies adapters with a batched gather-matmul (BGMV-style:
+``ops.quant.maybe_dequant_dense`` adds ``scale * (x @ A[g]) @ B[g]`` per
+token from a stacked pool) — so a mixed-adapter decode/prefill/spec wave
+packs the SAME device call with no new trace families, and the
+alternative (one ``merge_lora_into_params`` copy per tenant) stops
+costing N× base-model HBM plus a hot-swap compile wave per adapter
+change.
+
+Residency mirrors the KV ladder:
+
+- :class:`AdapterPool` — the HBM rung: a fixed-capacity stacked slot
+  array per LoRA target (slot 0 is the reserved IDENTITY adapter —
+  zeros at scale 0, so adapter-free rows ride the same program and
+  greedy outputs stay bit-identical to the pool-less engine), LRU over
+  refcount-0 slots, loads counted and timed.  Capacity is compiled into
+  the step once (``EngineConfig.adapter_pool_slots``); LOADING an
+  adapter later writes values into the same-shaped arrays, so publish →
+  serve needs no recompile (warmup covers the adapter slot).
+- :class:`AdapterStore` — the host rung (byte-budgeted LRU of decoded
+  host trees, ``HELIX_ADAPTER_HOST_POOL_BYTES``) over an optional
+  persistent filestore rung (checksummed ``.npz`` blobs under the PR 14
+  ``HELIX_FILESTORE_KV_DIR`` root), with an async prefetch worker
+  kicked at admission so a cold adapter overlaps its load with the
+  queue wait and never stalls an engine step
+  (``HELIX_ADAPTER_PREFETCH=0`` forces synchronous loads).
+
+This module is the single owner of the ``helix_adapter_*`` metric
+family (``tools/lint_metrics.py`` contract 11): the runner scrape
+surface calls :func:`collect_adapter_metrics`, the node agent builds
+its heartbeat adapter-residency block with
+:func:`adapter_residency_summary`, and the control plane clamps the
+runner-supplied block through :func:`validate_adapter_block` — the
+contracts 3-10 importer pattern.
+
+jax is imported lazily (inside :class:`AdapterPool`) so control-plane
+processes can import this module for sanitisation/validation without
+touching the accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import hashlib
+import io
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+log = logging.getLogger("helix.adapters")
+
+# ``model@adapter`` addressing: the separator and the adapter-id shape.
+# Ids are bounded and character-restricted BEFORE they can mint a
+# metrics label or become a filestore path component — the PR 7 tenant
+# sanitiser rule.  No leading dot (no hidden/parent-dir names), no path
+# separators, bounded length.
+ADAPTER_SEP = "@"
+MAX_ADAPTER_ID_LEN = 64
+_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+# bounds for federation blocks (heartbeats) and /v1/models listings so
+# a runner with thousands of published adapters can't bloat either
+MAX_RESIDENCY_ENTRIES = 128
+MAX_LISTED_ADAPTERS = 32
+
+# per-adapter accounting is top-K bounded like PR 7 tenants: the K most
+# recently active adapters get their own label series, the rest fold
+# into one __other__ bucket with totals conserved
+ADAPTER_TOP_K = 8
+OTHER_ADAPTER = "__other__"
+
+
+def sanitize_adapter_id(value) -> str:
+    """Bound a caller-supplied adapter id to the shapes that may mint a
+    metrics label or a filestore path component.  Returns "" for
+    anything hostile (too long, path-ish, wrong charset, a claim on the
+    ``__other__`` fold bucket)."""
+    if not isinstance(value, str):
+        return ""
+    v = value.strip()
+    if not v or len(v) > MAX_ADAPTER_ID_LEN or v == OTHER_ADAPTER:
+        return ""
+    if not _ID_RE.match(v):
+        return ""
+    return v
+
+
+def split_model_adapter(name) -> tuple:
+    """``"base@adapter"`` -> ``(base, adapter_id, ok)``.
+
+    ``ok`` is False when an ``@`` was present but the adapter id failed
+    sanitisation (the caller answers 404, never passes the raw value
+    on).  A plain model name returns ``(name, "", True)``."""
+    if not isinstance(name, str) or ADAPTER_SEP not in name:
+        return name, "", True
+    base, _, raw = name.partition(ADAPTER_SEP)
+    adapter = sanitize_adapter_id(raw)
+    return base, adapter, bool(adapter)
+
+
+def adapter_prefetch_enabled() -> bool:
+    """HELIX_ADAPTER_PREFETCH: 0/false forces synchronous tier loads
+    (debug/tests); default on — cold adapters load on a background
+    worker overlapped with the queue wait."""
+    v = os.environ.get("HELIX_ADAPTER_PREFETCH", "").strip().lower()
+    return v not in ("0", "false", "no", "off")
+
+
+def adapter_host_pool_bytes(default: int = 256 * 1024 * 1024) -> int:
+    """HELIX_ADAPTER_HOST_POOL_BYTES: byte budget for the host rung of
+    the adapter residency ladder (decoded adapter trees awaiting HBM
+    slots).  Default 256 MiB; 0 disables eviction bounds (unbounded)."""
+    v = os.environ.get("HELIX_ADAPTER_HOST_POOL_BYTES", "").strip()
+    if not v:
+        return default
+    return int(v)
+
+
+def adapter_pool_slots_env() -> Optional[int]:
+    """HELIX_ADAPTER_POOL_SLOTS: operator-level override for every
+    engine this node serves (the HELIX_SPEC_TOKENS contract — beats the
+    profile's ``engine.adapter_pool_slots``; 0 forces the batched
+    adapter path off).  None = unset, profile applies."""
+    v = os.environ.get("HELIX_ADAPTER_POOL_SLOTS", "").strip()
+    if not v:
+        return None
+    return max(0, int(v))
+
+
+# ---------------------------------------------------------------------------
+# adapter specs (host representation)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class AdapterSpec:
+    """One published adapter, decoded to host numpy: per-target stacked
+    ``a [L, fan_in, r]`` / ``b [L, r, fan_out]`` factors (f32) plus the
+    serving scale (alpha/rank)."""
+
+    adapter_id: str
+    rank: int
+    scale: float
+    targets: dict                 # {target: {"a": np, "b": np}}
+    checksum: str = ""
+
+    @property
+    def nbytes(self) -> int:
+        return sum(
+            int(f["a"].nbytes) + int(f["b"].nbytes)
+            for f in self.targets.values()
+        )
+
+
+def pack_lora_tree(adapter_id: str, lora_params: dict,
+                   scaling: float) -> AdapterSpec:
+    """A training-side LoRA tree (``training.lora`` layout:
+    ``{target: {lora_a [L, in, r], lora_b [L, r, out]}}``) as an
+    :class:`AdapterSpec` — the train -> publish bridge."""
+    targets = {}
+    rank = 0
+    for t, lp in lora_params.items():
+        a = np.asarray(lp["lora_a"], dtype=np.float32)
+        b = np.asarray(lp["lora_b"], dtype=np.float32)
+        if a.ndim != 3 or b.ndim != 3 or a.shape[-1] != b.shape[-2]:
+            raise ValueError(
+                f"adapter {adapter_id!r}: target {t!r} factors have "
+                f"incompatible shapes {a.shape} x {b.shape}"
+            )
+        rank = max(rank, a.shape[-1])
+        targets[t] = {"a": a, "b": b}
+    if not targets:
+        raise ValueError(f"adapter {adapter_id!r}: no LoRA targets")
+    return AdapterSpec(
+        adapter_id=adapter_id, rank=rank, scale=float(scaling),
+        targets=targets,
+    )
+
+
+def _spec_checksum(spec: AdapterSpec) -> str:
+    h = hashlib.blake2b(digest_size=16)
+    h.update(json.dumps(
+        {"rank": spec.rank, "scale": spec.scale,
+         "targets": sorted(spec.targets)}, sort_keys=True,
+    ).encode())
+    for t in sorted(spec.targets):
+        h.update(np.ascontiguousarray(spec.targets[t]["a"]).tobytes())
+        h.update(np.ascontiguousarray(spec.targets[t]["b"]).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# AdapterStore: host rung + persistent filestore rung, async prefetch
+# ---------------------------------------------------------------------------
+
+
+class AdapterStore:
+    """Published adapters for ONE base model: a byte-budgeted host LRU
+    of decoded :class:`AdapterSpec` trees over an optional checksummed
+    filestore directory (the persistent rung — survives restarts and is
+    shared by every runner on the filesystem, the PR 14 tier).
+
+    Thread-safe: HTTP publish threads, the async prefetch worker and
+    the engine thread all go through one lock.  ``prefetch`` never
+    blocks the caller; ``ready`` is the engine's admission gate."""
+
+    def __init__(self, model_name: str, dims: dict, num_layers: int,
+                 rank_cap: int, host_budget_bytes: Optional[int] = None,
+                 root_dir: str = "", prefetch: Optional[bool] = None):
+        self.model_name = model_name
+        self.dims = dict(dims)          # {target: (fan_in, fan_out)}
+        self.num_layers = int(num_layers)
+        self.rank_cap = int(rank_cap)
+        self.budget_bytes = (
+            adapter_host_pool_bytes() if host_budget_bytes is None
+            else int(host_budget_bytes)
+        )
+        self.root = root_dir or ""
+        if self.root:
+            os.makedirs(self.root, exist_ok=True)
+        self._prefetch_on = (
+            adapter_prefetch_enabled() if prefetch is None else bool(prefetch)
+        )
+        self._lock = threading.Lock()
+        self._host: "collections.OrderedDict[str, AdapterSpec]" = (
+            collections.OrderedDict()
+        )
+        self._host_bytes = 0
+        # per-id publish generation: bumped by every explicit publish
+        # (NOT by blob reads, which restore the same content) — the HBM
+        # pool compares this against the generation it loaded so a
+        # RE-published adapter reloads instead of serving stale weights
+        self._gens: dict = {}
+        # ids known to have a filestore blob (written by publish or
+        # seen by a successful read): the host-LRU eviction rule checks
+        # THIS set, never the filesystem — no I/O under the store lock
+        # (the engine thread's ready()/get_resident() share it)
+        self._blob_backed: set = set()
+        self._inflight: set = set()      # ids with a prefetch in flight
+        self._worker: Optional[threading.Thread] = None
+        self._queue: "collections.deque" = collections.deque()
+        self._wake = threading.Event()
+        # counters (plain ints, GIL-atomic reads from scrape threads)
+        self.publishes = 0
+        self.prefetches = 0
+        self.host_evictions = 0
+        self.load_errors = 0
+
+    # -- publish -----------------------------------------------------------
+
+    def validate_spec(self, spec: AdapterSpec) -> Optional[str]:
+        """None when the spec fits this base model's geometry, else the
+        reason (surfaced as an HTTP 400 by the publish endpoint)."""
+        if spec.rank > self.rank_cap:
+            return (
+                f"adapter rank {spec.rank} exceeds the pool rank cap "
+                f"{self.rank_cap} (EngineConfig.adapter_rank)"
+            )
+        for t, f in spec.targets.items():
+            want = self.dims.get(t)
+            if want is None:
+                return (
+                    f"target {t!r} is not servable by the batched pool "
+                    f"for {self.model_name!r} (pool targets: "
+                    f"{sorted(self.dims)})"
+                )
+            a, b = f["a"], f["b"]
+            if a.shape[0] != self.num_layers or (
+                a.shape[1], b.shape[2]
+            ) != want:
+                return (
+                    f"target {t!r} factors {a.shape} x {b.shape} do not "
+                    f"match model dims L={self.num_layers}, "
+                    f"(in, out)={want}"
+                )
+        return None
+
+    def publish(self, spec: AdapterSpec, persist: bool = True) -> None:
+        """Admit a validated spec to the host rung (and write through to
+        the filestore rung when configured) — the adapter becomes
+        servable without restart or recompile."""
+        if sanitize_adapter_id(spec.adapter_id) != spec.adapter_id:
+            # enforced at the STORE, not just the HTTP surface: every
+            # programmatic publisher goes through here, and the id is
+            # about to become a filestore path component
+            raise ValueError(
+                f"adapter id {spec.adapter_id!r} failed sanitisation "
+                "(bounded [A-Za-z0-9._-], no leading dot)"
+            )
+        err = self.validate_spec(spec)
+        if err:
+            raise ValueError(err)
+        if not spec.checksum:
+            spec.checksum = _spec_checksum(spec)
+        persisted = False
+        if persist and self.root:
+            self._write_blob(spec)
+            persisted = True
+        with self._lock:
+            if persisted:
+                self._blob_backed.add(spec.adapter_id)
+            self._install(spec)
+            self._gens[spec.adapter_id] = (
+                self._gens.get(spec.adapter_id, 0) + 1
+            )
+        self.publishes += 1
+
+    def publish_checkpoint(self, adapter_id: str, ckpt_dir: str,
+                           scale: Optional[float] = None) -> AdapterSpec:
+        """Publish a LoRA SFT checkpoint (``training.checkpoint``
+        layout, as written by ``helix-tpu sft --output``): restore,
+        pack, validate, admit — the restartless train → publish → serve
+        loop."""
+        from helix_tpu.training.checkpoint import restore_checkpoint
+
+        restored = restore_checkpoint(ckpt_dir)
+        if restored is None:
+            raise FileNotFoundError(
+                f"adapter checkpoint not found at {ckpt_dir!r}"
+            )
+        scaling = scale
+        if scaling is None:
+            scaling = float(restored.get("lora_scaling") or 0) or 1.0
+        spec = pack_lora_tree(
+            adapter_id, restored["lora_params"], scaling
+        )
+        self.publish(spec)
+        return spec
+
+    # -- host rung ---------------------------------------------------------
+
+    def _install(self, spec: AdapterSpec) -> None:
+        """Lock must be held."""
+        old = self._host.pop(spec.adapter_id, None)
+        if old is not None:
+            self._host_bytes -= old.nbytes
+        self._host[spec.adapter_id] = spec
+        self._host_bytes += spec.nbytes
+        if self.budget_bytes > 0:
+            # LRU-evict host copies past the byte budget — but only
+            # entries the filestore rung can reload (the cached
+            # _blob_backed set, NOT an isfile under the lock); an
+            # unpersisted adapter's only copy is never dropped
+            for aid in list(self._host):
+                if self._host_bytes <= self.budget_bytes:
+                    break
+                if aid == spec.adapter_id or aid not in self._blob_backed:
+                    continue
+                victim = self._host.pop(aid)
+                self._host_bytes -= victim.nbytes
+                self.host_evictions += 1
+
+    def generation(self, adapter_id: str) -> int:
+        """Publish generation of an adapter (0 = never explicitly
+        published in this process — e.g. restored from a blob)."""
+        with self._lock:
+            return self._gens.get(adapter_id, 0)
+
+    def ready(self, adapter_id: str) -> bool:
+        """Host-resident (an HBM load can proceed this step)."""
+        with self._lock:
+            return adapter_id in self._host
+
+    def contains(self, adapter_id: str) -> bool:
+        """Published on ANY rung (host, an in-flight prefetch, or the
+        filestore) — the in-memory checks come first so callers that
+        already kicked a prefetch never touch the (possibly remote)
+        filesystem."""
+        with self._lock:
+            if adapter_id in self._host or adapter_id in self._inflight:
+                return True
+        return self._has_blob(adapter_id)
+
+    def get_resident(self, adapter_id: str) -> Optional[AdapterSpec]:
+        """Host-rung hit or None — NO filestore fallback, no disk I/O:
+        the engine thread's pool-load lookup (a cold adapter defers to
+        the async prefetch instead of stalling the step on a blob
+        read + checksum)."""
+        with self._lock:
+            spec = self._host.get(adapter_id)
+            if spec is not None:
+                self._host.move_to_end(adapter_id)
+            return spec
+
+    def get(self, adapter_id: str) -> Optional[AdapterSpec]:
+        """Host hit, or a SYNCHRONOUS filestore load (callers that must
+        not block use ``get_resident`` / ``ready`` + ``prefetch``
+        instead)."""
+        spec = self.get_resident(adapter_id)
+        if spec is not None:
+            return spec
+        spec = self._read_blob(adapter_id)
+        if spec is not None:
+            with self._lock:
+                self._blob_backed.add(adapter_id)
+                self._install(spec)
+        return spec
+
+    def ids(self, bound: int = MAX_LISTED_ADAPTERS) -> list:
+        """Published adapter ids across rungs, sorted, bounded — the
+        /v1/models listing source."""
+        with self._lock:
+            out = set(self._host)
+        if self.root:
+            try:
+                for fn in os.listdir(self.root):
+                    if fn.endswith(".npz"):
+                        aid = sanitize_adapter_id(fn[:-4])
+                        if aid:
+                            out.add(aid)
+            except OSError:
+                pass
+        return sorted(out)[:bound]
+
+    # -- async prefetch ----------------------------------------------------
+
+    def prefetch(self, adapter_id: str) -> bool:
+        """Kick a filestore -> host load on the background worker and
+        return immediately (True when the adapter is or may become
+        host-resident).  NO filesystem I/O happens on the caller's
+        thread — even the blob-existence check runs on the worker, so
+        an event-loop or engine-thread caller can never stall on a
+        slow/remote filestore.  An id with no blob simply resolves to a
+        no-op there.  With prefetch disabled (HELIX_ADAPTER_PREFETCH=0)
+        the load happens inline instead."""
+        with self._lock:
+            if adapter_id in self._host:
+                return True
+            if adapter_id in self._inflight:
+                return True
+        if not self.root:
+            return False
+        if not self._prefetch_on:
+            return self.get(adapter_id) is not None
+        with self._lock:
+            if adapter_id in self._inflight:
+                return True
+            self._inflight.add(adapter_id)
+            self._queue.append(adapter_id)
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._prefetch_loop,
+                    name="adapter-prefetch", daemon=True,
+                )
+                self._worker.start()
+        self._wake.set()
+        self.prefetches += 1
+        return True
+
+    def _prefetch_loop(self) -> None:
+        while True:
+            self._wake.wait(timeout=1.0)
+            self._wake.clear()
+            while True:
+                with self._lock:
+                    if not self._queue:
+                        break
+                    aid = self._queue.popleft()
+                try:
+                    spec = self._read_blob(aid)
+                    if spec is not None:
+                        with self._lock:
+                            self._blob_backed.add(aid)
+                            self._install(spec)
+                except Exception:  # noqa: BLE001 — the tier degrades, never dies
+                    self.load_errors += 1
+                    log.exception("adapter prefetch failed for %s", aid)
+                finally:
+                    with self._lock:
+                        self._inflight.discard(aid)
+
+    # -- filestore rung ----------------------------------------------------
+
+    def _blob_path(self, adapter_id: str) -> str:
+        return os.path.join(self.root, f"{adapter_id}.npz")
+
+    def _has_blob(self, adapter_id: str) -> bool:
+        return bool(self.root) and os.path.isfile(
+            self._blob_path(adapter_id)
+        )
+
+    def _write_blob(self, spec: AdapterSpec) -> None:
+        arrays = {}
+        for t, f in spec.targets.items():
+            arrays[f"a__{t}"] = f["a"]
+            arrays[f"b__{t}"] = f["b"]
+        meta = json.dumps({
+            "adapter_id": spec.adapter_id, "rank": spec.rank,
+            "scale": spec.scale, "checksum": spec.checksum,
+            "model": self.model_name,
+        })
+        buf = io.BytesIO()
+        np.savez(buf, __meta__=np.frombuffer(
+            meta.encode(), dtype=np.uint8
+        ), **arrays)
+        path = self._blob_path(spec.adapter_id)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(buf.getvalue())
+        os.replace(tmp, path)
+
+    def _read_blob(self, adapter_id: str) -> Optional[AdapterSpec]:
+        if not self._has_blob(adapter_id):
+            return None
+        try:
+            with np.load(self._blob_path(adapter_id)) as z:
+                meta = json.loads(bytes(z["__meta__"]).decode())
+                targets = {}
+                for k in z.files:
+                    if k.startswith("a__"):
+                        t = k[3:]
+                        targets[t] = {
+                            "a": z[f"a__{t}"], "b": z[f"b__{t}"],
+                        }
+            spec = AdapterSpec(
+                adapter_id=adapter_id, rank=int(meta["rank"]),
+                scale=float(meta["scale"]), targets=targets,
+                checksum=str(meta.get("checksum", "")),
+            )
+            # checksum verified BEFORE the spec can reach a pool slot —
+            # a corrupt blob is a typed miss (recompute/prefetch path),
+            # never wrong weights
+            if spec.checksum and _spec_checksum(spec) != spec.checksum:
+                self.load_errors += 1
+                log.warning(
+                    "dropping corrupt adapter blob %s (checksum "
+                    "mismatch)", adapter_id,
+                )
+                return None
+            if self.validate_spec(spec) is not None:
+                self.load_errors += 1
+                return None
+            return spec
+        except Exception:  # noqa: BLE001 — a bad blob is a miss, not a crash
+            self.load_errors += 1
+            log.exception("unreadable adapter blob %s", adapter_id)
+            return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            resident = len(self._host)
+            used = self._host_bytes
+        return {
+            "host_resident": resident,
+            "host_used_bytes": used,
+            "host_budget_bytes": self.budget_bytes,
+            "publishes": self.publishes,
+            "prefetches": self.prefetches,
+            "host_evictions": self.host_evictions,
+            "load_errors": self.load_errors,
+        }
+
+
+def default_adapter_store(model_cfg, engine_cfg) -> "AdapterStore":
+    """The store an Engine builds for itself when the pool is enabled:
+    geometry from the model config, host budget + prefetch from the
+    documented env knobs, and the persistent rung under the PR 14
+    filestore root (``HELIX_FILESTORE_KV_DIR``) when one is set."""
+    from helix_tpu.training.lora import _target_dims
+
+    dims = _target_dims(model_cfg)
+    targets = tuple(
+        t for t in engine_cfg.adapter_targets if t in dims
+    )
+    root = ""
+    fs = os.environ.get("HELIX_FILESTORE_KV_DIR", "")
+    if fs:
+        ns = re.sub(r"[^A-Za-z0-9._-]", "_", model_cfg.name or "model")
+        root = os.path.join(fs, "adapters", ns)
+    return AdapterStore(
+        model_cfg.name or "model",
+        {t: dims[t] for t in targets},
+        model_cfg.num_layers,
+        engine_cfg.adapter_rank,
+        root_dir=root,
+    )
+
+
+# ---------------------------------------------------------------------------
+# AdapterPool: the HBM rung (stacked slots grafted into the ragged step)
+# ---------------------------------------------------------------------------
+
+
+class AdapterPool:
+    """Fixed-capacity device-resident adapter slots for one engine.
+
+    Per LoRA target the pool holds stacked factors shaped for the
+    layer-scanned forward (leading ``num_layers`` dim like every other
+    stacked weight): ``a [L, N, fan_in, R]``, ``b [L, N, R, fan_out]``,
+    plus one shared per-slot scale ``[L, N]``.  Slot 0 is the reserved
+    identity adapter (zero factors, zero scale): a row whose metadata
+    carries adapter id 0 adds an exact ``0.0`` to every projection, so
+    adapter-free traffic through the pool-enabled program emits
+    greedy-bit-identical tokens.
+
+    Loading writes one slot of each array (``.at[:, n].set``) — same
+    shapes, same dtypes, so the compiled step never retraces on adapter
+    churn.  Slots are LRU over refcount-0 entries; an engine holds one
+    ref per live request (admission → finish, parked requests
+    included), so a serving adapter can never be evicted out from
+    under its rows."""
+
+    def __init__(self, model_cfg, targets: tuple, rank: int, slots: int,
+                 dtype=None):
+        import jax.numpy as jnp
+
+        from helix_tpu.training.lora import _target_dims
+
+        if slots < 2:
+            raise ValueError(
+                f"adapter_pool_slots ({slots}) must be >= 2 (slot 0 is "
+                "the reserved identity adapter)"
+            )
+        dims = _target_dims(model_cfg)
+        self.targets = tuple(t for t in targets if t in dims)
+        if not self.targets:
+            raise ValueError(
+                f"no usable adapter targets in {targets} for "
+                f"{model_cfg.name}"
+            )
+        self.rank = int(rank)
+        self.slots = int(slots)
+        L = model_cfg.num_layers
+        dt = dtype or jnp.float32
+        self._a = {
+            t: jnp.zeros((L, self.slots, dims[t][0], self.rank), dt)
+            for t in self.targets
+        }
+        self._b = {
+            t: jnp.zeros((L, self.slots, self.rank, dims[t][1]), dt)
+            for t in self.targets
+        }
+        self._scale = jnp.zeros((L, self.slots), jnp.float32)
+        self._slot_of: dict = {}        # adapter id -> slot index
+        self._lru: "collections.OrderedDict[str, None]" = (
+            collections.OrderedDict()
+        )
+        self._refs: dict = {}           # adapter id -> live request count
+        self._gen_loaded: dict = {}     # adapter id -> publish generation
+        self.version = 0                # bumps per load/evict (graft cache)
+        # counters
+        self.loads = 0
+        self.evictions = 0
+        self.load_seconds = 0.0
+        # bounded per-adapter activity accounting (rows applied in
+        # device steps): top-K most recently active + __other__, totals
+        # conserved — the PR 7 tenant rule, so the labelled series
+        # count stays constant under adapter churn
+        self._rows: "collections.OrderedDict[str, int]" = (
+            collections.OrderedDict()
+        )
+        self._rows_other = 0
+        self.rows_applied_total = 0
+        self._lock = threading.Lock()
+
+    # -- graft surface (engine dispatch path) ------------------------------
+
+    def entries(self) -> dict:
+        """Per-target pool entries to merge into ``params["layers"]``:
+        the forward's layer scan slices the leading L dim exactly like
+        the base weights, and ``maybe_dequant_dense`` picks the
+        ``lora_pool_*`` keys up per projection."""
+        return {
+            t: {
+                "lora_pool_a": self._a[t],
+                "lora_pool_b": self._b[t],
+                "lora_pool_scale": self._scale,
+            }
+            for t in self.targets
+        }
+
+    def hbm_bytes(self) -> int:
+        return sum(
+            int(a.nbytes) for a in self._a.values()
+        ) + sum(int(b.nbytes) for b in self._b.values())
+
+    # -- residency ---------------------------------------------------------
+
+    def resident(self, adapter_id: str) -> bool:
+        with self._lock:
+            return adapter_id in self._slot_of
+
+    def resident_ids(self) -> list:
+        with self._lock:
+            return sorted(self._slot_of)
+
+    def slot_for(self, adapter_id: str) -> Optional[int]:
+        with self._lock:
+            return self._slot_of.get(adapter_id)
+
+    def acquire(self, adapter_id: str,
+                lookup: Callable[[str], Optional[AdapterSpec]],
+                generation: Optional[int] = None) -> Optional[int]:
+        """Pin ``adapter_id`` into an HBM slot for one request.
+
+        Resident: refcount++ and return the slot.  Host-ready (``lookup``
+        yields a spec): load into a free or LRU refcount-0 slot and
+        return it.  Otherwise None — the caller defers admission and
+        kicks a prefetch; the engine step never blocks on a cold
+        adapter.
+
+        ``generation`` is the store's publish generation: a resident
+        slot loaded from an OLDER generation reloads in place when no
+        live request pins it (re-publish serves the new weights on the
+        next admission); pinned slots keep serving the weights their
+        live rows were conditioned on, and reload once the refs
+        drain."""
+        with self._lock:
+            slot = self._slot_of.get(adapter_id)
+            if slot is not None:
+                stale = (
+                    generation is not None
+                    and self._gen_loaded.get(adapter_id) != generation
+                    and self._refs.get(adapter_id, 0) <= 0
+                )
+                if not stale:
+                    self._refs[adapter_id] = (
+                        self._refs.get(adapter_id, 0) + 1
+                    )
+                    self._lru.move_to_end(adapter_id)
+                    return slot
+        spec = lookup(adapter_id)
+        if spec is None:
+            return None
+        with self._lock:
+            slot = self._slot_of.get(adapter_id)
+            refresh = slot is not None
+            if refresh and (
+                generation is None
+                or self._gen_loaded.get(adapter_id) == generation
+                or self._refs.get(adapter_id, 0) > 0
+            ):
+                # raced: another thread loaded/refreshed it already (or
+                # a live request pinned the old weights mid-check)
+                self._refs[adapter_id] = self._refs.get(adapter_id, 0) + 1
+                self._lru.move_to_end(adapter_id)
+                return slot
+            if not refresh:
+                slot = self._free_slot_locked()
+                if slot is None:
+                    return None    # every slot pinned by live requests
+            t0 = time.monotonic()
+            self._load_locked(slot, spec)
+            self.load_seconds += time.monotonic() - t0
+            self._slot_of[adapter_id] = slot
+            self._lru[adapter_id] = None
+            self._lru.move_to_end(adapter_id)
+            self._refs[adapter_id] = 1
+            if generation is not None:
+                self._gen_loaded[adapter_id] = generation
+            self.loads += 1
+            self.version += 1
+            return slot
+
+    def release(self, adapter_id: str) -> None:
+        with self._lock:
+            n = self._refs.get(adapter_id, 0) - 1
+            if n > 0:
+                self._refs[adapter_id] = n
+            else:
+                self._refs.pop(adapter_id, None)
+
+    def _free_slot_locked(self) -> Optional[int]:
+        used = set(self._slot_of.values())
+        for s in range(1, self.slots):   # slot 0 = identity, never used
+            if s not in used:
+                return s
+        # LRU-evict a refcount-0 resident (its slot data stays garbage
+        # until overwritten; no live row can carry its id)
+        for aid in list(self._lru):
+            if self._refs.get(aid, 0) <= 0:
+                s = self._slot_of.pop(aid)
+                self._lru.pop(aid, None)
+                self._gen_loaded.pop(aid, None)
+                self.evictions += 1
+                self.version += 1
+                return s
+        return None
+
+    def _load_locked(self, slot: int, spec: AdapterSpec) -> None:
+        import jax.numpy as jnp
+
+        for t in self.targets:
+            f = spec.targets.get(t)
+            a_host = np.zeros(self._a[t].shape[0:1] + self._a[t].shape[2:],
+                              np.float32)
+            b_host = np.zeros(self._b[t].shape[0:1] + self._b[t].shape[2:],
+                              np.float32)
+            if f is not None:
+                r = f["a"].shape[-1]
+                a_host[:, :, :r] = f["a"]
+                b_host[:, :r, :] = f["b"]
+            dt = self._a[t].dtype
+            self._a[t] = self._a[t].at[:, slot].set(
+                jnp.asarray(a_host, dtype=dt)
+            )
+            self._b[t] = self._b[t].at[:, slot].set(
+                jnp.asarray(b_host, dtype=dt)
+            )
+        self._scale = self._scale.at[:, slot].set(
+            jnp.float32(spec.scale)
+        )
+
+    # -- bounded per-adapter activity --------------------------------------
+
+    def note_rows(self, counts: dict) -> None:
+        """Bank device-step rows per adapter id (top-K + __other__,
+        totals conserved — constant /metrics cardinality under adapter
+        churn)."""
+        with self._lock:
+            for aid, n in counts.items():
+                n = int(n)
+                if n <= 0:
+                    continue
+                self.rows_applied_total += n
+                if aid in self._rows:
+                    self._rows[aid] += n
+                    self._rows.move_to_end(aid)
+                elif len(self._rows) < ADAPTER_TOP_K:
+                    self._rows[aid] = n
+                    self._rows.move_to_end(aid)
+                else:
+                    # demote the stalest tracked adapter into __other__
+                    # (sums conserved), then track the newcomer
+                    old_id, old_n = self._rows.popitem(last=False)
+                    self._rows_other += old_n
+                    self._rows[aid] = n
+
+    def rows_applied(self) -> dict:
+        with self._lock:
+            out = dict(self._rows)
+            if self._rows_other:
+                out[OTHER_ADAPTER] = self._rows_other
+            return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "slots": self.slots,
+                "resident": len(self._slot_of),
+                "pinned": sum(
+                    1 for v in self._refs.values() if v > 0
+                ),
+                "loads": self.loads,
+                "evictions": self.evictions,
+                "load_seconds": round(self.load_seconds, 6),
+                "rows_applied": self.rows_applied_total,
+                "hbm_bytes": self.hbm_bytes(),
+            }
+
+
+# ---------------------------------------------------------------------------
+# metrics + federation (the single helix_adapter_* owner — lint contract 11)
+# ---------------------------------------------------------------------------
+
+
+def collect_adapter_metrics(c, loop, labels: dict) -> None:
+    """Runner-side adapter series for one engine loop (called from the
+    runner's scrape surface — the importer pattern).  No-op when the
+    engine serves without a pool."""
+    eng = loop.engine
+    pool = getattr(eng, "adapter_pool", None)
+    if pool is None:
+        return
+    st = pool.stats()
+    c.gauge(
+        "helix_adapter_pool_slots", st["slots"], labels,
+        help="HBM adapter-pool slot capacity (slot 0 = identity)",
+    )
+    c.gauge(
+        "helix_adapter_resident", st["resident"], labels,
+        help="Adapters currently resident in the HBM pool",
+    )
+    c.gauge(
+        "helix_adapter_pool_bytes", st["hbm_bytes"], labels,
+        help="HBM bytes held by the stacked adapter pool",
+    )
+    c.counter(
+        "helix_adapter_loads_total", st["loads"], labels,
+        help="Adapter loads into an HBM pool slot",
+    )
+    c.counter(
+        "helix_adapter_evictions_total", st["evictions"], labels,
+        help="LRU evictions of refcount-0 adapters from the HBM pool",
+    )
+    c.counter(
+        "helix_adapter_load_seconds_total", st["load_seconds"], labels,
+        help="Cumulative host->HBM adapter load time",
+    )
+    for aid, n in sorted(pool.rows_applied().items()):
+        c.counter(
+            "helix_adapter_rows_applied_total", n,
+            {**labels, "adapter": aid},
+            help="Device-step rows served per adapter (top-K bounded "
+                 "+ __other__)",
+        )
+    store = getattr(eng, "adapter_store", None)
+    if store is None:
+        return
+    sst = store.stats()
+    c.counter(
+        "helix_adapter_publishes_total", sst["publishes"], labels,
+        help="Adapters published (train -> publish -> serve)",
+    )
+    c.counter(
+        "helix_adapter_prefetches_total", sst["prefetches"], labels,
+        help="Async filestore->host adapter prefetches kicked",
+    )
+    c.counter(
+        "helix_adapter_host_evictions_total", sst["host_evictions"],
+        labels,
+        help="Host-tier adapter evictions (filestore-backed only)",
+    )
+    c.counter(
+        "helix_adapter_load_errors_total", sst["load_errors"], labels,
+        help="Corrupt/unreadable adapter blobs dropped at load",
+    )
+    c.gauge(
+        "helix_adapter_host_pool_used_bytes", sst["host_used_bytes"],
+        labels,
+        help="Host-tier bytes held by decoded adapter trees",
+    )
+    c.gauge(
+        "helix_adapter_host_pool_budget_bytes",
+        sst["host_budget_bytes"], labels,
+        help="Host-tier adapter byte budget "
+             "(HELIX_ADAPTER_HOST_POOL_BYTES)",
+    )
+
+
+def adapter_residency_summary(models) -> list:
+    """The heartbeat adapter-residency block: bounded, sorted
+    ``model@adapter`` ids currently resident in any live engine's HBM
+    pool — the control plane's adapter-affinity signal.  ``models`` is
+    the node agent's lock-free live-model snapshot."""
+    out = []
+    for m in models:
+        loop = getattr(m, "loop", None)
+        pool = getattr(getattr(loop, "engine", None), "adapter_pool",
+                       None)
+        if pool is None:
+            continue
+        name = getattr(m, "name", "")
+        for aid in pool.resident_ids():
+            out.append(f"{name}{ADAPTER_SEP}{aid}")
+            if len(out) >= MAX_RESIDENCY_ENTRIES:
+                return sorted(out)
+    return sorted(out)
+
+
+def validate_adapter_block(raw) -> list:
+    """Clamp a runner-supplied heartbeat adapters block: a bounded list
+    of sanitised ``model@adapter`` strings — malformed blocks degrade
+    to [] and never reject the heartbeat (the PR 4/7 validator rule)."""
+    if not isinstance(raw, (list, tuple)):
+        return []
+    out = []
+    for entry in raw:
+        if not isinstance(entry, str) or ADAPTER_SEP not in entry:
+            continue
+        base, _, aid = entry.partition(ADAPTER_SEP)
+        aid = sanitize_adapter_id(aid)
+        if not base or not aid or len(base) > 256:
+            continue
+        out.append(f"{base}{ADAPTER_SEP}{aid}")
+        if len(out) >= MAX_RESIDENCY_ENTRIES:
+            break
+    return sorted(set(out))

@@ -110,6 +110,12 @@ class Request:
     # priority class (serving/sched.py): "interactive" | "batch";
     # "" lets the engine loop stamp the profile's default at submit
     sched_class: str = ""
+    # multi-LoRA adapter id (engine/adapters.py): sanitised at the
+    # OpenAI surface from `model@adapter` addressing; "" = base model.
+    # The engine resolves it to an HBM pool slot at admission (deferred
+    # — never blocking a step — while the adapter is cold) and holds
+    # one pool ref until finish
+    adapter: str = ""
     cached_tokens: int = 0          # prompt tokens served by prefix cache
     preempt_count: int = 0          # times swapped out (bounds thrash)
     _page_hashes: Optional[list] = None
@@ -203,6 +209,29 @@ class EngineConfig:
     # bit-identical with the knob on or off.  Node-level override:
     # HELIX_ASYNC_LOOP (operator-beats-profile, 0 forces off).
     enable_async_loop: bool = False
+    # Continuous multi-LoRA serving (engine/adapters.py): >= 2 turns on
+    # the batched adapter path — a fixed-capacity stacked HBM pool of
+    # LoRA factors (slot 0 reserved for the zero identity adapter) is
+    # grafted into the unified ragged step, every device-step row
+    # carries its adapter slot in the per-row metadata, and the
+    # projections add scale * (x @ A[g]) @ B[g] per token via a batched
+    # gather-matmul — so N tenants' adapters serve against ONE resident
+    # base model with no per-tenant model copies, no hot-swap compile
+    # waves, and no new trace families (the pool shape is compiled once
+    # at warmup; loading an adapter later writes values into the same
+    # arrays).  0 = off (seed behaviour; `adapter:` profile merging
+    # still works as the single-adapter fallback).  Node-level
+    # override: HELIX_ADAPTER_POOL_SLOTS.  Unsupported for mrope (VL)
+    # models — the single-shot VL prefill does not thread per-token
+    # adapter ids.
+    adapter_pool_slots: int = 0
+    # pool-wide rank capacity: adapters with smaller rank zero-pad
+    # (exact — zero rows of A and zero columns of B contribute nothing)
+    adapter_rank: int = 16
+    # LoRA targets the pool serves (must cover every published
+    # adapter's targets; attention-only by default — MoE FFNs are not
+    # adaptable, dense FFN targets can be added per profile)
+    adapter_targets: tuple = ("wq", "wk", "wv", "wo")
     # Host-RAM KV tier (engine/kv_cache.HostPagePool): byte budget for
     # spilled pages.  >0 turns the tier on: PrefixCache evictions demote
     # page contents to host buffers instead of dying (restored into
@@ -286,13 +315,14 @@ class DecodeState:
     mrope_delta: jax.Array   # [B] i32
     keys: jax.Array          # [B, 2] u32 — per-slot PRNG keys
     token_counts: jax.Array  # [B, V] i32 — output-token histogram
+    adapter_slots: jax.Array  # [B] i32 — multi-LoRA pool slot (0 = none)
     sampling: SamplingState
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _rebuild_state(
     old: DecodeState, last_token, positions, page_tables, active,
-    mrope_delta, new_keys, keep, sampling,
+    mrope_delta, new_keys, keep, adapter_slots, sampling,
 ) -> DecodeState:
     B = last_token.shape[0]
     keepc = keep[:, None] > 0
@@ -310,6 +340,7 @@ def _rebuild_state(
         mrope_delta=mrope_delta,
         keys=jnp.where(keepc, old.keys, new_keys),
         token_counts=jnp.where(keepc, old.token_counts, fresh),
+        adapter_slots=adapter_slots,
         sampling=sampling,
     )
 
@@ -462,6 +493,11 @@ class RequestSnapshot:
     # max_tokens — and the importer backs the table's tail with fresh
     # (content-irrelevant) pages up to this count
     total_pages: int = 0
+    # multi-LoRA adapter id (ISSUE 15): the importer re-resolves it
+    # against ITS residency ladder, so a migrated adapter request keeps
+    # decoding through the same adapter on the peer; "" = base model
+    # (absent on pre-ISSUE-15 wire snapshots — default keeps them valid)
+    adapter: str = ""
 
     @property
     def has_kv(self) -> bool:
@@ -684,7 +720,7 @@ def _ring_chunk_attention(q, k, v, caches, lyr, p_pos, p_seg, p_hist,
 
 
 def _tail_decode_step(params, cache, state: DecodeState, *, cfg, backend,
-                      page_size):
+                      page_size, use_adapters: bool = False):
     """Traced body of ONE plain decode step over every slot: each active
     slot is a one-token row over its ragged paged history.  This is the
     fused-window TAIL of the unified step (scanned ``n_extra`` times
@@ -737,6 +773,9 @@ def _tail_decode_step(params, cache, state: DecodeState, *, cfg, backend,
             # inactive slots never consume expert capacity: outputs
             # are independent of batch-mates (decode is dropless too)
             moe_token_mask=(active > 0)[:, None],
+            adapter_ids=(
+                state.adapter_slots[:, None] if use_adapters else None
+            ),
         )
     cache = PagedKVCache.from_carry(pc)
     pages, offsets = slot_to_page_offset(pos2d, state.page_tables,
@@ -759,6 +798,7 @@ def _tail_decode_step(params, cache, state: DecodeState, *, cfg, backend,
         token_counts=state.token_counts.at[jnp.arange(B), token].add(
             active
         ),
+        adapter_slots=state.adapter_slots,
         sampling=state.sampling,
     )
     return cache, new_state, token
@@ -769,6 +809,7 @@ def _build_ragged_step_fn(
     model_cfg: ModelConfig, page_size: int, backend, mesh,
     token_bucket: int, has_hist: bool, prefill_rows: int,
     state_width: int, n_tail_max: int, ring_hist_pages: int = 0,
+    adapter_slots: int = 0,
 ):
     """THE unified device step: ONE compiled entry point serves every
     caller, keyed at runtime only on the prefill token-bucket.
@@ -815,6 +856,13 @@ def _build_ragged_step_fn(
         ("ragged", token_bucket, has_hist, prefill_rows,
          ring_hist_pages),
     )
+    # adapter_slots is an ENGINE-WIDE constant (EngineConfig), not a
+    # per-call shape axis: every existing trace family gains exactly
+    # one variant, so the compiled-shape count is unchanged vs the
+    # pool-less engine (the tentpole's no-new-trace-families contract;
+    # adapter LOADS write values into the same-shaped pool arrays and
+    # never retrace)
+    use_adapters = adapter_slots > 0
     cfg = model_cfg
     is_moe = cfg.num_experts > 0
     is_mrope = cfg.mrope_sections is not None
@@ -841,8 +889,15 @@ def _build_ragged_step_fn(
 
         # ---- 1. prefill segment --------------------------------------
         if Cb > 0:
-            (p_tokens, p_pos, p_seg, p_pages, p_offsets, p_t0, p_qlen,
-             p_hist, p_tables, p_ends, p_sampling, p_keys) = pargs
+            if use_adapters:
+                (p_tokens, p_pos, p_seg, p_pages, p_offsets, p_t0,
+                 p_qlen, p_hist, p_tables, p_ends, p_sampling, p_keys,
+                 p_aids) = pargs
+            else:
+                (p_tokens, p_pos, p_seg, p_pages, p_offsets, p_t0,
+                 p_qlen, p_hist, p_tables, p_ends, p_sampling,
+                 p_keys) = pargs
+                p_aids = None
             kacc0 = jnp.zeros((L, 1, Cb, KVH, D), kdt)
             vacc0 = jnp.zeros((L, 1, Cb, KVH, D), kdt)
 
@@ -880,6 +935,7 @@ def _build_ragged_step_fn(
                 carry_caches=(cache.carry(), kacc0, vacc0),
                 moe_token_mask=p_seg > 0,
                 return_moe_stats=is_moe,
+                adapter_ids=p_aids,
             )
             if is_moe:
                 logits_p, (pc, kacc, vacc), moe_stats = res
@@ -941,6 +997,12 @@ def _build_ragged_step_fn(
                 attn_fn=s_attn,
                 carry_caches=carry0,
                 moe_token_mask=live,
+                adapter_ids=(
+                    jnp.broadcast_to(
+                        state.adapter_slots[:, None], (B, W)
+                    )
+                    if use_adapters else None
+                ),
             )
         cache = PagedKVCache.from_carry(pc2)
         pages_s, offs_s = slot_to_page_offset(
@@ -1003,6 +1065,7 @@ def _build_ragged_step_fn(
             mrope_delta=state.mrope_delta,
             keys=keys,
             token_counts=counts,
+            adapter_slots=state.adapter_slots,
             sampling=state.sampling,
         )
 
@@ -1014,7 +1077,7 @@ def _build_ragged_step_fn(
                 c, st, buf = carry
                 c, st, tok = _tail_decode_step(
                     params, c, st, cfg=cfg, backend=backend,
-                    page_size=page_size,
+                    page_size=page_size, use_adapters=use_adapters,
                 )
                 return _pin_default_layout(c), st, buf.at[t].set(tok)
 
@@ -1178,6 +1241,50 @@ class Engine:
                 self.spec = SpecDecoder(
                     SpecConfig(spec_tokens=cfg.spec_tokens)
                 )
+        # --- continuous multi-LoRA serving (ISSUE 15) ---
+        # batched adapter pool (engine/adapters.py): one resident base
+        # model, many per-tenant adapters — requests carry an adapter
+        # id, every device-step row carries its pool slot, and the
+        # unified step applies scale * (x @ A) @ B per token via a
+        # batched gather-matmul.  None = off (config, or an unsupported
+        # model family).  adapter_store is the host/filestore residency
+        # ladder below the pool (built by default; the node agent may
+        # re-wire a custom one post-construction like kv_filestore).
+        self.adapter_pool = None
+        self.adapter_store = None
+        self._adapter_refs: dict[str, str] = {}   # req id -> adapter id
+        self._slot_adapters = np.zeros((B,), np.int32)
+        if cfg.adapter_pool_slots > 0:
+            if model_cfg.mrope_sections is not None:
+                logging.getLogger(__name__).warning(
+                    "batched multi-LoRA serving is not supported for "
+                    "mrope (VL) models — running without an adapter pool"
+                )
+            elif cfg.adapter_pool_slots < 2:
+                # slot 0 is the reserved identity adapter, so one slot
+                # can serve nothing — degrade to off (warn) instead of
+                # failing the whole model's profile apply
+                logging.getLogger(__name__).warning(
+                    "adapter_pool_slots=%d leaves no usable slots "
+                    "(slot 0 is the reserved identity) — running "
+                    "without an adapter pool; set >= 2 to serve "
+                    "adapters", cfg.adapter_pool_slots,
+                )
+            else:
+                from helix_tpu.engine.adapters import (
+                    AdapterPool,
+                    default_adapter_store,
+                )
+
+                self.adapter_pool = AdapterPool(
+                    model_cfg, cfg.adapter_targets, cfg.adapter_rank,
+                    cfg.adapter_pool_slots,
+                    dtype=jnp.dtype(model_cfg.dtype),
+                )
+                self.adapter_store = default_adapter_store(
+                    model_cfg, cfg
+                )
+        self._grafted_params = None    # (pool.version, params) cache
         # --- unified ragged step (ISSUE 10) ---
         # ONE compiled device-step entry point serves packed/cache-hit
         # prefill, chunked prefill, plain decode, the mixed step and
@@ -1290,6 +1397,22 @@ class Engine:
             )
         if not req.prompt_tokens:
             return "empty prompt"
+        if getattr(req, "adapter", ""):
+            if self.adapter_pool is None:
+                return (
+                    f"adapter '{req.adapter}' requested but this engine "
+                    "serves without an adapter pool "
+                    "(EngineConfig.adapter_pool_slots)"
+                )
+            if (
+                self.adapter_store is not None
+                and not self.adapter_pool.resident(req.adapter)
+                and not self.adapter_store.contains(req.adapter)
+            ):
+                return (
+                    f"adapter '{req.adapter}' is not published for "
+                    f"model '{self.model_cfg.name}'"
+                )
         return None
 
     def add_request(self, req: Request) -> None:
@@ -1699,6 +1822,123 @@ class Engine:
         for (digest, _page), page_arrays in zip(entries, arrays):
             self.host_pool.put(digest, page_arrays)
 
+    # ------------------------------------------------------------------
+    # continuous multi-LoRA serving (ISSUE 15)
+    # ------------------------------------------------------------------
+
+    def publish_adapter(self, adapter_id: str, lora_params: dict,
+                        scaling: float) -> None:
+        """Publish a trained LoRA tree for ``model@adapter_id`` serving
+        — validated against this model's geometry, admitted to the
+        host/filestore residency ladder, servable without restart or
+        recompile (the pool shape was compiled at warmup)."""
+        from helix_tpu.engine.adapters import pack_lora_tree
+
+        if self.adapter_pool is None or self.adapter_store is None:
+            raise ValueError(
+                "adapter serving is off for this engine "
+                "(EngineConfig.adapter_pool_slots)"
+            )
+        self.adapter_store.publish(
+            pack_lora_tree(adapter_id, lora_params, scaling)
+        )
+
+    def _adapter_ready(self, req: Request) -> bool:
+        """Can this request's adapter reach an HBM slot THIS step?
+        Resident or host-resident = yes; otherwise the async
+        filestore->host prefetch is (re-)kicked and admission defers —
+        a cold adapter overlaps its load with the queue wait and never
+        blocks an engine step."""
+        aid = getattr(req, "adapter", "")
+        if not aid or self.adapter_pool is None:
+            return True
+        if self.adapter_pool.resident(aid):
+            return True
+        if self.adapter_store is None:
+            return False
+        if self.adapter_store.ready(aid):
+            return True
+        self.adapter_store.prefetch(aid)
+        return False
+
+    def _acquire_adapter(self, req: Request) -> Optional[int]:
+        """Pin the request's adapter into an HBM pool slot (idempotent
+        per request — one ref held admission -> finish, parked requests
+        included, so a serving adapter can never be evicted under its
+        rows).  None = not loadable this step (cold, or every slot
+        pinned): the caller defers."""
+        aid = getattr(req, "adapter", "")
+        if not aid:
+            return 0
+        if self.adapter_pool is None:
+            return None
+        if req.id in self._adapter_refs:
+            return self.adapter_pool.slot_for(aid)
+        if self.adapter_store is not None:
+            # host-resident specs ONLY: this runs on the engine thread,
+            # and a filestore fallback here would be a blocking blob
+            # read + checksum stalling every in-flight decode — a cold
+            # adapter defers (the caller kicks the async prefetch)
+            lookup = self.adapter_store.get_resident
+            gen = self.adapter_store.generation(aid)
+        else:
+            lookup, gen = (lambda _id: None), None
+        slot = self.adapter_pool.acquire(aid, lookup, generation=gen)
+        if slot is not None:
+            self._adapter_refs[req.id] = aid
+        return slot
+
+    def _release_adapter(self, req: Request) -> None:
+        aid = self._adapter_refs.pop(req.id, None)
+        if aid is not None and self.adapter_pool is not None:
+            self.adapter_pool.release(aid)
+
+    def _graft_params(self):
+        """The model params with the adapter pool's stacked slot arrays
+        merged into each targeted layer entry (shallow dict copies —
+        the arrays themselves are the pool's).  Cached per pool
+        version: loads/evictions swap values, never shapes, so the
+        compiled step never retraces on adapter churn."""
+        if self.adapter_pool is None:
+            return self.params
+        cached = self._grafted_params
+        if cached is not None and cached[0] == self.adapter_pool.version:
+            return cached[1]
+        merged = dict(self.params)
+        layers = dict(merged["layers"])
+        for t, entry in self.adapter_pool.entries().items():
+            layers[t] = {**layers[t], **entry}
+        merged["layers"] = layers
+        self._grafted_params = (self.adapter_pool.version, merged)
+        return merged
+
+    def _note_adapter_rows(self, plan, draft_len) -> None:
+        """Bank this device call's rows per adapter id (bounded top-K
+        accounting on the pool) — host-side dict math only."""
+        pool = self.adapter_pool
+        if pool is None:
+            return
+        counts: dict = {}
+        if plan is not None:
+            for row in plan.rows:
+                if row.adapter and row.req is not None:
+                    aid = getattr(row.req, "adapter", "")
+                    if aid:
+                        counts[aid] = counts.get(aid, 0) + 1
+        if draft_len is not None:
+            dl = np.asarray(draft_len)
+            for i, req in enumerate(self.slots):
+                if (
+                    req is not None
+                    and i < len(dl)
+                    and dl[i] >= 0
+                    and self._slot_active(i)
+                    and getattr(req, "adapter", "")
+                ):
+                    counts[req.adapter] = counts.get(req.adapter, 0) + 1
+        if counts:
+            pool.note_rows(counts)
+
     def _try_claim(self, req: Request, use_cache: bool = False):
         """Allocate pages + a slot for one waiting request; returns its
         page table or None when resources are unavailable.
@@ -1714,6 +1954,15 @@ class Engine:
         free_slots = [i for i, s in enumerate(self.slots) if s is None]
         if not free_slots:
             return None
+        adapter_slot = 0
+        if getattr(req, "adapter", ""):
+            # pin the adapter into an HBM pool slot BEFORE any page/slot
+            # mutation — a cold adapter defers the whole claim (the ref,
+            # once held, survives queue waits and parks until finish)
+            got = self._acquire_adapter(req)
+            if got is None:
+                return None
+            adapter_slot = got
         plen = len(req.prompt_tokens)
         limit = min(plen + req.sampling.max_tokens, self.max_context_len)
         need = self.allocator.pages_needed(limit, self.cache_cfg.page_size)
@@ -1780,6 +2029,7 @@ class Engine:
             len(pages) * self.cache_cfg.page_size, self.max_context_len
         )
         self.slots[slot] = req
+        self._slot_adapters[slot] = adapter_slot
         table = np.zeros((self.cache_cfg.max_pages_per_seq,), np.int32)
         table[: len(pages)] = pages
         self._page_tables[slot] = table
@@ -1966,6 +2216,13 @@ class Engine:
                 self.waiting.pop(0)
                 continue
             req = self.waiting[0]
+            if not self._adapter_ready(req):
+                # cold adapter: its filestore->host prefetch was just
+                # (re-)kicked — set the request aside like a blocked
+                # long prompt so everything behind it keeps admitting
+                # and the engine step never waits on the load
+                deferred.append(self.waiting.pop(0))
+                continue
             plen = len(req.prompt_tokens)
             needs_chunking = plen > self.cfg.max_prefill_len
             is_mrope = self.model_cfg.mrope_sections is not None
@@ -2055,10 +2312,16 @@ class Engine:
             batch = []
 
         admitted_any = False
+        adapter_deferred: list = []
         while self.waiting:
             req = self.waiting[0]
             if req.finished:
                 self.waiting.pop(0)
+                continue
+            if not self._adapter_ready(req):
+                # cold adapter mid-wave: defer (prefetch already
+                # kicked), keep packing the rest of the queue
+                adapter_deferred.append(self.waiting.pop(0))
                 continue
             plen = len(req.prompt_tokens)
             if plen > C_cap:
@@ -2094,8 +2357,13 @@ class Engine:
             plan.add(
                 req, table, start, rem,
                 req.prompt_tokens[start:plen], sub, req.sampling,
+                adapter=int(self._slot_adapters[req.slot]),
             )
             batch.append((req, table))
+        if adapter_deferred:
+            # back at the queue head: FIFO among deferred adapters is
+            # preserved and the next admission pass re-checks readiness
+            self.waiting[:0] = adapter_deferred
         flush()
         admitted = 0
         for wave_plan, wave_batch in waves:
@@ -2200,6 +2468,7 @@ class Engine:
         plan.add(
             req, st["table"], start, rem,
             req.prompt_tokens[start:end], sub, req.sampling,
+            adapter=int(self._slot_adapters[st["slot"]]),
         )
         return plan, rem, end
 
@@ -2458,6 +2727,7 @@ class Engine:
                 mrope_delta=jnp.zeros((B,), jnp.int32),
                 keys=jnp.zeros((B, 2), jnp.uint32),
                 token_counts=jnp.zeros((B, V), jnp.int32),
+                adapter_slots=jnp.zeros((B,), jnp.int32),
                 sampling=sampling,
             )
         keep = np.array(
@@ -2476,6 +2746,7 @@ class Engine:
             jnp.asarray(self._mrope_delta),
             jnp.asarray(self._slot_keys),
             jnp.asarray(keep),
+            jnp.asarray(self._slot_adapters),
             sampling,
         )
         self._changed_slots.clear()
@@ -2732,6 +3003,7 @@ class Engine:
             "tenant": req.tenant,
             "trace_id": req.trace_id,
             "sched_class": req.sched_class,
+            "adapter": getattr(req, "adapter", ""),
             "max_len": req.max_len,
             "preempt_count": req.preempt_count,
             "page_size": self.cache_cfg.page_size,
@@ -2907,6 +3179,7 @@ class Engine:
             trace_id=snap.trace_id,
             tenant=snap.tenant,
             sched_class=snap.sched_class,
+            adapter=getattr(snap, "adapter", "") or "",
             preempt_count=int(snap.preempt_count),
         )
         err = self.validate_request(req)
@@ -3061,6 +3334,17 @@ class Engine:
             # cache-owned wedges every parked/imported request
             if not free_slots or not self._ensure_pages(n_private):
                 return
+            resume_adapter = 0
+            if getattr(req, "adapter", ""):
+                # ordinary preemptions keep their adapter ref parked
+                # (idempotent re-acquire); imported snapshots pin it
+                # here — a cold adapter keeps the park FIFO waiting
+                # while the prefetch overlaps (never blocks the step)
+                got = self._acquire_adapter(req)
+                if got is None:
+                    self._adapter_ready(req)   # (re-)kick the prefetch
+                    return
+                resume_adapter = got
             # claim + verify every host copy BEFORE touching allocator
             # state: a corrupt page means the sequence cannot be
             # reconstructed bit-exactly — fail the request loudly, never
@@ -3107,6 +3391,7 @@ class Engine:
             slot = free_slots[0]
             self.slots[slot] = req
             req.slot = slot
+            self._slot_adapters[slot] = resume_adapter
             row = np.zeros((self.cache_cfg.max_pages_per_seq,), np.int32)
             row[: len(table)] = table
             self._page_tables[slot] = row
@@ -3412,6 +3697,10 @@ class Engine:
             drafts = self._zero_drafts
         if draft_len is None:
             draft_len = self._inert_rows
+        pool_slots = (
+            self.adapter_pool.slots if self.adapter_pool is not None
+            else 0
+        )
         if plan is not None and plan.rows:
             rung = bucket_tokens(plan.used, self._token_ladder)
             self._charge_padding(rung, plan.used)
@@ -3429,6 +3718,10 @@ class Engine:
                 a["offsets"], a["t0"], a["qlen"], a["hist"],
                 a["tables"], a["ends"], sampling, a["keys"],
             )
+            if pool_slots:
+                # one more per-row metadata column: each token's
+                # adapter pool slot (0 = identity)
+                pargs = pargs + (a["aids"],)
             rows = plan.max_rows
             has_hist = plan.has_hist
         else:
@@ -3451,12 +3744,13 @@ class Engine:
         fn = _build_ragged_step_fn(
             self.model_cfg, self.cache_cfg.page_size, self._backend,
             self.mesh, rung, has_hist, rows, self._spec_width(),
-            self._n_tail_max, ring_hist,
+            self._n_tail_max, ring_hist, pool_slots,
         )
         self.num_device_calls += 1
+        self._note_adapter_rows(plan, draft_len)
         (self.cache, self._dstate, p_first, sampled, emit, extra,
          drops) = fn(
-            self.params, self.cache, self._dstate, pargs,
+            self._graft_params(), self.cache, self._dstate, pargs,
             jnp.asarray(drafts), jnp.asarray(draft_len),
             jnp.int32(n_extra),
         )
@@ -3561,5 +3855,6 @@ class Engine:
             self.prefix_cache.release(shared)
         if self.spec is not None:
             self.spec.forget(req.id)
+        self._release_adapter(req)
         if self.allocator.owns(req.id):
             self.allocator.free(req.id)

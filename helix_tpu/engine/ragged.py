@@ -102,6 +102,7 @@ class PrefillRow:
     key: np.ndarray             # [2] u32 sampling sub-key
     sampling: object            # SamplingParams
     t0: int = 0                 # assigned at finalize
+    adapter: int = 0            # multi-LoRA pool slot (0 = identity)
 
 
 class PrefillPlan:
@@ -124,11 +125,11 @@ class PrefillPlan:
         return len(self.rows) < self.max_rows and self.used + rem <= cap
 
     def add(self, req, table, start: int, rem: int, tokens, key,
-            sampling) -> None:
+            sampling, adapter: int = 0) -> None:
         row = PrefillRow(
             req=req, table=np.asarray(table), start=int(start),
             rem=int(rem), tokens=list(tokens), key=key, sampling=sampling,
-            t0=self.used,
+            t0=self.used, adapter=int(adapter),
         )
         self.rows.append(row)
         self.used += row.rem
@@ -141,9 +142,12 @@ class PrefillPlan:
         """Device arrays for the unified step's prefill inputs.
 
         Returns a dict of host arrays (the engine asarray's them):
-        ``tokens/pos/seg/pages/offsets [1, rung]``, per-row
+        ``tokens/pos/seg/pages/offsets/aids [1, rung]``, per-row
         ``t0/qlen/hist/ends [R]`` and ``tables [R, maxP]``, plus the
-        rows' sampling params and keys."""
+        rows' sampling params and keys.  ``aids`` carries each token's
+        multi-LoRA pool slot (0 = identity — padding and adapter-free
+        rows contribute an exact zero delta in the batched gather-
+        matmul)."""
         R = self.max_rows
         ps = self.page_size
         tokens = np.zeros((1, rung), np.int32)
@@ -151,6 +155,7 @@ class PrefillPlan:
         seg = np.zeros((1, rung), np.int32)
         pages = np.zeros((1, rung), np.int32)
         offsets = np.zeros((1, rung), np.int32)
+        aids = np.zeros((1, rung), np.int32)
         t0 = np.zeros((R,), np.int32)
         qlen = np.zeros((R,), np.int32)
         hist = np.zeros((R,), np.int32)
@@ -170,6 +175,7 @@ class PrefillPlan:
                 np.minimum(abs_pos // ps, len(row.table) - 1)
             ]
             offsets[0, sl] = abs_pos % ps
+            aids[0, sl] = row.adapter
             t0[j] = row.t0
             qlen[j] = row.rem
             hist[j] = row.start
@@ -180,7 +186,7 @@ class PrefillPlan:
         t0[len(self.rows):] = self.used
         return {
             "tokens": tokens, "pos": pos, "seg": seg,
-            "pages": pages, "offsets": offsets,
+            "pages": pages, "offsets": offsets, "aids": aids,
             "t0": t0, "qlen": qlen, "hist": hist, "ends": ends,
             "tables": tables, "keys": keys,
         }

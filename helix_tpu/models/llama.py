@@ -33,12 +33,13 @@ Params = dict
 AttnFn = Callable[..., jax.Array]
 
 
-def _dense(x, p):
+def _dense(x, p, adapter_ids=None):
     """x @ p["weight"] with fp32 MXU accumulation; handles int8-quantized
-    weights ({weight, scale}) and optional bias transparently."""
+    weights ({weight, scale}), optional bias, and batched multi-LoRA
+    pool slots (``adapter_ids`` names each token's slot) transparently."""
     from helix_tpu.ops.quant import maybe_dequant_dense
 
-    return maybe_dequant_dense(x, p)
+    return maybe_dequant_dense(x, p, adapter_ids=adapter_ids)
 
 
 def _act(name: str):
@@ -164,6 +165,7 @@ def _layer(
     inv_freq,
     attn_fn: AttnFn,
     moe_token_mask=None,
+    adapter_ids=None,
 ):
     """One decoder block. h: [B, S, E].
 
@@ -182,9 +184,9 @@ def _layer(
 
     # --- attention ---
     x = rms_norm(h, p["attn_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
-    q = _dense(x, p["wq"]).reshape(B, S, H, D)
-    k = _dense(x, p["wk"]).reshape(B, S, KVH, D)
-    v = _dense(x, p["wv"]).reshape(B, S, KVH, D)
+    q = _dense(x, p["wq"], adapter_ids).reshape(B, S, H, D)
+    k = _dense(x, p["wk"], adapter_ids).reshape(B, S, KVH, D)
+    v = _dense(x, p["wv"], adapter_ids).reshape(B, S, KVH, D)
     if cfg.qk_norm:
         q = rms_norm(q, p["q_norm"]["weight"], cfg.rms_norm_eps)
         k = rms_norm(k, p["k_norm"]["weight"], cfg.rms_norm_eps)
@@ -196,7 +198,7 @@ def _layer(
         attn_out, new_cache = res
     else:
         attn_out = res
-    h = h + _dense(attn_out.reshape(B, S, H * D), p["wo"])
+    h = h + _dense(attn_out.reshape(B, S, H * D), p["wo"], adapter_ids)
 
     # --- mlp ---
     x = rms_norm(h, p["mlp_norm"]["weight"], cfg.rms_norm_eps, cfg.norm_offset)
@@ -219,9 +221,9 @@ def _layer(
         )
         h = h + moe_out
     else:
-        gate = _dense(x, p["w_gate"])
-        up = _dense(x, p["w_up"])
-        h = h + _dense(act(gate) * up, p["w_down"])
+        gate = _dense(x, p["w_gate"], adapter_ids)
+        up = _dense(x, p["w_up"], adapter_ids)
+        h = h + _dense(act(gate) * up, p["w_down"], adapter_ids)
     return h, (k, v), new_cache, moe_dropped
 
 
@@ -287,6 +289,8 @@ def forward(
                           # inactive decode slots never consume capacity)
     return_moe_stats: bool = False,  # also return {"dropped": int32} —
                           # MoE capacity-overflow drops summed over layers
+    adapter_ids=None,     # [B, S] i32: per-token multi-LoRA pool slot
+                          # (0 = identity); None = no batched adapters
 ):
     """Run the decoder.
 
@@ -313,6 +317,7 @@ def forward(
         return _layer(
             h, layer_params, layer_cache, cfg, positions, inv_freq,
             attn_fn, moe_token_mask=moe_token_mask,
+            adapter_ids=adapter_ids,
         )
 
     h, kv, moe_dropped = scan_decoder_blocks(

@@ -43,6 +43,7 @@ SATURATION_KEYS = (
     "kv_host_occupancy",  # host KV tier bytes used / budget, 0..1
     "preempted_requests",  # decoders swapped out, parked for resume
     "prefill_budget_tokens",  # scheduler prefill-admission budget/step
+    "adapters_resident",  # multi-LoRA adapters in the HBM pool (ISSUE 15)
 )
 
 

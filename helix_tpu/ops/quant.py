@@ -146,12 +146,24 @@ def quantized_logical_axes(axes_tree: Any) -> Any:
     return walk(axes_tree)
 
 
-def maybe_dequant_dense(x, p: dict, compute_dtype=None):
-    """Dense through a weight dict {weight[, scale, bias, lora_a/lora_b]}.
+def maybe_dequant_dense(x, p: dict, adapter_ids=None, compute_dtype=None):
+    """Dense through a weight dict {weight[, scale, bias, lora_a/lora_b,
+    lora_pool_a/lora_pool_b/lora_pool_scale]}.
 
-    Handles int8 weight-only dequant and grafted LoRA adapters
-    (``helix_tpu.training.lora``) in one place so every projection in every
-    model family composes with both."""
+    Handles int8 weight-only dequant, a single grafted LoRA adapter
+    (``helix_tpu.training.lora`` — the merge-at-apply fallback), and the
+    batched multi-LoRA pool (``helix_tpu.engine.adapters``) in one place
+    so every projection in every model family composes with all three.
+
+    The pool path is BGMV-style: ``lora_pool_a [N, in, r]`` /
+    ``lora_pool_b [N, r, out]`` stack N adapter slots (slot 0 = the
+    zero identity adapter) and ``adapter_ids [..., S]`` names each
+    token's slot; the per-slot low-rank products are masked by the
+    token's one-hot slot selection BEFORE the B matmul, so summing over
+    N recovers exactly ``scale[g] * (x_t @ A[g]) @ B[g]`` per token —
+    two dense rank-sized einsums on the MXU, no per-token weight
+    gathers.  Rows at slot 0 contribute an exact ``+0.0``, keeping
+    greedy outputs for adapter-free traffic bit-identical."""
     compute_dtype = compute_dtype or x.dtype
     w = p["weight"]
     scale = p.get("scale")
@@ -174,6 +186,29 @@ def maybe_dequant_dense(x, p: dict, compute_dtype=None):
             low.astype(compute_dtype), p["lora_b"].astype(compute_dtype),
             cdims, preferred_element_type=jnp.float32,
         )
+    if adapter_ids is not None and "lora_pool_a" in p:
+        pa = p["lora_pool_a"].astype(compute_dtype)   # [N, in, r]
+        pb = p["lora_pool_b"].astype(compute_dtype)   # [N, r, out]
+        psc = p["lora_pool_scale"]                    # [N] f32
+        n_slots = pa.shape[0]
+        onehot = jax.nn.one_hot(
+            adapter_ids, n_slots, dtype=jnp.float32
+        )                                             # [..., S, N]
+        low = jnp.einsum(
+            "...si,nir->...snr", x, pa,
+            preferred_element_type=jnp.float32,
+        )
+        # mask by slot selection: only the token's own adapter row
+        # survives, so the n-sum in the second einsum IS the gather
+        low = (low * onehot[..., None]).astype(compute_dtype)
+        delta = jnp.einsum(
+            "...snr,nro->...so", low, pb,
+            preferred_element_type=jnp.float32,
+        )
+        tok_scale = jnp.einsum(
+            "...sn,n->...s", onehot, psc.astype(jnp.float32)
+        )
+        out = out + tok_scale[..., None] * delta
     b = p.get("bias")
     if b is not None:
         out = out + b.astype(jnp.float32)

@@ -452,6 +452,16 @@ class EngineLoop:
                 )
             )
             return
+        if getattr(req, "adapter", ""):
+            # cold-adapter overlap starts NOW, on the submitter's
+            # thread: the engine's ONE readiness gate (thread-safe —
+            # pool/store take their own locks) kicks the async
+            # filestore->host prefetch as a side effect, so the load
+            # rides the queue wait and a still-cold adapter defers
+            # admission instead of stalling a step
+            ready = getattr(self.engine, "_adapter_ready", None)
+            if ready is not None:
+                ready(req)
         with self._admission_lock:
             # re-check under the lock: stop() flips _draining inside the
             # same lock, so a submit can never slip its request into the
@@ -779,6 +789,20 @@ class EngineLoop:
                 "device_idle_ratio": round(self.device_idle_ratio(), 4),
                 "emit_queue_depth": self._emit_stage.depth(),
             },
+            # continuous multi-LoRA serving (ISSUE 15): HBM pool +
+            # host/filestore residency ladder; None = pool off
+            "adapters": (
+                {
+                    **eng.adapter_pool.stats(),
+                    "store": (
+                        eng.adapter_store.stats()
+                        if getattr(eng, "adapter_store", None)
+                        is not None else None
+                    ),
+                }
+                if getattr(eng, "adapter_pool", None) is not None
+                else None
+            ),
         }
 
     def device_idle_ratio(self) -> float:
@@ -836,6 +860,13 @@ class EngineLoop:
             # under (0 = unbudgeted — FIFO baseline or no cap declared)
             "prefill_budget_tokens": int(
                 getattr(eng, "prefill_budget", None) or 0
+            ),
+            # multi-LoRA adapters resident in the HBM pool (0 = pool
+            # off) — the control plane's adapter-affinity signal
+            "adapters_resident": (
+                eng.adapter_pool.stats()["resident"]
+                if getattr(eng, "adapter_pool", None) is not None
+                else 0
             ),
         }
         # schema lockstep: this summary IS the per-engine instance of the
@@ -1346,6 +1377,15 @@ class EngineLoop:
                 getattr(s, "tenant", ANON_TENANT)
                 for s in eng.slots if s is not None
             }),
+            # distinct multi-LoRA adapters sharing this step's batch
+            # (ISSUE 15): >1 = a genuinely mixed-adapter device call —
+            # the batched gather-matmul packing the wave that merged
+            # per-tenant model copies never could
+            "distinct_adapters": len({
+                getattr(s, "adapter", "")
+                for s in eng.slots
+                if s is not None and getattr(s, "adapter", "")
+            }),
         }
         if timing:
             # per-step time split (ISSUE 13): host build / device wait /
@@ -1713,6 +1753,7 @@ class EngineLoop:
             trace_id=req.trace_id,
             tenant=getattr(req, "tenant", ANON_TENANT),
             sched_class=getattr(req, "sched_class", ""),
+            adapter=getattr(req, "adapter", ""),
         )
 
     def _trial(self, group: list) -> bool:

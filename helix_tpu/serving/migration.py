@@ -328,8 +328,24 @@ _META_FIELDS = (
     "sampling", "stop_token_ids", "tenant", "trace_id", "sched_class",
     "max_len", "preempt_count", "position", "last_token", "mrope_delta",
     "key", "token_counts", "page_size", "num_layers", "kv_heads",
-    "head_dim", "kv_dtype", "page_checksums", "total_pages",
+    "head_dim", "kv_dtype", "page_checksums", "total_pages", "adapter",
 )
+
+
+def _wire_adapter(doc: dict) -> str:
+    """The snapshot's adapter id, sanitised at the wire boundary."""
+    from helix_tpu.engine.adapters import sanitize_adapter_id
+
+    raw = doc.get("adapter", "") or ""
+    if not raw:
+        return ""
+    adapter = sanitize_adapter_id(str(raw))
+    if not adapter:
+        raise SnapshotError(
+            "snapshot adapter id failed sanitisation",
+            code="snapshot_invalid",
+        )
+    return adapter
 
 
 def _meta_checksum(doc: dict) -> str:
@@ -337,6 +353,13 @@ def _meta_checksum(doc: dict) -> str:
     canon = {
         k: doc.get(k) for k in _META_FIELDS
     }
+    if not canon.get("adapter"):
+        # adapter-free snapshots hash EXACTLY like pre-ISSUE-15 wires
+        # (the key joined the schema later): both directions of a
+        # mixed-version rollout keep verifying for base-model traffic.
+        # An adapter-carrying snapshot hashes the id — an old importer
+        # rejects it (typed), which beats silently dropping the adapter
+        canon.pop("adapter", None)
     h.update(json.dumps(canon, sort_keys=True, default=str).encode())
     return h.hexdigest()
 
@@ -462,6 +485,13 @@ def wire_to_snapshot(doc: dict) -> RequestSnapshot:
                 str(s) for s in doc.get("page_checksums", [])
             ],
             total_pages=int(doc.get("total_pages", 0) or 0),
+            # multi-LoRA adapter id (ISSUE 15; absent on older wires).
+            # Sanitised at the wire boundary like every other adapter
+            # entry surface: a present-but-hostile id is a REJECTED
+            # snapshot (never a silent fall-back to base weights, and
+            # never a raw string that could reach a filestore path or
+            # metrics label on the importer)
+            adapter=_wire_adapter(doc),
         )
     except (TypeError, ValueError) as e:
         raise SnapshotError(

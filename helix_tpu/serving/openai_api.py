@@ -27,6 +27,13 @@ from helix_tpu import obs
 from helix_tpu.engine.engine import Request, SnapshotError
 from helix_tpu.engine.sampling import SamplingParams
 from helix_tpu.obs.slo import ANON_TENANT, TENANT_HEADER, sanitize_tenant
+from helix_tpu.engine.adapters import (
+    ADAPTER_SEP,
+    MAX_LISTED_ADAPTERS,
+    collect_adapter_metrics,
+    sanitize_adapter_id,
+    split_model_adapter,
+)
 from helix_tpu.serving.sched import CLASS_HEADER, sanitize_class
 from helix_tpu.obs.trace import TRACE_HEADER
 from helix_tpu.serving.engine_loop import (
@@ -186,6 +193,10 @@ class OpenAIServer:
         app.router.add_get("/logs", self.tail_logs)
         app.router.add_post("/admin/prefetch", self.prefetch_model)
         app.router.add_get("/v1/models", self.list_models)
+        # multi-LoRA registry surface (ISSUE 15): publish a trained
+        # LoRA checkpoint for `model@adapter` serving — no restart, no
+        # hot-swap, no recompile (the pool shape compiled at warmup)
+        app.router.add_post("/v1/adapters", self.publish_adapter)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/embeddings", self.embeddings)
@@ -373,6 +384,10 @@ class OpenAIServer:
             # persistent filestore KV tier (ISSUE 14): minted ONLY by
             # serving/kv_filestore.py (lint contract 10)
             collect_filestore_kv(c, m.loop, lbl)
+            # continuous multi-LoRA serving (ISSUE 15): helix_adapter_*
+            # series are minted ONLY by engine/adapters.py (lint
+            # contract 11)
+            collect_adapter_metrics(c, m.loop, lbl)
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 st = pc.stats
@@ -1017,10 +1032,13 @@ class OpenAIServer:
         return web.json_response({"logs": self.logbuf.tail(n)})
 
     async def list_models(self, request):
-        return web.json_response(
-            {
-                "object": "list",
-                "data": [
+        def build():
+            # runs in an executor: AdapterStore.ids walks the
+            # filestore directory, which may be a slow/remote mount —
+            # never on the event loop
+            data = []
+            for m in self.registry.list():
+                data.append(
                     {
                         "id": m.name,
                         "object": "model",
@@ -1032,8 +1050,89 @@ class OpenAIServer:
                             else {}
                         ),
                     }
-                    for m in self.registry.list()
-                ],
+                )
+                # published multi-LoRA adapters (ISSUE 15): bounded
+                # `base@adapter` entries, addressable through the same
+                # chat/completions surface
+                store = getattr(
+                    getattr(getattr(m, "loop", None), "engine", None),
+                    "adapter_store", None,
+                )
+                if store is not None:
+                    for aid in store.ids(MAX_LISTED_ADAPTERS):
+                        data.append(
+                            {
+                                "id": f"{m.name}{ADAPTER_SEP}{aid}",
+                                "object": "model",
+                                "created": m.created,
+                                "owned_by": m.owned_by,
+                                "parent": m.name,
+                            }
+                        )
+            return data
+
+        data = await asyncio.get_running_loop().run_in_executor(
+            None, build
+        )
+        return web.json_response({"object": "list", "data": data})
+
+    async def publish_adapter(self, request):
+        """POST /v1/adapters (runner-token gated): publish a LoRA SFT
+        checkpoint for ``model@name`` serving.  Body: ``{"model":
+        base, "name": adapter_id, "checkpoint": dir[, "scale": f]}``.
+        The checkpoint restores off the event loop, is validated
+        against the base model's geometry, and lands on the residency
+        ladder (host tier + filestore write-through) — servable
+        immediately, warmup already covered the pool shape."""
+        denied = self._require_runner_token(request)
+        if denied is not None:
+            return denied
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        base = body.get("model", "")
+        adapter_id = sanitize_adapter_id(body.get("name", ""))
+        ckpt = body.get("checkpoint", "")
+        if not adapter_id:
+            return _error(
+                400,
+                "'name' must be a bounded [A-Za-z0-9._-] adapter id",
+            )
+        if not ckpt or not isinstance(ckpt, str):
+            return _error(400, "'checkpoint' directory is required")
+        served, err = await self._lookup(base)
+        if err is not None:
+            return err
+        eng = getattr(served.loop, "engine", None)
+        store = getattr(eng, "adapter_store", None)
+        if store is None:
+            return _error(
+                409,
+                f"model '{base}' serves without an adapter pool "
+                "(engine.adapter_pool_slots)",
+            )
+        scale = body.get("scale")
+        try:
+            spec = await asyncio.get_running_loop().run_in_executor(
+                None, store.publish_checkpoint, adapter_id, ckpt,
+                float(scale) if scale is not None else None,
+            )
+        except FileNotFoundError as e:
+            return _error(404, str(e))
+        except (ValueError, TypeError, KeyError) as e:
+            # KeyError: a valid orbax checkpoint that is not a LoRA
+            # checkpoint (no lora_params tree) — a caller error, not a
+            # server fault
+            return _error(400, f"adapter rejected: {e}")
+        return web.json_response(
+            {
+                "id": f"{base}{ADAPTER_SEP}{adapter_id}",
+                "object": "model",
+                "parent": base,
+                "rank": spec.rank,
+                "scale": spec.scale,
+                "bytes": spec.nbytes,
             }
         )
 
@@ -1055,6 +1154,66 @@ class OpenAIServer:
                 "model_not_found",
             )
         return served, None
+
+    async def _lookup_generation(self, model: str):
+        """Resolve a generation target, including ``base@adapter``
+        multi-LoRA addressing (ISSUE 15): the base model faults in
+        through the ordinary registry path, the adapter id is sanitised
+        and must be published on the engine's residency ladder (404
+        otherwise — a hostile id never reaches a metrics label or a
+        filestore path), and its filestore->host prefetch is kicked so
+        a cold adapter overlaps loading with everything that follows.
+        Returns ``(served, adapter_id, error_response)``."""
+        base, adapter, ok = split_model_adapter(model)
+        if ADAPTER_SEP in (model or "") and model:
+            # a model whose LITERAL registered name contains '@' keeps
+            # resolving by exact name — adapter addressing never breaks
+            # a pre-existing registration
+            lit = await asyncio.get_running_loop().run_in_executor(
+                None, self.registry.get, model
+            )
+            if lit is not None:
+                return lit, "", None
+        if not ok:
+            return None, "", _error(
+                404, f"model '{model}' not found (invalid adapter id)",
+                "model_not_found",
+            )
+        served, err = await self._lookup(base)
+        if err is not None:
+            return None, "", err
+        if adapter:
+            loop = served.loop
+            eng = getattr(loop, "engine", None)
+            pool = getattr(eng, "adapter_pool", None)
+            store = getattr(eng, "adapter_store", None)
+            if pool is None or store is None:
+                return None, "", _error(
+                    404,
+                    f"model '{base}' does not serve adapters "
+                    "(engine.adapter_pool_slots is off)",
+                    "model_not_found",
+                )
+            # contains and the 404's listing both touch the filestore
+            # directory — off the event loop (the mount may be remote);
+            # prefetch itself does no caller-thread I/O by contract
+            aio = asyncio.get_running_loop()
+            known = pool.resident(adapter) or await aio.run_in_executor(
+                None, store.contains, adapter
+            )
+            if not known:
+                available = await aio.run_in_executor(
+                    None, store.ids, MAX_LISTED_ADAPTERS
+                )
+                return None, "", _error(
+                    404,
+                    f"adapter '{adapter}' is not published for model "
+                    f"'{base}'; available: {available}",
+                    "model_not_found",
+                )
+            if not pool.resident(adapter):
+                store.prefetch(adapter)
+        return served, adapter, None
 
     @staticmethod
     def _require_loop(served, model: str):
@@ -1211,7 +1370,8 @@ class OpenAIServer:
     # ------------------------------------------------------------------
     async def _disagg_prefill(self, request, served, model, prompt_ids,
                               sampling, kind, http_id, created,
-                              trace_id, tenant, sched_class):
+                              trace_id, tenant, sched_class,
+                              adapter: str = ""):
         """Disaggregated prefill/decode handoff (ISSUE 14), runner side.
 
         Submits the request like an ordinary stream, but stages an
@@ -1262,6 +1422,7 @@ class OpenAIServer:
             trace_id=trace_id,
             tenant=tenant,
             sched_class=sched_class,
+            adapter=adapter,
         )
         if peer_addr:
             served.loop.stage_disagg_export(req.id, on_export)
@@ -1495,7 +1656,7 @@ class OpenAIServer:
         sclass = self._sched_class(request)
         t_req = time.monotonic()
         model = body.get("model", "")
-        served, err = await self._lookup(model)
+        served, adapter, err = await self._lookup_generation(model)
         if err is not None:
             return err
         if served.kind == "embedding":
@@ -1537,6 +1698,11 @@ class OpenAIServer:
             prompt_ids = served.tokenizer.apply_chat_template(
                 messages, add_generation_prompt=True
             )
+        if adapter:
+            # `model@adapter` requests ride the batched multi-LoRA
+            # path: the engine resolves the id to an HBM pool slot at
+            # admission (ISSUE 15)
+            extra = {**(extra or {}), "adapter": adapter}
         shed = self._precheck_admission(
             served, prompt_ids, trace_id=tid, tenant=tenant
         )
@@ -1558,14 +1724,19 @@ class OpenAIServer:
         if (
             request.headers.get(DISAGG_HEADER)
             and body.get("stream")
-            and extra is None
+            and not has_images
             and self._require_runner_token(request) is None
             and hasattr(served.loop, "stage_disagg_export")
         ):
+            # adapter requests hand off too: the snapshot carries the
+            # adapter id and the decode peer re-resolves it against ITS
+            # residency ladder (an unpublished adapter there is a typed
+            # import rejection -> the ordinary colocated fallback)
             return await self._disagg_prefill(
                 request, served, model, prompt_ids, sampling,
                 kind="chat", http_id=rid, created=created,
                 trace_id=tid, tenant=tenant, sched_class=sclass,
+                adapter=adapter,
             )
 
         if body.get("stream"):
@@ -1695,12 +1866,13 @@ class OpenAIServer:
         sclass = self._sched_class(request)
         t_req = time.monotonic()
         model = body.get("model", "")
-        served, err = await self._lookup(model)
+        served, adapter, err = await self._lookup_generation(model)
         if err is not None:
             return err
         err = self._require_loop(served, model)
         if err is not None:
             return err
+        extra = {"adapter": adapter} if adapter else None
         prompt = body.get("prompt", "")
         if isinstance(prompt, list):
             prompt = prompt[0] if prompt else ""
@@ -1731,6 +1903,7 @@ class OpenAIServer:
                 request, served, model, prompt_ids, sampling,
                 kind="completions", http_id=rid, created=created,
                 trace_id=tid, tenant=tenant, sched_class=sclass,
+                adapter=adapter,
             )
 
         if body.get("stream"):
@@ -1745,7 +1918,7 @@ class OpenAIServer:
             t_emit = None
             try:
               async for delta, tok, finished, reason in self._generate(
-                served, prompt_ids, sampling, trace_id=tid,
+                served, prompt_ids, sampling, extra, trace_id=tid,
                 tenant=tenant, sched_class=sclass,
               ):
                 if t_emit is None:
@@ -1780,7 +1953,7 @@ class OpenAIServer:
         t_emit = None
         try:
           async for delta, tok, finished, reason in self._generate(
-            served, prompt_ids, sampling, trace_id=tid,
+            served, prompt_ids, sampling, extra, trace_id=tid,
             tenant=tenant, sched_class=sclass,
           ):
             if t_emit is None:
@@ -1901,12 +2074,13 @@ class OpenAIServer:
         sclass = self._sched_class(request)
         t_req = time.monotonic()
         model = body.get("model", "")
-        served, err = await self._lookup(model)
+        served, adapter, err = await self._lookup_generation(model)
         if err is not None:
             return err
         err = self._require_loop(served, model)
         if err is not None:
             return err
+        extra = {"adapter": adapter} if adapter else None
         messages = list(body.get("messages", []))
         if body.get("system"):
             messages = [{"role": "system", "content": body["system"]}] + messages
@@ -1974,7 +2148,7 @@ class OpenAIServer:
             t_emit = None
             try:
               async for delta, tok, finished, reason in self._generate(
-                served, prompt_ids, sampling, trace_id=tid,
+                served, prompt_ids, sampling, extra, trace_id=tid,
                 tenant=tenant, sched_class=sclass,
               ):
                 if t_emit is None:
@@ -2027,7 +2201,7 @@ class OpenAIServer:
         t_emit = None
         try:
           async for delta, tok, finished, reason in self._generate(
-            served, prompt_ids, sampling, trace_id=tid,
+            served, prompt_ids, sampling, extra, trace_id=tid,
             tenant=tenant, sched_class=sclass,
           ):
             if t_emit is None:

@@ -9,6 +9,18 @@ and optimizer state — frozen base weights never touch AdamW moments), and
 they appear.  Works over int8-quantized base weights (QLoRA-style: frozen
 int8 base + bf16 adapters), which is how an SFT job shares a chip with
 serving.
+
+Serving paths for a trained adapter (ISSUE 15):
+
+- **Batched multi-LoRA pool** (``engine/adapters.py``, the production
+  path): publish the checkpoint and address ``model@adapter`` — many
+  adapters serve concurrently against ONE resident base model through a
+  stacked HBM pool, mixed-adapter waves pack one device call.
+- **Merge-at-apply fallback** (this module + the profile's
+  ``adapter:``/``adapter_scale:`` fields): ``merge_lora_into_params``
+  bakes ONE adapter into the served tree at profile-apply time — kept
+  for single-adapter deployments and as the numerical reference the
+  batched path is pinned against (equal at scale = alpha/rank).
 """
 
 from __future__ import annotations

@@ -335,7 +335,7 @@ class TestClusterFederation:
             "queue_depth": 1, "tokens_per_sec": 123.5,
             "prefix_hit_rate": 0.5, "spec_acceptance_ratio": 0.4,
             "kv_host_occupancy": 0.1, "preempted_requests": 0,
-            "prefill_budget_tokens": 0,
+            "prefill_budget_tokens": 0, "adapters_resident": 0,
         }
         sat.update(overrides)
         r = requests.post(

@@ -79,6 +79,16 @@ Contracts, enforced repo-wide (wired into tier-1 via
    control plane ``collect_cp_pools`` (the contracts 3-8 importer
    pattern).
 
+11. **One adapter vocabulary** (ISSUE 15): the continuous multi-LoRA
+   serving series — ``helix_adapter_*`` (HBM pool residency, loads/
+   evictions/load-seconds, host-tier occupancy, prefetches, bounded
+   per-adapter rows-applied) — are minted ONLY by
+   ``helix_tpu/engine/adapters.py``.  The runner's scrape surface must
+   keep calling ``collect_adapter_metrics``, the node agent must build
+   its heartbeat residency block via ``adapter_residency_summary``, and
+   the control plane must clamp runner-supplied blocks through
+   ``validate_adapter_block`` (the contracts 3-10 importer pattern).
+
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
 """
@@ -469,6 +479,55 @@ def _disagg_schema_violations(root: str) -> list:
     return violations
 
 
+# -- contract 11: one adapter vocabulary --------------------------------------
+# Continuous multi-LoRA serving (ISSUE 15): helix_adapter_* series are
+# minted only by engine/adapters.py; the runner scrape surface, the
+# node agent's heartbeat block and the control plane's heartbeat
+# validation all route through its helpers.
+_ADAPTER_NAME_RE = re.compile(r"""["']helix_adapter_[a-z0-9_]*["']""")
+# (file, required symbol): the importer pattern
+_ADAPTER_IMPORTERS = (
+    (
+        os.path.join("helix_tpu", "serving", "openai_api.py"),
+        "collect_adapter_metrics",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "node_agent.py"),
+        "adapter_residency_summary",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "server.py"),
+        "validate_adapter_block",
+    ),
+)
+
+
+def _is_adapters(path: str, root: str) -> bool:
+    rel = os.path.relpath(path, root)
+    return rel == os.path.join("helix_tpu", "engine", "adapters.py")
+
+
+def _adapter_schema_violations(root: str) -> list:
+    violations = []
+    mod = os.path.join(root, "helix_tpu", "engine", "adapters.py")
+    if not os.path.isfile(mod):
+        return [
+            "helix_tpu/engine/adapters.py: missing — the adapter "
+            "metric vocabulary must live there"
+        ]
+    for rel, symbol in _ADAPTER_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if symbol not in f.read():
+                violations.append(
+                    f"{rel}: does not call {symbol} from the adapter "
+                    "module (helix_tpu/engine/adapters.py)"
+                )
+    return violations
+
+
 # -- contract 7: one compiled step entry point -------------------------------
 # The unified ragged step is THE device-step builder; these existing
 # names are the only lru-cached ``_build_*`` functions allowed under
@@ -566,6 +625,7 @@ def run(root: str) -> list:
     violations += _step_builder_violations(root)
     violations += _routing_schema_violations(root)
     violations += _disagg_schema_violations(root)
+    violations += _adapter_schema_violations(root)
     violations += _host_sync_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
@@ -586,7 +646,14 @@ def run(root: str) -> list:
         route_emitter = _is_route(path, root)
         autoscale_emitter = _is_autoscale(path, root)
         kv_filestore_emitter = _is_kv_filestore(path, root)
+        adapter_emitter = _is_adapters(path, root)
         for i, line in enumerate(lines, 1):
+            if not adapter_emitter and _ADAPTER_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: helix_adapter_* metric family named "
+                    "outside helix_tpu/engine/adapters.py — adapter "
+                    "series must come from the residency module"
+                )
             if not migration_emitter and _XFER_NAME_RE.search(line):
                 violations.append(
                     f"{rel}:{i}: helix_xfer_* metric family named "
