@@ -222,8 +222,11 @@ ENV_REFERENCE: tuple = (
         "compiles once at warmup, so publishing an adapter later "
         "needs no restart or recompile), 0 forces it off even where a "
         "profile enables it. Unset: the profile setting applies "
-        "(default off). Not supported for mrope (VL) or multihost "
-        "lockstep engines.",
+        "(default off). Not supported for mrope (VL) engines; on "
+        "multi-host meshes the pool runs on every host (adapter ids "
+        "ride the step plan and followers stage residency before the "
+        "step), so publish adapters to the leader and followers as a "
+        "pair.",
         section="accelerator",
     ),
     EnvVar(
@@ -258,7 +261,10 @@ ENV_REFERENCE: tuple = (
         "missing blobs degrade to recompute with a typed counter "
         "(helix_filestore_kv_corrupt_total), never an error. Point it "
         "at a shared filesystem to share prefixes across runners. "
-        "Unset: tier off. Never armed for multihost lockstep engines.",
+        "Unset: tier off. Multi-host meshes arm it too: point the "
+        "leader and every follower at the SAME directory — the step "
+        "plan carries each admission's cached_tokens and followers "
+        "verify their restore matched the leader's.",
         section="accelerator",
     ),
     EnvVar(
@@ -661,6 +667,43 @@ ENV_REFERENCE: tuple = (
     EnvVar(
         "HELIX_BENCH_CHILD",
         "Internal: marks the CPU-fallback bench child process.",
+        section="accelerator",
+    ),
+    # -- multi-host (DCN) serving (serving/multihost_serving.py) ---------
+    EnvVar(
+        "HELIX_MH_DIGEST",
+        "Follower-side emission-digest verification mode for multi-host "
+        "plan-broadcast serving: 'strict' (default) treats a rolling "
+        "per-step digest mismatch against the leader's plans as lost "
+        "lockstep (the follower stops and surfaces the restart ladder), "
+        "'warn' logs and counts it (helix-side stats "
+        "digest_mismatches), 'off' skips the check.",
+        default="strict",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_RING",
+        "Capacity (records) of the leader's plan ring buffer. A "
+        "follower that falls more than this many records behind cannot "
+        "rejoin by replay and must restart from a profile re-apply; "
+        "bigger rings buy crash-recovery window at the cost of leader "
+        "memory (plans are compact JSON, typically <1 KiB/step).",
+        default="4096",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_BACKOFF_BASE",
+        "Base seconds of a follower's capped exponential backoff (with "
+        "jitter) between retries after a transient plan-feed error "
+        "(retry n sleeps ~min(base * 2^n, cap)); fatal conditions "
+        "(ring fall-behind, leader restart, divergence) never retry.",
+        default="0.05",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_BACKOFF_CAP",
+        "Cap seconds of the follower plan-feed retry backoff.",
+        default="5.0",
         section="accelerator",
     ),
     # -- multi-host (DCN) training ---------------------------------------

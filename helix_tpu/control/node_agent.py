@@ -269,11 +269,6 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         # the batched adapter path on, 0 forces it off even where a
         # profile enables it
         ekw["adapter_pool_slots"] = adapter_slots
-    if pm.multihost:
-        # lockstep engines never serve the batched adapter path:
-        # publish/residency are leader-local decisions the follower's
-        # replayed command stream would not see
-        ekw["adapter_pool_slots"] = 0
     async_env = _os_env.environ.get("HELIX_ASYNC_LOOP", "")
     if async_env:
         # operator-level async-engine-loop override for EVERY engine
@@ -299,12 +294,14 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     engine = Engine(model_cfg, params, ecfg, mesh=mesh)
     engine.warmup()   # compile prefill/decode before the model goes routable
     fs_dir = _os_env.environ.get("HELIX_FILESTORE_KV_DIR", "")
-    if fs_dir and not pm.multihost:
+    if fs_dir:
         # persistent filestore KV tier (ISSUE 14): the bottom rung of
         # the residency ladder — full prefix pages persist across
         # restarts (content-addressed, checksummed, tenant-quota'd).
-        # Lockstep engines never arm it: a local-disk read at admission
-        # would desync follower replay.
+        # Multihost hosts arm it too: the step plan carries each
+        # admission's cached_tokens and followers verify their restore
+        # matched, so point every host at the SAME filestore directory
+        # (the PR 14 cluster-wide tier) and disk hits stay in sync.
         from helix_tpu.serving.kv_filestore import filestore_for_engine
 
         engine.kv_filestore = filestore_for_engine(
@@ -312,13 +309,14 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         )
     role = pm.multihost.get("role", "")
     if role == "leader":
-        # journal the command stream for follower hosts (lockstep SPMD
-        # over DCN; serving/multihost_serving.py)
-        from helix_tpu.serving.multihost_serving import LockstepLeader
+        # broadcast one StepPlan per engine step for follower hosts
+        # (plan-driven SPMD over DCN; serving/multihost_serving.py)
+        from helix_tpu.serving.multihost_serving import PlanLeader
 
-        engine = LockstepLeader(engine)
+        engine = PlanLeader(engine)
     elif role == "follower":
-        # this host replays the leader's journal — no local HTTP traffic
+        # this host executes the leader's step plans — no local HTTP
+        # traffic, no local scheduler/drafter/clock
         from helix_tpu.serving.multihost_serving import (
             FollowerLoop,
             HTTPFeed,
@@ -519,9 +517,9 @@ class NodeAgent:
                     )
                     if flight is not None:
                         flight.reset_baseline()
-                # multi-host FOLLOWERS replay the leader's journal and
-                # take no HTTP traffic: keep them out of the routable
-                # model list the router feeds on
+                # multi-host FOLLOWERS execute the leader's step plans
+                # and take no HTTP traffic: keep them out of the
+                # routable model list the router feeds on
                 self.state.models = sorted(
                     name for name, pm in want.items()
                     if pm.multihost.get("role", "") != "follower"

@@ -1175,6 +1175,14 @@ class Engine:
         self.victim_policy: Optional[Callable[[list], list]] = None
         self.prefill_budget: Optional[int] = None
         self._budget_left: Optional[int] = None
+        # plan-broadcast hooks (serving/multihost_serving.py): a leader
+        # wraps step_dispatch with a PlanRecorder that captures host
+        # decisions (admits+cached_tokens, resumes, drafts, budget,
+        # queue pressure) as data; a follower steps under a PlanDrive
+        # that pins the same decisions to the leader's plan.  Both are
+        # duck-typed so the engine never imports the serving layer.
+        self._plan_recorder = None
+        self._plan_drive = None
         self._slot_count_overrides: dict[int, np.ndarray] = {}
         # deferred chunk-final first tokens (ISSUE 13): the final chunk's
         # sampled token stays on device — _sync_state patches the slot's
@@ -1541,8 +1549,8 @@ class Engine:
                 self.step_complete(pend, emitted)
             except Exception:
                 # roll the predicted-state advance back before the
-                # failure propagates: quarantine bisection and lockstep
-                # callers retry through this wrapper, and a retry
+                # failure propagates: quarantine bisection and plan
+                # followers retry through this wrapper, and a retry
                 # against mirrors claiming (position p+n, last_token at
                 # p-1) would silently skip/mis-condition n tokens
                 self.discard_pending(pend)
@@ -1566,6 +1574,11 @@ class Engine:
         # per-step prefill-admission budget (scheduler feedback loop):
         # refreshed every step; admission charges it in _try_claim
         self._budget_left = self.prefill_budget
+        if self._plan_drive is not None:
+            # follower: the budget is the leader's decision, not ours
+            self._budget_left = self._plan_drive.budget
+        elif self._plan_recorder is not None:
+            self._plan_recorder.budget = self._budget_left
         self._admit(emitted)
         if self._chunking is not None and self._chunking["req"].finished:
             self._chunking = None    # aborted mid-prefill
@@ -1861,6 +1874,22 @@ class Engine:
         self.adapter_store.prefetch(aid)
         return False
 
+    def ensure_adapter_resident(self, adapter_id: str) -> bool:
+        """Synchronously stage an adapter onto the host rung so the NEXT
+        admission/resume can pin it without deferring.  Plan followers
+        call this before stepping (the leader only broadcasts a request
+        once it actually admitted it, so the adapter must load NOW, not
+        via the async prefetch the leader's queue wait amortized)."""
+        if not adapter_id or self.adapter_pool is None:
+            return not adapter_id
+        if self.adapter_pool.resident(adapter_id):
+            return True
+        if self.adapter_store is None:
+            return False
+        if self.adapter_store.ready(adapter_id):
+            return True
+        return self.adapter_store.get(adapter_id) is not None
+
     def _acquire_adapter(self, req: Request) -> Optional[int]:
         """Pin the request's adapter into an HBM pool slot (idempotent
         per request — one ref held admission -> finish, parked requests
@@ -1997,6 +2026,22 @@ class Engine:
                 req, hashes, len(shared) + restored, pages
             )
         req.cached_tokens = (len(shared) + restored) * self.cache_cfg.page_size
+        if self._plan_recorder is not None:
+            # leader: this admission is final — broadcast the full
+            # request identity plus the cached_tokens the prefix /
+            # filestore rungs restored (followers verify, so a
+            # leader-local disk hit can never silently desync replay)
+            self._plan_recorder.note_admit(req)
+        if self._plan_drive is not None:
+            want = self._plan_drive.cached_tokens.get(req.id)
+            if want is not None and want != req.cached_tokens:
+                raise RuntimeError(
+                    f"plan-follow divergence: request {req.id} restored "
+                    f"{req.cached_tokens} cached prompt tokens locally "
+                    f"but the leader's plan recorded {want} — the "
+                    "prefix/filestore rungs drifted between hosts "
+                    "(point both hosts at the same filestore dir)"
+                )
         self.num_admitted += 1
         if self._budget_left is not None:
             # charge the uncached prefill work this admission injects
@@ -2790,7 +2835,17 @@ class Engine:
         if n_active <= self.cfg.adaptive_sync_max_streams:
             return 1   # interactive: stream per-token
         cap = n_max
-        if self.waiting:
+        # queue pressure as the device sees it.  A plan follower pins
+        # this bit to the leader's value: its own queue drains exactly
+        # at each plan boundary, so reading it locally would diverge
+        # from the leader's (non-empty) queue and change the fused
+        # window — a different compiled shape mid-collective.
+        blocked = bool(self.waiting)
+        if self._plan_drive is not None:
+            blocked = self._plan_drive.queue_blocked
+        elif self._plan_recorder is not None:
+            self._plan_recorder.queue_blocked = blocked
+        if blocked:
             # Admission already ran this step, so a non-empty queue means
             # admission is RESOURCE-blocked — forcing single steps would
             # not admit anything sooner, it would just re-impose the
@@ -3323,6 +3378,13 @@ class Engine:
                 self.preempted.pop(0)
                 self._discard_preempted(st)
                 continue
+            if self._plan_drive is not None:
+                # follower: resume exactly the requests the leader
+                # resumed, in plan order — local slot/page headroom may
+                # transiently differ mid-plan and must not decide
+                drv = self._plan_drive.resumes
+                if not drv or drv[0] != req.id:
+                    return
             free_slots = [
                 i for i, s in enumerate(self.slots) if s is None
             ]
@@ -3406,6 +3468,10 @@ class Engine:
             self._state_dirty = True
             self._changed_slots.add(slot)
             self.num_resumes += 1
+            if self._plan_recorder is not None:
+                self._plan_recorder.resumes.append(req.id)
+            if self._plan_drive is not None:
+                self._plan_drive.resumes.pop(0)
             self.restore_seconds += time.monotonic() - t0
             self.preempted.pop(0)
             logging.getLogger(__name__).info(
@@ -3488,6 +3554,17 @@ class Engine:
         table_cap = self.cache_cfg.max_pages_per_seq * ps
         drafts = np.zeros((B, width - 1), np.int32)
         draft_len = np.zeros((B,), np.int32)
+        if self._plan_drive is not None:
+            # follower: drafts are DATA from the leader's plan — the
+            # local drafter (whose n-gram history and EMA gating are
+            # host state) never runs, so the verify call is built from
+            # the exact tokens the leader verified
+            for slot, toks in self._plan_drive.drafts:
+                drafts[slot, : len(toks)] = toks
+                draft_len[slot] = len(toks)
+            if not draft_len.any():
+                return None
+            return self._spec_dispatch_tail(drafts, draft_len)
         for i, req in enumerate(self.slots):
             if req is None or not self._slot_active(i):
                 continue
@@ -3535,6 +3612,16 @@ class Engine:
             draft_len[i] = len(toks)
         if not draft_len.any():
             return None
+        if self._plan_recorder is not None:
+            self._plan_recorder.drafts = [
+                (i, [int(t) for t in drafts[i, : int(draft_len[i])]])
+                for i in range(B) if draft_len[i] > 0
+            ]
+        return self._spec_dispatch_tail(drafts, draft_len)
+
+    def _spec_dispatch_tail(self, drafts, draft_len) -> PendingStep:
+        """The device half of a spec step: identical for a leader's
+        host-drafted tokens and a follower's plan-carried ones."""
         rows = [
             (i, r) for i, r in enumerate(self.slots)
             if r is not None and self._slot_active(i)

@@ -92,6 +92,16 @@ def compiled_step_shapes(model_key) -> int:
         return len(_SHAPES.get(model_key, ()))
 
 
+def step_shape_set(model_key) -> frozenset:
+    """Snapshot of the distinct compiled step shapes for a model key.
+    The multihost parity tests diff this across a leader run and a
+    follower replay: a plan-driven follower must trace ZERO shapes of
+    its own (same model key -> same registry entry, so the assertion is
+    'no new members after replay')."""
+    with _SHAPES_LOCK:
+        return frozenset(_SHAPES.get(model_key, ()))
+
+
 @dataclasses.dataclass
 class PrefillRow:
     req: object                 # engine.Request (None for warmup rows)

@@ -230,18 +230,10 @@ class EngineLoop:
         # the scheduler (ISSUE 9, serving/sched.py): owns every
         # ordering / per-tenant-bound / victim decision.  The FIFO
         # baseline (no sched_config, or policy: fifo) preserves the
-        # pre-scheduler semantics exactly.  Lockstep engines keep the
-        # scheduler inert: reordering, per-step budgets and policy
-        # preemption are leader-local decisions the follower's replayed
-        # command stream would never see.
+        # pre-scheduler semantics exactly.  Multihost leaders run the
+        # scheduler like any engine: its decisions (budget, victim
+        # order, admission order) replicate as step-plan data.
         self.sched = make_scheduler(sched_config)
-        if self.sched.active and hasattr(engine, "journal"):
-            # downgrade to the FIFO baseline outright (not just a
-            # disabled flag): metrics/stats must never claim a policy
-            # this loop will not run
-            from helix_tpu.serving.sched import FifoScheduler
-
-            self.sched = FifoScheduler(self.sched.cfg)
         self._sched_active = self.sched.active
         # per-tenant inbox depth (admission lock); the per-tenant bound
         # adds the engine-side wait-queue count on demand
@@ -249,16 +241,15 @@ class EngineLoop:
         # asynchronous pipelined loop (ISSUE 13): dispatch step N+1
         # against predicted post-step state while step N executes, and
         # emit through the bounded off-thread stage.  Requires the
-        # dispatch/complete engine split; lockstep leaders (journaled
-        # command stream) stay synchronous — a leader-local reorder of
-        # dispatch vs fetch would not desync the follower, but the
-        # journal step cadence is the replay contract, so don't touch it.
+        # dispatch/complete engine split.  Multihost leaders pipeline
+        # too: plan N+1 publishes at dispatch, so the broadcast rides
+        # the same overlap and followers apply it while device step N
+        # completes.
         self.async_enabled = (
             bool(getattr(
                 getattr(engine, "cfg", None), "enable_async_loop", False
             ))
             and hasattr(engine, "step_dispatch")
-            and not hasattr(engine, "journal")
         )
         self.pipelined_steps = 0    # steps dispatched while one was in flight
         self._emit_stage = _EmissionStage(
@@ -656,11 +647,6 @@ class EngineLoop:
         left for the ``_fail_all`` that follows."""
         self._emit_stage.flush()   # no error frame may overtake tokens
         if self.exporter is None:
-            return 0
-        if getattr(self.engine, "export_request", None) is None:
-            # lockstep leaders (journaled command stream) have no
-            # export path — a leader-local export would desync the
-            # follower's replay; degrade to the ordinary shed
             return 0
         from helix_tpu.serving.migration import (
             migrated_error,
@@ -1229,22 +1215,19 @@ class EngineLoop:
             inj.maybe_fail_step(self.name, self.steps, ids)
 
     def _step_once(self):
-        """One full synchronous engine step (quarantine bisection and
-        lockstep leaders use this — no pipelining)."""
+        """One full synchronous engine step (quarantine bisection uses
+        this — no pipelining)."""
         self._fault_gate()
         return self.engine.step()
 
     def _dispatch_once(self):
-        """Host phase of one engine step.  Lockstep leaders must run
-        their monolithic journaling ``step()`` — their ``__getattr__``
-        forwards ``step_dispatch`` to the INNER engine, which would step
-        correctly but publish nothing to the follower journal — as must
-        any engine without the dispatch/complete split.  Both return no
-        pending, so the loop behaves exactly synchronously."""
+        """Host phase of one engine step.  An engine without the
+        dispatch/complete split runs its monolithic ``step()`` and
+        returns no pending, so the loop behaves exactly synchronously.
+        Multihost leaders implement the split themselves (publishing the
+        step plan at dispatch), so they pipeline like any engine."""
         self._fault_gate()
-        if hasattr(self.engine, "journal") or not hasattr(
-            self.engine, "step_dispatch"
-        ):
+        if not hasattr(self.engine, "step_dispatch"):
             return self.engine.step(), None
         return self.engine.step_dispatch()
 

@@ -1,32 +1,59 @@
-"""Multi-host serving: lockstep SPMD engines over a DCN command log.
+"""Multi-host serving: plan-broadcast SPMD engines over a DCN feed.
 
-SURVEY §2.2/§7 puts inter-slice DCN in the engine's court; round 2
-covered multi-host *training* only (VERDICT missing #5: "no multi-host
-serving").  In JAX's multi-controller model every process must issue the
-SAME jit calls in the same order for collectives over a cross-host mesh
-to line up.  Serving has dynamic admission, so this module makes the
-call sequence deterministic by construction:
+SURVEY §2.2/§7 puts inter-slice DCN in the engine's court.  In JAX's
+multi-controller model every process must issue the SAME jit calls in
+the same order for collectives over a cross-host mesh to line up.
+Serving has dynamic admission, so this module makes the call sequence
+deterministic by construction — but unlike the original command-replay
+journal (which made followers re-derive every host decision and
+therefore pinned off every feature whose host state could drift), the
+contract is now a **per-step plan broadcast**:
 
-- the **leader** (process 0) takes HTTP traffic; every mutation
-  (admit/abort, incl. reaper aborts) is journaled; each engine step
-  publishes one sequenced record {admits, aborts, step} BEFORE the step
-  runs;
-- **followers** replay the journal: apply the same admissions (explicit
-  seeds pinned by the leader, so sampling is bit-identical), then call
-  ``engine.step()`` — the identical jit sequence on their shards of the
-  global mesh.  Their emitted tokens are discarded; only the leader
-  streams to clients.
+- the **leader** (process 0) takes HTTP traffic and runs the full host
+  stack — admission, WFQ reorder, spec drafting, preemption-by-swap,
+  prefix/filestore restoration, the async pipelined loop.  Its
+  ``step_dispatch`` finalizes everything the device call needs; a
+  ``PlanRecorder`` captures those decisions as *data* (admitted request
+  docs with ``cached_tokens``, resume order, draft tokens, the prefill
+  budget, the queue-pressure bit) and publishes ONE versioned
+  ``StepPlan`` record per step; abort/preempt publish immediately as
+  standalone ``ops`` records in arrival order;
+- **followers** are pure device executors: ``FollowerLoop`` decodes a
+  plan and drives the *same* engine step through a ``PlanDrive`` that
+  pins every host decision to the leader's values.  No follower-side
+  admission queue, scheduler, drafter, or clock participates — the
+  follower's compiled step shapes are the leader's by construction.
+
+Because plans pin decisions rather than forbidding them, the features
+the old journal disabled are all live on meshes: spec decode (drafts
+ride the plan), the adapter pool (followers stage residency before the
+step), WFQ (budget + victim order are leader-decided data), preemption
+(``ops`` records replay the swap in arrival order), the async pipeline
+(plan N+1 publishes while device step N completes), and filestore
+prefix hits (the plan carries ``cached_tokens``; point both hosts at
+the same filestore dir and the drive verifies the restore matched).
+
+Emission digests (rolling blake2s over per-step (request, token)
+emissions, aborted requests excluded over a one-plan window to absorb
+abort-arrival skew) let a follower detect silent divergence; the
+``HELIX_MH_DIGEST`` knob picks strict/warn/off.
 
 Transport is pluggable: in-process ``CommandLog`` (tests, and the ring
 buffer the leader serves), or ``HTTPFeed`` (follower long-polls the
-leader's ``/multihost/commands`` route over DCN).
+leader's ``/multihost/commands`` route over DCN with a pooled session).
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
 import itertools
+import json
 import logging
+import os
+import random
+import struct
 import threading
 import time
 from typing import Optional
@@ -36,13 +63,38 @@ from helix_tpu.engine.sampling import SamplingParams
 
 log = logging.getLogger("helix.mh-serving")
 
+#: Plan/request wire format version.  v1 was the command-replay journal
+#: ({admits, aborts, step} records whose request docs dropped tenant /
+#: sched_class / adapter / max_len); v2 is the step-plan broadcast.
+#: Mixed-version clusters are rejected typed, never misparsed.
+WIRE_VERSION = 2
+
+_DIGEST_SEED = b"\x00" * 16
+
+
+class LagError(RuntimeError):
+    """Follower fell off the ring (or ahead of it — leader restart)."""
+
+
+class WireVersionError(ValueError):
+    """Record from a different wire version; upgrade hosts together."""
+
+
+class DivergenceError(RuntimeError):
+    """Replica state no longer matches the leader's plan — lockstep lost."""
+
 
 class CommandLog:
-    """Sequenced ring buffer with blocking reads (the leader's journal)."""
+    """Sequenced ring buffer with blocking reads (the leader's journal).
+
+    The ring is a ``collections.deque``: overflow past capacity is an
+    O(1) ``popleft`` per dropped record, not an O(n) list re-slice per
+    publish (which made sustained publish throughput quadratic once the
+    ring was full)."""
 
     def __init__(self, capacity: int = 4096):
         self.capacity = capacity
-        self._records: list = []          # [(seq, record)]
+        self._records: collections.deque = collections.deque()
         self._first = 1
         self._next = 1
         self._cond = threading.Condition()
@@ -52,10 +104,9 @@ class CommandLog:
             seq = self._next
             self._next += 1
             self._records.append({**record, "seq": seq})
-            if len(self._records) > self.capacity:
-                dropped = len(self._records) - self.capacity
-                self._records = self._records[dropped:]
-                self._first += dropped
+            while len(self._records) > self.capacity:
+                self._records.popleft()
+                self._first += 1
             self._cond.notify_all()
             return seq
 
@@ -79,7 +130,8 @@ class CommandLog:
                         f"follower at seq {since} is ahead of the "
                         f"journal (next: {self._next}) — leader restart?"
                     )
-                out = [r for r in self._records if r["seq"] > since]
+                skip = max(0, since + 1 - self._first)
+                out = list(itertools.islice(self._records, skip, None))
                 if out:
                     return out
                 remaining = deadline - time.monotonic()
@@ -88,47 +140,192 @@ class CommandLog:
                 self._cond.wait(remaining)
 
 
-class LagError(RuntimeError):
-    pass
-
-
 def request_to_wire(req: Request) -> dict:
     if req.image_embeds is not None:
         raise ValueError(
             "multi-host serving covers text models (VL image embeds are "
-            "device-resident and not journalled)"
+            "device-resident and not broadcast)"
         )
     return {
+        "v": WIRE_VERSION,
         "id": req.id,
         "prompt_tokens": list(req.prompt_tokens),
         "sampling": dataclasses.asdict(req.sampling),
         "stop_token_ids": list(req.stop_token_ids),
+        "tenant": req.tenant,
+        "sched_class": req.sched_class,
+        "adapter": req.adapter,
+        "max_len": req.max_len,
+        "trace_id": req.trace_id,
     }
 
 
 def request_from_wire(doc: dict) -> Request:
+    v = doc.get("v")
+    if v != WIRE_VERSION:
+        raise WireVersionError(
+            f"request wire record version {v!r} (this host speaks "
+            f"{WIRE_VERSION}); v1 records dropped tenant/sched_class/"
+            "adapter/max_len and are rejected rather than misparsed — "
+            "upgrade the leader and followers together"
+        )
     return Request(
         id=doc["id"],
         prompt_tokens=list(doc["prompt_tokens"]),
         sampling=SamplingParams(**doc["sampling"]),
         stop_token_ids=tuple(doc["stop_token_ids"]),
+        tenant=doc["tenant"],
+        sched_class=doc["sched_class"],
+        adapter=doc["adapter"],
+        max_len=doc["max_len"],
+        trace_id=doc.get("trace_id", ""),
     )
 
 
-class LockstepLeader:
-    """Engine wrapper for the leader: journals every mutation and emits
-    one record per step.  Duck-types the Engine surface EngineLoop uses
-    (add_request / abort / step / has_work / validate_request /
-    reap_stuck / slots / waiting / recent_ttfts ...)."""
+class PlanRecorder:
+    """Captures the leader engine's per-dispatch host decisions as data.
+
+    The engine duck-types this via ``self._plan_recorder`` (set around
+    ``step_dispatch`` by :class:`PlanLeader`): admission claims call
+    ``note_admit`` after ``cached_tokens`` is final, resumes append the
+    resumed request id, spec drafting stores the drafted tokens per
+    slot, and the dispatch prologue stores the prefill budget and the
+    queue-pressure bit that pins the decode window."""
+
+    __slots__ = ("admits", "resumes", "drafts", "budget", "queue_blocked")
+
+    def __init__(self):
+        self.admits: list = []
+        self.resumes: list = []
+        self.drafts: list = []
+        self.budget = None
+        self.queue_blocked = False
+
+    def note_admit(self, req: Request) -> None:
+        doc = request_to_wire(req)
+        doc["cached_tokens"] = int(req.cached_tokens)
+        self.admits.append(doc)
+
+
+class PlanDrive:
+    """Pins a follower engine's host decisions to the leader's plan.
+
+    The engine duck-types this via ``self._plan_drive`` (set around
+    ``step()`` by :class:`FollowerLoop`): the prefill budget and the
+    queue-pressure bit are overridden, spec drafting consumes the
+    plan's draft tokens verbatim instead of running the host drafter,
+    resumes happen exactly in plan order, and each admission claim
+    verifies its locally-restored ``cached_tokens`` against the
+    leader's value (a mismatch means the prefix/filestore rungs drifted
+    between hosts and the device steps would desync)."""
+
+    __slots__ = ("budget", "queue_blocked", "drafts", "resumes",
+                 "cached_tokens")
+
+    def __init__(self, budget, queue_blocked, drafts, resumes,
+                 cached_tokens):
+        self.budget = budget
+        self.queue_blocked = bool(queue_blocked)
+        self.drafts = drafts
+        self.resumes = resumes
+        self.cached_tokens = cached_tokens
+
+
+def _fold_digest(prev: bytes, step_idx: int, emissions, excluded) -> bytes:
+    """Roll the emission digest forward over one step.
+
+    Emissions are folded sorted (order within a step is host-side
+    bookkeeping, not model output) and requests in ``excluded`` —
+    aborted in this plan or the next — are skipped: an abort lands on
+    the leader at arrival but on followers at the next plan boundary,
+    so tail emissions of an aborted request legitimately differ over a
+    one-plan window."""
+    h = hashlib.blake2s(prev)
+    h.update(struct.pack("<q", step_idx))
+    for rid, tok in sorted(emissions):
+        if rid in excluded:
+            continue
+        b = rid.encode("utf-8", "surrogatepass")
+        h.update(struct.pack("<I", len(b)))
+        h.update(b)
+        h.update(struct.pack("<q", int(tok)))
+    return h.digest()[:16]
+
+
+class PlanLeader:
+    """Engine wrapper for the leader: broadcasts one StepPlan per step.
+
+    Duck-types the Engine surface EngineLoop uses (add_request / abort /
+    step / step_dispatch / step_complete / pipeline_ready /
+    discard_pending / has_work / validate_request / reap_stuck / slots /
+    waiting / recent_ttfts ...).  Unlike the old command-replay journal
+    it does NOT disable anything: preemption, spec decode, adapters,
+    WFQ, the async pipeline, filestore prefix hits, and drain-time
+    snapshot export all run on the leader and replicate as plan data.
+    """
 
     def __init__(self, engine, journal: Optional[CommandLog] = None):
         self.engine = engine
-        self.journal = journal or CommandLog()
-        self._pending_admits: list = []
-        self._pending_aborts: list = []
+        if journal is None:
+            cap = int(os.environ.get("HELIX_MH_RING", "4096") or 4096)
+            journal = CommandLog(capacity=cap)
+        self.journal = journal
         self._seed_counter = itertools.count(0x5EED)
+        # serializes abort/preempt arrival against plan assembly: ops
+        # publish IMMEDIATELY in arrival order, so the stream position
+        # of an op relative to the surrounding plans is exactly the
+        # order the leader's engine saw it
+        self._mu = threading.Lock()
+        self._carry_admits: list = []     # re-carried from a failed plan
+        self._carry_resumes: list = []
+        self._carry_emissions: list = []
+        self._step_counter = 0
+        self._last_plan_idx = -1
+        self._dispatch_steps: dict = {}   # id(pend) -> plan idx
+        self._plan_content: dict = {}     # plan idx -> (admits, resumes)
+        self._emissions: dict = {}        # plan idx -> [(rid, tok)]
+        self._done_steps: set = set()
+        # plan idx -> rids aborted between that plan and the next one
+        # (the digest-exclusion window: those aborts race the step's
+        # completion on the leader but land post-step on followers)
+        self._aborts_after_plan: dict = {}
+        self._fold_next = 0
+        self._digest = _DIGEST_SEED
+        self._digest_step: Optional[int] = None
+        self._digest_reset_pending = False
+        # surfaced by bench.py and /admin stats
+        self.plans_published = 0
+        self.plan_bytes_total = 0
+        self.plan_bytes_max = 0
 
-    # -- mutations (journalled) --------------------------------------------
+    # -- attributes EngineLoop SETS on its engine must reach the real
+    # engine (a plain __getattr__ passthrough would shadow them here and
+    # silently break WFQ fair-share charging and victim ordering) ------
+    @property
+    def prefill_budget(self):
+        return self.engine.prefill_budget
+
+    @prefill_budget.setter
+    def prefill_budget(self, value):
+        self.engine.prefill_budget = value
+
+    @property
+    def on_admit(self):
+        return self.engine.on_admit
+
+    @on_admit.setter
+    def on_admit(self, value):
+        self.engine.on_admit = value
+
+    @property
+    def victim_policy(self):
+        return self.engine.victim_policy
+
+    @victim_policy.setter
+    def victim_policy(self, value):
+        self.engine.victim_policy = value
+
+    # -- mutations ----------------------------------------------------------
     def add_request(self, req: Request) -> None:
         if req.sampling.seed is None:
             # pin a seed so follower sampling is bit-identical without
@@ -136,55 +333,197 @@ class LockstepLeader:
             req.sampling = dataclasses.replace(
                 req.sampling, seed=next(self._seed_counter)
             )
-        self._pending_admits.append(request_to_wire(req))
+        # validate wire-encodability up front (VL rejects here, not at
+        # admission time deep inside a step)
+        request_to_wire(req)
         self.engine.add_request(req)
 
+    def _publish_op(self, op: str, rid: str) -> None:
+        # ops records publish at arrival (not at the next dispatch):
+        # an abort with no step behind it must still reach followers,
+        # or they keep a zombie request parked forever
+        self.journal.publish(
+            {"v": WIRE_VERSION, "kind": "ops", "ops": [[op, rid]]}
+        )
+        if op == "abort":
+            self._aborts_after_plan.setdefault(
+                self._last_plan_idx, set()
+            ).add(rid)
+
     def abort(self, request_id: str) -> None:
-        self._pending_aborts.append(request_id)
-        self.engine.abort(request_id)
+        with self._mu:
+            self.engine.abort(request_id)
+            self._publish_op("abort", request_id)
+
+    def preempt(self, request_id: str) -> bool:
+        with self._mu:
+            ok = self.engine.preempt(request_id)
+            if ok:
+                self._publish_op("preempt", request_id)
+            return ok
+
+    def preempt_for_pressure(self) -> Optional[str]:
+        with self._mu:
+            rid = self.engine.preempt_for_pressure()
+            if rid is not None:
+                self._publish_op("preempt", rid)
+            return rid
+
+    # snapshot IMPORT and the disaggregated prefill handoff (ISSUE
+    # 11/14) would create device state that exists only on the leader —
+    # a migrated-in request has no admission plan row followers could
+    # replay, so its later resume would diverge.  Export stays live
+    # (drain-time snapshots are leader-owned; the shipped request's
+    # abort rides the next plan like any abort).
+    import_request = None
+    export_prefill = None
 
     def reap_stuck(self, max_queue_seconds: float) -> list:
-        reaped = self.engine.reap_stuck(max_queue_seconds)
-        # time-based decisions MUST replicate as explicit aborts — the
-        # followers' clocks play no part in the call sequence
-        for req in reaped:
-            self._pending_aborts.append(req.id)
-        return reaped
+        # the reaper scans the waiting queue only, and waiting requests
+        # are never broadcast (only ADMITTED requests ride plans) — so a
+        # reap needs no wire record at all: followers never knew the
+        # request existed
+        return self.engine.reap_stuck(max_queue_seconds)
+
+    # -- the step plan ------------------------------------------------------
+    def step_dispatch(self):
+        eng = self.engine
+        with self._mu:
+            carry_admits, self._carry_admits = self._carry_admits, []
+            carry_resumes, self._carry_resumes = self._carry_resumes, []
+            carry_ems, self._carry_emissions = self._carry_emissions, []
+            step_idx = self._step_counter
+            self._step_counter += 1
+            rec = PlanRecorder()
+            eng._plan_recorder = rec
+            try:
+                emitted, pend = eng.step_dispatch()
+            except Exception:
+                # dispatch failed part-way: admissions recorded before
+                # the failure already mutated engine state and MUST
+                # still reach followers — re-carry them into the retry's
+                # plan, reuse the index, and restart the digest chain
+                # (emission attribution across the failure is not
+                # reconstructible)
+                self._carry_admits = carry_admits + rec.admits
+                self._carry_resumes = carry_resumes + rec.resumes
+                self._carry_emissions = carry_ems
+                self._step_counter = step_idx
+                self._reset_digest_chain()
+                raise
+            finally:
+                eng._plan_recorder = None
+            admits = carry_admits + rec.admits
+            resumes = carry_resumes + rec.resumes
+            self._advance_digest(step_idx)
+            record = {
+                "v": WIRE_VERSION,
+                "kind": "plan",
+                "step": step_idx,
+                "admits": admits,
+                "resumes": resumes,
+                "budget": rec.budget,
+                "queue_blocked": rec.queue_blocked,
+                "drafts": rec.drafts,
+                "digest_step": self._digest_step,
+                "digest": (self._digest.hex()
+                           if self._digest_step is not None else None),
+            }
+            if self._digest_reset_pending:
+                record["digest_reset"] = True
+                self._digest_reset_pending = False
+            self.journal.publish(record)
+            self._last_plan_idx = step_idx
+            self.plans_published += 1
+            nbytes = len(json.dumps(record, separators=(",", ":")))
+            self.plan_bytes_total += nbytes
+            self.plan_bytes_max = max(self.plan_bytes_max, nbytes)
+            ems = carry_ems + [(r.id, int(t)) for r, t in emitted]
+            self._emissions[step_idx] = ems
+            if pend is None:
+                self._done_steps.add(step_idx)
+            else:
+                self._dispatch_steps[id(pend)] = step_idx
+                self._plan_content[step_idx] = (admits, resumes)
+            return emitted, pend
+
+    def step_complete(self, pend, emitted=None):
+        base = len(emitted) if emitted is not None else 0
+        out = self.engine.step_complete(pend, emitted)
+        with self._mu:
+            idx = self._dispatch_steps.pop(id(pend), None)
+            if idx is not None:
+                self._emissions.setdefault(idx, []).extend(
+                    (r.id, int(t)) for r, t in out[base:]
+                )
+                self._done_steps.add(idx)
+                self._plan_content.pop(idx, None)
+        return out
 
     def step(self):
-        self.journal.publish(
-            {
-                "admits": self._pending_admits,
-                "aborts": self._pending_aborts,
-                "step": True,
-            }
-        )
-        self._pending_admits = []
-        self._pending_aborts = []
-        return self.engine.step()
+        emitted, pend = self.step_dispatch()
+        if pend is None:
+            return emitted
+        try:
+            return self.step_complete(pend, emitted)
+        except Exception:
+            self.discard_pending(pend)
+            raise
 
-    def preempt_for_pressure(self):
-        """Preemption-by-swap is a leader-LOCAL scheduling move the
-        journal does not replicate: followers would keep decoding the
-        parked victim and their per-step emissions would diverge from
-        the leader's.  Disabled under lockstep — the degradation ladder
-        falls through to the typed kv_exhausted shed (which replicates
-        as an explicit abort)."""
-        return None
+    def discard_pending(self, pend) -> None:
+        self.engine.discard_pending(pend)
+        with self._mu:
+            idx = self._dispatch_steps.pop(id(pend), None)
+            if idx is None:
+                return
+            # the published plan never ran to completion on the leader.
+            # Publish a discard marker so a replaying/rejoining follower
+            # skips the dead plan; its host effects (admissions and
+            # resumes survive the positional rollback) are re-carried
+            # into the retry's plan.  A live follower that already
+            # executed the plan treats the marker as lost lockstep and
+            # restarts — on a real cross-host mesh the failed collective
+            # has desynced the slice anyway, so the restart ladder is
+            # the honest recovery path.
+            admits, resumes = self._plan_content.pop(idx)
+            self._carry_admits = admits + self._carry_admits
+            self._carry_resumes = resumes + self._carry_resumes
+            self._carry_emissions = (
+                self._emissions.pop(idx, []) + self._carry_emissions
+            )
+            self._done_steps.discard(idx)
+            self.journal.publish(
+                {"v": WIRE_VERSION, "kind": "discard", "step": idx}
+            )
+            self._reset_digest_chain()
 
-    # snapshot export/import (ISSUE 11) are leader-local state moves the
-    # journal cannot express — a migrated-away request would keep
-    # decoding on followers, a migrated-in one would exist only on the
-    # leader.  Absent attributes make the engine loop's drain exporter
-    # degrade to the ordinary shed (and imports fail typed).
-    export_request = None
-    import_request = None
-    # the disaggregated prefill handoff (ISSUE 14) is the same
-    # leader-local state move — pinned off for the same reason
-    export_prefill = None
-    # the filestore KV tier reads local disk at admission, which would
-    # desync follower replay (cached_tokens diverge) — never armed here
-    kv_filestore = None
+    def _reset_digest_chain(self) -> None:
+        self._digest = _DIGEST_SEED
+        self._digest_step = None
+        self._digest_reset_pending = True
+        self._emissions.clear()
+        self._done_steps.clear()
+        self._aborts_after_plan.clear()
+        self._fold_next = self._step_counter
+
+    def _advance_digest(self, plan_idx: int) -> None:
+        # digest(M) folds step M's emissions minus requests aborted in
+        # the stream window between plan M and plan M+1: those aborts
+        # race step M's completion on the leader (the engine skips a
+        # freed slot's emission at reconcile) but land post-step on
+        # followers, so both sides exclude them.  Folding M therefore
+        # waits until plan M+1 is being published, when the window has
+        # closed.
+        while self._fold_next < plan_idx:
+            m = self._fold_next
+            if m not in self._done_steps:
+                break
+            excl = self._aborts_after_plan.pop(m, set())
+            ems = self._emissions.pop(m, [])
+            self._digest = _fold_digest(self._digest, m, ems, excl)
+            self._digest_step = m
+            self._done_steps.discard(m)
+            self._fold_next += 1
 
     # -- passthrough --------------------------------------------------------
     def __getattr__(self, name):
@@ -192,7 +531,7 @@ class LockstepLeader:
 
 
 class FollowerLoop:
-    """Replays the leader's journal against this host's engine replica.
+    """Drives this host's engine replica from the leader's plan feed.
 
     Recovery posture (round-3 verdict weak #7 — the failure paths need
     drills, not just detection):
@@ -201,13 +540,17 @@ class FollowerLoop:
       fresh engine replica and replay from seq 0 — as long as the ring
       still retains the journal head, replay reconstructs bit-identical
       engine state (``test_multihost_serving.TestFailureDrills``).  The
-      engine is deterministic given the command sequence, so rejoining is
+      engine is deterministic given the plan sequence, so rejoining is
       a pure function of the ring.
-    - **Fell off the ring / leader restarted**: fatal for lockstep.  The
-      loop stops, ``error`` carries an operator-actionable message, and
-      ``on_lost_lockstep(error)`` fires so the node agent can surface it
-      (restart the serving process; it will resync by replaying the ring,
-      or from the profile re-apply if the ring head is gone).
+    - **Fell off the ring / leader restarted / divergence detected**:
+      fatal for lockstep.  The loop stops, ``error`` carries an
+      operator-actionable message, and ``on_lost_lockstep(error)`` fires
+      so the node agent can surface it (restart the serving process; it
+      will resync by replaying the ring, or from the profile re-apply
+      if the ring head is gone).
+    - **Transient feed errors** retry with capped exponential backoff +
+      jitter (``HELIX_MH_BACKOFF_BASE``/``HELIX_MH_BACKOFF_CAP``);
+      counters are surfaced in :meth:`stats`.
     """
 
     def __init__(self, engine, feed, poll_timeout: float = 5.0,
@@ -221,51 +564,247 @@ class FollowerLoop:
         self._thread: Optional[threading.Thread] = None
         self.error: Optional[str] = None
         self.on_lost_lockstep = on_lost_lockstep
+        self.digest_mode = (
+            os.environ.get("HELIX_MH_DIGEST", "strict").strip().lower()
+            or "strict"
+        )
+        self.backoff_base = float(
+            os.environ.get("HELIX_MH_BACKOFF_BASE", "0.05") or 0.05
+        )
+        self.backoff_cap = float(
+            os.environ.get("HELIX_MH_BACKOFF_CAP", "5.0") or 5.0
+        )
+        self._skip: set = set()            # plan idxs discarded by the leader
+        self._applied_step = -1
+        self._prev = None                  # (step idx, emissions)
+        # plan idx -> rids aborted by ops records seen after that plan;
+        # mirrors the leader's digest-exclusion window by stream position
+        self._aborts_after_plan: dict = {}
+        self._digest = _DIGEST_SEED
+        self._digest_by_step: collections.OrderedDict = (
+            collections.OrderedDict()
+        )
+        # counters (stats())
+        self.plans_applied = 0
+        self.plans_skipped = 0
+        self.feed_errors = 0
+        self.backoff_seconds_total = 0.0
+        self.digest_checks = 0
+        self.digest_mismatches = 0
 
+    # -- plan application ---------------------------------------------------
     def apply(self, record: dict) -> None:
+        v = record.get("v")
+        if v != WIRE_VERSION:
+            raise WireVersionError(
+                f"plan record version {v!r} (this host speaks "
+                f"{WIRE_VERSION}) — upgrade the leader and followers "
+                "together"
+            )
+        if record.get("kind") == "discard":
+            self._handle_discard(record)
+            self.applied_seq = record["seq"]
+            return
+        if record.get("kind") == "ops":
+            self._apply_ops(record)
+            self.applied_seq = record["seq"]
+            return
+        step_idx = record["step"]
+        if step_idx in self._skip:
+            # the leader discarded this plan before completing it
+            self._skip.discard(step_idx)
+            self.plans_skipped += 1
+            self.applied_seq = record["seq"]
+            return
+        self._fold_and_check(record)
+        eng = self.engine
+        cached = {}
         for doc in record.get("admits", []):
-            self.engine.add_request(request_from_wire(doc))
-        for rid in record.get("aborts", []):
-            self.engine.abort(rid)
-        if record.get("step"):
-            self.engine.step()
-            self.steps += 1
+            req = request_from_wire(doc)
+            if req.adapter and hasattr(eng, "ensure_adapter_resident"):
+                if not eng.ensure_adapter_resident(req.adapter):
+                    raise DivergenceError(
+                        f"plan {step_idx}: adapter {req.adapter!r} for "
+                        f"{req.id} is not stageable on this replica"
+                    )
+            cached[req.id] = int(doc.get("cached_tokens", 0))
+            eng.add_request(req)
+        if record.get("resumes") and hasattr(eng, "ensure_adapter_resident"):
+            want = set(record["resumes"])
+            for st in list(getattr(eng, "preempted", [])):
+                if st.req.id in want and st.req.adapter:
+                    eng.ensure_adapter_resident(st.req.adapter)
+        drive = PlanDrive(
+            budget=record.get("budget"),
+            queue_blocked=record.get("queue_blocked", False),
+            drafts=[(int(s), [int(t) for t in toks])
+                    for s, toks in record.get("drafts", [])],
+            resumes=list(record.get("resumes", [])),
+            cached_tokens=cached,
+        )
+        eng._plan_drive = drive
+        try:
+            emitted = eng.step()
+        finally:
+            eng._plan_drive = None
+        if eng.waiting:
+            raise DivergenceError(
+                f"plan {step_idx}: {len(eng.waiting)} admitted requests "
+                "left unclaimed after the step — replica resources do "
+                "not match the leader's"
+            )
+        if drive.resumes:
+            raise DivergenceError(
+                f"plan {step_idx}: resumes not applied: {drive.resumes}"
+            )
+        self._prev = (step_idx, [(r.id, int(t)) for r, t in emitted])
+        self._applied_step = step_idx
+        self.steps += 1
+        self.plans_applied += 1
         self.applied_seq = record["seq"]
 
+    def _apply_ops(self, record: dict) -> None:
+        # ops records sit in the stream exactly where the leader's
+        # engine saw the abort/preempt relative to the surrounding
+        # plans, so applying them in stream order keeps the replica's
+        # slot/page state in step
+        eng = self.engine
+        for op in record.get("ops", []):
+            kind, rid = op[0], op[1]
+            if kind == "abort":
+                eng.abort(rid)
+                self._aborts_after_plan.setdefault(
+                    self._applied_step, set()
+                ).add(rid)
+            elif kind == "preempt":
+                if not eng.preempt(rid):
+                    raise DivergenceError(
+                        f"ops after step {self._applied_step}: preempt "
+                        f"of {rid} failed on this replica (request "
+                        "unknown or not swappable)"
+                    )
+            else:
+                raise DivergenceError(
+                    f"ops after step {self._applied_step}: unknown op "
+                    f"{kind!r}"
+                )
+
+    def _handle_discard(self, record: dict) -> None:
+        target = record["step"]
+        self._skip.discard(target)
+        if target <= self._applied_step:
+            raise DivergenceError(
+                f"this replica already executed step {target} that the "
+                "leader discarded after a step failure"
+            )
+        # the plan was skipped (or predates our join): restart the
+        # digest chain in step with the leader's reset
+        self._prev = None
+        self._digest = _DIGEST_SEED
+        self._aborts_after_plan.clear()
+
+    def _fold_and_check(self, record: dict) -> None:
+        if record.get("digest_reset"):
+            self._prev = None
+            self._digest = _DIGEST_SEED
+            self._aborts_after_plan.clear()
+        if self._prev is not None:
+            m, ems = self._prev
+            excl = self._aborts_after_plan.pop(m, set())
+            self._digest = _fold_digest(self._digest, m, ems, excl)
+            self._digest_by_step[m] = self._digest.hex()
+            self._prev = None
+            while len(self._digest_by_step) > 128:
+                self._digest_by_step.popitem(last=False)
+        want = record.get("digest")
+        ds = record.get("digest_step")
+        if want is None or ds is None or self.digest_mode == "off":
+            return
+        have = self._digest_by_step.get(ds)
+        if have is None:
+            # we joined (or reset) after step ds; nothing to compare
+            return
+        self.digest_checks += 1
+        if have != want:
+            self.digest_mismatches += 1
+            msg = (f"emission digest mismatch at step {ds}: leader "
+                   f"{want}, replica {have}")
+            if self.digest_mode == "strict":
+                raise DivergenceError(msg)
+            log.warning("%s", msg)
+
+    # -- pump ----------------------------------------------------------------
     def run_once(self) -> int:
         records = self.feed.read_since(
             self.applied_seq, timeout=self.poll_timeout
         )
+        # prescan for discard markers so a replayed/batched feed skips
+        # dead plans instead of executing steps the leader rolled back
+        for r in records:
+            if r.get("kind") == "discard":
+                self._skip.add(r.get("step"))
         for r in records:
             self.apply(r)
         return len(records)
 
+    def _fail(self, msg: str) -> None:
+        self.error = (
+            f"{msg} — lockstep lost; restart this follower with a fresh "
+            "engine replica (it replays the leader's ring from seq 0 on "
+            "start); if the ring no longer retains seq 1, re-apply the "
+            "serving profile on both hosts"
+        )
+        log.error("follower lost lockstep: %s", self.error)
+        if self.on_lost_lockstep is not None:
+            try:
+                self.on_lost_lockstep(self.error)
+            except Exception:  # noqa: BLE001 — operator hook
+                log.exception("on_lost_lockstep hook failed")
+
     def start(self) -> "FollowerLoop":
         def run():
+            attempt = 0
             while not self._stop.is_set():
                 try:
-                    self.run_once()
-                except LagError as e:
-                    # falling off the ring is fatal for lockstep: the
-                    # process must restart and resync from the ring head
-                    # (or a profile re-apply when the head is gone)
-                    self.error = (
-                        f"{e} — lockstep lost; restart this follower "
-                        "with a fresh engine replica (it replays the "
-                        "leader's ring from seq 0 on start); if the ring "
-                        "no longer retains seq 1, re-apply the serving "
-                        "profile on both hosts"
+                    records = self.feed.read_since(
+                        self.applied_seq, timeout=self.poll_timeout
                     )
-                    log.error("follower lost lockstep: %s", self.error)
-                    if self.on_lost_lockstep is not None:
-                        try:
-                            self.on_lost_lockstep(self.error)
-                        except Exception:  # noqa: BLE001 — operator hook
-                            log.exception("on_lost_lockstep hook failed")
+                except LagError as e:
+                    # falling off the ring (or a leader restart) is
+                    # fatal for lockstep: the process must restart and
+                    # resync from the ring head (or a profile re-apply
+                    # when the head is gone)
+                    self._fail(str(e))
                     return
                 except Exception as e:  # noqa: BLE001 — transient feed
-                    log.warning("follower feed error: %s", e)
-                    time.sleep(1.0)
+                    attempt += 1
+                    self.feed_errors += 1
+                    delay = min(
+                        self.backoff_cap,
+                        self.backoff_base * (2 ** min(attempt, 16)),
+                    ) * (0.5 + random.random() / 2.0)
+                    self.backoff_seconds_total += delay
+                    log.warning(
+                        "follower feed error (attempt %d, retry in "
+                        "%.2fs): %s", attempt, delay, e,
+                    )
+                    self._stop.wait(delay)
+                    continue
+                attempt = 0
+                try:
+                    for r in records:
+                        if r.get("kind") == "discard":
+                            self._skip.add(r.get("step"))
+                    for r in records:
+                        self.apply(r)
+                except (LagError, WireVersionError, DivergenceError) as e:
+                    self._fail(str(e))
+                    return
+                except Exception as e:  # noqa: BLE001 — half-applied plan
+                    # an engine error mid-plan cannot be retried (the
+                    # plan may be half-applied) — treat as divergence
+                    self._fail(f"plan apply failed: {e!r}")
+                    return
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
@@ -276,27 +815,67 @@ class FollowerLoop:
         if self._thread:
             self._thread.join(timeout=10)
 
+    def stats(self) -> dict:
+        return {
+            "applied_seq": self.applied_seq,
+            "steps": self.steps,
+            "plans_applied": self.plans_applied,
+            "plans_skipped": self.plans_skipped,
+            "feed_errors": self.feed_errors,
+            "backoff_seconds_total": round(self.backoff_seconds_total, 3),
+            "digest_mode": self.digest_mode,
+            "digest_checks": self.digest_checks,
+            "digest_mismatches": self.digest_mismatches,
+            "reconnects": getattr(self.feed, "reconnects", 0),
+        }
+
 
 class HTTPFeed:
-    """Follower-side transport: long-poll the leader over DCN."""
+    """Follower-side transport: long-poll the leader over DCN.
+
+    Keeps a pooled ``requests.Session`` alive across polls (one TCP/TLS
+    handshake per leader, not per long-poll); on a transport error the
+    pool is dropped so the next poll reconnects cleanly, counted in
+    ``reconnects``."""
 
     def __init__(self, leader_url: str, model: str):
         self.leader_url = leader_url.rstrip("/")
         self.model = model
+        self._session = None
+        self.reconnects = 0
+
+    def _sess(self):
+        if self._session is None:
+            import requests
+
+            self._session = requests.Session()
+        return self._session
 
     def read_since(self, since: int, timeout: float = 30.0) -> list:
-        import json
-        import urllib.parse
-        import urllib.request
-
-        q = urllib.parse.urlencode(
-            {"since": since, "timeout": timeout, "model": self.model}
-        )
-        req = urllib.request.Request(
-            f"{self.leader_url}/multihost/commands?{q}"
-        )
-        with urllib.request.urlopen(req, timeout=timeout + 10) as r:
-            doc = json.loads(r.read())
+        try:
+            resp = self._sess().get(
+                f"{self.leader_url}/multihost/commands",
+                params={
+                    "since": since, "timeout": timeout, "model": self.model,
+                },
+                timeout=timeout + 10,
+            )
+            doc = resp.json()
+        except Exception:
+            # drop the pooled connections; the next poll reconnects
+            self.reconnects += 1
+            sess, self._session = self._session, None
+            if sess is not None:
+                try:
+                    sess.close()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass
+            raise
         if doc.get("lagged"):
             raise LagError(doc.get("error", "fell off the leader's ring"))
         return doc.get("records", [])
+
+
+# the old name survived one release; keep the alias so operator tooling
+# importing LockstepLeader keeps working against the plan broadcast
+LockstepLeader = PlanLeader

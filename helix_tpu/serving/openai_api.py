@@ -217,13 +217,15 @@ class OpenAIServer:
         app.router.add_post("/v1/migrate/import", self.migrate_import)
         app.router.add_post("/v1/migrate/resume", self.migrate_resume)
         app.router.add_post("/admin/profiler", self.profiler_capture)
-        # multi-host lockstep journal (followers long-poll over DCN;
-        # see serving/multihost_serving.py)
+        # multi-host step-plan feed (followers long-poll over DCN;
+        # see serving/multihost_serving.py).  The route keeps its
+        # historical name — followers of either wire version find it,
+        # and the version field inside each record does the rejecting.
         app.router.add_get("/multihost/commands", self.multihost_commands)
         return app
 
     async def multihost_commands(self, request):
-        """Leader-side journal feed for follower hosts."""
+        """Leader-side plan feed for follower hosts."""
         import asyncio as _asyncio
 
         from helix_tpu.serving.multihost_serving import LagError
@@ -232,10 +234,13 @@ class OpenAIServer:
         served = self.registry.get(model)
         if served is None or served.loop is None:
             return _error(404, f"model '{model}' is not served here")
+        # multihost-ok: transport plumbing (serving the PlanLeader's
+        # ring), not a feature guard
         journal = getattr(served.loop.engine, "journal", None)
         if journal is None:
             return _error(
-                400, f"model '{model}' is not running in lockstep mode"
+                400, f"model '{model}' is not running as a multihost "
+                "leader"
             )
         since = int(request.query.get("since", 0))
         timeout = min(float(request.query.get("timeout", 25)), 55.0)

@@ -40,7 +40,7 @@ class ModelRegistry:
     def unregister(self, name: str):
         m = self._models.pop(name, None)
         if m is not None and m.follower is not None:
-            # a zombie follower would keep replaying the journal against
+            # a zombie follower would keep applying step plans against
             # the torn-down engine (duplicate collective participation)
             m.follower.stop()
         if m and m.loop:

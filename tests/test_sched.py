@@ -728,20 +728,24 @@ class TestSchedMetrics:
         assert c.samples[("helix_sched_wfq_enabled", ())] == 0
         assert c.samples[("helix_sched_prefill_budget_tokens", ())] == 0
 
-    def test_lockstep_downgrades_to_fifo_scheduler(self, tiny_parts):
+    def test_multihost_leader_keeps_full_scheduler(self, tiny_parts):
+        # Since the plan-broadcast rewrite the leader's scheduler runs at
+        # full strength (its decisions replicate as step-plan data), so a
+        # journal-bearing engine must NOT downgrade to FIFO.
         from helix_tpu.serving.engine_loop import EngineLoop
 
         eng = _mk_engine(tiny_parts)
-        eng.journal = object()   # duck-typed lockstep marker
+        eng.journal = object()   # duck-typed broadcast-ring marker
         loop = EngineLoop(
             eng, name="ls",
             sched_config={"sched": {"policy": "wfq",
                                     "prefill_budget_tokens": 512}},
         )   # not started
-        assert loop.sched.name == "fifo" and not loop._sched_active
+        assert loop.sched.name == "wfq" and loop._sched_active
         c = _Collector()
         loop.sched.collect(c, {})
-        assert c.samples[("helix_sched_wfq_enabled", ())] == 0
+        assert c.samples[("helix_sched_wfq_enabled", ())] == 1
+        assert c.samples[("helix_sched_prefill_budget_tokens", ())] == 512
         del eng.journal
 
     def test_saturation_carries_prefill_budget(self, tiny_parts):

@@ -89,15 +89,32 @@ Contracts, enforced repo-wide (wired into tier-1 via
    the control plane must clamp runner-supplied blocks through
    ``validate_adapter_block`` (the contracts 3-10 importer pattern).
 
+12. **No multihost feature forks** (ISSUE 16): the plan-broadcast
+   rewrite deleted every "inert for lockstep" downgrade — spec decode,
+   adapters, WFQ, preemption, the async pipeline and filestore prefix
+   hits all run on multi-host meshes because the leader's plan pins
+   them as data.  Under ``helix_tpu/engine/`` and ``helix_tpu/serving/``
+   (``serving/multihost_serving.py`` itself exempt), CODE — comments
+   and docstrings may discuss the topology freely — that sniffs the
+   leader journal (``hasattr``/``getattr(..., "journal")``) or
+   branches on a ``lockstep``/``multihost`` token fails the build: a
+   new guard would quietly regrow the single-host/multi-host feature
+   fork the rewrite collapsed.  Role wiring lives in
+   ``multihost_serving.py`` and the control plane (not scanned); a
+   genuine transport site carries a ``multihost-ok: <why>`` marker on
+   the line or in a comment within the two lines above it.
+
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
 """
 
 from __future__ import annotations
 
+import io
 import os
 import re
 import sys
+import tokenize
 
 # the naming contract (keep in sync with helix_tpu.obs.metrics):
 # lowercase snake_case under the helix_ prefix...
@@ -617,6 +634,82 @@ def _host_sync_violations(root: str) -> list:
     return violations
 
 
+# -- contract 12: no multihost feature forks ----------------------------------
+# Guard detection runs on code only: comments and docstrings are blanked
+# first, so prose may name the topology while an `if pm.multihost:` or a
+# journal-attribute sniff in live code fails the build.
+_MH_GUARD_ATTR = re.compile(r"""(?:has|get)attr\([^)]*["']journal["']""")
+# bare lockstep/multihost tokens in code (attribute guards like
+# `pm.multihost`, flags, branch conditions); \b keeps identifiers such
+# as multihost_serving / multihost_commands out of scope
+_MH_GUARD_TOKEN = re.compile(r"\b(?:lockstep|multihost)\b")
+_MH_GUARD_OK = "multihost-ok"
+_MH_GUARD_DIRS = (
+    os.path.join("helix_tpu", "engine"),
+    os.path.join("helix_tpu", "serving"),
+)
+_MH_GUARD_EXEMPT = os.path.join(
+    "helix_tpu", "serving", "multihost_serving.py"
+)
+
+
+def _blank_tokens(src: str, kinds) -> list:
+    """Per-line source with the given token kinds blanked out."""
+    grid = [list(line) for line in src.splitlines()]
+
+    def blank(srow, scol, erow, ecol):
+        for row in range(srow - 1, min(erow, len(grid))):
+            lo = scol if row == srow - 1 else 0
+            hi = ecol if row == erow - 1 else len(grid[row])
+            for col in range(lo, min(hi, len(grid[row]))):
+                grid[row][col] = " "
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type in kinds:
+                blank(*tok.start, *tok.end)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # unparseable tail: fall back to the raw remainder
+    return ["".join(row) for row in grid]
+
+
+def _mh_guard_violations(root: str) -> list:
+    violations = []
+    for path in _iter_py_files(root):
+        rel = os.path.relpath(path, root)
+        if rel == _MH_GUARD_EXEMPT:
+            continue
+        if not any(
+            rel.startswith(d + os.sep) for d in _MH_GUARD_DIRS
+        ):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            src = f.read()
+        raw = src.splitlines()
+        # the token check sees pure code (strings blanked: an error
+        # MESSAGE may say "multihost"); the journal-sniff check keeps
+        # string literals because the "journal" attribute name IS one
+        code = _blank_tokens(src, (tokenize.COMMENT, tokenize.STRING))
+        no_comments = _blank_tokens(src, (tokenize.COMMENT,))
+        for i, line in enumerate(code, 1):
+            if _MH_GUARD_ATTR.search(no_comments[i - 1]):
+                what = "leader-journal sniff (hasattr/getattr 'journal')"
+            elif _MH_GUARD_TOKEN.search(line):
+                what = "lockstep/multihost token in code"
+            else:
+                continue
+            if any(_MH_GUARD_OK in w for w in raw[max(0, i - 3):i]):
+                continue
+            violations.append(
+                f"{rel}:{i}: {what} — multi-host feature guards were "
+                "deleted by the plan-broadcast rewrite (every feature "
+                "replicates as plan data); role wiring belongs in "
+                "helix_tpu/serving/multihost_serving.py, and a genuine "
+                "transport site carries 'multihost-ok: <why>'"
+            )
+    return violations
+
+
 def run(root: str) -> list:
     """Returns a list of violation strings (empty = clean)."""
     sat_keys, violations = _load_saturation_schema(root)
@@ -627,6 +720,7 @@ def run(root: str) -> list:
     violations += _disagg_schema_violations(root)
     violations += _adapter_schema_violations(root)
     violations += _host_sync_violations(root)
+    violations += _mh_guard_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
     sched_reason_res = [
