@@ -1686,6 +1686,74 @@ def main():
         ),
     }
 
+    # -- N-follower fan-out + leader failover (ISSUE 17) ------------------
+    # Fan-out: three registered replicas replay the same journal through
+    # LocalFeed (so the leader's health registry sees them); the number
+    # to watch is that per-follower apply cost stays flat as the mesh
+    # widens — the leader publishes once regardless of N.  Failover: a
+    # standby is promoted through a real filestore checkpoint + log-tail
+    # replay; blackout is the full promote path (validate checksums,
+    # park at the boundary, rebuild the journal, republish).
+    from helix_tpu.serving.multihost_serving import (
+        CheckpointStore,
+        LocalFeed,
+        promote_follower,
+    )
+
+    fan = [
+        FollowerLoop(make_engine(kv_dtype),
+                     LocalFeed(mh_leader, f"bench-f{i}"))
+        for i in range(3)
+    ]
+    fan_walls = []
+    for f in fan:
+        t0 = time.perf_counter()
+        while f.run_once(timeout=0.0):
+            pass
+        fan_walls.append(time.perf_counter() - t0)
+    health = mh_leader.follower_health()
+
+    to_dir = _tempfile.mkdtemp(prefix="helix-bench-mhckpt-")
+    to_store = CheckpointStore(to_dir)
+    # failover parks in-flight requests at the boundary through the
+    # host KV tier, so the takeover pair runs with it enabled
+    _mh_pool = dict(host_pool_bytes=1 << 28)
+    to_leader = PlanLeader(make_engine(kv_dtype, **_mh_pool),
+                           checkpoint_store=to_store, name="bench")
+    to_standby = FollowerLoop(
+        make_engine(kv_dtype, **_mh_pool),
+        LocalFeed(to_leader, "bench-sb"),
+        name="bench", standby=True, checkpoint_store=to_store,
+    )
+    for r in _mh_reqs("to"):
+        to_leader.add_request(r)
+    for _ in range(4):             # leave work in flight at the kill
+        if to_leader.has_work():
+            to_leader.step()
+    _ref, _nbytes = to_store.save("bench", to_leader._capture_state())
+    while to_standby.run_once(timeout=0.0):
+        pass
+    to_new = promote_follower(to_standby, store=to_store, name="bench")
+    _mh_drain(to_new)
+
+    result["multihost"].update({
+        "followers": {
+            "replicas": len(fan),
+            "states": dict(
+                mh_leader.mh_stats()["follower_states"]
+            ),
+            "apply_ms_per_step_avg": round(
+                1000.0 * sum(fan_walls)
+                / max(1, sum(f.plans_applied for f in fan)), 3
+            ),
+            "max_lag_steps": max(
+                (st["lag_steps"] for st in health.values()), default=0
+            ),
+        },
+        "takeover_blackout_ms": round(float(to_new.takeover_ms), 1),
+        "checkpoint_bytes": int(_nbytes),
+    })
+
     if on_tpu:
         # decode-side model FLOPs utilisation: each generated token moves
         # ~2 FLOPs per active parameter through the MXU; a v5e chip peaks

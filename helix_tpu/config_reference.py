@@ -706,6 +706,86 @@ ENV_REFERENCE: tuple = (
         default="5.0",
         section="accelerator",
     ),
+    EnvVar(
+        "HELIX_MH_LAG_STEPS",
+        "Leader-side lag ladder threshold (steps): a follower whose "
+        "applied step sustains more than this many steps behind the "
+        "published plan enters the typed 'lagging' state and the "
+        "leader throttles admission (prefill budget pinned to 0, the "
+        "PR 8 discipline) until it catches back up to half the "
+        "threshold — back-pressure instead of ring overflow.",
+        default="64",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_MAX_FOLLOWERS",
+        "Bound on follower health entries the leader tracks (and the "
+        "size of the helix_mh_follower_* metric family); polls beyond "
+        "it are served but not registered (followers_dropped counts "
+        "them).",
+        default="16",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_FOLLOWER_TTL",
+        "Seconds without a poll before the leader marks a registered "
+        "follower 'lost' (it stops feeding the lag throttle; a "
+        "rejoining poll re-registers it).",
+        default="15",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_FOLLOWER_ID",
+        "Stable id this follower registers with the leader's health "
+        "registry (default: follower-<pid>). Set it per host so lag / "
+        "digest telemetry survives process restarts under one name.",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_CHECKPOINT_DIR",
+        "Shared filestore directory for leader-state checkpoints "
+        "(ISSUE 17 failover). Point every host of the mesh at the SAME "
+        "path (the PR 14 cluster filestore tier): the leader "
+        "checkpoints its host-side queue state there and a standby "
+        "promotes from the newest checkpoint. Empty = no "
+        "checkpointing, failover degrades to the full resync ladder.",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_CHECKPOINT_SECONDS",
+        "Seconds between leader-state checkpoints (captured on the "
+        "engine thread at a step boundary, written off-thread through "
+        "the filestore). Smaller = fresher takeover boundary, more "
+        "filestore writes.",
+        default="5",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_CHECKPOINT_KEEP",
+        "Newest leader-state checkpoints retained per model; older "
+        "ones are pruned after each write.",
+        default="3",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_STANDBY",
+        "Set to 1 on a follower host to mark it a hot standby (the "
+        "profile's multihost.standby beats this): standbys keep a "
+        "digest-verified replica and are the preferred "
+        "promote_follower target when the leader dies.",
+        default="0",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_MH_PROMOTE_AFTER",
+        "Standby auto-promotion trigger: after this many CONSECUTIVE "
+        "transient plan-feed failures (the leader host is gone, not a "
+        "blip) a standby stops retrying and fires its promotion hook. "
+        "0 (default) = never self-trigger; promotion is operator- or "
+        "node-agent-driven.",
+        default="0",
+        section="accelerator",
+    ),
     # -- multi-host (DCN) training ---------------------------------------
     EnvVar(
         "HELIX_COORDINATOR",

@@ -310,21 +310,53 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
     role = pm.multihost.get("role", "")
     if role == "leader":
         # broadcast one StepPlan per engine step for follower hosts
-        # (plan-driven SPMD over DCN; serving/multihost_serving.py)
-        from helix_tpu.serving.multihost_serving import PlanLeader
+        # (plan-driven SPMD over DCN; serving/multihost_serving.py);
+        # with HELIX_MH_CHECKPOINT_DIR set the leader also checkpoints
+        # its host-side state through the filestore so a standby can
+        # take over (ISSUE 17)
+        from helix_tpu.serving.multihost_serving import (
+            PlanLeader,
+            checkpoint_store_from_env,
+        )
 
-        engine = PlanLeader(engine)
+        engine = PlanLeader(
+            engine,
+            checkpoint_store=checkpoint_store_from_env(),
+            name=pm.name,
+        )
     elif role == "follower":
         # this host executes the leader's step plans — no local HTTP
         # traffic, no local scheduler/drafter/clock
         from helix_tpu.serving.multihost_serving import (
             FollowerLoop,
             HTTPFeed,
+            checkpoint_store_from_env,
         )
 
         follower = FollowerLoop(
-            engine, HTTPFeed(pm.multihost["leader_url"], pm.name)
-        ).start()
+            engine, HTTPFeed(pm.multihost["leader_url"], pm.name),
+            name=pm.name,
+            # standby followers arm auto-promotion (profile beats the
+            # HELIX_MH_STANDBY env default, which FollowerLoop reads
+            # when this is None)
+            standby=pm.multihost.get("standby"),
+            checkpoint_store=checkpoint_store_from_env(),
+        )
+
+        def _lost(err):
+            # the typed resync ladder (ISSUE 17): the error already
+            # carries the reason's operator action (RESYNC_ACTIONS) —
+            # a leader restart wants a profile re-apply, falling off
+            # the ring wants a fresh-replica restart, a rejected
+            # handoff checkpoint wants the shared checkpoint dir fixed
+            log.error(
+                "follower %s (%s) lost plan lockstep [reason=%s]: %s",
+                follower.follower_id, pm.name,
+                follower.resync_reason or "fatal", err,
+            )
+
+        follower.on_lost_lockstep = _lost
+        follower.start()
         return ServedModel(
             name=pm.name, loop=None, tokenizer=tokenizer, kind=pm.kind,
             context_length=(
@@ -332,13 +364,31 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
             ),
             vision=vision_runner, follower=follower,
         )
-    def _bound(env_name, cast=int):
-        import os
+    loop = _make_engine_loop(engine, pm)
+    return ServedModel(
+        name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,
+        context_length=pm.context_length or model_cfg.max_position_embeddings,
+        vision=vision_runner,
+    )
 
+
+def _make_engine_loop(engine, pm: ProfileModel):
+    """Build + start the EngineLoop around an engine for one model.
+
+    Shared by the profile apply path and standby promotion (ISSUE 17):
+    a promoted standby wraps the same engine replica in a fresh
+    PlanLeader and needs an identical loop around it — same admission
+    bounds, same SLO targets, same scheduler config."""
+    import os
+
+    from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.sched import SchedConfig
+
+    def _bound(env_name, cast=int):
         v = os.environ.get(env_name, "")
         return cast(v) if v else None
 
-    loop = EngineLoop(
+    return EngineLoop(
         engine, name=pm.name,
         # admission bounds (shed -> 429 instead of queue-rot); unbounded
         # unless the operator sets them — see README "Robustness knobs"
@@ -367,11 +417,6 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         # README "Scheduling"
         sched_config=SchedConfig.from_profile(pm.slo),
     ).start()
-    return ServedModel(
-        name=pm.name, loop=loop, tokenizer=tokenizer, kind=pm.kind,
-        context_length=pm.context_length or model_cfg.max_position_embeddings,
-        vision=vision_runner,
-    )
 
 
 class DelegatingRegistry:
@@ -496,7 +541,9 @@ class NodeAgent:
                         if self.registry.get(name) is None:
                             self.state.progress[name] = "loading"
                             t0 = time.monotonic()
-                            self.registry.register(self._build(pm))
+                            served = self._build(pm)
+                            self.registry.register(served)
+                            self._arm_promotion(served, pm)
                             log.info(
                                 "runner %s: model %s built in %.1fs "
                                 "(profile %s)",
@@ -545,7 +592,9 @@ class NodeAgent:
         )
 
         def build(name: str):
-            return self._build(want[name])
+            served = self._build(want[name])
+            self._arm_promotion(served, want[name])
+            return served
 
         def estimate(name: str) -> int:
             pm = want[name]
@@ -570,6 +619,71 @@ class NodeAgent:
             self.state.progress[name] = "lazy"
 
     # ------------------------------------------------------------------
+    def _arm_promotion(self, served, pm) -> None:
+        """Standby failover (ISSUE 17): when a standby follower's feed
+        declares the leader host dead (HELIX_MH_PROMOTE_AFTER
+        consecutive transient failures, not a typed resync), promote it
+        in-process: digest-verified takeover through the filestore
+        checkpoint, a fresh EngineLoop around the promoted engine, and
+        a registry swap so this host starts taking HTTP traffic."""
+        follower = getattr(served, "follower", None)
+        if follower is None or not getattr(follower, "standby", False):
+            return
+
+        def _promote(f):
+            self._promote_follower(served, pm, f)
+
+        follower.on_leader_lost = _promote
+
+    def _promote_follower(self, served, pm, follower) -> None:
+        from helix_tpu.serving.multihost_serving import (
+            promote_follower,
+            restore_sched_state,
+        )
+
+        t0 = time.monotonic()
+        try:
+            leader = promote_follower(follower, name=pm.name)
+        except Exception as e:  # noqa: BLE001 — typed rungs land here
+            # every refused rung degrades to today's resync ladder:
+            # nothing was mutated, the operator restarts this host's
+            # serving process (ring replay / checkpoint bootstrap) or
+            # re-applies the serving profile across the mesh
+            log.error(
+                "standby promotion for %s refused, still a follower: %s",
+                pm.name, e,
+            )
+            return
+        try:
+            loop = _make_engine_loop(leader, pm)
+            sched_doc = getattr(leader, "_ckpt_sched", None)
+            if sched_doc:
+                # the checkpoint carried the dead leader's scheduler
+                # state (WFQ deficits, tenant queue order); the new
+                # loop's scheduler resumes from it instead of resetting
+                # every tenant's debt
+                restore_sched_state(loop.sched, sched_doc)
+            self.registry.register(ServedModel(
+                name=pm.name, loop=loop, tokenizer=served.tokenizer,
+                kind=served.kind, context_length=served.context_length,
+                vision=served.vision,
+            ))
+            with self._lock:
+                if pm.name not in self.state.models:
+                    self.state.models = sorted(
+                        self.state.models + [pm.name]
+                    )
+            log.warning(
+                "standby %s promoted to plan leader for %s in %.0f ms "
+                "(boundary plan %d)",
+                follower.follower_id, pm.name,
+                (time.monotonic() - t0) * 1000.0, leader._last_plan_idx,
+            )
+        except Exception as e:  # noqa: BLE001 — surfaced via status
+            log.exception("promotion of %s failed after takeover", pm.name)
+            with self._lock:
+                self.state.error = f"promotion failed: {e}"
+
     def _live_models(self) -> list:
         """Already-resident ServedModels, without building or blocking.
 
@@ -709,6 +823,20 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 — heartbeat must never die
             return []
 
+    def multihost_summary(self) -> dict:
+        """The heartbeat mesh-health block (ISSUE 17): per-model role,
+        follower health states / worst lag / takeover counters on
+        leaders, applied-seq + resync reason on followers — rendered by
+        ``multihost_serving.mh_heartbeat_block`` over the lock-free
+        live-model snapshot (the heartbeat thread never blocks on a
+        build)."""
+        from helix_tpu.serving.multihost_serving import mh_heartbeat_block
+
+        try:
+            return mh_heartbeat_block(self._live_models())
+        except Exception:  # noqa: BLE001 — heartbeat must never die
+            return {}
+
     def pool_role(self) -> str:
         """This node's disaggregation pool role: HELIX_POOL_ROLE beats
         the applied profile's ``role:`` (unknown values degrade to the
@@ -751,6 +879,10 @@ class NodeAgent:
             # `model@adapter` ids resident in any live engine's HBM
             # pool — the scored router's adapter-affinity signal
             "adapters": self.adapter_summary(),
+            # mesh health federation (ISSUE 17): leader/follower roles,
+            # per-follower lag ladder states and takeover counters —
+            # /v1/cluster/status renders mesh health from this
+            "multihost": self.multihost_summary(),
             # disaggregation pool role (ISSUE 14): the router schedules
             # prefill and decode pools independently off this
             "role": self.pool_role(),

@@ -66,9 +66,11 @@ class ProfileModel:
     # MoE for ep-mesh dev profiles
     model_overrides: dict = dataclasses.field(default_factory=dict)
     # multi-host lockstep serving over DCN (serving/multihost_serving):
-    # {} = single host; {"role": "leader"} journals this engine's command
-    # stream; {"role": "follower", "leader_url": "http://host0:8000"}
-    # replays it on this host's shards of the global mesh
+    # {} = single host; {"role": "leader"} broadcasts this engine's step
+    # plans; {"role": "follower", "leader_url": "http://host0:8000"}
+    # executes them on this host's shards of the global mesh; add
+    # "standby": true on a follower to arm auto-promotion to leader
+    # when the leader host dies (ISSUE 17)
     multihost: dict = dataclasses.field(default_factory=dict)
     # declared SLO targets (obs/slo.py): {ttft_p95_seconds,
     # queue_wait_p95_seconds, goodput_floor_tps} — drives the engine
@@ -85,6 +87,18 @@ class ProfileModel:
             )
         if mh.get("role") == "follower" and not mh.get("leader_url"):
             raise ValueError("multihost followers need leader_url")
+        if "standby" in mh:
+            # standby followers (ISSUE 17): hot-spare hosts that arm
+            # auto-promotion to leader; normalise truthy YAML spellings
+            # to a real bool and reject leaders declaring it
+            if mh.get("role") != "follower":
+                raise ValueError(
+                    "multihost.standby is only valid on followers"
+                )
+            v = mh["standby"]
+            if isinstance(v, str):
+                v = v.strip().lower() in ("1", "true", "yes", "on")
+            mh["standby"] = bool(v)
         return cls(
             name=d["name"],
             checkpoint=d.get("checkpoint"),

@@ -426,6 +426,11 @@ class RunnerState:
     # strictly.  Mixed (the default) behaves exactly as before roles
     # existed.
     role: str = POOL_MIXED
+    # mesh-health block (ISSUE 17): per-model multi-host role plus
+    # follower lag-ladder states / takeover counters, sanitised by
+    # multihost_serving.validate_mh_block at heartbeat ingestion —
+    # /v1/cluster/status renders it, pruned with the runner
+    multihost: dict = dataclasses.field(default_factory=dict)
 
     @property
     def routable(self) -> bool:
@@ -495,6 +500,7 @@ class InferenceRouter:
         draining: bool = False,
         drain_deadline: float = 0.0,
         role: str = POOL_MIXED,
+        multihost: Optional[dict] = None,
     ) -> RunnerState:
         with self._lock:
             st = self._runners.get(runner_id)
@@ -517,6 +523,8 @@ class InferenceRouter:
                 st.tenants = dict(tenants)
             if adapters is not None:
                 st.adapters = list(adapters)
+            if multihost is not None:
+                st.multihost = dict(multihost)
             st.draining = bool(draining)
             st.drain_deadline = float(drain_deadline or 0.0)
             return st

@@ -59,6 +59,7 @@ from helix_tpu.obs.slo import (
     validate_tenant_rollup,
 )
 from helix_tpu.obs.trace import TRACE_HEADER
+from helix_tpu.serving.multihost_serving import validate_mh_block
 from helix_tpu.serving.migration import (
     DISAGG_HEADER,
     DISAGG_PEER_ADDR_HEADER,
@@ -1596,6 +1597,10 @@ class ControlPlane:
                     ),
                 }
             )
+            if st.multihost:
+                # mesh health (ISSUE 17): per-model role + follower
+                # lag-ladder states / takeover counters, heartbeat-fed
+                runners[-1]["multihost"] = st.multihost
             totals["runners"] += 1
             totals["routable"] += 1 if st.routable else 0
             totals["slots_busy"] += int(sat.get("slots_busy", 0))
@@ -1818,6 +1823,10 @@ class ControlPlane:
         # strings; malformed blocks degrade to [] and never reject the
         # heartbeat
         adapters = validate_adapter_block(body.get("adapters"))
+        # mesh-health block (ISSUE 17): runner-supplied like saturation —
+        # clamped to known roles / follower states / finite counters;
+        # malformed blocks degrade to {} and never reject the heartbeat
+        multihost = validate_mh_block(body.get("multihost"))
         # drain state (ISSUE 11): runner-supplied like saturation, so a
         # malformed flag DEGRADES to false (still-routable) instead of
         # 500ing the heartbeat and TTL-evicting a healthy runner — the
@@ -1860,6 +1869,7 @@ class ControlPlane:
             adapters=adapters,
             draining=draining,
             drain_deadline=drain_deadline,
+            multihost=multihost,
         )
         if draining:
             # the runner is acting on the drain: the request is served —

@@ -789,6 +789,14 @@ class EngineLoop:
                 if getattr(eng, "adapter_pool", None) is not None
                 else None
             ),
+            # N-follower mesh health + failover accounting (ISSUE 17):
+            # None except on a plan-broadcast leader.  multihost-ok:
+            # duck-typed stats surfacing, not a feature guard.
+            "multihost": (
+                eng.mh_stats()
+                if callable(getattr(eng, "mh_stats", None))
+                else None
+            ),
         }
 
     def device_idle_ratio(self) -> float:
@@ -1486,6 +1494,15 @@ class EngineLoop:
                 if not reconcile_or_fail():
                     continue
                 self._disagg_tick()
+            ctick = getattr(self.engine, "checkpoint_tick", None)
+            if ctick is not None and self.engine.checkpoint_due():
+                # leader-state checkpoint (ISSUE 17): capture is a pure
+                # host-side read of queue/digest bookkeeping (the blob
+                # write happens off-thread), but the snapshot must not
+                # straddle an in-flight pipelined step
+                if not reconcile_or_fail():
+                    continue
+                ctick(sched=self.sched)
             if not self.engine.has_work():
                 if not reconcile_or_fail():
                     continue

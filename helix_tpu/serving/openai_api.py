@@ -42,6 +42,7 @@ from helix_tpu.serving.engine_loop import (
     SHUTTING_DOWN,
 )
 from helix_tpu.serving.kv_filestore import collect_filestore_kv
+from helix_tpu.serving.multihost_serving import collect_mh_metrics
 from helix_tpu.serving.migration import (
     DISAGG_HEADER,
     DISAGG_PEER_ADDR_HEADER,
@@ -244,6 +245,33 @@ class OpenAIServer:
             )
         since = int(request.query.get("since", 0))
         timeout = min(float(request.query.get("timeout", 25)), 55.0)
+        # per-follower registration + health (ISSUE 17): HTTPFeed sends
+        # the follower's identity and applied position as query params;
+        # the leader's bounded registry drives the lag ladder and the
+        # helix_mh_follower_* family.  multihost-ok: transport plumbing.
+        note = getattr(served.loop.engine, "note_poll", None)
+        fid = request.query.get("follower_id", "")
+        if note is not None and fid:
+            def _qint(key):
+                v = request.query.get(key)
+                try:
+                    return int(v) if v is not None else None
+                except ValueError:
+                    return None
+
+            try:
+                apply_ms = float(request.query.get("apply_ms", ""))
+            except ValueError:
+                apply_ms = None
+            note(
+                fid[:128], since,
+                applied_step=_qint("applied_step"),
+                apply_ms=apply_ms,
+                digest_checks=_qint("digest_checks"),
+                digest_mismatches=_qint("digest_mismatches"),
+                standby=request.query.get("standby", "0")
+                in ("1", "true"),
+            )
         try:
             # long-polls park a thread for up to ``timeout`` — keep them
             # out of the shared default executor or a few followers
@@ -393,6 +421,10 @@ class OpenAIServer:
             # series are minted ONLY by engine/adapters.py (lint
             # contract 11)
             collect_adapter_metrics(c, m.loop, lbl)
+            # N-follower mesh health + failover accounting (ISSUE 17):
+            # helix_mh_* series are minted ONLY by
+            # serving/multihost_serving.py (lint contract 12)
+            collect_mh_metrics(c, m.loop, lbl)
             pc = getattr(eng, "prefix_cache", None)
             if pc is not None:
                 st = pc.stats

@@ -29,6 +29,12 @@ Rule shapes (dicts, JSON-friendly for the env var)::
     {"point": "transfer", "peer": "*", "mode": "corrupt", "page": 3}
     {"point": "transfer", "mode": "slow", "delay": 0.3, "p": 0.5}
     {"point": "transfer", "mode": "partial", "times": 1}
+    {"point": "plan_feed", "model": "*", "action": "drop", "times": 1}
+    {"point": "plan_feed", "model": "m", "on_step": 7, "action": "duplicate"}
+    {"point": "plan_feed", "action": "delay", "seconds": 0.2, "p": 0.5}
+    {"point": "plan_feed", "action": "reorder", "times": 1}
+    {"point": "leader_kill", "model": "m", "after_plan": 40, "times": 1}
+    {"point": "checkpoint", "model": "*", "mode": "corrupt", "times": 1}
     {"point": "heartbeat", "runner": "r1"}          # drop heartbeats
     {"point": "saturation", "runner": "r1",
      "set": {"kv_occupancy": 0.99}}                 # fake saturation
@@ -68,6 +74,15 @@ HOST_POOL_MODES = ("slow", "corrupt", "alloc_fail")
 # reject it).  Every mode must degrade to local recompute, never to a
 # stuck or wrong-KV request — that ladder is what the chaos lane proves.
 TRANSFER_MODES = ("drop", "slow", "corrupt", "partial")
+
+# plan-broadcast path (ISSUE 17): faults on the leader->follower plan
+# feed and on the leader's failover machinery.  drop/delay/duplicate/
+# reorder exercise the follower's seq discipline (duplicates skip
+# idempotently, gaps break the batch and re-poll — every one must be
+# recoverable, never a divergence); leader_kill arms the chaos lane's
+# mid-stream takeover; checkpoint corrupt flips a byte in a written
+# blob so the standby's pre-mutation checksum validation MUST reject it.
+PLAN_FEED_ACTIONS = ("drop", "delay", "duplicate", "reorder")
 
 
 class FaultInjected(RuntimeError):
@@ -250,6 +265,79 @@ class FaultInjector:
                     "delay": float(rule.get("delay", 0.05)),
                     "page": int(rule.get("page", 0)),
                 }
+        return None
+
+    def plan_feed_fault(self, model: str, step: int) -> Optional[dict]:
+        """Return the fault to apply to ONE plan record a follower is
+        about to apply, or None (ISSUE 17 N-follower fan-out).
+
+        The feed pump (``multihost_serving._maybe_fault_records``)
+        turns ``drop`` into a discarded record (the seq gap forces a
+        re-poll), ``duplicate`` into the record applied twice (the
+        duplicate must skip idempotently), ``delay`` into a
+        ``seconds``-second sleep (drives the lag ladder), and
+        ``reorder`` into the poll batch reversed (out-of-order seqs
+        must re-sort or re-poll, never apply out of order).  Rules
+        match by ``model`` ("*" = any) and optional ``on_step``."""
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "plan_feed":
+                    continue
+                if rule.get("model", "*") not in ("*", model):
+                    continue
+                on_step = rule.get("on_step")
+                if on_step is not None and step != on_step:
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                return {
+                    "action": rule.get("action", "drop"),
+                    "seconds": float(rule.get("seconds", 0.05)),
+                }
+        return None
+
+    def leader_kill_fault(self, model: str, plan_idx: int) -> bool:
+        """True if the leader should be killed after publishing plan
+        ``plan_idx`` (ISSUE 17 failover chaos lane).  The soak harness
+        polls this after each published plan and, when it fires, stops
+        the leader loop mid-stream and promotes the standby — the
+        takeover the digest chain must prove.  Rule shape::
+
+            {"point": "leader_kill", "model": "m", "after_plan": 40,
+             "times": 1}
+
+        ``after_plan`` fires once the published index reaches it
+        (>=, not ==): plan indices can skip under discards."""
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "leader_kill":
+                    continue
+                if rule.get("model", "*") not in ("*", model):
+                    continue
+                after = rule.get("after_plan")
+                if after is not None and plan_idx < int(after):
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                return True
+        return False
+
+    def checkpoint_fault(self, model: str) -> Optional[dict]:
+        """Return the fault to apply to ONE leader-state checkpoint
+        write, or None (ISSUE 17 failover).  ``corrupt`` flips a byte
+        in the written blob — the standby's checksum validation MUST
+        reject it BEFORE any allocator mutation and fall back to the
+        next-newest checkpoint (or a typed failure), which is the
+        contract under test."""
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "checkpoint":
+                    continue
+                if rule.get("model", "*") not in ("*", model):
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                return {"mode": rule.get("mode", "corrupt")}
         return None
 
     def saturation_override(self, runner_id: str) -> Optional[dict]:

@@ -23,16 +23,29 @@ from helix_tpu.engine.sampling import SamplingParams
 from helix_tpu.models.common import ModelConfig
 from helix_tpu.models.llama import init_params
 from helix_tpu.serving.multihost_serving import (
+    CHECKPOINT_VERSION,
+    FOLLOWER_HEALTHY,
+    FOLLOWER_LAGGING,
+    RESYNC_HANDOFF_MISMATCH,
+    RESYNC_LEADER_RESTART,
+    RESYNC_RING_OVERFLOW,
     WIRE_VERSION,
+    CheckpointError,
+    CheckpointStore,
     CommandLog,
     FollowerLoop,
     LagError,
+    LocalFeed,
     LockstepLeader,
     PlanLeader,
+    ResyncRequired,
     WireVersionError,
+    cold_start_leader,
+    promote_follower,
     request_from_wire,
     request_to_wire,
 )
+from helix_tpu.testing import faults
 
 
 @pytest.fixture(scope="module")
@@ -598,9 +611,12 @@ class TestSampleProfiles:
             by_name[sp.name] = sp
         leader = by_name["v5e16-2host-llama3"].models[0]
         follower = by_name["v5e16-2host-llama3-follower"].models[0]
+        standby = by_name["v5e16-2host-llama3-standby"].models[0]
         assert leader.multihost["role"] == "leader"
         assert follower.multihost["role"] == "follower"
         assert follower.multihost["leader_url"]
+        assert standby.multihost["role"] == "follower"
+        assert standby.multihost["standby"] is True
 
     def test_two_host_profile_pair_agrees(self):
         """The leader/follower halves describe ONE global engine: model,
@@ -619,14 +635,16 @@ class TestSampleProfiles:
 
         leader = load("v5e16-2host-llama3.yaml")
         follower = load("v5e16-2host-llama3-follower.yaml")
-        assert leader.name == follower.name
+        standby = load("v5e16-2host-llama3-standby.yaml")
+        assert leader.name == follower.name == standby.name
         assert leader.checkpoint == follower.checkpoint
         assert leader.context_length == follower.context_length
-        assert leader.mesh == follower.mesh
+        assert leader.mesh == follower.mesh == standby.mesh
         assert leader.quantization == follower.quantization
         # the engine block is the step-shape contract: a verbatim match,
-        # not merely overlapping keys
-        assert leader.engine == follower.engine
+        # not merely overlapping keys (and the standby variant too — it
+        # must be able to BECOME the leader without a shape change)
+        assert leader.engine == follower.engine == standby.engine
         # and the pair actually exercises the plan-broadcast features
         assert leader.engine.get("enable_spec_decode") is True
         assert leader.engine.get("adapter_pool_slots", 0) >= 2
@@ -649,14 +667,32 @@ class TestCommandLog:
         t.join(timeout=5)
         assert got and got[0]["seq"] == 1
 
-    def test_ring_overflow_raises_lag(self):
+    def test_ring_overflow_returns_typed_resync_record(self):
+        """ISSUE 17 bugfix: overflow is no longer an unconditional fatal
+        LagError raised in the transport — the reader gets ONE typed
+        ``resync_required`` record whose reason distinguishes "I fell
+        behind" from "the leader restarted"."""
         logj = CommandLog(capacity=4)
         for _ in range(10):
             logj.publish({"step": True})
-        with pytest.raises(LagError):
-            logj.read_since(1, timeout=0.1)
-        # a reader inside the retained window still works
-        assert logj.read_since(8, timeout=0.1)
+        recs = logj.read_since(1, timeout=0.1)
+        assert [r["kind"] for r in recs] == ["resync_required"]
+        assert recs[0]["reason"] == RESYNC_RING_OVERFLOW
+        assert "fell behind the ring" in recs[0]["error"]
+        # seq echoes the reader: its applied_seq must not advance
+        assert recs[0]["seq"] == 1
+        # a reader inside the retained window still gets real records
+        live = logj.read_since(8, timeout=0.1)
+        assert live
+        assert all(r.get("kind") != "resync_required" for r in live)
+
+    def test_reader_ahead_of_journal_typed_as_leader_restart(self):
+        logj = CommandLog()
+        logj.publish({"step": True})
+        recs = logj.read_since(57, timeout=0.1)
+        assert [r["kind"] for r in recs] == ["resync_required"]
+        assert recs[0]["reason"] == RESYNC_LEADER_RESTART
+        assert "leader restart" in recs[0]["error"]
 
     def test_publish_throughput_is_flat_when_ring_full(self):
         """The ring is a deque: overflow is an O(1) popleft, so publish
@@ -743,6 +779,79 @@ class TestGuardLint:
             tmp_path, "helix_tpu/control/wiring.py", src
         ) == []
 
+    def test_reminted_state_literal_flagged(self, tmp_path):
+        """ISSUE 17 fence: quoting a follower-state / resync-reason
+        literal under the guarded dirs forks the state machine — import
+        FOLLOWER_*/RESYNC_* from multihost_serving instead."""
+        out = self._lint(
+            tmp_path, "helix_tpu/serving/health2.py",
+            "def throttle(st):\n"
+            "    return st == 'lagging'\n",
+        )
+        assert len(out) == 1
+        assert "import FOLLOWER_*/RESYNC_*" in out[0]
+        # ... unless the site carries the marker (e.g. a wire-format
+        # shim that must speak the literal)
+        out = self._lint(
+            tmp_path, "helix_tpu/serving/health2.py",
+            "def throttle(st):\n"
+            "    # multihost-ok: wire-format shim\n"
+            "    return st == 'ring_overflow'\n",
+        )
+        assert out == []
+
+    def test_mh_metric_name_fenced_to_module(self, tmp_path):
+        """helix_mh_* series may only be minted inside
+        multihost_serving.py (the _MH_NAME_RE + _is_mh pair run()
+        applies helix_tpu-wide)."""
+        import os
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        import lint_metrics
+
+        assert lint_metrics._MH_NAME_RE.search(
+            'c.gauge("helix_mh_follower_lag_steps", 1)'
+        )
+        assert not lint_metrics._MH_NAME_RE.search(
+            "# prose mentioning helix_mh_follower_lag_steps is fine"
+        )
+        root = str(tmp_path)
+        inside = os.path.join(
+            root, "helix_tpu", "serving", "multihost_serving.py"
+        )
+        outside = os.path.join(root, "helix_tpu", "obs", "extra.py")
+        assert lint_metrics._is_mh(inside, root)
+        assert not lint_metrics._is_mh(outside, root)
+
+    def test_importer_pattern_enforced(self, tmp_path):
+        """The consumers named in _MH_IMPORTERS must import their
+        symbol from multihost_serving; a present-but-unwired importer
+        is a violation, an absent file is skipped (partial trees)."""
+        import os
+        import sys
+
+        sys.path.insert(
+            0, os.path.join(os.path.dirname(__file__), "..", "tools")
+        )
+        import lint_metrics
+
+        mod = tmp_path / "helix_tpu" / "serving" / "multihost_serving.py"
+        mod.parent.mkdir(parents=True, exist_ok=True)
+        mod.write_text("def collect_mh_metrics():\n    pass\n")
+        api = tmp_path / "helix_tpu" / "serving" / "openai_api.py"
+        api.write_text("# no mh import here\n")
+        out = lint_metrics._mh_importer_violations(str(tmp_path))
+        assert len(out) == 1
+        assert "collect_mh_metrics" in out[0]
+        api.write_text(
+            "from helix_tpu.serving.multihost_serving import "
+            "collect_mh_metrics\n"
+        )
+        assert lint_metrics._mh_importer_violations(str(tmp_path)) == []
+
 
 class TestHTTPFeedRoute:
     def test_journal_served_over_http(self, tiny):
@@ -807,3 +916,516 @@ class TestHTTPFeedRoute:
         assert follower.applied_seq >= 1
         loop_obj.stop(join=False)
         holder["loop"].call_soon_threadsafe(holder["loop"].stop)
+
+
+class TestFollowerFanout:
+    """ISSUE 17: N followers on one leader — per-follower health in the
+    leader's registry, the lag ladder throttling admission instead of
+    overflowing the ring, and clean rejoin."""
+
+    def test_three_follower_mesh_health_and_bit_identity(self, tiny):
+        leader = PlanLeader(_engine(tiny))
+        followers = [
+            FollowerLoop(_engine(tiny), LocalFeed(leader, f"host-{i}"))
+            for i in range(3)
+        ]
+        reqs = [
+            Request(id=f"r{i}", prompt_tokens=[3 + i, 5, 8],
+                    sampling=SamplingParams(temperature=0.8, top_k=20,
+                                            max_tokens=8))
+            for i in range(3)
+        ]
+        for r in reqs:
+            leader.add_request(r)
+        _drain(leader)
+        for f in followers:
+            _replay(f)
+        # replays run serially and can outlast the liveness TTL on a
+        # slow CPU box; one fresh poll per follower is the real rejoin
+        # path (lost -> healthy on the next poll at lag 0)
+        for f in followers:
+            f.run_once(timeout=0.01)
+        health = leader.follower_health()
+        assert set(health) == {"host-0", "host-1", "host-2"}
+        for st in health.values():
+            assert st["state"] == FOLLOWER_HEALTHY
+            assert st["lag_steps"] == 0
+            assert st["digest_mismatches"] == 0
+        # every replica converged to the leader's exact tokens
+        for f in followers:
+            for r in reqs:
+                fr = f.engine._requests[r.id]
+                assert fr.output_tokens == r.output_tokens
+                assert fr.finished
+        ms = leader.mh_stats()
+        assert ms["follower_states"][FOLLOWER_HEALTHY] == 3
+        assert ms["follower_states"][FOLLOWER_LAGGING] == 0
+        assert ms["followers"]["host-1"]["applied_step"] == \
+            leader._last_plan_idx
+
+    def test_lagging_follower_throttles_admission_then_rejoins(
+        self, tiny, monkeypatch
+    ):
+        monkeypatch.setenv("HELIX_MH_LAG_STEPS", "4")
+        leader = PlanLeader(_engine(tiny))
+        assert leader.lag_steps_limit == 4
+        long_req = Request(id="bg", prompt_tokens=[2, 4, 6],
+                           sampling=SamplingParams(temperature=0.0,
+                                                   max_tokens=40))
+        leader.add_request(long_req)
+        for _ in range(8):
+            leader.step()
+        # a follower reports far behind (the health path every LocalFeed
+        # / HTTPFeed poll drives)
+        leader.note_poll("slow-1", 0, applied_step=0)
+        assert (leader.follower_health()["slow-1"]["state"]
+                == FOLLOWER_LAGGING)
+        # while lagging: admission throttled — the queued request stays
+        # waiting (budget pinned to 0 for the dispatch), decode continues
+        queued = Request(id="q", prompt_tokens=[9, 9],
+                         sampling=SamplingParams(temperature=0.0,
+                                                 max_tokens=3))
+        leader.add_request(queued)
+        leader.step()
+        assert leader.throttled_steps >= 1
+        assert any(r.id == "q" for r in leader.engine.waiting)
+        # catch-up past the hysteresis point flips healthy and admission
+        # resumes (clean rejoin, no ring overflow, no resync)
+        leader.note_poll("slow-1", leader.journal._next - 1,
+                         applied_step=leader._last_plan_idx)
+        assert (leader.follower_health()["slow-1"]["state"]
+                == FOLLOWER_HEALTHY)
+        throttled_before = leader.throttled_steps
+        _drain(leader)
+        assert leader.throttled_steps == throttled_before
+        assert leader.engine._requests["q"].finished
+        # and a fresh replica replays the whole stream bit-identically
+        # (the throttled plan carried budget=0, so no divergence)
+        fe = _engine(tiny)
+        follower = FollowerLoop(fe, leader.journal)
+        _replay(follower)
+        assert fe._requests["bg"].output_tokens == long_req.output_tokens
+        assert fe._requests["q"].output_tokens == queued.output_tokens
+        assert follower.stats()["digest_mismatches"] == 0
+
+    def test_follower_registry_bounded(self, tiny, monkeypatch):
+        monkeypatch.setenv("HELIX_MH_MAX_FOLLOWERS", "2")
+        leader = PlanLeader(_engine(tiny))
+        for i in range(5):
+            leader.note_poll(f"f-{i}", 0, applied_step=0)
+        assert len(leader.follower_health()) == 2
+        assert leader.followers_dropped == 3
+
+
+class TestCheckpointStore:
+    def _state(self, plan_idx, seq):
+        return {"version": CHECKPOINT_VERSION, "model": "m",
+                "plan_idx": plan_idx, "seq": seq,
+                "waiting": [], "snapshots": []}
+
+    def test_round_trip_and_prune(self, tmp_path):
+        store = CheckpointStore(str(tmp_path), keep=2)
+        for i in range(4):
+            ref, nbytes = store.save("m", self._state(i, i + 1))
+            assert nbytes > 0
+        assert len(store.list_refs("m")) == 2   # keep-newest-K prune
+        ref, state = store.load_latest("m")
+        assert state["plan_idx"] == 3
+        assert state == store.load(ref)          # byte-stable reload
+
+    def test_missing_checkpoint_typed(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        with pytest.raises(CheckpointError) as ei:
+            store.load_latest("nope")
+        assert ei.value.code == "checkpoint_missing"
+
+    def test_corrupt_blob_skipped_for_older_good_one(self, tmp_path):
+        """One bad write must not take failover down: load_latest skips
+        (and counts) the corrupt newest blob and serves the previous
+        good one.  Corruption is injected through the deterministic
+        fault hook — the same path chaos_soak drives."""
+        store = CheckpointStore(str(tmp_path), keep=4)
+        store.save("m", self._state(0, 1))
+        faults.arm(seed=0, rules=[
+            {"point": "checkpoint", "model": "m", "times": 1},
+        ])
+        try:
+            bad_ref, _ = store.save("m", self._state(1, 2))
+        finally:
+            faults.disarm()
+        with pytest.raises(CheckpointError):
+            store.load(bad_ref)
+        ref, state = store.load_latest("m")
+        assert state["plan_idx"] == 0
+        assert store.corrupt_rejected >= 1
+
+    def test_version_skew_rejected_typed(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        blob = __import__("json").dumps(
+            {"v": 99, "checksum": "", "payload": "{}"}
+        ).encode()
+        store.store.write(CheckpointStore.OWNER,
+                          "m/ckpt-0000000000000001-0000000000000001.json",
+                          blob)
+        with pytest.raises(CheckpointError) as ei:
+            store.load_latest("m")
+        assert ei.value.code == "checkpoint_version"
+
+
+FAILOVER_ECFG = dict(
+    max_decode_batch=2, page_size=4, num_pages=64, max_pages_per_seq=16,
+    max_prefill_len=16, attn_backend="reference",
+    host_pool_bytes=1 << 22,   # failover parks at the boundary: host tier on
+)
+
+
+def _fo_engine(tiny):
+    cfg, params = tiny
+    return Engine(cfg, params, EngineConfig(**FAILOVER_ECFG))
+
+
+class TestLeaderFailover:
+    """ISSUE 17 acceptance drill: kill the leader mid-stream, promote a
+    digest-verified standby through the filestore checkpoint, and the
+    mesh finishes every request bit-identical to an uninterrupted run —
+    greedy AND seeded sampled traffic, WFQ budget + spec + adapters on
+    for the featureful variant."""
+
+    def _reqs(self):
+        return [
+            Request(id="g0", prompt_tokens=[5, 6, 7, 5, 6],
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_tokens=12)),
+            Request(id="s1", prompt_tokens=[9, 9, 4, 9],
+                    sampling=SamplingParams(temperature=0.8, top_k=20,
+                                            max_tokens=12)),
+            Request(id="s2", prompt_tokens=[2, 3, 2],
+                    sampling=SamplingParams(temperature=0.9,
+                                            max_tokens=10)),
+        ]
+
+    def _featureful_reqs(self):
+        return [
+            Request(id="g0", prompt_tokens=[5, 6, 7, 5, 6, 7, 5, 6],
+                    sampling=SamplingParams(temperature=0.0,
+                                            max_tokens=10)),
+            Request(id="s1", prompt_tokens=[9, 9, 4, 9, 9, 4, 9, 9],
+                    sampling=SamplingParams(temperature=0.8, top_k=20,
+                                            max_tokens=10),
+                    adapter="a1", tenant="t1"),
+            Request(id="s2", prompt_tokens=[2, 3, 2, 3, 2, 3, 2],
+                    sampling=SamplingParams(temperature=0.9,
+                                            max_tokens=10),
+                    adapter="a2", sched_class="batch"),
+        ]
+
+    def _reference(self, make_engine, reqs, budget=None):
+        ref = PlanLeader(make_engine())
+        if budget is not None:
+            ref.prefill_budget = budget
+        for r in reqs:
+            ref.add_request(r)
+        _drain(ref)
+        return {r.id: list(r.output_tokens) for r in reqs}
+
+    def _takeover_drill(self, make_engine, reqs_fn, tmp_path,
+                        budget=None):
+        ref_out = self._reference(make_engine, reqs_fn(), budget=budget)
+        store = CheckpointStore(str(tmp_path))
+        leader = PlanLeader(make_engine(), checkpoint_store=store,
+                            name="m")
+        if budget is not None:
+            leader.prefill_budget = budget
+        standby = FollowerLoop(make_engine(), LocalFeed(leader, "sb-1"),
+                               name="m", standby=True,
+                               checkpoint_store=store)
+        peer = FollowerLoop(make_engine(), LocalFeed(leader, "peer-1"),
+                            name="m", checkpoint_store=store)
+        reqs = reqs_fn()
+        for r in reqs:
+            leader.add_request(r)
+        steps = 0
+        while leader.engine.has_work() and steps < 6:
+            leader.step()
+            steps += 1
+            time.sleep(0.02)
+            leader.checkpoint_tick()
+        store.flush(10)
+        assert store.writes >= 1, "no checkpoint ever landed"
+        while standby.run_once(timeout=0.01):
+            pass
+        while peer.run_once(timeout=0.01):
+            pass
+        assert leader.engine.has_work(), "traffic ended before the kill"
+        # KILL: the old leader publishes nothing further
+        new_leader = promote_follower(standby, store=store, name="m")
+        assert new_leader.takeovers == 1
+        assert new_leader.engine is standby.engine
+        # surviving peer re-points and crosses the handoff seamlessly
+        peer.feed.retarget(new_leader)
+        while new_leader.engine.has_work():
+            new_leader.step()
+        while peer.run_once(timeout=0.01):
+            pass
+        got = {rid: list(new_leader.engine._requests[rid].output_tokens)
+               for rid in ref_out}
+        assert got == ref_out, "takeover diverged from uninterrupted run"
+        assert peer.handoffs == 1
+        assert peer.digest_mismatches == 0
+        peer_got = {rid: list(peer.engine._requests[rid].output_tokens)
+                    for rid in ref_out}
+        assert peer_got == ref_out
+        for rid in ref_out:
+            assert new_leader.engine._requests[rid].finished
+        return store, new_leader, peer
+
+    def test_takeover_bit_identity(self, tiny, monkeypatch):
+        monkeypatch.setenv("HELIX_MH_CHECKPOINT_SECONDS", "0.01")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            store, new_leader, peer = self._takeover_drill(
+                lambda: _fo_engine(tiny), self._reqs, tmp
+            )
+            # fresh follower bootstraps from the handoff checkpoint
+            fresh = FollowerLoop(_fo_engine(tiny),
+                                 LocalFeed(new_leader, "fresh-1"),
+                                 name="m", checkpoint_store=store)
+            while fresh.run_once(timeout=0.01):
+                pass
+            assert fresh.handoffs == 1
+            assert fresh.digest_mismatches == 0
+            assert fresh._applied_step == new_leader._last_plan_idx
+            ms = new_leader.mh_stats()
+            assert ms["follower_states"][FOLLOWER_HEALTHY] >= 2
+
+    def test_takeover_bit_identity_all_features(self, featureful,
+                                                monkeypatch):
+        """WFQ budget + spec decode + two live adapters through the
+        kill: the checkpoint carries budget/spec EMAs/adapter refs and
+        the promoted leader finishes bit-identical anyway."""
+        monkeypatch.setenv("HELIX_MH_CHECKPOINT_SECONDS", "0.01")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            _store, new_leader, _peer = self._takeover_drill(
+                featureful, self._featureful_reqs, tmp, budget=8
+            )
+            assert new_leader.engine.prefill_budget == 8
+            assert new_leader.engine.num_spec_steps > 0
+
+    def test_corrupt_checkpoint_rejected_before_any_mutation(
+        self, tiny, monkeypatch
+    ):
+        """Validate-before-mutate: when every checkpoint blob fails its
+        checksum, promotion refuses typed and the standby's allocator
+        is untouched (it can keep running as a follower)."""
+        monkeypatch.setenv("HELIX_MH_CHECKPOINT_SECONDS", "0.01")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            store = CheckpointStore(tmp)
+            leader = PlanLeader(_fo_engine(tiny), checkpoint_store=store,
+                                name="m")
+            standby = FollowerLoop(_fo_engine(tiny),
+                                   LocalFeed(leader, "sb-1"),
+                                   name="m", standby=True,
+                                   checkpoint_store=store)
+            req = Request(id="r", prompt_tokens=[2, 4, 6],
+                          sampling=SamplingParams(temperature=0.0,
+                                                  max_tokens=30))
+            leader.add_request(req)
+            faults.arm(seed=0, rules=[
+                {"point": "checkpoint", "model": "m", "p": 1.0},
+            ])
+            try:
+                for _ in range(4):
+                    leader.step()
+                    time.sleep(0.02)
+                    leader.checkpoint_tick()
+                store.flush(10)
+            finally:
+                faults.disarm()
+            assert store.writes >= 1
+            while standby.run_once(timeout=0.01):
+                pass
+            active_before = [r.id for r in standby.engine.slots
+                             if r is not None]
+            assert active_before, "nothing active at the boundary"
+            with pytest.raises(CheckpointError):
+                promote_follower(standby, store=store, name="m")
+            assert [r.id for r in standby.engine.slots
+                    if r is not None] == active_before
+            assert standby.engine.num_preemptions == 0
+
+    def test_takeover_past_overflowed_ring_typed_fallback(
+        self, tiny, monkeypatch
+    ):
+        """A standby that fell off the ring cannot silently become
+        leader (it would re-decide steps the mesh already executed):
+        promotion refuses with the typed ring_overflow reason and the
+        operator lands on today's full-resync ladder."""
+        monkeypatch.setenv("HELIX_MH_CHECKPOINT_SECONDS", "0.01")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            store = CheckpointStore(tmp)
+            leader = PlanLeader(_fo_engine(tiny),
+                                journal=CommandLog(capacity=4),
+                                checkpoint_store=store, name="m")
+            standby = FollowerLoop(_fo_engine(tiny),
+                                   LocalFeed(leader, "sb-1"),
+                                   name="m", standby=True,
+                                   checkpoint_store=store)
+            req = Request(id="r", prompt_tokens=[2, 4, 6],
+                          sampling=SamplingParams(temperature=0.0,
+                                                  max_tokens=40))
+            leader.add_request(req)
+            leader.step()
+            standby.run_once(timeout=0.01)   # applies the head
+            assert standby._applied_step >= 0
+            # leader runs FAR ahead of the 4-slot ring, checkpointing
+            for _ in range(10):
+                leader.step()
+                time.sleep(0.02)
+                leader.checkpoint_tick()
+            store.flush(10)
+            assert store.writes >= 1
+            with pytest.raises(ResyncRequired) as ei:
+                promote_follower(standby, store=store, name="m")
+            assert ei.value.reason == RESYNC_RING_OVERFLOW
+            assert standby.engine.num_preemptions == 0
+
+    def test_handoff_mismatch_peer_gets_typed_resync(self, tiny,
+                                                     monkeypatch):
+        """A non-standby peer behind the takeover boundary cannot cross
+        the handoff (its replica diverges from the parked boundary) —
+        it fails typed with handoff_mismatch and restarts fresh."""
+        monkeypatch.setenv("HELIX_MH_CHECKPOINT_SECONDS", "0.01")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            store = CheckpointStore(tmp)
+            leader = PlanLeader(_fo_engine(tiny), checkpoint_store=store,
+                                name="m")
+            standby = FollowerLoop(_fo_engine(tiny),
+                                   LocalFeed(leader, "sb-1"),
+                                   name="m", standby=True,
+                                   checkpoint_store=store)
+            laggard = FollowerLoop(_fo_engine(tiny),
+                                   LocalFeed(leader, "lag-1"),
+                                   name="m", checkpoint_store=store)
+            req = Request(id="r", prompt_tokens=[2, 4, 6],
+                          sampling=SamplingParams(temperature=0.0,
+                                                  max_tokens=40))
+            leader.add_request(req)
+            leader.step()
+            laggard.run_once(timeout=0.01)   # applies step 0, then stalls
+            behind = laggard._applied_step
+            for _ in range(5):
+                leader.step()
+                time.sleep(0.02)
+                leader.checkpoint_tick()
+            store.flush(10)
+            while standby.run_once(timeout=0.01):
+                pass
+            new_leader = promote_follower(standby, store=store, name="m")
+            assert new_leader._last_plan_idx > behind
+            laggard.feed.retarget(new_leader)
+            with pytest.raises(ResyncRequired) as ei:
+                laggard.run_once(timeout=0.01)
+            assert ei.value.reason == RESYNC_HANDOFF_MISMATCH
+            assert laggard.resync_reason == RESYNC_HANDOFF_MISMATCH
+
+    def test_cold_start_leader_finishes_waiting_work(self, tiny,
+                                                     monkeypatch):
+        """Last-resort rung: a FRESH process resumes from the newest
+        checkpoint alone.  Requests still waiting (never admitted) at
+        the checkpoint finish — delivery for them is exactly-once even
+        here, since no step ever ran them before the crash."""
+        monkeypatch.setenv("HELIX_MH_CHECKPOINT_SECONDS", "0.01")
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            store = CheckpointStore(tmp)
+            leader = PlanLeader(_fo_engine(tiny), checkpoint_store=store,
+                                name="m")
+            active = [
+                Request(id=f"a{i}", prompt_tokens=[3 + i, 5],
+                        sampling=SamplingParams(temperature=0.0,
+                                                max_tokens=30))
+                for i in range(2)
+            ]
+            for r in active:
+                leader.add_request(r)
+            leader.step()            # fills both decode slots
+            queued = Request(id="q", prompt_tokens=[8, 9],
+                             sampling=SamplingParams(temperature=0.0,
+                                                     max_tokens=4))
+            leader.add_request(queued)   # waits behind the full batch
+            leader.step()
+            time.sleep(0.02)
+            leader.checkpoint_tick()
+            store.flush(10)
+            assert store.writes >= 1
+            # leader dies; a fresh process cold-starts from the store
+            new_leader = cold_start_leader(_fo_engine(tiny), store,
+                                           name="m")
+            assert new_leader.takeovers == 1
+            _drain(new_leader)
+            assert new_leader.engine._requests["q"].finished
+            assert len(new_leader.engine._requests["q"].output_tokens) > 0
+
+
+class TestPlanFeedFaults:
+    """Satellite: the plan-feed fault family (testing/faults.py) proves
+    the _pump seq discipline repairs duplicated/reordered transports and
+    a dropped record re-reads from the ring instead of diverging."""
+
+    def test_duplicate_and_reorder_are_repaired(self, tiny):
+        leader = PlanLeader(_engine(tiny), name="m")
+        req = Request(id="r", prompt_tokens=[2, 4, 6],
+                      sampling=SamplingParams(temperature=0.7, top_k=9,
+                                              max_tokens=8))
+        leader.add_request(req)
+        _drain(leader)
+        fe = _engine(tiny)
+        follower = FollowerLoop(fe, leader.journal, name="m")
+        faults.arm(seed=3, rules=[
+            {"point": "plan_feed", "model": "m", "action": "duplicate",
+             "p": 0.5},
+            {"point": "plan_feed", "model": "m", "action": "reorder",
+             "p": 0.3},
+        ])
+        try:
+            _replay(follower)
+        finally:
+            faults.disarm()
+        assert fe._requests["r"].output_tokens == req.output_tokens
+        assert follower.stats()["digest_mismatches"] == 0
+        assert follower.records_duplicate > 0, "faults never fired"
+
+    def test_dropped_records_rereads_from_ring(self, tiny):
+        leader = PlanLeader(_engine(tiny), name="m")
+        req = Request(id="r", prompt_tokens=[1, 3, 5],
+                      sampling=SamplingParams(temperature=0.0,
+                                              max_tokens=8))
+        leader.add_request(req)
+        _drain(leader)
+        fe = _engine(tiny)
+        follower = FollowerLoop(fe, leader.journal, name="m")
+        faults.arm(seed=11, rules=[
+            {"point": "plan_feed", "model": "m", "action": "drop",
+             "p": 0.4},
+        ])
+        try:
+            for _ in range(200):
+                if not follower.run_once(timeout=0.01):
+                    # drained AND nothing dropped on the final pass?
+                    if fe._requests.get("r") is not None and \
+                            fe._requests["r"].finished:
+                        break
+        finally:
+            faults.disarm()
+        _replay(follower)          # clean tail read
+        assert fe._requests["r"].output_tokens == req.output_tokens

@@ -868,6 +868,198 @@ def run_disagg(seconds: float = 10.0, seed: int = 42) -> dict:
     }
 
 
+def run_multihost(seconds: float = 10.0, seed: int = 42) -> dict:
+    """ISSUE 17 scenario: the plan-broadcast leader dies, over and over.
+
+    A leader + hot standby + ordinary follower mesh serves a continuous
+    mixed stream (greedy AND seeded sampled).  Every few seconds the
+    leader is killed mid-stream: the standby is promoted through the
+    filestore checkpoint + CommandLog-tail replay (the real
+    ``promote_follower`` path, digest-verified), the surviving follower
+    re-points its feed across the handoff record, and a FRESH standby
+    bootstraps from the handoff checkpoint so the mesh is always one
+    kill away from another takeover.
+
+    Exit contract: **zero stuck requests**, ≥1 real takeover, and every
+    request's committed stream on the final leader AND on the surviving
+    follower replica is BIT-IDENTICAL to an uninterrupted single-host
+    reference run (explicit per-request sampling seeds make the
+    reference exact across takeovers)."""
+    import tempfile
+
+    import jax
+
+    from helix_tpu.engine.engine import Engine, EngineConfig, Request
+    from helix_tpu.engine.sampling import SamplingParams
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.serving.multihost_serving import (
+        CheckpointStore,
+        FollowerLoop,
+        LocalFeed,
+        PlanLeader,
+        ResyncRequired,
+        promote_follower,
+    )
+
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def build_engine():
+        return Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=4, page_size=4, num_pages=256,
+                max_pages_per_seq=64, max_prefill_len=64,
+                attn_backend="reference",
+                # failover parks in-flight requests at the takeover
+                # boundary through the host tier
+                host_pool_bytes=1 << 22,
+            ),
+        )
+
+    rng = random.Random(seed)
+    prompts: dict[str, tuple] = {}   # rid -> (prompt, max_toks, temp, seed)
+    takeover_ms: list = []
+    resyncs = [0]
+
+    def pump(f):
+        while f.run_once(timeout=0.0):
+            pass
+
+    prev_ckpt = os.environ.get("HELIX_MH_CHECKPOINT_SECONDS")
+    os.environ["HELIX_MH_CHECKPOINT_SECONDS"] = "0.05"
+    tmp = tempfile.mkdtemp(prefix="mh-soak-")
+    try:
+        store = CheckpointStore(tmp)
+        leader = PlanLeader(build_engine(), checkpoint_store=store,
+                            name="m")
+        standby = FollowerLoop(build_engine(), LocalFeed(leader, "sb-0"),
+                               name="m", standby=True,
+                               checkpoint_store=store)
+        peer = FollowerLoop(build_engine(), LocalFeed(leader, "peer"),
+                            name="m", checkpoint_store=store)
+        kill_every = max(1.5, seconds / 3.0)
+        t0 = time.monotonic()
+        next_kill = t0 + kill_every
+        n = 0
+        gen = 0
+        while time.monotonic() - t0 < seconds:
+            if n == 0 or rng.random() < 0.5:
+                n += 1
+                rid = f"mh-{n}"
+                prompt = [rng.randrange(4, 260)
+                          for _ in range(rng.randrange(4, 20))]
+                max_toks = rng.randrange(8, 40)
+                temp = rng.choice([0.0, 0.8])
+                sp_seed = rng.randrange(1 << 30)
+                prompts[rid] = (prompt, max_toks, temp, sp_seed)
+                leader.add_request(Request(
+                    id=rid, prompt_tokens=prompt,
+                    sampling=SamplingParams(
+                        temperature=temp, max_tokens=max_toks,
+                        seed=sp_seed,
+                    ),
+                ))
+            if leader.engine.has_work():
+                leader.step()
+            leader.checkpoint_tick()
+            pump(standby)
+            try:
+                pump(peer)
+            except ResyncRequired:
+                # the operator ladder: behind the handoff boundary ->
+                # full resync (fresh replica bootstraps from the ring)
+                resyncs[0] += 1
+                peer = FollowerLoop(
+                    build_engine(), LocalFeed(leader, "peer"),
+                    name="m", checkpoint_store=store,
+                )
+                pump(peer)
+            if time.monotonic() >= next_kill:
+                # KILL the leader: it publishes nothing further.  The
+                # standby takes over through checkpoint + log tail.
+                store.flush(10.0)
+                gen += 1
+                leader = promote_follower(standby, store=store,
+                                          name="m")
+                takeover_ms.append(float(leader.takeover_ms))
+                try:
+                    peer.feed.retarget(leader)
+                    pump(peer)
+                except ResyncRequired:
+                    resyncs[0] += 1
+                    peer = FollowerLoop(
+                        build_engine(), LocalFeed(leader, "peer"),
+                        name="m", checkpoint_store=store,
+                    )
+                    pump(peer)
+                standby = FollowerLoop(
+                    build_engine(), LocalFeed(leader, f"sb-{gen}"),
+                    name="m", standby=True, checkpoint_store=store,
+                )
+                pump(standby)   # bootstraps from the handoff checkpoint
+                next_kill = time.monotonic() + kill_every
+        # drain: finish everything on the final leader, replicas follow
+        deadline = time.monotonic() + 90.0
+        while leader.engine.has_work() and time.monotonic() < deadline:
+            leader.step()
+            leader.checkpoint_tick()
+            pump(standby)
+            pump(peer)
+        pump(standby)
+        pump(peer)
+        mh = leader.mh_stats()
+    finally:
+        if prev_ckpt is None:
+            os.environ.pop("HELIX_MH_CHECKPOINT_SECONDS", None)
+        else:
+            os.environ["HELIX_MH_CHECKPOINT_SECONDS"] = prev_ckpt
+
+    stuck = sorted(
+        rid for rid in prompts
+        if rid not in leader.engine._requests
+        or not leader.engine._requests[rid].finished
+    )
+    # bit-identity: solo replay of every request on a fresh engine —
+    # explicit seeds mean batching and takeovers cannot change streams
+    ref_engine = build_engine()
+    mismatches = []
+    for rid in sorted(prompts):
+        if rid in stuck:
+            continue
+        prompt, max_toks, temp, sp_seed = prompts[rid]
+        ref = Request(
+            id=f"ref-{rid}", prompt_tokens=list(prompt),
+            sampling=SamplingParams(temperature=temp,
+                                    max_tokens=max_toks, seed=sp_seed),
+        )
+        ref_engine.add_request(ref)
+        while not ref.finished:
+            ref_engine.step()
+        got = leader.engine._requests[rid].output_tokens
+        if got != ref.output_tokens:
+            mismatches.append((rid, "leader diverged"))
+        pr = peer.engine._requests.get(rid)
+        if pr is not None and pr.output_tokens != ref.output_tokens:
+            mismatches.append((rid, "follower replica diverged"))
+    counts: dict[str, int] = {"finished": len(prompts) - len(stuck)}
+    return {
+        "submitted": n,
+        "takeovers": len(takeover_ms),
+        "takeover_blackout_ms": takeover_ms,
+        "checkpoints": int(mh.get("checkpoints_captured", 0)),
+        "peer_handoffs": int(peer.handoffs),
+        "peer_resyncs": resyncs[0],
+        "migrated": len(prompts) - len(stuck),
+        "stuck": stuck,
+        "mismatches": mismatches,
+        "outcomes": counts,
+        "healthy_after": not stuck and not mismatches,
+        "stats": mh,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float, default=10.0)
@@ -875,7 +1067,8 @@ def main(argv=None) -> int:
     ap.add_argument("--step-fault-p", type=float, default=0.02)
     ap.add_argument(
         "--scenario",
-        choices=("faults", "memory", "crash", "scale", "disagg"),
+        choices=("faults", "memory", "crash", "scale", "disagg",
+                 "multihost"),
         default="faults",
         help="faults: injected step/dispatch faults (ISSUE 2); memory: "
         "sustained KV exhaustion against the tiering/preemption ladder "
@@ -886,7 +1079,10 @@ def main(argv=None) -> int:
         "stuck, zero lost tokens via the migration path (ISSUE 12); "
         "disagg: prefill/decode handoffs under injected transfer faults "
         "(drop/corrupt/slow/partial) — zero stuck, zero wrong tokens, "
-        "every failure degrades to local serving (ISSUE 14)",
+        "every failure degrades to local serving (ISSUE 14); "
+        "multihost: repeated plan-leader kills with digest-verified "
+        "standby takeover through the filestore checkpoint — zero "
+        "stuck, every stream bit-identical across handoffs (ISSUE 17)",
     )
     args = ap.parse_args(argv)
     if args.scenario == "memory":
@@ -897,6 +1093,8 @@ def main(argv=None) -> int:
         res = run_scale(seconds=args.seconds, seed=args.seed)
     elif args.scenario == "disagg":
         res = run_disagg(seconds=args.seconds, seed=args.seed)
+    elif args.scenario == "multihost":
+        res = run_multihost(seconds=args.seconds, seed=args.seed)
     else:
         res = run_soak(
             seconds=args.seconds, seed=args.seed,
@@ -915,6 +1113,27 @@ def main(argv=None) -> int:
     if args.scenario == "memory" and not res.get("tiering_moved"):
         print("KV TIERING COUNTERS DID NOT MOVE", file=sys.stderr)
         return 1
+    if args.scenario == "multihost":
+        if res.get("mismatches"):
+            print(
+                f"STREAMS DIVERGED ACROSS TAKEOVER: {res['mismatches']}",
+                file=sys.stderr,
+            )
+            return 1
+        if not res.get("takeovers"):
+            print("NO LEADER KILL ACTUALLY EXERCISED A TAKEOVER",
+                  file=sys.stderr)
+            return 1
+        blackouts = ", ".join(
+            f"{ms:.0f}" for ms in res["takeover_blackout_ms"]
+        )
+        print(
+            f"multihost takeovers: {res['takeovers']} "
+            f"(blackout ms: [{blackouts}]), checkpoints: "
+            f"{res['checkpoints']}, peer handoffs: "
+            f"{res['peer_handoffs']} (resyncs: {res['peer_resyncs']}) — "
+            "all streams bit-identical to an uninterrupted run"
+        )
     if args.scenario in ("crash", "scale", "disagg"):
         if res.get("mismatches"):
             print(

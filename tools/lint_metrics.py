@@ -104,6 +104,19 @@ Contracts, enforced repo-wide (wired into tier-1 via
    genuine transport site carries a ``multihost-ok: <why>`` marker on
    the line or in a comment within the two lines above it.
 
+   ISSUE 17 extends the fence to the mesh-health vocabulary: the
+   ``helix_mh_*`` metric family is minted ONLY by
+   ``serving/multihost_serving.py`` (quoted literal anywhere else in
+   ``helix_tpu/`` fails), the follower state-machine and resync-reason
+   literals (``"healthy"``/``"lagging"``/``"lost"``,
+   ``"ring_overflow"``/``"leader_restart"``/``"handoff_mismatch"``/
+   ``"checkpoint_rejected"``) stay quoted only there — consumers under
+   the guarded dirs import ``FOLLOWER_*``/``RESYNC_*`` instead of
+   re-minting strings that would silently fork the state machine — and
+   the scrape/heartbeat surfaces keep routing through the module's
+   helpers (``collect_mh_metrics``, ``mh_heartbeat_block``,
+   ``validate_mh_block``: the contracts 3-11 importer pattern).
+
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
 """
@@ -651,6 +664,59 @@ _MH_GUARD_DIRS = (
 _MH_GUARD_EXEMPT = os.path.join(
     "helix_tpu", "serving", "multihost_serving.py"
 )
+# ISSUE 17: the mesh-health vocabulary is part of the same fence.
+# Quoted helix_mh_* metric names anywhere else in helix_tpu/ re-mint
+# the family; quoted follower-state / resync-reason literals under the
+# guarded dirs fork the state machine (import FOLLOWER_*/RESYNC_*
+# from multihost_serving instead).
+_MH_NAME_RE = re.compile(r"""["']helix_mh_[a-z0-9_]*["']""")
+_MH_STATE_RE = re.compile(
+    r"""["'](?:healthy|lagging|lost|ring_overflow|leader_restart"""
+    r"""|handoff_mismatch|checkpoint_rejected)["']"""
+)
+# (file, required symbol): scrape + heartbeat surfaces keep routing
+# through the owning module's helpers
+_MH_IMPORTERS = (
+    (
+        os.path.join("helix_tpu", "serving", "openai_api.py"),
+        "collect_mh_metrics",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "node_agent.py"),
+        "mh_heartbeat_block",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "server.py"),
+        "validate_mh_block",
+    ),
+)
+
+
+def _is_mh(path: str, root: str) -> bool:
+    rel = os.path.relpath(path, root)
+    return rel == _MH_GUARD_EXEMPT
+
+
+def _mh_importer_violations(root: str) -> list:
+    violations = []
+    mod = os.path.join(root, _MH_GUARD_EXEMPT)
+    if not os.path.isfile(mod):
+        return [
+            "helix_tpu/serving/multihost_serving.py: missing — the "
+            "mesh-health vocabulary must live there"
+        ]
+    for rel, symbol in _MH_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if symbol not in f.read():
+                violations.append(
+                    f"{rel}: does not call {symbol} from "
+                    "helix_tpu/serving/multihost_serving.py (the "
+                    "mesh-health importer pattern)"
+                )
+    return violations
 
 
 def _blank_tokens(src: str, kinds) -> list:
@@ -696,6 +762,12 @@ def _mh_guard_violations(root: str) -> list:
                 what = "leader-journal sniff (hasattr/getattr 'journal')"
             elif _MH_GUARD_TOKEN.search(line):
                 what = "lockstep/multihost token in code"
+            elif _MH_STATE_RE.search(no_comments[i - 1]):
+                what = (
+                    "re-minted follower-state/resync-reason literal — "
+                    "import FOLLOWER_*/RESYNC_* from multihost_serving "
+                    "instead of quoting the state machine"
+                )
             else:
                 continue
             if any(_MH_GUARD_OK in w for w in raw[max(0, i - 3):i]):
@@ -721,6 +793,7 @@ def run(root: str) -> list:
     violations += _adapter_schema_violations(root)
     violations += _host_sync_violations(root)
     violations += _mh_guard_violations(root)
+    violations += _mh_importer_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
     sched_reason_res = [
@@ -741,7 +814,14 @@ def run(root: str) -> list:
         autoscale_emitter = _is_autoscale(path, root)
         kv_filestore_emitter = _is_kv_filestore(path, root)
         adapter_emitter = _is_adapters(path, root)
+        mh_emitter = _is_mh(path, root)
         for i, line in enumerate(lines, 1):
+            if not mh_emitter and _MH_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: helix_mh_* metric family named outside "
+                    "helix_tpu/serving/multihost_serving.py — mesh-"
+                    "health series must come from the broadcast module"
+                )
             if not adapter_emitter and _ADAPTER_NAME_RE.search(line):
                 violations.append(
                     f"{rel}:{i}: helix_adapter_* metric family named "
