@@ -1754,6 +1754,168 @@ def main():
         "checkpoint_bytes": int(_nbytes),
     })
 
+    # -- trace federation (ISSUE 18): span overhead + spans/request ----
+    # Host-side by construction (the device step records nothing), so
+    # the numbers to watch are the runner's per-span record tax with
+    # federation on vs off, the cp's per-span ingest cost, and how many
+    # spans each serving flow actually emits at the engine plane.
+    import threading as _obs_th
+
+    from helix_tpu.obs.trace import TraceFederation as _TraceFed
+    from helix_tpu.obs.trace import TraceStore as _TraceStore
+    from helix_tpu.serving.engine_loop import EngineLoop as _ObsLoop
+    from helix_tpu.serving.migration import (
+        snapshot_to_wire as _snap_to_wire,
+    )
+    from helix_tpu.serving.migration import (
+        wire_to_snapshot as _wire_to_snap,
+    )
+
+    _SPAN_N = 20000
+    _mono = time.monotonic()
+
+    def _record_pass(store):
+        t0 = time.perf_counter()
+        for i in range(_SPAN_N):
+            store.record(
+                f"bench-trace-{i & 127:06d}", "bench span", _mono,
+                _mono + 1e-4, plane="engine", request_id="r", step=i,
+            )
+        return (time.perf_counter() - t0) / _SPAN_N * 1e9
+
+    _obs_off_ns = _record_pass(_TraceStore(max_traces=256))
+    _st_on = _TraceStore(max_traces=256)
+    _st_on.enable_export(cap=65536)
+    _obs_on_ns = _record_pass(_st_on)
+    _obs_batch = {"spans": _st_on.drain_export(limit=4096)}
+    _obs_fed = _TraceFed(local=_TraceStore(), max_traces=4096)
+    _t0 = time.perf_counter()
+    _obs_fed.ingest("bench-runner", _obs_batch)
+    _obs_ing_ns = (
+        (time.perf_counter() - _t0)
+        / max(1, len(_obs_batch["spans"])) * 1e9
+    )
+
+    # spans per request at the always-on engine plane, counted from
+    # real EngineLoop flows with per-"host" stores (the HTTP planes
+    # stack their dispatch/handoff spans on top of these)
+    def _obs_loop(tag):
+        st = _TraceStore()
+        lp = _ObsLoop(make_engine(kv_dtype), name=f"bench-obs-{tag}")
+        lp._trace = st
+        lp.start()
+        return lp, st
+
+    _obs_prompt = [(13 * j) % (cfg.vocab_size - 2) + 1
+                   for j in range(24)]
+    _obs_sampling = SamplingParams(temperature=0.0, max_tokens=16)
+
+    def _obs_span_count(tid, *stores):
+        total = 0
+        for st in stores:
+            doc = st.get(tid)
+            total += len(doc["spans"]) if doc else 0
+        return total
+
+    def _obs_submit(lp, tid, rid):
+        ev = _obs_th.Event()
+
+        def cb(e):
+            if e.finished:
+                ev.set()
+
+        lp.submit(
+            Request(id=rid, prompt_tokens=list(_obs_prompt),
+                    sampling=_obs_sampling, trace_id=tid),
+            cb,
+        )
+        return ev
+
+    # plain: one colocated streamed request
+    _lp_plain, _st_plain = _obs_loop("plain")
+    _obs_submit(_lp_plain, "bench-plain-00001", "obs-plain").wait(120)
+    spans_plain = _obs_span_count("bench-plain-00001", _st_plain)
+    _lp_plain.stop(join=True)
+
+    # disagg: staged prefill export on one loop, checksum-validated
+    # import + decode on the other, source aborted on confirmed ship
+    _lp_pre, _st_pre = _obs_loop("pre")
+    _lp_dec, _st_dec = _obs_loop("dec")
+    _snap_box = {}
+    _ev_snap = _obs_th.Event()
+
+    def _on_export(kind, wire):
+        _snap_box["kind"], _snap_box["wire"] = kind, wire
+        _ev_snap.set()
+
+    _lp_pre.stage_disagg_export("obs-disagg", _on_export)
+    _ev_fin = _obs_submit(_lp_pre, "bench-disagg-0001", "obs-disagg")
+    assert _ev_snap.wait(120)
+    spans_disagg = None
+    if _snap_box["kind"] == "snapshot":
+        _ev_imp = _obs_th.Event()
+        _ev_dec = _obs_th.Event()
+
+        def _dec_cb(e):
+            if e.finished:
+                _ev_dec.set()
+
+        _lp_dec.submit_import(
+            _wire_to_snap(_snap_box["wire"]), _dec_cb,
+            on_result=lambda err, code: _ev_imp.set(),
+        )
+        assert _ev_imp.wait(120)
+        _lp_pre.abort("obs-disagg")
+        assert _ev_dec.wait(120)
+        spans_disagg = _obs_span_count(
+            "bench-disagg-0001", _st_pre, _st_dec
+        )
+    else:
+        _ev_fin.wait(120)   # short-generation fallback: served locally
+        spans_disagg = _obs_span_count("bench-disagg-0001", _st_pre)
+
+    # migrated: mid-decode snapshot through the real wire format,
+    # continuation on the peer loop
+    _mig_eng = make_engine(kv_dtype)
+    _mig_req = Request(
+        id="obs-mig", prompt_tokens=list(_obs_prompt),
+        sampling=_obs_sampling, trace_id="bench-migrate-001",
+    )
+    _mig_eng.add_request(_mig_req)
+    while len(_mig_req.output_tokens) < 4 and _mig_eng.has_work():
+        _mig_eng.step()
+    _mig_wire = _snap_to_wire(_mig_eng.export_request("obs-mig"))
+    _ev_mimp, _ev_mdec = _obs_th.Event(), _obs_th.Event()
+
+    def _mig_cb(e):
+        if e.finished:
+            _ev_mdec.set()
+
+    _lp_dec.submit_import(
+        _wire_to_snap(_mig_wire), _mig_cb,
+        on_result=lambda err, code: _ev_mimp.set(),
+    )
+    assert _ev_mimp.wait(120) and _ev_mdec.wait(120)
+    spans_migrated = _obs_span_count("bench-migrate-001", _st_dec)
+    _lp_pre.stop(join=True)
+    _lp_dec.stop(join=True)
+    del _mig_eng
+
+    result["observability"] = {
+        "span_record_ns": round(_obs_off_ns, 1),
+        "span_record_federated_ns": round(_obs_on_ns, 1),
+        "federation_overhead_ns_per_span": round(
+            _obs_on_ns - _obs_off_ns, 1
+        ),
+        "cp_ingest_ns_per_span": round(_obs_ing_ns, 1),
+        "export_batch_spans": len(_obs_batch["spans"]),
+        "spans_per_request_engine_plane": {
+            "plain": spans_plain,
+            "disagg": spans_disagg,
+            "migrated": spans_migrated,
+        },
+    }
+
     if on_tpu:
         # decode-side model FLOPs utilisation: each generated token moves
         # ~2 FLOPs per active parameter through the MXU; a v5e chip peaks

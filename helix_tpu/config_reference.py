@@ -488,6 +488,44 @@ ENV_REFERENCE: tuple = (
         default="0",
         section="observability",
     ),
+    # trace federation (ISSUE 18): the push cadence is the heartbeat
+    # interval — spans ride the existing beat, so there is no separate
+    # interval knob to tune (or forget)
+    EnvVar(
+        "HELIX_TRACE_FEDERATION",
+        "Set to 0/false/off to stop runners pushing completed trace "
+        "spans to the control plane inside the heartbeat payload. On "
+        "(the default) the cp stitches every host's spans per trace id "
+        "and serves the cluster-wide timeline at /v1/debug/traces/"
+        "{id}; off, each host only answers for its own spans.",
+        default="1",
+        section="observability",
+    ),
+    EnvVar(
+        "HELIX_TRACE_EXPORT_BATCH",
+        "Maximum spans one heartbeat may carry (and the control "
+        "plane's per-batch ingest clamp). Spans beyond the batch wait "
+        "for the next beat; the export ring bounds how many can wait.",
+        default="256",
+        section="observability",
+    ),
+    EnvVar(
+        "HELIX_TRACE_BUFFER",
+        "Runner-side pending-export ring size. When the heartbeat "
+        "falls behind span production, the OLDEST unsent span is "
+        "dropped and counted in helix_trace_dropped_spans_total — "
+        "memory stays bounded, loss stays visible.",
+        default="2048",
+        section="observability",
+    ),
+    EnvVar(
+        "HELIX_TRACE_CP_TRACES",
+        "How many federated traces the control plane retains (LRU "
+        "beyond that; a dead runner's spans are pruned with the "
+        "runner regardless).",
+        default="2048",
+        section="observability",
+    ),
     # -- scheduler (serving/sched.py; README "Scheduling") ---------------
     # HELIX_SCHED_* knobs beat the profile's slo.sched block (the
     # HELIX_SPEC_TOKENS operator-override contract)

@@ -24,6 +24,7 @@ log = logging.getLogger("helix.node_agent")
 
 from helix_tpu.control.profile import ProfileModel, ServingProfile
 from helix_tpu.device.detect import detect_accelerators
+from helix_tpu.obs import trace as obs_trace
 from helix_tpu.obs.flight import SATURATION_KEYS
 from helix_tpu.serving.registry import ModelRegistry, ServedModel
 
@@ -491,6 +492,12 @@ class NodeAgent:
         # 12 autoscale scale-down / operator drain): the CLI wires this
         # to process exit so a drained node actually releases its host
         self.on_drain: Optional[Callable[[], None]] = None
+        # trace federation (ISSUE 18): completed spans buffer in the
+        # process-wide trace store and ride out on each heartbeat;
+        # tests swap in a per-"host" store to prove cross-host stitch
+        self.trace_store = obs_trace.default_store()
+        if obs_trace.federation_enabled():
+            self.trace_store.enable_export()
 
     # ------------------------------------------------------------------
     def _teardown_all(self):
@@ -837,6 +844,19 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 — heartbeat must never die
             return {}
 
+    def trace_summary(self) -> dict:
+        """The heartbeat span block (ISSUE 18): up to
+        ``HELIX_TRACE_EXPORT_BATCH`` completed wire spans drained from
+        the pending-export ring.  ``{}`` when federation is off or
+        nothing is pending, so idle heartbeats stay small."""
+        try:
+            if not obs_trace.federation_enabled():
+                return {}
+            spans = self.trace_store.drain_export()
+            return {"spans": spans} if spans else {}
+        except Exception:  # noqa: BLE001 — heartbeat must never die
+            return {}
+
     def pool_role(self) -> str:
         """This node's disaggregation pool role: HELIX_POOL_ROLE beats
         the applied profile's ``role:`` (unknown values degrade to the
@@ -886,6 +906,10 @@ class NodeAgent:
             # disaggregation pool role (ISSUE 14): the router schedules
             # prefill and decode pools independently off this
             "role": self.pool_role(),
+            # trace federation (ISSUE 18): completed spans for the cp's
+            # stitched per-trace store ride the beat — bounded,
+            # droppable, validated server-side like the tenant rollup
+            "traces": self.trace_summary(),
             # drain state (ISSUE 11): the router stops routing NEW work
             # here the beat after this flips; in-flight work finishes or
             # migrates before the deadline

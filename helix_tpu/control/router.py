@@ -476,6 +476,11 @@ class InferenceRouter:
         # atomic) and rendered by collect_cp_pools
         self.pool_handoffs = 0
         self.pool_handoff_fallbacks = 0
+        # trace federation (ISSUE 18): the control plane hooks this so
+        # a dead runner's federated spans are pruned the same moment
+        # its saturation/breaker/affinity state is (called outside the
+        # lock, once per departed runner id)
+        self.on_evict: Optional[Callable[[str], None]] = None
 
     def _breaker(self, runner_id: str) -> CircuitBreaker:
         """Lock must be held."""
@@ -542,6 +547,11 @@ class InferenceRouter:
                 self._prune_dispatch_state(rid)
         for rid in dead:
             self._affinity.forget_runner(rid)
+            if self.on_evict is not None:
+                try:
+                    self.on_evict(rid)
+                except Exception:  # noqa: BLE001 — eviction must finish
+                    pass
         return dead
 
     def _prune_dispatch_state(self, runner_id: str) -> None:
@@ -559,6 +569,11 @@ class InferenceRouter:
             self._runners.pop(runner_id, None)
             self._prune_dispatch_state(runner_id)
         self._affinity.forget_runner(runner_id)
+        if self.on_evict is not None:
+            try:
+                self.on_evict(runner_id)
+            except Exception:  # noqa: BLE001 — removal must finish
+                pass
 
     def get(self, runner_id: str) -> Optional[RunnerState]:
         with self._lock:

@@ -58,7 +58,11 @@ from helix_tpu.obs.slo import (
     resolve_tenant,
     validate_tenant_rollup,
 )
-from helix_tpu.obs.trace import TRACE_HEADER
+from helix_tpu.obs.trace import (
+    TRACE_HEADER,
+    TraceFederation,
+    collect_cp_trace_ingest,
+)
 from helix_tpu.serving.multihost_serving import validate_mh_block
 from helix_tpu.serving.migration import (
     DISAGG_HEADER,
@@ -294,6 +298,11 @@ class ControlPlane:
             "One dispatch attempt to one runner (send to stream end)",
         )
         self.traces = obs.default_store()
+        # trace federation (ISSUE 18): runner-pushed spans land here,
+        # keyed by trace id and pruned with the runner; stitched with
+        # the cp's own dispatch spans (skew-corrected) on /v1/debug
+        self.federation = TraceFederation(local=self.traces)
+        self.router.on_evict = self.federation.prune_runner
         self.auth = Authenticator(self.db)
         self.billing = BillingService(self.db, usage_store=None)
         from helix_tpu.control.stripe import StripeService
@@ -1502,7 +1511,9 @@ class ControlPlane:
         c.counter(
             "helix_cp_heartbeats_dropped_total", self.heartbeats_dropped
         )
-        c.gauge("helix_cp_traces_stored", len(self.traces))
+        # trace-federation ingest series + the stored-traces gauge
+        # (ISSUE 18): minted ONLY by obs/trace.py (lint contract 13)
+        collect_cp_trace_ingest(c, self.federation)
         state_num = {"closed": 0, "half_open": 1, "open": 2}
         for rid, snap in self.router.breaker_states().items():
             lbl = {"runner": rid}
@@ -1705,20 +1716,21 @@ class ControlPlane:
         user = request.get("user")
         if self.auth_required and not (user and user.admin):
             return _err(403, "admin only")
-        return web.json_response({"traces": self.traces.ids()[-100:]})
+        return web.json_response({"traces": self.federation.ids()[-100:]})
 
     async def debug_trace(self, request):
-        """One request's spans across the spine (control plane dispatch
-        attempts + runner + engine when co-resident) as JSON, or Chrome
-        trace_event format with ?format=chrome."""
+        """One request's CLUSTER-WIDE timeline (ISSUE 18): the control
+        plane's dispatch spans stitched with every runner's federated
+        spans for the trace id, per-host clock-skew corrected, as JSON
+        or Chrome trace_event format with ?format=chrome."""
         user = request.get("user")
         if self.auth_required and not (user and user.admin):
             return _err(403, "admin only")
         tid = request.match_info["trace_id"]
         if request.query.get("format") == "chrome":
-            doc = self.traces.chrome_trace(tid)
+            doc = self.federation.chrome_trace(tid)
         else:
-            doc = self.traces.get(tid)
+            doc = self.federation.stitched(tid)
         if doc is None:
             return _err(404, f"unknown trace {tid!r}")
         return web.json_response(doc)
@@ -1875,6 +1887,11 @@ class ControlPlane:
             # the runner is acting on the drain: the request is served —
             # stop re-announcing it on the assignment poll
             self._drain_requested.discard(rid)
+        # trace federation (ISSUE 18): runner-supplied like saturation —
+        # spans are clamped to the wire schema and counted; a malformed
+        # batch degrades to nothing ingested and never rejects the
+        # heartbeat (TraceFederation.ingest cannot raise)
+        self.federation.ingest(rid, body.get("traces"))
         self.store.record_heartbeat(rid, body)
         self.router.evict_stale()
         if self.compute is not None and body.get("instance_id"):

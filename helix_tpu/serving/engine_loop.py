@@ -521,6 +521,7 @@ class EngineLoop:
     def _handle_import(self, item: _ImportItem) -> None:
         """Engine-thread half of submit_import."""
         rid = getattr(item.snapshot, "request_id", "")
+        t0 = time.monotonic()
         try:
             req = self.engine.import_request(item.snapshot)
         except SnapshotError as e:
@@ -546,6 +547,15 @@ class EngineLoop:
             return
         self._subscribers[req.id] = item.on_event
         self._admit_order.append(req.id)
+        # the engine-side admit leg of a migrated/disagg timeline
+        # (ISSUE 18): checksum-verified page import through admission
+        self._trace.record(
+            getattr(item.snapshot, "trace_id", ""),
+            "engine import admit", t0, time.monotonic(),
+            plane="engine", request_id=req.id,
+            prior_tokens=len(req.output_tokens),
+            pages=len(getattr(item.snapshot, "pages", ())),
+        )
         log.info(
             "engine '%s' imported request_id=%s (%d prior token(s), "
             "%d page(s))",
@@ -613,6 +623,7 @@ class EngineLoop:
             if not req.output_tokens:
                 continue   # still queued / prefilling
             self._disagg_cb.pop(rid, None)
+            t0 = time.monotonic()
             export = getattr(self.engine, "export_prefill", None)
             snap = None
             if export is not None:
@@ -636,6 +647,13 @@ class EngineLoop:
                 cb("local", None)
                 continue
             self.disagg_exports += 1
+            # the engine-side export leg (ISSUE 18): prefill snapshot
+            # gather + wire encode, before the HTTP handler ships it
+            self._trace.record(
+                getattr(req, "trace_id", ""), "disagg export",
+                t0, time.monotonic(), plane="engine", request_id=rid,
+                pages=len(wire.get("pages") or ()),
+            )
             cb("snapshot", wire)
 
     def _export_survivors(self) -> int:
@@ -666,10 +684,16 @@ class EngineLoop:
             if snap is None:
                 self.migration_failures += 1
                 continue
+            t0 = time.monotonic()
             try:
                 peer = self.exporter(snapshot_to_wire(snap))
             except Exception as e:  # noqa: BLE001 — degrade to shed
                 self.migration_failures += 1
+                self._trace.record(
+                    getattr(req, "trace_id", ""), "migrate export ship",
+                    t0, time.monotonic(), plane="engine",
+                    request_id=req.id, outcome="failed",
+                )
                 log.warning(
                     "engine '%s' could not ship snapshot for "
                     "request_id=%s: %s",
@@ -677,6 +701,13 @@ class EngineLoop:
                 )
                 continue
             shipped += 1
+            # the drain-ladder ship leg (ISSUE 18): snapshot encode +
+            # accepted POST to the peer that now owns the request
+            self._trace.record(
+                getattr(req, "trace_id", ""), "migrate export ship",
+                t0, time.monotonic(), plane="engine",
+                request_id=req.id, outcome="shipped", peer=peer,
+            )
             msg = migrated_error(req.id, peer)
             self.engine.abort(req.id)
             self._forget_request(req.id)

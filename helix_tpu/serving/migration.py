@@ -514,10 +514,14 @@ class ImportedStream:
     thread) calls ``attach``."""
 
     def __init__(self, request_id: str, model: str, prior_tokens: list,
-                 stop: tuple = ()):
+                 stop: tuple = (), trace_id: str = ""):
         self.request_id = request_id
         self.model = model
         self.prior_tokens = list(prior_tokens)
+        # the CALLER's trace identity (ISSUE 18): carried from import
+        # to resume so the resume leg lands in the same federated
+        # timeline as the ship that delivered the snapshot
+        self.trace_id = trace_id
         # serving-level stop STRINGS travel with the snapshot: the
         # resume stream must truncate on them exactly like the ordinary
         # handler would (engine-side stop_token_ids alone miss them)
@@ -664,6 +668,20 @@ class PeerShipper:
             if self.runner_token else {}
         )
 
+    def _ship_headers(self, wire: dict) -> dict:
+        """Import-POST headers: the runner token PLUS the request's
+        trace id (ISSUE 18 bugfix).  Without the header the importing
+        peer adopted nothing and minted a fresh id, so the handoff leg
+        vanished from the caller's federated timeline.  Only a
+        well-shaped id is forwarded — never fabricated."""
+        from helix_tpu.obs.trace import TRACE_HEADER, is_trace_id
+
+        h = self._headers()
+        tid = wire.get("trace_id")
+        if is_trace_id(tid):
+            h[TRACE_HEADER] = tid
+        return h
+
     def targets(self) -> list:
         if self._targets is not None:
             return self._targets
@@ -732,7 +750,7 @@ class PeerShipper:
                 try:
                     r = post(
                         f"{t['address'].rstrip('/')}/v1/migrate/import",
-                        json=body, headers=self._headers(),
+                        json=body, headers=self._ship_headers(wire),
                         timeout=min(cfg.attempt_timeout, remaining),
                     )
                 except Exception as e:  # noqa: BLE001 — try the next peer

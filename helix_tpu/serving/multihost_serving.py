@@ -87,6 +87,7 @@ import json
 import logging
 import os
 import random
+import re
 import struct
 import threading
 import time
@@ -94,6 +95,7 @@ from typing import Optional
 
 from helix_tpu.engine.engine import Request
 from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.obs import trace as obs_trace
 
 log = logging.getLogger("helix.mh-serving")
 
@@ -107,6 +109,20 @@ WIRE_VERSION = 2
 CHECKPOINT_VERSION = 1
 
 _DIGEST_SEED = b"\x00" * 16
+
+# plan-plane trace ids must satisfy the adoptable-id shape contract
+_PLAN_TID_RE = re.compile(r"[^A-Za-z0-9_-]")
+
+
+def plan_trace_id(model: str) -> str:
+    """The mesh's PLAN-PLANE trace identity (ISSUE 18): one stable,
+    well-shaped trace id per model mesh, shared by the leader and every
+    follower so plan publishes, follower applies, digest verifies,
+    checkpoints and takeovers stitch into ONE federated timeline — a
+    takeover blackout reads as a gap between the last leader publish
+    and the promoted host's first, not just ``takeover_blackout_ms``
+    in bench output."""
+    return ("mh-plan-" + _PLAN_TID_RE.sub("-", model or "default"))[:64]
 
 #: Follower health states in the leader's registry (ISSUE 17).  Minted
 #: ONLY here — lint contract 12 fences the literals; consumers
@@ -769,6 +785,12 @@ class PlanLeader:
         self.plans_published = 0
         self.plan_bytes_total = 0
         self.plan_bytes_max = 0
+        # plan-plane tracing (ISSUE 18): publish/checkpoint/takeover
+        # spans land under one stable per-mesh trace id in the
+        # process-wide store and federate to the cp like any runner
+        # span; tests swap the store per "host"
+        self._trace = obs_trace.default_store()
+        self.plan_trace_id = plan_trace_id(name)
 
     # -- attributes EngineLoop SETS on its engine must reach the real
     # engine (a plain __getattr__ passthrough would shadow them here and
@@ -810,13 +832,34 @@ class PlanLeader:
         request_to_wire(req)
         self.engine.add_request(req)
 
-    def _publish_op(self, op: str, rid: str) -> None:
+    def _req_trace(self, rid: str) -> str:
+        """The request's trace id if the engine still knows it (looked
+        up BEFORE the engine op — an aborted request is gone after)."""
+        get = getattr(self.engine, "get_request", None)
+        req = get(rid) if callable(get) else None
+        tid = getattr(req, "trace_id", "") if req is not None else ""
+        return tid if obs_trace.is_trace_id(tid) else ""
+
+    def _publish_op(self, op: str, rid: str, tid: str = "") -> None:
         # ops records publish at arrival (not at the next dispatch):
         # an abort with no step behind it must still reach followers,
         # or they keep a zombie request parked forever
-        self.journal.publish(
-            {"v": WIRE_VERSION, "kind": "ops", "ops": [[op, rid]]}
-        )
+        t0 = time.monotonic()
+        rec: dict = {
+            "v": WIRE_VERSION, "kind": "ops", "ops": [[op, rid]],
+        }
+        if tid:
+            # ISSUE 18 bugfix: the op carries the request's trace id so
+            # a cp-initiated abort is traceable THROUGH the follower —
+            # HTTPFeed poll responses deliver it with the record
+            rec["traces"] = {rid: tid}
+        self.journal.publish(rec)
+        if tid:
+            self._trace.record(
+                tid, "mh op publish", t0, time.monotonic(),
+                plane="engine", op=op, request_id=rid,
+                seq=self.journal._next - 1,
+            )
         if op == "abort":
             self._aborts_after_plan.setdefault(
                 self._last_plan_idx, set()
@@ -824,21 +867,23 @@ class PlanLeader:
 
     def abort(self, request_id: str) -> None:
         with self._mu:
+            tid = self._req_trace(request_id)
             self.engine.abort(request_id)
-            self._publish_op("abort", request_id)
+            self._publish_op("abort", request_id, tid)
 
     def preempt(self, request_id: str) -> bool:
         with self._mu:
+            tid = self._req_trace(request_id)
             ok = self.engine.preempt(request_id)
             if ok:
-                self._publish_op("preempt", request_id)
+                self._publish_op("preempt", request_id, tid)
             return ok
 
     def preempt_for_pressure(self) -> Optional[str]:
         with self._mu:
             rid = self.engine.preempt_for_pressure()
             if rid is not None:
-                self._publish_op("preempt", rid)
+                self._publish_op("preempt", rid, self._req_trace(rid))
             return rid
 
     # snapshot IMPORT and the disaggregated prefill handoff (ISSUE
@@ -1015,6 +1060,7 @@ class PlanLeader:
         if not self.checkpoint_due():
             return
         self._ckpt_last = time.monotonic()
+        t0 = self._ckpt_last
         if sched is not None:
             self._ckpt_sched = export_sched_state(sched)
         try:
@@ -1027,6 +1073,13 @@ class PlanLeader:
             return
         self.checkpoints_captured += 1
         self.checkpoint_store.save_async(self.name, state)
+        # capture cost on the step cadence is part of the plan-plane
+        # timeline (write-out is async; this span is the capture only)
+        self._trace.record(
+            self.plan_trace_id, "mh checkpoint", t0, time.monotonic(),
+            plane="engine", plan_idx=self._last_plan_idx,
+            snapshots=len(state.get("snapshots", ())),
+        )
 
     def _capture_state(self) -> Optional[dict]:
         """Everything a standby needs to continue the leader's host
@@ -1109,6 +1162,7 @@ class PlanLeader:
                 eng.prefill_budget = saved_budget
 
     def _step_dispatch_inner(self, eng):
+        t0 = time.monotonic()
         with self._mu:
             carry_admits, self._carry_admits = self._carry_admits, []
             carry_resumes, self._carry_resumes = self._carry_resumes, []
@@ -1159,6 +1213,15 @@ class PlanLeader:
             nbytes = len(json.dumps(record, separators=(",", ":")))
             self.plan_bytes_total += nbytes
             self.plan_bytes_max = max(self.plan_bytes_max, nbytes)
+            # plan-plane span (ISSUE 18): dispatch through publish,
+            # keyed by the plan seq so the follower's apply span for
+            # the same step correlates across hosts
+            self._trace.record(
+                self.plan_trace_id, "mh plan publish", t0,
+                time.monotonic(), plane="engine", step=step_idx,
+                seq=self.journal._next - 1, bytes=nbytes,
+                admits=len(admits),
+            )
             ems = carry_ems + [(r.id, int(t)) for r, t in emitted]
             self._emissions[step_idx] = ems
             if pend is None:
@@ -1348,6 +1411,12 @@ class FollowerLoop:
         self.handoffs = 0
         self.resync_reason = ""
         self.apply_ms = 0.0                # EMA of per-plan apply wall
+        # plan-plane tracing (ISSUE 18): apply/digest spans land under
+        # the mesh's shared plan trace id, keyed by plan step/seq so
+        # they correlate with the leader's publish spans after
+        # federation stitches both hosts on the cp
+        self._trace = obs_trace.default_store()
+        self.plan_trace_id = plan_trace_id(name)
         # in-process feeds register our health with the leader the way
         # HTTPFeed does via query params
         if hasattr(feed, "bind_follower"):
@@ -1446,6 +1515,13 @@ class FollowerLoop:
         dt_ms = (time.monotonic() - t0) * 1000.0
         self.apply_ms = (dt_ms if self.apply_ms == 0.0
                          else 0.8 * self.apply_ms + 0.2 * dt_ms)
+        # the follower half of the plan-plane timeline (ISSUE 18):
+        # same trace id and step/seq as the leader's publish span
+        self._trace.record(
+            self.plan_trace_id, "mh plan apply", t0, time.monotonic(),
+            plane="engine", step=step_idx, seq=record["seq"],
+            follower=self.follower_id,
+        )
 
     def _apply_ops(self, record: dict) -> None:
         # ops records sit in the stream exactly where the leader's
@@ -1453,8 +1529,11 @@ class FollowerLoop:
         # plans, so applying them in stream order keeps the replica's
         # slot/page state in step
         eng = self.engine
+        raw_traces = record.get("traces")
+        op_traces = raw_traces if isinstance(raw_traces, dict) else {}
         for op in record.get("ops", []):
             kind, rid = op[0], op[1]
+            t0 = time.monotonic()
             if kind == "abort":
                 eng.abort(rid)
                 self._aborts_after_plan.setdefault(
@@ -1476,6 +1555,16 @@ class FollowerLoop:
                 raise DivergenceError(
                     f"ops after step {self._applied_step}: unknown op "
                     f"{kind!r}"
+                )
+            # under the REQUEST's trace id (carried by the op record,
+            # ISSUE 18 bugfix): a cp-initiated abort now shows its
+            # follower-side application on the same stitched timeline
+            tid = op_traces.get(rid, "")
+            if obs_trace.is_trace_id(tid):
+                self._trace.record(
+                    tid, "mh op apply", t0, time.monotonic(),
+                    plane="engine", op=kind, request_id=rid,
+                    follower=self.follower_id,
                 )
 
     def _handle_discard(self, record: dict) -> None:
@@ -1644,7 +1733,19 @@ class FollowerLoop:
             # we joined (or reset) after step ds; nothing to compare
             return
         self.digest_checks += 1
-        if have != want:
+        t0 = time.monotonic()
+        ok = have == want
+        # digest verification is a first-class plan-plane event
+        # (ISSUE 18): a mismatch must be findable on the stitched
+        # timeline at the exact step where lockstep died
+        self._trace.record(
+            self.plan_trace_id, "mh digest verify", t0,
+            time.monotonic(), plane="engine", step=ds,
+            seq=record.get("seq", -1),
+            outcome="ok" if ok else "mismatch",
+            follower=self.follower_id,
+        )
+        if not ok:
             self.digest_mismatches += 1
             msg = (f"emission digest mismatch at step {ds}: leader "
                    f"{want}, replica {have}")
@@ -2123,6 +2224,16 @@ def promote_follower(follower: FollowerLoop,
         "ckpt": ref,
     })
     leader.takeover_ms = (time.monotonic() - t0) * 1000.0
+    # the takeover itself is a plan-plane span (ISSUE 18): on the
+    # stitched timeline the blackout reads as the gap between the dead
+    # leader's last publish and this span, and this span's width is
+    # the promotion cost
+    leader._trace.record(
+        leader.plan_trace_id, "mh promote follower", t0,
+        time.monotonic(), plane="engine", boundary=boundary,
+        follower=follower.follower_id,
+        ckpt=ref or "(none)",
+    )
     log.warning(
         "standby %s promoted to leader for %s at step %d in %.1f ms "
         "(checkpoint %s)", follower.follower_id, name or "<model>",

@@ -35,7 +35,12 @@ from helix_tpu.engine.adapters import (
     split_model_adapter,
 )
 from helix_tpu.serving.sched import CLASS_HEADER, sanitize_class
-from helix_tpu.obs.trace import TRACE_HEADER
+from helix_tpu.obs.trace import (
+    TRACE_HEADER,
+    adopt_trace_id,
+    collect_trace_metrics,
+    is_trace_id,
+)
 from helix_tpu.serving.engine_loop import (
     KV_EXHAUSTED,
     QUEUE_FULL,
@@ -171,7 +176,10 @@ class OpenAIServer:
         # time, latency histograms come from each EngineLoop's obs bundle
         self.obs = obs_registry or obs.Registry()
         self.obs.register_callback(self._collect_metrics)
-        self.traces = trace_store or obs.default_store()
+        # identity check, not truthiness: an EMPTY TraceStore is falsy
+        # (__len__ == 0) but still the caller's store
+        self.traces = (trace_store if trace_store is not None
+                       else obs.default_store())
         self._profiler_lock = threading.Lock()
         # migrated-in requests awaiting their resumed stream (ISSUE 11):
         # the peer engine may start generating before the control plane
@@ -311,6 +319,10 @@ class OpenAIServer:
         # and disagg handoffs share one ledger), minted ONLY by
         # serving/migration.py (lint contract 10)
         collect_xfer(c)
+        # trace-loss series (ISSUE 18): spans lost to the per-trace cap
+        # or the federation export ring, minted ONLY by obs/trace.py
+        # (lint contract 13)
+        collect_trace_metrics(c, self.traces)
         for m in self.registry.list():
             if m.loop is None:
                 continue
@@ -754,6 +766,7 @@ class OpenAIServer:
         if denied is not None:
             return denied
         self._sweep_imports()
+        t0 = time.monotonic()
         try:
             body = await request.json()
         except Exception:  # noqa: BLE001 — client error
@@ -763,6 +776,22 @@ class OpenAIServer:
         except SnapshotError as e:
             return _error(422, str(e), "invalid_request_error",
                           code=e.code)
+        # adopt the CALLER's trace (ISSUE 18): the shipping peer
+        # forwards X-Helix-Trace-Id (PeerShipper bugfix) and the wire
+        # snapshot carries trace_id — prefer the header, fall back to
+        # the snapshot, never mint (an untraced import stays untraced)
+        hdr_tid = request.headers.get(TRACE_HEADER)
+        trace_id = hdr_tid if is_trace_id(hdr_tid) else (
+            snap.trace_id if is_trace_id(snap.trace_id) else ""
+        )
+
+        def _span(outcome: str) -> None:
+            self.traces.record(
+                trace_id, "migrate import", t0, time.monotonic(),
+                plane="runner", request_id=snap.request_id,
+                model=snap.model, outcome=outcome,
+                prior_tokens=len(snap.output_tokens),
+            )
         served, err = await self._lookup(snap.model)
         if err is not None:
             return err
@@ -772,6 +801,7 @@ class OpenAIServer:
         stream = ImportedStream(
             snap.request_id, snap.model, snap.output_tokens,
             stop=tuple(snap.sampling.get("stop") or ()),
+            trace_id=trace_id,
         )
         if not self._imported.register(stream):
             return _error(
@@ -800,16 +830,19 @@ class OpenAIServer:
             # an unregistered orphan can never keep generating here
             self._imported.discard(snap.request_id)
             served.loop.abort(snap.request_id)
+            _span("timeout")
             return _error(
                 504, "import was not admitted in time", "timeout_error"
             )
         if err_msg is not None:
             self._imported.discard(snap.request_id)
             status = 503 if code == "shutting_down" else 422
+            _span(code or "snapshot_invalid")
             return _error(
                 status, err_msg, "invalid_request_error",
                 code=code or "snapshot_invalid",
             )
+        _span("admitted")
         return web.json_response(
             {
                 "ok": True,
@@ -857,6 +890,11 @@ class OpenAIServer:
         if not stream.attach(loop, q):
             return _error(409, f"request {rid!r} was already resumed")
         self._imported.discard(rid)
+        # the resume leg of the migrated timeline (ISSUE 18): stream
+        # attach through catch-up-slice sent, under the trace id the
+        # import adopted from the shipping peer
+        resume_tid = getattr(stream, "trace_id", "")
+        t_resume = time.monotonic()
         detok = IncrementalDetokenizer(served.tokenizer)
         prior = ""
         for t in stream.prior_tokens:
@@ -908,6 +946,11 @@ class OpenAIServer:
                      "catchup": True, "finish_reason": None}
                 )
                 sent = len(full)
+            self.traces.record(
+                resume_tid, "migrate resume", t_resume,
+                time.monotonic(), plane="runner", request_id=rid,
+                catchup_chars=max(0, sent - emitted_chars),
+            )
             while not finished:
                 try:
                     ev = await asyncio.wait_for(
@@ -1294,8 +1337,6 @@ class OpenAIServer:
     def _trace_id(self, request) -> str:
         """The request's end-to-end trace identity: adopt the control
         plane's (header, shape-validated) or mint one at this endpoint."""
-        from helix_tpu.obs.trace import adopt_trace_id
-
         return adopt_trace_id(request.headers.get(TRACE_HEADER))
 
     @staticmethod
@@ -1461,13 +1502,23 @@ class OpenAIServer:
             sched_class=sched_class,
             adapter=adapter,
         )
+        t_plan = time.monotonic()
         if peer_addr:
             served.loop.stage_disagg_export(req.id, on_export)
         served.loop.submit(req, on_event)
+        # the handoff-plan leg of the federated timeline (ISSUE 18):
+        # which decode peer the control plane named, staged or not
+        self.traces.record(
+            trace_id, "disagg handoff plan", t_plan, time.monotonic(),
+            plane="runner", request_id=req.id,
+            peer=peer_id or peer_addr or "(none)",
+            staged=bool(peer_addr),
+        )
         xfer = XferConfig()
         deadline = loop.time() + xfer.deadline
         last_event = loop.time()
         buffered: list = []
+        t_wait = time.monotonic()
         outcome = ("local", None) if not peer_addr else None
         try:
             while outcome is None:
@@ -1537,6 +1588,12 @@ class OpenAIServer:
             served.loop.unstage_disagg_export(req.id)
             served.loop.abort(req.id)
             raise
+        if peer_addr:
+            self.traces.record(
+                trace_id, "disagg prefill wait", t_wait,
+                time.monotonic(), plane="runner", request_id=req.id,
+                outcome=outcome[0],
+            )
 
         if outcome[0] == "snapshot":
             # the ship spends only what is LEFT of the one transfer
@@ -1562,16 +1619,28 @@ class OpenAIServer:
             )
             peer = None
             ship_err = ""
+            t_ship = time.monotonic()
             try:
                 peer = await loop.run_in_executor(
                     None, shipper, outcome[1]
                 )
             except Exception as e:  # noqa: BLE001 — degrade to local serving
                 ship_err = str(e)
+            self.traces.record(
+                trace_id, "disagg ship", t_ship, time.monotonic(),
+                plane="runner", request_id=req.id,
+                peer=peer or peer_id or peer_addr,
+                outcome="confirmed" if peer is not None else "failed",
+            )
             if peer is not None:
                 # handoff confirmed: tear the local request down and
                 # hand the stream to the control plane's resume path
                 served.loop.abort(req.id)
+                self.traces.record(
+                    trace_id, "disagg migrated frame",
+                    time.monotonic(), time.monotonic(),
+                    plane="runner", request_id=req.id, peer=peer,
+                )
                 resp = web.StreamResponse(
                     headers={
                         "Content-Type": "text/event-stream",
@@ -1601,6 +1670,19 @@ class OpenAIServer:
                 "disagg ship for request %s to %s failed (%s): "
                 "serving locally", req.id, peer_id or peer_addr,
                 ship_err[:200],
+            )
+
+        if peer_addr and outcome[0] != "completed":
+            # a fallback rung was taken: the handoff was attempted but
+            # this request is now serving colocated — name the rung so
+            # the timeline explains WHY the decode peer never appears
+            self.traces.record(
+                trace_id, "disagg fallback rung", time.monotonic(),
+                time.monotonic(), plane="runner", request_id=req.id,
+                rung=(
+                    "ship_failed" if outcome[0] == "snapshot"
+                    else "prefill_local"
+                ),
             )
 
         # -- colocated tail: stream buffered + live events ----------------

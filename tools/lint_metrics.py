@@ -117,6 +117,17 @@ Contracts, enforced repo-wide (wired into tier-1 via
    helpers (``collect_mh_metrics``, ``mh_heartbeat_block``,
    ``validate_mh_block``: the contracts 3-11 importer pattern).
 
+13. **Trace federation is one subsystem** (ISSUE 18).  Every
+   ``helix_trace_*`` / ``helix_cp_trace*`` series — the runner
+   span-loss counter, the control plane's federation-ingest counters,
+   and ``helix_cp_traces_stored`` — is minted ONLY by
+   ``helix_tpu/obs/trace.py``; a quoted literal anywhere else in
+   ``helix_tpu/`` or ``tools/`` fails.  The scrape surfaces route
+   through its collectors (``collect_trace_metrics`` on the runner,
+   ``collect_cp_trace_ingest`` on the cp) and the heartbeat push
+   drains through ``drain_export`` — the same importer pattern as
+   contracts 3-12.
+
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
 """
@@ -697,6 +708,62 @@ def _is_mh(path: str, root: str) -> bool:
     return rel == _MH_GUARD_EXEMPT
 
 
+# -- contract 13: trace federation is one subsystem ---------------------------
+# ISSUE 18: every ``helix_trace_*`` / ``helix_cp_trace*`` series (the
+# runner span-loss counter, the cp federation-ingest counters, and
+# ``helix_cp_traces_stored``) is minted ONLY by helix_tpu/obs/trace.py;
+# the serving plane, the control plane, and the heartbeat push all
+# route through its collector/drain helpers.  A second minting site
+# would fork the federation accounting the way ad-hoc saturation
+# gauges forked contract 1.
+_TRACE_NAME_RE = re.compile(
+    r"""["']helix_(?:trace_[a-z0-9_]*|cp_trace[a-z0-9_]*)["']"""
+)
+_TRACE_MOD = os.path.join("helix_tpu", "obs", "trace.py")
+# (file, required symbol): the scrape surfaces call the owning
+# module's collectors; the heartbeat drains through the export ring
+_TRACE_IMPORTERS = (
+    (
+        os.path.join("helix_tpu", "serving", "openai_api.py"),
+        "collect_trace_metrics",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "server.py"),
+        "collect_cp_trace_ingest",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "node_agent.py"),
+        "drain_export",
+    ),
+)
+
+
+def _is_trace_mod(path: str, root: str) -> bool:
+    return os.path.relpath(path, root) == _TRACE_MOD
+
+
+def _trace_importer_violations(root: str) -> list:
+    violations = []
+    mod = os.path.join(root, _TRACE_MOD)
+    if not os.path.isfile(mod):
+        return [
+            "helix_tpu/obs/trace.py: missing — the trace-federation "
+            "vocabulary must live there"
+        ]
+    for rel, symbol in _TRACE_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if symbol not in f.read():
+                violations.append(
+                    f"{rel}: does not call {symbol} from "
+                    "helix_tpu/obs/trace.py (the trace-federation "
+                    "importer pattern)"
+                )
+    return violations
+
+
 def _mh_importer_violations(root: str) -> list:
     violations = []
     mod = os.path.join(root, _MH_GUARD_EXEMPT)
@@ -794,6 +861,7 @@ def run(root: str) -> list:
     violations += _host_sync_violations(root)
     violations += _mh_guard_violations(root)
     violations += _mh_importer_violations(root)
+    violations += _trace_importer_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
     sched_reason_res = [
@@ -815,7 +883,15 @@ def run(root: str) -> list:
         kv_filestore_emitter = _is_kv_filestore(path, root)
         adapter_emitter = _is_adapters(path, root)
         mh_emitter = _is_mh(path, root)
+        trace_emitter = _is_trace_mod(path, root)
         for i, line in enumerate(lines, 1):
+            if not trace_emitter and _TRACE_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: helix_trace_*/helix_cp_trace* metric "
+                    "family named outside helix_tpu/obs/trace.py — "
+                    "trace-federation series must come from the span "
+                    "store module"
+                )
             if not mh_emitter and _MH_NAME_RE.search(line):
                 violations.append(
                     f"{rel}:{i}: helix_mh_* metric family named outside "

@@ -1,0 +1,224 @@
+"""Render a federated trace as an ASCII per-host timeline.
+
+Operators without a Chrome-trace viewer get the same story
+``/v1/debug/traces/{id}?format=chrome`` tells Perfetto: one stitched
+timeline per request across the control plane and every runner
+(ISSUE 18), plus two things the Chrome view makes you squint for —
+the **critical path** (the chain of spans that actually bounds
+end-to-end latency) and the **largest uncovered gap** with the spans
+on either side of it (a takeover blackout, a slow ship, a queue wait
+nobody instrumented).
+
+Input is the control plane's stitched JSON::
+
+    curl -s $CP/v1/debug/traces/$TID | python tools/trace_report.py -
+    python tools/trace_report.py trace.json --width 100
+
+The renderer is a pure function over the stitched doc (``render``),
+so the tier-1 unit test feeds it dicts directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional
+
+_BAR = "="
+_MIN_COL = 1
+
+
+def _spans(doc: dict) -> list:
+    """Normalized (host, name, plane, start, end, attrs) tuples in
+    start order.  Tolerates the single-store shape (no ``host``)."""
+    out = []
+    for s in doc.get("spans", []):
+        try:
+            start = float(s["start_unix"])
+            dur = max(0.0, float(s.get("duration_ms", 0.0)) / 1000.0)
+        except (KeyError, TypeError, ValueError):
+            continue
+        out.append((
+            str(s.get("host", s.get("plane", "?")) or "?"),
+            str(s.get("name", "?")),
+            str(s.get("plane", "")),
+            start,
+            start + dur,
+            s.get("attrs") or {},
+        ))
+    out.sort(key=lambda t: (t[3], t[4]))
+    return out
+
+
+def _fmt_ms(seconds: float) -> str:
+    ms = seconds * 1000.0
+    if ms >= 1000.0:
+        return f"{ms / 1000.0:.2f}s"
+    return f"{ms:.1f}ms"
+
+
+def _bar(start: float, end: float, t0: float, span_s: float,
+         width: int) -> str:
+    """One proportional ASCII bar inside a ``width``-column window."""
+    if span_s <= 0:
+        return _BAR * _MIN_COL
+    lo = int((start - t0) / span_s * width)
+    hi = int((end - t0) / span_s * width)
+    hi = max(hi, lo + _MIN_COL)
+    return " " * lo + _BAR * (hi - lo)
+
+
+def _critical_path(spans: list) -> list:
+    """Greedy furthest-reach chain from the trace's first span to its
+    last covered instant: at each frontier pick the span that starts
+    at/before it and extends furthest.  That chain is the set of spans
+    that BOUND end-to-end latency — shortening any other span cannot
+    shorten the trace."""
+    if not spans:
+        return []
+    chain = []
+    frontier = min(s[3] for s in spans)
+    end = max(s[4] for s in spans)
+    remaining = list(spans)
+    while frontier < end:
+        best = None
+        for s in remaining:
+            if s[3] <= frontier and (best is None or s[4] > best[4]):
+                best = s
+        if best is None or best[4] <= frontier:
+            # uncovered gap: jump to the next span start (the gap
+            # itself shows up in the gap report, not the path)
+            nxt = min(
+                (s for s in remaining if s[3] > frontier),
+                key=lambda s: s[3], default=None,
+            )
+            if nxt is None:
+                break
+            best = nxt
+        chain.append(best)
+        remaining.remove(best)
+        frontier = best[4]
+    return chain
+
+
+def _largest_gap(spans: list) -> Optional[tuple]:
+    """The widest instant-free interval strictly inside the trace
+    window, with the spans on either side: ``(gap_s, before, after)``
+    or None when coverage is continuous."""
+    if len(spans) < 2:
+        return None
+    best = None
+    covered_until = spans[0][4]
+    prev = spans[0]
+    for s in spans[1:]:
+        if s[3] > covered_until:
+            gap = s[3] - covered_until
+            if best is None or gap > best[0]:
+                best = (gap, prev, s)
+        if s[4] >= covered_until:
+            covered_until = s[4]
+            prev = s
+    return best
+
+
+def render(doc: dict, width: int = 72) -> str:
+    """The full report for one stitched trace doc."""
+    spans = _spans(doc)
+    lines = [f"trace {doc.get('trace_id', '?')}"]
+    if not spans:
+        lines.append("  (no spans)")
+        return "\n".join(lines)
+    t0 = min(s[3] for s in spans)
+    t1 = max(s[4] for s in spans)
+    span_s = t1 - t0
+    lines.append(
+        f"  {len(spans)} span(s) over {_fmt_ms(span_s)} across "
+        f"{len(set(s[0] for s in spans))} host(s)"
+    )
+    skew = doc.get("clock_skew_applied_s")
+    if skew:
+        for host, shift in sorted(skew.items()):
+            lines.append(
+                f"  clock skew: {host} shifted +{shift:.3f}s to honor "
+                "dispatch causality"
+            )
+    if doc.get("dropped_spans"):
+        lines.append(
+            f"  WARNING: {doc['dropped_spans']} span(s) dropped to "
+            "caps — the timeline below is incomplete"
+        )
+    # -- per-host timelines -------------------------------------------
+    hosts: dict = {}
+    for s in spans:
+        hosts.setdefault(s[0], []).append(s)
+    name_w = min(28, max(len(s[1]) for s in spans))
+    for host in sorted(hosts, key=lambda h: min(s[3] for s in hosts[h])):
+        lines.append(f"\n  [{host}]")
+        for (h, name, plane, start, end, attrs) in hosts[host]:
+            bar = _bar(start, end, t0, span_s, width)
+            lines.append(
+                f"    {name[:name_w]:<{name_w}} "
+                f"+{_fmt_ms(start - t0):>8} {_fmt_ms(end - start):>8} "
+                f"|{bar:<{width}}|"
+            )
+    # -- critical path ------------------------------------------------
+    chain = _critical_path(spans)
+    total = sum(s[4] - s[3] for s in chain)
+    lines.append(
+        f"\n  critical path ({len(chain)} span(s), "
+        f"{_fmt_ms(total)} of {_fmt_ms(span_s)}):"
+    )
+    for s in chain:
+        lines.append(
+            f"    {_fmt_ms(s[4] - s[3]):>8}  {s[0]}: {s[1]}"
+        )
+    # -- largest gap --------------------------------------------------
+    gap = _largest_gap(spans)
+    if gap is not None:
+        gap_s, before, after = gap
+        lines.append(
+            f"\n  largest gap: {_fmt_ms(gap_s)} between "
+            f"{before[0]}: {before[1]!r} and {after[0]}: {after[1]!r}"
+        )
+        if gap_s > span_s * 0.25:
+            lines.append(
+                "    (over a quarter of the trace — an uninstrumented "
+                "wait, a ship stall, or a takeover blackout)"
+            )
+    else:
+        lines.append("\n  no coverage gaps")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="ASCII per-host timeline for a federated trace"
+    )
+    p.add_argument(
+        "path",
+        help="stitched-trace JSON file from /v1/debug/traces/{id} "
+        "('-' reads stdin)",
+    )
+    p.add_argument("--width", type=int, default=72,
+                   help="timeline bar width in columns")
+    args = p.parse_args(argv)
+    try:
+        if args.path == "-":
+            doc = json.load(sys.stdin)
+        else:
+            with open(args.path, encoding="utf-8") as f:
+                doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"trace_report: cannot read trace: {e}", file=sys.stderr)
+        return 1
+    if not isinstance(doc, dict):
+        print("trace_report: expected a stitched trace JSON object",
+              file=sys.stderr)
+        return 1
+    print(render(doc, width=max(20, args.width)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
