@@ -1916,6 +1916,100 @@ def main():
         },
     }
 
+    # ---- correctness canaries (ISSUE 19) -------------------------------
+    # probe overhead (device steps per probe round, foreground TTFT p95
+    # with the prober on vs off) and detection latency for an injected
+    # silent-corruption fault — the numbers an operator weighs before
+    # opting into HELIX_CANARY=1
+    from helix_tpu.obs.canary import CanaryProber as _Canary
+    from helix_tpu.serving.registry import ServedModel as _CanServed
+    from helix_tpu.serving.tokenizer import ByteTokenizer as _CanTok
+    from helix_tpu.testing import faults as _can_faults
+
+    _can_lp = _ObsLoop(make_engine(kv_dtype), name="bench-canary")
+    _can_lp.start()
+    _can_served = _CanServed(
+        name="bench-canary-m", loop=_can_lp, tokenizer=_CanTok(),
+        context_length=256,
+    )
+    _can = _Canary(
+        runner_id="bench", models_fn=lambda: [_can_served],
+        interval=9999, failures=2, backoff=9999,
+    )
+    _t0 = time.perf_counter()
+    _can_probes = _can.mint_models([_can_served])
+    _can_mint_s = time.perf_counter() - _t0
+
+    _steps0 = _can_lp.flight.steps_recorded
+    _t0 = time.perf_counter()
+    _can.probe_round()
+    _can_round_s = time.perf_counter() - _t0
+    _can_round_steps = _can_lp.flight.steps_recorded - _steps0
+
+    def _can_ttft_p95(n, tag):
+        tts = []
+        for i in range(n):
+            ev = _obs_th.Event()
+            t0 = time.perf_counter()
+            box = [0.0]
+
+            def cb(e, box=box, t0=t0, ev=ev):
+                if e.token_id >= 0 and box[0] == 0.0:
+                    box[0] = time.perf_counter() - t0
+                if e.finished:
+                    ev.set()
+
+            _can_lp.submit(
+                Request(id=f"bench-can-{tag}-{i}",
+                        prompt_tokens=list(_obs_prompt),
+                        sampling=_obs_sampling),
+                cb,
+            )
+            assert ev.wait(120)
+            tts.append(box[0])
+        tts.sort()
+        return tts[min(len(tts) - 1, int(0.95 * len(tts)))]
+
+    _can_ttft_off = _can_ttft_p95(8, "off")
+    _can_stop = _obs_th.Event()
+
+    def _can_probe_bg():
+        while not _can_stop.is_set():
+            _can.probe_round()
+
+    _can_bg = _obs_th.Thread(target=_can_probe_bg, daemon=True)
+    _can_bg.start()
+    _can_ttft_on = _can_ttft_p95(8, "on")
+    _can_stop.set()
+    _can_bg.join(timeout=120)
+
+    # detection latency: inject silent output corruption, count probe
+    # rounds until the health rung flips to failing
+    _can_faults.arm(rules=[{
+        "point": "corrupt_output", "engine": "bench-canary",
+        "offset": 1,
+    }])
+    _t0 = time.perf_counter()
+    _det_rounds = 0
+    while _can.state != "failing" and _det_rounds < 10:
+        _can.probe_round()
+        _det_rounds += 1
+    _det_s = time.perf_counter() - _t0
+    _can_faults.disarm()
+    _can_lp.stop(join=True)
+
+    result["canary"] = {
+        "probes_minted": _can_probes,
+        "mint_seconds": round(_can_mint_s, 4),
+        "device_steps_per_probe_round": _can_round_steps,
+        "probe_round_seconds": round(_can_round_s, 4),
+        "foreground_ttft_p95_prober_off_s": round(_can_ttft_off, 4),
+        "foreground_ttft_p95_prober_on_s": round(_can_ttft_on, 4),
+        "detection_rounds_injected_corruption": _det_rounds,
+        "detection_seconds": round(_det_s, 4),
+        "state_after_detection": _can.state,
+    }
+
     if on_tpu:
         # decode-side model FLOPs utilisation: each generated token moves
         # ~2 FLOPs per active parameter through the MXU; a v5e chip peaks

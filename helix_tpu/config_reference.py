@@ -526,6 +526,53 @@ ENV_REFERENCE: tuple = (
         default="2048",
         section="observability",
     ),
+    EnvVar(
+        "HELIX_CANARY",
+        "Set to 1 to run the continuous correctness-canary scheduler "
+        "(obs/canary.py): golden greedy probes mint per serving axis "
+        "at profile apply and replay through the real serving path "
+        "under the reserved __canary__ tenant, verifying token-level "
+        "bit-identity. Off by default — probes consume real device "
+        "steps, so the operator opts in the way scored routing is "
+        "opted into.",
+        default="0",
+        section="observability",
+    ),
+    EnvVar(
+        "HELIX_CANARY_INTERVAL",
+        "Seconds between canary probe rounds while the runner's "
+        "canary health is ok (failing runners reprobe on "
+        "HELIX_CANARY_REPROBE_BACKOFF instead).",
+        default="60",
+        section="observability",
+    ),
+    EnvVar(
+        "HELIX_CANARY_AXES",
+        "Comma list restricting which serving axes mint golden probes "
+        "(decode, prefix, spec, adapter, int8, resume). Unset: every "
+        "axis the engine actually exercises, EXCEPT resume — the "
+        "post-migration replay axis only mints when listed "
+        "explicitly.",
+        section="observability",
+    ),
+    EnvVar(
+        "HELIX_CANARY_FAILURES",
+        "Consecutive mismatched probe rounds before the runner's "
+        "canary health flips to 'failing' (and the consecutive clean "
+        "rounds required to recover from 'reprobing' back to 'ok'). "
+        "Latency deviations and probe sheds/timeouts never count — "
+        "only token-level bit-identity failures move the rungs.",
+        default="2",
+        section="observability",
+    ),
+    EnvVar(
+        "HELIX_CANARY_REPROBE_BACKOFF",
+        "Seconds a canary-failing runner waits between recovery probe "
+        "rounds, so a transiently corrupted runner re-earns 'ok' "
+        "without waiting out the full probe interval.",
+        default="30",
+        section="observability",
+    ),
     # -- scheduler (serving/sched.py; README "Scheduling") ---------------
     # HELIX_SCHED_* knobs beat the profile's slo.sched block (the
     # HELIX_SPEC_TOKENS operator-override contract)
@@ -644,6 +691,20 @@ ENV_REFERENCE: tuple = (
         "Bound on the prefix-affinity LRU (distinct prompt heads "
         "remembered cluster-wide).",
         default="2048",
+        section="router",
+    ),
+    EnvVar(
+        "HELIX_ROUTER_CANARY_AVOID",
+        "Set to 1 to hard-avoid runners whose federated correctness-"
+        "canary health is failing or reprobing (wrong tokens are worse "
+        "than slow ones) — under BOTH routing policies. The LAST "
+        "runner serving a model is never stranded: it serves with a "
+        "warning (counted in "
+        "the cp canary route counters, logged with the trace id) "
+        "rather than shedding a whole model on a possibly-false-"
+        "positive probe. Unset/0: canary health is reported but never "
+        "steers.",
+        default="0",
         section="router",
     ),
     # -- dispatch robustness (control plane -> runner) -------------------

@@ -25,6 +25,7 @@ log = logging.getLogger("helix.node_agent")
 from helix_tpu.control.profile import ProfileModel, ServingProfile
 from helix_tpu.device.detect import detect_accelerators
 from helix_tpu.obs import trace as obs_trace
+from helix_tpu.obs.canary import CanaryProber, canary_enabled
 from helix_tpu.obs.flight import SATURATION_KEYS
 from helix_tpu.serving.registry import ModelRegistry, ServedModel
 
@@ -498,6 +499,13 @@ class NodeAgent:
         self.trace_store = obs_trace.default_store()
         if obs_trace.federation_enabled():
             self.trace_store.enable_export()
+        # correctness canaries (ISSUE 19): golden probes mint at profile
+        # apply, the scheduler replays them through the real serving
+        # path, health federates on the heartbeat.  Opt-in
+        # (HELIX_CANARY=1) — probes consume real device steps
+        self.canary = CanaryProber(
+            runner_id=runner_id, models_fn=self._live_models
+        )
 
     # ------------------------------------------------------------------
     def _teardown_all(self):
@@ -571,6 +579,19 @@ class NodeAgent:
                     )
                     if flight is not None:
                         flight.reset_baseline()
+                # correctness canaries (ISSUE 19): mint golden probes
+                # for the freshly built models and start the scheduler.
+                # Never fails an apply — a canary bug must not take a
+                # healthy runner out of service
+                if canary_enabled():
+                    try:
+                        self.canary.mint_models(self._live_models())
+                        self.canary.start()
+                    except Exception:  # noqa: BLE001 — apply survives
+                        log.warning(
+                            "runner %s: canary minting failed",
+                            self.runner_id, exc_info=True,
+                        )
                 # multi-host FOLLOWERS execute the leader's step plans
                 # and take no HTTP traffic: keep them out of the
                 # routable model list the router feeds on
@@ -779,6 +800,12 @@ class NodeAgent:
             "prefill_budget_tokens": prefill_budget,
             "adapters_resident": adapters_resident,
         }
+        # in-flight canary probes ride the real queues but must not
+        # look like demand to the autoscaler or the scored router —
+        # subtract them from the advertised depth (ISSUE 19)
+        out["queue_depth"] = max(
+            0, out["queue_depth"] - self.canary.inflight
+        )
         # chaos (ISSUE 12): a "saturation" fault rule overrides reported
         # keys so routing/autoscale tests can drive one runner toward
         # apparent KV exhaustion deterministically (schema-filtered —
@@ -857,6 +884,17 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 — heartbeat must never die
             return {}
 
+    def canary_summary(self) -> dict:
+        """The heartbeat canary-health block (ISSUE 19): health rung,
+        round/mismatch counters and failing axes from the local prober.
+        ``{}`` before any probe exists, so idle heartbeats stay small;
+        validated server-side (``obs.canary.validate_canary_block``)
+        like every other runner-supplied block."""
+        try:
+            return self.canary.summary()
+        except Exception:  # noqa: BLE001 — heartbeat must never die
+            return {}
+
     def pool_role(self) -> str:
         """This node's disaggregation pool role: HELIX_POOL_ROLE beats
         the applied profile's ``role:`` (unknown values degrade to the
@@ -910,6 +948,9 @@ class NodeAgent:
             # stitched per-trace store ride the beat — bounded,
             # droppable, validated server-side like the tenant rollup
             "traces": self.trace_summary(),
+            # correctness-canary health (ISSUE 19): the rung the
+            # corruption-aware router steers on
+            "canary": self.canary_summary(),
             # drain state (ISSUE 11): the router stops routing NEW work
             # here the beat after this flips; in-flight work finishes or
             # migrates before the deadline
@@ -1090,5 +1131,6 @@ class NodeAgent:
 
     def stop(self):
         self._stop.set()
+        self.canary.stop()
         for name in list(self.registry.names()):
             self.registry.unregister(name)

@@ -18,10 +18,15 @@ import collections
 import dataclasses
 import hashlib
 import json
+import logging
 import os
 import threading
 import time
 from typing import Callable, Iterable, Optional
+
+from helix_tpu.obs.canary import canary_failing
+
+log = logging.getLogger("helix.router")
 
 ROUTABLE_STATUS = "running"
 PROFILE_STATUSES = ("assigning", "loading", "starting", "running", "failed")
@@ -126,6 +131,12 @@ class RouterPolicy:
     # prefix-affinity routing (cp-side prompt-head digest -> runner)
     affinity: bool = False
     affinity_entries: int = 2048
+    # corruption-aware routing (ISSUE 19): hard-avoid runners whose
+    # federated correctness-canary health is failing/reprobing.  Opt-in
+    # (HELIX_ROUTER_CANARY_AVOID=1) and orthogonal to the policy choice
+    # — rr picks honour it too.  The LAST runner for a model is never
+    # stranded: it serves-with-warning instead (counted + logged).
+    canary_avoid: bool = False
 
     @classmethod
     def from_env(cls) -> "RouterPolicy":
@@ -156,6 +167,8 @@ class RouterPolicy:
             affinity_entries=_env_int(
                 "HELIX_PREFIX_AFFINITY_ENTRIES", 2048
             ),
+            canary_avoid=os.environ.get("HELIX_ROUTER_CANARY_AVOID", "")
+            not in ("", "0"),
         )
 
 
@@ -431,6 +444,11 @@ class RunnerState:
     # multihost_serving.validate_mh_block at heartbeat ingestion —
     # /v1/cluster/status renders it, pruned with the runner
     multihost: dict = dataclasses.field(default_factory=dict)
+    # correctness-canary health block (ISSUE 19): rung + counters +
+    # failing axes, sanitised by obs.canary.validate_canary_block at
+    # heartbeat ingestion — the corruption-aware avoid's signal,
+    # pruned with the runner like saturation
+    canary: dict = dataclasses.field(default_factory=dict)
 
     @property
     def routable(self) -> bool:
@@ -471,6 +489,11 @@ class InferenceRouter:
         # multi-LoRA adapter-affinity (ISSUE 15): picks placed on a
         # runner whose heartbeat residency block held the adapter
         self.route_adapter_affinity_hits = 0
+        # corruption-aware routing (ISSUE 19): picks steered around a
+        # canary-failing runner, and picks served BY one because it was
+        # the last candidate for the model (serve-with-warning)
+        self.route_canary_avoided = 0
+        self.route_canary_served_failing = 0
         # disaggregated prefill/decode (ISSUE 14): handoff outcomes,
         # incremented by the dispatch orchestration (plain ints, GIL-
         # atomic) and rendered by collect_cp_pools
@@ -506,6 +529,7 @@ class InferenceRouter:
         drain_deadline: float = 0.0,
         role: str = POOL_MIXED,
         multihost: Optional[dict] = None,
+        canary: Optional[dict] = None,
     ) -> RunnerState:
         with self._lock:
             st = self._runners.get(runner_id)
@@ -530,6 +554,8 @@ class InferenceRouter:
                 st.adapters = list(adapters)
             if multihost is not None:
                 st.multihost = dict(multihost)
+            if canary is not None:
+                st.canary = dict(canary)
             st.draining = bool(draining)
             st.drain_deadline = float(drain_deadline or 0.0)
             return st
@@ -621,6 +647,7 @@ class InferenceRouter:
         self, model: str, exclude: Iterable[str] = (),
         sched_class: str = "", affinity_key: Optional[str] = None,
         role: Optional[str] = None, adapter: str = "",
+        trace_id: str = "",
     ) -> Optional[RunnerState]:
         """Failure- and load-aware pick over routable runners serving
         ``model``: skips runners in ``exclude`` (already tried this
@@ -649,7 +676,16 @@ class InferenceRouter:
         (``role=None``) avoid prefill-pool runners while ANY
         decode/mixed runner serves the model; when the prefill pool is
         all there is, it serves ordinary traffic too (degrade-to-local
-        by design — a role is scheduling intent, not capability)."""
+        by design — a role is scheduling intent, not capability).
+
+        Corruption-aware avoid (ISSUE 19, ``policy.canary_avoid``):
+        runners whose federated correctness-canary health is failing or
+        reprobing are hard-avoided under BOTH policies — wrong tokens
+        are worse than slow ones.  Exception: when every remaining
+        candidate is canary-failing, the pick proceeds anyway
+        (serve-with-warning, counted + logged with ``trace_id``) — a
+        possibly-false-positive probe must not shed a whole model,
+        mirroring the all-candidates-full rule."""
         now = self.clock()
         exclude = set(exclude)
         with self._lock:
@@ -681,6 +717,26 @@ class InferenceRouter:
             ]
             if not allowed:
                 return None
+            if self.policy.canary_avoid:
+                healthy = [
+                    st for st in allowed if not canary_failing(st.canary)
+                ]
+                if healthy:
+                    if len(healthy) < len(allowed):
+                        self.route_canary_avoided += 1
+                    allowed = healthy
+                else:
+                    # every candidate is canary-failing: serving wrong-
+                    # token-SUSPECTED beats shedding the whole model on
+                    # a possibly-false-positive probe
+                    self.route_canary_served_failing += 1
+                    log.warning(
+                        "model %s: every candidate runner is canary-"
+                        "failing (%s) — serving with warning "
+                        "(trace_id=%s)",
+                        model, sorted(st.id for st in allowed),
+                        trace_id or "-",
+                    )
             if self.policy.policy == ROUTE_POLICY_SCORED:
                 return self._pick_scored(
                     model, allowed, now, sched_class, affinity_key,
@@ -930,6 +986,9 @@ class InferenceRouter:
             "class_steered": self.route_class_steered,
             "stale_neutral": self.route_stale_neutral,
             "affinity_entries": len(self._affinity),
+            "canary_avoid": p.canary_avoid,
+            "canary_avoided": self.route_canary_avoided,
+            "canary_served_failing": self.route_canary_served_failing,
         }
 
     def drain_retry_after(self, model: str) -> Optional[int]:
@@ -1095,6 +1154,17 @@ class InferenceRouter:
                 rid: dict(st.tenants)
                 for rid, st in sorted(self._runners.items())
                 if st.tenants
+            }
+
+    def canary_map(self) -> dict:
+        """{runner_id: last-heartbeat canary health block} over runners
+        that reported one.  Pruned with the runner, like saturation_map
+        — the cp's ``helix_cp_canary_*`` series can never leak labels."""
+        with self._lock:
+            return {
+                rid: dict(st.canary)
+                for rid, st in sorted(self._runners.items())
+                if st.canary
             }
 
     def breaker_states(self) -> dict:

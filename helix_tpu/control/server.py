@@ -48,6 +48,11 @@ from helix_tpu.engine.adapters import (
     split_model_adapter,
     validate_adapter_block,
 )
+from helix_tpu.obs.canary import (
+    canary_failing,
+    collect_cp_canary,
+    validate_canary_block,
+)
 from helix_tpu.obs.flight import SATURATION_KEYS
 from helix_tpu.obs.slo import (
     ANON_TENANT,
@@ -1555,6 +1560,14 @@ class ControlPlane:
         # pool-role + disagg handoff series (ISSUE 14): minted ONLY by
         # control/router.py (lint contract 10)
         collect_cp_pools(c, self.router, disagg_pools_enabled())
+        # correctness-canary series (ISSUE 19): minted ONLY by
+        # obs/canary.py (lint contract 14); blocks live on RunnerState
+        # so an evicted runner prunes its whole series
+        collect_cp_canary(
+            c, self.router.canary_map(),
+            avoided=self.router.route_canary_avoided,
+            served_failing=self.router.route_canary_served_failing,
+        )
 
     async def cluster_status(self, request):
         """Operator rollup of the whole cluster's saturation: per runner
@@ -1612,6 +1625,9 @@ class ControlPlane:
                 # mesh health (ISSUE 17): per-model role + follower
                 # lag-ladder states / takeover counters, heartbeat-fed
                 runners[-1]["multihost"] = st.multihost
+            if st.canary:
+                # correctness-canary health (ISSUE 19), heartbeat-fed
+                runners[-1]["canary"] = st.canary
             totals["runners"] += 1
             totals["routable"] += 1 if st.routable else 0
             totals["slots_busy"] += int(sat.get("slots_busy", 0))
@@ -1650,8 +1666,31 @@ class ControlPlane:
                     **self.router.pools_status(),
                     "disagg_enabled": disagg_pools_enabled(),
                 },
+                # correctness canaries (ISSUE 19): cluster rollup of
+                # the per-runner health rungs + the router's avoid
+                # posture — "which runners are suspected of emitting
+                # wrong tokens right now"
+                "canary": self._canary_status(),
             }
         )
+
+    def _canary_status(self) -> dict:
+        """The /v1/cluster/status ``canary`` block: avoid posture +
+        failing/ok runner ids from the federated health blocks."""
+        cmap = self.router.canary_map()
+        failing = sorted(
+            rid for rid, blk in cmap.items() if canary_failing(blk)
+        )
+        return {
+            "router_avoid": self.router.policy.canary_avoid,
+            "reporting": len(cmap),
+            "ok": sorted(
+                rid for rid in cmap if rid not in set(failing)
+            ),
+            "failing": failing,
+            "served_failing": self.router.route_canary_served_failing,
+            "avoided": self.router.route_canary_avoided,
+        }
 
     async def tenants_usage(self, request):
         """Cluster-wide per-tenant usage + SLO rollup: the federated
@@ -1839,6 +1878,11 @@ class ControlPlane:
         # clamped to known roles / follower states / finite counters;
         # malformed blocks degrade to {} and never reject the heartbeat
         multihost = validate_mh_block(body.get("multihost"))
+        # correctness-canary health (ISSUE 19): runner-supplied like
+        # saturation — clamped to known rungs / finite counters /
+        # bounded axis lists; malformed blocks degrade to {} (routable,
+        # not failing) and never reject the heartbeat
+        canary = validate_canary_block(body.get("canary"))
         # drain state (ISSUE 11): runner-supplied like saturation, so a
         # malformed flag DEGRADES to false (still-routable) instead of
         # 500ing the heartbeat and TTL-evicting a healthy runner — the
@@ -1882,6 +1926,7 @@ class ControlPlane:
             draining=draining,
             drain_deadline=drain_deadline,
             multihost=multihost,
+            canary=canary,
         )
         if draining:
             # the runner is acting on the drain: the request is served —
@@ -5144,6 +5189,7 @@ class ControlPlane:
         runner = self.router.pick_runner(
             route_model, sched_class=sched_class,
             affinity_key=affinity_key, adapter=route_adapter,
+            trace_id=trace_id,
         )
         if runner is None:
             if route_model and route_model in self.router.model_map():
@@ -5245,7 +5291,7 @@ class ControlPlane:
             if runner is None:
                 runner = self.router.pick_runner(
                     route_model, exclude=tried, sched_class=sched_class,
-                    adapter=route_adapter,
+                    adapter=route_adapter, trace_id=trace_id,
                 )
                 if runner is None and tried:
                     # every distinct candidate already failed once this
@@ -5253,7 +5299,7 @@ class ControlPlane:
                     # as a breaker still admits traffic
                     runner = self.router.pick_runner(
                         route_model, sched_class=sched_class,
-                        adapter=route_adapter,
+                        adapter=route_adapter, trace_id=trace_id,
                     )
                 if runner is None:
                     break

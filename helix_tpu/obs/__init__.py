@@ -9,6 +9,16 @@
   ``trace_event``.
 """
 
+from helix_tpu.obs.canary import (  # noqa: F401
+    CANARY_AXES,
+    CanaryProber,
+    GoldenProbe,
+    canary_enabled,
+    canary_failing,
+    default_prober,
+    mint_prompt,
+    validate_canary_block,
+)
 from helix_tpu.obs.flight import (  # noqa: F401
     SATURATION_KEYS,
     FlightRecorder,
@@ -30,6 +40,7 @@ from helix_tpu.obs.metrics import (  # noqa: F401
 )
 from helix_tpu.obs.slo import (  # noqa: F401
     ANON_TENANT,
+    CANARY_TENANT,
     OTHER_TENANT,
     TENANT_HEADER,
     TENANT_KEYS,

@@ -9,8 +9,11 @@ Two capacity-observability pieces that several layers share:
   past a multiple of the trailing p99, a quarantine firing, a
   zero-progress step with busy slots) and FREEZES a snapshot of the ring
   at that moment — the per-step batch composition leading up to an
-  incident survives even after the ring wraps.  Served at
-  ``GET /v1/debug/flight`` on the runner.
+  incident survives even after the ring wraps.  External anomaly
+  sources freeze the same tail via ``note_anomaly`` — the correctness
+  canary (``obs/canary.py``) calls it on a golden-probe bit-identity
+  mismatch, so the steps that produced wrong tokens are preserved.
+  Served at ``GET /v1/debug/flight`` on the runner.
 - ``SATURATION_KEYS`` — the one schema for the compact saturation
   summary a runner heartbeats to the control plane.  The node agent
   builds the payload from this tuple and the control plane renders one

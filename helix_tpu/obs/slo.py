@@ -78,6 +78,10 @@ ANON_TENANT = "anonymous"
 # the fold bucket demoted tenants aggregate into; a client may not claim
 # it (sanitize_tenant maps it to anonymous)
 OTHER_TENANT = "__other__"
+# the correctness-canary prober's identity (obs/canary.py): probes ride
+# the real serving path under this tenant but are invisible to tenant
+# accounting, SLO burn and autoscale signals; equally unclaimable
+CANARY_TENANT = "__canary__"
 
 _TENANT_RE = re.compile(r"[A-Za-z0-9_.@+:-]{1,64}")
 
@@ -114,12 +118,18 @@ def sanitize_tenant(raw) -> str:
     """The one tenant-id sanitiser both planes apply: printable
     identifier-ish strings up to 64 chars pass through, anything else
     (missing header, control chars, a client claiming the ``__other__``
-    fold bucket) lands under ``anonymous`` — a hostile header must never
-    mint an arbitrary /metrics label value."""
+    fold bucket or the ``__canary__`` prober identity) lands under
+    ``anonymous`` — a hostile header must never mint an arbitrary
+    /metrics label value or hide traffic inside the canary lane."""
     if not isinstance(raw, str):
         return ANON_TENANT
     raw = raw.strip()
-    if not raw or raw == OTHER_TENANT or not _TENANT_RE.fullmatch(raw):
+    if (
+        not raw
+        or raw == OTHER_TENANT
+        or raw == CANARY_TENANT
+        or not _TENANT_RE.fullmatch(raw)
+    ):
         return ANON_TENANT
     return raw
 
@@ -674,7 +684,7 @@ class AdmissionAudit:
 
     REASONS = (
         "queue_full", "kv_exhausted", "quarantine", "preempt_by_swap",
-        "shutting_down",
+        "shutting_down", "canary_mismatch",
     )
 
     def __init__(self, capacity: int = 256):
@@ -734,20 +744,32 @@ class SLOObserver:
         )
         self.audit = AdmissionAudit()
 
-    # thin delegates the engine loop calls on its hot paths
+    # thin delegates the engine loop calls on its hot paths.  The
+    # canary prober's probes ride these same paths under the reserved
+    # ``__canary__`` tenant — they are dropped HERE, at the accounting
+    # boundary, so probes never appear in per-tenant series, burn
+    # rates, /v1/tenants/usage totals or autoscale burn inputs.
     def note_first_token(self, tenant, ttft_s, queue_wait_s,
                          prompt_tokens) -> None:
+        if tenant == CANARY_TENANT:
+            return
         self.accounting.note_first_token(
             tenant, ttft_s, queue_wait_s, prompt_tokens
         )
 
     def note_tokens(self, tenant, n: int = 1) -> None:
+        if tenant == CANARY_TENANT:
+            return
         self.accounting.note_tokens(tenant, n)
 
     def note_shed(self, tenant, kv_exhausted: bool = False) -> None:
+        if tenant == CANARY_TENANT:
+            return
         self.accounting.note_shed(tenant, kv_exhausted=kv_exhausted)
 
     def note_preemption(self, tenant) -> None:
+        if tenant == CANARY_TENANT:
+            return
         self.accounting.note_preemption(tenant)
 
     def burn_rates(self, tenant: Optional[str] = None) -> dict:

@@ -42,6 +42,7 @@ from helix_tpu.serving.sched import (
     TENANT_QUEUE_FULL,
     make_scheduler,
 )
+from helix_tpu.testing import faults
 
 log = logging.getLogger("helix.engine")
 
@@ -1054,11 +1055,26 @@ class EngineLoop:
         the finishing token is always a request's LAST entry (the
         engine discards post-finish overruns), so only the last
         occurrence carries the finished flag."""
+        # chaos (ISSUE 19): a corrupt_output rule models a host silently
+        # computing wrong logits — offset every emitted token id (mod
+        # vocab) at emission time.  Requests still complete, latency is
+        # untouched; only the canary's bit-identity check can see it.
+        offset = 0
+        inj = faults.active()
+        if inj is not None:
+            corrupt = inj.corrupt_output(self.name)
+            if corrupt:
+                offset = int(corrupt.get("offset", 1))
+        vocab = getattr(
+            getattr(self.engine, "model_cfg", None), "vocab_size", 0
+        )
         last: dict = {}
         for idx, (req, _token) in enumerate(emitted):
             last[req.id] = idx
         events = []
         for idx, (req, token) in enumerate(emitted):
+            if offset and token >= 0 and vocab:
+                token = (token + offset) % vocab
             fin = req.finished and last[req.id] == idx
             events.append((
                 req, fin,

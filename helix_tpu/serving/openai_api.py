@@ -26,6 +26,7 @@ from aiohttp import web
 from helix_tpu import obs
 from helix_tpu.engine.engine import Request, SnapshotError
 from helix_tpu.engine.sampling import SamplingParams
+from helix_tpu.obs.canary import collect_canary_metrics, default_prober
 from helix_tpu.obs.slo import ANON_TENANT, TENANT_HEADER, sanitize_tenant
 from helix_tpu.engine.adapters import (
     ADAPTER_SEP,
@@ -323,6 +324,10 @@ class OpenAIServer:
         # or the federation export ring, minted ONLY by obs/trace.py
         # (lint contract 13)
         collect_trace_metrics(c, self.traces)
+        # correctness-canary series (ISSUE 19): health rung + probe /
+        # mismatch counters from the node agent's prober, minted ONLY
+        # by obs/canary.py (lint contract 14); no-op until one starts
+        collect_canary_metrics(c, default_prober())
         for m in self.registry.list():
             if m.loop is None:
                 continue

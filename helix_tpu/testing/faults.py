@@ -35,6 +35,7 @@ Rule shapes (dicts, JSON-friendly for the env var)::
     {"point": "plan_feed", "action": "reorder", "times": 1}
     {"point": "leader_kill", "model": "m", "after_plan": 40, "times": 1}
     {"point": "checkpoint", "model": "*", "mode": "corrupt", "times": 1}
+    {"point": "corrupt_output", "engine": "loop-a", "offset": 1}
     {"point": "heartbeat", "runner": "r1"}          # drop heartbeats
     {"point": "saturation", "runner": "r1",
      "set": {"kv_occupancy": 0.99}}                 # fake saturation
@@ -338,6 +339,30 @@ class FaultInjector:
                 if not self._try_fire(idx, rule):
                     continue
                 return {"mode": rule.get("mode", "corrupt")}
+        return None
+
+    def corrupt_output(self, engine_name: str) -> Optional[dict]:
+        """Return the corruption to apply to this engine loop's emitted
+        token ids this snapshot, or None (ISSUE 19 correctness
+        canaries).  The loop adds ``offset`` (mod vocab) to every token
+        id at emission time — a deterministic stand-in for a host that
+        silently computes wrong logits: requests complete, latency looks
+        normal, every speed gauge stays green, only the canary's
+        bit-identity check can see it.  Matches by EngineLoop ``name``
+        ("*" = any), so a two-runner test can corrupt exactly one
+        replica of a model.  Rule shape::
+
+            {"point": "corrupt_output", "engine": "m@r2", "offset": 1}
+        """
+        with self._lock:
+            for idx, rule in enumerate(self.rules):
+                if rule.get("point") != "corrupt_output":
+                    continue
+                if rule.get("engine", "*") not in ("*", engine_name):
+                    continue
+                if not self._try_fire(idx, rule):
+                    continue
+                return {"offset": int(rule.get("offset", 1))}
         return None
 
     def saturation_override(self, runner_id: str) -> Optional[dict]:

@@ -12,6 +12,7 @@ instead of forbidding them.
 
 import threading
 import time
+import zlib
 
 import jax
 import jax.numpy as jnp
@@ -266,9 +267,12 @@ def featureful(tiny):
         lp = init_lora_params(cfg, LoraConfig(rank=4),
                               jax.random.PRNGKey(seed))
         for t in lp:
+            # stable per-target fold (str hash() is randomized per
+            # process; weight-dependent assertions like "spec decode
+            # engaged" must not flip with PYTHONHASHSEED)
             lp[t]["lora_b"] = jax.random.normal(
                 jax.random.fold_in(jax.random.PRNGKey(seed),
-                                   hash(t) % 97),
+                                   zlib.crc32(t.encode()) % 97),
                 lp[t]["lora_b"].shape, jnp.float32) * 0.05
         return lp
 

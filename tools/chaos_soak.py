@@ -1060,6 +1060,230 @@ def run_multihost(seconds: float = 10.0, seed: int = 42) -> dict:
     }
 
 
+def run_corruption(seconds: float = 10.0, seed: int = 42) -> dict:
+    """Silent output corruption on one of two runners (ISSUE 19).
+
+    Two EngineLoops serve the same model behind a corruption-aware
+    router; after golden minting, a ``corrupt_output`` fault silently
+    offsets every token one runner emits — latency and throughput look
+    perfectly healthy.  Per-runner canary probers run on a short
+    cadence under sustained seeded foreground load while heartbeats
+    federate their health into the router.
+
+    Exit contract: **zero stuck requests**, the canary detects the
+    corruption within its rung threshold worth of probe rounds, and
+    every foreground request served AFTER detection streams
+    bit-identical to the healthy runner's golden output (the router
+    steered around the corrupted peer)."""
+    import threading
+
+    import jax
+
+    from helix_tpu.control.router import InferenceRouter, RouterPolicy
+    from helix_tpu.engine.engine import Engine, EngineConfig, Request
+    from helix_tpu.engine.sampling import SamplingParams
+    from helix_tpu.models.common import ModelConfig
+    from helix_tpu.models.llama import init_params
+    from helix_tpu.obs.canary import CANARY_FAILING, CanaryProber
+    from helix_tpu.serving.engine_loop import EngineLoop
+    from helix_tpu.serving.registry import ServedModel
+    from helix_tpu.serving.tokenizer import ByteTokenizer
+    from helix_tpu.testing import faults
+
+    tok = ByteTokenizer()
+    cfg = ModelConfig.tiny(vocab_size=512, dtype="float32", name="m1")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+
+    def build(side):
+        engine = Engine(
+            cfg, params,
+            EngineConfig(
+                max_decode_batch=4, page_size=4, num_pages=256,
+                max_pages_per_seq=32, max_prefill_len=64,
+                attn_backend="reference", eos_token_ids=tok.eos_ids,
+            ),
+        )
+        loop = EngineLoop(
+            engine, f"m1@{side}", max_queue_seconds=30.0,
+            max_queue_depth=64, max_queued_tokens=8192,
+        ).start()
+        served = ServedModel(
+            name="m1", loop=loop, tokenizer=tok, context_length=256
+        )
+        # short probes (4 tokens) keep the probe round cheap so
+        # detection lands early in the soak window
+        prober = CanaryProber(
+            runner_id=side, models_fn=lambda s=served: [s],
+            interval=9999, failures=2, backoff=9999,
+            probe_tokens=4, probe_timeout=60.0,
+        )
+        return {"loop": loop, "served": served, "prober": prober}
+
+    sides = {s: build(s) for s in ("r1", "r2")}
+    for s in sides.values():
+        s["prober"].mint_models([s["served"]])
+
+    router = InferenceRouter(policy=RouterPolicy(canary_avoid=True))
+
+    def beat(side):
+        router.upsert_from_heartbeat(
+            side, models=["m1"], profile_name="p",
+            profile_status="running",
+            canary=sides[side]["prober"].summary(),
+        )
+
+    beat("r1")
+    beat("r2")
+
+    # a small fixed prompt set so every foreground stream has a golden
+    # to compare against (greedy + fixed prompts = deterministic)
+    prompts = [
+        [10 + 3 * j for j in range(8)],
+        [40 + 5 * j for j in range(12)],
+        [200 + j for j in range(6)],
+    ]
+
+    def collect(loop, rid, prompt):
+        done = threading.Event()
+        toks: list = []
+        err = [None]
+
+        def cb(ev):
+            if ev.error:
+                err[0] = ev.error
+            elif ev.token_id >= 0:
+                toks.append(ev.token_id)
+            if ev.finished:
+                done.set()
+
+        loop.submit(
+            Request(id=rid, prompt_tokens=list(prompt),
+                    sampling=SamplingParams(
+                        temperature=0.0, max_tokens=12),
+                    stop_token_ids=tok.eos_ids),
+            cb,
+        )
+        return done, toks, err
+
+    # goldens from the healthy runner BEFORE the fault is armed
+    goldens = []
+    for i, p in enumerate(prompts):
+        done, toks, err = collect(sides["r1"]["loop"], f"golden-{i}", p)
+        assert done.wait(120) and err[0] is None
+        goldens.append(list(toks))
+
+    faults.arm(seed=seed, rules=[{
+        "point": "corrupt_output", "engine": "m1@r2", "offset": 1,
+    }])
+
+    stop = threading.Event()
+    detection = {"rounds": 0, "detected_at": 0}
+
+    def canary_pump():
+        while not stop.is_set():
+            for side in ("r1", "r2"):
+                sides[side]["prober"].probe_round()
+                beat(side)
+            detection["rounds"] += 1
+            if (
+                not detection["detected_at"]
+                and sides["r2"]["prober"].state == CANARY_FAILING
+            ):
+                detection["detected_at"] = detection["rounds"]
+            if stop.wait(0.25):
+                return
+
+    pump = threading.Thread(target=canary_pump, daemon=True)
+    pump.start()
+
+    rng = random.Random(seed)
+    inflight = []  # (rid, prompt_idx, runner, done, toks, err, post)
+    t0 = time.monotonic()
+    n = 0
+    detected_at_wall = [0.0]
+    try:
+        while True:
+            now = time.monotonic()
+            if detection["detected_at"] and not detected_at_wall[0]:
+                detected_at_wall[0] = now
+            if now - t0 >= seconds:
+                # the probe cadence shares the device with foreground
+                # load; extend the soak (bounded) until detection has
+                # happened AND at least a short post-detection window
+                # has exercised the steer — otherwise the bit-identity
+                # assertion would be vacuous on a slow machine
+                if not detection["detected_at"]:
+                    if now - t0 > seconds + 60.0:
+                        break
+                elif now - detected_at_wall[0] > 2.0:
+                    break
+            n += 1
+            pi = rng.randrange(len(prompts))
+            st = router.pick_runner("m1", trace_id=f"soak-{n}")
+            assert st is not None
+            post = bool(detection["detected_at"])
+            done, toks, err = collect(
+                sides[st.id]["loop"], f"req-{n}", prompts[pi]
+            )
+            inflight.append(
+                (f"req-{n}", pi, st.id, done, toks, err, post)
+            )
+            time.sleep(rng.uniform(0.0, 0.05))
+        stop.set()
+        pump.join(timeout=120)
+        deadline = time.monotonic() + 90.0
+        for _, _, _, done, _, _, _ in inflight:
+            done.wait(max(0.0, deadline - time.monotonic()))
+    finally:
+        stop.set()
+        faults.disarm()
+        for s in sides.values():
+            s["loop"].stop(join=False)
+
+    stuck = sorted(
+        rid for rid, _, _, done, _, _, _ in inflight
+        if not done.is_set()
+    )
+    corrupted_before = wrong_after = served_r2_after = sheds = 0
+    for rid, pi, runner, done, toks, err, post in inflight:
+        if rid in stuck:
+            continue
+        if err[0] is not None:
+            # a shed is a CAPACITY outcome (bounded admission doing its
+            # job under the steered load) — not a correctness violation
+            sheds += 1
+            continue
+        ok = list(toks) == goldens[pi]
+        if post:
+            if runner == "r2":
+                served_r2_after += 1
+            if not ok:
+                wrong_after += 1
+        elif not ok:
+            corrupted_before += 1
+    counts = {
+        "finished": len(inflight) - len(stuck),
+        "sheds": sheds,
+        "corrupted_before_detection": corrupted_before,
+    }
+    detected = bool(detection["detected_at"])
+    return {
+        "submitted": n,
+        "stuck": stuck,
+        "outcomes": counts,
+        "stats": {s: sides[s]["loop"].stats() for s in sides},
+        "healthy_after": not stuck and detected,
+        "detected": detected,
+        "detection_rounds": detection["detected_at"],
+        "probe_rounds": detection["rounds"],
+        "r2_state": sides["r2"]["prober"].state,
+        "corrupted_before_detection": corrupted_before,
+        "wrong_after_detection": wrong_after,
+        "served_r2_after_detection": served_r2_after,
+        "route_canary_avoided": router.route_canary_avoided,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seconds", type=float, default=10.0)
@@ -1068,7 +1292,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--scenario",
         choices=("faults", "memory", "crash", "scale", "disagg",
-                 "multihost"),
+                 "multihost", "corruption"),
         default="faults",
         help="faults: injected step/dispatch faults (ISSUE 2); memory: "
         "sustained KV exhaustion against the tiering/preemption ladder "
@@ -1082,7 +1306,11 @@ def main(argv=None) -> int:
         "every failure degrades to local serving (ISSUE 14); "
         "multihost: repeated plan-leader kills with digest-verified "
         "standby takeover through the filestore checkpoint — zero "
-        "stuck, every stream bit-identical across handoffs (ISSUE 17)",
+        "stuck, every stream bit-identical across handoffs (ISSUE 17); "
+        "corruption: silent output corruption on one of two runners — "
+        "the correctness canary detects within bounded probe rounds "
+        "and the router steers foreground to the healthy peer, zero "
+        "stuck (ISSUE 19)",
     )
     args = ap.parse_args(argv)
     if args.scenario == "memory":
@@ -1095,6 +1323,8 @@ def main(argv=None) -> int:
         res = run_disagg(seconds=args.seconds, seed=args.seed)
     elif args.scenario == "multihost":
         res = run_multihost(seconds=args.seconds, seed=args.seed)
+    elif args.scenario == "corruption":
+        res = run_corruption(seconds=args.seconds, seed=args.seed)
     else:
         res = run_soak(
             seconds=args.seconds, seed=args.seed,
@@ -1158,6 +1388,27 @@ def main(argv=None) -> int:
             f"{args.scenario} events: {events}, migrated: "
             f"{res['migrated']} — zero lost tokens, all combined "
             "streams bit-identical to uninterrupted runs"
+        )
+    if args.scenario == "corruption":
+        if not res.get("detected"):
+            print("CORRUPTION NEVER DETECTED BY THE CANARY",
+                  file=sys.stderr)
+            return 1
+        if res.get("wrong_after_detection"):
+            print(
+                "FOREGROUND SERVED WRONG TOKENS AFTER DETECTION: "
+                f"{res['wrong_after_detection']}",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"corruption detected in {res['detection_rounds']} probe "
+            f"round(s) (r2 state: {res['r2_state']}); corrupted "
+            f"foreground served pre-detection: "
+            f"{res['corrupted_before_detection']}; picks steered "
+            f"around the corrupted runner: {res['route_canary_avoided']}"
+            " — all post-detection streams bit-identical to the "
+            "healthy golden"
         )
     print("zero stuck requests — soak passed")
     return 0

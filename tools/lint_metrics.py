@@ -128,6 +128,17 @@ Contracts, enforced repo-wide (wired into tier-1 via
    drains through ``drain_export`` — the same importer pattern as
    contracts 3-12.
 
+14. **Correctness canaries are one subsystem** (ISSUE 19).  Every
+   ``helix_canary_*`` / ``helix_cp_canary_*`` series — the runner
+   health rung + probe/mismatch counters and the control plane's
+   federated per-runner family + router avoid counters — is minted
+   ONLY by ``helix_tpu/obs/canary.py``; a quoted literal anywhere else
+   in ``helix_tpu/`` or ``tools/`` fails.  The node agent runs probing
+   through ``CanaryProber``, the control plane clamps runner-supplied
+   health blocks through ``validate_canary_block``, and the router
+   steers on ``canary_failing`` — the same importer pattern as
+   contracts 3-13.
+
 Usage: ``python tools/lint_metrics.py [repo_root]`` — exits 1 with one
 line per violation.
 """
@@ -742,6 +753,66 @@ def _is_trace_mod(path: str, root: str) -> bool:
     return os.path.relpath(path, root) == _TRACE_MOD
 
 
+# -- contract 14: correctness canaries are one subsystem ----------------------
+# ISSUE 19: every ``helix_canary_*`` / ``helix_cp_canary_*`` series (the
+# runner health rung + probe/mismatch counters, the cp's federated
+# per-runner family, and the router avoid counters) is minted ONLY by
+# helix_tpu/obs/canary.py; the node agent, the control plane, and the
+# router route through its prober/validator/predicate.  A second
+# minting site would fork the correctness accounting the way ad-hoc
+# saturation gauges forked contract 1.
+_CANARY_NAME_RE = re.compile(
+    r"""["']helix_(?:canary_[a-z0-9_]*|cp_canary[a-z0-9_]*)["']"""
+)
+_CANARY_MOD = os.path.join("helix_tpu", "obs", "canary.py")
+# (file, required symbol): probing, heartbeat clamping, and routing all
+# route through the owning module
+_CANARY_IMPORTERS = (
+    (
+        os.path.join("helix_tpu", "control", "node_agent.py"),
+        "CanaryProber",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "server.py"),
+        "validate_canary_block",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "router.py"),
+        "canary_failing",
+    ),
+    (
+        os.path.join("helix_tpu", "serving", "openai_api.py"),
+        "collect_canary_metrics",
+    ),
+)
+
+
+def _is_canary_mod(path: str, root: str) -> bool:
+    return os.path.relpath(path, root) == _CANARY_MOD
+
+
+def _canary_importer_violations(root: str) -> list:
+    violations = []
+    mod = os.path.join(root, _CANARY_MOD)
+    if not os.path.isfile(mod):
+        return [
+            "helix_tpu/obs/canary.py: missing — the correctness-canary "
+            "vocabulary must live there"
+        ]
+    for rel, symbol in _CANARY_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if symbol not in f.read():
+                violations.append(
+                    f"{rel}: does not call {symbol} from "
+                    "helix_tpu/obs/canary.py (the correctness-canary "
+                    "importer pattern)"
+                )
+    return violations
+
+
 def _trace_importer_violations(root: str) -> list:
     violations = []
     mod = os.path.join(root, _TRACE_MOD)
@@ -862,6 +933,7 @@ def run(root: str) -> list:
     violations += _mh_guard_violations(root)
     violations += _mh_importer_violations(root)
     violations += _trace_importer_violations(root)
+    violations += _canary_importer_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
     sched_reason_res = [
@@ -884,6 +956,7 @@ def run(root: str) -> list:
         adapter_emitter = _is_adapters(path, root)
         mh_emitter = _is_mh(path, root)
         trace_emitter = _is_trace_mod(path, root)
+        canary_emitter = _is_canary_mod(path, root)
         for i, line in enumerate(lines, 1):
             if not trace_emitter and _TRACE_NAME_RE.search(line):
                 violations.append(
@@ -891,6 +964,13 @@ def run(root: str) -> list:
                     "family named outside helix_tpu/obs/trace.py — "
                     "trace-federation series must come from the span "
                     "store module"
+                )
+            if not canary_emitter and _CANARY_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: helix_canary_*/helix_cp_canary_* "
+                    "metric family named outside helix_tpu/obs/"
+                    "canary.py — correctness-canary series must come "
+                    "from the prober module"
                 )
             if not mh_emitter and _MH_NAME_RE.search(line):
                 violations.append(
