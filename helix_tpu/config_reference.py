@@ -277,6 +277,45 @@ ENV_REFERENCE: tuple = (
         section="accelerator",
     ),
     EnvVar(
+        "HELIX_MAX_PAGES_PER_SEQ",
+        "Per-sequence page-table capacity for EVERY engine this node "
+        "serves (operator-beats-profile, the HELIX_SPEC_TOKENS "
+        "contract — it also beats the bump derived from a profile's "
+        "context_length). On a tiered engine (ctx_hot_pages > 0) this "
+        "caps the DEVICE-resident pages one sequence may hold while "
+        "max_model_len can exceed it — the demoted cold middle lives "
+        "in the host pool; on a fully-resident engine it caps the "
+        "whole sequence. Unset: the profile's engine block (default "
+        "128).",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_CTX_HOT_PAGES",
+        "Tiered KV residency for million-token contexts (ISSUE 20): "
+        "> 0 keeps that many attention-hot TAIL pages of each long "
+        "sequence in HBM and demotes the cold middle to the host pool "
+        "(requires HELIX_KV_HOST_POOL_BYTES), streaming it back "
+        "through fixed-size chunks folded into the same online-softmax "
+        "merge as ring attention — outputs stay bit-identical to fully "
+        "resident while peak HBM pages stay bounded. Every restored "
+        "page re-verifies its blake2b checksum; a corrupt page is a "
+        "typed error, never wrong attention. Applies to every engine "
+        "this node serves (operator-beats-profile); 0 forces fully-"
+        "resident even where a profile enables tiering. Unset: the "
+        "profile's engine block (default 0 = off).",
+        section="accelerator",
+    ),
+    EnvVar(
+        "HELIX_CTX_TENANT_TOKENS",
+        "Per-tenant quota for the context-caching API (ISSUE 20): the "
+        "total prompt tokens one tenant may hold across its POST "
+        "/v1/context handles. Past it new creations are rejected 429 "
+        "with a typed counter (helix_ctx_quota_rejects_total); "
+        "resolving existing handles is never gated. 0/unset: "
+        "unlimited.",
+        section="accelerator",
+    ),
+    EnvVar(
         "HELIX_EXACT_SAMPLING",
         "Set to 1 to force the exact full-vocab top-p sampling path for "
         "every request (default: auto — the 64-candidate MXU fast path "

@@ -280,6 +280,24 @@ def _build_served_model(pm: ProfileModel, mesh=None) -> ServedModel:
         ekw["enable_async_loop"] = async_env.strip().lower() not in (
             "0", "false", "no", "off"
         )
+    mpps_env = _os_env.environ.get("HELIX_MAX_PAGES_PER_SEQ", "")
+    if mpps_env:
+        # operator-level per-sequence page-table cap for EVERY engine
+        # this node serves (same operator-beats-profile contract as
+        # HELIX_SPEC_TOKENS — it must also beat the context_length
+        # derived bump above).  On a tiered engine (ctx_hot_pages>0)
+        # this caps DEVICE-resident pages per sequence while
+        # max_model_len may exceed it; on a fully-resident engine it
+        # caps the whole sequence.
+        ekw["max_pages_per_seq"] = max(1, int(mpps_env))
+    hot_env = _os_env.environ.get("HELIX_CTX_HOT_PAGES", "")
+    if hot_env:
+        # operator-level tiered-KV override for EVERY engine this node
+        # serves (ISSUE 20): >0 keeps that many attention-hot tail
+        # pages in HBM and streams the demoted cold middle from the
+        # host pool each step; 0 forces fully-resident even where a
+        # profile enables tiering
+        ekw["ctx_hot_pages"] = max(0, int(hot_env))
     from helix_tpu.engine.residency import host_pool_budget_bytes
 
     host_budget = host_pool_budget_bytes(default=-1)
@@ -745,6 +763,7 @@ class NodeAgent:
         preempted = 0
         prefill_budget = 0
         adapters_resident = 0
+        kv_cold_pages = 0
         tps = 0.0
         for m in self._live_models():
             loop = getattr(m, "loop", None)
@@ -779,6 +798,10 @@ class NodeAgent:
             # multi-LoRA adapters resident in HBM pools sum across
             # engines (ISSUE 15) — the router's affinity denominator
             adapters_resident += sat.get("adapters_resident", 0)
+            # demoted cold-middle KV pages (tiered long-context, ISSUE
+            # 20) sum across engines — host-resident history the router
+            # should see as restorable pressure, not free capacity
+            kv_cold_pages += sat.get("kv_cold_pages", 0)
         from helix_tpu.testing import faults
 
         out = {
@@ -799,6 +822,7 @@ class NodeAgent:
             "preempted_requests": preempted,
             "prefill_budget_tokens": prefill_budget,
             "adapters_resident": adapters_resident,
+            "kv_cold_pages": kv_cold_pages,
         }
         # in-flight canary probes ride the real queues but must not
         # look like demand to the autoscaler or the scored router —
@@ -895,6 +919,25 @@ class NodeAgent:
         except Exception:  # noqa: BLE001 — heartbeat must never die
             return {}
 
+    def ctx_summary(self) -> dict:
+        """The heartbeat context-cache block (ISSUE 20): handle/token
+        counts and create/hit/quota counters from this node's registry
+        (the same per-root singleton the OpenAI surface serves, via
+        ``serving.context_cache.context_cache_for``).  ``{}`` while the
+        cache is empty and idle, so idle heartbeats stay small;
+        validated server-side (``validate_ctx_block``) like every other
+        runner-supplied block."""
+        try:
+            import os
+
+            from helix_tpu.serving.context_cache import context_cache_for
+
+            return context_cache_for(
+                os.environ.get("HELIX_FILESTORE_KV_DIR", "")
+            ).stats_block()
+        except Exception:  # noqa: BLE001 — heartbeat must never die
+            return {}
+
     def pool_role(self) -> str:
         """This node's disaggregation pool role: HELIX_POOL_ROLE beats
         the applied profile's ``role:`` (unknown values degrade to the
@@ -951,6 +994,9 @@ class NodeAgent:
             # correctness-canary health (ISSUE 19): the rung the
             # corruption-aware router steers on
             "canary": self.canary_summary(),
+            # context-cache registry (ISSUE 20): pinned-prefix handle /
+            # token counts for /v1/cluster/status capacity views
+            "ctx": self.ctx_summary(),
             # drain state (ISSUE 11): the router stops routing NEW work
             # here the beat after this flips; in-flight work finishes or
             # migrates before the deadline

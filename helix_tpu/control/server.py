@@ -68,6 +68,7 @@ from helix_tpu.obs.trace import (
     TraceFederation,
     collect_cp_trace_ingest,
 )
+from helix_tpu.serving.context_cache import validate_ctx_block
 from helix_tpu.serving.multihost_serving import validate_mh_block
 from helix_tpu.serving.migration import (
     DISAGG_HEADER,
@@ -1883,6 +1884,10 @@ class ControlPlane:
         # bounded axis lists; malformed blocks degrade to {} (routable,
         # not failing) and never reject the heartbeat
         canary = validate_canary_block(body.get("canary"))
+        # context-cache block (ISSUE 20): runner-supplied like
+        # saturation — clamped to finite counts; malformed blocks
+        # degrade to {} and never reject the heartbeat
+        ctx = validate_ctx_block(body.get("ctx"))
         # drain state (ISSUE 11): runner-supplied like saturation, so a
         # malformed flag DEGRADES to false (still-routable) instead of
         # 500ing the heartbeat and TTL-evicting a healthy runner — the
@@ -1911,7 +1916,7 @@ class ControlPlane:
             profile_name=profile.get("name", ""),
             profile_status=profile.get("status", "assigning"),
             accelerators=body.get("accelerators", []),
-            meta={"address": body.get("address", "")},
+            meta={"address": body.get("address", ""), "ctx": ctx},
             saturation=saturation,
             # pool role (ISSUE 14): runner-supplied like saturation —
             # a malformed role degrades to "mixed" (fully routable),

@@ -52,6 +52,7 @@ import numpy as np
 from helix_tpu.engine import ragged as ragged_meta
 from helix_tpu.engine.kv_cache import (
     CacheConfig,
+    ColdPageError,
     PageAllocator,
     PagedKVCache,
     slot_to_page_offset,
@@ -118,6 +119,10 @@ class Request:
     adapter: str = ""
     cached_tokens: int = 0          # prompt tokens served by prefix cache
     preempt_count: int = 0          # times swapped out (bounds thrash)
+    # force full device residency even on a tiered engine: context-cache
+    # creation prefills (serving/context_cache.py) must keep every page
+    # resident so the prefix cache / filestore can adopt them
+    ctx_pin: bool = False
     _page_hashes: Optional[list] = None
 
     @property
@@ -244,6 +249,24 @@ class EngineConfig:
     # preemption unavailable).  Node-level override:
     # HELIX_KV_HOST_POOL_BYTES.
     host_pool_bytes: int = 0
+    # Tiered KV residency for long contexts (ISSUE 20): > 0 turns on
+    # streamed chunked attention — a sequence keeps only its last
+    # ctx_hot_pages full pages (plus the partially written head page and
+    # any shared prefix) resident in the device pool; the cold middle
+    # demotes to the host tier page by page as decode/prefill advances,
+    # and every device step attends it from staged fixed-size chunks via
+    # the ring-attention online-softmax combine.  Context length is then
+    # bounded by the PAGE TABLE WIDTH (max_pages_per_seq * page_size),
+    # not the physical pool — the million-token-context lever.  Requires
+    # host_pool_bytes > 0; greedy and seeded outputs are bit-identical
+    # with tiering on vs fully resident.  Node-level override:
+    # HELIX_CTX_HOT_PAGES.  0 = off (seed behaviour).
+    ctx_hot_pages: int = 0
+    # Pages per staged cold chunk: each chunk gathers this many demoted
+    # pages from the host tier (checksum-verified per page) into one
+    # partial-attention block.  Larger chunks = fewer merge steps and
+    # fewer compiled chunk-count buckets, more transient HBM per step.
+    ctx_stream_pages: int = 4
 
     def cache_config(self, dtype: str = "bfloat16") -> CacheConfig:
         kv_dtype = (
@@ -666,21 +689,32 @@ def _pin_default_layout(cache):
 
 
 def _ragged_attn_call(q, k, v, caches, lyr, t0, q_len, hist, tables,
-                      backend):
+                      backend, cold=None):
     """One ragged-op invocation from inside a forward pass: unpack the
     pool carry (with optional int8 scale pools) and flatten the token
-    grid onto the op's flat row axis."""
+    grid onto the op's flat row axis.  ``cold`` (tiered KV residency)
+    carries the staged cold-middle chunks plus each row's demoted token
+    span — the op excludes the span from the hot gather and merges the
+    chunks' online-softmax stats instead."""
     kp, vp = caches[0], caches[1]
     ks = caches[2] if len(caches) == 4 else None
     vs = caches[3] if len(caches) == 4 else None
     Bq, Sq, H, D = q.shape
     KVH = k.shape[-2]
+    tkw = {}
+    if cold is not None:
+        (c_k, c_v, c_ks, c_vs, c_row, c_len, lo, hi) = cold
+        tkw = dict(
+            span_lo=lo, span_hi=hi, cold_k=c_k, cold_v=c_v,
+            cold_row=c_row, cold_len=c_len,
+            cold_k_scale=c_ks, cold_v_scale=c_vs,
+        )
     out = ragged_paged_attention(
         q.reshape(Bq * Sq, H, D),
         k.reshape(Bq * Sq, KVH, D),
         v.reshape(Bq * Sq, KVH, D),
         kp, vp, lyr, t0, q_len, hist, tables,
-        backend=backend, k_scale=ks, v_scale=vs,
+        backend=backend, k_scale=ks, v_scale=vs, **tkw,
     )
     return out.reshape(Bq, Sq, H, D)
 
@@ -809,7 +843,7 @@ def _build_ragged_step_fn(
     model_cfg: ModelConfig, page_size: int, backend, mesh,
     token_bucket: int, has_hist: bool, prefill_rows: int,
     state_width: int, n_tail_max: int, ring_hist_pages: int = 0,
-    adapter_slots: int = 0,
+    adapter_slots: int = 0, cold_chunks: int = 0, cold_ct: int = 0,
 ):
     """THE unified device step: ONE compiled entry point serves every
     caller, keyed at runtime only on the prefill token-bucket.
@@ -854,7 +888,7 @@ def _build_ragged_step_fn(
     ragged_meta.note_step_shape(
         (model_cfg, page_size, backend, mesh),
         ("ragged", token_bucket, has_hist, prefill_rows,
-         ring_hist_pages),
+         ring_hist_pages, cold_chunks),
     )
     # adapter_slots is an ENGINE-WIDE constant (EngineConfig), not a
     # per-call shape axis: every existing trace family gains exactly
@@ -881,11 +915,27 @@ def _build_ragged_step_fn(
 
     @functools.partial(jax.jit, donate_argnums=(1, 2))
     def step_fn(params, cache, state: DecodeState, pargs, drafts,
-                draft_len, n_extra):
+                draft_len, n_extra, cold=None):
         B = state.last_token.shape[0]
         L, KVH, D = cfg.num_layers, cfg.num_kv_heads, cfg.head_dim
         kdt = jnp.dtype(cfg.dtype)
         drops = None
+        # tiered KV residency (ISSUE 20): staged cold-middle chunks plus
+        # the per-row demoted token spans — one slab shared by the
+        # prefill segment (rows = plan rows, via c_prow) and the state
+        # segment (rows = decode slots, via c_srow); a chunk owned by
+        # neither mapping carries row -1 and masks to an exact zero
+        # contribution
+        if cold_chunks > 0:
+            (c_k, c_v, c_ks, c_vs, c_prow, c_srow, c_len,
+             p_span_lo, p_span_hi, s_span_lo, s_span_hi) = cold
+            p_cold = (c_k, c_v, c_ks, c_vs, c_prow, c_len,
+                      p_span_lo, p_span_hi)
+            s_cold = (c_k, c_v, c_ks, c_vs, c_srow, c_len,
+                      s_span_lo, s_span_hi)
+        else:
+            p_cold = None
+            s_cold = None
 
         # ---- 1. prefill segment --------------------------------------
         if Cb > 0:
@@ -911,7 +961,7 @@ def _build_ragged_step_fn(
                 elif has_hist:
                     out = _ragged_attn_call(
                         q, k, v, caches, lyr, p_t0, p_qlen, p_hist,
-                        p_tables, backend,
+                        p_tables, backend, cold=p_cold,
                     )
                 else:
                     # cold rows only: packed self-attention, no pool
@@ -972,7 +1022,7 @@ def _build_ragged_step_fn(
             (caches, kacc, vacc), lyr = carry_cache
             out = _ragged_attn_call(
                 q, k, v, caches, lyr, s_t0, s_qlen, s_hist,
-                state.page_tables, backend,
+                state.page_tables, backend, cold=s_cold,
             )
             return out, (caches, kacc.at[lyr].set(k),
                          vacc.at[lyr].set(v))
@@ -1162,6 +1212,38 @@ class Engine:
             if cfg.host_pool_bytes > 0
             else None
         )
+        # tiered KV residency (ISSUE 20): demoted cold-middle pages live
+        # in the host pool keyed ("ctx", req_id, page_idx); each tiered
+        # slot keeps a ledger {lo, hi, top, rid, table} — [lo, hi) is the
+        # demoted span (pages zeroed in the table), top the high-water of
+        # allocated device pages.  _cold_staged caches the assembled +
+        # device_put chunk slab between steps so prefetch overlaps H2D
+        # with the in-flight step's compute.
+        if cfg.ctx_hot_pages > 0:
+            if self.host_pool is None:
+                raise ValueError(
+                    "ctx_hot_pages > 0 requires host_pool_bytes > 0: "
+                    "demoted cold pages live in the host page pool"
+                )
+            if model_cfg.mrope_sections is not None:
+                raise ValueError(
+                    "tiered KV residency is not supported for mrope (VL) "
+                    "models"
+                )
+            if _mesh_sp(mesh) > 1:
+                raise ValueError(
+                    "tiered KV residency is not supported with sequence "
+                    "parallelism (ring attention owns the history split)"
+                )
+            if cfg.ctx_stream_pages < 1:
+                raise ValueError(
+                    f"ctx_stream_pages ({cfg.ctx_stream_pages}) must be "
+                    ">= 1"
+                )
+        self._tiered: dict[int, dict] = {}
+        self._cold_staged: Optional[dict] = None
+        self.num_ctx_stream_chunks = 0
+        self.num_ctx_demoted_pages = 0
         self.preempted: list[PreemptedSeq] = []   # parked, resume FIFO
         self._resume_failures: list = []          # (req, reason) for the loop
         # scheduler delegation (serving/sched.py): the loop wires these.
@@ -1373,10 +1455,11 @@ class Engine:
         return max(1, self.cache_cfg.num_pages - 1)
 
     @property
-    def max_context_len(self) -> int:
-        """Hard prompt+generation limit: the profile's max_model_len capped
-        by per-sequence page capacity AND the physical pool size (a prompt
-        that can never allocate must be rejected, not queued forever)."""
+    def _resident_context_cap(self) -> int:
+        """Context limit for a fully device-resident sequence: the
+        profile's max_model_len capped by per-sequence page capacity AND
+        the physical pool size (a prompt that can never allocate must be
+        rejected, not queued forever)."""
         cap = min(
             self.cache_cfg.max_seq_len,
             (self.cache_cfg.num_pages - 1) * self.cache_cfg.page_size,
@@ -1384,6 +1467,20 @@ class Engine:
         if self.cfg.max_model_len is not None:
             cap = min(cap, self.cfg.max_model_len)
         return cap
+
+    @property
+    def max_context_len(self) -> int:
+        """Hard prompt+generation limit.  With tiered KV residency on
+        (ctx_hot_pages > 0 and a host pool) the physical-pool term drops:
+        only the hot tail must fit in HBM, the cold middle streams from
+        host RAM — capacity is the per-sequence page-table width (and the
+        profile's max_model_len)."""
+        if self.cfg.ctx_hot_pages > 0 and self.host_pool is not None:
+            cap = self.cache_cfg.max_seq_len
+            if self.cfg.max_model_len is not None:
+                cap = min(cap, self.cfg.max_model_len)
+            return cap
+        return self._resident_context_cap
 
     def validate_request(self, req: Request) -> Optional[str]:
         """Admission pre-check, safe from any thread; None = acceptable."""
@@ -1546,6 +1643,11 @@ class Engine:
         emitted, pend = self.step_dispatch()
         if pend is not None:
             try:
+                # stage the NEXT step's cold chunks while this step's
+                # device work is still in flight: the gathers/device_puts
+                # are async and enqueue after the dispatched step on the
+                # device stream, so H2D traffic overlaps compute
+                self.prefetch_cold()
                 self.step_complete(pend, emitted)
             except Exception:
                 # roll the predicted-state advance back before the
@@ -1644,6 +1746,10 @@ class Engine:
             or self.preempted
             or self.spec is not None
             or self._pending_first
+            # tiered slots gather pages for demotion between steps — the
+            # gathers must order against a RECONCILED cache handle, so
+            # tiering keeps the loop on the synchronous path
+            or self._tiered
         ):
             return False
         # every active slot must have headroom for at least one more
@@ -1993,18 +2099,55 @@ class Engine:
                 return None
             adapter_slot = got
         plen = len(req.prompt_tokens)
+        ps = self.cache_cfg.page_size
+        maxP = self.cache_cfg.max_pages_per_seq
         limit = min(plen + req.sampling.max_tokens, self.max_context_len)
-        need = self.allocator.pages_needed(limit, self.cache_cfg.page_size)
-        need = min(need, self.cache_cfg.max_pages_per_seq)
-        shared: list = []
+        need = min(self.allocator.pages_needed(limit, ps), maxP)
+        k = 0
         hashes: list = []
         if use_cache and self.prefix_cache is not None:
             hashes = self._prompt_hashes(req)
             k = self.prefix_cache.match_len(hashes)
-            if not self._ensure_pages(need - k):
+        # tiered KV residency (ISSUE 20): a sequence longer than hot tail
+        # + one stream chunk admits with only its FIRST dispatch's pages;
+        # _tiered_prep grows the table lazily each step and demotes pages
+        # behind the hot tail to the host pool.  ctx_pin rows (context-
+        # cache creation prefills) stay fully resident.
+        tiered = (
+            self.cfg.ctx_hot_pages > 0
+            and self.host_pool is not None
+            and not getattr(req, "ctx_pin", False)
+            and need > k + self.cfg.ctx_hot_pages + self.cfg.ctx_stream_pages
+        )
+        if (
+            self.cfg.ctx_hot_pages > 0
+            and self.host_pool is not None
+            and not tiered
+        ):
+            # short (or pinned) rows on a tiered engine stay fully
+            # resident, so they must fit the physical pool exactly as on
+            # a non-tiered engine
+            limit = min(limit, self._resident_context_cap)
+            need = min(self.allocator.pages_needed(limit, ps), maxP)
+        if tiered:
+            # cover exactly the first dispatch: the first prefill chunk
+            # for long prompts, else the whole prompt plus one decode
+            # token (wave admissions dispatch before any prep pass runs)
+            if plen > self.cfg.max_prefill_len:
+                first = min(limit, self.cfg.max_prefill_len)
+            else:
+                first = min(limit, plen + 1)
+            need_now = min(
+                need, max(k, self.allocator.pages_needed(first, ps))
+            )
+        else:
+            need_now = need
+        shared: list = []
+        if use_cache and self.prefix_cache is not None:
+            if not self._ensure_pages(need_now - k):
                 return None   # blocked retry: no acquire, no stat churn
             shared = self.prefix_cache.acquire(hashes)
-        need_new = need - len(shared)
+        need_new = need_now - len(shared)
         if not self._ensure_pages(need_new):
             if shared:
                 self.prefix_cache.release(shared)
@@ -2069,15 +2212,30 @@ class Engine:
         if shared:
             self._shared_pages.setdefault(req.id, []).extend(shared)
         # pages round up to page granularity; the model context limit
-        # still binds exactly
-        req.max_len = min(
-            len(pages) * self.cache_cfg.page_size, self.max_context_len
-        )
+        # still binds exactly.  Tiered rows keep the full logical limit —
+        # their tables grow lazily, so page count is not a length cap.
+        if tiered:
+            req.max_len = limit
+        else:
+            req.max_len = min(len(pages) * ps, self.max_context_len)
         self.slots[slot] = req
         self._slot_adapters[slot] = adapter_slot
-        table = np.zeros((self.cache_cfg.max_pages_per_seq,), np.int32)
+        table = np.zeros((maxP,), np.int32)
         table[: len(pages)] = pages
         self._page_tables[slot] = table
+        if tiered:
+            # lo == hi == cached prefix pages: the restored/shared head
+            # is never demoted (prefix-cache shares it), keeping the
+            # cold span contiguous past it.  ``table`` is the object
+            # prefill plans alias, so lazy growth/demotion lands in
+            # already-built plans before finalize_device reads them.
+            self._tiered[slot] = {
+                "lo": req.cached_tokens // ps,
+                "hi": req.cached_tokens // ps,
+                "top": len(pages),
+                "rid": req.id,
+                "table": table,
+            }
         return table
 
     def _restore_host_prefix(
@@ -2095,7 +2253,9 @@ class Engine:
         k = len(shared)
         entries: list = []
         digests: list = []
-        while k + len(entries) < len(hashes):
+        # a tiered claim may have allocated fewer pages than the digest
+        # chain is long — restore only what has a device target
+        while k + len(entries) < min(len(hashes), len(pages)):
             h = hashes[k + len(entries)]
             if not self.host_pool.contains(h):
                 break
@@ -2154,7 +2314,10 @@ class Engine:
         device prefix cache so the NEXT sharer hits in HBM."""
         entries: list = []
         digests: list = []
-        while k + len(entries) < len(hashes):
+        while (
+            k + len(entries) < len(hashes)
+            and k + len(entries) < len(pages)
+        ):
             e = self.kv_filestore.get(hashes[k + len(entries)])
             if e is None:   # miss or corrupt — chain ends, recompute
                 break
@@ -2827,7 +2990,10 @@ class Engine:
         of compiled variants).
         """
         n_max = self.cfg.decode_steps_per_sync
-        if n_max <= 1 or self._chunking is not None:
+        if n_max <= 1 or self._chunking is not None or self._cold_active():
+            # cold-middle rows stream staged chunks through the primary
+            # attention call only — the fused tail re-gathers history
+            # without the cold stats, so tiered steps stay single-token
             return 1
         n_active = sum(
             1 for i in range(len(self.slots)) if self._slot_active(i)
@@ -2879,6 +3045,297 @@ class Engine:
         return n
 
     # ------------------------------------------------------------------
+    # tiered KV residency: streamed cold-middle attention (ISSUE 20)
+    # ------------------------------------------------------------------
+
+    @property
+    def kv_cold_pages(self) -> int:
+        """Demoted cold-middle pages currently host-resident across all
+        tiered slots — the saturation gauge for how much context lives
+        past HBM."""
+        return sum(
+            led["hi"] - led["lo"] for led in self._tiered.values()
+        )
+
+    def _cold_active(self) -> bool:
+        """True when any tiered slot has a non-empty demoted span (the
+        next step must stream cold chunks)."""
+        return any(
+            led["hi"] > led["lo"] for led in self._tiered.values()
+        )
+
+    def _ensure_tiered_pages(self, slot: int, led: dict,
+                             upto_tokens: int) -> None:
+        """Grow a tiered slot's page table to cover ``upto_tokens``
+        written positions.  Fresh pages land at the table's high-water
+        mark — both in the ledger's aliased table (already-built plan
+        rows see them) and the engine's [B, maxP] mirror."""
+        ps = self.cache_cfg.page_size
+        maxP = self.cache_cfg.max_pages_per_seq
+        need = min(self.allocator.pages_needed(upto_tokens, ps), maxP)
+        if need <= led["top"]:
+            return
+        n_new = need - led["top"]
+        if not self._ensure_pages(n_new):
+            # demotion runs before growth each step, so the steady-state
+            # footprint is hot tail + one growth margin; failing THAT
+            # means the pool is undersized for the admitted mix
+            raise MemoryError(
+                f"tiered slot {slot} cannot grow its page table by "
+                f"{n_new} page(s) — device pool exhausted even after "
+                "cold demotion"
+            )
+        pages = self.allocator.allocate(led["rid"], n_new)
+        for i, pg in enumerate(pages):
+            led["table"][led["top"] + i] = pg
+            self._page_tables[slot][led["top"] + i] = pg
+        led["top"] = need
+        # dirty WITHOUT marking the slot changed: page tables re-upload
+        # from the host mirror unconditionally, while the slot's
+        # device-evolved PRNG key stream and penalty histogram must
+        # survive (a changed-slot rebuild would reset both — seeded
+        # sampling would silently replay the key stream)
+        self._state_dirty = True
+
+    def _demote_slot(self, slot: int, led: dict, written: int) -> None:
+        """Move fully written pages behind the hot tail to the host pool
+        (checksummed, pinned) and zero their table entries.  ``written``
+        is the number of KV positions already written for this slot —
+        only pages wholly below ``written - ctx_hot_pages * page_size``
+        demote, so the hot tail always stays device-resident."""
+        ps = self.cache_cfg.page_size
+        target = min(
+            written // ps - self.cfg.ctx_hot_pages,
+            self.cache_cfg.max_pages_per_seq,
+        )
+        if target <= led["hi"]:
+            return
+        from helix_tpu.engine.kv_cache import gather_pages
+
+        idxs = list(range(led["hi"], target))
+        page_ids = [int(led["table"][i]) for i in idxs]
+        arrays = gather_pages(self.cache, page_ids)
+        for idx, page, page_arrays in zip(idxs, page_ids, arrays):
+            # pinned: cold pages are the ONLY copy of mid-history KV —
+            # prefix-spill pressure must never evict them
+            if not self.host_pool.put(
+                ("ctx", led["rid"], idx), page_arrays, pinned=True
+            ):
+                break   # host budget full: stop demoting, keep resident
+            self.allocator.detach(led["rid"], [page])
+            self.allocator.give_back([page])
+            led["table"][idx] = 0
+            self._page_tables[slot][idx] = 0
+            led["hi"] = idx + 1
+            self.num_ctx_demoted_pages += 1
+            # table-only change: see _ensure_tiered_pages — never reset
+            # the slot's device key stream / histogram over a demotion
+            self._state_dirty = True
+
+    def _tiered_prep(self, n_extra: int) -> None:
+        """Per-dispatch residency pass for every tiered slot: demote
+        pages that fell behind the hot tail, then grow the table to
+        cover this step's writes.  Demote-first frees the pages growth
+        is about to claim, bounding the per-slot device footprint at
+        hot tail + stream margin."""
+        for slot in sorted(self._tiered):
+            req = self.slots[slot]
+            if req is None:
+                continue
+            led = self._tiered[slot]
+            chunking = (
+                self._chunking is not None
+                and self._chunking.get("slot") == slot
+            )
+            if chunking:
+                written = int(self._chunking["next"])
+                upto = min(
+                    len(req.prompt_tokens),
+                    written + self.cfg.max_prefill_len,
+                )
+            else:
+                written = int(self._positions[slot])
+                upto = written + self._spec_width() + int(n_extra)
+            upto = min(
+                upto,
+                req.max_len or self.cache_cfg.max_seq_len,
+                self.cache_cfg.max_seq_len,
+            )
+            self._demote_slot(slot, led, written)
+            self._ensure_tiered_pages(slot, led, upto)
+
+    def _cold_spans(self) -> list:
+        """Ordered ``(slot, rid, lo, hi)`` for every tiered slot with a
+        non-empty demoted span — the staging order, ascending by slot so
+        the chunk-fold merge order is deterministic."""
+        spans = []
+        for slot in sorted(self._tiered):
+            led = self._tiered[slot]
+            if led["hi"] > led["lo"] and self.slots[slot] is not None:
+                spans.append((slot, led["rid"], led["lo"], led["hi"]))
+        return spans
+
+    def _refresh_cold_staged(self) -> Optional[dict]:
+        """Assemble (or reuse) the staged cold-chunk slab for the
+        current demoted spans: host gathers from the pool (checksum
+        verified — a corrupt page raises ``ColdPageError``), packed into
+        ``[L, nCb, Ct, KVH, D]`` chunk arrays and ``device_put`` as ONE
+        async upload.  Keyed on the exact span set, so ``prefetch_cold``
+        can build it while the previous step is still on device and the
+        dispatch reuses the in-flight handles."""
+        spans = self._cold_spans()
+        if not spans:
+            self._cold_staged = None
+            return None
+        key = tuple((rid, lo, hi) for _s, rid, lo, hi in spans)
+        staged = self._cold_staged
+        if staged is not None and staged["key"] == key:
+            return staged
+        sp = self.cfg.ctx_stream_pages
+        ps = self.cache_cfg.page_size
+        groups = []   # (rid, [page entries], valid tokens) per chunk
+        for _slot, rid, lo, hi in spans:
+            for c0 in range(lo, hi, sp):
+                c1 = min(c0 + sp, hi)
+                entries = []
+                for idx in range(c0, c1):
+                    e = self.host_pool.get(("ctx", rid, idx))
+                    if e is None:
+                        raise ColdPageError(
+                            f"cold KV page {idx} of request {rid} "
+                            "failed checksum verification on restore — "
+                            "refusing to attend corrupt history"
+                        )
+                    entries.append(e)
+                groups.append((rid, entries, (c1 - c0) * ps))
+        nC = len(groups)
+        nCb = 1
+        while nCb < nC:
+            nCb *= 2
+        e0 = groups[0][1][0]
+        L, _ps, KVH, D = np.asarray(e0["k"]).shape
+        Ct = sp * ps
+        kdt = np.asarray(e0["k"]).dtype
+        quant = self.cache_cfg.quantized
+        ck = np.zeros((L, nCb, Ct, KVH, D), kdt)
+        cv = np.zeros((L, nCb, Ct, KVH, D), kdt)
+        lens = np.zeros((nCb,), np.int32)
+        cks = np.zeros((L, nCb, Ct, KVH), np.float32) if quant else None
+        cvs = np.zeros((L, nCb, Ct, KVH), np.float32) if quant else None
+        owners = []
+        for j, (rid, entries, n_tok) in enumerate(groups):
+            ck[:, j, :n_tok] = np.concatenate(
+                [np.asarray(e["k"]) for e in entries], axis=1
+            )
+            cv[:, j, :n_tok] = np.concatenate(
+                [np.asarray(e["v"]) for e in entries], axis=1
+            )
+            lens[j] = n_tok
+            owners.append(rid)
+            if quant:
+                cks[:, j, :n_tok] = np.concatenate(
+                    [np.asarray(e["k_scale"], np.float32)
+                     for e in entries], axis=1
+                )
+                cvs[:, j, :n_tok] = np.concatenate(
+                    [np.asarray(e["v_scale"], np.float32)
+                     for e in entries], axis=1
+                )
+        self._cold_staged = {
+            "key": key,
+            "owners": tuple(owners),
+            "lens": lens,
+            "nCb": nCb,
+            "ct": Ct,
+            "k": jax.device_put(ck),
+            "v": jax.device_put(cv),
+            "ks": None if cks is None else jax.device_put(cks),
+            "vs": None if cvs is None else jax.device_put(cvs),
+        }
+        return self._cold_staged
+
+    def _finalize_cold(self, staged: dict, plan, n_rows: int):
+        """Bind the staged slab to THIS dispatch's row axes: per-chunk
+        owner rows for the prefill segment (plan row index) and the
+        state segment (decode slot), plus each row's demoted token span.
+        A chunk whose owner appears in neither axis keeps row -1 and
+        masks to zero (admission waves during another row's chunked
+        prefill).  Returns ``(cold_arg, cold_chunks, cold_ct)``."""
+        nCb = staged["nCb"]
+        B = len(self.slots)
+        spans = self._cold_spans()
+        rid_prow: dict = {}
+        if plan is not None:
+            for j, r in enumerate(plan.rows):
+                if r.req is not None:
+                    rid_prow[r.req.id] = j
+        ps = self.cache_cfg.page_size
+        prow = np.full((nCb,), -1, np.int32)
+        srow = np.full((nCb,), -1, np.int32)
+        p_lo = np.zeros((max(n_rows, 0),), np.int32)
+        p_hi = np.zeros((max(n_rows, 0),), np.int32)
+        s_lo = np.zeros((B,), np.int32)
+        s_hi = np.zeros((B,), np.int32)
+        span_by_rid = {}
+        for slot, rid, lo, hi in spans:
+            span_by_rid[rid] = (slot, lo, hi)
+            j = rid_prow.get(rid)
+            if j is not None and j < n_rows:
+                p_lo[j] = lo * ps
+                p_hi[j] = hi * ps
+            if self._slot_active(slot):
+                s_lo[slot] = lo * ps
+                s_hi[slot] = hi * ps
+        for c, rid in enumerate(staged["owners"]):
+            got = span_by_rid.get(rid)
+            if got is None:
+                continue
+            slot = got[0]
+            j = rid_prow.get(rid)
+            if j is not None and j < n_rows:
+                prow[c] = j
+            if self._slot_active(slot):
+                srow[c] = slot
+        if not (prow >= 0).any() and not (srow >= 0).any():
+            # nothing in THIS dispatch attends cold history (e.g. an
+            # admission wave while another row owns every span) — skip
+            # the cold argument so the call keeps its legacy trace
+            return None
+        self.num_ctx_stream_chunks += len(staged["owners"])
+        cold_arg = (
+            staged["k"], staged["v"], staged["ks"], staged["vs"],
+            jnp.asarray(prow), jnp.asarray(srow),
+            jnp.asarray(staged["lens"]),
+            jnp.asarray(p_lo), jnp.asarray(p_hi),
+            jnp.asarray(s_lo), jnp.asarray(s_hi),
+        )
+        return cold_arg, nCb, staged["ct"]
+
+    def prefetch_cold(self) -> None:
+        """Stage the NEXT dispatch's cold chunks while the current step
+        is still in flight: demotion gathers and the slab's ``device_put``
+        are async — they enqueue after the dispatched step on the device
+        stream, so the H2D traffic overlaps its compute and the next
+        ``_ragged_step`` finds the handles already uploaded.  Called by
+        ``step()`` / the async loop between dispatch and complete."""
+        if not self._tiered:
+            return
+        for slot in sorted(self._tiered):
+            req = self.slots[slot]
+            if req is None:
+                continue
+            led = self._tiered[slot]
+            if (
+                self._chunking is not None
+                and self._chunking.get("slot") == slot
+            ):
+                written = int(self._chunking["next"])
+            else:
+                written = int(self._positions[slot])
+            self._demote_slot(slot, led, written)
+        self._refresh_cold_staged()
+
+    # ------------------------------------------------------------------
     # preemption-by-swap (ISSUE 6)
     # ------------------------------------------------------------------
 
@@ -2905,6 +3362,11 @@ class Engine:
         slot = req.slot
         if not self._slot_active(slot):
             return False   # mid-chunked-prefill: nothing decodable to park
+        if slot in self._tiered:
+            # a tiered row's cold pages already live in the host pool
+            # under ("ctx", ...) keys — swap-out would double-spill and
+            # resume could not rebuild the demoted table; shed instead
+            return False
         # capture the device-evolving sampler state AFTER making the
         # device copy current — bit-exact resume needs the key stream
         # and penalty histogram exactly where the last step left them
@@ -3093,6 +3555,12 @@ class Engine:
             return None
         if req.image_embeds is not None or req.positions3 is not None:
             return None   # VL requests pin device-resident image state
+        if req.slot is not None and req.slot in self._tiered:
+            # tiered rows have demoted pages only this engine's host
+            # pool holds — a snapshot gathered from the device table
+            # would carry holes; migration of cold-middle rows is out
+            # of scope (the caller degrades to shed/replay)
+            return None
         base = self._snapshot_base(req)
         parked = next(
             (st for st in self.preempted if st.req is req), None
@@ -3778,6 +4246,14 @@ class Engine:
         token-bucket (plus the has-history / row-capacity variants the
         plan implies).  Returns ``(p_first, sampled, emit, extra,
         drops)`` device handles."""
+        if self._tiered:
+            # tiered rows: demote pages behind the hot tail, then grow
+            # tables to cover this step's writes — BEFORE the state sync
+            # so the uploaded mirrors carry the post-demotion tables.
+            # Plan rows alias the same table ndarrays (plan.add stores
+            # np.asarray(table)), so mutations land in already-built
+            # plans before finalize_device below reads them.
+            self._tiered_prep(n_extra)
         if self._state_dirty or self._dstate is None:
             self._sync_state()
         if drafts is None:
@@ -3828,10 +4304,20 @@ class Engine:
                     hist_tokens // self.cache_cfg.page_size,
                     self.cache_cfg.max_pages_per_seq,
                 )
+        cold_arg = None
+        cold_chunks = 0
+        cold_ct = 0
+        if self._tiered:
+            staged = self._refresh_cold_staged()
+            if staged is not None:
+                bound = self._finalize_cold(staged, plan, rows)
+                if bound is not None:
+                    cold_arg, cold_chunks, cold_ct = bound
         fn = _build_ragged_step_fn(
             self.model_cfg, self.cache_cfg.page_size, self._backend,
             self.mesh, rung, has_hist, rows, self._spec_width(),
             self._n_tail_max, ring_hist, pool_slots,
+            cold_chunks, cold_ct,
         )
         self.num_device_calls += 1
         self._note_adapter_rows(plan, draft_len)
@@ -3839,7 +4325,7 @@ class Engine:
          drops) = fn(
             self._graft_params(), self.cache, self._dstate, pargs,
             jnp.asarray(drafts), jnp.asarray(draft_len),
-            jnp.int32(n_extra),
+            jnp.int32(n_extra), cold_arg,
         )
         return p_first, sampled, emit, extra, drops
 
@@ -3866,6 +4352,11 @@ class Engine:
         newly prefilled full pages transfer ownership (detached from the
         allocator so request teardown can't free them out from under a
         future sharer)."""
+        if req.slot is not None and req.slot in self._tiered:
+            # tiered tables grow lazily and demote — prompt pages may
+            # already be host-resident, so neither prefix adoption nor
+            # filestore write-through can gather them from the device
+            return
         if self.prefix_cache is None:
             return
         hashes = self._prompt_hashes(req)
@@ -3927,6 +4418,13 @@ class Engine:
         req.finished = True
         req.finish_reason = reason
         if req.slot is not None:
+            led = self._tiered.pop(req.slot, None)
+            if led is not None:
+                # drop the cold pages' host residency and any staged
+                # chunk slab that references them
+                for idx in range(led["lo"], led["hi"]):
+                    self.host_pool.discard(("ctx", led["rid"], idx))
+                self._cold_staged = None
             self.slots[req.slot] = None
             self._state_dirty = True
             self._changed_slots.add(req.slot)

@@ -553,6 +553,18 @@ def page_checksum(arrays: dict) -> bytes:
     return _page_checksum(arrays)
 
 
+class ColdPageError(RuntimeError):
+    """A tiered sequence's demoted cold-middle page failed checksum
+    verification (or vanished from the host pool) at stream time.
+
+    Unlike a prefix-cache restore miss — which truncates the chain and
+    recomputes, correct by construction — a cold-middle page has no
+    recompute path mid-decode: the tokens it holds were already
+    conditioned on.  The ONLY safe outcome is a typed failure for this
+    request; attending garbage KV would silently corrupt every
+    subsequent token."""
+
+
 class _HostPage:
     """One spilled page: host copies of its K/V (+ int8 scale rows).
 

@@ -47,6 +47,7 @@ SATURATION_KEYS = (
     "preempted_requests",  # decoders swapped out, parked for resume
     "prefill_budget_tokens",  # scheduler prefill-admission budget/step
     "adapters_resident",  # multi-LoRA adapters in the HBM pool (ISSUE 15)
+    "kv_cold_pages",     # demoted cold-middle KV pages host-resident (ISSUE 20)
 )
 
 

@@ -65,6 +65,7 @@ import jax
 import jax.numpy as jnp
 
 from helix_tpu.ops.attention import DEFAULT_MASK_VALUE, mha_reference
+from helix_tpu.parallel.ring_attention import _merge_stats
 
 
 def paged_decode_attention_reference(
@@ -128,6 +129,62 @@ def _row_of_tokens(t0, q_len, T: int):
     return jnp.where(in_row, cand, -1), t - start
 
 
+def _cold_chunk_stats(q, row, cold_k, cold_v, cold_row, cold_len, *,
+                      scale, k_scale=None, v_scale=None):
+    """Online-softmax stats of the flat queries vs. staged cold chunks.
+
+    ``cold_k``/``cold_v`` are ONE layer's staged cold-middle chunks
+    ``[nC, Ct, KVH, D]`` (pool dtype; ``k_scale``/``v_scale`` are the
+    matching ``[nC, Ct, KVH]`` scale slabs for int8 pools), ``cold_row``
+    the owning flat-axis row per chunk (-1 = padding chunk) and
+    ``cold_len`` the valid token count per chunk.  A ``lax.scan`` in
+    ascending chunk order folds each chunk's blockwise stats into a
+    running ``(m, l, acc)`` with the exact ``ring_attention`` combine —
+    the deterministic merge order is what keeps tiered runs reproducible.
+    Cold tokens all precede every live query (they are the demoted middle
+    of the history), so no causal mask is needed: ownership + chunk
+    length decide visibility.  Returns fp32 ``(m [1,H,T,1], l, acc
+    [1,H,T,D])``.
+    """
+    T, H, D = q.shape
+    KVH = cold_k.shape[2]
+    qf = q[None].astype(jnp.float32)                     # [1, T, H, D]
+    acc0 = jnp.zeros((1, H, T, D), jnp.float32)
+    l0 = jnp.zeros((1, H, T, 1), jnp.float32)
+    m0 = l0 - jnp.inf
+
+    def fold(carry, xs):
+        m, l, acc = carry
+        ck, cv, crow, clen, cks, cvs = xs                # [Ct, KVH, D], ...
+        if cks is not None:
+            ck = ck.astype(jnp.float32) * cks[..., None]
+            cv = cv.astype(jnp.float32) * cvs[..., None]
+        ck = ck.astype(q.dtype)
+        cv = cv.astype(q.dtype)
+        if KVH != H:
+            ck = jnp.repeat(ck, H // KVH, axis=1)
+            cv = jnp.repeat(cv, H // KVH, axis=1)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk",
+            qf,
+            ck[None].astype(jnp.float32),
+        ) * scale
+        ok = (row[:, None] == crow) & (row[:, None] >= 0)  # [T, 1]
+        ok = ok & (jnp.arange(ck.shape[0])[None, :] < clen)
+        s = jnp.where(ok[None, None], s, DEFAULT_MASK_VALUE)
+        bm = jnp.max(s, axis=-1, keepdims=True)
+        bp = jnp.exp(s - bm)
+        bl = jnp.sum(bp, axis=-1, keepdims=True)
+        bacc = jnp.einsum(
+            "bhqk,bkhd->bhqd", bp, cv[None].astype(jnp.float32)
+        )
+        return _merge_stats(m, l, acc, bm, bl, bacc), None
+
+    xs = (cold_k, cold_v, cold_row, cold_len, k_scale, v_scale)
+    (m, l, acc), _ = jax.lax.scan(fold, (m0, l0, acc0), xs)
+    return m, l, acc
+
+
 def ragged_paged_attention_reference(
     q,            # [T, H, D] flat fresh queries
     k_new,        # [T, KVH, D] fresh K/V, attended raw
@@ -143,13 +200,33 @@ def ragged_paged_attention_reference(
     scale: Optional[float] = None,
     k_scale=None,  # [L, N, P, KVH] f32 — int8 pools' scale pools
     v_scale=None,
+    span_lo=None,  # [R] int32 — first cold (non-resident) history token
+    span_hi=None,  # [R] int32 — one past the last cold history token
+    cold_k=None,   # [L, nC, Ct, KVH, D] staged cold-middle chunks
+    cold_v=None,
+    cold_row=None,     # [nC] int32 — owning row per chunk (-1 = padding)
+    cold_len=None,     # [nC] int32 — valid tokens per chunk
+    cold_k_scale=None,  # [L, nC, Ct, KVH] f32 — int8 chunk scales
+    cold_v_scale=None,
 ) -> jax.Array:
     """XLA oracle for the ragged contract: gather every row's pages, build
     one segment-masked kv axis (R histories + the fresh tokens) and run
     the plain-softmax oracle.  Numerics match the pre-unification callers:
     history dequantized then cast to the compute dtype, fresh K/V raw,
     masked positions at ``DEFAULT_MASK_VALUE`` (``exp`` → exactly 0.0, so
-    the gather's fixed ``maxP`` width cannot perturb live sums)."""
+    the gather's fixed ``maxP`` width cannot perturb live sums).
+
+    Tiered KV residency (``span_lo``/``span_hi`` + ``cold_*``): row r's
+    history tokens in ``[span_lo[r], span_hi[r])`` are NOT pages-resident
+    (their table entries were demoted to the host tier and point at
+    garbage) — they are excluded from the hot gather's mask and instead
+    attended from the staged cold chunks via the online-softmax
+    ``(m, l, acc)`` combine, chunks first in ascending order, then the
+    hot+fresh block, so one deterministic merge reproduces the monolithic
+    masked softmax over the identical values.  ``span_lo == span_hi == 0``
+    rows are fully resident and unaffected; with no tiered arguments the
+    legacy single-softmax path runs byte-identically.
+    """
     T, H, D = q.shape
     R, maxP = tables.shape
     _, N, P, KVH, _ = k_pages.shape
@@ -168,8 +245,14 @@ def ragged_paged_attention_reference(
     kh = kh.astype(q.dtype).reshape(1, R * Hs, KVH, D)
     vh = vh.astype(q.dtype).reshape(1, R * Hs, KVH, D)
     hist_tok = jnp.arange(Hs)
+    resident = hist_tok[None, :] < hist[:, None]          # [R, Hs]
+    if span_lo is not None:
+        cold = (hist_tok[None, :] >= span_lo[:, None]) & (
+            hist_tok[None, :] < span_hi[:, None]
+        )
+        resident = resident & ~cold
     kv_seg_h = jnp.where(
-        hist_tok[None, :] < hist[:, None],
+        resident,
         jnp.arange(R)[:, None] + 1,
         0,
     ).reshape(1, R * Hs)
@@ -181,16 +264,51 @@ def ragged_paged_attention_reference(
     seg_fresh = jnp.where(row >= 0, row + 1, 0)
     kv_seg = jnp.concatenate([kv_seg_h, seg_fresh[None]], axis=1)
     kv_pos = jnp.concatenate([kv_pos_h, q_pos[None]], axis=1)
-    out = mha_reference(
-        q[None], k_all, v_all,
-        causal=True,
-        q_positions=q_pos[None],
-        kv_positions=kv_pos,
-        q_segment_ids=seg_fresh[None],
-        kv_segment_ids=kv_seg,
+    if cold_k is None:
+        out = mha_reference(
+            q[None], k_all, v_all,
+            causal=True,
+            q_positions=q_pos[None],
+            kv_positions=kv_pos,
+            q_segment_ids=seg_fresh[None],
+            kv_segment_ids=kv_seg,
+            scale=scale,
+        )
+        return out[0]
+
+    # Streamed path: cold chunk stats first (ascending chunk order), then
+    # the hot + fresh block's stats, one final combine.  Same masked
+    # logits as ``mha_reference`` would build for the hot block.
+    cm, cl, cacc = _cold_chunk_stats(
+        q, row, cold_k[layer], cold_v[layer], cold_row, cold_len,
         scale=scale,
+        k_scale=None if cold_k_scale is None else cold_k_scale[layer],
+        v_scale=None if cold_v_scale is None else cold_v_scale[layer],
     )
-    return out[0]
+    kf = k_all if k_all.shape[2] == H else jnp.repeat(
+        k_all, H // KVH, axis=2
+    )
+    vf = v_all if v_all.shape[2] == H else jnp.repeat(
+        v_all, H // KVH, axis=2
+    )
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk",
+        q[None].astype(jnp.float32),
+        kf.astype(jnp.float32),
+    ) * scale
+    mask = q_pos[None][:, None, :, None] >= kv_pos[:, None, None, :]
+    mask = mask & (
+        seg_fresh[None][:, None, :, None] == kv_seg[:, None, None, :]
+    )
+    s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    hm = jnp.max(s, axis=-1, keepdims=True)
+    hp = jnp.exp(s - hm)
+    hl = jnp.sum(hp, axis=-1, keepdims=True)
+    hacc = jnp.einsum("bhqk,bkhd->bhqd", hp, vf.astype(jnp.float32))
+    m, l, acc = _merge_stats(cm, cl, cacc, hm, hl, hacc)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (acc / l).transpose(0, 2, 1, 3)                 # [1, T, H, D]
+    return out[0].astype(q.dtype)
 
 
 def ragged_paged_attention(
@@ -209,18 +327,32 @@ def ragged_paged_attention(
     backend: Optional[str] = None,
     k_scale=None,  # [L, N, P, KVH] f32 — int8 pools' scale pools
     v_scale=None,
+    span_lo=None,  # [R] tiered rows: cold history span start (tokens)
+    span_hi=None,
+    cold_k=None,   # [L, nC, Ct, KVH, D] staged cold-middle chunks
+    cold_v=None,
+    cold_row=None,
+    cold_len=None,
+    cold_k_scale=None,
+    cold_v_scale=None,
 ):
     """THE paged-attention entry point: every device-step caller (packed/
     chunk prefill, decode, mixed, spec-verify) is a metadata assignment
     over this one contract.  Returns ``out [T, H, D]``.
 
     Dispatcher: Pallas kernel on TPU, XLA gather oracle elsewhere.
+    Tiered-residency metadata (``span_lo``/``cold_*``) routes to the
+    reference path on every backend: the Pallas kernel walks resident
+    pages only and has no carried-stats entry point yet, and silently
+    dropping the cold middle would be wrong KV — the fallback is the
+    honest degrade until the kernel grows the combine.
     """
+    tiered = cold_k is not None or span_lo is not None
     if backend is None:
         platform = jax.devices()[0].platform
         backend = "pallas" if platform in ("tpu", "axon") else "reference"
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
-    if backend == "pallas":
+    if backend == "pallas" and not tiered:
         from helix_tpu.ops.paged_kernel import ragged_paged_attention_tpu
 
         return ragged_paged_attention_tpu(
@@ -230,4 +362,8 @@ def ragged_paged_attention(
     return ragged_paged_attention_reference(
         q, k_new, v_new, k_pages, v_pages, layer, t0, q_len, hist,
         tables, scale=scale, k_scale=k_scale, v_scale=v_scale,
+        span_lo=span_lo, span_hi=span_hi,
+        cold_k=cold_k, cold_v=cold_v,
+        cold_row=cold_row, cold_len=cold_len,
+        cold_k_scale=cold_k_scale, cold_v_scale=cold_v_scale,
     )

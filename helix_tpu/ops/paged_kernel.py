@@ -358,10 +358,25 @@ def ragged_paged_attention_tpu(
     interpret: bool = False,
     k_scale=None,  # [L, N, P, KVH] f32 — present iff the pool is int8
     v_scale=None,
+    **tiered,      # span_lo/span_hi/cold_* — NOT supported in-kernel yet
 ):
     """Returns ``out [T, H, D]``.  Rows may start at any offset; the
     flat axis is padded internally so partial query blocks never DMA out
-    of bounds."""
+    of bounds.
+
+    Tiered-residency metadata (``span_lo``/``span_hi``/``cold_*`` from
+    the streamed cold-middle path) is rejected here: this kernel walks
+    only pages-resident history and carries no external ``(m, l, acc)``
+    stats, so accepting the arguments and ignoring them would silently
+    drop the demoted middle — wrong KV.  The dispatcher in
+    ``helix_tpu.ops.paged`` routes tiered calls to the reference path;
+    the guard keeps any direct caller honest."""
+    if any(v is not None for v in tiered.values()):
+        raise NotImplementedError(
+            "ragged_paged_attention_tpu: tiered cold-middle attention "
+            f"({sorted(k for k, v in tiered.items() if v is not None)}) "
+            "is reference-only; dispatch via ragged_paged_attention"
+        )
     T, H, D = q.shape
     L, N, P, KVH, _ = k_pages.shape
     R, maxP = tables.shape

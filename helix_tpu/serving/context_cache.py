@@ -1,0 +1,391 @@
+"""Context-caching API (ISSUE 20): persist a prompt prefix once, reference it forever.
+
+The serving half of the million-token-context work: tiered KV residency
+(engine/engine.py) makes a huge context *hold*; this module makes it
+*cheap to reuse*.  ``POST /v1/context`` tokenises a prompt prefix, runs
+it through the engine ONCE as a pinned prefill-only request (``ctx_pin``
+forces full device residency so the prefix-cache adoption + filestore
+write-through fire exactly as for any resident prompt), and registers a
+**content-addressed handle** — ``ctx-`` + blake2b of the token bytes —
+in a small registry persisted through the PR 14 filestore root.  A later
+chat/completions request carrying ``context_id`` prepends the cached
+token span; the engine's prefix cache (HBM -> host -> filestore ladder)
+then serves the span's pages without recomputing prefill, so TTFT drops
+to roughly the cost of the *new* tokens only.
+
+Contract, following the residency-ladder discipline:
+
+- handles are **content-addressed**: creating the same prefix twice
+  yields the same handle and charges nothing new — idempotent by
+  construction;
+- creation is **quota'd per tenant** (the PR 7 identity):
+  ``HELIX_CTX_TENANT_TOKENS`` caps the total cached tokens a tenant may
+  hold; past it new creations are rejected with a typed counter, reads
+  are never gated;
+- a registry entry that fails to load degrades to a **miss** (the
+  request is told the handle is unknown; nothing ever attends wrong
+  tokens) with a typed counter;
+- the ``helix_ctx_*`` metric family is minted ONLY here
+  (``tools/lint_metrics.py`` contract 15); the runner's /metrics calls
+  :func:`collect_ctx_metrics`, the node agent heartbeats
+  :meth:`ContextCache.stats_block` via :func:`context_cache_for`, and
+  the control plane clamps the block with :func:`validate_ctx_block`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import math
+import os
+import threading
+import time
+from typing import Optional
+
+log = logging.getLogger("helix.context_cache")
+
+# ---------------------------------------------------------------------------
+# metric vocabulary (lint_metrics contract 15: minted only in this module)
+# ---------------------------------------------------------------------------
+
+CTX_CREATES = "helix_ctx_creates_total"
+CTX_HITS = "helix_ctx_hits_total"
+CTX_MISSES = "helix_ctx_misses_total"
+CTX_QUOTA_REJECTS = "helix_ctx_quota_rejects_total"
+CTX_LOAD_ERRORS = "helix_ctx_load_errors_total"
+CTX_ENTRIES = "helix_ctx_entries"
+CTX_TOKENS = "helix_ctx_tokens"
+
+# handles are content-addressed: the blake2b digest of the token-id
+# bytes, so identical prefixes collapse to one entry across tenants,
+# requests, and restarts
+_HANDLE_DIGEST_CHARS = 24
+
+
+def ctx_tenant_token_cap() -> int:
+    """HELIX_CTX_TENANT_TOKENS: total cached prompt tokens one tenant
+    may hold across its context handles (0/unset = unlimited)."""
+    try:
+        return int(os.environ.get("HELIX_CTX_TENANT_TOKENS", "0") or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def context_handle(token_ids) -> str:
+    """The content-addressed handle for a token prefix."""
+    h = hashlib.blake2b(digest_size=16)
+    for t in token_ids:
+        h.update(int(t).to_bytes(4, "little", signed=False))
+    return "ctx-" + h.hexdigest()[:_HANDLE_DIGEST_CHARS]
+
+
+class ContextCache:
+    """Handle -> cached-prompt-prefix registry, persisted through the
+    filestore root (``root=''`` = in-memory only, dies with the
+    process — dev/tests).
+
+    Thread contract: HTTP handler threads create/resolve concurrently
+    and the heartbeat thread reads ``stats_block``; one lock guards the
+    registry, metric counters are plain GIL-atomic int reads."""
+
+    # registry blobs live under one reserved owner prefix in the
+    # backing store — KV page blobs (kv-pages) and user files share the
+    # same root without colliding (Filestore._resolve keeps owners
+    # disjoint)
+    OWNER = "ctx-cache"
+
+    def __init__(self, root: str = "",
+                 tenant_token_cap: Optional[int] = None):
+        self.root = root
+        self.store = None
+        if root:
+            from helix_tpu.control.filestore import Filestore
+
+            self.store = Filestore(root)
+        self.tenant_token_cap = (
+            tenant_token_cap if tenant_token_cap is not None
+            else ctx_tenant_token_cap()
+        )
+        self._lock = threading.Lock()
+        # handle -> {"tenant", "tokens" (count), "created"}; the token
+        # ids themselves load lazily from per-handle blobs so startup
+        # and heartbeats never touch million-token payloads
+        self._index: dict = {}
+        # handle -> list[int], populated on create / first resolve
+        self._tokens: dict = {}
+        # typed counters (scrape-time GIL-atomic reads)
+        self.creates = 0
+        self.hits = 0
+        self.misses = 0
+        self.quota_rejects = 0
+        self.load_errors = 0
+        if self.store is not None:
+            self._index = self._load_index()
+
+    # -- persistence -------------------------------------------------------
+    def _index_path(self) -> str:
+        return "index.json"
+
+    def _blob_path(self, handle: str) -> str:
+        return f"{handle[4:6] or '00'}/{handle}.json"
+
+    def _load_index(self) -> dict:
+        try:
+            doc = json.loads(
+                self.store.read(self.OWNER, self._index_path())
+            )
+            return {
+                str(h): {
+                    "tenant": str(e.get("tenant", "")),
+                    "tokens": int(e.get("tokens", 0)),
+                    "created": float(e.get("created", 0.0)),
+                }
+                for h, e in doc.items()
+                if isinstance(e, dict)
+            }
+        except FileNotFoundError:
+            return {}
+        except Exception:  # noqa: BLE001 — a mangled index resets, never errors
+            log.warning("context-cache index unreadable; starting empty")
+            return {}
+
+    def _save_index_locked(self) -> None:
+        if self.store is None:
+            return
+        try:
+            self.store.write(
+                self.OWNER, self._index_path(),
+                json.dumps(self._index).encode(),
+            )
+        except OSError:
+            log.warning("could not persist context-cache index")
+
+    # -- quota -------------------------------------------------------------
+    def usage(self, tenant: str) -> int:
+        """Total cached tokens charged to ``tenant``."""
+        with self._lock:
+            return sum(
+                e["tokens"] for e in self._index.values()
+                if e["tenant"] == tenant
+            )
+
+    def admit(self, tenant: str, n_tokens: int) -> bool:
+        """Would caching ``n_tokens`` more keep ``tenant`` inside its
+        quota?  False increments the typed reject counter — call once
+        per creation attempt, BEFORE paying the prefill."""
+        if self.tenant_token_cap <= 0:
+            return True
+        if self.usage(tenant) + int(n_tokens) > self.tenant_token_cap:
+            self.quota_rejects += 1
+            return False
+        return True
+
+    # -- registry operations -----------------------------------------------
+    def contains(self, handle: str) -> bool:
+        with self._lock:
+            return handle in self._index
+
+    def put(self, token_ids, tenant: str = "") -> str:
+        """Register a prefix; returns its handle.  Content-addressed:
+        an already-registered prefix returns the existing handle and
+        charges nothing new."""
+        ids = [int(t) for t in token_ids]
+        handle = context_handle(ids)
+        with self._lock:
+            if handle in self._index:
+                return handle
+            self._index[handle] = {
+                "tenant": tenant,
+                "tokens": len(ids),
+                "created": time.time(),
+            }
+            self._tokens[handle] = ids
+            if self.store is not None:
+                try:
+                    self.store.write(
+                        self.OWNER, self._blob_path(handle),
+                        json.dumps(
+                            {"tokens": ids, "tenant": tenant}
+                        ).encode(),
+                    )
+                except OSError:
+                    log.warning(
+                        "could not persist context blob %s", handle
+                    )
+            self._save_index_locked()
+        self.creates += 1
+        return handle
+
+    def get(self, handle: str) -> Optional[list]:
+        """The cached token ids for ``handle``, or None (unknown handle
+        or unreadable blob — both are misses; a request must never
+        attend a prefix we cannot reproduce exactly)."""
+        with self._lock:
+            known = handle in self._index
+            ids = self._tokens.get(handle)
+        if not known:
+            self.misses += 1
+            return None
+        if ids is not None:
+            self.hits += 1
+            return list(ids)
+        # index knows it but the tokens are not memory-resident: a
+        # restart with a persisted registry — load the blob lazily
+        try:
+            raw = self.store.read(self.OWNER, self._blob_path(handle))
+            doc = json.loads(raw)
+            ids = [int(t) for t in doc["tokens"]]
+            if context_handle(ids) != handle:
+                raise ValueError("content address mismatch")
+        except Exception as e:  # noqa: BLE001 — unreadable blob = typed miss
+            self.load_errors += 1
+            self.misses += 1
+            log.warning("dropping unreadable context %s: %s", handle, e)
+            with self._lock:
+                self._index.pop(handle, None)
+                self._save_index_locked()
+            return None
+        with self._lock:
+            self._tokens[handle] = ids
+        self.hits += 1
+        return list(ids)
+
+    def delete(self, handle: str) -> bool:
+        with self._lock:
+            if handle not in self._index:
+                return False
+            self._index.pop(handle, None)
+            self._tokens.pop(handle, None)
+            if self.store is not None:
+                try:
+                    self.store.delete(self.OWNER, self._blob_path(handle))
+                except (FileNotFoundError, PermissionError, OSError):
+                    pass
+            self._save_index_locked()
+        return True
+
+    def entries(self) -> list:
+        """Bounded listing for the HTTP surface (metadata only)."""
+        with self._lock:
+            return [
+                {"id": h, "tokens": e["tokens"], "created": e["created"]}
+                for h, e in sorted(
+                    self._index.items(), key=lambda kv: kv[1]["created"]
+                )
+            ]
+
+    # -- observability -----------------------------------------------------
+    def stats_block(self) -> dict:
+        """The heartbeat ctx block (clamped server-side by
+        :func:`validate_ctx_block` like every runner-supplied block);
+        ``{}`` while empty and idle so heartbeats stay small."""
+        with self._lock:
+            entries = len(self._index)
+            tokens = sum(e["tokens"] for e in self._index.values())
+        if not entries and not (self.creates or self.hits or self.misses):
+            return {}
+        return {
+            "entries": entries,
+            "tokens": tokens,
+            "creates": self.creates,
+            "hits": self.hits,
+            "misses": self.misses,
+            "quota_rejects": self.quota_rejects,
+        }
+
+
+# one cache per filestore root per process: the OpenAI surface creates
+# and resolves handles, the node agent heartbeats the same instance's
+# stats — they must agree
+_CACHES: dict = {}
+_CACHES_LOCK = threading.Lock()
+
+
+def context_cache_for(root: str = "") -> ContextCache:
+    """The process-wide :class:`ContextCache` bound to ``root`` (the
+    PR 14 filestore root; '' = in-memory)."""
+    with _CACHES_LOCK:
+        cache = _CACHES.get(root)
+        if cache is None:
+            cache = _CACHES[root] = ContextCache(root)
+        return cache
+
+
+# -- federation wire validation (the PR 7 pattern) ---------------------
+
+
+def _count(v) -> int:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return 0
+    try:
+        f = float(v)
+    except (OverflowError, ValueError):
+        return 0
+    if not math.isfinite(f) or f < 0:
+        return 0
+    return int(min(f, 2**53))
+
+
+def validate_ctx_block(raw) -> dict:
+    """Clamp one runner-supplied context-cache block to the wire
+    schema.  Like the PR 7 tenant blocks this NEVER raises and never
+    rejects: a malformed block (NaN counters, wrong types) degrades to
+    ``{}`` or clamped fields — rejecting would TTL-evict a healthy
+    runner over a telemetry bug."""
+    if not isinstance(raw, dict):
+        return {}
+    out = {
+        k: _count(raw.get(k))
+        for k in ("entries", "tokens", "creates", "hits", "misses",
+                  "quota_rejects")
+    }
+    if not any(out.values()):
+        return {}
+    return out
+
+
+# -- metric minting (lint_metrics contract 15) -------------------------
+#
+# Every helix_ctx_* series is minted HERE and only here; the runner
+# surface imports this collector.
+
+
+def collect_ctx_metrics(c, cache: Optional["ContextCache"]) -> None:
+    """Runner-side context-cache series (scrape-time collector; plain
+    GIL-atomic reads).  No-op before a cache exists."""
+    if cache is None:
+        return
+    with cache._lock:
+        entries = len(cache._index)
+        tokens = sum(e["tokens"] for e in cache._index.values())
+    c.gauge(
+        CTX_ENTRIES, entries,
+        help="Context handles registered on this runner",
+    )
+    c.gauge(
+        CTX_TOKENS, tokens,
+        help="Total prompt tokens held across context handles",
+    )
+    c.counter(
+        CTX_CREATES, cache.creates,
+        help="Context handles created (prefix prefilled + registered)",
+    )
+    c.counter(
+        CTX_HITS, cache.hits,
+        help="Requests that resolved a context handle (cached-span "
+             "prefill skipped via the prefix-cache ladder)",
+    )
+    c.counter(
+        CTX_MISSES, cache.misses,
+        help="context_id references that resolved to no usable entry",
+    )
+    c.counter(
+        CTX_QUOTA_REJECTS, cache.quota_rejects,
+        help="Context creations rejected by the per-tenant token quota",
+    )
+    c.counter(
+        CTX_LOAD_ERRORS, cache.load_errors,
+        help="Persisted context blobs dropped as unreadable/mismatched "
+             "(degrade to miss, never wrong tokens)",
+    )

@@ -894,6 +894,9 @@ class EngineLoop:
                 if getattr(eng, "adapter_pool", None) is not None
                 else 0
             ),
+            # tiered KV residency (ISSUE 20): cold-middle pages demoted
+            # to host RAM — how much admitted context lives past HBM
+            "kv_cold_pages": int(getattr(eng, "kv_cold_pages", 0)),
         }
         # schema lockstep: this summary IS the per-engine instance of the
         # shared heartbeat schema — emit exactly its key set
@@ -1337,6 +1340,7 @@ class EngineLoop:
             hp.restored_pages if hp is not None else 0,
             getattr(eng, "num_preemptions", 0),
             getattr(eng, "num_resumes", 0),
+            getattr(eng, "num_ctx_stream_chunks", 0),
         )
 
     def _resume_failures_pending(self) -> bool:
@@ -1347,7 +1351,8 @@ class EngineLoop:
         failed: Optional[str] = None, timing: Optional[dict] = None,
     ) -> None:
         eng = self.engine
-        p0, pad0, d0, a0, q0, sd0, sa0, sp0, rs0, pe0, re0 = pre
+        (p0, pad0, d0, a0, q0, sd0, sa0, sp0, rs0, pe0, re0,
+         cs0) = pre
         hp = getattr(eng, "host_pool", None)
         prefill = eng.num_prefill_tokens - p0
         decode = eng.num_decode_tokens - d0
@@ -1403,6 +1408,12 @@ class EngineLoop:
             "preemptions": getattr(eng, "num_preemptions", 0) - pe0,
             "resumes": getattr(eng, "num_resumes", 0) - re0,
             "host_pool_pages": hp.pages if hp is not None else 0,
+            # tiered KV residency (ISSUE 20): cold chunks streamed
+            # through attention this step, and the cold-page gauge
+            "ctx_stream_chunks": (
+                getattr(eng, "num_ctx_stream_chunks", 0) - cs0
+            ),
+            "kv_cold_pages": int(getattr(eng, "kv_cold_pages", 0)),
             # the scheduler's prefill-admission budget in force this
             # step (0 = unbudgeted)
             "prefill_budget_tokens": int(
@@ -1650,6 +1661,12 @@ class EngineLoop:
                     self.pipelined_steps += 1
                 elif pend is not None:
                     t_w = time.monotonic()
+                    if hasattr(self.engine, "prefetch_cold"):
+                        # stage the NEXT step's cold-middle KV chunks
+                        # while the dispatched step still runs on the
+                        # device — the gathers queue behind the step on
+                        # the device stream, so this is free overlap
+                        self.engine.prefetch_cold()
                     self.engine.step_complete(pend, emitted)
                     pend = None
                     dt_wait += time.monotonic() - t_w

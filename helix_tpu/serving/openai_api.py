@@ -47,7 +47,11 @@ from helix_tpu.serving.engine_loop import (
     QUEUE_FULL,
     SHUTTING_DOWN,
 )
-from helix_tpu.serving.kv_filestore import collect_filestore_kv
+from helix_tpu.serving.context_cache import (
+    collect_ctx_metrics,
+    context_cache_for,
+)
+from helix_tpu.serving.kv_filestore import collect_filestore_kv, kv_filestore_dir
 from helix_tpu.serving.multihost_serving import collect_mh_metrics
 from helix_tpu.serving.migration import (
     DISAGG_HEADER,
@@ -187,6 +191,10 @@ class OpenAIServer:
         # attaches, so token events buffer here until /v1/migrate/resume
         # claims them (or the migration timeout aborts the orphan)
         self._imported = ImportedStreams()
+        # context-caching registry (ISSUE 20): shared with the node
+        # agent's heartbeat block via the per-root singleton; persisted
+        # through the PR 14 filestore root when one is armed
+        self.ctx_cache = context_cache_for(kv_filestore_dir())
         # max seconds between consecutive engine events for one request
         # before the server gives up on it (wedged engine watchdog)
         self.inter_token_timeout = (
@@ -207,6 +215,12 @@ class OpenAIServer:
         # LoRA checkpoint for `model@adapter` serving — no restart, no
         # hot-swap, no recompile (the pool shape compiled at warmup)
         app.router.add_post("/v1/adapters", self.publish_adapter)
+        # context-caching API (ISSUE 20): persist a prompt prefix once
+        # (prefilled + adopted into the residency ladder), reference it
+        # from chat/completions via context_id — the cached span's
+        # prefill is skipped on every reuse
+        app.router.add_post("/v1/context", self.create_context)
+        app.router.add_get("/v1/context", self.list_contexts)
         app.router.add_post("/v1/chat/completions", self.chat_completions)
         app.router.add_post("/v1/completions", self.completions)
         app.router.add_post("/v1/embeddings", self.embeddings)
@@ -328,6 +342,10 @@ class OpenAIServer:
         # mismatch counters from the node agent's prober, minted ONLY
         # by obs/canary.py (lint contract 14); no-op until one starts
         collect_canary_metrics(c, default_prober())
+        # context-caching registry (ISSUE 20): handle/token gauges and
+        # create/hit/miss/quota counters, minted ONLY by
+        # serving/context_cache.py (lint contract 15)
+        collect_ctx_metrics(c, self.ctx_cache)
         for m in self.registry.list():
             if m.loop is None:
                 continue
@@ -1770,6 +1788,135 @@ class OpenAIServer:
         return resp
 
     # ------------------------------------------------------------------
+    # -- context-caching API (ISSUE 20) --------------------------------
+    def _resolve_context(self, body: dict, trace_id: str = ""):
+        """Resolve a request's ``context_id`` to its cached token span.
+        Returns ``(prefix_ids, error_response)`` — ``([], None)`` when
+        the request references no context.  An unknown or unreadable
+        handle is a clean 404 (typed miss), never silent recompute of a
+        prefix the caller believes is pinned."""
+        ctx_id = body.get("context_id", "")
+        if not ctx_id:
+            return [], None
+        if not isinstance(ctx_id, str):
+            return [], _error(
+                400, "'context_id' must be a string", trace_id=trace_id
+            )
+        cached = self.ctx_cache.get(ctx_id)
+        if cached is None:
+            return [], _error(
+                404, f"context '{ctx_id}' not found (expired, evicted, "
+                "or never created on this runner)",
+                "invalid_request_error", code="context_not_found",
+                trace_id=trace_id,
+            )
+        return cached, None
+
+    async def create_context(self, request):
+        """``POST /v1/context``: prefill a prompt prefix once and pin it
+        behind a content-addressed handle.  The prefix runs through the
+        engine as an ordinary one-token request with ``ctx_pin`` set —
+        fully resident even on a tiered engine, so the prefix-cache
+        adoption and the filestore write-through fire exactly as for any
+        resident prompt — then the handle registers in the (tenant-
+        quota'd, filestore-persisted) registry.  Requests that later
+        carry ``context_id`` prepend the span and the residency ladder
+        serves its pages without recomputing prefill."""
+        from helix_tpu.serving.context_cache import context_handle
+
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        tid = self._trace_id(request)
+        tenant = self._tenant(request)
+        model = body.get("model", "")
+        served, adapter, err = await self._lookup_generation(model)
+        if err is not None:
+            return err
+        if served.kind == "embedding":
+            return _error(404, f"model '{model}' is an embedding model",
+                          "model_not_found", trace_id=tid)
+        err = self._require_loop(served, model)
+        if err is not None:
+            return err
+        messages = body.get("messages")
+        prompt = body.get("prompt")
+        if messages:
+            # no generation prompt: this span is a PREFIX later
+            # requests extend, not a turn awaiting an answer
+            prompt_ids = served.tokenizer.apply_chat_template(
+                messages, add_generation_prompt=False
+            )
+        elif isinstance(prompt, list) and all(
+            isinstance(t, int) for t in prompt
+        ):
+            prompt_ids = list(prompt)
+        elif isinstance(prompt, str) and prompt:
+            prompt_ids = served.tokenizer.encode(prompt)
+        else:
+            return _error(
+                400, "'messages' or 'prompt' is required", trace_id=tid
+            )
+        if not prompt_ids:
+            return _error(400, "context prefix is empty", trace_id=tid)
+        handle = context_handle(prompt_ids)
+        if self.ctx_cache.contains(handle):
+            # content-addressed: the prefix is already pinned — answer
+            # without paying another prefill and without a new charge
+            return web.json_response({
+                "id": handle, "object": "context", "created": _now(),
+                "model": model, "tokens": len(prompt_ids),
+                "cached": True,
+            }, headers={TRACE_HEADER: tid})
+        if not self.ctx_cache.admit(tenant, len(prompt_ids)):
+            return _error(
+                429,
+                f"tenant '{tenant}' is over its context-cache token "
+                f"quota ({self.ctx_cache.tenant_token_cap} tokens)",
+                "overloaded_error", code="context_quota_exceeded",
+                trace_id=tid,
+            )
+        shed = self._precheck_admission(
+            served, prompt_ids, trace_id=tid, tenant=tenant
+        )
+        if shed is not None:
+            return shed
+        # prefill-once: one greedy token forces the full prefix through
+        # the engine; ctx_pin keeps it fully device-resident so every
+        # page adopts into the prefix cache (and writes through to the
+        # filestore tier when armed)
+        sampling = SamplingParams(temperature=0.0, max_tokens=1)
+        extra = {"ctx_pin": True}
+        if adapter:
+            extra["adapter"] = adapter
+        t0 = time.monotonic()
+        try:
+            async for _delta, _tok, finished, _reason in self._generate(
+                served, prompt_ids, sampling, extra, trace_id=tid,
+                tenant=tenant,
+            ):
+                if finished:
+                    break
+        except EngineRequestError as e:
+            return _engine_error_response(e, trace_id=tid)
+        handle = self.ctx_cache.put(prompt_ids, tenant=tenant)
+        self.traces.record(
+            tid, "context create", t0, time.monotonic(),
+            plane="runner", model=model, prompt_tokens=len(prompt_ids),
+            handle=handle, tenant=tenant,
+        )
+        return web.json_response({
+            "id": handle, "object": "context", "created": _now(),
+            "model": model, "tokens": len(prompt_ids),
+            "cached": False,
+        }, headers={TRACE_HEADER: tid})
+
+    async def list_contexts(self, request):
+        return web.json_response({
+            "object": "list", "data": self.ctx_cache.entries(),
+        })
+
     async def chat_completions(self, request):
         try:
             body = await request.json()
@@ -1827,6 +1974,14 @@ class OpenAIServer:
             # path: the engine resolves the id to an HBM pool slot at
             # admission (ISSUE 15)
             extra = {**(extra or {}), "adapter": adapter}
+        # context-cache reference (ISSUE 20): prepend the pinned span —
+        # the prefix-cache ladder serves its pages, so prefill covers
+        # only the NEW tokens
+        ctx_prefix, ctx_err = self._resolve_context(body, trace_id=tid)
+        if ctx_err is not None:
+            return ctx_err
+        if ctx_prefix:
+            prompt_ids = list(ctx_prefix) + list(prompt_ids)
         shed = self._precheck_admission(
             served, prompt_ids, trace_id=tid, tenant=tenant
         )
@@ -2003,6 +2158,12 @@ class OpenAIServer:
         sampling = self._sampling_from_body(body)
         t_admit = time.monotonic()
         prompt_ids = served.tokenizer.encode(prompt)
+        # context-cache reference (ISSUE 20) — see chat_completions
+        ctx_prefix, ctx_err = self._resolve_context(body, trace_id=tid)
+        if ctx_err is not None:
+            return ctx_err
+        if ctx_prefix:
+            prompt_ids = list(ctx_prefix) + list(prompt_ids)
         shed = self._precheck_admission(
             served, prompt_ids, trace_id=tid, tenant=tenant
         )

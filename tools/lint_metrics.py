@@ -791,6 +791,60 @@ def _is_canary_mod(path: str, root: str) -> bool:
     return os.path.relpath(path, root) == _CANARY_MOD
 
 
+# -- contract 15: the context cache is one subsystem --------------------------
+# ISSUE 20: every ``helix_ctx_*`` series (handle/token gauges, the
+# create/hit/miss/quota counters) is minted ONLY by
+# helix_tpu/serving/context_cache.py; the OpenAI surface scrapes
+# through its collector, the node agent heartbeats the shared per-root
+# registry, and the control plane clamps the block with its validator.
+# A second minting site would fork the pinned-prefix accounting the way
+# ad-hoc saturation gauges forked contract 1.
+_CTX_NAME_RE = re.compile(r"""["']helix_ctx_[a-z0-9_]*["']""")
+_CTX_MOD = os.path.join("helix_tpu", "serving", "context_cache.py")
+# (file, required symbol): creation/resolution metrics, heartbeat
+# summary, and wire clamping all route through the owning module
+_CTX_IMPORTERS = (
+    (
+        os.path.join("helix_tpu", "serving", "openai_api.py"),
+        "collect_ctx_metrics",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "node_agent.py"),
+        "context_cache_for",
+    ),
+    (
+        os.path.join("helix_tpu", "control", "server.py"),
+        "validate_ctx_block",
+    ),
+)
+
+
+def _is_ctx_mod(path: str, root: str) -> bool:
+    return os.path.relpath(path, root) == _CTX_MOD
+
+
+def _ctx_importer_violations(root: str) -> list:
+    violations = []
+    mod = os.path.join(root, _CTX_MOD)
+    if not os.path.isfile(mod):
+        return [
+            "helix_tpu/serving/context_cache.py: missing — the "
+            "context-cache vocabulary must live there"
+        ]
+    for rel, symbol in _CTX_IMPORTERS:
+        path = os.path.join(root, rel)
+        if not os.path.isfile(path):
+            continue
+        with open(path, encoding="utf-8", errors="replace") as f:
+            if symbol not in f.read():
+                violations.append(
+                    f"{rel}: does not call {symbol} from "
+                    "helix_tpu/serving/context_cache.py (the "
+                    "context-cache importer pattern)"
+                )
+    return violations
+
+
 def _canary_importer_violations(root: str) -> list:
     violations = []
     mod = os.path.join(root, _CANARY_MOD)
@@ -934,6 +988,7 @@ def run(root: str) -> list:
     violations += _mh_importer_violations(root)
     violations += _trace_importer_violations(root)
     violations += _canary_importer_violations(root)
+    violations += _ctx_importer_violations(root)
     sched_reasons, sched_violations = _load_sched_schema(root)
     violations += sched_violations
     sched_reason_res = [
@@ -957,7 +1012,14 @@ def run(root: str) -> list:
         mh_emitter = _is_mh(path, root)
         trace_emitter = _is_trace_mod(path, root)
         canary_emitter = _is_canary_mod(path, root)
+        ctx_emitter = _is_ctx_mod(path, root)
         for i, line in enumerate(lines, 1):
+            if not ctx_emitter and _CTX_NAME_RE.search(line):
+                violations.append(
+                    f"{rel}:{i}: helix_ctx_* metric family named "
+                    "outside helix_tpu/serving/context_cache.py — "
+                    "context-cache series must come from its module"
+                )
             if not trace_emitter and _TRACE_NAME_RE.search(line):
                 violations.append(
                     f"{rel}:{i}: helix_trace_*/helix_cp_trace* metric "
